@@ -1,0 +1,32 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference serving framework.
+
+Capability-parity rebuild of NVIDIA Dynamo (reference: /root/reference) designed
+TPU-first: the compute path is JAX/XLA/Pallas, intra-model parallelism is
+jax.sharding over device meshes, and the data plane is built for TPU-VM pods
+(ICI within a slice, DCN + host-staged DMA across slices) instead of
+NCCL/NVLink/RDMA.
+
+Top-level layout (mirrors the reference's capability map, SURVEY.md §1/§2):
+
+- ``dynamo_tpu.tokens``     — token block hashing (ref: lib/tokens/src/lib.rs)
+- ``dynamo_tpu.runtime``    — distributed runtime: control plane (discovery,
+  leases, request plane, event streams), component/endpoint model, streaming
+  response plane (ref: lib/runtime/)
+- ``dynamo_tpu.protocols``  — OpenAI + internal wire types (ref: lib/llm/src/protocols/)
+- ``dynamo_tpu.llm``        — preprocessor, detokenizer backend, migration,
+  model cards, discovery/watcher (ref: lib/llm/src/)
+- ``dynamo_tpu.router``     — KV-aware routing: radix indexer, scheduler,
+  events (ref: lib/llm/src/kv_router/)
+- ``dynamo_tpu.mocker``     — simulated engine for distributed tests without
+  TPUs (ref: lib/llm/src/mocker/)
+- ``dynamo_tpu.engine``     — the native JAX engine: paged KV cache,
+  continuous batching, sampling (replaces vLLM/SGLang/TRT-LLM backends)
+- ``dynamo_tpu.models``     — model families (Llama, ...) as functional JAX
+- ``dynamo_tpu.ops``        — Pallas TPU kernels + portable jnp fallbacks
+- ``dynamo_tpu.parallel``   — mesh construction, sharding rules, collectives
+- ``dynamo_tpu.frontend``   — OpenAI-compatible HTTP server (ref: lib/llm/src/http/)
+- ``dynamo_tpu.kvbm``       — multi-tier KV block manager (ref: lib/llm/src/block_manager/)
+- ``dynamo_tpu.planner``    — SLA autoscaling planner (ref: components/planner/)
+"""
+
+__version__ = "0.1.0"

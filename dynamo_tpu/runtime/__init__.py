@@ -1,0 +1,37 @@
+"""Distributed runtime: control plane, component model, streaming data plane.
+
+TPU-native rebuild of the reference's ``lib/runtime`` crate (SURVEY.md §2.1).
+The reference composes etcd (discovery/leases) + NATS (request plane/events) +
+direct TCP (response streams). This runtime keeps the same *semantics* behind a
+single self-contained control-plane service (``dynctl``) so a TPU-VM pod needs
+no external infrastructure, while the token hot path still flows over direct
+worker→requester TCP streams exactly like the reference's response plane
+(ref: lib/runtime/src/pipeline/network/tcp/server.rs:62).
+"""
+
+from dynamo_tpu.runtime.control_plane import (
+    ControlPlane,
+    LocalControlPlane,
+    NoRespondersError,
+    RemoteControlPlane,
+    ControlPlaneServer,
+)
+from dynamo_tpu.runtime.runtime import DistributedRuntime
+from dynamo_tpu.runtime.component import Component, Endpoint, Namespace, Client, Instance
+from dynamo_tpu.runtime.context import Context, StreamError
+
+__all__ = [
+    "ControlPlane",
+    "LocalControlPlane",
+    "RemoteControlPlane",
+    "ControlPlaneServer",
+    "NoRespondersError",
+    "DistributedRuntime",
+    "Namespace",
+    "Component",
+    "Endpoint",
+    "Client",
+    "Instance",
+    "Context",
+    "StreamError",
+]

@@ -1,0 +1,40 @@
+"""Length-prefixed msgpack framing for all runtime TCP planes.
+
+Analog of the reference's ``TwoPartCodec`` (ref: lib/runtime/src/pipeline/
+network/codec/two_part.rs:11): every frame is a 4-byte big-endian length
+followed by a msgpack map. A frame's ``t`` field is its type tag; data planes
+put the payload under ``d`` and an optional header under ``h`` — the two-part
+(header, data) split the reference uses for control-vs-payload separation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import msgpack
+
+MAX_FRAME = 256 * 1024 * 1024  # 256 MiB hard cap (KV block transfers can be large)
+
+_LEN = struct.Struct(">I")
+
+
+def pack_frame(obj: Any) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    """Read one frame; raises IncompleteReadError/ConnectionError on EOF."""
+    hdr = await reader.readexactly(4)
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    body = await reader.readexactly(n)
+    return msgpack.unpackb(body, raw=False)
+
+
+async def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    writer.write(pack_frame(obj))
+    await writer.drain()

@@ -1,0 +1,381 @@
+"""Namespace → Component → Endpoint service model with discovery.
+
+Mirrors the reference's component model and path scheme exactly so ops tooling
+carries over (ref: lib/runtime/src/component.rs:75-110,460-467,520):
+
+- instance key:   ``instances/<ns>/<comp>/<ep>:<lease-hex>``
+- request subject: ``<ns>_<comp>.<ep>-<lease-hex>``
+
+A served endpoint registers a control-plane request handler on its subject and
+writes its instance key under its process's primary lease; lease loss (crash,
+network partition, shutdown) deletes the key, and every client's prefix watch
+drops the instance — that is the failure-detection path.
+
+Requests carry a response-plane ``ConnectionInfo`` so token streams flow
+worker→requester directly (ref: egress/addressed_router.rs:60-230); the
+control-plane reply is only an acceptance ack. In-process endpoints
+short-circuit through asyncio queues with no sockets or hub round-trip.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Callable, Optional
+
+import msgpack
+
+from dynamo_tpu.runtime.context import Context, StreamError, STREAM_ERR_MSG
+from dynamo_tpu.runtime.control_plane import NoRespondersError, Watch
+from dynamo_tpu.runtime.response_plane import (
+    ConnectionInfo,
+    ResponseReceiver,
+    StreamSender,
+    make_local_stream,
+)
+
+logger = logging.getLogger("dynamo.component")
+
+INSTANCE_ROOT = "instances"
+
+#: handler(request, context) -> async iterator of response payloads
+EndpointHandler = Callable[[Any, Context], AsyncIterator[Any]]
+
+
+def instance_key(ns: str, comp: str, ep: str, lease_id: int) -> str:
+    return f"{INSTANCE_ROOT}/{ns}/{comp}/{ep}:{lease_id:x}"
+
+
+def instance_subject(ns: str, comp: str, ep: str, lease_id: int) -> str:
+    return f"{ns}_{comp}.{ep}-{lease_id:x}"
+
+
+@dataclass(frozen=True)
+class Instance:
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int  # lease id
+
+    @property
+    def subject(self) -> str:
+        return instance_subject(self.namespace, self.component, self.endpoint, self.instance_id)
+
+    def to_wire(self) -> dict:
+        return {
+            "namespace": self.namespace,
+            "component": self.component,
+            "endpoint": self.endpoint,
+            "instance_id": self.instance_id,
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "Instance":
+        return Instance(d["namespace"], d["component"], d["endpoint"], d["instance_id"])
+
+
+class Namespace:
+    def __init__(self, runtime, name: str):
+        self._runtime = runtime
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self._runtime, self, name)
+
+
+class Component:
+    def __init__(self, runtime, namespace: Namespace, name: str):
+        self._runtime = runtime
+        self.namespace = namespace
+        self.name = name
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self._runtime, self, name)
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace.name}/{self.name}"
+
+
+class ServeHandle:
+    """Handle to a live served endpoint; ``stop()`` deregisters it."""
+
+    def __init__(self, endpoint: "Endpoint", lease_id: int, cancel_serve, inflight: set):
+        self.endpoint = endpoint
+        self.lease_id = lease_id
+        self._cancel_serve = cancel_serve
+        self._inflight = inflight
+        self._stopped = asyncio.Event()
+
+    async def stop(self, graceful: bool = True):
+        rt = self.endpoint._runtime
+        key = instance_key(
+            self.endpoint.component.namespace.name,
+            self.endpoint.component.name,
+            self.endpoint.name,
+            self.lease_id,
+        )
+        await rt.plane.kv_delete(key)
+        if self._cancel_serve:
+            await self._cancel_serve()
+        rt._local_endpoints.pop(
+            instance_subject(
+                self.endpoint.component.namespace.name,
+                self.endpoint.component.name,
+                self.endpoint.name,
+                self.lease_id,
+            ),
+            None,
+        )
+        if graceful and self._inflight:
+            await asyncio.gather(*list(self._inflight), return_exceptions=True)
+        self._stopped.set()
+
+    async def wait(self):
+        await self._stopped.wait()
+
+
+class Endpoint:
+    def __init__(self, runtime, component: Component, name: str):
+        self._runtime = runtime
+        self.component = component
+        self.name = name
+
+    @property
+    def path(self) -> str:
+        return f"{self.component.path}/{self.name}"
+
+    async def serve_endpoint(
+        self,
+        handler: EndpointHandler,
+        metadata: Optional[dict] = None,
+        lease_id: Optional[int] = None,
+    ) -> ServeHandle:
+        """Register this endpoint and start handling requests.
+
+        ``handler(request, context)`` must return an async iterator of
+        msgpack-serializable responses (ref: component/endpoint.rs:61).
+        """
+        rt = self._runtime
+        ns, comp, ep = self.component.namespace.name, self.component.name, self.name
+        lease = lease_id if lease_id is not None else await rt.primary_lease()
+        subject = instance_subject(ns, comp, ep, lease)
+        inflight: set[asyncio.Task] = set()
+
+        async def on_request(payload: bytes) -> bytes:
+            envelope = msgpack.unpackb(payload, raw=False)
+            ctx = Context.from_wire(envelope.get("ctx", {}))
+            info = ConnectionInfo.from_wire(envelope["conn"])
+            # Connect the response stream BEFORE acking so a worker that
+            # cannot reach the requester fails the request instead of
+            # leaving the requester waiting on a stream that never opens.
+            try:
+                sender = await StreamSender.connect(info, ctx)
+            except Exception as e:
+                logger.exception("failed to open response stream to %s:%s", info.host, info.port)
+                return msgpack.packb({"ok": False, "error": f"response stream connect failed: {e!r}"})
+            task = asyncio.get_running_loop().create_task(
+                _pump_handler(handler, envelope.get("req"), ctx, sender)
+            )
+            inflight.add(task)
+            task.add_done_callback(inflight.discard)
+            return msgpack.packb({"ok": True})
+
+        cancel_serve = await rt.plane.serve(subject, on_request)
+        # in-process short-circuit path
+        rt._local_endpoints[subject] = (handler, inflight)
+
+        inst = Instance(ns, comp, ep, lease)
+        meta = dict(metadata or {})
+        value = msgpack.packb({**inst.to_wire(), "metadata": meta})
+        key = instance_key(ns, comp, ep, lease)
+        created = await rt.plane.kv_create(key, value, lease_id=lease)
+        if not created:
+            await rt.plane.kv_put(key, value, lease_id=lease)
+        logger.info("serving %s (instance %x)", subject, lease)
+        return ServeHandle(self, lease, cancel_serve, inflight)
+
+    def client(self) -> "Client":
+        return Client(self._runtime, self)
+
+
+async def _pump_handler(handler: EndpointHandler, request: Any, ctx: Context, sender: StreamSender):
+    """Drive one request through a handler, pumping output into a sender.
+
+    Shared by the remote (socket) and in-process (queue) paths so their
+    error/cancellation semantics cannot diverge.
+    """
+    try:
+        async for item in handler(request, ctx):
+            if ctx.cancelled:
+                break
+            await sender.send(item)
+        await sender.complete()
+    except asyncio.CancelledError:
+        await sender.error("worker shutting down")
+        raise
+    except Exception as e:
+        logger.exception("endpoint handler failed")
+        try:
+            await sender.error(f"handler error: {e!r}")
+        except Exception:
+            pass
+
+
+class Client:
+    """Endpoint client: discovery watch + random/round-robin/direct routing.
+
+    Combines the reference's endpoint ``Client`` (ref: component/client.rs) and
+    ``PushRouter`` (ref: pipeline/network/egress/push_router.rs:33): it watches
+    the instance prefix, keeps live/down sets, and on ``NoResponders`` or a
+    broken stream reports the instance down so the next pick avoids it.
+    """
+
+    def __init__(self, runtime, endpoint: Endpoint):
+        self._runtime = runtime
+        self.endpoint = endpoint
+        self._instances: dict[int, Instance] = {}
+        self._down: set[int] = set()
+        self._watch: Optional[Watch] = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._ready = asyncio.Event()
+        self._rr = 0
+        # Trailing ':' so an endpoint name that is a prefix of a sibling
+        # ("gen" vs "generate") cannot absorb the sibling's instances.
+        self._prefix = (
+            f"{INSTANCE_ROOT}/{endpoint.component.namespace.name}/"
+            f"{endpoint.component.name}/{endpoint.name}:"
+        )
+
+    async def start(self) -> "Client":
+        self._watch = await self._runtime.plane.watch_prefix(self._prefix)
+        for k, v in self._watch.snapshot.items():
+            self._apply("put", k, v)
+        self._ready.set()
+        self._watch_task = asyncio.get_running_loop().create_task(self._watch_loop())
+        return self
+
+    async def stop(self):
+        if self._watch_task:
+            self._watch_task.cancel()
+        if self._watch:
+            await self._watch.cancel()
+
+    async def _watch_loop(self):
+        try:
+            async for ev in self._watch:
+                try:
+                    self._apply(ev.type, ev.key, ev.value)
+                except Exception:
+                    # One bad instance value must not kill discovery.
+                    logger.exception("ignoring malformed instance event for %s", ev.key)
+        except asyncio.CancelledError:
+            pass
+
+    def _apply(self, typ: str, key: str, value: bytes):
+        # key = instances/<ns>/<comp>/<ep>:<lease-hex>
+        try:
+            lease_hex = key.rsplit(":", 1)[1]
+            iid = int(lease_hex, 16)
+        except (IndexError, ValueError):
+            return
+        if typ == "put":
+            d = msgpack.unpackb(value, raw=False)
+            self._instances[iid] = Instance.from_wire(d)
+            self._down.discard(iid)
+        else:
+            self._instances.pop(iid, None)
+            self._down.discard(iid)
+
+    def instance_ids(self) -> list[int]:
+        return sorted(self._instances)
+
+    def available_ids(self) -> list[int]:
+        return sorted(set(self._instances) - self._down)
+
+    def report_instance_down(self, instance_id: int):
+        logger.warning("instance %x reported down", instance_id)
+        self._down.add(instance_id)
+
+    async def wait_for_instances(self, timeout: float = 30.0) -> list[int]:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            ids = self.available_ids()
+            if ids:
+                return ids
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(f"no instances for {self._prefix}")
+            await asyncio.sleep(0.05)
+
+    # -- routing --
+    def _pick(self, mode: str, instance_id: Optional[int]) -> Instance:
+        if mode == "direct":
+            assert instance_id is not None
+            inst = self._instances.get(instance_id)
+            if inst is None:
+                raise NoRespondersError(f"instance {instance_id:x} not found")
+            return inst
+        ids = self.available_ids()
+        if not ids:
+            raise NoRespondersError(self._prefix)
+        if mode == "random":
+            return self._instances[random.choice(ids)]
+        # round robin
+        self._rr += 1
+        return self._instances[ids[self._rr % len(ids)]]
+
+    async def generate(
+        self,
+        request: Any,
+        ctx: Optional[Context] = None,
+        mode: str = "round_robin",
+        instance_id: Optional[int] = None,
+        retries: int = 1,
+    ) -> ResponseReceiver:
+        """Issue a request; returns a receiver over the response stream."""
+        ctx = ctx or Context()
+        attempts = 0
+        while True:
+            inst = self._pick(mode, instance_id)
+            try:
+                return await self._generate_to(inst, request, ctx)
+            except NoRespondersError:
+                self.report_instance_down(inst.instance_id)
+                attempts += 1
+                if mode == "direct" or attempts > retries:
+                    raise
+
+    async def _generate_to(self, inst: Instance, request: Any, ctx: Context) -> ResponseReceiver:
+        rt = self._runtime
+        local = rt._local_endpoints.get(inst.subject)
+        if local is not None:
+            handler, inflight = local
+            info, receiver, queue = make_local_stream(ctx)
+            sender = StreamSender.local(queue)
+            task = asyncio.get_running_loop().create_task(
+                _pump_handler(handler, request, ctx, sender)
+            )
+            inflight.add(task)
+            task.add_done_callback(inflight.discard)
+            return receiver
+
+        server = await rt.response_server()
+        info, receiver = server.register_stream(ctx)
+        envelope = msgpack.packb(
+            {"ctx": ctx.to_wire(), "conn": info.to_wire(), "req": request}
+        )
+        try:
+            ack = await rt.plane.request(inst.subject, envelope, timeout=10.0)
+        except NoRespondersError:
+            server.abandon_stream(info)
+            raise
+        except Exception:
+            server.abandon_stream(info)
+            raise
+        resp = msgpack.unpackb(ack, raw=False)
+        if not resp.get("ok"):
+            server.abandon_stream(info)
+            raise StreamError(resp.get("error", STREAM_ERR_MSG))
+        return receiver

@@ -1,0 +1,54 @@
+"""Runtime configuration from ``DYN_*`` environment variables.
+
+Env-first configuration like the reference (ref: lib/runtime/src/config.rs):
+
+- ``DYN_CONTROL_PLANE``  — ``host:port`` of the dynctl server; unset means
+  single-process mode with an in-process control plane.
+- ``DYN_LEASE_TTL``      — primary lease TTL seconds (default 10).
+- ``DYN_NAMESPACE``      — default namespace (default ``dynamo``).
+- ``DYN_LOG``            — log level (default info).
+- ``DYN_LOGGING_JSONL``  — JSONL log lines when truthy.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class RuntimeConfig:
+    control_plane_address: Optional[str] = field(
+        default_factory=lambda: os.environ.get("DYN_CONTROL_PLANE")
+    )
+    lease_ttl: float = field(default_factory=lambda: _env_float("DYN_LEASE_TTL", 10.0))
+    namespace: str = field(default_factory=lambda: os.environ.get("DYN_NAMESPACE", "dynamo"))
+
+    @staticmethod
+    def from_env() -> "RuntimeConfig":
+        return RuntimeConfig()
+
+
+_LOGGING_CONFIGURED = False
+
+
+def setup_logging():
+    global _LOGGING_CONFIGURED
+    if _LOGGING_CONFIGURED:
+        return
+    _LOGGING_CONFIGURED = True
+    level = os.environ.get("DYN_LOG", "info").upper()
+    if os.environ.get("DYN_LOGGING_JSONL"):
+        fmt = '{"ts":"%(asctime)s","level":"%(levelname)s","target":"%(name)s","msg":"%(message)s"}'
+    else:
+        fmt = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+    logging.basicConfig(level=getattr(logging, level, logging.INFO), format=fmt)

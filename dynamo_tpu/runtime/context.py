@@ -1,0 +1,59 @@
+"""Request context: id, cancellation, annotations, trace propagation.
+
+Analog of the reference's pipeline ``Context`` (ref: lib/runtime/src/pipeline/
+context.rs:1-517): every request carries a stable id end-to-end (it doubles as
+the ``x-request-id`` correlation header), a cooperative cancellation token that
+propagates across process hops, and free-form annotations that operators can
+attach (e.g. ``formatted_prompt``, ``token_ids``, ``query_instance_id``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+#: Sentinel emitted into a response stream when the producing worker died
+#: mid-stream; the migration operator keys off it
+#: (ref: lib/runtime/src/pipeline/network.rs:31).
+STREAM_ERR_MSG = "stream disconnected"
+
+
+class StreamError(Exception):
+    """A response stream terminated abnormally (worker died / transport lost)."""
+
+
+@dataclass
+class Context:
+    id: str = field(default_factory=lambda: uuid.uuid4().hex)
+    annotations: dict[str, Any] = field(default_factory=dict)
+    traceparent: Optional[str] = None
+    _cancel_event: asyncio.Event = field(default_factory=asyncio.Event, repr=False)
+
+    def cancel(self) -> None:
+        self._cancel_event.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel_event.is_set()
+
+    async def wait_cancelled(self) -> None:
+        await self._cancel_event.wait()
+
+    def child(self) -> "Context":
+        """A child context sharing the cancellation token and id."""
+        c = Context(id=self.id, annotations=dict(self.annotations), traceparent=self.traceparent)
+        c._cancel_event = self._cancel_event
+        return c
+
+    def to_wire(self) -> dict:
+        return {"id": self.id, "annotations": self.annotations, "traceparent": self.traceparent}
+
+    @staticmethod
+    def from_wire(d: dict) -> "Context":
+        return Context(
+            id=d.get("id") or uuid.uuid4().hex,
+            annotations=d.get("annotations") or {},
+            traceparent=d.get("traceparent"),
+        )

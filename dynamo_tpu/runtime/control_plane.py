@@ -1,0 +1,892 @@
+"""Self-contained control plane with etcd + NATS semantics.
+
+The reference runtime leans on two external services (SURVEY.md §2.1): etcd for
+discovery/leases/watches (ref: lib/runtime/src/transports/etcd.rs:35) and NATS
+for the request plane, events, queues and object store (ref: transports/
+nats.rs:48,426). A TPU-VM pod should not need either, so this module provides
+one service — ``dynctl`` — with both semantic sets:
+
+- **KV + leases + prefix watches** (etcd): ``kv_put/kv_create/kv_get/
+  kv_get_prefix/kv_delete``, leases with TTL + keepalive whose expiry deletes
+  attached keys and fires watch delete events.
+- **Pub/sub + request/reply** (NATS core): subjects with optional queue
+  groups; ``request`` raises :class:`NoRespondersError` when nothing serves
+  the subject — the same signal the reference uses for instant fault
+  detection (ref: pipeline/network/egress/push_router.rs:229).
+- **Durable streams + object store** (NATS JetStream): append-only logs with
+  consumer offsets (KV events ride these) and a bucket/name byte store
+  (radix snapshots).
+
+Two interchangeable implementations: :class:`LocalControlPlane` (pure
+in-process asyncio — used single-process and as the server's core) and
+:class:`RemoteControlPlane` (TCP client to a :class:`ControlPlaneServer`).
+Because the server *wraps* a LocalControlPlane, cross-process behavior is
+identical to in-process behavior by construction.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Awaitable, Callable, Optional
+
+from dynamo_tpu.runtime.codec import read_frame, write_frame
+
+logger = logging.getLogger("dynamo.control_plane")
+
+DEFAULT_LEASE_TTL = 10.0
+SWEEP_INTERVAL = 1.0
+STREAM_MAX_LEN = 65536  # per-stream ring buffer cap
+
+
+class NoRespondersError(Exception):
+    """No service instance is listening on the requested subject."""
+
+
+class ControlPlaneClosed(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str  # "put" | "delete"
+    key: str
+    value: bytes = b""
+
+
+class Watch:
+    """Prefix watch: a snapshot plus a live event queue."""
+
+    def __init__(self, snapshot: dict[str, bytes], queue: "asyncio.Queue[Optional[WatchEvent]]", cancel):
+        self.snapshot = snapshot
+        self._queue = queue
+        self._cancel = cancel
+
+    def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        return self._iter()
+
+    async def _iter(self):
+        while True:
+            ev = await self._queue.get()
+            if ev is None:
+                return
+            yield ev
+
+    async def cancel(self) -> None:
+        await self._cancel()
+
+
+class Subscription:
+    """Pub/sub subscription handle yielding ``(subject, payload)``."""
+
+    def __init__(self, queue: "asyncio.Queue[Optional[tuple[str, bytes]]]", cancel):
+        self._queue = queue
+        self._cancel = cancel
+
+    def __aiter__(self):
+        return self._iter()
+
+    async def _iter(self):
+        while True:
+            item = await self._queue.get()
+            if item is None:
+                return
+            yield item
+
+    async def cancel(self) -> None:
+        await self._cancel()
+
+
+class StreamSub:
+    """Durable-stream subscription yielding ``(seq, payload)`` from a start offset."""
+
+    def __init__(self, queue: "asyncio.Queue[Optional[tuple[int, bytes]]]", cancel):
+        self._queue = queue
+        self._cancel = cancel
+
+    def __aiter__(self):
+        return self._iter()
+
+    async def _iter(self):
+        while True:
+            item = await self._queue.get()
+            if item is None:
+                return
+            yield item
+
+    async def cancel(self) -> None:
+        await self._cancel()
+
+
+ServiceHandler = Callable[[bytes], Awaitable[bytes]]
+
+
+class ControlPlane(abc.ABC):
+    """Abstract control-plane client surface. All methods are coroutine-safe."""
+
+    # -- KV (etcd semantics) --
+    @abc.abstractmethod
+    async def kv_put(self, key: str, value: bytes, lease_id: Optional[int] = None) -> None: ...
+
+    @abc.abstractmethod
+    async def kv_create(self, key: str, value: bytes, lease_id: Optional[int] = None) -> bool:
+        """Create-if-absent; returns False when the key already exists."""
+
+    @abc.abstractmethod
+    async def kv_get(self, key: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    async def kv_get_prefix(self, prefix: str) -> dict[str, bytes]: ...
+
+    @abc.abstractmethod
+    async def kv_delete(self, key: str) -> int: ...
+
+    @abc.abstractmethod
+    async def kv_delete_prefix(self, prefix: str) -> int: ...
+
+    @abc.abstractmethod
+    async def watch_prefix(self, prefix: str) -> Watch: ...
+
+    # -- Leases --
+    @abc.abstractmethod
+    async def lease_create(self, ttl: float = DEFAULT_LEASE_TTL) -> int: ...
+
+    @abc.abstractmethod
+    async def lease_keepalive(self, lease_id: int) -> bool: ...
+
+    @abc.abstractmethod
+    async def lease_revoke(self, lease_id: int) -> None: ...
+
+    # -- Pub/sub + request/reply (NATS semantics) --
+    @abc.abstractmethod
+    async def publish(self, subject: str, payload: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def subscribe(self, subject: str, queue_group: Optional[str] = None) -> Subscription: ...
+
+    @abc.abstractmethod
+    async def request(self, subject: str, payload: bytes, timeout: float = 30.0) -> bytes: ...
+
+    @abc.abstractmethod
+    async def serve(self, subject: str, handler: ServiceHandler, queue_group: Optional[str] = None):
+        """Register a request handler; returns an awaitable-cancel handle."""
+
+    # -- Durable streams (JetStream semantics) --
+    @abc.abstractmethod
+    async def stream_publish(self, stream: str, payload: bytes) -> int: ...
+
+    @abc.abstractmethod
+    async def stream_subscribe(self, stream: str, start_seq: int = 0) -> StreamSub: ...
+
+    @abc.abstractmethod
+    async def stream_last_seq(self, stream: str) -> int: ...
+
+    # -- Object store --
+    @abc.abstractmethod
+    async def object_put(self, bucket: str, name: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def object_get(self, bucket: str, name: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+
+# --------------------------------------------------------------------------
+# Local (in-process) implementation
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _Lease:
+    id: int
+    ttl: float
+    deadline: float
+    keys: set[str] = field(default_factory=set)
+    owner: Optional[object] = None  # connection tag for revoke-on-disconnect
+
+
+@dataclass
+class _ServiceReg:
+    subject: str
+    handler: ServiceHandler
+    queue_group: Optional[str]
+    owner: Optional[object] = None
+
+
+def _subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style: exact match, or trailing ``>`` matches any suffix."""
+    if pattern.endswith(">"):
+        return subject.startswith(pattern[:-1])
+    return pattern == subject
+
+
+class LocalControlPlane(ControlPlane):
+    """In-process control plane; also the core of :class:`ControlPlaneServer`."""
+
+    def __init__(self):
+        self._kv: dict[str, bytes] = {}
+        self._key_lease: dict[str, int] = {}
+        self._leases: dict[int, _Lease] = {}
+        self._next_lease = int(time.time() * 1000) << 16 | random.getrandbits(16)
+        self._watches: list[tuple[str, asyncio.Queue]] = []
+        self._subs: list[tuple[str, Optional[str], asyncio.Queue]] = []
+        self._services: list[_ServiceReg] = []
+        self._rr: dict[str, int] = {}
+        self._streams: dict[str, tuple[int, list[tuple[int, bytes]]]] = {}  # first_seq offset handling
+        self._stream_subs: dict[str, list[asyncio.Queue]] = {}
+        self._objects: dict[tuple[str, str], bytes] = {}
+        self._closed = False
+        self._sweeper: Optional[asyncio.Task] = None
+
+    def _ensure_sweeper(self):
+        if self._sweeper is None or self._sweeper.done():
+            self._sweeper = asyncio.get_running_loop().create_task(self._sweep_loop())
+
+    async def _sweep_loop(self):
+        try:
+            while not self._closed:
+                await asyncio.sleep(SWEEP_INTERVAL)
+                now = time.monotonic()
+                expired = [l.id for l in self._leases.values() if l.deadline < now]
+                for lid in expired:
+                    logger.info("lease %x expired", lid)
+                    await self.lease_revoke(lid)
+        except asyncio.CancelledError:
+            pass
+
+    # -- KV --
+    def _notify(self, ev: WatchEvent):
+        for prefix, q in self._watches:
+            if ev.key.startswith(prefix):
+                q.put_nowait(ev)
+
+    async def kv_put(self, key, value, lease_id=None):
+        self._kv[key] = value
+        self._attach_lease(key, lease_id)
+        self._notify(WatchEvent("put", key, value))
+
+    def _attach_lease(self, key: str, lease_id: Optional[int]):
+        old = self._key_lease.pop(key, None)
+        if old is not None and old in self._leases:
+            self._leases[old].keys.discard(key)
+        if lease_id is not None:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise ValueError(f"unknown lease {lease_id:#x}")
+            lease.keys.add(key)
+            self._key_lease[key] = lease_id
+
+    async def kv_create(self, key, value, lease_id=None) -> bool:
+        if key in self._kv:
+            return False
+        await self.kv_put(key, value, lease_id)
+        return True
+
+    async def kv_get(self, key):
+        return self._kv.get(key)
+
+    async def kv_get_prefix(self, prefix):
+        return {k: v for k, v in self._kv.items() if k.startswith(prefix)}
+
+    async def kv_delete(self, key) -> int:
+        if key in self._kv:
+            del self._kv[key]
+            self._attach_lease(key, None)
+            self._notify(WatchEvent("delete", key))
+            return 1
+        return 0
+
+    async def kv_delete_prefix(self, prefix) -> int:
+        keys = [k for k in self._kv if k.startswith(prefix)]
+        for k in keys:
+            await self.kv_delete(k)
+        return len(keys)
+
+    async def watch_prefix(self, prefix) -> Watch:
+        q: asyncio.Queue = asyncio.Queue()
+        entry = (prefix, q)
+        self._watches.append(entry)
+        snapshot = await self.kv_get_prefix(prefix)
+
+        async def cancel():
+            if entry in self._watches:
+                self._watches.remove(entry)
+            q.put_nowait(None)
+
+        return Watch(snapshot, q, cancel)
+
+    # -- Leases --
+    async def lease_create(self, ttl=DEFAULT_LEASE_TTL, owner=None) -> int:
+        self._ensure_sweeper()
+        self._next_lease += 1
+        lid = self._next_lease
+        self._leases[lid] = _Lease(lid, ttl, time.monotonic() + ttl, owner=owner)
+        return lid
+
+    async def lease_keepalive(self, lease_id) -> bool:
+        lease = self._leases.get(lease_id)
+        if lease is None:
+            return False
+        lease.deadline = time.monotonic() + lease.ttl
+        return True
+
+    async def lease_revoke(self, lease_id):
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        for key in list(lease.keys):
+            await self.kv_delete(key)
+
+    async def revoke_owned(self, owner):
+        """Drop every lease/service/sub owned by a disconnected remote client."""
+        for lid in [l.id for l in self._leases.values() if l.owner is owner]:
+            await self.lease_revoke(lid)
+        self._services = [s for s in self._services if s.owner is not owner]
+
+    # -- Pub/sub --
+    async def publish(self, subject, payload):
+        groups: dict[str, list[asyncio.Queue]] = {}
+        for pattern, qg, q in self._subs:
+            if _subject_matches(pattern, subject):
+                if qg is None:
+                    q.put_nowait((subject, payload))
+                else:
+                    groups.setdefault(qg, []).append(q)
+        for qs in groups.values():
+            random.choice(qs).put_nowait((subject, payload))
+
+    async def subscribe(self, subject, queue_group=None) -> Subscription:
+        q: asyncio.Queue = asyncio.Queue()
+        entry = (subject, queue_group, q)
+        self._subs.append(entry)
+
+        async def cancel():
+            if entry in self._subs:
+                self._subs.remove(entry)
+            q.put_nowait(None)
+
+        return Subscription(q, cancel)
+
+    # -- Request/reply --
+    async def request(self, subject, payload, timeout=30.0) -> bytes:
+        regs = [s for s in self._services if _subject_matches(s.subject, subject)]
+        if not regs:
+            raise NoRespondersError(subject)
+        idx = self._rr.get(subject, 0)
+        self._rr[subject] = idx + 1
+        reg = regs[idx % len(regs)]
+        return await asyncio.wait_for(reg.handler(payload), timeout)
+
+    async def serve(self, subject, handler, queue_group=None, owner=None):
+        reg = _ServiceReg(subject, handler, queue_group, owner)
+        self._services.append(reg)
+
+        async def cancel():
+            if reg in self._services:
+                self._services.remove(reg)
+
+        return cancel
+
+    def has_responder(self, subject: str) -> bool:
+        return any(_subject_matches(s.subject, subject) for s in self._services)
+
+    # -- Durable streams --
+    async def stream_publish(self, stream, payload) -> int:
+        seq, entries = self._streams.get(stream, (0, []))
+        seq += 1
+        entries.append((seq, payload))
+        if len(entries) > STREAM_MAX_LEN:
+            entries[:] = entries[-STREAM_MAX_LEN:]
+        self._streams[stream] = (seq, entries)
+        for q in self._stream_subs.get(stream, []):
+            q.put_nowait((seq, payload))
+        return seq
+
+    async def stream_subscribe(self, stream, start_seq=0) -> StreamSub:
+        q: asyncio.Queue = asyncio.Queue()
+        _, entries = self._streams.get(stream, (0, []))
+        for seq, payload in entries:
+            if seq > start_seq:
+                q.put_nowait((seq, payload))
+        self._stream_subs.setdefault(stream, []).append(q)
+
+        async def cancel():
+            subs = self._stream_subs.get(stream, [])
+            if q in subs:
+                subs.remove(q)
+            q.put_nowait(None)
+
+        return StreamSub(q, cancel)
+
+    async def stream_last_seq(self, stream) -> int:
+        seq, _ = self._streams.get(stream, (0, []))
+        return seq
+
+    # -- Object store --
+    async def object_put(self, bucket, name, data):
+        self._objects[(bucket, name)] = data
+
+    async def object_get(self, bucket, name):
+        return self._objects.get((bucket, name))
+
+    async def close(self):
+        self._closed = True
+        if self._sweeper:
+            self._sweeper.cancel()
+        for _, q in self._watches:
+            q.put_nowait(None)
+        for _, _, q in self._subs:
+            q.put_nowait(None)
+        for qs in self._stream_subs.values():
+            for q in qs:
+                q.put_nowait(None)
+
+
+# --------------------------------------------------------------------------
+# TCP server + remote client
+# --------------------------------------------------------------------------
+
+
+class ControlPlaneServer:
+    """``dynctl``: exposes a LocalControlPlane over TCP to many processes."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.core = LocalControlPlane()
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set["_ServerConn"] = set()
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    async def start(self) -> str:
+        self._server = await asyncio.start_server(self._on_conn, self._host, self._port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        logger.info("control plane listening on %s", self.address)
+        return self.address
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+        for conn in list(self._conns):
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+        if self._server:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                logger.warning("control-plane server connections did not drain")
+        await self.core.close()
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = _ServerConn(self.core, reader, writer)
+        self._conns.add(conn)
+        try:
+            await conn.run()
+        finally:
+            self._conns.discard(conn)
+
+
+class _ServerConn:
+    """Per-client server-side connection: dispatches ops onto the core plane."""
+
+    def __init__(self, core: LocalControlPlane, reader, writer):
+        self.core = core
+        self.reader = reader
+        self.writer = writer
+        self._wlock = asyncio.Lock()
+        self._watch_tasks: dict[int, asyncio.Task] = {}
+        self._watch_handles: dict[int, Watch] = {}
+        self._sub_tasks: dict[int, asyncio.Task] = {}
+        self._sub_handles: dict[int, object] = {}
+        self._svc_cancels: dict[int, Callable] = {}
+        self._pending_svc: dict[int, asyncio.Future] = {}
+        self._next_rid = 0
+
+    async def _send(self, obj):
+        async with self._wlock:
+            await write_frame(self.writer, obj)
+
+    async def run(self):
+        try:
+            while True:
+                try:
+                    msg = await read_frame(self.reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                t = msg.get("t")
+                if t == "req":
+                    asyncio.get_running_loop().create_task(self._handle_req(msg))
+                elif t == "svc_res":
+                    fut = self._pending_svc.pop(msg["rid"], None)
+                    if fut and not fut.done():
+                        if msg.get("ok", False):
+                            fut.set_result(msg.get("payload", b""))
+                        else:
+                            fut.set_exception(RuntimeError(msg.get("error", "remote handler error")))
+        finally:
+            await self._cleanup()
+
+    async def _cleanup(self):
+        for task in list(self._watch_tasks.values()) + list(self._sub_tasks.values()):
+            task.cancel()
+        for h in self._watch_handles.values():
+            await h.cancel()
+        for h in self._sub_handles.values():
+            await h.cancel()  # type: ignore[attr-defined]
+        for cancel in self._svc_cancels.values():
+            await cancel()
+        for fut in self._pending_svc.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("client disconnected"))
+        await self.core.revoke_owned(self)
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    async def _handle_req(self, msg):
+        rid = msg["id"]
+        op = msg["op"]
+        try:
+            result = await self._dispatch(op, msg)
+            await self._send({"t": "res", "id": rid, "ok": True, "value": result})
+        except NoRespondersError as e:
+            await self._send({"t": "res", "id": rid, "ok": False, "error": "no_responders", "detail": str(e)})
+        except Exception as e:
+            logger.exception("control-plane op %s failed", op)
+            await self._send({"t": "res", "id": rid, "ok": False, "error": "error", "detail": repr(e)})
+
+    async def _dispatch(self, op, m):
+        core = self.core
+        if op == "kv_put":
+            await core.kv_put(m["key"], m["value"], m.get("lease"))
+        elif op == "kv_create":
+            return await core.kv_create(m["key"], m["value"], m.get("lease"))
+        elif op == "kv_get":
+            return core._kv.get(m["key"])
+        elif op == "kv_get_prefix":
+            return await core.kv_get_prefix(m["prefix"])
+        elif op == "kv_delete":
+            return await core.kv_delete(m["key"])
+        elif op == "kv_delete_prefix":
+            return await core.kv_delete_prefix(m["prefix"])
+        elif op == "lease_create":
+            return await core.lease_create(m.get("ttl", DEFAULT_LEASE_TTL), owner=self)
+        elif op == "lease_keepalive":
+            return await core.lease_keepalive(m["lease"])
+        elif op == "lease_revoke":
+            await core.lease_revoke(m["lease"])
+        elif op == "publish":
+            await core.publish(m["subject"], m["payload"])
+        elif op == "request":
+            return await core.request(m["subject"], m["payload"], m.get("req_timeout", 30.0))
+        elif op == "watch":
+            return await self._start_watch(m["wid"], m["prefix"])
+        elif op == "watch_cancel":
+            await self._stop_watch(m["wid"])
+        elif op == "subscribe":
+            await self._start_sub(m["sid"], m["subject"], m.get("queue_group"))
+        elif op == "sub_cancel":
+            await self._stop_sub(m["sid"])
+        elif op == "serve":
+            await self._start_serve(m["svc_id"], m["subject"], m.get("queue_group"))
+        elif op == "serve_cancel":
+            cancel = self._svc_cancels.pop(m["svc_id"], None)
+            if cancel:
+                await cancel()
+        elif op == "stream_publish":
+            return await core.stream_publish(m["stream"], m["payload"])
+        elif op == "stream_subscribe":
+            await self._start_stream_sub(m["sid"], m["stream"], m.get("start_seq", 0))
+        elif op == "stream_last_seq":
+            return await core.stream_last_seq(m["stream"])
+        elif op == "object_put":
+            await core.object_put(m["bucket"], m["name"], m["data"])
+        elif op == "object_get":
+            return await core.object_get(m["bucket"], m["name"])
+        else:
+            raise ValueError(f"unknown op {op}")
+        return None
+
+    async def _start_watch(self, wid, prefix):
+        watch = await self.core.watch_prefix(prefix)
+        self._watch_handles[wid] = watch
+
+        async def pump():
+            async for ev in watch:
+                await self._send({"t": "watch_ev", "wid": wid, "ev": ev.type, "key": ev.key, "value": ev.value})
+
+        self._watch_tasks[wid] = asyncio.get_running_loop().create_task(pump())
+        return watch.snapshot
+
+    async def _stop_watch(self, wid):
+        task = self._watch_tasks.pop(wid, None)
+        handle = self._watch_handles.pop(wid, None)
+        if handle:
+            await handle.cancel()
+        if task:
+            task.cancel()
+
+    async def _start_sub(self, sid, subject, queue_group):
+        sub = await self.core.subscribe(subject, queue_group)
+        self._sub_handles[sid] = sub
+
+        async def pump():
+            async for subj, payload in sub:
+                await self._send({"t": "sub_msg", "sid": sid, "subject": subj, "payload": payload})
+
+        self._sub_tasks[sid] = asyncio.get_running_loop().create_task(pump())
+
+    async def _start_stream_sub(self, sid, stream, start_seq):
+        sub = await self.core.stream_subscribe(stream, start_seq)
+        self._sub_handles[sid] = sub
+
+        async def pump():
+            async for seq, payload in sub:
+                await self._send({"t": "stream_msg", "sid": sid, "seq": seq, "payload": payload})
+
+        self._sub_tasks[sid] = asyncio.get_running_loop().create_task(pump())
+
+    async def _stop_sub(self, sid):
+        task = self._sub_tasks.pop(sid, None)
+        handle = self._sub_handles.pop(sid, None)
+        if handle:
+            await handle.cancel()  # type: ignore[attr-defined]
+        if task:
+            task.cancel()
+
+    async def _start_serve(self, svc_id, subject, queue_group):
+        async def forward(payload: bytes) -> bytes:
+            self._next_rid += 1
+            rid = self._next_rid
+            fut = asyncio.get_running_loop().create_future()
+            self._pending_svc[rid] = fut
+            try:
+                await self._send(
+                    {"t": "svc_req", "rid": rid, "svc_id": svc_id, "subject": subject, "payload": payload}
+                )
+                return await fut
+            finally:
+                # On timeout/cancellation the caller abandons the future;
+                # drop the entry so it cannot accumulate for the conn lifetime.
+                self._pending_svc.pop(rid, None)
+
+        cancel = await self.core.serve(subject, forward, queue_group, owner=self)
+        self._svc_cancels[svc_id] = cancel
+
+
+class RemoteControlPlane(ControlPlane):
+    """TCP client to a :class:`ControlPlaneServer`."""
+
+    def __init__(self, address: str):
+        host, _, port = address.rpartition(":")
+        self._host, self._port = host or "127.0.0.1", int(port)
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._wlock = asyncio.Lock()
+        self._pending: dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._watch_queues: dict[int, asyncio.Queue] = {}
+        self._sub_queues: dict[int, asyncio.Queue] = {}
+        self._handlers: dict[int, ServiceHandler] = {}
+        self._rx_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    async def connect(self) -> "RemoteControlPlane":
+        self._reader, self._writer = await asyncio.open_connection(self._host, self._port)
+        self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
+        return self
+
+    async def _rx_loop(self):
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                t = msg.get("t")
+                if t == "res":
+                    fut = self._pending.pop(msg["id"], None)
+                    if fut and not fut.done():
+                        if msg["ok"]:
+                            fut.set_result(msg.get("value"))
+                        elif msg.get("error") == "no_responders":
+                            fut.set_exception(NoRespondersError(msg.get("detail", "")))
+                        else:
+                            fut.set_exception(RuntimeError(msg.get("detail", "control plane error")))
+                elif t == "watch_ev":
+                    q = self._watch_queues.get(msg["wid"])
+                    if q:
+                        q.put_nowait(WatchEvent(msg["ev"], msg["key"], msg.get("value") or b""))
+                elif t == "sub_msg":
+                    q = self._sub_queues.get(msg["sid"])
+                    if q:
+                        q.put_nowait((msg["subject"], msg["payload"]))
+                elif t == "stream_msg":
+                    q = self._sub_queues.get(msg["sid"])
+                    if q:
+                        q.put_nowait((msg["seq"], msg["payload"]))
+                elif t == "svc_req":
+                    asyncio.get_running_loop().create_task(self._handle_svc(msg))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ControlPlaneClosed())
+            for q in list(self._watch_queues.values()) + list(self._sub_queues.values()):
+                q.put_nowait(None)
+
+    async def _handle_svc(self, msg):
+        handler = self._handlers.get(msg["svc_id"])
+        if handler is None:
+            await self._send({"t": "svc_res", "rid": msg["rid"], "ok": False, "error": "no handler"})
+            return
+        try:
+            result = await handler(msg["payload"])
+            await self._send({"t": "svc_res", "rid": msg["rid"], "ok": True, "payload": result})
+        except Exception as e:
+            logger.exception("service handler failed")
+            await self._send({"t": "svc_res", "rid": msg["rid"], "ok": False, "error": repr(e)})
+
+    async def _send(self, obj):
+        if self._closed:
+            raise ControlPlaneClosed()
+        async with self._wlock:
+            await write_frame(self._writer, obj)
+
+    async def _call(self, op: str, timeout: float = 60.0, **kwargs):
+        self._next_id += 1
+        rid = self._next_id
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        await self._send({"t": "req", "id": rid, "op": op, **kwargs})
+        return await asyncio.wait_for(fut, timeout)
+
+    # -- KV --
+    async def kv_put(self, key, value, lease_id=None):
+        await self._call("kv_put", key=key, value=value, lease=lease_id)
+
+    async def kv_create(self, key, value, lease_id=None) -> bool:
+        return await self._call("kv_create", key=key, value=value, lease=lease_id)
+
+    async def kv_get(self, key):
+        return await self._call("kv_get", key=key)
+
+    async def kv_get_prefix(self, prefix):
+        return await self._call("kv_get_prefix", prefix=prefix)
+
+    async def kv_delete(self, key):
+        return await self._call("kv_delete", key=key)
+
+    async def kv_delete_prefix(self, prefix):
+        return await self._call("kv_delete_prefix", prefix=prefix)
+
+    async def watch_prefix(self, prefix) -> Watch:
+        self._next_id += 1
+        wid = self._next_id
+        q: asyncio.Queue = asyncio.Queue()
+        self._watch_queues[wid] = q
+        snapshot = await self._call("watch", wid=wid, prefix=prefix)
+
+        async def cancel():
+            self._watch_queues.pop(wid, None)
+            q.put_nowait(None)
+            if not self._closed:
+                await self._call("watch_cancel", wid=wid)
+
+        return Watch(dict(snapshot or {}), q, cancel)
+
+    # -- Leases --
+    async def lease_create(self, ttl=DEFAULT_LEASE_TTL) -> int:
+        return await self._call("lease_create", ttl=ttl)
+
+    async def lease_keepalive(self, lease_id) -> bool:
+        return await self._call("lease_keepalive", lease=lease_id)
+
+    async def lease_revoke(self, lease_id):
+        await self._call("lease_revoke", lease=lease_id)
+
+    # -- Pub/sub --
+    async def publish(self, subject, payload):
+        await self._call("publish", subject=subject, payload=payload)
+
+    async def subscribe(self, subject, queue_group=None) -> Subscription:
+        self._next_id += 1
+        sid = self._next_id
+        q: asyncio.Queue = asyncio.Queue()
+        self._sub_queues[sid] = q
+        await self._call("subscribe", sid=sid, subject=subject, queue_group=queue_group)
+
+        async def cancel():
+            self._sub_queues.pop(sid, None)
+            q.put_nowait(None)
+            if not self._closed:
+                await self._call("sub_cancel", sid=sid)
+
+        return Subscription(q, cancel)
+
+    async def request(self, subject, payload, timeout=30.0) -> bytes:
+        return await self._call(
+            "request", timeout=timeout + 5.0, subject=subject, payload=payload, req_timeout=timeout
+        )
+
+    async def serve(self, subject, handler, queue_group=None):
+        self._next_id += 1
+        svc_id = self._next_id
+        self._handlers[svc_id] = handler
+        await self._call("serve", svc_id=svc_id, subject=subject, queue_group=queue_group)
+
+        async def cancel():
+            self._handlers.pop(svc_id, None)
+            if not self._closed:
+                await self._call("serve_cancel", svc_id=svc_id)
+
+        return cancel
+
+    # -- Streams --
+    async def stream_publish(self, stream, payload) -> int:
+        return await self._call("stream_publish", stream=stream, payload=payload)
+
+    async def stream_subscribe(self, stream, start_seq=0) -> StreamSub:
+        self._next_id += 1
+        sid = self._next_id
+        q: asyncio.Queue = asyncio.Queue()
+        self._sub_queues[sid] = q
+        await self._call("stream_subscribe", sid=sid, stream=stream, start_seq=start_seq)
+
+        async def cancel():
+            self._sub_queues.pop(sid, None)
+            q.put_nowait(None)
+            if not self._closed:
+                await self._call("sub_cancel", sid=sid)
+
+        return StreamSub(q, cancel)
+
+    async def stream_last_seq(self, stream) -> int:
+        return await self._call("stream_last_seq", stream=stream)
+
+    # -- Object store --
+    async def object_put(self, bucket, name, data):
+        await self._call("object_put", bucket=bucket, name=name, data=data)
+
+    async def object_get(self, bucket, name):
+        return await self._call("object_get", bucket=bucket, name=name)
+
+    async def close(self):
+        self._closed = True
+        if self._rx_task:
+            self._rx_task.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+            except Exception:
+                pass

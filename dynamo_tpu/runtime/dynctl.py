@@ -1,0 +1,37 @@
+"""``python -m dynamo_tpu.runtime.dynctl`` — run the control-plane server.
+
+Single self-contained process replacing the reference's etcd + NATS pair for
+TPU-VM deployments. Point every other process at it with
+``DYN_CONTROL_PLANE=host:port``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.runtime.config import setup_logging
+from dynamo_tpu.runtime.control_plane import ControlPlaneServer
+
+
+async def amain(host: str, port: int):
+    server = ControlPlaneServer(host, port)
+    addr = await server.start()
+    print(f"dynctl listening on {addr}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.stop()
+
+
+def main():
+    setup_logging()
+    ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=6650)
+    args = ap.parse_args()
+    asyncio.run(amain(args.host, args.port))
+
+
+if __name__ == "__main__":
+    main()

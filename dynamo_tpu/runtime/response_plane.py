@@ -1,0 +1,263 @@
+"""Direct worker→requester TCP response streaming.
+
+The token hot path must not transit the control-plane hub, so responses stream
+over a per-process TCP server exactly like the reference's response plane
+(ref: lib/runtime/src/pipeline/network/tcp/server.rs:62): the requester
+registers a pending stream and hands ``ConnectionInfo`` to the worker inside
+the request envelope; the worker connects back, sends a prologue identifying
+the stream, then pumps framed data until a ``complete`` or ``err`` sentinel.
+
+The same TCP connection is used *bidirectionally*: the requester can push a
+``cancel`` frame upstream, which trips the worker-side request context — this
+is how client disconnects abort generation on the engine.
+
+In-process callers short-circuit through an asyncio queue (no sockets), which
+is also what single-process deployments and most tests use.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import uuid
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.runtime.codec import read_frame, write_frame
+from dynamo_tpu.runtime.context import STREAM_ERR_MSG, Context, StreamError
+
+logger = logging.getLogger("dynamo.response_plane")
+
+_COMPLETE = {"t": "complete"}
+
+
+@dataclass(frozen=True)
+class ConnectionInfo:
+    host: str
+    port: int
+    stream_id: str
+    #: set for in-process short-circuit streams
+    local: bool = False
+
+    def to_wire(self) -> dict:
+        return {"host": self.host, "port": self.port, "stream_id": self.stream_id, "local": self.local}
+
+    @staticmethod
+    def from_wire(d: dict) -> "ConnectionInfo":
+        return ConnectionInfo(d["host"], d["port"], d["stream_id"], d.get("local", False))
+
+
+class ResponseReceiver:
+    """Requester-side view of one response stream.
+
+    The queue carries *frames* ({"t": "data"/"complete"/"err"}), never raw
+    payloads, so user data can never collide with the stream sentinels.
+    """
+
+    def __init__(self, queue: "asyncio.Queue[Any]", on_cancel=None):
+        self._queue = queue
+        self._on_cancel = on_cancel
+
+    def __aiter__(self) -> AsyncIterator[Any]:
+        return self._iter()
+
+    async def _iter(self):
+        while True:
+            frame = await self._queue.get()
+            t = frame.get("t")
+            if t == "data":
+                yield frame.get("d")
+            elif t == "complete":
+                return
+            elif t == "err":
+                raise StreamError(frame.get("msg", STREAM_ERR_MSG))
+
+    async def cancel(self):
+        """Tell the producing worker to stop."""
+        if self._on_cancel:
+            await self._on_cancel()
+
+
+class ResponseStreamServer:
+    """Per-process TCP server accepting worker response connections."""
+
+    def __init__(self, host: Optional[str] = None):
+        self._host = host or _default_host()
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._port = 0
+        self._pending: dict[str, tuple[asyncio.Queue, Context]] = {}
+
+    async def start(self):
+        if self._server is not None:
+            return
+        self._server = await asyncio.start_server(self._on_conn, "0.0.0.0", 0)
+        self._port = self._server.sockets[0].getsockname()[1]
+        logger.debug("response plane listening on %s:%d", self._host, self._port)
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for q, _ in self._pending.values():
+            q.put_nowait({"t": "err", "msg": STREAM_ERR_MSG})
+        self._pending.clear()
+
+    def register_stream(self, ctx: Context) -> tuple[ConnectionInfo, ResponseReceiver]:
+        """Register a pending stream; returns (info for the worker, receiver)."""
+        assert self._server is not None, "ResponseStreamServer not started"
+        stream_id = uuid.uuid4().hex
+        q: asyncio.Queue = asyncio.Queue()
+        self._pending[stream_id] = (q, ctx)
+        info = ConnectionInfo(self._host, self._port, stream_id)
+
+        async def on_cancel():
+            ctx.cancel()
+
+        return info, ResponseReceiver(q, on_cancel)
+
+    def abandon_stream(self, info: ConnectionInfo):
+        self._pending.pop(info.stream_id, None)
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            prologue = await read_frame(reader)
+            stream_id = prologue.get("stream_id")
+            entry = self._pending.pop(stream_id, None)
+            if entry is None:
+                await write_frame(writer, {"t": "err", "msg": f"unknown stream {stream_id}"})
+                writer.close()
+                return
+            q, ctx = entry
+            await write_frame(writer, {"t": "ok"})
+
+            async def cancel_pump():
+                # Push a cancel frame upstream when our local context cancels.
+                try:
+                    await ctx.wait_cancelled()
+                    await write_frame(writer, {"t": "cancel"})
+                except Exception:
+                    pass
+
+            cancel_task = asyncio.get_running_loop().create_task(cancel_pump())
+            try:
+                while True:
+                    frame = await read_frame(reader)
+                    t = frame.get("t")
+                    if t == "data":
+                        q.put_nowait(frame)
+                    elif t in ("complete", "err"):
+                        q.put_nowait(frame)
+                        return
+            except (asyncio.IncompleteReadError, ConnectionError):
+                q.put_nowait({"t": "err", "msg": STREAM_ERR_MSG})
+            finally:
+                cancel_task.cancel()
+        except Exception:
+            logger.exception("response connection failed")
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+class StreamSender:
+    """Worker-side handle for pushing response frames back to the requester."""
+
+    def __init__(self):
+        self._queue: Optional[asyncio.Queue] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    @staticmethod
+    async def connect(info: ConnectionInfo, ctx: Optional[Context] = None) -> "StreamSender":
+        s = StreamSender()
+        reader, writer = await asyncio.open_connection(info.host, info.port)
+        await write_frame(writer, {"stream_id": info.stream_id})
+        ack = await read_frame(reader)
+        if ack.get("t") != "ok":
+            writer.close()
+            raise StreamError(ack.get("msg", "handshake rejected"))
+        s._writer = writer
+
+        async def cancel_listener():
+            # Watch for upstream cancel frames and trip the worker context.
+            try:
+                while True:
+                    frame = await read_frame(reader)
+                    if frame.get("t") == "cancel" and ctx is not None:
+                        ctx.cancel()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                # Requester went away: cancel generation.
+                if ctx is not None and not s._closed:
+                    ctx.cancel()
+
+        s._reader_task = asyncio.get_running_loop().create_task(cancel_listener())
+        return s
+
+    @staticmethod
+    def local(queue: asyncio.Queue) -> "StreamSender":
+        s = StreamSender()
+        s._queue = queue
+        return s
+
+    async def send(self, data: Any) -> None:
+        if self._queue is not None:
+            self._queue.put_nowait({"t": "data", "d": data})
+        else:
+            await write_frame(self._writer, {"t": "data", "d": data})
+
+    async def complete(self) -> None:
+        self._closed = True
+        if self._queue is not None:
+            self._queue.put_nowait(_COMPLETE)
+        else:
+            try:
+                await write_frame(self._writer, _COMPLETE)
+            finally:
+                self._teardown()
+
+    async def error(self, msg: str) -> None:
+        self._closed = True
+        if self._queue is not None:
+            self._queue.put_nowait({"t": "err", "msg": msg})
+        else:
+            try:
+                await write_frame(self._writer, {"t": "err", "msg": msg})
+            finally:
+                self._teardown()
+
+    def _teardown(self):
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+
+def make_local_stream(ctx: Context) -> tuple[ConnectionInfo, ResponseReceiver, asyncio.Queue]:
+    """In-process short-circuit stream (no sockets)."""
+    q: asyncio.Queue = asyncio.Queue()
+    info = ConnectionInfo("", 0, uuid.uuid4().hex, local=True)
+
+    async def on_cancel():
+        ctx.cancel()
+
+    return info, ResponseReceiver(q, on_cancel), q
+
+
+def _default_host() -> str:
+    """Best-effort routable address of this host (TPU-VM DCN interface)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except Exception:
+        return "127.0.0.1"

@@ -1,0 +1,116 @@
+"""DistributedRuntime: per-process cluster handle.
+
+Analog of the reference's ``DistributedRuntime`` (ref: lib/runtime/src/
+lib.rs:145, distributed.rs:42-184): owns the control-plane client, a primary
+lease kept alive in the background (its loss makes every instance registered
+under it vanish cluster-wide), the lazy response-plane server, and the
+process-local endpoint registry used for in-process short-circuiting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from dynamo_tpu.runtime.component import Namespace
+from dynamo_tpu.runtime.config import RuntimeConfig, setup_logging
+from dynamo_tpu.runtime.control_plane import (
+    ControlPlane,
+    LocalControlPlane,
+    RemoteControlPlane,
+)
+from dynamo_tpu.runtime.response_plane import ResponseStreamServer
+
+logger = logging.getLogger("dynamo.runtime")
+
+
+class DistributedRuntime:
+    def __init__(self, plane: ControlPlane, config: RuntimeConfig, owns_plane: bool):
+        self.plane = plane
+        self.config = config
+        self._owns_plane = owns_plane
+        self._primary_lease: Optional[int] = None
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._response_server: Optional[ResponseStreamServer] = None
+        # subject -> (handler, inflight set); see component._generate_to
+        self._local_endpoints: dict = {}
+        self._shutdown_event = asyncio.Event()
+
+    @staticmethod
+    async def create(
+        address: Optional[str] = None,
+        plane: Optional[ControlPlane] = None,
+        config: Optional[RuntimeConfig] = None,
+        owns_plane: bool = True,
+    ) -> "DistributedRuntime":
+        """Connect to ``DYN_CONTROL_PLANE`` (or ``address``), else run in-process.
+
+        Pass ``owns_plane=False`` when several runtimes share one plane object;
+        the owner is responsible for closing it.
+        """
+        setup_logging()
+        config = config or RuntimeConfig.from_env()
+        owns = owns_plane
+        if plane is None:
+            addr = address or config.control_plane_address
+            if addr:
+                plane = await RemoteControlPlane(addr).connect()
+                logger.info("connected to control plane at %s", addr)
+            else:
+                plane = LocalControlPlane()
+                logger.info("running with in-process control plane")
+        return DistributedRuntime(plane, config, owns)
+
+    def namespace(self, name: Optional[str] = None) -> Namespace:
+        return Namespace(self, name or self.config.namespace)
+
+    async def primary_lease(self) -> int:
+        if self._primary_lease is None:
+            self._primary_lease = await self.plane.lease_create(self.config.lease_ttl)
+            self._keepalive_task = asyncio.get_running_loop().create_task(self._keepalive_loop())
+        return self._primary_lease
+
+    async def _keepalive_loop(self):
+        interval = max(self.config.lease_ttl / 3.0, 0.5)
+        try:
+            while not self._shutdown_event.is_set():
+                await asyncio.sleep(interval)
+                ok = await self.plane.lease_keepalive(self._primary_lease)
+                if not ok:
+                    logger.error("primary lease %x lost", self._primary_lease or 0)
+                    return
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("lease keepalive failed")
+
+    async def response_server(self) -> ResponseStreamServer:
+        if self._response_server is None:
+            self._response_server = ResponseStreamServer()
+            await self._response_server.start()
+        return self._response_server
+
+    @property
+    def is_shutdown(self) -> bool:
+        return self._shutdown_event.is_set()
+
+    async def wait_shutdown(self):
+        await self._shutdown_event.wait()
+
+    async def shutdown(self):
+        if self._shutdown_event.is_set():
+            return
+        self._shutdown_event.set()
+        if self._keepalive_task:
+            self._keepalive_task.cancel()
+        if self._primary_lease is not None:
+            try:
+                await self.plane.lease_revoke(self._primary_lease)
+            except Exception:
+                pass
+        if self._response_server:
+            await self._response_server.stop()
+        if self._owns_plane:
+            await self.plane.close()
+        logger.info("runtime shut down")

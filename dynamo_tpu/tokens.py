@@ -1,0 +1,183 @@
+"""Token block hashing: salted xxh3 block hashes and chained sequence hashes.
+
+Behavior-parity with the reference token library (ref: lib/tokens/src/lib.rs:16-29,
+lib/llm/src/kv_router/indexer.rs:55,89-137):
+
+- A token is a u32.
+- ``salt_hash = xxh3_64(salt_bytes, seed=0)`` (or a caller-provided u64 seed).
+- ``block_hash = xxh3_64(le_bytes(tokens), seed=salt_hash)`` over exactly
+  ``block_size`` tokens.
+- ``sequence_hash`` of the first block is its ``block_hash``; each subsequent
+  block chains ``xxh3_64(le_bytes([parent_sequence_hash, block_hash]), seed=salt_hash)``.
+- The KV router hashes with the fixed seed ``KV_HASH_SEED = 1337``
+  (ref: lib/llm/src/kv_router/indexer.rs:55) so that frontend-side hashes and
+  engine-side KV-event hashes agree across the cluster.
+
+These hashes are the *identity* of a KV block everywhere in the system: the
+radix index, KV events, the block manager's reuse pool, and the prefix cache in
+the JAX engine all key on them.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+import xxhash
+
+Token = int  # u32
+BlockHash = int  # u64
+SequenceHash = int  # u64
+SaltHash = int  # u64
+
+#: Fixed seed used by the KV-router hash domain (ref: kv_router/indexer.rs:55).
+KV_HASH_SEED: SaltHash = 1337
+
+_U64_MASK = (1 << 64) - 1
+
+
+def compute_hash(data: bytes, seed: int = KV_HASH_SEED) -> int:
+    """xxh3_64 with seed (ref: lib/tokens/src/lib.rs:32)."""
+    return xxhash.xxh3_64_intdigest(data, seed=seed & _U64_MASK)
+
+
+def compute_salt_hash(salt: bytes) -> SaltHash:
+    """Hash of a salt, seeded with 0 (ref: lib/tokens/src/lib.rs:23)."""
+    return xxhash.xxh3_64_intdigest(salt, seed=0)
+
+
+def _tokens_le_bytes(tokens: Sequence[int]) -> bytes:
+    return struct.pack(f"<{len(tokens)}I", *tokens)
+
+
+def compute_block_hash(tokens: Sequence[int], salt_hash: SaltHash = KV_HASH_SEED) -> BlockHash:
+    """Hash of the tokens local to one block (ref: kv_router/indexer.rs:102)."""
+    return compute_hash(_tokens_le_bytes(tokens), seed=salt_hash)
+
+
+def compute_block_hash_for_seq(
+    tokens: Sequence[int], kv_block_size: int, salt_hash: SaltHash = KV_HASH_SEED
+) -> list[BlockHash]:
+    """Per-block hashes for a token sequence, one per *complete* block.
+
+    Trailing tokens that do not fill a block are ignored, matching
+    ``chunks_exact`` in the reference (ref: kv_router/indexer.rs:125-137).
+    """
+    n = len(tokens) // kv_block_size
+    out = []
+    for i in range(n):
+        chunk = tokens[i * kv_block_size : (i + 1) * kv_block_size]
+        out.append(compute_hash(_tokens_le_bytes(chunk), seed=salt_hash))
+    return out
+
+
+def chain_sequence_hash(
+    parent: Optional[SequenceHash], block_hash: BlockHash, salt_hash: SaltHash = KV_HASH_SEED
+) -> SequenceHash:
+    """Combine a parent sequence hash with a block hash (ref: lib/tokens/src/lib.rs:226-247)."""
+    if parent is None:
+        return block_hash
+    return compute_hash(struct.pack("<2Q", parent & _U64_MASK, block_hash & _U64_MASK), seed=salt_hash)
+
+
+def compute_seq_hash_for_block(
+    block_hashes: Sequence[BlockHash], salt_hash: SaltHash = KV_HASH_SEED
+) -> list[SequenceHash]:
+    """Rolling sequence hashes for a list of block hashes (ref: kv_router/indexer.rs:139-160)."""
+    out: list[SequenceHash] = []
+    parent: Optional[SequenceHash] = None
+    for bh in block_hashes:
+        parent = chain_sequence_hash(parent, bh, salt_hash)
+        out.append(parent)
+    return out
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """A complete, immutable block of tokens with its hashes."""
+
+    tokens: tuple[int, ...]
+    block_hash: BlockHash
+    sequence_hash: SequenceHash
+    parent_sequence_hash: Optional[SequenceHash]
+
+    @staticmethod
+    def from_tokens(
+        tokens: Sequence[int],
+        parent_sequence_hash: Optional[SequenceHash],
+        salt_hash: SaltHash,
+    ) -> "TokenBlock":
+        bh = compute_block_hash(tokens, salt_hash)
+        sh = chain_sequence_hash(parent_sequence_hash, bh, salt_hash)
+        return TokenBlock(tuple(tokens), bh, sh, parent_sequence_hash)
+
+
+@dataclass
+class TokenBlockSequence:
+    """Splits a growing token stream into hash-chained fixed-size blocks.
+
+    Mirrors the reference's ``TokenBlockSequence`` (ref: lib/tokens/src/lib.rs:288):
+    complete blocks carry ``(block_hash, sequence_hash)``; the tail lives in
+    ``current_tokens`` until it fills.
+    """
+
+    block_size: int
+    salt_hash: SaltHash = KV_HASH_SEED
+    blocks: list[TokenBlock] = field(default_factory=list)
+    current_tokens: list[int] = field(default_factory=list)
+
+    @staticmethod
+    def from_tokens(
+        tokens: Iterable[int], block_size: int, salt_hash: SaltHash = KV_HASH_SEED
+    ) -> "TokenBlockSequence":
+        seq = TokenBlockSequence(block_size=block_size, salt_hash=salt_hash)
+        seq.extend(tokens)
+        return seq
+
+    def __len__(self) -> int:
+        return len(self.blocks) * self.block_size + len(self.current_tokens)
+
+    @property
+    def all_tokens(self) -> list[int]:
+        out: list[int] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self.current_tokens)
+        return out
+
+    def push_token(self, token: int) -> Optional[TokenBlock]:
+        """Append one token; returns the newly-completed block, if any."""
+        self.current_tokens.append(token)
+        if len(self.current_tokens) == self.block_size:
+            parent = self.blocks[-1].sequence_hash if self.blocks else None
+            block = TokenBlock.from_tokens(self.current_tokens, parent, self.salt_hash)
+            self.blocks.append(block)
+            self.current_tokens = []
+            return block
+        return None
+
+    def extend(self, tokens: Iterable[int]) -> list[TokenBlock]:
+        """Append many tokens; returns all newly-completed blocks."""
+        new_blocks = []
+        for t in tokens:
+            b = self.push_token(t)
+            if b is not None:
+                new_blocks.append(b)
+        return new_blocks
+
+    def truncate(self, num_tokens: int) -> None:
+        """Drop tokens from the end so that len(self) == num_tokens."""
+        if num_tokens >= len(self):
+            return
+        keep_blocks, rem = divmod(num_tokens, self.block_size)
+        all_toks = self.all_tokens[:num_tokens]
+        self.blocks = self.blocks[:keep_blocks]
+        self.current_tokens = list(all_toks[keep_blocks * self.block_size :])
+        assert len(self.current_tokens) == rem
+
+    def sequence_hashes(self) -> list[SequenceHash]:
+        return [b.sequence_hash for b in self.blocks]
+
+    def block_hashes(self) -> list[BlockHash]:
+        return [b.block_hash for b in self.blocks]

@@ -1,0 +1,183 @@
+"""Endpoint serve/client round trips: in-process and cross-runtime over TCP."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Context,
+    ControlPlaneServer,
+    DistributedRuntime,
+    NoRespondersError,
+    RemoteControlPlane,
+    StreamError,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+async def counting_handler(request, ctx: Context):
+    n = request["n"]
+    for i in range(n):
+        yield {"i": i, "req": request.get("tag", "")}
+
+
+@pytest.fixture
+async def local_rt():
+    rt = await DistributedRuntime.create(config=None)
+    yield rt
+    await rt.shutdown()
+
+
+@pytest.fixture
+async def cluster():
+    """Two runtimes (worker, client) joined through a real TCP control plane."""
+    server = ControlPlaneServer()
+    addr = await server.start()
+    worker_rt = await DistributedRuntime.create(
+        plane=await RemoteControlPlane(addr).connect(), config=_cfg()
+    )
+    client_rt = await DistributedRuntime.create(
+        plane=await RemoteControlPlane(addr).connect(), config=_cfg()
+    )
+    yield worker_rt, client_rt
+    await worker_rt.shutdown()
+    await client_rt.shutdown()
+    await server.stop()
+
+
+def _cfg():
+    from dynamo_tpu.runtime.config import RuntimeConfig
+
+    return RuntimeConfig(control_plane_address=None, lease_ttl=5.0, namespace="test")
+
+
+async def test_inprocess_roundtrip(local_rt):
+    ep = local_rt.namespace("ns").component("comp").endpoint("gen")
+    handle = await ep.serve_endpoint(counting_handler)
+    client = await ep.client().start()
+    await client.wait_for_instances(timeout=5)
+
+    stream = await client.generate({"n": 5, "tag": "x"})
+    items = [item async for item in stream]
+    assert items == [{"i": i, "req": "x"} for i in range(5)]
+    await client.stop()
+    await handle.stop()
+
+
+async def test_cross_runtime_roundtrip(cluster):
+    worker_rt, client_rt = cluster
+    ep_w = worker_rt.namespace("ns").component("comp").endpoint("gen")
+    handle = await ep_w.serve_endpoint(counting_handler)
+
+    ep_c = client_rt.namespace("ns").component("comp").endpoint("gen")
+    client = await ep_c.client().start()
+    ids = await client.wait_for_instances(timeout=5)
+    assert ids == [handle.lease_id]
+
+    stream = await client.generate({"n": 100, "tag": "remote"})
+    items = [item async for item in stream]
+    assert len(items) == 100
+    assert items[99] == {"i": 99, "req": "remote"}
+    await client.stop()
+
+
+async def test_no_responders(local_rt):
+    ep = local_rt.namespace("ns").component("comp").endpoint("nothing")
+    client = await ep.client().start()
+    with pytest.raises(NoRespondersError):
+        await client.generate({"n": 1})
+    await client.stop()
+
+
+async def test_handler_error_propagates(cluster):
+    worker_rt, client_rt = cluster
+
+    async def bad_handler(request, ctx):
+        yield {"ok": 1}
+        raise RuntimeError("boom")
+
+    ep_w = worker_rt.namespace("ns").component("c").endpoint("bad")
+    await ep_w.serve_endpoint(bad_handler)
+    client = await client_rt.namespace("ns").component("c").endpoint("bad").client().start()
+    await client.wait_for_instances(timeout=5)
+
+    stream = await client.generate({})
+    with pytest.raises(StreamError):
+        async for _ in stream:
+            pass
+    await client.stop()
+
+
+async def test_cancellation_stops_worker(cluster):
+    worker_rt, client_rt = cluster
+    produced = []
+
+    async def slow_handler(request, ctx: Context):
+        for i in range(1000):
+            if ctx.cancelled:
+                return
+            produced.append(i)
+            yield i
+            await asyncio.sleep(0.01)
+
+    ep_w = worker_rt.namespace("ns").component("c").endpoint("slow")
+    await ep_w.serve_endpoint(slow_handler)
+    client = await client_rt.namespace("ns").component("c").endpoint("slow").client().start()
+    await client.wait_for_instances(timeout=5)
+
+    ctx = Context()
+    stream = await client.generate({}, ctx=ctx)
+    got = []
+    async for item in stream:
+        got.append(item)
+        if len(got) == 3:
+            await stream.cancel()
+            break
+    await asyncio.sleep(0.5)
+    assert len(produced) < 100  # worker actually stopped early
+    await client.stop()
+
+
+async def test_instance_discovery_follows_lease(cluster):
+    worker_rt, client_rt = cluster
+    ep_w = worker_rt.namespace("ns").component("c").endpoint("d")
+    handle = await ep_w.serve_endpoint(counting_handler)
+
+    client = await client_rt.namespace("ns").component("c").endpoint("d").client().start()
+    await client.wait_for_instances(timeout=5)
+    assert client.instance_ids() == [handle.lease_id]
+
+    await handle.stop()
+    for _ in range(50):
+        if not client.instance_ids():
+            break
+        await asyncio.sleep(0.1)
+    assert client.instance_ids() == []
+    await client.stop()
+
+
+async def test_direct_routing(local_rt):
+    ep = local_rt.namespace("ns").component("c").endpoint("multi")
+    lease_a = await local_rt.plane.lease_create(30)
+    lease_b = await local_rt.plane.lease_create(30)
+
+    async def tagged(tag):
+        async def h(request, ctx):
+            yield tag
+
+        return h
+
+    ha = await ep.serve_endpoint(await tagged("a"), lease_id=lease_a)
+    hb = await ep.serve_endpoint(await tagged("b"), lease_id=lease_b)
+    client = await ep.client().start()
+    await client.wait_for_instances(timeout=5)
+    assert set(client.instance_ids()) == {lease_a, lease_b}
+
+    sa = await client.generate({}, mode="direct", instance_id=lease_a)
+    assert [x async for x in sa] == ["a"]
+    sb = await client.generate({}, mode="direct", instance_id=lease_b)
+    assert [x async for x in sb] == ["b"]
+    await client.stop()
+    await ha.stop()
+    await hb.stop()

@@ -1,0 +1,62 @@
+"""Conformance tests for token block hashing.
+
+Numeric vectors match the reference implementation's own unit tests
+(ref: lib/tokens/src/lib.rs:517-545, doctest at :280-288) so router hashes are
+wire-compatible with the reference's KV-event hash domain.
+"""
+
+from dynamo_tpu import tokens as tok
+
+
+def test_block_hash_vectors():
+    seq = tok.TokenBlockSequence.from_tokens(range(1, 11), block_size=4, salt_hash=1337)
+    assert len(seq.blocks) == 2
+    assert seq.current_tokens == [9, 10]
+    assert seq.blocks[0].tokens == (1, 2, 3, 4)
+    assert seq.blocks[0].block_hash == 14643705804678351452
+    assert seq.blocks[0].sequence_hash == 14643705804678351452
+    assert seq.blocks[1].tokens == (5, 6, 7, 8)
+    assert seq.blocks[1].block_hash == 16777012769546811212
+    assert seq.blocks[1].sequence_hash == 4945711292740353085
+
+
+def test_push_token_completes_block():
+    seq = tok.TokenBlockSequence(block_size=4, salt_hash=1337)
+    for t in [1, 2, 3]:
+        assert seq.push_token(t) is None
+    b = seq.push_token(4)
+    assert b is not None and b.sequence_hash == 14643705804678351452
+    assert len(seq) == 4
+
+
+def test_compute_block_hash_for_seq_chunks_exact():
+    for bs in (11, 32, 64):
+        assert len(tok.compute_block_hash_for_seq(list(range(bs)), bs)) == 1
+        assert len(tok.compute_block_hash_for_seq(list(range(bs + 1)), bs)) == 1
+        assert len(tok.compute_block_hash_for_seq(list(range(2 * bs + 1)), bs)) == 2
+
+
+def test_seq_hash_chaining_matches_blocks():
+    toks = list(range(100, 164))
+    bh = tok.compute_block_hash_for_seq(toks, 16)
+    sh = tok.compute_seq_hash_for_block(bh)
+    seq = tok.TokenBlockSequence.from_tokens(toks, 16)
+    assert seq.block_hashes() == bh
+    assert seq.sequence_hashes() == sh
+
+
+def test_truncate():
+    seq = tok.TokenBlockSequence.from_tokens(range(20), block_size=4)
+    seq.truncate(10)
+    assert len(seq) == 10
+    assert len(seq.blocks) == 2
+    assert seq.current_tokens == [8, 9]
+    # hashes of surviving blocks unchanged
+    ref = tok.TokenBlockSequence.from_tokens(range(10), block_size=4)
+    assert seq.sequence_hashes() == ref.sequence_hashes()
+
+
+def test_salt_changes_hashes():
+    a = tok.compute_block_hash_for_seq(list(range(16)), 16, salt_hash=1)
+    b = tok.compute_block_hash_for_seq(list(range(16)), 16, salt_hash=2)
+    assert a != b
