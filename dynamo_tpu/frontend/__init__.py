@@ -1,0 +1,5 @@
+"""OpenAI-compatible HTTP frontend (rebuild of lib/llm/src/http/service/)."""
+
+from dynamo_tpu.frontend.http import HttpService
+
+__all__ = ["HttpService"]

@@ -1,0 +1,221 @@
+"""OpenAI-compatible HTTP server with SSE streaming.
+
+Rebuild of the reference's axum service (ref: lib/llm/src/http/service/
+service_v2.rs:125-420, openai.rs:209-1106): routes
+
+- ``POST /v1/chat/completions`` (stream + non-stream)
+- ``POST /v1/completions``
+- ``GET  /v1/models``
+- ``GET  /health`` / ``/live``  — liveness + model readiness
+- ``GET  /metrics``             — Prometheus text exposition
+
+Streaming uses SSE (``data: {chunk}\\n\\n`` … ``data: [DONE]``) with client
+disconnect detection that cancels the request context so generation aborts on
+the worker (ref: http/service/disconnect.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.pipeline import aggregate_chat_stream, aggregate_completion_stream
+from dynamo_tpu.protocols import Annotated
+from dynamo_tpu.protocols.openai import (
+    RequestError,
+    error_body,
+    model_entry,
+    parse_chat_request,
+    parse_completion_request,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.control_plane import NoRespondersError
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+logger = logging.getLogger("dynamo.http")
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: ModelManager,
+        metrics: Optional[MetricsRegistry] = None,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+    ):
+        self.manager = manager
+        self.metrics = metrics or MetricsRegistry()
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+        self._requests = self.metrics.counter(
+            "http_requests_total", "HTTP requests by route/model/status"
+        )
+        self._latency = self.metrics.histogram(
+            "http_request_duration_seconds", "Request duration"
+        )
+        self._ttft = self.metrics.histogram(
+            "http_time_to_first_token_seconds", "Time to first streamed token"
+        )
+        self._inflight = self.metrics.gauge("http_inflight_requests", "In-flight requests")
+        self._inflight_count = 0
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=32 * 1024 * 1024)
+        app.router.add_post("/v1/chat/completions", self.handle_chat)
+        app.router.add_post("/v1/completions", self.handle_completions)
+        app.router.add_get("/v1/models", self.handle_models)
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/live", self.handle_live)
+        app.router.add_get("/metrics", self.handle_metrics)
+        return app
+
+    async def start(self) -> int:
+        app = self.build_app()
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = site._server.sockets[0].getsockname()[1]
+        logger.info("OpenAI HTTP frontend on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self):
+        if self._runner:
+            await self._runner.cleanup()
+
+    # -- handlers ----------------------------------------------------------
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        data = [model_entry(m) for m in self.manager.list_models()]
+        return web.json_response({"object": "list", "data": data})
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        models = self.manager.list_models()
+        status = "healthy" if models else "no_models"
+        return web.json_response({"status": status, "models": models})
+
+    async def handle_live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(), content_type="text/plain")
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_llm(request, chat=True)
+
+    async def handle_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_llm(request, chat=False)
+
+    async def _handle_llm(self, request: web.Request, chat: bool) -> web.StreamResponse:
+        route = "chat" if chat else "completions"
+        t0 = time.perf_counter()
+        try:
+            body = await request.json()
+        except Exception:
+            self._requests.inc(route=route, model="unknown", status="400")
+            return web.json_response(error_body("invalid JSON body"), status=400)
+        try:
+            parsed = parse_chat_request(body) if chat else parse_completion_request(body)
+        except RequestError as e:
+            self._requests.inc(route=route, model=str(body.get("model")), status="400")
+            return web.json_response(error_body(str(e)), status=400)
+
+        served = self.manager.get(parsed.model)
+        if served is None:
+            self._requests.inc(route=route, model=parsed.model, status="404")
+            return web.json_response(
+                error_body(f"model '{parsed.model}' not found", "model_not_found", 404),
+                status=404,
+            )
+
+        ctx = Context()
+        rid = request.headers.get("x-request-id") or request.headers.get("x-dynamo-request-id")
+        if rid:
+            ctx.id = rid
+        ctx.traceparent = request.headers.get("traceparent")
+
+        self._inflight_count += 1
+        self._inflight.set(self._inflight_count)
+        try:
+            stream = served.pipeline.generate(parsed, ctx)
+            if parsed.stream:
+                return await self._stream_sse(request, stream, ctx, route, parsed.model, t0)
+            try:
+                agg = aggregate_chat_stream(stream) if chat else aggregate_completion_stream(stream)
+                result = await agg
+            except NoRespondersError:
+                self._requests.inc(route=route, model=parsed.model, status="503")
+                return web.json_response(
+                    error_body("no workers available", "service_unavailable", 503), status=503
+                )
+            except (ValueError, RuntimeError) as e:
+                self._requests.inc(route=route, model=parsed.model, status="400")
+                return web.json_response(error_body(str(e)), status=400)
+            self._requests.inc(route=route, model=parsed.model, status="200")
+            self._latency.observe(time.perf_counter() - t0, route=route)
+            return web.json_response(result, headers={"x-request-id": ctx.id})
+        finally:
+            self._inflight_count -= 1
+            self._inflight.set(self._inflight_count)
+
+    async def _stream_sse(
+        self, request: web.Request, stream, ctx: Context, route: str, model: str, t0: float
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "x-request-id": ctx.id,
+            },
+        )
+        await resp.prepare(request)
+        first = True
+        status = "200"
+        try:
+            async for wire in stream:
+                ann = Annotated.from_wire(wire)
+                if ann.is_error():
+                    payload = {"error": {"message": "; ".join(ann.comment or []), "type": "engine_error"}}
+                    await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+                    status = "500"
+                    break
+                if ann.event is not None:
+                    await resp.write(
+                        f"event: {ann.event}\ndata: {json.dumps(ann.data)}\n\n".encode()
+                    )
+                    continue
+                if first:
+                    self._ttft.observe(time.perf_counter() - t0, route=route)
+                    first = False
+                await resp.write(f"data: {json.dumps(ann.data)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: propagate cancellation to the worker
+            ctx.cancel()
+            status = "499"
+            raise
+        except NoRespondersError:
+            await resp.write(
+                f"data: {json.dumps(error_body('no workers available', 'service_unavailable', 503))}\n\n".encode()
+            )
+            status = "503"
+        except Exception as e:
+            logger.exception("stream failed")
+            await resp.write(
+                f"data: {json.dumps(error_body(f'stream error: {e!r}', 'internal_error', 500))}\n\n".encode()
+            )
+            status = "500"
+        finally:
+            self._requests.inc(route=route, model=model, status=status)
+            self._latency.observe(time.perf_counter() - t0, route=route)
+        await resp.write_eof()
+        return resp

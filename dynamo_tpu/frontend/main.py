@@ -1,0 +1,61 @@
+"""``python -m dynamo_tpu.frontend.main`` — run the OpenAI HTTP frontend.
+
+Equivalent of ``python -m dynamo.frontend`` in the reference: joins the
+control plane, watches model registrations, serves OpenAI HTTP with the chosen
+routing mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.frontend.http import HttpService
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.router.protocols import KvRouterConfig
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.config import setup_logging
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--router-mode", choices=["kv", "round_robin", "random"], default="kv")
+    ap.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
+    ap.add_argument("--router-temperature", type=float, default=0.0)
+    args = ap.parse_args()
+
+    runtime = await DistributedRuntime.create()
+    manager = ModelManager()
+    watcher = await ModelWatcher(
+        runtime,
+        manager,
+        router_mode=args.router_mode,
+        kv_router_config=KvRouterConfig(
+            overlap_score_weight=args.kv_overlap_score_weight,
+            router_temperature=args.router_temperature,
+        ),
+    ).start()
+    service = HttpService(manager, host=args.host, port=args.port)
+    await service.start()
+    print(f"FRONTEND_READY port={service.port}", flush=True)
+
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await service.stop()
+    await watcher.stop()
+    await runtime.shutdown()
+
+
+def main():
+    setup_logging()
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+"""LLM pipeline layer: tokenization, preprocessing, detokenization, migration,
+model cards and discovery (rebuild of the reference's lib/llm pipeline ops,
+SURVEY.md §2.2)."""

@@ -1,0 +1,165 @@
+"""Model discovery: watch registrations, build per-model serving pipelines.
+
+Rebuild of the reference's ``ModelWatcher``/``ModelManager`` (ref: lib/llm/src/
+discovery/{watcher.rs:48,model_manager.rs:34}): frontends watch the
+``models/`` prefix; when a model's first worker registers, the watcher builds
+the canonical pipeline (preprocessor → backend → migration → router) pointed
+at that model's endpoint, and tears it down when the last worker leaves.
+
+Routing mode per model: ``kv`` (KV-aware KvPushRouter) or ``round_robin`` /
+``random`` (plain client routing).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.llm.model_card import MODEL_ROOT, ModelDeploymentCard, ModelEntry
+from dynamo_tpu.llm.pipeline import build_pipeline, OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import TokenizerWrapper, make_test_tokenizer
+from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
+from dynamo_tpu.router.protocols import KvRouterConfig
+from dynamo_tpu.runtime.component import Client
+from dynamo_tpu.runtime.context import Context
+
+logger = logging.getLogger("dynamo.discovery")
+
+
+def load_tokenizer(card: ModelDeploymentCard) -> TokenizerWrapper:
+    if card.tokenizer_ref == "test":
+        return make_test_tokenizer()
+    return TokenizerWrapper.from_dir(card.tokenizer_ref)
+
+
+@dataclass
+class ServedModel:
+    name: str
+    card: ModelDeploymentCard
+    client: Client
+    pipeline: OpenAIPreprocessor
+    router: Optional[KvRouter] = None
+    entries: dict[str, ModelEntry] = field(default_factory=dict)  # key -> entry
+
+    async def stop(self):
+        await self.client.stop()
+        if self.router:
+            await self.router.stop()
+
+
+class ModelManager:
+    """Holds the live model set; the HTTP layer resolves engines here."""
+
+    def __init__(self):
+        self.models: dict[str, ServedModel] = {}
+
+    def get(self, model_name: str) -> Optional[ServedModel]:
+        m = self.models.get(model_name)
+        if m is not None:
+            return m
+        # case-insensitive / slug fallback
+        low = model_name.lower()
+        for name, sm in self.models.items():
+            if name.lower() == low:
+                return sm
+        return None
+
+    def list_models(self) -> list[str]:
+        return sorted(self.models)
+
+
+class ModelWatcher:
+    def __init__(
+        self,
+        runtime,
+        manager: ModelManager,
+        router_mode: str = "kv",
+        kv_router_config: Optional[KvRouterConfig] = None,
+    ):
+        self.runtime = runtime
+        self.manager = manager
+        self.router_mode = router_mode
+        self.kv_router_config = kv_router_config or KvRouterConfig()
+        self._watch = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "ModelWatcher":
+        self._watch = await self.runtime.plane.watch_prefix(MODEL_ROOT + "/")
+        for k, v in self._watch.snapshot.items():
+            await self._apply("put", k, v)
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self):
+        if self._task:
+            self._task.cancel()
+        if self._watch:
+            await self._watch.cancel()
+        for m in list(self.manager.models.values()):
+            await m.stop()
+        self.manager.models.clear()
+
+    async def _loop(self):
+        try:
+            async for ev in self._watch:
+                try:
+                    await self._apply(ev.type, ev.key, ev.value)
+                except Exception:
+                    logger.exception("model watch event failed for %s", ev.key)
+        except asyncio.CancelledError:
+            pass
+
+    async def _apply(self, typ: str, key: str, value: bytes):
+        if typ == "put":
+            entry = ModelEntry.from_wire(msgpack.unpackb(value, raw=False))
+            await self._add(key, entry)
+        else:
+            await self._remove(key)
+
+    async def _add(self, key: str, entry: ModelEntry):
+        sm = self.manager.get(entry.name)
+        if sm is None:
+            card = entry.card or ModelDeploymentCard(display_name=entry.name)
+            tokenizer = load_tokenizer(card)
+            endpoint = (
+                self.runtime.namespace(entry.namespace)
+                .component(entry.component)
+                .endpoint(entry.endpoint)
+            )
+            client = await endpoint.client().start()
+            router = None
+            if self.router_mode == "kv":
+                router = await KvRouter(
+                    self.runtime.plane, card.kv_cache_block_size, self.kv_router_config
+                ).start()
+                engine = KvPushRouter(client, router).generate
+            else:
+                mode = self.router_mode
+
+                async def engine(req, ctx: Context, _client=client, _mode=mode):
+                    wire = req.to_wire() if hasattr(req, "to_wire") else req
+                    stream = await _client.generate(wire, ctx=ctx, mode=_mode)
+                    async for item in stream:
+                        yield item
+
+            pipeline = build_pipeline(card, tokenizer, engine)
+            sm = ServedModel(
+                name=entry.name, card=card, client=client, pipeline=pipeline, router=router
+            )
+            self.manager.models[entry.name] = sm
+            logger.info("model %s now served (router=%s)", entry.name, self.router_mode)
+        sm.entries[key] = entry
+
+    async def _remove(self, key: str):
+        for name, sm in list(self.manager.models.items()):
+            if key in sm.entries:
+                del sm.entries[key]
+                if not sm.entries:
+                    logger.info("model %s: last worker left, tearing down", name)
+                    await sm.stop()
+                    del self.manager.models[name]
+                return

@@ -1,0 +1,459 @@
+"""The in-process operator pipeline: preprocess → detokenize → migrate → route.
+
+Rebuild of the reference's canonical engine chain (ref: lib/llm/src/entrypoint/
+input/common.rs:259-312): every model served over HTTP gets
+
+    frontend → OpenAIPreprocessor → Backend(detokenizer) → Migration → client
+
+where ``client`` issues the request to a worker instance (possibly KV-routed).
+Operators are async-generator transformers over ``(request, Context)``; the
+request flows "forward" through each operator, the response stream flows
+"backward" being transformed at each hop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, AsyncIterator, Callable, Optional
+
+from dynamo_tpu.protocols import (
+    Annotated,
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.protocols.openai import (
+    ParsedRequest,
+    chat_chunk,
+    completion_chunk,
+    gen_request_id,
+    usage_block,
+)
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+from dynamo_tpu.runtime.context import Context, StreamError
+
+logger = logging.getLogger("dynamo.pipeline")
+
+#: downstream engine: async generator fn of (request, ctx) -> LLMEngineOutput stream
+EngineFn = Callable[[Any, Context], AsyncIterator[Any]]
+
+
+def is_event(item: Any) -> bool:
+    """True for Annotated out-of-band events (annotations, dry-route replies)
+    that must pass through operators untransformed."""
+    return isinstance(item, dict) and "event" in item and "token_ids" not in item
+
+
+# ---------------------------------------------------------------------------
+# Preprocessor
+# ---------------------------------------------------------------------------
+
+
+class OpenAIPreprocessor:
+    """OpenAI request → PreprocessedRequest; engine stream → OpenAI chunks.
+
+    ref: lib/llm/src/preprocessor.rs:158-280 (apply_template :279, tokenize :205).
+    """
+
+    def __init__(self, mdc: ModelDeploymentCard, tokenizer: TokenizerWrapper, downstream: EngineFn):
+        self.mdc = mdc
+        self.tokenizer = tokenizer
+        self.downstream = downstream
+        self._template_env = None
+
+    def _render_chat(self, req: ParsedRequest) -> str:
+        import jinja2
+
+        template_src = (
+            self.mdc.chat_template
+            or self.tokenizer.chat_template
+        )
+        if not template_src:
+            # crude concatenation fallback
+            return "\n".join(f"{m['role']}: {m.get('content', '')}" for m in req.messages) + "\nassistant:"
+        if self._template_env is None:
+            self._template_env = jinja2.Environment(keep_trailing_newline=True)
+            self._template_env.globals["raise_exception"] = _jinja_raise
+        template = self._template_env.from_string(template_src)
+        return template.render(
+            messages=req.messages,
+            tools=req.tools,
+            add_generation_prompt=True,
+            bos_token=self.tokenizer.bos_token or "",
+            eos_token=self.tokenizer.eos_token or "",
+        )
+
+    def preprocess(self, req: ParsedRequest) -> tuple[PreprocessedRequest, str]:
+        if req.messages is not None:
+            prompt = self._render_chat(req)
+            token_ids = self.tokenizer.encode(prompt)
+        else:
+            p = req.prompt
+            if isinstance(p, str):
+                prompt = p
+                token_ids = self.tokenizer.encode(p)
+            elif isinstance(p, list) and all(isinstance(t, int) for t in p):
+                prompt = ""
+                token_ids = list(p)
+            else:
+                raise ValueError("unsupported prompt type (batch prompts not yet supported)")
+
+        max_in = self.mdc.context_length
+        if len(token_ids) >= max_in:
+            raise ValueError(
+                f"prompt length {len(token_ids)} exceeds model context length {max_in}"
+            )
+        stop = req.stop
+        if stop.max_tokens is None:
+            stop.max_tokens = max_in - len(token_ids)
+        stop.max_tokens = min(stop.max_tokens, max_in - len(token_ids))
+        stop.apply_ignore_eos()
+
+        pre = PreprocessedRequest(
+            model=req.model,
+            token_ids=token_ids,
+            stop_conditions=stop,
+            sampling_options=req.sampling,
+            output_options=req.output,
+            eos_token_ids=list(self.mdc.eos_token_ids),
+            mdc_sum=self.mdc.checksum(),
+            annotations=req.annotations,
+            backend_instance_id=req.backend_instance_id,
+            router_config_override=req.router_config_override,
+        )
+        return pre, prompt
+
+    async def generate(self, req: ParsedRequest, ctx: Context) -> AsyncIterator[dict]:
+        """Yields Annotated-wire dicts whose ``data`` are OpenAI chunk objects."""
+        is_chat = req.messages is not None
+        pre, prompt = self.preprocess(req)
+
+        request_id = gen_request_id("chatcmpl" if is_chat else "cmpl")
+        created = int(time.time())
+
+        if "formatted_prompt" in req.annotations:
+            yield Annotated(event="formatted_prompt", data=prompt, id=ctx.id).to_wire()
+        if "token_ids" in req.annotations:
+            yield Annotated(event="token_ids", data=pre.token_ids, id=ctx.id).to_wire()
+
+        n_prompt = len(pre.token_ids)
+        n_completion = 0
+        first = True
+        async for out in self.downstream(pre, ctx):
+            if is_event(out):
+                yield out  # already Annotated wire form
+                continue
+            if isinstance(out, dict):
+                out = LLMEngineOutput.from_wire(out)
+            if out.finish_reason == FinishReason.ERROR:
+                yield Annotated.from_error(out.text or "engine error").to_wire()
+                return
+            n_completion += len(out.token_ids)
+            finish = FinishReason.to_openai(out.finish_reason)
+            text = out.text or ""
+            if is_chat:
+                chunk = chat_chunk(
+                    request_id, req.model, created,
+                    role="assistant" if first else None,
+                    content=text if (text or not finish) else None,
+                    finish_reason=finish,
+                )
+            else:
+                chunk = completion_chunk(
+                    request_id, req.model, created, text=text, finish_reason=finish
+                )
+            first = False
+            if out.finish_reason is not None and (req.stream_usage or not req.stream):
+                chunk["usage"] = usage_block(n_prompt, n_completion)
+            yield Annotated(data=chunk, id=ctx.id).to_wire()
+
+
+def _jinja_raise(msg):
+    raise ValueError(msg)
+
+
+# ---------------------------------------------------------------------------
+# Backend (incremental detokenizer with hidden-stop-sequence jail)
+# ---------------------------------------------------------------------------
+
+
+class StopSequenceJail:
+    """Holds back text that might be the start of a stop string.
+
+    ref: lib/llm/src/backend.rs:47-533 — the returned output must not contain
+    the stop strings, so any tail that is a prefix of a stop sequence is
+    "jailed" until disambiguated.
+    """
+
+    def __init__(self, stops: list[str]):
+        self.stops = [s for s in (stops or []) if s]
+        self._buf = ""
+
+    def feed(self, text: str) -> tuple[str, bool]:
+        """Returns (emit_text, hit_stop)."""
+        if not self.stops:
+            return text, False
+        self._buf += text
+        for s in self.stops:
+            idx = self._buf.find(s)
+            if idx != -1:
+                emit = self._buf[:idx]
+                self._buf = ""
+                return emit, True
+        # longest suffix of buf that is a prefix of any stop
+        hold = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, len(self._buf)), 0, -1):
+                if self._buf.endswith(s[:k]):
+                    hold = max(hold, k)
+                    break
+        if hold:
+            emit, self._buf = self._buf[:-hold], self._buf[-hold:]
+        else:
+            emit, self._buf = self._buf, ""
+        return emit, False
+
+    def flush(self) -> str:
+        out, self._buf = self._buf, ""
+        return out
+
+
+class Backend:
+    """Detokenizing operator: token_ids → text deltas, finish-reason mapping."""
+
+    def __init__(self, tokenizer: TokenizerWrapper, downstream: EngineFn):
+        self.tokenizer = tokenizer
+        self.downstream = downstream
+
+    async def generate(self, req: PreprocessedRequest, ctx: Context) -> AsyncIterator[LLMEngineOutput]:
+        decoder = self.tokenizer.decode_stream(
+            skip_special_tokens=req.output_options.skip_special_tokens
+        )
+        jail = StopSequenceJail(req.stop_conditions.stop or [])
+        hidden_stops = set(req.stop_conditions.stop_token_ids_hidden or [])
+        eos_ids = set(req.eos_token_ids)
+        ignore_eos = bool(req.stop_conditions.ignore_eos)
+        min_tokens = req.stop_conditions.min_tokens or 0
+        emitted = 0
+
+        async for out in self.downstream(req, ctx):
+            if is_event(out):
+                yield out
+                continue
+            if isinstance(out, dict):
+                out = LLMEngineOutput.from_wire(out)
+            if out.finish_reason == FinishReason.ERROR:
+                yield out
+                return
+            text_parts = []
+            stop_hit = None
+            for tid in out.token_ids:
+                emitted += 1
+                if not ignore_eos and emitted > min_tokens and (tid in hidden_stops or tid in eos_ids):
+                    stop_hit = FinishReason.STOP if tid in hidden_stops else FinishReason.EOS
+                    break
+                piece = decoder.step(tid)
+                if piece:
+                    emit, hit = jail.feed(piece)
+                    if emit:
+                        text_parts.append(emit)
+                    if hit:
+                        stop_hit = FinishReason.STOP
+                        break
+            text = "".join(text_parts)
+            if stop_hit is not None:
+                yield LLMEngineOutput(
+                    token_ids=out.token_ids, text=text, finish_reason=stop_hit, index=out.index
+                )
+                return
+            finish = out.finish_reason
+            if finish is not None and finish not in (FinishReason.ERROR,):
+                # engine finished (length/eos/cancelled): flush nothing from the
+                # jail — jailed text is by definition a stop-string prefix, but
+                # with no stop hit it is legitimate tail text.
+                tail = jail.flush()
+                if tail:
+                    text += tail
+            yield LLMEngineOutput(
+                token_ids=out.token_ids,
+                text=text,
+                cum_log_probs=out.cum_log_probs,
+                log_probs=out.log_probs,
+                finish_reason=finish,
+                index=out.index,
+                kv_transfer_params=out.kv_transfer_params,
+            )
+            if finish is not None:
+                return
+
+
+# ---------------------------------------------------------------------------
+# Migration (stream-level fault tolerance)
+# ---------------------------------------------------------------------------
+
+
+class Migration:
+    """Replays a broken stream on a new worker with accumulated tokens.
+
+    ref: lib/llm/src/migration.rs:26-716 + docs/architecture/request_migration.md:
+    on a mid-stream transport error the request is re-issued with
+    ``token_ids + tokens_emitted_so_far`` so the new worker continues where
+    the dead one stopped; bounded by the MDC's ``migration_limit``.
+    """
+
+    def __init__(self, downstream: EngineFn, migration_limit: int = 3):
+        self.downstream = downstream
+        self.migration_limit = migration_limit
+
+    async def generate(self, req: PreprocessedRequest, ctx: Context) -> AsyncIterator[LLMEngineOutput]:
+        accumulated: list[int] = []
+        budget = self.migration_limit if req.backend_instance_id is None else 0
+        current = req
+        while True:
+            try:
+                async for out in self.downstream(current, ctx):
+                    if is_event(out):
+                        yield out
+                        continue
+                    if isinstance(out, dict):
+                        out = LLMEngineOutput.from_wire(out)
+                    accumulated.extend(out.token_ids)
+                    yield out
+                    if out.finish_reason is not None:
+                        return
+                return
+            except StreamError as e:
+                if budget <= 0 or ctx.cancelled:
+                    raise
+                budget -= 1
+                remaining = None
+                if current.stop_conditions.max_tokens is not None:
+                    remaining = current.stop_conditions.max_tokens - len(accumulated)
+                    if remaining <= 0:
+                        yield LLMEngineOutput(finish_reason=FinishReason.LENGTH)
+                        return
+                logger.warning(
+                    "migrating request %s after %d tokens (%s); %d retries left",
+                    ctx.id, len(accumulated), e, budget,
+                )
+                new_stop = _clone_stop(current.stop_conditions, remaining)
+                current = PreprocessedRequest(
+                    model=current.model,
+                    token_ids=list(req.token_ids) + accumulated,
+                    stop_conditions=new_stop,
+                    sampling_options=current.sampling_options,
+                    output_options=current.output_options,
+                    eos_token_ids=current.eos_token_ids,
+                    mdc_sum=current.mdc_sum,
+                    annotations=current.annotations,
+                    router_config_override=current.router_config_override,
+                )
+                await asyncio.sleep(0.05)
+
+
+def _clone_stop(sc, max_tokens: Optional[int]):
+    from dataclasses import replace
+
+    return replace(sc, max_tokens=max_tokens if max_tokens is not None else sc.max_tokens)
+
+
+# ---------------------------------------------------------------------------
+# Composition helpers
+# ---------------------------------------------------------------------------
+
+
+def build_pipeline(
+    mdc: ModelDeploymentCard,
+    tokenizer: TokenizerWrapper,
+    engine: EngineFn,
+) -> "OpenAIPreprocessor":
+    """frontend-facing engine = Preprocessor(Backend(Migration(engine)))."""
+    migration = Migration(engine, migration_limit=mdc.migration_limit)
+    backend = Backend(tokenizer, migration.generate)
+    return OpenAIPreprocessor(mdc, tokenizer, backend.generate)
+
+
+async def aggregate_chat_stream(stream: AsyncIterator[dict]) -> dict:
+    """Fold a chunk stream into a non-streaming chat completion response."""
+    content: dict[int, list[str]] = {}
+    finish: dict[int, Optional[str]] = {}
+    base: Optional[dict] = None
+    usage = None
+    async for wire in stream:
+        ann = Annotated.from_wire(wire)
+        if ann.is_error():
+            raise RuntimeError("; ".join(ann.comment or ["stream error"]))
+        if ann.event is not None:
+            continue
+        chunk = ann.data
+        base = base or chunk
+        usage = chunk.get("usage") or usage
+        for ch in chunk.get("choices", []):
+            idx = ch.get("index", 0)
+            delta = ch.get("delta") or {}
+            if delta.get("content"):
+                content.setdefault(idx, []).append(delta["content"])
+            if ch.get("finish_reason"):
+                finish[idx] = ch["finish_reason"]
+    if base is None:
+        raise RuntimeError("empty response stream")
+    choices = [
+        {
+            "index": idx,
+            "message": {"role": "assistant", "content": "".join(content.get(idx, []))},
+            "finish_reason": finish.get(idx),
+        }
+        for idx in sorted(set(content) | set(finish) | {0})
+    ]
+    return {
+        "id": base["id"],
+        "object": "chat.completion",
+        "created": base["created"],
+        "model": base["model"],
+        "choices": choices,
+        "usage": usage or usage_block(0, 0),
+    }
+
+
+async def aggregate_completion_stream(stream: AsyncIterator[dict]) -> dict:
+    texts: dict[int, list[str]] = {}
+    finish: dict[int, Optional[str]] = {}
+    base = None
+    usage = None
+    async for wire in stream:
+        ann = Annotated.from_wire(wire)
+        if ann.is_error():
+            raise RuntimeError("; ".join(ann.comment or ["stream error"]))
+        if ann.event is not None:
+            continue
+        chunk = ann.data
+        base = base or chunk
+        usage = chunk.get("usage") or usage
+        for ch in chunk.get("choices", []):
+            idx = ch.get("index", 0)
+            if ch.get("text"):
+                texts.setdefault(idx, []).append(ch["text"])
+            if ch.get("finish_reason"):
+                finish[idx] = ch["finish_reason"]
+    if base is None:
+        raise RuntimeError("empty response stream")
+    choices = [
+        {
+            "index": idx,
+            "text": "".join(texts.get(idx, [])),
+            "finish_reason": finish.get(idx),
+            "logprobs": None,
+        }
+        for idx in sorted(set(texts) | set(finish) | {0})
+    ]
+    return {
+        "id": base["id"],
+        "object": "text_completion",
+        "created": base["created"],
+        "model": base["model"],
+        "choices": choices,
+        "usage": usage or usage_block(0, 0),
+    }

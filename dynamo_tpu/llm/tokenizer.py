@@ -1,0 +1,112 @@
+"""Tokenizer wrapper with incremental (streaming) detokenization.
+
+Rebuild of the reference's tokenizer layer (ref: lib/llm/src/tokenizers.rs:1-564,
+backend.rs DecodeStream usage): wraps an HF ``tokenizers.Tokenizer`` and exposes
+encode/decode plus a stateful per-request decode stream.
+
+``make_test_tokenizer`` builds a small deterministic WordLevel tokenizer in
+memory so the whole pipeline (and CI) runs without model downloads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from tokenizers import Tokenizer
+from tokenizers.decoders import DecodeStream
+
+
+class TokenizerWrapper:
+    def __init__(self, tokenizer: Tokenizer, chat_template: Optional[str] = None,
+                 bos_token: Optional[str] = None, eos_token: Optional[str] = None):
+        self._tk = tokenizer
+        self.chat_template = chat_template
+        self.bos_token = bos_token
+        self.eos_token = eos_token
+        self.eos_token_id: Optional[int] = (
+            tokenizer.token_to_id(eos_token) if eos_token else None
+        )
+        self.bos_token_id: Optional[int] = (
+            tokenizer.token_to_id(bos_token) if bos_token else None
+        )
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tk.get_vocab_size()
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        return self._tk.encode(text, add_special_tokens=add_special_tokens).ids
+
+    def decode(self, ids: list[int], skip_special_tokens: bool = True) -> str:
+        return self._tk.decode(ids, skip_special_tokens=skip_special_tokens)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._tk.token_to_id(token)
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> "IncrementalDecoder":
+        return IncrementalDecoder(self._tk, skip_special_tokens)
+
+    @staticmethod
+    def from_dir(path: str) -> "TokenizerWrapper":
+        """Load tokenizer.json (+ chat template from tokenizer_config.json)."""
+        tk = Tokenizer.from_file(os.path.join(path, "tokenizer.json"))
+        chat_template = bos = eos = None
+        cfg_path = os.path.join(path, "tokenizer_config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+            chat_template = cfg.get("chat_template")
+
+            def _tok(v):
+                if isinstance(v, dict):
+                    return v.get("content")
+                return v
+
+            bos = _tok(cfg.get("bos_token"))
+            eos = _tok(cfg.get("eos_token"))
+        return TokenizerWrapper(tk, chat_template, bos, eos)
+
+
+class IncrementalDecoder:
+    """Stateful token→text decoder for one response stream."""
+
+    def __init__(self, tokenizer: Tokenizer, skip_special_tokens: bool = True):
+        self._tk = tokenizer
+        self._stream = DecodeStream(skip_special_tokens=skip_special_tokens)
+
+    def step(self, token_id: int) -> Optional[str]:
+        """Feed one token; returns newly-decodable text (None while pending)."""
+        return self._stream.step(self._tk, token_id)
+
+
+DEFAULT_TEST_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ '<|' + message['role'] + '|>' }} {{ message['content'] }}\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|assistant|>' }}{% endif %}"
+)
+
+
+def make_test_tokenizer(extra_words: Optional[list[str]] = None) -> TokenizerWrapper:
+    """Small deterministic whitespace WordLevel tokenizer for tests/mocker."""
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    words = [
+        "<unk>", "<s>", "</s>", "<|user|>", "<|assistant|>", "<|system|>",
+        "hello", "world", "the", "quick", "brown", "fox", "jumps", "over",
+        "lazy", "dog", "a", "b", "c", "d", "e", "f", "g", "h", "i", "j",
+        "what", "is", "capital", "of", "france", "paris", "tell", "me",
+        "about", "tokens", "stream", "stop", "sequence", "test", ".", ",", "?",
+    ] + (extra_words or [])
+    vocab = {w: i for i, w in enumerate(dict.fromkeys(words))}
+    tk = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tk.pre_tokenizer = Whitespace()
+    return TokenizerWrapper(
+        tk,
+        chat_template=DEFAULT_TEST_CHAT_TEMPLATE,
+        bos_token="<s>",
+        eos_token="</s>",
+    )

@@ -1,0 +1,6 @@
+"""Mocker: a simulated LLM engine for distributed tests without accelerators
+(rebuild of lib/llm/src/mocker/, SURVEY.md §2.2 "Mocker")."""
+
+from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+
+__all__ = ["MockEngine", "MockEngineArgs"]

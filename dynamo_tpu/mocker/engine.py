@@ -1,0 +1,352 @@
+"""Simulated engine: continuous batching, chunked prefill, prefix cache,
+genuine KV events and load metrics — no accelerator needed.
+
+Rebuild of the reference's mocker (ref: lib/llm/src/mocker/{engine.rs:48,
+scheduler.rs:240,kv_manager.rs,evictor.rs,protocols.rs:67-100}): the mocker is
+the backbone of router/planner/frontend tests because it emits *real* KV
+events (same hash domain as the frontend) and real ForwardPassMetrics while
+modeling engine timing (prefill cost, chunked prefill, decode batching,
+watermark-based admission, LRU prefix-cache eviction).
+
+The token stream it produces is deterministic per request (seeded by the
+prompt) so tests can assert determinism.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Optional
+
+from dynamo_tpu.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.router.protocols import ForwardPassMetrics, KvStats, StoredBlock, WorkerStats
+from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.tokens import TokenBlockSequence
+
+logger = logging.getLogger("dynamo.mocker")
+
+
+@dataclass
+class MockEngineArgs:
+    """ref: mocker/protocols.rs:67-100 (same knobs, same defaults where sane)."""
+
+    num_gpu_blocks: int = 8192
+    block_size: int = 16
+    max_num_seqs: int = 256
+    max_num_batched_tokens: int = 8192
+    enable_prefix_caching: bool = True
+    enable_chunked_prefill: bool = True
+    watermark: float = 0.01
+    speedup_ratio: float = 1.0
+    #: base + per-token prefill cost (ms), divided by speedup_ratio
+    prefill_base_ms: float = 5.0
+    prefill_per_token_ms: float = 0.02
+    #: base + per-seq decode cost (ms) per iteration
+    decode_base_ms: float = 2.0
+    decode_per_seq_ms: float = 0.05
+    vocab_size: int = 1000
+
+
+@dataclass
+class _Seq:
+    request_id: str
+    req: PreprocessedRequest
+    ctx: Context
+    out_queue: "asyncio.Queue[Optional[LLMEngineOutput]]"
+    blocks: TokenBlockSequence = None  # full sequence incl. generated
+    prefill_pos: int = 0  # tokens prefilled so far
+    cached_tokens: int = 0  # tokens skipped via prefix cache
+    generated: int = 0
+    rng: random.Random = None
+    owned_block_hashes: list[int] = field(default_factory=list)
+    finished: Optional[str] = None
+
+    @property
+    def isl(self) -> int:
+        return len(self.req.token_ids)
+
+    @property
+    def in_prefill(self) -> bool:
+        return self.prefill_pos < self.isl
+
+
+class KvCacheSim:
+    """Block pool with active refcounts + inactive LRU prefix cache.
+
+    Mirrors the reference's KvManager+evictor semantics (ref: mocker/
+    kv_manager.rs, evictor.rs): blocks are keyed by chained sequence hash;
+    completed requests' blocks drop into an LRU reuse pool; admission needs
+    free = capacity - active - watermark; storing evicts LRU inactive blocks.
+    """
+
+    def __init__(self, capacity: int, watermark: float):
+        self.capacity = capacity
+        self.watermark_blocks = int(capacity * watermark)
+        self.active: dict[int, int] = {}  # seq_hash -> refcount
+        self.inactive: dict[int, float] = {}  # seq_hash -> last_use (LRU)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self.active) + len(self.inactive)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.capacity - self.used_blocks
+
+    def can_allocate(self, n: int) -> bool:
+        return self.free_blocks + len(self.inactive) - self.watermark_blocks >= n
+
+    def lookup_prefix(self, seq_hashes: list[int]) -> int:
+        """Longest cached prefix (active or inactive), in blocks."""
+        n = 0
+        for h in seq_hashes:
+            if h in self.active or h in self.inactive:
+                n += 1
+            else:
+                break
+        return n
+
+    def acquire(self, seq_hash: int) -> tuple[bool, list[int]]:
+        """Activate a block; returns (is_new_block, evicted_hashes)."""
+        evicted: list[int] = []
+        if seq_hash in self.active:
+            self.active[seq_hash] += 1
+            return False, evicted
+        if seq_hash in self.inactive:
+            del self.inactive[seq_hash]
+            self.active[seq_hash] = 1
+            return False, evicted
+        while self.free_blocks < 1 and self.inactive:
+            lru = min(self.inactive, key=self.inactive.get)
+            del self.inactive[lru]
+            evicted.append(lru)
+        self.active[seq_hash] = 1
+        return True, evicted
+
+    def release(self, seq_hash: int, cache: bool) -> Optional[int]:
+        """Drop one reference; returns the hash if the block left the pool."""
+        rc = self.active.get(seq_hash)
+        if rc is None:
+            return None
+        if rc > 1:
+            self.active[seq_hash] = rc - 1
+            return None
+        del self.active[seq_hash]
+        if cache:
+            self.inactive[seq_hash] = time.monotonic()
+            return None
+        return seq_hash
+
+
+class MockEngine:
+    """Async continuous-batching simulator serving PreprocessedRequests."""
+
+    def __init__(
+        self,
+        args: MockEngineArgs,
+        kv_publisher: Optional[KvEventPublisher] = None,
+        metrics_publisher: Optional[WorkerMetricsPublisher] = None,
+    ):
+        self.args = args
+        self.kv_publisher = kv_publisher
+        self.metrics_publisher = metrics_publisher
+        self.cache = KvCacheSim(args.num_gpu_blocks, args.watermark)
+        self.waiting: list[_Seq] = []
+        self.running: list[_Seq] = []
+        self._task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._stopped = False
+        self.iterations = 0
+
+    async def start(self) -> "MockEngine":
+        self._task = asyncio.get_running_loop().create_task(self._engine_loop())
+        return self
+
+    async def stop(self):
+        self._stopped = True
+        self._wake.set()
+        if self._task:
+            await self._task
+
+    # -- public engine interface ------------------------------------------
+    async def generate(self, req, ctx: Context) -> AsyncIterator[dict]:
+        """Endpoint handler: yields LLMEngineOutput wire dicts."""
+        if isinstance(req, dict):
+            req = PreprocessedRequest.from_wire(req)
+        seq = _Seq(
+            request_id=ctx.id,
+            req=req,
+            ctx=ctx,
+            out_queue=asyncio.Queue(),
+            blocks=TokenBlockSequence.from_tokens(req.token_ids, self.args.block_size),
+            rng=random.Random(req.sampling_options.seed if req.sampling_options.seed is not None
+                              else hash(tuple(req.token_ids)) & 0xFFFFFFFF),
+        )
+        self.waiting.append(seq)
+        self._wake.set()
+        while True:
+            out = await seq.out_queue.get()
+            if out is None:
+                return
+            yield out.to_wire()
+            if out.finish_reason is not None:
+                return
+
+    # -- engine loop -------------------------------------------------------
+    async def _engine_loop(self):
+        try:
+            while not self._stopped:
+                if not self.running and not self.waiting:
+                    self._wake.clear()
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), timeout=0.5)
+                    except asyncio.TimeoutError:
+                        continue
+                    continue
+                await self._step()
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("mocker engine loop crashed")
+
+    async def _step(self):
+        self.iterations += 1
+        self._admit()
+        prefill_tokens = await self._run_prefill_chunk()
+        decoded = await self._run_decode()
+        # simulated iteration latency
+        ms = 0.0
+        if prefill_tokens:
+            ms += self.args.prefill_base_ms + prefill_tokens * self.args.prefill_per_token_ms
+        if decoded:
+            ms += self.args.decode_base_ms + decoded * self.args.decode_per_seq_ms
+        if ms:
+            await asyncio.sleep(ms / 1000.0 / self.args.speedup_ratio)
+        else:
+            await asyncio.sleep(0)
+        self._reap_finished()
+        await self._publish_metrics()
+
+    def _admit(self):
+        while self.waiting and len(self.running) < self.args.max_num_seqs:
+            seq = self.waiting[0]
+            needed = len(seq.blocks.blocks) + 1
+            if not self.cache.can_allocate(needed):
+                break
+            self.waiting.pop(0)
+            if self.args.enable_prefix_caching:
+                cached = self.cache.lookup_prefix(seq.blocks.sequence_hashes())
+                seq.cached_tokens = cached * self.args.block_size
+                seq.prefill_pos = min(seq.cached_tokens, seq.isl)
+            self.running.append(seq)
+
+    async def _run_prefill_chunk(self) -> int:
+        budget = self.args.max_num_batched_tokens
+        total = 0
+        for seq in self.running:
+            if budget <= 0:
+                break
+            if not seq.in_prefill or seq.finished:
+                continue
+            chunk = min(seq.isl - seq.prefill_pos, budget) if self.args.enable_chunked_prefill else (
+                seq.isl - seq.prefill_pos
+            )
+            start_block = seq.prefill_pos // self.args.block_size
+            seq.prefill_pos += chunk
+            budget -= chunk
+            total += chunk
+            end_block = seq.prefill_pos // self.args.block_size
+            await self._store_blocks(seq, start_block, end_block)
+        return total
+
+    async def _store_blocks(self, seq: _Seq, start_block: int, end_block: int):
+        """Acquire+announce newly-filled complete blocks [start, end)."""
+        blocks = seq.blocks.blocks[start_block:end_block]
+        if not blocks:
+            return
+        stored: list[StoredBlock] = []
+        evicted_all: list[int] = []
+        parent = seq.blocks.blocks[start_block - 1].sequence_hash if start_block > 0 else None
+        for b in blocks:
+            is_new, evicted = self.cache.acquire(b.sequence_hash)
+            seq.owned_block_hashes.append(b.sequence_hash)
+            evicted_all.extend(evicted)
+            if is_new:
+                stored.append(StoredBlock(block_hash=b.sequence_hash, tokens_hash=b.block_hash))
+        if self.kv_publisher:
+            if evicted_all:
+                await self.kv_publisher.publish_removed(evicted_all)
+            if stored:
+                await self.kv_publisher.publish_stored(parent, stored)
+
+    async def _run_decode(self) -> int:
+        n = 0
+        for seq in self.running:
+            if seq.in_prefill or seq.finished:
+                continue
+            if seq.ctx.cancelled:
+                seq.finished = FinishReason.CANCELLED
+                seq.out_queue.put_nowait(LLMEngineOutput.cancelled())
+                continue
+            n += 1
+            tok = seq.rng.randint(10, self.args.vocab_size - 1)
+            max_tokens = seq.req.stop_conditions.max_tokens or 64
+            min_tokens = seq.req.stop_conditions.min_tokens or 0
+            eos = False
+            if seq.req.eos_token_ids and seq.generated >= min_tokens and not seq.req.stop_conditions.ignore_eos:
+                # small chance of sampling EOS to model natural stops
+                if seq.rng.random() < 0.02:
+                    tok = seq.req.eos_token_ids[0]
+                    eos = True
+            new_block = seq.blocks.push_token(tok)
+            if new_block is not None:
+                await self._store_blocks(
+                    seq, len(seq.blocks.blocks) - 1, len(seq.blocks.blocks)
+                )
+            seq.generated += 1
+            finish = None
+            if eos:
+                finish = FinishReason.EOS
+            elif seq.generated >= max_tokens:
+                finish = FinishReason.LENGTH
+            seq.finished = finish
+            seq.out_queue.put_nowait(LLMEngineOutput(token_ids=[tok], finish_reason=finish))
+        return n
+
+    def _reap_finished(self):
+        still = []
+        for seq in self.running:
+            if seq.finished is None:
+                still.append(seq)
+                continue
+            cache = self.args.enable_prefix_caching
+            for h in seq.owned_block_hashes:
+                gone = self.cache.release(h, cache)
+                # release without caching: block disappears silently; events
+                # for disappeared blocks are published on next eviction sweep
+            seq.out_queue.put_nowait(None)
+        self.running = still
+
+    async def _publish_metrics(self):
+        if not self.metrics_publisher or self.iterations % 8:
+            return
+        m = ForwardPassMetrics(
+            worker_stats=WorkerStats(
+                request_active_slots=len(self.running),
+                request_total_slots=self.args.max_num_seqs,
+                num_requests_waiting=len(self.waiting),
+            ),
+            kv_stats=KvStats(
+                kv_active_blocks=len(self.cache.active),
+                kv_total_blocks=self.cache.capacity,
+                gpu_cache_usage_perc=self.cache.used_blocks / self.cache.capacity,
+            ),
+        )
+        try:
+            await self.metrics_publisher.publish(m)
+        except Exception:
+            logger.exception("metrics publish failed")

@@ -1,0 +1,104 @@
+"""``python -m dynamo_tpu.mocker.main`` — run a mocker worker.
+
+Equivalent of the reference's ``components/backends/mocker`` CLI: joins the
+control plane, serves the ``generate`` endpoint, registers the model, and
+emits KV events + load metrics like a real engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.config import setup_logging
+
+
+async def run_mocker(
+    runtime: DistributedRuntime,
+    model_name: str,
+    args: MockEngineArgs,
+    namespace: str = "dynamo",
+    component: str = "mocker",
+    endpoint: str = "generate",
+    lease_id=None,
+):
+    lease = lease_id if lease_id is not None else await runtime.primary_lease()
+    kv_pub = KvEventPublisher(runtime.plane, worker_id=lease, kv_block_size=args.block_size)
+    metrics_pub = WorkerMetricsPublisher(runtime.plane, worker_id=lease)
+    engine = await MockEngine(args, kv_pub, metrics_pub).start()
+
+    ep = runtime.namespace(namespace).component(component).endpoint(endpoint)
+    handle = await ep.serve_endpoint(engine.generate, lease_id=lease)
+    card = ModelDeploymentCard(
+        display_name=model_name,
+        kv_cache_block_size=args.block_size,
+        eos_token_ids=[2],
+        tokenizer_ref="test",
+    )
+    card.runtime_config.total_kv_blocks = args.num_gpu_blocks
+    card.runtime_config.max_num_seqs = args.max_num_seqs
+    card.runtime_config.max_num_batched_tokens = args.max_num_batched_tokens
+    await register_llm(runtime, ep, card, lease_id=lease)
+    return engine, handle
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="dynamo-tpu mocker worker")
+    ap.add_argument("--model", default="mock-model")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="mocker")
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-gpu-blocks", type=int, default=8192)
+    ap.add_argument("--max-num-seqs", type=int, default=256)
+    ap.add_argument("--max-num-batched-tokens", type=int, default=8192)
+    ap.add_argument("--speedup-ratio", type=float, default=1.0)
+    ap.add_argument("--no-prefix-caching", action="store_true")
+    ap.add_argument(
+        "--vocab-size", type=int, default=0,
+        help="0 = derive from the model tokenizer so outputs decode to text",
+    )
+    cli = ap.parse_args()
+
+    vocab_size = cli.vocab_size
+    if vocab_size <= 0:
+        from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+
+        vocab_size = make_test_tokenizer().vocab_size
+
+    runtime = await DistributedRuntime.create()
+    args = MockEngineArgs(
+        num_gpu_blocks=cli.num_gpu_blocks,
+        block_size=cli.block_size,
+        max_num_seqs=cli.max_num_seqs,
+        max_num_batched_tokens=cli.max_num_batched_tokens,
+        speedup_ratio=cli.speedup_ratio,
+        enable_prefix_caching=not cli.no_prefix_caching,
+        vocab_size=vocab_size,
+    )
+    engine, handle = await run_mocker(
+        runtime, cli.model, args, cli.namespace, cli.component
+    )
+    print("MOCKER_READY", flush=True)
+
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await handle.stop()
+    await engine.stop()
+    await runtime.shutdown()
+
+
+def main():
+    setup_logging()
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

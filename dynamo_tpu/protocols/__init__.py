@@ -1,0 +1,195 @@
+"""Internal wire protocols: the engine-facing request/response types.
+
+Rebuild of the reference's ``lib/llm/src/protocols`` (common/preprocessor.rs:14,
+common/llm_backend.rs:62, common.rs:228-330): ``PreprocessedRequest`` is what
+flows from the preprocessor through router to engines; ``LLMEngineOutput`` is
+what engines stream back; ``Annotated`` wraps stream items with optional
+event/comment metadata (the SSE event model).
+
+Everything serializes to plain msgpack/JSON-compatible dicts — the wire format
+of the runtime's request plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+TokenId = int
+
+
+class FinishReason:
+    STOP = "stop"
+    LENGTH = "length"
+    EOS = "eos"
+    CANCELLED = "cancelled"
+    CONTENT_FILTER = "content_filter"
+    ERROR = "error"
+
+    @staticmethod
+    def to_openai(reason: Optional[str]) -> Optional[str]:
+        if reason in (FinishReason.EOS, FinishReason.CANCELLED):
+            return "stop"
+        return reason
+
+
+@dataclass
+class StopConditions:
+    """ref: protocols/common.rs:228-252."""
+
+    max_tokens: Optional[int] = None
+    stop: Optional[list[str]] = None
+    stop_token_ids_hidden: Optional[list[TokenId]] = None
+    min_tokens: Optional[int] = None
+    ignore_eos: Optional[bool] = None
+
+    def apply_ignore_eos(self) -> None:
+        if self.ignore_eos:
+            self.min_tokens = self.max_tokens
+            self.stop = None
+            self.stop_token_ids_hidden = None
+
+
+@dataclass
+class SamplingOptions:
+    """ref: protocols/common.rs:275-330 (beam search not carried over)."""
+
+    n: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    seed: Optional[int] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+
+
+@dataclass
+class OutputOptions:
+    logprobs: Optional[int] = None
+    prompt_logprobs: Optional[int] = None
+    skip_special_tokens: bool = True
+    echo: bool = False
+
+
+@dataclass
+class PreprocessedRequest:
+    """Internal representation of an LLM request (ref: common/preprocessor.rs:14-62)."""
+
+    model: str
+    token_ids: list[TokenId]
+    stop_conditions: StopConditions = field(default_factory=StopConditions)
+    sampling_options: SamplingOptions = field(default_factory=SamplingOptions)
+    output_options: OutputOptions = field(default_factory=OutputOptions)
+    eos_token_ids: list[TokenId] = field(default_factory=list)
+    mdc_sum: Optional[str] = None
+    annotations: list[str] = field(default_factory=list)
+    #: set by the KV router: how many prefix blocks the chosen worker already has
+    estimated_prefix_hit_num_blocks: Optional[int] = None
+    #: pin the request to a specific worker instance (bypasses routing)
+    backend_instance_id: Optional[int] = None
+    router_config_override: Optional[dict] = None
+
+    def has_annotation(self, a: str) -> bool:
+        return a in self.annotations
+
+    def to_wire(self) -> dict:
+        return asdict(self)
+
+    @staticmethod
+    def from_wire(d: dict) -> "PreprocessedRequest":
+        return PreprocessedRequest(
+            model=d["model"],
+            token_ids=list(d.get("token_ids") or []),
+            stop_conditions=StopConditions(**(d.get("stop_conditions") or {})),
+            sampling_options=SamplingOptions(**(d.get("sampling_options") or {})),
+            output_options=OutputOptions(**(d.get("output_options") or {})),
+            eos_token_ids=list(d.get("eos_token_ids") or []),
+            mdc_sum=d.get("mdc_sum"),
+            annotations=list(d.get("annotations") or []),
+            estimated_prefix_hit_num_blocks=d.get("estimated_prefix_hit_num_blocks"),
+            backend_instance_id=d.get("backend_instance_id"),
+            router_config_override=d.get("router_config_override"),
+        )
+
+
+@dataclass
+class LLMEngineOutput:
+    """One step of engine output (ref: common/llm_backend.rs:62-87)."""
+
+    token_ids: list[TokenId] = field(default_factory=list)
+    tokens: Optional[list[str]] = None
+    text: Optional[str] = None
+    cum_log_probs: Optional[float] = None
+    log_probs: Optional[list[float]] = None
+    finish_reason: Optional[str] = None
+    index: Optional[int] = None
+    #: disaggregation: prefill worker hands decode worker the KV transfer params
+    kv_transfer_params: Optional[dict] = None
+
+    def to_wire(self) -> dict:
+        d = {"token_ids": self.token_ids}
+        for k in ("tokens", "text", "cum_log_probs", "log_probs", "finish_reason", "index", "kv_transfer_params"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        return d
+
+    @staticmethod
+    def from_wire(d: dict) -> "LLMEngineOutput":
+        return LLMEngineOutput(
+            token_ids=list(d.get("token_ids") or []),
+            tokens=d.get("tokens"),
+            text=d.get("text"),
+            cum_log_probs=d.get("cum_log_probs"),
+            log_probs=d.get("log_probs"),
+            finish_reason=d.get("finish_reason"),
+            index=d.get("index"),
+            kv_transfer_params=d.get("kv_transfer_params"),
+        )
+
+    @staticmethod
+    def cancelled() -> "LLMEngineOutput":
+        return LLMEngineOutput(finish_reason=FinishReason.CANCELLED)
+
+    @staticmethod
+    def error(msg: str) -> "LLMEngineOutput":
+        return LLMEngineOutput(finish_reason=FinishReason.ERROR, text=msg)
+
+
+@dataclass
+class Annotated:
+    """Stream-item wrapper carrying optional event metadata (SSE model).
+
+    ref: lib/runtime's Annotated<T>: ``data`` is the payload; ``event`` names
+    out-of-band events (e.g. ``error``, or annotation replies like
+    ``formatted_prompt``); ``comment`` carries human-readable notes.
+    """
+
+    data: Optional[Any] = None
+    id: Optional[str] = None
+    event: Optional[str] = None
+    comment: Optional[list[str]] = None
+
+    def is_error(self) -> bool:
+        return self.event == "error"
+
+    def to_wire(self) -> dict:
+        d: dict = {}
+        if self.data is not None:
+            d["data"] = self.data
+        if self.id is not None:
+            d["id"] = self.id
+        if self.event is not None:
+            d["event"] = self.event
+        if self.comment:
+            d["comment"] = self.comment
+        return d
+
+    @staticmethod
+    def from_wire(d: dict) -> "Annotated":
+        return Annotated(data=d.get("data"), id=d.get("id"), event=d.get("event"), comment=d.get("comment"))
+
+    @staticmethod
+    def from_error(msg: str) -> "Annotated":
+        return Annotated(event="error", comment=[msg])

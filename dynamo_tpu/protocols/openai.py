@@ -1,0 +1,268 @@
+"""OpenAI-compatible API types: request validation + response/chunk builders.
+
+Rebuild of the reference's OpenAI protocol layer (ref: lib/llm/src/protocols/
+openai/, lib/async-openai fork). Requests/responses are handled as plain dicts
+(the HTTP edge is JSON); this module centralizes validation, defaulting, and
+the ``nvext`` extension block (ref: nvext.rs) that carries Dynamo-specific
+per-request knobs (annotations, ignore_eos, backend_instance_id,
+router config overrides).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from dynamo_tpu.protocols import (
+    OutputOptions,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+class RequestError(ValueError):
+    """400-level request validation error."""
+
+
+def _as_stop_list(stop) -> Optional[list[str]]:
+    if stop is None:
+        return None
+    if isinstance(stop, str):
+        return [stop]
+    if isinstance(stop, list) and all(isinstance(s, str) for s in stop):
+        return stop[:16]
+    raise RequestError("'stop' must be a string or list of strings")
+
+
+@dataclass
+class ParsedRequest:
+    """Normalized view of a chat-completion or completion request."""
+
+    model: str
+    messages: Optional[list[dict]] = None  # chat
+    prompt: Optional[Any] = None  # completions: str | list[str] | list[int]
+    stream: bool = False
+    stream_usage: bool = False
+    n: int = 1
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    stop: StopConditions = field(default_factory=StopConditions)
+    output: OutputOptions = field(default_factory=OutputOptions)
+    tools: Optional[list[dict]] = None
+    tool_choice: Optional[Any] = None
+    response_format: Optional[dict] = None
+    annotations: list[str] = field(default_factory=list)
+    backend_instance_id: Optional[int] = None
+    router_config_override: Optional[dict] = None
+    raw: dict = field(default_factory=dict)
+
+
+def parse_chat_request(body: dict) -> ParsedRequest:
+    if not isinstance(body, dict):
+        raise RequestError("request body must be a JSON object")
+    model = body.get("model")
+    if not model or not isinstance(model, str):
+        raise RequestError("'model' is required")
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        raise RequestError("'messages' must be a non-empty array")
+    for m in messages:
+        if not isinstance(m, dict) or "role" not in m:
+            raise RequestError("each message must be an object with a 'role'")
+    return _parse_common(body, ParsedRequest(model=model, messages=messages, raw=body))
+
+
+def parse_completion_request(body: dict) -> ParsedRequest:
+    if not isinstance(body, dict):
+        raise RequestError("request body must be a JSON object")
+    model = body.get("model")
+    if not model or not isinstance(model, str):
+        raise RequestError("'model' is required")
+    prompt = body.get("prompt")
+    if prompt is None:
+        raise RequestError("'prompt' is required")
+    return _parse_common(body, ParsedRequest(model=model, prompt=prompt, raw=body))
+
+
+def _parse_common(body: dict, req: ParsedRequest) -> ParsedRequest:
+    req.stream = bool(body.get("stream", False))
+    so = body.get("stream_options") or {}
+    req.stream_usage = bool(so.get("include_usage", False))
+    req.n = int(body.get("n") or 1)
+    if req.n < 1 or req.n > 16:
+        raise RequestError("'n' must be in [1, 16]")
+
+    temperature = body.get("temperature")
+    if temperature is not None and not (0.0 <= float(temperature) <= 2.0):
+        raise RequestError("'temperature' must be in [0, 2]")
+    top_p = body.get("top_p")
+    if top_p is not None and not (0.0 < float(top_p) <= 1.0):
+        raise RequestError("'top_p' must be in (0, 1]")
+
+    nvext = body.get("nvext") or {}
+    req.sampling = SamplingOptions(
+        n=req.n,
+        temperature=None if temperature is None else float(temperature),
+        top_p=None if top_p is None else float(top_p),
+        top_k=body.get("top_k") or nvext.get("top_k"),
+        seed=body.get("seed"),
+        presence_penalty=body.get("presence_penalty"),
+        frequency_penalty=body.get("frequency_penalty"),
+        repetition_penalty=nvext.get("repetition_penalty"),
+    )
+    max_tokens = body.get("max_completion_tokens", body.get("max_tokens"))
+    if max_tokens is not None and int(max_tokens) < 1:
+        raise RequestError("'max_tokens' must be >= 1")
+    req.stop = StopConditions(
+        max_tokens=None if max_tokens is None else int(max_tokens),
+        stop=_as_stop_list(body.get("stop")),
+        min_tokens=nvext.get("min_tokens"),
+        ignore_eos=nvext.get("ignore_eos"),
+    )
+    logprobs = body.get("logprobs")
+    top_logprobs = body.get("top_logprobs")
+    req.output = OutputOptions(
+        logprobs=(top_logprobs if isinstance(logprobs, bool) and logprobs else
+                  (logprobs if isinstance(logprobs, int) else None)),
+        echo=bool(body.get("echo", False)),
+    )
+    req.tools = body.get("tools")
+    req.tool_choice = body.get("tool_choice")
+    req.response_format = body.get("response_format")
+    req.annotations = list(nvext.get("annotations") or [])
+    req.backend_instance_id = nvext.get("backend_instance_id")
+    req.router_config_override = nvext.get("router_config_override")
+    return req
+
+
+# ---------------------------------------------------------------------------
+# Response builders
+# ---------------------------------------------------------------------------
+
+
+def gen_request_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def usage_block(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def chat_chunk(
+    request_id: str,
+    model: str,
+    created: int,
+    *,
+    index: int = 0,
+    role: Optional[str] = None,
+    content: Optional[str] = None,
+    tool_calls: Optional[list] = None,
+    reasoning_content: Optional[str] = None,
+    finish_reason: Optional[str] = None,
+    usage: Optional[dict] = None,
+) -> dict:
+    delta: dict = {}
+    if role is not None:
+        delta["role"] = role
+    if content is not None:
+        delta["content"] = content
+    if tool_calls is not None:
+        delta["tool_calls"] = tool_calls
+    if reasoning_content is not None:
+        delta["reasoning_content"] = reasoning_content
+    chunk = {
+        "id": request_id,
+        "object": "chat.completion.chunk",
+        "created": created,
+        "model": model,
+        "choices": [{"index": index, "delta": delta, "finish_reason": finish_reason}],
+    }
+    if usage is not None:
+        chunk["usage"] = usage
+    return chunk
+
+
+def chat_response(
+    request_id: str,
+    model: str,
+    created: int,
+    choices: list[dict],
+    usage: dict,
+) -> dict:
+    return {
+        "id": request_id,
+        "object": "chat.completion",
+        "created": created,
+        "model": model,
+        "choices": choices,
+        "usage": usage,
+    }
+
+
+def chat_choice(
+    index: int,
+    content: str,
+    finish_reason: Optional[str],
+    tool_calls: Optional[list] = None,
+    reasoning_content: Optional[str] = None,
+) -> dict:
+    message: dict = {"role": "assistant", "content": content}
+    if tool_calls:
+        message["tool_calls"] = tool_calls
+        message["content"] = content or None
+    if reasoning_content:
+        message["reasoning_content"] = reasoning_content
+    return {"index": index, "message": message, "finish_reason": finish_reason}
+
+
+def completion_chunk(
+    request_id: str,
+    model: str,
+    created: int,
+    *,
+    index: int = 0,
+    text: str = "",
+    finish_reason: Optional[str] = None,
+    usage: Optional[dict] = None,
+) -> dict:
+    chunk = {
+        "id": request_id,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": [{"index": index, "text": text, "finish_reason": finish_reason, "logprobs": None}],
+    }
+    if usage is not None:
+        chunk["usage"] = usage
+    return chunk
+
+
+def completion_response(
+    request_id: str, model: str, created: int, choices: list[dict], usage: dict
+) -> dict:
+    return {
+        "id": request_id,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": choices,
+        "usage": usage,
+    }
+
+
+def model_entry(model_id: str, created: Optional[int] = None) -> dict:
+    return {
+        "id": model_id,
+        "object": "model",
+        "created": created or int(time.time()),
+        "owned_by": "dynamo-tpu",
+    }
+
+
+def error_body(message: str, err_type: str = "invalid_request_error", code: int = 400) -> dict:
+    return {"error": {"message": message, "type": err_type, "code": code}}

@@ -1,0 +1,36 @@
+"""KV-aware routing (rebuild of lib/llm/src/kv_router/, SURVEY.md §2.4)."""
+
+from dynamo_tpu.router.protocols import (
+    KvCacheEvent,
+    RouterEvent,
+    ForwardPassMetrics,
+    KvRouterConfig,
+    KV_EVENTS_STREAM,
+    KV_METRICS_SUBJECT,
+)
+from dynamo_tpu.router.indexer import RadixTree, KvIndexer, ApproxKvIndexer, OverlapScores
+from dynamo_tpu.router.sequence import ActiveSequences, ActiveSequencesMultiWorker
+from dynamo_tpu.router.scheduler import KvScheduler, softmax_sample
+from dynamo_tpu.router.kv_router import KvRouter, KvPushRouter
+from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
+
+__all__ = [
+    "KvCacheEvent",
+    "RouterEvent",
+    "ForwardPassMetrics",
+    "KvRouterConfig",
+    "KV_EVENTS_STREAM",
+    "KV_METRICS_SUBJECT",
+    "RadixTree",
+    "KvIndexer",
+    "ApproxKvIndexer",
+    "OverlapScores",
+    "ActiveSequences",
+    "ActiveSequencesMultiWorker",
+    "KvScheduler",
+    "softmax_sample",
+    "KvRouter",
+    "KvPushRouter",
+    "KvEventPublisher",
+    "WorkerMetricsPublisher",
+]

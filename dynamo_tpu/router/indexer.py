@@ -1,0 +1,265 @@
+"""Global radix index over every worker's KV cache contents.
+
+Rebuild of the reference's ``RadixTree``/``KvIndexer``/``ApproxKvIndexer``
+(ref: lib/llm/src/kv_router/indexer.rs:224-590, approx.rs:165): a prefix tree
+whose edges are **local block hashes** (tokens-only, frontend-computable) and
+whose nodes record which workers hold that block (keyed for removal by the
+engine-side **external sequence hash**). Fed by RouterEvents from the
+``kv_events`` durable stream; queried per-request with ``find_matches`` to get
+per-worker contiguous-prefix overlap scores.
+
+The indexer applies events in a single asyncio task — the same actor-style
+single-threaded discipline the reference uses for race-freedom.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.router.protocols import KV_EVENTS_STREAM, KvCacheEvent, RouterEvent, StoredBlock
+
+logger = logging.getLogger("dynamo.kv_indexer")
+
+
+@dataclass
+class OverlapScores:
+    """Per-worker contiguous-prefix block overlap (ref: indexer.rs OverlapScores)."""
+
+    scores: dict[int, int] = field(default_factory=dict)
+    #: how often each matched block has been touched (cache popularity signal)
+    frequencies: list[int] = field(default_factory=list)
+
+    def best(self) -> int:
+        return max(self.scores.values(), default=0)
+
+
+class _Node:
+    __slots__ = ("children", "workers", "parent", "local_hash", "frequency")
+
+    def __init__(self, parent: Optional["_Node"], local_hash: Optional[int]):
+        self.children: dict[int, _Node] = {}
+        self.workers: set[int] = set()
+        self.parent = parent
+        self.local_hash = local_hash
+        self.frequency = 0
+
+
+class RadixTree:
+    """Single-threaded radix tree; all mutation happens on the indexer task."""
+
+    def __init__(self):
+        self.root = _Node(None, None)
+        # (worker_id, external_block_hash) -> node, for O(1) removal
+        self._lookup: dict[tuple[int, int], _Node] = {}
+        self.event_count = 0
+
+    def apply_event(self, ev: RouterEvent) -> None:
+        self.event_count += 1
+        worker, e = ev.worker_id, ev.event
+        if e.stored_blocks:
+            self._apply_stored(worker, e)
+        elif e.removed_hashes:
+            self._apply_removed(worker, e.removed_hashes)
+        elif e.cleared:
+            self.remove_worker(worker)
+
+    def _apply_stored(self, worker: int, e: KvCacheEvent) -> None:
+        if e.stored_parent_hash is None:
+            node = self.root
+        else:
+            node = self._lookup.get((worker, e.stored_parent_hash))
+            if node is None:
+                # Parent unknown (event loss / eviction race): anchor at root
+                # like the reference's defensive path.
+                logger.debug("stored event with unknown parent %x from %x", e.stored_parent_hash, worker)
+                node = self.root
+        for b in e.stored_blocks:
+            child = node.children.get(b.tokens_hash)
+            if child is None:
+                child = _Node(node, b.tokens_hash)
+                node.children[b.tokens_hash] = child
+            child.workers.add(worker)
+            self._lookup[(worker, b.block_hash)] = child
+            node = child
+
+    def _apply_removed(self, worker: int, hashes: list[int]) -> None:
+        for h in hashes:
+            node = self._lookup.pop((worker, h), None)
+            if node is None:
+                continue
+            node.workers.discard(worker)
+            self._prune(node)
+
+    def _prune(self, node: _Node) -> None:
+        while node is not self.root and not node.workers and not node.children:
+            parent = node.parent
+            if parent is None:
+                break
+            parent.children.pop(node.local_hash, None)
+            node = parent
+
+    def remove_worker(self, worker: int) -> None:
+        """Drop every block owned by a worker (ref: Cleared / worker death)."""
+        keys = [k for k in self._lookup if k[0] == worker]
+        for k in keys:
+            node = self._lookup.pop(k)
+            node.workers.discard(worker)
+            self._prune(node)
+
+    def find_matches(self, local_hashes: list[int]) -> OverlapScores:
+        """Walk the chain of local hashes from root, scoring workers per level."""
+        out = OverlapScores()
+        node = self.root
+        for h in local_hashes:
+            node = node.children.get(h)
+            if node is None:
+                break
+            node.frequency += 1
+            out.frequencies.append(node.frequency)
+            for w in node.workers:
+                out.scores[w] = out.scores.get(w, 0) + 1
+        return out
+
+    # -- snapshot support (restored on router start, ref: subscriber.rs:30-65) --
+    def dump(self) -> bytes:
+        """Serialize tree + removal lookup so a restored router keeps working."""
+        entries = []
+        node_path: dict[int, tuple[int, ...]] = {id(self.root): ()}
+
+        def walk(node: _Node, path: tuple[int, ...]):
+            for lh, child in node.children.items():
+                cpath = path + (lh,)
+                node_path[id(child)] = cpath
+                entries.append([list(cpath), sorted(child.workers)])
+                walk(child, cpath)
+
+        walk(self.root, ())
+        lookup = [
+            [w, h, list(node_path[id(node)])] for (w, h), node in self._lookup.items()
+        ]
+        return msgpack.packb({"entries": entries, "lookup": lookup, "count": self.event_count})
+
+    @staticmethod
+    def load(data: bytes) -> "RadixTree":
+        d = msgpack.unpackb(data, raw=False)
+        tree = RadixTree()
+        tree.event_count = d.get("count", 0)
+
+        def node_at(path) -> _Node:
+            node = tree.root
+            for lh in path:
+                child = node.children.get(lh)
+                if child is None:
+                    child = _Node(node, lh)
+                    node.children[lh] = child
+                node = child
+            return node
+
+        for path, workers in d.get("entries", []):
+            node_at(path).workers.update(workers)
+        for w, h, path in d.get("lookup", []):
+            tree._lookup[(w, h)] = node_at(path)
+        return tree
+
+
+class KvIndexer:
+    """Applies RouterEvents from the durable stream to a RadixTree."""
+
+    def __init__(self, plane, kv_block_size: int, stream: str = KV_EVENTS_STREAM):
+        self.plane = plane
+        self.kv_block_size = kv_block_size
+        self.stream = stream
+        self.tree = RadixTree()
+        self._task: Optional[asyncio.Task] = None
+        self._sub = None
+        self.events_applied = 0
+
+    async def start(self, start_seq: int = 0) -> "KvIndexer":
+        self._sub = await self.plane.stream_subscribe(self.stream, start_seq=start_seq)
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self):
+        if self._task:
+            self._task.cancel()
+        if self._sub:
+            await self._sub.cancel()
+
+    async def _loop(self):
+        try:
+            async for _seq, payload in self._sub:
+                try:
+                    ev = RouterEvent.from_wire(msgpack.unpackb(payload, raw=False))
+                    self.tree.apply_event(ev)
+                    self.events_applied += 1
+                except Exception:
+                    logger.exception("bad kv event ignored")
+        except asyncio.CancelledError:
+            pass
+
+    def find_matches(self, local_hashes: list[int]) -> OverlapScores:
+        return self.tree.find_matches(local_hashes)
+
+    def find_matches_for_tokens(self, token_ids: list[int]) -> OverlapScores:
+        from dynamo_tpu.tokens import compute_block_hash_for_seq
+
+        return self.find_matches(compute_block_hash_for_seq(token_ids, self.kv_block_size))
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.tree.remove_worker(worker_id)
+
+
+class ApproxKvIndexer:
+    """Predicts cache contents from routing decisions alone (no engine events).
+
+    ref: approx.rs:165 + TTL at kv_router.rs:276-281 (120 s). Each routed
+    request inserts its prefix blocks for the chosen worker with an expiry.
+    """
+
+    TTL_SECS = 120.0
+
+    def __init__(self, kv_block_size: int, ttl: float = TTL_SECS):
+        self.kv_block_size = kv_block_size
+        self.ttl = ttl
+        self.tree = RadixTree()
+        # (worker, first_external_hash_of_insert) -> (expiry, external_hashes)
+        self._expiries: list[tuple[float, int, list[int]]] = []
+        self._ids = 0
+
+    def process_routing_decision_for_request(self, token_ids: list[int], worker_id: int) -> None:
+        from dynamo_tpu.tokens import compute_block_hash_for_seq, compute_seq_hash_for_block
+
+        local = compute_block_hash_for_seq(token_ids, self.kv_block_size)
+        if not local:
+            return
+        ext = compute_seq_hash_for_block(local)
+        blocks = [StoredBlock(block_hash=e, tokens_hash=l) for e, l in zip(ext, local)]
+        self._ids += 1
+        ev = RouterEvent(worker_id, KvCacheEvent.stored(self._ids, None, blocks))
+        self.tree.apply_event(ev)
+        self._expiries.append((time.monotonic() + self.ttl, worker_id, ext))
+
+    def _expire(self):
+        now = time.monotonic()
+        while self._expiries and self._expiries[0][0] <= now:
+            _, worker, hashes = self._expiries.pop(0)
+            self._ids += 1
+            self.tree.apply_event(RouterEvent(worker, KvCacheEvent.removed(self._ids, hashes)))
+
+    def find_matches(self, local_hashes: list[int]) -> OverlapScores:
+        self._expire()
+        return self.tree.find_matches(local_hashes)
+
+    def find_matches_for_tokens(self, token_ids: list[int]) -> OverlapScores:
+        from dynamo_tpu.tokens import compute_block_hash_for_seq
+
+        return self.find_matches(compute_block_hash_for_seq(token_ids, self.kv_block_size))
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.tree.remove_worker(worker_id)

@@ -1,0 +1,156 @@
+"""KvRouter: the composed KV-aware routing engine.
+
+Rebuild of the reference's ``KvRouter``/``KvPushRouter`` (ref: lib/llm/src/
+kv_router.rs:210-435,473-612): composes the radix indexer (event-fed or
+approximate) with the cost scheduler, exposes ``find_best_match`` plus the
+request lifecycle (add → mark_prefill_completed → free), and wraps an endpoint
+Client as an engine operator that:
+
+- honors ``backend_instance_id`` pins (direct route),
+- answers ``query_instance_id`` annotations with a dry route (no generation),
+- sets ``estimated_prefix_hit_num_blocks`` on the outgoing request,
+- marks prefill complete on the first output, frees on stream end,
+- reports dead instances to discovery and evicts them from the radix tree.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Optional
+
+from dynamo_tpu.protocols import Annotated, PreprocessedRequest
+from dynamo_tpu.router.indexer import ApproxKvIndexer, KvIndexer, OverlapScores
+from dynamo_tpu.router.protocols import KvRouterConfig
+from dynamo_tpu.router.scheduler import KvScheduler, NoWorkersError, SchedulingDecision
+from dynamo_tpu.runtime.component import Client
+from dynamo_tpu.runtime.context import Context, StreamError
+from dynamo_tpu.runtime.control_plane import NoRespondersError
+from dynamo_tpu.tokens import compute_block_hash_for_seq, compute_seq_hash_for_block
+
+logger = logging.getLogger("dynamo.kv_router")
+
+
+class KvRouter:
+    def __init__(self, plane, block_size: int, config: Optional[KvRouterConfig] = None):
+        self.block_size = block_size
+        self.config = config or KvRouterConfig()
+        if self.config.use_kv_events:
+            self.indexer: KvIndexer | ApproxKvIndexer = KvIndexer(plane, block_size)
+        else:
+            self.indexer = ApproxKvIndexer(block_size)
+        self.scheduler = KvScheduler(block_size, self.config)
+
+    async def start(self) -> "KvRouter":
+        if isinstance(self.indexer, KvIndexer):
+            await self.indexer.start()
+        return self
+
+    async def stop(self):
+        if isinstance(self.indexer, KvIndexer):
+            await self.indexer.stop()
+
+    def find_best_match(
+        self,
+        request_id: str,
+        token_ids: list[int],
+        worker_ids: list[int],
+        router_config_override: Optional[dict] = None,
+    ) -> SchedulingDecision:
+        local = compute_block_hash_for_seq(token_ids, self.block_size)
+        seq_hashes = compute_seq_hash_for_block(local)
+        overlaps = self.indexer.find_matches(local)
+        decision = self.scheduler.schedule(
+            request_id,
+            isl_tokens=len(token_ids),
+            seq_hashes=seq_hashes,
+            overlaps=overlaps,
+            worker_ids=worker_ids,
+            router_config_override=router_config_override,
+        )
+        if isinstance(self.indexer, ApproxKvIndexer):
+            self.indexer.process_routing_decision_for_request(token_ids, decision.worker_id)
+        return decision
+
+    def mark_prefill_completed(self, request_id: str):
+        self.scheduler.mark_prefill_completed(request_id)
+
+    def free(self, request_id: str):
+        self.scheduler.free(request_id)
+
+    def remove_worker(self, worker_id: int):
+        self.indexer.remove_worker(worker_id)
+
+
+class KvPushRouter:
+    """Engine operator: route a PreprocessedRequest to the best worker."""
+
+    def __init__(self, client: Client, router: KvRouter):
+        self.client = client
+        self.router = router
+
+    async def generate(self, req: PreprocessedRequest, ctx: Context) -> AsyncIterator:
+        if isinstance(req, dict):
+            req = PreprocessedRequest.from_wire(req)
+
+        if req.backend_instance_id is not None:
+            async for item in self._stream_to(req, ctx, req.backend_instance_id, None):
+                yield item
+            return
+
+        worker_ids = self.client.available_ids()
+        if not worker_ids:
+            worker_ids = await self.client.wait_for_instances(timeout=5.0)
+        try:
+            decision = self.router.find_best_match(
+                ctx.id, req.token_ids, worker_ids, req.router_config_override
+            )
+        except NoWorkersError as e:
+            raise NoRespondersError(str(e)) from e
+
+        if req.has_annotation("query_instance_id"):
+            # dry route: report the decision without generating
+            self.router.free(ctx.id)
+            yield Annotated(
+                event="worker_instance_id",
+                data={"worker_id": decision.worker_id, "overlap_blocks": decision.overlap_blocks},
+                id=ctx.id,
+            ).to_wire()
+            return
+
+        req.estimated_prefix_hit_num_blocks = decision.overlap_blocks
+        async for item in self._stream_to(req, ctx, decision.worker_id, decision):
+            yield item
+
+    async def _stream_to(
+        self,
+        req: PreprocessedRequest,
+        ctx: Context,
+        instance_id: int,
+        decision: Optional[SchedulingDecision],
+    ) -> AsyncIterator:
+        tracked = decision is not None
+        prefill_done = False
+        try:
+            stream = await self.client.generate(
+                req.to_wire(), ctx=ctx, mode="direct", instance_id=instance_id
+            )
+        except (NoRespondersError, StreamError) as e:
+            if tracked:
+                self.router.free(ctx.id)
+            self.client.report_instance_down(instance_id)
+            self.router.remove_worker(instance_id)
+            raise StreamError(f"worker {instance_id:x} unavailable: {e}") from e
+        try:
+            async for item in stream:
+                if tracked and not prefill_done:
+                    self.router.mark_prefill_completed(ctx.id)
+                    prefill_done = True
+                yield item
+        except StreamError:
+            self.client.report_instance_down(instance_id)
+            self.router.remove_worker(instance_id)
+            raise
+        finally:
+            if tracked:
+                self.router.free(ctx.id)

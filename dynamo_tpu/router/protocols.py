@@ -1,0 +1,164 @@
+"""KV-router wire protocols: cache events, load metrics, router config.
+
+Rebuild of the reference's kv_router protocol types (ref: lib/llm/src/kv_router/
+protocols.rs:109-240 for events, :48-84 for ForwardPassMetrics; config defaults
+kv_router.rs:95-131). Hashes:
+
+- ``tokens_hash``  (LocalBlockHash): salted xxh3 of the block's tokens only —
+  the radix tree's edge key, computable frontend-side from token ids.
+- ``block_hash``   (ExternalSequenceBlockHash): the engine's chained sequence
+  hash identifying the physical stored block — the removal key.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+#: durable stream carrying RouterEvents (ref: kv_router.rs:59 "kv_events")
+KV_EVENTS_STREAM = "kv_events"
+#: pub/sub subject carrying ForwardPassMetrics (ref: "kv_metrics")
+KV_METRICS_SUBJECT = "kv_metrics"
+#: object-store bucket for radix snapshots (ref: kv_router.rs:68-71)
+RADIX_STATE_BUCKET = "radix-bucket"
+
+
+@dataclass
+class StoredBlock:
+    block_hash: int  # external sequence hash (engine identity)
+    tokens_hash: int  # local block hash (router identity)
+
+
+@dataclass
+class KvCacheEvent:
+    """One engine cache mutation: stored / removed / cleared."""
+
+    event_id: int = 0
+    stored_parent_hash: Optional[int] = None
+    stored_blocks: list[StoredBlock] = field(default_factory=list)
+    removed_hashes: list[int] = field(default_factory=list)
+    cleared: bool = False
+
+    @staticmethod
+    def stored(event_id: int, parent_hash: Optional[int], blocks: list[StoredBlock]) -> "KvCacheEvent":
+        return KvCacheEvent(event_id=event_id, stored_parent_hash=parent_hash, stored_blocks=blocks)
+
+    @staticmethod
+    def removed(event_id: int, hashes: list[int]) -> "KvCacheEvent":
+        return KvCacheEvent(event_id=event_id, removed_hashes=hashes)
+
+    @staticmethod
+    def clear(event_id: int) -> "KvCacheEvent":
+        return KvCacheEvent(event_id=event_id, cleared=True)
+
+    def to_wire(self) -> dict:
+        d: dict = {"event_id": self.event_id}
+        if self.stored_blocks:
+            d["stored"] = {
+                "parent_hash": self.stored_parent_hash,
+                "blocks": [{"block_hash": b.block_hash, "tokens_hash": b.tokens_hash} for b in self.stored_blocks],
+            }
+        elif self.removed_hashes:
+            d["removed"] = {"block_hashes": self.removed_hashes}
+        elif self.cleared:
+            d["cleared"] = True
+        return d
+
+    @staticmethod
+    def from_wire(d: dict) -> "KvCacheEvent":
+        ev = KvCacheEvent(event_id=d.get("event_id", 0))
+        if "stored" in d:
+            s = d["stored"]
+            ev.stored_parent_hash = s.get("parent_hash")
+            ev.stored_blocks = [
+                StoredBlock(b["block_hash"], b["tokens_hash"]) for b in s.get("blocks", [])
+            ]
+        elif "removed" in d:
+            ev.removed_hashes = list(d["removed"].get("block_hashes", []))
+        elif d.get("cleared"):
+            ev.cleared = True
+        return ev
+
+
+@dataclass
+class RouterEvent:
+    """A KvCacheEvent attributed to a worker (ref: indexer.rs RouterEvent)."""
+
+    worker_id: int
+    event: KvCacheEvent
+
+    def to_wire(self) -> dict:
+        return {"worker_id": self.worker_id, "event": self.event.to_wire()}
+
+    @staticmethod
+    def from_wire(d: dict) -> "RouterEvent":
+        return RouterEvent(d["worker_id"], KvCacheEvent.from_wire(d["event"]))
+
+
+@dataclass
+class WorkerStats:
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    num_requests_waiting: int = 0
+    data_parallel_rank: Optional[int] = None
+
+
+@dataclass
+class KvStats:
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+
+@dataclass
+class SpecDecodeStats:
+    num_spec_tokens: int = 0
+    num_drafts: int = 0
+    num_draft_tokens: int = 0
+    num_accepted_tokens: int = 0
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Per-forward-pass load report (ref: kv_router/protocols.rs:48-84)."""
+
+    worker_stats: WorkerStats = field(default_factory=WorkerStats)
+    kv_stats: KvStats = field(default_factory=KvStats)
+    spec_decode_stats: Optional[SpecDecodeStats] = None
+
+    def to_wire(self) -> dict:
+        d = {"worker_stats": asdict(self.worker_stats), "kv_stats": asdict(self.kv_stats)}
+        if self.spec_decode_stats:
+            d["spec_decode_stats"] = asdict(self.spec_decode_stats)
+        return d
+
+    @staticmethod
+    def from_wire(d: dict) -> "ForwardPassMetrics":
+        return ForwardPassMetrics(
+            worker_stats=WorkerStats(**(d.get("worker_stats") or {})),
+            kv_stats=KvStats(**(d.get("kv_stats") or {})),
+            spec_decode_stats=(
+                SpecDecodeStats(**d["spec_decode_stats"]) if d.get("spec_decode_stats") else None
+            ),
+        )
+
+
+@dataclass
+class KvRouterConfig:
+    """ref: kv_router.rs:95-131 (same defaults)."""
+
+    overlap_score_weight: float = 1.0
+    router_temperature: float = 0.0
+    use_kv_events: bool = True
+    router_replica_sync: bool = False
+    router_track_active_blocks: bool = True
+    router_snapshot_threshold: Optional[int] = 10000
+    router_reset_states: bool = False
+
+
+@dataclass
+class KVHitRateEvent:
+    worker_id: int
+    isl_blocks: int
+    overlap_blocks: int

@@ -1,0 +1,117 @@
+"""Worker-side publishers: KV cache events and load metrics.
+
+Rebuild of the reference's ``KvEventPublisher``/``WorkerMetricsPublisher``
+(ref: lib/llm/src/kv_router/publisher.rs:48-223, protocols.rs:48-84): engines
+report block stored/removed/cleared to the ``kv_events`` durable stream and
+``ForwardPassMetrics`` on the ``kv_metrics`` subject; routers and the metrics
+aggregator consume them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.router.protocols import (
+    KV_EVENTS_STREAM,
+    KV_METRICS_SUBJECT,
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RouterEvent,
+    StoredBlock,
+)
+
+logger = logging.getLogger("dynamo.kv_publisher")
+
+
+class KvEventPublisher:
+    def __init__(self, plane, worker_id: int, kv_block_size: int, stream: str = KV_EVENTS_STREAM):
+        self.plane = plane
+        self.worker_id = worker_id
+        self.kv_block_size = kv_block_size
+        self.stream = stream
+        self._event_id = 0
+
+    def _next_id(self) -> int:
+        self._event_id += 1
+        return self._event_id
+
+    async def publish(self, event: KvCacheEvent) -> None:
+        wire = RouterEvent(self.worker_id, event).to_wire()
+        await self.plane.stream_publish(self.stream, msgpack.packb(wire))
+
+    async def publish_stored(
+        self,
+        parent_hash: Optional[int],
+        blocks: list[StoredBlock],
+    ) -> None:
+        await self.publish(KvCacheEvent.stored(self._next_id(), parent_hash, blocks))
+
+    async def publish_removed(self, block_hashes: list[int]) -> None:
+        await self.publish(KvCacheEvent.removed(self._next_id(), block_hashes))
+
+    async def publish_cleared(self) -> None:
+        await self.publish(KvCacheEvent.clear(self._next_id()))
+
+
+class WorkerMetricsPublisher:
+    def __init__(self, plane, worker_id: int, subject: str = KV_METRICS_SUBJECT):
+        self.plane = plane
+        self.worker_id = worker_id
+        self.subject = subject
+
+    async def publish(self, metrics: ForwardPassMetrics) -> None:
+        wire = {"worker_id": self.worker_id, "metrics": metrics.to_wire()}
+        await self.plane.publish(self.subject, msgpack.packb(wire))
+
+
+class MetricsAggregator:
+    """Collects the latest ForwardPassMetrics per worker (ref: metrics_aggregator.rs)."""
+
+    def __init__(self, plane, subject: str = KV_METRICS_SUBJECT):
+        self.plane = plane
+        self.subject = subject
+        self.latest: dict[int, ForwardPassMetrics] = {}
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "MetricsAggregator":
+        self._sub = await self.plane.subscribe(self.subject)
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self):
+        if self._task:
+            self._task.cancel()
+        if self._sub:
+            await self._sub.cancel()
+
+    async def _loop(self):
+        try:
+            async for _subject, payload in self._sub:
+                try:
+                    d = msgpack.unpackb(payload, raw=False)
+                    self.latest[d["worker_id"]] = ForwardPassMetrics.from_wire(d["metrics"])
+                except Exception:
+                    logger.exception("bad metrics payload ignored")
+        except asyncio.CancelledError:
+            pass
+
+    def aggregate(self) -> dict:
+        total_active = sum(m.kv_stats.kv_active_blocks for m in self.latest.values())
+        total_blocks = sum(m.kv_stats.kv_total_blocks for m in self.latest.values())
+        return {
+            "workers": len(self.latest),
+            "kv_active_blocks": total_active,
+            "kv_total_blocks": total_blocks,
+            "gpu_cache_usage_perc": (total_active / total_blocks) if total_blocks else 0.0,
+            "requests_active": sum(
+                m.worker_stats.request_active_slots for m in self.latest.values()
+            ),
+            "requests_waiting": sum(
+                m.worker_stats.num_requests_waiting for m in self.latest.values()
+            ),
+        }

@@ -1,0 +1,134 @@
+"""Cost-based worker selection with softmax-temperature sampling.
+
+Rebuild of the reference scheduler (ref: lib/llm/src/kv_router/scheduler.rs:
+469-532 selector, :383-445 softmax): per worker,
+
+    logit = overlap_score_weight * potential_prefill_blocks + potential_decode_blocks
+
+(lower is better); selection is softmax sampling over min-max-normalized
+negated logits at ``router_temperature`` — temperature 0 means argmin with
+random tie-break.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.router.indexer import OverlapScores
+from dynamo_tpu.router.protocols import KvRouterConfig
+from dynamo_tpu.router.sequence import ActiveSequencesMultiWorker
+
+logger = logging.getLogger("dynamo.kv_scheduler")
+
+
+class NoWorkersError(Exception):
+    pass
+
+
+def softmax_sample(logits: dict[int, float], temperature: float, rng: Optional[random.Random] = None) -> int:
+    """Sample a worker id; lower logit = better (ref: scheduler.rs:383-445)."""
+    if not logits:
+        raise NoWorkersError("empty logits for softmax sampling")
+    rng = rng or random
+    if temperature == 0.0:
+        lo = min(logits.values())
+        best = [k for k, v in logits.items() if v == lo]
+        return rng.choice(best)
+
+    keys = list(logits.keys())
+    values = [logits[k] for k in keys]
+    lo, hi = min(values), max(values)
+    if lo == hi:
+        probs = [1.0 / len(keys)] * len(keys)
+    else:
+        scaled = [-(v / (hi - lo)) / temperature for v in values]
+        mx = max(scaled)
+        exps = [math.exp(s - mx) for s in scaled]
+        total = sum(exps)
+        probs = [e / total for e in exps]
+    x = rng.random()
+    acc = 0.0
+    for k, p in zip(keys, probs):
+        acc += p
+        if x <= acc:
+            return k
+    return keys[-1]
+
+
+@dataclass
+class SchedulingDecision:
+    worker_id: int
+    overlap_blocks: int
+    required_blocks: int
+    logits: dict[int, float]
+
+
+class KvScheduler:
+    """Combines overlap scores + active-sequence load into a routing choice."""
+
+    def __init__(
+        self,
+        block_size: int,
+        config: Optional[KvRouterConfig] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self.block_size = block_size
+        self.config = config or KvRouterConfig()
+        self.slots = ActiveSequencesMultiWorker(block_size)
+        self._rng = rng or random.Random()
+
+    def update_workers(self, worker_ids: list[int]):
+        self.slots.update_workers(worker_ids)
+
+    def schedule(
+        self,
+        request_id: str,
+        isl_tokens: int,
+        seq_hashes: Optional[list[int]],
+        overlaps: OverlapScores,
+        worker_ids: list[int],
+        router_config_override: Optional[dict] = None,
+    ) -> SchedulingDecision:
+        if not worker_ids:
+            raise NoWorkersError("no workers available")
+        if isl_tokens <= 0:
+            raise ValueError("isl_tokens must be > 0")
+        self.slots.update_workers(worker_ids)
+
+        override = router_config_override or {}
+        overlap_weight = override.get("overlap_score_weight", self.config.overlap_score_weight)
+        temperature = override.get("router_temperature", self.config.router_temperature)
+
+        track = seq_hashes if self.config.router_track_active_blocks else None
+        decode_blocks, prefill_tokens = self.slots.potential_blocks_and_tokens(
+            track, isl_tokens, overlaps.scores
+        )
+
+        request_blocks = -(-isl_tokens // self.block_size)
+        logits: dict[int, float] = {}
+        for w in worker_ids:
+            pt = prefill_tokens.get(w, isl_tokens)
+            potential_prefill_block = pt / self.block_size
+            decode_block = float(decode_blocks.get(w, math.floor(potential_prefill_block)))
+            logits[w] = overlap_weight * potential_prefill_block + decode_block
+
+        worker_id = softmax_sample(logits, temperature, self._rng)
+        overlap = overlaps.scores.get(worker_id, 0)
+
+        self.slots.add_request(request_id, worker_id, track, isl_tokens, overlap)
+        return SchedulingDecision(
+            worker_id=worker_id,
+            overlap_blocks=overlap,
+            required_blocks=request_blocks,
+            logits=logits,
+        )
+
+    def mark_prefill_completed(self, request_id: str):
+        self.slots.mark_prefill_completed(request_id)
+
+    def free(self, request_id: str):
+        self.slots.free(request_id)

@@ -1,0 +1,150 @@
+"""Active-sequence load tracking per worker.
+
+Rebuild of the reference's ``ActiveSequences(MultiWorker)`` (ref: lib/llm/src/
+kv_router/sequence.rs:53-230): tracks, per worker, the set of in-flight
+requests, their prefix blocks (deduplicated across requests — shared prefixes
+count once), and outstanding prefill tokens. Drives the scheduler's
+"potential load if scheduled here" computation. Stale requests are expired
+lazily so a crashed frontend cannot leak load forever.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+EXPIRY_SECS = 600.0
+
+
+class ActiveSequences:
+    def __init__(self, block_size: int):
+        assert block_size > 1, "block_size must be greater than 1"
+        self.block_size = block_size
+        self._active_seqs: dict[str, list[int]] = {}
+        self._prefill_tokens: dict[str, int] = {}
+        self._unique_blocks: dict[int, set[str]] = {}
+        self.active_blocks = 0
+        self.active_tokens = 0
+        self._started: dict[str, float] = {}
+
+    def _add_block(self, request_id: str, block: int):
+        users = self._unique_blocks.setdefault(block, set())
+        if not users:
+            self.active_blocks += 1
+        users.add(request_id)
+
+    def _remove_block(self, request_id: str, block: int):
+        users = self._unique_blocks.get(block)
+        if users is None:
+            return
+        users.discard(request_id)
+        if not users:
+            self.active_blocks -= 1
+            del self._unique_blocks[block]
+
+    def new_tokens(self, isl: int, overlap: int) -> int:
+        """Prefill tokens this worker would compute for the request."""
+        return max(isl - overlap * self.block_size, 0)
+
+    def new_blocks(self, seq_hashes: list[int]) -> int:
+        """Blocks not already held by any active request on this worker."""
+        return sum(1 for h in set(seq_hashes) if h not in self._unique_blocks)
+
+    def add_request(self, request_id: str, seq_hashes: Optional[list[int]], isl: int, overlap: int):
+        if request_id in self._active_seqs:
+            raise ValueError(f"request {request_id} already active")
+        self._expire()
+        pt = self.new_tokens(isl, overlap)
+        self._prefill_tokens[request_id] = pt
+        self.active_tokens += pt
+        seq = list(seq_hashes or [])
+        for h in seq:
+            self._add_block(request_id, h)
+        self._active_seqs[request_id] = seq
+        self._started[request_id] = time.monotonic()
+
+    def mark_prefill_completed(self, request_id: str):
+        pt = self._prefill_tokens.pop(request_id, None)
+        if pt is not None:
+            self.active_tokens -= pt
+
+    def free(self, request_id: str) -> int:
+        self.mark_prefill_completed(request_id)
+        seq = self._active_seqs.pop(request_id, None)
+        self._started.pop(request_id, None)
+        if seq is not None:
+            for h in seq:
+                self._remove_block(request_id, h)
+        return self.active_blocks
+
+    def push_decode_block(self, request_id: str, seq_hash: int):
+        """Account a newly-generated decode block for an active request."""
+        seq = self._active_seqs.get(request_id)
+        if seq is not None:
+            seq.append(seq_hash)
+            self._add_block(request_id, seq_hash)
+
+    def _expire(self):
+        cutoff = time.monotonic() - EXPIRY_SECS
+        stale = [r for r, t in self._started.items() if t < cutoff]
+        for r in stale:
+            self.free(r)
+
+    def potential_blocks_and_tokens(
+        self, seq_hashes: Optional[list[int]], isl: int, overlap: int
+    ) -> tuple[int, int]:
+        blocks = (self.new_blocks(seq_hashes) if seq_hashes else 0) + self.active_blocks
+        tokens = self.new_tokens(isl, overlap) + self.active_tokens
+        return blocks, tokens
+
+
+class ActiveSequencesMultiWorker:
+    """Per-worker ActiveSequences with request→worker attribution."""
+
+    def __init__(self, block_size: int, worker_ids: Optional[list[int]] = None):
+        self.block_size = block_size
+        self._workers: dict[int, ActiveSequences] = {
+            w: ActiveSequences(block_size) for w in (worker_ids or [])
+        }
+        self._request_worker: dict[str, int] = {}
+
+    def update_workers(self, worker_ids: list[int]):
+        for w in worker_ids:
+            self._workers.setdefault(w, ActiveSequences(self.block_size))
+        for w in list(self._workers):
+            if w not in worker_ids:
+                del self._workers[w]
+
+    def worker_ids(self) -> list[int]:
+        return sorted(self._workers)
+
+    def add_request(
+        self, request_id: str, worker_id: int, seq_hashes: Optional[list[int]], isl: int, overlap: int
+    ):
+        seqs = self._workers.setdefault(worker_id, ActiveSequences(self.block_size))
+        seqs.add_request(request_id, seq_hashes, isl, overlap)
+        self._request_worker[request_id] = worker_id
+
+    def mark_prefill_completed(self, request_id: str):
+        w = self._request_worker.get(request_id)
+        if w is not None and w in self._workers:
+            self._workers[w].mark_prefill_completed(request_id)
+
+    def free(self, request_id: str):
+        w = self._request_worker.pop(request_id, None)
+        if w is not None and w in self._workers:
+            self._workers[w].free(request_id)
+
+    def potential_blocks_and_tokens(
+        self, seq_hashes: Optional[list[int]], isl: int, overlaps: dict[int, int]
+    ) -> tuple[dict[int, int], dict[int, int]]:
+        blocks: dict[int, int] = {}
+        tokens: dict[int, int] = {}
+        for w, seqs in self._workers.items():
+            b, t = seqs.potential_blocks_and_tokens(seq_hashes, isl, overlaps.get(w, 0))
+            blocks[w] = b
+            tokens[w] = t
+        return blocks, tokens
+
+    def active_load(self) -> dict[int, tuple[int, int]]:
+        return {w: (s.active_blocks, s.active_tokens) for w, s in self._workers.items()}

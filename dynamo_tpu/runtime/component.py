@@ -58,6 +58,9 @@ class Instance:
     component: str
     endpoint: str
     instance_id: int  # lease id
+    #: free-form worker-provided info (e.g. dp_rank, served model) readable by
+    #: clients for selection logic
+    metadata: Optional[dict] = None
 
     @property
     def subject(self) -> str:
@@ -69,11 +72,18 @@ class Instance:
             "component": self.component,
             "endpoint": self.endpoint,
             "instance_id": self.instance_id,
+            "metadata": self.metadata or {},
         }
 
     @staticmethod
     def from_wire(d: dict) -> "Instance":
-        return Instance(d["namespace"], d["component"], d["endpoint"], d["instance_id"])
+        return Instance(
+            d["namespace"],
+            d["component"],
+            d["endpoint"],
+            d["instance_id"],
+            d.get("metadata") or {},
+        )
 
 
 class Namespace:
@@ -187,9 +197,8 @@ class Endpoint:
         # in-process short-circuit path
         rt._local_endpoints[subject] = (handler, inflight)
 
-        inst = Instance(ns, comp, ep, lease)
-        meta = dict(metadata or {})
-        value = msgpack.packb({**inst.to_wire(), "metadata": meta})
+        inst = Instance(ns, comp, ep, lease, metadata=dict(metadata or {}))
+        value = msgpack.packb(inst.to_wire())
         key = instance_key(ns, comp, ep, lease)
         created = await rt.plane.kv_create(key, value, lease_id=lease)
         if not created:
@@ -292,6 +301,12 @@ class Client:
     def instance_ids(self) -> list[int]:
         return sorted(self._instances)
 
+    def instances(self) -> list[Instance]:
+        return [self._instances[i] for i in sorted(self._instances)]
+
+    def instance(self, instance_id: int) -> Optional[Instance]:
+        return self._instances.get(instance_id)
+
     def available_ids(self) -> list[int]:
         return sorted(set(self._instances) - self._down)
 
@@ -341,7 +356,10 @@ class Client:
             inst = self._pick(mode, instance_id)
             try:
                 return await self._generate_to(inst, request, ctx)
-            except NoRespondersError:
+            except (NoRespondersError, StreamError):
+                # StreamError here is pre-stream (ack failed / worker could
+                # not open the response path) — safe to fail over, nothing
+                # was generated yet.
                 self.report_instance_down(inst.instance_id)
                 attempts += 1
                 if mode == "direct" or attempts > retries:

@@ -172,8 +172,11 @@ class ControlPlane(abc.ABC):
     async def request(self, subject: str, payload: bytes, timeout: float = 30.0) -> bytes: ...
 
     @abc.abstractmethod
-    async def serve(self, subject: str, handler: ServiceHandler, queue_group: Optional[str] = None):
-        """Register a request handler; returns an awaitable-cancel handle."""
+    async def serve(self, subject: str, handler: ServiceHandler):
+        """Register a request handler; returns an awaitable-cancel handle.
+
+        Multiple registrations on one subject form an implicit queue group:
+        ``request`` round-robins across them (NATS service semantics)."""
 
     # -- Durable streams (JetStream semantics) --
     @abc.abstractmethod
@@ -214,7 +217,6 @@ class _Lease:
 class _ServiceReg:
     subject: str
     handler: ServiceHandler
-    queue_group: Optional[str]
     owner: Optional[object] = None
 
 
@@ -382,8 +384,8 @@ class LocalControlPlane(ControlPlane):
         reg = regs[idx % len(regs)]
         return await asyncio.wait_for(reg.handler(payload), timeout)
 
-    async def serve(self, subject, handler, queue_group=None, owner=None):
-        reg = _ServiceReg(subject, handler, queue_group, owner)
+    async def serve(self, subject, handler, owner=None):
+        reg = _ServiceReg(subject, handler, owner)
         self._services.append(reg)
 
         async def cancel():
@@ -599,7 +601,7 @@ class _ServerConn:
         elif op == "sub_cancel":
             await self._stop_sub(m["sid"])
         elif op == "serve":
-            await self._start_serve(m["svc_id"], m["subject"], m.get("queue_group"))
+            await self._start_serve(m["svc_id"], m["subject"])
         elif op == "serve_cancel":
             cancel = self._svc_cancels.pop(m["svc_id"], None)
             if cancel:
@@ -665,7 +667,7 @@ class _ServerConn:
         if task:
             task.cancel()
 
-    async def _start_serve(self, svc_id, subject, queue_group):
+    async def _start_serve(self, svc_id, subject):
         async def forward(payload: bytes) -> bytes:
             self._next_rid += 1
             rid = self._next_rid
@@ -681,7 +683,7 @@ class _ServerConn:
                 # drop the entry so it cannot accumulate for the conn lifetime.
                 self._pending_svc.pop(rid, None)
 
-        cancel = await self.core.serve(subject, forward, queue_group, owner=self)
+        cancel = await self.core.serve(subject, forward, owner=self)
         self._svc_cancels[svc_id] = cancel
 
 
@@ -839,11 +841,11 @@ class RemoteControlPlane(ControlPlane):
             "request", timeout=timeout + 5.0, subject=subject, payload=payload, req_timeout=timeout
         )
 
-    async def serve(self, subject, handler, queue_group=None):
+    async def serve(self, subject, handler):
         self._next_id += 1
         svc_id = self._next_id
         self._handlers[svc_id] = handler
-        await self._call("serve", svc_id=svc_id, subject=subject, queue_group=queue_group)
+        await self._call("serve", svc_id=svc_id, subject=subject)
 
         async def cancel():
             self._handlers.pop(svc_id, None)

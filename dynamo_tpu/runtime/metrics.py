@@ -1,0 +1,153 @@
+"""Prometheus-style metrics registry (no external deps).
+
+Rebuild of the reference's hierarchical metrics registry (ref: lib/runtime/src/
+metrics.rs, metrics/prometheus_names.rs): counters/gauges/histograms with
+labels, auto-prefixed ``dynamo_*`` names, rendered in Prometheus text
+exposition format at the frontend's ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return "\n".join(lines)
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._callbacks: list = []
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def add_callback(self, fn):
+        """fn() -> dict[labels-tuple-or-None, value]; called at scrape time."""
+        self._callbacks.append(fn)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        values = dict(self._values)
+        for cb in self._callbacks:
+            try:
+                for labels, v in cb().items():
+                    values[tuple(sorted((labels or {}).items()))] = v
+            except Exception:
+                pass
+        for key, v in sorted(values.items()):
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return "\n".join(lines)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets=_DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+
+    def time(self, **labels):
+        return _Timer(self, labels)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key, counts in sorted(self._counts.items()):
+            labels = dict(key)
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += counts[i]
+                lines.append(f'{self.name}_bucket{_fmt_labels({**labels, "le": str(b)})} {cum}')
+            lines.append(f'{self.name}_bucket{_fmt_labels({**labels, "le": "+Inf"})} {counts[-1]}')
+            lines.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sums.get(key, 0.0)}")
+            lines.append(f"{self.name}_count{_fmt_labels(labels)} {counts[-1]}")
+        return "\n".join(lines)
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: dict):
+        self._hist = hist
+        self._labels = labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._t0, **self._labels)
+
+
+class MetricsRegistry:
+    def __init__(self, prefix: str = "dynamo"):
+        self.prefix = prefix
+        self._metrics: dict[str, object] = {}
+        self._start = time.time()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        full = f"{self.prefix}_{name}"
+        if full not in self._metrics:
+            self._metrics[full] = Counter(full, help_ or name)
+        return self._metrics[full]  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        full = f"{self.prefix}_{name}"
+        if full not in self._metrics:
+            self._metrics[full] = Gauge(full, help_ or name)
+        return self._metrics[full]  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        full = f"{self.prefix}_{name}"
+        if full not in self._metrics:
+            self._metrics[full] = Histogram(full, help_ or name, buckets)
+        return self._metrics[full]  # type: ignore[return-value]
+
+    def render(self) -> str:
+        up = f"# TYPE {self.prefix}_uptime_seconds gauge\n{self.prefix}_uptime_seconds {time.time() - self._start}"
+        parts = [m.render() for m in self._metrics.values()]  # type: ignore[attr-defined]
+        return "\n".join([up] + parts) + "\n"

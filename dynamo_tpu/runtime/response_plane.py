@@ -31,6 +31,23 @@ logger = logging.getLogger("dynamo.response_plane")
 
 _COMPLETE = {"t": "complete"}
 
+#: per-stream buffer cap: beyond this the server stops reading the worker's
+#: socket, letting TCP flow control throttle the producer (backpressure)
+STREAM_QUEUE_MAX = 1024
+
+
+def _put_sentinel(q: asyncio.Queue, frame: dict) -> None:
+    """Deliver a terminal frame even when the queue is full (drop oldest data)."""
+    while True:
+        try:
+            q.put_nowait(frame)
+            return
+        except asyncio.QueueFull:
+            try:
+                q.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+
 
 @dataclass(frozen=True)
 class ConnectionInfo:
@@ -101,14 +118,14 @@ class ResponseStreamServer:
             await self._server.wait_closed()
             self._server = None
         for q, _ in self._pending.values():
-            q.put_nowait({"t": "err", "msg": STREAM_ERR_MSG})
+            _put_sentinel(q, {"t": "err", "msg": STREAM_ERR_MSG})
         self._pending.clear()
 
     def register_stream(self, ctx: Context) -> tuple[ConnectionInfo, ResponseReceiver]:
         """Register a pending stream; returns (info for the worker, receiver)."""
         assert self._server is not None, "ResponseStreamServer not started"
         stream_id = uuid.uuid4().hex
-        q: asyncio.Queue = asyncio.Queue()
+        q: asyncio.Queue = asyncio.Queue(maxsize=STREAM_QUEUE_MAX)
         self._pending[stream_id] = (q, ctx)
         info = ConnectionInfo(self._host, self._port, stream_id)
 
@@ -146,12 +163,12 @@ class ResponseStreamServer:
                     frame = await read_frame(reader)
                     t = frame.get("t")
                     if t == "data":
-                        q.put_nowait(frame)
+                        await q.put(frame)  # blocks when full -> TCP backpressure
                     elif t in ("complete", "err"):
-                        q.put_nowait(frame)
+                        _put_sentinel(q, frame)
                         return
             except (asyncio.IncompleteReadError, ConnectionError):
-                q.put_nowait({"t": "err", "msg": STREAM_ERR_MSG})
+                _put_sentinel(q, {"t": "err", "msg": STREAM_ERR_MSG})
             finally:
                 cancel_task.cancel()
         except Exception:
@@ -206,14 +223,14 @@ class StreamSender:
 
     async def send(self, data: Any) -> None:
         if self._queue is not None:
-            self._queue.put_nowait({"t": "data", "d": data})
+            await self._queue.put({"t": "data", "d": data})
         else:
             await write_frame(self._writer, {"t": "data", "d": data})
 
     async def complete(self) -> None:
         self._closed = True
         if self._queue is not None:
-            self._queue.put_nowait(_COMPLETE)
+            _put_sentinel(self._queue, _COMPLETE)
         else:
             try:
                 await write_frame(self._writer, _COMPLETE)
@@ -223,7 +240,7 @@ class StreamSender:
     async def error(self, msg: str) -> None:
         self._closed = True
         if self._queue is not None:
-            self._queue.put_nowait({"t": "err", "msg": msg})
+            _put_sentinel(self._queue, {"t": "err", "msg": msg})
         else:
             try:
                 await write_frame(self._writer, {"t": "err", "msg": msg})
@@ -242,7 +259,7 @@ class StreamSender:
 
 def make_local_stream(ctx: Context) -> tuple[ConnectionInfo, ResponseReceiver, asyncio.Queue]:
     """In-process short-circuit stream (no sockets)."""
-    q: asyncio.Queue = asyncio.Queue()
+    q: asyncio.Queue = asyncio.Queue(maxsize=STREAM_QUEUE_MAX)
     info = ConnectionInfo("", 0, uuid.uuid4().hex, local=True)
 
     async def on_cancel():
@@ -252,7 +269,17 @@ def make_local_stream(ctx: Context) -> tuple[ConnectionInfo, ResponseReceiver, a
 
 
 def _default_host() -> str:
-    """Best-effort routable address of this host (TPU-VM DCN interface)."""
+    """Best-effort routable address of this host (TPU-VM DCN interface).
+
+    Override with ``DYN_RESPONSE_HOST`` when autodetection picks the wrong
+    interface; a loopback fallback is logged loudly since it breaks
+    cross-host response streams.
+    """
+    import os
+
+    override = os.environ.get("DYN_RESPONSE_HOST")
+    if override:
+        return override
     try:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         s.connect(("10.255.255.255", 1))
@@ -260,4 +287,15 @@ def _default_host() -> str:
         s.close()
         return ip
     except Exception:
-        return "127.0.0.1"
+        pass
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        if not ip.startswith("127."):
+            return ip
+    except Exception:
+        pass
+    logger.warning(
+        "could not detect a routable host address; advertising 127.0.0.1 "
+        "(cross-host response streams will fail — set DYN_RESPONSE_HOST)"
+    )
+    return "127.0.0.1"

@@ -31,6 +31,7 @@ class DistributedRuntime:
         self.config = config
         self._owns_plane = owns_plane
         self._primary_lease: Optional[int] = None
+        self._lease_lock = asyncio.Lock()
         self._keepalive_task: Optional[asyncio.Task] = None
         self._response_server: Optional[ResponseStreamServer] = None
         # subject -> (handler, inflight set); see component._generate_to
@@ -66,24 +67,49 @@ class DistributedRuntime:
         return Namespace(self, name or self.config.namespace)
 
     async def primary_lease(self) -> int:
-        if self._primary_lease is None:
-            self._primary_lease = await self.plane.lease_create(self.config.lease_ttl)
-            self._keepalive_task = asyncio.get_running_loop().create_task(self._keepalive_loop())
+        async with self._lease_lock:
+            if self._primary_lease is None:
+                self._primary_lease = await self.plane.lease_create(self.config.lease_ttl)
+                self._keepalive_task = asyncio.get_running_loop().create_task(
+                    self._keepalive_loop()
+                )
         return self._primary_lease
 
     async def _keepalive_loop(self):
+        """Refresh the primary lease; transient errors are retried.
+
+        A definitively-lost lease (keepalive returns False) means every
+        instance registered under it is already gone cluster-wide — the
+        process is an undiscoverable zombie, so we trip the shutdown event
+        and let the worker main exit (supervisor restarts it), matching the
+        reference's lease-loss-is-fatal semantics.
+        """
         interval = max(self.config.lease_ttl / 3.0, 0.5)
+        failures = 0
         try:
             while not self._shutdown_event.is_set():
                 await asyncio.sleep(interval)
-                ok = await self.plane.lease_keepalive(self._primary_lease)
+                try:
+                    ok = await self.plane.lease_keepalive(self._primary_lease)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    failures += 1
+                    logger.warning(
+                        "lease keepalive error (%d consecutive)", failures, exc_info=True
+                    )
+                    if failures >= 5:
+                        logger.error("lease keepalive failing persistently; shutting down")
+                        self._shutdown_event.set()
+                        return
+                    continue
                 if not ok:
-                    logger.error("primary lease %x lost", self._primary_lease or 0)
+                    logger.error("primary lease %x lost; shutting down", self._primary_lease or 0)
+                    self._shutdown_event.set()
                     return
+                failures = 0
         except asyncio.CancelledError:
             pass
-        except Exception:
-            logger.exception("lease keepalive failed")
 
     async def response_server(self) -> ResponseStreamServer:
         if self._response_server is None:

@@ -1,0 +1,178 @@
+"""Preprocessor / detokenizer backend / migration operator tests."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.pipeline import (
+    Backend,
+    Migration,
+    OpenAIPreprocessor,
+    StopSequenceJail,
+    aggregate_chat_stream,
+    build_pipeline,
+)
+from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+from dynamo_tpu.protocols import Annotated, FinishReason, LLMEngineOutput, PreprocessedRequest, StopConditions
+from dynamo_tpu.protocols.openai import parse_chat_request
+from dynamo_tpu.runtime.context import Context, StreamError
+
+pytestmark = pytest.mark.anyio
+
+TK = make_test_tokenizer()
+
+
+def make_engine(token_lists, finish=FinishReason.EOS, fail_after=None):
+    """Fake engine yielding given token id lists, optionally dying mid-stream."""
+
+    calls = []
+
+    async def engine(req: PreprocessedRequest, ctx: Context):
+        calls.append(req)
+        for i, toks in enumerate(token_lists):
+            if fail_after is not None and i == fail_after and len(calls) == 1:
+                raise StreamError("stream disconnected")
+            yield LLMEngineOutput(token_ids=list(toks))
+            await asyncio.sleep(0)
+        yield LLMEngineOutput(finish_reason=finish)
+
+    engine.calls = calls
+    return engine
+
+
+def ids(text):
+    return TK.encode(text, add_special_tokens=False)
+
+
+async def collect(agen):
+    return [x async for x in agen]
+
+
+async def test_backend_detokenizes_incrementally():
+    engine = make_engine([ids("hello"), ids("world"), ids("the quick")])
+    backend = Backend(TK, engine)
+    req = PreprocessedRequest(model="m", token_ids=ids("test"))
+    outs = await collect(backend.generate(req, Context()))
+    text = "".join(o.text or "" for o in outs)
+    assert text.split() == ["hello", "world", "the", "quick"]
+    assert outs[-1].finish_reason == FinishReason.EOS
+
+
+async def test_backend_stop_string_jail():
+    # stop sequence spans two engine outputs and must be hidden entirely
+    engine = make_engine([ids("hello stop"), ids("sequence world")])
+    backend = Backend(TK, engine)
+    req = PreprocessedRequest(
+        model="m",
+        token_ids=ids("test"),
+        stop_conditions=StopConditions(stop=["stop sequence"]),
+    )
+    outs = await collect(backend.generate(req, Context()))
+    text = "".join(o.text or "" for o in outs)
+    assert "stop sequence" not in text
+    assert "world" not in text  # generation ended at the stop
+    assert outs[-1].finish_reason == FinishReason.STOP
+
+
+async def test_backend_hidden_stop_token():
+    eos = TK.eos_token_id
+    engine = make_engine([ids("hello"), [eos], ids("world")], finish=None)
+    backend = Backend(TK, engine)
+    req = PreprocessedRequest(model="m", token_ids=ids("test"), eos_token_ids=[eos])
+    outs = await collect(backend.generate(req, Context()))
+    text = "".join(o.text or "" for o in outs)
+    assert "world" not in text
+    assert outs[-1].finish_reason == FinishReason.EOS
+
+
+def test_stop_jail_partial_prefix_held():
+    jail = StopSequenceJail(["ABC"])
+    emit, hit = jail.feed("xxA")
+    assert emit == "xx" and not hit
+    emit, hit = jail.feed("B")
+    assert emit == "" and not hit
+    emit, hit = jail.feed("q")  # "ABq" — not the stop, release
+    assert emit == "ABq" and not hit
+    emit, hit = jail.feed("ABC")
+    assert emit == "" and hit
+
+
+async def test_migration_resumes_with_accumulated_tokens():
+    engine = make_engine([ids("hello"), ids("world"), ids("fox")], fail_after=2)
+    migration = Migration(engine, migration_limit=2)
+    req = PreprocessedRequest(model="m", token_ids=ids("the quick"))
+    outs = await collect(migration.generate(req, Context()))
+    # second call must carry original + accumulated tokens
+    assert len(engine.calls) == 2
+    assert engine.calls[1].token_ids == ids("the quick") + ids("hello") + ids("world")
+    assert outs[-1].finish_reason == FinishReason.EOS
+
+
+async def test_migration_exhausts_budget():
+    async def dying(req, ctx):
+        raise StreamError("stream disconnected")
+        yield  # pragma: no cover
+
+    migration = Migration(dying, migration_limit=2)
+    req = PreprocessedRequest(model="m", token_ids=[1, 2])
+    with pytest.raises(StreamError):
+        await collect(migration.generate(req, Context()))
+
+
+async def test_full_pipeline_chat():
+    mdc = ModelDeploymentCard(display_name="test-model", eos_token_ids=[TK.eos_token_id])
+    engine = make_engine([ids("paris"), ids(".")])
+    pipe = build_pipeline(mdc, TK, engine)
+    body = {
+        "model": "test-model",
+        "messages": [{"role": "user", "content": "what is the capital of france ?"}],
+        "stream": True,
+    }
+    req = parse_chat_request(body)
+    chunks = await collect(pipe.generate(req, Context()))
+    # engine got templated+tokenized prompt
+    sent = engine.calls[0]
+    assert sent.token_ids  # non-empty
+    prompt_text = TK.decode(sent.token_ids)
+    assert "france" in prompt_text
+    # stream shape: role first, content deltas, finish last
+    anns = [Annotated.from_wire(c) for c in chunks]
+    first = anns[0].data
+    assert first["choices"][0]["delta"].get("role") == "assistant"
+    full = "".join(a.data["choices"][0]["delta"].get("content") or "" for a in anns if a.data)
+    assert "paris" in full
+    assert anns[-1].data["choices"][0]["finish_reason"] == "stop"
+
+
+async def test_pipeline_aggregation_and_annotations():
+    mdc = ModelDeploymentCard(display_name="test-model")
+    engine = make_engine([ids("hello world")])
+    pipe = build_pipeline(mdc, TK, engine)
+    body = {
+        "model": "test-model",
+        "messages": [{"role": "user", "content": "hello"}],
+        "nvext": {"annotations": ["formatted_prompt", "token_ids"]},
+    }
+    req = parse_chat_request(body)
+    chunks = await collect(pipe.generate(req, Context()))
+    events = [Annotated.from_wire(c).event for c in chunks]
+    assert "formatted_prompt" in events and "token_ids" in events
+
+    async def replay():
+        for c in chunks:
+            yield c
+
+    resp = await aggregate_chat_stream(replay())
+    assert resp["object"] == "chat.completion"
+    assert "hello" in resp["choices"][0]["message"]["content"]
+
+
+async def test_preprocessor_rejects_oversized_prompt():
+    mdc = ModelDeploymentCard(display_name="m", context_length=4)
+    pipe = OpenAIPreprocessor(mdc, TK, None)
+    req = parse_chat_request(
+        {"model": "m", "messages": [{"role": "user", "content": "the quick brown fox jumps over"}]}
+    )
+    with pytest.raises(ValueError, match="context length"):
+        pipe.preprocess(req)

@@ -1,0 +1,198 @@
+"""KV router: radix indexer, scheduler cost function, event flow, push router."""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.router import (
+    ActiveSequences,
+    ApproxKvIndexer,
+    KvEventPublisher,
+    KvIndexer,
+    KvRouter,
+    RadixTree,
+    KvScheduler,
+    softmax_sample,
+)
+from dynamo_tpu.router.protocols import KvCacheEvent, KvRouterConfig, RouterEvent, StoredBlock
+from dynamo_tpu.router.scheduler import NoWorkersError
+from dynamo_tpu.runtime.control_plane import LocalControlPlane
+from dynamo_tpu.tokens import compute_block_hash_for_seq, compute_seq_hash_for_block
+
+pytestmark = pytest.mark.anyio
+
+W0, W1 = 100, 200
+
+
+def stored_event(worker, tokens, block_size=4, event_id=1, parent=None):
+    local = compute_block_hash_for_seq(tokens, block_size)
+    ext = compute_seq_hash_for_block(local)
+    blocks = [StoredBlock(e, l) for e, l in zip(ext, local)]
+    return RouterEvent(worker, KvCacheEvent.stored(event_id, parent, blocks)), local, ext
+
+
+def test_radix_tree_overlap_scores():
+    tree = RadixTree()
+    toks = list(range(16))
+    ev0, local, _ = stored_event(W0, toks)
+    tree.apply_event(ev0)
+    ev1, _, _ = stored_event(W1, toks[:8])
+    tree.apply_event(ev1)
+
+    scores = tree.find_matches(local).scores
+    assert scores == {W0: 4, W1: 2}
+
+    # divergent suffix matches only the shared prefix
+    other = toks[:8] + [99, 98, 97, 96]
+    scores = tree.find_matches(compute_block_hash_for_seq(other, 4)).scores
+    assert scores == {W0: 2, W1: 2}
+
+    # unrelated tokens match nothing
+    assert tree.find_matches(compute_block_hash_for_seq(list(range(50, 66)), 4)).scores == {}
+
+
+def test_radix_tree_removal_and_clear():
+    tree = RadixTree()
+    toks = list(range(16))
+    ev0, local, ext0 = stored_event(W0, toks)
+    tree.apply_event(ev0)
+    ev1, _, _ = stored_event(W1, toks)
+    tree.apply_event(ev1)
+
+    # remove W0's last two blocks
+    tree.apply_event(RouterEvent(W0, KvCacheEvent.removed(2, ext0[2:])))
+    scores = tree.find_matches(local).scores
+    assert scores == {W0: 2, W1: 4}
+
+    tree.remove_worker(W1)
+    scores = tree.find_matches(local).scores
+    assert scores == {W0: 2}
+
+
+def test_radix_tree_dump_load_roundtrip():
+    tree = RadixTree()
+    ev0, local, ext = stored_event(W0, list(range(16)))
+    tree.apply_event(ev0)
+    restored = RadixTree.load(tree.dump())
+    assert restored.find_matches(local).scores == {W0: 4}
+    # removal by external hash still works after restore
+    restored.apply_event(RouterEvent(W0, KvCacheEvent.removed(2, ext[3:])))
+    assert restored.find_matches(local).scores == {W0: 3}
+
+
+def test_softmax_sample_argmin_at_zero_temperature():
+    rng = random.Random(0)
+    logits = {1: 5.0, 2: 1.0, 3: 9.0}
+    assert all(softmax_sample(logits, 0.0, rng) == 2 for _ in range(10))
+
+
+def test_softmax_sample_temperature_spreads():
+    rng = random.Random(0)
+    logits = {1: 1.0, 2: 1.5}
+    picks = {softmax_sample(logits, 1.0, rng) for _ in range(200)}
+    assert picks == {1, 2}
+
+
+def test_scheduler_prefers_overlap_and_balances_load():
+    from dynamo_tpu.router.indexer import OverlapScores
+
+    sched = KvScheduler(block_size=4, config=KvRouterConfig())
+    # W0 has 3 blocks of overlap, W1 none → W0 wins
+    d = sched.schedule(
+        "r1", isl_tokens=16, seq_hashes=[11, 12, 13, 14],
+        overlaps=OverlapScores(scores={W0: 3}), worker_ids=[W0, W1],
+    )
+    assert d.worker_id == W0
+    assert d.overlap_blocks == 3
+
+    # now W0 is loaded with r1's 4 blocks + 4 prefill tokens; a fresh request
+    # with no overlap anywhere goes to the idle W1
+    d2 = sched.schedule(
+        "r2", isl_tokens=16, seq_hashes=[21, 22, 23, 24],
+        overlaps=OverlapScores(), worker_ids=[W0, W1],
+    )
+    assert d2.worker_id == W1
+
+    sched.free("r1")
+    sched.free("r2")
+
+
+def test_scheduler_no_workers():
+    from dynamo_tpu.router.indexer import OverlapScores
+
+    sched = KvScheduler(block_size=4)
+    with pytest.raises(NoWorkersError):
+        sched.schedule("r", 16, None, OverlapScores(), [])
+
+
+def test_active_sequences_shared_blocks_counted_once():
+    seqs = ActiveSequences(block_size=4)
+    seqs.add_request("a", [1, 2, 3], isl=12, overlap=0)
+    seqs.add_request("b", [1, 2, 9], isl=12, overlap=1)
+    assert seqs.active_blocks == 4  # {1,2,3,9}
+    assert seqs.active_tokens == 12 + 8
+    seqs.mark_prefill_completed("a")
+    assert seqs.active_tokens == 8
+    seqs.free("b")
+    assert seqs.active_blocks == 3
+    seqs.free("a")
+    assert seqs.active_blocks == 0
+
+
+async def test_indexer_event_flow_via_stream():
+    plane = LocalControlPlane()
+    pub = KvEventPublisher(plane, worker_id=W0, kv_block_size=4)
+    indexer = await KvIndexer(plane, kv_block_size=4).start()
+
+    toks = list(range(16))
+    local = compute_block_hash_for_seq(toks, 4)
+    ext = compute_seq_hash_for_block(local)
+    await pub.publish_stored(None, [StoredBlock(e, l) for e, l in zip(ext, local)])
+    for _ in range(100):
+        if indexer.events_applied:
+            break
+        await asyncio.sleep(0.01)
+    assert indexer.find_matches_for_tokens(toks).scores == {W0: 4}
+
+    await pub.publish_removed(ext[2:])
+    for _ in range(100):
+        if indexer.events_applied == 2:
+            break
+        await asyncio.sleep(0.01)
+    assert indexer.find_matches_for_tokens(toks).scores == {W0: 2}
+    await indexer.stop()
+    await plane.close()
+
+
+def test_approx_indexer_ttl():
+    idx = ApproxKvIndexer(kv_block_size=4, ttl=0.0)  # instant expiry
+    toks = list(range(8))
+    idx.process_routing_decision_for_request(toks, W0)
+    assert idx.find_matches_for_tokens(toks).scores == {}
+
+    idx2 = ApproxKvIndexer(kv_block_size=4, ttl=60.0)
+    idx2.process_routing_decision_for_request(toks, W0)
+    assert idx2.find_matches_for_tokens(toks).scores == {W0: 2}
+
+
+async def test_kv_router_end_to_end_routing():
+    plane = LocalControlPlane()
+    router = await KvRouter(plane, block_size=4).start()
+    pub = KvEventPublisher(plane, worker_id=W0, kv_block_size=4)
+
+    toks = list(range(16))
+    local = compute_block_hash_for_seq(toks, 4)
+    ext = compute_seq_hash_for_block(local)
+    await pub.publish_stored(None, [StoredBlock(e, l) for e, l in zip(ext, local)])
+    for _ in range(100):
+        if router.indexer.events_applied:
+            break
+        await asyncio.sleep(0.01)
+
+    d = router.find_best_match("req1", toks, [W0, W1])
+    assert d.worker_id == W0 and d.overlap_blocks == 4
+    router.mark_prefill_completed("req1")
+    router.free("req1")
+    await router.stop()
+    await plane.close()
