@@ -1,0 +1,210 @@
+"""Paged KV cache: device arrays + host-side block pool with prefix cache.
+
+Device side: two arrays [L, num_slots, KV, hd] (num_slots = num_blocks *
+block_size), flat slot addressing; block 0 is the reserved NULL block —
+padding slot-maps and block-tables point at it and its contents are garbage
+by design (attention masks it out).
+
+Host side: ``BlockPool`` mirrors the reference's block lifecycle (ref:
+lib/llm/src/block_manager/pool/managed.rs — active refcounted registry +
+inactive LRU reuse pool keyed by SequenceHash; and the mocker's KvManager +
+LRU evictor — lib/llm/src/mocker/{kv_manager,evictor}.rs): blocks are
+refcounted while sequences use them; on release, hash-identified full blocks
+park in an LRU prefix cache for reuse; eviction emits the KV-removed events
+the router's radix index relies on (ref: kv_router/indexer.rs).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from dynamo_tpu.tokens import SequenceHash
+
+logger = logging.getLogger("dynamo.engine.cache")
+
+NULL_BLOCK = 0
+
+
+@dataclass
+class BlockMeta:
+    block_id: int
+    ref_count: int = 0
+    #: chained sequence hash once the block is full + registered (None = partial)
+    seq_hash: Optional[SequenceHash] = None
+    #: local tokens-only hash (the router's radix edge key)
+    tokens_hash: Optional[int] = None
+    parent_hash: Optional[SequenceHash] = None
+
+
+class BlockPool:
+    """Refcounted block allocator with an inactive LRU prefix cache.
+
+    Events: ``on_removed(seq_hashes)`` fires when cached blocks are evicted
+    (reused for new data), matching the reference's KV-removed events.
+    """
+
+    def __init__(self, num_blocks: int, enable_prefix_caching: bool = True,
+                 on_removed: Optional[Callable[[list[int]], None]] = None):
+        self.num_blocks = num_blocks
+        self.enable_prefix_caching = enable_prefix_caching
+        self.on_removed = on_removed
+        # block 0 reserved as NULL
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))
+        self._meta: dict[int, BlockMeta] = {}
+        #: seq_hash -> block_id for *all* registered full blocks (active+inactive)
+        self._by_hash: dict[SequenceHash, int] = {}
+        #: inactive (refcount 0) cached blocks, LRU order (oldest first)
+        self._lru: "OrderedDict[SequenceHash, int]" = OrderedDict()
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def num_free_blocks(self) -> int:
+        """Blocks allocatable right now (free list + evictable LRU)."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def num_active_blocks(self) -> int:
+        return len(self._meta) - len(self._lru)
+
+    def usage(self) -> float:
+        usable = self.num_blocks - 1
+        return (usable - self.num_free_blocks) / max(1, usable)
+
+    # -- allocation --------------------------------------------------------
+
+    def allocate(self, n: int) -> Optional[list[int]]:
+        """Allocate n blocks, evicting LRU-cached blocks if needed.
+
+        Returns None (allocating nothing) if capacity is insufficient.
+        """
+        if self.num_free_blocks < n:
+            return None
+        out = []
+        evicted: list[int] = []
+        for _ in range(n):
+            if self._free:
+                bid = self._free.pop()
+            else:
+                h, bid = self._lru.popitem(last=False)
+                meta = self._meta.pop(bid)
+                self._by_hash.pop(h, None)
+                evicted.append(meta.seq_hash)
+            self._meta[bid] = BlockMeta(block_id=bid, ref_count=1)
+            out.append(bid)
+        if evicted and self.on_removed:
+            self.on_removed(evicted)
+        return out
+
+    # -- prefix cache ------------------------------------------------------
+
+    def match_prefix(self, seq_hashes: list[SequenceHash]) -> list[int]:
+        """Longest cached prefix: block ids for leading seq hashes, increffed."""
+        if not self.enable_prefix_caching:
+            return []
+        out = []
+        for h in seq_hashes:
+            bid = self._by_hash.get(h)
+            if bid is None:
+                break
+            meta = self._meta[bid]
+            if meta.ref_count == 0:
+                self._lru.pop(h, None)
+            meta.ref_count += 1
+            out.append(bid)
+        return out
+
+    def register(self, block_id: int, seq_hash: SequenceHash, tokens_hash: int,
+                 parent_hash: Optional[SequenceHash]) -> bool:
+        """Mark a full block as identified by its hashes (→ reusable).
+
+        Returns False if an identical block is already registered (duplicate
+        content on this worker — caller may dedup, we keep both refs valid).
+        """
+        meta = self._meta[block_id]
+        meta.seq_hash, meta.tokens_hash, meta.parent_hash = seq_hash, tokens_hash, parent_hash
+        if not self.enable_prefix_caching:
+            return True
+        if seq_hash in self._by_hash and self._by_hash[seq_hash] != block_id:
+            return False
+        self._by_hash[seq_hash] = block_id
+        return True
+
+    def release(self, block_ids: list[int]) -> None:
+        """Decref; refcount-0 blocks go to the LRU cache (if hashed) or free."""
+        freed_hashes: list[int] = []
+        for bid in block_ids:
+            if bid == NULL_BLOCK:
+                continue
+            meta = self._meta.get(bid)
+            if meta is None:
+                continue
+            meta.ref_count -= 1
+            if meta.ref_count > 0:
+                continue
+            if (meta.seq_hash is not None and self.enable_prefix_caching
+                    and self._by_hash.get(meta.seq_hash) == bid):
+                self._lru[meta.seq_hash] = bid
+                self._lru.move_to_end(meta.seq_hash)
+            else:
+                # duplicate-content or unhashed block: its data vanishes, but a
+                # removed-event only fires if this block *was* the hash's home
+                if meta.seq_hash is not None and self._by_hash.get(meta.seq_hash) == bid:
+                    freed_hashes.append(meta.seq_hash)
+                    self._by_hash.pop(meta.seq_hash, None)
+                self._meta.pop(bid)
+                self._free.append(bid)
+        if freed_hashes and self.on_removed:
+            self.on_removed(freed_hashes)
+
+    def clear(self) -> None:
+        """Drop the entire prefix cache (admin clear_kv_blocks analog)."""
+        for h, bid in list(self._lru.items()):
+            self._meta.pop(bid, None)
+            self._by_hash.pop(h, None)
+            self._free.append(bid)
+        self._lru.clear()
+        if self.on_removed:
+            self.on_removed(None)  # None = cleared-all sentinel
+
+
+def allocate_device_cache(cfg, num_blocks: int, block_size: int, mesh=None,
+                          dtype=None):
+    """Allocate the [L, num_slots, KV, hd] k/v cache arrays (zeros)."""
+    import jax.numpy as jnp
+    import jax
+
+    from dynamo_tpu.engine.model import cache_shardings
+
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, num_blocks * block_size, cfg.num_kv_heads, cfg.head_dim)
+    if mesh is not None:
+        sh = cache_shardings(mesh)
+        k = jax.device_put(jnp.zeros(shape, dtype), sh)
+        v = jax.device_put(jnp.zeros(shape, dtype), sh)
+    else:
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+    return k, v
+
+
+def hbm_sized_num_blocks(cfg, block_size: int, fraction: float,
+                         tp_size: int = 1, default: int = 512) -> int:
+    """Size the block count from free device memory (TPU) or a default (CPU)."""
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+        free = stats["bytes_limit"] - stats["bytes_in_use"]
+    except Exception:
+        return default
+    bytes_per_block = (
+        2 * cfg.num_layers * block_size * (cfg.num_kv_heads // max(1, tp_size))
+        * cfg.head_dim * (2 if cfg.dtype == "bfloat16" else 4)
+    )
+    n = int(free * fraction / max(1, bytes_per_block))
+    return max(16, n)

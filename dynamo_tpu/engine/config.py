@@ -1,0 +1,182 @@
+"""Engine configuration: model architecture + engine runtime knobs.
+
+The knob set mirrors the reference's engine-arg surface (ref:
+components/backends/vllm/src/dynamo/vllm/args.py, mocker/protocols.rs:67-100)
+— block_size / num blocks / max_num_seqs / max_num_batched_tokens /
+enable_prefix_caching / enable_chunked_prefill — plus TPU-native additions
+(mesh shape, dtype, bucketing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ModelConfig:
+    """Llama-family decoder architecture (covers Llama 2/3, Mistral, Qwen2,
+    TinyLlama; MoE via n_routed_experts for Mixtral/DeepSeek-style models)."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 8192
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # MoE (0 experts = dense MLP)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # attention extras
+    qkv_bias: bool = False  # Qwen2-style
+    sliding_window: Optional[int] = None
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @staticmethod
+    def from_hf_config(d: dict) -> "ModelConfig":
+        """Map a HuggingFace ``config.json`` dict onto ModelConfig.
+
+        Handles llama/mistral/qwen2/mixtral keys (ref parity: the reference
+        loads the same file into its ModelDeploymentCard — model_card.rs:93).
+        """
+        arch = (d.get("architectures") or [""])[0].lower()
+        return ModelConfig(
+            vocab_size=d.get("vocab_size", 32000),
+            hidden_size=d.get("hidden_size", 4096),
+            intermediate_size=d.get("intermediate_size", 11008),
+            num_layers=d.get("num_hidden_layers", 32),
+            num_heads=d.get("num_attention_heads", 32),
+            num_kv_heads=d.get("num_key_value_heads", d.get("num_attention_heads", 32)),
+            head_dim=d.get("head_dim"),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rms_norm_eps=d.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=d.get("max_position_embeddings", 8192),
+            tie_word_embeddings=d.get("tie_word_embeddings", False),
+            num_experts=d.get("num_local_experts", d.get("n_routed_experts", 0)) or 0,
+            num_experts_per_tok=d.get("num_experts_per_tok", 2),
+            qkv_bias="qwen2" in arch,
+            sliding_window=d.get("sliding_window"),
+        )
+
+    @staticmethod
+    def from_pretrained(path: str) -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return ModelConfig.from_hf_config(json.load(f))
+
+    # ---- canned architectures for tests / benches -------------------------
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "ModelConfig":
+        return ModelConfig(
+            vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, rope_theta=10000.0,
+            max_position_embeddings=512, dtype="float32",
+        )
+
+    @staticmethod
+    def llama3_8b() -> "ModelConfig":
+        return ModelConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+            max_position_embeddings=8192,
+        )
+
+    @staticmethod
+    def llama3_70b() -> "ModelConfig":
+        return ModelConfig(
+            vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+            num_layers=80, num_heads=64, num_kv_heads=8, rope_theta=500000.0,
+            max_position_embeddings=8192,
+        )
+
+    @staticmethod
+    def llama3_1b() -> "ModelConfig":
+        """Llama-3.2-1B shape — fits a single v5e chip comfortably in bf16."""
+        return ModelConfig(
+            vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+            num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+            rope_theta=500000.0, max_position_embeddings=8192,
+            tie_word_embeddings=True,
+        )
+
+
+@dataclass
+class EngineArgs:
+    """Engine runtime knobs (ref: vllm/args.py + mocker/protocols.rs:67-100)."""
+
+    block_size: int = 16
+    num_blocks: Optional[int] = None  # None = size from HBM budget
+    max_num_seqs: int = 64
+    max_num_batched_tokens: int = 2048
+    max_model_len: int = 4096
+    enable_prefix_caching: bool = True
+    enable_chunked_prefill: bool = True
+    watermark: float = 0.01
+    # TPU-native:
+    tp_size: int = 1  # tensor parallel (mesh "tp" axis)
+    dp_size: int = 1  # batch shards inside one engine (mesh "dp" axis)
+    kv_cache_memory_fraction: float = 0.6  # of free HBM, when num_blocks is None
+    decode_batch_buckets: tuple = ()  # () = powers of two up to max_num_seqs
+    prefill_buckets: tuple = ()  # () = powers of two up to max_num_batched_tokens
+    use_pallas_attention: bool = False  # Pallas paged-attention kernel (TPU only)
+    seed: int = 0
+
+    def __post_init__(self):
+        if not self.decode_batch_buckets:
+            b = [2**i for i in range(0, max(1, self.max_num_seqs).bit_length())
+                 if 2**i <= self.max_num_seqs] or [1]
+            if b[-1] < self.max_num_seqs:  # non-power-of-two max must be covered
+                b.append(self.max_num_seqs)
+            self.decode_batch_buckets = tuple(b)
+        if not self.prefill_buckets:
+            lo = self.block_size.bit_length()
+            hi = self.max_num_batched_tokens.bit_length()
+            b = [2**i for i in range(lo - 1, hi) if 2**i <= self.max_num_batched_tokens]
+            b = [x for x in b if x >= self.block_size] or [self.block_size]
+            if b[-1] < self.max_num_batched_tokens:
+                b.append(self.max_num_batched_tokens)
+            self.prefill_buckets = tuple(b)
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return math.ceil(self.max_model_len / self.block_size)
+
+    def bucket_tokens(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def bucket_batch(self, n: int) -> int:
+        for b in self.decode_batch_buckets:
+            if n <= b:
+                return b
+        return self.decode_batch_buckets[-1]
+
+    def bucket_table_width(self, max_kv_len: int) -> int:
+        """Block-table width bucket (powers of two) for a batch's longest kv."""
+        need = math.ceil(max(1, max_kv_len) / self.block_size)
+        w = 1
+        while w < need:
+            w *= 2
+        return min(w, self.max_blocks_per_seq) if self.max_blocks_per_seq >= need else need
+
+    def replace(self, **kw) -> "EngineArgs":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,118 @@
+"""Checkpoint loading: HF safetensors → the engine's stacked params pytree.
+
+The reference resolves model artifacts from the HF hub into its engines
+(ref: lib/llm/src/local_model.rs:1-456, hub.rs); here the weights land
+directly in the JAX param layout of model.py (layers stacked on a leading L
+axis for lax.scan; projection matrices stored [in, out] so the forward pass
+is x @ W with no transposes at trace time).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+from dynamo_tpu.engine.config import ModelConfig
+
+logger = logging.getLogger("dynamo.engine.loader")
+
+
+def _load_tensors(path: str) -> dict:
+    """Load all *.safetensors under path into {name: np/jnp array}."""
+    files = sorted(
+        os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {path}")
+    out = {}
+    try:
+        from safetensors import safe_open
+
+        import jax.numpy as jnp
+        import ml_dtypes  # numpy bf16 support ships with jax
+
+        for f in files:
+            with safe_open(f, framework="numpy") as sf:
+                for name in sf.keys():
+                    out[name] = sf.get_tensor(name)
+    except (ImportError, TypeError, ValueError):
+        # bf16 via torch fallback (torch-cpu is baked into the image)
+        import torch
+
+        from safetensors.torch import load_file
+
+        for f in files:
+            for name, t in load_file(f).items():
+                out[name] = t.to(torch.float32).numpy()
+    return out
+
+
+def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
+    """Map HF llama/mistral/qwen2 weight names onto the model.py pytree."""
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    t = _load_tensors(path)
+
+    def get(name):
+        arr = t[name]
+        return jnp.asarray(np.asarray(arr), dtype=dtype)
+
+    def proj(name):  # HF stores [out, in] → we want [in, out]
+        return get(name).T
+
+    L = cfg.num_layers
+    stack = lambda names: jnp.stack(names)  # noqa: E731
+
+    layers: dict = {
+        "attn_norm": stack([get(f"model.layers.{i}.input_layernorm.weight") for i in range(L)]),
+        "mlp_norm": stack([get(f"model.layers.{i}.post_attention_layernorm.weight") for i in range(L)]),
+        "wq": stack([proj(f"model.layers.{i}.self_attn.q_proj.weight") for i in range(L)]),
+        "wk": stack([proj(f"model.layers.{i}.self_attn.k_proj.weight") for i in range(L)]),
+        "wv": stack([proj(f"model.layers.{i}.self_attn.v_proj.weight") for i in range(L)]),
+        "wo": stack([proj(f"model.layers.{i}.self_attn.o_proj.weight") for i in range(L)]),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = stack([get(f"model.layers.{i}.self_attn.q_proj.bias") for i in range(L)])
+        layers["bk"] = stack([get(f"model.layers.{i}.self_attn.k_proj.bias") for i in range(L)])
+        layers["bv"] = stack([get(f"model.layers.{i}.self_attn.v_proj.bias") for i in range(L)])
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["router"] = stack(
+            [proj(f"model.layers.{i}.block_sparse_moe.gate.weight") for i in range(L)])
+        layers["w_gate"] = stack([
+            jnp.stack([proj(f"model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight")
+                       for e in range(E)]) for i in range(L)])
+        layers["w_down"] = stack([
+            jnp.stack([proj(f"model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight")
+                       for e in range(E)]) for i in range(L)])
+        layers["w_up"] = stack([
+            jnp.stack([proj(f"model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight")
+                       for e in range(E)]) for i in range(L)])
+    else:
+        layers["w_gate"] = stack([proj(f"model.layers.{i}.mlp.gate_proj.weight") for i in range(L)])
+        layers["w_up"] = stack([proj(f"model.layers.{i}.mlp.up_proj.weight") for i in range(L)])
+        layers["w_down"] = stack([proj(f"model.layers.{i}.mlp.down_proj.weight") for i in range(L)])
+
+    params = {
+        "embed": get("model.embed_tokens.weight"),
+        "layers": layers,
+        "final_norm": get("model.norm.weight"),
+    }
+    if not cfg.tie_word_embeddings:
+        if "lm_head.weight" in t:
+            params["lm_head"] = proj("lm_head.weight")
+        else:
+            logger.warning("lm_head.weight missing; tying to embeddings")
+            cfg.tie_word_embeddings = True
+    return params
+
+
+def load_model(path: str, dtype=None) -> tuple[ModelConfig, dict]:
+    """Config + params from a local HF model directory."""
+    cfg = ModelConfig.from_pretrained(path)
+    return cfg, load_hf_params(cfg, path, dtype)

@@ -1,0 +1,318 @@
+"""Llama-family forward pass over a paged KV cache — pure JAX, scan-over-layers.
+
+TPU-first design notes (this is the part the reference delegates to vLLM's
+CUDA engine — ref: components/backends/vllm/src/dynamo/vllm/main.py:90-127 —
+and we build natively):
+
+- ONE jitted step handles both chunked prefill and decode: the step computes
+  Q for ``tokens[B, S]`` (S = chunk length, 1 for decode), scatters the new
+  K/V into the flat paged cache via ``slot_map``, then attends over pages
+  gathered through ``block_tables``. Scatter-before-gather makes the current
+  chunk visible to itself, so no separate self-attention path exists.
+- Layers are stacked on a leading L axis and driven by ``lax.scan`` — one
+  trace regardless of depth, fast compiles, XLA-friendly.
+- Static shapes everywhere: S, B and the block-table width W are bucketed by
+  the caller (EngineArgs.bucket_*), caches are fixed-size; padding rows point
+  at the reserved null block 0 and are masked out.
+- Sharding is GSPMD: params/caches carry ``NamedSharding`` over a
+  ("dp","tp") mesh — attention heads and MLP hidden sharded on "tp", batch on
+  "dp"; XLA inserts the collectives (scaling-book recipe, no hand NCCL).
+
+The MXU sees: qkv/o projections and MLP matmuls in bf16 at [B*S, D]×[D, ·];
+attention einsums batched per KV-head group. Softmax runs in f32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.config import ModelConfig
+
+# ---------------------------------------------------------------------------
+# Parameter init / pytree layout
+# ---------------------------------------------------------------------------
+#
+# params = {
+#   "embed":    [V, D]
+#   "layers": {                       (stacked on leading L axis)
+#     "attn_norm": [L, D], "mlp_norm": [L, D],
+#     "wq": [L, D, H*hd], "wk": [L, D, KV*hd], "wv": [L, D, KV*hd],
+#     "wo": [L, H*hd, D],
+#     dense:  "w_gate": [L, D, F], "w_up": [L, D, F], "w_down": [L, F, D]
+#     moe:    "router": [L, D, E], "w_gate": [L, E, D, F], "w_up": [L, E, D, F],
+#             "w_down": [L, E, F, D]
+#     optional bias: "bq": [L, H*hd], "bk": [L, KV*hd], "bv": [L, KV*hd]
+#   },
+#   "final_norm": [D], "lm_head": [D, V] (absent when tied)
+# }
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> dict:
+    """Random-init params with correct shapes/scales (for tests and benches)."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    D, hd = cfg.hidden_size, cfg.head_dim
+    H, KV, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+    F, V, E = cfg.intermediate_size, cfg.vocab_size, cfg.num_experts
+    ks = jax.random.split(key, 12)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((L, D), dtype),
+        "mlp_norm": jnp.ones((L, D), dtype),
+        "wq": w(ks[0], (L, D, H * hd), D),
+        "wk": w(ks[1], (L, D, KV * hd), D),
+        "wv": w(ks[2], (L, D, KV * hd), D),
+        "wo": w(ks[3], (L, H * hd, D), H * hd),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, H * hd), dtype)
+        layers["bk"] = jnp.zeros((L, KV * hd), dtype)
+        layers["bv"] = jnp.zeros((L, KV * hd), dtype)
+    if cfg.is_moe:
+        layers["router"] = w(ks[4], (L, D, E), D)
+        layers["w_gate"] = w(ks[5], (L, E, D, F), D)
+        layers["w_up"] = w(ks[6], (L, E, D, F), D)
+        layers["w_down"] = w(ks[7], (L, E, F, D), F)
+    else:
+        layers["w_gate"] = w(ks[5], (L, D, F), D)
+        layers["w_up"] = w(ks[6], (L, D, F), D)
+        layers["w_down"] = w(ks[7], (L, F, D), F)
+
+    params = {
+        "embed": w(ks[8], (V, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(ks[9], (D, V), D)
+    return params
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
+    """NamedShardings for the params pytree: TP shards heads / MLP hidden.
+
+    The scaling-book recipe: annotate, let XLA place the collectives.
+    """
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layers = {
+        "attn_norm": ns(None, None),
+        "mlp_norm": ns(None, None),
+        "wq": ns(None, None, "tp"),
+        "wk": ns(None, None, "tp"),
+        "wv": ns(None, None, "tp"),
+        "wo": ns(None, "tp", None),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = ns(None, "tp")
+        layers["bk"] = ns(None, "tp")
+        layers["bv"] = ns(None, "tp")
+    if cfg.is_moe:
+        layers["router"] = ns(None, None, None)
+        layers["w_gate"] = ns(None, "tp", None, None)  # experts over tp (EP)
+        layers["w_up"] = ns(None, "tp", None, None)
+        layers["w_down"] = ns(None, "tp", None, None)
+    else:
+        layers["w_gate"] = ns(None, None, "tp")
+        layers["w_up"] = ns(None, None, "tp")
+        layers["w_down"] = ns(None, "tp", None)
+
+    out = {
+        "embed": ns(None, None),
+        "layers": layers,
+        "final_norm": ns(None),
+    }
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = ns(None, "tp")
+    return out
+
+
+def cache_shardings(mesh: Mesh) -> NamedSharding:
+    """KV cache [L, num_slots, KV, hd]: heads sharded on tp, replicated on dp."""
+    return NamedSharding(mesh, P(None, None, "tp", None))
+
+
+def batch_shardings(mesh: Mesh) -> dict:
+    """Per-step batch inputs: batch axis over dp."""
+    return {
+        "tokens": NamedSharding(mesh, P("dp", None)),
+        "positions": NamedSharding(mesh, P("dp", None)),
+        "slot_map": NamedSharding(mesh, P("dp", None)),
+        "block_tables": NamedSharding(mesh, P("dp", None)),
+        "kv_lens": NamedSharding(mesh, P("dp")),
+        "last_idx": NamedSharding(mesh, P("dp")),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward pass
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding, llama convention (half-split). x: [B,S,N,hd]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _paged_attention(q, k_cache_l, v_cache_l, block_tables, positions, kv_lens,
+                     cfg: ModelConfig, block_size: int):
+    """Attention of q [B,S,H,hd] over paged KV.
+
+    Gathers pages [B,W,bs,KV,hd] from the flat cache [num_slots,KV,hd] through
+    block_tables [B,W]; logical key position of gathered index t is t itself
+    (block tables are logically ordered), so masking is pure index math.
+    (This is the XLA path; the Pallas kernel in ops/paged_attention.py is the
+    TPU fast path — same contract.)
+    """
+    B, S, H, hd = q.shape
+    KV = cfg.num_kv_heads
+    G = H // KV
+    W = block_tables.shape[1]
+    T = W * block_size
+
+    # [B, W, bs, KV, hd] -> [B, T, KV, hd]
+    slot_idx = block_tables[:, :, None] * block_size + jnp.arange(block_size)[None, None, :]
+    slot_idx = slot_idx.reshape(B, T)
+    k = k_cache_l[slot_idx]  # [B, T, KV, hd]
+    v = v_cache_l[slot_idx]
+
+    qg = q.reshape(B, S, KV, G, hd)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores / np.sqrt(hd)
+
+    key_pos = jnp.arange(T)
+    q_pos = positions  # [B, S]
+    mask = (key_pos[None, None, :] <= q_pos[:, :, None]) & (
+        key_pos[None, None, :] < kv_lens[:, None, None]
+    )  # [B, S, T]
+    if cfg.sliding_window:
+        mask = mask & (key_pos[None, None, :] > q_pos[:, :, None] - cfg.sliding_window)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)  # [B,KV,G,S,T]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, H, hd).astype(q.dtype)
+
+
+def _mlp_dense(x, lp):
+    h = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
+    return h @ lp["w_down"]
+
+
+def _mlp_moe(x, lp, cfg: ModelConfig):
+    """Token-choice MoE (Mixtral/DeepSeek-style), dense-einsum formulation.
+
+    Computes all experts' outputs weighted by the (sparse) router probs via a
+    one-hot combine — XLA-friendly (no ragged dispatch); the EP fast path
+    (all-to-all over "tp") is a later optimization, this is correct and
+    shardable (experts sharded over "tp" = expert parallelism; XLA reduces
+    over the expert axis).
+    """
+    B, S, D = x.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    logits = (x @ lp["router"]).astype(jnp.float32)  # [B,S,E]
+    topv, topi = jax.lax.top_k(logits, K)
+    gates = jax.nn.softmax(topv, axis=-1)  # [B,S,K]
+    # combine weights [B,S,E]
+    cw = jnp.zeros_like(logits).at[
+        jnp.arange(B)[:, None, None], jnp.arange(S)[None, :, None], topi
+    ].add(gates)
+    # all-experts compute: [E,B,S,F] — fine for modest E; EP shards E over tp
+    h = jnp.einsum("bsd,edf->ebsf", x, lp["w_gate"])
+    u = jnp.einsum("bsd,edf->ebsf", x, lp["w_up"])
+    h = jax.nn.silu(h) * u
+    y = jnp.einsum("ebsf,efd->ebsd", h, lp["w_down"])
+    return jnp.einsum("ebsd,bse->bsd", y, cw.astype(y.dtype))
+
+
+def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
+            last_idx, k_cache, v_cache, *, cfg: ModelConfig, block_size: int):
+    """One engine step.
+
+    Args:
+      tokens:       [B, S] int32 — token ids of the chunk (S=1 for decode).
+      positions:    [B, S] int32 — absolute positions (padding rows: 0).
+      slot_map:     [B, S] int32 — flat cache slot per token (padding → slot 0,
+                    the reserved null block).
+      block_tables: [B, W] int32 — logical→physical block map (padding → 0).
+      kv_lens:      [B] int32 — total valid kv length incl. this chunk.
+      last_idx:     [B] int32 — index in S of each row's last real token.
+      k_cache/v_cache: [L, num_slots, KV, hd] — donated, updated in place.
+
+    Returns: (logits [B, V] f32 at last_idx, k_cache, v_cache)
+    """
+    B, S = tokens.shape
+    D, hd = cfg.hidden_size, cfg.head_dim
+    H, KV = cfg.num_heads, cfg.num_kv_heads
+
+    x = params["embed"][tokens]  # [B,S,D]
+
+    def layer(x, xs):
+        lp, kc, vc = xs
+        h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if "bq" in lp:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = q.reshape(B, S, H, hd)
+        k = k.reshape(B, S, KV, hd)
+        v = v.reshape(B, S, KV, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        flat_slots = slot_map.reshape(B * S)
+        kc = kc.at[flat_slots].set(k.reshape(B * S, KV, hd), mode="drop")
+        vc = vc.at[flat_slots].set(v.reshape(B * S, KV, hd), mode="drop")
+
+        attn = _paged_attention(q, kc, vc, block_tables, positions, kv_lens,
+                                cfg, block_size)
+        x = x + attn.reshape(B, S, H * hd) @ lp["wo"]
+
+        h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            x = x + _mlp_moe(h, lp, cfg)
+        else:
+            x = x + _mlp_dense(h, lp)
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
+
+    x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x_last = x[jnp.arange(B), last_idx]  # [B, D]
+    if cfg.tie_word_embeddings:
+        logits = x_last @ params["embed"].T
+    else:
+        logits = x_last @ params["lm_head"]
+    return logits.astype(jnp.float32), k_cache, v_cache
+
+
+def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None):
+    """Jitted engine step with cache donation (and GSPMD shardings if mesh)."""
+    f = functools.partial(forward, cfg=cfg, block_size=block_size)
+    # donate caches (args 7, 8 → positions in the positional signature)
+    return jax.jit(f, donate_argnums=(7, 8))

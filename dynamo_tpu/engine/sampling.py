@@ -1,0 +1,92 @@
+"""On-device sampling: greedy / temperature / top-k / top-p, per-row params.
+
+Sampling runs inside jit on the [B, V] logits produced by the step fn, so
+only B sampled token ids (plus optional logprobs) cross the device→host
+boundary per step — never the logits. Per-row PRNG keys make per-request
+``seed`` deterministic regardless of batch composition (ref parity:
+SamplingOptions — lib/llm/src/protocols/common.rs:275-330).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+#: static cap for top-k masking (rows with top_k<=0 or >= cap are unrestricted)
+TOP_K_CAP = 64
+
+
+def _mask_top_k(logits, top_k):
+    """Keep each row's top-k logits (k dynamic per row, capped at TOP_K_CAP)."""
+    vals, _ = jax.lax.top_k(logits, TOP_K_CAP)  # [B, CAP] sorted desc
+    k = jnp.clip(top_k, 1, TOP_K_CAP)
+    kth = vals[jnp.arange(logits.shape[0]), k - 1]  # [B]
+    use = (top_k > 0) & (top_k <= TOP_K_CAP)
+    cut = jnp.where(use, kth, -jnp.inf)
+    return jnp.where(logits >= cut[:, None], logits, -jnp.inf)
+
+
+def _mask_top_p(logits, top_p):
+    """Nucleus: keep the smallest prefix of sorted probs with mass >= top_p."""
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep positions where the cumulative mass *before* this token < top_p
+    keep_sorted = (cum - probs) < top_p[:, None]
+    # threshold logit = smallest kept logit per row
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1)
+    use = (top_p > 0.0) & (top_p < 1.0)
+    cut = jnp.where(use, thresh, -jnp.inf)
+    return jnp.where(logits >= cut[:, None], logits, -jnp.inf)
+
+
+def sample(logits, temperature, top_k, top_p, keys):
+    """Sample one token per row.
+
+    Args:
+      logits: [B, V] f32.
+      temperature: [B] f32 (0 → greedy).
+      top_k: [B] i32 (0 → off). top_p: [B] f32 (0 or 1 → off).
+      keys: [B] uint32 pair folded — jax PRNG keys, shape [B, 2].
+    Returns: (tokens [B] i32, logprob_of_token [B] f32)
+    """
+    greedy_tok = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    scaled = _mask_top_k(scaled, top_k)
+    scaled = _mask_top_p(scaled, top_p)
+
+    sampled_tok = jax.vmap(_cat)(keys, scaled)
+
+    tokens = jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
+    logp_all = jax.nn.log_softmax(logits, axis=-1)
+    logp = logp_all[jnp.arange(logits.shape[0]), tokens]
+    return tokens.astype(jnp.int32), logp
+
+
+def _cat(key_data, row_logits):
+    key = jax.random.wrap_key_data(key_data, impl="threefry2x32")
+    return jax.random.categorical(key, row_logits)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def sample_jit(logits, temperature, top_k, top_p, keys):
+    return sample(logits, temperature, top_k, top_p, keys)
+
+
+def make_keys(seeds, steps):
+    """Host helper: per-row threefry key data from (seed, step). [B,2] uint32.
+
+    Pure numpy — any distinct (seed, step) pair is a distinct valid key, so no
+    per-row jax dispatch is needed on the hot decode path.
+    """
+    import numpy as np
+
+    out = np.zeros((len(seeds), 2), dtype=np.uint32)
+    for i, (s, st) in enumerate(zip(seeds, steps)):
+        out[i, 0] = int(s) & 0xFFFFFFFF
+        out[i, 1] = int(st) & 0xFFFFFFFF
+    return out

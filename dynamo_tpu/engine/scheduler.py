@@ -1,0 +1,292 @@
+"""Continuous batching scheduler: admission, chunked prefill, decode batching.
+
+Pure host-side logic (no jax imports) mirroring the semantics of the
+reference's engine schedulers it delegates to, and of its own mocker
+scheduler (ref: lib/llm/src/mocker/scheduler.rs:240 — admission watermark,
+chunked prefill budget, preemption; vLLM-style recompute preemption):
+
+- A sequence's lifecycle: waiting → running (prefill chunks → decode steps)
+  → finished. ``num_computed`` counts tokens whose KV is in the paged cache;
+  ``remaining = len(tokens) - num_computed``; remaining==1 means the next
+  step computes the last token's KV and samples (decode); remaining>1 means
+  a prefill chunk (which also samples iff it reaches the end).
+- Prefix-cache admission: full prompt blocks are matched against the
+  BlockPool by chained sequence hash (same salted-xxh3 domain as the
+  frontend/router — dynamo_tpu/tokens.py), skipping their recompute.
+- KV events: as blocks fill they are registered + reported stored; pool
+  eviction reports removed — feeding the router's radix index exactly like
+  the reference's engines do (ref: kv_router/publisher.rs).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from dynamo_tpu.engine.cache import BlockPool
+from dynamo_tpu.engine.config import EngineArgs
+from dynamo_tpu.protocols import FinishReason, PreprocessedRequest
+from dynamo_tpu.router.protocols import StoredBlock
+from dynamo_tpu.tokens import KV_HASH_SEED, TokenBlockSequence
+
+logger = logging.getLogger("dynamo.engine.scheduler")
+
+
+@dataclass
+class SeqState:
+    request_id: str
+    req: PreprocessedRequest
+    ctx: object  # runtime Context (has .cancelled)
+    sink: object  # asyncio.Queue for outputs (owned by engine)
+    tokens: list[int] = field(default_factory=list)  # prompt + generated
+    prompt_len: int = 0
+    hashes: TokenBlockSequence = None
+    block_table: list[int] = field(default_factory=list)
+    num_computed: int = 0  # tokens whose KV is resident
+    num_registered_blocks: int = 0  # blocks already registered/evented
+    num_cached_prompt: int = 0  # prefix-cache hit tokens (for metrics)
+    generated: int = 0
+    step_idx: int = 0  # sampling step counter (PRNG determinism)
+    finished: Optional[str] = None
+    preemptions: int = 0
+
+    @property
+    def remaining(self) -> int:
+        return len(self.tokens) - self.num_computed
+
+    def sampling_tuple(self):
+        s = self.req.sampling_options
+        return (
+            float(s.temperature if s.temperature is not None else 0.0),
+            int(s.top_k if s.top_k else 0),
+            float(s.top_p if s.top_p is not None else 1.0),
+            s.seed,  # None = unseeded (seed=0 is a valid pinned seed)
+        )
+
+
+@dataclass
+class PrefillWork:
+    seq: SeqState
+    start: int
+    chunk: int  # number of tokens to compute this step
+    sample: bool  # True when the chunk reaches the end of tokens
+
+
+@dataclass
+class StepPlan:
+    prefill: Optional[PrefillWork] = None
+    decode: list[SeqState] = field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return self.prefill is None and not self.decode
+
+
+class Scheduler:
+    """Plans one engine iteration; owns admission/preemption/bookkeeping."""
+
+    def __init__(self, args: EngineArgs, pool: BlockPool,
+                 on_stored: Optional[Callable] = None):
+        self.args = args
+        self.pool = pool
+        self.on_stored = on_stored  # fn(parent_hash, [StoredBlock])
+        self.waiting: deque[SeqState] = deque()
+        self.running: list[SeqState] = []
+        self.prefix_hit_tokens = 0
+        self.prefix_query_tokens = 0
+
+    # -- api ----------------------------------------------------------------
+
+    def add(self, seq: SeqState) -> None:
+        seq.tokens = list(seq.req.token_ids)
+        seq.prompt_len = len(seq.tokens)
+        seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
+                                        salt_hash=KV_HASH_SEED)
+        self.waiting.append(seq)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    def plan(self) -> StepPlan:
+        """Admission + one prefill chunk + the decode batch."""
+        self._reap_cancelled()
+        self._admit()
+        plan = StepPlan()
+
+        budget = self.args.max_num_batched_tokens
+        decode_seqs = [s for s in self.running if s.remaining == 1]
+
+        # ensure each decode seq has a block for its last position; preempt on
+        # allocation failure (victims chosen newest-first, vLLM-style).
+        # _preempt_for may evict a seq we already planned, so membership in
+        # self.running is re-checked before the plan is finalized.
+        ready_decode = []
+        for s in decode_seqs:
+            if s not in self.running:
+                continue  # preempted by an earlier iteration
+            if self._ensure_blocks(s, s.num_computed + 1):
+                ready_decode.append(s)
+            else:
+                if not self._preempt_for(s):
+                    self._preempt(s)
+        plan.decode = [s for s in ready_decode if s in self.running][: self.args.max_num_seqs]
+        budget -= len(plan.decode)
+
+        if self.args.enable_chunked_prefill or not plan.decode:
+            prefill_seqs = [s for s in self.running if s.remaining > 1]
+            for s in prefill_seqs:
+                chunk = min(s.remaining, max(0, budget))
+                if not self.args.enable_chunked_prefill and chunk < s.remaining:
+                    break
+                if chunk <= 0:
+                    break
+                if not self._ensure_blocks(s, s.num_computed + chunk):
+                    # not enough memory even after nothing to preempt → wait
+                    if not self._preempt_for(s):
+                        break
+                    if not self._ensure_blocks(s, s.num_computed + chunk):
+                        break
+                plan.prefill = PrefillWork(
+                    seq=s, start=s.num_computed, chunk=chunk,
+                    sample=(s.num_computed + chunk == len(s.tokens)),
+                )
+                break  # one prefill chunk per step
+        return plan
+
+    # -- post-step bookkeeping ----------------------------------------------
+
+    def commit_computed(self, seq: SeqState, new_num_computed: int) -> None:
+        """Advance num_computed; hash/register/event newly-filled blocks."""
+        old = seq.num_computed
+        seq.num_computed = new_num_computed
+        seq.hashes.extend(seq.tokens[len(seq.hashes): new_num_computed])
+        bs = self.args.block_size
+        full = new_num_computed // bs
+        stored: list[StoredBlock] = []
+        parent = None
+        for i in range(seq.num_registered_blocks, full):
+            blk = seq.hashes.blocks[i]
+            bid = seq.block_table[i]
+            fresh = self.pool.register(bid, blk.sequence_hash, blk.block_hash,
+                                       blk.parent_sequence_hash)
+            if fresh:
+                if not stored:
+                    parent = blk.parent_sequence_hash
+                stored.append(StoredBlock(block_hash=blk.sequence_hash,
+                                          tokens_hash=blk.block_hash))
+        seq.num_registered_blocks = full
+        if stored and self.on_stored:
+            self.on_stored(parent, stored)
+
+    def append_token(self, seq: SeqState, token: int) -> None:
+        seq.tokens.append(token)
+        seq.generated += 1
+        seq.step_idx += 1
+
+    def check_finish(self, seq: SeqState, token: int) -> Optional[str]:
+        sc = seq.req.stop_conditions
+        if not sc.ignore_eos and token in (seq.req.eos_token_ids or []):
+            if (sc.min_tokens or 0) < seq.generated:
+                return FinishReason.EOS
+        if sc.max_tokens is not None and seq.generated >= sc.max_tokens:
+            return FinishReason.LENGTH
+        if seq.num_computed + 1 >= self.args.max_model_len:
+            return FinishReason.LENGTH
+        return None
+
+    def finish(self, seq: SeqState, reason: str) -> None:
+        seq.finished = reason
+        if seq in self.running:
+            self.running.remove(seq)
+        self.pool.release(seq.block_table)
+        seq.block_table = []
+
+    # -- internals -----------------------------------------------------------
+
+    def _reap_cancelled(self) -> None:
+        for s in list(self.running):
+            if getattr(s.ctx, "cancelled", False):
+                self.finish(s, FinishReason.CANCELLED)
+                s.sink.put_nowait(None)  # unblock the generate() consumer
+        for s in list(self.waiting):
+            if getattr(s.ctx, "cancelled", False):
+                s.finished = FinishReason.CANCELLED
+                self.waiting.remove(s)
+                s.sink.put_nowait(None)
+
+    def _admit(self) -> None:
+        bs = self.args.block_size
+        while self.waiting and len(self.running) < self.args.max_num_seqs:
+            seq = self.waiting[0]
+            # watermark: keep a fraction of blocks free (ref: mocker watermark)
+            needed_first = max(1, min(len(seq.tokens), bs) // bs + 1)
+            free_frac = self.pool.num_free_blocks / max(1, self.pool.num_blocks)
+            if (self.pool.num_free_blocks < needed_first
+                    or (self.running and free_frac < self.args.watermark)):
+                break
+            self.waiting.popleft()
+            if seq.num_computed == 0 and not seq.block_table:
+                self._prefix_match(seq)
+            self.running.append(seq)
+
+    def _prefix_match(self, seq: SeqState) -> None:
+        self.prefix_query_tokens += seq.prompt_len
+        if not self.args.enable_prefix_caching:
+            return
+        bs = self.args.block_size
+        # match only full *prompt* blocks, and never the whole prompt — at
+        # least one token must be computed to produce logits
+        matchable = (seq.prompt_len - 1) // bs
+        if matchable <= 0:
+            return
+        probe = TokenBlockSequence.from_tokens(
+            seq.tokens[: matchable * bs], bs, KV_HASH_SEED)
+        hit_blocks = self.pool.match_prefix(probe.sequence_hashes())
+        if not hit_blocks:
+            return
+        n = len(hit_blocks)
+        seq.block_table = list(hit_blocks)
+        seq.num_computed = n * bs
+        seq.num_cached_prompt = n * bs
+        seq.num_registered_blocks = n
+        seq.hashes.extend(seq.tokens[: n * bs])
+        self.prefix_hit_tokens += n * bs
+
+    def _ensure_blocks(self, seq: SeqState, target_tokens: int) -> bool:
+        bs = self.args.block_size
+        need = (target_tokens + bs - 1) // bs - len(seq.block_table)
+        if need <= 0:
+            return True
+        got = self.pool.allocate(need)
+        if got is None:
+            return False
+        seq.block_table.extend(got)
+        return True
+
+    def _preempt_for(self, needy: SeqState) -> bool:
+        """Preempt the newest other running seq to free memory. True if any."""
+        for victim in reversed(self.running):
+            if victim is not needy:
+                self._preempt(victim)
+                return True
+        return False
+
+    def _preempt(self, seq: SeqState) -> None:
+        logger.warning("preempting request %s (recompute)", seq.request_id)
+        self.pool.release(seq.block_table)
+        seq.block_table = []
+        seq.num_computed = 0
+        seq.num_registered_blocks = 0
+        seq.num_cached_prompt = 0
+        seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
+                                        salt_hash=KV_HASH_SEED)
+        seq.preemptions += 1
+        if seq in self.running:
+            self.running.remove(seq)
+        self.waiting.appendleft(seq)

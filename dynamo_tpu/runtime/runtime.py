@@ -34,6 +34,7 @@ class DistributedRuntime:
         self._lease_lock = asyncio.Lock()
         self._keepalive_task: Optional[asyncio.Task] = None
         self._response_server: Optional[ResponseStreamServer] = None
+        self._response_server_lock = asyncio.Lock()
         # subject -> (handler, inflight set); see component._generate_to
         self._local_endpoints: dict = {}
         self._shutdown_event = asyncio.Event()
@@ -112,9 +113,13 @@ class DistributedRuntime:
             pass
 
     async def response_server(self) -> ResponseStreamServer:
-        if self._response_server is None:
-            self._response_server = ResponseStreamServer()
-            await self._response_server.start()
+        # lock: a second caller must not observe the server between
+        # construction and start() (lazy-init race under concurrent generate)
+        async with self._response_server_lock:
+            if self._response_server is None:
+                server = ResponseStreamServer()
+                await server.start()
+                self._response_server = server
         return self._response_server
 
     @property
