@@ -1,0 +1,90 @@
+"""Driver benchmark: steady-state decode throughput of the native JAX engine
+step on one chip. Prints ONE JSON line.
+
+Measures the production jitted step (dynamo_tpu.engine.model.forward) in
+continuous-decode shape: batch of sequences each extending by one token per
+step over the paged KV cache — the hot loop of serving. vs_baseline compares
+against the north-star 2000 decode tok/s/chip target (BASELINE.json; the
+reference publishes no absolute numbers — BASELINE.md).
+"""
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine import model as M
+from dynamo_tpu.engine.config import ModelConfig
+
+BASELINE_TOK_S = 2000.0
+
+
+def main():
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        cfg = ModelConfig.llama3_1b()
+        B, kv_len, iters = 64, 512, 50
+        num_blocks = 64 * 32 + 1  # B seqs × W blocks + null block 0
+    else:  # smoke fallback (CI / no chip)
+        cfg = ModelConfig.tiny()
+        B, kv_len, iters = 8, 64, 10
+        num_blocks = 128
+
+    block_size = 16
+    W = kv_len // block_size
+    dtype = jnp.dtype(cfg.dtype)
+
+    params = M.init_params(cfg, jax.random.key(0))
+    shape = (cfg.num_layers, num_blocks * block_size, cfg.num_kv_heads, cfg.head_dim)
+    k_cache = jnp.zeros(shape, dtype)
+    v_cache = jnp.zeros(shape, dtype)
+
+    # B sequences, each kv_len tokens deep, decoding one token each step
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, 1)), jnp.int32)
+    positions = jnp.full((B, 1), kv_len - 1, jnp.int32)
+    bt = np.zeros((B, W), np.int32)
+    for i in range(B):
+        bt[i] = 1 + i * W + np.arange(W)  # disjoint blocks per seq, 0 = null
+    slot_map = jnp.asarray(bt[:, -1] * block_size + (kv_len - 1) % block_size,
+                           jnp.int32).reshape(B, 1)
+    block_tables = jnp.asarray(bt)
+    kv_lens = jnp.full((B,), kv_len, jnp.int32)
+    last_idx = jnp.zeros((B,), jnp.int32)
+
+    step = jax.jit(functools.partial(M.forward, cfg=cfg, block_size=block_size),
+                   donate_argnums=(7, 8))
+
+    # warmup / compile
+    for _ in range(3):
+        logits, k_cache, v_cache = step(params, tokens, positions, slot_map,
+                                        block_tables, kv_lens, last_idx,
+                                        k_cache, v_cache)
+    logits.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        logits, k_cache, v_cache = step(params, tokens, positions, slot_map,
+                                        block_tables, kv_lens, last_idx,
+                                        k_cache, v_cache)
+    # block_until_ready alone is unreliable over the remote-chip tunnel; a
+    # small device->host fetch forces completion of the donated-cache chain
+    float(logits[0, 0])
+    dt = time.perf_counter() - t0
+
+    tok_s = B * iters / dt
+    print(json.dumps({
+        "metric": f"decode_tok_s_per_chip[{'llama3-1b' if on_tpu else 'tiny-cpu'}"
+                  f",B={B},kv={kv_len},{platform}]",
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

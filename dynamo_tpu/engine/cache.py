@@ -134,8 +134,12 @@ class BlockPool:
         return True
 
     def release(self, block_ids: list[int]) -> None:
-        """Decref; refcount-0 blocks go to the LRU cache (if hashed) or free."""
-        freed_hashes: list[int] = []
+        """Decref; refcount-0 blocks go to the LRU cache (if hashed) or free.
+
+        No removed-event fires here: unhashed/duplicate blocks were never
+        announced as stored, and a hash's home block parks in the LRU (its
+        event fires on eviction in allocate()).
+        """
         for bid in block_ids:
             if bid == NULL_BLOCK:
                 continue
@@ -150,15 +154,8 @@ class BlockPool:
                 self._lru[meta.seq_hash] = bid
                 self._lru.move_to_end(meta.seq_hash)
             else:
-                # duplicate-content or unhashed block: its data vanishes, but a
-                # removed-event only fires if this block *was* the hash's home
-                if meta.seq_hash is not None and self._by_hash.get(meta.seq_hash) == bid:
-                    freed_hashes.append(meta.seq_hash)
-                    self._by_hash.pop(meta.seq_hash, None)
                 self._meta.pop(bid)
                 self._free.append(bid)
-        if freed_hashes and self.on_removed:
-            self.on_removed(freed_hashes)
 
     def clear(self) -> None:
         """Drop the entire prefix cache (admin clear_kv_blocks analog)."""

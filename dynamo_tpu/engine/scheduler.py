@@ -27,7 +27,7 @@ from typing import Callable, Optional
 
 from dynamo_tpu.engine.cache import BlockPool
 from dynamo_tpu.engine.config import EngineArgs
-from dynamo_tpu.protocols import FinishReason, PreprocessedRequest
+from dynamo_tpu.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.router.protocols import StoredBlock
 from dynamo_tpu.tokens import KV_HASH_SEED, TokenBlockSequence
 
@@ -143,7 +143,16 @@ class Scheduler:
             for s in prefill_seqs:
                 chunk = min(s.remaining, max(0, budget))
                 if not self.args.enable_chunked_prefill and chunk < s.remaining:
-                    break
+                    if s.remaining > self.args.max_num_batched_tokens:
+                        # can never fit in one unchunked step: fail it rather
+                        # than wedge the prefill queue forever
+                        self.finish(s, FinishReason.ERROR)
+                        s.sink.put_nowait(LLMEngineOutput(
+                            finish_reason=FinishReason.ERROR,
+                            text="prompt exceeds max_num_batched_tokens "
+                                 "and chunked prefill is disabled"))
+                        s.sink.put_nowait(None)
+                    continue  # a shorter seq may still fit this step
                 if chunk <= 0:
                     break
                 if not self._ensure_blocks(s, s.num_computed + chunk):

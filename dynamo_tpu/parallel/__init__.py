@@ -1,0 +1,12 @@
+"""Parallelism primitives for the TPU engine.
+
+The reference delegates intra-model parallelism to its engines (vLLM/SGLang —
+ref: SURVEY §2.7, components/backends/*/args.py passthrough flags); here it is
+a first-class, native subsystem: a device-mesh abstraction (dp/tp/sp/ep axes),
+GSPMD sharding rules, and ring attention for context parallelism over ICI.
+"""
+
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+from dynamo_tpu.parallel.ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = ["MeshConfig", "make_mesh", "ring_attention", "ring_attention_sharded"]

@@ -1,0 +1,80 @@
+"""Device-mesh construction for dp/tp/sp/ep parallelism.
+
+Axis contract (used consistently across the engine, kernels, and the graft
+entrypoints):
+
+  "dp" — data parallel: engine-replica batch shards. KV caches are disjoint
+         per dp shard; each dp shard emits its own KV events (ref parity:
+         DP-rank-aware workers, components/backends/vllm/src/dynamo/vllm/main.py:221-237).
+  "sp" — sequence/context parallel: long-sequence prefill shards the sequence
+         axis; ring attention rotates KV around the "sp" ring over ICI
+         (the reference has no SP — SURVEY §5.7; this is TPU-native new work).
+  "tp" — tensor parallel: attention heads and MLP hidden dim. XLA inserts the
+         collectives from shardings (scaling-book recipe). MoE experts are
+         also sharded over "tp" (expert parallelism shares the axis; a model
+         with many experts can instead dedicate "ep" by reshaping).
+
+Multi-host: on a real multi-slice deployment the same mesh spans hosts via
+jax.distributed; dp×sp×tp ordering puts tp innermost so its collectives ride
+the fastest ICI links.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. Product must equal the device count in use."""
+
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.sp * self.tp
+
+    @property
+    def axis_names(self) -> tuple:
+        return ("dp", "sp", "tp")
+
+    @staticmethod
+    def for_devices(n: int, *, tp: Optional[int] = None, sp: int = 1,
+                    dp: Optional[int] = None) -> "MeshConfig":
+        """Fill in unspecified axes to cover ``n`` devices.
+
+        Priority when inferring: tp gets the remainder (serving engines are
+        usually TP-dominant), then dp.
+        """
+        if tp is None and dp is None:
+            tp = n // sp
+            dp = 1
+        elif tp is None:
+            tp = n // (sp * dp)
+        elif dp is None:
+            dp = n // (sp * tp)
+        cfg = MeshConfig(dp=dp, sp=sp, tp=tp)
+        if cfg.size != n:
+            raise ValueError(f"mesh {cfg} does not cover {n} devices")
+        return cfg
+
+
+def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None):
+    """Build a jax.sharding.Mesh with ("dp","sp","tp") axes.
+
+    ``devices`` defaults to all local devices; tp is the innermost
+    (fastest-varying) axis so tensor-parallel collectives use adjacent chips.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < cfg.size:
+        raise ValueError(f"mesh {cfg} needs {cfg.size} devices, got {len(devices)}")
+    arr = np.asarray(devices[: cfg.size], dtype=object).reshape(cfg.dp, cfg.sp, cfg.tp)
+    return Mesh(arr, cfg.axis_names)

@@ -1,0 +1,128 @@
+"""Ring attention: context-parallel attention over the "sp" mesh axis.
+
+The reference has NO sequence/context parallelism (SURVEY §5.7 — long context
+there is chunked prefill + KV offload); on TPU, sequence-sharded prefill with
+KV rotating around the ICI ring is the idiomatic way to scale context, so it
+is first-class here.
+
+Algorithm (blockwise / flash-style online softmax, f32 accumulators):
+each of the N devices on the "sp" axis holds a sequence shard of Q and of
+K/V. For N steps, every device attends its local Q against the K/V chunk it
+currently holds, folds the partial result into (m, l, o) running statistics,
+then rotates the K/V chunk to its ring neighbour with ``lax.ppermute``.
+After N steps every Q has seen every K/V exactly once; output = o / l.
+
+The Q/K/V chunks stay resident; only one K/V chunk is in flight per step, so
+ICI traffic per device is S/N · KV · hd per step — overlap with compute is
+XLA's job (the ppermute is independent of the current chunk's einsums).
+
+Causality is pure index math: the chunk a device holds at step t originated
+at ring position (idx - t) mod N, so global key positions are recovered
+without shipping position tensors.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG = -1e30
+
+
+def _local_attend(q, k, v, m, l, o, q_pos, k_pos, scale, causal, kv_len):
+    """One blockwise update. q:[B,Sq,H,hd] k/v:[B,Sk,KV,hd] (GQA-aware).
+
+    m,l: [B,H,Sq] f32 running max / denom; o: [B,Sq,H,hd] f32 numerator.
+    """
+    B, Sq, H, hd = q.shape
+    KV = k.shape[2]
+    G = H // KV
+
+    qg = q.reshape(B, Sq, KV, G, hd).astype(jnp.float32)
+    s = jnp.einsum("bskgd,btkd->bkgst", qg, k.astype(jnp.float32)) * scale
+
+    mask = jnp.ones((Sq, k.shape[1]), bool)
+    if causal:
+        mask = mask & (k_pos[None, :] <= q_pos[:, None])
+    if kv_len is not None:
+        mask = mask & (k_pos[None, :] < kv_len)
+    s = jnp.where(mask[None, None, None], s, _NEG)  # [B,KV,G,Sq,Sk]
+
+    s = s.reshape(B, H, Sq, -1)
+    chunk_max = jnp.max(s, axis=-1)  # [B,H,Sq]
+    new_m = jnp.maximum(m, chunk_max)
+    corr = jnp.exp(m - new_m)
+    p = jnp.exp(s - new_m[..., None])  # [B,H,Sq,Sk]
+    new_l = l * corr + jnp.sum(p, axis=-1)
+    pg = p.reshape(B, KV, G, Sq, -1)
+    pv = jnp.einsum("bkgst,btkd->bskgd", pg, v.astype(jnp.float32)).reshape(B, Sq, H, hd)
+    new_o = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return new_m, new_l, new_o
+
+
+def _ring_body(q, k, v, *, axis_name, causal, kv_len, n_per_shard):
+    """shard_map body: local shards in, local attention output out."""
+    B, Sq, H, hd = q.shape
+    Sk = k.shape[1]
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / np.sqrt(hd)
+
+    q_pos = idx * Sq + jnp.arange(Sq)
+    m = jnp.full((B, H, Sq), _NEG, jnp.float32)
+    l = jnp.zeros((B, H, Sq), jnp.float32)
+    o = jnp.zeros((B, Sq, H, hd), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for t in range(n):
+        src = (idx - t) % n
+        k_pos = src * Sk + jnp.arange(Sk)
+        m, l, o = _local_attend(q, k, v, m, l, o, q_pos, k_pos, scale,
+                                causal, kv_len)
+        if t != n - 1:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
+                   kv_len: Optional[int] = None):
+    """Ring attention over ``axis_name``; call INSIDE a shard_map context.
+
+    Args:
+      q: [B, S_local, H, hd] — local sequence shard of queries.
+      k, v: [B, S_local, KV, hd] — local shard of keys/values (GQA ok).
+      causal: apply causal mask using global positions.
+      kv_len: optional static int — total valid sequence length (masks
+        padding keys in the final shard).
+
+    Returns: [B, S_local, H, hd] attention output for the local Q shard.
+    """
+    return _ring_body(q, k, v, axis_name=axis_name, causal=causal,
+                      kv_len=kv_len, n_per_shard=None)
+
+
+def ring_attention_sharded(q, k, v, mesh, *, causal: bool = True,
+                           kv_len: Optional[int] = None,
+                           axis_name: str = "sp"):
+    """Whole-array entrypoint: shards S over "sp", runs the ring, gathers.
+
+    q: [B, S, H, hd]; k/v: [B, S, KV, hd]; S must divide by mesh "sp" size.
+    Heads stay shardable on "tp" by the caller's surrounding pjit — this
+    shard_map only names the "sp" axis and leaves others to GSPMD.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    body = functools.partial(_ring_body, axis_name=axis_name, causal=causal,
+                             kv_len=kv_len, n_per_shard=None)
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

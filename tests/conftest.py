@@ -2,16 +2,23 @@
 
 Multi-chip hardware is not available in CI; sharding logic is validated on a
 virtual CPU mesh (the same pattern the driver's dryrun_multichip uses).
-This must run before the first `import jax` anywhere in the test session.
+
+The container's sitecustomize imports jax at interpreter startup and pins the
+real single TPU chip (JAX_PLATFORMS=axon), so env vars alone are too late —
+we must override via jax.config before the first backend use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("DYN_LOG", "warning")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -201,3 +201,25 @@ async def test_non_power_of_two_limits():
     results = await asyncio.gather(*(collect(eng, req(p, max_tokens=4)) for p in prompts))
     assert all(len(t) == 4 for t, _ in results)
     await eng.close()
+
+
+async def test_unchunked_oversized_prompt_fails_without_wedging():
+    """Prompt > max_num_batched_tokens with chunking off must error, and a
+    short prompt admitted alongside must still complete (no prefill wedge)."""
+    eng = tiny_engine(enable_chunked_prefill=False)
+    long_req = req(list(range(1, 100)))  # 99 tokens > 64 budget
+    short_req = req(list(range(1, 10)), max_tokens=4)
+
+    async def run(r):
+        toks = []
+        reason = None
+        async for out in eng.generate(r):
+            toks.extend(out.token_ids)
+            if out.finish_reason is not None:
+                reason = out.finish_reason
+        return toks, reason
+
+    (lt, lr), (st, sr) = await asyncio.gather(run(long_req), run(short_req))
+    assert lr == FinishReason.ERROR
+    assert sr == FinishReason.LENGTH and len(st) == 4
+    await eng.close()
