@@ -77,7 +77,8 @@ class AsyncJaxEngine:
         self.pool = BlockPool(nb, args.enable_prefix_caching,
                               on_removed=self._on_removed)
         self.scheduler = Scheduler(args, self.pool, on_stored=self._on_stored)
-        self.step_fn = M.make_step_fn(cfg, args.block_size, mesh)
+        self.step_fn = M.make_step_fn(cfg, args.block_size, mesh,
+                                      use_pallas=args.use_pallas_attention)
         from dynamo_tpu.engine import sampling as S
         self._sampling = S
 

@@ -248,7 +248,8 @@ def _mlp_moe(x, lp, cfg: ModelConfig):
 
 
 def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
-            last_idx, k_cache, v_cache, *, cfg: ModelConfig, block_size: int):
+            last_idx, k_cache, v_cache, *, cfg: ModelConfig, block_size: int,
+            use_pallas: bool = False):
     """One engine step.
 
     Args:
@@ -289,8 +290,15 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         kc = kc.at[flat_slots].set(k.reshape(B * S, KV, hd), mode="drop")
         vc = vc.at[flat_slots].set(v.reshape(B * S, KV, hd), mode="drop")
 
-        attn = _paged_attention(q, kc, vc, block_tables, positions, kv_lens,
-                                cfg, block_size)
+        if use_pallas and S == 1:
+            # decode fast path: Pallas kernel streams pages HBM→VMEM once
+            from dynamo_tpu.ops.paged_attention import paged_attention_decode
+            attn = paged_attention_decode(
+                q[:, 0], kc, vc, block_tables, kv_lens,
+                block_size=block_size)[:, None]
+        else:
+            attn = _paged_attention(q, kc, vc, block_tables, positions,
+                                    kv_lens, cfg, block_size)
         x = x + attn.reshape(B, S, H * hd) @ lp["wo"]
 
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -311,8 +319,20 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
     return logits.astype(jnp.float32), k_cache, v_cache
 
 
-def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None):
-    """Jitted engine step with cache donation (and GSPMD shardings if mesh)."""
-    f = functools.partial(forward, cfg=cfg, block_size=block_size)
+def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,
+                 use_pallas: bool = False):
+    """Jitted engine step with cache donation (and GSPMD shardings if mesh).
+
+    ``use_pallas`` switches the decode (S=1) attention onto the Pallas paged
+    kernel — single-device only for now (under a mesh the kernel would need a
+    shard_map wrapper; the XLA path shards transparently).
+    """
+    from dynamo_tpu.ops.paged_attention import pallas_supported
+
+    use_pallas = (use_pallas and mesh is None
+                  and cfg.sliding_window is None  # kernel lacks window mask
+                  and pallas_supported(cfg.num_kv_heads, cfg.head_dim))
+    f = functools.partial(forward, cfg=cfg, block_size=block_size,
+                          use_pallas=use_pallas)
     # donate caches (args 7, 8 → positions in the positional signature)
     return jax.jit(f, donate_argnums=(7, 8))

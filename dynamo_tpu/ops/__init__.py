@@ -1,0 +1,13 @@
+"""TPU Pallas kernels for the hot ops.
+
+The reference's single CUDA kernel is a paged-KV block copy
+(ref: lib/llm/src/kernels/block_copy.cu:40); its engines' paged attention
+lives in vLLM. Here both are native: a paged-attention decode kernel and a
+block gather/scatter copy kernel, each with an XLA fallback so every code
+path also runs on CPU (interpret mode covers kernel tests in CI).
+"""
+
+from dynamo_tpu.ops.paged_attention import paged_attention_decode
+from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks
+
+__all__ = ["paged_attention_decode", "gather_blocks", "scatter_blocks"]

@@ -1,0 +1,66 @@
+"""Paged-KV block gather/scatter (the reference CUDA kernel's TPU analog).
+
+The reference ships one CUDA kernel — a dimension-aware strided block copy
+used for KV transfer and (de)fragmentation (ref: lib/llm/src/kernels/
+block_copy.cu:40-758). On TPU the same jobs are XLA dynamic gathers/scatters
+over the flat paged cache: XLA already emits single-pass DMA programs for
+these, so the kernels below are thin, jit-friendly contracts used by the
+KVBM offload path (device→host staging) and disagg KV transfer:
+
+  gather_blocks:  cache [L, slots, KV, hd] + ids [n] → [L, n, bs, KV, hd]
+  scatter_blocks: writes such a bundle back into (possibly different) slots
+
+A layout transpose between prefill-TP and decode-TP shardings is the
+``reshard`` helper: gather → logical reshape → device_put under the target
+sharding (XLA inserts the all-to-all).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_blocks(cache: jax.Array, block_ids, *, block_size: int) -> jax.Array:
+    """Pull whole blocks out of the flat paged cache.
+
+    cache: [L, num_slots, KV, hd]; block_ids: [n] int32.
+    Returns [L, n, block_size, KV, hd] (contiguous bundle, transfer-ready).
+    """
+    L, slots, KV, hd = cache.shape
+    block_ids = jnp.asarray(block_ids, jnp.int32)
+    paged = cache.reshape(L, slots // block_size, block_size, KV, hd)
+    return jnp.take(paged, block_ids, axis=1)
+
+
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",), donate_argnums=(0,))
+def _scatter(cache, block_ids, bundle, *, block_size):
+    L, slots, KV, hd = cache.shape
+    paged = cache.reshape(L, slots // block_size, block_size, KV, hd)
+    return paged.at[:, block_ids].set(bundle).reshape(L, slots, KV, hd)
+
+
+def scatter_blocks(cache: jax.Array, block_ids, bundle: jax.Array, *,
+                   block_size: int) -> jax.Array:
+    """Write a gathered bundle into blocks of the cache; returns new cache.
+
+    Shapes as in gather_blocks. The flat cache is donated at the jit
+    boundary (reshapes live inside it), so the write is in-place in HBM —
+    no transient second cache.
+    """
+    return _scatter(cache, jnp.asarray(block_ids, jnp.int32),
+                    bundle.astype(cache.dtype), block_size=block_size)
+
+
+def reshard_bundle(bundle: jax.Array, sharding) -> jax.Array:
+    """Re-lay a KV bundle onto a different sharding (prefill-TP ≠ decode-TP).
+
+    XLA lowers the device_put to the needed collective (all-to-all /
+    all-gather over ICI) — the TPU counterpart of the reference's
+    layout-transpose copy between prefill and decode workers
+    (ref: docs/architecture/disagg_serving.md:103).
+    """
+    return jax.device_put(bundle, sharding)

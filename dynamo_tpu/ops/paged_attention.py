@@ -1,0 +1,199 @@
+"""Pallas TPU paged-attention decode kernel.
+
+One query token per sequence attends over its paged KV cache (the serving
+hot loop). The XLA fallback in engine/model.py materializes the gathered
+K/V [B, W·bs, KV, hd] through HBM; this kernel instead streams pages
+HBM→VMEM with double-buffered async DMA and folds them into an online
+softmax, so K/V traffic is read exactly once and never re-materialized.
+
+Contract matches engine/model._paged_attention for S=1:
+  q            [B, H, hd]
+  k/v cache    [num_slots, KV, hd]   (flat paged layout, slot = block·bs+off)
+  block_tables [B, W] int32          (0 = reserved null block)
+  kv_lens      [B] int32             (valid kv length per sequence)
+  → out        [B, H, hd]
+
+TPU mapping: Mosaic requires DMA slices tile-aligned in the trailing dims
+(lane = 128), which a [bs, KV, hd≤64] page view violates. So the kernel
+works in the flattened [slots, KV·hd] view (KV·hd is a lane multiple for
+real GQA models: 8·64=512): pages DMA as [bs, KV·hd]; scores come from one
+MXU matmul of a block-expanded query Q̃ [H, KV·hd] (head h carries its q
+only in its own KV segment, zeros elsewhere, so contraction over KV·hd
+reduces to the correct per-group dot); PV accumulates in the [H, KV·hd]
+domain and the correct segment per head is gathered outside the kernel.
+The redundant-segment FLOPs are noise — decode attention is DMA-bound.
+
+Falls back to the XLA path when shapes can't align (KV·hd % 128 ≠ 0).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG = -1e30
+_LANE = 128
+
+
+def _decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
+                   qexp_ref,  # [1, H, KVhd] VMEM
+                   kcache_ref, vcache_ref,  # [slots, KVhd] HBM
+                   out_ref,  # [1, H, KVhd] VMEM
+                   kbuf, vbuf, dma_sem,  # scratch [D, bs, KVhd] / [D, 2]
+                   *, bs: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    kv_len = kv_lens_ref[b]
+    num_pages = (kv_len + bs - 1) // bs
+    H = qexp_ref.shape[1]
+    KVhd = qexp_ref.shape[2]
+
+    D = kbuf.shape[0]  # pipeline depth: D page fetches always in flight
+
+    def start_dma(w):
+        blk = block_tables_ref[b, w]
+        slot = w % D
+        pltpu.make_async_copy(
+            kcache_ref.at[pl.ds(blk * bs, bs)], kbuf.at[slot],
+            dma_sem.at[slot, 0]).start()
+        pltpu.make_async_copy(
+            vcache_ref.at[pl.ds(blk * bs, bs)], vbuf.at[slot],
+            dma_sem.at[slot, 1]).start()
+
+    def wait_dma(w):
+        slot = w % D
+        pltpu.make_async_copy(kbuf.at[slot], kbuf.at[slot],
+                              dma_sem.at[slot, 0]).wait()
+        pltpu.make_async_copy(vbuf.at[slot], vbuf.at[slot],
+                              dma_sem.at[slot, 1]).wait()
+
+    # D-deep rotating pipeline — scattered pages are independent, so keeping
+    # D fetches in flight hides per-DMA grant latency (a 2-deep double
+    # buffer serializes W·B small copies on that latency).
+    prefill_n = jnp.minimum(num_pages, D)
+    jax.lax.fori_loop(0, prefill_n, lambda w, c: (start_dma(w), c)[1], 0)
+
+    qexp = qexp_ref[0].astype(jnp.float32)  # [H, KVhd], block-expanded
+
+    def body(w, carry):
+        m, l, acc = carry  # [H,1] f32, [H,1] f32, [H,KVhd] f32
+        wait_dma(w)
+        kpage = kbuf[w % D].astype(jnp.float32)  # [bs, KVhd]
+        vpage = vbuf[w % D].astype(jnp.float32)
+
+        # scores: contraction over KVhd == per-group q·k (q̃ is segment-masked)
+        s = jax.lax.dot_general(
+            qexp, kpage, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [H, bs]
+
+        key_pos = w * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s = jnp.where(key_pos < kv_len, s, _NEG)
+
+        chunk_max = jnp.max(s, axis=1, keepdims=True)
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)  # [H, bs]
+        new_l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, vpage, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [H, KVhd]
+
+        # refill this slot for page w+D — issued after the loads above, so
+        # the in-order instruction stream can't overwrite data still in use
+        @pl.when(w + D < num_pages)
+        def _():
+            start_dma(w + D)
+
+        return new_m, new_l, acc * corr + pv
+
+    m0 = jnp.full((H, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((H, 1), jnp.float32)
+    acc0 = jnp.zeros((H, KVhd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+
+    out_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+
+
+def pallas_supported(num_kv_heads: int, head_dim: int) -> bool:
+    return (num_kv_heads * head_dim) % _LANE == 0
+
+
+def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
+                           block_size: int, interpret: bool = False):
+    """Decode-step paged attention. See module docstring for the contract."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, hd = q.shape
+    slots, KV, _ = k_cache.shape
+    G = H // KV
+    KVhd = KV * hd
+    bs = block_size
+    if not pallas_supported(KV, hd):
+        return paged_attention_decode_xla(
+            q, k_cache, v_cache, block_tables, kv_lens, block_size=bs)
+    interpret = interpret or jax.default_backend() != "tpu"
+
+    # block-expand q: head h's vector sits in its own KV segment, zeros else
+    seg = jnp.arange(H) // G  # [H]
+    onehot = jax.nn.one_hot(seg, KV, dtype=q.dtype)  # [H, KV]
+    qexp = jnp.einsum("bhd,hk->bhkd", q, onehot).reshape(B, H, KVhd)
+    qexp = qexp * jnp.asarray(1.0 / np.sqrt(hd), q.dtype)  # fold in the scale
+
+    W = block_tables.shape[1]
+    D = min(W, 16)  # pipeline depth (VMEM budget: 2·D·bs·KVhd·dtype bytes)
+    kernel = functools.partial(_decode_kernel, bs=bs)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, KVhd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+        ],
+        out_specs=pl.BlockSpec((1, H, KVhd), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((D, bs, KVhd), k_cache.dtype),  # D pages in flight
+            pltpu.VMEM((D, bs, KVhd), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((D, 2)),
+        ],
+    )
+    out_full = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, KVhd), q.dtype),
+        interpret=interpret,
+    )(block_tables, kv_lens,
+      qexp, k_cache.reshape(slots, KVhd), v_cache.reshape(slots, KVhd))
+
+    # pick each head's own KV segment back out
+    out_full = out_full.reshape(B, H, KV, hd)
+    return jnp.take_along_axis(
+        out_full, seg[None, :, None, None], axis=2).reshape(B, H, hd)
+
+
+def paged_attention_decode_xla(q, k_cache, v_cache, block_tables, kv_lens, *,
+                               block_size: int):
+    """Reference/fallback path (same math, gather through XLA)."""
+    B, H, hd = q.shape
+    KV = k_cache.shape[1]
+    G = H // KV
+    W = block_tables.shape[1]
+    T = W * block_size
+
+    slot_idx = (block_tables[:, :, None] * block_size
+                + jnp.arange(block_size)[None, None, :]).reshape(B, T)
+    k = k_cache[slot_idx]  # [B, T, KV, hd]
+    v = v_cache[slot_idx]
+    qg = q.reshape(B, KV, G, hd).astype(jnp.float32)
+    s = jnp.einsum("bkgd,btkd->bkgt", qg, k.astype(jnp.float32)) / np.sqrt(hd)
+    mask = jnp.arange(T)[None] < kv_lens[:, None]  # [B, T]
+    s = jnp.where(mask[:, None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgt,btkd->bkgd", p, v.astype(jnp.float32))
+    return o.reshape(B, H, hd).astype(q.dtype)

@@ -64,7 +64,7 @@ def _local_attend(q, k, v, m, l, o, q_pos, k_pos, scale, causal, kv_len):
     return new_m, new_l, new_o
 
 
-def _ring_body(q, k, v, *, axis_name, causal, kv_len, n_per_shard):
+def _ring_body(q, k, v, *, axis_name, causal, kv_len):
     """shard_map body: local shards in, local attention output out."""
     B, Sq, H, hd = q.shape
     Sk = k.shape[1]
@@ -104,7 +104,7 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     Returns: [B, S_local, H, hd] attention output for the local Q shard.
     """
     return _ring_body(q, k, v, axis_name=axis_name, causal=causal,
-                      kv_len=kv_len, n_per_shard=None)
+                      kv_len=kv_len)
 
 
 def ring_attention_sharded(q, k, v, mesh, *, causal: bool = True,
@@ -119,7 +119,7 @@ def ring_attention_sharded(q, k, v, mesh, *, causal: bool = True,
     from jax.sharding import PartitionSpec as P
 
     body = functools.partial(_ring_body, axis_name=axis_name, causal=causal,
-                             kv_len=kv_len, n_per_shard=None)
+                             kv_len=kv_len)
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -223,3 +223,26 @@ async def test_unchunked_oversized_prompt_fails_without_wedging():
     assert lr == FinishReason.ERROR
     assert sr == FinishReason.LENGTH and len(st) == 4
     await eng.close()
+
+
+async def test_pallas_attention_engine_equivalence():
+    """Engine outputs with the Pallas decode kernel (interpret on CPU) must
+    match the XLA attention path token-for-token."""
+    prompt = list(range(1, 40))
+    # KV*hd must be a lane multiple for the kernel: tiny() has KV=2, hd=16 →
+    # 32 lanes → kernel falls back; use a cfg with KV*hd = 128
+    cfg = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=64,
+                      dtype="float32", max_position_embeddings=512)
+    outs = []
+    for use_pallas in (False, True):
+        args = EngineArgs(block_size=8, num_blocks=64, max_num_seqs=4,
+                          max_num_batched_tokens=64, max_model_len=128,
+                          use_pallas_attention=use_pallas,
+                          prefill_buckets=(8, 16, 32, 64),
+                          decode_batch_buckets=(1, 2, 4))
+        eng = AsyncJaxEngine(cfg, args)
+        toks, reason = await collect(eng, req(prompt))
+        outs.append(toks)
+        await eng.close()
+    assert outs[0] == outs[1]
