@@ -1,0 +1,83 @@
+"""Disagg worker handlers: decode-first conditional disaggregation.
+
+Mirrors the reference's decode/prefill handler pair (ref:
+components/backends/vllm/src/dynamo/vllm/handlers.py:89-250): the decode
+worker receives the routed request; when a prefill fleet exists and the
+prompt is long enough (DisaggConfig.max_local_prefill_length), it issues a
+max_tokens=1 prefill request round-robin to the prefill component, receives
+the first token + KV bundle, injects the pages into its own cache, and
+decodes. Prefill worker downtime degrades gracefully to local prefill.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from dynamo_tpu.disagg.protocols import DisaggConfig, PrefillResponse
+from dynamo_tpu.protocols import LLMEngineOutput, PreprocessedRequest
+
+logger = logging.getLogger("dynamo.disagg")
+
+
+class PrefillWorkerHandler:
+    """Serves the prefill component's ``generate`` endpoint."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    async def generate(self, request: dict, ctx):
+        req = PreprocessedRequest.from_wire(request)
+        resp = await self.engine.prefill_extract(req, ctx)
+        yield resp.to_wire()
+
+
+class DecodeWorkerHandler:
+    """Serves the decode (or aggregated) component's ``generate`` endpoint.
+
+    ``prefill_client`` is a runtime Client bound to the prefill component's
+    generate endpoint, or None for pure aggregated serving.
+    """
+
+    def __init__(self, engine, prefill_client=None,
+                 config: Optional[DisaggConfig] = None):
+        self.engine = engine
+        self.prefill_client = prefill_client
+        self.config = config or DisaggConfig()
+
+    def _use_remote_prefill(self, req: PreprocessedRequest) -> bool:
+        if self.prefill_client is None:
+            return False
+        if not self.prefill_client.available_ids():
+            return False  # no prefill workers up: serve locally (elastic xPyD)
+        return len(req.token_ids) > self.config.max_local_prefill_length
+
+    async def generate(self, request: dict, ctx):
+        req = PreprocessedRequest.from_wire(request)
+        if self._use_remote_prefill(req):
+            yielded = False
+            try:
+                async for out in self._generate_disagg(req, ctx):
+                    yielded = True
+                    yield out
+                return
+            except Exception:
+                if yielded:  # mid-stream failure: surface, don't duplicate
+                    raise
+                logger.exception("remote prefill failed; falling back local")
+        async for out in self.engine.generate(req, ctx):
+            yield out.to_wire()
+
+    async def _generate_disagg(self, req: PreprocessedRequest, ctx):
+        logger.debug("remote prefill: %d prompt tokens → prefill fleet",
+                     len(req.token_ids))
+        stream = await self.prefill_client.generate(
+            req.to_wire(), mode="round_robin")
+        presp = None
+        async for frame in stream:
+            presp = PrefillResponse.from_wire(frame)
+            break
+        if presp is None:
+            raise RuntimeError("prefill worker returned no response")
+        async for out in self.engine.generate_injected(req, presp, ctx):
+            yield out.to_wire()

@@ -1,0 +1,79 @@
+"""Disagg wire types: KV bundle serialization + config.
+
+KvBundle is the TPU analog of NIXL's block-descriptor payload (ref:
+docs/architecture/disagg_serving.md:92-103): the gathered KV pages of one
+request, shipped as raw bytes + shape/dtype header over the response plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class DisaggConfig:
+    """Conditional-disaggregation knobs (ref: disagg_router.rs:13 —
+    DisaggRouterConf.max_local_prefill_length, watched at runtime)."""
+
+    #: prompts at or below this length prefill locally on the decode engine
+    max_local_prefill_length: int = 512
+    #: control-plane key watched for runtime updates
+    KEY = "public/components/disagg_router/max_local_prefill_length"
+
+
+@dataclass
+class KvBundle:
+    """One request's KV pages: [L, n_blocks, bs, KV, hd] k and v arrays."""
+
+    k: np.ndarray
+    v: np.ndarray
+    num_tokens: int  # valid tokens covered (may end mid-block)
+    block_size: int
+
+    def to_wire(self) -> dict:
+        return {
+            "shape": list(self.k.shape),
+            "dtype": str(self.k.dtype),
+            "k": self.k.tobytes(),
+            "v": self.v.tobytes(),
+            "num_tokens": self.num_tokens,
+            "block_size": self.block_size,
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "KvBundle":
+        import ml_dtypes  # bf16 numpy arrays round-trip through ml_dtypes
+
+        dtype = np.dtype(getattr(ml_dtypes, d["dtype"], None) or d["dtype"])
+        shape = tuple(d["shape"])
+        k = np.frombuffer(d["k"], dtype=dtype).reshape(shape)
+        v = np.frombuffer(d["v"], dtype=dtype).reshape(shape)
+        return KvBundle(k=k, v=v, num_tokens=d["num_tokens"],
+                        block_size=d["block_size"])
+
+
+@dataclass
+class PrefillResponse:
+    """First token + transfer payload returned by a prefill worker
+    (the reference's kv_transfer_params analog, ref: handlers.py:236-245)."""
+
+    token_id: int
+    logprob: Optional[float]
+    bundle: Optional[KvBundle]
+
+    def to_wire(self) -> dict:
+        return {
+            "token_id": self.token_id,
+            "logprob": self.logprob,
+            "kv": self.bundle.to_wire() if self.bundle else None,
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "PrefillResponse":
+        kv = d.get("kv")
+        return PrefillResponse(
+            token_id=d["token_id"], logprob=d.get("logprob"),
+            bundle=KvBundle.from_wire(kv) if kv else None)

@@ -108,6 +108,135 @@ class AsyncJaxEngine:
             if out.finish_reason is not None:
                 return
 
+    # ------------------------------------------------------- disagg support
+
+    async def prefill_extract(self, req: PreprocessedRequest, ctx=None):
+        """Run prefill only and hand back (first token, logprob, KvBundle).
+
+        The disagg prefill-worker path (ref: vllm/handlers.py:211-245 —
+        max_tokens=1 generation returning kv_transfer_params); here the
+        "transfer params" ARE the gathered pages.
+        """
+        import dataclasses
+
+        from dynamo_tpu.disagg.protocols import KvBundle, PrefillResponse
+        from dynamo_tpu.ops.block_copy import gather_blocks
+
+        self._ensure_loop()
+        sc = dataclasses.replace(req.stop_conditions, max_tokens=1,
+                                 min_tokens=1, ignore_eos=True)
+        preq = dataclasses.replace(req, stop_conditions=sc)
+        sink: asyncio.Queue = asyncio.Queue()
+        seq = SeqState(request_id=f"seq-{next(self._seq_counter)}",
+                       req=preq, ctx=ctx or _NullCtx(), sink=sink,
+                       hold_blocks=True)
+        self.scheduler.add(seq)
+        self._wake.set()
+        token, logp = None, None
+        try:
+            while True:
+                out = await sink.get()
+                if out is None:
+                    break
+                if out.token_ids:
+                    token, logp = out.token_ids[0], (out.log_probs or [None])[0]
+                if out.finish_reason is not None:
+                    break
+            if token is None:
+                return PrefillResponse(token_id=-1, logprob=None, bundle=None)
+            bs = self.args.block_size
+            n = (seq.prompt_len + bs - 1) // bs
+            ids = seq.block_table[:n]
+            kb = gather_blocks(self.k_cache, ids, block_size=bs)
+            vb = gather_blocks(self.v_cache, ids, block_size=bs)
+            # gather pads the id list to a power of two (compile-cache
+            # friendliness); slice back to the real block count host-side
+            bundle = KvBundle(k=np.asarray(kb)[:, :n], v=np.asarray(vb)[:, :n],
+                              num_tokens=seq.prompt_len, block_size=bs)
+            return PrefillResponse(token_id=token, logprob=logp, bundle=bundle)
+        finally:
+            # covers cancellation at any point: pending/running seqs are
+            # reaped with their blocks; finished ones release the held blocks
+            self.scheduler.abort(seq)
+            self._wake.set()
+
+    async def generate_injected(self, req: PreprocessedRequest, prefill,
+                                ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        """Decode a request whose prompt KV arrives as a KvBundle.
+
+        Falls back to a full local generate when the bundle can't be placed
+        (allocation failure or block-size mismatch).
+        """
+        from dynamo_tpu.ops.block_copy import scatter_blocks
+
+        bundle = prefill.bundle
+        bs = self.args.block_size
+        if bundle is None or bundle.block_size != bs or prefill.token_id < 0:
+            async for out in self.generate(req, ctx):
+                yield out
+            return
+
+        self._ensure_loop()
+        L, slots, KV, hd = self.k_cache.shape
+        if bundle.k.shape[0] != L or bundle.k.shape[3:] != (KV, hd):
+            logger.warning("KV bundle dims %s mismatch cache %s; local prefill",
+                           bundle.k.shape, self.k_cache.shape)
+            async for out in self.generate(req, ctx):
+                yield out
+            return
+        # respect admission limits: injection bypasses the waiting queue, so
+        # apply the seq cap + watermark here and fall back to the queued path
+        free_frac = self.pool.num_free_blocks / max(1, self.pool.num_blocks)
+        if (len(self.scheduler.running) >= self.args.max_num_seqs
+                or free_frac < self.args.watermark):
+            async for out in self.generate(req, ctx):
+                yield out
+            return
+        n = bundle.k.shape[1]
+        ids = self.pool.allocate(n)
+        if ids is None:  # memory pressure: recompute prefill locally
+            async for out in self.generate(req, ctx):
+                yield out
+            return
+        try:
+            self.k_cache = scatter_blocks(self.k_cache, ids, bundle.k,
+                                          block_size=bs)
+            self.v_cache = scatter_blocks(self.v_cache, ids, bundle.v,
+                                          block_size=bs)
+        except Exception:
+            self.pool.release(ids)
+            logger.exception("KV bundle scatter failed; local prefill")
+            async for out in self.generate(req, ctx):
+                yield out
+            return
+
+        sink: asyncio.Queue = asyncio.Queue()
+        seq = SeqState(request_id=f"seq-{next(self._seq_counter)}",
+                       req=req, ctx=ctx or _NullCtx(), sink=sink)
+        self.scheduler.add_prefilled(seq, ids)
+
+        # the prefill worker's token is the stream's first output
+        first = LLMEngineOutput(token_ids=[prefill.token_id],
+                                log_probs=[prefill.logprob]
+                                if prefill.logprob is not None else None)
+        self.scheduler.append_token(seq, prefill.token_id)
+        reason = self.scheduler.check_finish(seq, prefill.token_id)
+        if reason is not None:
+            first.finish_reason = reason
+            self.scheduler.finish(seq, reason)
+            yield first
+            return
+        yield first
+
+        self._wake.set()
+        while True:
+            out = await sink.get()
+            if out is None:
+                return
+            yield out
+            if out.finish_reason is not None:
+                return
+
     def _ensure_loop(self) -> None:
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(self._run())

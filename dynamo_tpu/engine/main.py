@@ -1,0 +1,149 @@
+"""``python -m dynamo_tpu.engine.main`` — run a native JAX engine worker.
+
+The TPU peer of the reference's engine backends (ref: components/backends/
+vllm/src/dynamo/vllm/main.py:62-321): joins the control plane, builds the
+engine (optionally sharded over a dp/sp/tp mesh), serves ``generate``,
+registers the model, publishes KV events + load metrics, and supports the
+three disagg roles:
+
+  --role aggregated   one engine does prefill+decode (default)
+  --role decode       decode worker; delegates long prefills to the prefill
+                      component when its workers exist
+  --role prefill      prefill worker; serves PrefillResponse payloads
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.config import setup_logging
+
+
+def build_engine(cli, cfg: ModelConfig, args: EngineArgs):
+    """Construct the engine BEFORE joining the control plane: param init /
+    cache allocation block the event loop long enough to starve the lease
+    keepalive, which would expire the primary lease mid-registration."""
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+    mesh = None
+    if args.tp_size * args.dp_size > 1:
+        from dynamo_tpu.parallel import MeshConfig, make_mesh
+        mesh = make_mesh(MeshConfig(dp=args.dp_size, sp=1, tp=args.tp_size))
+
+    params = None
+    if cli.model_path:
+        from dynamo_tpu.engine.loader import load_hf_params
+        params = load_hf_params(cfg, cli.model_path)
+
+    return AsyncJaxEngine(cfg, args, params=params, mesh=mesh)
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="dynamo-tpu JAX engine worker")
+    ap.add_argument("--model", default="jax-model", help="served model name")
+    ap.add_argument("--model-path", default=None,
+                    help="HF checkpoint dir (config.json + safetensors); "
+                         "omit for random weights (testing)")
+    ap.add_argument("--arch", default=None,
+                    choices=[None, "tiny", "llama3_1b", "llama3_8b", "llama3_70b"],
+                    help="canned architecture when no --model-path")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default=None,
+                    help="default: backend / prefill by role")
+    ap.add_argument("--role", default="aggregated",
+                    choices=["aggregated", "decode", "prefill"])
+    ap.add_argument("--prefill-component", default="prefill")
+    ap.add_argument("--max-local-prefill-length", type=int, default=512)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=None)
+    ap.add_argument("--max-num-seqs", type=int, default=64)
+    ap.add_argument("--max-num-batched-tokens", type=int, default=2048)
+    ap.add_argument("--max-model-len", type=int, default=4096)
+    ap.add_argument("--tp-size", type=int, default=1)
+    ap.add_argument("--dp-size", type=int, default=1)
+    ap.add_argument("--use-pallas-attention", action="store_true")
+    ap.add_argument("--no-prefix-caching", action="store_true")
+    cli = ap.parse_args()
+
+    if cli.model_path:
+        cfg = ModelConfig.from_pretrained(cli.model_path)
+    else:
+        cfg = getattr(ModelConfig, cli.arch or "tiny")()
+    args = EngineArgs(
+        block_size=cli.block_size, num_blocks=cli.num_blocks,
+        max_num_seqs=cli.max_num_seqs,
+        max_num_batched_tokens=cli.max_num_batched_tokens,
+        max_model_len=cli.max_model_len,
+        enable_prefix_caching=not cli.no_prefix_caching,
+        tp_size=cli.tp_size, dp_size=cli.dp_size,
+        use_pallas_attention=cli.use_pallas_attention,
+    )
+
+    engine = build_engine(cli, cfg, args)  # heavy JAX work first (see above)
+    runtime = await DistributedRuntime.create()
+    lease = await runtime.primary_lease()
+    engine.event_cb = KvEventPublisher(
+        runtime.plane, worker_id=lease,
+        kv_block_size=args.block_size).publish_sync
+    engine.metrics_cb = WorkerMetricsPublisher(
+        runtime.plane, worker_id=lease).publish_sync
+
+    component = cli.component or (
+        "prefill" if cli.role == "prefill" else "backend")
+    ns = runtime.namespace(cli.namespace)
+    ep = ns.component(component).endpoint("generate")
+
+    if cli.role == "prefill":
+        from dynamo_tpu.disagg.handlers import PrefillWorkerHandler
+        handler = PrefillWorkerHandler(engine)
+        serve = handler.generate
+    else:
+        from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+        from dynamo_tpu.disagg.protocols import DisaggConfig
+        prefill_client = None
+        if cli.role == "decode":
+            pc = ns.component(cli.prefill_component).endpoint("generate")
+            prefill_client = await pc.client().start()
+        handler = DecodeWorkerHandler(
+            engine, prefill_client,
+            DisaggConfig(max_local_prefill_length=cli.max_local_prefill_length))
+        serve = handler.generate
+
+    handle = await ep.serve_endpoint(serve, lease_id=lease)
+
+    if cli.role != "prefill":  # prefill fleet is internal, not a model server
+        card = ModelDeploymentCard(
+            display_name=cli.model,
+            kv_cache_block_size=args.block_size,
+            eos_token_ids=[2],
+            tokenizer_ref="test" if not cli.model_path else cli.model_path,
+        )
+        card.runtime_config.total_kv_blocks = engine.num_blocks
+        card.runtime_config.max_num_seqs = args.max_num_seqs
+        card.runtime_config.max_num_batched_tokens = args.max_num_batched_tokens
+        await register_llm(runtime, ep, card, lease_id=lease)
+
+    print("WORKER_READY", flush=True)
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await handle.stop(graceful=True)
+    await engine.close()
+    await runtime.shutdown()
+
+
+def main():
+    setup_logging()
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

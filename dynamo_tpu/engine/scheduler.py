@@ -51,6 +51,8 @@ class SeqState:
     step_idx: int = 0  # sampling step counter (PRNG determinism)
     finished: Optional[str] = None
     preemptions: int = 0
+    #: disagg: keep KV blocks alive past finish (owner gathers then releases)
+    hold_blocks: bool = False
 
     @property
     def remaining(self) -> int:
@@ -94,6 +96,7 @@ class Scheduler:
         self.on_stored = on_stored  # fn(parent_hash, [StoredBlock])
         self.waiting: deque[SeqState] = deque()
         self.running: list[SeqState] = []
+        self._aborted: set = set()  # reaped at next plan() like cancellation
         self.prefix_hit_tokens = 0
         self.prefix_query_tokens = 0
 
@@ -213,18 +216,52 @@ class Scheduler:
         seq.finished = reason
         if seq in self.running:
             self.running.remove(seq)
+        if not seq.hold_blocks:
+            self.pool.release(seq.block_table)
+            seq.block_table = []
+
+    def release_held(self, seq: SeqState) -> None:
+        """Free the blocks of a finished hold_blocks sequence."""
         self.pool.release(seq.block_table)
         seq.block_table = []
 
+    def add_prefilled(self, seq: SeqState, block_table: list[int]) -> None:
+        """Admit a sequence whose prompt KV was computed elsewhere (disagg:
+        decode worker receives prefill's pages already scattered into
+        ``block_table``). Registers/hashes the prompt blocks so prefix cache
+        and KV events behave exactly as if prefill ran locally."""
+        seq.tokens = list(seq.req.token_ids)
+        seq.prompt_len = len(seq.tokens)
+        seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
+                                        salt_hash=KV_HASH_SEED)
+        seq.block_table = list(block_table)
+        self.running.append(seq)
+        self.commit_computed(seq, seq.prompt_len)
+
     # -- internals -----------------------------------------------------------
 
+    def abort(self, seq: SeqState) -> None:
+        """Owner vanished (e.g. prefill_extract cancelled): guarantee the
+        seq's blocks return to the pool no matter what state it is in."""
+        if seq.finished is not None:
+            if seq.block_table:
+                self.release_held(seq)
+            return
+        seq.hold_blocks = False  # eventual finish() must release
+        self._aborted.add(id(seq))
+
     def _reap_cancelled(self) -> None:
+        def dead(s):
+            return getattr(s.ctx, "cancelled", False) or id(s) in self._aborted
+
         for s in list(self.running):
-            if getattr(s.ctx, "cancelled", False):
+            if dead(s):
+                self._aborted.discard(id(s))
                 self.finish(s, FinishReason.CANCELLED)
                 s.sink.put_nowait(None)  # unblock the generate() consumer
         for s in list(self.waiting):
-            if getattr(s.ctx, "cancelled", False):
+            if dead(s):
+                self._aborted.discard(id(s))
                 s.finished = FinishReason.CANCELLED
                 self.waiting.remove(s)
                 s.sink.put_nowait(None)

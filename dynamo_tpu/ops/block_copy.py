@@ -19,18 +19,34 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def _pad_pow2_ids(block_ids: np.ndarray) -> np.ndarray:
+    """Pad an id list to the next power of two by repeating the last id —
+    duplicate gathers/scatters of the same block are idempotent, and the
+    bounded shape set keeps the XLA compile cache from growing per prompt
+    length (the engine pads all other shapes the same way)."""
+    n = len(block_ids)
+    p = 1
+    while p < n:
+        p *= 2
+    if p == n:
+        return block_ids
+    return np.concatenate([block_ids, np.repeat(block_ids[-1:], p - n)])
 
 
 def gather_blocks(cache: jax.Array, block_ids, *, block_size: int) -> jax.Array:
     """Pull whole blocks out of the flat paged cache.
 
     cache: [L, num_slots, KV, hd]; block_ids: [n] int32.
-    Returns [L, n, block_size, KV, hd] (contiguous bundle, transfer-ready).
+    Returns [L, P, block_size, KV, hd] where P = next pow2 ≥ n (trailing
+    entries repeat the last block; slice host-side if exact n is needed).
     """
     L, slots, KV, hd = cache.shape
-    block_ids = jnp.asarray(block_ids, jnp.int32)
+    ids = _pad_pow2_ids(np.asarray(block_ids, np.int32))
     paged = cache.reshape(L, slots // block_size, block_size, KV, hd)
-    return jnp.take(paged, block_ids, axis=1)
+    return jnp.take(paged, jnp.asarray(ids), axis=1)
 
 
 import functools
@@ -43,16 +59,24 @@ def _scatter(cache, block_ids, bundle, *, block_size):
     return paged.at[:, block_ids].set(bundle).reshape(L, slots, KV, hd)
 
 
-def scatter_blocks(cache: jax.Array, block_ids, bundle: jax.Array, *,
+def scatter_blocks(cache: jax.Array, block_ids, bundle, *,
                    block_size: int) -> jax.Array:
     """Write a gathered bundle into blocks of the cache; returns new cache.
 
-    Shapes as in gather_blocks. The flat cache is donated at the jit
-    boundary (reshapes live inside it), so the write is in-place in HBM —
-    no transient second cache.
+    bundle: [L, n, bs, KV, hd] (np or jax). The flat cache is donated at the
+    jit boundary (reshapes live inside it), so the write is in-place in HBM —
+    no transient second cache. ids/bundle are pow2-padded (idempotent
+    duplicate writes) to bound the compile cache.
     """
-    return _scatter(cache, jnp.asarray(block_ids, jnp.int32),
-                    bundle.astype(cache.dtype), block_size=block_size)
+    ids = np.asarray(block_ids, np.int32)
+    n = len(ids)
+    pids = _pad_pow2_ids(ids)
+    if len(pids) != n:
+        pad = np.repeat(np.asarray(bundle[:, -1:]), len(pids) - n, axis=1)
+        bundle = np.concatenate([np.asarray(bundle), pad], axis=1)
+    return _scatter(cache, jnp.asarray(pids),
+                    jnp.asarray(bundle).astype(cache.dtype),
+                    block_size=block_size)
 
 
 def reshard_bundle(bundle: jax.Array, sharding) -> jax.Array:

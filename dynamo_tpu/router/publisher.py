@@ -27,6 +27,23 @@ from dynamo_tpu.router.protocols import (
 logger = logging.getLogger("dynamo.kv_publisher")
 
 
+def _spawn_publish(owner, coro) -> None:
+    """Task-spawn that survives GC (asyncio keeps only weak task refs) and
+    logs failures instead of dropping them as never-retrieved exceptions."""
+    tasks = getattr(owner, "_inflight_publishes", None)
+    if tasks is None:
+        tasks = owner._inflight_publishes = set()
+    task = asyncio.get_running_loop().create_task(coro)
+    tasks.add(task)
+
+    def _done(t):
+        tasks.discard(t)
+        if not t.cancelled() and t.exception() is not None:
+            logger.warning("publish failed: %r", t.exception())
+
+    task.add_done_callback(_done)
+
+
 class KvEventPublisher:
     def __init__(self, plane, worker_id: int, kv_block_size: int, stream: str = KV_EVENTS_STREAM):
         self.plane = plane
@@ -56,6 +73,10 @@ class KvEventPublisher:
     async def publish_cleared(self) -> None:
         await self.publish(KvCacheEvent.clear(self._next_id()))
 
+    def publish_sync(self, event: KvCacheEvent) -> None:
+        """Fire-and-forget adapter for engines' synchronous event callbacks."""
+        _spawn_publish(self, self.publish(event))
+
 
 class WorkerMetricsPublisher:
     def __init__(self, plane, worker_id: int, subject: str = KV_METRICS_SUBJECT):
@@ -66,6 +87,9 @@ class WorkerMetricsPublisher:
     async def publish(self, metrics: ForwardPassMetrics) -> None:
         wire = {"worker_id": self.worker_id, "metrics": metrics.to_wire()}
         await self.plane.publish(self.subject, msgpack.packb(wire))
+
+    def publish_sync(self, metrics: ForwardPassMetrics) -> None:
+        _spawn_publish(self, self.publish(metrics))
 
 
 class MetricsAggregator:

@@ -53,12 +53,14 @@ def test_gather_scatter_roundtrip():
     L, nb, bs, KV, hd = 2, 16, 4, 2, 8
     cache = jnp.arange(L * nb * bs * KV * hd, dtype=jnp.float32).reshape(
         L, nb * bs, KV, hd)
-    ids = jnp.asarray([3, 7, 1], jnp.int32)
-    bundle = gather_blocks(cache, ids, block_size=bs)
-    assert bundle.shape == (L, 3, bs, KV, hd)
+    ids = [3, 7, 1]
+    bundle = np.asarray(gather_blocks(cache, ids, block_size=bs))
+    assert bundle.shape == (L, 4, bs, KV, hd)  # pow2-padded (last id repeats)
+    np.testing.assert_array_equal(bundle[:, 2], bundle[:, 3])
+    bundle = bundle[:, : len(ids)]  # exact-n view, like the transfer path
     # write the bundle into different slots of an empty cache
     dst = jnp.zeros_like(cache)
-    new_ids = jnp.asarray([0, 2, 5], jnp.int32)
+    new_ids = [0, 2, 5]
     dst = scatter_blocks(dst, new_ids, bundle, block_size=bs)
-    out = gather_blocks(dst, new_ids, block_size=bs)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(bundle))
+    out = np.asarray(gather_blocks(dst, new_ids, block_size=bs))[:, : len(ids)]
+    np.testing.assert_array_equal(out, bundle)
