@@ -133,6 +133,17 @@ class BlockPool:
         self._by_hash[seq_hash] = block_id
         return True
 
+    def acquire(self, block_ids: list[int]) -> None:
+        """Incref blocks (e.g. pin for an async offload gather); pairs with
+        release(). Cached refcount-0 blocks are pulled out of the LRU."""
+        for bid in block_ids:
+            meta = self._meta.get(bid)
+            if meta is None:
+                continue
+            if (meta.ref_count == 0 and meta.seq_hash is not None):
+                self._lru.pop(meta.seq_hash, None)
+            meta.ref_count += 1
+
     def release(self, block_ids: list[int]) -> None:
         """Decref; refcount-0 blocks go to the LRU cache (if hashed) or free.
 

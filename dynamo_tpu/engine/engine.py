@@ -74,9 +74,19 @@ class AsyncJaxEngine:
         self.k_cache, self.v_cache = allocate_device_cache(
             cfg, nb, args.block_size, mesh)
 
+        self.kvbm = None
+        if args.kvbm_host_bytes > 0 and args.enable_prefix_caching:
+            from dynamo_tpu.kvbm import KvbmManager
+            self.kvbm = KvbmManager(args.kvbm_host_bytes,
+                                    disk_dir=args.kvbm_disk_dir,
+                                    disk_bytes=args.kvbm_disk_bytes)
+        self._offload_tasks: set = set()
+
         self.pool = BlockPool(nb, args.enable_prefix_caching,
                               on_removed=self._on_removed)
-        self.scheduler = Scheduler(args, self.pool, on_stored=self._on_stored)
+        self.scheduler = Scheduler(
+            args, self.pool, on_stored=self._on_stored,
+            onboard_cb=self._onboard if self.kvbm is not None else None)
         self.step_fn = M.make_step_fn(cfg, args.block_size, mesh,
                                       use_pallas=args.use_pallas_attention)
         from dynamo_tpu.engine import sampling as S
@@ -249,6 +259,9 @@ class AsyncJaxEngine:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        if self._offload_tasks:
+            await asyncio.gather(*list(self._offload_tasks),
+                                 return_exceptions=True)
 
     # ------------------------------------------------------------ main loop
 
@@ -395,9 +408,123 @@ class AsyncJaxEngine:
 
     # ------------------------------------------------------------- events
 
-    def _on_stored(self, parent_hash, blocks: list[StoredBlock]) -> None:
+    def _on_stored(self, parent_hash, blocks: list[StoredBlock],
+                   block_ids: Optional[list[int]] = None) -> None:
         if self.event_cb:
             self.event_cb(KvCacheEvent.stored(next(self._event_id), parent_hash, blocks))
+        if self.kvbm is not None and block_ids:
+            hashes = [b.block_hash for b in blocks]
+            fresh = [(h, bid) for h, bid in zip(hashes, block_ids)
+                     if h not in self.kvbm]
+            if fresh:
+                self._spawn_offload([h for h, _ in fresh],
+                                    [bid for _, bid in fresh])
+
+    # ----------------------------------------------------- KVBM offload/onboard
+
+    def _spawn_promote(self, hashes: list) -> None:
+        """G3→G2 in a worker thread (np.load off the event loop)."""
+        if getattr(self, "_promoting", None) is None:
+            self._promoting = set()
+        todo = [h for h in hashes if h not in self._promoting]
+        if not todo:
+            return
+        self._promoting.update(todo)
+
+        async def run():
+            try:
+                # reverse order: if the host tier can't hold the whole run,
+                # it must end up holding the EARLIEST blocks — a prefix is
+                # only usable from its first block
+                for h in reversed(todo):
+                    await asyncio.to_thread(self.kvbm.get, h)  # get() promotes
+            except Exception:
+                logger.exception("KVBM disk promotion failed")
+            finally:
+                self._promoting.difference_update(todo)
+
+        task = asyncio.get_running_loop().create_task(run())
+        self._offload_tasks.add(task)
+        task.add_done_callback(self._offload_tasks.discard)
+
+    def _spawn_offload(self, seq_hashes: list, block_ids: list[int]) -> None:
+        """G1→G2: pin the blocks, gather their pages once, park on host."""
+        self.pool.acquire(block_ids)
+        task = asyncio.get_running_loop().create_task(
+            self._offload(seq_hashes, block_ids))
+        self._offload_tasks.add(task)
+        task.add_done_callback(self._offload_tasks.discard)
+
+    async def _offload(self, seq_hashes: list, block_ids: list[int]) -> None:
+        from dynamo_tpu.ops.block_copy import gather_blocks
+
+        try:
+            bs = self.args.block_size
+            kb = gather_blocks(self.k_cache, block_ids, block_size=bs)
+            vb = gather_blocks(self.v_cache, block_ids, block_size=bs)
+
+            def work():  # host transfer + tier writes off the event loop
+                kbh, vbh = np.asarray(kb), np.asarray(vb)
+                for i, h in enumerate(seq_hashes):
+                    # copies, not views: a view would pin the whole
+                    # pow2-padded gather buffer past the tier byte budget
+                    self.kvbm.put(h, np.ascontiguousarray(kbh[:, i]),
+                                  np.ascontiguousarray(vbh[:, i]))
+
+            await asyncio.to_thread(work)
+        except Exception:
+            logger.exception("KVBM offload failed")
+        finally:
+            self.pool.release(block_ids)
+
+    def _onboard(self, probe, start: int, end: int) -> list[int]:
+        """G2→G1 at admission: missing prefix blocks found in the HOST tier
+        are scattered into fresh device blocks (synchronous — it replaces a
+        much more expensive recompute). Disk-resident blocks are NOT read
+        here — np.load inside plan() would stall every in-flight decode —
+        instead a background promotion pulls them G3→G2 so the next
+        admission of the prefix hits host."""
+        from dynamo_tpu.ops.block_copy import scatter_blocks
+
+        hashes = probe.sequence_hashes()[start:end]
+        ks, vs = [], []
+        for i, h in enumerate(hashes):
+            e = self.kvbm.get_host(h)
+            if e is None:
+                if self.kvbm.in_disk(h):
+                    self._spawn_promote(hashes[i:])
+                break
+            ks.append(e[0])
+            vs.append(e[1])
+        if not ks:
+            return []
+        m = len(ks)
+        ids = self.pool.allocate(m)
+        if ids is None:
+            return []
+        bs = self.args.block_size
+        try:
+            self.k_cache = scatter_blocks(self.k_cache, ids, np.stack(ks, 1),
+                                          block_size=bs)
+            self.v_cache = scatter_blocks(self.v_cache, ids, np.stack(vs, 1),
+                                          block_size=bs)
+        except Exception:
+            self.pool.release(ids)
+            logger.exception("KVBM onboard scatter failed")
+            return []
+        stored = []
+        parent = probe.blocks[start].parent_sequence_hash if start < len(probe.blocks) else None
+        for i, bid in enumerate(ids):
+            blk = probe.blocks[start + i]
+            if self.pool.register(bid, blk.sequence_hash, blk.block_hash,
+                                  blk.parent_sequence_hash):
+                stored.append(StoredBlock(block_hash=blk.sequence_hash,
+                                          tokens_hash=blk.block_hash))
+        self.kvbm.onboarded_blocks += m
+        if stored and self.event_cb:  # the worker owns these blocks again
+            self.event_cb(KvCacheEvent.stored(
+                next(self._event_id), parent, stored))
+        return ids
 
     def _on_removed(self, seq_hashes) -> None:
         if self.event_cb is None:

@@ -69,6 +69,10 @@ async def amain():
     ap.add_argument("--dp-size", type=int, default=1)
     ap.add_argument("--use-pallas-attention", action="store_true")
     ap.add_argument("--no-prefix-caching", action="store_true")
+    ap.add_argument("--kvbm-host-gb", type=float, default=0.0,
+                    help="host-DRAM KV tier size (0 = off)")
+    ap.add_argument("--kvbm-disk-dir", default=None)
+    ap.add_argument("--kvbm-disk-gb", type=float, default=0.0)
     cli = ap.parse_args()
 
     if cli.model_path:
@@ -83,6 +87,9 @@ async def amain():
         enable_prefix_caching=not cli.no_prefix_caching,
         tp_size=cli.tp_size, dp_size=cli.dp_size,
         use_pallas_attention=cli.use_pallas_attention,
+        kvbm_host_bytes=int(cli.kvbm_host_gb * (1 << 30)),
+        kvbm_disk_dir=cli.kvbm_disk_dir,
+        kvbm_disk_bytes=int(cli.kvbm_disk_gb * (1 << 30)),
     )
 
     engine = build_engine(cli, cfg, args)  # heavy JAX work first (see above)

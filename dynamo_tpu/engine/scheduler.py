@@ -90,10 +90,15 @@ class Scheduler:
     """Plans one engine iteration; owns admission/preemption/bookkeeping."""
 
     def __init__(self, args: EngineArgs, pool: BlockPool,
-                 on_stored: Optional[Callable] = None):
+                 on_stored: Optional[Callable] = None,
+                 onboard_cb: Optional[Callable] = None):
         self.args = args
         self.pool = pool
-        self.on_stored = on_stored  # fn(parent_hash, [StoredBlock])
+        self.on_stored = on_stored  # fn(parent_hash, [StoredBlock], [block_id])
+        #: fn(probe: TokenBlockSequence, start_block, end_block) -> [block_id]
+        #: — KVBM onboard hook: device-misses found in host/disk tiers come
+        #: back as freshly scattered device blocks extending the prefix hit
+        self.onboard_cb = onboard_cb
         self.waiting: deque[SeqState] = deque()
         self.running: list[SeqState] = []
         self._aborted: set = set()  # reaped at next plan() like cancellation
@@ -181,6 +186,7 @@ class Scheduler:
         bs = self.args.block_size
         full = new_num_computed // bs
         stored: list[StoredBlock] = []
+        stored_ids: list[int] = []
         parent = None
         for i in range(seq.num_registered_blocks, full):
             blk = seq.hashes.blocks[i]
@@ -192,9 +198,10 @@ class Scheduler:
                     parent = blk.parent_sequence_hash
                 stored.append(StoredBlock(block_hash=blk.sequence_hash,
                                           tokens_hash=blk.block_hash))
+                stored_ids.append(bid)
         seq.num_registered_blocks = full
         if stored and self.on_stored:
-            self.on_stored(parent, stored)
+            self.on_stored(parent, stored, stored_ids)
 
     def append_token(self, seq: SeqState, token: int) -> None:
         seq.tokens.append(token)
@@ -294,6 +301,9 @@ class Scheduler:
         probe = TokenBlockSequence.from_tokens(
             seq.tokens[: matchable * bs], bs, KV_HASH_SEED)
         hit_blocks = self.pool.match_prefix(probe.sequence_hashes())
+        if self.onboard_cb is not None and len(hit_blocks) < matchable:
+            hit_blocks = hit_blocks + self.onboard_cb(
+                probe, len(hit_blocks), matchable)
         if not hit_blocks:
             return
         n = len(hit_blocks)

@@ -1,0 +1,99 @@
+"""KvbmManager: offload/onboard orchestration across tiers.
+
+Offload path (ref: block_manager/offload.rs:4-34 — offload on registration,
+bounded in-flight): when the engine registers full blocks, their pages are
+gathered device→host once and inserted into G2; G2 evictions cascade into
+G3 when a disk tier is configured.
+
+Onboard path (ref: block_manager.rs:144-150): at admission, prompt prefix
+blocks missing from the device pool but present in G2/G3 are scattered back
+into freshly allocated device blocks, extending the prefix hit without
+recompute — the "KV offload TTFT win" the reference reports
+(docs/architecture/architecture.md:95).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+import numpy as np
+
+from dynamo_tpu.kvbm.tiers import DiskTier, HostTier
+
+logger = logging.getLogger("dynamo.kvbm")
+
+
+class KvbmManager:
+    """Thread-safe: disk promotion runs in worker threads while the engine's
+    event loop serves the host tier, so every tier access takes the lock."""
+
+    def __init__(self, host_bytes: int, disk_dir: Optional[str] = None,
+                 disk_bytes: int = 0):
+        self.host = HostTier(host_bytes)
+        self.disk = DiskTier(disk_dir, disk_bytes) if (disk_dir and disk_bytes) else None
+        self.offloaded_blocks = 0
+        self.onboarded_blocks = 0
+        self._lock = threading.Lock()
+
+    # -- queries -------------------------------------------------------------
+
+    def __contains__(self, h: int) -> bool:
+        with self._lock:
+            return h in self.host or (self.disk is not None and h in self.disk)
+
+    def in_disk(self, h: int) -> bool:
+        with self._lock:
+            return self.disk is not None and h in self.disk
+
+    def match_prefix(self, seq_hashes: list[int]) -> int:
+        """Longest leading run of hashes resident in any tier."""
+        n = 0
+        for h in seq_hashes:
+            if h not in self:
+                break
+            n += 1
+        return n
+
+    # -- offload (G1 → G2 → G3) ----------------------------------------------
+
+    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> None:
+        with self._lock:
+            if h in self.host:
+                return
+            self.offloaded_blocks += 1
+            for eh, ek, ev in self.host.put(h, k, v):
+                if self.disk is not None:
+                    self.disk.put(eh, ek, ev)
+
+    # -- onboard (G2/G3 → caller) --------------------------------------------
+
+    def get_host(self, h: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """Host-tier-only lookup — cheap enough for the admission path."""
+        with self._lock:
+            return self.host.get(h)
+
+    def get(self, h: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        with self._lock:
+            e = self.host.get(h)
+            if e is not None:
+                return e
+            if self.disk is not None:
+                e = self.disk.get(h)
+                if e is not None:
+                    # promote back to host (it is hot again)
+                    for eh, ek, ev in self.host.put(h, e[0], e[1]):
+                        self.disk.put(eh, ek, ev)
+                    return e
+            return None
+
+    def stats(self) -> dict:
+        return {
+            "host_blocks": len(self.host),
+            "host_bytes": self.host.used,
+            "disk_blocks": len(self.disk) if self.disk is not None else 0,
+            "disk_bytes": self.disk.used if self.disk is not None else 0,
+            "offloaded_blocks": self.offloaded_blocks,
+            "onboarded_blocks": self.onboarded_blocks,
+        }

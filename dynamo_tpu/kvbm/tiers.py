@@ -1,0 +1,139 @@
+"""Storage tiers: host-DRAM and disk block stores with byte-budget LRU.
+
+Each entry is one full KV block: (k, v) pages shaped [L, bs, KV, hd], keyed
+by the block's chained SequenceHash — the same identity the prefix cache and
+the router's radix index use, so a block found in any tier is usable by any
+sequence sharing the prefix (ref: block_manager/pool/managed.rs — inactive
+pool keyed by sequence hash).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("dynamo.kvbm")
+
+
+class HostTier:
+    """G2: host-DRAM LRU block store with a byte budget."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = capacity_bytes
+        self.used = 0
+        self._store: "OrderedDict[int, tuple[np.ndarray, np.ndarray]]" = OrderedDict()
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._store
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> list[tuple]:
+        """Insert; returns evicted (hash, k, v) entries (cascade candidates)."""
+        if h in self._store:
+            self._store.move_to_end(h)
+            return []
+        size = k.nbytes + v.nbytes
+        if size > self.capacity:
+            return []  # can never fit: drop without flushing the tier
+        evicted = []
+        while self._store and self.used + size > self.capacity:
+            eh, (ek, ev) = self._store.popitem(last=False)
+            self.used -= ek.nbytes + ev.nbytes
+            evicted.append((eh, ek, ev))
+        self._store[h] = (k, v)
+        self.used += size
+        return evicted
+
+    def get(self, h: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        e = self._store.get(h)
+        if e is not None:
+            self._store.move_to_end(h)
+        return e
+
+    def clear(self):
+        self._store.clear()
+        self.used = 0
+
+
+class DiskTier:
+    """G3: NVMe block store — one .npz file per block, LRU by byte budget."""
+
+    def __init__(self, directory: str, capacity_bytes: int):
+        self.dir = directory
+        self.capacity = capacity_bytes
+        self.used = 0
+        self._index: "OrderedDict[int, int]" = OrderedDict()  # hash -> nbytes
+        os.makedirs(directory, exist_ok=True)
+        # reconcile stale files from previous runs: the index starts empty,
+        # so anything on disk is unreachable — delete it or the directory
+        # grows past the budget across restarts
+        for name in os.listdir(directory):
+            if name.endswith(".npz"):
+                try:
+                    os.unlink(os.path.join(directory, name))
+                except OSError:
+                    pass
+
+    def _path(self, h: int) -> str:
+        return os.path.join(self.dir, f"{h & 0xFFFFFFFFFFFFFFFF:016x}.npz")
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> None:
+        if h in self._index:
+            self._index.move_to_end(h)
+            return
+        size = k.nbytes + v.nbytes
+        if size > self.capacity:
+            return  # can never fit: drop without flushing the tier
+        while self._index and self.used + size > self.capacity:
+            eh, esize = self._index.popitem(last=False)
+            self.used -= esize
+            try:
+                os.unlink(self._path(eh))
+            except OSError:
+                pass
+        # bf16 has no npy codec — store raw bytes + dtype string
+        np.savez(self._path(h),
+                 k=k.view(np.uint8), v=v.view(np.uint8),
+                 shape=np.asarray(k.shape), dtype=str(k.dtype))
+        self._index[h] = size
+        self.used += size
+
+    def get(self, h: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        if h not in self._index:
+            return None
+        try:
+            with np.load(self._path(h), allow_pickle=False) as z:
+                import ml_dtypes
+
+                dtype = np.dtype(getattr(ml_dtypes, str(z["dtype"]), None)
+                                 or str(z["dtype"]))
+                shape = tuple(z["shape"])
+                k = z["k"].view(dtype).reshape(shape)
+                v = z["v"].view(dtype).reshape(shape)
+        except Exception:
+            logger.exception("disk tier read failed for %x", h)
+            self._index.pop(h, None)
+            return None
+        self._index.move_to_end(h)
+        return k, v
+
+    def clear(self):
+        for h in list(self._index):
+            try:
+                os.unlink(self._path(h))
+            except OSError:
+                pass
+        self._index.clear()
+        self.used = 0
