@@ -1,0 +1,22 @@
+"""SLA planner: load-prediction-driven autoscaling of prefill/decode fleets.
+
+Rebuild of the reference planner (ref: components/planner/src/dynamo/planner/
+utils/planner_core.py:55-560): observe traffic each adjustment interval,
+predict the next interval's load, interpolate per-chip capacity from
+pre-deployment profiling, compute prefill/decode replica counts against the
+TTFT/ITL SLAs, and apply through a connector (Kubernetes in production, a
+control-plane-backed virtual connector in tests).
+"""
+
+from dynamo_tpu.planner.load_predictor import (
+    ArimaPredictor, ConstantPredictor, MovingAveragePredictor, make_predictor,
+)
+from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+from dynamo_tpu.planner.planner_core import Planner, PlannerConfig, Observation
+from dynamo_tpu.planner.virtual_connector import VirtualConnector
+
+__all__ = [
+    "ArimaPredictor", "ConstantPredictor", "MovingAveragePredictor",
+    "make_predictor", "PerfInterpolator", "Planner", "PlannerConfig",
+    "Observation", "VirtualConnector",
+]

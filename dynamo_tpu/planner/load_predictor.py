@@ -1,0 +1,90 @@
+"""Load predictors (ref: planner/utils/load_predictor.py:1-177).
+
+The reference offers constant / ARIMA / Prophet backends. Prophet is a heavy
+optional dep there and adds nothing at the horizon the planner uses (one
+adjustment interval ahead), so here: constant, moving-average, and an
+AR-with-trend predictor fit by least squares — the useful span of the ARIMA
+behavior without the statsmodels dependency.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+
+class BasePredictor:
+    def __init__(self, window: int = 64, minimum_data_points: int = 3):
+        self.window = window
+        self.minimum_data_points = minimum_data_points
+        self.data: deque = deque(maxlen=window)
+
+    def add_data_point(self, value: float) -> None:
+        if value is not None and np.isfinite(value):
+            self.data.append(float(value))
+
+    def get_last_value(self) -> Optional[float]:
+        return self.data[-1] if self.data else None
+
+    def predict_next(self) -> Optional[float]:
+        raise NotImplementedError
+
+
+class ConstantPredictor(BasePredictor):
+    """Next value = last value."""
+
+    def predict_next(self) -> Optional[float]:
+        return self.get_last_value()
+
+
+class MovingAveragePredictor(BasePredictor):
+    def __init__(self, window: int = 16, **kw):
+        super().__init__(window=window, **kw)
+
+    def predict_next(self) -> Optional[float]:
+        if not self.data:
+            return None
+        return float(np.mean(self.data))
+
+
+class ArimaPredictor(BasePredictor):
+    """AR(p)+trend via least squares — one-step-ahead forecast.
+
+    Falls back to the last value until minimum_data_points accumulate.
+    """
+
+    def __init__(self, window: int = 64, order: int = 3, **kw):
+        super().__init__(window=window, **kw)
+        self.order = order
+
+    def predict_next(self) -> Optional[float]:
+        n = len(self.data)
+        if n == 0:
+            return None
+        if n < max(self.minimum_data_points, self.order + 2):
+            return self.get_last_value()
+        y = np.asarray(self.data, np.float64)
+        p = self.order
+        # design matrix: lagged values + time index + bias
+        rows = []
+        targets = []
+        for t in range(p, n):
+            rows.append(np.concatenate([y[t - p:t], [t, 1.0]]))
+            targets.append(y[t])
+        X = np.asarray(rows)
+        b, *_ = np.linalg.lstsq(X, np.asarray(targets), rcond=None)
+        x_next = np.concatenate([y[n - p:], [n, 1.0]])
+        pred = float(x_next @ b)
+        if not np.isfinite(pred):
+            return self.get_last_value()
+        return max(0.0, pred)
+
+
+def make_predictor(kind: str, **kw) -> BasePredictor:
+    return {
+        "constant": ConstantPredictor,
+        "moving_average": MovingAveragePredictor,
+        "arima": ArimaPredictor,
+    }[kind](**kw)

@@ -1,0 +1,64 @@
+"""Per-chip capacity interpolation from pre-deployment profiling.
+
+ref: planner/utils/perf_interpolation.py + benchmarks/profiler/profile_sla.py
+— the profiler sweeps a single prefill replica (TTFT vs request rate) and a
+single decode replica (ITL vs per-chip token throughput at varying
+concurrency); the planner inverts those curves: "what per-replica load keeps
+us inside the SLA?"
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class ProfilePoint:
+    load: float  # requests/s (prefill) or tokens/s (decode) per replica
+    latency_ms: float  # TTFT (prefill) or ITL (decode)
+
+
+@dataclass
+class PerfInterpolator:
+    """Monotone latency-vs-load curve with inversion."""
+
+    points: list = field(default_factory=list)
+
+    def __post_init__(self):
+        self.points = sorted(
+            (p if isinstance(p, ProfilePoint) else ProfilePoint(*p)
+             for p in self.points),
+            key=lambda p: p.load)
+
+    @property
+    def loads(self):
+        return np.asarray([p.load for p in self.points])
+
+    @property
+    def lats(self):
+        return np.asarray([p.latency_ms for p in self.points])
+
+    def latency_at(self, load: float) -> float:
+        """Interpolated latency at a per-replica load (clamped to the sweep)."""
+        return float(np.interp(load, self.loads, self.lats))
+
+    def max_load_under(self, latency_target_ms: float) -> float:
+        """Largest per-replica load whose latency stays ≤ target.
+
+        0 means even an idle replica misses the SLA (impossible target);
+        the last sweep point means the target never binds in-range.
+        """
+        loads, lats = self.loads, self.lats
+        if latency_target_ms < lats[0]:
+            return 0.0
+        if latency_target_ms >= lats[-1]:
+            return float(loads[-1])
+        # walk segments; curve is assumed non-decreasing in load
+        idx = int(np.searchsorted(lats, latency_target_ms, side="right")) - 1
+        lo, hi = self.points[idx], self.points[idx + 1]
+        if hi.latency_ms == lo.latency_ms:
+            return float(hi.load)
+        frac = (latency_target_ms - lo.latency_ms) / (hi.latency_ms - lo.latency_ms)
+        return float(lo.load + frac * (hi.load - lo.load))

@@ -1,0 +1,178 @@
+"""Planner core: observe → predict → compute replicas → adjust.
+
+ref: planner_core.py:194 (observe), :259 (compute), :355 (adjust), :414
+(loop). Replica math: predicted request rate × predicted ISL gives prefill
+token demand; the prefill interpolator bounds the per-replica request rate
+that holds the TTFT SLA. Predicted decode token throughput (req rate × OSL)
+against the per-replica decode capacity at the ITL SLA gives decode
+replicas. Correction factors absorb systematic under/over-prediction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.planner.load_predictor import make_predictor
+from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+
+logger = logging.getLogger("dynamo.planner")
+
+
+@dataclass
+class Observation:
+    """One interval's traffic sample (the planner's Prometheus pull)."""
+
+    request_rate: float  # req/s
+    isl: float  # mean input sequence length
+    osl: float  # mean output sequence length
+    ttft_ms: Optional[float] = None
+    itl_ms: Optional[float] = None
+
+
+@dataclass
+class PlannerConfig:
+    ttft_sla_ms: float = 200.0
+    itl_sla_ms: float = 20.0
+    adjustment_interval_s: float = 30.0
+    predictor: str = "arima"
+    min_prefill_replicas: int = 1
+    max_prefill_replicas: int = 64
+    min_decode_replicas: int = 1
+    max_decode_replicas: int = 64
+    #: multiplicative headroom on predicted load (ref: correction factors)
+    prefill_correction: float = 1.0
+    decode_correction: float = 1.0
+    #: mean ISL the prefill sweep was profiled at; >0 scales prefill demand
+    #: by predicted_isl/profiled_isl so longer prompts grow the fleet
+    profiled_isl: float = 0.0
+    #: scale down only after this many consecutive lower intervals (damping)
+    scale_down_patience: int = 2
+
+
+@dataclass
+class Decision:
+    prefill_replicas: int
+    decode_replicas: int
+
+
+class Planner:
+    """Pure decision core — connectors apply the Decision; a MetricsSource
+    feeds observe(). Fully synchronous and unit-testable (ref pattern:
+    tests/planner/test_replica_calculation.py)."""
+
+    def __init__(self, cfg: PlannerConfig, prefill_perf: PerfInterpolator,
+                 decode_perf: PerfInterpolator):
+        self.cfg = cfg
+        self.prefill_perf = prefill_perf
+        self.decode_perf = decode_perf
+        self._rate = make_predictor(cfg.predictor)
+        self._isl = make_predictor(cfg.predictor)
+        self._osl = make_predictor(cfg.predictor)
+        self.current = Decision(cfg.min_prefill_replicas,
+                                cfg.min_decode_replicas)
+        self._downscale_streak_p = 0
+        self._downscale_streak_d = 0
+
+    # -- observe -------------------------------------------------------------
+
+    def observe(self, obs: Observation) -> None:
+        self._rate.add_data_point(obs.request_rate)
+        self._isl.add_data_point(obs.isl)
+        self._osl.add_data_point(obs.osl)
+
+    # -- compute -------------------------------------------------------------
+
+    def compute(self) -> Decision:
+        rate = self._rate.predict_next()
+        isl = self._isl.predict_next()
+        osl = self._osl.predict_next()
+        if rate is None or isl is None or osl is None:
+            return self.current  # no data yet
+
+        cfg = self.cfg
+        # prefill: per-replica sustainable request rate at the TTFT SLA. The
+        # sweep is taken at profiled_isl; prefill work scales ~linearly in
+        # prompt tokens, so rescale demand when the live ISL drifts from it.
+        eff_rate = rate
+        if cfg.profiled_isl > 0 and isl > 0:
+            eff_rate = rate * (isl / cfg.profiled_isl)
+        per_replica_rate = self.prefill_perf.max_load_under(cfg.ttft_sla_ms)
+        if per_replica_rate <= 0:
+            p = cfg.max_prefill_replicas
+        else:
+            p = math.ceil(eff_rate * cfg.prefill_correction / per_replica_rate)
+
+        # decode: demanded decode tokens/s vs per-replica capacity at ITL SLA
+        decode_demand = rate * osl
+        per_replica_tok = self.decode_perf.max_load_under(cfg.itl_sla_ms)
+        if per_replica_tok <= 0:
+            d = cfg.max_decode_replicas
+        else:
+            d = math.ceil(decode_demand * cfg.decode_correction / per_replica_tok)
+
+        p = max(cfg.min_prefill_replicas, min(cfg.max_prefill_replicas, p))
+        d = max(cfg.min_decode_replicas, min(cfg.max_decode_replicas, d))
+
+        # flap damping: immediate scale-up, patient scale-down
+        if p < self.current.prefill_replicas:
+            self._downscale_streak_p += 1
+            if self._downscale_streak_p < cfg.scale_down_patience:
+                p = self.current.prefill_replicas
+            else:
+                self._downscale_streak_p = 0
+        else:
+            self._downscale_streak_p = 0
+        if d < self.current.decode_replicas:
+            self._downscale_streak_d += 1
+            if self._downscale_streak_d < cfg.scale_down_patience:
+                d = self.current.decode_replicas
+            else:
+                self._downscale_streak_d = 0
+        else:
+            self._downscale_streak_d = 0
+
+        self.current = Decision(p, d)
+        return self.current
+
+
+class PlannerRunner:
+    """Drives Planner on a wall-clock loop against a metrics source and a
+    connector (ref: planner_core.py:414 run loop)."""
+
+    def __init__(self, planner: Planner, metrics_source, connector,
+                 interval_s: Optional[float] = None):
+        self.planner = planner
+        self.metrics_source = metrics_source  # async () -> Observation|None
+        self.connector = connector  # async (Decision) -> None
+        self.interval = interval_s or planner.cfg.adjustment_interval_s
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+
+    async def start(self):
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self):
+        self._stop.set()
+        if self._task:
+            await self._task
+
+    async def _loop(self):
+        while not self._stop.is_set():
+            try:
+                obs = await self.metrics_source()
+                if obs is not None:
+                    self.planner.observe(obs)
+                    decision = self.planner.compute()
+                    await self.connector.apply(decision)
+            except Exception:
+                logger.exception("planner iteration failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.interval)
+            except asyncio.TimeoutError:
+                pass

@@ -1,0 +1,49 @@
+"""Connectors: apply planner decisions.
+
+VirtualConnector (ref: planner/virtual_connector.py:1-316) writes the target
+replica counts into the control-plane KV store instead of patching k8s —
+tests and bare-metal launchers watch the keys and start/stop workers.
+The Kubernetes connector (patching a DynamoGraphDeployment-style CRD) slots
+in behind the same ``apply`` interface when running under the operator.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from dynamo_tpu.planner.planner_core import Decision
+
+logger = logging.getLogger("dynamo.planner")
+
+SCALE_KEY = "public/planner/{namespace}/target_replicas"
+
+
+class VirtualConnector:
+    def __init__(self, plane, namespace: str = "dynamo"):
+        self.plane = plane
+        self.namespace = namespace
+        self.key = SCALE_KEY.format(namespace=namespace)
+        self.applied: Optional[Decision] = None
+        self._revision = 0
+
+    async def apply(self, decision: Decision) -> None:
+        if (self.applied is not None
+                and decision.prefill_replicas == self.applied.prefill_replicas
+                and decision.decode_replicas == self.applied.decode_replicas):
+            return
+        self._revision += 1
+        payload = json.dumps({
+            "prefill": decision.prefill_replicas,
+            "decode": decision.decode_replicas,
+            "revision": self._revision,
+        }).encode()
+        await self.plane.kv_put(self.key, payload)
+        self.applied = decision
+        logger.info("planner scale: prefill=%d decode=%d",
+                    decision.prefill_replicas, decision.decode_replicas)
+
+    async def read_target(self) -> Optional[dict]:
+        v = await self.plane.kv_get(self.key)
+        return json.loads(v) if v else None

@@ -1,0 +1,134 @@
+"""Planner: predictor behavior, interpolation inversion, replica math, and a
+scaling e2e against the in-process control plane via VirtualConnector
+(ref pattern: tests/planner/test_replica_calculation.py + test_scaling_e2e.py
+with no k8s)."""
+
+import asyncio
+import math
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner import (
+    ArimaPredictor, ConstantPredictor, MovingAveragePredictor, Observation,
+    PerfInterpolator, Planner, PlannerConfig, VirtualConnector,
+)
+from dynamo_tpu.planner.planner_core import PlannerRunner
+
+pytestmark = pytest.mark.anyio
+
+# single-replica profiling sweeps: (load, latency_ms)
+PREFILL_SWEEP = [(0.5, 80), (1.0, 100), (2.0, 150), (4.0, 300), (8.0, 900)]
+DECODE_SWEEP = [(500, 8), (1000, 12), (2000, 18), (4000, 35), (8000, 80)]
+
+
+def make_planner(**kw) -> Planner:
+    kw.setdefault("scale_down_patience", 1)
+    cfg = PlannerConfig(ttft_sla_ms=200, itl_sla_ms=20, predictor="constant",
+                        **kw)
+    return Planner(cfg, PerfInterpolator(PREFILL_SWEEP),
+                   PerfInterpolator(DECODE_SWEEP))
+
+
+def test_interpolator_inversion():
+    p = PerfInterpolator(PREFILL_SWEEP)
+    assert p.max_load_under(100) == pytest.approx(1.0)
+    assert p.max_load_under(225) == pytest.approx(3.0)  # midway 150→300
+    assert p.max_load_under(50) == 0.0  # unattainable SLA
+    assert p.max_load_under(5000) == 8.0  # never binds
+    assert p.latency_at(1.5) == pytest.approx(125.0)
+
+
+def test_predictors():
+    c = ConstantPredictor()
+    m = MovingAveragePredictor(window=4)
+    a = ArimaPredictor()
+    for i in range(12):
+        for pred in (c, m, a):
+            pred.add_data_point(float(i))
+    assert c.predict_next() == 11.0
+    assert m.predict_next() == pytest.approx(np.mean([8, 9, 10, 11]))
+    # linear ramp: AR+trend must extrapolate ≈ 12
+    assert a.predict_next() == pytest.approx(12.0, abs=0.5)
+
+
+def test_replica_calculation_scales_with_load():
+    pl = make_planner()
+    per_replica_rate = PerfInterpolator(PREFILL_SWEEP).max_load_under(200)
+    per_replica_tok = PerfInterpolator(DECODE_SWEEP).max_load_under(20)
+    pl.observe(Observation(request_rate=9.0, isl=1000, osl=250))
+    d = pl.compute()
+    assert d.prefill_replicas == math.ceil(9.0 / per_replica_rate)
+    assert d.decode_replicas == math.ceil(9.0 * 250 / per_replica_tok)
+
+    # load drops → scale down (patience=1: immediate)
+    pl.observe(Observation(request_rate=1.0, isl=1000, osl=250))
+    d2 = pl.compute()
+    assert d2.prefill_replicas == 1
+    assert d2.decode_replicas < d.decode_replicas
+
+
+def test_scale_down_patience_damps_flapping():
+    pl = make_planner(scale_down_patience=3)
+    pl.observe(Observation(request_rate=9.0, isl=1000, osl=250))
+    up = pl.compute().prefill_replicas
+    assert up == 4
+    pl.observe(Observation(request_rate=0.5, isl=1000, osl=250))
+    assert pl.compute().prefill_replicas == up  # streak 1: hold
+    pl.observe(Observation(request_rate=0.5, isl=1000, osl=250))
+    assert pl.compute().prefill_replicas == up  # streak 2: hold
+    pl.observe(Observation(request_rate=0.5, isl=1000, osl=250))
+    assert pl.compute().prefill_replicas == 1  # streak 3: commit
+
+
+def test_impossible_sla_pins_to_max():
+    pl = make_planner(max_prefill_replicas=7)
+    pl.cfg.ttft_sla_ms = 10  # below the idle latency of the sweep
+    pl.observe(Observation(request_rate=1.0, isl=100, osl=10))
+    assert pl.compute().prefill_replicas == 7
+
+
+async def test_scaling_e2e_virtual_connector():
+    """Sinusoidal load through the full observe→compute→apply loop; the
+    control-plane key must track the demand curve up and down."""
+    from dynamo_tpu.runtime.control_plane import LocalControlPlane
+
+    plane = LocalControlPlane()
+    pl = make_planner()
+    conn = VirtualConnector(plane, "testns")
+
+    t = {"i": 0}
+
+    async def metrics():
+        i = t["i"]
+        t["i"] += 1
+        rate = 5.0 + 4.5 * math.sin(i / 3.0)
+        return Observation(request_rate=rate, isl=1000, osl=250)
+
+    runner = PlannerRunner(pl, metrics, conn, interval_s=0.01)
+    await runner.start()
+    seen = set()
+    for _ in range(200):
+        await asyncio.sleep(0.01)
+        tgt = await conn.read_target()
+        if tgt:
+            seen.add((tgt["prefill"], tgt["decode"]))
+        ps = {p for p, _ in seen}
+        if ps and min(ps) == 1 and max(ps) >= 3:
+            break
+    await runner.stop()
+    prefills = {p for p, _ in seen}
+    assert len(prefills) >= 2  # scaled both directions
+    assert max(prefills) >= 3 and min(prefills) == 1
+
+
+
+def test_isl_drift_scales_prefill_fleet():
+    per = PerfInterpolator(PREFILL_SWEEP).max_load_under(200)
+    pl = make_planner(profiled_isl=1000.0)
+    pl.observe(Observation(request_rate=3.0, isl=1000, osl=250))
+    assert pl.compute().prefill_replicas == math.ceil(3.0 / per)
+    pl2 = make_planner(profiled_isl=1000.0)
+    pl2.observe(Observation(request_rate=3.0, isl=4000, osl=250))
+    # 4x the profiled prompt length → 4x effective request rate
+    assert pl2.compute().prefill_replicas == math.ceil(3.0 * 4 / per)
