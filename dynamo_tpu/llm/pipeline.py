@@ -139,6 +139,20 @@ class OpenAIPreprocessor:
         if "token_ids" in req.annotations:
             yield Annotated(event="token_ids", data=pre.token_ids, id=ctx.id).to_wire()
 
+        # output parsers from the model card (ref: lib/parsers — applied at
+        # the frontend like the reference's parser registry)
+        reasoning = None
+        tool_parser_name = None
+        if is_chat:
+            from dynamo_tpu.parsers import get_reasoning_parser
+            rc = self.mdc.runtime_config
+            reasoning = get_reasoning_parser(rc.reasoning_parser)
+            if rc.tool_call_parser and req.tools:
+                tool_parser_name = rc.tool_call_parser
+        # with a tool parser active, content is buffered and parsed at stream
+        # end (a partial tool call must never leak as content)
+        tool_buf: Optional[list] = [] if tool_parser_name else None
+
         n_prompt = len(pre.token_ids)
         n_completion = 0
         first = True
@@ -154,16 +168,55 @@ class OpenAIPreprocessor:
             n_completion += len(out.token_ids)
             finish = FinishReason.to_openai(out.finish_reason)
             text = out.text or ""
-            if is_chat:
+            if not is_chat:
+                chunk = completion_chunk(
+                    request_id, req.model, created, text=text, finish_reason=finish
+                )
+                if out.finish_reason is not None and (req.stream_usage or not req.stream):
+                    chunk["usage"] = usage_block(n_prompt, n_completion)
+                yield Annotated(data=chunk, id=ctx.id).to_wire()
+                continue
+
+            r_delta = ""
+            if reasoning is not None:
+                r_delta, text = reasoning.feed(text)
+                if out.finish_reason is not None:
+                    r_tail, c_tail = reasoning.finalize()
+                    r_delta += r_tail
+                    text += c_tail
+            if tool_buf is not None:
+                tool_buf.append(text)
+                text = ""
+            if out.finish_reason is not None and tool_buf is not None:
+                from dynamo_tpu.parsers import parse_tool_calls
+                normal, calls = parse_tool_calls(tool_parser_name, "".join(tool_buf))
+                if calls:
+                    finish = "tool_calls"
+                    chunk = chat_chunk(
+                        request_id, req.model, created,
+                        role="assistant" if first else None,
+                        content=normal or None,
+                        tool_calls=[dict(tc.to_openai(), index=i)
+                                    for i, tc in enumerate(calls)],
+                        reasoning_content=r_delta or None,
+                        finish_reason=finish,
+                    )
+                else:
+                    chunk = chat_chunk(
+                        request_id, req.model, created,
+                        role="assistant" if first else None,
+                        content=normal,
+                        reasoning_content=r_delta or None,
+                        finish_reason=finish,
+                    )
+            else:
+                emit_content = text if (text or not finish) else None
                 chunk = chat_chunk(
                     request_id, req.model, created,
                     role="assistant" if first else None,
-                    content=text if (text or not finish) else None,
+                    content=emit_content,
+                    reasoning_content=r_delta or None,
                     finish_reason=finish,
-                )
-            else:
-                chunk = completion_chunk(
-                    request_id, req.model, created, text=text, finish_reason=finish
                 )
             first = False
             if out.finish_reason is not None and (req.stream_usage or not req.stream):
@@ -379,6 +432,8 @@ def build_pipeline(
 async def aggregate_chat_stream(stream: AsyncIterator[dict]) -> dict:
     """Fold a chunk stream into a non-streaming chat completion response."""
     content: dict[int, list[str]] = {}
+    reasoning: dict[int, list[str]] = {}
+    tool_calls: dict[int, list[dict]] = {}
     finish: dict[int, Optional[str]] = {}
     base: Optional[dict] = None
     usage = None
@@ -396,18 +451,32 @@ async def aggregate_chat_stream(stream: AsyncIterator[dict]) -> dict:
             delta = ch.get("delta") or {}
             if delta.get("content"):
                 content.setdefault(idx, []).append(delta["content"])
+            if delta.get("reasoning_content"):
+                reasoning.setdefault(idx, []).append(delta["reasoning_content"])
+            if delta.get("tool_calls"):
+                tool_calls.setdefault(idx, []).extend(delta["tool_calls"])
             if ch.get("finish_reason"):
                 finish[idx] = ch["finish_reason"]
     if base is None:
         raise RuntimeError("empty response stream")
-    choices = [
-        {
+    choices = []
+    for idx in sorted(set(content) | set(finish) | set(tool_calls)
+                      | set(reasoning) | {0}):
+        msg: dict = {"role": "assistant",
+                     "content": "".join(content.get(idx, []))}
+        if idx in reasoning:
+            msg["reasoning_content"] = "".join(reasoning[idx])
+        if idx in tool_calls:
+            msg["tool_calls"] = [
+                {k: v for k, v in tc.items() if k != "index"}
+                for tc in tool_calls[idx]
+            ]
+            msg["content"] = msg["content"] or None
+        choices.append({
             "index": idx,
-            "message": {"role": "assistant", "content": "".join(content.get(idx, []))},
+            "message": msg,
             "finish_reason": finish.get(idx),
-        }
-        for idx in sorted(set(content) | set(finish) | {0})
-    ]
+        })
     return {
         "id": base["id"],
         "object": "chat.completion",

@@ -1,0 +1,16 @@
+"""Output parsers: tool-call extraction + reasoning-block separation.
+
+Rebuild of the reference's dynamo-parsers crate (ref: lib/parsers/src/
+tool_calling/ — hermes/llama/mistral/etc. formats; src/reasoning/ —
+<think>-style block splitting). Parser names travel in the model card's
+runtime_config (model_card.py: tool_call_parser / reasoning_parser) and the
+frontend applies them to engine output text.
+"""
+
+from dynamo_tpu.parsers.reasoning import ReasoningParser, get_reasoning_parser
+from dynamo_tpu.parsers.tool_calling import (
+    ToolCall, get_tool_parser, parse_tool_calls,
+)
+
+__all__ = ["ReasoningParser", "get_reasoning_parser", "ToolCall",
+           "get_tool_parser", "parse_tool_calls"]

@@ -1,0 +1,83 @@
+"""Streaming reasoning-block parser (<think>…</think> and variants).
+
+ref: lib/parsers/src/reasoning/ — deepseek_r1 (``<think>``), granite
+(``<|start_of_role|>…``-framed), nemotron variants. The parser is a small
+incremental state machine: feed text deltas, get (reasoning_delta,
+content_delta) back, so SSE streaming can populate ``reasoning_content``
+separately from ``content`` chunk by chunk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class _Style:
+    open_tag: str
+    close_tag: str
+    #: model emits the open tag implicitly (R1 starts "thinking" at BOS)
+    starts_open: bool = False
+
+
+_STYLES = {
+    "deepseek_r1": _Style("<think>", "</think>", starts_open=True),
+    "qwen3": _Style("<think>", "</think>"),
+    "basic": _Style("<think>", "</think>"),
+    "granite": _Style("<reasoning>", "</reasoning>"),
+}
+
+
+class ReasoningParser:
+    """Incremental splitter. feed() returns (reasoning_delta, content_delta);
+    finalize() flushes anything still buffered (unterminated tag)."""
+
+    def __init__(self, style: str = "basic"):
+        self.style = _STYLES[style]
+        self.in_reasoning = self.style.starts_open
+        self._buf = ""  # holds a potential partial tag across deltas
+
+    def _active_tag(self) -> str:
+        return self.style.close_tag if self.in_reasoning else self.style.open_tag
+
+    def feed(self, delta: str) -> tuple[str, str]:
+        reasoning, content = [], []
+        self._buf += delta
+        while self._buf:
+            tag = self._active_tag()
+            idx = self._buf.find(tag)
+            if idx >= 0:
+                chunk = self._buf[:idx]
+                (reasoning if self.in_reasoning else content).append(chunk)
+                self._buf = self._buf[idx + len(tag):]
+                self.in_reasoning = not self.in_reasoning
+                continue
+            # keep a suffix that could be a split tag prefix; flush the rest
+            keep = 0
+            for k in range(min(len(tag) - 1, len(self._buf)), 0, -1):
+                if tag.startswith(self._buf[-k:]):
+                    keep = k
+                    break
+            flush = self._buf[: len(self._buf) - keep]
+            if flush:
+                (reasoning if self.in_reasoning else content).append(flush)
+            self._buf = self._buf[len(self._buf) - keep:]
+            break
+        return "".join(reasoning), "".join(content)
+
+    def finalize(self) -> tuple[str, str]:
+        """Flush the partial-tag buffer at stream end."""
+        out = self._buf
+        self._buf = ""
+        if not out:
+            return "", ""
+        return (out, "") if self.in_reasoning else ("", out)
+
+
+def get_reasoning_parser(name: Optional[str]) -> Optional[ReasoningParser]:
+    if not name:
+        return None
+    if name not in _STYLES:
+        return None
+    return ReasoningParser(name)

@@ -1,0 +1,201 @@
+"""Tool-call parsers: extract structured calls from generated text.
+
+ref: lib/parsers/src/tool_calling/ — per-model formats:
+
+  hermes       <tool_call>{"name": …, "arguments": {…}}</tool_call>
+  llama3_json  {"name": …, "parameters": {…}} (optionally after
+               <|python_tag|>; semicolon-separated for multiple calls)
+  mistral      [TOOL_CALLS][{…}, …] (bracketed JSON array)
+  phi4         functools[{…}, …]
+  pythonic     [fn(a=1), other(b="x")] (llama-4 style python call list)
+
+Each parser returns (normal_text, [ToolCall]); detection is conservative —
+text that doesn't parse stays ordinary content.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class ToolCall:
+    name: str
+    arguments: str  # JSON-encoded arguments object
+    id: str = field(default_factory=lambda: f"call-{uuid.uuid4().hex[:24]}")
+
+    def to_openai(self) -> dict:
+        return {
+            "id": self.id,
+            "type": "function",
+            "function": {"name": self.name, "arguments": self.arguments},
+        }
+
+
+def _mk(obj: dict) -> Optional[ToolCall]:
+    name = obj.get("name")
+    if not isinstance(name, str) or not name:
+        return None
+    args = obj.get("arguments", obj.get("parameters", {}))
+    if isinstance(args, str):
+        try:
+            args = json.loads(args)
+        except json.JSONDecodeError:
+            return None
+    return ToolCall(name=name, arguments=json.dumps(args))
+
+
+# -- hermes -------------------------------------------------------------------
+
+_HERMES_RE = re.compile(r"<tool_call>\s*(.*?)\s*</tool_call>", re.DOTALL)
+
+
+def parse_hermes(text: str):
+    calls = []
+    for m in _HERMES_RE.finditer(text):
+        try:
+            tc = _mk(json.loads(m.group(1)))
+        except json.JSONDecodeError:
+            continue
+        if tc:
+            calls.append(tc)
+    normal = _HERMES_RE.sub("", text).strip() if calls else text
+    return normal, calls
+
+
+# -- llama3 json --------------------------------------------------------------
+
+
+def _split_top_level(s: str, sep: str) -> list[str]:
+    """Split on sep only at brace/bracket depth 0 outside JSON strings."""
+    parts, depth, in_str, esc, start = [], 0, False, False, 0
+    for i, ch in enumerate(s):
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch in "{[":
+            depth += 1
+        elif ch in "}]":
+            depth -= 1
+        elif ch == sep and depth == 0:
+            parts.append(s[start:i])
+            start = i + 1
+    parts.append(s[start:])
+    return parts
+
+
+def parse_llama3_json(text: str):
+    stripped = text.strip()
+    if stripped.startswith("<|python_tag|>"):
+        stripped = stripped[len("<|python_tag|>"):]
+    candidates = [c.strip() for c in _split_top_level(stripped, ";")]
+    calls = []
+    for c in candidates:
+        if not (c.startswith("{") and c.endswith("}")):
+            return text, []
+        try:
+            tc = _mk(json.loads(c))
+        except json.JSONDecodeError:
+            return text, []
+        if tc is None:
+            return text, []
+        calls.append(tc)
+    return "", calls
+
+
+# -- mistral / phi4: marker + balanced JSON array ----------------------------
+
+
+def _parse_marked_array(text: str, marker_re: re.Pattern):
+    """Extract a JSON array right after a marker via raw_decode (balanced —
+    a greedy regex would swallow trailing prose up to the last ']')."""
+    m = marker_re.search(text)
+    if not m:
+        return text, []
+    try:
+        arr, end = json.JSONDecoder().raw_decode(text, m.end())
+    except json.JSONDecodeError:
+        return text, []
+    if not isinstance(arr, list):
+        return text, []
+    calls = [tc for obj in arr if isinstance(obj, dict) and (tc := _mk(obj))]
+    if not calls:
+        return text, []
+    normal = (text[: m.start()] + text[end:]).strip()
+    return normal, calls
+
+
+_MISTRAL_RE = re.compile(r"\[TOOL_CALLS\]\s*(?=\[)")
+_PHI4_RE = re.compile(r"functools\s*(?=\[)")
+
+
+def parse_mistral(text: str):
+    return _parse_marked_array(text, _MISTRAL_RE)
+
+
+def parse_phi4(text: str):
+    return _parse_marked_array(text, _PHI4_RE)
+
+
+# -- pythonic (llama-4) -------------------------------------------------------
+
+
+def parse_pythonic(text: str):
+    stripped = text.strip()
+    if not (stripped.startswith("[") and stripped.endswith("]")):
+        return text, []
+    try:
+        tree = ast.parse(stripped, mode="eval")
+    except SyntaxError:
+        return text, []
+    if not isinstance(tree.body, ast.List):
+        return text, []
+    calls = []
+    for el in tree.body.elts:
+        if not (isinstance(el, ast.Call) and isinstance(el.func, ast.Name)):
+            return text, []
+        if el.args:  # positional args can't be named without the schema —
+            return text, []  # reject rather than silently drop them
+        args = {}
+        for kw in el.keywords:
+            try:
+                args[kw.arg] = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return text, []
+        calls.append(ToolCall(name=el.func.id, arguments=json.dumps(args)))
+    return "", calls
+
+
+_PARSERS: dict[str, Callable] = {
+    "hermes": parse_hermes,
+    "llama3_json": parse_llama3_json,
+    "mistral": parse_mistral,
+    "phi4": parse_phi4,
+    "pythonic": parse_pythonic,
+}
+
+
+def get_tool_parser(name: Optional[str]) -> Optional[Callable]:
+    if not name:
+        return None
+    return _PARSERS.get(name)
+
+
+def parse_tool_calls(name: str, text: str):
+    """(normal_text, [ToolCall]) for the named format; unknown name = no-op."""
+    p = get_tool_parser(name)
+    if p is None:
+        return text, []
+    return p(text)

@@ -1,0 +1,162 @@
+"""parsers/: tool-call format extraction + streaming reasoning splitting,
+and their integration into the OpenAI pipeline chunk stream."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.parsers import ReasoningParser, parse_tool_calls
+from dynamo_tpu.parsers.reasoning import get_reasoning_parser
+
+pytestmark = pytest.mark.anyio
+
+
+# -- tool calling -------------------------------------------------------------
+
+def test_hermes_extracts_calls_and_text():
+    text = ('I will check.\n<tool_call>\n{"name": "get_weather", '
+            '"arguments": {"city": "Paris"}}\n</tool_call>')
+    normal, calls = parse_tool_calls("hermes", text)
+    assert normal == "I will check."
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris"}
+
+
+def test_hermes_multiple_and_malformed():
+    text = ('<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+            '<tool_call>not json</tool_call>'
+            '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>')
+    normal, calls = parse_tool_calls("hermes", text)
+    assert [c.name for c in calls] == ["a", "b"]
+
+
+def test_llama3_json():
+    text = '{"name": "lookup", "parameters": {"q": "tpu"}}'
+    normal, calls = parse_tool_calls("llama3_json", text)
+    assert normal == "" and calls[0].name == "lookup"
+    assert json.loads(calls[0].arguments) == {"q": "tpu"}
+    # plain prose must pass through untouched
+    normal, calls = parse_tool_calls("llama3_json", "just some text")
+    assert normal == "just some text" and calls == []
+
+
+def test_llama3_json_semicolon_multi():
+    text = ('{"name": "a", "parameters": {}} ; {"name": "b", "parameters": {}}')
+    _, calls = parse_tool_calls("llama3_json", text)
+    assert [c.name for c in calls] == ["a", "b"]
+
+
+def test_mistral():
+    text = '[TOOL_CALLS][{"name": "f", "arguments": {"k": 2}}]'
+    normal, calls = parse_tool_calls("mistral", text)
+    assert normal == "" and calls[0].name == "f"
+
+
+def test_pythonic():
+    text = '[get_weather(city="SF"), get_time(tz="PST")]'
+    normal, calls = parse_tool_calls("pythonic", text)
+    assert [c.name for c in calls] == ["get_weather", "get_time"]
+    assert json.loads(calls[0].arguments) == {"city": "SF"}
+    normal, calls = parse_tool_calls("pythonic", "[1, 2, 3]")
+    assert calls == []
+
+
+def test_unknown_parser_is_noop():
+    normal, calls = parse_tool_calls("nope", "text")
+    assert normal == "text" and calls == []
+
+
+# -- reasoning ----------------------------------------------------------------
+
+def test_reasoning_basic_split():
+    p = ReasoningParser("basic")
+    r, c = p.feed("<think>step one</think>answer")
+    assert r == "step one" and c == "answer"
+
+
+def test_reasoning_streaming_split_tags():
+    """Tags split across deltas must not leak into either side."""
+    p = ReasoningParser("basic")
+    rs, cs = [], []
+    for d in ["<th", "ink>rea", "soning</th", "ink>con", "tent"]:
+        r, c = p.feed(d)
+        rs.append(r)
+        cs.append(c)
+    r, c = p.finalize()
+    rs.append(r)
+    cs.append(c)
+    assert "".join(rs) == "reasoning"
+    assert "".join(cs) == "content"
+
+
+def test_reasoning_r1_starts_open():
+    p = get_reasoning_parser("deepseek_r1")
+    r, c = p.feed("chain of thought</think>final")
+    assert r == "chain of thought" and c == "final"
+
+
+def test_reasoning_unterminated_flushes_as_reasoning():
+    p = ReasoningParser("basic")
+    p.feed("<think>never closed")
+    r, c = p.finalize()
+    assert (r, c) == ("", "")  # all emitted already except empty buffer
+
+
+# -- pipeline integration -----------------------------------------------------
+
+async def test_pipeline_reasoning_and_tools():
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.pipeline import OpenAIPreprocessor, aggregate_chat_stream
+    from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+    from dynamo_tpu.protocols import LLMEngineOutput, FinishReason
+    from dynamo_tpu.protocols.openai import parse_chat_request
+
+    tok = make_test_tokenizer()
+    card = ModelDeploymentCard(display_name="m", kv_cache_block_size=4,
+                               eos_token_ids=[2], tokenizer_ref="test")
+    card.runtime_config.tool_call_parser = "hermes"
+    card.runtime_config.reasoning_parser = "basic"
+
+    pieces = ["<think>plan it</think>",
+              '<tool_call>{"name": "go", "arguments": {"n": 1}}</tool_call>']
+
+    async def engine(pre, ctx):
+        for i, piece in enumerate(pieces):
+            yield LLMEngineOutput(
+                token_ids=[i], text=piece,
+                finish_reason=FinishReason.STOP if i == len(pieces) - 1 else None)
+
+    pipe = OpenAIPreprocessor(card, tok, engine)
+    req = parse_chat_request({
+        "model": "m", "stream": False,
+        "messages": [{"role": "user", "content": "hi"}],
+        "tools": [{"type": "function", "function": {"name": "go"}}],
+    })
+    from dynamo_tpu.runtime.context import Context
+
+    result = await aggregate_chat_stream(pipe.generate(req, Context()))
+    msg = result["choices"][0]["message"]
+    assert msg["reasoning_content"] == "plan it"
+    assert msg["tool_calls"][0]["function"]["name"] == "go"
+    assert json.loads(msg["tool_calls"][0]["function"]["arguments"]) == {"n": 1}
+    assert result["choices"][0]["finish_reason"] == "tool_calls"
+    assert not msg["content"]
+
+
+def test_llama3_json_semicolon_inside_string():
+    text = '{"name": "search", "parameters": {"q": "a;b"}}'
+    normal, calls = parse_tool_calls("llama3_json", text)
+    assert calls and json.loads(calls[0].arguments) == {"q": "a;b"}
+
+
+def test_mistral_trailing_bracketed_prose():
+    text = '[TOOL_CALLS][{"name": "f", "arguments": {}}] see [1]'
+    normal, calls = parse_tool_calls("mistral", text)
+    assert calls and calls[0].name == "f"
+    assert normal == "see [1]"
+
+
+def test_pythonic_positional_args_rejected():
+    normal, calls = parse_tool_calls("pythonic", '[get_weather("SF")]')
+    assert calls == [] and normal == '[get_weather("SF")]'
