@@ -1,0 +1,58 @@
+"""ctypes loader for the native C++ core (graceful pure-Python fallback).
+
+``lib`` is None when libdynamo_native.so hasn't been built (see
+native_build.py); callers must branch. Parity with the Python implementations
+is enforced by tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "libdynamo_native.so")
+
+lib: Optional[ctypes.CDLL] = None
+if os.path.exists(_SO):
+    try:
+        lib = ctypes.CDLL(_SO)
+        lib.dyn_xxh3_64.restype = ctypes.c_uint64
+        lib.dyn_xxh3_64.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                    ctypes.c_uint64]
+        lib.dyn_block_hashes.restype = ctypes.c_size_t
+        lib.dyn_block_hashes.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+    except OSError:
+        lib = None
+
+
+def xxh3_64(data: bytes, seed: int) -> Optional[int]:
+    if lib is None:
+        return None
+    return lib.dyn_xxh3_64(data, len(data), seed & (2**64 - 1))
+
+
+def block_hashes(tokens, block_size: int, salt: int):
+    """(block_hashes, sequence_hashes) for complete blocks, or None."""
+    if lib is None:
+        return None
+    import struct
+
+    n_tokens = len(tokens)
+    n = n_tokens // block_size
+    if n == 0:
+        return [], []
+    # bulk-pack: per-element ctypes construction would dominate the call
+    packed = struct.pack(f"<{n_tokens}I", *tokens)
+    arr = ctypes.cast(ctypes.create_string_buffer(packed, len(packed)),
+                      ctypes.POINTER(ctypes.c_uint32))
+    out_b = (ctypes.c_uint64 * n)()
+    out_s = (ctypes.c_uint64 * n)()
+    lib.dyn_block_hashes(arr, n_tokens, block_size, salt & (2**64 - 1),
+                         out_b, out_s)
+    return list(out_b), list(out_s)

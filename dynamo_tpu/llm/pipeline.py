@@ -187,6 +187,8 @@ class OpenAIPreprocessor:
             if tool_buf is not None:
                 tool_buf.append(text)
                 text = ""
+                if out.finish_reason is None and not r_delta:
+                    continue  # content buffered; nothing to stream this step
             if out.finish_reason is not None and tool_buf is not None:
                 from dynamo_tpu.parsers import parse_tool_calls
                 normal, calls = parse_tool_calls(tool_parser_name, "".join(tool_buf))

@@ -1,0 +1,30 @@
+"""Build the native C++ core: ``python -m dynamo_tpu.native_build``.
+
+Compiles native/*.cc into ``dynamo_tpu/libdynamo_native.so`` with the local
+g++ (no external deps). The framework runs without it — _native.py falls
+back to pure Python — but the native path is the production configuration.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_DIR = os.path.dirname(PKG_DIR)
+SRC = [os.path.join(REPO_DIR, "native", "xxh3.cc")]
+OUT = os.path.join(PKG_DIR, "libdynamo_native.so")
+
+
+def build(out: str = OUT, verbose: bool = True) -> str:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", out, *SRC]
+    if verbose:
+        print("+", " ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    build()
+    sys.exit(0)

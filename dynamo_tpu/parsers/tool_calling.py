@@ -100,7 +100,10 @@ def parse_llama3_json(text: str):
     stripped = text.strip()
     if stripped.startswith("<|python_tag|>"):
         stripped = stripped[len("<|python_tag|>"):]
-    candidates = [c.strip() for c in _split_top_level(stripped, ";")]
+    candidates = [c for c in (x.strip() for x in _split_top_level(stripped, ";"))
+                  if c]  # tolerate trailing/doubled semicolons
+    if not candidates:
+        return text, []
     calls = []
     for c in candidates:
         if not (c.startswith("{") and c.endswith("}")):
@@ -119,22 +122,32 @@ def parse_llama3_json(text: str):
 
 
 def _parse_marked_array(text: str, marker_re: re.Pattern):
-    """Extract a JSON array right after a marker via raw_decode (balanced —
+    """Extract every marker-prefixed JSON array via raw_decode (balanced —
     a greedy regex would swallow trailing prose up to the last ']')."""
-    m = marker_re.search(text)
-    if not m:
-        return text, []
-    try:
-        arr, end = json.JSONDecoder().raw_decode(text, m.end())
-    except json.JSONDecodeError:
-        return text, []
-    if not isinstance(arr, list):
-        return text, []
-    calls = [tc for obj in arr if isinstance(obj, dict) and (tc := _mk(obj))]
+    calls: list[ToolCall] = []
+    normal_parts: list[str] = []
+    pos = 0
+    while True:
+        m = marker_re.search(text, pos)
+        if not m:
+            normal_parts.append(text[pos:])
+            break
+        try:
+            arr, end = json.JSONDecoder().raw_decode(text, m.end())
+        except json.JSONDecodeError:
+            normal_parts.append(text[pos:])
+            break
+        block = [tc for obj in arr if isinstance(obj, dict) and (tc := _mk(obj))] \
+            if isinstance(arr, list) else []
+        if not block:
+            normal_parts.append(text[pos:])
+            break
+        calls.extend(block)
+        normal_parts.append(text[pos:m.start()])
+        pos = end
     if not calls:
         return text, []
-    normal = (text[: m.start()] + text[end:]).strip()
-    return normal, calls
+    return "".join(normal_parts).strip(), calls
 
 
 _MISTRAL_RE = re.compile(r"\[TOOL_CALLS\]\s*(?=\[)")
@@ -170,6 +183,8 @@ def parse_pythonic(text: str):
             return text, []  # reject rather than silently drop them
         args = {}
         for kw in el.keywords:
+            if kw.arg is None:  # **kwargs form: reject like positionals
+                return text, []
             try:
                 args[kw.arg] = ast.literal_eval(kw.value)
             except (ValueError, SyntaxError):

@@ -63,7 +63,13 @@ def compute_block_hash_for_seq(
 
     Trailing tokens that do not fill a block are ignored, matching
     ``chunks_exact`` in the reference (ref: kv_router/indexer.rs:125-137).
+    Uses the native C++ batch path when built (one call for all blocks).
     """
+    from dynamo_tpu import _native
+
+    res = _native.block_hashes(tokens, kv_block_size, salt_hash)
+    if res is not None:
+        return res[0]
     n = len(tokens) // kv_block_size
     out = []
     for i in range(n):
@@ -158,8 +164,31 @@ class TokenBlockSequence:
         return None
 
     def extend(self, tokens: Iterable[int]) -> list[TokenBlock]:
-        """Append many tokens; returns all newly-completed blocks."""
-        new_blocks = []
+        """Append many tokens; returns all newly-completed blocks.
+
+        When the native core is built and the append is block-aligned, the
+        whole-blocks prefix hashes in one C++ call.
+        """
+        tokens = list(tokens)
+        new_blocks: list[TokenBlock] = []
+        if not self.current_tokens and len(tokens) >= self.block_size:
+            from dynamo_tpu import _native
+
+            res = _native.block_hashes(tokens, self.block_size, self.salt_hash)
+            if res is not None:
+                bhs, shs = res
+                fresh_chain = not self.blocks  # native chain starts at None
+                parent = self.blocks[-1].sequence_hash if self.blocks else None
+                for i, bh in enumerate(bhs):
+                    sh = shs[i] if fresh_chain else chain_sequence_hash(
+                        parent, bh, self.salt_hash)
+                    blk = TokenBlock(
+                        tuple(tokens[i * self.block_size:(i + 1) * self.block_size]),
+                        bh, sh, parent)
+                    self.blocks.append(blk)
+                    new_blocks.append(blk)
+                    parent = sh
+                tokens = tokens[len(bhs) * self.block_size:]
         for t in tokens:
             b = self.push_token(t)
             if b is not None:

@@ -1,0 +1,269 @@
+// Native token-hashing core: scalar XXH3-64 + batch block/sequence hashing.
+//
+// This is the C++ counterpart of the reference's dynamo-tokens crate
+// (ref: lib/tokens/src/lib.rs:16-29 — salted xxh3 block hashes, chained
+// sequence hashes). The hash IS the cluster-wide identity of a KV block
+// (router radix index, KV events, prefix caches), so the native path must be
+// bit-identical to xxhash's XXH3_64bits_withSeed; tests/test_native.py
+// verifies parity against the Python xxhash package over the full length
+// range (short/mid/long input classes).
+//
+// Build: g++ -O3 -shared -fPIC -o libdynamo_native.so xxh3.cc
+// (driven by dynamo_tpu/native_build.py; loaded via ctypes in
+// dynamo_tpu/_native.py with a pure-Python fallback when absent).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+typedef uint64_t u64;
+typedef uint32_t u32;
+typedef uint8_t u8;
+
+static const u64 PRIME32_1 = 0x9E3779B1ULL;
+static const u64 PRIME32_2 = 0x85EBCA77ULL;
+static const u64 PRIME32_3 = 0xC2B2AE3DULL;
+static const u64 PRIME64_1 = 0x9E3779B185EBCA87ULL;
+static const u64 PRIME64_2 = 0xC2B2AE3D27D4EB4FULL;
+static const u64 PRIME64_3 = 0x165667B19E3779F9ULL;
+static const u64 PRIME64_4 = 0x85EBCA77C2B2AE63ULL;
+static const u64 PRIME64_5 = 0x27D4EB2F165667C5ULL;
+static const u64 PRIME_MX1 = 0x165667919E3779F9ULL;
+static const u64 PRIME_MX2 = 0x9FB21C651E98DF25ULL;
+
+// canonical XXH3 kSecret (xxhash.h XXH3_kSecret, 192 bytes)
+static const u8 kSecret[192] = {
+    0xb8, 0xfe, 0x6c, 0x39, 0x23, 0xa4, 0x4b, 0xbe, 0x7c, 0x01, 0x81, 0x2c,
+    0xf7, 0x21, 0xad, 0x1c, 0xde, 0xd4, 0x6d, 0xe9, 0x83, 0x90, 0x97, 0xdb,
+    0x72, 0x40, 0xa4, 0xa4, 0xb7, 0xb3, 0x67, 0x1f, 0xcb, 0x79, 0xe6, 0x4e,
+    0xcc, 0xc0, 0xe5, 0x78, 0x82, 0x5a, 0xd0, 0x7d, 0xcc, 0xff, 0x72, 0x21,
+    0xb8, 0x08, 0x46, 0x74, 0xf7, 0x43, 0x24, 0x8e, 0xe0, 0x35, 0x90, 0xe6,
+    0x81, 0x3a, 0x26, 0x4c, 0x3c, 0x28, 0x52, 0xbb, 0x91, 0xc3, 0x00, 0xcb,
+    0x88, 0xd0, 0x65, 0x8b, 0x1b, 0x53, 0x2e, 0xa3, 0x71, 0x64, 0x48, 0x97,
+    0xa2, 0x0d, 0xf9, 0x4e, 0x38, 0x19, 0xef, 0x46, 0xa9, 0xde, 0xac, 0xd8,
+    0xa8, 0xfa, 0x76, 0x3f, 0xe3, 0x9c, 0x34, 0x3f, 0xf9, 0xdc, 0xbb, 0xc7,
+    0xc7, 0x0b, 0x4f, 0x1d, 0x8a, 0x51, 0xe0, 0x4b, 0xcd, 0xb4, 0x59, 0x31,
+    0xc8, 0x9f, 0x7e, 0xc9, 0xd9, 0x78, 0x73, 0x64, 0xea, 0xc5, 0xac, 0x83,
+    0x34, 0xd3, 0xeb, 0xc3, 0xc5, 0x81, 0xa0, 0xff, 0xfa, 0x13, 0x63, 0xeb,
+    0x17, 0x0d, 0xdd, 0x51, 0xb7, 0xf0, 0xda, 0x49, 0xd3, 0x16, 0x55, 0x26,
+    0x29, 0xd4, 0x68, 0x9e, 0x2b, 0x16, 0xbe, 0x58, 0x7d, 0x47, 0xa1, 0xfc,
+    0x8f, 0xf8, 0xb8, 0xd1, 0x7a, 0xd0, 0x31, 0xce, 0x45, 0xcb, 0x3a, 0x8f,
+    0x95, 0x16, 0x04, 0x28, 0xaf, 0xd7, 0xfb, 0xca, 0xbb, 0x4b, 0x40, 0x7e,
+};
+
+static inline u64 read64(const u8* p) {
+    u64 v;
+    std::memcpy(&v, p, 8);
+    return v;  // little-endian hosts only (x86/ARM/TPU-VM)
+}
+
+static inline u32 read32(const u8* p) {
+    u32 v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+static inline u64 rotl64(u64 x, int r) { return (x << r) | (x >> (64 - r)); }
+
+static inline u32 swap32(u32 x) { return __builtin_bswap32(x); }
+static inline u64 swap64(u64 x) { return __builtin_bswap64(x); }
+
+static inline u64 mul128_fold64(u64 a, u64 b) {
+    __uint128_t p = (__uint128_t)a * b;
+    return (u64)p ^ (u64)(p >> 64);
+}
+
+static inline u64 xxh64_avalanche(u64 h) {
+    h ^= h >> 33;
+    h *= PRIME64_2;
+    h ^= h >> 29;
+    h *= PRIME64_3;
+    h ^= h >> 32;
+    return h;
+}
+
+static inline u64 xxh3_avalanche(u64 h) {
+    h ^= h >> 37;
+    h *= PRIME_MX1;
+    h ^= h >> 32;
+    return h;
+}
+
+static inline u64 rrmxmx(u64 h, u64 len) {
+    h ^= rotl64(h, 49) ^ rotl64(h, 24);
+    h *= PRIME_MX2;
+    h ^= (h >> 35) + len;
+    h *= PRIME_MX2;
+    h ^= h >> 28;
+    return h;
+}
+
+static u64 len_0(u64 seed) {
+    return xxh64_avalanche(seed ^ (read64(kSecret + 56) ^ read64(kSecret + 64)));
+}
+
+static u64 len_1to3(const u8* in, size_t len, u64 seed) {
+    u8 c1 = in[0], c2 = in[len >> 1], c3 = in[len - 1];
+    u32 combined = ((u32)c1 << 16) | ((u32)c2 << 24) | (u32)c3 | ((u32)len << 8);
+    u64 bitflip = (u64)(read32(kSecret) ^ read32(kSecret + 4)) + seed;
+    return xxh64_avalanche((u64)combined ^ bitflip);
+}
+
+static u64 len_4to8(const u8* in, size_t len, u64 seed) {
+    seed ^= (u64)swap32((u32)seed) << 32;
+    u32 in1 = read32(in);
+    u32 in2 = read32(in + len - 4);
+    u64 bitflip = (read64(kSecret + 8) ^ read64(kSecret + 16)) - seed;
+    u64 input64 = (u64)in2 + ((u64)in1 << 32);
+    return rrmxmx(input64 ^ bitflip, len);
+}
+
+static u64 len_9to16(const u8* in, size_t len, u64 seed) {
+    u64 bitflip1 = (read64(kSecret + 24) ^ read64(kSecret + 32)) + seed;
+    u64 bitflip2 = (read64(kSecret + 40) ^ read64(kSecret + 48)) - seed;
+    u64 lo = read64(in) ^ bitflip1;
+    u64 hi = read64(in + len - 8) ^ bitflip2;
+    u64 acc = len + swap64(lo) + hi + mul128_fold64(lo, hi);
+    return xxh3_avalanche(acc);
+}
+
+static inline u64 mix16(const u8* in, const u8* secret, u64 seed) {
+    u64 lo = read64(in) ^ (read64(secret) + seed);
+    u64 hi = read64(in + 8) ^ (read64(secret + 8) - seed);
+    return mul128_fold64(lo, hi);
+}
+
+static u64 len_17to128(const u8* in, size_t len, u64 seed) {
+    u64 acc = len * PRIME64_1;
+    if (len > 32) {
+        if (len > 64) {
+            if (len > 96) {
+                acc += mix16(in + 48, kSecret + 96, seed);
+                acc += mix16(in + len - 64, kSecret + 112, seed);
+            }
+            acc += mix16(in + 32, kSecret + 64, seed);
+            acc += mix16(in + len - 48, kSecret + 80, seed);
+        }
+        acc += mix16(in + 16, kSecret + 32, seed);
+        acc += mix16(in + len - 32, kSecret + 48, seed);
+    }
+    acc += mix16(in, kSecret, seed);
+    acc += mix16(in + len - 16, kSecret + 16, seed);
+    return xxh3_avalanche(acc);
+}
+
+static u64 len_129to240(const u8* in, size_t len, u64 seed) {
+    u64 acc = len * PRIME64_1;
+    size_t nb = len / 16;
+    for (size_t i = 0; i < 8; i++) acc += mix16(in + 16 * i, kSecret + 16 * i, seed);
+    acc = xxh3_avalanche(acc);
+    for (size_t i = 8; i < nb; i++)
+        acc += mix16(in + 16 * i, kSecret + 16 * (i - 8) + 3, seed);
+    acc += mix16(in + len - 16, kSecret + 136 - 17, seed);
+    return xxh3_avalanche(acc);
+}
+
+// ---- long input (> 240 bytes) ----------------------------------------------
+
+static inline void accumulate512(u64 acc[8], const u8* in, const u8* secret) {
+    for (int i = 0; i < 8; i++) {
+        u64 data_val = read64(in + 8 * i);
+        u64 data_key = data_val ^ read64(secret + 8 * i);
+        acc[i ^ 1] += data_val;
+        acc[i] += (u64)(u32)data_key * (u64)(u32)(data_key >> 32);
+    }
+}
+
+static inline void scramble(u64 acc[8], const u8* secret) {
+    for (int i = 0; i < 8; i++) {
+        acc[i] ^= acc[i] >> 47;
+        acc[i] ^= read64(secret + 8 * i);
+        acc[i] *= (u64)PRIME32_1;
+    }
+}
+
+static inline u64 mix2accs(const u64* acc, const u8* secret) {
+    return mul128_fold64(acc[0] ^ read64(secret), acc[1] ^ read64(secret + 8));
+}
+
+static u64 merge_accs(const u64 acc[8], const u8* secret, u64 start) {
+    u64 r = start;
+    for (int i = 0; i < 4; i++) r += mix2accs(acc + 2 * i, secret + 16 * i);
+    return xxh3_avalanche(r);
+}
+
+static u64 hash_long(const u8* in, size_t len, u64 seed) {
+    u8 secret[192];
+    if (seed == 0) {
+        std::memcpy(secret, kSecret, 192);
+    } else {
+        for (int i = 0; i < 192 / 16; i++) {
+            u64 lo = read64(kSecret + 16 * i) + seed;
+            u64 hi = read64(kSecret + 16 * i + 8) - seed;
+            std::memcpy(secret + 16 * i, &lo, 8);
+            std::memcpy(secret + 16 * i + 8, &hi, 8);
+        }
+    }
+    u64 acc[8] = {PRIME32_3, PRIME64_1, PRIME64_2, PRIME64_3,
+                  PRIME64_4, PRIME32_2, PRIME64_5, PRIME32_1};
+    const size_t nbStripesPerBlock = (192 - 64) / 8;  // 16
+    const size_t blockLen = 64 * nbStripesPerBlock;
+    const size_t nbBlocks = (len - 1) / blockLen;
+    for (size_t b = 0; b < nbBlocks; b++) {
+        for (size_t s = 0; s < nbStripesPerBlock; s++)
+            accumulate512(acc, in + b * blockLen + 64 * s, secret + 8 * s);
+        scramble(acc, secret + 192 - 64);
+    }
+    const size_t nbStripes = ((len - 1) - blockLen * nbBlocks) / 64;
+    for (size_t s = 0; s < nbStripes; s++)
+        accumulate512(acc, in + nbBlocks * blockLen + 64 * s, secret + 8 * s);
+    accumulate512(acc, in + len - 64, secret + 192 - 64 - 7);
+    return merge_accs(acc, secret + 11, (u64)len * PRIME64_1);
+}
+
+static u64 xxh3_64(const u8* in, size_t len, u64 seed) {
+    if (len == 0) return len_0(seed);
+    if (len <= 3) return len_1to3(in, len, seed);
+    if (len <= 8) return len_4to8(in, len, seed);
+    if (len <= 16) return len_9to16(in, len, seed);
+    if (len <= 128) return len_17to128(in, len, seed);
+    if (len <= 240) return len_129to240(in, len, seed);
+    return hash_long(in, len, seed);
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t dyn_xxh3_64(const uint8_t* data, size_t len, uint64_t seed) {
+    return xxh3_64(data, len, seed);
+}
+
+// Batch path: per-block token hashes + chained sequence hashes in one call
+// (ref: lib/tokens parallel block hashing). tokens are u32 little-endian;
+// out_block/out_seq must hold n_tokens / block_size entries.
+size_t dyn_block_hashes(const uint32_t* tokens, size_t n_tokens,
+                        size_t block_size, uint64_t salt,
+                        uint64_t* out_block, uint64_t* out_seq) {
+    const size_t n = n_tokens / block_size;
+    uint64_t parent = 0;
+    for (size_t i = 0; i < n; i++) {
+        const u8* p = (const u8*)(tokens + i * block_size);
+        uint64_t bh = xxh3_64(p, block_size * 4, salt);
+        out_block[i] = bh;
+        if (i == 0) {
+            parent = bh;
+        } else {
+            u8 buf[16];
+            std::memcpy(buf, &parent, 8);
+            std::memcpy(buf + 8, &bh, 8);
+            parent = xxh3_64(buf, 16, salt);
+        }
+        out_seq[i] = parent;
+    }
+    return n;
+}
+
+}  // extern "C"

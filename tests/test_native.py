@@ -1,0 +1,64 @@
+"""Native C++ core: bit-parity of xxh3 + batch block hashing with the Python
+reference path. Builds the .so on demand (g++ is part of the toolchain)."""
+
+import importlib
+import os
+import random
+
+import pytest
+
+import dynamo_tpu._native as native
+from dynamo_tpu import native_build
+from dynamo_tpu import tokens as T
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_native():
+    if native.lib is None:
+        native_build.build(verbose=False)
+        importlib.reload(native)
+    assert native.lib is not None
+    yield
+
+
+def test_xxh3_parity_all_length_classes():
+    import xxhash
+
+    rng = random.Random(1)
+    for ln in [0, 1, 3, 4, 8, 9, 16, 17, 64, 128, 129, 240, 241, 256, 1024,
+               64 * 16 + 1, 50_000]:
+        for seed in (0, T.KV_HASH_SEED, 2**64 - 3):
+            data = bytes(rng.randrange(256) for _ in range(ln))
+            assert native.xxh3_64(data, seed) == xxhash.xxh3_64_intdigest(
+                data, seed=seed), (ln, seed)
+
+
+def test_batch_block_hashes_match_python():
+    rng = random.Random(2)
+    toks = [rng.randrange(2**31) for _ in range(1000)]
+    for bs in (4, 16, 64):
+        bhs, shs = native.block_hashes(toks, bs, T.KV_HASH_SEED)
+        want_b = [
+            T.compute_hash(T._tokens_le_bytes(toks[i * bs:(i + 1) * bs]),
+                           seed=T.KV_HASH_SEED)
+            for i in range(len(toks) // bs)
+        ]
+        assert bhs == want_b
+        assert shs == T.compute_seq_hash_for_block(want_b)
+
+
+def test_token_block_sequence_native_matches_incremental():
+    rng = random.Random(3)
+    toks = [rng.randrange(2**31) for _ in range(203)]
+    bulk = T.TokenBlockSequence.from_tokens(toks, 16)  # native batch path
+    inc = T.TokenBlockSequence(block_size=16)
+    for t in toks:  # push_token path (pure python hashing per block)
+        inc.push_token(t)
+    assert bulk.sequence_hashes() == inc.sequence_hashes()
+    assert bulk.block_hashes() == inc.block_hashes()
+    assert bulk.current_tokens == inc.current_tokens
+
+    # extend onto an existing chained prefix
+    pre = T.TokenBlockSequence.from_tokens(toks[:32], 16)
+    pre.extend(toks[32:])
+    assert pre.sequence_hashes() == inc.sequence_hashes()

@@ -160,3 +160,22 @@ def test_mistral_trailing_bracketed_prose():
 def test_pythonic_positional_args_rejected():
     normal, calls = parse_tool_calls("pythonic", '[get_weather("SF")]')
     assert calls == [] and normal == '[get_weather("SF")]'
+
+
+def test_llama3_json_trailing_semicolon():
+    text = '{"name": "a", "parameters": {}};'
+    _, calls = parse_tool_calls("llama3_json", text)
+    assert [c.name for c in calls] == ["a"]
+
+
+def test_mistral_multiple_marker_blocks():
+    text = ('[TOOL_CALLS][{"name": "f", "arguments": {}}] and '
+            '[TOOL_CALLS][{"name": "g", "arguments": {}}]')
+    normal, calls = parse_tool_calls("mistral", text)
+    assert [c.name for c in calls] == ["f", "g"]
+    assert "TOOL_CALLS" not in normal
+
+
+def test_pythonic_double_star_kwargs_rejected():
+    normal, calls = parse_tool_calls("pythonic", '[f(**{"a": 1})]')
+    assert calls == []
