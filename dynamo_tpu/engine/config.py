@@ -136,6 +136,9 @@ class EngineArgs:
     decode_batch_buckets: tuple = ()  # () = powers of two up to max_num_seqs
     prefill_buckets: tuple = ()  # () = powers of two up to max_num_batched_tokens
     use_pallas_attention: bool = False  # Pallas paged-attention kernel (TPU only)
+    #: decode steps fused into one jitted call when only decode work exists
+    #: (amortizes per-dispatch latency; tokens deliver in bursts of this size)
+    multi_step_decode: int = 1
     # KVBM tiers (0 = tier disabled; ref: block_manager.rs:62-75 G2/G3)
     kvbm_host_bytes: int = 0
     kvbm_disk_dir: Optional[str] = None

@@ -89,6 +89,11 @@ class AsyncJaxEngine:
             onboard_cb=self._onboard if self.kvbm is not None else None)
         self.step_fn = M.make_step_fn(cfg, args.block_size, mesh,
                                       use_pallas=args.use_pallas_attention)
+        self.multi_fn = None
+        if args.multi_step_decode > 1:
+            self.multi_fn = M.make_multi_decode_fn(
+                cfg, args.block_size, args.multi_step_decode, mesh,
+                use_pallas=args.use_pallas_attention)
         from dynamo_tpu.engine import sampling as S
         self._sampling = S
 
@@ -341,6 +346,11 @@ class AsyncJaxEngine:
     # -------------------------------------------------------------- decode
 
     async def _run_decode(self, seqs: list[SeqState]) -> None:
+        if (self.multi_fn is not None and seqs
+                and not self.scheduler.waiting
+                and all(s.remaining == 1 for s in self.scheduler.running)
+                and await self._run_multi_decode(seqs)):
+            return
         import jax.numpy as jnp
 
         args = self.args
@@ -374,6 +384,71 @@ class AsyncJaxEngine:
         for i, s in enumerate(seqs):
             self.scheduler.commit_computed(s, len(s.tokens))
             self._deliver(s, int(toks[i]), float(logps[i]))
+
+    async def _run_multi_decode(self, seqs: list[SeqState]) -> bool:
+        """Burst path: K decode steps in one dispatch. Returns False when a
+        precondition fails (block preallocation) so the caller falls back to
+        single-step."""
+        import jax.numpy as jnp
+
+        args = self.args
+        K = args.multi_step_decode
+        # preallocate blocks covering the whole burst for every seq
+        for s in seqs:
+            if not self._ensure_burst_blocks(s, len(s.tokens) + K):
+                return False
+
+        B = args.bucket_batch(len(seqs))
+        max_kv = max(len(s.tokens) for s in seqs) + K
+        W = args.bucket_table_width(max_kv)
+
+        last_tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        bt = np.full((B, W), NULL_BLOCK, np.int32)
+        kv_lens = np.zeros((B,), np.int32)
+        temp = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        seeds = np.zeros((B,), np.uint32)
+        step0 = np.zeros((B,), np.uint32)
+        for i, s in enumerate(seqs):
+            last_tokens[i] = s.tokens[-1]
+            positions[i] = len(s.tokens) - 1
+            n = min(len(s.block_table), W)
+            bt[i, :n] = s.block_table[:n]
+            kv_lens[i] = len(s.tokens)
+            t, k, p, seed = s.sampling_tuple()
+            temp[i], top_k[i], top_p[i] = t, k, p
+            seeds[i] = (seed if seed is not None
+                        else hash(s.request_id) & 0x7FFFFFFF) & 0xFFFFFFFF
+            step0[i] = s.step_idx & 0xFFFFFFFF
+
+        toks, logps, self.k_cache, self.v_cache = self.multi_fn(
+            self.params, jnp.asarray(last_tokens), jnp.asarray(positions),
+            jnp.asarray(bt), jnp.asarray(kv_lens), self.k_cache, self.v_cache,
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(seeds), jnp.asarray(step0))
+        toks, logps = await asyncio.to_thread(
+            lambda: (np.asarray(toks), np.asarray(logps)))
+
+        for i, s in enumerate(seqs):
+            for k in range(K):
+                self.scheduler.commit_computed(s, len(s.tokens))
+                self._deliver(s, int(toks[k, i]), float(logps[k, i]))
+                if s.finished is not None:
+                    break  # overshoot tokens are discarded
+        return True
+
+    def _ensure_burst_blocks(self, seq: SeqState, target_tokens: int) -> bool:
+        bs = self.args.block_size
+        need = (target_tokens + bs - 1) // bs - len(seq.block_table)
+        if need <= 0:
+            return True
+        got = self.pool.allocate(need)
+        if got is None:
+            return False
+        seq.block_table.extend(got)
+        return True
 
     # ------------------------------------------------------------ sampling
 

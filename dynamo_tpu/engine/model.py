@@ -177,15 +177,17 @@ def _rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
-def _paged_attention(q, k_cache_l, v_cache_l, block_tables, positions, kv_lens,
-                     cfg: ModelConfig, block_size: int):
+def _paged_attention(q, k_cache, v_cache, lidx, block_tables, positions,
+                     kv_lens, cfg: ModelConfig, block_size: int):
     """Attention of q [B,S,H,hd] over paged KV.
 
-    Gathers pages [B,W,bs,KV,hd] from the flat cache [num_slots,KV,hd] through
-    block_tables [B,W]; logical key position of gathered index t is t itself
-    (block tables are logically ordered), so masking is pure index math.
-    (This is the XLA path; the Pallas kernel in ops/paged_attention.py is the
-    TPU fast path — same contract.)
+    Gathers pages straight from the FULL cache [L,num_slots,KV,hd] at layer
+    ``lidx`` through block_tables [B,W] — one fused gather, never a per-layer
+    cache slice (slicing would copy ~the whole cache every step). Logical key
+    position of gathered index t is t itself (block tables are logically
+    ordered), so masking is pure index math. (This is the XLA path; the
+    Pallas kernel in ops/paged_attention.py is the decode fast path — same
+    contract.)
     """
     B, S, H, hd = q.shape
     KV = cfg.num_kv_heads
@@ -193,11 +195,10 @@ def _paged_attention(q, k_cache_l, v_cache_l, block_tables, positions, kv_lens,
     W = block_tables.shape[1]
     T = W * block_size
 
-    # [B, W, bs, KV, hd] -> [B, T, KV, hd]
     slot_idx = block_tables[:, :, None] * block_size + jnp.arange(block_size)[None, None, :]
     slot_idx = slot_idx.reshape(B, T)
-    k = k_cache_l[slot_idx]  # [B, T, KV, hd]
-    v = v_cache_l[slot_idx]
+    k = k_cache[lidx, slot_idx]  # [B, T, KV, hd]
+    v = v_cache[lidx, slot_idx]
 
     qg = q.reshape(B, S, KV, G, hd)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg.astype(jnp.float32), k.astype(jnp.float32))
@@ -270,8 +271,12 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
 
     x = params["embed"][tokens]  # [B,S,D]
 
-    def layer(x, xs):
-        lp, kc, vc = xs
+    def layer(carry, xs):
+        # caches ride the scan CARRY with indexed in-place updates — as scan
+        # xs/ys XLA materializes fresh stacked outputs, i.e. a full cache
+        # copy per step (measured: burst time scaled with cache size)
+        x, kc, vc = carry
+        lp, lidx = xs
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = h @ lp["wq"]
         k = h @ lp["wk"]
@@ -287,17 +292,17 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         k = _rope(k, positions, cfg.rope_theta)
 
         flat_slots = slot_map.reshape(B * S)
-        kc = kc.at[flat_slots].set(k.reshape(B * S, KV, hd), mode="drop")
-        vc = vc.at[flat_slots].set(v.reshape(B * S, KV, hd), mode="drop")
+        kc = kc.at[lidx, flat_slots].set(k.reshape(B * S, KV, hd), mode="drop")
+        vc = vc.at[lidx, flat_slots].set(v.reshape(B * S, KV, hd), mode="drop")
 
         if use_pallas and S == 1:
             # decode fast path: Pallas kernel streams pages HBM→VMEM once
             from dynamo_tpu.ops.paged_attention import paged_attention_decode
             attn = paged_attention_decode(
-                q[:, 0], kc, vc, block_tables, kv_lens,
+                q[:, 0], kc[lidx], vc[lidx], block_tables, kv_lens,
                 block_size=block_size)[:, None]
         else:
-            attn = _paged_attention(q, kc, vc, block_tables, positions,
+            attn = _paged_attention(q, kc, vc, lidx, block_tables, positions,
                                     kv_lens, cfg, block_size)
         x = x + attn.reshape(B, S, H * hd) @ lp["wo"]
 
@@ -306,9 +311,11 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             x = x + _mlp_moe(h, lp, cfg)
         else:
             x = x + _mlp_dense(h, lp)
-        return x, (kc, vc)
+        return (x, kc, vc), None
 
-    x, (k_cache, v_cache) = jax.lax.scan(layer, x, (params["layers"], k_cache, v_cache))
+    (x, k_cache, v_cache), _ = jax.lax.scan(
+        layer, (x, k_cache, v_cache),
+        (params["layers"], jnp.arange(cfg.num_layers)))
 
     x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     x_last = x[jnp.arange(B), last_idx]  # [B, D]
@@ -317,6 +324,65 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
     else:
         logits = x_last @ params["lm_head"]
     return logits.astype(jnp.float32), k_cache, v_cache
+
+
+def multi_decode(params, last_tokens, positions, block_tables, kv_lens,
+                 k_cache, v_cache, temperature, top_k, top_p, seeds, step0,
+                 *, cfg: ModelConfig, block_size: int, num_steps: int,
+                 use_pallas: bool = False):
+    """Run ``num_steps`` chained decode steps in ONE compiled program.
+
+    Per-step host dispatch dominates decode latency when the chip is remote
+    (and costs ~100µs even locally); scanning K steps on device with
+    on-device sampling amortizes it K-fold. Sampling reproduces the
+    single-step path exactly: same (seed, step) threefry key data per row
+    (engine/sampling.make_keys), so multi-step vs single-step token streams
+    are identical.
+
+    Args (B = batch):
+      last_tokens [B] — each row's newest token (whose KV is not yet written).
+      positions   [B] — that token's absolute position.
+      block_tables[B, W] — must already cover positions + num_steps slots.
+      kv_lens     [B] — current sequence length (incl. last token).
+      temperature/top_k/top_p [B], seeds [B], step0 [B] — sampling state.
+
+    Returns: (tokens [K, B], logps [K, B], k_cache, v_cache).
+    """
+    from dynamo_tpu.engine import sampling as S
+
+    B = last_tokens.shape[0]
+    bs = block_size
+
+    def step(carry, k):
+        tok, pos, kv, kc, vc = carry
+        slot = (jnp.take_along_axis(
+            block_tables, (pos // bs)[:, None], axis=1)[:, 0] * bs + pos % bs)
+        logits, kc, vc = forward(
+            params, tok[:, None], pos[:, None], slot[:, None], block_tables,
+            kv, jnp.zeros((B,), jnp.int32), kc, vc,
+            cfg=cfg, block_size=bs, use_pallas=use_pallas)
+        keys = jnp.stack(
+            [seeds.astype(jnp.uint32), (step0 + k).astype(jnp.uint32)], axis=1)
+        new_tok, logp = S.sample(logits, temperature, top_k, top_p, keys)
+        return (new_tok, pos + 1, kv + 1, kc, vc), (new_tok, logp)
+
+    (_, _, _, k_cache, v_cache), (toks, logps) = jax.lax.scan(
+        step, (last_tokens, positions, kv_lens, k_cache, v_cache),
+        jnp.arange(num_steps))
+    return toks, logps, k_cache, v_cache
+
+
+def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
+                         mesh: Optional[Mesh] = None, use_pallas: bool = False):
+    """Jitted multi-step decode with cache donation (args 5, 6)."""
+    from dynamo_tpu.ops.paged_attention import pallas_supported
+
+    use_pallas = (use_pallas and mesh is None
+                  and cfg.sliding_window is None
+                  and pallas_supported(cfg.num_kv_heads, cfg.head_dim))
+    f = functools.partial(multi_decode, cfg=cfg, block_size=block_size,
+                          num_steps=num_steps, use_pallas=use_pallas)
+    return jax.jit(f, donate_argnums=(5, 6))
 
 
 def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,

@@ -45,6 +45,11 @@ def _mask_top_p(logits, top_p):
 def sample(logits, temperature, top_k, top_p, keys):
     """Sample one token per row.
 
+    The expensive paths (categorical draw; full-vocab sort for top-p) are
+    gated behind ``lax.cond`` on whether ANY row needs them — an all-greedy
+    decode batch (the common serving case) pays only the argmax, not a
+    128k-wide sort per row per step.
+
     Args:
       logits: [B, V] f32.
       temperature: [B] f32 (0 → greedy).
@@ -54,14 +59,19 @@ def sample(logits, temperature, top_k, top_p, keys):
     """
     greedy_tok = jnp.argmax(logits, axis=-1)
 
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = logits / temp
-    scaled = _mask_top_k(scaled, top_k)
-    scaled = _mask_top_p(scaled, top_p)
+    def sampled(_):
+        temp = jnp.maximum(temperature, 1e-6)[:, None]
+        scaled = logits / temp
+        scaled = _mask_top_k(scaled, top_k)
+        any_top_p = jnp.any((top_p > 0.0) & (top_p < 1.0))
+        scaled = jax.lax.cond(any_top_p,
+                              lambda s: _mask_top_p(s, top_p),
+                              lambda s: s, scaled)
+        sampled_tok = jax.vmap(_cat)(keys, scaled)
+        return jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
 
-    sampled_tok = jax.vmap(_cat)(keys, scaled)
-
-    tokens = jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
+    any_sampling = jnp.any(temperature > 0.0)
+    tokens = jax.lax.cond(any_sampling, sampled, lambda _: greedy_tok, None)
     logp_all = jax.nn.log_softmax(logits, axis=-1)
     logp = logp_all[jnp.arange(logits.shape[0]), tokens]
     return tokens.astype(jnp.int32), logp

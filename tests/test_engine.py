@@ -246,3 +246,32 @@ async def test_pallas_attention_engine_equivalence():
         outs.append(toks)
         await eng.close()
     assert outs[0] == outs[1]
+
+
+async def test_multi_step_decode_equivalence():
+    """K-step fused decode must reproduce the single-step token stream,
+    greedy and seeded-sampling alike, including finish mid-burst."""
+    prompt = list(range(1, 20))
+    for sampling in ({}, {"temperature": 0.8, "seed": 7},
+                     {"temperature": 0.9, "top_k": 20, "seed": 3}):
+        single = tiny_engine()
+        want, wr = await collect(single, req(prompt, max_tokens=11, **sampling))
+        await single.close()
+
+        multi = tiny_engine(multi_step_decode=4)  # 11 % 4 != 0: mid-burst end
+        got, gr = await collect(multi, req(prompt, max_tokens=11, **sampling))
+        await multi.close()
+        assert got == want and gr == wr
+
+
+async def test_multi_step_decode_concurrent_batch():
+    eng = tiny_engine(multi_step_decode=4)
+    prompts = [list(range(1, 10)), list(range(5, 40)), list(range(2, 17))]
+    results = await asyncio.gather(
+        *(collect(eng, req(p, max_tokens=6)) for p in prompts))
+    await eng.close()
+    solo = tiny_engine()
+    for p, (got, _) in zip(prompts, results):
+        want, _ = await collect(solo, req(p, max_tokens=6))
+        assert got == want
+    await solo.close()
