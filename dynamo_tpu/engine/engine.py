@@ -346,9 +346,15 @@ class AsyncJaxEngine:
     # -------------------------------------------------------------- decode
 
     async def _run_decode(self, seqs: list[SeqState]) -> None:
+        K = self.args.multi_step_decode
         if (self.multi_fn is not None and seqs
                 and not self.scheduler.waiting
                 and all(s.remaining == 1 for s in self.scheduler.running)
+                # don't burn a burst when a seq is about to hit max_tokens —
+                # the overshoot steps would be computed and discarded
+                and all((s.req.stop_conditions.max_tokens is None
+                         or s.req.stop_conditions.max_tokens - s.generated >= K)
+                        for s in seqs)
                 and await self._run_multi_decode(seqs)):
             return
         import jax.numpy as jnp
@@ -393,13 +399,26 @@ class AsyncJaxEngine:
 
         args = self.args
         K = args.multi_step_decode
-        # preallocate blocks covering the whole burst for every seq
+        # the burst writes positions len-1 .. len+K-2 → len+K-1 slots.
+        # Preallocate all-or-nothing: a partial extension left behind would
+        # deepen the very memory pressure that made it fail.
+        extended: list = []
+        ok = True
         for s in seqs:
-            if not self._ensure_burst_blocks(s, len(s.tokens) + K):
-                return False
+            before = len(s.block_table)
+            if not self.scheduler._ensure_blocks(s, len(s.tokens) + K - 1):
+                ok = False
+                break
+            if len(s.block_table) > before:
+                extended.append((s, before))
+        if not ok:
+            for s, before in extended:
+                self.pool.release(s.block_table[before:])
+                del s.block_table[before:]
+            return False
 
         B = args.bucket_batch(len(seqs))
-        max_kv = max(len(s.tokens) for s in seqs) + K
+        max_kv = max(len(s.tokens) for s in seqs) + K - 1
         W = args.bucket_table_width(max_kv)
 
         last_tokens = np.zeros((B,), np.int32)
@@ -437,17 +456,6 @@ class AsyncJaxEngine:
                 self._deliver(s, int(toks[k, i]), float(logps[k, i]))
                 if s.finished is not None:
                     break  # overshoot tokens are discarded
-        return True
-
-    def _ensure_burst_blocks(self, seq: SeqState, target_tokens: int) -> bool:
-        bs = self.args.block_size
-        need = (target_tokens + bs - 1) // bs - len(seq.block_table)
-        if need <= 0:
-            return True
-        got = self.pool.allocate(need)
-        if got is None:
-            return False
-        seq.block_table.extend(got)
         return True
 
     # ------------------------------------------------------------ sampling

@@ -296,10 +296,18 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         vc = vc.at[lidx, flat_slots].set(v.reshape(B * S, KV, hd), mode="drop")
 
         if use_pallas and S == 1:
-            # decode fast path: Pallas kernel streams pages HBM→VMEM once
+            # decode fast path: Pallas kernel streams pages HBM→VMEM once.
+            # The kernel sees the FULL cache flattened to [L·slots, KV, hd]
+            # with block ids offset into layer lidx — slicing kc[lidx] would
+            # materialize a whole layer's cache per step.
             from dynamo_tpu.ops.paged_attention import paged_attention_decode
+            L_, slots_ = kc.shape[0], kc.shape[1]
+            nb = slots_ // block_size
             attn = paged_attention_decode(
-                q[:, 0], kc[lidx], vc[lidx], block_tables, kv_lens,
+                q[:, 0],
+                kc.reshape(L_ * slots_, KV, hd),
+                vc.reshape(L_ * slots_, KV, hd),
+                block_tables + lidx * nb, kv_lens,
                 block_size=block_size)[:, None]
         else:
             attn = _paged_attention(q, kc, vc, lidx, block_tables, positions,

@@ -1,0 +1,102 @@
+"""Recorders: capture request/response streams and KV events to JSONL.
+
+Rebuild of the reference's Recorder/KvRecorder (ref: lib/llm/src/
+recorder.rs:26-667, kv_router/recorder.rs:1-134): every recorded line is
+``{"ts": float, "kind": str, "data": ...}``; replay yields them back with
+optional timing preservation — used for router benchmarks and postmortem
+debugging.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, AsyncIterator, Optional
+
+
+class Recorder:
+    """Append-only JSONL event recorder."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a", encoding="utf-8")
+        self._t0 = time.monotonic()
+
+    def record(self, kind: str, data: Any) -> None:
+        line = json.dumps({"ts": round(time.monotonic() - self._t0, 6),
+                           "kind": kind, "data": data})
+        self._f.write(line + "\n")
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    async def wrap_stream(self, stream: AsyncIterator, kind: str = "response"
+                          ) -> AsyncIterator:
+        """Tee an async stream into the log."""
+        async for item in stream:
+            self.record(kind, item)
+            yield item
+
+
+def load_events(path: str) -> list[dict]:
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+async def replay(path: str, speed: float = 0.0) -> AsyncIterator[dict]:
+    """Yield recorded events; ``speed`` > 0 preserves inter-event timing
+    scaled by 1/speed (2.0 = twice as fast), 0 = as fast as possible."""
+    prev_ts: Optional[float] = None
+    for ev in load_events(path):
+        if speed > 0 and prev_ts is not None:
+            delay = (ev["ts"] - prev_ts) / speed
+            if delay > 0:
+                await asyncio.sleep(delay)
+        prev_ts = ev["ts"]
+        yield ev
+
+
+class KvRecorder:
+    """Records RouterEvents from the kv_events stream for later replay."""
+
+    def __init__(self, plane, path: str, stream: Optional[str] = None):
+        from dynamo_tpu.router.protocols import KV_EVENTS_STREAM
+
+        self.plane = plane
+        self.recorder = Recorder(path)
+        self.stream = stream or KV_EVENTS_STREAM
+        self._task = None
+        self._sub = None
+
+    async def start(self) -> "KvRecorder":
+        import msgpack
+
+        self._sub = await self.plane.stream_subscribe(self.stream)
+
+        async def loop():
+            try:
+                async for _seq, payload in self._sub:
+                    self.recorder.record(
+                        "kv_event", msgpack.unpackb(payload, raw=False))
+            except asyncio.CancelledError:
+                pass
+
+        self._task = asyncio.get_running_loop().create_task(loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._sub:
+            await self._sub.cancel()
+        self.recorder.flush()
+        self.recorder.close()

@@ -1,0 +1,1 @@
+"""Standalone metrics-aggregator component (ref: components/metrics)."""

@@ -1,0 +1,126 @@
+"""``python -m dynamo_tpu.metrics.main`` — cluster metrics aggregator.
+
+Rebuild of the reference's metrics component (ref: components/metrics/src/
+main.rs:1-251): subscribes to worker ForwardPassMetrics and KV events,
+aggregates load/capacity + KV-hit-rate, and exposes them as Prometheus
+gauges on ``/metrics`` for dashboards and the planner.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+import msgpack
+from aiohttp import web
+
+from dynamo_tpu.router.protocols import KV_EVENTS_STREAM
+from dynamo_tpu.router.publisher import MetricsAggregator
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.config import setup_logging
+
+logger = logging.getLogger("dynamo.metrics")
+
+
+class MetricsService:
+    def __init__(self, runtime: DistributedRuntime):
+        self.runtime = runtime
+        self.agg = MetricsAggregator(runtime.plane)
+        self.kv_stored = 0
+        self.kv_removed = 0
+        self._kv_task = None
+        self._kv_sub = None
+
+    async def start(self):
+        await self.agg.start()
+        self._kv_sub = await self.runtime.plane.stream_subscribe(KV_EVENTS_STREAM)
+
+        async def kv_loop():
+            try:
+                async for _seq, payload in self._kv_sub:
+                    try:
+                        ev = msgpack.unpackb(payload, raw=False)
+                        data = ev.get("event") or {}
+                        if "stored" in data:
+                            self.kv_stored += len(
+                                data["stored"].get("blocks") or [])
+                        elif "removed" in data:
+                            self.kv_removed += len(
+                                data["removed"].get("block_hashes") or [])
+                    except Exception:
+                        logger.exception("bad kv event ignored")
+            except asyncio.CancelledError:
+                pass
+
+        self._kv_task = asyncio.get_running_loop().create_task(kv_loop())
+        return self
+
+    async def stop(self):
+        if self._kv_task:
+            self._kv_task.cancel()
+        if self._kv_sub:
+            await self._kv_sub.cancel()
+        await self.agg.stop()
+
+    def render(self) -> str:
+        a = self.agg.aggregate()
+        lines = []
+
+        def gauge(name, value, help_):
+            lines.append(f"# HELP dynamo_{name} {help_}")
+            lines.append(f"# TYPE dynamo_{name} gauge")
+            lines.append(f"dynamo_{name} {value}")
+
+        gauge("workers", a["workers"], "live workers reporting metrics")
+        gauge("kv_active_blocks", a["kv_active_blocks"], "in-use KV blocks")
+        gauge("kv_total_blocks", a["kv_total_blocks"], "total KV blocks")
+        gauge("kv_cache_usage_perc", a["gpu_cache_usage_perc"],
+              "cluster KV usage fraction")
+        gauge("requests_active", a["requests_active"], "in-flight requests")
+        gauge("requests_waiting", a["requests_waiting"], "queued requests")
+        gauge("kv_blocks_stored_total", self.kv_stored,
+              "KV stored events observed")
+        gauge("kv_blocks_removed_total", self.kv_removed,
+              "KV removed events observed")
+        return "\n".join(lines) + "\n"
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="dynamo-tpu metrics aggregator")
+    ap.add_argument("--port", type=int, default=9091)
+    cli = ap.parse_args()
+
+    runtime = await DistributedRuntime.create()
+    svc = await MetricsService(runtime).start()
+
+    async def metrics(_req):
+        return web.Response(text=svc.render(),
+                            content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", cli.port)
+    await site.start()
+    print(f"metrics aggregator on :{cli.port}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await svc.stop()
+    await runner.cleanup()
+    await runtime.shutdown()
+
+
+def main():
+    setup_logging()
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,189 @@
+"""``python -m dynamo_tpu.run in=http out=engine --model ...`` — one-command
+serving, the reference's ``dynamo-run`` CLI analog (ref: launch/dynamo-run/
+src/main.rs:30, opt.rs:7).
+
+``in=``  http | text            (OpenAI server, or interactive REPL)
+``out=`` engine | mocker | echo (native JAX engine, simulator, or echo)
+
+Everything runs in ONE process over the in-process control plane unless
+DYN_CONTROL_PLANE points at a dynctl/etcd-style endpoint — handy for local
+smoke tests and demos; production uses the separate frontend/worker mains.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.config import setup_logging
+
+
+def parse_inout(argv):
+    inp, out, rest = "http", "engine", []
+    for a in argv:
+        if a.startswith("in="):
+            inp = a[3:]
+        elif a.startswith("out="):
+            out = a[4:]
+        else:
+            rest.append(a)
+    if inp not in ("http", "text"):
+        raise SystemExit(f"unknown in={inp} (http|text)")
+    if out not in ("engine", "mocker", "echo"):
+        raise SystemExit(f"unknown out={out} (engine|mocker|echo)")
+    return inp, out, rest
+
+
+async def start_worker(runtime, out: str, cli):
+    if out == "mocker":
+        from dynamo_tpu.mocker.engine import MockEngineArgs
+        from dynamo_tpu.mocker.main import run_mocker
+
+        engine, handle = await run_mocker(runtime, cli.model, MockEngineArgs())
+        return handle
+
+    if out == "echo":
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+        from dynamo_tpu.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+
+        async def echo(request, ctx):
+            req = PreprocessedRequest.from_wire(request)
+            for t in req.token_ids:
+                yield LLMEngineOutput(token_ids=[t]).to_wire()
+            yield LLMEngineOutput(
+                token_ids=[], finish_reason=FinishReason.STOP).to_wire()
+
+        ep = runtime.namespace("dynamo").component("echo").endpoint("generate")
+        handle = await ep.serve_endpoint(echo)
+        card = ModelDeploymentCard(
+            display_name=cli.model, kv_cache_block_size=16,
+            eos_token_ids=[], tokenizer_ref=cli.model_path or "test")
+        await register_llm(runtime, ep, card)
+        return handle
+
+    # native JAX engine (aggregated role)
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+
+    if cli.model_path:
+        cfg = ModelConfig.from_pretrained(cli.model_path)
+        from dynamo_tpu.engine.loader import load_hf_params
+        params = load_hf_params(cfg, cli.model_path)
+    else:
+        cfg = getattr(ModelConfig, cli.arch)()
+        params = None
+    eargs = EngineArgs(multi_step_decode=cli.multi_step_decode,
+                       use_pallas_attention=cli.use_pallas_attention)
+    engine = AsyncJaxEngine(cfg, eargs, params=params)
+    handler = DecodeWorkerHandler(engine)
+    ep = runtime.namespace("dynamo").component("backend").endpoint("generate")
+    handle = await ep.serve_endpoint(handler.generate)
+    card = ModelDeploymentCard(
+        display_name=cli.model, kv_cache_block_size=eargs.block_size,
+        eos_token_ids=[2], tokenizer_ref=cli.model_path or "test")
+    card.runtime_config.total_kv_blocks = engine.num_blocks
+    await register_llm(runtime, ep, card)
+    return handle
+
+
+async def run_text_repl(manager):
+    """Interactive REPL (in=text): reads prompts, streams completions."""
+    from dynamo_tpu.protocols.openai import parse_chat_request
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.protocols import Annotated
+
+    print("interactive chat — empty line or Ctrl-D to exit", flush=True)
+    loop = asyncio.get_running_loop()
+    while True:
+        line = await loop.run_in_executor(None, _read_prompt)
+        if not line:
+            return
+        model = manager.list_models()[0]
+        req = parse_chat_request({
+            "model": model, "stream": True,
+            "messages": [{"role": "user", "content": line}],
+        })
+        served = manager.get(model)
+        async for wire in served.pipeline.generate(req, Context()):
+            ann = Annotated.from_wire(wire)
+            if ann.event is not None or ann.data is None:
+                continue
+            for ch in ann.data.get("choices", []):
+                delta = (ch.get("delta") or {}).get("content")
+                if delta:
+                    print(delta, end="", flush=True)
+        print(flush=True)
+
+
+def _read_prompt():
+    try:
+        return input("> ").strip()
+    except EOFError:
+        return ""
+
+
+async def amain():
+    inp, out, rest = parse_inout(sys.argv[1:])
+    ap = argparse.ArgumentParser(description="dynamo-tpu run")
+    ap.add_argument("--model", default="dynamo-model")
+    ap.add_argument("--model-path", default=None)
+    ap.add_argument("--arch", default="tiny")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--router-mode", default="kv",
+                    choices=["kv", "round_robin", "random"])
+    ap.add_argument("--multi-step-decode", type=int, default=1)
+    ap.add_argument("--use-pallas-attention", action="store_true")
+    ap.add_argument("--vocab-size", type=int, default=0)
+    cli = ap.parse_args(rest)
+
+    runtime = await DistributedRuntime.create()
+    handle = await start_worker(runtime, out, cli)
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+
+    manager = ModelManager()
+    watcher = await ModelWatcher(runtime, manager,
+                                 router_mode=cli.router_mode).start()
+    # wait for the model registration to flow through discovery
+    for _ in range(100):
+        if manager.list_models():
+            break
+        await asyncio.sleep(0.05)
+
+    if inp == "text":
+        try:
+            await run_text_repl(manager)
+        finally:
+            await watcher.stop()
+            await handle.stop()
+            await runtime.shutdown()
+        return
+
+    service = HttpService(manager, port=cli.port)
+    await service.start()
+    print(f"READY http://localhost:{service.port}/v1  model={cli.model}",
+          flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await service.stop()
+    await watcher.stop()
+    await handle.stop()
+    await runtime.shutdown()
+
+
+def main():
+    setup_logging()
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

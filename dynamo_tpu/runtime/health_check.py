@@ -1,0 +1,95 @@
+"""Canary health checks: probe idle endpoints, drive instance health state.
+
+Rebuild of the reference's health-check manager (ref: lib/runtime/src/
+health_check.rs:20-579): each watched endpoint gets a canary payload; when an
+instance has been idle longer than the check interval, the manager sends the
+canary directly to it. Failures mark the instance down on the shared Client
+(so routing skips it); a later success restores it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+logger = logging.getLogger("dynamo.health")
+
+
+@dataclass
+class HealthCheckConfig:
+    #: probe an instance after this much idle time (s)
+    check_interval_s: float = 10.0
+    #: canary request timeout (s)
+    timeout_s: float = 5.0
+    #: consecutive failures before marking down
+    failure_threshold: int = 2
+    #: payload sent as the canary request (engine-specific, e.g. 1-token gen)
+    payload: Any = field(default_factory=lambda: {"health_check": True})
+
+
+class HealthCheckManager:
+    """Probes every instance of one endpoint client on a timer."""
+
+    def __init__(self, client, config: Optional[HealthCheckConfig] = None):
+        self.client = client
+        self.cfg = config or HealthCheckConfig()
+        self._failures: dict[int, int] = {}
+        self._last_ok: dict[int, float] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+
+    def note_activity(self, instance_id: int) -> None:
+        """Real traffic succeeded on this instance — reset its canary clock."""
+        self._last_ok[instance_id] = time.monotonic()
+        self._failures.pop(instance_id, None)
+
+    async def start(self) -> "HealthCheckManager":
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task:
+            await self._task
+
+    async def _loop(self) -> None:
+        interval = max(0.5, self.cfg.check_interval_s / 4)
+        while not self._stop.is_set():
+            try:
+                await self._probe_idle()
+            except Exception:
+                logger.exception("health probe iteration failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), interval)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _probe_idle(self) -> None:
+        now = time.monotonic()
+        for iid in self.client.instance_ids():
+            last = self._last_ok.get(iid, 0.0)
+            if now - last < self.cfg.check_interval_s:
+                continue
+            await self._probe(iid)
+
+    async def _probe(self, iid: int) -> None:
+        try:
+            stream = await asyncio.wait_for(
+                self.client.generate(self.cfg.payload, mode="direct",
+                                     instance_id=iid),
+                self.cfg.timeout_s)
+            async for _ in stream:  # drain; any frame counts as life
+                break
+            self.note_activity(iid)
+            # a previously-down instance that answers is routable again
+            self.client._down.discard(iid)
+        except Exception as e:
+            n = self._failures.get(iid, 0) + 1
+            self._failures[iid] = n
+            logger.warning("canary failed for %x (%d/%d): %r", iid, n,
+                           self.cfg.failure_threshold, e)
+            if n >= self.cfg.failure_threshold:
+                self.client.report_instance_down(iid)

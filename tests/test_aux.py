@@ -1,0 +1,90 @@
+"""Aux subsystems: canary health checks, recorders, metrics aggregation."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.llm.recorder import Recorder, KvRecorder, load_events, replay
+from dynamo_tpu.runtime.health_check import HealthCheckConfig, HealthCheckManager
+
+pytestmark = pytest.mark.anyio
+
+
+class FakeClient:
+    """Minimal Client surface for the health manager."""
+
+    def __init__(self, healthy: set, all_ids):
+        self.healthy = healthy
+        self.ids = list(all_ids)
+        self._down = set()
+
+    def instance_ids(self):
+        return list(self.ids)
+
+    def report_instance_down(self, iid):
+        self._down.add(iid)
+
+    async def generate(self, payload, mode="direct", instance_id=None):
+        if instance_id not in self.healthy:
+            raise RuntimeError("no responders")
+
+        async def stream():
+            yield {"ok": True}
+        return stream()
+
+
+async def test_health_check_marks_down_and_restores():
+    client = FakeClient(healthy={1}, all_ids=[1, 2])
+    cfg = HealthCheckConfig(check_interval_s=0.05, timeout_s=0.5,
+                            failure_threshold=2)
+    mgr = await HealthCheckManager(client, cfg).start()
+    for _ in range(100):
+        if 2 in client._down:
+            break
+        await asyncio.sleep(0.02)
+    assert 2 in client._down and 1 not in client._down
+
+    client.healthy.add(2)  # instance recovers → canary restores routing
+    for _ in range(100):
+        if 2 not in client._down:
+            break
+        await asyncio.sleep(0.02)
+    assert 2 not in client._down
+    await mgr.stop()
+
+
+async def test_recorder_roundtrip(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    r = Recorder(path)
+    r.record("request", {"prompt": "hi"})
+    r.record("response", {"token_ids": [1, 2]})
+    r.flush()
+    evs = load_events(path)
+    assert [e["kind"] for e in evs] == ["request", "response"]
+    got = []
+    async for ev in replay(path):
+        got.append(ev["data"])
+    assert got[0] == {"prompt": "hi"}
+
+
+async def test_kv_recorder_captures_stream(tmp_path):
+    import msgpack
+
+    from dynamo_tpu.router.protocols import KvCacheEvent, RouterEvent, StoredBlock
+    from dynamo_tpu.runtime.control_plane import LocalControlPlane
+
+    plane = LocalControlPlane()
+    path = str(tmp_path / "kv.jsonl")
+    rec = await KvRecorder(plane, path).start()
+    ev = RouterEvent(7, KvCacheEvent.stored(
+        1, None, [StoredBlock(block_hash=11, tokens_hash=22)]))
+    await plane.stream_publish("kv_events", msgpack.packb(ev.to_wire()))
+    for _ in range(50):
+        await asyncio.sleep(0.01)
+        rec.recorder.flush()
+        if load_events(path):
+            break
+    await rec.stop()
+    evs = load_events(path)
+    assert evs and evs[0]["data"]["worker_id"] == 7

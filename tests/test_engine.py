@@ -275,3 +275,24 @@ async def test_multi_step_decode_concurrent_batch():
         want, _ = await collect(solo, req(p, max_tokens=6))
         assert got == want
     await solo.close()
+
+
+async def test_multi_step_decode_with_pallas_kernel():
+    """Burst path + Pallas kernel (interpret on CPU) matches the XLA path."""
+    prompt = list(range(1, 30))
+    cfg = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=64,
+                      dtype="float32", max_position_embeddings=512)
+    outs = []
+    for use_pallas in (False, True):
+        args = EngineArgs(block_size=8, num_blocks=64, max_num_seqs=4,
+                          max_num_batched_tokens=64, max_model_len=128,
+                          use_pallas_attention=use_pallas,
+                          multi_step_decode=3,
+                          prefill_buckets=(8, 16, 32, 64),
+                          decode_batch_buckets=(1, 2, 4))
+        eng = AsyncJaxEngine(cfg, args)
+        toks, _ = await collect(eng, req(prompt, max_tokens=9))
+        outs.append(toks)
+        await eng.close()
+    assert outs[0] == outs[1] and len(outs[0]) == 9

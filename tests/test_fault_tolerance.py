@@ -1,0 +1,179 @@
+"""Fault tolerance e2e: kill a worker mid-stream → Migration resumes on
+another worker; worker death with no replacement → clean stream error.
+
+Cross-process analog of the reference's fault-tolerance suite
+(ref: tests/fault_tolerance/test_request_migration.py:293 — ManagedProcess
+kill + stream continuation assertions).
+"""
+
+import asyncio
+import json
+import os
+import signal
+import sys
+
+import socket
+
+import pytest
+
+pytestmark = pytest.mark.anyio
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+async def _spawn(args, port_env, ready_marker, log_name):
+    env = dict(os.environ, PYTHONPATH=REPO, DYN_CONTROL_PLANE=port_env,
+               JAX_PLATFORMS="cpu", DYN_LOG="warning")
+    proc = await asyncio.create_subprocess_exec(
+        PY, *args, env=env,
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT)
+    buf = []
+
+    async def wait_ready():
+        while True:
+            line = await proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"{log_name} exited before ready:\n" + b"".join(buf).decode())
+            buf.append(line)
+            if ready_marker.encode() in line:
+                return
+
+    await asyncio.wait_for(wait_ready(), 90)
+    # keep draining so the pipe never blocks the child
+    async def drain():
+        while True:
+            line = await proc.stdout.readline()
+            if not line:
+                return
+            buf.append(line)
+
+    task = asyncio.get_running_loop().create_task(drain())
+    proc._drain_task = task
+    proc._log = buf
+    return proc
+
+
+@pytest.mark.anyio
+async def test_migration_resumes_stream_on_worker_kill():
+    cp_port = free_port()
+    http_port = free_port()
+    addr = f"127.0.0.1:{cp_port}"
+    procs = []
+    try:
+        dynctl = await _spawn(
+            ["-m", "dynamo_tpu.runtime.dynctl", "--port", str(cp_port)],
+            addr, "dynctl listening", "dynctl")
+        procs.append(dynctl)
+
+        worker_args = ["-m", "dynamo_tpu.mocker.main", "--model", "mock",
+                       "--speedup-ratio", "0.2"]  # slow decode: ~10ms/token
+        w1 = await _spawn(worker_args, addr, "MOCKER_READY", "worker1")
+        procs.append(w1)
+
+        frontend = await _spawn(
+            ["-m", "dynamo_tpu.frontend.main", "--port", str(http_port),
+             "--router-mode", "round_robin"],
+            addr, "FRONTEND_READY", "frontend")
+        procs.append(frontend)
+
+        import aiohttp
+
+        chunks = []
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"http://127.0.0.1:{http_port}/v1/chat/completions",
+                json={"model": "mock", "stream": True,
+                      "messages": [{"role": "user", "content": "hello world"}],
+                      "max_tokens": 60, "ignore_eos": True},
+            ) as resp:
+                assert resp.status == 200
+                killed = False
+                second = None
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    payload = json.loads(line[6:])
+                    assert "error" not in payload, payload
+                    for ch in payload.get("choices", []):
+                        if (ch.get("delta") or {}).get("content"):
+                            chunks.append(ch["delta"]["content"])
+                    if len(chunks) >= 8 and not killed:
+                        # second worker up BEFORE the kill → migration target
+                        second = await _spawn(worker_args, addr,
+                                              "MOCKER_READY", "worker2")
+                        procs.append(second)
+                        w1.send_signal(signal.SIGKILL)
+                        killed = True
+        assert killed
+        # the stream must have continued past the kill point to completion
+        assert len(chunks) >= 30, f"stream died at {len(chunks)} chunks"
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        await asyncio.gather(*(p.wait() for p in procs),
+                             return_exceptions=True)
+
+
+@pytest.mark.anyio
+async def test_worker_kill_without_replacement_errors_cleanly():
+    cp_port = free_port()
+    http_port = free_port()
+    addr = f"127.0.0.1:{cp_port}"
+    procs = []
+    try:
+        procs.append(await _spawn(
+            ["-m", "dynamo_tpu.runtime.dynctl", "--port", str(cp_port)],
+            addr, "dynctl listening", "dynctl"))
+        w1 = await _spawn(
+            ["-m", "dynamo_tpu.mocker.main", "--model", "mock",
+             "--speedup-ratio", "0.2"],
+            addr, "MOCKER_READY", "worker1")
+        procs.append(w1)
+        procs.append(await _spawn(
+            ["-m", "dynamo_tpu.frontend.main", "--port", str(http_port),
+             "--router-mode", "round_robin"],
+            addr, "FRONTEND_READY", "frontend"))
+
+        import aiohttp
+
+        saw_error = False
+        n = 0
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"http://127.0.0.1:{http_port}/v1/chat/completions",
+                json={"model": "mock", "stream": True,
+                      "messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 60, "ignore_eos": True},
+            ) as resp:
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    payload = json.loads(line[6:])
+                    if "error" in payload:
+                        saw_error = True
+                        break
+                    n += 1
+                    if n == 5:
+                        w1.send_signal(signal.SIGKILL)
+        assert saw_error, "stream ended without surfacing an error"
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        await asyncio.gather(*(p.wait() for p in procs),
+                             return_exceptions=True)
