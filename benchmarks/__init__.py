@@ -1,0 +1,1 @@
+"""Benchmark harnesses (ref: benchmarks/ in the reference)."""

@@ -1,0 +1,105 @@
+"""Shared async OpenAI benchmarking client: streaming requests with TTFT/ITL
+measurement (the genai-perf-style core the harnesses build on — ref:
+benchmarks/utils/ in the reference)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import aiohttp
+
+
+@dataclass
+class RequestResult:
+    ok: bool
+    ttft_s: Optional[float] = None
+    latency_s: Optional[float] = None
+    itl_s: list = field(default_factory=list)
+    tokens: int = 0
+    error: Optional[str] = None
+
+
+def make_prompt(rng: random.Random, n_words: int, prefix: str = "") -> str:
+    body = " ".join(f"w{rng.randrange(10_000)}" for _ in range(n_words))
+    return (prefix + " " + body) if prefix else body
+
+
+async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
+                         prompt: str, max_tokens: int) -> RequestResult:
+    t0 = time.perf_counter()
+    res = RequestResult(ok=False)
+    try:
+        async with session.post(
+            f"{url}/v1/chat/completions",
+            json={"model": model, "stream": True, "ignore_eos": True,
+                  "max_tokens": max_tokens,
+                  "messages": [{"role": "user", "content": prompt}]},
+        ) as resp:
+            if resp.status != 200:
+                res.error = f"http {resp.status}"
+                return res
+            last = None
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                now = time.perf_counter()
+                if res.ttft_s is None:
+                    res.ttft_s = now - t0
+                elif last is not None:
+                    res.itl_s.append(now - last)
+                last = now
+                res.tokens += 1
+            res.latency_s = time.perf_counter() - t0
+            res.ok = res.ttft_s is not None
+            return res
+    except Exception as e:
+        res.error = repr(e)
+        return res
+
+
+async def run_closed_loop(url: str, model: str, *, concurrency: int,
+                          num_requests: int, isl_words: int, osl: int,
+                          prefix: str = "", seed: int = 0) -> list[RequestResult]:
+    """Closed-loop load: ``concurrency`` workers issue requests back-to-back."""
+    rng = random.Random(seed)
+    prompts = [make_prompt(rng, isl_words, prefix) for _ in range(num_requests)]
+    q: asyncio.Queue = asyncio.Queue()
+    for p in prompts:
+        q.put_nowait(p)
+    results: list[RequestResult] = []
+
+    async with aiohttp.ClientSession() as session:
+        async def worker():
+            while True:
+                try:
+                    p = q.get_nowait()
+                except asyncio.QueueEmpty:
+                    return
+                results.append(
+                    await stream_request(session, url, model, p, osl))
+
+        await asyncio.gather(*(worker() for _ in range(concurrency)))
+    return results
+
+
+def summarize(results: list[RequestResult]) -> dict:
+    import numpy as np
+
+    ok = [r for r in results if r.ok]
+    ttfts = sorted(r.ttft_s for r in ok)
+    itls = [x for r in ok for x in r.itl_s]
+    total_tokens = sum(r.tokens for r in ok)
+    wall = max((r.latency_s or 0) for r in ok) if ok else 0
+    return {
+        "requests": len(results),
+        "ok": len(ok),
+        "ttft_p50_ms": round(1e3 * float(np.percentile(ttfts, 50)), 2) if ttfts else None,
+        "ttft_p95_ms": round(1e3 * float(np.percentile(ttfts, 95)), 2) if ttfts else None,
+        "itl_p50_ms": round(1e3 * float(np.percentile(itls, 50)), 2) if itls else None,
+        "tokens": total_tokens,
+    }

@@ -1,0 +1,65 @@
+"""Pre-deployment SLA profiler: sweep one worker, emit interpolation tables.
+
+ref: benchmarks/profiler/profile_sla.py — the planner inverts these sweeps
+(planner/perf_interpolation.py) to size prefill/decode fleets. Output JSON:
+
+    {"prefill": [[req_per_s, ttft_ms], ...],
+     "decode":  [[tok_per_s, itl_ms], ...],
+     "isl_words": N, "osl": M}
+
+Usage: python -m benchmarks.profile_sla --url http://localhost:8000 \
+           --model demo --out profile.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+from benchmarks.client import run_closed_loop, summarize
+
+
+async def sweep(url: str, model: str, isl_words: int, osl: int,
+                concurrencies: list[int], requests_per_level: int):
+    prefill_pts, decode_pts = [], []
+    for c in concurrencies:
+        results = await run_closed_loop(
+            url, model, concurrency=c, num_requests=requests_per_level,
+            isl_words=isl_words, osl=osl)
+        ok = [r for r in results if r.ok]
+        if not ok:
+            break
+        s = summarize(results)
+        wall = sum(r.latency_s for r in ok) / max(1, c)  # per-worker stream time
+        req_rate = len(ok) / max(1e-9, wall)
+        tok_rate = sum(r.tokens for r in ok) / max(1e-9, wall)
+        prefill_pts.append([round(req_rate, 3), s["ttft_p50_ms"]])
+        decode_pts.append([round(tok_rate, 1), s["itl_p50_ms"]])
+        print(f"concurrency={c}: {s}", flush=True)
+    return prefill_pts, decode_pts
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="SLA profiling sweep")
+    ap.add_argument("--url", default="http://127.0.0.1:8000")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--isl-words", type=int, default=512)
+    ap.add_argument("--osl", type=int, default=64)
+    ap.add_argument("--concurrencies", default="1,2,4,8,16,32")
+    ap.add_argument("--requests-per-level", type=int, default=16)
+    ap.add_argument("--out", default="profile.json")
+    cli = ap.parse_args()
+
+    cs = [int(x) for x in cli.concurrencies.split(",")]
+    prefill, decode = await sweep(cli.url, cli.model, cli.isl_words, cli.osl,
+                                  cs, cli.requests_per_level)
+    out = {"prefill": prefill, "decode": decode,
+           "isl_words": cli.isl_words, "osl": cli.osl}
+    with open(cli.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {cli.out}")
+
+
+if __name__ == "__main__":
+    asyncio.run(amain())

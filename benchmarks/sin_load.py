@@ -1,0 +1,57 @@
+"""Sinusoidal open-loop load generator (planner scaling exercises).
+
+ref: benchmarks/sin_load_generator/sin_synth.py — request rate follows
+``base + amp * sin(2π t / period)``; used to drive planner scale-up/down.
+
+Usage: python -m benchmarks.sin_load --url http://... --model demo \
+           --base-rps 2 --amp-rps 1.5 --period-s 60 --duration-s 180
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import random
+import time
+
+import aiohttp
+
+from benchmarks.client import make_prompt, stream_request, summarize
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="sinusoidal load generator")
+    ap.add_argument("--url", default="http://127.0.0.1:8000")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--base-rps", type=float, default=2.0)
+    ap.add_argument("--amp-rps", type=float, default=1.5)
+    ap.add_argument("--period-s", type=float, default=60.0)
+    ap.add_argument("--duration-s", type=float, default=180.0)
+    ap.add_argument("--isl-words", type=int, default=128)
+    ap.add_argument("--osl", type=int, default=32)
+    cli = ap.parse_args()
+
+    rng = random.Random(0)
+    results = []
+    inflight: set = set()
+    t0 = time.monotonic()
+    async with aiohttp.ClientSession() as session:
+        while (now := time.monotonic() - t0) < cli.duration_s:
+            rate = max(0.05, cli.base_rps
+                       + cli.amp_rps * math.sin(2 * math.pi * now / cli.period_s))
+            task = asyncio.get_running_loop().create_task(stream_request(
+                session, cli.url, cli.model,
+                make_prompt(rng, cli.isl_words), cli.osl))
+            inflight.add(task)
+            task.add_done_callback(
+                lambda t: (inflight.discard(t), results.append(t.result())))
+            await asyncio.sleep(1.0 / rate)
+        if inflight:
+            await asyncio.gather(*inflight, return_exceptions=True)
+    print(json.dumps(summarize(results)))
+
+
+if __name__ == "__main__":
+    asyncio.run(amain())

@@ -1,0 +1,62 @@
+"""models/ registry + MoE engine path (EP-shardable token-choice experts)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu import models
+from dynamo_tpu.engine.config import EngineArgs
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.protocols import (
+    PreprocessedRequest, SamplingOptions, StopConditions,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+def test_presets_resolve():
+    for name in models.PRESETS:
+        cfg = models.get_model_config(name)
+        assert cfg.num_layers > 0 and cfg.vocab_size > 0
+    with pytest.raises(KeyError):
+        models.get_model_config("nope")
+
+
+def test_unsupported_arch_fails_loudly():
+    with pytest.raises(NotImplementedError):
+        models.from_hf_config(
+            {"architectures": ["DeepseekV3ForCausalLM"], "vocab_size": 100})
+
+
+def test_hf_mapping_round_trip():
+    cfg = models.from_hf_config({
+        "architectures": ["MixtralForCausalLM"], "vocab_size": 32000,
+        "hidden_size": 4096, "intermediate_size": 14336,
+        "num_hidden_layers": 32, "num_attention_heads": 32,
+        "num_key_value_heads": 8, "num_local_experts": 8,
+        "num_experts_per_tok": 2,
+    })
+    assert cfg.is_moe and cfg.num_experts == 8
+
+
+async def test_moe_engine_generates_deterministically():
+    cfg = models.get_model_config("moe_tiny")
+    args = EngineArgs(block_size=4, num_blocks=64, max_num_seqs=4,
+                      max_num_batched_tokens=64, max_model_len=128,
+                      prefill_buckets=(8, 16, 32, 64),
+                      decode_batch_buckets=(1, 2, 4))
+    req = PreprocessedRequest(
+        model="moe", token_ids=list(range(1, 18)),
+        stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+        sampling_options=SamplingOptions())
+
+    async def run():
+        eng = AsyncJaxEngine(cfg, args)
+        toks = []
+        async for out in eng.generate(req):
+            toks.extend(out.token_ids)
+        await eng.close()
+        return toks
+
+    t1, t2 = await run(), await run()
+    assert t1 == t2 and len(t1) == 6
