@@ -27,6 +27,30 @@ MODEL_TYPE_COMPLETIONS = "completions"
 MODEL_TYPE_EMBEDDINGS = "embeddings"
 
 
+def resolve_eos_token_ids(model_path: str) -> list[int]:
+    """EOS ids from generation_config.json, falling back to config.json.
+
+    (ref: model_card.rs loads the same HF artifacts for its MDC.)
+    Raises ValueError when neither file yields an ``eos_token_id``.
+    """
+    import os
+
+    def _norm(v):
+        if v is None:
+            return []
+        return [int(x) for x in (v if isinstance(v, list) else [v])]
+
+    for name in ("generation_config.json", "config.json"):
+        p = os.path.join(model_path, name)
+        if os.path.exists(p):
+            with open(p) as f:
+                ids = _norm(json.load(f).get("eos_token_id"))
+            if ids:
+                return ids
+    raise ValueError(
+        f"could not resolve eos_token_id from {model_path}; pass explicit EOS ids")
+
+
 def slugify(name: str) -> str:
     out = []
     for ch in name:

@@ -42,7 +42,10 @@ async def start_worker(runtime, out: str, cli):
         from dynamo_tpu.mocker.engine import MockEngineArgs
         from dynamo_tpu.mocker.main import run_mocker
 
-        engine, handle = await run_mocker(runtime, cli.model, MockEngineArgs())
+        margs = MockEngineArgs()
+        if cli.vocab_size:
+            margs.vocab_size = cli.vocab_size
+        engine, handle = await run_mocker(runtime, cli.model, margs)
         return handle
 
     if out == "echo":
@@ -70,11 +73,22 @@ async def start_worker(runtime, out: str, cli):
     from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
     from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
 
+    # resolve EOS before the heavy param load so a bad checkpoint dir fails
+    # in milliseconds (same fail-fast property as engine/main.py)
     if cli.model_path:
+        from dynamo_tpu.llm.model_card import resolve_eos_token_ids
+        eos = resolve_eos_token_ids(cli.model_path)
         cfg = ModelConfig.from_pretrained(cli.model_path)
         from dynamo_tpu.engine.loader import load_hf_params
         params = load_hf_params(cfg, cli.model_path)
     else:
+        # random weights — a demo by construction; still make the toy
+        # metadata impossible to mistake for a real deployment
+        import logging
+        logging.getLogger("dynamo.run").warning(
+            "no --model-path: serving RANDOM weights with the toy test "
+            "tokenizer and eos=[2] — demo/smoke only")
+        eos = [2]
         cfg = getattr(ModelConfig, cli.arch)()
         params = None
     eargs = EngineArgs(multi_step_decode=cli.multi_step_decode,
@@ -85,7 +99,7 @@ async def start_worker(runtime, out: str, cli):
     handle = await ep.serve_endpoint(handler.generate)
     card = ModelDeploymentCard(
         display_name=cli.model, kv_cache_block_size=eargs.block_size,
-        eos_token_ids=[2], tokenizer_ref=cli.model_path or "test")
+        eos_token_ids=eos, tokenizer_ref=cli.model_path or "test")
     card.runtime_config.total_kv_blocks = engine.num_blocks
     await register_llm(runtime, ep, card)
     return handle
@@ -138,7 +152,8 @@ async def amain():
                     choices=["kv", "round_robin", "random"])
     ap.add_argument("--multi-step-decode", type=int, default=1)
     ap.add_argument("--use-pallas-attention", action="store_true")
-    ap.add_argument("--vocab-size", type=int, default=0)
+    ap.add_argument("--vocab-size", type=int, default=0,
+                    help="mocker vocab size (out=mocker only)")
     cli = ap.parse_args(rest)
 
     runtime = await DistributedRuntime.create()

@@ -314,6 +314,12 @@ class Client:
         logger.warning("instance %x reported down", instance_id)
         self._down.add(instance_id)
 
+    def report_instance_up(self, instance_id: int):
+        """Restore a previously-down instance to the routable set."""
+        if instance_id in self._down:
+            logger.info("instance %x restored", instance_id)
+        self._down.discard(instance_id)
+
     async def wait_for_instances(self, timeout: float = 30.0) -> list[int]:
         deadline = asyncio.get_running_loop().time() + timeout
         while True:

@@ -18,6 +18,28 @@ from typing import Any, Optional
 logger = logging.getLogger("dynamo.health")
 
 
+def default_canary_payload() -> dict:
+    """A minimal *valid* 1-token generate request.
+
+    Engine generate endpoints parse their input with
+    ``PreprocessedRequest.from_wire``, so the canary must be a real request —
+    a bare ``{"health_check": true}`` dict would fail parsing on every probe
+    and mark healthy workers down (ref behavior: health_check.rs canary
+    payloads are per-endpoint valid requests).
+    """
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+
+    req = PreprocessedRequest(
+        model="__health_check__",
+        token_ids=[0],
+        stop_conditions=StopConditions(max_tokens=1, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+        annotations=["health_check"],
+    )
+    return req.to_wire()
+
+
 @dataclass
 class HealthCheckConfig:
     #: probe an instance after this much idle time (s)
@@ -26,8 +48,9 @@ class HealthCheckConfig:
     timeout_s: float = 5.0
     #: consecutive failures before marking down
     failure_threshold: int = 2
-    #: payload sent as the canary request (engine-specific, e.g. 1-token gen)
-    payload: Any = field(default_factory=lambda: {"health_check": True})
+    #: payload sent as the canary request (engine-specific; defaults to a
+    #: valid 1-token generate request)
+    payload: Any = field(default_factory=default_canary_payload)
 
 
 class HealthCheckManager:
@@ -69,23 +92,26 @@ class HealthCheckManager:
 
     async def _probe_idle(self) -> None:
         now = time.monotonic()
-        for iid in self.client.instance_ids():
-            last = self._last_ok.get(iid, 0.0)
-            if now - last < self.cfg.check_interval_s:
-                continue
-            await self._probe(iid)
+        due = [iid for iid in self.client.instance_ids()
+               if now - self._last_ok.get(iid, 0.0) >= self.cfg.check_interval_s]
+        if due:
+            # concurrent probes: one wedged instance must not stall the rest
+            await asyncio.gather(*(self._probe(iid) for iid in due))
+
+    async def _probe_once(self, iid: int) -> None:
+        stream = await self.client.generate(self.cfg.payload, mode="direct",
+                                            instance_id=iid)
+        async for _ in stream:  # drain; any frame counts as life
+            break
 
     async def _probe(self, iid: int) -> None:
         try:
-            stream = await asyncio.wait_for(
-                self.client.generate(self.cfg.payload, mode="direct",
-                                     instance_id=iid),
-                self.cfg.timeout_s)
-            async for _ in stream:  # drain; any frame counts as life
-                break
+            # one timeout covers connect *and* first frame — a worker that
+            # accepts the canary but never yields must still count as a failure
+            await asyncio.wait_for(self._probe_once(iid), self.cfg.timeout_s)
             self.note_activity(iid)
             # a previously-down instance that answers is routable again
-            self.client._down.discard(iid)
+            self.client.report_instance_up(iid)
         except Exception as e:
             n = self._failures.get(iid, 0) + 1
             self._failures[iid] = n

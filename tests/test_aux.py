@@ -25,6 +25,9 @@ class FakeClient:
     def report_instance_down(self, iid):
         self._down.add(iid)
 
+    def report_instance_up(self, iid):
+        self._down.discard(iid)
+
     async def generate(self, payload, mode="direct", instance_id=None):
         if instance_id not in self.healthy:
             raise RuntimeError("no responders")
@@ -52,6 +55,50 @@ async def test_health_check_marks_down_and_restores():
         await asyncio.sleep(0.02)
     assert 2 not in client._down
     await mgr.stop()
+
+
+async def test_health_check_hung_stream_counts_as_failure():
+    """A worker that accepts the canary but never yields must be marked down
+    (timeout covers connect + first frame, not just obtaining the stream)."""
+
+    class HangClient(FakeClient):
+        async def generate(self, payload, mode="direct", instance_id=None):
+            async def stream():
+                await asyncio.sleep(3600)
+                yield {}
+            return stream()
+
+    client = HangClient(healthy={1}, all_ids=[1])
+    cfg = HealthCheckConfig(check_interval_s=0.05, timeout_s=0.1,
+                            failure_threshold=2)
+    mgr = await HealthCheckManager(client, cfg).start()
+    for _ in range(100):
+        if 1 in client._down:
+            break
+        await asyncio.sleep(0.02)
+    assert 1 in client._down
+    await mgr.stop()
+
+
+async def test_default_canary_is_valid_request():
+    """The default canary must parse as a real PreprocessedRequest and be
+    servable by a real engine handler (ADVICE r1: {"health_check": true}
+    failed from_wire on every probe)."""
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+    from dynamo_tpu.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.health_check import default_canary_payload
+
+    payload = default_canary_payload()
+    req = PreprocessedRequest.from_wire(payload)  # must not raise
+    assert req.stop_conditions.max_tokens == 1
+
+    engine = await MockEngine(MockEngineArgs()).start()
+    got = []
+    async for out in engine.generate(payload, Context()):
+        got.append(out)
+    assert got, "canary produced no frames from a real handler"
+    await engine.stop()
 
 
 async def test_recorder_roundtrip(tmp_path):
