@@ -72,7 +72,12 @@ class ModelConfig:
             num_experts=d.get("num_local_experts", d.get("n_routed_experts", 0)) or 0,
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             qkv_bias="qwen2" in arch,
-            sliding_window=d.get("sliding_window"),
+            # qwen2 writes sliding_window but gates it behind
+            # use_sliding_window, whose HF default is False; mistral-style
+            # configs apply the window unconditionally
+            sliding_window=(d.get("sliding_window")
+                            if d.get("use_sliding_window",
+                                     "qwen2" not in arch) else None),
         )
 
     @staticmethod

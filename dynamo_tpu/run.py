@@ -44,6 +44,8 @@ async def start_worker(runtime, out: str, cli):
 
         margs = MockEngineArgs()
         if cli.vocab_size:
+            if cli.vocab_size < 16:  # mocker samples ids in [10, vocab)
+                raise SystemExit("--vocab-size must be >= 16")
             margs.vocab_size = cli.vocab_size
         engine, handle = await run_mocker(runtime, cli.model, margs)
         return handle
@@ -77,7 +79,10 @@ async def start_worker(runtime, out: str, cli):
     # in milliseconds (same fail-fast property as engine/main.py)
     if cli.model_path:
         from dynamo_tpu.llm.model_card import resolve_eos_token_ids
-        eos = resolve_eos_token_ids(cli.model_path)
+        try:
+            eos = resolve_eos_token_ids(cli.model_path)
+        except ValueError as e:
+            raise SystemExit(str(e))
         cfg = ModelConfig.from_pretrained(cli.model_path)
         from dynamo_tpu.engine.loader import load_hf_params
         params = load_hf_params(cfg, cli.model_path)
