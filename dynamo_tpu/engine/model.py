@@ -248,9 +248,49 @@ def _mlp_moe(x, lp, cfg: ModelConfig):
     return jnp.einsum("ebsd,bse->bsd", y, cw.astype(y.dtype))
 
 
+import logging
+
+_logger = logging.getLogger("dynamo.engine.model")
+
+
+def _shard_specs():
+    """shard_map specs for one attention call (heads on tp, batch on dp)."""
+    return dict(
+        q=P("dp", None, "tp", None),        # [B,S,H,hd]
+        cache=P(None, None, "tp", None),    # [L,slots,KV,hd]
+        bt=P("dp", None), lens=P("dp"), pos=P("dp", None), scalar=P())
+
+
+def _pallas_decode_attn(q1, kc, vc, lidx, block_tables, kv_lens, *,
+                        block_size: int):
+    """Decode Pallas kernel over the FULL stacked cache (per-shard local).
+
+    q1 [B,H,hd]; kc/vc [L,slots,KV,hd]. Blocks are addressed in the
+    flattened [L·slots] view with ids offset into layer ``lidx`` — slicing
+    kc[lidx] would materialize a whole layer's cache per step.
+    """
+    from dynamo_tpu.ops.paged_attention import paged_attention_decode
+
+    L_, slots_, KV, hd = kc.shape
+    nb = slots_ // block_size
+    return paged_attention_decode(
+        q1, kc.reshape(L_ * slots_, KV, hd), vc.reshape(L_ * slots_, KV, hd),
+        block_tables + lidx * nb, kv_lens, block_size=block_size)
+
+
+def _flash_prefill_attn(q, kc, vc, lidx, block_tables, positions, kv_lens, *,
+                        block_size: int, sliding_window):
+    from dynamo_tpu.ops.flash_prefill import flash_prefill_paged
+
+    return flash_prefill_paged(q, kc, vc, lidx, block_tables, positions,
+                               kv_lens, block_size=block_size,
+                               sliding_window=sliding_window)
+
+
 def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             last_idx, k_cache, v_cache, *, cfg: ModelConfig, block_size: int,
-            use_pallas: bool = False):
+            use_pallas: bool = False, use_flash_prefill: bool = False,
+            mesh: Optional[Mesh] = None):
     """One engine step.
 
     Args:
@@ -295,20 +335,41 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         kc = kc.at[lidx, flat_slots].set(k.reshape(B * S, KV, hd), mode="drop")
         vc = vc.at[lidx, flat_slots].set(v.reshape(B * S, KV, hd), mode="drop")
 
-        if use_pallas and S == 1:
+        # shard_map needs the (static) batch divisible by the dp axis;
+        # otherwise fall through to the XLA path, which GSPMD shards freely.
+        # This fires at trace time (per shape bucket), so warn loudly — a
+        # silently-bypassed kernel is a silent TTFT/HBM regression.
+        dp_ok = mesh is None or B % mesh.shape.get("dp", 1) == 0
+        if not dp_ok and (use_pallas if S == 1 else use_flash_prefill):
+            _logger.warning(
+                "Pallas %s kernel bypassed: batch %d not divisible by dp=%d "
+                "— falling back to the XLA attention path for this bucket",
+                "decode" if S == 1 else "prefill", B, mesh.shape.get("dp", 1))
+        sp = _shard_specs() if mesh is not None else None
+        if use_pallas and S == 1 and dp_ok:
             # decode fast path: Pallas kernel streams pages HBM→VMEM once.
-            # The kernel sees the FULL cache flattened to [L·slots, KV, hd]
-            # with block ids offset into layer lidx — slicing kc[lidx] would
-            # materialize a whole layer's cache per step.
-            from dynamo_tpu.ops.paged_attention import paged_attention_decode
-            L_, slots_ = kc.shape[0], kc.shape[1]
-            nb = slots_ // block_size
-            attn = paged_attention_decode(
-                q[:, 0],
-                kc.reshape(L_ * slots_, KV, hd),
-                vc.reshape(L_ * slots_, KV, hd),
-                block_tables + lidx * nb, kv_lens,
-                block_size=block_size)[:, None]
+            # Under a mesh the kernel runs per-shard via shard_map (heads on
+            # "tp", batch on "dp" — attention is head- and batch-local, so no
+            # collectives are needed).
+            fn = functools.partial(_pallas_decode_attn, block_size=block_size)
+            if mesh is not None:
+                fn = jax.shard_map(
+                    fn, mesh=mesh,
+                    in_specs=(P("dp", "tp", None), sp["cache"], sp["cache"],
+                              sp["scalar"], sp["bt"], sp["lens"]),
+                    out_specs=P("dp", "tp", None), check_vma=False)
+            attn = fn(q[:, 0], kc, vc, lidx, block_tables, kv_lens)[:, None]
+        elif use_flash_prefill and S > 1 and dp_ok:
+            # prefill fast path: flash kernel, no O(S·T) HBM score tensor
+            fn = functools.partial(_flash_prefill_attn, block_size=block_size,
+                                   sliding_window=cfg.sliding_window)
+            if mesh is not None:
+                fn = jax.shard_map(
+                    fn, mesh=mesh,
+                    in_specs=(sp["q"], sp["cache"], sp["cache"], sp["scalar"],
+                              sp["bt"], sp["pos"], sp["lens"]),
+                    out_specs=sp["q"], check_vma=False)
+            attn = fn(q, kc, vc, lidx, block_tables, positions, kv_lens)
         else:
             attn = _paged_attention(q, kc, vc, lidx, block_tables, positions,
                                     kv_lens, cfg, block_size)
@@ -337,7 +398,7 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
 def multi_decode(params, last_tokens, positions, block_tables, kv_lens,
                  k_cache, v_cache, temperature, top_k, top_p, seeds, step0,
                  *, cfg: ModelConfig, block_size: int, num_steps: int,
-                 use_pallas: bool = False):
+                 use_pallas: bool = False, mesh: Optional[Mesh] = None):
     """Run ``num_steps`` chained decode steps in ONE compiled program.
 
     Per-step host dispatch dominates decode latency when the chip is remote
@@ -368,7 +429,7 @@ def multi_decode(params, last_tokens, positions, block_tables, kv_lens,
         logits, kc, vc = forward(
             params, tok[:, None], pos[:, None], slot[:, None], block_tables,
             kv, jnp.zeros((B,), jnp.int32), kc, vc,
-            cfg=cfg, block_size=bs, use_pallas=use_pallas)
+            cfg=cfg, block_size=bs, use_pallas=use_pallas, mesh=mesh)
         keys = jnp.stack(
             [seeds.astype(jnp.uint32), (step0 + k).astype(jnp.uint32)], axis=1)
         new_tok, logp = S.sample(logits, temperature, top_k, top_p, keys)
@@ -380,33 +441,52 @@ def multi_decode(params, last_tokens, positions, block_tables, kv_lens,
     return toks, logps, k_cache, v_cache
 
 
+def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
+                          use_pallas: bool, use_flash_prefill):
+    """Static gating for the Pallas fast paths (trace-time decisions).
+
+    Under a mesh the kernels run per-shard through shard_map, so support is
+    judged on the LOCAL head counts (heads and kv-heads divided over "tp").
+    ``use_flash_prefill=None`` resolves to "on when running on TPU" — on CPU
+    the kernel would run in interpret mode, slower than the XLA path.
+    """
+    from dynamo_tpu.ops.paged_attention import pallas_supported
+
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    heads_ok = (cfg.num_kv_heads % tp == 0 and cfg.num_heads % tp == 0
+                and cfg.num_heads % cfg.num_kv_heads == 0)
+    decode_pallas = (use_pallas and heads_ok
+                     and cfg.sliding_window is None  # decode kernel lacks window
+                     and pallas_supported(cfg.num_kv_heads // tp, cfg.head_dim))
+    if use_flash_prefill is None:  # auto: on-TPU, or wherever pallas is asked
+        use_flash_prefill = use_pallas or jax.default_backend() == "tpu"
+    prefill_flash = (bool(use_flash_prefill) and heads_ok
+                     and cfg.head_dim % 64 == 0)
+    return decode_pallas, prefill_flash
+
+
 def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
                          mesh: Optional[Mesh] = None, use_pallas: bool = False):
     """Jitted multi-step decode with cache donation (args 5, 6)."""
-    from dynamo_tpu.ops.paged_attention import pallas_supported
-
-    use_pallas = (use_pallas and mesh is None
-                  and cfg.sliding_window is None
-                  and pallas_supported(cfg.num_kv_heads, cfg.head_dim))
+    decode_pallas, _ = _resolve_kernel_flags(cfg, mesh, use_pallas, False)
     f = functools.partial(multi_decode, cfg=cfg, block_size=block_size,
-                          num_steps=num_steps, use_pallas=use_pallas)
+                          num_steps=num_steps, use_pallas=decode_pallas,
+                          mesh=mesh)
     return jax.jit(f, donate_argnums=(5, 6))
 
 
 def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,
-                 use_pallas: bool = False):
+                 use_pallas: bool = False, use_flash_prefill=None):
     """Jitted engine step with cache donation (and GSPMD shardings if mesh).
 
-    ``use_pallas`` switches the decode (S=1) attention onto the Pallas paged
-    kernel — single-device only for now (under a mesh the kernel would need a
-    shard_map wrapper; the XLA path shards transparently).
+    ``use_pallas`` switches decode (S=1) attention onto the Pallas paged
+    kernel; prefill (S>1) uses the flash kernel when supported. Both work
+    under a mesh via shard_map (heads on "tp", batch on "dp").
     """
-    from dynamo_tpu.ops.paged_attention import pallas_supported
-
-    use_pallas = (use_pallas and mesh is None
-                  and cfg.sliding_window is None  # kernel lacks window mask
-                  and pallas_supported(cfg.num_kv_heads, cfg.head_dim))
+    decode_pallas, prefill_flash = _resolve_kernel_flags(
+        cfg, mesh, use_pallas, use_flash_prefill)
     f = functools.partial(forward, cfg=cfg, block_size=block_size,
-                          use_pallas=use_pallas)
+                          use_pallas=decode_pallas,
+                          use_flash_prefill=prefill_flash, mesh=mesh)
     # donate caches (args 7, 8 → positions in the positional signature)
     return jax.jit(f, donate_argnums=(7, 8))

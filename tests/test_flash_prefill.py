@@ -1,0 +1,172 @@
+"""Flash-prefill kernel: numerics vs a dense numpy oracle, plus the
+shard_map-wrapped Pallas paths (decode + prefill) on the virtual 8-CPU mesh.
+
+The XLA CPU backend in this image emulates MXU bf16 matmul precision, so the
+oracle is plain numpy (exact f32) and the kernel runs its f32
+Precision.HIGHEST path — mismatches surface at 1e-5, not inside bf16 noise.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.ops.flash_prefill import flash_prefill, flash_prefill_paged
+
+
+def dense_oracle(q, k, v, pos_base, kv_lens, window=None):
+    B, S, H, hd = q.shape
+    T, KV = k.shape[1], k.shape[2]
+    G = H // KV
+    out = np.zeros_like(q)
+    for b in range(B):
+        for s in range(S):
+            qpos = pos_base[b] + s
+            for h in range(H):
+                g = h // G
+                sc = (q[b, s, h] @ k[b, :, g].T) / np.sqrt(hd)
+                mask = (np.arange(T) <= qpos) & (np.arange(T) < kv_lens[b])
+                if window:
+                    mask &= np.arange(T) > qpos - window
+                if not mask.any():
+                    continue
+                sc = np.where(mask, sc, -1e30)
+                p = np.exp(sc - sc.max())
+                p /= p.sum()
+                out[b, s, h] = p @ v[b, :, g]
+    return out
+
+
+def make_inputs(B=2, S=24, H=8, KV=2, hd=64, T=64, seed=0):
+    rng = np.random.RandomState(seed)
+    q = rng.randn(B, S, H, hd).astype(np.float32)
+    k = rng.randn(B, T, KV, hd).astype(np.float32)
+    v = rng.randn(B, T, KV, hd).astype(np.float32)
+    pos_base = np.array([30, 0][:B], np.int32)
+    kv_lens = np.array([54, 10][:B], np.int32)
+    return q, k, v, pos_base, kv_lens
+
+
+def test_flash_prefill_vs_oracle():
+    q, k, v, pos_base, kv_lens = make_inputs()
+    got = np.asarray(flash_prefill(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(pos_base), jnp.asarray(kv_lens), interpret=True))
+    want = dense_oracle(q, k, v, pos_base, kv_lens)
+    # rows past kv_len are padding
+    np.testing.assert_allclose(got[0], want[0], atol=1e-5)
+    np.testing.assert_allclose(got[1, :10], want[1, :10], atol=1e-5)
+
+
+def test_flash_prefill_sliding_window():
+    q, k, v, pos_base, kv_lens = make_inputs()
+    got = np.asarray(flash_prefill(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(pos_base), jnp.asarray(kv_lens),
+        sliding_window=16, interpret=True))
+    want = dense_oracle(q, k, v, pos_base, kv_lens, window=16)
+    np.testing.assert_allclose(got[0], want[0], atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [None, 96])
+def test_flash_prefill_multi_tile_accumulation(window):
+    """T and S large enough to force several k/q tiles (online softmax
+    corrections across tiles — and, with a window, the tile-liveness skip
+    condition — are the error-prone parts)."""
+    rng = np.random.RandomState(3)
+    B, S, H, KV, hd, T = 1, 256, 4, 2, 64, 1024
+    q = rng.randn(B, S, H, hd).astype(np.float32)
+    k = rng.randn(B, T, KV, hd).astype(np.float32)
+    v = rng.randn(B, T, KV, hd).astype(np.float32)
+    pos_base = np.array([700], np.int32)
+    kv_lens = np.array([956], np.int32)
+    got = np.asarray(flash_prefill(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(pos_base), jnp.asarray(kv_lens),
+        sliding_window=window, interpret=True))
+    want = dense_oracle(q, k, v, pos_base, kv_lens, window=window)
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_paged_wrapper_gathers_right_layer():
+    rng = np.random.RandomState(1)
+    B, S, H, KV, hd, bs, W, L = 1, 16, 4, 2, 64, 8, 4, 3
+    kc = rng.randn(L, 40 * bs, KV, hd).astype(np.float32)
+    vc = rng.randn(L, 40 * bs, KV, hd).astype(np.float32)
+    q = rng.randn(B, S, H, hd).astype(np.float32)
+    bt = np.asarray([[5, 9, 2, 7]], np.int32)
+    positions = np.arange(S, dtype=np.int32)[None]
+    kv_lens = np.array([S], np.int32)
+    got = np.asarray(flash_prefill_paged(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc), jnp.int32(2),
+        jnp.asarray(bt), jnp.asarray(positions), jnp.asarray(kv_lens),
+        block_size=bs, interpret=True))
+    slot_idx = (bt[:, :, None] * bs + np.arange(bs)[None, None]).reshape(B, -1)
+    want = dense_oracle(q, kc[2][slot_idx], vc[2][slot_idx],
+                        np.zeros(B, np.int32), kv_lens)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("which", ["decode", "prefill"])
+def test_kernels_under_mesh_shard_map(which):
+    """make_step_fn with a (dp=2, tp=2) mesh must take the Pallas path via
+    shard_map and match the XLA-path output (r1 weakness: kernels were
+    force-disabled whenever mesh was not None)."""
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    # hd=64: decode kernel local KV·hd = 2·64 = 128 lanes; prefill kernel
+    # needs hd % 64 == 0
+    cfg = ModelConfig(vocab_size=128, hidden_size=8 * 64,
+                      intermediate_size=2 * 8 * 64, num_layers=2,
+                      num_heads=8, num_kv_heads=4, head_dim=64,
+                      dtype="float32")
+    mesh = make_mesh(MeshConfig(dp=2, sp=1, tp=2))
+    key = jax.random.key(0)
+    params = M.init_params(cfg, key, dtype=jnp.float32)
+
+    L, bs, nb = cfg.num_layers, 8, 32
+    B, W = 4, 4
+    kc = jnp.zeros((L, nb * bs, cfg.num_kv_heads, cfg.head_dim), jnp.float32)
+    vc = jnp.zeros_like(kc)
+    rng = np.random.RandomState(0)
+    if which == "decode":
+        S = 1
+        kv_lens = np.array([9, 17, 5, 25], np.int32)
+        positions = (kv_lens - 1)[:, None].astype(np.int32)
+    else:
+        S = 16
+        kv_lens = np.full((B,), S, np.int32)
+        positions = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    tokens = rng.randint(1, 128, size=(B, S)).astype(np.int32)
+    bt = np.stack([rng.choice(np.arange(1, nb), W, replace=False)
+                   for _ in range(B)]).astype(np.int32)
+    slot_map = np.zeros((B, S), np.int32)
+    for b in range(B):
+        for i in range(S):
+            pos = positions[b, i]
+            slot_map[b, i] = bt[b, pos // bs] * bs + pos % bs
+    last_idx = np.full((B,), S - 1, np.int32)
+
+    def run(step_fn):
+        logits, kc2, vc2 = step_fn(
+            params, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(slot_map), jnp.asarray(bt), jnp.asarray(kv_lens),
+            jnp.asarray(last_idx), jnp.array(kc), jnp.array(vc))
+        return np.asarray(logits)
+
+    use_pallas = which == "decode"
+    use_flash = which == "prefill"
+    fast = M.make_step_fn(cfg, bs, mesh=mesh, use_pallas=use_pallas,
+                          use_flash_prefill=use_flash)
+    slow = M.make_step_fn(cfg, bs, mesh=mesh, use_pallas=False,
+                          use_flash_prefill=False)
+    # sanity: the fast path actually resolved to a kernel
+    dec, pre = M._resolve_kernel_flags(cfg, mesh, use_pallas, use_flash)
+    if which == "decode":
+        assert dec, "decode Pallas path did not engage under the mesh"
+    else:
+        assert pre, "flash prefill path did not engage under the mesh"
+    np.testing.assert_allclose(run(fast), run(slow), atol=2e-2, rtol=2e-2)
