@@ -346,7 +346,41 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                 "— falling back to the XLA attention path for this bucket",
                 "decode" if S == 1 else "prefill", B, mesh.shape.get("dp", 1))
         sp = _shard_specs() if mesh is not None else None
-        if use_pallas and S == 1 and dp_ok:
+        # context parallelism: prefill chunks ring over the "sp" axis —
+        # each sp shard gathers 1/n of the page table and the slices rotate
+        # (SURVEY §5.7: the engine feature the reference lacks)
+        sp_n = mesh.shape.get("sp", 1) if mesh is not None else 1
+        tp_n = mesh.shape.get("tp", 1) if mesh is not None else 1
+        ring_want = sp_n > 1 and S > 1
+        ring_ok = (ring_want and dp_ok and S % sp_n == 0
+                   and H % tp_n == 0 and KV % tp_n == 0
+                   and (H // tp_n) % max(1, KV // tp_n) == 0)
+        if ring_want and not ring_ok:
+            _logger.warning(
+                "ring prefill bypassed: S=%d B=%d not divisible by "
+                "sp=%d/dp or heads by tp — XLA attention path for this bucket",
+                S, B, sp_n)
+        if ring_ok:
+            from dynamo_tpu.parallel.ring_attention import ring_prefill_paged
+
+            # pad the table width to a multiple of sp with NULL-block
+            # columns — their logical key positions land beyond kv_lens, so
+            # the ring's length mask drops them (W is clamped to
+            # max_blocks_per_seq, which need not divide by sp)
+            W_ = block_tables.shape[1]
+            W_pad = -(-W_ // sp_n) * sp_n
+            bt_ring = (block_tables if W_pad == W_ else jnp.pad(
+                block_tables, ((0, 0), (0, W_pad - W_))))
+            fn = functools.partial(
+                ring_prefill_paged, axis_name="sp", block_size=block_size,
+                sliding_window=cfg.sliding_window)
+            fn = jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=(P("dp", "sp", "tp", None), sp["cache"], sp["cache"],
+                          sp["scalar"], sp["bt"], P("dp", "sp"), sp["lens"]),
+                out_specs=P("dp", "sp", "tp", None), check_vma=False)
+            attn = fn(q, kc, vc, lidx, bt_ring, positions, kv_lens)
+        elif use_pallas and S == 1 and dp_ok:
             # decode fast path: Pallas kernel streams pages HBM→VMEM once.
             # Under a mesh the kernel runs per-shard via shard_map (heads on
             # "tp", batch on "dp" — attention is head- and batch-local, so no

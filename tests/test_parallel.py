@@ -74,3 +74,117 @@ def test_ring_attention_on_submesh_with_dp_tp():
     want = dense_attention(q, k, v)
     got = ring_attention_sharded(q, k, v, mesh)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_dynamic_kv_len_single_trace():
+    """kv_len is a traced operand: serving different lengths must not
+    recompile (r1 verdict weak #10)."""
+    mesh = make_mesh(MeshConfig(dp=1, sp=4, tp=1))
+    q, k, v = _qkv(jax.random.key(3), S=32)
+
+    traces = []
+
+    @jax.jit
+    def run(q, k, v, kv_len):
+        traces.append(1)
+        return ring_attention_sharded(q, k, v, mesh, kv_len=kv_len)
+
+    for kv_len in (20, 27, 32):
+        want = dense_attention(q, k, v, causal=True, kv_len=kv_len)
+        got = run(q, k, v, jnp.int32(kv_len))
+        np.testing.assert_allclose(np.asarray(got)[:, :kv_len],
+                                   np.asarray(want)[:, :kv_len],
+                                   atol=2e-5, rtol=2e-5)
+    assert len(traces) == 1
+
+
+def test_ring_prefill_paged_matches_dense():
+    """Engine-path ring: paged cache sharded gather + ring == dense attn."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.parallel.ring_attention import ring_prefill_paged
+
+    mesh = make_mesh(MeshConfig(dp=1, sp=4, tp=2))
+    B, S, H, KV, hd, bs = 2, 32, 4, 2, 16, 4
+    L = 3
+    lidx = 1
+    q, k, v = _qkv(jax.random.key(4), B=B, S=S, H=H, KV=KV, hd=hd)
+
+    # place K/V into a paged cache at layer lidx through a shuffled block map
+    W = S // bs
+    rng = np.random.default_rng(0)
+    num_blocks = B * W + 4
+    bt = np.zeros((B, W), np.int32)
+    ids = rng.permutation(np.arange(1, num_blocks))[: B * W].reshape(B, W)
+    bt[:] = ids
+    kc = np.zeros((L, num_blocks * bs, KV, hd), np.float32)
+    vc = np.zeros((L, num_blocks * bs, KV, hd), np.float32)
+    for b in range(B):
+        for t in range(S):
+            slot = bt[b, t // bs] * bs + t % bs
+            kc[lidx, slot] = np.asarray(k)[b, t]
+            vc[lidx, slot] = np.asarray(v)[b, t]
+
+    kv_lens = jnp.array([S, S - 5], jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+
+    fn = functools.partial(ring_prefill_paged, axis_name="sp", block_size=bs)
+    fn = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, "sp", "tp", None), P(None, None, "tp", None),
+                  P(None, None, "tp", None), P(), P(None, None),
+                  P(None, "sp"), P(None)),
+        out_specs=P(None, "sp", "tp", None), check_vma=False)
+    got = fn(q, jnp.asarray(kc), jnp.asarray(vc), jnp.int32(lidx),
+             jnp.asarray(bt), positions, kv_lens)
+
+    for b, n in enumerate([S, S - 5]):
+        want = dense_attention(q[b:b + 1], k[b:b + 1], v[b:b + 1],
+                               causal=True, kv_len=n)
+        np.testing.assert_allclose(np.asarray(got)[b, :n],
+                                   np.asarray(want)[0, :n],
+                                   atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.anyio
+@pytest.mark.parametrize("max_model_len,prompt_len", [
+    (256, 100),
+    # max_blocks_per_seq = 11 (odd): exercises NULL-block W padding to a
+    # multiple of sp inside the ring branch
+    (44, 38),
+])
+async def test_engine_sp_prefill_matches_single_device(max_model_len, prompt_len):
+    """The engine serves a prompt through chunked ring prefill on an sp=2
+    mesh and reproduces the single-device greedy continuation."""
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+
+    cfg = ModelConfig.tiny()
+    params = M.init_params(cfg, jax.random.key(0))
+    args = EngineArgs(block_size=4, num_blocks=256, max_num_seqs=4,
+                      max_num_batched_tokens=32,
+                      max_model_len=max_model_len)
+    prompt = jax.random.randint(jax.random.key(9), (prompt_len,), 0,
+                                cfg.vocab_size).tolist()
+    req = lambda: PreprocessedRequest(  # noqa: E731
+        model="t", token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0))
+
+    async def run(mesh):
+        eng = AsyncJaxEngine(cfg, args, params=params, mesh=mesh)
+        got = []
+        async for out in eng.generate(req()):
+            got.extend(out.token_ids)
+        await eng.close()
+        return got
+
+    base = await run(None)
+    mesh = make_mesh(MeshConfig(dp=1, sp=2, tp=1))
+    sp = await run(mesh)
+    assert sp == base
