@@ -1,41 +1,52 @@
-"""Driver benchmark: steady-state decode throughput of the native JAX engine
-step on one chip. Prints ONE JSON line.
+"""Driver benchmark. Prints ONE JSON line.
 
-Measures the production jitted step (dynamo_tpu.engine.model.forward) in
-continuous-decode shape: batch of sequences each extending by one token per
-step over the paged KV cache — the hot loop of serving. vs_baseline compares
-against the north-star 2000 decode tok/s/chip target (BASELINE.json; the
-reference publishes no absolute numbers — BASELINE.md).
+Two phases (round-2 verdict: the r1 bench measured a raw jitted loop and
+bypassed the serving stack — "no TTFT number exists at all"):
+
+1. kernel: steady-state fused multi-step decode throughput of the jitted
+   engine step (the r1 number, kept for continuity).
+2. e2e: the FULL serving path — OpenAI HTTP frontend, SSE streaming,
+   preprocessor → router pipeline → engine scheduler → paged cache →
+   detokenizer — driven closed-loop at fixed concurrency with the
+   reference's harness-default workload shape (ISL/OSL from
+   docs/benchmarks/benchmarking.md:33, scaled to the 1-chip bench budget).
+   Reports decode tok/s through HTTP and p50/p95 TTFT.
+
+The primary metric is the e2e decode throughput; vs_baseline compares
+against the north-star 2000 decode tok/s/chip (BASELINE.md). TTFT and the
+kernel number ride along in "extra".
 """
 
+import asyncio
 import json
+import os
+import tempfile
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-
-from dynamo_tpu.engine import model as M
-from dynamo_tpu.engine.config import ModelConfig
 
 BASELINE_TOK_S = 2000.0
 
 
-def main():
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
+# --------------------------------------------------------------- kernel phase
+
+def kernel_bench(on_tpu: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.config import ModelConfig
+
     if on_tpu:
         cfg = ModelConfig.llama3_1b()
-        B, kv_len, iters = 64, 512, 50
-    else:  # smoke fallback (CI / no chip)
+        B, kv_len, iters, K = 64, 512, 50, 16
+    else:
         cfg = ModelConfig.tiny()
-        B, kv_len, iters = 8, 64, 10
+        B, kv_len, iters, K = 8, 64, 10, 4
 
     block_size = 16
-    K_steps = 16 if on_tpu else 4
-    # each seq's table must cover kv_len plus one full burst of decode steps
-    W = (kv_len + K_steps + block_size - 1) // block_size
-    num_blocks = B * W + 1  # + null block 0
+    W = (kv_len + K + block_size - 1) // block_size
+    num_blocks = B * W + 1
     dtype = jnp.dtype(cfg.dtype)
 
     params = M.init_params(cfg, jax.random.key(0))
@@ -43,29 +54,23 @@ def main():
     k_cache = jnp.zeros(shape, dtype)
     v_cache = jnp.zeros(shape, dtype)
 
-    # B sequences, each kv_len tokens deep, decoding one token each step
     rng = np.random.default_rng(0)
-    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, 1)), jnp.int32)
-    positions = jnp.full((B, 1), kv_len - 1, jnp.int32)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B,)), jnp.int32)
+    positions = jnp.full((B,), kv_len - 1, jnp.int32)
     bt = np.zeros((B, W), np.int32)
     for i in range(B):
-        bt[i] = 1 + i * W + np.arange(W)  # disjoint blocks per seq, 0 = null
+        bt[i] = 1 + i * W + np.arange(W)
     block_tables = jnp.asarray(bt)
     kv_lens = jnp.full((B,), kv_len, jnp.int32)
 
-    # fused multi-step decode: the production burst path (engine
-    # multi_step_decode) — K chained steps + on-device sampling per dispatch
-    K = K_steps
     multi = M.make_multi_decode_fn(cfg, block_size, K)
     zeros_f = jnp.zeros((B,), jnp.float32)
     zeros_i = jnp.zeros((B,), jnp.int32)
     ones_f = jnp.ones((B,), jnp.float32)
     seeds = jnp.zeros((B,), jnp.uint32)
-    last_tokens = tokens[:, 0]
-    positions1 = positions[:, 0]
 
     def burst(kc, vc):
-        return multi(params, last_tokens, positions1, block_tables, kv_lens,
+        return multi(params, tokens, positions, block_tables, kv_lens,
                      kc, vc, zeros_f, zeros_i, ones_f, seeds, seeds)
 
     toks, logps, k_cache, v_cache = burst(k_cache, v_cache)  # compile
@@ -78,14 +83,161 @@ def main():
     # small device->host fetch forces completion of the donated-cache chain
     int(toks[-1, 0])
     dt = time.perf_counter() - t0
+    return {"kernel_tok_s": round(B * K * iters / dt, 1),
+            "kernel_shape": f"B={B},kv={kv_len},K={K}"}
 
-    tok_s = B * K * iters / dt
+
+# ------------------------------------------------------------------ e2e phase
+
+def _write_tokenizer_dir(path: str, vocab_size: int) -> None:
+    """WordLevel tokenizer whose vocab covers the model's sampled ids, so
+    random-weight outputs detokenize through the production DecodeStream."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {f"w{i}": i for i in range(vocab_size)}
+    tk = Tokenizer(WordLevel(vocab, unk_token="w0"))
+    tk.pre_tokenizer = Whitespace()
+    tk.save(os.path.join(path, "tokenizer.json"))
+    with open(os.path.join(path, "tokenizer_config.json"), "w") as f:
+        json.dump({"chat_template": "{% for m in messages %}{{ m['content'] }}"
+                                    "{% endfor %}"}, f)
+
+
+async def _e2e(on_tpu: bool) -> dict:
+    import aiohttp
+
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    if on_tpu:
+        cfg = ModelConfig.llama3_1b()
+        ISL, OSL, CONC, N_REQ, N_WARM = 1024, 128, 32, 64, 8
+        args = EngineArgs(
+            block_size=16, max_num_seqs=64, max_num_batched_tokens=2048,
+            max_model_len=2048, multi_step_decode=8, use_pallas_attention=True,
+            # pin the shape buckets so the run compiles a handful of programs
+            prefill_buckets=(1024, 2048), decode_batch_buckets=(32, 64))
+    else:
+        cfg = ModelConfig.tiny()
+        ISL, OSL, CONC, N_REQ, N_WARM = 64, 16, 4, 8, 2
+        args = EngineArgs(block_size=16, num_blocks=256, max_num_seqs=8,
+                          max_num_batched_tokens=256, max_model_len=256)
+
+    tmp = tempfile.mkdtemp(prefix="bench-tk-")
+    _write_tokenizer_dir(tmp, cfg.vocab_size)
+
+    rt = await DistributedRuntime.create()
+    eng = AsyncJaxEngine(cfg, args)
+    handler = DecodeWorkerHandler(eng)
+    ep = rt.namespace("dynamo").component("backend").endpoint("generate")
+    handle = await ep.serve_endpoint(handler.generate)
+    card = ModelDeploymentCard(
+        display_name="bench", kv_cache_block_size=args.block_size,
+        eos_token_ids=[], tokenizer_ref=tmp,
+        context_length=args.max_model_len)
+    card.runtime_config.total_kv_blocks = eng.num_blocks
+    await register_llm(rt, ep, card)
+
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager, router_mode="kv").start()
+    service = HttpService(manager, port=0)
+    await service.start()
+    for _ in range(200):
+        if manager.list_models():
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise RuntimeError("model never appeared in discovery")
+
+    url = f"http://127.0.0.1:{service.port}/v1/completions"
+    rng = np.random.default_rng(7)
+
+    async def one_request(session: aiohttp.ClientSession) -> tuple[float, int]:
+        """Returns (ttft_seconds, tokens_received). Distinct random prompts
+        defeat the prefix cache — every request pays a full prefill."""
+        prompt = rng.integers(1, cfg.vocab_size, ISL).tolist()
+        t0 = time.perf_counter()
+        ttft, n_tok = None, 0
+        async with session.post(url, json={
+                "model": "bench", "prompt": prompt, "stream": True,
+                "max_tokens": OSL, "ignore_eos": True,
+                "temperature": 0.0}) as resp:
+            assert resp.status == 200, await resp.text()
+            async for raw in resp.content:
+                line = raw.decode()
+                if not line.startswith("data: ") or line.startswith("data: [DONE]"):
+                    continue
+                payload = json.loads(line[6:])
+                if "error" in payload:  # in-band SSE error: fail the bench
+                    raise RuntimeError(f"engine error mid-stream: {payload}")
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                n_tok += 1
+        return ttft, n_tok
+
+    async def closed_loop(session, n_left: list, results: list):
+        while True:
+            if not n_left:
+                return
+            n_left.pop()
+            results.append(await one_request(session))
+
+    conn = aiohttp.TCPConnector(limit=0)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        # warmup: trigger the compile set (prefill buckets, decode buckets)
+        warm_left, warm_res = [0] * N_WARM, []
+        await asyncio.gather(*[closed_loop(session, warm_left, warm_res)
+                               for _ in range(CONC)])
+        t0 = time.perf_counter()
+        n_left, results = [0] * N_REQ, []
+        await asyncio.gather(*[closed_loop(session, n_left, results)
+                               for _ in range(CONC)])
+        elapsed = time.perf_counter() - t0
+
+    await service.stop()
+    await watcher.stop()
+    await handle.stop(graceful=False)
+    await eng.close()
+    await rt.shutdown()
+
+    ttfts = sorted(r[0] for r in results if r[0] is not None)
+    total_tokens = sum(r[1] for r in results)
+    return {
+        "e2e_tok_s": round(total_tokens / elapsed, 1),
+        "ttft_p50_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
+        "ttft_p95_ms": round(1000 * ttfts[int(len(ttfts) * 0.95)], 1),
+        "workload": f"ISL={ISL},OSL={OSL},conc={CONC},n={N_REQ}",
+    }
+
+
+def main():
+    import jax
+
+    # honor an explicit CPU request even though the container's
+    # sitecustomize pre-pins the axon TPU platform (env alone is too late)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    kern = kernel_bench(on_tpu)
+    e2e = asyncio.run(_e2e(on_tpu))
+
+    model = "llama3-1b" if on_tpu else "tiny-cpu"
+    tok_s = e2e["e2e_tok_s"]
     print(json.dumps({
-        "metric": f"decode_tok_s_per_chip[{'llama3-1b' if on_tpu else 'tiny-cpu'}"
-                  f",B={B},kv={kv_len},K={K},{platform}]",
-        "value": round(tok_s, 1),
+        "metric": f"e2e_http_decode_tok_s_per_chip[{model},{e2e['workload']},{platform}]",
+        "value": tok_s,
         "unit": "tok/s",
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+        "extra": {**kern, **e2e},
     }))
 
 

@@ -118,7 +118,8 @@ def _parse_common(body: dict, req: ParsedRequest) -> ParsedRequest:
         max_tokens=None if max_tokens is None else int(max_tokens),
         stop=_as_stop_list(body.get("stop")),
         min_tokens=nvext.get("min_tokens"),
-        ignore_eos=nvext.get("ignore_eos"),
+        # vLLM-style top-level extension accepted too; nvext wins when both set
+        ignore_eos=nvext.get("ignore_eos", body.get("ignore_eos")),
     )
     logprobs = body.get("logprobs")
     top_logprobs = body.get("top_logprobs")
