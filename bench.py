@@ -220,10 +220,9 @@ async def _e2e(on_tpu: bool) -> dict:
 def main():
     import jax
 
-    # honor an explicit CPU request even though the container's
-    # sitecustomize pre-pins the axon TPU platform (env alone is too late)
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    from dynamo_tpu.runtime.config import apply_platform_env
+
+    apply_platform_env()  # sitecustomize pins the TPU; honor JAX_PLATFORMS
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
 

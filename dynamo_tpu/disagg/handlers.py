@@ -14,22 +14,28 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
-from dynamo_tpu.disagg.protocols import DisaggConfig, PrefillResponse
+from dynamo_tpu.disagg.protocols import (
+    DisaggConfig, KvChunkFrame, PrefillResponse,
+)
 from dynamo_tpu.protocols import LLMEngineOutput, PreprocessedRequest
 
 logger = logging.getLogger("dynamo.disagg")
 
 
 class PrefillWorkerHandler:
-    """Serves the prefill component's ``generate`` endpoint."""
+    """Serves the prefill component's ``generate`` endpoint.
+
+    Streams KvChunkFrame wires while prefill is still computing (pipelined
+    transfer), then the final PrefillResponse with the tail pages.
+    """
 
     def __init__(self, engine):
         self.engine = engine
 
     async def generate(self, request: dict, ctx):
         req = PreprocessedRequest.from_wire(request)
-        resp = await self.engine.prefill_extract(req, ctx)
-        yield resp.to_wire()
+        async for frame in self.engine.prefill_extract_stream(req, ctx):
+            yield frame
 
 
 class DecodeWorkerHandler:
@@ -73,11 +79,86 @@ class DecodeWorkerHandler:
                      len(req.token_ids))
         stream = await self.prefill_client.generate(
             req.to_wire(), mode="round_robin")
+        eng = self.engine
+        bs = eng.args.block_size
+        total = (len(req.token_ids) + bs - 1) // bs
+        ids = None  # decode-side blocks, allocated on the first chunk frame
+        placed = True  # False → recompute locally after draining the stream
+        next_block = 0
         presp = None
-        async for frame in stream:
-            presp = PrefillResponse.from_wire(frame)
-            break
-        if presp is None:
-            raise RuntimeError("prefill worker returned no response")
-        async for out in self.engine.generate_injected(req, presp, ctx):
-            yield out.to_wire()
+        owned = False  # ids ownership not yet transferred to a sequence
+        try:
+            async for frame in stream:
+                if KvChunkFrame.is_wire(frame):
+                    ch = KvChunkFrame.from_wire(frame).bundle
+                    if not placed:
+                        continue  # keep draining: the final frame has the token
+                    n = ch.k.shape[1]
+                    if (not eng.check_bundle_dims(ch)
+                            or ch.start_block != next_block
+                            or ch.start_block + n > total):
+                        placed = False
+                        continue
+                    if ids is None:
+                        ids = eng.alloc_inject(total)
+                        if ids is None:
+                            placed = False
+                            continue
+                        owned = True
+                    try:
+                        eng.scatter_chunk(
+                            ids[ch.start_block:ch.start_block + n], ch.k, ch.v)
+                        next_block += n
+                    except Exception:
+                        logger.exception("KV chunk scatter failed")
+                        placed = False
+                else:
+                    presp = PrefillResponse.from_wire(frame)
+            if presp is None:
+                raise RuntimeError("prefill worker returned no response")
+
+            if presp.token_id < 0 or not placed:
+                if owned:
+                    owned = False
+                    eng.release_inject(ids)
+                async for out in eng.generate(req, ctx):
+                    yield out.to_wire()
+                return
+
+            if ids is None:
+                # no chunk frames arrived: the whole-bundle (unpipelined) path
+                async for out in eng.generate_injected(req, presp, ctx):
+                    yield out.to_wire()
+                return
+
+            tail = presp.bundle
+            if tail is not None:
+                n = tail.k.shape[1]
+                if (eng.check_bundle_dims(tail)
+                        and tail.start_block == next_block
+                        and tail.start_block + n <= total):
+                    try:
+                        eng.scatter_chunk(
+                            ids[tail.start_block:tail.start_block + n],
+                            tail.k, tail.v)
+                        next_block += n
+                    except Exception:
+                        logger.exception("KV tail scatter failed")
+                        placed = False
+                else:
+                    placed = False
+            if not placed or next_block < total:
+                owned = False
+                eng.release_inject(ids)
+                async for out in eng.generate(req, ctx):
+                    yield out.to_wire()
+                return
+            owned = False  # ownership transfers to the sequence
+            async for out in eng.generate_prefilled(req, presp.token_id,
+                                                    presp.logprob, ids, ctx):
+                yield out.to_wire()
+        finally:
+            # exception/cancellation escape hatch: injected blocks must never
+            # leak when the stream dies after alloc_inject
+            if owned and ids is not None:
+                eng.release_inject(ids)

@@ -26,12 +26,19 @@ class DisaggConfig:
 
 @dataclass
 class KvBundle:
-    """One request's KV pages: [L, n_blocks, bs, KV, hd] k and v arrays."""
+    """KV pages: [L, n_blocks, bs, KV, hd] k and v arrays.
+
+    ``start_block`` is the logical block ordinal of the first page —
+    the pipelined path ships several bundles per request (chunk frames while
+    prefill is still running, then the tail inside PrefillResponse), each
+    covering a contiguous logical range.
+    """
 
     k: np.ndarray
     v: np.ndarray
     num_tokens: int  # valid tokens covered (may end mid-block)
     block_size: int
+    start_block: int = 0
 
     def to_wire(self) -> dict:
         return {
@@ -41,6 +48,7 @@ class KvBundle:
             "v": self.v.tobytes(),
             "num_tokens": self.num_tokens,
             "block_size": self.block_size,
+            "start_block": self.start_block,
         }
 
     @staticmethod
@@ -52,7 +60,31 @@ class KvBundle:
         k = np.frombuffer(d["k"], dtype=dtype).reshape(shape)
         v = np.frombuffer(d["v"], dtype=dtype).reshape(shape)
         return KvBundle(k=k, v=v, num_tokens=d["num_tokens"],
-                        block_size=d["block_size"])
+                        block_size=d["block_size"],
+                        start_block=d.get("start_block", 0))
+
+
+@dataclass
+class KvChunkFrame:
+    """A mid-prefill transfer frame: pages of blocks whose KV is final.
+
+    Streamed over the response plane WHILE the prefill worker is still
+    computing later chunks — the TPU answer to NIXL's compute-overlapped
+    block transfer (ref: docs/architecture/disagg_serving.md:92-103).
+    """
+
+    bundle: KvBundle
+
+    def to_wire(self) -> dict:
+        return {"kv_chunk": self.bundle.to_wire()}
+
+    @staticmethod
+    def is_wire(d: dict) -> bool:
+        return "kv_chunk" in d
+
+    @staticmethod
+    def from_wire(d: dict) -> "KvChunkFrame":
+        return KvChunkFrame(bundle=KvBundle.from_wire(d["kv_chunk"]))
 
 
 @dataclass

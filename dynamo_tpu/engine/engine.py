@@ -175,67 +175,169 @@ class AsyncJaxEngine:
             self.scheduler.abort(seq)
             self._wake.set()
 
-    async def generate_injected(self, req: PreprocessedRequest, prefill,
-                                ctx=None) -> AsyncIterator[LLMEngineOutput]:
-        """Decode a request whose prompt KV arrives as a KvBundle.
+    async def prefill_extract_stream(self, req: PreprocessedRequest, ctx=None):
+        """Pipelined prefill: yields KvChunkFrame wires for blocks whose KV is
+        final WHILE later chunks are still computing, then the final
+        PrefillResponse with the unshipped tail.
 
-        Falls back to a full local generate when the bundle can't be placed
-        (allocation failure or block-size mismatch).
+        The TPU analog of NIXL's compute-overlapped block transfer (ref:
+        docs/architecture/disagg_serving.md:92-103): by the time the last
+        chunk finishes, most pages are already on the decode worker.
         """
-        from dynamo_tpu.ops.block_copy import scatter_blocks
+        import dataclasses
 
-        bundle = prefill.bundle
-        bs = self.args.block_size
-        if bundle is None or bundle.block_size != bs or prefill.token_id < 0:
-            async for out in self.generate(req, ctx):
-                yield out
-            return
+        from dynamo_tpu.disagg.protocols import KvChunkFrame, PrefillResponse
+
+        from dynamo_tpu.disagg.protocols import KvBundle
+        from dynamo_tpu.ops.block_copy import gather_blocks
 
         self._ensure_loop()
-        L, slots, KV, hd = self.k_cache.shape
-        if bundle.k.shape[0] != L or bundle.k.shape[3:] != (KV, hd):
-            logger.warning("KV bundle dims %s mismatch cache %s; local prefill",
-                           bundle.k.shape, self.k_cache.shape)
-            async for out in self.generate(req, ctx):
-                yield out
-            return
-        # respect admission limits: injection bypasses the waiting queue, so
-        # apply the seq cap + watermark here and fall back to the queued path
+        bs = self.args.block_size
+        sc = dataclasses.replace(req.stop_conditions, max_tokens=1,
+                                 min_tokens=1, ignore_eos=True)
+        preq = dataclasses.replace(req, stop_conditions=sc)
+        sink: asyncio.Queue = asyncio.Queue()
+        events: asyncio.Queue = asyncio.Queue()
+        state = {"shipped": 0}  # full blocks whose gather is dispatched
+
+        # The device gather MUST be dispatched inside the progress callback
+        # (engine-loop context, right after the chunk commits): the block
+        # table is valid at that instant, and the dispatched gather captures
+        # the current immutable cache array — a later preemption only
+        # releases host-side bookkeeping, the captured data stays correct.
+        # Shipping is monotonic; a preemption recompute re-fires progress
+        # with smaller ends, which are skipped (identical content anyway).
+        def on_progress(end: int) -> None:
+            full = end // bs
+            if full <= state["shipped"]:
+                return
+            ids = seq.block_table[state["shipped"]:full]
+            kb = gather_blocks(self.k_cache, ids, block_size=bs)
+            vb = gather_blocks(self.v_cache, ids, block_size=bs)
+            events.put_nowait(("chunk", (state["shipped"], len(ids), kb, vb)))
+            state["shipped"] = full
+
+        seq = SeqState(request_id=f"seq-{next(self._seq_counter)}",
+                       req=preq, ctx=ctx or _NullCtx(), sink=sink,
+                       hold_blocks=True, progress_cb=on_progress)
+
+        async def drain_sink():
+            while True:
+                out = await sink.get()
+                events.put_nowait(("out", out))
+                if out is None or out.finish_reason is not None:
+                    return
+
+        drainer = asyncio.get_running_loop().create_task(drain_sink())
+        self.scheduler.add(seq)
+        self._wake.set()
+        token, logp = None, None
+
+        async def to_host(kb, vb, n):
+            return await asyncio.to_thread(
+                lambda: (np.ascontiguousarray(np.asarray(kb)[:, :n]),
+                         np.ascontiguousarray(np.asarray(vb)[:, :n])))
+
+        try:
+            done = False
+            while not done:
+                kind, val = await events.get()
+                if kind == "chunk":
+                    # FIFO ordering guarantees every chunk event lands before
+                    # the finish output that follows it in the queue
+                    start, n, kb, vb = val
+                    k, v = await to_host(kb, vb, n)
+                    b = KvBundle(k=k, v=v, num_tokens=(start + n) * bs,
+                                 block_size=bs, start_block=start)
+                    yield KvChunkFrame(bundle=b).to_wire()
+                elif val is None:
+                    done = True
+                else:
+                    if val.token_ids:
+                        token = val.token_ids[0]
+                        logp = (val.log_probs or [None])[0]
+                    if val.finish_reason is not None:
+                        done = True
+            if token is None:
+                yield PrefillResponse(token_id=-1, logprob=None,
+                                      bundle=None).to_wire()
+                return
+            total = (seq.prompt_len + bs - 1) // bs
+            shipped = state["shipped"]
+            bundle = None
+            if total > shipped:
+                bundle = await self._gather_bundle(
+                    seq.block_table[shipped:total], seq.prompt_len, shipped)
+            yield PrefillResponse(token_id=token, logprob=logp,
+                                  bundle=bundle).to_wire()
+        finally:
+            drainer.cancel()
+            self.scheduler.abort(seq)
+            self._wake.set()
+
+    async def _gather_bundle(self, ids: list[int], num_tokens: int,
+                             start_block: int):
+        """Gather ``ids`` pages and bring them to host off the event loop."""
+        from dynamo_tpu.disagg.protocols import KvBundle
+        from dynamo_tpu.ops.block_copy import gather_blocks
+
+        bs = self.args.block_size
+        n = len(ids)
+        kb = gather_blocks(self.k_cache, ids, block_size=bs)
+        vb = gather_blocks(self.v_cache, ids, block_size=bs)
+        # gather pads ids to a power of two; slice back host-side
+        k, v = await asyncio.to_thread(
+            lambda: (np.ascontiguousarray(np.asarray(kb)[:, :n]),
+                     np.ascontiguousarray(np.asarray(vb)[:, :n])))
+        return KvBundle(k=k, v=v, num_tokens=num_tokens, block_size=bs,
+                        start_block=start_block)
+
+    # ------------------------------------------------- decode-side injection
+
+    def alloc_inject(self, n_blocks: int):
+        """Allocate blocks for externally-computed KV, respecting admission
+        limits (injection bypasses the waiting queue). None = can't place."""
         free_frac = self.pool.num_free_blocks / max(1, self.pool.num_blocks)
         if (len(self.scheduler.running) >= self.args.max_num_seqs
                 or free_frac < self.args.watermark):
-            async for out in self.generate(req, ctx):
-                yield out
-            return
-        n = bundle.k.shape[1]
-        ids = self.pool.allocate(n)
-        if ids is None:  # memory pressure: recompute prefill locally
-            async for out in self.generate(req, ctx):
-                yield out
-            return
-        try:
-            self.k_cache = scatter_blocks(self.k_cache, ids, bundle.k,
-                                          block_size=bs)
-            self.v_cache = scatter_blocks(self.v_cache, ids, bundle.v,
-                                          block_size=bs)
-        except Exception:
-            self.pool.release(ids)
-            logger.exception("KV bundle scatter failed; local prefill")
-            async for out in self.generate(req, ctx):
-                yield out
-            return
+            return None
+        return self.pool.allocate(n_blocks)
 
+    def release_inject(self, ids) -> None:
+        self.pool.release(ids)
+
+    def check_bundle_dims(self, bundle) -> bool:
+        L, slots, KV, hd = self.k_cache.shape
+        return (bundle.block_size == self.args.block_size
+                and bundle.k.shape[0] == L and bundle.k.shape[3:] == (KV, hd))
+
+    def scatter_chunk(self, ids, k: np.ndarray, v: np.ndarray) -> None:
+        """Place received pages [L, n, bs, KV, hd] into device blocks ``ids``."""
+        from dynamo_tpu.ops.block_copy import scatter_blocks
+
+        bs = self.args.block_size
+        self.k_cache = scatter_blocks(self.k_cache, ids, k, block_size=bs)
+        self.v_cache = scatter_blocks(self.v_cache, ids, v, block_size=bs)
+
+    async def generate_prefilled(self, req: PreprocessedRequest, token_id: int,
+                                 logprob, ids, ctx=None
+                                 ) -> AsyncIterator[LLMEngineOutput]:
+        """Decode a request whose prompt KV is already scattered into ``ids``.
+
+        Ownership of ``ids`` transfers to the sequence (released on finish).
+        """
+        self._ensure_loop()
         sink: asyncio.Queue = asyncio.Queue()
         seq = SeqState(request_id=f"seq-{next(self._seq_counter)}",
                        req=req, ctx=ctx or _NullCtx(), sink=sink)
         self.scheduler.add_prefilled(seq, ids)
 
         # the prefill worker's token is the stream's first output
-        first = LLMEngineOutput(token_ids=[prefill.token_id],
-                                log_probs=[prefill.logprob]
-                                if prefill.logprob is not None else None)
-        self.scheduler.append_token(seq, prefill.token_id)
-        reason = self.scheduler.check_finish(seq, prefill.token_id)
+        first = LLMEngineOutput(token_ids=[token_id],
+                                log_probs=[logprob]
+                                if logprob is not None else None)
+        self.scheduler.append_token(seq, token_id)
+        reason = self.scheduler.check_finish(seq, token_id)
         if reason is not None:
             first.finish_reason = reason
             self.scheduler.finish(seq, reason)
@@ -251,6 +353,45 @@ class AsyncJaxEngine:
             yield out
             if out.finish_reason is not None:
                 return
+
+    async def generate_injected(self, req: PreprocessedRequest, prefill,
+                                ctx=None) -> AsyncIterator[LLMEngineOutput]:
+        """Decode a request whose prompt KV arrives as one whole KvBundle
+        (the unpipelined path; the handler's streamed path uses
+        alloc_inject/scatter_chunk/generate_prefilled directly).
+
+        Falls back to a full local generate when the bundle can't be placed
+        (allocation failure or block-size mismatch).
+        """
+        bundle = prefill.bundle
+        if (bundle is None or prefill.token_id < 0
+                or not self.check_bundle_dims(bundle)
+                or bundle.start_block != 0):
+            if bundle is not None and not self.check_bundle_dims(bundle):
+                logger.warning("KV bundle dims %s mismatch cache %s; local "
+                               "prefill", bundle.k.shape, self.k_cache.shape)
+            async for out in self.generate(req, ctx):
+                yield out
+            return
+
+        self._ensure_loop()
+        n = bundle.k.shape[1]
+        ids = self.alloc_inject(n)
+        if ids is None:  # memory pressure: recompute prefill locally
+            async for out in self.generate(req, ctx):
+                yield out
+            return
+        try:
+            self.scatter_chunk(ids, bundle.k, bundle.v)
+        except Exception:
+            self.pool.release(ids)
+            logger.exception("KV bundle scatter failed; local prefill")
+            async for out in self.generate(req, ctx):
+                yield out
+            return
+        async for out in self.generate_prefilled(req, prefill.token_id,
+                                                 prefill.logprob, ids, ctx):
+            yield out
 
     def _ensure_loop(self) -> None:
         if self._task is None or self._task.done():
@@ -335,6 +476,18 @@ class AsyncJaxEngine:
             jnp.asarray(last_idx), self.k_cache, self.v_cache)
 
         self.scheduler.commit_computed(seq, end)
+        if seq.progress_cb is not None:
+            try:
+                seq.progress_cb(end)
+            except Exception:
+                # shipping is an optimization: stop it for THIS seq (the tail
+                # bundle covers whatever wasn't shipped) instead of letting
+                # the failure abort every in-flight sequence via _run's
+                # blanket handler
+                logger.exception("prefill progress callback failed; "
+                                 "disabling chunk shipping for %s",
+                                 seq.request_id)
+                seq.progress_cb = None
 
         if work.sample:
             toks, logps = await self._sample([seq], logits)

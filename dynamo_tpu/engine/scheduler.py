@@ -53,6 +53,9 @@ class SeqState:
     preemptions: int = 0
     #: disagg: keep KV blocks alive past finish (owner gathers then releases)
     hold_blocks: bool = False
+    #: disagg pipelining: called with (num_computed) after each prefill chunk
+    #: commits — lets the owner ship finished blocks while later chunks run
+    progress_cb: Optional[Callable] = None
 
     @property
     def remaining(self) -> int:

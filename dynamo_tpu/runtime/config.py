@@ -38,6 +38,23 @@ class RuntimeConfig:
         return RuntimeConfig()
 
 
+def apply_platform_env() -> None:
+    """Honor an explicit ``JAX_PLATFORMS`` even though the container's
+    sitecustomize imports jax at interpreter startup and pins the axon TPU
+    plugin (by then the env var is too late — jax.config must be used).
+    Without this, CPU-only smoke runs of the worker mains hang trying to
+    reach a TPU tunnel they were told not to use."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+    except Exception:  # jax absent (pure control-plane processes): fine
+        pass
+
+
 _LOGGING_CONFIGURED = False
 
 
@@ -46,6 +63,7 @@ def setup_logging():
     if _LOGGING_CONFIGURED:
         return
     _LOGGING_CONFIGURED = True
+    apply_platform_env()
     level = os.environ.get("DYN_LOG", "info").upper()
     if os.environ.get("DYN_LOGGING_JSONL"):
         fmt = '{"ts":"%(asctime)s","level":"%(levelname)s","target":"%(name)s","msg":"%(message)s"}'

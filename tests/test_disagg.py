@@ -143,6 +143,143 @@ async def test_handlers_end_to_end_local_client():
     await dec.close()
 
 
+async def test_pipelined_prefill_stream_chunks_then_final():
+    """A multi-chunk prompt must ship KvChunkFrames BEFORE the final
+    PrefillResponse (transfer overlapped with prefill compute), and the
+    streamed frames must reassemble into the exact aggregated KV."""
+    from dynamo_tpu.disagg.protocols import KvChunkFrame
+
+    prompt = list(range(1, 151))  # 150 tokens, chunks of 64 → 2 mid frames
+    pre = make_engine()
+    frames = []
+    async for w in pre.prefill_extract_stream(req(prompt)):
+        frames.append(w)
+    await pre.close()
+    chunk_frames = [f for f in frames if KvChunkFrame.is_wire(f)]
+    assert len(chunk_frames) >= 2  # blocks shipped while prefill ran
+    assert not KvChunkFrame.is_wire(frames[-1])
+    final = PrefillResponse.from_wire(frames[-1])
+    assert final.token_id >= 0
+    # contiguous coverage: chunks then tail cover ceil(150/4) blocks
+    nxt = 0
+    for f in chunk_frames:
+        b = KvChunkFrame.from_wire(f).bundle
+        assert b.start_block == nxt
+        nxt += b.k.shape[1]
+    assert final.bundle is not None and final.bundle.start_block == nxt
+    assert nxt + final.bundle.k.shape[1] == (len(prompt) + 3) // 4
+
+
+async def test_pipelined_disagg_matches_aggregated():
+    """Full handler flow with streamed chunk scatter == aggregated tokens."""
+    prompt = list(range(1, 151))
+
+    agg = make_engine()
+    want = await collect_engine(agg, req(prompt))
+    await agg.close()
+
+    pre = make_engine()
+    dec = make_engine()
+    ph = PrefillWorkerHandler(pre)
+
+    class FakePrefillClient:
+        def available_ids(self):
+            return [1]
+
+        async def generate(self, request, mode="round_robin"):
+            async def stream():
+                async for frame in ph.generate(request, None):
+                    yield frame
+            return stream()
+
+    dh = DecodeWorkerHandler(dec, FakePrefillClient(),
+                             DisaggConfig(max_local_prefill_length=8))
+    got = []
+    async for frame in dh.generate(req(prompt).to_wire(), None):
+        got.extend(frame.get("token_ids", []))
+    assert got == want
+    # decode-side blocks released when the request finished
+    await pre.close()
+    await dec.close()
+
+
+async def test_pipelined_disagg_mismatch_falls_back_local():
+    """A decode engine that can't place the chunks (block-size mismatch)
+    must drain the stream and recompute locally — same tokens, no leak."""
+    prompt = list(range(1, 151))
+    agg = make_engine(block_size=8)
+    want = await collect_engine(agg, req(prompt))
+    await agg.close()
+
+    pre = make_engine()  # block_size 4 → chunk frames won't place below
+    dec = make_engine(block_size=8)
+    free0 = dec.pool.num_free_blocks
+    ph = PrefillWorkerHandler(pre)
+
+    class FakePrefillClient:
+        def available_ids(self):
+            return [1]
+
+        async def generate(self, request, mode="round_robin"):
+            async def stream():
+                async for frame in ph.generate(request, None):
+                    yield frame
+            return stream()
+
+    dh = DecodeWorkerHandler(dec, FakePrefillClient(),
+                             DisaggConfig(max_local_prefill_length=8))
+    got = []
+    async for frame in dh.generate(req(prompt).to_wire(), None):
+        got.extend(frame.get("token_ids", []))
+    assert got == want
+    for _ in range(50):
+        if dec.pool.num_free_blocks == free0:
+            break
+        await asyncio.sleep(0.02)
+    assert dec.pool.num_free_blocks == free0
+    await pre.close()
+    await dec.close()
+
+
+async def test_pipelined_stream_failure_releases_injected_blocks():
+    """Prefill stream dying after chunk frames landed must not leak the
+    decode-side injected blocks (mid-stream failure surfaces upstream)."""
+    from dynamo_tpu.disagg.protocols import KvChunkFrame
+
+    prompt = list(range(1, 151))
+    pre = make_engine()
+    dec = make_engine()
+    free0 = dec.pool.num_free_blocks
+    ph = PrefillWorkerHandler(pre)
+
+    class DyingPrefillClient:
+        def available_ids(self):
+            return [1]
+
+        async def generate(self, request, mode="round_robin"):
+            async def stream():
+                async for frame in ph.generate(request, None):
+                    yield frame
+                    if KvChunkFrame.is_wire(frame):
+                        raise ConnectionError("prefill worker died")
+            return stream()
+
+    dh = DecodeWorkerHandler(dec, DyingPrefillClient(),
+                             DisaggConfig(max_local_prefill_length=8))
+    # no tokens were yielded before the failure → handler falls back local
+    got = []
+    async for frame in dh.generate(req(prompt).to_wire(), None):
+        got.extend(frame.get("token_ids", []))
+    assert len(got) == 8
+    for _ in range(50):
+        if dec.pool.num_free_blocks == free0 and not dec.scheduler.has_work:
+            break
+        await asyncio.sleep(0.02)
+    assert dec.pool.num_free_blocks == free0
+    await pre.close()
+    await dec.close()
+
+
 async def test_prefill_extract_cancelled_releases_blocks():
     """Cancelling prefill_extract mid-flight must not leak held blocks."""
     eng = make_engine()
