@@ -21,6 +21,10 @@ from dynamo_tpu.protocols import LLMEngineOutput, PreprocessedRequest
 
 logger = logging.getLogger("dynamo.disagg")
 
+#: request annotation by which a decode worker advertises that it can
+#: consume mid-prefill KvChunkFrames (pipelined transfer)
+KV_CHUNKS_ANNOTATION = "kv_chunks"
+
 
 class PrefillWorkerHandler:
     """Serves the prefill component's ``generate`` endpoint.
@@ -34,8 +38,15 @@ class PrefillWorkerHandler:
 
     async def generate(self, request: dict, ctx):
         req = PreprocessedRequest.from_wire(request)
-        async for frame in self.engine.prefill_extract_stream(req, ctx):
-            yield frame
+        # capability negotiation: chunk frames only when the decode side
+        # asked for them — an older decode worker that parses the first
+        # frame as PrefillResponse keeps working (whole-bundle path)
+        if KV_CHUNKS_ANNOTATION in (req.annotations or []):
+            async for frame in self.engine.prefill_extract_stream(req, ctx):
+                yield frame
+        else:
+            resp = await self.engine.prefill_extract(req, ctx)
+            yield resp.to_wire()
 
 
 class DecodeWorkerHandler:
@@ -75,10 +86,14 @@ class DecodeWorkerHandler:
             yield out.to_wire()
 
     async def _generate_disagg(self, req: PreprocessedRequest, ctx):
+        import dataclasses
+
         logger.debug("remote prefill: %d prompt tokens → prefill fleet",
                      len(req.token_ids))
+        preq = dataclasses.replace(
+            req, annotations=list(req.annotations or []) + [KV_CHUNKS_ANNOTATION])
         stream = await self.prefill_client.generate(
-            req.to_wire(), mode="round_robin")
+            preq.to_wire(), mode="round_robin")
         eng = self.engine
         bs = eng.args.block_size
         total = (len(req.token_ids) + bs - 1) // bs

@@ -102,6 +102,9 @@ class AsyncJaxEngine:
         self._task: Optional[asyncio.Task] = None
         self._closed = False
         self.steps = 0
+        #: multi-process DP fleet rank (None = single-rank); reported in
+        #: worker stats (ref: kv_router/protocols.rs:57 data_parallel_rank)
+        self.dp_rank: Optional[int] = None
 
     # ------------------------------------------------------------------ api
 
@@ -210,6 +213,11 @@ class AsyncJaxEngine:
         def on_progress(end: int) -> None:
             full = end // bs
             if full <= state["shipped"]:
+                return
+            # backpressure: if the consumer (response plane) is behind, skip
+            # this ship — unshipped blocks ride the next progress event or
+            # the tail bundle, instead of piling duplicate KV copies in HBM
+            if events.qsize() >= 4:
                 return
             ids = seq.block_table[state["shipped"]:full]
             kb = gather_blocks(self.k_cache, ids, block_size=bs)
@@ -778,6 +786,7 @@ class AsyncJaxEngine:
                 request_active_slots=len(sched.running),
                 request_total_slots=self.args.max_num_seqs,
                 num_requests_waiting=sched.num_waiting(),
+                data_parallel_rank=self.dp_rank,
             ),
             kv_stats=KvStats(
                 kv_active_blocks=active,

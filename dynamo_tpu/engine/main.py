@@ -67,7 +67,15 @@ async def amain():
     ap.add_argument("--max-num-batched-tokens", type=int, default=2048)
     ap.add_argument("--max-model-len", type=int, default=4096)
     ap.add_argument("--tp-size", type=int, default=1)
-    ap.add_argument("--dp-size", type=int, default=1)
+    ap.add_argument("--dp-size", type=int, default=1,
+                    help="in-process mesh dp axis (batch shards inside ONE "
+                         "engine); for a multi-process DP fleet use --dp-rank")
+    ap.add_argument("--dp-rank", type=int, default=None,
+                    help="this process's rank in a multi-process DP fleet "
+                         "(ref: vllm/main.py:221-237 per-rank workers; "
+                         "rank 0 registers the model, all ranks barrier)")
+    ap.add_argument("--num-ranks", type=int, default=1,
+                    help="total DP fleet size (with --dp-rank)")
     ap.add_argument("--use-pallas-attention", action="store_true")
     ap.add_argument("--multi-step-decode", type=int, default=1,
                     help="decode steps fused per jitted call (token bursts)")
@@ -138,9 +146,13 @@ async def amain():
         kvbm_disk_bytes=int(cli.kvbm_disk_gb * (1 << 30)),
     )
 
+    if cli.dp_rank is not None and not 0 <= cli.dp_rank < cli.num_ranks:
+        ap.error(f"--dp-rank {cli.dp_rank} outside [0, {cli.num_ranks})")
+
     engine = build_engine(cli, cfg, args)  # heavy JAX work first (see above)
     runtime = await DistributedRuntime.create()
     lease = await runtime.primary_lease()
+    engine.dp_rank = cli.dp_rank
     engine.event_cb = KvEventPublisher(
         runtime.plane, worker_id=lease,
         kv_block_size=args.block_size).publish_sync
@@ -170,7 +182,28 @@ async def amain():
 
     handle = await ep.serve_endpoint(serve, lease_id=lease)
 
-    if cli.role != "prefill":  # prefill fleet is internal, not a model server
+    # Multi-process DP fleet: every rank serves its own endpoint instance
+    # (its own lease → the router sees N routable instances, each with its
+    # own KV-event stream), but only rank 0 registers the model — and only
+    # after the whole fleet has checked in at the startup barrier, so the
+    # model never appears half-provisioned (ref: vllm/main.py:221-237
+    # rank-0-only registration; leader_worker_barrier.rs:14).
+    dp_fleet = cli.dp_rank is not None and cli.num_ranks > 1
+    register = cli.role != "prefill"
+    if dp_fleet:
+        from dynamo_tpu.runtime.barrier import LeaderWorkerBarrier
+        # component in the id keeps prefill-fleet and decode-fleet barriers
+        # of one model from colliding in a disagg deployment
+        barrier = LeaderWorkerBarrier(
+            runtime.plane, f"dp/{cli.namespace}/{component}/{cli.model}",
+            lease_id=lease)
+        if cli.dp_rank == 0:
+            await barrier.leader_enter(cli.model.encode(), cli.num_ranks - 1)
+        else:
+            await barrier.worker_enter(f"rank-{cli.dp_rank}")
+            register = False
+
+    if register:  # prefill fleet is internal, not a model server
         card = ModelDeploymentCard(
             display_name=cli.model,
             kv_cache_block_size=args.block_size,

@@ -1,0 +1,100 @@
+"""Leader-worker startup barrier over the control-plane KV store.
+
+Multi-host/multi-rank engine startup needs a rendezvous: the leader posts
+shared bootstrap data, waits until all N workers have checked in, then
+releases everyone at once (ref behavior contract:
+lib/runtime/src/utils/leader_worker_barrier.rs:14 — etcd-based; here the
+same semantics ride dynctl's KV + prefix watches).
+
+Key scheme (all under ``barriers/<barrier_id>/``):
+
+- ``leader``            — leader's payload; create-if-absent makes double
+                          leadership a loud failure.
+- ``workers/<worker>``  — one key per checked-in worker (lease-attached, so
+                          a dead worker disappears rather than wedging a
+                          future barrier of the same id).
+- ``ready``             — written by the leader once all N workers are
+                          present; workers block on it and then read the
+                          payload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from dynamo_tpu.runtime.control_plane import ControlPlane
+
+
+class BarrierError(Exception):
+    pass
+
+
+class LeaderWorkerBarrier:
+    def __init__(self, plane: ControlPlane, barrier_id: str,
+                 lease_id: Optional[int] = None):
+        self.plane = plane
+        self.prefix = f"barriers/{barrier_id}/"
+        self.lease_id = lease_id
+
+    async def leader_enter(self, data: bytes, num_workers: int,
+                           timeout: float = 120.0) -> None:
+        """Post ``data``, wait for ``num_workers`` check-ins, release."""
+        created = await self.plane.kv_create(self.prefix + "leader", data,
+                                             lease_id=self.lease_id)
+        if not created:
+            raise BarrierError(
+                f"barrier {self.prefix}: a leader is already registered")
+        watch = await self.plane.watch_prefix(self.prefix + "workers/")
+        try:
+            seen = set(watch.snapshot)
+
+            async def wait_workers():
+                if len(seen) >= num_workers:
+                    return
+                async for ev in watch:
+                    if ev.type == "put":
+                        seen.add(ev.key)
+                    else:
+                        seen.discard(ev.key)
+                    if len(seen) >= num_workers:
+                        return
+
+            try:
+                await asyncio.wait_for(wait_workers(), timeout)
+            except asyncio.TimeoutError:
+                raise BarrierError(
+                    f"barrier {self.prefix}: {len(seen)}/{num_workers} "
+                    f"workers after {timeout}s")
+        finally:
+            await watch.cancel()
+        await self.plane.kv_put(self.prefix + "ready", b"1",
+                                lease_id=self.lease_id)
+
+    async def worker_enter(self, worker_id: str,
+                           timeout: float = 120.0) -> bytes:
+        """Check in and block until the leader releases; returns its data."""
+        await self.plane.kv_put(self.prefix + f"workers/{worker_id}", b"1",
+                                lease_id=self.lease_id)
+        watch = await self.plane.watch_prefix(self.prefix + "ready")
+        try:
+            async def wait_ready():
+                if watch.snapshot:
+                    return
+                async for ev in watch:
+                    if ev.type == "put":
+                        return
+
+            try:
+                await asyncio.wait_for(wait_ready(), timeout)
+            except asyncio.TimeoutError:
+                raise BarrierError(
+                    f"barrier {self.prefix}: leader never released "
+                    f"within {timeout}s")
+        finally:
+            await watch.cancel()
+        data = await self.plane.kv_get(self.prefix + "leader")
+        if data is None:
+            raise BarrierError(
+                f"barrier {self.prefix}: leader key vanished (lease expiry?)")
+        return data

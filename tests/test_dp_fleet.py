@@ -1,0 +1,192 @@
+"""Leader-worker barrier + multi-process DP fleet startup.
+
+r1 verdict item #5: the barrier is the multi-host runway — rank-0-only
+model registration, per-rank endpoint instances/KV streams, fleet-complete
+gating (ref: utils/leader_worker_barrier.rs:14, vllm/main.py:221-237).
+"""
+
+import asyncio
+import os
+import socket
+import sys
+
+import pytest
+
+from dynamo_tpu.runtime.barrier import BarrierError, LeaderWorkerBarrier
+from dynamo_tpu.runtime.control_plane import LocalControlPlane
+
+pytestmark = pytest.mark.anyio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------- barrier unit
+
+
+async def test_barrier_rendezvous():
+    plane = LocalControlPlane()
+    b = LeaderWorkerBarrier(plane, "t1")
+    order = []
+
+    async def leader():
+        await b.leader_enter(b"bootstrap", num_workers=2, timeout=10)
+        order.append("leader")
+
+    async def worker(i):
+        data = await LeaderWorkerBarrier(plane, "t1").worker_enter(
+            f"w{i}", timeout=10)
+        order.append(f"w{i}")
+        assert data == b"bootstrap"
+
+    await asyncio.gather(leader(), worker(0), worker(1))
+    assert len(order) == 3
+    await plane.close()
+
+
+async def test_barrier_double_leader_fails():
+    plane = LocalControlPlane()
+    b = LeaderWorkerBarrier(plane, "t2")
+    t = asyncio.create_task(b.leader_enter(b"x", num_workers=1, timeout=5))
+    await asyncio.sleep(0.05)
+    with pytest.raises(BarrierError, match="already registered"):
+        await LeaderWorkerBarrier(plane, "t2").leader_enter(
+            b"y", num_workers=1, timeout=5)
+    await LeaderWorkerBarrier(plane, "t2").worker_enter("w0", timeout=5)
+    await t
+    await plane.close()
+
+
+async def test_barrier_leader_timeout_names_missing_count():
+    plane = LocalControlPlane()
+    b = LeaderWorkerBarrier(plane, "t3")
+    with pytest.raises(BarrierError, match="0/2 workers"):
+        await b.leader_enter(b"x", num_workers=2, timeout=0.2)
+    await plane.close()
+
+
+async def test_barrier_worker_sees_preexisting_ready():
+    """A worker arriving after release must pass straight through."""
+    plane = LocalControlPlane()
+    b = LeaderWorkerBarrier(plane, "t4")
+    t = asyncio.create_task(b.leader_enter(b"d", num_workers=1, timeout=5))
+    await LeaderWorkerBarrier(plane, "t4").worker_enter("w0", timeout=5)
+    await t
+    # late joiner (e.g. restarted rank): ready key already present
+    data = await LeaderWorkerBarrier(plane, "t4").worker_enter("w1", timeout=5)
+    assert data == b"d"
+    await plane.close()
+
+
+# --------------------------------------------------- cross-process DP fleet
+
+
+async def _spawn(args, addr, ready_marker, log_name):
+    env = dict(os.environ, PYTHONPATH=REPO, DYN_CONTROL_PLANE=addr,
+               JAX_PLATFORMS="cpu", DYN_LOG="warning")
+    proc = await asyncio.create_subprocess_exec(
+        PY, *args, env=env,
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT)
+    buf = []
+
+    async def wait_ready():
+        while True:
+            line = await proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"{log_name} exited before ready:\n" + b"".join(buf).decode())
+            buf.append(line)
+            if ready_marker.encode() in line:
+                return
+
+    await asyncio.wait_for(wait_ready(), 120)
+
+    async def drain():
+        while True:
+            line = await proc.stdout.readline()
+            if not line:
+                return
+            buf.append(line)
+
+    proc._drain_task = asyncio.get_running_loop().create_task(drain())
+    proc._log = buf
+    return proc
+
+
+async def test_dp_fleet_two_ranks_router_e2e():
+    """2-process DP fleet: one model registration, two routable instances,
+    requests land on both ranks."""
+    cp_port = free_port()
+    addr = f"127.0.0.1:{cp_port}"
+    procs = []
+    try:
+        dynctl = await _spawn(
+            ["-m", "dynamo_tpu.runtime.dynctl", "--port", str(cp_port)],
+            addr, "dynctl listening", "dynctl")
+        procs.append(dynctl)
+
+        common = ["-m", "dynamo_tpu.engine.main", "--arch", "tiny",
+                  "--block-size", "4", "--num-blocks", "64",
+                  "--max-num-batched-tokens", "64", "--max-model-len", "128",
+                  "--allow-test-metadata", "--model", "dp-tiny",
+                  "--num-ranks", "2"]
+        # start rank 1 FIRST: it must block at the barrier until rank 0 leads
+        r1_task = asyncio.create_task(_spawn(
+            common + ["--dp-rank", "1"], addr, "WORKER_READY", "rank1"))
+        await asyncio.sleep(1.0)
+        assert not r1_task.done()  # still waiting at the barrier
+        r0 = await _spawn(common + ["--dp-rank", "0"], addr,
+                          "WORKER_READY", "rank0")
+        procs.append(r0)
+        r1 = await r1_task
+        procs.append(r1)
+
+        from dynamo_tpu.llm.model_card import MODEL_ROOT
+        from dynamo_tpu.protocols import (PreprocessedRequest,
+                                          SamplingOptions, StopConditions)
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        os.environ["DYN_CONTROL_PLANE"] = addr
+        try:
+            rt = await DistributedRuntime.create()
+            # exactly ONE registering rank (rank 0) — all model keys under a
+            # single lease dir models/<slug>/<lease>/<kind>
+            entries = await rt.plane.kv_get_prefix(MODEL_ROOT)
+            leases = {k.split("/")[2] for k in entries}
+            assert len(leases) == 1, entries
+
+            ep = rt.namespace("dynamo").component("backend").endpoint("generate")
+            client = await ep.client().start()
+            for _ in range(100):
+                if len(client.available_ids()) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            ids = client.available_ids()
+            assert len(ids) == 2  # one routable instance per rank
+
+            req = PreprocessedRequest(
+                model="dp-tiny", token_ids=list(range(1, 9)),
+                stop_conditions=StopConditions(max_tokens=2, ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0))
+            # both ranks must actually serve: route to each directly
+            for iid in ids:
+                stream = await client.generate(req.to_wire(), mode="direct",
+                                               instance_id=iid)
+                toks = []
+                async for frame in stream:
+                    toks.extend(frame.get("token_ids", []))
+                assert len(toks) == 2, f"instance {iid:x} failed"
+            await rt.shutdown()
+        finally:
+            os.environ.pop("DYN_CONTROL_PLANE", None)
+    finally:
+        for p in procs:
+            if p.returncode is None:
+                p.kill()
+            await p.wait()
