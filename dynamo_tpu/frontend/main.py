@@ -25,6 +25,14 @@ async def amain():
     ap.add_argument("--router-mode", choices=["kv", "round_robin", "random"], default="kv")
     ap.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     ap.add_argument("--router-temperature", type=float, default=0.0)
+    ap.add_argument("--router-replica-sync", action="store_true",
+                    help="broadcast routing decisions to other frontend "
+                         "replicas (ref: sequence.rs:283-340)")
+    ap.add_argument("--router-snapshot-threshold", type=int, default=10000,
+                    help="radix snapshot to the object store every N events "
+                         "(0 = off; ref: subscriber.rs:30-65)")
+    ap.add_argument("--router-reset-states", action="store_true",
+                    help="ignore any persisted radix snapshot on start")
     args = ap.parse_args()
 
     runtime = await DistributedRuntime.create()
@@ -36,6 +44,9 @@ async def amain():
         kv_router_config=KvRouterConfig(
             overlap_score_weight=args.kv_overlap_score_weight,
             router_temperature=args.router_temperature,
+            router_replica_sync=args.router_replica_sync,
+            router_snapshot_threshold=args.router_snapshot_threshold or None,
+            router_reset_states=args.router_reset_states,
         ),
     ).start()
     service = HttpService(manager, host=args.host, port=args.port)

@@ -127,8 +127,10 @@ class RadixTree:
         return out
 
     # -- snapshot support (restored on router start, ref: subscriber.rs:30-65) --
-    def dump(self) -> bytes:
-        """Serialize tree + removal lookup so a restored router keeps working."""
+    def dump_obj(self) -> dict:
+        """Walk tree + removal lookup into plain lists (must run while the
+        tree is quiescent — i.e. on the indexer task); serialization of the
+        result can then happen off the event loop."""
         entries = []
         node_path: dict[int, tuple[int, ...]] = {id(self.root): ()}
 
@@ -143,7 +145,11 @@ class RadixTree:
         lookup = [
             [w, h, list(node_path[id(node)])] for (w, h), node in self._lookup.items()
         ]
-        return msgpack.packb({"entries": entries, "lookup": lookup, "count": self.event_count})
+        return {"entries": entries, "lookup": lookup, "count": self.event_count}
+
+    def dump(self) -> bytes:
+        """Serialize tree + removal lookup so a restored router keeps working."""
+        return msgpack.packb(self.dump_obj())
 
     @staticmethod
     def load(data: bytes) -> "RadixTree":
@@ -168,19 +174,56 @@ class RadixTree:
         return tree
 
 
-class KvIndexer:
-    """Applies RouterEvents from the durable stream to a RadixTree."""
+#: object-store bucket for radix snapshots (ref: RADIX_STATE_BUCKET
+#: "radix-bucket", kv_router.rs:68-71)
+RADIX_BUCKET = "radix-bucket"
 
-    def __init__(self, plane, kv_block_size: int, stream: str = KV_EVENTS_STREAM):
+
+class KvIndexer:
+    """Applies RouterEvents from the durable stream to a RadixTree.
+
+    Durability (ref: subscriber.rs:30-65): every ``snapshot_threshold``
+    applied events the tree is dumped to the object store together with the
+    last applied stream seq, under a lease-guarded distributed lock (so only
+    one of N router replicas pays the dump). On start the snapshot is
+    restored and the stream consumed from seq+1 — a restarted frontend keeps
+    its overlap scores even after the event stream's ring buffer truncated
+    the early events.
+    """
+
+    def __init__(self, plane, kv_block_size: int, stream: str = KV_EVENTS_STREAM,
+                 snapshot_threshold: Optional[int] = None,
+                 reset_states: bool = False):
         self.plane = plane
         self.kv_block_size = kv_block_size
         self.stream = stream
+        self.snapshot_threshold = snapshot_threshold
+        self.reset_states = reset_states
         self.tree = RadixTree()
         self._task: Optional[asyncio.Task] = None
         self._sub = None
         self.events_applied = 0
+        self.snapshots_written = 0
+        self._last_seq = -1
+        self._since_snapshot = 0
+        self._snapshot_task: Optional[asyncio.Task] = None
 
     async def start(self, start_seq: int = 0) -> "KvIndexer":
+        if self.snapshot_threshold and not self.reset_states:
+            data = await self.plane.object_get(RADIX_BUCKET, self.stream)
+            if data:
+                try:
+                    d = msgpack.unpackb(data, raw=False)
+                    self.tree = RadixTree.load(d["tree"])
+                    self._last_seq = d["seq"]
+                    # stream_subscribe start is EXCLUSIVE (delivers seq >
+                    # start_seq), so resuming right after snapshot seq S
+                    # means passing S itself
+                    start_seq = max(start_seq, d["seq"])
+                    logger.info("restored radix snapshot at seq %d", d["seq"])
+                except Exception:
+                    logger.exception("radix snapshot restore failed; fresh tree")
+                    self.tree = RadixTree()
         self._sub = await self.plane.stream_subscribe(self.stream, start_seq=start_seq)
         self._task = asyncio.get_running_loop().create_task(self._loop())
         return self
@@ -188,20 +231,60 @@ class KvIndexer:
     async def stop(self):
         if self._task:
             self._task.cancel()
+        if self._snapshot_task and not self._snapshot_task.done():
+            try:
+                await self._snapshot_task
+            except Exception:
+                pass
         if self._sub:
             await self._sub.cancel()
 
     async def _loop(self):
         try:
-            async for _seq, payload in self._sub:
+            async for seq, payload in self._sub:
                 try:
                     ev = RouterEvent.from_wire(msgpack.unpackb(payload, raw=False))
                     self.tree.apply_event(ev)
                     self.events_applied += 1
+                    self._last_seq = seq
+                    self._since_snapshot += 1
                 except Exception:
                     logger.exception("bad kv event ignored")
+                if (self.snapshot_threshold
+                        and self._since_snapshot >= self.snapshot_threshold
+                        and (self._snapshot_task is None
+                             or self._snapshot_task.done())):
+                    self._since_snapshot = 0
+                    self._snapshot_task = asyncio.get_running_loop().create_task(
+                        self._snapshot())
         except asyncio.CancelledError:
             pass
+
+    async def _snapshot(self):
+        """Dump under a lease-guarded lock; losers skip (a replica won)."""
+        lock_key = f"locks/radix/{self.stream}"
+        try:
+            lease = await self.plane.lease_create(ttl=10.0)
+            if not await self.plane.kv_create(lock_key, b"1", lease_id=lease):
+                await self.plane.lease_revoke(lease)
+                return
+            try:
+                # tree mutation happens only on the indexer task of THIS
+                # process; capture seq + walk in one synchronous section,
+                # then serialize off the event loop (packb is O(tree) and
+                # would stall every in-flight request on a busy frontend)
+                seq = self._last_seq
+                obj = self.tree.dump_obj()
+                payload = await asyncio.to_thread(
+                    lambda: msgpack.packb(
+                        {"seq": seq, "tree": msgpack.packb(obj)}))
+                await self.plane.object_put(RADIX_BUCKET, self.stream, payload)
+                self.snapshots_written += 1
+                logger.debug("radix snapshot written at seq %d", seq)
+            finally:
+                await self.plane.lease_revoke(lease)  # deletes the lock key
+        except Exception:
+            logger.exception("radix snapshot failed")
 
     def find_matches(self, local_hashes: list[int]) -> OverlapScores:
         return self.tree.find_matches(local_hashes)
@@ -225,11 +308,14 @@ class ApproxKvIndexer:
     TTL_SECS = 120.0
 
     def __init__(self, kv_block_size: int, ttl: float = TTL_SECS):
+        from collections import deque
+
         self.kv_block_size = kv_block_size
         self.ttl = ttl
         self.tree = RadixTree()
-        # (worker, first_external_hash_of_insert) -> (expiry, external_hashes)
-        self._expiries: list[tuple[float, int, list[int]]] = []
+        # (expiry, worker, external_hashes) — appended in time order, popped
+        # from the left (deque: the r1 O(n) list.pop(0) scan is gone)
+        self._expiries: deque[tuple[float, int, list[int]]] = deque()
         self._ids = 0
 
     def process_routing_decision_for_request(self, token_ids: list[int], worker_id: int) -> None:
@@ -248,7 +334,7 @@ class ApproxKvIndexer:
     def _expire(self):
         now = time.monotonic()
         while self._expiries and self._expiries[0][0] <= now:
-            _, worker, hashes = self._expiries.pop(0)
+            _, worker, hashes = self._expiries.popleft()
             self._ids += 1
             self.tree.apply_event(RouterEvent(worker, KvCacheEvent.removed(self._ids, hashes)))
 

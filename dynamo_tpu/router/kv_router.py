@@ -31,24 +31,86 @@ from dynamo_tpu.tokens import compute_block_hash_for_seq, compute_seq_hash_for_b
 logger = logging.getLogger("dynamo.kv_router")
 
 
+#: pub/sub subject for cross-replica routing-decision sync
+#: (ref: subjects prefill_events / active_sequences_events, kv_router.rs:64-65)
+ROUTER_SYNC_SUBJECT = "router_sync"
+
+
 class KvRouter:
     def __init__(self, plane, block_size: int, config: Optional[KvRouterConfig] = None):
+        import uuid
+
+        self.plane = plane
         self.block_size = block_size
         self.config = config or KvRouterConfig()
         if self.config.use_kv_events:
-            self.indexer: KvIndexer | ApproxKvIndexer = KvIndexer(plane, block_size)
+            self.indexer: KvIndexer | ApproxKvIndexer = KvIndexer(
+                plane, block_size,
+                snapshot_threshold=self.config.router_snapshot_threshold,
+                reset_states=self.config.router_reset_states)
         else:
             self.indexer = ApproxKvIndexer(block_size)
         self.scheduler = KvScheduler(block_size, self.config)
+        #: identifies this replica in sync messages (skip own echoes)
+        self.replica_id = uuid.uuid4().hex
+        self._sync_sub = None
+        self._sync_task = None
 
     async def start(self) -> "KvRouter":
         if isinstance(self.indexer, KvIndexer):
             await self.indexer.start()
+        if self.config.router_replica_sync:
+            self._sync_sub = await self.plane.subscribe(ROUTER_SYNC_SUBJECT)
+            self._sync_task = asyncio.get_running_loop().create_task(
+                self._sync_loop())
         return self
 
     async def stop(self):
         if isinstance(self.indexer, KvIndexer):
             await self.indexer.stop()
+        if self._sync_task:
+            self._sync_task.cancel()
+        if self._sync_sub:
+            await self._sync_sub.cancel()
+
+    # -- replica sync (ref: sequence.rs:283-340) ----------------------------
+
+    def _publish_sync(self, op: str, request_id: str, **extra) -> None:
+        """Fire-and-forget broadcast of a local routing decision so OTHER
+        router replicas account this load in their ActiveSequences."""
+        import msgpack
+
+        msg = {"origin": self.replica_id, "op": op,
+               "request_id": request_id, **extra}
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # sync caller outside an event loop (unit tests)
+        loop.create_task(self.plane.publish(
+            ROUTER_SYNC_SUBJECT, msgpack.packb(msg)))
+
+    async def _sync_loop(self):
+        import msgpack
+
+        try:
+            async for _subject, payload in self._sync_sub:
+                try:
+                    m = msgpack.unpackb(payload, raw=False)
+                    if m.get("origin") == self.replica_id:
+                        continue
+                    op, rid = m["op"], m["request_id"]
+                    if op == "add":
+                        self.scheduler.slots.add_request(
+                            rid, m["worker_id"], m.get("seq_hashes"),
+                            m["isl_tokens"], m["overlap"])
+                    elif op == "prefill_done":
+                        self.scheduler.mark_prefill_completed(rid)
+                    elif op == "free":
+                        self.scheduler.free(rid)
+                except Exception:
+                    logger.exception("bad router sync message ignored")
+        except asyncio.CancelledError:
+            pass
 
     def find_best_match(
         self,
@@ -70,13 +132,24 @@ class KvRouter:
         )
         if isinstance(self.indexer, ApproxKvIndexer):
             self.indexer.process_routing_decision_for_request(token_ids, decision.worker_id)
+        if self.config.router_replica_sync:
+            track = (seq_hashes
+                     if self.config.router_track_active_blocks else None)
+            self._publish_sync(
+                "add", request_id, worker_id=decision.worker_id,
+                isl_tokens=len(token_ids), overlap=decision.overlap_blocks,
+                seq_hashes=track)
         return decision
 
     def mark_prefill_completed(self, request_id: str):
         self.scheduler.mark_prefill_completed(request_id)
+        if self.config.router_replica_sync:
+            self._publish_sync("prefill_done", request_id)
 
     def free(self, request_id: str):
         self.scheduler.free(request_id)
+        if self.config.router_replica_sync:
+            self._publish_sync("free", request_id)
 
     def remove_worker(self, worker_id: int):
         self.indexer.remove_worker(worker_id)

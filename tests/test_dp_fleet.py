@@ -105,7 +105,12 @@ async def _spawn(args, addr, ready_marker, log_name):
             if ready_marker.encode() in line:
                 return
 
-    await asyncio.wait_for(wait_ready(), 120)
+    try:
+        await asyncio.wait_for(wait_ready(), 120)
+    except BaseException:
+        proc.kill()  # never leak a half-started process on timeout/cancel
+        await proc.wait()
+        raise
 
     async def drain():
         while True:
@@ -139,13 +144,25 @@ async def test_dp_fleet_two_ranks_router_e2e():
         # start rank 1 FIRST: it must block at the barrier until rank 0 leads
         r1_task = asyncio.create_task(_spawn(
             common + ["--dp-rank", "1"], addr, "WORKER_READY", "rank1"))
-        await asyncio.sleep(1.0)
-        assert not r1_task.done()  # still waiting at the barrier
-        r0 = await _spawn(common + ["--dp-rank", "0"], addr,
-                          "WORKER_READY", "rank0")
-        procs.append(r0)
-        r1 = await r1_task
-        procs.append(r1)
+        try:
+            await asyncio.sleep(1.0)
+            assert not r1_task.done()  # still waiting at the barrier
+            r0 = await _spawn(common + ["--dp-rank", "0"], addr,
+                              "WORKER_READY", "rank0")
+            procs.append(r0)
+            r1 = await r1_task
+            procs.append(r1)
+        except BaseException:
+            if (r1_task.done() and not r1_task.cancelled()
+                    and r1_task.exception() is None):
+                p = r1_task.result()
+                p.kill()
+                await p.wait()
+            else:
+                # _spawn kills its own proc on cancel, so cancelling the
+                # task suffices to reap a rank 1 that never became ready
+                r1_task.cancel()
+            raise
 
         from dynamo_tpu.llm.model_card import MODEL_ROOT
         from dynamo_tpu.protocols import (PreprocessedRequest,

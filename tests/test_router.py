@@ -196,3 +196,79 @@ async def test_kv_router_end_to_end_routing():
     router.free("req1")
     await router.stop()
     await plane.close()
+
+
+async def test_indexer_snapshot_write_and_restore():
+    """Durability (r1 verdict item #6): the tree is snapshotted to the
+    object store every N events and a restarted router restores it even
+    when the event stream no longer replays the early events."""
+    from dynamo_tpu.router.indexer import KvIndexer, RADIX_BUCKET
+    from dynamo_tpu.router.publisher import KvEventPublisher
+
+    plane = LocalControlPlane()
+    idx = await KvIndexer(plane, kv_block_size=4,
+                          snapshot_threshold=3).start()
+    pub = KvEventPublisher(plane, worker_id=W0, kv_block_size=4)
+
+    toks = list(range(16))
+    local = compute_block_hash_for_seq(toks, 4)
+    ext = compute_seq_hash_for_block(local)
+    for i in range(4):  # 4 chained events > threshold 3
+        await pub.publish_stored(
+            ext[i - 1] if i else None, [StoredBlock(ext[i], local[i])])
+    for _ in range(200):
+        if idx.snapshots_written:
+            break
+        await asyncio.sleep(0.01)
+    assert idx.snapshots_written >= 1
+    assert await plane.object_get(RADIX_BUCKET, idx.stream) is not None
+    # snapshot lock was released (lease revoked deletes the key)
+    assert await plane.kv_get(f"locks/radix/{idx.stream}") is None
+    await idx.stop()
+
+    # "restarted frontend": consume NOTHING from the stream (start beyond
+    # its end) — any overlap must come from the restored snapshot
+    last = await plane.stream_last_seq(idx.stream)
+    idx2 = await KvIndexer(plane, kv_block_size=4,
+                           snapshot_threshold=3).start(start_seq=last + 1)
+    # the first chain of blocks present at snapshot time must match; the
+    # snapshot covered at least threshold (3) of the 4 events
+    scores = idx2.find_matches(local)
+    assert scores.scores.get(W0, 0) >= 3
+    await idx2.stop()
+
+    # router_reset_states ignores the snapshot
+    idx3 = await KvIndexer(plane, kv_block_size=4, snapshot_threshold=3,
+                           reset_states=True).start(start_seq=last + 1)
+    assert idx3.find_matches(local).scores == {}
+    await idx3.stop()
+    await plane.close()
+
+
+async def test_router_replica_sync_load_propagates():
+    """Two router replicas with router_replica_sync: a decision on A shows
+    up in B's active-sequence load (and clears on free)."""
+    cfg = KvRouterConfig(use_kv_events=False, router_replica_sync=True)
+    plane = LocalControlPlane()
+    a = await KvRouter(plane, block_size=4, config=cfg).start()
+    b = await KvRouter(plane, block_size=4, config=cfg).start()
+
+    toks = list(range(32))
+    d = a.find_best_match("sync-req", toks, [W0, W1])
+    for _ in range(200):
+        if b.scheduler.slots.active_load().get(d.worker_id, (0, 0))[0]:
+            break
+        await asyncio.sleep(0.01)
+    blocks, tokens = b.scheduler.slots.active_load()[d.worker_id]
+    assert blocks == 8 and tokens == 32
+
+    a.mark_prefill_completed("sync-req")
+    a.free("sync-req")
+    for _ in range(200):
+        if b.scheduler.slots.active_load().get(d.worker_id, (1, 1)) == (0, 0):
+            break
+        await asyncio.sleep(0.01)
+    assert b.scheduler.slots.active_load()[d.worker_id] == (0, 0)
+    await a.stop()
+    await b.stop()
+    await plane.close()
