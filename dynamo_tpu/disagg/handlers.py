@@ -18,6 +18,7 @@ from dynamo_tpu.disagg.protocols import (
     DisaggConfig, KvChunkFrame, PrefillResponse,
 )
 from dynamo_tpu.protocols import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.control_plane import NoRespondersError
 
 logger = logging.getLogger("dynamo.disagg")
 
@@ -57,10 +58,12 @@ class DecodeWorkerHandler:
     """
 
     def __init__(self, engine, prefill_client=None,
-                 config: Optional[DisaggConfig] = None):
+                 config: Optional[DisaggConfig] = None, prefill_queue=None):
         self.engine = engine
         self.prefill_client = prefill_client
         self.config = config or DisaggConfig()
+        #: optional PrefillQueueClient: queued dispatch with claim/fallback
+        self.prefill_queue = prefill_queue
 
     def _use_remote_prefill(self, req: PreprocessedRequest) -> bool:
         if self.prefill_client is None:
@@ -92,8 +95,26 @@ class DecodeWorkerHandler:
                      len(req.token_ids))
         preq = dataclasses.replace(
             req, annotations=list(req.annotations or []) + [KV_CHUNKS_ANNOTATION])
-        stream = await self.prefill_client.generate(
-            preq.to_wire(), mode="round_robin")
+        instance_id = None
+        if self.prefill_queue is not None:
+            instance_id = await self.prefill_queue.acquire()
+            if (instance_id is not None
+                    and instance_id not in self.prefill_client.available_ids()):
+                # claim raced ahead of discovery, or the claimant just died
+                logger.warning("claimed prefill instance %x not routable; "
+                               "falling back to round robin", instance_id)
+                instance_id = None
+        stream = None
+        if instance_id is not None:
+            try:
+                stream = await self.prefill_client.generate(
+                    preq.to_wire(), mode="direct", instance_id=instance_id)
+            except NoRespondersError:
+                logger.warning("claimed prefill instance %x unreachable; "
+                               "falling back to round robin", instance_id)
+        if stream is None:  # no queue, claim timeout, or dead claimant
+            stream = await self.prefill_client.generate(
+                preq.to_wire(), mode="round_robin")
         eng = self.engine
         bs = eng.args.block_size
         total = (len(req.token_ids) + bs - 1) // bs

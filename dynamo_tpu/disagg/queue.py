@@ -1,0 +1,143 @@
+"""Global prefill queue: backlog-controlled dispatch to the prefill fleet.
+
+The reference queues disagg prefill work on a JetStream work queue that
+prefill workers pull from (ref: NatsQueue transports/nats.rs:426,
+docs/architecture/disagg_serving.md:62-101) — pull beats push-round-robin
+because a busy prefill worker simply doesn't pop, and the queue depth is a
+direct autoscaling signal for the planner.
+
+Here the queue carries small JOB TICKETS only; the KV pages still flow over
+the direct response plane (the fast path). Flow:
+
+  decode worker:  subscribe claim.<job> → queue_push(ticket) → wait claim
+                  → client.generate(mode="direct", instance_id=claimed)
+  prefill worker: [capacity gate] → queue_pop → publish claim.<job>
+
+A claim timeout on the decode side falls back to round-robin dispatch, so a
+fleet without queue-popping workers (or an empty fleet) degrades to the r1
+behavior instead of stalling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from typing import Optional
+
+import msgpack
+
+logger = logging.getLogger("dynamo.prefill_queue")
+
+PREFILL_QUEUE = "prefill_queue"
+CLAIM_SUBJECT = "prefill_claim"
+
+
+class PrefillQueueClient:
+    """Decode-worker side: acquire a prefill worker through the queue."""
+
+    def __init__(self, plane, queue: str = PREFILL_QUEUE,
+                 claim_timeout: float = 10.0):
+        self.plane = plane
+        self.queue = queue
+        self.claim_timeout = claim_timeout
+
+    async def acquire(self) -> Optional[int]:
+        """Enqueue a ticket; returns the claiming prefill worker's instance
+        id, or None on timeout (caller falls back to round robin)."""
+        job_id = uuid.uuid4().hex
+        sub = await self.plane.subscribe(f"{CLAIM_SUBJECT}.{job_id}")
+        try:
+            # expires_at lets workers discard tickets whose decode side has
+            # already fallen back — a stale ticket must not count as work
+            await self.plane.queue_push(
+                self.queue, msgpack.packb({
+                    "job_id": job_id,
+                    "expires_at": time.time() + self.claim_timeout}))
+
+            async def first_claim():
+                async for _subject, payload in sub:
+                    return msgpack.unpackb(payload, raw=False)
+                return None
+
+            try:
+                claim = await asyncio.wait_for(first_claim(),
+                                               self.claim_timeout)
+            except asyncio.TimeoutError:
+                logger.warning("prefill queue claim timed out; falling back "
+                               "to round robin")
+                return None
+            return claim["instance_id"] if claim else None
+        finally:
+            await sub.cancel()
+
+    async def depth(self) -> int:
+        return await self.plane.queue_depth(self.queue)
+
+
+class PrefillQueueWorker:
+    """Prefill-worker side: pop tickets when the engine has capacity.
+
+    ``capacity_gate`` is a plain (synchronous) callable returning True when
+    this worker should take more work (typically: engine not backlogged).
+    The pop loop is the backlog control: a saturated worker stops popping
+    and tickets wait in the queue, where the planner can see them.
+    """
+
+    def __init__(self, plane, instance_id: int, capacity_gate=None,
+                 queue: str = PREFILL_QUEUE, poll: float = 0.2):
+        self.plane = plane
+        self.instance_id = instance_id
+        self.capacity_gate = capacity_gate
+        self.queue = queue
+        self.poll = poll
+        self._task: Optional[asyncio.Task] = None
+        self._stop = False
+        self.claims = 0
+
+    async def start(self) -> "PrefillQueueWorker":
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self):
+        self._stop = True
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self):
+        while not self._stop:
+            try:
+                if self.capacity_gate is not None and not self.capacity_gate():
+                    await asyncio.sleep(self.poll)
+                    continue
+                item = await self.plane.queue_pop(self.queue, timeout=5.0)
+                if item is None:
+                    continue
+                ticket = msgpack.unpackb(item, raw=False)
+                exp = ticket.get("expires_at")
+                if exp is not None and exp < time.time():
+                    continue  # decode side already fell back; discard
+                await self.plane.publish(
+                    f"{CLAIM_SUBJECT}.{ticket['job_id']}",
+                    msgpack.packb({"instance_id": self.instance_id}))
+                self.claims += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("prefill queue worker loop error; retrying")
+                await asyncio.sleep(1.0)
+
+
+def engine_capacity_gate(engine, max_waiting: int = 0):
+    """Default gate: take work only while the engine's waiting queue is at
+    or below ``max_waiting`` (admission backlog = stop popping)."""
+
+    def gate() -> bool:
+        return engine.scheduler.num_waiting() <= max_waiting
+
+    return gate

@@ -60,6 +60,11 @@ async def amain():
     ap.add_argument("--role", default="aggregated",
                     choices=["aggregated", "decode", "prefill"])
     ap.add_argument("--prefill-component", default="prefill")
+    ap.add_argument("--prefill-queue", action="store_true", default=True,
+                    help="queued prefill dispatch (pull-based backlog "
+                         "control; ref: transports/nats.rs:426)")
+    ap.add_argument("--no-prefill-queue", dest="prefill_queue",
+                    action="store_false")
     ap.add_argument("--max-local-prefill-length", type=int, default=512)
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--num-blocks", type=int, default=None)
@@ -164,6 +169,7 @@ async def amain():
     ns = runtime.namespace(cli.namespace)
     ep = ns.component(component).endpoint("generate")
 
+    queue_worker = None
     if cli.role == "prefill":
         from dynamo_tpu.disagg.handlers import PrefillWorkerHandler
         handler = PrefillWorkerHandler(engine)
@@ -172,15 +178,27 @@ async def amain():
         from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
         from dynamo_tpu.disagg.protocols import DisaggConfig
         prefill_client = None
+        prefill_queue = None
         if cli.role == "decode":
             pc = ns.component(cli.prefill_component).endpoint("generate")
             prefill_client = await pc.client().start()
+            if cli.prefill_queue:
+                from dynamo_tpu.disagg.queue import PrefillQueueClient
+                prefill_queue = PrefillQueueClient(runtime.plane)
         handler = DecodeWorkerHandler(
             engine, prefill_client,
-            DisaggConfig(max_local_prefill_length=cli.max_local_prefill_length))
+            DisaggConfig(max_local_prefill_length=cli.max_local_prefill_length),
+            prefill_queue=prefill_queue)
         serve = handler.generate
 
     handle = await ep.serve_endpoint(serve, lease_id=lease)
+
+    if cli.role == "prefill" and cli.prefill_queue:
+        from dynamo_tpu.disagg.queue import (PrefillQueueWorker,
+                                             engine_capacity_gate)
+        queue_worker = await PrefillQueueWorker(
+            runtime.plane, instance_id=lease,
+            capacity_gate=engine_capacity_gate(engine)).start()
 
     # Multi-process DP fleet: every rank serves its own endpoint instance
     # (its own lease → the router sees N routable instances, each with its
@@ -221,6 +239,8 @@ async def amain():
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if queue_worker is not None:
+        await queue_worker.stop()
     await handle.stop(graceful=True)
     await engine.close()
     await runtime.shutdown()

@@ -64,7 +64,20 @@ class MetricsService:
             await self._kv_sub.cancel()
         await self.agg.stop()
 
-    def render(self) -> str:
+    async def sample_queue_depth(self) -> int:
+        """Current global prefill-queue backlog (planner scaling signal).
+        A slow/absent control plane must not break the whole /metrics
+        endpoint — local gauges still serve; depth reads 0."""
+        from dynamo_tpu.disagg.queue import PREFILL_QUEUE
+
+        try:
+            return await asyncio.wait_for(
+                self.runtime.plane.queue_depth(PREFILL_QUEUE), 2.0)
+        except Exception:
+            logger.warning("prefill queue depth unavailable; reporting 0")
+            return 0
+
+    def render(self, prefill_queue_depth: int = 0) -> str:
         a = self.agg.aggregate()
         lines = []
 
@@ -84,6 +97,8 @@ class MetricsService:
               "KV stored events observed")
         gauge("kv_blocks_removed_total", self.kv_removed,
               "KV removed events observed")
+        gauge("prefill_queue_depth", prefill_queue_depth,
+              "tickets waiting in the global prefill queue")
         return "\n".join(lines) + "\n"
 
 
@@ -96,7 +111,8 @@ async def amain():
     svc = await MetricsService(runtime).start()
 
     async def metrics(_req):
-        return web.Response(text=svc.render(),
+        depth = await svc.sample_queue_depth()
+        return web.Response(text=svc.render(depth),
                             content_type="text/plain")
 
     app = web.Application()

@@ -55,6 +55,7 @@ class KvRouter:
         self.replica_id = uuid.uuid4().hex
         self._sync_sub = None
         self._sync_task = None
+        self._publish_tasks: set = set()  # strong refs: loop holds only weak
 
     async def start(self) -> "KvRouter":
         if isinstance(self.indexer, KvIndexer):
@@ -86,8 +87,16 @@ class KvRouter:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             return  # sync caller outside an event loop (unit tests)
-        loop.create_task(self.plane.publish(
+        task = loop.create_task(self.plane.publish(
             ROUTER_SYNC_SUBJECT, msgpack.packb(msg)))
+        self._publish_tasks.add(task)
+
+        def done(t):
+            self._publish_tasks.discard(t)
+            if not t.cancelled() and t.exception() is not None:
+                logger.warning("router sync publish failed: %r", t.exception())
+
+        task.add_done_callback(done)
 
     async def _sync_loop(self):
         import msgpack

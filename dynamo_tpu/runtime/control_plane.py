@@ -31,6 +31,7 @@ import asyncio
 import logging
 import random
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Awaitable, Callable, Optional
 
@@ -178,6 +179,19 @@ class ControlPlane(abc.ABC):
         Multiple registrations on one subject form an implicit queue group:
         ``request`` round-robins across them (NATS service semantics)."""
 
+    # -- Work queues (NatsQueue semantics, ref: transports/nats.rs:426 —
+    #    the global prefill queue rides this) --
+    @abc.abstractmethod
+    async def queue_push(self, queue: str, payload: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def queue_pop(self, queue: str, timeout: float = 30.0) -> Optional[bytes]:
+        """Pop one item; blocks up to ``timeout``; None when nothing arrived.
+        Each item is delivered to exactly one popper (work-queue semantics)."""
+
+    @abc.abstractmethod
+    async def queue_depth(self, queue: str) -> int: ...
+
     # -- Durable streams (JetStream semantics) --
     @abc.abstractmethod
     async def stream_publish(self, stream: str, payload: bytes) -> int: ...
@@ -241,6 +255,8 @@ class LocalControlPlane(ControlPlane):
         self._rr: dict[str, int] = {}
         self._streams: dict[str, tuple[int, list[tuple[int, bytes]]]] = {}  # first_seq offset handling
         self._stream_subs: dict[str, list[asyncio.Queue]] = {}
+        self._queues: dict[str, "deque[bytes]"] = {}
+        self._queue_waiters: dict[str, "deque[asyncio.Future]"] = {}
         self._objects: dict[tuple[str, str], bytes] = {}
         self._closed = False
         self._sweeper: Optional[asyncio.Task] = None
@@ -397,6 +413,42 @@ class LocalControlPlane(ControlPlane):
     def has_responder(self, subject: str) -> bool:
         return any(_subject_matches(s.subject, subject) for s in self._services)
 
+    # -- Work queues --
+    QUEUE_MAX_LEN = 65536  # oldest tickets dropped past this (cap like streams)
+
+    async def queue_push(self, queue, payload) -> None:
+        waiters = self._queue_waiters.get(queue)
+        while waiters:
+            fut = waiters.popleft()
+            if not fut.done():  # hand straight to a blocked popper
+                fut.set_result(payload)
+                return
+        q = self._queues.setdefault(queue, deque())
+        q.append(payload)
+        while len(q) > self.QUEUE_MAX_LEN:
+            q.popleft()
+
+    async def queue_pop(self, queue, timeout: float = 30.0) -> Optional[bytes]:
+        q = self._queues.get(queue)
+        if q:
+            return q.popleft()
+        fut = asyncio.get_running_loop().create_future()
+        waiters = self._queue_waiters.setdefault(queue, deque())
+        waiters.append(fut)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return None
+        finally:
+            # a timed-out waiter must not linger until the next push skims it
+            try:
+                waiters.remove(fut)
+            except ValueError:
+                pass
+
+    async def queue_depth(self, queue) -> int:
+        return len(self._queues.get(queue, ()))
+
     # -- Durable streams --
     async def stream_publish(self, stream, payload) -> int:
         seq, entries = self._streams.get(stream, (0, []))
@@ -447,6 +499,10 @@ class LocalControlPlane(ControlPlane):
         for qs in self._stream_subs.values():
             for q in qs:
                 q.put_nowait(None)
+        for waiters in self._queue_waiters.values():
+            for fut in waiters:
+                if not fut.done():
+                    fut.set_result(None)
 
 
 # --------------------------------------------------------------------------
@@ -606,6 +662,12 @@ class _ServerConn:
             cancel = self._svc_cancels.pop(m["svc_id"], None)
             if cancel:
                 await cancel()
+        elif op == "queue_push":
+            await core.queue_push(m["queue"], m["payload"])
+        elif op == "queue_pop":
+            return await core.queue_pop(m["queue"], m.get("pop_timeout", 30.0))
+        elif op == "queue_depth":
+            return await core.queue_depth(m["queue"])
         elif op == "stream_publish":
             return await core.stream_publish(m["stream"], m["payload"])
         elif op == "stream_subscribe":
@@ -853,6 +915,17 @@ class RemoteControlPlane(ControlPlane):
                 await self._call("serve_cancel", svc_id=svc_id)
 
         return cancel
+
+    # -- Work queues --
+    async def queue_push(self, queue, payload):
+        await self._call("queue_push", queue=queue, payload=payload)
+
+    async def queue_pop(self, queue, timeout: float = 30.0):
+        return await self._call("queue_pop", timeout=timeout + 5.0,
+                                queue=queue, pop_timeout=timeout)
+
+    async def queue_depth(self, queue) -> int:
+        return await self._call("queue_depth", queue=queue)
 
     # -- Streams --
     async def stream_publish(self, stream, payload) -> int:

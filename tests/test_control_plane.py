@@ -150,3 +150,45 @@ async def test_remote_disconnect_revokes_lease():
         await asyncio.sleep(0.1)
     assert await server.core.kv_get("dc/a") is None
     await server.stop()
+
+
+async def test_work_queue_semantics():
+    """push/pop order, exactly-one delivery, block-until-push, timeout."""
+    plane = LocalControlPlane()
+    await plane.queue_push("q", b"a")
+    await plane.queue_push("q", b"b")
+    assert await plane.queue_depth("q") == 2
+    assert await plane.queue_pop("q") == b"a"
+    assert await plane.queue_pop("q") == b"b"
+    assert await plane.queue_depth("q") == 0
+    # timeout with nothing queued
+    assert await plane.queue_pop("q", timeout=0.05) is None
+    # blocked popper woken by push; each item delivered exactly once
+    pops = [asyncio.create_task(plane.queue_pop("q", timeout=5.0))
+            for _ in range(2)]
+    await asyncio.sleep(0.02)
+    await plane.queue_push("q", b"x")
+    await plane.queue_push("q", b"y")
+    got = sorted(await asyncio.gather(*pops))
+    assert got == [b"x", b"y"]
+    await plane.close()
+
+
+async def test_work_queue_cross_process_semantics():
+    """Same semantics through the TCP server/remote client pair."""
+    server = ControlPlaneServer(port=0)
+    addr = await server.start()
+    a = await RemoteControlPlane(addr).connect()
+    b = await RemoteControlPlane(addr).connect()
+    try:
+        pop = asyncio.create_task(a.queue_pop("jobs", timeout=5.0))
+        await asyncio.sleep(0.05)
+        await b.queue_push("jobs", b"ticket")
+        assert await pop == b"ticket"
+        await b.queue_push("jobs", b"t2")
+        assert await a.queue_depth("jobs") == 1
+        assert await b.queue_pop("jobs", timeout=1.0) == b"t2"
+    finally:
+        await a.close()
+        await b.close()
+        await server.stop()

@@ -296,3 +296,101 @@ async def test_prefill_extract_cancelled_releases_blocks():
         await asyncio.sleep(0.02)
     assert eng.pool.num_free_blocks == free0
     await eng.close()
+
+
+async def test_prefill_queue_dispatch_end_to_end():
+    """Queued dispatch (r1 verdict item #7): decode enqueues a ticket, the
+    prefill worker pops + claims, KV streams direct — tokens match
+    aggregated, and the queue drains to zero for the depth gauge."""
+    from dynamo_tpu.disagg.queue import (
+        PREFILL_QUEUE, PrefillQueueClient, PrefillQueueWorker,
+        engine_capacity_gate,
+    )
+    from dynamo_tpu.runtime.control_plane import LocalControlPlane
+
+    plane = LocalControlPlane()
+    prompt = list(range(1, 151))
+    agg = make_engine()
+    want = await collect_engine(agg, req(prompt))
+    await agg.close()
+
+    pre = make_engine()
+    dec = make_engine()
+    ph = PrefillWorkerHandler(pre)
+    PRE_ID = 7001
+
+    class DirectOnlyPrefillClient:
+        """Fails unless the queue claim routed mode=direct to PRE_ID."""
+
+        def available_ids(self):
+            return [PRE_ID]
+
+        async def generate(self, request, mode="round_robin", instance_id=None):
+            assert mode == "direct" and instance_id == PRE_ID, \
+                f"expected queued direct dispatch, got {mode}/{instance_id}"
+
+            async def stream():
+                async for frame in ph.generate(request, None):
+                    yield frame
+            return stream()
+
+    qw = await PrefillQueueWorker(
+        plane, instance_id=PRE_ID,
+        capacity_gate=engine_capacity_gate(pre)).start()
+    dh = DecodeWorkerHandler(
+        dec, DirectOnlyPrefillClient(),
+        DisaggConfig(max_local_prefill_length=8),
+        prefill_queue=PrefillQueueClient(plane))
+
+    got = []
+    async for frame in dh.generate(req(prompt).to_wire(), None):
+        got.extend(frame.get("token_ids", []))
+    assert got == want
+    assert qw.claims == 1
+    assert await plane.queue_depth(PREFILL_QUEUE) == 0  # drained
+
+    await qw.stop()
+    await pre.close()
+    await dec.close()
+    await plane.close()
+
+
+async def test_prefill_queue_claim_timeout_falls_back_round_robin():
+    """No queue worker popping → claim times out → round-robin fallback."""
+    from dynamo_tpu.disagg.queue import PrefillQueueClient
+    from dynamo_tpu.runtime.control_plane import LocalControlPlane
+
+    plane = LocalControlPlane()
+    prompt = list(range(1, 151))
+    agg = make_engine()
+    want = await collect_engine(agg, req(prompt))
+    await agg.close()
+
+    pre = make_engine()
+    dec = make_engine()
+    ph = PrefillWorkerHandler(pre)
+    modes = []
+
+    class RecordingClient:
+        def available_ids(self):
+            return [1]
+
+        async def generate(self, request, mode="round_robin", instance_id=None):
+            modes.append(mode)
+
+            async def stream():
+                async for frame in ph.generate(request, None):
+                    yield frame
+            return stream()
+
+    dh = DecodeWorkerHandler(
+        dec, RecordingClient(), DisaggConfig(max_local_prefill_length=8),
+        prefill_queue=PrefillQueueClient(plane, claim_timeout=0.1))
+    got = []
+    async for frame in dh.generate(req(prompt).to_wire(), None):
+        got.extend(frame.get("token_ids", []))
+    assert got == want
+    assert modes == ["round_robin"]
+    await pre.close()
+    await dec.close()
+    await plane.close()
