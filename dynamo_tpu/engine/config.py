@@ -37,6 +37,10 @@ class ModelConfig:
     # MoE (0 experts = dense MLP)
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    #: EP dispatch capacity: each expert takes up to ceil(N*K/E * this)
+    #: tokens per step (Switch-style dropping past that; >= E/K disables
+    #: dropping entirely)
+    moe_capacity_factor: float = 2.0
     # attention extras
     qkv_bias: bool = False  # Qwen2-style
     sliding_window: Optional[int] = None

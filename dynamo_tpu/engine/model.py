@@ -222,14 +222,83 @@ def _mlp_dense(x, lp):
     return h @ lp["w_down"]
 
 
+def moe_capacity(n_tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Per-expert token capacity for one EP dispatch (Switch-style)."""
+    return min(n_tokens, max(1, int(np.ceil(
+        n_tokens * top_k * capacity_factor / num_experts))))
+
+
+def _mlp_moe_ep(x, router_w, wg, wu, wd, *, cfg: ModelConfig,
+                axis_name: str = "tp"):
+    """Expert-parallel MoE (shard_map body over the expert axis).
+
+    Each device holds E/n experts WHOLE (wg/wu/wd are the local slices) and
+    sees the full token set (x is replicated over the axis). Dispatch is a
+    capacity-bounded one-hot gather — each local expert processes at most
+    C = ceil(N·K/E · capacity_factor) tokens — so per-device FLOPs are
+    ~N·K·3DF/n regardless of E (the r1 dense-einsum path paid E× that).
+    The combine is a gate-weighted scatter followed by a psum over the axis
+    (the all-to-all of a classic GShard dispatch collapses into this psum
+    because x rides replicated on an axis the attention weights already
+    shard). Tokens beyond an expert's capacity are dropped, Switch-style;
+    capacity_factor ≥ E/K makes dropping impossible (tests use that).
+
+    ref workload: recipes/deepseek-r1/sglang-wideep/tep16p-dep16d-disagg.yaml
+    (--ep-size 16 wide-EP serving).
+    """
+    B, S, D = x.shape
+    N = B * S
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    idx = jax.lax.axis_index(axis_name)
+    E_local = wg.shape[0]
+
+    xf = x.reshape(N, D)
+    logits = (xf @ router_w).astype(jnp.float32)  # [N, E]
+    topv, topi = jax.lax.top_k(logits, K)
+    gates = jax.nn.softmax(topv, axis=-1)
+    cw = jnp.zeros((N, E), jnp.float32).at[
+        jnp.arange(N)[:, None], topi].add(gates)
+    local = jax.lax.dynamic_slice_in_dim(cw, idx * E_local, E_local, axis=1)
+
+    C = moe_capacity(N, E, K, cfg.moe_capacity_factor)
+    mask = local > 0  # [N, E_local]
+    pos = jnp.cumsum(mask, axis=0) * mask  # 1-based slot per (token, expert)
+    keep = mask & (pos <= C)
+    slot = (pos - 1)[..., None] == jnp.arange(C)[None, None, :]  # [N,El,C]
+    disp = (keep[..., None] & slot).astype(x.dtype)
+
+    xe = jnp.einsum("nec,nd->ecd", disp, xf)  # [E_local, C, D]
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, wg))
+    h = h * jnp.einsum("ecd,edf->ecf", xe, wu)
+    y = jnp.einsum("ecf,efd->ecd", h, wd)  # [E_local, C, D]
+    comb = disp * local[..., None].astype(x.dtype)  # gate-weighted one-hot
+    out = jnp.einsum("nec,ecd->nd", comb, y)
+    out = jax.lax.psum(out, axis_name)
+    return out.reshape(B, S, D).astype(x.dtype)
+
+
+def make_moe_ep_fn(cfg: ModelConfig, mesh: Mesh, axis_name: str = "tp"):
+    """The production shard_map wiring for the EP MoE dispatch —
+    (x, router_w, wg, wu, wd) -> [B,S,D]; used by forward and by tests so
+    specs cannot drift between them."""
+    fn = functools.partial(_mlp_moe_ep, cfg=cfg, axis_name=axis_name)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P("dp", None, None), P(None, None),
+                  P(axis_name, None, None), P(axis_name, None, None),
+                  P(axis_name, None, None)),
+        out_specs=P("dp", None, None), check_vma=False)
+
+
 def _mlp_moe(x, lp, cfg: ModelConfig):
     """Token-choice MoE (Mixtral/DeepSeek-style), dense-einsum formulation.
 
     Computes all experts' outputs weighted by the (sparse) router probs via a
-    one-hot combine — XLA-friendly (no ragged dispatch); the EP fast path
-    (all-to-all over "tp") is a later optimization, this is correct and
-    shardable (experts sharded over "tp" = expert parallelism; XLA reduces
-    over the expert axis).
+    one-hot combine — XLA-friendly (no ragged dispatch). This is the
+    single-device / fallback path; under a tp>1 mesh the engine dispatches
+    the expert-parallel ``_mlp_moe_ep`` instead (per-token FLOPs independent
+    of E).
     """
     B, S, D = x.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok
@@ -411,7 +480,19 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
 
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
-            x = x + _mlp_moe(h, lp, cfg)
+            ep_want = mesh is not None and tp_n > 1
+            ep_ok = (ep_want and dp_ok and cfg.num_experts % tp_n == 0)
+            if ep_want and not ep_ok:
+                _logger.warning(
+                    "EP MoE bypassed: B=%d/dp or experts=%d/tp=%d not "
+                    "divisible — dense-einsum path for this bucket",
+                    B, cfg.num_experts, tp_n)
+            if ep_ok:
+                fn = make_moe_ep_fn(cfg, mesh)
+                x = x + fn(h, lp["router"], lp["w_gate"], lp["w_up"],
+                           lp["w_down"])
+            else:
+                x = x + _mlp_moe(h, lp, cfg)
         else:
             x = x + _mlp_dense(h, lp)
         return (x, kc, vc), None
