@@ -19,6 +19,7 @@ Backend/Migration/Router operators unchanged.
 from __future__ import annotations
 
 import asyncio
+import functools
 import itertools
 import logging
 import time
@@ -125,6 +126,56 @@ class AsyncJaxEngine:
             yield out
             if out.finish_reason is not None:
                 return
+
+    # ---------------------------------------------------------- embeddings
+
+    async def embed(self, token_id_lists: list[list[int]]) -> list[list[float]]:
+        """Mean-pooled L2-normalized embeddings for a batch of token lists
+        (ref surface: /v1/embeddings, openai.rs:714). Shapes bucket to
+        powers of two so steady traffic reuses a handful of programs."""
+        import jax
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine import model as M
+
+        if not token_id_lists:
+            return []
+        # dense S×S attention: bound inputs by the serving context the same
+        # way generate does (an unbounded S would OOM the worker)
+        limit = self.args.max_model_len
+        too_long = max(len(t) for t in token_id_lists)
+        if too_long > limit:
+            raise ValueError(
+                f"embedding input of {too_long} tokens exceeds "
+                f"max_model_len {limit}")
+        if getattr(self, "_embed_fn", None) is None:
+            # one jitted callable; jax.jit caches per (B,S) bucket itself
+            self._embed_fn = jax.jit(
+                functools.partial(M.embedding_forward, cfg=self.cfg))
+        B = 1 << (len(token_id_lists) - 1).bit_length()
+        S = max(8, 1 << (too_long - 1).bit_length())
+        tokens = np.zeros((B, S), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        for i, ids in enumerate(token_id_lists):
+            tokens[i, :len(ids)] = ids
+            lengths[i] = len(ids)
+
+        def run():  # compile/dispatch + host copy off the event loop
+            out = self._embed_fn(self.params, jnp.asarray(tokens),
+                                 jnp.asarray(lengths))
+            return np.asarray(out)
+
+        host = await asyncio.to_thread(run)
+        return [host[i].tolist() for i in range(len(token_id_lists))]
+
+    async def embed_handler(self, request: dict, ctx=None):
+        """Endpoint handler: {"token_ids": [[...]]} → one embeddings frame."""
+        try:
+            vecs = await self.embed(request.get("token_ids") or [])
+        except ValueError as e:  # input too long: client error, not a crash
+            yield {"error": str(e)}
+            return
+        yield {"embeddings": vecs}
 
     # ------------------------------------------------------- disagg support
 

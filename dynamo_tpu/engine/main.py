@@ -192,6 +192,11 @@ async def amain():
         serve = handler.generate
 
     handle = await ep.serve_endpoint(serve, lease_id=lease)
+    embed_handle = None
+    if cli.role != "prefill":  # embeddings ride the decode/agg fleet
+        embed_ep = ns.component(component).endpoint("embed")
+        embed_handle = await embed_ep.serve_endpoint(
+            engine.embed_handler, lease_id=lease)
 
     if cli.role == "prefill" and cli.prefill_queue:
         from dynamo_tpu.disagg.queue import (PrefillQueueWorker,
@@ -241,6 +246,8 @@ async def amain():
     await stop.wait()
     if queue_worker is not None:
         await queue_worker.stop()
+    if embed_handle is not None:
+        await embed_handle.stop(graceful=False)
     await handle.stop(graceful=True)
     await engine.close()
     await runtime.shutdown()

@@ -224,9 +224,14 @@ def _mlp_dense(x, lp):
 
 def moe_capacity(n_tokens: int, num_experts: int, top_k: int,
                  capacity_factor: float) -> int:
-    """Per-expert token capacity for one EP dispatch (Switch-style)."""
-    return min(n_tokens, max(1, int(np.ceil(
-        n_tokens * top_k * capacity_factor / num_experts))))
+    """Per-expert token capacity for one EP dispatch (Switch-style).
+
+    Floored at min(n_tokens, 16): at decode-sized batches the average-load
+    formula would give C=1-2 and routinely drop assignments whenever two
+    tokens pick the same expert — the FLOPs saved are negligible there, so
+    small batches run dropless instead of silently degrading."""
+    avg = int(np.ceil(n_tokens * top_k * capacity_factor / num_experts))
+    return min(n_tokens, max(avg, min(n_tokens, 16), 1))
 
 
 def _mlp_moe_ep(x, router_w, wg, wu, wd, *, cfg: ModelConfig,
@@ -508,6 +513,58 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
     else:
         logits = x_last @ params["lm_head"]
     return logits.astype(jnp.float32), k_cache, v_cache
+
+
+def embedding_forward(params, tokens, lengths, *, cfg: ModelConfig):
+    """Mean-pooled sequence embeddings (ref surface: /v1/embeddings,
+    lib/llm/src/http/service/openai.rs:714).
+
+    Dense causal self-attention over the padded batch — no paged cache (an
+    embedding pass has no decode phase to reuse KV for), so this path has
+    zero interaction with the serving cache/pool. Returns [B, D] f32,
+    L2-normalized mean over each row's valid positions.
+    """
+    B, S = tokens.shape
+    D, hd = cfg.hidden_size, cfg.head_dim
+    H, KV = cfg.num_heads, cfg.num_kv_heads
+    G = H // KV
+    positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+    valid = jnp.arange(S)[None, :] < lengths[:, None]  # [B, S]
+    causal = (jnp.arange(S)[None, :] <= jnp.arange(S)[:, None])[None]  # [1,S,S]
+    mask = causal & valid[:, None, :]
+    if cfg.sliding_window:  # same window semantics as the serving paths
+        mask = mask & (jnp.arange(S)[None, :]
+                       > jnp.arange(S)[:, None] - cfg.sliding_window)[None]
+
+    x = params["embed"][tokens]
+
+    def layer(x, lp):
+        h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if "bq" in lp:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = _rope(q.reshape(B, S, H, hd), positions, cfg.rope_theta)
+        k = _rope(k.reshape(B, S, KV, hd), positions, cfg.rope_theta)
+        v = v.reshape(B, S, KV, hd)
+        qg = q.reshape(B, S, KV, G, hd).astype(jnp.float32)
+        s = jnp.einsum("bskgd,btkd->bkgst", qg, k.astype(jnp.float32))
+        s = s / np.sqrt(hd)
+        s = jnp.where(mask[:, None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bkgst,btkd->bskgd", p, v.astype(jnp.float32))
+        x = x + attn.reshape(B, S, H * hd).astype(x.dtype) @ lp["wo"]
+        h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + (_mlp_moe(h, lp, cfg) if cfg.is_moe else _mlp_dense(h, lp))
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps).astype(jnp.float32)
+    pooled = (x * valid[..., None]).sum(1) / jnp.maximum(
+        lengths[:, None].astype(jnp.float32), 1.0)
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
 
 
 def multi_decode(params, last_tokens, positions, block_tables, kv_lens,

@@ -70,6 +70,7 @@ class HttpService:
         app = web.Application(client_max_size=32 * 1024 * 1024)
         app.router.add_post("/v1/chat/completions", self.handle_chat)
         app.router.add_post("/v1/completions", self.handle_completions)
+        app.router.add_post("/v1/embeddings", self.handle_embeddings)
         app.router.add_get("/v1/models", self.handle_models)
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/live", self.handle_live)
@@ -107,6 +108,77 @@ class HttpService:
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render(), content_type="text/plain")
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI embeddings (ref: openai.rs:714): tokenize each input via
+        the model's tokenizer, mean-pool on a worker, return vectors."""
+        t0 = time.perf_counter()
+        try:
+            body = await request.json()
+        except Exception:
+            self._requests.inc(route="embeddings", model="unknown", status="400")
+            return web.json_response(error_body("invalid JSON body"), status=400)
+        model = body.get("model")
+        served = self.manager.get(model) if isinstance(model, str) else None
+        if served is None:
+            self._requests.inc(route="embeddings", model=str(model), status="404")
+            return web.json_response(
+                error_body(f"model '{model}' not found", "model_not_found", 404),
+                status=404)
+        raw = body.get("input")
+        if isinstance(raw, str):
+            inputs = [raw]
+        elif isinstance(raw, list) and raw and all(isinstance(t, int) for t in raw):
+            inputs = [raw]  # one pre-tokenized input
+        elif isinstance(raw, list):
+            inputs = raw
+        else:
+            self._requests.inc(route="embeddings", model=model, status="400")
+            return web.json_response(
+                error_body("'input' must be a string, array of strings, or "
+                           "array of token arrays"), status=400)
+        tk = served.pipeline.tokenizer
+        token_lists, n_tokens = [], 0
+        for item in inputs:
+            if isinstance(item, str):
+                ids = tk.encode(item)
+            elif isinstance(item, list) and all(isinstance(t, int) for t in item):
+                ids = list(item)
+            else:
+                self._requests.inc(route="embeddings", model=model, status="400")
+                return web.json_response(
+                    error_body("each input must be a string or token array"),
+                    status=400)
+            if not ids:
+                ids = [0]
+            token_lists.append(ids)
+            n_tokens += len(ids)
+        # bound inputs at the HTTP edge too (dense S×S attention worker-side)
+        limit = served.card.context_length
+        if any(len(t) > limit for t in token_lists):
+            self._requests.inc(route="embeddings", model=model, status="400")
+            return web.json_response(
+                error_body(f"embedding input exceeds context length {limit}"),
+                status=400)
+        try:
+            vecs = await served.embed(token_lists)
+        except ValueError as e:
+            self._requests.inc(route="embeddings", model=model, status="400")
+            return web.json_response(error_body(str(e)), status=400)
+        except NoRespondersError:
+            self._requests.inc(route="embeddings", model=model, status="503")
+            return web.json_response(
+                error_body("no workers available", "service_unavailable", 503),
+                status=503)
+        self._requests.inc(route="embeddings", model=model, status="200")
+        self._latency.observe(time.perf_counter() - t0, route="embeddings")
+        return web.json_response({
+            "object": "list",
+            "model": model,
+            "data": [{"object": "embedding", "index": i, "embedding": v}
+                     for i, v in enumerate(vecs)],
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        })
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_llm(request, chat=True)

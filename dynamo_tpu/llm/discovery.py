@@ -44,9 +44,33 @@ class ServedModel:
     pipeline: OpenAIPreprocessor
     router: Optional[KvRouter] = None
     entries: dict[str, ModelEntry] = field(default_factory=dict)  # key -> entry
+    #: lazy client to the worker's "embed" endpoint (ref: openai.rs:714)
+    embed_client: Optional[Client] = None
+    _endpoint: Optional[object] = None
+    _embed_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+    async def get_embed_client(self) -> Client:
+        async with self._embed_lock:  # concurrent firsts must not double-create
+            if self.embed_client is None:
+                ep = self._endpoint.component.endpoint("embed")
+                self.embed_client = await ep.client().start()
+            return self.embed_client
+
+    async def embed(self, token_id_lists: list[list[int]]) -> list[list[float]]:
+        """Round-robin one embed request to a worker; returns vectors."""
+        client = await self.get_embed_client()
+        stream = await client.generate({"token_ids": token_id_lists},
+                                       mode="round_robin")
+        async for frame in stream:
+            if "error" in frame:
+                raise ValueError(frame["error"])
+            return frame.get("embeddings") or []
+        raise RuntimeError("empty embeddings response")
 
     async def stop(self):
         await self.client.stop()
+        if self.embed_client:
+            await self.embed_client.stop()
         if self.router:
             await self.router.stop()
 
@@ -148,7 +172,8 @@ class ModelWatcher:
 
             pipeline = build_pipeline(card, tokenizer, engine)
             sm = ServedModel(
-                name=entry.name, card=card, client=client, pipeline=pipeline, router=router
+                name=entry.name, card=card, client=client, pipeline=pipeline,
+                router=router, _endpoint=endpoint,
             )
             self.manager.models[entry.name] = sm
             logger.info("model %s now served (router=%s)", entry.name, self.router_mode)

@@ -30,8 +30,8 @@ def parse_inout(argv):
             out = a[4:]
         else:
             rest.append(a)
-    if inp not in ("http", "text"):
-        raise SystemExit(f"unknown in={inp} (http|text)")
+    if inp not in ("http", "text", "batch"):
+        raise SystemExit(f"unknown in={inp} (http|text|batch)")
     if out not in ("engine", "mocker", "echo"):
         raise SystemExit(f"unknown out={out} (engine|mocker|echo)")
     return inp, out, rest
@@ -100,8 +100,12 @@ async def start_worker(runtime, out: str, cli):
                        use_pallas_attention=cli.use_pallas_attention)
     engine = AsyncJaxEngine(cfg, eargs, params=params)
     handler = DecodeWorkerHandler(engine)
-    ep = runtime.namespace("dynamo").component("backend").endpoint("generate")
+    backend = runtime.namespace("dynamo").component("backend")
+    ep = backend.endpoint("generate")
     handle = await ep.serve_endpoint(handler.generate)
+    embed_handle = await backend.endpoint("embed").serve_endpoint(
+        engine.embed_handler)
+    handle.also_stop = embed_handle  # _stop_worker stops both
     card = ModelDeploymentCard(
         display_name=cli.model, kv_cache_block_size=eargs.block_size,
         eos_token_ids=eos, tokenizer_ref=cli.model_path or "test")
@@ -139,11 +143,86 @@ async def run_text_repl(manager):
         print(flush=True)
 
 
+async def _stop_worker(handle):
+    extra = getattr(handle, "also_stop", None)
+    if extra is not None:
+        await extra.stop(graceful=False)
+    await handle.stop()
+
+
 def _read_prompt():
     try:
         return input("> ").strip()
     except EOFError:
         return ""
+
+
+async def run_batch(manager, cli):
+    """``in=batch``: process a JSONL file of requests with bounded
+    concurrency, writing one JSON response per line (ref:
+    lib/llm/src/entrypoint/input.rs:32 batch mode).
+
+    Each input line is either {"prompt": "..."} or {"messages": [...]},
+    plus optional sampling fields (max_tokens, temperature, ...).
+    """
+    import json
+
+    from dynamo_tpu.llm.pipeline import (aggregate_chat_stream,
+                                         aggregate_completion_stream)
+    from dynamo_tpu.protocols.openai import (parse_chat_request,
+                                             parse_completion_request)
+    from dynamo_tpu.runtime.context import Context
+
+    if not cli.input_file:
+        raise SystemExit("in=batch requires --input-file <requests.jsonl>")
+    models = manager.list_models()
+    if not models:
+        raise SystemExit("no model registered (worker failed to start?)")
+    model = models[0]
+    lines: list = []
+    with open(cli.input_file) as f:
+        for ln, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                lines.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                # one bad line becomes one error entry, not a dead batch
+                lines.append({"_parse_error": f"line {ln}: {e}"})
+
+    sem = asyncio.Semaphore(cli.batch_concurrency)
+    results: list = [None] * len(lines)
+
+    async def one(i: int, body: dict):
+        async with sem:
+            if "_parse_error" in body:
+                results[i] = {"error": {"message": body["_parse_error"]}}
+                return
+            body.setdefault("model", model)
+            body["stream"] = True
+            try:
+                if "messages" in body:
+                    req = parse_chat_request(body)
+                    agg = aggregate_chat_stream
+                else:
+                    req = parse_completion_request(body)
+                    agg = aggregate_completion_stream
+                served = manager.get(req.model)
+                results[i] = await agg(served.pipeline.generate(req, Context()))
+            except Exception as e:
+                results[i] = {"error": {"message": str(e)}}
+
+    await asyncio.gather(*[one(i, body) for i, body in enumerate(lines)])
+
+    out = open(cli.output_file, "w") if cli.output_file else sys.stdout
+    try:
+        for r in results:
+            out.write(json.dumps(r) + "\n")
+    finally:
+        if cli.output_file:
+            out.close()
+    ok = sum(1 for r in results if r and "error" not in r)
+    print(f"BATCH_DONE {ok}/{len(results)} ok", file=sys.stderr, flush=True)
 
 
 async def amain():
@@ -159,6 +238,11 @@ async def amain():
     ap.add_argument("--use-pallas-attention", action="store_true")
     ap.add_argument("--vocab-size", type=int, default=0,
                     help="mocker vocab size (out=mocker only)")
+    ap.add_argument("--input-file", default=None,
+                    help="in=batch: JSONL file of requests")
+    ap.add_argument("--output-file", default=None,
+                    help="in=batch: JSONL output (default stdout)")
+    ap.add_argument("--batch-concurrency", type=int, default=8)
     cli = ap.parse_args(rest)
 
     runtime = await DistributedRuntime.create()
@@ -176,12 +260,15 @@ async def amain():
             break
         await asyncio.sleep(0.05)
 
-    if inp == "text":
+    if inp in ("text", "batch"):
         try:
-            await run_text_repl(manager)
+            if inp == "text":
+                await run_text_repl(manager)
+            else:
+                await run_batch(manager, cli)
         finally:
             await watcher.stop()
-            await handle.stop()
+            await _stop_worker(handle)
             await runtime.shutdown()
         return
 
@@ -196,7 +283,7 @@ async def amain():
     await stop.wait()
     await service.stop()
     await watcher.stop()
-    await handle.stop()
+    await _stop_worker(handle)
     await runtime.shutdown()
 
 

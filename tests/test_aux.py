@@ -135,3 +135,45 @@ async def test_kv_recorder_captures_stream(tmp_path):
     await rec.stop()
     evs = load_events(path)
     assert evs and evs[0]["data"]["worker_id"] == 7
+
+
+@pytest.mark.anyio
+async def test_run_batch_entrypoint(tmp_path):
+    """``run.py in=batch``: JSONL in → JSONL out through the full pipeline
+    (ref: entrypoint/input.rs:32 batch mode)."""
+    import asyncio
+    import json
+    import os
+    import sys
+
+    inp = tmp_path / "reqs.jsonl"
+    outp = tmp_path / "resp.jsonl"
+    reqs = [
+        {"messages": [{"role": "user", "content": "hello world"}],
+         "max_tokens": 4},
+        {"prompt": "the quick brown fox", "max_tokens": 3},
+        {"messages": [{"role": "user", "content": "tell me about tokens"}],
+         "max_tokens": 2},
+    ]
+    inp.write_text("".join(json.dumps(r) + "\n" for r in reqs))
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu",
+               DYN_LOG="warning")
+    env.pop("DYN_CONTROL_PLANE", None)  # in-process plane
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "dynamo_tpu.run", "in=batch", "out=mocker",
+        "--model", "mock", "--input-file", str(inp),
+        "--output-file", str(outp),
+        env=env, stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.STDOUT)
+    out, _ = await asyncio.wait_for(proc.communicate(), 120)
+    assert proc.returncode == 0, out.decode()
+    assert b"BATCH_DONE 3/3 ok" in out, out.decode()
+
+    lines = [json.loads(line) for line in outp.read_text().splitlines()]
+    assert len(lines) == 3
+    assert lines[0]["object"] == "chat.completion"
+    assert lines[0]["choices"][0]["finish_reason"] == "length"
+    assert lines[1]["object"] == "text_completion"
+    assert lines[1]["choices"][0]["finish_reason"] == "length"

@@ -296,3 +296,68 @@ async def test_multi_step_decode_with_pallas_kernel():
         outs.append(toks)
         await eng.close()
     assert outs[0] == outs[1] and len(outs[0]) == 9
+
+
+async def test_engine_embed_normalized_and_padding_invariant():
+    """embed(): L2-normalized vectors; padding must not change a row's
+    embedding (mask correctness)."""
+    eng = tiny_engine()
+    a = list(range(1, 9))
+    b = list(range(20, 45))
+    v_joint = await eng.embed([a, b])  # padded batch (different lengths)
+    v_solo = await eng.embed([a])
+    assert abs(float(np.linalg.norm(v_joint[0])) - 1.0) < 1e-5
+    np.testing.assert_allclose(np.asarray(v_joint[0]), np.asarray(v_solo[0]),
+                               atol=1e-5, rtol=1e-5)
+    # distinct inputs produce distinct embeddings
+    assert abs(float(np.dot(v_joint[0], v_joint[1]))) < 0.999
+    await eng.close()
+
+
+async def test_embeddings_http_e2e():
+    """/v1/embeddings through the full frontend + worker embed endpoint."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    rt = await DistributedRuntime.create()
+    eng = tiny_engine()
+    backend = rt.namespace("dynamo").component("backend")
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+    handle = await backend.endpoint("generate").serve_endpoint(
+        DecodeWorkerHandler(eng).generate)
+    eh = await backend.endpoint("embed").serve_endpoint(eng.embed_handler)
+    card = ModelDeploymentCard(display_name="emb", kv_cache_block_size=4,
+                               eos_token_ids=[2], tokenizer_ref="test")
+    await register_llm(rt, backend.endpoint("generate"), card)
+
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    service = HttpService(manager, port=0)
+    await service.start()
+    try:
+        for _ in range(100):
+            if manager.list_models():
+                break
+            await asyncio.sleep(0.05)
+        async with aiohttp.ClientSession() as http:
+            resp = await http.post(
+                f"http://127.0.0.1:{service.port}/v1/embeddings",
+                json={"model": "emb",
+                      "input": ["hello world", "the quick brown fox"]})
+            assert resp.status == 200, await resp.text()
+            body = await resp.json()
+        assert body["object"] == "list" and len(body["data"]) == 2
+        assert body["data"][0]["index"] == 0
+        assert len(body["data"][0]["embedding"]) == eng.cfg.hidden_size
+        assert body["usage"]["prompt_tokens"] > 0
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await eh.stop(graceful=False)
+        await handle.stop(graceful=False)
+        await eng.close()
+        await rt.shutdown()
