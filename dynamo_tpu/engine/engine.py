@@ -141,13 +141,20 @@ class AsyncJaxEngine:
         if not token_id_lists:
             return []
         # dense S×S attention: bound inputs by the serving context the same
-        # way generate does (an unbounded S would OOM the worker)
+        # way generate does (an unbounded S — or an unbounded batch of
+        # near-limit inputs — would OOM the worker)
         limit = self.args.max_model_len
         too_long = max(len(t) for t in token_id_lists)
         if too_long > limit:
             raise ValueError(
                 f"embedding input of {too_long} tokens exceeds "
                 f"max_model_len {limit}")
+        total = len(token_id_lists) * too_long  # padded batch footprint
+        budget = max(4096, 8 * limit)
+        if total > budget:
+            raise ValueError(
+                f"embedding batch of {len(token_id_lists)}×{too_long} tokens "
+                f"exceeds the per-request budget {budget}; split the batch")
         if getattr(self, "_embed_fn", None) is None:
             # one jitted callable; jax.jit caches per (B,S) bucket itself
             self._embed_fn = jax.jit(

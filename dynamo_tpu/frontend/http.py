@@ -153,12 +153,18 @@ class HttpService:
                 ids = [0]
             token_lists.append(ids)
             n_tokens += len(ids)
-        # bound inputs at the HTTP edge too (dense S×S attention worker-side)
+        # bound inputs at the HTTP edge too (dense S×S attention worker-side);
+        # the worker enforces its own batch budget as the authority
         limit = served.card.context_length
         if any(len(t) > limit for t in token_lists):
             self._requests.inc(route="embeddings", model=model, status="400")
             return web.json_response(
                 error_body(f"embedding input exceeds context length {limit}"),
+                status=400)
+        if len(token_lists) > 256:
+            self._requests.inc(route="embeddings", model=model, status="400")
+            return web.json_response(
+                error_body("at most 256 inputs per embeddings request"),
                 status=400)
         try:
             vecs = await served.embed(token_lists)
@@ -213,6 +219,10 @@ class HttpService:
         if rid:
             ctx.id = rid
         ctx.traceparent = request.headers.get("traceparent")
+        ctx.ensure_traceparent()  # synthesize when the client sent none
+        from dynamo_tpu.runtime.context import CURRENT_REQUEST
+
+        CURRENT_REQUEST.set(ctx)  # frontend-side log lines carry the id
 
         self._inflight_count += 1
         self._inflight.set(self._inflight_count)

@@ -188,6 +188,8 @@ async def register_llm(
             card=card,
         )
         key = entry.key() + f"/{mt}"
-        await runtime.plane.kv_put(key, msgpack.packb(entry.to_wire()), lease_id=lease)
+        value = msgpack.packb(entry.to_wire())
+        await runtime.plane.kv_put(key, value, lease_id=lease)
+        runtime.record_registration(key, value)  # survives hub restarts
         entries.append(entry)
     return entries

@@ -48,7 +48,7 @@ async def start_worker(runtime, out: str, cli):
                 raise SystemExit("--vocab-size must be >= 16")
             margs.vocab_size = cli.vocab_size
         engine, handle = await run_mocker(runtime, cli.model, margs)
-        return handle
+        return [handle]
 
     if out == "echo":
         from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
@@ -67,7 +67,7 @@ async def start_worker(runtime, out: str, cli):
             display_name=cli.model, kv_cache_block_size=16,
             eos_token_ids=[], tokenizer_ref=cli.model_path or "test")
         await register_llm(runtime, ep, card)
-        return handle
+        return [handle]
 
     # native JAX engine (aggregated role)
     from dynamo_tpu.engine.config import EngineArgs, ModelConfig
@@ -105,13 +105,12 @@ async def start_worker(runtime, out: str, cli):
     handle = await ep.serve_endpoint(handler.generate)
     embed_handle = await backend.endpoint("embed").serve_endpoint(
         engine.embed_handler)
-    handle.also_stop = embed_handle  # _stop_worker stops both
     card = ModelDeploymentCard(
         display_name=cli.model, kv_cache_block_size=eargs.block_size,
         eos_token_ids=eos, tokenizer_ref=cli.model_path or "test")
     card.runtime_config.total_kv_blocks = engine.num_blocks
     await register_llm(runtime, ep, card)
-    return handle
+    return [handle, embed_handle]
 
 
 async def run_text_repl(manager):
@@ -143,11 +142,10 @@ async def run_text_repl(manager):
         print(flush=True)
 
 
-async def _stop_worker(handle):
-    extra = getattr(handle, "also_stop", None)
-    if extra is not None:
-        await extra.stop(graceful=False)
-    await handle.stop()
+async def _stop_worker(handles):
+    for h in reversed(handles[1:]):  # auxiliary endpoints first, hard stop
+        await h.stop(graceful=False)
+    await handles[0].stop()
 
 
 def _read_prompt():
@@ -246,7 +244,7 @@ async def amain():
     cli = ap.parse_args(rest)
 
     runtime = await DistributedRuntime.create()
-    handle = await start_worker(runtime, out, cli)
+    handles = await start_worker(runtime, out, cli)
 
     from dynamo_tpu.frontend.http import HttpService
     from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
@@ -268,7 +266,7 @@ async def amain():
                 await run_batch(manager, cli)
         finally:
             await watcher.stop()
-            await _stop_worker(handle)
+            await _stop_worker(handles)
             await runtime.shutdown()
         return
 
@@ -283,7 +281,7 @@ async def amain():
     await stop.wait()
     await service.stop()
     await watcher.stop()
-    await _stop_worker(handle)
+    await _stop_worker(handles)
     await runtime.shutdown()
 
 

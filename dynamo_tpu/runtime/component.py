@@ -127,6 +127,7 @@ class ServeHandle:
             self.endpoint.name,
             self.lease_id,
         )
+        rt.drop_registration(key)
         await rt.plane.kv_delete(key)
         if self._cancel_serve:
             await self._cancel_serve()
@@ -203,6 +204,7 @@ class Endpoint:
         created = await rt.plane.kv_create(key, value, lease_id=lease)
         if not created:
             await rt.plane.kv_put(key, value, lease_id=lease)
+        rt.record_registration(key, value)  # replayed after a hub restart
         logger.info("serving %s (instance %x)", subject, lease)
         return ServeHandle(self, lease, cancel_serve, inflight)
 
@@ -216,6 +218,10 @@ async def _pump_handler(handler: EndpointHandler, request: Any, ctx: Context, se
     Shared by the remote (socket) and in-process (queue) paths so their
     error/cancellation semantics cannot diverge.
     """
+    from dynamo_tpu.runtime.context import CURRENT_REQUEST
+
+    CURRENT_REQUEST.set(ctx)  # worker-side log lines carry the request id
+    logger.debug("handling request (traceparent=%s)", ctx.traceparent)
     try:
         async for item in handler(request, ctx):
             if ctx.cancelled:

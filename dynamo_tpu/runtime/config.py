@@ -66,7 +66,21 @@ def setup_logging():
     apply_platform_env()
     level = os.environ.get("DYN_LOG", "info").upper()
     if os.environ.get("DYN_LOGGING_JSONL"):
-        fmt = '{"ts":"%(asctime)s","level":"%(levelname)s","target":"%(name)s","msg":"%(message)s"}'
+        fmt = ('{"ts":"%(asctime)s","level":"%(levelname)s",'
+               '"target":"%(name)s","rid":"%(rid)s","msg":"%(message)s"}')
     else:
-        fmt = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+        fmt = "%(asctime)s %(levelname)-7s %(name)s [%(rid)s]: %(message)s"
     logging.basicConfig(level=getattr(logging, level, logging.INFO), format=fmt)
+
+    # every record carries the current request id (trace correlation across
+    # frontend and worker processes — ref: logging.rs:150-215)
+    class _RidFilter(logging.Filter):
+        def filter(self, record):
+            from dynamo_tpu.runtime.context import CURRENT_REQUEST
+
+            ctx = CURRENT_REQUEST.get()
+            record.rid = ctx.id[:16] if ctx is not None else "-"
+            return True
+
+    for h in logging.getLogger().handlers:
+        h.addFilter(_RidFilter())

@@ -10,9 +10,17 @@ attach (e.g. ``formatted_prompt``, ``token_ids``, ``query_instance_id``).
 from __future__ import annotations
 
 import asyncio
+import contextvars
+import secrets
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Optional
+
+#: task-local current request — set by the endpoint pump (worker side) and
+#: the HTTP handler (frontend side) so every log line in between can carry
+#: the request id / trace id (ref: logging.rs:150-215 span parenting)
+CURRENT_REQUEST: contextvars.ContextVar[Optional["Context"]] = (
+    contextvars.ContextVar("dyn_current_request", default=None))
 
 #: Sentinel emitted into a response stream when the producing worker died
 #: mid-stream; the migration operator keys off it
@@ -47,8 +55,28 @@ class Context:
         c._cancel_event = self._cancel_event
         return c
 
+    def ensure_traceparent(self) -> str:
+        """Return a W3C traceparent, synthesizing one if the caller didn't
+        send one (the request id doubles as the 128-bit trace id)."""
+        if not self.traceparent:
+            trace_id = (self.id if len(self.id) == 32
+                        and all(c in "0123456789abcdef" for c in self.id)
+                        else uuid.uuid4().hex)
+            self.traceparent = f"00-{trace_id}-{secrets.token_hex(8)}-01"
+        return self.traceparent
+
+    def child_traceparent(self) -> Optional[str]:
+        """traceparent for the next hop: same trace id, fresh span id."""
+        if not self.traceparent:
+            return None
+        parts = self.traceparent.split("-")
+        if len(parts) != 4:
+            return self.traceparent
+        return f"{parts[0]}-{parts[1]}-{secrets.token_hex(8)}-{parts[3]}"
+
     def to_wire(self) -> dict:
-        return {"id": self.id, "annotations": self.annotations, "traceparent": self.traceparent}
+        return {"id": self.id, "annotations": self.annotations,
+                "traceparent": self.child_traceparent()}
 
     @staticmethod
     def from_wire(d: dict) -> "Context":

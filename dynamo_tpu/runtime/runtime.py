@@ -38,6 +38,16 @@ class DistributedRuntime:
         # subject -> (handler, inflight set); see component._generate_to
         self._local_endpoints: dict = {}
         self._shutdown_event = asyncio.Event()
+        # key -> value written under the primary lease; replayed when the
+        # hub restarts and the lease must be recreated (see _recover_lease)
+        self._registrations: dict[str, bytes] = {}
+        self._recover_lock = asyncio.Lock()
+
+    def record_registration(self, key: str, value: bytes) -> None:
+        self._registrations[key] = value
+
+    def drop_registration(self, key: str) -> None:
+        self._registrations.pop(key, None)
 
     @staticmethod
     async def create(
@@ -74,7 +84,37 @@ class DistributedRuntime:
                 self._keepalive_task = asyncio.get_running_loop().create_task(
                     self._keepalive_loop()
                 )
+                if hasattr(self.plane, "add_reconnect_callback"):
+                    self.plane.add_reconnect_callback(self._recover_lease)
         return self._primary_lease
+
+    async def _recover_lease(self) -> None:
+        """After a hub restart the lease and every key under it are gone:
+        mint a fresh lease and re-put the recorded registrations (instance
+        keys and model entries keep their original names — only the backing
+        TTL lease changes), so the worker survives a dynctl restart instead
+        of becoming an undiscoverable zombie.
+
+        Serialized + idempotent: the reconnect callback and the keepalive
+        not-ok path can both fire after one restart; a second concurrent
+        recovery would re-bind keys to a lease nobody keeps alive."""
+        async with self._recover_lock:
+            try:  # someone else may have recovered while we waited
+                if (self._primary_lease is not None
+                        and await self.plane.lease_keepalive(self._primary_lease)):
+                    return
+            except Exception:
+                pass
+            new_lease = await self.plane.lease_create(self.config.lease_ttl)
+            self._primary_lease = new_lease
+            for key, value in list(self._registrations.items()):
+                try:
+                    await self.plane.kv_put(key, value, lease_id=new_lease)
+                except Exception:
+                    logger.exception("re-registration of %s failed", key)
+            logger.info("recovered primary lease (%x) and %d registrations "
+                        "after control-plane restart", new_lease,
+                        len(self._registrations))
 
     async def _keepalive_loop(self):
         """Refresh the primary lease; transient errors are retried.
@@ -105,9 +145,18 @@ class DistributedRuntime:
                         return
                     continue
                 if not ok:
-                    logger.error("primary lease %x lost; shutting down", self._primary_lease or 0)
-                    self._shutdown_event.set()
-                    return
+                    # the hub may have restarted (all lease state lost):
+                    # recovery replays registrations under a fresh lease
+                    try:
+                        await self._recover_lease()
+                        failures = 0
+                        continue
+                    except Exception:
+                        logger.error("primary lease %x lost and recovery "
+                                     "failed; shutting down",
+                                     self._primary_lease or 0, exc_info=True)
+                        self._shutdown_event.set()
+                        return
                 failures = 0
         except asyncio.CancelledError:
             pass

@@ -177,3 +177,77 @@ async def test_worker_kill_without_replacement_errors_cleanly():
                 pass
         await asyncio.gather(*(p.wait() for p in procs),
                              return_exceptions=True)
+
+
+@pytest.mark.anyio
+async def test_worker_survives_dynctl_restart():
+    """Kill the control-plane hub mid-fleet and restart it on the SAME
+    port: the worker must reconnect, mint a fresh lease, replay its
+    instance + model registrations, and serve again (r1 verdict item #10:
+    'worker survives a dynctl restart')."""
+    cp_port = free_port()
+    addr = f"127.0.0.1:{cp_port}"
+    procs = []
+    try:
+        dynctl = await _spawn(
+            ["-m", "dynamo_tpu.runtime.dynctl", "--port", str(cp_port)],
+            addr, "dynctl listening", "dynctl")
+        w = await _spawn(["-m", "dynamo_tpu.mocker.main", "--model", "mock"],
+                         addr, "MOCKER_READY", "worker")
+        procs.append(w)
+
+        # hub dies...
+        dynctl.kill()
+        await dynctl.wait()
+        await asyncio.sleep(1.0)
+        # ...and comes back empty on the same port
+        dynctl2 = await _spawn(
+            ["-m", "dynamo_tpu.runtime.dynctl", "--port", str(cp_port)],
+            addr, "dynctl listening", "dynctl2")
+        procs.append(dynctl2)
+
+        import os
+
+        from dynamo_tpu.llm.model_card import MODEL_ROOT
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        os.environ["DYN_CONTROL_PLANE"] = addr
+        try:
+            rt = await DistributedRuntime.create()
+            # worker reconnect backoff + lease keepalive interval: allow a
+            # few seconds for re-registration to replay
+            entries = {}
+            for _ in range(120):
+                entries = await rt.plane.kv_get_prefix(MODEL_ROOT)
+                if entries:
+                    break
+                await asyncio.sleep(0.25)
+            assert entries, "model registration did not reappear after restart"
+
+            ep = rt.namespace("dynamo").component("mocker").endpoint("generate")
+            client = await ep.client().start()
+            for _ in range(60):
+                if client.available_ids():
+                    break
+                await asyncio.sleep(0.25)
+            assert client.available_ids(), "instance did not reappear"
+
+            from dynamo_tpu.protocols import (PreprocessedRequest,
+                                              SamplingOptions, StopConditions)
+            req = PreprocessedRequest(
+                model="mock", token_ids=list(range(1, 20)),
+                stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+                sampling_options=SamplingOptions())
+            stream = await client.generate(req.to_wire())
+            toks = []
+            async for frame in stream:
+                toks.extend(frame.get("token_ids", []))
+            assert len(toks) == 4
+            await rt.shutdown()
+        finally:
+            os.environ.pop("DYN_CONTROL_PLANE", None)
+    finally:
+        for p in procs:
+            if p.returncode is None:
+                p.kill()
+            await p.wait()

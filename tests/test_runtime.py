@@ -181,3 +181,26 @@ async def test_direct_routing(local_rt):
     await client.stop()
     await ha.stop()
     await hb.stop()
+
+
+def test_traceparent_synthesis_and_child_spans():
+    """W3C traceparent: synthesized when absent (trace id = request id),
+    same trace id with a fresh span id per hop (ref:
+    addressed_router.rs:144-167)."""
+    from dynamo_tpu.runtime.context import Context
+
+    ctx = Context()
+    tp = ctx.ensure_traceparent()
+    ver, trace_id, span_id, flags = tp.split("-")
+    assert ver == "00" and len(trace_id) == 32 and len(span_id) == 16
+    assert trace_id == ctx.id  # uuid4 hex doubles as the trace id
+
+    # wire hop: same trace, new span
+    wire = ctx.to_wire()
+    ver2, trace2, span2, _ = wire["traceparent"].split("-")
+    assert trace2 == trace_id and span2 != span_id
+
+    # an incoming traceparent is preserved, not replaced
+    ctx2 = Context(traceparent="00-" + "a" * 32 + "-" + "b" * 16 + "-01")
+    assert ctx2.ensure_traceparent().split("-")[1] == "a" * 32
+    assert Context.from_wire(ctx2.to_wire()).traceparent.split("-")[1] == "a" * 32
