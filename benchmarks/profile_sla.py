@@ -45,6 +45,10 @@ async def amain():
     ap.add_argument("--url", default="http://127.0.0.1:8000")
     ap.add_argument("--model", required=True)
     ap.add_argument("--isl-words", type=int, default=512)
+    ap.add_argument("--isl-sweep", default=None,
+                    help="comma-separated ISLs for the 2D TTFT table "
+                         "(ref: perf_interpolation.py:48 — TTFT depends on "
+                         "ISL too; default: just --isl-words)")
     ap.add_argument("--osl", type=int, default=64)
     ap.add_argument("--concurrencies", default="1,2,4,8,16,32")
     ap.add_argument("--requests-per-level", type=int, default=16)
@@ -52,10 +56,22 @@ async def amain():
     cli = ap.parse_args()
 
     cs = [int(x) for x in cli.concurrencies.split(",")]
-    prefill, decode = await sweep(cli.url, cli.model, cli.isl_words, cli.osl,
-                                  cs, cli.requests_per_level)
-    out = {"prefill": prefill, "decode": decode,
-           "isl_words": cli.isl_words, "osl": cli.osl}
+    isls = ([int(x) for x in cli.isl_sweep.split(",")] if cli.isl_sweep
+            else [cli.isl_words])
+    prefill_by_isl = {}
+    decode = []
+    for isl in isls:
+        print(f"--- ISL sweep @ {isl} words ---", flush=True)
+        prefill, dec = await sweep(cli.url, cli.model, isl, cli.osl,
+                                   cs, cli.requests_per_level)
+        prefill_by_isl[isl] = prefill
+        if isl == isls[len(isls) // 2] or len(isls) == 1:
+            decode = dec  # ITL barely depends on ISL; keep the middle sweep
+    base_isl = cli.isl_words if cli.isl_words in prefill_by_isl else isls[0]
+    out = {"prefill": prefill_by_isl[base_isl],
+           "prefill_by_isl": prefill_by_isl,
+           "decode": decode,
+           "isl_words": base_isl, "osl": cli.osl}
     with open(cli.out, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {cli.out}")

@@ -11,6 +11,7 @@ decodes. Prefill worker downtime degrades gracefully to local prefill.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Optional
 
@@ -48,6 +49,54 @@ class PrefillWorkerHandler:
         else:
             resp = await self.engine.prefill_extract(req, ctx)
             yield resp.to_wire()
+
+
+class DisaggConfigWatcher:
+    """Watches the conditional-disagg threshold in the control-plane KV
+    store and updates a DisaggConfig live (ref: disagg_router.rs:26-80 —
+    the reference watches etcd for DisaggRouterConf changes at runtime).
+
+    Write ``DisaggConfig.KEY`` with an integer payload to retune the
+    local-vs-remote prefill decision without restarting decode workers.
+    """
+
+    def __init__(self, plane, config: DisaggConfig):
+        self.plane = plane
+        self.config = config
+        self._watch = None
+        self._task = None
+
+    async def start(self) -> "DisaggConfigWatcher":
+        self._watch = await self.plane.watch_prefix(DisaggConfig.KEY)
+        for _k, v in self._watch.snapshot.items():
+            self._apply(v)
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self):
+        if self._task:
+            self._task.cancel()
+        if self._watch:
+            await self._watch.cancel()
+
+    def _apply(self, value: bytes):
+        try:
+            n = int(value.decode())
+        except (ValueError, AttributeError):
+            logger.warning("ignoring bad disagg threshold payload %r", value)
+            return
+        if n != self.config.max_local_prefill_length:
+            logger.info("disagg max_local_prefill_length: %d -> %d",
+                        self.config.max_local_prefill_length, n)
+            self.config.max_local_prefill_length = n
+
+    async def _loop(self):
+        try:
+            async for ev in self._watch:
+                if ev.type == "put":
+                    self._apply(ev.value)
+        except asyncio.CancelledError:
+            pass
 
 
 class DecodeWorkerHandler:

@@ -185,11 +185,14 @@ async def amain():
             if cli.prefill_queue:
                 from dynamo_tpu.disagg.queue import PrefillQueueClient
                 prefill_queue = PrefillQueueClient(runtime.plane)
-        handler = DecodeWorkerHandler(
-            engine, prefill_client,
-            DisaggConfig(max_local_prefill_length=cli.max_local_prefill_length),
-            prefill_queue=prefill_queue)
+        dconf = DisaggConfig(
+            max_local_prefill_length=cli.max_local_prefill_length)
+        handler = DecodeWorkerHandler(engine, prefill_client, dconf,
+                                      prefill_queue=prefill_queue)
         serve = handler.generate
+        if cli.role == "decode":  # live-tunable threshold (disagg_router.rs)
+            from dynamo_tpu.disagg.handlers import DisaggConfigWatcher
+            await DisaggConfigWatcher(runtime.plane, dconf).start()
 
     handle = await ep.serve_endpoint(serve, lease_id=lease)
     embed_handle = None

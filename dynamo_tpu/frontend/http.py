@@ -125,6 +125,16 @@ class HttpService:
             return web.json_response(
                 error_body(f"model '{model}' not found", "model_not_found", 404),
                 status=404)
+        ctx = Context()
+        rid = (request.headers.get("x-request-id")
+               or request.headers.get("x-dynamo-request-id"))
+        if rid:
+            ctx.id = rid
+        ctx.traceparent = request.headers.get("traceparent")
+        ctx.ensure_traceparent()
+        from dynamo_tpu.runtime.context import CURRENT_REQUEST
+
+        CURRENT_REQUEST.set(ctx)
         raw = body.get("input")
         if isinstance(raw, str):
             inputs = [raw]
@@ -167,7 +177,7 @@ class HttpService:
                 error_body("at most 256 inputs per embeddings request"),
                 status=400)
         try:
-            vecs = await served.embed(token_lists)
+            vecs = await served.embed(token_lists, ctx=ctx)
         except ValueError as e:
             self._requests.inc(route="embeddings", model=model, status="400")
             return web.json_response(error_body(str(e)), status=400)

@@ -56,11 +56,12 @@ class ServedModel:
                 self.embed_client = await ep.client().start()
             return self.embed_client
 
-    async def embed(self, token_id_lists: list[list[int]]) -> list[list[float]]:
+    async def embed(self, token_id_lists: list[list[int]],
+                    ctx=None) -> list[list[float]]:
         """Round-robin one embed request to a worker; returns vectors."""
         client = await self.get_embed_client()
         stream = await client.generate({"token_ids": token_id_lists},
-                                       mode="round_robin")
+                                       ctx=ctx, mode="round_robin")
         async for frame in stream:
             if "error" in frame:
                 raise ValueError(frame["error"])

@@ -62,3 +62,57 @@ class PerfInterpolator:
             return float(hi.load)
         frac = (latency_target_ms - lo.latency_ms) / (hi.latency_ms - lo.latency_ms)
         return float(lo.load + frac * (hi.load - lo.load))
+
+
+@dataclass
+class PerfInterpolator2D:
+    """Latency over (ISL, load): one monotone curve per profiled ISL.
+
+    The reference interpolates TTFT over the ISL dimension too (ref:
+    planner/utils/perf_interpolation.py:48); r1 approximated it with a
+    single linear rescale. Queries between profiled ISLs blend the two
+    neighbouring curves linearly; outside the profiled range the nearest
+    curve is used (clamped — extrapolating a superlinear prefill cost from
+    two points misleads more than it helps).
+    """
+
+    curves: dict = field(default_factory=dict)  # isl -> PerfInterpolator|points
+
+    def __post_init__(self):
+        self.curves = {
+            float(isl): (c if isinstance(c, PerfInterpolator)
+                         else PerfInterpolator(points=list(c)))
+            for isl, c in self.curves.items()
+        }
+        self._isls = sorted(self.curves)
+        if not self._isls:
+            raise ValueError("PerfInterpolator2D needs at least one ISL sweep")
+
+    def _neighbors(self, isl: float):
+        isls = self._isls
+        if isl <= isls[0]:
+            return isls[0], isls[0], 0.0
+        if isl >= isls[-1]:
+            return isls[-1], isls[-1], 0.0
+        idx = int(np.searchsorted(isls, isl, side="right")) - 1
+        lo, hi = isls[idx], isls[idx + 1]
+        return lo, hi, (isl - lo) / (hi - lo)
+
+    def max_load_under(self, latency_target_ms: float, isl: float) -> float:
+        lo, hi, t = self._neighbors(isl)
+        a = self.curves[lo].max_load_under(latency_target_ms)
+        b = self.curves[hi].max_load_under(latency_target_ms)
+        return float(a + t * (b - a))
+
+    def latency_at(self, load: float, isl: float) -> float:
+        lo, hi, t = self._neighbors(isl)
+        a = self.curves[lo].latency_at(load)
+        b = self.curves[hi].latency_at(load)
+        return float(a + t * (b - a))
+
+    @staticmethod
+    def from_profile(profile: dict) -> "PerfInterpolator2D":
+        """Build from profile_sla.py output's ``prefill_by_isl`` table."""
+        return PerfInterpolator2D(curves={
+            float(isl): pts for isl, pts in profile["prefill_by_isl"].items()
+        })

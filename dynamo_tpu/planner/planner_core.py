@@ -18,7 +18,9 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from dynamo_tpu.planner.load_predictor import make_predictor
-from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+from dynamo_tpu.planner.perf_interpolation import (
+    PerfInterpolator, PerfInterpolator2D,
+)
 
 logger = logging.getLogger("dynamo.planner")
 
@@ -65,7 +67,8 @@ class Planner:
     feeds observe(). Fully synchronous and unit-testable (ref pattern:
     tests/planner/test_replica_calculation.py)."""
 
-    def __init__(self, cfg: PlannerConfig, prefill_perf: PerfInterpolator,
+    def __init__(self, cfg: PlannerConfig,
+                 prefill_perf: "PerfInterpolator | PerfInterpolator2D",
                  decode_perf: PerfInterpolator):
         self.cfg = cfg
         self.prefill_perf = prefill_perf
@@ -95,13 +98,18 @@ class Planner:
             return self.current  # no data yet
 
         cfg = self.cfg
-        # prefill: per-replica sustainable request rate at the TTFT SLA. The
-        # sweep is taken at profiled_isl; prefill work scales ~linearly in
-        # prompt tokens, so rescale demand when the live ISL drifts from it.
+        # prefill: per-replica sustainable request rate at the TTFT SLA.
+        # With a 2D profile (TTFT over ISL × rate) the capacity comes from
+        # the curve AT the predicted ISL; a 1D profile falls back to the
+        # linear ISL-drift rescale around profiled_isl.
         eff_rate = rate
-        if cfg.profiled_isl > 0 and isl > 0:
-            eff_rate = rate * (isl / cfg.profiled_isl)
-        per_replica_rate = self.prefill_perf.max_load_under(cfg.ttft_sla_ms)
+        if isinstance(self.prefill_perf, PerfInterpolator2D):
+            per_replica_rate = self.prefill_perf.max_load_under(
+                cfg.ttft_sla_ms, isl)
+        else:
+            if cfg.profiled_isl > 0 and isl > 0:
+                eff_rate = rate * (isl / cfg.profiled_isl)
+            per_replica_rate = self.prefill_perf.max_load_under(cfg.ttft_sla_ms)
         if per_replica_rate <= 0:
             p = cfg.max_prefill_replicas
         else:

@@ -245,6 +245,9 @@ class LocalControlPlane(ControlPlane):
     """In-process control plane; also the core of :class:`ControlPlaneServer`."""
 
     def __init__(self):
+        #: identifies this hub incarnation: stream seqs are only comparable
+        #: within one epoch (clients resume from 0 after a hub restart)
+        self.epoch = f"{random.getrandbits(64):016x}"
         self._kv: dict[str, bytes] = {}
         self._key_lease: dict[str, int] = {}
         self._leases: dict[int, _Lease] = {}
@@ -662,6 +665,8 @@ class _ServerConn:
             cancel = self._svc_cancels.pop(m["svc_id"], None)
             if cancel:
                 await cancel()
+        elif op == "epoch":
+            return core.epoch
         elif op == "queue_push":
             await core.queue_push(m["queue"], m["payload"])
         elif op == "queue_pop":
@@ -794,6 +799,7 @@ class RemoteControlPlane(ControlPlane):
         self._reader, self._writer = await asyncio.open_connection(self._host, self._port)
         self._connected = True
         self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
+        self._epoch = await self._call("epoch")
         return self
 
     async def _rx_loop(self):
@@ -882,6 +888,17 @@ class RemoteControlPlane(ControlPlane):
 
     async def _replay(self):
         """Re-establish serves, watches, and subscriptions on the new conn."""
+        # epoch check: a RESTARTED hub resets stream seq counters, so seqs
+        # from the previous epoch are meaningless — resume every stream from
+        # 0 (comparing seqs alone cannot detect a restarted hub whose new
+        # counter already passed our old high-water mark)
+        epoch = await self._call("epoch")
+        new_epoch = epoch != getattr(self, "_epoch", None)
+        self._epoch = epoch
+        if new_epoch:
+            for sid, meta in list(self._sub_meta.items()):
+                if meta[0] == "stream":
+                    self._sub_meta[sid] = ("stream", meta[1], 0)
         for svc_id, subject in list(self._serve_meta.items()):
             await self._call("serve", svc_id=svc_id, subject=subject)
         for wid, prefix in list(self._watch_meta.items()):
@@ -898,15 +915,8 @@ class RemoteControlPlane(ControlPlane):
                 await self._call("subscribe", sid=sid, subject=meta[1],
                                  queue_group=meta[2])
             else:
-                # a RESTARTED hub resets stream seqs to 0 — resuming at our
-                # old high-water mark would silently skip everything until
-                # the new counter catches up
-                server_last = await self._call("stream_last_seq",
-                                               stream=meta[1])
-                start = meta[2] if server_last >= meta[2] else 0
-                self._sub_meta[sid] = ("stream", meta[1], start)
                 await self._call("stream_subscribe", sid=sid, stream=meta[1],
-                                 start_seq=start)
+                                 start_seq=meta[2])
 
     async def _handle_svc(self, msg):
         handler = self._handlers.get(msg["svc_id"])

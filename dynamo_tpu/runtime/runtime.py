@@ -119,11 +119,12 @@ class DistributedRuntime:
     async def _keepalive_loop(self):
         """Refresh the primary lease; transient errors are retried.
 
-        A definitively-lost lease (keepalive returns False) means every
-        instance registered under it is already gone cluster-wide — the
-        process is an undiscoverable zombie, so we trip the shutdown event
-        and let the worker main exit (supervisor restarts it), matching the
-        reference's lease-loss-is-fatal semantics.
+        A definitively-lost lease (keepalive returns False — typically the
+        hub restarted and forgot it) triggers recovery: a fresh lease is
+        minted and the recorded registrations are re-put, so the worker
+        rejoins the cluster instead of dying. Only when recovery itself
+        fails is the shutdown event tripped (the process is then an
+        undiscoverable zombie and the supervisor should restart it).
         """
         interval = max(self.config.lease_ttl / 3.0, 0.5)
         failures = 0

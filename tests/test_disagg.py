@@ -394,3 +394,34 @@ async def test_prefill_queue_claim_timeout_falls_back_round_robin():
     await pre.close()
     await dec.close()
     await plane.close()
+
+
+async def test_disagg_threshold_watched_from_control_plane():
+    """The conditional-disagg threshold updates live from the KV store
+    (ref: disagg_router.rs:26-80)."""
+    from dynamo_tpu.disagg.handlers import DisaggConfigWatcher
+    from dynamo_tpu.runtime.control_plane import LocalControlPlane
+
+    plane = LocalControlPlane()
+    cfg = DisaggConfig(max_local_prefill_length=512)
+    w = await DisaggConfigWatcher(plane, cfg).start()
+    await plane.kv_put(DisaggConfig.KEY, b"128")
+    for _ in range(100):
+        if cfg.max_local_prefill_length == 128:
+            break
+        await asyncio.sleep(0.01)
+    assert cfg.max_local_prefill_length == 128
+    await plane.kv_put(DisaggConfig.KEY, b"not-a-number")  # ignored
+    await asyncio.sleep(0.05)
+    assert cfg.max_local_prefill_length == 128
+    await w.stop()
+    await plane.close()
+
+    # pre-existing value applies at start
+    plane2 = LocalControlPlane()
+    await plane2.kv_put(DisaggConfig.KEY, b"64")
+    cfg2 = DisaggConfig()
+    w2 = await DisaggConfigWatcher(plane2, cfg2).start()
+    assert cfg2.max_local_prefill_length == 64
+    await w2.stop()
+    await plane2.close()

@@ -132,3 +132,50 @@ def test_isl_drift_scales_prefill_fleet():
     pl2.observe(Observation(request_rate=3.0, isl=4000, osl=250))
     # 4x the profiled prompt length → 4x effective request rate
     assert pl2.compute().prefill_replicas == math.ceil(3.0 * 4 / per)
+
+
+def test_perf_interpolator_2d_blends_isl_curves():
+    """TTFT capacity interpolates over the ISL dimension (r1 weak #9)."""
+    from dynamo_tpu.planner.perf_interpolation import PerfInterpolator2D
+
+    # at ISL 512 a replica holds 10 req/s under 200ms; at ISL 2048 only 2
+    p2 = PerfInterpolator2D(curves={
+        512: [[2.0, 50.0], [10.0, 200.0], [20.0, 800.0]],
+        2048: [[0.5, 80.0], [2.0, 200.0], [6.0, 900.0]],
+    })
+    assert p2.max_load_under(200.0, 512) == 10.0
+    assert p2.max_load_under(200.0, 2048) == 2.0
+    mid = p2.max_load_under(200.0, 1280)  # halfway: linear blend
+    assert abs(mid - 6.0) < 1e-9
+    # clamped outside the profiled range
+    assert p2.max_load_under(200.0, 100) == 10.0
+    assert p2.max_load_under(200.0, 9999) == 2.0
+    assert p2.latency_at(2.0, 2048) == 200.0
+
+
+def test_planner_uses_2d_prefill_profile():
+    """With a 2D profile, predicted ISL picks the right capacity curve —
+    long prompts grow the prefill fleet without the scalar rescale."""
+    from dynamo_tpu.planner.perf_interpolation import (PerfInterpolator,
+                                                       PerfInterpolator2D)
+    from dynamo_tpu.planner.planner_core import (Observation, Planner,
+                                                 PlannerConfig)
+
+    p2 = PerfInterpolator2D(curves={
+        512: [[2.0, 50.0], [10.0, 200.0], [20.0, 800.0]],
+        2048: [[0.5, 80.0], [2.0, 200.0], [6.0, 900.0]],
+    })
+    dec = PerfInterpolator(points=[[100.0, 5.0], [1000.0, 20.0]])
+    cfg = PlannerConfig(ttft_sla_ms=200.0, itl_sla_ms=20.0,
+                        predictor="constant", max_prefill_replicas=64)
+    pl = Planner(cfg, p2, dec)
+    for _ in range(3):
+        pl.observe(Observation(request_rate=8.0, isl=512, osl=100))
+    d_short = pl.compute()
+    assert d_short.prefill_replicas == 1  # 8 req/s / 10 per replica
+
+    pl2 = Planner(cfg, p2, dec)
+    for _ in range(3):
+        pl2.observe(Observation(request_rate=8.0, isl=2048, osl=100))
+    d_long = pl2.compute()
+    assert d_long.prefill_replicas == 4  # 8 req/s / 2 per replica
