@@ -1,41 +1,177 @@
-"""Runtime configuration from ``DYN_*`` environment variables.
+"""Typed, layered runtime configuration (``DYN_*``).
 
-Env-first configuration like the reference (ref: lib/runtime/src/config.rs):
+Figment-style layering like the reference (ref: lib/runtime/src/config.rs:
+1-608 — defaults < config file < environment, typed extraction with clear
+errors):
 
-- ``DYN_CONTROL_PLANE``  — ``host:port`` of the dynctl server; unset means
-  single-process mode with an in-process control plane.
-- ``DYN_LEASE_TTL``      — primary lease TTL seconds (default 10).
-- ``DYN_NAMESPACE``      — default namespace (default ``dynamo``).
-- ``DYN_LOG``            — log level (default info).
-- ``DYN_LOGGING_JSONL``  — JSONL log lines when truthy.
+1. dataclass defaults,
+2. an optional config file (``DYN_CONFIG_FILE``: TOML or JSON),
+3. ``DYN_<FIELD>`` environment variables (highest precedence).
+
+Values are coerced to the field's declared type; a bad value or an unknown
+key in the config file raises :class:`ConfigError` naming the offender —
+a typo'd knob must fail loudly at startup, not silently use a default.
+
+Env surface:
+
+- ``DYN_CONTROL_PLANE``    — ``host:port`` of dynctl; unset = in-process.
+- ``DYN_LEASE_TTL``        — primary lease TTL seconds (default 10).
+- ``DYN_NAMESPACE``        — default namespace (default ``dynamo``).
+- ``DYN_REQUEST_TIMEOUT``  — request-plane ack timeout seconds.
+- ``DYN_HEALTH_CHECK_INTERVAL`` / ``DYN_HEALTH_CHECK_FAILURES`` — canary
+  probe cadence and unroutable threshold.
+- ``DYN_SYSTEM_PORT``      — system status server port (0 = disabled).
+- ``DYN_LOG``              — log level (default info).
+- ``DYN_LOGGING_JSONL``    — JSONL log lines when truthy.
+- ``DYN_CONFIG_FILE``      — path to a TOML/JSON file with the same keys
+  (lower-case field names).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import logging
 import os
+import typing
 from dataclasses import dataclass, field
 from typing import Optional
 
 
-def _env_float(name: str, default: float) -> float:
+class ConfigError(Exception):
+    """A configuration value failed validation; message names the field."""
+
+
+_TRUTHY = {"1", "true", "yes", "on"}
+_FALSY = {"0", "false", "no", "off", ""}
+
+
+def _coerce(name: str, value, typ):
+    """Coerce ``value`` (often a string from the env) to ``typ``."""
+    origin = typing.get_origin(typ)
+    if origin is typing.Union:  # Optional[T]
+        args = [a for a in typing.get_args(typ) if a is not type(None)]
+        if value is None:
+            return None
+        return _coerce(name, value, args[0])
+    if value is None:  # null for a non-Optional field: fail loudly
+        raise ConfigError(f"config field '{name}': null is not allowed")
     try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+        if typ is bool:
+            if isinstance(value, bool):
+                return value
+            s = str(value).strip().lower()
+            if s in _TRUTHY:
+                return True
+            if s in _FALSY:
+                return False
+            raise ValueError(f"not a boolean: {value!r}")
+        if typ is int:
+            return int(str(value).strip())
+        if typ is float:
+            return float(str(value).strip())
+        if typ is str:
+            return str(value)
+    except (TypeError, ValueError) as e:
+        raise ConfigError(f"config field '{name}': {e}") from None
+    return value
 
 
 @dataclass
 class RuntimeConfig:
-    control_plane_address: Optional[str] = field(
-        default_factory=lambda: os.environ.get("DYN_CONTROL_PLANE")
-    )
-    lease_ttl: float = field(default_factory=lambda: _env_float("DYN_LEASE_TTL", 10.0))
-    namespace: str = field(default_factory=lambda: os.environ.get("DYN_NAMESPACE", "dynamo"))
+    """Process-wide runtime knobs (ref: config.rs RuntimeConfig)."""
+
+    #: dynctl address (host:port); None = in-process control plane
+    control_plane_address: Optional[str] = None
+    #: primary lease TTL seconds; instances vanish this long after a crash
+    lease_ttl: float = 10.0
+    namespace: str = "dynamo"
+    #: request-plane ack timeout (seconds)
+    request_timeout: float = 10.0
+    #: canary health-check cadence (seconds) and failure threshold
+    health_check_interval: float = 30.0
+    health_check_failures: int = 3
+    #: system status server port (0 = disabled)
+    system_port: int = 0
+
+    def __post_init__(self):
+        if self.lease_ttl <= 0:
+            raise ConfigError("config field 'lease_ttl': must be > 0")
+        if self.request_timeout <= 0:
+            raise ConfigError("config field 'request_timeout': must be > 0")
+        if self.health_check_failures < 1:
+            raise ConfigError(
+                "config field 'health_check_failures': must be >= 1")
+        if self.health_check_interval <= 0:
+            raise ConfigError(
+                "config field 'health_check_interval': must be > 0")
+        if not self.namespace:
+            raise ConfigError("config field 'namespace': must be non-empty")
+
+    # -- layered loading -----------------------------------------------------
+
+    #: field name → env var (control_plane_address keeps its historical name)
+    _ENV_OVERRIDES = {
+        "control_plane_address": "DYN_CONTROL_PLANE",
+        "health_check_interval": "DYN_HEALTH_CHECK_INTERVAL",
+        "health_check_failures": "DYN_HEALTH_CHECK_FAILURES",
+    }
+
+    @classmethod
+    def load(cls, config_file: Optional[str] = None,
+             env: Optional[dict] = None) -> "RuntimeConfig":
+        """defaults < config file < DYN_* env (highest wins)."""
+        env = os.environ if env is None else env
+        # `from __future__ import annotations` stringifies field.type;
+        # resolve the real types for coercion
+        hints = typing.get_type_hints(cls)
+        fields = {f.name: f for f in dataclasses.fields(cls)
+                  if not f.name.startswith("_")}
+        values: dict = {}
+
+        path = config_file or env.get("DYN_CONFIG_FILE")
+        if path:
+            file_vals = cls._read_file(path)
+            unknown = set(file_vals) - set(fields)
+            if unknown:
+                raise ConfigError(
+                    f"unknown config key(s) in {path}: {sorted(unknown)}")
+            values.update(file_vals)
+
+        for name, f in fields.items():
+            var = cls._ENV_OVERRIDES.get(name, f"DYN_{name.upper()}")
+            if var in env:
+                values[name] = env[var]
+
+        coerced = {
+            name: _coerce(name, values[name], hints[name])
+            for name in values
+        }
+        return cls(**coerced)
+
+    @staticmethod
+    def _read_file(path: str) -> dict:
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise ConfigError(f"cannot read config file {path}: {e}") from None
+        text = raw.decode()
+        if path.endswith(".json"):
+            try:
+                return json.loads(text)
+            except json.JSONDecodeError as e:
+                raise ConfigError(f"bad JSON in {path}: {e}") from None
+        try:
+            import tomllib
+
+            return tomllib.loads(text)
+        except Exception as e:
+            raise ConfigError(f"bad TOML in {path}: {e}") from None
 
     @staticmethod
     def from_env() -> "RuntimeConfig":
-        return RuntimeConfig()
+        return RuntimeConfig.load()
 
 
 def apply_platform_env() -> None:

@@ -42,6 +42,14 @@ class DistributedRuntime:
         # hub restarts and the lease must be recreated (see _recover_lease)
         self._registrations: dict[str, bytes] = {}
         self._recover_lock = asyncio.Lock()
+        # structured concurrency root (ref: utils/tasks/tracker.rs):
+        # components spawn through runtime.tracker (or a child of it);
+        # shutdown() drains the whole tree. SHUTDOWN-policy task failures
+        # trip the runtime's shutdown event (critical-task semantics).
+        from dynamo_tpu.runtime.tasks import TaskTracker
+
+        self.tracker = TaskTracker(
+            "runtime", on_shutdown=self._shutdown_event.set)
 
     def record_registration(self, key: str, value: bytes) -> None:
         self._registrations[key] = value
@@ -180,9 +188,14 @@ class DistributedRuntime:
         await self._shutdown_event.wait()
 
     async def shutdown(self):
-        if self._shutdown_event.is_set():
+        # idempotence keys on a cleanup flag, NOT the shutdown event: a
+        # critical-task failure sets the event first, and the subsequent
+        # explicit shutdown() must still run the cleanup
+        if getattr(self, "_cleanup_done", False):
             return
+        self._cleanup_done = True
         self._shutdown_event.set()
+        await self.tracker.join(graceful_timeout=5.0)
         if self._keepalive_task:
             self._keepalive_task.cancel()
         if self._primary_lease is not None:

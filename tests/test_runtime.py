@@ -204,3 +204,148 @@ def test_traceparent_synthesis_and_child_spans():
     ctx2 = Context(traceparent="00-" + "a" * 32 + "-" + "b" * 16 + "-01")
     assert ctx2.ensure_traceparent().split("-")[1] == "a" * 32
     assert Context.from_wire(ctx2.to_wire()).traceparent.split("-")[1] == "a" * 32
+
+
+def test_runtime_config_layering(tmp_path):
+    """defaults < config file < DYN_* env, typed coercion, loud failures
+    (ref: config.rs:1-608 figment layering)."""
+    import pytest as _pytest
+
+    from dynamo_tpu.runtime.config import ConfigError, RuntimeConfig
+
+    # defaults
+    cfg = RuntimeConfig.load(env={})
+    assert cfg.lease_ttl == 10.0 and cfg.namespace == "dynamo"
+    assert cfg.control_plane_address is None
+
+    # file layer
+    f = tmp_path / "dyn.toml"
+    f.write_text('lease_ttl = 5.0\nnamespace = "prod"\nsystem_port = 9100\n')
+    cfg = RuntimeConfig.load(config_file=str(f), env={})
+    assert cfg.lease_ttl == 5.0 and cfg.namespace == "prod"
+    assert cfg.system_port == 9100
+
+    # env overrides the file, strings coerce to the field types
+    cfg = RuntimeConfig.load(config_file=str(f), env={
+        "DYN_LEASE_TTL": "2.5", "DYN_CONTROL_PLANE": "10.0.0.1:2379",
+        "DYN_HEALTH_CHECK_FAILURES": "7"})
+    assert cfg.lease_ttl == 2.5 and cfg.namespace == "prod"
+    assert cfg.control_plane_address == "10.0.0.1:2379"
+    assert cfg.health_check_failures == 7
+
+    # JSON files work too
+    j = tmp_path / "dyn.json"
+    j.write_text('{"request_timeout": 3.0}')
+    assert RuntimeConfig.load(config_file=str(j), env={}).request_timeout == 3.0
+
+    # typo'd file key fails loudly
+    bad = tmp_path / "bad.toml"
+    bad.write_text("leese_ttl = 5.0\n")
+    with _pytest.raises(ConfigError, match="leese_ttl"):
+        RuntimeConfig.load(config_file=str(bad), env={})
+
+    # malformed value names the field
+    with _pytest.raises(ConfigError, match="lease_ttl"):
+        RuntimeConfig.load(env={"DYN_LEASE_TTL": "fast"})
+    # validation: nonsense ranges rejected
+    with _pytest.raises(ConfigError, match="lease_ttl"):
+        RuntimeConfig.load(env={"DYN_LEASE_TTL": "-1"})
+
+
+@pytest.mark.anyio
+async def test_task_tracker_hierarchy_and_policies():
+    """Structured concurrency (ref: utils/tasks/tracker.rs): error
+    policies, child coverage, graceful join."""
+    from dynamo_tpu.runtime.tasks import OnErrorPolicy, TaskTracker
+
+    shutdowns = []
+    root = TaskTracker("r", on_shutdown=lambda: shutdowns.append(1))
+    child = root.child("c")
+    ran = []
+
+    async def ok(tag):
+        ran.append(tag)
+
+    async def boom():
+        raise RuntimeError("kaboom")
+
+    async def forever():
+        await asyncio.sleep(3600)
+
+    # CONTINUE: failure logged, siblings unaffected
+    t1 = child.spawn(ok("a"))
+    t2 = child.spawn(boom(), "boom", OnErrorPolicy.CONTINUE)
+    await asyncio.gather(t1, t2, return_exceptions=True)
+    assert ran == ["a"] and child.errors == 1
+
+    # CANCEL_SCOPE: failure cancels the tracker's other tasks
+    scope = root.child("scope")
+    hang = scope.spawn(forever(), "hang")
+    bad = scope.spawn(boom(), "boom", OnErrorPolicy.CANCEL_SCOPE)
+    await asyncio.gather(hang, bad, return_exceptions=True)
+    assert hang.cancelled()
+
+    # SHUTDOWN bubbles to the root callback from a grandchild
+    gc = child.child("gc")
+    t = gc.spawn(boom(), "critical", OnErrorPolicy.SHUTDOWN)
+    await asyncio.gather(t, return_exceptions=True)
+    assert shutdowns == [1]
+
+    # join drains children and cancels stragglers; refuses new spawns
+    s = root.child("drain")
+    slow = s.spawn(forever(), "slow")
+    await root.join(graceful_timeout=0.05)
+    assert slow.cancelled()
+    with pytest.raises(RuntimeError, match="closed"):
+        root.spawn(ok("x"))
+    assert root.inflight == 0
+
+
+@pytest.mark.anyio
+async def test_task_tracker_concurrency_bound():
+    from dynamo_tpu.runtime.tasks import TaskTracker
+
+    tr = TaskTracker("b", max_concurrency=2)
+    active = 0
+    peak = 0
+
+    async def work():
+        nonlocal active, peak
+        active += 1
+        peak = max(peak, active)
+        await asyncio.sleep(0.02)
+        active -= 1
+
+    await asyncio.gather(*[tr.spawn(work()) for _ in range(8)])
+    assert peak <= 2
+
+
+@pytest.mark.anyio
+async def test_task_tracker_join_covers_grandchildren():
+    """join() drains the WHOLE subtree, not only direct children."""
+    from dynamo_tpu.runtime.tasks import TaskTracker
+
+    root = TaskTracker("r")
+    gc = root.child("c").child("gc")
+
+    async def forever():
+        await asyncio.sleep(3600)
+
+    t = gc.spawn(forever(), "deep")
+    await root.join(graceful_timeout=0.05)
+    assert t.cancelled()
+    with pytest.raises(RuntimeError, match="closed"):
+        gc.spawn(forever())
+
+
+def test_runtime_config_null_rejected(tmp_path):
+    import pytest as _pytest
+
+    from dynamo_tpu.runtime.config import ConfigError, RuntimeConfig
+
+    j = tmp_path / "n.json"
+    j.write_text('{"namespace": null}')
+    with _pytest.raises(ConfigError, match="namespace"):
+        RuntimeConfig.load(config_file=str(j), env={})
+    with _pytest.raises(ConfigError, match="health_check_interval"):
+        RuntimeConfig.load(env={"DYN_HEALTH_CHECK_INTERVAL": "0"})
