@@ -188,14 +188,16 @@ def allocate_device_cache(cfg, num_blocks: int, block_size: int, mesh=None,
     from dynamo_tpu.engine.model import cache_shardings
 
     dtype = dtype or jnp.dtype(cfg.dtype)
-    shape = (cfg.num_layers, num_blocks * block_size, cfg.num_kv_heads, cfg.head_dim)
+    (kh, kd), (vh, vd) = cfg.kv_cache_spec
+    k_shape = (cfg.num_layers, num_blocks * block_size, kh, kd)
+    v_shape = (cfg.num_layers, num_blocks * block_size, vh, vd)
     if mesh is not None:
-        sh = cache_shardings(mesh)
-        k = jax.device_put(jnp.zeros(shape, dtype), sh)
-        v = jax.device_put(jnp.zeros(shape, dtype), sh)
+        sh = cache_shardings(mesh, cfg)
+        k = jax.device_put(jnp.zeros(k_shape, dtype), sh)
+        v = jax.device_put(jnp.zeros(v_shape, dtype), sh)
     else:
-        k = jnp.zeros(shape, dtype)
-        v = jnp.zeros(shape, dtype)
+        k = jnp.zeros(k_shape, dtype)
+        v = jnp.zeros(v_shape, dtype)
     return k, v
 
 
@@ -210,9 +212,13 @@ def hbm_sized_num_blocks(cfg, block_size: int, fraction: float,
         free = stats["bytes_limit"] - stats["bytes_in_use"]
     except Exception:
         return default
+    (kh, kd), (vh, vd) = cfg.kv_cache_spec
+    # MLA's single-latent-head cache is not TP-shardable (replicated)
+    k_heads = kh // max(1, tp_size) if kh % max(1, tp_size) == 0 else kh
+    v_heads = vh // max(1, tp_size) if vh % max(1, tp_size) == 0 else vh
     bytes_per_block = (
-        2 * cfg.num_layers * block_size * (cfg.num_kv_heads // max(1, tp_size))
-        * cfg.head_dim * (2 if cfg.dtype == "bfloat16" else 4)
+        cfg.num_layers * block_size * (k_heads * kd + v_heads * vd)
+        * (2 if cfg.dtype == "bfloat16" else 4)
     )
     n = int(free * fraction / max(1, bytes_per_block))
     return max(16, n)

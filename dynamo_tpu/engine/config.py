@@ -41,9 +41,35 @@ class ModelConfig:
     #: tokens per step (Switch-style dropping past that; >= E/K disables
     #: dropping entirely)
     moe_capacity_factor: float = 2.0
+    #: expert MLP width (DeepSeek's moe_intermediate_size); None = use
+    #: intermediate_size (Mixtral-style)
+    moe_intermediate_size: Optional[int] = None
+    #: always-on shared experts (DeepSeek): dense SwiGLU of width
+    #: n_shared_experts * moe_intermediate_size added to the routed output
+    n_shared_experts: int = 0
+    #: leading dense (non-MoE) decoder layers (DeepSeek first_k_dense_replace)
+    first_k_dense_replace: int = 0
+    #: router scoring: "softmax" (Mixtral: softmax over top-k logits) or
+    #: "sigmoid" (DeepSeek-V3: sigmoid scores + e_score_correction_bias for
+    #: expert choice, gathered raw scores as weights)
+    scoring_func: str = "softmax"
+    norm_topk_prob: bool = False
+    routed_scaling_factor: float = 1.0
+    # group-limited routing (DeepSeek: experts in n_group groups, routing
+    # restricted to the best topk_group groups)
+    n_group: int = 1
+    topk_group: int = 1
     # attention extras
     qkv_bias: bool = False  # Qwen2-style
     sliding_window: Optional[int] = None
+    # --- MLA (multi-head latent attention, DeepSeek V2/V3) ---------------
+    #: latent rank of the compressed KV; >0 switches attention to MLA and
+    #: the paged cache to the latent layout (see kv_cache_spec)
+    kv_lora_rank: int = 0
+    q_lora_rank: Optional[int] = None  # None = full q projection (V2-Lite)
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -53,6 +79,40 @@ class ModelConfig:
     def is_moe(self) -> bool:
         return self.num_experts > 0
 
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def moe_ffn_size(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
+
+    @property
+    def num_dense_prefix_layers(self) -> int:
+        """Layers in the separate ``dense_layers`` param stack. THE single
+        source of the dense-prefix rule — loader, init, shardings, and
+        forward all key off this, so the pytree contract cannot drift."""
+        return self.first_k_dense_replace if self.is_moe else 0
+
+    @property
+    def kv_cache_spec(self) -> tuple[tuple[int, int], tuple[int, int]]:
+        """((heads, dim) of k_cache, (heads, dim) of v_cache) per slot.
+
+        MHA/GQA: both caches hold [num_kv_heads, head_dim]. MLA stores the
+        compressed latent instead — k_cache [1, kv_lora_rank] (normalized
+        c_kv) and v_cache [1, qk_rope_head_dim] (the shared post-RoPE k_rot)
+        — the memory win that makes DeepSeek-class models servable (ref
+        behavior delegated to engines; e.g. vLLM's MLA cache does the same).
+        """
+        if self.is_mla:
+            return ((1, self.kv_lora_rank), (1, self.qk_rope_head_dim))
+        return ((self.num_kv_heads, self.head_dim),
+                (self.num_kv_heads, self.head_dim))
+
     @staticmethod
     def from_hf_config(d: dict) -> "ModelConfig":
         """Map a HuggingFace ``config.json`` dict onto ModelConfig.
@@ -61,6 +121,8 @@ class ModelConfig:
         loads the same file into its ModelDeploymentCard — model_card.rs:93).
         """
         arch = (d.get("architectures") or [""])[0].lower()
+        is_deepseek = "deepseek" in arch
+        mla = is_deepseek and d.get("kv_lora_rank") is not None
         return ModelConfig(
             vocab_size=d.get("vocab_size", 32000),
             hidden_size=d.get("hidden_size", 4096),
@@ -68,13 +130,29 @@ class ModelConfig:
             num_layers=d.get("num_hidden_layers", 32),
             num_heads=d.get("num_attention_heads", 32),
             num_kv_heads=d.get("num_key_value_heads", d.get("num_attention_heads", 32)),
-            head_dim=d.get("head_dim"),
+            head_dim=d.get("head_dim") if not is_deepseek else None,
             rope_theta=d.get("rope_theta", 10000.0),
             rms_norm_eps=d.get("rms_norm_eps", 1e-5),
             max_position_embeddings=d.get("max_position_embeddings", 8192),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
             num_experts=d.get("num_local_experts", d.get("n_routed_experts", 0)) or 0,
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
+            moe_intermediate_size=d.get("moe_intermediate_size"),
+            n_shared_experts=d.get("n_shared_experts", 0) or 0,
+            first_k_dense_replace=d.get("first_k_dense_replace", 0) or 0,
+            scoring_func=d.get("scoring_func",
+                               "sigmoid" if "deepseekv3" in arch else "softmax"),
+            # Mixtral renormalizes its top-k gates (its HF config has no
+            # such key); DeepSeek configs carry the flag explicitly
+            norm_topk_prob=d.get("norm_topk_prob", "mixtral" in arch),
+            routed_scaling_factor=d.get("routed_scaling_factor", 1.0),
+            n_group=d.get("n_group", 1) or 1,
+            topk_group=d.get("topk_group", 1) or 1,
+            kv_lora_rank=d.get("kv_lora_rank", 0) if mla else 0,
+            q_lora_rank=d.get("q_lora_rank") if mla else None,
+            qk_nope_head_dim=d.get("qk_nope_head_dim", 128),
+            qk_rope_head_dim=d.get("qk_rope_head_dim", 64),
+            v_head_dim=d.get("v_head_dim", 128),
             qkv_bias="qwen2" in arch,
             # qwen2 writes sliding_window but gates it behind
             # use_sliding_window, whose HF default is False; mistral-style

@@ -5,6 +5,11 @@ The reference resolves model artifacts from the HF hub into its engines
 directly in the JAX param layout of model.py (layers stacked on a leading L
 axis for lax.scan; projection matrices stored [in, out] so the forward pass
 is x @ W with no transposes at trace time).
+
+Supported families: llama/mistral/qwen2 (dense), mixtral (MoE,
+block_sparse_moe names), deepseek V2/V3 (MLA + MoE with shared experts and
+a dense prefix; rope-interleaved checkpoints are de-interleaved here once so
+the runtime rope is plain half-split).
 """
 
 from __future__ import annotations
@@ -51,16 +56,37 @@ def _load_tensors(path: str) -> dict:
     return out
 
 
+def _deinterleave_rope_rows(w: np.ndarray, starts, dr: int) -> np.ndarray:
+    """Permute rope-dim out-rows from interleaved to half-split layout.
+
+    HF/DeepSeek checkpoints store rotary dims interleaved (re/im pairs); the
+    runtime rope is half-split, so converting once at load (out[j]=in[2j],
+    out[dr/2+j]=in[2j+1] within each rope row range) keeps the hot path free
+    of per-step permutes. ``w`` is HF [out, in]; ``starts`` are the first
+    rope row of each head's range.
+    """
+    perm = np.concatenate([np.arange(0, dr, 2), np.arange(1, dr, 2)])
+    w = np.asarray(w).copy()
+    for s in starts:
+        w[s:s + dr] = w[s:s + dr][perm]
+    return w
+
+
 def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
-    """Map HF llama/mistral/qwen2 weight names onto the model.py pytree."""
+    """Map HF llama/mistral/qwen2/mixtral/deepseek weight names onto the
+    model.py pytree."""
     import jax.numpy as jnp
 
     dtype = dtype or jnp.dtype(cfg.dtype)
     t = _load_tensors(path)
+    raw_cfg = {}
+    cfg_file = os.path.join(path, "config.json")
+    if os.path.exists(cfg_file):
+        with open(cfg_file) as f:
+            raw_cfg = json.load(f)
 
     def get(name):
-        arr = t[name]
-        return jnp.asarray(np.asarray(arr), dtype=dtype)
+        return jnp.asarray(np.asarray(t[name]), dtype=dtype)
 
     def proj(name):  # HF stores [out, in] → we want [in, out]
         return get(name).T
@@ -68,41 +94,118 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
     L = cfg.num_layers
     stack = lambda names: jnp.stack(names)  # noqa: E731
 
-    layers: dict = {
-        "attn_norm": stack([get(f"model.layers.{i}.input_layernorm.weight") for i in range(L)]),
-        "mlp_norm": stack([get(f"model.layers.{i}.post_attention_layernorm.weight") for i in range(L)]),
-        "wq": stack([proj(f"model.layers.{i}.self_attn.q_proj.weight") for i in range(L)]),
-        "wk": stack([proj(f"model.layers.{i}.self_attn.k_proj.weight") for i in range(L)]),
-        "wv": stack([proj(f"model.layers.{i}.self_attn.v_proj.weight") for i in range(L)]),
-        "wo": stack([proj(f"model.layers.{i}.self_attn.o_proj.weight") for i in range(L)]),
-    }
-    if cfg.qkv_bias:
-        layers["bq"] = stack([get(f"model.layers.{i}.self_attn.q_proj.bias") for i in range(L)])
-        layers["bk"] = stack([get(f"model.layers.{i}.self_attn.k_proj.bias") for i in range(L)])
-        layers["bv"] = stack([get(f"model.layers.{i}.self_attn.v_proj.bias") for i in range(L)])
-    if cfg.is_moe:
+    def attn_layer(i: int) -> dict:
+        pre = f"model.layers.{i}.self_attn"
+        if not cfg.is_mla:
+            out = {
+                "wq": proj(f"{pre}.q_proj.weight"),
+                "wk": proj(f"{pre}.k_proj.weight"),
+                "wv": proj(f"{pre}.v_proj.weight"),
+                "wo": proj(f"{pre}.o_proj.weight"),
+            }
+            if cfg.qkv_bias:
+                out["bq"] = get(f"{pre}.q_proj.bias")
+                out["bk"] = get(f"{pre}.k_proj.bias")
+                out["bv"] = get(f"{pre}.v_proj.bias")
+            return out
+        # --- MLA (DeepSeek) ---
+        r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+        dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
+        H = cfg.num_heads
+        interleaved = raw_cfg.get("rope_interleave", True)
+
+        kv_a = np.asarray(t[f"{pre}.kv_a_proj_with_mqa.weight"])  # [r+dr, D]
+        if interleaved:
+            kv_a = _deinterleave_rope_rows(kv_a, [r], dr)
+        q_name = (f"{pre}.q_b_proj.weight" if cfg.q_lora_rank
+                  else f"{pre}.q_proj.weight")
+        q_w = np.asarray(t[q_name])  # [H*(dn+dr), in]
+        if interleaved:
+            q_w = _deinterleave_rope_rows(
+                q_w, [h * (dn + dr) + dn for h in range(H)], dr)
+        kv_b = np.asarray(t[f"{pre}.kv_b_proj.weight"])  # [H*(dn+dv), r]
+        kv_b = kv_b.reshape(H, dn + dv, r)
+        w_uk = kv_b[:, :dn].transpose(2, 0, 1).reshape(r, H * dn)
+        w_uv = kv_b[:, dn:].transpose(2, 0, 1).reshape(r, H * dv)
+
+        out = {
+            "kv_a": jnp.asarray(kv_a, dtype=dtype).T,
+            "kv_a_norm": get(f"{pre}.kv_a_layernorm.weight"),
+            "w_uk": jnp.asarray(w_uk, dtype=dtype),
+            "w_uv": jnp.asarray(w_uv, dtype=dtype),
+            "wo": proj(f"{pre}.o_proj.weight"),
+        }
+        if cfg.q_lora_rank:
+            out["q_a"] = proj(f"{pre}.q_a_proj.weight")
+            out["q_a_norm"] = get(f"{pre}.q_a_layernorm.weight")
+            out["q_b"] = jnp.asarray(q_w, dtype=dtype).T
+        else:
+            out["wq"] = jnp.asarray(q_w, dtype=dtype).T
+        return out
+
+    def dense_mlp_layer(i: int) -> dict:
+        return {
+            "w_gate": proj(f"model.layers.{i}.mlp.gate_proj.weight"),
+            "w_up": proj(f"model.layers.{i}.mlp.up_proj.weight"),
+            "w_down": proj(f"model.layers.{i}.mlp.down_proj.weight"),
+        }
+
+    def moe_mlp_layer(i: int) -> dict:
+        import jax.numpy as jnp
+
         E = cfg.num_experts
-        layers["router"] = stack(
-            [proj(f"model.layers.{i}.block_sparse_moe.gate.weight") for i in range(L)])
-        layers["w_gate"] = stack([
-            jnp.stack([proj(f"model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight")
-                       for e in range(E)]) for i in range(L)])
-        layers["w_down"] = stack([
-            jnp.stack([proj(f"model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight")
-                       for e in range(E)]) for i in range(L)])
-        layers["w_up"] = stack([
-            jnp.stack([proj(f"model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight")
-                       for e in range(E)]) for i in range(L)])
-    else:
-        layers["w_gate"] = stack([proj(f"model.layers.{i}.mlp.gate_proj.weight") for i in range(L)])
-        layers["w_up"] = stack([proj(f"model.layers.{i}.mlp.up_proj.weight") for i in range(L)])
-        layers["w_down"] = stack([proj(f"model.layers.{i}.mlp.down_proj.weight") for i in range(L)])
+        if f"model.layers.{i}.block_sparse_moe.gate.weight" in t:  # mixtral
+            pre = f"model.layers.{i}.block_sparse_moe"
+            names = ("w1", "w2", "w3")  # gate, down, up
+            expert = lambda e, n: proj(f"{pre}.experts.{e}.{n}.weight")  # noqa: E731
+            out = {
+                "router": proj(f"{pre}.gate.weight"),
+                "router_bias": jnp.zeros((E,), jnp.float32),
+                "w_gate": jnp.stack([expert(e, "w1") for e in range(E)]),
+                "w_down": jnp.stack([expert(e, "w2") for e in range(E)]),
+                "w_up": jnp.stack([expert(e, "w3") for e in range(E)]),
+            }
+            return out
+        pre = f"model.layers.{i}.mlp"  # deepseek/qwen-moe style
+        bias_name = f"{pre}.gate.e_score_correction_bias"
+        expert = lambda e, n: proj(f"{pre}.experts.{e}.{n}.weight")  # noqa: E731
+        out = {
+            "router": proj(f"{pre}.gate.weight"),
+            "router_bias": (jnp.asarray(np.asarray(t[bias_name]), jnp.float32)
+                            if bias_name in t else jnp.zeros((E,), jnp.float32)),
+            "w_gate": jnp.stack([expert(e, "gate_proj") for e in range(E)]),
+            "w_up": jnp.stack([expert(e, "up_proj") for e in range(E)]),
+            "w_down": jnp.stack([expert(e, "down_proj") for e in range(E)]),
+        }
+        if cfg.n_shared_experts:
+            out["ws_gate"] = proj(f"{pre}.shared_experts.gate_proj.weight")
+            out["ws_up"] = proj(f"{pre}.shared_experts.up_proj.weight")
+            out["ws_down"] = proj(f"{pre}.shared_experts.down_proj.weight")
+        return out
+
+    def norm_layer(i: int) -> dict:
+        return {
+            "attn_norm": get(f"model.layers.{i}.input_layernorm.weight"),
+            "mlp_norm": get(f"model.layers.{i}.post_attention_layernorm.weight"),
+        }
+
+    k_dense = cfg.num_dense_prefix_layers
+
+    def build_stack(idxs, moe: bool) -> dict:
+        per_layer = []
+        for i in idxs:
+            d = {**norm_layer(i), **attn_layer(i)}
+            d.update(moe_mlp_layer(i) if moe else dense_mlp_layer(i))
+            per_layer.append(d)
+        return {k: stack([d[k] for d in per_layer]) for k in per_layer[0]}
 
     params = {
         "embed": get("model.embed_tokens.weight"),
-        "layers": layers,
+        "layers": build_stack(range(k_dense, L), cfg.is_moe),
         "final_norm": get("model.norm.weight"),
     }
+    if k_dense:
+        params["dense_layers"] = build_stack(range(k_dense), False)
     if not cfg.tie_word_embeddings:
         if "lm_head.weight" in t:
             params["lm_head"] = proj("lm_head.weight")

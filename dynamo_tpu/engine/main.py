@@ -136,7 +136,8 @@ async def amain():
     if cli.model_path:
         cfg = ModelConfig.from_pretrained(cli.model_path)
     else:
-        cfg = getattr(ModelConfig, cli.arch or "tiny")()
+        from dynamo_tpu.models import get_model_config
+        cfg = get_model_config(cli.arch or "tiny")
     args = EngineArgs(
         block_size=cli.block_size, num_blocks=cli.num_blocks,
         max_num_seqs=cli.max_num_seqs,

@@ -53,47 +53,137 @@ from dynamo_tpu.engine.config import ModelConfig
 # }
 
 
-def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> dict:
-    """Random-init params with correct shapes/scales (for tests and benches)."""
-    dtype = dtype or jnp.dtype(cfg.dtype)
+def _init_layer_stack(cfg: ModelConfig, key: jax.Array, n: int, moe: bool,
+                      dtype) -> dict:
+    """Random-init one stacked layer group (n layers, dense or MoE MLP)."""
     D, hd = cfg.hidden_size, cfg.head_dim
-    H, KV, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
-    F, V, E = cfg.intermediate_size, cfg.vocab_size, cfg.num_experts
-    ks = jax.random.split(key, 12)
+    H, KV = cfg.num_heads, cfg.num_kv_heads
+    F, E = cfg.intermediate_size, cfg.num_experts
+    ks = jax.random.split(key, 16)
 
     def w(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(dtype)
 
     layers = {
-        "attn_norm": jnp.ones((L, D), dtype),
-        "mlp_norm": jnp.ones((L, D), dtype),
-        "wq": w(ks[0], (L, D, H * hd), D),
-        "wk": w(ks[1], (L, D, KV * hd), D),
-        "wv": w(ks[2], (L, D, KV * hd), D),
-        "wo": w(ks[3], (L, H * hd, D), H * hd),
+        "attn_norm": jnp.ones((n, D), dtype),
+        "mlp_norm": jnp.ones((n, D), dtype),
     }
-    if cfg.qkv_bias:
-        layers["bq"] = jnp.zeros((L, H * hd), dtype)
-        layers["bk"] = jnp.zeros((L, KV * hd), dtype)
-        layers["bv"] = jnp.zeros((L, KV * hd), dtype)
-    if cfg.is_moe:
-        layers["router"] = w(ks[4], (L, D, E), D)
-        layers["w_gate"] = w(ks[5], (L, E, D, F), D)
-        layers["w_up"] = w(ks[6], (L, E, D, F), D)
-        layers["w_down"] = w(ks[7], (L, E, F, D), F)
+    if cfg.is_mla:
+        r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+        dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
+        if cfg.q_lora_rank:
+            qr = cfg.q_lora_rank
+            layers["q_a"] = w(ks[0], (n, D, qr), D)
+            layers["q_a_norm"] = jnp.ones((n, qr), dtype)
+            layers["q_b"] = w(ks[10], (n, qr, H * (dn + dr)), qr)
+        else:
+            layers["wq"] = w(ks[0], (n, D, H * (dn + dr)), D)
+        layers["kv_a"] = w(ks[1], (n, D, r + dr), D)
+        layers["kv_a_norm"] = jnp.ones((n, r), dtype)
+        layers["w_uk"] = w(ks[2], (n, r, H * dn), r)
+        layers["w_uv"] = w(ks[11], (n, r, H * dv), r)
+        layers["wo"] = w(ks[3], (n, H * dv, D), H * dv)
     else:
-        layers["w_gate"] = w(ks[5], (L, D, F), D)
-        layers["w_up"] = w(ks[6], (L, D, F), D)
-        layers["w_down"] = w(ks[7], (L, F, D), F)
+        layers["wq"] = w(ks[0], (n, D, H * hd), D)
+        layers["wk"] = w(ks[1], (n, D, KV * hd), D)
+        layers["wv"] = w(ks[2], (n, D, KV * hd), D)
+        layers["wo"] = w(ks[3], (n, H * hd, D), H * hd)
+        if cfg.qkv_bias:
+            layers["bq"] = jnp.zeros((n, H * hd), dtype)
+            layers["bk"] = jnp.zeros((n, KV * hd), dtype)
+            layers["bv"] = jnp.zeros((n, KV * hd), dtype)
+    if moe:
+        Fm = cfg.moe_ffn_size
+        layers["router"] = w(ks[4], (n, D, E), D)
+        layers["router_bias"] = jnp.zeros((n, E), jnp.float32)
+        layers["w_gate"] = w(ks[5], (n, E, D, Fm), D)
+        layers["w_up"] = w(ks[6], (n, E, D, Fm), D)
+        layers["w_down"] = w(ks[7], (n, E, Fm, D), Fm)
+        if cfg.n_shared_experts:
+            Fs = cfg.n_shared_experts * Fm
+            layers["ws_gate"] = w(ks[12], (n, D, Fs), D)
+            layers["ws_up"] = w(ks[13], (n, D, Fs), D)
+            layers["ws_down"] = w(ks[14], (n, Fs, D), Fs)
+    else:
+        layers["w_gate"] = w(ks[5], (n, D, F), D)
+        layers["w_up"] = w(ks[6], (n, D, F), D)
+        layers["w_down"] = w(ks[7], (n, F, D), F)
+    return layers
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> dict:
+    """Random-init params with correct shapes/scales (for tests and benches).
+
+    MoE models with a dense prefix (DeepSeek first_k_dense_replace) get a
+    separate ``dense_layers`` stack — layer stacks must be shape-uniform for
+    lax.scan, and the dense prefix's MLP weights differ from the experts'.
+    """
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    D, V, L = cfg.hidden_size, cfg.vocab_size, cfg.num_layers
+    k_dense = cfg.num_dense_prefix_layers
+    ks = jax.random.split(key, 4)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(dtype)
 
     params = {
-        "embed": w(ks[8], (V, D), D),
-        "layers": layers,
+        "embed": w(ks[0], (V, D), D),
+        "layers": _init_layer_stack(cfg, ks[1], L - k_dense, cfg.is_moe, dtype),
         "final_norm": jnp.ones((D,), dtype),
     }
+    if k_dense:
+        params["dense_layers"] = _init_layer_stack(cfg, ks[2], k_dense, False, dtype)
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = w(ks[9], (D, V), D)
+        params["lm_head"] = w(ks[3], (D, V), D)
     return params
+
+
+def _layer_stack_shardings(cfg: ModelConfig, mesh: Mesh, moe: bool) -> dict:
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layers = {
+        "attn_norm": ns(None, None),
+        "mlp_norm": ns(None, None),
+    }
+    if cfg.is_mla:
+        # heads shard on tp via the H-major output dims; latent-rank
+        # projections (q_a / kv_a) replicate — they are small and shared
+        if cfg.q_lora_rank:
+            layers["q_a"] = ns(None, None, None)
+            layers["q_a_norm"] = ns(None, None)
+            layers["q_b"] = ns(None, None, "tp")
+        else:
+            layers["wq"] = ns(None, None, "tp")
+        layers["kv_a"] = ns(None, None, None)
+        layers["kv_a_norm"] = ns(None, None)
+        layers["w_uk"] = ns(None, None, "tp")
+        layers["w_uv"] = ns(None, None, "tp")
+        layers["wo"] = ns(None, "tp", None)
+    else:
+        layers["wq"] = ns(None, None, "tp")
+        layers["wk"] = ns(None, None, "tp")
+        layers["wv"] = ns(None, None, "tp")
+        layers["wo"] = ns(None, "tp", None)
+        if cfg.qkv_bias:
+            layers["bq"] = ns(None, "tp")
+            layers["bk"] = ns(None, "tp")
+            layers["bv"] = ns(None, "tp")
+    if moe:
+        layers["router"] = ns(None, None, None)
+        layers["router_bias"] = ns(None, None)
+        layers["w_gate"] = ns(None, "tp", None, None)  # experts over tp (EP)
+        layers["w_up"] = ns(None, "tp", None, None)
+        layers["w_down"] = ns(None, "tp", None, None)
+        if cfg.n_shared_experts:
+            layers["ws_gate"] = ns(None, None, "tp")
+            layers["ws_up"] = ns(None, None, "tp")
+            layers["ws_down"] = ns(None, "tp", None)
+    else:
+        layers["w_gate"] = ns(None, None, "tp")
+        layers["w_up"] = ns(None, None, "tp")
+        layers["w_down"] = ns(None, "tp", None)
+    return layers
 
 
 def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
@@ -104,40 +194,26 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
-    layers = {
-        "attn_norm": ns(None, None),
-        "mlp_norm": ns(None, None),
-        "wq": ns(None, None, "tp"),
-        "wk": ns(None, None, "tp"),
-        "wv": ns(None, None, "tp"),
-        "wo": ns(None, "tp", None),
-    }
-    if cfg.qkv_bias:
-        layers["bq"] = ns(None, "tp")
-        layers["bk"] = ns(None, "tp")
-        layers["bv"] = ns(None, "tp")
-    if cfg.is_moe:
-        layers["router"] = ns(None, None, None)
-        layers["w_gate"] = ns(None, "tp", None, None)  # experts over tp (EP)
-        layers["w_up"] = ns(None, "tp", None, None)
-        layers["w_down"] = ns(None, "tp", None, None)
-    else:
-        layers["w_gate"] = ns(None, None, "tp")
-        layers["w_up"] = ns(None, None, "tp")
-        layers["w_down"] = ns(None, "tp", None)
-
     out = {
         "embed": ns(None, None),
-        "layers": layers,
+        "layers": _layer_stack_shardings(cfg, mesh, cfg.is_moe),
         "final_norm": ns(None),
     }
+    if cfg.num_dense_prefix_layers:
+        out["dense_layers"] = _layer_stack_shardings(cfg, mesh, False)
     if not cfg.tie_word_embeddings:
         out["lm_head"] = ns(None, "tp")
     return out
 
 
-def cache_shardings(mesh: Mesh) -> NamedSharding:
-    """KV cache [L, num_slots, KV, hd]: heads sharded on tp, replicated on dp."""
+def cache_shardings(mesh: Mesh, cfg: Optional[ModelConfig] = None) -> NamedSharding:
+    """KV cache [L, num_slots, KV, hd]: heads sharded on tp, replicated on dp.
+
+    MLA's latent cache has a single shared "head" — it rides replicated
+    (the well-known MLA/TP property; the latent is tiny, ~576 dims/token).
+    """
+    if cfg is not None and cfg.is_mla:
+        return NamedSharding(mesh, P(None, None, None, None))
     return NamedSharding(mesh, P(None, None, "tp", None))
 
 
@@ -217,9 +293,116 @@ def _paged_attention(q, k_cache, v_cache, lidx, block_tables, positions,
     return out.reshape(B, S, H, hd).astype(q.dtype)
 
 
+def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
+                   kv_lens, cfg: ModelConfig, block_size: int):
+    """Multi-head latent attention (DeepSeek V2/V3) over the paged latent
+    cache — the weight-ABSORBED formulation throughout.
+
+    The cache stores per token only the normalized latent c [kv_lora_rank]
+    (in k_cache) and the shared post-RoPE k_rot [qk_rope_head_dim] (in
+    v_cache). Queries are absorbed through W_UK so scores are computed in
+    latent space (q_eff·c + q_rot·k_rot), and the output latent is expanded
+    through W_UV — K/V are never materialized per gathered token, which is
+    the whole point of MLA's cache compression. RoPE convention is
+    half-split; checkpoints with interleaved rope dims are de-interleaved at
+    load time (loader.py). Returns (attn [B,S,H*v_head_dim], kc, vc).
+
+    ref capability: recipes/deepseek-r1/sglang-wideep (the reference serves
+    DeepSeek via engine-internal MLA; here it is native).
+    """
+    B, S, D = h.shape
+    H = cfg.num_heads
+    r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
+
+    if "q_b" in lp:
+        q = _rms_norm(h @ lp["q_a"], lp["q_a_norm"], cfg.rms_norm_eps) @ lp["q_b"]
+    else:
+        q = h @ lp["wq"]
+    q = q.reshape(B, S, H, dn + dr)
+    q_nope, q_rot = q[..., :dn], q[..., dn:]
+    q_rot = _rope(q_rot, positions, cfg.rope_theta)
+
+    ckv = h @ lp["kv_a"]  # [B,S,r+dr]
+    c = _rms_norm(ckv[..., :r], lp["kv_a_norm"], cfg.rms_norm_eps)
+    k_rot = _rope(ckv[..., None, r:], positions, cfg.rope_theta)  # [B,S,1,dr]
+
+    flat = slot_map.reshape(B * S)
+    kc = kc.at[lidx, flat].set(c.reshape(B * S, 1, r), mode="drop")
+    vc = vc.at[lidx, flat].set(k_rot.reshape(B * S, 1, dr), mode="drop")
+
+    W = block_tables.shape[1]
+    T = W * block_size
+    slot_idx = (block_tables[:, :, None] * block_size
+                + jnp.arange(block_size)[None, None, :]).reshape(B, T)
+    cg = kc[lidx, slot_idx][:, :, 0].astype(jnp.float32)   # [B,T,r]
+    krg = vc[lidx, slot_idx][:, :, 0].astype(jnp.float32)  # [B,T,dr]
+
+    w_uk = lp["w_uk"].reshape(r, H, dn).astype(jnp.float32)
+    q_eff = jnp.einsum("bshd,rhd->bshr", q_nope.astype(jnp.float32), w_uk)
+    scores = (jnp.einsum("bshr,btr->bhst", q_eff, cg)
+              + jnp.einsum("bshd,btd->bhst", q_rot.astype(jnp.float32), krg))
+    scores = scores / np.sqrt(dn + dr)
+
+    key_pos = jnp.arange(T)
+    mask = (key_pos[None, None, :] <= positions[:, :, None]) & (
+        key_pos[None, None, :] < kv_lens[:, None, None])  # [B,S,T]
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o_lat = jnp.einsum("bhst,btr->bshr", probs, cg)
+    w_uv = lp["w_uv"].reshape(r, H, dv).astype(jnp.float32)
+    out = jnp.einsum("bshr,rhd->bshd", o_lat, w_uv)
+    return out.reshape(B, S, H * dv).astype(h.dtype), kc, vc
+
+
 def _mlp_dense(x, lp):
     h = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
     return h @ lp["w_down"]
+
+
+def _router_weights(xf, router_w, router_bias, cfg: ModelConfig):
+    """Token→expert combine weights [N, E] (f32), zero for unrouted experts.
+
+    Two scoring disciplines (ref workloads: Mixtral recipes use softmax;
+    DeepSeek-V3 wide-EP uses sigmoid — recipes/deepseek-r1/sglang-wideep):
+    - softmax: softmax over ALL expert logits, gather the top-k probs
+      (Mixtral AND DeepSeek-V2 semantics — they differ only in
+      norm_topk_prob: Mixtral renormalizes the gathered probs, V2 uses
+      them raw scaled by routed_scaling_factor).
+    - sigmoid: sigmoid scores; expert CHOICE adds e_score_correction_bias
+      and optionally restricts to the best ``topk_group`` of ``n_group``
+      expert groups (group score = sum of each group's top-2 choice scores,
+      masked groups contribute 0.0 — DeepSeek-V3 semantics exactly); the
+      WEIGHTS are the raw sigmoid scores at the chosen experts, optionally
+      sum-normalized, scaled by routed_scaling_factor.
+    """
+    N = xf.shape[0]
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    logits = (xf @ router_w).astype(jnp.float32)  # [N, E]
+    if cfg.scoring_func == "sigmoid":
+        scores = jax.nn.sigmoid(logits)
+        choice = scores + router_bias[None, :]
+        if cfg.n_group > 1:
+            G = cfg.n_group
+            gs = choice.reshape(N, G, E // G)
+            group_scores = jax.lax.top_k(gs, 2)[0].sum(-1)  # [N, G]
+            _, gi = jax.lax.top_k(group_scores, cfg.topk_group)
+            gmask = jnp.zeros((N, G), bool).at[jnp.arange(N)[:, None], gi].set(True)
+            choice = jnp.where(
+                jnp.repeat(gmask, E // G, axis=1), choice, 0.0)
+        _, topi = jax.lax.top_k(choice, K)
+        gates = jnp.take_along_axis(scores, topi, axis=1)
+        if cfg.norm_topk_prob:
+            gates = gates / (gates.sum(-1, keepdims=True) + 1e-20)
+        gates = gates * cfg.routed_scaling_factor
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
+        gates, topi = jax.lax.top_k(probs, K)
+        if cfg.norm_topk_prob:
+            gates = gates / (gates.sum(-1, keepdims=True) + 1e-20)
+        gates = gates * cfg.routed_scaling_factor
+    return jnp.zeros((N, E), jnp.float32).at[
+        jnp.arange(N)[:, None], topi].add(gates)
 
 
 def moe_capacity(n_tokens: int, num_experts: int, top_k: int,
@@ -234,7 +417,7 @@ def moe_capacity(n_tokens: int, num_experts: int, top_k: int,
     return min(n_tokens, max(avg, min(n_tokens, 16), 1))
 
 
-def _mlp_moe_ep(x, router_w, wg, wu, wd, *, cfg: ModelConfig,
+def _mlp_moe_ep(x, router_w, router_bias, wg, wu, wd, *, cfg: ModelConfig,
                 axis_name: str = "tp"):
     """Expert-parallel MoE (shard_map body over the expert axis).
 
@@ -259,11 +442,7 @@ def _mlp_moe_ep(x, router_w, wg, wu, wd, *, cfg: ModelConfig,
     E_local = wg.shape[0]
 
     xf = x.reshape(N, D)
-    logits = (xf @ router_w).astype(jnp.float32)  # [N, E]
-    topv, topi = jax.lax.top_k(logits, K)
-    gates = jax.nn.softmax(topv, axis=-1)
-    cw = jnp.zeros((N, E), jnp.float32).at[
-        jnp.arange(N)[:, None], topi].add(gates)
+    cw = _router_weights(xf, router_w, router_bias, cfg)
     local = jax.lax.dynamic_slice_in_dim(cw, idx * E_local, E_local, axis=1)
 
     C = moe_capacity(N, E, K, cfg.moe_capacity_factor)
@@ -285,12 +464,12 @@ def _mlp_moe_ep(x, router_w, wg, wu, wd, *, cfg: ModelConfig,
 
 def make_moe_ep_fn(cfg: ModelConfig, mesh: Mesh, axis_name: str = "tp"):
     """The production shard_map wiring for the EP MoE dispatch —
-    (x, router_w, wg, wu, wd) -> [B,S,D]; used by forward and by tests so
-    specs cannot drift between them."""
+    (x, router_w, router_bias, wg, wu, wd) -> [B,S,D]; used by forward and
+    by tests so specs cannot drift between them."""
     fn = functools.partial(_mlp_moe_ep, cfg=cfg, axis_name=axis_name)
     return jax.shard_map(
         fn, mesh=mesh,
-        in_specs=(P("dp", None, None), P(None, None),
+        in_specs=(P("dp", None, None), P(None, None), P(None),
                   P(axis_name, None, None), P(axis_name, None, None),
                   P(axis_name, None, None)),
         out_specs=P("dp", None, None), check_vma=False)
@@ -306,14 +485,8 @@ def _mlp_moe(x, lp, cfg: ModelConfig):
     of E).
     """
     B, S, D = x.shape
-    E, K = cfg.num_experts, cfg.num_experts_per_tok
-    logits = (x @ lp["router"]).astype(jnp.float32)  # [B,S,E]
-    topv, topi = jax.lax.top_k(logits, K)
-    gates = jax.nn.softmax(topv, axis=-1)  # [B,S,K]
-    # combine weights [B,S,E]
-    cw = jnp.zeros_like(logits).at[
-        jnp.arange(B)[:, None, None], jnp.arange(S)[None, :, None], topi
-    ].add(gates)
+    cw = _router_weights(x.reshape(B * S, D), lp["router"],
+                         lp["router_bias"], cfg).reshape(B, S, -1)
     # all-experts compute: [E,B,S,F] — fine for modest E; EP shards E over tp
     h = jnp.einsum("bsd,edf->ebsf", x, lp["w_gate"])
     u = jnp.einsum("bsd,edf->ebsf", x, lp["w_up"])
@@ -385,13 +558,24 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
 
     x = params["embed"][tokens]  # [B,S,D]
 
-    def layer(carry, xs):
+    def make_layer(moe: bool):
+        def layer(carry, xs):
+            return _layer_body(carry, xs, moe)
+        return layer
+
+    def _layer_body(carry, xs, moe):
         # caches ride the scan CARRY with indexed in-place updates — as scan
         # xs/ys XLA materializes fresh stacked outputs, i.e. a full cache
         # copy per step (measured: burst time scaled with cache size)
         x, kc, vc = carry
         lp, lidx = xs
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        if cfg.is_mla:
+            attn_flat, kc, vc = _mla_attention(
+                h, lp, lidx, kc, vc, slot_map, block_tables, positions,
+                kv_lens, cfg, block_size)
+            x = x + attn_flat @ lp["wo"]
+            return _mlp_epilogue(x, kc, vc, lp, moe)
         q = h @ lp["wq"]
         k = h @ lp["wk"]
         v = h @ lp["wv"]
@@ -482,9 +666,13 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             attn = _paged_attention(q, kc, vc, lidx, block_tables, positions,
                                     kv_lens, cfg, block_size)
         x = x + attn.reshape(B, S, H * hd) @ lp["wo"]
+        return _mlp_epilogue(x, kc, vc, lp, moe)
 
+    def _mlp_epilogue(x, kc, vc, lp, moe):
+        tp_n = mesh.shape.get("tp", 1) if mesh is not None else 1
+        dp_ok = mesh is None or B % mesh.shape.get("dp", 1) == 0
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        if cfg.is_moe:
+        if moe:
             ep_want = mesh is not None and tp_n > 1
             ep_ok = (ep_want and dp_ok and cfg.num_experts % tp_n == 0)
             if ep_want and not ep_ok:
@@ -494,17 +682,28 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                     B, cfg.num_experts, tp_n)
             if ep_ok:
                 fn = make_moe_ep_fn(cfg, mesh)
-                x = x + fn(h, lp["router"], lp["w_gate"], lp["w_up"],
-                           lp["w_down"])
+                x = x + fn(h, lp["router"], lp["router_bias"], lp["w_gate"],
+                           lp["w_up"], lp["w_down"])
             else:
                 x = x + _mlp_moe(h, lp, cfg)
+            if cfg.n_shared_experts:  # DeepSeek: dense shared experts on top
+                x = x + _mlp_dense(h, {"w_gate": lp["ws_gate"],
+                                       "w_up": lp["ws_up"],
+                                       "w_down": lp["ws_down"]})
         else:
             x = x + _mlp_dense(h, lp)
         return (x, kc, vc), None
 
-    (x, k_cache, v_cache), _ = jax.lax.scan(
-        layer, (x, k_cache, v_cache),
-        (params["layers"], jnp.arange(cfg.num_layers)))
+    k_dense = cfg.num_dense_prefix_layers
+    carry = (x, k_cache, v_cache)
+    if k_dense:
+        carry, _ = jax.lax.scan(
+            make_layer(False), carry,
+            (params["dense_layers"], jnp.arange(k_dense)))
+    carry, _ = jax.lax.scan(
+        make_layer(cfg.is_moe), carry,
+        (params["layers"], k_dense + jnp.arange(cfg.num_layers - k_dense)))
+    (x, k_cache, v_cache) = carry
 
     x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     x_last = x[jnp.arange(B), last_idx]  # [B, D]
@@ -524,6 +723,10 @@ def embedding_forward(params, tokens, lengths, *, cfg: ModelConfig):
     zero interaction with the serving cache/pool. Returns [B, D] f32,
     L2-normalized mean over each row's valid positions.
     """
+    if cfg.is_mla or cfg.num_dense_prefix_layers:
+        raise NotImplementedError(
+            "embedding_forward covers the MHA/GQA families; serve embeddings "
+            "from a dense model (MLA/dense-prefix MoE are generation-only)")
     B, S = tokens.shape
     D, hd = cfg.hidden_size, cfg.head_dim
     H, KV = cfg.num_heads, cfg.num_kv_heads
@@ -624,6 +827,8 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
     """
     from dynamo_tpu.ops.paged_attention import pallas_supported
 
+    if cfg.is_mla:  # MLA attends in latent space — its own XLA path for now
+        return False, False
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     heads_ok = (cfg.num_kv_heads % tp == 0 and cfg.num_heads % tp == 0
                 and cfg.num_heads % cfg.num_kv_heads == 0)

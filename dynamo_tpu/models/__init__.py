@@ -3,11 +3,12 @@
 The engine's forward pass (engine/model.py) natively covers the llama
 decoder family — RoPE + RMSNorm + GQA paged attention, SwiGLU MLP — plus
 token-choice MoE (Mixtral-style, experts shardable over "tp" = EP),
-sliding-window attention (Mistral), and QKV bias (Qwen2). Presets below are
-the shapes used by the reference's recipes (ref: recipes/llama-3-70b,
-recipes/deepseek-r1, recipes/gpt-oss-120b) where the architecture is
-supported; unsupported attention variants (DeepSeek MLA) are documented as
-gaps rather than approximated silently.
+sliding-window attention (Mistral), QKV bias (Qwen2), and MLA — multi-head
+latent attention with a compressed paged cache (DeepSeek V2/V3, incl.
+sigmoid + group-limited routing, shared experts, and the dense layer
+prefix). Presets below are the shapes used by the reference's recipes (ref:
+recipes/llama-3-70b, recipes/deepseek-r1, recipes/gpt-oss-120b); unsupported
+architectures fail loudly rather than being approximated silently.
 """
 
 from __future__ import annotations
@@ -33,7 +34,8 @@ def mixtral_8x7b() -> ModelConfig:
     return ModelConfig(
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=1000000.0,
-        max_position_embeddings=32768, num_experts=8, num_experts_per_tok=2)
+        max_position_embeddings=32768, num_experts=8, num_experts_per_tok=2,
+        norm_topk_prob=True)  # Mixtral renormalizes the top-k gate probs
 
 
 def moe_tiny() -> ModelConfig:
@@ -41,7 +43,53 @@ def moe_tiny() -> ModelConfig:
     return ModelConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
         num_heads=4, num_kv_heads=2, dtype="float32",
-        num_experts=4, num_experts_per_tok=2, max_position_embeddings=512)
+        num_experts=4, num_experts_per_tok=2, max_position_embeddings=512,
+        norm_topk_prob=True)
+
+
+def mla_tiny() -> ModelConfig:
+    """Small MLA+MoE (DeepSeek-V3 shaped) for tests of the latent-cache path."""
+    return ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=3,
+        num_heads=4, num_kv_heads=4, dtype="float32",
+        max_position_embeddings=512,
+        kv_lora_rank=32, q_lora_rank=48, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16,
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+        n_shared_experts=1, first_k_dense_replace=1, scoring_func="sigmoid",
+        norm_topk_prob=True, routed_scaling_factor=2.5, n_group=2,
+        topk_group=1, moe_capacity_factor=4.0)
+
+
+def deepseek_v2_lite() -> ModelConfig:
+    """DeepSeek-V2-Lite (15.7B total / 2.4B active): MLA without q
+    compression, softmax routing, 2 shared experts."""
+    return ModelConfig(
+        vocab_size=102400, hidden_size=2048, intermediate_size=10944,
+        num_layers=27, num_heads=16, num_kv_heads=16, rope_theta=10000.0,
+        max_position_embeddings=4096,
+        kv_lora_rank=512, q_lora_rank=None, qk_nope_head_dim=128,
+        qk_rope_head_dim=64, v_head_dim=128,
+        num_experts=64, num_experts_per_tok=6, moe_intermediate_size=1408,
+        n_shared_experts=2, first_k_dense_replace=1,
+        scoring_func="softmax", norm_topk_prob=False,
+        routed_scaling_factor=1.0)
+
+
+def deepseek_v3() -> ModelConfig:
+    """DeepSeek-V3/R1 (671B total / 37B active): MLA with q compression,
+    sigmoid + group-limited routing (ref flagship:
+    recipes/deepseek-r1/sglang-wideep/tep16p-dep16d-disagg.yaml)."""
+    return ModelConfig(
+        vocab_size=129280, hidden_size=7168, intermediate_size=18432,
+        num_layers=61, num_heads=128, num_kv_heads=128, rope_theta=10000.0,
+        max_position_embeddings=4096,
+        kv_lora_rank=512, q_lora_rank=1536, qk_nope_head_dim=128,
+        qk_rope_head_dim=64, v_head_dim=128,
+        num_experts=256, num_experts_per_tok=8, moe_intermediate_size=2048,
+        n_shared_experts=1, first_k_dense_replace=3,
+        scoring_func="sigmoid", norm_topk_prob=True,
+        routed_scaling_factor=2.5, n_group=8, topk_group=4)
 
 
 PRESETS = {
@@ -53,13 +101,17 @@ PRESETS = {
     "mistral_7b": mistral_7b,
     "qwen2_7b": qwen2_7b,
     "mixtral_8x7b": mixtral_8x7b,
+    "mla_tiny": mla_tiny,
+    "deepseek_v2_lite": deepseek_v2_lite,
+    "deepseek_v3": deepseek_v3,
 }
 
-#: architectures the forward pass does NOT cover yet (round-1 gaps —
-#: listed so callers fail loudly instead of serving wrong numerics)
+#: architectures the forward pass does NOT cover yet (listed so callers
+#: fail loudly instead of serving wrong numerics). DeepSeek V2/V3 (MLA)
+#: graduated from this map in round 2 — engine/model.py:_mla_attention.
 UNSUPPORTED = {
-    "DeepseekV2ForCausalLM": "MLA attention not implemented",
-    "DeepseekV3ForCausalLM": "MLA attention not implemented",
+    "MambaForCausalLM": "state-space layers not implemented",
+    "JambaForCausalLM": "state-space layers not implemented",
 }
 
 

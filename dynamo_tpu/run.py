@@ -94,7 +94,8 @@ async def start_worker(runtime, out: str, cli):
             "no --model-path: serving RANDOM weights with the toy test "
             "tokenizer and eos=[2] — demo/smoke only")
         eos = [2]
-        cfg = getattr(ModelConfig, cli.arch)()
+        from dynamo_tpu.models import get_model_config
+        cfg = get_model_config(cli.arch)
         params = None
     eargs = EngineArgs(multi_step_decode=cli.multi_step_decode,
                        use_pallas_attention=cli.use_pallas_attention)

@@ -326,6 +326,19 @@ class Client:
             logger.info("instance %x restored", instance_id)
         self._down.discard(instance_id)
 
+    async def start_health_checks(self, payload=None):
+        """Start a canary health-check manager on this client, with cadence
+        and threshold from the layered RuntimeConfig
+        (``DYN_HEALTH_CHECK_INTERVAL`` / ``DYN_HEALTH_CHECK_FAILURES`` —
+        ref: health_check.rs driven by DYN_* config). Returns the manager
+        (caller stops it via ``await mgr.stop()``)."""
+        from dynamo_tpu.runtime.health_check import (
+            HealthCheckConfig, HealthCheckManager,
+        )
+
+        cfg = HealthCheckConfig.from_runtime(self._runtime.config, payload)
+        return await HealthCheckManager(self, cfg).start()
+
     async def wait_for_instances(self, timeout: float = 30.0) -> list[int]:
         deadline = asyncio.get_running_loop().time() + timeout
         while True:
@@ -397,7 +410,8 @@ class Client:
             {"ctx": ctx.to_wire(), "conn": info.to_wire(), "req": request}
         )
         try:
-            ack = await rt.plane.request(inst.subject, envelope, timeout=10.0)
+            ack = await rt.plane.request(inst.subject, envelope,
+                                         timeout=rt.config.request_timeout)
         except NoRespondersError:
             server.abandon_stream(info)
             raise

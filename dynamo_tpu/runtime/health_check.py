@@ -52,6 +52,16 @@ class HealthCheckConfig:
     #: valid 1-token generate request)
     payload: Any = field(default_factory=default_canary_payload)
 
+    @staticmethod
+    def from_runtime(config, payload: Any = None) -> "HealthCheckConfig":
+        """Derive probe cadence/threshold from the layered RuntimeConfig
+        (``DYN_HEALTH_CHECK_INTERVAL`` / ``DYN_HEALTH_CHECK_FAILURES``)."""
+        kw = dict(check_interval_s=config.health_check_interval,
+                  failure_threshold=config.health_check_failures)
+        if payload is not None:
+            kw["payload"] = payload
+        return HealthCheckConfig(**kw)
+
 
 class HealthCheckManager:
     """Probes every instance of one endpoint client on a timer."""

@@ -50,6 +50,12 @@ class DistributedRuntime:
 
         self.tracker = TaskTracker(
             "runtime", on_shutdown=self._shutdown_event.set)
+        # per-runtime metrics registry, exposed by the system status server
+        # (ref: lib/runtime/src/metrics.rs registry-per-DRT)
+        from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        self._system_runner = None
 
     def record_registration(self, key: str, value: bytes) -> None:
         self._registrations[key] = value
@@ -80,7 +86,40 @@ class DistributedRuntime:
             else:
                 plane = LocalControlPlane()
                 logger.info("running with in-process control plane")
-        return DistributedRuntime(plane, config, owns)
+        rt = DistributedRuntime(plane, config, owns)
+        if config.system_port:
+            await rt._start_system_server(config.system_port)
+        return rt
+
+    async def _start_system_server(self, port: int) -> None:
+        """System status server: /health, /live, /metrics (ref:
+        system_status_server.rs:1-811, enabled by DYN_SYSTEM_PORT here vs
+        the reference's DYN_SYSTEM_ENABLED)."""
+        from aiohttp import web
+
+        async def health(_):
+            return web.json_response({
+                "status": "ready" if not self._shutdown_event.is_set()
+                else "shutting_down",
+                "endpoints": sorted(self._local_endpoints),
+                "inflight": self.tracker.inflight,
+            })
+
+        async def live(_):
+            return web.json_response({"live": True})
+
+        async def metrics(_):
+            return web.Response(text=self.metrics.render(),
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/health", health)
+        app.router.add_get("/live", live)
+        app.router.add_get("/metrics", metrics)
+        self._system_runner = web.AppRunner(app, access_log=None)
+        await self._system_runner.setup()
+        await web.TCPSite(self._system_runner, "0.0.0.0", port).start()
+        logger.info("system status server on :%d", port)
 
     def namespace(self, name: Optional[str] = None) -> Namespace:
         return Namespace(self, name or self.config.namespace)
@@ -205,6 +244,8 @@ class DistributedRuntime:
                 pass
         if self._response_server:
             await self._response_server.stop()
+        if self._system_runner is not None:
+            await self._system_runner.cleanup()
         if self._owns_plane:
             await self.plane.close()
         logger.info("runtime shut down")

@@ -52,6 +52,9 @@ class TaskTracker:
     def child(self, name: str,
               max_concurrency: Optional[int] = None) -> "TaskTracker":
         c = TaskTracker(f"{self.name}/{name}", max_concurrency, parent=self)
+        # a child born after join() inherits the drained state — otherwise
+        # its spawns would escape the structured-concurrency guarantee
+        c._closed = self._closed
         self._children.append(c)
         return c
 

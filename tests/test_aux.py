@@ -177,3 +177,60 @@ async def test_run_batch_entrypoint(tmp_path):
     assert lines[0]["choices"][0]["finish_reason"] == "length"
     assert lines[1]["object"] == "text_completion"
     assert lines[1]["choices"][0]["finish_reason"] == "length"
+
+
+async def test_system_status_server_and_config_wiring():
+    """DYN_SYSTEM_PORT starts the /health /live /metrics server on the
+    runtime (ref: system_status_server.rs); health-check knobs flow from
+    RuntimeConfig into HealthCheckConfig.from_runtime."""
+    import aiohttp
+
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.health_check import HealthCheckConfig
+    from dynamo_tpu.runtime.runtime import DistributedRuntime
+
+    rc = RuntimeConfig.load(env={"DYN_SYSTEM_PORT": "18977",
+                                 "DYN_HEALTH_CHECK_INTERVAL": "7.5",
+                                 "DYN_HEALTH_CHECK_FAILURES": "5"})
+    hc = HealthCheckConfig.from_runtime(rc)
+    assert hc.check_interval_s == 7.5 and hc.failure_threshold == 5
+
+    rt = await DistributedRuntime.create(config=rc)
+    try:
+        rt.metrics.counter("aux_test_total", "test").inc(3)
+        async with aiohttp.ClientSession() as s:
+            async with s.get("http://127.0.0.1:18977/health") as r:
+                assert (await r.json())["status"] == "ready"
+            async with s.get("http://127.0.0.1:18977/live") as r:
+                assert (await r.json())["live"] is True
+            async with s.get("http://127.0.0.1:18977/metrics") as r:
+                body = await r.text()
+                assert "dynamo_aux_test_total 3" in body
+                assert "dynamo_uptime_seconds" in body
+    finally:
+        await rt.shutdown()
+
+
+async def test_tracker_child_after_join_is_closed():
+    """A child created after join() must refuse spawns (structured
+    concurrency cannot leak past the shutdown drain)."""
+    import pytest as _pytest
+
+    from dynamo_tpu.runtime.tasks import TaskTracker
+
+    t = TaskTracker("root")
+    ran = []
+
+    async def work():
+        ran.append(1)
+
+    t.spawn(work())
+    await t.join()
+    late = t.child("late")
+
+    async def never():
+        ran.append(2)
+
+    with _pytest.raises(RuntimeError):
+        late.spawn(never())
+    assert ran == [1]

@@ -25,7 +25,20 @@ def test_presets_resolve():
 def test_unsupported_arch_fails_loudly():
     with pytest.raises(NotImplementedError):
         models.from_hf_config(
-            {"architectures": ["DeepseekV3ForCausalLM"], "vocab_size": 100})
+            {"architectures": ["MambaForCausalLM"], "vocab_size": 100})
+
+
+def test_deepseek_arch_now_supported():
+    """DeepSeek graduated from the UNSUPPORTED map in round 2 (MLA)."""
+    cfg = models.from_hf_config({
+        "architectures": ["DeepseekV3ForCausalLM"], "vocab_size": 100,
+        "kv_lora_rank": 512, "q_lora_rank": 1536, "n_routed_experts": 256,
+        "n_shared_experts": 1, "first_k_dense_replace": 3,
+        "norm_topk_prob": True, "routed_scaling_factor": 2.5,
+        "n_group": 8, "topk_group": 4, "moe_intermediate_size": 2048,
+    })
+    assert cfg.is_mla and cfg.scoring_func == "sigmoid"
+    assert cfg.n_group == 8 and cfg.n_shared_experts == 1
 
 
 def test_hf_mapping_round_trip():
@@ -87,6 +100,7 @@ def test_moe_ep_matches_dense_einsum():
     x = jax.random.normal(ks[0], (B, S, D), jnp.float32)
     lp = {
         "router": jax.random.normal(ks[1], (D, E)) * 0.5,
+        "router_bias": jnp.zeros((E,), jnp.float32),
         "w_gate": jax.random.normal(ks[2], (E, D, F)) / np.sqrt(D),
         "w_up": jax.random.normal(ks[3], (E, D, F)) / np.sqrt(D),
         "w_down": jax.random.normal(ks[4], (E, F, D)) / np.sqrt(F),
@@ -95,7 +109,8 @@ def test_moe_ep_matches_dense_einsum():
 
     mesh = make_mesh(MeshConfig(dp=1, sp=1, tp=2))
     fn = M.make_moe_ep_fn(cfg, mesh)  # the production wiring
-    got = fn(x, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"])
+    got = fn(x, lp["router"], lp["router_bias"], lp["w_gate"], lp["w_up"],
+             lp["w_down"])
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-5, rtol=1e-5)
 
