@@ -379,28 +379,32 @@ def _router_weights(xf, router_w, router_bias, cfg: ModelConfig):
     N = xf.shape[0]
     E, K = cfg.num_experts, cfg.num_experts_per_tok
     logits = (xf @ router_w).astype(jnp.float32)  # [N, E]
+
+    def group_mask(choice, group_score_fn):
+        """Zero out experts outside the best ``topk_group`` groups."""
+        G = cfg.n_group
+        group_scores = group_score_fn(choice.reshape(N, G, E // G))  # [N, G]
+        _, gi = jax.lax.top_k(group_scores, cfg.topk_group)
+        gmask = jnp.zeros((N, G), bool).at[jnp.arange(N)[:, None], gi].set(True)
+        return jnp.where(jnp.repeat(gmask, E // G, axis=1), choice, 0.0)
+
     if cfg.scoring_func == "sigmoid":
         scores = jax.nn.sigmoid(logits)
         choice = scores + router_bias[None, :]
-        if cfg.n_group > 1:
-            G = cfg.n_group
-            gs = choice.reshape(N, G, E // G)
-            group_scores = jax.lax.top_k(gs, 2)[0].sum(-1)  # [N, G]
-            _, gi = jax.lax.top_k(group_scores, cfg.topk_group)
-            gmask = jnp.zeros((N, G), bool).at[jnp.arange(N)[:, None], gi].set(True)
-            choice = jnp.where(
-                jnp.repeat(gmask, E // G, axis=1), choice, 0.0)
+        if cfg.n_group > 1:  # V3: group score = sum of the group's top-2
+            choice = group_mask(choice, lambda g: jax.lax.top_k(g, 2)[0].sum(-1))
         _, topi = jax.lax.top_k(choice, K)
         gates = jnp.take_along_axis(scores, topi, axis=1)
-        if cfg.norm_topk_prob:
-            gates = gates / (gates.sum(-1, keepdims=True) + 1e-20)
-        gates = gates * cfg.routed_scaling_factor
     else:
         probs = jax.nn.softmax(logits, axis=-1)
-        gates, topi = jax.lax.top_k(probs, K)
-        if cfg.norm_topk_prob:
-            gates = gates / (gates.sum(-1, keepdims=True) + 1e-20)
-        gates = gates * cfg.routed_scaling_factor
+        choice = probs
+        if cfg.n_group > 1:  # V2 group_limited_greedy: group score = max
+            choice = group_mask(choice, lambda g: g.max(-1))
+        _, topi = jax.lax.top_k(choice, K)
+        gates = jnp.take_along_axis(probs, topi, axis=1)
+    if cfg.norm_topk_prob:
+        gates = gates / (gates.sum(-1, keepdims=True) + 1e-20)
+    gates = gates * cfg.routed_scaling_factor
     return jnp.zeros((N, E), jnp.float32).at[
         jnp.arange(N)[:, None], topi].add(gates)
 

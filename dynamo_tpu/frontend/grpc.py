@@ -1,0 +1,266 @@
+"""KServe v2 gRPC frontend (open inference protocol).
+
+Rebuild of the reference's tonic KServe service (ref: lib/llm/src/grpc/
+service/kserve.rs:31+, protos/kserve.proto): text-in/text-out LLM inference
+over the standard ``inference.GRPCInferenceService``:
+
+- ``ServerLive`` / ``ServerReady`` / ``ModelReady`` — health surface.
+- ``ServerMetadata`` / ``ModelMetadata`` — model discovery; every served
+  model advertises ``text_input`` (BYTES, [1]), ``streaming`` (BOOL, [1])
+  inputs and a ``text_output`` (BYTES) output, matching the reference's
+  tensor contract (kserve.rs:344-402).
+- ``ModelInfer`` — unary: decodes ``text_input`` (bytes_contents or
+  length-prefixed raw form), lowers onto the completion pipeline, folds the
+  stream, returns one ``text_output`` tensor. A truthy ``streaming`` tensor
+  is rejected like the reference (kserve.rs:190).
+- ``ModelStreamInfer`` — one request in, a ``ModelStreamInferResponse`` per
+  generated delta out; engine errors ride ``error_message``.
+
+Sampling knobs arrive as request ``parameters`` (max_tokens, temperature,
+top_p, seed) — InferParameter int64/double values.
+
+The service stubs are hand-wired through ``grpc.method_handlers_generic_handler``
+(message classes come from protoc's ``kserve_pb2``; the grpc codegen plugin
+is not in the image).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import grpc
+from grpc import aio
+
+from dynamo_tpu.frontend import kserve_pb2 as pb
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.pipeline import aggregate_completion_stream
+from dynamo_tpu.protocols import Annotated
+from dynamo_tpu.protocols.openai import RequestError, parse_completion_request
+from dynamo_tpu.runtime.context import Context
+
+logger = logging.getLogger("dynamo.grpc")
+
+_SERVICE = "inference.GRPCInferenceService"
+
+
+def _param_value(p: pb.InferParameter):
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+class _ParsedInfer:
+    def __init__(self):
+        self.text_input: Optional[str] = None
+        self.streaming = False
+
+
+def _parse_infer_request(req: pb.ModelInferRequest) -> _ParsedInfer:
+    """Decode the tensor contract (ref: kserve.rs:442-527)."""
+    out = _ParsedInfer()
+    raw_idx = 0  # tensors without inline contents consume raw slots in order
+    for t in req.inputs:
+        raw = None
+        if not t.contents.ListFields():
+            if raw_idx < len(req.raw_input_contents):
+                raw = req.raw_input_contents[raw_idx]
+            raw_idx += 1
+        if t.name == "text_input":
+            if t.contents.bytes_contents:
+                if t.datatype not in ("", "BYTES"):
+                    raise RequestError(
+                        f"'text_input' must be BYTES, got {t.datatype}")
+                out.text_input = t.contents.bytes_contents[0].decode(
+                    "utf-8", "replace")
+            elif raw is not None:
+                if len(raw) < 4:  # length-prefixed string encoding
+                    raise RequestError(
+                        "'text_input' raw input must be length-prefixed")
+                out.text_input = raw[4:].decode("utf-8", "replace")
+            else:
+                raise RequestError("missing contents for 'text_input'")
+        elif t.name in ("streaming", "stream"):
+            if t.contents.bool_contents:
+                out.streaming = bool(t.contents.bool_contents[0])
+            elif raw:  # raw BOOL: one byte per element
+                out.streaming = raw[0] != 0
+        else:
+            raise RequestError(
+                f"invalid input name: {t.name}; supported inputs are "
+                "'text_input', 'streaming'")
+    if out.text_input is None:
+        raise RequestError("missing required input: 'text_input'")
+    return out
+
+
+def _completion_body(req: pb.ModelInferRequest, parsed: _ParsedInfer) -> dict:
+    body = {"model": req.model_name, "prompt": parsed.text_input,
+            "stream": parsed.streaming}
+    params = {k: _param_value(v) for k, v in req.parameters.items()}
+    for k in ("max_tokens", "temperature", "top_p", "seed", "top_k",
+              "frequency_penalty", "presence_penalty"):
+        if params.get(k) is not None:
+            body[k] = params[k]
+    if isinstance(body.get("max_tokens"), float):
+        body["max_tokens"] = int(body["max_tokens"])
+    if isinstance(params.get("stop"), str):
+        body["stop"] = params["stop"]
+    return body
+
+
+def _text_response(model: str, rid: str, texts: list[str],
+                   finished: bool = True) -> pb.ModelInferResponse:
+    resp = pb.ModelInferResponse(model_name=model, id=rid)
+    tensor = resp.outputs.add()
+    tensor.name = "text_output"
+    tensor.datatype = "BYTES"
+    tensor.shape.append(len(texts))
+    tensor.contents.bytes_contents.extend(t.encode() for t in texts)
+    if finished:
+        resp.parameters["triton_final_response"].bool_param = True
+    return resp
+
+
+class KserveGrpcService:
+    """gRPC server fronting the same ModelManager as the HTTP service."""
+
+    def __init__(self, manager: ModelManager, host: str = "0.0.0.0",
+                 port: int = 8787):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self._server: Optional[aio.Server] = None
+
+    # -- rpc handlers ------------------------------------------------------
+
+    async def server_live(self, request, context) -> pb.ServerLiveResponse:
+        return pb.ServerLiveResponse(live=True)
+
+    async def server_ready(self, request, context) -> pb.ServerReadyResponse:
+        return pb.ServerReadyResponse(ready=bool(self.manager.list_models()))
+
+    async def model_ready(self, request, context) -> pb.ModelReadyResponse:
+        return pb.ModelReadyResponse(
+            ready=self.manager.get(request.name) is not None)
+
+    async def server_metadata(self, request, context):
+        return pb.ServerMetadataResponse(
+            name="dynamo-tpu", version="0.2", extensions=["llm"])
+
+    async def model_metadata(self, request, context):
+        if self.manager.get(request.name) is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"model '{request.name}' not found")
+        md = pb.ModelMetadataResponse(
+            name=request.name, versions=["1"], platform="dynamo")
+        md.inputs.add(name="text_input", datatype="BYTES", shape=[1])
+        md.inputs.add(name="streaming", datatype="BOOL", shape=[1])
+        md.outputs.add(name="text_output", datatype="BYTES", shape=[-1])
+        return md
+
+    async def model_infer(self, request, context) -> pb.ModelInferResponse:
+        served = self.manager.get(request.model_name)
+        if served is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"model '{request.model_name}' not found")
+        try:
+            parsed_in = _parse_infer_request(request)
+            if parsed_in.streaming:
+                raise RequestError(
+                    "streaming is not supported by ModelInfer; use "
+                    "ModelStreamInfer")
+            parsed = parse_completion_request(
+                _completion_body(request, parsed_in))
+        except RequestError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        ctx = Context()
+        try:
+            result = await aggregate_completion_stream(
+                served.pipeline.generate(parsed, ctx))
+        except Exception as e:
+            ctx.cancel()
+            await context.abort(grpc.StatusCode.INTERNAL, repr(e))
+        texts = [c.get("text", "") for c in result["choices"]]
+        return _text_response(request.model_name, request.id, texts)
+
+    async def model_stream_infer(self, request_iterator, context):
+        """One inbound request drives one outbound delta stream (the
+        reference demuxes the same way — kserve.rs:242)."""
+        request = await request_iterator.__anext__()
+        served = self.manager.get(request.model_name)
+        if served is None:
+            yield pb.ModelStreamInferResponse(
+                error_message=f"model '{request.model_name}' not found")
+            return
+        try:
+            parsed_in = _parse_infer_request(request)
+            body = _completion_body(request, parsed_in)
+            body["stream"] = True
+            parsed = parse_completion_request(body)
+        except RequestError as e:
+            yield pb.ModelStreamInferResponse(error_message=str(e))
+            return
+        ctx = Context()
+        try:
+            async for wire in served.pipeline.generate(parsed, ctx):
+                ann = Annotated.from_wire(wire)
+                if ann.is_error():
+                    yield pb.ModelStreamInferResponse(
+                        error_message="; ".join(ann.comment or ["error"]))
+                    return
+                if ann.event is not None or ann.data is None:
+                    continue
+                chunk = ann.data
+                texts = [c.get("text", "") for c in chunk.get("choices", [])]
+                done = any(c.get("finish_reason")
+                           for c in chunk.get("choices", []))
+                yield pb.ModelStreamInferResponse(
+                    infer_response=_text_response(
+                        request.model_name, request.id, texts, finished=done))
+        except BaseException:
+            ctx.cancel()  # client went away or engine died: stop the worker
+            raise
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _handlers(self) -> grpc.GenericRpcHandler:
+        def unary(fn, req_cls, resp_cls):
+            # grpc.aio servers accept coroutine handlers through the plain
+            # grpc method-handler constructors
+            return grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+
+        handlers = {
+            "ServerLive": unary(self.server_live, pb.ServerLiveRequest,
+                                pb.ServerLiveResponse),
+            "ServerReady": unary(self.server_ready, pb.ServerReadyRequest,
+                                 pb.ServerReadyResponse),
+            "ModelReady": unary(self.model_ready, pb.ModelReadyRequest,
+                                pb.ModelReadyResponse),
+            "ServerMetadata": unary(self.server_metadata,
+                                    pb.ServerMetadataRequest,
+                                    pb.ServerMetadataResponse),
+            "ModelMetadata": unary(self.model_metadata,
+                                   pb.ModelMetadataRequest,
+                                   pb.ModelMetadataResponse),
+            "ModelInfer": unary(self.model_infer, pb.ModelInferRequest,
+                                pb.ModelInferResponse),
+            "ModelStreamInfer": grpc.stream_stream_rpc_method_handler(
+                self.model_stream_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelStreamInferResponse.SerializeToString),
+        }
+        return grpc.method_handlers_generic_handler(_SERVICE, handlers)
+
+    async def start(self) -> int:
+        self._server = aio.server()
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        logger.info("KServe gRPC frontend on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self):
+        if self._server:
+            await self._server.stop(grace=2.0)

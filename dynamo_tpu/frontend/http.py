@@ -5,6 +5,8 @@ service_v2.rs:125-420, openai.rs:209-1106): routes
 
 - ``POST /v1/chat/completions`` (stream + non-stream)
 - ``POST /v1/completions``
+- ``POST /v1/embeddings``
+- ``POST /v1/responses``        — Responses API lowered onto the chat chain
 - ``GET  /v1/models``
 - ``GET  /health`` / ``/live``  — liveness + model readiness
 - ``GET  /metrics``             — Prometheus text exposition
@@ -30,9 +32,13 @@ from dynamo_tpu.protocols import Annotated
 from dynamo_tpu.protocols.openai import (
     RequestError,
     error_body,
+    gen_request_id,
     model_entry,
     parse_chat_request,
     parse_completion_request,
+    parse_responses_request,
+    response_msg_id,
+    response_object,
 )
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.control_plane import NoRespondersError
@@ -71,6 +77,7 @@ class HttpService:
         app.router.add_post("/v1/chat/completions", self.handle_chat)
         app.router.add_post("/v1/completions", self.handle_completions)
         app.router.add_post("/v1/embeddings", self.handle_embeddings)
+        app.router.add_post("/v1/responses", self.handle_responses)
         app.router.add_get("/v1/models", self.handle_models)
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/live", self.handle_live)
@@ -91,6 +98,21 @@ class HttpService:
     async def stop(self):
         if self._runner:
             await self._runner.cleanup()
+
+    def _request_context(self, request: web.Request) -> Context:
+        """Per-request Context: honor inbound request-id/traceparent headers
+        and bind the contextvar so frontend log lines carry the id."""
+        ctx = Context()
+        rid = (request.headers.get("x-request-id")
+               or request.headers.get("x-dynamo-request-id"))
+        if rid:
+            ctx.id = rid
+        ctx.traceparent = request.headers.get("traceparent")
+        ctx.ensure_traceparent()  # synthesize when the client sent none
+        from dynamo_tpu.runtime.context import CURRENT_REQUEST
+
+        CURRENT_REQUEST.set(ctx)
+        return ctx
 
     # -- handlers ----------------------------------------------------------
 
@@ -115,6 +137,8 @@ class HttpService:
         t0 = time.perf_counter()
         try:
             body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError
         except Exception:
             self._requests.inc(route="embeddings", model="unknown", status="400")
             return web.json_response(error_body("invalid JSON body"), status=400)
@@ -125,16 +149,7 @@ class HttpService:
             return web.json_response(
                 error_body(f"model '{model}' not found", "model_not_found", 404),
                 status=404)
-        ctx = Context()
-        rid = (request.headers.get("x-request-id")
-               or request.headers.get("x-dynamo-request-id"))
-        if rid:
-            ctx.id = rid
-        ctx.traceparent = request.headers.get("traceparent")
-        ctx.ensure_traceparent()
-        from dynamo_tpu.runtime.context import CURRENT_REQUEST
-
-        CURRENT_REQUEST.set(ctx)
+        ctx = self._request_context(request)
         raw = body.get("input")
         if isinstance(raw, str):
             inputs = [raw]
@@ -196,6 +211,158 @@ class HttpService:
             "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
         })
 
+    async def handle_responses(self, request: web.Request) -> web.StreamResponse:
+        """OpenAI Responses API (ref: openai.rs:1005): ``input`` +
+        ``instructions`` lower onto the chat pipeline; streaming emits
+        typed ``response.*`` SSE events."""
+        t0 = time.perf_counter()
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError
+        except Exception:
+            self._requests.inc(route="responses", model="unknown", status="400")
+            return web.json_response(error_body("invalid JSON body"), status=400)
+        try:
+            parsed = parse_responses_request(body)
+        except RequestError as e:
+            self._requests.inc(route="responses", model=str(body.get("model")),
+                               status="400")
+            return web.json_response(error_body(str(e)), status=400)
+        served = self.manager.get(parsed.model)
+        if served is None:
+            self._requests.inc(route="responses", model=parsed.model, status="404")
+            return web.json_response(
+                error_body(f"model '{parsed.model}' not found",
+                           "model_not_found", 404), status=404)
+
+        ctx = self._request_context(request)
+        rid = gen_request_id("resp")
+        created = int(time.time())
+        self._inflight_count += 1
+        self._inflight.set(self._inflight_count)
+        try:
+            stream = served.pipeline.generate(parsed, ctx)
+            if parsed.stream:
+                return await self._stream_responses_sse(
+                    request, stream, ctx, parsed.model, rid, created, t0)
+            try:
+                result = await aggregate_chat_stream(stream)
+            except NoRespondersError:
+                self._requests.inc(route="responses", model=parsed.model,
+                                   status="503")
+                return web.json_response(
+                    error_body("no workers available", "service_unavailable",
+                               503), status=503)
+            except (ValueError, RuntimeError) as e:
+                self._requests.inc(route="responses", model=parsed.model,
+                                   status="400")
+                return web.json_response(error_body(str(e)), status=400)
+            choice = result["choices"][0]
+            text = choice["message"].get("content") or ""
+            # responses-API status: max_output_tokens truncation reports
+            # "incomplete", everything else "completed"
+            status_word = ("incomplete" if choice.get("finish_reason") == "length"
+                           else "completed")
+            self._requests.inc(route="responses", model=parsed.model, status="200")
+            self._latency.observe(time.perf_counter() - t0, route="responses")
+            out = response_object(rid, parsed.model, created, text, status_word,
+                                  result.get("usage"))
+            if status_word == "incomplete":
+                out["incomplete_details"] = {"reason": "max_output_tokens"}
+            return web.json_response(out, headers={"x-request-id": ctx.id})
+        finally:
+            self._inflight_count -= 1
+            self._inflight.set(self._inflight_count)
+
+    async def _stream_responses_sse(self, request, stream, ctx, model,
+                                    rid, created, t0) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache", "x-request-id": ctx.id})
+        await resp.prepare(request)
+
+        async def emit(event: str, payload: dict):
+            await resp.write(
+                f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode())
+
+        status = "200"
+        parts: list[str] = []
+        usage = None
+        try:
+            await emit("response.created", {
+                "type": "response.created",
+                "response": response_object(rid, model, created, "",
+                                            "in_progress")})
+            first = True
+            finish = None
+            async for wire in stream:
+                ann = Annotated.from_wire(wire)
+                if ann.is_error():
+                    await emit("response.failed", {
+                        "type": "response.failed",
+                        "response": response_object(rid, model, created,
+                                                    "".join(parts), "failed")})
+                    status = "500"
+                    break
+                if ann.event is not None:
+                    continue
+                chunk = ann.data
+                usage = chunk.get("usage") or usage
+                for ch in chunk.get("choices", []):
+                    delta = (ch.get("delta") or {}).get("content")
+                    finish = ch.get("finish_reason") or finish
+                    if delta:
+                        if first:
+                            self._ttft.observe(time.perf_counter() - t0,
+                                               route="responses")
+                            first = False
+                        parts.append(delta)
+                        await emit("response.output_text.delta", {
+                            "type": "response.output_text.delta",
+                            "item_id": response_msg_id(rid),
+                            "output_index": 0, "content_index": 0,
+                            "delta": delta})
+            if status == "200":
+                text = "".join(parts)
+                await emit("response.output_text.done", {
+                    "type": "response.output_text.done",
+                    "item_id": response_msg_id(rid),
+                    "output_index": 0, "content_index": 0, "text": text})
+                # max_output_tokens truncation ends the stream with
+                # response.incomplete (OpenAI semantics); clean EOS/stop
+                # ends with response.completed
+                word = "incomplete" if finish == "length" else "completed"
+                final = response_object(rid, model, created, text, word, usage)
+                if word == "incomplete":
+                    final["incomplete_details"] = {
+                        "reason": "max_output_tokens"}
+                await emit(f"response.{word}",
+                           {"type": f"response.{word}", "response": final})
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.cancel()
+            status = "499"
+            raise
+        except NoRespondersError:
+            await emit("response.failed", {
+                "type": "response.failed",
+                "response": response_object(rid, model, created,
+                                            "".join(parts), "failed")})
+            status = "503"
+        except Exception:
+            logger.exception("responses stream failed")
+            await emit("response.failed", {
+                "type": "response.failed",
+                "response": response_object(rid, model, created,
+                                            "".join(parts), "failed")})
+            status = "500"
+        finally:
+            self._requests.inc(route="responses", model=model, status=status)
+            self._latency.observe(time.perf_counter() - t0, route="responses")
+        await resp.write_eof()
+        return resp
+
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_llm(request, chat=True)
 
@@ -207,6 +374,8 @@ class HttpService:
         t0 = time.perf_counter()
         try:
             body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError
         except Exception:
             self._requests.inc(route=route, model="unknown", status="400")
             return web.json_response(error_body("invalid JSON body"), status=400)
@@ -224,16 +393,7 @@ class HttpService:
                 status=404,
             )
 
-        ctx = Context()
-        rid = request.headers.get("x-request-id") or request.headers.get("x-dynamo-request-id")
-        if rid:
-            ctx.id = rid
-        ctx.traceparent = request.headers.get("traceparent")
-        ctx.ensure_traceparent()  # synthesize when the client sent none
-        from dynamo_tpu.runtime.context import CURRENT_REQUEST
-
-        CURRENT_REQUEST.set(ctx)  # frontend-side log lines carry the id
-
+        ctx = self._request_context(request)
         self._inflight_count += 1
         self._inflight.set(self._inflight_count)
         try:

@@ -33,6 +33,9 @@ async def amain():
                          "(0 = off; ref: subscriber.rs:30-65)")
     ap.add_argument("--router-reset-states", action="store_true",
                     help="ignore any persisted radix snapshot on start")
+    ap.add_argument("--grpc-port", type=int, default=0,
+                    help="also serve the KServe gRPC frontend on this port "
+                         "(0 = disabled; ref: grpc/service/kserve.rs:31)")
     args = ap.parse_args()
 
     runtime = await DistributedRuntime.create()
@@ -51,6 +54,13 @@ async def amain():
     ).start()
     service = HttpService(manager, host=args.host, port=args.port)
     await service.start()
+    grpc_service = None
+    if args.grpc_port:
+        from dynamo_tpu.frontend.grpc import KserveGrpcService
+
+        grpc_service = KserveGrpcService(manager, host=args.host,
+                                         port=args.grpc_port)
+        await grpc_service.start()
     print(f"FRONTEND_READY port={service.port}", flush=True)
 
     loop = asyncio.get_running_loop()
@@ -59,6 +69,8 @@ async def amain():
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     await service.stop()
+    if grpc_service is not None:
+        await grpc_service.stop()
     await watcher.stop()
     await runtime.shutdown()
 

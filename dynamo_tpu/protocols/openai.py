@@ -267,3 +267,81 @@ def model_entry(model_id: str, created: Optional[int] = None) -> dict:
 
 def error_body(message: str, err_type: str = "invalid_request_error", code: int = 400) -> dict:
     return {"error": {"message": message, "type": err_type, "code": code}}
+
+
+# -- Responses API (ref: lib/llm/src/http/service/openai.rs:1005) ------------
+
+
+def parse_responses_request(body: dict) -> ParsedRequest:
+    """Parse a /v1/responses body by lowering it onto the chat pipeline.
+
+    The responses API is a superset of chat; the serving semantics here map
+    ``input`` (string or message-item list) + ``instructions`` onto chat
+    messages and reuse the chat operator chain end-to-end — same as the
+    reference, whose responses route drives the chat engines.
+    """
+    if not isinstance(body, dict):
+        raise RequestError("request body must be a JSON object")
+    model = body.get("model")
+    if not model or not isinstance(model, str):
+        raise RequestError("'model' is required")
+    raw = body.get("input")
+    messages: list[dict] = []
+    if instructions := body.get("instructions"):
+        messages.append({"role": "system", "content": str(instructions)})
+    if isinstance(raw, str):
+        messages.append({"role": "user", "content": raw})
+    elif isinstance(raw, list) and raw:
+        for item in raw:
+            if not isinstance(item, dict) or "role" not in item:
+                raise RequestError(
+                    "each input item must be an object with a 'role'")
+            content = item.get("content")
+            if isinstance(content, list):  # content parts → concatenated text
+                texts = []
+                for part in content:
+                    if isinstance(part, dict) and "text" in part:
+                        texts.append(str(part["text"]))
+                    else:
+                        raise RequestError(
+                            "input content parts must carry 'text' "
+                            "(input_text/output_text)")
+                content = "".join(texts)
+            messages.append({"role": item["role"], "content": content or ""})
+    else:
+        raise RequestError("'input' must be a string or a non-empty array")
+    chat_body = dict(body)
+    chat_body["messages"] = messages
+    if "max_output_tokens" in body:
+        chat_body["max_tokens"] = body["max_output_tokens"]
+    return parse_chat_request(chat_body)
+
+
+def response_msg_id(request_id: str) -> str:
+    """Output-item id for a response id ('resp-<hex>' → 'msg-<hex>')."""
+    return "msg-" + request_id.split("-", 1)[-1]
+
+
+def response_object(request_id: str, model: str, created: int, text: str,
+                    status: str, usage: Optional[dict] = None) -> dict:
+    u = usage or {}
+    return {
+        "id": request_id,
+        "object": "response",
+        "created_at": created,
+        "status": status,
+        "model": model,
+        "output": [{
+            "type": "message",
+            "id": response_msg_id(request_id),
+            "status": status,
+            "role": "assistant",
+            "content": [{"type": "output_text", "text": text,
+                         "annotations": []}],
+        }],
+        "usage": {
+            "input_tokens": u.get("prompt_tokens", 0),
+            "output_tokens": u.get("completion_tokens", 0),
+            "total_tokens": u.get("total_tokens", 0),
+        },
+    }

@@ -2,8 +2,9 @@
 serving, the reference's ``dynamo-run`` CLI analog (ref: launch/dynamo-run/
 src/main.rs:30, opt.rs:7).
 
-``in=``  http | text            (OpenAI server, or interactive REPL)
-``out=`` engine | mocker | echo (native JAX engine, simulator, or echo)
+``in=``  http | text | batch | grpc (OpenAI server, REPL, JSONL batch, or
+                                     KServe gRPC)
+``out=`` engine | mocker | echo     (native JAX engine, simulator, or echo)
 
 Everything runs in ONE process over the in-process control plane unless
 DYN_CONTROL_PLANE points at a dynctl/etcd-style endpoint — handy for local
@@ -30,8 +31,8 @@ def parse_inout(argv):
             out = a[4:]
         else:
             rest.append(a)
-    if inp not in ("http", "text", "batch"):
-        raise SystemExit(f"unknown in={inp} (http|text|batch)")
+    if inp not in ("http", "text", "batch", "grpc"):
+        raise SystemExit(f"unknown in={inp} (http|text|batch|grpc)")
     if out not in ("engine", "mocker", "echo"):
         raise SystemExit(f"unknown out={out} (engine|mocker|echo)")
     return inp, out, rest
@@ -271,10 +272,18 @@ async def amain():
             await runtime.shutdown()
         return
 
-    service = HttpService(manager, port=cli.port)
-    await service.start()
-    print(f"READY http://localhost:{service.port}/v1  model={cli.model}",
-          flush=True)
+    if inp == "grpc":
+        from dynamo_tpu.frontend.grpc import KserveGrpcService
+
+        service = KserveGrpcService(manager, port=cli.port)
+        await service.start()
+        print(f"READY grpc://localhost:{service.port}  model={cli.model}",
+              flush=True)
+    else:
+        service = HttpService(manager, port=cli.port)
+        await service.start()
+        print(f"READY http://localhost:{service.port}/v1  model={cli.model}",
+              flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):

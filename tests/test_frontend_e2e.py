@@ -221,3 +221,48 @@ async def test_kv_routing_prefix_affinity(stack):
     assert len(picked) == 2
     assert picked[0]["worker_id"] == picked[1]["worker_id"]
     assert picked[0]["overlap_blocks"] >= 1
+
+
+async def test_responses_endpoint(stack):
+    """/v1/responses (ref: openai.rs:1005): non-stream returns a response
+    object; stream emits typed response.* SSE events ending in completed."""
+    rt, service, add_mocker, manager = stack
+    await add_mocker()
+    await wait_for_model(manager)
+    base = f"http://127.0.0.1:{service.port}"
+
+    async with aiohttp.ClientSession() as http:
+        body = {"model": MODEL, "input": "tell me about tokens",
+                "instructions": "be brief", "max_output_tokens": 6}
+        async with http.post(f"{base}/v1/responses", json=body) as r:
+            assert r.status == 200
+            resp = await r.json()
+            assert resp["object"] == "response"
+            # the mocker runs to max_output_tokens → truncation reports
+            # "incomplete" with the reason, per responses-API semantics
+            assert resp["status"] == "incomplete"
+            assert resp["incomplete_details"]["reason"] == "max_output_tokens"
+            out = resp["output"][0]
+            assert out["role"] == "assistant"
+            assert out["content"][0]["type"] == "output_text"
+            assert resp["usage"]["output_tokens"] >= 1
+
+        # message-item input form + streaming
+        body = {"model": MODEL, "stream": True, "max_output_tokens": 5,
+                "input": [{"role": "user", "content": [
+                    {"type": "input_text", "text": "hello there"}]}]}
+        events = []
+        async with http.post(f"{base}/v1/responses", json=body) as r:
+            assert r.status == 200
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("event: "):
+                    events.append(line.split(" ", 1)[1])
+        assert events[0] == "response.created"
+        assert "response.output_text.delta" in events
+        assert events[-2:] == ["response.output_text.done",
+                               "response.incomplete"]  # length-truncated
+
+        async with http.post(f"{base}/v1/responses",
+                             json={"model": MODEL, "input": []}) as r:
+            assert r.status == 400
