@@ -81,6 +81,9 @@ class AsyncJaxEngine:
             self.kvbm = KvbmManager(args.kvbm_host_bytes,
                                     disk_dir=args.kvbm_disk_dir,
                                     disk_bytes=args.kvbm_disk_bytes)
+        #: set by engine/main.py when a distributed KVBM fleet is configured
+        #: (RemoteKvbm — leader lookup + peer fetch)
+        self.kvbm_remote = None
         self._offload_tasks: set = set()
 
         self.pool = BlockPool(nb, args.enable_prefix_caching,
@@ -724,6 +727,31 @@ class AsyncJaxEngine:
 
     # ----------------------------------------------------- KVBM offload/onboard
 
+    def _spawn_remote_fetch(self, hashes: list) -> None:
+        """G4→G2: pull prefix blocks held by PEER workers into the local
+        host tier (distributed KVBM — ref: block_manager/distributed/
+        leader.rs cross-worker onboarding). Same discipline as the disk
+        promotion: the admission path never blocks on the network; the next
+        admission of the prefix onboards from host."""
+        if getattr(self, "_remote_fetching", None) is None:
+            self._remote_fetching = set()
+        todo = [h for h in hashes if h not in self._remote_fetching]
+        if not todo:
+            return
+        self._remote_fetching.update(todo)
+
+        async def run():
+            try:
+                await self.kvbm_remote.fetch_into_host(todo)
+            except Exception:
+                logger.exception("KVBM remote fetch failed")
+            finally:
+                self._remote_fetching.difference_update(todo)
+
+        task = asyncio.get_running_loop().create_task(run())
+        self._offload_tasks.add(task)
+        task.add_done_callback(self._offload_tasks.discard)
+
     def _spawn_promote(self, hashes: list) -> None:
         """G3→G2 in a worker thread (np.load off the event loop)."""
         if getattr(self, "_promoting", None) is None:
@@ -795,6 +823,8 @@ class AsyncJaxEngine:
             if e is None:
                 if self.kvbm.in_disk(h):
                     self._spawn_promote(hashes[i:])
+                elif self.kvbm_remote is not None:
+                    self._spawn_remote_fetch(hashes[i:])
                 break
             ks.append(e[0])
             vs.append(e[1])

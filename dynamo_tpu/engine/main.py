@@ -99,6 +99,16 @@ async def amain():
                     help="host-DRAM KV tier size (0 = off)")
     ap.add_argument("--kvbm-disk-dir", default=None)
     ap.add_argument("--kvbm-disk-gb", type=float, default=0.0)
+    ap.add_argument("--kvbm-distributed", action="store_true",
+                    help="join the distributed KVBM fleet: announce tier "
+                         "contents, serve fetch/control, pull peer blocks "
+                         "(ref: block_manager/distributed/worker.rs:137). "
+                         "Requires a kvbm leader (--kvbm-leader-workers on "
+                         "one process, or dynamo_tpu.kvbm.main)")
+    ap.add_argument("--kvbm-leader-workers", type=int, default=0,
+                    help="also run the KVBM leader in this process, "
+                         "expecting N workers at the startup barrier "
+                         "(ref: distributed/leader.rs:126)")
     cli = ap.parse_args()
 
     # resolve model metadata BEFORE the heavy engine build so a
@@ -195,6 +205,26 @@ async def amain():
             from dynamo_tpu.disagg.handlers import DisaggConfigWatcher
             await DisaggConfigWatcher(runtime.plane, dconf).start()
 
+    kvbm_leader = None
+    kvbm_worker = None
+    if cli.kvbm_leader_workers:
+        from dynamo_tpu.kvbm.distributed import KvbmLeader
+        kvbm_leader = KvbmLeader(runtime, cli.namespace,
+                                 num_workers=cli.kvbm_leader_workers)
+        leader_task = asyncio.get_running_loop().create_task(
+            kvbm_leader.start())  # barrier completes once workers join
+    if cli.kvbm_distributed:
+        if engine.kvbm is None:
+            ap.error("--kvbm-distributed needs --kvbm-host-gb > 0")
+        from dynamo_tpu.kvbm.distributed import KvbmWorkerService, RemoteKvbm
+        kvbm_worker = await KvbmWorkerService(
+            runtime, engine.kvbm, cli.namespace, engine=engine).start()
+        engine.kvbm_remote = RemoteKvbm(
+            runtime, engine.kvbm, cli.namespace,
+            worker_id=kvbm_worker.worker_id)
+    if kvbm_leader is not None:
+        await leader_task
+
     handle = await ep.serve_endpoint(serve, lease_id=lease)
     embed_handle = None
     if cli.role != "prefill":  # embeddings ride the decode/agg fleet
@@ -248,6 +278,10 @@ async def amain():
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if kvbm_worker is not None:
+        await kvbm_worker.stop()
+    if kvbm_leader is not None:
+        await kvbm_leader.stop()
     if queue_worker is not None:
         await queue_worker.stop()
     if embed_handle is not None:

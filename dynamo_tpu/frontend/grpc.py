@@ -26,6 +26,7 @@ is not in the image).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Optional
 
@@ -177,6 +178,10 @@ class KserveGrpcService:
         try:
             result = await aggregate_completion_stream(
                 served.pipeline.generate(parsed, ctx))
+        except asyncio.CancelledError:
+            # client cancelled / deadline exceeded: stop the worker too
+            ctx.cancel()
+            raise
         except Exception as e:
             ctx.cancel()
             await context.abort(grpc.StatusCode.INTERNAL, repr(e))

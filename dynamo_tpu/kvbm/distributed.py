@@ -1,0 +1,372 @@
+"""Distributed KVBM: leader/worker block orchestration + runtime controller.
+
+Rebuild of the reference's multi-worker block manager (ref:
+lib/llm/src/block_manager/distributed/{leader.rs:126,worker.rs:137,zmq.rs},
+controller.rs:1-234; startup sync via
+lib/runtime/src/utils/leader_worker_barrier.rs:14):
+
+- **Startup**: one ``KvbmLeader`` per cluster, N ``KvbmWorkerService``s
+  rendezvous through the control-plane LeaderWorkerBarrier; the leader's
+  barrier payload carries shared pool config (host-tier budget), so every
+  worker sizes its G2 identically.
+- **Ownership map**: workers publish tier store/evict events on the
+  ``kvbm_events`` subject (the reference's ZMQ leader↔worker channel →
+  control-plane pub/sub here); the leader folds them into a
+  hash → {worker} map.
+- **Cross-worker onboarding**: a worker missing a prefix block asks the
+  leader (``lookup`` endpoint) who holds it, then pulls the block bytes
+  straight from the owning worker's ``fetch`` endpoint over the response
+  plane — leader coordinates, data flows worker↔worker, exactly the
+  reference's split of control vs data path.
+- **Runtime controller**: every worker serves a ``control`` endpoint
+  (reset / resize / stats); ``KvbmController`` fans an op out to all
+  registered workers (ref: controller.rs reset/resize pools at runtime).
+
+Remote blocks land in the LOCAL host tier first (G2 as the staging buffer,
+SURVEY §5.8) and onboard to the device on the next admission, mirroring the
+G3→G2 promotion discipline — admission never blocks on the network.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.kvbm.manager import KvbmManager
+from dynamo_tpu.runtime.barrier import LeaderWorkerBarrier
+from dynamo_tpu.runtime.control_plane import NoRespondersError
+
+logger = logging.getLogger("dynamo.kvbm.dist")
+
+KVBM_COMPONENT = "kvbm"
+KVBM_EVENTS_SUBJECT = "kvbm_events"
+
+
+def _pack_block(h: int, k: np.ndarray, v: np.ndarray) -> dict:
+    return {
+        "hash": h,
+        "k": k.tobytes(), "v": v.tobytes(),
+        "k_shape": list(k.shape), "v_shape": list(v.shape),
+        "dtype": str(k.dtype),
+    }
+
+
+def _unpack_block(d: dict) -> tuple[int, np.ndarray, np.ndarray]:
+    import ml_dtypes
+
+    dtype = np.dtype(getattr(ml_dtypes, d["dtype"], None) or d["dtype"])
+    k = np.frombuffer(d["k"], dtype).reshape(d["k_shape"]).copy()
+    v = np.frombuffer(d["v"], dtype).reshape(d["v_shape"]).copy()
+    return d["hash"], k, v
+
+
+class KvbmLeader:
+    """Cluster-wide block-ownership map + lookup endpoint (one per cluster)."""
+
+    def __init__(self, runtime, namespace: str = "dynamo",
+                 num_workers: int = 1, host_bytes: Optional[int] = None):
+        self.runtime = runtime
+        self.namespace = namespace
+        self.num_workers = num_workers
+        self.host_bytes = host_bytes
+        #: hash -> set of worker instance-ids holding the block
+        self.owners: dict[int, set[int]] = {}
+        self._by_worker: dict[int, set[int]] = {}
+        self._sub = None
+        self._sub_task: Optional[asyncio.Task] = None
+        self._inst_watch = None
+        self._watch_task: Optional[asyncio.Task] = None
+        self._handle = None
+
+    async def start(self, barrier_timeout: float = 120.0) -> "KvbmLeader":
+        rt = self.runtime
+        self._sub = await rt.plane.subscribe(KVBM_EVENTS_SUBJECT)
+        loop = asyncio.get_running_loop()
+        self._sub_task = loop.create_task(self._event_loop())
+        # prune dead workers: a worker's fetch instance key vanishes with
+        # its lease; purge its ownership entries so peers stop targeting it
+        self._inst_watch = await rt.plane.watch_prefix(
+            f"instances/{self.namespace}/{KVBM_COMPONENT}/fetch:")
+        self._watch_task = loop.create_task(self._instance_loop())
+        ep = rt.namespace(self.namespace).component(KVBM_COMPONENT).endpoint("lookup")
+        self._handle = await ep.serve_endpoint(self._lookup)
+        payload = msgpack.packb({"host_bytes": self.host_bytes})
+        barrier = LeaderWorkerBarrier(rt.plane, f"kvbm-{self.namespace}",
+                                      lease_id=await rt.primary_lease())
+        await barrier.leader_enter(payload, self.num_workers,
+                                   timeout=barrier_timeout)
+        logger.info("kvbm leader up: %d workers joined", self.num_workers)
+        return self
+
+    async def _event_loop(self):
+        async for _subject, msg in self._sub:
+            try:
+                ev = msgpack.unpackb(msg, raw=False)
+                wid = ev["worker"]
+                mine = self._by_worker.setdefault(wid, set())
+                if ev.get("cleared"):
+                    for h in mine:
+                        s = self.owners.get(h)
+                        if s is not None:
+                            s.discard(wid)
+                            if not s:
+                                del self.owners[h]
+                    mine.clear()
+                    continue
+                for h in ev.get("stored", ()):
+                    self.owners.setdefault(h, set()).add(wid)
+                    mine.add(h)
+                for h in ev.get("removed", ()):
+                    s = self.owners.get(h)
+                    if s is not None:
+                        s.discard(wid)
+                        if not s:
+                            del self.owners[h]
+                    mine.discard(h)
+            except Exception:
+                logger.exception("bad kvbm event")
+
+    def _purge_worker(self, wid: int) -> None:
+        for h in self._by_worker.pop(wid, set()):
+            s = self.owners.get(h)
+            if s is not None:
+                s.discard(wid)
+                if not s:
+                    del self.owners[h]
+
+    async def _instance_loop(self):
+        async for ev in self._inst_watch:
+            if ev.type == "delete":
+                try:
+                    wid = int(ev.key.rsplit(":", 1)[-1], 16)
+                except ValueError:
+                    continue
+                if wid in self._by_worker:
+                    logger.info("kvbm worker %x gone; purging ownership", wid)
+                    self._purge_worker(wid)
+
+    async def _lookup(self, request, ctx):
+        """{hashes, exclude?} → {owners: [[hash, [worker_id, ...]], ...]}
+        — pair list, not a dict (the wire codec rejects int map keys); ALL
+        owners are returned so the fetcher can fail over if its first
+        choice died between the purge watch firing and the fetch."""
+        exclude = request.get("exclude")
+        out = []
+        for h in request.get("hashes", ()):
+            wids = [w for w in self.owners.get(h, ()) if w != exclude]
+            if wids:
+                out.append([h, wids])
+        yield {"owners": out}
+
+    async def stop(self):
+        if self._handle is not None:
+            await self._handle.stop(graceful=False)
+        for t in (self._sub_task, getattr(self, "_watch_task", None)):
+            if t is not None:
+                t.cancel()
+        if getattr(self, "_inst_watch", None) is not None:
+            await self._inst_watch.cancel()
+        if self._sub is not None:
+            await self._sub.cancel()
+
+
+class KvbmWorkerService:
+    """Per-engine worker: announces tier contents, serves fetch + control."""
+
+    def __init__(self, runtime, manager: KvbmManager,
+                 namespace: str = "dynamo", engine=None):
+        self.runtime = runtime
+        self.manager = manager
+        self.namespace = namespace
+        self.engine = engine  # optional: reset also clears the device pool
+        self.worker_id: Optional[int] = None
+        self._handles = []
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        manager.on_change = self._on_change
+
+    async def start(self, barrier_timeout: float = 120.0) -> "KvbmWorkerService":
+        rt = self.runtime
+        self._loop = asyncio.get_running_loop()
+        lease = await rt.primary_lease()
+        self.worker_id = lease
+        comp = rt.namespace(self.namespace).component(KVBM_COMPONENT)
+        self._handles.append(await comp.endpoint("fetch").serve_endpoint(
+            self._fetch, lease_id=lease))
+        self._handles.append(await comp.endpoint("control").serve_endpoint(
+            self._control, lease_id=lease))
+        barrier = LeaderWorkerBarrier(rt.plane, f"kvbm-{self.namespace}",
+                                      lease_id=lease)
+        payload = msgpack.unpackb(
+            await barrier.worker_enter(f"worker-{lease:x}",
+                                       timeout=barrier_timeout), raw=False)
+        if payload.get("host_bytes"):  # leader-dictated shared pool config
+            self.manager.resize_host(payload["host_bytes"])
+        # announce pre-existing contents (restart case)
+        existing = self.manager.resident_hashes()
+        if existing:
+            self._on_change(existing, [])
+        logger.info("kvbm worker %x joined", lease)
+        return self
+
+    # -- events ------------------------------------------------------------
+
+    def _on_change(self, stored, removed) -> None:
+        if self._loop is None or self.worker_id is None:
+            return  # not started yet (e.g. initial resize from the barrier)
+        ev = {"worker": self.worker_id}
+        if removed is None:
+            ev["cleared"] = True
+        else:
+            ev["stored"] = list(stored)
+            ev["removed"] = list(removed)
+        payload = msgpack.packb(ev)
+        # tier writes run on to_thread workers (engine offload path); hop
+        # back onto the loop so the publish rides the runtime's connection
+        self._loop.call_soon_threadsafe(
+            lambda: self._loop.create_task(
+                self.runtime.plane.publish(KVBM_EVENTS_SUBJECT, payload)))
+
+    # -- endpoints ----------------------------------------------------------
+
+    async def _fetch(self, request, ctx):
+        """{hashes} → one frame per resident block ({hash,k,v,shapes,dtype})."""
+        for h in request.get("hashes", ()):
+            e = await asyncio.to_thread(self.manager.get, h)
+            if e is None:
+                continue
+            yield _pack_block(h, e[0], e[1])
+
+    async def _control(self, request, ctx):
+        op = request.get("op")
+        if op == "reset":
+            await asyncio.to_thread(self.manager.clear)
+            if self.engine is not None and hasattr(self.engine, "pool"):
+                self.engine.pool.clear()
+            yield {"ok": True}
+        elif op == "resize":
+            await asyncio.to_thread(self.manager.resize_host,
+                                    int(request["host_bytes"]))
+            yield {"ok": True, "stats": self.manager.stats()}
+        elif op == "stats":
+            yield {"ok": True, "stats": self.manager.stats(),
+                   "worker": self.worker_id}
+        else:
+            yield {"ok": False, "error": f"unknown op {op!r}"}
+
+    async def stop(self):
+        self.manager.on_change = None
+        for h in self._handles:
+            await h.stop(graceful=False)
+        self._handles.clear()
+
+
+class RemoteKvbm:
+    """Worker-side client: leader lookup + peer fetch into the local tier."""
+
+    def __init__(self, runtime, manager: KvbmManager,
+                 namespace: str = "dynamo", worker_id: Optional[int] = None):
+        self.runtime = runtime
+        self.manager = manager
+        self.namespace = namespace
+        self.worker_id = worker_id
+        self._lookup_client = None
+        self._fetch_client = None
+        self.fetched_blocks = 0
+
+    async def _clients(self):
+        if self._lookup_client is None:
+            comp = self.runtime.namespace(self.namespace).component(KVBM_COMPONENT)
+            self._lookup_client = await comp.endpoint("lookup").client().start()
+            self._fetch_client = await comp.endpoint("fetch").client().start()
+        return self._lookup_client, self._fetch_client
+
+    async def fetch_into_host(self, hashes: list[int]) -> int:
+        """Pull missing blocks from their owners into the local host tier.
+        Returns how many blocks landed."""
+        hashes = [h for h in hashes if h not in self.manager]
+        if not hashes:
+            return 0
+        lookup, fetch = await self._clients()
+        try:
+            recv = await lookup.generate(
+                {"hashes": hashes, "exclude": self.worker_id})
+            owners = []
+            async for frame in recv:
+                owners = frame.get("owners", [])
+        except NoRespondersError:
+            return 0  # no leader (single-worker deployment): benign
+        # remaining hash → ordered candidate owners; batch by first choice,
+        # fail over to the next owner when a worker is unreachable or no
+        # longer holds the block
+        remaining: dict[int, list[int]] = {
+            int(h): list(wids) for h, wids in owners}
+        landed = 0
+        while remaining:
+            by_worker: dict[int, list[int]] = {}
+            for h, wids in remaining.items():
+                by_worker.setdefault(wids[0], []).append(h)
+            # every pass either pops a hash (fetched / out of candidates)
+            # or shortens its owner list, so the loop must terminate
+            for wid, hs in by_worker.items():
+                got: set[int] = set()
+                try:
+                    recv = await fetch.generate({"hashes": hs}, mode="direct",
+                                                instance_id=wid)
+                    async for frame in recv:
+                        h, k, v = _unpack_block(frame)
+                        self.manager.put(h, k, v)
+                        got.add(h)
+                        landed += 1
+                except Exception:
+                    logger.warning("kvbm fetch from worker %x failed", wid,
+                                   exc_info=True)
+                for h in hs:
+                    if h in got:
+                        remaining.pop(h, None)
+                    else:  # this owner failed us: advance to the next
+                        wids = remaining.get(h)
+                        if wids is not None:
+                            wids.remove(wid)
+                            if not wids:
+                                remaining.pop(h, None)
+        self.fetched_blocks += landed
+        return landed
+
+
+class KvbmController:
+    """Admin client for the runtime controller endpoints (ref:
+    controller.rs): fans reset/resize/stats out to every worker."""
+
+    def __init__(self, runtime, namespace: str = "dynamo"):
+        self.runtime = runtime
+        self.namespace = namespace
+        self._client = None
+
+    async def _control(self):
+        if self._client is None:
+            comp = self.runtime.namespace(self.namespace).component(KVBM_COMPONENT)
+            self._client = await comp.endpoint("control").client().start()
+        return self._client
+
+    async def _fanout(self, request: dict) -> list[dict]:
+        client = await self._control()
+        out = []
+        for iid in client.available_ids():
+            recv = await client.generate(request, mode="direct",
+                                         instance_id=iid)
+            async for frame in recv:
+                out.append(frame)
+        return out
+
+    async def reset_pools(self) -> int:
+        return len(await self._fanout({"op": "reset"}))
+
+    async def resize_host(self, host_bytes: int) -> list[dict]:
+        return await self._fanout({"op": "resize", "host_bytes": host_bytes})
+
+    async def stats(self) -> list[dict]:
+        return await self._fanout({"op": "stats"})

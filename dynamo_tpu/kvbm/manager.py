@@ -30,12 +30,29 @@ class KvbmManager:
     event loop serves the host tier, so every tier access takes the lock."""
 
     def __init__(self, host_bytes: int, disk_dir: Optional[str] = None,
-                 disk_bytes: int = 0):
+                 disk_bytes: int = 0, on_change=None):
         self.host = HostTier(host_bytes)
         self.disk = DiskTier(disk_dir, disk_bytes) if (disk_dir and disk_bytes) else None
         self.offloaded_blocks = 0
         self.onboarded_blocks = 0
         self._lock = threading.Lock()
+        #: on_change(stored_hashes, removed_hashes) — removed=None means
+        #: cleared-all. Feeds the distributed KVBM leader's ownership map
+        #: (ref: block_manager/events.rs block store/evict events).
+        self.on_change = on_change
+
+    def _notify(self, stored: list[int], removed) -> None:
+        """Fire on_change. MUST be called with the lock held: mutation and
+        notification stay atomic so events reach the distributed leader in
+        tier-state order (a notify after lock release can interleave with a
+        concurrent re-insert and leave the ownership map wrong). The
+        callback must therefore be non-blocking (the worker service's is:
+        pack + call_soon_threadsafe)."""
+        if self.on_change is not None and (stored or removed or removed is None):
+            try:
+                self.on_change(stored, removed)
+            except Exception:
+                logger.exception("kvbm on_change callback failed")
 
     # -- queries -------------------------------------------------------------
 
@@ -63,9 +80,45 @@ class KvbmManager:
             if h in self.host:
                 return
             self.offloaded_blocks += 1
-            for eh, ek, ev in self.host.put(h, k, v):
-                if self.disk is not None:
-                    self.disk.put(eh, ek, ev)
+            removed = self._cascade(self.host.put(h, k, v))
+            self._notify([h], removed)
+
+    def resident_hashes(self) -> list[int]:
+        """Host-tier contents snapshot (for fleet-join announcements)."""
+        with self._lock:
+            return list(self.host._store)
+
+    def _cascade(self, host_evicted) -> list[int]:
+        """Push host evictions into disk; return hashes gone from ALL tiers.
+        Caller holds the lock."""
+        removed: list[int] = []
+        for eh, ek, ev in host_evicted:
+            if self.disk is not None:
+                removed.extend(self.disk.put(eh, ek, ev))
+                if eh not in self.disk:  # too big for the disk budget
+                    removed.append(eh)
+            else:
+                removed.append(eh)
+        return removed
+
+    # -- runtime controller surface (ref: block_manager/controller.rs) -------
+
+    def clear(self) -> None:
+        """Drop every tier (admin reset)."""
+        with self._lock:
+            self.host.clear()
+            if self.disk is not None:
+                self.disk.clear()
+            self._notify([], None)
+
+    def resize_host(self, capacity_bytes: int) -> None:
+        """Change the host-tier byte budget at runtime; shrinking evicts LRU
+        entries (cascading into disk when configured)."""
+        with self._lock:
+            self.host.capacity = max(0, int(capacity_bytes))
+            removed = self._cascade(
+                self.host.evict_to_capacity(self.host.capacity))
+            self._notify([], removed)
 
     # -- onboard (G2/G3 → caller) --------------------------------------------
 
@@ -82,9 +135,11 @@ class KvbmManager:
             if self.disk is not None:
                 e = self.disk.get(h)
                 if e is not None:
-                    # promote back to host (it is hot again)
-                    for eh, ek, ev in self.host.put(h, e[0], e[1]):
-                        self.disk.put(eh, ek, ev)
+                    # promote back to host (it is hot again); evictions the
+                    # promotion forces out of ALL tiers must be announced
+                    # like any other, or the leader's map goes stale
+                    removed = self._cascade(self.host.put(h, e[0], e[1]))
+                    self._notify([], removed)
                     return e
             return None
 

@@ -41,13 +41,20 @@ class HostTier:
         size = k.nbytes + v.nbytes
         if size > self.capacity:
             return []  # can never fit: drop without flushing the tier
+        evicted = self.evict_to_capacity(self.capacity - size)
+        self._store[h] = (k, v)
+        self.used += size
+        return evicted
+
+    def evict_to_capacity(self, capacity: int) -> list[tuple]:
+        """Pop LRU entries until ``used <= capacity``; returns the evicted
+        (hash, k, v) entries. The ONE place eviction accounting lives —
+        put() and the runtime resize both go through it."""
         evicted = []
-        while self._store and self.used + size > self.capacity:
+        while self._store and self.used > capacity:
             eh, (ek, ev) = self._store.popitem(last=False)
             self.used -= ek.nbytes + ev.nbytes
             evicted.append((eh, ek, ev))
-        self._store[h] = (k, v)
-        self.used += size
         return evicted
 
     def get(self, h: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
@@ -89,26 +96,32 @@ class DiskTier:
     def __len__(self) -> int:
         return len(self._index)
 
-    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> None:
+    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> list[int]:
+        """Insert; returns hashes evicted out of the tier entirely."""
         if h in self._index:
             self._index.move_to_end(h)
-            return
+            return []
         size = k.nbytes + v.nbytes
         if size > self.capacity:
-            return  # can never fit: drop without flushing the tier
+            return []  # can never fit: drop without flushing the tier
+        evicted = []
         while self._index and self.used + size > self.capacity:
             eh, esize = self._index.popitem(last=False)
             self.used -= esize
+            evicted.append(eh)
             try:
                 os.unlink(self._path(eh))
             except OSError:
                 pass
-        # bf16 has no npy codec — store raw bytes + dtype string
+        # bf16 has no npy codec — store raw bytes + dtype string; k and v
+        # shapes are stored separately (MLA caches are asymmetric)
         np.savez(self._path(h),
                  k=k.view(np.uint8), v=v.view(np.uint8),
-                 shape=np.asarray(k.shape), dtype=str(k.dtype))
+                 k_shape=np.asarray(k.shape), v_shape=np.asarray(v.shape),
+                 dtype=str(k.dtype))
         self._index[h] = size
         self.used += size
+        return evicted
 
     def get(self, h: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
         if h not in self._index:
@@ -119,9 +132,8 @@ class DiskTier:
 
                 dtype = np.dtype(getattr(ml_dtypes, str(z["dtype"]), None)
                                  or str(z["dtype"]))
-                shape = tuple(z["shape"])
-                k = z["k"].view(dtype).reshape(shape)
-                v = z["v"].view(dtype).reshape(shape)
+                k = z["k"].view(dtype).reshape(tuple(z["k_shape"]))
+                v = z["v"].view(dtype).reshape(tuple(z["v_shape"]))
         except Exception:
             logger.exception("disk tier read failed for %x", h)
             self._index.pop(h, None)

@@ -1,0 +1,207 @@
+"""Distributed KVBM: leader/worker rendezvous, ownership map, cross-worker
+block fetch, and the runtime controller (ref: block_manager/distributed/
+{leader.rs,worker.rs}, controller.rs, leader_worker_barrier.rs:14)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.kvbm import KvbmManager
+from dynamo_tpu.kvbm.distributed import (
+    KvbmController, KvbmLeader, KvbmWorkerService, RemoteKvbm,
+)
+from dynamo_tpu.runtime import DistributedRuntime
+
+pytestmark = pytest.mark.anyio
+
+
+def blk(seed: int, shape=(2, 4, 2, 8)):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape, np.float32),
+            rng.standard_normal(shape, np.float32))
+
+
+@pytest.fixture
+async def fleet():
+    """Leader + two kvbm workers sharing one in-process control plane."""
+    rt = await DistributedRuntime.create()
+    m1 = KvbmManager(1 << 20)
+    m2 = KvbmManager(1 << 20)
+    # worker runtimes share the plane but own their leases
+    rt1 = await DistributedRuntime.create(plane=rt.plane, owns_plane=False)
+    rt2 = await DistributedRuntime.create(plane=rt.plane, owns_plane=False)
+    leader = KvbmLeader(rt, num_workers=2)
+    lt = asyncio.get_running_loop().create_task(leader.start())
+    # workers rendezvous at the barrier — start them concurrently
+    w1, w2 = await asyncio.gather(KvbmWorkerService(rt1, m1).start(),
+                                  KvbmWorkerService(rt2, m2).start())
+    await lt
+    try:
+        yield rt, leader, (m1, w1, rt1), (m2, w2, rt2)
+    finally:
+        await w1.stop()
+        await w2.stop()
+        await leader.stop()
+        await rt1.shutdown()
+        await rt2.shutdown()
+        await rt.shutdown()
+
+
+async def _settle(check, timeout=5.0):
+    for _ in range(int(timeout / 0.05)):
+        if check():
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError("condition never settled")
+
+
+async def test_ownership_and_cross_worker_fetch(fleet):
+    rt, leader, (m1, w1, rt1), (m2, w2, rt2) = fleet
+
+    k, v = blk(1)
+    m1.put(101, k, v)
+    m1.put(102, *blk(2))
+    await _settle(lambda: 101 in leader.owners and 102 in leader.owners)
+    assert leader.owners[101] == {w1.worker_id}
+
+    # worker 2 pulls the blocks it misses straight from worker 1
+    remote = RemoteKvbm(rt2, m2, worker_id=w2.worker_id)
+    landed = await remote.fetch_into_host([101, 102, 999])
+    assert landed == 2
+    got = m2.get_host(101)
+    np.testing.assert_array_equal(got[0], k)
+    np.testing.assert_array_equal(got[1], v)
+    # ... and now the leader sees both workers owning the block
+    await _settle(lambda: leader.owners.get(101) == {w1.worker_id, w2.worker_id})
+
+    # a worker never fetches from itself
+    remote1 = RemoteKvbm(rt1, m1, worker_id=w1.worker_id)
+    assert await remote1.fetch_into_host([101]) == 0
+
+
+async def test_eviction_updates_ownership(fleet):
+    rt, leader, (m1, w1, rt1), _ = fleet
+    k, v = blk(3)
+    tiny = KvbmManager(k.nbytes + v.nbytes + 64)  # fits exactly one block
+    tiny.on_change = m1.on_change  # reuse worker 1's announcer
+    m1_on = w1.manager
+    w1.manager = tiny
+    try:
+        tiny.put(201, k, v)
+        await _settle(lambda: 201 in leader.owners)
+        tiny.put(202, *blk(4))  # evicts 201 (no disk tier → gone)
+        await _settle(lambda: 201 not in leader.owners)
+        assert 202 in leader.owners
+    finally:
+        w1.manager = m1_on
+
+
+async def test_controller_reset_resize_stats(fleet):
+    rt, leader, (m1, w1, rt1), (m2, w2, rt2) = fleet
+    m1.put(301, *blk(5))
+    m2.put(302, *blk(6))
+
+    ctl = KvbmController(rt)
+    stats = await ctl.stats()
+    assert len(stats) == 2
+    assert sum(s["stats"]["host_blocks"] for s in stats) == 2
+
+    # shrink worker tiers to nothing → blocks evicted
+    out = await ctl.resize_host(0)
+    assert all(o["ok"] for o in out)
+    assert len(m1.host) == 0 and len(m2.host) == 0
+    await _settle(lambda: 301 not in leader.owners and 302 not in leader.owners)
+
+    # reset is idempotent and clears everything
+    m1.resize_host(1 << 20)
+    m1.put(303, *blk(7))
+    assert await ctl.reset_pools() == 2
+    assert len(m1.host) == 0
+    await _settle(lambda: 303 not in leader.owners)
+
+
+async def test_engine_remote_onboard_e2e():
+    """Two engines with distributed KVBM: engine A serves a prompt (blocks
+    offload to its host tier); engine B — cold — admits the same prompt,
+    background-fetches the prefix from A, and the SECOND admission onboards
+    from host instead of recomputing."""
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    rt = await DistributedRuntime.create()
+    rt1 = await DistributedRuntime.create(plane=rt.plane, owns_plane=False)
+    rt2 = await DistributedRuntime.create(plane=rt.plane, owns_plane=False)
+    cfg = ModelConfig.tiny()
+    args = EngineArgs(block_size=4, num_blocks=64, max_num_seqs=4,
+                      max_num_batched_tokens=32, max_model_len=128,
+                      prefill_buckets=(8, 16, 32),
+                      decode_batch_buckets=(1, 2, 4),
+                      kvbm_host_bytes=1 << 22)
+    e1 = AsyncJaxEngine(cfg, args)
+    e2 = AsyncJaxEngine(cfg, args)
+
+    leader = KvbmLeader(rt, num_workers=2)
+    lt = asyncio.get_running_loop().create_task(leader.start())
+    w1, w2 = await asyncio.gather(
+        KvbmWorkerService(rt1, e1.kvbm, engine=e1).start(),
+        KvbmWorkerService(rt2, e2.kvbm, engine=e2).start())
+    await lt
+    e2.kvbm_remote = RemoteKvbm(rt2, e2.kvbm, worker_id=w2.worker_id)
+
+    async def run(eng, prompt):
+        r = PreprocessedRequest(
+            model="t", token_ids=prompt,
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+        toks = []
+        async for out in eng.generate(r):
+            toks.extend(out.token_ids)
+        return toks
+
+    try:
+        prompt = list(range(1, 17))  # 4 full blocks
+        t1 = await run(e1, prompt)
+        await _settle(lambda: len(e1.kvbm.host) >= 3)  # offloads landed
+        await _settle(lambda: any(h in leader.owners
+                                  for h in list(e1.kvbm.host._store)))
+
+        # cold engine B: first admission misses locally, triggers the
+        # background peer fetch into B's host tier
+        t2 = await run(e2, prompt)
+        assert t2 == t1  # same greedy tokens either way
+        await _settle(lambda: len(e2.kvbm.host) >= 1, timeout=10.0)
+        before = e2.kvbm.onboarded_blocks
+        # drop B's DEVICE prefix cache so the next admission must onboard
+        # from the host tier (where the peer-fetched blocks landed)
+        e2.pool.clear()
+        t3 = await run(e2, prompt)
+        assert t3 == t1
+        assert e2.kvbm.onboarded_blocks > before
+    finally:
+        await w1.stop()
+        await w2.stop()
+        await leader.stop()
+        await e1.close()
+        await e2.close()
+        await rt1.shutdown()
+        await rt2.shutdown()
+        await rt.shutdown()
+
+
+async def test_dead_worker_purged_from_ownership(fleet):
+    """A worker whose lease dies must vanish from the leader's map — its
+    fetch instance key deletion drives the purge (no stale shadows)."""
+    rt, leader, (m1, w1, rt1), (m2, w2, rt2) = fleet
+    m1.put(401, *blk(8))
+    m2.put(402, *blk(9))
+    await _settle(lambda: 401 in leader.owners and 402 in leader.owners)
+
+    # worker 1 dies (stop endpoints, revoke lease → instance keys vanish)
+    await w1.stop()
+    await rt1.shutdown()
+    await _settle(lambda: 401 not in leader.owners)
+    assert 402 in leader.owners  # survivor untouched
