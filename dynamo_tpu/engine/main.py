@@ -19,8 +19,7 @@ import asyncio
 import signal
 
 from dynamo_tpu.engine.config import EngineArgs, ModelConfig
-from dynamo_tpu.llm.model_card import (ModelDeploymentCard,
-                                       register_llm, resolve_eos_token_ids)
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
 from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.runtime import DistributedRuntime
 from dynamo_tpu.runtime.config import setup_logging
@@ -38,9 +37,8 @@ def build_engine(cli, cfg: ModelConfig, args: EngineArgs):
         mesh = make_mesh(MeshConfig(dp=args.dp_size, sp=1, tp=args.tp_size))
 
     params = None
-    if cli.model_path:
-        from dynamo_tpu.engine.loader import load_hf_params
-        params = load_hf_params(cfg, cli.model_path)
+    if getattr(cli, "_resolved_model", None) is not None:
+        params = cli._resolved_model.load_params(cfg)
 
     return AsyncJaxEngine(cfg, args, params=params, mesh=mesh)
 
@@ -104,7 +102,7 @@ async def amain():
                          "contents, serve fetch/control, pull peer blocks "
                          "(ref: block_manager/distributed/worker.rs:137). "
                          "Requires a kvbm leader (--kvbm-leader-workers on "
-                         "one process, or dynamo_tpu.kvbm.main)")
+                         "one worker, or python -m dynamo_tpu.kvbm.main)")
     ap.add_argument("--kvbm-leader-workers", type=int, default=0,
                     help="also run the KVBM leader in this process, "
                          "expecting N workers at the startup barrier "
@@ -113,8 +111,16 @@ async def amain():
 
     # resolve model metadata BEFORE the heavy engine build so a
     # misconfiguration fails in milliseconds, not after param init
+    cli._resolved_model = None
+    if cli.model_path:
+        from dynamo_tpu.llm.resolve import resolve_model
+        try:
+            cli._resolved_model = resolve_model(cli.model_path)
+        except FileNotFoundError as e:
+            raise SystemExit(str(e))
     eos: list[int] = []
-    tokenizer_ref = cli.tokenizer or cli.model_path
+    tokenizer_ref = cli.tokenizer or (
+        cli._resolved_model.tokenizer_ref if cli._resolved_model else None)
     if cli.role != "prefill":
         if cli.eos_token_ids:
             try:
@@ -124,9 +130,9 @@ async def amain():
                          f"got {cli.eos_token_ids!r}")
             if not eos:
                 ap.error("--eos-token-ids is empty")
-        elif cli.model_path:
+        elif cli._resolved_model is not None:
             try:
-                eos = resolve_eos_token_ids(cli.model_path)
+                eos = cli._resolved_model.eos_token_ids()
             except ValueError as e:
                 raise SystemExit(f"{e}; pass --eos-token-ids")
         elif cli.allow_test_metadata:
@@ -143,8 +149,8 @@ async def amain():
                 "tokenizer/EOS metadata. Pass --model-path, or --eos-token-ids "
                 "plus --tokenizer, or --allow-test-metadata for tests.")
 
-    if cli.model_path:
-        cfg = ModelConfig.from_pretrained(cli.model_path)
+    if cli._resolved_model is not None:
+        cfg = cli._resolved_model.config()
     else:
         from dynamo_tpu.models import get_model_config
         cfg = get_model_config(cli.arch or "tiny")
@@ -207,23 +213,29 @@ async def amain():
 
     kvbm_leader = None
     kvbm_worker = None
-    if cli.kvbm_leader_workers:
-        from dynamo_tpu.kvbm.distributed import KvbmLeader
-        kvbm_leader = KvbmLeader(runtime, cli.namespace,
-                                 num_workers=cli.kvbm_leader_workers)
-        leader_task = asyncio.get_running_loop().create_task(
-            kvbm_leader.start())  # barrier completes once workers join
-    if cli.kvbm_distributed:
-        if engine.kvbm is None:
-            ap.error("--kvbm-distributed needs --kvbm-host-gb > 0")
-        from dynamo_tpu.kvbm.distributed import KvbmWorkerService, RemoteKvbm
-        kvbm_worker = await KvbmWorkerService(
-            runtime, engine.kvbm, cli.namespace, engine=engine).start()
-        engine.kvbm_remote = RemoteKvbm(
-            runtime, engine.kvbm, cli.namespace,
-            worker_id=kvbm_worker.worker_id)
-    if kvbm_leader is not None:
-        await leader_task
+    if cli.kvbm_distributed and engine.kvbm is None:
+        ap.error("--kvbm-distributed needs --kvbm-host-gb > 0")
+    if cli.kvbm_leader_workers or cli.kvbm_distributed:
+        from dynamo_tpu.kvbm.distributed import (
+            KvbmLeader, KvbmWorkerService, RemoteKvbm,
+        )
+        # leader and worker rendezvous at the same barrier — start them
+        # concurrently so an early leader failure (stale leader key, etc.)
+        # surfaces immediately instead of masking behind a barrier timeout
+        starts = []
+        if cli.kvbm_leader_workers:
+            kvbm_leader = KvbmLeader(runtime, cli.namespace,
+                                     num_workers=cli.kvbm_leader_workers)
+            starts.append(kvbm_leader.start())
+        if cli.kvbm_distributed:
+            kvbm_worker = KvbmWorkerService(
+                runtime, engine.kvbm, cli.namespace, engine=engine)
+            starts.append(kvbm_worker.start())
+        await asyncio.gather(*starts)
+        if kvbm_worker is not None:
+            engine.kvbm_remote = RemoteKvbm(
+                runtime, engine.kvbm, cli.namespace,
+                worker_id=kvbm_worker.worker_id)
 
     handle = await ep.serve_endpoint(serve, lease_id=lease)
     embed_handle = None

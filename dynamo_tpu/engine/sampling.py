@@ -19,11 +19,13 @@ TOP_K_CAP = 64
 
 
 def _mask_top_k(logits, top_k):
-    """Keep each row's top-k logits (k dynamic per row, capped at TOP_K_CAP)."""
-    vals, _ = jax.lax.top_k(logits, TOP_K_CAP)  # [B, CAP] sorted desc
-    k = jnp.clip(top_k, 1, TOP_K_CAP)
+    """Keep each row's top-k logits (k dynamic per row, capped at TOP_K_CAP;
+    the cap clamps to the vocab for toy models smaller than it)."""
+    cap = min(TOP_K_CAP, logits.shape[-1])
+    vals, _ = jax.lax.top_k(logits, cap)  # [B, cap] sorted desc
+    k = jnp.clip(top_k, 1, cap)
     kth = vals[jnp.arange(logits.shape[0]), k - 1]  # [B]
-    use = (top_k > 0) & (top_k <= TOP_K_CAP)
+    use = (top_k > 0) & (top_k <= cap)
     cut = jnp.where(use, kth, -jnp.inf)
     return jnp.where(logits >= cut[:, None], logits, -jnp.inf)
 

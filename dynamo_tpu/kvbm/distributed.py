@@ -37,13 +37,19 @@ import msgpack
 import numpy as np
 
 from dynamo_tpu.kvbm.manager import KvbmManager
+from dynamo_tpu.router.publisher import _spawn_publish
 from dynamo_tpu.runtime.barrier import LeaderWorkerBarrier
 from dynamo_tpu.runtime.control_plane import NoRespondersError
 
 logger = logging.getLogger("dynamo.kvbm.dist")
 
 KVBM_COMPONENT = "kvbm"
-KVBM_EVENTS_SUBJECT = "kvbm_events"
+
+
+def _events_subject(namespace: str) -> str:
+    """Per-namespace events subject — two fleets sharing one control plane
+    must not fold each other's ownership events."""
+    return f"kvbm_events.{namespace}"
 
 
 def _pack_block(h: int, k: np.ndarray, v: np.ndarray) -> dict:
@@ -84,7 +90,7 @@ class KvbmLeader:
 
     async def start(self, barrier_timeout: float = 120.0) -> "KvbmLeader":
         rt = self.runtime
-        self._sub = await rt.plane.subscribe(KVBM_EVENTS_SUBJECT)
+        self._sub = await rt.plane.subscribe(_events_subject(self.namespace))
         loop = asyncio.get_running_loop()
         self._sub_task = loop.create_task(self._event_loop())
         # prune dead workers: a worker's fetch instance key vanishes with
@@ -224,11 +230,14 @@ class KvbmWorkerService:
             ev["stored"] = list(stored)
             ev["removed"] = list(removed)
         payload = msgpack.packb(ev)
+        subject = _events_subject(self.namespace)
         # tier writes run on to_thread workers (engine offload path); hop
-        # back onto the loop so the publish rides the runtime's connection
+        # back onto the loop so the publish rides the runtime's connection.
+        # _spawn_publish keeps a strong task ref + logs failures — a GC'd
+        # or silently-failed publish would leave the leader's map stale.
         self._loop.call_soon_threadsafe(
-            lambda: self._loop.create_task(
-                self.runtime.plane.publish(KVBM_EVENTS_SUBJECT, payload)))
+            _spawn_publish, self,
+            self.runtime.plane.publish(subject, payload))
 
     # -- endpoints ----------------------------------------------------------
 

@@ -1,0 +1,54 @@
+"""``python -m dynamo_tpu.kvbm.main`` — standalone distributed-KVBM leader.
+
+Runs the cluster-wide block-ownership leader (ref: block_manager/
+distributed/leader.rs:126) as its own process: engine workers join with
+``--kvbm-distributed`` and the fleet rendezvous at the startup barrier.
+Alternative to colocating the leader in one engine process via
+``--kvbm-leader-workers``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.config import setup_logging
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="dynamo-tpu KVBM leader")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--num-workers", type=int, required=True,
+                    help="workers expected at the startup barrier")
+    ap.add_argument("--host-bytes", type=int, default=0,
+                    help="shared host-tier budget pushed to every worker "
+                         "at the barrier (0 = keep each worker's own)")
+    ap.add_argument("--barrier-timeout", type=float, default=300.0)
+    cli = ap.parse_args()
+
+    from dynamo_tpu.kvbm.distributed import KvbmLeader
+
+    runtime = await DistributedRuntime.create()
+    leader = KvbmLeader(runtime, cli.namespace, num_workers=cli.num_workers,
+                        host_bytes=cli.host_bytes or None)
+    await leader.start(barrier_timeout=cli.barrier_timeout)
+    print("KVBM_LEADER_READY", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await leader.stop()
+    await runtime.shutdown()
+
+
+def main():
+    setup_logging()
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

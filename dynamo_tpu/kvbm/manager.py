@@ -90,11 +90,14 @@ class KvbmManager:
 
     def _cascade(self, host_evicted) -> list[int]:
         """Push host evictions into disk; return hashes gone from ALL tiers.
-        Caller holds the lock."""
+        Caller holds the lock. Disk evictions are checked against the host
+        tier: a get()-promoted block lives in both, and evicting its disk
+        copy must not report the block removed while host still serves it."""
         removed: list[int] = []
         for eh, ek, ev in host_evicted:
             if self.disk is not None:
-                removed.extend(self.disk.put(eh, ek, ev))
+                removed.extend(h for h in self.disk.put(eh, ek, ev)
+                               if h not in self.host)
                 if eh not in self.disk:  # too big for the disk budget
                     removed.append(eh)
             else:

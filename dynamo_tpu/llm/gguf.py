@@ -1,0 +1,274 @@
+"""GGUF file parser: metadata, tensor index, tokenizer extraction.
+
+Rebuild of the reference's GGUF support (ref: lib/llm/src/gguf/*.rs — it
+parses metadata + tokenizer out of llama.cpp model files to build the
+ModelDeploymentCard and preprocessor; actual quantized inference is the
+llama.cpp engine's job there). Here the same surface:
+
+- ``GGUFFile.parse`` reads the header, all metadata KV pairs, and the
+  tensor index (name/shape/type/offset) without touching tensor data.
+- ``config_from_gguf`` maps ``llama.*``/``qwen2.*`` metadata keys onto
+  :class:`ModelConfig`.
+- ``tokenizer_from_gguf`` rebuilds a HF ``tokenizers`` BPE from the
+  embedded ``tokenizer.ggml.*`` arrays.
+- ``load_tensor`` materializes F32/F16/BF16 tensors (enough to serve an
+  unquantized export natively; quantized ggml types are indexed but load
+  refuses them loudly rather than dequantizing silently wrong).
+
+Format per the public GGUF spec (ggml project): little-endian, magic
+"GGUF", version 3; strings are u64-length-prefixed UTF-8; arrays carry an
+element type + u64 count.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, BinaryIO, Optional
+
+import numpy as np
+
+GGUF_MAGIC = b"GGUF"
+
+# metadata value types
+_U8, _I8, _U16, _I16, _U32, _I32, _F32, _BOOL, _STR, _ARR, _U64, _I64, _F64 = range(13)
+
+_SCALAR_FMT = {
+    _U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I", _I32: "<i",
+    _F32: "<f", _U64: "<Q", _I64: "<q", _F64: "<d",
+}
+
+#: ggml tensor dtypes we can materialize (id → numpy dtype factory)
+GGML_F32, GGML_F16 = 0, 1
+GGML_BF16 = 30
+
+
+def _np_dtype(ggml_type: int):
+    if ggml_type == GGML_F32:
+        return np.dtype(np.float32)
+    if ggml_type == GGML_F16:
+        return np.dtype(np.float16)
+    if ggml_type == GGML_BF16:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return None
+
+
+@dataclass
+class GGUFTensorInfo:
+    name: str
+    shape: tuple[int, ...]  # numpy/row-major order (GGUF stores reversed)
+    ggml_type: int
+    offset: int  # relative to data_start
+
+
+@dataclass
+class GGUFFile:
+    path: str
+    version: int
+    metadata: dict[str, Any]
+    tensors: dict[str, GGUFTensorInfo]
+    data_start: int
+    alignment: int = 32
+
+    # -- parsing -----------------------------------------------------------
+
+    @staticmethod
+    def _read_str(f: BinaryIO) -> str:
+        (n,) = struct.unpack("<Q", f.read(8))
+        return f.read(n).decode("utf-8", "replace")
+
+    @classmethod
+    def _read_value(cls, f: BinaryIO, vtype: int):
+        if vtype in _SCALAR_FMT:
+            fmt = _SCALAR_FMT[vtype]
+            (v,) = struct.unpack(fmt, f.read(struct.calcsize(fmt)))
+            return v
+        if vtype == _BOOL:
+            return f.read(1)[0] != 0
+        if vtype == _STR:
+            return cls._read_str(f)
+        if vtype == _ARR:
+            (etype,) = struct.unpack("<I", f.read(4))
+            (count,) = struct.unpack("<Q", f.read(8))
+            if etype in _SCALAR_FMT:
+                # bulk-read scalar arrays (token scores etc. can be 100k+)
+                fmt = _SCALAR_FMT[etype]
+                size = struct.calcsize(fmt)
+                buf = f.read(size * count)
+                return list(np.frombuffer(buf, dtype=fmt[1]).tolist())
+            return [cls._read_value(f, etype) for _ in range(count)]
+        raise ValueError(f"unknown GGUF value type {vtype}")
+
+    @classmethod
+    def parse(cls, path: str) -> "GGUFFile":
+        with open(path, "rb") as f:
+            if f.read(4) != GGUF_MAGIC:
+                raise ValueError(f"{path}: not a GGUF file")
+            (version,) = struct.unpack("<I", f.read(4))
+            if version < 2:
+                raise ValueError(f"{path}: GGUF v{version} unsupported (< 2)")
+            n_tensors, n_kv = struct.unpack("<QQ", f.read(16))
+
+            metadata: dict[str, Any] = {}
+            for _ in range(n_kv):
+                key = cls._read_str(f)
+                (vtype,) = struct.unpack("<I", f.read(4))
+                metadata[key] = cls._read_value(f, vtype)
+
+            tensors: dict[str, GGUFTensorInfo] = {}
+            for _ in range(n_tensors):
+                name = cls._read_str(f)
+                (nd,) = struct.unpack("<I", f.read(4))
+                dims = struct.unpack(f"<{nd}Q", f.read(8 * nd))
+                gtype, offset = struct.unpack("<IQ", f.read(12))
+                # GGUF dims are innermost-first; numpy wants outermost-first
+                tensors[name] = GGUFTensorInfo(
+                    name=name, shape=tuple(reversed(dims)),
+                    ggml_type=gtype, offset=offset)
+
+            alignment = int(metadata.get("general.alignment", 32))
+            pos = f.tell()
+            data_start = (pos + alignment - 1) // alignment * alignment
+        return cls(path=path, version=version, metadata=metadata,
+                   tensors=tensors, data_start=data_start, alignment=alignment)
+
+    # -- tensor data -------------------------------------------------------
+
+    def load_tensor(self, name: str) -> np.ndarray:
+        info = self.tensors[name]
+        dtype = _np_dtype(info.ggml_type)
+        if dtype is None:
+            raise NotImplementedError(
+                f"tensor {name}: ggml type {info.ggml_type} is quantized — "
+                "native serving needs an F32/F16/BF16 export (quantized GGUF "
+                "would be dequantized silently wrong; refusing)")
+        count = int(np.prod(info.shape)) if info.shape else 1
+        with open(self.path, "rb") as f:
+            f.seek(self.data_start + info.offset)
+            buf = f.read(count * dtype.itemsize)
+        return np.frombuffer(buf, dtype=dtype).reshape(info.shape)
+
+    @property
+    def architecture(self) -> str:
+        return str(self.metadata.get("general.architecture", ""))
+
+
+def config_from_gguf(g: GGUFFile):
+    """Map ``<arch>.*`` metadata keys onto ModelConfig (ref: gguf.rs builds
+    the same view for its ModelDeploymentCard)."""
+    from dynamo_tpu.engine.config import ModelConfig
+
+    arch = g.architecture
+    if arch not in ("llama", "mistral", "qwen2"):
+        raise NotImplementedError(
+            f"GGUF architecture '{arch}' not supported (llama/mistral/qwen2)")
+    md = g.metadata
+
+    def key(name, default=None):
+        return md.get(f"{arch}.{name}", default)
+
+    n_heads = int(key("attention.head_count", 32))
+    vocab = md.get("tokenizer.ggml.tokens")
+    vocab_size = int(key("vocab_size", len(vocab) if vocab else 32000))
+    return ModelConfig(
+        vocab_size=vocab_size,
+        hidden_size=int(key("embedding_length", 4096)),
+        intermediate_size=int(key("feed_forward_length", 11008)),
+        num_layers=int(key("block_count", 32)),
+        num_heads=n_heads,
+        num_kv_heads=int(key("attention.head_count_kv", n_heads)),
+        rope_theta=float(key("rope.freq_base", 10000.0)),
+        rms_norm_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
+        max_position_embeddings=int(key("context_length", 8192)),
+        qkv_bias=arch == "qwen2",
+    )
+
+
+def tokenizer_from_gguf(g: GGUFFile):
+    """HF ``tokenizers.Tokenizer`` from the embedded ggml vocab.
+
+    Supports the BPE ('gpt2') vocab model: tokens + merges come straight
+    from ``tokenizer.ggml.*``. SentencePiece-style ('llama') vocabs carry
+    scores instead of merges; those are rebuilt as a greedy Unigram over
+    the token scores — byte-fallback tokens included.
+    """
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+
+    md = g.metadata
+    tokens = md.get("tokenizer.ggml.tokens")
+    if not tokens:
+        raise ValueError("GGUF carries no tokenizer.ggml.tokens")
+    model_kind = md.get("tokenizer.ggml.model", "gpt2")
+
+    if model_kind == "gpt2":
+        vocab = {t: i for i, t in enumerate(tokens)}
+        merges = []
+        for m in md.get("tokenizer.ggml.merges", []):
+            a, _, b = m.partition(" ")
+            merges.append((a, b))
+        tk = Tokenizer(models.BPE(vocab=vocab, merges=merges,
+                                  byte_fallback=False))
+        tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        tk.decoder = decoders.ByteLevel()
+        return tk
+    if model_kind == "llama":
+        scores = md.get("tokenizer.ggml.scores") or [0.0] * len(tokens)
+        tk = Tokenizer(models.Unigram(
+            vocab=list(zip(tokens, [float(s) for s in scores])),
+            unk_id=int(md.get("tokenizer.ggml.unknown_token_id", 0)),
+            byte_fallback=True))
+        tk.decoder = decoders.Sequence([
+            decoders.Replace("▁", " "), decoders.ByteFallback(),
+            decoders.Fuse()])
+        return tk
+    raise NotImplementedError(f"GGUF tokenizer model '{model_kind}'")
+
+
+def eos_ids_from_gguf(g: GGUFFile) -> list[int]:
+    eos = g.metadata.get("tokenizer.ggml.eos_token_id")
+    return [int(eos)] if eos is not None else []
+
+
+def load_gguf_params(g: GGUFFile, cfg, dtype=None) -> dict:
+    """GGUF tensor names → the engine's stacked params pytree (unquantized
+    exports only; see load_tensor). llama.cpp naming: ``blk.<i>.*``,
+    ``token_embd``, ``output_norm``, ``output``."""
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.dtype(cfg.dtype)
+
+    def get(name):
+        return jnp.asarray(g.load_tensor(name), dtype=dtype)
+
+    def proj(name):  # stored [out, in] like HF → transpose to [in, out]
+        return get(name).T
+
+    L = cfg.num_layers
+    stack = lambda xs: jnp.stack(xs)  # noqa: E731
+    layers = {
+        "attn_norm": stack([get(f"blk.{i}.attn_norm.weight") for i in range(L)]),
+        "mlp_norm": stack([get(f"blk.{i}.ffn_norm.weight") for i in range(L)]),
+        "wq": stack([proj(f"blk.{i}.attn_q.weight") for i in range(L)]),
+        "wk": stack([proj(f"blk.{i}.attn_k.weight") for i in range(L)]),
+        "wv": stack([proj(f"blk.{i}.attn_v.weight") for i in range(L)]),
+        "wo": stack([proj(f"blk.{i}.attn_output.weight") for i in range(L)]),
+        "w_gate": stack([proj(f"blk.{i}.ffn_gate.weight") for i in range(L)]),
+        "w_up": stack([proj(f"blk.{i}.ffn_up.weight") for i in range(L)]),
+        "w_down": stack([proj(f"blk.{i}.ffn_down.weight") for i in range(L)]),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = stack([get(f"blk.{i}.attn_q.bias") for i in range(L)])
+        layers["bk"] = stack([get(f"blk.{i}.attn_k.bias") for i in range(L)])
+        layers["bv"] = stack([get(f"blk.{i}.attn_v.bias") for i in range(L)])
+    params = {
+        "embed": get("token_embd.weight"),
+        "layers": layers,
+        "final_norm": get("output_norm.weight"),
+    }
+    if "output.weight" in g.tensors:
+        params["lm_head"] = proj("output.weight")
+    else:
+        cfg.tie_word_embeddings = True
+    return params

@@ -1,0 +1,118 @@
+"""Model resolution: local dir / GGUF file / HF hub id → servable artifacts.
+
+Rebuild of the reference's model resolution (ref: lib/llm/src/hub.rs:1-299 +
+local_model.rs:1-456 — accepts a local path, a GGUF file, or a HF repo id;
+repo ids resolve through the local HF cache before any network). Resolution
+order here:
+
+1. existing directory with ``config.json`` → HF checkpoint dir,
+2. existing ``*.gguf`` file → GGUF,
+3. ``org/name`` repo id → newest snapshot in the HF cache
+   (``$HF_HOME``/``~/.cache/huggingface/hub``), else ``huggingface_hub``
+   download when the environment allows network.
+
+Every kind answers the same four questions: model config, engine params,
+EOS ids, and the tokenizer reference to publish in the MDC.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass
+class ResolvedModel:
+    kind: str  # "hf_dir" | "gguf"
+    path: str
+
+    @property
+    def tokenizer_ref(self) -> str:
+        return self.path
+
+    def config(self):
+        if self.kind == "gguf":
+            from dynamo_tpu.llm.gguf import GGUFFile, config_from_gguf
+
+            return config_from_gguf(GGUFFile.parse(self.path))
+        from dynamo_tpu.engine.config import ModelConfig
+
+        return ModelConfig.from_pretrained(self.path)
+
+    def load_params(self, cfg, dtype=None) -> dict:
+        if self.kind == "gguf":
+            from dynamo_tpu.llm.gguf import GGUFFile, load_gguf_params
+
+            return load_gguf_params(GGUFFile.parse(self.path), cfg, dtype)
+        from dynamo_tpu.engine.loader import load_hf_params
+
+        return load_hf_params(cfg, self.path, dtype)
+
+    def eos_token_ids(self) -> list[int]:
+        if self.kind == "gguf":
+            from dynamo_tpu.llm.gguf import GGUFFile, eos_ids_from_gguf
+
+            return eos_ids_from_gguf(GGUFFile.parse(self.path))
+        from dynamo_tpu.llm.model_card import resolve_eos_token_ids
+
+        return resolve_eos_token_ids(self.path)
+
+
+def _hf_cache_dir() -> str:
+    if os.environ.get("HF_HUB_CACHE"):
+        return os.environ["HF_HUB_CACHE"]
+    home = os.environ.get("HF_HOME",
+                          os.path.expanduser("~/.cache/huggingface"))
+    return os.path.join(home, "hub")
+
+
+def _cached_snapshot(repo_id: str):
+    """Newest complete snapshot of a repo in the local HF cache, or None."""
+    repo_dir = os.path.join(_hf_cache_dir(),
+                            "models--" + repo_id.replace("/", "--"))
+    snaps = os.path.join(repo_dir, "snapshots")
+    if not os.path.isdir(snaps):
+        return None
+    candidates = [os.path.join(snaps, d) for d in os.listdir(snaps)]
+    candidates = [d for d in candidates
+                  if os.path.exists(os.path.join(d, "config.json"))]
+    if not candidates:
+        return None
+    return max(candidates, key=os.path.getmtime)
+
+
+def resolve_model(ref: str, allow_download: bool = True) -> ResolvedModel:
+    """Resolve a model reference to local artifacts (dir path, GGUF file,
+    or ``org/name`` hub id). Raises FileNotFoundError with the attempted
+    interpretations when nothing matches."""
+    if os.path.isdir(ref):
+        if os.path.exists(os.path.join(ref, "config.json")):
+            return ResolvedModel("hf_dir", ref)
+        ggufs = sorted(f for f in os.listdir(ref) if f.endswith(".gguf"))
+        if ggufs:
+            return ResolvedModel("gguf", os.path.join(ref, ggufs[0]))
+        raise FileNotFoundError(
+            f"{ref}: directory has neither config.json nor a .gguf file")
+    if os.path.isfile(ref):
+        if ref.endswith(".gguf"):
+            return ResolvedModel("gguf", ref)
+        raise FileNotFoundError(f"{ref}: only .gguf files are servable directly")
+    if "/" in ref and not ref.startswith((".", "/")):
+        snap = _cached_snapshot(ref)
+        if snap is not None:
+            return ResolvedModel("hf_dir", snap)
+        if allow_download:
+            try:
+                from huggingface_hub import snapshot_download
+
+                path = snapshot_download(ref)
+                return ResolvedModel("hf_dir", path)
+            except Exception as e:
+                raise FileNotFoundError(
+                    f"{ref}: not in the HF cache ({_hf_cache_dir()}) and "
+                    f"download failed ({e!r})") from None
+        raise FileNotFoundError(
+            f"{ref}: not in the HF cache ({_hf_cache_dir()}) and downloads "
+            "are disabled")
+    raise FileNotFoundError(
+        f"{ref}: not a checkpoint dir, .gguf file, or org/name repo id")

@@ -50,7 +50,23 @@ class TokenizerWrapper:
 
     @staticmethod
     def from_dir(path: str) -> "TokenizerWrapper":
-        """Load tokenizer.json (+ chat template from tokenizer_config.json)."""
+        """Load tokenizer.json (+ chat template from tokenizer_config.json).
+        A ``*.gguf`` path loads the file's embedded ggml vocab instead."""
+        if path.endswith(".gguf"):
+            from dynamo_tpu.llm.gguf import GGUFFile, tokenizer_from_gguf
+
+            g = GGUFFile.parse(path)
+            tk = tokenizer_from_gguf(g)
+            tokens = g.metadata.get("tokenizer.ggml.tokens") or []
+
+            def tok_at(key):
+                i = g.metadata.get(key)
+                return tokens[int(i)] if i is not None and int(i) < len(tokens) else None
+
+            return TokenizerWrapper(
+                tk, g.metadata.get("tokenizer.chat_template"),
+                tok_at("tokenizer.ggml.bos_token_id"),
+                tok_at("tokenizer.ggml.eos_token_id"))
         tk = Tokenizer.from_file(os.path.join(path, "tokenizer.json"))
         chat_template = bos = eos = None
         cfg_path = os.path.join(path, "tokenizer_config.json")

@@ -77,16 +77,20 @@ async def start_worker(runtime, out: str, cli):
     from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
 
     # resolve EOS before the heavy param load so a bad checkpoint dir fails
-    # in milliseconds (same fail-fast property as engine/main.py)
+    # in milliseconds (same fail-fast property as engine/main.py).
+    # --model-path accepts a HF dir, a .gguf file, or an org/name hub id
+    # (ref: hub.rs resolution order)
+    tokenizer_ref = None
     if cli.model_path:
-        from dynamo_tpu.llm.model_card import resolve_eos_token_ids
+        from dynamo_tpu.llm.resolve import resolve_model
         try:
-            eos = resolve_eos_token_ids(cli.model_path)
-        except ValueError as e:
+            resolved = resolve_model(cli.model_path)
+            eos = resolved.eos_token_ids()
+        except (FileNotFoundError, ValueError) as e:
             raise SystemExit(str(e))
-        cfg = ModelConfig.from_pretrained(cli.model_path)
-        from dynamo_tpu.engine.loader import load_hf_params
-        params = load_hf_params(cfg, cli.model_path)
+        cfg = resolved.config()
+        params = resolved.load_params(cfg)
+        tokenizer_ref = resolved.tokenizer_ref
     else:
         # random weights — a demo by construction; still make the toy
         # metadata impossible to mistake for a real deployment
@@ -109,7 +113,7 @@ async def start_worker(runtime, out: str, cli):
         engine.embed_handler)
     card = ModelDeploymentCard(
         display_name=cli.model, kv_cache_block_size=eargs.block_size,
-        eos_token_ids=eos, tokenizer_ref=cli.model_path or "test")
+        eos_token_ids=eos, tokenizer_ref=tokenizer_ref or "test")
     card.runtime_config.total_kv_blocks = engine.num_blocks
     await register_llm(runtime, ep, card)
     return [handle, embed_handle]
