@@ -136,7 +136,9 @@ class GGUFFile:
 
     # -- tensor data -------------------------------------------------------
 
-    def load_tensor(self, name: str) -> np.ndarray:
+    def load_tensor(self, name: str, f: Optional[BinaryIO] = None) -> np.ndarray:
+        """Materialize one tensor; pass an open file to batch many reads
+        through a single handle (load_gguf_params does)."""
         info = self.tensors[name]
         dtype = _np_dtype(info.ggml_type)
         if dtype is None:
@@ -145,7 +147,11 @@ class GGUFFile:
                 "native serving needs an F32/F16/BF16 export (quantized GGUF "
                 "would be dequantized silently wrong; refusing)")
         count = int(np.prod(info.shape)) if info.shape else 1
-        with open(self.path, "rb") as f:
+        if f is None:
+            with open(self.path, "rb") as fh:
+                fh.seek(self.data_start + info.offset)
+                buf = fh.read(count * dtype.itemsize)
+        else:
             f.seek(self.data_start + info.offset)
             buf = f.read(count * dtype.itemsize)
         return np.frombuffer(buf, dtype=dtype).reshape(info.shape)
@@ -173,6 +179,9 @@ def config_from_gguf(g: GGUFFile):
     vocab = md.get("tokenizer.ggml.tokens")
     vocab_size = int(key("vocab_size", len(vocab) if vocab else 32000))
     return ModelConfig(
+        # no output.weight tensor = tied embeddings (derived here, at the
+        # config layer, so every consumer of config() agrees)
+        tie_word_embeddings="output.weight" not in g.tensors,
         vocab_size=vocab_size,
         hidden_size=int(key("embedding_length", 4096)),
         intermediate_size=int(key("feed_forward_length", 11008)),
@@ -238,37 +247,36 @@ def load_gguf_params(g: GGUFFile, cfg, dtype=None) -> dict:
     import jax.numpy as jnp
 
     dtype = dtype or jnp.dtype(cfg.dtype)
+    with open(g.path, "rb") as fh:  # one handle for the whole load
 
-    def get(name):
-        return jnp.asarray(g.load_tensor(name), dtype=dtype)
+        def get(name):
+            return jnp.asarray(g.load_tensor(name, fh), dtype=dtype)
 
-    def proj(name):  # stored [out, in] like HF → transpose to [in, out]
-        return get(name).T
+        def proj(name):  # stored [out, in] like HF → transpose to [in, out]
+            return get(name).T
 
-    L = cfg.num_layers
-    stack = lambda xs: jnp.stack(xs)  # noqa: E731
-    layers = {
-        "attn_norm": stack([get(f"blk.{i}.attn_norm.weight") for i in range(L)]),
-        "mlp_norm": stack([get(f"blk.{i}.ffn_norm.weight") for i in range(L)]),
-        "wq": stack([proj(f"blk.{i}.attn_q.weight") for i in range(L)]),
-        "wk": stack([proj(f"blk.{i}.attn_k.weight") for i in range(L)]),
-        "wv": stack([proj(f"blk.{i}.attn_v.weight") for i in range(L)]),
-        "wo": stack([proj(f"blk.{i}.attn_output.weight") for i in range(L)]),
-        "w_gate": stack([proj(f"blk.{i}.ffn_gate.weight") for i in range(L)]),
-        "w_up": stack([proj(f"blk.{i}.ffn_up.weight") for i in range(L)]),
-        "w_down": stack([proj(f"blk.{i}.ffn_down.weight") for i in range(L)]),
-    }
-    if cfg.qkv_bias:
-        layers["bq"] = stack([get(f"blk.{i}.attn_q.bias") for i in range(L)])
-        layers["bk"] = stack([get(f"blk.{i}.attn_k.bias") for i in range(L)])
-        layers["bv"] = stack([get(f"blk.{i}.attn_v.bias") for i in range(L)])
-    params = {
-        "embed": get("token_embd.weight"),
-        "layers": layers,
-        "final_norm": get("output_norm.weight"),
-    }
-    if "output.weight" in g.tensors:
-        params["lm_head"] = proj("output.weight")
-    else:
-        cfg.tie_word_embeddings = True
+        L = cfg.num_layers
+        stack = lambda xs: jnp.stack(xs)  # noqa: E731
+        layers = {
+            "attn_norm": stack([get(f"blk.{i}.attn_norm.weight") for i in range(L)]),
+            "mlp_norm": stack([get(f"blk.{i}.ffn_norm.weight") for i in range(L)]),
+            "wq": stack([proj(f"blk.{i}.attn_q.weight") for i in range(L)]),
+            "wk": stack([proj(f"blk.{i}.attn_k.weight") for i in range(L)]),
+            "wv": stack([proj(f"blk.{i}.attn_v.weight") for i in range(L)]),
+            "wo": stack([proj(f"blk.{i}.attn_output.weight") for i in range(L)]),
+            "w_gate": stack([proj(f"blk.{i}.ffn_gate.weight") for i in range(L)]),
+            "w_up": stack([proj(f"blk.{i}.ffn_up.weight") for i in range(L)]),
+            "w_down": stack([proj(f"blk.{i}.ffn_down.weight") for i in range(L)]),
+        }
+        if cfg.qkv_bias:
+            layers["bq"] = stack([get(f"blk.{i}.attn_q.bias") for i in range(L)])
+            layers["bk"] = stack([get(f"blk.{i}.attn_k.bias") for i in range(L)])
+            layers["bv"] = stack([get(f"blk.{i}.attn_v.bias") for i in range(L)])
+        params = {
+            "embed": get("token_embd.weight"),
+            "layers": layers,
+            "final_norm": get("output_norm.weight"),
+        }
+        if "output.weight" in g.tensors:
+            params["lm_head"] = proj("output.weight")
     return params

@@ -25,34 +25,44 @@ from dataclasses import dataclass
 class ResolvedModel:
     kind: str  # "hf_dir" | "gguf"
     path: str
+    _gguf: object = None  # parsed GGUFFile, cached across the accessors
 
     @property
     def tokenizer_ref(self) -> str:
         return self.path
 
+    def gguf(self):
+        """The parsed GGUFFile, cached — config/eos/params/tokenizer all
+        need the (metadata-heavy) parse; one pass serves them all."""
+        if self._gguf is None:
+            from dynamo_tpu.llm.gguf import GGUFFile
+
+            self._gguf = GGUFFile.parse(self.path)
+        return self._gguf
+
     def config(self):
         if self.kind == "gguf":
-            from dynamo_tpu.llm.gguf import GGUFFile, config_from_gguf
+            from dynamo_tpu.llm.gguf import config_from_gguf
 
-            return config_from_gguf(GGUFFile.parse(self.path))
+            return config_from_gguf(self.gguf())
         from dynamo_tpu.engine.config import ModelConfig
 
         return ModelConfig.from_pretrained(self.path)
 
     def load_params(self, cfg, dtype=None) -> dict:
         if self.kind == "gguf":
-            from dynamo_tpu.llm.gguf import GGUFFile, load_gguf_params
+            from dynamo_tpu.llm.gguf import load_gguf_params
 
-            return load_gguf_params(GGUFFile.parse(self.path), cfg, dtype)
+            return load_gguf_params(self.gguf(), cfg, dtype)
         from dynamo_tpu.engine.loader import load_hf_params
 
         return load_hf_params(cfg, self.path, dtype)
 
     def eos_token_ids(self) -> list[int]:
         if self.kind == "gguf":
-            from dynamo_tpu.llm.gguf import GGUFFile, eos_ids_from_gguf
+            from dynamo_tpu.llm.gguf import eos_ids_from_gguf
 
-            return eos_ids_from_gguf(GGUFFile.parse(self.path))
+            return eos_ids_from_gguf(self.gguf())
         from dynamo_tpu.llm.model_card import resolve_eos_token_ids
 
         return resolve_eos_token_ids(self.path)
@@ -89,6 +99,17 @@ def resolve_model(ref: str, allow_download: bool = True) -> ResolvedModel:
         if os.path.exists(os.path.join(ref, "config.json")):
             return ResolvedModel("hf_dir", ref)
         ggufs = sorted(f for f in os.listdir(ref) if f.endswith(".gguf"))
+        if len(ggufs) > 1:
+            # prefer an unquantized export (quantized variants refuse to
+            # load); sharded exports are not supported — say so, don't
+            # silently index shard 1 of N
+            if any("-of-" in f for f in ggufs):
+                raise FileNotFoundError(
+                    f"{ref}: sharded GGUF exports are not supported; point "
+                    "--model-path at a single-file export")
+            full = [f for f in ggufs
+                    if any(t in f.lower() for t in ("f32", "f16", "bf16"))]
+            ggufs = full or ggufs
         if ggufs:
             return ResolvedModel("gguf", os.path.join(ref, ggufs[0]))
         raise FileNotFoundError(

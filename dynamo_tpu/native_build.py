@@ -13,11 +13,15 @@ import sys
 
 PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 REPO_DIR = os.path.dirname(PKG_DIR)
-SRC = [os.path.join(REPO_DIR, "native", "xxh3.cc")]
+SRC = [os.path.join(REPO_DIR, "native", "xxh3.cc"),
+       os.path.join(REPO_DIR, "native", "dynamo_c.cc")]
 OUT = os.path.join(PKG_DIR, "libdynamo_native.so")
 
 
 def build(out: str = OUT, verbose: bool = True) -> str:
+    """One shared lib carries both the hashing core (ctypes-loaded by
+    _native.py) and the C ABI event-publish surface for external engines
+    (ref: lib/bindings/c — dynamo_llm_init / dynamo_kv_event_publish_*)."""
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", out, *SRC]
     if verbose:
         print("+", " ".join(cmd))

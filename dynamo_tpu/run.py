@@ -88,6 +88,9 @@ async def start_worker(runtime, out: str, cli):
             eos = resolved.eos_token_ids()
         except (FileNotFoundError, ValueError) as e:
             raise SystemExit(str(e))
+        if not eos:  # a GGUF without an eos id would never stop generating
+            raise SystemExit(
+                f"{cli.model_path}: no EOS token id in the model metadata")
         cfg = resolved.config()
         params = resolved.load_params(cfg)
         tokenizer_ref = resolved.tokenizer_ref

@@ -1,0 +1,380 @@
+// C ABI bindings: KV-event publishing for external (C/C++) engines.
+//
+// Rebuild of the reference's C bindings (ref: lib/bindings/c/src/lib.rs:40-326
+// — dynamo_llm_init / dynamo_llm_shutdown / dynamo_kv_event_publish_stored /
+// dynamo_kv_event_publish_removed, consumed by the TRT-LLM C++ runtime to
+// feed the KV router). Here the events ride the control plane's TCP protocol
+// (4-byte big-endian length + msgpack map frames, op "stream_publish" onto
+// the "kv_events" durable stream) — the same stream the Python
+// KvEventPublisher writes and the router's indexer consumes, so an external
+// engine is indistinguishable from a native one.
+//
+// Wire parity with dynamo_tpu/router/protocols.py RouterEvent.to_wire():
+//   {"worker_id": w, "event": {"event_id": e,
+//     "stored": {"parent_hash": p|nil, "blocks":
+//                [{"block_hash": id, "tokens_hash": h}, ...]}
+//     | "removed": {"block_hashes": [...]} }}
+// Like the reference, the caller's block_ids are used verbatim as the
+// blocks' identity (ExternalSequenceBlockHash) and tokens_hash is computed
+// here from the token chunks (salted xxh3, seed 1337 — tokens.py parity).
+//
+// Thread-safety: one global connection guarded by a mutex (the reference's
+// publisher is a single handle too). lora_id is accepted for ABI parity and
+// ignored (LoRA-scoped routing is not implemented).
+//
+// Build: python -m dynamo_tpu.native_build (links with xxh3.cc).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+extern "C" uint64_t dyn_xxh3_64(const uint8_t* data, size_t len, uint64_t seed);
+
+namespace {
+
+constexpr uint64_t kKvHashSeed = 1337;  // tokens.py KV_HASH_SEED
+
+// ---------------------------------------------------------------- msgpack
+
+struct Packer {
+    std::vector<uint8_t> buf;
+
+    void byte(uint8_t b) { buf.push_back(b); }
+    void be16(uint16_t v) { byte(v >> 8); byte(v & 0xff); }
+    void be32(uint32_t v) { be16(v >> 16); be16(v & 0xffff); }
+    void be64(uint64_t v) { be32(v >> 32); be32(v & 0xffffffffu); }
+
+    void nil() { byte(0xc0); }
+    void b(bool v) { byte(v ? 0xc3 : 0xc2); }
+    void uint(uint64_t v) {
+        if (v < 0x80) byte(static_cast<uint8_t>(v));
+        else if (v <= 0xff) { byte(0xcc); byte(v); }
+        else if (v <= 0xffff) { byte(0xcd); be16(v); }
+        else if (v <= 0xffffffffu) { byte(0xce); be32(v); }
+        else { byte(0xcf); be64(v); }
+    }
+    void str(const char* s) {
+        size_t n = strlen(s);
+        if (n < 32) byte(0xa0 | n);
+        else { byte(0xd9); byte(n); }  // str8 (keys here are short)
+        buf.insert(buf.end(), s, s + n);
+    }
+    void bin(const uint8_t* d, size_t n) {
+        if (n <= 0xff) { byte(0xc4); byte(n); }
+        else if (n <= 0xffff) { byte(0xc5); be16(n); }
+        else { byte(0xc6); be32(n); }
+        buf.insert(buf.end(), d, d + n);
+    }
+    void map(size_t n) {
+        if (n < 16) byte(0x80 | n);
+        else { byte(0xde); be16(n); }
+    }
+    void arr(size_t n) {
+        if (n < 16) byte(0x90 | n);
+        else { byte(0xdc); be16(n); }
+    }
+};
+
+// Minimal decoder: enough to read {"t":"res","id":u,"ok":b,...} responses.
+struct Unpacker {
+    const uint8_t* p;
+    const uint8_t* end;
+
+    bool ok() const { return p <= end; }
+    uint8_t peek() const { return *p; }
+    uint8_t next() { return *p++; }
+    uint64_t be(int n) {
+        uint64_t v = 0;
+        while (n--) v = (v << 8) | next();
+        return v;
+    }
+
+    // returns false on malformed input
+    bool skip() {
+        if (p >= end) return false;
+        uint8_t t = next();
+        if (t < 0x80 || t >= 0xe0) return true;           // fixint
+        if ((t & 0xf0) == 0x80) return skip_n((t & 0x0f) * 2);  // fixmap
+        if ((t & 0xf0) == 0x90) return skip_n(t & 0x0f);  // fixarray
+        if ((t & 0xe0) == 0xa0) { p += t & 0x1f; return ok(); }  // fixstr
+        switch (t) {
+            case 0xc0: case 0xc2: case 0xc3: return true;
+            case 0xcc: case 0xd0: p += 1; return ok();
+            case 0xcd: case 0xd1: p += 2; return ok();
+            case 0xce: case 0xd2: case 0xca: p += 4; return ok();
+            case 0xcf: case 0xd3: case 0xcb: p += 8; return ok();
+            case 0xd9: case 0xc4: { uint64_t n = be(1); p += n; return ok(); }
+            case 0xda: case 0xc5: { uint64_t n = be(2); p += n; return ok(); }
+            case 0xdb: case 0xc6: { uint64_t n = be(4); p += n; return ok(); }
+            case 0xdc: return skip_n(be(2));
+            case 0xdd: return skip_n(be(4));
+            case 0xde: return skip_n(be(2) * 2);
+            case 0xdf: return skip_n(be(4) * 2);
+            default: return false;
+        }
+    }
+    bool skip_n(uint64_t n) {
+        while (n--) if (!skip()) return false;
+        return true;
+    }
+    bool read_str(std::string* out) {
+        if (p >= end) return false;
+        uint8_t t = next();
+        uint64_t n;
+        if ((t & 0xe0) == 0xa0) n = t & 0x1f;
+        else if (t == 0xd9) n = be(1);
+        else if (t == 0xda) n = be(2);
+        else return false;
+        if (p + n > end) return false;
+        out->assign(reinterpret_cast<const char*>(p), n);
+        p += n;
+        return true;
+    }
+    bool read_uint(uint64_t* out) {
+        if (p >= end) return false;
+        uint8_t t = next();
+        if (t < 0x80) { *out = t; return true; }
+        if (t == 0xcc) { *out = be(1); return true; }
+        if (t == 0xcd) { *out = be(2); return true; }
+        if (t == 0xce) { *out = be(4); return true; }
+        if (t == 0xcf) { *out = be(8); return true; }
+        return false;
+    }
+};
+
+// ---------------------------------------------------------------- client
+
+struct Client {
+    int fd = -1;
+    uint64_t next_id = 0;
+    uint64_t worker_id = 0;
+    uint32_t kv_block_size = 0;
+    std::mutex mu;
+
+    bool send_all(const uint8_t* d, size_t n) {
+        while (n) {
+            ssize_t w = ::send(fd, d, n, 0);
+            if (w <= 0) return false;
+            d += w;
+            n -= w;
+        }
+        return true;
+    }
+    bool recv_all(uint8_t* d, size_t n) {
+        while (n) {
+            ssize_t r = ::recv(fd, d, n, 0);
+            if (r <= 0) return false;
+            d += r;
+            n -= r;
+        }
+        return true;
+    }
+
+    // send one request frame, wait for its "res" (the connection is used
+    // synchronously under the mutex, so responses arrive in order)
+    bool call(const Packer& req, uint64_t rid) {
+        uint8_t len[4];
+        uint32_t n = req.buf.size();
+        len[0] = n >> 24; len[1] = n >> 16; len[2] = n >> 8; len[3] = n;
+        if (!send_all(len, 4) || !send_all(req.buf.data(), n)) return false;
+        for (;;) {
+            if (!recv_all(len, 4)) return false;
+            uint32_t m = (uint32_t(len[0]) << 24) | (uint32_t(len[1]) << 16) |
+                         (uint32_t(len[2]) << 8) | len[3];
+            if (m > (64u << 20)) return false;
+            std::vector<uint8_t> body(m);
+            if (!recv_all(body.data(), m)) return false;
+            Unpacker u{body.data(), body.data() + m};
+            if (u.p >= u.end) return false;
+            uint8_t t = u.next();
+            uint64_t fields = 0;
+            if ((t & 0xf0) == 0x80) fields = t & 0x0f;
+            else if (t == 0xde) fields = u.be(2);
+            else return false;
+            std::string key, typ;
+            uint64_t id = 0;
+            bool got_ok = false, ok_val = false;
+            for (uint64_t i = 0; i < fields; i++) {
+                if (!u.read_str(&key)) return false;
+                if (key == "t") {
+                    if (!u.read_str(&typ)) return false;
+                } else if (key == "id") {
+                    if (!u.read_uint(&id)) return false;
+                } else if (key == "ok") {
+                    if (u.p >= u.end) return false;
+                    uint8_t b = u.next();
+                    got_ok = true;
+                    ok_val = (b == 0xc3);
+                } else {
+                    if (!u.skip()) return false;
+                }
+            }
+            if (typ == "res" && id == rid) return got_ok && ok_val;
+            // anything else (stray event frame): keep reading
+        }
+    }
+};
+
+Client* g_client = nullptr;
+std::mutex g_init_mu;
+
+int publish(const Packer& payload) {
+    if (!g_client) {
+        fprintf(stderr, "dynamo_c: publish before dynamo_llm_init\n");
+        return 1;
+    }
+    std::lock_guard<std::mutex> lock(g_client->mu);
+    uint64_t rid = ++g_client->next_id;
+    Packer req;
+    req.map(5);
+    req.str("t"); req.str("req");
+    req.str("id"); req.uint(rid);
+    req.str("op"); req.str("stream_publish");
+    req.str("stream"); req.str("kv_events");
+    req.str("payload"); req.bin(payload.buf.data(), payload.buf.size());
+    if (!g_client->call(req, rid)) {
+        fprintf(stderr, "dynamo_c: stream_publish failed\n");
+        return 1;
+    }
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Connect to the control plane and create the KV publisher state.
+// `addr` is "host:port"; pass NULL to read DYN_CONTROL_PLANE from the
+// environment. namespace/component are accepted for ABI parity with the
+// reference (events are attributed by worker_id on this control plane).
+// Returns 0 on success.
+int dynamo_llm_init(const char* addr, const char* /*ns*/,
+                    const char* /*component*/, uint64_t worker_id,
+                    uint32_t kv_block_size) {
+    std::lock_guard<std::mutex> lock(g_init_mu);
+    if (g_client) {
+        fprintf(stderr, "dynamo_c: already initialized\n");
+        return 1;
+    }
+    const char* a = addr ? addr : getenv("DYN_CONTROL_PLANE");
+    if (!a || !*a) {
+        fprintf(stderr, "dynamo_c: no address (set DYN_CONTROL_PLANE)\n");
+        return 1;
+    }
+    std::string s(a);
+    size_t colon = s.rfind(':');
+    if (colon == std::string::npos) {
+        fprintf(stderr, "dynamo_c: address must be host:port\n");
+        return 1;
+    }
+    std::string host = s.substr(0, colon), port = s.substr(colon + 1);
+
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 || !res) {
+        fprintf(stderr, "dynamo_c: cannot resolve %s\n", a);
+        return 1;
+    }
+    int fd = -1;
+    for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+        fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+        close(fd);
+        fd = -1;
+    }
+    freeaddrinfo(res);
+    if (fd < 0) {
+        fprintf(stderr, "dynamo_c: cannot connect to %s\n", a);
+        return 1;
+    }
+    g_client = new Client();
+    g_client->fd = fd;
+    g_client->worker_id = worker_id;
+    g_client->kv_block_size = kv_block_size;
+    return 0;
+}
+
+int dynamo_llm_shutdown(void) {
+    std::lock_guard<std::mutex> lock(g_init_mu);
+    if (!g_client) return 1;
+    close(g_client->fd);
+    delete g_client;
+    g_client = nullptr;
+    return 0;
+}
+
+// Publish a stored event: block_ids are the blocks' external identities
+// (used verbatim, like the reference's ExternalSequenceBlockHash);
+// tokens_hash is computed here from each block's token chunk. Every
+// num_block_tokens[i] must equal the kv_block_size from init (partial
+// blocks are not indexable). Returns 0 on success.
+int dynamo_kv_event_publish_stored(uint64_t event_id,
+                                   const uint32_t* token_ids,
+                                   const size_t* num_block_tokens,
+                                   const uint64_t* block_ids,
+                                   size_t num_blocks,
+                                   const uint64_t* parent_hash,
+                                   uint64_t /*lora_id*/) {
+    if (!g_client) return 1;
+    for (size_t i = 0; i < num_blocks; i++) {
+        if (num_block_tokens[i] != g_client->kv_block_size) {
+            fprintf(stderr,
+                    "dynamo_c: block %zu has %zu tokens, expected %u\n", i,
+                    num_block_tokens[i], g_client->kv_block_size);
+            return 1;
+        }
+    }
+    Packer ev;
+    ev.map(2);
+    ev.str("worker_id"); ev.uint(g_client->worker_id);
+    ev.str("event");
+    ev.map(2);
+    ev.str("event_id"); ev.uint(event_id);
+    ev.str("stored");
+    ev.map(2);
+    ev.str("parent_hash");
+    if (parent_hash) ev.uint(*parent_hash); else ev.nil();
+    ev.str("blocks");
+    ev.arr(num_blocks);
+    const uint32_t* tok = token_ids;
+    for (size_t i = 0; i < num_blocks; i++) {
+        uint64_t th = dyn_xxh3_64(reinterpret_cast<const uint8_t*>(tok),
+                                  num_block_tokens[i] * 4, kKvHashSeed);
+        tok += num_block_tokens[i];
+        ev.map(2);
+        ev.str("block_hash"); ev.uint(block_ids[i]);
+        ev.str("tokens_hash"); ev.uint(th);
+    }
+    return publish(ev);
+}
+
+int dynamo_kv_event_publish_removed(uint64_t event_id,
+                                    const uint64_t* block_ids,
+                                    size_t num_blocks) {
+    if (!g_client) return 1;
+    Packer ev;
+    ev.map(2);
+    ev.str("worker_id"); ev.uint(g_client->worker_id);
+    ev.str("event");
+    ev.map(2);
+    ev.str("event_id"); ev.uint(event_id);
+    ev.str("removed");
+    ev.map(1);
+    ev.str("block_hashes");
+    ev.arr(num_blocks);
+    for (size_t i = 0; i < num_blocks; i++) ev.uint(block_ids[i]);
+    return publish(ev);
+}
+
+}  // extern "C"

@@ -1,0 +1,45 @@
+/* C ABI for external engines: KV-event publishing into the dynamo-tpu
+ * router (ref surface: lib/bindings/c/src/lib.rs:40-326).
+ *
+ * Link against libdynamo_native.so (python -m dynamo_tpu.native_build).
+ * All functions return 0 on success, non-zero on error (details on stderr).
+ */
+#ifndef DYNAMO_LLM_H
+#define DYNAMO_LLM_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Connect to the control plane ("host:port"; NULL reads DYN_CONTROL_PLANE)
+ * and create the process-wide KV publisher. ns/component are accepted for
+ * parity with the reference ABI; events are attributed by worker_id. */
+int dynamo_llm_init(const char* addr, const char* ns, const char* component,
+                    uint64_t worker_id, uint32_t kv_block_size);
+
+int dynamo_llm_shutdown(void);
+
+/* Publish KV-stored: token_ids is the flat token array; num_block_tokens[i]
+ * (each == kv_block_size) describes how token_ids splits into blocks;
+ * block_ids are the blocks' external identities; parent_hash may be NULL
+ * (no parent). lora_id accepted for ABI parity, ignored. */
+int dynamo_kv_event_publish_stored(uint64_t event_id,
+                                   const uint32_t* token_ids,
+                                   const size_t* num_block_tokens,
+                                   const uint64_t* block_ids,
+                                   size_t num_blocks,
+                                   const uint64_t* parent_hash,
+                                   uint64_t lora_id);
+
+int dynamo_kv_event_publish_removed(uint64_t event_id,
+                                    const uint64_t* block_ids,
+                                    size_t num_blocks);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* DYNAMO_LLM_H */

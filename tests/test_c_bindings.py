@@ -1,0 +1,112 @@
+"""C ABI bindings e2e: an "external engine" (ctypes driving the C ABI the
+way a C++ runtime would) publishes KV events over the control-plane TCP
+protocol; the router-side indexer must see them exactly like native-engine
+events (ref: lib/bindings/c/src/lib.rs:40-326)."""
+
+import asyncio
+import ctypes
+import os
+
+import pytest
+
+from dynamo_tpu.router.indexer import RadixTree
+from dynamo_tpu.router.protocols import KV_EVENTS_STREAM, RouterEvent
+from dynamo_tpu.runtime.control_plane import ControlPlaneServer
+from dynamo_tpu.tokens import compute_block_hash_for_seq
+
+pytestmark = pytest.mark.anyio
+
+_SO = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "dynamo_tpu", "libdynamo_native.so")
+
+
+@pytest.fixture
+def clib():
+    if not os.path.exists(_SO):
+        from dynamo_tpu.native_build import build
+
+        build(verbose=False)
+    lib = ctypes.CDLL(_SO)
+    lib.dynamo_llm_init.restype = ctypes.c_int
+    lib.dynamo_llm_init.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_uint32]
+    lib.dynamo_llm_shutdown.restype = ctypes.c_int
+    lib.dynamo_kv_event_publish_stored.restype = ctypes.c_int
+    lib.dynamo_kv_event_publish_stored.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+    lib.dynamo_kv_event_publish_removed.restype = ctypes.c_int
+    lib.dynamo_kv_event_publish_removed.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t]
+    return lib
+
+
+async def test_c_publish_feeds_router(clib):
+    server = ControlPlaneServer(port=0)
+    addr = await server.start()
+    WORKER = 0xBEEF
+    BS = 4
+    tokens = list(range(1, 13))  # 3 full blocks
+    # external ids double as the blocks' identity (chained hashes here so
+    # the radix tree sees a real lineage)
+    seq_hashes = [101, 102, 103]
+
+    def c_init():
+        return clib.dynamo_llm_init(addr.encode(), b"dynamo", b"backend",
+                                    WORKER, BS)
+
+    def c_stored():
+        tok = (ctypes.c_uint32 * len(tokens))(*tokens)
+        nbt = (ctypes.c_size_t * 3)(BS, BS, BS)
+        ids = (ctypes.c_uint64 * 3)(*seq_hashes)
+        return clib.dynamo_kv_event_publish_stored(
+            1, tok, nbt, ids, 3, None, 0)
+
+    def c_removed():
+        ids = (ctypes.c_uint64 * 1)(seq_hashes[2])
+        return clib.dynamo_kv_event_publish_removed(2, ids, 1)
+
+    try:
+        # the C client is blocking: run it off the event loop
+        assert await asyncio.to_thread(c_init) == 0
+        assert await asyncio.to_thread(c_stored) == 0
+
+        # read the durable stream like the router background task does
+        sub = await server.core.stream_subscribe(KV_EVENTS_STREAM, 0)
+        seq, payload = await asyncio.wait_for(sub.__aiter__().__anext__(), 5)
+        import msgpack
+
+        ev = RouterEvent.from_wire(msgpack.unpackb(payload, raw=False))
+        assert ev.worker_id == WORKER
+        assert [b.block_hash for b in ev.event.stored_blocks] == seq_hashes
+        # tokens_hash computed C-side must be bit-identical to tokens.py
+        want = compute_block_hash_for_seq(tokens, BS)
+        assert [b.tokens_hash for b in ev.event.stored_blocks] == want
+        assert ev.event.stored_parent_hash is None
+
+        assert await asyncio.to_thread(c_removed) == 0
+        _, payload = await asyncio.wait_for(sub.__aiter__().__anext__(), 5)
+        ev2 = RouterEvent.from_wire(msgpack.unpackb(payload, raw=False))
+        assert ev2.event.removed_hashes == [seq_hashes[2]]
+        await sub.cancel()
+
+        # and the radix tree folds them like any native worker's events
+        tree = RadixTree()
+        tree.apply_event(ev)
+        tree.apply_event(ev2)
+        scores = tree.find_matches(want[:2]).scores
+        assert scores.get(WORKER) == 2
+
+        # partial block must be rejected loudly (ref: lib.rs checks)
+        tok = (ctypes.c_uint32 * 3)(1, 2, 3)
+        nbt = (ctypes.c_size_t * 1)(3)
+        ids = (ctypes.c_uint64 * 1)(7)
+        rc = await asyncio.to_thread(
+            lambda: clib.dynamo_kv_event_publish_stored(3, tok, nbt, ids, 1,
+                                                        None, 0))
+        assert rc != 0
+    finally:
+        await asyncio.to_thread(clib.dynamo_llm_shutdown)
+        await server.stop()

@@ -171,8 +171,8 @@ def test_resolution_kinds(gguf_path, tmp_path):
     assert resolve_model(os.path.dirname(path)).kind == "gguf"
     with pytest.raises(FileNotFoundError):
         resolve_model(str(tmp_path / "nope"))
-    with pytest.raises(FileNotFoundError):
-        resolve_model("no-such-org/no-such-model-xyz")
+    with pytest.raises(FileNotFoundError):  # hermetic: no network attempt
+        resolve_model("no-such-org/no-such-model-xyz", allow_download=False)
 
 
 def test_quantized_tensor_refuses(gguf_path, tmp_path):
