@@ -559,8 +559,8 @@ class AsyncJaxEngine:
                 seq.progress_cb = None
 
         if work.sample:
-            toks, logps = await self._sample([seq], logits)
-            self._deliver(seq, int(toks[0]), float(logps[0]))
+            toks, logps, tops = await self._sample([seq], logits)
+            self._deliver(seq, int(toks[0]), float(logps[0]), tops.get(0))
         else:
             # chunk didn't reach the end: logits unused, but sync to pace the loop
             await asyncio.to_thread(lambda: logits.block_until_ready())
@@ -572,6 +572,9 @@ class AsyncJaxEngine:
         if (self.multi_fn is not None and seqs
                 and not self.scheduler.waiting
                 and all(s.remaining == 1 for s in self.scheduler.running)
+                # top-k capture needs the logits: burst keeps them on
+                # device, so logprobs requests take the single-step path
+                and all(s.req.output_options.logprobs is None for s in seqs)
                 # don't burn a burst when a seq is about to hit max_tokens —
                 # the overshoot steps would be computed and discarded
                 and all((s.req.stop_conditions.max_tokens is None
@@ -608,10 +611,10 @@ class AsyncJaxEngine:
             jnp.asarray(slot_map), jnp.asarray(bt), jnp.asarray(kv_lens),
             jnp.asarray(last_idx), self.k_cache, self.v_cache)
 
-        toks, logps = await self._sample(seqs, logits)
+        toks, logps, tops = await self._sample(seqs, logits)
         for i, s in enumerate(seqs):
             self.scheduler.commit_computed(s, len(s.tokens))
-            self._deliver(s, int(toks[i]), float(logps[i]))
+            self._deliver(s, int(toks[i]), float(logps[i]), tops.get(i))
 
     async def _run_multi_decode(self, seqs: list[SeqState]) -> bool:
         """Burst path: K decode steps in one dispatch. Returns False when a
@@ -683,27 +686,58 @@ class AsyncJaxEngine:
     # ------------------------------------------------------------ sampling
 
     async def _sample(self, seqs: list[SeqState], logits):
-        """Sample one token per seq from padded logits [B>=len(seqs), V]."""
+        """Sample one token per seq from padded logits [B>=len(seqs), V].
+
+        Returns (tokens, logps, tops) — ``tops[i]`` is the row's top-k
+        [token_id, logprob] alternatives when seq i requested logprobs
+        (ref surface: perf/logprobs.rs TokenLogProbs), else absent.
+        """
         B = logits.shape[0]
         temp = np.zeros((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
         seeds, steps = [], []
+        want_tops: dict[int, int] = {}
         for i, s in enumerate(seqs):
             t, k, p, seed = s.sampling_tuple()
             temp[i], top_k[i], top_p[i] = t, k, p
             seeds.append(seed if seed is not None else hash(s.request_id) & 0x7FFFFFFF)
             steps.append(s.step_idx)
+            n = s.req.output_options.logprobs
+            if n is not None:  # 0 still captures the selected token's entry
+                want_tops[i] = max(1, min(int(n), 20))
         seeds += [0] * (B - len(seqs))
         steps += [0] * (B - len(seqs))
         keys = self._sampling.make_keys(seeds, steps)
         toks, logps = self._sampling.sample_jit(logits, temp, top_k, top_p, keys)
-        return await asyncio.to_thread(lambda: (np.asarray(toks), np.asarray(logps)))
+        top_res = None
+        if want_tops:
+            # device-side top-k: only O(B·k) crosses to host, and the
+            # selected logprob comes from the same log_softmax as its
+            # alternatives (an ulp disagreement would read as a near-tie)
+            kmax = max(want_tops.values())
+            top_res = self._sampling.make_topk_logprobs_fn(kmax)(logits, toks)
 
-    def _deliver(self, seq: SeqState, token: int, logp: float) -> None:
+        def fetch():
+            t, l = np.asarray(toks), np.asarray(logps)
+            tops: dict[int, list[list]] = {}
+            if top_res is not None:
+                ids, vals, sel = (np.asarray(x) for x in top_res)
+                l = l.copy()
+                for i, n in want_tops.items():
+                    tops[i] = [[int(j), float(v)]
+                               for j, v in zip(ids[i, :n], vals[i, :n])]
+                    l[i] = sel[i]
+            return t, l, tops
+
+        return await asyncio.to_thread(fetch)
+
+    def _deliver(self, seq: SeqState, token: int, logp: float,
+                 top: Optional[list] = None) -> None:
         self.scheduler.append_token(seq, token)
         reason = self.scheduler.check_finish(seq, token)
         out = LLMEngineOutput(token_ids=[token], log_probs=[logp],
+                              top_logprobs=[top] if top is not None else None,
                               finish_reason=reason)
         if reason is not None:
             self.scheduler.finish(seq, reason)

@@ -102,3 +102,20 @@ def make_keys(seeds, steps):
         out[i, 0] = int(s) & 0xFFFFFFFF
         out[i, 1] = int(st) & 0xFFFFFFFF
     return out
+
+
+@functools.lru_cache(maxsize=8)
+def make_topk_logprobs_fn(k: int):
+    """Jitted (logits [B,V], toks [B]) -> (top ids [B,k], top logprobs [B,k],
+    selected logprob [B]) — all from ONE device log_softmax, so the selected
+    value and its own top-k entry can never disagree by an ulp. Device-side
+    top-k keeps the host transfer at O(B*k) instead of copying the whole
+    padded [B,V] logits batch (perf/logprobs capture path)."""
+
+    def fn(logits, toks):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        vals, ids = jax.lax.top_k(lp, min(k, logits.shape[-1]))
+        sel = jnp.take_along_axis(lp, toks[:, None], axis=1)[:, 0]
+        return ids, vals, sel
+
+    return jax.jit(fn)

@@ -64,6 +64,34 @@ class OpenAIPreprocessor:
         self.downstream = downstream
         self._template_env = None
 
+    def _openai_logprobs(self, out: LLMEngineOutput, chat: bool,
+                         k: int) -> dict:
+        """Engine top_logprobs → OpenAI logprobs objects (chat: content
+        entries with alternatives; completions: tokens/token_logprobs/
+        top_logprobs arrays). ``k`` is the REQUESTED alternatives count —
+        0 emits selected-token logprobs with empty alternative lists.
+        ref surface: perf/logprobs.rs consumes exactly these shapes."""
+        tk = self.tokenizer
+        logps = out.log_probs or [None] * len(out.token_ids)
+        if chat:
+            content = []
+            for tid, lp, tops in zip(out.token_ids, logps, out.top_logprobs):
+                content.append({
+                    "token": tk.decode([tid]),
+                    "logprob": lp,
+                    "top_logprobs": [
+                        {"token": tk.decode([int(t)]), "logprob": p}
+                        for t, p in (tops or [])[:k]],
+                })
+            return {"content": content}
+        return {
+            "tokens": [tk.decode([tid]) for tid in out.token_ids],
+            "token_logprobs": list(logps),
+            "top_logprobs": [
+                {tk.decode([int(t)]): p for t, p in (tops or [])[:k]}
+                for tops in out.top_logprobs],
+        }
+
     def _render_chat(self, req: ParsedRequest) -> str:
         import jinja2
 
@@ -168,9 +196,12 @@ class OpenAIPreprocessor:
             n_completion += len(out.token_ids)
             finish = FinishReason.to_openai(out.finish_reason)
             text = out.text or ""
+            lp = (self._openai_logprobs(out, is_chat, req.output.logprobs or 0)
+                  if out.top_logprobs else None)
             if not is_chat:
                 chunk = completion_chunk(
-                    request_id, req.model, created, text=text, finish_reason=finish
+                    request_id, req.model, created, text=text,
+                    finish_reason=finish, logprobs=lp
                 )
                 if out.finish_reason is not None and (req.stream_usage or not req.stream):
                     chunk["usage"] = usage_block(n_prompt, n_completion)
@@ -201,7 +232,7 @@ class OpenAIPreprocessor:
                         tool_calls=[dict(tc.to_openai(), index=i)
                                     for i, tc in enumerate(calls)],
                         reasoning_content=r_delta or None,
-                        finish_reason=finish,
+                        finish_reason=finish, logprobs=lp,
                     )
                 else:
                     chunk = chat_chunk(
@@ -209,7 +240,7 @@ class OpenAIPreprocessor:
                         role="assistant" if first else None,
                         content=normal,
                         reasoning_content=r_delta or None,
-                        finish_reason=finish,
+                        finish_reason=finish, logprobs=lp,
                     )
             else:
                 emit_content = text if (text or not finish) else None
@@ -218,7 +249,7 @@ class OpenAIPreprocessor:
                     role="assistant" if first else None,
                     content=emit_content,
                     reasoning_content=r_delta or None,
-                    finish_reason=finish,
+                    finish_reason=finish, logprobs=lp,
                 )
             first = False
             if out.finish_reason is not None and (req.stream_usage or not req.stream):
@@ -337,6 +368,7 @@ class Backend:
                 text=text,
                 cum_log_probs=out.cum_log_probs,
                 log_probs=out.log_probs,
+                top_logprobs=out.top_logprobs,
                 finish_reason=finish,
                 index=out.index,
                 kv_transfer_params=out.kv_transfer_params,
@@ -436,6 +468,7 @@ async def aggregate_chat_stream(stream: AsyncIterator[dict]) -> dict:
     content: dict[int, list[str]] = {}
     reasoning: dict[int, list[str]] = {}
     tool_calls: dict[int, list[dict]] = {}
+    logprobs: dict[int, list[dict]] = {}
     finish: dict[int, Optional[str]] = {}
     base: Optional[dict] = None
     usage = None
@@ -457,6 +490,8 @@ async def aggregate_chat_stream(stream: AsyncIterator[dict]) -> dict:
                 reasoning.setdefault(idx, []).append(delta["reasoning_content"])
             if delta.get("tool_calls"):
                 tool_calls.setdefault(idx, []).extend(delta["tool_calls"])
+            if (ch.get("logprobs") or {}).get("content"):
+                logprobs.setdefault(idx, []).extend(ch["logprobs"]["content"])
             if ch.get("finish_reason"):
                 finish[idx] = ch["finish_reason"]
     if base is None:
@@ -474,11 +509,14 @@ async def aggregate_chat_stream(stream: AsyncIterator[dict]) -> dict:
                 for tc in tool_calls[idx]
             ]
             msg["content"] = msg["content"] or None
-        choices.append({
+        choice = {
             "index": idx,
             "message": msg,
             "finish_reason": finish.get(idx),
-        })
+        }
+        if idx in logprobs:
+            choice["logprobs"] = {"content": logprobs[idx]}
+        choices.append(choice)
     return {
         "id": base["id"],
         "object": "chat.completion",

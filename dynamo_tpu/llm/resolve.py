@@ -101,15 +101,17 @@ def resolve_model(ref: str, allow_download: bool = True) -> ResolvedModel:
         ggufs = sorted(f for f in os.listdir(ref) if f.endswith(".gguf"))
         if len(ggufs) > 1:
             # prefer an unquantized export (quantized variants refuse to
-            # load); sharded exports are not supported — say so, don't
-            # silently index shard 1 of N
-            if any("-of-" in f for f in ggufs):
-                raise FileNotFoundError(
-                    f"{ref}: sharded GGUF exports are not supported; point "
-                    "--model-path at a single-file export")
+            # load), THEN reject if only shards survive — a valid
+            # single-file export must win over leftover shard files
             full = [f for f in ggufs
                     if any(t in f.lower() for t in ("f32", "f16", "bf16"))]
             ggufs = full or ggufs
+            single = [f for f in ggufs if "-of-" not in f]
+            if not single:
+                raise FileNotFoundError(
+                    f"{ref}: only sharded GGUF exports found; point "
+                    "--model-path at a single-file export")
+            ggufs = single
         if ggufs:
             return ResolvedModel("gguf", os.path.join(ref, ggufs[0]))
         raise FileNotFoundError(

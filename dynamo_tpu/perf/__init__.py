@@ -1,0 +1,8 @@
+"""Performance analysis tooling (ref: lib/llm/src/perf.rs, perf/logprobs.rs)."""
+
+from dynamo_tpu.perf.logprobs import (  # noqa: F401
+    ChoiceAnalysis,
+    SensitivityAnalysis,
+    analyze_logprob_sensitivity,
+    compare_runs,
+)

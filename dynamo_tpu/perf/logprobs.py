@@ -1,0 +1,264 @@
+"""Logprob sensitivity analysis: how close was sampling to diverging?
+
+Rebuild of the reference's logprob tooling (ref: lib/llm/src/perf/
+logprobs.rs:1-1621 — SensitivityAnalysis / ChoiceAnalysis /
+PositionCloseness over OpenAI responses with logprobs): given chat
+completions that carry ``logprobs.content`` (selected token + top
+alternatives per position), compute
+
+- per-position **closeness**: logprob gap between the selected token and
+  the best alternative — small gaps are the positions where a different
+  seed/engine/precision would flip the output;
+- **close positions** under a threshold, per choice;
+- **greedy detection**: fraction of positions where the selected token was
+  the argmax (≈1.0 ⇒ the run was effectively greedy);
+- **run comparison**: first divergence + per-position gap stats between two
+  runs of the same prompt (the determinism/precision debugging tool).
+
+CLI: ``python -m dynamo_tpu.perf.logprobs recorded.jsonl`` over request
+recorder output (llm/recorder.py) or a JSONL of response objects.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+
+@dataclass
+class PositionCloseness:
+    position: int
+    selected_token: str
+    selected_logprob: float
+    closest_alternative: Optional[str]
+    gap: float  # selected_logprob - best alternative logprob (>= 0 if greedy)
+    is_greedy: bool  # selected was the argmax of the reported set
+
+
+@dataclass
+class ChoiceAnalysis:
+    choice_index: int
+    positions: list[PositionCloseness] = field(default_factory=list)
+
+    @property
+    def num_positions(self) -> int:
+        return len(self.positions)
+
+    def close_positions(self, threshold: float) -> list[PositionCloseness]:
+        """Positions whose |gap| is under ``threshold`` nats — the flip
+        candidates (ref: get_close_positions_for_choice)."""
+        return [p for p in self.positions
+                if p.closest_alternative is not None
+                and abs(p.gap) < threshold]
+
+    def close_position_percentage(self, threshold: float) -> float:
+        if not self.positions:
+            return 0.0
+        return 100.0 * len(self.close_positions(threshold)) / len(self.positions)
+
+    @property
+    def greedy_percentage(self) -> float:
+        """% of positions where the selected token had the best logprob
+        (ref: greedy_selection_percentage)."""
+        if not self.positions:
+            return 0.0
+        return 100.0 * sum(p.is_greedy for p in self.positions) / len(self.positions)
+
+    @property
+    def likely_greedy(self) -> bool:
+        return self.greedy_percentage >= 99.999  # ref: detect_likely_greedy
+
+    @property
+    def min_gap(self) -> Optional[PositionCloseness]:
+        cands = [p for p in self.positions if p.closest_alternative is not None]
+        return min(cands, key=lambda p: abs(p.gap)) if cands else None
+
+
+@dataclass
+class SensitivityAnalysis:
+    choices: list[ChoiceAnalysis] = field(default_factory=list)
+
+    def choice(self, index: int) -> Optional[ChoiceAnalysis]:
+        for c in self.choices:
+            if c.choice_index == index:
+                return c
+        return None
+
+    def to_dict(self, thresholds=(0.1, 0.5, 1.0)) -> dict:
+        out = {"choices": []}
+        for c in self.choices:
+            m = c.min_gap
+            out["choices"].append({
+                "index": c.choice_index,
+                "positions": c.num_positions,
+                "greedy_pct": round(c.greedy_percentage, 3),
+                "likely_greedy": c.likely_greedy,
+                "close_pct": {str(t): round(c.close_position_percentage(t), 3)
+                              for t in thresholds},
+                "min_gap": (None if m is None else
+                            {"position": m.position, "gap": round(m.gap, 6),
+                             "selected": m.selected_token,
+                             "alternative": m.closest_alternative}),
+            })
+        return out
+
+    def print_summary(self, thresholds=(0.1, 0.5, 1.0)) -> None:
+        for c in self.choices:
+            print(f"choice {c.choice_index}: {c.num_positions} positions, "
+                  f"greedy {c.greedy_percentage:.1f}%"
+                  + (" (likely greedy decoding)" if c.likely_greedy else ""))
+            for t in thresholds:
+                n = len(c.close_positions(t))
+                print(f"  gap < {t:>4} nats: {n:4d} positions "
+                      f"({c.close_position_percentage(t):.1f}%)")
+            m = c.min_gap
+            if m is not None:
+                print(f"  tightest: pos {m.position} "
+                      f"{m.selected_token!r} vs {m.closest_alternative!r} "
+                      f"(gap {m.gap:+.4f})")
+
+
+def _iter_logprob_content(response: dict):
+    """Yield (choice_index, content_entries) for every choice carrying
+    logprobs, accepting chat responses AND raw choice lists."""
+    for ch in response.get("choices", []):
+        lp = ch.get("logprobs") or {}
+        entries = lp.get("content")
+        if entries:
+            yield ch.get("index", 0), entries
+
+
+def analyze_logprob_sensitivity(
+        responses: Iterable[dict]) -> SensitivityAnalysis:
+    """Fold OpenAI chat responses (with logprobs) into a sensitivity
+    analysis (ref: analyze_logprob_sensitivity, logprobs.rs:270)."""
+    by_choice: dict[int, ChoiceAnalysis] = {}
+    for resp in responses:
+        for idx, entries in _iter_logprob_content(resp):
+            ca = by_choice.setdefault(idx, ChoiceAnalysis(choice_index=idx))
+            for entry in entries:
+                sel_tok = entry.get("token", "")
+                sel_lp = float(entry.get("logprob", -math.inf))
+                best_alt, best_lp = None, -math.inf
+                skipped_self = False  # selected token's own entry (once)
+                for alt in entry.get("top_logprobs", []):
+                    if not skipped_self and alt.get("token") == sel_tok:
+                        skipped_self = True
+                        continue
+                    lp = float(alt.get("logprob", -math.inf))
+                    if lp > best_lp:
+                        best_alt, best_lp = alt.get("token"), lp
+                ca.positions.append(PositionCloseness(
+                    position=len(ca.positions),
+                    selected_token=sel_tok,
+                    selected_logprob=sel_lp,
+                    closest_alternative=best_alt,
+                    gap=(sel_lp - best_lp) if best_alt is not None else math.inf,
+                    is_greedy=best_alt is None or sel_lp >= best_lp,
+                ))
+    return SensitivityAnalysis(
+        choices=[by_choice[i] for i in sorted(by_choice)])
+
+
+@dataclass
+class RunComparison:
+    """Token-level divergence between two runs of one prompt."""
+
+    first_divergence: Optional[int]
+    num_compared: int
+    max_logprob_delta: float
+    mean_logprob_delta: float
+
+    def to_dict(self) -> dict:
+        return {
+            "first_divergence": self.first_divergence,
+            "num_compared": self.num_compared,
+            "max_logprob_delta": self.max_logprob_delta,
+            "mean_logprob_delta": self.mean_logprob_delta,
+        }
+
+
+def compare_runs(a: dict, b: dict, choice: int = 0) -> RunComparison:
+    """Compare two responses' selected tokens + logprobs position by
+    position — the cross-run/precision divergence tool (ref: perf.rs
+    top-k divergence intent)."""
+    ea = dict(_iter_logprob_content(a)).get(choice, [])
+    eb = dict(_iter_logprob_content(b)).get(choice, [])
+    n = min(len(ea), len(eb))
+    first_div = None
+    deltas = []
+    for i in range(n):
+        if ea[i].get("token") != eb[i].get("token"):
+            first_div = i
+            break
+        deltas.append(abs(float(ea[i].get("logprob", 0.0))
+                          - float(eb[i].get("logprob", 0.0))))
+    if first_div is None and len(ea) != len(eb):
+        first_div = n
+    return RunComparison(
+        first_divergence=first_div,
+        num_compared=len(deltas),
+        max_logprob_delta=max(deltas) if deltas else 0.0,
+        mean_logprob_delta=(sum(deltas) / len(deltas)) if deltas else 0.0,
+    )
+
+
+def _load_responses(path: str) -> list[dict]:
+    """Responses from a JSONL file: raw response objects, or the request
+    recorder's envelope lines (llm/recorder.py wraps frames)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            # recorder envelopes: {"dir": "out", "frame": {"data": {...}}}
+            frame = d.get("frame")
+            if isinstance(frame, dict) and isinstance(frame.get("data"), dict):
+                d = frame["data"]
+            if "choices" in d:
+                out.append(d)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="logprob sensitivity analysis over recorded responses")
+    ap.add_argument("path", help="JSONL of responses (or recorder output)")
+    ap.add_argument("--compare", default=None,
+                    help="second JSONL: report run-vs-run divergence")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    responses = _load_responses(args.path)
+    if not responses:
+        print("no responses with logprobs found")
+        return 1
+    analysis = analyze_logprob_sensitivity(responses)
+    if args.compare:
+        other = _load_responses(args.compare)
+        cmp_res = compare_runs(responses[0], other[0]) if other else None
+    else:
+        cmp_res = None
+    if args.json:
+        out = analysis.to_dict()
+        if cmp_res is not None:
+            out["comparison"] = cmp_res.to_dict()
+        print(json.dumps(out))
+    else:
+        analysis.print_summary()
+        if cmp_res is not None:
+            print(f"run comparison: first divergence at "
+                  f"{cmp_res.first_divergence}, mean |Δlogprob| "
+                  f"{cmp_res.mean_logprob_delta:.6f} over "
+                  f"{cmp_res.num_compared} positions")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -122,6 +122,10 @@ class LLMEngineOutput:
     text: Optional[str] = None
     cum_log_probs: Optional[float] = None
     log_probs: Optional[list[float]] = None
+    #: per emitted token: top-k alternatives as [token_id, logprob] pairs
+    #: (present only when the request asked for logprobs — ref surface:
+    #: perf/logprobs.rs TokenLogProbs)
+    top_logprobs: Optional[list[list]] = None
     finish_reason: Optional[str] = None
     index: Optional[int] = None
     #: disaggregation: prefill worker hands decode worker the KV transfer params
@@ -129,7 +133,9 @@ class LLMEngineOutput:
 
     def to_wire(self) -> dict:
         d = {"token_ids": self.token_ids}
-        for k in ("tokens", "text", "cum_log_probs", "log_probs", "finish_reason", "index", "kv_transfer_params"):
+        for k in ("tokens", "text", "cum_log_probs", "log_probs",
+                  "top_logprobs", "finish_reason", "index",
+                  "kv_transfer_params"):
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
@@ -143,6 +149,7 @@ class LLMEngineOutput:
             text=d.get("text"),
             cum_log_probs=d.get("cum_log_probs"),
             log_probs=d.get("log_probs"),
+            top_logprobs=d.get("top_logprobs"),
             finish_reason=d.get("finish_reason"),
             index=d.get("index"),
             kv_transfer_params=d.get("kv_transfer_params"),

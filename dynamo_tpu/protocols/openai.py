@@ -123,9 +123,22 @@ def _parse_common(body: dict, req: ParsedRequest) -> ParsedRequest:
     )
     logprobs = body.get("logprobs")
     top_logprobs = body.get("top_logprobs")
+    if top_logprobs is not None and not (
+            isinstance(top_logprobs, int) and 0 <= top_logprobs <= 20):
+        raise RequestError("'top_logprobs' must be an integer in [0, 20]")
+    if isinstance(logprobs, int) and not isinstance(logprobs, bool) \
+            and not 0 <= logprobs <= 20:
+        raise RequestError("'logprobs' must be in [0, 20]")
+    # chat: logprobs=true (+optional top_logprobs N); completions:
+    # logprobs=N. Stored as the requested alternatives count (None = off;
+    # 0 = selected-token logprobs only).
+    if isinstance(logprobs, bool):
+        lp_count = (top_logprobs if top_logprobs is not None else 1) \
+            if logprobs else None
+    else:
+        lp_count = logprobs if isinstance(logprobs, int) else None
     req.output = OutputOptions(
-        logprobs=(top_logprobs if isinstance(logprobs, bool) and logprobs else
-                  (logprobs if isinstance(logprobs, int) else None)),
+        logprobs=lp_count,
         echo=bool(body.get("echo", False)),
     )
     req.tools = body.get("tools")
@@ -166,6 +179,7 @@ def chat_chunk(
     reasoning_content: Optional[str] = None,
     finish_reason: Optional[str] = None,
     usage: Optional[dict] = None,
+    logprobs: Optional[dict] = None,
 ) -> dict:
     delta: dict = {}
     if role is not None:
@@ -181,7 +195,9 @@ def chat_chunk(
         "object": "chat.completion.chunk",
         "created": created,
         "model": model,
-        "choices": [{"index": index, "delta": delta, "finish_reason": finish_reason}],
+        "choices": [{"index": index, "delta": delta,
+                     "logprobs": logprobs,
+                     "finish_reason": finish_reason}],
     }
     if usage is not None:
         chunk["usage"] = usage
@@ -230,13 +246,14 @@ def completion_chunk(
     text: str = "",
     finish_reason: Optional[str] = None,
     usage: Optional[dict] = None,
+    logprobs: Optional[dict] = None,
 ) -> dict:
     chunk = {
         "id": request_id,
         "object": "text_completion",
         "created": created,
         "model": model,
-        "choices": [{"index": index, "text": text, "finish_reason": finish_reason, "logprobs": None}],
+        "choices": [{"index": index, "text": text, "finish_reason": finish_reason, "logprobs": logprobs}],
     }
     if usage is not None:
         chunk["usage"] = usage

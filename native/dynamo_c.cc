@@ -18,9 +18,10 @@
 // blocks' identity (ExternalSequenceBlockHash) and tokens_hash is computed
 // here from the token chunks (salted xxh3, seed 1337 — tokens.py parity).
 //
-// Thread-safety: one global connection guarded by a mutex (the reference's
-// publisher is a single handle too). lora_id is accepted for ABI parity and
-// ignored (LoRA-scoped routing is not implemented).
+// Thread-safety: every entry point serializes on ONE global mutex — init,
+// shutdown, and publishes cannot race (a publish concurrent with shutdown
+// must not observe a deleted client). lora_id is accepted for ABI parity
+// and ignored (LoRA-scoped routing is not implemented).
 //
 // Build: python -m dynamo_tpu.native_build (links with xxh3.cc).
 
@@ -85,40 +86,48 @@ struct Packer {
 };
 
 // Minimal decoder: enough to read {"t":"res","id":u,"ok":b,...} responses.
+// Every read is bounds-checked — a truncated or malicious frame must fail
+// the parse, never read past the buffer.
 struct Unpacker {
     const uint8_t* p;
     const uint8_t* end;
 
-    bool ok() const { return p <= end; }
-    uint8_t peek() const { return *p; }
-    uint8_t next() { return *p++; }
-    uint64_t be(int n) {
+    size_t remaining() const { return static_cast<size_t>(end - p); }
+    bool take(size_t n) {  // consume n raw bytes if available
+        if (remaining() < n) return false;
+        p += n;
+        return true;
+    }
+    bool be(size_t n, uint64_t* out) {
+        if (remaining() < n) return false;
         uint64_t v = 0;
-        while (n--) v = (v << 8) | next();
-        return v;
+        while (n--) v = (v << 8) | *p++;
+        *out = v;
+        return true;
     }
 
     // returns false on malformed input
     bool skip() {
         if (p >= end) return false;
-        uint8_t t = next();
+        uint8_t t = *p++;
+        uint64_t n = 0;
         if (t < 0x80 || t >= 0xe0) return true;           // fixint
         if ((t & 0xf0) == 0x80) return skip_n((t & 0x0f) * 2);  // fixmap
         if ((t & 0xf0) == 0x90) return skip_n(t & 0x0f);  // fixarray
-        if ((t & 0xe0) == 0xa0) { p += t & 0x1f; return ok(); }  // fixstr
+        if ((t & 0xe0) == 0xa0) return take(t & 0x1f);    // fixstr
         switch (t) {
             case 0xc0: case 0xc2: case 0xc3: return true;
-            case 0xcc: case 0xd0: p += 1; return ok();
-            case 0xcd: case 0xd1: p += 2; return ok();
-            case 0xce: case 0xd2: case 0xca: p += 4; return ok();
-            case 0xcf: case 0xd3: case 0xcb: p += 8; return ok();
-            case 0xd9: case 0xc4: { uint64_t n = be(1); p += n; return ok(); }
-            case 0xda: case 0xc5: { uint64_t n = be(2); p += n; return ok(); }
-            case 0xdb: case 0xc6: { uint64_t n = be(4); p += n; return ok(); }
-            case 0xdc: return skip_n(be(2));
-            case 0xdd: return skip_n(be(4));
-            case 0xde: return skip_n(be(2) * 2);
-            case 0xdf: return skip_n(be(4) * 2);
+            case 0xcc: case 0xd0: return take(1);
+            case 0xcd: case 0xd1: return take(2);
+            case 0xce: case 0xd2: case 0xca: return take(4);
+            case 0xcf: case 0xd3: case 0xcb: return take(8);
+            case 0xd9: case 0xc4: return be(1, &n) && take(n);
+            case 0xda: case 0xc5: return be(2, &n) && take(n);
+            case 0xdb: case 0xc6: return be(4, &n) && take(n);
+            case 0xdc: return be(2, &n) && skip_n(n);
+            case 0xdd: return be(4, &n) && skip_n(n);
+            case 0xde: return be(2, &n) && skip_n(n * 2);
+            case 0xdf: return be(4, &n) && skip_n(n * 2);
             default: return false;
         }
     }
@@ -128,25 +137,25 @@ struct Unpacker {
     }
     bool read_str(std::string* out) {
         if (p >= end) return false;
-        uint8_t t = next();
+        uint8_t t = *p++;
         uint64_t n;
         if ((t & 0xe0) == 0xa0) n = t & 0x1f;
-        else if (t == 0xd9) n = be(1);
-        else if (t == 0xda) n = be(2);
+        else if (t == 0xd9) { if (!be(1, &n)) return false; }
+        else if (t == 0xda) { if (!be(2, &n)) return false; }
         else return false;
-        if (p + n > end) return false;
+        if (remaining() < n) return false;
         out->assign(reinterpret_cast<const char*>(p), n);
         p += n;
         return true;
     }
     bool read_uint(uint64_t* out) {
         if (p >= end) return false;
-        uint8_t t = next();
+        uint8_t t = *p++;
         if (t < 0x80) { *out = t; return true; }
-        if (t == 0xcc) { *out = be(1); return true; }
-        if (t == 0xcd) { *out = be(2); return true; }
-        if (t == 0xce) { *out = be(4); return true; }
-        if (t == 0xcf) { *out = be(8); return true; }
+        if (t == 0xcc) return be(1, out);
+        if (t == 0xcd) return be(2, out);
+        if (t == 0xce) return be(4, out);
+        if (t == 0xcf) return be(8, out);
         return false;
     }
 };
@@ -158,7 +167,6 @@ struct Client {
     uint64_t next_id = 0;
     uint64_t worker_id = 0;
     uint32_t kv_block_size = 0;
-    std::mutex mu;
 
     bool send_all(const uint8_t* d, size_t n) {
         while (n) {
@@ -195,10 +203,10 @@ struct Client {
             if (!recv_all(body.data(), m)) return false;
             Unpacker u{body.data(), body.data() + m};
             if (u.p >= u.end) return false;
-            uint8_t t = u.next();
+            uint8_t t = *u.p++;
             uint64_t fields = 0;
             if ((t & 0xf0) == 0x80) fields = t & 0x0f;
-            else if (t == 0xde) fields = u.be(2);
+            else if (t == 0xde) { if (!u.be(2, &fields)) return false; }
             else return false;
             std::string key, typ;
             uint64_t id = 0;
@@ -211,7 +219,7 @@ struct Client {
                     if (!u.read_uint(&id)) return false;
                 } else if (key == "ok") {
                     if (u.p >= u.end) return false;
-                    uint8_t b = u.next();
+                    uint8_t b = *u.p++;
                     got_ok = true;
                     ok_val = (b == 0xc3);
                 } else {
@@ -225,14 +233,14 @@ struct Client {
 };
 
 Client* g_client = nullptr;
-std::mutex g_init_mu;
+std::mutex g_mu;  // serializes init, shutdown, and every publish
 
-int publish(const Packer& payload) {
+// caller must hold g_mu
+int publish_locked(const Packer& payload) {
     if (!g_client) {
         fprintf(stderr, "dynamo_c: publish before dynamo_llm_init\n");
         return 1;
     }
-    std::lock_guard<std::mutex> lock(g_client->mu);
     uint64_t rid = ++g_client->next_id;
     Packer req;
     req.map(5);
@@ -260,7 +268,7 @@ extern "C" {
 int dynamo_llm_init(const char* addr, const char* /*ns*/,
                     const char* /*component*/, uint64_t worker_id,
                     uint32_t kv_block_size) {
-    std::lock_guard<std::mutex> lock(g_init_mu);
+    std::lock_guard<std::mutex> lock(g_mu);
     if (g_client) {
         fprintf(stderr, "dynamo_c: already initialized\n");
         return 1;
@@ -306,7 +314,7 @@ int dynamo_llm_init(const char* addr, const char* /*ns*/,
 }
 
 int dynamo_llm_shutdown(void) {
-    std::lock_guard<std::mutex> lock(g_init_mu);
+    std::lock_guard<std::mutex> lock(g_mu);
     if (!g_client) return 1;
     close(g_client->fd);
     delete g_client;
@@ -326,6 +334,7 @@ int dynamo_kv_event_publish_stored(uint64_t event_id,
                                    size_t num_blocks,
                                    const uint64_t* parent_hash,
                                    uint64_t /*lora_id*/) {
+    std::lock_guard<std::mutex> lock(g_mu);
     if (!g_client) return 1;
     for (size_t i = 0; i < num_blocks; i++) {
         if (num_block_tokens[i] != g_client->kv_block_size) {
@@ -356,12 +365,13 @@ int dynamo_kv_event_publish_stored(uint64_t event_id,
         ev.str("block_hash"); ev.uint(block_ids[i]);
         ev.str("tokens_hash"); ev.uint(th);
     }
-    return publish(ev);
+    return publish_locked(ev);
 }
 
 int dynamo_kv_event_publish_removed(uint64_t event_id,
                                     const uint64_t* block_ids,
                                     size_t num_blocks) {
+    std::lock_guard<std::mutex> lock(g_mu);
     if (!g_client) return 1;
     Packer ev;
     ev.map(2);
@@ -374,7 +384,7 @@ int dynamo_kv_event_publish_removed(uint64_t event_id,
     ev.str("block_hashes");
     ev.arr(num_blocks);
     for (size_t i = 0; i < num_blocks; i++) ev.uint(block_ids[i]);
-    return publish(ev);
+    return publish_locked(ev);
 }
 
 }  // extern "C"

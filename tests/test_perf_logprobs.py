@@ -1,0 +1,149 @@
+"""Logprobs: engine top-k capture → OpenAI logprobs surface → sensitivity
+analysis (ref: lib/llm/src/perf/logprobs.rs)."""
+
+import asyncio
+import json
+import math
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.perf.logprobs import (
+    analyze_logprob_sensitivity, compare_runs,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+async def test_engine_emits_top_logprobs():
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (
+        OutputOptions, PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    eng = AsyncJaxEngine(ModelConfig.tiny(), EngineArgs(
+        block_size=4, num_blocks=64, max_num_seqs=4,
+        max_num_batched_tokens=32, max_model_len=128,
+        prefill_buckets=(8, 16, 32), decode_batch_buckets=(1, 2, 4),
+        multi_step_decode=4))  # burst enabled: logprobs must bypass it
+    req = PreprocessedRequest(
+        model="t", token_ids=list(range(1, 9)),
+        stop_conditions=StopConditions(max_tokens=5, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+        output_options=OutputOptions(logprobs=3))
+    outs = []
+    async for out in eng.generate(req):
+        outs.append(out)
+    toks = [t for o in outs for t in o.token_ids]
+    tops = [tp for o in outs for tp in (o.top_logprobs or [])]
+    assert len(tops) == len(toks) == 5
+    for tok, alts in zip(toks, tops):
+        assert 1 <= len(alts) <= 3
+        # sorted descending, and greedy's choice is the argmax entry
+        lps = [p for _, p in alts]
+        assert lps == sorted(lps, reverse=True)
+        assert alts[0][0] == tok  # temperature=0 → selected is the best
+        assert all(p <= 0.0 for p in lps)  # logprobs, normalized
+    await eng.close()
+
+
+async def test_logprobs_through_openai_surface():
+    """in-process pipeline: chat request with logprobs → chunks carry
+    logprobs.content; aggregation folds them into the final choice."""
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.pipeline import aggregate_chat_stream, build_pipeline
+    from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+    from dynamo_tpu.protocols import PreprocessedRequest
+    from dynamo_tpu.protocols.openai import parse_chat_request
+    from dynamo_tpu.runtime.context import Context
+
+    tk = make_test_tokenizer()
+    cfg = ModelConfig.tiny(vocab_size=tk.vocab_size)
+    eng = AsyncJaxEngine(cfg, EngineArgs(
+        block_size=4, num_blocks=64, max_num_seqs=4,
+        max_num_batched_tokens=32, max_model_len=128,
+        prefill_buckets=(8, 16, 32), decode_batch_buckets=(1, 2, 4)))
+
+    async def engine_fn(request, ctx):
+        req = PreprocessedRequest.from_wire(request) \
+            if isinstance(request, dict) else request
+        async for out in eng.generate(req, ctx):
+            yield out.to_wire()
+
+    mdc = ModelDeploymentCard(display_name="t", kv_cache_block_size=4,
+                              eos_token_ids=[], tokenizer_ref="test")
+    pipe = build_pipeline(mdc, tk, engine_fn)
+    parsed = parse_chat_request({
+        "model": "t", "messages": [{"role": "user", "content": "hello hi"}],
+        "max_tokens": 4, "temperature": 0.0,
+        "logprobs": True, "top_logprobs": 2,
+    })
+    result = await aggregate_chat_stream(pipe.generate(parsed, Context()))
+    lp = result["choices"][0].get("logprobs")
+    assert lp and len(lp["content"]) == 4
+    entry = lp["content"][0]
+    assert "token" in entry and isinstance(entry["logprob"], float)
+    assert 1 <= len(entry["top_logprobs"]) <= 2
+    await eng.close()
+
+    # the analysis module consumes exactly this shape
+    analysis = analyze_logprob_sensitivity([result])
+    ca = analysis.choices[0]
+    assert ca.num_positions == 4
+    assert ca.greedy_percentage == 100.0 and ca.likely_greedy
+
+
+def _resp(tokens_with_alts, index=0):
+    content = []
+    for tok, lp, alts in tokens_with_alts:
+        content.append({
+            "token": tok, "logprob": lp,
+            "top_logprobs": [{"token": t, "logprob": p}
+                             for t, p in [(tok, lp)] + alts]})
+    return {"choices": [{"index": index, "logprobs": {"content": content},
+                         "message": {}, "finish_reason": "stop"}]}
+
+
+def test_sensitivity_math():
+    resp = _resp([
+        ("a", -0.1, [("b", -0.15)]),   # gap 0.05 — a close call
+        ("c", -0.2, [("d", -3.0)]),    # gap 2.8 — decisive
+        ("e", -1.0, [("f", -0.5)]),    # negative gap — NOT greedy
+    ])
+    analysis = analyze_logprob_sensitivity([resp])
+    ca = analysis.choices[0]
+    assert ca.num_positions == 3
+    assert len(ca.close_positions(0.1)) == 1
+    assert len(ca.close_positions(1.0)) == 2  # |gap| 0.05 and 0.5
+    assert abs(ca.greedy_percentage - 200 / 3) < 1e-6
+    assert not ca.likely_greedy
+    m = ca.min_gap
+    assert m.position == 0 and m.closest_alternative == "b"
+    d = analysis.to_dict()
+    assert d["choices"][0]["positions"] == 3
+
+
+def test_compare_runs():
+    a = _resp([("x", -0.1, []), ("y", -0.2, []), ("z", -0.3, [])])
+    b = _resp([("x", -0.1, []), ("y", -0.25, []), ("w", -0.3, [])])
+    cmp_res = compare_runs(a, b)
+    assert cmp_res.first_divergence == 2
+    assert cmp_res.num_compared == 2
+    assert abs(cmp_res.max_logprob_delta - 0.05) < 1e-9
+
+    same = compare_runs(a, a)
+    assert same.first_divergence is None
+    assert same.max_logprob_delta == 0.0
+
+
+def test_cli_on_jsonl(tmp_path, capsys):
+    from dynamo_tpu.perf.logprobs import main
+
+    p = tmp_path / "resp.jsonl"
+    p.write_text(json.dumps(_resp([("a", -0.1, [("b", -0.12)])])) + "\n")
+    assert main([str(p), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["choices"][0]["positions"] == 1
