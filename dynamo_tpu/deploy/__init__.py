@@ -1,0 +1,2 @@
+"""Deploy/control layer: operator reconciler + Kubernetes connector +
+recipes (ref: deploy/cloud/operator, components/planner k8s connector)."""

@@ -1,0 +1,80 @@
+"""Kubernetes planner connector: apply scaling decisions to a
+DynamoGraphDeployment custom resource.
+
+Rebuild of the reference's KubernetesConnector (ref: components/planner/src/
+dynamo/planner/kubernetes_connector.py — patches the DynamoGraphDeployment
+CRD's per-service replica counts; the operator's reconciler then realizes
+them as pods). No kubernetes client library ships in this image, so the
+patch rides ``kubectl`` (which handles kubeconfig/in-cluster auth); the
+command runner is injectable for tests and alternative transports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Callable, Optional
+
+from dynamo_tpu.planner.planner_core import Decision
+
+logger = logging.getLogger("dynamo.planner.k8s")
+
+GRAPH_RESOURCE = "dynamographdeployment"
+
+
+async def _kubectl(argv: list[str]) -> tuple[int, str]:
+    """Default runner: kubectl subprocess (argv excludes the binary)."""
+    proc = await asyncio.create_subprocess_exec(
+        "kubectl", *argv,
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT)
+    out, _ = await proc.communicate()
+    return proc.returncode, out.decode()
+
+
+class KubernetesConnector:
+    """``apply(decision)`` → one JSON merge patch per changed service."""
+
+    def __init__(self, deployment: str, k8s_namespace: str = "default",
+                 prefill_service: str = "prefill",
+                 decode_service: str = "decode",
+                 runner: Optional[Callable] = None):
+        self.deployment = deployment
+        self.k8s_namespace = k8s_namespace
+        self.prefill_service = prefill_service
+        self.decode_service = decode_service
+        self.runner = runner or _kubectl
+        self.applied: Optional[Decision] = None
+
+    async def apply(self, decision: Decision) -> None:
+        if (self.applied is not None
+                and decision.prefill_replicas == self.applied.prefill_replicas
+                and decision.decode_replicas == self.applied.decode_replicas):
+            return
+        patch = {"spec": {"services": {
+            self.prefill_service: {"replicas": int(decision.prefill_replicas)},
+            self.decode_service: {"replicas": int(decision.decode_replicas)},
+        }}}
+        rc, out = await self.runner([
+            "-n", self.k8s_namespace, "patch", GRAPH_RESOURCE,
+            self.deployment, "--type", "merge", "-p", json.dumps(patch)])
+        if rc != 0:
+            # keep self.applied unchanged so the next tick retries
+            logger.error("kubectl patch failed (rc=%d): %s", rc, out.strip())
+            return
+        self.applied = decision
+        logger.info("k8s scale applied: prefill=%d decode=%d",
+                    decision.prefill_replicas, decision.decode_replicas)
+
+    async def read_replicas(self) -> Optional[dict]:
+        """Observed spec replicas (for drift checks / tests)."""
+        rc, out = await self.runner([
+            "-n", self.k8s_namespace, "get", GRAPH_RESOURCE, self.deployment,
+            "-o", "json"])
+        if rc != 0:
+            return None
+        try:
+            spec = json.loads(out).get("spec", {}).get("services", {})
+            return {name: svc.get("replicas") for name, svc in spec.items()}
+        except (ValueError, AttributeError):
+            return None

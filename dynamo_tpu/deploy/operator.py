@@ -1,0 +1,304 @@
+"""Process operator: reconcile a DynamoGraphDeployment spec into processes.
+
+Analog of the reference's Kubernetes operator (ref: deploy/cloud/operator —
+Go CRDs + reconcilers realizing DynamoGraphDeployment/
+DynamoComponentDeployment as pods): the same desired-state → observe →
+reconcile loop, realized as local processes so the operator semantics run
+(and test) anywhere — a TPU-VM, a dev box, CI — without a cluster. On GKE
+the real scheduler is Kubernetes itself (deploy/recipes/k8s/); this
+reconciler is the single-host / bare-TPU-VM deployment path and the
+operator's testbed.
+
+Spec (YAML, CRD-shaped — ref: api/v1alpha1/dynamographdeployment_types.go):
+
+    apiVersion: dynamo.tpu/v1alpha1
+    kind: DynamoGraphDeployment
+    metadata: {name: my-graph}
+    spec:
+      services:
+        frontend:
+          replicas: 1
+          command: [python, -m, dynamo_tpu.frontend.main, --port, "8000"]
+          env: {DYN_LOG: info}
+        decode:
+          replicas: 2
+          command: [python, -m, dynamo_tpu.engine.main, --role, decode]
+          plannerRole: decode        # planner target overrides replicas
+
+Reconcile behavior:
+
+- spec file changes are picked up each tick (mtime watch);
+- missing replicas are spawned (env merged over os.environ, with
+  DYN_REPLICA_INDEX set), excess replicas get SIGTERM → SIGKILL;
+- crashed replicas restart with exponential backoff, counted in status;
+- services marked ``plannerRole: prefill|decode`` follow the planner's
+  VirtualConnector target key on the control plane — the SLA planner
+  drives real scale-up/down end-to-end without Kubernetes (ref intent:
+  planner → operator → pods);
+- observed state is written to ``<spec>.status.json`` every tick (the CRD
+  status subresource analog); scale-down kills newest-first and the dead
+  workers' leases expire, which is the reference's etcd-cleanup-on-
+  scale-down contract (internal/etcd/) falling out of lease semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import yaml
+
+logger = logging.getLogger("dynamo.operator")
+
+_BACKOFF = (1.0, 2.0, 5.0, 10.0, 30.0)
+
+
+@dataclass
+class ServiceSpec:
+    name: str
+    replicas: int
+    command: list[str]
+    env: dict = field(default_factory=dict)
+    planner_role: Optional[str] = None  # "prefill" | "decode"
+
+
+@dataclass
+class Replica:
+    proc: subprocess.Popen
+    index: int
+    started: float
+
+
+def parse_spec(path: str) -> dict[str, ServiceSpec]:
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if not isinstance(doc, dict) or doc.get("kind") != "DynamoGraphDeployment":
+        raise ValueError(f"{path}: expected kind DynamoGraphDeployment")
+    out: dict[str, ServiceSpec] = {}
+    for name, svc in (doc.get("spec", {}).get("services") or {}).items():
+        cmd = svc.get("command")
+        if not cmd or not isinstance(cmd, list):
+            raise ValueError(f"service {name}: 'command' list is required")
+        out[name] = ServiceSpec(
+            name=name,
+            replicas=int(svc.get("replicas", 1)),
+            command=[str(c) for c in cmd],
+            env={str(k): str(v) for k, v in (svc.get("env") or {}).items()},
+            planner_role=svc.get("plannerRole"),
+        )
+    if not out:
+        raise ValueError(f"{path}: no services in spec")
+    return out
+
+
+class ProcessOperator:
+    def __init__(self, spec_path: str, plane=None, namespace: str = "dynamo",
+                 tick_s: float = 1.0):
+        self.spec_path = spec_path
+        self.plane = plane  # control plane for planner-target watching
+        self.namespace = namespace
+        self.tick_s = tick_s
+        self.services: dict[str, ServiceSpec] = parse_spec(spec_path)
+        self.replicas: dict[str, list[Replica]] = {s: [] for s in self.services}
+        self.restarts: dict[str, int] = {s: 0 for s in self.services}
+        self._crash_streak: dict[str, int] = {s: 0 for s in self.services}
+        self._next_start: dict[str, float] = {s: 0.0 for s in self.services}
+        self._spec_mtime = os.path.getmtime(spec_path)
+        self._planner_target: Optional[dict] = None
+        self._stop = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    # -- desired state -----------------------------------------------------
+
+    def _desired(self, svc: ServiceSpec) -> int:
+        if svc.planner_role and self._planner_target:
+            t = self._planner_target.get(svc.planner_role)
+            if t is not None:
+                return max(0, int(t))
+        return svc.replicas
+
+    async def _refresh_planner_target(self) -> None:
+        if self.plane is None:
+            return
+        from dynamo_tpu.planner.virtual_connector import SCALE_KEY
+
+        try:
+            v = await self.plane.kv_get(
+                SCALE_KEY.format(namespace=self.namespace))
+            self._planner_target = json.loads(v) if v else None
+        except Exception:
+            logger.exception("planner target read failed")
+
+    def _maybe_reload_spec(self) -> None:
+        try:
+            mtime = os.path.getmtime(self.spec_path)
+        except OSError:
+            return
+        if mtime == self._spec_mtime:
+            return
+        self._spec_mtime = mtime
+        try:
+            new = parse_spec(self.spec_path)
+        except ValueError as e:
+            logger.error("spec reload rejected: %s", e)
+            return
+        for name in list(self.replicas):
+            if name not in new:  # service removed: drain it
+                self._scale_to(self.services[name], 0)
+                del self.replicas[name]
+        for name, svc in new.items():
+            self.replicas.setdefault(name, [])
+            self.restarts.setdefault(name, 0)
+            self._crash_streak.setdefault(name, 0)
+            self._next_start.setdefault(name, 0.0)
+        self.services = new
+        logger.info("spec reloaded: %s",
+                    {n: s.replicas for n, s in new.items()})
+
+    # -- reconcile ---------------------------------------------------------
+
+    def _spawn(self, svc: ServiceSpec, index: int) -> Replica:
+        env = dict(os.environ)
+        env.update(svc.env)
+        env["DYN_REPLICA_INDEX"] = str(index)
+        proc = subprocess.Popen(svc.command, env=env)
+        logger.info("started %s[%d] pid=%d", svc.name, index, proc.pid)
+        return Replica(proc=proc, index=index, started=time.monotonic())
+
+    def _scale_to(self, svc: ServiceSpec, want: int) -> None:
+        reps = self.replicas[svc.name]
+        # reap exited replicas (crash → restart with backoff)
+        alive = []
+        for r in reps:
+            if r.proc.poll() is None:
+                alive.append(r)
+            else:
+                logger.warning("%s[%d] exited rc=%s", svc.name, r.index,
+                               r.proc.returncode)
+                self.restarts[svc.name] += 1
+                streak = self._crash_streak[svc.name]
+                if time.monotonic() - r.started > 60:
+                    streak = 0  # ran long enough: reset the backoff
+                self._crash_streak[svc.name] = streak + 1
+                delay = _BACKOFF[min(streak, len(_BACKOFF) - 1)]
+                self._next_start[svc.name] = time.monotonic() + delay
+        reps[:] = alive
+        # scale down: newest first (leases expire → discovery forgets them)
+        while len(reps) > want:
+            r = reps.pop()
+            logger.info("stopping %s[%d] pid=%d", svc.name, r.index, r.proc.pid)
+            r.proc.terminate()
+            try:
+                r.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                r.proc.kill()
+                r.proc.wait()
+        # scale up (respecting crash backoff)
+        while len(reps) < want and time.monotonic() >= self._next_start[svc.name]:
+            used = {r.index for r in reps}
+            index = next(i for i in range(want) if i not in used)
+            reps.append(self._spawn(svc, index))
+
+    def reconcile_once(self) -> None:
+        self._maybe_reload_spec()
+        for svc in self.services.values():
+            self._scale_to(svc, self._desired(svc))
+        self._write_status()
+
+    def _write_status(self) -> None:
+        status = {
+            "observedAt": time.time(),
+            "services": {
+                name: {
+                    "desired": self._desired(svc),
+                    "ready": sum(1 for r in self.replicas[name]
+                                 if r.proc.poll() is None),
+                    "restarts": self.restarts[name],
+                    "pids": [r.proc.pid for r in self.replicas[name]
+                             if r.proc.poll() is None],
+                }
+                for name, svc in self.services.items()
+            },
+        }
+        if self._planner_target:
+            status["plannerTarget"] = self._planner_target
+        tmp = self.spec_path + ".status.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(status, f, indent=2)
+        os.replace(tmp, self.spec_path + ".status.json")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> "ProcessOperator":
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def _loop(self):
+        while not self._stop.is_set():
+            await self._refresh_planner_target()
+            await asyncio.to_thread(self.reconcile_once)
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.tick_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def stop(self, drain: bool = True):
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+        if drain:
+            for svc in self.services.values():
+                self._scale_to(svc, 0)
+            self._write_status()
+
+
+async def amain():
+    import argparse
+
+    from dynamo_tpu.runtime.config import setup_logging
+
+    ap = argparse.ArgumentParser(
+        description="dynamo-tpu process operator (DynamoGraphDeployment)")
+    ap.add_argument("spec", help="DynamoGraphDeployment YAML")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--tick", type=float, default=1.0)
+    ap.add_argument("--follow-planner", action="store_true",
+                    help="watch the planner's target-replicas key on the "
+                         "control plane (DYN_CONTROL_PLANE)")
+    args = ap.parse_args()
+    setup_logging()
+
+    plane = None
+    runtime = None
+    if args.follow_planner:
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        runtime = await DistributedRuntime.create()
+        plane = runtime.plane
+    op = await ProcessOperator(args.spec, plane=plane,
+                               namespace=args.namespace,
+                               tick_s=args.tick).start()
+    print("OPERATOR_READY", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await op.stop()
+    if runtime is not None:
+        await runtime.shutdown()
+
+
+def main():
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

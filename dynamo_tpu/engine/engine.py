@@ -714,9 +714,11 @@ class AsyncJaxEngine:
         if want_tops:
             # device-side top-k: only O(B·k) crosses to host, and the
             # selected logprob comes from the same log_softmax as its
-            # alternatives (an ulp disagreement would read as a near-tie)
-            kmax = max(want_tops.values())
-            top_res = self._sampling.make_topk_logprobs_fn(kmax)(logits, toks)
+            # alternatives (an ulp disagreement would read as a near-tie).
+            # Always the k=20 kernel — one XLA compile ever, sliced per row
+            # below (a per-kmax kernel would recompile as batch composition
+            # shifts, stalling the decode loop)
+            top_res = self._sampling.make_topk_logprobs_fn(20)(logits, toks)
 
         def fetch():
             t, l = np.asarray(toks), np.asarray(logps)

@@ -71,6 +71,22 @@ class HttpService:
         )
         self._inflight = self.metrics.gauge("http_inflight_requests", "In-flight requests")
         self._inflight_count = 0
+        # token counters: the planner's ISL/OSL source (ref: the planner
+        # scrapes the frontend's Prometheus — planner/utils/prometheus.py)
+        self._prompt_tokens = self.metrics.counter(
+            "llm_prompt_tokens_total", "Prompt tokens by model")
+        self._completion_tokens = self.metrics.counter(
+            "llm_completion_tokens_total", "Completion tokens by model")
+        self._finished = self.metrics.counter(
+            "llm_requests_finished_total", "Finished LLM requests by model")
+
+    def _record_usage(self, model: str, usage: Optional[dict]) -> None:
+        if not usage:
+            return
+        self._prompt_tokens.inc(usage.get("prompt_tokens", 0) or 0, model=model)
+        self._completion_tokens.inc(usage.get("completion_tokens", 0) or 0,
+                                    model=model)
+        self._finished.inc(model=model)
 
     def build_app(self) -> web.Application:
         app = web.Application(client_max_size=32 * 1024 * 1024)
@@ -258,6 +274,7 @@ class HttpService:
                 self._requests.inc(route="responses", model=parsed.model,
                                    status="400")
                 return web.json_response(error_body(str(e)), status=400)
+            self._record_usage(parsed.model, result.get("usage"))
             choice = result["choices"][0]
             text = choice["message"].get("content") or ""
             # responses-API status: max_output_tokens truncation reports
@@ -309,7 +326,9 @@ class HttpService:
                 if ann.event is not None:
                     continue
                 chunk = ann.data
-                usage = chunk.get("usage") or usage
+                if chunk.get("usage"):
+                    usage = chunk["usage"]
+                    self._record_usage(model, usage)
                 for ch in chunk.get("choices", []):
                     delta = (ch.get("delta") or {}).get("content")
                     finish = ch.get("finish_reason") or finish
@@ -399,10 +418,13 @@ class HttpService:
         try:
             stream = served.pipeline.generate(parsed, ctx)
             if parsed.stream:
-                return await self._stream_sse(request, stream, ctx, route, parsed.model, t0)
+                return await self._stream_sse(
+                    request, stream, ctx, route, parsed.model, t0,
+                    keep_usage=parsed.stream_usage)
             try:
                 agg = aggregate_chat_stream(stream) if chat else aggregate_completion_stream(stream)
                 result = await agg
+                self._record_usage(parsed.model, result.get("usage"))
             except NoRespondersError:
                 self._requests.inc(route=route, model=parsed.model, status="503")
                 return web.json_response(
@@ -419,7 +441,8 @@ class HttpService:
             self._inflight.set(self._inflight_count)
 
     async def _stream_sse(
-        self, request: web.Request, stream, ctx: Context, route: str, model: str, t0: float
+        self, request: web.Request, stream, ctx: Context, route: str,
+        model: str, t0: float, keep_usage: bool = True
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
@@ -448,7 +471,14 @@ class HttpService:
                 if first:
                     self._ttft.observe(time.perf_counter() - t0, route=route)
                     first = False
-                await resp.write(f"data: {json.dumps(ann.data)}\n\n".encode())
+                data = ann.data
+                if isinstance(data, dict) and "usage" in data:
+                    # the pipeline always attaches final-chunk usage for
+                    # metrics; only clients that asked get it on the wire
+                    self._record_usage(model, data.get("usage"))
+                    if not keep_usage:
+                        data = {k: v for k, v in data.items() if k != "usage"}
+                await resp.write(f"data: {json.dumps(data)}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: propagate cancellation to the worker

@@ -203,7 +203,10 @@ class OpenAIPreprocessor:
                     request_id, req.model, created, text=text,
                     finish_reason=finish, logprobs=lp
                 )
-                if out.finish_reason is not None and (req.stream_usage or not req.stream):
+                if out.finish_reason is not None:
+                    # always attached: the frontend records token metrics
+                    # from it (planner's ISL/OSL source) and strips it from
+                    # the client stream unless stream_options asked
                     chunk["usage"] = usage_block(n_prompt, n_completion)
                 yield Annotated(data=chunk, id=ctx.id).to_wire()
                 continue
@@ -252,7 +255,7 @@ class OpenAIPreprocessor:
                     finish_reason=finish, logprobs=lp,
                 )
             first = False
-            if out.finish_reason is not None and (req.stream_usage or not req.stream):
+            if out.finish_reason is not None:
                 chunk["usage"] = usage_block(n_prompt, n_completion)
             yield Annotated(data=chunk, id=ctx.id).to_wire()
 
@@ -352,7 +355,11 @@ class Backend:
             text = "".join(text_parts)
             if stop_hit is not None:
                 yield LLMEngineOutput(
-                    token_ids=out.token_ids, text=text, finish_reason=stop_hit, index=out.index
+                    token_ids=out.token_ids, text=text,
+                    cum_log_probs=out.cum_log_probs,
+                    log_probs=out.log_probs,
+                    top_logprobs=out.top_logprobs,
+                    finish_reason=stop_hit, index=out.index,
                 )
                 return
             finish = out.finish_reason
@@ -530,6 +537,7 @@ async def aggregate_chat_stream(stream: AsyncIterator[dict]) -> dict:
 async def aggregate_completion_stream(stream: AsyncIterator[dict]) -> dict:
     texts: dict[int, list[str]] = {}
     finish: dict[int, Optional[str]] = {}
+    logprobs: dict[int, dict[str, list]] = {}
     base = None
     usage = None
     async for wire in stream:
@@ -545,6 +553,11 @@ async def aggregate_completion_stream(stream: AsyncIterator[dict]) -> dict:
             idx = ch.get("index", 0)
             if ch.get("text"):
                 texts.setdefault(idx, []).append(ch["text"])
+            if ch.get("logprobs"):  # concat per-chunk token arrays
+                agg = logprobs.setdefault(idx, {
+                    "tokens": [], "token_logprobs": [], "top_logprobs": []})
+                for k in agg:
+                    agg[k].extend(ch["logprobs"].get(k) or [])
             if ch.get("finish_reason"):
                 finish[idx] = ch["finish_reason"]
     if base is None:
@@ -554,7 +567,7 @@ async def aggregate_completion_stream(stream: AsyncIterator[dict]) -> dict:
             "index": idx,
             "text": "".join(texts.get(idx, [])),
             "finish_reason": finish.get(idx),
-            "logprobs": None,
+            "logprobs": logprobs.get(idx),
         }
         for idx in sorted(set(texts) | set(finish) | {0})
     ]

@@ -1,0 +1,130 @@
+"""``python -m dynamo_tpu.planner.main`` — run the SLA planner as a service.
+
+The reference's planner process (ref: components/planner — observe
+Prometheus each adjustment interval → predict load → interpolate profiled
+perf → scale via a connector): scrapes the frontend's /metrics, computes
+prefill/decode replica targets, and applies them through the chosen
+connector:
+
+- ``--connector virtual`` (default): write the target to the control-plane
+  KV (the process operator's ``--follow-planner`` realizes it);
+- ``--connector kubernetes``: kubectl merge-patch a DynamoGraphDeployment;
+- ``--connector log``: print decisions only (dry run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+
+from dynamo_tpu.planner.perf_interpolation import (
+    PerfInterpolator, PerfInterpolator2D,
+)
+from dynamo_tpu.planner.planner_core import (
+    Planner, PlannerConfig, PlannerRunner,
+)
+from dynamo_tpu.planner.prometheus import PrometheusMetricsSource
+from dynamo_tpu.runtime.config import setup_logging
+
+logger = logging.getLogger("dynamo.planner")
+
+
+def load_profile(path: str):
+    """profile_sla.py output → (prefill interpolator, decode interpolator,
+    profiled base ISL)."""
+    with open(path) as f:
+        d = json.load(f)
+    by_isl = d.get("prefill_by_isl")
+    if by_isl and len(by_isl) > 1:
+        prefill = PerfInterpolator2D(curves={
+            float(isl): pts for isl, pts in by_isl.items()})
+    else:
+        prefill = PerfInterpolator(points=d["prefill"])
+    decode = PerfInterpolator(points=d["decode"])
+    return prefill, decode, float(d.get("isl_words", 0))
+
+
+class LogConnector:
+    async def apply(self, decision):
+        logger.info("decision (dry run): prefill=%d decode=%d",
+                    decision.prefill_replicas, decision.decode_replicas)
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="dynamo-tpu SLA planner")
+    ap.add_argument("--frontend", default="http://127.0.0.1:8000",
+                    help="frontend base URL (scraped at /metrics)")
+    ap.add_argument("--profile-results", required=True,
+                    help="profile_sla.py output JSON")
+    ap.add_argument("--ttft-sla-ms", type=float, default=200.0)
+    ap.add_argument("--itl-sla-ms", type=float, default=20.0)
+    ap.add_argument("--adjustment-interval", type=float, default=30.0)
+    ap.add_argument("--predictor", default="arima",
+                    choices=["constant", "moving_average", "arima"])
+    ap.add_argument("--min-prefill", type=int, default=1)
+    ap.add_argument("--max-prefill", type=int, default=64)
+    ap.add_argument("--min-decode", type=int, default=1)
+    ap.add_argument("--max-decode", type=int, default=64)
+    ap.add_argument("--scale-down-patience", type=int, default=2)
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--connector", default="virtual",
+                    choices=["virtual", "kubernetes", "log"])
+    ap.add_argument("--k8s-deployment", default=None,
+                    help="DynamoGraphDeployment name (connector=kubernetes)")
+    ap.add_argument("--k8s-namespace", default="default")
+    cli = ap.parse_args()
+    setup_logging()
+
+    prefill_perf, decode_perf, profiled_isl = load_profile(cli.profile_results)
+    cfg = PlannerConfig(
+        ttft_sla_ms=cli.ttft_sla_ms, itl_sla_ms=cli.itl_sla_ms,
+        adjustment_interval_s=cli.adjustment_interval,
+        predictor=cli.predictor,
+        min_prefill_replicas=cli.min_prefill,
+        max_prefill_replicas=cli.max_prefill,
+        min_decode_replicas=cli.min_decode,
+        max_decode_replicas=cli.max_decode,
+        profiled_isl=profiled_isl,
+        scale_down_patience=cli.scale_down_patience,
+    )
+    planner = Planner(cfg, prefill_perf, decode_perf)
+
+    runtime = None
+    if cli.connector == "virtual":
+        from dynamo_tpu.planner.virtual_connector import VirtualConnector
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        runtime = await DistributedRuntime.create()
+        connector = VirtualConnector(runtime.plane, cli.namespace)
+    elif cli.connector == "kubernetes":
+        from dynamo_tpu.deploy.kubernetes_connector import KubernetesConnector
+
+        if not cli.k8s_deployment:
+            ap.error("--k8s-deployment is required with connector=kubernetes")
+        connector = KubernetesConnector(cli.k8s_deployment,
+                                        k8s_namespace=cli.k8s_namespace)
+    else:
+        connector = LogConnector()
+
+    runner = await PlannerRunner(
+        planner, PrometheusMetricsSource(cli.frontend), connector).start()
+    print("PLANNER_READY", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await runner.stop()
+    if runtime is not None:
+        await runtime.shutdown()
+
+
+def main():
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

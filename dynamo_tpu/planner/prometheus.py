@@ -1,0 +1,102 @@
+"""Prometheus metrics source for the planner.
+
+Rebuild of the reference's frontend-scraping source (ref: components/
+planner/src/dynamo/planner/utils/prometheus.py): each planner tick pulls
+the frontend's ``/metrics`` text exposition and turns counter DELTAS over
+the interval into an Observation — request rate, mean ISL/OSL (from the
+llm_*_tokens_total counters), and mean TTFT/ITL-ish latency (from the
+histogram sums/counts). No client library: the exposition format is three
+trivial line shapes.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import time
+from typing import Optional
+
+from dynamo_tpu.planner.planner_core import Observation
+
+logger = logging.getLogger("dynamo.planner.prom")
+
+_LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([^\s]+)$")
+
+
+def parse_prometheus_text(text: str) -> dict[str, float]:
+    """name{labels} → value, summing across label sets per metric name."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line.strip())
+        if not m:
+            continue
+        name, _labels, value = m.groups()
+        try:
+            out[name] = out.get(name, 0.0) + float(value)
+        except ValueError:
+            continue
+    return out
+
+
+class PrometheusMetricsSource:
+    """async () -> Observation|None over a frontend /metrics URL."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        if not self.url.endswith("/metrics"):
+            self.url += "/metrics"
+        self._prev: Optional[dict[str, float]] = None
+        self._prev_t: float = 0.0
+
+    async def _fetch(self) -> Optional[dict[str, float]]:
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(self.url,
+                                 timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    if r.status != 200:
+                        return None
+                    return parse_prometheus_text(await r.text())
+        except Exception:
+            logger.warning("metrics scrape failed: %s", self.url)
+            return None
+
+    async def __call__(self) -> Optional[Observation]:
+        cur = await self._fetch()
+        now = time.monotonic()
+        if cur is None:
+            return None
+        prev, prev_t = self._prev, self._prev_t
+        self._prev, self._prev_t = cur, now
+        if prev is None:
+            return None  # first sample: no deltas yet
+
+        def delta(name: str) -> float:
+            return max(0.0, cur.get(name, 0.0) - prev.get(name, 0.0))
+
+        dt = max(1e-9, now - prev_t)
+        finished = delta("dynamo_llm_requests_finished_total")
+        if finished <= 0:
+            return None  # idle interval: nothing to learn from
+        prompt = delta("dynamo_llm_prompt_tokens_total")
+        completion = delta("dynamo_llm_completion_tokens_total")
+        d_lat_sum = delta("dynamo_http_request_duration_seconds_sum")
+        d_lat_cnt = delta("dynamo_http_request_duration_seconds_count")
+        d_ttft_sum = delta("dynamo_http_time_to_first_token_seconds_sum")
+        d_ttft_cnt = delta("dynamo_http_time_to_first_token_seconds_count")
+        ttft_ms = (1000.0 * d_ttft_sum / d_ttft_cnt) if d_ttft_cnt else None
+        osl = completion / finished
+        itl_ms = None
+        if d_lat_cnt and ttft_ms is not None and osl > 1:
+            mean_lat_ms = 1000.0 * d_lat_sum / d_lat_cnt
+            itl_ms = max(0.0, (mean_lat_ms - ttft_ms) / (osl - 1))
+        return Observation(
+            request_rate=finished / dt,
+            isl=prompt / finished,
+            osl=osl,
+            ttft_ms=ttft_ms,
+            itl_ms=itl_ms,
+        )

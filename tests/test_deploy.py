@@ -1,0 +1,192 @@
+"""Deploy layer: process operator reconciliation, Kubernetes connector,
+Prometheus metrics source (ref: deploy/cloud/operator reconcilers,
+planner kubernetes_connector.py, planner/utils/prometheus.py)."""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from dynamo_tpu.deploy.kubernetes_connector import KubernetesConnector
+from dynamo_tpu.deploy.operator import ProcessOperator, parse_spec
+from dynamo_tpu.planner.planner_core import Decision, Observation
+from dynamo_tpu.planner.prometheus import (
+    PrometheusMetricsSource, parse_prometheus_text,
+)
+
+pytestmark = pytest.mark.anyio
+
+SLEEPER = [sys.executable, "-c",
+           "import time\nwhile True: time.sleep(0.2)"]
+
+
+def write_spec(path, services: dict) -> None:
+    import yaml
+
+    doc = {"apiVersion": "dynamo.tpu/v1alpha1",
+           "kind": "DynamoGraphDeployment",
+           "metadata": {"name": "t"},
+           "spec": {"services": services}}
+    with open(path, "w") as f:
+        yaml.safe_dump(doc, f)
+
+
+def alive(op: ProcessOperator, svc: str) -> int:
+    return sum(1 for r in op.replicas[svc] if r.proc.poll() is None)
+
+
+async def test_operator_scale_and_crash_restart(tmp_path):
+    spec = str(tmp_path / "graph.yaml")
+    write_spec(spec, {"work": {"replicas": 2, "command": SLEEPER,
+                               "env": {"X_TEST": "1"}}})
+    op = ProcessOperator(spec, tick_s=0.1)
+    try:
+        op.reconcile_once()
+        assert alive(op, "work") == 2
+        status = json.load(open(spec + ".status.json"))
+        assert status["services"]["work"]["ready"] == 2
+
+        # crash one replica → reaped, restart counted, respawned (after
+        # backoff; force the clock past it)
+        op.replicas["work"][0].proc.kill()
+        op.replicas["work"][0].proc.wait()
+        op.reconcile_once()
+        assert op.restarts["work"] == 1
+        op._next_start["work"] = 0.0
+        op.reconcile_once()
+        assert alive(op, "work") == 2
+
+        # spec edit → scale down to 1 (newest killed first)
+        write_spec(spec, {"work": {"replicas": 1, "command": SLEEPER}})
+        os.utime(spec, (time.time() + 2, time.time() + 2))
+        op.reconcile_once()
+        assert alive(op, "work") == 1
+    finally:
+        await op.stop()
+    assert alive(op, "work") == 0  # drained
+
+
+async def test_operator_follows_planner_target(tmp_path):
+    from dynamo_tpu.planner.virtual_connector import VirtualConnector
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    spec = str(tmp_path / "graph.yaml")
+    write_spec(spec, {
+        "decode": {"replicas": 1, "command": SLEEPER, "plannerRole": "decode"},
+        "aux": {"replicas": 1, "command": SLEEPER},
+    })
+    rt = await DistributedRuntime.create()
+    op = await ProcessOperator(spec, plane=rt.plane, tick_s=0.05).start()
+    try:
+        for _ in range(40):
+            if alive(op, "decode") == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert alive(op, "decode") == 1
+
+        # the planner writes a target; the operator must realize it
+        await VirtualConnector(rt.plane).apply(
+            Decision(prefill_replicas=0, decode_replicas=3))
+        for _ in range(100):
+            if alive(op, "decode") == 3:
+                break
+            await asyncio.sleep(0.05)
+        assert alive(op, "decode") == 3
+        assert alive(op, "aux") == 1  # non-planner service untouched
+    finally:
+        await op.stop()
+        await rt.shutdown()
+
+
+def test_spec_validation(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("kind: Nope\n")
+    with pytest.raises(ValueError):
+        parse_spec(str(bad))
+    bad.write_text(
+        "kind: DynamoGraphDeployment\nspec:\n  services:\n    a: {replicas: 1}\n")
+    with pytest.raises(ValueError):  # no command
+        parse_spec(str(bad))
+
+
+async def test_kubernetes_connector_patches():
+    calls = []
+    state = {"prefill": 1, "decode": 1}
+
+    async def fake_kubectl(argv):
+        calls.append(argv)
+        if argv[2] == "patch":
+            patch = json.loads(argv[-1])
+            for name, svc in patch["spec"]["services"].items():
+                state[name] = svc["replicas"]
+            return 0, "patched"
+        if argv[2] == "get":
+            return 0, json.dumps({"spec": {"services": {
+                n: {"replicas": r} for n, r in state.items()}}})
+        return 1, "unknown"
+
+    c = KubernetesConnector("graph", k8s_namespace="serving",
+                            runner=fake_kubectl)
+    await c.apply(Decision(prefill_replicas=2, decode_replicas=5))
+    assert state == {"prefill": 2, "decode": 5}
+    assert calls[0][:2] == ["-n", "serving"]
+
+    # unchanged decision → no second patch
+    await c.apply(Decision(prefill_replicas=2, decode_replicas=5))
+    assert len(calls) == 1
+    assert await c.read_replicas() == {"prefill": 2, "decode": 5}
+
+    # failed patch keeps .applied unset so the next tick retries
+    async def failing(argv):
+        return 1, "rbac denied"
+
+    c2 = KubernetesConnector("graph", runner=failing)
+    await c2.apply(Decision(prefill_replicas=3, decode_replicas=3))
+    assert c2.applied is None
+
+
+async def test_prometheus_source_deltas():
+    samples = []
+
+    def text(finished, prompt, completion, lat_sum, lat_cnt, ttft_sum, ttft_cnt):
+        return "\n".join([
+            f'dynamo_llm_requests_finished_total{{model="m"}} {finished}',
+            f'dynamo_llm_prompt_tokens_total{{model="m"}} {prompt}',
+            f'dynamo_llm_completion_tokens_total{{model="m"}} {completion}',
+            f"dynamo_http_request_duration_seconds_sum {lat_sum}",
+            f"dynamo_http_request_duration_seconds_count {lat_cnt}",
+            f"dynamo_http_time_to_first_token_seconds_sum {ttft_sum}",
+            f"dynamo_http_time_to_first_token_seconds_count {ttft_cnt}",
+        ])
+
+    src = PrometheusMetricsSource("http://unused:0")
+
+    async def fake_fetch():
+        return parse_prometheus_text(samples.pop(0))
+
+    src._fetch = fake_fetch
+    samples.append(text(10, 5000, 1000, 10.0, 10, 1.0, 10))
+    assert await src() is None  # first sample: no deltas
+    # +20 requests, +16000 prompt tokens, +4000 completion tokens
+    samples.append(text(30, 21000, 5000, 110.0, 30, 3.0, 30))
+    src._prev_t -= 10.0  # pretend 10s elapsed
+    obs = await src()
+    assert obs is not None
+    assert abs(obs.request_rate - 2.0) < 0.2
+    assert abs(obs.isl - 800.0) < 1e-6
+    assert abs(obs.osl - 200.0) < 1e-6
+    assert abs(obs.ttft_ms - 100.0) < 1e-6  # 2s Δsum / 20 Δcount
+    # mean latency 5000ms; (5000-100)/(200-1) ≈ 24.6ms ITL
+    assert 20.0 < obs.itl_ms < 30.0
+
+
+def test_recipes_parse():
+    for name in ("mocker-demo", "llama3-70b-v5e64-disagg",
+                 "deepseek-r1-wideep"):
+        svcs = parse_spec(f"deploy/recipes/{name}.yaml")
+        assert svcs and all(s.command for s in svcs.values())
+    assert parse_spec(
+        "deploy/recipes/llama3-70b-v5e64-disagg.yaml")["decode"].planner_role == "decode"
