@@ -16,6 +16,7 @@ import aiohttp
 @dataclass
 class RequestResult:
     ok: bool
+    prompt_tokens: int = 0
     ttft_s: Optional[float] = None
     latency_s: Optional[float] = None
     itl_s: list = field(default_factory=list)
@@ -37,6 +38,7 @@ async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
             f"{url}/v1/chat/completions",
             json={"model": model, "stream": True, "ignore_eos": True,
                   "max_tokens": max_tokens,
+                  "stream_options": {"include_usage": True},
                   "messages": [{"role": "user", "content": prompt}]},
         ) as resp:
             if resp.status != 200:
@@ -54,6 +56,13 @@ async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
                     res.itl_s.append(now - last)
                 last = now
                 res.tokens += 1
+                if '"usage"' in line:
+                    import json as _json
+                    try:  # final chunk: record the true token ISL
+                        u = _json.loads(line[6:]).get("usage") or {}
+                        res.prompt_tokens = u.get("prompt_tokens", 0)
+                    except ValueError:
+                        pass
             res.latency_s = time.perf_counter() - t0
             res.ok = res.ttft_s is not None
             return res

@@ -23,6 +23,7 @@ from benchmarks.client import run_closed_loop, summarize
 async def sweep(url: str, model: str, isl_words: int, osl: int,
                 concurrencies: list[int], requests_per_level: int):
     prefill_pts, decode_pts = [], []
+    results = []
     for c in concurrencies:
         results = await run_closed_loop(
             url, model, concurrency=c, num_requests=requests_per_level,
@@ -37,7 +38,12 @@ async def sweep(url: str, model: str, isl_words: int, osl: int,
         prefill_pts.append([round(req_rate, 3), s["ttft_p50_ms"]])
         decode_pts.append([round(tok_rate, 1), s["itl_p50_ms"]])
         print(f"concurrency={c}: {s}", flush=True)
-    return prefill_pts, decode_pts
+    # measured TOKEN ISL (from response usage) — the planner's Prometheus
+    # observations are in tokens, so curves must be keyed the same way
+    with_tok = [r for r in results if r.ok and r.prompt_tokens] if results else []
+    isl_tokens = (sum(r.prompt_tokens for r in with_tok) / len(with_tok)
+                  if with_tok else None)
+    return prefill_pts, decode_pts, isl_tokens
 
 
 async def amain():
@@ -60,18 +66,23 @@ async def amain():
             else [cli.isl_words])
     prefill_by_isl = {}
     decode = []
+    tok_isl_by_words = {}
     for isl in isls:
         print(f"--- ISL sweep @ {isl} words ---", flush=True)
-        prefill, dec = await sweep(cli.url, cli.model, isl, cli.osl,
-                                   cs, cli.requests_per_level)
-        prefill_by_isl[isl] = prefill
+        prefill, dec, isl_tok = await sweep(cli.url, cli.model, isl, cli.osl,
+                                            cs, cli.requests_per_level)
+        # key curves by the MEASURED token ISL (falls back to words) so the
+        # planner's token-denominated observations query the right curve
+        tok_isl_by_words[isl] = round(isl_tok) if isl_tok else isl
+        prefill_by_isl[tok_isl_by_words[isl]] = prefill
         if isl == isls[len(isls) // 2] or len(isls) == 1:
             decode = dec  # ITL barely depends on ISL; keep the middle sweep
-    base_isl = cli.isl_words if cli.isl_words in prefill_by_isl else isls[0]
+    base_words = cli.isl_words if cli.isl_words in isls else isls[0]
+    base_isl = tok_isl_by_words[base_words]
     out = {"prefill": prefill_by_isl[base_isl],
            "prefill_by_isl": prefill_by_isl,
            "decode": decode,
-           "isl_words": base_isl, "osl": cli.osl}
+           "isl_words": base_words, "isl_tokens": base_isl, "osl": cli.osl}
     with open(cli.out, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {cli.out}")

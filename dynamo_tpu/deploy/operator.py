@@ -74,6 +74,9 @@ class Replica:
     proc: subprocess.Popen
     index: int
     started: float
+    #: (command, env) the process was started with — a spec edit that
+    #: changes either makes the replica stale and it is restarted
+    config: tuple = ()
 
 
 def parse_spec(path: str) -> dict[str, ServiceSpec]:
@@ -164,16 +167,34 @@ class ProcessOperator:
 
     # -- reconcile ---------------------------------------------------------
 
+    @staticmethod
+    def _svc_config(svc: ServiceSpec) -> tuple:
+        return (tuple(svc.command), tuple(sorted(svc.env.items())))
+
     def _spawn(self, svc: ServiceSpec, index: int) -> Replica:
         env = dict(os.environ)
         env.update(svc.env)
         env["DYN_REPLICA_INDEX"] = str(index)
         proc = subprocess.Popen(svc.command, env=env)
         logger.info("started %s[%d] pid=%d", svc.name, index, proc.pid)
-        return Replica(proc=proc, index=index, started=time.monotonic())
+        return Replica(proc=proc, index=index, started=time.monotonic(),
+                       config=self._svc_config(svc))
 
     def _scale_to(self, svc: ServiceSpec, want: int) -> None:
         reps = self.replicas[svc.name]
+        # replicas running an outdated command/env are stale: stop them
+        # (the scale-up below respawns with the current spec) — a spec
+        # edit must converge, not just adjust counts
+        cur = self._svc_config(svc)
+        for r in [r for r in reps if r.config != cur and r.proc.poll() is None]:
+            logger.info("restarting %s[%d]: spec changed", svc.name, r.index)
+            r.proc.terminate()
+            try:
+                r.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                r.proc.kill()
+                r.proc.wait()
+            reps.remove(r)
         # reap exited replicas (crash → restart with backoff)
         alive = []
         for r in reps:

@@ -30,6 +30,9 @@ class ModelConfig:
     num_kv_heads: int = 32
     head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
     rope_theta: float = 10000.0
+    #: HF rope_scaling dict (yarn / llama3 supported — model.rope_params);
+    #: unsupported types fail loudly at trace time
+    rope_scaling: Optional[dict] = None
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 8192
     tie_word_embeddings: bool = False
@@ -61,7 +64,22 @@ class ModelConfig:
     topk_group: int = 1
     # attention extras
     qkv_bias: bool = False  # Qwen2-style
+    o_bias: bool = False  # gpt-oss: o_proj carries a bias too
     sliding_window: Optional[int] = None
+    #: per-layer sliding windows (gpt-oss alternates sliding/full layers);
+    #: entries are window sizes with 0 = full attention. Overrides
+    #: ``sliding_window`` when set; length must equal num_layers.
+    layer_windows: Optional[tuple] = None
+    #: learned per-head attention-sink logits (gpt-oss): an extra softmax
+    #: slot that absorbs probability mass without contributing output
+    attention_sinks: bool = False
+    #: expert MLP activation: "swiglu" (llama/mixtral/deepseek) or
+    #: "swiglu_oss" (gpt-oss clamped variant with biases and (up+1) gating)
+    moe_activation: str = "swiglu"
+    #: add the router bias to the logits BEFORE top-k in softmax scoring
+    #: (gpt-oss's router has a true bias; DeepSeek's e_score_correction_bias
+    #: only steers expert CHOICE and is handled in the sigmoid branch)
+    router_logit_bias: bool = False
     # --- MLA (multi-head latent attention, DeepSeek V2/V3) ---------------
     #: latent rank of the compressed KV; >0 switches attention to MLA and
     #: the paged cache to the latent layout (see kv_cache_spec)
@@ -74,6 +92,12 @@ class ModelConfig:
     def __post_init__(self):
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_heads
+        if self.layer_windows is not None:
+            self.layer_windows = tuple(int(w or 0) for w in self.layer_windows)
+            if len(self.layer_windows) != self.num_layers:
+                raise ValueError(
+                    f"layer_windows has {len(self.layer_windows)} entries "
+                    f"for {self.num_layers} layers")
 
     @property
     def is_moe(self) -> bool:
@@ -122,7 +146,17 @@ class ModelConfig:
         """
         arch = (d.get("architectures") or [""])[0].lower()
         is_deepseek = "deepseek" in arch
+        is_gpt_oss = "gptoss" in arch
         mla = is_deepseek and d.get("kv_lora_rank") is not None
+        layer_windows = None
+        if is_gpt_oss:
+            L = d.get("num_hidden_layers", 36)
+            types = d.get("layer_types") or [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(L)]
+            layer_windows = tuple(
+                d.get("sliding_window", 128) if t == "sliding_attention" else 0
+                for t in types)
         return ModelConfig(
             vocab_size=d.get("vocab_size", 32000),
             hidden_size=d.get("hidden_size", 4096),
@@ -132,6 +166,7 @@ class ModelConfig:
             num_kv_heads=d.get("num_key_value_heads", d.get("num_attention_heads", 32)),
             head_dim=d.get("head_dim") if not is_deepseek else None,
             rope_theta=d.get("rope_theta", 10000.0),
+            rope_scaling=d.get("rope_scaling"),
             rms_norm_eps=d.get("rms_norm_eps", 1e-5),
             max_position_embeddings=d.get("max_position_embeddings", 8192),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
@@ -142,9 +177,10 @@ class ModelConfig:
             first_k_dense_replace=d.get("first_k_dense_replace", 0) or 0,
             scoring_func=d.get("scoring_func",
                                "sigmoid" if "deepseekv3" in arch else "softmax"),
-            # Mixtral renormalizes its top-k gates (its HF config has no
-            # such key); DeepSeek configs carry the flag explicitly
-            norm_topk_prob=d.get("norm_topk_prob", "mixtral" in arch),
+            # Mixtral and gpt-oss renormalize their top-k gates (their HF
+            # configs have no such key); DeepSeek carries the flag explicitly
+            norm_topk_prob=d.get("norm_topk_prob",
+                                 "mixtral" in arch or is_gpt_oss),
             routed_scaling_factor=d.get("routed_scaling_factor", 1.0),
             n_group=d.get("n_group", 1) or 1,
             topk_group=d.get("topk_group", 1) or 1,
@@ -153,13 +189,21 @@ class ModelConfig:
             qk_nope_head_dim=d.get("qk_nope_head_dim", 128),
             qk_rope_head_dim=d.get("qk_rope_head_dim", 64),
             v_head_dim=d.get("v_head_dim", 128),
-            qkv_bias="qwen2" in arch,
+            qkv_bias=("qwen2" in arch
+                      or (is_gpt_oss and d.get("attention_bias", True))),
+            o_bias=is_gpt_oss and d.get("attention_bias", True),
+            layer_windows=layer_windows,
+            attention_sinks=is_gpt_oss,
+            moe_activation="swiglu_oss" if is_gpt_oss else "swiglu",
+            router_logit_bias=is_gpt_oss,
             # qwen2 writes sliding_window but gates it behind
             # use_sliding_window, whose HF default is False; mistral-style
-            # configs apply the window unconditionally
+            # configs apply the window unconditionally; gpt-oss windows are
+            # per-layer (layer_windows above)
             sliding_window=(d.get("sliding_window")
-                            if d.get("use_sliding_window",
-                                     "qwen2" not in arch) else None),
+                            if not is_gpt_oss
+                            and d.get("use_sliding_window",
+                                      "qwen2" not in arch) else None),
         )
 
     @staticmethod

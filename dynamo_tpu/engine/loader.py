@@ -107,6 +107,10 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
                 out["bq"] = get(f"{pre}.q_proj.bias")
                 out["bk"] = get(f"{pre}.k_proj.bias")
                 out["bv"] = get(f"{pre}.v_proj.bias")
+            if cfg.o_bias:
+                out["bo"] = get(f"{pre}.o_proj.bias")
+            if cfg.attention_sinks:
+                out["sink"] = get(f"{pre}.sinks")
             return out
         # --- MLA (DeepSeek) ---
         r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
@@ -166,6 +170,30 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
                 "w_up": jnp.stack([expert(e, "w3") for e in range(E)]),
             }
             return out
+        if f"model.layers.{i}.mlp.experts.gate_up_proj_blocks" in t:
+            raise NotImplementedError(
+                "this gpt-oss checkpoint stores MXFP4-quantized experts "
+                "(gate_up_proj_blocks/scales); dequantize to bf16 first "
+                "(e.g. save_pretrained from transformers with "
+                "dequantized weights) — loading quantized blocks silently "
+                "wrong is refused")
+        if f"model.layers.{i}.mlp.experts.gate_up_proj" in t:  # gpt-oss
+            pre = f"model.layers.{i}.mlp"
+            # fused [E, D, 2F] with gate/up interleaved on the last dim;
+            # stored [in, out] already (nn.Parameter, not a Linear)
+            gu = np.asarray(t[f"{pre}.experts.gate_up_proj"])
+            gub = np.asarray(t[f"{pre}.experts.gate_up_proj_bias"])  # [E, 2F]
+            return {
+                "router": proj(f"{pre}.router.weight"),
+                "router_bias": jnp.asarray(
+                    np.asarray(t[f"{pre}.router.bias"]), jnp.float32),
+                "w_gate": jnp.asarray(gu[..., ::2], dtype=dtype),
+                "w_up": jnp.asarray(gu[..., 1::2], dtype=dtype),
+                "b_gate": jnp.asarray(gub[..., ::2], dtype=dtype),
+                "b_up": jnp.asarray(gub[..., 1::2], dtype=dtype),
+                "w_down": get(f"{pre}.experts.down_proj"),  # [E, F, D]
+                "b_down": get(f"{pre}.experts.down_proj_bias"),  # [E, D]
+            }
         pre = f"model.layers.{i}.mlp"  # deepseek/qwen-moe style
         bias_name = f"{pre}.gate.e_score_correction_bias"
         expert = lambda e, n: proj(f"{pre}.experts.{e}.{n}.weight")  # noqa: E731

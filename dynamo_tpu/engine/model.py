@@ -92,6 +92,11 @@ def _init_layer_stack(cfg: ModelConfig, key: jax.Array, n: int, moe: bool,
             layers["bq"] = jnp.zeros((n, H * hd), dtype)
             layers["bk"] = jnp.zeros((n, KV * hd), dtype)
             layers["bv"] = jnp.zeros((n, KV * hd), dtype)
+        if cfg.o_bias:
+            layers["bo"] = jnp.zeros((n, D), dtype)
+        if cfg.attention_sinks:
+            layers["sink"] = (jax.random.normal(ks[15], (n, H), jnp.float32)
+                              * 0.5).astype(dtype)
     if moe:
         Fm = cfg.moe_ffn_size
         layers["router"] = w(ks[4], (n, D, E), D)
@@ -99,6 +104,10 @@ def _init_layer_stack(cfg: ModelConfig, key: jax.Array, n: int, moe: bool,
         layers["w_gate"] = w(ks[5], (n, E, D, Fm), D)
         layers["w_up"] = w(ks[6], (n, E, D, Fm), D)
         layers["w_down"] = w(ks[7], (n, E, Fm, D), Fm)
+        if cfg.moe_activation == "swiglu_oss":
+            layers["b_gate"] = jnp.zeros((n, E, Fm), dtype)
+            layers["b_up"] = jnp.zeros((n, E, Fm), dtype)
+            layers["b_down"] = jnp.zeros((n, E, D), dtype)
         if cfg.n_shared_experts:
             Fs = cfg.n_shared_experts * Fm
             layers["ws_gate"] = w(ks[12], (n, D, Fs), D)
@@ -169,12 +178,20 @@ def _layer_stack_shardings(cfg: ModelConfig, mesh: Mesh, moe: bool) -> dict:
             layers["bq"] = ns(None, "tp")
             layers["bk"] = ns(None, "tp")
             layers["bv"] = ns(None, "tp")
+        if cfg.o_bias:
+            layers["bo"] = ns(None, None)
+        if cfg.attention_sinks:
+            layers["sink"] = ns(None, "tp")
     if moe:
         layers["router"] = ns(None, None, None)
         layers["router_bias"] = ns(None, None)
         layers["w_gate"] = ns(None, "tp", None, None)  # experts over tp (EP)
         layers["w_up"] = ns(None, "tp", None, None)
         layers["w_down"] = ns(None, "tp", None, None)
+        if cfg.moe_activation == "swiglu_oss":
+            layers["b_gate"] = ns(None, "tp", None)
+            layers["b_up"] = ns(None, "tp", None)
+            layers["b_down"] = ns(None, "tp", None)
         if cfg.n_shared_experts:
             layers["ws_gate"] = ns(None, None, "tp")
             layers["ws_up"] = ns(None, None, "tp")
@@ -240,21 +257,78 @@ def _rms_norm(x, w, eps):
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
-def _rope(x, positions, theta):
+def rope_params(theta: float, hd: int, scaling: Optional[dict]):
+    """(inv_freq [hd/2] numpy, attention_scaling) honoring HF rope_scaling.
+
+    Supported rope_type values (HF ROPE_INIT_FUNCTIONS semantics):
+    - default/None — plain RoPE;
+    - "yarn" — NTK-by-parts frequency blend + 0.1·ln(factor)+1 attention
+      scaling (gpt-oss ships factor=32 over 4096 original positions);
+    - "llama3" — Llama-3.1's per-band wavelength rescale (no attn scaling).
+    Anything else fails loudly — silently extrapolating untrained
+    frequencies produces degenerate long-context output.
+    """
+    half = hd // 2
+    inv = 1.0 / (theta ** (np.arange(0, half, dtype=np.float64) / half))
+    if not scaling or scaling.get("rope_type", scaling.get("type")) in (
+            None, "default"):
+        return inv.astype(np.float32), 1.0
+    kind = scaling.get("rope_type", scaling.get("type"))
+    factor = float(scaling.get("factor", 1.0))
+    if kind == "yarn":
+        orig = float(scaling.get("original_max_position_embeddings", 4096))
+        beta_fast = float(scaling.get("beta_fast", 32.0))
+        beta_slow = float(scaling.get("beta_slow", 1.0))
+
+        def correction_dim(rot):
+            # HF _compute_yarn_parameters: dim·ln(orig/(2π·rot))/(2·ln θ)
+            return half * np.log(orig / (rot * 2 * np.pi)) / np.log(theta)
+
+        low = np.floor(correction_dim(beta_fast))
+        high = np.ceil(correction_dim(beta_slow))
+        low, high = max(low, 0), min(high, half - 1)
+        ramp = np.clip((np.arange(half) - low) / max(1e-3, high - low), 0, 1)
+        mask = 1.0 - ramp  # 1 = extrapolate (high freq), 0 = interpolate
+        inv = inv / factor * (1 - mask) + inv * mask
+        attn = float(scaling.get("attention_factor")
+                     or (0.1 * np.log(factor) + 1.0))
+        if scaling.get("mscale") and scaling.get("mscale_all_dim"):
+            def yarn_mscale(s, m):
+                return 0.1 * m * np.log(s) + 1.0 if s > 1 else 1.0
+            attn = (yarn_mscale(factor, float(scaling["mscale"]))
+                    / yarn_mscale(factor, float(scaling["mscale_all_dim"])))
+        return inv.astype(np.float32), attn
+    if kind == "llama3":  # HF _compute_llama3_parameters exactly
+        orig = float(scaling.get("original_max_position_embeddings", 8192))
+        lo_f = float(scaling.get("low_freq_factor", 1.0))
+        hi_f = float(scaling.get("high_freq_factor", 4.0))
+        low_wl, high_wl = orig / lo_f, orig / hi_f
+        wavelen = 2 * np.pi / inv
+        out = np.where(wavelen > low_wl, inv / factor, inv)
+        smooth = (orig / wavelen - lo_f) / (hi_f - lo_f)
+        smoothed = (1 - smooth) * inv / factor + smooth * inv
+        is_mid = (wavelen <= low_wl) & (wavelen >= high_wl)
+        out = np.where(is_mid, smoothed, out)
+        return out.astype(np.float32), 1.0
+    raise NotImplementedError(f"rope_scaling type '{kind}' not supported")
+
+
+def _rope(x, positions, theta, scaling: Optional[dict] = None):
     """Rotary embedding, llama convention (half-split). x: [B,S,N,hd]."""
     hd = x.shape[-1]
-    half = hd // 2
-    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    inv_freq, attn_scale = rope_params(theta, hd, scaling)
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :] * attn_scale
+    sin = jnp.sin(angles)[:, :, None, :] * attn_scale
+    x1 = x[..., : hd // 2].astype(jnp.float32)
+    x2 = x[..., hd // 2:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
 
 
 def _paged_attention(q, k_cache, v_cache, lidx, block_tables, positions,
-                     kv_lens, cfg: ModelConfig, block_size: int):
+                     kv_lens, cfg: ModelConfig, block_size: int,
+                     window=None, sinks=None):
     """Attention of q [B,S,H,hd] over paged KV.
 
     Gathers pages straight from the FULL cache [L,num_slots,KV,hd] at layer
@@ -285,10 +359,24 @@ def _paged_attention(q, k_cache, v_cache, lidx, block_tables, positions,
     mask = (key_pos[None, None, :] <= q_pos[:, :, None]) & (
         key_pos[None, None, :] < kv_lens[:, None, None]
     )  # [B, S, T]
-    if cfg.sliding_window:
-        mask = mask & (key_pos[None, None, :] > q_pos[:, :, None] - cfg.sliding_window)
+    if window is None:
+        window = cfg.sliding_window
+    if window is not None:
+        # window may be a traced per-layer scalar (gpt-oss alternates
+        # sliding/full layers; 0 = full attention)
+        in_window = key_pos[None, None, :] > q_pos[:, :, None] - window
+        mask = mask & (in_window | (jnp.asarray(window) <= 0))
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)  # [B,KV,G,S,T]
-    probs = jax.nn.softmax(scores, axis=-1)
+    if sinks is not None:
+        # attention sink: one extra softmax slot per head that absorbs
+        # probability mass but contributes nothing to the output
+        # (gpt-oss 'sinks' — combined softmax then drop the sink column)
+        s = sinks.astype(jnp.float32).reshape(KV, G)[None, :, :, None]
+        m = jnp.maximum(scores.max(-1), s)  # [B,KV,G,S]
+        e = jnp.exp(scores - m[..., None])
+        probs = e / (e.sum(-1) + jnp.exp(s - m))[..., None]
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v.astype(jnp.float32))
     return out.reshape(B, S, H, hd).astype(q.dtype)
 
@@ -321,11 +409,12 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
         q = h @ lp["wq"]
     q = q.reshape(B, S, H, dn + dr)
     q_nope, q_rot = q[..., :dn], q[..., dn:]
-    q_rot = _rope(q_rot, positions, cfg.rope_theta)
+    q_rot = _rope(q_rot, positions, cfg.rope_theta, cfg.rope_scaling)
 
     ckv = h @ lp["kv_a"]  # [B,S,r+dr]
     c = _rms_norm(ckv[..., :r], lp["kv_a_norm"], cfg.rms_norm_eps)
-    k_rot = _rope(ckv[..., None, r:], positions, cfg.rope_theta)  # [B,S,1,dr]
+    k_rot = _rope(ckv[..., None, r:], positions, cfg.rope_theta,
+                  cfg.rope_scaling)  # [B,S,1,dr]
 
     flat = slot_map.reshape(B * S)
     kc = kc.at[lidx, flat].set(c.reshape(B * S, 1, r), mode="drop")
@@ -396,6 +485,8 @@ def _router_weights(xf, router_w, router_bias, cfg: ModelConfig):
         _, topi = jax.lax.top_k(choice, K)
         gates = jnp.take_along_axis(scores, topi, axis=1)
     else:
+        if cfg.router_logit_bias:  # gpt-oss: a true bias on the logits
+            logits = logits + router_bias[None, :]
         probs = jax.nn.softmax(logits, axis=-1)
         choice = probs
         if cfg.n_group > 1:  # V2 group_limited_greedy: group score = max
@@ -407,6 +498,14 @@ def _router_weights(xf, router_w, router_bias, cfg: ModelConfig):
     gates = gates * cfg.routed_scaling_factor
     return jnp.zeros((N, E), jnp.float32).at[
         jnp.arange(N)[:, None], topi].add(gates)
+
+
+def _oss_glu(gate, up, alpha: float = 1.702, limit: float = 7.0):
+    """gpt-oss clamped GLU: clip both halves, sigmoid-gate with alpha, and
+    shift ``up`` by one (HF GptOssExperts semantics exactly)."""
+    gate = jnp.clip(gate, max=limit)
+    up = jnp.clip(up, -limit, limit)
+    return (up + 1.0) * (gate * jax.nn.sigmoid(alpha * gate))
 
 
 def moe_capacity(n_tokens: int, num_experts: int, top_k: int,
@@ -421,8 +520,8 @@ def moe_capacity(n_tokens: int, num_experts: int, top_k: int,
     return min(n_tokens, max(avg, min(n_tokens, 16), 1))
 
 
-def _mlp_moe_ep(x, router_w, router_bias, wg, wu, wd, *, cfg: ModelConfig,
-                axis_name: str = "tp"):
+def _mlp_moe_ep(x, router_w, router_bias, wg, wu, wd, bg=None, bu=None,
+                bd=None, *, cfg: ModelConfig, axis_name: str = "tp"):
     """Expert-parallel MoE (shard_map body over the expert axis).
 
     Each device holds E/n experts WHOLE (wg/wu/wd are the local slices) and
@@ -457,9 +556,15 @@ def _mlp_moe_ep(x, router_w, router_bias, wg, wu, wd, *, cfg: ModelConfig,
     disp = (keep[..., None] & slot).astype(x.dtype)
 
     xe = jnp.einsum("nec,nd->ecd", disp, xf)  # [E_local, C, D]
-    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, wg))
-    h = h * jnp.einsum("ecd,edf->ecf", xe, wu)
-    y = jnp.einsum("ecf,efd->ecd", h, wd)  # [E_local, C, D]
+    hg = jnp.einsum("ecd,edf->ecf", xe, wg)
+    hu = jnp.einsum("ecd,edf->ecf", xe, wu)
+    if cfg.moe_activation == "swiglu_oss":
+        inter = _oss_glu(hg + bg[:, None, :], hu + bu[:, None, :])
+    else:
+        inter = jax.nn.silu(hg) * hu
+    y = jnp.einsum("ecf,efd->ecd", inter, wd)  # [E_local, C, D]
+    if cfg.moe_activation == "swiglu_oss":
+        y = y + bd[:, None, :]
     comb = disp * local[..., None].astype(x.dtype)  # gate-weighted one-hot
     out = jnp.einsum("nec,ecd->nd", comb, y)
     out = jax.lax.psum(out, axis_name)
@@ -471,11 +576,13 @@ def make_moe_ep_fn(cfg: ModelConfig, mesh: Mesh, axis_name: str = "tp"):
     (x, router_w, router_bias, wg, wu, wd) -> [B,S,D]; used by forward and
     by tests so specs cannot drift between them."""
     fn = functools.partial(_mlp_moe_ep, cfg=cfg, axis_name=axis_name)
+    specs = [P("dp", None, None), P(None, None), P(None),
+             P(axis_name, None, None), P(axis_name, None, None),
+             P(axis_name, None, None)]
+    if cfg.moe_activation == "swiglu_oss":  # expert biases shard with E
+        specs += [P(axis_name, None), P(axis_name, None), P(axis_name, None)]
     return jax.shard_map(
-        fn, mesh=mesh,
-        in_specs=(P("dp", None, None), P(None, None), P(None),
-                  P(axis_name, None, None), P(axis_name, None, None),
-                  P(axis_name, None, None)),
+        fn, mesh=mesh, in_specs=tuple(specs),
         out_specs=P("dp", None, None), check_vma=False)
 
 
@@ -494,8 +601,15 @@ def _mlp_moe(x, lp, cfg: ModelConfig):
     # all-experts compute: [E,B,S,F] — fine for modest E; EP shards E over tp
     h = jnp.einsum("bsd,edf->ebsf", x, lp["w_gate"])
     u = jnp.einsum("bsd,edf->ebsf", x, lp["w_up"])
-    h = jax.nn.silu(h) * u
-    y = jnp.einsum("ebsf,efd->ebsd", h, lp["w_down"])
+    if cfg.moe_activation == "swiglu_oss":
+        h = h + lp["b_gate"][:, None, None, :]
+        u = u + lp["b_up"][:, None, None, :]
+        inter = _oss_glu(h, u)
+    else:
+        inter = jax.nn.silu(h) * u
+    y = jnp.einsum("ebsf,efd->ebsd", inter, lp["w_down"])
+    if cfg.moe_activation == "swiglu_oss":
+        y = y + lp["b_down"][:, None, None, :]
     return jnp.einsum("ebsd,bse->bsd", y, cw.astype(y.dtype))
 
 
@@ -590,8 +704,8 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         q = q.reshape(B, S, H, hd)
         k = k.reshape(B, S, KV, hd)
         v = v.reshape(B, S, KV, hd)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
         flat_slots = slot_map.reshape(B * S)
         kc = kc.at[lidx, flat_slots].set(k.reshape(B * S, KV, hd), mode="drop")
@@ -616,7 +730,9 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         ring_want = sp_n > 1 and S > 1
         ring_ok = (ring_want and dp_ok and S % sp_n == 0
                    and H % tp_n == 0 and KV % tp_n == 0
-                   and (H // tp_n) % max(1, KV // tp_n) == 0)
+                   and (H // tp_n) % max(1, KV // tp_n) == 0
+                   # per-layer windows / sink logits: XLA path only
+                   and cfg.layer_windows is None and not cfg.attention_sinks)
         if ring_want and not ring_ok:
             _logger.warning(
                 "ring prefill bypassed: S=%d B=%d not divisible by "
@@ -667,9 +783,14 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                     out_specs=sp["q"], check_vma=False)
             attn = fn(q, kc, vc, lidx, block_tables, positions, kv_lens)
         else:
+            window = (jnp.asarray(cfg.layer_windows, jnp.int32)[lidx]
+                      if cfg.layer_windows is not None else None)
             attn = _paged_attention(q, kc, vc, lidx, block_tables, positions,
-                                    kv_lens, cfg, block_size)
+                                    kv_lens, cfg, block_size, window=window,
+                                    sinks=lp.get("sink"))
         x = x + attn.reshape(B, S, H * hd) @ lp["wo"]
+        if "bo" in lp:
+            x = x + lp["bo"]
         return _mlp_epilogue(x, kc, vc, lp, moe)
 
     def _mlp_epilogue(x, kc, vc, lp, moe):
@@ -686,8 +807,11 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                     B, cfg.num_experts, tp_n)
             if ep_ok:
                 fn = make_moe_ep_fn(cfg, mesh)
-                x = x + fn(h, lp["router"], lp["router_bias"], lp["w_gate"],
-                           lp["w_up"], lp["w_down"])
+                ep_args = [h, lp["router"], lp["router_bias"], lp["w_gate"],
+                           lp["w_up"], lp["w_down"]]
+                if cfg.moe_activation == "swiglu_oss":
+                    ep_args += [lp["b_gate"], lp["b_up"], lp["b_down"]]
+                x = x + fn(*ep_args)
             else:
                 x = x + _mlp_moe(h, lp, cfg)
             if cfg.n_shared_experts:  # DeepSeek: dense shared experts on top
@@ -727,10 +851,11 @@ def embedding_forward(params, tokens, lengths, *, cfg: ModelConfig):
     zero interaction with the serving cache/pool. Returns [B, D] f32,
     L2-normalized mean over each row's valid positions.
     """
-    if cfg.is_mla or cfg.num_dense_prefix_layers:
+    if (cfg.is_mla or cfg.num_dense_prefix_layers
+            or cfg.layer_windows is not None or cfg.attention_sinks):
         raise NotImplementedError(
             "embedding_forward covers the MHA/GQA families; serve embeddings "
-            "from a dense model (MLA/dense-prefix MoE are generation-only)")
+            "from a dense model (MLA/gpt-oss variants are generation-only)")
     B, S = tokens.shape
     D, hd = cfg.hidden_size, cfg.head_dim
     H, KV = cfg.num_heads, cfg.num_kv_heads
@@ -752,8 +877,10 @@ def embedding_forward(params, tokens, lengths, *, cfg: ModelConfig):
         v = h @ lp["wv"]
         if "bq" in lp:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-        q = _rope(q.reshape(B, S, H, hd), positions, cfg.rope_theta)
-        k = _rope(k.reshape(B, S, KV, hd), positions, cfg.rope_theta)
+        q = _rope(q.reshape(B, S, H, hd), positions, cfg.rope_theta,
+                  cfg.rope_scaling)
+        k = _rope(k.reshape(B, S, KV, hd), positions, cfg.rope_theta,
+                  cfg.rope_scaling)
         v = v.reshape(B, S, KV, hd)
         qg = q.reshape(B, S, KV, G, hd).astype(jnp.float32)
         s = jnp.einsum("bskgd,btkd->bkgst", qg, k.astype(jnp.float32))
@@ -833,6 +960,8 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
 
     if cfg.is_mla:  # MLA attends in latent space — its own XLA path for now
         return False, False
+    if cfg.layer_windows is not None or cfg.attention_sinks:
+        return False, False  # gpt-oss attention variants: XLA path for now
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     heads_ok = (cfg.num_kv_heads % tp == 0 and cfg.num_heads % tp == 0
                 and cfg.num_heads % cfg.num_kv_heads == 0)

@@ -61,6 +61,41 @@ def mla_tiny() -> ModelConfig:
         topk_group=1, moe_capacity_factor=4.0)
 
 
+def _gpt_oss(num_layers: int, num_experts: int) -> ModelConfig:
+    return ModelConfig(
+        vocab_size=201088, hidden_size=2880, intermediate_size=2880,
+        num_layers=num_layers, num_heads=64, num_kv_heads=8, head_dim=64,
+        rope_theta=150000.0, max_position_embeddings=131072,
+        num_experts=num_experts, num_experts_per_tok=4, norm_topk_prob=True,
+        qkv_bias=True, o_bias=True, attention_sinks=True,
+        moe_activation="swiglu_oss", router_logit_bias=True,
+        layer_windows=tuple(128 if i % 2 == 0 else 0
+                            for i in range(num_layers)))
+
+
+def gpt_oss_20b() -> ModelConfig:
+    """gpt-oss-20b: alternating sliding/full attention with sink logits,
+    32-expert clamped-GLU MoE (ref workload: recipes/gpt-oss-120b)."""
+    return _gpt_oss(24, 32)
+
+
+def gpt_oss_120b() -> ModelConfig:
+    return _gpt_oss(36, 128)
+
+
+def gptoss_tiny() -> ModelConfig:
+    """Small gpt-oss-shaped config for tests of sinks/windows/oss-MoE."""
+    return ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=32, num_layers=4,
+        num_heads=4, num_kv_heads=2, head_dim=16, dtype="float32",
+        max_position_embeddings=512,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        qkv_bias=True, o_bias=True, attention_sinks=True,
+        moe_activation="swiglu_oss", router_logit_bias=True,
+        moe_capacity_factor=4.0,
+        layer_windows=(8, 0, 8, 0))
+
+
 def deepseek_v2_lite() -> ModelConfig:
     """DeepSeek-V2-Lite (15.7B total / 2.4B active): MLA without q
     compression, softmax routing, 2 shared experts."""
@@ -104,6 +139,9 @@ PRESETS = {
     "mla_tiny": mla_tiny,
     "deepseek_v2_lite": deepseek_v2_lite,
     "deepseek_v3": deepseek_v3,
+    "gptoss_tiny": gptoss_tiny,
+    "gpt_oss_20b": gpt_oss_20b,
+    "gpt_oss_120b": gpt_oss_120b,
 }
 
 #: architectures the forward pass does NOT cover yet (listed so callers

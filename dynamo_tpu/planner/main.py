@@ -37,14 +37,16 @@ def load_profile(path: str):
     profiled base ISL)."""
     with open(path) as f:
         d = json.load(f)
-    by_isl = d.get("prefill_by_isl")
-    if by_isl and len(by_isl) > 1:
-        prefill = PerfInterpolator2D(curves={
-            float(isl): pts for isl, pts in by_isl.items()})
+    if len(d.get("prefill_by_isl") or {}) > 1:
+        prefill = PerfInterpolator2D.from_profile(d)
     else:
         prefill = PerfInterpolator(points=d["prefill"])
     decode = PerfInterpolator(points=d["decode"])
-    return prefill, decode, float(d.get("isl_words", 0))
+    # the live Observation's ISL is in TOKENS (from the frontend's token
+    # counters); prefer the profiler's measured token ISL and only fall
+    # back to the word count with the rough 1.3 tokens/word factor
+    isl_tokens = d.get("isl_tokens") or 1.3 * float(d.get("isl_words", 0))
+    return prefill, decode, float(isl_tokens)
 
 
 class LogConnector:

@@ -22,9 +22,19 @@ logger = logging.getLogger("dynamo.planner.prom")
 
 _LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([^\s]+)$")
 
+#: the routes whose latency histograms describe LLM generation — embeddings
+#: or error routes would corrupt the ITL estimate (their latencies average
+#: into the same metric name)
+_LLM_ROUTES = ('route="chat"', 'route="completions"', 'route="responses"')
+
 
 def parse_prometheus_text(text: str) -> dict[str, float]:
-    """name{labels} → value, summing across label sets per metric name."""
+    """name{labels} → value, summing across label sets per metric name.
+
+    Latency/TTFT histogram series are only summed for LLM-generation routes
+    (chat/completions/responses); token counters carry only model labels and
+    sum freely.
+    """
     out: dict[str, float] = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
@@ -32,7 +42,10 @@ def parse_prometheus_text(text: str) -> dict[str, float]:
         m = _LINE.match(line.strip())
         if not m:
             continue
-        name, _labels, value = m.groups()
+        name, labels, value = m.groups()
+        if (labels and "route=" in labels
+                and not any(r in labels for r in _LLM_ROUTES)):
+            continue
         try:
             out[name] = out.get(name, 0.0) + float(value)
         except ValueError:

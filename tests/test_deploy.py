@@ -190,3 +190,21 @@ def test_recipes_parse():
         assert svcs and all(s.command for s in svcs.values())
     assert parse_spec(
         "deploy/recipes/llama3-70b-v5e64-disagg.yaml")["decode"].planner_role == "decode"
+
+
+async def test_operator_restarts_on_command_change(tmp_path):
+    spec = str(tmp_path / "graph.yaml")
+    write_spec(spec, {"work": {"replicas": 1, "command": SLEEPER}})
+    op = ProcessOperator(spec, tick_s=0.1)
+    try:
+        op.reconcile_once()
+        pid_before = op.replicas["work"][0].proc.pid
+        # change the env (same replica count): replica must be replaced
+        write_spec(spec, {"work": {"replicas": 1, "command": SLEEPER,
+                                   "env": {"NEW": "cfg"}}})
+        os.utime(spec, (time.time() + 2, time.time() + 2))
+        op.reconcile_once()
+        assert alive(op, "work") == 1
+        assert op.replicas["work"][0].proc.pid != pid_before
+    finally:
+        await op.stop()

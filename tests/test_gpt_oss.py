@@ -1,0 +1,208 @@
+"""gpt-oss family: attention sinks, alternating sliding windows, clamped-GLU
+MoE with biases — golden parity vs HF transformers' GptOss implementation
+(ref workload: recipes/gpt-oss-120b/trtllm)."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    import torch
+    from transformers import GptOssConfig, GptOssForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = GptOssConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=32,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, num_local_experts=4, num_experts_per_tok=2,
+        sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"] * 2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        rope_scaling=None, attention_bias=True, tie_word_embeddings=False,
+    )
+    model = GptOssForCausalLM(hf_cfg).eval().to(torch.float32)
+    with torch.no_grad():  # make sinks/biases non-trivial
+        for layer in model.model.layers:
+            layer.self_attn.sinks.copy_(torch.randn_like(layer.self_attn.sinks))
+            layer.mlp.router.bias.copy_(
+                torch.randn_like(layer.mlp.router.bias) * 0.5)
+    path = tmp_path_factory.mktemp("gptoss_tiny")
+    model.save_pretrained(path, safe_serialization=True)
+    return model, str(path)
+
+
+def _paged_inputs(token_rows, block_size=4):
+    import jax.numpy as jnp
+
+    B = len(token_rows)
+    S = max(len(r) for r in token_rows)
+    W = (S + block_size - 1) // block_size
+    tokens = np.zeros((B, S), np.int32)
+    positions = np.zeros((B, S), np.int32)
+    slot_map = np.zeros((B, S), np.int32)
+    bt = np.zeros((B, W), np.int32)
+    kv_lens = np.zeros((B,), np.int32)
+    last_idx = np.zeros((B,), np.int32)
+    nxt = 1
+    for b, row in enumerate(token_rows):
+        n = len(row)
+        tokens[b, :n] = row
+        positions[b, :n] = np.arange(n)
+        blocks = list(range(nxt, nxt + W))
+        nxt += W
+        bt[b] = blocks
+        for s in range(n):
+            slot_map[b, s] = blocks[s // block_size] * block_size + s % block_size
+        kv_lens[b] = n
+        last_idx[b] = n - 1
+    return (jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(slot_map),
+            jnp.asarray(bt), jnp.asarray(kv_lens), jnp.asarray(last_idx),
+            nxt + 1)
+
+
+def test_gpt_oss_logits_parity_vs_hf(hf_checkpoint):
+    """Sequences LONGER than the sliding window on the sliding layers —
+    window masking, sink softmax, router bias, and the clamped GLU all have
+    to be right at once."""
+    import torch
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.cache import allocate_device_cache
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.loader import load_hf_params
+    from dynamo_tpu.engine.model import forward
+
+    model, path = hf_checkpoint
+    cfg = ModelConfig.from_pretrained(path)
+    assert cfg.attention_sinks and cfg.router_logit_bias
+    assert cfg.layer_windows == (8, 0, 8, 0)
+    assert cfg.moe_activation == "swiglu_oss"
+    params = load_hf_params(cfg, path, dtype=jnp.float32)
+
+    rows = [[5, 9, 17, 23, 42, 77, 101, 3, 54, 61, 7, 90],  # 12 > window 8
+            [7, 11, 13, 19]]
+    (tokens, positions, slot_map, bt, kv_lens, last_idx,
+     num_blocks) = _paged_inputs(rows)
+    kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
+    logits, kc, vc = forward(params, tokens, positions, slot_map, bt,
+                             kv_lens, last_idx, kc, vc, cfg=cfg, block_size=4)
+
+    with torch.no_grad():
+        for b, row in enumerate(rows):
+            hf = model(torch.tensor([row])).logits[0, -1].numpy()
+            np.testing.assert_allclose(np.asarray(logits[b]), hf,
+                                       atol=3e-4, rtol=3e-3)
+
+
+def test_gpt_oss_decode_matches_prefill(hf_checkpoint):
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.cache import allocate_device_cache
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.loader import load_hf_params
+    from dynamo_tpu.engine.model import forward
+
+    _, path = hf_checkpoint
+    cfg = ModelConfig.from_pretrained(path)
+    params = load_hf_params(cfg, path, dtype=jnp.float32)
+
+    row = [5, 9, 17, 23, 42, 77, 101, 3, 54, 61, 7, 90]
+    (tokens, positions, slot_map, bt, kv_lens, last_idx,
+     num_blocks) = _paged_inputs([row])
+    kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
+    want, _, _ = forward(params, tokens, positions, slot_map, bt, kv_lens,
+                         last_idx, kc, vc, cfg=cfg, block_size=4)
+
+    kc2, vc2 = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
+    (t8, p8, s8, _, kv8, li8, _) = _paged_inputs([row[:8]])
+    got, kc2, vc2 = forward(params, t8, p8, s8, bt, kv8, li8, kc2, vc2,
+                            cfg=cfg, block_size=4)
+    for i in range(8, len(row)):
+        tok = jnp.asarray([[row[i]]], jnp.int32)
+        pos = jnp.asarray([[i]], jnp.int32)
+        slot = jnp.asarray([[int(bt[0, i // 4]) * 4 + i % 4]], jnp.int32)
+        got, kc2, vc2 = forward(params, tok, pos, slot, bt,
+                                jnp.asarray([i + 1], jnp.int32),
+                                jnp.asarray([0], jnp.int32),
+                                kc2, vc2, cfg=cfg, block_size=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-3)
+
+
+async def test_gpt_oss_engine_generate():
+    from dynamo_tpu.engine.config import EngineArgs
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.models import get_model_config
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    cfg = get_model_config("gptoss_tiny")
+    eng = AsyncJaxEngine(cfg, EngineArgs(
+        block_size=4, num_blocks=64, max_num_seqs=4,
+        max_num_batched_tokens=32, max_model_len=128,
+        prefill_buckets=(8, 16, 32), decode_batch_buckets=(1, 2, 4)))
+
+    async def run():
+        r = PreprocessedRequest(
+            model="oss", token_ids=list(range(1, 14)),
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+        toks = []
+        async for out in eng.generate(r):
+            toks.extend(out.token_ids)
+        return toks
+
+    t1, t2 = await run(), await run()
+    assert t1 == t2 and len(t1) == 6
+    await eng.close()
+
+
+def test_gpt_oss_presets():
+    from dynamo_tpu.models import get_model_config
+
+    big = get_model_config("gpt_oss_120b")
+    assert big.num_experts == 128 and big.layer_windows[0] == 128
+    assert big.layer_windows[1] == 0 and len(big.layer_windows) == 36
+    assert get_model_config("gpt_oss_20b").num_layers == 24
+
+
+def test_rope_scaling_matches_hf():
+    """rope_params (yarn + llama3) must match HF's ROPE_INIT_FUNCTIONS —
+    real gpt-oss checkpoints ship yarn factor=32, llama-3.1 ships llama3."""
+    import torch
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from dynamo_tpu.engine.model import rope_params
+
+    class C:  # minimal config shim for the HF init fns
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    yarn = {"rope_type": "yarn", "factor": 32.0,
+            "original_max_position_embeddings": 4096,
+            "beta_fast": 32.0, "beta_slow": 1.0}
+    hf_cfg = C(rope_theta=150000.0, head_dim=64, hidden_size=64 * 4,
+               num_attention_heads=4, max_position_embeddings=131072,
+               rope_scaling=dict(yarn), partial_rotary_factor=1.0)
+    hf_inv, hf_scale = ROPE_INIT_FUNCTIONS["yarn"](hf_cfg, "cpu")
+    inv, scale = rope_params(150000.0, 64, yarn)
+    np.testing.assert_allclose(inv, hf_inv.numpy(), rtol=1e-6)
+    assert abs(scale - hf_scale) < 1e-6
+
+    llama3 = {"rope_type": "llama3", "factor": 8.0,
+              "original_max_position_embeddings": 8192,
+              "low_freq_factor": 1.0, "high_freq_factor": 4.0}
+    hf_cfg = C(rope_theta=500000.0, head_dim=128, hidden_size=128 * 4,
+               num_attention_heads=4, max_position_embeddings=131072,
+               rope_scaling=dict(llama3), partial_rotary_factor=1.0)
+    hf_inv, hf_scale = ROPE_INIT_FUNCTIONS["llama3"](hf_cfg, "cpu")
+    inv, scale = rope_params(500000.0, 128, llama3)
+    np.testing.assert_allclose(inv, hf_inv.numpy(), rtol=1e-6)
+    assert hf_scale == scale == 1.0
+
+    with pytest.raises(NotImplementedError):
+        rope_params(10000.0, 64, {"rope_type": "longrope", "factor": 4})
