@@ -44,10 +44,24 @@ async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
             if resp.status != 200:
                 res.error = f"http {resp.status}"
                 return res
+            import json as _json
+
             last = None
             async for raw in resp.content:
                 line = raw.decode().strip()
                 if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                try:
+                    chunk = _json.loads(line[6:])
+                except ValueError:
+                    continue
+                if chunk.get("usage"):  # record the true token ISL
+                    res.prompt_tokens = chunk["usage"].get("prompt_tokens", 0)
+                # only content-bearing chunks count as tokens — a
+                # usage-only final chunk (vLLM/OpenAI emit one with empty
+                # choices) must not inflate token counts or ITL samples
+                if not any((c.get("delta") or {}).get("content")
+                           or c.get("text") for c in chunk.get("choices", [])):
                     continue
                 now = time.perf_counter()
                 if res.ttft_s is None:
@@ -56,13 +70,6 @@ async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
                     res.itl_s.append(now - last)
                 last = now
                 res.tokens += 1
-                if '"usage"' in line:
-                    import json as _json
-                    try:  # final chunk: record the true token ISL
-                        u = _json.loads(line[6:]).get("usage") or {}
-                        res.prompt_tokens = u.get("prompt_tokens", 0)
-                    except ValueError:
-                        pass
             res.latency_s = time.perf_counter() - t0
             res.ok = res.ttft_s is not None
             return res

@@ -1,0 +1,94 @@
+"""Control-plane (dynctl) ceiling benchmark — VERDICT r1 weak #7: "request
+ingress, KV events, and metrics all share one asyncio hub with no benchmark
+of its ceiling."
+
+Measures, against a real TCP ControlPlaneServer with N concurrent client
+processes' worth of connections:
+
+- **rpc**: request/reply round-trips/s through a served endpoint subject
+  (the request-plane hop every inference request pays once — the response
+  stream itself rides direct worker↔frontend TCP, not the hub);
+- **kv_put**: discovery-write ops/s;
+- **stream_publish**: KV-event appends/s (the router feed).
+
+Usage: python -m benchmarks.hub_bench [--clients 8] [--seconds 3]
+Prints one JSON line per op kind.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import msgpack
+
+from dynamo_tpu.runtime.control_plane import (
+    ControlPlaneServer, RemoteControlPlane,
+)
+
+
+async def _timed(clients, seconds: float, op) -> dict:
+    stop = time.perf_counter() + seconds
+    counts = [0] * len(clients)
+
+    async def worker(i, plane):
+        while time.perf_counter() < stop:
+            await op(i, counts[i], plane)
+            counts[i] += 1
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker(i, p) for i, p in enumerate(clients)))
+    dt = time.perf_counter() - t0
+    total = sum(counts)
+    return {"ops": total, "seconds": round(dt, 3),
+            "ops_per_s": round(total / dt, 1)}
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="dynctl hub ceiling bench")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    cli = ap.parse_args()
+
+    server = ControlPlaneServer(port=0)
+    addr = await server.start()
+    clients = [await RemoteControlPlane(addr).connect()
+               for _ in range(cli.clients)]
+
+    # an echo service on the hub's request plane
+    async def echo(payload: bytes) -> bytes:
+        return payload
+
+    await clients[0].serve("bench.echo", echo)
+    payload = msgpack.packb({"tokens": list(range(64))})
+
+    results = {}
+
+    async def rpc(i, n, plane):
+        await plane.request("bench.echo", payload, timeout=30.0)
+
+    results["rpc_roundtrips"] = await _timed(clients, cli.seconds, rpc)
+
+    async def kv(i, n, plane):
+        await plane.kv_put(f"bench/{i}/{n % 512}", payload)
+
+    results["kv_put"] = await _timed(clients, cli.seconds, kv)
+
+    async def pub(i, n, plane):
+        await plane.stream_publish("bench_events", payload)
+
+    results["stream_publish"] = await _timed(clients, cli.seconds, pub)
+
+    for name, r in results.items():
+        print(json.dumps({"metric": f"hub_{name}", "clients": cli.clients,
+                          **r}), flush=True)
+
+    for c in clients:
+        await c.close()
+    await server.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(amain())

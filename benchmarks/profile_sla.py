@@ -82,7 +82,11 @@ async def amain():
     out = {"prefill": prefill_by_isl[base_isl],
            "prefill_by_isl": prefill_by_isl,
            "decode": decode,
-           "isl_words": base_words, "isl_tokens": base_isl, "osl": cli.osl}
+           "isl_words": base_words, "osl": cli.osl}
+    if base_isl != base_words:  # only when actually MEASURED in tokens —
+        # a word count mislabeled as tokens would defeat the planner's
+        # tokens-per-word fallback conversion
+        out["isl_tokens"] = base_isl
     with open(cli.out, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {cli.out}")

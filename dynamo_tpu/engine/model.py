@@ -284,9 +284,11 @@ def rope_params(theta: float, hd: int, scaling: Optional[dict]):
             # HF _compute_yarn_parameters: dim·ln(orig/(2π·rot))/(2·ln θ)
             return half * np.log(orig / (rot * 2 * np.pi)) / np.log(theta)
 
-        low = np.floor(correction_dim(beta_fast))
-        high = np.ceil(correction_dim(beta_slow))
-        low, high = max(low, 0), min(high, half - 1)
+        low = correction_dim(beta_fast)
+        high = correction_dim(beta_slow)
+        if scaling.get("truncate", True):  # gpt-oss ships truncate=false
+            low, high = np.floor(low), np.ceil(high)
+        low, high = max(low, 0), min(high, hd - 1)  # HF clamps to dim-1
         ramp = np.clip((np.arange(half) - low) / max(1e-3, high - low), 0, 1)
         mask = 1.0 - ramp  # 1 = extrapolate (high freq), 0 = interpolate
         inv = inv / factor * (1 - mask) + inv * mask
@@ -311,6 +313,21 @@ def rope_params(theta: float, hd: int, scaling: Optional[dict]):
         out = np.where(is_mid, smoothed, out)
         return out.astype(np.float32), 1.0
     raise NotImplementedError(f"rope_scaling type '{kind}' not supported")
+
+
+def mla_softmax_scale(cfg: ModelConfig) -> float:
+    """MLA attention scale: qk_head_dim^-0.5 times the YaRN mscale² HF's
+    DeepseekV2/V3 attention applies when rope_scaling carries
+    mscale_all_dim (without it, every real long-context DeepSeek checkpoint
+    attends ~1.9× too flat)."""
+    scale = 1.0 / np.sqrt(cfg.qk_head_dim)
+    s = cfg.rope_scaling or {}
+    if s.get("mscale_all_dim"):
+        factor = float(s.get("factor", 1.0))
+        if factor > 1.0:
+            m = 0.1 * float(s["mscale_all_dim"]) * np.log(factor) + 1.0
+            scale *= m * m
+    return float(scale)
 
 
 def _rope(x, positions, theta, scaling: Optional[dict] = None):
@@ -431,7 +448,7 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
     q_eff = jnp.einsum("bshd,rhd->bshr", q_nope.astype(jnp.float32), w_uk)
     scores = (jnp.einsum("bshr,btr->bhst", q_eff, cg)
               + jnp.einsum("bshd,btd->bhst", q_rot.astype(jnp.float32), krg))
-    scores = scores / np.sqrt(dn + dr)
+    scores = scores * mla_softmax_scale(cfg)
 
     key_pos = jnp.arange(T)
     mask = (key_pos[None, None, :] <= positions[:, :, None]) & (

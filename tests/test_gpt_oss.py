@@ -206,3 +206,42 @@ def test_rope_scaling_matches_hf():
 
     with pytest.raises(NotImplementedError):
         rope_params(10000.0, 64, {"rope_type": "longrope", "factor": 4})
+
+
+def test_mla_softmax_scale_yarn():
+    """DeepSeek YaRN: attention scale must carry mscale² (HF DeepseekV3
+    multiplies qk_head_dim^-0.5 by yarn_get_mscale(factor, mscale_all_dim)²)."""
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.model import mla_softmax_scale
+
+    base = ModelConfig(kv_lora_rank=512, qk_nope_head_dim=128,
+                       qk_rope_head_dim=64)
+    assert abs(mla_softmax_scale(base) - (192 ** -0.5)) < 1e-9
+    scaled = ModelConfig(kv_lora_rank=512, qk_nope_head_dim=128,
+                         qk_rope_head_dim=64,
+                         rope_scaling={"rope_type": "yarn", "factor": 40.0,
+                                       "mscale": 1.0, "mscale_all_dim": 1.0,
+                                       "original_max_position_embeddings": 4096})
+    m = 0.1 * np.log(40.0) + 1.0
+    assert abs(mla_softmax_scale(scaled) - (192 ** -0.5) * m * m) < 1e-9
+
+
+def test_yarn_truncate_false_matches_hf():
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from dynamo_tpu.engine.model import rope_params
+
+    class C:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    yarn = {"rope_type": "yarn", "factor": 32.0, "truncate": False,
+            "original_max_position_embeddings": 4096,
+            "beta_fast": 32.0, "beta_slow": 1.0}
+    hf_cfg = C(rope_theta=150000.0, head_dim=64, hidden_size=256,
+               num_attention_heads=4, max_position_embeddings=131072,
+               rope_scaling=dict(yarn), partial_rotary_factor=1.0)
+    hf_inv, hf_scale = ROPE_INIT_FUNCTIONS["yarn"](hf_cfg, "cpu")
+    inv, scale = rope_params(150000.0, 64, yarn)
+    np.testing.assert_allclose(inv, hf_inv.numpy(), rtol=1e-6)
+    assert abs(scale - hf_scale) < 1e-6
