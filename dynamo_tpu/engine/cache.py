@@ -180,8 +180,13 @@ class BlockPool:
 
 
 def allocate_device_cache(cfg, num_blocks: int, block_size: int, mesh=None,
-                          dtype=None):
-    """Allocate the [L, num_slots, KV, hd] k/v cache arrays (zeros)."""
+                          dtype=None, global_arrays: bool = False):
+    """Allocate the [L, num_slots, KV, hd] k/v cache arrays (zeros).
+
+    ``global_arrays`` (multi-host meshes): zeros are materialized through a
+    jitted creation so shards land on non-addressable devices too —
+    device_put can only reach this process's devices.
+    """
     import jax.numpy as jnp
     import jax
 
@@ -191,6 +196,12 @@ def allocate_device_cache(cfg, num_blocks: int, block_size: int, mesh=None,
     (kh, kd), (vh, vd) = cfg.kv_cache_spec
     k_shape = (cfg.num_layers, num_blocks * block_size, kh, kd)
     v_shape = (cfg.num_layers, num_blocks * block_size, vh, vd)
+    if mesh is not None and global_arrays:
+        from dynamo_tpu.parallel.multihost import global_zeros
+
+        sh = cache_shardings(mesh, cfg)
+        return (global_zeros(k_shape, dtype, sh),
+                global_zeros(v_shape, dtype, sh))
     if mesh is not None:
         sh = cache_shardings(mesh, cfg)
         k = jax.device_put(jnp.zeros(k_shape, dtype), sh)

@@ -62,18 +62,33 @@ class AsyncJaxEngine:
         self.metrics_cb = metrics_cb
         self._event_id = itertools.count()
 
+        #: mesh spans processes? then arrays must be created as GLOBAL
+        #: arrays (device_put cannot reach another host's devices) and every
+        #: rank replays the same step order (parallel/multihost.py)
+        self._multihost = False
+        if mesh is not None:
+            from dynamo_tpu.parallel.multihost import is_multihost
+            self._multihost = is_multihost(mesh)
+        #: leader hook: called with (kind, host_arrays) right before each
+        #: jitted dispatch so follower ranks stay in SPMD lockstep
+        self.broadcast_cb: Optional[Callable] = None
+
         if params is None:
             params = M.init_params(cfg, jax.random.key(args.seed))
         if mesh is not None:
             sh = M.param_shardings(cfg, mesh)
-            params = jax.device_put(params, sh)
+            if self._multihost:
+                from dynamo_tpu.parallel.multihost import global_put
+                params = jax.tree.map(global_put, params, sh)
+            else:
+                params = jax.device_put(params, sh)
         self.params = params
 
         nb = args.num_blocks or hbm_sized_num_blocks(
             cfg, args.block_size, args.kv_cache_memory_fraction, args.tp_size)
         self.num_blocks = nb
         self.k_cache, self.v_cache = allocate_device_cache(
-            cfg, nb, args.block_size, mesh)
+            cfg, nb, args.block_size, mesh, global_arrays=self._multihost)
 
         self.kvbm = None
         if args.kvbm_host_bytes > 0 and args.enable_prefix_caching:
@@ -92,12 +107,14 @@ class AsyncJaxEngine:
             args, self.pool, on_stored=self._on_stored,
             onboard_cb=self._onboard if self.kvbm is not None else None)
         self.step_fn = M.make_step_fn(cfg, args.block_size, mesh,
-                                      use_pallas=args.use_pallas_attention)
+                                      use_pallas=args.use_pallas_attention,
+                                      replicate_logits=self._multihost)
         self.multi_fn = None
         if args.multi_step_decode > 1:
             self.multi_fn = M.make_multi_decode_fn(
                 cfg, args.block_size, args.multi_step_decode, mesh,
-                use_pallas=args.use_pallas_attention)
+                use_pallas=args.use_pallas_attention,
+                replicate_outputs=self._multihost)
         from dynamo_tpu.engine import sampling as S
         self._sampling = S
 
@@ -539,10 +556,17 @@ class AsyncJaxEngine:
         kv_lens = np.array([end], np.int32)
         last_idx = np.array([chunk - 1], np.int32)
 
+        self._broadcast("step", tokens=tokens, positions=positions,
+                        slot_map=slot_map, block_tables=bt, kv_lens=kv_lens,
+                        last_idx=last_idx)
         logits, self.k_cache, self.v_cache = self.step_fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(slot_map), jnp.asarray(bt), jnp.asarray(kv_lens),
-            jnp.asarray(last_idx), self.k_cache, self.v_cache)
+            self.params, self._put_batch("tokens", tokens),
+            self._put_batch("positions", positions),
+            self._put_batch("slot_map", slot_map),
+            self._put_batch("block_tables", bt),
+            self._put_batch("kv_lens", kv_lens),
+            self._put_batch("last_idx", last_idx),
+            self.k_cache, self.v_cache)
 
         self.scheduler.commit_computed(seq, end)
         if seq.progress_cb is not None:
@@ -606,10 +630,17 @@ class AsyncJaxEngine:
             bt[i, :n] = s.block_table[:n]
             kv_lens[i] = len(s.tokens)
 
+        self._broadcast("step", tokens=tokens, positions=positions,
+                        slot_map=slot_map, block_tables=bt, kv_lens=kv_lens,
+                        last_idx=last_idx)
         logits, self.k_cache, self.v_cache = self.step_fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(slot_map), jnp.asarray(bt), jnp.asarray(kv_lens),
-            jnp.asarray(last_idx), self.k_cache, self.v_cache)
+            self.params, self._put_batch("tokens", tokens),
+            self._put_batch("positions", positions),
+            self._put_batch("slot_map", slot_map),
+            self._put_batch("block_tables", bt),
+            self._put_batch("kv_lens", kv_lens),
+            self._put_batch("last_idx", last_idx),
+            self.k_cache, self.v_cache)
 
         toks, logps, tops = await self._sample(seqs, logits)
         for i, s in enumerate(seqs):
@@ -667,11 +698,19 @@ class AsyncJaxEngine:
                         else hash(s.request_id) & 0x7FFFFFFF) & 0xFFFFFFFF
             step0[i] = s.step_idx & 0xFFFFFFFF
 
+        self._broadcast("multi", last_tokens=last_tokens,
+                        positions=positions, block_tables=bt, kv_lens=kv_lens,
+                        temp=temp, top_k=top_k, top_p=top_p, seeds=seeds,
+                        step0=step0)
         toks, logps, self.k_cache, self.v_cache = self.multi_fn(
-            self.params, jnp.asarray(last_tokens), jnp.asarray(positions),
-            jnp.asarray(bt), jnp.asarray(kv_lens), self.k_cache, self.v_cache,
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-            jnp.asarray(seeds), jnp.asarray(step0))
+            self.params, self._put_batch("last_tokens", last_tokens),
+            self._put_batch("positions", positions),
+            self._put_batch("block_tables", bt),
+            self._put_batch("kv_lens", kv_lens),
+            self.k_cache, self.v_cache,
+            self._put_batch("temp", temp), self._put_batch("top_k", top_k),
+            self._put_batch("top_p", top_p), self._put_batch("seeds", seeds),
+            self._put_batch("step0", step0))
         toks, logps = await asyncio.to_thread(
             lambda: (np.asarray(toks), np.asarray(logps)))
 
@@ -684,6 +723,27 @@ class AsyncJaxEngine:
         return True
 
     # ------------------------------------------------------------ sampling
+
+
+    def _put_batch(self, name: str, arr):
+        """Host batch array → device array; under a multi-host mesh the
+        array becomes a GLOBAL array (batch axis on "dp", replicated when
+        dp=1) so every rank's jitted call sees identical operands."""
+        import jax.numpy as jnp
+
+        if not self._multihost:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dynamo_tpu.parallel.multihost import global_put
+
+        a = np.asarray(arr)
+        spec = P(*(["dp"] + [None] * (a.ndim - 1)))
+        return global_put(a, NamedSharding(self.mesh, spec))
+
+    def _broadcast(self, kind: str, **arrays) -> None:
+        if self.broadcast_cb is not None:
+            self.broadcast_cb(kind, arrays)
 
     async def _sample(self, seqs: list[SeqState], logits):
         """Sample one token per seq from padded logits [B>=len(seqs), V].
@@ -709,18 +769,28 @@ class AsyncJaxEngine:
         seeds += [0] * (B - len(seqs))
         steps += [0] * (B - len(seqs))
         keys = self._sampling.make_keys(seeds, steps)
-        toks, logps = self._sampling.sample_jit(logits, temp, top_k, top_p, keys)
-        top_res = None
-        if want_tops:
-            # device-side top-k: only O(B·k) crosses to host, and the
-            # selected logprob comes from the same log_softmax as its
-            # alternatives (an ulp disagreement would read as a near-tie).
-            # Always the k=20 kernel — one XLA compile ever, sliced per row
-            # below (a per-kmax kernel would recompile as batch composition
-            # shifts, stalling the decode loop)
-            top_res = self._sampling.make_topk_logprobs_fn(20)(logits, toks)
 
-        def fetch():
+        def run_sampling():
+            # runs in a worker thread: the host sync below must NEVER block
+            # the event loop — under multi-host it waits on a collective the
+            # FOLLOWER ranks can only join after the loop's broadcaster task
+            # flushed the step (blocking the loop here deadlocked the fleet)
+            lg = logits
+            if self._multihost:
+                # logits are fully replicated (make_step_fn): round-trip
+                # through host so sampling is a LOCAL computation — a global
+                # op here would have to be mirrored by every follower rank
+                lg = np.asarray(lg)
+            toks, logps = self._sampling.sample_jit(lg, temp, top_k, top_p,
+                                                    keys)
+            top_res = None
+            if want_tops:
+                # device-side top-k: only O(B·k) crosses to host, and the
+                # selected logprob comes from the same log_softmax as its
+                # alternatives (an ulp disagreement would read as a fake
+                # near-tie). Always the k=20 kernel — one XLA compile ever,
+                # sliced per row below
+                top_res = self._sampling.make_topk_logprobs_fn(20)(lg, toks)
             t, l = np.asarray(toks), np.asarray(logps)
             tops: dict[int, list[list]] = {}
             if top_res is not None:
@@ -732,7 +802,7 @@ class AsyncJaxEngine:
                     l[i] = sel[i]
             return t, l, tops
 
-        return await asyncio.to_thread(fetch)
+        return await asyncio.to_thread(run_sampling)
 
     def _deliver(self, seq: SeqState, token: int, logp: float,
                  top: Optional[list] = None) -> None:

@@ -32,7 +32,19 @@ def build_engine(cli, cfg: ModelConfig, args: EngineArgs):
     from dynamo_tpu.engine.engine import AsyncJaxEngine
 
     mesh = None
-    if args.tp_size * args.dp_size > 1:
+    if getattr(cli, "_mh_world", 0) > 1:
+        # multi-host: one GLOBAL mesh over every process's devices; rank 0
+        # runs the scheduler, other ranks replay its step stream
+        if args.dp_size > 1:
+            raise SystemExit(
+                "multi-host step replication supports dp=1 only (tp/sp span "
+                "hosts); multi-host DP runs one engine per rank instead "
+                "(--dp-rank/--num-ranks)")
+        from dynamo_tpu.parallel import MeshConfig
+        from dynamo_tpu.parallel.multihost import make_global_mesh
+        mesh = make_global_mesh(
+            MeshConfig(dp=args.dp_size, sp=1, tp=args.tp_size))
+    elif args.tp_size * args.dp_size > 1:
         from dynamo_tpu.parallel import MeshConfig, make_mesh
         mesh = make_mesh(MeshConfig(dp=args.dp_size, sp=1, tp=args.tp_size))
 
@@ -107,6 +119,13 @@ async def amain():
                     help="also run the KVBM leader in this process, "
                          "expecting N workers at the startup barrier "
                          "(ref: distributed/leader.rs:126)")
+    ap.add_argument("--jax-coordinator", default=None,
+                    help="multi-host: jax.distributed coordinator host:port "
+                         "(TPU pods auto-detect with --jax-num-processes "
+                         "alone; the engine's mesh then spans every host — "
+                         "parallel/multihost.py)")
+    ap.add_argument("--jax-num-processes", type=int, default=None)
+    ap.add_argument("--jax-process-id", type=int, default=None)
     cli = ap.parse_args()
 
     # resolve model metadata BEFORE the heavy engine build so a
@@ -171,8 +190,49 @@ async def amain():
     if cli.dp_rank is not None and not 0 <= cli.dp_rank < cli.num_ranks:
         ap.error(f"--dp-rank {cli.dp_rank} outside [0, {cli.num_ranks})")
 
+    cli._mh_rank, cli._mh_world = 0, 1
+    if cli.jax_coordinator or cli.jax_num_processes:
+        from dynamo_tpu.parallel.multihost import init_multihost
+        cli._mh_rank, cli._mh_world = init_multihost(
+            cli.jax_coordinator, cli.jax_num_processes, cli.jax_process_id)
+
     engine = build_engine(cli, cfg, args)  # heavy JAX work first (see above)
     runtime = await DistributedRuntime.create()
+
+    if cli._mh_world > 1 and cli._mh_rank > 0:
+        # follower rank: replay the leader's step stream in SPMD lockstep —
+        # no endpoints, no registration; the leader owns the serving surface.
+        # Check in at the barrier only AFTER subscribing: a step published
+        # before the subscription exists is lost, and a gapped stream is an
+        # unrecoverable desync.
+        from dynamo_tpu.parallel.multihost import StepFollower
+        from dynamo_tpu.runtime.barrier import LeaderWorkerBarrier
+        follower = await StepFollower(engine, runtime.plane,
+                                      cli.namespace).start()
+        barrier = LeaderWorkerBarrier(
+            runtime.plane, f"mh/{cli.namespace}/{cli.model}",
+            lease_id=await runtime.primary_lease())
+        await barrier.worker_enter(f"mh-rank-{cli._mh_rank}")
+        print("FOLLOWER_READY", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await follower.stop()
+        await runtime.shutdown()
+        return
+    if cli._mh_world > 1:
+        # leader: serve NOTHING until every follower has subscribed — early
+        # steps would be lost and wedge the first cross-host collective
+        from dynamo_tpu.parallel.multihost import StepBroadcaster
+        from dynamo_tpu.runtime.barrier import LeaderWorkerBarrier
+        engine.broadcast_cb = StepBroadcaster(runtime.plane, cli.namespace)
+        barrier = LeaderWorkerBarrier(
+            runtime.plane, f"mh/{cli.namespace}/{cli.model}",
+            lease_id=await runtime.primary_lease())
+        await barrier.leader_enter(b"1", cli._mh_world - 1)
+
     lease = await runtime.primary_lease()
     engine.dp_rank = cli.dp_rank
     engine.event_cb = KvEventPublisher(

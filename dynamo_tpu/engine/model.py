@@ -231,6 +231,11 @@ def cache_shardings(mesh: Mesh, cfg: Optional[ModelConfig] = None) -> NamedShard
     """
     if cfg is not None and cfg.is_mla:
         return NamedSharding(mesh, P(None, None, None, None))
+    tp = mesh.shape.get("tp", 1)
+    if cfg is not None and cfg.num_kv_heads % max(1, tp) != 0:
+        # KV heads not divisible by tp (tiny test models on wide meshes):
+        # replicate the head dim rather than fail allocation
+        return NamedSharding(mesh, P(None, None, None, None))
     return NamedSharding(mesh, P(None, None, "tp", None))
 
 
@@ -993,17 +998,29 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
 
 
 def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
-                         mesh: Optional[Mesh] = None, use_pallas: bool = False):
-    """Jitted multi-step decode with cache donation (args 5, 6)."""
+                         mesh: Optional[Mesh] = None, use_pallas: bool = False,
+                         replicate_outputs: bool = False):
+    """Jitted multi-step decode with cache donation (args 5, 6).
+
+    ``replicate_outputs`` (multi-host): tokens/logps come back fully
+    replicated so the leader rank can read them host-side without issuing
+    another global computation the follower ranks would not mirror.
+    """
     decode_pallas, _ = _resolve_kernel_flags(cfg, mesh, use_pallas, False)
     f = functools.partial(multi_decode, cfg=cfg, block_size=block_size,
                           num_steps=num_steps, use_pallas=decode_pallas,
                           mesh=mesh)
-    return jax.jit(f, donate_argnums=(5, 6))
+    kw = {}
+    if replicate_outputs and mesh is not None:
+        rep = NamedSharding(mesh, P())
+        csh = cache_shardings(mesh, cfg)
+        kw["out_shardings"] = (rep, rep, csh, csh)
+    return jax.jit(f, donate_argnums=(5, 6), **kw)
 
 
 def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,
-                 use_pallas: bool = False, use_flash_prefill=None):
+                 use_pallas: bool = False, use_flash_prefill=None,
+                 replicate_logits: bool = False):
     """Jitted engine step with cache donation (and GSPMD shardings if mesh).
 
     ``use_pallas`` switches decode (S=1) attention onto the Pallas paged
@@ -1015,5 +1032,10 @@ def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,
     f = functools.partial(forward, cfg=cfg, block_size=block_size,
                           use_pallas=decode_pallas,
                           use_flash_prefill=prefill_flash, mesh=mesh)
+    kw = {}
+    if replicate_logits and mesh is not None:  # multi-host: see above
+        kw["out_shardings"] = (NamedSharding(mesh, P()),
+                               cache_shardings(mesh, cfg),
+                               cache_shardings(mesh, cfg))
     # donate caches (args 7, 8 → positions in the positional signature)
-    return jax.jit(f, donate_argnums=(7, 8))
+    return jax.jit(f, donate_argnums=(7, 8), **kw)

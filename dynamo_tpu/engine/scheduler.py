@@ -167,8 +167,10 @@ class Scheduler:
                 if chunk <= 0:
                     break
                 if not self._ensure_blocks(s, s.num_computed + chunk):
-                    # not enough memory even after nothing to preempt → wait
-                    if not self._preempt_for(s):
+                    # not enough memory: preempt, but never a seq already in
+                    # THIS step's decode batch (its block table is about to
+                    # be indexed by the jitted call) — else wait
+                    if not self._preempt_for(s, exclude=plan.decode):
                         break
                     if not self._ensure_blocks(s, s.num_computed + chunk):
                         break
@@ -328,12 +330,19 @@ class Scheduler:
         seq.block_table.extend(got)
         return True
 
-    def _preempt_for(self, needy: SeqState) -> bool:
-        """Preempt the newest other running seq to free memory. True if any."""
+    def _preempt_for(self, needy: SeqState, exclude=()) -> bool:
+        """Preempt the newest other running seq to free memory. True if any.
+
+        ``exclude`` protects sequences already finalized into this step's
+        decode batch: evicting one would free the very block table the
+        imminent jitted call is about to index (the bench-on-TPU crash —
+        a prefill chunk preempting a planned decode mid-step).
+        """
         for victim in reversed(self.running):
-            if victim is not needy:
-                self._preempt(victim)
-                return True
+            if victim is needy or any(victim is e for e in exclude):
+                continue
+            self._preempt(victim)
+            return True
         return False
 
     def _preempt(self, seq: SeqState) -> None:

@@ -1,0 +1,125 @@
+"""Rank worker for the multi-host lockstep test (tests/test_multihost.py).
+
+Usage: python tests/mh_worker.py <rank> <coordinator> <plane_addr>
+
+Two JAX processes × 2 virtual CPU devices form one GLOBAL tp=4 mesh. Rank 0
+runs the real engine (greedy generate) broadcasting each step's host inputs;
+rank 1 replays them through identical jitted functions. Both ranks finish by
+computing a jitted GLOBAL checksum of their k_cache — bit-identical inputs
+must leave bit-identical global cache state on both ranks.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+
+def _script_env():
+    """ONLY for subprocess execution — mutating XLA_FLAGS inside a pytest
+    process would poison any later jax backend re-initialization."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def mh_model_cfg():
+    """Shared by worker and test: every head dim divisible by tp=4."""
+    from dynamo_tpu.engine.config import ModelConfig
+
+    return ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=4, dtype="float32",
+        max_position_embeddings=512)
+
+
+def mh_engine_args():
+    from dynamo_tpu.engine.config import EngineArgs
+
+    return EngineArgs(block_size=4, num_blocks=64, max_num_seqs=2,
+                      max_num_batched_tokens=32, max_model_len=64,
+                      prefill_buckets=(16,), decode_batch_buckets=(1,))
+
+
+async def wait_kv(plane, key, timeout=60.0):
+    for _ in range(int(timeout / 0.05)):
+        v = await plane.kv_get(key)
+        if v is not None:
+            return v
+        await asyncio.sleep(0.05)
+    raise TimeoutError(key)
+
+
+async def main():
+    import jax
+
+    rank, coord, plane_addr = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+
+    from dynamo_tpu.parallel import MeshConfig
+    from dynamo_tpu.parallel.multihost import (
+        StepBroadcaster, StepFollower, init_multihost, make_global_mesh,
+    )
+
+    r, world = init_multihost(coord, 2, rank)
+    assert (r, world) == (rank, 2)
+    mesh = make_global_mesh(MeshConfig(dp=1, sp=1, tp=4))
+
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.runtime.control_plane import RemoteControlPlane
+
+    cfg = mh_model_cfg()
+    args = mh_engine_args()
+    plane = await RemoteControlPlane(plane_addr).connect()
+    eng = AsyncJaxEngine(cfg, args, mesh=mesh)
+    assert eng._multihost, "mesh must span both processes"
+
+    if rank == 0:
+        bcast = StepBroadcaster(plane)
+        eng.broadcast_cb = bcast
+        await wait_kv(plane, "mh/ready")
+
+        req = PreprocessedRequest(
+            model="t", token_ids=list(range(1, 13)),
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+        toks = []
+        async for out in eng.generate(req):
+            toks.extend(out.token_ids)
+        print("TOKENS " + json.dumps(toks), flush=True)
+        await bcast.stop()
+        await plane.kv_put("mh/nsteps", str(bcast.steps_sent).encode())
+        await wait_kv(plane, "mh/replayed")
+    else:
+        follower = await StepFollower(eng, plane).start()
+        await plane.kv_put("mh/ready", b"1")
+        nsteps = int(await wait_kv(plane, "mh/nsteps"))
+        for _ in range(1200):
+            if follower.steps_replayed >= nsteps:
+                break
+            await asyncio.sleep(0.05)
+        assert follower.steps_replayed == nsteps, \
+            f"replayed {follower.steps_replayed}/{nsteps}"
+        print(f"REPLAYED {follower.steps_replayed}", flush=True)
+        await plane.kv_put("mh/replayed", b"1")
+        await follower.stop()
+
+    # BOTH ranks issue the same global reduction — program order aligned
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cks = jax.jit(lambda a: jnp.sum(jnp.abs(a.astype(jnp.float32))),
+                  out_shardings=NamedSharding(mesh, P()))(eng.k_cache)
+    print(f"CKSUM {float(cks):.6f}", flush=True)
+    await eng.close()
+    await plane.close()
+
+
+if __name__ == "__main__":
+    _script_env()
+    asyncio.run(main())

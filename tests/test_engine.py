@@ -361,3 +361,24 @@ async def test_embeddings_http_e2e():
         await handle.stop(graceful=False)
         await eng.close()
         await rt.shutdown()
+
+
+async def test_preemption_never_evicts_planned_decode():
+    """Memory pressure with mixed prefill+decode: planning a prefill chunk
+    must never preempt a sequence already finalized into this step's decode
+    batch (its freed block table would be indexed by the imminent jitted
+    call — the bench-on-TPU IndexError). Under pressure everything still
+    completes, possibly after recompute preemptions."""
+    eng = tiny_engine(num_blocks=20, max_num_seqs=4,
+                      max_num_batched_tokens=16, max_model_len=128,
+                      prefill_buckets=(8, 16), decode_batch_buckets=(1, 2, 4))
+
+    async def run(seed):
+        prompt = [1 + (seed * 7 + i) % 200 for i in range(24)]
+        toks, reason = await collect(eng, req(prompt, max_tokens=8))
+        assert reason == FinishReason.LENGTH
+        return toks
+
+    results = await asyncio.gather(*(run(i) for i in range(4)))
+    assert all(len(r) == 8 for r in results)
+    await eng.close()
