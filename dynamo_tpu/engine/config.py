@@ -270,6 +270,13 @@ class EngineArgs:
     #: decode steps fused into one jitted call when only decode work exists
     #: (amortizes per-dispatch latency; tokens deliver in bursts of this size)
     multi_step_decode: int = 1
+    #: speculative decoding via prompt lookup (n-gram drafting): draft up to
+    #: this many tokens from the sequence's own history and verify them in
+    #: ONE forward — greedy-invariant (identical tokens to plain decode).
+    #: 0 = off. Applies to temperature-0 batches without logprobs; the
+    #: reference delegates spec decode to its engines and reports it via
+    #: SpecDecodeStats (kv_router/protocols.rs:48-84)
+    speculative_tokens: int = 0
     # KVBM tiers (0 = tier disabled; ref: block_manager.rs:62-75 G2/G3)
     kvbm_host_bytes: int = 0
     kvbm_disk_dir: Optional[str] = None

@@ -34,7 +34,8 @@ from dynamo_tpu.engine.config import EngineArgs, ModelConfig
 from dynamo_tpu.engine.scheduler import Scheduler, SeqState, StepPlan
 from dynamo_tpu.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.router.protocols import (
-    ForwardPassMetrics, KvCacheEvent, KvStats, StoredBlock, WorkerStats,
+    ForwardPassMetrics, KvCacheEvent, KvStats, SpecDecodeStats, StoredBlock,
+    WorkerStats,
 )
 
 logger = logging.getLogger("dynamo.engine")
@@ -115,6 +116,12 @@ class AsyncJaxEngine:
                 cfg, args.block_size, args.multi_step_decode, mesh,
                 use_pallas=args.use_pallas_attention,
                 replicate_outputs=self._multihost)
+        self.verify_fn = None
+        if args.speculative_tokens > 0:
+            self.verify_fn = M.make_verify_fn(
+                cfg, args.block_size, mesh,
+                replicate_outputs=self._multihost)
+        self.spec_stats = SpecDecodeStats()
         from dynamo_tpu.engine import sampling as S
         self._sampling = S
 
@@ -591,7 +598,140 @@ class AsyncJaxEngine:
 
     # -------------------------------------------------------------- decode
 
+    # ---------------------------------------------- speculative decoding
+
+    @staticmethod
+    def _draft_tokens(s, k: int) -> list[int]:
+        """Prompt-lookup draft: match the trailing 3- or 2-gram earlier in
+        the sequence and propose the tokens that followed it.
+
+        O(new tokens) per call: ``s.ngram_pos`` maps each n-gram to the END
+        position of its newest occurrence, extended incrementally — a full
+        backward history scan per decode step would be O(n²) Python work on
+        the event loop over a long generation. The current trailing gram's
+        own end is deliberately left unindexed until the sequence grows past
+        it, so a lookup never matches itself.
+        """
+        tokens = s.tokens
+        n_tok = len(tokens)
+        idx = s.ngram_pos
+        for e in range(max(s.ngram_indexed + 1, 2), n_tok):  # end-exclusive
+            if e >= 2:
+                idx[(tokens[e - 2], tokens[e - 1])] = e
+            if e >= 3:
+                idx[(tokens[e - 3], tokens[e - 2], tokens[e - 1])] = e
+        s.ngram_indexed = max(s.ngram_indexed, n_tok - 1)
+        for n in (3, 2):
+            if n_tok <= n:
+                continue
+            e = idx.get(tuple(tokens[-n:]))
+            if e is not None:
+                cont = tokens[e:e + k]
+                if cont:
+                    return cont
+        return []
+
+    def _prealloc_blocks(self, seqs: list[SeqState], extra: int) -> bool:
+        """All-or-nothing block preallocation for fused decode paths — a
+        partial extension left behind would deepen the memory pressure that
+        made it fail (shared by the burst and speculative paths)."""
+        extended: list = []
+        for s in seqs:
+            before = len(s.block_table)
+            if not self.scheduler._ensure_blocks(s, len(s.tokens) + extra):
+                for s2, b2 in extended:
+                    self.pool.release(s2.block_table[b2:])
+                    del s2.block_table[b2:]
+                return False
+            if len(s.block_table) > before:
+                extended.append((s, before))
+        return True
+
+    async def _run_spec_decode(self, seqs: list[SeqState]) -> bool:
+        """Draft-and-verify: one forward over [last_token, draft...] per seq
+        accepts the longest greedy-matching draft prefix plus one corrected
+        token — emitting 1..K+1 tokens per dispatch with EXACTLY the tokens
+        plain greedy decode would produce. Returns False (fall back) when no
+        seq drafts anything or block preallocation fails."""
+        args = self.args
+        K = args.speculative_tokens
+        drafts = [self._draft_tokens(s, K) for s in seqs]
+        if not any(drafts):
+            return False
+        if not self._prealloc_blocks(seqs, K):
+            return False
+
+        B = args.bucket_batch(len(seqs))
+        S = 1 + K
+        bs = args.block_size
+        max_kv = max(len(s.tokens) for s in seqs) + K
+        W = args.bucket_table_width(max_kv)
+
+        tokens = np.zeros((B, S), np.int32)
+        positions = np.zeros((B, S), np.int32)
+        slot_map = np.zeros((B, S), np.int32)
+        bt = np.full((B, W), NULL_BLOCK, np.int32)
+        kv_lens = np.zeros((B,), np.int32)
+        for i, s in enumerate(seqs):
+            row = [s.tokens[-1]] + drafts[i] + [0] * (K - len(drafts[i]))
+            base = len(s.tokens) - 1
+            tokens[i] = row
+            positions[i] = base + np.arange(S)
+            for j in range(S):
+                p = base + j
+                slot_map[i, j] = s.block_table[p // bs] * bs + p % bs
+            n = min(len(s.block_table), W)
+            bt[i, :n] = s.block_table[:n]
+            kv_lens[i] = len(s.tokens) + K
+
+        self._broadcast("verify", tokens=tokens, positions=positions,
+                        slot_map=slot_map, block_tables=bt, kv_lens=kv_lens)
+        ids, lps, self.k_cache, self.v_cache = self.verify_fn(
+            self.params, self._put_batch("tokens", tokens),
+            self._put_batch("positions", positions),
+            self._put_batch("slot_map", slot_map),
+            self._put_batch("block_tables", bt),
+            self._put_batch("kv_lens", kv_lens),
+            self.k_cache, self.v_cache)
+        ids, lps = await asyncio.to_thread(
+            lambda: (np.asarray(ids), np.asarray(lps)))
+
+        for i, s in enumerate(seqs):
+            d = drafts[i]
+            accepted = 0
+            while accepted < len(d) and d[accepted] == int(ids[i, accepted]):
+                accepted += 1
+            # emit accepted drafts + the corrected/bonus token; like the
+            # burst loop, each commit marks the CURRENT tokens' KV resident
+            # (the verify step computed it — accepted drafts equal the real
+            # tokens) before the next append
+            emitted = 0
+            for j in range(accepted + 1):
+                self.scheduler.commit_computed(s, len(s.tokens))
+                self._deliver(s, int(ids[i, j]), float(lps[i, j]))
+                emitted += 1
+                if s.finished is not None:
+                    break
+            # count what was actually DELIVERED — a seq finishing mid-burst
+            # must not inflate acceptance telemetry
+            self.spec_stats.num_drafts += 1
+            self.spec_stats.num_draft_tokens += len(d)
+            self.spec_stats.num_accepted_tokens += min(accepted, emitted)
+            self.spec_stats.num_spec_tokens += emitted
+        return True
+
     async def _run_decode(self, seqs: list[SeqState]) -> None:
+        if (self.verify_fn is not None and seqs
+                and not self.scheduler.waiting
+                and all(s.remaining == 1 for s in self.scheduler.running)
+                and all(s.sampling_tuple()[0] == 0.0 for s in seqs)
+                and all(s.req.output_options.logprobs is None for s in seqs)
+                # a seq one token from its limit gains nothing from a draft
+                and all((s.req.stop_conditions.max_tokens is None
+                         or s.req.stop_conditions.max_tokens - s.generated >= 2)
+                        for s in seqs)
+                and await self._run_spec_decode(seqs)):
+            return
         K = self.args.multi_step_decode
         if (self.multi_fn is not None and seqs
                 and not self.scheduler.waiting
@@ -655,22 +795,8 @@ class AsyncJaxEngine:
 
         args = self.args
         K = args.multi_step_decode
-        # the burst writes positions len-1 .. len+K-2 → len+K-1 slots.
-        # Preallocate all-or-nothing: a partial extension left behind would
-        # deepen the very memory pressure that made it fail.
-        extended: list = []
-        ok = True
-        for s in seqs:
-            before = len(s.block_table)
-            if not self.scheduler._ensure_blocks(s, len(s.tokens) + K - 1):
-                ok = False
-                break
-            if len(s.block_table) > before:
-                extended.append((s, before))
-        if not ok:
-            for s, before in extended:
-                self.pool.release(s.block_table[before:])
-                del s.block_table[before:]
+        # the burst writes positions len-1 .. len+K-2 → len+K-1 slots
+        if not self._prealloc_blocks(seqs, K - 1):
             return False
 
         B = args.bucket_batch(len(seqs))
@@ -990,6 +1116,8 @@ class AsyncJaxEngine:
                     sched.prefix_hit_tokens / sched.prefix_query_tokens
                     if sched.prefix_query_tokens else 0.0),
             ),
+            spec_decode_stats=(self.spec_stats
+                               if self.spec_stats.num_drafts else None),
         )
 
 

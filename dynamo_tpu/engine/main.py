@@ -62,8 +62,8 @@ async def amain():
                     help="HF checkpoint dir (config.json + safetensors); "
                          "omit for random weights (testing)")
     ap.add_argument("--arch", default=None,
-                    choices=[None, "tiny", "llama3_1b", "llama3_8b", "llama3_70b"],
-                    help="canned architecture when no --model-path")
+                    help="canned architecture preset when no --model-path "
+                         "(see dynamo_tpu.models.PRESETS)")
     ap.add_argument("--namespace", default="dynamo")
     ap.add_argument("--component", default=None,
                     help="default: backend / prefill by role")
@@ -92,6 +92,9 @@ async def amain():
     ap.add_argument("--num-ranks", type=int, default=1,
                     help="total DP fleet size (with --dp-rank)")
     ap.add_argument("--use-pallas-attention", action="store_true")
+    ap.add_argument("--speculative-tokens", type=int, default=0,
+                    help="prompt-lookup speculative decoding: draft up to N "
+                         "tokens per step (greedy-invariant)")
     ap.add_argument("--multi-step-decode", type=int, default=1,
                     help="decode steps fused per jitted call (token bursts)")
     ap.add_argument("--no-prefix-caching", action="store_true")
@@ -182,6 +185,7 @@ async def amain():
         tp_size=cli.tp_size, dp_size=cli.dp_size,
         use_pallas_attention=cli.use_pallas_attention,
         multi_step_decode=cli.multi_step_decode,
+        speculative_tokens=cli.speculative_tokens,
         kvbm_host_bytes=int(cli.kvbm_host_gb * (1 << 30)),
         kvbm_disk_dir=cli.kvbm_disk_dir,
         kvbm_disk_bytes=int(cli.kvbm_disk_gb * (1 << 30)),

@@ -677,7 +677,7 @@ def _flash_prefill_attn(q, kc, vc, lidx, block_tables, positions, kv_lens, *,
 def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             last_idx, k_cache, v_cache, *, cfg: ModelConfig, block_size: int,
             use_pallas: bool = False, use_flash_prefill: bool = False,
-            mesh: Optional[Mesh] = None):
+            mesh: Optional[Mesh] = None, all_logits: bool = False):
     """One engine step.
 
     Args:
@@ -856,12 +856,49 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
     (x, k_cache, v_cache) = carry
 
     x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    if all_logits:  # speculative verification reads every position
+        return (x @ head).astype(jnp.float32), k_cache, v_cache
     x_last = x[jnp.arange(B), last_idx]  # [B, D]
-    if cfg.tie_word_embeddings:
-        logits = x_last @ params["embed"].T
-    else:
-        logits = x_last @ params["lm_head"]
+    logits = x_last @ head
     return logits.astype(jnp.float32), k_cache, v_cache
+
+
+def verify_forward(params, tokens, positions, slot_map, block_tables,
+                   kv_lens, k_cache, v_cache, *, cfg: ModelConfig,
+                   block_size: int, mesh: Optional[Mesh] = None):
+    """Speculative-decode verification step: like ``forward`` over a chunk
+    of [last_token, draft...] but returns the GREEDY continuation at every
+    position — (argmax ids [B,S], their logprobs [B,S], caches). Draft KV is
+    scattered like any chunk; slots past the accepted prefix hold wrong-KV
+    garbage that the next real step overwrites (slot = f(position)), and
+    kv_lens caps what any later attention can read.
+
+    Only O(B·S) ids/logps cross to host instead of [B,S,V] logits — the
+    acceptance rule (greedy prefix match) needs nothing more."""
+    logits, k_cache, v_cache = forward(
+        params, tokens, positions, slot_map, block_tables, kv_lens,
+        jnp.zeros((tokens.shape[0],), jnp.int32), k_cache, v_cache,
+        cfg=cfg, block_size=block_size, mesh=mesh, all_logits=True)
+    lp = jax.nn.log_softmax(logits, axis=-1)  # [B,S,V] f32
+    ids = jnp.argmax(lp, axis=-1)
+    chosen = jnp.take_along_axis(lp, ids[..., None], axis=-1)[..., 0]
+    return ids.astype(jnp.int32), chosen, k_cache, v_cache
+
+
+def make_verify_fn(cfg: ModelConfig, block_size: int,
+                   mesh: Optional[Mesh] = None,
+                   replicate_outputs: bool = False):
+    """Jitted speculative verification with cache donation (args 6, 7)."""
+    f = functools.partial(verify_forward, cfg=cfg, block_size=block_size,
+                          mesh=mesh)
+    kw = {}
+    if replicate_outputs and mesh is not None:
+        rep = NamedSharding(mesh, P())
+        csh = cache_shardings(mesh, cfg)
+        kw["out_shardings"] = (rep, rep, csh, csh)
+    return jax.jit(f, donate_argnums=(6, 7), **kw)
 
 
 def embedding_forward(params, tokens, lengths, *, cfg: ModelConfig):

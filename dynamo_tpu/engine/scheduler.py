@@ -53,6 +53,11 @@ class SeqState:
     preemptions: int = 0
     #: disagg: keep KV blocks alive past finish (owner gathers then releases)
     hold_blocks: bool = False
+    #: speculative decoding: incrementally-built n-gram → end-position index
+    #: over ``tokens`` (engine._draft_tokens) — avoids O(n) history scans
+    #: per decode step
+    ngram_pos: dict = field(default_factory=dict)
+    ngram_indexed: int = 0
     #: disagg pipelining: called with (num_computed) after each prefill chunk
     #: commits — lets the owner ship finished blocks while later chunks run
     progress_cb: Optional[Callable] = None

@@ -48,6 +48,7 @@ STEP_KEYS = {
              "last_idx"),
     "multi": ("last_tokens", "positions", "block_tables", "kv_lens",
               "temp", "top_k", "top_p", "seeds", "step0"),
+    "verify": ("tokens", "positions", "slot_map", "block_tables", "kv_lens"),
 }
 
 
@@ -222,6 +223,11 @@ class StepFollower:
                 keys = STEP_KEYS[kind]
                 if kind == "step":
                     _, eng.k_cache, eng.v_cache = eng.step_fn(
+                        eng.params,
+                        *(eng._put_batch(k, a[k]) for k in keys),
+                        eng.k_cache, eng.v_cache)
+                elif kind == "verify":  # speculative verification
+                    _, _, eng.k_cache, eng.v_cache = eng.verify_fn(
                         eng.params,
                         *(eng._put_batch(k, a[k]) for k in keys),
                         eng.k_cache, eng.v_cache)

@@ -106,6 +106,7 @@ async def start_worker(runtime, out: str, cli):
         cfg = get_model_config(cli.arch)
         params = None
     eargs = EngineArgs(multi_step_decode=cli.multi_step_decode,
+                       speculative_tokens=cli.speculative_tokens,
                        use_pallas_attention=cli.use_pallas_attention)
     engine = AsyncJaxEngine(cfg, eargs, params=params)
     handler = DecodeWorkerHandler(engine)
@@ -242,6 +243,7 @@ async def amain():
     ap.add_argument("--router-mode", default="kv",
                     choices=["kv", "round_robin", "random"])
     ap.add_argument("--multi-step-decode", type=int, default=1)
+    ap.add_argument("--speculative-tokens", type=int, default=0)
     ap.add_argument("--use-pallas-attention", action="store_true")
     ap.add_argument("--vocab-size", type=int, default=0,
                     help="mocker vocab size (out=mocker only)")

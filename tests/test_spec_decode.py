@@ -1,0 +1,125 @@
+"""Prompt-lookup speculative decoding: greedy invariance + acceptance.
+
+The engine drafts tokens from the sequence's own history and verifies them
+in one forward (ref surface: SpecDecodeStats, kv_router/protocols.rs:48-84 —
+the reference delegates the mechanism to its engines; here it is native).
+The hard guarantee: greedy outputs are IDENTICAL with spec decode on or off.
+"""
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.protocols import (
+    OutputOptions, PreprocessedRequest, SamplingOptions, StopConditions,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+def make_engine(**kw) -> AsyncJaxEngine:
+    defaults = dict(block_size=4, num_blocks=128, max_num_seqs=4,
+                    max_num_batched_tokens=64, max_model_len=256,
+                    prefill_buckets=(8, 16, 32, 64),
+                    decode_batch_buckets=(1, 2, 4))
+    defaults.update(kw)
+    return AsyncJaxEngine(ModelConfig.tiny(), EngineArgs(**defaults))
+
+
+async def run(eng, prompt, max_tokens=16, temperature=0.0, logprobs=None):
+    req = PreprocessedRequest(
+        model="t", token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=temperature),
+        output_options=OutputOptions(logprobs=logprobs))
+    toks = []
+    async for out in eng.generate(req):
+        toks.extend(out.token_ids)
+    return toks
+
+
+def test_draft_tokens_prompt_lookup():
+    from types import SimpleNamespace
+
+    def d(tokens, k):
+        s = SimpleNamespace(tokens=tokens, ngram_pos={}, ngram_indexed=0)
+        return AsyncJaxEngine._draft_tokens(s, k)
+
+    # trailing [5,6] seen earlier → continuation [7,8,9]
+    assert d([1, 5, 6, 7, 8, 9, 2, 5, 6], 3) == [7, 8, 9]
+    # newest match wins
+    assert d([5, 6, 1, 5, 6, 2, 9, 5, 6], 2) == [2, 9]
+    # nothing repeats → no draft
+    assert d([1, 2, 3, 4, 5], 3) == []
+    assert d([7], 3) == []
+
+    # incremental: the index extends as the sequence grows, and the
+    # trailing gram never matches itself
+    s = SimpleNamespace(tokens=[1, 5, 6, 7], ngram_pos={}, ngram_indexed=0)
+    assert AsyncJaxEngine._draft_tokens(s, 2) == []
+    s.tokens = s.tokens + [2, 5, 6]
+    assert AsyncJaxEngine._draft_tokens(s, 2) == [7, 2]
+
+
+async def test_greedy_invariance_repetitive_prompt():
+    """A repetitive prompt gets drafts ACCEPTED — and the token stream must
+    equal plain greedy decode exactly."""
+    phrase = [11, 12, 13, 14, 15, 16]
+    prompt = phrase * 4  # heavy n-gram structure
+    plain = make_engine()
+    spec = make_engine(speculative_tokens=4)
+
+    want = await run(plain, prompt, max_tokens=20)
+    got = await run(spec, prompt, max_tokens=20)
+    assert got == want
+    assert spec.spec_stats.num_drafts > 0
+    assert spec.spec_stats.num_accepted_tokens > 0
+    # spec needed fewer dispatches than tokens (the point of the feature)
+    assert spec.spec_stats.num_spec_tokens > spec.spec_stats.num_drafts
+    await plain.close()
+    await spec.close()
+
+
+async def test_greedy_invariance_random_prompt():
+    """Non-repetitive prompts (drafts mostly rejected/absent) must also be
+    byte-identical — rejections may not corrupt the cache."""
+    prompt = [7, 91, 23, 151, 3, 88, 42, 199, 64, 5, 130, 77]
+    plain = make_engine()
+    spec = make_engine(speculative_tokens=4)
+    want = await run(plain, prompt, max_tokens=16)
+    got = await run(spec, prompt, max_tokens=16)
+    assert got == want
+    await plain.close()
+    await spec.close()
+
+
+async def test_spec_concurrent_batch_invariance():
+    """Multiple concurrent greedy streams under spec decode equal their
+    plain counterparts (batched verify, per-row acceptance)."""
+    import asyncio
+
+    prompts = [([21, 22, 23, 24] * 5)[:18],
+               ([31, 32, 33] * 6)[:17],
+               [2, 71, 5, 93, 11, 44, 8, 120]]
+    plain = make_engine()
+    spec = make_engine(speculative_tokens=3)
+    want = await asyncio.gather(*(run(plain, p, 12) for p in prompts))
+    got = await asyncio.gather(*(run(spec, p, 12) for p in prompts))
+    assert got == want
+    await plain.close()
+    await spec.close()
+
+
+async def test_spec_skipped_for_sampled_or_logprobs():
+    """Sampled requests and logprobs requests bypass the spec path (it is
+    greedy-only and carries no top-k capture)."""
+    spec = make_engine(speculative_tokens=4)
+    prompt = [11, 12, 13, 14] * 4
+    await run(spec, prompt, max_tokens=8, temperature=0.8)
+    assert spec.spec_stats.num_drafts == 0
+    await run(spec, prompt, max_tokens=8, logprobs=2)
+    assert spec.spec_stats.num_drafts == 0
+    # and a greedy run immediately after still engages it
+    await run(spec, prompt, max_tokens=8)
+    assert spec.spec_stats.num_drafts > 0
+    await spec.close()
