@@ -112,6 +112,12 @@ class ModelConfig:
         return self.qk_nope_head_dim + self.qk_rope_head_dim
 
     @property
+    def rope_cache_dim(self) -> int:
+        """MLA rope-part cache width: qk_rope_head_dim rounded up to a
+        128-lane multiple (TPU DMA tile alignment)."""
+        return -(-self.qk_rope_head_dim // 128) * 128
+
+    @property
     def moe_ffn_size(self) -> int:
         return self.moe_intermediate_size or self.intermediate_size
 
@@ -128,12 +134,14 @@ class ModelConfig:
 
         MHA/GQA: both caches hold [num_kv_heads, head_dim]. MLA stores the
         compressed latent instead — k_cache [1, kv_lora_rank] (normalized
-        c_kv) and v_cache [1, qk_rope_head_dim] (the shared post-RoPE k_rot)
-        — the memory win that makes DeepSeek-class models servable (ref
-        behavior delegated to engines; e.g. vLLM's MLA cache does the same).
+        c_kv) and v_cache [1, rope_pad] (the shared post-RoPE k_rot, zero-
+        padded to a 128-lane multiple so the Pallas decode kernel can DMA
+        cache pages tile-aligned) — the memory win that makes DeepSeek-class
+        models servable (ref behavior delegated to engines; e.g. vLLM's MLA
+        cache does the same).
         """
         if self.is_mla:
-            return ((1, self.kv_lora_rank), (1, self.qk_rope_head_dim))
+            return ((1, self.kv_lora_rank), (1, self.rope_cache_dim))
         return ((self.num_kv_heads, self.head_dim),
                 (self.num_kv_heads, self.head_dim))
 

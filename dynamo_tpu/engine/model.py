@@ -404,7 +404,8 @@ def _paged_attention(q, k_cache, v_cache, lidx, block_tables, positions,
 
 
 def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
-                   kv_lens, cfg: ModelConfig, block_size: int):
+                   kv_lens, cfg: ModelConfig, block_size: int,
+                   use_pallas: bool = False, mesh: Optional[Mesh] = None):
     """Multi-head latent attention (DeepSeek V2/V3) over the paged latent
     cache — the weight-ABSORBED formulation throughout.
 
@@ -433,6 +434,7 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
     q_nope, q_rot = q[..., :dn], q[..., dn:]
     q_rot = _rope(q_rot, positions, cfg.rope_theta, cfg.rope_scaling)
 
+    pr = cfg.rope_cache_dim  # rope part zero-padded to a lane multiple
     ckv = h @ lp["kv_a"]  # [B,S,r+dr]
     c = _rms_norm(ckv[..., :r], lp["kv_a_norm"], cfg.rms_norm_eps)
     k_rot = _rope(ckv[..., None, r:], positions, cfg.rope_theta,
@@ -440,29 +442,60 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
 
     flat = slot_map.reshape(B * S)
     kc = kc.at[lidx, flat].set(c.reshape(B * S, 1, r), mode="drop")
-    vc = vc.at[lidx, flat].set(k_rot.reshape(B * S, 1, dr), mode="drop")
-
-    W = block_tables.shape[1]
-    T = W * block_size
-    slot_idx = (block_tables[:, :, None] * block_size
-                + jnp.arange(block_size)[None, None, :]).reshape(B, T)
-    cg = kc[lidx, slot_idx][:, :, 0].astype(jnp.float32)   # [B,T,r]
-    krg = vc[lidx, slot_idx][:, :, 0].astype(jnp.float32)  # [B,T,dr]
+    vc = vc.at[lidx, flat].set(
+        jnp.pad(k_rot.reshape(B * S, 1, dr), ((0, 0), (0, 0), (0, pr - dr))),
+        mode="drop")
 
     w_uk = lp["w_uk"].reshape(r, H, dn).astype(jnp.float32)
     q_eff = jnp.einsum("bshd,rhd->bshr", q_nope.astype(jnp.float32), w_uk)
-    scores = (jnp.einsum("bshr,btr->bhst", q_eff, cg)
-              + jnp.einsum("bshd,btd->bhst", q_rot.astype(jnp.float32), krg))
-    scores = scores * mla_softmax_scale(cfg)
 
-    key_pos = jnp.arange(T)
-    mask = (key_pos[None, None, :] <= positions[:, :, None]) & (
-        key_pos[None, None, :] < kv_lens[:, None, None])  # [B,S,T]
-    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    o_lat = jnp.einsum("bhst,btr->bshr", probs, cg)
+    if use_pallas and S == 1:
+        # Pallas latent decode: pages stream HBM→VMEM once; output stays in
+        # latent space, W_UV expansion below is shared with the XLA path
+        from dynamo_tpu.ops.paged_attention import mla_paged_decode
+
+        L_, slots_, _, _ = kc.shape
+        nb = slots_ // block_size
+        scale = mla_softmax_scale(cfg)
+        qr_pad = jnp.pad(q_rot[:, 0].astype(jnp.float32),
+                         ((0, 0), (0, 0), (0, pr - dr)))
+
+        def run(qe1, qr1, kcf, vcf, lidx_, bt, lens):
+            return mla_paged_decode(
+                qe1, qr1, kcf.reshape(L_ * slots_, r),
+                vcf.reshape(L_ * slots_, pr), bt + lidx_ * nb, lens,
+                block_size=block_size, scale=scale)
+
+        if mesh is not None:  # heads on tp; latent cache is replicated
+            run = jax.shard_map(
+                run, mesh=mesh,
+                in_specs=(P("dp", "tp", None), P("dp", "tp", None),
+                          P(None, None, None, None), P(None, None, None, None),
+                          P(), P("dp", None), P("dp")),
+                out_specs=P("dp", "tp", None), check_vma=False)
+        o_lat = run(q_eff[:, 0], qr_pad, kc, vc, lidx, block_tables,
+                    kv_lens)[:, None]  # [B,1,H,r]
+    else:
+        W = block_tables.shape[1]
+        T = W * block_size
+        slot_idx = (block_tables[:, :, None] * block_size
+                    + jnp.arange(block_size)[None, None, :]).reshape(B, T)
+        cg = kc[lidx, slot_idx][:, :, 0].astype(jnp.float32)        # [B,T,r]
+        krg = vc[lidx, slot_idx][:, :, 0, :dr].astype(jnp.float32)  # [B,T,dr]
+
+        scores = (jnp.einsum("bshr,btr->bhst", q_eff, cg)
+                  + jnp.einsum("bshd,btd->bhst",
+                               q_rot.astype(jnp.float32), krg))
+        scores = scores * mla_softmax_scale(cfg)
+
+        key_pos = jnp.arange(T)
+        mask = (key_pos[None, None, :] <= positions[:, :, None]) & (
+            key_pos[None, None, :] < kv_lens[:, None, None])  # [B,S,T]
+        scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o_lat = jnp.einsum("bhst,btr->bshr", probs, cg)
     w_uv = lp["w_uv"].reshape(r, H, dv).astype(jnp.float32)
-    out = jnp.einsum("bshr,rhd->bshd", o_lat, w_uv)
+    out = jnp.einsum("bshr,rhd->bshd", o_lat.astype(jnp.float32), w_uv)
     return out.reshape(B, S, H * dv).astype(h.dtype), kc, vc
 
 
@@ -713,7 +746,9 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         if cfg.is_mla:
             attn_flat, kc, vc = _mla_attention(
                 h, lp, lidx, kc, vc, slot_map, block_tables, positions,
-                kv_lens, cfg, block_size)
+                kv_lens, cfg, block_size,
+                use_pallas=use_pallas and (mesh is None or B % mesh.shape.get(
+                    "dp", 1) == 0), mesh=mesh)
             x = x + attn_flat @ lp["wo"]
             return _mlp_epilogue(x, kc, vc, lp, moe)
         q = h @ lp["wq"]
@@ -1017,8 +1052,13 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
     """
     from dynamo_tpu.ops.paged_attention import pallas_supported
 
-    if cfg.is_mla:  # MLA attends in latent space — its own XLA path for now
-        return False, False
+    if cfg.is_mla:  # latent-space attention: its own Pallas decode kernel
+        from dynamo_tpu.ops.paged_attention import mla_pallas_supported
+
+        tp_ = mesh.shape.get("tp", 1) if mesh is not None else 1
+        return (use_pallas and cfg.num_heads % tp_ == 0
+                and mla_pallas_supported(cfg.kv_lora_rank,
+                                         cfg.rope_cache_dim)), False
     if cfg.layer_windows is not None or cfg.attention_sinks:
         return False, False  # gpt-oss attention variants: XLA path for now
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1

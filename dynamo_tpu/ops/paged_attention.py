@@ -197,3 +197,140 @@ def paged_attention_decode_xla(q, k_cache, v_cache, block_tables, kv_lens, *,
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bkgt,btkd->bkgd", p, v.astype(jnp.float32))
     return o.reshape(B, H, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------- MLA decode
+
+def _mla_decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
+                       qe_ref,  # [1, H, R] VMEM (scale folded in)
+                       qr_ref,  # [1, H, PR] VMEM
+                       ccache_ref, rcache_ref,  # [slots, R] / [slots, PR] HBM
+                       out_ref,  # [1, H, R] VMEM
+                       cbuf, rbuf, dma_sem,  # [D, bs, R] / [D, bs, PR] / [D,2]
+                       *, bs: int):
+    """MLA is simpler than GQA here: every head attends over the SAME single
+    latent page, so no block-expansion trick is needed — scores are
+    q_eff·c + q_rot·rope (both lane-aligned MXU matmuls) and the VALUE is
+    the latent itself; W_UV absorption happens outside."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    kv_len = kv_lens_ref[b]
+    num_pages = (kv_len + bs - 1) // bs
+    H, R = qe_ref.shape[1], qe_ref.shape[2]
+    D = cbuf.shape[0]
+
+    def start_dma(w):
+        blk = block_tables_ref[b, w]
+        slot = w % D
+        pltpu.make_async_copy(
+            ccache_ref.at[pl.ds(blk * bs, bs)], cbuf.at[slot],
+            dma_sem.at[slot, 0]).start()
+        pltpu.make_async_copy(
+            rcache_ref.at[pl.ds(blk * bs, bs)], rbuf.at[slot],
+            dma_sem.at[slot, 1]).start()
+
+    def wait_dma(w):
+        slot = w % D
+        pltpu.make_async_copy(cbuf.at[slot], cbuf.at[slot],
+                              dma_sem.at[slot, 0]).wait()
+        pltpu.make_async_copy(rbuf.at[slot], rbuf.at[slot],
+                              dma_sem.at[slot, 1]).wait()
+
+    prefill_n = jnp.minimum(num_pages, D)
+    jax.lax.fori_loop(0, prefill_n, lambda w, c: (start_dma(w), c)[1], 0)
+
+    qe = qe_ref[0].astype(jnp.float32)  # [H, R]
+    qr = qr_ref[0].astype(jnp.float32)  # [H, PR]
+
+    def body(w, carry):
+        m, l, acc = carry
+        wait_dma(w)
+        cpage = cbuf[w % D].astype(jnp.float32)  # [bs, R]
+        rpage = rbuf[w % D].astype(jnp.float32)  # [bs, PR]
+
+        s = jax.lax.dot_general(
+            qe, cpage, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [H, bs]
+        s = s + jax.lax.dot_general(
+            qr, rpage, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        key_pos = w * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s = jnp.where(key_pos < kv_len, s, _NEG)
+
+        chunk_max = jnp.max(s, axis=1, keepdims=True)
+        new_m = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+        new_l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, cpage, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [H, R] — value IS the latent
+
+        @pl.when(w + D < num_pages)
+        def _():
+            start_dma(w + D)
+
+        return new_m, new_l, acc * corr + pv
+
+    m0 = jnp.full((H, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((H, 1), jnp.float32)
+    acc0 = jnp.zeros((H, R), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+    out_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+
+
+def mla_pallas_supported(kv_lora_rank: int, rope_cache_dim: int) -> bool:
+    return kv_lora_rank % _LANE == 0 and rope_cache_dim % _LANE == 0
+
+
+def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
+                     kv_lens, *, block_size: int, scale: float,
+                     interpret: bool = False):
+    """MLA decode over the paged latent cache.
+
+    q_eff [B,H,R] (queries absorbed through W_UK), q_rot [B,H,PR] (post-rope
+    part, zero-padded to the cache's lane-aligned PR), latent_cache
+    [slots,R], rope_cache [slots,PR] → attention output IN LATENT SPACE
+    [B,H,R] (caller expands through W_UV). ``scale`` is the softmax scale
+    (incl. YaRN mscale² — engine/model.mla_softmax_scale), folded into the
+    queries here.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, R = q_eff.shape
+    PR = q_rot.shape[-1]
+    bs = block_size
+    interpret = interpret or jax.default_backend() != "tpu"
+
+    qe = (q_eff.astype(jnp.float32) * scale).astype(q_eff.dtype)
+    qr = (q_rot.astype(jnp.float32) * scale).astype(q_rot.dtype)
+
+    W = block_tables.shape[1]
+    D = min(W, 8)  # VMEM: D·bs·(R+PR)·dtype bytes in flight
+    kernel = functools.partial(_mla_decode_kernel, bs=bs)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, R), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, H, PR), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+        ],
+        out_specs=pl.BlockSpec((1, H, R), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((D, bs, R), latent_cache.dtype),
+            pltpu.VMEM((D, bs, PR), rope_cache.dtype),
+            pltpu.SemaphoreType.DMA((D, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, R), q_eff.dtype),
+        interpret=interpret,
+    )(block_tables, kv_lens, qe, qr, latent_cache, rope_cache)

@@ -107,7 +107,7 @@ def test_mla_logits_parity_vs_hf(hf_checkpoint):
      num_blocks) = _paged_inputs(cfg, rows)
     kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
     assert kc.shape[-2:] == (1, cfg.kv_lora_rank)
-    assert vc.shape[-2:] == (1, cfg.qk_rope_head_dim)
+    assert vc.shape[-2:] == (1, cfg.rope_cache_dim)  # rope lane-padded
 
     logits, kc, vc = forward(params, tokens, positions, slot_map, bt,
                              kv_lens, last_idx, kc, vc, cfg=cfg, block_size=4)
@@ -196,4 +196,87 @@ def test_deepseek_presets_resolve():
     assert v3.is_mla and v3.num_experts == 256 and v3.first_k_dense_replace == 3
     lite = get_model_config("deepseek_v2_lite")
     assert lite.is_mla and lite.q_lora_rank is None
-    assert lite.kv_cache_spec == ((1, 512), (1, 64))
+    assert lite.kv_cache_spec == ((1, 512), (1, 128))  # rope 64 lane-padded
+
+
+def test_mla_pallas_decode_matches_xla():
+    """The Pallas latent-decode kernel (interpret mode on CPU) must equal
+    the XLA gather path bit-for-bit-ish on a lane-aligned config."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.cache import allocate_device_cache
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.model import forward, init_params
+    from dynamo_tpu.ops.paged_attention import mla_pallas_supported
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=4, dtype="float32",
+        max_position_embeddings=256,
+        kv_lora_rank=128, q_lora_rank=None, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16)
+    assert mla_pallas_supported(cfg.kv_lora_rank, cfg.rope_cache_dim)
+    params = init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+
+    # prefill 9 tokens (XLA path), then one decode step both ways
+    row = [5, 9, 17, 23, 42, 77, 101, 3, 54]
+    (tokens, positions, slot_map, bt, kv_lens, last_idx,
+     num_blocks) = _paged_inputs(cfg, [row])
+    caches = {}
+    for name in ("xla", "pallas"):
+        kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
+        _, kc, vc = forward(params, tokens, positions, slot_map, bt, kv_lens,
+                            last_idx, kc, vc, cfg=cfg, block_size=4)
+        caches[name] = (kc, vc)
+
+    tok = jnp.asarray([[61]], jnp.int32)
+    pos = jnp.asarray([[9]], jnp.int32)
+    slot = jnp.asarray([[int(bt[0, 2]) * 4 + 1]], jnp.int32)
+    lens = jnp.asarray([10], jnp.int32)
+    li = jnp.asarray([0], jnp.int32)
+    outs = {}
+    for name, up in (("xla", False), ("pallas", True)):
+        kc, vc = caches[name]
+        logits, _, _ = forward(params, tok, pos, slot, bt, lens, li, kc, vc,
+                               cfg=cfg, block_size=4, use_pallas=up)
+        outs[name] = np.asarray(logits)
+    np.testing.assert_allclose(outs["pallas"], outs["xla"],
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_mla_pallas_decode_sharded():
+    """Pallas latent decode through shard_map on a dp×tp mesh equals the
+    unsharded XLA result (heads shard on tp, latent cache replicated)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.cache import allocate_device_cache
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.model import forward, init_params, param_shardings
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=4, dtype="float32",
+        max_position_embeddings=256,
+        kv_lora_rank=128, q_lora_rank=None, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16)
+    params = init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+
+    row = [5, 9, 17, 23, 42, 77, 101, 3]
+    (tokens, positions, slot_map, bt, kv_lens, last_idx,
+     num_blocks) = _paged_inputs(cfg, [row, [int(x) + 1 for x in row]])
+    kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
+    want, _, _ = forward(params, tokens, positions, slot_map, bt, kv_lens,
+                         last_idx, kc, vc, cfg=cfg, block_size=4)
+
+    mesh = make_mesh(MeshConfig(dp=2, sp=1, tp=2))
+    sparams = jax.device_put(params, param_shardings(cfg, mesh))
+    kc2, vc2 = allocate_device_cache(cfg, num_blocks, 4, mesh=mesh,
+                                     dtype=jnp.float32)
+    got, _, _ = forward(sparams, tokens, positions, slot_map, bt, kv_lens,
+                        last_idx, kc2, vc2, cfg=cfg, block_size=4,
+                        use_pallas=True, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
