@@ -681,13 +681,15 @@ def _shard_specs():
         bt=P("dp", None), lens=P("dp"), pos=P("dp", None), scalar=P())
 
 
-def _pallas_decode_attn(q1, kc, vc, lidx, block_tables, kv_lens, *,
-                        block_size: int):
+def _pallas_decode_attn(q1, kc, vc, lidx, block_tables, kv_lens, window,
+                        sinks, *, block_size: int, has_sink: bool):
     """Decode Pallas kernel over the FULL stacked cache (per-shard local).
 
     q1 [B,H,hd]; kc/vc [L,slots,KV,hd]. Blocks are addressed in the
     flattened [L·slots] view with ids offset into layer ``lidx`` — slicing
-    kc[lidx] would materialize a whole layer's cache per step.
+    kc[lidx] would materialize a whole layer's cache per step. ``window``
+    is a (possibly per-layer traced) scalar, 0 = full attention; ``sinks``
+    [H] are gpt-oss attention-sink logits (ignored unless has_sink).
     """
     from dynamo_tpu.ops.paged_attention import paged_attention_decode
 
@@ -695,7 +697,8 @@ def _pallas_decode_attn(q1, kc, vc, lidx, block_tables, kv_lens, *,
     nb = slots_ // block_size
     return paged_attention_decode(
         q1, kc.reshape(L_ * slots_, KV, hd), vc.reshape(L_ * slots_, KV, hd),
-        block_tables + lidx * nb, kv_lens, block_size=block_size)
+        block_tables + lidx * nb, kv_lens, block_size=block_size,
+        window=window, sinks=sinks if has_sink else None)
 
 
 def _flash_prefill_attn(q, kc, vc, lidx, block_tables, positions, kv_lens, *,
@@ -743,12 +746,17 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         x, kc, vc = carry
         lp, lidx = xs
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        dp_ok = mesh is None or B % mesh.shape.get("dp", 1) == 0
         if cfg.is_mla:
+            if use_pallas and not dp_ok and S == 1:
+                _logger.warning(
+                    "MLA Pallas decode bypassed: batch %d not divisible by "
+                    "dp=%d — XLA path for this bucket", B,
+                    mesh.shape.get("dp", 1))
             attn_flat, kc, vc = _mla_attention(
                 h, lp, lidx, kc, vc, slot_map, block_tables, positions,
                 kv_lens, cfg, block_size,
-                use_pallas=use_pallas and (mesh is None or B % mesh.shape.get(
-                    "dp", 1) == 0), mesh=mesh)
+                use_pallas=use_pallas and dp_ok, mesh=mesh)
             x = x + attn_flat @ lp["wo"]
             return _mlp_epilogue(x, kc, vc, lp, moe)
         q = h @ lp["wq"]
@@ -768,11 +776,11 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         kc = kc.at[lidx, flat_slots].set(k.reshape(B * S, KV, hd), mode="drop")
         vc = vc.at[lidx, flat_slots].set(v.reshape(B * S, KV, hd), mode="drop")
 
-        # shard_map needs the (static) batch divisible by the dp axis;
-        # otherwise fall through to the XLA path, which GSPMD shards freely.
-        # This fires at trace time (per shape bucket), so warn loudly — a
-        # silently-bypassed kernel is a silent TTFT/HBM regression.
-        dp_ok = mesh is None or B % mesh.shape.get("dp", 1) == 0
+        # shard_map needs the (static) batch divisible by the dp axis
+        # (dp_ok computed above, shared with the MLA branch); otherwise fall
+        # through to the XLA path, which GSPMD shards freely. This fires at
+        # trace time (per shape bucket), so warn loudly — a silently-
+        # bypassed kernel is a silent TTFT/HBM regression.
         if not dp_ok and (use_pallas if S == 1 else use_flash_prefill):
             _logger.warning(
                 "Pallas %s kernel bypassed: batch %d not divisible by dp=%d "
@@ -816,18 +824,28 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                 out_specs=P("dp", "sp", "tp", None), check_vma=False)
             attn = fn(q, kc, vc, lidx, bt_ring, positions, kv_lens)
         elif use_pallas and S == 1 and dp_ok:
-            # decode fast path: Pallas kernel streams pages HBM→VMEM once.
+            # decode fast path: Pallas kernel streams pages HBM→VMEM once
+            # (sliding-window layers skip out-of-window pages entirely).
             # Under a mesh the kernel runs per-shard via shard_map (heads on
             # "tp", batch on "dp" — attention is head- and batch-local, so no
             # collectives are needed).
-            fn = functools.partial(_pallas_decode_attn, block_size=block_size)
+            if cfg.layer_windows is not None:
+                window = jnp.asarray(cfg.layer_windows, jnp.int32)[lidx]
+            else:
+                window = jnp.asarray(cfg.sliding_window or 0, jnp.int32)
+            sinks = lp.get("sink", jnp.zeros((q.shape[2],), q.dtype))
+            fn = functools.partial(_pallas_decode_attn,
+                                   block_size=block_size,
+                                   has_sink="sink" in lp)
             if mesh is not None:
                 fn = jax.shard_map(
                     fn, mesh=mesh,
                     in_specs=(P("dp", "tp", None), sp["cache"], sp["cache"],
-                              sp["scalar"], sp["bt"], sp["lens"]),
+                              sp["scalar"], sp["bt"], sp["lens"],
+                              sp["scalar"], P("tp")),
                     out_specs=P("dp", "tp", None), check_vma=False)
-            attn = fn(q[:, 0], kc, vc, lidx, block_tables, kv_lens)[:, None]
+            attn = fn(q[:, 0], kc, vc, lidx, block_tables, kv_lens,
+                      window, sinks)[:, None]
         elif use_flash_prefill and S > 1 and dp_ok:
             # prefill fast path: flash kernel, no O(S·T) HBM score tensor
             fn = functools.partial(_flash_prefill_attn, block_size=block_size,
@@ -1059,14 +1077,15 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
         return (use_pallas and cfg.num_heads % tp_ == 0
                 and mla_pallas_supported(cfg.kv_lora_rank,
                                          cfg.rope_cache_dim)), False
-    if cfg.layer_windows is not None or cfg.attention_sinks:
-        return False, False  # gpt-oss attention variants: XLA path for now
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     heads_ok = (cfg.num_kv_heads % tp == 0 and cfg.num_heads % tp == 0
                 and cfg.num_heads % cfg.num_kv_heads == 0)
+    # decode kernel handles sliding windows (incl. per-layer) and sinks;
+    # the flash PREFILL kernel does not cover the gpt-oss variants yet
     decode_pallas = (use_pallas and heads_ok
-                     and cfg.sliding_window is None  # decode kernel lacks window
                      and pallas_supported(cfg.num_kv_heads // tp, cfg.head_dim))
+    if cfg.layer_windows is not None or cfg.attention_sinks:
+        return decode_pallas, False
     if use_flash_prefill is None:  # auto: on-TPU, or wherever pallas is asked
         use_flash_prefill = use_pallas or jax.default_backend() == "tpu"
     prefill_flash = (bool(use_flash_prefill) and heads_ok

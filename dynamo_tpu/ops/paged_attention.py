@@ -38,18 +38,25 @@ _NEG = -1e30
 _LANE = 128
 
 
-def _decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
+def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
                    qexp_ref,  # [1, H, KVhd] VMEM
+                   sink_ref,  # [1, H, 1] VMEM (zeros when has_sink=False)
                    kcache_ref, vcache_ref,  # [slots, KVhd] HBM
                    out_ref,  # [1, H, KVhd] VMEM
                    kbuf, vbuf, dma_sem,  # scratch [D, bs, KVhd] / [D, 2]
-                   *, bs: int):
+                   *, bs: int, has_sink: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b = pl.program_id(0)
     kv_len = kv_lens_ref[b]
     num_pages = (kv_len + bs - 1) // bs
+    # sliding window (gpt-oss/mistral): pages entirely outside the window
+    # are never fetched — a 128-token window reads 1-2 pages regardless of
+    # context length. window<=0 means full attention.
+    win = window_ref[0]
+    first_key = jnp.where(win > 0, jnp.maximum(kv_len - win, 0), 0)
+    start_page = first_key // bs
     H = qexp_ref.shape[1]
     KVhd = qexp_ref.shape[2]
 
@@ -75,8 +82,9 @@ def _decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
     # D-deep rotating pipeline — scattered pages are independent, so keeping
     # D fetches in flight hides per-DMA grant latency (a 2-deep double
     # buffer serializes W·B small copies on that latency).
-    prefill_n = jnp.minimum(num_pages, D)
-    jax.lax.fori_loop(0, prefill_n, lambda w, c: (start_dma(w), c)[1], 0)
+    prefill_n = jnp.minimum(num_pages, start_page + D)
+    jax.lax.fori_loop(start_page, prefill_n,
+                      lambda w, c: (start_dma(w), c)[1], 0)
 
     qexp = qexp_ref[0].astype(jnp.float32)  # [H, KVhd], block-expanded
 
@@ -92,7 +100,7 @@ def _decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
             preferred_element_type=jnp.float32)  # [H, bs]
 
         key_pos = w * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
-        s = jnp.where(key_pos < kv_len, s, _NEG)
+        s = jnp.where((key_pos < kv_len) & (key_pos >= first_key), s, _NEG)
 
         chunk_max = jnp.max(s, axis=1, keepdims=True)
         new_m = jnp.maximum(m, chunk_max)
@@ -111,10 +119,17 @@ def _decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
 
         return new_m, new_l, acc * corr + pv
 
-    m0 = jnp.full((H, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((H, 1), jnp.float32)
+    if has_sink:
+        # gpt-oss attention sink: an extra softmax slot with zero value
+        # contribution — seed the online softmax with it (m=sink, l=1)
+        m0 = sink_ref[0].astype(jnp.float32)  # [H, 1]
+        l0 = jnp.ones((H, 1), jnp.float32)
+    else:
+        m0 = jnp.full((H, 1), _NEG, jnp.float32)
+        l0 = jnp.zeros((H, 1), jnp.float32)
     acc0 = jnp.zeros((H, KVhd), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(start_page, num_pages, body,
+                                  (m0, l0, acc0))
 
     out_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
 
@@ -124,8 +139,15 @@ def pallas_supported(num_kv_heads: int, head_dim: int) -> bool:
 
 
 def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
-                           block_size: int, interpret: bool = False):
-    """Decode-step paged attention. See module docstring for the contract."""
+                           block_size: int, interpret: bool = False,
+                           window=None, sinks=None):
+    """Decode-step paged attention. See module docstring for the contract.
+
+    ``window``: sliding-window size as a (possibly traced per-layer) scalar
+    — 0/None = full attention; pages outside the window are never fetched.
+    ``sinks``: optional per-head attention-sink logits [H] (gpt-oss),
+    seeded into the online softmax with zero value contribution.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -136,8 +158,14 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
     bs = block_size
     if not pallas_supported(KV, hd):
         return paged_attention_decode_xla(
-            q, k_cache, v_cache, block_tables, kv_lens, block_size=bs)
+            q, k_cache, v_cache, block_tables, kv_lens, block_size=bs,
+            window=window, sinks=sinks)
     interpret = interpret or jax.default_backend() != "tpu"
+    has_sink = sinks is not None
+    win_arr = jnp.asarray([0 if window is None else window],
+                          jnp.int32).reshape(1)
+    sink_in = (jnp.zeros((1, H, 1), q.dtype) if not has_sink
+               else sinks.reshape(1, H, 1).astype(q.dtype))
 
     # block-expand q: head h's vector sits in its own KV segment, zeros else
     seg = jnp.arange(H) // G  # [H]
@@ -147,12 +175,13 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
 
     W = block_tables.shape[1]
     D = min(W, 16)  # pipeline depth (VMEM budget: 2·D·bs·KVhd·dtype bytes)
-    kernel = functools.partial(_decode_kernel, bs=bs)
+    kernel = functools.partial(_decode_kernel, bs=bs, has_sink=has_sink)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, H, KVhd), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, H, 1), lambda b, *_: (0, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.HBM),
             pl.BlockSpec(memory_space=pltpu.HBM),
         ],
@@ -168,8 +197,9 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, KVhd), q.dtype),
         interpret=interpret,
-    )(block_tables, kv_lens,
-      qexp, k_cache.reshape(slots, KVhd), v_cache.reshape(slots, KVhd))
+    )(block_tables, kv_lens, win_arr,
+      qexp, sink_in, k_cache.reshape(slots, KVhd),
+      v_cache.reshape(slots, KVhd))
 
     # pick each head's own KV segment back out
     out_full = out_full.reshape(B, H, KV, hd)
@@ -178,8 +208,10 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
 
 
 def paged_attention_decode_xla(q, k_cache, v_cache, block_tables, kv_lens, *,
-                               block_size: int):
-    """Reference/fallback path (same math, gather through XLA)."""
+                               block_size: int, window=None, sinks=None):
+    """Reference/fallback path (same math, gather through XLA) — honors the
+    same window/sink contract as the kernel, so a shape-based fallback can
+    never silently change attention semantics."""
     B, H, hd = q.shape
     KV = k_cache.shape[1]
     G = H // KV
@@ -192,9 +224,19 @@ def paged_attention_decode_xla(q, k_cache, v_cache, block_tables, kv_lens, *,
     v = v_cache[slot_idx]
     qg = q.reshape(B, KV, G, hd).astype(jnp.float32)
     s = jnp.einsum("bkgd,btkd->bkgt", qg, k.astype(jnp.float32)) / np.sqrt(hd)
-    mask = jnp.arange(T)[None] < kv_lens[:, None]  # [B, T]
+    key_pos = jnp.arange(T)
+    mask = key_pos[None] < kv_lens[:, None]  # [B, T]
+    if window is not None:
+        win = jnp.asarray(window)
+        mask = mask & ((win <= 0) | (key_pos[None] >= kv_lens[:, None] - win))
     s = jnp.where(mask[:, None, None], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
+    if sinks is not None:  # combined softmax, sink slot contributes no value
+        sk = sinks.astype(jnp.float32).reshape(KV, G)[None, :, :, None]
+        m = jnp.maximum(s.max(-1), sk[..., 0])[..., None]
+        e = jnp.exp(s - m)
+        p = e / (e.sum(-1, keepdims=True) + jnp.exp(sk - m))
+    else:
+        p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bkgt,btkd->bkgd", p, v.astype(jnp.float32))
     return o.reshape(B, H, hd).astype(q.dtype)
 
@@ -258,7 +300,7 @@ def _mla_decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
             preferred_element_type=jnp.float32)
 
         key_pos = w * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
-        s = jnp.where(key_pos < kv_len, s, _NEG)
+        s = jnp.where(key_pos < kv_len, s, _NEG)  # MLA: full attention
 
         chunk_max = jnp.max(s, axis=1, keepdims=True)
         new_m = jnp.maximum(m, chunk_max)
