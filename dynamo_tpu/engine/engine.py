@@ -726,6 +726,7 @@ class AsyncJaxEngine:
                 and all(s.remaining == 1 for s in self.scheduler.running)
                 and all(s.sampling_tuple()[0] == 0.0 for s in seqs)
                 and all(s.req.output_options.logprobs is None for s in seqs)
+                and all(not s.req.sampling_options.logit_bias for s in seqs)
                 # a seq one token from its limit gains nothing from a draft
                 and all((s.req.stop_conditions.max_tokens is None
                          or s.req.stop_conditions.max_tokens - s.generated >= 2)
@@ -736,9 +737,11 @@ class AsyncJaxEngine:
         if (self.multi_fn is not None and seqs
                 and not self.scheduler.waiting
                 and all(s.remaining == 1 for s in self.scheduler.running)
-                # top-k capture needs the logits: burst keeps them on
-                # device, so logprobs requests take the single-step path
+                # top-k capture and logit_bias need host-visible logits:
+                # the burst keeps them on device, so those requests take
+                # the single-step path
                 and all(s.req.output_options.logprobs is None for s in seqs)
+                and all(not s.req.sampling_options.logit_bias for s in seqs)
                 # don't burn a burst when a seq is about to hit max_tokens —
                 # the overshoot steps would be computed and discarded
                 and all((s.req.stop_conditions.max_tokens is None
@@ -896,6 +899,18 @@ class AsyncJaxEngine:
         steps += [0] * (B - len(seqs))
         keys = self._sampling.make_keys(seeds, steps)
 
+        # OpenAI logit_bias: sparse (row, token, value) triples — at most
+        # 300 entries per request, never a dense [B, V] materialization
+        b_rows, b_cols, b_vals = [], [], []
+        V = logits.shape[-1]
+        for i, s in enumerate(seqs):
+            for tid, v in (s.req.sampling_options.logit_bias or {}).items():
+                t = int(tid)
+                if 0 <= t < V:
+                    b_rows.append(i)
+                    b_cols.append(t)
+                    b_vals.append(v)
+
         def run_sampling():
             # runs in a worker thread: the host sync below must NEVER block
             # the event loop — under multi-host it waits on a collective the
@@ -906,7 +921,17 @@ class AsyncJaxEngine:
                 # logits are fully replicated (make_step_fn): round-trip
                 # through host so sampling is a LOCAL computation — a global
                 # op here would have to be mirrored by every follower rank
+                # (this includes the bias add below: numpy, never a device
+                # op on the global array)
                 lg = np.asarray(lg)
+                if b_rows:
+                    lg = lg.copy()
+                    np.add.at(lg, (b_rows, b_cols), b_vals)
+            elif b_rows:  # single-host: a tiny device scatter-add
+                import jax.numpy as jnp
+
+                lg = lg.at[jnp.asarray(b_rows), jnp.asarray(b_cols)].add(
+                    jnp.asarray(b_vals, lg.dtype))
             toks, logps = self._sampling.sample_jit(lg, temp, top_k, top_p,
                                                     keys)
             top_res = None

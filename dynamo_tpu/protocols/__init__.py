@@ -62,6 +62,10 @@ class SamplingOptions:
     presence_penalty: Optional[float] = None
     frequency_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None
+    #: OpenAI logit_bias: token-id (stringified on the wire) → additive
+    #: bias in [-100, 100] applied to logits before sampling — the logits
+    #: processing surface (ref: bindings py-src logits processing API)
+    logit_bias: Optional[dict] = None
 
 
 @dataclass

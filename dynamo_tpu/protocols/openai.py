@@ -100,8 +100,25 @@ def _parse_common(body: dict, req: ParsedRequest) -> ParsedRequest:
     if top_p is not None and not (0.0 < float(top_p) <= 1.0):
         raise RequestError("'top_p' must be in (0, 1]")
 
+    logit_bias = body.get("logit_bias")
+    if logit_bias is not None:
+        if not isinstance(logit_bias, dict):
+            raise RequestError("'logit_bias' must be an object")
+        try:  # keys stay STRINGS end-to-end (the wire codec rejects int
+            # map keys); the engine converts at application time
+            logit_bias = {str(int(k)): float(v)
+                          for k, v in logit_bias.items()}
+        except (TypeError, ValueError):
+            raise RequestError(
+                "'logit_bias' keys must be token ids, values numbers")
+        if any(not -100.0 <= v <= 100.0 for v in logit_bias.values()):
+            raise RequestError("'logit_bias' values must be in [-100, 100]")
+        if len(logit_bias) > 300:
+            raise RequestError("'logit_bias' supports at most 300 tokens")
+
     nvext = body.get("nvext") or {}
     req.sampling = SamplingOptions(
+        logit_bias=logit_bias,
         n=req.n,
         temperature=None if temperature is None else float(temperature),
         top_p=None if top_p is None else float(top_p),

@@ -382,3 +382,32 @@ async def test_preemption_never_evicts_planned_decode():
     results = await asyncio.gather(*(run(i) for i in range(4)))
     assert all(len(r) == 8 for r in results)
     await eng.close()
+
+
+async def test_logit_bias_steers_and_bans():
+    """OpenAI logit_bias: +100 forces a token, -100 bans it — applied in
+    the engine sampler pre-sampling (the logits-processing surface)."""
+    from dynamo_tpu.protocols import SamplingOptions
+
+    eng = tiny_engine()
+    prompt = list(range(1, 16))
+
+    async def run(bias):
+        r = PreprocessedRequest(
+            model="tiny", token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0,
+                                             logit_bias=bias))
+        toks = []
+        async for out in eng.generate(r):
+            toks.extend(out.token_ids)
+        return toks
+
+    plain = await run(None)
+    forced = await run({"37": 100.0})
+    assert forced == [37, 37, 37, 37]
+    banned = await run({str(plain[0]): -100.0})
+    assert banned[0] != plain[0]
+    # bias-free requests afterwards are unaffected
+    assert await run(None) == plain
+    await eng.close()

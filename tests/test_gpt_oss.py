@@ -247,83 +247,58 @@ def test_yarn_truncate_false_matches_hf():
     assert abs(scale - hf_scale) < 1e-6
 
 
-def test_gpt_oss_pallas_decode_matches_xla():
-    """Decode kernel with per-layer windows + attention sinks (interpret
-    mode) must equal the XLA path — including a sequence long enough that
-    the sliding layer SKIPS out-of-window pages."""
+def _decode_kernel_parity(cfg, seed):
+    """Prefill via XLA, then one decode step kernel-vs-XLA on cfg."""
     import jax
     import jax.numpy as jnp
 
     from dynamo_tpu.engine.cache import allocate_device_cache
-    from dynamo_tpu.engine.config import ModelConfig
     from dynamo_tpu.engine.model import forward, init_params
 
-    cfg = ModelConfig(
+    params = init_params(cfg, jax.random.key(seed), dtype=jnp.float32)
+    row = list(range(3, 25))  # 22 tokens >> window 8 (page skipping)
+    (tokens, positions, slot_map, bt, kv_lens, last_idx,
+     num_blocks) = _paged_inputs([row])
+    caches = {}
+    for name in ("xla", "pallas"):
+        kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
+        _, kc, vc = forward(params, tokens, positions, slot_map, bt, kv_lens,
+                            last_idx, kc, vc, cfg=cfg, block_size=4)
+        caches[name] = (kc, vc)
+    tok = jnp.asarray([[61]], jnp.int32)
+    pos = jnp.asarray([[22]], jnp.int32)
+    slot = jnp.asarray([[int(bt[0, 5]) * 4 + 2]], jnp.int32)
+    lens = jnp.asarray([23], jnp.int32)
+    li = jnp.asarray([0], jnp.int32)
+    outs = {}
+    for name, up in (("xla", False), ("pallas", True)):
+        kc, vc = caches[name]
+        logits, _, _ = forward(params, tok, pos, slot, bt, lens, li, kc, vc,
+                               cfg=cfg, block_size=4, use_pallas=up)
+        outs[name] = np.asarray(logits)
+    np.testing.assert_allclose(outs["pallas"], outs["xla"],
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_gpt_oss_pallas_decode_matches_xla():
+    """Decode kernel with per-layer windows + attention sinks (interpret
+    mode) must equal the XLA path — including page SKIPPING on the sliding
+    layer."""
+    from dynamo_tpu.engine.config import ModelConfig
+
+    _decode_kernel_parity(ModelConfig(
         vocab_size=128, hidden_size=128, intermediate_size=96, num_layers=2,
         num_heads=4, num_kv_heads=2, head_dim=64, dtype="float32",
         max_position_embeddings=256,
         qkv_bias=True, o_bias=True, attention_sinks=True,
-        layer_windows=(8, 0))  # KV*hd = 128: kernel-supported
-    params = init_params(cfg, jax.random.key(5), dtype=jnp.float32)
-
-    row = list(range(3, 25))  # 22 tokens >> window 8
-    (tokens, positions, slot_map, bt, kv_lens, last_idx,
-     num_blocks) = _paged_inputs([row])
-    caches = {}
-    for name in ("xla", "pallas"):
-        kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
-        _, kc, vc = forward(params, tokens, positions, slot_map, bt, kv_lens,
-                            last_idx, kc, vc, cfg=cfg, block_size=4)
-        caches[name] = (kc, vc)
-
-    tok = jnp.asarray([[61]], jnp.int32)
-    pos = jnp.asarray([[22]], jnp.int32)
-    slot = jnp.asarray([[int(bt[0, 5]) * 4 + 2]], jnp.int32)
-    lens = jnp.asarray([23], jnp.int32)
-    li = jnp.asarray([0], jnp.int32)
-    outs = {}
-    for name, up in (("xla", False), ("pallas", True)):
-        kc, vc = caches[name]
-        logits, _, _ = forward(params, tok, pos, slot, bt, lens, li, kc, vc,
-                               cfg=cfg, block_size=4, use_pallas=up)
-        outs[name] = np.asarray(logits)
-    np.testing.assert_allclose(outs["pallas"], outs["xla"],
-                               atol=1e-4, rtol=1e-4)
+        layer_windows=(8, 0)), seed=5)  # KV*hd = 128: kernel-supported
 
 
 def test_mistral_window_pallas_decode_matches_xla():
     """Uniform sliding window (mistral) through the decode kernel."""
-    import jax
-    import jax.numpy as jnp
-
-    from dynamo_tpu.engine.cache import allocate_device_cache
     from dynamo_tpu.engine.config import ModelConfig
-    from dynamo_tpu.engine.model import forward, init_params
 
-    cfg = ModelConfig(
+    _decode_kernel_parity(ModelConfig(
         vocab_size=128, hidden_size=128, intermediate_size=96, num_layers=2,
         num_heads=4, num_kv_heads=2, head_dim=64, dtype="float32",
-        max_position_embeddings=256, sliding_window=8)
-    params = init_params(cfg, jax.random.key(6), dtype=jnp.float32)
-    row = list(range(3, 25))
-    (tokens, positions, slot_map, bt, kv_lens, last_idx,
-     num_blocks) = _paged_inputs([row])
-    caches = {}
-    for name in ("xla", "pallas"):
-        kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
-        _, kc, vc = forward(params, tokens, positions, slot_map, bt, kv_lens,
-                            last_idx, kc, vc, cfg=cfg, block_size=4)
-        caches[name] = (kc, vc)
-    tok = jnp.asarray([[61]], jnp.int32)
-    pos = jnp.asarray([[22]], jnp.int32)
-    slot = jnp.asarray([[int(bt[0, 5]) * 4 + 2]], jnp.int32)
-    lens = jnp.asarray([23], jnp.int32)
-    li = jnp.asarray([0], jnp.int32)
-    outs = {}
-    for name, up in (("xla", False), ("pallas", True)):
-        kc, vc = caches[name]
-        logits, _, _ = forward(params, tok, pos, slot, bt, lens, li, kc, vc,
-                               cfg=cfg, block_size=4, use_pallas=up)
-        outs[name] = np.asarray(logits)
-    np.testing.assert_allclose(outs["pallas"], outs["xla"],
-                               atol=1e-4, rtol=1e-4)
+        max_position_embeddings=256, sliding_window=8), seed=6)
