@@ -18,7 +18,8 @@ from typing import Optional
 from dynamo_tpu.disagg.protocols import (
     DisaggConfig, KvChunkFrame, PrefillResponse,
 )
-from dynamo_tpu.protocols import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.protocols import (FinishReason, LLMEngineOutput,
+                                  PreprocessedRequest)
 from dynamo_tpu.runtime.control_plane import NoRespondersError
 
 logger = logging.getLogger("dynamo.disagg")
@@ -107,12 +108,16 @@ class DecodeWorkerHandler:
     """
 
     def __init__(self, engine, prefill_client=None,
-                 config: Optional[DisaggConfig] = None, prefill_queue=None):
+                 config: Optional[DisaggConfig] = None, prefill_queue=None,
+                 mm_client=None):
         self.engine = engine
         self.prefill_client = prefill_client
         self.config = config or DisaggConfig()
         #: optional PrefillQueueClient: queued dispatch with claim/fallback
         self.prefill_queue = prefill_queue
+        #: optional encode-component Client: resolves mm_refs → mm_embeds
+        #: before generation (the nixl_connect embedding-read analog)
+        self.mm_client = mm_client
 
     def _use_remote_prefill(self, req: PreprocessedRequest) -> bool:
         if self.prefill_client is None:
@@ -123,6 +128,17 @@ class DecodeWorkerHandler:
 
     async def generate(self, request: dict, ctx):
         req = PreprocessedRequest.from_wire(request)
+        if req.mm_refs:
+            if self.mm_client is None:
+                yield LLMEngineOutput(
+                    finish_reason=FinishReason.ERROR,
+                    text="request carries multimodal content but no encoder "
+                         "component is configured (--mm-encode)").to_wire()
+                return
+            from dynamo_tpu.multimodal import resolve_mm_refs
+
+            await resolve_mm_refs(req, self.mm_client,
+                                  self.engine.cfg.hidden_size)
         if self._use_remote_prefill(req):
             yielded = False
             try:

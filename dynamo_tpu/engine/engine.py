@@ -116,6 +116,7 @@ class AsyncJaxEngine:
                 cfg, args.block_size, args.multi_step_decode, mesh,
                 use_pallas=args.use_pallas_attention,
                 replicate_outputs=self._multihost)
+        self._step_mm_fn = None  # compiled lazily on first mm request
         self.verify_fn = None
         if args.speculative_tokens > 0:
             self.verify_fn = M.make_verify_fn(
@@ -539,6 +540,36 @@ class AsyncJaxEngine:
 
     # ------------------------------------------------------------- prefill
 
+    def _mm_arrays(self, seq, start: int, end: int, S: int):
+        """(mm_vec [1,S,D] f32, mm_mask [1,S] bool) for the chunk, or None
+        when no multimodal segment overlaps [start, end)."""
+        segs = seq.req.mm_embeds or []
+        D = self.cfg.hidden_size
+        vec = None
+        mask = None
+        for seg in segs:
+            s0 = int(seg.get("start", 0))
+            rows = seg["embeds"]
+            for j, row in enumerate(rows):
+                p = s0 + j
+                if start <= p < end:
+                    if vec is None:
+                        vec = np.zeros((1, S, D), np.float32)
+                        mask = np.zeros((1, S), bool)
+                    vec[0, p - start, :len(row)] = row
+                    mask[0, p - start] = True
+        return (vec, mask) if vec is not None else None
+
+    def _get_step_mm_fn(self):
+        if self._step_mm_fn is None:
+            from dynamo_tpu.engine import model as M
+
+            self._step_mm_fn = M.make_step_mm_fn(
+                self.cfg, self.args.block_size, self.mesh,
+                use_pallas=self.args.use_pallas_attention,
+                replicate_logits=self._multihost)
+        return self._step_mm_fn
+
     async def _run_prefill(self, work) -> None:
         import jax.numpy as jnp
 
@@ -563,17 +594,35 @@ class AsyncJaxEngine:
         kv_lens = np.array([end], np.int32)
         last_idx = np.array([chunk - 1], np.int32)
 
-        self._broadcast("step", tokens=tokens, positions=positions,
-                        slot_map=slot_map, block_tables=bt, kv_lens=kv_lens,
-                        last_idx=last_idx)
-        logits, self.k_cache, self.v_cache = self.step_fn(
-            self.params, self._put_batch("tokens", tokens),
-            self._put_batch("positions", positions),
-            self._put_batch("slot_map", slot_map),
-            self._put_batch("block_tables", bt),
-            self._put_batch("kv_lens", kv_lens),
-            self._put_batch("last_idx", last_idx),
-            self.k_cache, self.v_cache)
+        mm = self._mm_arrays(seq, start, end, S)
+        if mm is not None:
+            mm_vec, mm_mask = mm
+            self._broadcast("step_mm", tokens=tokens, positions=positions,
+                            slot_map=slot_map, block_tables=bt,
+                            kv_lens=kv_lens, last_idx=last_idx,
+                            mm_vec=mm_vec, mm_mask=mm_mask)
+            logits, self.k_cache, self.v_cache = self._get_step_mm_fn()(
+                self.params, self._put_batch("tokens", tokens),
+                self._put_batch("positions", positions),
+                self._put_batch("slot_map", slot_map),
+                self._put_batch("block_tables", bt),
+                self._put_batch("kv_lens", kv_lens),
+                self._put_batch("last_idx", last_idx),
+                self._put_batch("mm_vec", mm_vec),
+                self._put_batch("mm_mask", mm_mask),
+                self.k_cache, self.v_cache)
+        else:
+            self._broadcast("step", tokens=tokens, positions=positions,
+                            slot_map=slot_map, block_tables=bt,
+                            kv_lens=kv_lens, last_idx=last_idx)
+            logits, self.k_cache, self.v_cache = self.step_fn(
+                self.params, self._put_batch("tokens", tokens),
+                self._put_batch("positions", positions),
+                self._put_batch("slot_map", slot_map),
+                self._put_batch("block_tables", bt),
+                self._put_batch("kv_lens", kv_lens),
+                self._put_batch("last_idx", last_idx),
+                self.k_cache, self.v_cache)
 
         self.scheduler.commit_computed(seq, end)
         if seq.progress_cb is not None:

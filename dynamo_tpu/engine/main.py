@@ -122,6 +122,11 @@ async def amain():
                     help="also run the KVBM leader in this process, "
                          "expecting N workers at the startup barrier "
                          "(ref: distributed/leader.rs:126)")
+    ap.add_argument("--mm-encode", action="store_true",
+                    help="run a multimodal encode worker in this process "
+                         "AND resolve image refs against the encoder "
+                         "component (stub encoder; plug a vision tower via "
+                         "dynamo_tpu.multimodal.EncodeWorker)")
     ap.add_argument("--jax-coordinator", default=None,
                     help="multi-host: jax.distributed coordinator host:port "
                          "(TPU pods auto-detect with --jax-num-processes "
@@ -268,13 +273,24 @@ async def amain():
                 prefill_queue = PrefillQueueClient(runtime.plane)
         dconf = DisaggConfig(
             max_local_prefill_length=cli.max_local_prefill_length)
+        mm_client = None
+        if cli.mm_encode:
+            from dynamo_tpu.multimodal.encoder import ENCODE_COMPONENT
+            mm_ep = ns.component(ENCODE_COMPONENT).endpoint("encode")
+            mm_client = await mm_ep.client().start()
         handler = DecodeWorkerHandler(engine, prefill_client, dconf,
-                                      prefill_queue=prefill_queue)
+                                      prefill_queue=prefill_queue,
+                                      mm_client=mm_client)
         serve = handler.generate
         if cli.role == "decode":  # live-tunable threshold (disagg_router.rs)
             from dynamo_tpu.disagg.handlers import DisaggConfigWatcher
             await DisaggConfigWatcher(runtime.plane, dconf).start()
 
+    mm_worker = None
+    if cli.mm_encode:
+        from dynamo_tpu.multimodal import EncodeWorker
+        mm_worker = await EncodeWorker(runtime,
+                                       namespace=cli.namespace).start()
     kvbm_leader = None
     kvbm_worker = None
     if cli.kvbm_distributed and engine.kvbm is None:
@@ -354,6 +370,8 @@ async def amain():
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if mm_worker is not None:
+        await mm_worker.stop()
     if kvbm_worker is not None:
         await kvbm_worker.stop()
     if kvbm_leader is not None:

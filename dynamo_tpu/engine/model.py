@@ -713,7 +713,8 @@ def _flash_prefill_attn(q, kc, vc, lidx, block_tables, positions, kv_lens, *,
 def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             last_idx, k_cache, v_cache, *, cfg: ModelConfig, block_size: int,
             use_pallas: bool = False, use_flash_prefill: bool = False,
-            mesh: Optional[Mesh] = None, all_logits: bool = False):
+            mesh: Optional[Mesh] = None, all_logits: bool = False,
+            mm_vec=None, mm_mask=None):
     """One engine step.
 
     Args:
@@ -733,6 +734,10 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
     H, KV = cfg.num_heads, cfg.num_kv_heads
 
     x = params["embed"][tokens]  # [B,S,D]
+    if mm_vec is not None:
+        # multimodal: positions under mm_mask take externally-provided
+        # embeddings (llava-style placeholder substitution)
+        x = jnp.where(mm_mask[..., None], mm_vec.astype(x.dtype), x)
 
     def make_layer(moe: bool):
         def layer(carry, xs):
@@ -1091,6 +1096,32 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
     prefill_flash = (bool(use_flash_prefill) and heads_ok
                      and cfg.head_dim % 64 == 0)
     return decode_pallas, prefill_flash
+
+
+def make_step_mm_fn(cfg: ModelConfig, block_size: int,
+                    mesh: Optional[Mesh] = None, use_pallas: bool = False,
+                    use_flash_prefill=None, replicate_logits: bool = False):
+    """Jitted engine step accepting multimodal embedding overrides:
+    (params, tokens, positions, slot_map, block_tables, kv_lens, last_idx,
+    mm_vec [B,S,D], mm_mask [B,S], k_cache, v_cache). Compiled lazily by the
+    engine only when a request actually carries mm content."""
+    decode_pallas, prefill_flash = _resolve_kernel_flags(
+        cfg, mesh, use_pallas, use_flash_prefill)
+
+    def f(params, tokens, positions, slot_map, block_tables, kv_lens,
+          last_idx, mm_vec, mm_mask, k_cache, v_cache):
+        return forward(params, tokens, positions, slot_map, block_tables,
+                       kv_lens, last_idx, k_cache, v_cache, cfg=cfg,
+                       block_size=block_size, use_pallas=decode_pallas,
+                       use_flash_prefill=prefill_flash, mesh=mesh,
+                       mm_vec=mm_vec, mm_mask=mm_mask)
+
+    kw = {}
+    if replicate_logits and mesh is not None:
+        kw["out_shardings"] = (NamedSharding(mesh, P()),
+                               cache_shardings(mesh, cfg),
+                               cache_shardings(mesh, cfg))
+    return jax.jit(f, donate_argnums=(9, 10), **kw)
 
 
 def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,

@@ -115,11 +115,18 @@ class Scheduler:
 
     # -- api ----------------------------------------------------------------
 
+    @staticmethod
+    def _salt_for(req) -> int:
+        # multimodal content salts the block hashes: identical placeholder
+        # tokens with different images must never share KV identity
+        digest = req.mm_digest() if hasattr(req, "mm_digest") else None
+        return KV_HASH_SEED if digest is None else digest
+
     def add(self, seq: SeqState) -> None:
         seq.tokens = list(seq.req.token_ids)
         seq.prompt_len = len(seq.tokens)
         seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
-                                        salt_hash=KV_HASH_SEED)
+                                        salt_hash=self._salt_for(seq.req))
         self.waiting.append(seq)
 
     @property
@@ -250,7 +257,7 @@ class Scheduler:
         seq.tokens = list(seq.req.token_ids)
         seq.prompt_len = len(seq.tokens)
         seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
-                                        salt_hash=KV_HASH_SEED)
+                                        salt_hash=self._salt_for(seq.req))
         seq.block_table = list(block_table)
         self.running.append(seq)
         self.commit_computed(seq, seq.prompt_len)
@@ -308,8 +315,11 @@ class Scheduler:
         matchable = (seq.prompt_len - 1) // bs
         if matchable <= 0:
             return
+        # the probe MUST use the same salt as registration: an unsalted
+        # probe would let a multimodal request reuse KV computed for the
+        # same tokens WITHOUT its image embeddings (and vice versa)
         probe = TokenBlockSequence.from_tokens(
-            seq.tokens[: matchable * bs], bs, KV_HASH_SEED)
+            seq.tokens[: matchable * bs], bs, self._salt_for(seq.req))
         hit_blocks = self.pool.match_prefix(probe.sequence_hashes())
         if self.onboard_cb is not None and len(hit_blocks) < matchable:
             hit_blocks = hit_blocks + self.onboard_cb(
@@ -358,7 +368,7 @@ class Scheduler:
         seq.num_registered_blocks = 0
         seq.num_cached_prompt = 0
         seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
-                                        salt_hash=KV_HASH_SEED)
+                                        salt_hash=self._salt_for(seq.req))
         seq.preemptions += 1
         if seq in self.running:
             self.running.remove(seq)

@@ -83,6 +83,10 @@ class ModelDeploymentCard:
     tokenizer_ref: str = "test"
     chat_template: Optional[str] = None
     eos_token_ids: list[int] = field(default_factory=list)
+    #: placeholder tokens emitted per image in multimodal prompts (the
+    #: vision tower's patch-token count; ref surface: trtllm multimodal
+    #: encode helper)
+    mm_placeholder_tokens: int = 16
     runtime_config: ModelRuntimeConfig = field(default_factory=ModelRuntimeConfig)
     user_data: dict = field(default_factory=dict)
 
@@ -108,6 +112,7 @@ class ModelDeploymentCard:
             tokenizer_ref=d.get("tokenizer_ref", "test"),
             chat_template=d.get("chat_template"),
             eos_token_ids=list(d.get("eos_token_ids") or []),
+            mm_placeholder_tokens=d.get("mm_placeholder_tokens", 16),
             runtime_config=ModelRuntimeConfig(**rc),
             user_data=d.get("user_data") or {},
         )

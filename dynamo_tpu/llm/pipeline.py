@@ -114,10 +114,68 @@ class OpenAIPreprocessor:
             eos_token=self.tokenizer.eos_token or "",
         )
 
+    @staticmethod
+    def _extract_mm(messages):
+        """Replace image_url content parts with unique sentinels; returns
+        (rewritten messages, [ref urls]) — the sentinels survive chat-template
+        rendering so image positions can be located after tokenization."""
+        refs: list[str] = []
+        out = []
+        for m in messages:
+            c = m.get("content")
+            if isinstance(c, list):
+                parts = []
+                for part in c:
+                    if isinstance(part, dict) and part.get("type") == "image_url":
+                        url = (part.get("image_url") or {}).get("url", "")
+                        parts.append(f"\x00mm{len(refs)}\x00")
+                        refs.append(url)
+                    elif isinstance(part, dict) and "text" in part:
+                        # strip NULs: user text must never be able to forge
+                        # a sentinel and alias/crash image placement
+                        parts.append(str(part["text"]).replace("\x00", ""))
+                m = dict(m, content="".join(parts))
+            elif isinstance(c, str) and "\x00" in c:
+                # plain-string messages can forge sentinels too
+                m = dict(m, content=c.replace("\x00", ""))
+            out.append(m)
+        return out, refs
+
+    def _tokenize_mm(self, prompt: str, refs: list[str]):
+        """Split the rendered prompt at sentinels, tokenize segments
+        separately, and insert placeholder runs per image — segment-wise
+        tokenization is the only scheme stable across tokenizers (a sentinel
+        tokenized inline splits unpredictably)."""
+        import re
+
+        n_ph = self.mdc.mm_placeholder_tokens
+        token_ids: list[int] = []
+        mm_refs = []
+        pieces = re.split("\x00mm(\\d+)\x00", prompt)
+        # pieces = [text, idx, text, idx, ..., text]
+        for i, piece in enumerate(pieces):
+            if i % 2 == 0:
+                if piece:
+                    token_ids.extend(self.tokenizer.encode(
+                        piece, add_special_tokens=(i == 0)))
+            else:
+                mm_refs.append({"start": len(token_ids),
+                                "ref": refs[int(piece)], "tokens": n_ph})
+                token_ids.extend([0] * n_ph)  # placeholder run
+        return token_ids, mm_refs
+
     def preprocess(self, req: ParsedRequest) -> tuple[PreprocessedRequest, str]:
+        mm_refs = None
         if req.messages is not None:
-            prompt = self._render_chat(req)
-            token_ids = self.tokenizer.encode(prompt)
+            messages, refs = self._extract_mm(req.messages)
+            if refs:
+                import dataclasses as _dc
+
+                prompt = self._render_chat(_dc.replace(req, messages=messages))
+                token_ids, mm_refs = self._tokenize_mm(prompt, refs)
+            else:
+                prompt = self._render_chat(req)
+                token_ids = self.tokenizer.encode(prompt)
         else:
             p = req.prompt
             if isinstance(p, str):
@@ -151,6 +209,7 @@ class OpenAIPreprocessor:
             annotations=req.annotations,
             backend_instance_id=req.backend_instance_id,
             router_config_override=req.router_config_override,
+            mm_refs=mm_refs,
         )
         return pre, prompt
 

@@ -1,0 +1,7 @@
+"""Multimodal runway: encode worker + embedding transfer (ref surface:
+the trtllm backend's multimodal encode helper and nixl_connect's typed
+embedding transfer, SURVEY §2.6)."""
+
+from dynamo_tpu.multimodal.encoder import (  # noqa: F401
+    EncodeWorker, StubEncoder, resolve_mm_refs,
+)

@@ -1,0 +1,95 @@
+"""Multimodal encode worker: media refs → prompt-embedding segments.
+
+Rebuild of the reference's multimodal split (ref: the TRT-LLM backend's
+multimodal encode helper + ``nixl_connect`` typed embedding transfer,
+lib/bindings/python/src/dynamo/nixl_connect/__init__.py): a separate encode
+component turns media references into embedding tensors; the LLM worker
+fetches them over the response plane (the DCN analog of the NIXL read) and
+injects them at the prompt's placeholder positions
+(PreprocessedRequest.mm_embeds → engine/model.forward mm_vec/mm_mask).
+
+The encoder itself is pluggable: production plugs a vision tower (a jitted
+JAX ViT fits the ``encode(ref, n_tokens, dim)`` contract); the shipped
+:class:`StubEncoder` is deterministic-from-ref, which is exactly what the
+transfer/injection/caching machinery needs for tests — including the
+prefix-cache property that the same image yields the same embeddings (and
+therefore the same mm-salted block hashes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("dynamo.multimodal")
+
+ENCODE_COMPONENT = "encoder"
+
+
+class StubEncoder:
+    """Deterministic embeddings derived from the ref string (content-stable:
+    same ref → same vectors, different refs → different vectors)."""
+
+    def encode(self, ref: str, n_tokens: int, dim: int) -> np.ndarray:
+        seed = int.from_bytes(hashlib.sha256(ref.encode()).digest()[:8],
+                              "little")
+        rng = np.random.default_rng(seed)
+        return rng.standard_normal((n_tokens, dim), np.float32) * 0.02
+
+
+class EncodeWorker:
+    """Serves the ``encode`` endpoint on the encoder component: request
+    {"refs": [str], "tokens": n, "dim": d} → one frame per ref
+    {"ref", "embeds": [[...]]}."""
+
+    def __init__(self, runtime, encoder=None, namespace: str = "dynamo"):
+        self.runtime = runtime
+        self.encoder = encoder or StubEncoder()
+        self.namespace = namespace
+        self._handle = None
+
+    async def start(self) -> "EncodeWorker":
+        ep = self.runtime.namespace(self.namespace).component(
+            ENCODE_COMPONENT).endpoint("encode")
+        self._handle = await ep.serve_endpoint(self._encode)
+        return self
+
+    async def _encode(self, request, ctx):
+        import asyncio
+
+        n = int(request.get("tokens", 16))
+        dim = int(request.get("dim", 0))
+        for ref in request.get("refs", []):
+            emb = await asyncio.to_thread(self.encoder.encode, ref, n, dim)
+            yield {"ref": ref, "embeds": [row.tolist() for row in emb]}
+
+    async def stop(self):
+        if self._handle is not None:
+            await self._handle.stop(graceful=False)
+
+
+async def resolve_mm_refs(req, client, dim: int) -> None:
+    """Fill ``req.mm_embeds`` from ``req.mm_refs`` by fetching embeddings
+    from the encode component (in place; clears mm_refs). Duplicate refs
+    are fetched once."""
+    refs = req.mm_refs or []
+    if not refs:
+        return
+    unique = sorted({seg["ref"] for seg in refs})
+    tokens = max(int(seg.get("tokens", 16)) for seg in refs)
+    recv = await client.generate({"refs": unique, "tokens": tokens,
+                                  "dim": dim})
+    by_ref: dict[str, list] = {}
+    async for frame in recv:
+        by_ref[frame["ref"]] = frame["embeds"]
+    missing = [seg["ref"] for seg in refs if seg["ref"] not in by_ref]
+    if missing:
+        raise RuntimeError(f"encoder returned no embeddings for {missing}")
+    req.mm_embeds = [
+        {"start": int(seg["start"]),
+         "embeds": by_ref[seg["ref"]][: int(seg.get("tokens", tokens))]}
+        for seg in refs]
+    req.mm_refs = None

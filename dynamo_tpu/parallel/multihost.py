@@ -49,6 +49,8 @@ STEP_KEYS = {
     "multi": ("last_tokens", "positions", "block_tables", "kv_lens",
               "temp", "top_k", "top_p", "seeds", "step0"),
     "verify": ("tokens", "positions", "slot_map", "block_tables", "kv_lens"),
+    "step_mm": ("tokens", "positions", "slot_map", "block_tables", "kv_lens",
+                "last_idx", "mm_vec", "mm_mask"),
 }
 
 
@@ -223,6 +225,11 @@ class StepFollower:
                 keys = STEP_KEYS[kind]
                 if kind == "step":
                     _, eng.k_cache, eng.v_cache = eng.step_fn(
+                        eng.params,
+                        *(eng._put_batch(k, a[k]) for k in keys),
+                        eng.k_cache, eng.v_cache)
+                elif kind == "step_mm":  # multimodal prefill chunk
+                    _, eng.k_cache, eng.v_cache = eng._get_step_mm_fn()(
                         eng.params,
                         *(eng._put_batch(k, a[k]) for k in keys),
                         eng.k_cache, eng.v_cache)

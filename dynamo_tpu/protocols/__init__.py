@@ -93,6 +93,43 @@ class PreprocessedRequest:
     #: pin the request to a specific worker instance (bypasses routing)
     backend_instance_id: Optional[int] = None
     router_config_override: Optional[dict] = None
+    #: multimodal segments: [{"start": pos, "embeds": [[...D floats]]}] —
+    #: prompt positions whose token embeddings are REPLACED by these
+    #: vectors (llava-style placeholder substitution; ref surface:
+    #: nixl_connect multimodal embedding transfer + the trtllm encode
+    #: helper). Resolved from mm_refs by the worker before generation.
+    mm_embeds: Optional[list] = None
+    #: unresolved media references: [{"start": pos, "ref": str,
+    #: "tokens": n}] — the decode handler fetches embeddings from the
+    #: encode component and fills mm_embeds
+    mm_refs: Optional[list] = None
+
+    def mm_digest(self) -> Optional[int]:
+        """Stable content hash of the multimodal payload — salts the block
+        hashes so two prompts with identical placeholder TOKENS but
+        different images never share prefix-cache/KV identity. Memoized:
+        the scheduler consults it on every add/probe/resume/preempt and
+        the payload is immutable once resolved."""
+        if not self.mm_embeds and not self.mm_refs:
+            return None
+        cached = getattr(self, "_mm_digest_cache", None)
+        if cached is not None:
+            return cached
+        import struct as _struct
+
+        from dynamo_tpu.tokens import compute_salt_hash
+
+        chunks: list[bytes] = []
+        for seg in (self.mm_embeds or self.mm_refs):
+            chunks.append(_struct.pack("<q", int(seg.get("start", 0))))
+            if "embeds" in seg:
+                for row in seg["embeds"]:
+                    chunks.append(_struct.pack(f"<{len(row)}f", *row))
+            else:
+                chunks.append(str(seg.get("ref", "")).encode())
+        digest = compute_salt_hash(b"".join(chunks))
+        object.__setattr__(self, "_mm_digest_cache", digest)
+        return digest
 
     def has_annotation(self, a: str) -> bool:
         return a in self.annotations
@@ -113,6 +150,8 @@ class PreprocessedRequest:
             annotations=list(d.get("annotations") or []),
             estimated_prefix_hit_num_blocks=d.get("estimated_prefix_hit_num_blocks"),
             backend_instance_id=d.get("backend_instance_id"),
+            mm_embeds=d.get("mm_embeds"),
+            mm_refs=d.get("mm_refs"),
             router_config_override=d.get("router_config_override"),
         )
 

@@ -109,7 +109,16 @@ async def start_worker(runtime, out: str, cli):
                        speculative_tokens=cli.speculative_tokens,
                        use_pallas_attention=cli.use_pallas_attention)
     engine = AsyncJaxEngine(cfg, eargs, params=params)
-    handler = DecodeWorkerHandler(engine)
+    mm_client = None
+    mm_worker = None
+    if cli.mm_encode:
+        from dynamo_tpu.multimodal import EncodeWorker
+        from dynamo_tpu.multimodal.encoder import ENCODE_COMPONENT
+        mm_worker = await EncodeWorker(runtime).start()
+        mm_ep = runtime.namespace("dynamo").component(
+            ENCODE_COMPONENT).endpoint("encode")
+        mm_client = await mm_ep.client().start()
+    handler = DecodeWorkerHandler(engine, mm_client=mm_client)
     backend = runtime.namespace("dynamo").component("backend")
     ep = backend.endpoint("generate")
     handle = await ep.serve_endpoint(handler.generate)
@@ -120,7 +129,10 @@ async def start_worker(runtime, out: str, cli):
         eos_token_ids=eos, tokenizer_ref=tokenizer_ref or "test")
     card.runtime_config.total_kv_blocks = engine.num_blocks
     await register_llm(runtime, ep, card)
-    return [handle, embed_handle]
+    handles = [handle, embed_handle]
+    if mm_worker is not None:  # stopped by _stop_worker with the rest
+        handles.append(mm_worker._handle)
+    return handles
 
 
 async def run_text_repl(manager):
@@ -244,6 +256,9 @@ async def amain():
                     choices=["kv", "round_robin", "random"])
     ap.add_argument("--multi-step-decode", type=int, default=1)
     ap.add_argument("--speculative-tokens", type=int, default=0)
+    ap.add_argument("--mm-encode", action="store_true",
+                    help="start a stub multimodal encode worker and resolve "
+                         "image_url content parts against it")
     ap.add_argument("--use-pallas-attention", action="store_true")
     ap.add_argument("--vocab-size", type=int, default=0,
                     help="mocker vocab size (out=mocker only)")

@@ -1,0 +1,187 @@
+"""Multimodal runway: image parts → placeholders → encode worker →
+embedding injection (ref surface: trtllm multimodal encode helper +
+nixl_connect embedding transfer, SURVEY §2.6)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.protocols import (
+    PreprocessedRequest, SamplingOptions, StopConditions,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+def engine_args(**kw):
+    d = dict(block_size=4, num_blocks=128, max_num_seqs=4,
+             max_num_batched_tokens=64, max_model_len=256,
+             prefill_buckets=(8, 16, 32, 64), decode_batch_buckets=(1, 2, 4))
+    d.update(kw)
+    return EngineArgs(**d)
+
+
+def mm_req(prompt, embeds_segments, max_tokens=6):
+    return PreprocessedRequest(
+        model="t", token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+        mm_embeds=embeds_segments)
+
+
+async def collect(eng, req):
+    toks = []
+    async for out in eng.generate(req):
+        toks.extend(out.token_ids)
+    return toks
+
+
+async def test_mm_embeds_change_output_deterministically():
+    """Injected embeddings must change generation (vs placeholder tokens)
+    and be deterministic for identical content."""
+    cfg = ModelConfig.tiny()
+    eng = AsyncJaxEngine(cfg, engine_args())
+    D = cfg.hidden_size
+    prompt = [5, 0, 0, 0, 0, 9, 11, 3]  # 4 placeholder positions
+    rng = np.random.default_rng(1)
+    emb_a = (rng.standard_normal((4, D)) * 0.05).tolist()
+    emb_b = (rng.standard_normal((4, D)) * 0.05).tolist()
+
+    plain = await collect(eng, mm_req(prompt, None))
+    with_a1 = await collect(eng, mm_req(prompt, [{"start": 1, "embeds": emb_a}]))
+    with_a2 = await collect(eng, mm_req(prompt, [{"start": 1, "embeds": emb_a}]))
+    with_b = await collect(eng, mm_req(prompt, [{"start": 1, "embeds": emb_b}]))
+    assert with_a1 == with_a2          # deterministic
+    assert with_a1 != plain            # injection matters
+    assert with_a1 != with_b           # content matters
+    await eng.close()
+
+
+async def test_mm_salts_prefix_cache():
+    """Identical placeholder TOKENS with different images must not share
+    prefix-cache blocks; the same image must."""
+    cfg = ModelConfig.tiny()
+    eng = AsyncJaxEngine(cfg, engine_args())
+    D = cfg.hidden_size
+    prompt = list(range(1, 17))  # 4 full blocks
+    rng = np.random.default_rng(2)
+    emb_a = (rng.standard_normal((4, D)) * 0.05).tolist()
+    emb_b = (rng.standard_normal((4, D)) * 0.05).tolist()
+    seg_a = [{"start": 0, "embeds": emb_a}]
+    seg_b = [{"start": 0, "embeds": emb_b}]
+
+    await collect(eng, mm_req(prompt, seg_a))
+    base_hits = eng.scheduler.prefix_hit_tokens
+    # same image again → prefix hit
+    await collect(eng, mm_req(prompt, seg_a))
+    assert eng.scheduler.prefix_hit_tokens > base_hits
+    hits_after_same = eng.scheduler.prefix_hit_tokens
+    # DIFFERENT image, same tokens → must NOT hit the cache
+    await collect(eng, mm_req(prompt, seg_b))
+    assert eng.scheduler.prefix_hit_tokens == hits_after_same
+    await eng.close()
+
+
+def test_preprocessor_expands_image_parts():
+    """image_url content parts become placeholder runs + positioned refs."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.pipeline import OpenAIPreprocessor
+    from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+    from dynamo_tpu.protocols.openai import parse_chat_request
+
+    tk = make_test_tokenizer()
+    mdc = ModelDeploymentCard(display_name="t", eos_token_ids=[],
+                              tokenizer_ref="test", mm_placeholder_tokens=4)
+    pre = OpenAIPreprocessor(mdc, tk, None)
+    parsed = parse_chat_request({
+        "model": "t",
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "describe "},
+            {"type": "image_url", "image_url": {"url": "img://cat"}},
+            {"type": "text", "text": " and "},
+            {"type": "image_url", "image_url": {"url": "img://dog"}},
+        ]}],
+        "max_tokens": 4,
+    })
+    req, _prompt = pre.preprocess(parsed)
+    assert req.mm_refs is not None and len(req.mm_refs) == 2
+    a, b = req.mm_refs
+    assert a["ref"] == "img://cat" and b["ref"] == "img://dog"
+    assert a["tokens"] == b["tokens"] == 4
+    # placeholder runs of exactly 4 zeros sit at the recorded positions
+    for seg in (a, b):
+        s = seg["start"]
+        assert req.token_ids[s:s + 4] == [0, 0, 0, 0]
+    assert b["start"] >= a["start"] + 4
+    assert req.mm_digest() is not None
+
+
+async def test_encode_worker_resolution_e2e():
+    """Full loop: encode worker serves embeddings; the decode handler
+    resolves refs and generates — same ref twice gives identical output,
+    different refs differ (StubEncoder is content-stable)."""
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+    from dynamo_tpu.multimodal import EncodeWorker
+    from dynamo_tpu.multimodal.encoder import ENCODE_COMPONENT
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    rt = await DistributedRuntime.create()
+    cfg = ModelConfig.tiny()
+    eng = AsyncJaxEngine(cfg, engine_args())
+    worker = await EncodeWorker(rt).start()
+    client = await rt.namespace("dynamo").component(
+        ENCODE_COMPONENT).endpoint("encode").client().start()
+    handler = DecodeWorkerHandler(eng, mm_client=client)
+
+    async def run(ref):
+        req = PreprocessedRequest(
+            model="t", token_ids=[5, 0, 0, 0, 0, 9, 11, 3],
+            stop_conditions=StopConditions(max_tokens=5, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            mm_refs=[{"start": 1, "ref": ref, "tokens": 4}])
+        toks = []
+        async for out in handler.generate(req.to_wire(), None):
+            from dynamo_tpu.protocols import LLMEngineOutput
+            o = LLMEngineOutput.from_wire(out)
+            toks.extend(o.token_ids)
+            assert o.finish_reason != "error", o.text
+        return toks
+
+    try:
+        cat1 = await run("img://cat")
+        cat2 = await run("img://cat")
+        dog = await run("img://dog")
+        assert cat1 == cat2
+        assert cat1 != dog
+    finally:
+        await worker.stop()
+        await eng.close()
+        await rt.shutdown()
+
+
+def test_sentinel_injection_is_neutralized():
+    """User text containing literal NUL sentinels must not crash or alias
+    image placement (security: forged '\\x00mmN\\x00' in a text part)."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.pipeline import OpenAIPreprocessor
+    from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+    from dynamo_tpu.protocols.openai import parse_chat_request
+
+    tk = make_test_tokenizer()
+    mdc = ModelDeploymentCard(display_name="t", eos_token_ids=[],
+                              tokenizer_ref="test", mm_placeholder_tokens=4)
+    pre = OpenAIPreprocessor(mdc, tk, None)
+    parsed = parse_chat_request({
+        "model": "t",
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "evil \x00mm7\x00 and \x00mm0\x00 text "},
+            {"type": "image_url", "image_url": {"url": "img://real"}},
+        ]}],
+        "max_tokens": 4,
+    })
+    req, _ = pre.preprocess(parsed)  # must not raise
+    assert len(req.mm_refs) == 1
+    assert req.mm_refs[0]["ref"] == "img://real"
