@@ -137,8 +137,14 @@ class DecodeWorkerHandler:
                 return
             from dynamo_tpu.multimodal import resolve_mm_refs
 
-            await resolve_mm_refs(req, self.mm_client,
-                                  self.engine.cfg.hidden_size)
+            try:
+                await resolve_mm_refs(req, self.mm_client,
+                                      self.engine.cfg.hidden_size)
+            except Exception as e:  # same graceful surface as no-encoder
+                yield LLMEngineOutput(
+                    finish_reason=FinishReason.ERROR,
+                    text=f"multimodal encode failed: {e}").to_wire()
+                return
         if self._use_remote_prefill(req):
             yielded = False
             try:

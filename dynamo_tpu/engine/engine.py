@@ -594,35 +594,20 @@ class AsyncJaxEngine:
         kv_lens = np.array([end], np.int32)
         last_idx = np.array([chunk - 1], np.int32)
 
+        operands = {"tokens": tokens, "positions": positions,
+                    "slot_map": slot_map, "block_tables": bt,
+                    "kv_lens": kv_lens, "last_idx": last_idx}
         mm = self._mm_arrays(seq, start, end, S)
         if mm is not None:
-            mm_vec, mm_mask = mm
-            self._broadcast("step_mm", tokens=tokens, positions=positions,
-                            slot_map=slot_map, block_tables=bt,
-                            kv_lens=kv_lens, last_idx=last_idx,
-                            mm_vec=mm_vec, mm_mask=mm_mask)
-            logits, self.k_cache, self.v_cache = self._get_step_mm_fn()(
-                self.params, self._put_batch("tokens", tokens),
-                self._put_batch("positions", positions),
-                self._put_batch("slot_map", slot_map),
-                self._put_batch("block_tables", bt),
-                self._put_batch("kv_lens", kv_lens),
-                self._put_batch("last_idx", last_idx),
-                self._put_batch("mm_vec", mm_vec),
-                self._put_batch("mm_mask", mm_mask),
-                self.k_cache, self.v_cache)
+            operands["mm_vec"], operands["mm_mask"] = mm
+            kind, fn = "step_mm", self._get_step_mm_fn()
         else:
-            self._broadcast("step", tokens=tokens, positions=positions,
-                            slot_map=slot_map, block_tables=bt,
-                            kv_lens=kv_lens, last_idx=last_idx)
-            logits, self.k_cache, self.v_cache = self.step_fn(
-                self.params, self._put_batch("tokens", tokens),
-                self._put_batch("positions", positions),
-                self._put_batch("slot_map", slot_map),
-                self._put_batch("block_tables", bt),
-                self._put_batch("kv_lens", kv_lens),
-                self._put_batch("last_idx", last_idx),
-                self.k_cache, self.v_cache)
+            kind, fn = "step", self.step_fn
+        self._broadcast(kind, **operands)
+        logits, self.k_cache, self.v_cache = fn(
+            self.params,
+            *(self._put_batch(k, v) for k, v in operands.items()),
+            self.k_cache, self.v_cache)
 
         self.scheduler.commit_computed(seq, end)
         if seq.progress_cb is not None:

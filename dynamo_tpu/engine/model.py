@@ -701,13 +701,14 @@ def _pallas_decode_attn(q1, kc, vc, lidx, block_tables, kv_lens, window,
         window=window, sinks=sinks if has_sink else None)
 
 
-def _flash_prefill_attn(q, kc, vc, lidx, block_tables, positions, kv_lens, *,
-                        block_size: int, sliding_window):
+def _flash_prefill_attn(q, kc, vc, lidx, block_tables, positions, kv_lens,
+                        window, sinks, *, block_size: int, has_sink: bool):
     from dynamo_tpu.ops.flash_prefill import flash_prefill_paged
 
     return flash_prefill_paged(q, kc, vc, lidx, block_tables, positions,
                                kv_lens, block_size=block_size,
-                               sliding_window=sliding_window)
+                               sliding_window=window,
+                               sinks=sinks if has_sink else None)
 
 
 def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
@@ -852,16 +853,25 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             attn = fn(q[:, 0], kc, vc, lidx, block_tables, kv_lens,
                       window, sinks)[:, None]
         elif use_flash_prefill and S > 1 and dp_ok:
-            # prefill fast path: flash kernel, no O(S·T) HBM score tensor
+            # prefill fast path: flash kernel, no O(S·T) HBM score tensor;
+            # window is traced (per-layer for gpt-oss), sinks seed the
+            # online softmax
+            if cfg.layer_windows is not None:
+                window = jnp.asarray(cfg.layer_windows, jnp.int32)[lidx]
+            else:
+                window = jnp.asarray(cfg.sliding_window or 0, jnp.int32)
+            sinks = lp.get("sink", jnp.zeros((q.shape[2],), q.dtype))
             fn = functools.partial(_flash_prefill_attn, block_size=block_size,
-                                   sliding_window=cfg.sliding_window)
+                                   has_sink="sink" in lp)
             if mesh is not None:
                 fn = jax.shard_map(
                     fn, mesh=mesh,
                     in_specs=(sp["q"], sp["cache"], sp["cache"], sp["scalar"],
-                              sp["bt"], sp["pos"], sp["lens"]),
+                              sp["bt"], sp["pos"], sp["lens"], sp["scalar"],
+                              P("tp")),
                     out_specs=sp["q"], check_vma=False)
-            attn = fn(q, kc, vc, lidx, block_tables, positions, kv_lens)
+            attn = fn(q, kc, vc, lidx, block_tables, positions, kv_lens,
+                      window, sinks)
         else:
             window = (jnp.asarray(cfg.layer_windows, jnp.int32)[lidx]
                       if cfg.layer_windows is not None else None)
@@ -1085,12 +1095,10 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     heads_ok = (cfg.num_kv_heads % tp == 0 and cfg.num_heads % tp == 0
                 and cfg.num_heads % cfg.num_kv_heads == 0)
-    # decode kernel handles sliding windows (incl. per-layer) and sinks;
-    # the flash PREFILL kernel does not cover the gpt-oss variants yet
+    # both kernels handle sliding windows (incl. per-layer gpt-oss
+    # windows) and attention sinks
     decode_pallas = (use_pallas and heads_ok
                      and pallas_supported(cfg.num_kv_heads // tp, cfg.head_dim))
-    if cfg.layer_windows is not None or cfg.attention_sinks:
-        return decode_pallas, False
     if use_flash_prefill is None:  # auto: on-TPU, or wherever pallas is asked
         use_flash_prefill = use_pallas or jax.default_backend() == "tpu"
     prefill_flash = (bool(use_flash_prefill) and heads_ok

@@ -155,7 +155,9 @@ class OpenAIPreprocessor:
         # pieces = [text, idx, text, idx, ..., text]
         for i, piece in enumerate(pieces):
             if i % 2 == 0:
-                if piece:
+                # the FIRST segment is always encoded (even when empty) so
+                # a prompt that begins with an image still gets its BOS
+                if piece or i == 0:
                     token_ids.extend(self.tokenizer.encode(
                         piece, add_special_tokens=(i == 0)))
             else:

@@ -66,9 +66,9 @@ class EncodeWorker:
             emb = await asyncio.to_thread(self.encoder.encode, ref, n, dim)
             yield {"ref": ref, "embeds": [row.tolist() for row in emb]}
 
-    async def stop(self):
+    async def stop(self, graceful: bool = False):
         if self._handle is not None:
-            await self._handle.stop(graceful=False)
+            await self._handle.stop(graceful=graceful)
 
 
 async def resolve_mm_refs(req, client, dim: int) -> None:

@@ -43,12 +43,13 @@ import numpy as np
 _NEG = -1e30
 
 
-def _prefill_kernel(pos_base_ref, kv_lens_ref,  # scalar prefetch
+def _prefill_kernel(pos_base_ref, kv_lens_ref, window_ref,  # scalar prefetch
                     q_ref,  # [1, 1, G, TQ, hd] VMEM
+                    sink_ref,  # [1, 1, G, 1] VMEM (zeros when has_sink=False)
                     k_ref, v_ref,  # [1, 1, TK, hd] VMEM
                     o_ref,  # [1, 1, G, TQ, hd] VMEM
                     m_sc, l_sc, acc_sc,  # [G·TQ, 1], [G·TQ, 1], [G·TQ, hd]
-                    *, scale: float, window: int):
+                    *, scale: float, has_sink: bool):
     from jax.experimental import pallas as pl
 
     b = pl.program_id(0)
@@ -60,11 +61,21 @@ def _prefill_kernel(pos_base_ref, kv_lens_ref,  # scalar prefetch
     TK = k_ref.shape[2]
     kv_len = kv_lens_ref[b]
     pos0 = pos_base_ref[b]
+    # sliding window as a traced scalar: static for mistral, a per-layer
+    # value for gpt-oss; 0 = full attention
+    win = window_ref[0]
 
     @pl.when(tk == 0)
     def _init():
-        m_sc[...] = jnp.full_like(m_sc, _NEG)
-        l_sc[...] = jnp.zeros_like(l_sc)
+        if has_sink:
+            # seed the online softmax with the sink slot (zero value):
+            # row r of the [G·TQ] flattening belongs to head g = r // TQ
+            s = sink_ref[0, 0].astype(jnp.float32)  # [G, 1]
+            m_sc[...] = jnp.repeat(s, TQ, axis=0)
+            l_sc[...] = jnp.ones_like(l_sc)
+        else:
+            m_sc[...] = jnp.full_like(m_sc, _NEG)
+            l_sc[...] = jnp.zeros_like(l_sc)
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
     k_start = tk * TK
@@ -72,9 +83,8 @@ def _prefill_kernel(pos_base_ref, kv_lens_ref,  # scalar prefetch
     # tile is live unless entirely in the future, past kv_len, or (window)
     # entirely before every query's window
     live = (k_start <= q_hi) & (k_start < kv_len)
-    if window > 0:
-        q_lo = pos0 + tq * TQ
-        live = live & (k_start + TK - 1 > q_lo - window)
+    q_lo = pos0 + tq * TQ
+    live = live & ((win <= 0) | (k_start + TK - 1 > q_lo - win))
 
     # f32 inputs (CPU parity tests) need full-precision MXU passes; bf16
     # serving inputs take the native single-pass MXU path
@@ -95,8 +105,7 @@ def _prefill_kernel(pos_base_ref, kv_lens_ref,  # scalar prefetch
         q_pos = pos0 + tq * TQ + jax.lax.rem(rows, TQ)
         key_pos = k_start + cols
         mask = (key_pos <= q_pos) & (key_pos < kv_len)
-        if window > 0:
-            mask = mask & (key_pos > q_pos - window)
+        mask = mask & ((win <= 0) | (key_pos > q_pos - win))
         s = jnp.where(mask, s, _NEG)
 
         m_prev, l_prev = m_sc[...], l_sc[...]
@@ -118,8 +127,12 @@ def _prefill_kernel(pos_base_ref, kv_lens_ref,  # scalar prefetch
 
 
 def flash_prefill(q, k, v, pos_base, kv_lens, *, sliding_window=None,
-                  interpret: bool = False):
-    """Flash attention for a prefill chunk. See module docstring."""
+                  sinks=None, interpret: bool = False):
+    """Flash attention for a prefill chunk. See module docstring.
+
+    ``sliding_window`` may be a traced scalar (per-layer gpt-oss windows);
+    ``sinks`` [H] are optional attention-sink logits seeded into the online
+    softmax with zero value contribution."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -141,14 +154,20 @@ def flash_prefill(q, k, v, pos_base, kv_lens, *, sliding_window=None,
     k4 = k.transpose(0, 2, 1, 3)
     v4 = v.transpose(0, 2, 1, 3)
 
+    has_sink = sinks is not None
+    win_arr = jnp.asarray(
+        [0 if sliding_window is None else sliding_window],
+        jnp.int32).reshape(1)
+    sink_in = (jnp.zeros((1, KV, G, 1), q.dtype) if not has_sink
+               else sinks.reshape(1, KV, G, 1).astype(q.dtype))
     kernel = functools.partial(
-        _prefill_kernel, scale=float(1.0 / np.sqrt(hd)),
-        window=int(sliding_window or 0))
+        _prefill_kernel, scale=float(1.0 / np.sqrt(hd)), has_sink=has_sink)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, KV, S // TQ, T // TK),
         in_specs=[
             pl.BlockSpec((1, 1, G, TQ, hd), lambda b, kk, tq, tk, *_: (b, kk, 0, tq, 0)),
+            pl.BlockSpec((1, 1, G, 1), lambda b, kk, tq, tk, *_: (0, kk, 0, 0)),
             pl.BlockSpec((1, 1, TK, hd), lambda b, kk, tq, tk, *_: (b, kk, tk, 0)),
             pl.BlockSpec((1, 1, TK, hd), lambda b, kk, tq, tk, *_: (b, kk, tk, 0)),
         ],
@@ -165,7 +184,8 @@ def flash_prefill(q, k, v, pos_base, kv_lens, *, sliding_window=None,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q5.shape, q.dtype),
         interpret=interpret,
-    )(pos_base.astype(jnp.int32), kv_lens.astype(jnp.int32), q5, k4, v4)
+    )(pos_base.astype(jnp.int32), kv_lens.astype(jnp.int32), win_arr,
+      q5, sink_in, k4, v4)
 
     # [B,KV,G,S,hd] → [B,S,H,hd]
     return out5.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd)
@@ -173,7 +193,7 @@ def flash_prefill(q, k, v, pos_base, kv_lens, *, sliding_window=None,
 
 def flash_prefill_paged(q, k_cache, v_cache, lidx, block_tables, positions,
                         kv_lens, *, block_size: int, sliding_window=None,
-                        interpret: bool = False):
+                        sinks=None, interpret: bool = False):
     """Gather pages at layer ``lidx`` (XLA fused gather), then flash-attend.
 
     Same signature family as engine/model._paged_attention; q [B,S,H,hd],
@@ -186,4 +206,5 @@ def flash_prefill_paged(q, k_cache, v_cache, lidx, block_tables, positions,
     k = k_cache[lidx, slot_idx]  # [B, T, KV, hd]
     v = v_cache[lidx, slot_idx]
     return flash_prefill(q, k, v, positions[:, 0], kv_lens,
-                         sliding_window=sliding_window, interpret=interpret)
+                         sliding_window=sliding_window, sinks=sinks,
+                         interpret=interpret)

@@ -130,8 +130,8 @@ async def start_worker(runtime, out: str, cli):
     card.runtime_config.total_kv_blocks = engine.num_blocks
     await register_llm(runtime, ep, card)
     handles = [handle, embed_handle]
-    if mm_worker is not None:  # stopped by _stop_worker with the rest
-        handles.append(mm_worker._handle)
+    if mm_worker is not None:  # duck-typed: _stop_worker calls .stop()
+        handles.append(mm_worker)
     return handles
 
 

@@ -248,7 +248,8 @@ def test_yarn_truncate_false_matches_hf():
 
 
 def _decode_kernel_parity(cfg, seed):
-    """Prefill via XLA, then one decode step kernel-vs-XLA on cfg."""
+    """Prefill (flash kernel vs XLA checked too), then one decode step
+    kernel-vs-XLA on cfg."""
     import jax
     import jax.numpy as jnp
 
@@ -260,11 +261,17 @@ def _decode_kernel_parity(cfg, seed):
     (tokens, positions, slot_map, bt, kv_lens, last_idx,
      num_blocks) = _paged_inputs([row])
     caches = {}
-    for name in ("xla", "pallas"):
+    prefill_logits = {}
+    for name, flash in (("xla", False), ("pallas", True)):
         kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
-        _, kc, vc = forward(params, tokens, positions, slot_map, bt, kv_lens,
-                            last_idx, kc, vc, cfg=cfg, block_size=4)
+        lg, kc, vc = forward(params, tokens, positions, slot_map, bt, kv_lens,
+                             last_idx, kc, vc, cfg=cfg, block_size=4,
+                             use_flash_prefill=flash)
         caches[name] = (kc, vc)
+        prefill_logits[name] = np.asarray(lg)
+    # flash PREFILL with windows/sinks must match the XLA prefill
+    np.testing.assert_allclose(prefill_logits["pallas"],
+                               prefill_logits["xla"], atol=1e-4, rtol=1e-4)
     tok = jnp.asarray([[61]], jnp.int32)
     pos = jnp.asarray([[22]], jnp.int32)
     slot = jnp.asarray([[int(bt[0, 5]) * 4 + 2]], jnp.int32)
