@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import logging
 import signal
 
 from dynamo_tpu.engine.config import EngineArgs, ModelConfig
@@ -122,6 +123,13 @@ async def amain():
                     help="also run the KVBM leader in this process, "
                          "expecting N workers at the startup barrier "
                          "(ref: distributed/leader.rs:126)")
+    ap.add_argument("--profile-dir", default=None,
+                    help="capture a jax.profiler trace of serving into this "
+                         "directory (view with tensorboard/xprof; ref "
+                         "surface: the reference's benchmarks/profiler "
+                         "tooling)")
+    ap.add_argument("--profile-seconds", type=float, default=30.0,
+                    help="trace duration after WORKER_READY")
     ap.add_argument("--mm-encode", action="store_true",
                     help="run a multimodal encode worker in this process "
                          "AND resolve image refs against the encoder "
@@ -365,11 +373,30 @@ async def amain():
         await register_llm(runtime, ep, card, lease_id=lease)
 
     print("WORKER_READY", flush=True)
+    profile_task = None
+    if cli.profile_dir:
+        import jax
+
+        async def _profile():
+            try:
+                jax.profiler.start_trace(cli.profile_dir)
+                await asyncio.sleep(cli.profile_seconds)
+                jax.profiler.stop_trace()
+                print(f"PROFILE_WRITTEN {cli.profile_dir}", flush=True)
+            except Exception:
+                logging.getLogger("dynamo.profile").exception(
+                    "profiler trace failed")
+
+        # strong ref: asyncio keeps only weak task refs
+        profile_task = asyncio.get_running_loop().create_task(_profile())
     loop = asyncio.get_running_loop()
     stop = asyncio.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if profile_task is not None and not profile_task.done():
+        profile_task.cancel()  # stop_trace is skipped; partial traces are
+        # not written rather than corrupted
     if mm_worker is not None:
         await mm_worker.stop()
     if kvbm_worker is not None:

@@ -809,6 +809,13 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                 "ring prefill bypassed: S=%d B=%d not divisible by "
                 "sp=%d/dp or heads by tp — XLA attention path for this bucket",
                 S, B, sp_n)
+        # per-layer window (traced for gpt-oss) + sink logits, shared by
+        # both kernel fast paths below
+        if cfg.layer_windows is not None:
+            window = jnp.asarray(cfg.layer_windows, jnp.int32)[lidx]
+        else:
+            window = jnp.asarray(cfg.sliding_window or 0, jnp.int32)
+        sinks = lp.get("sink", jnp.zeros((q.shape[2],), q.dtype))
         if ring_ok:
             from dynamo_tpu.parallel.ring_attention import ring_prefill_paged
 
@@ -835,11 +842,6 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             # Under a mesh the kernel runs per-shard via shard_map (heads on
             # "tp", batch on "dp" — attention is head- and batch-local, so no
             # collectives are needed).
-            if cfg.layer_windows is not None:
-                window = jnp.asarray(cfg.layer_windows, jnp.int32)[lidx]
-            else:
-                window = jnp.asarray(cfg.sliding_window or 0, jnp.int32)
-            sinks = lp.get("sink", jnp.zeros((q.shape[2],), q.dtype))
             fn = functools.partial(_pallas_decode_attn,
                                    block_size=block_size,
                                    has_sink="sink" in lp)
@@ -856,11 +858,6 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             # prefill fast path: flash kernel, no O(S·T) HBM score tensor;
             # window is traced (per-layer for gpt-oss), sinks seed the
             # online softmax
-            if cfg.layer_windows is not None:
-                window = jnp.asarray(cfg.layer_windows, jnp.int32)[lidx]
-            else:
-                window = jnp.asarray(cfg.sliding_window or 0, jnp.int32)
-            sinks = lp.get("sink", jnp.zeros((q.shape[2],), q.dtype))
             fn = functools.partial(_flash_prefill_attn, block_size=block_size,
                                    has_sink="sink" in lp)
             if mesh is not None:
