@@ -533,7 +533,7 @@ class AsyncJaxEngine:
             await asyncio.sleep(0)
 
     async def _execute(self, plan: StepPlan) -> None:
-        if plan.prefill is not None:
+        if plan.prefill:
             await self._run_prefill(plan.prefill)
         if plan.decode:
             await self._run_decode(plan.decode)
@@ -570,36 +570,49 @@ class AsyncJaxEngine:
                 replicate_logits=self._multihost)
         return self._step_mm_fn
 
-    async def _run_prefill(self, work) -> None:
+    async def _run_prefill(self, works: list) -> None:
+        """Execute a BATCH of prefill chunks as rows of one jitted step —
+        the scheduler groups same-bucket chunks so concurrent prompts do
+        not serialize one-prefill-per-step."""
         import jax.numpy as jnp
 
-        seq, start, chunk = work.seq, work.start, work.chunk
         args = self.args
-        S = args.bucket_tokens(chunk)
         bs = args.block_size
-        end = start + chunk
+        B = args.bucket_batch(len(works))
+        S = args.bucket_tokens(max(w.chunk for w in works))
+        max_end = max(w.start + w.chunk for w in works)
+        W = args.bucket_table_width(max_end)
 
-        tokens = np.zeros((1, S), np.int32)
-        positions = np.zeros((1, S), np.int32)
-        slot_map = np.zeros((1, S), np.int32)
-        tokens[0, :chunk] = seq.tokens[start:end]
-        positions[0, :chunk] = np.arange(start, end)
-        for i, pos in enumerate(range(start, end)):
-            slot_map[0, i] = seq.block_table[pos // bs] * bs + pos % bs
-
-        W = args.bucket_table_width(end)
-        bt = np.zeros((1, W), np.int32)
-        n = min(len(seq.block_table), W)
-        bt[0, :n] = seq.block_table[:n]
-        kv_lens = np.array([end], np.int32)
-        last_idx = np.array([chunk - 1], np.int32)
+        tokens = np.zeros((B, S), np.int32)
+        positions = np.zeros((B, S), np.int32)
+        slot_map = np.zeros((B, S), np.int32)
+        bt = np.full((B, W), NULL_BLOCK, np.int32)
+        kv_lens = np.zeros((B,), np.int32)
+        last_idx = np.zeros((B,), np.int32)
+        mm_vec = mm_mask = None
+        for i, w in enumerate(works):
+            seq, start, chunk = w.seq, w.start, w.chunk
+            end = start + chunk
+            tokens[i, :chunk] = seq.tokens[start:end]
+            positions[i, :chunk] = np.arange(start, end)
+            for j, pos in enumerate(range(start, end)):
+                slot_map[i, j] = seq.block_table[pos // bs] * bs + pos % bs
+            n = min(len(seq.block_table), W)
+            bt[i, :n] = seq.block_table[:n]
+            kv_lens[i] = end
+            last_idx[i] = chunk - 1
+            mm = self._mm_arrays(seq, start, end, S)
+            if mm is not None:
+                if mm_vec is None:
+                    mm_vec = np.zeros((B, S, self.cfg.hidden_size), np.float32)
+                    mm_mask = np.zeros((B, S), bool)
+                mm_vec[i], mm_mask[i] = mm[0][0], mm[1][0]
 
         operands = {"tokens": tokens, "positions": positions,
                     "slot_map": slot_map, "block_tables": bt,
                     "kv_lens": kv_lens, "last_idx": last_idx}
-        mm = self._mm_arrays(seq, start, end, S)
-        if mm is not None:
-            operands["mm_vec"], operands["mm_mask"] = mm
+        if mm_vec is not None:
+            operands["mm_vec"], operands["mm_mask"] = mm_vec, mm_mask
             kind, fn = "step_mm", self._get_step_mm_fn()
         else:
             kind, fn = "step", self.step_fn
@@ -609,25 +622,36 @@ class AsyncJaxEngine:
             *(self._put_batch(k, v) for k, v in operands.items()),
             self.k_cache, self.v_cache)
 
-        self.scheduler.commit_computed(seq, end)
-        if seq.progress_cb is not None:
-            try:
-                seq.progress_cb(end)
-            except Exception:
-                # shipping is an optimization: stop it for THIS seq (the tail
-                # bundle covers whatever wasn't shipped) instead of letting
-                # the failure abort every in-flight sequence via _run's
-                # blanket handler
-                logger.exception("prefill progress callback failed; "
-                                 "disabling chunk shipping for %s",
-                                 seq.request_id)
-                seq.progress_cb = None
+        for w in works:
+            seq, end = w.seq, w.start + w.chunk
+            self.scheduler.commit_computed(seq, end)
+            if seq.progress_cb is not None:
+                try:
+                    seq.progress_cb(end)
+                except Exception:
+                    # shipping is an optimization: stop it for THIS seq (the
+                    # tail bundle covers whatever wasn't shipped) instead of
+                    # letting the failure abort every in-flight sequence via
+                    # _run's blanket handler
+                    logger.exception("prefill progress callback failed; "
+                                     "disabling chunk shipping for %s",
+                                     seq.request_id)
+                    seq.progress_cb = None
 
-        if work.sample:
-            toks, logps, tops = await self._sample([seq], logits)
-            self._deliver(seq, int(toks[0]), float(logps[0]), tops.get(0))
+        sample_rows = [(i, w.seq) for i, w in enumerate(works) if w.sample]
+        if sample_rows:
+            # gather the sampling rows, padded to a batch bucket so the
+            # sampling jit sees a bounded set of shapes
+            rows = [i for i, _ in sample_rows]
+            Bp = args.bucket_batch(len(rows))
+            idx = rows + [rows[0]] * (Bp - len(rows))
+            sel = logits[jnp.asarray(idx, jnp.int32)]
+            seqs = [s for _, s in sample_rows]
+            toks, logps, tops = await self._sample(seqs, sel)
+            for j, (_, seq) in enumerate(sample_rows):
+                self._deliver(seq, int(toks[j]), float(logps[j]), tops.get(j))
         else:
-            # chunk didn't reach the end: logits unused, but sync to pace the loop
+            # no chunk reached its end: logits unused, sync to pace the loop
             await asyncio.to_thread(lambda: logits.block_until_ready())
 
     # -------------------------------------------------------------- decode

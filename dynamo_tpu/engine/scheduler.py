@@ -86,12 +86,14 @@ class PrefillWork:
 
 @dataclass
 class StepPlan:
-    prefill: Optional[PrefillWork] = None
+    #: prefill chunks batched into ONE jitted call (same-bucket rows);
+    #: empty list = no prefill this step
+    prefill: list[PrefillWork] = field(default_factory=list)
     decode: list[SeqState] = field(default_factory=list)
 
     @property
     def empty(self) -> bool:
-        return self.prefill is None and not self.decode
+        return not self.prefill and not self.decode
 
 
 class Scheduler:
@@ -162,8 +164,18 @@ class Scheduler:
         budget -= len(plan.decode)
 
         if self.args.enable_chunked_prefill or not plan.decode:
+            # BATCHED prefill: several sequences' chunks ride one jitted
+            # call as rows of a [B, S_bucket] batch. Rows share one token
+            # bucket (the first chunk picks it; larger chunks wait for the
+            # next step) and the PADDED cost B·S_bucket is bounded by
+            # max_num_batched_tokens — concurrent prompts no longer
+            # serialize one-prefill-per-step.
             prefill_seqs = [s for s in self.running if s.remaining > 1]
+            s_bucket = None
+            max_b = self.args.max_num_seqs
             for s in prefill_seqs:
+                if s not in self.running:
+                    continue  # preempted by an earlier iteration's victim pick
                 chunk = min(s.remaining, max(0, budget))
                 if not self.args.enable_chunked_prefill and chunk < s.remaining:
                     if s.remaining > self.args.max_num_batched_tokens:
@@ -176,21 +188,34 @@ class Scheduler:
                                  "and chunked prefill is disabled"))
                         s.sink.put_nowait(None)
                     continue  # a shorter seq may still fit this step
-                if chunk <= 0:
+                if chunk <= 0 or len(plan.prefill) >= max_b:
                     break
+                bucket = self.args.bucket_tokens(chunk)
+                if s_bucket is None:
+                    s_bucket = bucket
+                elif bucket > s_bucket:
+                    continue  # would inflate every row's padding: next step
+                # padded-cost bound applies only when ADDING rows: the
+                # first chunk always runs even if its bucket exceeds the
+                # budget (custom buckets may be coarser than the budget —
+                # refusing it would wedge the engine forever)
+                if plan.prefill and (len(plan.prefill) + 1) * s_bucket > \
+                        self.args.max_num_batched_tokens:
+                    break
+                protected = plan.decode + [w.seq for w in plan.prefill]
                 if not self._ensure_blocks(s, s.num_computed + chunk):
-                    # not enough memory: preempt, but never a seq already in
-                    # THIS step's decode batch (its block table is about to
-                    # be indexed by the jitted call) — else wait
-                    if not self._preempt_for(s, exclude=plan.decode):
+                    # not enough memory: preempt, but never a seq whose
+                    # block table this step's jitted calls are about to
+                    # index — else wait
+                    if not self._preempt_for(s, exclude=protected):
                         break
                     if not self._ensure_blocks(s, s.num_computed + chunk):
                         break
-                plan.prefill = PrefillWork(
+                plan.prefill.append(PrefillWork(
                     seq=s, start=s.num_computed, chunk=chunk,
                     sample=(s.num_computed + chunk == len(s.tokens)),
-                )
-                break  # one prefill chunk per step
+                ))
+                budget -= chunk
         return plan
 
     # -- post-step bookkeeping ----------------------------------------------
