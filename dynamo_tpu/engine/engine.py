@@ -640,12 +640,23 @@ class AsyncJaxEngine:
 
         sample_rows = [(i, w.seq) for i, w in enumerate(works) if w.sample]
         if sample_rows:
-            # gather the sampling rows, padded to a batch bucket so the
-            # sampling jit sees a bounded set of shapes
             rows = [i for i, _ in sample_rows]
-            Bp = args.bucket_batch(len(rows))
-            idx = rows + [rows[0]] * (Bp - len(rows))
-            sel = logits[jnp.asarray(idx, jnp.int32)]
+            if rows == list(range(len(works))):
+                # common case (non-chunked prompts): every row samples —
+                # _sample tolerates padded B >= len(seqs), no gather needed
+                sel = logits
+            else:
+                # gather the sampling rows, padded to a batch bucket so the
+                # sampling jit sees a bounded set of shapes. Under
+                # multi-host this MUST be a host-side gather: a leader-only
+                # device op on the replicated global array would never be
+                # mirrored by the follower ranks (see _sample)
+                Bp = args.bucket_batch(len(rows))
+                idx = rows + [rows[0]] * (Bp - len(rows))
+                if self._multihost:
+                    sel = np.asarray(logits)[np.asarray(idx)]
+                else:
+                    sel = logits[jnp.asarray(idx, jnp.int32)]
             seqs = [s for _, s in sample_rows]
             toks, logps, tops = await self._sample(seqs, sel)
             for j, (_, seq) in enumerate(sample_rows):

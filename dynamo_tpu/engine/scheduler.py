@@ -172,7 +172,10 @@ class Scheduler:
             # serialize one-prefill-per-step.
             prefill_seqs = [s for s in self.running if s.remaining > 1]
             s_bucket = None
-            max_b = self.args.max_num_seqs
+            # row cap: the engine pads B to a decode_batch_bucket, so more
+            # rows than the largest bucket would overflow the padded batch
+            max_b = min(self.args.max_num_seqs,
+                        self.args.decode_batch_buckets[-1])
             for s in prefill_seqs:
                 if s not in self.running:
                     continue  # preempted by an earlier iteration's victim pick
