@@ -244,8 +244,11 @@ def main():
             "unit": "tok/s",
             "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
             "extra": {**kern, "e2e_error": repr(e)[:300]},
-        }))
-        return
+        }), flush=True)
+        # _e2e has no try/finally: a mid-flight failure leaves the service/
+        # engine/runtime threads alive, which would keep the interpreter
+        # (and the driver's timeout) hanging after the metric printed
+        os._exit(0)
 
     tok_s = e2e["e2e_tok_s"]
     print(json.dumps({

@@ -23,6 +23,7 @@ import functools
 import itertools
 import logging
 import time
+from collections import Counter
 from typing import AsyncIterator, Callable, Optional
 
 import numpy as np
@@ -39,6 +40,17 @@ from dynamo_tpu.router.protocols import (
 )
 
 logger = logging.getLogger("dynamo.engine")
+
+
+def _has_penalties(s) -> bool:
+    """True when the seq requests any sampling penalty (OpenAI presence/
+    frequency over generated text, nvext/HF repetition over prompt+generated
+    — ref: lib/llm/src/protocols/common.rs sampling options). Penalties need
+    the per-step token history, so these rows are excluded from the fused
+    burst and speculative paths."""
+    so = s.req.sampling_options
+    return bool(so.presence_penalty or so.frequency_penalty
+                or (so.repetition_penalty not in (None, 1.0)))
 
 
 class AsyncJaxEngine:
@@ -645,20 +657,24 @@ class AsyncJaxEngine:
                 # common case (non-chunked prompts): every row samples —
                 # _sample tolerates padded B >= len(seqs), no gather needed
                 sel = logits
+                rows = None
             else:
                 # gather the sampling rows, padded to a batch bucket so the
                 # sampling jit sees a bounded set of shapes. Under
-                # multi-host this MUST be a host-side gather: a leader-only
+                # multi-host the gather must be host-side (a leader-only
                 # device op on the replicated global array would never be
-                # mirrored by the follower ranks (see _sample)
+                # mirrored by the follower ranks) AND off the event loop
+                # (the host sync would stall the step broadcaster task) —
+                # _sample's worker thread does it when given ``rows``
                 Bp = args.bucket_batch(len(rows))
-                idx = rows + [rows[0]] * (Bp - len(rows))
+                rows = rows + [rows[0]] * (Bp - len(rows))
                 if self._multihost:
-                    sel = np.asarray(logits)[np.asarray(idx)]
+                    sel = logits  # gathered host-side in run_sampling
                 else:
-                    sel = logits[jnp.asarray(idx, jnp.int32)]
+                    sel = logits[jnp.asarray(rows, jnp.int32)]
+                    rows = None
             seqs = [s for _, s in sample_rows]
-            toks, logps, tops = await self._sample(seqs, sel)
+            toks, logps, tops = await self._sample(seqs, sel, rows=rows)
             for j, (_, seq) in enumerate(sample_rows):
                 self._deliver(seq, int(toks[j]), float(logps[j]), tops.get(j))
         else:
@@ -796,6 +812,7 @@ class AsyncJaxEngine:
                 and all(s.sampling_tuple()[0] == 0.0 for s in seqs)
                 and all(s.req.output_options.logprobs is None for s in seqs)
                 and all(not s.req.sampling_options.logit_bias for s in seqs)
+                and not any(_has_penalties(s) for s in seqs)
                 # a seq one token from its limit gains nothing from a draft
                 and all((s.req.stop_conditions.max_tokens is None
                          or s.req.stop_conditions.max_tokens - s.generated >= 2)
@@ -811,6 +828,7 @@ class AsyncJaxEngine:
                 # the single-step path
                 and all(s.req.output_options.logprobs is None for s in seqs)
                 and all(not s.req.sampling_options.logit_bias for s in seqs)
+                and not any(_has_penalties(s) for s in seqs)
                 # don't burn a burst when a seq is about to hit max_tokens —
                 # the overshoot steps would be computed and discarded
                 and all((s.req.stop_conditions.max_tokens is None
@@ -943,14 +961,19 @@ class AsyncJaxEngine:
         if self.broadcast_cb is not None:
             self.broadcast_cb(kind, arrays)
 
-    async def _sample(self, seqs: list[SeqState], logits):
+    async def _sample(self, seqs: list[SeqState], logits, rows=None):
         """Sample one token per seq from padded logits [B>=len(seqs), V].
+
+        ``rows`` (multi-host batched prefill): bucket-padded row indices to
+        gather from ``logits`` host-side, inside the worker thread — the
+        sync must stay off the event loop, and the gather must be local
+        (never a device op on the replicated global array).
 
         Returns (tokens, logps, tops) — ``tops[i]`` is the row's top-k
         [token_id, logprob] alternatives when seq i requested logprobs
         (ref surface: perf/logprobs.rs TokenLogProbs), else absent.
         """
-        B = logits.shape[0]
+        B = len(rows) if rows is not None else logits.shape[0]
         temp = np.zeros((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
@@ -968,39 +991,83 @@ class AsyncJaxEngine:
         steps += [0] * (B - len(seqs))
         keys = self._sampling.make_keys(seeds, steps)
 
-        # OpenAI logit_bias: sparse (row, token, value) triples — at most
-        # 300 entries per request, never a dense [B, V] materialization
-        b_rows, b_cols, b_vals = [], [], []
         V = logits.shape[-1]
-        for i, s in enumerate(seqs):
-            for tid, v in (s.req.sampling_options.logit_bias or {}).items():
-                t = int(tid)
-                if 0 <= t < V:
-                    b_rows.append(i)
-                    b_cols.append(t)
-                    b_vals.append(v)
+
+        def build_triples():
+            # sparse logit edits — at most a few hundred entries per row,
+            # never a dense [B, V] materialization. Built in the worker
+            # thread: the per-seq history scans (Counter over generated
+            # tokens, set over the full sequence) are O(context) and must
+            # not run on the event loop. seqs are not mutated while a step
+            # is in flight (the engine loop delivers only after _sample).
+            b_rows, b_cols, b_vals = [], [], []  # additive: bias + penalties
+            # repetition penalty is multiplicative read-modify-write (HF
+            # semantics: logit>0 -> /p else *p, over prompt+generated), so
+            # it gets its own triples, applied BEFORE the additive terms
+            r_rows, r_cols, r_pens = [], [], []
+            for i, s in enumerate(seqs):
+                so = s.req.sampling_options
+                for tid, v in (so.logit_bias or {}).items():
+                    t = int(tid)
+                    if 0 <= t < V:
+                        b_rows.append(i)
+                        b_cols.append(t)
+                        b_vals.append(v)
+                pres = so.presence_penalty or 0.0
+                freq = so.frequency_penalty or 0.0
+                if pres or freq:
+                    # OpenAI semantics: counted over the GENERATED text
+                    # only — rides the same sparse scatter-add as logit_bias
+                    for tid, cnt in Counter(s.tokens[s.prompt_len:]).items():
+                        if 0 <= tid < V:
+                            b_rows.append(i)
+                            b_cols.append(int(tid))
+                            b_vals.append(-(pres + freq * cnt))
+                rep = so.repetition_penalty
+                if rep is not None and rep > 0 and rep != 1.0:
+                    for tid in set(s.tokens):
+                        if 0 <= tid < V:
+                            r_rows.append(i)
+                            r_cols.append(int(tid))
+                            r_pens.append(float(rep))
+            return b_rows, b_cols, b_vals, r_rows, r_cols, r_pens
 
         def run_sampling():
             # runs in a worker thread: the host sync below must NEVER block
             # the event loop — under multi-host it waits on a collective the
             # FOLLOWER ranks can only join after the loop's broadcaster task
             # flushed the step (blocking the loop here deadlocked the fleet)
+            b_rows, b_cols, b_vals, r_rows, r_cols, r_pens = build_triples()
             lg = logits
-            if self._multihost:
+            if self._multihost or isinstance(lg, np.ndarray):
                 # logits are fully replicated (make_step_fn): round-trip
                 # through host so sampling is a LOCAL computation — a global
                 # op here would have to be mirrored by every follower rank
-                # (this includes the bias add below: numpy, never a device
-                # op on the global array)
+                # (this includes the penalty/bias edits below: numpy, never
+                # a device op on the global array)
                 lg = np.asarray(lg)
-                if b_rows:
+                if rows is not None:
+                    lg = lg[np.asarray(rows)]  # fancy index: fresh, writable
+                elif r_rows or b_rows:
                     lg = lg.copy()
+                if r_rows:
+                    v = lg[r_rows, r_cols]
+                    rp = np.asarray(r_pens, lg.dtype)
+                    lg[r_rows, r_cols] = np.where(v > 0, v / rp, v * rp)
+                if b_rows:
                     np.add.at(lg, (b_rows, b_cols), b_vals)
-            elif b_rows:  # single-host: a tiny device scatter-add
+            elif r_rows or b_rows:  # single-host: tiny device gather/scatter
                 import jax.numpy as jnp
 
-                lg = lg.at[jnp.asarray(b_rows), jnp.asarray(b_cols)].add(
-                    jnp.asarray(b_vals, lg.dtype))
+                if r_rows:
+                    rr = jnp.asarray(r_rows)
+                    rc = jnp.asarray(r_cols)
+                    rp = jnp.asarray(r_pens, lg.dtype)
+                    v = lg[rr, rc]
+                    lg = lg.at[rr, rc].set(jnp.where(v > 0, v / rp, v * rp))
+                if b_rows:
+                    lg = lg.at[jnp.asarray(b_rows), jnp.asarray(b_cols)].add(
+                        jnp.asarray(b_vals, lg.dtype))
             toks, logps = self._sampling.sample_jit(lg, temp, top_k, top_p,
                                                     keys)
             top_res = None

@@ -145,6 +145,10 @@ class Scheduler:
         plan = StepPlan()
 
         budget = self.args.max_num_batched_tokens
+        # row cap for BOTH batch lists: the engine pads B to a
+        # decode_batch_bucket, so more rows than the largest bucket would
+        # overflow the padded batch arrays
+        max_b = min(self.args.max_num_seqs, self.args.decode_batch_buckets[-1])
         decode_seqs = [s for s in self.running if s.remaining == 1]
 
         # ensure each decode seq has a block for its last position; preempt on
@@ -160,7 +164,7 @@ class Scheduler:
             else:
                 if not self._preempt_for(s):
                     self._preempt(s)
-        plan.decode = [s for s in ready_decode if s in self.running][: self.args.max_num_seqs]
+        plan.decode = [s for s in ready_decode if s in self.running][:max_b]
         budget -= len(plan.decode)
 
         if self.args.enable_chunked_prefill or not plan.decode:
@@ -172,10 +176,6 @@ class Scheduler:
             # serialize one-prefill-per-step.
             prefill_seqs = [s for s in self.running if s.remaining > 1]
             s_bucket = None
-            # row cap: the engine pads B to a decode_batch_bucket, so more
-            # rows than the largest bucket would overflow the padded batch
-            max_b = min(self.args.max_num_seqs,
-                        self.args.decode_batch_buckets[-1])
             for s in prefill_seqs:
                 if s not in self.running:
                     continue  # preempted by an earlier iteration's victim pick

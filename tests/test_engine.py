@@ -451,3 +451,18 @@ async def test_prefill_runs_when_bucket_exceeds_budget():
         collect(eng, req(list(range(1, 40)), max_tokens=3)), 60)
     assert len(toks) == 3 and reason == FinishReason.LENGTH
     await eng.close()
+
+
+async def test_decode_batch_capped_at_largest_bucket():
+    """More concurrent decode seqs than decode_batch_buckets[-1]: the
+    scheduler must cap the decode (and spec/burst) batch at the largest
+    bucket — the engine pads B with bucket_batch, so extra rows would
+    index out of bounds in the step's batch arrays."""
+    eng = tiny_engine(max_num_seqs=8, decode_batch_buckets=(1, 2))
+    prompts = [list(range(1 + 7 * i, 7 * i + 6)) for i in range(5)]
+    results = await asyncio.wait_for(
+        asyncio.gather(*(collect(eng, req(p, max_tokens=4))
+                         for p in prompts)), 120)
+    for toks, reason in results:
+        assert len(toks) == 4 and reason == FinishReason.LENGTH
+    await eng.close()
