@@ -64,6 +64,9 @@ class ModelConfig:
     topk_group: int = 1
     # attention extras
     qkv_bias: bool = False  # Qwen2-style
+    #: per-head RMSNorm on q and k before RoPE (Qwen3 / Qwen3-MoE); the
+    #: learned scale has head_dim width, shared across heads
+    qk_norm: bool = False
     o_bias: bool = False  # gpt-oss: o_proj carries a bias too
     sliding_window: Optional[int] = None
     #: per-layer sliding windows (gpt-oss alternates sliding/full layers);
@@ -155,6 +158,15 @@ class ModelConfig:
         arch = (d.get("architectures") or [""])[0].lower()
         is_deepseek = "deepseek" in arch
         is_gpt_oss = "gptoss" in arch
+        if "qwen3moe" in arch:
+            # the uniform layer stack (lax.scan) requires every non-prefix
+            # layer to be MoE; refuse irregular sparsity loudly rather than
+            # serving a silently-wrong forward
+            if d.get("mlp_only_layers") or d.get("decoder_sparse_step", 1) != 1:
+                raise ValueError(
+                    "Qwen3-MoE checkpoints with mlp_only_layers or "
+                    "decoder_sparse_step != 1 interleave dense layers mid-"
+                    "stack, which the stacked-layer forward does not support")
         mla = is_deepseek and d.get("kv_lora_rank") is not None
         layer_windows = None
         if is_gpt_oss:
@@ -178,7 +190,10 @@ class ModelConfig:
             rms_norm_eps=d.get("rms_norm_eps", 1e-5),
             max_position_embeddings=d.get("max_position_embeddings", 8192),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
-            num_experts=d.get("num_local_experts", d.get("n_routed_experts", 0)) or 0,
+            num_experts=(d.get("num_local_experts")       # mixtral
+                         or d.get("n_routed_experts")      # deepseek
+                         or d.get("num_experts", 0)        # qwen3-moe
+                         or 0),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             moe_intermediate_size=d.get("moe_intermediate_size"),
             n_shared_experts=d.get("n_shared_experts", 0) or 0,
@@ -199,6 +214,7 @@ class ModelConfig:
             v_head_dim=d.get("v_head_dim", 128),
             qkv_bias=("qwen2" in arch
                       or (is_gpt_oss and d.get("attention_bias", True))),
+            qk_norm="qwen3" in arch,
             o_bias=is_gpt_oss and d.get("attention_bias", True),
             layer_windows=layer_windows,
             attention_sinks=is_gpt_oss,

@@ -23,7 +23,6 @@ import functools
 import itertools
 import logging
 import time
-from collections import Counter
 from typing import AsyncIterator, Callable, Optional
 
 import numpy as np
@@ -1015,17 +1014,27 @@ class AsyncJaxEngine:
                         b_vals.append(v)
                 pres = so.presence_penalty or 0.0
                 freq = so.frequency_penalty or 0.0
+                rep = so.repetition_penalty
+                rep_on = rep is not None and rep > 0 and rep != 1.0
+                if pres or freq or rep_on:
+                    # fold new history incrementally (ngram_pos pattern):
+                    # O(new tokens) per step, not O(context)
+                    for j in range(s.pen_indexed, len(s.tokens)):
+                        t = s.tokens[j]
+                        s.seen_tokens.add(t)
+                        if j >= s.prompt_len:
+                            s.gen_counts[t] = s.gen_counts.get(t, 0) + 1
+                    s.pen_indexed = len(s.tokens)
                 if pres or freq:
                     # OpenAI semantics: counted over the GENERATED text
                     # only — rides the same sparse scatter-add as logit_bias
-                    for tid, cnt in Counter(s.tokens[s.prompt_len:]).items():
+                    for tid, cnt in s.gen_counts.items():
                         if 0 <= tid < V:
                             b_rows.append(i)
                             b_cols.append(int(tid))
                             b_vals.append(-(pres + freq * cnt))
-                rep = so.repetition_penalty
-                if rep is not None and rep > 0 and rep != 1.0:
-                    for tid in set(s.tokens):
+                if rep_on:
+                    for tid in s.seen_tokens:
                         if 0 <= tid < V:
                             r_rows.append(i)
                             r_cols.append(int(tid))

@@ -107,6 +107,9 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
                 out["bq"] = get(f"{pre}.q_proj.bias")
                 out["bk"] = get(f"{pre}.k_proj.bias")
                 out["bv"] = get(f"{pre}.v_proj.bias")
+            if cfg.qk_norm:
+                out["q_norm"] = get(f"{pre}.q_norm.weight")
+                out["k_norm"] = get(f"{pre}.k_norm.weight")
             if cfg.o_bias:
                 out["bo"] = get(f"{pre}.o_proj.bias")
             if cfg.attention_sinks:

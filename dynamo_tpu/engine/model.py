@@ -92,6 +92,9 @@ def _init_layer_stack(cfg: ModelConfig, key: jax.Array, n: int, moe: bool,
             layers["bq"] = jnp.zeros((n, H * hd), dtype)
             layers["bk"] = jnp.zeros((n, KV * hd), dtype)
             layers["bv"] = jnp.zeros((n, KV * hd), dtype)
+        if cfg.qk_norm:
+            layers["q_norm"] = jnp.ones((n, hd), dtype)
+            layers["k_norm"] = jnp.ones((n, hd), dtype)
         if cfg.o_bias:
             layers["bo"] = jnp.zeros((n, D), dtype)
         if cfg.attention_sinks:
@@ -178,6 +181,9 @@ def _layer_stack_shardings(cfg: ModelConfig, mesh: Mesh, moe: bool) -> dict:
             layers["bq"] = ns(None, "tp")
             layers["bk"] = ns(None, "tp")
             layers["bv"] = ns(None, "tp")
+        if cfg.qk_norm:
+            layers["q_norm"] = ns(None, None)
+            layers["k_norm"] = ns(None, None)
         if cfg.o_bias:
             layers["bo"] = ns(None, None)
         if cfg.attention_sinks:
@@ -775,6 +781,9 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         q = q.reshape(B, S, H, hd)
         k = k.reshape(B, S, KV, hd)
         v = v.reshape(B, S, KV, hd)
+        if cfg.qk_norm:  # Qwen3: per-head RMSNorm before RoPE
+            q = _rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+            k = _rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
         q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
@@ -1001,10 +1010,13 @@ def embedding_forward(params, tokens, lengths, *, cfg: ModelConfig):
         v = h @ lp["wv"]
         if "bq" in lp:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-        q = _rope(q.reshape(B, S, H, hd), positions, cfg.rope_theta,
-                  cfg.rope_scaling)
-        k = _rope(k.reshape(B, S, KV, hd), positions, cfg.rope_theta,
-                  cfg.rope_scaling)
+        q = q.reshape(B, S, H, hd)
+        k = k.reshape(B, S, KV, hd)
+        if cfg.qk_norm:
+            q = _rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+            k = _rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         v = v.reshape(B, S, KV, hd)
         qg = q.reshape(B, S, KV, G, hd).astype(jnp.float32)
         s = jnp.einsum("bskgd,btkd->bkgst", qg, k.astype(jnp.float32))

@@ -58,6 +58,13 @@ class SeqState:
     #: per decode step
     ngram_pos: dict = field(default_factory=dict)
     ngram_indexed: int = 0
+    #: sampling penalties: incrementally-folded token history (engine
+    #: _sample.build_triples) — ``gen_counts`` counts GENERATED tokens
+    #: (presence/frequency), ``seen_tokens`` is distinct prompt+generated
+    #: (repetition), ``pen_indexed`` the fold watermark into ``tokens``
+    gen_counts: dict = field(default_factory=dict)
+    seen_tokens: set = field(default_factory=set)
+    pen_indexed: int = 0
     #: disagg pipelining: called with (num_computed) after each prefill chunk
     #: commits — lets the owner ship finished blocks while later chunks run
     progress_cb: Optional[Callable] = None

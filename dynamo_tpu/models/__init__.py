@@ -3,7 +3,7 @@
 The engine's forward pass (engine/model.py) natively covers the llama
 decoder family — RoPE + RMSNorm + GQA paged attention, SwiGLU MLP — plus
 token-choice MoE (Mixtral-style, experts shardable over "tp" = EP),
-sliding-window attention (Mistral), QKV bias (Qwen2), and MLA — multi-head
+sliding-window attention (Mistral), QKV bias (Qwen2), QK-norm (Qwen3 dense + MoE), and MLA — multi-head
 latent attention with a compressed paged cache (DeepSeek V2/V3, incl.
 sigmoid + group-limited routing, shared experts, and the dense layer
 prefix). Presets below are the shapes used by the reference's recipes (ref:
@@ -28,6 +28,23 @@ def qwen2_7b() -> ModelConfig:
         vocab_size=152064, hidden_size=3584, intermediate_size=18944,
         num_layers=28, num_heads=28, num_kv_heads=4, rope_theta=1000000.0,
         max_position_embeddings=32768, qkv_bias=True)
+
+
+def qwen3_8b() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=151936, hidden_size=4096, intermediate_size=12288,
+        num_layers=36, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=1000000.0, max_position_embeddings=40960, qk_norm=True)
+
+
+def qwen3_moe_30b_a3b() -> ModelConfig:
+    """Qwen3-30B-A3B: 128 experts, 8 active — EP-friendly on a tpu mesh."""
+    return ModelConfig(
+        vocab_size=151936, hidden_size=2048, intermediate_size=6144,
+        num_layers=48, num_heads=32, num_kv_heads=4, head_dim=128,
+        rope_theta=1000000.0, max_position_embeddings=40960, qk_norm=True,
+        num_experts=128, num_experts_per_tok=8, moe_intermediate_size=768,
+        norm_topk_prob=True)
 
 
 def mixtral_8x7b() -> ModelConfig:
@@ -135,6 +152,8 @@ PRESETS = {
     "llama3_70b": ModelConfig.llama3_70b,
     "mistral_7b": mistral_7b,
     "qwen2_7b": qwen2_7b,
+    "qwen3_8b": qwen3_8b,
+    "qwen3_moe_30b_a3b": qwen3_moe_30b_a3b,
     "mixtral_8x7b": mixtral_8x7b,
     "mla_tiny": mla_tiny,
     "deepseek_v2_lite": deepseek_v2_lite,

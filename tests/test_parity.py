@@ -150,3 +150,37 @@ def test_mixtral_moe_parity(tmp_path):
         sliding_window=None, tie_word_embeddings=False,
         attn_implementation="eager")
     _check_parity(transformers.MixtralForCausalLM, hf_cfg, tmp_path)
+
+
+def test_qwen3_qk_norm_parity(tmp_path):
+    """Per-head RMSNorm on q/k before RoPE + explicit head_dim != D/H."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, rope_theta=10000.0, max_position_embeddings=256,
+        tie_word_embeddings=False, attn_implementation="eager")
+    _check_parity(transformers.Qwen3ForCausalLM, hf_cfg, tmp_path)
+    cfg = ModelConfig.from_pretrained(str(tmp_path))
+    assert cfg.qk_norm and not cfg.qkv_bias and cfg.head_dim == 32
+
+
+def test_qwen3_moe_parity(tmp_path):
+    """QK-norm + standard softmax top-k routing with gate renormalization."""
+    hf_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        rope_theta=10000.0, max_position_embeddings=256,
+        tie_word_embeddings=False, attn_implementation="eager")
+    _check_parity(transformers.Qwen3MoeForCausalLM, hf_cfg, tmp_path)
+    cfg = ModelConfig.from_pretrained(str(tmp_path))
+    assert cfg.qk_norm and cfg.num_experts == 4 and cfg.norm_topk_prob
+
+
+def test_qwen3_moe_irregular_sparsity_refused():
+    with pytest.raises(ValueError, match="decoder_sparse_step"):
+        ModelConfig.from_hf_config({
+            "architectures": ["Qwen3MoeForCausalLM"],
+            "num_experts": 4, "decoder_sparse_step": 2})
