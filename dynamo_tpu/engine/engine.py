@@ -65,7 +65,8 @@ class AsyncJaxEngine:
 
     def __init__(self, cfg: ModelConfig, args: EngineArgs, params=None,
                  mesh=None, event_cb: Optional[Callable] = None,
-                 metrics_cb: Optional[Callable] = None):
+                 metrics_cb: Optional[Callable] = None,
+                 guided_vocab: Optional[list] = None):
         import jax
         from dynamo_tpu.engine import model as M
 
@@ -137,6 +138,10 @@ class AsyncJaxEngine:
         from dynamo_tpu.engine import sampling as S
         self._sampling = S
 
+        #: id → token text, for guided decoding's token-level DFA walks
+        #: (engine/main.py decodes it from the served tokenizer); None =
+        #: guided requests are refused
+        self.guided_vocab = guided_vocab
         self._seq_counter = itertools.count()
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
@@ -148,14 +153,34 @@ class AsyncJaxEngine:
 
     # ------------------------------------------------------------------ api
 
+    def _new_seq(self, req: PreprocessedRequest, ctx, sink,
+                 **kw) -> SeqState:
+        """Build a SeqState — the ONE place request-scoped engine state
+        (like the guided-decoding cursor) attaches, so every entry path
+        (generate, disagg prefill_extract, generate_prefilled/injected)
+        honors it."""
+        seq = SeqState(request_id=f"seq-{next(self._seq_counter)}",
+                       req=req, ctx=ctx or _NullCtx(), sink=sink, **kw)
+        if req.sampling_options.guided:
+            from dynamo_tpu.llm.guided import compile_guided
+            if self.guided_vocab is None:
+                raise ValueError(
+                    "guided decoding requested but this worker has no "
+                    "tokenizer vocabulary (engine started without "
+                    "guided_vocab)")
+            # compile is cheap (machines are cached across requests); the
+            # per-state vocab walks happen in the sampling worker thread
+            seq.guided_state = compile_guided(
+                req.sampling_options.guided, self.guided_vocab,
+                req.eos_token_ids or [])
+        return seq
+
     async def generate(self, req: PreprocessedRequest, ctx=None
                        ) -> AsyncIterator[LLMEngineOutput]:
         """EngineFn-compatible async stream of per-token outputs."""
         self._ensure_loop()
         sink: asyncio.Queue = asyncio.Queue()
-        seq = SeqState(
-            request_id=f"seq-{next(self._seq_counter)}",
-            req=req, ctx=ctx or _NullCtx(), sink=sink)
+        seq = self._new_seq(req, ctx, sink)
         self.scheduler.add(seq)
         self._wake.set()
         while True:
@@ -242,9 +267,7 @@ class AsyncJaxEngine:
                                  min_tokens=1, ignore_eos=True)
         preq = dataclasses.replace(req, stop_conditions=sc)
         sink: asyncio.Queue = asyncio.Queue()
-        seq = SeqState(request_id=f"seq-{next(self._seq_counter)}",
-                       req=preq, ctx=ctx or _NullCtx(), sink=sink,
-                       hold_blocks=True)
+        seq = self._new_seq(preq, ctx, sink, hold_blocks=True)
         self.scheduler.add(seq)
         self._wake.set()
         token, logp = None, None
@@ -322,9 +345,8 @@ class AsyncJaxEngine:
             events.put_nowait(("chunk", (state["shipped"], len(ids), kb, vb)))
             state["shipped"] = full
 
-        seq = SeqState(request_id=f"seq-{next(self._seq_counter)}",
-                       req=preq, ctx=ctx or _NullCtx(), sink=sink,
-                       hold_blocks=True, progress_cb=on_progress)
+        seq = self._new_seq(preq, ctx, sink, hold_blocks=True,
+                            progress_cb=on_progress)
 
         async def drain_sink():
             while True:
@@ -433,8 +455,12 @@ class AsyncJaxEngine:
         """
         self._ensure_loop()
         sink: asyncio.Queue = asyncio.Queue()
-        seq = SeqState(request_id=f"seq-{next(self._seq_counter)}",
-                       req=req, ctx=ctx or _NullCtx(), sink=sink)
+        seq = self._new_seq(req, ctx, sink)
+        if seq.guided_state is not None:
+            # the prefill worker sampled this token under the same mask
+            # (it compiles the same options); re-advance the local cursor —
+            # in a thread, since a new DFA state costs an O(vocab) walk
+            await asyncio.to_thread(seq.guided_state.advance, token_id)
         self.scheduler.add_prefilled(seq, ids)
 
         # the prefill worker's token is the stream's first output
@@ -812,6 +838,7 @@ class AsyncJaxEngine:
                 and all(s.req.output_options.logprobs is None for s in seqs)
                 and all(not s.req.sampling_options.logit_bias for s in seqs)
                 and not any(_has_penalties(s) for s in seqs)
+                and all(s.guided_state is None for s in seqs)
                 # a seq one token from its limit gains nothing from a draft
                 and all((s.req.stop_conditions.max_tokens is None
                          or s.req.stop_conditions.max_tokens - s.generated >= 2)
@@ -828,6 +855,7 @@ class AsyncJaxEngine:
                 and all(s.req.output_options.logprobs is None for s in seqs)
                 and all(not s.req.sampling_options.logit_bias for s in seqs)
                 and not any(_has_penalties(s) for s in seqs)
+                and all(s.guided_state is None for s in seqs)
                 # don't burn a burst when a seq is about to hit max_tokens —
                 # the overshoot steps would be computed and discarded
                 and all((s.req.stop_conditions.max_tokens is None
@@ -1039,14 +1067,22 @@ class AsyncJaxEngine:
                             r_rows.append(i)
                             r_cols.append(int(tid))
                             r_pens.append(float(rep))
-            return b_rows, b_cols, b_vals, r_rows, r_cols, r_pens
+            # guided decoding: rows whose logits are masked to the
+            # constraint's allowed set (allowed() walks the vocab once per
+            # NEW dfa state — here in the worker thread, cached after)
+            g_rows = [(i, [t for t in s.guided_state.allowed_token_ids()
+                           if 0 <= t < V])
+                      for i, s in enumerate(seqs)
+                      if s.guided_state is not None]
+            return b_rows, b_cols, b_vals, r_rows, r_cols, r_pens, g_rows
 
         def run_sampling():
             # runs in a worker thread: the host sync below must NEVER block
             # the event loop — under multi-host it waits on a collective the
             # FOLLOWER ranks can only join after the loop's broadcaster task
             # flushed the step (blocking the loop here deadlocked the fleet)
-            b_rows, b_cols, b_vals, r_rows, r_cols, r_pens = build_triples()
+            (b_rows, b_cols, b_vals, r_rows, r_cols, r_pens,
+             g_rows) = build_triples()
             lg = logits
             if self._multihost or isinstance(lg, np.ndarray):
                 # logits are fully replicated (make_step_fn): round-trip
@@ -1057,7 +1093,7 @@ class AsyncJaxEngine:
                 lg = np.asarray(lg)
                 if rows is not None:
                     lg = lg[np.asarray(rows)]  # fancy index: fresh, writable
-                elif r_rows or b_rows:
+                elif r_rows or b_rows or g_rows:
                     lg = lg.copy()
                 if r_rows:
                     v = lg[r_rows, r_cols]
@@ -1065,7 +1101,14 @@ class AsyncJaxEngine:
                     lg[r_rows, r_cols] = np.where(v > 0, v / rp, v * rp)
                 if b_rows:
                     np.add.at(lg, (b_rows, b_cols), b_vals)
-            elif r_rows or b_rows:  # single-host: tiny device gather/scatter
+                for i, allowed in g_rows:
+                    masked = np.full((lg.shape[-1],), -1e30, lg.dtype)
+                    if allowed:
+                        ai = np.asarray(allowed)
+                        masked[ai] = lg[i, ai]
+                    lg[i] = masked
+            elif r_rows or b_rows or g_rows:
+                # single-host: tiny device gather/scatter
                 import jax.numpy as jnp
 
                 if r_rows:
@@ -1077,6 +1120,12 @@ class AsyncJaxEngine:
                 if b_rows:
                     lg = lg.at[jnp.asarray(b_rows), jnp.asarray(b_cols)].add(
                         jnp.asarray(b_vals, lg.dtype))
+                for i, allowed in g_rows:
+                    masked = jnp.full((lg.shape[-1],), -1e30, lg.dtype)
+                    if allowed:
+                        ai = jnp.asarray(allowed)
+                        masked = masked.at[ai].set(lg[i, ai])
+                    lg = lg.at[i].set(masked)
             toks, logps = self._sampling.sample_jit(lg, temp, top_k, top_p,
                                                     keys)
             top_res = None
@@ -1088,6 +1137,12 @@ class AsyncJaxEngine:
                 # sliced per row below
                 top_res = self._sampling.make_topk_logprobs_fn(20)(lg, toks)
             t, l = np.asarray(toks), np.asarray(logps)
+            for gi, gs in enumerate(seqs):
+                if gs.guided_state is not None:
+                    # advance here, in the worker thread: a newly-visited
+                    # DFA state triggers an O(vocab) walk that must stay
+                    # off the event loop (_deliver does not advance)
+                    gs.guided_state.advance(int(t[gi]))
             tops: dict[int, list[list]] = {}
             if top_res is not None:
                 ids, vals, sel = (np.asarray(x) for x in top_res)

@@ -53,7 +53,8 @@ def build_engine(cli, cfg: ModelConfig, args: EngineArgs):
     if getattr(cli, "_resolved_model", None) is not None:
         params = cli._resolved_model.load_params(cfg)
 
-    return AsyncJaxEngine(cfg, args, params=params, mesh=mesh)
+    return AsyncJaxEngine(cfg, args, params=params, mesh=mesh,
+                          guided_vocab=getattr(cli, "_guided_vocab", None))
 
 
 async def amain():
@@ -213,6 +214,16 @@ async def amain():
         cli._mh_rank, cli._mh_world = init_multihost(
             cli.jax_coordinator, cli.jax_num_processes, cli.jax_process_id)
 
+    cli._guided_vocab = None
+    if tokenizer_ref and cli.role != "prefill":
+        try:
+            from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+            cli._guided_vocab = TokenizerWrapper.from_dir(
+                tokenizer_ref).guided_vocab()
+        except Exception:
+            logging.getLogger("dynamo.engine.main").warning(
+                "could not decode vocab from %s; guided decoding disabled",
+                tokenizer_ref, exc_info=True)
     engine = build_engine(cli, cfg, args)  # heavy JAX work first (see above)
     runtime = await DistributedRuntime.create()
 

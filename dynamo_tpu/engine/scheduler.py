@@ -65,6 +65,9 @@ class SeqState:
     gen_counts: dict = field(default_factory=dict)
     seen_tokens: set = field(default_factory=set)
     pen_indexed: int = 0
+    #: guided decoding constraint cursor (llm/guided.GuidedState), attached
+    #: by the engine when the request carries guided options
+    guided_state: object = None
     #: disagg pipelining: called with (num_computed) after each prefill chunk
     #: commits — lets the owner ship finished blocks while later chunks run
     progress_cb: Optional[Callable] = None
@@ -265,6 +268,12 @@ class Scheduler:
         if not sc.ignore_eos and token in (seq.req.eos_token_ids or []):
             if (sc.min_tokens or 0) < seq.generated:
                 return FinishReason.EOS
+        gs = seq.guided_state
+        if gs is not None and (gs.done or gs.exhausted):
+            # constraint completed (or hit a token-level dead end): stop
+            # even without EOS ids / with ignore_eos — free-running past
+            # the constraint would emit unconstrained tokens
+            return FinishReason.STOP
         if sc.max_tokens is not None and seq.generated >= sc.max_tokens:
             return FinishReason.LENGTH
         if seq.num_computed + 1 >= self.args.max_model_len:

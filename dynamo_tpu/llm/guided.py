@@ -1,0 +1,526 @@
+"""Guided decoding: constrain sampling to a regex / JSON schema / choice set.
+
+The reference accepts ``guided_json`` / ``guided_regex`` / ``guided_choice``
+/ ``guided_grammar`` on every request (ref: lib/llm/src/protocols/openai/
+common_ext.rs:53-73, validated mutually-exclusive in protocols/common.rs
+GuidedDecodingOptions) and forwards them to its engines, which implement
+the constraint with xgrammar/outlines. Here the constraint runs in-process:
+
+1. a small regex engine (subset) compiles the pattern to an NFA (Thompson
+   construction) determinized LAZILY into a char-level DFA;
+2. :class:`TokenMachine` lifts the char DFA to token level — for each DFA
+   state it computes, once, the set of vocabulary tokens whose full text
+   walks to a live state, and where each lands;
+3. the engine masks every logit outside the allowed set each step (the
+   same sparse host-side logit-edit path as logit_bias/penalties) and
+   advances the per-sequence :class:`GuidedState` with the sampled token.
+
+TPU-fit: the constraint work is host-side Python on O(allowed) sparse
+sets; the device never sees dynamic shapes — masks ride the existing
+bucketed sampling dispatch.
+
+``guided_grammar`` (EBNF) is refused loudly rather than approximated.
+
+Regex subset: literals, ``.``, escapes (``\\d \\w \\s \\D \\W \\S`` and
+escaped metachars), classes ``[...]``/``[^...]`` with ranges, groups
+``(...)``, alternation ``|``, quantifiers ``* + ? {m} {m,} {m,n}``.
+Anchoring is implicit (full-match), as in outlines.
+"""
+
+from __future__ import annotations
+
+import json
+import re as _pyre
+from typing import Optional
+
+_META = set("\\.[](){}|*+?^$")
+
+
+# --------------------------------------------------------------- regex → NFA
+
+class _Frag:
+    """NFA fragment: start state + list of dangling (state, key) out-edges.
+
+    States are dicts: key → list of next-state ids, where key is None
+    (epsilon) or a frozenset of chars, or the sentinel ``ANY``.
+    """
+
+    def __init__(self, start, outs):
+        self.start = start
+        self.outs = outs
+
+
+ANY = "<any>"
+_DIGITS = frozenset("0123456789")
+_WORD = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_")
+_SPACE = frozenset(" \t\n\r\f\v")
+#: complement classes are expressed against this universe (printable ASCII
+#: + whitespace) — guided outputs are JSON/regex text, not arbitrary bytes
+_UNIVERSE = frozenset(chr(c) for c in range(32, 127)) | _SPACE
+
+
+class _Nfa:
+    def __init__(self):
+        self.trans: list[list] = []  # state -> [(charset|None|ANY, next)]
+
+    def state(self) -> int:
+        self.trans.append([])
+        return len(self.trans) - 1
+
+    def edge(self, a, key, b):
+        self.trans[a].append((key, b))
+
+
+class _RegexParser:
+    """Recursive-descent parser for the supported subset."""
+
+    def __init__(self, pattern: str, nfa: _Nfa):
+        self.p = pattern
+        self.i = 0
+        self.nfa = nfa
+
+    def _peek(self):
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def _eat(self):
+        c = self.p[self.i]
+        self.i += 1
+        return c
+
+    def parse(self):
+        frag = self._alt()
+        if self.i != len(self.p):
+            raise ValueError(f"unexpected {self.p[self.i]!r} at {self.i} "
+                             f"in regex {self.p!r}")
+        return frag
+
+    def _alt(self):
+        branches = [self._concat()]
+        while self._peek() == "|":
+            self._eat()
+            branches.append(self._concat())
+        if len(branches) == 1:
+            return branches[0]
+        s = self.nfa.state()
+        outs = []
+        for b in branches:
+            self.nfa.edge(s, None, b.start)
+            outs += b.outs
+        return _Frag(s, outs)
+
+    def _concat(self):
+        frags = []
+        while self._peek() is not None and self._peek() not in "|)":
+            frags.append(self._repeat())
+        if not frags:
+            s = self.nfa.state()
+            return _Frag(s, [(s, None)])
+        cur = frags[0]
+        for nxt in frags[1:]:
+            cur = self._join(cur, nxt)
+        return cur
+
+    def _join(self, a, b):
+        for st, key in a.outs:
+            self.nfa.edge(st, key, b.start)
+        return _Frag(a.start, b.outs)
+
+    def _repeat(self):
+        atom = self._atom()
+        c = self._peek()
+        if c == "*":
+            self._eat()
+            return self._star(atom)
+        if c == "+":
+            self._eat()
+            return self._join(atom, self._star(self._clone(atom)))
+        if c == "?":
+            self._eat()
+            return self._opt(atom)
+        if c == "{":
+            return self._counted(atom)
+        return atom
+
+    def _counted(self, atom):
+        j = self.p.index("}", self.i)
+        spec = self.p[self.i + 1:j]
+        self.i = j + 1
+        if "," in spec:
+            lo_s, hi_s = spec.split(",", 1)
+            lo, hi = int(lo_s or 0), (int(hi_s) if hi_s else None)
+        else:
+            lo = hi = int(spec)
+        frag = None
+        for _ in range(lo):
+            c = self._clone(atom)
+            frag = c if frag is None else self._join(frag, c)
+        if hi is None:
+            tail = self._star(self._clone(atom))
+            return tail if frag is None else self._join(frag, tail)
+        for _ in range(hi - lo):
+            c = self._opt(self._clone(atom))
+            frag = c if frag is None else self._join(frag, c)
+        if frag is None:  # {0}
+            s = self.nfa.state()
+            return _Frag(s, [(s, None)])
+        return frag
+
+    def _star(self, atom):
+        s = self.nfa.state()
+        self.nfa.edge(s, None, atom.start)
+        for st, key in atom.outs:
+            self.nfa.edge(st, key, s)
+        return _Frag(s, [(s, None)])
+
+    def _opt(self, atom):
+        s = self.nfa.state()
+        self.nfa.edge(s, None, atom.start)
+        return _Frag(s, atom.outs + [(s, None)])
+
+    def _clone(self, frag):
+        """Re-parse is simpler than graph cloning: atoms record their span."""
+        start, end = frag.span
+        sub = _RegexParser(self.p[start:end], self.nfa)
+        out = sub._alt_noconsume()
+        out.span = frag.span
+        return out
+
+    def _alt_noconsume(self):
+        return self._alt()
+
+    def _atom(self):
+        start_pos = self.i
+        c = self._eat()
+        if c == "(":
+            inner = self._alt()
+            if self._peek() != ")":
+                raise ValueError(f"unbalanced group in {self.p!r}")
+            self._eat()
+            frag = inner
+        elif c == "[":
+            frag = self._charclass()
+        elif c == ".":
+            frag = self._edge_frag(ANY)
+        elif c == "\\":
+            frag = self._edge_frag(self._escape(self._eat()))
+        elif c in "*+?{":
+            raise ValueError(f"dangling quantifier in {self.p!r}")
+        else:
+            frag = self._edge_frag(frozenset(c))
+        frag.span = (start_pos, self.i)
+        return frag
+
+    def _edge_frag(self, key):
+        a = self.nfa.state()
+        return _Frag(a, [(a, key)])
+
+    def _escape(self, c):
+        table = {"d": _DIGITS, "w": _WORD, "s": _SPACE,
+                 "D": _UNIVERSE - _DIGITS, "W": _UNIVERSE - _WORD,
+                 "S": _UNIVERSE - _SPACE,
+                 "n": frozenset("\n"), "t": frozenset("\t"),
+                 "r": frozenset("\r")}
+        if c in table:
+            return table[c]
+        return frozenset(c)  # escaped literal/metachar
+
+    def _charclass(self):
+        neg = self._peek() == "^"
+        if neg:
+            self._eat()
+        chars = set()
+        first = True
+        while True:
+            c = self._peek()
+            if c is None:
+                raise ValueError(f"unterminated class in {self.p!r}")
+            if c == "]" and not first:
+                self._eat()
+                break
+            first = False
+            c = self._eat()
+            if c == "\\":
+                chars |= self._escape(self._eat())
+                continue
+            if self._peek() == "-" and self.i + 1 < len(self.p) \
+                    and self.p[self.i + 1] != "]":
+                self._eat()
+                hi = self._eat()
+                if hi == "\\":
+                    hi = self._eat()
+                chars |= {chr(x) for x in range(ord(c), ord(hi) + 1)}
+            else:
+                chars.add(c)
+        key = (_UNIVERSE - chars) if neg else frozenset(chars)
+        return self._edge_frag(key)
+
+
+class CharDfa:
+    """Lazily-determinized DFA over characters (subset construction)."""
+
+    def __init__(self, pattern: str):
+        self.nfa = _Nfa()
+        frag = _RegexParser(pattern, self.nfa).parse()
+        accept = self.nfa.state()
+        for st, key in frag.outs:
+            self.nfa.edge(st, key, accept)
+        self.accept_nfa = accept
+        self.start = self._closure(frozenset([frag.start]))
+        self._step_cache: dict = {}
+
+    def _closure(self, states: frozenset) -> frozenset:
+        seen = set(states)
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            for key, nxt in self.nfa.trans[s]:
+                if key is None and nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return frozenset(seen)
+
+    def step(self, state: frozenset, ch: str) -> Optional[frozenset]:
+        """None = dead."""
+        cached = self._step_cache.get((state, ch))
+        if cached is not None:
+            return cached if cached != DEAD else None
+        nxt = set()
+        for s in state:
+            for key, t in self.nfa.trans[s]:
+                if key is None:
+                    continue
+                if key == ANY or ch in key:
+                    nxt.add(t)
+        out = self._closure(frozenset(nxt)) if nxt else None
+        self._step_cache[(state, ch)] = out if out is not None else DEAD
+        return out
+
+    def walk(self, state: frozenset, text: str) -> Optional[frozenset]:
+        for ch in text:
+            state = self.step(state, ch)
+            if state is None:
+                return None
+        return state
+
+    def is_accepting(self, state: frozenset) -> bool:
+        return self.accept_nfa in state
+
+    def fullmatch(self, text: str) -> bool:
+        s = self.walk(self.start, text)
+        return s is not None and self.is_accepting(s)
+
+
+# ------------------------------------------------------------- token machine
+
+class TokenMachine:
+    """Token-level view of a CharDfa over a fixed vocabulary.
+
+    ``allowed(state)`` → {token_id: next_state} for every token whose FULL
+    text survives the walk — computed once per distinct state and cached.
+    Empty-text tokens (special markers that decode to "") are never allowed.
+    """
+
+    def __init__(self, dfa: CharDfa, vocab: list[str]):
+        self.dfa = dfa
+        self.vocab = vocab
+        self._allowed_cache: dict = {}
+
+    @property
+    def start(self):
+        return self.dfa.start
+
+    def allowed(self, state) -> dict:
+        hit = self._allowed_cache.get(state)
+        if hit is not None:
+            return hit
+        out = {}
+        for tid, text in enumerate(self.vocab):
+            if not text:
+                continue
+            nxt = self.dfa.walk(state, text)
+            if nxt is not None:
+                out[tid] = nxt
+        self._allowed_cache[state] = out
+        return out
+
+    def is_accepting(self, state) -> bool:
+        return self.dfa.is_accepting(state)
+
+
+DEAD = "<dead>"
+
+
+class GuidedState:
+    """Per-sequence constraint cursor (attached to SeqState by the engine).
+
+    ``advance`` runs in the engine's sampling worker thread (never on the
+    event loop: it may trigger an O(vocab) walk for a newly-visited DFA
+    state). ``done``/``exhausted`` are plain reads for the scheduler's
+    finish check — a completed or stranded constraint must STOP the
+    sequence even when the request has no EOS ids or set ignore_eos.
+    """
+
+    def __init__(self, machine: TokenMachine, eos_ids: list[int]):
+        self.machine = machine
+        self.state = machine.start
+        self.eos_ids = list(eos_ids)
+        self.done = False
+        #: no token can extend the constraint from the current state — the
+        #: sequence must finish (reason "stop") instead of free-running
+        self.exhausted = False
+
+    def allowed_token_ids(self) -> list[int]:
+        """Tokens permitted at the current position; EOS joins the set when
+        the constraint can terminate here. A finished (or dead) constraint
+        allows only EOS so the sequence ends instead of free-running.
+
+        Liveness is CHAR-level (as in outlines): a token is allowed when its
+        text keeps the char DFA alive, even if no further token sequence can
+        complete the pattern. With byte/char-complete vocabularies (any real
+        BPE) this cannot strand the walk; vocabularies missing single-char
+        tokens can hit token-level dead ends, which terminate via EOS."""
+        if self.done:
+            return self.eos_ids
+        allowed = list(self.machine.allowed(self.state).keys())
+        if self.machine.is_accepting(self.state) or not allowed:
+            allowed += self.eos_ids
+        return allowed
+
+    def advance(self, token_id: int) -> None:
+        if self.done:
+            return
+        if token_id in self.eos_ids:
+            self.done = True
+            return
+        nxt = self.machine.allowed(self.state).get(token_id)
+        if nxt is None:
+            self.done = True  # off-constraint (shouldn't happen when masked)
+            return
+        self.state = nxt
+        if not self.machine.allowed(nxt):
+            # complete (accepting) or token-level dead end: either way no
+            # further token is legal — finish before sampling another
+            self.exhausted = True
+
+
+# --------------------------------------------------------- schema → pattern
+
+_STR_RE = r'"([^"\\]|\\["\\nrt])*"'
+_INT_RE = r"-?(0|[1-9]\d*)"
+_NUM_RE = _INT_RE + r"(\.\d+)?([eE][-+]?\d+)?"
+
+
+_SCHEMA_KEYS = {"type", "properties", "items", "minItems", "maxItems",
+                "enum", "const", "required", "title", "description",
+                "$schema", "additionalProperties"}
+
+
+def schema_to_regex(schema) -> str:
+    """JSON-schema subset → regex producing canonical (whitespace-free)
+    JSON. Covered: object (properties all required, in declared order),
+    array (items, minItems/maxItems), string, integer, number, boolean,
+    null, enum, const. Unsupported keywords fail loudly."""
+    if schema is True or schema == {}:
+        return _NUM_RE + "|" + _STR_RE + "|true|false|null"
+    unknown = set(schema) - _SCHEMA_KEYS
+    if unknown:
+        raise ValueError(f"unsupported JSON-schema keywords for "
+                         f"guided_json: {sorted(unknown)}")
+    if "enum" in schema:
+        return "|".join(_pyre.escape(json.dumps(v, separators=(",", ":")))
+                        for v in schema["enum"])
+    if "const" in schema:
+        return _pyre.escape(json.dumps(schema["const"], separators=(",", ":")))
+    t = schema.get("type")
+    if t == "string":
+        return _STR_RE
+    if t == "integer":
+        return _INT_RE
+    if t == "number":
+        return _NUM_RE
+    if t == "boolean":
+        return "true|false"
+    if t == "null":
+        return "null"
+    if t == "array":
+        item = schema_to_regex(schema.get("items", True))
+        lo = schema.get("minItems", 0)
+        hi = schema.get("maxItems")
+        item_g = f"({item})"
+        if hi is None:
+            body = (f"{item_g}(,{item_g})*" if lo == 0
+                    else f"{item_g}(,{item_g}){{{max(0, lo - 1)},}}")
+            if lo == 0:
+                body = f"({body})?"
+        elif hi == 0:
+            body = ""
+        else:
+            body = f"{item_g}(,{item_g}){{{max(0, lo - 1)},{hi - 1}}}"
+            if lo == 0:
+                body = f"({body})?"
+        return rf"\[{body}\]"
+    if t == "object":
+        props = schema.get("properties", {})
+        if not props:
+            return r"\{\}"
+        parts = []
+        for name, sub in props.items():
+            key = _pyre.escape(json.dumps(name))
+            parts.append(f"{key}:({schema_to_regex(sub)})")
+        return r"\{" + ",".join(parts) + r"\}"
+    raise ValueError(f"unsupported JSON-schema construct for guided_json: "
+                     f"{schema!r}")
+
+
+# ------------------------------------------------------------------- factory
+
+def guided_pattern(guided: dict) -> str:
+    """Resolve a request's guided-decoding options dict ({"regex": ...} |
+    {"json": ...} | {"choice": [...]} — already validated mutually
+    exclusive) to the constraint regex. Raises ValueError on unsupported
+    or malformed options — the frontend calls this at parse time so bad
+    requests 400 instead of erroring deep in a worker."""
+    if guided.get("grammar") is not None:
+        raise ValueError("guided_grammar (EBNF) is not supported; use "
+                         "guided_json or guided_regex")
+    if guided.get("choice") is not None:
+        return "|".join(_pyre.escape(str(c)) for c in guided["choice"])
+    if guided.get("regex") is not None:
+        return guided["regex"]
+    if guided.get("json") is not None:
+        schema = guided["json"]
+        if isinstance(schema, str):
+            schema = json.loads(schema)
+        return schema_to_regex(schema)
+    raise ValueError(f"empty guided-decoding options: {guided!r}")
+
+
+def validate_guided(guided: dict) -> None:
+    """Parse-time validation: resolves the pattern AND compiles the char
+    NFA, so regex syntax errors and unsupported schema keywords are caught
+    at the API boundary."""
+    CharDfa(guided_pattern(guided))
+
+
+#: (pattern, vocab identity) → TokenMachine. The machine's per-state token
+#: walks are the expensive part (O(vocab) per newly-visited state) — with
+#: one schema served by many requests, the cache makes every request after
+#: the first reuse the warm walks. Bounded FIFO eviction.
+_MACHINE_CACHE: dict = {}
+_MACHINE_CACHE_CAP = 64
+
+
+def compile_guided(guided: dict, vocab: list[str],
+                   eos_ids: list[int]) -> GuidedState:
+    """Build a GuidedState for one request (machines are cached across
+    requests; the state cursor is per-sequence)."""
+    pattern = guided_pattern(guided)
+    key = (pattern, id(vocab))
+    machine = _MACHINE_CACHE.get(key)
+    if machine is None or machine.vocab is not vocab:
+        machine = TokenMachine(CharDfa(pattern), vocab)
+        if len(_MACHINE_CACHE) >= _MACHINE_CACHE_CAP:
+            _MACHINE_CACHE.pop(next(iter(_MACHINE_CACHE)))
+        _MACHINE_CACHE[key] = machine
+    return GuidedState(machine, eos_ids)

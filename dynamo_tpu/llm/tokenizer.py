@@ -48,6 +48,44 @@ class TokenizerWrapper:
     def decode_stream(self, skip_special_tokens: bool = True) -> "IncrementalDecoder":
         return IncrementalDecoder(self._tk, skip_special_tokens)
 
+    def guided_vocab(self) -> list[str]:
+        """id → the EXACT text each token contributes mid-sequence — the
+        alphabet for guided decoding's token-level DFA
+        (llm/guided.TokenMachine). Per-id decode() is wrong for that:
+        detokenizers are not pointwise (decode(t1+t2) != decode(t1)+
+        decode(t2)) — byte-level BPEs spell a leading space as "Ġ" and
+        SentencePiece as "▁", both of which single-token decode strips.
+        The token STRINGS carry the truth, so they are transformed
+        directly (Ġ/byte-map inversion, ▁→space). Specials map to "" and
+        are thus never constraint-eligible."""
+        n = self._tk.get_vocab_size()
+        try:
+            plain = self._tk.decode_batch([[i] for i in range(n)],
+                                          skip_special_tokens=True)
+        except Exception:
+            plain = [self.decode([i]) for i in range(n)]
+        pieces = [self._tk.id_to_token(i) or "" for i in range(n)]
+        byte_level = any("\u0120" in t for t in pieces)  # "Ġ" marker
+        metaspace = not byte_level and any(
+            t.startswith("\u2581") for t in pieces)  # "▁" marker
+        if byte_level:
+            inv = _bytelevel_inverse()
+            out = []
+            for dec, t in zip(plain, pieces):
+                if dec == "" or not t:
+                    out.append("")  # special / empty: never eligible
+                elif all(c in inv for c in t):
+                    out.append(bytes(inv[c] for c in t)
+                               .decode("utf-8", errors="ignore"))
+                else:
+                    out.append(dec)
+            return out
+        if metaspace:
+            return ["" if dec == "" or not t
+                    else t.replace("\u2581", " ")
+                    for dec, t in zip(plain, pieces)]
+        return plain
+
     @staticmethod
     def from_dir(path: str) -> "TokenizerWrapper":
         """Load tokenizer.json (+ chat template from tokenizer_config.json).
@@ -83,6 +121,22 @@ class TokenizerWrapper:
             bos = _tok(cfg.get("bos_token"))
             eos = _tok(cfg.get("eos_token"))
         return TokenizerWrapper(tk, chat_template, bos, eos)
+
+
+def _bytelevel_inverse() -> dict:
+    """char → byte inverse of the byte-level BPE alphabet (the standard
+    printable-remap table used by GPT-2-lineage tokenizers): printable
+    bytes map to themselves, the rest to U+0100+offset codepoints."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(0xA1, 0xAC + 1)) + list(range(0xAE, 0xFF + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
 
 
 class IncrementalDecoder:

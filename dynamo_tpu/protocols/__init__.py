@@ -66,6 +66,10 @@ class SamplingOptions:
     #: bias in [-100, 100] applied to logits before sampling — the logits
     #: processing surface (ref: bindings py-src logits processing API)
     logit_bias: Optional[dict] = None
+    #: guided decoding (ref: common_ext.rs:53-73, GuidedDecodingOptions in
+    #: protocols/common.rs — mutually exclusive): exactly one of
+    #: {"json": schema, "regex": str, "choice": [str], "grammar": str}
+    guided: Optional[dict] = None
 
 
 @dataclass

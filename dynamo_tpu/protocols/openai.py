@@ -117,8 +117,32 @@ def _parse_common(body: dict, req: ParsedRequest) -> ParsedRequest:
             raise RequestError("'logit_bias' supports at most 300 tokens")
 
     nvext = body.get("nvext") or {}
+    # guided decoding: accepted at top level AND in nvext (ref:
+    # common_ext.rs CommonExt is flattened into both request types);
+    # nvext wins per field, and exactly ONE option may be set
+    guided = {}
+    for key in ("json", "regex", "choice", "grammar"):
+        v = nvext.get(f"guided_{key}", body.get(f"guided_{key}"))
+        if v is not None:
+            guided[key] = v
+    if len(guided) > 1:
+        raise RequestError(
+            "only one of guided_json / guided_regex / guided_choice / "
+            "guided_grammar may be set")
+    if "choice" in guided and (not isinstance(guided["choice"], list)
+                               or not guided["choice"]):
+        raise RequestError("'guided_choice' must be a non-empty list")
+    if guided:
+        from dynamo_tpu.llm.guided import validate_guided
+        try:
+            validate_guided(guided)  # 400 here, not a worker-side error
+        except ValueError as e:
+            raise RequestError(str(e))
+        except Exception as e:  # malformed schema json etc.
+            raise RequestError(f"invalid guided-decoding options: {e}")
     req.sampling = SamplingOptions(
         logit_bias=logit_bias,
+        guided=guided or None,
         n=req.n,
         temperature=None if temperature is None else float(temperature),
         top_p=None if top_p is None else float(top_p),

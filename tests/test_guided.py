@@ -1,0 +1,324 @@
+"""Guided decoding: regex engine, schema compiler, token machine, and the
+engine-level constraint (ref surface: common_ext.rs guided_json/regex/
+choice/grammar + GuidedDecodingOptions exclusivity in protocols/common.rs).
+"""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.llm.guided import (
+    CharDfa, GuidedState, TokenMachine, compile_guided, schema_to_regex,
+)
+from dynamo_tpu.protocols import (
+    PreprocessedRequest, SamplingOptions, StopConditions,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+# ------------------------------------------------------------- regex engine
+
+@pytest.mark.parametrize("pattern,accepts,rejects", [
+    (r"[ab]{3}", ["aba", "bbb"], ["ab", "abab", "c"]),
+    (r"\d+", ["0", "42"], ["", "4a", "-1"]),
+    (r"(foo|ba+r)?x", ["x", "foox", "baaarx"], ["foo", "bx"]),
+    (r"a{2,4}b", ["aab", "aaaab"], ["ab", "aaaaab"]),
+    (r"[^b]c*", ["a", "acc"], ["b", "bc", ""]),
+    (r'"([^"\\]|\\["\\nrt])*"', ['""', '"hi"', '"a\\"b"'], ['"', '"a']),
+    (r"yes|no|maybe", ["yes", "no", "maybe"], ["ye", "nomaybe"]),
+])
+def test_regex_matches_python_re(pattern, accepts, rejects):
+    d = CharDfa(pattern)
+    for s in accepts:
+        assert d.fullmatch(s), (pattern, s)
+        assert re.fullmatch(pattern, s)  # engine agrees with python re
+    for s in rejects:
+        assert not d.fullmatch(s), (pattern, s)
+        assert not re.fullmatch(pattern, s)
+
+
+def test_schema_to_regex_roundtrip():
+    schema = {"type": "object", "properties": {
+        "name": {"type": "string"},
+        "age": {"type": "integer"},
+        "vip": {"type": "boolean"},
+        "tags": {"type": "array", "items": {"enum": ["a", "b"]},
+                 "minItems": 1, "maxItems": 2}}}
+    pat = schema_to_regex(schema)
+    d = CharDfa(pat)
+    good = json.dumps({"name": "bo\\"+"\"b", "age": -3, "vip": True,
+                       "tags": ["a", "b"]}, separators=(",", ":"))
+    assert d.fullmatch(good)
+    assert re.fullmatch(pat, good)  # cross-check the generated pattern
+    assert not d.fullmatch('{"name":"x","age":1.5,"vip":true,"tags":["a"]}')
+    assert not d.fullmatch('{"age":1}')
+
+
+def test_schema_unsupported_fails_loudly():
+    with pytest.raises(ValueError, match="unsupported"):
+        schema_to_regex({"type": "object",
+                         "patternProperties": {".*": {}}, "x": 1})
+
+
+def test_token_machine_multi_char_tokens():
+    vocab = ["a", "b", "ab", "ba", "c", ""]
+    tm = TokenMachine(CharDfa(r"[ab]{4}"), vocab)
+    names = lambda st: {vocab[i] for i in tm.allowed(st)}  # noqa: E731
+    assert names(tm.start) == {"a", "b", "ab", "ba"}
+    st = tm.allowed(tm.start)[vocab.index("ab")]  # consumed 2 of 4
+    assert names(st) == {"a", "b", "ab", "ba"}
+    st = tm.allowed(st)[vocab.index("ba")]  # consumed 4: only EOS next
+    assert names(st) == set()
+    assert tm.is_accepting(st)
+
+
+def test_guided_state_eos_gating():
+    gs = GuidedState(TokenMachine(CharDfa(r"ab?"), ["a", "b"]), eos_ids=[9])
+    assert gs.allowed_token_ids() == [0]  # must start with "a"; not accepting
+    gs.advance(0)
+    assert sorted(gs.allowed_token_ids()) == [1, 9]  # "b" optional → eos ok
+    gs.advance(9)
+    assert gs.done and gs.allowed_token_ids() == [9]
+
+
+def test_compile_guided_variants():
+    vocab = ["x", "y", "z"]
+    gs = compile_guided({"choice": ["xy", "z"]}, vocab, [5])
+    assert sorted(gs.allowed_token_ids()) == [0, 2]
+    with pytest.raises(ValueError, match="guided_grammar"):
+        compile_guided({"grammar": "root ::= x"}, vocab, [5])
+
+
+# ------------------------------------------------------------ engine level
+
+def _vocab(n):
+    """Single-char vocab: token id i decodes to a printable char; id 0 is
+    reserved (never produced by constraints used here)."""
+    return [""] + [chr(32 + i) for i in range(n - 1)]
+
+
+def _req(guided, max_tokens=16):
+    return PreprocessedRequest(
+        model="tiny", token_ids=[1, 2, 3],
+        sampling_options=SamplingOptions(temperature=0.0, guided=guided),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        eos_token_ids=[2])
+
+
+async def _collect(eng, req):
+    toks = []
+    async for out in eng.generate(req):
+        toks.extend(out.token_ids)
+        if out.finish_reason is not None:
+            return toks, out.finish_reason
+    return toks, None
+
+
+@pytest.fixture
+async def engine():
+    cfg = ModelConfig.tiny()
+    eng = AsyncJaxEngine(cfg, EngineArgs(
+        block_size=16, num_blocks=64, max_num_seqs=4,
+        max_num_batched_tokens=128, max_model_len=128,
+        multi_step_decode=4), guided_vocab=_vocab(cfg.vocab_size))
+    yield eng
+    await eng.close()
+
+
+def _text(eng, toks):
+    return "".join(eng.guided_vocab[t] for t in toks if t != 2)
+
+
+async def test_guided_choice_engine(engine):
+    toks, reason = await _collect(engine,
+                                  _req({"choice": ["apple", "banana"]}))
+    assert _text(engine, toks) in ("apple", "banana")
+    # completion ends the stream: either the model emitted EOS (allowed at
+    # the accepting state) or exhaustion stopped it
+    assert reason in ("stop", "eos")
+
+
+async def test_guided_regex_engine(engine):
+    toks, _ = await _collect(engine, _req({"regex": r"[ab]{3}"}))
+    txt = _text(engine, toks)
+    assert re.fullmatch(r"[ab]{3}", txt), txt
+
+
+async def test_guided_json_engine(engine):
+    # bounded value types so greedy output always closes within max_tokens
+    schema = {"type": "object", "properties": {
+        "ok": {"type": "boolean"}, "kind": {"enum": ["x", "yz"]}}}
+    toks, _ = await _collect(engine, _req({"json": schema}, max_tokens=32))
+    txt = _text(engine, toks)
+    obj = json.loads(txt)
+    assert isinstance(obj["ok"], bool) and obj["kind"] in ("x", "yz")
+
+
+async def test_guided_deterministic(engine):
+    a = await _collect(engine, _req({"regex": r"[ab]{3}"}))
+    b = await _collect(engine, _req({"regex": r"[ab]{3}"}))
+    assert a == b
+
+
+async def test_guided_without_vocab_refused():
+    import asyncio  # noqa: F401
+
+    eng = AsyncJaxEngine(ModelConfig.tiny(), EngineArgs(
+        block_size=16, num_blocks=32, max_num_seqs=2,
+        max_num_batched_tokens=64, max_model_len=64))
+    try:
+        with pytest.raises(ValueError, match="guided decoding requested"):
+            await _collect(eng, _req({"choice": ["x"]}))
+    finally:
+        await eng.close()
+
+
+# --------------------------------------------------------- protocol parsing
+
+def test_openai_guided_parsing_and_exclusivity():
+    from dynamo_tpu.protocols.openai import (
+        RequestError, parse_completion_request,
+    )
+
+    req = parse_completion_request({"model": "m", "prompt": "p",
+                                    "guided_choice": ["a", "b"]})
+    assert req.sampling.guided == {"choice": ["a", "b"]}
+    req = parse_completion_request({"model": "m", "prompt": "p",
+                                    "nvext": {"guided_regex": r"\d+"}})
+    assert req.sampling.guided == {"regex": r"\d+"}
+    with pytest.raises(RequestError, match="only one of"):
+        parse_completion_request({"model": "m", "prompt": "p",
+                                  "guided_regex": "x",
+                                  "guided_choice": ["y"]})
+    with pytest.raises(RequestError, match="non-empty"):
+        parse_completion_request({"model": "m", "prompt": "p",
+                                  "guided_choice": []})
+
+
+async def test_guided_stops_without_eos_ids():
+    """Constraint completion must finish the stream (reason 'stop') even
+    when the request has NO eos ids — free-running past the constraint
+    would emit unconstrained tokens."""
+    cfg = ModelConfig.tiny()
+    eng = AsyncJaxEngine(cfg, EngineArgs(
+        block_size=16, num_blocks=64, max_num_seqs=4,
+        max_num_batched_tokens=128, max_model_len=128),
+        guided_vocab=_vocab(cfg.vocab_size))
+    try:
+        req = PreprocessedRequest(
+            model="tiny", token_ids=[1, 2, 3],
+            sampling_options=SamplingOptions(
+                temperature=0.0, guided={"choice": ["hi", "yo"]}),
+            stop_conditions=StopConditions(max_tokens=16),
+            eos_token_ids=[])
+        toks, reason = await _collect(eng, req)
+        assert _text(eng, toks) in ("hi", "yo")
+        assert reason == "stop"
+        assert len(toks) == 2  # exactly the constraint, nothing after
+    finally:
+        await eng.close()
+
+
+async def test_guided_disagg_prefill_then_decode():
+    """The disagg path (prefill_extract → generate_prefilled) must honor
+    the constraint end-to-end: first token masked on the prefill worker,
+    the rest on the decode worker."""
+    cfg = ModelConfig.tiny()
+    mk = lambda: AsyncJaxEngine(cfg, EngineArgs(  # noqa: E731
+        block_size=16, num_blocks=64, max_num_seqs=4,
+        max_num_batched_tokens=128, max_model_len=128),
+        guided_vocab=_vocab(cfg.vocab_size))
+    pre, dec = mk(), mk()
+    try:
+        req = _req({"regex": r"[xy]{4}"}, max_tokens=12)
+        resp = await pre.prefill_extract(req)
+        first_txt = pre.guided_vocab[resp.token_id]
+        assert first_txt in ("x", "y"), first_txt
+        toks = []
+        async for out in dec.generate_injected(req, resp):
+            toks.extend(out.token_ids)
+            if out.finish_reason is not None:
+                break
+        txt = _text(dec, toks)
+        assert re.fullmatch(r"[xy]{4}", txt), txt
+    finally:
+        await pre.close()
+        await dec.close()
+
+
+def test_guided_vocab_byte_level_and_metaspace(tmp_path):
+    """decode(t1+t2) != decode(t1)+decode(t2): the DFA alphabet must carry
+    each token's true mid-sequence contribution (Ġ/▁ → leading space)."""
+    from tokenizers import Tokenizer, decoders, pre_tokenizers
+    from tokenizers.models import BPE, WordLevel
+
+    from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+
+    # byte-level BPE: "Ġfoo" must contribute " foo"
+    vocab = {"Ġfoo": 0, "bar": 1, "Ċ": 2, "<s>": 3}
+    tk = Tokenizer(BPE(vocab, [], unk_token=None))
+    tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tk.decoder = decoders.ByteLevel()
+    tk.add_special_tokens(["<s>"])
+    p = tmp_path / "bl"
+    p.mkdir()
+    tk.save(str(p / "tokenizer.json"))
+    gv = TokenizerWrapper.from_dir(str(p)).guided_vocab()
+    assert gv[0] == " foo" and gv[1] == "bar" and gv[2] == "\n"
+    assert gv[3] == ""  # special: never constraint-eligible
+
+    # metaspace (SentencePiece-style): "▁hi" must contribute " hi"
+    vocab2 = {"▁hi": 0, "there": 1}
+    tk2 = Tokenizer(WordLevel(vocab2, unk_token=None))
+    p2 = tmp_path / "ms"
+    p2.mkdir()
+    tk2.save(str(p2 / "tokenizer.json"))
+    gv2 = TokenizerWrapper.from_dir(str(p2)).guided_vocab()
+    assert gv2[0] == " hi" and gv2[1] == "there"
+
+
+def test_guided_parse_time_validation():
+    from dynamo_tpu.protocols.openai import (
+        RequestError, parse_completion_request,
+    )
+
+    with pytest.raises(RequestError, match="guided_grammar"):
+        parse_completion_request({"model": "m", "prompt": "p",
+                                  "guided_grammar": "root ::= x"})
+    with pytest.raises(RequestError, match="unbalanced|unexpected|dangling"):
+        parse_completion_request({"model": "m", "prompt": "p",
+                                  "guided_regex": "(ab"})
+    with pytest.raises(RequestError, match="unsupported"):
+        parse_completion_request({"model": "m", "prompt": "p",
+                                  "guided_json": {"patternProperties": {}}})
+
+
+def test_machine_cache_reused():
+    from dynamo_tpu.llm import guided as G
+
+    vocab = ["a", "b"]
+    g1 = compile_guided({"regex": "ab"}, vocab, [])
+    g2 = compile_guided({"regex": "ab"}, vocab, [])
+    assert g1.machine is g2.machine  # warm walks shared across requests
+    assert g1 is not g2  # cursor is per-request
+
+
+async def test_guided_mask_bounds_vs_model_vocab():
+    """guided_vocab longer than the model's logits width must not crash
+    the sampling step (ids >= V are dropped from the mask)."""
+    cfg = ModelConfig.tiny()
+    big_vocab = _vocab(cfg.vocab_size) + ["zz", "zzz"]  # ids >= V
+    eng = AsyncJaxEngine(cfg, EngineArgs(
+        block_size=16, num_blocks=64, max_num_seqs=4,
+        max_num_batched_tokens=128, max_model_len=128),
+        guided_vocab=big_vocab)
+    try:
+        toks, _ = await _collect(eng, _req({"regex": "z+"}, max_tokens=4))
+        assert all(t < cfg.vocab_size for t in toks)
+    finally:
+        await eng.close()
