@@ -1070,8 +1070,7 @@ class AsyncJaxEngine:
             # guided decoding: rows whose logits are masked to the
             # constraint's allowed set (allowed() walks the vocab once per
             # NEW dfa state — here in the worker thread, cached after)
-            g_rows = [(i, [t for t in s.guided_state.allowed_token_ids()
-                           if 0 <= t < V])
+            g_rows = [(i, s.guided_state.allowed_token_ids(V))
                       for i, s in enumerate(seqs)
                       if s.guided_state is not None]
             return b_rows, b_cols, b_vals, r_rows, r_cols, r_pens, g_rows

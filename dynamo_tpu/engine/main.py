@@ -215,7 +215,9 @@ async def amain():
             cli.jax_coordinator, cli.jax_num_processes, cli.jax_process_id)
 
     cli._guided_vocab = None
-    if tokenizer_ref and cli.role != "prefill":
+    # every role needs it: disagg PREFILL workers sample the first token
+    # under the same guided mask (prefill_extract -> _new_seq)
+    if tokenizer_ref:
         try:
             from dynamo_tpu.llm.tokenizer import TokenizerWrapper
             cli._guided_vocab = TokenizerWrapper.from_dir(

@@ -60,11 +60,21 @@ _SPACE = frozenset(" \t\n\r\f\v")
 _UNIVERSE = frozenset(chr(c) for c in range(32, 127)) | _SPACE
 
 
+#: hard ceiling on NFA size: a 17-byte pattern like "(a{9999}){9999}"
+#: would otherwise expand to ~1e8 states at parse time (request-body DoS —
+#: validate_guided runs in the frontend parser)
+_MAX_NFA_STATES = 100_000
+_MAX_COUNTED_REPEAT = 256
+
+
 class _Nfa:
     def __init__(self):
         self.trans: list[list] = []  # state -> [(charset|None|ANY, next)]
 
     def state(self) -> int:
+        if len(self.trans) >= _MAX_NFA_STATES:
+            raise ValueError(
+                f"regex too large (> {_MAX_NFA_STATES} NFA states)")
         self.trans.append([])
         return len(self.trans) - 1
 
@@ -151,6 +161,9 @@ class _RegexParser:
             lo, hi = int(lo_s or 0), (int(hi_s) if hi_s else None)
         else:
             lo = hi = int(spec)
+        if lo > _MAX_COUNTED_REPEAT or (hi or 0) > _MAX_COUNTED_REPEAT:
+            raise ValueError(f"counted repetition above "
+                             f"{_MAX_COUNTED_REPEAT} is not supported")
         frag = None
         for _ in range(lo):
             c = self._clone(atom)
@@ -290,7 +303,9 @@ class CharDfa:
             for key, t in self.nfa.trans[s]:
                 if key is None:
                     continue
-                if key == ANY or ch in key:
+                if (key == ANY and ch != "\n") or (key != ANY
+                                                     and ch in key):
+                    # '.' excludes newline (python-re default semantics)
                     nxt.add(t)
         out = self._closure(frozenset(nxt)) if nxt else None
         self._step_cache[(state, ch)] = out if out is not None else DEAD
@@ -325,6 +340,7 @@ class TokenMachine:
         self.dfa = dfa
         self.vocab = vocab
         self._allowed_cache: dict = {}
+        self._ids_cache: dict = {}  # (state, max_id) -> [token_id]
 
     @property
     def start(self):
@@ -343,6 +359,17 @@ class TokenMachine:
                 out[tid] = nxt
         self._allowed_cache[state] = out
         return out
+
+    def allowed_ids_below(self, state, max_id: int) -> list:
+        """Cached id list clamped to the model's logits width — the
+        per-step fast path (the dict walk + filter would be O(vocab) of
+        Python per sampled token otherwise). Callers must not mutate."""
+        key = (state, max_id)
+        hit = self._ids_cache.get(key)
+        if hit is None:
+            hit = [t for t in self.allowed(state) if 0 <= t < max_id]
+            self._ids_cache[key] = hit
+        return hit
 
     def is_accepting(self, state) -> bool:
         return self.dfa.is_accepting(state)
@@ -370,7 +397,7 @@ class GuidedState:
         #: sequence must finish (reason "stop") instead of free-running
         self.exhausted = False
 
-    def allowed_token_ids(self) -> list[int]:
+    def allowed_token_ids(self, max_id: Optional[int] = None) -> list[int]:
         """Tokens permitted at the current position; EOS joins the set when
         the constraint can terminate here. A finished (or dead) constraint
         allows only EOS so the sequence ends instead of free-running.
@@ -380,11 +407,16 @@ class GuidedState:
         complete the pattern. With byte/char-complete vocabularies (any real
         BPE) this cannot strand the walk; vocabularies missing single-char
         tokens can hit token-level dead ends, which terminate via EOS."""
+        hi = max_id if max_id is not None else len(self.machine.vocab)
+        # clamp EOS only against an EXPLICIT logits width — eos ids may
+        # legitimately exceed the constraint vocabulary's length
+        eos = (list(self.eos_ids) if max_id is None
+               else [e for e in self.eos_ids if 0 <= e < max_id])
         if self.done:
-            return self.eos_ids
-        allowed = list(self.machine.allowed(self.state).keys())
+            return eos
+        allowed = self.machine.allowed_ids_below(self.state, hi)
         if self.machine.is_accepting(self.state) or not allowed:
-            allowed += self.eos_ids
+            return allowed + eos  # new list: never mutate the cached one
         return allowed
 
     def advance(self, token_id: int) -> None:
@@ -416,13 +448,35 @@ _SCHEMA_KEYS = {"type", "properties", "items", "minItems", "maxItems",
                 "$schema", "additionalProperties"}
 
 
+def json_value_regex(depth: int = 3) -> str:
+    """Generic JSON value, nesting bounded at ``depth`` (regular languages
+    cannot express unbounded nesting; outlines bounds it the same way).
+    Depth 0 is primitives only; each level adds arrays/objects of the
+    level below."""
+    v = _NUM_RE + "|" + _STR_RE + "|true|false|null"
+    for _ in range(depth):
+        item = f"({v})"
+        arr = rf"\[({item}(,{item})*)?\]"
+        obj = rf"\{{({_STR_RE}:{item}(,{_STR_RE}:{item})*)?\}}"
+        v = v + "|" + arr + "|" + obj
+    return v
+
+
+def json_object_regex(depth: int = 3) -> str:
+    """Generic JSON OBJECT (response_format: json_object), values nested
+    up to ``depth``."""
+    item = f"({json_value_regex(depth - 1)})"
+    return rf"\{{({_STR_RE}:{item}(,{_STR_RE}:{item})*)?\}}"
+
+
 def schema_to_regex(schema) -> str:
     """JSON-schema subset → regex producing canonical (whitespace-free)
-    JSON. Covered: object (properties all required, in declared order),
-    array (items, minItems/maxItems), string, integer, number, boolean,
-    null, enum, const. Unsupported keywords fail loudly."""
+    JSON. Covered: object (properties all required, in declared order;
+    no properties = any object), array (items, minItems/maxItems), string,
+    integer, number, boolean, null, enum, const. Unsupported keywords
+    fail loudly."""
     if schema is True or schema == {}:
-        return _NUM_RE + "|" + _STR_RE + "|true|false|null"
+        return json_value_regex()
     unknown = set(schema) - _SCHEMA_KEYS
     if unknown:
         raise ValueError(f"unsupported JSON-schema keywords for "
@@ -463,7 +517,7 @@ def schema_to_regex(schema) -> str:
     if t == "object":
         props = schema.get("properties", {})
         if not props:
-            return r"\{\}"
+            return json_object_regex()
         parts = []
         for name, sub in props.items():
             key = _pyre.escape(json.dumps(name))

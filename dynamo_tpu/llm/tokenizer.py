@@ -75,15 +75,32 @@ class TokenizerWrapper:
                 if dec == "" or not t:
                     out.append("")  # special / empty: never eligible
                 elif all(c in inv for c in t):
-                    out.append(bytes(inv[c] for c in t)
-                               .decode("utf-8", errors="ignore"))
+                    try:
+                        # STRICT: a token holding a partial multi-byte
+                        # UTF-8 sequence has no standalone text — marking
+                        # it ineligible is conservative-correct (the mask
+                        # must never admit a token whose real contribution
+                        # differs from what the DFA walked)
+                        out.append(bytes(inv[c] for c in t).decode("utf-8"))
+                    except UnicodeDecodeError:
+                        out.append("")
                 else:
                     out.append(dec)
             return out
         if metaspace:
-            return ["" if dec == "" or not t
-                    else t.replace("\u2581", " ")
-                    for dec, t in zip(plain, pieces)]
+            out = []
+            for dec, t in zip(plain, pieces):
+                if dec == "" or not t:
+                    out.append("")
+                elif _SP_BYTE.fullmatch(t):
+                    # SentencePiece byte-fallback "<0xHH>": the piece text
+                    # lies about the contribution; ASCII bytes map to their
+                    # char, partial/high bytes are ineligible (see above)
+                    b = int(t[3:5], 16)
+                    out.append(chr(b) if b < 0x80 else "")
+                else:
+                    out.append(t.replace("\u2581", " "))
+            return out
         return plain
 
     @staticmethod
@@ -121,6 +138,9 @@ class TokenizerWrapper:
             bos = _tok(cfg.get("bos_token"))
             eos = _tok(cfg.get("eos_token"))
         return TokenizerWrapper(tk, chat_template, bos, eos)
+
+
+_SP_BYTE = __import__("re").compile(r"<0x[0-9A-Fa-f]{2}>")
 
 
 def _bytelevel_inverse() -> dict:

@@ -129,6 +129,22 @@ def _parse_common(body: dict, req: ParsedRequest) -> ParsedRequest:
         raise RequestError(
             "only one of guided_json / guided_regex / guided_choice / "
             "guided_grammar may be set")
+    # OpenAI response_format maps onto the same constraint machinery;
+    # explicit guided_* options win when both are present
+    rf = body.get("response_format")
+    if not guided and isinstance(rf, dict):
+        rft = rf.get("type")
+        if rft == "json_schema":
+            schema = (rf.get("json_schema") or {}).get("schema")
+            if schema is None:
+                raise RequestError(
+                    "response_format json_schema requires "
+                    "json_schema.schema")
+            guided["json"] = schema
+        elif rft == "json_object":
+            guided["json"] = {"type": "object"}  # any (depth-bounded) object
+        elif rft not in (None, "text"):
+            raise RequestError(f"unsupported response_format type: {rft!r}")
     if "choice" in guided and (not isinstance(guided["choice"], list)
                                or not guided["choice"]):
         raise RequestError("'guided_choice' must be a non-empty list")

@@ -108,7 +108,19 @@ async def start_worker(runtime, out: str, cli):
     eargs = EngineArgs(multi_step_decode=cli.multi_step_decode,
                        speculative_tokens=cli.speculative_tokens,
                        use_pallas_attention=cli.use_pallas_attention)
-    engine = AsyncJaxEngine(cfg, eargs, params=params)
+    guided_vocab = None
+    if tokenizer_ref:
+        try:
+            from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+            guided_vocab = TokenizerWrapper.from_dir(
+                tokenizer_ref).guided_vocab()
+        except Exception:
+            import logging
+            logging.getLogger("dynamo.run").warning(
+                "could not decode vocab from %s; guided decoding disabled",
+                tokenizer_ref, exc_info=True)
+    engine = AsyncJaxEngine(cfg, eargs, params=params,
+                            guided_vocab=guided_vocab)
     mm_client = None
     mm_worker = None
     if cli.mm_encode:

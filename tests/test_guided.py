@@ -322,3 +322,70 @@ async def test_guided_mask_bounds_vs_model_vocab():
         assert all(t < cfg.vocab_size for t in toks)
     finally:
         await eng.close()
+
+
+def test_response_format_maps_to_guided():
+    from dynamo_tpu.llm.guided import json_object_regex
+    from dynamo_tpu.protocols.openai import (
+        RequestError, parse_completion_request,
+    )
+
+    r = parse_completion_request({"model": "m", "prompt": "p",
+                                  "response_format": {"type": "json_object"}})
+    assert r.sampling.guided == {"json": {"type": "object"}}
+    r = parse_completion_request({
+        "model": "m", "prompt": "p",
+        "response_format": {"type": "json_schema",
+                            "json_schema": {"schema": {"type": "integer"}}}})
+    assert r.sampling.guided == {"json": {"type": "integer"}}
+    # explicit guided_* beats response_format
+    r = parse_completion_request({"model": "m", "prompt": "p",
+                                  "guided_regex": "a+",
+                                  "response_format": {"type": "json_object"}})
+    assert r.sampling.guided == {"regex": "a+"}
+    with pytest.raises(RequestError, match="unsupported response_format"):
+        parse_completion_request({"model": "m", "prompt": "p",
+                                  "response_format": {"type": "xml"}})
+    with pytest.raises(RequestError, match="json_schema.schema"):
+        parse_completion_request({"model": "m", "prompt": "p",
+                                  "response_format": {"type": "json_schema"}})
+    # the json_object pattern accepts nested objects/arrays (depth-bounded)
+    d = CharDfa(json_object_regex())
+    assert d.fullmatch('{"a":[1,"x"],"b":{"c":true}}')
+    assert not d.fullmatch('[1]')
+
+
+def test_regex_dos_caps():
+    """Pathological counted repetition must be rejected at parse time, not
+    expand to ~1e8 NFA states on the frontend event loop."""
+    with pytest.raises(ValueError, match="counted repetition"):
+        CharDfa("(a{1000}){1000}")
+    with pytest.raises(ValueError, match="too large"):
+        CharDfa("(" * 0 + "a{256}" * 400)  # many max-size repeats
+
+
+def test_dot_excludes_newline():
+    d = CharDfa("a.b")
+    assert d.fullmatch("axb")
+    assert not d.fullmatch("a\nb")  # python-re default semantics
+
+
+def test_sp_byte_fallback_tokens(tmp_path):
+    """SentencePiece byte-fallback '<0xHH>' pieces: ASCII bytes contribute
+    their char; high/partial bytes are constraint-ineligible (the mask must
+    never admit a token whose real text differs from the DFA's walk)."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+
+    from dynamo_tpu.llm.tokenizer import TokenizerWrapper
+
+    vocab = {"▁hi": 0, "<0x41>": 1, "<0xC3>": 2, "plain": 3}
+    tk = Tokenizer(WordLevel(vocab, unk_token=None))
+    p = tmp_path / "spb"
+    p.mkdir()
+    tk.save(str(p / "tokenizer.json"))
+    gv = TokenizerWrapper.from_dir(str(p)).guided_vocab()
+    assert gv[0] == " hi"
+    assert gv[1] == "A"      # <0x41> really contributes "A"
+    assert gv[2] == ""       # partial UTF-8 byte: never eligible
+    assert gv[3] == "plain"
