@@ -218,14 +218,8 @@ async def amain():
     # every role needs it: disagg PREFILL workers sample the first token
     # under the same guided mask (prefill_extract -> _new_seq)
     if tokenizer_ref:
-        try:
-            from dynamo_tpu.llm.tokenizer import TokenizerWrapper
-            cli._guided_vocab = TokenizerWrapper.from_dir(
-                tokenizer_ref).guided_vocab()
-        except Exception:
-            logging.getLogger("dynamo.engine.main").warning(
-                "could not decode vocab from %s; guided decoding disabled",
-                tokenizer_ref, exc_info=True)
+        from dynamo_tpu.llm.tokenizer import load_guided_vocab
+        cli._guided_vocab = load_guided_vocab(tokenizer_ref)
     engine = build_engine(cli, cfg, args)  # heavy JAX work first (see above)
     runtime = await DistributedRuntime.create()
 

@@ -41,6 +41,8 @@ _SCALAR_FMT = {
 #: ggml tensor dtypes we can materialize (id → numpy dtype factory)
 GGML_F32, GGML_F16 = 0, 1
 GGML_BF16 = 30
+GGML_Q4_0, GGML_Q4_1, GGML_Q5_0, GGML_Q5_1, GGML_Q8_0 = 2, 3, 6, 7, 8
+GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 12, 13, 14
 
 
 def _np_dtype(ggml_type: int):
@@ -53,6 +55,146 @@ def _np_dtype(ggml_type: int):
 
         return np.dtype(ml_dtypes.bfloat16)
     return None
+
+
+# ------------------------------------------------------- quant dequantizers
+#
+# Vectorized numpy dequantization of the ggml block formats (public GGUF
+# spec / ggml-quants layout; ref behavior: the llamacpp engine serves these
+# natively — here they materialize to float at load). Each entry:
+# (bytes_per_block, values_per_block, fn(raw_u8[nb, bytes]) -> f32[nb, vals]).
+
+def _deq_q8_0(b):
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)  # [nb, 1]
+    q = b[:, 2:].view(np.int8).astype(np.float32)
+    return d * q
+
+
+def _nibbles(qs):
+    """[nb, n] uint8 → [nb, 2n] with all LOW nibbles first, then HIGH —
+    the ggml 4-bit in-block ordering."""
+    return np.concatenate([qs & 0xF, qs >> 4], axis=1)
+
+
+def _deq_q4_0(b):
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)
+    return d * (_nibbles(b[:, 2:]).astype(np.float32) - 8.0)
+
+
+def _deq_q4_1(b):
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)
+    m = b[:, 2:4].copy().view(np.float16).astype(np.float32)
+    return d * _nibbles(b[:, 4:]).astype(np.float32) + m
+
+
+def _q5_high_bits(qh_bytes):
+    """[nb, 4] packed u32 → [nb, 32] the per-value 5th bits."""
+    qh = qh_bytes.copy().view(np.uint32)  # [nb, 1]
+    return ((qh >> np.arange(32, dtype=np.uint32)[None, :]) & 1).astype(np.uint8)
+
+
+def _deq_q5_0(b):
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)
+    q = _nibbles(b[:, 6:]) | (_q5_high_bits(b[:, 2:6]) << 4)
+    return d * (q.astype(np.float32) - 16.0)
+
+
+def _deq_q5_1(b):
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)
+    m = b[:, 2:4].copy().view(np.float16).astype(np.float32)
+    q = _nibbles(b[:, 8:]) | (_q5_high_bits(b[:, 4:8]) << 4)
+    return d * q.astype(np.float32) + m
+
+
+def _k_scale_min(scales):
+    """q4_K/q5_K 12-byte packed 6-bit scales/mins → (sc[nb,8], m[nb,8])."""
+    sc = np.empty(scales.shape[:1] + (8,), np.float32)
+    mn = np.empty_like(sc)
+    for j in range(8):
+        if j < 4:
+            sc[:, j] = (scales[:, j] & 63).astype(np.float32)
+            mn[:, j] = (scales[:, j + 4] & 63).astype(np.float32)
+        else:
+            sc[:, j] = ((scales[:, j + 4] & 0xF)
+                        | ((scales[:, j - 4] >> 6) << 4)).astype(np.float32)
+            mn[:, j] = ((scales[:, j + 4] >> 4)
+                        | ((scales[:, j] >> 6) << 4)).astype(np.float32)
+    return sc, mn
+
+
+def _deq_q4_k(b):
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)
+    dmin = b[:, 2:4].copy().view(np.float16).astype(np.float32)
+    sc, mn = _k_scale_min(b[:, 4:16])
+    qs = b[:, 16:]  # [nb, 128]
+    out = np.empty((b.shape[0], 256), np.float32)
+    for j in range(4):  # 64 values per chunk: 32 low nibbles, 32 high
+        q = qs[:, 32 * j:32 * (j + 1)]
+        lo, hi = 2 * j, 2 * j + 1
+        out[:, 64 * j:64 * j + 32] = (
+            d * sc[:, lo:lo + 1] * (q & 0xF) - dmin * mn[:, lo:lo + 1])
+        out[:, 64 * j + 32:64 * (j + 1)] = (
+            d * sc[:, hi:hi + 1] * (q >> 4) - dmin * mn[:, hi:hi + 1])
+    return out
+
+
+def _deq_q5_k(b):
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)
+    dmin = b[:, 2:4].copy().view(np.float16).astype(np.float32)
+    sc, mn = _k_scale_min(b[:, 4:16])
+    qh, qs = b[:, 16:48], b[:, 48:]  # [nb,32], [nb,128]
+    out = np.empty((b.shape[0], 256), np.float32)
+    u = 1
+    for j in range(4):
+        q = qs[:, 32 * j:32 * (j + 1)]
+        lo, hi = 2 * j, 2 * j + 1
+        out[:, 64 * j:64 * j + 32] = (
+            d * sc[:, lo:lo + 1]
+            * ((q & 0xF) + np.where(qh & u, 16, 0))
+            - dmin * mn[:, lo:lo + 1])
+        u <<= 1
+        out[:, 64 * j + 32:64 * (j + 1)] = (
+            d * sc[:, hi:hi + 1]
+            * ((q >> 4) + np.where(qh & u, 16, 0))
+            - dmin * mn[:, hi:hi + 1])
+        u <<= 1
+    return out
+
+
+def _deq_q6_k(b):
+    ql, qh = b[:, :128], b[:, 128:192]
+    sc = b[:, 192:208].view(np.int8).astype(np.float32)  # [nb, 16]
+    d = b[:, 208:210].copy().view(np.float16).astype(np.float32)
+    out = np.empty((b.shape[0], 256), np.float32)
+    for half in range(2):  # 128 values per half
+        qlh = ql[:, 64 * half:64 * (half + 1)]
+        qhh = qh[:, 32 * half:32 * (half + 1)]
+        s = sc[:, 8 * half:8 * (half + 1)]
+        base = 128 * half
+        # scale per 16 values → expand each of the 2 idx per 32-lane row
+        sl = np.repeat(s, 16, axis=1)  # [nb, 128]
+        q1 = ((qlh[:, :32] & 0xF) | (((qhh >> 0) & 3) << 4)).astype(np.int16) - 32
+        q2 = ((qlh[:, 32:] & 0xF) | (((qhh >> 2) & 3) << 4)).astype(np.int16) - 32
+        q3 = ((qlh[:, :32] >> 4) | (((qhh >> 4) & 3) << 4)).astype(np.int16) - 32
+        q4 = ((qlh[:, 32:] >> 4) | (((qhh >> 6) & 3) << 4)).astype(np.int16) - 32
+        out[:, base + 0:base + 32] = d * sl[:, 0:32] * q1
+        out[:, base + 32:base + 64] = d * sl[:, 32:64] * q2
+        out[:, base + 64:base + 96] = d * sl[:, 64:96] * q3
+        out[:, base + 96:base + 128] = d * sl[:, 96:128] * q4
+    return out
+
+
+#: ggml_type → (bytes_per_block, values_per_block, dequant)
+GGML_QUANTS = {
+    GGML_Q4_0: (18, 32, _deq_q4_0),
+    GGML_Q4_1: (20, 32, _deq_q4_1),
+    GGML_Q5_0: (22, 32, _deq_q5_0),
+    GGML_Q5_1: (24, 32, _deq_q5_1),
+    GGML_Q8_0: (34, 32, _deq_q8_0),
+    GGML_Q4_K: (144, 256, _deq_q4_k),
+    GGML_Q5_K: (176, 256, _deq_q5_k),
+    GGML_Q6_K: (210, 256, _deq_q6_k),
+}
 
 
 @dataclass
@@ -140,21 +282,33 @@ class GGUFFile:
         """Materialize one tensor; pass an open file to batch many reads
         through a single handle (load_gguf_params does)."""
         info = self.tensors[name]
+        count = int(np.prod(info.shape)) if info.shape else 1
         dtype = _np_dtype(info.ggml_type)
         if dtype is None:
-            raise NotImplementedError(
-                f"tensor {name}: ggml type {info.ggml_type} is quantized — "
-                "native serving needs an F32/F16/BF16 export (quantized GGUF "
-                "would be dequantized silently wrong; refusing)")
-        count = int(np.prod(info.shape)) if info.shape else 1
+            quant = GGML_QUANTS.get(info.ggml_type)
+            if quant is None:
+                raise NotImplementedError(
+                    f"tensor {name}: ggml type {info.ggml_type} is not "
+                    "supported (F32/F16/BF16 and "
+                    "Q4_0/Q4_1/Q5_0/Q5_1/Q8_0/Q4_K/Q5_K/Q6_K are)")
+            bpb, vpb, deq = quant
+            if count % vpb:
+                raise ValueError(f"tensor {name}: {count} values not a "
+                                 f"multiple of the {vpb}-value quant block")
+            nbytes = count // vpb * bpb
+            buf = self._read(f, info.offset, nbytes)
+            raw = np.frombuffer(buf, np.uint8).reshape(-1, bpb)
+            return deq(raw).reshape(info.shape)
+        buf = self._read(f, info.offset, count * dtype.itemsize)
+        return np.frombuffer(buf, dtype=dtype).reshape(info.shape)
+
+    def _read(self, f: Optional[BinaryIO], offset: int, n: int) -> bytes:
         if f is None:
             with open(self.path, "rb") as fh:
-                fh.seek(self.data_start + info.offset)
-                buf = fh.read(count * dtype.itemsize)
-        else:
-            f.seek(self.data_start + info.offset)
-            buf = f.read(count * dtype.itemsize)
-        return np.frombuffer(buf, dtype=dtype).reshape(info.shape)
+                fh.seek(self.data_start + offset)
+                return fh.read(n)
+        f.seek(self.data_start + offset)
+        return f.read(n)
 
     @property
     def architecture(self) -> str:

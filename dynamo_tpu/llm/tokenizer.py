@@ -10,6 +10,9 @@ memory so the whole pipeline (and CI) runs without model downloads.
 
 from __future__ import annotations
 
+import logging
+import re
+
 import json
 import os
 from typing import Optional
@@ -140,7 +143,20 @@ class TokenizerWrapper:
         return TokenizerWrapper(tk, chat_template, bos, eos)
 
 
-_SP_BYTE = __import__("re").compile(r"<0x[0-9A-Fa-f]{2}>")
+_SP_BYTE = re.compile(r"<0x[0-9A-Fa-f]{2}>")
+
+
+def load_guided_vocab(tokenizer_ref: str):
+    """Best-effort guided-decoding vocabulary for a worker main: returns
+    None (guided requests will be refused with a clear error) when the
+    tokenizer cannot be decoded, rather than failing startup."""
+    try:
+        return TokenizerWrapper.from_dir(tokenizer_ref).guided_vocab()
+    except Exception:
+        logging.getLogger("dynamo.tokenizer").warning(
+            "could not decode vocab from %s; guided decoding disabled",
+            tokenizer_ref, exc_info=True)
+        return None
 
 
 def _bytelevel_inverse() -> dict:

@@ -135,7 +135,8 @@ def _parse_common(body: dict, req: ParsedRequest) -> ParsedRequest:
     if not guided and isinstance(rf, dict):
         rft = rf.get("type")
         if rft == "json_schema":
-            schema = (rf.get("json_schema") or {}).get("schema")
+            js = rf.get("json_schema")
+            schema = js.get("schema") if isinstance(js, dict) else None
             if schema is None:
                 raise RequestError(
                     "response_format json_schema requires "

@@ -110,15 +110,8 @@ async def start_worker(runtime, out: str, cli):
                        use_pallas_attention=cli.use_pallas_attention)
     guided_vocab = None
     if tokenizer_ref:
-        try:
-            from dynamo_tpu.llm.tokenizer import TokenizerWrapper
-            guided_vocab = TokenizerWrapper.from_dir(
-                tokenizer_ref).guided_vocab()
-        except Exception:
-            import logging
-            logging.getLogger("dynamo.run").warning(
-                "could not decode vocab from %s; guided decoding disabled",
-                tokenizer_ref, exc_info=True)
+        from dynamo_tpu.llm.tokenizer import load_guided_vocab
+        guided_vocab = load_guided_vocab(tokenizer_ref)
     engine = AsyncJaxEngine(cfg, eargs, params=params,
                             guided_vocab=guided_vocab)
     mm_client = None

@@ -175,10 +175,10 @@ def test_resolution_kinds(gguf_path, tmp_path):
         resolve_model("no-such-org/no-such-model-xyz", allow_download=False)
 
 
-def test_quantized_tensor_refuses(gguf_path, tmp_path):
+def test_unsupported_quant_refuses(gguf_path, tmp_path):
     path, _ = gguf_path
     g = GGUFFile.parse(path)
-    g.tensors["token_embd.weight"].ggml_type = 12  # a ggml quant type
+    g.tensors["token_embd.weight"].ggml_type = 10  # q2_K: not implemented
     with pytest.raises(NotImplementedError):
         g.load_tensor("token_embd.weight")
 
@@ -209,3 +209,246 @@ async def test_engine_serves_gguf(gguf_path):
         toks.extend(out.token_ids)
     assert len(toks) == 4
     await eng.close()
+
+
+# ------------------------------------------------------ quant dequantization
+
+def _scalar_q6k(block: bytes) -> np.ndarray:
+    """Independent straight-from-spec scalar q6_K dequant to cross-check
+    the vectorized loader path."""
+    ql, qh = block[:128], block[128:192]
+    sc = np.frombuffer(block[192:208], np.int8)
+    d = float(np.frombuffer(block[208:210], np.float16)[0])
+    y = np.zeros(256, np.float32)
+    for half in range(2):
+        for l in range(32):
+            is_ = l // 16
+            b0, b1 = ql[64 * half + l], ql[64 * half + 32 + l]
+            h = qh[32 * half + l]
+            q1 = ((b0 & 0xF) | (((h >> 0) & 3) << 4)) - 32
+            q2 = ((b1 & 0xF) | (((h >> 2) & 3) << 4)) - 32
+            q3 = ((b0 >> 4) | (((h >> 4) & 3) << 4)) - 32
+            q4 = ((b1 >> 4) | (((h >> 6) & 3) << 4)) - 32
+            s = sc[8 * half:]
+            y[128 * half + l + 0] = d * s[is_ + 0] * q1
+            y[128 * half + l + 32] = d * s[is_ + 2] * q2
+            y[128 * half + l + 64] = d * s[is_ + 4] * q3
+            y[128 * half + l + 96] = d * s[is_ + 6] * q4
+    return y
+
+
+def _scalar_q4k(block: bytes) -> np.ndarray:
+    d = float(np.frombuffer(block[0:2], np.float16)[0])
+    dmin = float(np.frombuffer(block[2:4], np.float16)[0])
+    scales = block[4:16]
+    qs = block[16:]
+
+    def sm(j):
+        if j < 4:
+            return scales[j] & 63, scales[j + 4] & 63
+        return ((scales[j + 4] & 0xF) | ((scales[j - 4] >> 6) << 4),
+                (scales[j + 4] >> 4) | ((scales[j] >> 6) << 4))
+
+    y = np.zeros(256, np.float32)
+    pos = 0
+    for j in range(4):
+        s1, m1 = sm(2 * j)
+        s2, m2 = sm(2 * j + 1)
+        chunk = qs[32 * j:32 * (j + 1)]
+        for q in chunk:
+            y[pos] = d * s1 * (q & 0xF) - dmin * m1
+            pos += 1
+        for q in chunk:
+            y[pos] = d * s2 * (q >> 4) - dmin * m2
+            pos += 1
+    return y
+
+
+def test_q8_0_q4_0_roundtrip():
+    """Quantize synthetic rows in the documented formats; dequant must
+    recover within the format's quantization error."""
+    from dynamo_tpu.llm.gguf import GGML_QUANTS, GGML_Q4_0, GGML_Q8_0
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4, 64)).astype(np.float32)
+
+    # q8_0 encoder: per-32 block, d = max|x|/127, q = round(x/d)
+    blocks = []
+    for row in x.reshape(-1, 32):
+        d = np.abs(row).max() / 127.0
+        q = np.clip(np.round(row / d), -127, 127).astype(np.int8)
+        blocks.append(np.float16(d).tobytes() + q.tobytes())
+    _, _, deq = GGML_QUANTS[GGML_Q8_0]
+    out = deq(np.frombuffer(b"".join(blocks), np.uint8).reshape(-1, 34))
+    np.testing.assert_allclose(out.reshape(x.shape), x, atol=0.02)
+
+    # q4_0 encoder: d = -max|x|/8 convention is ggml's; use d = max|x|/7
+    # with the (q-8) decode — valid blocks even if not bit-identical to
+    # llama.cpp's chosen scale
+    blocks = []
+    for row in x.reshape(-1, 32):
+        d = np.abs(row).max() / 7.0
+        q = np.clip(np.round(row / d) + 8, 0, 15).astype(np.uint8)
+        packed = (q[:16] | (q[16:] << 4)).astype(np.uint8)  # low|high nibble
+        blocks.append(np.float16(d).tobytes() + packed.tobytes())
+    _, _, deq = GGML_QUANTS[GGML_Q4_0]
+    out = deq(np.frombuffer(b"".join(blocks), np.uint8).reshape(-1, 18))
+    # error bound is d/2 = max|row|/14 — worst row here has max|x| ~3.3
+    np.testing.assert_allclose(out.reshape(x.shape), x, atol=0.3)
+
+
+def test_k_quants_match_scalar_reference():
+    rng = np.random.default_rng(9)
+    from dynamo_tpu.llm.gguf import GGML_QUANTS, GGML_Q4_K, GGML_Q6_K
+
+    raw6 = rng.integers(0, 256, (3, 210), dtype=np.uint8)
+    raw6[:, 208:210] = np.frombuffer(
+        np.full(3, 0.02, np.float16).tobytes(), np.uint8).reshape(3, 2)
+    _, _, deq6 = GGML_QUANTS[GGML_Q6_K]
+    got = deq6(raw6.copy())
+    for i in range(3):
+        np.testing.assert_allclose(got[i], _scalar_q6k(raw6[i].tobytes()),
+                                   rtol=1e-5, atol=1e-6)
+
+    raw4 = rng.integers(0, 256, (3, 144), dtype=np.uint8)
+    half = np.frombuffer(np.full(3, 0.01, np.float16).tobytes(),
+                         np.uint8).reshape(3, 2)
+    raw4[:, 0:2] = half
+    raw4[:, 2:4] = half
+    _, _, deq4 = GGML_QUANTS[GGML_Q4_K]
+    got = deq4(raw4.copy())
+    for i in range(3):
+        np.testing.assert_allclose(got[i], _scalar_q4k(raw4[i].tobytes()),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_quantized_gguf_serves(tmp_path):
+    """A GGUF whose big matrices are q8_0 must load and produce logits
+    close to the f32 original through the real loader path."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.llm.gguf import (
+        GGML_Q8_0, GGUFFile, config_from_gguf, load_gguf_params,
+    )
+
+    f32 = str(tmp_path / "f32.gguf")
+    tensors = write_tiny_gguf(f32)
+
+    # re-encode every (n, 32k)-shaped matrix as q8_0
+    def q8(arr):
+        rows = arr.reshape(-1, 32)
+        d = np.abs(rows).max(axis=1, keepdims=True) / 127.0
+        d = np.where(d == 0, 1e-8, d)
+        q = np.clip(np.round(rows / d), -127, 127).astype(np.int8)
+        blocks = np.concatenate(
+            [d.astype(np.float16).view(np.uint8), q.view(np.uint8)], axis=1)
+        return blocks.tobytes()
+
+    qpath = str(tmp_path / "q8.gguf")
+    with open(f32, "rb") as f:
+        head = f.read()
+    # rewrite: simplest valid approach — patch tensor data in place is
+    # fiddly; rebuild via the writer with a custom data section
+    align, infos, data = 32, b"", b""
+    for name, arr in tensors.items():
+        pad = (-len(data)) % align
+        data += b"\0" * pad
+        quantize = arr.ndim == 2 and arr.size % 32 == 0
+        infos += (_s(name) + struct.pack("<I", arr.ndim)
+                  + struct.pack(f"<{arr.ndim}Q", *reversed(arr.shape))
+                  + struct.pack("<IQ", GGML_Q8_0 if quantize else 0,
+                                len(data)))
+        data += q8(arr) if quantize else arr.tobytes()
+    # reuse the metadata bytes from the f32 file
+    n_kv = struct.unpack("<Q", head[16:24])[0]
+    meta = head[24:g0_meta_end(f32)]
+    header = b"GGUF" + struct.pack("<I", 3) + struct.pack(
+        "<QQ", len(tensors), n_kv)
+    body = header + meta + infos
+    pad = (-len(body)) % align
+    with open(qpath, "wb") as f:
+        f.write(body + b"\0" * pad + data)
+
+    g = GGUFFile.parse(qpath)
+    cfg = config_from_gguf(g)
+    cfg.dtype = "float32"
+    params = load_gguf_params(g, cfg, dtype=jnp.float32)
+    w = np.asarray(params["layers"]["wq"][0])
+    ref = tensors["blk.0.attn_q.weight"].T
+    np.testing.assert_allclose(w, ref, atol=0.02)
+
+
+def g0_meta_end(path):
+    """Offset where the metadata block ends (= where tensor infos start):
+    re-derive by re-reading kv pairs exactly as the parser does."""
+    with open(path, "rb") as f:
+        f.read(8)
+        n_tensors, n_kv = struct.unpack("<QQ", f.read(16))
+        for _ in range(n_kv):
+            GGUFFile._read_str(f)
+            (vtype,) = struct.unpack("<I", f.read(4))
+            GGUFFile._read_value(f, vtype)
+        return f.tell()
+
+
+def test_q5_0_roundtrip_and_q5k_scalar():
+    from dynamo_tpu.llm.gguf import GGML_QUANTS, GGML_Q5_0, GGML_Q5_K
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 64)).astype(np.float32)
+    blocks = []
+    for row in x.reshape(-1, 32):
+        d = np.abs(row).max() / 15.0
+        q = np.clip(np.round(row / d) + 16, 0, 31).astype(np.uint8)
+        qh = 0
+        for j in range(32):
+            qh |= int(q[j] >> 4) << j
+        packed = ((q[:16] & 0xF) | ((q[16:] & 0xF) << 4)).astype(np.uint8)
+        blocks.append(np.float16(d).tobytes()
+                      + struct.pack("<I", qh) + packed.tobytes())
+    _, _, deq = GGML_QUANTS[GGML_Q5_0]
+    out = deq(np.frombuffer(b"".join(blocks), np.uint8).reshape(-1, 22))
+    np.testing.assert_allclose(out.reshape(x.shape), x, atol=0.12)
+
+    # q5_K vs straight-from-spec scalar
+    raw = rng.integers(0, 256, (2, 176), dtype=np.uint8)
+    half = np.frombuffer(np.full(2, 0.01, np.float16).tobytes(),
+                         np.uint8).reshape(2, 2)
+    raw[:, 0:2] = half
+    raw[:, 2:4] = half
+
+    def scalar_q5k(block):
+        d = float(np.frombuffer(block[0:2], np.float16)[0])
+        dmin = float(np.frombuffer(block[2:4], np.float16)[0])
+        scales = block[4:16]
+        qh, qs = block[16:48], block[48:]
+
+        def sm(j):
+            if j < 4:
+                return scales[j] & 63, scales[j + 4] & 63
+            return ((scales[j + 4] & 0xF) | ((scales[j - 4] >> 6) << 4),
+                    (scales[j + 4] >> 4) | ((scales[j] >> 6) << 4))
+
+        y = np.zeros(256, np.float32)
+        pos, u1, u2 = 0, 1, 2
+        for j in range(4):
+            s1, m1 = sm(2 * j)
+            s2, m2 = sm(2 * j + 1)
+            chunk = qs[32 * j:32 * (j + 1)]
+            for l, q in enumerate(chunk):
+                y[pos] = d * s1 * ((q & 0xF) + (16 if qh[l] & u1 else 0)) \
+                    - dmin * m1
+                pos += 1
+            for l, q in enumerate(chunk):
+                y[pos] = d * s2 * ((q >> 4) + (16 if qh[l] & u2 else 0)) \
+                    - dmin * m2
+                pos += 1
+            u1 <<= 2
+            u2 <<= 2
+        return y
+
+    _, _, deq5k = GGML_QUANTS[GGML_Q5_K]
+    got = deq5k(raw.copy())
+    for i in range(2):
+        np.testing.assert_allclose(got[i], scalar_q5k(raw[i].tobytes()),
+                                   rtol=1e-5, atol=1e-6)
