@@ -72,6 +72,41 @@ def _deinterleave_rope_rows(w: np.ndarray, starts, dr: int) -> np.ndarray:
     return w
 
 
+#: fp4 e2m1 value table, sign in the high bit (HF mxfp4 FP4_VALUES)
+_FP4_LUT = np.array([0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+                     -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0],
+                    np.float32)
+
+
+def _mxfp4_dequant(blocks: np.ndarray, scales: np.ndarray,
+                   out_dtype=np.float32) -> np.ndarray:
+    """[..., G, 16]u8 blocks + [..., G]u8 e8m0 scales → [..., last-two-
+    swapped] in ``out_dtype``, matching transformers'
+    convert_moe_packed_tensors (nibble lo/hi interleave, ldexp by
+    scale-127, final transpose(1, 2)).
+
+    Dequantizes one leading-axis (expert) slice at a time so the float32
+    transient is bounded per expert, not the whole layer — and fp4 values
+    times power-of-2 scales are EXACT in bf16, so emitting the target
+    dtype directly loses nothing.
+    """
+    *prefix, G, B = blocks.shape
+    out = np.empty((*prefix, G * B * 2), np.dtype(out_dtype))
+    n_lead = prefix[0] if prefix else 1
+    blk_l = blocks.reshape(n_lead, -1, B)
+    sc_l = scales.reshape(n_lead, -1)
+    out_l = out.reshape(n_lead, -1, G * B * 2)
+    for ei in range(n_lead):
+        blk = blk_l[ei]
+        exp = sc_l[ei].astype(np.int32).reshape(-1, 1) - 127
+        tmp = np.empty((blk.shape[0], B * 2), np.float32)
+        tmp[:, 0::2] = _FP4_LUT[blk & 0x0F]
+        tmp[:, 1::2] = _FP4_LUT[blk >> 4]
+        np.ldexp(tmp, exp, out=tmp)
+        out_l[ei] = tmp.reshape(-1, G * B * 2)
+    return out.swapaxes(-2, -1)
+
+
 def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
     """Map HF llama/mistral/qwen2/mixtral/deepseek weight names onto the
     model.py pytree."""
@@ -174,12 +209,32 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
             }
             return out
         if f"model.layers.{i}.mlp.experts.gate_up_proj_blocks" in t:
-            raise NotImplementedError(
-                "this gpt-oss checkpoint stores MXFP4-quantized experts "
-                "(gate_up_proj_blocks/scales); dequantize to bf16 first "
-                "(e.g. save_pretrained from transformers with "
-                "dequantized weights) — loading quantized blocks silently "
-                "wrong is refused")
+            # MXFP4-quantized experts (the format real gpt-oss checkpoints
+            # ship): e2m1 nibble pairs + e8m0 per-32 block scales,
+            # dequantized at load (layout per the HF mxfp4 integration:
+            # lo/hi nibbles interleave along the last dim, stored
+            # [E, cols, groups, 16] -> param [E, rows, cols])
+            pre = f"model.layers.{i}.mlp"
+            gu = _mxfp4_dequant(
+                np.asarray(t[f"{pre}.experts.gate_up_proj_blocks"]),
+                np.asarray(t[f"{pre}.experts.gate_up_proj_scales"]),
+                out_dtype=dtype)
+            gub = np.asarray(t[f"{pre}.experts.gate_up_proj_bias"])
+            down = _mxfp4_dequant(
+                np.asarray(t[f"{pre}.experts.down_proj_blocks"]),
+                np.asarray(t[f"{pre}.experts.down_proj_scales"]),
+                out_dtype=dtype)
+            return {
+                "router": proj(f"{pre}.router.weight"),
+                "router_bias": jnp.asarray(
+                    np.asarray(t[f"{pre}.router.bias"]), jnp.float32),
+                "w_gate": jnp.asarray(gu[..., ::2], dtype=dtype),
+                "w_up": jnp.asarray(gu[..., 1::2], dtype=dtype),
+                "b_gate": jnp.asarray(gub[..., ::2], dtype=dtype),
+                "b_up": jnp.asarray(gub[..., 1::2], dtype=dtype),
+                "w_down": jnp.asarray(down, dtype=dtype),
+                "b_down": get(f"{pre}.experts.down_proj_bias"),
+            }
         if f"model.layers.{i}.mlp.experts.gate_up_proj" in t:  # gpt-oss
             pre = f"model.layers.{i}.mlp"
             # fused [E, D, 2F] with gate/up interleaved on the last dim;

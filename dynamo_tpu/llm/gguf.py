@@ -292,9 +292,14 @@ class GGUFFile:
                     "supported (F32/F16/BF16 and "
                     "Q4_0/Q4_1/Q5_0/Q5_1/Q8_0/Q4_K/Q5_K/Q6_K are)")
             bpb, vpb, deq = quant
-            if count % vpb:
-                raise ValueError(f"tensor {name}: {count} values not a "
-                                 f"multiple of the {vpb}-value quant block")
+            # ggml blocks never span rows: the ROW length (ne[0], our last
+            # dim) must be block-aligned — a total-count check would let a
+            # malformed file dequantize scrambled across row boundaries
+            row = info.shape[-1] if info.shape else count
+            if row % vpb:
+                raise ValueError(
+                    f"tensor {name}: row length {row} not a multiple of "
+                    f"the {vpb}-value quant block")
             nbytes = count // vpb * bpb
             buf = self._read(f, info.offset, nbytes)
             raw = np.frombuffer(buf, np.uint8).reshape(-1, bpb)

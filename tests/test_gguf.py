@@ -353,7 +353,7 @@ def test_quantized_gguf_serves(tmp_path):
     for name, arr in tensors.items():
         pad = (-len(data)) % align
         data += b"\0" * pad
-        quantize = arr.ndim == 2 and arr.size % 32 == 0
+        quantize = arr.ndim == 2 and arr.shape[-1] % 32 == 0
         infos += (_s(name) + struct.pack("<I", arr.ndim)
                   + struct.pack(f"<{arr.ndim}Q", *reversed(arr.shape))
                   + struct.pack("<IQ", GGML_Q8_0 if quantize else 0,
@@ -373,9 +373,10 @@ def test_quantized_gguf_serves(tmp_path):
     cfg = config_from_gguf(g)
     cfg.dtype = "float32"
     params = load_gguf_params(g, cfg, dtype=jnp.float32)
-    w = np.asarray(params["layers"]["wq"][0])
-    ref = tensors["blk.0.attn_q.weight"].T
+    w = np.asarray(params["layers"]["w_down"][0])
+    ref = tensors["blk.0.ffn_down.weight"].T  # [F=32, D] rows are aligned
     np.testing.assert_allclose(w, ref, atol=0.02)
+    assert np.abs(w - ref).max() > 0  # the quantized path really ran
 
 
 def g0_meta_end(path):
@@ -452,3 +453,16 @@ def test_q5_0_roundtrip_and_q5k_scalar():
     for i in range(2):
         np.testing.assert_allclose(got[i], scalar_q5k(raw[i].tobytes()),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_quant_rows_must_be_block_aligned(gguf_path):
+    """ggml blocks never span rows: a tensor whose row length is not a
+    block multiple must refuse, not dequantize scrambled."""
+    from dynamo_tpu.llm.gguf import GGML_Q8_0
+
+    path, _ = gguf_path
+    g = GGUFFile.parse(path)
+    info = g.tensors["blk.0.attn_q.weight"]  # rows of 16 < 32-value block
+    info.ggml_type = GGML_Q8_0
+    with pytest.raises(ValueError, match="row length"):
+        g.load_tensor("blk.0.attn_q.weight")
