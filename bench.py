@@ -217,12 +217,37 @@ async def _e2e(on_tpu: bool) -> dict:
     }
 
 
+def _device_init_responsive(timeout_s: float = 240.0) -> bool:
+    """Probe jax backend init in a SUBPROCESS: a broken TPU tunnel makes
+    jax.devices() hang forever (observed: axon UNAVAILABLE wedged for
+    hours), which would leave the driver with no metric at all. A hung
+    probe -> fall back to the CPU bench in THIS process."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     import jax
 
     from dynamo_tpu.runtime.config import apply_platform_env
 
     apply_platform_env()  # sitecustomize pins the TPU; honor JAX_PLATFORMS
+    # the probe costs one duplicate backend init (~30s healthy); skip it
+    # with DYN_BENCH_SKIP_PROBE=1 on hosts known good
+    if (not os.environ.get("JAX_PLATFORMS")
+            and not os.environ.get("DYN_BENCH_SKIP_PROBE")
+            and not _device_init_responsive()):
+        print("device init unresponsive; falling back to CPU bench",
+              flush=True)
+        jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
 

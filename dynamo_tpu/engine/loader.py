@@ -192,6 +192,23 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
             "w_down": proj(f"model.layers.{i}.mlp.down_proj.weight"),
         }
 
+    def oss_experts(pre: str, gu, w_down) -> dict:
+        """gpt-oss expert dict from fused gate_up [E, D, 2F] (bf16 or
+        dequantized MXFP4) + down [E, F, D] — ONE builder so the quantized
+        and unquantized load paths cannot diverge."""
+        gub = np.asarray(t[f"{pre}.experts.gate_up_proj_bias"])  # [E, 2F]
+        return {
+            "router": proj(f"{pre}.router.weight"),
+            "router_bias": jnp.asarray(
+                np.asarray(t[f"{pre}.router.bias"]), jnp.float32),
+            "w_gate": jnp.asarray(gu[..., ::2], dtype=dtype),
+            "w_up": jnp.asarray(gu[..., 1::2], dtype=dtype),
+            "b_gate": jnp.asarray(gub[..., ::2], dtype=dtype),
+            "b_up": jnp.asarray(gub[..., 1::2], dtype=dtype),
+            "w_down": w_down,  # [E, F, D]
+            "b_down": get(f"{pre}.experts.down_proj_bias"),  # [E, D]
+        }
+
     def moe_mlp_layer(i: int) -> dict:
         import jax.numpy as jnp
 
@@ -219,39 +236,17 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
                 np.asarray(t[f"{pre}.experts.gate_up_proj_blocks"]),
                 np.asarray(t[f"{pre}.experts.gate_up_proj_scales"]),
                 out_dtype=dtype)
-            gub = np.asarray(t[f"{pre}.experts.gate_up_proj_bias"])
             down = _mxfp4_dequant(
                 np.asarray(t[f"{pre}.experts.down_proj_blocks"]),
                 np.asarray(t[f"{pre}.experts.down_proj_scales"]),
                 out_dtype=dtype)
-            return {
-                "router": proj(f"{pre}.router.weight"),
-                "router_bias": jnp.asarray(
-                    np.asarray(t[f"{pre}.router.bias"]), jnp.float32),
-                "w_gate": jnp.asarray(gu[..., ::2], dtype=dtype),
-                "w_up": jnp.asarray(gu[..., 1::2], dtype=dtype),
-                "b_gate": jnp.asarray(gub[..., ::2], dtype=dtype),
-                "b_up": jnp.asarray(gub[..., 1::2], dtype=dtype),
-                "w_down": jnp.asarray(down, dtype=dtype),
-                "b_down": get(f"{pre}.experts.down_proj_bias"),
-            }
+            return oss_experts(pre, gu, jnp.asarray(down, dtype=dtype))
         if f"model.layers.{i}.mlp.experts.gate_up_proj" in t:  # gpt-oss
             pre = f"model.layers.{i}.mlp"
             # fused [E, D, 2F] with gate/up interleaved on the last dim;
             # stored [in, out] already (nn.Parameter, not a Linear)
-            gu = np.asarray(t[f"{pre}.experts.gate_up_proj"])
-            gub = np.asarray(t[f"{pre}.experts.gate_up_proj_bias"])  # [E, 2F]
-            return {
-                "router": proj(f"{pre}.router.weight"),
-                "router_bias": jnp.asarray(
-                    np.asarray(t[f"{pre}.router.bias"]), jnp.float32),
-                "w_gate": jnp.asarray(gu[..., ::2], dtype=dtype),
-                "w_up": jnp.asarray(gu[..., 1::2], dtype=dtype),
-                "b_gate": jnp.asarray(gub[..., ::2], dtype=dtype),
-                "b_up": jnp.asarray(gub[..., 1::2], dtype=dtype),
-                "w_down": get(f"{pre}.experts.down_proj"),  # [E, F, D]
-                "b_down": get(f"{pre}.experts.down_proj_bias"),  # [E, D]
-            }
+            return oss_experts(pre, np.asarray(t[f"{pre}.experts.gate_up_proj"]),
+                               get(f"{pre}.experts.down_proj"))
         pre = f"model.layers.{i}.mlp"  # deepseek/qwen-moe style
         bias_name = f"{pre}.gate.e_score_correction_bias"
         expert = lambda e, n: proj(f"{pre}.experts.{e}.{n}.weight")  # noqa: E731
