@@ -11,9 +11,11 @@ llama.cpp engine's job there). Here the same surface:
   :class:`ModelConfig`.
 - ``tokenizer_from_gguf`` rebuilds a HF ``tokenizers`` BPE from the
   embedded ``tokenizer.ggml.*`` arrays.
-- ``load_tensor`` materializes F32/F16/BF16 tensors (enough to serve an
-  unquantized export natively; quantized ggml types are indexed but load
-  refuses them loudly rather than dequantizing silently wrong).
+- ``load_tensor`` materializes F32/F16/BF16 tensors directly and
+  DEQUANTIZES the common ggml quant formats (Q4_0/Q4_1/Q5_0/Q5_1/Q8_0 and
+  the Q2_K..Q6_K superblocks) to float at load — real llama.cpp
+  checkpoints ship quantized. Unsupported formats (IQ*) refuse loudly
+  rather than dequantizing silently wrong.
 
 Format per the public GGUF spec (ggml project): little-endian, magic
 "GGUF", version 3; strings are u64-length-prefixed UTF-8; arrays carry an
@@ -42,7 +44,7 @@ _SCALAR_FMT = {
 GGML_F32, GGML_F16 = 0, 1
 GGML_BF16 = 30
 GGML_Q4_0, GGML_Q4_1, GGML_Q5_0, GGML_Q5_1, GGML_Q8_0 = 2, 3, 6, 7, 8
-GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 12, 13, 14
+GGML_Q2_K, GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 10, 11, 12, 13, 14
 
 
 def _np_dtype(ggml_type: int):
@@ -161,6 +163,65 @@ def _deq_q5_k(b):
     return out
 
 
+def _deq_q2_k(b):
+    # 84B: scales 16×(lo4=scale, hi4=min), qs 64B of 2-bit quants, d, dmin
+    sc_raw = b[:, :16]
+    qs = b[:, 16:80]
+    d = b[:, 80:82].copy().view(np.float16).astype(np.float32)
+    dmin = b[:, 82:84].copy().view(np.float16).astype(np.float32)
+    out = np.empty((b.shape[0], 256), np.float32)
+    pos, is_ = 0, 0
+    for n in range(2):  # 128 values per 32-byte q chunk
+        q = qs[:, 32 * n:32 * (n + 1)]
+        for shift in (0, 2, 4, 6):
+            for half in range(2):  # two 16-value sub-groups
+                sc = sc_raw[:, is_:is_ + 1]
+                is_ += 1
+                dl = d * (sc & 0xF)
+                ml = dmin * (sc >> 4).astype(np.float32)
+                qv = (q[:, 16 * half:16 * (half + 1)] >> shift) & 3
+                out[:, pos:pos + 16] = dl * qv - ml
+                pos += 16
+    return out
+
+
+def _q3k_scales(scales):
+    """q3_K 12-byte packing → 16 signed 6-bit scales (value - 32)."""
+    a = scales.copy().view(np.uint32)  # [nb, 3]
+    k1, k2 = np.uint32(0x03030303), np.uint32(0x0F0F0F0F)
+    tmp = a[:, 2]
+    aux = np.empty((scales.shape[0], 4), np.uint32)
+    aux[:, 0] = (a[:, 0] & k2) | (((tmp >> 0) & k1) << 4)
+    aux[:, 1] = (a[:, 1] & k2) | (((tmp >> 2) & k1) << 4)
+    aux[:, 2] = ((a[:, 0] >> 4) & k2) | (((tmp >> 4) & k1) << 4)
+    aux[:, 3] = ((a[:, 1] >> 4) & k2) | (((tmp >> 6) & k1) << 4)
+    return aux.view(np.int8).astype(np.float32) - 32.0  # [nb, 16]
+
+
+def _deq_q3_k(b):
+    # 110B: hmask 32B (high bits), qs 64B (2-bit), scales 12B, d fp16
+    hm = b[:, :32]
+    qs = b[:, 32:96]
+    sc = _q3k_scales(b[:, 96:108])
+    d = b[:, 108:110].copy().view(np.float16).astype(np.float32)
+    out = np.empty((b.shape[0], 256), np.float32)
+    pos, is_, m = 0, 0, 1
+    for n in range(2):
+        q = qs[:, 32 * n:32 * (n + 1)]
+        for shift in (0, 2, 4, 6):
+            for half in range(2):
+                dl = d * sc[:, is_:is_ + 1]
+                is_ += 1
+                cols = slice(16 * half, 16 * (half + 1))
+                qv = ((q[:, cols] >> shift) & 3).astype(np.int8)
+                # hmask bit SET means the value is NOT shifted down by 4
+                qv = qv - np.where(hm[:, cols] & m, 0, 4).astype(np.int8)
+                out[:, pos:pos + 16] = dl * qv
+                pos += 16
+            m <<= 1
+    return out
+
+
 def _deq_q6_k(b):
     ql, qh = b[:, :128], b[:, 128:192]
     sc = b[:, 192:208].view(np.int8).astype(np.float32)  # [nb, 16]
@@ -186,6 +247,8 @@ def _deq_q6_k(b):
 
 #: ggml_type → (bytes_per_block, values_per_block, dequant)
 GGML_QUANTS = {
+    GGML_Q2_K: (84, 256, _deq_q2_k),
+    GGML_Q3_K: (110, 256, _deq_q3_k),
     GGML_Q4_0: (18, 32, _deq_q4_0),
     GGML_Q4_1: (20, 32, _deq_q4_1),
     GGML_Q5_0: (22, 32, _deq_q5_0),
@@ -290,7 +353,7 @@ class GGUFFile:
                 raise NotImplementedError(
                     f"tensor {name}: ggml type {info.ggml_type} is not "
                     "supported (F32/F16/BF16 and "
-                    "Q4_0/Q4_1/Q5_0/Q5_1/Q8_0/Q4_K/Q5_K/Q6_K are)")
+                    "Q4_0/Q4_1/Q5_0/Q5_1/Q8_0/Q2_K..Q6_K are)")
             bpb, vpb, deq = quant
             # ggml blocks never span rows: the ROW length (ne[0], our last
             # dim) must be block-aligned — a total-count check would let a

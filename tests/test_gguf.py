@@ -178,7 +178,7 @@ def test_resolution_kinds(gguf_path, tmp_path):
 def test_unsupported_quant_refuses(gguf_path, tmp_path):
     path, _ = gguf_path
     g = GGUFFile.parse(path)
-    g.tensors["token_embd.weight"].ggml_type = 10  # q2_K: not implemented
+    g.tensors["token_embd.weight"].ggml_type = 16  # iq2_xxs: unsupported
     with pytest.raises(NotImplementedError):
         g.load_tensor("token_embd.weight")
 
@@ -466,3 +466,78 @@ def test_quant_rows_must_be_block_aligned(gguf_path):
     info.ggml_type = GGML_Q8_0
     with pytest.raises(ValueError, match="row length"):
         g.load_tensor("blk.0.attn_q.weight")
+
+
+def test_q2k_q3k_match_scalar_reference():
+    from dynamo_tpu.llm.gguf import GGML_QUANTS, GGML_Q2_K, GGML_Q3_K
+
+    def scalar_q2k(block):
+        sc = block[:16]
+        qs = block[16:80]
+        d = float(np.frombuffer(block[80:82], np.float16)[0])
+        dmin = float(np.frombuffer(block[82:84], np.float16)[0])
+        y = np.zeros(256, np.float32)
+        pos = is_ = 0
+        for n in range(2):
+            q = qs[32 * n:32 * (n + 1)]
+            for shift in (0, 2, 4, 6):
+                for half in range(2):
+                    s = sc[is_]
+                    is_ += 1
+                    dl, ml = d * (s & 0xF), dmin * (s >> 4)
+                    for l in range(16):
+                        y[pos] = dl * ((q[16 * half + l] >> shift) & 3) - ml
+                        pos += 1
+        return y
+
+    def scalar_q3k(block):
+        hm = block[:32]
+        qs = block[32:96]
+        import struct as st
+        aux = list(st.unpack("<3I", block[96:108]))
+        k1, k2 = 0x03030303, 0x0F0F0F0F
+        tmp = aux[2]
+        a = [(aux[0] & k2) | (((tmp >> 0) & k1) << 4),
+             (aux[1] & k2) | (((tmp >> 2) & k1) << 4),
+             ((aux[0] >> 4) & k2) | (((tmp >> 4) & k1) << 4),
+             ((aux[1] >> 4) & k2) | (((tmp >> 6) & k1) << 4)]
+        sc = np.frombuffer(st.pack("<4I", *a), np.int8).astype(np.float32) - 32
+        d = float(np.frombuffer(block[108:110], np.float16)[0])
+        y = np.zeros(256, np.float32)
+        pos = is_ = 0
+        m = 1
+        for n in range(2):
+            q = qs[32 * n:32 * (n + 1)]
+            for shift in (0, 2, 4, 6):
+                for half in range(2):
+                    dl = d * sc[is_]
+                    is_ += 1
+                    for l in range(16):
+                        col = 16 * half + l
+                        qv = (q[col] >> shift) & 3
+                        if not (hm[col] & m):
+                            qv -= 4
+                        y[pos] = dl * qv
+                        pos += 1
+                m <<= 1
+        return y
+
+    rng = np.random.default_rng(11)
+    raw2 = rng.integers(0, 256, (3, 84), dtype=np.uint8)
+    half = np.frombuffer(np.full(3, 0.05, np.float16).tobytes(),
+                         np.uint8).reshape(3, 2)
+    raw2[:, 80:82] = half
+    raw2[:, 82:84] = half
+    _, _, deq2 = GGML_QUANTS[GGML_Q2_K]
+    got = deq2(raw2.copy())
+    for i in range(3):
+        np.testing.assert_allclose(got[i], scalar_q2k(raw2[i].tobytes()),
+                                   rtol=1e-5, atol=1e-6)
+
+    raw3 = rng.integers(0, 256, (3, 110), dtype=np.uint8)
+    raw3[:, 108:110] = half
+    _, _, deq3 = GGML_QUANTS[GGML_Q3_K]
+    got = deq3(raw3.copy())
+    for i in range(3):
+        np.testing.assert_allclose(got[i], scalar_q3k(raw3[i].tobytes()),
+                                   rtol=1e-5, atol=1e-6)
