@@ -147,6 +147,9 @@ class AsyncJaxEngine:
         self._task: Optional[asyncio.Task] = None
         self._closed = False
         self.steps = 0
+        #: jitted full-model forward passes (each reads every weight once
+        #: from HBM) — the denominator for roofline/MFU accounting in bench.py
+        self.param_reads = 0
         #: multi-process DP fleet rank (None = single-rank); reported in
         #: worker stats (ref: kv_router/protocols.rs:57 data_parallel_rank)
         self.dp_rank: Optional[int] = None
@@ -613,6 +616,8 @@ class AsyncJaxEngine:
         not serialize one-prefill-per-step."""
         import jax.numpy as jnp
 
+        self.param_reads += 1
+
         args = self.args
         bs = args.block_size
         B = args.bucket_batch(len(works))
@@ -828,6 +833,7 @@ class AsyncJaxEngine:
             self.spec_stats.num_draft_tokens += len(d)
             self.spec_stats.num_accepted_tokens += min(accepted, emitted)
             self.spec_stats.num_spec_tokens += emitted
+        self.param_reads += 1
         return True
 
     async def _run_decode(self, seqs: list[SeqState]) -> None:
@@ -890,6 +896,7 @@ class AsyncJaxEngine:
         self._broadcast("step", tokens=tokens, positions=positions,
                         slot_map=slot_map, block_tables=bt, kv_lens=kv_lens,
                         last_idx=last_idx)
+        self.param_reads += 1
         logits, self.k_cache, self.v_cache = self.step_fn(
             self.params, self._put_batch("tokens", tokens),
             self._put_batch("positions", positions),
@@ -945,6 +952,7 @@ class AsyncJaxEngine:
                         positions=positions, block_tables=bt, kv_lens=kv_lens,
                         temp=temp, top_k=top_k, top_p=top_p, seeds=seeds,
                         step0=step0)
+        self.param_reads += K
         toks, logps, self.k_cache, self.v_cache = self.multi_fn(
             self.params, self._put_batch("last_tokens", last_tokens),
             self._put_batch("positions", positions),
