@@ -1078,8 +1078,19 @@ class AsyncJaxEngine:
             # guided decoding: rows whose logits are masked to the
             # constraint's allowed set (allowed() walks the vocab once per
             # NEW dfa state — here in the worker thread, cached after)
-            g_rows = [(i, s.guided_state.allowed_token_ids(V))
-                      for i, s in enumerate(seqs)
+            def g_allowed(s):
+                ids = s.guided_state.allowed_token_ids(V)
+                if (s.req.stop_conditions.min_tokens or 0) > s.generated:
+                    # min_tokens: suppress EOS from the allowed set (the
+                    # unguided path gates EOS the same way) — unless EOS is
+                    # all the constraint has left, where stopping beats an
+                    # all-masked step
+                    non_eos = [t for t in ids
+                               if t not in s.guided_state.eos_ids]
+                    if non_eos:
+                        return non_eos
+                return ids
+            g_rows = [(i, g_allowed(s)) for i, s in enumerate(seqs)
                       if s.guided_state is not None]
             return b_rows, b_cols, b_vals, r_rows, r_cols, r_pens, g_rows
 

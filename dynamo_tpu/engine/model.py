@@ -273,6 +273,8 @@ def rope_params(theta: float, hd: int, scaling: Optional[dict]):
 
     Supported rope_type values (HF ROPE_INIT_FUNCTIONS semantics):
     - default/None — plain RoPE;
+    - "linear" — position interpolation: every frequency divided by factor
+      (common in long-context GGUF exports);
     - "yarn" — NTK-by-parts frequency blend + 0.1·ln(factor)+1 attention
       scaling (gpt-oss ships factor=32 over 4096 original positions);
     - "llama3" — Llama-3.1's per-band wavelength rescale (no attn scaling).
@@ -286,6 +288,8 @@ def rope_params(theta: float, hd: int, scaling: Optional[dict]):
         return inv.astype(np.float32), 1.0
     kind = scaling.get("rope_type", scaling.get("type"))
     factor = float(scaling.get("factor", 1.0))
+    if kind == "linear":
+        return (inv / factor).astype(np.float32), 1.0
     if kind == "yarn":
         orig = float(scaling.get("original_max_position_embeddings", 4096))
         beta_fast = float(scaling.get("beta_fast", 32.0))

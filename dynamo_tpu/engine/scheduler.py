@@ -272,8 +272,11 @@ class Scheduler:
         if gs is not None and (gs.done or gs.exhausted):
             # constraint completed (or hit a token-level dead end): stop
             # even without EOS ids / with ignore_eos — free-running past
-            # the constraint would emit unconstrained tokens
-            return FinishReason.STOP
+            # the constraint would emit unconstrained tokens. min_tokens
+            # delays only the DONE stop; an exhausted machine has every
+            # next token masked, so it must stop regardless
+            if gs.exhausted or (sc.min_tokens or 0) <= seq.generated:
+                return FinishReason.STOP
         if sc.max_tokens is not None and seq.generated >= sc.max_tokens:
             return FinishReason.LENGTH
         if seq.num_computed + 1 >= self.args.max_model_len:

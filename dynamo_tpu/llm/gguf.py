@@ -400,6 +400,30 @@ def config_from_gguf(g: GGUFFile):
     n_heads = int(key("attention.head_count", 32))
     vocab = md.get("tokenizer.ggml.tokens")
     vocab_size = int(key("vocab_size", len(vocab) if vocab else 32000))
+    # rope.scaling.* — long-context GGUF exports (scaled qwen2/llama) serve
+    # garbage past the original context with plain RoPE, so map the ggml
+    # keys onto HF rope_scaling semantics and fail loudly on unknown types
+    # (same posture as model.rope_params)
+    scaling = None
+    sc_type = key("rope.scaling.type")
+    if sc_type and sc_type != "none":
+        if sc_type not in ("linear", "yarn"):
+            raise NotImplementedError(
+                f"GGUF rope scaling type '{sc_type}' not supported")
+        scaling = {"rope_type": sc_type,
+                   "factor": float(key("rope.scaling.factor", 1.0))}
+        orig = key("rope.scaling.original_context_length")
+        if orig is not None:
+            scaling["original_max_position_embeddings"] = int(orig)
+        attn = key("rope.scaling.attn_factor")
+        if attn is not None and sc_type == "yarn":
+            # ggml semantics: attn_factor MULTIPLIES the yarn mscale
+            # (mscale = attn_factor·(1 + 0.1·ln(factor))); HF's
+            # attention_factor REPLACES the formula, so pre-multiply here
+            import math
+
+            scaling["attention_factor"] = float(attn) * (
+                0.1 * math.log(scaling["factor"]) + 1.0)
     return ModelConfig(
         # no output.weight tensor = tied embeddings (derived here, at the
         # config layer, so every consumer of config() agrees)
@@ -413,6 +437,7 @@ def config_from_gguf(g: GGUFFile):
         rope_theta=float(key("rope.freq_base", 10000.0)),
         rms_norm_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
         max_position_embeddings=int(key("context_length", 8192)),
+        rope_scaling=scaling,
         qkv_bias=arch == "qwen2",
     )
 

@@ -55,9 +55,22 @@ _DIGITS = frozenset("0123456789")
 _WORD = frozenset(
     "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_")
 _SPACE = frozenset(" \t\n\r\f\v")
-#: complement classes are expressed against this universe (printable ASCII
-#: + whitespace) — guided outputs are JSON/regex text, not arbitrary bytes
-_UNIVERSE = frozenset(chr(c) for c in range(32, 127)) | _SPACE
+
+
+class _Neg:
+    """Complement charclass edge key: matches any char NOT in ``excl``.
+
+    Kept as an exclusion set (not materialized against an ASCII universe)
+    so the full Unicode space survives — guided_json string values are
+    built from ``[^"\\\\]`` and must be able to emit non-ASCII text."""
+
+    __slots__ = ("excl",)
+
+    def __init__(self, excl):
+        self.excl = frozenset(excl)
+
+    def __contains__(self, ch) -> bool:
+        return ch not in self.excl
 
 
 #: hard ceiling on NFA size: a 17-byte pattern like "(a{9999}){9999}"
@@ -230,8 +243,7 @@ class _RegexParser:
 
     def _escape(self, c):
         table = {"d": _DIGITS, "w": _WORD, "s": _SPACE,
-                 "D": _UNIVERSE - _DIGITS, "W": _UNIVERSE - _WORD,
-                 "S": _UNIVERSE - _SPACE,
+                 "D": _Neg(_DIGITS), "W": _Neg(_WORD), "S": _Neg(_SPACE),
                  "n": frozenset("\n"), "t": frozenset("\t"),
                  "r": frozenset("\r")}
         if c in table:
@@ -243,6 +255,7 @@ class _RegexParser:
         if neg:
             self._eat()
         chars = set()
+        comp = None  # ∩ of exclusion sets from complement escapes (\D\W\S)
         first = True
         while True:
             c = self._peek()
@@ -254,7 +267,11 @@ class _RegexParser:
             first = False
             c = self._eat()
             if c == "\\":
-                chars |= self._escape(self._eat())
+                e = self._escape(self._eat())
+                if isinstance(e, _Neg):
+                    comp = e.excl if comp is None else comp & e.excl
+                else:
+                    chars |= e
                 continue
             if self._peek() == "-" and self.i + 1 < len(self.p) \
                     and self.p[self.i + 1] != "]":
@@ -265,7 +282,12 @@ class _RegexParser:
                 chars |= {chr(x) for x in range(ord(c), ord(hi) + 1)}
             else:
                 chars.add(c)
-        key = (_UNIVERSE - chars) if neg else frozenset(chars)
+        # the class is a union of members: positive chars P plus complement
+        # members ¬E1,¬E2… → P ∪ ¬(E1∩E2∩…) = ¬((E1∩…) − P)
+        if comp is not None:
+            key = frozenset(comp - chars) if neg else _Neg(comp - chars)
+        else:
+            key = _Neg(chars) if neg else frozenset(chars)
         return self._edge_frag(key)
 
 

@@ -191,7 +191,9 @@ def _parse_common(body: dict, req: ParsedRequest) -> ParsedRequest:
     # logprobs=N. Stored as the requested alternatives count (None = off;
     # 0 = selected-token logprobs only).
     if isinstance(logprobs, bool):
-        lp_count = (top_logprobs if top_logprobs is not None else 1) \
+        # OpenAI: logprobs=true alone returns ONLY the selected token's
+        # logprob (no alternatives list); top_logprobs adds N alternatives
+        lp_count = (top_logprobs if top_logprobs is not None else 0) \
             if logprobs else None
     else:
         lp_count = logprobs if isinstance(logprobs, int) else None

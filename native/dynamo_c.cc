@@ -66,7 +66,9 @@ struct Packer {
     void str(const char* s) {
         size_t n = strlen(s);
         if (n < 32) byte(0xa0 | n);
-        else { byte(0xd9); byte(n); }  // str8 (keys here are short)
+        else if (n <= 0xff) { byte(0xd9); byte(n); }        // str8
+        else if (n <= 0xffff) { byte(0xda); be16(n); }      // str16
+        else { byte(0xdb); be32(static_cast<uint32_t>(n)); }  // str32
         buf.insert(buf.end(), s, s + n);
     }
     void bin(const uint8_t* d, size_t n) {

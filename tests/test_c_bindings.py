@@ -110,3 +110,35 @@ async def test_c_publish_feeds_router(clib):
     finally:
         await asyncio.to_thread(clib.dynamo_llm_shutdown)
         await server.stop()
+
+
+async def test_c_long_component_names(clib):
+    """Component/namespace strings >255 bytes must produce valid msgpack
+    str16 frames (round-2 advisor: the str8 length byte silently wrapped)."""
+    server = ControlPlaneServer(port=0)
+    addr = await server.start()
+    long_ns = ("n" * 300).encode()
+    tokens = list(range(1, 5))
+    try:
+        rc = await asyncio.to_thread(
+            lambda: clib.dynamo_llm_init(addr.encode(), long_ns, b"backend",
+                                         0xF00D, 4))
+        assert rc == 0
+        tok = (ctypes.c_uint32 * 4)(*tokens)
+        nbt = (ctypes.c_size_t * 1)(4)
+        ids = (ctypes.c_uint64 * 1)(42)
+        rc = await asyncio.to_thread(
+            lambda: clib.dynamo_kv_event_publish_stored(1, tok, nbt, ids, 1,
+                                                        None, 0))
+        assert rc == 0
+        sub = await server.core.stream_subscribe(KV_EVENTS_STREAM, 0)
+        _, payload = await asyncio.wait_for(sub.__aiter__().__anext__(), 5)
+        import msgpack
+
+        ev = RouterEvent.from_wire(msgpack.unpackb(payload, raw=False))
+        assert ev.worker_id == 0xF00D
+        assert [b.block_hash for b in ev.event.stored_blocks] == [42]
+        await sub.cancel()
+    finally:
+        await asyncio.to_thread(clib.dynamo_llm_shutdown)
+        await server.stop()

@@ -541,3 +541,37 @@ def test_q2k_q3k_match_scalar_reference():
     for i in range(3):
         np.testing.assert_allclose(got[i], scalar_q3k(raw3[i].tobytes()),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_rope_scaling_metadata():
+    """rope.scaling.* must reach ModelConfig.rope_scaling (round-2 advisor:
+    long-context scaled exports served plain RoPE silently)."""
+    from types import SimpleNamespace
+
+    def fake(extra):
+        md = {"general.architecture": "qwen2",
+              "qwen2.embedding_length": 16, "qwen2.block_count": 1,
+              "qwen2.attention.head_count": 2, **extra}
+        return SimpleNamespace(architecture="qwen2", metadata=md, tensors={})
+
+    cfg = config_from_gguf(fake({
+        "qwen2.rope.scaling.type": "yarn",
+        "qwen2.rope.scaling.factor": 4.0,
+        "qwen2.rope.scaling.original_context_length": 32768,
+        "qwen2.rope.scaling.attn_factor": 1.2}))
+    import math
+
+    assert cfg.rope_scaling == {
+        "rope_type": "yarn", "factor": 4.0,
+        "original_max_position_embeddings": 32768,
+        # ggml attn_factor multiplies the yarn mscale formula; HF
+        # attention_factor replaces it — the loader pre-multiplies
+        "attention_factor": 1.2 * (0.1 * math.log(4.0) + 1.0)}
+    cfg = config_from_gguf(fake({"qwen2.rope.scaling.type": "linear",
+                                 "qwen2.rope.scaling.factor": 2.0}))
+    assert cfg.rope_scaling == {"rope_type": "linear", "factor": 2.0}
+    assert config_from_gguf(fake({})).rope_scaling is None
+    assert config_from_gguf(
+        fake({"qwen2.rope.scaling.type": "none"})).rope_scaling is None
+    with pytest.raises(NotImplementedError):
+        config_from_gguf(fake({"qwen2.rope.scaling.type": "su"}))

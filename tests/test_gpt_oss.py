@@ -369,3 +369,24 @@ def test_mxfp4_checkpoint_loads(hf_checkpoint, tmp_path):
     # biases and router are untouched by quantization
     np.testing.assert_allclose(np.asarray(params["layers"]["b_gate"]),
                                np.asarray(ref["layers"]["b_gate"]))
+
+
+def test_linear_rope_matches_hf():
+    """'linear' rope scaling (common in long-context GGUF exports) must
+    match HF's linear ROPE_INIT function."""
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from dynamo_tpu.engine.model import rope_params
+
+    class C:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    linear = {"rope_type": "linear", "factor": 4.0}
+    hf_cfg = C(rope_theta=10000.0, head_dim=64, hidden_size=64 * 4,
+               num_attention_heads=4, max_position_embeddings=8192,
+               rope_scaling=dict(linear), partial_rotary_factor=1.0)
+    hf_inv, hf_scale = ROPE_INIT_FUNCTIONS["linear"](hf_cfg, "cpu")
+    inv, scale = rope_params(10000.0, 64, linear)
+    np.testing.assert_allclose(inv, hf_inv.numpy(), rtol=1e-6)
+    assert abs(scale - hf_scale) < 1e-6

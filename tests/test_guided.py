@@ -389,3 +389,52 @@ def test_sp_byte_fallback_tokens(tmp_path):
     assert gv[1] == "A"      # <0x41> really contributes "A"
     assert gv[2] == ""       # partial UTF-8 byte: never eligible
     assert gv[3] == "plain"
+
+
+def test_negated_class_admits_non_ascii():
+    """Complement classes are exclusion sets over the FULL char space
+    (round-2 advisor: a printable-ASCII universe silently constrained all
+    guided_json string output to ASCII)."""
+    d = CharDfa(r'"[^"\\]*"')
+    assert d.fullmatch('"héllo wörld"')
+    assert d.fullmatch('"日本語"')
+    assert not d.fullmatch('"a"b"')
+    for pat, ok, bad in [(r"\D+", "héé", "h3"), (r"\W+", "¡™", "¡a"),
+                         (r"\S+", "né", "n é")]:
+        assert CharDfa(pat).fullmatch(ok) and re.fullmatch(pat, ok)
+        assert not CharDfa(pat).fullmatch(bad)
+    # complement escapes INSIDE classes: [^\D] ≡ \d, [5\D] ≡ ¬(digits−{5})
+    assert CharDfa(r"[^\D]+").fullmatch("123")
+    assert not CharDfa(r"[^\D]+").fullmatch("1a3")
+    assert CharDfa(r"[5\D]+").fullmatch("a5é")
+    assert not CharDfa(r"[5\D]+").fullmatch("46")
+    # token machine: a multibyte token survives the walk into a JSON string
+    vocab = ["é", "a", '"']
+    tm = TokenMachine(CharDfa(r'"[^"\\]*"'), vocab)
+    st = tm.allowed(tm.start)[2]  # consume the opening quote
+    assert 0 in tm.allowed(st)    # é permitted inside the string
+
+
+async def test_guided_min_tokens_defers_eos():
+    """min_tokens must suppress EOS from the guided allowed set and defer
+    the guided STOP (round-2 advisor: a constraint completing before
+    min_tokens ended the sequence early)."""
+    cfg = ModelConfig.tiny()
+    eng = AsyncJaxEngine(cfg, EngineArgs(
+        block_size=16, num_blocks=64, max_num_seqs=4,
+        max_num_batched_tokens=128, max_model_len=128),
+        guided_vocab=_vocab(cfg.vocab_size))
+    try:
+        req = PreprocessedRequest(
+            model="tiny", token_ids=[1, 2, 3],
+            sampling_options=SamplingOptions(
+                temperature=0.0, guided={"choice": ["hi", "hiyo"]}),
+            stop_conditions=StopConditions(max_tokens=16, min_tokens=4),
+            eos_token_ids=[5])
+        toks, reason = await _collect(eng, req)
+        # "hi" satisfies the constraint at 2 tokens but min_tokens=4 keeps
+        # EOS masked until the longer branch is spelled out
+        assert _text(eng, toks).startswith("hiyo")
+        assert reason in ("stop", "eos")
+    finally:
+        await eng.close()

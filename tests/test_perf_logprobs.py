@@ -147,3 +147,17 @@ def test_cli_on_jsonl(tmp_path, capsys):
     assert main([str(p), "--json"]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["choices"][0]["positions"] == 1
+
+
+def test_chat_logprobs_true_without_top_logprobs():
+    """OpenAI semantics: logprobs=true alone returns the selected token's
+    logprob with NO alternatives; top_logprobs=N adds N alternatives
+    (round-2 advisor: true alone mapped to one alternative)."""
+    from dynamo_tpu.protocols.openai import parse_chat_request
+
+    body = {"model": "m", "messages": [{"role": "user", "content": "x"}]}
+    assert parse_chat_request({**body, "logprobs": True}).output.logprobs == 0
+    assert parse_chat_request(
+        {**body, "logprobs": True, "top_logprobs": 3}).output.logprobs == 3
+    assert parse_chat_request({**body, "logprobs": False}).output.logprobs is None
+    assert parse_chat_request(body).output.logprobs is None
