@@ -305,6 +305,13 @@ class EngineArgs:
     kvbm_host_bytes: int = 0
     kvbm_disk_dir: Optional[str] = None
     kvbm_disk_bytes: int = 0
+    #: on-device weight quantization: None (model dtype) | "int8" (per-out-
+    #: channel) | "int8-gN" / "int4-gN" (grouped, N along the contraction
+    #: dim). Weights stay quantized in HBM; dequant rides the matmul
+    #: (engine/quant.py). GGUF/MXFP4 checkpoints can also load pre-quantized
+    #: (loader keeps native groups). Ref capability: FP8 70B recipe,
+    #: recipes/llama-3-70b/vllm/disagg-single-node/deploy.yaml:21-86
+    quantization: Optional[str] = None
     seed: int = 0
 
     def __post_init__(self):

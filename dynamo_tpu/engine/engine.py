@@ -88,8 +88,24 @@ class AsyncJaxEngine:
 
         if params is None:
             params = M.init_params(cfg, jax.random.key(args.seed))
+        if args.quantization is not None:
+            from dynamo_tpu.engine.quant import quantize_params
+            # host-side quantization (numpy): the bf16 original never has
+            # to coexist with the quantized copy in HBM. Idempotent —
+            # leaves already quantized at load (MXFP4/GGUF) pass through
+            params = quantize_params(
+                jax.tree.map(np.asarray, params), args.quantization)
+            if mesh is None:
+                # the host-side walk left every leaf as numpy; put the tree
+                # back on device or each jitted step re-uploads it
+                params = jax.device_put(params)
         if mesh is not None:
+            from dynamo_tpu.engine.quant import quant_shardings
             sh = M.param_shardings(cfg, mesh)
+            # no-op on unquantized trees; mirrors weight shardings onto
+            # QTensor subtrees (q like the weight, scales' group dim
+            # replicated) for load-time-quantized checkpoints too
+            sh = quant_shardings(sh, params)
             if self._multihost:
                 from dynamo_tpu.parallel.multihost import global_put
                 params = jax.tree.map(global_put, params, sh)

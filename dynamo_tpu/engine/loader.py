@@ -107,6 +107,32 @@ def _mxfp4_dequant(blocks: np.ndarray, scales: np.ndarray,
     return out.swapaxes(-2, -1)
 
 
+#: fp4 e2m1 values ×2 are exact small integers — the basis of the lossless
+#: MXFP4 → grouped-int8 re-encode below
+_FP4_LUT2 = (_FP4_LUT * 2).astype(np.int8)
+
+
+def _mxfp4_to_qtensor(blocks: np.ndarray, scales: np.ndarray) -> dict:
+    """LOSSLESS MXFP4 → grouped-int8 QTensor (engine/quant.py layout).
+
+    fp4 e2m1 magnitudes are {0,.5,1,1.5,2,3,4,6}: doubled they are exact
+    int8 values, and the e8m0 block scale halves to stay a power of two —
+    so ``q·s`` reproduces every MXFP4 weight bit-exactly in bf16, at
+    1 B/weight HBM residency instead of 2 (the reference serves gpt-oss
+    MXFP4 natively: recipes/gpt-oss-120b/trtllm/agg/deploy.yaml). Returns
+    {"q": [..., I, O] int8, "s": [..., G, O] f32} matching
+    ``_mxfp4_dequant(...)`` = dequantize(result) exactly."""
+    *prefix, G, B = blocks.shape
+    n_lead = prefix[0] if prefix else 1
+    blk = blocks.reshape(n_lead, -1, B)
+    q = np.empty((n_lead, blk.shape[1], B * 2), np.int8)
+    q[..., 0::2] = _FP4_LUT2[blk & 0x0F]
+    q[..., 1::2] = _FP4_LUT2[blk >> 4]
+    q = q.reshape(*prefix, G * B * 2).swapaxes(-2, -1)  # [..., I, O]
+    s = np.ldexp(0.5, scales.astype(np.int32) - 127).astype(np.float32)
+    return {"q": q, "s": s.swapaxes(-2, -1)}  # s: [..., G, O]
+
+
 def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
     """Map HF llama/mistral/qwen2/mixtral/deepseek weight names onto the
     model.py pytree."""
@@ -127,7 +153,7 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
         return get(name).T
 
     L = cfg.num_layers
-    stack = lambda names: jnp.stack(names)  # noqa: E731
+    from dynamo_tpu.engine.quant import stack_layers as stack
 
     def attn_layer(i: int) -> dict:
         pre = f"model.layers.{i}.self_attn"
@@ -197,12 +223,21 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
         dequantized MXFP4) + down [E, F, D] — ONE builder so the quantized
         and unquantized load paths cannot diverge."""
         gub = np.asarray(t[f"{pre}.experts.gate_up_proj_bias"])  # [E, 2F]
+        if isinstance(gu, dict):  # MXFP4 kept quantized: slice q AND s on
+            # the interleaved output dim (scales are per (group, out-col))
+            w_gate = {"q": jnp.asarray(gu["q"][..., ::2]),
+                      "s": jnp.asarray(gu["s"][..., ::2])}
+            w_up = {"q": jnp.asarray(gu["q"][..., 1::2]),
+                    "s": jnp.asarray(gu["s"][..., 1::2])}
+        else:
+            w_gate = jnp.asarray(gu[..., ::2], dtype=dtype)
+            w_up = jnp.asarray(gu[..., 1::2], dtype=dtype)
         return {
             "router": proj(f"{pre}.router.weight"),
             "router_bias": jnp.asarray(
                 np.asarray(t[f"{pre}.router.bias"]), jnp.float32),
-            "w_gate": jnp.asarray(gu[..., ::2], dtype=dtype),
-            "w_up": jnp.asarray(gu[..., 1::2], dtype=dtype),
+            "w_gate": w_gate,
+            "w_up": w_up,
             "b_gate": jnp.asarray(gub[..., ::2], dtype=dtype),
             "b_up": jnp.asarray(gub[..., 1::2], dtype=dtype),
             "w_down": w_down,  # [E, F, D]
@@ -227,20 +262,31 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
             return out
         if f"model.layers.{i}.mlp.experts.gate_up_proj_blocks" in t:
             # MXFP4-quantized experts (the format real gpt-oss checkpoints
-            # ship): e2m1 nibble pairs + e8m0 per-32 block scales,
-            # dequantized at load (layout per the HF mxfp4 integration:
-            # lo/hi nibbles interleave along the last dim, stored
-            # [E, cols, groups, 16] -> param [E, rows, cols])
+            # ship): e2m1 nibble pairs + e8m0 per-32 block scales (layout
+            # per the HF mxfp4 integration: lo/hi nibbles interleave along
+            # the last dim, stored [E, cols, groups, 16] → param
+            # [E, rows, cols]). Kept QUANTIZED in HBM by default — the
+            # int8 re-encode is bit-exact, at half the bf16 footprint;
+            # DYN_MXFP4_DEQUANT=1 restores load-time bf16 for debugging
             pre = f"model.layers.{i}.mlp"
-            gu = _mxfp4_dequant(
+            if os.environ.get("DYN_MXFP4_DEQUANT"):
+                gu = _mxfp4_dequant(
+                    np.asarray(t[f"{pre}.experts.gate_up_proj_blocks"]),
+                    np.asarray(t[f"{pre}.experts.gate_up_proj_scales"]),
+                    out_dtype=dtype)
+                down = _mxfp4_dequant(
+                    np.asarray(t[f"{pre}.experts.down_proj_blocks"]),
+                    np.asarray(t[f"{pre}.experts.down_proj_scales"]),
+                    out_dtype=dtype)
+                return oss_experts(pre, gu, jnp.asarray(down, dtype=dtype))
+            gu = _mxfp4_to_qtensor(
                 np.asarray(t[f"{pre}.experts.gate_up_proj_blocks"]),
-                np.asarray(t[f"{pre}.experts.gate_up_proj_scales"]),
-                out_dtype=dtype)
-            down = _mxfp4_dequant(
+                np.asarray(t[f"{pre}.experts.gate_up_proj_scales"]))
+            down = _mxfp4_to_qtensor(
                 np.asarray(t[f"{pre}.experts.down_proj_blocks"]),
-                np.asarray(t[f"{pre}.experts.down_proj_scales"]),
-                out_dtype=dtype)
-            return oss_experts(pre, gu, jnp.asarray(down, dtype=dtype))
+                np.asarray(t[f"{pre}.experts.down_proj_scales"]))
+            return oss_experts(pre, gu,
+                               {k: jnp.asarray(v) for k, v in down.items()})
         if f"model.layers.{i}.mlp.experts.gate_up_proj" in t:  # gpt-oss
             pre = f"model.layers.{i}.mlp"
             # fused [E, D, 2F] with gate/up interleaved on the last dim;

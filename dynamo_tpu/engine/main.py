@@ -94,6 +94,12 @@ async def amain():
     ap.add_argument("--num-ranks", type=int, default=1,
                     help="total DP fleet size (with --dp-rank)")
     ap.add_argument("--use-pallas-attention", action="store_true")
+    ap.add_argument("--quantization", default=None,
+                    help="on-device weight quantization: int8 | int8-gN | "
+                         "int4-gN; weights stay quantized in HBM with "
+                         "dequant fused into the matmuls (GGUF Q8_0 and "
+                         "gpt-oss MXFP4 checkpoints load pre-quantized "
+                         "regardless)")
     ap.add_argument("--speculative-tokens", type=int, default=0,
                     help="prompt-lookup speculative decoding: draft up to N "
                          "tokens per step (greedy-invariant)")
@@ -203,6 +209,7 @@ async def amain():
         kvbm_host_bytes=int(cli.kvbm_host_gb * (1 << 30)),
         kvbm_disk_dir=cli.kvbm_disk_dir,
         kvbm_disk_bytes=int(cli.kvbm_disk_gb * (1 << 30)),
+        quantization=cli.quantization,
     )
 
     if cli.dp_rank is not None and not 0 <= cli.dp_rank < cli.num_ranks:

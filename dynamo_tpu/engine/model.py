@@ -33,6 +33,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.quant import is_qtensor as _is_q
+from dynamo_tpu.engine.quant import materialize as _qmat
+from dynamo_tpu.engine.quant import qmm as _mm
 
 # ---------------------------------------------------------------------------
 # Parameter init / pytree layout
@@ -437,15 +440,16 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
     dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
 
     if "q_b" in lp:
-        q = _rms_norm(h @ lp["q_a"], lp["q_a_norm"], cfg.rms_norm_eps) @ lp["q_b"]
+        q = _mm(_rms_norm(_mm(h, lp["q_a"]), lp["q_a_norm"],
+                          cfg.rms_norm_eps), lp["q_b"])
     else:
-        q = h @ lp["wq"]
+        q = _mm(h, lp["wq"])
     q = q.reshape(B, S, H, dn + dr)
     q_nope, q_rot = q[..., :dn], q[..., dn:]
     q_rot = _rope(q_rot, positions, cfg.rope_theta, cfg.rope_scaling)
 
     pr = cfg.rope_cache_dim  # rope part zero-padded to a lane multiple
-    ckv = h @ lp["kv_a"]  # [B,S,r+dr]
+    ckv = _mm(h, lp["kv_a"])  # [B,S,r+dr]
     c = _rms_norm(ckv[..., :r], lp["kv_a_norm"], cfg.rms_norm_eps)
     k_rot = _rope(ckv[..., None, r:], positions, cfg.rope_theta,
                   cfg.rope_scaling)  # [B,S,1,dr]
@@ -510,8 +514,8 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
 
 
 def _mlp_dense(x, lp):
-    h = jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])
-    return h @ lp["w_down"]
+    h = jax.nn.silu(_mm(x, lp["w_gate"])) * _mm(x, lp["w_up"])
+    return _mm(h, lp["w_down"])
 
 
 def _router_weights(xf, router_w, router_bias, cfg: ModelConfig):
@@ -663,16 +667,18 @@ def _mlp_moe(x, lp, cfg: ModelConfig):
     B, S, D = x.shape
     cw = _router_weights(x.reshape(B * S, D), lp["router"],
                          lp["router_bias"], cfg).reshape(B, S, -1)
-    # all-experts compute: [E,B,S,F] — fine for modest E; EP shards E over tp
-    h = jnp.einsum("bsd,edf->ebsf", x, lp["w_gate"])
-    u = jnp.einsum("bsd,edf->ebsf", x, lp["w_up"])
+    # all-experts compute: [E,B,S,F] — fine for modest E; EP shards E over
+    # tp. Quantized expert stacks ride the fusable dequant chain (the
+    # einsum reads int8 tiles from HBM, dequantizing in VMEM)
+    h = jnp.einsum("bsd,edf->ebsf", x, _qmat(lp["w_gate"], x.dtype))
+    u = jnp.einsum("bsd,edf->ebsf", x, _qmat(lp["w_up"], x.dtype))
     if cfg.moe_activation == "swiglu_oss":
         h = h + lp["b_gate"][:, None, None, :]
         u = u + lp["b_up"][:, None, None, :]
         inter = _oss_glu(h, u)
     else:
         inter = jax.nn.silu(h) * u
-    y = jnp.einsum("ebsf,efd->ebsd", inter, lp["w_down"])
+    y = jnp.einsum("ebsf,efd->ebsd", inter, _qmat(lp["w_down"], x.dtype))
     if cfg.moe_activation == "swiglu_oss":
         y = y + lp["b_down"][:, None, None, :]
     return jnp.einsum("ebsd,bse->bsd", y, cw.astype(y.dtype))
@@ -773,11 +779,11 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                 h, lp, lidx, kc, vc, slot_map, block_tables, positions,
                 kv_lens, cfg, block_size,
                 use_pallas=use_pallas and dp_ok, mesh=mesh)
-            x = x + attn_flat @ lp["wo"]
+            x = x + _mm(attn_flat, lp["wo"])
             return _mlp_epilogue(x, kc, vc, lp, moe)
-        q = h @ lp["wq"]
-        k = h @ lp["wk"]
-        v = h @ lp["wv"]
+        q = _mm(h, lp["wq"])
+        k = _mm(h, lp["wk"])
+        v = _mm(h, lp["wv"])
         if "bq" in lp:
             q = q + lp["bq"]
             k = k + lp["bk"]
@@ -888,7 +894,7 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             attn = _paged_attention(q, kc, vc, lidx, block_tables, positions,
                                     kv_lens, cfg, block_size, window=window,
                                     sinks=lp.get("sink"))
-        x = x + attn.reshape(B, S, H * hd) @ lp["wo"]
+        x = x + _mm(attn.reshape(B, S, H * hd), lp["wo"])
         if "bo" in lp:
             x = x + lp["bo"]
         return _mlp_epilogue(x, kc, vc, lp, moe)
@@ -907,8 +913,13 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                     B, cfg.num_experts, tp_n)
             if ep_ok:
                 fn = make_moe_ep_fn(cfg, mesh)
-                ep_args = [h, lp["router"], lp["router_bias"], lp["w_gate"],
-                           lp["w_up"], lp["w_down"]]
+                # quantized experts: materialize per-shard before the
+                # shard_map boundary (specs are per-array); the EP rewrite
+                # will dequantize inside the shard when this shows up hot
+                ep_args = [h, lp["router"], lp["router_bias"],
+                           _qmat(lp["w_gate"], h.dtype),
+                           _qmat(lp["w_up"], h.dtype),
+                           _qmat(lp["w_down"], h.dtype)]
                 if cfg.moe_activation == "swiglu_oss":
                     ep_args += [lp["b_gate"], lp["b_up"], lp["b_down"]]
                 x = x + fn(*ep_args)
@@ -937,9 +948,9 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
     head = (params["embed"].T if cfg.tie_word_embeddings
             else params["lm_head"])
     if all_logits:  # speculative verification reads every position
-        return (x @ head).astype(jnp.float32), k_cache, v_cache
+        return _mm(x, head).astype(jnp.float32), k_cache, v_cache
     x_last = x[jnp.arange(B), last_idx]  # [B, D]
-    logits = x_last @ head
+    logits = _mm(x_last, head)
     return logits.astype(jnp.float32), k_cache, v_cache
 
 
@@ -1009,9 +1020,9 @@ def embedding_forward(params, tokens, lengths, *, cfg: ModelConfig):
 
     def layer(x, lp):
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = h @ lp["wq"]
-        k = h @ lp["wk"]
-        v = h @ lp["wv"]
+        q = _mm(h, lp["wq"])
+        k = _mm(h, lp["wk"])
+        v = _mm(h, lp["wv"])
         if "bq" in lp:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(B, S, H, hd)
@@ -1028,7 +1039,7 @@ def embedding_forward(params, tokens, lengths, *, cfg: ModelConfig):
         s = jnp.where(mask[:, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bkgst,btkd->bskgd", p, v.astype(jnp.float32))
-        x = x + attn.reshape(B, S, H * hd).astype(x.dtype) @ lp["wo"]
+        x = x + _mm(attn.reshape(B, S, H * hd).astype(x.dtype), lp["wo"])
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + (_mlp_moe(h, lp, cfg) if cfg.is_moe else _mlp_dense(h, lp))
         return x, None

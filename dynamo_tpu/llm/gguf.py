@@ -24,6 +24,7 @@ element type + u64 count.
 
 from __future__ import annotations
 
+import os
 import struct
 from dataclasses import dataclass, field
 from typing import Any, BinaryIO, Optional
@@ -370,6 +371,29 @@ class GGUFFile:
         buf = self._read(f, info.offset, count * dtype.itemsize)
         return np.frombuffer(buf, dtype=dtype).reshape(info.shape)
 
+    def load_tensor_q8_native(self, name: str,
+                              f: Optional[BinaryIO] = None) -> Optional[dict]:
+        """Q8_0 tensor as a grouped-int8 QTensor (engine/quant.py layout) —
+        the weights NEVER widen past 1 B each: ggml's per-32 blocks map
+        exactly onto {"q": int8 [in, out], "s": f32 [in/32, out]} (the
+        stored layout is [out, in] row-major with blocks along the row, so
+        one transpose lands groups on the contraction dim). Returns None
+        for any other ggml type — callers fall back to ``load_tensor``."""
+        info = self.tensors[name]
+        if info.ggml_type != GGML_Q8_0 or len(info.shape) != 2:
+            return None
+        R, C = info.shape  # [out, in]
+        if C % 32:
+            raise ValueError(f"tensor {name}: row length {C} not a multiple "
+                             "of the 32-value quant block")
+        raw = np.frombuffer(
+            self._read(f, info.offset, R * C // 32 * 34),
+            np.uint8).reshape(R * C // 32, 34)
+        s = raw[:, :2].copy().view(np.float16).astype(np.float32)
+        q = raw[:, 2:].view(np.int8)
+        return {"q": np.ascontiguousarray(q.reshape(R, C).T),
+                "s": np.ascontiguousarray(s.reshape(R, C // 32).T)}
+
     def _read(self, f: Optional[BinaryIO], offset: int, n: int) -> bytes:
         if f is None:
             with open(self.path, "rb") as fh:
@@ -502,18 +526,31 @@ def load_gguf_params(g: GGUFFile, cfg, dtype=None) -> dict:
         def proj(name):  # stored [out, in] like HF → transpose to [in, out]
             return get(name).T
 
+        def proj_w(name):
+            """Matmul weight: Q8_0 tensors stay QUANTIZED in HBM (grouped-
+            int8 QTensor, bit-identical numerics via the f32 dequant chain
+            in engine/quant.materialize); everything else dequantizes as
+            before. DYN_GGUF_DEQUANT=1 forces the legacy bf16 load."""
+            if not os.environ.get("DYN_GGUF_DEQUANT"):
+                qt = g.load_tensor_q8_native(name, fh)
+                if qt is not None:
+                    return {"q": jnp.asarray(qt["q"]),
+                            "s": jnp.asarray(qt["s"])}
+            return proj(name)
+
         L = cfg.num_layers
-        stack = lambda xs: jnp.stack(xs)  # noqa: E731
+        from dynamo_tpu.engine.quant import stack_layers as stack
+
         layers = {
             "attn_norm": stack([get(f"blk.{i}.attn_norm.weight") for i in range(L)]),
             "mlp_norm": stack([get(f"blk.{i}.ffn_norm.weight") for i in range(L)]),
-            "wq": stack([proj(f"blk.{i}.attn_q.weight") for i in range(L)]),
-            "wk": stack([proj(f"blk.{i}.attn_k.weight") for i in range(L)]),
-            "wv": stack([proj(f"blk.{i}.attn_v.weight") for i in range(L)]),
-            "wo": stack([proj(f"blk.{i}.attn_output.weight") for i in range(L)]),
-            "w_gate": stack([proj(f"blk.{i}.ffn_gate.weight") for i in range(L)]),
-            "w_up": stack([proj(f"blk.{i}.ffn_up.weight") for i in range(L)]),
-            "w_down": stack([proj(f"blk.{i}.ffn_down.weight") for i in range(L)]),
+            "wq": stack([proj_w(f"blk.{i}.attn_q.weight") for i in range(L)]),
+            "wk": stack([proj_w(f"blk.{i}.attn_k.weight") for i in range(L)]),
+            "wv": stack([proj_w(f"blk.{i}.attn_v.weight") for i in range(L)]),
+            "wo": stack([proj_w(f"blk.{i}.attn_output.weight") for i in range(L)]),
+            "w_gate": stack([proj_w(f"blk.{i}.ffn_gate.weight") for i in range(L)]),
+            "w_up": stack([proj_w(f"blk.{i}.ffn_up.weight") for i in range(L)]),
+            "w_down": stack([proj_w(f"blk.{i}.ffn_down.weight") for i in range(L)]),
         }
         if cfg.qkv_bias:
             layers["bq"] = stack([get(f"blk.{i}.attn_q.bias") for i in range(L)])
@@ -525,5 +562,5 @@ def load_gguf_params(g: GGUFFile, cfg, dtype=None) -> dict:
             "final_norm": get("output_norm.weight"),
         }
         if "output.weight" in g.tensors:
-            params["lm_head"] = proj("output.weight")
+            params["lm_head"] = proj_w("output.weight")
     return params

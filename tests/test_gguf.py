@@ -373,10 +373,28 @@ def test_quantized_gguf_serves(tmp_path):
     cfg = config_from_gguf(g)
     cfg.dtype = "float32"
     params = load_gguf_params(g, cfg, dtype=jnp.float32)
-    w = np.asarray(params["layers"]["w_down"][0])
+    from dynamo_tpu.engine import quant as Q
+
+    node = params["layers"]["w_down"]
+    # Q8_0 weights stay QUANTIZED in HBM: grouped-int8 QTensor with the
+    # ggml per-32 scales, never widened past 1 B/weight
+    assert Q.is_qtensor(node)
+    assert node["q"].dtype == jnp.int8
+    assert node["s"].shape[-2] * 32 == node["q"].shape[-2]
+    w = np.asarray(Q.dequantize(node, jnp.float32)[0])
     ref = tensors["blk.0.ffn_down.weight"].T  # [F=32, D] rows are aligned
     np.testing.assert_allclose(w, ref, atol=0.02)
     assert np.abs(w - ref).max() > 0  # the quantized path really ran
+    # bit-identical to the legacy dequantize-at-load path
+    import os
+
+    os.environ["DYN_GGUF_DEQUANT"] = "1"
+    try:
+        legacy = load_gguf_params(GGUFFile.parse(qpath), cfg,
+                                  dtype=jnp.float32)
+    finally:
+        del os.environ["DYN_GGUF_DEQUANT"]
+    np.testing.assert_array_equal(w, np.asarray(legacy["layers"]["w_down"][0]))
 
 
 def g0_meta_end(path):

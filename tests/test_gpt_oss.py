@@ -356,10 +356,25 @@ def test_mxfp4_checkpoint_loads(hf_checkpoint, tmp_path):
     cfg.dtype = "float32"
     params = load_hf_params(cfg, str(qdir), dtype=jnp.float32)
     ref = load_hf_params(cfg, path, dtype=jnp.float32)
+    import os
+
+    from dynamo_tpu.engine import quant as Q
+
+    os.environ["DYN_MXFP4_DEQUANT"] = "1"
+    try:  # legacy bf16-at-load path, for the bit-exactness cross-check
+        deq = load_hf_params(cfg, str(qdir), dtype=jnp.float32)
+    finally:
+        del os.environ["DYN_MXFP4_DEQUANT"]
     for key in ("w_gate", "w_up", "w_down"):
-        got = np.asarray(params["layers"][key])
+        node = params["layers"][key]
+        # experts stay QUANTIZED in HBM (grouped-int8 QTensor re-encode)
+        assert Q.is_qtensor(node), key
+        assert node["q"].dtype == jnp.int8
+        got = np.asarray(Q.dequantize(node, jnp.float32))
         want = np.asarray(ref["layers"][key])
         assert got.shape == want.shape
+        # the int8 re-encode is LOSSLESS vs the dequantize-at-load path
+        np.testing.assert_array_equal(got, np.asarray(deq["layers"][key]))
         # fp4 worst-case grid gap is 2 (between entries 4 and 6) at a
         # block scale of max/6 — up to ~20% of the block max
         err = np.abs(got - want).max()
