@@ -1358,6 +1358,8 @@ class AsyncJaxEngine:
             self.event_cb(KvCacheEvent.removed(next(self._event_id), list(seq_hashes)))
 
     def _metrics(self) -> ForwardPassMetrics:
+        from dynamo_tpu.engine.model import MOE_DROPS
+
         sched = self.scheduler
         active = self.pool.num_active_blocks
         return ForwardPassMetrics(
@@ -1366,6 +1368,7 @@ class AsyncJaxEngine:
                 request_total_slots=self.args.max_num_seqs,
                 num_requests_waiting=sched.num_waiting(),
                 data_parallel_rank=self.dp_rank,
+                moe_dropped_tokens=MOE_DROPS["total"],
             ),
             kv_stats=KvStats(
                 kv_active_blocks=active,

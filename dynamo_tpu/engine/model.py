@@ -25,6 +25,7 @@ attention einsums batched per KV-head group. Softmax runs in f32.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional
 
 import jax
@@ -589,70 +590,115 @@ def moe_capacity(n_tokens: int, num_experts: int, top_k: int,
     return min(n_tokens, max(avg, min(n_tokens, 16), 1))
 
 
+#: host-side MoE drop telemetry, fed by jax.debug.callback from the EP
+#: dispatch (capacity overflow is a NUMERICS event — it must be observable,
+#: not silent); engine._metrics surfaces it in worker stats
+MOE_DROPS = {"total": 0}
+_moe_drop_lock = threading.Lock()  # callbacks fire per device, concurrently
+_moe_drop_warned = [False]
+
+
+def _record_moe_drops(n) -> None:
+    n = int(n)
+    if n:
+        with _moe_drop_lock:
+            MOE_DROPS["total"] += n
+            warn = not _moe_drop_warned[0]
+            _moe_drop_warned[0] = True
+        if warn:
+            logging.getLogger("dynamo.engine.model").warning(
+                "MoE capacity overflow: %d token-expert assignments dropped "
+                "this step (raise moe_capacity_factor; >= E/K is dropless). "
+                "Further drops count in metrics without this warning.", n)
+
+
 def _mlp_moe_ep(x, router_w, router_bias, wg, wu, wd, bg=None, bu=None,
                 bd=None, *, cfg: ModelConfig, axis_name: str = "tp"):
-    """Expert-parallel MoE (shard_map body over the expert axis).
+    """Expert-parallel MoE: token-sharded all-to-all dispatch (shard_map
+    body over the expert axis).
 
-    Each device holds E/n experts WHOLE (wg/wu/wd are the local slices) and
-    sees the full token set (x is replicated over the axis). Dispatch is a
-    capacity-bounded one-hot gather — each local expert processes at most
-    C = ceil(N·K/E · capacity_factor) tokens — so per-device FLOPs are
-    ~N·K·3DF/n regardless of E (the r1 dense-einsum path paid E× that).
-    The combine is a gate-weighted scatter followed by a psum over the axis
-    (the all-to-all of a classic GShard dispatch collapses into this psum
-    because x rides replicated on an axis the attention weights already
-    shard). Tokens beyond an expert's capacity are dropped, Switch-style;
-    capacity_factor ≥ E/K makes dropping impossible (tests use that).
+    Tokens enter SPLIT over the mesh (x is this shard's [N_loc, D] slice)
+    and each device holds E/n experts whole. Every shard routes its local
+    tokens and packs one capacity-C buffer per GLOBAL expert; a tiled
+    all_to_all swaps buffers so each device receives, from all n shards,
+    exactly the tokens bound for ITS experts ([E_local, n·C, D]); expert
+    MLPs run there, a mirror all_to_all returns results to the token
+    owners, and the gate-weighted combine is local. No psum, no replicated
+    token set: router/dispatch/combine all scale with N/n per device (the
+    r2 path paid global-N on every shard; the r1 dense path paid E× that).
+
+    Per-(shard, expert) capacity C = moe_capacity(N_loc, ...) bounds the
+    buffers; assignments beyond C drop Switch-style but are COUNTED into
+    model.MOE_DROPS via debug callback (only attached when C < N_loc).
+    capacity_factor ≥ E/K clamps C to N_loc, making dropping impossible —
+    the hot-expert-skew invariance test pins that.
 
     ref workload: recipes/deepseek-r1/sglang-wideep/tep16p-dep16d-disagg.yaml
     (--ep-size 16 wide-EP serving).
     """
-    B, S, D = x.shape
-    N = B * S
+    Nl, D = x.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok
-    idx = jax.lax.axis_index(axis_name)
-    E_local = wg.shape[0]
 
-    xf = x.reshape(N, D)
-    cw = _router_weights(xf, router_w, router_bias, cfg)
-    local = jax.lax.dynamic_slice_in_dim(cw, idx * E_local, E_local, axis=1)
-
-    C = moe_capacity(N, E, K, cfg.moe_capacity_factor)
-    mask = local > 0  # [N, E_local]
+    cw = _router_weights(x, router_w, router_bias, cfg)  # [Nl, E]
+    C = moe_capacity(Nl, E, K, cfg.moe_capacity_factor)
+    mask = cw > 0
     pos = jnp.cumsum(mask, axis=0) * mask  # 1-based slot per (token, expert)
     keep = mask & (pos <= C)
-    slot = (pos - 1)[..., None] == jnp.arange(C)[None, None, :]  # [N,El,C]
+    slot = (pos - 1)[..., None] == jnp.arange(C)[None, None, :]  # [Nl,E,C]
     disp = (keep[..., None] & slot).astype(x.dtype)
 
-    xe = jnp.einsum("nec,nd->ecd", disp, xf)  # [E_local, C, D]
-    hg = jnp.einsum("ecd,edf->ecf", xe, wg)
-    hu = jnp.einsum("ecd,edf->ecf", xe, wu)
+    xe = jnp.einsum("nec,nd->ecd", disp, x)  # [E, C, D] per-expert buffers
+    # dispatch: shard j receives every shard's buffers for its expert block
+    xr = jax.lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=1,
+                            tiled=True)  # [E_local, n·C, D]
+    hg = jnp.einsum("ecd,edf->ecf", xr, _qmat(wg, x.dtype))
+    hu = jnp.einsum("ecd,edf->ecf", xr, _qmat(wu, x.dtype))
     if cfg.moe_activation == "swiglu_oss":
         inter = _oss_glu(hg + bg[:, None, :], hu + bu[:, None, :])
     else:
         inter = jax.nn.silu(hg) * hu
-    y = jnp.einsum("ecf,efd->ecd", inter, wd)  # [E_local, C, D]
+    y = jnp.einsum("ecf,efd->ecd", inter, _qmat(wd, x.dtype))
     if cfg.moe_activation == "swiglu_oss":
         y = y + bd[:, None, :]
-    comb = disp * local[..., None].astype(x.dtype)  # gate-weighted one-hot
-    out = jnp.einsum("nec,ecd->nd", comb, y)
-    out = jax.lax.psum(out, axis_name)
-    return out.reshape(B, S, D).astype(x.dtype)
+    # return trip: slice the n token-owner segments back out and land each
+    # at its source shard, restoring the [E, C, D] view of MY tokens
+    yl = jax.lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
+                            tiled=True)
+    comb = disp * cw[..., None].astype(x.dtype)  # gate-weighted one-hot
+    out = jnp.einsum("nec,ecd->nd", comb, yl)
+    if C < Nl:  # drops possible under skew: count them (free otherwise)
+        jax.debug.callback(_record_moe_drops, (mask & ~keep).sum())
+    return out.astype(x.dtype)
+
+
+def _ep_token_axes(mesh: Mesh) -> tuple:
+    """Mesh axes the EP dispatch shards tokens over (every axis present:
+    batch-parallel, sequence-parallel and the expert axis all hold disjoint
+    token slices during the MLP)."""
+    return tuple(a for a in ("dp", "sp", "tp") if a in mesh.axis_names)
 
 
 def make_moe_ep_fn(cfg: ModelConfig, mesh: Mesh, axis_name: str = "tp"):
     """The production shard_map wiring for the EP MoE dispatch —
-    (x, router_w, router_bias, wg, wu, wd) -> [B,S,D]; used by forward and
-    by tests so specs cannot drift between them."""
+    (x [B,S,D], router_w, router_bias, wg, wu, wd[, biases]) -> [B,S,D];
+    used by forward and by tests so specs cannot drift between them.
+    Weight specs are pytree PREFIXES, so quantized experts (QTensor dicts,
+    q/s both [E, ...]) shard straight through and dequantize INSIDE the
+    shard — quantized bytes are what rides HBM and the ICI."""
     fn = functools.partial(_mlp_moe_ep, cfg=cfg, axis_name=axis_name)
-    specs = [P("dp", None, None), P(None, None), P(None),
-             P(axis_name, None, None), P(axis_name, None, None),
-             P(axis_name, None, None)]
+    tok_axes = _ep_token_axes(mesh)
+    wspec = P(axis_name, None, None)
+    specs = [P(tok_axes, None), P(None, None), P(None), wspec, wspec, wspec]
     if cfg.moe_activation == "swiglu_oss":  # expert biases shard with E
         specs += [P(axis_name, None), P(axis_name, None), P(axis_name, None)]
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=tuple(specs),
-        out_specs=P("dp", None, None), check_vma=False)
+    inner = jax.shard_map(fn, mesh=mesh, in_specs=tuple(specs),
+                          out_specs=P(tok_axes, None), check_vma=False)
+
+    def wrapped(x, *args):
+        B, S, D = x.shape
+        return inner(x.reshape(B * S, D), *args).reshape(B, S, D)
+
+    return wrapped
 
 
 def _mlp_moe(x, lp, cfg: ModelConfig):
@@ -905,21 +951,26 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if moe:
             ep_want = mesh is not None and tp_n > 1
-            ep_ok = (ep_want and dp_ok and cfg.num_experts % tp_n == 0)
+            n_tok_shards = 1
+            if mesh is not None:
+                for a in _ep_token_axes(mesh):
+                    n_tok_shards *= mesh.shape[a]
+            # no dp_ok needed: tokens flatten to [B*S, D] before the
+            # shard_map, so only the total count has to divide the shards
+            ep_ok = (ep_want and cfg.num_experts % tp_n == 0
+                     and (B * S) % n_tok_shards == 0)
             if ep_want and not ep_ok:
                 _logger.warning(
-                    "EP MoE bypassed: B=%d/dp or experts=%d/tp=%d not "
-                    "divisible — dense-einsum path for this bucket",
-                    B, cfg.num_experts, tp_n)
+                    "EP MoE bypassed: tokens=%d not divisible over %d mesh "
+                    "shards, B=%d/dp, or experts=%d/tp=%d — dense-einsum "
+                    "path for this bucket", B * S, n_tok_shards, B,
+                    cfg.num_experts, tp_n)
             if ep_ok:
                 fn = make_moe_ep_fn(cfg, mesh)
-                # quantized experts: materialize per-shard before the
-                # shard_map boundary (specs are per-array); the EP rewrite
-                # will dequantize inside the shard when this shows up hot
+                # quantized experts pass through whole: the shard body
+                # dequantizes its local slice inside the matmul
                 ep_args = [h, lp["router"], lp["router_bias"],
-                           _qmat(lp["w_gate"], h.dtype),
-                           _qmat(lp["w_up"], h.dtype),
-                           _qmat(lp["w_down"], h.dtype)]
+                           lp["w_gate"], lp["w_up"], lp["w_down"]]
                 if cfg.moe_activation == "swiglu_oss":
                     ep_args += [lp["b_gate"], lp["b_up"], lp["b_down"]]
                 x = x + fn(*ep_args)

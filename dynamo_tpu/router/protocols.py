@@ -101,6 +101,9 @@ class WorkerStats:
     request_total_slots: int = 0
     num_requests_waiting: int = 0
     data_parallel_rank: Optional[int] = None
+    #: cumulative MoE token-expert assignments dropped at EP capacity
+    #: (model.MOE_DROPS) — nonzero means routing skew is changing numerics
+    moe_dropped_tokens: int = 0
 
 
 @dataclass

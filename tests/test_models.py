@@ -193,3 +193,104 @@ def test_moe_ep_indivisible_batch_falls_back():
         jnp.ones((B, W), jnp.int32), jnp.full((B,), S, jnp.int32),
         jnp.full((B,), S - 1, jnp.int32), kc, vc)
     assert logits.shape == (B, cfg.vocab_size)
+
+
+def test_moe_ep_skew_invariance_and_structure():
+    """Hot-expert skew must NOT change outputs when capacity can hold the
+    worst case (cf >= E/K), the dispatch must be all-to-all (token-sharded),
+    and capacity overflow must COUNT drops instead of silently changing
+    numerics (round-2 verdict #4 / weak #3)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = ModelConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      num_experts=4, num_experts_per_tok=2, dtype="float32",
+                      moe_capacity_factor=2.0)  # E/K = 2 → dropless
+    key = jax.random.key(3)
+    # N_loc = 4*64/4 shards = 64 local tokens: past the dropless floor, so
+    # the tight-capacity arm below really drops
+    B, S, D = 4, 64, cfg.hidden_size
+    E, F = cfg.num_experts, cfg.intermediate_size
+    ks = jax.random.split(key, 5)
+    x = jax.random.normal(ks[0], (B, S, D), jnp.float32)
+    lp = {
+        # heavy bias on expert 0: EVERY token routes its top-1 there
+        "router": jax.random.normal(ks[1], (D, E)) * 0.05,
+        "router_bias": jnp.asarray([8.0, 0.0, 0.0, 0.0], jnp.float32),
+        "w_gate": jax.random.normal(ks[2], (E, D, F)) / np.sqrt(D),
+        "w_up": jax.random.normal(ks[3], (E, D, F)) / np.sqrt(D),
+        "w_down": jax.random.normal(ks[4], (E, F, D)) / np.sqrt(F),
+    }
+    cfg_biased = dataclasses.replace(cfg, router_logit_bias=True)
+    want = M._mlp_moe(x, lp, cfg_biased)
+
+    mesh = make_mesh(MeshConfig(dp=2, sp=1, tp=2))
+    fn = M.make_moe_ep_fn(cfg_biased, mesh)
+    args = (x, lp["router"], lp["router_bias"], lp["w_gate"], lp["w_up"],
+            lp["w_down"])
+    got = fn(*args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+    # structural claim: the dispatch is an all-to-all exchange, not a
+    # replicated-tokens psum (no all-reduce in the compiled module)
+    hlo = jax.jit(fn).lower(*args).compile().as_text()
+    assert "all-to-all" in hlo
+    assert "all-reduce" not in hlo
+
+    # tight capacity + skew → drops are COUNTED, not silent
+    M.MOE_DROPS["total"] = 0
+    cfg_tight = dataclasses.replace(cfg_biased, moe_capacity_factor=0.26)
+    got_t = M.make_moe_ep_fn(cfg_tight, mesh)(*args)
+    jax.effects_barrier()
+    assert M.MOE_DROPS["total"] > 0
+    # and with drops the output really differs (that is WHY they count)
+    assert not np.allclose(np.asarray(got_t), np.asarray(want), atol=1e-5)
+
+
+def test_moe_ep_quantized_experts_shard_through():
+    """QTensor expert stacks pass the shard_map boundary whole and
+    dequantize inside the shard — output equals the dense path on the
+    dequantized weights."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine import quant as Q
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = ModelConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      num_experts=4, num_experts_per_tok=2, dtype="float32",
+                      moe_capacity_factor=100.0)
+    key = jax.random.key(7)
+    B, S, D = 2, 8, cfg.hidden_size
+    E, F = cfg.num_experts, cfg.intermediate_size
+    ks = jax.random.split(key, 5)
+    x = jax.random.normal(ks[0], (B, S, D), jnp.float32)
+    router = jax.random.normal(ks[1], (D, E)) * 0.5
+    rbias = jnp.zeros((E,), jnp.float32)
+    wq = {n: Q.quantize(jax.random.normal(k, sh) / np.sqrt(sh[-2]),
+                        bits=8, group=16)
+          for n, k, sh in [("w_gate", ks[2], (E, D, F)),
+                           ("w_up", ks[3], (E, D, F)),
+                           ("w_down", ks[4], (E, F, D))]}
+    lp_deq = {"router": router, "router_bias": rbias,
+              **{n: Q.dequantize(v, jnp.float32) for n, v in wq.items()}}
+    want = M._mlp_moe(x, lp_deq, cfg)
+
+    mesh = make_mesh(MeshConfig(dp=1, sp=1, tp=2))
+    got = M.make_moe_ep_fn(cfg, mesh)(
+        x, router, rbias, wq["w_gate"], wq["w_up"], wq["w_down"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
