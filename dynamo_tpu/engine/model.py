@@ -419,7 +419,8 @@ def _paged_attention(q, k_cache, v_cache, lidx, block_tables, positions,
 
 def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
                    kv_lens, cfg: ModelConfig, block_size: int,
-                   use_pallas: bool = False, mesh: Optional[Mesh] = None):
+                   use_pallas: bool = False, use_flash: bool = False,
+                   mesh: Optional[Mesh] = None):
     """Multi-head latent attention (DeepSeek V2/V3) over the paged latent
     cache — the weight-ABSORBED formulation throughout.
 
@@ -491,24 +492,50 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
         o_lat = run(q_eff[:, 0], qr_pad, kc, vc, lidx, block_tables,
                     kv_lens)[:, None]  # [B,1,H,r]
     else:
+        # both prefill paths share the paged latent gather (linear in T;
+        # an XLA fused dynamic-gather) — only what happens to the scores
+        # differs between them
         W = block_tables.shape[1]
         T = W * block_size
         slot_idx = (block_tables[:, :, None] * block_size
                     + jnp.arange(block_size)[None, None, :]).reshape(B, T)
-        cg = kc[lidx, slot_idx][:, :, 0].astype(jnp.float32)        # [B,T,r]
-        krg = vc[lidx, slot_idx][:, :, 0, :dr].astype(jnp.float32)  # [B,T,dr]
+        cg = kc[lidx, slot_idx][:, :, 0]   # [B,T,r]  cache dtype
+        krg = vc[lidx, slot_idx][:, :, 0]  # [B,T,pr] (rope, padded)
+        if use_flash and S > 1:
+            # flash prefill in latent space: online softmax, no [B,H,S,T]
+            # HBM score tensor (the r2 verdict's DeepSeek-at-8k failure
+            # mode); only the quadratic part moves into the kernel
+            from dynamo_tpu.ops.flash_prefill import flash_mla_prefill
 
-        scores = (jnp.einsum("bshr,btr->bhst", q_eff, cg)
-                  + jnp.einsum("bshd,btd->bhst",
-                               q_rot.astype(jnp.float32), krg))
-        scores = scores * mla_softmax_scale(cfg)
+            dt = kc.dtype
+            qr_pad = jnp.pad(q_rot, ((0, 0), (0, 0), (0, 0), (0, pr - dr)))
+            fn = functools.partial(flash_mla_prefill,
+                                   scale=mla_softmax_scale(cfg))
+            if mesh is not None:  # heads on tp; the latent stream is shared
+                fn = jax.shard_map(
+                    fn, mesh=mesh,
+                    in_specs=(P("dp", None, "tp", None),
+                              P("dp", None, "tp", None),
+                              P("dp", None, None), P("dp", None, None),
+                              P("dp"), P("dp")),
+                    out_specs=P("dp", None, "tp", None), check_vma=False)
+            o_lat = fn(q_eff.astype(dt), qr_pad.astype(dt), cg, krg,
+                       positions[:, 0], kv_lens).astype(jnp.float32)
+        else:
+            cg = cg.astype(jnp.float32)
+            krg = krg[..., :dr].astype(jnp.float32)
 
-        key_pos = jnp.arange(T)
-        mask = (key_pos[None, None, :] <= positions[:, :, None]) & (
-            key_pos[None, None, :] < kv_lens[:, None, None])  # [B,S,T]
-        scores = jnp.where(mask[:, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o_lat = jnp.einsum("bhst,btr->bshr", probs, cg)
+            scores = (jnp.einsum("bshr,btr->bhst", q_eff, cg)
+                      + jnp.einsum("bshd,btd->bhst",
+                                   q_rot.astype(jnp.float32), krg))
+            scores = scores * mla_softmax_scale(cfg)
+
+            key_pos = jnp.arange(T)
+            mask = (key_pos[None, None, :] <= positions[:, :, None]) & (
+                key_pos[None, None, :] < kv_lens[:, None, None])  # [B,S,T]
+            scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            o_lat = jnp.einsum("bhst,btr->bshr", probs, cg)
     w_uv = lp["w_uv"].reshape(r, H, dv).astype(jnp.float32)
     out = jnp.einsum("bshr,rhd->bshd", o_lat.astype(jnp.float32), w_uv)
     return out.reshape(B, S, H * dv).astype(h.dtype), kc, vc
@@ -606,7 +633,7 @@ def _record_moe_drops(n) -> None:
             warn = not _moe_drop_warned[0]
             _moe_drop_warned[0] = True
         if warn:
-            logging.getLogger("dynamo.engine.model").warning(
+            _logger.warning(
                 "MoE capacity overflow: %d token-expert assignments dropped "
                 "this step (raise moe_capacity_factor; >= E/K is dropless). "
                 "Further drops count in metrics without this warning.", n)
@@ -824,7 +851,8 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             attn_flat, kc, vc = _mla_attention(
                 h, lp, lidx, kc, vc, slot_map, block_tables, positions,
                 kv_lens, cfg, block_size,
-                use_pallas=use_pallas and dp_ok, mesh=mesh)
+                use_pallas=use_pallas and dp_ok,
+                use_flash=use_flash_prefill and dp_ok, mesh=mesh)
             x = x + _mm(attn_flat, lp["wo"])
             return _mlp_epilogue(x, kc, vc, lp, moe)
         q = _mm(h, lp["wq"])
@@ -947,7 +975,6 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
 
     def _mlp_epilogue(x, kc, vc, lp, moe):
         tp_n = mesh.shape.get("tp", 1) if mesh is not None else 1
-        dp_ok = mesh is None or B % mesh.shape.get("dp", 1) == 0
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if moe:
             ep_want = mesh is not None and tp_n > 1
@@ -1160,13 +1187,16 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
     """
     from dynamo_tpu.ops.paged_attention import pallas_supported
 
-    if cfg.is_mla:  # latent-space attention: its own Pallas decode kernel
+    if cfg.is_mla:  # latent-space attention: its own Pallas kernels
         from dynamo_tpu.ops.paged_attention import mla_pallas_supported
 
         tp_ = mesh.shape.get("tp", 1) if mesh is not None else 1
-        return (use_pallas and cfg.num_heads % tp_ == 0
-                and mla_pallas_supported(cfg.kv_lora_rank,
-                                         cfg.rope_cache_dim)), False
+        mla_ok = (cfg.num_heads % tp_ == 0
+                  and mla_pallas_supported(cfg.kv_lora_rank,
+                                           cfg.rope_cache_dim))
+        if use_flash_prefill is None:
+            use_flash_prefill = use_pallas or jax.default_backend() == "tpu"
+        return (use_pallas and mla_ok), (bool(use_flash_prefill) and mla_ok)
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     heads_ok = (cfg.num_kv_heads % tp == 0 and cfg.num_heads % tp == 0
                 and cfg.num_heads % cfg.num_kv_heads == 0)

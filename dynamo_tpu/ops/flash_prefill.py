@@ -57,7 +57,10 @@ def _prefill_kernel(pos_base_ref, kv_lens_ref, window_ref,  # scalar prefetch
     tk = pl.program_id(3)
     n_tk = pl.num_programs(3)
 
+    # hd (score width, = k width) and hdv (value/output width) may differ:
+    # MLA attends in latent space where K carries the rope tail V lacks
     G, TQ, hd = q_ref.shape[2], q_ref.shape[3], q_ref.shape[4]
+    hdv = v_ref.shape[3]
     TK = k_ref.shape[2]
     kv_len = kv_lens_ref[b]
     pos0 = pos_base_ref[b]
@@ -123,21 +126,24 @@ def _prefill_kernel(pos_base_ref, kv_lens_ref, window_ref,  # scalar prefetch
     @pl.when(tk == n_tk - 1)
     def _finalize():
         out = acc_sc[...] / jnp.maximum(l_sc[...], 1e-30)
-        o_ref[0, 0] = out.reshape(G, TQ, hd).astype(o_ref.dtype)
+        o_ref[0, 0] = out.reshape(G, TQ, hdv).astype(o_ref.dtype)
 
 
 def flash_prefill(q, k, v, pos_base, kv_lens, *, sliding_window=None,
-                  sinks=None, interpret: bool = False):
+                  sinks=None, scale=None, interpret: bool = False):
     """Flash attention for a prefill chunk. See module docstring.
 
     ``sliding_window`` may be a traced scalar (per-layer gpt-oss windows);
     ``sinks`` [H] are optional attention-sink logits seeded into the online
-    softmax with zero value contribution."""
+    softmax with zero value contribution. ``v``'s trailing dim (= output
+    width) may differ from q/k's (MLA latent attention); ``scale`` defaults
+    to 1/√hd."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, S, H, hd = q.shape
     T, KV = k.shape[1], k.shape[2]
+    hdv = v.shape[3]
     G = H // KV
 
     TQ = min(S, max(1, 512 // max(G, 1)))
@@ -161,7 +167,9 @@ def flash_prefill(q, k, v, pos_base, kv_lens, *, sliding_window=None,
     sink_in = (jnp.zeros((1, KV, G, 1), q.dtype) if not has_sink
                else sinks.reshape(1, KV, G, 1).astype(q.dtype))
     kernel = functools.partial(
-        _prefill_kernel, scale=float(1.0 / np.sqrt(hd)), has_sink=has_sink)
+        _prefill_kernel,
+        scale=float(scale if scale is not None else 1.0 / np.sqrt(hd)),
+        has_sink=has_sink)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, KV, S // TQ, T // TK),
@@ -169,26 +177,49 @@ def flash_prefill(q, k, v, pos_base, kv_lens, *, sliding_window=None,
             pl.BlockSpec((1, 1, G, TQ, hd), lambda b, kk, tq, tk, *_: (b, kk, 0, tq, 0)),
             pl.BlockSpec((1, 1, G, 1), lambda b, kk, tq, tk, *_: (0, kk, 0, 0)),
             pl.BlockSpec((1, 1, TK, hd), lambda b, kk, tq, tk, *_: (b, kk, tk, 0)),
-            pl.BlockSpec((1, 1, TK, hd), lambda b, kk, tq, tk, *_: (b, kk, tk, 0)),
+            pl.BlockSpec((1, 1, TK, hdv), lambda b, kk, tq, tk, *_: (b, kk, tk, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, G, TQ, hd), lambda b, kk, tq, tk, *_: (b, kk, 0, tq, 0)),
+            (1, 1, G, TQ, hdv), lambda b, kk, tq, tk, *_: (b, kk, 0, tq, 0)),
         scratch_shapes=[
             pltpu.VMEM((G * TQ, 1), jnp.float32),
             pltpu.VMEM((G * TQ, 1), jnp.float32),
-            pltpu.VMEM((G * TQ, hd), jnp.float32),
+            pltpu.VMEM((G * TQ, hdv), jnp.float32),
         ],
     )
     out5 = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(q5.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, S, hdv), q.dtype),
         interpret=interpret,
     )(pos_base.astype(jnp.int32), kv_lens.astype(jnp.int32), win_arr,
       q5, sink_in, k4, v4)
 
-    # [B,KV,G,S,hd] → [B,S,H,hd]
-    return out5.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd)
+    # [B,KV,G,S,hdv] → [B,S,H,hdv]
+    return out5.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hdv)
+
+
+def flash_mla_prefill(q_eff, q_rot, c, k_rot, pos_base, kv_lens, *,
+                      scale: float, interpret: bool = False):
+    """Flash prefill over the compressed MLA latent cache — scores in
+    latent space, O(S·T) never leaves VMEM.
+
+    MLA attention is exactly single-KV-head attention once absorbed: every
+    query head shares the one latent stream, Q=[q_eff|q_rot] against
+    K=[c|k_rot] (the rope tail rides only the scores), V=c (output stays in
+    latent space; the caller expands through W_UV). The generalized flash
+    kernel runs it with KV=1, G=H, hd=r+pr, hdv=r — killing the [B,H,S,T]
+    HBM score tensor the XLA path materializes (r2 verdict #3; DeepSeek at
+    ISL 8192 is the reference's wide-EP flagship workload,
+    ref: recipes/deepseek-r1/sglang-wideep/tep16p-dep16d-disagg.yaml:61).
+
+    Args: q_eff [B,S,H,r] (absorbed), q_rot [B,S,H,pr] (rope, padded like
+    the cache), c [B,T,r], k_rot [B,T,pr]; → [B,S,H,r] latent output.
+    """
+    q_cat = jnp.concatenate([q_eff, q_rot], axis=-1)
+    k_cat = jnp.concatenate([c, k_rot], axis=-1)[:, :, None, :]
+    return flash_prefill(q_cat, k_cat, c[:, :, None, :], pos_base, kv_lens,
+                         scale=scale, interpret=interpret)
 
 
 def flash_prefill_paged(q, k_cache, v_cache, lidx, block_tables, positions,

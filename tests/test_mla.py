@@ -280,3 +280,85 @@ def test_mla_pallas_decode_sharded():
                         use_pallas=True, mesh=mesh)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_mla_flash_prefill_matches_xla():
+    """The latent flash-prefill kernel (interpret mode on CPU) must equal
+    the XLA score-materializing path — logits AND the written caches —
+    including a SECOND chunk attending back over the first (pos_base > 0,
+    the chunked-prefill case the online softmax must get right)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.cache import allocate_device_cache
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.model import forward, init_params
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=4, dtype="float32",
+        max_position_embeddings=256,
+        kv_lora_rank=128, q_lora_rank=None, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16)
+    params = init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+
+    rows = [[5, 9, 17, 23, 42, 77, 101, 3],
+            [6, 10, 18, 24, 43, 78, 102, 4]]
+    (tokens, positions, slot_map, bt, kv_lens, last_idx,
+     num_blocks) = _paged_inputs(cfg, rows, block_size=4)
+    outs = {}
+    for flash in (False, True):
+        kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
+        logits, kc, vc = forward(
+            params, tokens, positions, slot_map, bt, kv_lens, last_idx,
+            kc, vc, cfg=cfg, block_size=4, use_flash_prefill=flash)
+        # second chunk: 4 more tokens per row at positions 8..11
+        t2 = jnp.asarray([[11, 12, 13, 14], [15, 16, 17, 18]], jnp.int32)
+        p2 = jnp.asarray([[8, 9, 10, 11]] * 2, jnp.int32)
+        s2 = jnp.stack([bt[:, 2] * 4 + j for j in range(4)], axis=1)
+        l2 = jnp.asarray([12, 12], jnp.int32)
+        li2 = jnp.asarray([3, 3], jnp.int32)
+        logits2, kc, vc = forward(
+            params, t2, p2, s2.astype(jnp.int32), bt, l2, li2, kc, vc,
+            cfg=cfg, block_size=4, use_flash_prefill=flash)
+        outs[flash] = (np.asarray(logits), np.asarray(logits2),
+                       np.asarray(kc), np.asarray(vc))
+    for a, b in zip(outs[False], outs[True]):
+        np.testing.assert_allclose(b, a, atol=1e-4, rtol=1e-4)
+
+
+def test_mla_flash_prefill_sharded():
+    """Latent flash prefill through shard_map on a dp×tp mesh equals the
+    unsharded XLA result (heads shard on tp, latent stream replicated)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.cache import allocate_device_cache
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.model import forward, init_params, param_shardings
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=4, dtype="float32",
+        max_position_embeddings=256,
+        kv_lora_rank=128, q_lora_rank=None, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16)
+    params = init_params(cfg, jax.random.key(4), dtype=jnp.float32)
+
+    row = [5, 9, 17, 23, 42, 77, 101, 3]
+    (tokens, positions, slot_map, bt, kv_lens, last_idx,
+     num_blocks) = _paged_inputs(cfg, [row, [int(x) + 1 for x in row]])
+    kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=jnp.float32)
+    want, _, _ = forward(params, tokens, positions, slot_map, bt, kv_lens,
+                         last_idx, kc, vc, cfg=cfg, block_size=4)
+
+    mesh = make_mesh(MeshConfig(dp=2, sp=1, tp=2))
+    sparams = jax.device_put(params, param_shardings(cfg, mesh))
+    kc2, vc2 = allocate_device_cache(cfg, num_blocks, 4, mesh=mesh,
+                                     dtype=jnp.float32)
+    got, _, _ = forward(sparams, tokens, positions, slot_map, bt, kv_lens,
+                        last_idx, kc2, vc2, cfg=cfg, block_size=4,
+                        use_flash_prefill=True, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
