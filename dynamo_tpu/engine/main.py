@@ -106,6 +106,17 @@ async def amain():
     ap.add_argument("--multi-step-decode", type=int, default=1,
                     help="decode steps fused per jitted call (token bursts)")
     ap.add_argument("--no-prefix-caching", action="store_true")
+    # choices= fails fast on a typo — an unknown parser name would
+    # otherwise silently disable extraction AND buffer all chat streaming
+    ap.add_argument("--tool-call-parser", default=None,
+                    choices=["hermes", "llama3_json", "mistral", "phi4",
+                             "pythonic", "nemotron_deci", "deepseek_v3_1",
+                             "harmony"],
+                    help="tool-call format (gpt-oss defaults to harmony)")
+    ap.add_argument("--reasoning-parser", default=None,
+                    choices=["deepseek_r1", "qwen3", "basic", "granite",
+                             "gpt_oss"],
+                    help="reasoning format (gpt-oss defaults to gpt_oss)")
     ap.add_argument("--eos-token-ids", default=None,
                     help="comma-separated EOS ids (default: read from "
                          "generation_config.json next to --model-path)")
@@ -384,6 +395,14 @@ async def amain():
         card.runtime_config.total_kv_blocks = engine.num_blocks
         card.runtime_config.max_num_seqs = args.max_num_seqs
         card.runtime_config.max_num_batched_tokens = args.max_num_batched_tokens
+        tool_parser, reasoning_parser = cli.tool_call_parser, cli.reasoning_parser
+        if cfg.attention_sinks:  # gpt-oss family emits harmony channels:
+            # parse them by default so tool_calls/reasoning_content populate
+            # (ref: parsers config.rs:145 harmony, reasoning/gpt_oss_parser.rs)
+            tool_parser = tool_parser or "harmony"
+            reasoning_parser = reasoning_parser or "gpt_oss"
+        card.runtime_config.tool_call_parser = tool_parser
+        card.runtime_config.reasoning_parser = reasoning_parser
         await register_llm(runtime, ep, card, lease_id=lease)
 
     print("WORKER_READY", flush=True)

@@ -1,0 +1,232 @@
+"""Harmony (gpt-oss) channel-format parsing — reasoning + tool calls.
+
+gpt-oss emits OpenAI's harmony markup: messages framed by special tokens,
+each with a header naming a channel (``analysis`` = chain of thought,
+``commentary`` = tool calls, ``final`` = user-visible answer) and an
+optional recipient::
+
+  <|channel|>analysis<|message|>Need to call get_weather.<|end|>
+  <|start|>assistant<|channel|>commentary to=functions.get_weather
+  <|constrain|>json<|message|>{"location":"SF"}<|call|>
+
+The reference parses this with the openai_harmony tokenizer crate
+(ref: lib/parsers/src/tool_calling/harmony/harmony_parser.rs,
+lib/parsers/src/reasoning/gpt_oss_parser.rs). Here it is a from-scratch
+TEXT-level parser: the engine's detokenizer already yields the special
+tokens as text, so a marker state machine recovers the same message
+structure without a tokenizer round-trip.
+
+Two consumers with the pipeline's standard interfaces:
+
+- :class:`HarmonyChannelParser` — streaming ``feed()``/``finalize()``
+  (reasoning-parser interface): analysis (and non-tool commentary) text
+  streams out as reasoning deltas, final as content deltas, and
+  tool-call commentary segments pass through RAW (markers intact) so the
+  harmony tool parser downstream can extract them at stream end.
+- :func:`parse_harmony` — full-text tool-call parser: commentary segments
+  addressed ``to=functions.<name>`` become ToolCalls; text outside tool
+  segments (or in final/analysis channels) is the normal text.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+_MARKERS = ("<|start|>", "<|channel|>", "<|message|>", "<|end|>",
+            "<|call|>", "<|return|>", "<|constrain|>")
+_END_MARKERS = ("<|end|>", "<|call|>", "<|return|>", "<|start|>")
+_CHANNEL_RE = re.compile(r"<\|channel\|>\s*([a-zA-Z_]+)")
+_RECIPIENT_RE = re.compile(r"to=([^\s<]+)")
+
+
+def _find_marker(s: str, start: int = 0, markers=_MARKERS):
+    """(index, marker) of the earliest marker at/after ``start``; (-1, None)
+    when absent."""
+    best, which = -1, None
+    for m in markers:
+        i = s.find(m, start)
+        if i >= 0 and (best < 0 or i < best):
+            best, which = i, m
+    return best, which
+
+
+def _holdback(s: str) -> int:
+    """Length of the buffer suffix that could be the prefix of a split
+    marker (all markers start with '<|'); 0 when the tail is safe."""
+    for k in range(min(12, len(s)), 0, -1):
+        tail = s[-k:]
+        if any(m.startswith(tail) for m in _MARKERS):
+            return k
+    return 0
+
+
+class HarmonyChannelParser:
+    """Streaming harmony splitter with the ReasoningParser interface:
+    ``feed(delta) -> (reasoning_delta, content_delta)``, ``finalize()``."""
+
+    def __init__(self):
+        self._buf = ""
+        self._state = "header"  # generation resumes inside a header: the
+        # prompt ends with <|start|>assistant, so output begins <|channel|>
+        self._header = ""
+        self._raw_seg = ""      # raw text of the current segment (for the
+        # tool-call passthrough, markers intact)
+        self._channel = None
+        self._passthrough = False
+        self._any_message = False  # saw at least one <|message|> — if a
+        # stream carries NO harmony markup at all, finalize returns the
+        # accumulated text as content instead of swallowing it
+
+    def _route_body(self, chunk: str, reasoning: list, content: list):
+        if not chunk:
+            return
+        if self._passthrough:
+            content.append(chunk)
+        elif self._channel == "final":
+            content.append(chunk)
+        else:  # analysis / plain commentary / unknown → reasoning
+            reasoning.append(chunk)
+
+    def feed(self, delta: str) -> tuple[str, str]:
+        reasoning: list = []
+        content: list = []
+        self._buf += delta
+        while self._buf:
+            idx, marker = _find_marker(self._buf)
+            if idx < 0:
+                keep = _holdback(self._buf)
+                chunk = self._buf[:len(self._buf) - keep]
+                if self._state == "header":
+                    self._header += chunk
+                    self._raw_seg += chunk
+                else:
+                    self._route_body(chunk, reasoning, content)
+                self._buf = self._buf[len(self._buf) - keep:]
+                break
+            chunk = self._buf[:idx]
+            self._buf = self._buf[idx + len(marker):]
+            if self._state == "header":
+                self._header += chunk
+                self._raw_seg += chunk
+                if marker == "<|message|>":
+                    self._any_message = True
+                    self._raw_seg += marker
+                    # channel/recipient come from the RAW header (markers
+                    # intact): the <|channel|> marker anchors the channel
+                    # name, so stray words like the role can't shadow it
+                    chans = _CHANNEL_RE.findall(self._raw_seg)
+                    rec = _RECIPIENT_RE.search(self._raw_seg)
+                    self._channel = chans[-1] if chans else None
+                    self._passthrough = bool(
+                        self._channel == "commentary" and rec
+                        and rec.group(1).startswith("functions."))
+                    if self._passthrough:
+                        # hand the whole raw segment (markers intact) to
+                        # the content stream for the harmony tool parser
+                        content.append(self._raw_seg)
+                    self._state = "body"
+                else:
+                    # <|channel|>/<|constrain|>/<|start|>/stray end marker:
+                    # keep building the header text (the channel regex
+                    # re-anchors on the <|channel|> we prepend at parse)
+                    self._raw_seg += marker
+                    if marker == "<|start|>":
+                        self._header = ""
+                        self._raw_seg = "<|start|>"
+            else:  # body
+                if marker in _END_MARKERS:
+                    self._route_body(chunk, reasoning, content)
+                    if self._passthrough:
+                        content.append(marker if marker != "<|start|>"
+                                       else "<|call|>")
+                    self._state = "header"
+                    self._header = ""
+                    self._raw_seg = "<|start|>" if marker == "<|start|>" else ""
+                    self._channel = None
+                    self._passthrough = False
+                else:
+                    # stray non-terminator marker inside a body: treat as
+                    # literal text (harmony never nests)
+                    self._route_body(chunk + marker, reasoning, content)
+        return "".join(reasoning), "".join(content)
+
+    def finalize(self) -> tuple[str, str]:
+        out = self._buf
+        self._buf = ""
+        if self._state == "header":
+            if not self._any_message:
+                # no harmony markup in the whole stream: plain content
+                return "", self._header + out
+            return "", ""  # an unterminated header is markup, not content
+        if not out:
+            return "", ""
+        if self._passthrough or self._channel == "final":
+            return "", out
+        return out, ""
+
+
+def parse_harmony(text: str):
+    """Full-text harmony tool-call parse → (normal_text, [ToolCall]).
+
+    Conservative like every other parser here: when no tool-call segment
+    parses, the original text comes back untouched."""
+    from dynamo_tpu.parsers.tool_calling import ToolCall
+
+    if "<|channel|>" not in text:
+        return text, []
+    calls: list = []
+    finals: list = []
+    analyses: list = []
+    plain: list = []
+    pos = 0
+    n = len(text)
+    while pos < n:
+        idx, marker = _find_marker(text, pos, ("<|start|>", "<|channel|>"))
+        if idx < 0:
+            plain.append(text[pos:])
+            break
+        plain.append(text[pos:idx])
+        # header spans to <|message|> (or EOF → discard as stray markup)
+        hstart = idx if marker == "<|channel|>" else idx + len("<|start|>")
+        mi = text.find("<|message|>", hstart)
+        if mi < 0:
+            break
+        hdr_raw = text[idx:mi]  # markers intact: <|channel|> anchors the
+        chans = _CHANNEL_RE.findall(hdr_raw)  # channel name
+        ch = chans[-1] if chans else None
+        rec = _RECIPIENT_RE.search(hdr_raw)
+        body_start = mi + len("<|message|>")
+        bi, _ = _find_marker(text, body_start, _END_MARKERS)
+        body_end = bi if bi >= 0 else n
+        body = text[body_start:body_end]
+        pos = body_end
+        if pos < n and not text.startswith("<|start|>", pos):
+            # consume the end marker (<|end|>/<|call|>/<|return|>)
+            _, em = _find_marker(text, pos, _END_MARKERS)
+            pos += len(em or "")
+        channel = ch
+        if (channel == "commentary" and rec
+                and rec.group(1).startswith("functions.")):
+            name = rec.group(1)[len("functions."):]
+            if name:
+                try:
+                    args = json.loads(body.strip())
+                except json.JSONDecodeError:
+                    continue  # ref behavior: invalid JSON args → skip call
+                calls.append(ToolCall(name=name, arguments=json.dumps(args)))
+        elif channel == "final":
+            finals.append(body)
+        elif channel == "analysis":
+            analyses.append(body)
+        else:
+            plain.append(body)
+    if not calls:
+        # conservative like every parser here: no successfully-parsed call
+        # (including a functions.* segment with broken JSON) → the
+        # caller's text comes back verbatim, never mangled or swallowed
+        return text, []
+    normal = "".join(plain) + "".join(finals)
+    if not normal.strip():
+        normal = "".join(analyses)
+    return normal.strip(), calls

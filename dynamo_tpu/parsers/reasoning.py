@@ -75,9 +75,15 @@ class ReasoningParser:
         return (out, "") if self.in_reasoning else ("", out)
 
 
-def get_reasoning_parser(name: Optional[str]) -> Optional[ReasoningParser]:
+def get_reasoning_parser(name: Optional[str]):
     if not name:
         return None
+    if name in ("gpt_oss", "harmony"):
+        # channel-structured markup, not tag-delimited: its own machine
+        # (ref: lib/parsers/src/reasoning/gpt_oss_parser.rs)
+        from dynamo_tpu.parsers.harmony import HarmonyChannelParser
+
+        return HarmonyChannelParser()
     if name not in _STYLES:
         return None
     return ReasoningParser(name)

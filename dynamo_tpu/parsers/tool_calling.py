@@ -2,12 +2,18 @@
 
 ref: lib/parsers/src/tool_calling/ — per-model formats:
 
-  hermes       <tool_call>{"name": …, "arguments": {…}}</tool_call>
-  llama3_json  {"name": …, "parameters": {…}} (optionally after
-               <|python_tag|>; semicolon-separated for multiple calls)
-  mistral      [TOOL_CALLS][{…}, …] (bracketed JSON array)
-  phi4         functools[{…}, …]
-  pythonic     [fn(a=1), other(b="x")] (llama-4 style python call list)
+  hermes         <tool_call>{"name": …, "arguments": {…}}</tool_call>
+  llama3_json    {"name": …, "parameters": {…}} (optionally after
+                 <|python_tag|>; semicolon-separated for multiple calls)
+  mistral        [TOOL_CALLS][{…}, …] (bracketed JSON array)
+  phi4           functools[{…}, …]
+  pythonic       [fn(a=1), other(b="x")] (llama-4 style python call list)
+  nemotron_deci  <TOOLCALL>[{…}, …]</TOOLCALL> (ref: config.rs:92)
+  deepseek_v3_1  <｜tool▁calls▁begin｜><｜tool▁call▁begin｜>name<｜tool▁sep｜>
+                 {args}<｜tool▁call▁end｜><｜tool▁calls▁end｜>
+                 (ref: config.rs:156, json/deepseek_parser.rs)
+  harmony        gpt-oss channel markup (parsers/harmony.py;
+                 ref: tool_calling/harmony/harmony_parser.rs)
 
 Each parser returns (normal_text, [ToolCall]); detection is conservative —
 text that doesn't parse stays ordinary content.
@@ -162,6 +168,59 @@ def parse_phi4(text: str):
     return _parse_marked_array(text, _PHI4_RE)
 
 
+# -- nemotron_deci ------------------------------------------------------------
+
+_NEMOTRON_RE = re.compile(r"<TOOLCALL>\s*(.*?)\s*</TOOLCALL>", re.DOTALL)
+
+
+def parse_nemotron_deci(text: str):
+    calls = []
+    for m in _NEMOTRON_RE.finditer(text):
+        try:
+            arr = json.loads(m.group(1))
+        except json.JSONDecodeError:
+            continue
+        if isinstance(arr, list):
+            calls.extend(tc for obj in arr
+                         if isinstance(obj, dict) and (tc := _mk(obj)))
+    if not calls:
+        return text, []
+    return _NEMOTRON_RE.sub("", text).strip(), calls
+
+
+# -- deepseek_v3_1 ------------------------------------------------------------
+# <｜tool▁calls▁begin｜><｜tool▁call▁begin｜>name<｜tool▁sep｜>{args}
+# <｜tool▁call▁end｜>…<｜tool▁calls▁end｜> — the ▁/｜ glyphs are DeepSeek's
+# fullwidth specials, kept verbatim (they arrive as detokenized text)
+
+_DS_CALL_RE = re.compile(
+    "<｜tool▁call▁begin｜>(.*?)<｜tool▁sep｜>(.*?)<｜tool▁call▁end｜>",
+    re.DOTALL)
+_DS_START = "<｜tool▁calls▁begin｜>"
+
+
+def parse_deepseek_v3_1(text: str):
+    trimmed = text.strip()
+    i = trimmed.find(_DS_START)
+    if i < 0:
+        return text, []
+    calls = []
+    for name, args in _DS_CALL_RE.findall(trimmed):
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            parsed = json.loads(args.strip())
+        except json.JSONDecodeError:
+            continue  # ref: invalid JSON → skip the call
+        calls.append(ToolCall(name=name, arguments=json.dumps(parsed)))
+    if not calls:
+        return trimmed, []
+    # ref parity: normal text is everything BEFORE the calls block,
+    # untouched (deepseek_parser.rs test pins the trailing space)
+    return trimmed[:i], calls
+
+
 # -- pythonic (llama-4) -------------------------------------------------------
 
 
@@ -193,12 +252,21 @@ def parse_pythonic(text: str):
     return "", calls
 
 
+def _parse_harmony(text: str):
+    from dynamo_tpu.parsers.harmony import parse_harmony
+
+    return parse_harmony(text)
+
+
 _PARSERS: dict[str, Callable] = {
     "hermes": parse_hermes,
     "llama3_json": parse_llama3_json,
     "mistral": parse_mistral,
     "phi4": parse_phi4,
     "pythonic": parse_pythonic,
+    "nemotron_deci": parse_nemotron_deci,
+    "deepseek_v3_1": parse_deepseek_v3_1,
+    "harmony": _parse_harmony,
 }
 
 
