@@ -172,8 +172,8 @@ class AsyncJaxEngine:
 
     # ------------------------------------------------------------------ api
 
-    def _new_seq(self, req: PreprocessedRequest, ctx, sink,
-                 **kw) -> SeqState:
+    async def _new_seq(self, req: PreprocessedRequest, ctx, sink,
+                       **kw) -> SeqState:
         """Build a SeqState — the ONE place request-scoped engine state
         (like the guided-decoding cursor) attaches, so every entry path
         (generate, disagg prefill_extract, generate_prefilled/injected)
@@ -187,11 +187,12 @@ class AsyncJaxEngine:
                     "guided decoding requested but this worker has no "
                     "tokenizer vocabulary (engine started without "
                     "guided_vocab)")
-            # compile is cheap (machines are cached across requests); the
-            # per-state vocab walks happen in the sampling worker thread
-            seq.guided_state = compile_guided(
-                req.sampling_options.guided, self.guided_vocab,
-                req.eos_token_ids or [])
+            # off the event loop: a fresh machine's compile includes the
+            # start-state token-liveness proof, which can walk the vocab
+            # through the char DFA hundreds of times on a cold cache
+            seq.guided_state = await asyncio.to_thread(
+                compile_guided, req.sampling_options.guided,
+                self.guided_vocab, req.eos_token_ids or [])
         return seq
 
     async def generate(self, req: PreprocessedRequest, ctx=None
@@ -199,7 +200,7 @@ class AsyncJaxEngine:
         """EngineFn-compatible async stream of per-token outputs."""
         self._ensure_loop()
         sink: asyncio.Queue = asyncio.Queue()
-        seq = self._new_seq(req, ctx, sink)
+        seq = await self._new_seq(req, ctx, sink)
         self.scheduler.add(seq)
         self._wake.set()
         while True:
@@ -286,7 +287,7 @@ class AsyncJaxEngine:
                                  min_tokens=1, ignore_eos=True)
         preq = dataclasses.replace(req, stop_conditions=sc)
         sink: asyncio.Queue = asyncio.Queue()
-        seq = self._new_seq(preq, ctx, sink, hold_blocks=True)
+        seq = await self._new_seq(preq, ctx, sink, hold_blocks=True)
         self.scheduler.add(seq)
         self._wake.set()
         token, logp = None, None
@@ -364,7 +365,7 @@ class AsyncJaxEngine:
             events.put_nowait(("chunk", (state["shipped"], len(ids), kb, vb)))
             state["shipped"] = full
 
-        seq = self._new_seq(preq, ctx, sink, hold_blocks=True,
+        seq = await self._new_seq(preq, ctx, sink, hold_blocks=True,
                             progress_cb=on_progress)
 
         async def drain_sink():
@@ -474,7 +475,7 @@ class AsyncJaxEngine:
         """
         self._ensure_loop()
         sink: asyncio.Queue = asyncio.Queue()
-        seq = self._new_seq(req, ctx, sink)
+        seq = await self._new_seq(req, ctx, sink)
         if seq.guided_state is not None:
             # the prefill worker sampled this token under the same mask
             # (it compiles the same options); re-advance the local cursor —

@@ -30,6 +30,7 @@ Anchoring is implicit (full-match), as in outlines.
 from __future__ import annotations
 
 import json
+import logging
 import re as _pyre
 from typing import Optional
 
@@ -358,11 +359,19 @@ class TokenMachine:
     Empty-text tokens (special markers that decode to "") are never allowed.
     """
 
+    #: forward-search cap per liveness query: past this a state is treated
+    #: as live (optimistic = char-level semantics, never worse than r2)
+    #: and a warning logs once. Each explored state costs one vocab walk,
+    #: so the cap bounds pathological patterns, not normal serving.
+    MAX_LIVE_SEARCH = 500
+
     def __init__(self, dfa: CharDfa, vocab: list[str]):
         self.dfa = dfa
         self.vocab = vocab
         self._allowed_cache: dict = {}
         self._ids_cache: dict = {}  # (state, max_id) -> [token_id]
+        self._live_memo: dict = {}  # state -> token-level liveness
+        self._live_cap_warned = False
 
     @property
     def start(self):
@@ -385,13 +394,72 @@ class TokenMachine:
     def allowed_ids_below(self, state, max_id: int) -> list:
         """Cached id list clamped to the model's logits width — the
         per-step fast path (the dict walk + filter would be O(vocab) of
-        Python per sampled token otherwise). Callers must not mutate."""
+        Python per sampled token otherwise). Callers must not mutate.
+
+        Tokens landing in token-DEAD states (char-alive but no token path
+        to acceptance — r2 verdict #6) are excluded, so generation can
+        never stall into an all-masked step mid-constraint."""
         key = (state, max_id)
         hit = self._ids_cache.get(key)
         if hit is None:
-            hit = [t for t in self.allowed(state) if 0 <= t < max_id]
+            hit = [t for t, nxt in self.allowed(state).items()
+                   if 0 <= t < max_id and self.token_live(nxt)]
             self._ids_cache[key] = hit
         return hit
+
+    def token_live(self, state) -> bool:
+        """True when acceptance is reachable from ``state`` via TOKENS (or
+        ``state`` accepts already). Char-level liveness alone strands
+        generation on vocabularies missing the needed characters.
+
+        Memoized DFS: proving LIVE stops at the first accepting path (and
+        marks the whole discovery path live); proving DEAD requires
+        exhausting the state's token-closure, which then bulk-memoizes as
+        dead (every closure member shares the verdict)."""
+        memo = self._live_memo
+        hit = memo.get(state)
+        if hit is not None:
+            return hit
+        if self.is_accepting(state):
+            memo[state] = True
+            return True
+        parents: dict = {state: None}
+        stack = [state]
+        explored = 0
+        while stack:
+            s = stack.pop()
+            explored += 1
+            if explored > self.MAX_LIVE_SEARCH:
+                if not self._live_cap_warned:
+                    self._live_cap_warned = True
+                    logging.getLogger("dynamo.llm.guided").warning(
+                        "guided liveness search capped at %d states — "
+                        "falling back to char-level liveness for this "
+                        "constraint (token-level dead ends possible)",
+                        self.MAX_LIVE_SEARCH)
+                memo[state] = True  # optimistic: old behavior, not worse
+                return True
+            for nxt in self.allowed(s).values():
+                if nxt in parents or memo.get(nxt) is False:
+                    continue
+                if memo.get(nxt) or self.is_accepting(nxt):
+                    memo[nxt] = True
+                    cur = s  # the discovery path reaches acceptance too
+                    while cur is not None:
+                        memo[cur] = True
+                        cur = parents[cur]
+                    return True
+                parents[nxt] = s
+                stack.append(nxt)
+        for s in parents:  # exhaustive: the whole closure never accepts
+            memo[s] = False
+        return False
+
+    def has_live_continuation(self, state) -> bool:
+        """Some token from ``state`` lands on a token-live state (memo
+        lookups after first touch — no second O(vocab) filter pass like an
+        allowed_ids_below call with a different max_id would pay)."""
+        return any(self.token_live(n) for n in self.allowed(state).values())
 
     def is_accepting(self, state) -> bool:
         return self.dfa.is_accepting(state)
@@ -424,11 +492,11 @@ class GuidedState:
         the constraint can terminate here. A finished (or dead) constraint
         allows only EOS so the sequence ends instead of free-running.
 
-        Liveness is CHAR-level (as in outlines): a token is allowed when its
-        text keeps the char DFA alive, even if no further token sequence can
-        complete the pattern. With byte/char-complete vocabularies (any real
-        BPE) this cannot strand the walk; vocabularies missing single-char
-        tokens can hit token-level dead ends, which terminate via EOS."""
+        Liveness is TOKEN-level: a token is allowed only when its landing
+        state still has some token path to acceptance
+        (TokenMachine.token_live), so the walk cannot strand — vocabularies
+        missing the pattern's characters refuse at compile time instead
+        (compile_guided checks the start state)."""
         hi = max_id if max_id is not None else len(self.machine.vocab)
         # clamp EOS only against an EXPLICIT logits width — eos ids may
         # legitimately exceed the constraint vocabulary's length
@@ -452,9 +520,10 @@ class GuidedState:
             self.done = True  # off-constraint (shouldn't happen when masked)
             return
         self.state = nxt
-        if not self.machine.allowed(nxt):
-            # complete (accepting) or token-level dead end: either way no
-            # further token is legal — finish before sampling another
+        if not self.machine.has_live_continuation(nxt):
+            # complete (accepting) or stranded (possible only past the
+            # liveness-search cap): no further token is legal — finish
+            # before sampling another
             self.exhausted = True
 
 
@@ -611,4 +680,10 @@ def compile_guided(guided: dict, vocab: list[str],
         if len(_MACHINE_CACHE) >= _MACHINE_CACHE_CAP:
             _MACHINE_CACHE.pop(next(iter(_MACHINE_CACHE)))
         _MACHINE_CACHE[key] = machine
+    if not machine.token_live(machine.start):
+        # refuse at COMPILE time: no token sequence over this vocabulary
+        # can satisfy the pattern, so generation would stall immediately
+        raise ValueError(
+            "guided constraint cannot be satisfied by any token sequence "
+            "over this model's vocabulary")
     return GuidedState(machine, eos_ids)

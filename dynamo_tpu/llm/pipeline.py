@@ -238,6 +238,13 @@ class OpenAIPreprocessor:
             reasoning = get_reasoning_parser(rc.reasoning_parser)
             if rc.tool_call_parser and req.tools:
                 tool_parser_name = rc.tool_call_parser
+            elif hasattr(reasoning, "route_tools_to_reasoning"):
+                # tool-less request on a harmony model: no tool parser will
+                # run, so the channel parser must NOT pass commentary
+                # segments through raw (the <|...|> markup would stream
+                # verbatim as content) — route them into reasoning instead,
+                # markup stripped, and keep final-channel streaming live
+                reasoning.route_tools_to_reasoning = True
         # with a tool parser active, content is buffered and parsed at stream
         # end (a partial tool call must never leak as content)
         tool_buf: Optional[list] = [] if tool_parser_name else None

@@ -77,6 +77,10 @@ class HarmonyChannelParser:
         self._any_message = False  # saw at least one <|message|> — if a
         # stream carries NO harmony markup at all, finalize returns the
         # accumulated text as content instead of swallowing it
+        #: set by the pipeline when NO tool parser will consume the content
+        #: stream: tool-call commentary then routes to reasoning (markup
+        #: stripped) instead of passing through raw
+        self.route_tools_to_reasoning = False
 
     def _route_body(self, chunk: str, reasoning: list, content: list):
         if not chunk:
@@ -120,7 +124,8 @@ class HarmonyChannelParser:
                     self._channel = chans[-1] if chans else None
                     self._passthrough = bool(
                         self._channel == "commentary" and rec
-                        and rec.group(1).startswith("functions."))
+                        and rec.group(1).startswith("functions.")
+                        and not self.route_tools_to_reasoning)
                     if self._passthrough:
                         # hand the whole raw segment (markers intact) to
                         # the content stream for the harmony tool parser
@@ -213,7 +218,11 @@ def parse_harmony(text: str):
                 try:
                     args = json.loads(body.strip())
                 except json.JSONDecodeError:
-                    continue  # ref behavior: invalid JSON args → skip call
+                    # ref parity (harmony_parser.rs: null args → call
+                    # dropped, body NOT surfaced as text); the all-broken
+                    # case still returns the full original via the
+                    # no-calls fallback below
+                    continue
                 calls.append(ToolCall(name=name, arguments=json.dumps(args)))
         elif channel == "final":
             finals.append(body)

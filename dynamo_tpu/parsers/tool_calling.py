@@ -175,17 +175,26 @@ _NEMOTRON_RE = re.compile(r"<TOOLCALL>\s*(.*?)\s*</TOOLCALL>", re.DOTALL)
 
 def parse_nemotron_deci(text: str):
     calls = []
+    parsed_spans = []
     for m in _NEMOTRON_RE.finditer(text):
         try:
             arr = json.loads(m.group(1))
         except json.JSONDecodeError:
-            continue
+            continue  # unparseable block: stays in the normal text
         if isinstance(arr, list):
-            calls.extend(tc for obj in arr
-                         if isinstance(obj, dict) and (tc := _mk(obj)))
+            block = [tc for obj in arr
+                     if isinstance(obj, dict) and (tc := _mk(obj))]
+            if block:
+                calls.extend(block)
+                parsed_spans.append(m.span())
     if not calls:
         return text, []
-    return _NEMOTRON_RE.sub("", text).strip(), calls
+    out, pos = [], 0
+    for a, b in parsed_spans:  # strip only the blocks that became calls
+        out.append(text[pos:a])
+        pos = b
+    out.append(text[pos:])
+    return "".join(out).strip(), calls
 
 
 # -- deepseek_v3_1 ------------------------------------------------------------
@@ -215,7 +224,7 @@ def parse_deepseek_v3_1(text: str):
             continue  # ref: invalid JSON → skip the call
         calls.append(ToolCall(name=name, arguments=json.dumps(parsed)))
     if not calls:
-        return trimmed, []
+        return text, []  # nothing parsed: caller's text verbatim
     # ref parity: normal text is everything BEFORE the calls block,
     # untouched (deepseek_parser.rs test pins the trailing space)
     return trimmed[:i], calls

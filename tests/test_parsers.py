@@ -367,3 +367,33 @@ async def test_pipeline_harmony_round_trip():
         "location": "San Francisco"}
     assert result["choices"][0]["finish_reason"] == "tool_calls"
     assert not msg["content"]
+
+
+@pytest.mark.parametrize("chunk", [1, 9, 1000])
+def test_harmony_toolless_routes_commentary_to_reasoning(chunk):
+    """Without a downstream tool parser (request carries no tools), the
+    channel parser must NOT leak raw <|...|> markup as content — tool
+    commentary routes into reasoning, markup stripped, final stays live."""
+    p = get_reasoning_parser("gpt_oss")
+    p.route_tools_to_reasoning = True
+    text = _HARMONY_TOOL + ('<|start|>assistant<|channel|>final<|message|>'
+                            'Answer.<|return|>')
+    r_all, c_all = [], []
+    for i in range(0, len(text), chunk):
+        r, c = p.feed(text[i:i + chunk])
+        r_all.append(r)
+        c_all.append(c)
+    r, c = p.finalize()
+    r_all.append(r)
+    c_all.append(c)
+    content = "".join(c_all)
+    assert "<|" not in content and content == "Answer."
+    assert '{"location":"San Francisco"}' in "".join(r_all)
+
+
+def test_nemotron_unparseable_block_survives():
+    text = ('<TOOLCALL>[{"name": "f", "arguments": {}}]</TOOLCALL> '
+            '<TOOLCALL>[broken</TOOLCALL>')
+    normal, calls = parse_tool_calls("nemotron_deci", text)
+    assert [c.name for c in calls] == ["f"]
+    assert normal == "<TOOLCALL>[broken</TOOLCALL>"
