@@ -215,18 +215,20 @@ class AsyncJaxEngine:
 
     async def embed(self, token_id_lists: list[list[int]]) -> list[list[float]]:
         """Mean-pooled L2-normalized embeddings for a batch of token lists
-        (ref surface: /v1/embeddings, openai.rs:714). Shapes bucket to
+        (ref surface: /v1/embeddings, openai.rs:714). Runs the SERVING
+        forward over a scratch paged cache, so every family the engine
+        generates with (MLA, gpt-oss, MoE, …) embeds too. Shapes bucket to
         powers of two so steady traffic reuses a handful of programs."""
-        import jax
         import jax.numpy as jnp
 
         from dynamo_tpu.engine import model as M
+        from dynamo_tpu.engine.cache import allocate_device_cache
 
         if not token_id_lists:
             return []
-        # dense S×S attention: bound inputs by the serving context the same
-        # way generate does (an unbounded S — or an unbounded batch of
-        # near-limit inputs — would OOM the worker)
+        # bound inputs by the serving context the same way generate does
+        # (an unbounded S — or an unbounded batch of near-limit inputs —
+        # would OOM the worker)
         limit = self.args.max_model_len
         too_long = max(len(t) for t in token_id_lists)
         if too_long > limit:
@@ -240,20 +242,34 @@ class AsyncJaxEngine:
                 f"embedding batch of {len(token_id_lists)}×{too_long} tokens "
                 f"exceeds the per-request budget {budget}; split the batch")
         if getattr(self, "_embed_fn", None) is None:
-            # one jitted callable; jax.jit caches per (B,S) bucket itself
-            self._embed_fn = jax.jit(
-                functools.partial(M.embedding_forward, cfg=self.cfg))
+            # one jitted callable (jax.jit re-specializes per (B,S) bucket)
+            # + per-bucket scratch caches, reused across calls
+            self._embed_fn = M.make_embed_fn(
+                self.cfg, self.args.block_size, self.mesh,
+                use_pallas=self.args.use_pallas_attention)
+            self._embed_caches: dict = {}
+        bs = self.args.block_size
         B = 1 << (len(token_id_lists) - 1).bit_length()
-        S = max(8, 1 << (too_long - 1).bit_length())
+        S = max(bs, 1 << (too_long - 1).bit_length())
         tokens = np.zeros((B, S), np.int32)
         lengths = np.zeros((B,), np.int32)
         for i, ids in enumerate(token_id_lists):
             tokens[i, :len(ids)] = ids
             lengths[i] = len(ids)
+        caches = self._embed_caches.get((B, S))
+        if caches is None:
+            # keep ONE scratch cache: mixed-shape embed traffic must not
+            # accumulate per-bucket HBM the serving pool never budgeted
+            # for (re-allocating on a shape change beats an OOM)
+            self._embed_caches.clear()
+            caches = allocate_device_cache(
+                self.cfg, B * (S // bs) + 1, bs, self.mesh,
+                global_arrays=self._multihost)
+            self._embed_caches[(B, S)] = caches
 
         def run():  # compile/dispatch + host copy off the event loop
             out = self._embed_fn(self.params, jnp.asarray(tokens),
-                                 jnp.asarray(lengths))
+                                 jnp.asarray(lengths), *caches)
             return np.asarray(out)
 
         host = await asyncio.to_thread(run)
@@ -1313,7 +1329,7 @@ class AsyncJaxEngine:
         for i, h in enumerate(hashes):
             e = self.kvbm.get_host(h)
             if e is None:
-                if self.kvbm.in_disk(h):
+                if self.kvbm.in_lower_tier(h):  # G3 disk or G4 remote
                     self._spawn_promote(hashes[i:])
                 elif self.kvbm_remote is not None:
                     self._spawn_remote_fetch(hashes[i:])

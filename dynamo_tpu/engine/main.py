@@ -131,6 +131,10 @@ async def amain():
                     help="host-DRAM KV tier size (0 = off)")
     ap.add_argument("--kvbm-disk-dir", default=None)
     ap.add_argument("--kvbm-disk-gb", type=float, default=0.0)
+    ap.add_argument("--kvbm-g4-gb", type=float, default=0.0,
+                    help="G4 remote-tier byte budget backed by the control "
+                         "plane's object store (0 = disabled; ref: "
+                         "block_manager.rs CacheLevel::G4)")
     ap.add_argument("--kvbm-distributed", action="store_true",
                     help="join the distributed KVBM fleet: announce tier "
                          "contents, serve fetch/control, pull peer blocks "
@@ -326,6 +330,15 @@ async def amain():
                                        namespace=cli.namespace).start()
     kvbm_leader = None
     kvbm_worker = None
+    if cli.kvbm_g4_gb > 0:
+        if engine.kvbm is None:
+            ap.error("--kvbm-g4-gb requires --kvbm-host-gb (G4 backstops "
+                     "the host/disk tiers)")
+        from dynamo_tpu.kvbm.distributed import ObjectStoreG4Client
+        engine.kvbm.attach_remote(
+            ObjectStoreG4Client(runtime.plane, asyncio.get_running_loop(),
+                                cli.namespace),
+            int(cli.kvbm_g4_gb * (1 << 30)))
     if cli.kvbm_distributed and engine.kvbm is None:
         ap.error("--kvbm-distributed needs --kvbm-host-gb > 0")
     if cli.kvbm_leader_workers or cli.kvbm_distributed:

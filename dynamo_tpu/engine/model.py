@@ -804,7 +804,7 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             last_idx, k_cache, v_cache, *, cfg: ModelConfig, block_size: int,
             use_pallas: bool = False, use_flash_prefill: bool = False,
             mesh: Optional[Mesh] = None, all_logits: bool = False,
-            mm_vec=None, mm_mask=None):
+            return_hidden: bool = False, mm_vec=None, mm_mask=None):
     """One engine step.
 
     Args:
@@ -1023,6 +1023,8 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
     (x, k_cache, v_cache) = carry
 
     x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if return_hidden:  # embeddings: pooled downstream, no lm head
+        return x.astype(jnp.float32), k_cache, v_cache
     head = (params["embed"].T if cfg.tie_word_embeddings
             else params["lm_head"])
     if all_logits:  # speculative verification reads every position
@@ -1068,66 +1070,47 @@ def make_verify_fn(cfg: ModelConfig, block_size: int,
     return jax.jit(f, donate_argnums=(6, 7), **kw)
 
 
-def embedding_forward(params, tokens, lengths, *, cfg: ModelConfig):
-    """Mean-pooled sequence embeddings (ref surface: /v1/embeddings,
-    lib/llm/src/http/service/openai.rs:714).
+def make_embed_fn(cfg: ModelConfig, block_size: int,
+                  mesh: Optional[Mesh] = None, use_pallas: bool = False):
+    """Jitted mean-pooled sequence embeddings over the SERVING forward
+    (ref surface: /v1/embeddings, lib/llm/src/http/service/openai.rs:714 —
+    the reference serves embeddings regardless of backend model family).
 
-    Dense causal self-attention over the padded batch — no paged cache (an
-    embedding pass has no decode phase to reuse KV for), so this path has
-    zero interaction with the serving cache/pool. Returns [B, D] f32,
-    L2-normalized mean over each row's valid positions.
+    Reusing ``forward`` (with a caller-provided scratch paged cache and a
+    trivial contiguous block layout built in-trace) means every family the
+    engine can generate with — MLA latent attention, gpt-oss per-layer
+    windows + sinks, MoE, dense-prefix stacks — embeds through the exact
+    layer code the parity suites pin, instead of a dense-only re-
+    implementation that refused them (the r2 gap at rows 24/§ verdict #8).
+
+    Returns f(params, tokens [B,S], lengths [B], k_cache, v_cache) →
+    [B, D] f32, L2-normalized mean over valid positions. S must be a
+    multiple of block_size; the scratch cache needs B·S/block_size + 1
+    blocks and is NOT donated (reused across calls, contents irrelevant).
     """
-    if (cfg.is_mla or cfg.num_dense_prefix_layers
-            or cfg.layer_windows is not None or cfg.attention_sinks):
-        raise NotImplementedError(
-            "embedding_forward covers the MHA/GQA families; serve embeddings "
-            "from a dense model (MLA/gpt-oss variants are generation-only)")
-    B, S = tokens.shape
-    D, hd = cfg.hidden_size, cfg.head_dim
-    H, KV = cfg.num_heads, cfg.num_kv_heads
-    G = H // KV
-    positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
-    valid = jnp.arange(S)[None, :] < lengths[:, None]  # [B, S]
-    causal = (jnp.arange(S)[None, :] <= jnp.arange(S)[:, None])[None]  # [1,S,S]
-    mask = causal & valid[:, None, :]
-    if cfg.sliding_window:  # same window semantics as the serving paths
-        mask = mask & (jnp.arange(S)[None, :]
-                       > jnp.arange(S)[:, None] - cfg.sliding_window)[None]
+    _, prefill_flash = _resolve_kernel_flags(cfg, mesh, use_pallas, None)
 
-    x = params["embed"][tokens]
+    def f(params, tokens, lengths, k_cache, v_cache):
+        B, S = tokens.shape
+        W = S // block_size
+        positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        bt = 1 + jnp.arange(B)[:, None] * W + jnp.arange(W)[None, :]
+        slot_map = (bt[:, :, None] * block_size
+                    + jnp.arange(block_size)[None, None, :]).reshape(B, S)
+        # padded rows attend only keys < kv_len, so junk past a row's
+        # length never reaches a valid position; pooling masks it anyway
+        x, _, _ = forward(
+            params, tokens, positions, slot_map, bt.astype(jnp.int32),
+            lengths.astype(jnp.int32), jnp.zeros((B,), jnp.int32),
+            k_cache, v_cache, cfg=cfg, block_size=block_size,
+            use_flash_prefill=prefill_flash, mesh=mesh, return_hidden=True)
+        valid = (jnp.arange(S)[None, :] < lengths[:, None])
+        pooled = (x * valid[..., None]).sum(1) / jnp.maximum(
+            lengths[:, None].astype(jnp.float32), 1.0)
+        return pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
 
-    def layer(x, lp):
-        h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = _mm(h, lp["wq"])
-        k = _mm(h, lp["wk"])
-        v = _mm(h, lp["wv"])
-        if "bq" in lp:
-            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-        q = q.reshape(B, S, H, hd)
-        k = k.reshape(B, S, KV, hd)
-        if cfg.qk_norm:
-            q = _rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
-            k = _rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
-        q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-        k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-        v = v.reshape(B, S, KV, hd)
-        qg = q.reshape(B, S, KV, G, hd).astype(jnp.float32)
-        s = jnp.einsum("bskgd,btkd->bkgst", qg, k.astype(jnp.float32))
-        s = s / np.sqrt(hd)
-        s = jnp.where(mask[:, None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("bkgst,btkd->bskgd", p, v.astype(jnp.float32))
-        x = x + _mm(attn.reshape(B, S, H * hd).astype(x.dtype), lp["wo"])
-        h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + (_mlp_moe(h, lp, cfg) if cfg.is_moe else _mlp_dense(h, lp))
-        return x, None
-
-    x, _ = jax.lax.scan(layer, x, params["layers"])
-    x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps).astype(jnp.float32)
-    pooled = (x * valid[..., None]).sum(1) / jnp.maximum(
-        lengths[:, None].astype(jnp.float32), 1.0)
-    return pooled / jnp.maximum(
-        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+    return jax.jit(f)
 
 
 def multi_decode(params, last_tokens, positions, block_tables, kv_lens,

@@ -210,7 +210,10 @@ class KvbmWorkerService:
             await barrier.worker_enter(f"worker-{lease:x}",
                                        timeout=barrier_timeout), raw=False)
         if payload.get("host_bytes"):  # leader-dictated shared pool config
-            self.manager.resize_host(payload["host_bytes"])
+            # off the loop: resize may cascade to G4, whose drain blocks on
+            # coroutines scheduled onto THIS loop (self-deadlock otherwise)
+            await asyncio.to_thread(self.manager.resize_host,
+                                    payload["host_bytes"])
         # announce pre-existing contents (restart case)
         existing = self.manager.resident_hashes()
         if existing:
@@ -379,3 +382,40 @@ class KvbmController:
 
     async def stats(self) -> list[dict]:
         return await self._fanout({"op": "stats"})
+
+
+class ObjectStoreG4Client:
+    """Sync facade over the control plane's object store for the KVBM G4
+    tier (ref: block_manager.rs:62-75 CacheLevel::G4 — the reference backs
+    G4 with NIXL FS/S3 plugins; here the same object store that carries
+    radix snapshots does).
+
+    put/get/delete by block hash; bridges onto the runtime's event loop via
+    run_coroutine_threadsafe. Callers must NOT be on that loop — the
+    KvbmManager guarantees it (G4 I/O runs on the engine's offload/onboard
+    worker threads, outside the manager lock)."""
+
+    BUCKET = "kvbm-g4"
+
+    def __init__(self, plane, loop, namespace: str = "dynamo",
+                 timeout: float = 30.0):
+        self.plane = plane
+        self.loop = loop
+        self.ns = namespace
+        self.timeout = timeout
+
+    def _run(self, coro):
+        return asyncio.run_coroutine_threadsafe(
+            coro, self.loop).result(self.timeout)
+
+    def _name(self, h: int) -> str:
+        return f"{self.ns}/{h:016x}"
+
+    def put(self, h: int, data: bytes) -> None:
+        self._run(self.plane.object_put(self.BUCKET, self._name(h), data))
+
+    def get(self, h: int):
+        return self._run(self.plane.object_get(self.BUCKET, self._name(h)))
+
+    def delete(self, h: int) -> None:
+        self._run(self.plane.object_delete(self.BUCKET, self._name(h)))

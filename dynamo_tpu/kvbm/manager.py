@@ -20,19 +20,35 @@ from typing import Optional
 
 import numpy as np
 
-from dynamo_tpu.kvbm.tiers import DiskTier, HostTier
+from dynamo_tpu.kvbm.tiers import DiskTier, HostTier, RemoteTier
 
 logger = logging.getLogger("dynamo.kvbm")
 
 
 class KvbmManager:
     """Thread-safe: disk promotion runs in worker threads while the engine's
-    event loop serves the host tier, so every tier access takes the lock."""
+    event loop serves the host tier, so every tier access takes the lock.
+
+    Tier order: G2 host DRAM → G3 disk → G4 remote object store (armed via
+    :meth:`attach_remote` after the runtime connects). G4 I/O never runs
+    under the lock: mutating methods queue remote put/delete ops and drain
+    them after release — put()/get() callers are worker threads (the engine
+    offload/onboard paths run in asyncio.to_thread), so the drain's
+    blocking round-trips are safe there."""
 
     def __init__(self, host_bytes: int, disk_dir: Optional[str] = None,
                  disk_bytes: int = 0, on_change=None):
         self.host = HostTier(host_bytes)
         self.disk = DiskTier(disk_dir, disk_bytes) if (disk_dir and disk_bytes) else None
+        self.remote: Optional[RemoteTier] = None
+        self._remote_ops: list = []  # (op, hash, payload|None), lock-guarded
+        #: hashes whose G4 put is queued but not yet written: fetches must
+        #: treat them as misses WITHOUT discarding the index entry, or the
+        #: later write leaks an orphaned object
+        self._pending_puts: set = set()
+        #: serializes drains end-to-end so a delete queued after a put can
+        #: never execute before it (two offload threads draining)
+        self._drain_lock = threading.Lock()
         self.offloaded_blocks = 0
         self.onboarded_blocks = 0
         self._lock = threading.Lock()
@@ -54,15 +70,62 @@ class KvbmManager:
             except Exception:
                 logger.exception("kvbm on_change callback failed")
 
+    def attach_remote(self, client, capacity_bytes: int = 0) -> None:
+        """Arm the G4 tier (ref: block_manager.rs:62-75 CacheLevel::G4).
+        Called after runtime startup — the engine is constructed before the
+        control plane connects, so the object-store client arrives late."""
+        with self._lock:
+            self.remote = RemoteTier(client, capacity_bytes)
+
+    def _drain_remote(self) -> None:
+        """Perform queued G4 I/O. MUST be called WITHOUT the lock held."""
+        with self._drain_lock:
+            while True:
+                with self._lock:
+                    if not self._remote_ops or self.remote is None:
+                        return
+                    op, h, payload = self._remote_ops.pop(0)
+                    client = self.remote.client
+                failed = False
+                try:
+                    if op == "put":
+                        client.put(h, payload)
+                    else:
+                        client.delete(h)
+                except Exception:
+                    logger.exception("kvbm G4 %s failed for %x", op, h)
+                    failed = True
+                if op == "put":
+                    with self._lock:
+                        self._pending_puts.discard(h)
+                        if failed and self.remote is not None:
+                            self.remote.discard(h)
+                            self._notify_if_gone(h)
+
+    def _notify_if_gone(self, h: int) -> None:
+        """Announce removal when ``h`` left its LAST tier (lock held) —
+        a silent drop would leave the distributed leader's map stale."""
+        if h not in self.host and (self.disk is None or h not in self.disk):
+            self._notify([], [h])
+
     # -- queries -------------------------------------------------------------
 
     def __contains__(self, h: int) -> bool:
         with self._lock:
-            return h in self.host or (self.disk is not None and h in self.disk)
+            return (h in self.host
+                    or (self.disk is not None and h in self.disk)
+                    or (self.remote is not None and h in self.remote))
 
     def in_disk(self, h: int) -> bool:
         with self._lock:
             return self.disk is not None and h in self.disk
+
+    def in_lower_tier(self, h: int) -> bool:
+        """Resident below host (G3 disk or G4 remote) — the admission path
+        schedules a background promotion for these instead of blocking."""
+        with self._lock:
+            return ((self.disk is not None and h in self.disk)
+                    or (self.remote is not None and h in self.remote))
 
     def match_prefix(self, seq_hashes: list[int]) -> int:
         """Longest leading run of hashes resident in any tier."""
@@ -82,6 +145,7 @@ class KvbmManager:
             self.offloaded_blocks += 1
             removed = self._cascade(self.host.put(h, k, v))
             self._notify([h], removed)
+        self._drain_remote()
 
     def resident_hashes(self) -> list[int]:
         """Host-tier contents snapshot (for fleet-join announcements)."""
@@ -89,20 +153,45 @@ class KvbmManager:
             return list(self.host._store)
 
     def _cascade(self, host_evicted) -> list[int]:
-        """Push host evictions into disk; return hashes gone from ALL tiers.
-        Caller holds the lock. Disk evictions are checked against the host
-        tier: a get()-promoted block lives in both, and evicting its disk
-        copy must not report the block removed while host still serves it."""
+        """Push host evictions down the tiers (G2→G3→G4); return hashes
+        gone from ALL tiers. Caller holds the lock. Evictions out of a
+        deeper tier are checked against the shallower ones: a promoted
+        block lives in several tiers at once, and evicting one copy must
+        not report the block removed while another still serves it.
+        Remote writes/deletes only QUEUE here (drained outside the lock)."""
         removed: list[int] = []
         for eh, ek, ev in host_evicted:
             if self.disk is not None:
-                removed.extend(h for h in self.disk.put(eh, ek, ev)
-                               if h not in self.host)
+                for d in self.disk.put(eh, ek, ev,
+                                       capture=self.remote is not None):
+                    if isinstance(d, tuple):
+                        removed.extend(self._to_remote(*d))
+                    elif d not in self.host:
+                        removed.append(d)
                 if eh not in self.disk:  # too big for the disk budget
                     removed.append(eh)
+            elif self.remote is not None:
+                removed.extend(self._to_remote(eh, ek, ev))
             else:
                 removed.append(eh)
         return removed
+
+    def _to_remote(self, h: int, k: np.ndarray, v: np.ndarray) -> list[int]:
+        """Queue a G4 write (lock held); returns hashes LRU-evicted out of
+        every tier by the G4 budget."""
+        from dynamo_tpu.kvbm.tiers import RemoteTier
+
+        payload = RemoteTier.encode(k, v)
+        gone = []
+        for rh in self.remote.reserve(h, len(payload)):
+            self._remote_ops.append(("delete", rh, None))
+            self._pending_puts.discard(rh)
+            if rh not in self.host and (self.disk is None
+                                        or rh not in self.disk):
+                gone.append(rh)
+        self._remote_ops.append(("put", h, payload))
+        self._pending_puts.add(h)
+        return gone
 
     # -- runtime controller surface (ref: block_manager/controller.rs) -------
 
@@ -112,7 +201,11 @@ class KvbmManager:
             self.host.clear()
             if self.disk is not None:
                 self.disk.clear()
+            if self.remote is not None:
+                self._remote_ops.extend(
+                    ("delete", h, None) for h in self.remote.clear())
             self._notify([], None)
+        self._drain_remote()
 
     def resize_host(self, capacity_bytes: int) -> None:
         """Change the host-tier byte budget at runtime; shrinking evicts LRU
@@ -122,6 +215,7 @@ class KvbmManager:
             removed = self._cascade(
                 self.host.evict_to_capacity(self.host.capacity))
             self._notify([], removed)
+        self._drain_remote()
 
     # -- onboard (G2/G3 → caller) --------------------------------------------
 
@@ -143,8 +237,38 @@ class KvbmManager:
                     # like any other, or the leader's map goes stale
                     removed = self._cascade(self.host.put(h, e[0], e[1]))
                     self._notify([], removed)
-                    return e
+            # a queued-but-unwritten put must read as a MISS without
+            # discarding the index entry (the write is still coming)
+            hit_remote = (e is None and self.remote is not None
+                          and h in self.remote
+                          and h not in self._pending_puts)
+            client = self.remote.client if hit_remote else None
+        if e is not None or not hit_remote:
+            self._drain_remote()  # a promotion may have queued G4 writes
+            return e
+        # G4 fetch OUTSIDE the lock (network round trip); the index entry
+        # may race an eviction — a miss is handled like any cold block
+        try:
+            data = client.get(h)
+        except Exception:
+            logger.exception("kvbm G4 fetch failed for %x", h)
+            data = None
+        if data is None:
+            with self._lock:
+                if (self.remote is not None
+                        and h not in self._pending_puts):
+                    self.remote.discard(h)
+                    self._notify_if_gone(h)
             return None
+        from dynamo_tpu.kvbm.tiers import RemoteTier
+
+        k, v = RemoteTier.decode(data)
+        with self._lock:
+            self.remote.touch(h)
+            removed = self._cascade(self.host.put(h, k, v))
+            self._notify([], removed)
+        self._drain_remote()
+        return k, v
 
     def stats(self) -> dict:
         return {
@@ -152,6 +276,8 @@ class KvbmManager:
             "host_bytes": self.host.used,
             "disk_blocks": len(self.disk) if self.disk is not None else 0,
             "disk_bytes": self.disk.used if self.disk is not None else 0,
+            "remote_blocks": len(self.remote) if self.remote is not None else 0,
+            "remote_bytes": self.remote.used if self.remote is not None else 0,
             "offloaded_blocks": self.offloaded_blocks,
             "onboarded_blocks": self.onboarded_blocks,
         }

@@ -96,8 +96,11 @@ class DiskTier:
     def __len__(self) -> int:
         return len(self._index)
 
-    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> list[int]:
-        """Insert; returns hashes evicted out of the tier entirely."""
+    def put(self, h: int, k: np.ndarray, v: np.ndarray,
+            capture: bool = False) -> list:
+        """Insert; returns hashes evicted out of the tier entirely —
+        as (h, k, v) tuples when ``capture`` (a deeper tier wants the
+        bytes; the file is read back before the unlink), else bare ints."""
         if h in self._index:
             self._index.move_to_end(h)
             return []
@@ -106,9 +109,12 @@ class DiskTier:
             return []  # can never fit: drop without flushing the tier
         evicted = []
         while self._index and self.used + size > self.capacity:
-            eh, esize = self._index.popitem(last=False)
+            eh = next(iter(self._index))
+            entry = self.get(eh) if capture else None  # a failed read
+            # already dropped eh from the index (and used) — pop defaults
+            esize = self._index.pop(eh, 0)
             self.used -= esize
-            evicted.append(eh)
+            evicted.append((eh, *entry) if entry is not None else eh)
             try:
                 os.unlink(self._path(eh))
             except OSError:
@@ -136,7 +142,9 @@ class DiskTier:
                 v = z["v"].view(dtype).reshape(tuple(z["v_shape"]))
         except Exception:
             logger.exception("disk tier read failed for %x", h)
-            self._index.pop(h, None)
+            n = self._index.pop(h, None)
+            if n is not None:
+                self.used -= n
             return None
         self._index.move_to_end(h)
         return k, v
@@ -149,3 +157,87 @@ class DiskTier:
                 pass
         self._index.clear()
         self.used = 0
+
+
+class RemoteTier:
+    """G4: object-store-backed remote block store (ref: lib/llm/src/
+    block_manager.rs:62-75 ``CacheLevel::G4`` — the reference backs it with
+    NIXL FS/S3 plugins; here the control plane's object store is the
+    backend, the same one radix snapshots ride).
+
+    This class is only the INDEX (hash → byte size, dict order = LRU) plus
+    the wire codec. Remote I/O goes through ``client`` and must happen
+    OUTSIDE the KvbmManager lock — the manager queues put/delete ops under
+    the lock and drains them after release (see ``KvbmManager._drain_remote``),
+    so admission-path lock holders never wait on a network round trip.
+    """
+
+    def __init__(self, client, capacity_bytes: int = 0):
+        self.client = client
+        self.capacity = int(capacity_bytes)  # 0 = unbounded
+        self._index: "OrderedDict[int, int]" = OrderedDict()
+        self.used = 0
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def reserve(self, h: int, nbytes: int) -> list[int]:
+        """Record ``h`` as (about to be) remote; returns LRU-evicted hashes
+        the caller must delete remotely. Caller holds the manager lock."""
+        if h in self._index:
+            self._index.move_to_end(h)
+            return []
+        self._index[h] = nbytes
+        self.used += nbytes
+        evicted = []
+        if self.capacity > 0:
+            while self.used > self.capacity and len(self._index) > 1:
+                eh, en = self._index.popitem(last=False)
+                self.used -= en
+                evicted.append(eh)
+        return evicted
+
+    def discard(self, h: int) -> None:
+        n = self._index.pop(h, None)
+        if n is not None:
+            self.used -= n
+
+    def touch(self, h: int) -> None:
+        if h in self._index:
+            self._index.move_to_end(h)
+
+    def clear(self) -> list[int]:
+        out = list(self._index)
+        self._index.clear()
+        self.used = 0
+        return out
+
+    # -- wire codec (shape/dtype header + raw pages) --------------------------
+
+    @staticmethod
+    def encode(k: np.ndarray, v: np.ndarray) -> bytes:
+        import json as _json
+        import struct as _struct
+
+        hdr = _json.dumps({"ks": k.shape, "kd": str(k.dtype),
+                           "vs": v.shape, "vd": str(v.dtype)}).encode()
+        return (_struct.pack("<I", len(hdr)) + hdr
+                + np.ascontiguousarray(k).tobytes()
+                + np.ascontiguousarray(v).tobytes())
+
+    @staticmethod
+    def decode(data: bytes) -> tuple[np.ndarray, np.ndarray]:
+        import json as _json
+        import struct as _struct
+
+        (n,) = _struct.unpack_from("<I", data)
+        hdr = _json.loads(data[4:4 + n].decode())
+        k_dt, v_dt = np.dtype(hdr["kd"]), np.dtype(hdr["vd"])
+        k_n = int(np.prod(hdr["ks"])) * k_dt.itemsize
+        off = 4 + n
+        k = np.frombuffer(data[off:off + k_n], k_dt).reshape(hdr["ks"])
+        v = np.frombuffer(data[off + k_n:], v_dt).reshape(hdr["vs"])
+        return k, v

@@ -210,6 +210,9 @@ class ControlPlane(abc.ABC):
     async def object_get(self, bucket: str, name: str) -> Optional[bytes]: ...
 
     @abc.abstractmethod
+    async def object_delete(self, bucket: str, name: str) -> None: ...
+
+    @abc.abstractmethod
     async def close(self) -> None: ...
 
 
@@ -491,6 +494,9 @@ class LocalControlPlane(ControlPlane):
     async def object_get(self, bucket, name):
         return self._objects.get((bucket, name))
 
+    async def object_delete(self, bucket, name):
+        self._objects.pop((bucket, name), None)
+
     async def close(self):
         self._closed = True
         if self._sweeper:
@@ -683,6 +689,8 @@ class _ServerConn:
             await core.object_put(m["bucket"], m["name"], m["data"])
         elif op == "object_get":
             return await core.object_get(m["bucket"], m["name"])
+        elif op == "object_delete":
+            await core.object_delete(m["bucket"], m["name"])
         else:
             raise ValueError(f"unknown op {op}")
         return None
@@ -1084,6 +1092,9 @@ class RemoteControlPlane(ControlPlane):
 
     async def object_get(self, bucket, name):
         return await self._call("object_get", bucket=bucket, name=name)
+
+    async def object_delete(self, bucket, name):
+        await self._call("object_delete", bucket=bucket, name=name)
 
     async def close(self):
         self._closed = True

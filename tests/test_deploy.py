@@ -208,3 +208,163 @@ async def test_operator_restarts_on_command_change(tmp_path):
         assert op.replicas["work"][0].proc.pid != pid_before
     finally:
         await op.stop()
+
+
+async def test_kubectl_contract_full_surface(tmp_path, monkeypatch):
+    """The k8s path with a REAL subprocess against a fake kubectl binary
+    (r2 verdict #10: no cluster in this environment, so the full CLI/JSON
+    surface is pinned by contract): CRD + recipe manifests apply, the
+    connector's merge patches mutate the stored resource, reads observe
+    them, and the recorded argv sequence is exactly what a cluster would
+    receive."""
+    import subprocess
+
+    import yaml
+
+    state = tmp_path / "k8s-state.json"
+    log = tmp_path / "kubectl-argv.jsonl"
+    fake = tmp_path / "bin" / "kubectl"
+    fake.parent.mkdir()
+    fake.write_text(f"""#!{sys.executable}
+import json, sys, yaml
+STATE, LOG = {str(state)!r}, {str(log)!r}
+args = sys.argv[1:]
+open(LOG, "a").write(json.dumps(args) + "\\n")
+try:
+    store = json.load(open(STATE))
+except FileNotFoundError:
+    store = {{}}
+
+def merge(dst, src):
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            merge(dst[k], v)
+        else:
+            dst[k] = v
+
+ns = "default"
+if args[:1] == ["-n"]:
+    ns, args = args[1], args[2:]
+cmd = args[0]
+if cmd == "apply" and args[1] == "-f":
+    for doc in yaml.safe_load_all(open(args[2])):
+        if not doc:
+            continue
+        key = f"{{ns}}/{{doc['kind'].lower()}}/{{doc['metadata']['name']}}"
+        store[key] = doc
+        print(f"{{doc['kind'].lower()}}/{{doc['metadata']['name']}} configured")
+elif cmd == "patch":
+    key = f"{{ns}}/{{args[1]}}/{{args[2]}}"
+    assert args[3:5] == ["--type", "merge"], args
+    assert args[5] == "-p"
+    if key not in store:
+        print(f"Error: {{args[1]}} {{args[2]}} not found"); sys.exit(1)
+    merge(store[key], json.loads(args[6]))
+    print("patched")
+elif cmd == "get":
+    key = f"{{ns}}/{{args[1]}}/{{args[2]}}"
+    assert args[3:5] == ["-o", "json"], args
+    if key not in store:
+        print("NotFound"); sys.exit(1)
+    print(json.dumps(store[key]))
+else:
+    print(f"unknown command {{cmd}}"); sys.exit(1)
+json.dump(store, open(STATE, "w"))
+""")
+    fake.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{fake.parent}:{os.environ['PATH']}")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    crd = os.path.join(repo, "deploy", "recipes", "k8s", "crd.yaml")
+    gke = os.path.join(repo, "deploy", "recipes", "k8s",
+                       "llama3-70b-gke.yaml")
+    graph = os.path.join(repo, "deploy", "recipes",
+                         "llama3-70b-v5e64-disagg.yaml")
+    # the real yamls (CRD + raw GKE resources + the graph CR) apply
+    # cleanly through the fake cluster
+    for f in (crd, gke, graph):
+        r = subprocess.run(["kubectl", "-n", "serving", "apply", "-f", f],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+    # the graph resource's kind matches the CRD it rides on
+    crd_doc = next(iter(yaml.safe_load_all(open(crd))))
+    graph_doc = next(iter(yaml.safe_load_all(open(graph))))
+    assert graph_doc["kind"] == crd_doc["spec"]["names"]["kind"]
+    graph_name = graph_doc["metadata"]["name"]
+
+    # the connector's DEFAULT runner (real kubectl subprocess) scales it
+    c = KubernetesConnector(graph_name, k8s_namespace="serving")
+    await c.apply(Decision(prefill_replicas=4, decode_replicas=12))
+    got = await c.read_replicas()
+    assert got and got.get(c.prefill_service) == 4
+    assert got.get(c.decode_service) == 12
+
+    # pin the exact wire surface the cluster saw
+    argvs = [json.loads(line) for line in open(log)]
+    patch_argv = next(a for a in argvs if "patch" in a)
+    assert patch_argv[:6] == ["-n", "serving", "patch",
+                              "dynamographdeployment", graph_name, "--type"]
+    assert json.loads(patch_argv[-1]) == {"spec": {"services": {
+        "prefill": {"replicas": 4}, "decode": {"replicas": 12}}}}
+    get_argv = argvs[-1]
+    assert get_argv == ["-n", "serving", "get", "dynamographdeployment",
+                        graph_name, "-o", "json"]
+
+
+async def test_operator_scale_down_revokes_leases(tmp_path):
+    """The reference's etcd-cleanup-on-scale-down contract: killing a
+    replica must revoke its leases so discovery forgets the instance
+    (ref: deploy/cloud/operator — here it falls out of lease semantics)."""
+    from dynamo_tpu.runtime.control_plane import ControlPlaneServer
+
+    server = ControlPlaneServer(port=0)
+    addr = await server.start()
+    worker_py = (
+        "import asyncio\n"
+        "from dynamo_tpu.runtime import DistributedRuntime\n"
+        "async def main():\n"
+        "    rt = await DistributedRuntime.create()\n"
+        "    ep = rt.namespace('prod').component('w').endpoint('gen')\n"
+        "    async def h(req, ctx):\n"
+        "        yield {}\n"
+        "    await ep.serve_endpoint(h)\n"
+        "    await asyncio.sleep(120)\n"
+        "asyncio.run(main())\n")
+    spec = str(tmp_path / "graph.yaml")
+    write_spec(spec, {"w": {
+        "replicas": 2, "command": [sys.executable, "-c", worker_py],
+        "env": {"DYN_CONTROL_PLANE": addr,
+                "PYTHONPATH": os.pathsep.join(sys.path)}}})
+
+    from dynamo_tpu.runtime import DistributedRuntime
+    os.environ["DYN_CONTROL_PLANE"] = addr
+    try:
+        rt = await DistributedRuntime.create()
+        client = await rt.namespace("prod").component("w").endpoint(
+            "gen").client().start()
+        op = ProcessOperator(spec, tick_s=0.1)
+        op.reconcile_once()
+        for _ in range(200):
+            if len(client.instance_ids()) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(client.instance_ids()) == 2
+
+        write_spec(spec, {"w": {
+            "replicas": 1, "command": [sys.executable, "-c", worker_py],
+            "env": {"DYN_CONTROL_PLANE": addr,
+                    "PYTHONPATH": os.pathsep.join(sys.path)}}})
+        os.utime(spec, (time.time() + 2, time.time() + 2))
+        op.reconcile_once()
+        # the killed replica's disconnect revokes its lease → discovery
+        # forgets the instance without any explicit cleanup call
+        for _ in range(200):
+            if len(client.instance_ids()) == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert len(client.instance_ids()) == 1
+        await op.stop()
+        await rt.shutdown()
+    finally:
+        os.environ.pop("DYN_CONTROL_PLANE", None)
+        await server.stop()

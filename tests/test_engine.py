@@ -466,3 +466,30 @@ async def test_decode_batch_capped_at_largest_bucket():
     for toks, reason in results:
         assert len(toks) == 4 and reason == FinishReason.LENGTH
     await eng.close()
+
+
+@pytest.mark.parametrize("arch", ["mla_tiny", "gptoss_tiny", "moe_tiny"])
+async def test_engine_embed_all_families(arch):
+    """/v1/embeddings backing path must work for EVERY served family —
+    MLA, gpt-oss (windows+sinks), MoE — via the serving forward (r2
+    verdict #8: the dense-only embedding_forward refused these)."""
+    from dynamo_tpu import models
+
+    cfg = models.get_model_config(arch)
+    args = EngineArgs(block_size=4, num_blocks=128, max_num_seqs=4,
+                      max_num_batched_tokens=64, max_model_len=128)
+    eng = AsyncJaxEngine(cfg, args)
+    try:
+        a = list(range(1, 9))
+        b = list(range(20, 45))
+        v_joint = await eng.embed([a, b])
+        v_solo = await eng.embed([a])
+        assert abs(float(np.linalg.norm(v_joint[0])) - 1.0) < 1e-4
+        # padding/batch invariance: same input, same vector
+        np.testing.assert_allclose(np.asarray(v_joint[0]),
+                                   np.asarray(v_solo[0]),
+                                   atol=2e-4, rtol=2e-4)
+        assert abs(float(np.dot(np.asarray(v_joint[0]),
+                                np.asarray(v_joint[1])))) < 0.999
+    finally:
+        await eng.close()
