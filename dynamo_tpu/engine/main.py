@@ -248,13 +248,14 @@ async def amain():
     if cli._mh_world > 1 and cli._mh_rank > 0:
         # follower rank: replay the leader's step stream in SPMD lockstep —
         # no endpoints, no registration; the leader owns the serving surface.
-        # Check in at the barrier only AFTER subscribing: a step published
-        # before the subscription exists is lost, and a gapped stream is an
-        # unrecoverable desync.
+        # Check in at the barrier only AFTER the stream endpoint is
+        # advertised: the leader dials every registered follower right
+        # after the barrier, before its first step.
         from dynamo_tpu.parallel.multihost import StepFollower
         from dynamo_tpu.runtime.barrier import LeaderWorkerBarrier
         follower = await StepFollower(engine, runtime.plane,
-                                      cli.namespace).start()
+                                      cli.namespace).start(
+            lease_id=await runtime.primary_lease())
         barrier = LeaderWorkerBarrier(
             runtime.plane, f"mh/{cli.namespace}/{cli.model}",
             lease_id=await runtime.primary_lease())
@@ -273,11 +274,15 @@ async def amain():
         # steps would be lost and wedge the first cross-host collective
         from dynamo_tpu.parallel.multihost import StepBroadcaster
         from dynamo_tpu.runtime.barrier import LeaderWorkerBarrier
-        engine.broadcast_cb = StepBroadcaster(runtime.plane, cli.namespace)
+        bcast = StepBroadcaster(runtime.plane, cli.namespace)
+        engine.broadcast_cb = bcast
         barrier = LeaderWorkerBarrier(
             runtime.plane, f"mh/{cli.namespace}/{cli.model}",
             lease_id=await runtime.primary_lease())
         await barrier.leader_enter(b"1", cli._mh_world - 1)
+        # every follower checked in → its stream endpoint is registered;
+        # dial the DIRECT connections before the first step ships
+        await bcast.connect(expect=cli._mh_world - 1)
 
     lease = await runtime.primary_lease()
     engine.dp_rank = cli.dp_rank

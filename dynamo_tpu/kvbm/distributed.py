@@ -330,7 +330,10 @@ class RemoteKvbm:
                                                 instance_id=wid)
                     async for frame in recv:
                         h, k, v = _unpack_block(frame)
-                        self.manager.put(h, k, v)
+                        # off the loop: with G4 armed, put() drains remote
+                        # ops whose client blocks on coroutines scheduled
+                        # onto THIS loop (self-deadlock inline)
+                        await asyncio.to_thread(self.manager.put, h, k, v)
                         got.add(h)
                         landed += 1
                 except Exception:

@@ -166,10 +166,15 @@ class KvbmManager:
                                        capture=self.remote is not None):
                     if isinstance(d, tuple):
                         removed.extend(self._to_remote(*d))
-                    elif d not in self.host:
+                    elif d not in self.host and (
+                            self.remote is None or d not in self.remote):
                         removed.append(d)
-                if eh not in self.disk:  # too big for the disk budget
-                    removed.append(eh)
+                if eh not in self.disk:  # too big for the disk budget:
+                    # G4 (unbounded-entry object store) still takes it
+                    if self.remote is not None:
+                        removed.extend(self._to_remote(eh, ek, ev))
+                    else:
+                        removed.append(eh)
             elif self.remote is not None:
                 removed.extend(self._to_remote(eh, ek, ev))
             else:

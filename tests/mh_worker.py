@@ -83,6 +83,7 @@ async def main():
         bcast = StepBroadcaster(plane)
         eng.broadcast_cb = bcast
         await wait_kv(plane, "mh/ready")
+        await bcast.connect(expect=1)  # direct stream to the follower
 
         req = PreprocessedRequest(
             model="t", token_ids=list(range(1, 13)),

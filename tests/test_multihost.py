@@ -87,3 +87,57 @@ async def test_two_process_global_mesh_lockstep(unused_tcp_port_factory=None):
     # multi-host sharding must not change the numerics
     ref = await _single_process_reference()
     assert toks == ref
+
+
+async def test_step_stream_direct_zero_hub_traffic():
+    """Step replication rides DIRECT leader→follower TCP (r2 weak #4):
+    zero hub messages per step, in-order delivery, and a clean drain."""
+    import numpy as np
+
+    from dynamo_tpu.parallel.multihost import (
+        STEP_KEYS, StepBroadcaster, StepFollower,
+    )
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    rt = await DistributedRuntime.create()
+    plane = rt.plane
+    published = []
+    orig_publish = plane.publish
+
+    async def counting_publish(subject, payload):
+        published.append(subject)
+        return await orig_publish(subject, payload)
+
+    plane.publish = counting_publish
+
+    calls = []
+
+    class _EngStub:
+        params = None
+        k_cache = v_cache = None
+
+        def _put_batch(self, name, arr):
+            return arr
+
+        def step_fn(self, params, *args):
+            calls.append(args[0])  # tokens operand
+            return None, None, None
+
+    follower = await StepFollower(_EngStub(), plane).start()
+    bcast = StepBroadcaster(plane)
+    await bcast.connect(expect=1)
+    N = 25
+    for i in range(N):
+        bcast("step", {k: np.full((2, 1), i, np.int32)
+                       for k in STEP_KEYS["step"]})
+    await bcast.stop()
+    for _ in range(200):
+        if follower.steps_replayed == N:
+            break
+        await asyncio.sleep(0.02)
+    assert follower.steps_replayed == N
+    # in dispatch order, and NOT via the hub
+    assert [int(c[0, 0]) for c in calls] == list(range(N))
+    assert published == []
+    await follower.stop()
+    await rt.shutdown()
