@@ -375,6 +375,17 @@ async def amain():
         embed_handle = await embed_ep.serve_endpoint(
             engine.embed_handler, lease_id=lease)
 
+    async def clear_kv_handler(request, ctx):
+        """Admin flush (ref: clear_kv_blocks.rs): device prefix cache +
+        every KVBM tier."""
+        engine.pool.clear()
+        if engine.kvbm is not None:
+            await asyncio.to_thread(engine.kvbm.clear)
+        yield {"ok": True, "message": "KV cache cleared"}
+
+    clear_handle = await ns.component(component).endpoint(
+        "clear_kv_blocks").serve_endpoint(clear_kv_handler, lease_id=lease)
+
     if cli.role == "prefill" and cli.prefill_queue:
         from dynamo_tpu.disagg.queue import (PrefillQueueWorker,
                                              engine_capacity_gate)
@@ -458,6 +469,7 @@ async def amain():
         await queue_worker.stop()
     if embed_handle is not None:
         await embed_handle.stop(graceful=False)
+    await clear_handle.stop(graceful=False)
     await handle.stop(graceful=True)
     await engine.close()
     await runtime.shutdown()

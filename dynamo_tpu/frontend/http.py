@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Optional
 
@@ -54,9 +55,22 @@ class HttpService:
         metrics: Optional[MetricsRegistry] = None,
         host: str = "0.0.0.0",
         port: int = 8000,
+        tls_cert_path: Optional[str] = None,
+        tls_key_path: Optional[str] = None,
     ):
         self.manager = manager
         self.metrics = metrics or MetricsRegistry()
+        #: optional TLS (ref: service_v2.rs:132 enable_tls/cert/key) —
+        #: both paths or neither
+        if bool(tls_cert_path) != bool(tls_key_path):
+            raise ValueError("TLS needs BOTH --tls-cert-path and "
+                             "--tls-key-path")
+        self.tls_cert_path = tls_cert_path
+        self.tls_key_path = tls_key_path
+        #: bearer token gating destructive admin routes (clear_kv_blocks);
+        #: unset = open, matching the reference's unauthenticated route —
+        #: set DYN_ADMIN_TOKEN (or --admin-token) on exposed binds
+        self.admin_token = os.environ.get("DYN_ADMIN_TOKEN")
         self.host = host
         self.port = port
         self._runner: Optional[web.AppRunner] = None
@@ -98,17 +112,28 @@ class HttpService:
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/live", self.handle_live)
         app.router.add_get("/metrics", self.handle_metrics)
+        # admin: flush every worker's KV cache/prefix state (ref:
+        # lib/llm/src/http/service/clear_kv_blocks.rs)
+        app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
         return app
 
     async def start(self) -> int:
         app = self.build_app()
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        ssl_ctx = None
+        if self.tls_cert_path:
+            import ssl
+
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(self.tls_cert_path, self.tls_key_path)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=ssl_ctx)
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]
-        logger.info("OpenAI HTTP frontend on %s:%d", self.host, self.port)
+        logger.info("OpenAI HTTP%s frontend on %s:%d",
+                    "S" if ssl_ctx else "", self.host, self.port)
         return self.port
 
     async def stop(self):
@@ -135,6 +160,30 @@ class HttpService:
     async def handle_models(self, request: web.Request) -> web.Response:
         data = [model_entry(m) for m in self.manager.list_models()]
         return web.json_response({"object": "list", "data": data})
+
+    async def handle_clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """POST /clear_kv_blocks — fan a cache flush to every worker of
+        every served model (ref: clear_kv_blocks.rs:28 — per-worker
+        cleared/failed accounting in the response)."""
+        if self.admin_token and (request.headers.get("authorization", "")
+                                 != f"Bearer {self.admin_token}"):
+            return web.json_response({"error": "unauthorized"}, status=401)
+        if not self.manager.list_models():
+            return web.json_response(
+                {"message": "No active worker groups found"})
+        cleared, failed = [], []
+        for name in self.manager.list_models():
+            served = self.manager.get(name)
+            try:
+                results = await served.clear_kv_blocks()
+            except Exception as e:  # noqa: BLE001 — per-model accounting
+                failed.append({"name": name, "error": str(e)})
+                continue
+            for r in results:
+                (cleared if r.get("status") == "cleared" else failed).append(
+                    {"name": name, **r})
+        return web.json_response(
+            {"cleared_workers": cleared, "failed_workers": failed})
 
     async def handle_health(self, request: web.Request) -> web.Response:
         models = self.manager.list_models()

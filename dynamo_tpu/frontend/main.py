@@ -22,6 +22,13 @@ async def amain():
     ap = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--tls-cert-path", default=None,
+                    help="serve HTTPS with this certificate chain (ref: "
+                         "service_v2.rs enable_tls)")
+    ap.add_argument("--tls-key-path", default=None)
+    ap.add_argument("--admin-token", default=None,
+                    help="bearer token required on destructive admin routes "
+                         "(/clear_kv_blocks); also via DYN_ADMIN_TOKEN")
     ap.add_argument("--router-mode", choices=["kv", "round_robin", "random"], default="kv")
     ap.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     ap.add_argument("--router-temperature", type=float, default=0.0)
@@ -52,7 +59,11 @@ async def amain():
             router_reset_states=args.router_reset_states,
         ),
     ).start()
-    service = HttpService(manager, host=args.host, port=args.port)
+    service = HttpService(manager, host=args.host, port=args.port,
+                          tls_cert_path=args.tls_cert_path,
+                          tls_key_path=args.tls_key_path)
+    if args.admin_token:
+        service.admin_token = args.admin_token
     await service.start()
     grpc_service = None
     if args.grpc_port:

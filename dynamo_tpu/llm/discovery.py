@@ -46,6 +46,8 @@ class ServedModel:
     entries: dict[str, ModelEntry] = field(default_factory=dict)  # key -> entry
     #: lazy client to the worker's "embed" endpoint (ref: openai.rs:714)
     embed_client: Optional[Client] = None
+    #: lazy client to the worker's "clear_kv_blocks" admin endpoint
+    clear_client: Optional[Client] = None
     _endpoint: Optional[object] = None
     _embed_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
@@ -68,10 +70,43 @@ class ServedModel:
             return frame.get("embeddings") or []
         raise RuntimeError("empty embeddings response")
 
+    async def clear_kv_blocks(self) -> list[dict]:
+        """Ask EVERY instance of the worker component to flush its KV
+        cache (ref: lib/llm/src/http/service/clear_kv_blocks.rs — the
+        admin route fans to each worker's clear endpoint)."""
+        async with self._embed_lock:
+            if self.clear_client is None:
+                ep = self._endpoint.component.endpoint("clear_kv_blocks")
+                self.clear_client = await ep.client().start()
+        client = self.clear_client
+        ids = list(client.instance_ids())
+        if not ids:
+            # a worker generation that never registered the admin endpoint
+            # must read as a FAILURE, not an empty success
+            return [{"status": "error",
+                     "error": "no clear_kv_blocks endpoint instances "
+                              "(worker predates the admin surface?)"}]
+        results = []
+        for iid in ids:
+            try:
+                stream = await client.generate({}, mode="direct",
+                                               instance_id=iid)
+                async for frame in stream:
+                    results.append({"instance": f"{iid:x}",
+                                    "status": "cleared",
+                                    "response": frame.get("message")})
+                    break
+            except Exception as e:  # noqa: BLE001 — per-worker status
+                results.append({"instance": f"{iid:x}",
+                                "status": "error", "error": str(e)})
+        return results
+
     async def stop(self):
         await self.client.stop()
         if self.embed_client:
             await self.embed_client.stop()
+        if self.clear_client:
+            await self.clear_client.stop()
         if self.router:
             await self.router.stop()
 

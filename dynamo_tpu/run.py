@@ -129,12 +129,23 @@ async def start_worker(runtime, out: str, cli):
     handle = await ep.serve_endpoint(handler.generate)
     embed_handle = await backend.endpoint("embed").serve_endpoint(
         engine.embed_handler)
+
+    async def clear_kv_handler(request, ctx):
+        """Admin flush (ref: clear_kv_blocks.rs): device prefix cache +
+        every KVBM tier."""
+        engine.pool.clear()
+        if engine.kvbm is not None:
+            await asyncio.to_thread(engine.kvbm.clear)
+        yield {"ok": True, "message": "KV cache cleared"}
+
+    clear_handle = await backend.endpoint("clear_kv_blocks").serve_endpoint(
+        clear_kv_handler)
     card = ModelDeploymentCard(
         display_name=cli.model, kv_cache_block_size=eargs.block_size,
         eos_token_ids=eos, tokenizer_ref=tokenizer_ref or "test")
     card.runtime_config.total_kv_blocks = engine.num_blocks
     await register_llm(runtime, ep, card)
-    handles = [handle, embed_handle]
+    handles = [handle, embed_handle, clear_handle]
     if mm_worker is not None:  # duck-typed: _stop_worker calls .stop()
         handles.append(mm_worker)
     return handles

@@ -60,6 +60,7 @@ async def test_kv_bundle_wire_roundtrip():
     assert b2.num_tokens == 11 and b2.block_size == 4
 
 
+@pytest.mark.slow
 async def test_disagg_matches_aggregated():
     """prefill_extract on engine A + generate_injected on engine B must equal
     engine C's aggregated generate, token for token."""
@@ -97,6 +98,7 @@ async def test_prefill_blocks_released_after_extract():
     await eng.close()
 
 
+@pytest.mark.slow
 async def test_handlers_end_to_end_local_client():
     """PrefillWorkerHandler + DecodeWorkerHandler over a fake client."""
     pre = make_engine()
@@ -203,6 +205,7 @@ async def test_pipelined_disagg_matches_aggregated():
     await dec.close()
 
 
+@pytest.mark.slow
 async def test_pipelined_disagg_mismatch_falls_back_local():
     """A decode engine that can't place the chunks (block-size mismatch)
     must drain the stream and recompute locally — same tokens, no leak."""
@@ -298,6 +301,7 @@ async def test_prefill_extract_cancelled_releases_blocks():
     await eng.close()
 
 
+@pytest.mark.slow
 async def test_prefill_queue_dispatch_end_to_end():
     """Queued dispatch (r1 verdict item #7): decode enqueues a ticket, the
     prefill worker pops + claims, KV streams direct — tokens match

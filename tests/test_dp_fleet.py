@@ -15,7 +15,7 @@ import pytest
 from dynamo_tpu.runtime.barrier import BarrierError, LeaderWorkerBarrier
 from dynamo_tpu.runtime.control_plane import LocalControlPlane
 
-pytestmark = pytest.mark.anyio
+pytestmark = [pytest.mark.anyio, pytest.mark.slow]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PY = sys.executable

@@ -87,6 +87,7 @@ async def test_prefix_cache_reuse_and_consistency():
     await eng.close()
 
 
+@pytest.mark.slow
 async def test_concurrent_batch_matches_solo():
     prompts = [list(range(1, 10)), list(range(5, 30)), list(range(40, 48))]
     eng = tiny_engine(enable_prefix_caching=False)
@@ -248,6 +249,7 @@ async def test_pallas_attention_engine_equivalence():
     assert outs[0] == outs[1]
 
 
+@pytest.mark.slow
 async def test_multi_step_decode_equivalence():
     """K-step fused decode must reproduce the single-step token stream,
     greedy and seeded-sampling alike, including finish mid-burst."""
@@ -264,6 +266,7 @@ async def test_multi_step_decode_equivalence():
         assert got == want and gr == wr
 
 
+@pytest.mark.slow
 async def test_multi_step_decode_concurrent_batch():
     eng = tiny_engine(multi_step_decode=4)
     prompts = [list(range(1, 10)), list(range(5, 40)), list(range(2, 17))]
@@ -277,6 +280,7 @@ async def test_multi_step_decode_concurrent_batch():
     await solo.close()
 
 
+@pytest.mark.slow
 async def test_multi_step_decode_with_pallas_kernel():
     """Burst path + Pallas kernel (interpret on CPU) matches the XLA path."""
     prompt = list(range(1, 30))

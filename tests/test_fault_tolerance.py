@@ -16,7 +16,7 @@ import socket
 
 import pytest
 
-pytestmark = pytest.mark.anyio
+pytestmark = [pytest.mark.anyio, pytest.mark.slow]
 
 
 def free_port() -> int:

@@ -266,3 +266,91 @@ async def test_responses_endpoint(stack):
         async with http.post(f"{base}/v1/responses",
                              json={"model": MODEL, "input": []}) as r:
             assert r.status == 400
+
+
+async def test_clear_kv_blocks_admin(stack):
+    """POST /clear_kv_blocks fans to every worker's clear endpoint and
+    reports per-worker status (ref: http/service/clear_kv_blocks.rs)."""
+    import aiohttp
+
+    rt, service, add_mocker, manager = stack
+    await add_mocker()
+    await wait_for_model(manager)
+    sm = manager.get(MODEL)
+    cleared = {"n": 0}
+
+    async def clear_handler(request, ctx):
+        cleared["n"] += 1
+        yield {"ok": True, "message": "KV cache cleared"}
+
+    h = await sm._endpoint.component.endpoint(
+        "clear_kv_blocks").serve_endpoint(clear_handler)
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{service.port}/clear_kv_blocks")
+            d = await r.json()
+        assert cleared["n"] == 1
+        assert len(d["cleared_workers"]) == 1
+        assert d["cleared_workers"][0]["status"] == "cleared"
+        assert d["failed_workers"] == []
+    finally:
+        await h.stop(graceful=False)
+
+
+async def test_clear_kv_blocks_no_models():
+    import aiohttp
+
+    service = HttpService(ModelManager(), port=0)
+    await service.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{service.port}/clear_kv_blocks")
+            assert (await r.json())["message"] == "No active worker groups found"
+    finally:
+        await service.stop()
+
+
+async def test_tls_serving(tmp_path):
+    """--tls-cert-path/--tls-key-path serve HTTPS (ref: service_v2.rs
+    enable_tls); mismatched args refuse."""
+    import ssl
+    import subprocess
+
+    import aiohttp
+
+    cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+    subprocess.run(["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                    "-nodes", "-keyout", key, "-out", cert, "-days", "1",
+                    "-subj", "/CN=localhost"], check=True,
+                   capture_output=True)
+    with pytest.raises(ValueError, match="BOTH"):
+        HttpService(ModelManager(), port=0, tls_cert_path=cert)
+    service = HttpService(ModelManager(), port=0,
+                          tls_cert_path=cert, tls_key_path=key)
+    await service.start()
+    try:
+        ctx = ssl.create_default_context(cafile=cert)
+        ctx.check_hostname = False
+        async with aiohttp.ClientSession() as s:
+            r = await s.get(f"https://127.0.0.1:{service.port}/live",
+                            ssl=ctx)
+            assert r.status == 200
+    finally:
+        await service.stop()
+
+
+async def test_clear_kv_blocks_admin_token(stack, monkeypatch):
+    """With DYN_ADMIN_TOKEN set, the destructive route needs the bearer."""
+    import aiohttp
+
+    rt, service, add_mocker, manager = stack
+    service.admin_token = "s3cret"
+    base = f"http://127.0.0.1:{service.port}"
+    async with aiohttp.ClientSession() as s:
+        r = await s.post(f"{base}/clear_kv_blocks")
+        assert r.status == 401
+        r = await s.post(f"{base}/clear_kv_blocks",
+                         headers={"Authorization": "Bearer s3cret"})
+        assert r.status == 200  # no models yet → message payload

@@ -119,6 +119,7 @@ def test_mla_logits_parity_vs_hf(hf_checkpoint):
                                        atol=2e-4, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_mla_decode_matches_full_prefill(hf_checkpoint):
     """Token-by-token decode through the paged latent cache reproduces the
     one-shot prefill logits (cache round-trip correctness)."""

@@ -130,6 +130,7 @@ def test_moe_ep_capacity_bounds_flops():
     assert moe_capacity(16, 8, 2, 2.0) == 16
 
 
+@pytest.mark.slow
 async def test_moe_engine_on_mesh_matches_single_device():
     """Greedy MoE generation through the engine on a tp=2 mesh (EP path)
     equals the single-device run when capacity is ample."""

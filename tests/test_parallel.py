@@ -48,6 +48,7 @@ def test_mesh_config_infer():
         MeshConfig.for_devices(8, tp=3)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_dense(causal):
     mesh = make_mesh(MeshConfig(dp=1, sp=8, tp=1))
@@ -57,6 +58,7 @@ def test_ring_attention_matches_dense(causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_kv_len_padding():
     mesh = make_mesh(MeshConfig(dp=1, sp=4, tp=1))
     q, k, v = _qkv(jax.random.key(1), S=32)
@@ -98,6 +100,7 @@ def test_ring_attention_dynamic_kv_len_single_trace():
     assert len(traces) == 1
 
 
+@pytest.mark.slow
 def test_ring_prefill_paged_matches_dense():
     """Engine-path ring: paged cache sharded gather + ring == dense attn."""
     import functools
@@ -148,6 +151,7 @@ def test_ring_prefill_paged_matches_dense():
                                    atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.anyio
 @pytest.mark.parametrize("max_model_len,prompt_len", [
     (256, 100),

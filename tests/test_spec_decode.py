@@ -93,6 +93,7 @@ async def test_greedy_invariance_random_prompt():
     await spec.close()
 
 
+@pytest.mark.slow
 async def test_spec_concurrent_batch_invariance():
     """Multiple concurrent greedy streams under spec decode equal their
     plain counterparts (batched verify, per-row acceptance)."""
