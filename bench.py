@@ -54,7 +54,7 @@ def _roofline(params, tok_s: float, reads_per_s: float, prefix: str) -> dict:
 
 # --------------------------------------------------------------- kernel phase
 
-def kernel_bench(on_tpu: bool) -> dict:
+def kernel_bench(on_tpu: bool, quantization=None) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -74,6 +74,11 @@ def kernel_bench(on_tpu: bool) -> dict:
     dtype = jnp.dtype(cfg.dtype)
 
     params = M.init_params(cfg, jax.random.key(0))
+    if quantization:
+        from dynamo_tpu.engine.quant import quantize_params
+
+        params = jax.device_put(quantize_params(
+            jax.tree.map(np.asarray, params), quantization))
     shape = (cfg.num_layers, num_blocks * block_size, cfg.num_kv_heads, cfg.head_dim)
     k_cache = jnp.zeros(shape, dtype)
     v_cache = jnp.zeros(shape, dtype)
@@ -108,9 +113,10 @@ def kernel_bench(on_tpu: bool) -> dict:
     int(toks[-1, 0])
     dt = time.perf_counter() - t0
     tok_s = B * K * iters / dt
-    return {"kernel_tok_s": round(tok_s, 1),
-            "kernel_shape": f"B={B},kv={kv_len},K={K}",
-            **_roofline(params, tok_s, iters * K / dt, "kernel")}
+    tag = "kernel" if not quantization else f"kernel_{quantization}"
+    return {f"{tag}_tok_s": round(tok_s, 1),
+            f"{tag}_shape": f"B={B},kv={kv_len},K={K}",
+            **_roofline(params, tok_s, iters * K / dt, tag)}
 
 
 # ------------------------------------------------------------------ e2e phase
@@ -316,6 +322,13 @@ def main():
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
         kern = kernel_bench(on_tpu)
+        try:
+            # int8 weights halve HBM weight traffic — the bandwidth-bound
+            # decode ceiling doubles; measure it alongside bf16 so the
+            # quantization win is on record whenever the chip is up
+            kern.update(kernel_bench(on_tpu, quantization="int8"))
+        except Exception as e:  # noqa: BLE001 — optional extra datum
+            kern["kernel_int8_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         out = {
             "metric": f"kernel_decode_tok_s_per_chip[{model},{platform},"

@@ -4,6 +4,7 @@ A tiny GGUF file is written in-test from the public spec, then parsed,
 mapped to ModelConfig, its tokenizer rebuilt, its tensors loaded, and the
 whole thing served through the engine for a greedy generate."""
 
+import asyncio
 import os
 import struct
 
@@ -322,19 +323,11 @@ def test_k_quants_match_scalar_reference():
                                    rtol=1e-5, atol=1e-6)
 
 
-def test_quantized_gguf_serves(tmp_path):
-    """A GGUF whose big matrices are q8_0 must load and produce logits
-    close to the f32 original through the real loader path."""
-    import jax.numpy as jnp
+def write_q8_gguf(f32_path: str, qpath: str, tensors: dict) -> None:
+    """Re-encode every (n, 32k)-shaped matrix of a written f32 GGUF as
+    q8_0 (shared by the loader test and the e2e serve test)."""
+    from dynamo_tpu.llm.gguf import GGML_Q8_0
 
-    from dynamo_tpu.llm.gguf import (
-        GGML_Q8_0, GGUFFile, config_from_gguf, load_gguf_params,
-    )
-
-    f32 = str(tmp_path / "f32.gguf")
-    tensors = write_tiny_gguf(f32)
-
-    # re-encode every (n, 32k)-shaped matrix as q8_0
     def q8(arr):
         rows = arr.reshape(-1, 32)
         d = np.abs(rows).max(axis=1, keepdims=True) / 127.0
@@ -344,11 +337,8 @@ def test_quantized_gguf_serves(tmp_path):
             [d.astype(np.float16).view(np.uint8), q.view(np.uint8)], axis=1)
         return blocks.tobytes()
 
-    qpath = str(tmp_path / "q8.gguf")
-    with open(f32, "rb") as f:
+    with open(f32_path, "rb") as f:
         head = f.read()
-    # rewrite: simplest valid approach — patch tensor data in place is
-    # fiddly; rebuild via the writer with a custom data section
     align, infos, data = 32, b"", b""
     for name, arr in tensors.items():
         pad = (-len(data)) % align
@@ -361,13 +351,28 @@ def test_quantized_gguf_serves(tmp_path):
         data += q8(arr) if quantize else arr.tobytes()
     # reuse the metadata bytes from the f32 file
     n_kv = struct.unpack("<Q", head[16:24])[0]
-    meta = head[24:g0_meta_end(f32)]
+    meta = head[24:g0_meta_end(f32_path)]
     header = b"GGUF" + struct.pack("<I", 3) + struct.pack(
         "<QQ", len(tensors), n_kv)
     body = header + meta + infos
     pad = (-len(body)) % align
     with open(qpath, "wb") as f:
         f.write(body + b"\0" * pad + data)
+
+
+def test_quantized_gguf_serves(tmp_path):
+    """A GGUF whose big matrices are q8_0 must load and produce logits
+    close to the f32 original through the real loader path."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.llm.gguf import (
+        GGUFFile, config_from_gguf, load_gguf_params,
+    )
+
+    f32 = str(tmp_path / "f32.gguf")
+    tensors = write_tiny_gguf(f32)
+    qpath = str(tmp_path / "q8.gguf")
+    write_q8_gguf(f32, qpath, tensors)
 
     g = GGUFFile.parse(qpath)
     cfg = config_from_gguf(g)
@@ -593,3 +598,83 @@ def test_rope_scaling_metadata():
         fake({"qwen2.rope.scaling.type": "none"})).rope_scaling is None
     with pytest.raises(NotImplementedError):
         config_from_gguf(fake({"qwen2.rope.scaling.type": "su"}))
+
+
+async def test_q8_gguf_http_serve_native_matches_dequant(tmp_path):
+    """E2E serve of a QUANTIZED artifact (r2 weak #6): the full HTTP stack
+    serves a q8_0 GGUF with weights resident int8 (native QTensors), and
+    greedy output is token-for-token identical to serving the same file
+    through the legacy dequantize-at-load path."""
+    import aiohttp
+
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+    from dynamo_tpu.engine import quant as Q
+    from dynamo_tpu.engine.config import EngineArgs
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    f32 = str(tmp_path / "f32.gguf")
+    tensors = write_tiny_gguf(f32)
+    qpath = str(tmp_path / "q8.gguf")
+    write_q8_gguf(f32, qpath, tensors)
+
+    rt = await DistributedRuntime.create()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager, router_mode="rr").start()
+    service = HttpService(manager, port=0)
+    await service.start()
+    engines, handles = [], []
+    try:
+        for name, env in (("g-native", None), ("g-dequant", "1")):
+            if env:
+                os.environ["DYN_GGUF_DEQUANT"] = env
+            try:
+                r = resolve_model(qpath)
+                cfg = r.config()
+                cfg.dtype = "float32"
+                params = r.load_params(cfg)
+            finally:
+                os.environ.pop("DYN_GGUF_DEQUANT", None)
+            qleaves = [v for v in params["layers"].values()
+                       if Q.is_qtensor(v)]
+            assert bool(qleaves) == (name == "g-native")
+            eng = AsyncJaxEngine(cfg, EngineArgs(
+                block_size=4, num_blocks=64, max_num_seqs=2,
+                max_num_batched_tokens=32, max_model_len=64), params=params)
+            engines.append(eng)
+            ep = rt.namespace("dynamo").component(name).endpoint("generate")
+            handles.append(await ep.serve_endpoint(
+                DecodeWorkerHandler(eng).generate))
+            card = ModelDeploymentCard(
+                display_name=name, kv_cache_block_size=4,
+                eos_token_ids=r.eos_token_ids(), tokenizer_ref=qpath,
+                context_length=64)
+            card.runtime_config.total_kv_blocks = eng.num_blocks
+            await register_llm(rt, ep, card)
+        for _ in range(100):
+            if len(manager.list_models()) == 2:
+                break
+            await asyncio.sleep(0.05)
+        outs = {}
+        async with aiohttp.ClientSession() as http:
+            for name in ("g-native", "g-dequant"):
+                resp = await http.post(
+                    f"http://127.0.0.1:{service.port}/v1/completions",
+                    json={"model": name, "prompt": "abc hi ab",
+                          "temperature": 0.0, "max_tokens": 8,
+                          "ignore_eos": True})
+                assert resp.status == 200, await resp.text()
+                body = await resp.json()
+                outs[name] = body["choices"][0]["text"]
+        assert outs["g-native"] == outs["g-dequant"]
+    finally:
+        await service.stop()
+        await watcher.stop()
+        for h in handles:
+            await h.stop(graceful=False)
+        for e in engines:
+            await e.close()
+        await rt.shutdown()
