@@ -629,6 +629,9 @@ async def test_q8_gguf_http_serve_native_matches_dequant(tmp_path):
     engines, handles = [], []
     try:
         for name, env in (("g-native", None), ("g-dequant", "1")):
+            # hermetic against a user-exported DYN_GGUF_DEQUANT: clear for
+            # the native arm, restore whatever was set afterward
+            saved = os.environ.pop("DYN_GGUF_DEQUANT", None)
             if env:
                 os.environ["DYN_GGUF_DEQUANT"] = env
             try:
@@ -638,6 +641,8 @@ async def test_q8_gguf_http_serve_native_matches_dequant(tmp_path):
                 params = r.load_params(cfg)
             finally:
                 os.environ.pop("DYN_GGUF_DEQUANT", None)
+                if saved is not None:
+                    os.environ["DYN_GGUF_DEQUANT"] = saved
             qleaves = [v for v in params["layers"].values()
                        if Q.is_qtensor(v)]
             assert bool(qleaves) == (name == "g-native")
