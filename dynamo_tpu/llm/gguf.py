@@ -46,6 +46,7 @@ GGML_F32, GGML_F16 = 0, 1
 GGML_BF16 = 30
 GGML_Q4_0, GGML_Q4_1, GGML_Q5_0, GGML_Q5_1, GGML_Q8_0 = 2, 3, 6, 7, 8
 GGML_Q2_K, GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 10, 11, 12, 13, 14
+GGML_IQ4_NL, GGML_IQ4_XS = 20, 23
 
 
 def _np_dtype(ggml_type: int):
@@ -246,6 +247,36 @@ def _deq_q6_k(b):
     return out
 
 
+#: iq4 nonlinear 4-bit codebook (ggml kvalues_iq4nl): importance-matrix
+#: exports map nibbles through this table instead of a linear grid
+_IQ4_VALUES = np.array([-127, -104, -83, -65, -49, -35, -22, -10,
+                        1, 13, 25, 38, 53, 69, 89, 113], np.float32)
+
+
+def _deq_iq4_nl(b):
+    """IQ4_NL: f16 scale + 16 nibble bytes per 32 values; low nibbles are
+    values 0..15, high nibbles 16..31, through the nonlinear codebook."""
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)  # [nb, 1]
+    return d * _IQ4_VALUES[_nibbles(b[:, 2:])]
+
+
+def _deq_iq4_xs(b):
+    """IQ4_XS superblock (256 values, 136 B): f16 d + u16 scales_h +
+    4 B scales_l + 128 B nibbles; per-32 sub-scale ls = low-nibble |
+    (2 bits of scales_h << 4), value = d·(ls−32)·codebook[nibble]."""
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)      # [nb, 1]
+    sh = b[:, 2:4].copy().view(np.uint16).astype(np.uint32)      # [nb, 1]
+    sl = b[:, 4:8]                                               # [nb, 4]
+    qs = b[:, 8:].reshape(len(b), 8, 16)                         # [nb, 8, 16]
+    ib = np.arange(8)
+    ls = (((sl[:, ib // 2] >> (4 * (ib % 2))) & 0xF)
+          | (((sh >> (2 * ib)) & 3) << 4)).astype(np.float32)    # [nb, 8]
+    dl = d * (ls - 32.0)
+    vals = np.concatenate([_IQ4_VALUES[qs & 0xF],
+                           _IQ4_VALUES[qs >> 4]], axis=2)        # [nb, 8, 32]
+    return (dl[:, :, None] * vals).reshape(len(b), 256)
+
+
 #: ggml_type → (bytes_per_block, values_per_block, dequant)
 GGML_QUANTS = {
     GGML_Q2_K: (84, 256, _deq_q2_k),
@@ -258,6 +289,8 @@ GGML_QUANTS = {
     GGML_Q4_K: (144, 256, _deq_q4_k),
     GGML_Q5_K: (176, 256, _deq_q5_k),
     GGML_Q6_K: (210, 256, _deq_q6_k),
+    GGML_IQ4_NL: (18, 32, _deq_iq4_nl),
+    GGML_IQ4_XS: (136, 256, _deq_iq4_xs),
 }
 
 
@@ -354,7 +387,8 @@ class GGUFFile:
                 raise NotImplementedError(
                     f"tensor {name}: ggml type {info.ggml_type} is not "
                     "supported (F32/F16/BF16 and "
-                    "Q4_0/Q4_1/Q5_0/Q5_1/Q8_0/Q2_K..Q6_K are)")
+                    "Q4_0/Q4_1/Q5_0/Q5_1/Q8_0/Q2_K..Q6_K/IQ4_NL/IQ4_XS "
+                    "are)")
             bpb, vpb, deq = quant
             # ggml blocks never span rows: the ROW length (ne[0], our last
             # dim) must be block-aligned — a total-count check would let a

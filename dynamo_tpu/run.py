@@ -105,9 +105,13 @@ async def start_worker(runtime, out: str, cli):
         from dynamo_tpu.models import get_model_config
         cfg = get_model_config(cli.arch)
         params = None
+    if cli.quantization:  # validate the spec BEFORE the heavy load
+        from dynamo_tpu.engine.quant import parse_spec
+        parse_spec(cli.quantization)
     eargs = EngineArgs(multi_step_decode=cli.multi_step_decode,
                        speculative_tokens=cli.speculative_tokens,
-                       use_pallas_attention=cli.use_pallas_attention)
+                       use_pallas_attention=cli.use_pallas_attention,
+                       quantization=cli.quantization)
     guided_vocab = None
     if tokenizer_ref:
         from dynamo_tpu.llm.tokenizer import load_guided_vocab
@@ -276,6 +280,9 @@ async def amain():
                     help="start a stub multimodal encode worker and resolve "
                          "image_url content parts against it")
     ap.add_argument("--use-pallas-attention", action="store_true")
+    ap.add_argument("--quantization", default=None,
+                    help="on-device weight quantization: int8 | int8-gN | "
+                         "int4-gN (weights stay quantized in HBM)")
     ap.add_argument("--vocab-size", type=int, default=0,
                     help="mocker vocab size (out=mocker only)")
     ap.add_argument("--input-file", default=None,

@@ -683,3 +683,52 @@ async def test_q8_gguf_http_serve_native_matches_dequant(tmp_path):
         for e in engines:
             await e.close()
         await rt.shutdown()
+
+
+def test_iq4_nl_and_xs_vs_scalar_spec():
+    """IQ4_NL / IQ4_XS (nonlinear-codebook 4-bit, the importance-matrix
+    export family) dequantize bit-identically to straight-from-spec scalar
+    implementations over random blocks."""
+    from dynamo_tpu.llm.gguf import (
+        GGML_IQ4_NL, GGML_IQ4_XS, GGML_QUANTS, _IQ4_VALUES,
+    )
+
+    rng = np.random.default_rng(11)
+
+    def scalar_iq4_nl(block: bytes) -> np.ndarray:
+        d = np.frombuffer(block[:2], np.float16)[0].astype(np.float32)
+        qs = np.frombuffer(block[2:], np.uint8)
+        out = np.empty(32, np.float32)
+        for j in range(16):
+            out[j] = d * _IQ4_VALUES[qs[j] & 0xF]
+            out[j + 16] = d * _IQ4_VALUES[qs[j] >> 4]
+        return out
+
+    def scalar_iq4_xs(block: bytes) -> np.ndarray:
+        d = np.frombuffer(block[:2], np.float16)[0].astype(np.float32)
+        sh = np.frombuffer(block[2:4], np.uint16)[0]
+        sl = np.frombuffer(block[4:8], np.uint8)
+        qs = np.frombuffer(block[8:], np.uint8)
+        out = np.empty(256, np.float32)
+        for ib in range(8):
+            ls = ((sl[ib // 2] >> (4 * (ib % 2))) & 0xF) | (
+                ((sh >> (2 * ib)) & 3) << 4)
+            dl = d * (float(ls) - 32.0)
+            for j in range(16):
+                q = qs[16 * ib + j]
+                out[32 * ib + j] = dl * _IQ4_VALUES[q & 0xF]
+                out[32 * ib + j + 16] = dl * _IQ4_VALUES[q >> 4]
+        return out
+
+    for gtype, scalar, bpb, vpb in ((GGML_IQ4_NL, scalar_iq4_nl, 18, 32),
+                                    (GGML_IQ4_XS, scalar_iq4_xs, 136, 256)):
+        raw = rng.integers(0, 256, (4, bpb), dtype=np.uint8)
+        # keep the f16 scale finite
+        half = np.frombuffer(
+            np.full(4, 0.02, np.float16).tobytes(), np.uint8).reshape(4, 2)
+        raw[:, 0:2] = half
+        _, _, deq = GGML_QUANTS[gtype]
+        got = deq(raw)
+        for i in range(4):
+            np.testing.assert_array_equal(
+                got[i], scalar(raw[i].tobytes()), err_msg=str(gtype))
