@@ -40,9 +40,18 @@ def _roofline(params, tok_s: float, reads_per_s: float, prefix: str) -> dict:
     MFU numerator covers the same window as the traffic numerator."""
     import jax
 
-    leaves = jax.tree.leaves(params)
-    n_params = sum(x.size for x in leaves)
-    params_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
+    n_params = 0
+    params_bytes = 0
+    for path, x in jax.tree_util.tree_leaves_with_path(params):
+        key = getattr(path[-1], "key", None) if path else None
+        # TPU HBM packs two int4 weights per byte (quant.py); itemsize
+        # reports 1, which would overstate hbm_util 2x on int4 runs
+        nbytes = x.size // 2 if x.dtype.name == "int4" else x.size * x.dtype.itemsize
+        params_bytes += nbytes
+        # QTensor scale/zero leaves ('s'/'z') are dequant metadata, not
+        # matmul parameters — keep them out of the MFU numerator
+        if key not in ("s", "z"):
+            n_params += x.size
     return {
         f"{prefix}_hbm_gbps": round(params_bytes * reads_per_s / 1e9, 1),
         f"{prefix}_hbm_util_v5e": round(

@@ -219,11 +219,6 @@ class AsyncJaxEngine:
         forward over a scratch paged cache, so every family the engine
         generates with (MLA, gpt-oss, MoE, …) embeds too. Shapes bucket to
         powers of two so steady traffic reuses a handful of programs."""
-        import jax.numpy as jnp
-
-        from dynamo_tpu.engine import model as M
-        from dynamo_tpu.engine.cache import allocate_device_cache
-
         if not token_id_lists:
             return []
         # bound inputs by the serving context the same way generate does
@@ -241,21 +236,52 @@ class AsyncJaxEngine:
             raise ValueError(
                 f"embedding batch of {len(token_id_lists)}×{too_long} tokens "
                 f"exceeds the per-request budget {budget}; split the batch")
-        if getattr(self, "_embed_fn", None) is None:
-            # one jitted callable (jax.jit re-specializes per (B,S) bucket)
-            # + per-bucket scratch caches, reused across calls
-            self._embed_fn = M.make_embed_fn(
-                self.cfg, self.args.block_size, self.mesh,
-                use_pallas=self.args.use_pallas_attention)
-            self._embed_caches: dict = {}
         bs = self.args.block_size
         B = 1 << (len(token_id_lists) - 1).bit_length()
         S = max(bs, 1 << (too_long - 1).bit_length())
+        if self._multihost:
+            # the batch axis shards over "dp" under a global mesh; a bucket
+            # narrower than the dp extent cannot be laid out
+            B = max(B, self.mesh.shape.get("dp", 1))
         tokens = np.zeros((B, S), np.int32)
         lengths = np.zeros((B,), np.int32)
         for i, ids in enumerate(token_id_lists):
             tokens[i, :len(ids)] = ids
             lengths[i] = len(ids)
+
+        if self._multihost:
+            # broadcast + dispatch ON the event-loop thread: follower replay
+            # order must match the leader's device dispatch order, and every
+            # other step kind dispatches from this thread (a to_thread embed
+            # could interleave differently on leader vs followers and wedge
+            # the fleet in mismatched collectives)
+            self._broadcast("embed", tokens=tokens, lengths=lengths)
+            out = self._embed_forward(tokens, lengths)
+            host = await asyncio.to_thread(np.asarray, out)
+        else:
+            def run():  # compile/dispatch + host copy off the event loop
+                return np.asarray(self._embed_forward(tokens, lengths))
+
+            host = await asyncio.to_thread(run)
+        return [host[i].tolist() for i in range(len(token_id_lists))]
+
+    def _embed_forward(self, tokens: np.ndarray, lengths: np.ndarray):
+        """Setup (jitted fn + scratch caches) and dispatch of one embed
+        forward — shared verbatim by the leader path and the follower's
+        step replay so both ranks compile the identical program."""
+        from dynamo_tpu.engine import model as M
+        from dynamo_tpu.engine.cache import allocate_device_cache
+
+        if getattr(self, "_embed_fn", None) is None:
+            # one jitted callable (jax.jit re-specializes per (B,S) bucket)
+            # + per-bucket scratch caches, reused across calls
+            self._embed_fn = M.make_embed_fn(
+                self.cfg, self.args.block_size, self.mesh,
+                use_pallas=self.args.use_pallas_attention,
+                replicate_outputs=self._multihost)
+            self._embed_caches: dict = {}
+        bs = self.args.block_size
+        B, S = tokens.shape
         caches = self._embed_caches.get((B, S))
         if caches is None:
             # keep ONE scratch cache: mixed-shape embed traffic must not
@@ -266,14 +292,8 @@ class AsyncJaxEngine:
                 self.cfg, B * (S // bs) + 1, bs, self.mesh,
                 global_arrays=self._multihost)
             self._embed_caches[(B, S)] = caches
-
-        def run():  # compile/dispatch + host copy off the event loop
-            out = self._embed_fn(self.params, jnp.asarray(tokens),
-                                 jnp.asarray(lengths), *caches)
-            return np.asarray(out)
-
-        host = await asyncio.to_thread(run)
-        return [host[i].tolist() for i in range(len(token_id_lists))]
+        return self._embed_fn(self.params, self._put_batch("tokens", tokens),
+                              self._put_batch("lengths", lengths), *caches)
 
     async def embed_handler(self, request: dict, ctx=None):
         """Endpoint handler: {"token_ids": [[...]]} → one embeddings frame."""

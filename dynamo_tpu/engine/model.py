@@ -1071,7 +1071,8 @@ def make_verify_fn(cfg: ModelConfig, block_size: int,
 
 
 def make_embed_fn(cfg: ModelConfig, block_size: int,
-                  mesh: Optional[Mesh] = None, use_pallas: bool = False):
+                  mesh: Optional[Mesh] = None, use_pallas: bool = False,
+                  replicate_outputs: bool = False):
     """Jitted mean-pooled sequence embeddings over the SERVING forward
     (ref surface: /v1/embeddings, lib/llm/src/http/service/openai.rs:714 —
     the reference serves embeddings regardless of backend model family).
@@ -1110,7 +1111,12 @@ def make_embed_fn(cfg: ModelConfig, block_size: int,
         return pooled / jnp.maximum(
             jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
 
-    return jax.jit(f)
+    kw = {}
+    if replicate_outputs and mesh is not None:
+        # multi-host: the [B, D] output must come back fully replicated or
+        # the leader's host fetch would span non-addressable devices
+        kw["out_shardings"] = NamedSharding(mesh, P())
+    return jax.jit(f, **kw)
 
 
 def multi_decode(params, last_tokens, positions, block_tables, kv_lens,

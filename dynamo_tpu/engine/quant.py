@@ -134,7 +134,11 @@ def qmm(x, w):
         return x @ w
     q, s = w["q"], w["s"]
     if s.shape[-2] == 1 and "z" not in w:
-        return (x @ q.astype(x.dtype)) * s[..., 0, :].astype(x.dtype)
+        # Scale multiply in f32 with ONE final cast, matching materialize()'s
+        # dequantize-at-load contract — a bf16 scale would shed ~8 mantissa
+        # bits and diverge from the grouped path beyond quantization error.
+        out = (x @ q.astype(x.dtype)).astype(jnp.float32)
+        return (out * s[..., 0, :].astype(jnp.float32)).astype(x.dtype)
     return x @ materialize(w, x.dtype)
 
 

@@ -42,6 +42,9 @@ class KvbmManager:
         self.disk = DiskTier(disk_dir, disk_bytes) if (disk_dir and disk_bytes) else None
         self.remote: Optional[RemoteTier] = None
         self._remote_ops: list = []  # (op, hash, payload|None), lock-guarded
+        #: failed deletes awaiting their next attempt (merged into the op
+        #: queue at the START of each drain, so retries span drain calls)
+        self._remote_retry: list = []
         #: hashes whose G4 put is queued but not yet written: fetches must
         #: treat them as misses WITHOUT discarding the index entry, or the
         #: later write leaks an orphaned object
@@ -80,11 +83,19 @@ class KvbmManager:
     def _drain_remote(self) -> None:
         """Perform queued G4 I/O. MUST be called WITHOUT the lock held."""
         with self._drain_lock:
+            with self._lock:
+                # failed deletes parked by a PREVIOUS drain get their next
+                # attempt now — retrying within the same drain loop would
+                # burn the whole budget inside one transient plane outage
+                if self._remote_retry:
+                    self._remote_ops.extend(self._remote_retry)
+                    self._remote_retry.clear()
             while True:
                 with self._lock:
                     if not self._remote_ops or self.remote is None:
                         return
-                    op, h, payload = self._remote_ops.pop(0)
+                    op, h, payload, *rest = self._remote_ops.pop(0)
+                    attempts = rest[0] if rest else 0
                     client = self.remote.client
                 failed = False
                 try:
@@ -101,6 +112,20 @@ class KvbmManager:
                         if failed and self.remote is not None:
                             self.remote.discard(h)
                             self._notify_if_gone(h)
+                elif failed:
+                    # the index entry is already gone — dropping the delete
+                    # would orphan the object in the plane's store forever
+                    # on a flaky plane; park it for the NEXT drain (retrying
+                    # in this loop would exhaust the budget in milliseconds)
+                    with self._lock:
+                        if attempts + 1 < 5 and self.remote is not None:
+                            self._remote_retry.append(
+                                ("delete", h, None, attempts + 1))
+                        else:
+                            logger.error(
+                                "kvbm G4 delete for %x gave up after %d "
+                                "attempts — object orphaned in the store",
+                                h, attempts + 1)
 
     def _notify_if_gone(self, h: int) -> None:
         """Announce removal when ``h`` left its LAST tier (lock held) —

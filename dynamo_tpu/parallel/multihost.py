@@ -54,6 +54,7 @@ STEP_KEYS = {
     "verify": ("tokens", "positions", "slot_map", "block_tables", "kv_lens"),
     "step_mm": ("tokens", "positions", "slot_map", "block_tables", "kv_lens",
                 "last_idx", "mm_vec", "mm_mask"),
+    "embed": ("tokens", "lengths"),
 }
 
 
@@ -187,6 +188,7 @@ class StepBroadcaster:
         prefix = STEP_STREAM_PREFIX.format(namespace=self.namespace)
         deadline = _time.monotonic() + timeout
         connected: dict = {}
+        dial_failures: dict = {}
         while True:
             infos = await self.plane.kv_get_prefix(prefix)
             for key in sorted(infos):
@@ -196,10 +198,20 @@ class StepBroadcaster:
                     msgpack.unpackb(infos[key], raw=False))
                 try:
                     connected[key] = await StreamSender.connect(info)
+                    dial_failures.pop(key, None)
                 except Exception:
-                    # a previous fleet incarnation's endpoint whose lease
-                    # has not expired yet: remove it so it can neither
-                    # satisfy the count nor crash a later dial
+                    # could be a previous fleet incarnation's endpoint whose
+                    # lease has not expired yet — OR a live follower hit by a
+                    # transient TCP failure. Deleting a live follower's key
+                    # makes the expected count unreachable while that
+                    # follower waits forever, so only conclude "stale" after
+                    # several consecutive failed dials across poll rounds.
+                    dial_failures[key] = dial_failures.get(key, 0) + 1
+                    if dial_failures[key] < 3:
+                        logger.warning(
+                            "follower step endpoint %s failed dial %d/3 — "
+                            "will retry", key, dial_failures[key])
+                        continue
                     logger.warning(
                         "stale follower step endpoint %s — deleting", key)
                     try:
@@ -321,6 +333,8 @@ class StepFollower:
                         eng.params,
                         *(eng._put_batch(k, a[k]) for k in keys),
                         eng.k_cache, eng.v_cache)
+                elif kind == "embed":  # /v1/embeddings scratch forward
+                    eng._embed_forward(a["tokens"], a["lengths"])
                 elif kind == "verify":  # speculative verification
                     _, _, eng.k_cache, eng.v_cache = eng.verify_fn(
                         eng.params,

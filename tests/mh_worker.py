@@ -93,6 +93,11 @@ async def main():
         async for out in eng.generate(req):
             toks.extend(out.token_ids)
         print("TOKENS " + json.dumps(toks), flush=True)
+        # /v1/embeddings on a multi-host fleet: the embed forward contains
+        # global-mesh collectives, so without broadcast+replay (the r3
+        # advisor's medium finding) this call wedges rank 0 forever
+        vecs = await eng.embed([[1, 2, 3, 4], [5, 6]])
+        print(f"EMBDIM {len(vecs[0])}", flush=True)
         await bcast.stop()
         await plane.kv_put("mh/nsteps", str(bcast.steps_sent).encode())
         await wait_kv(plane, "mh/replayed")
