@@ -312,9 +312,21 @@ class EngineArgs:
     #: (loader keeps native groups). Ref capability: FP8 70B recipe,
     #: recipes/llama-3-70b/vllm/disagg-single-node/deploy.yaml:21-86
     quantization: Optional[str] = None
+    #: paged KV cache dtype: None/"auto" (model dtype) | "int8" (symmetric
+    #: per-(slot, head) scales; ~2x KV capacity and half the decode kernel's
+    #: HBM page traffic — engine/cache.py int8 notes). KV-capacity role of
+    #: the reference's G1 tier (lib/llm/src/block_manager/). Not yet
+    #: supported for MLA latent caches (falls back to model dtype).
+    kv_cache_dtype: Optional[str] = None
     seed: int = 0
 
     def __post_init__(self):
+        if self.kv_cache_dtype not in (None, "auto", "int8"):
+            # an unknown value silently serving full-precision would run a
+            # deployment at half its planned KV capacity — fail loudly
+            raise ValueError(
+                f"kv_cache_dtype={self.kv_cache_dtype!r} not supported "
+                "(None/'auto' = model dtype, or 'int8')")
         if not self.decode_batch_buckets:
             b = [2**i for i in range(0, max(1, self.max_num_seqs).bit_length())
                  if 2**i <= self.max_num_seqs] or [1]

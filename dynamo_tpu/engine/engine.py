@@ -113,11 +113,21 @@ class AsyncJaxEngine:
                 params = jax.device_put(params, sh)
         self.params = params
 
+        self._kv_quant = args.kv_cache_dtype == "int8"
+        if self._kv_quant and cfg.is_mla:
+            # the latent cache's single shared "head" needs its own scale
+            # layout + kernel treatment — not built yet; fail soft so an
+            # MLA deployment with a fleet-wide int8 flag still serves
+            logger.warning("int8 KV cache is not supported for MLA latent "
+                           "caches yet — using model dtype")
+            self._kv_quant = False
         nb = args.num_blocks or hbm_sized_num_blocks(
-            cfg, args.block_size, args.kv_cache_memory_fraction, args.tp_size)
+            cfg, args.block_size, args.kv_cache_memory_fraction, args.tp_size,
+            kv_cache_dtype="int8" if self._kv_quant else None)
         self.num_blocks = nb
         self.k_cache, self.v_cache = allocate_device_cache(
-            cfg, nb, args.block_size, mesh, global_arrays=self._multihost)
+            cfg, nb, args.block_size, mesh, global_arrays=self._multihost,
+            dtype="int8" if self._kv_quant else None)
 
         self.kvbm = None
         if args.kvbm_host_bytes > 0 and args.enable_prefix_caching:
@@ -137,19 +147,22 @@ class AsyncJaxEngine:
             onboard_cb=self._onboard if self.kvbm is not None else None)
         self.step_fn = M.make_step_fn(cfg, args.block_size, mesh,
                                       use_pallas=args.use_pallas_attention,
-                                      replicate_logits=self._multihost)
+                                      replicate_logits=self._multihost,
+                                      kv_quant=self._kv_quant)
         self.multi_fn = None
         if args.multi_step_decode > 1:
             self.multi_fn = M.make_multi_decode_fn(
                 cfg, args.block_size, args.multi_step_decode, mesh,
                 use_pallas=args.use_pallas_attention,
-                replicate_outputs=self._multihost)
+                replicate_outputs=self._multihost,
+                kv_quant=self._kv_quant)
         self._step_mm_fn = None  # compiled lazily on first mm request
         self.verify_fn = None
         if args.speculative_tokens > 0:
             self.verify_fn = M.make_verify_fn(
                 cfg, args.block_size, mesh,
-                replicate_outputs=self._multihost)
+                replicate_outputs=self._multihost,
+                kv_quant=self._kv_quant)
         self.spec_stats = SpecDecodeStats()
         from dynamo_tpu.engine import sampling as S
         self._sampling = S
@@ -490,7 +503,8 @@ class AsyncJaxEngine:
         self.pool.release(ids)
 
     def check_bundle_dims(self, bundle) -> bool:
-        L, slots, KV, hd = self.k_cache.shape
+        from dynamo_tpu.engine.cache import cache_shape
+        L, slots, KV, hd = cache_shape(self.k_cache)
         return (bundle.block_size == self.args.block_size
                 and bundle.k.shape[0] == L and bundle.k.shape[3:] == (KV, hd))
 
@@ -555,8 +569,10 @@ class AsyncJaxEngine:
                 or not self.check_bundle_dims(bundle)
                 or bundle.start_block != 0):
             if bundle is not None and not self.check_bundle_dims(bundle):
+                from dynamo_tpu.engine.cache import cache_shape
                 logger.warning("KV bundle dims %s mismatch cache %s; local "
-                               "prefill", bundle.k.shape, self.k_cache.shape)
+                               "prefill", bundle.k.shape,
+                               cache_shape(self.k_cache))
             async for out in self.generate(req, ctx):
                 yield out
             return
@@ -660,7 +676,8 @@ class AsyncJaxEngine:
             self._step_mm_fn = M.make_step_mm_fn(
                 self.cfg, self.args.block_size, self.mesh,
                 use_pallas=self.args.use_pallas_attention,
-                replicate_logits=self._multihost)
+                replicate_logits=self._multihost,
+                kv_quant=self._kv_quant)
         return self._step_mm_fn
 
     async def _run_prefill(self, works: list) -> None:

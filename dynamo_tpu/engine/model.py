@@ -233,20 +233,29 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
     return out
 
 
-def cache_shardings(mesh: Mesh, cfg: Optional[ModelConfig] = None) -> NamedSharding:
+def cache_shardings(mesh: Mesh, cfg: Optional[ModelConfig] = None,
+                    quant: bool = False):
     """KV cache [L, num_slots, KV, hd]: heads sharded on tp, replicated on dp.
 
     MLA's latent cache has a single shared "head" — it rides replicated
     (the well-known MLA/TP property; the latent is tiny, ~576 dims/token).
-    """
+
+    ``quant``: int8 caches are {"q": [L,slots,KV,hd], "s": [L,slots,KV]}
+    pytrees — returns a matching dict of shardings (scales shard with their
+    heads)."""
     if cfg is not None and cfg.is_mla:
-        return NamedSharding(mesh, P(None, None, None, None))
-    tp = mesh.shape.get("tp", 1)
-    if cfg is not None and cfg.num_kv_heads % max(1, tp) != 0:
+        head_axis = None
+    elif (cfg is not None
+          and cfg.num_kv_heads % max(1, mesh.shape.get("tp", 1)) != 0):
         # KV heads not divisible by tp (tiny test models on wide meshes):
         # replicate the head dim rather than fail allocation
-        return NamedSharding(mesh, P(None, None, None, None))
-    return NamedSharding(mesh, P(None, None, "tp", None))
+        head_axis = None
+    else:
+        head_axis = "tp"
+    q_sh = NamedSharding(mesh, P(None, None, head_axis, None))
+    if not quant:
+        return q_sh
+    return {"q": q_sh, "s": NamedSharding(mesh, P(None, None, head_axis))}
 
 
 def batch_shardings(mesh: Mesh) -> dict:
@@ -383,8 +392,10 @@ def _paged_attention(q, k_cache, v_cache, lidx, block_tables, positions,
 
     slot_idx = block_tables[:, :, None] * block_size + jnp.arange(block_size)[None, None, :]
     slot_idx = slot_idx.reshape(B, T)
-    k = k_cache[lidx, slot_idx]  # [B, T, KV, hd]
-    v = v_cache[lidx, slot_idx]
+    from dynamo_tpu.engine.cache import gather_pages
+
+    k = gather_pages(k_cache, lidx, slot_idx)  # [B, T, KV, hd]
+    v = gather_pages(v_cache, lidx, slot_idx)
 
     qg = q.reshape(B, S, KV, G, hd)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg.astype(jnp.float32), k.astype(jnp.float32))
@@ -762,11 +773,17 @@ import logging
 _logger = logging.getLogger("dynamo.engine.model")
 
 
-def _shard_specs():
-    """shard_map specs for one attention call (heads on tp, batch on dp)."""
+def _shard_specs(kv_quant: bool = False):
+    """shard_map specs for one attention call (heads on tp, batch on dp).
+
+    ``kv_quant``: the cache operand is a {"q","s"} pytree — its spec must
+    be a matching dict (scales shard with their heads, no hd axis)."""
+    cache = P(None, None, "tp", None)       # [L,slots,KV,hd]
+    if kv_quant:
+        cache = {"q": cache, "s": P(None, None, "tp")}
     return dict(
         q=P("dp", None, "tp", None),        # [B,S,H,hd]
-        cache=P(None, None, "tp", None),    # [L,slots,KV,hd]
+        cache=cache,
         bt=P("dp", None), lens=P("dp"), pos=P("dp", None), scalar=P())
 
 
@@ -780,12 +797,21 @@ def _pallas_decode_attn(q1, kc, vc, lidx, block_tables, kv_lens, window,
     is a (possibly per-layer traced) scalar, 0 = full attention; ``sinks``
     [H] are gpt-oss attention-sink logits (ignored unless has_sink).
     """
+    from dynamo_tpu.engine.cache import cache_shape, is_quant_cache
     from dynamo_tpu.ops.paged_attention import paged_attention_decode
 
-    L_, slots_, KV, hd = kc.shape
+    L_, slots_, KV, hd = cache_shape(kc)
     nb = slots_ // block_size
+    flat = L_ * slots_
+    if is_quant_cache(kc):
+        return paged_attention_decode(
+            q1, kc["q"].reshape(flat, KV, hd), vc["q"].reshape(flat, KV, hd),
+            block_tables + lidx * nb, kv_lens, block_size=block_size,
+            window=window, sinks=sinks if has_sink else None,
+            k_scales=kc["s"].reshape(flat, KV),
+            v_scales=vc["s"].reshape(flat, KV))
     return paged_attention_decode(
-        q1, kc.reshape(L_ * slots_, KV, hd), vc.reshape(L_ * slots_, KV, hd),
+        q1, kc.reshape(flat, KV, hd), vc.reshape(flat, KV, hd),
         block_tables + lidx * nb, kv_lens, block_size=block_size,
         window=window, sinks=sinks if has_sink else None)
 
@@ -822,6 +848,8 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
     B, S = tokens.shape
     D, hd = cfg.hidden_size, cfg.head_dim
     H, KV = cfg.num_heads, cfg.num_kv_heads
+    from dynamo_tpu.engine.cache import gather_pages, is_quant_cache
+    kv_quant = is_quant_cache(k_cache)
 
     x = params["embed"][tokens]  # [B,S,D]
     if mm_vec is not None:
@@ -872,8 +900,20 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
         flat_slots = slot_map.reshape(B * S)
-        kc = kc.at[lidx, flat_slots].set(k.reshape(B * S, KV, hd), mode="drop")
-        vc = vc.at[lidx, flat_slots].set(v.reshape(B * S, KV, hd), mode="drop")
+        if kv_quant:
+            from dynamo_tpu.engine.cache import quantize_kv
+
+            kq, ks = quantize_kv(k.reshape(B * S, KV, hd))
+            vq, vs = quantize_kv(v.reshape(B * S, KV, hd))
+            kc = {"q": kc["q"].at[lidx, flat_slots].set(kq, mode="drop"),
+                  "s": kc["s"].at[lidx, flat_slots].set(ks, mode="drop")}
+            vc = {"q": vc["q"].at[lidx, flat_slots].set(vq, mode="drop"),
+                  "s": vc["s"].at[lidx, flat_slots].set(vs, mode="drop")}
+        else:
+            kc = kc.at[lidx, flat_slots].set(k.reshape(B * S, KV, hd),
+                                             mode="drop")
+            vc = vc.at[lidx, flat_slots].set(v.reshape(B * S, KV, hd),
+                                             mode="drop")
 
         # shard_map needs the (static) batch divisible by the dp axis
         # (dp_ok computed above, shared with the MLA branch); otherwise fall
@@ -885,7 +925,7 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                 "Pallas %s kernel bypassed: batch %d not divisible by dp=%d "
                 "— falling back to the XLA attention path for this bucket",
                 "decode" if S == 1 else "prefill", B, mesh.shape.get("dp", 1))
-        sp = _shard_specs() if mesh is not None else None
+        sp = _shard_specs(kv_quant) if mesh is not None else None
         # context parallelism: prefill chunks ring over the "sp" axis —
         # each sp shard gathers 1/n of the page table and the slices rotate
         # (SURVEY §5.7: the engine feature the reference lacks)
@@ -1058,14 +1098,15 @@ def verify_forward(params, tokens, positions, slot_map, block_tables,
 
 def make_verify_fn(cfg: ModelConfig, block_size: int,
                    mesh: Optional[Mesh] = None,
-                   replicate_outputs: bool = False):
+                   replicate_outputs: bool = False,
+                   kv_quant: bool = False):
     """Jitted speculative verification with cache donation (args 6, 7)."""
     f = functools.partial(verify_forward, cfg=cfg, block_size=block_size,
                           mesh=mesh)
     kw = {}
     if replicate_outputs and mesh is not None:
         rep = NamedSharding(mesh, P())
-        csh = cache_shardings(mesh, cfg)
+        csh = cache_shardings(mesh, cfg, quant=kv_quant)
         kw["out_shardings"] = (rep, rep, csh, csh)
     return jax.jit(f, donate_argnums=(6, 7), **kw)
 
@@ -1202,7 +1243,8 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
 
 def make_step_mm_fn(cfg: ModelConfig, block_size: int,
                     mesh: Optional[Mesh] = None, use_pallas: bool = False,
-                    use_flash_prefill=None, replicate_logits: bool = False):
+                    use_flash_prefill=None, replicate_logits: bool = False,
+                    kv_quant: bool = False):
     """Jitted engine step accepting multimodal embedding overrides:
     (params, tokens, positions, slot_map, block_tables, kv_lens, last_idx,
     mm_vec [B,S,D], mm_mask [B,S], k_cache, v_cache). Compiled lazily by the
@@ -1220,15 +1262,15 @@ def make_step_mm_fn(cfg: ModelConfig, block_size: int,
 
     kw = {}
     if replicate_logits and mesh is not None:
-        kw["out_shardings"] = (NamedSharding(mesh, P()),
-                               cache_shardings(mesh, cfg),
-                               cache_shardings(mesh, cfg))
+        csh = cache_shardings(mesh, cfg, quant=kv_quant)
+        kw["out_shardings"] = (NamedSharding(mesh, P()), csh, csh)
     return jax.jit(f, donate_argnums=(9, 10), **kw)
 
 
 def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
                          mesh: Optional[Mesh] = None, use_pallas: bool = False,
-                         replicate_outputs: bool = False):
+                         replicate_outputs: bool = False,
+                         kv_quant: bool = False):
     """Jitted multi-step decode with cache donation (args 5, 6).
 
     ``replicate_outputs`` (multi-host): tokens/logps come back fully
@@ -1242,14 +1284,14 @@ def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
     kw = {}
     if replicate_outputs and mesh is not None:
         rep = NamedSharding(mesh, P())
-        csh = cache_shardings(mesh, cfg)
+        csh = cache_shardings(mesh, cfg, quant=kv_quant)
         kw["out_shardings"] = (rep, rep, csh, csh)
     return jax.jit(f, donate_argnums=(5, 6), **kw)
 
 
 def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,
                  use_pallas: bool = False, use_flash_prefill=None,
-                 replicate_logits: bool = False):
+                 replicate_logits: bool = False, kv_quant: bool = False):
     """Jitted engine step with cache donation (and GSPMD shardings if mesh).
 
     ``use_pallas`` switches decode (S=1) attention onto the Pallas paged
@@ -1263,8 +1305,7 @@ def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,
                           use_flash_prefill=prefill_flash, mesh=mesh)
     kw = {}
     if replicate_logits and mesh is not None:  # multi-host: see above
-        kw["out_shardings"] = (NamedSharding(mesh, P()),
-                               cache_shardings(mesh, cfg),
-                               cache_shardings(mesh, cfg))
+        csh = cache_shardings(mesh, cfg, quant=kv_quant)
+        kw["out_shardings"] = (NamedSharding(mesh, P()), csh, csh)
     # donate caches (args 7, 8 → positions in the positional signature)
     return jax.jit(f, donate_argnums=(7, 8), **kw)

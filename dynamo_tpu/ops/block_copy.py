@@ -36,13 +36,26 @@ def _pad_pow2_ids(block_ids: np.ndarray) -> np.ndarray:
     return np.concatenate([block_ids, np.repeat(block_ids[-1:], p - n)])
 
 
-def gather_blocks(cache: jax.Array, block_ids, *, block_size: int) -> jax.Array:
+def gather_blocks(cache, block_ids, *, block_size: int) -> jax.Array:
     """Pull whole blocks out of the flat paged cache.
 
-    cache: [L, num_slots, KV, hd]; block_ids: [n] int32.
+    cache: [L, num_slots, KV, hd] array, or an int8 {"q","s"} cache.
     Returns [L, P, block_size, KV, hd] where P = next pow2 ≥ n (trailing
     entries repeat the last block; slice host-side if exact n is needed).
-    """
+
+    int8 caches dequantize into an f32 bundle: int8 × f32-scale products
+    are exact in f32 and re-quantize to the identical (q, s) pair, so
+    KVBM offload→onboard and disagg transfer stay bit-deterministic
+    (see engine/cache.py int8 notes)."""
+    from dynamo_tpu.engine.cache import dequantize_kv, is_quant_cache
+
+    if is_quant_cache(cache):
+        L, slots, KV, hd = cache["q"].shape
+        ids = jnp.asarray(_pad_pow2_ids(np.asarray(block_ids, np.int32)))
+        qp = cache["q"].reshape(L, slots // block_size, block_size, KV, hd)
+        sp = cache["s"].reshape(L, slots // block_size, block_size, KV)
+        return dequantize_kv(jnp.take(qp, ids, axis=1),
+                             jnp.take(sp, ids, axis=1))
     L, slots, KV, hd = cache.shape
     ids = _pad_pow2_ids(np.asarray(block_ids, np.int32))
     paged = cache.reshape(L, slots // block_size, block_size, KV, hd)
@@ -59,21 +72,42 @@ def _scatter(cache, block_ids, bundle, *, block_size):
     return paged.at[:, block_ids].set(bundle).reshape(L, slots, KV, hd)
 
 
-def scatter_blocks(cache: jax.Array, block_ids, bundle, *,
-                   block_size: int) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("block_size",), donate_argnums=(0,))
+def _scatter_quant(cache, block_ids, bundle, *, block_size):
+    """Quantize the f32 bundle in-trace and write both cache leaves."""
+    from dynamo_tpu.engine.cache import quantize_kv
+
+    L, slots, KV, hd = cache["q"].shape
+    qb, sb = quantize_kv(bundle)  # [L, n, bs, KV, hd] / [L, n, bs, KV]
+    qp = cache["q"].reshape(L, slots // block_size, block_size, KV, hd)
+    sp = cache["s"].reshape(L, slots // block_size, block_size, KV)
+    return {
+        "q": qp.at[:, block_ids].set(qb).reshape(L, slots, KV, hd),
+        "s": sp.at[:, block_ids].set(sb).reshape(L, slots, KV),
+    }
+
+
+def scatter_blocks(cache, block_ids, bundle, *, block_size: int):
     """Write a gathered bundle into blocks of the cache; returns new cache.
 
     bundle: [L, n, bs, KV, hd] (np or jax). The flat cache is donated at the
     jit boundary (reshapes live inside it), so the write is in-place in HBM —
     no transient second cache. ids/bundle are pow2-padded (idempotent
-    duplicate writes) to bound the compile cache.
+    duplicate writes) to bound the compile cache. int8 caches re-quantize
+    the bundle in-trace (bit-exact for bundles born from gather_blocks).
     """
+    from dynamo_tpu.engine.cache import is_quant_cache
+
     ids = np.asarray(block_ids, np.int32)
     n = len(ids)
     pids = _pad_pow2_ids(ids)
     if len(pids) != n:
         pad = np.repeat(np.asarray(bundle[:, -1:]), len(pids) - n, axis=1)
         bundle = np.concatenate([np.asarray(bundle), pad], axis=1)
+    if is_quant_cache(cache):
+        return _scatter_quant(cache, jnp.asarray(pids),
+                              jnp.asarray(bundle, jnp.float32),
+                              block_size=block_size)
     return _scatter(cache, jnp.asarray(pids),
                     jnp.asarray(bundle).astype(cache.dtype),
                     block_size=block_size)

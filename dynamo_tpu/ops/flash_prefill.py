@@ -230,12 +230,16 @@ def flash_prefill_paged(q, k_cache, v_cache, lidx, block_tables, positions,
     Same signature family as engine/model._paged_attention; q [B,S,H,hd],
     caches [L, slots, KV, hd].
     """
+    from dynamo_tpu.engine.cache import gather_pages
+
     B = q.shape[0]
     W = block_tables.shape[1]
     slot_idx = (block_tables[:, :, None] * block_size
                 + jnp.arange(block_size)[None, None, :]).reshape(B, W * block_size)
-    k = k_cache[lidx, slot_idx]  # [B, T, KV, hd]
-    v = v_cache[lidx, slot_idx]
+    # int8 caches dequantize in the gather (fused); the kernel then runs on
+    # the q-dtype values exactly as with a plain cache
+    k = gather_pages(k_cache, lidx, slot_idx).astype(q.dtype)  # [B,T,KV,hd]
+    v = gather_pages(v_cache, lidx, slot_idx).astype(q.dtype)
     return flash_prefill(q, k, v, positions[:, 0], kv_lens,
                          sliding_window=sliding_window, sinks=sinks,
                          interpret=interpret)

@@ -42,11 +42,18 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
                    qexp_ref,  # [1, H, KVhd] VMEM
                    sink_ref,  # [1, H, 1] VMEM (zeros when has_sink=False)
                    kcache_ref, vcache_ref,  # [slots, KVhd] HBM
-                   out_ref,  # [1, H, KVhd] VMEM
-                   kbuf, vbuf, dma_sem,  # scratch [D, bs, KVhd] / [D, 2]
-                   *, bs: int, has_sink: bool):
+                   *rest,  # [ksc_ref, vsc_ref (HBM [slots, KV]),] out_ref,
+                           # kbuf, vbuf, [ksbuf, vsbuf,] dma_sem
+                   bs: int, has_sink: bool, quant: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if quant:
+        (ksc_ref, vsc_ref, out_ref, kbuf, vbuf,
+         ksbuf, vsbuf, dma_sem) = rest
+    else:
+        out_ref, kbuf, vbuf, dma_sem = rest
+        ksc_ref = vsc_ref = ksbuf = vsbuf = None
 
     b = pl.program_id(0)
     kv_len = kv_lens_ref[b]
@@ -71,6 +78,13 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
         pltpu.make_async_copy(
             vcache_ref.at[pl.ds(blk * bs, bs)], vbuf.at[slot],
             dma_sem.at[slot, 1]).start()
+        if quant:  # per-(slot, head) scales ride their own small DMAs
+            pltpu.make_async_copy(
+                ksc_ref.at[pl.ds(blk * bs, bs)], ksbuf.at[slot],
+                dma_sem.at[slot, 2]).start()
+            pltpu.make_async_copy(
+                vsc_ref.at[pl.ds(blk * bs, bs)], vsbuf.at[slot],
+                dma_sem.at[slot, 3]).start()
 
     def wait_dma(w):
         slot = w % D
@@ -78,6 +92,11 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
                               dma_sem.at[slot, 0]).wait()
         pltpu.make_async_copy(vbuf.at[slot], vbuf.at[slot],
                               dma_sem.at[slot, 1]).wait()
+        if quant:
+            pltpu.make_async_copy(ksbuf.at[slot], ksbuf.at[slot],
+                                  dma_sem.at[slot, 2]).wait()
+            pltpu.make_async_copy(vsbuf.at[slot], vsbuf.at[slot],
+                                  dma_sem.at[slot, 3]).wait()
 
     # D-deep rotating pipeline — scattered pages are independent, so keeping
     # D fetches in flight hides per-DMA grant latency (a 2-deep double
@@ -87,6 +106,16 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
                       lambda w, c: (start_dma(w), c)[1], 0)
 
     qexp = qexp_ref[0].astype(jnp.float32)  # [H, KVhd], block-expanded
+
+    if quant:
+        # static head→segment one-hot [H, KV]: head h's scale per key t is
+        # seg_oh @ spage.T — one tiny MXU matmul instead of lane-expanding
+        # scales to the [bs, KVhd] domain
+        KV = ksbuf.shape[2]
+        G = H // KV
+        rows = jax.lax.broadcasted_iota(jnp.int32, (H, KV), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (H, KV), 1)
+        seg_oh = (cols == rows // G).astype(jnp.float32)
 
     def body(w, carry):
         m, l, acc = carry  # [H,1] f32, [H,1] f32, [H,KVhd] f32
@@ -98,6 +127,14 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
         s = jax.lax.dot_general(
             qexp, kpage, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [H, bs]
+        if quant:
+            # dequant scores in the [H, bs] domain: each head contracts only
+            # its own segment, so its raw score scales by that segment's
+            # per-key k-scale
+            ksc = jax.lax.dot_general(
+                seg_oh, ksbuf[w % D], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [H, bs]
+            s = s * ksc
 
         key_pos = w * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
         s = jnp.where((key_pos < kv_len) & (key_pos >= first_key), s, _NEG)
@@ -107,8 +144,16 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
         corr = jnp.exp(m - new_m)
         p = jnp.exp(s - new_m)  # [H, bs]
         new_l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv_p = p
+        if quant:
+            # fold per-key v-scales into p (head h's own segment scaling;
+            # other segments become garbage the caller discards anyway)
+            vsc = jax.lax.dot_general(
+                seg_oh, vsbuf[w % D], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [H, bs]
+            pv_p = p * vsc
         pv = jax.lax.dot_general(
-            p, vpage, (((1,), (0,)), ((), ())),
+            pv_p, vpage, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [H, KVhd]
 
         # refill this slot for page w+D — issued after the loads above, so
@@ -140,13 +185,18 @@ def pallas_supported(num_kv_heads: int, head_dim: int) -> bool:
 
 def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
                            block_size: int, interpret: bool = False,
-                           window=None, sinks=None):
+                           window=None, sinks=None,
+                           k_scales=None, v_scales=None):
     """Decode-step paged attention. See module docstring for the contract.
 
     ``window``: sliding-window size as a (possibly traced per-layer) scalar
     — 0/None = full attention; pages outside the window are never fetched.
     ``sinks``: optional per-head attention-sink logits [H] (gpt-oss),
     seeded into the online softmax with zero value contribution.
+    ``k_scales``/``v_scales`` [slots, KV] f32 (int8 caches): pages are int8
+    and dequantize IN the kernel — HBM page traffic halves vs bf16, the
+    decode bandwidth win the KV-capacity role of the reference's G1 tier
+    implies (lib/llm/src/block_manager/).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -156,10 +206,11 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
     G = H // KV
     KVhd = KV * hd
     bs = block_size
+    quant = k_scales is not None
     if not pallas_supported(KV, hd):
         return paged_attention_decode_xla(
             q, k_cache, v_cache, block_tables, kv_lens, block_size=bs,
-            window=window, sinks=sinks)
+            window=window, sinks=sinks, k_scales=k_scales, v_scales=v_scales)
     interpret = interpret or jax.default_backend() != "tpu"
     has_sink = sinks is not None
     win_arr = jnp.asarray([0 if window is None else window],
@@ -175,31 +226,40 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
 
     W = block_tables.shape[1]
     D = min(W, 16)  # pipeline depth (VMEM budget: 2·D·bs·KVhd·dtype bytes)
-    kernel = functools.partial(_decode_kernel, bs=bs, has_sink=has_sink)
+    kernel = functools.partial(_decode_kernel, bs=bs, has_sink=has_sink,
+                               quant=quant)
+    in_specs = [
+        pl.BlockSpec((1, H, KVhd), lambda b, *_: (b, 0, 0)),
+        pl.BlockSpec((1, H, 1), lambda b, *_: (0, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.HBM),
+        pl.BlockSpec(memory_space=pltpu.HBM),
+    ]
+    scratch = [
+        pltpu.VMEM((D, bs, KVhd), k_cache.dtype),  # D pages in flight
+        pltpu.VMEM((D, bs, KVhd), v_cache.dtype),
+    ]
+    operands = [k_cache.reshape(slots, KVhd), v_cache.reshape(slots, KVhd)]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.HBM),
+                     pl.BlockSpec(memory_space=pltpu.HBM)]
+        scratch += [pltpu.VMEM((D, bs, KV), jnp.float32),
+                    pltpu.VMEM((D, bs, KV), jnp.float32)]
+        operands += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
+    scratch.append(pltpu.SemaphoreType.DMA((D, 4 if quant else 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, H, KVhd), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec((1, H, 1), lambda b, *_: (0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, KVhd), lambda b, *_: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((D, bs, KVhd), k_cache.dtype),  # D pages in flight
-            pltpu.VMEM((D, bs, KVhd), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((D, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     out_full = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, KVhd), q.dtype),
         interpret=interpret,
-    )(block_tables, kv_lens, win_arr,
-      qexp, sink_in, k_cache.reshape(slots, KVhd),
-      v_cache.reshape(slots, KVhd))
+    )(block_tables, kv_lens, win_arr, qexp, sink_in, *operands)
 
     # pick each head's own KV segment back out
     out_full = out_full.reshape(B, H, KV, hd)
@@ -208,10 +268,11 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
 
 
 def paged_attention_decode_xla(q, k_cache, v_cache, block_tables, kv_lens, *,
-                               block_size: int, window=None, sinks=None):
+                               block_size: int, window=None, sinks=None,
+                               k_scales=None, v_scales=None):
     """Reference/fallback path (same math, gather through XLA) — honors the
-    same window/sink contract as the kernel, so a shape-based fallback can
-    never silently change attention semantics."""
+    same window/sink/int8 contract as the kernel, so a shape-based fallback
+    can never silently change attention semantics."""
     B, H, hd = q.shape
     KV = k_cache.shape[1]
     G = H // KV
@@ -222,6 +283,9 @@ def paged_attention_decode_xla(q, k_cache, v_cache, block_tables, kv_lens, *,
                 + jnp.arange(block_size)[None, None, :]).reshape(B, T)
     k = k_cache[slot_idx]  # [B, T, KV, hd]
     v = v_cache[slot_idx]
+    if k_scales is not None:  # int8 pages: dequant fused into the gather
+        k = k.astype(jnp.float32) * k_scales[slot_idx][..., None]
+        v = v.astype(jnp.float32) * v_scales[slot_idx][..., None]
     qg = q.reshape(B, KV, G, hd).astype(jnp.float32)
     s = jnp.einsum("bkgd,btkd->bkgt", qg, k.astype(jnp.float32)) / np.sqrt(hd)
     key_pos = jnp.arange(T)

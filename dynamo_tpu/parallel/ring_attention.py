@@ -196,8 +196,12 @@ def ring_prefill_paged(q, kc, vc, lidx, block_tables, positions, kv_lens, *,
     local_bt = jax.lax.dynamic_slice_in_dim(block_tables, idx * Wl, Wl, axis=1)
     slot_idx = (local_bt[:, :, None] * block_size
                 + jnp.arange(block_size)[None, None, :]).reshape(B, Tl)
-    k = kc[lidx, slot_idx]  # [B, Tl, KV, hd]
-    v = vc[lidx, slot_idx]
+    from dynamo_tpu.engine.cache import gather_pages
+
+    # int8 caches dequantize inside the gather; ring slices then rotate
+    # as q-dtype chunks exactly like the plain-cache path
+    k = gather_pages(kc, lidx, slot_idx).astype(q.dtype)  # [B, Tl, KV, hd]
+    v = gather_pages(vc, lidx, slot_idx).astype(q.dtype)
 
     return _ring_loop(q, k, v, positions, kv_lens, axis_name=axis_name,
                       causal=True, k_chunk_len=Tl,

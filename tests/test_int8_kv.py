@@ -1,0 +1,326 @@
+"""int8 paged KV cache: quantization numerics, attention parity across every
+read path (XLA gather, Pallas decode interpret, flash prefill paged), the
+gather/scatter bit-determinism contract KVBM/disagg rely on, capacity
+sizing, and e2e engine serving.
+
+Mirrors the KV-capacity role of the reference's G1 tier (ref:
+lib/llm/src/block_manager/) — the reference gets KV compression from
+engine-side fp8 KV caches (vllm flags pass through); here int8 pages are a
+first-class cache layout (engine/cache.py int8 notes).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.cache import (
+    allocate_device_cache, cache_shape, dequantize_kv, hbm_sized_num_blocks,
+    is_quant_cache, quantize_kv,
+)
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+
+pytestmark = pytest.mark.anyio
+
+
+# ------------------------------------------------------------------ numerics
+
+def test_quantize_roundtrip_is_exact():
+    """dequant → requant must reproduce identical (q, s): the contract that
+    keeps KVBM offload→onboard and disagg transfer bit-deterministic."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 4, 32)).astype(np.float32) * 3.0
+    q, s = quantize_kv(x)
+    assert q.dtype == np.int8 and s.dtype == np.float32
+    deq = dequantize_kv(q, s)
+    q2, s2 = quantize_kv(deq)
+    np.testing.assert_array_equal(q, q2)
+    np.testing.assert_array_equal(s, s2)
+
+
+def test_quantize_error_bounded():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((16, 2, 64)).astype(np.float32)
+    q, s = quantize_kv(x)
+    err = np.abs(dequantize_kv(q, s) - x)
+    # symmetric int8: error ≤ s/2 per element
+    assert np.all(err <= s[..., None] / 2 + 1e-7)
+
+
+def test_quantize_zero_block():
+    q, s = quantize_kv(np.zeros((4, 2, 8), np.float32))
+    assert np.all(q == 0)
+    deq = dequantize_kv(q, s)
+    assert np.all(deq == 0)
+
+
+def test_jnp_and_np_quantize_agree():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((8, 2, 16)).astype(np.float32)
+    qn, sn = quantize_kv(x)
+    qj, sj = quantize_kv(jnp.asarray(x))
+    np.testing.assert_array_equal(qn, np.asarray(qj))
+    np.testing.assert_array_equal(sn, np.asarray(sj))
+
+
+# ------------------------------------------------------- allocation / sizing
+
+def test_allocate_int8_cache_shapes():
+    cfg = ModelConfig.tiny()
+    k, v = allocate_device_cache(cfg, 8, 4, dtype="int8")
+    assert is_quant_cache(k) and is_quant_cache(v)
+    L, slots, KV, hd = cache_shape(k)
+    assert (L, slots) == (cfg.num_layers, 32)
+    assert k["q"].dtype == np.int8
+    assert k["s"].shape == (L, slots, KV)
+
+
+def test_hbm_sizing_int8_roughly_doubles():
+    cfg = ModelConfig.llama3_1b()
+    # fake free memory via the math itself: compare per-block byte formulas
+    (kh, kd), (vh, vd) = cfg.kv_cache_spec
+    bf16 = cfg.num_layers * 16 * (kh * kd + vh * vd) * 2
+    int8 = cfg.num_layers * 16 * (kh * (kd + 4) + vh * (vd + 4))
+    assert 1.8 < bf16 / int8 < 2.0
+
+
+# ------------------------------------------------------------ attention paths
+
+def _paged_setup(seed=0, B=2, kv_len=48, bs=4, KV=2, H=4, hd=16):
+    """Build a random quantized cache + matching bf16 cache and q batch."""
+    rng = np.random.default_rng(seed)
+    W = (kv_len + bs - 1) // bs
+    num_blocks = B * W + 1
+    slots = num_blocks * bs
+    kf = rng.standard_normal((slots, KV, hd)).astype(np.float32)
+    vf = rng.standard_normal((slots, KV, hd)).astype(np.float32)
+    kq, ks = quantize_kv(kf)
+    vq, vs = quantize_kv(vf)
+    q = rng.standard_normal((B, H, hd)).astype(np.float32)
+    bt = np.zeros((B, W), np.int32)
+    for i in range(B):
+        bt[i] = 1 + i * W + np.arange(W)
+    kv_lens = np.full((B,), kv_len, np.int32)
+    return q, kf, vf, kq, ks, vq, vs, bt, kv_lens
+
+
+def test_decode_xla_int8_close_to_f32():
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.paged_attention import paged_attention_decode_xla
+
+    q, kf, vf, kq, ks, vq, vs, bt, lens = _paged_setup()
+    ref = paged_attention_decode_xla(
+        jnp.asarray(q), jnp.asarray(kf), jnp.asarray(vf),
+        jnp.asarray(bt), jnp.asarray(lens), block_size=4)
+    out = paged_attention_decode_xla(
+        jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+        jnp.asarray(bt), jnp.asarray(lens), block_size=4,
+        k_scales=jnp.asarray(ks), v_scales=jnp.asarray(vs))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=0.05, atol=0.05)
+
+
+def test_decode_pallas_interpret_matches_xla_int8():
+    """The in-kernel dequant (scale DMA + segment-matmul) must agree with
+    the XLA gather-dequant path on the same int8 pages."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.paged_attention import (
+        paged_attention_decode, paged_attention_decode_xla,
+    )
+
+    # KV·hd = 2·64 = 128 → lane-aligned, kernel path taken (interpret on CPU)
+    q, kf, vf, kq, ks, vq, vs, bt, lens = _paged_setup(KV=2, hd=64, H=4)
+    ref = paged_attention_decode_xla(
+        jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+        jnp.asarray(bt), jnp.asarray(lens), block_size=4,
+        k_scales=jnp.asarray(ks), v_scales=jnp.asarray(vs))
+    out = paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+        jnp.asarray(bt), jnp.asarray(lens), block_size=4, interpret=True,
+        k_scales=jnp.asarray(ks), v_scales=jnp.asarray(vs))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_decode_pallas_int8_sliding_window_and_sinks():
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.paged_attention import (
+        paged_attention_decode, paged_attention_decode_xla,
+    )
+
+    q, kf, vf, kq, ks, vq, vs, bt, lens = _paged_setup(KV=2, hd=64, H=4)
+    sinks = np.linspace(-1, 1, 4).astype(np.float32)
+    for window in (None, 8):
+        ref = paged_attention_decode_xla(
+            jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+            jnp.asarray(bt), jnp.asarray(lens), block_size=4, window=window,
+            sinks=jnp.asarray(sinks),
+            k_scales=jnp.asarray(ks), v_scales=jnp.asarray(vs))
+        out = paged_attention_decode(
+            jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+            jnp.asarray(bt), jnp.asarray(lens), block_size=4, window=window,
+            sinks=jnp.asarray(sinks), interpret=True,
+            k_scales=jnp.asarray(ks), v_scales=jnp.asarray(vs))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_flash_prefill_paged_int8():
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.flash_prefill import flash_prefill_paged
+
+    rng = np.random.default_rng(3)
+    B, S, H, KV, hd, bs = 1, 16, 4, 2, 16, 4
+    W = S // bs
+    slots = (B * W + 1) * bs
+    kf = rng.standard_normal((2, slots, KV, hd)).astype(np.float32)
+    vf = rng.standard_normal((2, slots, KV, hd)).astype(np.float32)
+    kq, ks = quantize_kv(kf)
+    vq, vs = quantize_kv(vf)
+    q = rng.standard_normal((B, S, H, hd)).astype(np.float32)
+    bt = np.arange(1, B * W + 1, dtype=np.int32).reshape(B, W)
+    pos = np.broadcast_to(np.arange(S, dtype=np.int32), (B, S)).copy()
+    lens = np.full((B,), S, np.int32)
+
+    ref = flash_prefill_paged(
+        jnp.asarray(q), jnp.asarray(kf), jnp.asarray(vf), 1,
+        jnp.asarray(bt), jnp.asarray(pos), jnp.asarray(lens),
+        block_size=bs, interpret=True)
+    out = flash_prefill_paged(
+        jnp.asarray(q), {"q": jnp.asarray(kq), "s": jnp.asarray(ks)},
+        {"q": jnp.asarray(vq), "s": jnp.asarray(vs)}, 1,
+        jnp.asarray(bt), jnp.asarray(pos), jnp.asarray(lens),
+        block_size=bs, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=0.05, atol=0.05)
+
+
+# ------------------------------------------------------- gather/scatter paths
+
+def test_gather_scatter_roundtrip_bit_exact():
+    """offload → onboard over an int8 cache must restore the identical
+    quantized pages (the determinism KVBM promises across tiers)."""
+    from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks
+
+    cfg = ModelConfig.tiny()
+    k, v = allocate_device_cache(cfg, 8, 4, dtype="int8")
+    rng = np.random.default_rng(4)
+    L, slots, KV, hd = cache_shape(k)
+    # fill with quantized random content
+    kf = rng.standard_normal((L, slots, KV, hd)).astype(np.float32)
+    kq, ks = quantize_kv(kf)
+    import jax.numpy as jnp
+
+    k = {"q": jnp.asarray(kq), "s": jnp.asarray(ks)}
+    ids = [2, 5, 3]
+    bundle = np.asarray(gather_blocks(k, ids, block_size=4))[:, :3]
+    assert bundle.dtype == np.float32
+    # snapshot before scatter: the cache is DONATED at the jit boundary
+    q_src = np.asarray(k["q"]).reshape(L, slots // 4, 4, KV, hd)[:, [2, 5, 3]]
+    # scatter into DIFFERENT blocks, then gather back: bit-identical
+    k2 = scatter_blocks(k, [6, 1, 7], bundle, block_size=4)
+    back = np.asarray(gather_blocks(k2, [6, 1, 7], block_size=4))[:, :3]
+    np.testing.assert_array_equal(back, bundle)
+    # and the quantized representation round-tripped exactly
+    q_dst = np.asarray(k2["q"]).reshape(L, slots // 4, 4, KV, hd)[:, [6, 1, 7]]
+    np.testing.assert_array_equal(q_src, q_dst)
+
+
+# --------------------------------------------------------------- engine e2e
+
+def _engine(**kw):
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+
+    cfg = ModelConfig.tiny()
+    defaults = dict(block_size=4, num_blocks=128, max_num_seqs=8,
+                    max_num_batched_tokens=64, max_model_len=256,
+                    prefill_buckets=(8, 16, 32, 64),
+                    decode_batch_buckets=(1, 2, 4, 8))
+    defaults.update(kw)
+    return AsyncJaxEngine(cfg, EngineArgs(**defaults))
+
+
+def _req(tokens, max_tokens=8):
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    return PreprocessedRequest(
+        model="tiny", token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens,
+                                       ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0))
+
+
+async def _collect(eng, r):
+    toks = []
+    async for out in eng.generate(r):
+        toks.extend(out.token_ids)
+    return toks
+
+
+async def test_engine_int8_kv_serves_and_matches_bf16_greedy():
+    """Same weights, same greedy prompt: the int8-cache engine must produce
+    the same tokens as the full-precision cache on a short horizon (tiny
+    f32 model — quantization noise far below the logit gaps)."""
+    e_ref = _engine()
+    e_q = _engine(kv_cache_dtype="int8")
+    assert e_q._kv_quant and is_quant_cache(e_q.k_cache)
+    prompt = list(range(1, 20))
+    t_ref = await _collect(e_ref, _req(prompt))
+    t_q = await _collect(e_q, _req(prompt))
+    assert t_ref == t_q
+    await e_ref.close()
+    await e_q.close()
+
+
+async def test_engine_int8_prefix_cache_reuse_deterministic():
+    eng = _engine(kv_cache_dtype="int8")
+    prompt = list(range(1, 30))
+    t1 = await _collect(eng, _req(prompt))
+    t2 = await _collect(eng, _req(prompt))  # prefix-cache hit path
+    assert t1 == t2
+    await eng.close()
+
+
+async def test_engine_int8_with_kvbm_offload_onboard():
+    """Offload to host (f32 bundles) → clear device → onboard → decode must
+    be deterministic vs the never-offloaded run."""
+    eng = _engine(kv_cache_dtype="int8", kvbm_host_bytes=1 << 24)
+    prompt = list(range(1, 40))
+    t1 = await _collect(eng, _req(prompt))
+    # force everything off-device, then replay: onboard path re-quantizes
+    for _ in range(50):
+        if eng.kvbm.offloaded_blocks:
+            break
+        await asyncio.sleep(0.05)
+    eng.pool.clear()
+    t2 = await _collect(eng, _req(prompt))
+    assert t1 == t2
+    await eng.close()
+
+
+async def test_engine_int8_multi_step_decode():
+    e_q = _engine(kv_cache_dtype="int8", multi_step_decode=4)
+    e_ref = _engine(kv_cache_dtype="int8")
+    prompt = list(range(1, 16))
+    assert await _collect(e_q, _req(prompt)) == \
+        await _collect(e_ref, _req(prompt))
+    await e_q.close()
+    await e_ref.close()
+
+
+async def test_engine_int8_spec_decode():
+    e_q = _engine(kv_cache_dtype="int8", speculative_tokens=3)
+    e_ref = _engine(kv_cache_dtype="int8")
+    prompt = [1, 2, 3, 4, 1, 2, 3, 4, 1, 2, 3]  # n-gram-friendly
+    assert await _collect(e_q, _req(prompt)) == \
+        await _collect(e_ref, _req(prompt))
+    await e_q.close()
+    await e_ref.close()
