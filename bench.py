@@ -63,11 +63,12 @@ def _roofline(params, tok_s: float, reads_per_s: float, prefix: str) -> dict:
 
 # --------------------------------------------------------------- kernel phase
 
-def kernel_bench(on_tpu: bool, quantization=None) -> dict:
+def kernel_bench(on_tpu: bool, quantization=None, kv_int8=False) -> dict:
     import jax
     import jax.numpy as jnp
 
     from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.cache import allocate_device_cache
     from dynamo_tpu.engine.config import ModelConfig
 
     if on_tpu:
@@ -80,7 +81,6 @@ def kernel_bench(on_tpu: bool, quantization=None) -> dict:
     block_size = 16
     W = (kv_len + K + block_size - 1) // block_size
     num_blocks = B * W + 1
-    dtype = jnp.dtype(cfg.dtype)
 
     params = M.init_params(cfg, jax.random.key(0))
     if quantization:
@@ -88,9 +88,8 @@ def kernel_bench(on_tpu: bool, quantization=None) -> dict:
 
         params = jax.device_put(quantize_params(
             jax.tree.map(np.asarray, params), quantization))
-    shape = (cfg.num_layers, num_blocks * block_size, cfg.num_kv_heads, cfg.head_dim)
-    k_cache = jnp.zeros(shape, dtype)
-    v_cache = jnp.zeros(shape, dtype)
+    k_cache, v_cache = allocate_device_cache(
+        cfg, num_blocks, block_size, dtype="int8" if kv_int8 else None)
 
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B,)), jnp.int32)
@@ -123,6 +122,8 @@ def kernel_bench(on_tpu: bool, quantization=None) -> dict:
     dt = time.perf_counter() - t0
     tok_s = B * K * iters / dt
     tag = "kernel" if not quantization else f"kernel_{quantization}"
+    if kv_int8:
+        tag += "_kv8"
     return {f"{tag}_tok_s": round(tok_s, 1),
             f"{tag}_shape": f"B={B},kv={kv_len},K={K}",
             **_roofline(params, tok_s, iters * K / dt, tag)}
@@ -316,6 +317,58 @@ def _init_backend() -> tuple[str, bool]:
 
 
 def main():
+    """Parent orchestrator: try the full bench in a CHILD process under a
+    hard deadline; if the child hangs or dies without a metric, rerun it
+    pinned to CPU. The r3→r4 lesson: the axon tunnel can pass the init
+    probe and then wedge mid-compile (observed 2026-07-30: jax.devices()
+    answered at 22:39, wedged from 22:40 on), which left the driver with
+    rc=124 and NO metric line. A deadline around the whole attempt makes
+    that outcome impossible: the driver always gets one JSON line.
+
+    DYN_BENCH_TPU_DEADLINE (default 2700 s) bounds the TPU attempt —
+    generous because first compiles of the 1B multi-step program over the
+    tunnel are minutes each."""
+    import subprocess
+    import sys
+
+    if os.environ.get("DYN_BENCH_CHILD"):
+        _child_main()
+        return
+
+    deadline = int(os.environ.get("DYN_BENCH_TPU_DEADLINE", "2700"))
+    attempts = [({}, deadline)]
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        attempts.append(({"JAX_PLATFORMS": "cpu"}, 1800))
+    for extra_env, tmo in attempts:
+        env = {**os.environ, "DYN_BENCH_CHILD": "1", **extra_env}
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, timeout=tmo, capture_output=True,
+                               text=True)
+        except subprocess.TimeoutExpired:
+            print(f"bench child timed out after {tmo}s "
+                  f"(env {extra_env}); falling back", file=sys.stderr,
+                  flush=True)
+            continue
+        line = next((ln for ln in reversed(r.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        if line is not None:
+            # replay the child's non-metric output for the log, then the
+            # ONE metric line last (driver parses the tail)
+            for ln in r.stdout.splitlines():
+                if ln is not line:
+                    print(ln, flush=True)
+            sys.stderr.write(r.stderr[-4000:])
+            print(line, flush=True)
+            return
+        sys.stderr.write(r.stderr[-4000:])
+    print(json.dumps({"metric": "bench_failed", "value": 0.0,
+                      "unit": "tok/s", "vs_baseline": 0.0,
+                      "extra": {"error": "all bench children hung/died"}}),
+          flush=True)
+
+
+def _child_main():
     """Always prints exactly ONE JSON metric line, whatever breaks.
 
     Result quality degrades in stages instead of vanishing: full e2e metric →
@@ -338,6 +391,12 @@ def main():
             kern.update(kernel_bench(on_tpu, quantization="int8"))
         except Exception as e:  # noqa: BLE001 — optional extra datum
             kern["kernel_int8_error"] = repr(e)[:200]
+        try:
+            # int8 KV pages: the other half of decode's HBM traffic
+            kern.update(kernel_bench(on_tpu, quantization="int8",
+                                     kv_int8=True))
+        except Exception as e:  # noqa: BLE001 — optional extra datum
+            kern["kernel_kv8_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         out = {
             "metric": f"kernel_decode_tok_s_per_chip[{model},{platform},"

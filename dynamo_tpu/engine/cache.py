@@ -99,6 +99,49 @@ def dequantize_kv(q, s, dtype=None):
     return out if dtype is None else out.astype(dtype)
 
 
+def pack_kv_blocks(q, s):
+    """(int8 [..., bs, KV, hd], f32 [..., bs, KV]) → uint8 [..., X] with
+    X = bs·KV·(hd+4): q bytes then scale bytes, per leading index.
+
+    The NATIVE bundle format for quantized caches: offload tiers and the
+    disagg wire carry ~1.03 bytes/element instead of the 4 an f32 bundle
+    costs (and the device→host copy shrinks the same way). Byte order is
+    the host's native layout — every TPU-VM in a fleet is little-endian,
+    and bundles never persist across architectures."""
+    import jax
+    import jax.numpy as jnp
+
+    bs, KV, hd = q.shape[-3:]
+    lead = q.shape[:-3]
+    qb = jax.lax.bitcast_convert_type(q, jnp.uint8).reshape(
+        *lead, bs * KV * hd)
+    sb = jax.lax.bitcast_convert_type(s, jnp.uint8).reshape(
+        *lead, bs * KV * 4)
+    return jnp.concatenate([qb, sb], axis=-1)
+
+
+def unpack_kv_blocks(buf, block_size: int, KV: int, hd: int):
+    """Inverse of :func:`pack_kv_blocks`: uint8 [..., X] →
+    (int8 [..., bs, KV, hd], f32 [..., bs, KV])."""
+    import jax
+    import jax.numpy as jnp
+
+    bs = block_size
+    lead = buf.shape[:-1]
+    nq = bs * KV * hd
+    buf = jnp.asarray(buf)
+    q = jax.lax.bitcast_convert_type(
+        buf[..., :nq], jnp.int8).reshape(*lead, bs, KV, hd)
+    s = jax.lax.bitcast_convert_type(
+        buf[..., nq:].reshape(*lead, bs, KV, 4), jnp.float32)
+    return q, s
+
+
+def packed_block_width(block_size: int, KV: int, hd: int) -> int:
+    """Trailing byte width of a packed quant-bundle row."""
+    return block_size * KV * (hd + 4)
+
+
 @dataclass
 class BlockMeta:
     block_id: int

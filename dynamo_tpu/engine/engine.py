@@ -503,10 +503,16 @@ class AsyncJaxEngine:
         self.pool.release(ids)
 
     def check_bundle_dims(self, bundle) -> bool:
-        from dynamo_tpu.engine.cache import cache_shape
+        from dynamo_tpu.engine.cache import cache_shape, packed_block_width
         L, slots, KV, hd = cache_shape(self.k_cache)
-        return (bundle.block_size == self.args.block_size
-                and bundle.k.shape[0] == L and bundle.k.shape[3:] == (KV, hd))
+        if bundle.block_size != self.args.block_size:
+            return False
+        k = bundle.k
+        if k.ndim == 3:  # packed quant bundle [L, n, X]
+            return (k.shape[0] == L and k.dtype == np.uint8
+                    and k.shape[2] == packed_block_width(
+                        self.args.block_size, KV, hd))
+        return k.shape[0] == L and k.shape[3:] == (KV, hd)
 
     def scatter_chunk(self, ids, k: np.ndarray, v: np.ndarray) -> None:
         """Place received pages [L, n, bs, KV, hd] into device blocks ``ids``."""

@@ -39,23 +39,24 @@ def _pad_pow2_ids(block_ids: np.ndarray) -> np.ndarray:
 def gather_blocks(cache, block_ids, *, block_size: int) -> jax.Array:
     """Pull whole blocks out of the flat paged cache.
 
-    cache: [L, num_slots, KV, hd] array, or an int8 {"q","s"} cache.
-    Returns [L, P, block_size, KV, hd] where P = next pow2 ≥ n (trailing
-    entries repeat the last block; slice host-side if exact n is needed).
+    cache: [L, num_slots, KV, hd] array → bundle [L, P, block_size, KV, hd];
+    int8 {"q","s"} cache → PACKED uint8 bundle [L, P, bs·KV·(hd+4)] (native
+    (q, s) bytes — engine/cache.pack_kv_blocks). P = next pow2 ≥ n (trailing
+    entries repeat the last block; slice axis 1 host-side for exact n).
 
-    int8 caches dequantize into an f32 bundle: int8 × f32-scale products
-    are exact in f32 and re-quantize to the identical (q, s) pair, so
-    KVBM offload→onboard and disagg transfer stay bit-deterministic
-    (see engine/cache.py int8 notes)."""
-    from dynamo_tpu.engine.cache import dequantize_kv, is_quant_cache
+    Packed bundles keep KVBM tiers and the disagg wire at ~1 byte/element
+    (4x smaller than an f32 bundle, 2x smaller than bf16) and make the
+    offload→onboard roundtrip bit-exact by construction — the packing
+    happens on device, so the device→host copy shrinks identically."""
+    from dynamo_tpu.engine.cache import is_quant_cache, pack_kv_blocks
 
     if is_quant_cache(cache):
         L, slots, KV, hd = cache["q"].shape
         ids = jnp.asarray(_pad_pow2_ids(np.asarray(block_ids, np.int32)))
         qp = cache["q"].reshape(L, slots // block_size, block_size, KV, hd)
         sp = cache["s"].reshape(L, slots // block_size, block_size, KV)
-        return dequantize_kv(jnp.take(qp, ids, axis=1),
-                             jnp.take(sp, ids, axis=1))
+        return pack_kv_blocks(jnp.take(qp, ids, axis=1),
+                              jnp.take(sp, ids, axis=1))
     L, slots, KV, hd = cache.shape
     ids = _pad_pow2_ids(np.asarray(block_ids, np.int32))
     paged = cache.reshape(L, slots // block_size, block_size, KV, hd)
@@ -87,27 +88,64 @@ def _scatter_quant(cache, block_ids, bundle, *, block_size):
     }
 
 
+@functools.partial(jax.jit, static_argnames=("block_size",), donate_argnums=(0,))
+def _scatter_packed(cache, block_ids, bundle, *, block_size):
+    """Write a packed uint8 bundle's (q, s) bytes straight into the cache
+    leaves — no requant, bit-exact by construction."""
+    from dynamo_tpu.engine.cache import unpack_kv_blocks
+
+    L, slots, KV, hd = cache["q"].shape
+    qb, sb = unpack_kv_blocks(bundle, block_size, KV, hd)
+    qp = cache["q"].reshape(L, slots // block_size, block_size, KV, hd)
+    sp = cache["s"].reshape(L, slots // block_size, block_size, KV)
+    return {
+        "q": qp.at[:, block_ids].set(qb).reshape(L, slots, KV, hd),
+        "s": sp.at[:, block_ids].set(sb).reshape(L, slots, KV),
+    }
+
+
+def _is_packed(bundle) -> bool:
+    return np.asarray(bundle).dtype == np.uint8 and np.asarray(bundle).ndim == 3
+
+
 def scatter_blocks(cache, block_ids, bundle, *, block_size: int):
     """Write a gathered bundle into blocks of the cache; returns new cache.
 
-    bundle: [L, n, bs, KV, hd] (np or jax). The flat cache is donated at the
-    jit boundary (reshapes live inside it), so the write is in-place in HBM —
-    no transient second cache. ids/bundle are pow2-padded (idempotent
-    duplicate writes) to bound the compile cache. int8 caches re-quantize
-    the bundle in-trace (bit-exact for bundles born from gather_blocks).
+    bundle: [L, n, bs, KV, hd] values (np or jax), or a packed uint8
+    [L, n, X] quant bundle (gather_blocks' native int8-cache format). The
+    flat cache is donated at the jit boundary (reshapes live inside it), so
+    the write is in-place in HBM — no transient second cache. ids/bundle
+    are pow2-padded (idempotent duplicate writes) to bound the compile
+    cache.
+
+    Cross-layout pairs both work: a packed bundle into a plain cache
+    dequantizes on the way in (mixed prefill/decode deployments); a value
+    bundle into an int8 cache re-quantizes in-trace (bit-exact for bundles
+    that started as quantized pages — engine/cache.py int8 notes).
     """
-    from dynamo_tpu.engine.cache import is_quant_cache
+    from dynamo_tpu.engine.cache import (
+        is_quant_cache, unpack_kv_blocks, dequantize_kv,
+    )
 
     ids = np.asarray(block_ids, np.int32)
     n = len(ids)
     pids = _pad_pow2_ids(ids)
+    packed = _is_packed(bundle)
     if len(pids) != n:
         pad = np.repeat(np.asarray(bundle[:, -1:]), len(pids) - n, axis=1)
         bundle = np.concatenate([np.asarray(bundle), pad], axis=1)
     if is_quant_cache(cache):
+        if packed:
+            return _scatter_packed(cache, jnp.asarray(pids),
+                                   jnp.asarray(bundle),
+                                   block_size=block_size)
         return _scatter_quant(cache, jnp.asarray(pids),
                               jnp.asarray(bundle, jnp.float32),
                               block_size=block_size)
+    if packed:  # quantized prefill → full-precision decode cache
+        KV, hd = cache.shape[2], cache.shape[3]
+        qb, sb = unpack_kv_blocks(jnp.asarray(bundle), block_size, KV, hd)
+        bundle = dequantize_kv(qb, sb)
     return _scatter(cache, jnp.asarray(pids),
                     jnp.asarray(bundle).astype(cache.dtype),
                     block_size=block_size)

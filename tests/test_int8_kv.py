@@ -203,9 +203,29 @@ def test_flash_prefill_paged_int8():
 
 # ------------------------------------------------------- gather/scatter paths
 
+def test_pack_unpack_roundtrip():
+    from dynamo_tpu.engine.cache import (
+        pack_kv_blocks, packed_block_width, unpack_kv_blocks,
+    )
+
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((2, 3, 4, 2, 16)).astype(np.float32)
+    q, s = quantize_kv(x)
+    import jax.numpy as jnp
+
+    buf = pack_kv_blocks(jnp.asarray(q), jnp.asarray(s))
+    assert buf.shape == (2, 3, packed_block_width(4, 2, 16))
+    assert buf.dtype == np.uint8
+    q2, s2 = unpack_kv_blocks(buf, 4, 2, 16)
+    np.testing.assert_array_equal(np.asarray(q2), q)
+    np.testing.assert_array_equal(np.asarray(s2), s)
+
+
 def test_gather_scatter_roundtrip_bit_exact():
     """offload → onboard over an int8 cache must restore the identical
-    quantized pages (the determinism KVBM promises across tiers)."""
+    quantized pages (the determinism KVBM promises across tiers). The
+    native bundle is PACKED uint8 — ~1 byte/element on the wire/tiers."""
+    from dynamo_tpu.engine.cache import packed_block_width
     from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks
 
     cfg = ModelConfig.tiny()
@@ -220,7 +240,8 @@ def test_gather_scatter_roundtrip_bit_exact():
     k = {"q": jnp.asarray(kq), "s": jnp.asarray(ks)}
     ids = [2, 5, 3]
     bundle = np.asarray(gather_blocks(k, ids, block_size=4))[:, :3]
-    assert bundle.dtype == np.float32
+    assert bundle.dtype == np.uint8
+    assert bundle.shape == (L, 3, packed_block_width(4, KV, hd))
     # snapshot before scatter: the cache is DONATED at the jit boundary
     q_src = np.asarray(k["q"]).reshape(L, slots // 4, 4, KV, hd)[:, [2, 5, 3]]
     # scatter into DIFFERENT blocks, then gather back: bit-identical
@@ -230,6 +251,29 @@ def test_gather_scatter_roundtrip_bit_exact():
     # and the quantized representation round-tripped exactly
     q_dst = np.asarray(k2["q"]).reshape(L, slots // 4, 4, KV, hd)[:, [6, 1, 7]]
     np.testing.assert_array_equal(q_src, q_dst)
+
+
+def test_packed_bundle_into_plain_cache_dequantizes():
+    """Quantized prefill worker → full-precision decode worker: the packed
+    bundle must land as dequantized values."""
+    from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks
+
+    cfg = ModelConfig.tiny()
+    kq_cache, _ = allocate_device_cache(cfg, 8, 4, dtype="int8")
+    kp_cache, _ = allocate_device_cache(cfg, 8, 4, dtype="float32")
+    rng = np.random.default_rng(5)
+    L, slots, KV, hd = cache_shape(kq_cache)
+    kf = rng.standard_normal((L, slots, KV, hd)).astype(np.float32)
+    kq, ks = quantize_kv(kf)
+    import jax.numpy as jnp
+
+    src = {"q": jnp.asarray(kq), "s": jnp.asarray(ks)}
+    bundle = np.asarray(gather_blocks(src, [2, 5], block_size=4))[:, :2]
+    out = scatter_blocks(kp_cache, [1, 3], bundle, block_size=4)
+    got = np.asarray(gather_blocks(out, [1, 3], block_size=4))[:, :2]
+    want = dequantize_kv(kq, ks).reshape(
+        L, slots // 4, 4, KV, hd)[:, [2, 5]]
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
 
 
 # --------------------------------------------------------------- engine e2e
