@@ -350,16 +350,17 @@ def main():
                   f"(env {extra_env}); falling back", file=sys.stderr,
                   flush=True)
             continue
-        line = next((ln for ln in reversed(r.stdout.splitlines())
-                     if ln.startswith("{")), None)
-        if line is not None:
+        lines = r.stdout.splitlines()
+        idx = next((i for i in range(len(lines) - 1, -1, -1)
+                    if lines[i].startswith("{")), None)
+        if idx is not None:
             # replay the child's non-metric output for the log, then the
             # ONE metric line last (driver parses the tail)
-            for ln in r.stdout.splitlines():
-                if ln is not line:
+            for i, ln in enumerate(lines):
+                if i != idx:
                     print(ln, flush=True)
             sys.stderr.write(r.stderr[-4000:])
-            print(line, flush=True)
+            print(lines[idx], flush=True)
             return
         sys.stderr.write(r.stderr[-4000:])
     print(json.dumps({"metric": "bench_failed", "value": 0.0,

@@ -164,8 +164,12 @@ class DecodeWorkerHandler:
 
         logger.debug("remote prefill: %d prompt tokens → prefill fleet",
                      len(req.token_ids))
+        caps = [KV_CHUNKS_ANNOTATION]
+        direct_cap = getattr(self.engine, "direct_capability", lambda: None)()
+        if direct_cap:
+            caps.append(direct_cap)
         preq = dataclasses.replace(
-            req, annotations=list(req.annotations or []) + [KV_CHUNKS_ANNOTATION])
+            req, annotations=list(req.annotations or []) + caps)
         instance_id = None
         if self.prefill_queue is not None:
             instance_id = await self.prefill_queue.acquire()
@@ -195,11 +199,32 @@ class DecodeWorkerHandler:
         presp = None
         owned = False  # ids ownership not yet transferred to a sequence
         try:
+            from dynamo_tpu.disagg.transfer import KvDirectFrame, pull_bundle
+
             async for frame in stream:
-                if KvChunkFrame.is_wire(frame):
-                    ch = KvChunkFrame.from_wire(frame).bundle
+                if KvChunkFrame.is_wire(frame) or KvDirectFrame.is_wire(frame):
                     if not placed:
-                        continue  # keep draining: the final frame has the token
+                        # keep draining: the final frame has the token. Drop
+                        # unclaimed same-process offers now instead of
+                        # pinning gathered pages until the TTL sweep
+                        if (KvDirectFrame.is_wire(frame)
+                                and eng.direct_transfer is not None):
+                            eng.direct_transfer.retract(
+                                KvDirectFrame.from_wire(frame).desc)
+                        continue
+                    if KvDirectFrame.is_wire(frame):
+                        try:
+                            # device-to-device pull (disagg/transfer.py) —
+                            # the descriptor frame carries no page bytes
+                            ch = pull_bundle(eng.direct_transfer,
+                                             KvDirectFrame.from_wire(frame))
+                        except Exception:
+                            logger.exception("direct KV pull failed; will "
+                                             "recompute prefill locally")
+                            placed = False
+                            continue
+                    else:
+                        ch = KvChunkFrame.from_wire(frame).bundle
                     n = ch.k.shape[1]
                     if (not eng.check_bundle_dims(ch)
                             or ch.start_block != next_block

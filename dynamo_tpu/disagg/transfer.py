@@ -1,0 +1,276 @@
+"""Direct device-to-device KV transfer — the NIXL analog (SURVEY §5.8 "Bulk
+KV transfer" option (a)).
+
+The host-staged KvBundle path (protocols.py) serializes every page through
+host RAM and the response plane. That is the right DCN fallback, but when
+prefill and decode sit in the same pod it pays two PCIe/DMA hops and a
+serialize/deserialize the hardware doesn't require. The reference avoids
+this with NIXL: workers publish transfer metadata to etcd and the decode GPU
+pulls pages directly over RDMA/NVLink (ref:
+docs/architecture/disagg_serving.md:92-103,
+lib/llm/src/block_manager/block/transfer/nixl.rs). The TPU equivalents:
+
+1. **same-process** — prefill and decode engines share one JAX client
+   (co-located roles on one TPU VM, in-proc tests, the CPU dryrun mesh).
+   Gathered page arrays move by reference through an in-process offer
+   registry: zero copies, zero host staging.
+2. **cross-process TPU** — ``jax.experimental.transfer``: prefill registers
+   the gathered device arrays under a uuid on its TransferServer and ships
+   only a small descriptor (uuid + server address + shape/dtype) over the
+   response plane; the decode process pulls the pages device-to-device over
+   ICI (same pod) or DCN (cross-slice). Exactly NIXL's metadata/bulk split:
+   descriptor on the control path, pages on the fast path.
+3. anything else (CPU cross-process, version skew, pull failure) — the
+   caller keeps the host-staged KvBundle path.
+
+Mode selection is capability-negotiated per request: the decode worker
+advertises ``kv_direct:<proc>/<platform>`` in the request annotations; the
+prefill worker compares against its own identity and only offers a direct
+descriptor when the pull can actually succeed. A failed pull on the decode
+side degrades to local prefill recompute (the handler's existing
+``placed=False`` path), never to a wrong answer.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import socket
+import threading
+import time
+import uuid as _uuidlib
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("dynamo.disagg.transfer")
+
+#: annotation prefix by which a decode worker advertises direct-pull reach
+KV_DIRECT_ANNOTATION = "kv_direct"
+
+_proc_token: Optional[str] = None
+_uuid_counter = itertools.count(1)
+_uuid_base = int.from_bytes(os.urandom(6), "big") << 24
+
+# in-process offer registry (path 1). Shared across all engines in the
+# process: the decode engine pops what the prefill engine pushed.
+_offers: dict[int, tuple[float, object]] = {}
+_offers_lock = threading.Lock()
+
+
+def proc_token() -> str:
+    """Identity of this process for same-process detection. Random suffix
+    guards against pid reuse across worker restarts."""
+    global _proc_token
+    if _proc_token is None:
+        _proc_token = (f"{socket.gethostname()}:{os.getpid()}:"
+                       f"{_uuidlib.uuid4().hex[:8]}")
+    return _proc_token
+
+
+def _platform() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _sweep_locked(now: float) -> None:
+    dead = [u for u, (exp, _) in _offers.items() if exp < now]
+    for u in dead:
+        del _offers[u]
+    if dead:
+        logger.warning("evicted %d expired direct-KV offers (decode side "
+                       "never pulled — fell back to local prefill?)", len(dead))
+
+
+class DirectTransferManager:
+    """Per-engine manager for direct KV page transfer.
+
+    One instance per engine; the same-process registry underneath is
+    process-global, so a decode engine's ``pull`` finds a co-located prefill
+    engine's ``offer`` regardless of which manager made it.
+    """
+
+    def __init__(self, ttl_s: float = 60.0, enable_ici: bool = True):
+        self.ttl_s = ttl_s
+        self.enable_ici = enable_ici
+        self._server = None          # lazy TransferServer (TPU only)
+        self._conns: dict[str, object] = {}   # address -> TransferConnection
+        self.stats = {"offers": 0, "pulls": 0, "pull_failures": 0}
+
+    # ------------------------------------------------------------ capability
+
+    def capability(self) -> str:
+        """What a decode worker advertises in request annotations."""
+        return f"{KV_DIRECT_ANNOTATION}:{proc_token()}/{_platform()}"
+
+    @staticmethod
+    def parse_capability(annotations) -> Optional[tuple[str, str]]:
+        """(proc, platform) from a request's annotations, or None."""
+        for a in annotations or []:
+            if isinstance(a, str) and a.startswith(KV_DIRECT_ANNOTATION + ":"):
+                body = a.split(":", 1)[1]
+                if "/" in body:
+                    proc, platform = body.rsplit("/", 1)
+                    return proc, platform
+        return None
+
+    def choose_mode(self, annotations) -> Optional[str]:
+        """Prefill-side path selection: "proc" | "ici" | None (host-staged).
+
+        Conservative by design: a wrong "direct" choice costs a prefill
+        recompute on the decode side, so only offer it when the pull is
+        expected to succeed (same process, or both ends on TPU where the
+        transfer server moves bytes over ICI/DCN).
+        """
+        cap = self.parse_capability(annotations)
+        if cap is None:
+            return None
+        peer_proc, peer_platform = cap
+        if peer_proc == proc_token():
+            return "proc"
+        if (self.enable_ici and peer_platform == "tpu"
+                and _platform() == "tpu"):
+            return "ici"
+        return None
+
+    # ----------------------------------------------------------- server side
+
+    def _ensure_server(self):
+        if self._server is None:
+            import jax
+            from jax.experimental import transfer
+
+            client = jax.devices()[0].client
+            # [::]:0 binds an ephemeral port on all interfaces; the address
+            # in the descriptor is what peers dial (NIXL-metadata analog)
+            self._server = transfer.start_transfer_server(client)
+            logger.info("KV transfer server listening on %s",
+                        self._server.address())
+        return self._server
+
+    def offer(self, mode: str, arrays: list, meta: dict) -> dict:
+        """Register device arrays for a remote pull; returns the wire
+        descriptor. ``meta`` carries num_tokens/block_size/start_block."""
+        uid = _uuid_base + next(_uuid_counter)
+        now = time.monotonic()
+        desc = {
+            "mode": mode,
+            "proc": proc_token(),
+            "uuid": uid,
+            "arrays": [{"shape": list(x.shape), "dtype": str(x.dtype)}
+                       for x in arrays],
+            **meta,
+        }
+        if mode == "proc":
+            with _offers_lock:
+                _sweep_locked(now)
+                _offers[uid] = (now + self.ttl_s, arrays)
+        elif mode == "ici":
+            srv = self._ensure_server()
+            srv.await_pull(uid, arrays)
+            desc["addr"] = srv.address()
+        else:
+            raise ValueError(f"unknown transfer mode {mode!r}")
+        self.stats["offers"] += 1
+        return desc
+
+    def retract(self, desc: dict) -> None:
+        """Drop a same-process offer that will never be pulled (request
+        aborted). Server-side ("ici") offers have no cancel API upstream;
+        they are bounded by the decode worker's pull-or-fallback discipline."""
+        if desc.get("mode") == "proc":
+            with _offers_lock:
+                _offers.pop(desc["uuid"], None)
+
+    # ----------------------------------------------------------- client side
+
+    def pull(self, desc: dict) -> list:
+        """Fetch the offered arrays; raises on any failure (caller falls
+        back to local prefill)."""
+        try:
+            out = self._pull(desc)
+            self.stats["pulls"] += 1
+            return out
+        except Exception:
+            self.stats["pull_failures"] += 1
+            raise
+
+    def _pull(self, desc: dict) -> list:
+        mode = desc.get("mode")
+        if mode == "proc":
+            if desc.get("proc") != proc_token():
+                raise RuntimeError("same-process KV descriptor from another "
+                                   "process (capability skew)")
+            with _offers_lock:
+                entry = _offers.pop(desc["uuid"], None)
+            if entry is None:
+                raise RuntimeError(f"direct KV offer {desc['uuid']} expired "
+                                   "or already claimed")
+            return entry[1]
+        if mode == "ici":
+            import jax
+            import jax.numpy as jnp
+
+            conn = self._conns.get(desc["addr"])
+            if conn is None:
+                conn = self._ensure_server().connect(desc["addr"])
+                self._conns[desc["addr"]] = conn
+            dev = jax.devices()[0]
+            sharding = jax.sharding.SingleDeviceSharding(dev)
+            xs = [jax.ShapeDtypeStruct(tuple(a["shape"]),
+                                       jnp.dtype(a["dtype"]),
+                                       sharding=sharding)
+                  for a in desc["arrays"]]
+            return conn.pull(desc["uuid"], xs)
+        raise RuntimeError(f"unknown transfer mode {mode!r}")
+
+    def close(self) -> None:
+        self._conns.clear()
+        self._server = None
+
+
+# ------------------------------------------------------------------- wire
+
+class KvDirectFrame:
+    """Response-plane frame carrying a direct-transfer descriptor instead of
+    page bytes. Pairs with KvChunkFrame: same streaming positions (mid-
+    prefill chunks and the pre-response tail), ~100 bytes instead of the
+    pages themselves."""
+
+    def __init__(self, desc: dict):
+        self.desc = desc
+
+    def to_wire(self) -> dict:
+        return {"kv_direct": self.desc}
+
+    @staticmethod
+    def is_wire(d: dict) -> bool:
+        return isinstance(d, dict) and "kv_direct" in d
+
+    @staticmethod
+    def from_wire(d: dict) -> "KvDirectFrame":
+        return KvDirectFrame(d["kv_direct"])
+
+
+class DirectKvBundle:
+    """KvBundle-shaped view over pulled device arrays, so the decode
+    handler's dim checks and scatter path treat both transports alike."""
+
+    def __init__(self, k, v, num_tokens: int, block_size: int,
+                 start_block: int):
+        self.k = k
+        self.v = v
+        self.num_tokens = num_tokens
+        self.block_size = block_size
+        self.start_block = start_block
+
+
+def pull_bundle(mgr: DirectTransferManager, frame: KvDirectFrame
+                ) -> DirectKvBundle:
+    d = frame.desc
+    k, v = mgr.pull(d)
+    return DirectKvBundle(k=k, v=v, num_tokens=d["num_tokens"],
+                          block_size=d["block_size"],
+                          start_block=d.get("start_block", 0))

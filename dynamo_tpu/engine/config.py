@@ -318,6 +318,11 @@ class EngineArgs:
     #: the reference's G1 tier (lib/llm/src/block_manager/). Not yet
     #: supported for MLA latent caches (falls back to model dtype).
     kv_cache_dtype: Optional[str] = None
+    #: disagg KV transfer: offer direct device-to-device page pulls
+    #: (same-process registry / jax.experimental.transfer over ICI) when the
+    #: decode worker advertises reach — the NIXL analog (disagg/transfer.py).
+    #: False = always host-staged bundles over the response plane.
+    kv_transfer_direct: bool = True
     seed: int = 0
 
     def __post_init__(self):

@@ -182,6 +182,19 @@ class AsyncJaxEngine:
         #: multi-process DP fleet rank (None = single-rank); reported in
         #: worker stats (ref: kv_router/protocols.rs:57 data_parallel_rank)
         self.dp_rank: Optional[int] = None
+        #: direct device-to-device KV transfer for disagg (NIXL analog);
+        #: None = host-staged bundles only
+        self.direct_transfer = None
+        if args.kv_transfer_direct:
+            from dynamo_tpu.disagg.transfer import DirectTransferManager
+            self.direct_transfer = DirectTransferManager()
+
+    def direct_capability(self) -> Optional[str]:
+        """Annotation a decode worker sends so prefill can offer direct
+        device-to-device KV pulls (disagg/transfer.py)."""
+        if self.direct_transfer is None:
+            return None
+        return self.direct_transfer.capability()
 
     # ------------------------------------------------------------------ api
 
@@ -381,9 +394,15 @@ class AsyncJaxEngine:
         from dynamo_tpu.disagg.protocols import KvChunkFrame, PrefillResponse
 
         from dynamo_tpu.disagg.protocols import KvBundle
+        from dynamo_tpu.disagg.transfer import KvDirectFrame
         from dynamo_tpu.ops.block_copy import gather_blocks
 
         self._ensure_loop()
+        # direct device-to-device mode when the decode worker's capability
+        # annotation says the pull can succeed (disagg/transfer.py); pages
+        # then never touch the host on this side — only descriptors ship
+        mode = (self.direct_transfer.choose_mode(req.annotations)
+                if self.direct_transfer is not None else None)
         bs = self.args.block_size
         sc = dataclasses.replace(req.stop_conditions, max_tokens=1,
                                  min_tokens=1, ignore_eos=True)
@@ -442,6 +461,13 @@ class AsyncJaxEngine:
                     # FIFO ordering guarantees every chunk event lands before
                     # the finish output that follows it in the queue
                     start, n, kb, vb = val
+                    if mode is not None:
+                        desc = self.direct_transfer.offer(
+                            mode, [kb[:, :n], vb[:, :n]],
+                            {"num_tokens": (start + n) * bs,
+                             "block_size": bs, "start_block": start})
+                        yield KvDirectFrame(desc).to_wire()
+                        continue
                     k, v = await to_host(kb, vb, n)
                     b = KvBundle(k=k, v=v, num_tokens=(start + n) * bs,
                                  block_size=bs, start_block=start)
@@ -462,8 +488,23 @@ class AsyncJaxEngine:
             shipped = state["shipped"]
             bundle = None
             if total > shipped:
-                bundle = await self._gather_bundle(
-                    seq.block_table[shipped:total], seq.prompt_len, shipped)
+                if mode is not None:
+                    n = total - shipped
+                    kb = gather_blocks(self.k_cache,
+                                       seq.block_table[shipped:total],
+                                       block_size=bs)
+                    vb = gather_blocks(self.v_cache,
+                                       seq.block_table[shipped:total],
+                                       block_size=bs)
+                    desc = self.direct_transfer.offer(
+                        mode, [kb[:, :n], vb[:, :n]],
+                        {"num_tokens": seq.prompt_len, "block_size": bs,
+                         "start_block": shipped})
+                    yield KvDirectFrame(desc).to_wire()
+                else:
+                    bundle = await self._gather_bundle(
+                        seq.block_table[shipped:total], seq.prompt_len,
+                        shipped)
             yield PrefillResponse(token_id=token, logprob=logp,
                                   bundle=bundle).to_wire()
         finally:

@@ -105,7 +105,10 @@ def _scatter_packed(cache, block_ids, bundle, *, block_size):
 
 
 def _is_packed(bundle) -> bool:
-    return np.asarray(bundle).dtype == np.uint8 and np.asarray(bundle).ndim == 3
+    # attribute check, not np.asarray: device bundles must not round-trip
+    # through host memory just to inspect dtype
+    return (getattr(bundle, "dtype", None) == np.uint8
+            and getattr(bundle, "ndim", 0) == 3)
 
 
 def scatter_blocks(cache, block_ids, bundle, *, block_size: int):
@@ -132,8 +135,14 @@ def scatter_blocks(cache, block_ids, bundle, *, block_size: int):
     pids = _pad_pow2_ids(ids)
     packed = _is_packed(bundle)
     if len(pids) != n:
-        pad = np.repeat(np.asarray(bundle[:, -1:]), len(pids) - n, axis=1)
-        bundle = np.concatenate([np.asarray(bundle), pad], axis=1)
+        if isinstance(bundle, jax.Array):
+            # direct-transfer bundles live on device; pad there — a numpy
+            # round-trip would stage every page through host RAM
+            pad = jnp.repeat(bundle[:, -1:], len(pids) - n, axis=1)
+            bundle = jnp.concatenate([bundle, pad], axis=1)
+        else:
+            pad = np.repeat(np.asarray(bundle[:, -1:]), len(pids) - n, axis=1)
+            bundle = np.concatenate([np.asarray(bundle), pad], axis=1)
     if is_quant_cache(cache):
         if packed:
             return _scatter_packed(cache, jnp.asarray(pids),

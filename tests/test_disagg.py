@@ -248,6 +248,7 @@ async def test_pipelined_stream_failure_releases_injected_blocks():
     """Prefill stream dying after chunk frames landed must not leak the
     decode-side injected blocks (mid-stream failure surfaces upstream)."""
     from dynamo_tpu.disagg.protocols import KvChunkFrame
+    from dynamo_tpu.disagg.transfer import KvDirectFrame
 
     prompt = list(range(1, 151))
     pre = make_engine()
@@ -263,7 +264,8 @@ async def test_pipelined_stream_failure_releases_injected_blocks():
             async def stream():
                 async for frame in ph.generate(request, None):
                     yield frame
-                    if KvChunkFrame.is_wire(frame):
+                    if (KvChunkFrame.is_wire(frame)
+                            or KvDirectFrame.is_wire(frame)):
                         raise ConnectionError("prefill worker died")
             return stream()
 
@@ -429,3 +431,215 @@ async def test_disagg_threshold_watched_from_control_plane():
     assert cfg2.max_local_prefill_length == 64
     await w2.stop()
     await plane2.close()
+
+
+# ------------------------------------------------- direct (NIXL-analog) path
+
+class _LocalPrefillClient:
+    """Routes decode→prefill calls to an in-process PrefillWorkerHandler."""
+
+    def __init__(self, ph):
+        self.ph = ph
+
+    def available_ids(self):
+        return [1]
+
+    async def generate(self, request, mode="round_robin", instance_id=None):
+        async def stream():
+            async for frame in self.ph.generate(request, None):
+                yield frame
+        return stream()
+
+
+async def test_direct_transfer_same_process_matches_aggregated():
+    """Co-located prefill+decode negotiate the zero-copy direct path: only
+    descriptor frames cross the wire (no page bytes), the decode engine
+    pulls device arrays from the in-process registry, and the tokens equal
+    the aggregated run's exactly."""
+    from dynamo_tpu.disagg import transfer as T
+    from dynamo_tpu.disagg.transfer import KvDirectFrame
+
+    # earlier fallback tests may have parked offers (TTL-swept in prod)
+    T._offers.clear()
+
+    prompt = list(range(1, 151))
+    agg = make_engine()
+    want = await collect_engine(agg, req(prompt))
+    await agg.close()
+
+    pre = make_engine()
+    dec = make_engine()
+    ph = PrefillWorkerHandler(pre)
+
+    seen = {"direct": 0, "chunk": 0}
+
+    class SpyClient(_LocalPrefillClient):
+        async def generate(self, request, mode="round_robin",
+                           instance_id=None):
+            from dynamo_tpu.disagg.protocols import KvChunkFrame
+
+            async def stream():
+                async for frame in self.ph.generate(request, None):
+                    if KvDirectFrame.is_wire(frame):
+                        seen["direct"] += 1
+                    elif KvChunkFrame.is_wire(frame):
+                        seen["chunk"] += 1
+                    yield frame
+            return stream()
+
+    dh = DecodeWorkerHandler(dec, SpyClient(ph),
+                             DisaggConfig(max_local_prefill_length=8))
+    got = []
+    async for frame in dh.generate(req(prompt).to_wire(), None):
+        got.extend(frame.get("token_ids", []))
+    assert got == want
+    assert seen["direct"] >= 2 and seen["chunk"] == 0
+    assert pre.direct_transfer.stats["offers"] == seen["direct"]
+    assert dec.direct_transfer.stats["pulls"] == seen["direct"]
+    # every offer was claimed — nothing parked in the registry
+    from dynamo_tpu.disagg import transfer as T
+    assert not T._offers
+    await pre.close()
+    await dec.close()
+
+
+async def test_direct_disabled_uses_host_staged_bundles():
+    """kv_transfer_direct=False on the decode side → no capability
+    annotation → prefill ships host-staged KvChunkFrames (the DCN path)."""
+    from dynamo_tpu.disagg.protocols import KvChunkFrame
+    from dynamo_tpu.disagg.transfer import KvDirectFrame
+
+    prompt = list(range(1, 151))
+    agg = make_engine()
+    want = await collect_engine(agg, req(prompt))
+    await agg.close()
+
+    pre = make_engine()
+    dec = make_engine(kv_transfer_direct=False)
+    ph = PrefillWorkerHandler(pre)
+    seen = {"direct": 0, "chunk": 0}
+
+    class SpyClient(_LocalPrefillClient):
+        async def generate(self, request, mode="round_robin",
+                           instance_id=None):
+            async def stream():
+                async for frame in self.ph.generate(request, None):
+                    if KvDirectFrame.is_wire(frame):
+                        seen["direct"] += 1
+                    elif KvChunkFrame.is_wire(frame):
+                        seen["chunk"] += 1
+                    yield frame
+            return stream()
+
+    dh = DecodeWorkerHandler(dec, SpyClient(ph),
+                             DisaggConfig(max_local_prefill_length=8))
+    got = []
+    async for frame in dh.generate(req(prompt).to_wire(), None):
+        got.extend(frame.get("token_ids", []))
+    assert got == want
+    assert seen["chunk"] >= 2 and seen["direct"] == 0
+    await pre.close()
+    await dec.close()
+
+
+async def test_direct_pull_failure_falls_back_local():
+    """A decode worker whose pulls fail (expired offer / dead server) must
+    drain the stream, recompute prefill locally, and leak nothing."""
+    prompt = list(range(1, 151))
+    agg = make_engine()
+    want = await collect_engine(agg, req(prompt))
+    await agg.close()
+
+    pre = make_engine()
+    dec = make_engine()
+    free0 = dec.pool.num_free_blocks
+
+    def boom(desc):
+        raise RuntimeError("synthetic pull failure")
+
+    dec.direct_transfer.pull = boom
+    ph = PrefillWorkerHandler(pre)
+    dh = DecodeWorkerHandler(dec, _LocalPrefillClient(ph),
+                             DisaggConfig(max_local_prefill_length=8))
+    got = []
+    async for frame in dh.generate(req(prompt).to_wire(), None):
+        got.extend(frame.get("token_ids", []))
+    assert got == want
+    for _ in range(50):
+        if dec.pool.num_free_blocks == free0 and not dec.scheduler.has_work:
+            break
+        await asyncio.sleep(0.02)
+    assert dec.pool.num_free_blocks == free0
+    await pre.close()
+    await dec.close()
+
+
+async def test_direct_transfer_int8_kv_bit_exact():
+    """int8 KV caches on both ends: the direct path ships PACKED (q,s)
+    device bundles and the scatter is bit-exact — disagg tokens equal the
+    aggregated int8 run's."""
+    prompt = list(range(1, 151))
+    agg = make_engine(kv_cache_dtype="int8")
+    want = await collect_engine(agg, req(prompt))
+    await agg.close()
+
+    pre = make_engine(kv_cache_dtype="int8")
+    dec = make_engine(kv_cache_dtype="int8")
+    ph = PrefillWorkerHandler(pre)
+    dh = DecodeWorkerHandler(dec, _LocalPrefillClient(ph),
+                             DisaggConfig(max_local_prefill_length=8))
+    got = []
+    async for frame in dh.generate(req(prompt).to_wire(), None):
+        got.extend(frame.get("token_ids", []))
+    assert got == want
+    assert pre.direct_transfer.stats["offers"] >= 1
+    assert dec.direct_transfer.stats["pulls"] >= 1
+    await pre.close()
+    await dec.close()
+
+
+async def test_direct_offer_registry_ttl_eviction():
+    """Unclaimed same-process offers (decode fell back) are swept after the
+    TTL instead of pinning gathered pages forever."""
+    import numpy as np
+
+    from dynamo_tpu.disagg import transfer as T
+
+    mgr = T.DirectTransferManager(ttl_s=0.01)
+    desc = mgr.offer("proc", [np.zeros((2, 2))],
+                     {"num_tokens": 4, "block_size": 4, "start_block": 0})
+    assert desc["uuid"] in T._offers
+    import time
+    time.sleep(0.02)
+    # the sweep rides the next offer
+    mgr.offer("proc", [np.zeros((2, 2))],
+              {"num_tokens": 4, "block_size": 4, "start_block": 0})
+    assert desc["uuid"] not in T._offers
+    # explicit retract drops immediately
+    d2 = mgr.offer("proc", [np.zeros((2, 2))],
+                   {"num_tokens": 4, "block_size": 4, "start_block": 0})
+    mgr.retract(d2)
+    assert d2["uuid"] not in T._offers
+    with pytest.raises(RuntimeError):
+        mgr.pull(d2)
+    assert mgr.stats["pull_failures"] == 1
+    T._offers.clear()
+
+
+async def test_direct_capability_negotiation():
+    """Mode selection: same proc → "proc"; cross-proc CPU → host-staged
+    (None); no capability → None."""
+    from dynamo_tpu.disagg import transfer as T
+
+    mgr = T.DirectTransferManager()
+    assert mgr.choose_mode([mgr.capability()]) == "proc"
+    assert mgr.choose_mode(["kv_direct:otherhost:1:deadbeef/cpu"]) is None
+    assert mgr.choose_mode(["kv_chunks"]) is None
+    assert mgr.choose_mode(None) is None
+    # TPU↔TPU cross-process advertises the transfer-server path
+    other = "kv_direct:otherhost:1:deadbeef/tpu"
+    import unittest.mock as mock
+    with mock.patch.object(T, "_platform", return_value="tpu"):
+        assert mgr.choose_mode([other]) == "ici"
+    with mock.patch.object(T, "_platform", return_value="cpu"):
+        assert mgr.choose_mode([other]) is None  # cpu end: host-staged
