@@ -225,7 +225,7 @@ class DecodeWorkerHandler:
                             continue
                     else:
                         ch = KvChunkFrame.from_wire(frame).bundle
-                    n = ch.k.shape[1]
+                    n = ch.num_blocks
                     if (not eng.check_bundle_dims(ch)
                             or ch.start_block != next_block
                             or ch.start_block + n > total):
@@ -265,7 +265,7 @@ class DecodeWorkerHandler:
 
             tail = presp.bundle
             if tail is not None:
-                n = tail.k.shape[1]
+                n = tail.num_blocks
                 if (eng.check_bundle_dims(tail)
                         and tail.start_block == next_block
                         and tail.start_block + n <= total):

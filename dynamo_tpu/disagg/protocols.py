@@ -40,6 +40,13 @@ class KvBundle:
     block_size: int
     start_block: int = 0
 
+    @property
+    def num_blocks(self) -> int:
+        """Block count of the payload (host-staged bundles are sliced to
+        the exact count; direct device bundles override this — their arrays
+        keep the pow2-padded gather width)."""
+        return self.k.shape[1]
+
     def to_wire(self) -> dict:
         return {
             "shape": list(self.k.shape),

@@ -183,6 +183,7 @@ class DirectTransferManager:
         if desc.get("mode") == "proc":
             with _offers_lock:
                 _offers.pop(desc["uuid"], None)
+                _sweep_locked(time.monotonic())
 
     # ----------------------------------------------------------- client side
 
@@ -205,6 +206,9 @@ class DirectTransferManager:
                                    "process (capability skew)")
             with _offers_lock:
                 entry = _offers.pop(desc["uuid"], None)
+                # sweeping on every registry touch (offer/pull/retract)
+                # bounds how long an idle worker pins unclaimed pages
+                _sweep_locked(time.monotonic())
             if entry is None:
                 raise RuntimeError(f"direct KV offer {desc['uuid']} expired "
                                    "or already claimed")
@@ -256,15 +260,21 @@ class KvDirectFrame:
 
 class DirectKvBundle:
     """KvBundle-shaped view over pulled device arrays, so the decode
-    handler's dim checks and scatter path treat both transports alike."""
+    handler's dim checks and scatter path treat both transports alike.
+
+    ``num_blocks`` is the TRUE block count: the device arrays keep the
+    pow2-padded gather width (trailing entries duplicate the last block),
+    preserving the bounded compile-cache contract of ops/block_copy.py on
+    both ends of the wire."""
 
     def __init__(self, k, v, num_tokens: int, block_size: int,
-                 start_block: int):
+                 start_block: int, num_blocks: int):
         self.k = k
         self.v = v
         self.num_tokens = num_tokens
         self.block_size = block_size
         self.start_block = start_block
+        self.num_blocks = num_blocks
 
 
 def pull_bundle(mgr: DirectTransferManager, frame: KvDirectFrame
@@ -273,4 +283,5 @@ def pull_bundle(mgr: DirectTransferManager, frame: KvDirectFrame
     k, v = mgr.pull(d)
     return DirectKvBundle(k=k, v=v, num_tokens=d["num_tokens"],
                           block_size=d["block_size"],
-                          start_block=d.get("start_block", 0))
+                          start_block=d.get("start_block", 0),
+                          num_blocks=d.get("n", k.shape[1]))

@@ -287,6 +287,10 @@ class EngineArgs:
     # TPU-native:
     tp_size: int = 1  # tensor parallel (mesh "tp" axis)
     dp_size: int = 1  # batch shards inside one engine (mesh "dp" axis)
+    #: pipeline stages (mesh "pp" axis, outermost): stage-sliced layer stack
+    #: + GPipe microbatching (parallel/pipeline.py). Dense GQA families only;
+    #: disables multi-step decode / spec decode / int8 KV for the engine.
+    pp_size: int = 1
     kv_cache_memory_fraction: float = 0.6  # of free HBM, when num_blocks is None
     decode_batch_buckets: tuple = ()  # () = powers of two up to max_num_seqs
     prefill_buckets: tuple = ()  # () = powers of two up to max_num_batched_tokens

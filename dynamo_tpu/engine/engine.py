@@ -113,7 +113,20 @@ class AsyncJaxEngine:
                 params = jax.device_put(params, sh)
         self.params = params
 
+        self._pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        if self._pp > 1:
+            from dynamo_tpu.parallel.pipeline import pp_compatible
+            reason = pp_compatible(cfg, self._pp)
+            if reason is not None:
+                # a pp fleet silently serving un-pipelined would run at a
+                # fraction of its planned capacity — fail loudly
+                raise ValueError(f"pp_size={self._pp}: {reason}")
+
         self._kv_quant = args.kv_cache_dtype == "int8"
+        if self._kv_quant and self._pp > 1:
+            logger.warning("int8 KV cache is not supported under pipeline "
+                           "parallelism yet — using model dtype")
+            self._kv_quant = False
         if self._kv_quant and cfg.is_mla:
             # the latent cache's single shared "head" needs its own scale
             # layout + kernel treatment — not built yet; fail soft so an
@@ -145,24 +158,39 @@ class AsyncJaxEngine:
         self.scheduler = Scheduler(
             args, self.pool, on_stored=self._on_stored,
             onboard_cb=self._onboard if self.kvbm is not None else None)
-        self.step_fn = M.make_step_fn(cfg, args.block_size, mesh,
-                                      use_pallas=args.use_pallas_attention,
-                                      replicate_logits=self._multihost,
-                                      kv_quant=self._kv_quant)
-        self.multi_fn = None
-        if args.multi_step_decode > 1:
-            self.multi_fn = M.make_multi_decode_fn(
-                cfg, args.block_size, args.multi_step_decode, mesh,
-                use_pallas=args.use_pallas_attention,
-                replicate_outputs=self._multihost,
-                kv_quant=self._kv_quant)
-        self._step_mm_fn = None  # compiled lazily on first mm request
-        self.verify_fn = None
-        if args.speculative_tokens > 0:
-            self.verify_fn = M.make_verify_fn(
+        if self._pp > 1:
+            from dynamo_tpu.parallel.pipeline import make_pp_step_fn
+            self.step_fn = make_pp_step_fn(
                 cfg, args.block_size, mesh,
-                replicate_outputs=self._multihost,
-                kv_quant=self._kv_quant)
+                replicate_logits=self._multihost)
+            if args.multi_step_decode > 1:
+                logger.warning("multi-step decode is not pipelined yet — "
+                               "single-step decode under pp")
+            if args.speculative_tokens > 0:
+                logger.warning("speculative decoding is not pipelined yet — "
+                               "disabled under pp")
+            self.multi_fn = None
+            self._step_mm_fn = None
+            self.verify_fn = None
+        else:
+            self.step_fn = M.make_step_fn(cfg, args.block_size, mesh,
+                                          use_pallas=args.use_pallas_attention,
+                                          replicate_logits=self._multihost,
+                                          kv_quant=self._kv_quant)
+            self.multi_fn = None
+            if args.multi_step_decode > 1:
+                self.multi_fn = M.make_multi_decode_fn(
+                    cfg, args.block_size, args.multi_step_decode, mesh,
+                    use_pallas=args.use_pallas_attention,
+                    replicate_outputs=self._multihost,
+                    kv_quant=self._kv_quant)
+            self._step_mm_fn = None  # compiled lazily on first mm request
+            self.verify_fn = None
+            if args.speculative_tokens > 0:
+                self.verify_fn = M.make_verify_fn(
+                    cfg, args.block_size, mesh,
+                    replicate_outputs=self._multihost,
+                    kv_quant=self._kv_quant)
         self.spec_stats = SpecDecodeStats()
         from dynamo_tpu.engine import sampling as S
         self._sampling = S
@@ -462,9 +490,12 @@ class AsyncJaxEngine:
                     # the finish output that follows it in the queue
                     start, n, kb, vb = val
                     if mode is not None:
+                        # ship the pow2-padded gather output unchanged (the
+                        # compile-cache contract in ops/block_copy.py); the
+                        # true block count rides the descriptor
                         desc = self.direct_transfer.offer(
-                            mode, [kb[:, :n], vb[:, :n]],
-                            {"num_tokens": (start + n) * bs,
+                            mode, [kb, vb],
+                            {"num_tokens": (start + n) * bs, "n": n,
                              "block_size": bs, "start_block": start})
                         yield KvDirectFrame(desc).to_wire()
                         continue
@@ -497,9 +528,9 @@ class AsyncJaxEngine:
                                        seq.block_table[shipped:total],
                                        block_size=bs)
                     desc = self.direct_transfer.offer(
-                        mode, [kb[:, :n], vb[:, :n]],
-                        {"num_tokens": seq.prompt_len, "block_size": bs,
-                         "start_block": shipped})
+                        mode, [kb, vb],
+                        {"num_tokens": seq.prompt_len, "n": n,
+                         "block_size": bs, "start_block": shipped})
                     yield KvDirectFrame(desc).to_wire()
                 else:
                     bundle = await self._gather_bundle(
@@ -718,6 +749,14 @@ class AsyncJaxEngine:
 
     def _get_step_mm_fn(self):
         if self._step_mm_fn is None:
+            if self._pp > 1:
+                # the unpipelined mm step would scan the pp-sharded stack on
+                # every device — the exact silent-slowdown the pp guard in
+                # __init__ exists to prevent; refuse instead (surfaces as a
+                # clean per-request error through the step-failure path)
+                raise ValueError(
+                    "multimodal requests are not supported under pipeline "
+                    "parallelism yet")
             from dynamo_tpu.engine import model as M
 
             self._step_mm_fn = M.make_step_mm_fn(

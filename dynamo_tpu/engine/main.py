@@ -44,10 +44,12 @@ def build_engine(cli, cfg: ModelConfig, args: EngineArgs):
         from dynamo_tpu.parallel import MeshConfig
         from dynamo_tpu.parallel.multihost import make_global_mesh
         mesh = make_global_mesh(
-            MeshConfig(dp=args.dp_size, sp=1, tp=args.tp_size))
-    elif args.tp_size * args.dp_size > 1:
+            MeshConfig(dp=args.dp_size, sp=1, tp=args.tp_size,
+                       pp=args.pp_size))
+    elif args.tp_size * args.dp_size * args.pp_size > 1:
         from dynamo_tpu.parallel import MeshConfig, make_mesh
-        mesh = make_mesh(MeshConfig(dp=args.dp_size, sp=1, tp=args.tp_size))
+        mesh = make_mesh(MeshConfig(dp=args.dp_size, sp=1, tp=args.tp_size,
+                                    pp=args.pp_size))
 
     params = None
     if getattr(cli, "_resolved_model", None) is not None:
@@ -84,6 +86,14 @@ async def amain():
     ap.add_argument("--max-num-batched-tokens", type=int, default=2048)
     ap.add_argument("--max-model-len", type=int, default=4096)
     ap.add_argument("--tp-size", type=int, default=1)
+    ap.add_argument("--pp-size", type=int, default=1,
+                    help="pipeline stages (GPipe microbatching over the "
+                         "outermost mesh axis; dense GQA families)")
+    ap.add_argument("--kv-cache-dtype", default=None,
+                    choices=["auto", "int8"],
+                    help="paged KV cache dtype: int8 = symmetric per-"
+                         "(slot,head) scales, ~2x KV capacity (engine/"
+                         "cache.py)")
     ap.add_argument("--dp-size", type=int, default=1,
                     help="in-process mesh dp axis (batch shards inside ONE "
                          "engine); for a multi-process DP fleet use --dp-rank")
@@ -217,7 +227,7 @@ async def amain():
         max_num_batched_tokens=cli.max_num_batched_tokens,
         max_model_len=cli.max_model_len,
         enable_prefix_caching=not cli.no_prefix_caching,
-        tp_size=cli.tp_size, dp_size=cli.dp_size,
+        tp_size=cli.tp_size, dp_size=cli.dp_size, pp_size=cli.pp_size,
         use_pallas_attention=cli.use_pallas_attention,
         multi_step_decode=cli.multi_step_decode,
         speculative_tokens=cli.speculative_tokens,
@@ -225,6 +235,7 @@ async def amain():
         kvbm_disk_dir=cli.kvbm_disk_dir,
         kvbm_disk_bytes=int(cli.kvbm_disk_gb * (1 << 30)),
         quantization=cli.quantization,
+        kv_cache_dtype=cli.kv_cache_dtype,
     )
 
     if cli.dp_rank is not None and not 0 <= cli.dp_rank < cli.num_ranks:

@@ -154,9 +154,13 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> dict:
     return params
 
 
-def _layer_stack_shardings(cfg: ModelConfig, mesh: Mesh, moe: bool) -> dict:
+def _layer_stack_shardings(cfg: ModelConfig, mesh: Mesh, moe: bool,
+                           stack_axis=None) -> dict:
+    """``stack_axis``: mesh axis for the stacked-layer leading dim — "pp"
+    when pipeline stages each hold a slice of the stack (pipeline.py),
+    None (replicated) otherwise."""
     def ns(*spec):
-        return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P(stack_axis, *spec[1:]))
 
     layers = {
         "attn_norm": ns(None, None),
@@ -221,13 +225,21 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict:
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    # pipeline stages (pipeline.py) each hold a slice of the layer stack;
+    # embed/final_norm/head replicate across pp (both pipeline ends use them)
+    pp = mesh.shape.get("pp", 1)
+    k_dense = cfg.num_dense_prefix_layers
+    main_axis = ("pp" if pp > 1 and (cfg.num_layers - k_dense) % pp == 0
+                 else None)
     out = {
         "embed": ns(None, None),
-        "layers": _layer_stack_shardings(cfg, mesh, cfg.is_moe),
+        "layers": _layer_stack_shardings(cfg, mesh, cfg.is_moe, main_axis),
         "final_norm": ns(None),
     }
-    if cfg.num_dense_prefix_layers:
-        out["dense_layers"] = _layer_stack_shardings(cfg, mesh, False)
+    if k_dense:
+        dense_axis = "pp" if pp > 1 and k_dense % pp == 0 else None
+        out["dense_layers"] = _layer_stack_shardings(cfg, mesh, False,
+                                                     dense_axis)
     if not cfg.tie_word_embeddings:
         out["lm_head"] = ns(None, "tp")
     return out
@@ -252,10 +264,15 @@ def cache_shardings(mesh: Mesh, cfg: Optional[ModelConfig] = None,
         head_axis = None
     else:
         head_axis = "tp"
-    q_sh = NamedSharding(mesh, P(None, None, head_axis, None))
+    # pipeline stages own their layers' cache slices (pipeline.py)
+    pp = mesh.shape.get("pp", 1)
+    layer_axis = ("pp" if pp > 1 and cfg is not None
+                  and cfg.num_layers % pp == 0 else None)
+    q_sh = NamedSharding(mesh, P(layer_axis, None, head_axis, None))
     if not quant:
         return q_sh
-    return {"q": q_sh, "s": NamedSharding(mesh, P(None, None, head_axis))}
+    return {"q": q_sh,
+            "s": NamedSharding(mesh, P(layer_axis, None, head_axis))}
 
 
 def batch_shardings(mesh: Mesh) -> dict:

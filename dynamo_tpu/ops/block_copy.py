@@ -131,18 +131,25 @@ def scatter_blocks(cache, block_ids, bundle, *, block_size: int):
     )
 
     ids = np.asarray(block_ids, np.int32)
-    n = len(ids)
     pids = _pad_pow2_ids(ids)
     packed = _is_packed(bundle)
-    if len(pids) != n:
+    # direct-transfer bundles arrive ALREADY pow2-padded (gather width kept
+    # across the wire), so the pad delta is vs the bundle's actual width,
+    # not len(ids)
+    missing = len(pids) - bundle.shape[1]
+    if missing > 0:
         if isinstance(bundle, jax.Array):
-            # direct-transfer bundles live on device; pad there — a numpy
-            # round-trip would stage every page through host RAM
-            pad = jnp.repeat(bundle[:, -1:], len(pids) - n, axis=1)
+            # device bundles pad on device — a numpy round-trip would stage
+            # every page through host RAM
+            pad = jnp.repeat(bundle[:, -1:], missing, axis=1)
             bundle = jnp.concatenate([bundle, pad], axis=1)
         else:
-            pad = np.repeat(np.asarray(bundle[:, -1:]), len(pids) - n, axis=1)
+            pad = np.repeat(np.asarray(bundle[:, -1:]), missing, axis=1)
             bundle = np.concatenate([np.asarray(bundle), pad], axis=1)
+    elif missing < 0:
+        raise ValueError(
+            f"bundle width {bundle.shape[1]} exceeds padded id count "
+            f"{len(pids)} — ids and bundle disagree")
     if is_quant_cache(cache):
         if packed:
             return _scatter_packed(cache, jnp.asarray(pids),

@@ -34,31 +34,35 @@ class MeshConfig:
     dp: int = 1
     sp: int = 1
     tp: int = 1
+    #: pipeline stages (parallel/pipeline.py): OUTERMOST axis — stage
+    #: boundaries carry only activations once per microbatch tick, so pp
+    #: tolerates the slowest links (DCN across slices)
+    pp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.sp * self.tp
+        return self.pp * self.dp * self.sp * self.tp
 
     @property
     def axis_names(self) -> tuple:
-        return ("dp", "sp", "tp")
+        return ("pp", "dp", "sp", "tp")
 
     @staticmethod
     def for_devices(n: int, *, tp: Optional[int] = None, sp: int = 1,
-                    dp: Optional[int] = None) -> "MeshConfig":
+                    dp: Optional[int] = None, pp: int = 1) -> "MeshConfig":
         """Fill in unspecified axes to cover ``n`` devices.
 
         Priority when inferring: tp gets the remainder (serving engines are
         usually TP-dominant), then dp.
         """
         if tp is None and dp is None:
-            tp = n // sp
+            tp = n // (sp * pp)
             dp = 1
         elif tp is None:
-            tp = n // (sp * dp)
+            tp = n // (pp * sp * dp)
         elif dp is None:
-            dp = n // (sp * tp)
-        cfg = MeshConfig(dp=dp, sp=sp, tp=tp)
+            dp = n // (pp * sp * tp)
+        cfg = MeshConfig(dp=dp, sp=sp, tp=tp, pp=pp)
         if cfg.size != n:
             raise ValueError(f"mesh {cfg} does not cover {n} devices")
         return cfg
@@ -76,5 +80,6 @@ def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None):
     devices = list(devices if devices is not None else jax.devices())
     if len(devices) < cfg.size:
         raise ValueError(f"mesh {cfg} needs {cfg.size} devices, got {len(devices)}")
-    arr = np.asarray(devices[: cfg.size], dtype=object).reshape(cfg.dp, cfg.sp, cfg.tp)
+    arr = np.asarray(devices[: cfg.size], dtype=object).reshape(
+        cfg.pp, cfg.dp, cfg.sp, cfg.tp)
     return Mesh(arr, cfg.axis_names)

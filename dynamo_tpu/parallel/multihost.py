@@ -90,7 +90,8 @@ def make_global_mesh(cfg: MeshConfig):
         raise ValueError(
             f"mesh {cfg} needs exactly {cfg.size} devices, cluster has "
             f"{len(devices)}")
-    arr = np.asarray(devices, dtype=object).reshape(cfg.dp, cfg.sp, cfg.tp)
+    arr = np.asarray(devices, dtype=object).reshape(
+        cfg.pp, cfg.dp, cfg.sp, cfg.tp)
     return Mesh(arr, cfg.axis_names)
 
 

@@ -497,3 +497,40 @@ async def test_engine_embed_all_families(arch):
                                 np.asarray(v_joint[1])))) < 0.999
     finally:
         await eng.close()
+
+
+@pytest.mark.slow
+async def test_engine_pp_serving_matches_single_device():
+    """Full engine serving through the pipeline-parallel step (pp=2):
+    greedy tokens must equal the single-device engine's exactly."""
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    async def run(mesh, **kw):
+        cfg = ModelConfig.tiny()
+        args = EngineArgs(block_size=4, num_blocks=128, max_num_seqs=8,
+                          max_num_batched_tokens=64, max_model_len=256,
+                          prefill_buckets=(8, 16, 32, 64),
+                          decode_batch_buckets=(1, 2, 4, 8), **kw)
+        eng = AsyncJaxEngine(cfg, args, mesh=mesh)
+        outs = []
+        for p in [list(range(1, 23)), list(range(5, 40))]:
+            toks = []
+            async for out in eng.generate(req(p)):
+                toks.extend(out.token_ids)
+            outs.append(toks)
+        await eng.close()
+        return outs
+
+    want = await run(None)
+    got = await run(make_mesh(MeshConfig(pp=2, dp=2, tp=2)))
+    assert got == want
+
+
+async def test_engine_pp_rejects_incompatible_config():
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = ModelConfig.tiny()
+    mesh = make_mesh(MeshConfig(pp=8))  # 2 layers % 8 != 0
+    with pytest.raises(ValueError, match="pp"):
+        AsyncJaxEngine(cfg, EngineArgs(block_size=4, num_blocks=64),
+                       mesh=mesh)
