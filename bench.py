@@ -266,6 +266,70 @@ async def _e2e(on_tpu: bool) -> dict:
     }
 
 
+async def _spec_bench(on_tpu: bool) -> dict:
+    """Speculative-decode phase: decode throughput with and without
+    prompt-lookup drafting on a REPETITIVE workload (where lookup drafts
+    land), plus the measured acceptance rate — the SpecDecodeStats
+    telemetry surface, on record whenever the bench runs."""
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+
+    if on_tpu:
+        cfg = ModelConfig.llama3_1b()
+        N, OSL, ISL = 8, 64, 256
+        base = dict(block_size=16, max_num_seqs=8,
+                    max_num_batched_tokens=512, max_model_len=512,
+                    num_blocks=512, use_pallas_attention=True,
+                    prefill_buckets=(256,), decode_batch_buckets=(8,))
+    else:
+        cfg = ModelConfig.tiny()
+        N, OSL, ISL = 4, 24, 64
+        base = dict(block_size=4, max_num_seqs=4,
+                    max_num_batched_tokens=64, max_model_len=128,
+                    num_blocks=256, prefill_buckets=(64,),
+                    decode_batch_buckets=(4,))
+    cycle = list(range(5, 21))
+    prompts = [((cycle[i:] + cycle[:i]) * ISL)[:ISL] for i in range(N)]
+
+    async def measure(spec: bool):
+        eng = AsyncJaxEngine(cfg, EngineArgs(
+            **base, speculative_tokens=4 if spec else 0))
+
+        async def one(p):
+            req = PreprocessedRequest(
+                model="b", token_ids=p,
+                stop_conditions=StopConditions(max_tokens=OSL,
+                                               ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0))
+            n = 0
+            async for out in eng.generate(req):
+                n += len(out.token_ids)
+            return n
+
+        await asyncio.gather(*[one(p) for p in prompts])  # warm compiles
+        t0 = time.perf_counter()
+        total = sum(await asyncio.gather(*[one(p) for p in prompts]))
+        dt = time.perf_counter() - t0
+        st = eng.spec_stats
+        accept = (st.num_accepted_tokens / st.num_draft_tokens
+                  if st.num_draft_tokens else 0.0)
+        await eng.close()
+        return total / dt, accept
+
+    spec_tok_s, accept = await measure(True)
+    plain_tok_s, _ = await measure(False)
+    return {
+        "spec_decode_tok_s": round(spec_tok_s, 1),
+        "nospec_decode_tok_s": round(plain_tok_s, 1),
+        "spec_accept_rate": round(accept, 3),
+        "spec_gain": round(spec_tok_s / plain_tok_s, 3)
+        if plain_tok_s else 0.0,
+        "spec_workload": f"repetitive ISL={ISL},OSL={OSL},n={N},K=4",
+    }
+
+
 def _device_init_responsive(timeout_s: float = 240.0) -> bool:
     """Probe jax backend init in a SUBPROCESS: a broken TPU tunnel makes
     jax.devices() hang forever (observed: axon UNAVAILABLE wedged for
@@ -398,6 +462,12 @@ def _child_main():
                                      kv_int8=True))
         except Exception as e:  # noqa: BLE001 — optional extra datum
             kern["kernel_kv8_error"] = repr(e)[:200]
+        try:
+            # before the out={} snapshot below: spec numbers must survive
+            # an e2e failure (extra holds a copy of kern, not a reference)
+            kern.update(asyncio.run(_spec_bench(on_tpu)))
+        except Exception as e:  # noqa: BLE001 — optional extra datum
+            kern["spec_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         out = {
             "metric": f"kernel_decode_tok_s_per_chip[{model},{platform},"

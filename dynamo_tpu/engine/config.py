@@ -298,13 +298,22 @@ class EngineArgs:
     #: decode steps fused into one jitted call when only decode work exists
     #: (amortizes per-dispatch latency; tokens deliver in bursts of this size)
     multi_step_decode: int = 1
-    #: speculative decoding via prompt lookup (n-gram drafting): draft up to
-    #: this many tokens from the sequence's own history and verify them in
+    #: speculative decoding: draft up to this many tokens and verify them in
     #: ONE forward — greedy-invariant (identical tokens to plain decode).
     #: 0 = off. Applies to temperature-0 batches without logprobs; the
     #: reference delegates spec decode to its engines and reports it via
     #: SpecDecodeStats (kv_router/protocols.rs:48-84)
     speculative_tokens: int = 0
+    #: how drafts are produced: "prompt_lookup" (n-gram match in the
+    #: sequence's own history — free, shines on repetitive text) or
+    #: "draft_layers" (layer-skip self-drafting: the first
+    #: speculative_draft_layers layers + shared LM head run as the draft
+    #: model — model.make_draft_fn; drafts every step, costs
+    #: draft_layers/num_layers of a forward per drafted token)
+    speculative_method: str = "prompt_lookup"
+    #: layer count of the layer-skip draft model (speculative_method=
+    #: "draft_layers"); must be in (0, num_layers)
+    speculative_draft_layers: int = 0
     # KVBM tiers (0 = tier disabled; ref: block_manager.rs:62-75 G2/G3)
     kvbm_host_bytes: int = 0
     kvbm_disk_dir: Optional[str] = None
@@ -330,6 +339,15 @@ class EngineArgs:
     seed: int = 0
 
     def __post_init__(self):
+        if self.speculative_method not in ("prompt_lookup", "draft_layers"):
+            raise ValueError(
+                f"speculative_method={self.speculative_method!r} unknown "
+                "(prompt_lookup or draft_layers)")
+        if (self.speculative_method == "draft_layers"
+                and self.speculative_tokens > 0
+                and self.speculative_draft_layers < 1):
+            raise ValueError("speculative_method='draft_layers' needs "
+                             "speculative_draft_layers >= 1")
         if self.kv_cache_dtype not in (None, "auto", "int8"):
             # an unknown value silently serving full-precision would run a
             # deployment at half its planned KV capacity — fail loudly

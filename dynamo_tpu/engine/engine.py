@@ -172,6 +172,7 @@ class AsyncJaxEngine:
             self.multi_fn = None
             self._step_mm_fn = None
             self.verify_fn = None
+            self.draft_fn = None
         else:
             self.step_fn = M.make_step_fn(cfg, args.block_size, mesh,
                                           use_pallas=args.use_pallas_attention,
@@ -186,11 +187,19 @@ class AsyncJaxEngine:
                     kv_quant=self._kv_quant)
             self._step_mm_fn = None  # compiled lazily on first mm request
             self.verify_fn = None
+            self.draft_fn = None
             if args.speculative_tokens > 0:
                 self.verify_fn = M.make_verify_fn(
                     cfg, args.block_size, mesh,
                     replicate_outputs=self._multihost,
                     kv_quant=self._kv_quant)
+                if args.speculative_method == "draft_layers":
+                    self.draft_fn = M.make_draft_fn(
+                        cfg, args.block_size, args.speculative_draft_layers,
+                        args.speculative_tokens, mesh,
+                        use_pallas=args.use_pallas_attention,
+                        replicate_outputs=self._multihost,
+                        kv_quant=self._kv_quant)
         self.spec_stats = SpecDecodeStats()
         from dynamo_tpu.engine import sampling as S
         self._sampling = S
@@ -232,6 +241,12 @@ class AsyncJaxEngine:
         (like the guided-decoding cursor) attaches, so every entry path
         (generate, disagg prefill_extract, generate_prefilled/injected)
         honors it."""
+        if req.mm_embeds and self._pp > 1:
+            # admission-time refusal: raising mid-step (inside _run_prefill)
+            # would fail every in-flight sequence in the batch, not just
+            # this request
+            raise ValueError("multimodal requests are not supported under "
+                             "pipeline parallelism yet")
         seq = SeqState(request_id=f"seq-{next(self._seq_counter)}",
                        req=req, ctx=ctx or _NullCtx(), sink=sink, **kw)
         if req.sampling_options.guided:
@@ -750,10 +765,8 @@ class AsyncJaxEngine:
     def _get_step_mm_fn(self):
         if self._step_mm_fn is None:
             if self._pp > 1:
-                # the unpipelined mm step would scan the pp-sharded stack on
-                # every device — the exact silent-slowdown the pp guard in
-                # __init__ exists to prevent; refuse instead (surfaces as a
-                # clean per-request error through the step-failure path)
+                # backstop only — _new_seq refuses mm requests at admission
+                # under pp, so this cannot fire from the serving path
                 raise ValueError(
                     "multimodal requests are not supported under pipeline "
                     "parallelism yet")
@@ -918,6 +931,44 @@ class AsyncJaxEngine:
                 extended.append((s, before))
         return True
 
+    async def _run_draft_model(self, seqs: list[SeqState],
+                               K: int) -> list[list[int]]:
+        """Layer-skip draft dispatch: K greedy tokens per row from the
+        first speculative_draft_layers layers (model.make_draft_fn). Draft
+        KV lands in the tokens' real slots — blocks are already
+        preallocated by the caller."""
+        args = self.args
+        bs = args.block_size
+        B = args.bucket_batch(len(seqs))
+        max_kv = max(len(s.tokens) for s in seqs) + K
+        W = args.bucket_table_width(max_kv)
+
+        last_tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        bt = np.full((B, W), NULL_BLOCK, np.int32)
+        kv_lens = np.zeros((B,), np.int32)
+        for i, s in enumerate(seqs):
+            last_tokens[i] = s.tokens[-1]
+            positions[i] = len(s.tokens) - 1
+            n = min(len(s.block_table), W)
+            bt[i, :n] = s.block_table[:n]
+            kv_lens[i] = len(s.tokens)
+
+        self._broadcast("draft", last_tokens=last_tokens,
+                        positions=positions, block_tables=bt,
+                        kv_lens=kv_lens)
+        toks, self.k_cache, self.v_cache = self.draft_fn(
+            self.params, self._put_batch("last_tokens", last_tokens),
+            self._put_batch("positions", positions),
+            self._put_batch("block_tables", bt),
+            self._put_batch("kv_lens", kv_lens),
+            self.k_cache, self.v_cache)
+        # draft forwards read draft_layers/num_layers of the weights
+        self.param_reads += (K * args.speculative_draft_layers
+                             / self.cfg.num_layers)
+        toks = await asyncio.to_thread(lambda: np.asarray(toks))
+        return [toks[:, i].tolist() for i in range(len(seqs))]
+
     async def _run_spec_decode(self, seqs: list[SeqState]) -> bool:
         """Draft-and-verify: one forward over [last_token, draft...] per seq
         accepts the longest greedy-matching draft prefix plus one corrected
@@ -926,11 +977,24 @@ class AsyncJaxEngine:
         seq drafts anything or block preallocation fails."""
         args = self.args
         K = args.speculative_tokens
+        if self.draft_fn is not None:
+            # the draft model writes KV into the draft slots, so blocks
+            # must exist BEFORE drafting
+            if not self._prealloc_blocks(seqs, K):
+                return False
+            drafts = await self._run_draft_model(seqs, K)
+            return await self._verify_and_commit(seqs, drafts)
         drafts = [self._draft_tokens(s, K) for s in seqs]
         if not any(drafts):
             return False
         if not self._prealloc_blocks(seqs, K):
             return False
+        return await self._verify_and_commit(seqs, drafts)
+
+    async def _verify_and_commit(self, seqs: list[SeqState],
+                                 drafts: list[list[int]]) -> bool:
+        args = self.args
+        K = args.speculative_tokens
 
         B = args.bucket_batch(len(seqs))
         S = 1 + K

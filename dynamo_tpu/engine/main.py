@@ -110,6 +110,12 @@ async def amain():
                          "dequant fused into the matmuls (GGUF Q8_0 and "
                          "gpt-oss MXFP4 checkpoints load pre-quantized "
                          "regardless)")
+    ap.add_argument("--speculative-method", default="prompt_lookup",
+                    choices=["prompt_lookup", "draft_layers"],
+                    help="draft source: n-gram prompt lookup (free) or "
+                         "layer-skip self-drafting (model.make_draft_fn)")
+    ap.add_argument("--speculative-draft-layers", type=int, default=0,
+                    help="layer count of the layer-skip draft model")
     ap.add_argument("--speculative-tokens", type=int, default=0,
                     help="prompt-lookup speculative decoding: draft up to N "
                          "tokens per step (greedy-invariant)")
@@ -231,6 +237,8 @@ async def amain():
         use_pallas_attention=cli.use_pallas_attention,
         multi_step_decode=cli.multi_step_decode,
         speculative_tokens=cli.speculative_tokens,
+        speculative_method=cli.speculative_method,
+        speculative_draft_layers=cli.speculative_draft_layers,
         kvbm_host_bytes=int(cli.kvbm_host_gb * (1 << 30)),
         kvbm_disk_dir=cli.kvbm_disk_dir,
         kvbm_disk_bytes=int(cli.kvbm_disk_gb * (1 << 30)),

@@ -1306,6 +1306,62 @@ def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
     return jax.jit(f, donate_argnums=(5, 6), **kw)
 
 
+def make_draft_fn(cfg: ModelConfig, block_size: int, draft_layers: int,
+                  num_steps: int, mesh: Optional[Mesh] = None,
+                  use_pallas: bool = False, replicate_outputs: bool = False,
+                  kv_quant: bool = False):
+    """Layer-skip self-drafting (the draft-model speculative path): chain
+    ``num_steps`` GREEDY decode steps through only the first
+    ``draft_layers`` layers + the shared final norm / LM head, in one
+    compiled program.
+
+    The draft model IS the serving model's prefix — no second checkpoint,
+    no second KV cache: draft KV for layers < draft_layers lands in the
+    draft tokens' REAL cache slots. Accepted tokens get those rows
+    recomputed identically by the verify pass; rejected slots hold garbage
+    that the next real step overwrites and kv_lens caps out of any read
+    (the verify_forward contract). The reference models this capability as
+    SpecDecodeStats on its engines (ref: kv_router/protocols.rs:48-84).
+
+    Returns (tokens [K, B], k_cache, v_cache).
+    """
+    import dataclasses
+
+    if cfg.num_dense_prefix_layers:
+        raise ValueError("layer-skip drafting needs a uniform layer stack "
+                         "(num_dense_prefix_layers == 0)")
+    # == num_layers is allowed: the draft IS the model, acceptance ~100% —
+    # useless in production, but the sharpest end-to-end plumbing check
+    if not 0 < draft_layers <= cfg.num_layers:
+        raise ValueError(
+            f"draft_layers={draft_layers} outside (0, {cfg.num_layers}]")
+    cfg_d = dataclasses.replace(cfg, num_layers=draft_layers)
+    decode_pallas, _ = _resolve_kernel_flags(cfg_d, mesh, use_pallas, False)
+
+    def f(params, last_tokens, positions, block_tables, kv_lens,
+          k_cache, v_cache):
+        pd = dict(params)
+        pd["layers"] = jax.tree.map(lambda x: x[:draft_layers],
+                                    params["layers"])
+        B = last_tokens.shape[0]
+        zf = jnp.zeros((B,), jnp.float32)
+        zi = jnp.zeros((B,), jnp.int32)
+        zu = jnp.zeros((B,), jnp.uint32)
+        toks, _, k_cache, v_cache = multi_decode(
+            pd, last_tokens, positions, block_tables, kv_lens,
+            k_cache, v_cache, zf, zi, jnp.ones((B,), jnp.float32), zu, zu,
+            cfg=cfg_d, block_size=block_size, num_steps=num_steps,
+            use_pallas=decode_pallas, mesh=mesh)
+        return toks, k_cache, v_cache
+
+    kw = {}
+    if replicate_outputs and mesh is not None:
+        rep = NamedSharding(mesh, P())
+        csh = cache_shardings(mesh, cfg, quant=kv_quant)
+        kw["out_shardings"] = (rep, csh, csh)
+    return jax.jit(f, donate_argnums=(5, 6), **kw)
+
+
 def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,
                  use_pallas: bool = False, use_flash_prefill=None,
                  replicate_logits: bool = False, kv_quant: bool = False):

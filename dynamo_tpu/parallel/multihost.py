@@ -52,6 +52,7 @@ STEP_KEYS = {
     "multi": ("last_tokens", "positions", "block_tables", "kv_lens",
               "temp", "top_k", "top_p", "seeds", "step0"),
     "verify": ("tokens", "positions", "slot_map", "block_tables", "kv_lens"),
+    "draft": ("last_tokens", "positions", "block_tables", "kv_lens"),
     "step_mm": ("tokens", "positions", "slot_map", "block_tables", "kv_lens",
                 "last_idx", "mm_vec", "mm_mask"),
     "embed": ("tokens", "lengths"),
@@ -338,6 +339,11 @@ class StepFollower:
                     eng._embed_forward(a["tokens"], a["lengths"])
                 elif kind == "verify":  # speculative verification
                     _, _, eng.k_cache, eng.v_cache = eng.verify_fn(
+                        eng.params,
+                        *(eng._put_batch(k, a[k]) for k in keys),
+                        eng.k_cache, eng.v_cache)
+                elif kind == "draft":  # layer-skip speculative drafting
+                    _, eng.k_cache, eng.v_cache = eng.draft_fn(
                         eng.params,
                         *(eng._put_batch(k, a[k]) for k in keys),
                         eng.k_cache, eng.v_cache)

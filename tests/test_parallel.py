@@ -263,6 +263,41 @@ def test_pp_forward_matches_dense(pp, M):
                                atol=1e-5, rtol=1e-5)
 
 
+def test_pp_forward_windows_and_sinks_match_dense():
+    """gpt-oss-style per-layer sliding windows + attention sinks through
+    the pipeline: the pp copy of the dense layer body indexes windows by
+    GLOBAL layer id and must match the plain forward exactly."""
+    from dynamo_tpu.engine import model as Mo
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.parallel.pipeline import pp_forward
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=4,
+        num_heads=4, num_kv_heads=2, head_dim=16, dtype="float32",
+        layer_windows=(4, 0, 4, 0), attention_sinks=True)
+    block_size, W, B, S = 4, 4, 4, 8
+    num_blocks = 1 + B * W
+    mesh = make_mesh(MeshConfig(pp=2, tp=4))
+
+    params = Mo.init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+    inputs = _pp_inputs(cfg, B, S, W, block_size, kv_len=S)
+    shape = (cfg.num_layers, num_blocks * block_size,
+             cfg.num_kv_heads, cfg.head_dim)
+    kc, vc = jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+    want, _, _ = Mo.forward(params, *inputs, kc, vc, cfg=cfg,
+                            block_size=block_size)
+
+    p_pp = jax.device_put(params, Mo.param_shardings(cfg, mesh))
+    csh = Mo.cache_shardings(mesh, cfg)
+    kc2 = jax.device_put(jnp.zeros(shape, jnp.float32), csh)
+    vc2 = jax.device_put(jnp.zeros(shape, jnp.float32), csh)
+    got, _, _ = pp_forward(p_pp, *inputs, kc2, vc2, cfg=cfg,
+                           block_size=block_size, mesh=mesh,
+                           num_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
 def test_pp_decode_step_matches_dense():
     """Single-token decode (S=1) through the pipeline after a prefill."""
     from dynamo_tpu.engine import model as Mo
