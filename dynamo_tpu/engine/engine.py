@@ -938,7 +938,6 @@ class AsyncJaxEngine:
         KV lands in the tokens' real slots — blocks are already
         preallocated by the caller."""
         args = self.args
-        bs = args.block_size
         B = args.bucket_batch(len(seqs))
         max_kv = max(len(s.tokens) for s in seqs) + K
         W = args.bucket_table_width(max_kv)

@@ -117,8 +117,9 @@ async def amain():
     ap.add_argument("--speculative-draft-layers", type=int, default=0,
                     help="layer count of the layer-skip draft model")
     ap.add_argument("--speculative-tokens", type=int, default=0,
-                    help="prompt-lookup speculative decoding: draft up to N "
-                         "tokens per step (greedy-invariant)")
+                    help="speculative decoding: draft up to N tokens per "
+                         "step, any --speculative-method "
+                         "(greedy-invariant); 0 = off")
     ap.add_argument("--multi-step-decode", type=int, default=1,
                     help="decode steps fused per jitted call (token bursts)")
     ap.add_argument("--no-prefix-caching", action="store_true")
@@ -168,6 +169,14 @@ async def amain():
                          "tooling)")
     ap.add_argument("--profile-seconds", type=float, default=30.0,
                     help="trace duration after WORKER_READY")
+    ap.add_argument("--mm-vision-model", default=None,
+                    help="path to a CLIPVisionModel checkpoint: the encode "
+                         "worker runs the real JAX ViT tower "
+                         "(multimodal/vit.py) instead of the stub")
+    ap.add_argument("--mm-projector", default=None,
+                    help="safetensors file with the vision→LM projector "
+                         "(llava multi_modal_projector or native w1/b1/"
+                         "w2/b2)")
     ap.add_argument("--mm-encode", action="store_true",
                     help="run a multimodal encode worker in this process "
                          "AND resolve image refs against the encoder "
@@ -348,9 +357,28 @@ async def amain():
             await DisaggConfigWatcher(runtime.plane, dconf).start()
 
     mm_worker = None
+    mm_encoder = None
+    if (cli.mm_vision_model or cli.mm_projector) and not cli.mm_encode:
+        ap.error("--mm-vision-model/--mm-projector configure the encode "
+                 "worker — pass --mm-encode to start one")
     if cli.mm_encode:
         from dynamo_tpu.multimodal import EncodeWorker
-        mm_worker = await EncodeWorker(runtime,
+        if cli.mm_vision_model:
+            from dynamo_tpu.multimodal.vit import VitEncoder
+            mm_encoder = VitEncoder.from_pretrained(
+                cli.mm_vision_model, projector_path=cli.mm_projector)
+            if mm_encoder.output_dim != cfg.hidden_size:
+                # serving misaligned embeddings would be silent garbage;
+                # refuse at startup, not per request
+                ap.error(
+                    f"vision tower outputs dim {mm_encoder.output_dim} but "
+                    f"the LM hidden size is {cfg.hidden_size} — provide "
+                    "--mm-projector (llava multi_modal_projector weights)")
+            logging.getLogger("dynamo.engine.main").info(
+                "vision tower %s: %d tokens/image, dim %d",
+                cli.mm_vision_model, mm_encoder.tokens_per_image,
+                mm_encoder.output_dim)
+        mm_worker = await EncodeWorker(runtime, encoder=mm_encoder,
                                        namespace=cli.namespace).start()
     kvbm_leader = None
     kvbm_worker = None
@@ -451,6 +479,10 @@ async def amain():
             reasoning_parser = reasoning_parser or "gpt_oss"
         card.runtime_config.tool_call_parser = tool_parser
         card.runtime_config.reasoning_parser = reasoning_parser
+        if mm_encoder is not None:
+            # the preprocessor's per-image placeholder run must match what
+            # the tower actually produces (VitEncoder refuses mismatches)
+            card.mm_placeholder_tokens = mm_encoder.tokens_per_image
         await register_llm(runtime, ep, card, lease_id=lease)
 
     print("WORKER_READY", flush=True)

@@ -1,0 +1,349 @@
+"""JAX vision tower: CLIP-family ViT for the multimodal encode worker.
+
+The reference runs real encode workers next to its engines (TRT-LLM
+multimodal helper, SURVEY §2.6; typed embedding transfer via nixl_connect —
+lib/bindings/python/src/dynamo/nixl_connect/__init__.py). This is the TPU
+engine for that worker: a CLIP-convention ViT whose numerics are golden-
+tested against ``transformers.CLIPVisionModel`` (tests/test_multimodal.py,
+same conformance pattern as tests/test_parity.py for the LM families).
+
+TPU-first choices:
+- the patch "conv" is space-to-depth + one [P·P·3, D] matmul — identical
+  math to the stride-P conv, but lands on the MXU as a single large GEMM
+  instead of an im2col the compiler must invent;
+- layers are stacked [L, ...] and driven by ``lax.scan`` (one compiled
+  layer body), matching the LM stack's compile-cost discipline;
+- the whole encode (preprocess → tower → projector) jits as one program;
+  bf16/f32 follow the params' dtype.
+
+A llava-style two-layer GELU projector maps the tower's hidden size onto
+the LM's when projector weights are provided (`projector`: {"w1","b1",
+"w2","b2"}); without one, the encoder serves the tower's native dim.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("dynamo.multimodal.vit")
+
+#: CLIP preprocessing constants (openai/clip-vit-* processor defaults)
+CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+@dataclass
+class VitConfig:
+    """CLIP vision-tower shape (transformers CLIPVisionConfig fields)."""
+
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    image_size: int = 224
+    patch_size: int = 32
+    layer_norm_eps: float = 1e-5
+    #: CLIP uses quick_gelu (x * sigmoid(1.702 x)); newer towers use gelu
+    hidden_act: str = "quick_gelu"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @staticmethod
+    def from_hf(path: str) -> "VitConfig":
+        import json
+        import os
+
+        with open(os.path.join(path, "config.json")) as f:
+            raw = json.load(f)
+        # CLIPVisionModel saves the vision config at top level; full CLIP
+        # checkpoints nest it under "vision_config"
+        c = raw.get("vision_config", raw)
+        return VitConfig(
+            hidden_size=c["hidden_size"],
+            intermediate_size=c["intermediate_size"],
+            num_layers=c["num_hidden_layers"],
+            num_heads=c["num_attention_heads"],
+            image_size=c["image_size"],
+            patch_size=c["patch_size"],
+            layer_norm_eps=c.get("layer_norm_eps", 1e-5),
+            hidden_act=c.get("hidden_act", "quick_gelu"),
+        )
+
+
+def init_vit_params(cfg: VitConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    D, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    pd = cfg.patch_size * cfg.patch_size * 3
+    ks = iter(jax.random.split(key, 8))
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype) / np.sqrt(fan_in))
+
+    layers = {
+        "ln1_w": jnp.ones((L, D), dtype), "ln1_b": jnp.zeros((L, D), dtype),
+        "ln2_w": jnp.ones((L, D), dtype), "ln2_b": jnp.zeros((L, D), dtype),
+        "wq": w(next(ks), (L, D, D), D), "bq": jnp.zeros((L, D), dtype),
+        "wk": w(next(ks), (L, D, D), D), "bk": jnp.zeros((L, D), dtype),
+        "wv": w(next(ks), (L, D, D), D), "bv": jnp.zeros((L, D), dtype),
+        "wo": w(next(ks), (L, D, D), D), "bo": jnp.zeros((L, D), dtype),
+        "w1": w(next(ks), (L, D, I), D), "b1": jnp.zeros((L, I), dtype),
+        "w2": w(next(ks), (L, I, D), I), "b2": jnp.zeros((L, D), dtype),
+    }
+    return {
+        "patch": w(next(ks), (pd, D), pd),
+        "cls": jnp.zeros((D,), dtype),
+        "pos": w(next(ks), (cfg.num_patches + 1, D), D) * 0.02,
+        "pre_ln_w": jnp.ones((D,), dtype), "pre_ln_b": jnp.zeros((D,), dtype),
+        "post_ln_w": jnp.ones((D,), dtype),
+        "post_ln_b": jnp.zeros((D,), dtype),
+        "layers": layers,
+    }
+
+
+def load_clip_vision_params(path: str, dtype=jnp.float32) -> dict:
+    """Load a transformers CLIPVisionModel checkpoint (safetensors).
+
+    The stride-P conv kernel [D, 3, P, P] is re-laid as the space-to-depth
+    matmul weight [P·P·3, D] matching ``_patchify``'s (row, col, channel)
+    flattening order.
+    """
+    import os
+
+    from safetensors import safe_open
+
+    files = [f for f in os.listdir(path) if f.endswith(".safetensors")]
+    tensors = {}
+    for fn in files:
+        with safe_open(os.path.join(path, fn), framework="np") as f:
+            for k in f.keys():
+                tensors[k.removeprefix("vision_model.")] = f.get_tensor(k)
+
+    cfg = VitConfig.from_hf(path)
+    L, D = cfg.num_layers, cfg.hidden_size
+
+    def t(name):
+        return jnp.asarray(tensors[name], dtype)
+
+    conv = tensors["embeddings.patch_embedding.weight"]  # [D, 3, P, P]
+    # -> [P, P, 3, D] -> [P·P·3, D]: rows vary slowest, channel fastest —
+    # the exact flatten order _patchify produces
+    patch = jnp.asarray(
+        np.transpose(conv, (2, 3, 1, 0)).reshape(-1, D), dtype)
+
+    def stack(fmt, transpose=False):
+        xs = [tensors[fmt.format(i)] for i in range(L)]
+        a = np.stack(xs)
+        if transpose:  # torch Linear stores [out, in]; we matmul [in, out]
+            a = np.transpose(a, (0, 2, 1))
+        return jnp.asarray(a, dtype)
+
+    E = "encoder.layers.{}."
+    layers = {
+        "ln1_w": stack(E + "layer_norm1.weight"),
+        "ln1_b": stack(E + "layer_norm1.bias"),
+        "ln2_w": stack(E + "layer_norm2.weight"),
+        "ln2_b": stack(E + "layer_norm2.bias"),
+        "wq": stack(E + "self_attn.q_proj.weight", True),
+        "bq": stack(E + "self_attn.q_proj.bias"),
+        "wk": stack(E + "self_attn.k_proj.weight", True),
+        "bk": stack(E + "self_attn.k_proj.bias"),
+        "wv": stack(E + "self_attn.v_proj.weight", True),
+        "bv": stack(E + "self_attn.v_proj.bias"),
+        "wo": stack(E + "self_attn.out_proj.weight", True),
+        "bo": stack(E + "self_attn.out_proj.bias"),
+        "w1": stack(E + "mlp.fc1.weight", True),
+        "b1": stack(E + "mlp.fc1.bias"),
+        "w2": stack(E + "mlp.fc2.weight", True),
+        "b2": stack(E + "mlp.fc2.bias"),
+    }
+    return {
+        "patch": patch,
+        "cls": t("embeddings.class_embedding"),
+        "pos": t("embeddings.position_embedding.weight"),
+        "pre_ln_w": t("pre_layrnorm.weight"),   # (sic — HF's historic typo)
+        "pre_ln_b": t("pre_layrnorm.bias"),
+        "post_ln_w": t("post_layernorm.weight"),
+        "post_ln_b": t("post_layernorm.bias"),
+        "layers": layers,
+    }
+
+
+def _ln(x, w, b, eps):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * w + b
+
+
+def _act(x, kind: str):
+    if kind == "quick_gelu":
+        return x * jax.nn.sigmoid(1.702 * x)
+    return jax.nn.gelu(x, approximate=False)
+
+
+def _patchify(pixels, patch: int):
+    """[B, H, W, 3] → [B, N, P·P·3] space-to-depth (rows slowest,
+    channel fastest — must match load_clip_vision_params' kernel layout)."""
+    B, H, W, C = pixels.shape
+    gh, gw = H // patch, W // patch
+    x = pixels.reshape(B, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)         # [B, gh, gw, P, P, C]
+    return x.reshape(B, gh * gw, patch * patch * C)
+
+
+def vit_forward(params: dict, pixels, *, cfg: VitConfig):
+    """[B, H, W, 3] normalized pixels → hidden states [B, 1+N, D]
+    (CLIPVisionModel.last_hidden_state convention: post-LN applied to the
+    pooled CLS in HF, NOT to the sequence — we return pre-post-LN hidden
+    states exactly like ``last_hidden_state``)."""
+    B = pixels.shape[0]
+    D, H = cfg.hidden_size, cfg.num_heads
+    hd = D // H
+
+    x = _patchify(pixels.astype(params["patch"].dtype), cfg.patch_size)
+    x = x @ params["patch"]                                # [B, N, D]
+    cls = jnp.broadcast_to(params["cls"], (B, 1, D))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"]  # [B, 1+N, D]
+    x = _ln(x, params["pre_ln_w"], params["pre_ln_b"], cfg.layer_norm_eps)
+
+    S = x.shape[1]
+
+    def layer(x, lp):
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.layer_norm_eps)
+        q = (h @ lp["wq"] + lp["bq"]).reshape(B, S, H, hd)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(B, S, H, hd)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(B, S, H, hd)
+        scores = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(hd)
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, D)
+        x = x + attn @ lp["wo"] + lp["bo"]
+        h = _ln(x, lp["ln2_w"], lp["ln2_b"], cfg.layer_norm_eps)
+        h = _act(h @ lp["w1"] + lp["b1"], cfg.hidden_act)
+        return x + h @ lp["w2"] + lp["b2"], None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return x
+
+
+def preprocess_image(img, image_size: int) -> np.ndarray:
+    """PIL image / [H,W,3] uint8-or-float array → CLIP-normalized
+    [image_size, image_size, 3] f32."""
+    arr = np.asarray(img)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    arr = arr.astype(np.float32)
+    if arr.ndim == 2:
+        arr = np.stack([arr] * 3, axis=-1)
+    if arr.shape[-1] == 4:  # RGBA (.npy path has no PIL convert("RGB"))
+        arr = arr[..., :3]
+    if arr.shape[-1] != 3:
+        raise ValueError(f"expected RGB(A)/grayscale image, got shape "
+                         f"{arr.shape}")
+    if arr.shape[:2] != (image_size, image_size):
+        arr = np.asarray(jax.image.resize(
+            jnp.asarray(arr), (image_size, image_size, 3), "bilinear"))
+    return (arr - CLIP_MEAN) / CLIP_STD
+
+
+def load_image(ref: str) -> np.ndarray:
+    """Resolve a media ref to an [H, W, 3] array. Zero-egress runtime:
+    ``file:`` / plain paths (PIL formats or .npy) and ``data:`` URIs."""
+    import base64
+    import io
+
+    if ref.startswith("data:"):
+        _, b64 = ref.split(",", 1)
+        from PIL import Image
+
+        return np.asarray(
+            Image.open(io.BytesIO(base64.b64decode(b64))).convert("RGB"))
+    path = ref.removeprefix("file://").removeprefix("file:")
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+
+    return np.asarray(Image.open(path).convert("RGB"))
+
+
+def load_projector(path: str, dtype=jnp.float32) -> dict:
+    """Load llava-style multimodal projector weights from a safetensors
+    file: either our native {w1,b1,w2,b2} ([in, out] layout) or HF llava's
+    ``multi_modal_projector.linear_{1,2}.{weight,bias}`` ([out, in])."""
+    from safetensors import safe_open
+
+    with safe_open(path, framework="np") as f:
+        keys = set(f.keys())
+        if {"w1", "b1", "w2", "b2"} <= keys:
+            return {k: jnp.asarray(f.get_tensor(k), dtype)
+                    for k in ("w1", "b1", "w2", "b2")}
+        pre = "multi_modal_projector."
+        return {
+            "w1": jnp.asarray(f.get_tensor(pre + "linear_1.weight").T, dtype),
+            "b1": jnp.asarray(f.get_tensor(pre + "linear_1.bias"), dtype),
+            "w2": jnp.asarray(f.get_tensor(pre + "linear_2.weight").T, dtype),
+            "b2": jnp.asarray(f.get_tensor(pre + "linear_2.bias"), dtype),
+        }
+
+
+class VitEncoder:
+    """Real vision tower behind the encode worker (StubEncoder's contract:
+    ``encode(ref, n_tokens, dim) -> [n_tokens, dim]``).
+
+    llava-style output: the CLS token is dropped and the N patch embeddings
+    flow to the LM, through the projector when one is configured. The
+    requested (n_tokens, dim) must match what the tower produces — a
+    mismatch means the prompt was built for a different tower, which must
+    fail loudly rather than serve misaligned embeddings.
+    """
+
+    def __init__(self, params: dict, cfg: VitConfig,
+                 projector: Optional[dict] = None):
+        self.cfg = cfg
+        self.params = params
+        self.projector = projector
+
+        def encode_fn(p, proj, px):
+            h = vit_forward(p, px, cfg=cfg)[:, 1:]  # drop CLS (llava)
+            if proj is not None:
+                h = (_act(h @ proj["w1"] + proj["b1"], "gelu")
+                     @ proj["w2"] + proj["b2"])
+            return h
+
+        self._jit = jax.jit(encode_fn)
+
+    @staticmethod
+    def from_pretrained(path: str, dtype=jnp.float32,
+                        projector_path: Optional[str] = None) -> "VitEncoder":
+        cfg = VitConfig.from_hf(path)
+        proj = (load_projector(projector_path, dtype)
+                if projector_path else None)
+        return VitEncoder(load_clip_vision_params(path, dtype), cfg,
+                          projector=proj)
+
+    @property
+    def tokens_per_image(self) -> int:
+        return self.cfg.num_patches
+
+    @property
+    def output_dim(self) -> int:
+        if self.projector is not None:
+            return self.projector["w2"].shape[-1]
+        return self.cfg.hidden_size
+
+    def encode(self, ref: str, n_tokens: int, dim: int) -> np.ndarray:
+        if n_tokens != self.tokens_per_image or dim != self.output_dim:
+            raise ValueError(
+                f"prompt expects ({n_tokens} tokens, dim {dim}) but this "
+                f"tower produces ({self.tokens_per_image}, "
+                f"{self.output_dim}) — placeholder/tower mismatch")
+        pixels = preprocess_image(load_image(ref), self.cfg.image_size)
+        h = self._jit(self.params, self.projector,
+                      jnp.asarray(pixels)[None])
+        return np.asarray(h[0], np.float32)

@@ -185,3 +185,150 @@ def test_sentinel_injection_is_neutralized():
     req, _ = pre.preprocess(parsed)  # must not raise
     assert len(req.mm_refs) == 1
     assert req.mm_refs[0]["ref"] == "img://real"
+
+
+# ----------------------------------------------------- real ViT vision tower
+
+def _tiny_clip(tmp_path):
+    """Save a tiny random CLIPVisionModel checkpoint; returns (model, path)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers import CLIPVisionConfig, CLIPVisionModel
+
+    torch.manual_seed(0)
+    hf_cfg = CLIPVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=3,
+        num_attention_heads=4, image_size=16, patch_size=4)
+    m = CLIPVisionModel(hf_cfg).eval()
+    path = str(tmp_path / "clip")
+    m.save_pretrained(path, safe_serialization=True)
+    return m, path
+
+
+def test_vit_golden_parity_vs_hf(tmp_path):
+    """JAX ViT last_hidden_state vs transformers CLIPVisionModel — the
+    conformance pattern of tests/test_parity.py applied to the tower."""
+    torch = pytest.importorskip("torch")
+    m, path = _tiny_clip(tmp_path)
+
+    from dynamo_tpu.multimodal.vit import (
+        VitConfig, load_clip_vision_params, vit_forward,
+    )
+
+    cfg = VitConfig.from_hf(path)
+    assert cfg.num_patches == 16
+    params = load_clip_vision_params(path)
+
+    rng = np.random.RandomState(3)
+    pixels = rng.randn(2, 16, 16, 3).astype(np.float32)
+    with torch.no_grad():
+        want = m(torch.tensor(pixels.transpose(0, 3, 1, 2))
+                 ).last_hidden_state.numpy()
+    import jax.numpy as jnp
+
+    got = np.asarray(vit_forward(params, jnp.asarray(pixels), cfg=cfg))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-3)
+
+
+def test_vit_encoder_projector_and_contract(tmp_path):
+    """VitEncoder honors the (n_tokens, dim) contract: native shapes pass,
+    a projector re-dims, mismatches fail loudly."""
+    _, path = _tiny_clip(tmp_path)
+    import jax.numpy as jnp
+
+    from dynamo_tpu.multimodal.vit import VitEncoder
+
+    enc = VitEncoder.from_pretrained(path)
+    img = (np.random.RandomState(0).rand(16, 16, 3) * 255).astype(np.uint8)
+    npy = str(tmp_path / "img.npy")
+    np.save(npy, img)
+
+    out = enc.encode(npy, enc.tokens_per_image, enc.output_dim)
+    assert out.shape == (16, 32)
+    # content-stable: the prefix-cache property the router relies on
+    np.testing.assert_array_equal(
+        out, enc.encode(npy, enc.tokens_per_image, enc.output_dim))
+
+    with pytest.raises(ValueError, match="mismatch"):
+        enc.encode(npy, 99, enc.output_dim)
+
+    # llava-style projector maps the tower dim onto the LM's hidden size
+    rng = np.random.RandomState(1)
+    proj = {"w1": jnp.asarray(rng.randn(32, 24), jnp.float32) * 0.1,
+            "b1": jnp.zeros((24,), jnp.float32),
+            "w2": jnp.asarray(rng.randn(24, 64), jnp.float32) * 0.1,
+            "b2": jnp.zeros((64,), jnp.float32)}
+    enc2 = VitEncoder(enc.params, enc.cfg, projector=proj)
+    out2 = enc2.encode(npy, enc2.tokens_per_image, 64)
+    assert out2.shape == (16, 64)
+
+
+async def test_vit_encode_worker_hidden_state_parity_e2e(tmp_path):
+    """Image request through the FULL runway — encode worker (real ViT +
+    projector) → response-plane transfer → decode handler injection — must
+    deliver embeddings bit-identical to the tower's direct output, and the
+    engine must generate from them (hidden-state parity e2e)."""
+    _, path = _tiny_clip(tmp_path)
+    import jax.numpy as jnp
+    from PIL import Image
+
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+    from dynamo_tpu.multimodal import EncodeWorker
+    from dynamo_tpu.multimodal.encoder import ENCODE_COMPONENT
+    from dynamo_tpu.multimodal.vit import VitEncoder
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    cfg = ModelConfig.tiny()  # hidden_size 64
+    rng = np.random.RandomState(1)
+    proj = {"w1": jnp.asarray(rng.randn(32, 24), jnp.float32) * 0.1,
+            "b1": jnp.zeros((24,), jnp.float32),
+            "w2": jnp.asarray(rng.randn(24, cfg.hidden_size),
+                              jnp.float32) * 0.1,
+            "b2": jnp.zeros((cfg.hidden_size,), jnp.float32)}
+    enc = VitEncoder(VitEncoder.from_pretrained(path).params,
+                     VitEncoder.from_pretrained(path).cfg, projector=proj)
+
+    png = str(tmp_path / "cat.png")
+    Image.fromarray((np.random.RandomState(7).rand(20, 20, 3) * 255)
+                    .astype(np.uint8)).save(png)
+    want = enc.encode(png, enc.tokens_per_image, cfg.hidden_size)
+
+    rt = await DistributedRuntime.create()
+    eng = AsyncJaxEngine(cfg, engine_args())
+    worker = await EncodeWorker(rt, encoder=enc).start()
+    client = await rt.namespace("dynamo").component(
+        ENCODE_COMPONENT).endpoint("encode").client().start()
+
+    captured = {}
+    orig_generate = eng.generate
+
+    def spy_generate(req, ctx=None):
+        if req.mm_embeds:
+            captured["segs"] = req.mm_embeds
+        return orig_generate(req, ctx)
+
+    eng.generate = spy_generate
+    handler = DecodeWorkerHandler(eng, mm_client=client)
+
+    n = enc.tokens_per_image
+    req = PreprocessedRequest(
+        model="t", token_ids=[5] + [0] * n + [9, 11, 3],
+        stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+        mm_refs=[{"start": 1, "ref": png, "tokens": n}])
+    toks = []
+    try:
+        async for out in handler.generate(req.to_wire(), None):
+            from dynamo_tpu.protocols import LLMEngineOutput
+            o = LLMEngineOutput.from_wire(out)
+            assert o.finish_reason != "error", o.text
+            toks.extend(o.token_ids)
+        assert len(toks) == 4
+        seg = captured["segs"][0]
+        got = np.asarray(seg["embeds"], np.float32)
+        # transfer fidelity: what the engine injects IS the tower output
+        np.testing.assert_allclose(got, want, atol=1e-6, rtol=1e-6)
+    finally:
+        await worker.stop()
+        await eng.close()
+        await rt.shutdown()
