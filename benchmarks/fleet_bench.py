@@ -1,0 +1,110 @@
+"""Hub ceiling under a fleet-shaped load — VERDICT r3 next-step #5: "benchmark
+the hub at a 100-mocker fleet ... a published hub-ceiling number."
+
+Simulates what N workers actually put on the dynctl hub during serving
+(each over its own TCP connection, like a real fleet):
+
+- KV events: chained stored + removed publishes to the ``kv_events`` stream
+  (the router feed — the highest-rate producer in a real deployment);
+- metrics: ForwardPassMetrics pub/sub at a fixed cadence per worker;
+- discovery heartbeats: lease keepalives.
+
+One KvIndexer consumes the event stream concurrently (the router's actual
+code path, radix apply included). Reported:
+
+- ``events_per_s``: aggregate stored/removed publishes the hub sustained;
+- ``indexer_lag_events``: how far the router's single consumer task was
+  behind at the end (0 = the router keeps up at this fleet size);
+- ``indexer_applied_per_s``: radix apply throughput.
+
+Usage: python -m benchmarks.fleet_bench [--workers 100] [--seconds 5]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+from dynamo_tpu.router.indexer import KvIndexer
+from dynamo_tpu.router.protocols import ForwardPassMetrics, KvStats, StoredBlock, WorkerStats
+from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.runtime.control_plane import ControlPlaneServer, RemoteControlPlane
+
+BLOCK_SIZE = 16
+CHAIN = 8  # blocks announced per stored event (a 128-token prefill chunk)
+
+
+async def _worker_load(i: int, plane, stop_at: float, counts: list[int]):
+    """One worker's steady-state hub traffic: publish a stored chain, later
+    remove it (LRU churn), heartbeat the lease, publish metrics."""
+    kv = KvEventPublisher(plane, worker_id=i + 1, kv_block_size=BLOCK_SIZE)
+    metrics = WorkerMetricsPublisher(plane, worker_id=i + 1)
+    lease = await plane.lease_create(ttl=5.0)
+    base = (i + 1) << 32
+    gen = 0
+    while time.perf_counter() < stop_at:
+        hashes = [base + gen * CHAIN + j for j in range(CHAIN)]
+        await kv.publish_stored(None, [
+            StoredBlock(block_hash=h, tokens_hash=h) for h in hashes])
+        counts[i] += 1
+        if gen % 4 == 3:  # evict an older chain: 3:1 store:remove mix
+            old = [base + (gen - 3) * CHAIN + j for j in range(CHAIN)]
+            await kv.publish_removed(old)
+            counts[i] += 1
+        if gen % 8 == 0:
+            await metrics.publish(ForwardPassMetrics(
+                worker_stats=WorkerStats(request_active_slots=4, request_total_slots=64),
+                kv_stats=KvStats(kv_active_blocks=CHAIN * 4, kv_total_blocks=1024,
+                                 gpu_cache_usage_perc=0.1)))
+            await plane.lease_keepalive(lease)
+        gen += 1
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="fleet-shaped hub ceiling bench")
+    ap.add_argument("--workers", type=int, default=100)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    cli = ap.parse_args()
+
+    server = ControlPlaneServer(port=0)
+    addr = await server.start()
+    planes = [await RemoteControlPlane(addr).connect() for _ in range(cli.workers)]
+    router_plane = await RemoteControlPlane(addr).connect()
+    indexer = await KvIndexer(router_plane, kv_block_size=BLOCK_SIZE).start()
+
+    counts = [0] * cli.workers
+    t0 = time.perf_counter()
+    stop_at = t0 + cli.seconds
+    await asyncio.gather(*(
+        _worker_load(i, p, stop_at, counts) for i, p in enumerate(planes)))
+    dt = time.perf_counter() - t0
+
+    published = sum(counts)
+    last = await router_plane.stream_last_seq("kv_events")
+    lag = last - indexer._last_seq
+    # give the consumer a moment to drain, then measure apply throughput
+    drain_t0 = time.perf_counter()
+    while indexer._last_seq < last and time.perf_counter() - drain_t0 < 10:
+        await asyncio.sleep(0.05)
+    out = {
+        "workers": cli.workers,
+        "events_per_s": round(published / dt, 1),
+        "indexer_lag_events": int(lag),
+        "indexer_applied": indexer.events_applied,
+        "indexer_applied_per_s": round(
+            indexer.events_applied / (time.perf_counter() - t0), 1),
+        "gaps_detected": indexer.gaps_detected,
+        "seconds": round(dt, 3),
+    }
+    await indexer.stop()
+    for p in planes + [router_plane]:
+        await p.close()
+    await server.stop()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    asyncio.run(amain())

@@ -257,6 +257,12 @@ async def amain():
 
     if cli.dp_rank is not None and not 0 <= cli.dp_rank < cli.num_ranks:
         ap.error(f"--dp-rank {cli.dp_rank} outside [0, {cli.num_ranks})")
+    if (cli.mm_vision_model or cli.mm_projector) and not cli.mm_encode:
+        ap.error("--mm-vision-model/--mm-projector configure the encode "
+                 "worker — pass --mm-encode to start one")
+    if cli.mm_projector and not cli.mm_vision_model:
+        ap.error("--mm-projector without --mm-vision-model would leave the "
+                 "stub encoder serving random embeddings — pass the tower too")
 
     cli._mh_rank, cli._mh_world = 0, 1
     if cli.jax_coordinator or cli.jax_num_processes:
@@ -314,9 +320,10 @@ async def amain():
 
     lease = await runtime.primary_lease()
     engine.dp_rank = cli.dp_rank
-    engine.event_cb = KvEventPublisher(
-        runtime.plane, worker_id=lease,
-        kv_block_size=args.block_size).publish_sync
+    kv_pub = KvEventPublisher(
+        runtime.plane, worker_id=lease, kv_block_size=args.block_size)
+    await kv_pub.start_resync_responder()
+    engine.event_cb = kv_pub.publish_sync
     engine.metrics_cb = WorkerMetricsPublisher(
         runtime.plane, worker_id=lease).publish_sync
 
@@ -358,9 +365,6 @@ async def amain():
 
     mm_worker = None
     mm_encoder = None
-    if (cli.mm_vision_model or cli.mm_projector) and not cli.mm_encode:
-        ap.error("--mm-vision-model/--mm-projector configure the encode "
-                 "worker — pass --mm-encode to start one")
     if cli.mm_encode:
         from dynamo_tpu.multimodal import EncodeWorker
         if cli.mm_vision_model:

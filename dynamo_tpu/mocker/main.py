@@ -29,6 +29,7 @@ async def run_mocker(
 ):
     lease = lease_id if lease_id is not None else await runtime.primary_lease()
     kv_pub = KvEventPublisher(runtime.plane, worker_id=lease, kv_block_size=args.block_size)
+    await kv_pub.start_resync_responder()
     metrics_pub = WorkerMetricsPublisher(runtime.plane, worker_id=lease)
     engine = await MockEngine(args, kv_pub, metrics_pub).start()
 

@@ -247,8 +247,16 @@ def preprocess_image(img, image_size: int) -> np.ndarray:
         raise ValueError(f"expected RGB(A)/grayscale image, got shape "
                          f"{arr.shape}")
     if arr.shape[:2] != (image_size, image_size):
+        # Match CLIPImageProcessor: bicubic shortest-edge resize, then
+        # center crop — NOT an aspect-distorting squash (the towers were
+        # trained on crop-preprocessed images).
+        h, w = arr.shape[:2]
+        scale = image_size / min(h, w)
+        nh, nw = max(image_size, round(h * scale)), max(image_size, round(w * scale))
         arr = np.asarray(jax.image.resize(
-            jnp.asarray(arr), (image_size, image_size, 3), "bilinear"))
+            jnp.asarray(arr), (nh, nw, 3), "cubic"))
+        top, left = (nh - image_size) // 2, (nw - image_size) // 2
+        arr = arr[top:top + image_size, left:left + image_size]
     return (arr - CLIP_MEAN) / CLIP_STD
 
 

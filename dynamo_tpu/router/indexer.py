@@ -22,7 +22,13 @@ from typing import Optional
 
 import msgpack
 
-from dynamo_tpu.router.protocols import KV_EVENTS_STREAM, KvCacheEvent, RouterEvent, StoredBlock
+from dynamo_tpu.router.protocols import (
+    KV_EVENTS_STREAM,
+    KV_RESYNC_SUBJECT,
+    KvCacheEvent,
+    RouterEvent,
+    StoredBlock,
+)
 
 logger = logging.getLogger("dynamo.kv_indexer")
 
@@ -58,6 +64,9 @@ class RadixTree:
         # (worker_id, external_block_hash) -> node, for O(1) removal
         self._lookup: dict[tuple[int, int], _Node] = {}
         self.event_count = 0
+        #: stored events dropped because their parent was unknown — each one
+        #: is evidence of event loss; the indexer turns these into resyncs
+        self.orphan_events = 0
 
     def apply_event(self, ev: RouterEvent) -> None:
         self.event_count += 1
@@ -75,10 +84,16 @@ class RadixTree:
         else:
             node = self._lookup.get((worker, e.stored_parent_hash))
             if node is None:
-                # Parent unknown (event loss / eviction race): anchor at root
-                # like the reference's defensive path.
-                logger.debug("stored event with unknown parent %x from %x", e.stored_parent_hash, worker)
-                node = self.root
+                # Parent unknown = we provably missed the parent's stored
+                # event (loss or eviction race). Anchoring mid-chain blocks
+                # at the root would fabricate first-block prefix matches
+                # that nothing ever removes (removal goes through _lookup);
+                # drop instead and let the indexer's orphan counter force a
+                # worker resync, which re-announces the full chain.
+                logger.debug("stored event with unknown parent %x from %x dropped",
+                             e.stored_parent_hash, worker)
+                self.orphan_events += 1
+                return
         for b in e.stored_blocks:
             child = node.children.get(b.tokens_hash)
             if child is None:
@@ -207,6 +222,9 @@ class KvIndexer:
         self._last_seq = -1
         self._since_snapshot = 0
         self._snapshot_task: Optional[asyncio.Task] = None
+        self.gaps_detected = 0
+        self.resyncs_requested = 0
+        self._last_resync_at = 0.0  # monotonic; debounces orphan-triggered resyncs
 
     async def start(self, start_seq: int = 0) -> "KvIndexer":
         if self.snapshot_threshold and not self.reset_states:
@@ -224,9 +242,51 @@ class KvIndexer:
                 except Exception:
                     logger.exception("radix snapshot restore failed; fresh tree")
                     self.tree = RadixTree()
-        self._sub = await self.plane.stream_subscribe(self.stream, start_seq=start_seq)
+        # Subscribe-time gap check (ref: subscriber.rs:30-65 sequence-gap →
+        # snapshot resync). Two provable-loss shapes, both of which would
+        # otherwise leave a quiescent stream serving a silently-stale tree:
+        # - truncated: the ring advanced past our resume point — events in
+        #   (start_seq, first_seq) are gone forever;
+        # - regressed: the hub restarted and seqs reset below our resume
+        #   point — stream_subscribe(start_seq) would filter the ENTIRE
+        #   post-restart backlog as "already seen".
+        first = await self.plane.stream_first_seq(self.stream)
+        last = await self.plane.stream_last_seq(self.stream)
+        truncated = start_seq + 1 < first and last > start_seq
+        regressed = last < start_seq
+        if truncated or regressed:
+            logger.warning(
+                "kv event stream %s %s resume seq %d (first retained %d, last %d); resyncing",
+                self.stream, "truncated past" if truncated else "regressed below",
+                start_seq, first, last)
+            start_seq = first - 1
+            self._last_seq = start_seq  # cursor now means "post-gap window"
+            self._sub = await self.plane.stream_subscribe(self.stream, start_seq=start_seq)
+            await self._force_resync()
+        else:
+            self._sub = await self.plane.stream_subscribe(self.stream, start_seq=start_seq)
+            self._last_seq = max(self._last_seq, start_seq)
         self._task = asyncio.get_running_loop().create_task(self._loop())
         return self
+
+    async def _force_resync(self):
+        """Drop the (possibly stale) tree and ask every worker to re-announce
+        its cache contents. Stored events are idempotent, so replicas that
+        did NOT gap simply re-confirm their state."""
+        self.gaps_detected += 1
+        self.tree = RadixTree()
+        await self._request_resync()
+
+    async def _request_resync(self):
+        """Ask workers for a replay WITHOUT dropping the tree (used for
+        orphaned chains, where existing state is still valid — replayed
+        stored events are idempotent upserts)."""
+        self._last_resync_at = time.monotonic()
+        try:
+            await self.plane.publish(f"{KV_RESYNC_SUBJECT}.{self.stream}", b"resync")
+            self.resyncs_requested += 1
+        except Exception:
+            logger.exception("kv resync request failed")
 
     async def stop(self):
         if self._task:
@@ -242,12 +302,31 @@ class KvIndexer:
     async def _loop(self):
         try:
             async for seq, payload in self._sub:
+                if self._last_seq >= 0 and seq != self._last_seq + 1:
+                    # Forward jump = ring overflow outran this consumer;
+                    # regression = plane restarted and the stream reset.
+                    # Either way the tree can no longer be trusted.
+                    logger.warning(
+                        "kv event stream %s gap (applied %d, received %d); resyncing",
+                        self.stream, self._last_seq, seq)
+                    await self._force_resync()
+                # a received-but-undecodable event was not MISSED — advance
+                # the cursor regardless so it can't masquerade as a gap
+                self._last_seq = seq
                 try:
                     ev = RouterEvent.from_wire(msgpack.unpackb(payload, raw=False))
+                    orphans_before = self.tree.orphan_events
                     self.tree.apply_event(ev)
                     self.events_applied += 1
-                    self._last_seq = seq
                     self._since_snapshot += 1
+                    if self.tree.orphan_events > orphans_before:
+                        # a dropped unknown-parent chain means this tree is
+                        # missing state the worker holds; ask for a replay
+                        # (debounced — one gap usually orphans many chains)
+                        now = time.monotonic()
+                        if now - self._last_resync_at > 5.0:
+                            self._last_resync_at = now
+                            await self._request_resync()
                 except Exception:
                     logger.exception("bad kv event ignored")
                 if (self.snapshot_threshold

@@ -19,6 +19,8 @@ from typing import Optional
 KV_EVENTS_STREAM = "kv_events"
 #: pub/sub subject carrying ForwardPassMetrics (ref: "kv_metrics")
 KV_METRICS_SUBJECT = "kv_metrics"
+#: subject prefix a gapped router publishes on to ask workers to re-announce
+KV_RESYNC_SUBJECT = "kv_resync"
 #: object-store bucket for radix snapshots (ref: kv_router.rs:68-71)
 RADIX_STATE_BUCKET = "radix-bucket"
 

@@ -202,6 +202,12 @@ class ControlPlane(abc.ABC):
     @abc.abstractmethod
     async def stream_last_seq(self, stream: str) -> int: ...
 
+    @abc.abstractmethod
+    async def stream_first_seq(self, stream: str) -> int:
+        """Oldest seq still retained (ring truncation floor). A consumer whose
+        last applied seq is < first_seq-1 has provably missed events and must
+        resync (ref: JetStream stream FirstSeq, kv_router/subscriber.rs:30-65)."""
+
     # -- Object store --
     @abc.abstractmethod
     async def object_put(self, bucket: str, name: str, data: bytes) -> None: ...
@@ -247,10 +253,11 @@ def _subject_matches(pattern: str, subject: str) -> bool:
 class LocalControlPlane(ControlPlane):
     """In-process control plane; also the core of :class:`ControlPlaneServer`."""
 
-    def __init__(self):
+    def __init__(self, stream_max_len: int = STREAM_MAX_LEN):
         #: identifies this hub incarnation: stream seqs are only comparable
         #: within one epoch (clients resume from 0 after a hub restart)
         self.epoch = f"{random.getrandbits(64):016x}"
+        self.stream_max_len = stream_max_len
         self._kv: dict[str, bytes] = {}
         self._key_lease: dict[str, int] = {}
         self._leases: dict[int, _Lease] = {}
@@ -460,8 +467,8 @@ class LocalControlPlane(ControlPlane):
         seq, entries = self._streams.get(stream, (0, []))
         seq += 1
         entries.append((seq, payload))
-        if len(entries) > STREAM_MAX_LEN:
-            entries[:] = entries[-STREAM_MAX_LEN:]
+        if len(entries) > self.stream_max_len:
+            entries[:] = entries[-self.stream_max_len:]
         self._streams[stream] = (seq, entries)
         for q in self._stream_subs.get(stream, []):
             q.put_nowait((seq, payload))
@@ -486,6 +493,10 @@ class LocalControlPlane(ControlPlane):
     async def stream_last_seq(self, stream) -> int:
         seq, _ = self._streams.get(stream, (0, []))
         return seq
+
+    async def stream_first_seq(self, stream) -> int:
+        seq, entries = self._streams.get(stream, (0, []))
+        return entries[0][0] if entries else seq + 1
 
     # -- Object store --
     async def object_put(self, bucket, name, data):
@@ -685,6 +696,8 @@ class _ServerConn:
             await self._start_stream_sub(m["sid"], m["stream"], m.get("start_seq", 0))
         elif op == "stream_last_seq":
             return await core.stream_last_seq(m["stream"])
+        elif op == "stream_first_seq":
+            return await core.stream_first_seq(m["stream"])
         elif op == "object_put":
             await core.object_put(m["bucket"], m["name"], m["data"])
         elif op == "object_get":
@@ -1085,6 +1098,9 @@ class RemoteControlPlane(ControlPlane):
 
     async def stream_last_seq(self, stream) -> int:
         return await self._call("stream_last_seq", stream=stream)
+
+    async def stream_first_seq(self, stream) -> int:
+        return await self._call("stream_first_seq", stream=stream)
 
     # -- Object store --
     async def object_put(self, bucket, name, data):

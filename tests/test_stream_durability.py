@@ -1,0 +1,283 @@
+"""Stream-gap detection and radix resync.
+
+The hub's durable streams are ring buffers (runtime/control_plane.py
+STREAM_MAX_LEN): past the cap the oldest entries silently vanish. Round-3
+verdict: a slow or restarted router could lose KV events with no resync
+signal, leaving its radix index silently stale. The recovery protocol
+mirrors the reference's durable-consumer resync
+(ref: lib/llm/src/kv_router/subscriber.rs:30-65):
+
+- the plane exposes ``stream_first_seq`` (JetStream FirstSeq analog),
+- the indexer detects gaps at subscribe time (truncated past resume point)
+  and mid-stream (seq discontinuity, incl. hub-restart regression),
+- on gap it drops the tree and publishes on ``kv_resync.<stream>``,
+- every worker's KvEventPublisher answers by re-announcing its mirror of
+  currently-held blocks (idempotent stored upserts).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.router.indexer import KvIndexer
+from dynamo_tpu.router.publisher import KvEventPublisher
+from dynamo_tpu.router.protocols import StoredBlock
+from dynamo_tpu.runtime.control_plane import LocalControlPlane
+
+pytestmark = pytest.mark.anyio
+
+BS = 4  # kv block size for these tests
+
+
+async def _announce_chain(pub: KvEventPublisher, hashes: list[int], base: int = 0):
+    """Announce a chain of blocks whose tokens_hash == block_hash + base."""
+    blocks = [StoredBlock(block_hash=h, tokens_hash=h + base) for h in hashes]
+    await pub.publish_stored(None, blocks)
+
+
+async def _drain(indexer: KvIndexer, timeout: float = 2.0):
+    """Wait until the indexer has consumed everything currently in the stream."""
+    last = await indexer.plane.stream_last_seq(indexer.stream)
+    deadline = asyncio.get_running_loop().time() + timeout
+    while indexer._last_seq < last:
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(f"indexer stuck at {indexer._last_seq} < {last}")
+        await asyncio.sleep(0.01)
+        last = await indexer.plane.stream_last_seq(indexer.stream)
+
+
+async def test_stream_first_seq_tracks_truncation():
+    plane = LocalControlPlane(stream_max_len=4)
+    assert await plane.stream_first_seq("s") == 1  # empty stream: next seq
+    for i in range(10):
+        await plane.stream_publish("s", bytes([i]))
+    assert await plane.stream_last_seq("s") == 10
+    assert await plane.stream_first_seq("s") == 7  # 4 retained: 7..10
+    await plane.close()
+
+
+async def test_subscribe_time_gap_triggers_resync():
+    """A router that subscribes after the ring truncated must not serve a
+    silently-empty index: it asks workers to re-announce."""
+    plane = LocalControlPlane(stream_max_len=4)
+    pub = await KvEventPublisher(plane, worker_id=7, kv_block_size=BS).start_resync_responder()
+
+    # worker announces 3 chains; ring cap 4 then floods with removals of
+    # unknown blocks so every stored event is truncated out of the ring
+    await _announce_chain(pub, [1, 2, 3])
+    await _announce_chain(pub, [10, 11])
+    await pub.publish_removed([999])  # no-op remove, just stream traffic
+    for _ in range(8):
+        await plane.stream_publish("kv_events", b"\x81\xa1x\x01")  # junk filler
+
+    # fresh router joins late: resume point 0 but first retained seq > 1
+    idx = await KvIndexer(plane, kv_block_size=BS).start()
+    try:
+        assert idx.gaps_detected == 1
+        assert idx.resyncs_requested == 1
+        # resync replay flows through the stream; wait for it
+        for _ in range(200):
+            if idx.tree.find_matches([1, 2, 3]).best() == 3:
+                break
+            await asyncio.sleep(0.01)
+        scores = idx.tree.find_matches([1, 2, 3])
+        assert scores.scores == {7: 3}
+        assert idx.tree.find_matches([10, 11]).scores == {7: 2}
+        assert pub.resyncs_served == 1
+    finally:
+        await idx.stop()
+        await pub.stop()
+        await plane.close()
+
+
+async def test_midlife_gap_triggers_resync():
+    """A seq discontinuity on a live subscription (overflow outran the
+    consumer, or the hub restarted and seqs regressed) drops + rebuilds."""
+    plane = LocalControlPlane()
+    pub = await KvEventPublisher(plane, worker_id=3, kv_block_size=BS).start_resync_responder()
+    idx = await KvIndexer(plane, kv_block_size=BS).start()
+    try:
+        await _announce_chain(pub, [5, 6])
+        await _drain(idx)
+        assert idx.tree.find_matches([5, 6]).best() == 2
+
+        # simulate the consumer having missed 100 events: jump the stream seq
+        seq, entries = plane._streams["kv_events"]
+        plane._streams["kv_events"] = (seq + 100, entries)
+        await _announce_chain(pub, [20, 21])
+
+        for _ in range(200):
+            if (idx.tree.find_matches([5, 6]).best() == 2
+                    and idx.tree.find_matches([20, 21]).best() == 2):
+                break
+            await asyncio.sleep(0.01)
+        assert idx.gaps_detected == 1
+        # tree was rebuilt from the worker's mirror: old AND new chains present
+        assert idx.tree.find_matches([5, 6]).scores == {3: 2}
+        assert idx.tree.find_matches([20, 21]).scores == {3: 2}
+    finally:
+        await idx.stop()
+        await pub.stop()
+        await plane.close()
+
+
+async def test_publisher_mirror_tracks_removals():
+    """The resync replay must not resurrect blocks the worker evicted."""
+    plane = LocalControlPlane()
+    pub = await KvEventPublisher(plane, worker_id=9, kv_block_size=BS).start_resync_responder()
+    idx = await KvIndexer(plane, kv_block_size=BS).start()
+    try:
+        await _announce_chain(pub, [1, 2, 3])
+        await pub.publish_removed([3])
+        await _drain(idx)
+        assert idx.tree.find_matches([1, 2, 3]).best() == 2
+
+        # force a gap → resync; evicted block 3 must NOT come back
+        seq, entries = plane._streams["kv_events"]
+        plane._streams["kv_events"] = (seq + 50, entries)
+        await _announce_chain(pub, [40])
+        for _ in range(200):
+            if idx.tree.find_matches([40]).best() == 1:
+                break
+            await asyncio.sleep(0.01)
+        assert idx.tree.find_matches([1, 2, 3]).scores == {9: 2}
+        assert (9, 3) not in idx.tree._lookup
+    finally:
+        await idx.stop()
+        await pub.stop()
+        await plane.close()
+
+
+async def test_eviction_racing_replay_cannot_resurrect_block():
+    """A removed(h) issued WHILE a resync replay is in flight must land
+    after the replay's stored(h) on the stream (publish-lock atomicity) —
+    otherwise the router would believe h exists after the worker evicted it."""
+
+    class SlowStreamPlane(LocalControlPlane):
+        async def stream_publish(self, stream, payload):
+            await asyncio.sleep(0.01)  # widen the interleaving window
+            return await super().stream_publish(stream, payload)
+
+    plane = SlowStreamPlane()
+    pub = await KvEventPublisher(plane, worker_id=4, kv_block_size=BS).start_resync_responder()
+    idx = await KvIndexer(plane, kv_block_size=BS).start()
+    try:
+        # many single-block chains → replay spans many stream appends
+        for h in range(100, 120):
+            await _announce_chain(pub, [h])
+        await _drain(idx)
+
+        replay = asyncio.get_running_loop().create_task(pub._replay_announced())
+        await asyncio.sleep(0.03)  # replay is mid-flight now
+        await pub.publish_removed([110])
+        await replay
+        await _drain(idx)
+        assert idx.tree.find_matches([110]).best() == 0
+        assert (4, 110) not in idx.tree._lookup
+        assert idx.tree.find_matches([111]).scores == {4: 1}
+    finally:
+        await idx.stop()
+        await pub.stop()
+        await plane.close()
+
+
+async def test_orphan_chain_triggers_resync_without_tree_reset():
+    """A stored event with an unknown parent is dropped (no phantom
+    root-anchored prefix matches) and provokes a worker replay."""
+    plane = LocalControlPlane()
+    pub = await KvEventPublisher(plane, worker_id=6, kv_block_size=BS).start_resync_responder()
+    idx = await KvIndexer(plane, kv_block_size=BS).start()
+    try:
+        await _announce_chain(pub, [1, 2])
+        await _drain(idx)
+        # mid-chain announcement whose parent the INDEXER never saw: publish
+        # directly, bypassing the mirror bookkeeping of a real parent
+        import msgpack
+        from dynamo_tpu.router.protocols import KvCacheEvent, RouterEvent
+        ev = RouterEvent(6, KvCacheEvent.stored(
+            999, 12345, [StoredBlock(block_hash=50, tokens_hash=50)]))
+        await plane.stream_publish("kv_events", msgpack.packb(ev.to_wire()))
+        for _ in range(200):
+            if idx.resyncs_requested >= 1 and idx.tree.find_matches([1, 2]).best() == 2:
+                break
+            await asyncio.sleep(0.01)
+        assert idx.tree.orphan_events == 1
+        assert idx.gaps_detected == 0          # tree was NOT reset
+        assert idx.resyncs_requested == 1
+        # the orphan block never shows up as a false FIRST-block match
+        assert idx.tree.find_matches([50]).best() == 0
+        assert idx.tree.find_matches([1, 2]).scores == {6: 2}
+    finally:
+        await idx.stop()
+        await pub.stop()
+        await plane.close()
+
+
+async def test_replay_skips_chains_with_evicted_ancestors():
+    """A dangling mirror entry (parent evicted, child surviving) must NOT be
+    replayed: it would be an eternal orphan at every indexer, re-triggering
+    fleet-wide replays forever."""
+    plane = LocalControlPlane()
+    pub = await KvEventPublisher(plane, worker_id=2, kv_block_size=BS).start_resync_responder()
+    idx = await KvIndexer(plane, kv_block_size=BS).start()
+    try:
+        await _announce_chain(pub, [1, 2, 3])
+        await pub.publish_removed([2])  # middle eviction: 3 is now dangling
+        await _drain(idx)
+
+        await pub._replay_announced()
+        await _drain(idx)
+        assert idx.tree.orphan_events == 0          # nothing undeliverable emitted
+        assert idx.resyncs_requested == 0           # and no resync storm
+        assert idx.tree.find_matches([1]).scores == {2: 1}
+    finally:
+        await idx.stop()
+        await pub.stop()
+        await plane.close()
+
+
+async def test_hub_restart_regression_detected_at_subscribe():
+    """A router resuming from a pre-restart snapshot (seq 500) against a
+    reset stream (seqs 1..N) must resync and consume the whole backlog —
+    not filter it all as already-seen."""
+    import msgpack
+
+    from dynamo_tpu.router.indexer import RADIX_BUCKET, RadixTree
+
+    plane = LocalControlPlane()
+    pub = await KvEventPublisher(plane, worker_id=8, kv_block_size=BS).start_resync_responder()
+    # pre-restart snapshot: stale tree state at seq 500
+    stale = RadixTree()
+    await plane.object_put(RADIX_BUCKET, "kv_events", msgpack.packb(
+        {"seq": 500, "tree": stale.dump()}))
+    # post-restart world: the stream starts over at seq 1
+    await _announce_chain(pub, [70, 71])
+
+    idx = await KvIndexer(plane, kv_block_size=BS, snapshot_threshold=10000).start()
+    try:
+        assert idx.gaps_detected == 1
+        for _ in range(200):
+            if idx.tree.find_matches([70, 71]).best() == 2:
+                break
+            await asyncio.sleep(0.01)
+        assert idx.tree.find_matches([70, 71]).scores == {8: 2}
+        assert idx._last_seq >= 1  # cursor rebased into the new epoch
+    finally:
+        await idx.stop()
+        await pub.stop()
+        await plane.close()
+
+
+async def test_no_spurious_resync_on_clean_stream():
+    plane = LocalControlPlane()
+    pub = KvEventPublisher(plane, worker_id=1, kv_block_size=BS)
+    await _announce_chain(pub, [1])
+    idx = await KvIndexer(plane, kv_block_size=BS).start()
+    try:
+        await _announce_chain(pub, [2])
+        await _drain(idx)
+        assert idx.gaps_detected == 0
+        assert idx.resyncs_requested == 0
+    finally:
+        await idx.stop()
+        await plane.close()
