@@ -433,6 +433,10 @@ def main():
           flush=True)
 
 
+class _PhaseSkipped(Exception):
+    """Raised to skip the e2e phase under DYN_BENCH_PHASES."""
+
+
 def _child_main():
     """Always prints exactly ONE JSON metric line, whatever breaks.
 
@@ -445,29 +449,44 @@ def _child_main():
     out = {"metric": "bench_failed", "value": 0.0, "unit": "tok/s",
            "vs_baseline": 0.0, "extra": {}}
     rc = 1
+    # DYN_BENCH_PHASES: comma list of {kernel,spec,e2e} to run (default all)
+    # — perf iteration on one phase shouldn't pay the full suite each time
+    phases = {p.strip() for p in
+              os.environ.get("DYN_BENCH_PHASES", "kernel,spec,e2e").split(",")
+              if p.strip()}
+    unknown = phases - {"kernel", "spec", "e2e"}
+    if unknown:
+        # a typo'd phase must not masquerade as a 100% perf regression
+        raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
+                         f"{sorted(unknown)} (valid: kernel, spec, e2e)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
-        kern = kernel_bench(on_tpu)
-        try:
-            # int8 weights halve HBM weight traffic — the bandwidth-bound
-            # decode ceiling doubles; measure it alongside bf16 so the
-            # quantization win is on record whenever the chip is up
-            kern.update(kernel_bench(on_tpu, quantization="int8"))
-        except Exception as e:  # noqa: BLE001 — optional extra datum
-            kern["kernel_int8_error"] = repr(e)[:200]
-        try:
-            # int8 KV pages: the other half of decode's HBM traffic
-            kern.update(kernel_bench(on_tpu, quantization="int8",
-                                     kv_int8=True))
-        except Exception as e:  # noqa: BLE001 — optional extra datum
-            kern["kernel_kv8_error"] = repr(e)[:200]
-        try:
-            # before the out={} snapshot below: spec numbers must survive
-            # an e2e failure (extra holds a copy of kern, not a reference)
-            kern.update(asyncio.run(_spec_bench(on_tpu)))
-        except Exception as e:  # noqa: BLE001 — optional extra datum
-            kern["spec_error"] = repr(e)[:200]
+        if "kernel" in phases:
+            kern = kernel_bench(on_tpu)
+        else:
+            kern = {"kernel_tok_s": 0.0, "kernel_skipped": True}
+        if "kernel" in phases:
+            try:
+                # int8 weights halve HBM weight traffic — the bandwidth-bound
+                # decode ceiling doubles; measure it alongside bf16 so the
+                # quantization win is on record whenever the chip is up
+                kern.update(kernel_bench(on_tpu, quantization="int8"))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["kernel_int8_error"] = repr(e)[:200]
+            try:
+                # int8 KV pages: the other half of decode's HBM traffic
+                kern.update(kernel_bench(on_tpu, quantization="int8",
+                                         kv_int8=True))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["kernel_kv8_error"] = repr(e)[:200]
+        if "spec" in phases:
+            try:
+                # before the out={} snapshot below: spec numbers must survive
+                # an e2e failure (extra holds a copy of kern, not a reference)
+                kern.update(asyncio.run(_spec_bench(on_tpu)))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["spec_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         out = {
             "metric": f"kernel_decode_tok_s_per_chip[{model},{platform},"
@@ -479,7 +498,11 @@ def _child_main():
         }
         rc = 0
         try:
+            if "e2e" not in phases:
+                raise _PhaseSkipped()
             e2e = asyncio.run(_e2e(on_tpu))
+        except _PhaseSkipped:
+            out["extra"]["e2e_skipped"] = True
         except Exception as e:  # noqa: BLE001 — keep the kernel metric
             traceback.print_exc()
             out["extra"]["e2e_error"] = repr(e)[:300]

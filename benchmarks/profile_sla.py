@@ -7,6 +7,22 @@ ref: benchmarks/profiler/profile_sla.py — the planner inverts these sweeps
      "decode":  [[tok_per_s, itl_ms], ...],
      "isl_words": N, "osl": M}
 
+Beyond the sweep (matching the reference profiler's surface):
+
+- ``--dry-run``: print the full measurement plan (levels × ISLs, request
+  counts, rough duration) without touching the endpoint (ref profile_sla
+  --dry-run);
+- ``--ttft-target/--itl-target``: after the sweep, invert the measured
+  curves through the PLANNER'S OWN interpolator and print the recommended
+  per-replica operating loads — the same math the SLA planner will run in
+  production, so what the profiler promises is what the planner enforces
+  (ref: recommendation phase, profile_sla.py:400-470);
+- SLA inversion self-check: every emitted curve is verified to round-trip
+  (latency_at(max_load_under(t)) ≤ t) and flagged when non-monotonic —
+  a noisy sweep that would make the planner oscillate fails loudly here;
+- resumable: existing ``--out`` reuses completed (isl, concurrency) levels
+  (ref: profile_cache utils).
+
 Usage: python -m benchmarks.profile_sla --url http://localhost:8000 \
            --model demo --out profile.json
 """
@@ -16,15 +32,29 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 
 from benchmarks.client import run_closed_loop, summarize
 
 
 async def sweep(url: str, model: str, isl_words: int, osl: int,
-                concurrencies: list[int], requests_per_level: int):
+                concurrencies: list[int], requests_per_level: int,
+                cache: dict, save=None):
+    """One ISL's concurrency sweep. ``cache`` maps "isl:conc" → completed
+    level results; hits are reused (resume after an aborted run). ``save``
+    is called after EVERY completed level so an aborted sweep leaves its
+    finished levels on disk for the rerun."""
     prefill_pts, decode_pts = [], []
-    results = []
+    isl_tokens = None
     for c in concurrencies:
+        key = f"{isl_words}:{c}"
+        hit = cache.get(key)
+        if hit:
+            prefill_pts.append(hit["prefill_pt"])
+            decode_pts.append(hit["decode_pt"])
+            isl_tokens = hit.get("isl_tokens") or isl_tokens
+            print(f"concurrency={c}: cached", flush=True)
+            continue
         results = await run_closed_loop(
             url, model, concurrency=c, num_requests=requests_per_level,
             isl_words=isl_words, osl=osl)
@@ -35,15 +65,83 @@ async def sweep(url: str, model: str, isl_words: int, osl: int,
         wall = sum(r.latency_s for r in ok) / max(1, c)  # per-worker stream time
         req_rate = len(ok) / max(1e-9, wall)
         tok_rate = sum(r.tokens for r in ok) / max(1e-9, wall)
-        prefill_pts.append([round(req_rate, 3), s["ttft_p50_ms"]])
-        decode_pts.append([round(tok_rate, 1), s["itl_p50_ms"]])
+        prefill_pt = [round(req_rate, 3), s["ttft_p50_ms"]]
+        decode_pt = [round(tok_rate, 1), s["itl_p50_ms"]]
+        prefill_pts.append(prefill_pt)
+        decode_pts.append(decode_pt)
+        # measured TOKEN ISL (from response usage) — the planner's
+        # Prometheus observations are in tokens, so curves must be keyed
+        # the same way
+        with_tok = [r for r in ok if r.prompt_tokens]
+        lvl_tok = (sum(r.prompt_tokens for r in with_tok) / len(with_tok)
+                   if with_tok else None)
+        isl_tokens = lvl_tok or isl_tokens
+        cache[key] = {"prefill_pt": prefill_pt, "decode_pt": decode_pt,
+                      "isl_tokens": lvl_tok}
+        if save is not None:
+            save()
         print(f"concurrency={c}: {s}", flush=True)
-    # measured TOKEN ISL (from response usage) — the planner's Prometheus
-    # observations are in tokens, so curves must be keyed the same way
-    with_tok = [r for r in results if r.ok and r.prompt_tokens] if results else []
-    isl_tokens = (sum(r.prompt_tokens for r in with_tok) / len(with_tok)
-                  if with_tok else None)
     return prefill_pts, decode_pts, isl_tokens
+
+
+def check_inversion(points: list, label: str, targets=(0.5, 0.9)) -> list[str]:
+    """Verify the planner's interpolator round-trips this curve: for targets
+    inside the measured latency range, latency_at(max_load_under(t)) ≤ t.
+    Returns human-readable problems (empty = curve is planner-safe)."""
+    from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+
+    problems = []
+    lats = [p[1] for p in points]
+    if any(b < a for a, b in zip(lats, lats[1:])):
+        problems.append(
+            f"{label}: latency non-monotonic over load {lats} — the planner "
+            "inverts this curve; noisy sweeps make it oscillate. Re-run with "
+            "more --requests-per-level.")
+    interp = PerfInterpolator(points=list(points))
+    lo, hi = min(lats), max(lats)
+    for frac in targets:
+        t = lo + frac * (hi - lo)
+        load = interp.max_load_under(t)
+        back = interp.latency_at(load)
+        if back > t * 1.001:
+            problems.append(
+                f"{label}: inversion violated at target {t:.1f}ms: "
+                f"max_load_under→{load:.3f} but latency_at→{back:.1f}ms")
+    return problems
+
+
+def recommend(out: dict, ttft_target_ms, itl_target_ms) -> dict:
+    """Invert the emitted tables through the planner's interpolators —
+    the exact objects planner/planner_core.py builds from this file."""
+    from dynamo_tpu.planner.perf_interpolation import (
+        PerfInterpolator,
+        PerfInterpolator2D,
+    )
+
+    rec = {}
+    if ttft_target_ms and out.get("prefill_by_isl"):
+        interp = PerfInterpolator2D.from_profile(out)
+        isl = out.get("isl_tokens") or out["isl_words"]
+        load = interp.max_load_under(ttft_target_ms, isl)
+        rec["prefill_req_per_s_per_replica"] = round(load, 3)
+        if load <= 0:
+            rec["prefill_verdict"] = (
+                f"IMPOSSIBLE: even an idle replica exceeds {ttft_target_ms}ms "
+                "TTFT — smaller model, more chips per replica, or a looser SLA")
+        else:
+            rec["prefill_verdict"] = (
+                f"size the prefill fleet at ceil(observed_req_rate / {load:.3f})")
+    if itl_target_ms and out.get("decode"):
+        interp = PerfInterpolator(points=list(out["decode"]))
+        load = interp.max_load_under(itl_target_ms)
+        rec["decode_tok_per_s_per_replica"] = round(load, 1)
+        if load <= 0:
+            rec["decode_verdict"] = (
+                f"IMPOSSIBLE: idle-replica ITL exceeds {itl_target_ms}ms")
+        else:
+            rec["decode_verdict"] = (
+                f"size the decode fleet at ceil(observed_tok_rate / {load:.1f})")
+    return rec
 
 
 async def amain():
@@ -59,18 +157,82 @@ async def amain():
     ap.add_argument("--concurrencies", default="1,2,4,8,16,32")
     ap.add_argument("--requests-per-level", type=int, default=16)
     ap.add_argument("--out", default="profile.json")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the measurement plan and exit (no traffic)")
+    ap.add_argument("--ttft-target", type=float, default=None,
+                    help="TTFT SLA in ms: emit a fleet-sizing recommendation")
+    ap.add_argument("--itl-target", type=float, default=None,
+                    help="ITL SLA in ms: emit a fleet-sizing recommendation")
+    ap.add_argument("--fresh", action="store_true",
+                    help="ignore cached levels in an existing --out file")
     cli = ap.parse_args()
 
     cs = [int(x) for x in cli.concurrencies.split(",")]
     isls = ([int(x) for x in cli.isl_sweep.split(",")] if cli.isl_sweep
             else [cli.isl_words])
+
+    if cli.dry_run:
+        n_levels = len(isls) * len(cs)
+        plan = {
+            "url": cli.url, "model": cli.model,
+            "levels": [{"isl_words": isl, "concurrency": c,
+                        "requests": cli.requests_per_level}
+                       for isl in isls for c in cs],
+            "total_levels": n_levels,
+            "total_requests": n_levels * cli.requests_per_level,
+            "est_minutes": round(n_levels * cli.requests_per_level
+                                 * (cli.osl * 0.03 + 1.0) / 60 / max(cs), 1),
+        }
+        print(json.dumps(plan, indent=2))
+        return
+
+    # cache validity is parameterized: levels measured under a different
+    # osl / request count must NOT be reused (mislabeled curves would make
+    # the planner size fleets from the wrong workload shape)
+    params = {"osl": cli.osl, "requests_per_level": cli.requests_per_level,
+              "model": cli.model}
+    cache: dict = {}
+    if not cli.fresh and os.path.exists(cli.out):
+        try:
+            with open(cli.out) as f:
+                prior = json.load(f)
+            if prior.get("sweep_params") == params:
+                cache = prior.get("levels", {})
+                if cache:
+                    print(f"resuming: {len(cache)} completed levels in {cli.out}")
+            elif prior.get("levels"):
+                print(f"ignoring cached levels in {cli.out}: sweep params "
+                      f"changed ({prior.get('sweep_params')} -> {params})")
+        except (ValueError, OSError):
+            cache = {}
+
+    def save_partial():
+        """Persist completed levels after each measurement — an aborted
+        sweep resumes instead of replaying. Merged INTO the existing file:
+        a prior complete profile keeps its prefill/decode tables (the
+        planner may re-read --out mid-sweep; truncating it would break
+        PerfInterpolator2D.from_profile on a file that was valid before)."""
+        doc = {}
+        try:
+            with open(cli.out) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            pass
+        doc.update({"levels": cache, "sweep_params": params, "partial": True})
+        try:
+            with open(cli.out, "w") as f:
+                json.dump(doc, f)
+        except OSError:
+            pass
+
     prefill_by_isl = {}
     decode = []
     tok_isl_by_words = {}
     for isl in isls:
         print(f"--- ISL sweep @ {isl} words ---", flush=True)
         prefill, dec, isl_tok = await sweep(cli.url, cli.model, isl, cli.osl,
-                                            cs, cli.requests_per_level)
+                                            cs, cli.requests_per_level, cache,
+                                            save=save_partial)
         # key curves by the MEASURED token ISL (falls back to words) so the
         # planner's token-denominated observations query the right curve
         tok_isl_by_words[isl] = round(isl_tok) if isl_tok else isl
@@ -82,11 +244,32 @@ async def amain():
     out = {"prefill": prefill_by_isl[base_isl],
            "prefill_by_isl": prefill_by_isl,
            "decode": decode,
-           "isl_words": base_words, "osl": cli.osl}
+           "isl_words": base_words, "osl": cli.osl,
+           "levels": cache, "sweep_params": params}
     if base_isl != base_words:  # only when actually MEASURED in tokens —
         # a word count mislabeled as tokens would defeat the planner's
         # tokens-per-word fallback conversion
         out["isl_tokens"] = base_isl
+
+    # SLA inversion self-check: the planner will invert these exact tables;
+    # fail loudly now rather than oscillate in production
+    problems = []
+    for isl_key, pts in prefill_by_isl.items():
+        if len(pts) >= 2:
+            problems += check_inversion(pts, f"prefill@isl={isl_key}")
+    if len(decode) >= 2:
+        problems += check_inversion(decode, "decode")
+    if problems:
+        out["sla_check"] = problems
+        for p in problems:
+            print(f"SLA-CHECK FAIL: {p}", flush=True)
+    else:
+        out["sla_check"] = "ok"
+
+    if cli.ttft_target or cli.itl_target:
+        out["recommendation"] = recommend(out, cli.ttft_target, cli.itl_target)
+        print(json.dumps(out["recommendation"], indent=2))
+
     with open(cli.out, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {cli.out}")

@@ -1,0 +1,288 @@
+"""DynamoGraphDeployment controller: a real reconcile loop over the k8s API.
+
+The in-cluster counterpart of the reference's Go operator
+(ref: deploy/cloud/operator/internal/controller/dynamographdeployment_controller.go,
+api/v1alpha1/dynamographdeployment_types.go:30). Same machinery, Python:
+
+- **informer**: list + watch the CR and owned pods, maintain a local cache,
+  coalesce changes into a work queue keyed by CR name (client-go reflector
+  + workqueue pattern); 410-expired or dropped watches trigger a relist;
+- **reconcile**: diff desired (spec.services[*].replicas) against owned
+  pods (label-selected), create missing pods (ownerReferences set), delete
+  excess newest-first — the same scale-down order the process operator
+  uses, so planner-driven shrink kills the youngest worker;
+- **status subresource**: observedGeneration + per-service desired/ready +
+  a Ready condition, written via PUT …/status with resourceVersion
+  conflict-retry (the UpdateStatus + RetryOnConflict idiom);
+- CR deletion → owned pods deleted (no server-side GC in the fake server;
+  against a real apiserver ownerReferences make this a no-op backstop).
+
+Runs against any API endpoint KubeClient can reach: the in-repo
+FakeKubeApiServer in tests (real HTTP, real watch streams), a genuine
+apiserver via KubeClient.in_cluster() in production.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from dynamo_tpu.deploy.kube_api import (
+    Conflict,
+    KubeClient,
+    NotFound,
+    WatchExpired,
+)
+
+logger = logging.getLogger("dynamo.controller")
+
+GROUP, VERSION = "dynamo.tpu", "v1alpha1"
+PLURAL = "dynamographdeployments"
+LABEL_GRAPH = "dynamo.tpu/graph"
+LABEL_SERVICE = "dynamo.tpu/service"
+
+
+def pod_name(graph: str, service: str, index: int) -> str:
+    return f"{graph}-{service}-{index}"
+
+
+class DynamoGraphController:
+    def __init__(self, client: KubeClient, namespace: str = "default"):
+        self.client = client
+        self.namespace = namespace
+        self.crs = client.resource(GROUP, VERSION, namespace, PLURAL)
+        self.pods = client.resource("", "v1", namespace, "pods")
+        self._cache: dict[str, dict] = {}
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._queued: set[str] = set()
+        self._tasks: list[asyncio.Task] = []
+        self._stopping = False
+        self.reconciles = 0
+        self.status_conflicts_retried = 0
+        self.relists = 0
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "DynamoGraphController":
+        rv = await self._relist()
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._watch_crs(rv)),
+            loop.create_task(self._watch_pods()),
+            loop.create_task(self._worker()),
+        ]
+        return self
+
+    async def stop(self):
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    # ------------------------------------------------------------- informer
+    def _enqueue(self, name: str):
+        if name not in self._queued:
+            self._queued.add(name)
+            self._queue.put_nowait(name)
+
+    async def _relist(self) -> str:
+        """Full list → rebuild cache, enqueue everything, return the list
+        resourceVersion to resume watching from."""
+        lst = await self.crs.list()
+        self.relists += 1
+        self._cache = {o["metadata"]["name"]: o for o in lst["items"]}
+        for name in self._cache:
+            self._enqueue(name)
+        return lst["metadata"]["resourceVersion"]
+
+    async def _watch_crs(self, rv: str):
+        while not self._stopping:
+            try:
+                async for ev_type, obj in self.crs.watch(resource_version=rv):
+                    name = obj["metadata"]["name"]
+                    rv = obj["metadata"]["resourceVersion"]
+                    if ev_type == "DELETED":
+                        self._cache.pop(name, None)
+                    else:
+                        self._cache[name] = obj
+                    self._enqueue(name)
+                # server closed the stream: resume from last seen rv
+            except WatchExpired:
+                logger.info("CR watch expired; relisting")
+                rv = await self._relist()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("CR watch failed; relisting after backoff")
+                await asyncio.sleep(1.0)
+                try:
+                    rv = await self._relist()
+                except Exception:
+                    logger.exception("relist failed; retrying")
+
+    async def _watch_pods(self):
+        rv = "0"
+        while not self._stopping:
+            try:
+                async for ev_type, obj in self.pods.watch(resource_version=rv):
+                    rv = obj["metadata"]["resourceVersion"]
+                    graph = obj["metadata"].get("labels", {}).get(LABEL_GRAPH)
+                    if graph:
+                        self._enqueue(graph)
+            except WatchExpired:
+                rv = "0"
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("pod watch failed; retrying")
+                await asyncio.sleep(1.0)
+                rv = "0"
+
+    async def _worker(self):
+        while not self._stopping:
+            name = await self._queue.get()
+            self._queued.discard(name)
+            try:
+                await self.reconcile(name)
+                self.reconciles += 1
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("reconcile(%s) failed; requeueing", name)
+                await asyncio.sleep(0.5)
+                self._enqueue(name)
+
+    # ------------------------------------------------------------ reconcile
+    async def reconcile(self, name: str):
+        cr = self._cache.get(name)
+        owned = await self.pods.list(label_selector=f"{LABEL_GRAPH}={name}")
+        by_service: dict[str, list[dict]] = {}
+        for pod in owned["items"]:
+            svc = pod["metadata"].get("labels", {}).get(LABEL_SERVICE, "")
+            by_service.setdefault(svc, []).append(pod)
+
+        if cr is None:
+            # CR gone: delete every owned pod (GC backstop)
+            for pods in by_service.values():
+                for pod in pods:
+                    await self._delete_pod(pod["metadata"]["name"])
+            return
+
+        services = (cr.get("spec") or {}).get("services") or {}
+        status_services = {}
+        all_ready = True
+        for svc, spec in services.items():
+            desired = int(spec.get("replicas", 1))
+
+            def _index(pod):
+                # numeric replica index, NOT lexicographic name order —
+                # "-10" must sort after "-9" or scale-down kills the wrong pod
+                try:
+                    return int(pod["metadata"]["name"].rsplit("-", 1)[1])
+                except (IndexError, ValueError):
+                    return -1
+            have = sorted(by_service.pop(svc, []), key=_index)
+            # create missing replicas at the first free indices
+            used = {p["metadata"]["name"] for p in have}
+            idx = 0
+            while len(have) < desired:
+                pname = pod_name(name, svc, idx)
+                idx += 1
+                if pname in used:
+                    continue
+                pod = self._pod_for(cr, svc, spec, pname)
+                try:
+                    created = await self.pods.create(pod)
+                    have.append(created)
+                except Conflict:
+                    pass  # another worker got there; next reconcile settles
+            # delete excess, newest-first (planner scale-down contract)
+            while len(have) > desired:
+                victim = have.pop()
+                await self._delete_pod(victim["metadata"]["name"])
+            ready = sum(1 for p in have
+                        if (p.get("status") or {}).get("phase") == "Running")
+            status_services[svc] = {"desired": desired, "ready": ready}
+            if ready < desired:
+                all_ready = False
+        # pods whose service vanished from the spec
+        for pods in by_service.values():
+            for pod in pods:
+                await self._delete_pod(pod["metadata"]["name"])
+
+        status = {
+            "observedGeneration": cr["metadata"].get("generation", 1),
+            "services": status_services,
+            "conditions": [{
+                "type": "Ready",
+                "status": "True" if all_ready else "False",
+            }],
+        }
+        await self._update_status(name, status)
+
+    def _pod_for(self, cr: dict, svc: str, spec: dict, pname: str) -> dict:
+        return {
+            "metadata": {
+                "name": pname,
+                "labels": {LABEL_GRAPH: cr["metadata"]["name"],
+                           LABEL_SERVICE: svc},
+                "ownerReferences": [{
+                    "apiVersion": f"{GROUP}/{VERSION}",
+                    "kind": "DynamoGraphDeployment",
+                    "name": cr["metadata"]["name"],
+                    "uid": cr["metadata"].get("uid", ""),
+                    "controller": True,
+                }],
+            },
+            "spec": {"containers": [{
+                "name": svc,
+                "command": spec.get("command", []),
+                "env": [{"name": k, "value": str(v)}
+                        for k, v in (spec.get("env") or {}).items()],
+            }]},
+        }
+
+    async def _delete_pod(self, pname: str):
+        try:
+            await self.pods.delete(pname)
+        except NotFound:
+            pass
+
+    async def _update_status(self, name: str, status: dict):
+        """UpdateStatus with RetryOnConflict: PUT …/status carries the read
+        resourceVersion; a 409 means someone wrote between our read and
+        write — re-read and retry."""
+        for _ in range(5):
+            try:
+                cur = await self.crs.get(name)
+            except NotFound:
+                return
+            if cur.get("status") == status:
+                # No-op writes matter: every status PUT emits a MODIFIED
+                # event that re-enqueues this very reconcile — writing
+                # unconditionally turns the controller into a hot loop
+                # chasing its own updates.
+                return
+            # the UpdateStatus idiom: PUT the FULL read object with status
+            # replaced — a real apiserver rejects a metadata+status stub
+            # (apiVersion/kind are required for typed PUTs)
+            obj = dict(cur)
+            obj["status"] = status
+            sess = await self.client.session()
+            url = f"{self.crs.prefix}/{name}/status"
+            async with sess.put(url, json=obj) as resp:
+                if resp.status == 409:
+                    self.status_conflicts_retried += 1
+                    continue
+                if resp.status == 404:
+                    return
+                if resp.status >= 400:
+                    body = await resp.json(content_type=None)
+                    raise RuntimeError(f"status update failed: {body}")
+                return
+        logger.warning("status update for %s lost 5 conflicts; giving up "
+                       "until next reconcile", name)

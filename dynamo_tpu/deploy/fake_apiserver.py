@@ -1,0 +1,367 @@
+"""In-repo Kubernetes API server speaking the real REST contract.
+
+The envtest analog: the reference's Go operator is developed against
+controller-runtime's envtest (a real kube-apiserver binary); this image has
+no cluster, so the controller (deploy/controller.py) runs against THIS
+server over actual HTTP — the wire contract is the genuine one:
+
+- typed resource paths (``/apis/{group}/{version}/namespaces/{ns}/{plural}``
+  for CRs, ``/api/v1/namespaces/{ns}/pods`` for pods);
+- ``metadata.resourceVersion`` from a single monotonically-increasing
+  counter, bumped on every write; ``metadata.generation`` bumped only on
+  spec changes (ref semantics: status writes don't change generation);
+- optimistic concurrency: PUT with a stale resourceVersion → 409 Conflict;
+- the **status subresource** (``…/{name}/status``): PATCH/PUT there applies
+  ONLY ``.status`` (a spec smuggled into a status patch is discarded), and
+  main-resource patches cannot touch ``.status``;
+- **watches**: ``GET …?watch=1&resourceVersion=N`` streams newline-delimited
+  JSON events (ADDED/MODIFIED/DELETED) for changes after N; a
+  resourceVersion older than the retained history returns a 410 Gone
+  ERROR event, forcing the client to relist (the informer contract);
+- label selectors on list (``labelSelector=k=v,k2=v2``);
+- pods get a fake kubelet: created pods transition Pending → Running
+  after ``pod_start_delay`` seconds (0 = immediately), so controllers can
+  count readiness.
+
+Ref: deploy/cloud/operator/internal/controller/ reconciles against exactly
+these verbs; dynamographdeployment_types.go:30 defines the CR this server
+stores schema-lessly (CRD validation is the real server's job).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+logger = logging.getLogger("dynamo.fake_apiserver")
+
+#: watch events retained for resume; older resourceVersions get 410 Gone
+WATCH_HISTORY = 4096
+
+
+def _match_selector(labels: dict, selector: str) -> bool:
+    for clause in selector.split(","):
+        if not clause:
+            continue
+        if "=" not in clause:
+            return False
+        k, v = clause.split("=", 1)
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+class _Kind:
+    """Storage + watch hub for one (path-prefix, plural)."""
+
+    def __init__(self, server: "FakeKubeApiServer", api_version: str, kind: str):
+        self.server = server
+        self.api_version = api_version
+        self.kind = kind
+        self.objs: dict[tuple[str, str], dict] = {}  # (ns, name) -> obj
+        self.history: list[tuple[int, str, dict]] = []  # (rv, type, obj)
+        self.subs: list[asyncio.Queue] = []
+        #: rv of the newest event dropped from history — a watch resuming
+        #: below this provably missed events (exact per-kind 410 floor; the
+        #: global rv counter makes gap-based detection unsound)
+        self.truncated_below = 0
+
+    def _emit(self, ev_type: str, obj: dict):
+        rv = int(obj["metadata"]["resourceVersion"])
+        self.history.append((rv, ev_type, copy.deepcopy(obj)))
+        if len(self.history) > WATCH_HISTORY:
+            self.truncate(WATCH_HISTORY)
+        for q in self.subs:
+            q.put_nowait((ev_type, copy.deepcopy(obj)))
+
+    def truncate(self, keep: int):
+        """Drop all but the newest ``keep`` events (tests use this to force
+        the 410 relist path)."""
+        if len(self.history) > keep:
+            cut = len(self.history) - keep
+            self.truncated_below = self.history[cut - 1][0]
+            del self.history[:cut]
+
+
+class FakeKubeApiServer:
+    """aiohttp app serving the contract above. ``start()`` → base_url."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 pod_start_delay: float = 0.0):
+        self._rv = 0
+        self.pod_start_delay = pod_start_delay
+        self._host, self._port = host, port
+        self._kinds: dict[str, _Kind] = {}
+        self._runner: Optional[web.AppRunner] = None
+        self.base_url = ""
+        self._pod_timers: set[asyncio.Task] = set()
+
+    def register(self, group: str, version: str, plural: str, kind: str):
+        key = f"apis/{group}/{version}" if group else f"api/{version}"
+        self._kinds[f"{key}/{plural}"] = _Kind(
+            self, f"{group}/{version}" if group else version, kind)
+
+    def next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> str:
+        self.register("", "v1", "pods", "Pod")
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        self._port = site._server.sockets[0].getsockname()[1]
+        self.base_url = f"http://{self._host}:{self._port}"
+        return self.base_url
+
+    async def stop(self):
+        for t in self._pod_timers:
+            t.cancel()
+        for kind in self._kinds.values():
+            for q in kind.subs:
+                q.put_nowait(None)
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------- routing
+    async def _dispatch(self, req: web.Request) -> web.StreamResponse:
+        parts = [p for p in req.path.split("/") if p]
+        # {api|apis/group}/{version}/namespaces/{ns}/{plural}[/{name}[/status]]
+        try:
+            if parts[0] == "api":
+                head, rest = "api/" + parts[1], parts[2:]
+            else:
+                head, rest = "/".join(parts[:3]), parts[3:]
+            if rest[0] != "namespaces":
+                return web.json_response({"message": "cluster-scoped paths "
+                                          "not supported"}, status=404)
+            ns, plural, rest = rest[1], rest[2], rest[3:]
+        except IndexError:
+            return web.json_response({"message": "bad path"}, status=404)
+        kind = self._kinds.get(f"{head}/{plural}")
+        if kind is None:
+            return web.json_response({"message": f"unknown resource {plural}"},
+                                     status=404)
+        name = rest[0] if rest else None
+        sub = rest[1] if len(rest) > 1 else None
+        if sub not in (None, "status"):
+            return web.json_response({"message": f"unknown subresource {sub}"},
+                                     status=404)
+
+        m = req.method
+        if m == "GET" and name is None:
+            if req.query.get("watch") in ("1", "true"):
+                return await self._watch(req, kind, ns)
+            return self._list(req, kind, ns)
+        if m == "GET":
+            obj = kind.objs.get((ns, name))
+            if obj is None:
+                return self._not_found(kind, name)
+            return web.json_response(obj)
+        if m == "POST" and name is None:
+            return await self._create(req, kind, ns)
+        if m in ("PATCH", "PUT") and name:
+            return await self._update(req, kind, ns, name,
+                                      status_sub=sub == "status",
+                                      replace=m == "PUT")
+        if m == "DELETE" and name:
+            return self._delete(kind, ns, name)
+        return web.json_response({"message": "method not allowed"}, status=405)
+
+    def _not_found(self, kind: _Kind, name: str) -> web.Response:
+        return web.json_response(
+            {"kind": "Status", "status": "Failure", "code": 404, "reason":
+             "NotFound", "message": f"{kind.kind} \"{name}\" not found"},
+            status=404)
+
+    # --------------------------------------------------------------- verbs
+    def _list(self, req: web.Request, kind: _Kind, ns: str) -> web.Response:
+        selector = req.query.get("labelSelector", "")
+        items = [o for (ons, _), o in sorted(kind.objs.items())
+                 if ons == ns and _match_selector(
+                     o["metadata"].get("labels", {}), selector)]
+        return web.json_response({
+            "kind": kind.kind + "List", "apiVersion": kind.api_version,
+            "metadata": {"resourceVersion": str(self._rv)},
+            "items": items})
+
+    async def _create(self, req, kind: _Kind, ns: str) -> web.Response:
+        obj = await req.json()
+        name = obj.get("metadata", {}).get("name")
+        if not name:
+            return web.json_response({"message": "metadata.name required"},
+                                     status=422)
+        if (ns, name) in kind.objs:
+            return web.json_response(
+                {"kind": "Status", "status": "Failure", "code": 409,
+                 "reason": "AlreadyExists",
+                 "message": f"{kind.kind} \"{name}\" already exists"},
+                status=409)
+        md = obj.setdefault("metadata", {})
+        md["namespace"] = ns
+        md["resourceVersion"] = str(self.next_rv())
+        md["generation"] = 1
+        obj.setdefault("apiVersion", kind.api_version)
+        obj.setdefault("kind", kind.kind)
+        kind.objs[(ns, name)] = obj
+        kind._emit("ADDED", obj)
+        if kind.kind == "Pod":
+            self._start_kubelet(kind, ns, name)
+        return web.json_response(obj, status=201)
+
+    def _start_kubelet(self, kind: _Kind, ns: str, name: str):
+        """Fake kubelet: Pending → Running after pod_start_delay."""
+        async def run():
+            if self.pod_start_delay:
+                await asyncio.sleep(self.pod_start_delay)
+            obj = kind.objs.get((ns, name))
+            if obj is None:
+                return
+            obj.setdefault("status", {})["phase"] = "Running"
+            obj["metadata"]["resourceVersion"] = str(self.next_rv())
+            kind._emit("MODIFIED", obj)
+
+        pod = kind.objs[(ns, name)]
+        pod.setdefault("status", {})["phase"] = "Pending"
+        t = asyncio.get_running_loop().create_task(run())
+        self._pod_timers.add(t)
+        t.add_done_callback(self._pod_timers.discard)
+
+    async def _update(self, req, kind: _Kind, ns: str, name: str, *,
+                      status_sub: bool, replace: bool) -> web.Response:
+        obj = kind.objs.get((ns, name))
+        if obj is None:
+            return self._not_found(kind, name)
+        body = await req.json()
+        if replace:
+            sent_rv = body.get("metadata", {}).get("resourceVersion")
+            if sent_rv is not None and sent_rv != obj["metadata"]["resourceVersion"]:
+                return web.json_response(
+                    {"kind": "Status", "status": "Failure", "code": 409,
+                     "reason": "Conflict",
+                     "message": f"the object has been modified (rv {sent_rv} "
+                                f"!= {obj['metadata']['resourceVersion']})"},
+                    status=409)
+        spec_before = json.dumps(obj.get("spec"), sort_keys=True)
+        if status_sub:
+            # the status subresource touches ONLY .status
+            if replace:
+                obj["status"] = body.get("status")
+            else:
+                obj["status"] = _merge(obj.get("status"), body.get("status"))
+        else:
+            if replace:
+                preserved_status = obj.get("status")
+                md = body.setdefault("metadata", {})
+                md["namespace"] = ns
+                md["name"] = name
+                md["generation"] = obj["metadata"]["generation"]
+                body["status"] = preserved_status
+                kind.objs[(ns, name)] = obj = body
+            else:
+                body.pop("status", None)  # main resource can't write status
+                _merge_into(obj, body)
+        if json.dumps(obj.get("spec"), sort_keys=True) != spec_before:
+            obj["metadata"]["generation"] = obj["metadata"].get("generation", 1) + 1
+        obj["metadata"]["resourceVersion"] = str(self.next_rv())
+        kind._emit("MODIFIED", obj)
+        return web.json_response(obj)
+
+    def _delete(self, kind: _Kind, ns: str, name: str) -> web.Response:
+        obj = kind.objs.pop((ns, name), None)
+        if obj is None:
+            return self._not_found(kind, name)
+        obj["metadata"]["resourceVersion"] = str(self.next_rv())
+        kind._emit("DELETED", obj)
+        return web.json_response(obj)
+
+    # --------------------------------------------------------------- watch
+    async def _watch(self, req: web.Request, kind: _Kind, ns: str
+                     ) -> web.StreamResponse:
+        try:
+            since = int(req.query.get("resourceVersion", "0"))
+        except ValueError:
+            since = 0
+        selector = req.query.get("labelSelector", "")
+
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(req)
+
+        async def send(ev_type, obj):
+            if obj["metadata"].get("namespace") != ns:
+                return
+            if not _match_selector(obj["metadata"].get("labels", {}), selector):
+                return
+            await resp.write(json.dumps(
+                {"type": ev_type, "object": obj}).encode() + b"\n")
+
+        q: asyncio.Queue = asyncio.Queue()
+        try:
+            # 410 Gone: events below the truncation floor are unrecoverable
+            if since and since < kind.truncated_below:
+                await resp.write(json.dumps({
+                    "type": "ERROR",
+                    "object": {"kind": "Status", "code": 410,
+                               "reason": "Expired",
+                               "message": "too old resource version"},
+                }).encode() + b"\n")
+                await resp.write_eof()
+                return resp
+
+            # subscribe BEFORE replay so nothing lands between them; replay
+            # everything after `since` (rv=0 replays full retained history —
+            # ADDED events for current objects, the list+watch hand-off)
+            kind.subs.append(q)
+            for _rv, ev_type, obj in list(kind.history):
+                if _rv > since:
+                    await send(ev_type, obj)
+            while True:
+                item = await q.get()
+                if item is None:
+                    break
+                await send(*item)
+            await resp.write_eof()
+        except (ConnectionResetError, asyncio.CancelledError,
+                ConnectionError):
+            pass
+        finally:
+            if q in kind.subs:
+                kind.subs.remove(q)
+        return resp
+
+
+def _merge(base, patch):
+    """JSON merge patch (RFC 7386): null deletes, dicts recurse."""
+    if not isinstance(patch, dict) or not isinstance(base, dict):
+        return copy.deepcopy(patch)
+    out = dict(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge(out.get(k), v)
+    return out
+
+
+def _merge_into(obj: dict, patch: dict):
+    for k, v in patch.items():
+        if k == "metadata":
+            # merging clients may echo metadata; never let them rewind
+            # server-owned fields
+            v = {mk: mv for mk, mv in (v or {}).items()
+                 if mk not in ("resourceVersion", "generation", "namespace")}
+            obj["metadata"] = _merge(obj.get("metadata"), v)
+        elif v is None:
+            obj.pop(k, None)
+        else:
+            obj[k] = _merge(obj.get(k), v)

@@ -1,0 +1,170 @@
+"""Minimal Kubernetes REST client: list/get/create/patch/delete, the status
+subresource, and resumable watches with the informer relist contract.
+
+This is the transport the in-cluster controller (deploy/controller.py) rides
+— aiohttp against any server speaking the k8s API: the in-repo
+FakeKubeApiServer (envtest analog) in CI, a real apiserver in production
+(``token``/``ca_path`` cover in-cluster auth — the operator pod's
+serviceaccount files).
+
+Watch semantics implemented the way client-go's reflector does it
+(ref: the Go operator's controller-runtime caches,
+deploy/cloud/operator/internal/controller/):
+
+- ``watch()`` yields (type, object) events from ``resourceVersion`` onward;
+- a 410 Gone ERROR event raises :class:`WatchExpired` — callers relist and
+  re-watch from the fresh list resourceVersion;
+- disconnects surface as StopAsyncIteration (caller re-establishes).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import AsyncIterator, Optional
+
+import aiohttp
+
+logger = logging.getLogger("dynamo.kube_api")
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, body: dict):
+        self.status = status
+        self.body = body
+        super().__init__(f"HTTP {status}: {body.get('message', body)}")
+
+
+class Conflict(ApiError):
+    """409 — optimistic-concurrency loss or AlreadyExists."""
+
+
+class NotFound(ApiError):
+    """404."""
+
+
+class WatchExpired(Exception):
+    """410 Gone on a watch: the resourceVersion fell out of server history;
+    relist and re-watch."""
+
+
+def _wrap(status: int, body: dict) -> ApiError:
+    if status == 409:
+        return Conflict(status, body)
+    if status == 404:
+        return NotFound(status, body)
+    return ApiError(status, body)
+
+
+class Resource:
+    """One (group, version, namespace, plural) binding."""
+
+    def __init__(self, client: "KubeClient", group: str, version: str,
+                 namespace: str, plural: str):
+        head = f"apis/{group}/{version}" if group else f"api/{version}"
+        self.prefix = (f"{client.base_url}/{head}/namespaces/"
+                       f"{namespace}/{plural}")
+        self.client = client
+
+    async def _req(self, method: str, url: str, **kw) -> dict:
+        sess = await self.client.session()
+        async with sess.request(method, url, **kw) as resp:
+            body = await resp.json(content_type=None)
+            if resp.status >= 400:
+                raise _wrap(resp.status, body)
+            return body
+
+    async def list(self, label_selector: str = "") -> dict:
+        url = self.prefix
+        if label_selector:
+            url += f"?labelSelector={label_selector}"
+        return await self._req("GET", url)
+
+    async def get(self, name: str) -> dict:
+        return await self._req("GET", f"{self.prefix}/{name}")
+
+    async def create(self, obj: dict) -> dict:
+        return await self._req("POST", self.prefix, json=obj)
+
+    async def patch(self, name: str, patch: dict) -> dict:
+        return await self._req(
+            "PATCH", f"{self.prefix}/{name}", json=patch,
+            headers={"Content-Type": "application/merge-patch+json"})
+
+    async def replace(self, name: str, obj: dict) -> dict:
+        return await self._req("PUT", f"{self.prefix}/{name}", json=obj)
+
+    async def patch_status(self, name: str, status: dict) -> dict:
+        return await self._req(
+            "PATCH", f"{self.prefix}/{name}/status", json={"status": status},
+            headers={"Content-Type": "application/merge-patch+json"})
+
+    async def delete(self, name: str) -> dict:
+        return await self._req("DELETE", f"{self.prefix}/{name}")
+
+    async def watch(self, resource_version: str = "0",
+                    label_selector: str = "") -> AsyncIterator[tuple[str, dict]]:
+        """Yields (event_type, object). Raises WatchExpired on 410. Returns
+        normally when the server closes the stream (caller re-watches)."""
+        url = f"{self.prefix}?watch=1&resourceVersion={resource_version}"
+        if label_selector:
+            url += f"&labelSelector={label_selector}"
+        sess = await self.client.session()
+        async with sess.get(url, timeout=aiohttp.ClientTimeout(
+                total=None, sock_read=None)) as resp:
+            if resp.status >= 400:
+                raise _wrap(resp.status, await resp.json(content_type=None))
+            async for raw in resp.content:
+                line = raw.strip()
+                if not line:
+                    continue
+                ev = json.loads(line)
+                if ev.get("type") == "ERROR":
+                    code = ev.get("object", {}).get("code")
+                    if code == 410:
+                        raise WatchExpired()
+                    raise ApiError(code or 500, ev.get("object", {}))
+                yield ev["type"], ev["object"]
+
+
+class KubeClient:
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 ca_path: Optional[str] = None):
+        self.base_url = base_url.rstrip("/")
+        self._token = token
+        self._ca_path = ca_path
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    @staticmethod
+    def in_cluster() -> "KubeClient":
+        """Build from the serviceaccount mount a real operator pod gets."""
+        import os
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+        with open(f"{sa}/token") as f:
+            token = f.read().strip()
+        return KubeClient(f"https://{host}:{port}", token=token,
+                          ca_path=f"{sa}/ca.crt")
+
+    async def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            headers = {}
+            if self._token:
+                headers["Authorization"] = f"Bearer {self._token}"
+            connector = None
+            if self._ca_path:
+                import ssl
+                connector = aiohttp.TCPConnector(
+                    ssl=ssl.create_default_context(cafile=self._ca_path))
+            self._session = aiohttp.ClientSession(
+                headers=headers, connector=connector)
+        return self._session
+
+    def resource(self, group: str, version: str, namespace: str,
+                 plural: str) -> Resource:
+        return Resource(self, group, version, namespace, plural)
+
+    async def close(self):
+        if self._session and not self._session.closed:
+            await self._session.close()

@@ -32,8 +32,42 @@ async def _kubectl(argv: list[str]) -> tuple[int, str]:
     return proc.returncode, out.decode()
 
 
-class KubernetesConnector:
-    """``apply(decision)`` → one JSON merge patch per changed service."""
+class _ScaleConnectorBase:
+    """Shared decision→CR-merge-patch logic; subclasses supply the
+    transport (``_patch``). Keeps the dedup short-circuit and patch shape
+    in ONE place so kubectl and API transports can't drift."""
+
+    prefill_service: str
+    decode_service: str
+    applied: Optional[Decision]
+
+    def _build_patch(self, decision: Decision) -> dict:
+        return {"spec": {"services": {
+            self.prefill_service: {"replicas": int(decision.prefill_replicas)},
+            self.decode_service: {"replicas": int(decision.decode_replicas)},
+        }}}
+
+    def _unchanged(self, decision: Decision) -> bool:
+        return (self.applied is not None
+                and decision.prefill_replicas == self.applied.prefill_replicas
+                and decision.decode_replicas == self.applied.decode_replicas)
+
+    async def apply(self, decision: Decision) -> None:
+        if self._unchanged(decision):
+            return
+        if not await self._patch(self._build_patch(decision)):
+            return  # keep self.applied unchanged so the next tick retries
+        self.applied = decision
+        logger.info("k8s scale applied: prefill=%d decode=%d",
+                    decision.prefill_replicas, decision.decode_replicas)
+
+    async def _patch(self, patch: dict) -> bool:
+        raise NotImplementedError
+
+
+class KubernetesConnector(_ScaleConnectorBase):
+    """``apply(decision)`` → one JSON merge patch per changed service,
+    applied via kubectl (kubeconfig/in-cluster auth handled by the CLI)."""
 
     def __init__(self, deployment: str, k8s_namespace: str = "default",
                  prefill_service: str = "prefill",
@@ -46,25 +80,14 @@ class KubernetesConnector:
         self.runner = runner or _kubectl
         self.applied: Optional[Decision] = None
 
-    async def apply(self, decision: Decision) -> None:
-        if (self.applied is not None
-                and decision.prefill_replicas == self.applied.prefill_replicas
-                and decision.decode_replicas == self.applied.decode_replicas):
-            return
-        patch = {"spec": {"services": {
-            self.prefill_service: {"replicas": int(decision.prefill_replicas)},
-            self.decode_service: {"replicas": int(decision.decode_replicas)},
-        }}}
+    async def _patch(self, patch: dict) -> bool:
         rc, out = await self.runner([
             "-n", self.k8s_namespace, "patch", GRAPH_RESOURCE,
             self.deployment, "--type", "merge", "-p", json.dumps(patch)])
         if rc != 0:
-            # keep self.applied unchanged so the next tick retries
             logger.error("kubectl patch failed (rc=%d): %s", rc, out.strip())
-            return
-        self.applied = decision
-        logger.info("k8s scale applied: prefill=%d decode=%d",
-                    decision.prefill_replicas, decision.decode_replicas)
+            return False
+        return True
 
     async def read_replicas(self) -> Optional[dict]:
         """Observed spec replicas (for drift checks / tests)."""
@@ -78,3 +101,40 @@ class KubernetesConnector:
             return {name: svc.get("replicas") for name, svc in spec.items()}
         except (ValueError, AttributeError):
             return None
+
+
+class ApiKubernetesConnector(_ScaleConnectorBase):
+    """Same contract as :class:`KubernetesConnector`, but PATCHes the CR
+    through the Kubernetes REST API directly (deploy/kube_api.KubeClient) —
+    no kubectl in the planner pod. The in-cluster controller
+    (deploy/controller.py) observes the spec change via its watch and
+    realizes it as pods; this is the reference's planner → CRD patch →
+    reconciler flow end to end (ref: components/planner/src/dynamo/planner/
+    kubernetes_connector.py)."""
+
+    def __init__(self, client, deployment: str, k8s_namespace: str = "default",
+                 prefill_service: str = "prefill",
+                 decode_service: str = "decode"):
+        from dynamo_tpu.deploy.controller import GROUP, PLURAL, VERSION
+
+        self.deployment = deployment
+        self.crs = client.resource(GROUP, VERSION, k8s_namespace, PLURAL)
+        self.prefill_service = prefill_service
+        self.decode_service = decode_service
+        self.applied: Optional[Decision] = None
+
+    async def _patch(self, patch: dict) -> bool:
+        try:
+            await self.crs.patch(self.deployment, patch)
+            return True
+        except Exception:
+            logger.exception("CR patch failed; will retry next tick")
+            return False
+
+    async def read_replicas(self) -> Optional[dict]:
+        try:
+            obj = await self.crs.get(self.deployment)
+        except Exception:
+            return None
+        spec = obj.get("spec", {}).get("services", {})
+        return {name: svc.get("replicas") for name, svc in spec.items()}

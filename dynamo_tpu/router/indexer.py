@@ -225,6 +225,8 @@ class KvIndexer:
         self.gaps_detected = 0
         self.resyncs_requested = 0
         self._last_resync_at = 0.0  # monotonic; debounces orphan-triggered resyncs
+        self._snap_epoch = None  # hub epoch recorded in the restored snapshot
+        self._snap_seq = None    # seq of the restored snapshot (None = none)
 
     async def start(self, start_seq: int = 0) -> "KvIndexer":
         if self.snapshot_threshold and not self.reset_states:
@@ -234,6 +236,8 @@ class KvIndexer:
                     d = msgpack.unpackb(data, raw=False)
                     self.tree = RadixTree.load(d["tree"])
                     self._last_seq = d["seq"]
+                    self._snap_epoch = d.get("epoch")
+                    self._snap_seq = d["seq"]
                     # stream_subscribe start is EXCLUSIVE (delivers seq >
                     # start_seq), so resuming right after snapshot seq S
                     # means passing S itself
@@ -247,17 +251,28 @@ class KvIndexer:
         # otherwise leave a quiescent stream serving a silently-stale tree:
         # - truncated: the ring advanced past our resume point — events in
         #   (start_seq, first_seq) are gone forever;
-        # - regressed: the hub restarted and seqs reset below our resume
-        #   point — stream_subscribe(start_seq) would filter the ENTIRE
-        #   post-restart backlog as "already seen".
+        # - epoch change: the hub restarted since the snapshot was taken, so
+        #   its seqs live in a different numbering — the snapshot cursor is
+        #   meaningless. Seq comparison alone CANNOT detect this (a caller
+        #   legitimately subscribes past the current end to consume nothing;
+        #   see test_indexer_snapshot_write_and_restore), which is why the
+        #   snapshot records the hub epoch.
         first = await self.plane.stream_first_seq(self.stream)
         last = await self.plane.stream_last_seq(self.stream)
+        cur_epoch = await self.plane.get_epoch()
         truncated = start_seq + 1 < first and last > start_seq
-        regressed = last < start_seq
+        if self._snap_epoch is not None:
+            regressed = self._snap_epoch != cur_epoch
+        else:
+            # epoch-less snapshot (written by an older build): fall back to
+            # the seq heuristic, scoped to the SNAPSHOT's own cursor so an
+            # explicit past-the-end start_seq isn't misread as a restart
+            regressed = (self._snap_seq is not None
+                         and last < self._snap_seq)
         if truncated or regressed:
             logger.warning(
                 "kv event stream %s %s resume seq %d (first retained %d, last %d); resyncing",
-                self.stream, "truncated past" if truncated else "regressed below",
+                self.stream, "truncated past" if truncated else "epoch-changed under",
                 start_seq, first, last)
             start_seq = first - 1
             self._last_seq = start_seq  # cursor now means "post-gap window"
@@ -354,9 +369,11 @@ class KvIndexer:
                 # would stall every in-flight request on a busy frontend)
                 seq = self._last_seq
                 obj = self.tree.dump_obj()
+                epoch = await self.plane.get_epoch()
                 payload = await asyncio.to_thread(
                     lambda: msgpack.packb(
-                        {"seq": seq, "tree": msgpack.packb(obj)}))
+                        {"seq": seq, "epoch": epoch,
+                         "tree": msgpack.packb(obj)}))
                 await self.plane.object_put(RADIX_BUCKET, self.stream, payload)
                 self.snapshots_written += 1
                 logger.debug("radix snapshot written at seq %d", seq)

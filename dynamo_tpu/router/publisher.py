@@ -156,14 +156,26 @@ class KvEventPublisher:
             # dangling entry (ancestor evicted while the child survives LRU)
             # can't be routed to anyway — find_matches walks from the root —
             # and emitting it would be an eternal orphan at every indexer,
-            # re-triggering a fleet-wide replay each time. Mirror insertion
-            # order announces parents before children, so one pass suffices.
+            # re-triggering a fleet-wide replay each time. Iterate to
+            # fixpoint: mirror order USUALLY has parents first, but a
+            # remove-then-re-store moves the parent behind its children
+            # (dict re-insertion), so one pass could drop valid chains.
+            snapshot = list(self._announced.items())
             reachable: set[int] = set()
-            items = []
-            for bh, (parent, tokens_hash) in list(self._announced.items()):
-                if parent is None or parent in reachable:
-                    reachable.add(bh)
-                    items.append((bh, parent, tokens_hash))
+            pending = snapshot
+            ordered: list[tuple] = []
+            while True:
+                still = []
+                for bh, (parent, tokens_hash) in pending:
+                    if parent is None or parent in reachable:
+                        reachable.add(bh)
+                        ordered.append((bh, parent, tokens_hash))
+                    else:
+                        still.append((bh, (parent, tokens_hash)))
+                if len(still) == len(pending):
+                    break  # the rest are genuinely dangling
+                pending = still
+            items = ordered
             chain_parent: Optional[int] = None
             chain: list[StoredBlock] = []
             prev_hash: Optional[int] = None

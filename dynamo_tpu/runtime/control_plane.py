@@ -498,6 +498,9 @@ class LocalControlPlane(ControlPlane):
         seq, entries = self._streams.get(stream, (0, []))
         return entries[0][0] if entries else seq + 1
 
+    async def get_epoch(self) -> str:
+        return self.epoch
+
     # -- Object store --
     async def object_put(self, bucket, name, data):
         self._objects[(bucket, name)] = data
@@ -1101,6 +1104,9 @@ class RemoteControlPlane(ControlPlane):
 
     async def stream_first_seq(self, stream) -> int:
         return await self._call("stream_first_seq", stream=stream)
+
+    async def get_epoch(self) -> str:
+        return await self._call("epoch")
 
     # -- Object store --
     async def object_put(self, bucket, name, data):

@@ -26,3 +26,38 @@ import pytest  # noqa: E402
 @pytest.fixture
 def anyio_backend():
     return "asyncio"
+
+
+# ---------------------------------------------------------------- test tiers
+# Default `pytest tests/` = fast tier (< ~8 min): the slow tier
+# (tests/slow_tier.txt — heavy sharding/parity variants with faster siblings)
+# is deselected. DYN_TEST_FULL=1 runs everything (the pre-snapshot gate).
+# Explicitly-named tests always run: `pytest tests/test_mla.py::x` works
+# regardless of tier.
+
+def _slow_tier() -> set:
+    path = os.path.join(os.path.dirname(__file__), "slow_tier.txt")
+    try:
+        with open(path) as f:
+            return {ln.strip() for ln in f
+                    if ln.strip() and not ln.startswith("#")}
+    except OSError:
+        return set()
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("DYN_TEST_FULL"):
+        return
+    if any("::" in a for a in config.args):
+        return  # explicit node selection overrides tiering
+    slow = _slow_tier()
+    # node ids are root-relative when run from the repo root; normalize so
+    # `cd tests && pytest` keeps the same tier
+    def in_slow(item):
+        nid = item.nodeid
+        return nid in slow or f"tests/{nid}" in slow
+
+    dropped = [it for it in items if in_slow(it)]
+    if dropped:
+        config.hook.pytest_deselected(items=dropped)
+        items[:] = [it for it in items if not in_slow(it)]

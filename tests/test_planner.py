@@ -179,3 +179,42 @@ def test_planner_uses_2d_prefill_profile():
         pl2.observe(Observation(request_rate=8.0, isl=2048, osl=100))
     d_long = pl2.compute()
     assert d_long.prefill_replicas == 4  # 8 req/s / 2 per replica
+
+
+# ----------------------------------------------------- profiler depth (r4)
+
+def test_profile_sla_inversion_check_flags_noise():
+    """The profiler's self-check must catch curves the planner can't invert."""
+    from benchmarks.profile_sla import check_inversion
+
+    clean = [[1.0, 50.0], [2.0, 80.0], [4.0, 200.0]]
+    assert check_inversion(clean, "prefill") == []
+
+    noisy = [[1.0, 80.0], [2.0, 50.0], [4.0, 200.0]]  # latency dips with load
+    problems = check_inversion(noisy, "prefill")
+    assert problems and "non-monotonic" in problems[0]
+
+
+def test_profile_sla_recommendation_inverts_like_planner():
+    """The recommendation must be the planner's own inversion, bit for bit."""
+    from benchmarks.profile_sla import recommend
+    from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+
+    out = {
+        "prefill": [[1.0, 100.0], [2.0, 180.0], [4.0, 400.0]],
+        "prefill_by_isl": {1000: [[1.0, 100.0], [2.0, 180.0], [4.0, 400.0]]},
+        "decode": [[500.0, 10.0], [1000.0, 18.0], [2000.0, 45.0]],
+        "isl_words": 1000, "osl": 64,
+    }
+    rec = recommend(out, ttft_target_ms=200.0, itl_target_ms=20.0)
+    expected_decode = PerfInterpolator(
+        points=[[500.0, 10.0], [1000.0, 18.0], [2000.0, 45.0]]
+    ).max_load_under(20.0)
+    assert rec["decode_tok_per_s_per_replica"] == round(expected_decode, 1)
+    assert rec["prefill_req_per_s_per_replica"] > 2.0  # 200ms sits past c=2
+    assert "size the" in rec["prefill_verdict"]
+
+    # impossible SLA: idle replica already over target
+    rec2 = recommend(out, ttft_target_ms=50.0, itl_target_ms=5.0)
+    assert "IMPOSSIBLE" in rec2["prefill_verdict"]
+    assert "IMPOSSIBLE" in rec2["decode_verdict"]

@@ -236,20 +236,22 @@ async def test_replay_skips_chains_with_evicted_ancestors():
         await plane.close()
 
 
-async def test_hub_restart_regression_detected_at_subscribe():
-    """A router resuming from a pre-restart snapshot (seq 500) against a
-    reset stream (seqs 1..N) must resync and consume the whole backlog —
-    not filter it all as already-seen."""
+async def test_hub_restart_epoch_change_detected_at_subscribe():
+    """A router resuming from a pre-restart snapshot (old epoch, seq 500)
+    against a reset stream (new epoch, seqs 1..N) must resync and consume
+    the whole backlog — not filter it all as already-seen. Seqs alone can't
+    distinguish this from a legitimate past-the-end subscribe; the snapshot
+    epoch can."""
     import msgpack
 
     from dynamo_tpu.router.indexer import RADIX_BUCKET, RadixTree
 
     plane = LocalControlPlane()
     pub = await KvEventPublisher(plane, worker_id=8, kv_block_size=BS).start_resync_responder()
-    # pre-restart snapshot: stale tree state at seq 500
+    # pre-restart snapshot: stale tree state at seq 500 in a PRIOR epoch
     stale = RadixTree()
     await plane.object_put(RADIX_BUCKET, "kv_events", msgpack.packb(
-        {"seq": 500, "tree": stale.dump()}))
+        {"seq": 500, "epoch": "dead-epoch", "tree": stale.dump()}))
     # post-restart world: the stream starts over at seq 1
     await _announce_chain(pub, [70, 71])
 
@@ -262,6 +264,65 @@ async def test_hub_restart_regression_detected_at_subscribe():
             await asyncio.sleep(0.01)
         assert idx.tree.find_matches([70, 71]).scores == {8: 2}
         assert idx._last_seq >= 1  # cursor rebased into the new epoch
+    finally:
+        await idx.stop()
+        await pub.stop()
+        await plane.close()
+
+
+async def test_subscribe_past_end_with_snapshot_is_not_a_gap():
+    """Same-epoch snapshot resuming past the stream end = quiescent resume,
+    NOT a hub restart — the restored tree must survive (regression guard
+    for the r4 epoch check; this exact pattern broke once)."""
+    import msgpack
+
+    from dynamo_tpu.router.indexer import RADIX_BUCKET, RadixTree
+
+    plane = LocalControlPlane()
+    pub = KvEventPublisher(plane, worker_id=5, kv_block_size=BS)
+    await _announce_chain(pub, [30, 31])
+    idx = await KvIndexer(plane, kv_block_size=BS, snapshot_threshold=1).start()
+    for _ in range(200):
+        if idx.snapshots_written:
+            break
+        await asyncio.sleep(0.01)
+    await idx.stop()
+
+    last = await plane.stream_last_seq("kv_events")
+    idx2 = await KvIndexer(plane, kv_block_size=BS,
+                           snapshot_threshold=1).start(start_seq=last + 1)
+    try:
+        assert idx2.gaps_detected == 0
+        assert idx2.tree.find_matches([30, 31]).scores == {5: 2}
+    finally:
+        await idx2.stop()
+        await plane.close()
+
+
+async def test_replay_survives_parent_reinsertion_behind_child():
+    """remove-then-re-store moves a parent BEHIND its child in mirror
+    order (dict re-insertion); the replay must still announce the full
+    chain (fixpoint, not single-pass)."""
+    plane = LocalControlPlane()
+    pub = await KvEventPublisher(plane, worker_id=11, kv_block_size=BS).start_resync_responder()
+    idx = await KvIndexer(plane, kv_block_size=BS).start()
+    try:
+        await _announce_chain(pub, [1])
+        await pub.publish_stored(1, [StoredBlock(block_hash=2, tokens_hash=2)])
+        await pub.publish_removed([1])
+        await _announce_chain(pub, [1])  # parent now AFTER child in mirror
+        await _drain(idx)
+
+        # force a gap → full resync from the mirror
+        seq, entries = plane._streams["kv_events"]
+        plane._streams["kv_events"] = (seq + 50, entries)
+        await _announce_chain(pub, [99])
+        for _ in range(200):
+            if (idx.tree.find_matches([1, 2]).best() == 2
+                    and idx.tree.find_matches([99]).best() == 1):
+                break
+            await asyncio.sleep(0.01)
+        assert idx.tree.find_matches([1, 2]).scores == {11: 2}
     finally:
         await idx.stop()
         await pub.stop()
