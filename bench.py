@@ -464,9 +464,6 @@ def _child_main():
         model = "llama3-1b" if on_tpu else "tiny-cpu"
         if "kernel" in phases:
             kern = kernel_bench(on_tpu)
-        else:
-            kern = {"kernel_tok_s": 0.0, "kernel_skipped": True}
-        if "kernel" in phases:
             try:
                 # int8 weights halve HBM weight traffic — the bandwidth-bound
                 # decode ceiling doubles; measure it alongside bf16 so the
@@ -480,6 +477,8 @@ def _child_main():
                                          kv_int8=True))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["kernel_kv8_error"] = repr(e)[:200]
+        else:
+            kern = {"kernel_tok_s": 0.0, "kernel_skipped": True}
         if "spec" in phases:
             try:
                 # before the out={} snapshot below: spec numbers must survive
@@ -488,12 +487,19 @@ def _child_main():
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["spec_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
+        if "kernel" in phases:
+            fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"
+                               f"{platform},e2e-failed]")
+            fallback_vs = round(tok_s / BASELINE_TOK_S, 3)
+        else:
+            # a skipped kernel must not read as a 0.0 tok/s regression
+            fallback_metric = f"kernel_phase_skipped[{model},{platform}]"
+            fallback_vs = 0.0
         out = {
-            "metric": f"kernel_decode_tok_s_per_chip[{model},{platform},"
-                      f"e2e-failed]",
+            "metric": fallback_metric,
             "value": tok_s,
             "unit": "tok/s",
-            "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+            "vs_baseline": fallback_vs,
             "extra": dict(kern),
         }
         rc = 0

@@ -78,18 +78,15 @@ def build_fixture(cfg: dict, path: str, *, seed: int = 0) -> float:
         return (torch.randn(*shape, generator=gen, dtype=torch.float32)
                 .mul_(0.02).to(torch.bfloat16))
 
-    shard, shard_idx, shard_bytes, weight_map = {}, 1, 0, {}
-    files = []
+    shard, shard_idx, shard_bytes = {}, 1, 0
 
     def flush():
+        # no index.json needed: the loader discovers shards by globbing
+        # *.safetensors (engine/loader.py)
         nonlocal shard, shard_idx, shard_bytes
         if not shard:
             return
-        name = f"model-{shard_idx:05d}.safetensors"
-        save_file(shard, os.path.join(path, name))
-        for k in shard:
-            weight_map[k] = name
-        files.append(name)
+        save_file(shard, os.path.join(path, f"model-{shard_idx:05d}.safetensors"))
         shard, shard_idx, shard_bytes = {}, shard_idx + 1, 0
 
     def put(name, tensor):
@@ -176,7 +173,8 @@ async def serve_bench(path: str, *, kv_int8: bool, isl: int, osl: int,
     out["num_blocks_auto"] = eng.num_blocks
     out["kv_capacity_tokens"] = eng.num_blocks * args.block_size
     try:
-        stats = jax.local_devices()[0].memory_stats()
+        from dynamo_tpu.engine.cache import bounded_memory_stats
+        stats = bounded_memory_stats(jax.local_devices()[0])
         out["hbm_in_use_gb"] = round(stats.get("bytes_in_use", 0) / 2**30, 2)
         out["hbm_limit_gb"] = round(stats.get("bytes_limit", 0) / 2**30, 2)
     except Exception:

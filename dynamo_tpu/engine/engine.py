@@ -19,6 +19,7 @@ Backend/Migration/Router operators unchanged.
 from __future__ import annotations
 
 import asyncio
+import collections
 import functools
 import itertools
 import logging
@@ -134,9 +135,14 @@ class AsyncJaxEngine:
             logger.warning("int8 KV cache is not supported for MLA latent "
                            "caches yet — using model dtype")
             self._kv_quant = False
+        from dynamo_tpu.engine.cache import tree_nbytes
+        # tree_nbytes is GLOBAL bytes; the fallback estimator reasons about
+        # ONE chip's HBM, and TP shards the big weight matrices across
+        # chips (replicated norm/scale leaves are noise at this precision)
         nb = args.num_blocks or hbm_sized_num_blocks(
             cfg, args.block_size, args.kv_cache_memory_fraction, args.tp_size,
-            kv_cache_dtype="int8" if self._kv_quant else None)
+            kv_cache_dtype="int8" if self._kv_quant else None,
+            params_bytes=tree_nbytes(self.params) // max(1, args.tp_size))
         self.num_blocks = nb
         self.k_cache, self.v_cache = allocate_device_cache(
             cfg, nb, args.block_size, mesh, global_arrays=self._multihost,
@@ -216,6 +222,10 @@ class AsyncJaxEngine:
         #: jitted full-model forward passes (each reads every weight once
         #: from HBM) — the denominator for roofline/MFU accounting in bench.py
         self.param_reads = 0
+        #: per-step phase timing ring (kind, n_seqs, n_tokens, wall_ms) —
+        #: the profile that located the r4 serving-vs-kernel gap; cheap
+        #: enough to keep always-on, dumped by step_trace_summary()
+        self.step_trace: "collections.deque" = collections.deque(maxlen=2048)
         #: multi-process DP fleet rank (None = single-rank); reported in
         #: worker stats (ref: kv_router/protocols.rs:57 data_parallel_rank)
         self.dp_rank: Optional[int] = None
@@ -736,9 +746,36 @@ class AsyncJaxEngine:
 
     async def _execute(self, plan: StepPlan) -> None:
         if plan.prefill:
+            t0 = time.perf_counter()
             await self._run_prefill(plan.prefill)
+            self.step_trace.append((
+                "prefill", len(plan.prefill),
+                sum(w.chunk for w in plan.prefill),
+                (time.perf_counter() - t0) * 1000))
         if plan.decode:
+            t0 = time.perf_counter()
+            gen0 = sum(s.generated for s in plan.decode)
             await self._run_decode(plan.decode)
+            self.step_trace.append((
+                "decode", len(plan.decode),
+                sum(s.generated for s in plan.decode) - gen0,
+                (time.perf_counter() - t0) * 1000))
+
+    def step_trace_summary(self) -> dict:
+        """Aggregate the timing ring: per kind, steps / seqs / tokens /
+        total+mean wall — the first thing to read when e2e throughput is
+        far below the kernel ceiling."""
+        agg: dict[str, list] = {}
+        for kind, n, toks, ms in self.step_trace:
+            a = agg.setdefault(kind, [0, 0, 0, 0.0])
+            a[0] += 1
+            a[1] += n
+            a[2] += toks
+            a[3] += ms
+        return {k: {"steps": a[0], "seqs": a[1], "tokens": a[2],
+                    "total_ms": round(a[3], 1),
+                    "mean_ms": round(a[3] / a[0], 1)}
+                for k, a in agg.items()}
 
     # ------------------------------------------------------------- prefill
 
@@ -1056,9 +1093,17 @@ class AsyncJaxEngine:
         return True
 
     async def _run_decode(self, seqs: list[SeqState]) -> None:
+        # Burst/spec paths gate on the DECODE SUBSET only — not on a
+        # globally-idle scheduler. The old `not waiting and all(running)`
+        # gate meant any queued or mid-prefill request demoted every other
+        # stream to one-token-per-dispatch; under continuous closed-loop
+        # load that is the COMMON state, and each single step pays the full
+        # dispatch+fetch round trip (~230 ms measured over the tunnel,
+        # r4 step trace) — the fleet decoded at 31 tok/s while the kernel
+        # does 4k+. A K-burst delays a pending prefill chunk by one burst
+        # (~bounded TTFT cost) and buys K× fewer host round trips.
         if (self.verify_fn is not None and seqs
-                and not self.scheduler.waiting
-                and all(s.remaining == 1 for s in self.scheduler.running)
+                and all(s.remaining == 1 for s in seqs)
                 and all(s.sampling_tuple()[0] == 0.0 for s in seqs)
                 and all(s.req.output_options.logprobs is None for s in seqs)
                 and all(not s.req.sampling_options.logit_bias for s in seqs)
@@ -1072,8 +1117,7 @@ class AsyncJaxEngine:
             return
         K = self.args.multi_step_decode
         if (self.multi_fn is not None and seqs
-                and not self.scheduler.waiting
-                and all(s.remaining == 1 for s in self.scheduler.running)
+                and all(s.remaining == 1 for s in seqs)
                 # top-k capture and logit_bias need host-visible logits:
                 # the burst keeps them on device, so those requests take
                 # the single-step path

@@ -29,6 +29,7 @@ Falls back to the XLA path when shapes can't align (KV·hd % 128 ≠ 0).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -42,13 +43,22 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
                    qexp_ref,  # [1, H, KVhd] VMEM
                    sink_ref,  # [1, H, 1] VMEM (zeros when has_sink=False)
                    kcache_ref, vcache_ref,  # [slots, KVhd] HBM
-                   *rest,  # [ksc_ref, vsc_ref (HBM [slots, KV]),] out_ref,
-                           # kbuf, vbuf, [ksbuf, vsbuf,] dma_sem
-                   bs: int, has_sink: bool, quant: bool):
+                   *rest,  # [ksc_ref, vsc_ref (HBM [slots, KV] | VMEM),]
+                           # out_ref, kbuf, vbuf, [ksbuf, vsbuf,] dma_sem
+                   bs: int, has_sink: bool, quant: bool,
+                   vmem_scales: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    if quant:
+    if quant and vmem_scales:
+        # scales ride as ordinary VMEM operands (constant block → fetched
+        # once for the whole grid): 2 DMAs/page, same as the bf16 path.
+        # The r4 chip measurement showed the 4-DMA variant at 1557 tok/s vs
+        # 4528 bf16 — the two tiny (bs·KV·4 B) scale copies pay full DMA
+        # grant latency each, tripling effective page-fetch cost.
+        ksc_ref, vsc_ref, out_ref, kbuf, vbuf, dma_sem = rest
+        ksbuf = vsbuf = None
+    elif quant:
         (ksc_ref, vsc_ref, out_ref, kbuf, vbuf,
          ksbuf, vsbuf, dma_sem) = rest
     else:
@@ -78,7 +88,8 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
         pltpu.make_async_copy(
             vcache_ref.at[pl.ds(blk * bs, bs)], vbuf.at[slot],
             dma_sem.at[slot, 1]).start()
-        if quant:  # per-(slot, head) scales ride their own small DMAs
+        if quant and not vmem_scales:
+            # per-(slot, head) scales ride their own small DMAs
             pltpu.make_async_copy(
                 ksc_ref.at[pl.ds(blk * bs, bs)], ksbuf.at[slot],
                 dma_sem.at[slot, 2]).start()
@@ -92,7 +103,7 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
                               dma_sem.at[slot, 0]).wait()
         pltpu.make_async_copy(vbuf.at[slot], vbuf.at[slot],
                               dma_sem.at[slot, 1]).wait()
-        if quant:
+        if quant and not vmem_scales:
             pltpu.make_async_copy(ksbuf.at[slot], ksbuf.at[slot],
                                   dma_sem.at[slot, 2]).wait()
             pltpu.make_async_copy(vsbuf.at[slot], vsbuf.at[slot],
@@ -111,7 +122,7 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
         # static head→segment one-hot [H, KV]: head h's scale per key t is
         # seg_oh @ spage.T — one tiny MXU matmul instead of lane-expanding
         # scales to the [bs, KVhd] domain
-        KV = ksbuf.shape[2]
+        KV = ksc_ref.shape[1] if vmem_scales else ksbuf.shape[2]
         G = H // KV
         rows = jax.lax.broadcasted_iota(jnp.int32, (H, KV), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (H, KV), 1)
@@ -122,6 +133,13 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
         wait_dma(w)
         kpage = kbuf[w % D].astype(jnp.float32)  # [bs, KVhd]
         vpage = vbuf[w % D].astype(jnp.float32)
+        if quant and vmem_scales:
+            blk = block_tables_ref[b, w]
+            kscpage = ksc_ref[pl.ds(blk * bs, bs)]  # [bs, KV], VMEM slice
+            vscpage = vsc_ref[pl.ds(blk * bs, bs)]
+        elif quant:
+            kscpage = ksbuf[w % D]
+            vscpage = vsbuf[w % D]
 
         # scores: contraction over KVhd == per-group q·k (q̃ is segment-masked)
         s = jax.lax.dot_general(
@@ -132,7 +150,7 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
             # its own segment, so its raw score scales by that segment's
             # per-key k-scale
             ksc = jax.lax.dot_general(
-                seg_oh, ksbuf[w % D], (((1,), (1,)), ((), ())),
+                seg_oh, kscpage, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # [H, bs]
             s = s * ksc
 
@@ -149,7 +167,7 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
             # fold per-key v-scales into p (head h's own segment scaling;
             # other segments become garbage the caller discards anyway)
             vsc = jax.lax.dot_general(
-                seg_oh, vsbuf[w % D], (((1,), (1,)), ((), ())),
+                seg_oh, vscpage, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # [H, bs]
             pv_p = p * vsc
         pv = jax.lax.dot_general(
@@ -226,8 +244,17 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
 
     W = block_tables.shape[1]
     D = min(W, 16)  # pipeline depth (VMEM budget: 2·D·bs·KVhd·dtype bytes)
+    # int8 scale placement: resident in VMEM when both arrays fit the
+    # budget (one fetch for the whole grid, 2 DMAs/page like bf16) — the
+    # 4-DMA variant measured 2.9x slower on-chip (tiny scale copies pay
+    # full grant latency). Budget overridable for experiments.
+    vmem_scales = False
+    if quant:
+        scale_bytes = 2 * slots * KV * 4
+        budget = int(os.environ.get("DYN_KV_SCALE_VMEM_BYTES", 6 << 20))
+        vmem_scales = scale_bytes <= budget
     kernel = functools.partial(_decode_kernel, bs=bs, has_sink=has_sink,
-                               quant=quant)
+                               quant=quant, vmem_scales=vmem_scales)
     in_specs = [
         pl.BlockSpec((1, H, KVhd), lambda b, *_: (b, 0, 0)),
         pl.BlockSpec((1, H, 1), lambda b, *_: (0, 0, 0)),
@@ -240,13 +267,20 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
     ]
     operands = [k_cache.reshape(slots, KVhd), v_cache.reshape(slots, KVhd)]
     if quant:
-        in_specs += [pl.BlockSpec(memory_space=pltpu.HBM),
-                     pl.BlockSpec(memory_space=pltpu.HBM)]
-        scratch += [pltpu.VMEM((D, bs, KV), jnp.float32),
-                    pltpu.VMEM((D, bs, KV), jnp.float32)]
+        if vmem_scales:
+            # constant block index → Pallas fetches the arrays once and
+            # keeps them resident across the whole (B,) grid
+            in_specs += [pl.BlockSpec((slots, KV), lambda b, *_: (0, 0)),
+                         pl.BlockSpec((slots, KV), lambda b, *_: (0, 0))]
+        else:
+            in_specs += [pl.BlockSpec(memory_space=pltpu.HBM),
+                         pl.BlockSpec(memory_space=pltpu.HBM)]
+            scratch += [pltpu.VMEM((D, bs, KV), jnp.float32),
+                        pltpu.VMEM((D, bs, KV), jnp.float32)]
         operands += [k_scales.astype(jnp.float32),
                      v_scales.astype(jnp.float32)]
-    scratch.append(pltpu.SemaphoreType.DMA((D, 4 if quant else 2)))
+    scratch.append(
+        pltpu.SemaphoreType.DMA((D, 4 if quant and not vmem_scales else 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B,),
