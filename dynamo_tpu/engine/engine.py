@@ -1102,8 +1102,9 @@ class AsyncJaxEngine:
         # r4 step trace) — the fleet decoded at 31 tok/s while the kernel
         # does 4k+. A K-burst delays a pending prefill chunk by one burst
         # (~bounded TTFT cost) and buys K× fewer host round trips.
+        # (plan.decode already contains only remaining==1 seqs — the
+        # scheduler guarantees it, no per-step re-check needed)
         if (self.verify_fn is not None and seqs
-                and all(s.remaining == 1 for s in seqs)
                 and all(s.sampling_tuple()[0] == 0.0 for s in seqs)
                 and all(s.req.output_options.logprobs is None for s in seqs)
                 and all(not s.req.sampling_options.logit_bias for s in seqs)
@@ -1117,7 +1118,6 @@ class AsyncJaxEngine:
             return
         K = self.args.multi_step_decode
         if (self.multi_fn is not None and seqs
-                and all(s.remaining == 1 for s in seqs)
                 # top-k capture and logit_bias need host-visible logits:
                 # the burst keeps them on device, so those requests take
                 # the single-step path

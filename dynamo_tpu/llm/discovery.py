@@ -48,6 +48,9 @@ class ServedModel:
     embed_client: Optional[Client] = None
     #: lazy client to the worker's "clear_kv_blocks" admin endpoint
     clear_client: Optional[Client] = None
+    #: SHARED load monitor (owned by the ModelWatcher); this model's client
+    #: is registered with it — stop() only unregisters
+    monitor: Optional[object] = None
     _endpoint: Optional[object] = None
     _embed_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
@@ -102,6 +105,8 @@ class ServedModel:
         return results
 
     async def stop(self):
+        if self.monitor:
+            self.monitor.unregister_client(self.client)
         await self.client.stop()
         if self.embed_client:
             await self.embed_client.stop()
@@ -139,11 +144,23 @@ class ModelWatcher:
         manager: ModelManager,
         router_mode: str = "kv",
         kv_router_config: Optional[KvRouterConfig] = None,
+        busy_threshold: Optional[float] = None,
     ):
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         self.kv_router_config = kv_router_config or KvRouterConfig()
+        #: KV-load fraction above which a worker is skipped by rr/random
+        #: routing (ref: worker_monitor.rs busy_threshold). Defaults from
+        #: the layered RuntimeConfig (DYN_BUSY_THRESHOLD / config file,
+        #: validated there). None = monitoring off. KV-mode routing has its
+        #: own richer load signal, so this mainly serves round_robin/random.
+        if busy_threshold is None:
+            busy_threshold = getattr(runtime.config, "busy_threshold", None)
+        self.busy_threshold = busy_threshold
+        #: ONE monitor shared by every served model (single kv_metrics
+        #: subscription + models/ watch; clients filter the busy set)
+        self._monitor = None
         self._watch = None
         self._task: Optional[asyncio.Task] = None
 
@@ -162,6 +179,9 @@ class ModelWatcher:
         for m in list(self.manager.models.values()):
             await m.stop()
         self.manager.models.clear()
+        if self._monitor is not None:
+            await self._monitor.stop()
+            self._monitor = None
 
     async def _loop(self):
         try:
@@ -191,6 +211,14 @@ class ModelWatcher:
                 .endpoint(entry.endpoint)
             )
             client = await endpoint.client().start()
+            if self.busy_threshold is not None:
+                if self._monitor is None:
+                    from dynamo_tpu.runtime.worker_monitor import WorkerMonitor
+
+                    self._monitor = await WorkerMonitor(
+                        plane=self.runtime.plane,
+                        busy_threshold=self.busy_threshold).start()
+                self._monitor.register_client(client)
             router = None
             if self.router_mode == "kv":
                 router = await KvRouter(
@@ -209,7 +237,7 @@ class ModelWatcher:
             pipeline = build_pipeline(card, tokenizer, engine)
             sm = ServedModel(
                 name=entry.name, card=card, client=client, pipeline=pipeline,
-                router=router, _endpoint=endpoint,
+                router=router, monitor=self._monitor, _endpoint=endpoint,
             )
             self.manager.models[entry.name] = sm
             logger.info("model %s now served (router=%s)", entry.name, self.router_mode)

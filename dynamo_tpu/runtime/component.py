@@ -253,6 +253,10 @@ class Client:
         self.endpoint = endpoint
         self._instances: dict[int, Instance] = {}
         self._down: set[int] = set()
+        # load-saturated workers (WorkerMonitor): skipped by rr/random
+        # routing but NOT dead — distinct from _down so a recovered canary
+        # can't accidentally clear a load signal or vice versa
+        self._busy: set[int] = set()
         self._watch: Optional[Watch] = None
         self._watch_task: Optional[asyncio.Task] = None
         self._ready = asyncio.Event()
@@ -314,7 +318,21 @@ class Client:
         return self._instances.get(instance_id)
 
     def available_ids(self) -> list[int]:
-        return sorted(set(self._instances) - self._down)
+        # the busy set may come from a SHARED monitor spanning several
+        # models' clients — only ids this client actually owns count
+        busy = self._busy & set(self._instances)
+        ids = set(self._instances) - self._down - busy
+        if not ids and busy:
+            # every worker saturated: routing to a busy worker beats
+            # NoResponders (the reference degrades the same way — busy is
+            # backpressure, not failure)
+            ids = set(self._instances) - self._down
+        return sorted(ids)
+
+    def set_busy_instances(self, instance_ids) -> None:
+        """Replace the load-busy set (ref: worker_monitor.rs
+        update_free_instances) — typically called by WorkerMonitor."""
+        self._busy = set(instance_ids)
 
     def report_instance_down(self, instance_id: int):
         logger.warning("instance %x reported down", instance_id)

@@ -93,8 +93,14 @@ class RuntimeConfig:
     health_check_failures: int = 3
     #: system status server port (0 = disabled)
     system_port: int = 0
+    #: KV-load fraction above which routing skips a worker (WorkerMonitor);
+    #: None = load monitoring off (ref: worker_monitor.rs busy_threshold)
+    busy_threshold: Optional[float] = None
 
     def __post_init__(self):
+        if self.busy_threshold is not None and not 0 < self.busy_threshold <= 1:
+            raise ConfigError(
+                "config field 'busy_threshold': must be in (0, 1]")
         if self.lease_ttl <= 0:
             raise ConfigError("config field 'lease_ttl': must be > 0")
         if self.request_timeout <= 0:
@@ -115,6 +121,7 @@ class RuntimeConfig:
         "control_plane_address": "DYN_CONTROL_PLANE",
         "health_check_interval": "DYN_HEALTH_CHECK_INTERVAL",
         "health_check_failures": "DYN_HEALTH_CHECK_FAILURES",
+        "busy_threshold": "DYN_BUSY_THRESHOLD",
     }
 
     @classmethod

@@ -1,0 +1,148 @@
+"""Load-aware worker monitor: mark KV-saturated workers busy on a Client.
+
+Rebuild of the reference's WorkerMonitor
+(ref: lib/runtime/src/utils/worker_monitor.rs:1-190): subscribes to the
+``kv_metrics`` subject (ForwardPassMetrics per worker), watches the
+``models/`` prefix for each worker's registered ``total_kv_blocks``
+(typed-prefix-watcher role, keyed by lease id), and when a worker's
+``kv_active_blocks > threshold × total`` marks it BUSY on the client —
+round-robin/random routing then skips it until its load drops. Busy is a
+separate set from health-down: a saturated worker is healthy and comes
+back by itself; a failed canary does not.
+
+The reference's TODO (generalize beyond KV-cache load) applies here too;
+the threshold contract is kept identical so operators can port configs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.llm.model_card import MODEL_ROOT
+from dynamo_tpu.router.protocols import KV_METRICS_SUBJECT
+
+logger = logging.getLogger("dynamo.worker_monitor")
+
+DEFAULT_BUSY_THRESHOLD = 0.95
+
+
+@dataclass
+class WorkerLoadState:
+    kv_active_blocks: Optional[int] = None
+    kv_total_blocks: Optional[int] = None
+
+    def is_busy(self, threshold: float) -> bool:
+        if self.kv_active_blocks is None or not self.kv_total_blocks:
+            return False
+        return self.kv_active_blocks > threshold * self.kv_total_blocks
+
+
+class WorkerMonitor:
+    """Maintains per-worker load states from the ``kv_metrics`` subject and
+    pushes the busy set to every REGISTERED client. One monitor serves any
+    number of clients/models (one metrics subscription, one models/ watch —
+    per-model monitors would duplicate all of it and cross-pollute busy
+    sets); each client filters the set against its own instances."""
+
+    def __init__(self, client=None, busy_threshold: float = DEFAULT_BUSY_THRESHOLD,
+                 plane=None):
+        if plane is None:
+            plane = client._runtime.plane
+        self.busy_threshold = busy_threshold
+        self.load_states: dict[int, WorkerLoadState] = {}
+        self._plane = plane
+        self._clients: list = [client] if client is not None else []
+        self._metrics_sub = None
+        self._model_watch = None
+        self._tasks: list[asyncio.Task] = []
+        self._busy: list[int] = []
+
+    def register_client(self, client) -> None:
+        if client not in self._clients:
+            self._clients.append(client)
+            client.set_busy_instances(self._busy)
+
+    def unregister_client(self, client) -> None:
+        if client in self._clients:
+            self._clients.remove(client)
+            client.set_busy_instances(())
+
+    async def start(self) -> "WorkerMonitor":
+        self._metrics_sub = await self._plane.subscribe(KV_METRICS_SUBJECT)
+        self._model_watch = await self._plane.watch_prefix(MODEL_ROOT + "/")
+        loop = asyncio.get_running_loop()
+        self._tasks = [loop.create_task(self._metrics_loop()),
+                       loop.create_task(self._models_loop())]
+        return self
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        if self._metrics_sub:
+            await self._metrics_sub.cancel()
+        if self._model_watch:
+            await self._model_watch.cancel()
+
+    # ------------------------------------------------------------- loops
+    async def _models_loop(self):
+        """models/<slug>/<lease-hex> → runtime_config.total_kv_blocks.
+        A deleted key (lease expiry / drain) drops the worker's state."""
+        try:
+            for key, value in self._model_watch.snapshot.items():
+                self._apply_model("put", key, value)
+            async for ev in self._model_watch:
+                self._apply_model(ev.type, ev.key, ev.value)
+        except asyncio.CancelledError:
+            pass
+
+    def _apply_model(self, ev_type: str, key: str, value: bytes):
+        # models/<slug>/<lease-hex>[/<model-type>] — the lease is POSITIONAL
+        # (a trailing type segment like ".../chat" must not be parsed)
+        parts = key.split("/")
+        try:
+            lease = int(parts[2], 16)
+        except (IndexError, ValueError):
+            return
+        if ev_type == "delete":
+            self.load_states.pop(lease, None)
+            self._recompute()
+            return
+        try:
+            d = msgpack.unpackb(value, raw=False)
+        except Exception:
+            return
+        card = (d.get("card") or {}) if isinstance(d, dict) else {}
+        total = (card.get("runtime_config") or {}).get("total_kv_blocks")
+        st = self.load_states.setdefault(lease, WorkerLoadState())
+        st.kv_total_blocks = total
+        self._recompute()
+
+    async def _metrics_loop(self):
+        try:
+            async for _subject, payload in self._metrics_sub:
+                try:
+                    d = msgpack.unpackb(payload, raw=False)
+                    worker = d["worker_id"]
+                    active = d["metrics"]["kv_stats"]["kv_active_blocks"]
+                except Exception:
+                    continue
+                st = self.load_states.setdefault(worker, WorkerLoadState())
+                st.kv_active_blocks = active
+                self._recompute()
+        except asyncio.CancelledError:
+            pass
+
+    def _recompute(self):
+        busy = sorted(w for w, st in self.load_states.items()
+                      if st.is_busy(self.busy_threshold))
+        if busy != self._busy:
+            logger.info("busy workers changed: %s",
+                        [f"{w:x}" for w in busy])
+            self._busy = busy
+            for client in self._clients:
+                client.set_busy_instances(busy)

@@ -368,3 +368,32 @@ async def test_engine_int8_spec_decode():
         await _collect(e_ref, _req(prompt))
     await e_q.close()
     await e_ref.close()
+
+
+def test_decode_pallas_int8_both_scale_placements_match(monkeypatch):
+    """The kernel has TWO int8 scale placements — VMEM-resident operands
+    (small caches) and per-page scale DMAs (caches past the VMEM budget).
+    Tests naturally exercise only the VMEM variant; force the DMA variant
+    via DYN_KV_SCALE_VMEM_BYTES=0 so its unpacking/semaphore layout keeps
+    coverage (it remains the production path for 100k+-slot caches)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.paged_attention import (
+        paged_attention_decode, paged_attention_decode_xla,
+    )
+
+    q, kf, vf, kq, ks, vq, vs, bt, lens = _paged_setup(KV=2, hd=64, H=4)
+    args = (jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+            jnp.asarray(bt), jnp.asarray(lens))
+    kw = dict(block_size=4, k_scales=jnp.asarray(ks), v_scales=jnp.asarray(vs))
+    ref = paged_attention_decode_xla(*args, **kw)
+
+    monkeypatch.setenv("DYN_KV_SCALE_VMEM_BYTES", str(1 << 30))
+    out_vmem = paged_attention_decode(*args, interpret=True, **kw)
+    monkeypatch.setenv("DYN_KV_SCALE_VMEM_BYTES", "0")
+    out_dma = paged_attention_decode(*args, interpret=True, **kw)
+
+    np.testing.assert_allclose(np.asarray(out_vmem), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(out_dma), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)

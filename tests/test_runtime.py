@@ -349,3 +349,101 @@ def test_runtime_config_null_rejected(tmp_path):
         RuntimeConfig.load(config_file=str(j), env={})
     with _pytest.raises(ConfigError, match="health_check_interval"):
         RuntimeConfig.load(env={"DYN_HEALTH_CHECK_INTERVAL": "0"})
+
+
+async def test_worker_monitor_busy_routing(local_rt):
+    """WorkerMonitor (ref: worker_monitor.rs): a KV-saturated worker is
+    skipped by routing until its load drops; all-busy degrades to routing
+    anyway (backpressure, not failure)."""
+    import msgpack
+
+    from dynamo_tpu.llm.model_card import MODEL_ROOT
+    from dynamo_tpu.router.protocols import (
+        ForwardPassMetrics, KvStats, KV_METRICS_SUBJECT,
+    )
+    from dynamo_tpu.runtime.worker_monitor import WorkerMonitor
+
+    ep = local_rt.namespace("ns").component("comp").endpoint("gen")
+    hits: list[int] = []
+
+    def make_handler(tag):
+        async def handler(request, ctx=None):
+            hits.append(tag)
+            yield {"ok": tag}
+        return handler
+
+    l1 = await local_rt.plane.lease_create(ttl=10.0)
+    l2 = await local_rt.plane.lease_create(ttl=10.0)
+    h1 = await ep.serve_endpoint(make_handler(1), lease_id=l1)
+    h2 = await ep.serve_endpoint(make_handler(2), lease_id=l2)
+    client = await ep.client().start()
+    ids = await client.wait_for_instances(timeout=5)
+    assert len(ids) == 2
+
+    # register each worker's capacity under models/ (what register_llm does)
+    for iid in ids:
+        await local_rt.plane.kv_put(
+            f"{MODEL_ROOT}/m/{iid:x}",
+            msgpack.packb({"name": "m", "instance_id": iid,
+                           "card": {"display_name": "m",
+                                    "runtime_config": {"total_kv_blocks": 100}}}))
+    mon = await WorkerMonitor(client, busy_threshold=0.9).start()
+    try:
+        async def publish_load(iid, active):
+            await local_rt.plane.publish(KV_METRICS_SUBJECT, msgpack.packb({
+                "worker_id": iid,
+                "metrics": ForwardPassMetrics(
+                    kv_stats=KvStats(kv_active_blocks=active,
+                                     kv_total_blocks=100)).to_wire()}))
+
+        # worker ids[0] saturated (95 > 0.9*100), ids[1] light
+        await publish_load(ids[0], 95)
+        await publish_load(ids[1], 10)
+        for _ in range(100):
+            if client.available_ids() == [ids[1]]:
+                break
+            await asyncio.sleep(0.01)
+        assert client.available_ids() == [ids[1]]
+
+        hits.clear()
+        for _ in range(4):
+            recv = await client.generate({"n": 1}, mode="round_robin")
+            async for _ in recv:
+                pass
+        assert set(hits) == {2}  # all routed to the light worker
+
+        # both saturated → degrade to routing anyway (never NoResponders)
+        await publish_load(ids[1], 99)
+        for _ in range(100):
+            if mon._busy == sorted(ids):
+                break
+            await asyncio.sleep(0.01)
+        assert sorted(client.available_ids()) == sorted(ids)
+
+        # load drops → busy clears
+        await publish_load(ids[0], 5)
+        await publish_load(ids[1], 5)
+        for _ in range(100):
+            if not mon._busy:
+                break
+            await asyncio.sleep(0.01)
+        assert sorted(client.available_ids()) == sorted(ids)
+    finally:
+        await mon.stop()
+        await h1.stop(graceful=False)
+        await h2.stop(graceful=False)
+
+
+def test_busy_threshold_config_layering(monkeypatch):
+    """DYN_BUSY_THRESHOLD rides the layered RuntimeConfig like every other
+    DYN_* knob — validated, not a bare float() at the call site."""
+    import pytest as _pytest
+
+    from dynamo_tpu.runtime.config import ConfigError, RuntimeConfig
+
+    assert RuntimeConfig.load(env={}).busy_threshold is None
+    assert RuntimeConfig.load(env={"DYN_BUSY_THRESHOLD": "0.9"}).busy_threshold == 0.9
+    with _pytest.raises(ConfigError):
+        RuntimeConfig.load(env={"DYN_BUSY_THRESHOLD": "abc"})
+    with _pytest.raises(ConfigError):
+        RuntimeConfig.load(env={"DYN_BUSY_THRESHOLD": "1.5"})
