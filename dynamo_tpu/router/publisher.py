@@ -207,6 +207,14 @@ class WorkerMetricsPublisher:
         _spawn_publish(self, self.publish(metrics))
 
 
+def parse_load_event(payload: bytes) -> tuple[int, ForwardPassMetrics]:
+    """Decode one ``kv_metrics`` message → (worker_id, metrics). The ONE
+    place that knows the wire shape — MetricsAggregator and the runtime's
+    WorkerMonitor both ride it, so a format change can't silently diverge."""
+    d = msgpack.unpackb(payload, raw=False)
+    return d["worker_id"], ForwardPassMetrics.from_wire(d["metrics"])
+
+
 class MetricsAggregator:
     """Collects the latest ForwardPassMetrics per worker (ref: metrics_aggregator.rs)."""
 
@@ -232,8 +240,8 @@ class MetricsAggregator:
         try:
             async for _subject, payload in self._sub:
                 try:
-                    d = msgpack.unpackb(payload, raw=False)
-                    self.latest[d["worker_id"]] = ForwardPassMetrics.from_wire(d["metrics"])
+                    worker_id, metrics = parse_load_event(payload)
+                    self.latest[worker_id] = metrics
                 except Exception:
                     logger.exception("bad metrics payload ignored")
         except asyncio.CancelledError:

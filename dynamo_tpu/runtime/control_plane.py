@@ -29,8 +29,11 @@ from __future__ import annotations
 import abc
 import asyncio
 import logging
+import os
 import random
 import time
+
+import msgpack
 from collections import deque
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Awaitable, Callable, Optional
@@ -501,6 +504,36 @@ class LocalControlPlane(ControlPlane):
     async def get_epoch(self) -> str:
         return self.epoch
 
+    # -- persistence (dynctl --persist) ---------------------------------
+    #: stream entries retained in a snapshot — consumers further behind
+    #: resync via the gap protocol (indexer stream_first_seq check), so a
+    #: bounded snapshot is principled, not lossy-by-accident
+    PERSIST_STREAM_TAIL = 4096
+
+    def dump_state(self) -> bytes:
+        """Durable subset of hub state. LEASED keys are excluded: their
+        owners died with the old process and re-register under fresh
+        leases — persisting them would resurrect ghost instances. The
+        epoch is preserved so stream seqs stay comparable across the
+        restart (consumers resume WITHOUT a false gap)."""
+        kv = {k: v for k, v in self._kv.items() if k not in self._key_lease}
+        streams = {
+            name: [seq, [list(e) for e in entries[-self.PERSIST_STREAM_TAIL:]]]
+            for name, (seq, entries) in self._streams.items()
+        }
+        objects = [[b, n, data] for (b, n), data in self._objects.items()]
+        return msgpack.packb({"v": 1, "epoch": self.epoch, "kv": kv,
+                              "streams": streams, "objects": objects})
+
+    def load_state(self, data: bytes) -> None:
+        d = msgpack.unpackb(data, raw=False)
+        self.epoch = d["epoch"]
+        self._kv.update(d.get("kv") or {})
+        for name, (seq, entries) in (d.get("streams") or {}).items():
+            self._streams[name] = (seq, [tuple(e) for e in entries])
+        for b, n, obj in d.get("objects") or []:
+            self._objects[(b, n)] = obj
+
     # -- Object store --
     async def object_put(self, bucket, name, data):
         self._objects[(bucket, name)] = data
@@ -536,24 +569,80 @@ class LocalControlPlane(ControlPlane):
 class ControlPlaneServer:
     """``dynctl``: exposes a LocalControlPlane over TCP to many processes."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None,
+                 persist_interval: float = 5.0):
         self.core = LocalControlPlane()
         self._host = host
         self._port = port
         self._server: Optional[asyncio.base_events.Server] = None
         self._conns: set["_ServerConn"] = set()
+        #: durable-state file (ref role: etcd's WAL + JetStream file store —
+        #: discovery keys, object store, stream tails survive a hub restart;
+        #: leases deliberately do NOT). None = in-memory only.
+        self._persist_path = persist_path
+        self._persist_interval = persist_interval
+        self._persist_task: Optional[asyncio.Task] = None
 
     @property
     def address(self) -> str:
         return f"{self._host}:{self._port}"
 
     async def start(self) -> str:
+        if self._persist_path and os.path.exists(self._persist_path):
+            try:
+                with open(self._persist_path, "rb") as f:
+                    self.core.load_state(f.read())
+                logger.info("control plane state restored from %s (epoch %s)",
+                            self._persist_path, self.core.epoch)
+            except Exception:
+                logger.exception("state restore failed; starting fresh")
         self._server = await asyncio.start_server(self._on_conn, self._host, self._port)
         self._port = self._server.sockets[0].getsockname()[1]
+        if self._persist_path:
+            self._persist_task = asyncio.get_running_loop().create_task(
+                self._persist_loop())
         logger.info("control plane listening on %s", self.address)
         return self.address
 
+    def _write_state(self, data: bytes) -> None:
+        tmp = f"{self._persist_path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._persist_path)  # atomic: never a torn snapshot
+
+    async def _persist_loop(self):
+        try:
+            while True:
+                await asyncio.sleep(self._persist_interval)
+                try:
+                    # dump on the LOOP thread: the core's dicts are mutated
+                    # by loop-thread handlers, so iterating them off-thread
+                    # races ("dict changed size"); only the file IO moves
+                    # to a worker
+                    data = self.core.dump_state()
+                    await asyncio.to_thread(self._write_state, data)
+                except Exception:
+                    logger.exception("state snapshot failed; retrying next tick")
+        except asyncio.CancelledError:
+            pass
+
     async def stop(self):
+        if self._persist_task:
+            self._persist_task.cancel()
+            try:
+                # an in-flight to_thread write can't be cancelled mid-write;
+                # await it so it can't land AFTER (and clobber) the final
+                # flush below
+                await self._persist_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._persist_path:
+            try:
+                # final flush: clean shutdown loses nothing
+                self._write_state(self.core.dump_state())
+            except Exception:
+                logger.exception("final state snapshot failed")
         if self._server:
             self._server.close()
         for conn in list(self._conns):

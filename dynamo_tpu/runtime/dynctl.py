@@ -14,12 +14,23 @@ from dynamo_tpu.runtime.config import setup_logging
 from dynamo_tpu.runtime.control_plane import ControlPlaneServer
 
 
-async def amain(host: str, port: int):
-    server = ControlPlaneServer(host, port)
+async def amain(host: str, port: int, persist: str = None,
+                persist_interval: float = 5.0):
+    server = ControlPlaneServer(host, port, persist_path=persist,
+                                persist_interval=persist_interval)
     addr = await server.start()
     print(f"dynctl listening on {addr}", flush=True)
+
+    stop = asyncio.Event()
     try:
-        await asyncio.Event().wait()
+        import signal
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+    except (ImportError, NotImplementedError):
+        pass
+    try:
+        await stop.wait()  # SIGTERM → graceful stop → final state flush
     finally:
         await server.stop()
 
@@ -29,8 +40,15 @@ def main():
     ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=6650)
+    ap.add_argument("--persist", default=None, metavar="FILE",
+                    help="durable-state file: discovery keys, object store "
+                         "and stream tails survive a restart (leases do "
+                         "not); snapshotted every --persist-interval s, "
+                         "flushed on SIGTERM")
+    ap.add_argument("--persist-interval", type=float, default=5.0)
     args = ap.parse_args()
-    asyncio.run(amain(args.host, args.port))
+    asyncio.run(amain(args.host, args.port, args.persist,
+                      args.persist_interval))
 
 
 if __name__ == "__main__":

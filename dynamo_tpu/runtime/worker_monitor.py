@@ -123,16 +123,17 @@ class WorkerMonitor:
         self._recompute()
 
     async def _metrics_loop(self):
+        from dynamo_tpu.router.publisher import parse_load_event
+
         try:
             async for _subject, payload in self._metrics_sub:
                 try:
-                    d = msgpack.unpackb(payload, raw=False)
-                    worker = d["worker_id"]
-                    active = d["metrics"]["kv_stats"]["kv_active_blocks"]
+                    worker, metrics = parse_load_event(payload)
                 except Exception:
+                    logger.exception("bad kv_metrics payload ignored")
                     continue
                 st = self.load_states.setdefault(worker, WorkerLoadState())
-                st.kv_active_blocks = active
+                st.kv_active_blocks = metrics.kv_stats.kv_active_blocks
                 self._recompute()
         except asyncio.CancelledError:
             pass

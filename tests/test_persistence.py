@@ -1,0 +1,118 @@
+"""dynctl durable state (--persist): hub restarts without losing the world.
+
+The reference rides replicated etcd + JetStream file stores
+(ref: lib/runtime/src/transports/etcd.rs:35, transports/nats.rs:48); the
+single-hub analog is a periodic + on-shutdown snapshot of the durable
+subset: unleased KV, the object store, and stream TAILS (bounded — anyone
+further behind resyncs via the stream-gap protocol). Leases and their keys
+are deliberately dropped: instance registrations must not outlive their
+processes."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.control_plane import (
+    ControlPlaneServer,
+    LocalControlPlane,
+    RemoteControlPlane,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+async def test_state_roundtrip_excludes_leases(tmp_path):
+    path = str(tmp_path / "state.bin")
+    s1 = ControlPlaneServer(persist_path=path)
+    addr = await s1.start()
+    plane = await RemoteControlPlane(addr).connect()
+
+    await plane.kv_put("config/threshold", b"0.9")
+    lease = await plane.lease_create(ttl=30.0)
+    await plane.kv_put("instances/ns/comp/ep:abc", b"live", lease_id=lease)
+    await plane.object_put("bucket", "snap", b"obj-data")
+    seqs = [await plane.stream_publish("events", f"e{i}".encode())
+            for i in range(5)]
+    old_epoch = await plane.get_epoch()
+    await plane.close()
+    await s1.stop()  # graceful: final flush
+
+    s2 = ControlPlaneServer(persist_path=path)
+    addr2 = await s2.start()
+    plane2 = await RemoteControlPlane(addr2).connect()
+    try:
+        assert await plane2.kv_get("config/threshold") == b"0.9"
+        # the leased instance key did NOT survive (its process is gone)
+        assert await plane2.kv_get("instances/ns/comp/ep:abc") is None
+        assert await plane2.object_get("bucket", "snap") == b"obj-data"
+        # stream seqs CONTINUE (same epoch): no false gap for resuming
+        # consumers, and new publishes extend the old numbering
+        assert await plane2.get_epoch() == old_epoch
+        assert await plane2.stream_last_seq("events") == seqs[-1]
+        assert await plane2.stream_first_seq("events") == seqs[0]
+        assert await plane2.stream_publish("events", b"post") == seqs[-1] + 1
+        sub = await plane2.stream_subscribe("events", start_seq=seqs[2])
+        got = []
+        async for seq, payload in sub:
+            got.append((seq, payload))
+            if len(got) == 3:
+                break
+        assert got == [(4, b"e3"), (5, b"e4"), (6, b"post")]
+        await sub.cancel()
+    finally:
+        await plane2.close()
+        await s2.stop()
+
+
+async def test_indexer_resumes_across_persisted_restart(tmp_path):
+    """A router snapshot + a persisted hub: restart looks like a quiescent
+    resume (same epoch, seqs intact) — no resync storm, tree intact."""
+    import msgpack
+
+    from dynamo_tpu.router.indexer import KvIndexer
+    from dynamo_tpu.router.publisher import KvEventPublisher
+    from dynamo_tpu.router.protocols import StoredBlock
+
+    path = str(tmp_path / "state.bin")
+    s1 = ControlPlaneServer(persist_path=path)
+    addr = await s1.start()
+    plane = await RemoteControlPlane(addr).connect()
+    pub = KvEventPublisher(plane, worker_id=3, kv_block_size=4)
+    await pub.publish_stored(None, [StoredBlock(block_hash=h, tokens_hash=h)
+                                    for h in (1, 2)])
+    idx = await KvIndexer(plane, kv_block_size=4, snapshot_threshold=1).start()
+    for _ in range(200):
+        if idx.snapshots_written:
+            break
+        await asyncio.sleep(0.01)
+    await idx.stop()
+    await plane.close()
+    await s1.stop()
+
+    s2 = ControlPlaneServer(persist_path=path)
+    addr2 = await s2.start()
+    plane2 = await RemoteControlPlane(addr2).connect()
+    try:
+        idx2 = await KvIndexer(plane2, kv_block_size=4,
+                               snapshot_threshold=1).start()
+        assert idx2.gaps_detected == 0  # same epoch: NOT a false restart
+        assert idx2.tree.find_matches([1, 2]).scores == {3: 2}
+        await idx2.stop()
+    finally:
+        await plane2.close()
+        await s2.stop()
+
+
+async def test_stream_tail_bounded_in_snapshot(tmp_path):
+    core = LocalControlPlane()
+    core.PERSIST_STREAM_TAIL = 3
+    for i in range(10):
+        await core.stream_publish("s", bytes([i]))
+    data = core.dump_state()
+
+    fresh = LocalControlPlane()
+    fresh.load_state(data)
+    assert await fresh.stream_last_seq("s") == 10
+    assert await fresh.stream_first_seq("s") == 8  # tail of 3: 8..10
+    await core.close()
+    await fresh.close()
