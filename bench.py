@@ -101,14 +101,16 @@ def kernel_bench(on_tpu: bool, quantization=None, kv_int8=False) -> dict:
     kv_lens = jnp.full((B,), kv_len, jnp.int32)
 
     multi = M.make_multi_decode_fn(cfg, block_size, K)
-    zeros_f = jnp.zeros((B,), jnp.float32)
-    zeros_i = jnp.zeros((B,), jnp.int32)
-    ones_f = jnp.ones((B,), jnp.float32)
-    seeds = jnp.zeros((B,), jnp.uint32)
+    # packed layout: ints=[last_tokens, positions, kv_lens, top_k],
+    # floats=[temp, top_p], rand=[seeds, step0]
+    ints = jnp.stack([tokens, positions, kv_lens,
+                      jnp.zeros((B,), jnp.int32)], axis=1)
+    floats = jnp.stack([jnp.zeros((B,), jnp.float32),
+                        jnp.ones((B,), jnp.float32)], axis=1)
+    rand = jnp.zeros((B, 2), jnp.uint32)
 
     def burst(kc, vc):
-        return multi(params, tokens, positions, block_tables, kv_lens,
-                     kc, vc, zeros_f, zeros_i, ones_f, seeds, seeds)
+        return multi(params, ints, floats, rand, block_tables, kc, vc)
 
     toks, logps, k_cache, v_cache = burst(k_cache, v_cache)  # compile
     int(toks[0, 0])

@@ -49,8 +49,10 @@ STEP_STREAM_PREFIX = "mh_steps/{namespace}/"
 STEP_KEYS = {
     "step": ("tokens", "positions", "slot_map", "block_tables", "kv_lens",
              "last_idx"),
-    "multi": ("last_tokens", "positions", "block_tables", "kv_lens",
-              "temp", "top_k", "top_p", "seeds", "step0"),
+    # packed layout (see model.make_multi_decode_fn): ints [B,4] i32 =
+    # last_tokens/positions/kv_lens/top_k, floats [B,2] f32 = temp/top_p,
+    # rand [B,2] u32 = seeds/step0
+    "multi": ("ints", "floats", "rand", "block_tables"),
     "verify": ("tokens", "positions", "slot_map", "block_tables", "kv_lens"),
     "draft": ("last_tokens", "positions", "block_tables", "kv_lens"),
     "step_mm": ("tokens", "positions", "slot_map", "block_tables", "kv_lens",
@@ -325,35 +327,25 @@ class StepFollower:
                     self.on_fatal()
                     return
                 keys = STEP_KEYS[kind]
-                if kind == "step":
-                    _, eng.k_cache, eng.v_cache = eng.step_fn(
-                        eng.params,
-                        *(eng._put_batch(k, a[k]) for k in keys),
-                        eng.k_cache, eng.v_cache)
-                elif kind == "step_mm":  # multimodal prefill chunk
-                    _, eng.k_cache, eng.v_cache = eng._get_step_mm_fn()(
-                        eng.params,
-                        *(eng._put_batch(k, a[k]) for k in keys),
-                        eng.k_cache, eng.v_cache)
-                elif kind == "embed":  # /v1/embeddings scratch forward
+                if kind == "embed":  # /v1/embeddings scratch forward
                     eng._embed_forward(a["tokens"], a["lengths"])
-                elif kind == "verify":  # speculative verification
-                    _, _, eng.k_cache, eng.v_cache = eng.verify_fn(
-                        eng.params,
-                        *(eng._put_batch(k, a[k]) for k in keys),
-                        eng.k_cache, eng.v_cache)
-                elif kind == "draft":  # layer-skip speculative drafting
-                    _, eng.k_cache, eng.v_cache = eng.draft_fn(
-                        eng.params,
-                        *(eng._put_batch(k, a[k]) for k in keys),
-                        eng.k_cache, eng.v_cache)
-                else:  # "multi": caches sit mid-signature
-                    head, tail = keys[:4], keys[4:]
-                    _, _, eng.k_cache, eng.v_cache = eng.multi_fn(
-                        eng.params,
-                        *(eng._put_batch(k, a[k]) for k in head),
-                        eng.k_cache, eng.v_cache,
-                        *(eng._put_batch(k, a[k]) for k in tail))
+                else:
+                    # every cache-evolving kind shares one calling shape:
+                    # fn(params, *operands, k_cache, v_cache) -> (..., kc, vc).
+                    # Resolve the attribute LAZILY — an eager dict would
+                    # touch fns the engine never built (no spec/multi
+                    # configured) and crash the replay for unrelated kinds.
+                    if kind == "step_mm":
+                        fn = eng._get_step_mm_fn()
+                    else:
+                        fn = getattr(eng, {"step": "step_fn",
+                                           "verify": "verify_fn",
+                                           "draft": "draft_fn",
+                                           "multi": "multi_fn"}[kind])
+                    outs = fn(eng.params,
+                              *(eng._put_batch(k, a[k]) for k in keys),
+                              eng.k_cache, eng.v_cache)
+                    eng.k_cache, eng.v_cache = outs[-2], outs[-1]
                 self.steps_replayed += 1
             except asyncio.CancelledError:
                 raise
