@@ -1125,11 +1125,11 @@ class AsyncJaxEngine:
                 and all(not s.req.sampling_options.logit_bias for s in seqs)
                 and not any(_has_penalties(s) for s in seqs)
                 and all(s.guided_state is None for s in seqs)
-                # don't burn a burst when a seq is about to hit max_tokens —
-                # the overshoot steps would be computed and discarded
-                and all((s.req.stop_conditions.max_tokens is None
-                         or s.req.stop_conditions.max_tokens - s.generated >= K)
-                        for s in seqs)
+                # NOTE a seq within K of max_tokens does NOT disqualify the
+                # burst: its overshoot rows cost FLOPs on the batch dim, not
+                # wall clock, while the old fallback cost EVERY stream K
+                # single-step dispatch round trips whenever any one stream
+                # was finishing — under continuous load, constantly
                 and await self._run_multi_decode(seqs)):
             return
         import jax.numpy as jnp
