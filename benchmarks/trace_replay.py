@@ -1,0 +1,194 @@
+"""Replay a mooncake-style request trace against the OpenAI HTTP endpoint.
+
+Analog of the reference's real-data router benchmark
+(ref: benchmarks/router/real_data_benchmark.py + prefix_data_generator/
+synthesizer.py): trace records are JSONL
+
+    {"timestamp": ms, "input_length": n, "output_length": m,
+     "hash_ids": [b0, b1, ...]}
+
+where ``hash_ids`` name prefix blocks of ``--block-tokens`` tokens each,
+shared across requests (the prefix-caching/KV-routing signal). Each hash id
+expands to a DETERMINISTIC token block (seeded by the id), so two requests
+sharing hash ids share real token prefixes end to end — the radix index,
+prefix cache, and KV-aware routing all see genuine overlap.
+
+No genai-perf in this image (zero egress): the replay client is
+asyncio+aiohttp, open-loop at trace timestamps (scaled by ``--speedup``),
+streaming, reporting TTFT/ITL percentiles and aggregate throughput as one
+JSON line.
+
+``--synthesize N`` generates a small built-in trace (prefix tree: roots ×
+depth chains, Poisson arrivals) when no real mooncake file is at hand.
+
+Usage:
+    python -m benchmarks.trace_replay --url http://127.0.0.1:8000 \
+        --model mock --trace mooncake_trace.jsonl [--speedup 10]
+    python -m benchmarks.trace_replay --model mock --synthesize 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+VOCAB_LOW, VOCAB_HIGH = 10, 30000
+
+
+def block_tokens_for(hash_id: int, n: int) -> list[int]:
+    """The deterministic token block a hash id names (same id → same
+    tokens, across requests and processes)."""
+    rng = np.random.default_rng(0xC0FFEE ^ (hash_id * 2654435761 % 2**32))
+    return rng.integers(VOCAB_LOW, VOCAB_HIGH, n).tolist()
+
+
+def prompt_for(rec: dict, block_tokens: int) -> list[int]:
+    toks: list[int] = []
+    for h in rec.get("hash_ids", []):
+        toks.extend(block_tokens_for(int(h), block_tokens))
+    n = int(rec["input_length"])
+    if len(toks) < n:  # unique tail: the un-shared part of the prompt
+        rng = np.random.default_rng(rec.get("timestamp", 0) * 31 + n)
+        toks.extend(rng.integers(VOCAB_LOW, VOCAB_HIGH,
+                                 n - len(toks)).tolist())
+    return toks[:n]
+
+
+def synthesize(n: int, *, block_tokens: int, seed: int = 0,
+               roots: int = 8, depth: int = 6,
+               mean_iat_ms: float = 120.0) -> list[dict]:
+    """Prefix-tree trace: each request walks a root chain to a random
+    depth (shared prefix) and adds a unique tail; Poisson arrivals.
+    Mirrors the synthesizer's tree-walk model at toy scale."""
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    for i in range(n):
+        root = int(rng.integers(roots))
+        d = int(rng.integers(1, depth + 1))
+        # chain ids are globally unique per (root, level)
+        hash_ids = [root * 1000 + lvl for lvl in range(d)]
+        isl = d * block_tokens + int(rng.integers(8, 64))
+        out.append({
+            "timestamp": int(t),
+            "input_length": isl,
+            "output_length": int(rng.integers(16, 96)),
+            "hash_ids": hash_ids,
+        })
+        t += float(rng.exponential(mean_iat_ms))
+    return out
+
+
+async def replay(url: str, model: str, trace: list[dict], *,
+                 block_tokens: int, speedup: float) -> dict:
+    import aiohttp
+
+    results: list[tuple] = []  # (ttft, n_tok, itls)
+    errors: list[str] = []
+
+    async def one(session, rec):
+        prompt = prompt_for(rec, block_tokens)
+        t0 = time.perf_counter()
+        ttft, last, itls, n_tok = None, None, [], 0
+        try:
+            async with session.post(f"{url}/v1/completions", json={
+                    "model": model, "prompt": prompt, "stream": True,
+                    "max_tokens": int(rec["output_length"]),
+                    "ignore_eos": True, "temperature": 0.0}) as resp:
+                if resp.status != 200:
+                    errors.append(f"HTTP {resp.status}: "
+                                  f"{(await resp.text())[:200]}")
+                    results.append((None, 0, []))
+                    return
+                async for raw in resp.content:
+                    line = raw.decode()
+                    if not line.startswith("data: ") or line.startswith("data: [DONE]"):
+                        continue
+                    payload = json.loads(line[6:])
+                    if "error" in payload:
+                        errors.append(f"SSE error: {str(payload)[:200]}")
+                        results.append((None, 0, []))
+                        return
+                    now = time.perf_counter()
+                    if ttft is None:
+                        ttft = now - t0
+                    elif last is not None:
+                        itls.append(now - last)
+                    last = now
+                    n_tok += 1
+        except aiohttp.ClientError as e:
+            errors.append(f"client error: {e!r}"[:200])
+            results.append((None, 0, []))
+            return
+        results.append((ttft, n_tok, itls))
+
+    t_start = time.perf_counter()
+    base_ts = trace[0]["timestamp"]
+    conn = aiohttp.TCPConnector(limit=0)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        tasks = []
+        for rec in trace:
+            target = (rec["timestamp"] - base_ts) / 1000.0 / speedup
+            delay = target - (time.perf_counter() - t_start)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(asyncio.get_running_loop().create_task(
+                one(session, rec)))
+        await asyncio.gather(*tasks)
+    wall = time.perf_counter() - t_start
+
+    ok = [r for r in results if r[0] is not None]
+    ttfts = sorted(r[0] for r in ok)
+    itls = sorted(x for r in ok for x in r[2])
+    total_tok = sum(r[1] for r in ok)
+
+    def pct(xs, p):
+        return round(1000 * xs[min(int(len(xs) * p), len(xs) - 1)], 1) if xs else None
+
+    return {
+        "requests": len(trace), "ok": len(ok),
+        "failed": len(results) - len(ok),
+        "errors": errors[:5],
+        "wall_s": round(wall, 2),
+        "output_tok_s": round(total_tok / wall, 1),
+        "ttft_p50_ms": pct(ttfts, 0.50), "ttft_p95_ms": pct(ttfts, 0.95),
+        "itl_p50_ms": pct(itls, 0.50), "itl_p95_ms": pct(itls, 0.95),
+        "speedup": speedup,
+    }
+
+
+async def amain():
+    ap = argparse.ArgumentParser(description="mooncake-style trace replay")
+    ap.add_argument("--url", default="http://127.0.0.1:8000")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--trace", default=None,
+                    help="mooncake-style JSONL; omit with --synthesize")
+    ap.add_argument("--synthesize", type=int, default=None, metavar="N",
+                    help="generate an N-request prefix-tree trace instead")
+    ap.add_argument("--block-tokens", type=int, default=64,
+                    help="tokens per hash-id block (mooncake block_size "
+                         "is 512; smaller suits toy models)")
+    ap.add_argument("--speedup", type=float, default=1.0,
+                    help="replay timestamps this many times faster")
+    ap.add_argument("--seed", type=int, default=0)
+    cli = ap.parse_args()
+
+    if cli.trace:
+        with open(cli.trace) as f:
+            trace = [json.loads(ln) for ln in f if ln.strip()]
+    elif cli.synthesize:
+        trace = synthesize(cli.synthesize, block_tokens=cli.block_tokens,
+                           seed=cli.seed)
+    else:
+        ap.error("pass --trace FILE or --synthesize N")
+    trace.sort(key=lambda r: r["timestamp"])
+    out = await replay(cli.url, cli.model, trace,
+                       block_tokens=cli.block_tokens, speedup=cli.speedup)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    asyncio.run(amain())

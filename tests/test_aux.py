@@ -234,3 +234,32 @@ async def test_tracker_child_after_join_is_closed():
     with _pytest.raises(RuntimeError):
         late.spawn(never())
     assert ran == [1]
+
+
+def test_trace_replay_blocks_are_shared_and_deterministic():
+    """Two trace records sharing hash_ids must expand to identical token
+    prefixes (that's the whole prefix-caching signal), and expansion is
+    stable across calls."""
+    from benchmarks.trace_replay import block_tokens_for, prompt_for, synthesize
+
+    assert block_tokens_for(42, 16) == block_tokens_for(42, 16)
+    assert block_tokens_for(42, 16) != block_tokens_for(43, 16)
+
+    a = {"timestamp": 0, "input_length": 140, "output_length": 8,
+         "hash_ids": [7, 8]}
+    b = {"timestamp": 999, "input_length": 150, "output_length": 8,
+         "hash_ids": [7, 8, 9]}
+    pa, pb = prompt_for(a, 64), prompt_for(b, 64)
+    assert len(pa) == 140 and len(pb) == 150
+    assert pa[:128] == pb[:128]          # shared 2-block prefix
+    assert pa[128:] != pb[128:140]       # unique tails diverge
+
+    tr = synthesize(50, block_tokens=32, seed=1)
+    assert len(tr) == 50
+    assert tr == synthesize(50, block_tokens=32, seed=1)  # reproducible
+    ts = [r["timestamp"] for r in tr]
+    assert ts == sorted(ts)
+    # prefix sharing exists in the synthetic tree
+    from collections import Counter
+    first_blocks = Counter(tuple(r["hash_ids"][:1]) for r in tr)
+    assert max(first_blocks.values()) > 1
