@@ -128,13 +128,6 @@ class AsyncJaxEngine:
             logger.warning("int8 KV cache is not supported under pipeline "
                            "parallelism yet — using model dtype")
             self._kv_quant = False
-        if self._kv_quant and cfg.is_mla:
-            # the latent cache's single shared "head" needs its own scale
-            # layout + kernel treatment — not built yet; fail soft so an
-            # MLA deployment with a fleet-wide int8 flag still serves
-            logger.warning("int8 KV cache is not supported for MLA latent "
-                           "caches yet — using model dtype")
-            self._kv_quant = False
         from dynamo_tpu.engine.cache import tree_nbytes
         # tree_nbytes is GLOBAL bytes; the fallback estimator reasons about
         # ONE chip's HBM, and TP shards the big weight matrices across

@@ -484,37 +484,71 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
     k_rot = _rope(ckv[..., None, r:], positions, cfg.rope_theta,
                   cfg.rope_scaling)  # [B,S,1,dr]
 
+    from dynamo_tpu.engine.cache import is_quant_cache
+
+    kv_quant = is_quant_cache(kc)
     flat = slot_map.reshape(B * S)
-    kc = kc.at[lidx, flat].set(c.reshape(B * S, 1, r), mode="drop")
-    vc = vc.at[lidx, flat].set(
-        jnp.pad(k_rot.reshape(B * S, 1, dr), ((0, 0), (0, 0), (0, pr - dr))),
-        mode="drop")
+    rot_pad = jnp.pad(k_rot.reshape(B * S, 1, dr),
+                      ((0, 0), (0, 0), (0, pr - dr)))
+    if kv_quant:
+        # int8 latent pages: one scale per (slot, stream) — the latent and
+        # rope streams quantize independently (their magnitudes differ)
+        from dynamo_tpu.engine.cache import quantize_kv
+
+        cq, cs = quantize_kv(c.reshape(B * S, 1, r))
+        rq, rs = quantize_kv(rot_pad)
+        kc = {"q": kc["q"].at[lidx, flat].set(cq, mode="drop"),
+              "s": kc["s"].at[lidx, flat].set(cs, mode="drop")}
+        vc = {"q": vc["q"].at[lidx, flat].set(rq, mode="drop"),
+              "s": vc["s"].at[lidx, flat].set(rs, mode="drop")}
+    else:
+        kc = kc.at[lidx, flat].set(c.reshape(B * S, 1, r), mode="drop")
+        vc = vc.at[lidx, flat].set(rot_pad, mode="drop")
 
     w_uk = lp["w_uk"].reshape(r, H, dn).astype(jnp.float32)
     q_eff = jnp.einsum("bshd,rhd->bshr", q_nope.astype(jnp.float32), w_uk)
 
-    if use_pallas and S == 1:
+    from dynamo_tpu.engine.cache import cache_shape
+    from dynamo_tpu.ops.paged_attention import mla_int8_kernel_supported
+
+    _L, _slots, _, _ = cache_shape(kc)
+    pallas_ok = (not kv_quant
+                 or mla_int8_kernel_supported(block_size, _L * _slots))
+    if use_pallas and S == 1 and pallas_ok:
         # Pallas latent decode: pages stream HBM→VMEM once; output stays in
         # latent space, W_UV expansion below is shared with the XLA path
         from dynamo_tpu.ops.paged_attention import mla_paged_decode
 
-        L_, slots_, _, _ = kc.shape
+        L_, slots_ = _L, _slots
         nb = slots_ // block_size
         scale = mla_softmax_scale(cfg)
         qr_pad = jnp.pad(q_rot[:, 0].astype(jnp.float32),
                          ((0, 0), (0, 0), (0, pr - dr)))
+        flat_slots = L_ * slots_
 
-        def run(qe1, qr1, kcf, vcf, lidx_, bt, lens):
-            return mla_paged_decode(
-                qe1, qr1, kcf.reshape(L_ * slots_, r),
-                vcf.reshape(L_ * slots_, pr), bt + lidx_ * nb, lens,
-                block_size=block_size, scale=scale)
+        if kv_quant:
+            def run(qe1, qr1, kcf, vcf, lidx_, bt, lens):
+                return mla_paged_decode(
+                    qe1, qr1, kcf["q"].reshape(flat_slots, r),
+                    vcf["q"].reshape(flat_slots, pr), bt + lidx_ * nb, lens,
+                    block_size=block_size, scale=scale,
+                    c_scales=kcf["s"].reshape(flat_slots),
+                    r_scales=vcf["s"].reshape(flat_slots))
+            cache_spec = {"q": P(None, None, None, None),
+                          "s": P(None, None, None)}
+        else:
+            def run(qe1, qr1, kcf, vcf, lidx_, bt, lens):
+                return mla_paged_decode(
+                    qe1, qr1, kcf.reshape(flat_slots, r),
+                    vcf.reshape(flat_slots, pr), bt + lidx_ * nb, lens,
+                    block_size=block_size, scale=scale)
+            cache_spec = P(None, None, None, None)
 
         if mesh is not None:  # heads on tp; latent cache is replicated
             run = jax.shard_map(
                 run, mesh=mesh,
                 in_specs=(P("dp", "tp", None), P("dp", "tp", None),
-                          P(None, None, None, None), P(None, None, None, None),
+                          cache_spec, cache_spec,
                           P(), P("dp", None), P("dp")),
                 out_specs=P("dp", "tp", None), check_vma=False)
         o_lat = run(q_eff[:, 0], qr_pad, kc, vc, lidx, block_tables,
@@ -527,15 +561,20 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
         T = W * block_size
         slot_idx = (block_tables[:, :, None] * block_size
                     + jnp.arange(block_size)[None, None, :]).reshape(B, T)
-        cg = kc[lidx, slot_idx][:, :, 0]   # [B,T,r]  cache dtype
-        krg = vc[lidx, slot_idx][:, :, 0]  # [B,T,pr] (rope, padded)
+        # gather_pages dequantizes int8 caches to f32 in the gather (the
+        # shared contract for every XLA-level attention read — cache.py);
+        # plain caches come back in cache dtype
+        from dynamo_tpu.engine.cache import gather_pages
+
+        cg = gather_pages(kc, lidx, slot_idx)[:, :, 0]   # [B,T,r]
+        krg = gather_pages(vc, lidx, slot_idx)[:, :, 0]  # [B,T,pr] (padded)
         if use_flash and S > 1:
             # flash prefill in latent space: online softmax, no [B,H,S,T]
             # HBM score tensor (the r2 verdict's DeepSeek-at-8k failure
             # mode); only the quadratic part moves into the kernel
             from dynamo_tpu.ops.flash_prefill import flash_mla_prefill
 
-            dt = kc.dtype
+            dt = cg.dtype  # cache dtype; f32 for dequantized int8 gathers
             qr_pad = jnp.pad(q_rot, ((0, 0), (0, 0), (0, 0), (0, pr - dr)))
             fn = functools.partial(flash_mla_prefill,
                                    scale=mla_softmax_scale(cfg))

@@ -345,15 +345,28 @@ def _mla_decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
                        qe_ref,  # [1, H, R] VMEM (scale folded in)
                        qr_ref,  # [1, H, PR] VMEM
                        ccache_ref, rcache_ref,  # [slots, R] / [slots, PR] HBM
-                       out_ref,  # [1, H, R] VMEM
-                       cbuf, rbuf, dma_sem,  # [D, bs, R] / [D, bs, PR] / [D,2]
-                       *, bs: int):
+                       *rest,  # [csc_ref, rsc_ref (VMEM [slots, 1]),]
+                               # out_ref, cbuf, rbuf, dma_sem
+                       bs: int, quant: bool = False):
     """MLA is simpler than GQA here: every head attends over the SAME single
     latent page, so no block-expansion trick is needed — scores are
     q_eff·c + q_rot·rope (both lane-aligned MXU matmuls) and the VALUE is
-    the latent itself; W_UV absorption happens outside."""
+    the latent itself; W_UV absorption happens outside.
+
+    int8 pages (``quant``): the per-slot scales are ONE f32 per key,
+    lane-packed [rows, 128] and VMEM-resident (no scale DMAs — the GQA
+    lesson); callers gate on mla_int8_kernel_supported (VMEM budget +
+    bs | 128) and fall back to the XLA gather path past it. Score parts
+    dequant separately (c and rope carry different scales); the value
+    dequant folds into p."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if quant:
+        csc_ref, rsc_ref, out_ref, cbuf, rbuf, dma_sem = rest
+    else:
+        out_ref, cbuf, rbuf, dma_sem = rest
+        csc_ref = rsc_ref = None
 
     b = pl.program_id(0)
     kv_len = kv_lens_ref[b]
@@ -389,13 +402,27 @@ def _mla_decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
         wait_dma(w)
         cpage = cbuf[w % D].astype(jnp.float32)  # [bs, R]
         rpage = rbuf[w % D].astype(jnp.float32)  # [bs, PR]
+        if quant:
+            blk = block_tables_ref[b, w]
+            # scales are LANE-PACKED [rows, 128] (a [slots, 1] block would
+            # tile-pad the lane dim 1→128, inflating VMEM 128×); a page's
+            # bs scales sit inside one row because bs divides 128
+            off = blk * bs
+            csc = csc_ref[off // _LANE, pl.ds(off % _LANE, bs)].reshape(1, bs)
+            rsc = rsc_ref[off // _LANE, pl.ds(off % _LANE, bs)].reshape(1, bs)
 
-        s = jax.lax.dot_general(
+        sc = jax.lax.dot_general(
             qe, cpage, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [H, bs]
-        s = s + jax.lax.dot_general(
+        sr = jax.lax.dot_general(
             qr, rpage, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if quant:
+            # the two score parts carry DIFFERENT quant scales — dequant
+            # each before summing
+            s = sc * csc + sr * rsc
+        else:
+            s = sc + sr
 
         key_pos = w * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
         s = jnp.where(key_pos < kv_len, s, _NEG)  # MLA: full attention
@@ -405,9 +432,10 @@ def _mla_decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
         corr = jnp.exp(m - new_m)
         p = jnp.exp(s - new_m)
         new_l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        # value IS the latent; its dequant folds into p (per-key scale)
         pv = jax.lax.dot_general(
-            p, cpage, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [H, R] — value IS the latent
+            p * csc if quant else p, cpage, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [H, R]
 
         @pl.when(w + D < num_pages)
         def _():
@@ -426,9 +454,22 @@ def mla_pallas_supported(kv_lora_rank: int, rope_cache_dim: int) -> bool:
     return kv_lora_rank % _LANE == 0 and rope_cache_dim % _LANE == 0
 
 
+def mla_int8_kernel_supported(block_size: int, flat_slots: int) -> bool:
+    """Whether the int8 latent kernel can take this cache: a page's scales
+    must sit in one lane row (bs | 128) and both lane-packed scale arrays
+    must fit the VMEM budget (callers fall back to the XLA gather path
+    otherwise)."""
+    if _LANE % block_size:
+        return False
+    padded = -(-flat_slots // _LANE) * _LANE
+    budget = int(os.environ.get("DYN_KV_SCALE_VMEM_BYTES", 6 << 20))
+    return 2 * padded * 4 <= budget
+
+
 def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
                      kv_lens, *, block_size: int, scale: float,
-                     interpret: bool = False):
+                     interpret: bool = False,
+                     c_scales=None, r_scales=None):
     """MLA decode over the paged latent cache.
 
     q_eff [B,H,R] (queries absorbed through W_UK), q_rot [B,H,PR] (post-rope
@@ -437,6 +478,10 @@ def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
     [B,H,R] (caller expands through W_UV). ``scale`` is the softmax scale
     (incl. YaRN mscale² — engine/model.mla_softmax_scale), folded into the
     queries here.
+
+    ``c_scales``/``r_scales`` [slots] f32 (int8 caches): pages are int8 and
+    dequantize in the kernel; scales ride lane-packed in VMEM (no scale
+    DMAs). Callers must check :func:`mla_int8_kernel_supported` first.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -444,6 +489,7 @@ def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
     B, H, R = q_eff.shape
     PR = q_rot.shape[-1]
     bs = block_size
+    quant = c_scales is not None
     interpret = interpret or jax.default_backend() != "tpu"
 
     qe = (q_eff.astype(jnp.float32) * scale).astype(q_eff.dtype)
@@ -451,16 +497,34 @@ def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
 
     W = block_tables.shape[1]
     D = min(W, 8)  # VMEM: D·bs·(R+PR)·dtype bytes in flight
-    kernel = functools.partial(_mla_decode_kernel, bs=bs)
+    slots = latent_cache.shape[0]
+    kernel = functools.partial(_mla_decode_kernel, bs=bs, quant=quant)
+    in_specs = [
+        pl.BlockSpec((1, H, R), lambda b, *_: (b, 0, 0)),
+        pl.BlockSpec((1, H, PR), lambda b, *_: (b, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.HBM),
+        pl.BlockSpec(memory_space=pltpu.HBM),
+    ]
+    operands = [latent_cache, rope_cache]
+    if quant:
+        # constant block index → fetched once, resident for the whole grid.
+        # LANE-PACKED [rows, 128] so VMEM holds slots×4 bytes, not ×512
+        # (a [slots, 1] block would pad its lane dim 1→128); callers gate
+        # on mla_int8_kernel_supported for the budget + bs|128 invariants
+        padded = -(-slots // _LANE) * _LANE
+        rows = padded // _LANE
+
+        def lane_pack(s):
+            s = s.astype(jnp.float32)
+            return jnp.pad(s, (0, padded - slots)).reshape(rows, _LANE)
+
+        in_specs += [pl.BlockSpec((rows, _LANE), lambda b, *_: (0, 0)),
+                     pl.BlockSpec((rows, _LANE), lambda b, *_: (0, 0))]
+        operands += [lane_pack(c_scales), lane_pack(r_scales)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, H, R), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec((1, H, PR), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, R), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((D, bs, R), latent_cache.dtype),
@@ -473,4 +537,4 @@ def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, R), q_eff.dtype),
         interpret=interpret,
-    )(block_tables, kv_lens, qe, qr, latent_cache, rope_cache)
+    )(block_tables, kv_lens, qe, qr, *operands)

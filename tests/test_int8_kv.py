@@ -397,3 +397,102 @@ def test_decode_pallas_int8_both_scale_placements_match(monkeypatch):
                                rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(np.asarray(out_dma), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
+
+
+def _mla_cfg():
+    from dynamo_tpu.engine.config import ModelConfig
+
+    return ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=4, dtype="float32",
+        max_position_embeddings=256,
+        kv_lora_rank=128, q_lora_rank=None, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16)
+
+
+def test_mla_int8_cache_matches_bf16_paths():
+    """MLA latent caches now quantize too: prefill (gather dequant), XLA
+    decode, and the Pallas latent kernel (VMEM-resident per-slot scales)
+    must all track the full-precision cache within int8 tolerance."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.cache import allocate_device_cache
+    from dynamo_tpu.engine.model import forward, init_params
+    from tests.test_mla import _paged_inputs
+
+    cfg = _mla_cfg()
+    params = init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+    row = [5, 9, 17, 23, 42, 77, 101, 3, 54]
+    (tokens, positions, slot_map, bt, kv_lens, last_idx,
+     num_blocks) = _paged_inputs(cfg, [row])
+
+    outs, caches = {}, {}
+    for name, dtype in (("f32", jnp.float32), ("int8", "int8")):
+        kc, vc = allocate_device_cache(cfg, num_blocks, 4, dtype=dtype)
+        logits, kc, vc = forward(params, tokens, positions, slot_map, bt,
+                                 kv_lens, last_idx, kc, vc, cfg=cfg,
+                                 block_size=4)
+        outs[name] = np.asarray(logits)
+        caches[name] = (kc, vc)
+    # prefill logits: int8 cache only affects ATTENTION reads of cached
+    # tokens; tolerance is the int8 quant noise floor
+    np.testing.assert_allclose(outs["int8"], outs["f32"], atol=0.1, rtol=0.1)
+
+    # one decode step: XLA gather path and Pallas latent kernel on the
+    # SAME int8 cache must agree with each other tightly, and with f32
+    # within quant noise
+    tok = jnp.asarray([[61]], jnp.int32)
+    pos = jnp.asarray([[9]], jnp.int32)
+    slot = jnp.asarray([[int(bt[0, 2]) * 4 + 1]], jnp.int32)
+    lens = jnp.asarray([10], jnp.int32)
+    li = jnp.asarray([0], jnp.int32)
+
+    dec = {}
+    for name, up in (("xla", False), ("pallas", True)):
+        kc, vc = jax.tree.map(jnp.copy, caches["int8"])
+        logits, _, _ = forward(params, tok, pos, slot, bt, lens, li, kc, vc,
+                               cfg=cfg, block_size=4, use_pallas=up)
+        dec[name] = np.asarray(logits)
+    np.testing.assert_allclose(dec["pallas"], dec["xla"], atol=2e-3, rtol=2e-3)
+
+    kc, vc = caches["f32"]
+    ref, _, _ = forward(params, tok, pos, slot, bt, lens, li, kc, vc,
+                        cfg=cfg, block_size=4)
+    np.testing.assert_allclose(dec["xla"], np.asarray(ref), atol=0.1, rtol=0.1)
+
+
+@pytest.mark.anyio
+async def test_mla_engine_serves_with_int8_kv():
+    """End-to-end: the engine no longer falls back to bf16 for MLA — an
+    int8-KV mla_tiny engine generates deterministically."""
+    from dynamo_tpu.engine.config import EngineArgs
+    from dynamo_tpu.models import get_model_config
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    cfg = get_model_config("mla_tiny")
+    args = EngineArgs(block_size=4, num_blocks=64, max_num_seqs=4,
+                      max_num_batched_tokens=64, max_model_len=64,
+                      kv_cache_dtype="int8")
+    eng = AsyncJaxEngine(cfg, args)
+    assert eng._kv_quant, "MLA int8 KV must not silently fall back"
+
+    async def run():
+        req = PreprocessedRequest(
+            model="m", token_ids=[3, 1, 4, 1, 5, 9, 2, 6],
+            sampling_options=SamplingOptions(temperature=0.0),
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True))
+        toks = []
+        async for out in eng.generate(req):
+            toks.extend(out.token_ids or [])
+            if out.finish_reason is not None:
+                break
+        return toks
+
+    a = await run()
+    b = await run()
+    assert len(a) == 6 and a == b  # deterministic greedy under int8 KV
+    await eng.close()
