@@ -111,7 +111,8 @@ async def start_worker(runtime, out: str, cli):
     eargs = EngineArgs(multi_step_decode=cli.multi_step_decode,
                        speculative_tokens=cli.speculative_tokens,
                        use_pallas_attention=cli.use_pallas_attention,
-                       quantization=cli.quantization)
+                       quantization=cli.quantization,
+                       kv_cache_dtype=cli.kv_cache_dtype)
     guided_vocab = None
     if tokenizer_ref:
         from dynamo_tpu.llm.tokenizer import load_guided_vocab
@@ -283,6 +284,10 @@ async def amain():
     ap.add_argument("--quantization", default=None,
                     help="on-device weight quantization: int8 | int8-gN | "
                          "int4-gN (weights stay quantized in HBM)")
+    ap.add_argument("--kv-cache-dtype", default=None,
+                    help="int8 = quantized paged KV cache (per-(slot,head) "
+                         "scales, dequant in the attention kernels; GQA "
+                         "and MLA latent caches both supported)")
     ap.add_argument("--vocab-size", type=int, default=0,
                     help="mocker vocab size (out=mocker only)")
     ap.add_argument("--input-file", default=None,
