@@ -245,3 +245,61 @@ async def test_planner_connector_drives_controller_end_to_end():
         await ctrl.stop()
         await client.close()
         await server.stop()
+
+
+async def test_sla_planner_scales_pods_through_api_end_to_end():
+    """The whole L7 loop over real HTTP: traffic observations → SLA planner
+    Decision → API merge patch on the CRD → controller watch → pods. The
+    reference's planner→operator→pods contract
+    (ref: components/planner + deploy/cloud/operator), one process."""
+    from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+    from dynamo_tpu.planner.planner_core import (
+        Observation, Planner, PlannerConfig,
+    )
+
+    server, client = await _env()
+    crs = client.resource(GROUP, VERSION, "default", PLURAL)
+    pods = client.resource("", "v1", "default", "pods")
+    ctrl = await DynamoGraphController(client).start()
+    try:
+        await crs.create(graph_cr(prefill=1, decode=1))
+        conn = ApiKubernetesConnector(client, "g1")
+        planner = Planner(
+            PlannerConfig(ttft_sla_ms=200.0, itl_sla_ms=20.0,
+                          scale_down_patience=1),
+            prefill_perf=PerfInterpolator(
+                points=[[1.0, 100.0], [2.0, 180.0], [4.0, 400.0]]),
+            decode_perf=PerfInterpolator(
+                points=[[500.0, 10.0], [1000.0, 18.0], [2000.0, 45.0]]))
+
+        # sustained heavy traffic → fleet must grow
+        for _ in range(4):
+            planner.observe(Observation(request_rate=40.0, isl=1000, osl=64))
+        heavy = planner.compute()
+        assert heavy.prefill_replicas > 1 and heavy.decode_replicas > 1
+        await conn.apply(heavy)
+
+        async def n_pods(want):
+            async def check():
+                lst = await pods.list(label_selector=f"{LABEL_GRAPH}=g1")
+                return len(lst["items"]) == want or None
+            return check
+        await _wait(await n_pods(heavy.prefill_replicas + heavy.decode_replicas),
+                    msg="scale-up pods")
+
+        # traffic collapses → fleet shrinks (patience=1)
+        for _ in range(6):
+            planner.observe(Observation(request_rate=0.2, isl=200, osl=16))
+            light = planner.compute()
+        assert light.prefill_replicas < heavy.prefill_replicas
+        await conn.apply(light)
+        await _wait(await n_pods(light.prefill_replicas + light.decode_replicas),
+                    msg="scale-down pods")
+        # CRD spec reflects the last applied decision
+        assert await conn.read_replicas() == {
+            "prefill": light.prefill_replicas,
+            "decode": light.decode_replicas}
+    finally:
+        await ctrl.stop()
+        await client.close()
+        await server.stop()
