@@ -849,9 +849,12 @@ class AsyncJaxEngine:
                     mm_mask = np.zeros((B, S), bool)
                 mm_vec[i], mm_mask[i] = mm[0][0], mm[1][0]
 
-        operands = {"tokens": tokens, "positions": positions,
-                    "slot_map": slot_map, "block_tables": bt,
-                    "kv_lens": kv_lens, "last_idx": last_idx}
+        # packed operands: 3 transfers per prefill step instead of 6 (the
+        # burst-packing pattern; ~12 ms per small put over a tunneled chip)
+        ints3 = np.stack([tokens, positions, slot_map], axis=1)
+        lens_last = np.stack([kv_lens, last_idx], axis=1)
+        operands = {"ints3": ints3, "lens_last": lens_last,
+                    "block_tables": bt}
         if mm_vec is not None:
             operands["mm_vec"], operands["mm_mask"] = mm_vec, mm_mask
             kind, fn = "step_mm", self._get_step_mm_fn()
@@ -1048,12 +1051,11 @@ class AsyncJaxEngine:
             bt[i, :n] = s.block_table[:n]
             kv_lens[i] = len(s.tokens) + K
 
-        self._broadcast("verify", tokens=tokens, positions=positions,
-                        slot_map=slot_map, block_tables=bt, kv_lens=kv_lens)
+        ints3 = np.stack([tokens, positions, slot_map], axis=1)
+        self._broadcast("verify", ints3=ints3, block_tables=bt,
+                        kv_lens=kv_lens)
         ids, lps, self.k_cache, self.v_cache = self.verify_fn(
-            self.params, self._put_batch("tokens", tokens),
-            self._put_batch("positions", positions),
-            self._put_batch("slot_map", slot_map),
+            self.params, self._put_batch("ints3", ints3),
             self._put_batch("block_tables", bt),
             self._put_batch("kv_lens", kv_lens),
             self.k_cache, self.v_cache)
@@ -1149,17 +1151,15 @@ class AsyncJaxEngine:
             bt[i, :n] = s.block_table[:n]
             kv_lens[i] = len(s.tokens)
 
-        self._broadcast("step", tokens=tokens, positions=positions,
-                        slot_map=slot_map, block_tables=bt, kv_lens=kv_lens,
-                        last_idx=last_idx)
+        ints3 = np.stack([tokens, positions, slot_map], axis=1)
+        lens_last = np.stack([kv_lens, last_idx], axis=1)
+        self._broadcast("step", ints3=ints3, lens_last=lens_last,
+                        block_tables=bt)
         self.param_reads += 1
         logits, self.k_cache, self.v_cache = self.step_fn(
-            self.params, self._put_batch("tokens", tokens),
-            self._put_batch("positions", positions),
-            self._put_batch("slot_map", slot_map),
+            self.params, self._put_batch("ints3", ints3),
+            self._put_batch("lens_last", lens_last),
             self._put_batch("block_tables", bt),
-            self._put_batch("kv_lens", kv_lens),
-            self._put_batch("last_idx", last_idx),
             self.k_cache, self.v_cache)
 
         toks, logps, tops = await self._sample(seqs, logits)

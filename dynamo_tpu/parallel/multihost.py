@@ -47,16 +47,16 @@ STEP_STREAM_PREFIX = "mh_steps/{namespace}/"
 #: the follower's replay, and the engine's dispatch must agree or the fleet
 #: silently desyncs
 STEP_KEYS = {
-    "step": ("tokens", "positions", "slot_map", "block_tables", "kv_lens",
-             "last_idx"),
-    # packed layout (see model.make_multi_decode_fn): ints [B,4] i32 =
+    # packed layouts (model.make_step_fn / make_multi_decode_fn /
+    # make_verify_fn): ints3 [B,3,S] i32 = tokens/positions/slot_map,
+    # lens_last [B,2] i32 = kv_lens/last_idx, ints [B,4] i32 =
     # last_tokens/positions/kv_lens/top_k, floats [B,2] f32 = temp/top_p,
     # rand [B,2] u32 = seeds/step0
+    "step": ("ints3", "lens_last", "block_tables"),
     "multi": ("ints", "floats", "rand", "block_tables"),
-    "verify": ("tokens", "positions", "slot_map", "block_tables", "kv_lens"),
+    "verify": ("ints3", "block_tables", "kv_lens"),
     "draft": ("last_tokens", "positions", "block_tables", "kv_lens"),
-    "step_mm": ("tokens", "positions", "slot_map", "block_tables", "kv_lens",
-                "last_idx", "mm_vec", "mm_mask"),
+    "step_mm": ("ints3", "lens_last", "block_tables", "mm_vec", "mm_mask"),
     "embed": ("tokens", "lengths"),
 }
 

@@ -238,12 +238,17 @@ def make_pp_step_fn(cfg: ModelConfig, block_size: int, mesh: Mesh,
     model.make_step_fn — the lm head is tp-sharded otherwise)."""
     from jax.sharding import NamedSharding
 
-    f = functools.partial(pp_forward, cfg=cfg, block_size=block_size,
+    def f(params, ints3, lens_last, block_tables, k_cache, v_cache):
+        # packed layout shared with model.make_step_fn (drop-in contract)
+        return pp_forward(params, ints3[:, 0], ints3[:, 1], ints3[:, 2],
+                          block_tables, lens_last[:, 0], lens_last[:, 1],
+                          k_cache, v_cache, cfg=cfg, block_size=block_size,
                           mesh=mesh, num_microbatches=num_microbatches)
+
     kw = {}
     if replicate_logits:
         from dynamo_tpu.engine.model import cache_shardings
 
         csh = cache_shardings(mesh, cfg)
         kw["out_shardings"] = (NamedSharding(mesh, P()), csh, csh)
-    return jax.jit(f, donate_argnums=(7, 8), **kw)
+    return jax.jit(f, donate_argnums=(4, 5), **kw)

@@ -151,10 +151,12 @@ def test_kernels_under_mesh_shard_map(which):
     last_idx = np.full((B,), S - 1, np.int32)
 
     def run(step_fn):
+        # packed step layout (model.make_step_fn)
+        ints3 = jnp.asarray(np.stack([tokens, positions, slot_map], axis=1))
+        lens_last = jnp.asarray(np.stack([kv_lens, last_idx], axis=1))
         logits, kc2, vc2 = step_fn(
-            params, jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(slot_map), jnp.asarray(bt), jnp.asarray(kv_lens),
-            jnp.asarray(last_idx), jnp.array(kc), jnp.array(vc))
+            params, ints3, lens_last, jnp.asarray(bt),
+            jnp.array(kc), jnp.array(vc))
         return np.asarray(logits)
 
     use_pallas = which == "decode"

@@ -331,7 +331,10 @@ def test_pp_decode_step_matches_dense():
     csh = Mo.cache_shardings(mesh, cfg)
     p_pp = jax.device_put(params, sh)
     step = make_pp_step_fn(cfg, block_size, mesh)
-    got, _, _ = step(p_pp, *dec, jax.device_put(kc, csh),
+    d_tok, d_pos, d_slot, d_bt, d_lens, d_last = dec
+    d_ints3 = jnp.stack([d_tok, d_pos, d_slot], axis=1)
+    d_ll = jnp.stack([d_lens, d_last], axis=1)
+    got, _, _ = step(p_pp, d_ints3, d_ll, d_bt, jax.device_put(kc, csh),
                      jax.device_put(vc, csh))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-5, rtol=1e-5)
