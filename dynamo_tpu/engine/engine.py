@@ -986,14 +986,11 @@ class AsyncJaxEngine:
             bt[i, :n] = s.block_table[:n]
             kv_lens[i] = len(s.tokens)
 
-        self._broadcast("draft", last_tokens=last_tokens,
-                        positions=positions, block_tables=bt,
-                        kv_lens=kv_lens)
+        ints = np.stack([last_tokens, positions, kv_lens], axis=1)
+        self._broadcast("draft", ints=ints, block_tables=bt)
         toks, self.k_cache, self.v_cache = self.draft_fn(
-            self.params, self._put_batch("last_tokens", last_tokens),
-            self._put_batch("positions", positions),
+            self.params, self._put_batch("ints", ints),
             self._put_batch("block_tables", bt),
-            self._put_batch("kv_lens", kv_lens),
             self.k_cache, self.v_cache)
         # draft forwards read draft_layers/num_layers of the weights
         self.param_reads += (K * args.speculative_draft_layers

@@ -1403,8 +1403,10 @@ def make_draft_fn(cfg: ModelConfig, block_size: int, draft_layers: int,
     cfg_d = dataclasses.replace(cfg, num_layers=draft_layers)
     decode_pallas, _ = _resolve_kernel_flags(cfg_d, mesh, use_pallas, False)
 
-    def f(params, last_tokens, positions, block_tables, kv_lens,
-          k_cache, v_cache):
+    def f(params, ints, block_tables, k_cache, v_cache):
+        # packed: ints [B,3] i32 = last_tokens/positions/kv_lens (2 puts
+        # per draft dispatch instead of 4 — see make_step_fn)
+        last_tokens, positions, kv_lens = ints[:, 0], ints[:, 1], ints[:, 2]
         pd = dict(params)
         pd["layers"] = jax.tree.map(lambda x: x[:draft_layers],
                                     params["layers"])
@@ -1424,7 +1426,7 @@ def make_draft_fn(cfg: ModelConfig, block_size: int, draft_layers: int,
         rep = NamedSharding(mesh, P())
         csh = cache_shardings(mesh, cfg, quant=kv_quant)
         kw["out_shardings"] = (rep, csh, csh)
-    return jax.jit(f, donate_argnums=(5, 6), **kw)
+    return jax.jit(f, donate_argnums=(3, 4), **kw)
 
 
 def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,

@@ -55,7 +55,7 @@ STEP_KEYS = {
     "step": ("ints3", "lens_last", "block_tables"),
     "multi": ("ints", "floats", "rand", "block_tables"),
     "verify": ("ints3", "block_tables", "kv_lens"),
-    "draft": ("last_tokens", "positions", "block_tables", "kv_lens"),
+    "draft": ("ints", "block_tables"),  # ints [B,3] = last_tokens/positions/kv_lens
     "step_mm": ("ints3", "lens_last", "block_tables", "mm_vec", "mm_mask"),
     "embed": ("tokens", "lengths"),
 }
