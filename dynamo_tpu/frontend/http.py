@@ -194,7 +194,30 @@ class HttpService:
         return web.json_response({"status": "live"})
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        self._refresh_router_metrics()
         return web.Response(text=self.metrics.render(), content_type="text/plain")
+
+    def _refresh_router_metrics(self) -> None:
+        """Snapshot per-model KV-router stream health into gauges at scrape
+        time (ref role: the reference's router metrics aggregation). A
+        nonzero gaps/resyncs rate is the operator's signal that the event
+        stream is outrunning its consumers (ring cap / hub sizing)."""
+        from dynamo_tpu.router.indexer import KvIndexer
+
+        for name, sm in self.manager.models.items():
+            idx = getattr(sm.router, "indexer", None) if sm.router else None
+            if not isinstance(idx, KvIndexer):
+                continue
+            for field in ("events_applied", "gaps_detected",
+                          "resyncs_requested", "snapshots_written"):
+                self.metrics.gauge(
+                    f"kv_router_{field}",
+                    "KV event stream health").set(getattr(idx, field),
+                                                  model=name)
+            self.metrics.gauge(
+                "kv_router_orphan_events",
+                "stored events dropped for unknown parents").set(
+                    idx.tree.orphan_events, model=name)
 
     async def handle_embeddings(self, request: web.Request) -> web.Response:
         """OpenAI embeddings (ref: openai.rs:714): tokenize each input via
