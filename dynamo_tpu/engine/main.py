@@ -327,6 +327,20 @@ async def amain():
     engine.metrics_cb = WorkerMetricsPublisher(
         runtime.plane, worker_id=lease).publish_sync
 
+    # step-trace phases on the worker's own /metrics (DYN_SYSTEM_PORT):
+    # per-kind steps/tokens/mean wall — the first scrape to read when e2e
+    # throughput sits far below the kernel ceiling (r4 lesson)
+    def _trace_cb(field):
+        def cb():
+            return {(("kind", kind),): v[field]
+                    for kind, v in engine.step_trace_summary().items()}
+        return cb
+
+    for fld in ("steps", "tokens", "mean_ms"):
+        runtime.metrics.gauge(
+            f"engine_step_{fld}",
+            "engine step trace (sliding window)").add_callback(_trace_cb(fld))
+
     component = cli.component or (
         "prefill" if cli.role == "prefill" else "backend")
     ns = runtime.namespace(cli.namespace)

@@ -8,6 +8,7 @@ exposition format at the frontend's ``/metrics``.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Optional
@@ -57,7 +58,9 @@ class Gauge:
             self._values[key] = value
 
     def add_callback(self, fn):
-        """fn() -> dict[labels-tuple-or-None, value]; called at scrape time."""
+        """fn() -> dict[labels, value] evaluated at scrape time; ``labels``
+        is None (no labels) or a TUPLE of (name, value) pairs — a dict
+        cannot key a dict, which the old contract implied."""
         self._callbacks.append(fn)
 
     def render(self) -> str:
@@ -66,9 +69,16 @@ class Gauge:
         for cb in self._callbacks:
             try:
                 for labels, v in cb().items():
-                    values[tuple(sorted((labels or {}).items()))] = v
+                    # keys must be None or ((name, value), ...) pairs — an
+                    # iterable of anything else (e.g. a bare string, whose
+                    # sort would silently yield characters) is a caller bug
+                    key = (() if labels is None else
+                           tuple(sorted((str(n), str(lv))
+                                        for n, lv in labels)))
+                    values[key] = v
             except Exception:
-                pass
+                logging.getLogger("dynamo.metrics").exception(
+                    "gauge %s scrape callback failed", self.name)
         for key, v in sorted(values.items()):
             lines.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return "\n".join(lines)

@@ -263,3 +263,19 @@ def test_trace_replay_blocks_are_shared_and_deterministic():
     from collections import Counter
     first_blocks = Counter(tuple(r["hash_ids"][:1]) for r in tr)
     assert max(first_blocks.values()) > 1
+
+
+def test_gauge_scrape_callbacks_with_labels():
+    """Scrape-time gauge callbacks carry labeled samples (the engine's
+    step-trace wiring in engine/main relies on this)."""
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    m = MetricsRegistry()
+    g = m.gauge("engine_step_mean_ms", "x")
+    state = {"decode": 12.5, "prefill": 230.0}
+    g.add_callback(lambda: {(("kind", k),): v for k, v in state.items()})
+    out = m.render()
+    assert 'dynamo_engine_step_mean_ms{kind="decode"} 12.5' in out
+    assert 'dynamo_engine_step_mean_ms{kind="prefill"} 230.0' in out
+    state["decode"] = 99.0  # live: re-evaluated per scrape
+    assert 'kind="decode"} 99.0' in m.render()
