@@ -83,6 +83,28 @@ class ModelConfig:
     #: (gpt-oss's router has a true bias; DeepSeek's e_score_correction_bias
     #: only steers expert CHOICE and is handled in the sigmoid branch)
     router_logit_bias: bool = False
+    # --- Gemma family -----------------------------------------------------
+    #: scale token embeddings by sqrt(hidden_size) (Gemma; NOT folded into
+    #: the weights — the tied lm_head reads them unscaled)
+    embed_scale: bool = False
+    #: RMSNorm scales by (1 + w) (Gemma); folded into the stored weights at
+    #: LOAD time (loader.norm_get), so the forward never branches on it
+    norm_plus_one: bool = False
+    #: dense-MLP activation: "silu" (llama-family SwiGLU) or "gelu_tanh"
+    #: (Gemma GeGLU). Distinct from moe_activation.
+    hidden_activation: str = "silu"
+    #: Gemma-2 soft capping: s = cap·tanh(s/cap) on attention scores and on
+    #: final logits; 0 = off. Nonzero attn cap forces the XLA attention
+    #: path (the Pallas kernels' online softmax has no tanh stage).
+    attn_logit_softcap: float = 0.0
+    final_logit_softcap: float = 0.0
+    #: Gemma-2 sandwich norms: post-norms applied to each sublayer's OUTPUT
+    #: before the residual add (extra per-layer weights post_attn_norm /
+    #: post_mlp_norm; mlp_norm holds pre_feedforward_layernorm)
+    sandwich_norms: bool = False
+    #: attention scale = query_pre_attn_scalar^-0.5 instead of head_dim^-0.5
+    #: (Gemma-2; folded into q so every attention path inherits it)
+    query_pre_attn_scalar: Optional[float] = None
     # --- MLA (multi-head latent attention, DeepSeek V2/V3) ---------------
     #: latent rank of the compressed KV; >0 switches attention to MLA and
     #: the paged cache to the latent layout (see kv_cache_spec)
@@ -158,6 +180,12 @@ class ModelConfig:
         arch = (d.get("architectures") or [""])[0].lower()
         is_deepseek = "deepseek" in arch
         is_gpt_oss = "gptoss" in arch
+        is_gemma2 = "gemma2" in arch
+        is_gemma = "gemma" in arch  # gemma-1 OR gemma-2
+        if "gemma3" in arch:
+            raise NotImplementedError(
+                "Gemma-3 (dual-base rope, plus-one qk-norm) is not "
+                "supported yet; Gemma 1/2 are")
         if "qwen3moe" in arch:
             # the uniform layer stack (lax.scan) requires every non-prefix
             # layer to be MoE; refuse irregular sparsity loudly rather than
@@ -169,6 +197,12 @@ class ModelConfig:
                     "stack, which the stacked-layer forward does not support")
         mla = is_deepseek and d.get("kv_lora_rank") is not None
         layer_windows = None
+        if is_gemma2:
+            # HF Gemma2: sliding attention on EVEN layer indices
+            # (Gemma2DecoderLayer: is_sliding = not bool(layer_idx % 2))
+            L = d.get("num_hidden_layers", 26)
+            w = d.get("sliding_window", 4096)
+            layer_windows = tuple(w if i % 2 == 0 else 0 for i in range(L))
         if is_gpt_oss:
             L = d.get("num_hidden_layers", 36)
             types = d.get("layer_types") or [
@@ -185,6 +219,16 @@ class ModelConfig:
             num_heads=d.get("num_attention_heads", 32),
             num_kv_heads=d.get("num_key_value_heads", d.get("num_attention_heads", 32)),
             head_dim=d.get("head_dim") if not is_deepseek else None,
+            embed_scale=is_gemma,
+            norm_plus_one=is_gemma,
+            hidden_activation=("gelu_tanh" if is_gemma else "silu"),
+            attn_logit_softcap=(d.get("attn_logit_softcapping") or 0.0)
+            if is_gemma2 else 0.0,
+            final_logit_softcap=(d.get("final_logit_softcapping") or 0.0)
+            if is_gemma2 else 0.0,
+            sandwich_norms=is_gemma2,
+            query_pre_attn_scalar=(d.get("query_pre_attn_scalar")
+                                   if is_gemma2 else None),
             rope_theta=d.get("rope_theta", 10000.0),
             rope_scaling=d.get("rope_scaling"),
             rms_norm_eps=d.get("rms_norm_eps", 1e-5),

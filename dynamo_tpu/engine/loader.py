@@ -310,10 +310,28 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
             out["ws_down"] = proj(f"{pre}.shared_experts.down_proj.weight")
         return out
 
+    def norm_get(name):
+        """Gemma RMSNorms scale by (1 + w); folding the +1 into the stored
+        weight at load keeps the forward's single-norm codepath (x̂·w)."""
+        w = get(name)
+        return w + 1 if cfg.norm_plus_one else w
+
     def norm_layer(i: int) -> dict:
+        if cfg.sandwich_norms:
+            # Gemma-2: post_attention_layernorm is the POST-norm on the
+            # attention OUTPUT; the pre-MLP norm is pre_feedforward_layernorm
+            return {
+                "attn_norm": norm_get(f"model.layers.{i}.input_layernorm.weight"),
+                "post_attn_norm": norm_get(
+                    f"model.layers.{i}.post_attention_layernorm.weight"),
+                "mlp_norm": norm_get(
+                    f"model.layers.{i}.pre_feedforward_layernorm.weight"),
+                "post_mlp_norm": norm_get(
+                    f"model.layers.{i}.post_feedforward_layernorm.weight"),
+            }
         return {
-            "attn_norm": get(f"model.layers.{i}.input_layernorm.weight"),
-            "mlp_norm": get(f"model.layers.{i}.post_attention_layernorm.weight"),
+            "attn_norm": norm_get(f"model.layers.{i}.input_layernorm.weight"),
+            "mlp_norm": norm_get(f"model.layers.{i}.post_attention_layernorm.weight"),
         }
 
     k_dense = cfg.num_dense_prefix_layers
@@ -329,7 +347,7 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
     params = {
         "embed": get("model.embed_tokens.weight"),
         "layers": build_stack(range(k_dense, L), cfg.is_moe),
-        "final_norm": get("model.norm.weight"),
+        "final_norm": norm_get("model.norm.weight"),
     }
     if k_dense:
         params["dense_layers"] = build_stack(range(k_dense), False)

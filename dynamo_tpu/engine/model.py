@@ -72,6 +72,9 @@ def _init_layer_stack(cfg: ModelConfig, key: jax.Array, n: int, moe: bool,
         "attn_norm": jnp.ones((n, D), dtype),
         "mlp_norm": jnp.ones((n, D), dtype),
     }
+    if cfg.sandwich_norms:  # Gemma-2 post-norms on sublayer outputs
+        layers["post_attn_norm"] = jnp.ones((n, D), dtype)
+        layers["post_mlp_norm"] = jnp.ones((n, D), dtype)
     if cfg.is_mla:
         r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
         dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
@@ -166,6 +169,9 @@ def _layer_stack_shardings(cfg: ModelConfig, mesh: Mesh, moe: bool,
         "attn_norm": ns(None, None),
         "mlp_norm": ns(None, None),
     }
+    if cfg.sandwich_norms:  # Gemma-2 post-norms replicate like the others
+        layers["post_attn_norm"] = ns(None, None)
+        layers["post_mlp_norm"] = ns(None, None)
     if cfg.is_mla:
         # heads shard on tp via the H-major output dims; latent-rank
         # projections (q_a / kv_a) replicate — they are small and shared
@@ -417,6 +423,11 @@ def _paged_attention(q, k_cache, v_cache, lidx, block_tables, positions,
     qg = q.reshape(B, S, KV, G, hd)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg.astype(jnp.float32), k.astype(jnp.float32))
     scores = scores / np.sqrt(hd)
+    if cfg.attn_logit_softcap:
+        # Gemma-2 attention capping — BEFORE masking (HF applies it to raw
+        # scores; the -inf mask must stay -inf, not tanh-squashed)
+        c = cfg.attn_logit_softcap
+        scores = jnp.tanh(scores / c) * c
 
     key_pos = jnp.arange(T)
     q_pos = positions  # [B, S]
@@ -608,8 +619,10 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
     return out.reshape(B, S, H * dv).astype(h.dtype), kc, vc
 
 
-def _mlp_dense(x, lp):
-    h = jax.nn.silu(_mm(x, lp["w_gate"])) * _mm(x, lp["w_up"])
+def _mlp_dense(x, lp, act: str = "silu"):
+    g = _mm(x, lp["w_gate"])
+    g = jax.nn.gelu(g, approximate=True) if act == "gelu_tanh" else jax.nn.silu(g)
+    h = g * _mm(x, lp["w_up"])
     return _mm(h, lp["w_down"])
 
 
@@ -908,6 +921,10 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
     kv_quant = is_quant_cache(k_cache)
 
     x = params["embed"][tokens]  # [B,S,D]
+    if cfg.embed_scale:
+        # Gemma: embeddings scale by sqrt(D); NOT folded into the weights
+        # (the tied lm_head reads them unscaled)
+        x = x * jnp.asarray(np.sqrt(D), x.dtype)
     if mm_vec is not None:
         # multimodal: positions under mm_mask take externally-provided
         # embeddings (llava-style placeholder substitution)
@@ -954,6 +971,11 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             k = _rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
         q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        if cfg.query_pre_attn_scalar is not None:
+            # Gemma-2: score scale is qpas^-0.5, not hd^-0.5; every path
+            # below folds hd^-0.5, so pre-scale q by sqrt(hd/qpas)
+            q = q * jnp.asarray(
+                np.sqrt(hd / cfg.query_pre_attn_scalar), q.dtype)
 
         flat_slots = slot_map.reshape(B * S)
         if kv_quant:
@@ -991,8 +1013,10 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         ring_ok = (ring_want and dp_ok and S % sp_n == 0
                    and H % tp_n == 0 and KV % tp_n == 0
                    and (H // tp_n) % max(1, KV // tp_n) == 0
-                   # per-layer windows / sink logits: XLA path only
-                   and cfg.layer_windows is None and not cfg.attention_sinks)
+                   # per-layer windows / sink logits / score softcaps:
+                   # XLA path only
+                   and cfg.layer_windows is None and not cfg.attention_sinks
+                   and not cfg.attn_logit_softcap)
         if ring_want and not ring_ok:
             _logger.warning(
                 "ring prefill bypassed: S=%d B=%d not divisible by "
@@ -1064,9 +1088,13 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             attn = _paged_attention(q, kc, vc, lidx, block_tables, positions,
                                     kv_lens, cfg, block_size, window=window,
                                     sinks=lp.get("sink"))
-        x = x + _mm(attn.reshape(B, S, H * hd), lp["wo"])
+        attn_out = _mm(attn.reshape(B, S, H * hd), lp["wo"])
         if "bo" in lp:
-            x = x + lp["bo"]
+            attn_out = attn_out + lp["bo"]
+        if cfg.sandwich_norms:  # Gemma-2: post-norm on the sublayer OUTPUT
+            attn_out = _rms_norm(attn_out, lp["post_attn_norm"],
+                                 cfg.rms_norm_eps)
+        x = x + attn_out
         return _mlp_epilogue(x, kc, vc, lp, moe)
 
     def _mlp_epilogue(x, kc, vc, lp, moe):
@@ -1104,7 +1132,10 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                                        "w_up": lp["ws_up"],
                                        "w_down": lp["ws_down"]})
         else:
-            x = x + _mlp_dense(h, lp)
+            out = _mlp_dense(h, lp, act=cfg.hidden_activation)
+            if cfg.sandwich_norms:  # Gemma-2 post-norm on the MLP output
+                out = _rms_norm(out, lp["post_mlp_norm"], cfg.rms_norm_eps)
+            x = x + out
         return (x, kc, vc), None
 
     k_dense = cfg.num_dense_prefix_layers
@@ -1123,11 +1154,19 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         return x.astype(jnp.float32), k_cache, v_cache
     head = (params["embed"].T if cfg.tie_word_embeddings
             else params["lm_head"])
+
+    def _cap(lg):
+        # Gemma-2 final softcapping (HF: cap·tanh(logits/cap))
+        if not cfg.final_logit_softcap:
+            return lg
+        c = cfg.final_logit_softcap
+        return jnp.tanh(lg / c) * c
+
     if all_logits:  # speculative verification reads every position
-        return _mm(x, head).astype(jnp.float32), k_cache, v_cache
+        return _cap(_mm(x, head).astype(jnp.float32)), k_cache, v_cache
     x_last = x[jnp.arange(B), last_idx]  # [B, D]
-    logits = _mm(x_last, head)
-    return logits.astype(jnp.float32), k_cache, v_cache
+    logits = _cap(_mm(x_last, head).astype(jnp.float32))
+    return logits, k_cache, v_cache
 
 
 def verify_forward(params, tokens, positions, slot_map, block_tables,
@@ -1295,6 +1334,10 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
                 and cfg.num_heads % cfg.num_kv_heads == 0)
     # both kernels handle sliding windows (incl. per-layer gpt-oss
     # windows) and attention sinks
+    if cfg.attn_logit_softcap:
+        # Gemma-2 score capping (cap·tanh(s/cap)) has no stage in the
+        # kernels' online softmax — XLA attention path only
+        return False, False
     decode_pallas = (use_pallas and heads_ok
                      and pallas_supported(cfg.num_kv_heads // tp, cfg.head_dim))
     if use_flash_prefill is None:  # auto: on-TPU, or wherever pallas is asked
@@ -1400,7 +1443,12 @@ def make_draft_fn(cfg: ModelConfig, block_size: int, draft_layers: int,
     if not 0 < draft_layers <= cfg.num_layers:
         raise ValueError(
             f"draft_layers={draft_layers} outside (0, {cfg.num_layers}]")
-    cfg_d = dataclasses.replace(cfg, num_layers=draft_layers)
+    # per-layer windows must shrink WITH the stack or __post_init__'s
+    # length check rejects the draft config (gpt-oss / Gemma-2)
+    cfg_d = dataclasses.replace(
+        cfg, num_layers=draft_layers,
+        layer_windows=(cfg.layer_windows[:draft_layers]
+                       if cfg.layer_windows is not None else None))
     decode_pallas, _ = _resolve_kernel_flags(cfg_d, mesh, use_pallas, False)
 
     def f(params, ints, block_tables, k_cache, v_cache):

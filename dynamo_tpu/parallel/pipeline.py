@@ -58,6 +58,12 @@ def pp_compatible(cfg: ModelConfig, pp: int) -> Optional[str]:
         return "pp needs a uniform layer stack"
     if cfg.num_layers % pp:
         return f"num_layers={cfg.num_layers} not divisible by pp={pp}"
+    if (cfg.embed_scale or cfg.sandwich_norms or cfg.final_logit_softcap
+            or cfg.attn_logit_softcap or cfg.query_pre_attn_scalar is not None
+            or cfg.hidden_activation != "silu"):
+        # the pp mirror of model.forward implements none of the Gemma
+        # deviations — serving would be silently wrong, so refuse loudly
+        return "pp does not implement Gemma-family semantics yet"
     return None
 
 

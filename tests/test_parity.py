@@ -184,3 +184,64 @@ def test_qwen3_moe_irregular_sparsity_refused():
         ModelConfig.from_hf_config({
             "architectures": ["Qwen3MoeForCausalLM"],
             "num_experts": 4, "decoder_sparse_step": 2})
+
+
+def test_gemma_parity(tmp_path):
+    """Gemma-1: (1+w) RMSNorms (folded at load), sqrt(D) embedding scale,
+    GeGLU MLP, explicit head_dim != hidden/heads, tied embeddings."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, rope_theta=10000.0, max_position_embeddings=256,
+        hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True, attn_implementation="eager")
+    _check_parity(transformers.GemmaForCausalLM, hf_cfg, tmp_path)
+
+
+def test_gemma2_parity(tmp_path):
+    """Gemma-2: sandwich norms, attention+final soft capping, alternating
+    sliding windows, query_pre_attn_scalar score scale — the full stack of
+    Gemma-2 deviations in one checkpoint."""
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, rope_theta=10000.0, max_position_embeddings=256,
+        hidden_activation="gelu_pytorch_tanh",
+        query_pre_attn_scalar=24, sliding_window=8,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        tie_word_embeddings=True, attn_implementation="eager")
+    _check_parity(transformers.Gemma2ForCausalLM, hf_cfg, tmp_path)
+
+
+def test_gemma2_engine_on_mesh(tmp_path):
+    """Gemma-2 under a dp×tp mesh: the sandwich-norm leaves must have
+    shardings (a missing key crashed device_put), and pp must REFUSE the
+    config rather than serve silently-wrong logits."""
+    import jax
+
+    from dynamo_tpu.engine.model import (
+        init_params, param_shardings,
+    )
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+    from dynamo_tpu.parallel.pipeline import pp_compatible
+
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, query_pre_attn_scalar=24, sliding_window=8,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        tie_word_embeddings=True)
+    _save_hf(transformers.Gemma2ForCausalLM, hf_cfg, tmp_path)
+    cfg = ModelConfig.from_pretrained(str(tmp_path))
+    cfg.dtype = "float32"
+
+    mesh = make_mesh(MeshConfig(dp=2, tp=2))
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    sharded = jax.device_put(params, param_shardings(cfg, mesh))
+    assert "post_attn_norm" in sharded["layers"]
+
+    # draft-config slicing must survive the per-layer windows tuple
+    from dynamo_tpu.engine.model import make_draft_fn
+    make_draft_fn(cfg, 4, draft_layers=2, num_steps=2)
+
+    assert pp_compatible(cfg, 2) is not None  # refused, not silently wrong
