@@ -186,6 +186,24 @@ class ModelConfig:
             raise NotImplementedError(
                 "Gemma-3 (dual-base rope, plus-one qk-norm) is not "
                 "supported yet; Gemma 1/2 are")
+        is_phi3 = "phi3" in arch  # Phi-3 family AND Phi-4 (same arch class)
+        if is_phi3:
+            if float(d.get("partial_rotary_factor") or 1.0) != 1.0:
+                raise NotImplementedError(
+                    "partial rotary (phi-4-mini style) is not supported")
+            sc = d.get("rope_scaling")
+            if sc and sc.get("rope_type", sc.get("type")) == "longrope":
+                # longrope factors live in the scaling dict but the window
+                # sizes live on the top-level config — carry them together
+                # (model.rope_params reads only the dict)
+                sc = dict(sc)
+                sc["max_position_embeddings"] = d.get(
+                    "max_position_embeddings", 4096)
+                sc["original_max_position_embeddings"] = d.get(
+                    "original_max_position_embeddings",
+                    sc.get("original_max_position_embeddings",
+                           sc["max_position_embeddings"]))
+                d = {**d, "rope_scaling": sc}
         if "qwen3moe" in arch:
             # the uniform layer stack (lax.scan) requires every non-prefix
             # layer to be MoE; refuse irregular sparsity loudly rather than

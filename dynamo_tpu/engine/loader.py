@@ -158,12 +158,25 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
     def attn_layer(i: int) -> dict:
         pre = f"model.layers.{i}.self_attn"
         if not cfg.is_mla:
-            out = {
-                "wq": proj(f"{pre}.q_proj.weight"),
-                "wk": proj(f"{pre}.k_proj.weight"),
-                "wv": proj(f"{pre}.v_proj.weight"),
-                "wo": proj(f"{pre}.o_proj.weight"),
-            }
+            if f"{pre}.qkv_proj.weight" in t:
+                # Phi-3/Phi-4 fuse q|k|v rows into one projection; split at
+                # the head boundaries (rows are [H·hd | KV·hd | KV·hd])
+                qkv = proj(f"{pre}.qkv_proj.weight")  # [D, (H+2KV)·hd]
+                nq = cfg.num_heads * cfg.head_dim
+                nkv = cfg.num_kv_heads * cfg.head_dim
+                out = {
+                    "wq": qkv[:, :nq],
+                    "wk": qkv[:, nq:nq + nkv],
+                    "wv": qkv[:, nq + nkv:nq + 2 * nkv],
+                    "wo": proj(f"{pre}.o_proj.weight"),
+                }
+            else:
+                out = {
+                    "wq": proj(f"{pre}.q_proj.weight"),
+                    "wk": proj(f"{pre}.k_proj.weight"),
+                    "wv": proj(f"{pre}.v_proj.weight"),
+                    "wo": proj(f"{pre}.o_proj.weight"),
+                }
             if cfg.qkv_bias:
                 out["bq"] = get(f"{pre}.q_proj.bias")
                 out["bk"] = get(f"{pre}.k_proj.bias")
@@ -212,10 +225,20 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
         return out
 
     def dense_mlp_layer(i: int) -> dict:
+        pre = f"model.layers.{i}.mlp"
+        if f"{pre}.gate_up_proj.weight" in t:
+            # Phi-3/Phi-4 fuse gate|up (HF chunks: first half gate)
+            gu = proj(f"{pre}.gate_up_proj.weight")  # [D, 2F]
+            F2 = gu.shape[-1] // 2
+            return {
+                "w_gate": gu[:, :F2],
+                "w_up": gu[:, F2:],
+                "w_down": proj(f"{pre}.down_proj.weight"),
+            }
         return {
-            "w_gate": proj(f"model.layers.{i}.mlp.gate_proj.weight"),
-            "w_up": proj(f"model.layers.{i}.mlp.up_proj.weight"),
-            "w_down": proj(f"model.layers.{i}.mlp.down_proj.weight"),
+            "w_gate": proj(f"{pre}.gate_proj.weight"),
+            "w_up": proj(f"{pre}.up_proj.weight"),
+            "w_down": proj(f"{pre}.down_proj.weight"),
         }
 
     def oss_experts(pre: str, gu, w_down) -> dict:
@@ -312,9 +335,13 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
 
     def norm_get(name):
         """Gemma RMSNorms scale by (1 + w); folding the +1 into the stored
-        weight at load keeps the forward's single-norm codepath (x̂·w)."""
+        weight at load keeps the forward's single-norm codepath (x̂·w).
+        The fold happens in f32 (HF computes 1.0 + weight.float()): adding
+        1 in bf16 would flush small-w channels to exactly 1.0."""
         w = get(name)
-        return w + 1 if cfg.norm_plus_one else w
+        if not cfg.norm_plus_one:
+            return w
+        return (np.asarray(w, np.float32) + 1.0).astype(w.dtype)
 
     def norm_layer(i: int) -> dict:
         if cfg.sandwich_norms:

@@ -363,6 +363,33 @@ def rope_params(theta: float, hd: int, scaling: Optional[dict]):
         is_mid = (wavelen <= low_wl) & (wavelen >= high_wl)
         out = np.where(is_mid, smoothed, out)
         return out.astype(np.float32), 1.0
+    if kind == "longrope":  # Phi-3/Phi-4 (HF _compute_longrope_parameters)
+        # from_hf_config injects max/original_max into the scaling dict —
+        # HF reads them from top-level config attrs. Factor selection is
+        # STATIC here (serving sizes the cache for max_model_len): long
+        # factors whenever the model extends past its original window; HF
+        # switches per-forward at seq_len > original, so parity holds for
+        # sequences past that boundary (the extended-serving regime).
+        if "max_position_embeddings" not in scaling:
+            # injected by from_hf_config's phi3 branch — a longrope dict
+            # arriving without it means an arch we haven't wired (PhiMoE?)
+            raise NotImplementedError(
+                "longrope scaling requires max/original window sizes in the "
+                "rope_scaling dict (wired for Phi-3/Phi-4 configs only)")
+        max_pos = float(scaling["max_position_embeddings"])
+        orig = float(scaling.get("original_max_position_embeddings", max_pos))
+        factor = max_pos / orig
+        ext = np.asarray(scaling["long_factor"] if factor > 1.0
+                         else scaling["short_factor"], np.float64)
+        if ext.shape[0] != half:
+            raise ValueError(
+                f"longrope factor array has {ext.shape[0]} entries, "
+                f"head_dim/2 is {half}")
+        attn = scaling.get("attention_factor")
+        if attn is None:
+            attn = (np.sqrt(1 + np.log(factor) / np.log(orig))
+                    if factor > 1.0 else 1.0)
+        return (inv / ext).astype(np.float32), float(attn)
     raise NotImplementedError(f"rope_scaling type '{kind}' not supported")
 
 

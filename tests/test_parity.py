@@ -245,3 +245,31 @@ def test_gemma2_engine_on_mesh(tmp_path):
     make_draft_fn(cfg, 4, draft_layers=2, num_steps=2)
 
     assert pp_compatible(cfg, 2) is not None  # refused, not silently wrong
+
+
+def test_phi3_longrope_parity(tmp_path):
+    """Phi-3/Phi-4 arch: fused qkv/gate_up projections + longrope scaling.
+    original_max=8 < every test sequence length, so HF runs its LONG
+    factors throughout — the static regime the serving config targets."""
+    half = (64 // 4) // 2  # head_dim/2
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, max_position_embeddings=256,
+        original_max_position_embeddings=8, pad_token_id=0,
+        rope_scaling={"type": "longrope",
+                      "short_factor": [1.0] * half,
+                      "long_factor": [1.0 + 0.05 * i for i in range(half)]},
+        sliding_window=None, tie_word_embeddings=False,
+        attn_implementation="eager")
+    _check_parity(transformers.Phi3ForCausalLM, hf_cfg, tmp_path)
+
+
+def test_phi3_sliding_window_parity(tmp_path):
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, max_position_embeddings=256,
+        sliding_window=8, pad_token_id=0, tie_word_embeddings=False,
+        attn_implementation="eager")
+    _check_parity(transformers.Phi3ForCausalLM, hf_cfg, tmp_path)
