@@ -26,9 +26,15 @@ import numpy as np
 
 
 def _payloads(n_steps: int, batch: int) -> list[bytes]:
-    from dynamo_tpu.parallel.multihost import STEP_KEYS, _pack_step
+    from dynamo_tpu.parallel.multihost import _pack_step
 
-    arrays = {k: np.zeros((batch, 1), np.int32) for k in STEP_KEYS["step"]}
+    # the REAL packed "step" schema at decode shapes (S=1, W=64 pages):
+    # measured frames must match what production steps actually ship
+    arrays = {
+        "ints3": np.zeros((batch, 3, 1), np.int32),
+        "lens_last": np.zeros((batch, 2), np.int32),
+        "block_tables": np.zeros((batch, 64), np.int32),
+    }
     return [_pack_step("step", i + 1, arrays) for i in range(n_steps)]
 
 
