@@ -320,16 +320,24 @@ class FakeKubeApiServer:
 
             # subscribe BEFORE replay so nothing lands between them; replay
             # everything after `since` (rv=0 replays full retained history —
-            # ADDED events for current objects, the list+watch hand-off)
+            # ADDED events for current objects, the list+watch hand-off).
+            # An event emitted between subscribe and the history snapshot
+            # sits in BOTH — skip live items at or below the max replayed rv
+            # so clients never see duplicates (k8s watch contract).
             kind.subs.append(q)
+            replayed = since
             for _rv, ev_type, obj in list(kind.history):
                 if _rv > since:
                     await send(ev_type, obj)
+                    replayed = max(replayed, _rv)
             while True:
                 item = await q.get()
                 if item is None:
                     break
-                await send(*item)
+                ev_type, obj = item
+                if int(obj["metadata"]["resourceVersion"]) <= replayed:
+                    continue
+                await send(ev_type, obj)
             await resp.write_eof()
         except (ConnectionResetError, asyncio.CancelledError,
                 ConnectionError):

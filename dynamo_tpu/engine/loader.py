@@ -336,12 +336,16 @@ def load_hf_params(cfg: ModelConfig, path: str, dtype=None) -> dict:
     def norm_get(name):
         """Gemma RMSNorms scale by (1 + w); folding the +1 into the stored
         weight at load keeps the forward's single-norm codepath (x̂·w).
-        The fold happens in f32 (HF computes 1.0 + weight.float()): adding
-        1 in bf16 would flush small-w channels to exactly 1.0."""
+        The fold happens AND STAYS in f32 (HF computes 1.0 + weight.float()
+        and multiplies pre-downcast): folding then casting to bf16 would
+        flush small-w channels to exactly 1.0, compounding over Gemma-2's
+        4 norms/layer (ADVICE r4). Norm vectors are negligible next to the
+        weight matrices, and _rms_norm applies f32 weights before its final
+        cast."""
         w = get(name)
         if not cfg.norm_plus_one:
             return w
-        return (np.asarray(w, np.float32) + 1.0).astype(w.dtype)
+        return np.asarray(w, np.float32) + 1.0
 
     def norm_layer(i: int) -> dict:
         if cfg.sandwich_norms:

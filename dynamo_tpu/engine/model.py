@@ -301,7 +301,14 @@ def batch_shardings(mesh: Mesh) -> dict:
 def _rms_norm(x, w, eps):
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+    xn = xf * jax.lax.rsqrt(var + eps)
+    if w.dtype == jnp.float32 and x.dtype != jnp.float32:
+        # f32 weights = loader-folded (1+w) norms (Gemma): HF applies the
+        # scale in f32 and casts once at the end; casting x̂ first would
+        # bf16-quantize the fold and flush small-|w| channels to 1.0
+        return (xn * w).astype(x.dtype)
+    # HF Llama-style: x̂ cast back, then a same-dtype weight multiply
+    return xn.astype(x.dtype) * w
 
 
 def rope_params(theta: float, hd: int, scaling: Optional[dict]):

@@ -122,7 +122,7 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
         # static head→segment one-hot [H, KV]: head h's scale per key t is
         # seg_oh @ spage.T — one tiny MXU matmul instead of lane-expanding
         # scales to the [bs, KVhd] domain
-        KV = ksc_ref.shape[1] if vmem_scales else ksbuf.shape[2]
+        KV = ksc_ref.shape[0] if vmem_scales else ksbuf.shape[2]
         G = H // KV
         rows = jax.lax.broadcasted_iota(jnp.int32, (H, KV), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (H, KV), 1)
@@ -134,12 +134,17 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
         kpage = kbuf[w % D].astype(jnp.float32)  # [bs, KVhd]
         vpage = vbuf[w % D].astype(jnp.float32)
         if quant and vmem_scales:
+            # resident layout is TRANSPOSED [KV, padded_slots] (slots on the
+            # lane dim — a [slots, KV] block would tile-pad KV→128, 16-128×
+            # the useful bytes; ADVICE r4)
             blk = block_tables_ref[b, w]
-            kscpage = ksc_ref[pl.ds(blk * bs, bs)]  # [bs, KV], VMEM slice
-            vscpage = vsc_ref[pl.ds(blk * bs, bs)]
+            kscpage = ksc_ref[:, pl.ds(blk * bs, bs)]  # [KV, bs] VMEM slice
+            vscpage = vsc_ref[:, pl.ds(blk * bs, bs)]
+            sc_dims = (((1,), (0,)), ((), ()))  # seg_oh[H,KV] @ [KV,bs]
         elif quant:
-            kscpage = ksbuf[w % D]
+            kscpage = ksbuf[w % D]  # [bs, KV]
             vscpage = vsbuf[w % D]
+            sc_dims = (((1,), (1,)), ((), ()))
 
         # scores: contraction over KVhd == per-group q·k (q̃ is segment-masked)
         s = jax.lax.dot_general(
@@ -150,7 +155,7 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
             # its own segment, so its raw score scales by that segment's
             # per-key k-scale
             ksc = jax.lax.dot_general(
-                seg_oh, kscpage, (((1,), (1,)), ((), ())),
+                seg_oh, kscpage, sc_dims,
                 preferred_element_type=jnp.float32)  # [H, bs]
             s = s * ksc
 
@@ -167,7 +172,7 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
             # fold per-key v-scales into p (head h's own segment scaling;
             # other segments become garbage the caller discards anyway)
             vsc = jax.lax.dot_general(
-                seg_oh, vscpage, (((1,), (1,)), ((), ())),
+                seg_oh, vscpage, sc_dims,
                 preferred_element_type=jnp.float32)  # [H, bs]
             pv_p = p * vsc
         pv = jax.lax.dot_general(
@@ -250,8 +255,14 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
     # full grant latency). Budget overridable for experiments.
     vmem_scales = False
     if quant:
-        scale_bytes = 2 * slots * KV * 4
-        budget = int(os.environ.get("DYN_KV_SCALE_VMEM_BYTES", 6 << 20))
+        # honest VMEM footprint of the lane-packed TRANSPOSED [KV, slots]
+        # layout: sublane dim pads KV→8, lane dim pads slots→128. (The r4
+        # [slots, KV] layout tile-padded its lane dim KV→128 — 16-128× the
+        # bytes the old 2·slots·KV·4 check counted, so configs passed the
+        # check yet overflowed VMEM at Mosaic compile time; ADVICE r4.)
+        padded_slots = -(-slots // _LANE) * _LANE
+        scale_bytes = 2 * (-(-KV // 8) * 8) * padded_slots * 4
+        budget = int(os.environ.get("DYN_KV_SCALE_VMEM_BYTES", 32 << 20))
         vmem_scales = scale_bytes <= budget
     kernel = functools.partial(_decode_kernel, bs=bs, has_sink=has_sink,
                                quant=quant, vmem_scales=vmem_scales)
@@ -269,16 +280,23 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
     if quant:
         if vmem_scales:
             # constant block index → Pallas fetches the arrays once and
-            # keeps them resident across the whole (B,) grid
-            in_specs += [pl.BlockSpec((slots, KV), lambda b, *_: (0, 0)),
-                         pl.BlockSpec((slots, KV), lambda b, *_: (0, 0))]
+            # keeps them resident across the whole (B,) grid. Transposed so
+            # slots ride the (cheap) lane dim — see the budget note above.
+            def lane_pack_t(s):
+                s = s.astype(jnp.float32).T  # [KV, slots]
+                return jnp.pad(s, ((0, 0), (0, padded_slots - slots)))
+
+            in_specs += [
+                pl.BlockSpec((KV, padded_slots), lambda b, *_: (0, 0)),
+                pl.BlockSpec((KV, padded_slots), lambda b, *_: (0, 0))]
+            operands += [lane_pack_t(k_scales), lane_pack_t(v_scales)]
         else:
             in_specs += [pl.BlockSpec(memory_space=pltpu.HBM),
                          pl.BlockSpec(memory_space=pltpu.HBM)]
             scratch += [pltpu.VMEM((D, bs, KV), jnp.float32),
                         pltpu.VMEM((D, bs, KV), jnp.float32)]
-        operands += [k_scales.astype(jnp.float32),
-                     v_scales.astype(jnp.float32)]
+            operands += [k_scales.astype(jnp.float32),
+                         v_scales.astype(jnp.float32)]
     scratch.append(
         pltpu.SemaphoreType.DMA((D, 4 if quant and not vmem_scales else 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -462,7 +480,7 @@ def mla_int8_kernel_supported(block_size: int, flat_slots: int) -> bool:
     if _LANE % block_size:
         return False
     padded = -(-flat_slots // _LANE) * _LANE
-    budget = int(os.environ.get("DYN_KV_SCALE_VMEM_BYTES", 6 << 20))
+    budget = int(os.environ.get("DYN_KV_SCALE_VMEM_BYTES", 32 << 20))
     return 2 * padded * 4 <= budget
 
 

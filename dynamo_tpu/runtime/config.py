@@ -15,6 +15,9 @@ a typo'd knob must fail loudly at startup, not silently use a default.
 Env surface:
 
 - ``DYN_CONTROL_PLANE``    — ``host:port`` of dynctl; unset = in-process.
+  May be a comma-separated list (``primary:port,standby:port``) when a
+  warm-standby dynctl runs (``--standby-of``): clients fail over by
+  cycling the list on reconnect.
 - ``DYN_LEASE_TTL``        — primary lease TTL seconds (default 10).
 - ``DYN_NAMESPACE``        — default namespace (default ``dynamo``).
 - ``DYN_REQUEST_TIMEOUT``  — request-plane ack timeout seconds.

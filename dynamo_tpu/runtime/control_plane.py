@@ -534,6 +534,15 @@ class LocalControlPlane(ControlPlane):
         for b, n, obj in d.get("objects") or []:
             self._objects[(b, n)] = obj
 
+    def replace_state(self, data: bytes) -> None:
+        """Standby replication: mirror a primary's durable state wholesale
+        (a standby serves no clients, so there are no watches/subs to
+        notify — deleted keys must vanish, hence clear-then-load)."""
+        self._kv.clear()
+        self._streams.clear()
+        self._objects.clear()
+        self.load_state(data)
+
     # -- Object store --
     async def object_put(self, bucket, name, data):
         self._objects[(bucket, name)] = data
@@ -571,7 +580,10 @@ class ControlPlaneServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  persist_path: Optional[str] = None,
-                 persist_interval: float = 5.0):
+                 persist_interval: float = 5.0,
+                 standby_of: Optional[str] = None,
+                 takeover_after: float = 6.0,
+                 replicate_interval: float = 1.0):
         self.core = LocalControlPlane()
         self._host = host
         self._port = port
@@ -583,6 +595,18 @@ class ControlPlaneServer:
         self._persist_path = persist_path
         self._persist_interval = persist_interval
         self._persist_task: Optional[asyncio.Task] = None
+        #: warm standby (ref role: etcd replication / clustered NATS —
+        #: lib/runtime/src/transports/etcd.rs:35-770 rides an HA etcd
+        #: cluster; dynctl gets a 2-node primary/standby analog): while
+        #: ``standby_of`` is set the server rejects client ops, mirrors the
+        #: primary's durable state every ``replicate_interval`` s, and
+        #: promotes itself after ``takeover_after`` s of primary silence.
+        self._standby_of = standby_of
+        self._takeover_after = takeover_after
+        self._replicate_interval = replicate_interval
+        self._standby_task: Optional[asyncio.Task] = None
+        self._fence_task: Optional[asyncio.Task] = None
+        self.is_standby = standby_of is not None
 
     @property
     def address(self) -> str:
@@ -602,8 +626,120 @@ class ControlPlaneServer:
         if self._persist_path:
             self._persist_task = asyncio.get_running_loop().create_task(
                 self._persist_loop())
-        logger.info("control plane listening on %s", self.address)
+        if self.is_standby:
+            self._standby_task = asyncio.get_running_loop().create_task(
+                self._standby_loop())
+        logger.info("control plane listening on %s%s", self.address,
+                    " (standby)" if self.is_standby else "")
         return self.address
+
+    async def _standby_loop(self):
+        """Mirror the primary until it goes silent, then promote."""
+        last_ok = time.monotonic()
+        host, _, port = self._standby_of.rpartition(":")
+        host, port = host or "127.0.0.1", int(port)
+        reader = writer = None
+        rid = 0
+        try:
+            while self.is_standby:
+                try:
+                    if writer is None:
+                        reader, writer = await asyncio.wait_for(
+                            asyncio.open_connection(host, port), 5.0)
+                    rid += 1
+                    await write_frame(writer, {"t": "req", "id": rid,
+                                               "op": "dump_state"})
+                    # private conn: the only traffic is our own responses
+                    msg = await asyncio.wait_for(read_frame(reader), 10.0)
+                    if not (msg.get("t") == "res" and msg.get("ok")):
+                        raise RuntimeError(msg.get("detail", "pull failed"))
+                    self.core.replace_state(msg["value"])
+                    last_ok = time.monotonic()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    if writer is not None:
+                        try:
+                            writer.close()
+                        except Exception:
+                            pass
+                    reader = writer = None
+                    if time.monotonic() - last_ok > self._takeover_after:
+                        self._promote()
+                        return
+                await asyncio.sleep(self._replicate_interval)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+    def _promote(self):
+        """Standby → primary. The replicated state may lag the dead primary
+        by up to one replicate interval, so old-epoch stream seqs can sit
+        AHEAD of our counters — a fresh epoch forces every client to resume
+        streams from 0 and resync through the gap protocol (indexer
+        snapshot restore) instead of silently skipping rolled-back entries."""
+        self.core.epoch = f"{random.getrandbits(64):016x}"
+        self.is_standby = False
+        logger.warning("standby promoted to primary (epoch %s)",
+                       self.core.epoch)
+        # fence the OLD primary: if it was merely paused/partitioned (not
+        # dead) it would otherwise keep serving its connected clients
+        # forever — split brain. Keep probing its address; on contact,
+        # demote it into OUR standby.
+        self._fence_task = asyncio.get_running_loop().create_task(
+            self._fence_old_primary(self._standby_of))
+
+    async def _fence_old_primary(self, old_addr: str):
+        host, _, port = old_addr.rpartition(":")
+        host, port = host or "127.0.0.1", int(port)
+        while True:
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port), 5.0)
+                try:
+                    await write_frame(writer, {"t": "req", "id": 1,
+                                               "op": "demote",
+                                               "port": self._port,
+                                               "epoch": self.core.epoch})
+                    msg = await asyncio.wait_for(read_frame(reader), 10.0)
+                    if msg.get("ok"):
+                        logger.warning("old primary %s demoted into standby",
+                                       old_addr)
+                        return
+                finally:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            await asyncio.sleep(max(self._replicate_interval * 2, 1.0))
+
+    async def demote(self, new_primary: str):
+        """A newer primary exists (it fenced us): reject clients from now
+        on — closing their conns makes them fail over within one reconnect
+        cycle — and fall in line as the new primary's standby."""
+        if self.is_standby:
+            return
+        logger.warning("demoted: %s took over while we were unreachable; "
+                       "becoming its standby", new_primary)
+        self.is_standby = True
+        self._standby_of = new_primary
+        for conn in list(self._conns):
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+        if self._standby_task is None or self._standby_task.done():
+            self._standby_task = asyncio.get_running_loop().create_task(
+                self._standby_loop())
 
     def _write_state(self, data: bytes) -> None:
         tmp = f"{self._persist_path}.tmp"
@@ -628,6 +764,18 @@ class ControlPlaneServer:
             pass
 
     async def stop(self):
+        if self._fence_task:
+            self._fence_task.cancel()
+            try:
+                await self._fence_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._standby_task:
+            self._standby_task.cancel()
+            try:
+                await self._standby_task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._persist_task:
             self._persist_task.cancel()
             try:
@@ -658,7 +806,7 @@ class ControlPlaneServer:
         await self.core.close()
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        conn = _ServerConn(self.core, reader, writer)
+        conn = _ServerConn(self.core, reader, writer, server=self)
         self._conns.add(conn)
         try:
             await conn.run()
@@ -669,10 +817,11 @@ class ControlPlaneServer:
 class _ServerConn:
     """Per-client server-side connection: dispatches ops onto the core plane."""
 
-    def __init__(self, core: LocalControlPlane, reader, writer):
+    def __init__(self, core: LocalControlPlane, reader, writer, server=None):
         self.core = core
         self.reader = reader
         self.writer = writer
+        self.server = server
         self._wlock = asyncio.Lock()
         self._watch_tasks: dict[int, asyncio.Task] = {}
         self._watch_handles: dict[int, Watch] = {}
@@ -727,6 +876,23 @@ class _ServerConn:
     async def _handle_req(self, msg):
         rid = msg["id"]
         op = msg["op"]
+        if op == "demote" and self.server is not None:
+            # fencing from a promoted standby (see _fence_old_primary);
+            # its reachable address = the conn's source IP + its port
+            peer = self.writer.get_extra_info("peername") or ("127.0.0.1",)
+            await self._send({"t": "res", "id": rid, "ok": True,
+                              "value": None})
+            await self.server.demote(f"{peer[0]}:{msg['port']}")
+            return
+        # a standby mirrors state but serves no clients: reject every op so
+        # a multi-address RemoteControlPlane fails over to the primary
+        # (dump_state stays open — it is how replication reads us/peers)
+        if (self.server is not None and self.server.is_standby
+                and op != "dump_state"):
+            await self._send({"t": "res", "id": rid, "ok": False,
+                              "error": "standby",
+                              "detail": "hub is a standby replica"})
+            return
         try:
             result = await self._dispatch(op, msg)
             await self._send({"t": "res", "id": rid, "ok": True, "value": result})
@@ -776,6 +942,8 @@ class _ServerConn:
                 await cancel()
         elif op == "epoch":
             return core.epoch
+        elif op == "dump_state":
+            return core.dump_state()
         elif op == "queue_push":
             await core.queue_push(m["queue"], m["payload"])
         elif op == "queue_pop":
@@ -878,13 +1046,27 @@ class RemoteControlPlane(ControlPlane):
     seen seq. In-flight request futures fail with ControlPlaneClosed (the
     callers' retry logic owns those); higher layers re-register leases via
     ``add_reconnect_callback``.
+
+    ``address`` may be a comma-separated list (``h1:p1,h2:p2``) naming a
+    primary plus warm standbys: connect and every reconnect attempt cycle
+    through the list, and a hub answering ``standby`` counts as down — so
+    a standby's promotion is discovered by ordinary failover. An epoch
+    change after failover resets stream cursors exactly like a hub restart.
     """
 
     RECONNECT_BACKOFF = (0.2, 0.5, 1.0, 2.0, 5.0)
 
     def __init__(self, address: str):
-        host, _, port = address.rpartition(":")
-        self._host, self._port = host or "127.0.0.1", int(port)
+        self._addrs = []
+        for part in address.split(","):
+            part = part.strip()
+            if part:
+                host, _, port = part.rpartition(":")
+                self._addrs.append((host or "127.0.0.1", int(port)))
+        if not self._addrs:
+            raise ValueError(f"no control-plane address in {address!r}")
+        self._addr_i = 0  # index of the address currently/last connected
+        self._host, self._port = self._addrs[0]
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._wlock = asyncio.Lock()
@@ -896,6 +1078,7 @@ class RemoteControlPlane(ControlPlane):
         self._rx_task: Optional[asyncio.Task] = None
         self._closed = False
         self._connected = False
+        self._established = False  # ever fully connected (epoch verified)
         # replay metadata for reconnect
         self._serve_meta: dict[int, str] = {}  # svc_id -> subject
         self._watch_meta: dict[int, str] = {}  # wid -> prefix
@@ -908,12 +1091,38 @@ class RemoteControlPlane(ControlPlane):
         (runtime uses this to re-create its lease + registrations)."""
         self._reconnect_cbs.append(cb)
 
-    async def connect(self) -> "RemoteControlPlane":
-        self._reader, self._writer = await asyncio.open_connection(self._host, self._port)
+    async def _open(self, i: int) -> None:
+        """Dial address ``i`` and verify it serves (standbys reject the
+        epoch call). On failure the half-open conn is torn down so its rx
+        task cannot linger."""
+        host, port = self._addrs[i]
+        self._reader, self._writer = await asyncio.open_connection(host, port)
         self._connected = True
         self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
-        self._epoch = await self._call("epoch")
-        return self
+        try:
+            epoch = await self._call("epoch", timeout=10.0)
+        except Exception:
+            self._connected = False
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            raise
+        self._addr_i = i
+        self._host, self._port = host, port
+        self._new_epoch = epoch
+
+    async def connect(self) -> "RemoteControlPlane":
+        last_err: Optional[Exception] = None
+        for off in range(len(self._addrs)):
+            try:
+                await self._open((self._addr_i + off) % len(self._addrs))
+                self._epoch = self._new_epoch
+                self._established = True
+                return self
+            except Exception as e:  # noqa: BLE001 — try the next address
+                last_err = e
+        raise last_err
 
     async def _rx_loop(self):
         try:
@@ -955,10 +1164,12 @@ class RemoteControlPlane(ControlPlane):
                 if not fut.done():
                     fut.set_exception(ControlPlaneClosed())
             self._pending.clear()
-            if not self._closed:
+            if not self._closed and self._established:
                 # guard against duplicate loops: a replay failure inside a
                 # RUNNING reconnect loop also lands here when its fresh
-                # rx task dies — that loop keeps retrying, don't stack one
+                # rx task dies — that loop keeps retrying, don't stack one.
+                # (_established gates out rx tasks of PROBE connections made
+                # while connect() is still cycling the address list)
                 if self._reconnect_task is None or self._reconnect_task.done():
                     logger.warning("control-plane connection lost; reconnecting")
                     self._reconnect_task = asyncio.get_running_loop().create_task(
@@ -975,11 +1186,10 @@ class RemoteControlPlane(ControlPlane):
             await asyncio.sleep(delay)
             attempt += 1
             try:
-                self._reader, self._writer = await asyncio.open_connection(
-                    self._host, self._port)
-                self._connected = True
-                self._rx_task = asyncio.get_running_loop().create_task(
-                    self._rx_loop())
+                # cycle the address list: the current hub first, then its
+                # standbys — a promoted standby is found within one cycle
+                await self._open((self._addr_i + attempt - 1)
+                                 % len(self._addrs))
                 await self._replay()
                 logger.info("control-plane reconnected after %d attempt(s)",
                             attempt)
@@ -1054,8 +1264,18 @@ class RemoteControlPlane(ControlPlane):
         rid = self._next_id
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
-        await self._send({"t": "req", "id": rid, "op": op, **kwargs})
-        return await asyncio.wait_for(fut, timeout)
+        try:
+            await self._send({"t": "req", "id": rid, "op": op, **kwargs})
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            # a send failure/timeout abandons the future — drop it so a
+            # later rx-loop teardown can't set an exception nobody will
+            # ever retrieve (the loop's exception handler would flag it)
+            self._pending.pop(rid, None)
+            if fut.done() and not fut.cancelled():
+                fut.exception()  # mark retrieved (timeout/send-fail races)
+            else:
+                fut.cancel()
 
     # -- KV --
     async def kv_put(self, key, value, lease_id=None):

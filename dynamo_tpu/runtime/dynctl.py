@@ -3,6 +3,12 @@
 Single self-contained process replacing the reference's etcd + NATS pair for
 TPU-VM deployments. Point every other process at it with
 ``DYN_CONTROL_PLANE=host:port``.
+
+HA: run a second dynctl with ``--standby-of primary:port`` and set
+``DYN_CONTROL_PLANE=primary:port,standby:port`` everywhere — the standby
+mirrors durable state, promotes itself (fresh epoch) after sustained
+primary silence, and fences/demotes the old primary if it comes back
+(ref HA role: lib/runtime/src/transports/etcd.rs:35-770 replicated etcd).
 """
 
 from __future__ import annotations
@@ -15,11 +21,16 @@ from dynamo_tpu.runtime.control_plane import ControlPlaneServer
 
 
 async def amain(host: str, port: int, persist: str = None,
-                persist_interval: float = 5.0):
+                persist_interval: float = 5.0, standby_of: str = None,
+                takeover_after: float = 6.0, replicate_interval: float = 1.0):
     server = ControlPlaneServer(host, port, persist_path=persist,
-                                persist_interval=persist_interval)
+                                persist_interval=persist_interval,
+                                standby_of=standby_of,
+                                takeover_after=takeover_after,
+                                replicate_interval=replicate_interval)
     addr = await server.start()
-    print(f"dynctl listening on {addr}", flush=True)
+    print(f"dynctl listening on {addr}"
+          + (" (standby)" if server.is_standby else ""), flush=True)
 
     stop = asyncio.Event()
     try:
@@ -46,9 +57,18 @@ def main():
                          "not); snapshotted every --persist-interval s, "
                          "flushed on SIGTERM")
     ap.add_argument("--persist-interval", type=float, default=5.0)
+    ap.add_argument("--standby-of", default=None, metavar="HOST:PORT",
+                    help="run as a warm standby of this primary: mirror its "
+                         "durable state, reject client ops, and promote to "
+                         "primary (fresh epoch) after --takeover-after s of "
+                         "primary silence; point clients at "
+                         "DYN_CONTROL_PLANE=primary,standby")
+    ap.add_argument("--takeover-after", type=float, default=6.0)
+    ap.add_argument("--replicate-interval", type=float, default=1.0)
     args = ap.parse_args()
     asyncio.run(amain(args.host, args.port, args.persist,
-                      args.persist_interval))
+                      args.persist_interval, args.standby_of,
+                      args.takeover_after, args.replicate_interval))
 
 
 if __name__ == "__main__":
