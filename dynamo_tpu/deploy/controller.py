@@ -41,6 +41,7 @@ GROUP, VERSION = "dynamo.tpu", "v1alpha1"
 PLURAL = "dynamographdeployments"
 LABEL_GRAPH = "dynamo.tpu/graph"
 LABEL_SERVICE = "dynamo.tpu/service"
+LABEL_GANG = "dynamo.tpu/gang"
 
 
 def pod_name(graph: str, service: str, index: int) -> str:
@@ -48,12 +49,28 @@ def pod_name(graph: str, service: str, index: int) -> str:
 
 
 class DynamoGraphController:
-    def __init__(self, client: KubeClient, namespace: str = "default"):
+    """``plane``: optional control-plane client for discovery hygiene — on
+    scale-down/teardown the controller deletes the removed pods' (and
+    removed services') ``instances/…`` keys instead of letting them linger
+    a lease TTL (ref: deploy/cloud/operator/internal/etcd/etcd.go:34 +
+    dynamocomponentdeployment_controller.go:607). ``multinode: N`` in a
+    service spec makes each replica a POD GANG of N (multi-host TPU
+    worker): gang members are created all-or-nothing — a partial gang is
+    rolled back, never left to start a fleet (ref:
+    internal/controller_common/podgangset.go)."""
+
+    def __init__(self, client: KubeClient, namespace: str = "default",
+                 plane=None, dynamo_namespace: str = "dynamo"):
         self.client = client
         self.namespace = namespace
+        self.plane = plane
+        self.dynamo_namespace = dynamo_namespace
         self.crs = client.resource(GROUP, VERSION, namespace, PLURAL)
         self.pods = client.resource("", "v1", namespace, "pods")
         self._cache: dict[str, dict] = {}
+        #: graph → its dynamoNamespace, remembered so teardown of a DELETED
+        #: CR (spec gone from the cache) still scopes discovery cleanup
+        self._graph_ns: dict[str, str] = {}
         self._queue: asyncio.Queue = asyncio.Queue()
         self._queued: set[str] = set()
         self._tasks: list[asyncio.Task] = []
@@ -164,55 +181,53 @@ class DynamoGraphController:
         for pod in owned["items"]:
             svc = pod["metadata"].get("labels", {}).get(LABEL_SERVICE, "")
             by_service.setdefault(svc, []).append(pod)
+        deleted_pods: list[str] = []
 
         if cr is None:
-            # CR gone: delete every owned pod (GC backstop)
+            # CR gone: delete every owned pod (GC backstop) + wipe each
+            # service's discovery subtree
             for pods in by_service.values():
                 for pod in pods:
-                    await self._delete_pod(pod["metadata"]["name"])
+                    await self._delete_pod(pod["metadata"]["name"],
+                                           deleted_pods)
+            await self._cleanup_discovery(
+                deleted_pods, services=list(by_service),
+                dyn_ns=self._graph_ns.pop(name, self.dynamo_namespace))
             return
 
+        # each graph serves in its own dynamo namespace (the reference's
+        # per-deployment Spec.DynamoNamespace) — without the scoping, two
+        # graphs sharing a service name would wipe each other's discovery
+        # keys on teardown
+        dyn_ns = ((cr.get("spec") or {}).get("dynamoNamespace")
+                  or self.dynamo_namespace)
+        self._graph_ns[name] = dyn_ns
         services = (cr.get("spec") or {}).get("services") or {}
         status_services = {}
         all_ready = True
         for svc, spec in services.items():
             desired = int(spec.get("replicas", 1))
-
-            def _index(pod):
-                # numeric replica index, NOT lexicographic name order —
-                # "-10" must sort after "-9" or scale-down kills the wrong pod
-                try:
-                    return int(pod["metadata"]["name"].rsplit("-", 1)[1])
-                except (IndexError, ValueError):
-                    return -1
-            have = sorted(by_service.pop(svc, []), key=_index)
-            # create missing replicas at the first free indices
-            used = {p["metadata"]["name"] for p in have}
-            idx = 0
-            while len(have) < desired:
-                pname = pod_name(name, svc, idx)
-                idx += 1
-                if pname in used:
-                    continue
-                pod = self._pod_for(cr, svc, spec, pname)
-                try:
-                    created = await self.pods.create(pod)
-                    have.append(created)
-                except Conflict:
-                    pass  # another worker got there; next reconcile settles
-            # delete excess, newest-first (planner scale-down contract)
-            while len(have) > desired:
-                victim = have.pop()
-                await self._delete_pod(victim["metadata"]["name"])
-            ready = sum(1 for p in have
-                        if (p.get("status") or {}).get("phase") == "Running")
+            nodes = int(spec.get("multinode", 1))
+            have = by_service.pop(svc, [])
+            if nodes > 1:
+                ready = await self._reconcile_gangs(
+                    cr, svc, spec, have, desired, nodes, deleted_pods,
+                    dyn_ns)
+            else:
+                ready = await self._reconcile_single(
+                    cr, svc, spec, have, desired, deleted_pods, dyn_ns)
             status_services[svc] = {"desired": desired, "ready": ready}
             if ready < desired:
                 all_ready = False
-        # pods whose service vanished from the spec
+        # pods whose service vanished from the spec: delete them AND the
+        # service's whole discovery subtree (the ref operator's etcd
+        # DeleteKeys-by-service-prefix)
         for pods in by_service.values():
             for pod in pods:
-                await self._delete_pod(pod["metadata"]["name"])
+                await self._delete_pod(pod["metadata"]["name"], deleted_pods)
+        await self._cleanup_discovery(deleted_pods,
+                                      services=list(by_service),
+                                      dyn_ns=dyn_ns)
 
         status = {
             "observedGeneration": cr["metadata"].get("generation", 1),
@@ -224,12 +239,177 @@ class DynamoGraphController:
         }
         await self._update_status(name, status)
 
-    def _pod_for(self, cr: dict, svc: str, spec: dict, pname: str) -> dict:
+    async def _reconcile_single(self, cr, svc, spec, have, desired,
+                                deleted_pods, dyn_ns) -> int:
+        name = cr["metadata"]["name"]
+
+        def _index(pod):
+            # numeric replica index, NOT lexicographic name order —
+            # "-10" must sort after "-9" or scale-down kills the wrong pod
+            try:
+                return int(pod["metadata"]["name"].rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                return -1
+        have = sorted(have, key=_index)
+        # create missing replicas at the first free indices
+        used = {p["metadata"]["name"] for p in have}
+        idx = 0
+        while len(have) < desired:
+            pname = pod_name(name, svc, idx)
+            idx += 1
+            if pname in used:
+                continue
+            pod = self._pod_for(cr, svc, spec, pname, dyn_ns=dyn_ns)
+            try:
+                created = await self.pods.create(pod)
+                have.append(created)
+            except Conflict:
+                pass  # another worker got there; next reconcile settles
+        # delete excess, newest-first (planner scale-down contract)
+        while len(have) > desired:
+            victim = have.pop()
+            await self._delete_pod(victim["metadata"]["name"], deleted_pods)
+        return sum(1 for p in have
+                   if (p.get("status") or {}).get("phase") == "Running")
+
+    async def _reconcile_gangs(self, cr, svc, spec, have, desired, nodes,
+                               deleted_pods, dyn_ns) -> int:
+        """Each replica is a gang of ``nodes`` pods named
+        ``{graph}-{svc}-{replica}-{rank}``. Creation is all-or-nothing per
+        gang; scale-down removes whole gangs, newest-first. A replica
+        counts ready only when EVERY member runs — a v5e-64 slice is
+        useless partially scheduled."""
+        name = cr["metadata"]["name"]
+        gangs: dict[int, list[dict]] = {}
+        for pod in have:
+            try:
+                r = int(pod["metadata"]["name"].rsplit("-", 2)[1])
+            except (IndexError, ValueError):
+                r = -1
+            if r < 0 or LABEL_GANG not in pod["metadata"].get("labels", {}):
+                # legacy single-node pod (service switched to multinode) or
+                # an unparseable stray: it can never join a gang — replace
+                # it with properly ganged pods
+                await self._delete_pod(pod["metadata"]["name"], deleted_pods)
+                continue
+            gangs.setdefault(r, []).append(pod)
+        existing = sorted(gangs)
+        # create missing gangs at the first free replica indices
+        idx = 0
+        while len(existing) < desired:
+            if idx in gangs:
+                idx += 1
+                continue
+            if not await self._create_gang(cr, svc, spec, idx, nodes,
+                                           dyn_ns):
+                # placement failed (rolled back): do NOT fall through to a
+                # higher index — retry THIS replica slot on the next
+                # reconcile (self-requeued: a first-member failure leaves
+                # no pod event behind to trigger one)
+                asyncio.get_running_loop().call_later(
+                    0.5, self._enqueue, name)
+                break
+            gangs[idx] = []  # placeholder; next reconcile sees pods
+            existing.append(idx)
+            idx += 1
+        # delete excess gangs, newest-first
+        while len(existing) > desired:
+            victim = existing.pop()
+            for pod in gangs.get(victim, []):
+                await self._delete_pod(pod["metadata"]["name"], deleted_pods)
+        # repair incomplete gangs (a member died: recreate just the hole —
+        # the gang barrier keeps the survivors parked until it returns)
+        for r in existing:
+            members = {p["metadata"]["name"] for p in gangs.get(r, [])}
+            for h in range(nodes):
+                pname = f"{pod_name(name, svc, r)}-{h}"
+                if gangs.get(r) and pname not in members:
+                    try:
+                        await self.pods.create(self._pod_for(
+                            cr, svc, spec, pname, gang_replica=r,
+                            gang_rank=h, gang_nodes=nodes, dyn_ns=dyn_ns))
+                    except Conflict:
+                        pass
+        ready = 0
+        for r in existing:
+            members = gangs.get(r, [])
+            if len(members) == nodes and all(
+                    (p.get("status") or {}).get("phase") == "Running"
+                    for p in members):
+                ready += 1
+        return ready
+
+    async def _create_gang(self, cr, svc, spec, replica, nodes,
+                           dyn_ns) -> bool:
+        """All-or-nothing gang creation: on any member's failure the
+        already-created members are rolled back, so a partially placed
+        multi-host worker can never start (ref: podgangset.go)."""
+        name = cr["metadata"]["name"]
+        created = []
+        for h in range(nodes):
+            pname = f"{pod_name(name, svc, replica)}-{h}"
+            pod = self._pod_for(cr, svc, spec, pname, gang_replica=replica,
+                                gang_rank=h, gang_nodes=nodes, dyn_ns=dyn_ns)
+            try:
+                created.append(await self.pods.create(pod))
+            except Conflict:
+                continue  # member already exists — keep going
+            except Exception:
+                logger.warning(
+                    "gang %s-%s-%d: member %d/%d failed to place; rolling "
+                    "back the partial gang", name, svc, replica, h, nodes)
+                for p in created:
+                    await self._delete_pod(p["metadata"]["name"], [])
+                return False
+        return True
+
+    async def _cleanup_discovery(self, pods, services=(), dyn_ns=None):
+        """Delete removed pods'/services' ``instances/…`` keys so routing
+        never dangles a scaled-down worker for a lease TTL (the keys are
+        lease-attached, so this is an acceleration, not the only GC)."""
+        if self.plane is None or not (pods or services):
+            return
+        dyn_ns = dyn_ns or self.dynamo_namespace
+        try:
+            for svc in services:
+                await self.plane.kv_delete_prefix(
+                    f"instances/{dyn_ns}/{svc}/")
+            if pods:
+                import msgpack
+                podset = set(pods)
+                entries = await self.plane.kv_get_prefix(
+                    f"instances/{dyn_ns}/")
+                for key, value in (entries or {}).items():
+                    try:
+                        meta = msgpack.unpackb(value, raw=False).get(
+                            "metadata") or {}
+                    except Exception:
+                        continue
+                    if meta.get("pod") in podset:
+                        await self.plane.kv_delete(key)
+        except Exception:
+            logger.exception(
+                "discovery cleanup failed (lease TTL will settle it)")
+
+    def _pod_for(self, cr: dict, svc: str, spec: dict, pname: str,
+                 gang_replica: Optional[int] = None, gang_rank: int = 0,
+                 gang_nodes: int = 1, dyn_ns: Optional[str] = None) -> dict:
+        labels = {LABEL_GRAPH: cr["metadata"]["name"], LABEL_SERVICE: svc}
+        env = dict(spec.get("env") or {})
+        env["DYN_POD_NAME"] = pname  # discovery-cleanup identity
+        env.setdefault("DYN_NAMESPACE", dyn_ns or self.dynamo_namespace)
+        if gang_replica is not None:
+            gname = pod_name(cr["metadata"]["name"], svc, gang_replica)
+            labels[LABEL_GANG] = gname
+            # multi-host worker coordination (parallel/multihost.py
+            # leader/follower): rank 0 is the leader; members find it by
+            # the stable pod-0 name (headless-service DNS in a real cluster)
+            env.update({"DYN_MH_RANK": gang_rank, "DYN_MH_COUNT": gang_nodes,
+                        "DYN_MH_LEADER": f"{gname}-0"})
         return {
             "metadata": {
                 "name": pname,
-                "labels": {LABEL_GRAPH: cr["metadata"]["name"],
-                           LABEL_SERVICE: svc},
+                "labels": labels,
                 "ownerReferences": [{
                     "apiVersion": f"{GROUP}/{VERSION}",
                     "kind": "DynamoGraphDeployment",
@@ -242,13 +422,15 @@ class DynamoGraphController:
                 "name": svc,
                 "command": spec.get("command", []),
                 "env": [{"name": k, "value": str(v)}
-                        for k, v in (spec.get("env") or {}).items()],
+                        for k, v in env.items()],
             }]},
         }
 
-    async def _delete_pod(self, pname: str):
+    async def _delete_pod(self, pname: str, deleted: Optional[list] = None):
         try:
             await self.pods.delete(pname)
+            if deleted is not None:
+                deleted.append(pname)
         except NotFound:
             pass
 

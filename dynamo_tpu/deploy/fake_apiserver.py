@@ -100,6 +100,9 @@ class FakeKubeApiServer:
         self._runner: Optional[web.AppRunner] = None
         self.base_url = ""
         self._pod_timers: set[asyncio.Task] = set()
+        #: test hook: ``(name_substring, n)`` → fail the next n creates of
+        #: matching objects with 403 (quota-style rejection)
+        self.fail_create: Optional[tuple] = None
 
     def register(self, group: str, version: str, plural: str, kind: str):
         key = f"apis/{group}/{version}" if group else f"api/{version}"
@@ -200,6 +203,15 @@ class FakeKubeApiServer:
         if not name:
             return web.json_response({"message": "metadata.name required"},
                                      status=422)
+        if self.fail_create and self.fail_create[1] > 0 \
+                and self.fail_create[0] in name:
+            # test hook: simulate quota/scheduling rejection (see
+            # fail_create attr) — exercises the controller's gang rollback
+            self.fail_create = (self.fail_create[0], self.fail_create[1] - 1)
+            return web.json_response(
+                {"kind": "Status", "status": "Failure", "code": 403,
+                 "reason": "Forbidden", "message": "quota exceeded (test)"},
+                status=403)
         if (ns, name) in kind.objs:
             return web.json_response(
                 {"kind": "Status", "status": "Failure", "code": 409,

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 
 from dynamo_tpu.engine.config import EngineArgs, ModelConfig
@@ -263,6 +264,17 @@ async def amain():
     if cli.mm_projector and not cli.mm_vision_model:
         ap.error("--mm-projector without --mm-vision-model would leave the "
                  "stub encoder serving random embeddings — pass the tower too")
+
+    # operator-injected gang env (deploy/controller._pod_for): a multinode
+    # gang member boots the multi-host cluster with no extra flags — rank 0
+    # is the leader, found at its stable pod-0 name (headless-service DNS)
+    if cli.jax_coordinator is None and os.environ.get("DYN_MH_LEADER"):
+        cli.jax_coordinator = (os.environ["DYN_MH_LEADER"] + ":"
+                               + os.environ.get("DYN_MH_PORT", "9876"))
+        if cli.jax_num_processes is None:
+            cli.jax_num_processes = int(os.environ.get("DYN_MH_COUNT", "1"))
+        if cli.jax_process_id is None:
+            cli.jax_process_id = int(os.environ.get("DYN_MH_RANK", "0"))
 
     cli._mh_rank, cli._mh_world = 0, 1
     if cli.jax_coordinator or cli.jax_num_processes:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Optional
@@ -198,7 +199,15 @@ class Endpoint:
         # in-process short-circuit path
         rt._local_endpoints[subject] = (handler, inflight)
 
-        inst = Instance(ns, comp, ep, lease, metadata=dict(metadata or {}))
+        meta = dict(metadata or {})
+        # under the k8s operator every pod gets DYN_POD_NAME; stamping it
+        # into the instance record lets the controller delete THIS pod's
+        # discovery keys the moment it scales the pod away, instead of
+        # waiting out the lease TTL (ref role: operator/internal/etcd/)
+        pod = os.environ.get("DYN_POD_NAME")
+        if pod and "pod" not in meta:
+            meta["pod"] = pod
+        inst = Instance(ns, comp, ep, lease, metadata=meta)
         value = msgpack.packb(inst.to_wire())
         key = instance_key(ns, comp, ep, lease)
         created = await rt.plane.kv_create(key, value, lease_id=lease)

@@ -722,11 +722,23 @@ class ControlPlaneServer:
                 pass
             await asyncio.sleep(max(self._replicate_interval * 2, 1.0))
 
-    async def demote(self, new_primary: str):
+    async def demote(self, new_primary: str, epoch: Optional[str] = None):
         """A newer primary exists (it fenced us): reject clients from now
         on — closing their conns makes them fail over within one reconnect
-        cycle — and fall in line as the new primary's standby."""
+        cycle — and fall in line as the new primary's standby.
+
+        Trust model: like every other op on this plane (any client may
+        kv_delete_prefix the world), demote assumes a trusted network — the
+        reference's etcd/NATS deployments carry the same assumption inside
+        the cluster. Two guards bound the blast radius of a stray frame:
+        the epoch must differ from ours (a real fencer always promoted
+        under a fresh one), and a demotion toward a dead/bogus peer
+        self-heals — the standby loop re-promotes after ``takeover_after``
+        of failed pulls."""
         if self.is_standby:
+            return
+        if epoch is not None and epoch == self.core.epoch:
+            logger.warning("ignoring demote carrying our own epoch")
             return
         logger.warning("demoted: %s took over while we were unreachable; "
                        "becoming its standby", new_primary)
@@ -882,7 +894,8 @@ class _ServerConn:
             peer = self.writer.get_extra_info("peername") or ("127.0.0.1",)
             await self._send({"t": "res", "id": rid, "ok": True,
                               "value": None})
-            await self.server.demote(f"{peer[0]}:{msg['port']}")
+            await self.server.demote(f"{peer[0]}:{msg['port']}",
+                                     epoch=msg.get("epoch"))
             return
         # a standby mirrors state but serves no clients: reject every op so
         # a multi-address RemoteControlPlane fails over to the primary

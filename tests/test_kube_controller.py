@@ -303,3 +303,213 @@ async def test_sla_planner_scales_pods_through_api_end_to_end():
         await ctrl.stop()
         await client.close()
         await server.stop()
+
+
+def gang_cr(name="mh", workers=2, nodes=4):
+    """A multi-host service: each replica is a gang of ``nodes`` pods."""
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "DynamoGraphDeployment",
+        "metadata": {"name": name},
+        "spec": {"services": {
+            "worker": {"replicas": workers, "multinode": nodes,
+                       "command": ["python", "-m", "w"]},
+        }},
+    }
+
+
+async def test_gang_create_all_or_nothing_and_scale_down():
+    """multinode services place whole pod gangs (ref: podgangset.go):
+    members carry rank/count/leader env, a replica is ready only when
+    every member runs, scale-down removes whole gangs newest-first."""
+    server, client = await _env()
+    crs = client.resource(GROUP, VERSION, "default", PLURAL)
+    pods = client.resource("", "v1", "default", "pods")
+    ctrl = await DynamoGraphController(client).start()
+    try:
+        await crs.create(gang_cr(workers=2, nodes=3))
+
+        async def settled(n):
+            async def p():
+                lst = await pods.list(label_selector=f"{LABEL_GRAPH}=mh")
+                return lst["items"] if len(lst["items"]) == n else None
+            return await _wait(p, msg=f"{n} pods")
+        items = await settled(6)
+        names = sorted(p["metadata"]["name"] for p in items)
+        assert names == [f"mh-worker-{r}-{h}" for r in range(2)
+                         for h in range(3)]
+        env0 = {e["name"]: e["value"] for e in
+                items[0]["spec"]["containers"][0]["env"]}
+        assert env0["DYN_MH_RANK"] == "0" and env0["DYN_MH_COUNT"] == "3"
+        assert env0["DYN_MH_LEADER"] == "mh-worker-0-0"
+        assert env0["DYN_POD_NAME"] == "mh-worker-0-0"
+        gangs = {p["metadata"]["labels"]["dynamo.tpu/gang"] for p in items}
+        assert gangs == {"mh-worker-0", "mh-worker-1"}
+
+        async def status_ready():
+            obj = await crs.get("mh")
+            st = obj.get("status") or {}
+            svc = (st.get("services") or {}).get("worker") or {}
+            return svc if svc.get("ready") == 2 else None
+        await _wait(status_ready, msg="both gangs ready")
+
+        # scale down 2 -> 1: the NEWEST whole gang goes, none of gang 0
+        cur = await crs.get("mh")
+        cur["spec"]["services"]["worker"]["replicas"] = 1
+        await crs.replace("mh", cur)
+        items = await settled(3)
+        assert {p["metadata"]["name"] for p in items} == {
+            "mh-worker-0-0", "mh-worker-0-1", "mh-worker-0-2"}
+    finally:
+        await ctrl.stop()
+        await client.close()
+        await server.stop()
+
+
+async def test_partial_gang_is_rolled_back():
+    """A gang member failing to place (quota) rolls back the whole gang —
+    a partially scheduled multi-host worker never starts."""
+    server, client = await _env()
+    crs = client.resource(GROUP, VERSION, "default", PLURAL)
+    pods = client.resource("", "v1", "default", "pods")
+    # fail the 3rd member of gang 1 a few times (reconcile retries)
+    server.fail_create = ("mh-worker-1-2", 3)
+    ctrl = await DynamoGraphController(client).start()
+    try:
+        await crs.create(gang_cr(workers=2, nodes=3))
+
+        async def gang0_up():
+            lst = await pods.list(label_selector=f"{LABEL_GRAPH}=mh")
+            names = {p["metadata"]["name"] for p in lst["items"]}
+            return names if {"mh-worker-0-0", "mh-worker-0-1",
+                             "mh-worker-0-2"} <= names else None
+        names = await _wait(gang0_up, msg="gang 0 placed")
+        # while the quota injection holds, gang 1 must be all-or-nothing.
+        # A partial set IS briefly observable inside the create→rollback
+        # window (separate HTTP calls); what must never happen is a partial
+        # gang PERSISTING — flag only a partial set seen twice in a row.
+        prev = None
+        for _ in range(12):
+            lst = await pods.list(label_selector=f"{LABEL_GRAPH}=mh")
+            g1 = frozenset(p["metadata"]["name"] for p in lst["items"]
+                           if p["metadata"]["labels"].get("dynamo.tpu/gang")
+                           == "mh-worker-1")
+            partial = g1 and g1 != frozenset(
+                {"mh-worker-1-0", "mh-worker-1-1", "mh-worker-1-2"})
+            assert not (partial and g1 == prev), f"partial gang persisted: {g1}"
+            prev = g1 if partial else None
+            await asyncio.sleep(0.07)
+
+        # once quota clears, the requeue loop completes gang 1 IN ITS OWN
+        # slot — no stray higher-index gangs from the failed attempts
+        async def all_up():
+            lst = await pods.list(label_selector=f"{LABEL_GRAPH}=mh")
+            names = sorted(p["metadata"]["name"] for p in lst["items"])
+            return names == [f"mh-worker-{r}-{h}" for r in range(2)
+                             for h in range(3)] or None
+        await _wait(all_up, timeout=10.0, msg="gang 1 completes in slot 1")
+    finally:
+        await ctrl.stop()
+        await client.close()
+        await server.stop()
+
+
+async def test_scale_down_cleans_discovery_keys():
+    """Scale-down deletes the removed pods' instances/ keys immediately,
+    and a service removed from the spec loses its whole discovery subtree
+    (ref: operator/internal/etcd/etcd.go:34, DeleteKeys by prefix)."""
+    import msgpack
+
+    from dynamo_tpu.runtime.control_plane import LocalControlPlane
+
+    server, client = await _env()
+    plane = LocalControlPlane()
+
+    def inst_val(pod):
+        return msgpack.packb({"namespace": "dynamo", "component": "c",
+                              "endpoint": "e", "lease": 1,
+                              "metadata": {"pod": pod}})
+
+    # discovery keys as live workers would write them, one per pod
+    await plane.kv_put("instances/dynamo/decode/e:aa", inst_val("g1-decode-0"))
+    await plane.kv_put("instances/dynamo/decode/e:bb", inst_val("g1-decode-1"))
+    await plane.kv_put("instances/dynamo/prefill/e:cc",
+                       inst_val("g1-prefill-0"))
+
+    crs = client.resource(GROUP, VERSION, "default", PLURAL)
+    pods = client.resource("", "v1", "default", "pods")
+    ctrl = await DynamoGraphController(client, plane=plane).start()
+    try:
+        await crs.create(graph_cr(prefill=1, decode=2))
+
+        async def n_pods(n):
+            lst = await pods.list(label_selector=f"{LABEL_GRAPH}=g1")
+            return len(lst["items"]) == n or None
+        await _wait(lambda: n_pods(3), msg="3 pods")
+
+        # scale decode 2 -> 1: victim's key goes, survivor's stays
+        cur = await crs.get("g1")
+        cur["spec"]["services"]["decode"]["replicas"] = 1
+        await crs.replace("g1", cur)
+        await _wait(lambda: n_pods(2), msg="scale down")
+
+        async def victim_key_gone():
+            keys = await plane.kv_get_prefix("instances/dynamo/")
+            return ("instances/dynamo/decode/e:bb" not in keys) or None
+        await _wait(victim_key_gone, msg="victim discovery key removed")
+        keys = await plane.kv_get_prefix("instances/dynamo/")
+        assert "instances/dynamo/decode/e:aa" in keys
+        assert "instances/dynamo/prefill/e:cc" in keys
+
+        # remove the prefill service entirely -> its subtree is wiped
+        cur = await crs.get("g1")
+        del cur["spec"]["services"]["prefill"]
+        await crs.replace("g1", cur)
+
+        async def prefill_gone():
+            keys = await plane.kv_get_prefix("instances/dynamo/")
+            return all(not k.startswith("instances/dynamo/prefill/")
+                       for k in keys) or None
+        await _wait(prefill_gone, msg="prefill subtree wiped")
+        keys = await plane.kv_get_prefix("instances/dynamo/")
+        assert "instances/dynamo/decode/e:aa" in keys  # untouched
+    finally:
+        await ctrl.stop()
+        await client.close()
+        await server.stop()
+
+
+async def test_single_to_multinode_migration_replaces_legacy_pods():
+    """Switching a service to multinode must retire the legacy single-node
+    pods and form proper gangs — not wedge on unparseable names."""
+    server, client = await _env()
+    crs = client.resource(GROUP, VERSION, "default", PLURAL)
+    pods = client.resource("", "v1", "default", "pods")
+    ctrl = await DynamoGraphController(client).start()
+    try:
+        cr = {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "DynamoGraphDeployment",
+            "metadata": {"name": "mig"},
+            "spec": {"services": {"worker": {"replicas": 2,
+                                             "command": ["w"]}}},
+        }
+        await crs.create(cr)
+
+        async def names_are(expect):
+            lst = await pods.list(label_selector=f"{LABEL_GRAPH}=mig")
+            names = sorted(p["metadata"]["name"] for p in lst["items"])
+            return names == expect or None
+        await _wait(lambda: names_are(["mig-worker-0", "mig-worker-1"]),
+                    msg="single-node pods")
+
+        cur = await crs.get("mig")
+        cur["spec"]["services"]["worker"] = {
+            "replicas": 1, "multinode": 2, "command": ["w"]}
+        await crs.replace("mig", cur)
+        await _wait(lambda: names_are(["mig-worker-0-0", "mig-worker-0-1"]),
+                    timeout=10.0, msg="gangs replace legacy pods")
+    finally:
+        await ctrl.stop()
+        await client.close()
+        await server.stop()
