@@ -34,10 +34,14 @@ from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.runtime.control_plane import ControlPlaneServer, RemoteControlPlane
 
 BLOCK_SIZE = 16
-CHAIN = 8  # blocks announced per stored event (a 128-token prefill chunk)
+#: blocks announced per stored event. 8 = a 128-token prefill chunk
+#: (conservative); --chain 125 models per-REQUEST batching of an ISL-2000
+#: prefill — the publish-batching lever the 70B sizing note relies on.
+CHAIN = 8
 
 
-async def _worker_load(i: int, plane, stop_at: float, counts: list[int]):
+async def _worker_load(i: int, plane, stop_at: float, counts: list[int],
+                       stored_counts: list[int], chain: int = CHAIN):
     """One worker's steady-state hub traffic: publish a stored chain, later
     remove it (LRU churn), heartbeat the lease, publish metrics."""
     kv = KvEventPublisher(plane, worker_id=i + 1, kv_block_size=BLOCK_SIZE)
@@ -46,18 +50,19 @@ async def _worker_load(i: int, plane, stop_at: float, counts: list[int]):
     base = (i + 1) << 32
     gen = 0
     while time.perf_counter() < stop_at:
-        hashes = [base + gen * CHAIN + j for j in range(CHAIN)]
+        hashes = [base + gen * chain + j for j in range(chain)]
         await kv.publish_stored(None, [
             StoredBlock(block_hash=h, tokens_hash=h) for h in hashes])
         counts[i] += 1
+        stored_counts[i] += 1
         if gen % 4 == 3:  # evict an older chain: 3:1 store:remove mix
-            old = [base + (gen - 3) * CHAIN + j for j in range(CHAIN)]
+            old = [base + (gen - 3) * chain + j for j in range(chain)]
             await kv.publish_removed(old)
             counts[i] += 1
         if gen % 8 == 0:
             await metrics.publish(ForwardPassMetrics(
                 worker_stats=WorkerStats(request_active_slots=4, request_total_slots=64),
-                kv_stats=KvStats(kv_active_blocks=CHAIN * 4, kv_total_blocks=1024,
+                kv_stats=KvStats(kv_active_blocks=chain * 4, kv_total_blocks=1024,
                                  gpu_cache_usage_perc=0.1)))
             await plane.lease_keepalive(lease)
         gen += 1
@@ -67,6 +72,8 @@ async def amain():
     ap = argparse.ArgumentParser(description="fleet-shaped hub ceiling bench")
     ap.add_argument("--workers", type=int, default=100)
     ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--chain", type=int, default=CHAIN,
+                    help="blocks per stored event (publish batching)")
     cli = ap.parse_args()
 
     server = ControlPlaneServer(port=0)
@@ -76,13 +83,16 @@ async def amain():
     indexer = await KvIndexer(router_plane, kv_block_size=BLOCK_SIZE).start()
 
     counts = [0] * cli.workers
+    stored_counts = [0] * cli.workers
     t0 = time.perf_counter()
     stop_at = t0 + cli.seconds
     await asyncio.gather(*(
-        _worker_load(i, p, stop_at, counts) for i, p in enumerate(planes)))
+        _worker_load(i, p, stop_at, counts, stored_counts, cli.chain)
+        for i, p in enumerate(planes)))
     dt = time.perf_counter() - t0
 
     published = sum(counts)
+    stored = sum(stored_counts)
     last = await router_plane.stream_last_seq("kv_events")
     lag = last - indexer._last_seq
     # give the consumer a moment to drain, then measure apply throughput
@@ -92,6 +102,9 @@ async def amain():
     out = {
         "workers": cli.workers,
         "events_per_s": round(published / dt, 1),
+        "stored_blocks_per_s": round(stored * cli.chain / dt, 1),
+        "removed_blocks_per_s": round((published - stored) * cli.chain / dt, 1),
+        "chain": cli.chain,
         "indexer_lag_events": int(lag),
         "indexer_applied": indexer.events_applied,
         "indexer_applied_per_s": round(

@@ -243,6 +243,18 @@ class DynamoGraphController:
                                 deleted_pods, dyn_ns) -> int:
         name = cr["metadata"]["name"]
 
+        # pods still carrying a gang label are leftovers of a multinode
+        # past (service reverted to single-node): their DYN_MH_* env would
+        # park the engine waiting for peers that will never exist — retire
+        # them and place plain replicas instead
+        keep = []
+        for pod in have:
+            if LABEL_GANG in pod["metadata"].get("labels", {}):
+                await self._delete_pod(pod["metadata"]["name"], deleted_pods)
+            else:
+                keep.append(pod)
+        have = keep
+
         def _index(pod):
             # numeric replica index, NOT lexicographic name order —
             # "-10" must sort after "-9" or scale-down kills the wrong pod
@@ -317,10 +329,35 @@ class DynamoGraphController:
             victim = existing.pop()
             for pod in gangs.get(victim, []):
                 await self._delete_pod(pod["metadata"]["name"], deleted_pods)
-        # repair incomplete gangs (a member died: recreate just the hole —
-        # the gang barrier keeps the survivors parked until it returns)
+        # repair gangs: recreate dead members (the gang barrier keeps the
+        # survivors parked until the hole returns) and retire stale ranks
+        # beyond a SHRUNK ``multinode`` — without that, a 4→3 edit leaves
+        # a 4th member forever and ready never reaches desired
+
+        def _rank(pod) -> int:
+            try:
+                return int(pod["metadata"]["name"].rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                return -1
+
+        def _mh_count(pod) -> str:
+            for e in pod.get("spec", {}).get("containers", [{}])[0] \
+                        .get("env", []):
+                if e.get("name") == "DYN_MH_COUNT":
+                    return e.get("value", "")
+            return ""
         for r in existing:
-            members = {p["metadata"]["name"] for p in gangs.get(r, [])}
+            members = set()
+            for pod in list(gangs.get(r, [])):
+                # a member past the (shrunk) rank range, or one whose
+                # baked-in DYN_MH_COUNT disagrees with the spec, would
+                # park the gang barrier forever — recreate it
+                if _rank(pod) >= nodes or _mh_count(pod) != str(nodes):
+                    await self._delete_pod(pod["metadata"]["name"],
+                                           deleted_pods)
+                    gangs[r].remove(pod)
+                else:
+                    members.add(pod["metadata"]["name"])
             for h in range(nodes):
                 pname = f"{pod_name(name, svc, r)}-{h}"
                 if gangs.get(r) and pname not in members:

@@ -49,6 +49,15 @@ class MockEngineArgs:
     decode_base_ms: float = 2.0
     decode_per_seq_ms: float = 0.05
     vocab_size: int = 1000
+    #: data-parallel ranks simulated by ONE mocker process (ref:
+    #: mocker/protocols.rs:95 + engine.rs:115-127 — one scheduler, KV-event
+    #: stream and metrics publisher per rank). Ranks surface as separate
+    #: instances on the endpoint, so the router sees per-rank event
+    #: interleaving exactly as it would from a real DP fleet.
+    dp_size: int = 1
+    #: simulated engine-initialization delay before serving (ref:
+    #: protocols.rs:98 startup_time)
+    startup_time: Optional[float] = None
 
 
 @dataclass

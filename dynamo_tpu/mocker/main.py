@@ -27,14 +27,41 @@ async def run_mocker(
     endpoint: str = "generate",
     lease_id=None,
 ):
-    lease = lease_id if lease_id is not None else await runtime.primary_lease()
-    kv_pub = KvEventPublisher(runtime.plane, worker_id=lease, kv_block_size=args.block_size)
-    await kv_pub.start_resync_responder()
-    metrics_pub = WorkerMetricsPublisher(runtime.plane, worker_id=lease)
-    engine = await MockEngine(args, kv_pub, metrics_pub).start()
+    """Start ``args.dp_size`` simulated ranks on one endpoint.
 
+    Each rank gets its own lease, scheduler, KV-event publisher and
+    metrics publisher (ref: mocker/engine.rs:115-127,199-296 — one of each
+    per DP rank), so the router observes the same per-rank event
+    interleaving a real DP fleet produces. Returns (engines, handles);
+    single-rank callers get 1-element lists."""
+    if args.startup_time:
+        await asyncio.sleep(args.startup_time)
     ep = runtime.namespace(namespace).component(component).endpoint(endpoint)
-    handle = await ep.serve_endpoint(engine.generate, lease_id=lease)
+    engines, handles = [], []
+    # start the runtime keepalive loop unconditionally — extra-rank leases
+    # are adopted into it so they cannot silently expire mid-run
+    primary = await runtime.primary_lease()
+    lease0 = None
+    for rank in range(max(1, args.dp_size)):
+        if rank == 0 and lease_id is not None:
+            lease = lease_id
+            runtime.adopt_lease(lease)
+        elif rank == 0:
+            lease = primary
+        else:
+            lease = await runtime.plane.lease_create(
+                runtime.config.lease_ttl)
+            runtime.adopt_lease(lease)
+        lease0 = lease0 if lease0 is not None else lease
+        kv_pub = KvEventPublisher(runtime.plane, worker_id=lease,
+                                  kv_block_size=args.block_size)
+        await kv_pub.start_resync_responder()
+        metrics_pub = WorkerMetricsPublisher(runtime.plane, worker_id=lease)
+        engine = await MockEngine(args, kv_pub, metrics_pub).start()
+        handle = await ep.serve_endpoint(engine.generate, lease_id=lease,
+                                         metadata={"dp_rank": rank})
+        engines.append(engine)
+        handles.append(handle)
     card = ModelDeploymentCard(
         display_name=model_name,
         kv_cache_block_size=args.block_size,
@@ -44,8 +71,8 @@ async def run_mocker(
     card.runtime_config.total_kv_blocks = args.num_gpu_blocks
     card.runtime_config.max_num_seqs = args.max_num_seqs
     card.runtime_config.max_num_batched_tokens = args.max_num_batched_tokens
-    await register_llm(runtime, ep, card, lease_id=lease)
-    return engine, handle
+    await register_llm(runtime, ep, card, lease_id=lease0)
+    return engines, handles
 
 
 async def amain():
@@ -58,6 +85,10 @@ async def amain():
     ap.add_argument("--max-num-seqs", type=int, default=256)
     ap.add_argument("--max-num-batched-tokens", type=int, default=8192)
     ap.add_argument("--speedup-ratio", type=float, default=1.0)
+    ap.add_argument("--dp-size", type=int, default=1,
+                    help="simulated DP ranks (one scheduler + KV event "
+                         "stream + metrics stream per rank)")
+    ap.add_argument("--startup-time", type=float, default=None)
     ap.add_argument("--no-prefix-caching", action="store_true")
     ap.add_argument(
         "--vocab-size", type=int, default=0,
@@ -80,8 +111,10 @@ async def amain():
         speedup_ratio=cli.speedup_ratio,
         enable_prefix_caching=not cli.no_prefix_caching,
         vocab_size=vocab_size,
+        dp_size=cli.dp_size,
+        startup_time=cli.startup_time,
     )
-    engine, handle = await run_mocker(
+    engines, handles = await run_mocker(
         runtime, cli.model, args, cli.namespace, cli.component
     )
     print("MOCKER_READY", flush=True)
@@ -91,8 +124,10 @@ async def amain():
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
-    await handle.stop()
-    await engine.stop()
+    for handle in handles:
+        await handle.stop()
+    for engine in engines:
+        await engine.stop()
     await runtime.shutdown()
 
 

@@ -82,9 +82,67 @@ class ArimaPredictor(BasePredictor):
         return max(0.0, pred)
 
 
+class SeasonalPredictor(BasePredictor):
+    """Season-aware forecaster (ref Prophet role: load_predictor.py:119 —
+    daily/hourly traffic cycles that an AR window flattens into lag).
+
+    Model: y(t) = bias + trend·t + seasonal[t mod P], fit by least squares
+    over the window. ``period=0`` auto-detects P as the autocorrelation
+    peak once two cycles of data exist. Falls back to the AR predictor
+    until a period is established — so it is never worse than "arima" on
+    aperiodic traffic."""
+
+    def __init__(self, window: int = 256, period: int = 0, **kw):
+        super().__init__(window=window, **kw)
+        self.period = period
+        self._ar = ArimaPredictor()
+
+    def add_data_point(self, value: float) -> None:
+        super().add_data_point(value)
+        self._ar.add_data_point(value)
+
+    def _detect_period(self, y: np.ndarray) -> int:
+        n = len(y)
+        yc = y - y.mean()
+        denom = float(yc @ yc)
+        if denom <= 0:
+            return 0
+        best_lag, best_r = 0, 0.35  # require a real cycle, not noise
+        for lag in range(3, n // 2):
+            r = float(yc[:-lag] @ yc[lag:]) / denom
+            if r > best_r:
+                best_lag, best_r = lag, r
+        return best_lag
+
+    def predict_next(self) -> Optional[float]:
+        n = len(self.data)
+        if n == 0:
+            return None
+        y = np.asarray(self.data, np.float64)
+        P = self.period or self._detect_period(y)
+        if P < 2 or n < 2 * P:
+            return self._ar.predict_next()
+        # least squares over [seasonal one-hot | t | 1]
+        t = np.arange(n, dtype=np.float64)
+        X = np.zeros((n, P + 2))
+        X[np.arange(n), np.arange(n) % P] = 1.0
+        X[:, P] = t
+        X[:, P + 1] = 1.0
+        b, *_ = np.linalg.lstsq(X, y, rcond=None)
+        x = np.zeros(P + 2)
+        x[n % P] = 1.0
+        x[P] = n
+        x[P + 1] = 1.0
+        pred = float(x @ b)
+        if not np.isfinite(pred):
+            return self.get_last_value()
+        return max(0.0, pred)
+
+
 def make_predictor(kind: str, **kw) -> BasePredictor:
     return {
         "constant": ConstantPredictor,
         "moving_average": MovingAveragePredictor,
         "arima": ArimaPredictor,
+        "seasonal": SeasonalPredictor,
     }[kind](**kw)

@@ -65,7 +65,11 @@ async def amain():
     ap.add_argument("--itl-sla-ms", type=float, default=20.0)
     ap.add_argument("--adjustment-interval", type=float, default=30.0)
     ap.add_argument("--predictor", default="arima",
-                    choices=["constant", "moving_average", "arima"])
+                    choices=["constant", "moving_average", "arima",
+                             "seasonal"])
+    ap.add_argument("--no-correction", action="store_true",
+                    help="freeze the adaptive TTFT/ITL correction factors "
+                         "(ref planner --no-correction)")
     ap.add_argument("--min-prefill", type=int, default=1)
     ap.add_argument("--max-prefill", type=int, default=64)
     ap.add_argument("--min-decode", type=int, default=1)
@@ -91,6 +95,7 @@ async def amain():
         max_decode_replicas=cli.max_decode,
         profiled_isl=profiled_isl,
         scale_down_patience=cli.scale_down_patience,
+        no_correction=cli.no_correction,
     )
     planner = Planner(cfg, prefill_perf, decode_perf)
 

@@ -46,9 +46,18 @@ class PlannerConfig:
     max_prefill_replicas: int = 64
     min_decode_replicas: int = 1
     max_decode_replicas: int = 64
-    #: multiplicative headroom on predicted load (ref: correction factors)
+    #: multiplicative headroom on predicted load (static operator knob)
     prefill_correction: float = 1.0
     decode_correction: float = 1.0
+    #: adaptive corrections (ref: planner_core.py:126-131,372-384): each
+    #: interval the observed TTFT/ITL is compared against what the profile
+    #: predicts at the observed per-replica load; the EMA'd ratio rescales
+    #: the SLA the capacity lookup uses (corrected_itl = itl / d_corr), so
+    #: systematic profile optimism/pessimism converges out of the loop
+    no_correction: bool = False
+    correction_ema: float = 0.5
+    correction_min: float = 0.25
+    correction_max: float = 8.0
     #: mean ISL the prefill sweep was profiled at; >0 scales prefill demand
     #: by predicted_isl/profiled_isl so longer prompts grow the fleet
     profiled_isl: float = 0.0
@@ -80,6 +89,9 @@ class Planner:
                                 cfg.min_decode_replicas)
         self._downscale_streak_p = 0
         self._downscale_streak_d = 0
+        #: adaptive observed/expected latency ratios (1.0 = profile exact)
+        self.p_correction_factor = 1.0
+        self.d_correction_factor = 1.0
 
     # -- observe -------------------------------------------------------------
 
@@ -87,6 +99,39 @@ class Planner:
         self._rate.add_data_point(obs.request_rate)
         self._isl.add_data_point(obs.isl)
         self._osl.add_data_point(obs.osl)
+        if not self.cfg.no_correction:
+            self._update_corrections(obs)
+
+    def _update_corrections(self, obs: Observation) -> None:
+        """EMA of observed/expected latency at the observed per-replica
+        load (ref: planner_core.py:372-384 recomputes the raw ratio every
+        interval; the EMA keeps one noisy interval from whipsawing the
+        fleet)."""
+        a = self.cfg.correction_ema
+        if obs.ttft_ms is not None and obs.request_rate > 0:
+            load = obs.request_rate / max(1, self.current.prefill_replicas)
+            if isinstance(self.prefill_perf, PerfInterpolator2D):
+                expect = self.prefill_perf.latency_at(load, obs.isl)
+            else:
+                # mirror compute()'s eff_rate ISL rescale: expectation must
+                # be read at the ISL-adjusted load, or ISL drift shows up
+                # BOTH here (as a rising correction) and there (as scaled
+                # demand) — double-provisioning the prefill fleet
+                if self.cfg.profiled_isl > 0 and obs.isl > 0:
+                    load *= obs.isl / self.cfg.profiled_isl
+                expect = self.prefill_perf.latency_at(load)
+            if expect > 0:
+                self.p_correction_factor = (
+                    (1 - a) * self.p_correction_factor
+                    + a * (obs.ttft_ms / expect))
+        if obs.itl_ms is not None and obs.request_rate > 0 and obs.osl > 0:
+            tok_load = (obs.request_rate * obs.osl
+                        / max(1, self.current.decode_replicas))
+            expect = self.decode_perf.latency_at(tok_load)
+            if expect > 0:
+                self.d_correction_factor = (
+                    (1 - a) * self.d_correction_factor
+                    + a * (obs.itl_ms / expect))
 
     # -- compute -------------------------------------------------------------
 
@@ -98,6 +143,20 @@ class Planner:
             return self.current  # no data yet
 
         cfg = self.cfg
+
+        def _clamp_corr(c: float) -> float:
+            return min(max(c, cfg.correction_min), cfg.correction_max)
+
+        # adaptive corrections rescale the SLA the capacity lookup uses —
+        # a profile found 2× optimistic (observed latency twice expected)
+        # makes the lookup answer "what load holds HALF the SLA", which is
+        # the load that holds the real SLA on the real system (ref:
+        # corrected_itl = self.args.itl / d_correction_factor)
+        p_corr = 1.0 if cfg.no_correction else _clamp_corr(
+            self.p_correction_factor)
+        d_corr = 1.0 if cfg.no_correction else _clamp_corr(
+            self.d_correction_factor)
+
         # prefill: per-replica sustainable request rate at the TTFT SLA.
         # With a 2D profile (TTFT over ISL × rate) the capacity comes from
         # the curve AT the predicted ISL; a 1D profile falls back to the
@@ -105,11 +164,12 @@ class Planner:
         eff_rate = rate
         if isinstance(self.prefill_perf, PerfInterpolator2D):
             per_replica_rate = self.prefill_perf.max_load_under(
-                cfg.ttft_sla_ms, isl)
+                cfg.ttft_sla_ms / p_corr, isl)
         else:
             if cfg.profiled_isl > 0 and isl > 0:
                 eff_rate = rate * (isl / cfg.profiled_isl)
-            per_replica_rate = self.prefill_perf.max_load_under(cfg.ttft_sla_ms)
+            per_replica_rate = self.prefill_perf.max_load_under(
+                cfg.ttft_sla_ms / p_corr)
         if per_replica_rate <= 0:
             p = cfg.max_prefill_replicas
         else:
@@ -117,7 +177,8 @@ class Planner:
 
         # decode: demanded decode tokens/s vs per-replica capacity at ITL SLA
         decode_demand = rate * osl
-        per_replica_tok = self.decode_perf.max_load_under(cfg.itl_sla_ms)
+        per_replica_tok = self.decode_perf.max_load_under(
+            cfg.itl_sla_ms / d_corr)
         if per_replica_tok <= 0:
             d = cfg.max_decode_replicas
         else:

@@ -48,7 +48,7 @@ async def start_worker(runtime, out: str, cli):
             if cli.vocab_size < 16:  # mocker samples ids in [10, vocab)
                 raise SystemExit("--vocab-size must be >= 16")
             margs.vocab_size = cli.vocab_size
-        engine, handle = await run_mocker(runtime, cli.model, margs)
+        (engine, *_), (handle, *_) = await run_mocker(runtime, cli.model, margs)
         return [handle]
 
     if out == "echo":

@@ -41,6 +41,9 @@ class DistributedRuntime:
         # key -> value written under the primary lease; replayed when the
         # hub restarts and the lease must be recreated (see _recover_lease)
         self._registrations: dict[str, bytes] = {}
+        #: secondary leases kept alive alongside the primary (DP-rank
+        #: instance identities — see adopt_lease)
+        self._extra_leases: set[int] = set()
         self._recover_lock = asyncio.Lock()
         # structured concurrency root (ref: utils/tasks/tracker.rs):
         # components spawn through runtime.tracker (or a child of it);
@@ -59,6 +62,15 @@ class DistributedRuntime:
 
     def record_registration(self, key: str, value: bytes) -> None:
         self._registrations[key] = value
+
+    def adopt_lease(self, lease_id: int) -> None:
+        """Keep a SECONDARY lease alive in the keepalive loop (DP-rank
+        instance identities each need their own lease — the instance key
+        embeds it). If such a lease is ever lost (hub restart, missed
+        TTLs), its recorded registrations are re-bound to the primary
+        lease: key NAMES (and so instance ids) stay stable, only the
+        backing TTL object changes."""
+        self._extra_leases.add(lease_id)
 
     def drop_registration(self, key: str) -> None:
         self._registrations.pop(key, None)
@@ -192,6 +204,29 @@ class DistributedRuntime:
                         self._shutdown_event.set()
                         return
                     continue
+                for extra in list(self._extra_leases):
+                    try:
+                        ok2 = await self.plane.lease_keepalive(extra)
+                    except Exception:
+                        continue  # transient; retried next tick
+                    if not ok2:
+                        # the rank's lease is gone (its keys with it):
+                        # re-bind its recorded keys to the primary lease —
+                        # identity (key names) is preserved
+                        self._extra_leases.discard(extra)
+                        suffix = f":{extra:x}"
+                        for key, value in list(self._registrations.items()):
+                            if key.endswith(suffix):
+                                try:
+                                    await self.plane.kv_put(
+                                        key, value,
+                                        lease_id=self._primary_lease)
+                                except Exception:
+                                    logger.exception(
+                                        "re-bind of %s failed", key)
+                        logger.warning(
+                            "secondary lease %x lost; its registrations "
+                            "re-bound to the primary lease", extra)
                 if not ok:
                     # the hub may have restarted (all lease state lost):
                     # recovery replays registrations under a fresh lease

@@ -45,7 +45,7 @@ async def stack():
 
     async def add_mocker(**kw):
         lease = await rt.plane.lease_create(30)
-        engine, handle = await run_mocker(rt, MODEL, mock_args(**kw), lease_id=lease)
+        (engine,), (handle,) = await run_mocker(rt, MODEL, mock_args(**kw), lease_id=lease)
         engines.append((engine, handle))
         return engine, handle
 
@@ -354,3 +354,55 @@ async def test_clear_kv_blocks_admin_token(stack, monkeypatch):
         r = await s.post(f"{base}/clear_kv_blocks",
                          headers={"Authorization": "Bearer s3cret"})
         assert r.status == 200  # no models yet → message payload
+
+
+async def test_dp_ranked_mocker_interleaves_per_rank_kv_events(stack):
+    """dp_size mocker (ref: mocker/protocols.rs:95, engine.rs:115-127):
+    one process simulates N DP ranks — N instances on the endpoint, each
+    with its own KV-event stream identity — and the router's indexer sees
+    per-rank event interleaving at fleet scale."""
+    from dynamo_tpu.router.indexer import KvIndexer
+
+    rt, service, add_mocker, manager = stack
+    lease = await rt.plane.lease_create(30)
+    engines, handles = await run_mocker(
+        rt, MODEL, mock_args(dp_size=3), lease_id=lease)
+    assert len(engines) == 3 and len(handles) == 3
+    try:
+        await wait_for_model(manager)
+        # 3 rank instances registered on the endpoint, rank metadata intact
+        ep = rt.namespace("dynamo").component("mocker").endpoint("generate")
+        client = await ep.client().start()
+        ids = await client.wait_for_instances(timeout=5)
+        assert len(set(ids)) == 3
+        ranks = sorted(int(i.metadata["dp_rank"]) for i in client.instances())
+        assert ranks == [0, 1, 2]
+
+        idx = await KvIndexer(rt.plane, kv_block_size=4).start()
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as http:
+            # CONCURRENT distinct prompts: the KV router sees in-flight
+            # load and spreads the batch over ranks (sequential requests
+            # against an idle fleet all argmin onto one worker)
+            async def one(i):
+                async with http.post(f"{base}/v1/completions", json={
+                    "model": MODEL, "prompt": f"prompt number {i} " * 6,
+                    "max_tokens": 32, "stream": False,
+                }) as resp:
+                    assert resp.status == 200, await resp.text()
+            await asyncio.gather(*(one(i) for i in range(24)))
+        # every rank decoded something and emitted ITS OWN stored events
+        rank_leases = {h.lease_id for h in handles}
+        def seen_workers():
+            return {w for w, _ in idx.tree._lookup}
+        for _ in range(100):
+            if rank_leases <= seen_workers():
+                break
+            await asyncio.sleep(0.05)
+        assert rank_leases <= seen_workers(), (rank_leases, seen_workers())
+        await idx.stop()
+    finally:
+        for h in handles:
+            await h.stop(graceful=False)
+        for e in engines:
+            await e.stop()

@@ -30,7 +30,7 @@ async def grpc_stack():
     manager = ModelManager()
     watcher = await ModelWatcher(rt, manager, router_mode="round_robin").start()
     tk = make_test_tokenizer()
-    engine, handle = await run_mocker(
+    (engine,), (handle,) = await run_mocker(
         rt, MODEL, MockEngineArgs(vocab_size=tk.vocab_size, block_size=4,
                                   num_gpu_blocks=256, speedup_ratio=20.0))
     service = KserveGrpcService(manager, port=0)

@@ -4,6 +4,7 @@ scaling e2e against the in-process control plane via VirtualConnector
 with no k8s)."""
 
 import asyncio
+import dataclasses
 import math
 
 import numpy as np
@@ -218,3 +219,74 @@ def test_profile_sla_recommendation_inverts_like_planner():
     rec2 = recommend(out, ttft_target_ms=50.0, itl_target_ms=5.0)
     assert "IMPOSSIBLE" in rec2["prefill_verdict"]
     assert "IMPOSSIBLE" in rec2["decode_verdict"]
+
+
+def test_seasonal_predictor_tracks_cycle():
+    """ref Prophet role (load_predictor.py:119): a cyclic load must be
+    forecast at its NEXT phase, not its mean (MA) or its lagged tail."""
+    from dynamo_tpu.planner.load_predictor import SeasonalPredictor
+
+    s = SeasonalPredictor(period=8)
+    auto = SeasonalPredictor(period=0)  # autocorrelation period detection
+    m = MovingAveragePredictor(window=8)
+    n = 30  # next sample lands at phase 30%8=6 → trough side, far from mean
+    xs = [10 + 5 * math.sin(2 * math.pi * i / 8) for i in range(n)]
+    for x in xs:
+        s.add_data_point(x)
+        auto.add_data_point(x)
+        m.add_data_point(x)
+    truth = 10 + 5 * math.sin(2 * math.pi * n / 8)
+    assert abs(s.predict_next() - truth) < 0.5
+    assert abs(auto.predict_next() - truth) < 0.5
+    assert abs(m.predict_next() - truth) > 3.0  # MA sits at the mean
+
+
+def test_seasonal_predictor_aperiodic_fallback():
+    from dynamo_tpu.planner.load_predictor import SeasonalPredictor
+
+    s = SeasonalPredictor(period=0)
+    for i in range(12):
+        s.add_data_point(float(i))  # pure ramp: no cycle to detect
+    assert s.predict_next() == pytest.approx(12.0, abs=0.5)
+
+
+def test_correction_factors_converge_on_optimistic_profile():
+    """Adaptive corrections (ref: planner_core.py:126-131,372-384): the
+    real system runs 2x the profiled latency; the correction loop must
+    converge the fleet to the size the REAL system needs and hold it
+    there, with both factors settling near 2."""
+    prefill = PerfInterpolator(PREFILL_SWEEP)
+    decode = PerfInterpolator(DECODE_SWEEP)
+    pl = make_planner(correction_ema=0.6)
+    TRUE_K = 2.0  # plant: latency = 2 x profile at every load
+    rate, isl, osl = 6.0, 1000, 250
+    history = []
+    for _ in range(12):
+        load = rate / pl.current.prefill_replicas
+        tok = rate * osl / pl.current.decode_replicas
+        obs = Observation(request_rate=rate, isl=isl, osl=osl,
+                          ttft_ms=prefill.latency_at(load) * TRUE_K,
+                          itl_ms=decode.latency_at(tok) * TRUE_K)
+        pl.observe(obs)
+        history.append(pl.compute())
+    assert pl.p_correction_factor == pytest.approx(TRUE_K, abs=0.3)
+    assert pl.d_correction_factor == pytest.approx(TRUE_K, abs=0.3)
+    # fixed point of the corrected loop = capacity at sla/K on the profile
+    expect_p = math.ceil(rate / prefill.max_load_under(200 / TRUE_K))
+    expect_d = math.ceil(rate * osl / decode.max_load_under(20 / TRUE_K))
+    assert [dataclasses.astuple(h) for h in history[-3:]] == \
+        [(expect_p, expect_d)] * 3
+    # and the corrected fleet is LARGER than the naive one would be
+    naive = make_planner(no_correction=True)
+    naive.observe(Observation(request_rate=rate, isl=isl, osl=osl))
+    nd = naive.compute()
+    assert expect_p > nd.prefill_replicas
+    assert expect_d > nd.decode_replicas
+
+
+def test_no_correction_flag_freezes_factors():
+    pl = make_planner(no_correction=True)
+    pl.observe(Observation(request_rate=4.0, isl=1000, osl=250,
+                           ttft_ms=5000.0, itl_ms=500.0))
+    assert pl.p_correction_factor == 1.0
+    assert pl.d_correction_factor == 1.0
