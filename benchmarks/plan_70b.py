@@ -1,0 +1,181 @@
+"""Paper-exercise Llama-3-70B on a v5e-64 slice (VERDICT r4 #9).
+
+Two parts:
+
+1. **Sharded compile proof**: AOT-compile the production decode step at
+   70B LAYER SHAPES (hidden 8192, heads 64/8, ffn 28672) over a TP=8
+   virtual mesh, depth-reduced to a few scan steps — ``lax.scan`` over
+   layers means the compiled program is identical modulo the leading L
+   dim, so this validates the 70B shardings without 141 GB of arrays.
+
+2. **Budget + roofline solver**: exact per-chip HBM accounting (weights /
+   KV split) and the KV-capacity-coupled decode roofline for every
+   (tp, weight dtype, KV dtype) combo — decode throughput on v5e is
+   bandwidth-bound, and at ISL 2000 the reachable batch is capped by KV
+   residency, which feeds back into how well weight reads amortize.
+
+Prints one JSON line; the markdown table for PERF_NOTES goes to stderr.
+
+Usage: JAX_PLATFORMS=cpu python -m benchmarks.plan_70b [--compile]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+HBM_PER_CHIP = 16e9          # v5e
+HBM_BW = 819e9               # bytes/s
+RUNTIME_OVERHEAD = 1.5e9     # XLA prealloc, activations, framework slack
+ISL, OSL = 2000, 256         # reference harness default workload
+AVG_KV = ISL + OSL // 2      # mean resident context during decode
+
+
+def model_bytes(cfg, dtype_bytes: float) -> int:
+    """Exact parameter bytes for the llama3_70b preset."""
+    D, F, V, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    per_layer = (D * H * hd + 2 * D * KV * hd + H * hd * D  # q k v o
+                 + 3 * D * F                                # gate up down
+                 + 2 * D)                                   # norms (f32-ish, ~0)
+    total = L * per_layer + 2 * V * D + D                   # embed + head + norm
+    return int(total * dtype_bytes)
+
+
+def kv_bytes_per_token_per_chip(cfg, tp: int, kv_dtype_bytes: float) -> float:
+    """K+V bytes one context token occupies on ONE chip (KV heads shard
+    over tp; tp > num_kv_heads replicates heads, capping the win)."""
+    heads_per_chip = max(cfg.num_kv_heads / tp, 1.0)
+    scale = 4.0 / 16 if kv_dtype_bytes == 1 else 0.0  # int8: f32 scale per (slot, head)
+    return 2 * cfg.num_layers * heads_per_chip * (cfg.head_dim * kv_dtype_bytes + scale)
+
+
+def solve(cfg, tp: int, w_bytes: float, kv_b: float) -> dict:
+    """Per-worker batch the HBM budget allows, and the decode roofline at
+    that batch. Returns Nones when weights alone do not fit."""
+    w_per_chip = model_bytes(cfg, w_bytes) / tp
+    kv_room = HBM_PER_CHIP - RUNTIME_OVERHEAD - w_per_chip
+    if kv_room <= 0:
+        return {"fits": False, "weights_gb_chip": round(w_per_chip / 1e9, 1)}
+    kvpt = kv_bytes_per_token_per_chip(cfg, tp, kv_b)
+    max_tokens = int(kv_room / kvpt)
+    batch = max_tokens // (ISL + OSL)  # each seq holds its full context
+    if batch == 0:
+        return {"fits": False, "weights_gb_chip": round(w_per_chip / 1e9, 1),
+                "note": "KV room < one sequence"}
+    # bandwidth-bound step: weights once + every seq's context once
+    step_bytes = w_per_chip + batch * AVG_KV * kvpt
+    step_s = step_bytes / HBM_BW
+    tok_s_worker = batch / step_s
+    return {
+        "fits": True,
+        "weights_gb_chip": round(w_per_chip / 1e9, 1),
+        "kv_room_gb_chip": round(kv_room / 1e9, 1),
+        "kv_bytes_per_tok_chip": int(kvpt),
+        "max_batch_per_worker": batch,
+        "step_ms_roofline": round(step_s * 1e3, 1),
+        "tok_s_per_chip_roofline": int(tok_s_worker / tp),
+        "tok_s_per_chip_at_60pct": int(0.6 * tok_s_worker / tp),
+    }
+
+
+def compile_proof(tp: int = 8, layers: int = 2) -> dict:
+    """AOT-compile the decode step at 70B layer shapes over a TP mesh."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={tp}").strip()
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    full = ModelConfig.llama3_70b()
+    cfg = ModelConfig(**{**full.__dict__, "num_layers": layers})
+    mesh = make_mesh(MeshConfig(dp=1, sp=1, tp=tp))
+    block_size, num_blocks, B, W = 16, 64, 8, 16
+
+    params = jax.eval_shape(functools.partial(M.init_params, cfg),
+                            jax.random.key(0))
+    kc = jax.ShapeDtypeStruct((cfg.num_layers, num_blocks * block_size,
+                               cfg.num_kv_heads, cfg.head_dim),
+                              jnp.dtype(cfg.dtype))
+    args = (
+        params,
+        jax.ShapeDtypeStruct((B, 1), jnp.int32),      # tokens
+        jax.ShapeDtypeStruct((B, 1), jnp.int32),      # positions
+        jax.ShapeDtypeStruct((B, 1), jnp.int32),      # slot_map
+        jax.ShapeDtypeStruct((B, W), jnp.int32),      # block_tables
+        jax.ShapeDtypeStruct((B,), jnp.int32),        # kv_lens
+        jax.ShapeDtypeStruct((B,), jnp.int32),        # last_idx
+        kc, kc,
+    )
+    fn = functools.partial(M.forward, cfg=cfg, block_size=block_size,
+                           mesh=mesh)
+    sh_params = M.param_shardings(cfg, mesh)
+    sh_cache = M.cache_shardings(mesh, cfg)
+    bs = M.batch_shardings(mesh)
+    in_sh = (sh_params, bs["tokens"], bs["positions"], bs["slot_map"],
+             bs["block_tables"], bs["kv_lens"], bs["last_idx"],
+             sh_cache, sh_cache)
+    with mesh:
+        lowered = jax.jit(fn, in_shardings=in_sh).lower(*args)
+        compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    return {
+        "tp": tp, "layers": layers,
+        "argument_gb": round(ma.argument_size_in_bytes / 1e9, 2),
+        "temp_gb": round(ma.temp_size_in_bytes / 1e9, 3),
+        "output_gb": round(ma.output_size_in_bytes / 1e9, 3),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--compile", action="store_true",
+                    help="also AOT-compile the sharded step (slow on 1 core)")
+    cli = ap.parse_args()
+
+    from dynamo_tpu.engine.config import ModelConfig
+    cfg = ModelConfig.llama3_70b()
+
+    combos = {}
+    for tp in (8, 16):
+        for wname, wb in (("bf16", 2.0), ("int8", 1.0), ("int4", 0.5)):
+            for kname, kb in (("bf16", 2.0), ("int8", 1.0)):
+                combos[f"tp{tp}_w{wname}_kv{kname}"] = solve(cfg, tp, wb, kb)
+
+    out = {
+        "model": "llama3-70b",
+        "workload": f"ISL={ISL} OSL={OSL} (benchmarking.md:33)",
+        "params_b": round(model_bytes(cfg, 1.0) / 1e9, 1),
+        "combos": combos,
+    }
+    if cli.compile:
+        out["compile_proof"] = compile_proof()
+
+    # human table to stderr
+    print("| config | w GB/chip | KV room | max B/worker | roofline tok/s/chip | @60% |",
+          file=sys.stderr)
+    print("|---|---|---|---|---|---|", file=sys.stderr)
+    for k, v in combos.items():
+        if not v.get("fits"):
+            print(f"| {k} | {v['weights_gb_chip']} | DOES NOT FIT | - | - | - |",
+                  file=sys.stderr)
+        else:
+            print(f"| {k} | {v['weights_gb_chip']} | {v['kv_room_gb_chip']} | "
+                  f"{v['max_batch_per_worker']} | {v['tok_s_per_chip_roofline']} | "
+                  f"{v['tok_s_per_chip_at_60pct']} |", file=sys.stderr)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,16 @@ def pod_name(graph: str, service: str, index: int) -> str:
     return f"{graph}-{service}-{index}"
 
 
+def _trailing_int(name: str, depth: int = 1) -> int:
+    """``depth``-th dash-separated suffix of a pod name as an int, -1 when
+    absent/non-numeric — the one place pod-name indices are parsed (replica
+    index at depth 1; gang replica at depth 2 for ``…-{replica}-{rank}``)."""
+    try:
+        return int(name.rsplit("-", depth)[1])
+    except (IndexError, ValueError):
+        return -1
+
+
 class DynamoGraphController:
     """``plane``: optional control-plane client for discovery hygiene — on
     scale-down/teardown the controller deletes the removed pods' (and
@@ -255,14 +265,10 @@ class DynamoGraphController:
                 keep.append(pod)
         have = keep
 
-        def _index(pod):
-            # numeric replica index, NOT lexicographic name order —
-            # "-10" must sort after "-9" or scale-down kills the wrong pod
-            try:
-                return int(pod["metadata"]["name"].rsplit("-", 1)[1])
-            except (IndexError, ValueError):
-                return -1
-        have = sorted(have, key=_index)
+        # sort by numeric replica index, NOT lexicographic name order —
+        # "-10" must sort after "-9" or scale-down kills the wrong pod
+        have = sorted(have, key=lambda p: _trailing_int(
+            p["metadata"]["name"]))
         # create missing replicas at the first free indices
         used = {p["metadata"]["name"] for p in have}
         idx = 0
@@ -294,10 +300,7 @@ class DynamoGraphController:
         name = cr["metadata"]["name"]
         gangs: dict[int, list[dict]] = {}
         for pod in have:
-            try:
-                r = int(pod["metadata"]["name"].rsplit("-", 2)[1])
-            except (IndexError, ValueError):
-                r = -1
+            r = _trailing_int(pod["metadata"]["name"], depth=2)
             if r < 0 or LABEL_GANG not in pod["metadata"].get("labels", {}):
                 # legacy single-node pod (service switched to multinode) or
                 # an unparseable stray: it can never join a gang — replace
@@ -334,12 +337,6 @@ class DynamoGraphController:
         # beyond a SHRUNK ``multinode`` — without that, a 4→3 edit leaves
         # a 4th member forever and ready never reaches desired
 
-        def _rank(pod) -> int:
-            try:
-                return int(pod["metadata"]["name"].rsplit("-", 1)[1])
-            except (IndexError, ValueError):
-                return -1
-
         def _mh_count(pod) -> str:
             for e in pod.get("spec", {}).get("containers", [{}])[0] \
                         .get("env", []):
@@ -352,7 +349,8 @@ class DynamoGraphController:
                 # a member past the (shrunk) rank range, or one whose
                 # baked-in DYN_MH_COUNT disagrees with the spec, would
                 # park the gang barrier forever — recreate it
-                if _rank(pod) >= nodes or _mh_count(pod) != str(nodes):
+                if (_trailing_int(pod["metadata"]["name"]) >= nodes
+                        or _mh_count(pod) != str(nodes)):
                     await self._delete_pod(pod["metadata"]["name"],
                                            deleted_pods)
                     gangs[r].remove(pod)

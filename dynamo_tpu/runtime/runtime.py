@@ -214,9 +214,12 @@ class DistributedRuntime:
                         # re-bind its recorded keys to the primary lease —
                         # identity (key names) is preserved
                         self._extra_leases.discard(extra)
-                        suffix = f":{extra:x}"
+                        # a lease id appears as ':<hex>' in instance keys
+                        # and as a '/<hex>/' path segment in models/ keys
+                        # (llm/model_card.py) — re-bind both kinds
+                        pats = (f":{extra:x}", f"/{extra:x}/")
                         for key, value in list(self._registrations.items()):
-                            if key.endswith(suffix):
+                            if key.endswith(pats[0]) or pats[1] in key:
                                 try:
                                     await self.plane.kv_put(
                                         key, value,
