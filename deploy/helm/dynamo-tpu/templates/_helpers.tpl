@@ -1,0 +1,10 @@
+{{- define "dynamo-tpu.primaryAddr" -}}
+{{ .Release.Name }}-dynctl-0.{{ .Release.Name }}-dynctl:{{ .Values.controlPlane.port }}
+{{- end -}}
+{{- define "dynamo-tpu.planeList" -}}
+{{- if .Values.controlPlane.standby -}}
+{{ include "dynamo-tpu.primaryAddr" . }},{{ .Release.Name }}-dynctl-1.{{ .Release.Name }}-dynctl:{{ .Values.controlPlane.port }}
+{{- else -}}
+{{ include "dynamo-tpu.primaryAddr" . }}
+{{- end -}}
+{{- end -}}

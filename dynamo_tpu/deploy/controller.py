@@ -503,3 +503,43 @@ class DynamoGraphController:
                 return
         logger.warning("status update for %s lost 5 conflicts; giving up "
                        "until next reconcile", name)
+
+
+async def _amain():
+    """``python -m dynamo_tpu.deploy.controller`` — run the reconciler
+    in-cluster (serviceaccount mount) or against --kube-api for dev. With
+    DYN_CONTROL_PLANE set, scale-down discovery cleanup is active."""
+    import argparse
+    import os
+
+    from dynamo_tpu.runtime.config import setup_logging
+
+    setup_logging()
+    ap = argparse.ArgumentParser(description="DynamoGraphDeployment operator")
+    ap.add_argument("--namespace", default=os.environ.get(
+        "POD_NAMESPACE", "default"))
+    ap.add_argument("--kube-api", default=None,
+                    help="apiserver base URL (default: in-cluster config)")
+    ap.add_argument("--dynamo-namespace", default="dynamo")
+    args = ap.parse_args()
+
+    client = (KubeClient(args.kube_api) if args.kube_api
+              else KubeClient.in_cluster())
+    plane = None
+    if os.environ.get("DYN_CONTROL_PLANE"):
+        from dynamo_tpu.runtime.control_plane import RemoteControlPlane
+        plane = await RemoteControlPlane(
+            os.environ["DYN_CONTROL_PLANE"]).connect()
+    ctrl = await DynamoGraphController(
+        client, namespace=args.namespace, plane=plane,
+        dynamo_namespace=args.dynamo_namespace).start()
+    print("CONTROLLER_READY", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await ctrl.stop()
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(_amain())
