@@ -6,3 +6,11 @@ from dynamo_tpu.perf.logprobs import (  # noqa: F401
     analyze_logprob_sensitivity,
     compare_runs,
 )
+from dynamo_tpu.perf.recording import (  # noqa: F401
+    LatencySummary,
+    RecordedStream,
+    StreamRecorder,
+    TimestampedResponse,
+    record_stream,
+    summarize,
+)

@@ -1,0 +1,196 @@
+"""Response-stream recording: timestamps on a live stream, latency analysis.
+
+Rebuild of the reference's perf recording framework (ref:
+lib/llm/src/perf.rs:32-336 — TimestampedResponse / RecordedStream /
+RecordingStream with Sink vs Passthrough modes and the record_stream
+constructors): wrap any async response stream so every item is
+timestamped as it leaves the engine, then analyze the recording —
+TTFT, inter-token gaps, duration, token rate — or aggregate many
+recordings into the percentile summary a load harness needs.
+
+The recorder is transport-agnostic: it wraps the async iterators the
+pipeline and frontend already pass around (engine outputs, SSE deltas,
+router streams), adds no buffering in passthrough mode, and defers all
+analysis to after the stream closes.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Iterable, Optional
+
+
+@dataclass
+class TimestampedResponse:
+    """One stream item + when it arrived (ref: perf.rs:32 — sequence
+    number and elapsed-since-start, not wall clock, so recordings are
+    comparable across hosts)."""
+
+    data: Any
+    sequence: int
+    t_rel: float  # seconds since the stream was wrapped
+
+
+@dataclass
+class RecordedStream:
+    """A finished stream's timeline (ref: perf.rs:84-135)."""
+
+    responses: list[TimestampedResponse] = field(default_factory=list)
+    start_time: float = 0.0          # wall clock, informational
+    total_duration: float = 0.0      # first wrap → stream close
+    request_id: Optional[str] = None
+
+    @property
+    def response_count(self) -> int:
+        return len(self.responses)
+
+    # -- latency views ----------------------------------------------------
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time to the FIRST response (the stream-level TTFT analog)."""
+        return self.responses[0].t_rel if self.responses else None
+
+    @property
+    def inter_arrival_gaps(self) -> list[float]:
+        """Gaps between consecutive responses (the ITL analog when one
+        response ≈ one token)."""
+        ts = [r.t_rel for r in self.responses]
+        return [b - a for a, b in zip(ts, ts[1:])]
+
+    @property
+    def responses_per_s(self) -> float:
+        if self.total_duration <= 0 or not self.responses:
+            return 0.0
+        return len(self.responses) / self.total_duration
+
+    # -- serialization (offline analysis / recorder integration) ----------
+    def to_obj(self, data_fn: Callable[[Any], Any] = lambda d: d) -> dict:
+        return {
+            "request_id": self.request_id,
+            "start_time": self.start_time,
+            "total_duration": self.total_duration,
+            "responses": [
+                {"seq": r.sequence, "t": r.t_rel, "data": data_fn(r.data)}
+                for r in self.responses
+            ],
+        }
+
+    @staticmethod
+    def from_obj(d: dict) -> "RecordedStream":
+        return RecordedStream(
+            responses=[TimestampedResponse(r.get("data"), r["seq"], r["t"])
+                       for r in d.get("responses", [])],
+            start_time=d.get("start_time", 0.0),
+            total_duration=d.get("total_duration", 0.0),
+            request_id=d.get("request_id"),
+        )
+
+
+class StreamRecorder:
+    """Wraps an async iterator; the recording fills in as items flow.
+
+    ``passthrough`` (default) re-yields every item to the caller —
+    recording is invisible to the consumer (ref RecordingMode::
+    Passthrough). ``sink()`` consumes the stream internally and returns
+    the finished recording (ref RecordingMode::Sink)."""
+
+    def __init__(self, stream: AsyncIterator, request_id: Optional[str] = None,
+                 keep_data: bool = True):
+        self._stream = stream
+        self.recording = RecordedStream(start_time=time.time(),
+                                        request_id=request_id)
+        self._keep_data = keep_data
+        self._t0 = time.perf_counter()
+
+    async def __aiter__(self):
+        seq = 0
+        try:
+            async for item in self._stream:
+                self.recording.responses.append(TimestampedResponse(
+                    item if self._keep_data else None, seq,
+                    time.perf_counter() - self._t0))
+                seq += 1
+                yield item
+        finally:
+            self.recording.total_duration = time.perf_counter() - self._t0
+
+    async def sink(self) -> RecordedStream:
+        async for _ in self:
+            pass
+        return self.recording
+
+
+def record_stream(stream: AsyncIterator, request_id: Optional[str] = None,
+                  keep_data: bool = True) -> StreamRecorder:
+    """Passthrough-record ``stream`` (ref: perf.rs:272 record_stream).
+
+    Use ``async for item in recorder: ...`` then read
+    ``recorder.recording``; or ``await recorder.sink()`` to consume."""
+    return StreamRecorder(stream, request_id=request_id, keep_data=keep_data)
+
+
+# -------------------------------------------------------------- aggregation
+
+def _percentile(sorted_xs: list[float], q: float) -> float:
+    if not sorted_xs:
+        return math.nan
+    idx = min(len(sorted_xs) - 1, max(0, int(round(q * (len(sorted_xs) - 1)))))
+    return sorted_xs[idx]
+
+
+@dataclass
+class LatencySummary:
+    """Fleet/run-level percentile table over many recordings — the
+    genai-perf-style summary (ref methodology:
+    docs/benchmarks/benchmarking.md:33)."""
+
+    count: int
+    ttft_p50: float
+    ttft_p95: float
+    ttft_p99: float
+    gap_p50: float
+    gap_p95: float
+    duration_p50: float
+    duration_p95: float
+    responses_per_s_mean: float
+
+    def to_obj(self) -> dict:
+        return {k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in self.__dict__.items()}
+
+
+def summarize(recordings: Iterable[RecordedStream]) -> LatencySummary:
+    recs = [r for r in recordings if r.response_count]
+    ttfts = sorted(r.ttft for r in recs)
+    gaps = sorted(g for r in recs for g in r.inter_arrival_gaps)
+    durs = sorted(r.total_duration for r in recs)
+    rates = [r.responses_per_s for r in recs]
+    return LatencySummary(
+        count=len(recs),
+        ttft_p50=_percentile(ttfts, 0.50),
+        ttft_p95=_percentile(ttfts, 0.95),
+        ttft_p99=_percentile(ttfts, 0.99),
+        gap_p50=_percentile(gaps, 0.50),
+        gap_p95=_percentile(gaps, 0.95),
+        duration_p50=_percentile(durs, 0.50),
+        duration_p95=_percentile(durs, 0.95),
+        responses_per_s_mean=(sum(rates) / len(rates)) if rates else 0.0,
+    )
+
+
+def dump_jsonl(recordings: Iterable[RecordedStream], path: str,
+               data_fn: Callable[[Any], Any] = lambda d: None) -> None:
+    """One recording per line; ``data_fn`` controls payload serialization
+    (default drops payloads — timelines are usually what analysis needs)."""
+    with open(path, "w") as f:
+        for rec in recordings:
+            f.write(json.dumps(rec.to_obj(data_fn)) + "\n")
+
+
+def load_jsonl(path: str) -> list[RecordedStream]:
+    with open(path) as f:
+        return [RecordedStream.from_obj(json.loads(line))
+                for line in f if line.strip()]

@@ -161,3 +161,79 @@ def test_chat_logprobs_true_without_top_logprobs():
         {**body, "logprobs": True, "top_logprobs": 3}).output.logprobs == 3
     assert parse_chat_request({**body, "logprobs": False}).output.logprobs is None
     assert parse_chat_request(body).output.logprobs is None
+
+
+# ------------------------------------------------- stream recording (r5)
+
+@pytest.mark.anyio
+async def test_record_stream_passthrough_and_timing():
+    """Passthrough recording is invisible to the consumer and captures a
+    faithful timeline (ref: perf.rs RecordingMode::Passthrough)."""
+    import asyncio
+
+    from dynamo_tpu.perf import record_stream, summarize
+
+    async def gen():
+        for i in range(5):
+            await asyncio.sleep(0.02)
+            yield {"token": i}
+
+    rec = record_stream(gen(), request_id="r1")
+    got = [item async for item in rec]
+    assert got == [{"token": i} for i in range(5)]
+
+    r = rec.recording
+    assert r.response_count == 5 and r.request_id == "r1"
+    assert r.ttft == pytest.approx(0.02, abs=0.05)
+    gaps = r.inter_arrival_gaps
+    assert len(gaps) == 4
+    assert all(0.005 < g < 0.2 for g in gaps)
+    assert r.total_duration >= 5 * 0.015
+    assert r.responses_per_s > 0
+
+    s = summarize([r])
+    assert s.count == 1 and s.ttft_p50 == pytest.approx(r.ttft)
+
+
+@pytest.mark.anyio
+async def test_record_stream_sink_and_jsonl_roundtrip(tmp_path):
+    from dynamo_tpu.perf import record_stream, summarize
+    from dynamo_tpu.perf.recording import dump_jsonl, load_jsonl
+
+    async def gen(n):
+        for i in range(n):
+            yield i
+
+    recs = []
+    for n in (3, 7):
+        recs.append(await record_stream(gen(n)).sink())
+    assert [r.response_count for r in recs] == [3, 7]
+
+    path = str(tmp_path / "recs.jsonl")
+    dump_jsonl(recs, path)
+    loaded = load_jsonl(path)
+    assert [r.response_count for r in loaded] == [3, 7]
+    # timelines survive the roundtrip; payloads default to dropped
+    assert loaded[1].responses[6].t_rel == recs[1].responses[6].t_rel
+    assert loaded[0].responses[0].data is None
+    s = summarize(loaded)
+    assert s.count == 2
+
+
+@pytest.mark.anyio
+async def test_record_stream_partial_consumption_still_closes_timing():
+    """A consumer that abandons the stream mid-way still gets a coherent
+    recording (total_duration set in the finally)."""
+    from dynamo_tpu.perf import record_stream
+
+    async def gen():
+        for i in range(100):
+            yield i
+
+    rec = record_stream(gen())
+    agen = rec.__aiter__()
+    for _ in range(3):
+        await agen.__anext__()
+    await agen.aclose()
+    assert rec.recording.response_count == 3
+    assert rec.recording.total_duration > 0
