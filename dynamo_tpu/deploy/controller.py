@@ -42,6 +42,7 @@ PLURAL = "dynamographdeployments"
 LABEL_GRAPH = "dynamo.tpu/graph"
 LABEL_SERVICE = "dynamo.tpu/service"
 LABEL_GANG = "dynamo.tpu/gang"
+FINALIZER = "dynamo.tpu/cleanup"
 
 
 def pod_name(graph: str, service: str, index: int) -> str:
@@ -212,6 +213,36 @@ class DynamoGraphController:
         dyn_ns = ((cr.get("spec") or {}).get("dynamoNamespace")
                   or self.dynamo_namespace)
         self._graph_ns[name] = dyn_ns
+
+        # finalizer protocol (ref: controller_common/finalizer.go): our
+        # finalizer pins a deleted CR until pods AND discovery keys are
+        # gone — guaranteed teardown even if the controller restarts
+        # mid-delete (the terminating CR persists and re-triggers this)
+        md = cr["metadata"]
+        if md.get("deletionTimestamp"):
+            for pods in by_service.values():
+                for pod in pods:
+                    await self._delete_pod(pod["metadata"]["name"],
+                                           deleted_pods)
+            # cleanup is keyed off the SPEC's services (still present on a
+            # terminating CR) — a crash between pod deletion and cleanup
+            # must not skip the keys on resume, when no pods are left to
+            # observe the service names from
+            svcs = set((cr.get("spec") or {}).get("services") or {}) \
+                | set(by_service)
+            await self._cleanup_discovery(
+                deleted_pods, services=sorted(svcs), dyn_ns=dyn_ns)
+            if by_service:
+                # pods may be Terminating (grace period, stuck node) — on
+                # a real apiserver DELETE is async. Keep the finalizer
+                # until a reconcile observes ZERO owned pods.
+                asyncio.get_running_loop().call_later(
+                    0.5, self._enqueue, name)
+                return
+            await self._set_finalizer(name, present=False)
+            return
+        if FINALIZER not in (md.get("finalizers") or []):
+            await self._set_finalizer(name, present=True)
         services = (cr.get("spec") or {}).get("services") or {}
         status_services = {}
         all_ready = True
@@ -460,6 +491,29 @@ class DynamoGraphController:
                         for k, v in env.items()],
             }]},
         }
+
+    async def _set_finalizer(self, name: str, present: bool):
+        """Optimistic add/remove of OUR finalizer: a fresh read + full PUT
+        carrying its resourceVersion, so a concurrent writer (another
+        controller's finalizer, a spec edit) 409s us instead of being
+        clobbered by a blind merge of the whole list. Races settle on the
+        next reconcile — the event that beat us re-enqueues this CR."""
+        try:
+            cur = await self.crs.get(name)
+        except NotFound:
+            return
+        fins = list(cur["metadata"].get("finalizers") or [])
+        if present == (FINALIZER in fins):
+            return
+        if present:
+            fins.append(FINALIZER)
+        else:
+            fins.remove(FINALIZER)
+        cur["metadata"]["finalizers"] = fins
+        try:
+            await self.crs.replace(name, cur)
+        except (Conflict, NotFound):
+            pass
 
     async def _delete_pod(self, pname: str, deleted: Optional[list] = None):
         try:

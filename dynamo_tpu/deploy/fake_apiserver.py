@@ -34,6 +34,7 @@ import asyncio
 import copy
 import json
 import logging
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -277,6 +278,13 @@ class FakeKubeApiServer:
                 md["namespace"] = ns
                 md["name"] = name
                 md["generation"] = obj["metadata"]["generation"]
+                # deletionTimestamp is server-owned: a replace can neither
+                # set nor clear it (k8s contract — only finalizer removal
+                # lets a terminating object go)
+                md.pop("deletionTimestamp", None)
+                if obj["metadata"].get("deletionTimestamp"):
+                    md["deletionTimestamp"] = \
+                        obj["metadata"]["deletionTimestamp"]
                 body["status"] = preserved_status
                 kind.objs[(ns, name)] = obj = body
             else:
@@ -285,13 +293,31 @@ class FakeKubeApiServer:
         if json.dumps(obj.get("spec"), sort_keys=True) != spec_before:
             obj["metadata"]["generation"] = obj["metadata"].get("generation", 1) + 1
         obj["metadata"]["resourceVersion"] = str(self.next_rv())
+        # a terminating object whose LAST finalizer was just removed is
+        # collected now (k8s finalizer contract)
+        if (obj["metadata"].get("deletionTimestamp")
+                and not obj["metadata"].get("finalizers")):
+            kind.objs.pop((ns, name), None)
+            kind._emit("DELETED", obj)
+            return web.json_response(obj)
         kind._emit("MODIFIED", obj)
         return web.json_response(obj)
 
     def _delete(self, kind: _Kind, ns: str, name: str) -> web.Response:
-        obj = kind.objs.pop((ns, name), None)
+        obj = kind.objs.get((ns, name))
         if obj is None:
             return self._not_found(kind, name)
+        # k8s finalizer semantics: while finalizers remain, DELETE only
+        # marks deletionTimestamp (MODIFIED); the object disappears when
+        # the last finalizer is removed (see _update)
+        if obj["metadata"].get("finalizers"):
+            if not obj["metadata"].get("deletionTimestamp"):
+                obj["metadata"]["deletionTimestamp"] = (
+                    time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+                obj["metadata"]["resourceVersion"] = str(self.next_rv())
+                kind._emit("MODIFIED", obj)
+            return web.json_response(obj)
+        kind.objs.pop((ns, name), None)
         obj["metadata"]["resourceVersion"] = str(self.next_rv())
         kind._emit("DELETED", obj)
         return web.json_response(obj)
@@ -379,7 +405,8 @@ def _merge_into(obj: dict, patch: dict):
             # merging clients may echo metadata; never let them rewind
             # server-owned fields
             v = {mk: mv for mk, mv in (v or {}).items()
-                 if mk not in ("resourceVersion", "generation", "namespace")}
+                 if mk not in ("resourceVersion", "generation", "namespace",
+                               "deletionTimestamp")}
             obj["metadata"] = _merge(obj.get("metadata"), v)
         elif v is None:
             obj.pop(k, None)

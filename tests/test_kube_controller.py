@@ -149,6 +149,10 @@ async def test_status_conflict_is_retried():
     ctrl = DynamoGraphController(client)
     try:
         await crs.create(graph_cr(prefill=0, decode=0))
+        # pre-add the controller's finalizer so reconcile() skips the
+        # finalizer-ensure GET+PUT (it would consume a racing round)
+        from dynamo_tpu.deploy.controller import FINALIZER
+        await crs.patch("g1", {"metadata": {"finalizers": [FINALIZER]}})
         # interleave: bump the CR's rv after every GET the controller makes
         orig_get = crs.get
         bumped = {"n": 0}
@@ -509,6 +513,61 @@ async def test_single_to_multinode_migration_replaces_legacy_pods():
         await crs.replace("mig", cur)
         await _wait(lambda: names_are(["mig-worker-0-0", "mig-worker-0-1"]),
                     timeout=10.0, msg="gangs replace legacy pods")
+    finally:
+        await ctrl.stop()
+        await client.close()
+        await server.stop()
+
+
+async def test_finalizer_pins_cr_until_cleanup_done():
+    """The controller's finalizer (ref: controller_common/finalizer.go)
+    keeps a deleted CR terminating until pods and discovery keys are
+    gone — even across a controller restart mid-delete."""
+    import msgpack
+
+    from dynamo_tpu.deploy.controller import FINALIZER
+    from dynamo_tpu.runtime.control_plane import LocalControlPlane
+
+    server, client = await _env()
+    plane = LocalControlPlane()
+    await plane.kv_put(
+        "instances/dynamo/decode/e:aa",
+        msgpack.packb({"metadata": {"pod": "g1-decode-0"}}))
+
+    crs = client.resource(GROUP, VERSION, "default", PLURAL)
+    pods = client.resource("", "v1", "default", "pods")
+    ctrl = await DynamoGraphController(client, plane=plane).start()
+    try:
+        await crs.create(graph_cr(prefill=0, decode=1))
+
+        async def finalized():
+            obj = await crs.get("g1")
+            return FINALIZER in (obj["metadata"].get("finalizers") or []) \
+                or None
+        await _wait(finalized, msg="finalizer added")
+
+        # stop the controller BEFORE deleting: the delete only marks the
+        # CR terminating (finalizer holds it)
+        await ctrl.stop()
+        await crs.delete("g1")
+        obj = await crs.get("g1")
+        assert obj["metadata"].get("deletionTimestamp")
+
+        # a fresh controller (restart) finishes the teardown: pods and
+        # discovery keys collected, finalizer released, CR gone
+        ctrl = await DynamoGraphController(client, plane=plane).start()
+
+        async def cr_gone():
+            try:
+                await crs.get("g1")
+                return None
+            except Exception:
+                return True
+        await _wait(cr_gone, msg="CR collected after finalizer release")
+        lst = await pods.list(label_selector=f"{LABEL_GRAPH}=g1")
+        assert lst["items"] == []
+        keys = await plane.kv_get_prefix("instances/dynamo/")
+        assert keys == {}
     finally:
         await ctrl.stop()
         await client.close()
