@@ -506,6 +506,11 @@ class DynamoGraphController:
         if present == (FINALIZER in fins):
             return
         if present:
+            if cur["metadata"].get("deletionTimestamp"):
+                # a real apiserver 422s finalizer ADDITIONS on a
+                # terminating object; the deletion event that beat our
+                # cache will re-enqueue and take the teardown path
+                return
             fins.append(FINALIZER)
         else:
             fins.remove(FINALIZER)

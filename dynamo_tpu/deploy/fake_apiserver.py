@@ -264,6 +264,18 @@ class FakeKubeApiServer:
                      "message": f"the object has been modified (rv {sent_rv} "
                                 f"!= {obj['metadata']['resourceVersion']})"},
                     status=409)
+        # real-apiserver contract: no NEW finalizers on a terminating
+        # object (finalizer removal is how it gets collected)
+        if obj["metadata"].get("deletionTimestamp"):
+            new_fins = set((body.get("metadata") or {})
+                           .get("finalizers") or [])
+            if new_fins - set(obj["metadata"].get("finalizers") or []):
+                return web.json_response(
+                    {"kind": "Status", "status": "Failure", "code": 422,
+                     "reason": "Invalid",
+                     "message": "no new finalizers can be added if the "
+                                "object is being deleted"},
+                    status=422)
         spec_before = json.dumps(obj.get("spec"), sort_keys=True)
         if status_sub:
             # the status subresource touches ONLY .status
