@@ -139,7 +139,8 @@ def _write_tokenizer(path: str, vocab_size: int) -> None:
 
 
 async def serve_bench(path: str, *, kv_int8: bool, isl: int, osl: int,
-                      conc: int, n_req: int) -> dict:
+                      conc: int, n_req: int,
+                      prefill_buckets=(1024, 2048, 4096)) -> dict:
     import aiohttp
     import jax
 
@@ -163,7 +164,7 @@ async def serve_bench(path: str, *, kv_int8: bool, isl: int, osl: int,
         multi_step_decode=8, use_pallas_attention=True,
         quantization="int8",
         kv_cache_dtype="int8" if kv_int8 else None,
-        prefill_buckets=(1024, 2048, 4096),
+        prefill_buckets=prefill_buckets,
         decode_batch_buckets=(8, 16, 32))
     t0 = time.perf_counter()
     eng = AsyncJaxEngine(cfg, args, params=params)
@@ -184,9 +185,11 @@ async def serve_bench(path: str, *, kv_int8: bool, isl: int, osl: int,
     handler = DecodeWorkerHandler(eng)
     ep = rt.namespace("dynamo").component("backend").endpoint("generate")
     handle = await ep.serve_endpoint(handler.generate)
+    with open(os.path.join(path, "config.json")) as f:
+        geom = json.load(f)
     card = ModelDeploymentCard(
         display_name="llama8b-rand", kv_cache_block_size=args.block_size,
-        eos_token_ids=[LLAMA8B["eos_token_id"]], tokenizer_ref=path,
+        eos_token_ids=[geom["eos_token_id"]], tokenizer_ref=path,
         context_length=args.max_model_len)
     card.runtime_config.total_kv_blocks = eng.num_blocks
     await register_llm(rt, ep, card)
@@ -203,7 +206,7 @@ async def serve_bench(path: str, *, kv_int8: bool, isl: int, osl: int,
     rng = np.random.default_rng(11)
 
     async def one(session):
-        prompt = rng.integers(1, LLAMA8B["vocab_size"], isl).tolist()
+        prompt = rng.integers(1, geom["vocab_size"], isl).tolist()
         t0 = time.perf_counter()
         ttft, n_tok = None, 0
         async with session.post(url, json={
@@ -263,6 +266,15 @@ async def serve_bench(path: str, *, kv_int8: bool, isl: int, osl: int,
     return out
 
 
+# tiny geometry for --smoke: same code path, CPU-feasible sizes — proves
+# the WHOLE chain (fixture → from_pretrained → load → int8 quantize →
+# HTTP serve → metrics) before a scarce chip window is spent on it
+SMOKE = {**LLAMA8B, "hidden_size": 256, "intermediate_size": 512,
+         "num_hidden_layers": 4, "num_attention_heads": 8,
+         "num_key_value_heads": 4, "vocab_size": 2048,
+         "eos_token_id": 2000, "bos_token_id": 1}
+
+
 def main():
     ap = argparse.ArgumentParser(description="8B-class real-size serve bench")
     ap.add_argument("--fixture-only", action="store_true")
@@ -271,17 +283,39 @@ def main():
     ap.add_argument("--osl", type=int, default=256)
     ap.add_argument("--conc", type=int, default=16)
     ap.add_argument("--n", type=int, default=32)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny-geometry CPU run of the full harness")
+    ap.add_argument("--platform", default=None,
+                    help="cpu = force backend before first device touch "
+                         "(a dead axon tunnel wedges bare jax init)")
     cli = ap.parse_args()
 
-    out = {"model": "llama-3-8B-geometry (random weights)"}
-    if not os.path.exists(os.path.join(FIXTURE_DIR, ".complete")):
-        out["fixture_build_s"] = round(build_fixture(LLAMA8B, FIXTURE_DIR), 1)
+    if cli.platform:
+        import jax
+
+        jax.config.update("jax_platforms", cli.platform)
+
+    geom, fdir = LLAMA8B, FIXTURE_DIR
+    if cli.smoke:
+        geom, fdir = SMOKE, FIXTURE_DIR + "-smoke"
+        cli.isl, cli.osl = min(cli.isl, 128), min(cli.osl, 16)
+        cli.conc, cli.n = min(cli.conc, 4), min(cli.n, 8)
+
+    out = {"model": ("llama-3-8B-geometry (random weights)"
+                     if not cli.smoke else "smoke-geometry (random weights)")}
+    if not os.path.exists(os.path.join(fdir, ".complete")):
+        out["fixture_build_s"] = round(build_fixture(geom, fdir), 1)
     if cli.fixture_only:
         print(json.dumps(out))
         return
+    buckets = (1024, 2048, 4096)
+    if cli.smoke:
+        # padded-to-1024 prefills would 8x the smoke run's CPU wall time
+        b0 = max(128, 1 << (cli.isl - 1).bit_length())
+        buckets = (b0, b0 * 2)
     out.update(asyncio.run(serve_bench(
-        FIXTURE_DIR, kv_int8=cli.kv_int8, isl=cli.isl, osl=cli.osl,
-        conc=cli.conc, n_req=cli.n)))
+        fdir, kv_int8=cli.kv_int8, isl=cli.isl, osl=cli.osl,
+        conc=cli.conc, n_req=cli.n, prefill_buckets=buckets)))
     print(json.dumps(out))
 
 

@@ -265,8 +265,10 @@ class FakeKubeApiServer:
                                 f"!= {obj['metadata']['resourceVersion']})"},
                     status=409)
         # real-apiserver contract: no NEW finalizers on a terminating
-        # object (finalizer removal is how it gets collected)
-        if obj["metadata"].get("deletionTimestamp"):
+        # object (finalizer removal is how it gets collected). Status-
+        # subresource writes are exempt — a real apiserver IGNORES body
+        # metadata there rather than rejecting it.
+        if not status_sub and obj["metadata"].get("deletionTimestamp"):
             new_fins = set((body.get("metadata") or {})
                            .get("finalizers") or [])
             if new_fins - set(obj["metadata"].get("finalizers") or []):

@@ -53,10 +53,11 @@ def pp_schedule(M: int, n_stages: int) -> tuple[int, float]:
     executes: ``T = M + S - 1`` ticks (the GPipe optimum — every stage
     runs every tick, invalid ticks write to the reserved null block), of
     which each stage does M useful ones → bubble = (S-1)/(M+S-1). The
-    default picks the largest M ≤ 4S dividing B, so a batch of B ≥ 4S
-    lands under a 20% bubble; B < S degrades gracefully toward
-    sequential stages. Larger B (or an explicit num_microbatches) is the
-    amortization knob the serving scheduler owns."""
+    default picks the largest DIVISOR of B up to 4S (microbatches must
+    split B evenly), so power-of-two batches ≥ 4S — the engine's decode
+    buckets — land under a 20% bubble; a B with no divisor near 4S
+    (e.g. prime) degrades toward sequential stages, so callers with
+    arbitrary B should pass num_microbatches (or pad B) themselves."""
     ticks = M + n_stages - 1
     return ticks, (n_stages - 1) / ticks
 
@@ -193,7 +194,8 @@ def pp_forward(params, tokens, positions, slot_map, block_tables, kv_lens,
                all_logits: bool = False):
     """Pipelined engine step; same contract as model.forward.
 
-    B must divide into ``num_microbatches`` (default min(B, pp)); embed and
+    B must divide into ``num_microbatches`` (default: largest divisor of
+    B up to 4·pp — see pp_schedule for the bubble math); embed and
     the LM head run outside the pipeline (they are stage-agnostic and tiny
     next to the layer stack).
     """
