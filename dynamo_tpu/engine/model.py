@@ -557,8 +557,11 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
     from dynamo_tpu.ops.paged_attention import mla_int8_kernel_supported
 
     _L, _slots, _, _ = cache_shape(kc)
+    # scales are layer-sliced into the kernel (scale_slot_base), so the
+    # VMEM budget gate is per-layer — serving-scale stacked caches stay
+    # on the fast path instead of falling back at L× the footprint
     pallas_ok = (not kv_quant
-                 or mla_int8_kernel_supported(block_size, _L * _slots))
+                 or mla_int8_kernel_supported(block_size, _slots))
     if use_pallas and S == 1 and pallas_ok:
         # Pallas latent decode: pages stream HBM→VMEM once; output stays in
         # latent space, W_UV expansion below is shared with the XLA path
@@ -577,8 +580,11 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
                     qe1, qr1, kcf["q"].reshape(flat_slots, r),
                     vcf["q"].reshape(flat_slots, pr), bt + lidx_ * nb, lens,
                     block_size=block_size, scale=scale,
-                    c_scales=kcf["s"].reshape(flat_slots),
-                    r_scales=vcf["s"].reshape(flat_slots))
+                    c_scales=jax.lax.dynamic_index_in_dim(
+                        kcf["s"], lidx_, keepdims=False).reshape(slots_),
+                    r_scales=jax.lax.dynamic_index_in_dim(
+                        vcf["s"], lidx_, keepdims=False).reshape(slots_),
+                    scale_slot_base=lidx_ * slots_)
             cache_spec = {"q": P(None, None, None, None),
                           "s": P(None, None, None)}
         else:
@@ -907,12 +913,20 @@ def _pallas_decode_attn(q1, kc, vc, lidx, block_tables, kv_lens, window,
     nb = slots_ // block_size
     flat = L_ * slots_
     if is_quant_cache(kc):
+        # pages stay flat [L·slots] (slicing kc[lidx] would copy a whole
+        # layer of PAGES per step), but scales are tiny — slice THIS
+        # layer's [slots, KV] so the kernel's VMEM-resident scale budget
+        # covers serving-scale caches (an all-layers table is L× too big);
+        # scale_slot_base rebases the offset block ids onto the slice
         return paged_attention_decode(
             q1, kc["q"].reshape(flat, KV, hd), vc["q"].reshape(flat, KV, hd),
             block_tables + lidx * nb, kv_lens, block_size=block_size,
             window=window, sinks=sinks if has_sink else None,
-            k_scales=kc["s"].reshape(flat, KV),
-            v_scales=vc["s"].reshape(flat, KV))
+            k_scales=jax.lax.dynamic_index_in_dim(kc["s"], lidx,
+                                                  keepdims=False),
+            v_scales=jax.lax.dynamic_index_in_dim(vc["s"], lidx,
+                                                  keepdims=False),
+            scale_slot_base=lidx * slots_)
     return paged_attention_decode(
         q1, kc.reshape(flat, KV, hd), vc.reshape(flat, KV, hd),
         block_tables + lidx * nb, kv_lens, block_size=block_size,

@@ -39,7 +39,8 @@ _NEG = -1e30
 _LANE = 128
 
 
-def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
+def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,
+                   sbase_ref,  # scalar pf; sbase = scale-table slot base
                    qexp_ref,  # [1, H, KVhd] VMEM
                    sink_ref,  # [1, H, 1] VMEM (zeros when has_sink=False)
                    kcache_ref, vcache_ref,  # [slots, KVhd] HBM
@@ -89,12 +90,15 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
             vcache_ref.at[pl.ds(blk * bs, bs)], vbuf.at[slot],
             dma_sem.at[slot, 1]).start()
         if quant and not vmem_scales:
-            # per-(slot, head) scales ride their own small DMAs
+            # per-(slot, head) scales ride their own small DMAs; offsets
+            # rebase onto the scale table (callers may pass ONE layer's
+            # slice of a stacked cache — see scale_slot_base)
+            soff = blk * bs - sbase_ref[0]
             pltpu.make_async_copy(
-                ksc_ref.at[pl.ds(blk * bs, bs)], ksbuf.at[slot],
+                ksc_ref.at[pl.ds(soff, bs)], ksbuf.at[slot],
                 dma_sem.at[slot, 2]).start()
             pltpu.make_async_copy(
-                vsc_ref.at[pl.ds(blk * bs, bs)], vsbuf.at[slot],
+                vsc_ref.at[pl.ds(soff, bs)], vsbuf.at[slot],
                 dma_sem.at[slot, 3]).start()
 
     def wait_dma(w):
@@ -138,8 +142,9 @@ def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,  # scalar pf
             # lane dim — a [slots, KV] block would tile-pad KV→128, 16-128×
             # the useful bytes; ADVICE r4)
             blk = block_tables_ref[b, w]
-            kscpage = ksc_ref[:, pl.ds(blk * bs, bs)]  # [KV, bs] VMEM slice
-            vscpage = vsc_ref[:, pl.ds(blk * bs, bs)]
+            soff = blk * bs - sbase_ref[0]  # rebase onto the scale slice
+            kscpage = ksc_ref[:, pl.ds(soff, bs)]  # [KV, bs] VMEM slice
+            vscpage = vsc_ref[:, pl.ds(soff, bs)]
             sc_dims = (((1,), (0,)), ((), ()))  # seg_oh[H,KV] @ [KV,bs]
         elif quant:
             kscpage = ksbuf[w % D]  # [bs, KV]
@@ -209,7 +214,8 @@ def pallas_supported(num_kv_heads: int, head_dim: int) -> bool:
 def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
                            block_size: int, interpret: bool = False,
                            window=None, sinks=None,
-                           k_scales=None, v_scales=None):
+                           k_scales=None, v_scales=None,
+                           scale_slot_base=None):
     """Decode-step paged attention. See module docstring for the contract.
 
     ``window``: sliding-window size as a (possibly traced per-layer) scalar
@@ -220,6 +226,11 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
     and dequantize IN the kernel — HBM page traffic halves vs bf16, the
     decode bandwidth win the KV-capacity role of the reference's G1 tier
     implies (lib/llm/src/block_manager/).
+    ``scale_slot_base`` (traced scalar, default 0): slot offset of the
+    scale tables relative to the page cache — callers with a LAYER-STACKED
+    flat cache pass one layer's scale slice plus ``lidx·slots`` so the
+    VMEM-resident scale budget is per-layer, not ×L (serving-scale caches
+    would otherwise always fall back to the slow 4-DMA path).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -233,11 +244,14 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
     if not pallas_supported(KV, hd):
         return paged_attention_decode_xla(
             q, k_cache, v_cache, block_tables, kv_lens, block_size=bs,
-            window=window, sinks=sinks, k_scales=k_scales, v_scales=v_scales)
+            window=window, sinks=sinks, k_scales=k_scales,
+            v_scales=v_scales, scale_slot_base=scale_slot_base)
     interpret = interpret or jax.default_backend() != "tpu"
     has_sink = sinks is not None
     win_arr = jnp.asarray([0 if window is None else window],
                           jnp.int32).reshape(1)
+    sbase_arr = jnp.asarray([0 if scale_slot_base is None
+                             else scale_slot_base], jnp.int32).reshape(1)
     sink_in = (jnp.zeros((1, H, 1), q.dtype) if not has_sink
                else sinks.reshape(1, H, 1).astype(q.dtype))
 
@@ -260,7 +274,12 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
         # [slots, KV] layout tile-padded its lane dim KV→128 — 16-128× the
         # bytes the old 2·slots·KV·4 check counted, so configs passed the
         # check yet overflowed VMEM at Mosaic compile time; ADVICE r4.)
-        padded_slots = -(-slots // _LANE) * _LANE
+        # Sized from the SCALE table, not the page cache: layer-stacked
+        # callers pass one layer's slice (scale_slot_base), so the gate
+        # and the packed operand are per-layer — an L·slots cache must
+        # not fail the gate at L× the real residency.
+        sc_slots = k_scales.shape[0]
+        padded_slots = -(-sc_slots // _LANE) * _LANE
         scale_bytes = 2 * (-(-KV // 8) * 8) * padded_slots * 4
         budget = int(os.environ.get("DYN_KV_SCALE_VMEM_BYTES", 32 << 20))
         vmem_scales = scale_bytes <= budget
@@ -283,8 +302,8 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
             # keeps them resident across the whole (B,) grid. Transposed so
             # slots ride the (cheap) lane dim — see the budget note above.
             def lane_pack_t(s):
-                s = s.astype(jnp.float32).T  # [KV, slots]
-                return jnp.pad(s, ((0, 0), (0, padded_slots - slots)))
+                s = s.astype(jnp.float32).T  # [KV, sc_slots]
+                return jnp.pad(s, ((0, 0), (0, padded_slots - sc_slots)))
 
             in_specs += [
                 pl.BlockSpec((KV, padded_slots), lambda b, *_: (0, 0)),
@@ -300,7 +319,7 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
     scratch.append(
         pltpu.SemaphoreType.DMA((D, 4 if quant and not vmem_scales else 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, KVhd), lambda b, *_: (b, 0, 0)),
@@ -311,7 +330,7 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, KVhd), q.dtype),
         interpret=interpret,
-    )(block_tables, kv_lens, win_arr, qexp, sink_in, *operands)
+    )(block_tables, kv_lens, win_arr, sbase_arr, qexp, sink_in, *operands)
 
     # pick each head's own KV segment back out
     out_full = out_full.reshape(B, H, KV, hd)
@@ -321,7 +340,8 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
 
 def paged_attention_decode_xla(q, k_cache, v_cache, block_tables, kv_lens, *,
                                block_size: int, window=None, sinks=None,
-                               k_scales=None, v_scales=None):
+                               k_scales=None, v_scales=None,
+                               scale_slot_base=None):
     """Reference/fallback path (same math, gather through XLA) — honors the
     same window/sink/int8 contract as the kernel, so a shape-based fallback
     can never silently change attention semantics."""
@@ -336,8 +356,9 @@ def paged_attention_decode_xla(q, k_cache, v_cache, block_tables, kv_lens, *,
     k = k_cache[slot_idx]  # [B, T, KV, hd]
     v = v_cache[slot_idx]
     if k_scales is not None:  # int8 pages: dequant fused into the gather
-        k = k.astype(jnp.float32) * k_scales[slot_idx][..., None]
-        v = v.astype(jnp.float32) * v_scales[slot_idx][..., None]
+        sidx = slot_idx - (0 if scale_slot_base is None else scale_slot_base)
+        k = k.astype(jnp.float32) * k_scales[sidx][..., None]
+        v = v.astype(jnp.float32) * v_scales[sidx][..., None]
     qg = q.reshape(B, KV, G, hd).astype(jnp.float32)
     s = jnp.einsum("bkgd,btkd->bkgt", qg, k.astype(jnp.float32)) / np.sqrt(hd)
     key_pos = jnp.arange(T)
@@ -359,7 +380,8 @@ def paged_attention_decode_xla(q, k_cache, v_cache, block_tables, kv_lens, *,
 
 # ---------------------------------------------------------------- MLA decode
 
-def _mla_decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
+def _mla_decode_kernel(block_tables_ref, kv_lens_ref,
+                       sbase_ref,  # scalar prefetch; scale-table slot base
                        qe_ref,  # [1, H, R] VMEM (scale folded in)
                        qr_ref,  # [1, H, PR] VMEM
                        ccache_ref, rcache_ref,  # [slots, R] / [slots, PR] HBM
@@ -424,8 +446,9 @@ def _mla_decode_kernel(block_tables_ref, kv_lens_ref,  # scalar prefetch
             blk = block_tables_ref[b, w]
             # scales are LANE-PACKED [rows, 128] (a [slots, 1] block would
             # tile-pad the lane dim 1→128, inflating VMEM 128×); a page's
-            # bs scales sit inside one row because bs divides 128
-            off = blk * bs
+            # bs scales sit inside one row because bs divides 128. The
+            # offset rebases onto the (possibly layer-sliced) scale table.
+            off = blk * bs - sbase_ref[0]
             csc = csc_ref[off // _LANE, pl.ds(off % _LANE, bs)].reshape(1, bs)
             rsc = rsc_ref[off // _LANE, pl.ds(off % _LANE, bs)].reshape(1, bs)
 
@@ -487,7 +510,8 @@ def mla_int8_kernel_supported(block_size: int, flat_slots: int) -> bool:
 def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
                      kv_lens, *, block_size: int, scale: float,
                      interpret: bool = False,
-                     c_scales=None, r_scales=None):
+                     c_scales=None, r_scales=None,
+                     scale_slot_base=None):
     """MLA decode over the paged latent cache.
 
     q_eff [B,H,R] (queries absorbed through W_UK), q_rot [B,H,PR] (post-rope
@@ -500,6 +524,10 @@ def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
     ``c_scales``/``r_scales`` [slots] f32 (int8 caches): pages are int8 and
     dequantize in the kernel; scales ride lane-packed in VMEM (no scale
     DMAs). Callers must check :func:`mla_int8_kernel_supported` first.
+    ``scale_slot_base``: slot offset of the scale tables relative to the
+    page cache (layer-stacked callers pass one layer's slice + its base,
+    keeping VMEM residency per-layer — same contract as
+    paged_attention_decode).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -512,10 +540,12 @@ def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
 
     qe = (q_eff.astype(jnp.float32) * scale).astype(q_eff.dtype)
     qr = (q_rot.astype(jnp.float32) * scale).astype(q_rot.dtype)
+    sbase_arr = jnp.asarray([0 if scale_slot_base is None
+                             else scale_slot_base], jnp.int32).reshape(1)
 
     W = block_tables.shape[1]
     D = min(W, 8)  # VMEM: D·bs·(R+PR)·dtype bytes in flight
-    slots = latent_cache.shape[0]
+    slots = (c_scales.shape[0] if quant else latent_cache.shape[0])
     kernel = functools.partial(_mla_decode_kernel, bs=bs, quant=quant)
     in_specs = [
         pl.BlockSpec((1, H, R), lambda b, *_: (b, 0, 0)),
@@ -540,7 +570,7 @@ def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
                      pl.BlockSpec((rows, _LANE), lambda b, *_: (0, 0))]
         operands += [lane_pack(c_scales), lane_pack(r_scales)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, R), lambda b, *_: (b, 0, 0)),
@@ -555,4 +585,4 @@ def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, R), q_eff.dtype),
         interpret=interpret,
-    )(block_tables, kv_lens, qe, qr, *operands)
+    )(block_tables, kv_lens, sbase_arr, qe, qr, *operands)

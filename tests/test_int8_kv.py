@@ -532,3 +532,43 @@ def test_hbm_sizing_int8_capacity_and_estimate_fallback(monkeypatch):
     # int8: 1 byte + 4-byte scale per (slot, head) vs 2-byte bf16 → the
     # per-slot ratio for hd=64 is (2*64*2)/(64+4+64+4) ≈ 1.88x
     assert 1.7 < int8 / bf16 < 2.0, (bf16, int8)
+
+
+def test_decode_scale_slot_base_layer_slice_matches(monkeypatch):
+    """scale_slot_base (r5): a layer-stacked flat cache passes ONE layer's
+    scale slice + that layer's slot base, so VMEM residency is per-layer.
+    Both placements must agree with full-table, base-0 results."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.paged_attention import (
+        paged_attention_decode, paged_attention_decode_xla,
+    )
+
+    q, kf, vf, kq, ks, vq, vs, bt, lens = _paged_setup(KV=2, hd=64, H=4)
+    slots = kq.shape[0]
+    # build a fake "layer 1 of 2" flat cache: layer 0 is garbage pages,
+    # layer 1 is our real pages; block tables shift by nb like the engine's
+    nb = slots // 4
+    kq2 = np.concatenate([np.ones_like(kq) * 7, kq])
+    vq2 = np.concatenate([np.ones_like(vq) * 7, vq])
+    bt2 = bt + nb
+    args = (jnp.asarray(q), jnp.asarray(kq2), jnp.asarray(vq2),
+            jnp.asarray(bt2), jnp.asarray(lens))
+    # scales: ONLY layer 1's slice, rebased by scale_slot_base=slots
+    kw = dict(block_size=4, k_scales=jnp.asarray(ks),
+              v_scales=jnp.asarray(vs), scale_slot_base=slots)
+    ref = paged_attention_decode_xla(
+        jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+        jnp.asarray(bt), jnp.asarray(lens), block_size=4,
+        k_scales=jnp.asarray(ks), v_scales=jnp.asarray(vs))
+
+    assert np.allclose(np.asarray(paged_attention_decode_xla(*args, **kw)),
+                       np.asarray(ref), rtol=2e-3, atol=2e-3)
+    monkeypatch.setenv("DYN_KV_SCALE_VMEM_BYTES", str(1 << 30))
+    out_vmem = paged_attention_decode(*args, interpret=True, **kw)
+    monkeypatch.setenv("DYN_KV_SCALE_VMEM_BYTES", "0")
+    out_dma = paged_attention_decode(*args, interpret=True, **kw)
+    np.testing.assert_allclose(np.asarray(out_vmem), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(out_dma), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
