@@ -1,12 +1,13 @@
 """Rank worker for the multi-host lockstep test (tests/test_multihost.py).
 
-Usage: python tests/mh_worker.py <rank> <coordinator> <plane_addr>
+Usage: python tests/mh_worker.py <rank> <coordinator> <plane_addr> [world]
 
-Two JAX processes × 2 virtual CPU devices form one GLOBAL tp=4 mesh. Rank 0
-runs the real engine (greedy generate) broadcasting each step's host inputs;
-rank 1 replays them through identical jitted functions. Both ranks finish by
+``world`` (default 2) JAX processes × 2 virtual CPU devices form one GLOBAL
+tp=2·world mesh. Rank 0 runs the real engine (greedy generate) broadcasting
+each step's host inputs over DIRECT TCP to every follower; ranks >= 1
+replay them through identical jitted functions. All ranks finish by
 computing a jitted GLOBAL checksum of their k_cache — bit-identical inputs
-must leave bit-identical global cache state on both ranks.
+must leave bit-identical global cache state on every rank.
 """
 
 import asyncio
@@ -25,14 +26,18 @@ def _script_env():
     jax.config.update("jax_platforms", "cpu")
 
 
-def mh_model_cfg():
-    """Shared by worker and test: every head dim divisible by tp=4."""
+def mh_model_cfg(world: int = 2):
+    """Shared by worker and test: heads divisible by tp=2·world."""
     from dynamo_tpu.engine.config import ModelConfig
 
+    tp = 2 * world
+    # vocab must shard over tp (lm-head partition); 256 kept for world=2
+    # so the single-process reference tokens stay comparable
     return ModelConfig(
-        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
-        num_heads=4, num_kv_heads=4, dtype="float32",
-        max_position_embeddings=512)
+        vocab_size=256 if tp == 4 else 48 * tp,
+        hidden_size=16 * tp, intermediate_size=32 * tp,
+        num_layers=2, num_heads=tp, num_kv_heads=tp, dtype="float32",
+        head_dim=16, max_position_embeddings=512)
 
 
 def mh_engine_args():
@@ -43,7 +48,7 @@ def mh_engine_args():
                       prefill_buckets=(16,), decode_batch_buckets=(1,))
 
 
-async def wait_kv(plane, key, timeout=60.0):
+async def wait_kv(plane, key, timeout=240.0):
     for _ in range(int(timeout / 0.05)):
         v = await plane.kv_get(key)
         if v is not None:
@@ -56,15 +61,16 @@ async def main():
     import jax
 
     rank, coord, plane_addr = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    world = int(sys.argv[4]) if len(sys.argv) > 4 else 2
 
     from dynamo_tpu.parallel import MeshConfig
     from dynamo_tpu.parallel.multihost import (
         StepBroadcaster, StepFollower, init_multihost, make_global_mesh,
     )
 
-    r, world = init_multihost(coord, 2, rank)
-    assert (r, world) == (rank, 2)
-    mesh = make_global_mesh(MeshConfig(dp=1, sp=1, tp=4))
+    r, w = init_multihost(coord, world, rank)
+    assert (r, w) == (rank, world)
+    mesh = make_global_mesh(MeshConfig(dp=1, sp=1, tp=2 * world))
 
     from dynamo_tpu.engine.config import EngineArgs, ModelConfig
     from dynamo_tpu.engine.engine import AsyncJaxEngine
@@ -73,7 +79,7 @@ async def main():
     )
     from dynamo_tpu.runtime.control_plane import RemoteControlPlane
 
-    cfg = mh_model_cfg()
+    cfg = mh_model_cfg(world)
     args = mh_engine_args()
     plane = await RemoteControlPlane(plane_addr).connect()
     eng = AsyncJaxEngine(cfg, args, mesh=mesh)
@@ -82,8 +88,10 @@ async def main():
     if rank == 0:
         bcast = StepBroadcaster(plane)
         eng.broadcast_cb = bcast
-        await wait_kv(plane, "mh/ready")
-        await bcast.connect(expect=1)  # direct stream to the follower
+        for fr in range(1, world):
+            await wait_kv(plane, f"mh/ready{fr}")
+        # direct one-to-MANY streams, one per follower
+        await bcast.connect(expect=world - 1)
 
         req = PreprocessedRequest(
             model="t", token_ids=list(range(1, 13)),
@@ -100,19 +108,20 @@ async def main():
         print(f"EMBDIM {len(vecs[0])}", flush=True)
         await bcast.stop()
         await plane.kv_put("mh/nsteps", str(bcast.steps_sent).encode())
-        await wait_kv(plane, "mh/replayed")
+        for fr in range(1, world):
+            await wait_kv(plane, f"mh/replayed{fr}")
     else:
         follower = await StepFollower(eng, plane).start()
-        await plane.kv_put("mh/ready", b"1")
+        await plane.kv_put(f"mh/ready{rank}", b"1")
         nsteps = int(await wait_kv(plane, "mh/nsteps"))
-        for _ in range(1200):
+        for _ in range(4800):  # 240s — 3 jax procs contend on a 1-core host
             if follower.steps_replayed >= nsteps:
                 break
             await asyncio.sleep(0.05)
         assert follower.steps_replayed == nsteps, \
             f"replayed {follower.steps_replayed}/{nsteps}"
         print(f"REPLAYED {follower.steps_replayed}", flush=True)
-        await plane.kv_put("mh/replayed", b"1")
+        await plane.kv_put(f"mh/replayed{rank}", b"1")
         await follower.stop()
 
     # BOTH ranks issue the same global reduction — program order aligned

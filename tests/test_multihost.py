@@ -44,7 +44,7 @@ async def _single_process_reference() -> list[int]:
     return toks
 
 
-async def test_two_process_global_mesh_lockstep(unused_tcp_port_factory=None):
+async def _run_lockstep(world: int) -> list[str]:
     from dynamo_tpu.runtime.control_plane import ControlPlaneServer
 
     import socket
@@ -61,13 +61,13 @@ async def test_two_process_global_mesh_lockstep(unused_tcp_port_factory=None):
 
     procs = [await asyncio.create_subprocess_exec(
         sys.executable, os.path.join(REPO, "tests", "mh_worker.py"),
-        str(rank), coord, plane_addr, env=env,
+        str(rank), coord, plane_addr, str(world), env=env,
         stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT)
-        for rank in (0, 1)]
+        for rank in range(world)]
     outs = []
     try:
         for p in procs:
-            out, _ = await asyncio.wait_for(p.communicate(), 300)
+            out, _ = await asyncio.wait_for(p.communicate(), 420)
             outs.append(out.decode())
             assert p.returncode == 0, out.decode()
     finally:
@@ -75,7 +75,11 @@ async def test_two_process_global_mesh_lockstep(unused_tcp_port_factory=None):
             if p.returncode is None:
                 p.kill()
         await server.stop()
+    return outs
 
+
+async def test_two_process_global_mesh_lockstep():
+    outs = await _run_lockstep(2)
     toks = json.loads(re.search(r"TOKENS (\[.*\])", outs[0]).group(1))
     assert len(toks) == 6
     replayed = int(re.search(r"REPLAYED (\d+)", outs[1]).group(1))
@@ -87,6 +91,21 @@ async def test_two_process_global_mesh_lockstep(unused_tcp_port_factory=None):
     # multi-host sharding must not change the numerics
     ref = await _single_process_reference()
     assert toks == ref
+
+
+async def test_three_process_one_to_many_step_fanout():
+    """3 ranks, tp=6 global mesh: the leader's step stream fans out over
+    TWO direct TCP connections — the one-to-many replication a real
+    multi-host fleet runs (the 2-process test only ever covers a single
+    follower link). Every rank must replay every step and end with the
+    SAME global cache checksum."""
+    outs = await _run_lockstep(3)
+    toks = json.loads(re.search(r"TOKENS (\[.*\])", outs[0]).group(1))
+    assert len(toks) == 6
+    for o in outs[1:]:
+        assert int(re.search(r"REPLAYED (\d+)", o).group(1)) >= 6
+    cks = [float(re.search(r"CKSUM ([0-9.]+)", o).group(1)) for o in outs]
+    assert cks[0] == cks[1] == cks[2] > 0.0
 
 
 async def test_step_stream_direct_zero_hub_traffic():
