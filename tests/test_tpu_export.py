@@ -56,16 +56,22 @@ def test_gqa_decode_int8_scale_placements_export_for_tpu(monkeypatch):
     lens = jnp.full((B,), 64, jnp.int32)
     ks = jnp.ones((slots, KV), jnp.float32)
 
-    def fn(*a):
-        q, kc, vc, bt, lens, ks, vs = a
-        return paged_attention_decode(q, kc, vc, bt, lens, block_size=bs,
-                                      k_scales=ks, v_scales=vs,
-                                      scale_slot_base=slots)
+    def make_fn():
+        # a FRESH function object per export: the env var is read at trace
+        # time, and jax's trace cache is keyed on (callable, avals) — the
+        # same object would silently reuse the first placement's jaxpr
+        def fn(*a):
+            q, kc, vc, bt, lens, ks, vs = a
+            return paged_attention_decode(q, kc, vc, bt, lens,
+                                          block_size=bs, k_scales=ks,
+                                          v_scales=vs,
+                                          scale_slot_base=slots)
+        return fn
 
     monkeypatch.setenv("DYN_KV_SCALE_VMEM_BYTES", str(1 << 30))
-    _export_tpu(fn, q, kc, kc, bt, lens, ks, ks)
+    _export_tpu(make_fn(), q, kc, kc, bt, lens, ks, ks)
     monkeypatch.setenv("DYN_KV_SCALE_VMEM_BYTES", "0")
-    _export_tpu(fn, q, kc, kc, bt, lens, ks, ks)
+    _export_tpu(make_fn(), q, kc, kc, bt, lens, ks, ks)
 
 
 def test_mla_decode_kernels_export_for_tpu():
@@ -106,3 +112,37 @@ def test_flash_prefill_kernel_exports_for_tpu():
 
     _export_tpu(lambda *a: flash_prefill_paged(*a, block_size=bs),
                 q, kc, kc, lidx, bt, pos, lens)
+
+
+def test_full_serving_step_exports_for_tpu():
+    """The COMPOSED serving step — scan over layers, Pallas decode
+    attention, int8 resident weights, int8 KV with layer-sliced scales —
+    at llama3-1b production widths (depth-reduced: scan makes the
+    program identical modulo the leading L dim)."""
+    import functools
+
+    import numpy as np
+
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.cache import allocate_device_cache
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.quant import quantize_params
+
+    cfg = ModelConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_layers=2, num_heads=32, num_kv_heads=8, head_dim=64,
+        rope_theta=500000.0, max_position_embeddings=8192,
+        tie_word_embeddings=True)
+    bs, nb, B, W = 16, 64, 8, 16
+    params = quantize_params(
+        jax.tree.map(np.asarray, M.init_params(cfg, jax.random.key(0))),
+        "int8")
+    kc, vc = allocate_device_cache(cfg, nb, bs, None, dtype="int8")
+    args = (params,
+            jnp.zeros((B, 1), jnp.int32), jnp.zeros((B, 1), jnp.int32),
+            jnp.zeros((B, 1), jnp.int32),
+            jnp.zeros((B, W), jnp.int32), jnp.full((B,), 64, jnp.int32),
+            jnp.zeros((B,), jnp.int32), kc, vc)
+    fn = functools.partial(M.forward, cfg=cfg, block_size=bs,
+                           use_pallas=True)
+    _export_tpu(fn, *args)
