@@ -123,7 +123,8 @@ def kernel_bench(on_tpu: bool, quantization=None, kv_int8=False) -> dict:
     int(toks[-1, 0])
     dt = time.perf_counter() - t0
     tok_s = B * K * iters / dt
-    tag = "kernel" if not quantization else f"kernel_{quantization}"
+    tag = ("kernel" if not quantization
+           else f"kernel_{quantization.replace('-', '_')}")
     if kv_int8:
         tag += "_kv8"
     return {f"{tag}_tok_s": round(tok_s, 1),
@@ -506,19 +507,22 @@ def _child_main():
         model = "llama3-1b" if on_tpu else "tiny-cpu"
         if "kernel" in phases:
             kern = kernel_bench(on_tpu)
-            try:
-                # int8 weights halve HBM weight traffic — the bandwidth-bound
-                # decode ceiling doubles; measure it alongside bf16 so the
-                # quantization win is on record whenever the chip is up
-                kern.update(kernel_bench(on_tpu, quantization="int8"))
-            except Exception as e:  # noqa: BLE001 — optional extra datum
-                kern["kernel_int8_error"] = repr(e)[:200]
-            try:
-                # int8 KV pages: the other half of decode's HBM traffic
-                kern.update(kernel_bench(on_tpu, quantization="int8",
-                                         kv_int8=True))
-            except Exception as e:  # noqa: BLE001 — optional extra datum
-                kern["kernel_kv8_error"] = repr(e)[:200]
+            # quantization variants, each an optional extra datum:
+            # int8 halves weight traffic (bandwidth-bound ceiling 2x),
+            # int8 KV halves the other half, int4-g32+kv8 is the 70B
+            # plan's BEST config (plan_70b: 1599 tok/s/chip roofline) —
+            # chip-only, a CPU fallback run shouldn't pay a 4th compile
+            variants = [("kernel_int8_error", "int8", False, True),
+                        ("kernel_kv8_error", "int8", True, True),
+                        ("kernel_int4_error", "int4-g32", True, on_tpu)]
+            for err_key, quant, kv8, run in variants:
+                if not run:
+                    continue
+                try:
+                    kern.update(kernel_bench(on_tpu, quantization=quant,
+                                             kv_int8=kv8))
+                except Exception as e:  # noqa: BLE001 — optional datum
+                    kern[err_key] = repr(e)[:200]
         else:
             kern = {"kernel_tok_s": 0.0, "kernel_skipped": True}
         if "spec" in phases:
