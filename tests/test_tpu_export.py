@@ -146,3 +146,22 @@ def test_full_serving_step_exports_for_tpu():
     fn = functools.partial(M.forward, cfg=cfg, block_size=bs,
                            use_pallas=True)
     _export_tpu(fn, *args)
+
+
+def test_flash_prefill_int8_cache_exports_for_tpu():
+    """Quant-cache flash prefill ({"q","s"} pytree caches, dequant fused
+    into the page gather) must also cross-lower for TPU."""
+    from dynamo_tpu.ops.flash_prefill import flash_prefill_paged
+
+    L, KV, hd, H, bs, nb, B, S = 2, 8, 128, 32, 16, 16, 2, 64
+    slots = nb * bs
+    q = jnp.zeros((B, S, H, hd), jnp.bfloat16)
+    kq = {"q": jnp.zeros((L, slots, KV, hd), jnp.int8),
+          "s": jnp.ones((L, slots, KV), jnp.float32)}
+    lidx = jnp.int32(0)
+    bt = jnp.zeros((B, nb), jnp.int32)
+    pos = jnp.tile(jnp.arange(S, dtype=jnp.int32), (B, 1))
+    lens = jnp.full((B,), S, jnp.int32)
+
+    _export_tpu(lambda *a: flash_prefill_paged(*a, block_size=bs),
+                q, kq, kq, lidx, bt, pos, lens)
