@@ -61,6 +61,107 @@ def _roofline(params, tok_s: float, reads_per_s: float, prefix: str) -> dict:
     }
 
 
+# -------------------------------------------------------------- observe smoke
+
+#: span names one mock request through the full stack must produce
+#: (acceptance: ≥6 named phases including TTFT and ITL)
+OBSERVE_PHASES = (
+    "http.request", "preprocess.tokenize", "router.schedule",
+    "worker.handle", "engine.ttft", "engine.decode", "ttft", "itl",
+)
+#: Prometheus series /metrics must expose out of the box
+OBSERVE_SERIES = (
+    "dynamo_ttft_seconds", "dynamo_itl_seconds", "dynamo_e2e_seconds",
+    "dynamo_phase_seconds",
+)
+
+
+async def observe_smoke() -> dict:
+    """``bench.py --observe``: one mock request through the full serving
+    stack, then assert the stitched trace (/v1/traces/{id}) contains the
+    expected span set and /metrics exposes the SLO histograms. No
+    accelerator needed (mocker engine) — wired into tier-1 as a fast test
+    (tests/test_observability.py)."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+    from dynamo_tpu.mocker.engine import MockEngineArgs
+    from dynamo_tpu.mocker.main import run_mocker
+    from dynamo_tpu.observability import configure_tracer
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    configure_tracer(service="observe")  # fresh buffer: hermetic assertions
+    rt = await DistributedRuntime.create()
+    # setup INSIDE the try: a failing start must not leak engine loops /
+    # watcher tasks into the calling process (pytest runs this in-suite)
+    engines, handles = [], []
+    watcher = service = None
+    try:
+        args = MockEngineArgs(vocab_size=make_test_tokenizer().vocab_size,
+                              block_size=4, num_gpu_blocks=128,
+                              speedup_ratio=20.0)
+        engines, handles = await run_mocker(rt, "observe", args)
+        manager = ModelManager()
+        watcher = await ModelWatcher(rt, manager, router_mode="kv").start()
+        service = HttpService(manager, port=0, runtime=rt)
+        await service.start()
+        for _ in range(200):
+            if manager.list_models():
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise RuntimeError("model never appeared in discovery")
+
+        rid = "observe-smoke-request"
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                    f"{base}/v1/completions",
+                    json={"model": "observe", "prompt": "hello tokens stream",
+                          "max_tokens": 8, "stream": True,
+                          "ignore_eos": True},
+                    headers={"x-request-id": rid}) as resp:
+                assert resp.status == 200, await resp.text()
+                async for _ in resp.content:
+                    pass
+            async with http.get(f"{base}/v1/traces/{rid}") as resp:
+                assert resp.status == 200, await resp.text()
+                trace = await resp.json()
+            async with http.get(f"{base}/metrics") as resp:
+                assert resp.status == 200
+                metrics_text = await resp.text()
+
+        phases = set(trace["phases"])
+        missing = [p for p in OBSERVE_PHASES if p not in phases]
+        if missing:
+            raise AssertionError(
+                f"trace missing phases {missing}; got {sorted(phases)}")
+        missing_series = [s for s in OBSERVE_SERIES if s not in metrics_text]
+        if missing_series:
+            raise AssertionError(f"/metrics missing {missing_series}")
+        # every span must stitch: a recorded parent id that is absent from
+        # the trace means a broken hop in the parenting chain
+        ids = {s["span_id"] for s in trace["spans"]}
+        orphans = [s["name"] for s in trace["spans"]
+                   if s.get("parent_span_id") and s["parent_span_id"] not in ids]
+        if orphans:
+            raise AssertionError(f"orphaned spans (broken parenting): {orphans}")
+        return {"observe": "ok", "spans": len(trace["spans"]),
+                "phases": sorted(phases), "trace_id": trace["trace_id"]}
+    finally:
+        if service is not None:
+            await service.stop()
+        if watcher is not None:
+            await watcher.stop()
+        for h in handles:
+            await h.stop(graceful=False)
+        for e in engines:
+            await e.stop()
+        await rt.shutdown()
+
+
 # --------------------------------------------------------------- kernel phase
 
 def kernel_bench(on_tpu: bool, quantization=None, kv_int8=False) -> dict:
@@ -416,6 +517,21 @@ def main():
     tunnel are minutes each."""
     import subprocess
     import sys
+
+    if "--observe" in sys.argv:
+        # observability smoke: no accelerator, no child orchestration —
+        # prints one JSON line and exits nonzero on a missing span/series
+        try:
+            out = asyncio.run(observe_smoke())
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"observe": "failed",
+                              "error": repr(e)[:300]}), flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        return
 
     if os.environ.get("DYN_BENCH_CHILD"):
         _child_main()

@@ -13,11 +13,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 from dynamo_tpu.disagg.protocols import (
     DisaggConfig, KvChunkFrame, PrefillResponse,
 )
+from dynamo_tpu.observability import get_tracer
 from dynamo_tpu.protocols import (FinishReason, LLMEngineOutput,
                                   PreprocessedRequest)
 from dynamo_tpu.runtime.control_plane import NoRespondersError
@@ -172,7 +174,7 @@ class DecodeWorkerHandler:
             req, annotations=list(req.annotations or []) + caps)
         instance_id = None
         if self.prefill_queue is not None:
-            instance_id = await self.prefill_queue.acquire()
+            instance_id = await self.prefill_queue.acquire(ctx)
             if (instance_id is not None
                     and instance_id not in self.prefill_client.available_ids()):
                 # claim raced ahead of discovery, or the claimant just died
@@ -180,16 +182,21 @@ class DecodeWorkerHandler:
                                "falling back to round robin", instance_id)
                 instance_id = None
         stream = None
+        # pass ctx so the prefill hop keeps the request's trace identity —
+        # a fresh Context here would land every prefill-side span
+        # (worker.handle / prefill.extract / kv.direct_pull) in a
+        # disconnected trace invisible to /v1/traces/{request_id}
         if instance_id is not None:
             try:
                 stream = await self.prefill_client.generate(
-                    preq.to_wire(), mode="direct", instance_id=instance_id)
+                    preq.to_wire(), ctx=ctx, mode="direct",
+                    instance_id=instance_id)
             except NoRespondersError:
                 logger.warning("claimed prefill instance %x unreachable; "
                                "falling back to round robin", instance_id)
         if stream is None:  # no queue, claim timeout, or dead claimant
             stream = await self.prefill_client.generate(
-                preq.to_wire(), mode="round_robin")
+                preq.to_wire(), ctx=ctx, mode="round_robin")
         eng = self.engine
         bs = eng.args.block_size
         total = (len(req.token_ids) + bs - 1) // bs
@@ -198,6 +205,7 @@ class DecodeWorkerHandler:
         next_block = 0
         presp = None
         owned = False  # ids ownership not yet transferred to a sequence
+        t_xfer0 = time.time()  # remote-prefill stream + KV placement phase
         try:
             from dynamo_tpu.disagg.transfer import KvDirectFrame, pull_bundle
 
@@ -248,6 +256,14 @@ class DecodeWorkerHandler:
                     presp = PrefillResponse.from_wire(frame)
             if presp is None:
                 raise RuntimeError("prefill worker returned no response")
+            # per-tier transfer timing as a first-class signal (KV-cache
+            # survey): covers the prefill stream + chunk scatters
+            get_tracer().record(
+                "kv.transfer", ctx, start=t_xfer0, end=time.time(),
+                service="disagg", blocks_placed=next_block,
+                total_blocks=total, placed=placed,
+                direct=self.engine.direct_transfer is not None
+                if hasattr(self.engine, "direct_transfer") else False)
 
             if presp.token_id < 0 or not placed:
                 if owned:

@@ -43,32 +43,46 @@ class PrefillQueueClient:
         self.queue = queue
         self.claim_timeout = claim_timeout
 
-    async def acquire(self) -> Optional[int]:
+    async def acquire(self, ctx=None) -> Optional[int]:
         """Enqueue a ticket; returns the claiming prefill worker's instance
-        id, or None on timeout (caller falls back to round robin)."""
+        id, or None on timeout (caller falls back to round robin).
+
+        ``ctx`` (optional request Context) attributes the queue wait to the
+        request's trace as a ``prefill.queue_wait`` span — the per-phase
+        latency signal NetKV-style decode-instance selection hinges on."""
+        from dynamo_tpu.observability import get_tracer
+
         job_id = uuid.uuid4().hex
         sub = await self.plane.subscribe(f"{CLAIM_SUBJECT}.{job_id}")
+        span = get_tracer().span("prefill.queue_wait", ctx,
+                                 service="disagg")
         try:
-            # expires_at lets workers discard tickets whose decode side has
-            # already fallen back — a stale ticket must not count as work
-            await self.plane.queue_push(
-                self.queue, msgpack.packb({
-                    "job_id": job_id,
-                    "expires_at": time.time() + self.claim_timeout}))
+            with span as sp:
+                # expires_at lets workers discard tickets whose decode side
+                # has already fallen back — a stale ticket must not count
+                # as work
+                await self.plane.queue_push(
+                    self.queue, msgpack.packb({
+                        "job_id": job_id,
+                        "expires_at": time.time() + self.claim_timeout}))
 
-            async def first_claim():
-                async for _subject, payload in sub:
-                    return msgpack.unpackb(payload, raw=False)
-                return None
+                async def first_claim():
+                    async for _subject, payload in sub:
+                        return msgpack.unpackb(payload, raw=False)
+                    return None
 
-            try:
-                claim = await asyncio.wait_for(first_claim(),
-                                               self.claim_timeout)
-            except asyncio.TimeoutError:
-                logger.warning("prefill queue claim timed out; falling back "
-                               "to round robin")
-                return None
-            return claim["instance_id"] if claim else None
+                try:
+                    claim = await asyncio.wait_for(first_claim(),
+                                                   self.claim_timeout)
+                except asyncio.TimeoutError:
+                    logger.warning("prefill queue claim timed out; falling "
+                                   "back to round robin")
+                    sp.set(claimed=False, timeout=True)
+                    return None
+                iid = claim["instance_id"] if claim else None
+                sp.set(claimed=iid is not None,
+                       instance=f"{iid:x}" if iid is not None else None)
+                return iid
         finally:
             await sub.cancel()
 

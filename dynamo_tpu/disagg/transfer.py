@@ -189,14 +189,22 @@ class DirectTransferManager:
 
     def pull(self, desc: dict) -> list:
         """Fetch the offered arrays; raises on any failure (caller falls
-        back to local prefill)."""
-        try:
-            out = self._pull(desc)
-            self.stats["pulls"] += 1
-            return out
-        except Exception:
-            self.stats["pull_failures"] += 1
-            raise
+        back to local prefill). Attributed to the current request's trace
+        as a ``kv.direct_pull`` span (ctx from the endpoint pump's
+        task-local CURRENT_REQUEST)."""
+        from dynamo_tpu.observability import get_tracer
+
+        with get_tracer().span("kv.direct_pull", service="disagg",
+                               mode=desc.get("mode"),
+                               n_blocks=desc.get("n")) as sp:
+            try:
+                out = self._pull(desc)
+                self.stats["pulls"] += 1
+                return out
+            except Exception:
+                self.stats["pull_failures"] += 1
+                sp.set(failed=True)
+                raise
 
     def _pull(self, desc: dict) -> list:
         mode = desc.get("mode")

@@ -270,18 +270,39 @@ class AsyncJaxEngine:
     async def generate(self, req: PreprocessedRequest, ctx=None
                        ) -> AsyncIterator[LLMEngineOutput]:
         """EngineFn-compatible async stream of per-token outputs."""
+        from dynamo_tpu.observability import get_tracer
+
         self._ensure_loop()
         sink: asyncio.Queue = asyncio.Queue()
         seq = await self._new_seq(req, ctx, sink)
         self.scheduler.add(seq)
         self._wake.set()
-        while True:
-            out: Optional[LLMEngineOutput] = await sink.get()
-            if out is None:
-                return
-            yield out
-            if out.finish_reason is not None:
-                return
+        # phase timing: queue+prefill until the first token (engine-side
+        # TTFT), then the decode loop until finish — recorded as spans on
+        # the request's trace (no-op for trace-less contexts)
+        tracer = get_tracer()
+        t0 = time.time()
+        t_first = None
+        n_tokens = 0
+        try:
+            while True:
+                out: Optional[LLMEngineOutput] = await sink.get()
+                if out is None:
+                    return
+                if t_first is None and out.token_ids:
+                    t_first = time.time()
+                    tracer.record("engine.ttft", ctx, start=t0, end=t_first,
+                                  service="engine",
+                                  prompt_tokens=len(req.token_ids))
+                n_tokens += len(out.token_ids)
+                yield out
+                if out.finish_reason is not None:
+                    return
+        finally:
+            if t_first is not None:
+                tracer.record("engine.decode", ctx, start=t_first,
+                              end=time.time(), service="engine",
+                              tokens=n_tokens)
 
     # ---------------------------------------------------------- embeddings
 
@@ -391,6 +412,7 @@ class AsyncJaxEngine:
         from dynamo_tpu.ops.block_copy import gather_blocks
 
         self._ensure_loop()
+        t0 = time.time()
         sc = dataclasses.replace(req.stop_conditions, max_tokens=1,
                                  min_tokens=1, ignore_eos=True)
         preq = dataclasses.replace(req, stop_conditions=sc)
@@ -425,6 +447,12 @@ class AsyncJaxEngine:
             # reaped with their blocks; finished ones release the held blocks
             self.scheduler.abort(seq)
             self._wake.set()
+            from dynamo_tpu.observability import get_tracer
+
+            get_tracer().record("prefill.extract", ctx, start=t0,
+                                end=time.time(), service="engine",
+                                prompt_tokens=len(req.token_ids),
+                                streamed=False)
 
     async def prefill_extract_stream(self, req: PreprocessedRequest, ctx=None):
         """Pipelined prefill: yields KvChunkFrame wires for blocks whose KV is
@@ -492,6 +520,7 @@ class AsyncJaxEngine:
         drainer = asyncio.get_running_loop().create_task(drain_sink())
         self.scheduler.add(seq)
         self._wake.set()
+        t0 = time.time()
         token, logp = None, None
 
         async def to_host(kb, vb, n):
@@ -560,6 +589,12 @@ class AsyncJaxEngine:
             drainer.cancel()
             self.scheduler.abort(seq)
             self._wake.set()
+            from dynamo_tpu.observability import get_tracer
+
+            get_tracer().record("prefill.extract", ctx, start=t0,
+                                end=time.time(), service="engine",
+                                prompt_tokens=len(req.token_ids),
+                                streamed=True, mode=mode or "host")
 
     async def _gather_bundle(self, ids: list[int], num_tokens: int,
                              start_block: int):
@@ -619,7 +654,11 @@ class AsyncJaxEngine:
 
         Ownership of ``ids`` transfers to the sequence (released on finish).
         """
+        from dynamo_tpu.observability import get_tracer
+
         self._ensure_loop()
+        tracer = get_tracer()
+        t0 = time.time()
         sink: asyncio.Queue = asyncio.Queue()
         seq = await self._new_seq(req, ctx, sink)
         if seq.guided_state is not None:
@@ -629,11 +668,18 @@ class AsyncJaxEngine:
             await asyncio.to_thread(seq.guided_state.advance, token_id)
         self.scheduler.add_prefilled(seq, ids)
 
-        # the prefill worker's token is the stream's first output
+        # the prefill worker's token is the stream's first output;
+        # engine-side "TTFT" here is just the injection admission time
+        # (the real prefill cost lives in the prefill worker's
+        # prefill.extract span)
         first = LLMEngineOutput(token_ids=[token_id],
                                 log_probs=[logprob]
                                 if logprob is not None else None)
         self.scheduler.append_token(seq, token_id)
+        t_first = time.time()
+        tracer.record("engine.ttft", ctx, start=t0, end=t_first,
+                      service="engine", prompt_tokens=len(req.token_ids),
+                      injected=True)
         reason = self.scheduler.check_finish(seq, token_id)
         if reason is not None:
             first.finish_reason = reason
@@ -643,13 +689,20 @@ class AsyncJaxEngine:
         yield first
 
         self._wake.set()
-        while True:
-            out = await sink.get()
-            if out is None:
-                return
-            yield out
-            if out.finish_reason is not None:
-                return
+        n_tokens = 1
+        try:
+            while True:
+                out = await sink.get()
+                if out is None:
+                    return
+                n_tokens += len(out.token_ids)
+                yield out
+                if out.finish_reason is not None:
+                    return
+        finally:
+            tracer.record("engine.decode", ctx, start=t_first,
+                          end=time.time(), service="engine",
+                          tokens=n_tokens)
 
     async def generate_injected(self, req: PreprocessedRequest, prefill,
                                 ctx=None) -> AsyncIterator[LLMEngineOutput]:
@@ -738,9 +791,14 @@ class AsyncJaxEngine:
             await asyncio.sleep(0)
 
     async def _execute(self, plan: StepPlan) -> None:
+        # env-gated jax.profiler correlation (DYN_JAX_PROFILER=1): device
+        # traces carry the serving phase names alongside request spans
+        from dynamo_tpu.observability.profiler import annotate
+
         if plan.prefill:
             t0 = time.perf_counter()
-            await self._run_prefill(plan.prefill)
+            with annotate("dynamo.prefill_step"):
+                await self._run_prefill(plan.prefill)
             self.step_trace.append((
                 "prefill", len(plan.prefill),
                 sum(w.chunk for w in plan.prefill),
@@ -748,7 +806,8 @@ class AsyncJaxEngine:
         if plan.decode:
             t0 = time.perf_counter()
             gen0 = sum(s.generated for s in plan.decode)
-            await self._run_decode(plan.decode)
+            with annotate("dynamo.decode_step"):
+                await self._run_decode(plan.decode)
             self.step_trace.append((
                 "decode", len(plan.decode),
                 sum(s.generated for s in plan.decode) - gen0,

@@ -446,6 +446,12 @@ async def amain():
                 worker_id=kvbm_worker.worker_id)
 
     handle = await ep.serve_endpoint(serve, lease_id=lease)
+    # span buffer query endpoint (observability/collector.py): lets the
+    # frontend's /v1/traces/{id} and `dynctl trace` stitch this worker's
+    # engine/prefill/KV-transfer spans into the request trace
+    from dynamo_tpu.observability import ensure_trace_endpoint
+
+    await ensure_trace_endpoint(runtime)
     embed_handle = None
     if cli.role != "prefill":  # embeddings ride the decode/agg fleet
         embed_ep = ns.component(component).endpoint("embed")

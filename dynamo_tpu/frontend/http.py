@@ -41,11 +41,55 @@ from dynamo_tpu.protocols.openai import (
     response_msg_id,
     response_object,
 )
+from dynamo_tpu.observability import fetch_trace, get_tracer
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.control_plane import NoRespondersError
-from dynamo_tpu.runtime.metrics import MetricsRegistry
+from dynamo_tpu.runtime.metrics import MetricsRegistry, render_registries
 
 logger = logging.getLogger("dynamo.http")
+
+
+class _StreamTiming:
+    """TTFT/ITL phase accounting shared by BOTH SSE paths (chat/completions
+    and responses) — one implementation so the SLO series can never diverge
+    by route. Epoch timestamps so the spans stitch with worker-side spans."""
+
+    def __init__(self, service: "HttpService", route: str, t0_perf: float):
+        self._svc = service
+        self.route = route
+        self.t0_epoch = time.time() - (time.perf_counter() - t0_perf)
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
+        self.n_chunks = 0
+        self._itl = service.tracer.metrics.histogram("itl_seconds")
+
+    def tick(self) -> bool:
+        """Mark one streamed output chunk; True when it was the first.
+        Each inter-chunk gap feeds dynamo_itl_seconds."""
+        now = time.time()
+        first = self.t_first is None
+        if first:
+            self.t_first = now
+        elif self.t_last is not None:
+            self._itl.observe(now - self.t_last)
+        self.t_last = now
+        self.n_chunks += 1
+        return first
+
+    def finish(self, ctx) -> None:
+        """Record the retroactive "ttft" (arrival → first chunk) and "itl"
+        (first → last chunk) phase spans."""
+        if self.t_first is None:
+            return
+        tracer = self._svc.tracer
+        tracer.record("ttft", ctx, start=self.t0_epoch, end=self.t_first,
+                      service="frontend", route=self.route)
+        if self.t_last is not None and self.n_chunks > 1:
+            dur = self.t_last - self.t_first
+            tracer.record("itl", ctx, start=self.t_first, end=self.t_last,
+                          service="frontend", route=self.route,
+                          chunks=self.n_chunks,
+                          mean_itl_s=round(dur / (self.n_chunks - 1), 6))
 
 
 class HttpService:
@@ -57,9 +101,13 @@ class HttpService:
         port: int = 8000,
         tls_cert_path: Optional[str] = None,
         tls_key_path: Optional[str] = None,
+        runtime=None,
     ):
         self.manager = manager
         self.metrics = metrics or MetricsRegistry()
+        #: optional DistributedRuntime — lets /v1/traces/{id} stitch spans
+        #: fetched from workers over the control plane (None = local only)
+        self.runtime = runtime
         #: optional TLS (ref: service_v2.rs:132 enable_tls/cert/key) —
         #: both paths or neither
         if bool(tls_cert_path) != bool(tls_key_path):
@@ -94,6 +142,13 @@ class HttpService:
         self._finished = self.metrics.counter(
             "llm_requests_finished_total", "Finished LLM requests by model")
 
+    @property
+    def tracer(self):
+        """Resolved per use: configure_tracer() after service construction
+        must not silently split /metrics and /v1/traces from the recorder
+        every instrumentation site writes to."""
+        return get_tracer()
+
     def _record_usage(self, model: str, usage: Optional[dict]) -> None:
         if not usage:
             return
@@ -112,6 +167,9 @@ class HttpService:
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/live", self.handle_live)
         app.router.add_get("/metrics", self.handle_metrics)
+        # stitched request trace (observability spine): spans recorded in
+        # this process merged with spans fetched from workers
+        app.router.add_get("/v1/traces/{request_id}", self.handle_trace)
         # admin: flush every worker's KV cache/prefix state (ref:
         # lib/llm/src/http/service/clear_kv_blocks.rs)
         app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
@@ -195,7 +253,37 @@ class HttpService:
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         self._refresh_router_metrics()
-        return web.Response(text=self.metrics.render(), content_type="text/plain")
+        # merged exposition: HTTP registry + the tracer's SLO registry
+        # (dynamo_ttft_seconds / dynamo_itl_seconds / dynamo_e2e_seconds /
+        # dynamo_phase_seconds{phase=...}) with duplicate headers dropped
+        text = render_registries(self.metrics, self.tracer.metrics)
+        return web.Response(text=text, content_type="text/plain")
+
+    async def handle_trace(self, request: web.Request) -> web.Response:
+        """GET /v1/traces/{request_id} — the stitched request trace.
+
+        Merges this process's span buffer with spans fanned out from every
+        registered worker tracer (observability/collector.py); the request
+        id doubles as the trace id when the client sent no traceparent."""
+        rid = request.match_info["request_id"]
+        spans = {s.span_id: s.to_dict() for s in self.tracer.spans_for(rid)}
+        if self.runtime is not None:
+            try:
+                for d in await fetch_trace(self.runtime.plane, rid):
+                    spans.setdefault(d["span_id"], d)
+            except Exception:
+                logger.exception("trace fan-out failed; serving local spans")
+        if not spans:
+            return web.json_response(
+                error_body(f"no trace recorded for '{rid}'",
+                           "trace_not_found", 404), status=404)
+        ordered = sorted(spans.values(), key=lambda d: d.get("start") or 0.0)
+        return web.json_response({
+            "request_id": rid,
+            "trace_id": ordered[0].get("trace_id"),
+            "phases": sorted({d.get("name") for d in ordered}),
+            "spans": ordered,
+        })
 
     def _refresh_router_metrics(self) -> None:
         """Snapshot per-model KV-router stream health into gauges at scrape
@@ -279,16 +367,21 @@ class HttpService:
             return web.json_response(
                 error_body("at most 256 inputs per embeddings request"),
                 status=400)
-        try:
-            vecs = await served.embed(token_lists, ctx=ctx)
-        except ValueError as e:
-            self._requests.inc(route="embeddings", model=model, status="400")
-            return web.json_response(error_body(str(e)), status=400)
-        except NoRespondersError:
-            self._requests.inc(route="embeddings", model=model, status="503")
-            return web.json_response(
-                error_body("no workers available", "service_unavailable", 503),
-                status=503)
+        # root span so the worker's embed spans have a recorded parent
+        with self.tracer.span(
+                "http.request", ctx, service="frontend",
+                adopt_wire_span=ctx.traceparent_synthesized,
+                route="embeddings", model=model):
+            try:
+                vecs = await served.embed(token_lists, ctx=ctx)
+            except ValueError as e:
+                self._requests.inc(route="embeddings", model=model, status="400")
+                return web.json_response(error_body(str(e)), status=400)
+            except NoRespondersError:
+                self._requests.inc(route="embeddings", model=model, status="503")
+                return web.json_response(
+                    error_body("no workers available", "service_unavailable", 503),
+                    status=503)
         self._requests.inc(route="embeddings", model=model, status="200")
         self._latency.observe(time.perf_counter() - t0, route="embeddings")
         return web.json_response({
@@ -329,6 +422,17 @@ class HttpService:
         created = int(time.time())
         self._inflight_count += 1
         self._inflight.set(self._inflight_count)
+        # root span (same contract as _handle_llm): downstream phases must
+        # have a recorded parent or the trace renders as an orphan forest
+        with self.tracer.span(
+                "http.request", ctx, service="frontend",
+                adopt_wire_span=ctx.traceparent_synthesized,
+                route="responses", model=parsed.model):
+            return await self._handle_responses_inner(
+                request, served, parsed, ctx, rid, created, t0)
+
+    async def _handle_responses_inner(self, request, served, parsed, ctx,
+                                      rid, created, t0) -> web.StreamResponse:
         try:
             stream = served.pipeline.generate(parsed, ctx)
             if parsed.stream:
@@ -379,12 +483,14 @@ class HttpService:
         status = "200"
         parts: list[str] = []
         usage = None
+        # same TTFT/ITL phase recording as _stream_sse, keyed on output
+        # text deltas — responses traffic must feed the same SLO series
+        timing = _StreamTiming(self, "responses", t0)
         try:
             await emit("response.created", {
                 "type": "response.created",
                 "response": response_object(rid, model, created, "",
                                             "in_progress")})
-            first = True
             finish = None
             async for wire in stream:
                 ann = Annotated.from_wire(wire)
@@ -405,10 +511,9 @@ class HttpService:
                     delta = (ch.get("delta") or {}).get("content")
                     finish = ch.get("finish_reason") or finish
                     if delta:
-                        if first:
+                        if timing.tick():
                             self._ttft.observe(time.perf_counter() - t0,
                                                route="responses")
-                            first = False
                         parts.append(delta)
                         await emit("response.output_text.delta", {
                             "type": "response.output_text.delta",
@@ -451,6 +556,7 @@ class HttpService:
         finally:
             self._requests.inc(route="responses", model=model, status=status)
             self._latency.observe(time.perf_counter() - t0, route="responses")
+            timing.finish(ctx)
         await resp.write_eof()
         return resp
 
@@ -487,30 +593,41 @@ class HttpService:
         ctx = self._request_context(request)
         self._inflight_count += 1
         self._inflight.set(self._inflight_count)
-        try:
-            stream = served.pipeline.generate(parsed, ctx)
-            if parsed.stream:
-                return await self._stream_sse(
-                    request, stream, ctx, route, parsed.model, t0,
-                    keep_usage=parsed.stream_usage)
+        # root span: every downstream phase (tokenize, route, worker,
+        # engine, TTFT/ITL) parents under it; duration feeds
+        # dynamo_e2e_seconds via the tracer's SLO registry. When WE
+        # synthesized the traceparent the root adopts its span id (no
+        # phantom parent); a client-sent traceparent stays the parent.
+        with self.tracer.span(
+                "http.request", ctx, service="frontend",
+                adopt_wire_span=ctx.traceparent_synthesized,
+                route=route, model=parsed.model) as root:
             try:
-                agg = aggregate_chat_stream(stream) if chat else aggregate_completion_stream(stream)
-                result = await agg
-                self._record_usage(parsed.model, result.get("usage"))
-            except NoRespondersError:
-                self._requests.inc(route=route, model=parsed.model, status="503")
-                return web.json_response(
-                    error_body("no workers available", "service_unavailable", 503), status=503
-                )
-            except (ValueError, RuntimeError) as e:
-                self._requests.inc(route=route, model=parsed.model, status="400")
-                return web.json_response(error_body(str(e)), status=400)
-            self._requests.inc(route=route, model=parsed.model, status="200")
-            self._latency.observe(time.perf_counter() - t0, route=route)
-            return web.json_response(result, headers={"x-request-id": ctx.id})
-        finally:
-            self._inflight_count -= 1
-            self._inflight.set(self._inflight_count)
+                stream = served.pipeline.generate(parsed, ctx)
+                if parsed.stream:
+                    return await self._stream_sse(
+                        request, stream, ctx, route, parsed.model, t0,
+                        keep_usage=parsed.stream_usage)
+                try:
+                    agg = aggregate_chat_stream(stream) if chat else aggregate_completion_stream(stream)
+                    result = await agg
+                    self._record_usage(parsed.model, result.get("usage"))
+                except NoRespondersError:
+                    root.set(status_code=503)
+                    self._requests.inc(route=route, model=parsed.model, status="503")
+                    return web.json_response(
+                        error_body("no workers available", "service_unavailable", 503), status=503
+                    )
+                except (ValueError, RuntimeError) as e:
+                    root.set(status_code=400)
+                    self._requests.inc(route=route, model=parsed.model, status="400")
+                    return web.json_response(error_body(str(e)), status=400)
+                self._requests.inc(route=route, model=parsed.model, status="200")
+                self._latency.observe(time.perf_counter() - t0, route=route)
+                return web.json_response(result, headers={"x-request-id": ctx.id})
+            finally:
+                self._inflight_count -= 1
+                self._inflight.set(self._inflight_count)
 
     async def _stream_sse(
         self, request: web.Request, stream, ctx: Context, route: str,
@@ -525,8 +642,8 @@ class HttpService:
             },
         )
         await resp.prepare(request)
-        first = True
         status = "200"
+        timing = _StreamTiming(self, route, t0)
         try:
             async for wire in stream:
                 ann = Annotated.from_wire(wire)
@@ -540,9 +657,8 @@ class HttpService:
                         f"event: {ann.event}\ndata: {json.dumps(ann.data)}\n\n".encode()
                     )
                     continue
-                if first:
+                if timing.tick():
                     self._ttft.observe(time.perf_counter() - t0, route=route)
-                    first = False
                 data = ann.data
                 if isinstance(data, dict) and "usage" in data:
                     # the pipeline always attaches final-chunk usage for
@@ -571,5 +687,6 @@ class HttpService:
         finally:
             self._requests.inc(route=route, model=model, status=status)
             self._latency.observe(time.perf_counter() - t0, route=route)
+            timing.finish(ctx)
         await resp.write_eof()
         return resp

@@ -61,10 +61,16 @@ async def amain():
     ).start()
     service = HttpService(manager, host=args.host, port=args.port,
                           tls_cert_path=args.tls_cert_path,
-                          tls_key_path=args.tls_key_path)
+                          tls_key_path=args.tls_key_path,
+                          runtime=runtime)
     if args.admin_token:
         service.admin_token = args.admin_token
     await service.start()
+    # register this process's span buffer so `dynctl trace` sees the
+    # frontend-side phases (http.request / tokenize / route / ttft / itl)
+    from dynamo_tpu.observability import ensure_trace_endpoint
+
+    await ensure_trace_endpoint(runtime)
     grpc_service = None
     if args.grpc_port:
         from dynamo_tpu.frontend.grpc import KserveGrpcService

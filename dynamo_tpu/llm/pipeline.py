@@ -217,8 +217,13 @@ class OpenAIPreprocessor:
 
     async def generate(self, req: ParsedRequest, ctx: Context) -> AsyncIterator[dict]:
         """Yields Annotated-wire dicts whose ``data`` are OpenAI chunk objects."""
+        from dynamo_tpu.observability import get_tracer
+
         is_chat = req.messages is not None
-        pre, prompt = self.preprocess(req)
+        with get_tracer().span("preprocess.tokenize", ctx,
+                               service="frontend") as sp:
+            pre, prompt = self.preprocess(req)
+            sp.set(n_prompt_tokens=len(pre.token_ids), chat=is_chat)
 
         request_id = gen_request_id("chatcmpl" if is_chat else "cmpl")
         created = int(time.time())

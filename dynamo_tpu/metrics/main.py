@@ -81,10 +81,18 @@ class MetricsService:
         a = self.agg.aggregate()
         lines = []
 
-        def gauge(name, value, help_):
+        def metric(name, value, help_, type_):
             lines.append(f"# HELP dynamo_{name} {help_}")
-            lines.append(f"# TYPE dynamo_{name} gauge")
+            lines.append(f"# TYPE dynamo_{name} {type_}")
             lines.append(f"dynamo_{name} {value}")
+
+        def gauge(name, value, help_):
+            metric(name, value, help_, "gauge")
+
+        def counter(name, value, help_):
+            # monotonically increasing series: advertising them as gauges
+            # breaks every rate()/increase() query downstream
+            metric(name, value, help_, "counter")
 
         gauge("workers", a["workers"], "live workers reporting metrics")
         gauge("kv_active_blocks", a["kv_active_blocks"], "in-use KV blocks")
@@ -93,10 +101,10 @@ class MetricsService:
               "cluster KV usage fraction")
         gauge("requests_active", a["requests_active"], "in-flight requests")
         gauge("requests_waiting", a["requests_waiting"], "queued requests")
-        gauge("kv_blocks_stored_total", self.kv_stored,
-              "KV stored events observed")
-        gauge("kv_blocks_removed_total", self.kv_removed,
-              "KV removed events observed")
+        counter("kv_blocks_stored_total", self.kv_stored,
+                "KV stored events observed")
+        counter("kv_blocks_removed_total", self.kv_removed,
+                "KV removed events observed")
         gauge("prefill_queue_depth", prefill_queue_depth,
               "tickets waiting in the global prefill queue")
         return "\n".join(lines) + "\n"

@@ -197,13 +197,34 @@ class MockEngine:
         )
         self.waiting.append(seq)
         self._wake.set()
-        while True:
-            out = await seq.out_queue.get()
-            if out is None:
-                return
-            yield out.to_wire()
-            if out.finish_reason is not None:
-                return
+        # same engine-side phase spans the real engine records, so the
+        # mock path yields a full stitched trace in accelerator-less tests
+        from dynamo_tpu.observability import get_tracer
+
+        tracer = get_tracer()
+        t0 = time.time()
+        t_first = None
+        n_tokens = 0
+        try:
+            while True:
+                out = await seq.out_queue.get()
+                if out is None:
+                    return
+                if t_first is None and out.token_ids:
+                    t_first = time.time()
+                    tracer.record("engine.ttft", ctx, start=t0, end=t_first,
+                                  service="engine",
+                                  prompt_tokens=len(req.token_ids),
+                                  cached_tokens=seq.cached_tokens)
+                n_tokens += len(out.token_ids)
+                yield out.to_wire()
+                if out.finish_reason is not None:
+                    return
+        finally:
+            if t_first is not None:
+                tracer.record("engine.decode", ctx, start=t_first,
+                              end=time.time(), service="engine",
+                              tokens=n_tokens)
 
     # -- engine loop -------------------------------------------------------
     async def _engine_loop(self):

@@ -72,6 +72,10 @@ async def run_mocker(
     card.runtime_config.max_num_seqs = args.max_num_seqs
     card.runtime_config.max_num_batched_tokens = args.max_num_batched_tokens
     await register_llm(runtime, ep, card, lease_id=lease0)
+    # expose this process's span buffer to /v1/traces/{id} + dynctl trace
+    from dynamo_tpu.observability import ensure_trace_endpoint
+
+    await ensure_trace_endpoint(runtime)
     return engines, handles
 
 

@@ -1,0 +1,29 @@
+"""Distributed request tracing + request-lifecycle SLO metrics.
+
+Span/Tracer recorder keyed by the runtime's existing W3C trace ids
+(tracing.py), cross-process stitching over the control plane (collector.py),
+and the env-gated jax.profiler correlation hook (profiler.py).
+See docs/observability.md.
+"""
+
+from dynamo_tpu.observability.tracing import (
+    CURRENT_SPAN,
+    Span,
+    Tracer,
+    configure_tracer,
+    get_tracer,
+    parse_traceparent,
+    stitch,
+)
+from dynamo_tpu.observability.collector import (
+    TRACER_PREFIX,
+    ensure_trace_endpoint,
+    fetch_trace,
+    serve_traces,
+)
+
+__all__ = [
+    "CURRENT_SPAN", "Span", "Tracer", "configure_tracer", "get_tracer",
+    "parse_traceparent", "stitch", "TRACER_PREFIX",
+    "ensure_trace_endpoint", "fetch_trace", "serve_traces",
+]

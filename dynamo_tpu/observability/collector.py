@@ -1,0 +1,125 @@
+"""Cross-process trace stitching over the control plane.
+
+Each tracing process registers a tiny request handler (``serve_traces``)
+under a discovery key, and anyone holding a control-plane client can fan a
+request id out to every registered tracer and merge the answers
+(``fetch_trace``) — the transport behind the frontend's
+``/v1/traces/{request_id}`` debug endpoint and ``dynctl trace``.
+
+The discovery key lives under the process's primary lease, so a dead worker
+drops out of the fan-out exactly like its serving endpoints do (ref: the
+component model's instance keys, runtime/component.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.observability.tracing import Tracer, get_tracer
+
+logger = logging.getLogger("dynamo.observability")
+
+#: discovery prefix: observability/tracers/<lease-hex> → {subject, service}
+TRACER_PREFIX = "observability/tracers/"
+
+
+class TraceServeHandle:
+    def __init__(self, runtime, key: str, cancel_serve):
+        self._runtime = runtime
+        self._key = key
+        self._cancel = cancel_serve
+
+    async def stop(self) -> None:
+        try:
+            self._runtime.drop_registration(self._key)
+            await self._runtime.plane.kv_delete(self._key)
+        finally:
+            if self._cancel:
+                await self._cancel()
+
+
+async def serve_traces(runtime, tracer: Optional[Tracer] = None
+                       ) -> TraceServeHandle:
+    """Expose this process's span buffer to trace queries.
+
+    Query wire: msgpack ``{"request_id": <id>}`` → ``{"service": ...,
+    "spans": [span dicts]}``; an empty/absent request id returns the whole
+    buffer (bounded by the tracer's ring capacity).
+    """
+    # resolve the GLOBAL tracer per request unless one was pinned
+    # explicitly — a configure_tracer() after registration must not leave
+    # this endpoint serving an abandoned buffer (same split the
+    # HttpService.tracer property prevents)
+    def current() -> Tracer:
+        return tracer if tracer is not None else get_tracer()
+
+    lease = await runtime.primary_lease()
+    subject = f"traces-{lease:x}"
+
+    async def on_request(payload: bytes) -> bytes:
+        try:
+            q = msgpack.unpackb(payload, raw=False) or {}
+        except Exception:
+            q = {}
+        trc = current()
+        rid = q.get("request_id")
+        spans = trc.spans_for(rid) if rid else trc.all_spans()
+        return msgpack.packb({
+            "service": trc.service,
+            "spans": [s.to_dict() for s in spans],
+        })
+
+    cancel = await runtime.plane.serve(subject, on_request)
+    key = f"{TRACER_PREFIX}{lease:x}"
+    value = msgpack.packb({"subject": subject, "service": current().service})
+    await runtime.plane.kv_put(key, value, lease_id=lease)
+    runtime.record_registration(key, value)
+    logger.debug("trace query endpoint on %s", subject)
+    return TraceServeHandle(runtime, key, cancel)
+
+
+async def ensure_trace_endpoint(runtime) -> TraceServeHandle:
+    """Idempotent per-runtime ``serve_traces`` — entrypoints that may start
+    several components on one runtime (mocker ranks, engine roles) register
+    exactly one trace query endpoint."""
+    handle = getattr(runtime, "_trace_serve_handle", None)
+    if handle is None:
+        handle = await serve_traces(runtime)
+        runtime._trace_serve_handle = handle
+    return handle
+
+
+async def fetch_trace(plane, request_id: str, timeout: float = 2.0
+                      ) -> list[dict]:
+    """Fan ``request_id`` out to every registered tracer; merged span dicts
+    (deduped by span id, ordered by start time). A slow or dead tracer
+    times out individually — partial traces beat no trace."""
+    try:
+        entries = await plane.kv_get_prefix(TRACER_PREFIX)
+    except Exception:
+        logger.exception("tracer discovery failed")
+        return []
+
+    async def one(value: bytes) -> list[dict]:
+        try:
+            meta = msgpack.unpackb(value, raw=False)
+            raw = await asyncio.wait_for(
+                plane.request(meta["subject"],
+                              msgpack.packb({"request_id": request_id}),
+                              timeout=timeout),
+                timeout + 0.5)
+            return msgpack.unpackb(raw, raw=False).get("spans") or []
+        except Exception:
+            return []  # that tracer is gone/slow; keep the rest
+
+    results = await asyncio.gather(*(one(v) for v in entries.values()))
+    merged: dict[str, dict] = {}
+    for spans in results:
+        for d in spans:
+            if isinstance(d, dict) and d.get("span_id"):
+                merged.setdefault(d["span_id"], d)
+    return sorted(merged.values(), key=lambda d: (d.get("start") or 0.0))

@@ -1,0 +1,46 @@
+"""Optional ``jax.profiler`` correlation hook (env-gated).
+
+With ``DYN_JAX_PROFILER=1`` the engine wraps each jitted step dispatch in a
+``jax.profiler.TraceAnnotation``, so device traces captured with
+``jax.profiler.start_trace`` carry the serving-layer phase names
+(``dynamo.prefill_step`` / ``dynamo.decode_step``) and line up with the
+request spans recorded by the tracer. Off by default: the annotation is a
+per-dispatch host-side cost the steady-state serving loop should not pay.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+_enabled: bool | None = None
+
+
+def enabled() -> bool:
+    """Gate, computed once per process (the engine loop is hot)."""
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get(
+            "DYN_JAX_PROFILER", "").lower() not in ("", "0", "false")
+    return _enabled
+
+
+def _reset_for_tests() -> None:
+    global _enabled
+    _enabled = None
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """``with annotate("dynamo.decode_step"): <dispatch>`` — no-op unless
+    DYN_JAX_PROFILER is set and jax's profiler is importable."""
+    if not enabled():
+        yield
+        return
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:  # jax absent/old: gating must never break serving
+        yield
+        return
+    with TraceAnnotation(name):
+        yield

@@ -1,0 +1,398 @@
+"""In-process span recorder keyed by the runtime's W3C trace ids.
+
+The runtime has propagated a ``traceparent`` on every ``Context`` hop since
+the beginning (runtime/context.py) but nothing ever *recorded* a span, so
+operators could not answer "where did this request spend its time" across
+frontend → router → prefill → KV transfer → decode (ref survey §2,
+``logging.rs`` span parenting). This module is that missing recorder:
+
+- ``Span`` — one named phase of one request: trace id + span id + parent,
+  wall-clock start/end (epoch seconds, so spans stitch across processes),
+  free-form attributes.
+- ``Tracer`` — per-process singleton holding a bounded ring buffer of ended
+  spans, a ``MetricsRegistry`` of SLO histograms fed on span end
+  (``dynamo_phase_seconds{phase=...}``, ``dynamo_ttft_seconds``,
+  ``dynamo_itl_seconds``, ``dynamo_e2e_seconds``), and optional JSONL export
+  (``DYN_TRACE_JSONL=<path>`` appends every ended span).
+
+Parenting rules (W3C-compatible without changing Context wire semantics —
+``to_wire`` still mints a fresh span id per hop, see
+tests/test_runtime.py::test_traceparent_synthesis_and_child_spans):
+
+1. same task/process: a new span parents to the task-local CURRENT_SPAN
+   when it belongs to the same trace;
+2. cross-process: the receiver's first span parents to the span id carried
+   by the incoming ``traceparent`` — and the *sender* records that hop id
+   as a zero-cost ``rpc.send`` span (``Tracer.record_hop``) so the chain
+   frontend span → hop span → worker span stitches with no orphans.
+
+Every API degrades to a no-op when the context carries no trace identity
+(e.g. the engine's ``_NullCtx``) so call sites need no guards.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import json
+import os
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from dynamo_tpu.runtime.context import CURRENT_REQUEST, Context
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+#: task-local innermost live span — the parent for same-process child spans
+CURRENT_SPAN: contextvars.ContextVar[Optional["Span"]] = (
+    contextvars.ContextVar("dyn_current_span", default=None))
+
+#: span name → extra (unlabeled) histogram fed on end, besides phase_seconds
+_SLO_HISTOGRAMS = {
+    "http.request": "e2e_seconds",
+    "ttft": "ttft_seconds",
+    "engine.ttft": "engine_ttft_seconds",
+}
+
+#: zero-duration marker spans (wire hops): stored for stitching but kept
+#: out of the latency histograms — an always-zero phase whose count can
+#: exceed request count under retries is dashboard noise
+_NO_HISTOGRAM = {"rpc.send"}
+
+
+def parse_traceparent(tp: Optional[str]) -> Optional[tuple[str, str]]:
+    """``00-<trace>-<span>-<flags>`` → (trace_id, span_id), else None.
+    Validity is delegated to ``Context._traceparent_valid`` — ONE parser
+    rules both synthesis (ensure_traceparent) and recording, so the two
+    can never drift into accepting different formats."""
+    if not tp or not Context._traceparent_valid(tp):
+        return None
+    parts = tp.split("-")
+    return parts[1], parts[2]
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str] = None
+    start: float = 0.0          # epoch seconds (cross-process stitchable)
+    end: Optional[float] = None
+    service: str = ""
+    request_id: Optional[str] = None
+    attributes: dict[str, Any] = field(default_factory=dict)
+    status: str = "ok"
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+    def set(self, **attrs) -> "Span":
+        self.attributes.update(attrs)
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "trace_id": self.trace_id,
+            "span_id": self.span_id, "parent_span_id": self.parent_span_id,
+            "start": self.start, "end": self.end, "service": self.service,
+            "request_id": self.request_id, "attributes": self.attributes,
+            "status": self.status,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Span":
+        return Span(
+            name=d.get("name", ""), trace_id=d.get("trace_id", ""),
+            span_id=d.get("span_id", ""),
+            parent_span_id=d.get("parent_span_id"),
+            start=d.get("start", 0.0), end=d.get("end"),
+            service=d.get("service", ""), request_id=d.get("request_id"),
+            attributes=d.get("attributes") or {},
+            status=d.get("status", "ok"),
+        )
+
+
+class _NoopSpan:
+    """Returned when the context has no trace identity: every method a real
+    span exposes, doing nothing — call sites stay guard-free."""
+
+    name = trace_id = span_id = service = ""
+    parent_span_id = request_id = end = duration = None
+    start = 0.0
+    status = "ok"
+    attributes: dict = {}
+
+    def set(self, **attrs):
+        return self
+
+    def __setattr__(self, k, v):  # the singleton must stay immutable
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _SpanScope:
+    """Context manager from ``Tracer.span``: starts on enter, binds
+    CURRENT_SPAN, ends + records on exit (status=error on exception)."""
+
+    def __init__(self, tracer: "Tracer", name: str, ctx, service, attrs,
+                 adopt_wire_span: bool = False):
+        self._tracer = tracer
+        self._name = name
+        self._ctx = ctx
+        self._service = service
+        self._attrs = attrs
+        self._adopt = adopt_wire_span
+        self._span = _NOOP
+        self._token = None
+
+    def __enter__(self):
+        self._span = self._tracer.start(self._name, self._ctx,
+                                        service=self._service,
+                                        adopt_wire_span=self._adopt,
+                                        **self._attrs)
+        if self._span is not _NOOP:
+            self._token = CURRENT_SPAN.set(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._token is not None:
+            CURRENT_SPAN.reset(self._token)
+        if self._span is not _NOOP:
+            if exc_type is not None:
+                self._span.status = "error"
+                self._span.set(error=repr(exc)[:200])
+            self._tracer.finish(self._span)
+        return False
+
+
+class Tracer:
+    """Bounded in-process trace store + SLO histogram feeder.
+
+    One per process (``get_tracer()``); thread-safe — spans may end from
+    worker threads (the engine's sampling thread) while the event loop
+    starts new ones.
+    """
+
+    def __init__(self, service: str = "", capacity: int = 2048,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.service = service or os.environ.get("DYN_SERVICE", "dynamo")
+        self.metrics = metrics or MetricsRegistry()
+        self._spans: collections.deque[Span] = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+        self._jsonl_path = os.environ.get("DYN_TRACE_JSONL") or None
+        # pre-create the SLO series so /metrics exposes them before the
+        # first request (operators wire dashboards against empty series)
+        self.metrics.histogram(
+            "phase_seconds", "Per-phase request latency by span name")
+        self.metrics.histogram(
+            "ttft_seconds", "Time to first streamed token (frontend)")
+        self.metrics.histogram(
+            "itl_seconds", "Inter-token latency (frontend, per gap)")
+        self.metrics.histogram(
+            "e2e_seconds", "End-to-end request latency (frontend)")
+        self.metrics.histogram(
+            "engine_ttft_seconds",
+            "Engine-side queue+prefill time to first token")
+
+    # ------------------------------------------------------------ creation
+
+    @staticmethod
+    def _resolve_ctx(ctx):
+        """A usable Context (has a trace identity) or None. ``ctx=None``
+        falls back to the task-local CURRENT_REQUEST — worker-side helpers
+        (e.g. the KV transfer manager) have no ctx parameter but run under
+        the endpoint pump which binds it."""
+        if ctx is None:
+            ctx = CURRENT_REQUEST.get()
+        if ctx is None or not hasattr(ctx, "ensure_traceparent"):
+            return None
+        return ctx
+
+    def start(self, name: str, ctx=None, service: Optional[str] = None,
+              adopt_wire_span: bool = False, **attrs) -> Span:
+        """``adopt_wire_span``: the span takes the traceparent's own span id
+        as its identity instead of parenting to it — for the trust-boundary
+        root when the frontend SYNTHESIZED the traceparent (a parent id that
+        no process ever recorded would read as a broken chain)."""
+        ctx = self._resolve_ctx(ctx)
+        if ctx is None:
+            return _NOOP
+        parsed = parse_traceparent(ctx.ensure_traceparent())
+        if parsed is None:
+            return _NOOP
+        trace_id, wire_span = parsed
+        cur = CURRENT_SPAN.get()
+        if cur is not None and cur.trace_id == trace_id:
+            parent, span_id = cur.span_id, secrets.token_hex(8)
+        elif adopt_wire_span:
+            parent, span_id = None, wire_span
+        else:
+            parent, span_id = wire_span, secrets.token_hex(8)
+        return Span(
+            name=name, trace_id=trace_id, span_id=span_id,
+            parent_span_id=parent, start=time.time(),
+            service=service or self.service,
+            request_id=getattr(ctx, "id", None), attributes=dict(attrs))
+
+    def finish(self, span: Span) -> None:
+        if span is _NOOP or isinstance(span, _NoopSpan):
+            return
+        if span.end is None:
+            span.end = time.time()
+        self._store(span)
+
+    def span(self, name: str, ctx=None, service: Optional[str] = None,
+             adopt_wire_span: bool = False, **attrs) -> _SpanScope:
+        """``with tracer.span("router.schedule", ctx) as sp: ...``"""
+        return _SpanScope(self, name, ctx, service, attrs,
+                          adopt_wire_span=adopt_wire_span)
+
+    def record(self, name: str, ctx=None, start: Optional[float] = None,
+               end: Optional[float] = None, service: Optional[str] = None,
+               **attrs) -> Span:
+        """Record a span retroactively from measured timestamps (epoch
+        seconds) — how TTFT/ITL phases are logged once the boundary token
+        has actually been observed."""
+        sp = self.start(name, ctx, service=service, **attrs)
+        if sp is _NOOP or isinstance(sp, _NoopSpan):
+            return sp
+        now = time.time()
+        sp.start = start if start is not None else now
+        sp.end = end if end is not None else now
+        self._store(sp)
+        return sp
+
+    def record_hop(self, ctx, hop_traceparent: Optional[str],
+                   **attrs) -> Span:
+        """Record the wire hop minted by ``Context.to_wire`` as a real span
+        (name ``rpc.send``) so the receiver's spans — which parent to that
+        hop id — stitch back to the sender's chain."""
+        parsed = parse_traceparent(hop_traceparent)
+        if parsed is None:
+            return _NOOP
+        trace_id, hop_span = parsed
+        cur = CURRENT_SPAN.get()
+        parent = None
+        if cur is not None and cur.trace_id == trace_id:
+            parent = cur.span_id
+        else:
+            own = parse_traceparent(getattr(ctx, "traceparent", None))
+            if own is not None and own[0] == trace_id:
+                parent = own[1]
+        now = time.time()
+        sp = Span(name="rpc.send", trace_id=trace_id, span_id=hop_span,
+                  parent_span_id=parent, start=now, end=now,
+                  service=self.service,
+                  request_id=getattr(ctx, "id", None),
+                  attributes=dict(attrs))
+        self._store(sp)
+        return sp
+
+    # ------------------------------------------------------------- storage
+
+    def _store(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+        dur = span.duration
+        if dur is not None and dur >= 0 and span.name not in _NO_HISTOGRAM:
+            self.metrics.histogram("phase_seconds").observe(
+                dur, phase=span.name)
+            extra = _SLO_HISTOGRAMS.get(span.name)
+            if extra:
+                self.metrics.histogram(extra).observe(dur)
+        path = self._jsonl_path
+        if path:
+            try:
+                with open(path, "a") as f:
+                    f.write(json.dumps(span.to_dict()) + "\n")
+            except OSError:
+                self._jsonl_path = None  # never retry a broken sink per span
+
+    def spans_for(self, request_or_trace_id: str) -> list[Span]:
+        """All buffered spans whose request id OR trace id matches, oldest
+        first (the request id doubles as the trace id when the client sent
+        no traceparent — context.py:ensure_traceparent)."""
+        rid = request_or_trace_id
+        with self._lock:
+            return [s for s in self._spans
+                    if s.request_id == rid or s.trace_id == rid]
+
+    def all_spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def export_jsonl(self, path: str) -> int:
+        """Dump every buffered span as JSONL; returns the line count."""
+        spans = self.all_spans()
+        with open(path, "w") as f:
+            for s in spans:
+                f.write(json.dumps(s.to_dict()) + "\n")
+        return len(spans)
+
+
+# ---------------------------------------------------------------- singleton
+
+_tracer: Optional[Tracer] = None
+_tracer_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (created on first use)."""
+    global _tracer
+    if _tracer is None:
+        with _tracer_lock:
+            if _tracer is None:
+                _tracer = Tracer()
+    return _tracer
+
+
+def configure_tracer(service: Optional[str] = None,
+                     capacity: Optional[int] = None) -> Tracer:
+    """Re-create the global tracer (entrypoints name their role; tests
+    isolate their buffers)."""
+    global _tracer
+    with _tracer_lock:
+        _tracer = Tracer(service=service or "",
+                         capacity=capacity or 2048)
+    return _tracer
+
+
+def stitch(spans: list[dict]) -> list[dict]:
+    """Order raw span dicts into a parent-first tree walk with a ``depth``
+    key added — shared by ``dynctl trace`` and anything rendering a trace.
+    Orphans (parent not in the set) surface as roots, not silently dropped."""
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict[Optional[str], list[dict]] = {}
+    for s in spans:
+        parent = s.get("parent_span_id")
+        key = parent if parent in by_id and parent != s["span_id"] else None
+        children.setdefault(key, []).append(s)
+    for sibs in children.values():
+        sibs.sort(key=lambda s: (s.get("start") or 0.0))
+    out: list[dict] = []
+    seen: set[str] = set()
+
+    def walk(s: dict, depth: int) -> None:
+        if s["span_id"] in seen:
+            return
+        seen.add(s["span_id"])
+        out.append({**s, "depth": depth})
+        for c in children.get(s["span_id"], []):
+            walk(c, depth + 1)
+
+    for root in children.get(None, []):
+        walk(root, 0)
+    for s in spans:  # cycles / self-parents: still emitted
+        walk(s, 0)
+    return out

@@ -180,15 +180,22 @@ class KvPushRouter:
                 yield item
             return
 
-        worker_ids = self.client.available_ids()
-        if not worker_ids:
-            worker_ids = await self.client.wait_for_instances(timeout=5.0)
-        try:
-            decision = self.router.find_best_match(
-                ctx.id, req.token_ids, worker_ids, req.router_config_override
-            )
-        except NoWorkersError as e:
-            raise NoRespondersError(str(e)) from e
+        from dynamo_tpu.observability import get_tracer
+
+        with get_tracer().span("router.schedule", ctx,
+                               service="router") as sp:
+            worker_ids = self.client.available_ids()
+            if not worker_ids:
+                worker_ids = await self.client.wait_for_instances(timeout=5.0)
+            try:
+                decision = self.router.find_best_match(
+                    ctx.id, req.token_ids, worker_ids, req.router_config_override
+                )
+            except NoWorkersError as e:
+                raise NoRespondersError(str(e)) from e
+            sp.set(worker_id=f"{decision.worker_id:x}",
+                   overlap_blocks=decision.overlap_blocks,
+                   candidates=len(worker_ids))
 
         if req.has_annotation("query_instance_id"):
             # dry route: report the decision without generating

@@ -227,25 +227,34 @@ async def _pump_handler(handler: EndpointHandler, request: Any, ctx: Context, se
     Shared by the remote (socket) and in-process (queue) paths so their
     error/cancellation semantics cannot diverge.
     """
+    from dynamo_tpu.observability import get_tracer
     from dynamo_tpu.runtime.context import CURRENT_REQUEST
 
     CURRENT_REQUEST.set(ctx)  # worker-side log lines carry the request id
     logger.debug("handling request (traceparent=%s)", ctx.traceparent)
-    try:
-        async for item in handler(request, ctx):
-            if ctx.cancelled:
-                break
-            await sender.send(item)
-        await sender.complete()
-    except asyncio.CancelledError:
-        await sender.error("worker shutting down")
-        raise
-    except Exception as e:
-        logger.exception("endpoint handler failed")
+    # worker-side root span: parents to the sender's rpc hop (remote) or
+    # the caller's live span (in-process short-circuit)
+    with get_tracer().span("worker.handle", ctx, service="worker") as sp:
         try:
-            await sender.error(f"handler error: {e!r}")
-        except Exception:
-            pass
+            n_items = 0
+            async for item in handler(request, ctx):
+                if ctx.cancelled:
+                    break
+                n_items += 1
+                await sender.send(item)
+            sp.set(items=n_items, cancelled=ctx.cancelled)
+            await sender.complete()
+        except asyncio.CancelledError:
+            await sender.error("worker shutting down")
+            raise
+        except Exception as e:
+            logger.exception("endpoint handler failed")
+            sp.status = "error"
+            sp.set(error=repr(e)[:200])
+            try:
+                await sender.error(f"handler error: {e!r}")
+            except Exception:
+                pass
 
 
 class Client:
@@ -433,9 +442,16 @@ class Client:
 
         server = await rt.response_server()
         info, receiver = server.register_stream(ctx)
+        ctx_wire = ctx.to_wire()
         envelope = msgpack.packb(
-            {"ctx": ctx.to_wire(), "conn": info.to_wire(), "req": request}
+            {"ctx": ctx_wire, "conn": info.to_wire(), "req": request}
         )
+        # record the wire hop's fresh span id as an rpc.send span so the
+        # remote worker's spans (which parent to that id) stitch back here
+        from dynamo_tpu.observability import get_tracer
+
+        get_tracer().record_hop(ctx, ctx_wire.get("traceparent"),
+                                target=inst.subject)
         try:
             ack = await rt.plane.request(inst.subject, envelope,
                                          timeout=rt.config.request_timeout)

@@ -37,6 +37,11 @@ class Context:
     id: str = field(default_factory=lambda: uuid.uuid4().hex)
     annotations: dict[str, Any] = field(default_factory=dict)
     traceparent: Optional[str] = None
+    #: True when ensure_traceparent minted the value (absent or malformed
+    #: inbound header) — the trust-boundary root span keys off this to
+    #: adopt the wire span id instead of parenting to a phantom. Local
+    #: state, never serialized.
+    traceparent_synthesized: bool = field(default=False, repr=False)
     _cancel_event: asyncio.Event = field(default_factory=asyncio.Event, repr=False)
 
     def cancel(self) -> None:
@@ -55,22 +60,41 @@ class Context:
         c._cancel_event = self._cancel_event
         return c
 
+    @staticmethod
+    def _traceparent_valid(tp: str) -> bool:
+        parts = tp.split("-")
+        # W3C: version 00 has exactly 4 fields; HIGHER versions may append
+        # extra dash-separated fields and parsers must still accept the
+        # first four — rejecting them would sever the caller's trace
+        if len(parts) < 4 or (parts[0] == "00" and len(parts) != 4):
+            return False
+        return (len(parts[1]) == 32 and len(parts[2]) == 16
+                and all(c in "0123456789abcdef"
+                        for c in parts[1] + parts[2]))
+
     def ensure_traceparent(self) -> str:
         """Return a W3C traceparent, synthesizing one if the caller didn't
-        send one (the request id doubles as the 128-bit trace id)."""
-        if not self.traceparent:
+        send one (the request id doubles as the 128-bit trace id). A
+        malformed inbound value is REPLACED, per the W3C ignore-invalid
+        rule — otherwise it would silently disable span recording for the
+        whole request."""
+        if not self.traceparent or not self._traceparent_valid(self.traceparent):
             trace_id = (self.id if len(self.id) == 32
                         and all(c in "0123456789abcdef" for c in self.id)
                         else uuid.uuid4().hex)
             self.traceparent = f"00-{trace_id}-{secrets.token_hex(8)}-01"
+            self.traceparent_synthesized = True
         return self.traceparent
 
     def child_traceparent(self) -> Optional[str]:
-        """traceparent for the next hop: same trace id, fresh span id."""
+        """traceparent for the next hop: same trace id, fresh span id.
+        Future-version values (extra trailing fields) are rewritten to the
+        4-field form we understand — the W3C-sanctioned downgrade when a
+        propagator mutates the header."""
         if not self.traceparent:
             return None
         parts = self.traceparent.split("-")
-        if len(parts) != 4:
+        if len(parts) < 4:
             return self.traceparent
         return f"{parts[0]}-{parts[1]}-{secrets.token_hex(8)}-{parts[3]}"
 

@@ -1,6 +1,7 @@
-"""``python -m dynamo_tpu.runtime.dynctl`` — run the control-plane server.
+"""``python -m dynamo_tpu.runtime.dynctl`` — control-plane server + ops CLI.
 
-Single self-contained process replacing the reference's etcd + NATS pair for
+Default (no subcommand): run the control-plane server — a single
+self-contained process replacing the reference's etcd + NATS pair for
 TPU-VM deployments. Point every other process at it with
 ``DYN_CONTROL_PLANE=host:port``.
 
@@ -9,12 +10,21 @@ HA: run a second dynctl with ``--standby-of primary:port`` and set
 mirrors durable state, promotes itself (fresh epoch) after sustained
 primary silence, and fences/demotes the old primary if it comes back
 (ref HA role: lib/runtime/src/transports/etcd.rs:35-770 replicated etcd).
+
+Subcommands:
+
+- ``dynctl trace <request-id>`` — stitch the request's spans fetched from
+  every registered tracer over the control plane (frontend, workers) and
+  print the trace tree; ``--json`` dumps the raw span list. Needs
+  ``DYN_CONTROL_PLANE`` pointed at the cluster's hub.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
+import sys
 
 from dynamo_tpu.runtime.config import setup_logging
 from dynamo_tpu.runtime.control_plane import ControlPlaneServer
@@ -46,8 +56,68 @@ async def amain(host: str, port: int, persist: str = None,
         await server.stop()
 
 
+async def trace_amain(request_id: str, as_json: bool, timeout: float) -> int:
+    """Fetch + stitch + print one request's distributed trace."""
+    from dynamo_tpu.observability import fetch_trace, get_tracer, stitch
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    runtime = await DistributedRuntime.create()
+    try:
+        spans = {d["span_id"]: d
+                 for d in await fetch_trace(runtime.plane, request_id,
+                                            timeout=timeout)}
+        # a dynctl running inside a serving process (tests) also sees its
+        # own buffer; standalone CLI runs contribute nothing here
+        for s in get_tracer().spans_for(request_id):
+            spans.setdefault(s.span_id, s.to_dict())
+        ordered = sorted(spans.values(),
+                         key=lambda d: d.get("start") or 0.0)
+        if not ordered:
+            print(f"no spans recorded for request {request_id!r} "
+                  "(is DYN_CONTROL_PLANE set, and did the request run "
+                  "recently enough to still be in the span ring buffers?)",
+                  file=sys.stderr)
+            return 1
+        if as_json:
+            print(json.dumps(ordered, indent=2))
+            return 0
+        t0 = min(d.get("start") or 0.0 for d in ordered)
+        print(f"trace {ordered[0].get('trace_id')} "
+              f"(request {request_id}): {len(ordered)} spans")
+        for d in stitch(ordered):
+            dur = ((d.get("end") or d.get("start") or 0.0)
+                   - (d.get("start") or 0.0))
+            off = (d.get("start") or 0.0) - t0
+            attrs = " ".join(f"{k}={v}" for k, v in
+                             (d.get("attributes") or {}).items())
+            mark = "" if d.get("status", "ok") == "ok" else " [ERROR]"
+            print(f"  {'  ' * d['depth']}{d['name']:<24s} "
+                  f"+{off * 1000:8.1f}ms {dur * 1000:8.1f}ms "
+                  f"[{d.get('service', '')}]{mark} {attrs}".rstrip())
+        return 0
+    finally:
+        await runtime.shutdown()
+
+
+def _trace_main(argv: list[str]) -> None:
+    ap = argparse.ArgumentParser(
+        prog="dynctl trace",
+        description="stitch and print a request's distributed trace")
+    ap.add_argument("request_id")
+    ap.add_argument("--json", action="store_true",
+                    help="dump raw span dicts instead of the tree view")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-tracer fetch timeout (seconds)")
+    args = ap.parse_args(argv)
+    raise SystemExit(asyncio.run(
+        trace_amain(args.request_id, args.json, args.timeout)))
+
+
 def main():
     setup_logging()
+    if len(sys.argv) > 1 and sys.argv[1] == "trace":
+        _trace_main(sys.argv[2:])
+        return
     ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=6650)

@@ -18,10 +18,19 @@ _DEFAULT_BUCKETS = (
 )
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus exposition escaping for label values: backslash, double
+    quote, and newline must be escaped or the scrape output is corrupt
+    (e.g. a model name containing ``"``)."""
+    return (str(v).replace("\\", "\\\\")
+            .replace('"', '\\"').replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -98,10 +107,14 @@ class Histogram:
         with self._lock:
             counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
             self._sums[key] = self._sums.get(key, 0.0) + value
+            # per-bucket (non-cumulative) counts: render() cumulates.
+            # Incrementing EVERY matching bucket here double-counted once
+            # render added them up (le="1.0" could exceed the total count)
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     counts[i] += 1
-            counts[-1] += 1  # +Inf
+                    break
+            counts[-1] += 1  # +Inf (total observations)
 
     def time(self, **labels):
         return _Timer(self, labels)
@@ -158,6 +171,62 @@ class MetricsRegistry:
         return self._metrics[full]  # type: ignore[return-value]
 
     def render(self) -> str:
-        up = f"# TYPE {self.prefix}_uptime_seconds gauge\n{self.prefix}_uptime_seconds {time.time() - self._start}"
+        up = (f"# HELP {self.prefix}_uptime_seconds "
+              f"Seconds since this registry was created\n"
+              f"# TYPE {self.prefix}_uptime_seconds gauge\n"
+              f"{self.prefix}_uptime_seconds {time.time() - self._start}")
         parts = [m.render() for m in self._metrics.values()]  # type: ignore[attr-defined]
         return "\n".join([up] + parts) + "\n"
+
+
+def render_registries(*registries: "MetricsRegistry") -> str:
+    """Render several registries as ONE exposition document.
+
+    Prometheus forbids repeated ``# TYPE``/``# HELP`` headers for the same
+    metric, which naturally happens when two registries share a prefix (the
+    HTTP service's registry + the tracer's SLO registry both emit
+    ``dynamo_uptime_seconds``). Headers after the first are dropped, and so
+    are duplicate UNLABELED samples of an already-seen metric (the uptime
+    case) — label-distinct series from different registries merge under the
+    first header untouched.
+    """
+    seen_headers: set[tuple[str, str]] = set()
+    seen_metrics: set[str] = set()
+    out: list[str] = []
+    for reg in registries:
+        pending: set[str] = set()  # metric names this registry introduced
+        for line in reg.render().splitlines():
+            if line.startswith("# "):
+                fields = line.split()
+                if len(fields) < 3:
+                    out.append(line)
+                    continue
+                kind, name = fields[1], fields[2]
+                if (kind, name) in seen_headers:
+                    continue
+                seen_headers.add((kind, name))
+                pending.add(name)
+                out.append(line)
+                continue
+            if not line:
+                continue
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            base = name
+            for suf in ("_bucket", "_sum", "_count"):
+                if base.endswith(suf):
+                    base = base[: -len(suf)]
+                    break
+            if base in seen_metrics and base not in pending:
+                # duplicate UNLABELED series from a later registry (e.g.
+                # uptime, or an unlabeled histogram whose only label is the
+                # synthetic ``le``) — emitting them twice makes Prometheus
+                # reject the whole scrape
+                if "{" not in line:
+                    continue
+                labels = line.split("{", 1)[1].rsplit("}", 1)[0]
+                if all(p.startswith("le=")
+                       for p in labels.split(",") if p):
+                    continue
+            out.append(line)
+        seen_metrics |= pending
+    return "\n".join(out) + "\n"

@@ -121,8 +121,15 @@ class DistributedRuntime:
             return web.json_response({"live": True})
 
         async def metrics(_):
-            return web.Response(text=self.metrics.render(),
-                                content_type="text/plain")
+            # merge the process tracer's SLO registry: worker-side phase
+            # histograms (engine.ttft/decode, kv.transfer, queue_wait)
+            # live there and must be scrapable in multi-process topologies
+            from dynamo_tpu.observability import get_tracer
+            from dynamo_tpu.runtime.metrics import render_registries
+
+            return web.Response(
+                text=render_registries(self.metrics, get_tracer().metrics),
+                content_type="text/plain")
 
         app = web.Application()
         app.router.add_get("/health", health)

@@ -109,7 +109,7 @@ async def test_handlers_end_to_end_local_client():
         def available_ids(self):
             return [1]
 
-        async def generate(self, request, mode="round_robin"):
+        async def generate(self, request, ctx=None, mode="round_robin"):
             async def stream():
                 async for frame in ph.generate(request, None):
                     yield frame
@@ -188,7 +188,7 @@ async def test_pipelined_disagg_matches_aggregated():
         def available_ids(self):
             return [1]
 
-        async def generate(self, request, mode="round_robin"):
+        async def generate(self, request, ctx=None, mode="round_robin"):
             async def stream():
                 async for frame in ph.generate(request, None):
                     yield frame
@@ -223,7 +223,7 @@ async def test_pipelined_disagg_mismatch_falls_back_local():
         def available_ids(self):
             return [1]
 
-        async def generate(self, request, mode="round_robin"):
+        async def generate(self, request, ctx=None, mode="round_robin"):
             async def stream():
                 async for frame in ph.generate(request, None):
                     yield frame
@@ -260,7 +260,7 @@ async def test_pipelined_stream_failure_releases_injected_blocks():
         def available_ids(self):
             return [1]
 
-        async def generate(self, request, mode="round_robin"):
+        async def generate(self, request, ctx=None, mode="round_robin"):
             async def stream():
                 async for frame in ph.generate(request, None):
                     yield frame
@@ -331,7 +331,7 @@ async def test_prefill_queue_dispatch_end_to_end():
         def available_ids(self):
             return [PRE_ID]
 
-        async def generate(self, request, mode="round_robin", instance_id=None):
+        async def generate(self, request, ctx=None, mode="round_robin", instance_id=None):
             assert mode == "direct" and instance_id == PRE_ID, \
                 f"expected queued direct dispatch, got {mode}/{instance_id}"
 
@@ -381,7 +381,7 @@ async def test_prefill_queue_claim_timeout_falls_back_round_robin():
         def available_ids(self):
             return [1]
 
-        async def generate(self, request, mode="round_robin", instance_id=None):
+        async def generate(self, request, ctx=None, mode="round_robin", instance_id=None):
             modes.append(mode)
 
             async def stream():
@@ -444,7 +444,7 @@ class _LocalPrefillClient:
     def available_ids(self):
         return [1]
 
-    async def generate(self, request, mode="round_robin", instance_id=None):
+    async def generate(self, request, ctx=None, mode="round_robin", instance_id=None):
         async def stream():
             async for frame in self.ph.generate(request, None):
                 yield frame
@@ -474,7 +474,7 @@ async def test_direct_transfer_same_process_matches_aggregated():
     seen = {"direct": 0, "chunk": 0}
 
     class SpyClient(_LocalPrefillClient):
-        async def generate(self, request, mode="round_robin",
+        async def generate(self, request, ctx=None, mode="round_robin",
                            instance_id=None):
             from dynamo_tpu.disagg.protocols import KvChunkFrame
 
@@ -520,7 +520,7 @@ async def test_direct_disabled_uses_host_staged_bundles():
     seen = {"direct": 0, "chunk": 0}
 
     class SpyClient(_LocalPrefillClient):
-        async def generate(self, request, mode="round_robin",
+        async def generate(self, request, ctx=None, mode="round_robin",
                            instance_id=None):
             async def stream():
                 async for frame in self.ph.generate(request, None):
