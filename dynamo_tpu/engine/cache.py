@@ -165,6 +165,10 @@ class BlockPool:
         self.num_blocks = num_blocks
         self.enable_prefix_caching = enable_prefix_caching
         self.on_removed = on_removed
+        #: fn() called whenever release() returns capacity to the pool —
+        #: the engine loop parks on it instead of polling when it is
+        #: memory-starved (a freed block is exactly what unblocks plan())
+        self.on_freed: Optional[Callable[[], None]] = None
         # block 0 reserved as NULL
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))
         self._meta: dict[int, BlockMeta] = {}
@@ -265,6 +269,7 @@ class BlockPool:
         announced as stored, and a hash's home block parks in the LRU (its
         event fires on eviction in allocate()).
         """
+        freed = False
         for bid in block_ids:
             if bid == NULL_BLOCK:
                 continue
@@ -274,6 +279,7 @@ class BlockPool:
             meta.ref_count -= 1
             if meta.ref_count > 0:
                 continue
+            freed = True  # LRU-parked blocks count as allocatable too
             if (meta.seq_hash is not None and self.enable_prefix_caching
                     and self._by_hash.get(meta.seq_hash) == bid):
                 self._lru[meta.seq_hash] = bid
@@ -281,6 +287,8 @@ class BlockPool:
             else:
                 self._meta.pop(bid)
                 self._free.append(bid)
+        if freed and self.on_freed:
+            self.on_freed()
 
     def clear(self) -> None:
         """Drop the entire prefix cache (admin clear_kv_blocks analog)."""
@@ -291,6 +299,8 @@ class BlockPool:
         self._lru.clear()
         if self.on_removed:
             self.on_removed(None)  # None = cleared-all sentinel
+        if self.on_freed:
+            self.on_freed()
 
 
 def allocate_device_cache(cfg, num_blocks: int, block_size: int, mesh=None,

@@ -360,6 +360,18 @@ class EngineArgs:
     #: decode steps fused into one jitted call when only decode work exists
     #: (amortizes per-dispatch latency; tokens deliver in bursts of this size)
     multi_step_decode: int = 1
+    #: depth-2 software pipelining of single-step decode: step N+1 is
+    #: dispatched with step N's sampled tokens fed device-to-device, so the
+    #: host copy + commit/emit of step N overlap step N+1's device time
+    #: (engine._run_decode_pipelined). Applies when multi_step_decode == 1,
+    #: no speculative decoding, single host. Greedy-invariant: emits exactly
+    #: the tokens the serial loop would.
+    pipeline_decode: bool = True
+    #: AOT bucket warmup at startup (engine.warmup()): precompile the jitted
+    #: step for every configured prefill/decode bucket so the first real
+    #: request does not eat XLA compilation (the TTFT p95-vs-p50 cliff).
+    #: Opt-in — warmup costs one compile per bucket up front.
+    warmup_buckets: bool = False
     #: speculative decoding: draft up to this many tokens and verify them in
     #: ONE forward — greedy-invariant (identical tokens to plain decode).
     #: 0 = off. Applies to temperature-0 batches without logprobs; the

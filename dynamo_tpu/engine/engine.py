@@ -209,9 +209,17 @@ class AsyncJaxEngine:
         self.guided_vocab = guided_vocab
         self._seq_counter = itertools.count()
         self._wake = asyncio.Event()
+        # memory-starved plan(): park on _wake instead of hot-polling; a
+        # BlockPool release (seq finish, offload unpin, abort) is the event
+        # that can make the next plan() non-empty
+        self.pool.on_freed = self._wake.set
         self._task: Optional[asyncio.Task] = None
         self._closed = False
         self.steps = 0
+        #: decode steps executed by the depth-2 pipelined loop (telemetry:
+        #: nonzero means the e2e path is actually overlapping copy/commit
+        #: with device compute)
+        self.pipelined_steps = 0
         #: jitted full-model forward passes (each reads every weight once
         #: from HBM) — the denominator for roofline/MFU accounting in bench.py
         self.param_reads = 0
@@ -773,8 +781,16 @@ class AsyncJaxEngine:
                 continue
             plan = self.scheduler.plan()
             if plan.empty:
-                # memory-starved and nothing runnable: yield to event loop
-                await asyncio.sleep(0.005)
+                # memory-starved and nothing runnable: park until a BlockPool
+                # release or a finishing sequence sets _wake (event-driven —
+                # the old 5 ms poll burned a wakeup per tick under pressure).
+                # The timeout is a safety net for edge signals that have no
+                # hook (e.g. a context cancelled while we sleep).
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=0.05)
+                except asyncio.TimeoutError:
+                    pass
                 continue
             try:
                 await self._execute(plan)
@@ -795,6 +811,10 @@ class AsyncJaxEngine:
         # traces carry the serving phase names alongside request spans
         from dynamo_tpu.observability.profiler import annotate
 
+        if not plan.prefill and plan.decode and self._can_pipeline(plan.decode):
+            with annotate("dynamo.decode_pipeline"):
+                if await self._run_decode_pipelined(plan.decode):
+                    return
         if plan.prefill:
             t0 = time.perf_counter()
             with annotate("dynamo.prefill_step"):
@@ -828,6 +848,122 @@ class AsyncJaxEngine:
                     "total_ms": round(a[3], 1),
                     "mean_ms": round(a[3] / a[0], 1)}
                 for k, a in agg.items()}
+
+    # ------------------------------------------------------- bucket warmup
+
+    async def warmup(self, seq_lens: Optional[list] = None,
+                     prefill_batches: Optional[list] = None) -> dict:
+        """AOT bucket precompile: one dummy dispatch per configured
+        (prefill-chunk × decode-batch) bucket signature, so the first REAL
+        request never eats an XLA compile — first-compile is the TTFT
+        p95-vs-p50 cliff this attacks.
+
+        ``seq_lens``: expected total sequence lengths (prompt + output) of
+        the workload; they choose the block-table-width buckets to trace
+        (default: max_model_len). Prefill buckets are traced at EVERY
+        power-of-two width from their own up to the workload width —
+        chunked continuations of a long prompt re-trace the chunk bucket
+        at growing table widths. ``prefill_batches``: expected concurrent
+        prefill row counts (default [1]); concurrent arrivals batch into
+        one call at bucket_batch(rows). Dummy writes land in the reserved
+        NULL block, whose contents are garbage by design. Must run BEFORE
+        serving traffic (the dummy calls ride the same donated cache chain
+        as real steps). Returns a report listing each compiled signature
+        exactly once.
+        """
+        if self._multihost:
+            logger.warning("bucket warmup skipped under multi-host (dummy "
+                           "steps are not in the leader's broadcast replay)")
+            return {}
+        if self.scheduler.has_work:
+            # the dummy dispatches run in a worker thread and reassign the
+            # donated cache chain; racing a live engine step would hand XLA
+            # an already-donated buffer and fail every in-flight sequence
+            raise RuntimeError(
+                "bucket warmup must run before serving traffic (sequences "
+                "are already scheduled)")
+        args = self.args
+        lens = sorted({min(max(int(x), 1), args.max_model_len)
+                       for x in (seq_lens or [args.max_model_len])})
+        widths = sorted({args.bucket_table_width(x) for x in lens})
+        prefill_bs = sorted({args.bucket_batch(max(1, int(b)))
+                             for b in (prefill_batches or [1])})
+        t_start = time.perf_counter()
+
+        def run_all():
+            import jax.numpy as jnp
+
+            report: dict = {"prefill": [], "decode": [], "multi": [],
+                            "sample": []}
+            sampled_b: set = set()
+
+            def dispatch(B: int, S: int, W: int):
+                ints3 = np.zeros((B, 3, S), np.int32)
+                lens_last = np.zeros((B, 2), np.int32)
+                lens_last[:, 0] = 1  # kv_len 1: attend one NULL slot
+                bt = np.full((B, W), NULL_BLOCK, np.int32)
+                logits, self.k_cache, self.v_cache = self.step_fn(
+                    self.params, jnp.asarray(ints3), jnp.asarray(lens_last),
+                    jnp.asarray(bt), self.k_cache, self.v_cache)
+                return logits
+
+            def warm_sample(logits):
+                B = logits.shape[0]
+                if B in sampled_b:
+                    return
+                sampled_b.add(B)
+                toks, _ = self._sampling.sample_jit(
+                    logits, np.zeros((B,), np.float32),
+                    np.zeros((B,), np.int32), np.ones((B,), np.float32),
+                    self._sampling.make_keys([0] * B, [0] * B))
+                np.asarray(toks)  # block: this signature's compile is done
+                report["sample"].append(B)
+
+            for S in args.prefill_buckets:
+                # width range: the chunk's own width plus every reachable
+                # step up to the workload width (chunk N of a long prompt
+                # keeps bucket S while its table width grows) — derived via
+                # bucket_table_width so the max_blocks_per_seq cap matches
+                # what serving will actually request
+                ws = {args.bucket_table_width(S)}
+                t = S
+                while t < max(lens):
+                    t = min(t * 2, max(lens))
+                    ws.add(args.bucket_table_width(t))
+                for B in prefill_bs:
+                    for W in sorted(ws):
+                        logits = dispatch(B, S, W)
+                        report["prefill"].append((B, S, W))
+                        warm_sample(logits)
+            for B in args.decode_batch_buckets:
+                for W in widths:
+                    logits = dispatch(B, 1, W)
+                    report["decode"].append((B, W))
+                    warm_sample(logits)
+            if self.multi_fn is not None:
+                for B in args.decode_batch_buckets:
+                    for W in widths:
+                        ints = np.zeros((B, 4), np.int32)
+                        ints[:, 2] = 1  # kv_lens
+                        floats = np.zeros((B, 2), np.float32)
+                        floats[:, 1] = 1.0  # top_p off
+                        rand = np.zeros((B, 2), np.uint32)
+                        bt = np.full((B, W), NULL_BLOCK, np.int32)
+                        toks, _, self.k_cache, self.v_cache = self.multi_fn(
+                            self.params, jnp.asarray(ints),
+                            jnp.asarray(floats), jnp.asarray(rand),
+                            jnp.asarray(bt), self.k_cache, self.v_cache)
+                        np.asarray(toks)
+                        report["multi"].append((B, W))
+            return report
+
+        report = await asyncio.to_thread(run_all)
+        report["seconds"] = round(time.perf_counter() - t_start, 2)
+        logger.info(
+            "bucket warmup: %d prefill + %d decode + %d multi signatures "
+            "in %.1fs", len(report["prefill"]), len(report["decode"]),
+            len(report["multi"]), report["seconds"])
+        return report
 
     # ------------------------------------------------------------- prefill
 
@@ -1123,17 +1259,12 @@ class AsyncJaxEngine:
             accepted = 0
             while accepted < len(d) and d[accepted] == int(ids[i, accepted]):
                 accepted += 1
-            # emit accepted drafts + the corrected/bonus token; like the
-            # burst loop, each commit marks the CURRENT tokens' KV resident
-            # (the verify step computed it — accepted drafts equal the real
-            # tokens) before the next append
-            emitted = 0
-            for j in range(accepted + 1):
-                self.scheduler.commit_computed(s, len(s.tokens))
-                self._deliver(s, int(ids[i, j]), float(lps[i, j]))
-                emitted += 1
-                if s.finished is not None:
-                    break
+            # emit accepted drafts + the corrected/bonus token as ONE
+            # coalesced output; each commit marks the CURRENT tokens' KV
+            # resident (the verify step computed it — accepted drafts equal
+            # the real tokens) before the next append
+            emitted = self._deliver_batch(s, ids[i, :accepted + 1],
+                                          lps[i, :accepted + 1])
             # count what was actually DELIVERED — a seq finishing mid-burst
             # must not inflate acceptance telemetry
             self.spec_stats.num_drafts += 1
@@ -1223,6 +1354,175 @@ class AsyncJaxEngine:
             self.scheduler.commit_computed(s, len(s.tokens))
             self._deliver(s, int(toks[i]), float(logps[i]), tops.get(i))
 
+    # ------------------------------------------------- pipelined decode loop
+
+    #: re-plan (admission, preemption, metrics) at least this often even
+    #: when the pipeline could keep running — bounds how long a pipelined
+    #: burst can defer scheduler housekeeping
+    PIPELINE_REPLAN_STEPS = 64
+
+    def _can_pipeline(self, seqs: list[SeqState]) -> bool:
+        """True when the decode batch qualifies for the depth-2 pipelined
+        loop: single-host, single-step decode, every running seq in the
+        batch, and no request feature that forces a host round trip
+        between sample and emit (logprob capture, logit edits, guided)."""
+        if not self.args.pipeline_decode or self._multihost or self._pp > 1:
+            return False
+        if self.multi_fn is not None or self.verify_fn is not None:
+            return False
+        if self.scheduler.waiting or self.scheduler._aborted:
+            return False
+        # a running seq still mid-prefill needs plan() interleaving
+        if len(seqs) != len(self.scheduler.running):
+            return False
+        for s in seqs:
+            if (s.req.output_options.logprobs is not None
+                    or s.req.sampling_options.logit_bias
+                    or _has_penalties(s) or s.guided_state is not None):
+                return False
+        return True
+
+    def _dispatch_decode_step(self, seqs: list[SeqState], feed=None):
+        """Dispatch ONE single-token decode step without any host sync.
+
+        ``feed`` is the previous (uncommitted) step's handle: its sampled
+        tokens are substituted into the token column ON DEVICE, so this
+        dispatch never waits for the previous step's device→host copy.
+        Positions/slots/tables only need token COUNTS, which the host knows
+        before the token identities arrive. Returns a handle for
+        _commit_decode_step, or None when block allocation fails (caller
+        drains and falls back to plan(), which preempts).
+        """
+        import jax.numpy as jnp
+
+        args = self.args
+        bs = args.block_size
+        off = 1 if feed is not None else 0  # uncommitted in-flight tokens
+        for s in seqs:
+            # this step writes KV at position len(s.tokens)-1+off → the
+            # table must cover len+off tokens
+            if not self.scheduler._ensure_blocks(s, len(s.tokens) + off):
+                return None
+        B = args.bucket_batch(len(seqs))
+        max_kv = max(len(s.tokens) + off for s in seqs)
+        W = args.bucket_table_width(max_kv)
+
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B, 1), np.int32)
+        slot_map = np.zeros((B, 1), np.int32)
+        bt = np.full((B, W), NULL_BLOCK, np.int32)
+        kv_lens = np.zeros((B,), np.int32)
+        last_idx = np.zeros((B,), np.int32)
+        temp = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        seeds, steps = [], []
+        for i, s in enumerate(seqs):
+            pos = len(s.tokens) - 1 + off
+            if feed is None:
+                tokens[i, 0] = s.tokens[-1]
+            positions[i, 0] = pos
+            slot_map[i, 0] = s.block_table[pos // bs] * bs + pos % bs
+            n = min(len(s.block_table), W)
+            bt[i, :n] = s.block_table[:n]
+            kv_lens[i] = pos + 1
+            t, k, p, seed = s.sampling_tuple()
+            temp[i], top_k[i], top_p[i] = t, k, p
+            seeds.append(seed if seed is not None
+                         else hash(s.request_id) & 0x7FFFFFFF)
+            # step_idx increments at commit; an uncommitted in-flight token
+            # shifts this step's PRNG index by one (identical to what the
+            # serial loop would use)
+            steps.append(s.step_idx + off)
+        seeds += [0] * (B - len(seqs))
+        steps += [0] * (B - len(seqs))
+        keys = self._sampling.make_keys(seeds, steps)
+
+        ints3 = jnp.asarray(np.stack([tokens, positions, slot_map], axis=1))
+        if feed is not None:
+            ints3 = ints3.at[:, 0, 0].set(feed["toks"].astype(jnp.int32))
+        lens_last = np.stack([kv_lens, last_idx], axis=1)
+        self.param_reads += 1
+        t0 = time.perf_counter()
+        logits, self.k_cache, self.v_cache = self.step_fn(
+            self.params, ints3, jnp.asarray(lens_last), jnp.asarray(bt),
+            self.k_cache, self.v_cache)
+        toks, logps = self._sampling.sample_jit(logits, temp, top_k, top_p,
+                                                keys)
+        # device→host copy in a worker thread: the loop dispatches step N+1
+        # and only then awaits this
+        copy = asyncio.get_running_loop().create_task(asyncio.to_thread(
+            lambda: (np.asarray(toks), np.asarray(logps))))
+        return {"seqs": list(seqs), "toks": toks, "copy": copy, "t0": t0}
+
+    async def _commit_decode_step(self, handle) -> None:
+        """Land one in-flight step: await its host copy, then commit + emit.
+        Rows of sequences that finished at an earlier step are overshoot —
+        their KV write targeted an unregistered block and is discarded."""
+        toks, logps = await handle["copy"]
+        n = 0
+        for i, s in enumerate(handle["seqs"]):
+            if s.finished is not None:
+                continue
+            self.scheduler.commit_computed(s, len(s.tokens))
+            self._deliver(s, int(toks[i]), float(logps[i]))
+            n += 1
+        self.pipelined_steps += 1
+        self.step_trace.append((
+            "decode_pipe", len(handle["seqs"]), n,
+            (time.perf_counter() - handle["t0"]) * 1000))
+
+    async def _run_decode_pipelined(self, seqs: list[SeqState]) -> bool:
+        """Depth-2 software pipeline over single-step decode.
+
+        Serial loop per token: dispatch → device compute → host copy →
+        commit/emit. Pipelined: step N+1 is dispatched (token column fed
+        device-to-device from step N's sampler output) BEFORE step N's host
+        copy is awaited, so the copy + Python bookkeeping + sink emission of
+        step N overlap step N+1's device time. Greedy-invariant: positions,
+        PRNG step indices and commits are exactly the serial loop's.
+
+        Drains (commits every in-flight step) and returns whenever the
+        steady state breaks: a sequence finished or was cancelled, new work
+        arrived, allocation failed, or PIPELINE_REPLAN_STEPS elapsed.
+        Returns True when at least one step ran.
+        """
+        prev = None
+        done = 0
+        try:
+            while True:
+                handle = self._dispatch_decode_step(seqs, feed=prev)
+                if handle is None:
+                    break  # allocation failure: plan() handles preemption
+                done += 1
+                # swap BEFORE the await: if the commit raises, ``prev`` is
+                # the still-in-flight dispatch the except path must reap
+                committed, prev = prev, handle
+                if committed is not None:
+                    await self._commit_decode_step(committed)
+                if (done >= self.PIPELINE_REPLAN_STEPS or self._closed
+                        or self.scheduler.waiting or self.scheduler._aborted
+                        or any(s.finished is not None for s in seqs)
+                        or any(getattr(s.ctx, "cancelled", False)
+                               for s in seqs)):
+                    break
+        except BaseException:
+            # surface the step failure, but never abandon an in-flight host
+            # copy task (its late exception would be unretrieved)
+            if prev is not None:
+                prev["copy"].cancel()
+                try:
+                    await prev["copy"]
+                except (Exception, asyncio.CancelledError):
+                    pass
+            raise
+        if prev is not None:
+            await self._commit_decode_step(prev)
+        # _run adds 1 per _execute; top up so self.steps counts every
+        # committed pipelined step exactly once
+        self.steps += max(0, done - 1)
+        return done > 0
+
     async def _run_multi_decode(self, seqs: list[SeqState]) -> bool:
         """Burst path: K decode steps in one dispatch. Returns False when a
         precondition fails (block preallocation) so the caller falls back to
@@ -1278,11 +1578,8 @@ class AsyncJaxEngine:
             lambda: (np.asarray(toks), np.asarray(logps)))
 
         for i, s in enumerate(seqs):
-            for k in range(K):
-                self.scheduler.commit_computed(s, len(s.tokens))
-                self._deliver(s, int(toks[k, i]), float(logps[k, i]))
-                if s.finished is not None:
-                    break  # overshoot tokens are discarded
+            # one coalesced output per seq per burst (overshoot discarded)
+            self._deliver_batch(s, toks[:, i], logps[:, i])
         return True
 
     # ------------------------------------------------------------ sampling
@@ -1484,6 +1781,33 @@ class AsyncJaxEngine:
             return t, l, tops
 
         return await asyncio.to_thread(run_sampling)
+
+    def _deliver_batch(self, seq: SeqState, tokens, logps) -> int:
+        """Coalesced per-step emission: commit/append each token of a fused
+        burst, but put ONE LLMEngineOutput on the sink for the whole step —
+        one queue item → one detokenizer iteration → one SSE write instead
+        of K of each. Tokens past a finish are discarded (overshoot rows).
+        Returns the number of tokens actually delivered."""
+        ids: list[int] = []
+        lps: list[float] = []
+        reason = None
+        for t, lp in zip(tokens, logps):
+            self.scheduler.commit_computed(seq, len(seq.tokens))
+            self.scheduler.append_token(seq, int(t))
+            ids.append(int(t))
+            lps.append(float(lp))
+            reason = self.scheduler.check_finish(seq, int(t))
+            if reason is not None:
+                break
+        if not ids:
+            return 0
+        if reason is not None:
+            self.scheduler.finish(seq, reason)
+        seq.sink.put_nowait(LLMEngineOutput(token_ids=ids, log_probs=lps,
+                                            finish_reason=reason))
+        if reason is not None:
+            seq.sink.put_nowait(None)
+        return len(ids)
 
     def _deliver(self, seq: SeqState, token: int, logp: float,
                  top: Optional[list] = None) -> None:

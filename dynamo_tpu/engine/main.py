@@ -123,6 +123,18 @@ async def amain():
                          "(greedy-invariant); 0 = off")
     ap.add_argument("--multi-step-decode", type=int, default=1,
                     help="decode steps fused per jitted call (token bursts)")
+    ap.add_argument("--warmup-buckets", action="store_true",
+                    help="AOT-precompile every configured prefill/decode "
+                         "bucket before serving so the first request pays "
+                         "no XLA compile (engine.warmup())")
+    ap.add_argument("--warmup-seq-lens", default=None,
+                    help="comma-separated expected total sequence lengths "
+                         "for --warmup-buckets (picks the block-table-width "
+                         "buckets to trace; default: max-model-len)")
+    ap.add_argument("--no-pipeline-decode", dest="pipeline_decode",
+                    action="store_false", default=True,
+                    help="disable the depth-2 pipelined decode loop "
+                         "(overlaps device compute with host commit/emit)")
     ap.add_argument("--no-prefix-caching", action="store_true")
     # choices= fails fast on a typo — an unknown parser name would
     # otherwise silently disable extraction AND buffer all chat streaming
@@ -254,6 +266,8 @@ async def amain():
         kvbm_disk_bytes=int(cli.kvbm_disk_gb * (1 << 30)),
         quantization=cli.quantization,
         kv_cache_dtype=cli.kv_cache_dtype,
+        pipeline_decode=cli.pipeline_decode,
+        warmup_buckets=cli.warmup_buckets,
     )
 
     if cli.dp_rank is not None and not 0 <= cli.dp_rank < cli.num_ranks:
@@ -288,7 +302,22 @@ async def amain():
     if tokenizer_ref:
         from dynamo_tpu.llm.tokenizer import load_guided_vocab
         cli._guided_vocab = load_guided_vocab(tokenizer_ref)
+    # parse BEFORE the heavy engine build: a typo'd value must fail in
+    # milliseconds, not after minutes of weight loading
+    warmup_seq_lens = None
+    if cli.warmup_seq_lens:
+        try:
+            warmup_seq_lens = [int(x) for x in cli.warmup_seq_lens.split(",")
+                               if x.strip()]
+        except ValueError:
+            ap.error(f"--warmup-seq-lens must be comma-separated ints, "
+                     f"got {cli.warmup_seq_lens!r}")
+
     engine = build_engine(cli, cfg, args)  # heavy JAX work first (see above)
+    if args.warmup_buckets:
+        # before joining the control plane: no request can race the dummy
+        # dispatches, and a slow compile can't starve the lease keepalive
+        await engine.warmup(seq_lens=warmup_seq_lens)
     runtime = await DistributedRuntime.create()
 
     if cli._mh_world > 1 and cli._mh_rank > 0:

@@ -46,6 +46,13 @@ from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.control_plane import NoRespondersError
 from dynamo_tpu.runtime.metrics import MetricsRegistry, render_registries
 
+# SSE writers iterate _batched(stream) instead of the raw stream so chunks
+# that pile up while a socket write is in flight coalesce into ONE write —
+# within an engine step, every sequence's chunk arrives back-to-back, and
+# the per-write syscall/async overhead is paid once per step, not per
+# token. Bounded queue: a slow client still backpressures the worker.
+from dynamo_tpu.runtime.streams import batched as _batched
+
 logger = logging.getLogger("dynamo.http")
 
 
@@ -476,9 +483,11 @@ class HttpService:
                      "Cache-Control": "no-cache", "x-request-id": ctx.id})
         await resp.prepare(request)
 
+        def record(event: str, payload: dict) -> bytes:
+            return f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode()
+
         async def emit(event: str, payload: dict):
-            await resp.write(
-                f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode())
+            await resp.write(record(event, payload))
 
         status = "200"
         parts: list[str] = []
@@ -492,34 +501,46 @@ class HttpService:
                 "response": response_object(rid, model, created, "",
                                             "in_progress")})
             finish = None
-            async for wire in stream:
-                ann = Annotated.from_wire(wire)
-                if ann.is_error():
-                    await emit("response.failed", {
-                        "type": "response.failed",
-                        "response": response_object(rid, model, created,
-                                                    "".join(parts), "failed")})
-                    status = "500"
+            stop = False
+            async for items in _batched(stream):
+                # one transport write per batch (same coalescing as
+                # _stream_sse — typed events re-split client-side unchanged)
+                buf = bytearray()
+                for wire in items:
+                    ann = Annotated.from_wire(wire)
+                    if ann.is_error():
+                        buf += record("response.failed", {
+                            "type": "response.failed",
+                            "response": response_object(
+                                rid, model, created, "".join(parts),
+                                "failed")})
+                        status = "500"
+                        stop = True
+                        break
+                    if ann.event is not None:
+                        continue
+                    chunk = ann.data
+                    if chunk.get("usage"):
+                        usage = chunk["usage"]
+                        self._record_usage(model, usage)
+                    for ch in chunk.get("choices", []):
+                        delta = (ch.get("delta") or {}).get("content")
+                        finish = ch.get("finish_reason") or finish
+                        if delta:
+                            if timing.tick():
+                                self._ttft.observe(
+                                    time.perf_counter() - t0,
+                                    route="responses")
+                            parts.append(delta)
+                            buf += record("response.output_text.delta", {
+                                "type": "response.output_text.delta",
+                                "item_id": response_msg_id(rid),
+                                "output_index": 0, "content_index": 0,
+                                "delta": delta})
+                if buf:
+                    await resp.write(bytes(buf))
+                if stop:
                     break
-                if ann.event is not None:
-                    continue
-                chunk = ann.data
-                if chunk.get("usage"):
-                    usage = chunk["usage"]
-                    self._record_usage(model, usage)
-                for ch in chunk.get("choices", []):
-                    delta = (ch.get("delta") or {}).get("content")
-                    finish = ch.get("finish_reason") or finish
-                    if delta:
-                        if timing.tick():
-                            self._ttft.observe(time.perf_counter() - t0,
-                                               route="responses")
-                        parts.append(delta)
-                        await emit("response.output_text.delta", {
-                            "type": "response.output_text.delta",
-                            "item_id": response_msg_id(rid),
-                            "output_index": 0, "content_index": 0,
-                            "delta": delta})
             if status == "200":
                 text = "".join(parts)
                 await emit("response.output_text.done", {
@@ -645,28 +666,37 @@ class HttpService:
         status = "200"
         timing = _StreamTiming(self, route, t0)
         try:
-            async for wire in stream:
-                ann = Annotated.from_wire(wire)
-                if ann.is_error():
-                    payload = {"error": {"message": "; ".join(ann.comment or []), "type": "engine_error"}}
-                    await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
-                    status = "500"
+            stop = False
+            async for items in _batched(stream):
+                # one transport write per batch: chunks that queued up while
+                # the previous write was in flight coalesce (still one SSE
+                # `data:` record per chunk — clients re-split unchanged)
+                buf = bytearray()
+                for wire in items:
+                    ann = Annotated.from_wire(wire)
+                    if ann.is_error():
+                        payload = {"error": {"message": "; ".join(ann.comment or []), "type": "engine_error"}}
+                        buf += f"data: {json.dumps(payload)}\n\n".encode()
+                        status = "500"
+                        stop = True
+                        break
+                    if ann.event is not None:
+                        buf += f"event: {ann.event}\ndata: {json.dumps(ann.data)}\n\n".encode()
+                        continue
+                    if timing.tick():
+                        self._ttft.observe(time.perf_counter() - t0, route=route)
+                    data = ann.data
+                    if isinstance(data, dict) and "usage" in data:
+                        # the pipeline always attaches final-chunk usage for
+                        # metrics; only clients that asked get it on the wire
+                        self._record_usage(model, data.get("usage"))
+                        if not keep_usage:
+                            data = {k: v for k, v in data.items() if k != "usage"}
+                    buf += f"data: {json.dumps(data)}\n\n".encode()
+                if buf:
+                    await resp.write(bytes(buf))
+                if stop:
                     break
-                if ann.event is not None:
-                    await resp.write(
-                        f"event: {ann.event}\ndata: {json.dumps(ann.data)}\n\n".encode()
-                    )
-                    continue
-                if timing.tick():
-                    self._ttft.observe(time.perf_counter() - t0, route=route)
-                data = ann.data
-                if isinstance(data, dict) and "usage" in data:
-                    # the pipeline always attaches final-chunk usage for
-                    # metrics; only clients that asked get it on the wire
-                    self._record_usage(model, data.get("usage"))
-                    if not keep_usage:
-                        data = {k: v for k, v in data.items() if k != "usage"}
-                await resp.write(f"data: {json.dumps(data)}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: propagate cancellation to the worker

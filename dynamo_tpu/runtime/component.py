@@ -36,6 +36,7 @@ from dynamo_tpu.runtime.response_plane import (
     StreamSender,
     make_local_stream,
 )
+from dynamo_tpu.runtime.streams import batched
 
 logger = logging.getLogger("dynamo.component")
 
@@ -235,13 +236,17 @@ async def _pump_handler(handler: EndpointHandler, request: Any, ctx: Context, se
     # worker-side root span: parents to the sender's rpc hop (remote) or
     # the caller's live span (in-process short-circuit)
     with get_tracer().span("worker.handle", ctx, service="worker") as sp:
+        # handler output rides batched(): items that pile up while a send
+        # is in flight coalesce into one send_many() — one transport write
+        # per batch over the corked response plane
+        stream = batched(handler(request, ctx), maxsize=64)
         try:
             n_items = 0
-            async for item in handler(request, ctx):
+            async for items in stream:
                 if ctx.cancelled:
                     break
-                n_items += 1
-                await sender.send(item)
+                n_items += len(items)
+                await sender.send_many(items)
             sp.set(items=n_items, cancelled=ctx.cancelled)
             await sender.complete()
         except asyncio.CancelledError:
@@ -255,6 +260,11 @@ async def _pump_handler(handler: EndpointHandler, request: Any, ctx: Context, se
                 await sender.error(f"handler error: {e!r}")
             except Exception:
                 pass
+        finally:
+            # deterministic teardown of the pump task + handler generator
+            # (a cancel-break above must not leave them draining into the
+            # bounded queue until GC)
+            await stream.aclose()
 
 
 class Client:

@@ -24,7 +24,7 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Optional
 
-from dynamo_tpu.runtime.codec import read_frame, write_frame
+from dynamo_tpu.runtime.codec import pack_frame, read_frame, write_frame
 from dynamo_tpu.runtime.context import STREAM_ERR_MSG, Context, StreamError
 
 logger = logging.getLogger("dynamo.response_plane")
@@ -181,13 +181,26 @@ class ResponseStreamServer:
 
 
 class StreamSender:
-    """Worker-side handle for pushing response frames back to the requester."""
+    """Worker-side handle for pushing response frames back to the requester.
+
+    Sends are CORKED: frames are written to the transport without awaiting
+    ``drain()`` (the event loop flushes writes to the socket on its own —
+    drain is only backpressure), and the drain round trip is paid once per
+    ``SEND_HIGH_WATER`` bytes or on flush/complete instead of once per
+    token frame. ``send_many()`` packs a whole batch into one write.
+    """
+
+    #: unflushed bytes after which send()/send_many() await one drain —
+    #: bounds worker-side memory when the requester reads slowly (TCP flow
+    #: control then throttles us through the paused transport)
+    SEND_HIGH_WATER = 64 * 1024
 
     def __init__(self):
         self._queue: Optional[asyncio.Queue] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._reader_task: Optional[asyncio.Task] = None
         self._closed = False
+        self._unflushed = 0
 
     @staticmethod
     async def connect(info: ConnectionInfo, ctx: Optional[Context] = None) -> "StreamSender":
@@ -225,7 +238,35 @@ class StreamSender:
         if self._queue is not None:
             await self._queue.put({"t": "data", "d": data})
         else:
-            await write_frame(self._writer, {"t": "data", "d": data})
+            self._write_corked(pack_frame({"t": "data", "d": data}))
+            await self._maybe_drain()
+
+    async def send_many(self, items: list) -> None:
+        """Send a batch of data frames as ONE transport write (and at most
+        one drain) — the coalesced path for per-step token batches."""
+        if not items:
+            return
+        if self._queue is not None:
+            for d in items:
+                await self._queue.put({"t": "data", "d": d})
+        else:
+            self._write_corked(b"".join(
+                pack_frame({"t": "data", "d": d}) for d in items))
+            await self._maybe_drain()
+
+    def _write_corked(self, buf: bytes) -> None:
+        self._writer.write(buf)
+        self._unflushed += len(buf)
+
+    async def _maybe_drain(self) -> None:
+        if self._unflushed >= self.SEND_HIGH_WATER:
+            await self.flush()
+
+    async def flush(self) -> None:
+        """Pay the backpressure drain now (no-op when nothing is corked)."""
+        if self._writer is not None and self._unflushed:
+            self._unflushed = 0
+            await self._writer.drain()
 
     async def complete(self) -> None:
         self._closed = True
@@ -233,6 +274,7 @@ class StreamSender:
             _put_sentinel(self._queue, _COMPLETE)
         else:
             try:
+                self._unflushed = 0
                 await write_frame(self._writer, _COMPLETE)
             finally:
                 self._teardown()

@@ -57,6 +57,20 @@ async def _wait(predicate, timeout=5.0, msg="condition"):
         await asyncio.sleep(0.02)
 
 
+async def _mutate_cr(crs, name, mutate, retries=5):
+    """get→mutate→replace with retry-on-conflict: the live controller's
+    status writes legitimately bump resourceVersion between the test's get
+    and replace (the same RetryOnConflict idiom the controller uses)."""
+    for _ in range(retries):
+        cur = await crs.get(name)
+        mutate(cur)
+        try:
+            return await crs.replace(name, cur)
+        except Conflict:
+            await asyncio.sleep(0.02)
+    raise AssertionError(f"replace of {name} kept conflicting")
+
+
 async def test_create_scale_and_status():
     server, client = await _env()
     crs = client.resource(GROUP, VERSION, "default", PLURAL)
@@ -452,9 +466,10 @@ async def test_scale_down_cleans_discovery_keys():
         await _wait(lambda: n_pods(3), msg="3 pods")
 
         # scale decode 2 -> 1: victim's key goes, survivor's stays
-        cur = await crs.get("g1")
-        cur["spec"]["services"]["decode"]["replicas"] = 1
-        await crs.replace("g1", cur)
+        def scale_down(cur):
+            cur["spec"]["services"]["decode"]["replicas"] = 1
+
+        await _mutate_cr(crs, "g1", scale_down)
         await _wait(lambda: n_pods(2), msg="scale down")
 
         async def victim_key_gone():
@@ -466,9 +481,10 @@ async def test_scale_down_cleans_discovery_keys():
         assert "instances/dynamo/prefill/e:cc" in keys
 
         # remove the prefill service entirely -> its subtree is wiped
-        cur = await crs.get("g1")
-        del cur["spec"]["services"]["prefill"]
-        await crs.replace("g1", cur)
+        def drop_prefill(cur):
+            del cur["spec"]["services"]["prefill"]
+
+        await _mutate_cr(crs, "g1", drop_prefill)
 
         async def prefill_gone():
             keys = await plane.kv_get_prefix("instances/dynamo/")
