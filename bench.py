@@ -164,6 +164,101 @@ async def observe_smoke() -> dict:
 
 # --------------------------------------------------------------- kernel phase
 
+#: chaos smoke gate: p95 under 1% drop injection must stay within this
+#: factor of the clean p95 (completion rate must be exactly 1.0)
+CHAOS_P95_BOUND = 5.0
+
+
+async def chaos_smoke(spec: str = "stream.send:drop=0.01",
+                      seed: int = 1234) -> dict:
+    """Overload-protection smoke (docs/robustness.md): the same mocker
+    stack twice — clean, then with ``spec`` injected (seeded) — asserting
+    that every request still completes EXACTLY (migration + backoff absorb
+    the faults) and p95 latency degradation stays bounded. No accelerator;
+    runs in seconds."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+    from dynamo_tpu.mocker.engine import MockEngineArgs
+    from dynamo_tpu.mocker.main import run_mocker
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.chaos import configure_chaos
+
+    N_REQ, OSL = 32, 16
+    rt = await DistributedRuntime.create()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager, router_mode="kv").start()
+    service = HttpService(manager, port=0)
+    await service.start()
+    args = MockEngineArgs(vocab_size=make_test_tokenizer().vocab_size,
+                          block_size=4, num_gpu_blocks=1024,
+                          speedup_ratio=50.0)
+    engines, handles = await run_mocker(rt, "chaos-bench", args,
+                                        migration_limit=100)
+    for _ in range(200):
+        if manager.list_models():
+            break
+        await asyncio.sleep(0.05)
+    url = f"http://127.0.0.1:{service.port}/v1/completions"
+
+    async def one(session, i):
+        t0 = time.perf_counter()
+        complete = False
+        try:
+            async with session.post(url, json={
+                    "model": "chaos-bench", "prompt": [10 + i, 11, 12, 13],
+                    "max_tokens": OSL, "ignore_eos": True}) as r:
+                if r.status == 200:
+                    data = await r.json()
+                    complete = data["usage"]["completion_tokens"] == OSL
+        except Exception:  # noqa: BLE001 — a failed request counts as lost
+            pass
+        return complete, time.perf_counter() - t0
+
+    async def wave():
+        async with aiohttp.ClientSession() as session:
+            res = await asyncio.gather(*[one(session, i)
+                                         for i in range(N_REQ)])
+        lats = sorted(lat for _ok, lat in res)
+        rate = sum(1 for ok, _ in res if ok) / len(res)
+        return rate, lats
+
+    def p95(lats):
+        return lats[min(len(lats) - 1, int(len(lats) * 0.95))]
+
+    try:
+        clean_rate, clean = await wave()
+        inj = configure_chaos(spec, seed=seed)
+        try:
+            chaos_rate, chaotic = await wave()
+        finally:
+            configure_chaos(None)
+    finally:
+        await service.stop()
+        await watcher.stop()
+        for handle in handles:
+            await handle.stop(graceful=False)
+        for engine in engines:
+            await engine.stop()
+        await rt.shutdown()
+
+    ratio = round(p95(chaotic) / max(p95(clean), 1e-9), 2)
+    return {
+        "chaos_spec": spec,
+        "chaos_seed": seed,
+        "clean_completion_rate": clean_rate,
+        "chaos_completion_rate": chaos_rate,
+        "clean_p95_ms": round(p95(clean) * 1000, 1),
+        "chaos_p95_ms": round(p95(chaotic) * 1000, 1),
+        "chaos_p95_ratio": ratio,
+        "chaos_faults_fired": sum(inj.counts.values()),
+        "chaos_ok": (chaos_rate == 1.0 and clean_rate == 1.0
+                     and ratio <= CHAOS_P95_BOUND),
+    }
+
+
 def kernel_bench(on_tpu: bool, quantization=None, kv_int8=False) -> dict:
     import jax
     import jax.numpy as jnp
@@ -545,6 +640,26 @@ def main():
         print(json.dumps(out), flush=True)
         return
 
+    if "--chaos" in sys.argv:
+        # chaos smoke: no accelerator, no child orchestration — prints one
+        # JSON line; exits nonzero when completion rate or p95 degradation
+        # breaks the bound (the recovery paths regressed)
+        idx = sys.argv.index("--chaos")
+        spec = (sys.argv[idx + 1] if idx + 1 < len(sys.argv)
+                and not sys.argv[idx + 1].startswith("-")
+                else "stream.send:drop=0.01")
+        try:
+            out = asyncio.run(chaos_smoke(spec))
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"chaos": "failed", "error": repr(e)[:300]}),
+                  flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["chaos_ok"] else 1)
+
     if os.environ.get("DYN_BENCH_CHILD"):
         _child_main()
         return
@@ -623,13 +738,15 @@ def _child_main():
     # DYN_BENCH_PHASES: comma list of {kernel,spec,e2e} to run (default all)
     # — perf iteration on one phase shouldn't pay the full suite each time
     phases = {p.strip() for p in
-              os.environ.get("DYN_BENCH_PHASES", "kernel,spec,e2e").split(",")
+              os.environ.get("DYN_BENCH_PHASES",
+                             "kernel,spec,e2e,chaos").split(",")
               if p.strip()}
-    unknown = phases - {"kernel", "spec", "e2e"}
+    unknown = phases - {"kernel", "spec", "e2e", "chaos"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
-                         f"{sorted(unknown)} (valid: kernel, spec, e2e)")
+                         f"{sorted(unknown)} (valid: kernel, spec, e2e, "
+                         f"chaos)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -660,6 +777,14 @@ def _child_main():
                 kern.update(asyncio.run(_spec_bench(on_tpu)))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["spec_error"] = repr(e)[:200]
+        if "chaos" in phases:
+            # chaos smoke (mocker-based, seconds): completion rate + p95
+            # degradation under 1% drop injection, in the gains block every
+            # round so a recovery-path regression is visible immediately
+            try:
+                kern["chaos_smoke"] = asyncio.run(chaos_smoke())
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["chaos_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

@@ -38,10 +38,29 @@ class PrefillQueueClient:
     """Decode-worker side: acquire a prefill worker through the queue."""
 
     def __init__(self, plane, queue: str = PREFILL_QUEUE,
-                 claim_timeout: float = 10.0):
+                 claim_timeout: float = 10.0, metrics=None):
         self.plane = plane
         self.queue = queue
         self.claim_timeout = claim_timeout
+        #: claim waits that timed out (fell back to round robin) — mirrored
+        #: to ``dynamo_prefill_claim_timeouts_total`` when a registry is given
+        self.claim_timeouts = 0
+        self._timeout_counter = (
+            metrics.counter("prefill_claim_timeouts_total",
+                            "prefill queue claim waits that timed out")
+            if metrics is not None else None)
+
+    def _budget_s(self, ctx) -> float:
+        """Claim wait + ticket TTL derived from the request's remaining
+        deadline instead of the flat default: a request with 200 ms left
+        must not park a ticket for 10 s, and its ticket must expire the
+        moment the decode side would have fallen back anyway."""
+        budget = self.claim_timeout
+        remaining = ctx.remaining_s() if ctx is not None and hasattr(
+            ctx, "remaining_s") else None
+        if remaining is not None:
+            budget = max(0.0, min(budget, remaining))
+        return budget
 
     async def acquire(self, ctx=None) -> Optional[int]:
         """Enqueue a ticket; returns the claiming prefill worker's instance
@@ -52,6 +71,9 @@ class PrefillQueueClient:
         latency signal NetKV-style decode-instance selection hinges on."""
         from dynamo_tpu.observability import get_tracer
 
+        budget = self._budget_s(ctx)
+        if budget <= 0:
+            return None  # deadline already spent: no point queueing
         job_id = uuid.uuid4().hex
         sub = await self.plane.subscribe(f"{CLAIM_SUBJECT}.{job_id}")
         span = get_tracer().span("prefill.queue_wait", ctx,
@@ -64,7 +86,7 @@ class PrefillQueueClient:
                 await self.plane.queue_push(
                     self.queue, msgpack.packb({
                         "job_id": job_id,
-                        "expires_at": time.time() + self.claim_timeout}))
+                        "expires_at": time.time() + budget}))
 
                 async def first_claim():
                     async for _subject, payload in sub:
@@ -72,11 +94,14 @@ class PrefillQueueClient:
                     return None
 
                 try:
-                    claim = await asyncio.wait_for(first_claim(),
-                                                   self.claim_timeout)
+                    claim = await asyncio.wait_for(first_claim(), budget)
                 except asyncio.TimeoutError:
-                    logger.warning("prefill queue claim timed out; falling "
-                                   "back to round robin")
+                    logger.warning("prefill queue claim timed out after "
+                                   "%.1fs; falling back to round robin",
+                                   budget)
+                    self.claim_timeouts += 1
+                    if self._timeout_counter is not None:
+                        self._timeout_counter.inc()
                     sp.set(claimed=False, timeout=True)
                     return None
                 iid = claim["instance_id"] if claim else None
@@ -100,7 +125,8 @@ class PrefillQueueWorker:
     """
 
     def __init__(self, plane, instance_id: int, capacity_gate=None,
-                 queue: str = PREFILL_QUEUE, poll: float = 0.2):
+                 queue: str = PREFILL_QUEUE, poll: float = 0.2,
+                 metrics=None):
         self.plane = plane
         self.instance_id = instance_id
         self.capacity_gate = capacity_gate
@@ -109,6 +135,15 @@ class PrefillQueueWorker:
         self._task: Optional[asyncio.Task] = None
         self._stop = False
         self.claims = 0
+        #: expired tickets popped and dropped — a rising rate means decode
+        #: workers are giving up before this fleet can claim (undersized
+        #: prefill fleet or too-tight deadlines); mirrored to
+        #: ``dynamo_prefill_tickets_discarded_total`` when a registry is given
+        self.discarded = 0
+        self._discard_counter = (
+            metrics.counter("prefill_tickets_discarded_total",
+                            "expired prefill queue tickets discarded")
+            if metrics is not None else None)
 
     async def start(self) -> "PrefillQueueWorker":
         self._task = asyncio.get_running_loop().create_task(self._loop())
@@ -135,7 +170,16 @@ class PrefillQueueWorker:
                 ticket = msgpack.unpackb(item, raw=False)
                 exp = ticket.get("expires_at")
                 if exp is not None and exp < time.time():
-                    continue  # decode side already fell back; discard
+                    # decode side already fell back; discard — but LOUDLY:
+                    # silent drops hid fleet-undersizing from operators
+                    self.discarded += 1
+                    if self._discard_counter is not None:
+                        self._discard_counter.inc()
+                    logger.warning(
+                        "discarding expired prefill ticket %s (%.1fs stale; "
+                        "%d discarded total)", ticket.get("job_id", "?")[:16],
+                        time.time() - exp, self.discarded)
+                    continue
                 await self.plane.publish(
                     f"{CLAIM_SUBJECT}.{ticket['job_id']}",
                     msgpack.packb({"instance_id": self.instance_id}))

@@ -34,6 +34,8 @@ from dynamo_tpu.engine.cache import (
 from dynamo_tpu.engine.config import EngineArgs, ModelConfig
 from dynamo_tpu.engine.scheduler import Scheduler, SeqState, StepPlan
 from dynamo_tpu.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.chaos import get_chaos as _get_chaos
+from dynamo_tpu.runtime.context import StreamError
 from dynamo_tpu.router.protocols import (
     ForwardPassMetrics, KvCacheEvent, KvStats, SpecDecodeStats, StoredBlock,
     WorkerStats,
@@ -297,6 +299,8 @@ class AsyncJaxEngine:
                 out: Optional[LLMEngineOutput] = await sink.get()
                 if out is None:
                     return
+                if isinstance(out, Exception):
+                    raise out  # chaos/step failure: surfaces as StreamError
                 if t_first is None and out.token_ids:
                     t_first = time.time()
                     tracer.record("engine.ttft", ctx, start=t0, end=t_first,
@@ -432,8 +436,8 @@ class AsyncJaxEngine:
         try:
             while True:
                 out = await sink.get()
-                if out is None:
-                    break
+                if out is None or isinstance(out, Exception):
+                    break  # step failure: graceful token_id=-1 fallback below
                 if out.token_ids:
                     token, logp = out.token_ids[0], (out.log_probs or [None])[0]
                 if out.finish_reason is not None:
@@ -521,6 +525,8 @@ class AsyncJaxEngine:
         async def drain_sink():
             while True:
                 out = await sink.get()
+                if isinstance(out, Exception):
+                    out = None  # step failure: token_id=-1 fallback downstream
                 events.put_nowait(("out", out))
                 if out is None or out.finish_reason is not None:
                     return
@@ -703,6 +709,8 @@ class AsyncJaxEngine:
                 out = await sink.get()
                 if out is None:
                     return
+                if isinstance(out, Exception):
+                    raise out  # chaos/step failure: surfaces as StreamError
                 n_tokens += len(out.token_ids)
                 yield out
                 if out.finish_reason is not None:
@@ -780,6 +788,20 @@ class AsyncJaxEngine:
                 await self._wake.wait()
                 continue
             plan = self.scheduler.plan()
+            chaos = _get_chaos()
+            if (chaos is not None and not plan.empty
+                    and chaos.should_error("engine.step")):
+                # injected step crash: fail in-flight sequences with a
+                # RETRYABLE stream error (a dead worker's streams migrate;
+                # the chaos layer exercises exactly that path)
+                logger.warning("chaos: engine.step error injected; failing "
+                               "%d in-flight seqs",
+                               len(self.scheduler.running))
+                for s in list(self.scheduler.running):
+                    self.scheduler.finish(s, FinishReason.ERROR)
+                    s.sink.put_nowait(StreamError(
+                        "chaos: injected engine step error"))
+                continue
             if plan.empty:
                 # memory-starved and nothing runnable: park until a BlockPool
                 # release or a finishing sequence sets _wake (event-driven —

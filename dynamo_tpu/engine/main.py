@@ -402,7 +402,8 @@ async def amain():
             prefill_client = await pc.client().start()
             if cli.prefill_queue:
                 from dynamo_tpu.disagg.queue import PrefillQueueClient
-                prefill_queue = PrefillQueueClient(runtime.plane)
+                prefill_queue = PrefillQueueClient(runtime.plane,
+                                                   metrics=runtime.metrics)
         dconf = DisaggConfig(
             max_local_prefill_length=cli.max_local_prefill_length)
         mm_client = None
@@ -503,7 +504,8 @@ async def amain():
                                              engine_capacity_gate)
         queue_worker = await PrefillQueueWorker(
             runtime.plane, instance_id=lease,
-            capacity_gate=engine_capacity_gate(engine)).start()
+            capacity_gate=engine_capacity_gate(engine),
+            metrics=runtime.metrics).start()
 
     # Multi-process DP fleet: every rank serves its own endpoint instance
     # (its own lease → the router sees N routable instances, each with its
@@ -586,7 +588,10 @@ async def amain():
     if embed_handle is not None:
         await embed_handle.stop(graceful=False)
     await clear_handle.stop(graceful=False)
-    await handle.stop(graceful=True)
+    # SIGTERM drain: deregistration (lease key delete) happens first inside
+    # stop(), so routers stop picking this worker; in-flight streams then
+    # get DYN_DRAIN_TIMEOUT to finish before being cancelled
+    await handle.stop(graceful=True, timeout=runtime.config.drain_timeout)
     await engine.close()
     await runtime.shutdown()
 

@@ -325,17 +325,31 @@ class Scheduler:
         def dead(s):
             return getattr(s.ctx, "cancelled", False) or id(s) in self._aborted
 
+        def expired(s):
+            # end-to-end deadline (runtime Context): enforced at PLAN time so
+            # an expired sequence never spends another device step
+            return getattr(s.ctx, "expired", False)
+
         for s in list(self.running):
             if dead(s):
                 self._aborted.discard(id(s))
                 self.finish(s, FinishReason.CANCELLED)
                 s.sink.put_nowait(None)  # unblock the generate() consumer
+            elif expired(s):
+                self.finish(s, FinishReason.DEADLINE)
+                s.sink.put_nowait(LLMEngineOutput(
+                    finish_reason=FinishReason.DEADLINE))
         for s in list(self.waiting):
             if dead(s):
                 self._aborted.discard(id(s))
                 s.finished = FinishReason.CANCELLED
                 self.waiting.remove(s)
                 s.sink.put_nowait(None)
+            elif expired(s):
+                s.finished = FinishReason.DEADLINE
+                self.waiting.remove(s)
+                s.sink.put_nowait(LLMEngineOutput(
+                    finish_reason=FinishReason.DEADLINE))
 
     def _admit(self) -> None:
         bs = self.args.block_size

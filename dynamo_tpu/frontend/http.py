@@ -42,7 +42,11 @@ from dynamo_tpu.protocols.openai import (
     response_object,
 )
 from dynamo_tpu.observability import fetch_trace, get_tracer
-from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceededError,
+    OverloadedError,
+)
 from dynamo_tpu.runtime.control_plane import NoRespondersError
 from dynamo_tpu.runtime.metrics import MetricsRegistry, render_registries
 
@@ -126,11 +130,34 @@ class HttpService:
         #: unset = open, matching the reference's unauthenticated route —
         #: set DYN_ADMIN_TOKEN (or --admin-token) on exposed binds
         self.admin_token = os.environ.get("DYN_ADMIN_TOKEN")
+        # overload protection (docs/robustness.md): bounded in-flight work
+        # with early 429 rejection beats silent pile-up. Caps read from the
+        # layered RuntimeConfig when a runtime is attached, else from env —
+        # both spell the knobs DYN_MAX_INFLIGHT / DYN_MAX_QUEUE /
+        # DYN_REQUEST_DEADLINE. 0/None disables a cap.
+        rcfg = getattr(runtime, "config", None)
+        if rcfg is None:
+            # runtime-less construction (tests, bench): load the layered
+            # config from env so the SAME validation applies — a typo'd or
+            # out-of-range knob fails loudly at startup either way
+            from dynamo_tpu.runtime.config import RuntimeConfig
+
+            rcfg = RuntimeConfig.load()
+        self.max_inflight = rcfg.max_inflight
+        self.max_queue = rcfg.max_queue
+        #: default end-to-end deadline seconds (None = no deadline) applied
+        #: when the client sends no X-Request-Timeout-Ms
+        self.default_deadline_s = rcfg.request_deadline
+        self._draining = False
         self.host = host
         self.port = port
         self._runner: Optional[web.AppRunner] = None
         self._requests = self.metrics.counter(
             "http_requests_total", "HTTP requests by route/model/status"
+        )
+        self._rejected = self.metrics.counter(
+            "http_requests_rejected_total",
+            "requests rejected for overload/deadline by route/model/reason"
         )
         self._latency = self.metrics.histogram(
             "http_request_duration_seconds", "Request duration"
@@ -140,6 +167,7 @@ class HttpService:
         )
         self._inflight = self.metrics.gauge("http_inflight_requests", "In-flight requests")
         self._inflight_count = 0
+        self._model_inflight: dict[str, int] = {}
         # token counters: the planner's ISL/OSL source (ref: the planner
         # scrapes the frontend's Prometheus — planner/utils/prometheus.py)
         self._prompt_tokens = self.metrics.counter(
@@ -155,6 +183,80 @@ class HttpService:
         must not silently split /metrics and /v1/traces from the recorder
         every instrumentation site writes to."""
         return get_tracer()
+
+    # -- overload protection ----------------------------------------------
+
+    def _begin_request(self, model: str) -> None:
+        self._inflight_count += 1
+        self._inflight.set(self._inflight_count)
+        self._model_inflight[model] = self._model_inflight.get(model, 0) + 1
+
+    def _end_request(self, model: str) -> None:
+        self._inflight_count -= 1
+        self._inflight.set(self._inflight_count)
+        n = self._model_inflight.get(model, 1) - 1
+        if n <= 0:
+            self._model_inflight.pop(model, None)
+        else:
+            self._model_inflight[model] = n
+
+    def _admission(self, route: str, model: str) -> Optional[web.Response]:
+        """Admission control: None = admitted, else the rejection response.
+
+        Sheds with OpenAI-style 429 + ``Retry-After`` BEFORE any pipeline
+        state is created — under sustained overload the right behavior is
+        bounded queues and early rejection, not silent pile-up."""
+        reason = None
+        if self._draining:
+            self._rejected.inc(route=route, model=model, reason="draining")
+            self._requests.inc(route=route, model=model, status="503")
+            return web.json_response(
+                error_body("server is draining", "service_unavailable", 503),
+                status=503, headers={"Retry-After": "1"})
+        if self.max_inflight and self._inflight_count >= self.max_inflight:
+            reason = "max_inflight"
+        elif (self.max_queue
+                and self._model_inflight.get(model, 0) >= self.max_queue):
+            reason = "max_queue"
+        if reason is None:
+            return None
+        return self._overloaded_response(route, model, reason)
+
+    def _overloaded_response(self, route: str, model: str,
+                             reason: str) -> web.Response:
+        """The ONE 429 + Retry-After contract — frontend admission sheds
+        and worker-fleet sheds must stay byte-identical so clients back
+        off the same way regardless of which layer rejected."""
+        self._rejected.inc(route=route, model=model, reason=reason)
+        self._requests.inc(route=route, model=model, status="429")
+        return web.json_response(
+            error_body(f"server overloaded ({reason}); retry after the "
+                       "indicated delay", "overloaded", 429),
+            status=429, headers={"Retry-After": "1"})
+
+    def _deadline_reject(self, route: str, model: str,
+                         reason: str = "deadline") -> web.Response:
+        """408 response; ``reason`` separates expired-on-arrival
+        ("deadline") from admitted work that expired downstream
+        ("deadline_inflight") so admission-cap sizing isn't polluted by
+        requests that did consume worker capacity."""
+        self._rejected.inc(route=route, model=model, reason=reason)
+        self._requests.inc(route=route, model=model, status="408")
+        return web.json_response(
+            error_body("request deadline exceeded", "deadline_exceeded", 408),
+            status=408)
+
+    async def drain(self, timeout: float = 30.0) -> None:
+        """Graceful drain (SIGTERM path): stop admitting (new work gets 503,
+        /health flips to draining so load balancers pull this replica), then
+        wait up to ``timeout`` for in-flight streams to finish."""
+        self._draining = True
+        deadline = time.monotonic() + timeout
+        while self._inflight_count > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if self._inflight_count:
+            logger.warning("drain timeout: %d requests still in flight",
+                           self._inflight_count)
 
     def _record_usage(self, model: str, usage: Optional[dict]) -> None:
         if not usage:
@@ -215,6 +317,28 @@ class HttpService:
             ctx.id = rid
         ctx.traceparent = request.headers.get("traceparent")
         ctx.ensure_traceparent()  # synthesize when the client sent none
+        # end-to-end deadline: X-Request-Timeout-Ms wins, else the
+        # configured default; a malformed header is ignored (same rule as
+        # malformed traceparent) rather than failing the request
+        timeout_ms: Optional[float] = None
+        raw = request.headers.get("x-request-timeout-ms")
+        if raw is not None:
+            try:
+                timeout_ms = float(raw)
+            except ValueError:
+                logger.warning("ignoring malformed X-Request-Timeout-Ms=%r",
+                               raw)
+            else:
+                # bound to [0, ~31 years]: inf/NaN/1e306 parse as floats but
+                # would overflow the remaining-ms wire encoding downstream
+                if not 0 <= timeout_ms <= 1e12:
+                    logger.warning(
+                        "ignoring out-of-range X-Request-Timeout-Ms=%r", raw)
+                    timeout_ms = None
+        if timeout_ms is None and self.default_deadline_s is not None:
+            timeout_ms = self.default_deadline_s * 1000.0
+        if timeout_ms is not None:
+            ctx.set_timeout_ms(timeout_ms)
         from dynamo_tpu.runtime.context import CURRENT_REQUEST
 
         CURRENT_REQUEST.set(ctx)
@@ -252,6 +376,10 @@ class HttpService:
 
     async def handle_health(self, request: web.Request) -> web.Response:
         models = self.manager.list_models()
+        if self._draining:
+            # load balancers must stop sending traffic during SIGTERM drain
+            return web.json_response(
+                {"status": "draining", "models": models}, status=503)
         status = "healthy" if models else "no_models"
         return web.json_response({"status": status, "models": models})
 
@@ -424,11 +552,15 @@ class HttpService:
                 error_body(f"model '{parsed.model}' not found",
                            "model_not_found", 404), status=404)
 
+        rejection = self._admission("responses", parsed.model)
+        if rejection is not None:
+            return rejection
         ctx = self._request_context(request)
+        if ctx.expired:
+            return self._deadline_reject("responses", parsed.model)
         rid = gen_request_id("resp")
         created = int(time.time())
-        self._inflight_count += 1
-        self._inflight.set(self._inflight_count)
+        self._begin_request(parsed.model)
         # root span (same contract as _handle_llm): downstream phases must
         # have a recorded parent or the trace renders as an orphan forest
         with self.tracer.span(
@@ -447,6 +579,12 @@ class HttpService:
                     request, stream, ctx, parsed.model, rid, created, t0)
             try:
                 result = await aggregate_chat_stream(stream)
+            except DeadlineExceededError:
+                return self._deadline_reject("responses", parsed.model,
+                                             reason="deadline_inflight")
+            except OverloadedError:
+                return self._overloaded_response(
+                    "responses", parsed.model, "worker_overloaded")
             except NoRespondersError:
                 self._requests.inc(route="responses", model=parsed.model,
                                    status="503")
@@ -472,8 +610,7 @@ class HttpService:
                 out["incomplete_details"] = {"reason": "max_output_tokens"}
             return web.json_response(out, headers={"x-request-id": ctx.id})
         finally:
-            self._inflight_count -= 1
-            self._inflight.set(self._inflight_count)
+            self._end_request(parsed.model)
 
     async def _stream_responses_sse(self, request, stream, ctx, model,
                                     rid, created, t0) -> web.StreamResponse:
@@ -561,6 +698,20 @@ class HttpService:
             ctx.cancel()
             status = "499"
             raise
+        except DeadlineExceededError:
+            await emit("response.failed", {
+                "type": "response.failed",
+                "response": response_object(rid, model, created,
+                                            "".join(parts), "failed")})
+            status = "408"
+        except OverloadedError:
+            await emit("response.failed", {
+                "type": "response.failed",
+                "response": response_object(rid, model, created,
+                                            "".join(parts), "failed")})
+            self._rejected.inc(route="responses", model=model,
+                               reason="worker_overloaded")
+            status = "429"
         except NoRespondersError:
             await emit("response.failed", {
                 "type": "response.failed",
@@ -611,9 +762,15 @@ class HttpService:
                 status=404,
             )
 
+        rejection = self._admission(route, parsed.model)
+        if rejection is not None:
+            return rejection
         ctx = self._request_context(request)
-        self._inflight_count += 1
-        self._inflight.set(self._inflight_count)
+        if ctx.expired:
+            # expired on arrival (e.g. X-Request-Timeout-Ms: 0, or queued
+            # behind a slow LB): reject with 408 before any worker sees it
+            return self._deadline_reject(route, parsed.model)
+        self._begin_request(parsed.model)
         # root span: every downstream phase (tokenize, route, worker,
         # engine, TTFT/ITL) parents under it; duration feeds
         # dynamo_e2e_seconds via the tracer's SLO registry. When WE
@@ -633,6 +790,17 @@ class HttpService:
                     agg = aggregate_chat_stream(stream) if chat else aggregate_completion_stream(stream)
                     result = await agg
                     self._record_usage(parsed.model, result.get("usage"))
+                except DeadlineExceededError:
+                    root.set(status_code=408)
+                    return self._deadline_reject(route, parsed.model,
+                                                 reason="deadline_inflight")
+                except OverloadedError:
+                    # the WORKER fleet shed the request (typed terminal
+                    # error): same 429 + Retry-After contract as frontend
+                    # admission, so clients back off identically
+                    root.set(status_code=429)
+                    return self._overloaded_response(
+                        route, parsed.model, "worker_overloaded")
                 except NoRespondersError:
                     root.set(status_code=503)
                     self._requests.inc(route=route, model=parsed.model, status="503")
@@ -647,8 +815,7 @@ class HttpService:
                 self._latency.observe(time.perf_counter() - t0, route=route)
                 return web.json_response(result, headers={"x-request-id": ctx.id})
             finally:
-                self._inflight_count -= 1
-                self._inflight.set(self._inflight_count)
+                self._end_request(parsed.model)
 
     async def _stream_sse(
         self, request: web.Request, stream, ctx: Context, route: str,
@@ -703,6 +870,21 @@ class HttpService:
             ctx.cancel()
             status = "499"
             raise
+        except DeadlineExceededError:
+            await resp.write(
+                f"data: {json.dumps(error_body('request deadline exceeded', 'deadline_exceeded', 408))}\n\n".encode()
+            )
+            status = "408"
+        except OverloadedError:
+            # fleet shed after the SSE response opened: can't change the
+            # HTTP status, but the in-band error keeps the overloaded type
+            # so clients back off like the non-stream 429 path
+            await resp.write(
+                f"data: {json.dumps(error_body('worker fleet overloaded; retry later', 'overloaded', 429))}\n\n".encode()
+            )
+            self._rejected.inc(route=route, model=model,
+                               reason="worker_overloaded")
+            status = "429"
         except NoRespondersError:
             await resp.write(
                 f"data: {json.dumps(error_body('no workers available', 'service_unavailable', 503))}\n\n".encode()

@@ -82,9 +82,22 @@ async def amain():
 
     loop = asyncio.get_running_loop()
     stop = asyncio.Event()
+    got_sig: dict = {}
+
+    def on_sig(s):
+        got_sig["sig"] = s
+        stop.set()
+
     for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
+        loop.add_signal_handler(sig, on_sig, sig)
     await stop.wait()
+    if got_sig.get("sig") == signal.SIGTERM:
+        # graceful drain (bounded by DYN_DRAIN_TIMEOUT via RuntimeConfig):
+        # stop admitting — new requests get 503 + Retry-After and /health
+        # flips to draining so load balancers pull this replica — then let
+        # in-flight streams finish. Ctrl-C (SIGINT) skips the drain: an
+        # operator at the keyboard wants the process gone now.
+        await service.drain(runtime.config.drain_timeout)
     await service.stop()
     if grpc_service is not None:
         await grpc_service.stop()

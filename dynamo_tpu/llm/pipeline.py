@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from typing import Any, AsyncIterator, Callable, Optional
 
@@ -33,7 +34,11 @@ from dynamo_tpu.protocols.openai import (
 )
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.llm.tokenizer import TokenizerWrapper
-from dynamo_tpu.runtime.context import Context, StreamError
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceededError,
+    StreamError,
+)
 
 logger = logging.getLogger("dynamo.pipeline")
 
@@ -469,15 +474,39 @@ class Migration:
     on a mid-stream transport error the request is re-issued with
     ``token_ids + tokens_emitted_so_far`` so the new worker continues where
     the dead one stopped; bounded by the MDC's ``migration_limit``.
+
+    Retry policy (docs/robustness.md): only RETRYABLE stream errors are
+    re-sent — typed terminal failures (overload shedding, deadline expiry)
+    re-raise immediately so the budget is never burned against a fleet that
+    will reject again. Re-sends back off exponentially with full jitter
+    (thundering-herd protection when a worker death breaks many streams at
+    once), capped by the request's remaining deadline.
     """
+
+    #: full-jitter backoff: sleep ~U(0, min(CAP, BASE * 2**attempt))
+    BACKOFF_BASE_S = 0.025
+    BACKOFF_CAP_S = 1.0
 
     def __init__(self, downstream: EngineFn, migration_limit: int = 3):
         self.downstream = downstream
         self.migration_limit = migration_limit
 
+    def _backoff_s(self, attempt: int, ctx: Context) -> Optional[float]:
+        """Jittered delay before re-send ``attempt`` (1-based), clamped to
+        the request's remaining deadline budget. None = budget exhausted."""
+        delay = random.uniform(
+            0.0, min(self.BACKOFF_CAP_S, self.BACKOFF_BASE_S * (2 ** attempt)))
+        remaining = ctx.remaining_s()
+        if remaining is None:
+            return delay
+        if remaining <= 0:
+            return None
+        return min(delay, remaining)
+
     async def generate(self, req: PreprocessedRequest, ctx: Context) -> AsyncIterator[LLMEngineOutput]:
         accumulated: list[int] = []
         budget = self.migration_limit if req.backend_instance_id is None else 0
+        attempt = 0
         current = req
         while True:
             try:
@@ -492,19 +521,47 @@ class Migration:
                     if out.finish_reason is not None:
                         return
                 return
+            except DeadlineExceededError:
+                if accumulated:
+                    # the stream already carried tokens: end it cleanly with
+                    # the deadline reason instead of a mid-stream exception
+                    yield LLMEngineOutput(finish_reason=FinishReason.DEADLINE)
+                    return
+                raise
             except StreamError as e:
-                if budget <= 0 or ctx.cancelled:
+                if not e.retryable or budget <= 0 or ctx.cancelled:
                     raise
+                if ctx.expired:
+                    if accumulated:
+                        yield LLMEngineOutput(
+                            finish_reason=FinishReason.DEADLINE)
+                        return
+                    raise DeadlineExceededError(
+                        "deadline expired while migrating") from e
                 budget -= 1
+                attempt += 1
                 remaining = None
-                if current.stop_conditions.max_tokens is not None:
-                    remaining = current.stop_conditions.max_tokens - len(accumulated)
+                if req.stop_conditions.max_tokens is not None:
+                    # against the ORIGINAL budget: current's max_tokens was
+                    # already reduced by earlier migrations while
+                    # ``accumulated`` is cumulative — subtracting from it
+                    # again truncated twice-migrated streams early
+                    remaining = req.stop_conditions.max_tokens - len(accumulated)
                     if remaining <= 0:
                         yield LLMEngineOutput(finish_reason=FinishReason.LENGTH)
                         return
+                delay = self._backoff_s(attempt, ctx)
+                if delay is None:  # raced to expiry since the check above
+                    if accumulated:
+                        yield LLMEngineOutput(
+                            finish_reason=FinishReason.DEADLINE)
+                        return
+                    raise DeadlineExceededError(
+                        "deadline expired while migrating") from e
                 logger.warning(
-                    "migrating request %s after %d tokens (%s); %d retries left",
-                    ctx.id, len(accumulated), e, budget,
+                    "migrating request %s after %d tokens (%s); %d retries "
+                    "left, backoff %.0f ms",
+                    ctx.id, len(accumulated), e, budget, delay * 1000,
                 )
                 new_stop = _clone_stop(current.stop_conditions, remaining)
                 current = PreprocessedRequest(
@@ -518,7 +575,7 @@ class Migration:
                     annotations=current.annotations,
                     router_config_override=current.router_config_override,
                 )
-                await asyncio.sleep(0.05)
+                await asyncio.sleep(delay)
 
 
 def _clone_stop(sc, max_tokens: Optional[int]):

@@ -24,7 +24,8 @@ from typing import AsyncIterator, Optional
 from dynamo_tpu.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.router.protocols import ForwardPassMetrics, KvStats, StoredBlock, WorkerStats
 from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
-from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.chaos import get_chaos
+from dynamo_tpu.runtime.context import Context, StreamError
 from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = logging.getLogger("dynamo.mocker")
@@ -186,6 +187,11 @@ class MockEngine:
         """Endpoint handler: yields LLMEngineOutput wire dicts."""
         if isinstance(req, dict):
             req = PreprocessedRequest.from_wire(req)
+        if getattr(ctx, "expired", False):
+            # an expired request must never enter the scheduler
+            yield LLMEngineOutput(
+                finish_reason=FinishReason.DEADLINE).to_wire()
+            return
         seq = _Seq(
             request_id=ctx.id,
             req=req,
@@ -210,6 +216,8 @@ class MockEngine:
                 out = await seq.out_queue.get()
                 if out is None:
                     return
+                if isinstance(out, Exception):
+                    raise out  # chaos step failure → retryable stream error
                 if t_first is None and out.token_ids:
                     t_first = time.time()
                     tracer.record("engine.ttft", ctx, start=t0, end=t_first,
@@ -245,7 +253,36 @@ class MockEngine:
 
     async def _step(self):
         self.iterations += 1
+        chaos = get_chaos()
+        if (chaos is not None and self.running
+                and chaos.should_error("engine.step")):
+            # injected step crash: in-flight streams fail RETRYABLY so the
+            # frontend's Migration operator re-issues them elsewhere — same
+            # contract as the real engine's chaos hook
+            for seq in self.running:
+                if seq.finished is None:
+                    seq.finished = FinishReason.ERROR
+                    seq.out_queue.put_nowait(StreamError(
+                        "chaos: injected engine step error"))
+            self._reap_finished()
+            return
         self._admit()
+        # plan-time deadline enforcement: an expired sequence spends no
+        # further simulated step and finishes with the "deadline" reason.
+        # The WAITING queue is swept too (same contract as the real
+        # scheduler): a request starved behind a saturated batch must not
+        # hang past its budget waiting for an admission slot.
+        for seq in self.running:
+            if seq.finished is None and getattr(seq.ctx, "expired", False):
+                seq.finished = FinishReason.DEADLINE
+                seq.out_queue.put_nowait(LLMEngineOutput(
+                    finish_reason=FinishReason.DEADLINE))
+        for seq in list(self.waiting):
+            if getattr(seq.ctx, "expired", False):
+                self.waiting.remove(seq)
+                seq.out_queue.put_nowait(LLMEngineOutput(
+                    finish_reason=FinishReason.DEADLINE))
+                seq.out_queue.put_nowait(None)
         prefill_tokens = await self._run_prefill_chunk()
         decoded = await self._run_decode()
         # simulated iteration latency

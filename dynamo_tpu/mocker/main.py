@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import signal
+from typing import Optional
 
 from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
 from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
@@ -26,6 +27,7 @@ async def run_mocker(
     component: str = "mocker",
     endpoint: str = "generate",
     lease_id=None,
+    migration_limit: Optional[int] = None,
 ):
     """Start ``args.dp_size`` simulated ranks on one endpoint.
 
@@ -68,6 +70,8 @@ async def run_mocker(
         eos_token_ids=[2],
         tokenizer_ref="test",
     )
+    if migration_limit is not None:
+        card.migration_limit = migration_limit
     card.runtime_config.total_kv_blocks = args.num_gpu_blocks
     card.runtime_config.max_num_seqs = args.max_num_seqs
     card.runtime_config.max_num_batched_tokens = args.max_num_batched_tokens

@@ -25,6 +25,9 @@ class FinishReason:
     CANCELLED = "cancelled"
     CONTENT_FILTER = "content_filter"
     ERROR = "error"
+    #: the request's end-to-end deadline expired mid-generation; the stream
+    #: ends cleanly with the tokens produced so far (docs/robustness.md)
+    DEADLINE = "deadline"
 
     @staticmethod
     def to_openai(reason: Optional[str]) -> Optional[str]:

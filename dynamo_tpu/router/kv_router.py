@@ -24,7 +24,11 @@ from dynamo_tpu.router.indexer import ApproxKvIndexer, KvIndexer, OverlapScores
 from dynamo_tpu.router.protocols import KvRouterConfig
 from dynamo_tpu.router.scheduler import KvScheduler, NoWorkersError, SchedulingDecision
 from dynamo_tpu.runtime.component import Client
-from dynamo_tpu.runtime.context import Context, StreamError
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceededError,
+    StreamError,
+)
 from dynamo_tpu.runtime.control_plane import NoRespondersError
 from dynamo_tpu.tokens import compute_block_hash_for_seq, compute_seq_hash_for_block
 
@@ -175,6 +179,12 @@ class KvPushRouter:
         if isinstance(req, dict):
             req = PreprocessedRequest.from_wire(req)
 
+        if ctx.expired:
+            # refuse to spend routing/scheduler state on dead work — the
+            # expired request must never reach a worker
+            raise DeadlineExceededError(
+                "request deadline expired before routing")
+
         if req.backend_instance_id is not None:
             async for item in self._stream_to(req, ctx, req.backend_instance_id, None):
                 yield item
@@ -227,6 +237,13 @@ class KvPushRouter:
         except (NoRespondersError, StreamError) as e:
             if tracked:
                 self.router.free(ctx.id)
+            if isinstance(e, StreamError) and not e.retryable:
+                # typed TERMINAL rejection (overloaded/deadline): the worker
+                # is healthy and shed on purpose — evicting it from routing
+                # or laundering the error into a retryable StreamError would
+                # defeat the taxonomy (Migration would re-send to a
+                # saturated fleet and the fleet would bleed workers)
+                raise
             self.client.report_instance_down(instance_id)
             self.router.remove_worker(instance_id)
             raise StreamError(f"worker {instance_id:x} unavailable: {e}") from e
@@ -236,9 +253,10 @@ class KvPushRouter:
                     self.router.mark_prefill_completed(ctx.id)
                     prefill_done = True
                 yield item
-        except StreamError:
-            self.client.report_instance_down(instance_id)
-            self.router.remove_worker(instance_id)
+        except StreamError as e:
+            if e.retryable:  # same rule mid-stream: terminal ≠ worker death
+                self.client.report_instance_down(instance_id)
+                self.router.remove_worker(instance_id)
             raise
         finally:
             if tracked:

@@ -1,0 +1,207 @@
+"""Deterministic fault injection ("chaos") for recovery-path testing.
+
+Every fault-tolerance claim in this codebase (migration, backoff, admission
+shedding, deadline expiry) needs a way to be *proven* in fast tier-1 tests,
+short of the slow process-kill suite. This module is that substrate: a
+seeded, spec-driven injection registry with hook points compiled into the
+hot paths (control-plane publish, response-plane sends, request dispatch,
+engine step). When no spec is configured the hooks cost one global read.
+
+Spec grammar (``DYN_CHAOS``)::
+
+    DYN_CHAOS="plane.publish:drop=0.1;stream.send:delay=50ms;engine.step:error=0.05"
+
+    spec    := entry (';' entry)*
+    entry   := hook ':' action (',' action)*
+    hook    := 'plane.publish' | 'stream.send' | 'request.dispatch'
+             | 'engine.step'   (free-form: unknown hooks parse but never fire)
+    action  := 'drop=' PROB | 'error=' PROB | 'delay=' DURATION
+    PROB    := float in [0, 1]
+    DURATION:= float with optional 'ms' or 's' suffix (default ms)
+
+Semantics per hook:
+
+- ``drop``  — the operation is lost. At ``plane.publish`` the message is
+  silently not delivered (models pub/sub loss); at ``stream.send`` /
+  ``request.dispatch`` the transport "dies" (raises :class:`ChaosError`,
+  which the surrounding machinery surfaces as a retryable stream error —
+  frames are never partially delivered, so token accounting stays exact).
+- ``error`` — raise :class:`ChaosError` at the hook (models a crashed step
+  / exploding handler).
+- ``delay`` — sleep before the operation (models a slow network / stalled
+  worker; only applied at async hooks).
+
+Determinism: one ``random.Random(seed)`` (``DYN_CHAOS_SEED``, default 0)
+drives every roll in hook-call order, so a fixed workload + fixed spec +
+fixed seed reproduces the exact same fault sequence. Per-hook fire counts
+are kept on the injector (``injector.counts``) so tests can assert faults
+actually fired.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+logger = logging.getLogger("dynamo.chaos")
+
+
+class ChaosError(Exception):
+    """An injected fault. Never raised unless chaos is configured."""
+
+
+class ChaosSpecError(ValueError):
+    """The DYN_CHAOS spec string failed to parse; message names the part."""
+
+
+@dataclass
+class ChaosRule:
+    """Parsed actions for one hook point."""
+
+    drop: float = 0.0
+    error: float = 0.0
+    delay_s: float = 0.0
+
+
+def _parse_duration(raw: str) -> float:
+    """'50ms' / '2s' / bare number (ms) → seconds."""
+    s = raw.strip().lower()
+    mult = 0.001
+    if s.endswith("ms"):
+        s = s[:-2]
+    elif s.endswith("s"):
+        s, mult = s[:-1], 1.0
+    try:
+        v = float(s)
+    except ValueError:
+        raise ChaosSpecError(f"bad chaos duration {raw!r}") from None
+    if v < 0:
+        raise ChaosSpecError(f"negative chaos duration {raw!r}")
+    return v * mult
+
+
+def parse_chaos_spec(spec: str) -> dict[str, ChaosRule]:
+    """Parse the ``DYN_CHAOS`` grammar; raises ChaosSpecError loudly —
+    a typo'd fault plan silently injecting nothing defeats the point."""
+    rules: dict[str, ChaosRule] = {}
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" not in entry:
+            raise ChaosSpecError(f"chaos entry {entry!r}: expected hook:action=value")
+        hook, actions = entry.split(":", 1)
+        hook = hook.strip()
+        if not hook:
+            raise ChaosSpecError(f"chaos entry {entry!r}: empty hook name")
+        rule = rules.setdefault(hook, ChaosRule())
+        for action in actions.split(","):
+            action = action.strip()
+            if "=" not in action:
+                raise ChaosSpecError(f"chaos action {action!r}: expected name=value")
+            name, value = (p.strip() for p in action.split("=", 1))
+            if name in ("drop", "error"):
+                try:
+                    p = float(value)
+                except ValueError:
+                    raise ChaosSpecError(f"chaos action {action!r}: bad probability") from None
+                if not 0.0 <= p <= 1.0:
+                    raise ChaosSpecError(f"chaos action {action!r}: probability outside [0, 1]")
+                setattr(rule, name, p)
+            elif name == "delay":
+                rule.delay_s = _parse_duration(value)
+            else:
+                raise ChaosSpecError(f"chaos action {action!r}: unknown action {name!r}")
+    return rules
+
+
+@dataclass
+class ChaosInjector:
+    """Seeded decision engine behind every hook point."""
+
+    rules: dict[str, ChaosRule]
+    seed: int = 0
+    #: (hook, action) -> times fired; lets tests assert injection happened
+    counts: dict[tuple[str, str], int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "ChaosInjector":
+        return cls(rules=parse_chaos_spec(spec), seed=seed)
+
+    def _fired(self, hook: str, action: str) -> None:
+        key = (hook, action)
+        self.counts[key] = self.counts.get(key, 0) + 1
+
+    def should_drop(self, hook: str) -> bool:
+        rule = self.rules.get(hook)
+        if rule is None or rule.drop <= 0.0:
+            return False
+        if self._rng.random() < rule.drop:
+            self._fired(hook, "drop")
+            logger.debug("chaos: dropping at %s", hook)
+            return True
+        return False
+
+    def should_error(self, hook: str) -> bool:
+        rule = self.rules.get(hook)
+        if rule is None or rule.error <= 0.0:
+            return False
+        if self._rng.random() < rule.error:
+            self._fired(hook, "error")
+            logger.debug("chaos: erroring at %s", hook)
+            return True
+        return False
+
+    def delay_s(self, hook: str) -> float:
+        rule = self.rules.get(hook)
+        if rule is None or rule.delay_s <= 0.0:
+            return 0.0
+        self._fired(hook, "delay")
+        return rule.delay_s
+
+    async def pre(self, hook: str) -> None:
+        """Apply delay-then-error at an async hook point. Raises ChaosError
+        on an error roll; the caller handles ``should_drop`` itself because
+        drop semantics differ per hook."""
+        d = self.delay_s(hook)
+        if d > 0.0:
+            import asyncio
+
+            await asyncio.sleep(d)
+        if self.should_error(hook):
+            raise ChaosError(f"injected error at {hook}")
+
+
+#: None = chaos off (the common case: one global read per hook);
+#: _UNSET = env not consulted yet
+_UNSET = object()
+_injector = _UNSET
+
+
+def get_chaos() -> Optional[ChaosInjector]:
+    """The process-wide injector, lazily built from ``DYN_CHAOS`` /
+    ``DYN_CHAOS_SEED``; None when chaos is off."""
+    global _injector
+    if _injector is _UNSET:
+        spec = os.environ.get("DYN_CHAOS")
+        if spec:
+            seed = int(os.environ.get("DYN_CHAOS_SEED", "0"))
+            _injector = ChaosInjector.from_spec(spec, seed=seed)
+            logger.warning("chaos enabled (seed=%d): %s", seed, spec)
+        else:
+            _injector = None
+    return _injector
+
+
+def configure_chaos(spec: Optional[str], seed: int = 0) -> Optional[ChaosInjector]:
+    """Install (or with spec=None, remove) the global injector — the test /
+    bench entry point; overrides whatever the env said."""
+    global _injector
+    _injector = ChaosInjector.from_spec(spec, seed=seed) if spec else None
+    return _injector

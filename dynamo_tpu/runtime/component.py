@@ -28,7 +28,15 @@ from typing import Any, AsyncIterator, Callable, Optional
 
 import msgpack
 
-from dynamo_tpu.runtime.context import Context, StreamError, STREAM_ERR_MSG
+from dynamo_tpu.runtime.chaos import ChaosError, get_chaos
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceededError,
+    OverloadedError,
+    StreamError,
+    STREAM_ERR_MSG,
+    stream_error_from_wire,
+)
 from dynamo_tpu.runtime.control_plane import NoRespondersError, Watch
 from dynamo_tpu.runtime.response_plane import (
     ConnectionInfo,
@@ -121,7 +129,14 @@ class ServeHandle:
         self._inflight = inflight
         self._stopped = asyncio.Event()
 
-    async def stop(self, graceful: bool = True):
+    async def stop(self, graceful: bool = True,
+                   timeout: Optional[float] = None):
+        """Deregister, then (graceful) wait for in-flight streams to finish.
+
+        ``timeout`` bounds the graceful drain (``DYN_DRAIN_TIMEOUT`` at the
+        mains): streams still running when it expires are cancelled instead
+        of holding shutdown hostage.
+        """
         rt = self.endpoint._runtime
         key = instance_key(
             self.endpoint.component.namespace.name,
@@ -143,7 +158,18 @@ class ServeHandle:
             None,
         )
         if graceful and self._inflight:
-            await asyncio.gather(*list(self._inflight), return_exceptions=True)
+            tasks = list(self._inflight)
+            if timeout is not None:
+                done, pending = await asyncio.wait(tasks, timeout=timeout)
+                if pending:
+                    logger.warning(
+                        "drain timeout (%.1fs): cancelling %d in-flight "
+                        "streams", timeout, len(pending))
+                    for t in pending:
+                        t.cancel()
+                    await asyncio.gather(*pending, return_exceptions=True)
+            else:
+                await asyncio.gather(*tasks, return_exceptions=True)
         self._stopped.set()
 
     async def wait(self):
@@ -165,30 +191,58 @@ class Endpoint:
         handler: EndpointHandler,
         metadata: Optional[dict] = None,
         lease_id: Optional[int] = None,
+        max_inflight: Optional[int] = None,
     ) -> ServeHandle:
         """Register this endpoint and start handling requests.
 
         ``handler(request, context)`` must return an async iterator of
         msgpack-serializable responses (ref: component/endpoint.rs:61).
+
+        ``max_inflight`` bounds concurrent requests on this endpoint
+        (default ``DYN_WORKER_MAX_INFLIGHT``; 0 = unbounded): excess is
+        rejected at the ack with a typed TERMINAL "overloaded" stream error
+        — retryable-vs-terminal is what stops Migration from re-sending
+        into a saturated fleet (docs/robustness.md).
         """
         rt = self._runtime
         ns, comp, ep = self.component.namespace.name, self.component.name, self.name
         lease = lease_id if lease_id is not None else await rt.primary_lease()
         subject = instance_subject(ns, comp, ep, lease)
         inflight: set[asyncio.Task] = set()
+        if max_inflight is None:
+            max_inflight = getattr(rt.config, "worker_max_inflight", 0)
+
+        # slots reserved between admission and task creation: the awaited
+        # response-stream connect below yields, so a concurrent ack burst
+        # would otherwise all pass the len(inflight) check before any of
+        # them lands in the set — exceeding the cap exactly when it matters
+        reserved = [0]
 
         async def on_request(payload: bytes) -> bytes:
             envelope = msgpack.unpackb(payload, raw=False)
             ctx = Context.from_wire(envelope.get("ctx", {}))
+            # admission BEFORE opening the response stream: a shed request
+            # must be cheap for the worker (no socket, no handler task)
+            if max_inflight and len(inflight) + reserved[0] >= max_inflight:
+                return msgpack.packb({
+                    "ok": False, "code": "overloaded", "retryable": False,
+                    "error": f"worker at capacity ({max_inflight} in flight)"})
+            if ctx.expired:
+                return msgpack.packb({
+                    "ok": False, "code": "deadline", "retryable": False,
+                    "error": "request deadline expired before dispatch"})
             info = ConnectionInfo.from_wire(envelope["conn"])
             # Connect the response stream BEFORE acking so a worker that
             # cannot reach the requester fails the request instead of
             # leaving the requester waiting on a stream that never opens.
+            reserved[0] += 1
             try:
                 sender = await StreamSender.connect(info, ctx)
             except Exception as e:
                 logger.exception("failed to open response stream to %s:%s", info.host, info.port)
                 return msgpack.packb({"ok": False, "error": f"response stream connect failed: {e!r}"})
+            finally:
+                reserved[0] -= 1
             task = asyncio.get_running_loop().create_task(
                 _pump_handler(handler, envelope.get("req"), ctx, sender)
             )
@@ -198,7 +252,7 @@ class Endpoint:
 
         cancel_serve = await rt.plane.serve(subject, on_request)
         # in-process short-circuit path
-        rt._local_endpoints[subject] = (handler, inflight)
+        rt._local_endpoints[subject] = (handler, inflight, max_inflight)
 
         meta = dict(metadata or {})
         # under the k8s operator every pod gets DYN_POD_NAME; stamping it
@@ -252,6 +306,24 @@ async def _pump_handler(handler: EndpointHandler, request: Any, ctx: Context, se
         except asyncio.CancelledError:
             await sender.error("worker shutting down")
             raise
+        except ChaosError as e:
+            # injected transport loss: retryable by definition (migration's
+            # recovery path is exactly what chaos exists to exercise)
+            sp.status = "error"
+            sp.set(error=repr(e)[:200])
+            try:
+                await sender.error(f"chaos: {e}", retryable=True)
+            except Exception:
+                pass
+        except StreamError as e:
+            # typed failure from the handler (overload/deadline/transport):
+            # preserve its taxonomy across the hop
+            sp.status = "error"
+            sp.set(error=repr(e)[:200])
+            try:
+                await sender.error(str(e), code=e.code, retryable=e.retryable)
+            except Exception:
+                pass
         except Exception as e:
             logger.exception("endpoint handler failed")
             sp.status = "error"
@@ -281,6 +353,15 @@ class Client:
         self.endpoint = endpoint
         self._instances: dict[int, Instance] = {}
         self._down: set[int] = set()
+        # per-instance circuit breaker: consecutive transport failures; at
+        # _breaker_threshold the breaker is OPEN (instance also in _down).
+        # The canary success path (report_instance_up) HALF-closes an open
+        # breaker — one more failure reopens immediately, a real success
+        # (record_success) closes it.
+        self._fail_streak: dict[int, int] = {}
+        self._half_open: set[int] = set()
+        self._breaker_threshold = max(
+            1, getattr(runtime.config, "circuit_threshold", 3))
         # load-saturated workers (WorkerMonitor): skipped by rr/random
         # routing but NOT dead — distinct from _down so a recovered canary
         # can't accidentally clear a load signal or vice versa
@@ -332,9 +413,13 @@ class Client:
             d = msgpack.unpackb(value, raw=False)
             self._instances[iid] = Instance.from_wire(d)
             self._down.discard(iid)
+            self._fail_streak.pop(iid, None)  # fresh registration: closed
+            self._half_open.discard(iid)
         else:
             self._instances.pop(iid, None)
             self._down.discard(iid)
+            self._fail_streak.pop(iid, None)
+            self._half_open.discard(iid)
 
     def instance_ids(self) -> list[int]:
         return sorted(self._instances)
@@ -355,6 +440,15 @@ class Client:
             # NoResponders (the reference degrades the same way — busy is
             # backpressure, not failure)
             ids = set(self._instances) - self._down
+        if not ids and self._instances:
+            # every REGISTERED instance is marked down. Down-marking is a
+            # soft signal (a blipped stream under fault injection marks a
+            # perfectly live worker); lease loss is the authoritative death
+            # signal and would have removed the instance entirely. Routing
+            # to a down-but-registered instance as a last resort beats
+            # leaving the fleet unreachable until a canary runs — a real
+            # success then clears the mark (record_success).
+            ids = set(self._instances)
         return sorted(ids)
 
     def set_busy_instances(self, instance_ids) -> None:
@@ -365,12 +459,45 @@ class Client:
     def report_instance_down(self, instance_id: int):
         logger.warning("instance %x reported down", instance_id)
         self._down.add(instance_id)
+        if instance_id in self._half_open:
+            # trial traffic failed: reopen immediately, no fresh streak
+            self._half_open.discard(instance_id)
+            self._fail_streak[instance_id] = self._breaker_threshold
+            logger.warning("instance %x circuit breaker RE-OPENED "
+                           "(half-open trial failed)", instance_id)
+            return
+        streak = self._fail_streak.get(instance_id, 0) + 1
+        self._fail_streak[instance_id] = streak
+        if streak == self._breaker_threshold:
+            logger.warning("instance %x circuit breaker OPEN "
+                           "(%d consecutive failures)", instance_id, streak)
 
     def report_instance_up(self, instance_id: int):
-        """Restore a previously-down instance to the routable set."""
+        """Restore a previously-down instance to the routable set (the
+        canary success path). An OPEN breaker only HALF-closes here: the
+        instance takes trial traffic, but a single further failure reopens
+        it; a real success (record_success) closes it."""
         if instance_id in self._down:
             logger.info("instance %x restored", instance_id)
         self._down.discard(instance_id)
+        if self._fail_streak.get(instance_id, 0) >= self._breaker_threshold:
+            self._half_open.add(instance_id)
+
+    def record_success(self, instance_id: int):
+        """Real traffic reached the instance: fully close its breaker and
+        clear any stale down mark (self-healing without waiting for the
+        canary when last-resort routing succeeded)."""
+        self._fail_streak.pop(instance_id, None)
+        self._half_open.discard(instance_id)
+        self._down.discard(instance_id)
+
+    def breaker_state(self, instance_id: int) -> str:
+        """closed | half-open | open — for tests, metrics and dynctl."""
+        if instance_id in self._half_open:
+            return "half-open"
+        if self._fail_streak.get(instance_id, 0) >= self._breaker_threshold:
+            return "open"
+        return "closed"
 
     async def start_health_checks(self, payload=None):
         """Start a canary health-check manager on this client, with cadence
@@ -422,11 +549,23 @@ class Client:
     ) -> ResponseReceiver:
         """Issue a request; returns a receiver over the response stream."""
         ctx = ctx or Context()
+        if ctx.expired:
+            raise DeadlineExceededError(
+                "request deadline expired before dispatch")
         attempts = 0
         while True:
             inst = self._pick(mode, instance_id)
             try:
                 return await self._generate_to(inst, request, ctx)
+            except OverloadedError:
+                # the worker is alive and SHED the request — not a failure
+                # signal: don't mark it down / feed its breaker, just try
+                # another instance while the budget lasts
+                attempts += 1
+                if mode == "direct" or attempts > retries:
+                    raise
+            except DeadlineExceededError:
+                raise  # no instance can beat an expired clock
             except (NoRespondersError, StreamError):
                 # StreamError here is pre-stream (ack failed / worker could
                 # not open the response path) — safe to fail over, nothing
@@ -438,9 +577,26 @@ class Client:
 
     async def _generate_to(self, inst: Instance, request: Any, ctx: Context) -> ResponseReceiver:
         rt = self._runtime
+        chaos = get_chaos()
+        if chaos is not None:
+            # request-dispatch hook: pre-stream, so failover is always safe
+            try:
+                await chaos.pre("request.dispatch")
+                if chaos.should_drop("request.dispatch"):
+                    raise ChaosError("injected drop at request.dispatch")
+            except ChaosError as e:
+                raise StreamError(f"chaos: {e}") from e
         local = rt._local_endpoints.get(inst.subject)
         if local is not None:
-            handler, inflight = local
+            handler, inflight, max_inflight = local
+            # same admission/deadline contract as the remote ack path —
+            # in-process short-circuiting must not bypass overload shedding
+            if max_inflight and len(inflight) >= max_inflight:
+                raise OverloadedError(
+                    f"worker at capacity ({max_inflight} in flight)")
+            if ctx.expired:
+                raise DeadlineExceededError(
+                    "request deadline expired before dispatch")
             info, receiver, queue = make_local_stream(ctx)
             sender = StreamSender.local(queue)
             task = asyncio.get_running_loop().create_task(
@@ -448,6 +604,7 @@ class Client:
             )
             inflight.add(task)
             task.add_done_callback(inflight.discard)
+            self.record_success(inst.instance_id)
             return receiver
 
         server = await rt.response_server()
@@ -474,5 +631,8 @@ class Client:
         resp = msgpack.unpackb(ack, raw=False)
         if not resp.get("ok"):
             server.abandon_stream(info)
-            raise StreamError(resp.get("error", STREAM_ERR_MSG))
+            raise stream_error_from_wire(
+                resp.get("error", STREAM_ERR_MSG), resp.get("code"),
+                resp.get("retryable", True))
+        self.record_success(inst.instance_id)
         return receiver

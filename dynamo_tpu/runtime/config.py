@@ -28,6 +28,19 @@ Env surface:
 - ``DYN_LOGGING_JSONL``    — JSONL log lines when truthy.
 - ``DYN_CONFIG_FILE``      — path to a TOML/JSON file with the same keys
   (lower-case field names).
+
+Overload protection / robustness (docs/robustness.md):
+
+- ``DYN_REQUEST_DEADLINE``    — default e2e deadline seconds (frontend).
+- ``DYN_MAX_INFLIGHT`` / ``DYN_MAX_QUEUE`` — frontend admission caps
+  (total / per-model); excess gets 429 + ``Retry-After``.
+- ``DYN_WORKER_MAX_INFLIGHT`` — per-endpoint worker admission cap; excess
+  is rejected with a terminal "overloaded" stream error.
+- ``DYN_CIRCUIT_THRESHOLD``   — consecutive transport failures that open a
+  client's per-instance circuit breaker.
+- ``DYN_DRAIN_TIMEOUT``       — graceful SIGTERM drain bound (seconds).
+- ``DYN_CHAOS`` / ``DYN_CHAOS_SEED`` — seeded fault injection
+  (runtime/chaos.py spec grammar).
 """
 
 from __future__ import annotations
@@ -99,6 +112,24 @@ class RuntimeConfig:
     #: KV-load fraction above which routing skips a worker (WorkerMonitor);
     #: None = load monitoring off (ref: worker_monitor.rs busy_threshold)
     busy_threshold: Optional[float] = None
+    #: default end-to-end request deadline (seconds) applied by the frontend
+    #: when the client sends no ``X-Request-Timeout-Ms``; None = no deadline
+    request_deadline: Optional[float] = None
+    #: frontend admission: max concurrent in-flight HTTP LLM requests
+    #: (0 = unbounded); excess gets 429 + Retry-After
+    max_inflight: int = 0
+    #: frontend admission: max in-flight requests PER MODEL (0 = unbounded)
+    max_queue: int = 0
+    #: worker admission: max concurrent requests per served endpoint
+    #: (0 = unbounded); excess is rejected with a terminal "overloaded"
+    #: stream error so Migration does not burn its budget on a full fleet
+    worker_max_inflight: int = 0
+    #: consecutive transport failures that OPEN a client's per-instance
+    #: circuit breaker (canary success half-closes it; a real success closes)
+    circuit_threshold: int = 3
+    #: graceful SIGTERM drain bound (seconds): in-flight streams get this
+    #: long to finish before shutdown forces them
+    drain_timeout: float = 30.0
 
     def __post_init__(self):
         if self.busy_threshold is not None and not 0 < self.busy_threshold <= 1:
@@ -116,6 +147,17 @@ class RuntimeConfig:
                 "config field 'health_check_interval': must be > 0")
         if not self.namespace:
             raise ConfigError("config field 'namespace': must be non-empty")
+        if self.request_deadline is not None and self.request_deadline <= 0:
+            raise ConfigError(
+                "config field 'request_deadline': must be > 0")
+        for fname in ("max_inflight", "max_queue", "worker_max_inflight"):
+            if getattr(self, fname) < 0:
+                raise ConfigError(f"config field '{fname}': must be >= 0")
+        if self.circuit_threshold < 1:
+            raise ConfigError(
+                "config field 'circuit_threshold': must be >= 1")
+        if self.drain_timeout <= 0:
+            raise ConfigError("config field 'drain_timeout': must be > 0")
 
     # -- layered loading -----------------------------------------------------
 
@@ -173,7 +215,10 @@ class RuntimeConfig:
             except json.JSONDecodeError as e:
                 raise ConfigError(f"bad JSON in {path}: {e}") from None
         try:
-            import tomllib
+            try:
+                import tomllib  # 3.11+
+            except ModuleNotFoundError:
+                import tomli as tomllib  # 3.10 fallback
 
             return tomllib.loads(text)
         except Exception as e:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import secrets
+import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -29,7 +30,56 @@ STREAM_ERR_MSG = "stream disconnected"
 
 
 class StreamError(Exception):
-    """A response stream terminated abnormally (worker died / transport lost)."""
+    """A response stream terminated abnormally (worker died / transport lost).
+
+    Root of the error taxonomy (docs/robustness.md): ``retryable`` tells the
+    migration layer whether re-issuing the request can help. Transport loss
+    is retryable (another worker can finish the stream); typed terminal
+    conditions (overload shedding, deadline expiry) are not — retrying them
+    burns the migration budget against a fleet that will reject again.
+    """
+
+    #: taxonomy code carried on the wire ("overloaded", "deadline", None)
+    code: Optional[str] = None
+    retryable: bool = True
+
+    def __init__(self, msg: str = STREAM_ERR_MSG,
+                 code: Optional[str] = None,
+                 retryable: Optional[bool] = None):
+        super().__init__(msg)
+        if code is not None:
+            self.code = code
+        if retryable is not None:
+            self.retryable = retryable
+
+
+class TerminalStreamError(StreamError):
+    """A stream failure that re-sending cannot fix; Migration must not retry."""
+
+    retryable = False
+
+
+class OverloadedError(TerminalStreamError):
+    """The worker (or fleet) shed this request at admission (bounded queue)."""
+
+    code = "overloaded"
+
+
+class DeadlineExceededError(TerminalStreamError):
+    """The request's end-to-end deadline expired before/while serving it."""
+
+    code = "deadline"
+
+
+def stream_error_from_wire(msg: str, code: Optional[str],
+                           retryable: bool) -> StreamError:
+    """Rehydrate a typed stream error from an err-frame's fields so the
+    class (and therefore Migration's retry decision) survives the hop."""
+    if code == "overloaded":
+        return OverloadedError(msg)
+    if code == "deadline":
+        return DeadlineExceededError(msg)
+    return StreamError(msg, code=code, retryable=retryable)
 
 
 @dataclass
@@ -42,6 +92,11 @@ class Context:
     #: adopt the wire span id instead of parenting to a phantom. Local
     #: state, never serialized.
     traceparent_synthesized: bool = field(default=False, repr=False)
+    #: absolute end-to-end deadline on the LOCAL monotonic clock, or None.
+    #: Never serialized as an absolute value: to_wire/from_wire carry the
+    #: REMAINING budget in ms and re-anchor it to the receiver's clock, so
+    #: cross-host clock skew cannot poison downstream hops.
+    deadline: Optional[float] = None
     _cancel_event: asyncio.Event = field(default_factory=asyncio.Event, repr=False)
 
     def cancel(self) -> None:
@@ -54,9 +109,26 @@ class Context:
     async def wait_cancelled(self) -> None:
         await self._cancel_event.wait()
 
+    # -- deadline ------------------------------------------------------------
+
+    def set_timeout_ms(self, timeout_ms: float) -> None:
+        """Anchor the deadline ``timeout_ms`` from now (local monotonic)."""
+        self.deadline = time.monotonic() + max(0.0, timeout_ms) / 1000.0
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds of budget left (may be negative); None = no deadline."""
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() >= self.deadline
+
     def child(self) -> "Context":
-        """A child context sharing the cancellation token and id."""
-        c = Context(id=self.id, annotations=dict(self.annotations), traceparent=self.traceparent)
+        """A child context sharing the cancellation token, deadline and id."""
+        c = Context(id=self.id, annotations=dict(self.annotations),
+                    traceparent=self.traceparent, deadline=self.deadline)
         c._cancel_event = self._cancel_event
         return c
 
@@ -99,13 +171,23 @@ class Context:
         return f"{parts[0]}-{parts[1]}-{secrets.token_hex(8)}-{parts[3]}"
 
     def to_wire(self) -> dict:
-        return {"id": self.id, "annotations": self.annotations,
-                "traceparent": self.child_traceparent()}
+        d = {"id": self.id, "annotations": self.annotations,
+             "traceparent": self.child_traceparent()}
+        rem = self.remaining_s()
+        if rem is not None:
+            # remaining-ms, floored at 0: the receiver re-anchors to its own
+            # monotonic clock, so skew between hosts cannot extend or
+            # retro-expire the budget
+            d["deadline_ms"] = max(0, int(rem * 1000))
+        return d
 
     @staticmethod
     def from_wire(d: dict) -> "Context":
-        return Context(
+        ctx = Context(
             id=d.get("id") or uuid.uuid4().hex,
             annotations=d.get("annotations") or {},
             traceparent=d.get("traceparent"),
         )
+        if d.get("deadline_ms") is not None:
+            ctx.set_timeout_ms(float(d["deadline_ms"]))
+        return ctx

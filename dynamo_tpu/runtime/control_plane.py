@@ -38,6 +38,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Awaitable, Callable, Optional
 
+from dynamo_tpu.runtime.chaos import get_chaos
 from dynamo_tpu.runtime.codec import read_frame, write_frame
 
 logger = logging.getLogger("dynamo.control_plane")
@@ -384,6 +385,11 @@ class LocalControlPlane(ControlPlane):
 
     # -- Pub/sub --
     async def publish(self, subject, payload):
+        chaos = get_chaos()
+        if chaos is not None:
+            await chaos.pre("plane.publish")
+            if chaos.should_drop("plane.publish"):
+                return  # message loss: subscribers simply never see it
         groups: dict[str, list[asyncio.Queue]] = {}
         for pattern, qg, q in self._subs:
             if _subject_matches(pattern, subject):
@@ -1341,6 +1347,11 @@ class RemoteControlPlane(ControlPlane):
 
     # -- Pub/sub --
     async def publish(self, subject, payload):
+        chaos = get_chaos()
+        if chaos is not None:
+            await chaos.pre("plane.publish")
+            if chaos.should_drop("plane.publish"):
+                return  # injected loss before the hub ever sees the message
         await self._call("publish", subject=subject, payload=payload)
 
     async def subscribe(self, subject, queue_group=None) -> Subscription:

@@ -24,8 +24,14 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Optional
 
+from dynamo_tpu.runtime.chaos import ChaosError, get_chaos
 from dynamo_tpu.runtime.codec import pack_frame, read_frame, write_frame
-from dynamo_tpu.runtime.context import STREAM_ERR_MSG, Context, StreamError
+from dynamo_tpu.runtime.context import (
+    STREAM_ERR_MSG,
+    Context,
+    StreamError,
+    stream_error_from_wire,
+)
 
 logger = logging.getLogger("dynamo.response_plane")
 
@@ -88,7 +94,11 @@ class ResponseReceiver:
             elif t == "complete":
                 return
             elif t == "err":
-                raise StreamError(frame.get("msg", STREAM_ERR_MSG))
+                # typed rehydration: the error class (and so Migration's
+                # retry decision) survives the wire hop
+                raise stream_error_from_wire(
+                    frame.get("msg", STREAM_ERR_MSG), frame.get("code"),
+                    frame.get("retryable", True))
 
     async def cancel(self):
         """Tell the producing worker to stop."""
@@ -234,7 +244,21 @@ class StreamSender:
         s._queue = queue
         return s
 
+    @staticmethod
+    async def _chaos_gate() -> None:
+        """``stream.send`` chaos hook, shared by both transports. Runs
+        BEFORE anything is enqueued/written so a "dropped" batch is never
+        partially delivered — token accounting across a migration stays
+        exact. drop and error both kill the send (transport loss)."""
+        chaos = get_chaos()
+        if chaos is None:
+            return
+        await chaos.pre("stream.send")
+        if chaos.should_drop("stream.send"):
+            raise ChaosError("injected drop at stream.send")
+
     async def send(self, data: Any) -> None:
+        await self._chaos_gate()
         if self._queue is not None:
             await self._queue.put({"t": "data", "d": data})
         else:
@@ -246,6 +270,7 @@ class StreamSender:
         one drain) — the coalesced path for per-step token batches."""
         if not items:
             return
+        await self._chaos_gate()
         if self._queue is not None:
             for d in items:
                 await self._queue.put({"t": "data", "d": d})
@@ -279,13 +304,20 @@ class StreamSender:
             finally:
                 self._teardown()
 
-    async def error(self, msg: str) -> None:
+    async def error(self, msg: str, code: Optional[str] = None,
+                    retryable: bool = True) -> None:
+        """Terminate the stream with a typed error frame. ``retryable``
+        False marks the failure terminal (overload/deadline): the receiver
+        raises a TerminalStreamError and Migration will not re-send."""
         self._closed = True
+        frame = {"t": "err", "msg": msg, "retryable": retryable}
+        if code is not None:
+            frame["code"] = code
         if self._queue is not None:
-            _put_sentinel(self._queue, {"t": "err", "msg": msg})
+            _put_sentinel(self._queue, frame)
         else:
             try:
-                await write_frame(self._writer, {"t": "err", "msg": msg})
+                await write_frame(self._writer, frame)
             finally:
                 self._teardown()
 

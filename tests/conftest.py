@@ -61,3 +61,26 @@ def pytest_collection_modifyitems(config, items):
     if dropped:
         config.hook.pytest_deselected(items=dropped)
         items[:] = [it for it in items if not in_slow(it)]
+
+
+# ------------------------------------------------------------- chaos fixture
+# Seeded fault injection (dynamo_tpu/runtime/chaos.py). Usage:
+#
+#     async def test_x(chaos):
+#         inj = chaos("stream.send:drop=0.1;engine.step:error=0.05", seed=7)
+#         ... drive the stack; assert inj.counts afterwards ...
+#
+# The injector is GLOBAL (the hooks live in hot paths); the fixture
+# guarantees it is removed again so no other test inherits the faults.
+
+@pytest.fixture
+def chaos():
+    from dynamo_tpu.runtime.chaos import configure_chaos
+
+    def _install(spec: str, seed: int = 0):
+        return configure_chaos(spec, seed=seed)
+
+    try:
+        yield _install
+    finally:
+        configure_chaos(None)

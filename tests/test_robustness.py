@@ -1,0 +1,660 @@
+"""Overload protection + chaos layer: the recovery paths, proven in tier-1.
+
+Covers the robustness surface end-to-end (docs/robustness.md): deadline
+propagation across hops (remaining-ms wire encoding), 429 + Retry-After
+under admission caps, circuit breaker open/half-open/close, the
+retryable-vs-terminal stream error taxonomy in Migration, graceful drain,
+prefill-queue ticket hygiene, and the seeded chaos substrate — including
+the acceptance scenario: 10% response-plane drops + 5% engine-step errors
+with every request completing exactly, via migration/backoff, with zero
+duplicate or lost tokens.
+"""
+
+import asyncio
+import json
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.http import HttpService
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.pipeline import Migration
+from dynamo_tpu.mocker.engine import MockEngineArgs
+from dynamo_tpu.mocker.main import run_mocker
+from dynamo_tpu.protocols import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.chaos import (
+    ChaosInjector,
+    ChaosSpecError,
+    parse_chaos_spec,
+)
+from dynamo_tpu.runtime.component import Instance
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceededError,
+    OverloadedError,
+    StreamError,
+    stream_error_from_wire,
+)
+from dynamo_tpu.disagg.queue import PrefillQueueClient, PrefillQueueWorker
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.anyio
+
+MODEL = "mock-model"
+
+
+# --------------------------------------------------------------- unit layer
+
+
+def test_deadline_wire_roundtrip_is_skew_proof():
+    """to_wire carries REMAINING ms, from_wire re-anchors locally — an
+    absolute timestamp would break the moment two hosts' clocks disagree."""
+    ctx = Context()
+    assert ctx.remaining_s() is None and not ctx.expired
+    assert "deadline_ms" not in ctx.to_wire()
+
+    ctx.set_timeout_ms(500)
+    wire = ctx.to_wire()
+    assert 0 < wire["deadline_ms"] <= 500
+    hop = Context.from_wire(wire)
+    rem = hop.remaining_s()
+    assert rem is not None and 0 < rem <= 0.5
+    # child shares the deadline
+    assert abs(hop.child().deadline - hop.deadline) < 1e-9
+
+    expired = Context()
+    expired.set_timeout_ms(0)
+    assert expired.expired
+    assert expired.to_wire()["deadline_ms"] == 0
+    assert Context.from_wire(expired.to_wire()).expired
+
+
+def test_error_taxonomy_wire_roundtrip():
+    assert StreamError("x").retryable
+    assert not OverloadedError("x").retryable
+    assert not DeadlineExceededError("x").retryable
+    e = stream_error_from_wire("busy", "overloaded", True)
+    assert isinstance(e, OverloadedError) and not e.retryable
+    e = stream_error_from_wire("late", "deadline", True)
+    assert isinstance(e, DeadlineExceededError)
+    e = stream_error_from_wire("gone", None, True)
+    assert type(e) is StreamError and e.retryable
+    e = stream_error_from_wire("gone", None, False)
+    assert not e.retryable
+
+
+def test_chaos_spec_grammar():
+    rules = parse_chaos_spec(
+        "plane.publish:drop=0.1;stream.send:delay=50ms,error=0.2;"
+        "engine.step:error=0.05")
+    assert rules["plane.publish"].drop == 0.1
+    assert rules["stream.send"].delay_s == 0.05
+    assert rules["stream.send"].error == 0.2
+    assert rules["engine.step"].error == 0.05
+    assert parse_chaos_spec("a.b:delay=2s")["a.b"].delay_s == 2.0
+    for bad in ("nodelim", "hook:drop=2.0", "hook:wat=1", "hook:drop=x",
+                ":drop=0.1", "hook:delay=-5ms"):
+        with pytest.raises(ChaosSpecError):
+            parse_chaos_spec(bad)
+
+
+def test_chaos_seeded_determinism():
+    """Same spec + seed → identical decision sequence; different seed
+    diverges. This is what makes chaos tests reproducible."""
+    def run(seed):
+        inj = ChaosInjector.from_spec(
+            "stream.send:drop=0.3;engine.step:error=0.2", seed=seed)
+        return [(inj.should_drop("stream.send"),
+                 inj.should_error("engine.step")) for _ in range(200)]
+
+    a, b, c = run(7), run(7), run(8)
+    assert a == b
+    assert a != c
+    inj = ChaosInjector.from_spec("stream.send:drop=1.0", seed=0)
+    assert inj.should_drop("stream.send")
+    assert inj.counts[("stream.send", "drop")] == 1
+    # unknown hooks never fire
+    assert not inj.should_drop("plane.publish")
+
+
+async def test_migration_terminal_errors_not_retried():
+    """Typed terminal stream errors must not burn the migration budget."""
+    calls = []
+
+    async def overloaded(req, ctx):
+        calls.append(1)
+        raise OverloadedError("worker at capacity")
+        yield  # pragma: no cover
+
+    mig = Migration(overloaded, migration_limit=5)
+    with pytest.raises(OverloadedError):
+        async for _ in mig.generate(_req(), Context()):
+            pass
+    assert len(calls) == 1  # no retries
+
+    calls.clear()
+
+    async def dying(req, ctx):
+        calls.append(1)
+        raise StreamError("stream disconnected")
+        yield  # pragma: no cover
+
+    mig = Migration(dying, migration_limit=2)
+    with pytest.raises(StreamError):
+        async for _ in mig.generate(_req(), Context()):
+            pass
+    assert len(calls) == 3  # original + 2 retryable re-sends
+
+
+async def test_migration_backoff_exponential_jitter_capped(monkeypatch):
+    """The re-send delay is ~U(0, min(cap, base·2^attempt)) — assert the
+    upper bounds grow exponentially and saturate at the cap."""
+    bounds = []
+
+    def fake_uniform(lo, hi):
+        bounds.append((lo, hi))
+        return 0.0  # don't actually sleep in the test
+
+    monkeypatch.setattr("dynamo_tpu.llm.pipeline.random.uniform",
+                        fake_uniform)
+
+    async def dying(req, ctx):
+        raise StreamError("stream disconnected")
+        yield  # pragma: no cover
+
+    mig = Migration(dying, migration_limit=8)
+    with pytest.raises(StreamError):
+        async for _ in mig.generate(_req(), Context()):
+            pass
+    uppers = [hi for _lo, hi in bounds]
+    assert len(uppers) == 8
+    base, cap = Migration.BACKOFF_BASE_S, Migration.BACKOFF_CAP_S
+    for i, hi in enumerate(uppers):
+        assert hi == pytest.approx(min(cap, base * 2 ** (i + 1)))
+    assert uppers[-1] == cap  # saturated
+
+
+async def test_migration_deadline_bounds_retries():
+    """With an expired deadline the retry loop stops instead of sleeping:
+    no tokens emitted → DeadlineExceededError; tokens emitted → the stream
+    ends cleanly with the 'deadline' finish reason."""
+    async def dying(req, ctx):
+        raise StreamError("stream disconnected")
+        yield  # pragma: no cover
+
+    ctx = Context()
+    ctx.set_timeout_ms(0)
+    mig = Migration(dying, migration_limit=50)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceededError):
+        async for _ in mig.generate(_req(), ctx):
+            pass
+    assert time.monotonic() - t0 < 1.0  # no 50-retry backoff ladder
+
+    # one token, then permanent failure + expired deadline: clean finish
+    state = {"n": 0}
+
+    async def one_then_die(req, ctx):
+        if state["n"] == 0:
+            state["n"] += 1
+            yield LLMEngineOutput(token_ids=[5])
+        raise StreamError("stream disconnected")
+
+    ctx2 = Context()
+    ctx2.set_timeout_ms(0)
+    outs = []
+    async for out in Migration(one_then_die, migration_limit=50).generate(
+            _req(), ctx2):
+        outs.append(out)
+    assert outs[0].token_ids == [5]
+    assert outs[-1].finish_reason == FinishReason.DEADLINE
+
+
+async def test_migration_twice_keeps_original_token_budget():
+    """Regression (found by the chaos layer): remaining tokens must be
+    computed against the ORIGINAL max_tokens — the re-issued request's
+    max_tokens already shrank, and subtracting cumulative ``accumulated``
+    from it again truncated twice-migrated streams early."""
+    state = {"attempt": 0}
+
+    async def flaky(req, ctx):
+        state["attempt"] += 1
+        n = 0
+        for tok in range(100, 100 + (req.stop_conditions.max_tokens or 0)):
+            if state["attempt"] < 3 and n == 4:
+                raise StreamError("stream disconnected")  # die after 4 each
+            n += 1
+            last = n == req.stop_conditions.max_tokens
+            yield LLMEngineOutput(
+                token_ids=[tok],
+                finish_reason=FinishReason.LENGTH if last else None)
+
+    got = []
+    async for out in Migration(flaky, migration_limit=5).generate(
+            _req(max_tokens=12), Context()):
+        got.extend(out.token_ids)
+    assert state["attempt"] == 3
+    assert len(got) == 12  # 4 + 4 + 4-tail... exactly the original budget
+
+
+def _req(max_tokens=16):
+    return PreprocessedRequest(
+        model=MODEL, token_ids=[1, 2, 3],
+        stop_conditions=StopConditions(max_tokens=max_tokens))
+
+
+# ----------------------------------------------------------- breaker layer
+
+
+async def test_circuit_breaker_open_half_open_close():
+    rt = await DistributedRuntime.create()
+    try:
+        client = rt.namespace("ns").component("c").endpoint("e").client()
+        client._breaker_threshold = 3
+        iid, healthy = 0xAB, 0xCD
+        client._instances[iid] = Instance("ns", "c", "e", iid)
+        client._instances[healthy] = Instance("ns", "c", "e", healthy)
+
+        assert client.breaker_state(iid) == "closed"
+        for _ in range(3):
+            client.report_instance_down(iid)
+        assert client.breaker_state(iid) == "open"
+        assert iid not in client.available_ids()
+
+        # last-resort routing: when EVERY registered instance is down, the
+        # soft down marks yield rather than leaving the fleet unreachable
+        client.report_instance_down(healthy)
+        assert set(client.available_ids()) == {iid, healthy}
+        client.report_instance_up(healthy)
+        client.record_success(healthy)
+        assert client.available_ids() == [healthy]
+
+        # canary success HALF-closes: routable again, but on probation
+        client.report_instance_up(iid)
+        assert client.breaker_state(iid) == "half-open"
+        assert iid in client.available_ids()
+
+        # a single trial failure reopens immediately (no fresh 3-streak)
+        client.report_instance_down(iid)
+        assert client.breaker_state(iid) == "open"
+        assert client.available_ids() == [healthy]
+
+        # canary again, then REAL success fully closes
+        client.report_instance_up(iid)
+        assert client.breaker_state(iid) == "half-open"
+        client.record_success(iid)
+        assert client.breaker_state(iid) == "closed"
+
+        # below threshold, failures never open it
+        client.report_instance_down(iid)
+        client.report_instance_up(iid)
+        assert client.breaker_state(iid) == "closed"
+    finally:
+        await rt.shutdown()
+
+
+async def test_worker_admission_typed_overload_and_deadline():
+    """The endpoint sheds work above max_inflight with a TERMINAL
+    overloaded error, and refuses deadline-expired dispatch — on both the
+    in-process short-circuit and the remote (wire) path."""
+    rt = await DistributedRuntime.create()
+    try:
+        release = asyncio.Event()
+
+        async def slow_handler(request, ctx):
+            await release.wait()
+            yield {"ok": True, "remaining": ctx.remaining_s()}
+
+        ep = rt.namespace("ns").component("c").endpoint("gen")
+        handle = await ep.serve_endpoint(slow_handler, max_inflight=1)
+        client = await ep.client().start()
+
+        first = await client.generate({}, ctx=Context())
+        await asyncio.sleep(0.05)  # let the pump task start
+        with pytest.raises(OverloadedError) as ei:
+            await client.generate({}, ctx=Context())
+        assert not ei.value.retryable
+
+        expired = Context()
+        expired.set_timeout_ms(0)
+        with pytest.raises(DeadlineExceededError):
+            await client.generate({}, ctx=expired)
+
+        release.set()
+        frames = [f async for f in first]
+        assert frames and frames[0]["ok"]
+
+        # remote path: drop the in-process shortcut so the request goes
+        # through the control-plane ack — same typed rejections
+        subject = next(iter(rt._local_endpoints))
+        local = rt._local_endpoints.pop(subject)
+        expired2 = Context()
+        expired2.set_timeout_ms(0)
+        with pytest.raises(DeadlineExceededError):
+            await client.generate({}, ctx=expired2)
+        # deadline survives the wire: handler sees a re-anchored budget
+        ctx = Context()
+        ctx.set_timeout_ms(5000)
+        stream = await client.generate({}, ctx=ctx)
+        frames = [f async for f in stream]
+        assert frames and 0 < frames[0]["remaining"] <= 5.0
+        rt._local_endpoints[subject] = local
+        await client.stop()
+        await handle.stop(graceful=False)
+    finally:
+        await rt.shutdown()
+
+
+# ---------------------------------------------------------- queue hygiene
+
+
+async def test_prefill_queue_ticket_discard_and_claim_timeout():
+    import msgpack
+
+    rt = await DistributedRuntime.create()
+    try:
+        metrics = MetricsRegistry()
+        # an already-expired ticket is discarded loudly, not claimed
+        await rt.plane.queue_push("prefill_queue", msgpack.packb(
+            {"job_id": "deadbeef", "expires_at": time.time() - 5.0}))
+        worker = await PrefillQueueWorker(
+            rt.plane, instance_id=0x1, poll=0.01, metrics=metrics).start()
+        for _ in range(100):
+            if worker.discarded:
+                break
+            await asyncio.sleep(0.01)
+        assert worker.discarded == 1 and worker.claims == 0
+        assert "dynamo_prefill_tickets_discarded_total 1" in metrics.render()
+        await worker.stop()
+
+        # client: claim wait is capped by the request's remaining deadline
+        client = PrefillQueueClient(rt.plane, claim_timeout=30.0,
+                                    metrics=metrics)
+        ctx = Context()
+        ctx.set_timeout_ms(150)
+        t0 = time.monotonic()
+        assert await client.acquire(ctx) is None  # nobody pops: timeout
+        assert time.monotonic() - t0 < 5.0  # NOT the flat 30 s
+        assert client.claim_timeouts == 1
+        assert "dynamo_prefill_claim_timeouts_total 1" in metrics.render()
+
+        # fully spent budget: no ticket is even enqueued (the timed-out
+        # acquire above legitimately left its own ticket behind)
+        depth_before = await rt.plane.queue_depth("prefill_queue")
+        spent = Context()
+        spent.set_timeout_ms(0)
+        assert await client.acquire(spent) is None
+        assert await rt.plane.queue_depth("prefill_queue") == depth_before
+    finally:
+        await rt.shutdown()
+
+
+# --------------------------------------------------------------- e2e layer
+
+
+def mock_args(**kw):
+    from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+
+    kw.setdefault("vocab_size", make_test_tokenizer().vocab_size)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_gpu_blocks", 256)
+    kw.setdefault("speedup_ratio", 20.0)
+    return MockEngineArgs(**kw)
+
+
+@pytest.fixture
+async def stack():
+    """One runtime, N mockers (added by tests), watcher + HTTP service."""
+    rt = await DistributedRuntime.create()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager, router_mode="kv").start()
+    service = HttpService(manager, port=0)
+    await service.start()
+    engines = []
+
+    async def add_mocker(migration_limit=None, **kw):
+        lease = await rt.plane.lease_create(30)
+        (engine,), (handle,) = await run_mocker(
+            rt, MODEL, mock_args(**kw), lease_id=lease,
+            migration_limit=migration_limit)
+        engines.append((engine, handle))
+        return engine, handle
+
+    try:
+        yield rt, service, add_mocker, manager
+    finally:
+        await service.stop()
+        await watcher.stop()
+        for engine, handle in engines:
+            await handle.stop(graceful=False)
+            await engine.stop()
+        await rt.shutdown()
+
+
+async def wait_for_model(manager: ModelManager, timeout=5.0):
+    for _ in range(int(timeout / 0.05)):
+        if manager.get(MODEL):
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError("model never appeared")
+
+
+async def test_expired_request_rejected_408_never_reaches_engine(stack):
+    rt, service, add_mocker, manager = stack
+    engine, _ = await add_mocker()
+    await wait_for_model(manager)
+    base = f"http://127.0.0.1:{service.port}"
+    body = {"model": MODEL, "prompt": [1, 2, 3], "max_tokens": 4}
+
+    async with aiohttp.ClientSession() as http:
+        async with http.post(f"{base}/v1/completions", json=body,
+                             headers={"X-Request-Timeout-Ms": "0"}) as r:
+            assert r.status == 408
+            payload = await r.json()
+            assert payload["error"]["type"] == "deadline_exceeded"
+        # the engine never saw the request: no work was ever admitted
+        assert engine.iterations == 0
+        assert not engine.waiting and not engine.running
+
+        # a sane deadline completes normally end-to-end
+        async with http.post(f"{base}/v1/completions", json=body,
+                             headers={"X-Request-Timeout-Ms": "30000"}) as r:
+            assert r.status == 200
+            out = (await r.json())["usage"]["completion_tokens"]
+            assert out >= 1
+
+
+async def test_deadline_expires_mid_stream_finish_reason_deadline(stack):
+    rt, service, add_mocker, manager = stack
+    # slow decode (~10 ms/token) so a 250 ms budget expires mid-generation
+    await add_mocker(speedup_ratio=0.2)
+    await wait_for_model(manager)
+    base = f"http://127.0.0.1:{service.port}"
+    body = {"model": MODEL, "prompt": [1, 2, 3], "max_tokens": 500,
+            "ignore_eos": True, "stream": True}
+
+    finishes, n_tokens = [], 0
+    async with aiohttp.ClientSession() as http:
+        async with http.post(f"{base}/v1/completions", json=body,
+                             headers={"X-Request-Timeout-Ms": "250"}) as r:
+            assert r.status == 200
+            async for raw in r.content:
+                line = raw.decode()
+                if not line.startswith("data: ") or "[DONE]" in line:
+                    continue
+                payload = json.loads(line[6:])
+                assert "error" not in payload, payload
+                ch = payload["choices"][0]
+                if ch.get("text"):
+                    n_tokens += 1
+                if ch.get("finish_reason"):
+                    finishes.append(ch["finish_reason"])
+    assert finishes == ["deadline"]
+    assert 0 < n_tokens < 500  # partial output, then a clean deadline stop
+
+
+async def test_admission_cap_429_with_retry_after(stack):
+    rt, service, add_mocker, manager = stack
+    await add_mocker(speedup_ratio=0.05)  # slow: first request stays in flight
+    await wait_for_model(manager)
+    service.max_inflight = 1
+    base = f"http://127.0.0.1:{service.port}"
+    slow_body = {"model": MODEL, "prompt": [1, 2, 3], "max_tokens": 400,
+                 "ignore_eos": True, "stream": True}
+
+    async with aiohttp.ClientSession() as http:
+        first = asyncio.ensure_future(
+            http.post(f"{base}/v1/completions", json=slow_body))
+        for _ in range(100):
+            if service._inflight_count >= 1:
+                break
+            await asyncio.sleep(0.01)
+        assert service._inflight_count == 1
+
+        # request N+1: shed with OpenAI-style 429 + Retry-After
+        async with http.post(f"{base}/v1/completions", json={
+                "model": MODEL, "prompt": [1], "max_tokens": 2}) as r:
+            assert r.status == 429
+            assert r.headers.get("Retry-After") == "1"
+            payload = await r.json()
+            assert payload["error"]["type"] == "overloaded"
+        # rejection metric exported
+        text = (service.metrics.render())
+        assert "dynamo_http_requests_rejected_total" in text
+
+        resp = await first
+        resp.close()
+
+        # per-model queue cap uses the same contract
+        service.max_inflight = 0
+        service.max_queue = 1
+        second = asyncio.ensure_future(
+            http.post(f"{base}/v1/completions", json=slow_body))
+        for _ in range(100):
+            if service._model_inflight.get(MODEL, 0) >= 1:
+                break
+            await asyncio.sleep(0.01)
+        async with http.post(f"{base}/v1/completions", json={
+                "model": MODEL, "prompt": [1], "max_tokens": 2}) as r:
+            assert r.status == 429
+        (await second).close()
+
+
+async def test_worker_shed_surfaces_as_429_through_router(stack):
+    """Fleet saturation end-to-end: the worker sheds with a typed terminal
+    OverloadedError, the KV router must NOT evict the healthy worker or
+    launder the error into a retryable one, Migration must not retry, and
+    the frontend returns the same 429 + Retry-After as frontend admission."""
+    rt, service, add_mocker, manager = stack
+    rt.config.worker_max_inflight = 1  # applies to endpoints served after
+    await add_mocker(speedup_ratio=0.05)
+    await wait_for_model(manager)
+    base = f"http://127.0.0.1:{service.port}"
+    slow = {"model": MODEL, "prompt": [1, 2, 3], "max_tokens": 400,
+            "ignore_eos": True, "stream": True}
+
+    async with aiohttp.ClientSession() as http:
+        first = asyncio.ensure_future(
+            http.post(f"{base}/v1/completions", json=slow))
+        served = manager.get(MODEL)
+        for _ in range(100):  # wait until the slow request occupies the slot
+            if any(len(inflight) >= 1 for _h, inflight, _cap
+                   in rt._local_endpoints.values()):
+                break
+            await asyncio.sleep(0.01)
+
+        async with http.post(f"{base}/v1/completions", json={
+                "model": MODEL, "prompt": [1], "max_tokens": 2}) as r:
+            assert r.status == 429, await r.text()
+            assert r.headers.get("Retry-After") == "1"
+            assert (await r.json())["error"]["type"] == "overloaded"
+        # the shedding worker is healthy: not marked down, still routable
+        assert not served.client._down
+        assert served.client.available_ids()
+        (await first).close()
+
+
+async def test_mocker_waiting_queue_deadline_sweep():
+    """A request starved in the WAITING queue behind a saturated batch must
+    finish with 'deadline' when its budget expires — not hang for a slot."""
+    from dynamo_tpu.mocker.engine import MockEngine
+
+    eng = await MockEngine(mock_args(max_num_seqs=1,
+                                     speedup_ratio=50.0)).start()
+    try:
+        hog_ctx = Context()
+        hog = eng.generate(_req(max_tokens=10_000), hog_ctx)
+        await hog.__anext__()  # hog is admitted and generating
+
+        starved_ctx = Context()
+        starved_ctx.set_timeout_ms(100)
+        outs = []
+        async for wire in eng.generate(_req(max_tokens=4), starved_ctx):
+            outs.append(LLMEngineOutput.from_wire(wire))
+        assert outs[-1].finish_reason == FinishReason.DEADLINE
+        hog_ctx.cancel()
+        await hog.aclose()
+    finally:
+        await eng.stop()
+
+
+async def test_drain_stops_admission(stack):
+    rt, service, add_mocker, manager = stack
+    await add_mocker()
+    await wait_for_model(manager)
+    base = f"http://127.0.0.1:{service.port}"
+
+    await service.drain(timeout=0.2)
+    async with aiohttp.ClientSession() as http:
+        async with http.get(f"{base}/health") as r:
+            assert r.status == 503
+            assert (await r.json())["status"] == "draining"
+        async with http.post(f"{base}/v1/completions", json={
+                "model": MODEL, "prompt": [1], "max_tokens": 2}) as r:
+            assert r.status == 503
+            assert r.headers.get("Retry-After") == "1"
+
+
+async def test_chaos_e2e_all_requests_complete_exactly(stack, chaos):
+    """THE acceptance scenario: 10% response-plane drops + 5% engine-step
+    errors (fixed seed). Every request must complete through migration +
+    backoff with EXACTLY max_tokens completion tokens — zero duplicate or
+    lost tokens — and the injector must actually have fired."""
+    rt, service, add_mocker, manager = stack
+    await add_mocker(migration_limit=100)
+    await wait_for_model(manager)
+    inj = chaos("stream.send:drop=0.1;engine.step:error=0.05", seed=12345)
+    base = f"http://127.0.0.1:{service.port}"
+    N_REQ, OSL = 6, 12
+
+    async def one(i):
+        body = {"model": MODEL, "prompt": [10 + i, 11, 12, 13],
+                "max_tokens": OSL, "ignore_eos": True}
+        async with http.post(f"{base}/v1/completions", json=body) as r:
+            assert r.status == 200, await r.text()
+            return await r.json()
+
+    async with aiohttp.ClientSession() as http:
+        results = await asyncio.gather(*[one(i) for i in range(N_REQ)])
+
+    for res in results:
+        # exact accounting: migration resumed with accumulated tokens, so
+        # the total is neither short (lost) nor long (duplicated)
+        assert res["usage"]["completion_tokens"] == OSL
+        assert res["choices"][0]["finish_reason"] == "length"
+        assert len(res["choices"][0]["text"]) > 0
+    # the run wasn't vacuously clean: faults fired
+    assert sum(inj.counts.values()) > 0, inj.counts
+
+
+async def test_chaos_off_by_default():
+    from dynamo_tpu.runtime.chaos import get_chaos
+
+    assert get_chaos() is None
