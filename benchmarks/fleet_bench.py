@@ -34,9 +34,12 @@ from dynamo_tpu.router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.runtime.control_plane import ControlPlaneServer, RemoteControlPlane
 
 BLOCK_SIZE = 16
-#: blocks announced per stored event. 8 = a 128-token prefill chunk
-#: (conservative); --chain 125 models per-REQUEST batching of an ISL-2000
-#: prefill — the publish-batching lever the 70B sizing note relies on.
+#: blocks announced per stored event. The engine now batches per REQUEST
+#: by default (scheduler.commit_computed; DYN_KV_EVENT_PER_CHUNK=1 restores
+#: per-chunk), so production traffic looks like --chain 125 (an ISL-2000
+#: prefill). 8 = the old per-128-token-chunk behavior, kept as the default
+#: here so the CONSERVATIVE ceiling stays on record; pass --chain 125 for
+#: the deployed shape (docs/PERF_NOTES.md has both measurements).
 CHAIN = 8
 
 

@@ -193,9 +193,14 @@ class PrefillQueueWorker:
 
 def engine_capacity_gate(engine, max_waiting: int = 0):
     """Default gate: take work only while the engine's waiting queue is at
-    or below ``max_waiting`` (admission backlog = stop popping)."""
+    or below ``max_waiting`` (admission backlog = stop popping). Swapped
+    sequences count as backlog too — they hold no device blocks but WILL
+    reclaim capacity before new admissions, so claiming more prefill
+    tickets while the swapped queue is populated only deepens the KV
+    pressure that parked them."""
 
     def gate() -> bool:
-        return engine.scheduler.num_waiting() <= max_waiting
+        sched = engine.scheduler
+        return (sched.num_waiting() + len(sched.swapped)) <= max_waiting
 
     return gate

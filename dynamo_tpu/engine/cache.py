@@ -17,6 +17,7 @@ the router's radix index relies on (ref: kv_router/indexer.rs).
 from __future__ import annotations
 
 import logging
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -142,6 +143,76 @@ def packed_block_width(block_size: int, KV: int, hd: int) -> int:
     return block_size * KV * (hd + 4)
 
 
+class SwapStore:
+    """Byte-budgeted accounting for sequences' KV swapped out to host DRAM.
+
+    Preempt-to-swap stages a victim's device pages in host memory (as the
+    same value/packed quant bundles the KVBM G2 tier and the disagg wire
+    carry) instead of throwing the KV away and re-prefilling. This class
+    owns ONLY the budget arithmetic — buffers live on the engine's per-
+    sequence swap entries; the scheduler asks reserve() before a swap-out
+    and falls back to recompute preemption when the answer is no.
+
+    ``external_used`` shares the budget with the KVBM host tier: when the
+    engine runs G2 offload and swap against one DRAM allowance, available
+    swap bytes = budget − swap-reserved − G2-resident (and the G2 tier's
+    puts symmetrically evict down to budget − swap-reserved — HostTier's
+    own ``external_used`` hook, wired by the engine). Thread-safe: the
+    reserve happens on the event loop, the release can come from the
+    offload worker threads' completion callbacks.
+    """
+
+    def __init__(self, budget_bytes: int,
+                 external_used: Optional[Callable[[], int]] = None,
+                 make_room: Optional[Callable[[int], None]] = None):
+        self.budget = max(0, int(budget_bytes))
+        self.external_used = external_used
+        #: fn(target_bytes): ask the external consumer to shrink to
+        #: ``target_bytes`` — without it, a G2 prefix cache that has
+        #: naturally filled the shared allowance (LRU caches always do)
+        #: would turn every reserve() into a permanent miss and silently
+        #: disable swap in exactly the flagship KVBM deployment. G2's
+        #: redundant cache copies yield to live-sequence KV.
+        self.make_room = make_room
+        self.used = 0  # bytes reserved by live swap entries
+        self._lock = threading.Lock()
+
+    def _external(self) -> int:
+        # a lock-free attribute read on the G2 tier (never a lock
+        # acquisition): safe under our lock, and the residual race with a
+        # concurrent G2 put is bounded by one block because the tier
+        # enforces the shared budget from its side too
+        if self.external_used is None:
+            return 0
+        try:
+            return int(self.external_used())
+        except Exception:  # a broken G2 probe must not wedge swap
+            logger.exception("swap external_used probe failed")
+            return 0
+
+    def reserve(self, nbytes: int) -> bool:
+        with self._lock:
+            ext = self._external()
+            avail = self.budget - self.used - ext
+            if avail < nbytes and self.make_room is not None and ext > 0:
+                # evict the external LRU down far enough that this
+                # reservation fits (kvbm takes its own lock; it never
+                # takes ours, so the ordering is acyclic)
+                try:
+                    self.make_room(max(0, ext - (nbytes - avail)))
+                except Exception:
+                    logger.exception("swap make_room failed")
+                avail = self.budget - self.used - self._external()
+            if avail < nbytes:
+                return False
+            self.used += nbytes
+            return True
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.used = max(0, self.used - nbytes)
+
+
 @dataclass
 class BlockMeta:
     block_id: int
@@ -176,6 +247,19 @@ class BlockPool:
         self._by_hash: dict[SequenceHash, int] = {}
         #: inactive (refcount 0) cached blocks, LRU order (oldest first)
         self._lru: "OrderedDict[SequenceHash, int]" = OrderedDict()
+        #: blocks' worth of KV currently parked on HOST by preempt-to-swap —
+        #: accounting DISTINCT from the LRU prefix cache above: these blocks
+        #: are NOT device-resident (their device ids were released) but their
+        #: sequences are live and will re-allocate on swap-in
+        self.swapped_blocks = 0
+
+    # -- swap accounting ---------------------------------------------------
+
+    def note_swapped_out(self, n: int) -> None:
+        self.swapped_blocks += n
+
+    def note_swapped_in(self, n: int) -> None:
+        self.swapped_blocks = max(0, self.swapped_blocks - n)
 
     # -- capacity ----------------------------------------------------------
 

@@ -392,6 +392,32 @@ class EngineArgs:
     kvbm_host_bytes: int = 0
     kvbm_disk_dir: Optional[str] = None
     kvbm_disk_bytes: int = 0
+    #: preempt-to-swap: under KV pressure the scheduler swaps a victim's
+    #: device pages to host DRAM (gather → host bundle, same value/packed
+    #: quant format the G2 tier carries) and swaps them back before the
+    #: sequence's next step, instead of releasing the blocks and
+    #: re-prefilling from scratch. Recompute preemption remains the
+    #: fallback when the host-byte budget is exhausted or a bundle is torn
+    #: down. Disabled automatically under multi-host step replication.
+    preempt_swap: bool = True
+    #: host-byte budget for swapped-out KV. None = share the G2 tier's
+    #: budget when kvbm_host_bytes > 0 (available swap bytes shrink as G2
+    #: fills), else a standalone 1 GiB allowance.
+    swap_host_bytes: Optional[int] = None
+    #: publish one KV stored event per prefill CHUNK instead of one per
+    #: request. Per-request batching is the default — per-chunk publishing
+    #: measured 11% under the 70B fleet's stored-blocks/s requirement
+    #: (docs/PERF_NOTES.md fleet_bench table: 47.3k vs 53k needed; per-
+    #: request reaches 119.5k). None = read the DYN_KV_EVENT_PER_CHUNK
+    #: env escape hatch (unset/0/false = batched).
+    kv_event_per_chunk: Optional[bool] = None
+    #: speculative-decode auto-disable: when the rolling measured gain over
+    #: spec_gain_window verify dispatches stays < 1 (drafts cost more than
+    #: they accept — BENCH_r05: accept 0.019, gain 0.729, a 27% slowdown
+    #: with nothing turning it off), fall back to plain decode and re-probe
+    #: after spec_reprobe_steps engine steps. 0 disables the governor.
+    spec_gain_window: int = 64
+    spec_reprobe_steps: int = 4096
     #: on-device weight quantization: None (model dtype) | "int8" (per-out-
     #: channel) | "int8-gN" / "int4-gN" (grouped, N along the contraction
     #: dim). Weights stay quantized in HBM; dequant rides the matmul
@@ -422,6 +448,9 @@ class EngineArgs:
                 and self.speculative_draft_layers < 1):
             raise ValueError("speculative_method='draft_layers' needs "
                              "speculative_draft_layers >= 1")
+        if self.kv_event_per_chunk is None:
+            self.kv_event_per_chunk = os.environ.get(
+                "DYN_KV_EVENT_PER_CHUNK", "").lower() not in ("", "0", "false")
         if self.kv_cache_dtype not in (None, "auto", "int8"):
             # an unknown value silently serving full-precision would run a
             # deployment at half its planned KV capacity — fail loudly

@@ -29,7 +29,8 @@ from typing import AsyncIterator, Callable, Optional
 import numpy as np
 
 from dynamo_tpu.engine.cache import (
-    BlockPool, NULL_BLOCK, allocate_device_cache, hbm_sized_num_blocks,
+    BlockPool, NULL_BLOCK, SwapStore, allocate_device_cache,
+    hbm_sized_num_blocks,
 )
 from dynamo_tpu.engine.config import EngineArgs, ModelConfig
 from dynamo_tpu.engine.scheduler import Scheduler, SeqState, StepPlan
@@ -42,6 +43,31 @@ from dynamo_tpu.router.protocols import (
 )
 
 logger = logging.getLogger("dynamo.engine")
+
+#: standalone preempt-to-swap host budget when no G2 tier is configured
+DEFAULT_SWAP_HOST_BYTES = 1 << 30
+
+
+class _SwapEntry:
+    """One swapped-out sequence's host-side KV bundle + budget reservation.
+
+    Lifecycle: created (gather dispatched, budget reserved) → ready (host
+    copy landed) → freed (swap-in consumed it, or teardown). ``dropped``
+    marks a teardown that raced the in-flight copy — the copy task frees
+    the reservation when it completes."""
+
+    __slots__ = ("n", "nbytes", "k", "v", "ready", "failed", "freed",
+                 "dropped")
+
+    def __init__(self, n: int, nbytes: int):
+        self.n = n              # device blocks captured
+        self.nbytes = nbytes    # reserved against the SwapStore budget
+        self.k = None           # host bundle [L, n, bs, KV, hd] or packed
+        self.v = None
+        self.ready = False
+        self.failed = False
+        self.freed = False
+        self.dropped = False
 
 
 def _has_penalties(s) -> bool:
@@ -156,9 +182,38 @@ class AsyncJaxEngine:
 
         self.pool = BlockPool(nb, args.enable_prefix_caching,
                               on_removed=self._on_removed)
+        #: preempt-to-swap: host staging for preempted sequences' KV
+        #: (scheduler-driven swap-out/swap-in replacing recompute). Budget
+        #: shares the G2 tier's allowance when one is configured. Disabled
+        #: under multi-host step replication: the gather/scatter dispatches
+        #: are leader-local and would desync the follower replay.
+        self._swap: Optional[SwapStore] = None
+        if args.preempt_swap and not self._multihost:
+            budget = args.swap_host_bytes
+            shared = room = None
+            if budget is None:
+                if self.kvbm is not None:
+                    budget = args.kvbm_host_bytes
+                    shared = lambda: self.kvbm.host.used  # noqa: E731
+                    # a full G2 LRU yields DRAM to swap reservations —
+                    # without this, steady-state offload traffic would
+                    # permanently starve swap of the shared allowance
+                    room = self.kvbm.make_host_room
+                else:
+                    budget = DEFAULT_SWAP_HOST_BYTES
+            self._swap = SwapStore(budget, external_used=shared,
+                                   make_room=room)
+            if shared is not None:
+                # both directions of the shared allowance: G2 puts evict
+                # down to (budget − swap reservations), so combined host
+                # residency stays inside the ONE configured budget
+                self.kvbm.host.external_used = lambda: self._swap.used
+        self.swap_out_blocks = 0
+        self.swap_in_blocks = 0
         self.scheduler = Scheduler(
             args, self.pool, on_stored=self._on_stored,
-            onboard_cb=self._onboard if self.kvbm is not None else None)
+            onboard_cb=self._onboard if self.kvbm is not None else None,
+            swapper=self if self._swap is not None else None)
         if self._pp > 1:
             from dynamo_tpu.parallel.pipeline import make_pp_step_fn
             self.step_fn = make_pp_step_fn(
@@ -202,6 +257,14 @@ class AsyncJaxEngine:
                         replicate_outputs=self._multihost,
                         kv_quant=self._kv_quant)
         self.spec_stats = SpecDecodeStats()
+        #: speculative-decode auto-disable governor: rolling emitted-tokens
+        #: window; when the measured gain stays < 1 the engine falls back to
+        #: plain decode and re-probes after spec_reprobe_steps
+        self._spec_window: "collections.deque" = collections.deque(
+            maxlen=max(1, args.spec_gain_window))
+        self._spec_resume_step = 0
+        self.spec_disabled_total = 0
+        self.spec_measured_gain: Optional[float] = None
         from dynamo_tpu.engine import sampling as S
         self._sampling = S
 
@@ -1276,6 +1339,7 @@ class AsyncJaxEngine:
         ids, lps = await asyncio.to_thread(
             lambda: (np.asarray(ids), np.asarray(lps)))
 
+        total_emitted = 0
         for i, s in enumerate(seqs):
             d = drafts[i]
             accepted = 0
@@ -1293,8 +1357,57 @@ class AsyncJaxEngine:
             self.spec_stats.num_draft_tokens += len(d)
             self.spec_stats.num_accepted_tokens += min(accepted, emitted)
             self.spec_stats.num_spec_tokens += emitted
+            total_emitted += emitted
         self.param_reads += 1
+        self._note_spec_result(total_emitted, len(seqs))
         return True
+
+    # ------------------------------------------ spec auto-disable governor
+
+    def _spec_active(self) -> bool:
+        """False while the governor has speculative decode suspended (the
+        rolling measured gain fell below 1 — drafting was a net slowdown).
+        Re-probes automatically once ``spec_reprobe_steps`` steps pass."""
+        return self.steps >= self._spec_resume_step
+
+    def _spec_dispatch_cost(self) -> float:
+        """Estimated dispatch cost of one draft+verify round relative to a
+        plain decode step (both read the weights once; layer-skip drafting
+        adds draft_layers/num_layers of a forward per drafted token)."""
+        args = self.args
+        if (args.speculative_method == "draft_layers"
+                and args.speculative_draft_layers > 0):
+            return 1.0 + (args.speculative_tokens
+                          * args.speculative_draft_layers
+                          / max(1, self.cfg.num_layers))
+        return 1.05  # prompt lookup: free drafts, small verify overhead
+
+    def _note_spec_result(self, emitted: int, n_seqs: int) -> None:
+        """Feed the governor one verify dispatch's outcome. When the mean
+        tokens-per-dispatch over the window, discounted by the dispatch
+        cost, stays under 1.0 (BENCH_r05: accept 0.019 → gain 0.729, a 27%
+        slowdown with nothing turning it off), suspend speculation and
+        re-probe after spec_reprobe_steps engine steps."""
+        if self.args.spec_gain_window <= 0:
+            return
+        self._spec_window.append(emitted / max(1, n_seqs))
+        if len(self._spec_window) < (self._spec_window.maxlen or 1):
+            return
+        gain = (sum(self._spec_window) / len(self._spec_window)
+                / self._spec_dispatch_cost())
+        self.spec_measured_gain = gain
+        if gain < 1.0:
+            self.spec_disabled_total += 1
+            self._spec_resume_step = (self.steps
+                                      + max(1, self.args.spec_reprobe_steps))
+            self._spec_window.clear()
+            logger.warning(
+                "speculative decode suspended: measured gain %.3f < 1 over "
+                "%d dispatches (accept rate %.3f); re-probing after %d "
+                "steps", gain, self.args.spec_gain_window,
+                self.spec_stats.num_accepted_tokens
+                / max(1, self.spec_stats.num_draft_tokens),
+                self.args.spec_reprobe_steps)
 
     async def _run_decode(self, seqs: list[SeqState]) -> None:
         # Burst/spec paths gate on the DECODE SUBSET only — not on a
@@ -1308,7 +1421,7 @@ class AsyncJaxEngine:
         # (~bounded TTFT cost) and buys K× fewer host round trips.
         # (plan.decode already contains only remaining==1 seqs — the
         # scheduler guarantees it, no per-step re-check needed)
-        if (self.verify_fn is not None and seqs
+        if (self.verify_fn is not None and seqs and self._spec_active()
                 and all(s.sampling_tuple()[0] == 0.0 for s in seqs)
                 and all(s.req.output_options.logprobs is None for s in seqs)
                 and all(not s.req.sampling_options.logit_bias for s in seqs)
@@ -1392,7 +1505,9 @@ class AsyncJaxEngine:
             return False
         if self.multi_fn is not None or self.verify_fn is not None:
             return False
-        if self.scheduler.waiting or self.scheduler._aborted:
+        # swapped seqs need plan() to run their swap-in admission promptly
+        if (self.scheduler.waiting or self.scheduler.swapped
+                or self.scheduler._aborted):
             return False
         # a running seq still mid-prefill needs plan() interleaving
         if len(seqs) != len(self.scheduler.running):
@@ -1523,7 +1638,8 @@ class AsyncJaxEngine:
                 if committed is not None:
                     await self._commit_decode_step(committed)
                 if (done >= self.PIPELINE_REPLAN_STEPS or self._closed
-                        or self.scheduler.waiting or self.scheduler._aborted
+                        or self.scheduler.waiting or self.scheduler.swapped
+                        or self.scheduler._aborted
                         or any(s.finished is not None for s in seqs)
                         or any(getattr(s.ctx, "cancelled", False)
                                for s in seqs)):
@@ -1991,6 +2107,184 @@ class AsyncJaxEngine:
                 next(self._event_id), parent, stored))
         return ids
 
+    # ------------------------------------------------------ preempt-to-swap
+    #
+    # The scheduler's swapper backend: under KV pressure a victim's device
+    # pages move to host DRAM (swap_out) and return before its next planned
+    # step (swap_in) instead of being recomputed from scratch. Bundles ride
+    # the SAME formats the G2 tier and the disagg wire use — value arrays
+    # for plain caches, packed (q, s) uint8 for int8 caches — so the
+    # round-trip is bit-exact by construction for both.
+
+    def _swap_block_bytes(self) -> int:
+        """Host bytes one swapped block costs (k + v, actual n — the pow2
+        gather padding is sliced off before the bundle is retained)."""
+        cached = getattr(self, "_swap_blk_bytes", None)
+        if cached is not None:
+            return cached
+        from dynamo_tpu.engine.cache import (
+            cache_shape, is_quant_cache, packed_block_width,
+        )
+
+        bs = self.args.block_size
+        total = 0
+        for cache in (self.k_cache, self.v_cache):
+            L, _slots, KV, hd = cache_shape(cache)
+            if is_quant_cache(cache):
+                total += L * packed_block_width(bs, KV, hd)  # uint8
+            else:
+                total += L * bs * KV * hd * cache.dtype.itemsize
+        self._swap_blk_bytes = total
+        return total
+
+    def swap_out(self, seq: SeqState) -> bool:
+        """Stage ``seq``'s computed KV on host; True = the scheduler may
+        release its device blocks and park it in the swapped queue.
+
+        The gathers are dispatched HERE, synchronously, against the current
+        immutable cache arrays — device program order guarantees they read
+        the pages before any later step reuses the slots, so the blocks are
+        free for reallocation the moment this returns (same capacity
+        timing as recompute preemption). Only the device→host copy runs
+        async, overlapped with the next steps exactly like _spawn_offload.
+        """
+        from dynamo_tpu.ops.block_copy import gather_blocks
+
+        bs = self.args.block_size
+        n = (seq.num_computed + bs - 1) // bs  # blocks holding computed KV
+        if n <= 0 or n > len(seq.block_table):
+            return False
+        nbytes = n * self._swap_block_bytes()
+        if not self._swap.reserve(nbytes):
+            return False  # host budget exhausted → recompute fallback
+        entry = _SwapEntry(n, nbytes)
+        try:
+            ids = seq.block_table[:n]
+            kb = gather_blocks(self.k_cache, ids, block_size=bs)
+            vb = gather_blocks(self.v_cache, ids, block_size=bs)
+        except Exception:
+            logger.exception("swap-out gather dispatch failed for %s",
+                             seq.request_id)
+            self._swap.release(nbytes)
+            return False
+        seq.swap = entry
+        self.swap_out_blocks += n
+        self.pool.note_swapped_out(n)
+
+        async def copy():
+            try:
+                def work():
+                    # contiguous copies, not views: a view would pin the
+                    # whole pow2-padded gather buffer past the budget
+                    entry.k = np.ascontiguousarray(np.asarray(kb)[:, :n])
+                    entry.v = np.ascontiguousarray(np.asarray(vb)[:, :n])
+
+                await asyncio.to_thread(work)
+                entry.ready = True
+            except Exception:
+                logger.exception("swap-out host copy failed for %s",
+                                 seq.request_id)
+                entry.failed = True
+                self._swap_free(entry)
+            finally:
+                if entry.dropped:
+                    self._swap_free(entry)
+                self._wake.set()  # a ready bundle can unblock plan()
+
+        task = asyncio.get_running_loop().create_task(copy())
+        self._offload_tasks.add(task)
+        task.add_done_callback(self._offload_tasks.discard)
+        return True
+
+    def swap_status(self, seq: SeqState) -> str:
+        entry = seq.swap
+        if entry is None or entry.failed or entry.freed:
+            return "failed"
+        return "ready" if entry.ready else "pending"
+
+    def swap_in(self, seq: SeqState) -> bool:
+        """Scatter the host bundle back into the freshly allocated block
+        table. No host sync needed: the scatter produces the new cache
+        arrays the next jitted step consumes, so device data dependencies
+        order it before any read of those pages."""
+        from dynamo_tpu.ops.block_copy import scatter_blocks
+
+        entry: _SwapEntry = seq.swap
+        if (entry is None or not entry.ready or entry.failed or entry.freed
+                or len(seq.block_table) < entry.n):
+            return False
+        bs = self.args.block_size
+        ids = seq.block_table[:entry.n]
+        try:
+            self.k_cache = scatter_blocks(self.k_cache, ids, entry.k,
+                                          block_size=bs)
+            self.v_cache = scatter_blocks(self.v_cache, ids, entry.v,
+                                          block_size=bs)
+        except Exception:
+            logger.exception("swap-in scatter failed for %s", seq.request_id)
+            entry.failed = True
+            self.pool.note_swapped_in(entry.n)
+            self._swap_free(entry)
+            seq.swap = None
+            return False
+        self.swap_in_blocks += entry.n
+        self.pool.note_swapped_in(entry.n)
+        self._swap_free(entry)
+        seq.swap = None
+        # re-register the returning full blocks so the prefix cache serves
+        # them again; fresh registrations (hash no longer resident via the
+        # LRU) are re-announced so the router's radix view heals
+        stored: list[StoredBlock] = []
+        stored_ids: list[int] = []
+        parent = None
+        for i in range(min(seq.num_registered_blocks, entry.n)):
+            blk = seq.hashes.blocks[i]
+            if self.pool.register(seq.block_table[i], blk.sequence_hash,
+                                  blk.block_hash, blk.parent_sequence_hash):
+                if not stored:
+                    parent = blk.parent_sequence_hash
+                stored.append(StoredBlock(block_hash=blk.sequence_hash,
+                                          tokens_hash=blk.block_hash))
+                stored_ids.append(seq.block_table[i])
+        if stored:
+            self._on_stored(parent, stored, stored_ids)
+        return True
+
+    def swap_drop(self, seq: SeqState) -> None:
+        """Cancel-safe teardown: free the bundle + budget (or mark the
+        in-flight copy to free itself on completion)."""
+        entry: _SwapEntry = seq.swap
+        if entry is None:
+            return
+        seq.swap = None
+        entry.dropped = True
+        self.pool.note_swapped_in(entry.n)
+        if entry.ready or entry.failed:
+            self._swap_free(entry)
+
+    def _swap_free(self, entry: "_SwapEntry") -> None:
+        if entry.freed:
+            return
+        entry.freed = True
+        entry.k = entry.v = None
+        self._swap.release(entry.nbytes)
+
+    def swap_stats(self) -> dict:
+        """Telemetry for /metrics (engine/main.py gauge/counter callbacks)."""
+        sched = self.scheduler
+        return {
+            "swap_out_blocks": self.swap_out_blocks,
+            "swap_in_blocks": self.swap_in_blocks,
+            "preempt_swap": sched.preempt_swap_total,
+            "preempt_recompute": sched.preempt_recompute_total,
+            "swap_in_seqs": sched.swap_in_total,
+            "recomputed_tokens": sched.recomputed_tokens_total,
+            "swapped_seqs": len(sched.swapped),
+            "swapped_blocks": self.pool.swapped_blocks,
+            "swap_host_bytes": self._swap.used if self._swap else 0,
+            "swap_host_budget": self._swap.budget if self._swap else 0,
+        }
+
     def _on_removed(self, seq_hashes) -> None:
         if self.event_cb is None:
             return
@@ -2008,7 +2302,9 @@ class AsyncJaxEngine:
             worker_stats=WorkerStats(
                 request_active_slots=len(sched.running),
                 request_total_slots=self.args.max_num_seqs,
-                num_requests_waiting=sched.num_waiting(),
+                # swapped seqs count as waiting load: they hold no device
+                # blocks but WILL reclaim capacity before new admissions
+                num_requests_waiting=sched.num_waiting() + len(sched.swapped),
                 data_parallel_rank=self.dp_rank,
                 moe_dropped_tokens=MOE_DROPS["total"],
             ),

@@ -157,6 +157,16 @@ async def amain():
     ap.add_argument("--allow-test-metadata", action="store_true",
                     help="permit the toy tokenizer + eos=[2] defaults when no "
                          "--model-path is given (tests only)")
+    ap.add_argument("--no-preempt-swap", dest="preempt_swap",
+                    action="store_false", default=True,
+                    help="disable preempt-to-swap (KV of preempted "
+                         "sequences staged in host DRAM and swapped back "
+                         "instead of recomputed); preemption then always "
+                         "releases + re-prefills")
+    ap.add_argument("--swap-host-gb", type=float, default=None,
+                    help="host-byte budget for swapped-out KV (default: "
+                         "share the G2 tier budget when --kvbm-host-gb is "
+                         "set, else 1 GiB)")
     ap.add_argument("--kvbm-host-gb", type=float, default=0.0,
                     help="host-DRAM KV tier size (0 = off)")
     ap.add_argument("--kvbm-disk-dir", default=None)
@@ -264,6 +274,9 @@ async def amain():
         kvbm_host_bytes=int(cli.kvbm_host_gb * (1 << 30)),
         kvbm_disk_dir=cli.kvbm_disk_dir,
         kvbm_disk_bytes=int(cli.kvbm_disk_gb * (1 << 30)),
+        preempt_swap=cli.preempt_swap,
+        swap_host_bytes=(int(cli.swap_host_gb * (1 << 30))
+                         if cli.swap_host_gb is not None else None),
         quantization=cli.quantization,
         kv_cache_dtype=cli.kv_cache_dtype,
         pipeline_decode=cli.pipeline_decode,
@@ -381,6 +394,41 @@ async def amain():
         runtime.metrics.gauge(
             f"engine_step_{fld}",
             "engine step trace (sliding window)").add_callback(_trace_cb(fld))
+
+    # preempt-to-swap telemetry (docs/performance.md): swap volume, the
+    # swap-vs-recompute preemption split, and the host bytes the swapped
+    # bundles hold — scraped from the engine's own monotonic totals
+    def _swap_cb(field):
+        return lambda: {None: engine.swap_stats()[field]}
+
+    for name, fld, help_ in (
+            ("swap_out_blocks_total", "swap_out_blocks",
+             "KV blocks swapped out to the host tier by preemption"),
+            ("swap_in_blocks_total", "swap_in_blocks",
+             "KV blocks swapped back to device from the host tier"),
+            ("preempt_swap_total", "preempt_swap",
+             "preemptions resolved by swap-out (KV preserved)"),
+            ("swap_in_seqs_total", "swap_in_seqs",
+             "swapped-out sequences re-activated by swap-in"),
+            ("preempt_recompute_total", "preempt_recompute",
+             "preemptions resolved by release-and-recompute (including "
+             "swap-outs whose swap-in later fell back)"),
+            ("preempt_recomputed_tokens_total", "recomputed_tokens",
+             "tokens discarded by recompute preemptions (re-prefilled)")):
+        runtime.metrics.counter(name, help_).add_callback(_swap_cb(fld))
+    runtime.metrics.gauge(
+        "swap_host_bytes",
+        "host bytes held by swapped-out KV bundles").add_callback(
+        _swap_cb("swap_host_bytes"))
+    runtime.metrics.gauge(
+        "swapped_blocks",
+        "KV blocks currently host-resident via preempt-to-swap").add_callback(
+        _swap_cb("swapped_blocks"))
+    runtime.metrics.counter(
+        "spec_disabled_total",
+        "times the engine auto-suspended losing speculative "
+        "decode").add_callback(
+        lambda: {None: engine.spec_disabled_total})
 
     component = cli.component or (
         "prefill" if cli.role == "prefill" else "backend")

@@ -6,7 +6,12 @@ scheduler (ref: lib/llm/src/mocker/scheduler.rs:240 — admission watermark,
 chunked prefill budget, preemption; vLLM-style recompute preemption):
 
 - A sequence's lifecycle: waiting → running (prefill chunks → decode steps)
-  → finished. ``num_computed`` counts tokens whose KV is in the paged cache;
+  → finished, with a ``swapped`` station between waiting and running:
+  preempted victims whose KV was staged to host DRAM (preempt-to-swap) park
+  there and re-enter ``running`` at their old progress once blocks free up —
+  only when the host budget is exhausted (or a bundle is torn down) does a
+  victim fall back to the classic release-and-recompute path.
+- ``num_computed`` counts tokens whose KV is in the paged cache;
   ``remaining = len(tokens) - num_computed``; remaining==1 means the next
   step computes the last token's KV and samples (decode); remaining>1 means
   a prefill chunk (which also samples iff it reaches the end).
@@ -71,6 +76,15 @@ class SeqState:
     #: disagg pipelining: called with (num_computed) after each prefill chunk
     #: commits — lets the owner ship finished blocks while later chunks run
     progress_cb: Optional[Callable] = None
+    #: preempt-to-swap: the engine's host-side swap entry while this seq's
+    #: KV lives off-device (None = not swapped)
+    swap: object = None
+    #: per-request KV-event batching: stored blocks accumulated across
+    #: prefill chunks, flushed as ONE chained event when the prompt
+    #: completes (or at finish/preemption) — docs/PERF_NOTES.md fleet_bench
+    pending_stored: list = field(default_factory=list)
+    pending_stored_ids: list = field(default_factory=list)
+    pending_parent: object = None
 
     @property
     def remaining(self) -> int:
@@ -111,7 +125,8 @@ class Scheduler:
 
     def __init__(self, args: EngineArgs, pool: BlockPool,
                  on_stored: Optional[Callable] = None,
-                 onboard_cb: Optional[Callable] = None):
+                 onboard_cb: Optional[Callable] = None,
+                 swapper: Optional[object] = None):
         self.args = args
         self.pool = pool
         self.on_stored = on_stored  # fn(parent_hash, [StoredBlock], [block_id])
@@ -119,11 +134,30 @@ class Scheduler:
         #: — KVBM onboard hook: device-misses found in host/disk tiers come
         #: back as freshly scattered device blocks extending the prefix hit
         self.onboard_cb = onboard_cb
+        #: preempt-to-swap backend (the engine): swap_out(seq) -> bool,
+        #: swap_status(seq) -> "ready"|"pending"|"failed", swap_in(seq) ->
+        #: bool, swap_drop(seq). None = recompute preemption only.
+        self.swapper = swapper
         self.waiting: deque[SeqState] = deque()
         self.running: list[SeqState] = []
+        #: swapped-out victims, FIFO — between waiting and running; swap-in
+        #: admission runs BEFORE _admit so a resumed sequence reclaims its
+        #: old position instead of queueing behind fresh prompts
+        self.swapped: deque[SeqState] = deque()
         self._aborted: set = set()  # reaped at next plan() like cancellation
         self.prefix_hit_tokens = 0
         self.prefix_query_tokens = 0
+        #: one KV stored event per REQUEST (prefill chunks accumulate on the
+        #: seq and flush when the prompt completes) unless per-chunk
+        #: publishing was explicitly requested
+        self._batch_events = not args.kv_event_per_chunk
+        # preemption telemetry (→ dynamo_preempt_{swap,recompute}_total)
+        self.preempt_swap_total = 0
+        self.preempt_recompute_total = 0
+        self.swap_in_total = 0
+        #: prompt+generated tokens thrown away by recompute preemptions —
+        #: each will be re-prefilled (the waste swap-based preemption kills)
+        self.recomputed_tokens_total = 0
 
     # -- api ----------------------------------------------------------------
 
@@ -143,7 +177,7 @@ class Scheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self.swapped)
 
     def num_waiting(self) -> int:
         return len(self.waiting)
@@ -151,6 +185,7 @@ class Scheduler:
     def plan(self) -> StepPlan:
         """Admission + one prefill chunk + the decode batch."""
         self._reap_cancelled()
+        self._swap_in_pass()
         self._admit()
         plan = StepPlan()
 
@@ -186,12 +221,18 @@ class Scheduler:
             # serialize one-prefill-per-step.
             prefill_seqs = [s for s in self.running if s.remaining > 1]
             s_bucket = None
+            # chunks must fit the LARGEST compiled prefill bucket: with
+            # custom buckets coarser than max_num_batched_tokens, an
+            # unclamped chunk (e.g. a recompute re-prefill of prompt +
+            # generated tokens) would overflow the padded batch row
+            cap = min(self.args.max_num_batched_tokens,
+                      self.args.prefill_buckets[-1])
             for s in prefill_seqs:
                 if s not in self.running:
                     continue  # preempted by an earlier iteration's victim pick
-                chunk = min(s.remaining, max(0, budget))
+                chunk = min(s.remaining, max(0, budget), cap)
                 if not self.args.enable_chunked_prefill and chunk < s.remaining:
-                    if s.remaining > self.args.max_num_batched_tokens:
+                    if s.remaining > cap:
                         # can never fit in one unchunked step: fail it rather
                         # than wedge the prefill queue forever
                         self.finish(s, FinishReason.ERROR)
@@ -234,7 +275,15 @@ class Scheduler:
     # -- post-step bookkeeping ----------------------------------------------
 
     def commit_computed(self, seq: SeqState, new_num_computed: int) -> None:
-        """Advance num_computed; hash/register/event newly-filled blocks."""
+        """Advance num_computed; hash/register/event newly-filled blocks.
+
+        KV stored events batch PER REQUEST by default: chunks of a long
+        prompt accumulate on the sequence and publish as one chained event
+        when the prompt completes (decode-filled blocks still publish as
+        they register — they arrive one per block_size tokens). Per-chunk
+        publishing measured 11% under the 70B fleet's stored-blocks/s
+        requirement; per-request has 2.3× headroom (docs/PERF_NOTES.md).
+        """
         old = seq.num_computed
         seq.num_computed = new_num_computed
         seq.hashes.extend(seq.tokens[len(seq.hashes): new_num_computed])
@@ -255,8 +304,40 @@ class Scheduler:
                                           tokens_hash=blk.block_hash))
                 stored_ids.append(bid)
         seq.num_registered_blocks = full
-        if stored and self.on_stored:
+        if not self.on_stored:
+            return
+        if self._batch_events and new_num_computed < seq.prompt_len:
+            # mid-prompt chunk: park the delta; a later chunk (or finish/
+            # preempt) flushes the whole chain in one event
+            if stored:
+                if not seq.pending_stored:
+                    seq.pending_parent = parent
+                seq.pending_stored.extend(stored)
+                seq.pending_stored_ids.extend(stored_ids)
+            return
+        if seq.pending_stored:
+            # consecutive blocks of one sequence: earlier chunks' blocks
+            # chain straight into this one's, under the FIRST chunk's
+            # parent. This path must run even when THIS commit registered
+            # no new full block (a prompt whose tail is a partial block):
+            # prompt completion is the flush point either way.
+            stored = seq.pending_stored + stored
+            stored_ids = seq.pending_stored_ids + stored_ids
+            parent = seq.pending_parent
+            seq.pending_stored, seq.pending_stored_ids = [], []
+            seq.pending_parent = None
+        if stored:
             self.on_stored(parent, stored, stored_ids)
+
+    def _flush_stored(self, seq: SeqState) -> None:
+        """Publish any batched-but-unflushed stored blocks. Must run BEFORE
+        the seq's blocks are released (finish/preempt): the offload hook
+        pins the block ids synchronously."""
+        if seq.pending_stored and self.on_stored:
+            self.on_stored(seq.pending_parent, seq.pending_stored,
+                           seq.pending_stored_ids)
+        seq.pending_stored, seq.pending_stored_ids = [], []
+        seq.pending_parent = None
 
     def append_token(self, seq: SeqState, token: int) -> None:
         seq.tokens.append(token)
@@ -285,8 +366,11 @@ class Scheduler:
 
     def finish(self, seq: SeqState, reason: str) -> None:
         seq.finished = reason
+        self._flush_stored(seq)
         if seq in self.running:
             self.running.remove(seq)
+        if seq.swap is not None and self.swapper is not None:
+            self.swapper.swap_drop(seq)
         if not seq.hold_blocks:
             self.pool.release(seq.block_table)
             seq.block_table = []
@@ -350,6 +434,75 @@ class Scheduler:
                 self.waiting.remove(s)
                 s.sink.put_nowait(LLMEngineOutput(
                     finish_reason=FinishReason.DEADLINE))
+        for s in list(self.swapped):
+            # cancel-safe teardown: a swapped seq holds NO device blocks,
+            # only a host bundle + budget reservation — drop both
+            if dead(s) or expired(s):
+                self._aborted.discard(id(s))
+                self.swapped.remove(s)
+                if self.swapper is not None:
+                    self.swapper.swap_drop(s)
+                if dead(s):
+                    s.finished = FinishReason.CANCELLED
+                    s.sink.put_nowait(None)
+                else:
+                    s.finished = FinishReason.DEADLINE
+                    s.sink.put_nowait(LLMEngineOutput(
+                        finish_reason=FinishReason.DEADLINE))
+
+    def _swap_in_pass(self) -> None:
+        """Re-activate swapped-out sequences (FIFO) when capacity returns.
+
+        Swap-in admission charges ``_ensure_blocks`` for the sequence's
+        whole resident prefix BEFORE re-activation (plus one token of
+        headroom so the imminent decode/prefill step cannot immediately
+        re-preempt it), and runs before ``_admit`` so a resumed sequence
+        takes priority over fresh prompts — it resumes at its old progress
+        instead of re-prefilling behind the queue.
+        """
+        if self.swapper is None:
+            return
+        while self.swapped and len(self.running) < self.args.max_num_seqs:
+            seq = self.swapped[0]
+            st = self.swapper.swap_status(seq)
+            if st == "pending":
+                break  # host copy still in flight; FIFO order preserved
+            if st != "ready":
+                # bundle torn down / copy failed: recompute fallback
+                self.swapped.popleft()
+                logger.warning("swap-in of %s unavailable (%s); falling "
+                               "back to recompute", seq.request_id, st)
+                self.swapper.swap_drop(seq)  # reclaim budget/accounting
+                # the preemption counted as swap at swap-out time, but it
+                # RESOLVED by recompute — count that too, or dashboards
+                # read a 100% swap success while recomputed tokens climb
+                self.preempt_recompute_total += 1
+                self.recomputed_tokens_total += seq.num_computed
+                self._reset_for_recompute(seq)
+                self.waiting.appendleft(seq)
+                continue
+            bs = self.args.block_size
+            need = (seq.num_computed + bs) // bs  # ceil((computed+1)/bs)
+            free_after = self.pool.num_free_blocks - need
+            if free_after < 0 or (self.running and free_after
+                                  < self.args.watermark * self.pool.num_blocks):
+                break  # not enough room yet — wait, don't thrash
+            self.swapped.popleft()
+            if not self._ensure_blocks(seq, seq.num_computed + 1):
+                self.swapped.appendleft(seq)
+                break
+            if not self.swapper.swap_in(seq):
+                self.pool.release(seq.block_table)
+                seq.block_table = []
+                self.preempt_recompute_total += 1  # resolved by recompute
+                self.recomputed_tokens_total += seq.num_computed
+                self._reset_for_recompute(seq)
+                self.waiting.appendleft(seq)
+                continue
+            self.swap_in_total += 1
+            # old position: ahead of every later admission, and victim
+            # selection (newest-first) reaches it last
+            self.running.insert(0, seq)
 
     def _admit(self) -> None:
         bs = self.args.block_size
@@ -422,15 +575,45 @@ class Scheduler:
         return False
 
     def _preempt(self, seq: SeqState) -> None:
-        logger.warning("preempting request %s (recompute)", seq.request_id)
+        """Evict a victim to free KV blocks: swap its resident pages to the
+        host tier when the swapper accepts (budget available), else the
+        classic release-and-recompute. Either way the victim's device
+        blocks return to the pool THIS plan — the swap gather is dispatched
+        against the immutable current cache array before release."""
+        self._flush_stored(seq)  # blocks are still resident: pinnable
+        if (self.swapper is not None and seq.num_computed > 0
+                and seq.block_table and self.swapper.swap_out(seq)):
+            logger.info("preempting request %s (swap-out, %d tokens)",
+                        seq.request_id, seq.num_computed)
+            self.pool.release(seq.block_table)
+            seq.block_table = []
+            seq.preemptions += 1
+            self.preempt_swap_total += 1
+            if seq in self.running:
+                self.running.remove(seq)
+            self.swapped.append(seq)
+            return
+        if seq.num_computed > 0:
+            # a zero-progress victim (admitted, nothing computed) discards
+            # no KV — requeueing it is free and counts as neither a swap
+            # nor a recompute preemption
+            logger.warning("preempting request %s (recompute)",
+                           seq.request_id)
+            self.preempt_recompute_total += 1
+            self.recomputed_tokens_total += seq.num_computed
         self.pool.release(seq.block_table)
         seq.block_table = []
+        self._reset_for_recompute(seq)
+        seq.preemptions += 1
+        if seq in self.running:
+            self.running.remove(seq)
+        self.waiting.appendleft(seq)
+
+    def _reset_for_recompute(self, seq: SeqState) -> None:
+        """Zero a sequence's computed-KV bookkeeping so admission re-runs
+        its prefill from scratch (the recompute-preemption path)."""
         seq.num_computed = 0
         seq.num_registered_blocks = 0
         seq.num_cached_prompt = 0
         seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
                                         salt_hash=self._salt_for(seq.req))
-        seq.preemptions += 1
-        if seq in self.running:
-            self.running.remove(seq)
-        self.waiting.appendleft(seq)

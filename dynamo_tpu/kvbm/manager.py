@@ -237,6 +237,20 @@ class KvbmManager:
             self._notify([], None)
         self._drain_remote()
 
+    def make_host_room(self, target_bytes: int) -> None:
+        """Evict host-tier LRU entries until ``used <= target_bytes``
+        (cascading into disk/remote like any other eviction), WITHOUT
+        changing the configured capacity. The preempt-to-swap path calls
+        this when a swap reservation doesn't fit the shared DRAM
+        allowance: G2 entries are redundant cache copies (re-fetchable or
+        merely re-computable), strictly less valuable than a live
+        sequence's KV that would otherwise be discarded and re-prefilled."""
+        with self._lock:
+            removed = self._cascade(
+                self.host.evict_to_capacity(max(0, int(target_bytes))))
+            self._notify([], removed)
+        self._drain_remote()
+
     def resize_host(self, capacity_bytes: int) -> None:
         """Change the host-tier byte budget at runtime; shrinking evicts LRU
         entries (cascading into disk when configured)."""

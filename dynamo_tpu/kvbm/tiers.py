@@ -20,12 +20,30 @@ logger = logging.getLogger("dynamo.kvbm")
 
 
 class HostTier:
-    """G2: host-DRAM LRU block store with a byte budget."""
+    """G2: host-DRAM LRU block store with a byte budget.
 
-    def __init__(self, capacity_bytes: int):
+    ``external_used`` (callable → bytes) makes the budget SHARED with
+    another host-DRAM consumer (preempt-to-swap reservations): puts evict
+    down to ``capacity − external`` so the combined residency stays inside
+    the one allowance from both directions — the SwapStore's reserve()
+    subtracts this tier's ``used``, and this tier's put() subtracts the
+    swap reservations.
+    """
+
+    def __init__(self, capacity_bytes: int, external_used=None):
         self.capacity = capacity_bytes
         self.used = 0
+        self.external_used = external_used
         self._store: "OrderedDict[int, tuple[np.ndarray, np.ndarray]]" = OrderedDict()
+
+    def _external(self) -> int:
+        if self.external_used is None:
+            return 0
+        try:
+            return int(self.external_used())
+        except Exception:  # a broken probe must not wedge offload
+            logger.exception("host tier external_used probe failed")
+            return 0
 
     def __contains__(self, h: int) -> bool:
         return h in self._store
@@ -39,9 +57,10 @@ class HostTier:
             self._store.move_to_end(h)
             return []
         size = k.nbytes + v.nbytes
-        if size > self.capacity:
-            return []  # can never fit: drop without flushing the tier
-        evicted = self.evict_to_capacity(self.capacity - size)
+        budget = self.capacity - self._external()
+        if size > budget:
+            return []  # can never fit right now: drop without flushing
+        evicted = self.evict_to_capacity(budget - size)
         self._store[h] = (k, v)
         self.used += size
         return evicted

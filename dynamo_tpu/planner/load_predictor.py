@@ -95,7 +95,11 @@ class SeasonalPredictor(BasePredictor):
     def __init__(self, window: int = 256, period: int = 0, **kw):
         super().__init__(window=window, **kw)
         self.period = period
-        self._ar = ArimaPredictor()
+        # the AR fallback must see the SAME window: dropping the kwarg left
+        # it at ArimaPredictor's 64-sample default, so a wide-window
+        # seasonal predictor forecast from a narrower history whenever the
+        # period was not yet established (advisor round-5 finding)
+        self._ar = ArimaPredictor(window=window, **kw)
 
     def add_data_point(self, value: float) -> None:
         super().add_data_point(value)

@@ -34,11 +34,32 @@ def _fmt_labels(labels: dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
+def _merge_callback_values(values: dict, callbacks: list, name: str) -> dict:
+    """Fold scrape-time callback samples into ``values`` (shared by Counter
+    and Gauge render). Each callback returns dict[labels, value]; ``labels``
+    is None (no labels) or a TUPLE of (name, value) pairs — a dict cannot
+    key a dict. Keys must be None or ((name, value), ...) pairs — an
+    iterable of anything else (e.g. a bare string, whose sort would
+    silently yield characters) is a caller bug."""
+    for cb in callbacks:
+        try:
+            for labels, v in cb().items():
+                key = (() if labels is None else
+                       tuple(sorted((str(n), str(lv))
+                                    for n, lv in labels)))
+                values[key] = v
+        except Exception:
+            logging.getLogger("dynamo.metrics").exception(
+                "metric %s scrape callback failed", name)
+    return values
+
+
 class Counter:
     def __init__(self, name: str, help_: str):
         self.name = name
         self.help = help_
         self._values: dict[tuple, float] = {}
+        self._callbacks: list = []
         self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0, **labels):
@@ -46,9 +67,19 @@ class Counter:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def add_callback(self, fn):
+        """fn() -> dict[labels, value] evaluated at scrape time (the
+        _merge_callback_values contract, shared with Gauge). For monotonic
+        totals OWNED elsewhere (e.g. the engine's swap/preempt counters) —
+        the callback value replaces the stored sample so the series stays
+        a true counter."""
+        self._callbacks.append(fn)
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for key, v in sorted(self._values.items()):
+        values = _merge_callback_values(dict(self._values), self._callbacks,
+                                        self.name)
+        for key, v in sorted(values.items()):
             lines.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return "\n".join(lines)
 
@@ -67,27 +98,14 @@ class Gauge:
             self._values[key] = value
 
     def add_callback(self, fn):
-        """fn() -> dict[labels, value] evaluated at scrape time; ``labels``
-        is None (no labels) or a TUPLE of (name, value) pairs — a dict
-        cannot key a dict, which the old contract implied."""
+        """fn() -> dict[labels, value] evaluated at scrape time (the
+        _merge_callback_values contract, shared with Counter)."""
         self._callbacks.append(fn)
 
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        values = dict(self._values)
-        for cb in self._callbacks:
-            try:
-                for labels, v in cb().items():
-                    # keys must be None or ((name, value), ...) pairs — an
-                    # iterable of anything else (e.g. a bare string, whose
-                    # sort would silently yield characters) is a caller bug
-                    key = (() if labels is None else
-                           tuple(sorted((str(n), str(lv))
-                                        for n, lv in labels)))
-                    values[key] = v
-            except Exception:
-                logging.getLogger("dynamo.metrics").exception(
-                    "gauge %s scrape callback failed", self.name)
+        values = _merge_callback_values(dict(self._values), self._callbacks,
+                                        self.name)
         for key, v in sorted(values.items()):
             lines.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return "\n".join(lines)

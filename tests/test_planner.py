@@ -250,6 +250,23 @@ def test_seasonal_predictor_aperiodic_fallback():
     assert s.predict_next() == pytest.approx(12.0, abs=0.5)
 
 
+def test_seasonal_predictor_fallback_honors_window():
+    """Regression (advisor round-5 finding): SeasonalPredictor dropped the
+    ``window`` kwarg on its ARIMA fallback, leaving it at the 64-sample
+    default — the fallback must see exactly the configured window."""
+    from dynamo_tpu.planner.load_predictor import SeasonalPredictor
+
+    s = SeasonalPredictor(window=6, period=0)
+    assert s._ar.window == 6
+    assert s._ar.data.maxlen == 6
+    for i in range(20):
+        s.add_data_point(float(i))
+    # the fallback's history is bounded by the configured window
+    assert list(s._ar.data) == [float(i) for i in range(14, 20)]
+    # aperiodic data → forecast comes FROM the fallback, fit on that window
+    assert s.predict_next() == pytest.approx(20.0, abs=0.5)
+
+
 def test_correction_factors_converge_on_optimistic_profile():
     """Adaptive corrections (ref: planner_core.py:126-131,372-384): the
     real system runs 2x the profiled latency; the correction loop must

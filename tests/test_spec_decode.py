@@ -216,3 +216,47 @@ def test_draft_fn_validation():
                    speculative_method="draft_layers")
     with pytest.raises(ValueError, match="speculative_method"):
         EngineArgs(block_size=4, speculative_method="magic")
+
+
+# ------------------------------------------- auto-disable governor (ISSUE 4)
+
+async def test_spec_auto_disables_on_losing_gain_and_reprobes():
+    """BENCH_r05 recorded accept 0.019 / gain 0.729 — a 27% slowdown with
+    nothing turning speculation off. The governor must suspend spec decode
+    once the rolling measured gain stays < 1 over the window, count it,
+    and re-arm after the re-probe interval."""
+    eng = make_engine(speculative_tokens=4, spec_gain_window=8,
+                      spec_reprobe_steps=100)
+    assert eng._spec_active()
+    # 8 dispatches that each emitted only the corrected token (accept 0):
+    # mean 1.0 tokens/dispatch under a >1 dispatch cost → gain < 1
+    for _ in range(8):
+        eng._note_spec_result(emitted=2, n_seqs=2)
+    assert not eng._spec_active()
+    assert eng.spec_disabled_total == 1
+    assert eng.spec_measured_gain is not None and eng.spec_measured_gain < 1.0
+    # re-probe: once spec_reprobe_steps engine steps pass, spec re-arms
+    eng.steps += 100
+    assert eng._spec_active()
+    # a WINNING window must never trip the governor
+    for _ in range(8):
+        eng._note_spec_result(emitted=6, n_seqs=2)  # 3 tokens/dispatch
+    assert eng._spec_active()
+    assert eng.spec_disabled_total == 1
+    await eng.close()
+
+
+async def test_suspended_spec_takes_plain_decode_path():
+    """While suspended, decode must not dispatch draft/verify at all (the
+    whole point: stop paying for losing speculation)."""
+    eng = make_engine(speculative_tokens=4)
+    eng._spec_resume_step = 10_000_000  # governor tripped
+    prompt = [11, 12, 13, 14] * 4  # repetitive: spec WOULD engage
+    toks = await run(eng, prompt, max_tokens=8)
+    assert len(toks) == 8
+    assert eng.spec_stats.num_drafts == 0
+    # and plain greedy output is unchanged
+    plain = make_engine()
+    assert toks == await run(plain, prompt, max_tokens=8)
+    await eng.close()
+    await plain.close()
