@@ -636,6 +636,182 @@ async def mem_pressure_bench(on_tpu: bool = False) -> dict:
     }
 
 
+async def qos_bench(on_tpu: bool = False, reps: int = 4) -> dict:
+    """``bench.py --qos``: multi-tenant isolation under 2x oversubscription
+    (docs/qos.md).
+
+    Two tenants share one engine whose KV pool holds ~half the combined
+    working set and whose seq slots hold half the offered concurrency: a
+    ``batch``-class tenant floods first, then an ``interactive``-class
+    tenant arrives. Three runs on the same seeded workload:
+
+    1. unloaded — the interactive workload alone (its baseline TTFT),
+    2. qos      — mixed, QoS scheduling on (weighted-fair admission +
+                  priority preemption through the swap tier),
+    3. fifo     — mixed, QoS scheduling off (the pre-QoS scheduler).
+
+    Acceptance (ISSUE 5): interactive TTFT p95 under QoS stays ≤ 1.2x its
+    unloaded value while aggregate decode tok/s holds ≥ 0.9x FIFO, and the
+    batch tenant still completes every request (no starvation).
+    """
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+    from dynamo_tpu.runtime.context import Context
+
+    if on_tpu:
+        cfg = ModelConfig.llama3_1b()
+        bs = 16
+        N_I, ISL_I, OSL_I = 8, 128, 32
+        N_B, ISL_B, OSL_B = 12, 512, 64
+        slots = 10
+        extra = dict(use_pallas_attention=True)
+    else:
+        cfg = ModelConfig.tiny()
+        bs = 4
+        N_I, ISL_I, OSL_I = 8, 32, 16
+        # batch OSL long enough to amortize the swap preemptions the
+        # interactive wave triggers — the regime of interest is sustained
+        # decode under oversubscription, not a prefill sprint
+        N_B, ISL_B, OSL_B = 8, 128, 64
+        slots = 8  # 16 offered seqs -> 2x compute oversubscription
+        extra = {}
+    working = (N_B * ((ISL_B + OSL_B + bs - 1) // bs)
+               + N_I * ((ISL_I + OSL_I + bs - 1) // bs))
+    num_blocks = working // 2 + 1  # 2x KV oversubscription (+ NULL block)
+    base = dict(block_size=bs, num_blocks=num_blocks, max_num_seqs=slots,
+                # budget for several prompt-bucket rows per step: an
+                # interactive chunk rides the same jitted call as
+                # concurrent batch prompt chunks instead of waiting a step
+                # behind them
+                max_num_batched_tokens=2 * max(ISL_B, 128),
+                max_model_len=2 * (ISL_B + OSL_B),
+                prefill_buckets=(max(ISL_B, 128),),
+                decode_batch_buckets=(1 << (slots - 1).bit_length(),),
+                enable_prefix_caching=False, **extra)
+    rng = np.random.default_rng(23)
+    int_prompts = [rng.integers(1, cfg.vocab_size, ISL_I).tolist()
+                   for _ in range(N_I)]
+    bat_prompts = [rng.integers(1, cfg.vocab_size, ISL_B).tolist()
+                   for _ in range(N_B)]
+
+    def req(tokens, osl):
+        return PreprocessedRequest(
+            model="m", token_ids=list(tokens),
+            stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+
+    async def one(eng, tokens, osl, ctx):
+        """(ttft_s, n_tokens) for one request."""
+        t0 = time.perf_counter()
+        ttft, n = None, 0
+        async for out in eng.generate(req(tokens, osl), ctx):
+            if ttft is None and out.token_ids:
+                ttft = time.perf_counter() - t0
+            n += len(out.token_ids)
+        return ttft, n
+
+    def ctx(tenant, cls):
+        return Context(tenant=tenant, priority=cls)
+
+    async def interactive_wave(eng):
+        return await asyncio.gather(*[
+            one(eng, p, OSL_I, ctx("tenant-int", "interactive"))
+            for p in int_prompts])
+
+    async def mixed(eng):
+        """Batch floods first; interactive arrives once batch occupies the
+        engine. Returns (int_results, bat_results, elapsed_s)."""
+        t0 = time.perf_counter()
+        bat = [asyncio.ensure_future(
+            one(eng, p, OSL_B, ctx("tenant-bat", "batch")))
+            for p in bat_prompts]
+        for _ in range(20000):  # wait until batch has occupied the engine
+            if (len(eng.scheduler.running) >= min(slots, N_B) - 1
+                    and any(s.num_computed > 0
+                            for s in eng.scheduler.running)):
+                break
+            await asyncio.sleep(0.001)
+        ints = [asyncio.ensure_future(
+            one(eng, p, OSL_I, ctx("tenant-int", "interactive")))
+            for p in int_prompts]
+        int_res = await asyncio.gather(*ints)
+        bat_res = await asyncio.gather(*bat)
+        return int_res, bat_res, time.perf_counter() - t0
+
+    def p95(vals):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(len(vals) * 0.95))]
+
+    async def run_phase(qos: bool, mixed_load: bool):
+        """Warm pass (compiles every bucket), then ``reps`` timed passes;
+        per-metric best-of — wall-clock noise on a 2-core shared host
+        swings single-rep ratios by ±40%, so each metric keeps its best
+        rep while the structural counters accumulate across all of them."""
+        eng = AsyncJaxEngine(cfg, EngineArgs(**base, qos_scheduling=qos))
+        out: dict = {}
+        if mixed_load:
+            await mixed(eng)
+            stats0 = dict(eng.qos_stats()["preemptions"])
+            for _ in range(reps):
+                int_res, bat_res, dt = await mixed(eng)
+                tok_s = (sum(n for _, n in int_res)
+                         + sum(n for _, n in bat_res)) / dt
+                if not out or tok_s > out["tok_s"]:
+                    out["tok_s"] = tok_s
+                # pool TTFT samples across reps: the p95 of one 8-request
+                # wave is just its max, and a single event-loop hiccup on
+                # one request would masquerade as a policy failure
+                out.setdefault("int_ttfts", []).extend(
+                    t for t, _ in int_res)
+                out.setdefault("bat_tokens", []).append(
+                    sum(n for _, n in bat_res))
+            stats = eng.qos_stats()["preemptions"]
+            preempts = {k: v - stats0.get(k, 0) for k, v in stats.items()
+                        if v - stats0.get(k, 0)}
+            out["preempts_by_class"] = {c: n for (_t, c), n
+                                        in preempts.items()}
+        else:
+            await interactive_wave(eng)
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                int_res = await interactive_wave(eng)
+                dt = time.perf_counter() - t0
+                tok_s = sum(n for _, n in int_res) / dt
+                if not out or tok_s > out["tok_s"]:
+                    out["tok_s"] = tok_s
+                out.setdefault("int_ttfts", []).extend(
+                    t for t, _ in int_res)
+        await eng.close()
+        return out
+
+    unloaded = await run_phase(qos=True, mixed_load=False)
+    qos = await run_phase(qos=True, mixed_load=True)
+    fifo = await run_phase(qos=False, mixed_load=True)
+
+    unloaded_p95 = p95(unloaded["int_ttfts"])
+    qos_p95 = p95(qos["int_ttfts"])
+    fifo_p95 = p95(fifo["int_ttfts"])
+    return {
+        "qos_workload": (f"int={N_I}x(ISL={ISL_I},OSL={OSL_I}) "
+                         f"batch={N_B}x(ISL={ISL_B},OSL={OSL_B}) "
+                         f"slots={slots} blocks={num_blocks}"),
+        "unloaded_int_ttft_p95_ms": round(unloaded_p95 * 1000, 1),
+        "qos_int_ttft_p95_ms": round(qos_p95 * 1000, 1),
+        "fifo_int_ttft_p95_ms": round(fifo_p95 * 1000, 1),
+        "qos_ttft_vs_unloaded": round(qos_p95 / max(unloaded_p95, 1e-9), 3),
+        "fifo_ttft_vs_unloaded": round(fifo_p95 / max(unloaded_p95, 1e-9), 3),
+        "qos_tok_s": round(qos["tok_s"], 1),
+        "fifo_tok_s": round(fifo["tok_s"], 1),
+        "qos_vs_fifo_tok_s": round(qos["tok_s"] / max(fifo["tok_s"], 1e-9),
+                                   3),
+        "batch_completed": min(qos["bat_tokens"]),  # worst rep: starvation
+        "batch_expected": N_B * OSL_B,
+        "qos_preempts_by_class": qos["preempts_by_class"],
+    }
+
+
 def _device_init_responsive(timeout_s: float = 240.0) -> bool:
     """Probe jax backend init in a SUBPROCESS: a broken TPU tunnel makes
     jax.devices() hang forever (observed: axon UNAVAILABLE wedged for
@@ -737,6 +913,27 @@ def main():
               and out["swap_out_blocks"] > 0)
         raise SystemExit(0 if ok else 1)
 
+    if "--qos" in sys.argv:
+        # multi-tenant QoS smoke: two tenants at 2x oversubscription —
+        # prints one JSON line; exits nonzero when the isolation contract
+        # breaks (interactive TTFT p95 > 1.2x unloaded, aggregate tok/s
+        # < 0.9x FIFO, batch starved, or a non-batch class was preempted)
+        try:
+            out = asyncio.run(qos_bench(False))
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"qos": "failed", "error": repr(e)[:300]}),
+                  flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        ok = (out["qos_ttft_vs_unloaded"] <= 1.2
+              and out["qos_vs_fifo_tok_s"] >= 0.9
+              and out["batch_completed"] == out["batch_expected"]
+              and set(out["qos_preempts_by_class"]) <= {"batch"})
+        raise SystemExit(0 if ok else 1)
+
     if "--chaos" in sys.argv:
         # chaos smoke: no accelerator, no child orchestration — prints one
         # JSON line; exits nonzero when completion rate or p95 degradation
@@ -836,14 +1033,14 @@ def _child_main():
     # — perf iteration on one phase shouldn't pay the full suite each time
     phases = {p.strip() for p in
               os.environ.get("DYN_BENCH_PHASES",
-                             "kernel,spec,e2e,chaos,mem").split(",")
+                             "kernel,spec,e2e,chaos,mem,qos").split(",")
               if p.strip()}
-    unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem"}
+    unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
-                         f"chaos, mem)")
+                         f"chaos, mem, qos)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -890,6 +1087,14 @@ def _child_main():
                 kern["mem_pressure"] = asyncio.run(mem_pressure_bench(on_tpu))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["mem_error"] = repr(e)[:200]
+        if "qos" in phases:
+            # multi-tenant isolation phase: interactive TTFT under 2x
+            # oversubscription vs unloaded + aggregate tok/s vs FIFO —
+            # the differentiated-service record (ISSUE 5 acceptance)
+            try:
+                kern["qos"] = asyncio.run(qos_bench(on_tpu))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["qos_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

@@ -436,6 +436,18 @@ class EngineArgs:
     #: decode worker advertises reach — the NIXL analog (disagg/transfer.py).
     #: False = always host-staged bundles over the response plane.
     kv_transfer_direct: bool = True
+    #: multi-tenant QoS scheduling (docs/qos.md): per-class waiting queues
+    #: drained by weighted-fair virtual token counters, class-aware
+    #: preemption victims, aging. With one tenant/class the drain order is
+    #: exact FIFO, so this default changes nothing for untagged traffic;
+    #: False restores the flat FIFO drain/victim order (bench baseline) —
+    #: the swap-in starvation guard (head-of-line skip-ahead after
+    #: repeated failed reservations) stays active in both modes, it is a
+    #: bugfix to the swap tier, not a QoS policy.
+    qos_scheduling: bool = True
+    #: QoS policy override (dynamo_tpu.qos.QosConfig); None = load from the
+    #: DYN_QOS_* environment at scheduler construction
+    qos: Optional[object] = None
     seed: int = 0
 
     def __post_init__(self):

@@ -2283,7 +2283,13 @@ class AsyncJaxEngine:
             "swapped_blocks": self.pool.swapped_blocks,
             "swap_host_bytes": self._swap.used if self._swap else 0,
             "swap_host_budget": self._swap.budget if self._swap else 0,
+            "swap_in_blocked": sched.swap_in_blocked_total,
         }
+
+    def qos_stats(self) -> dict:
+        """Per-(tenant, class) QoS telemetry: served tokens, queue wait,
+        preemptions (→ dynamo_tenant_* metrics, engine/main.py)."""
+        return self.scheduler.qos.snapshot()
 
     def _on_removed(self, seq_hashes) -> None:
         if self.event_cb is None:

@@ -425,10 +425,38 @@ async def amain():
         "KV blocks currently host-resident via preempt-to-swap").add_callback(
         _swap_cb("swapped_blocks"))
     runtime.metrics.counter(
+        "swap_in_blocked_total",
+        "swap-in head-of-line candidates re-parked by the starvation "
+        "guard (failed block reservations)").add_callback(
+        _swap_cb("swap_in_blocked"))
+    runtime.metrics.counter(
         "spec_disabled_total",
         "times the engine auto-suspended losing speculative "
         "decode").add_callback(
         lambda: {None: engine.spec_disabled_total})
+
+    # multi-tenant QoS telemetry (docs/qos.md): per-(tenant, class) served
+    # tokens, queue wait, preemptions from the scheduler's fairness ledger;
+    # rejections-by-tenant are a FRONTEND family (dynamo_tenant_rejected_total)
+    def _qos_cb(field):
+        def cb():
+            return {(("class", c), ("tenant", t)): v
+                    for (t, c), v in engine.qos_stats()[field].items()}
+        return cb
+
+    for name, fld, help_ in (
+            ("tenant_served_tokens_total", "served_tokens",
+             "tokens whose KV this engine computed, by tenant/class "
+             "(prefill + decode + recompute re-prefills)"),
+            ("tenant_queue_wait_seconds_total", "queue_wait_s",
+             "cumulative seconds sequences waited for admission, by "
+             "tenant/class"),
+            ("tenant_queue_wait_count", "queue_wait_n",
+             "admission waits observed, by tenant/class (divide into "
+             "the seconds total for the mean)"),
+            ("tenant_preemptions_total", "preemptions",
+             "sequences preempted (swap or recompute), by tenant/class")):
+        runtime.metrics.counter(name, help_).add_callback(_qos_cb(fld))
 
     component = cli.component or (
         "prefill" if cli.role == "prefill" else "backend")

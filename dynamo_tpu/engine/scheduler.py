@@ -26,6 +26,7 @@ chunked prefill budget, preemption; vLLM-style recompute preemption):
 from __future__ import annotations
 
 import logging
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -33,10 +34,18 @@ from typing import Callable, Optional
 from dynamo_tpu.engine.cache import BlockPool
 from dynamo_tpu.engine.config import EngineArgs
 from dynamo_tpu.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.qos import CLASS_RANK, DEFAULT_TENANT, normalize_priority
+from dynamo_tpu.qos.fair import ClassQueues, QosBook
 from dynamo_tpu.router.protocols import StoredBlock
 from dynamo_tpu.tokens import KV_HASH_SEED, TokenBlockSequence
 
 logger = logging.getLogger("dynamo.engine.scheduler")
+
+#: starvation guard for the swapped queue (docs/qos.md): a swap-in
+#: candidate whose block reservation fails this many consecutive passes is
+#: re-parked behind its peers (dynamo_swap_in_blocked_total counts it) so a
+#: large head-of-line sequence cannot block smaller resumable ones forever
+SWAP_IN_SKIP_AFTER = 3
 
 
 @dataclass
@@ -85,6 +94,15 @@ class SeqState:
     pending_stored: list = field(default_factory=list)
     pending_stored_ids: list = field(default_factory=list)
     pending_parent: object = None
+    #: multi-tenant QoS (docs/qos.md): tenant id + priority class copied
+    #: off the Context at add() time (wire fields; absent = defaults),
+    #: plus the bookkeeping the fair queues / starvation guards key on
+    tenant: str = DEFAULT_TENANT
+    priority: str = "standard"
+    qos_enqueue_t: float = 0.0    # when the seq (re-)entered waiting
+    qos_arrival: Optional[int] = None  # global arrival stamp (ClassQueues)
+    swap_in_attempts: int = 0     # consecutive failed swap-in reservations
+    parked_t: float = 0.0         # when the seq entered the swapped queue
 
     @property
     def remaining(self) -> int:
@@ -138,11 +156,19 @@ class Scheduler:
         #: swap_status(seq) -> "ready"|"pending"|"failed", swap_in(seq) ->
         #: bool, swap_drop(seq). None = recompute preemption only.
         self.swapper = swapper
-        self.waiting: deque[SeqState] = deque()
+        #: multi-tenant QoS ledger (virtual token counters, per-tenant
+        #: telemetry) + the per-class waiting queues it drains. With QoS
+        #: scheduling off — or a single default tenant/class, i.e. every
+        #: pre-QoS workload — the drain order is exact FIFO.
+        self.qos = QosBook(args.qos)
+        self.waiting: ClassQueues = ClassQueues(
+            self.qos, fifo=not args.qos_scheduling)
         self.running: list[SeqState] = []
-        #: swapped-out victims, FIFO — between waiting and running; swap-in
+        #: swapped-out victims — between waiting and running; swap-in
         #: admission runs BEFORE _admit so a resumed sequence reclaims its
-        #: old position instead of queueing behind fresh prompts
+        #: old position instead of queueing behind fresh prompts. Drained
+        #: best-class-first (aged sequences jump the order), FIFO within a
+        #: class; plain FIFO when QoS scheduling is off.
         self.swapped: deque[SeqState] = deque()
         self._aborted: set = set()  # reaped at next plan() like cancellation
         self.prefix_hit_tokens = 0
@@ -158,6 +184,10 @@ class Scheduler:
         #: prompt+generated tokens thrown away by recompute preemptions —
         #: each will be re-prefilled (the waste swap-based preemption kills)
         self.recomputed_tokens_total = 0
+        #: swap-in starvation guard fires (head-of-line candidate re-parked
+        #: after SWAP_IN_SKIP_AFTER failed reservations) →
+        #: dynamo_swap_in_blocked_total
+        self.swap_in_blocked_total = 0
 
     # -- api ----------------------------------------------------------------
 
@@ -168,11 +198,23 @@ class Scheduler:
         digest = req.mm_digest() if hasattr(req, "mm_digest") else None
         return KV_HASH_SEED if digest is None else digest
 
+    def _stamp_qos(self, seq: SeqState) -> None:
+        """Copy tenant/priority off the runtime Context (wire fields; a
+        pre-QoS peer sends neither → defaults) and register the sequence
+        with the fairness ledger."""
+        seq.tenant = str(getattr(seq.ctx, "tenant", None)
+                         or DEFAULT_TENANT)
+        seq.priority = normalize_priority(
+            getattr(seq.ctx, "priority", None), warn=False)
+        self.qos.enter(seq)
+
     def add(self, seq: SeqState) -> None:
         seq.tokens = list(seq.req.token_ids)
         seq.prompt_len = len(seq.tokens)
         seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
                                         salt_hash=self._salt_for(seq.req))
+        self._stamp_qos(seq)
+        seq.qos_enqueue_t = time.monotonic()
         self.waiting.append(seq)
 
     @property
@@ -195,6 +237,17 @@ class Scheduler:
         # overflow the padded batch arrays
         max_b = min(self.args.max_num_seqs, self.args.decode_batch_buckets[-1])
         decode_seqs = [s for s in self.running if s.remaining == 1]
+        if self.args.qos_scheduling:
+            # class-ordered work within the step (docs/qos.md): interactive
+            # rows claim batch budget / row slots / the prefill token
+            # bucket first, so an interactive prefill chunk never pads up
+            # to (or queues a step behind) a concurrent batch prompt.
+            # Stable within a class — single-class workloads keep the
+            # exact pre-QoS order.
+            order = {id(s): i for i, s in enumerate(self.running)}
+            by_class = lambda s: (CLASS_RANK.get(s.priority, 1),  # noqa: E731
+                                  order[id(s)])
+            decode_seqs.sort(key=by_class)
 
         # ensure each decode seq has a block for its last position; preempt on
         # allocation failure (victims chosen newest-first, vLLM-style).
@@ -220,6 +273,8 @@ class Scheduler:
             # max_num_batched_tokens — concurrent prompts no longer
             # serialize one-prefill-per-step.
             prefill_seqs = [s for s in self.running if s.remaining > 1]
+            if self.args.qos_scheduling:
+                prefill_seqs.sort(key=by_class)
             s_bucket = None
             # chunks must fit the LARGEST compiled prefill bucket: with
             # custom buckets coarser than max_num_batched_tokens, an
@@ -270,11 +325,39 @@ class Scheduler:
                     sample=(s.num_computed + chunk == len(s.tokens)),
                 ))
                 budget -= chunk
+        if self.args.qos_scheduling and plan.prefill and plan.decode:
+            # TTFT protection (docs/qos.md): when this step carries a
+            # prefill chunk of a BETTER class, strictly-worse-class decode
+            # rows sit the step out — their next token arrives one step
+            # late (a bounded ITL hit for the backlogged class) instead of
+            # inflating every step of the interactive prompt's prefill.
+            # ONLY when it pays: decode dispatch cost is set by the padded
+            # batch bucket, so shedding worse rows that leave the bucket
+            # unchanged would delay their tokens without speeding the step
+            # by a single flop. Same-class mixes (every pre-QoS workload)
+            # are untouched either way.
+            best = min(CLASS_RANK.get(w.seq.priority, 1)
+                       for w in plan.prefill)
+            better = [s for s in plan.decode
+                      if CLASS_RANK.get(s.priority, 1) <= best]
+            # Shedding to EMPTY when every row is worse-class looks like
+            # the biggest win (the whole decode dispatch skipped) but
+            # measured consistently WORSE on bench.py --qos: interactive
+            # TTFT p95 117ms vs 84ms, ratio 1.3-1.65x vs 0.75-1.09x over
+            # 3 runs each — oscillating between prefill-only and
+            # decode-only step shapes costs more than the batched decode
+            # rows ever did, and batch rows frozen mid-wave hold their
+            # slots/blocks longer. Worse-class rows therefore ride along
+            # unless dropping them shrinks the compiled bucket.
+            if better and self.args.bucket_batch(len(better)) \
+                    < self.args.bucket_batch(len(plan.decode)):
+                plan.decode = better
         return plan
 
     # -- post-step bookkeeping ----------------------------------------------
 
-    def commit_computed(self, seq: SeqState, new_num_computed: int) -> None:
+    def commit_computed(self, seq: SeqState, new_num_computed: int,
+                        charge: bool = True) -> None:
         """Advance num_computed; hash/register/event newly-filled blocks.
 
         KV stored events batch PER REQUEST by default: chunks of a long
@@ -286,6 +369,15 @@ class Scheduler:
         """
         old = seq.num_computed
         seq.num_computed = new_num_computed
+        # served-token accounting (docs/qos.md): every token whose KV this
+        # engine computed — prefill chunks, decode steps, and recompute
+        # re-prefills alike — advances the tenant's virtual counter at its
+        # class weight. Prefix-cache hits and disagg-attached prompt KV
+        # (charge=False) charge nothing: no work done HERE, and the prefill
+        # worker already charged its own ledger, so charging again would
+        # double-count dynamo_tenant_served_tokens_total fleet-wide.
+        if charge:
+            self.qos.charge(seq.tenant, seq.priority, new_num_computed - old)
         seq.hashes.extend(seq.tokens[len(seq.hashes): new_num_computed])
         bs = self.args.block_size
         full = new_num_computed // bs
@@ -366,6 +458,7 @@ class Scheduler:
 
     def finish(self, seq: SeqState, reason: str) -> None:
         seq.finished = reason
+        self.qos.leave(seq)
         self._flush_stored(seq)
         if seq in self.running:
             self.running.remove(seq)
@@ -386,12 +479,15 @@ class Scheduler:
         ``block_table``). Registers/hashes the prompt blocks so prefix cache
         and KV events behave exactly as if prefill ran locally."""
         seq.tokens = list(seq.req.token_ids)
+        self._stamp_qos(seq)
         seq.prompt_len = len(seq.tokens)
         seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
                                         salt_hash=self._salt_for(seq.req))
         seq.block_table = list(block_table)
         self.running.append(seq)
-        self.commit_computed(seq, seq.prompt_len)
+        # charge=False: the prompt's KV was computed (and QoS-charged) on
+        # the prefill worker; this engine only attaches the pages
+        self.commit_computed(seq, seq.prompt_len, charge=False)
 
     # -- internals -----------------------------------------------------------
 
@@ -428,10 +524,12 @@ class Scheduler:
                 self._aborted.discard(id(s))
                 s.finished = FinishReason.CANCELLED
                 self.waiting.remove(s)
+                self.qos.leave(s)
                 s.sink.put_nowait(None)
             elif expired(s):
                 s.finished = FinishReason.DEADLINE
                 self.waiting.remove(s)
+                self.qos.leave(s)
                 s.sink.put_nowait(LLMEngineOutput(
                     finish_reason=FinishReason.DEADLINE))
         for s in list(self.swapped):
@@ -440,6 +538,7 @@ class Scheduler:
             if dead(s) or expired(s):
                 self._aborted.discard(id(s))
                 self.swapped.remove(s)
+                self.qos.leave(s)
                 if self.swapper is not None:
                     self.swapper.swap_drop(s)
                 if dead(s):
@@ -450,8 +549,42 @@ class Scheduler:
                     s.sink.put_nowait(LLMEngineOutput(
                         finish_reason=FinishReason.DEADLINE))
 
+    def _swap_in_candidate(self, exclude: frozenset = frozenset()) -> SeqState:
+        """Next swapped sequence to resume: aged ones first (oldest parked,
+        starvation guard), then best class, then FIFO by park time. Plain
+        FIFO when QoS scheduling is off.
+
+        ``exclude`` holds ids of candidates already re-parked THIS pass:
+        without it the class-first order re-picks a sole best-class
+        candidate immediately after its own skip-ahead (re-parking only
+        moves it behind same-class peers), and worse-class sequences
+        behind it are never even tried."""
+        if not self.args.qos_scheduling:
+            return self.swapped[0]
+        pool = [s for s in self.swapped if id(s) not in exclude] \
+            or list(self.swapped)
+        now = time.monotonic()
+        aging = self.qos.cfg.aging_s
+        if aging > 0:
+            aged = [s for s in pool if now - s.parked_t >= aging]
+            if aged:
+                return min(aged, key=lambda s: s.parked_t)
+        return min(pool,
+                   key=lambda s: (CLASS_RANK.get(s.priority, 1), s.parked_t))
+
+    def _swap_in_fallback(self, seq: SeqState) -> None:
+        """Swap-in impossible (torn bundle / failed copy): resolve the
+        preemption by recompute. Counted as recompute even though the
+        swap-out counted as swap — or dashboards read 100% swap success
+        while recomputed tokens climb."""
+        self.preempt_recompute_total += 1
+        self.recomputed_tokens_total += seq.num_computed
+        self._reset_for_recompute(seq)
+        seq.qos_enqueue_t = time.monotonic()
+        self.waiting.appendleft(seq)
+
     def _swap_in_pass(self) -> None:
-        """Re-activate swapped-out sequences (FIFO) when capacity returns.
+        """Re-activate swapped-out sequences when capacity returns.
 
         Swap-in admission charges ``_ensure_blocks`` for the sequence's
         whole resident prefix BEFORE re-activation (plus one token of
@@ -459,62 +592,121 @@ class Scheduler:
         re-preempt it), and runs before ``_admit`` so a resumed sequence
         takes priority over fresh prompts — it resumes at its old progress
         instead of re-prefilling behind the queue.
+
+        Starvation guard (docs/qos.md): a head-of-line candidate whose
+        block reservation keeps failing — e.g. a long sequence needing more
+        blocks than ever free at once — is re-parked behind its peers after
+        ``SWAP_IN_SKIP_AFTER`` failed passes (``dynamo_swap_in_blocked_total``
+        counts each re-park) so smaller resumable sequences get their shot.
         """
         if self.swapper is None:
             return
+        rotations = 0
+        skipped: set = set()  # re-parked this pass: don't re-pick them
         while self.swapped and len(self.running) < self.args.max_num_seqs:
-            seq = self.swapped[0]
+            if rotations > len(self.swapped):
+                break  # full cycle without progress: wait for more memory
+            seq = self._swap_in_candidate(frozenset(skipped))
             st = self.swapper.swap_status(seq)
             if st == "pending":
-                break  # host copy still in flight; FIFO order preserved
+                break  # host copy still in flight; order preserved
             if st != "ready":
                 # bundle torn down / copy failed: recompute fallback
-                self.swapped.popleft()
+                self.swapped.remove(seq)
                 logger.warning("swap-in of %s unavailable (%s); falling "
                                "back to recompute", seq.request_id, st)
                 self.swapper.swap_drop(seq)  # reclaim budget/accounting
-                # the preemption counted as swap at swap-out time, but it
-                # RESOLVED by recompute — count that too, or dashboards
-                # read a 100% swap success while recomputed tokens climb
-                self.preempt_recompute_total += 1
-                self.recomputed_tokens_total += seq.num_computed
-                self._reset_for_recompute(seq)
-                self.waiting.appendleft(seq)
+                self._swap_in_fallback(seq)
                 continue
             bs = self.args.block_size
             need = (seq.num_computed + bs) // bs  # ceil((computed+1)/bs)
             free_after = self.pool.num_free_blocks - need
-            if free_after < 0 or (self.running and free_after
-                                  < self.args.watermark * self.pool.num_blocks):
-                break  # not enough room yet — wait, don't thrash
-            self.swapped.popleft()
+            watermarked = (self.running and self.pool.num_free_blocks - need
+                           < self.args.watermark * self.pool.num_blocks)
+            if free_after < 0 or watermarked:
+                # not enough room for THIS candidate. A smaller sequence
+                # behind it may still fit: after SWAP_IN_SKIP_AFTER failed
+                # passes the candidate is re-parked (skip-ahead) instead of
+                # pinning the whole queue behind its reservation.
+                seq.swap_in_attempts += 1
+                if (len(self.swapped) > 1
+                        and seq.swap_in_attempts >= SWAP_IN_SKIP_AFTER):
+                    seq.swap_in_attempts = 0
+                    seq.parked_t = time.monotonic()  # back of its class
+                    self.swapped.remove(seq)  # and of the FIFO order
+                    self.swapped.append(seq)
+                    skipped.add(id(seq))  # let worse classes have a shot
+                    self.swap_in_blocked_total += 1
+                    rotations += 1
+                    logger.info("swap-in of %s blocked (needs %d blocks, "
+                                "%d free); skipping ahead", seq.request_id,
+                                need, self.pool.num_free_blocks)
+                    continue
+                break  # wait, don't thrash
+            self.swapped.remove(seq)
             if not self._ensure_blocks(seq, seq.num_computed + 1):
+                seq.swap_in_attempts += 1
                 self.swapped.appendleft(seq)
                 break
+            seq.swap_in_attempts = 0
             if not self.swapper.swap_in(seq):
                 self.pool.release(seq.block_table)
                 seq.block_table = []
-                self.preempt_recompute_total += 1  # resolved by recompute
-                self.recomputed_tokens_total += seq.num_computed
-                self._reset_for_recompute(seq)
-                self.waiting.appendleft(seq)
+                self._swap_in_fallback(seq)  # resolved by recompute
                 continue
             self.swap_in_total += 1
             # old position: ahead of every later admission, and victim
             # selection (newest-first) reaches it last
             self.running.insert(0, seq)
 
+    def _make_room_for(self, seq: SeqState) -> bool:
+        """Admission-time priority preemption (docs/qos.md): evict one
+        running sequence of a STRICTLY worse class — lowest class /
+        highest debt / newest first, through the swap path when the host
+        budget allows — so an arriving higher-priority request gets its
+        slot and blocks now instead of queueing behind saturated batch
+        work. Same-class running work is never churned. False = no
+        eligible victim (the arrival waits like before)."""
+        if not self.args.qos_scheduling:
+            return False
+        rank = CLASS_RANK.get(seq.priority, 1)
+        for victim in self._victim_order(seq):
+            if CLASS_RANK.get(victim.priority, 1) <= rank:
+                continue
+            self._preempt(victim)
+            return True
+        return False
+
     def _admit(self) -> None:
         bs = self.args.block_size
-        while self.waiting and len(self.running) < self.args.max_num_seqs:
-            seq = self.waiting[0]
+        now = time.monotonic()
+        while self.waiting:
+            # weighted-fair pick (docs/qos.md): the backlogged tenant with
+            # the least virtual time goes first (aging escape hatch for
+            # starving sequences; exact FIFO with QoS scheduling off or a
+            # single default tenant/class)
+            seq = self.waiting.pick(now)
+            # slots full: a higher-priority arrival may claim one from a
+            # worse-class victim; anything else waits. The freed capacity
+            # goes to THIS seq, not a re-pick — a recompute-preempted
+            # victim lands back in waiting with a lower virtual time than
+            # the arrival that displaced it, and a re-pick would hand it
+            # straight back its old slot and preempt it again, forever.
+            # _make_room_for only ever evicts strictly-worse classes, so
+            # each call shrinks running and the loop is bounded.
+            while len(self.running) >= self.args.max_num_seqs:
+                if not self._make_room_for(seq):
+                    return
             # watermark: keep a fraction of blocks free (ref: mocker watermark)
             needed_first = max(1, min(len(seq.tokens), bs) // bs + 1)
-            free_frac = self.pool.num_free_blocks / max(1, self.pool.num_blocks)
-            if (self.pool.num_free_blocks < needed_first
-                    or (self.running and free_frac < self.args.watermark)):
-                break
-            self.waiting.popleft()
+            while (self.pool.num_free_blocks < needed_first
+                   or (self.running and self.pool.num_free_blocks
+                       < self.args.watermark * self.pool.num_blocks)):
+                if not self._make_room_for(seq):
+                    return
+            self.waiting.remove(seq)
+            self.qos.note_queue_wait(seq.tenant, seq.priority,
+                                     max(0.0, now - seq.qos_enqueue_t))
             if seq.num_computed == 0 and not seq.block_table:
                 self._prefix_match(seq)
             self.running.append(seq)
@@ -560,19 +752,41 @@ class Scheduler:
         return True
 
     def _preempt_for(self, needy: SeqState, exclude=()) -> bool:
-        """Preempt the newest other running seq to free memory. True if any.
+        """Preempt another running seq to free memory. True if any.
+
+        Victim order under QoS (docs/qos.md): lowest priority class first
+        (batch before standard before interactive), then the tenant with
+        the most accumulated service (highest virtual time — the "debt"
+        that weighted fairness says should yield first), then newest. A
+        victim of a BETTER class than the needy sequence is never taken —
+        the needy one preempts itself instead (caller falls through to
+        ``_preempt(needy)``), which is exactly how interactive KV survives
+        batch pressure. With QoS scheduling off: newest-first, vLLM-style.
 
         ``exclude`` protects sequences already finalized into this step's
         decode batch: evicting one would free the very block table the
         imminent jitted call is about to index (the bench-on-TPU crash —
         a prefill chunk preempting a planned decode mid-step).
         """
-        for victim in reversed(self.running):
+        for victim in self._victim_order(needy):
             if victim is needy or any(victim is e for e in exclude):
                 continue
             self._preempt(victim)
             return True
         return False
+
+    def _victim_order(self, needy: SeqState) -> list[SeqState]:
+        if not self.args.qos_scheduling:
+            return list(reversed(self.running))
+        needy_rank = CLASS_RANK.get(needy.priority, 1)
+        idx = {id(s): i for i, s in enumerate(self.running)}
+        candidates = [s for s in self.running
+                      if CLASS_RANK.get(s.priority, 1) >= needy_rank]
+        return sorted(
+            candidates,
+            key=lambda s: (CLASS_RANK.get(s.priority, 1),
+                           self.qos.vt_of(s.tenant), idx[id(s)]),
+            reverse=True)
 
     def _preempt(self, seq: SeqState) -> None:
         """Evict a victim to free KV blocks: swap its resident pages to the
@@ -589,11 +803,15 @@ class Scheduler:
             seq.block_table = []
             seq.preemptions += 1
             self.preempt_swap_total += 1
+            self.qos.note_preempt(seq.tenant, seq.priority)
             if seq in self.running:
                 self.running.remove(seq)
+            seq.parked_t = time.monotonic()
+            seq.swap_in_attempts = 0
             self.swapped.append(seq)
             return
         if seq.num_computed > 0:
+            self.qos.note_preempt(seq.tenant, seq.priority)
             # a zero-progress victim (admitted, nothing computed) discards
             # no KV — requeueing it is free and counts as neither a swap
             # nor a recompute preemption
@@ -607,6 +825,7 @@ class Scheduler:
         seq.preemptions += 1
         if seq in self.running:
             self.running.remove(seq)
+        seq.qos_enqueue_t = time.monotonic()
         self.waiting.appendleft(seq)
 
     def _reset_for_recompute(self, seq: SeqState) -> None:

@@ -42,6 +42,9 @@ from dynamo_tpu.protocols.openai import (
     response_object,
 )
 from dynamo_tpu.observability import fetch_trace, get_tracer
+from dynamo_tpu.qos import (CLASS_RANK, DEFAULT_TENANT, QosConfig,
+                            normalize_priority)
+from dynamo_tpu.qos.quota import DrainRateEstimator, TenantQuotas
 from dynamo_tpu.runtime.context import (
     Context,
     DeadlineExceededError,
@@ -148,6 +151,18 @@ class HttpService:
         #: default end-to-end deadline seconds (None = no deadline) applied
         #: when the client sends no X-Request-Timeout-Ms
         self.default_deadline_s = rcfg.request_deadline
+        # multi-tenant QoS (docs/qos.md): tenant identity (API key /
+        # x-dynamo-tenant), priority class, per-tenant token-rate +
+        # inflight quotas, and the drain-rate estimator that turns the old
+        # hardcoded Retry-After: 1 into an observed-backlog estimate
+        self.qos = QosConfig.load()
+        self.quotas = TenantQuotas(self.qos)
+        self._drain_rate = DrainRateEstimator()
+        # self-declared tenant ids seen so far; past max_adhoc_tenants new
+        # names demote to "default" so a client looping random ids cannot
+        # grow buckets/counters/metric labels without bound (docs/qos.md)
+        self._adhoc_tenants: set = set()
+        self._adhoc_overflow_warned = False
         self._draining = False
         self.host = host
         self.port = port
@@ -176,6 +191,18 @@ class HttpService:
             "llm_completion_tokens_total", "Completion tokens by model")
         self._finished = self.metrics.counter(
             "llm_requests_finished_total", "Finished LLM requests by model")
+        # dynamo_tenant_* families (docs/qos.md): differentiated-service
+        # accounting at the edge; the engine-side families (served tokens,
+        # queue wait, preemptions) live on the worker's /metrics
+        self._tenant_requests = self.metrics.counter(
+            "tenant_requests_total", "LLM requests by tenant/class/status")
+        self._tenant_rejected = self.metrics.counter(
+            "tenant_rejected_total",
+            "requests rejected by tenant/class/reason (quotas + shared "
+            "admission)")
+        self._tenant_tokens = self.metrics.counter(
+            "tenant_completion_tokens_total",
+            "completion tokens served by tenant/class")
 
     @property
     def tracer(self):
@@ -184,14 +211,16 @@ class HttpService:
         every instrumentation site writes to."""
         return get_tracer()
 
-    # -- overload protection ----------------------------------------------
+    # -- overload protection / QoS ------------------------------------------
 
-    def _begin_request(self, model: str) -> None:
+    def _begin_request(self, model: str, tenant: Optional[str] = None) -> None:
         self._inflight_count += 1
         self._inflight.set(self._inflight_count)
         self._model_inflight[model] = self._model_inflight.get(model, 0) + 1
+        if tenant is not None:
+            self.quotas.begin(tenant)
 
-    def _end_request(self, model: str) -> None:
+    def _end_request(self, model: str, tenant: Optional[str] = None) -> None:
         self._inflight_count -= 1
         self._inflight.set(self._inflight_count)
         n = self._model_inflight.get(model, 1) - 1
@@ -199,8 +228,99 @@ class HttpService:
             self._model_inflight.pop(model, None)
         else:
             self._model_inflight[model] = n
+        if tenant is not None:
+            self.quotas.end(tenant)
+        # drain-rate sample: every finished request sharpens the
+        # Retry-After estimate the next rejection hands out
+        self._drain_rate.note()
 
-    def _admission(self, route: str, model: str) -> Optional[web.Response]:
+    def _resolve_qos(self, request: web.Request) -> tuple[str, str]:
+        """(tenant, priority class) for a request (docs/qos.md).
+
+        Tenant: a configured API key (``Authorization: Bearer``) wins,
+        else the ``x-dynamo-tenant`` header, else "default". A tenant
+        configured WITH api_keys is a key-protected identity: a bare
+        header claiming it is spoofing (it would inherit the tenant's
+        class and drain its quotas) and demotes to "default"; unconfigured
+        names past the ``max_adhoc_tenants`` cap demote too (bounded
+        per-tenant state — a client looping random ids must not be a
+        memory/metrics DoS). Priority: the ``x-dynamo-priority`` header,
+        else the tenant's configured class, else "standard"; a malformed
+        value degrades to the default with a warning (same rule as
+        malformed traceparent), and without an API key the header may only
+        LOWER the class below the tenant's configured default — an
+        anonymous client claiming ``interactive`` would otherwise gain
+        weighted-fair priority, preemption of paying tenants' running
+        work, and favored routing for free. Escalation above the
+        configured default is an authenticated-tenant privilege."""
+        tenant = None
+        key_authed = False
+        auth = request.headers.get("authorization", "")
+        if auth.lower().startswith("bearer "):
+            tenant = self.qos.tenant_for_api_key(auth[7:].strip())
+            key_authed = tenant is not None
+        if tenant is None:
+            claimed = request.headers.get("x-dynamo-tenant")
+            pol = self.qos.tenants.get(claimed) if claimed else None
+            if pol is not None and pol.api_keys:
+                logger.warning(
+                    "x-dynamo-tenant claims key-protected tenant %r "
+                    "without its key; using %r", claimed, DEFAULT_TENANT)
+            elif claimed and pol is None and claimed != DEFAULT_TENANT:
+                # unconfigured self-declared id: admit up to the cap
+                if claimed in self._adhoc_tenants \
+                        or len(self._adhoc_tenants) \
+                        < self.qos.max_adhoc_tenants:
+                    self._adhoc_tenants.add(claimed)
+                    tenant = claimed
+                elif not self._adhoc_overflow_warned:
+                    self._adhoc_overflow_warned = True
+                    logger.warning(
+                        "more than %d distinct x-dynamo-tenant ids seen; "
+                        "demoting new ones to %r (DYN_QOS_MAX_TENANTS)",
+                        self.qos.max_adhoc_tenants, DEFAULT_TENANT)
+            else:
+                tenant = claimed
+            tenant = tenant or DEFAULT_TENANT
+        raw = request.headers.get("x-dynamo-priority")
+        base = self.qos.default_priority(tenant)
+        # malformed values degrade to the TENANT's class, not the global
+        # default — else a key-authed batch tenant's typo escalates it to
+        # "standard" past the escalation check below (key_authed skips it)
+        cls = normalize_priority(raw, default=base) if raw is not None else base
+        if not key_authed and CLASS_RANK[cls] < CLASS_RANK[base]:
+            logger.warning(
+                "x-dynamo-priority %r escalates above tenant %r's "
+                "configured class without an API key; using %r",
+                raw, tenant, base)
+            cls = base
+        return tenant, cls
+
+    def _retry_after(self, backlog: int) -> int:
+        """Seconds a rejected client should wait, from the observed drain
+        rate (completions/s), clamped to [1, 30]; 1 with no signal yet."""
+        return self._drain_rate.retry_after_s(backlog)
+
+    def _qos_admission(self, route: str, model: str, tenant: str, cls: str,
+                       cost_tokens: float) -> Optional[web.Response]:
+        """Per-tenant quota check (BEFORE the shared caps, so one tenant's
+        burst is shed as that tenant's 429 instead of consuming the shared
+        DYN_MAX_INFLIGHT budget): None = admitted (bucket charged), else
+        the 429. Retry-After for rate rejections derives from the tenant's
+        own bucket refill time, for inflight rejections from drain."""
+        verdict = self.quotas.admit(tenant, cost_tokens)
+        if verdict is None:
+            return None
+        reason, retry_after = verdict
+        if reason == "tenant_inflight":
+            retry_after = max(retry_after, self._retry_after(1))
+        return self._overloaded_response(route, model, reason,
+                                         retry_after=retry_after,
+                                         tenant=tenant, cls=cls)
+
+    def _admission(self, route: str, model: str,
+                   tenant: Optional[str] = None,
+                   cls: Optional[str] = None) -> Optional[web.Response]:
         """Admission control: None = admitted, else the rejection response.
 
         Sheds with OpenAI-style 429 + ``Retry-After`` BEFORE any pipeline
@@ -210,9 +330,11 @@ class HttpService:
         if self._draining:
             self._rejected.inc(route=route, model=model, reason="draining")
             self._requests.inc(route=route, model=model, status="503")
+            # how long until everything in flight has drained
+            ra = self._retry_after(max(1, self._inflight_count))
             return web.json_response(
                 error_body("server is draining", "service_unavailable", 503),
-                status=503, headers={"Retry-After": "1"})
+                status=503, headers={"Retry-After": str(ra)})
         if self.max_inflight and self._inflight_count >= self.max_inflight:
             reason = "max_inflight"
         elif (self.max_queue
@@ -220,19 +342,32 @@ class HttpService:
             reason = "max_queue"
         if reason is None:
             return None
-        return self._overloaded_response(route, model, reason)
+        return self._overloaded_response(route, model, reason,
+                                         tenant=tenant, cls=cls)
 
-    def _overloaded_response(self, route: str, model: str,
-                             reason: str) -> web.Response:
-        """The ONE 429 + Retry-After contract — frontend admission sheds
-        and worker-fleet sheds must stay byte-identical so clients back
-        off the same way regardless of which layer rejected."""
+    def _overloaded_response(self, route: str, model: str, reason: str,
+                             retry_after: Optional[int] = None,
+                             tenant: Optional[str] = None,
+                             cls: Optional[str] = None) -> web.Response:
+        """The ONE 429 + Retry-After contract — frontend admission sheds,
+        tenant-quota sheds, and worker-fleet sheds must stay identical in
+        shape so clients back off the same way regardless of which layer
+        rejected. Retry-After is an estimate from the observed queue drain
+        rate (or the quota's refill time), clamped to [1, 30] s."""
         self._rejected.inc(route=route, model=model, reason=reason)
         self._requests.inc(route=route, model=model, status="429")
+        if tenant is not None:
+            self._tenant_rejected.inc(route=route, tenant=tenant,
+                                      qos=cls or "standard", reason=reason)
+        if retry_after is None:
+            # one slot must free before this client can be admitted
+            backlog = max(1, self._inflight_count - self.max_inflight + 1
+                          if self.max_inflight else 1)
+            retry_after = self._retry_after(backlog)
         return web.json_response(
             error_body(f"server overloaded ({reason}); retry after the "
                        "indicated delay", "overloaded", 429),
-            status=429, headers={"Retry-After": "1"})
+            status=429, headers={"Retry-After": str(int(retry_after))})
 
     def _deadline_reject(self, route: str, model: str,
                          reason: str = "deadline") -> web.Response:
@@ -258,13 +393,18 @@ class HttpService:
             logger.warning("drain timeout: %d requests still in flight",
                            self._inflight_count)
 
-    def _record_usage(self, model: str, usage: Optional[dict]) -> None:
+    def _record_usage(self, model: str, usage: Optional[dict],
+                      ctx: Optional[Context] = None) -> None:
         if not usage:
             return
         self._prompt_tokens.inc(usage.get("prompt_tokens", 0) or 0, model=model)
         self._completion_tokens.inc(usage.get("completion_tokens", 0) or 0,
                                     model=model)
         self._finished.inc(model=model)
+        if ctx is not None and ctx.tenant is not None:
+            self._tenant_tokens.inc(
+                usage.get("completion_tokens", 0) or 0,
+                tenant=ctx.tenant, qos=ctx.priority or "standard")
 
     def build_app(self) -> web.Application:
         app = web.Application(client_max_size=32 * 1024 * 1024)
@@ -307,10 +447,17 @@ class HttpService:
         if self._runner:
             await self._runner.cleanup()
 
-    def _request_context(self, request: web.Request) -> Context:
+    def _request_context(self, request: web.Request,
+                         tenant: Optional[str] = None,
+                         priority: Optional[str] = None) -> Context:
         """Per-request Context: honor inbound request-id/traceparent headers
-        and bind the contextvar so frontend log lines carry the id."""
+        and bind the contextvar so frontend log lines carry the id. QoS
+        identity (tenant + priority class) is stamped here so every
+        downstream hop — router bias, engine fair queues, span tags —
+        reads one authoritative source."""
         ctx = Context()
+        ctx.tenant = tenant
+        ctx.priority = priority
         rid = (request.headers.get("x-request-id")
                or request.headers.get("x-dynamo-request-id"))
         if rid:
@@ -552,21 +699,36 @@ class HttpService:
                 error_body(f"model '{parsed.model}' not found",
                            "model_not_found", 404), status=404)
 
-        rejection = self._admission("responses", parsed.model)
+        tenant, qos_class = self._resolve_qos(request)
+        cost = parsed.stop.max_tokens or self.qos.default_cost
+        rejection = self._qos_admission(
+            "responses", parsed.model, tenant, qos_class, cost)
         if rejection is not None:
             return rejection
-        ctx = self._request_context(request)
+        rejection = self._admission("responses", parsed.model,
+                                    tenant=tenant, cls=qos_class)
+        if rejection is not None:
+            # the quota charge above bought no service — refund it, or
+            # retries through an overloaded frontend drain the bucket
+            self.quotas.refund(tenant, cost)
+            return rejection
+        ctx = self._request_context(request, tenant=tenant,
+                                    priority=qos_class)
         if ctx.expired:
+            self.quotas.refund(tenant, cost)
             return self._deadline_reject("responses", parsed.model)
         rid = gen_request_id("resp")
         created = int(time.time())
-        self._begin_request(parsed.model)
+        self._begin_request(parsed.model, tenant)
+        self._tenant_requests.inc(route="responses", tenant=tenant,
+                                  qos=qos_class)
         # root span (same contract as _handle_llm): downstream phases must
         # have a recorded parent or the trace renders as an orphan forest
         with self.tracer.span(
                 "http.request", ctx, service="frontend",
                 adopt_wire_span=ctx.traceparent_synthesized,
-                route="responses", model=parsed.model):
+                route="responses", model=parsed.model,
+                tenant=tenant, qos=qos_class):
             return await self._handle_responses_inner(
                 request, served, parsed, ctx, rid, created, t0)
 
@@ -595,7 +757,7 @@ class HttpService:
                 self._requests.inc(route="responses", model=parsed.model,
                                    status="400")
                 return web.json_response(error_body(str(e)), status=400)
-            self._record_usage(parsed.model, result.get("usage"))
+            self._record_usage(parsed.model, result.get("usage"), ctx=ctx)
             choice = result["choices"][0]
             text = choice["message"].get("content") or ""
             # responses-API status: max_output_tokens truncation reports
@@ -610,7 +772,7 @@ class HttpService:
                 out["incomplete_details"] = {"reason": "max_output_tokens"}
             return web.json_response(out, headers={"x-request-id": ctx.id})
         finally:
-            self._end_request(parsed.model)
+            self._end_request(parsed.model, ctx.tenant)
 
     async def _stream_responses_sse(self, request, stream, ctx, model,
                                     rid, created, t0) -> web.StreamResponse:
@@ -659,7 +821,7 @@ class HttpService:
                     chunk = ann.data
                     if chunk.get("usage"):
                         usage = chunk["usage"]
-                        self._record_usage(model, usage)
+                        self._record_usage(model, usage, ctx=ctx)
                     for ch in chunk.get("choices", []):
                         delta = (ch.get("delta") or {}).get("content")
                         finish = ch.get("finish_reason") or finish
@@ -762,15 +924,28 @@ class HttpService:
                 status=404,
             )
 
-        rejection = self._admission(route, parsed.model)
+        tenant, qos_class = self._resolve_qos(request)
+        cost = parsed.stop.max_tokens or self.qos.default_cost
+        rejection = self._qos_admission(
+            route, parsed.model, tenant, qos_class, cost)
         if rejection is not None:
             return rejection
-        ctx = self._request_context(request)
+        rejection = self._admission(route, parsed.model,
+                                    tenant=tenant, cls=qos_class)
+        if rejection is not None:
+            # the quota charge above bought no service — refund it, or
+            # retries through an overloaded frontend drain the bucket
+            self.quotas.refund(tenant, cost)
+            return rejection
+        ctx = self._request_context(request, tenant=tenant,
+                                    priority=qos_class)
         if ctx.expired:
             # expired on arrival (e.g. X-Request-Timeout-Ms: 0, or queued
             # behind a slow LB): reject with 408 before any worker sees it
+            self.quotas.refund(tenant, cost)
             return self._deadline_reject(route, parsed.model)
-        self._begin_request(parsed.model)
+        self._begin_request(parsed.model, tenant)
+        self._tenant_requests.inc(route=route, tenant=tenant, qos=qos_class)
         # root span: every downstream phase (tokenize, route, worker,
         # engine, TTFT/ITL) parents under it; duration feeds
         # dynamo_e2e_seconds via the tracer's SLO registry. When WE
@@ -779,7 +954,8 @@ class HttpService:
         with self.tracer.span(
                 "http.request", ctx, service="frontend",
                 adopt_wire_span=ctx.traceparent_synthesized,
-                route=route, model=parsed.model) as root:
+                route=route, model=parsed.model,
+                tenant=tenant, qos=qos_class) as root:
             try:
                 stream = served.pipeline.generate(parsed, ctx)
                 if parsed.stream:
@@ -789,7 +965,8 @@ class HttpService:
                 try:
                     agg = aggregate_chat_stream(stream) if chat else aggregate_completion_stream(stream)
                     result = await agg
-                    self._record_usage(parsed.model, result.get("usage"))
+                    self._record_usage(parsed.model, result.get("usage"),
+                                       ctx=ctx)
                 except DeadlineExceededError:
                     root.set(status_code=408)
                     return self._deadline_reject(route, parsed.model,
@@ -815,7 +992,7 @@ class HttpService:
                 self._latency.observe(time.perf_counter() - t0, route=route)
                 return web.json_response(result, headers={"x-request-id": ctx.id})
             finally:
-                self._end_request(parsed.model)
+                self._end_request(parsed.model, tenant)
 
     async def _stream_sse(
         self, request: web.Request, stream, ctx: Context, route: str,
@@ -856,7 +1033,7 @@ class HttpService:
                     if isinstance(data, dict) and "usage" in data:
                         # the pipeline always attaches final-chunk usage for
                         # metrics; only clients that asked get it on the wire
-                        self._record_usage(model, data.get("usage"))
+                        self._record_usage(model, data.get("usage"), ctx=ctx)
                         if not keep_usage:
                             data = {k: v for k, v in data.items() if k != "usage"}
                     buf += f"data: {json.dumps(data)}\n\n".encode()

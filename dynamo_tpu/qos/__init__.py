@@ -1,0 +1,267 @@
+"""Multi-tenant QoS: priority classes, tenant identity, and policy config.
+
+The policy plane over the mechanisms earlier PRs built — deadlines and
+admission control (docs/robustness.md), preempt-to-swap (docs/performance.md),
+cost-based KV routing. Every request now carries a ``tenant`` id and a
+``priority`` class end-to-end (Context wire fields, backward-compatible with
+peers that omit them) and each layer consults this module's policy:
+
+- the frontend maps API keys / the ``x-dynamo-tenant`` header to a tenant,
+  enforces per-tenant token-rate + inflight quotas (qos/quota.py),
+- the engine scheduler drains per-class queues with VTC-style weighted-fair
+  virtual token counters and picks preemption victims lowest-priority /
+  highest-debt first (qos/fair.py),
+- the KV router biases its cost function so interactive requests avoid
+  saturated workers (router/scheduler.py).
+
+Related work: tiered KV residency as a scheduling-policy problem (From
+Tensor Buffer to Distributed Memory Hierarchy, arxiv 2607.02574); per-class
+signals on the wire for SLO-aware selection (NetKV, arxiv 2606.03910);
+VTC weighted fairness (Sheng et al., Fairness in Serving Large Language
+Models, OSDI'24 — the virtual-token-counter scheme the scheduler borrows).
+
+Knobs (``DYN_QOS_*`` — docs/qos.md):
+
+- ``DYN_QOS_WEIGHTS``              — ``interactive=4,standard=2,batch=1``
+- ``DYN_QOS_AGING_S``              — waiting/swapped age that bypasses the
+  fair order (starvation guard; 0 disables)
+- ``DYN_QOS_TENANT_RATE``          — default token-bucket refill, tokens/s
+  per tenant (0 = unlimited)
+- ``DYN_QOS_TENANT_BURST``         — default bucket capacity (tokens)
+- ``DYN_QOS_TENANT_MAX_INFLIGHT``  — default per-tenant inflight cap (0 = off)
+- ``DYN_QOS_DEFAULT_COST``         — tokens charged when a request carries
+  no max_tokens (quota accounting only)
+- ``DYN_QOS_MAX_TENANTS``          — distinct self-declared (header-only)
+  tenant ids the frontend will track before demoting new ones to
+  "default" (bounds per-tenant state + metric cardinality; 0 = header
+  tenants disabled entirely)
+- ``DYN_QOS_TENANTS``              — JSON per-tenant overrides, e.g.
+  ``{"acme": {"priority": "interactive", "rate": 500, "burst": 2000,
+  "max_inflight": 8, "weight": 8, "api_keys": ["sk-acme-1"]}}``
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.runtime.config import ConfigError
+
+logger = logging.getLogger("dynamo.qos")
+
+
+class PriorityClass:
+    INTERACTIVE = "interactive"
+    STANDARD = "standard"
+    BATCH = "batch"
+
+
+#: every legal class, best-first. Rank = index: LOWER ranks are admitted
+#: first on ties and preempted last.
+CLASSES = (PriorityClass.INTERACTIVE, PriorityClass.STANDARD,
+           PriorityClass.BATCH)
+CLASS_RANK = {c: i for i, c in enumerate(CLASSES)}
+DEFAULT_CLASS = PriorityClass.STANDARD
+DEFAULT_TENANT = "default"
+
+_DEFAULT_WEIGHTS = {PriorityClass.INTERACTIVE: 4.0,
+                    PriorityClass.STANDARD: 2.0,
+                    PriorityClass.BATCH: 1.0}
+
+
+def normalize_priority(raw, *, warn: bool = True,
+                       default: Optional[str] = None) -> str:
+    """Map a wire/header priority string onto a known class.
+
+    None/empty (field absent — legacy peer) maps silently to ``default``
+    (the global default class unless the caller knows better — e.g. the
+    frontend passes the tenant's configured class, so a key-authenticated
+    batch tenant's typo'd header cannot silently escalate it to
+    "standard"); a malformed value falls back WITH a warning rather than
+    failing the request — a typo'd header must degrade service class, not
+    availability.
+    """
+    default = DEFAULT_CLASS if default is None else default
+    if raw is None or raw == "":
+        return default
+    cls = str(raw).strip().lower()
+    if cls in CLASS_RANK:
+        return cls
+    if warn:
+        logger.warning("unknown priority class %r; using %r", raw, default)
+    return default
+
+
+@dataclass
+class TenantPolicy:
+    """Per-tenant overrides (DYN_QOS_TENANTS entries)."""
+
+    priority: Optional[str] = None     # default class for the tenant
+    rate: Optional[float] = None       # token-bucket refill tokens/s
+    burst: Optional[float] = None      # bucket capacity
+    max_inflight: Optional[int] = None
+    weight: Optional[float] = None     # fair-share weight (overrides class)
+    api_keys: tuple = ()
+
+
+@dataclass
+class QosConfig:
+    """The QoS policy: class weights, quotas, aging. Env-loadable."""
+
+    weights: dict = field(default_factory=lambda: dict(_DEFAULT_WEIGHTS))
+    #: seconds a waiting/swapped sequence may age before it bypasses the
+    #: fair order entirely (anti-starvation); 0 disables aging
+    aging_s: float = 30.0
+    #: default per-tenant token-bucket refill (tokens/s); 0 = unlimited
+    tenant_rate: float = 0.0
+    #: default bucket capacity; 0 = 4x rate
+    tenant_burst: float = 0.0
+    #: default per-tenant inflight cap; 0 = unbounded
+    tenant_max_inflight: int = 0
+    #: tokens charged against the bucket when a request has no max_tokens
+    default_cost: int = 256
+    #: distinct ad-hoc (x-dynamo-tenant, unconfigured) tenant ids admitted
+    #: before new names demote to "default" — an attacker looping random
+    #: ids must not grow per-tenant buckets/counters/virtual-time entries
+    #: and /metrics label cardinality without bound; 0 disables header
+    #: tenants outright. Configured tenants are never subject to the cap.
+    max_adhoc_tenants: int = 1024
+    tenants: dict = field(default_factory=dict)  # name -> TenantPolicy
+    _key_to_tenant: dict = field(default_factory=dict, repr=False)
+
+    def __post_init__(self):
+        for c, w in self.weights.items():
+            if c not in CLASS_RANK:
+                raise ConfigError(f"DYN_QOS_WEIGHTS: unknown class {c!r}")
+            if not w > 0:
+                raise ConfigError(
+                    f"DYN_QOS_WEIGHTS: weight for {c!r} must be > 0")
+        for c in CLASSES:
+            self.weights.setdefault(c, _DEFAULT_WEIGHTS[c])
+        if self.aging_s < 0:
+            raise ConfigError("DYN_QOS_AGING_S: must be >= 0")
+        if self.tenant_rate < 0 or self.tenant_burst < 0:
+            raise ConfigError("DYN_QOS_TENANT_RATE/BURST: must be >= 0")
+        if self.tenant_max_inflight < 0:
+            raise ConfigError("DYN_QOS_TENANT_MAX_INFLIGHT: must be >= 0")
+        if self.default_cost < 1:
+            raise ConfigError("DYN_QOS_DEFAULT_COST: must be >= 1")
+        if self.max_adhoc_tenants < 0:
+            raise ConfigError("DYN_QOS_MAX_TENANTS: must be >= 0")
+        self._key_to_tenant = {}
+        for name, pol in self.tenants.items():
+            if pol.priority is not None and pol.priority not in CLASS_RANK:
+                raise ConfigError(
+                    f"DYN_QOS_TENANTS[{name!r}].priority: unknown class "
+                    f"{pol.priority!r}")
+            if pol.weight is not None and not pol.weight > 0:
+                raise ConfigError(
+                    f"DYN_QOS_TENANTS[{name!r}].weight: must be > 0")
+            for key in pol.api_keys:
+                self._key_to_tenant[key] = name
+
+    # -- resolution --------------------------------------------------------
+
+    def tenant_for_api_key(self, key: Optional[str]) -> Optional[str]:
+        if not key:
+            return None
+        return self._key_to_tenant.get(key)
+
+    def default_priority(self, tenant: str) -> str:
+        pol = self.tenants.get(tenant)
+        if pol is not None and pol.priority:
+            return pol.priority
+        return DEFAULT_CLASS
+
+    def weight_for(self, tenant: str, cls: str) -> float:
+        """Fair-share weight: the tenant override wins, else class weight."""
+        pol = self.tenants.get(tenant)
+        if pol is not None and pol.weight is not None:
+            return pol.weight
+        return self.weights.get(cls, _DEFAULT_WEIGHTS[DEFAULT_CLASS])
+
+    def rate_for(self, tenant: str) -> tuple[float, float]:
+        """(refill tokens/s, burst capacity); (0, _) = unlimited."""
+        pol = self.tenants.get(tenant)
+        rate = pol.rate if pol is not None and pol.rate is not None \
+            else self.tenant_rate
+        burst = pol.burst if pol is not None and pol.burst is not None \
+            else self.tenant_burst
+        if rate > 0 and burst <= 0:
+            burst = 4.0 * rate
+        return rate, burst
+
+    def max_inflight_for(self, tenant: str) -> int:
+        pol = self.tenants.get(tenant)
+        if pol is not None and pol.max_inflight is not None:
+            return pol.max_inflight
+        return self.tenant_max_inflight
+
+    # -- env loading -------------------------------------------------------
+
+    @classmethod
+    def load(cls, env: Optional[dict] = None) -> "QosConfig":
+        env = os.environ if env is None else env
+        kw: dict = {}
+        raw = env.get("DYN_QOS_WEIGHTS")
+        if raw:
+            weights: dict = {}
+            for part in raw.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                if "=" not in part:
+                    raise ConfigError(
+                        f"DYN_QOS_WEIGHTS: expected class=weight, got {part!r}")
+                name, _, val = part.partition("=")
+                try:
+                    weights[name.strip().lower()] = float(val)
+                except ValueError:
+                    raise ConfigError(
+                        f"DYN_QOS_WEIGHTS: bad weight {val!r}") from None
+            kw["weights"] = weights
+        for key, fld, typ in (("DYN_QOS_AGING_S", "aging_s", float),
+                              ("DYN_QOS_TENANT_RATE", "tenant_rate", float),
+                              ("DYN_QOS_TENANT_BURST", "tenant_burst", float),
+                              ("DYN_QOS_TENANT_MAX_INFLIGHT",
+                               "tenant_max_inflight", int),
+                              ("DYN_QOS_DEFAULT_COST", "default_cost", int),
+                              ("DYN_QOS_MAX_TENANTS",
+                               "max_adhoc_tenants", int)):
+            if key in env:
+                try:
+                    kw[fld] = typ(str(env[key]).strip())
+                except ValueError:
+                    raise ConfigError(
+                        f"{key}: not a {typ.__name__}: {env[key]!r}") from None
+        raw = env.get("DYN_QOS_TENANTS")
+        if raw:
+            try:
+                parsed = json.loads(raw)
+            except json.JSONDecodeError as e:
+                raise ConfigError(f"DYN_QOS_TENANTS: bad JSON: {e}") from None
+            if not isinstance(parsed, dict):
+                raise ConfigError("DYN_QOS_TENANTS: must be a JSON object")
+            tenants = {}
+            for name, spec in parsed.items():
+                if not isinstance(spec, dict):
+                    raise ConfigError(
+                        f"DYN_QOS_TENANTS[{name!r}]: must be an object")
+                unknown = set(spec) - {"priority", "rate", "burst",
+                                       "max_inflight", "weight", "api_keys"}
+                if unknown:
+                    raise ConfigError(
+                        f"DYN_QOS_TENANTS[{name!r}]: unknown key(s) "
+                        f"{sorted(unknown)}")
+                tenants[name] = TenantPolicy(
+                    priority=spec.get("priority"),
+                    rate=spec.get("rate"),
+                    burst=spec.get("burst"),
+                    max_inflight=spec.get("max_inflight"),
+                    weight=spec.get("weight"),
+                    api_keys=tuple(spec.get("api_keys") or ()))
+            kw["tenants"] = tenants
+        return cls(**kw)

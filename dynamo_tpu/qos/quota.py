@@ -1,0 +1,174 @@
+"""Per-tenant quotas and Retry-After estimation (frontend admission).
+
+Two enforcement surfaces (docs/qos.md):
+
+- :class:`TokenBucket` / :class:`TenantQuotas` — per-tenant token-rate and
+  inflight caps, checked BEFORE the global admission caps so one tenant's
+  burst is shed as *that tenant's* 429 instead of eating the shared
+  DYN_MAX_INFLIGHT budget.
+- :class:`DrainRateEstimator` — replaces the old hardcoded
+  ``Retry-After: 1`` on 429/503 with an estimate derived from the observed
+  request drain rate (completions/second over a sliding window), clamped
+  to [1, 30] s. Quota rejections instead derive Retry-After from the
+  tenant's own bucket refill time.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from typing import Optional
+
+#: Retry-After clamp (seconds): never tell a client to come back sooner
+#: than 1 s (herd) or later than 30 s (a stale estimate must not park
+#: well-behaved clients for minutes)
+RETRY_AFTER_MIN_S = 1
+RETRY_AFTER_MAX_S = 30
+
+
+def clamp_retry_after(seconds: float) -> int:
+    if seconds != seconds or seconds == float("inf"):  # NaN/inf guard
+        return RETRY_AFTER_MAX_S
+    return int(min(RETRY_AFTER_MAX_S,
+                   max(RETRY_AFTER_MIN_S, math.ceil(seconds))))
+
+
+class TokenBucket:
+    """Classic token bucket; monotonic clock injectable for tests."""
+
+    def __init__(self, rate: float, burst: float, clock=time.monotonic):
+        self.rate = float(rate)          # tokens/s refill
+        self.burst = float(burst)        # capacity
+        self._clock = clock
+        self._level = self.burst
+        self._t = clock()
+
+    def _refill(self) -> None:
+        now = self._clock()
+        self._level = min(self.burst,
+                          self._level + (now - self._t) * self.rate)
+        self._t = now
+
+    def try_take(self, cost: float) -> Optional[float]:
+        """Take ``cost`` tokens; None on success, else seconds until the
+        bucket could cover the request (for Retry-After)."""
+        self._refill()
+        if self._level >= cost:
+            self._level -= cost
+            return None
+        # a cost larger than the whole bucket can never be served; report
+        # the time to refill to FULL so the client backs off maximally
+        deficit = min(cost, self.burst) - self._level
+        if self.rate <= 0:
+            return float("inf")
+        return deficit / self.rate
+
+    def put(self, cost: float) -> None:
+        """Return ``cost`` tokens (a charged request that was never
+        served), capped at capacity."""
+        self._refill()
+        self._level = min(self.burst, self._level + cost)
+
+    @property
+    def level(self) -> float:
+        self._refill()
+        return self._level
+
+
+class DrainRateEstimator:
+    """Observed completion rate → Retry-After seconds.
+
+    ``note()`` on every finished request; ``retry_after_s(backlog)``
+    answers "how long until ``backlog`` requests have drained" from the
+    completions/second measured over the last ``maxlen`` finishes. With no
+    history (cold start) the answer degrades to the old constant 1 s.
+    """
+
+    def __init__(self, maxlen: int = 64, clock=time.monotonic):
+        self._done: deque[float] = deque(maxlen=maxlen)
+        self._clock = clock
+
+    def note(self) -> None:
+        self._done.append(self._clock())
+
+    def rate(self) -> Optional[float]:
+        """Completions per second over the window; None = no signal."""
+        if len(self._done) < 2:
+            return None
+        span = self._done[-1] - self._done[0]
+        if span <= 0:
+            return None
+        # stale window: if the newest completion is far older than the
+        # window span, the measured rate no longer describes the present
+        age = self._clock() - self._done[-1]
+        return (len(self._done) - 1) / (span + age)
+
+    def retry_after_s(self, backlog: int) -> int:
+        r = self.rate()
+        if r is None or r <= 0:
+            return RETRY_AFTER_MIN_S
+        return clamp_retry_after(max(1, backlog) / r)
+
+
+class TenantQuotas:
+    """Per-tenant admission state: token buckets + inflight counts."""
+
+    def __init__(self, cfg, clock=time.monotonic):
+        self.cfg = cfg
+        self._clock = clock
+        self._buckets: dict[str, TokenBucket] = {}
+        self._inflight: dict[str, int] = {}
+
+    def _bucket(self, tenant: str) -> Optional[TokenBucket]:
+        rate, burst = self.cfg.rate_for(tenant)
+        if rate <= 0:
+            return None
+        b = self._buckets.get(tenant)
+        if b is None or b.rate != rate or b.burst != burst:
+            b = TokenBucket(rate, burst, clock=self._clock)
+            self._buckets[tenant] = b
+        return b
+
+    def inflight(self, tenant: str) -> int:
+        return self._inflight.get(tenant, 0)
+
+    def admit(self, tenant: str, cost_tokens: float
+              ) -> Optional[tuple[str, int]]:
+        """None = admitted (bucket charged); else (reason, retry_after_s).
+
+        Inflight caps are checked first (no bucket charge for a request
+        that is shed anyway); the caller pairs an admit with begin()/end().
+        """
+        cap = self.cfg.max_inflight_for(tenant)
+        if cap and self.inflight(tenant) >= cap:
+            # the tenant's own concurrency must drain; without a per-tenant
+            # drain series the bucket refill horizon is the best local
+            # signal, falling back to the 1 s floor
+            return "tenant_inflight", RETRY_AFTER_MIN_S
+        bucket = self._bucket(tenant)
+        if bucket is not None:
+            wait = bucket.try_take(cost_tokens)
+            if wait is not None:
+                return "tenant_rate", clamp_retry_after(wait)
+        return None
+
+    def refund(self, tenant: str, cost_tokens: float) -> None:
+        """Undo an ``admit`` charge for a request rejected downstream
+        (shared admission caps, pre-dispatch deadline) before any service
+        was rendered — without this, a tenant retrying through an
+        overloaded frontend drains its own bucket on requests that never
+        ran and its later rejections get misattributed to tenant_rate."""
+        bucket = self._buckets.get(tenant)
+        if bucket is not None:
+            bucket.put(cost_tokens)
+
+    def begin(self, tenant: str) -> None:
+        self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+
+    def end(self, tenant: str) -> None:
+        n = self._inflight.get(tenant, 1) - 1
+        if n <= 0:
+            self._inflight.pop(tenant, None)
+        else:
+            self._inflight[tenant] = n

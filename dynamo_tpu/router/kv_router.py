@@ -131,6 +131,7 @@ class KvRouter:
         token_ids: list[int],
         worker_ids: list[int],
         router_config_override: Optional[dict] = None,
+        priority: Optional[str] = None,
     ) -> SchedulingDecision:
         local = compute_block_hash_for_seq(token_ids, self.block_size)
         seq_hashes = compute_seq_hash_for_block(local)
@@ -142,6 +143,7 @@ class KvRouter:
             overlaps=overlaps,
             worker_ids=worker_ids,
             router_config_override=router_config_override,
+            priority=priority,
         )
         if isinstance(self.indexer, ApproxKvIndexer):
             self.indexer.process_routing_decision_for_request(token_ids, decision.worker_id)
@@ -198,14 +200,20 @@ class KvPushRouter:
             if not worker_ids:
                 worker_ids = await self.client.wait_for_instances(timeout=5.0)
             try:
+                # class-biased cost (docs/qos.md): interactive requests
+                # avoid saturated workers, batch chases cache overlap
                 decision = self.router.find_best_match(
-                    ctx.id, req.token_ids, worker_ids, req.router_config_override
+                    ctx.id, req.token_ids, worker_ids,
+                    req.router_config_override,
+                    priority=getattr(ctx, "priority", None),
                 )
             except NoWorkersError as e:
                 raise NoRespondersError(str(e)) from e
             sp.set(worker_id=f"{decision.worker_id:x}",
                    overlap_blocks=decision.overlap_blocks,
-                   candidates=len(worker_ids))
+                   candidates=len(worker_ids),
+                   tenant=getattr(ctx, "tenant", None) or "default",
+                   qos=getattr(ctx, "priority", None) or "standard")
 
         if req.has_annotation("query_instance_id"):
             # dry route: report the decision without generating

@@ -160,6 +160,14 @@ class KvRouterConfig:
     router_track_active_blocks: bool = True
     router_snapshot_threshold: Optional[int] = 10000
     router_reset_states: bool = False
+    #: multi-tenant QoS (docs/qos.md): multiplier on the LOAD term of the
+    #: cost function by priority class. Interactive requests weigh a
+    #: worker's active decode load heavier (they flee saturated workers
+    #: even at the cost of some prefix-cache overlap); batch requests
+    #: discount it (they chase cache hits and tolerate queueing). 1.0 for
+    #: both disables the bias. The standard class always uses 1.0.
+    qos_interactive_load_factor: float = 2.0
+    qos_batch_load_factor: float = 0.5
 
 
 @dataclass

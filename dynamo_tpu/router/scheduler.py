@@ -84,6 +84,17 @@ class KvScheduler:
     def update_workers(self, worker_ids: list[int]):
         self.slots.update_workers(worker_ids)
 
+    def _load_factor(self, priority: Optional[str]) -> float:
+        """QoS bias on the load term (docs/qos.md): interactive requests
+        penalize a worker's active decode load harder — they route away
+        from saturated workers even at some prefix-overlap cost — while
+        batch requests discount it and chase cache hits."""
+        if priority == "interactive":
+            return self.config.qos_interactive_load_factor
+        if priority == "batch":
+            return self.config.qos_batch_load_factor
+        return 1.0
+
     def schedule(
         self,
         request_id: str,
@@ -92,6 +103,7 @@ class KvScheduler:
         overlaps: OverlapScores,
         worker_ids: list[int],
         router_config_override: Optional[dict] = None,
+        priority: Optional[str] = None,
     ) -> SchedulingDecision:
         if not worker_ids:
             raise NoWorkersError("no workers available")
@@ -102,6 +114,7 @@ class KvScheduler:
         override = router_config_override or {}
         overlap_weight = override.get("overlap_score_weight", self.config.overlap_score_weight)
         temperature = override.get("router_temperature", self.config.router_temperature)
+        load_factor = self._load_factor(priority)
 
         track = seq_hashes if self.config.router_track_active_blocks else None
         decode_blocks, prefill_tokens = self.slots.potential_blocks_and_tokens(
@@ -114,7 +127,8 @@ class KvScheduler:
             pt = prefill_tokens.get(w, isl_tokens)
             potential_prefill_block = pt / self.block_size
             decode_block = float(decode_blocks.get(w, math.floor(potential_prefill_block)))
-            logits[w] = overlap_weight * potential_prefill_block + decode_block
+            logits[w] = (overlap_weight * potential_prefill_block
+                         + load_factor * decode_block)
 
         worker_id = softmax_sample(logits, temperature, self._rng)
         overlap = overlaps.scores.get(worker_id, 0)

@@ -97,6 +97,14 @@ class Context:
     #: REMAINING budget in ms and re-anchor it to the receiver's clock, so
     #: cross-host clock skew cannot poison downstream hops.
     deadline: Optional[float] = None
+    #: multi-tenant QoS (docs/qos.md): tenant id + priority class stamped
+    #: by the frontend, consulted by the engine scheduler (weighted-fair
+    #: admission, victim selection) and the KV router (class-biased cost).
+    #: None = unspecified — peers that predate QoS omit both fields and
+    #: every consumer applies defaults ("default" tenant, "standard"
+    #: class), so the wire stays backward-compatible in both directions.
+    tenant: Optional[str] = None
+    priority: Optional[str] = None
     _cancel_event: asyncio.Event = field(default_factory=asyncio.Event, repr=False)
 
     def cancel(self) -> None:
@@ -128,7 +136,8 @@ class Context:
     def child(self) -> "Context":
         """A child context sharing the cancellation token, deadline and id."""
         c = Context(id=self.id, annotations=dict(self.annotations),
-                    traceparent=self.traceparent, deadline=self.deadline)
+                    traceparent=self.traceparent, deadline=self.deadline,
+                    tenant=self.tenant, priority=self.priority)
         c._cancel_event = self._cancel_event
         return c
 
@@ -179,14 +188,32 @@ class Context:
             # monotonic clock, so skew between hosts cannot extend or
             # retro-expire the budget
             d["deadline_ms"] = max(0, int(rem * 1000))
+        # QoS fields ride the wire only when set: a pre-QoS peer never sees
+        # keys it does not understand, and one that omits them round-trips
+        # to the unspecified (defaulted) state
+        if self.tenant is not None:
+            d["tenant"] = self.tenant
+        if self.priority is not None:
+            d["priority"] = self.priority
         return d
 
     @staticmethod
     def from_wire(d: dict) -> "Context":
+        priority = d.get("priority")
+        if priority is not None:
+            # a malformed class from a peer degrades to the default WITH a
+            # warning instead of failing the request (same rule the
+            # frontend applies to the x-dynamo-priority header)
+            from dynamo_tpu.qos import normalize_priority
+
+            priority = normalize_priority(priority)
+        tenant = d.get("tenant")
         ctx = Context(
             id=d.get("id") or uuid.uuid4().hex,
             annotations=d.get("annotations") or {},
             traceparent=d.get("traceparent"),
+            tenant=str(tenant) if tenant is not None else None,
+            priority=priority,
         )
         if d.get("deadline_ms") is not None:
             ctx.set_timeout_ms(float(d["deadline_ms"]))

@@ -206,6 +206,38 @@ def test_traceparent_synthesis_and_child_spans():
     assert Context.from_wire(ctx2.to_wire()).traceparent.split("-")[1] == "a" * 32
 
 
+def test_context_tenant_priority_wire_roundtrip(caplog):
+    """QoS wire fields (docs/qos.md): tenant/priority survive
+    to_wire/from_wire, a legacy peer that sends NEITHER gets defaults with
+    no KeyError (and emits neither key back), and a malformed priority
+    string falls back to the default class with a warning."""
+    import logging
+
+    from dynamo_tpu.runtime.context import Context
+
+    ctx = Context(tenant="acme", priority="batch")
+    ctx.set_timeout_ms(5000)
+    back = Context.from_wire(ctx.to_wire())
+    assert back.tenant == "acme" and back.priority == "batch"
+    assert back.remaining_s() is not None  # deadline rides along unchanged
+    # child contexts keep the QoS identity (worker-side hops)
+    assert ctx.child().tenant == "acme" and ctx.child().priority == "batch"
+
+    # legacy peer: both fields absent — defaults applied, no KeyError,
+    # and the reply wire stays clean of keys the peer never sent
+    legacy = Context.from_wire({"id": "req-1", "annotations": {"k": "v"}})
+    assert legacy.tenant is None and legacy.priority is None
+    assert "tenant" not in legacy.to_wire()
+    assert "priority" not in legacy.to_wire()
+    assert legacy.annotations == {"k": "v"}
+
+    # malformed priority: fallback + warning, never a failed request
+    with caplog.at_level(logging.WARNING, logger="dynamo.qos"):
+        bad = Context.from_wire({"id": "req-2", "priority": "ultra!!"})
+    assert bad.priority == "standard"
+    assert any("ultra!!" in r.message for r in caplog.records)
+
+
 def test_runtime_config_layering(tmp_path):
     """defaults < config file < DYN_* env, typed coercion, loud failures
     (ref: config.rs:1-608 figment layering)."""
