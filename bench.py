@@ -19,6 +19,7 @@ kernel number ride along in "extra".
 
 import asyncio
 import json
+import math
 import os
 import tempfile
 import time
@@ -812,6 +813,267 @@ async def qos_bench(on_tpu: bool = False, reps: int = 4) -> dict:
     }
 
 
+async def autoscale_bench(duration_s: float = 40.0,
+                          chaos_spec: str = "stream.send:drop=0.02",
+                          chaos_seed: int = 1234) -> dict:
+    """``bench.py --autoscale``: the closed loop, end to end, under churn
+    (docs/autoscaling.md / ISSUE 6 acceptance).
+
+    A REAL fleet: a control-plane hub, an in-process frontend, and mocker
+    workers spawned as operator subprocesses (plannerRole: decode,
+    readiness-gated). The autoscale controller fuses frontend /metrics
+    scrapes with worker ForwardPassMetrics, runs the predictor + planner,
+    and actuates through the VirtualConnector SCALE_KEY the operator
+    follows — while a diurnal sine of QoS-mixed traffic (interactive /
+    standard / batch headers) runs one full cycle with seeded chaos
+    dropping 2% of worker token frames.
+
+    Asserts the Monday-morning contract: the loop scales up AND back down
+    autonomously, interactive TTFT p95 holds its SLO through the scale
+    events, batch traffic all completes (backlog drains), and usage-exact
+    token accounting shows ZERO loss across worker churn (drain +
+    migration absorb scale-downs and chaos)."""
+    import sys
+    import tempfile
+
+    import aiohttp
+    import yaml
+
+    from benchmarks.client import Mix, qos_headers, stream_request
+    from dynamo_tpu.autoscale import (
+        AutoscaleController, AutoscaleRunner, ObservationFuser, SloConfig,
+        make_planner, plane_readiness,
+    )
+    from dynamo_tpu.autoscale.slo import ClassSlo
+    from dynamo_tpu.deploy.operator import ProcessOperator
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+    from dynamo_tpu.planner.prometheus import PrometheusMetricsSource
+    from dynamo_tpu.planner.virtual_connector import VirtualConnector
+    from dynamo_tpu.router.publisher import MetricsAggregator
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.control_plane import ControlPlaneServer
+
+    MODEL, OSL, ISL_WORDS = "autoscale-bench", 24, 48
+    PERIOD = 36.0
+    BASE_RPS, AMP_RPS = 2.2, 1.8
+    INT_TTFT_SLO_MS = 1500.0  # 2-core CPU host: generous but honest
+
+    # one mocker worker ≈ 2 req/s at OSL 24 (speedup 0.05 → ~40ms decode
+    # steps, 2 seq slots); the sweeps tell the planner exactly that, so
+    # the sine's 0.4→4.0 req/s swing demands 1→2(3)→1 replicas
+    prefill_perf = PerfInterpolator([(1.0, 200.0), (2.0, 700.0),
+                                     (4.0, 2500.0)])
+    decode_perf = PerfInterpolator([(24.0, 10.0), (48.0, 40.0),
+                                    (96.0, 300.0)])
+    slo = SloConfig(
+        class_slos={
+            "interactive": ClassSlo(ttft_p95_ms=INT_TTFT_SLO_MS, itl_ms=40.0),
+            "standard": ClassSlo(ttft_p95_ms=6000.0, itl_ms=80.0),
+            "batch": ClassSlo(),
+        },
+        min_replicas=1, max_replicas=3,
+        cooldown_up_s=2.0, cooldown_down_s=8.0,
+        adjustment_interval_s=1.0, predictor="arima",
+        backlog_per_replica=3.0)
+
+    server = ControlPlaneServer(port=0)
+    addr = await server.start()
+    old_plane = os.environ.get("DYN_CONTROL_PLANE")
+    os.environ["DYN_CONTROL_PLANE"] = addr
+
+    tmp = tempfile.mkdtemp(prefix="autoscale-bench-")
+    spec_path = os.path.join(tmp, "graph.yaml")
+    # real worker capacity (~6 req/s) sits WELL above what the planner's
+    # sweeps claim a replica holds (~2 req/s): the controller scales
+    # proactively on predicted demand with headroom, the way a production
+    # SLO loop is provisioned — and completion rate then tracks the sine
+    # honestly on both slopes (a saturated fleet's completion rate reads
+    # as its own capacity, which would pin the predictor at the peak)
+    worker_cmd = [
+        sys.executable, "-m", "dynamo_tpu.mocker.main",
+        "--model", MODEL, "--component", "mocker",
+        "--block-size", "4", "--num-gpu-blocks", "4096",
+        "--max-num-seqs", "4", "--speedup-ratio", "0.1",
+        "--migration-limit", "50",
+    ]
+    with open(spec_path, "w") as f:
+        yaml.safe_dump({
+            "apiVersion": "dynamo.tpu/v1alpha1",
+            "kind": "DynamoGraphDeployment",
+            "metadata": {"name": "autoscale-bench"},
+            "spec": {"services": {"decode": {
+                "replicas": 1, "plannerRole": "decode",
+                "command": worker_cmd,
+                "env": {
+                    "DYN_CONTROL_PLANE": addr,
+                    "PYTHONPATH": os.pathsep.join(sys.path),
+                    "JAX_PLATFORMS": "cpu",
+                    # chaos lives in the WORKERS: token-frame drops are
+                    # where scale-down churn could lose tokens
+                    "DYN_CHAOS": chaos_spec,
+                    "DYN_CHAOS_SEED": str(chaos_seed),
+                    "DYN_DRAIN_TIMEOUT": "8",
+                    "DYN_LOG": "warning",
+                }}}},
+        }, f)
+
+    rt = await DistributedRuntime.create()
+    manager = ModelManager()
+    watcher = service = operator = aggregator = runner = None
+    results: list = []
+    by_class: dict = {}
+    replica_timeline: list[tuple[float, int]] = []
+    try:
+        watcher = await ModelWatcher(rt, manager, router_mode="kv").start()
+        service = HttpService(manager, port=0, runtime=rt)
+        await service.start()
+        operator = await ProcessOperator(
+            spec_path, plane=rt.plane, tick_s=0.25, drain_timeout=10.0
+        ).start()
+
+        aggregator = await MetricsAggregator(rt.plane,
+                                             stale_after_s=3.0).start()
+        frontend_url = f"http://127.0.0.1:{service.port}"
+        fuser = ObservationFuser(
+            PrometheusMetricsSource(frontend_url), aggregator)
+        # aggregated fleet: one decode-role service serves prefill+decode,
+        # so the prefill dimension is pinned — otherwise its (serviceless)
+        # replica math flaps and eats the shared cooldown windows
+        planner = make_planner(slo, prefill_perf, decode_perf,
+                               min_prefill_replicas=1,
+                               max_prefill_replicas=1)
+
+        async def readiness():
+            return await plane_readiness(rt.plane, "dynamo")
+
+        controller = AutoscaleController(
+            slo, planner, fuser, VirtualConnector(rt.plane),
+            readiness=readiness, metrics=rt.metrics, plane=rt.plane)
+        runner = await AutoscaleRunner(controller).start()
+
+        for _ in range(300):  # first worker registered + model discovered
+            if manager.list_models():
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("mocker fleet never appeared in discovery")
+
+        mix = Mix("interactive=0.5,standard=0.2,batch=0.3")
+        rng = np.random.default_rng(7)
+        import random as _random
+
+        prompt_rng = _random.Random(7)
+        from benchmarks.client import make_prompt
+
+        inflight: set = set()
+        t0 = time.monotonic()
+        # after the cycle, overnight-trough traffic trickles on while the
+        # loop steps the fleet back down (3→2→1 takes one cooldown window
+        # per step) — an abrupt stop would leave the predictors
+        # extrapolating from the final drain burst instead of the trough
+        tail_budget = 3 * slo.cooldown_down_s + 12.0
+        async with aiohttp.ClientSession() as session:
+            while (now := time.monotonic() - t0) < duration_s + tail_budget:
+                if now < duration_s:
+                    # diurnal cycle starting at the trough: ramp → peak at
+                    # PERIOD/2 → back down (sin phase-shifted by -π/2)
+                    rate = max(0.05, BASE_RPS + AMP_RPS * math.sin(
+                        2 * math.pi * now / PERIOD - math.pi / 2))
+                else:
+                    rate = 0.4  # overnight trickle
+                    if (controller.applied.decode_replicas
+                            == slo.min_replicas
+                            and operator._status()["services"]["decode"]
+                            ["ready"] == slo.min_replicas):
+                        break  # fleet settled at the floor
+                cls = mix.pick(prompt_rng)
+                task = asyncio.get_running_loop().create_task(
+                    stream_request(
+                        session, frontend_url, MODEL,
+                        make_prompt(prompt_rng, ISL_WORDS), OSL,
+                        headers=qos_headers(None, cls)))
+                inflight.add(task)
+
+                def _done(t, cls=cls):
+                    inflight.discard(t)
+                    results.append(t.result())
+                    by_class.setdefault(cls, []).append(t.result())
+
+                task.add_done_callback(_done)
+                replica_timeline.append(
+                    (round(now, 1), controller.applied.decode_replicas))
+                await asyncio.sleep(float(rng.exponential(1.0 / rate)))
+            if inflight:
+                await asyncio.gather(*inflight, return_exceptions=True)
+        final_fused = await fuser()
+        final_status = operator._status()
+    finally:
+        if runner is not None:
+            await runner.stop()
+        if aggregator is not None:
+            await aggregator.stop()
+        if operator is not None:
+            await operator.stop()  # drains the fleet
+        if service is not None:
+            await service.stop()
+        if watcher is not None:
+            await watcher.stop()
+        await rt.shutdown()
+        await server.stop()
+        if old_plane is None:
+            os.environ.pop("DYN_CONTROL_PLANE", None)
+        else:
+            os.environ["DYN_CONTROL_PLANE"] = old_plane
+
+    def p95(vals):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(len(vals) * 0.95))] if vals else None
+
+    ok = [r for r in results if r.ok]
+    lost_tokens = sum(OSL - r.completion_tokens for r in ok)
+    int_res = by_class.get("interactive", [])
+    bat_res = by_class.get("batch", [])
+    int_p95 = p95([r.ttft_s for r in int_res if r.ttft_s is not None])
+    peak_replicas = max((n for _t, n in replica_timeline), default=1)
+    svc = final_status["services"]["decode"]
+    out = {
+        "workload": (f"sine {BASE_RPS}±{AMP_RPS} req/s period {PERIOD}s "
+                     f"x {duration_s}s, OSL {OSL}, mix int/std/batch "
+                     f".5/.2/.3, chaos {chaos_spec}"),
+        "requests": len(results), "ok": len(ok),
+        "failed": len(results) - len(ok),
+        "lost_tokens": lost_tokens,
+        "int_ttft_p95_ms": (round(int_p95 * 1000, 1)
+                            if int_p95 is not None else None),
+        "int_ttft_slo_ms": INT_TTFT_SLO_MS,
+        "int_requests": len(int_res),
+        "batch_ok": sum(1 for r in bat_res if r.ok),
+        "batch_requests": len(bat_res),
+        "scale_ups": controller.scale_ups,
+        "scale_downs": controller.scale_downs,
+        "peak_replicas": peak_replicas,
+        "final_replicas_ready": svc["ready"],
+        "final_queue_depth": final_fused.queue_depth,
+        "deferred_for_readiness": controller.deferred_for_readiness,
+        "held_for_cooldown": controller.held_for_cooldown,
+        "drains_completed": final_status["drainsCompleted"],
+        "drains_killed": final_status["drainsKilled"],
+        "drain_seconds_total": final_status["drainSecondsTotal"],
+    }
+    out["autoscale_ok"] = bool(
+        out["failed"] == 0
+        and lost_tokens == 0
+        and out["scale_ups"] >= 1 and out["scale_downs"] >= 1
+        and peak_replicas >= 2
+        and out["final_replicas_ready"] == slo.min_replicas
+        and out["batch_ok"] == out["batch_requests"]
+        and out["final_queue_depth"] == 0
+        and int_p95 is not None and int_p95 * 1000 <= INT_TTFT_SLO_MS)
+    return out
+
+
 def _device_init_responsive(timeout_s: float = 240.0) -> bool:
     """Probe jax backend init in a SUBPROCESS: a broken TPU tunnel makes
     jax.devices() hang forever (observed: axon UNAVAILABLE wedged for
@@ -934,6 +1196,24 @@ def main():
               and set(out["qos_preempts_by_class"]) <= {"batch"})
         raise SystemExit(0 if ok else 1)
 
+    if "--autoscale" in sys.argv:
+        # closed-loop SLA autoscaling proof: a real operator-managed
+        # mocker fleet through a full diurnal cycle with chaos on — prints
+        # one JSON line; exits nonzero when the loop fails to scale both
+        # ways, loses tokens across churn, strands backlog, or breaches
+        # the interactive TTFT SLO (docs/autoscaling.md)
+        try:
+            out = asyncio.run(autoscale_bench())
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"autoscale": "failed",
+                              "error": repr(e)[:300]}), flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["autoscale_ok"] else 1)
+
     if "--chaos" in sys.argv:
         # chaos smoke: no accelerator, no child orchestration — prints one
         # JSON line; exits nonzero when completion rate or p95 degradation
@@ -1033,14 +1313,16 @@ def _child_main():
     # — perf iteration on one phase shouldn't pay the full suite each time
     phases = {p.strip() for p in
               os.environ.get("DYN_BENCH_PHASES",
-                             "kernel,spec,e2e,chaos,mem,qos").split(",")
+                             "kernel,spec,e2e,chaos,mem,qos,autoscale"
+                             ).split(",")
               if p.strip()}
-    unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos"}
+    unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
+                        "autoscale"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
-                         f"chaos, mem, qos)")
+                         f"chaos, mem, qos, autoscale)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -1095,6 +1377,15 @@ def _child_main():
                 kern["qos"] = asyncio.run(qos_bench(on_tpu))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["qos_error"] = repr(e)[:200]
+        if "autoscale" in phases:
+            # closed-loop autoscaling phase: diurnal QoS-mixed cycle over
+            # an operator-managed mocker fleet with chaos on — scale
+            # events, SLO hold, and zero-loss token accounting on record
+            # every round (ISSUE 6 acceptance)
+            try:
+                kern["autoscale"] = asyncio.run(autoscale_bench())
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["autoscale_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

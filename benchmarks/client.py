@@ -21,6 +21,11 @@ class RequestResult:
     latency_s: Optional[float] = None
     itl_s: list = field(default_factory=list)
     tokens: int = 0
+    #: server-reported usage.completion_tokens — the EXACT count (client-
+    #: side ``tokens`` undercounts when coalesced emission packs several
+    #: tokens into one SSE delta); the autoscale bench's zero-loss
+    #: accounting reads this
+    completion_tokens: int = 0
     error: Optional[str] = None
 
 
@@ -29,8 +34,62 @@ def make_prompt(rng: random.Random, n_words: int, prefix: str = "") -> str:
     return (prefix + " " + body) if prefix else body
 
 
+class Mix:
+    """Weighted categorical sampler for ``--tenant-mix``/``--priority-mix``
+    CLI values (``"interactive=0.6,batch=0.4"`` or bare ``"a,b"`` for
+    uniform). Deterministic given the caller's seeded rng."""
+
+    def __init__(self, spec: str):
+        self.choices: list[tuple[str, float]] = []
+        total = 0.0
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, w = part.partition("=")
+            try:
+                weight = float(w) if w else 1.0
+            except ValueError:
+                raise ValueError(
+                    f"bad mix component {part!r} (want name=weight)") from None
+            if weight < 0:
+                raise ValueError(f"mix weight for {name!r} must be >= 0")
+            self.choices.append((name.strip(), weight))
+            total += weight
+        if self.choices and total <= 0:
+            raise ValueError(f"mix {spec!r} has zero total weight")
+        self._total = total
+
+    def __bool__(self) -> bool:
+        return bool(self.choices)
+
+    def pick(self, rng: random.Random) -> Optional[str]:
+        if not self.choices:
+            return None
+        x = rng.random() * self._total
+        for name, w in self.choices:
+            x -= w
+            if x <= 0:
+                return name
+        return self.choices[-1][0]
+
+
+def qos_headers(tenant: Optional[str], priority: Optional[str]) -> dict:
+    """The QoS wire headers (docs/qos.md). NB: anonymous priority can only
+    LOWER the class below the tenant's configured default — escalating to
+    ``interactive`` needs the tenant configured with that class
+    (``DYN_QOS_TENANTS``) or an API key."""
+    h = {}
+    if tenant:
+        h["x-dynamo-tenant"] = tenant
+    if priority:
+        h["x-dynamo-priority"] = priority
+    return h
+
+
 async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
-                         prompt: str, max_tokens: int) -> RequestResult:
+                         prompt: str, max_tokens: int,
+                         headers: Optional[dict] = None) -> RequestResult:
     t0 = time.perf_counter()
     res = RequestResult(ok=False)
     try:
@@ -40,6 +99,7 @@ async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
                   "max_tokens": max_tokens,
                   "stream_options": {"include_usage": True},
                   "messages": [{"role": "user", "content": prompt}]},
+            headers=headers or {},
         ) as resp:
             if resp.status != 200:
                 res.error = f"http {resp.status}"
@@ -55,8 +115,10 @@ async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
                     chunk = _json.loads(line[6:])
                 except ValueError:
                     continue
-                if chunk.get("usage"):  # record the true token ISL
+                if chunk.get("usage"):  # record the true token ISL/OSL
                     res.prompt_tokens = chunk["usage"].get("prompt_tokens", 0)
+                    res.completion_tokens = chunk["usage"].get(
+                        "completion_tokens", 0)
                 # only content-bearing chunks count as tokens — a
                 # usage-only final chunk (vLLM/OpenAI emit one with empty
                 # choices) must not inflate token counts or ITL samples

@@ -18,7 +18,9 @@ import time
 
 import aiohttp
 
-from benchmarks.client import make_prompt, stream_request, summarize
+from benchmarks.client import (
+    Mix, make_prompt, qos_headers, stream_request, summarize,
+)
 
 
 async def amain():
@@ -31,26 +33,47 @@ async def amain():
     ap.add_argument("--duration-s", type=float, default=180.0)
     ap.add_argument("--isl-words", type=int, default=128)
     ap.add_argument("--osl", type=int, default=32)
+    ap.add_argument("--tenant-mix", default="",
+                    help='weighted x-dynamo-tenant mix, e.g. '
+                         '"acme=0.7,free=0.3" (empty = no header)')
+    ap.add_argument("--priority-mix", default="",
+                    help='weighted x-dynamo-priority mix, e.g. '
+                         '"interactive=0.5,standard=0.3,batch=0.2"; note '
+                         'escalation above a tenant\'s configured class '
+                         'needs DYN_QOS_TENANTS/API-key auth (docs/qos.md)')
+    ap.add_argument("--seed", type=int, default=0)
     cli = ap.parse_args()
 
-    rng = random.Random(0)
+    tenant_mix, priority_mix = Mix(cli.tenant_mix), Mix(cli.priority_mix)
+    rng = random.Random(cli.seed)
     results = []
+    by_class: dict = {}
     inflight: set = set()
     t0 = time.monotonic()
     async with aiohttp.ClientSession() as session:
         while (now := time.monotonic() - t0) < cli.duration_s:
             rate = max(0.05, cli.base_rps
                        + cli.amp_rps * math.sin(2 * math.pi * now / cli.period_s))
+            cls = priority_mix.pick(rng)
             task = asyncio.get_running_loop().create_task(stream_request(
                 session, cli.url, cli.model,
-                make_prompt(rng, cli.isl_words), cli.osl))
+                make_prompt(rng, cli.isl_words), cli.osl,
+                headers=qos_headers(tenant_mix.pick(rng), cls)))
             inflight.add(task)
-            task.add_done_callback(
-                lambda t: (inflight.discard(t), results.append(t.result())))
+
+            def _done(t, cls=cls):
+                inflight.discard(t)
+                results.append(t.result())
+                by_class.setdefault(cls or "default", []).append(t.result())
+
+            task.add_done_callback(_done)
             await asyncio.sleep(1.0 / rate)
         if inflight:
             await asyncio.gather(*inflight, return_exceptions=True)
-    print(json.dumps(summarize(results)))
+    out = summarize(results)
+    if priority_mix:
+        out["by_class"] = {c: summarize(rs) for c, rs in sorted(by_class.items())}
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

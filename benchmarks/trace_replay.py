@@ -32,9 +32,12 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import random
 import time
 
 import numpy as np
+
+from benchmarks.client import Mix
 
 VOCAB_LOW, VOCAB_HIGH = 10, 30000
 
@@ -86,22 +89,31 @@ async def replay(url: str, model: str, trace: list[dict], *,
                  block_tokens: int, speedup: float) -> dict:
     import aiohttp
 
-    results: list[tuple] = []  # (ttft, n_tok, itls)
+    results: list[tuple] = []  # (ttft, n_tok, itls, qos_class)
     errors: list[str] = []
 
     async def one(session, rec):
         prompt = prompt_for(rec, block_tokens)
+        # QoS identity stamped onto the record by --tenant-mix /
+        # --priority-mix (or carried by a real trace's own fields)
+        headers = {}
+        if rec.get("tenant"):
+            headers["x-dynamo-tenant"] = str(rec["tenant"])
+        if rec.get("priority"):
+            headers["x-dynamo-priority"] = str(rec["priority"])
+        cls = rec.get("priority") or "default"
         t0 = time.perf_counter()
         ttft, last, itls, n_tok = None, None, [], 0
         try:
             async with session.post(f"{url}/v1/completions", json={
                     "model": model, "prompt": prompt, "stream": True,
                     "max_tokens": int(rec["output_length"]),
-                    "ignore_eos": True, "temperature": 0.0}) as resp:
+                    "ignore_eos": True, "temperature": 0.0},
+                    headers=headers) as resp:
                 if resp.status != 200:
                     errors.append(f"HTTP {resp.status}: "
                                   f"{(await resp.text())[:200]}")
-                    results.append((None, 0, []))
+                    results.append((None, 0, [], cls))
                     return
                 async for raw in resp.content:
                     line = raw.decode()
@@ -110,7 +122,7 @@ async def replay(url: str, model: str, trace: list[dict], *,
                     payload = json.loads(line[6:])
                     if "error" in payload:
                         errors.append(f"SSE error: {str(payload)[:200]}")
-                        results.append((None, 0, []))
+                        results.append((None, 0, [], cls))
                         return
                     now = time.perf_counter()
                     if ttft is None:
@@ -121,9 +133,9 @@ async def replay(url: str, model: str, trace: list[dict], *,
                     n_tok += 1
         except aiohttp.ClientError as e:
             errors.append(f"client error: {e!r}"[:200])
-            results.append((None, 0, []))
+            results.append((None, 0, [], cls))
             return
-        results.append((ttft, n_tok, itls))
+        results.append((ttft, n_tok, itls, cls))
 
     t_start = time.perf_counter()
     base_ts = trace[0]["timestamp"]
@@ -148,7 +160,7 @@ async def replay(url: str, model: str, trace: list[dict], *,
     def pct(xs, p):
         return round(1000 * xs[min(int(len(xs) * p), len(xs) - 1)], 1) if xs else None
 
-    return {
+    out = {
         "requests": len(trace), "ok": len(ok),
         "failed": len(results) - len(ok),
         "errors": errors[:5],
@@ -158,6 +170,18 @@ async def replay(url: str, model: str, trace: list[dict], *,
         "itl_p50_ms": pct(itls, 0.50), "itl_p95_ms": pct(itls, 0.95),
         "speedup": speedup,
     }
+    classes = {r[3] for r in results}
+    if classes - {"default"}:
+        per = {}
+        for c in sorted(classes):
+            cok = [r for r in ok if r[3] == c]
+            ct = sorted(r[0] for r in cok)
+            per[c] = {"ok": len(cok),
+                      "requests": sum(1 for r in results if r[3] == c),
+                      "ttft_p50_ms": pct(ct, 0.50),
+                      "ttft_p95_ms": pct(ct, 0.95)}
+        out["by_class"] = per
+    return out
 
 
 async def amain():
@@ -174,6 +198,16 @@ async def amain():
     ap.add_argument("--speedup", type=float, default=1.0,
                     help="replay timestamps this many times faster")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tenant-mix", default="",
+                    help='weighted x-dynamo-tenant mix stamped onto records '
+                         'lacking their own "tenant" field, e.g. '
+                         '"acme=0.7,free=0.3" (empty = no header)')
+    ap.add_argument("--priority-mix", default="",
+                    help='weighted x-dynamo-priority mix stamped onto '
+                         'records lacking their own "priority" field, e.g. '
+                         '"interactive=0.5,standard=0.3,batch=0.2"; note '
+                         'escalation above a tenant\'s configured class '
+                         'needs DYN_QOS_TENANTS/API-key auth (docs/qos.md)')
     cli = ap.parse_args()
 
     if cli.trace:
@@ -185,6 +219,17 @@ async def amain():
     else:
         ap.error("pass --trace FILE or --synthesize N")
     trace.sort(key=lambda r: r["timestamp"])
+    # QoS identity assignment is seeded and happens AFTER the timestamp
+    # sort so the same (trace, seed, mixes) always drives the same classed
+    # request sequence — a real trace's own tenant/priority fields win
+    tenant_mix, priority_mix = Mix(cli.tenant_mix), Mix(cli.priority_mix)
+    if tenant_mix or priority_mix:
+        qrng = random.Random(cli.seed ^ 0x9E3779B9)
+        for rec in trace:
+            if tenant_mix and not rec.get("tenant"):
+                rec["tenant"] = tenant_mix.pick(qrng)
+            if priority_mix and not rec.get("priority"):
+                rec["priority"] = priority_mix.pick(qrng)
     out = await replay(cli.url, cli.model, trace,
                        block_tokens=cli.block_tokens, speedup=cli.speedup)
     print(json.dumps(out))

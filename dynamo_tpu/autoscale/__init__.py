@@ -1,0 +1,27 @@
+"""Closed-loop SLA autoscaling (ROADMAP item 4, docs/autoscaling.md).
+
+Wires the pieces that already existed into one loop that provably
+materializes capacity: SLO spec (``slo.py``) → fused observation feed
+(``observe.py``: frontend scrapes ⊕ worker ForwardPassMetrics) → predictor
++ planner capacity inversion → cooldown/readiness gating
+(``controller.py``) → VirtualConnector SCALE_KEY → ProcessOperator
+spawn/drain (``deploy/operator.py``). ``python -m dynamo_tpu.autoscale.main``
+runs it as a service; ``dynctl autoscale`` shows the loop's live state.
+"""
+
+from dynamo_tpu.autoscale.controller import (
+    AUTOSCALE_STATUS_KEY, AutoscaleController, AutoscaleRunner,
+    OPERATOR_STATUS_KEY, TickResult, make_planner, plane_readiness,
+)
+from dynamo_tpu.autoscale.observe import (
+    ClassTtftTracker, FusedObservation, ObservationFuser, histogram_p95,
+    parse_class_ttft_buckets,
+)
+from dynamo_tpu.autoscale.slo import ClassSlo, SloConfig
+
+__all__ = [
+    "AUTOSCALE_STATUS_KEY", "AutoscaleController", "AutoscaleRunner",
+    "ClassSlo", "ClassTtftTracker", "FusedObservation", "ObservationFuser",
+    "OPERATOR_STATUS_KEY", "SloConfig", "TickResult", "histogram_p95",
+    "make_planner", "parse_class_ttft_buckets", "plane_readiness",
+]

@@ -1,0 +1,380 @@
+"""Closed-loop SLA autoscale controller: predictor → planner → operator.
+
+ROADMAP item 4: every ingredient existed — ``planner/load_predictor.py``
+(seasonal/ARIMA), ``planner/planner_core.py`` (capacity inversion +
+adaptive corrections), ``deploy/operator.py`` (reconcile), QoS classes
+(PR 5), SIGTERM drain (PR 3) — but decisions stopped at a log line or a KV
+key and nothing verified they MATERIALIZED. This controller closes the
+loop:
+
+    frontend /metrics ─┐
+                       ├─ ObservationFuser ─→ Planner (predict + invert)
+    worker FP metrics ─┘          │
+                                  ├─ reactive backlog / SLO-breach terms
+                                  ▼
+                    cooldown + readiness gate (anti-flap, anti-phantom)
+                                  ▼
+               VirtualConnector SCALE_KEY ─→ ProcessOperator (spawn/drain)
+                                  ▲                  │
+                                  └── ready counts ──┘  (operator status)
+
+Two stability mechanisms beyond the planner's own scale-down patience:
+
+- **cooldown/hysteresis** (``SloConfig.cooldown_{up,down}_s``): a scale
+  event opens a per-direction quiet period; decisions inside it hold the
+  applied target. Asymmetric on purpose — scale-up reacts in one interval,
+  scale-down waits out transients.
+- **readiness gating**: the operator reports how many replicas are
+  *registered on the control plane* (for engine workers that registration
+  happens only after AOT warmup — ``engine/main.py`` warms up BEFORE
+  joining the plane). While ready < applied target, further scale-up is
+  deferred: the capacity is already coming, and stacking decisions during
+  a compile cliff is how feedback loops overshoot. Corrections likewise
+  read the READY count (``Observation.ready_*``), so a latency spike
+  measured against phantom capacity cannot inflate the correction factor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.autoscale.observe import FusedObservation, ObservationFuser
+from dynamo_tpu.autoscale.slo import SloConfig
+from dynamo_tpu.planner.perf_interpolation import (
+    PerfInterpolator, PerfInterpolator2D,
+)
+from dynamo_tpu.planner.planner_core import Decision, Planner, PlannerConfig
+
+logger = logging.getLogger("dynamo.autoscale")
+
+#: controller status on the control plane (``dynctl autoscale`` reads it)
+AUTOSCALE_STATUS_KEY = "public/autoscale/{namespace}/status"
+#: operator-observed fleet state (written by deploy/operator.py)
+OPERATOR_STATUS_KEY = "public/operator/{namespace}/status"
+
+
+def make_planner(slo: SloConfig,
+                 prefill_perf: "PerfInterpolator | PerfInterpolator2D",
+                 decode_perf: PerfInterpolator,
+                 **overrides) -> Planner:
+    """Planner parameterized by the governing class's SLO (the strictest
+    class sizes the fleet; weaker classes ride its capacity)."""
+    gov = slo.governing
+    kw = dict(
+        ttft_sla_ms=gov.ttft_p95_ms,
+        itl_sla_ms=gov.itl_ms,
+        adjustment_interval_s=slo.adjustment_interval_s,
+        predictor=slo.predictor,
+        min_prefill_replicas=slo.min_replicas,
+        max_prefill_replicas=slo.max_replicas,
+        min_decode_replicas=slo.min_replicas,
+        max_decode_replicas=slo.max_replicas)
+    kw.update(overrides)
+    return Planner(PlannerConfig(**kw), prefill_perf, decode_perf)
+
+
+async def plane_readiness(plane, namespace: str = "dynamo") -> Optional[dict]:
+    """Read the operator's ready counts by planner role from its status
+    key → ``{"prefill": n, "decode": n}`` (None when no operator runs)."""
+    try:
+        raw = await plane.kv_get(OPERATOR_STATUS_KEY.format(
+            namespace=namespace))
+    except Exception:
+        return None
+    if not raw:
+        return None
+    try:
+        status = json.loads(raw)
+    except ValueError:
+        return None
+    out: dict[str, int] = {}
+    drain_s = float(status.get("drainSecondsTotal", 0.0) or 0.0)
+    for svc in (status.get("services") or {}).values():
+        role = svc.get("plannerRole")
+        if role:
+            out[role] = out.get(role, 0) + int(svc.get("ready", 0))
+    out["_drain_seconds_total"] = drain_s
+    return out
+
+
+@dataclass
+class TickResult:
+    """What one controller tick decided and why (tests + status view)."""
+
+    desired: Decision
+    applied: bool
+    direction: str  # "up" | "down" | "hold"
+    reason: str
+    fused: Optional[FusedObservation] = None
+    ready: Optional[dict] = None
+    breaches: dict = field(default_factory=dict)
+
+
+class AutoscaleController:
+    """One tick = observe → predict → decide → gate → actuate."""
+
+    def __init__(self, slo: SloConfig, planner: Planner,
+                 source: "ObservationFuser", connector, *,
+                 readiness=None, metrics=None, plane=None,
+                 namespace: str = "dynamo", now_fn=time.monotonic):
+        self.slo = slo
+        self.planner = planner
+        self.source = source          # async () -> FusedObservation
+        self.connector = connector    # async .apply(Decision)
+        self.readiness = readiness    # async () -> {"decode": n, ...}|None
+        self.plane = plane
+        self.namespace = namespace
+        self.now = now_fn
+        self.applied: Decision = planner.current
+        self._last_up: float = float("-inf")
+        self._last_down: float = float("-inf")
+        self.ticks = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.deferred_for_readiness = 0
+        self.held_for_cooldown = 0
+        self.last_result: Optional[TickResult] = None
+        self._init_metrics(metrics)
+
+    def _init_metrics(self, metrics) -> None:
+        """dynamo_autoscale_* families on the host process's registry."""
+        if metrics is None:
+            self._m_decisions = self._m_desired = None
+            self._m_ready = self._m_drain = None
+            return
+        self._m_decisions = metrics.counter(
+            "autoscale_decisions_total",
+            "autoscale decisions applied, by direction")
+        self._m_desired = metrics.gauge(
+            "autoscale_replicas_desired",
+            "replica target the controller last applied, by role")
+        self._m_ready = metrics.gauge(
+            "autoscale_replicas_ready",
+            "replicas registered+warm per the operator, by role")
+        self._m_drain = metrics.counter(
+            "autoscale_drain_seconds",
+            "cumulative seconds scale-down victims spent draining "
+            "(operator-reported)")
+        self._drain_reported = 0.0
+
+    # -- decision core -----------------------------------------------------
+
+    def _cooldown_ok(self, direction: str) -> bool:
+        now = self.now()
+        last = max(self._last_up, self._last_down)
+        window = (self.slo.cooldown_up_s if direction == "up"
+                  else self.slo.cooldown_down_s)
+        return now - last >= window
+
+    def _clamp(self, n: int) -> int:
+        return max(self.slo.min_replicas, min(self.slo.max_replicas, n))
+
+    def _breaches(self, fused: FusedObservation) -> dict:
+        """Per-class SLO breach check from the interval's TTFT p95s."""
+        out = {}
+        for cls, p95 in (fused.ttft_p95_ms or {}).items():
+            target = self.slo.slo_for(cls).ttft_p95_ms
+            if target is not None:
+                out[cls] = {"ttft_p95_ms": p95, "target_ms": target,
+                            "ok": p95 <= target}
+        return out
+
+    async def tick(self) -> TickResult:
+        self.ticks += 1
+        fused = await self.source()
+        ready = await self.readiness() if self.readiness is not None else None
+        ready_decode = (ready or {}).get("decode")
+        ready_prefill = (ready or {}).get("prefill")
+
+        obs = fused.observation
+        if obs is not None:
+            # corrections must see REAL capacity: during a scale-up's
+            # startup/compile window the live fleet is smaller than the
+            # planner's decision, and attributing the latency of N-k
+            # replicas to N would inflate the correction factor exactly
+            # when the loop is most excitable
+            if ready_decode is not None:
+                obs.ready_decode = ready_decode
+            if ready_prefill is not None:
+                obs.ready_prefill = ready_prefill
+            self.planner.observe(obs)
+        target = self.planner.compute()
+        p, d = target.prefill_replicas, target.decode_replicas
+        reason = "predicted"
+
+        # reactive backlog term: queue depth the edge rates can't see.
+        # Sized against the APPLIED fleet: backlog/replica over the knob
+        # means the current fleet is provably behind, however rosy the
+        # completion-rate forecast looks.
+        if self.slo.backlog_per_replica > 0 and fused.queue_depth > 0:
+            need = math.ceil(fused.queue_depth / self.slo.backlog_per_replica)
+            if need > d:
+                d, reason = need, "backlog"
+
+        # reactive SLO-breach term: a governed class over its TTFT target
+        # asks for one replica beyond the applied fleet (bounded: breaches
+        # repeat every tick; cooldown spaces the steps). TTFT is prefill-
+        # bound in a disaggregated fleet, so when the prefill dimension is
+        # actually scalable it steps too — bumping only decode there would
+        # grow the wrong pool forever while the breach persists.
+        breaches = self._breaches(fused)
+        if any(not b["ok"] for b in breaches.values()):
+            if self.applied.decode_replicas + 1 > d:
+                d = self.applied.decode_replicas + 1
+                reason = "slo_breach"
+            cfg = self.planner.cfg
+            if (cfg.max_prefill_replicas > cfg.min_prefill_replicas
+                    and self.applied.prefill_replicas + 1 > p):
+                p = self.applied.prefill_replicas + 1
+                reason = "slo_breach"
+
+        p, d = self._clamp(p), self._clamp(d)
+
+        # readiness gate: while the last scale-up is still materializing
+        # (ready < applied), don't stack another one — the planner would
+        # be reacting to capacity that is already on its way. Both
+        # dimensions gate independently (a prefill compile cliff must not
+        # stack prefill scale-ups any more than a decode one).
+        if (ready_decode is not None
+                and ready_decode < self.applied.decode_replicas
+                and d > self.applied.decode_replicas):
+            d = self.applied.decode_replicas
+            reason = "deferred_unready"
+            self.deferred_for_readiness += 1
+        if (ready_prefill is not None
+                and ready_prefill < self.applied.prefill_replicas
+                and p > self.applied.prefill_replicas):
+            p = self.applied.prefill_replicas
+            reason = "deferred_unready"
+            self.deferred_for_readiness += 1
+
+        direction = ("up" if (d > self.applied.decode_replicas
+                              or p > self.applied.prefill_replicas)
+                     else "down" if (d < self.applied.decode_replicas
+                                     or p < self.applied.prefill_replicas)
+                     else "hold")
+        applied = False
+        if direction != "hold":
+            if self._cooldown_ok(direction):
+                decision = Decision(p, d)
+                await self.connector.apply(decision)
+                self.applied = decision
+                # keep the planner's internal state consistent with what
+                # was actually actuated (its patience/corrections key off
+                # self.current)
+                self.planner.current = decision
+                if direction == "up":
+                    self._last_up = self.now()
+                    self.scale_ups += 1
+                else:
+                    self._last_down = self.now()
+                    self.scale_downs += 1
+                applied = True
+                if self._m_decisions is not None:
+                    self._m_decisions.inc(direction=direction)
+                logger.info("autoscale %s → prefill=%d decode=%d (%s)",
+                            direction, p, d, reason)
+            else:
+                reason = f"cooldown_{direction}"
+                self.held_for_cooldown += 1
+                self.planner.current = self.applied
+        else:
+            self.planner.current = self.applied
+
+        result = TickResult(desired=self.applied, applied=applied,
+                            direction=direction if applied else "hold",
+                            reason=reason, fused=fused, ready=ready,
+                            breaches=breaches)
+        self.last_result = result
+        self._export(result, ready)
+        await self._publish_status(result)
+        return result
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _export(self, result: TickResult, ready: Optional[dict]) -> None:
+        if self._m_desired is None:
+            return
+        self._m_desired.set(self.applied.decode_replicas, role="decode")
+        self._m_desired.set(self.applied.prefill_replicas, role="prefill")
+        if ready:
+            for role in ("decode", "prefill"):
+                if role in ready:
+                    self._m_ready.set(ready[role], role=role)
+            drain = ready.get("_drain_seconds_total", 0.0)
+            if drain > self._drain_reported:
+                self._m_drain.inc(drain - self._drain_reported)
+                self._drain_reported = drain
+
+    async def _publish_status(self, result: TickResult) -> None:
+        if self.plane is None:
+            return
+        fused = result.fused or FusedObservation()
+        obs = fused.observation
+        status = {
+            "ts": time.time(),
+            "desired": {"prefill": self.applied.prefill_replicas,
+                        "decode": self.applied.decode_replicas},
+            "ready": {k: v for k, v in (result.ready or {}).items()
+                      if not k.startswith("_")},
+            "queueDepth": fused.queue_depth,
+            "workers": fused.workers,
+            "requestRate": round(obs.request_rate, 3) if obs else None,
+            "slo": {cls: dict(b) for cls, b in result.breaches.items()},
+            "lastDecision": {"direction": result.direction,
+                             "reason": result.reason,
+                             "applied": result.applied},
+            "counters": {"ticks": self.ticks, "scaleUps": self.scale_ups,
+                         "scaleDowns": self.scale_downs,
+                         "deferredUnready": self.deferred_for_readiness,
+                         "heldCooldown": self.held_for_cooldown,
+                         "scrapeFailures": getattr(self.source,
+                                                   "scrape_failures", 0)},
+        }
+        try:
+            await self.plane.kv_put(
+                AUTOSCALE_STATUS_KEY.format(namespace=self.namespace),
+                json.dumps(status).encode())
+        except Exception:
+            logger.warning("autoscale status publish failed", exc_info=True)
+
+
+class AutoscaleRunner:
+    """Wall-clock loop around the controller (PlannerRunner's shape: a
+    tick exception is logged and the loop keeps going — one bad scrape
+    must not abandon the fleet)."""
+
+    def __init__(self, controller: AutoscaleController,
+                 interval_s: Optional[float] = None):
+        self.controller = controller
+        self.interval = interval_s or controller.slo.adjustment_interval_s
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+        self.tick_errors = 0
+
+    async def start(self) -> "AutoscaleRunner":
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task:
+            await self._task
+
+    async def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.controller.tick()
+            except Exception:
+                self.tick_errors += 1
+                logger.exception("autoscale tick failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.interval)
+            except asyncio.TimeoutError:
+                pass

@@ -1,0 +1,101 @@
+"""``python -m dynamo_tpu.autoscale.main`` — run the SLA autoscaler service.
+
+The SLO-driven successor to ``python -m dynamo_tpu.planner.main``: same
+profile-driven capacity inversion, but the SLA comes from the declarative
+``DYN_SLO_*`` spec (per-QoS-class targets), the observation feed fuses the
+frontend scrape with worker ForwardPassMetrics (reactive backlog term),
+and decisions flow through cooldown + readiness gating before hitting the
+operator. Pair with::
+
+    python -m dynamo_tpu.runtime.dynctl                       # hub
+    python -m dynamo_tpu.deploy.operator graph.yaml --follow-planner
+    python -m dynamo_tpu.autoscale.main --profile-results profile.json
+
+and watch the loop with ``dynctl autoscale``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from dynamo_tpu.autoscale.controller import (
+    AutoscaleController, AutoscaleRunner, make_planner, plane_readiness,
+)
+from dynamo_tpu.autoscale.observe import ObservationFuser
+from dynamo_tpu.autoscale.slo import SloConfig
+from dynamo_tpu.planner.main import load_profile
+from dynamo_tpu.planner.prometheus import PrometheusMetricsSource
+from dynamo_tpu.router.publisher import MetricsAggregator
+from dynamo_tpu.runtime.config import setup_logging
+
+logger = logging.getLogger("dynamo.autoscale")
+
+
+async def amain():
+    ap = argparse.ArgumentParser(
+        description="dynamo-tpu closed-loop SLA autoscaler (DYN_SLO_* "
+                    "declares the targets; docs/autoscaling.md)")
+    ap.add_argument("--frontend", default="http://127.0.0.1:8000",
+                    help="frontend base URL (scraped at /metrics)")
+    ap.add_argument("--profile-results", required=True,
+                    help="profile_sla.py output JSON")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--no-correction", action="store_true",
+                    help="freeze the adaptive TTFT/ITL correction factors")
+    ap.add_argument("--interval", type=float, default=None,
+                    help="override DYN_SLO_INTERVAL_S")
+    cli = ap.parse_args()
+    setup_logging()
+
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    slo = SloConfig.load()
+    if cli.interval:
+        slo = slo.with_(adjustment_interval_s=cli.interval)
+    prefill_perf, decode_perf, profiled_isl = load_profile(cli.profile_results)
+    planner = make_planner(slo, prefill_perf, decode_perf,
+                           profiled_isl=profiled_isl,
+                           no_correction=cli.no_correction)
+
+    runtime = await DistributedRuntime.create()
+    from dynamo_tpu.planner.virtual_connector import VirtualConnector
+
+    connector = VirtualConnector(runtime.plane, cli.namespace)
+    # expiry ON: the autoscaler reads the aggregate as LOAD — a drained
+    # worker's last report must not count as backlog forever (idle
+    # workers aging out is fine here; capacity comes from the operator's
+    # ready counts, not this feed)
+    aggregator = await MetricsAggregator(runtime.plane,
+                                         stale_after_s=10.0).start()
+    fuser = ObservationFuser(PrometheusMetricsSource(cli.frontend),
+                             aggregator)
+
+    async def readiness():
+        return await plane_readiness(runtime.plane, cli.namespace)
+
+    controller = AutoscaleController(
+        slo, planner, fuser, connector, readiness=readiness,
+        metrics=runtime.metrics, plane=runtime.plane,
+        namespace=cli.namespace)
+    runner = await AutoscaleRunner(controller).start()
+    print("AUTOSCALER_READY", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await runner.stop()
+    await aggregator.stop()
+    await runtime.shutdown()
+
+
+def main():
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,196 @@
+"""Observation feed for the autoscaler: frontend scrapes ⊕ worker metrics.
+
+The planner's historical feed (``planner/prometheus.py``) sees only the
+frontend's edge counters — rates and mean latencies of *completed*
+requests. That signal goes blind exactly when scaling matters most: under
+saturation, requests queue instead of completing, and the completion-rate
+"demand" estimate reads LOW while the real demand is piling up in worker
+queues. This module fuses two feeds into one :class:`FusedObservation`:
+
+- **frontend** (``PrometheusMetricsSource``): request rate, ISL/OSL, mean
+  TTFT/ITL — the proactive signal the ``SeasonalPredictor``/
+  ``ArimaPredictor`` forecast from — plus per-QoS-class TTFT p95 estimated
+  from the ``dynamo_http_ttft_class_seconds`` histogram deltas (the SLO
+  compliance signal);
+- **workers** (``ForwardPassMetrics`` over the control plane, the same
+  subject the KV router consumes): waiting+swapped depth and slot
+  occupancy — the reactive signal that sees saturation the edge cannot.
+
+Either feed may fail a tick without breaking the loop: a dead frontend
+scrape still yields worker depth (reactive scaling keeps working), and a
+quiet metrics subject still yields edge rates.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.planner.planner_core import Observation
+from dynamo_tpu.planner.prometheus import _LINE
+
+logger = logging.getLogger("dynamo.autoscale")
+
+_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+#: frontend histogram family carrying per-class TTFT (frontend/http.py)
+TTFT_CLASS_METRIC = "dynamo_http_ttft_class_seconds"
+
+
+def parse_class_ttft_buckets(text: str) -> dict[str, dict[float, float]]:
+    """``{qos_class: {le_upper_bound: cumulative_count}}`` from one
+    /metrics exposition (``+Inf`` maps to ``float('inf')``)."""
+    out: dict[str, dict[float, float]] = {}
+    prefix = TTFT_CLASS_METRIC + "_bucket"
+    for line in text.splitlines():
+        if not line.startswith(prefix):
+            continue
+        m = _LINE.match(line.strip())
+        if not m or m.group(1) != prefix:
+            continue
+        labels = dict(_LABEL.findall(m.group(2) or ""))
+        le, cls = labels.get("le"), labels.get("qos")
+        if le is None or cls is None:
+            continue
+        try:
+            bound = float("inf") if le == "+Inf" else float(le)
+            out.setdefault(cls, {})[bound] = float(m.group(3))
+        except ValueError:
+            continue
+    return out
+
+
+def histogram_p95(delta: dict[float, float]) -> Optional[float]:
+    """p95 (seconds) from per-bucket cumulative-count deltas, linearly
+    interpolated inside the crossing bucket (standard histogram_quantile).
+    None when the interval recorded nothing."""
+    bounds = sorted(delta)
+    if not bounds or bounds[-1] != float("inf"):
+        return None
+    total = delta[float("inf")]
+    if total <= 0:
+        return None
+    target = 0.95 * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for b in bounds:
+        cum = delta[b]
+        if cum >= target:
+            if b == float("inf"):
+                return prev_bound  # tail bucket: best lower bound we have
+            if cum == prev_cum:
+                return b
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_bound + frac * (b - prev_bound)
+        prev_bound, prev_cum = b, cum
+    return prev_bound
+
+
+class ClassTtftTracker:
+    """Interval p95 per QoS class from successive /metrics scrapes."""
+
+    def __init__(self):
+        self._prev: Optional[dict[str, dict[float, float]]] = None
+
+    def feed(self, text: Optional[str]) -> dict[str, float]:
+        """→ ``{class: ttft_p95_ms}`` for the classes that completed first
+        tokens this interval. A counter reset (frontend restart) SKIPS the
+        class for one interval and rebases — clamping per-bucket deltas
+        at 0 is not enough, because post-restart traffic can push high
+        buckets past their pre-restart counts while low buckets stay
+        under, shape-skewing the delta toward a false SLO breach."""
+        if not text:
+            return {}
+        cur = parse_class_ttft_buckets(text)
+        prev, self._prev = self._prev, cur
+        if prev is None:
+            return {}
+        out: dict[str, float] = {}
+        for cls, buckets in cur.items():
+            pb = prev.get(cls, {})
+            if any(c < pb.get(b, 0.0) for b, c in buckets.items()):
+                continue  # reset: rebase on the fresh counters
+            delta = {b: c - pb.get(b, 0.0) for b, c in buckets.items()}
+            p95 = histogram_p95(delta)
+            if p95 is not None:
+                out[cls] = round(p95 * 1000.0, 3)
+        return out
+
+
+@dataclass
+class FusedObservation:
+    """One controller tick's fused view of the system."""
+
+    #: edge-traffic sample for the predictors; None when the frontend
+    #: scrape failed or the interval was idle
+    observation: Optional[Observation] = None
+    #: waiting+swapped sequences across the worker fleet (ForwardPassMetrics
+    #: num_requests_waiting — includes swapped since PR 4)
+    queue_depth: int = 0
+    active_slots: int = 0
+    total_slots: int = 0
+    #: workers currently reporting metrics
+    workers: int = 0
+    #: per-QoS-class TTFT p95 (ms) over the scrape interval
+    ttft_p95_ms: dict = field(default_factory=dict)
+    #: True when the frontend scrape itself failed this tick (vs idle)
+    frontend_down: bool = False
+
+
+class ObservationFuser:
+    """async () -> FusedObservation over a frontend source + worker feed.
+
+    ``frontend_source`` is any ``async () -> Observation|None`` (usually
+    :class:`~dynamo_tpu.planner.prometheus.PrometheusMetricsSource`; its
+    ``last_text`` attribute, when present, feeds the per-class p95
+    tracker). ``aggregator`` is a started
+    :class:`~dynamo_tpu.router.publisher.MetricsAggregator` (or anything
+    with ``.aggregate() -> dict``); None runs edge-only.
+    """
+
+    def __init__(self, frontend_source, aggregator=None):
+        self.frontend = frontend_source
+        self.aggregator = aggregator
+        self.ttft_tracker = ClassTtftTracker()
+        self.scrape_failures = 0
+        self.ticks = 0
+
+    async def __call__(self) -> FusedObservation:
+        self.ticks += 1
+        obs: Optional[Observation] = None
+        frontend_down = False
+        # PrometheusMetricsSource swallows its own fetch errors (returns
+        # None) and counts them internally — fold that counter in, or a
+        # dead frontend reads as "0 scrape failures" in the status view
+        before = getattr(self.frontend, "scrape_failures", 0)
+        try:
+            obs = await self.frontend()
+            failed = getattr(self.frontend, "scrape_failures", 0) - before
+            if failed > 0:
+                frontend_down = True
+                self.scrape_failures += failed
+        except Exception:
+            # a scrape failure must not kill the loop: the reactive
+            # (worker-depth) half still scales the fleet
+            logger.warning("frontend observation failed", exc_info=True)
+            frontend_down = True
+            self.scrape_failures += 1
+        fused = FusedObservation(observation=obs, frontend_down=frontend_down)
+        text = getattr(self.frontend, "last_text", None)
+        fused.ttft_p95_ms = self.ttft_tracker.feed(text)
+        if self.aggregator is not None:
+            try:
+                agg = self.aggregator.aggregate()
+                fused.queue_depth = int(agg.get("requests_waiting", 0))
+                fused.active_slots = int(agg.get("requests_active", 0))
+                fused.workers = int(agg.get("workers", 0))
+                fused.total_slots = int(agg.get("total_slots", 0) or 0)
+            except Exception:
+                logger.warning("worker metrics aggregation failed",
+                               exc_info=True)
+        if obs is not None:
+            # thread the fleet-depth signal into the planner's Observation
+            # so corrections and (future) demand terms can see it
+            obs.queue_depth = fused.queue_depth
+        return fused

@@ -1,0 +1,170 @@
+"""Declarative SLO spec for the closed-loop autoscaler (``DYN_SLO_*``).
+
+The planner (PR 5 seed: ``planner/planner_core.py``) answers "how many
+replicas hold a TTFT/ITL SLA at predicted load"; this module declares the
+SLA side of that sentence per QoS class, plus the loop-stability knobs
+(scale bounds, cooldowns, reactive backlog threshold) the controller needs
+so the loop cannot flap (docs/autoscaling.md).
+
+Env surface (same layering rule as ``runtime/config.py``: a bad value must
+fail loudly at startup, not silently use a default):
+
+- ``DYN_SLO_<CLASS>_TTFT_P95_MS`` / ``DYN_SLO_<CLASS>_ITL_MS`` — per-QoS-
+  class latency targets (classes: INTERACTIVE/STANDARD/BATCH; an empty
+  value clears the target for that class).
+- ``DYN_SLO_GOVERNING_CLASS``  — the class whose targets parameterize the
+  planner's capacity inversion (default interactive: the strictest class
+  sizes the fleet; weaker classes ride its capacity).
+- ``DYN_SLO_MIN_REPLICAS`` / ``DYN_SLO_MAX_REPLICAS`` — fleet bounds.
+- ``DYN_SLO_COOLDOWN_UP_S`` / ``DYN_SLO_COOLDOWN_DOWN_S`` — hysteresis:
+  minimum spacing between scale events per direction.
+- ``DYN_SLO_INTERVAL_S``      — controller tick cadence.
+- ``DYN_SLO_PREDICTOR``       — constant|moving_average|arima|seasonal.
+- ``DYN_SLO_BACKLOG_PER_REPLICA`` — reactive term: waiting+swapped depth a
+  single replica is allowed to carry before backlog alone forces
+  scale-up (0 disables the reactive path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from dynamo_tpu.qos import CLASSES, CLASS_RANK, PriorityClass
+from dynamo_tpu.runtime.config import ConfigError
+
+
+@dataclass(frozen=True)
+class ClassSlo:
+    """Latency targets for one QoS class (None = no target)."""
+
+    ttft_p95_ms: Optional[float] = None
+    itl_ms: Optional[float] = None
+
+
+#: conservative defaults mirroring the planner CLI's historical 200/20 for
+#: the strict class; batch carries no latency SLO (it is throughput traffic
+#: whose contract is "completes, eventually" — docs/qos.md)
+_DEFAULT_CLASS_SLOS = {
+    PriorityClass.INTERACTIVE: ClassSlo(ttft_p95_ms=200.0, itl_ms=20.0),
+    PriorityClass.STANDARD: ClassSlo(ttft_p95_ms=1000.0, itl_ms=40.0),
+    PriorityClass.BATCH: ClassSlo(),
+}
+
+
+@dataclass
+class SloConfig:
+    """The autoscaler's declarative contract: per-class targets + loop knobs."""
+
+    class_slos: dict = field(
+        default_factory=lambda: dict(_DEFAULT_CLASS_SLOS))
+    #: class whose targets drive the planner's capacity inversion
+    governing_class: str = PriorityClass.INTERACTIVE
+    min_replicas: int = 1
+    max_replicas: int = 8
+    #: hysteresis: min seconds between scale events, per direction — the
+    #: asymmetry (fast up, slow down) is deliberate: under-capacity burns
+    #: SLOs now, over-capacity only burns chips
+    cooldown_up_s: float = 15.0
+    cooldown_down_s: float = 60.0
+    adjustment_interval_s: float = 10.0
+    predictor: str = "seasonal"
+    #: reactive term: waiting+swapped sequences one replica may carry
+    #: before backlog alone forces scale-up (0 = proactive-only)
+    backlog_per_replica: float = 8.0
+
+    def __post_init__(self):
+        if self.governing_class not in CLASS_RANK:
+            raise ConfigError(
+                f"slo field 'governing_class': unknown class "
+                f"{self.governing_class!r} (valid: {', '.join(CLASSES)})")
+        if self.min_replicas < 0:
+            raise ConfigError("slo field 'min_replicas': must be >= 0")
+        if self.max_replicas < max(1, self.min_replicas):
+            raise ConfigError(
+                "slo field 'max_replicas': must be >= max(1, min_replicas)")
+        for fname in ("cooldown_up_s", "cooldown_down_s",
+                      "adjustment_interval_s"):
+            if getattr(self, fname) < 0:
+                raise ConfigError(f"slo field '{fname}': must be >= 0")
+        if self.backlog_per_replica < 0:
+            raise ConfigError(
+                "slo field 'backlog_per_replica': must be >= 0")
+        if self.predictor not in ("constant", "moving_average", "arima",
+                                  "seasonal"):
+            raise ConfigError(
+                f"slo field 'predictor': unknown predictor "
+                f"{self.predictor!r}")
+        if self.adjustment_interval_s == 0:
+            raise ConfigError(
+                "slo field 'adjustment_interval_s': must be > 0")
+        for cls, slo in self.class_slos.items():
+            if cls not in CLASS_RANK:
+                raise ConfigError(f"slo class_slos: unknown class {cls!r}")
+            for n, v in (("ttft_p95_ms", slo.ttft_p95_ms),
+                         ("itl_ms", slo.itl_ms)):
+                if v is not None and v <= 0:
+                    raise ConfigError(
+                        f"slo target '{cls}.{n}': must be > 0")
+
+    # -- lookups -----------------------------------------------------------
+
+    def slo_for(self, cls: str) -> ClassSlo:
+        return self.class_slos.get(cls, ClassSlo())
+
+    @property
+    def governing(self) -> ClassSlo:
+        """The targets that parameterize the planner's capacity lookup.
+        A governing class with no TTFT/ITL target falls back to the strict
+        defaults — the planner needs SOME inversion point."""
+        slo = self.slo_for(self.governing_class)
+        base = _DEFAULT_CLASS_SLOS[PriorityClass.INTERACTIVE]
+        return ClassSlo(
+            ttft_p95_ms=slo.ttft_p95_ms or base.ttft_p95_ms,
+            itl_ms=slo.itl_ms or base.itl_ms)
+
+    # -- env loading -------------------------------------------------------
+
+    @classmethod
+    def load(cls, env: Optional[dict] = None) -> "SloConfig":
+        import os
+
+        env = os.environ if env is None else env
+
+        def num(var: str, default, kind=float):
+            raw = env.get(var)
+            if raw is None or raw == "":
+                return default
+            try:
+                return kind(raw)
+            except (TypeError, ValueError):
+                raise ConfigError(
+                    f"{var}: expected {kind.__name__}, got {raw!r}") from None
+
+        class_slos = {}
+        for c in CLASSES:
+            base = _DEFAULT_CLASS_SLOS[c]
+            up = c.upper()
+            ttft_raw = env.get(f"DYN_SLO_{up}_TTFT_P95_MS")
+            itl_raw = env.get(f"DYN_SLO_{up}_ITL_MS")
+            # empty string explicitly CLEARS a default target
+            ttft = (None if ttft_raw == "" else
+                    num(f"DYN_SLO_{up}_TTFT_P95_MS", base.ttft_p95_ms))
+            itl = (None if itl_raw == "" else
+                   num(f"DYN_SLO_{up}_ITL_MS", base.itl_ms))
+            class_slos[c] = ClassSlo(ttft_p95_ms=ttft, itl_ms=itl)
+        return cls(
+            class_slos=class_slos,
+            governing_class=env.get("DYN_SLO_GOVERNING_CLASS",
+                                    PriorityClass.INTERACTIVE),
+            min_replicas=num("DYN_SLO_MIN_REPLICAS", 1, int),
+            max_replicas=num("DYN_SLO_MAX_REPLICAS", 8, int),
+            cooldown_up_s=num("DYN_SLO_COOLDOWN_UP_S", 15.0),
+            cooldown_down_s=num("DYN_SLO_COOLDOWN_DOWN_S", 60.0),
+            adjustment_interval_s=num("DYN_SLO_INTERVAL_S", 10.0),
+            predictor=env.get("DYN_SLO_PREDICTOR", "seasonal"),
+            backlog_per_replica=num("DYN_SLO_BACKLOG_PER_REPLICA", 8.0),
+        )
+
+    def with_(self, **kw) -> "SloConfig":
+        return replace(self, **kw)

@@ -29,16 +29,36 @@ Reconcile behavior:
 
 - spec file changes are picked up each tick (mtime watch);
 - missing replicas are spawned (env merged over os.environ, with
-  DYN_REPLICA_INDEX set), excess replicas get SIGTERM → SIGKILL;
+  DYN_REPLICA_INDEX and a unique DYN_POD_NAME set), excess replicas are
+  **drained, not killed**: victims get SIGTERM and the PR 3
+  ``DYN_DRAIN_TIMEOUT`` window to finish their in-flight streams
+  ASYNCHRONOUSLY — the reconcile loop keeps ticking while they drain, and
+  only a victim that outlives the window is SIGKILLed (migration absorbs
+  whatever it was still holding). The old behavior (fixed blocking
+  ``wait(timeout=10)`` then SIGKILL) both froze reconcile mid-drain and
+  cut streams ~20 s before the configured drain window;
+- scale-down victims are chosen by **fewest in-flight streams** (worker
+  ``ForwardPassMetrics`` matched to replicas via their DYN_POD_NAME
+  instance metadata), newest-first on ties — so shedding capacity
+  disturbs the least work;
 - crashed replicas restart with exponential backoff, counted in status;
 - services marked ``plannerRole: prefill|decode`` follow the planner's
-  VirtualConnector target key on the control plane — the SLA planner
-  drives real scale-up/down end-to-end without Kubernetes (ref intent:
-  planner → operator → pods);
+  VirtualConnector target key on the control plane — the SLA planner /
+  autoscaler drives real scale-up/down end-to-end without Kubernetes
+  (ref intent: planner → operator → pods);
+- **readiness gating** (``readinessGate``, default on for planner-role
+  services when a control plane is attached): a replica only counts as
+  ``ready`` once it has REGISTERED on the control plane — for engine
+  workers that happens strictly after AOT warmup (engine/main.py warms up
+  before joining), so the autoscaler never sees phantom capacity during a
+  compile cliff. ``alive`` (process up) is reported separately;
 - observed state is written to ``<spec>.status.json`` every tick (the CRD
-  status subresource analog); scale-down kills newest-first and the dead
-  workers' leases expire, which is the reference's etcd-cleanup-on-
-  scale-down contract (internal/etcd/) falling out of lease semantics.
+  status subresource analog, atomically via temp file + ``os.replace`` so
+  readers never observe a torn file) and mirrored to the control-plane
+  key ``public/operator/<ns>/status`` for the autoscale controller and
+  ``dynctl autoscale``; dead workers' leases expire, which is the
+  reference's etcd-cleanup-on-scale-down contract (internal/etcd/)
+  falling out of lease semantics.
 """
 
 from __future__ import annotations
@@ -67,6 +87,8 @@ class ServiceSpec:
     command: list[str]
     env: dict = field(default_factory=dict)
     planner_role: Optional[str] = None  # "prefill" | "decode"
+    #: None = auto (gate when planner_role is set and a plane is attached)
+    readiness_gate: Optional[bool] = None
 
 
 @dataclass
@@ -77,6 +99,14 @@ class Replica:
     #: (command, env) the process was started with — a spec edit that
     #: changes either makes the replica stale and it is restarted
     config: tuple = ()
+    #: unique per-spawn identity; workers stamp it into their instance
+    #: metadata (DYN_POD_NAME → component.serve_endpoint), which is how
+    #: the operator matches control-plane registrations back to processes
+    pod_name: str = ""
+    # -- drain bookkeeping (only meaningful once the replica is a victim)
+    drain_started: float = 0.0
+    drain_deadline: float = 0.0
+    killed: bool = False
 
 
 def parse_spec(path: str) -> dict[str, ServiceSpec]:
@@ -89,12 +119,14 @@ def parse_spec(path: str) -> dict[str, ServiceSpec]:
         cmd = svc.get("command")
         if not cmd or not isinstance(cmd, list):
             raise ValueError(f"service {name}: 'command' list is required")
+        gate = svc.get("readinessGate")
         out[name] = ServiceSpec(
             name=name,
             replicas=int(svc.get("replicas", 1)),
             command=[str(c) for c in cmd],
             env={str(k): str(v) for k, v in (svc.get("env") or {}).items()},
             planner_role=svc.get("plannerRole"),
+            readiness_gate=None if gate is None else bool(gate),
         )
     if not out:
         raise ValueError(f"{path}: no services in spec")
@@ -103,18 +135,42 @@ def parse_spec(path: str) -> dict[str, ServiceSpec]:
 
 class ProcessOperator:
     def __init__(self, spec_path: str, plane=None, namespace: str = "dynamo",
-                 tick_s: float = 1.0):
+                 tick_s: float = 1.0, drain_timeout: Optional[float] = None):
         self.spec_path = spec_path
         self.plane = plane  # control plane for planner-target watching
         self.namespace = namespace
         self.tick_s = tick_s
+        if drain_timeout is None:
+            raw = os.environ.get("DYN_DRAIN_TIMEOUT", "30")
+            try:
+                drain_timeout = float(raw)
+            except ValueError:
+                raise ValueError(
+                    f"DYN_DRAIN_TIMEOUT: expected seconds, got {raw!r}"
+                ) from None
+        #: graceful window a scale-down victim gets between SIGTERM and
+        #: SIGKILL (the PR 3 drain contract, honored asynchronously)
+        self.drain_timeout = max(0.0, drain_timeout)
         self.services: dict[str, ServiceSpec] = parse_spec(spec_path)
         self.replicas: dict[str, list[Replica]] = {s: [] for s in self.services}
         self.restarts: dict[str, int] = {s: 0 for s in self.services}
         self._crash_streak: dict[str, int] = {s: 0 for s in self.services}
         self._next_start: dict[str, float] = {s: 0.0 for s in self.services}
+        #: victims mid-drain: no longer capacity, still alive processes
+        self._draining: dict[str, list[Replica]] = {s: [] for s in self.services}
         self._spec_mtime = os.path.getmtime(spec_path)
         self._planner_target: Optional[dict] = None
+        self._spawn_seq = 0
+        #: pod name -> instance id, from the control plane's instances/
+        #: prefix (refreshed each async tick; empty without a plane)
+        self._registered_pods: dict[str, int] = {}
+        #: instance id -> in-flight streams, from worker ForwardPassMetrics
+        self._inflight_by_instance: dict[int, int] = {}
+        self._metrics_agg = None  # MetricsAggregator when plane is set
+        # drain telemetry (mirrored into status → dynamo_autoscale_drain_seconds)
+        self.drain_seconds_total = 0.0
+        self.drains_completed = 0
+        self.drains_killed = 0
         self._stop = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
 
@@ -161,6 +217,7 @@ class ProcessOperator:
             self.restarts.setdefault(name, 0)
             self._crash_streak.setdefault(name, 0)
             self._next_start.setdefault(name, 0.0)
+            self._draining.setdefault(name, [])
         self.services = new
         logger.info("spec reloaded: %s",
                     {n: s.replicas for n, s in new.items()})
@@ -175,26 +232,87 @@ class ProcessOperator:
         env = dict(os.environ)
         env.update(svc.env)
         env["DYN_REPLICA_INDEX"] = str(index)
+        self._spawn_seq += 1
+        # unique per spawn: a crashed replica's successor must not inherit
+        # the stale registration of its predecessor's still-leased keys
+        pod_name = f"{svc.name}-{index}-{self._spawn_seq}"
+        env["DYN_POD_NAME"] = pod_name
         proc = subprocess.Popen(svc.command, env=env)
-        logger.info("started %s[%d] pid=%d", svc.name, index, proc.pid)
+        logger.info("started %s[%d] pid=%d pod=%s", svc.name, index,
+                    proc.pid, pod_name)
         return Replica(proc=proc, index=index, started=time.monotonic(),
-                       config=self._svc_config(svc))
+                       config=self._svc_config(svc), pod_name=pod_name)
+
+    # -- drain-safe scale-down --------------------------------------------
+
+    def _begin_drain(self, svc_name: str, r: Replica, why: str) -> None:
+        """SIGTERM the victim and give it the drain window ASYNCHRONOUSLY:
+        it leaves the capacity set now, the reconcile loop keeps ticking,
+        and _reap_draining SIGKILLs only a victim that outlives
+        ``drain_timeout``. (The old fixed blocking ``wait(timeout=10)``
+        both froze reconcile and ignored DYN_DRAIN_TIMEOUT — in-flight
+        streams died ~20 s before their configured window.)"""
+        now = time.monotonic()
+        r.drain_started = now
+        r.drain_deadline = now + self.drain_timeout
+        logger.info("draining %s[%d] pid=%d (%s, window %.1fs)", svc_name,
+                    r.index, r.proc.pid, why, self.drain_timeout)
+        try:
+            r.proc.terminate()
+        except ProcessLookupError:
+            pass
+        self._draining.setdefault(svc_name, []).append(r)
+
+    def _reap_draining(self) -> None:
+        """Advance every in-progress drain (non-blocking, every tick)."""
+        now = time.monotonic()
+        for name in list(self._draining):
+            keep = []
+            for r in self._draining[name]:
+                if r.proc.poll() is not None:
+                    took = now - r.drain_started
+                    self.drain_seconds_total += took
+                    if r.killed:
+                        self.drains_killed += 1
+                    else:
+                        self.drains_completed += 1
+                        logger.info("%s[%d] drained in %.1fs", name,
+                                    r.index, took)
+                    continue
+                if not r.killed and now >= r.drain_deadline:
+                    logger.warning("%s[%d] outlived its %.1fs drain window; "
+                                   "SIGKILL", name, r.index,
+                                   self.drain_timeout)
+                    try:
+                        r.proc.kill()
+                    except ProcessLookupError:
+                        pass
+                    r.killed = True
+                keep.append(r)
+            if keep or name in self.services:
+                self._draining[name] = keep
+            else:
+                del self._draining[name]  # removed service fully drained
+
+    def _inflight_of(self, r: Replica) -> int:
+        """In-flight streams on a replica per its last ForwardPassMetrics
+        (matched through the pod-name instance metadata). Unregistered
+        replicas report -1: a worker that never joined the plane holds no
+        streams and is the cheapest possible victim."""
+        iid = self._registered_pods.get(r.pod_name)
+        if iid is None:
+            return -1
+        return self._inflight_by_instance.get(iid, 0)
 
     def _scale_to(self, svc: ServiceSpec, want: int) -> None:
         reps = self.replicas[svc.name]
-        # replicas running an outdated command/env are stale: stop them
+        # replicas running an outdated command/env are stale: drain them
         # (the scale-up below respawns with the current spec) — a spec
         # edit must converge, not just adjust counts
         cur = self._svc_config(svc)
         for r in [r for r in reps if r.config != cur and r.proc.poll() is None]:
-            logger.info("restarting %s[%d]: spec changed", svc.name, r.index)
-            r.proc.terminate()
-            try:
-                r.proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                r.proc.kill()
-                r.proc.wait()
             reps.remove(r)
+            self._begin_drain(svc.name, r, "spec changed")
         # reap exited replicas (crash → restart with backoff)
         alive = []
         for r in reps:
@@ -211,60 +329,143 @@ class ProcessOperator:
                 delay = _BACKOFF[min(streak, len(_BACKOFF) - 1)]
                 self._next_start[svc.name] = time.monotonic() + delay
         reps[:] = alive
-        # scale down: newest first (leases expire → discovery forgets them)
-        while len(reps) > want:
-            r = reps.pop()
-            logger.info("stopping %s[%d] pid=%d", svc.name, r.index, r.proc.pid)
-            r.proc.terminate()
-            try:
-                r.proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                r.proc.kill()
-                r.proc.wait()
+        # scale down: fewest in-flight streams first (disturb the least
+        # work), newest-first on ties (the historical order; leases expire
+        # → discovery forgets the victims)
+        if len(reps) > want:
+            victims = sorted(reps, key=lambda r: (self._inflight_of(r),
+                                                  -r.started))
+            for r in victims[: len(reps) - want]:
+                reps.remove(r)
+                self._begin_drain(svc.name, r, "scale down")
         # scale up (respecting crash backoff)
         while len(reps) < want and time.monotonic() >= self._next_start[svc.name]:
             used = {r.index for r in reps}
             index = next(i for i in range(want) if i not in used)
             reps.append(self._spawn(svc, index))
 
+    # -- readiness ---------------------------------------------------------
+
+    def _gated(self, svc: ServiceSpec) -> bool:
+        if svc.readiness_gate is not None:
+            return svc.readiness_gate and self.plane is not None
+        return self.plane is not None and svc.planner_role is not None
+
+    def _alive(self, name: str) -> list[Replica]:
+        return [r for r in self.replicas[name] if r.proc.poll() is None]
+
+    def _ready_count(self, svc: ServiceSpec) -> int:
+        """Replicas that count toward capacity: alive AND (when gated)
+        registered on the control plane. Engine workers register strictly
+        after AOT warmup, so 'registered' subsumes 'warm' — the planner
+        never counts a replica still paying its compile cliff."""
+        alive = self._alive(svc.name)
+        if not self._gated(svc):
+            return len(alive)
+        return sum(1 for r in alive if r.pod_name in self._registered_pods)
+
     def reconcile_once(self) -> None:
         self._maybe_reload_spec()
+        self._reap_draining()
         for svc in self.services.values():
             self._scale_to(svc, self._desired(svc))
         self._write_status()
 
-    def _write_status(self) -> None:
+    def _status(self) -> dict:
         status = {
             "observedAt": time.time(),
             "services": {
                 name: {
                     "desired": self._desired(svc),
-                    "ready": sum(1 for r in self.replicas[name]
-                                 if r.proc.poll() is None),
+                    "alive": len(self._alive(name)),
+                    "ready": self._ready_count(svc),
+                    "draining": len(self._draining.get(name, [])),
                     "restarts": self.restarts[name],
-                    "pids": [r.proc.pid for r in self.replicas[name]
-                             if r.proc.poll() is None],
+                    "plannerRole": svc.planner_role,
+                    "readinessGated": self._gated(svc),
+                    "pids": [r.proc.pid for r in self._alive(name)],
                 }
                 for name, svc in self.services.items()
             },
+            "drainSecondsTotal": round(self.drain_seconds_total, 3),
+            "drainsCompleted": self.drains_completed,
+            "drainsKilled": self.drains_killed,
         }
         if self._planner_target:
             status["plannerTarget"] = self._planner_target
+        return status
+
+    def _write_status(self) -> None:
+        # temp file + os.replace: a concurrent reader (dynctl, the
+        # autoscale loop, tests tailing the file) must never observe a
+        # torn/partial JSON document
+        status = self._status()
         tmp = self.spec_path + ".status.json.tmp"
         with open(tmp, "w") as f:
             json.dump(status, f, indent=2)
         os.replace(tmp, self.spec_path + ".status.json")
 
+    async def _publish_status(self) -> None:
+        """Mirror observed state to the control plane so the autoscale
+        controller's readiness gate and ``dynctl autoscale`` see it
+        without filesystem access."""
+        if self.plane is None:
+            return
+        from dynamo_tpu.autoscale.controller import OPERATOR_STATUS_KEY
+
+        try:
+            await self.plane.kv_put(
+                OPERATOR_STATUS_KEY.format(namespace=self.namespace),
+                json.dumps(self._status()).encode())
+        except Exception:
+            logger.exception("operator status publish failed")
+
+    async def _refresh_observed(self) -> None:
+        """Refresh the pod→instance map (readiness) and per-instance
+        in-flight counts (victim selection) from the control plane."""
+        if self.plane is None:
+            return
+        try:
+            import msgpack
+
+            regs = await self.plane.kv_get_prefix("instances/")
+            pods: dict[str, int] = {}
+            for v in regs.values():
+                try:
+                    d = msgpack.unpackb(v, raw=False)
+                    pod = (d.get("metadata") or {}).get("pod")
+                    if pod:
+                        pods[pod] = int(d["instance_id"])
+                except Exception:
+                    continue
+            self._registered_pods = pods
+        except Exception:
+            logger.exception("instance registry read failed")
+        if self._metrics_agg is not None:
+            # snapshot(), not .latest: workers publish only while
+            # stepping, so an idle replica's final busy report must age
+            # out or victim selection drains a genuinely-busy peer first
+            self._inflight_by_instance = {
+                wid: m.worker_stats.request_active_slots
+                for wid, m in self._metrics_agg.snapshot().items()}
+
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> "ProcessOperator":
+        if self.plane is not None and self._metrics_agg is None:
+            from dynamo_tpu.router.publisher import MetricsAggregator
+
+            self._metrics_agg = await MetricsAggregator(
+                self.plane, stale_after_s=10.0).start()
         self._task = asyncio.get_running_loop().create_task(self._loop())
         return self
 
     async def _loop(self):
         while not self._stop.is_set():
             await self._refresh_planner_target()
+            await self._refresh_observed()
             await asyncio.to_thread(self.reconcile_once)
+            await self._publish_status()
             try:
                 await asyncio.wait_for(self._stop.wait(), self.tick_s)
             except asyncio.TimeoutError:
@@ -274,10 +475,35 @@ class ProcessOperator:
         self._stop.set()
         if self._task is not None:
             await self._task
+        if self._metrics_agg is not None:
+            await self._metrics_agg.stop()
+            self._metrics_agg = None
         if drain:
             for svc in self.services.values():
                 self._scale_to(svc, 0)
+            # bounded graceful shutdown: give every victim its drain
+            # window (they all drain CONCURRENTLY), then force the rest
+            deadline = time.monotonic() + self.drain_timeout + 2.0
+            while (any(self._draining.values())
+                   and time.monotonic() < deadline):
+                self._reap_draining()
+                if not any(self._draining.values()):
+                    break
+                await asyncio.sleep(0.05)
+            for name in list(self._draining):
+                for r in self._draining[name]:
+                    if r.proc.poll() is None:
+                        try:
+                            r.proc.kill()
+                        except ProcessLookupError:
+                            pass
+                        r.proc.wait()
+                        self.drains_killed += 1
+                        self.drain_seconds_total += (
+                            time.monotonic() - r.drain_started)
+            self._draining = {s: [] for s in self.services}
             self._write_status()
+            await self._publish_status()
 
 
 async def amain():

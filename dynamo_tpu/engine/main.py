@@ -157,6 +157,11 @@ async def amain():
     ap.add_argument("--allow-test-metadata", action="store_true",
                     help="permit the toy tokenizer + eos=[2] defaults when no "
                          "--model-path is given (tests only)")
+    ap.add_argument("--migration-limit", type=int, default=None,
+                    help="max stream migrations per request (model card "
+                         "migration_limit; raise under autoscale worker "
+                         "churn so drained/killed workers' streams resume "
+                         "elsewhere)")
     ap.add_argument("--no-preempt-swap", dest="preempt_swap",
                     action="store_false", default=True,
                     help="disable preempt-to-swap (KV of preempted "
@@ -614,6 +619,8 @@ async def amain():
         card.runtime_config.total_kv_blocks = engine.num_blocks
         card.runtime_config.max_num_seqs = args.max_num_seqs
         card.runtime_config.max_num_batched_tokens = args.max_num_batched_tokens
+        if cli.migration_limit is not None:
+            card.migration_limit = cli.migration_limit
         tool_parser, reasoning_parser = cli.tool_call_parser, cli.reasoning_parser
         if cfg.attention_sinks:  # gpt-oss family emits harmony channels:
             # parse them by default so tool_calls/reasoning_content populate

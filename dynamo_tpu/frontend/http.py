@@ -180,6 +180,12 @@ class HttpService:
         self._ttft = self.metrics.histogram(
             "http_time_to_first_token_seconds", "Time to first streamed token"
         )
+        # per-QoS-class TTFT: the autoscaler's SLO-compliance signal
+        # (DYN_SLO_<CLASS>_TTFT_P95_MS targets are checked against the
+        # interval p95 estimated from these buckets — autoscale/observe.py)
+        self._ttft_class = self.metrics.histogram(
+            "http_ttft_class_seconds",
+            "Time to first streamed token by QoS class")
         self._inflight = self.metrics.gauge("http_inflight_requests", "In-flight requests")
         self._inflight_count = 0
         self._model_inflight: dict[str, int] = {}
@@ -827,9 +833,10 @@ class HttpService:
                         finish = ch.get("finish_reason") or finish
                         if delta:
                             if timing.tick():
-                                self._ttft.observe(
-                                    time.perf_counter() - t0,
-                                    route="responses")
+                                dt = time.perf_counter() - t0
+                                self._ttft.observe(dt, route="responses")
+                                self._ttft_class.observe(
+                                    dt, qos=ctx.priority or "standard")
                             parts.append(delta)
                             buf += record("response.output_text.delta", {
                                 "type": "response.output_text.delta",
@@ -1028,7 +1035,10 @@ class HttpService:
                         buf += f"event: {ann.event}\ndata: {json.dumps(ann.data)}\n\n".encode()
                         continue
                     if timing.tick():
-                        self._ttft.observe(time.perf_counter() - t0, route=route)
+                        dt = time.perf_counter() - t0
+                        self._ttft.observe(dt, route=route)
+                        self._ttft_class.observe(
+                            dt, qos=ctx.priority or "standard")
                     data = ann.data
                     if isinstance(data, dict) and "usage" in data:
                         # the pipeline always attaches final-chunk usage for

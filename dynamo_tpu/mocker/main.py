@@ -98,6 +98,9 @@ async def amain():
                          "stream + metrics stream per rank)")
     ap.add_argument("--startup-time", type=float, default=None)
     ap.add_argument("--no-prefix-caching", action="store_true")
+    ap.add_argument("--migration-limit", type=int, default=None,
+                    help="max stream migrations per request (model card "
+                         "migration_limit; raise under chaos/worker churn)")
     ap.add_argument(
         "--vocab-size", type=int, default=0,
         help="0 = derive from the model tokenizer so outputs decode to text",
@@ -123,7 +126,8 @@ async def amain():
         startup_time=cli.startup_time,
     )
     engines, handles = await run_mocker(
-        runtime, cli.model, args, cli.namespace, cli.component
+        runtime, cli.model, args, cli.namespace, cli.component,
+        migration_limit=cli.migration_limit,
     )
     print("MOCKER_READY", flush=True)
 
@@ -132,8 +136,13 @@ async def amain():
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    # SIGTERM drain (same contract as engine/main.py): deregister first so
+    # routers stop picking this worker, then give in-flight streams the
+    # DYN_DRAIN_TIMEOUT window instead of holding shutdown open forever —
+    # the operator's drain-safe scale-down counts on this bound
     for handle in handles:
-        await handle.stop()
+        await handle.stop(graceful=True,
+                          timeout=runtime.config.drain_timeout)
     for engine in engines:
         await engine.stop()
     await runtime.shutdown()

@@ -44,6 +44,11 @@ class PerfInterpolator:
         """Interpolated latency at a per-replica load (clamped to the sweep)."""
         return float(np.interp(load, self.loads, self.lats))
 
+    def min_load(self) -> float:
+        """The sweep's lowest measured load — the most pessimistic
+        capacity the profile can honestly claim for one replica."""
+        return float(self.loads[0])
+
     def max_load_under(self, latency_target_ms: float) -> float:
         """Largest per-replica load whose latency stays ≤ target.
 
@@ -108,6 +113,14 @@ class PerfInterpolator2D:
         lo, hi, t = self._neighbors(isl)
         a = self.curves[lo].latency_at(load)
         b = self.curves[hi].latency_at(load)
+        return float(a + t * (b - a))
+
+    def min_load(self, isl: float) -> float:
+        """Blended lowest measured load at this ISL (see
+        :meth:`PerfInterpolator.min_load`)."""
+        lo, hi, t = self._neighbors(isl)
+        a = self.curves[lo].min_load()
+        b = self.curves[hi].min_load()
         return float(a + t * (b - a))
 
     @staticmethod

@@ -34,6 +34,16 @@ class Observation:
     osl: float  # mean output sequence length
     ttft_ms: Optional[float] = None
     itl_ms: Optional[float] = None
+    #: waiting+swapped sequences across the worker fleet (fused in by the
+    #: autoscaler's ObservationFuser; the reactive backlog signal)
+    queue_depth: Optional[float] = None
+    #: replicas actually registered+warm when this interval was measured
+    #: (operator readiness gate). When set, the correction math reads
+    #: per-replica load against REAL capacity instead of the planner's
+    #: decision — a compile-cliff latency spike otherwise inflates the
+    #: correction factor exactly when the loop is most excitable.
+    ready_prefill: Optional[int] = None
+    ready_decode: Optional[int] = None
 
 
 @dataclass
@@ -108,8 +118,15 @@ class Planner:
         interval; the EMA keeps one noisy interval from whipsawing the
         fleet)."""
         a = self.cfg.correction_ema
+        # `is not None`, not truthiness: ready == 0 (whole fleet mid-
+        # restart) is the MOST important case to honor — falling back to
+        # the decision count there understates per-replica load N-fold
+        p_replicas = (obs.ready_prefill if obs.ready_prefill is not None
+                      else self.current.prefill_replicas)
+        d_replicas = (obs.ready_decode if obs.ready_decode is not None
+                      else self.current.decode_replicas)
         if obs.ttft_ms is not None and obs.request_rate > 0:
-            load = obs.request_rate / max(1, self.current.prefill_replicas)
+            load = obs.request_rate / max(1, p_replicas)
             if isinstance(self.prefill_perf, PerfInterpolator2D):
                 expect = self.prefill_perf.latency_at(load, obs.isl)
             else:
@@ -126,7 +143,7 @@ class Planner:
                     + a * (obs.ttft_ms / expect))
         if obs.itl_ms is not None and obs.request_rate > 0 and obs.osl > 0:
             tok_load = (obs.request_rate * obs.osl
-                        / max(1, self.current.decode_replicas))
+                        / max(1, d_replicas))
             expect = self.decode_perf.latency_at(tok_load)
             if expect > 0:
                 self.d_correction_factor = (
@@ -157,19 +174,38 @@ class Planner:
         d_corr = 1.0 if cfg.no_correction else _clamp_corr(
             self.d_correction_factor)
 
+        def capacity(perf, sla_ms: float, corr: float, *isl_args) -> float:
+            """Per-replica capacity at the CORRECTED SLA, with a floor.
+
+            0 (impossible) is kept only when the RAW SLA is itself below
+            the profile's idle latency — "throw max capacity at it" is
+            then the honest answer. But when the raw SLA is achievable
+            and only the corrected target (sla/corr) fell off the curve,
+            the correction factor has exceeded its useful range: adding
+            replicas cannot improve PER-REPLICA latency, so pinning the
+            fleet at max would burn chips forever (observed live: a 20 ms
+            ITL target against a ~23 ms engine pinned decode at max
+            through an entire load trough). Fall back to the profile's
+            most pessimistic measured capacity instead.
+            """
+            cap = perf.max_load_under(sla_ms / corr, *isl_args)
+            if cap <= 0 and perf.max_load_under(sla_ms, *isl_args) > 0:
+                cap = perf.min_load(*isl_args)
+            return cap
+
         # prefill: per-replica sustainable request rate at the TTFT SLA.
         # With a 2D profile (TTFT over ISL × rate) the capacity comes from
         # the curve AT the predicted ISL; a 1D profile falls back to the
         # linear ISL-drift rescale around profiled_isl.
         eff_rate = rate
         if isinstance(self.prefill_perf, PerfInterpolator2D):
-            per_replica_rate = self.prefill_perf.max_load_under(
-                cfg.ttft_sla_ms / p_corr, isl)
+            per_replica_rate = capacity(self.prefill_perf, cfg.ttft_sla_ms,
+                                        p_corr, isl)
         else:
             if cfg.profiled_isl > 0 and isl > 0:
                 eff_rate = rate * (isl / cfg.profiled_isl)
-            per_replica_rate = self.prefill_perf.max_load_under(
-                cfg.ttft_sla_ms / p_corr)
+            per_replica_rate = capacity(self.prefill_perf, cfg.ttft_sla_ms,
+                                        p_corr)
         if per_replica_rate <= 0:
             p = cfg.max_prefill_replicas
         else:
@@ -177,8 +213,7 @@ class Planner:
 
         # decode: demanded decode tokens/s vs per-replica capacity at ITL SLA
         decode_demand = rate * osl
-        per_replica_tok = self.decode_perf.max_load_under(
-            cfg.itl_sla_ms / d_corr)
+        per_replica_tok = capacity(self.decode_perf, cfg.itl_sla_ms, d_corr)
         if per_replica_tok <= 0:
             d = cfg.max_decode_replicas
         else:
@@ -221,6 +256,12 @@ class PlannerRunner:
         self.interval = interval_s or planner.cfg.adjustment_interval_s
         self._task: Optional[asyncio.Task] = None
         self._stop = asyncio.Event()
+        #: loop telemetry (tests + dynctl): total iterations, iterations
+        #: whose metrics source yielded nothing (scrape failure / idle),
+        #: and iterations that raised (the loop survives both)
+        self.ticks = 0
+        self.empty_ticks = 0
+        self.tick_errors = 0
 
     async def start(self):
         self._task = asyncio.get_running_loop().create_task(self._loop())
@@ -233,13 +274,17 @@ class PlannerRunner:
 
     async def _loop(self):
         while not self._stop.is_set():
+            self.ticks += 1
             try:
                 obs = await self.metrics_source()
                 if obs is not None:
                     self.planner.observe(obs)
                     decision = self.planner.compute()
                     await self.connector.apply(decision)
+                else:
+                    self.empty_ticks += 1
             except Exception:
+                self.tick_errors += 1
                 logger.exception("planner iteration failed")
             try:
                 await asyncio.wait_for(self._stop.wait(), self.interval)

@@ -56,12 +56,28 @@ def parse_prometheus_text(text: str) -> dict[str, float]:
 class PrometheusMetricsSource:
     """async () -> Observation|None over a frontend /metrics URL."""
 
+    #: counter families whose raw monotonic values feed the deltas — the
+    #: reset detector watches exactly these (histogram means ride on them)
+    _COUNTERS = (
+        "dynamo_llm_requests_finished_total",
+        "dynamo_llm_prompt_tokens_total",
+        "dynamo_llm_completion_tokens_total",
+        "dynamo_http_request_duration_seconds_count",
+        "dynamo_http_time_to_first_token_seconds_count",
+    )
+
     def __init__(self, url: str):
         self.url = url.rstrip("/")
         if not self.url.endswith("/metrics"):
             self.url += "/metrics"
         self._prev: Optional[dict[str, float]] = None
         self._prev_t: float = 0.0
+        #: raw text of the last successful scrape (the autoscaler's
+        #: per-class TTFT tracker parses histogram buckets from it)
+        self.last_text: Optional[str] = None
+        #: scrape failures + counter resets observed (loop telemetry)
+        self.scrape_failures = 0
+        self.resets = 0
 
     async def _fetch(self) -> Optional[dict[str, float]]:
         import aiohttp
@@ -71,9 +87,13 @@ class PrometheusMetricsSource:
                 async with s.get(self.url,
                                  timeout=aiohttp.ClientTimeout(total=5)) as r:
                     if r.status != 200:
+                        self.scrape_failures += 1
                         return None
-                    return parse_prometheus_text(await r.text())
+                    text = await r.text()
+                    self.last_text = text
+                    return parse_prometheus_text(text)
         except Exception:
+            self.scrape_failures += 1
             logger.warning("metrics scrape failed: %s", self.url)
             return None
 
@@ -86,6 +106,17 @@ class PrometheusMetricsSource:
         self._prev, self._prev_t = cur, now
         if prev is None:
             return None  # first sample: no deltas yet
+        # counter-reset detection: a restarted frontend starts every
+        # counter back at ~0, so cur < prev. The per-delta max(0, ·) below
+        # already clamps each counter individually, but a PARTIAL interval
+        # (reset mid-window: small-but-positive deltas against pre-restart
+        # latency sums) would still feed the predictor a garbage sample —
+        # skip the whole interval and rebase on the fresh counters.
+        if any(cur.get(n, 0.0) < prev.get(n, 0.0) for n in self._COUNTERS):
+            self.resets += 1
+            logger.warning("counter reset detected (frontend restart?); "
+                           "skipping one observation interval")
+            return None
 
         def delta(name: str) -> float:
             return max(0.0, cur.get(name, 0.0) - prev.get(name, 0.0))

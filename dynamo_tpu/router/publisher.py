@@ -216,12 +216,24 @@ def parse_load_event(payload: bytes) -> tuple[int, ForwardPassMetrics]:
 
 
 class MetricsAggregator:
-    """Collects the latest ForwardPassMetrics per worker (ref: metrics_aggregator.rs)."""
+    """Collects the latest ForwardPassMetrics per worker (ref: metrics_aggregator.rs).
 
-    def __init__(self, plane, subject: str = KV_METRICS_SUBJECT):
+    Workers that stop reporting (crash, scale-down drain) age out of the
+    aggregate after ``stale_after_s`` — without expiry a drained worker's
+    last report would count as phantom load/backlog forever, which the
+    autoscale loop would read as demand that never drains. Expiry is
+    OPT-IN (default off): workers publish only while actively stepping,
+    so an expiring aggregate reads a healthy-but-idle fleet as empty —
+    wrong for the cluster /metrics view, right for the autoscaler (which
+    cares about load, not liveness)."""
+
+    def __init__(self, plane, subject: str = KV_METRICS_SUBJECT,
+                 stale_after_s: float = 0.0):
         self.plane = plane
         self.subject = subject
+        self.stale_after_s = stale_after_s
         self.latest: dict[int, ForwardPassMetrics] = {}
+        self._seen_at: dict[int, float] = {}
         self._sub = None
         self._task: Optional[asyncio.Task] = None
 
@@ -237,17 +249,39 @@ class MetricsAggregator:
             await self._sub.cancel()
 
     async def _loop(self):
+        import time as _time
+
         try:
             async for _subject, payload in self._sub:
                 try:
                     worker_id, metrics = parse_load_event(payload)
                     self.latest[worker_id] = metrics
+                    self._seen_at[worker_id] = _time.monotonic()
                 except Exception:
                     logger.exception("bad metrics payload ignored")
         except asyncio.CancelledError:
             pass
 
+    def _expire_stale(self) -> None:
+        import time as _time
+
+        if not self.stale_after_s:
+            return
+        cutoff = _time.monotonic() - self.stale_after_s
+        for wid in [w for w, t in self._seen_at.items() if t < cutoff]:
+            self._seen_at.pop(wid, None)
+            self.latest.pop(wid, None)
+
+    def snapshot(self) -> dict:
+        """Per-worker latest metrics with staleness expiry applied —
+        readers of per-worker state (the operator's victim selection)
+        must use this, not ``.latest`` directly, or a long-idle worker's
+        final busy report reads as current load forever."""
+        self._expire_stale()
+        return dict(self.latest)
+
     def aggregate(self) -> dict:
+        self._expire_stale()
         total_active = sum(m.kv_stats.kv_active_blocks for m in self.latest.values())
         total_blocks = sum(m.kv_stats.kv_total_blocks for m in self.latest.values())
         return {
@@ -260,5 +294,8 @@ class MetricsAggregator:
             ),
             "requests_waiting": sum(
                 m.worker_stats.num_requests_waiting for m in self.latest.values()
+            ),
+            "total_slots": sum(
+                m.worker_stats.request_total_slots for m in self.latest.values()
             ),
         }

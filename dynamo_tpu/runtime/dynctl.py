@@ -17,6 +17,10 @@ Subcommands:
   every registered tracer over the control plane (frontend, workers) and
   print the trace tree; ``--json`` dumps the raw span list. Needs
   ``DYN_CONTROL_PLANE`` pointed at the cluster's hub.
+- ``dynctl autoscale`` — live view of the closed-loop SLA autoscaler
+  (docs/autoscaling.md): controller decision/SLO state, planner target,
+  and the operator's desired/alive/ready/draining counts per service;
+  ``--watch`` refreshes, ``--json`` dumps the raw status documents.
 """
 
 from __future__ import annotations
@@ -99,6 +103,101 @@ async def trace_amain(request_id: str, as_json: bool, timeout: float) -> int:
         await runtime.shutdown()
 
 
+async def autoscale_amain(namespace: str, as_json: bool,
+                          watch: float = 0.0) -> int:
+    """Render the autoscale loop's live state from its control-plane keys."""
+    from dynamo_tpu.autoscale.controller import (
+        AUTOSCALE_STATUS_KEY, OPERATOR_STATUS_KEY,
+    )
+    from dynamo_tpu.planner.virtual_connector import SCALE_KEY
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    runtime = await DistributedRuntime.create()
+
+    async def read(key_tpl: str):
+        raw = await runtime.plane.kv_get(
+            key_tpl.format(namespace=namespace))
+        return json.loads(raw) if raw else None
+
+    def fmt_age(ts) -> str:
+        import time as _t
+
+        return f"{max(0.0, _t.time() - ts):.0f}s ago" if ts else "never"
+
+    try:
+        while True:
+            ctl = await read(AUTOSCALE_STATUS_KEY)
+            op = await read(OPERATOR_STATUS_KEY)
+            target = await read(SCALE_KEY)
+            if as_json:
+                print(json.dumps({"autoscale": ctl, "operator": op,
+                                  "plannerTarget": target}, indent=2))
+            else:
+                print(f"autoscale status (namespace {namespace!r})")
+                if ctl is None and op is None and target is None:
+                    print("  nothing published — is the autoscaler/operator "
+                          "running against this control plane?")
+                if ctl:
+                    d = ctl.get("desired") or {}
+                    r = ctl.get("ready") or {}
+                    last = ctl.get("lastDecision") or {}
+                    c = ctl.get("counters") or {}
+                    print(f"  controller  updated {fmt_age(ctl.get('ts'))}: "
+                          f"desired prefill={d.get('prefill')} "
+                          f"decode={d.get('decode')}  ready={r or '-'}  "
+                          f"backlog={ctl.get('queueDepth')}  "
+                          f"workers={ctl.get('workers')}")
+                    print(f"  last decision: {last.get('direction')} "
+                          f"({last.get('reason')})  "
+                          f"ups={c.get('scaleUps')} downs={c.get('scaleDowns')} "
+                          f"deferred={c.get('deferredUnready')} "
+                          f"cooldown-held={c.get('heldCooldown')} "
+                          f"scrape-failures={c.get('scrapeFailures')}")
+                    for cls, b in sorted((ctl.get("slo") or {}).items()):
+                        mark = "OK" if b.get("ok") else "BREACH"
+                        print(f"  slo {cls:<12s} ttft p95 "
+                              f"{b.get('ttft_p95_ms')}ms / "
+                              f"target {b.get('target_ms')}ms  [{mark}]")
+                if target:
+                    print(f"  planner key: prefill={target.get('prefill')} "
+                          f"decode={target.get('decode')} "
+                          f"(rev {target.get('revision')})")
+                if op:
+                    for name, svc in sorted(
+                            (op.get("services") or {}).items()):
+                        role = svc.get("plannerRole") or "-"
+                        gate = "gated" if svc.get("readinessGated") else "ungated"
+                        print(f"  {name:<12s} role={role:<8s} "
+                              f"desired={svc.get('desired')} "
+                              f"alive={svc.get('alive')} "
+                              f"ready={svc.get('ready')} "
+                              f"draining={svc.get('draining')} "
+                              f"restarts={svc.get('restarts')} [{gate}]")
+                    print(f"  drains: {op.get('drainsCompleted', 0)} graceful"
+                          f", {op.get('drainsKilled', 0)} killed, "
+                          f"{op.get('drainSecondsTotal', 0.0)}s total")
+            if not watch:
+                return 0 if (ctl or op or target) else 1
+            await asyncio.sleep(watch)
+            print()
+    finally:
+        await runtime.shutdown()
+
+
+def _autoscale_main(argv: list[str]) -> None:
+    ap = argparse.ArgumentParser(
+        prog="dynctl autoscale",
+        description="show the closed-loop SLA autoscaler's live state")
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw status documents")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every N seconds (0 = one-shot)")
+    args = ap.parse_args(argv)
+    raise SystemExit(asyncio.run(
+        autoscale_amain(args.namespace, args.json, args.watch)))
+
+
 def _trace_main(argv: list[str]) -> None:
     ap = argparse.ArgumentParser(
         prog="dynctl trace",
@@ -117,6 +216,9 @@ def main():
     setup_logging()
     if len(sys.argv) > 1 and sys.argv[1] == "trace":
         _trace_main(sys.argv[2:])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "autoscale":
+        _autoscale_main(sys.argv[2:])
         return
     ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
     ap.add_argument("--host", default="0.0.0.0")
