@@ -813,6 +813,133 @@ async def qos_bench(on_tpu: bool = False, reps: int = 4) -> dict:
     }
 
 
+async def ragged_bench(on_tpu: bool = False, reps: int = 3) -> dict:
+    """``bench.py --ragged``: ragged vs bucketed A/B on a MIXED
+    prefill+decode workload (ISSUE 7 acceptance).
+
+    The same seeded workload — long-prompt/short-output requests arriving
+    while short-prompt/long-output streams are mid-decode, so steps
+    genuinely carry prefill chunks AND decode rows — runs twice: ragged
+    step on (one packed launch per plan, ops/ragged_attention.py), then
+    ``ragged_step=False`` (the bucketed per-(chunk × batch × width) path).
+    Reports decode tok/s, TTFT p95, AOT warmup seconds, compiled-signature
+    counts (warmup AND serving), and padded-token waste for both.
+
+    Acceptance: compiled signatures shrink ≥ 4×, tok/s holds, TTFT p95
+    does not regress (target: a measurable win from zero padded dispatch).
+    """
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+
+    if on_tpu:
+        cfg = ModelConfig.llama3_1b()
+        bs = 16
+        N_P, ISL_P, OSL_P = 8, 512, 32   # prefill-heavy
+        N_D, ISL_D, OSL_D = 8, 64, 128   # decode-heavy
+        slots, budget = 16, 1024
+        extra = dict(use_pallas_attention=True)
+    else:
+        cfg = ModelConfig.tiny()
+        bs = 4
+        N_P, ISL_P, OSL_P = 4, 96, 12
+        N_D, ISL_D, OSL_D = 4, 16, 40
+        slots, budget = 8, 128
+        extra = {}
+    max_len = 2 * max(ISL_P + OSL_P, ISL_D + OSL_D)
+    working = (N_P * ((ISL_P + OSL_P + bs - 1) // bs)
+               + N_D * ((ISL_D + OSL_D + bs - 1) // bs))
+    base = dict(block_size=bs, num_blocks=2 * working + 8, max_num_seqs=slots,
+                max_num_batched_tokens=budget, max_model_len=max_len,
+                enable_prefix_caching=False, **extra)
+    rng = np.random.default_rng(37)
+    p_prompts = [rng.integers(1, cfg.vocab_size, ISL_P).tolist()
+                 for _ in range(N_P)]
+    d_prompts = [rng.integers(1, cfg.vocab_size, ISL_D).tolist()
+                 for _ in range(N_D)]
+
+    def req(tokens, osl):
+        return PreprocessedRequest(
+            model="m", token_ids=list(tokens),
+            stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+
+    async def one(eng, tokens, osl):
+        t0 = time.perf_counter()
+        ttft, n = None, 0
+        async for out in eng.generate(req(tokens, osl)):
+            if ttft is None and out.token_ids:
+                ttft = time.perf_counter() - t0
+            n += len(out.token_ids)
+        return ttft, n
+
+    async def wave(eng):
+        """Decode-heavy streams first; prefill-heavy prompts arrive once
+        decode is underway — the mixed regime the ragged step targets."""
+        t0 = time.perf_counter()
+        dec = [asyncio.ensure_future(one(eng, p, OSL_D)) for p in d_prompts]
+        for _ in range(20000):
+            if any(s.generated > 0 for s in eng.scheduler.running):
+                break
+            await asyncio.sleep(0.001)
+        pre = [asyncio.ensure_future(one(eng, p, OSL_P)) for p in p_prompts]
+        res = await asyncio.gather(*dec, *pre)
+        return res, time.perf_counter() - t0
+
+    def p95(vals):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(len(vals) * 0.95))]
+
+    async def measure(ragged: bool) -> dict:
+        eng = AsyncJaxEngine(cfg, EngineArgs(**base, ragged_step=ragged))
+        warm = await eng.warmup(seq_lens=[ISL_P + OSL_P, ISL_D + OSL_D],
+                                prefill_batches=[1, N_P])
+        warm_sigs = sum(len(v) for v in warm.values() if isinstance(v, list))
+        out: dict = {"warmup_s": warm["seconds"], "warmup_sigs": warm_sigs}
+        await wave(eng)  # serving-path caches warm (XLA already compiled)
+        for _ in range(reps):
+            res, dt = await wave(eng)
+            tok_s = sum(n for _, n in res) / dt
+            if "tok_s" not in out or tok_s > out["tok_s"]:
+                out["tok_s"] = tok_s
+            # pool TTFT samples across reps (the p95 of one small wave is
+            # its max — see qos_bench)
+            out.setdefault("ttfts", []).extend(
+                t for t, _ in res if t is not None)
+        out["signatures"] = len(eng.compiled_signatures)
+        out["padded_tokens"] = eng.padded_tokens_total
+        out["step_trace"] = eng.step_trace_summary()
+        await eng.close()
+        return out
+
+    r = await measure(True)
+    b = await measure(False)
+    r_p95, b_p95 = p95(r["ttfts"]), p95(b["ttfts"])
+    return {
+        "ragged_workload": (f"pre={N_P}x(ISL={ISL_P},OSL={OSL_P}) "
+                            f"dec={N_D}x(ISL={ISL_D},OSL={OSL_D}) "
+                            f"slots={slots} budget={budget}"),
+        "ragged_tok_s": round(r["tok_s"], 1),
+        "bucketed_tok_s": round(b["tok_s"], 1),
+        "ragged_vs_bucketed_tok_s": round(r["tok_s"] / max(b["tok_s"], 1e-9),
+                                          3),
+        "ragged_ttft_p95_ms": round(r_p95 * 1000, 1),
+        "bucketed_ttft_p95_ms": round(b_p95 * 1000, 1),
+        "ragged_vs_bucketed_ttft_p95": round(r_p95 / max(b_p95, 1e-9), 3),
+        "ragged_warmup_s": r["warmup_s"],
+        "bucketed_warmup_s": b["warmup_s"],
+        "ragged_signatures": r["signatures"],
+        "bucketed_signatures": b["signatures"],
+        "ragged_warmup_signatures": r["warmup_sigs"],
+        "bucketed_warmup_signatures": b["warmup_sigs"],
+        "signature_reduction": round(
+            b["warmup_sigs"] / max(r["warmup_sigs"], 1), 2),
+        "ragged_padded_tokens": r["padded_tokens"],
+        "bucketed_padded_tokens": b["padded_tokens"],
+    }
+
+
 async def autoscale_bench(duration_s: float = 40.0,
                           chaos_spec: str = "stream.send:drop=0.02",
                           chaos_seed: int = 1234) -> dict:
@@ -1196,6 +1323,28 @@ def main():
               and set(out["qos_preempts_by_class"]) <= {"batch"})
         raise SystemExit(0 if ok else 1)
 
+    if "--ragged" in sys.argv:
+        # ragged-vs-bucketed A/B on the mixed prefill+decode workload —
+        # prints one JSON line; exits nonzero when the ragged step loses
+        # its contract (compiled signatures not ≥4× fewer, tok/s
+        # regression past CPU noise, or TTFT p95 materially worse)
+        try:
+            out = asyncio.run(ragged_bench(False))
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"ragged": "failed", "error": repr(e)[:300]}),
+                  flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        ok = (out["signature_reduction"] >= 4.0
+              and out["ragged_vs_bucketed_tok_s"] >= 0.85
+              and out["ragged_vs_bucketed_ttft_p95"] <= 1.25
+              and out["ragged_padded_tokens"]
+              < out["bucketed_padded_tokens"])
+        raise SystemExit(0 if ok else 1)
+
     if "--autoscale" in sys.argv:
         # closed-loop SLA autoscaling proof: a real operator-managed
         # mocker fleet through a full diurnal cycle with chaos on — prints
@@ -1313,16 +1462,16 @@ def _child_main():
     # — perf iteration on one phase shouldn't pay the full suite each time
     phases = {p.strip() for p in
               os.environ.get("DYN_BENCH_PHASES",
-                             "kernel,spec,e2e,chaos,mem,qos,autoscale"
+                             "kernel,spec,e2e,chaos,mem,qos,autoscale,ragged"
                              ).split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
-                        "autoscale"}
+                        "autoscale", "ragged"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
-                         f"chaos, mem, qos, autoscale)")
+                         f"chaos, mem, qos, autoscale, ragged)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -1377,6 +1526,15 @@ def _child_main():
                 kern["qos"] = asyncio.run(qos_bench(on_tpu))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["qos_error"] = repr(e)[:200]
+        if "ragged" in phases:
+            # ragged-vs-bucketed A/B on the mixed prefill+decode workload:
+            # signature counts, warmup time, padded-token waste, and the
+            # tok/s + TTFT contrast on record every round (ISSUE 7
+            # acceptance)
+            try:
+                kern["ragged"] = asyncio.run(ragged_bench(on_tpu))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["ragged_error"] = repr(e)[:200]
         if "autoscale" in phases:
             # closed-loop autoscaling phase: diurnal QoS-mixed cycle over
             # an operator-managed mocker fleet with chaos on — scale

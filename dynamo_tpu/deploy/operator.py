@@ -166,6 +166,14 @@ class ProcessOperator:
         self._registered_pods: dict[str, int] = {}
         #: instance id -> in-flight streams, from worker ForwardPassMetrics
         self._inflight_by_instance: dict[int, int] = {}
+        #: instance ids whose latest stats report warmed_up=False: the
+        #: worker registered but its AOT warmup was skipped (multi-host
+        #: step replication) and no real step has compiled yet — it must
+        #: not count as ready capacity while it pays the compile cliff
+        #: (the 'registered subsumes warm' invariant below does not hold
+        #: for such workers). Self-healing: the flag flips on the worker's
+        #: first served step.
+        self._cold_instances: set = set()
         self._metrics_agg = None  # MetricsAggregator when plane is set
         # drain telemetry (mirrored into status → dynamo_autoscale_drain_seconds)
         self.drain_seconds_total = 0.0
@@ -356,13 +364,32 @@ class ProcessOperator:
 
     def _ready_count(self, svc: ServiceSpec) -> int:
         """Replicas that count toward capacity: alive AND (when gated)
-        registered on the control plane. Engine workers register strictly
-        after AOT warmup, so 'registered' subsumes 'warm' — the planner
-        never counts a replica still paying its compile cliff."""
+        registered on the control plane AND not reporting themselves cold.
+        Engine workers register strictly after AOT warmup, so 'registered'
+        normally subsumes 'warm' — EXCEPT when warmup was skipped
+        (multi-host step replication): those workers publish
+        WorkerStats.warmed_up=False until their first real step compiles,
+        and counting them ready would hand the autoscale loop phantom
+        capacity mid-compile-cliff."""
         alive = self._alive(svc.name)
         if not self._gated(svc):
             return len(alive)
-        return sum(1 for r in alive if r.pod_name in self._registered_pods)
+        n = 0
+        for r in alive:
+            iid = self._registered_pods.get(r.pod_name)
+            if iid is not None and iid not in self._cold_instances:
+                n += 1
+        return n
+
+    def _cold_count(self, svc: ServiceSpec) -> int:
+        """Registered-but-cold replicas (status surface for the skipped-
+        warmup case — dynctl autoscale and the readiness gate both see
+        why ready < alive)."""
+        if not self._gated(svc):
+            return 0
+        return sum(1 for r in self._alive(svc.name)
+                   if self._registered_pods.get(r.pod_name)
+                   in self._cold_instances)
 
     def reconcile_once(self) -> None:
         self._maybe_reload_spec()
@@ -379,6 +406,7 @@ class ProcessOperator:
                     "desired": self._desired(svc),
                     "alive": len(self._alive(name)),
                     "ready": self._ready_count(svc),
+                    "cold": self._cold_count(svc),
                     "draining": len(self._draining.get(name, [])),
                     "restarts": self.restarts[name],
                     "plannerRole": svc.planner_role,
@@ -445,9 +473,13 @@ class ProcessOperator:
             # snapshot(), not .latest: workers publish only while
             # stepping, so an idle replica's final busy report must age
             # out or victim selection drains a genuinely-busy peer first
+            snap = self._metrics_agg.snapshot()
             self._inflight_by_instance = {
                 wid: m.worker_stats.request_active_slots
-                for wid, m in self._metrics_agg.snapshot().items()}
+                for wid, m in snap.items()}
+            self._cold_instances = {
+                wid for wid, m in snap.items()
+                if m.worker_stats.warmed_up is False}
 
     # -- lifecycle ---------------------------------------------------------
 

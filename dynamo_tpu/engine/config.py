@@ -17,6 +17,12 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 
+#: static cap on prefill chunks co-scheduled into one ragged step (the
+#: chunk grid sizes for exactly this many — model.ragged_grid_shape);
+#: extra chunks wait a step
+RAGGED_MAX_CHUNKS = 4
+
+
 @dataclass
 class ModelConfig:
     """Llama-family decoder architecture (covers Llama 2/3, Mistral, Qwen2,
@@ -356,6 +362,20 @@ class EngineArgs:
     kv_cache_memory_fraction: float = 0.6  # of free HBM, when num_blocks is None
     decode_batch_buckets: tuple = ()  # () = powers of two up to max_num_seqs
     prefill_buckets: tuple = ()  # () = powers of two up to max_num_batched_tokens
+    #: ragged step (docs/performance.md): prefill chunks and decode rows of
+    #: a plan ride ONE packed token batch served by the ragged paged-
+    #: attention path (ops/ragged_attention.py) instead of separate
+    #: (chunk-bucket × batch-bucket × table-width-bucket) compiled programs.
+    #: Compiled-signature count collapses to the token buckets below (R and
+    #: W derive statically from T), warmup shrinks to a handful of traces,
+    #: and the scheduler plans a token budget per step instead of grouping
+    #: same-bucket chunks. Falls back to the bucketed path automatically
+    #: for MLA caches, pipeline parallelism, and multi-host step
+    #: replication; False (--no-ragged-step) restores it wholesale.
+    ragged_step: bool = True
+    #: packed-token buckets for the ragged step; () = powers of two from 8
+    #: up to max_num_batched_tokens
+    ragged_token_buckets: tuple = ()
     use_pallas_attention: bool = False  # Pallas paged-attention kernel (TPU only)
     #: decode steps fused into one jitted call when only decode work exists
     #: (amortizes per-dispatch latency; tokens deliver in bursts of this size)
@@ -483,6 +503,12 @@ class EngineArgs:
             if b[-1] < self.max_num_batched_tokens:
                 b.append(self.max_num_batched_tokens)
             self.prefill_buckets = tuple(b)
+        if not self.ragged_token_buckets:
+            cap = max(8, self.max_num_batched_tokens)
+            b = [2**i for i in range(3, cap.bit_length()) if 2**i <= cap]
+            if b[-1] < cap:  # non-power-of-two budget must be covered
+                b.append(cap)
+            self.ragged_token_buckets = tuple(b)
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -499,6 +525,19 @@ class EngineArgs:
             if n <= b:
                 return b
         return self.decode_batch_buckets[-1]
+
+    def bucket_ragged_tokens(self, n: int) -> int:
+        """Packed-token bucket for a ragged step of ``n`` real tokens."""
+        for b in self.ragged_token_buckets:
+            if n <= b:
+                return b
+        return self.ragged_token_buckets[-1]
+
+    def ragged_rows(self, t_bucket: int) -> int:
+        """Row count of the ragged step's metadata arrays — derived
+        STATICALLY from the token bucket (each row holds ≥ 1 token), so the
+        compiled signature is keyed by T alone."""
+        return max(1, min(self.max_num_seqs, t_bucket))
 
     def bucket_table_width(self, max_kv_len: int) -> int:
         """Block-table width bucket (powers of two) for a batch's longest kv."""

@@ -210,10 +210,28 @@ class AsyncJaxEngine:
                 self.kvbm.host.external_used = lambda: self._swap.used
         self.swap_out_blocks = 0
         self.swap_in_blocks = 0
+        #: ragged step (docs/performance.md): mixed prefill+decode in ONE
+        #: packed launch — compiled signatures collapse to the token
+        #: buckets, the scheduler plans a token budget per step, and
+        #: padded dispatch between buckets disappears. Bucketed fns stay
+        #: built as the escape hatch (--no-ragged-step) and for the paths
+        #: ragged doesn't cover yet.
+        ragged_blockers = [r for r, hit in (
+            ("MLA latent cache", cfg.is_mla),
+            ("pipeline parallelism", self._pp > 1),
+            ("multi-host step replication", self._multihost),
+            ("multi-step fused decode", args.multi_step_decode > 1),
+            ("speculative decoding", args.speculative_tokens > 0),
+        ) if hit]
+        self._ragged = args.ragged_step and not ragged_blockers
+        if args.ragged_step and not self._ragged:
+            logger.info("ragged step disabled (%s) — bucketed step path "
+                        "in use", ", ".join(ragged_blockers))
         self.scheduler = Scheduler(
             args, self.pool, on_stored=self._on_stored,
             onboard_cb=self._onboard if self.kvbm is not None else None,
-            swapper=self if self._swap is not None else None)
+            swapper=self if self._swap is not None else None,
+            token_budget=self._ragged)
         if self._pp > 1:
             from dynamo_tpu.parallel.pipeline import make_pp_step_fn
             self.step_fn = make_pp_step_fn(
@@ -227,6 +245,9 @@ class AsyncJaxEngine:
                                "disabled under pp")
             self.multi_fn = None
             self._step_mm_fn = None
+            self.ragged_fn = None
+            self.ragged_dec_fn = None
+            self._ragged_mm_fn = None
             self.verify_fn = None
             self.draft_fn = None
         else:
@@ -242,6 +263,22 @@ class AsyncJaxEngine:
                     replicate_outputs=self._multihost,
                     kv_quant=self._kv_quant)
             self._step_mm_fn = None  # compiled lazily on first mm request
+            self.ragged_fn = None
+            self.ragged_dec_fn = None
+            self._ragged_mm_fn = None  # lazy, like _step_mm_fn
+            if self._ragged:
+                self.ragged_fn = M.make_ragged_step_fn(
+                    cfg, args.block_size, mesh,
+                    use_pallas=args.use_pallas_attention,
+                    replicate_logits=self._multihost,
+                    kv_quant=self._kv_quant)
+                # decode-only variant (no chunk grid): what the pipelined
+                # decode loop dispatches
+                self.ragged_dec_fn = M.make_ragged_step_fn(
+                    cfg, args.block_size, mesh,
+                    use_pallas=args.use_pallas_attention,
+                    replicate_logits=self._multihost,
+                    kv_quant=self._kv_quant, chunks=False)
             self.verify_fn = None
             self.draft_fn = None
             if args.speculative_tokens > 0:
@@ -288,6 +325,22 @@ class AsyncJaxEngine:
         #: jitted full-model forward passes (each reads every weight once
         #: from HBM) — the denominator for roofline/MFU accounting in bench.py
         self.param_reads = 0
+        #: padded-dispatch waste: tokens (and decode batch rows) dispatched
+        #: beyond the plan's REAL work because static shapes bucket up —
+        #: the cost the ragged step eliminates. Exported as
+        #: dynamo_step_padded_tokens_total (engine/main.py); per-step
+        #: values ride the step trace.
+        self.padded_tokens_total = 0
+        #: distinct jitted step signatures dispatched so far (kind + static
+        #: shape tuple) — len() is dynamo_step_compiled_signatures, the
+        #: bucket-lattice-vs-ragged contrast on /metrics
+        self.compiled_signatures: set = set()
+        #: AOT warmup bookkeeping: ``warmup_skipped`` marks a worker whose
+        #: requested warmup could not run (multi-host step replication) —
+        #: surfaced via WorkerStats.warmed_up so the autoscale readiness
+        #: gate does not count a cold worker as warm (docs/autoscaling.md)
+        self.warmup_requested = args.warmup_buckets
+        self.warmup_skipped = False
         #: per-step phase timing ring (kind, n_seqs, n_tokens, wall_ms) —
         #: the profile that located the r4 serving-vs-kernel gap; cheap
         #: enough to keep always-on, dumped by step_trace_summary()
@@ -900,6 +953,17 @@ class AsyncJaxEngine:
             with annotate("dynamo.decode_pipeline"):
                 if await self._run_decode_pipelined(plan.decode):
                     return
+        if self._ragged and not plan.empty:
+            # one packed launch for the whole plan — prefill chunks and
+            # decode rows together (docs/performance.md ragged step)
+            t0 = time.perf_counter()
+            n_tok = sum(w.chunk for w in plan.prefill) + len(plan.decode)
+            with annotate("dynamo.ragged_step"):
+                padded = await self._run_ragged(plan)
+            self.step_trace.append((
+                "ragged", len(plan.prefill) + len(plan.decode), n_tok,
+                (time.perf_counter() - t0) * 1000, padded))
+            return
         if plan.prefill:
             t0 = time.perf_counter()
             with annotate("dynamo.prefill_step"):
@@ -923,15 +987,17 @@ class AsyncJaxEngine:
         total+mean wall — the first thing to read when e2e throughput is
         far below the kernel ceiling."""
         agg: dict[str, list] = {}
-        for kind, n, toks, ms in self.step_trace:
-            a = agg.setdefault(kind, [0, 0, 0, 0.0])
+        for kind, n, toks, ms, *rest in self.step_trace:
+            a = agg.setdefault(kind, [0, 0, 0, 0.0, 0])
             a[0] += 1
             a[1] += n
             a[2] += toks
             a[3] += ms
+            a[4] += rest[0] if rest else 0  # padded tokens (ragged entries)
         return {k: {"steps": a[0], "seqs": a[1], "tokens": a[2],
                     "total_ms": round(a[3], 1),
-                    "mean_ms": round(a[3] / a[0], 1)}
+                    "mean_ms": round(a[3] / a[0], 1),
+                    "padded_tokens": a[4]}
                 for k, a in agg.items()}
 
     # ------------------------------------------------------- bucket warmup
@@ -957,9 +1023,17 @@ class AsyncJaxEngine:
         exactly once.
         """
         if self._multihost:
+            # NOT silent: warmup_skipped feeds WorkerStats.warmed_up, so
+            # the operator's readiness gate (deploy/operator.py) stops
+            # counting this worker as warm until its first real step lands
+            # — a cold multi-host worker must not absorb autoscale traffic
+            # projections while it pays the compile cliff.
             logger.warning("bucket warmup skipped under multi-host (dummy "
-                           "steps are not in the leader's broadcast replay)")
-            return {}
+                           "steps are not in the leader's broadcast "
+                           "replay); worker reports warmed_up=false until "
+                           "its first served step")
+            self.warmup_skipped = True
+            return {"skipped": "multihost"}
         if self.scheduler.has_work:
             # the dummy dispatches run in a worker thread and reassign the
             # donated cache chain; racing a live engine step would hand XLA
@@ -974,6 +1048,50 @@ class AsyncJaxEngine:
         prefill_bs = sorted({args.bucket_batch(max(1, int(b)))
                              for b in (prefill_batches or [1])})
         t_start = time.perf_counter()
+
+        def run_ragged():
+            # the ragged step's whole signature space IS the token-bucket
+            # list: R and W derive statically from T, the table width never
+            # enters the signature (the kernel walks real pages, the XLA
+            # path's while-loop trip count follows real kv length) — so
+            # warmup is a handful of traces instead of the
+            # (chunk × batch × width) lattice, and seq_lens/prefill_batches
+            # have nothing left to choose.
+            import jax.numpy as jnp
+
+            from dynamo_tpu.engine.model import ragged_grid_shape
+
+            report: dict = {"ragged": [], "sample": []}
+            sampled: set = set()
+            for T in args.ragged_token_buckets:
+                R = args.ragged_rows(T)
+                W = args.max_blocks_per_seq
+                C, _ = ragged_grid_shape(T)
+                ints5 = np.zeros((5, T), np.int32)
+                ints5[3] = C
+                rows3 = np.zeros((R, 3), np.int32)
+                rows3[0] = (0, 1, 1)  # one real row attending a NULL slot
+                bt = np.full((R, W), NULL_BLOCK, np.int32)
+                gr = np.zeros((C,), np.int32)
+                # both variants: the mixed step and the pipelined
+                # decode-only step
+                for kind, fn in (("ragged", self.ragged_fn),
+                                 ("ragged_dec", self.ragged_dec_fn)):
+                    logits, self.k_cache, self.v_cache = fn(
+                        self.params, jnp.asarray(ints5), jnp.asarray(rows3),
+                        jnp.asarray(gr), jnp.asarray(bt),
+                        self.k_cache, self.v_cache)
+                    self.compiled_signatures.add((kind, T))
+                    report["ragged"].append((kind, T, R, W))
+                if R not in sampled:
+                    sampled.add(R)
+                    toks, _ = self._sampling.sample_jit(
+                        logits, np.zeros((R,), np.float32),
+                        np.zeros((R,), np.int32), np.ones((R,), np.float32),
+                        self._sampling.make_keys([0] * R, [0] * R))
+                    np.asarray(toks)
+                    report["sample"].append(R)
+            return report
 
         def run_all():
             import jax.numpy as jnp
@@ -990,6 +1108,7 @@ class AsyncJaxEngine:
                 logits, self.k_cache, self.v_cache = self.step_fn(
                     self.params, jnp.asarray(ints3), jnp.asarray(lens_last),
                     jnp.asarray(bt), self.k_cache, self.v_cache)
+                self.compiled_signatures.add(("step", B, S, W))
                 return logits
 
             def warm_sample(logits):
@@ -1039,15 +1158,22 @@ class AsyncJaxEngine:
                             jnp.asarray(floats), jnp.asarray(rand),
                             jnp.asarray(bt), self.k_cache, self.v_cache)
                         np.asarray(toks)
+                        self.compiled_signatures.add(("multi", B, W))
                         report["multi"].append((B, W))
             return report
 
-        report = await asyncio.to_thread(run_all)
+        report = await asyncio.to_thread(
+            run_ragged if self._ragged else run_all)
         report["seconds"] = round(time.perf_counter() - t_start, 2)
-        logger.info(
-            "bucket warmup: %d prefill + %d decode + %d multi signatures "
-            "in %.1fs", len(report["prefill"]), len(report["decode"]),
-            len(report["multi"]), report["seconds"])
+        if self._ragged:
+            logger.info("ragged warmup: %d token-bucket signatures in %.1fs",
+                        len(report["ragged"]), report["seconds"])
+        else:
+            logger.info(
+                "bucket warmup: %d prefill + %d decode + %d multi "
+                "signatures in %.1fs", len(report["prefill"]),
+                len(report["decode"]), len(report["multi"]),
+                report["seconds"])
         return report
 
     # ------------------------------------------------------------- prefill
@@ -1140,6 +1266,8 @@ class AsyncJaxEngine:
             kind, fn = "step_mm", self._get_step_mm_fn()
         else:
             kind, fn = "step", self.step_fn
+        self.compiled_signatures.add((kind, B, S, W))
+        self.padded_tokens_total += B * S - sum(w.chunk for w in works)
         self._broadcast(kind, **operands)
         logits, self.k_cache, self.v_cache = fn(
             self.params,
@@ -1192,6 +1320,147 @@ class AsyncJaxEngine:
         else:
             # no chunk reached its end: logits unused, sync to pace the loop
             await asyncio.to_thread(lambda: logits.block_until_ready())
+
+    # -------------------------------------------------------- ragged step
+
+    def _get_ragged_mm_fn(self):
+        if self._ragged_mm_fn is None:
+            from dynamo_tpu.engine import model as M
+
+            self._ragged_mm_fn = M.make_ragged_step_fn(
+                self.cfg, self.args.block_size, self.mesh,
+                use_pallas=self.args.use_pallas_attention,
+                replicate_logits=self._multihost,
+                kv_quant=self._kv_quant, mm=True)
+        return self._ragged_mm_fn
+
+    async def _run_ragged(self, plan: StepPlan) -> int:
+        """Execute the WHOLE plan — decode rows and prefill chunks — as one
+        packed ragged launch (ops/ragged_attention.py; docs/performance.md).
+
+        Every row's tokens pack consecutively into a [T_bucket] batch with
+        per-row (q_start, q_len, kv_len) metadata; nothing pads to a
+        chunk/batch/width bucket, so the only waste is the tail of the one
+        token bucket (returned, for the step trace / padded-tokens metric).
+        """
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine.model import ragged_grid_shape
+
+        args = self.args
+        bs = args.block_size
+        works = plan.prefill
+        total = len(plan.decode) + sum(w.chunk for w in works)
+        T = args.bucket_ragged_tokens(total)
+        R = args.ragged_rows(T)
+        W = args.max_blocks_per_seq
+        C, S_C = ragged_grid_shape(T)
+        self.param_reads += 1
+        self.padded_tokens_total += T - total
+
+        # ints5: tokens / positions / slot_map / grid_row / grid_col —
+        # grid_row defaults to the dump tile C (decode + padding tokens)
+        ints5 = np.zeros((5, T), np.int32)
+        ints5[3] = C
+        rows3 = np.zeros((R, 3), np.int32)  # q_start/q_len/kv_len; 0 = pad
+        grid_rows = np.zeros((C,), np.int32)
+        bt = np.full((R, W), NULL_BLOCK, np.int32)
+        mm_vec = mm_mask = None
+        #: (seq, samples?) in row order — decode rows first, then chunks
+        rows = [(s, True, None) for s in plan.decode]
+        rows += [(w.seq, w.sample, w) for w in works]
+        t = 0
+        tile = 0
+        for i, (seq, _sample, w) in enumerate(rows):
+            if w is None:  # decode row: one token, the sequence's newest
+                start, chunk = len(seq.tokens) - 1, 1
+            else:
+                start, chunk = w.start, w.chunk
+            end = start + chunk
+            ints5[0, t:t + chunk] = seq.tokens[start:end]
+            ints5[1, t:t + chunk] = np.arange(start, end)
+            for j, pos in enumerate(range(start, end)):
+                ints5[2, t + j] = seq.block_table[pos // bs] * bs + pos % bs
+            if chunk > 1:
+                # chunk grid tiling: ceil(chunk / S_C) tiles of this row
+                # (1-token chunks ride the decode sub-call instead)
+                for off in range(0, chunk, S_C):
+                    width = min(S_C, chunk - off)
+                    grid_rows[tile] = i
+                    ints5[3, t + off:t + off + width] = tile
+                    ints5[4, t + off:t + off + width] = np.arange(width)
+                    tile += 1
+            rows3[i] = (t, chunk, end)
+            n = min(len(seq.block_table), W)
+            bt[i, :n] = seq.block_table[:n]
+            if w is not None:
+                mm = self._mm_arrays(seq, start, end, chunk)
+                if mm is not None:
+                    if mm_vec is None:
+                        mm_vec = np.zeros((T, self.cfg.hidden_size),
+                                          np.float32)
+                        mm_mask = np.zeros((T,), bool)
+                    mm_vec[t:t + chunk] = mm[0][0]
+                    mm_mask[t:t + chunk] = mm[1][0]
+            t += chunk
+        assert tile <= C, f"chunk grid overflow: {tile} > {C}"
+
+        operands = {"ints5": ints5, "rows3": rows3, "grid_rows": grid_rows,
+                    "block_tables": bt}
+        if mm_vec is not None:
+            operands["mm_vec"], operands["mm_mask"] = mm_vec, mm_mask
+            kind, fn = "ragged_mm", self._get_ragged_mm_fn()
+        elif works:
+            kind, fn = "ragged", self.ragged_fn
+        else:
+            # decode-only plan that bypassed the pipelined loop (logprobs,
+            # guided, penalties, swapped/waiting work pending): the
+            # no-chunk-grid variant
+            kind, fn = "ragged_dec", self.ragged_dec_fn
+        self.compiled_signatures.add((kind, T))
+        self._broadcast(kind, **operands)
+        logits, self.k_cache, self.v_cache = fn(
+            self.params,
+            *(self._put_batch(k, v) for k, v in operands.items()),
+            self.k_cache, self.v_cache)
+
+        # commit BEFORE sampling, exactly like the bucketed steps: chunk
+        # progress (and disagg block shipping) must never wait on the
+        # sampler's host round trip
+        for w in works:
+            seq, end = w.seq, w.start + w.chunk
+            self.scheduler.commit_computed(seq, end)
+            if seq.progress_cb is not None:
+                try:
+                    seq.progress_cb(end)
+                except Exception:
+                    logger.exception("prefill progress callback failed; "
+                                     "disabling chunk shipping for %s",
+                                     seq.request_id)
+                    seq.progress_cb = None
+        for s in plan.decode:
+            self.scheduler.commit_computed(s, len(s.tokens))
+
+        sample_rows = [(i, seq) for i, (seq, smp, _w) in enumerate(rows)
+                       if smp]
+        if not sample_rows:
+            # every row was a mid-prompt chunk: logits unused, sync to pace
+            await asyncio.to_thread(lambda: logits.block_until_ready())
+            return T - total
+        idx = [i for i, _ in sample_rows]
+        if idx == list(range(len(rows))):
+            # common case: every row samples — _sample tolerates the
+            # padded R >= len(rows), no gather needed
+            sel = logits
+        else:
+            Bp = args.bucket_batch(len(idx))
+            sel = logits[jnp.asarray(idx + [idx[0]] * (Bp - len(idx)),
+                                     jnp.int32)]
+        seqs = [s for _, s in sample_rows]
+        toks, logps, tops = await self._sample(seqs, sel)
+        for j, (_i, seq) in enumerate(sample_rows):
+            self._deliver(seq, int(toks[j]), float(logps[j]), tops.get(j))
+        return T - total
 
     # -------------------------------------------------------------- decode
 
@@ -1267,6 +1536,7 @@ class AsyncJaxEngine:
             kv_lens[i] = len(s.tokens)
 
         ints = np.stack([last_tokens, positions, kv_lens], axis=1)
+        self.compiled_signatures.add(("draft", B, W))
         self._broadcast("draft", ints=ints, block_tables=bt)
         toks, self.k_cache, self.v_cache = self.draft_fn(
             self.params, self._put_batch("ints", ints),
@@ -1329,6 +1599,8 @@ class AsyncJaxEngine:
             kv_lens[i] = len(s.tokens) + K
 
         ints3 = np.stack([tokens, positions, slot_map], axis=1)
+        self.compiled_signatures.add(("verify", B, S, W))
+        self.padded_tokens_total += (B - len(seqs)) * S
         self._broadcast("verify", ints3=ints3, block_tables=bt,
                         kv_lens=kv_lens)
         ids, lps, self.k_cache, self.v_cache = self.verify_fn(
@@ -1475,6 +1747,8 @@ class AsyncJaxEngine:
 
         ints3 = np.stack([tokens, positions, slot_map], axis=1)
         lens_last = np.stack([kv_lens, last_idx], axis=1)
+        self.compiled_signatures.add(("step", B, 1, W))
+        self.padded_tokens_total += B - len(seqs)
         self._broadcast("step", ints3=ints3, lens_last=lens_last,
                         block_tables=bt)
         self.param_reads += 1
@@ -1540,19 +1814,31 @@ class AsyncJaxEngine:
             # table must cover len+off tokens
             if not self.scheduler._ensure_blocks(s, len(s.tokens) + off):
                 return None
-        B = args.bucket_batch(len(seqs))
-        max_kv = max(len(s.tokens) + off for s in seqs)
-        W = args.bucket_table_width(max_kv)
+        R = None
+        if self._ragged:
+            # ragged layout: decode row i is the single packed token at
+            # flat index i — the feed substitution lands on ints5[0, :n].
+            # Token arrays size to the T bucket, row/sampling/table arrays
+            # to the (statically derived, R <= T) row count — the hot loop
+            # must not memset T-bucket-sized host buffers it never reads.
+            B = args.bucket_ragged_tokens(len(seqs))
+            R = args.ragged_rows(B)
+            W = args.max_blocks_per_seq
+        else:
+            B = args.bucket_batch(len(seqs))
+            max_kv = max(len(s.tokens) + off for s in seqs)
+            W = args.bucket_table_width(max_kv)
 
-        tokens = np.zeros((B, 1), np.int32)
-        positions = np.zeros((B, 1), np.int32)
-        slot_map = np.zeros((B, 1), np.int32)
-        bt = np.full((B, W), NULL_BLOCK, np.int32)
-        kv_lens = np.zeros((B,), np.int32)
-        last_idx = np.zeros((B,), np.int32)
-        temp = np.zeros((B,), np.float32)
-        top_k = np.zeros((B,), np.int32)
-        top_p = np.ones((B,), np.float32)
+        A = R if R is not None else B  # per-row host array size
+        tokens = np.zeros((A, 1), np.int32)
+        positions = np.zeros((A, 1), np.int32)
+        slot_map = np.zeros((A, 1), np.int32)
+        bt = np.full((A, W), NULL_BLOCK, np.int32)
+        kv_lens = np.zeros((A,), np.int32)
+        last_idx = np.zeros((A,), np.int32)
+        temp = np.zeros((A,), np.float32)
+        top_k = np.zeros((A,), np.int32)
+        top_p = np.ones((A,), np.float32)
         seeds, steps = [], []
         for i, s in enumerate(seqs):
             pos = len(s.tokens) - 1 + off
@@ -1571,19 +1857,47 @@ class AsyncJaxEngine:
             # shifts this step's PRNG index by one (identical to what the
             # serial loop would use)
             steps.append(s.step_idx + off)
-        seeds += [0] * (B - len(seqs))
-        steps += [0] * (B - len(seqs))
+        seeds += [0] * (A - len(seqs))
+        steps += [0] * (A - len(seqs))
         keys = self._sampling.make_keys(seeds, steps)
 
-        ints3 = jnp.asarray(np.stack([tokens, positions, slot_map], axis=1))
-        if feed is not None:
-            ints3 = ints3.at[:, 0, 0].set(feed["toks"].astype(jnp.int32))
-        lens_last = np.stack([kv_lens, last_idx], axis=1)
         self.param_reads += 1
-        t0 = time.perf_counter()
-        logits, self.k_cache, self.v_cache = self.step_fn(
-            self.params, ints3, jnp.asarray(lens_last), jnp.asarray(bt),
-            self.k_cache, self.v_cache)
+        if self._ragged:
+            from dynamo_tpu.engine.model import ragged_grid_shape
+
+            C, _ = ragged_grid_shape(B)
+            ints5 = np.zeros((5, B), np.int32)
+            ints5[0, :R] = tokens[:, 0]
+            ints5[1, :R] = positions[:, 0]
+            ints5[2, :R] = slot_map[:, 0]
+            ints5[3] = C  # every token is decode: grid dump tile
+            rows3 = np.zeros((R, 3), np.int32)
+            rows3[:len(seqs), 0] = np.arange(len(seqs))
+            rows3[:len(seqs), 1] = 1
+            rows3[:len(seqs), 2] = kv_lens[:len(seqs)]
+            ints5 = jnp.asarray(ints5)
+            if feed is not None:
+                ints5 = ints5.at[0, :len(seqs)].set(
+                    feed["toks"][:len(seqs)].astype(jnp.int32))
+            self.compiled_signatures.add(("ragged_dec", B))
+            self.padded_tokens_total += B - len(seqs)
+            t0 = time.perf_counter()
+            logits, self.k_cache, self.v_cache = self.ragged_dec_fn(
+                self.params, ints5, jnp.asarray(rows3),
+                jnp.zeros((C,), jnp.int32), jnp.asarray(bt),
+                self.k_cache, self.v_cache)
+        else:
+            ints3 = jnp.asarray(
+                np.stack([tokens, positions, slot_map], axis=1))
+            if feed is not None:
+                ints3 = ints3.at[:, 0, 0].set(feed["toks"].astype(jnp.int32))
+            lens_last = np.stack([kv_lens, last_idx], axis=1)
+            self.compiled_signatures.add(("step", B, 1, W))
+            self.padded_tokens_total += B - len(seqs)
+            t0 = time.perf_counter()
+            logits, self.k_cache, self.v_cache = self.step_fn(
+                self.params, ints3, jnp.asarray(lens_last), jnp.asarray(bt),
+                self.k_cache, self.v_cache)
         toks, logps = self._sampling.sample_jit(logits, temp, top_k, top_p,
                                                 keys)
         # device→host copy in a worker thread: the loop dispatches step N+1
@@ -1703,6 +2017,8 @@ class AsyncJaxEngine:
         ints = np.stack([last_tokens, positions, kv_lens, top_k], axis=1)
         floats = np.stack([temp, top_p], axis=1)
         rand = np.stack([seeds, step0], axis=1)
+        self.compiled_signatures.add(("multi", B, W))
+        self.padded_tokens_total += (B - len(seqs)) * K
         self._broadcast("multi", ints=ints, floats=floats, rand=rand,
                         block_tables=bt)
         self.param_reads += K
@@ -2313,6 +2629,11 @@ class AsyncJaxEngine:
                 num_requests_waiting=sched.num_waiting() + len(sched.swapped),
                 data_parallel_rank=self.dp_rank,
                 moe_dropped_tokens=MOE_DROPS["total"],
+                # cold = warmup was requested but skipped (multi-host) and
+                # no real step has compiled yet; workers that never asked
+                # for warmup report None (legacy semantics: counted warm)
+                warmed_up=(None if not self.warmup_requested
+                           else not self.warmup_skipped or self.steps > 0),
             ),
             kv_stats=KvStats(
                 kv_active_blocks=active,

@@ -135,6 +135,13 @@ async def amain():
                     action="store_false", default=True,
                     help="disable the depth-2 pipelined decode loop "
                          "(overlaps device compute with host commit/emit)")
+    ap.add_argument("--no-ragged-step", dest="ragged_step",
+                    action="store_false", default=True,
+                    help="disable the ragged mixed prefill+decode step "
+                         "(one packed launch per plan, one compiled "
+                         "signature per token bucket) and restore the "
+                         "bucketed per-(chunk,batch,width) step path "
+                         "wholesale (docs/performance.md)")
     ap.add_argument("--no-prefix-caching", action="store_true")
     # choices= fails fast on a typo — an unknown parser name would
     # otherwise silently disable extraction AND buffer all chat streaming
@@ -285,6 +292,7 @@ async def amain():
         quantization=cli.quantization,
         kv_cache_dtype=cli.kv_cache_dtype,
         pipeline_decode=cli.pipeline_decode,
+        ragged_step=cli.ragged_step,
         warmup_buckets=cli.warmup_buckets,
     )
 
@@ -386,6 +394,25 @@ async def amain():
     engine.metrics_cb = WorkerMetricsPublisher(
         runtime.plane, worker_id=lease).publish_sync
 
+    cold_beacon = None
+    if engine.warmup_skipped:
+        # the engine loop publishes ForwardPassMetrics only once steps run,
+        # so a cold worker (multi-host warmup skip) would never get its
+        # warmed_up=False report onto the wire — and a single publish would
+        # age out of the operator's staleness window. Beacon the cold state
+        # until the first real step compiles; the loop's own publishes
+        # (warmed_up=True) take over from there.
+        async def _cold_beacon():
+            while engine.steps == 0 and not engine._closed:
+                try:
+                    engine.metrics_cb(engine._metrics())
+                except Exception:
+                    logging.getLogger("dynamo.engine.main").exception(
+                        "cold-state metrics publish failed")
+                await asyncio.sleep(2.0)
+
+        cold_beacon = asyncio.get_running_loop().create_task(_cold_beacon())
+
     # step-trace phases on the worker's own /metrics (DYN_SYSTEM_PORT):
     # per-kind steps/tokens/mean wall — the first scrape to read when e2e
     # throughput sits far below the kernel ceiling (r4 lesson)
@@ -439,6 +466,26 @@ async def amain():
         "times the engine auto-suspended losing speculative "
         "decode").add_callback(
         lambda: {None: engine.spec_disabled_total})
+
+    # padded-dispatch waste + compiled-signature census (docs/performance.md
+    # ragged section): the bucket-lattice-vs-ragged contrast, readable off
+    # /metrics instead of only from bench output
+    runtime.metrics.counter(
+        "step_padded_tokens_total",
+        "tokens dispatched beyond the plan's real work because static "
+        "shapes bucket up (zero-ish under the ragged step)").add_callback(
+        lambda: {None: engine.padded_tokens_total})
+    runtime.metrics.gauge(
+        "step_compiled_signatures",
+        "distinct jitted step signatures dispatched so far (the compile "
+        "surface warmup must cover)").add_callback(
+        lambda: {None: len(engine.compiled_signatures)})
+    runtime.metrics.gauge(
+        "engine_warmup_skipped",
+        "1 = requested AOT warmup could not run (multi-host step "
+        "replication); the worker reports warmed_up=false until its first "
+        "served step").add_callback(
+        lambda: {None: int(engine.warmup_skipped)})
 
     # multi-tenant QoS telemetry (docs/qos.md): per-(tenant, class) served
     # tokens, queue wait, preemptions from the scheduler's fairness ledger;
@@ -660,6 +707,8 @@ async def amain():
     if profile_task is not None and not profile_task.done():
         profile_task.cancel()  # stop_trace is skipped; partial traces are
         # not written rather than corrupted
+    if cold_beacon is not None and not cold_beacon.done():
+        cold_beacon.cancel()
     if mm_worker is not None:
         await mm_worker.stop()
     if kvbm_worker is not None:

@@ -490,6 +490,178 @@ def _paged_attention(q, k_cache, v_cache, lidx, block_tables, positions,
     return out.reshape(B, S, H, hd).astype(q.dtype)
 
 
+from dynamo_tpu.engine.config import RAGGED_MAX_CHUNKS
+
+#: chunk-grid tile width (tokens per grid row)
+RAGGED_TILE = 32
+
+
+def _paged_attention_seg(q, k_cache, v_cache, lidx, block_tables, positions,
+                         kv_lens, cfg: ModelConfig, block_size: int,
+                         window=None, sinks=None, seg_keys: int = 128):
+    """:func:`_paged_attention` semantics (same masking, windows, sinks,
+    softcap, int8-dequant gather) with the key axis walked in fixed
+    ``seg_keys`` segments by a dynamic-trip ``lax.while_loop`` + online
+    softmax — so the compiled program covers the FULL table width while
+    gather traffic and score flops follow the batch's ACTUAL max kv
+    length. This is what lets the ragged step keep the table width out of
+    its compiled signature without paying full-width gathers every step
+    (measured: ≈ the width-bucketed dense cost; the while adds ~µs).
+
+    Only the ragged path uses it: the online softmax accumulates in a
+    different reduction order than the dense softmax, so the bucketed
+    paths keep their exact historical numerics.
+    """
+    B, S, H, hd = q.shape
+    KV = cfg.num_kv_heads
+    G = H // KV
+    W = block_tables.shape[1]
+    bs = block_size
+    from dynamo_tpu.engine.cache import gather_pages
+
+    spp = max(1, min(W, -(-seg_keys // bs)))
+    SEG = spp * bs
+    nseg = -(-W // spp)
+    # pad the table so every segment slice is in-bounds (NULL-block
+    # columns gather the reserved block 0, masked below)
+    bt = (block_tables if W == nseg * spp
+          else jnp.pad(block_tables, ((0, 0), (0, nseg * spp - W))))
+    max_kv = jnp.max(kv_lens)
+    qg = q.reshape(B, S, KV, G, hd).astype(jnp.float32)
+    if window is None:
+        window = cfg.sliding_window
+    win = None if window is None else jnp.asarray(window)
+    cap = cfg.attn_logit_softcap
+
+    def cond(c):
+        return (c[0] * SEG < max_kv) & (c[0] < nseg)
+
+    def body(c):
+        s, m, l, acc = c
+        pages = jax.lax.dynamic_slice(bt, (0, s * spp), (B, spp))
+        slot_idx = (pages[:, :, None] * bs
+                    + jnp.arange(bs)[None, None, :]).reshape(B, SEG)
+        k = gather_pages(k_cache, lidx, slot_idx).astype(jnp.float32)
+        v = gather_pages(v_cache, lidx, slot_idx).astype(jnp.float32)
+        sc = jnp.einsum("bskgd,btkd->bkgst", qg, k) / np.sqrt(hd)
+        if cap:
+            # Gemma-2 capping BEFORE masking, like _paged_attention
+            sc = jnp.tanh(sc / cap) * cap
+        key_pos = s * SEG + jnp.arange(SEG)
+        mask = (key_pos[None, None, :] <= positions[:, :, None]) & (
+            key_pos[None, None, :] < kv_lens[:, None, None])  # [B, S, SEG]
+        if win is not None:
+            mask = mask & ((win <= 0)
+                           | (key_pos[None, None, :]
+                              > positions[:, :, None] - win))
+        sc = jnp.where(mask[:, None, None, :, :], sc, -1e30)
+        m_new = jnp.maximum(m, sc.max(-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(sc - m_new[..., None])
+        l_new = l * corr + p.sum(-1)
+        acc_new = (acc * corr[..., None]
+                   + jnp.einsum("bkgst,btkd->bkgsd", p, v))
+        return s + 1, m_new, l_new, acc_new
+
+    m0 = jnp.full((B, KV, G, S), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, KV, G, S), jnp.float32)
+    acc0 = jnp.zeros((B, KV, G, S, hd), jnp.float32)
+    _, m, l, acc = jax.lax.while_loop(cond, body, (0, m0, l0, acc0))
+    if sinks is not None:
+        # sink slot joins the denominator with zero value contribution;
+        # fully-masked rows (m still -1e30) come out exactly zero
+        sk = sinks.astype(jnp.float32).reshape(KV, G)[None, :, :, None]
+        m2 = jnp.maximum(m, sk)
+        coef = jnp.exp(m - m2)
+        out = (acc * coef[..., None]) / (
+            l * coef + jnp.exp(sk - m2))[..., None]
+    else:
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd).astype(q.dtype)
+
+
+def ragged_grid_shape(t_bucket: int) -> tuple[int, int]:
+    """(tiles, tile_width) of the chunk grid for a ragged step of
+    ``t_bucket`` packed tokens — STATIC per token bucket. Every chunk
+    splits into ceil(q_len / width) grid rows, so the capacity proof is
+    sum_i ceil(q_i / W) <= (sum q_i) / W + n_chunks <= T // W +
+    RAGGED_MAX_CHUNKS."""
+    width = min(RAGGED_TILE, t_bucket)
+    return t_bucket // width + RAGGED_MAX_CHUNKS, width
+
+
+def _ragged_attention(q, kc, vc, lidx, block_tables, positions, rows3,
+                      grid_row, grid_col, grid_rows,
+                      cfg: ModelConfig, block_size: int,
+                      window=None, sinks=None):
+    """Ragged paged attention, XLA path: ONE packed token batch of mixed
+    prefill chunks and decode rows, decomposed into two calls of
+    :func:`_paged_attention_seg` (same masking/window/sink/softcap/int8
+    semantics as the bucketed ``_paged_attention``, key axis walked by a
+    dynamic-trip segment loop) — the compiled signature depends only on
+    the token bucket (chunk grid and decode row count derive statically
+    from T, the table rides at full width) while gather traffic follows
+    the batch's ACTUAL kv lengths.
+
+    - rows with q_len == 1 (decode steps AND one-token chunk tails) attend
+      as a [R, 1] decode batch through their own row tables;
+    - chunk tokens scatter into a host-tiled [C, RAGGED_TILE] grid (each
+      chunk occupies ceil(q_len/width) grid rows of its own row's table —
+      ``grid_row``/``grid_col`` per token and ``grid_rows`` per tile are
+      host-computed), attend as a bucketed prefill batch, and gather back
+      into packed order. Tokens outside the grid point at dump slots.
+
+    q [T, H, hd]; block_tables [R, W]; positions [T]; rows3 [R, 3]
+    (q_start, q_len, kv_len); grid_rows None = no-chunk variant (the
+    pipelined decode path) — the grid sub-call is skipped entirely.
+    """
+    T, H, hd = q.shape
+    R = rows3.shape[0]
+    q_start, q_len, kv_lens = rows3[:, 0], rows3[:, 1], rows3[:, 2]
+
+    if grid_rows is None:
+        # decode-only variant (the pipelined loop's dispatch): the engine
+        # guarantees the identity layout — token i IS row i's single token
+        # — so the gather/scatter plumbing below is pure overhead here.
+        # Padding rows carry kv_len 0 (fully masked, output zero, never
+        # sampled).
+        dec_out = _paged_attention_seg(
+            q[:R][:, None], kc, vc, lidx, block_tables,
+            positions[:R][:, None], jnp.where(q_len == 1, kv_lens, 0),
+            cfg, block_size, window=window, sinks=sinks)[:, 0]
+        return jnp.pad(dec_out.astype(q.dtype), ((0, T - R), (0, 0), (0, 0)))
+
+    # decode sub-call: one token per row; non-decode rows read the zero
+    # dump token and scatter their (garbage) output back to the dump slot
+    is_dec = q_len == 1
+    dec_idx = jnp.where(is_dec, q_start, T)
+    q_pad = jnp.pad(q, ((0, 1), (0, 0), (0, 0)))
+    pos_pad = jnp.pad(positions, (0, 1))
+    dec_out = _paged_attention_seg(
+        q_pad[dec_idx][:, None], kc, vc, lidx, block_tables,
+        pos_pad[dec_idx][:, None], jnp.where(is_dec, kv_lens, 0),
+        cfg, block_size, window=window, sinks=sinks)[:, 0]  # [R, H, hd]
+    out = jnp.zeros((T + 1, H, hd), q.dtype).at[dec_idx].set(
+        dec_out.astype(q.dtype))[:T]
+
+    if grid_rows is not None:
+        C = grid_rows.shape[0]
+        S_C = min(RAGGED_TILE, T)
+        qg = jnp.zeros((C + 1, S_C, H, hd), q.dtype).at[
+            grid_row, grid_col].set(q)
+        pg = jnp.zeros((C + 1, S_C), positions.dtype).at[
+            grid_row, grid_col].set(positions)
+        g_out = _paged_attention_seg(
+            qg[:C], kc, vc, lidx, block_tables[grid_rows], pg[:C],
+            kv_lens[grid_rows], cfg, block_size, window=window,
+            sinks=sinks)
+        g_pad = jnp.pad(g_out, ((0, 1), (0, 0), (0, 0), (0, 0)))
+        vals = g_pad[grid_row, grid_col]  # [T, H, hd]
+        out = jnp.where((grid_row < C)[:, None, None],
+                        vals.astype(q.dtype), out)
+    return out
+
+
 def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
                    kv_lens, cfg: ModelConfig, block_size: int,
                    use_pallas: bool = False, use_flash: bool = False,
@@ -947,7 +1119,8 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             last_idx, k_cache, v_cache, *, cfg: ModelConfig, block_size: int,
             use_pallas: bool = False, use_flash_prefill: bool = False,
             mesh: Optional[Mesh] = None, all_logits: bool = False,
-            return_hidden: bool = False, mm_vec=None, mm_mask=None):
+            return_hidden: bool = False, mm_vec=None, mm_mask=None,
+            ragged=None):
     """One engine step.
 
     Args:
@@ -959,6 +1132,16 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
       kv_lens:      [B] int32 — total valid kv length incl. this chunk.
       last_idx:     [B] int32 — index in S of each row's last real token.
       k_cache/v_cache: [L, num_slots, KV, hd] — donated, updated in place.
+
+    ``ragged`` switches the step to the PACKED mixed prefill+decode layout
+    (make_ragged_step_fn): tokens/positions/slot_map arrive as [1, T] with
+    every sequence's chunk laid out consecutively, ``ragged`` is
+    ``(rows3 [R, 3], grid_row [T], grid_col [T], grid_rows [C] | None)``,
+    and block_tables/kv_lens/last_idx are
+    per ROW ([R, W] / [R] / [R] flat-token indices) — logits come back
+    [R, V]. Everything outside attention (norms, projections, RoPE, KV
+    scatter, MLP/MoE) runs the exact same code as the bucketed step, so
+    parity holds by construction.
 
     Returns: (logits [B, V] f32 at last_idx, k_cache, v_cache)
     """
@@ -1057,7 +1240,7 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         # (SURVEY §5.7: the engine feature the reference lacks)
         sp_n = mesh.shape.get("sp", 1) if mesh is not None else 1
         tp_n = mesh.shape.get("tp", 1) if mesh is not None else 1
-        ring_want = sp_n > 1 and S > 1
+        ring_want = sp_n > 1 and S > 1 and ragged is None
         ring_ok = (ring_want and dp_ok and S % sp_n == 0
                    and H % tp_n == 0 and KV % tp_n == 0
                    and (H // tp_n) % max(1, KV // tp_n) == 0
@@ -1077,7 +1260,40 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         else:
             window = jnp.asarray(cfg.sliding_window or 0, jnp.int32)
         sinks = lp.get("sink", jnp.zeros((q.shape[2],), q.dtype))
-        if ring_ok:
+        if ragged is not None:
+            rows3, grid_row, grid_col, grid_rows = ragged
+            # Pallas ragged kernel: single-launch mixed prefill+decode over
+            # the flat page view. XLA ragged path covers everything the
+            # kernel doesn't (int8 pages, non-aligned heads, meshes,
+            # Gemma-2 softcap) with identical masking semantics.
+            from dynamo_tpu.ops.ragged_attention import (
+                ragged_paged_attention, ragged_pallas_supported,
+            )
+
+            # lane alignment checked HERE: the kernel's own fallback is the
+            # dense per-token oracle, fine for tests but O(T·W·bs) memory —
+            # non-aligned shapes must take the grid path below instead
+            use_ragged_kernel = (use_pallas and mesh is None and not kv_quant
+                                 and not cfg.attn_logit_softcap
+                                 and ragged_pallas_supported(KV, hd))
+            if use_ragged_kernel:
+                from dynamo_tpu.engine.cache import cache_shape
+
+                L_, slots_, KV_, hd_ = cache_shape(kc)
+                nb = slots_ // block_size
+                flat = L_ * slots_
+                attn = ragged_paged_attention(
+                    q[0], kc.reshape(flat, KV_, hd_),
+                    vc.reshape(flat, KV_, hd_),
+                    block_tables + lidx * nb, rows3,
+                    block_size=block_size, window=window,
+                    sinks=lp.get("sink"))[None]
+            else:
+                attn = _ragged_attention(
+                    q[0], kc, vc, lidx, block_tables, positions[0],
+                    rows3, grid_row, grid_col, grid_rows, cfg, block_size,
+                    window=window, sinks=lp.get("sink"))[None]
+        elif ring_ok:
             from dynamo_tpu.parallel.ring_attention import ring_prefill_paged
 
             # pad the table width to a multiple of sp with NULL-block
@@ -1212,7 +1428,12 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
 
     if all_logits:  # speculative verification reads every position
         return _cap(_mm(x, head).astype(jnp.float32)), k_cache, v_cache
-    x_last = x[jnp.arange(B), last_idx]  # [B, D]
+    if ragged is not None:
+        # per-ROW last-token gather from the packed axis: last_idx holds
+        # flat token indices (q_start + q_len - 1; padding rows clamp to 0)
+        x_last = x[0, last_idx]  # [R, D]
+    else:
+        x_last = x[jnp.arange(B), last_idx]  # [B, D]
     logits = _cap(_mm(x_last, head).astype(jnp.float32))
     return logits, k_cache, v_cache
 
@@ -1523,6 +1744,60 @@ def make_draft_fn(cfg: ModelConfig, block_size: int, draft_layers: int,
         csh = cache_shardings(mesh, cfg, quant=kv_quant)
         kw["out_shardings"] = (rep, csh, csh)
     return jax.jit(f, donate_argnums=(3, 4), **kw)
+
+
+def make_ragged_step_fn(cfg: ModelConfig, block_size: int,
+                        mesh: Optional[Mesh] = None, use_pallas: bool = False,
+                        replicate_logits: bool = False,
+                        kv_quant: bool = False, mm: bool = False,
+                        chunks: bool = True):
+    """Jitted RAGGED engine step: every prefill chunk and decode row of a
+    scheduler plan rides ONE packed token batch — no padding to separate
+    (chunk-bucket × batch-bucket × width-bucket) signatures. The compiled
+    signature depends only on the token bucket T: the row count, chunk-grid
+    shape and table width all derive statically from it (config.ragged_rows,
+    ragged_grid_shape, max_blocks_per_seq), so steady serving compiles one
+    program per token-budget bucket per variant.
+
+    PACKED operand layout: ``ints5`` [5, T] int32 stacks tokens / positions
+    / slot_map / grid_row / grid_col; ``rows3`` [R, 3] int32 stacks per-row
+    (q_start, q_len, kv_len) — q_len = 0 marks a padding row; ``grid_rows``
+    [C] maps each chunk-grid tile to its row. ``chunks=False`` builds the
+    decode-only variant (the pipelined decode loop's dispatch): the chunk
+    grid is skipped entirely and the grid operands are ignored.
+
+    Signature: ``fn(params, ints5, rows3, grid_rows, block_tables [R, W],
+    [mm_vec [T, D], mm_mask [T],] k_cache, v_cache) ->
+    (logits [R, V], k_cache, v_cache)`` (``mm=True`` adds the multimodal
+    override operands, compiled lazily by the engine like make_step_mm_fn).
+    """
+    if cfg.is_mla:
+        raise ValueError("the ragged step does not cover MLA latent caches "
+                         "yet — run with ragged_step=False")
+    decode_pallas, _ = _resolve_kernel_flags(cfg, mesh, use_pallas, False)
+
+    def f(params, ints5, rows3, grid_rows, block_tables, *rest):
+        if mm:
+            mm_vec, mm_mask, k_cache, v_cache = rest
+            mm_vec, mm_mask = mm_vec[None], mm_mask[None]
+        else:
+            k_cache, v_cache = rest
+            mm_vec = mm_mask = None
+        q_start, q_len, kv_lens = rows3[:, 0], rows3[:, 1], rows3[:, 2]
+        last_flat = jnp.clip(q_start + q_len - 1, 0, ints5.shape[1] - 1)
+        return forward(
+            params, ints5[0][None], ints5[1][None], ints5[2][None],
+            block_tables, kv_lens, last_flat, k_cache, v_cache,
+            cfg=cfg, block_size=block_size, use_pallas=decode_pallas,
+            mesh=mesh, mm_vec=mm_vec, mm_mask=mm_mask,
+            ragged=(rows3, ints5[3], ints5[4],
+                    grid_rows if chunks else None))
+
+    kw = {}
+    if replicate_logits and mesh is not None:
+        csh = cache_shardings(mesh, cfg, quant=kv_quant)
+        kw["out_shardings"] = (NamedSharding(mesh, P()), csh, csh)
+    return jax.jit(f, donate_argnums=(7, 8) if mm else (5, 6), **kw)
 
 
 def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,

@@ -32,7 +32,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from dynamo_tpu.engine.cache import BlockPool
-from dynamo_tpu.engine.config import EngineArgs
+from dynamo_tpu.engine.config import RAGGED_MAX_CHUNKS, EngineArgs
 from dynamo_tpu.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.qos import CLASS_RANK, DEFAULT_TENANT, normalize_priority
 from dynamo_tpu.qos.fair import ClassQueues, QosBook
@@ -144,9 +144,22 @@ class Scheduler:
     def __init__(self, args: EngineArgs, pool: BlockPool,
                  on_stored: Optional[Callable] = None,
                  onboard_cb: Optional[Callable] = None,
-                 swapper: Optional[object] = None):
+                 swapper: Optional[object] = None,
+                 token_budget: bool = False):
         self.args = args
         self.pool = pool
+        #: ragged-step planning (docs/performance.md): the step is ONE
+        #: packed launch, so plan() budgets TOKENS (prefill chunks + decode
+        #: rows co-scheduled under max_num_batched_tokens) instead of
+        #: grouping same-bucket chunks. Chunk sizes are free (no
+        #: prefill-bucket clamp — the bucketed path's chunk-clamp
+        #: workaround doesn't apply), padding-cost row checks are moot
+        #: (nothing pads to a bucket), and the QoS decode sit-out collapses
+        #: to plain budget accounting: better-class chunks are admitted
+        #: first (class order), and decode rows cost one token each — they
+        #: never inflate a better-class prefill's padded step shape, so
+        #: there is nothing to shed.
+        self.token_budget = token_budget
         self.on_stored = on_stored  # fn(parent_hash, [StoredBlock], [block_id])
         #: fn(probe: TokenBlockSequence, start_block, end_block) -> [block_id]
         #: — KVBM onboard hook: device-misses found in host/disk tiers come
@@ -262,7 +275,12 @@ class Scheduler:
             else:
                 if not self._preempt_for(s):
                     self._preempt(s)
-        plan.decode = [s for s in ready_decode if s in self.running][:max_b]
+        row_cap = max_b
+        if self.token_budget:
+            # packed step: decode rows spend the shared token budget (one
+            # token each) and must also fit the packed-token bucket cap
+            row_cap = min(max_b, budget)
+        plan.decode = [s for s in ready_decode if s in self.running][:row_cap]
         budget -= len(plan.decode)
 
         if self.args.enable_chunked_prefill or not plan.decode:
@@ -279,9 +297,14 @@ class Scheduler:
             # chunks must fit the LARGEST compiled prefill bucket: with
             # custom buckets coarser than max_num_batched_tokens, an
             # unclamped chunk (e.g. a recompute re-prefill of prompt +
-            # generated tokens) would overflow the padded batch row
-            cap = min(self.args.max_num_batched_tokens,
-                      self.args.prefill_buckets[-1])
+            # generated tokens) would overflow the padded batch row.
+            # Token-budget (ragged) planning has no per-row padding, so the
+            # clamp is simply the step budget.
+            if self.token_budget:
+                cap = self.args.max_num_batched_tokens
+            else:
+                cap = min(self.args.max_num_batched_tokens,
+                          self.args.prefill_buckets[-1])
             for s in prefill_seqs:
                 if s not in self.running:
                     continue  # preempted by an earlier iteration's victim pick
@@ -297,20 +320,33 @@ class Scheduler:
                                  "and chunked prefill is disabled"))
                         s.sink.put_nowait(None)
                     continue  # a shorter seq may still fit this step
-                if chunk <= 0 or len(plan.prefill) >= max_b:
+                prefill_cap = max_b
+                if self.token_budget:
+                    # the ragged step's chunk grid sizes for at most
+                    # RAGGED_MAX_CHUNKS co-scheduled chunks (model.
+                    # ragged_grid_shape capacity proof); later chunks wait
+                    # a step — they were budget-starved anyway
+                    prefill_cap = min(max_b, RAGGED_MAX_CHUNKS)
+                if chunk <= 0 or len(plan.prefill) >= prefill_cap:
                     break
-                bucket = self.args.bucket_tokens(chunk)
-                if s_bucket is None:
-                    s_bucket = bucket
-                elif bucket > s_bucket:
-                    continue  # would inflate every row's padding: next step
-                # padded-cost bound applies only when ADDING rows: the
-                # first chunk always runs even if its bucket exceeds the
-                # budget (custom buckets may be coarser than the budget —
-                # refusing it would wedge the engine forever)
-                if plan.prefill and (len(plan.prefill) + 1) * s_bucket > \
-                        self.args.max_num_batched_tokens:
-                    break
+                if not self.token_budget:
+                    # bucketed step: rows of one jitted call share a token
+                    # bucket, and the PADDED cost B·S_bucket is what the
+                    # budget must bound. The ragged step has neither
+                    # constraint — chunks of any size pack side by side and
+                    # only REAL tokens spend budget.
+                    bucket = self.args.bucket_tokens(chunk)
+                    if s_bucket is None:
+                        s_bucket = bucket
+                    elif bucket > s_bucket:
+                        continue  # would inflate every row's padding
+                    # padded-cost bound applies only when ADDING rows: the
+                    # first chunk always runs even if its bucket exceeds
+                    # the budget (custom buckets may be coarser than the
+                    # budget — refusing it would wedge the engine forever)
+                    if plan.prefill and (len(plan.prefill) + 1) * s_bucket \
+                            > self.args.max_num_batched_tokens:
+                        break
                 protected = plan.decode + [w.seq for w in plan.prefill]
                 if not self._ensure_blocks(s, s.num_computed + chunk):
                     # not enough memory: preempt, but never a seq whose
@@ -325,7 +361,8 @@ class Scheduler:
                     sample=(s.num_computed + chunk == len(s.tokens)),
                 ))
                 budget -= chunk
-        if self.args.qos_scheduling and plan.prefill and plan.decode:
+        if (self.args.qos_scheduling and plan.prefill and plan.decode
+                and not self.token_budget):
             # TTFT protection (docs/qos.md): when this step carries a
             # prefill chunk of a BETTER class, strictly-worse-class decode
             # rows sit the step out — their next token arrives one step

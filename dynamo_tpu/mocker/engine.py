@@ -59,6 +59,15 @@ class MockEngineArgs:
     #: simulated engine-initialization delay before serving (ref:
     #: protocols.rs:98 startup_time)
     startup_time: Optional[float] = None
+    #: token-budget planning (the real engine's ragged-step mode,
+    #: docs/performance.md): decode rows and prefill chunks co-schedule
+    #: under ONE max_num_batched_tokens budget per step — decode rows
+    #: reserve a token each first, prefill fills the remainder — and a
+    #: mixed step costs a SINGLE launch (one base latency, not
+    #: prefill_base + decode_base). Fleet-level tests (autoscale, QoS,
+    #: chaos) therefore exercise the new planning mode without a real
+    #: model; False restores the independent prefill/decode budgets.
+    token_budget_plan: bool = True
 
 
 @dataclass
@@ -283,14 +292,30 @@ class MockEngine:
                 seq.out_queue.put_nowait(LLMEngineOutput(
                     finish_reason=FinishReason.DEADLINE))
                 seq.out_queue.put_nowait(None)
-        prefill_tokens = await self._run_prefill_chunk()
-        decoded = await self._run_decode()
-        # simulated iteration latency
-        ms = 0.0
-        if prefill_tokens:
-            ms += self.args.prefill_base_ms + prefill_tokens * self.args.prefill_per_token_ms
-        if decoded:
-            ms += self.args.decode_base_ms + decoded * self.args.decode_per_seq_ms
+        if self.args.token_budget_plan:
+            # ragged-style step: decode rows spend the shared budget first
+            # (one token each), prefill chunks fill what remains, and the
+            # whole step is ONE launch — one base cost covers both kinds
+            budget = self.args.max_num_batched_tokens
+            decoded = await self._run_decode(
+                max_rows=min(budget, self.args.max_num_seqs))
+            prefill_tokens = await self._run_prefill_chunk(
+                budget=budget - decoded)
+            ms = 0.0
+            if prefill_tokens or decoded:
+                ms = (max(self.args.prefill_base_ms if prefill_tokens else 0.0,
+                          self.args.decode_base_ms if decoded else 0.0)
+                      + prefill_tokens * self.args.prefill_per_token_ms
+                      + decoded * self.args.decode_per_seq_ms)
+        else:
+            prefill_tokens = await self._run_prefill_chunk()
+            decoded = await self._run_decode()
+            # simulated iteration latency: two independent launches
+            ms = 0.0
+            if prefill_tokens:
+                ms += self.args.prefill_base_ms + prefill_tokens * self.args.prefill_per_token_ms
+            if decoded:
+                ms += self.args.decode_base_ms + decoded * self.args.decode_per_seq_ms
         if ms:
             await asyncio.sleep(ms / 1000.0 / self.args.speedup_ratio)
         else:
@@ -311,8 +336,9 @@ class MockEngine:
                 seq.prefill_pos = min(seq.cached_tokens, seq.isl)
             self.running.append(seq)
 
-    async def _run_prefill_chunk(self) -> int:
-        budget = self.args.max_num_batched_tokens
+    async def _run_prefill_chunk(self, budget: Optional[int] = None) -> int:
+        if budget is None:
+            budget = self.args.max_num_batched_tokens
         total = 0
         for seq in self.running:
             if budget <= 0:
@@ -350,11 +376,13 @@ class MockEngine:
             if stored:
                 await self.kv_publisher.publish_stored(parent, stored)
 
-    async def _run_decode(self) -> int:
+    async def _run_decode(self, max_rows: Optional[int] = None) -> int:
         n = 0
         for seq in self.running:
             if seq.in_prefill or seq.finished:
                 continue
+            if max_rows is not None and n >= max_rows:
+                break  # token budget spent: the row waits one step
             if seq.ctx.cancelled:
                 seq.finished = FinishReason.CANCELLED
                 seq.out_queue.put_nowait(LLMEngineOutput.cancelled())

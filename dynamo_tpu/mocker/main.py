@@ -98,6 +98,11 @@ async def amain():
                          "stream + metrics stream per rank)")
     ap.add_argument("--startup-time", type=float, default=None)
     ap.add_argument("--no-prefix-caching", action="store_true")
+    ap.add_argument("--no-token-budget-plan", dest="token_budget_plan",
+                    action="store_false", default=True,
+                    help="restore independent prefill/decode step budgets "
+                         "(the pre-ragged engine timing model) instead of "
+                         "one co-scheduled token budget per step")
     ap.add_argument("--migration-limit", type=int, default=None,
                     help="max stream migrations per request (model card "
                          "migration_limit; raise under chaos/worker churn)")
@@ -124,6 +129,7 @@ async def amain():
         vocab_size=vocab_size,
         dp_size=cli.dp_size,
         startup_time=cli.startup_time,
+        token_budget_plan=cli.token_budget_plan,
     )
     engines, handles = await run_mocker(
         runtime, cli.model, args, cli.namespace, cli.component,

@@ -39,6 +39,12 @@ _NEG = -1e30
 _LANE = 128
 
 
+def _hbm_space(pltpu):
+    """``pltpu.HBM`` where the jax version has it; ``ANY`` (compiler keeps
+    un-blocked operands off VMEM) on versions that predate the alias."""
+    return getattr(pltpu, "HBM", pltpu.ANY)
+
+
 def _decode_kernel(block_tables_ref, kv_lens_ref, window_ref,
                    sbase_ref,  # scalar pf; sbase = scale-table slot base
                    qexp_ref,  # [1, H, KVhd] VMEM
@@ -288,8 +294,8 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
     in_specs = [
         pl.BlockSpec((1, H, KVhd), lambda b, *_: (b, 0, 0)),
         pl.BlockSpec((1, H, 1), lambda b, *_: (0, 0, 0)),
-        pl.BlockSpec(memory_space=pltpu.HBM),
-        pl.BlockSpec(memory_space=pltpu.HBM),
+        pl.BlockSpec(memory_space=_hbm_space(pltpu)),
+        pl.BlockSpec(memory_space=_hbm_space(pltpu)),
     ]
     scratch = [
         pltpu.VMEM((D, bs, KVhd), k_cache.dtype),  # D pages in flight
@@ -310,8 +316,8 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, kv_lens, *,
                 pl.BlockSpec((KV, padded_slots), lambda b, *_: (0, 0))]
             operands += [lane_pack_t(k_scales), lane_pack_t(v_scales)]
         else:
-            in_specs += [pl.BlockSpec(memory_space=pltpu.HBM),
-                         pl.BlockSpec(memory_space=pltpu.HBM)]
+            in_specs += [pl.BlockSpec(memory_space=_hbm_space(pltpu)),
+                         pl.BlockSpec(memory_space=_hbm_space(pltpu))]
             scratch += [pltpu.VMEM((D, bs, KV), jnp.float32),
                         pltpu.VMEM((D, bs, KV), jnp.float32)]
             operands += [k_scales.astype(jnp.float32),
@@ -550,8 +556,8 @@ def mla_paged_decode(q_eff, q_rot, latent_cache, rope_cache, block_tables,
     in_specs = [
         pl.BlockSpec((1, H, R), lambda b, *_: (b, 0, 0)),
         pl.BlockSpec((1, H, PR), lambda b, *_: (b, 0, 0)),
-        pl.BlockSpec(memory_space=pltpu.HBM),
-        pl.BlockSpec(memory_space=pltpu.HBM),
+        pl.BlockSpec(memory_space=_hbm_space(pltpu)),
+        pl.BlockSpec(memory_space=_hbm_space(pltpu)),
     ]
     operands = [latent_cache, rope_cache]
     if quant:

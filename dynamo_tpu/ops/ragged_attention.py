@@ -1,0 +1,299 @@
+"""Pallas TPU ragged paged-attention kernel: mixed prefill+decode, one launch.
+
+One packed token batch serves every sequence in the step — decode rows
+(q_len=1) and prefill chunks (q_len>1) ride the SAME kernel with per-row
+``(q_start, q_len, kv_len)`` metadata, so the engine no longer pads decode
+batches and prefill chunks to separate compiled buckets (the Ragged Paged
+Attention design, PAPERS.md arxiv 2604.15464; the bucket-lattice tax it
+kills is quantified in docs/performance.md).
+
+Contract (one layer; the stacked-cache wiring lives in engine/model.py):
+  q            [T, H, hd]        packed queries, row-major by sequence; a
+                                 row's tokens are consecutive positions
+                                 ending at kv_len-1 (the engine's chunk
+                                 layout), so per-token positions are pure
+                                 index math: pos = kv_len - q_len + j
+  k/v cache    [slots, KV, hd]   flat paged layout (slot = block·bs + off)
+  block_tables [R, W] int32      per ROW (0 = reserved null block)
+  rows3        [R, 3] int32      (q_start, q_len, kv_len) per row; padding
+                                 rows carry q_len = 0 and are skipped
+  → out        [T, H, hd]
+
+TPU mapping: the same flattened [slots, KV·hd] page-DMA machinery as the
+decode kernel in ops/paged_attention.py — pages stream HBM→VMEM once per
+query tile through a D-deep rotating DMA pipeline, scores come from one MXU
+matmul of the block-expanded query tile [TQ·H, KV·hd] (head h carries its q
+only in its own KV segment, so contraction over KV·hd is the per-group
+dot), and an online softmax folds pages as they land. Query tiles DMA from
+HBM at dynamic offsets (q_start is data), so T never enters VMEM whole and
+the compiled signature depends ONLY on (T, R, W) — one program per token
+budget, not per (chunk × batch × width) bucket.
+
+Sliding windows and attention sinks match the decode kernel; int8 KV pages
+take the XLA fallback (engine/model._ragged_attention dequantizes in the
+gather), as do shapes with KV·hd not lane-aligned.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.ops.paged_attention import _LANE, _NEG, _hbm_space
+
+
+def ragged_pallas_supported(num_kv_heads: int, head_dim: int) -> bool:
+    """Same lane-alignment condition as the decode kernel (flattened
+    [slots, KV·hd] DMA view)."""
+    return (num_kv_heads * head_dim) % _LANE == 0
+
+
+def _ragged_kernel(rows3_ref, block_tables_ref, win_ref,  # scalar prefetch
+                   sink_ref,   # [1, H, 1] VMEM (zeros when has_sink=False)
+                   q_ref,      # [Tpad, H·KVhd] HBM (block-expanded, scaled)
+                   kcache_ref, vcache_ref,  # [slots, KVhd] HBM
+                   out_ref,    # [Tpad, H·KVhd] HBM
+                   qbuf, obuf,  # [TQ, H·KVhd] VMEM scratch
+                   kbuf, vbuf,  # [D, bs, KVhd] VMEM scratch
+                   qo_sem, dma_sem,
+                   *, bs: int, tq: int, H: int, has_sink: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = pl.program_id(0)
+    q_start = rows3_ref[r, 0]
+    q_len = rows3_ref[r, 1]
+    kv_len = rows3_ref[r, 2]
+    win = win_ref[0]
+    KVhd = qbuf.shape[-1] // H
+    D = kbuf.shape[0]
+
+    def start_page_dma(w):
+        blk = block_tables_ref[r, w]
+        slot = w % D
+        pltpu.make_async_copy(
+            kcache_ref.at[pl.ds(blk * bs, bs)], kbuf.at[slot],
+            dma_sem.at[slot, 0]).start()
+        pltpu.make_async_copy(
+            vcache_ref.at[pl.ds(blk * bs, bs)], vbuf.at[slot],
+            dma_sem.at[slot, 1]).start()
+
+    def wait_page_dma(w):
+        slot = w % D
+        pltpu.make_async_copy(kbuf.at[slot], kbuf.at[slot],
+                              dma_sem.at[slot, 0]).wait()
+        pltpu.make_async_copy(vbuf.at[slot], vbuf.at[slot],
+                              dma_sem.at[slot, 1]).wait()
+
+    n_tiles = (q_len + tq - 1) // tq
+
+    def tile_body(t, _carry):
+        tok0 = q_start + t * tq
+        # query tile in: the packed array is padded by TQ rows, so the
+        # fixed-size copy can never run off the end
+        pltpu.make_async_copy(q_ref.at[pl.ds(tok0, tq)], qbuf,
+                              qo_sem.at[0]).start()
+        pltpu.make_async_copy(qbuf, qbuf, qo_sem.at[0]).wait()
+
+        # positions of this tile: pos0 .. pos0+tq-1 (chunk tokens occupy
+        # the tail of the kv range — the engine's packing contract)
+        pos0 = kv_len - q_len + t * tq
+        hi_pos = jnp.minimum(pos0 + tq - 1, kv_len - 1)
+        num_pages = jnp.minimum((hi_pos + bs) // bs, (kv_len + bs - 1) // bs)
+        # sliding window: the EARLIEST key any tile position can see is
+        # pos0 - win + 1; pages wholly before it are never fetched
+        first_key = jnp.where(win > 0, jnp.maximum(pos0 - win + 1, 0), 0)
+        start_page = first_key // bs
+
+        prefill_n = jnp.minimum(num_pages, start_page + D)
+        jax.lax.fori_loop(start_page, prefill_n,
+                          lambda w, c: (start_page_dma(w), c)[1], 0)
+
+        # [TQ·H, KVhd] query tile: row j·H+h is token j's block-expanded
+        # query for head h (same MXU trick as the decode kernel)
+        qt = qbuf[...].reshape(tq * H, KVhd).astype(jnp.float32)
+
+        def page_body(w, carry):
+            m, l, acc = carry  # [TQ·H,1] f32 ×2, [TQ·H,KVhd] f32
+            wait_page_dma(w)
+            kpage = kbuf[w % D].astype(jnp.float32)  # [bs, KVhd]
+            vpage = vbuf[w % D].astype(jnp.float32)
+
+            s = jax.lax.dot_general(
+                qt, kpage, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [TQ·H, bs]
+
+            rows = jax.lax.broadcasted_iota(jnp.int32, (tq * H, bs), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (tq * H, bs), 1)
+            q_pos = pos0 + rows // H
+            key_pos = w * bs + cols
+            mask = (key_pos <= q_pos) & (key_pos < kv_len)
+            mask = mask & ((win <= 0) | (key_pos > q_pos - win))
+            s = jnp.where(mask, s, _NEG)
+
+            chunk_max = jnp.max(s, axis=1, keepdims=True)
+            new_m = jnp.maximum(m, chunk_max)
+            corr = jnp.exp(m - new_m)
+            p = jnp.exp(s - new_m)
+            new_l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, vpage, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [TQ·H, KVhd]
+
+            @pl.when(w + D < num_pages)
+            def _():
+                start_page_dma(w + D)
+
+            return new_m, new_l, acc * corr + pv
+
+        if has_sink:
+            # sink slot: seeds the online softmax, contributes no value
+            sk = sink_ref[0].astype(jnp.float32)  # [H, 1]
+            m0 = jnp.broadcast_to(sk[None], (tq, H, 1)).reshape(tq * H, 1)
+            l0 = jnp.ones((tq * H, 1), jnp.float32)
+        else:
+            m0 = jnp.full((tq * H, 1), _NEG, jnp.float32)
+            l0 = jnp.zeros((tq * H, 1), jnp.float32)
+        acc0 = jnp.zeros((tq * H, KVhd), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(start_page, num_pages, page_body,
+                                      (m0, l0, acc0))
+
+        obuf[...] = (acc / jnp.maximum(l, 1e-30)).reshape(
+            tq, H * KVhd).astype(obuf.dtype)
+        # tile out: overruns past q_len land in the NEXT row's region,
+        # which that row's own (later, sequential) grid step overwrites;
+        # the last row's overrun lands in the TQ-row output padding
+        pltpu.make_async_copy(obuf, out_ref.at[pl.ds(tok0, tq)],
+                              qo_sem.at[1]).start()
+        pltpu.make_async_copy(obuf, obuf, qo_sem.at[1]).wait()
+        return 0
+
+    @pl.when(q_len > 0)
+    def _():
+        jax.lax.fori_loop(0, n_tiles, tile_body, 0)
+
+
+def ragged_paged_attention(q, k_cache, v_cache, block_tables, rows3, *,
+                           block_size: int, interpret: bool = False,
+                           window=None, sinks=None, tq: int = 8):
+    """Ragged paged attention over a packed token batch. See module
+    docstring for the contract. Falls back to :func:`ragged_attention_xla`
+    when KV·hd is not lane-aligned."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, H, hd = q.shape
+    slots, KV, _ = k_cache.shape
+    G = H // KV
+    KVhd = KV * hd
+    bs = block_size
+    if not ragged_pallas_supported(KV, hd):
+        return ragged_attention_xla(
+            q, k_cache, v_cache, block_tables, rows3, block_size=bs,
+            window=window, sinks=sinks)
+    interpret = interpret or jax.default_backend() != "tpu"
+    R, W = block_tables.shape
+    has_sink = sinks is not None
+    win_arr = jnp.asarray([0 if window is None else window],
+                          jnp.int32).reshape(1)
+    sink_in = (jnp.zeros((1, H, 1), q.dtype) if not has_sink
+               else sinks.reshape(1, H, 1).astype(q.dtype))
+
+    # block-expand q (head h's vector in its own KV segment) + fold the
+    # softmax scale; pad by one tile so fixed-size tile DMAs never overrun
+    seg = jnp.arange(H) // G
+    onehot = jax.nn.one_hot(seg, KV, dtype=q.dtype)
+    qexp = jnp.einsum("thd,hk->thkd", q, onehot).reshape(T, H * KVhd)
+    qexp = qexp * jnp.asarray(1.0 / np.sqrt(hd), q.dtype)
+    qexp = jnp.pad(qexp, ((0, tq), (0, 0)))
+
+    D = min(W, 8)  # page-pipeline depth (VMEM: 2·D·bs·KVhd·dtype bytes)
+    kernel = functools.partial(_ragged_kernel, bs=bs, tq=tq, H=H,
+                               has_sink=has_sink)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(R,),
+        in_specs=[
+            pl.BlockSpec((1, H, 1), lambda r, *_: (0, 0, 0)),
+            pl.BlockSpec(memory_space=_hbm_space(pltpu)),  # qexp
+            pl.BlockSpec(memory_space=_hbm_space(pltpu)),  # k pages
+            pl.BlockSpec(memory_space=_hbm_space(pltpu)),  # v pages
+        ],
+        out_specs=pl.BlockSpec(memory_space=_hbm_space(pltpu)),
+        scratch_shapes=[
+            pltpu.VMEM((tq, H * KVhd), q.dtype),       # qbuf
+            pltpu.VMEM((tq, H * KVhd), q.dtype),       # obuf
+            pltpu.VMEM((D, bs, KVhd), k_cache.dtype),  # kbuf
+            pltpu.VMEM((D, bs, KVhd), v_cache.dtype),  # vbuf
+            pltpu.SemaphoreType.DMA((2,)),             # q-in / out tiles
+            pltpu.SemaphoreType.DMA((D, 2)),           # page pipeline
+        ],
+    )
+    out_full = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T + tq, H * KVhd), q.dtype),
+        interpret=interpret,
+    )(rows3.astype(jnp.int32), block_tables.astype(jnp.int32), win_arr,
+      sink_in, qexp, k_cache.reshape(slots, KVhd),
+      v_cache.reshape(slots, KVhd))
+
+    # pick each head's own KV segment back out of the expanded domain
+    out_full = out_full[:T].reshape(T, H, KV, hd)
+    return jnp.take_along_axis(
+        out_full, seg[None, :, None, None], axis=2).reshape(T, H, hd)
+
+
+def ragged_attention_xla(q, k_cache, v_cache, block_tables, rows3, *,
+                         block_size: int, window=None, sinks=None):
+    """Reference/fallback path: per-token dense gather through XLA, same
+    masking semantics as the kernel — the oracle the kernel tests pin, and
+    the path non-lane-aligned shapes take."""
+    T, H, hd = q.shape
+    KV = k_cache.shape[1]
+    G = H // KV
+    R, W = block_tables.shape
+    bs = block_size
+    Tk = W * bs
+
+    q_start = rows3[:, 0]
+    q_len = rows3[:, 1]
+    kv_len = rows3[:, 2]
+    # token → row membership from the contiguous packing. Padding rows
+    # (q_len == 0) carry zero q_start/q_len, which would break
+    # searchsorted's sorted-input precondition — push their end markers
+    # past every real token so the search only ever lands real rows (or
+    # the first padding row, for padding tokens; its kv_len 0 masks all).
+    ends = jnp.where(q_len > 0, q_start + q_len, jnp.int32(1 << 30))
+    tok = jnp.arange(T)
+    row_ids = jnp.clip(
+        jnp.searchsorted(ends, tok, side="right"), 0, R - 1)
+    positions = kv_len[row_ids] - (q_start + q_len)[row_ids] + tok
+
+    slot_idx = (block_tables[:, :, None] * bs
+                + jnp.arange(bs)[None, None, :]).reshape(R, Tk)
+    k = k_cache[slot_idx][row_ids].astype(jnp.float32)  # [T, Tk, KV, hd]
+    v = v_cache[slot_idx][row_ids].astype(jnp.float32)
+
+    qg = q.reshape(T, KV, G, hd).astype(jnp.float32)
+    s = jnp.einsum("tkgd,tskd->tkgs", qg, k) / np.sqrt(hd)
+    key_pos = jnp.arange(Tk)
+    mask = (key_pos[None, :] <= positions[:, None]) & (
+        key_pos[None, :] < kv_len[row_ids][:, None])
+    if window is not None:
+        win = jnp.asarray(window)
+        mask = mask & ((win <= 0)
+                       | (key_pos[None, :] > positions[:, None] - win))
+    s = jnp.where(mask[:, None, None, :], s, _NEG)
+    if sinks is not None:
+        sk = sinks.astype(jnp.float32).reshape(KV, G)[None, :, :, None]
+        m = jnp.maximum(s.max(-1), sk[..., 0])[..., None]
+        e = jnp.exp(s - m)
+        p = e / (e.sum(-1, keepdims=True) + jnp.exp(sk - m))
+    else:
+        p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("tkgs,tskd->tkgd", p, v)
+    return o.reshape(T, H, hd).astype(q.dtype)

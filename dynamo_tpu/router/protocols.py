@@ -12,7 +12,7 @@ kv_router.rs:95-131). Hashes:
 
 from __future__ import annotations
 
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass, field, fields
 from typing import Optional
 
 #: durable stream carrying RouterEvents (ref: kv_router.rs:59 "kv_events")
@@ -106,6 +106,11 @@ class WorkerStats:
     #: cumulative MoE token-expert assignments dropped at EP capacity
     #: (model.MOE_DROPS) — nonzero means routing skew is changing numerics
     moe_dropped_tokens: int = 0
+    #: AOT-warmup state: False = warmup was requested but could not run
+    #: (multi-host step replication skips it) and no real step has landed
+    #: yet — the operator's readiness gate treats such a worker as cold
+    #: (deploy/operator.py). None = unknown/legacy publisher (counts warm).
+    warmed_up: Optional[bool] = None
 
 
 @dataclass
@@ -133,16 +138,29 @@ class ForwardPassMetrics:
     spec_decode_stats: Optional[SpecDecodeStats] = None
 
     def to_wire(self) -> dict:
-        d = {"worker_stats": asdict(self.worker_stats), "kv_stats": asdict(self.kv_stats)}
+        ws = asdict(self.worker_stats)
+        if ws.get("warmed_up") is None:
+            # same interop discipline as the QoS wire fields (PR 5): the
+            # new field rides only when set, so peers that predate it
+            # never see an unknown key unless the feature is in use
+            ws.pop("warmed_up", None)
+        d = {"worker_stats": ws, "kv_stats": asdict(self.kv_stats)}
         if self.spec_decode_stats:
             d["spec_decode_stats"] = asdict(self.spec_decode_stats)
         return d
 
     @staticmethod
     def from_wire(d: dict) -> "ForwardPassMetrics":
+        def known(cls, payload):
+            # drop unrecognized keys: a NEWER peer's extra stats fields
+            # must not crash an older receiver (forward wire compat)
+            names = {f.name for f in fields(cls)}
+            return {k: v for k, v in (payload or {}).items() if k in names}
+
         return ForwardPassMetrics(
-            worker_stats=WorkerStats(**(d.get("worker_stats") or {})),
-            kv_stats=KvStats(**(d.get("kv_stats") or {})),
+            worker_stats=WorkerStats(**known(WorkerStats,
+                                             d.get("worker_stats"))),
+            kv_stats=KvStats(**known(KvStats, d.get("kv_stats"))),
             spec_decode_stats=(
                 SpecDecodeStats(**d["spec_decode_stats"]) if d.get("spec_decode_stats") else None
             ),

@@ -144,16 +144,22 @@ async def test_step_exception_fails_all_inflight_then_recovers():
     in-flight sequence (no hung consumers, no unretrieved task errors) and
     leave the engine loop serving subsequent requests."""
     eng = tiny_engine()
-    real = eng.step_fn
     calls = {"n": 0}
 
-    def boom(*a):
-        calls["n"] += 1
-        if calls["n"] == 4:  # past prefill + first pipelined dispatches
-            raise RuntimeError("injected step failure")
-        return real(*a)
+    def wrap(real):
+        def boom(*a):
+            calls["n"] += 1
+            if calls["n"] == 4:  # past prefill + first pipelined dispatches
+                raise RuntimeError("injected step failure")
+            return real(*a)
+        return boom
 
-    eng.step_fn = boom
+    # wrap every step entry point: the ragged engine dispatches through
+    # ragged_fn/ragged_dec_fn, the bucketed fallback through step_fn
+    eng.step_fn = wrap(eng.step_fn)
+    if eng.ragged_fn is not None:
+        eng.ragged_fn = wrap(eng.ragged_fn)
+        eng.ragged_dec_fn = wrap(eng.ragged_dec_fn)
     results = await asyncio.gather(
         collect(eng, req(range(1, 12), max_tokens=50)),
         collect(eng, req(range(20, 33), max_tokens=50)))
@@ -215,7 +221,9 @@ async def test_warmup_compiles_each_bucket_exactly_once():
     """The AOT warmup pass dispatches exactly one dummy step per configured
     bucket signature, and a real request inside the warmed envelope adds NO
     new step signature (its compiles were all paid up front)."""
-    eng = tiny_engine()
+    # the BUCKETED warmup contract (--no-ragged-step); the ragged warmup's
+    # token-bucket contract is pinned in tests/test_ragged.py
+    eng = tiny_engine(ragged_step=False)
     sigs = []
     real = eng.step_fn
 
