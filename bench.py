@@ -813,6 +813,33 @@ async def qos_bench(on_tpu: bool = False, reps: int = 4) -> dict:
     }
 
 
+async def disagg_bench() -> dict:
+    """``bench.py`` ``disagg`` phase: the network-aware disaggregation
+    A/Bs (ISSUE 9 acceptance; docs/disagg.md).
+
+    1. **Placement**: topology-costed KV routing vs topology-blind over a
+       multi-worker in-process fleet (2 prefill + 4 decode, half the
+       decode pool a far pod away across an emulated slow link) — same
+       workload, same seed. Gate: blind foreground TTFT p95 must be
+       ≥ 1.2x the topology-aware arm's (measured ~3.4x on tiny-cpu).
+    2. **Layer interleave**: layer-split vs whole-bundle tail transfer on
+       one pair, paired per-rep against a free-wire baseline. Gate: the
+       split's transfer-exposed TTFT gap must not exceed the whole-bundle
+       gap (measured ~0.6x on tiny-cpu).
+    """
+    from benchmarks.disagg_ab import fleet_ab, layer_ab
+
+    fleet = await fleet_ab(prefill_workers=2, decode_workers=4, fg=12,
+                           seed=0)
+    layer = await layer_ab(reps=6)
+    placement_ratio = fleet.get("ttft_p95_ratio_blind_over_topo") or 0.0
+    gap_ratio = layer.get("gap_ratio_split_over_whole")
+    ok = placement_ratio >= 1.2 and (gap_ratio is None or gap_ratio <= 1.0)
+    return {"fleet": fleet, "layer": layer,
+            "placement_ratio": placement_ratio,
+            "layer_gap_ratio": gap_ratio, "disagg_ok": ok}
+
+
 async def ragged_bench(on_tpu: bool = False, reps: int = 3) -> dict:
     """``bench.py --ragged``: ragged vs bucketed A/B on a MIXED
     prefill+decode workload (ISSUE 7 acceptance).
@@ -1345,6 +1372,24 @@ def main():
               < out["bucketed_padded_tokens"])
         raise SystemExit(0 if ok else 1)
 
+    if "--disagg" in sys.argv:
+        # network-aware disagg A/Bs: topology-costed placement vs blind +
+        # layer-interleaved vs whole-bundle tail — prints one JSON line;
+        # exits nonzero when placement stops beating blind by the margin
+        # or the layer split regresses the transfer-exposed gap
+        # (docs/disagg.md)
+        try:
+            out = asyncio.run(disagg_bench())
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"disagg": "failed", "error": repr(e)[:300]}),
+                  flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["disagg_ok"] else 1)
+
     if "--autoscale" in sys.argv:
         # closed-loop SLA autoscaling proof: a real operator-managed
         # mocker fleet through a full diurnal cycle with chaos on — prints
@@ -1462,16 +1507,16 @@ def _child_main():
     # — perf iteration on one phase shouldn't pay the full suite each time
     phases = {p.strip() for p in
               os.environ.get("DYN_BENCH_PHASES",
-                             "kernel,spec,e2e,chaos,mem,qos,autoscale,ragged"
-                             ).split(",")
+                             "kernel,spec,e2e,chaos,mem,qos,autoscale,"
+                             "ragged,disagg").split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
-                        "autoscale", "ragged"}
+                        "autoscale", "ragged", "disagg"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
-                         f"chaos, mem, qos, autoscale, ragged)")
+                         f"chaos, mem, qos, autoscale, ragged, disagg)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -1535,6 +1580,14 @@ def _child_main():
                 kern["ragged"] = asyncio.run(ragged_bench(on_tpu))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["ragged_error"] = repr(e)[:200]
+        if "disagg" in phases:
+            # network-aware disagg phase: topology-costed placement vs
+            # blind + layer-interleaved vs whole-bundle tail transfer —
+            # the A/B margins on record every round (ISSUE 9 acceptance)
+            try:
+                kern["disagg"] = asyncio.run(disagg_bench())
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["disagg_error"] = repr(e)[:200]
         if "autoscale" in phases:
             # closed-loop autoscaling phase: diurnal QoS-mixed cycle over
             # an operator-managed mocker fleet with chaos on — scale

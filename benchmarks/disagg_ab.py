@@ -1,4 +1,17 @@
-"""Agg vs disagg A/B at long ISL — the TTFT-interference experiment.
+"""Agg vs disagg A/B at long ISL — the TTFT-interference experiment —
+plus the network-aware fleet scenarios (docs/disagg.md):
+
+- ``--prefill-workers N --decode-workers M`` builds an in-process fleet
+  with SYNTHETIC topology labels (prefill pool in pod p0/slice s0; decode
+  workers half near, half in a far pod) and an emulated per-link bandwidth
+  (router/topology.TopologyCostModel.seconds applied per frame). The A/B:
+  topology-costed routing (scheduler link_costs term) vs topology-blind,
+  same workload, same seed — foreground TTFT p95 is the placement signal.
+- ``--layer-ab`` compares layer-interleaved tail streaming
+  (kv_transfer_layer_groups) against whole-bundle tails on one
+  prefill→decode pair over the same emulated link: the measured
+  ``tail exposure`` (first decode token wall − prefill-complete wall at
+  the producer) is the transfer-serialized gap the split shrinks.
 
 VERDICT r4 #4: e2e TTFT p95 ≫ p50 and PERF_NOTES blames prefill/decode
 interference, but nothing measured it. This harness does the A/B the
@@ -152,6 +165,344 @@ async def run_arm(cfg, args, *, disagg: bool, isl: int, osl: int, bg: int,
     }
 
 
+# ------------------------------------------------------- fleet scenarios
+
+_DONE = object()
+
+
+def _frame_bytes(frame: dict) -> int:
+    """Wire size of a disagg frame for link emulation (page frames carry
+    their raw bytes; descriptors/responses are control-path sized)."""
+    d = frame.get("kv_chunk") or frame.get("kv_layer")
+    if d is not None:
+        return len(d["k"]) + len(d["v"])
+    kv = frame.get("kv")
+    if isinstance(kv, dict):  # whole-bundle tail inside PrefillResponse
+        return len(kv["k"]) + len(kv["v"])
+    return 256
+
+
+class EmulatedPrefillClient:
+    """In-process prefill pool with an emulated network.
+
+    Frames flow through a bounded queue pump (the response-plane analog —
+    the producer stages ahead while the consumer is busy) and each frame is
+    charged the wire time of the (prefill, decode) link class via
+    ``TopologyCostModel.seconds``. The topology IS the emulation; the
+    placement policy under test decides who pays which link.
+    """
+
+    def __init__(self, handlers, labels, my_labels, model, record=None):
+        self.handlers = handlers          # instance_id -> PrefillWorkerHandler
+        self.labels = labels              # instance_id -> TopologyLabels
+        self.my = my_labels
+        self.model = model
+        self.record = record              # optional (t_produced, frame) sink
+        self._rr = 0
+
+    def available_ids(self):
+        return sorted(self.handlers)
+
+    def instances(self):
+        from types import SimpleNamespace
+
+        return [SimpleNamespace(instance_id=i,
+                                metadata={"topo": self.labels[i].to_metadata()})
+                for i in sorted(self.handlers)]
+
+    async def generate(self, request, ctx=None, mode="round_robin",
+                       instance_id=None):
+        import time as _time
+
+        ids = self.available_ids()
+        if mode == "direct" and instance_id is not None:
+            pid = instance_id
+        else:
+            self._rr += 1
+            pid = ids[self._rr % len(ids)]
+        from dynamo_tpu.router.topology import link_class
+
+        link = link_class(self.labels[pid], self.my)
+        ph = self.handlers[pid]
+        q: asyncio.Queue = asyncio.Queue(maxsize=8)
+
+        async def pump():
+            try:
+                async for frame in ph.generate(request, None):
+                    await q.put((_time.perf_counter(), frame))
+            finally:
+                await q.put((0.0, _DONE))
+
+        task = asyncio.get_running_loop().create_task(pump())
+        model, rec = self.model, self.record
+
+        async def stream():
+            # absolute link clock: frame f starts transferring when the
+            # link frees up (or when produced, whichever is later) and is
+            # DELIVERED wire-time later; the consumer only sleeps if that
+            # instant has not already passed. A frame's wire time thus
+            # elapses WHILE the consumer scatters earlier frames — what a
+            # real NIC does, and exactly the overlap layer-interleaving
+            # exists to exploit.
+            link_free = 0.0
+            try:
+                while True:
+                    t_prod, frame = await q.get()
+                    if frame is _DONE:
+                        return
+                    start = max(link_free, t_prod)
+                    deliver = start + model.seconds(link,
+                                                    _frame_bytes(frame))
+                    link_free = deliver
+                    wait = deliver - _time.perf_counter()
+                    if wait > 0:
+                        await asyncio.sleep(wait)
+                    if rec is not None:
+                        rec(t_prod, frame)
+                    yield frame
+            finally:
+                task.cancel()
+
+        return stream()
+
+
+async def fleet_ab(prefill_workers: int = 2, decode_workers: int = 4,
+                   isl: int = 96, osl: int = 8, fg: int = 12,
+                   seed: int = 0, gbps=None):
+    """Topology-aware vs topology-blind decode placement at fleet scale.
+
+    Builds P prefill + M decode engines in one process. The prefill pool
+    lives in pod ``p0``/slice ``s0``; decode workers alternate near
+    (same slice) and far (pod ``p1`` — the host-staged link class). Both
+    arms run the same foreground workload over the same emulated links,
+    differing ONLY in whether the router's cost function sees link costs.
+    Returns TTFT stats per arm + the placement split.
+    """
+    import random as _random
+
+    from dynamo_tpu.disagg.handlers import (
+        DecodeWorkerHandler, DisaggConfig, PrefillWorkerHandler,
+    )
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.perf import record_stream, summarize
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.router.indexer import OverlapScores
+    from dynamo_tpu.router.protocols import KvRouterConfig
+    from dynamo_tpu.router.scheduler import KvScheduler
+    from dynamo_tpu.router.topology import (
+        TopologyCostModel, TopologyLabels, link_costs,
+    )
+
+    # emulation-scaled bandwidths (not real-link values): the tiny-cpu KV
+    # payload is ~50 KB, so links are slowed until the near/far delta
+    # dominates scheduler noise while keeping the 25x ici:host ratio of
+    # the real default table
+    model = TopologyCostModel(gbps or {"proc": 0.2, "ici": 0.05,
+                                       "dcn": 0.01, "host": 0.002})
+    cfg = ModelConfig.tiny()
+    args = EngineArgs(block_size=4, num_blocks=256, max_num_seqs=16,
+                      max_num_batched_tokens=64, max_model_len=isl + 64,
+                      kv_transfer_direct=False,  # force the emulated wire
+                      prefill_buckets=(32, 64), decode_batch_buckets=(2, 4))
+
+    pre_handlers, pre_labels = {}, {}
+    pres = []
+    for i in range(prefill_workers):
+        eng = AsyncJaxEngine(cfg, args)
+        pres.append(eng)
+        pre_handlers[7000 + i] = PrefillWorkerHandler(eng)
+        pre_labels[7000 + i] = TopologyLabels(
+            host=f"ph{i}", slice_id="s0", pod="p0")
+
+    decode = []  # (wid, engine, handler, labels)
+    for j in range(decode_workers):
+        near = j % 2 == 0
+        labels = (TopologyLabels(host=f"dh{j}", slice_id="s0", pod="p0")
+                  if near else
+                  TopologyLabels(host=f"dh{j}", slice_id=f"s9{j}", pod="p1"))
+        eng = AsyncJaxEngine(cfg, args)
+        dh = DecodeWorkerHandler(
+            eng, EmulatedPrefillClient(pre_handlers, pre_labels, labels,
+                                       model),
+            DisaggConfig(max_local_prefill_length=16))
+        decode.append((8000 + j, eng, dh, labels))
+
+    def req(tokens, max_tokens):
+        return PreprocessedRequest(
+            model="b", token_ids=tokens,
+            stop_conditions=StopConditions(max_tokens=max_tokens,
+                                           ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+
+    # warm every engine's compile set through its own serving path (the
+    # warm prompt also pays the emulated wire once, which is fine — it is
+    # outside the measured window)
+    for _, _eng, dh, _ in decode:
+        async for _ in dh.generate(req(list(range(2, isl + 2)), 2).to_wire(),
+                                   None):
+            pass
+
+    worker_ids = [w for w, *_ in decode]
+    wl = {w: labels for w, _, _, labels in decode}
+    sources = list(pre_labels.values())
+    arms = {}
+    for arm in ("blind", "topo"):
+        sched = KvScheduler(args.block_size, KvRouterConfig(),
+                            rng=_random.Random(seed))
+        costs = (link_costs(sources, wl, model) if arm == "topo" else None)
+        by_worker = {w: 0 for w in worker_ids}
+        recs = []
+        base = 200 if arm == "topo" else 500  # disjoint prompt spaces
+        for i in range(fg):
+            prompt = [(base + 7 * i + j) % 997 + 2 for j in range(isl)]
+            rid = f"{arm}-{i}"
+            d = sched.schedule(rid, isl_tokens=isl, seq_hashes=None,
+                               overlaps=OverlapScores(),
+                               worker_ids=worker_ids, link_costs=costs)
+            by_worker[d.worker_id] += 1
+            dh = next(h for w, _, h, _ in decode if w == d.worker_id)
+            rec = record_stream(dh.generate(req(prompt, osl).to_wire(), None),
+                                request_id=rid)
+            async for _ in rec:
+                pass
+            sched.mark_prefill_completed(rid)
+            sched.free(rid)
+            recs.append(rec.recording)
+        s = summarize(recs)
+        near_ids = {w for w, _, _, labels in decode if labels.pod == "p0"}
+        arms[arm] = {
+            "ttft_p50_s": round(s.ttft_p50, 4),
+            "ttft_p95_s": round(s.ttft_p95, 4),
+            "near_share": round(sum(v for w, v in by_worker.items()
+                                    if w in near_ids) / max(1, fg), 3),
+        }
+    for eng in pres:
+        await eng.close()
+    for _, eng, _, _ in decode:
+        await eng.close()
+    out = {
+        "workload": f"P={prefill_workers} M={decode_workers} ISL={isl} "
+                    f"OSL={osl} fg={fg}",
+        **{f"{a}_{k}": v for a, st in arms.items() for k, v in st.items()},
+        "ttft_p95_ratio_blind_over_topo": round(
+            arms["blind"]["ttft_p95_s"] / arms["topo"]["ttft_p95_s"], 2)
+        if arms["topo"]["ttft_p95_s"] else None,
+    }
+    return out
+
+
+async def layer_ab(isl: int = 256, osl: int = 4, reps: int = 8,
+                   gbps: float = 0.5, groups: int = 4):
+    """Layer-interleaved vs whole-bundle tail transfer on one
+    prefill→decode pair over the same emulated link.
+
+    The signal is the **transfer-exposed TTFT gap**: TTFT with the link
+    emulated minus TTFT of a no-link baseline (same pair, near-infinite
+    bandwidth) — i.e. the wall the tail transfer adds on top of compute.
+    Whole-bundle pays staging, wire and scatter strictly serialized after
+    prefill; the layer split starts the wire after ONE group's staging and
+    overlaps the rest, so its gap should be smaller.
+    """
+    import statistics
+    import time as _time
+
+    from dynamo_tpu.disagg.handlers import (
+        DecodeWorkerHandler, DisaggConfig, PrefillWorkerHandler,
+    )
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.router.topology import TopologyCostModel, TopologyLabels
+
+    # deep model, NARROW matmuls but wide KV heads (hd=64): the tail
+    # bundle is ~8 MB while prefill compute (the noise floor) stays
+    # small. The prompt fits ONE chunk, so the ENTIRE prompt's KV is the
+    # tail — the maximally transfer-serialized case the split targets.
+    cfg = ModelConfig(vocab_size=256, hidden_size=256,
+                      intermediate_size=256, num_layers=16, num_heads=4,
+                      num_kv_heads=4, rope_theta=10000.0,
+                      max_position_embeddings=isl + 64, dtype="float32")
+    labels = TopologyLabels(host="d0", slice_id="sd", pod="p0")
+    plabels = {7100: TopologyLabels(host="p1", slice_id="sp", pod="p0")}
+
+    def req(tokens, max_tokens):
+        return PreprocessedRequest(
+            model="b", token_ids=tokens,
+            stop_conditions=StopConditions(max_tokens=max_tokens,
+                                           ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+
+    chunk = isl  # single-chunk prompts: the whole prompt KV is the tail
+
+    def make_pair(g):
+        args = EngineArgs(block_size=4, num_blocks=256, max_num_seqs=8,
+                          max_num_batched_tokens=chunk,
+                          max_model_len=isl + 64,
+                          kv_transfer_direct=False,
+                          kv_transfer_layer_groups=g,
+                          prefill_buckets=(chunk // 2, chunk),
+                          decode_batch_buckets=(1, 2))
+        return AsyncJaxEngine(cfg, args), AsyncJaxEngine(cfg, args)
+
+    def handler(pre, dec, bw):
+        return DecodeWorkerHandler(
+            dec, EmulatedPrefillClient({7100: PrefillWorkerHandler(pre)},
+                                       plabels, labels,
+                                       TopologyCostModel({"dcn": bw})),
+            DisaggConfig(max_local_prefill_length=16))
+
+    split_pair = make_pair(groups)
+    whole_pair = make_pair(0)
+    # each arm gets a free-wire baseline ON ITS OWN PAIR — a gap computed
+    # against the other pair's baseline folds pair-to-pair engine
+    # differences into the transfer signal
+    arms = {"split": handler(*split_pair, gbps),
+            "split0": handler(*split_pair, 1e6),
+            "whole": handler(*whole_pair, gbps),
+            "whole0": handler(*whole_pair, 1e6)}
+
+    async def one(dh, prompt):
+        t0 = _time.perf_counter()
+        t_first = None
+        async for frame in dh.generate(req(prompt, osl).to_wire(), None):
+            if t_first is None and frame.get("token_ids"):
+                t_first = _time.perf_counter()
+        return t_first - t0
+
+    ttfts: dict[str, list] = {t: [] for t in arms}
+    # arms interleave WITHIN each rep so machine drift (the dominant noise
+    # on a shared CPU host) hits all three equally and the per-rep paired
+    # differences stay clean; rep 0 warms every pair and is discarded
+    for i in range(reps + 1):
+        for j, (tag, dh) in enumerate(arms.items()):
+            prompt = [(300 * j + 11 * i + k) % 997 + 2 for k in range(isl)]
+            t = await one(dh, prompt)
+            if i > 0:
+                ttfts[tag].append(t)
+    for eng in (*split_pair, *whole_pair):
+        await eng.close()
+    gaps_split = [s - n for s, n in zip(ttfts["split"], ttfts["split0"])]
+    gaps_whole = [w - n for w, n in zip(ttfts["whole"], ttfts["whole0"])]
+    gap_split = statistics.median(gaps_split)
+    gap_whole = statistics.median(gaps_whole)
+    out = {
+        "ttft_p50_s": {t: round(statistics.median(v), 4)
+                       for t, v in ttfts.items()},
+        "gap_split_s": round(gap_split, 4),
+        "gap_whole_s": round(gap_whole, 4),
+        "gap_ratio_split_over_whole": round(gap_split / gap_whole, 3)
+        if gap_whole > 0 else None,
+        "workload": f"ISL={isl} chunk={chunk} L=16 KV=4 hd=64 "
+                    f"groups={groups} gbps={gbps}",
+    }
+    return out
+
+
 async def amain():
     ap = argparse.ArgumentParser(description="agg vs disagg TTFT A/B")
     ap.add_argument("--arch", default="llama3_1b")
@@ -160,12 +511,31 @@ async def amain():
     ap.add_argument("--bg", type=int, default=24)
     ap.add_argument("--fg", type=int, default=8)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--prefill-workers", type=int, default=0,
+                    help="run the multi-worker topology A/B with this many "
+                         "prefill workers (with --decode-workers)")
+    ap.add_argument("--decode-workers", type=int, default=4)
+    ap.add_argument("--layer-ab", action="store_true",
+                    help="run the layer-interleaved vs whole-bundle tail "
+                         "transfer A/B")
+    ap.add_argument("--seed", type=int, default=0)
     cli = ap.parse_args()
 
     import jax
 
     if cli.platform:
         jax.config.update("jax_platforms", cli.platform)
+
+    if cli.prefill_workers > 0 or cli.layer_ab:
+        out = {"platform": jax.default_backend()}
+        if cli.prefill_workers > 0:
+            out["fleet"] = await fleet_ab(
+                prefill_workers=cli.prefill_workers,
+                decode_workers=cli.decode_workers, seed=cli.seed)
+        if cli.layer_ab:
+            out["layer"] = await layer_ab()
+        print(json.dumps(out), flush=True)
+        return
 
     on_tpu = jax.default_backend() == "tpu"
     if cli.arch == "tiny" or not on_tpu:

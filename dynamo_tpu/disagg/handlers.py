@@ -29,6 +29,11 @@ logger = logging.getLogger("dynamo.disagg")
 #: request annotation by which a decode worker advertises that it can
 #: consume mid-prefill KvChunkFrames (pipelined transfer)
 KV_CHUNKS_ANNOTATION = "kv_chunks"
+#: request annotation by which a decode worker advertises that it can
+#: scatter LAYER SLICES of the tail chunk as they land (layer-interleaved
+#: transfer, docs/disagg.md) — without it the prefill side ships the tail
+#: as one full-depth bundle
+KV_LAYERS_ANNOTATION = "kv_layers"
 
 
 class PrefillWorkerHandler:
@@ -111,7 +116,7 @@ class DecodeWorkerHandler:
 
     def __init__(self, engine, prefill_client=None,
                  config: Optional[DisaggConfig] = None, prefill_queue=None,
-                 mm_client=None):
+                 mm_client=None, metrics=None, topo_labels=None):
         self.engine = engine
         self.prefill_client = prefill_client
         self.config = config or DisaggConfig()
@@ -120,6 +125,81 @@ class DecodeWorkerHandler:
         #: optional encode-component Client: resolves mm_refs → mm_embeds
         #: before generation (the nixl_connect embedding-read analog)
         self.mm_client = mm_client
+        #: this worker's locality labels (router/topology.py); None = read
+        #: DYN_TOPO_* lazily. Used by the claim-timeout fallback to prefer
+        #: near prefill instances over blind round robin.
+        self._topo_labels = topo_labels
+        self._topo_model = None
+        # KV-transfer observability (MetricsRegistry, optional): volume and
+        # wall per link path, plus the silent-degradation counters — the
+        # kv.transfer span already times this but nothing aggregated it
+        if metrics is not None:
+            self._xfer_bytes = metrics.counter(
+                "kv_transfer_bytes_total",
+                "disagg KV bytes placed on this decode worker, by link path")
+            self._xfer_seconds = metrics.histogram(
+                "kv_transfer_seconds",
+                "remote-prefill stream + KV placement wall per request, "
+                "by link path")
+            self._claim_fallback = metrics.counter(
+                "prefill_claim_fallback_total",
+                "queued prefill dispatches that degraded to round robin, "
+                "by reason")
+            self._pull_failures = metrics.counter(
+                "kv_direct_pull_failures_total",
+                "direct KV pulls that failed and degraded to host-staged "
+                "placement or local prefill recompute")
+        else:
+            self._xfer_bytes = self._xfer_seconds = None
+            self._claim_fallback = self._pull_failures = None
+
+    def _labels(self):
+        if self._topo_labels is None:
+            from dynamo_tpu.router.topology import TopologyLabels
+
+            self._topo_labels = TopologyLabels.from_env()
+        return self._topo_labels
+
+    def _count_fallback(self, reason: str):
+        if self._claim_fallback is not None:
+            self._claim_fallback.inc(reason=reason)
+
+    def _nearest_prefill_instance(self):
+        """Same-pod-preferring pick for the claim-timeout fallback: the
+        cheapest-link prefill instance by locality labels, or None when
+        labels give no strict preference (plain round robin then)."""
+        import random as _random
+
+        from dynamo_tpu.router.topology import (
+            TopologyCostModel, TopologyLabels, link_class,
+        )
+
+        instances = getattr(self.prefill_client, "instances", None)
+        my = self._labels()
+        if instances is None or not my:
+            return None
+        try:
+            insts = instances()
+        except Exception:
+            return None
+        if self._topo_model is None:
+            self._topo_model = TopologyCostModel()
+        routable = set(self.prefill_client.available_ids())
+        costs = {}
+        for inst in insts:
+            if inst.instance_id not in routable:
+                continue
+            # unlabeled instances price at the host class (link_class's
+            # unknown-side rule) — same convention as router/topology
+            # .link_costs, so a mixed labeled/unlabeled pool still
+            # prefers the strictly-nearer labeled instance
+            labels = TopologyLabels.from_metadata(inst.metadata)
+            costs[inst.instance_id] = self._topo_model.rel_cost(
+                link_class(labels, my))
+        if not costs or min(costs.values()) >= max(costs.values()):
+            return None  # unlabeled pool or all equally far: no preference
+        lo = min(costs.values())
+        return _random.choice([i for i, c in costs.items() if c == lo])
 
     def _use_remote_prefill(self, req: PreprocessedRequest) -> bool:
         if self.prefill_client is None:
@@ -167,20 +247,28 @@ class DecodeWorkerHandler:
         logger.debug("remote prefill: %d prompt tokens → prefill fleet",
                      len(req.token_ids))
         caps = [KV_CHUNKS_ANNOTATION]
+        if getattr(getattr(self.engine, "args", None),
+                   "kv_transfer_layer_groups", 0) > 1:
+            # layer-interleaved tail (docs/disagg.md): we can scatter
+            # layer slices as they land
+            caps.append(KV_LAYERS_ANNOTATION)
         direct_cap = getattr(self.engine, "direct_capability", lambda: None)()
         if direct_cap:
             caps.append(direct_cap)
         preq = dataclasses.replace(
             req, annotations=list(req.annotations or []) + caps)
         instance_id = None
+        fallback_reason = None
         if self.prefill_queue is not None:
             instance_id = await self.prefill_queue.acquire(ctx)
-            if (instance_id is not None
-                    and instance_id not in self.prefill_client.available_ids()):
+            if instance_id is None:
+                fallback_reason = "timeout"
+            elif instance_id not in self.prefill_client.available_ids():
                 # claim raced ahead of discovery, or the claimant just died
                 logger.warning("claimed prefill instance %x not routable; "
                                "falling back to round robin", instance_id)
                 instance_id = None
+                fallback_reason = "unroutable"
         stream = None
         # pass ctx so the prefill hop keeps the request's trace identity —
         # a fresh Context here would land every prefill-side span
@@ -194,6 +282,27 @@ class DecodeWorkerHandler:
             except NoRespondersError:
                 logger.warning("claimed prefill instance %x unreachable; "
                                "falling back to round robin", instance_id)
+                fallback_reason = "unreachable"
+        if stream is None and fallback_reason is not None:
+            # the silent degradation, counted: a rising rate means the
+            # queue path is not working (undersized/odd prefill fleet)
+            self._count_fallback(fallback_reason)
+            # the CLAIM FALLBACK (only) prefers a near prefill instance
+            # when the pool publishes locality labels — the KV pages are
+            # about to cross exactly that link. Queue-less deployments
+            # keep plain round robin: a standing near-preference with no
+            # load signal would pin all of this worker's prefills onto
+            # one instance (the queue's pull discipline is the load
+            # balancer; without a claim there is none).
+            near = self._nearest_prefill_instance()
+            if near is not None:
+                try:
+                    stream = await self.prefill_client.generate(
+                        preq.to_wire(), ctx=ctx, mode="direct",
+                        instance_id=near)
+                except NoRespondersError:
+                    logger.warning("near prefill instance %x unreachable; "
+                                   "falling back to round robin", near)
         if stream is None:  # no queue, claim timeout, or dead claimant
             stream = await self.prefill_client.generate(
                 preq.to_wire(), ctx=ctx, mode="round_robin")
@@ -205,12 +314,20 @@ class DecodeWorkerHandler:
         next_block = 0
         presp = None
         owned = False  # ids ownership not yet transferred to a sequence
+        # layer-interleaved tail assembly (docs/disagg.md): blocks covered
+        # by layer slices count as placed only once every layer landed
+        lnext = 0          # next expected start_layer of the assembly
+        lblocks = None     # block count the partial assembly covers
+        xfer_path = "host"  # link path label: proc | ici | host
+        xfer_bytes = 0
         t_xfer0 = time.time()  # remote-prefill stream + KV placement phase
         try:
+            from dynamo_tpu.disagg.protocols import KvLayerFrame
             from dynamo_tpu.disagg.transfer import KvDirectFrame, pull_bundle
 
             async for frame in stream:
-                if KvChunkFrame.is_wire(frame) or KvDirectFrame.is_wire(frame):
+                if (KvChunkFrame.is_wire(frame) or KvLayerFrame.is_wire(frame)
+                        or KvDirectFrame.is_wire(frame)):
                     if not placed:
                         # keep draining: the final frame has the token. Drop
                         # unclaimed same-process offers now instead of
@@ -221,24 +338,44 @@ class DecodeWorkerHandler:
                                 KvDirectFrame.from_wire(frame).desc)
                         continue
                     if KvDirectFrame.is_wire(frame):
+                        df = KvDirectFrame.from_wire(frame)
                         try:
                             # device-to-device pull (disagg/transfer.py) —
                             # the descriptor frame carries no page bytes
-                            ch = pull_bundle(eng.direct_transfer,
-                                             KvDirectFrame.from_wire(frame))
+                            ch = pull_bundle(eng.direct_transfer, df)
                         except Exception:
                             logger.exception("direct KV pull failed; will "
                                              "recompute prefill locally")
+                            if self._pull_failures is not None:
+                                self._pull_failures.inc()
                             placed = False
                             continue
+                        xfer_path = df.desc.get("mode") or xfer_path
+                    elif KvLayerFrame.is_wire(frame):
+                        ch = KvLayerFrame.from_wire(frame).bundle
                     else:
                         ch = KvChunkFrame.from_wire(frame).bundle
                     n = ch.num_blocks
+                    tl = getattr(ch, "total_layers", None)
                     if (not eng.check_bundle_dims(ch)
-                            or ch.start_block != next_block
                             or ch.start_block + n > total):
                         placed = False
                         continue
+                    if tl is None:
+                        # full-depth bundle: must extend the contiguous
+                        # range, and must not interleave a torn assembly
+                        if ch.start_block != next_block or lnext != 0:
+                            placed = False
+                            continue
+                    else:
+                        # layer slice: same block range throughout, layers
+                        # in order — anything else is a torn transfer
+                        nl = ch.k.shape[0]
+                        if (ch.start_block != next_block
+                                or getattr(ch, "start_layer", 0) != lnext
+                                or (lblocks is not None and lblocks != n)):
+                            placed = False
+                            continue
                     if ids is None:
                         ids = eng.alloc_inject(total)
                         if ids is None:
@@ -246,9 +383,23 @@ class DecodeWorkerHandler:
                             continue
                         owned = True
                     try:
-                        eng.scatter_chunk(
-                            ids[ch.start_block:ch.start_block + n], ch.k, ch.v)
-                        next_block += n
+                        if tl is None:
+                            eng.scatter_chunk(
+                                ids[ch.start_block:ch.start_block + n],
+                                ch.k, ch.v)
+                            next_block += n
+                        else:
+                            eng.scatter_chunk(
+                                ids[ch.start_block:ch.start_block + n],
+                                ch.k, ch.v,
+                                start_layer=getattr(ch, "start_layer", 0))
+                            lnext += nl
+                            lblocks = n
+                            if lnext >= tl:  # full depth landed
+                                next_block += n
+                                lnext, lblocks = 0, None
+                        xfer_bytes += (getattr(ch.k, "nbytes", 0)
+                                       + getattr(ch.v, "nbytes", 0))
                     except Exception:
                         logger.exception("KV chunk scatter failed")
                         placed = False
@@ -258,12 +409,17 @@ class DecodeWorkerHandler:
                 raise RuntimeError("prefill worker returned no response")
             # per-tier transfer timing as a first-class signal (KV-cache
             # survey): covers the prefill stream + chunk scatters
+            t_xfer1 = time.time()
             get_tracer().record(
-                "kv.transfer", ctx, start=t_xfer0, end=time.time(),
+                "kv.transfer", ctx, start=t_xfer0, end=t_xfer1,
                 service="disagg", blocks_placed=next_block,
-                total_blocks=total, placed=placed,
+                total_blocks=total, placed=placed, path=xfer_path,
                 direct=self.engine.direct_transfer is not None
                 if hasattr(self.engine, "direct_transfer") else False)
+            if self._xfer_seconds is not None:
+                self._xfer_seconds.observe(t_xfer1 - t_xfer0, path=xfer_path)
+            if self._xfer_bytes is not None and xfer_bytes:
+                self._xfer_bytes.inc(xfer_bytes, path=xfer_path)
 
             if presp.token_id < 0 or not placed:
                 if owned:

@@ -32,6 +32,14 @@ class KvBundle:
     the pipelined path ships several bundles per request (chunk frames while
     prefill is still running, then the tail inside PrefillResponse), each
     covering a contiguous logical range.
+
+    Layer-interleaved transfer (docs/disagg.md): a bundle may carry only a
+    LAYER SLICE of its block range — ``total_layers`` set means the k/v
+    arrays hold layers [start_layer, start_layer + k.shape[0]) of a
+    ``total_layers``-deep cache. The tail chunk ships as several such
+    slices so the wire/scatter of early layers overlaps the host staging of
+    later ones. ``total_layers`` None (the default) is a full-depth bundle
+    and the wire format is byte-identical to the pre-layer-split one.
     """
 
     k: np.ndarray
@@ -39,6 +47,8 @@ class KvBundle:
     num_tokens: int  # valid tokens covered (may end mid-block)
     block_size: int
     start_block: int = 0
+    start_layer: int = 0
+    total_layers: Optional[int] = None  # None = full depth
 
     @property
     def num_blocks(self) -> int:
@@ -48,7 +58,7 @@ class KvBundle:
         return self.k.shape[1]
 
     def to_wire(self) -> dict:
-        return {
+        d = {
             "shape": list(self.k.shape),
             "dtype": str(self.k.dtype),
             "k": self.k.tobytes(),
@@ -57,6 +67,12 @@ class KvBundle:
             "block_size": self.block_size,
             "start_block": self.start_block,
         }
+        if self.total_layers is not None:
+            # only layer slices carry the extra keys: full-depth bundles
+            # stay wire-identical for pre-layer-split peers
+            d["start_layer"] = self.start_layer
+            d["total_layers"] = self.total_layers
+        return d
 
     @staticmethod
     def from_wire(d: dict) -> "KvBundle":
@@ -68,7 +84,9 @@ class KvBundle:
         v = np.frombuffer(d["v"], dtype=dtype).reshape(shape)
         return KvBundle(k=k, v=v, num_tokens=d["num_tokens"],
                         block_size=d["block_size"],
-                        start_block=d.get("start_block", 0))
+                        start_block=d.get("start_block", 0),
+                        start_layer=d.get("start_layer", 0),
+                        total_layers=d.get("total_layers"))
 
 
 @dataclass
@@ -92,6 +110,33 @@ class KvChunkFrame:
     @staticmethod
     def from_wire(d: dict) -> "KvChunkFrame":
         return KvChunkFrame(bundle=KvBundle.from_wire(d["kv_chunk"]))
+
+
+@dataclass
+class KvLayerFrame:
+    """A layer-sliced transfer frame of the TAIL chunk (docs/disagg.md).
+
+    After the last prefill chunk commits, the whole-bundle path serializes
+    gather → host copy → wire → scatter before decode can start. Layer
+    frames split that tail on the layer axis: group g's wire/scatter
+    overlaps group g+1's device→host staging, so the decode side's first
+    step launches before the last layer group lands. Only sent when the
+    decode worker advertised ``kv_layers`` (capability negotiation — an
+    older peer keeps receiving the whole tail inside PrefillResponse).
+    """
+
+    bundle: KvBundle
+
+    def to_wire(self) -> dict:
+        return {"kv_layer": self.bundle.to_wire()}
+
+    @staticmethod
+    def is_wire(d: dict) -> bool:
+        return isinstance(d, dict) and "kv_layer" in d
+
+    @staticmethod
+    def from_wire(d: dict) -> "KvLayerFrame":
+        return KvLayerFrame(bundle=KvBundle.from_wire(d["kv_layer"]))
 
 
 @dataclass

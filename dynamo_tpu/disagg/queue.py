@@ -16,6 +16,10 @@ the direct response plane (the fast path). Flow:
 A claim timeout on the decode side falls back to round-robin dispatch, so a
 fleet without queue-popping workers (or an empty fleet) degrades to the r1
 behavior instead of stalling.
+
+QoS-aware pool (docs/disagg.md): tickets are class-split by the request's
+priority and workers drain best-class-first; the standard class rides the
+legacy plain queue so pre-QoS workers keep serving default traffic.
 """
 
 from __future__ import annotations
@@ -32,6 +36,34 @@ logger = logging.getLogger("dynamo.prefill_queue")
 
 PREFILL_QUEUE = "prefill_queue"
 CLAIM_SUBJECT = "prefill_claim"
+
+#: QoS-aware prefill pool (docs/disagg.md): tickets are class-split so
+#: workers pop best-class-first. The STANDARD class rides the legacy plain
+#: queue — a pre-QoS worker fleet keeps serving default traffic unchanged;
+#: only interactive/batch tickets need upgraded workers. Pop order is
+#: interactive → legacy/standard → batch.
+QOS_QUEUE_CLASSES = ("interactive", "batch")
+
+
+def qos_queue_name(queue: str, priority) -> str:
+    """Queue a ticket of this priority class lands on."""
+    if priority in QOS_QUEUE_CLASSES:
+        return f"{queue}.{priority}"
+    return queue  # standard/unknown: the legacy queue
+
+
+def pop_order(queue: str) -> list[str]:
+    """Queues a worker drains, best class first."""
+    return [f"{queue}.interactive", queue, f"{queue}.batch"]
+
+
+async def prefill_queue_depth(plane, queue: str = PREFILL_QUEUE) -> int:
+    """Total backlog across the class-split queues (the autoscaling /
+    metrics signal — a class split must not hide depth)."""
+    total = 0
+    for q in pop_order(queue):
+        total += await plane.queue_depth(q)
+    return total
 
 
 class PrefillQueueClient:
@@ -75,6 +107,8 @@ class PrefillQueueClient:
         if budget <= 0:
             return None  # deadline already spent: no point queueing
         job_id = uuid.uuid4().hex
+        priority = getattr(ctx, "priority", None)
+        tenant = getattr(ctx, "tenant", None)
         sub = await self.plane.subscribe(f"{CLAIM_SUBJECT}.{job_id}")
         span = get_tracer().span("prefill.queue_wait", ctx,
                                  service="disagg")
@@ -82,11 +116,18 @@ class PrefillQueueClient:
             with span as sp:
                 # expires_at lets workers discard tickets whose decode side
                 # has already fallen back — a stale ticket must not count
-                # as work
+                # as work. Tickets are class-split (qos_queue_name) so the
+                # prefill pool serves best-class-first; tenant/qos ride the
+                # ticket for observability.
+                ticket = {"job_id": job_id,
+                          "expires_at": time.time() + budget}
+                if priority:
+                    ticket["qos"] = priority
+                if tenant:
+                    ticket["tenant"] = tenant
                 await self.plane.queue_push(
-                    self.queue, msgpack.packb({
-                        "job_id": job_id,
-                        "expires_at": time.time() + budget}))
+                    qos_queue_name(self.queue, priority),
+                    msgpack.packb(ticket))
 
                 async def first_claim():
                     async for _subject, payload in sub:
@@ -112,7 +153,7 @@ class PrefillQueueClient:
             await sub.cancel()
 
     async def depth(self) -> int:
-        return await self.plane.queue_depth(self.queue)
+        return await prefill_queue_depth(self.plane, self.queue)
 
 
 class PrefillQueueWorker:
@@ -134,6 +175,9 @@ class PrefillQueueWorker:
         self.poll = poll
         self._task: Optional[asyncio.Task] = None
         self._stop = False
+        #: last wall time a class-split (interactive/batch) ticket was
+        #: popped — governs the adaptive blocking tail in _pop_best_class
+        self._class_seen_at = 0.0
         self.claims = 0
         #: expired tickets popped and dropped — a rising rate means decode
         #: workers are giving up before this fleet can claim (undersized
@@ -158,13 +202,32 @@ class PrefillQueueWorker:
             except asyncio.CancelledError:
                 pass
 
+    async def _pop_best_class(self) -> Optional[bytes]:
+        """Best-class-first drain (docs/disagg.md): sweep interactive →
+        legacy/standard → batch with near-nonblocking pops, then block on
+        the legacy queue (the common case) so an idle worker is not
+        spinning. The blocking tail ADAPTS: while class-split traffic has
+        been seen recently the block is short (an interactive ticket waits
+        at most ~1s behind a standard pop); a fleet that has only ever
+        seen legacy/standard tickets blocks long, keeping idle-poll RPC
+        volume against the control plane near the pre-QoS rate."""
+        for i, q in enumerate(pop_order(self.queue)):
+            item = await self.plane.queue_pop(q, timeout=0.02)
+            if item is not None:
+                if i != 1:  # a class-split (non-legacy) queue produced
+                    self._class_seen_at = time.time()
+                return item
+        recent = time.time() - self._class_seen_at < 60.0
+        return await self.plane.queue_pop(self.queue,
+                                          timeout=1.0 if recent else 5.0)
+
     async def _loop(self):
         while not self._stop:
             try:
                 if self.capacity_gate is not None and not self.capacity_gate():
                     await asyncio.sleep(self.poll)
                     continue
-                item = await self.plane.queue_pop(self.queue, timeout=5.0)
+                item = await self._pop_best_class()
                 if item is None:
                     continue
                 ticket = msgpack.unpackb(item, raw=False)

@@ -191,13 +191,19 @@ class DirectTransferManager:
         """Fetch the offered arrays; raises on any failure (caller falls
         back to local prefill). Attributed to the current request's trace
         as a ``kv.direct_pull`` span (ctx from the endpoint pump's
-        task-local CURRENT_REQUEST)."""
+        task-local CURRENT_REQUEST). Chaos hook ``kv.direct_pull`` injects
+        failures here so the degrade-to-recompute path is provable in
+        tier-1 (runtime/chaos.py)."""
         from dynamo_tpu.observability import get_tracer
+        from dynamo_tpu.runtime.chaos import ChaosError, get_chaos
 
         with get_tracer().span("kv.direct_pull", service="disagg",
                                mode=desc.get("mode"),
                                n_blocks=desc.get("n")) as sp:
             try:
+                chaos = get_chaos()
+                if chaos is not None and chaos.should_error("kv.direct_pull"):
+                    raise ChaosError("injected kv.direct_pull failure")
                 out = self._pull(desc)
                 self.stats["pulls"] += 1
                 return out
@@ -276,13 +282,19 @@ class DirectKvBundle:
     both ends of the wire."""
 
     def __init__(self, k, v, num_tokens: int, block_size: int,
-                 start_block: int, num_blocks: int):
+                 start_block: int, num_blocks: int,
+                 start_layer: int = 0, total_layers=None):
         self.k = k
         self.v = v
         self.num_tokens = num_tokens
         self.block_size = block_size
         self.start_block = start_block
         self.num_blocks = num_blocks
+        # layer-interleaved tail (docs/disagg.md): the arrays may cover
+        # only layers [start_layer, start_layer + k.shape[0]) of a
+        # total_layers-deep cache
+        self.start_layer = start_layer
+        self.total_layers = total_layers
 
 
 def pull_bundle(mgr: DirectTransferManager, frame: KvDirectFrame
@@ -292,4 +304,6 @@ def pull_bundle(mgr: DirectTransferManager, frame: KvDirectFrame
     return DirectKvBundle(k=k, v=v, num_tokens=d["num_tokens"],
                           block_size=d["block_size"],
                           start_block=d.get("start_block", 0),
-                          num_blocks=d.get("n", k.shape[1]))
+                          num_blocks=d.get("n", k.shape[1]),
+                          start_layer=d.get("start_layer", 0),
+                          total_layers=d.get("total_layers"))

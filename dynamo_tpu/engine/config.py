@@ -456,6 +456,14 @@ class EngineArgs:
     #: decode worker advertises reach — the NIXL analog (disagg/transfer.py).
     #: False = always host-staged bundles over the response plane.
     kv_transfer_direct: bool = True
+    #: layer-interleaved disagg transfer (docs/disagg.md): the TAIL chunk's
+    #: bundle — the one whole-bundle transfer serializes after prefill
+    #: completes — is split into this many layer groups and streamed as the
+    #: gathers land, so early layers' wire/scatter overlaps later layers'
+    #: host staging and decode's first step launches before the last layer
+    #: arrives. Capability-negotiated per request (``kv_layers``); clamped
+    #: to the model's layer count. <= 1 restores whole-bundle tails.
+    kv_transfer_layer_groups: int = 4
     #: multi-tenant QoS scheduling (docs/qos.md): per-class waiting queues
     #: drained by weighted-fair virtual token counters, class-aware
     #: preemption victims, aging. With one tenant/class the drain order is

@@ -593,9 +593,9 @@ class AsyncJaxEngine:
         """
         import dataclasses
 
-        from dynamo_tpu.disagg.protocols import KvChunkFrame, PrefillResponse
-
-        from dynamo_tpu.disagg.protocols import KvBundle
+        from dynamo_tpu.disagg.protocols import (
+            KvBundle, KvChunkFrame, KvLayerFrame, PrefillResponse,
+        )
         from dynamo_tpu.disagg.transfer import KvDirectFrame
         from dynamo_tpu.ops.block_copy import gather_blocks
 
@@ -605,6 +605,11 @@ class AsyncJaxEngine:
         # then never touch the host on this side — only descriptors ship
         mode = (self.direct_transfer.choose_mode(req.annotations)
                 if self.direct_transfer is not None else None)
+        # layer-interleaved tail (docs/disagg.md): when negotiated, the
+        # FINAL chunk's blocks are not shipped as one full-depth frame at
+        # its commit — they ride the tail path below, split on the layer
+        # axis so early layers' wire/scatter overlaps later layers' staging
+        layer_groups = self._kv_layer_groups(req.annotations)
         bs = self.args.block_size
         sc = dataclasses.replace(req.stop_conditions, max_tokens=1,
                                  min_tokens=1, ignore_eos=True)
@@ -621,6 +626,8 @@ class AsyncJaxEngine:
         # Shipping is monotonic; a preemption recompute re-fires progress
         # with smaller ends, which are skipped (identical content anyway).
         def on_progress(end: int) -> None:
+            if layer_groups is not None and end >= seq.prompt_len:
+                return  # final commit: the whole last chunk is the tail
             full = end // bs
             if full <= state["shipped"]:
                 return
@@ -696,8 +703,45 @@ class AsyncJaxEngine:
             shipped = state["shipped"]
             bundle = None
             if total > shipped:
-                if mode is not None:
-                    n = total - shipped
+                n = total - shipped
+                groups = layer_groups
+                if groups and mode is None:
+                    # layer-interleaved tail (docs/disagg.md): ONE gather,
+                    # then host-stage + ship a layer group at a time — the
+                    # wire/scatter of group g overlaps the device→host copy
+                    # of group g+1, instead of serializing the full-depth
+                    # bundle after prefill completes
+                    kb = gather_blocks(self.k_cache,
+                                       seq.block_table[shipped:total],
+                                       block_size=bs)
+                    vb = gather_blocks(self.v_cache,
+                                       seq.block_table[shipped:total],
+                                       block_size=bs)
+                    L = kb.shape[0]
+                    for g0, g1 in groups:
+                        k, v = await to_host(kb[g0:g1], vb[g0:g1], n)
+                        yield KvLayerFrame(KvBundle(
+                            k=k, v=v, num_tokens=seq.prompt_len,
+                            block_size=bs, start_block=shipped,
+                            start_layer=g0, total_layers=L)).to_wire()
+                elif groups and mode is not None:
+                    # direct path: one offer per layer group — the decode
+                    # side's pulls + layer scatters interleave the same way
+                    kb = gather_blocks(self.k_cache,
+                                       seq.block_table[shipped:total],
+                                       block_size=bs)
+                    vb = gather_blocks(self.v_cache,
+                                       seq.block_table[shipped:total],
+                                       block_size=bs)
+                    L = kb.shape[0]
+                    for g0, g1 in groups:
+                        desc = self.direct_transfer.offer(
+                            mode, [kb[g0:g1], vb[g0:g1]],
+                            {"num_tokens": seq.prompt_len, "n": n,
+                             "block_size": bs, "start_block": shipped,
+                             "start_layer": g0, "total_layers": L})
+                        yield KvDirectFrame(desc).to_wire()
+                elif mode is not None:
                     kb = gather_blocks(self.k_cache,
                                        seq.block_table[shipped:total],
                                        block_size=bs)
@@ -725,6 +769,29 @@ class AsyncJaxEngine:
                                 end=time.time(), service="engine",
                                 prompt_tokens=len(req.token_ids),
                                 streamed=True, mode=mode or "host")
+
+    def _kv_layer_groups(self, annotations):
+        """Contiguous (start, end) layer ranges for the layer-interleaved
+        tail transfer, or None for whole-bundle. Only when the decode peer
+        advertised ``kv_layers`` (capability negotiation) AND this engine
+        has splitting enabled AND the model is deep enough to split."""
+        from dynamo_tpu.disagg.handlers import KV_LAYERS_ANNOTATION
+        from dynamo_tpu.engine.cache import cache_shape
+
+        g = getattr(self.args, "kv_transfer_layer_groups", 0) or 0
+        if g <= 1 or KV_LAYERS_ANNOTATION not in (annotations or []):
+            return None
+        L = cache_shape(self.k_cache)[0]
+        g = min(g, L)
+        if g <= 1:
+            return None
+        base, rem = divmod(L, g)
+        out, s = [], 0
+        for i in range(g):
+            e = s + base + (1 if i < rem else 0)
+            out.append((s, e))
+            s = e
+        return out
 
     async def _gather_bundle(self, ids: list[int], num_tokens: int,
                              start_block: int):
@@ -763,19 +830,35 @@ class AsyncJaxEngine:
         if bundle.block_size != self.args.block_size:
             return False
         k = bundle.k
-        if k.ndim == 3:  # packed quant bundle [L, n, X]
-            return (k.shape[0] == L and k.dtype == np.uint8
+        # layer slices (docs/disagg.md): the bundle covers layers
+        # [start_layer, start_layer + k.shape[0]) of a total_layers-deep
+        # cache — depth must match OUR cache and the slice must fit
+        tl = getattr(bundle, "total_layers", None)
+        if tl is None:
+            want_layers = L
+        else:
+            sl = getattr(bundle, "start_layer", 0) or 0
+            if tl != L or sl < 0 or sl + k.shape[0] > L:
+                return False
+            want_layers = k.shape[0]
+        if k.ndim == 3:  # packed quant bundle [nL, n, X]
+            return (k.shape[0] == want_layers and k.dtype == np.uint8
                     and k.shape[2] == packed_block_width(
                         self.args.block_size, KV, hd))
-        return k.shape[0] == L and k.shape[3:] == (KV, hd)
+        return k.shape[0] == want_layers and k.shape[3:] == (KV, hd)
 
-    def scatter_chunk(self, ids, k: np.ndarray, v: np.ndarray) -> None:
-        """Place received pages [L, n, bs, KV, hd] into device blocks ``ids``."""
+    def scatter_chunk(self, ids, k: np.ndarray, v: np.ndarray,
+                      start_layer=None) -> None:
+        """Place received pages [L, n, bs, KV, hd] into device blocks
+        ``ids``. ``start_layer`` set means k/v are a layer slice covering
+        [start_layer, start_layer + k.shape[0]) only."""
         from dynamo_tpu.ops.block_copy import scatter_blocks
 
         bs = self.args.block_size
-        self.k_cache = scatter_blocks(self.k_cache, ids, k, block_size=bs)
-        self.v_cache = scatter_blocks(self.v_cache, ids, v, block_size=bs)
+        self.k_cache = scatter_blocks(self.k_cache, ids, k, block_size=bs,
+                                      start_layer=start_layer)
+        self.v_cache = scatter_blocks(self.v_cache, ids, v, block_size=bs,
+                                      start_layer=start_layer)
 
     async def generate_prefilled(self, req: PreprocessedRequest, token_id: int,
                                  logprob, ids, ctx=None

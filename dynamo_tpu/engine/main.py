@@ -142,6 +142,11 @@ async def amain():
                          "signature per token bucket) and restore the "
                          "bucketed per-(chunk,batch,width) step path "
                          "wholesale (docs/performance.md)")
+    ap.add_argument("--kv-layer-groups", type=int, default=4,
+                    help="layer-interleaved disagg transfer: split the tail "
+                         "chunk's KV bundle into this many layer groups "
+                         "streamed as they are gathered (docs/disagg.md); "
+                         "<=1 restores whole-bundle tails")
     ap.add_argument("--no-prefix-caching", action="store_true")
     # choices= fails fast on a typo — an unknown parser name would
     # otherwise silently disable extraction AND buffer all chat streaming
@@ -294,6 +299,7 @@ async def amain():
         pipeline_decode=cli.pipeline_decode,
         ragged_step=cli.ragged_step,
         warmup_buckets=cli.warmup_buckets,
+        kv_transfer_layer_groups=cli.kv_layer_groups,
     )
 
     if cli.dp_rank is not None and not 0 <= cli.dp_rank < cli.num_ranks:
@@ -541,7 +547,8 @@ async def amain():
             mm_client = await mm_ep.client().start()
         handler = DecodeWorkerHandler(engine, prefill_client, dconf,
                                       prefill_queue=prefill_queue,
-                                      mm_client=mm_client)
+                                      mm_client=mm_client,
+                                      metrics=runtime.metrics)
         serve = handler.generate
         if cli.role == "decode":  # live-tunable threshold (disagg_router.rs)
             from dynamo_tpu.disagg.handlers import DisaggConfigWatcher

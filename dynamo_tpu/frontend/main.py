@@ -40,6 +40,15 @@ async def amain():
                          "(0 = off; ref: subscriber.rs:30-65)")
     ap.add_argument("--router-reset-states", action="store_true",
                     help="ignore any persisted radix snapshot on start")
+    ap.add_argument("--transfer-cost-weight", type=float, default=1.0,
+                    help="weight on the topology-costed KV-transfer term "
+                         "of the routing logit (docs/disagg.md); active "
+                         "only when the prefill pool publishes DYN_TOPO_* "
+                         "locality labels. 0 = topology-blind")
+    ap.add_argument("--prefill-component", default="prefill",
+                    help="component whose instances are the KV source "
+                         "pool for the transfer term ('' disables the "
+                         "pool watch)")
     ap.add_argument("--grpc-port", type=int, default=0,
                     help="also serve the KServe gRPC frontend on this port "
                          "(0 = disabled; ref: grpc/service/kserve.rs:31)")
@@ -57,6 +66,8 @@ async def amain():
             router_replica_sync=args.router_replica_sync,
             router_snapshot_threshold=args.router_snapshot_threshold or None,
             router_reset_states=args.router_reset_states,
+            transfer_cost_weight=args.transfer_cost_weight,
+            prefill_component=args.prefill_component,
         ),
     ).start()
     service = HttpService(manager, host=args.host, port=args.port,

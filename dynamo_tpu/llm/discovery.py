@@ -48,6 +48,9 @@ class ServedModel:
     embed_client: Optional[Client] = None
     #: lazy client to the worker's "clear_kv_blocks" admin endpoint
     clear_client: Optional[Client] = None
+    #: prefill-pool watch feeding the router's topology-costed KV-transfer
+    #: term (docs/disagg.md); None in aggregated/topology-blind deployments
+    prefill_client: Optional[Client] = None
     #: SHARED load monitor (owned by the ModelWatcher); this model's client
     #: is registered with it — stop() only unregisters
     monitor: Optional[object] = None
@@ -112,6 +115,8 @@ class ServedModel:
             await self.embed_client.stop()
         if self.clear_client:
             await self.clear_client.stop()
+        if self.prefill_client:
+            await self.prefill_client.stop()
         if self.router:
             await self.router.stop()
 
@@ -220,11 +225,22 @@ class ModelWatcher:
                         busy_threshold=self.busy_threshold).start()
                 self._monitor.register_client(client)
             router = None
+            prefill_client = None
             if self.router_mode == "kv":
                 router = await KvRouter(
                     self.runtime.plane, card.kv_cache_block_size, self.kv_router_config
                 ).start()
-                engine = KvPushRouter(client, router).generate
+                # network-aware disagg (docs/disagg.md): watch the prefill
+                # pool so routing can cost KV transfer by topology; an
+                # absent/unlabeled pool leaves the term at zero
+                pcfg = self.kv_router_config
+                if pcfg.prefill_component and pcfg.transfer_cost_weight > 0:
+                    prefill_client = await (
+                        self.runtime.namespace(entry.namespace)
+                        .component(pcfg.prefill_component)
+                        .endpoint("generate").client().start())
+                engine = KvPushRouter(client, router,
+                                      prefill_client=prefill_client).generate
             else:
                 mode = self.router_mode
 
@@ -238,6 +254,7 @@ class ModelWatcher:
             sm = ServedModel(
                 name=entry.name, card=card, client=client, pipeline=pipeline,
                 router=router, monitor=self._monitor, _endpoint=endpoint,
+                prefill_client=prefill_client,
             )
             self.manager.models[entry.name] = sm
             logger.info("model %s now served (router=%s)", entry.name, self.router_mode)

@@ -68,11 +68,13 @@ class MetricsService:
         """Current global prefill-queue backlog (planner scaling signal).
         A slow/absent control plane must not break the whole /metrics
         endpoint — local gauges still serve; depth reads 0."""
-        from dynamo_tpu.disagg.queue import PREFILL_QUEUE
+        from dynamo_tpu.disagg.queue import prefill_queue_depth
 
         try:
+            # sums the QoS class-split queues — the split must not hide
+            # backlog from the planner (docs/disagg.md)
             return await asyncio.wait_for(
-                self.runtime.plane.queue_depth(PREFILL_QUEUE), 2.0)
+                prefill_queue_depth(self.runtime.plane), 2.0)
         except Exception:
             logger.warning("prefill queue depth unavailable; reporting 0")
             return 0

@@ -28,6 +28,7 @@ async def run_mocker(
     endpoint: str = "generate",
     lease_id=None,
     migration_limit: Optional[int] = None,
+    topo: Optional[dict] = None,
 ):
     """Start ``args.dp_size`` simulated ranks on one endpoint.
 
@@ -60,8 +61,13 @@ async def run_mocker(
         await kv_pub.start_resync_responder()
         metrics_pub = WorkerMetricsPublisher(runtime.plane, worker_id=lease)
         engine = await MockEngine(args, kv_pub, metrics_pub).start()
+        # synthetic locality labels ({"host":…,"slice":…,"pod":…}) let fleet
+        # tests/benches exercise topology-costed routing without real slices
+        meta = {"dp_rank": rank}
+        if topo:
+            meta["topo"] = dict(topo)
         handle = await ep.serve_endpoint(engine.generate, lease_id=lease,
-                                         metadata={"dp_rank": rank})
+                                         metadata=meta)
         engines.append(engine)
         handles.append(handle)
     card = ModelDeploymentCard(
@@ -106,6 +112,12 @@ async def amain():
     ap.add_argument("--migration-limit", type=int, default=None,
                     help="max stream migrations per request (model card "
                          "migration_limit; raise under chaos/worker churn)")
+    ap.add_argument("--topo-host", default=None,
+                    help="locality label: host (default DYN_TOPO_HOST)")
+    ap.add_argument("--topo-slice", default=None,
+                    help="locality label: slice (default DYN_TOPO_SLICE)")
+    ap.add_argument("--topo-pod", default=None,
+                    help="locality label: pod (default DYN_TOPO_POD)")
     ap.add_argument(
         "--vocab-size", type=int, default=0,
         help="0 = derive from the model tokenizer so outputs decode to text",
@@ -131,9 +143,12 @@ async def amain():
         startup_time=cli.startup_time,
         token_budget_plan=cli.token_budget_plan,
     )
+    topo = {k: v for k, v in (("host", cli.topo_host),
+                              ("slice", cli.topo_slice),
+                              ("pod", cli.topo_pod)) if v}
     engines, handles = await run_mocker(
         runtime, cli.model, args, cli.namespace, cli.component,
-        migration_limit=cli.migration_limit,
+        migration_limit=cli.migration_limit, topo=topo or None,
     )
     print("MOCKER_READY", flush=True)
 

@@ -104,6 +104,61 @@ def _scatter_packed(cache, block_ids, bundle, *, block_size):
     }
 
 
+@functools.partial(jax.jit, static_argnames=("block_size", "start_layer"),
+                   donate_argnums=(0,))
+def _scatter_layers(cache, block_ids, bundle, *, block_size, start_layer):
+    """Write a LAYER SLICE [nL, n, bs, KV, hd] of a bundle into layers
+    [start_layer, start_layer+nL) of the cache. start_layer is static: the
+    prefill side splits into a fixed group count, so the signature set is
+    bounded by groups × widths (same discipline as the pow2 id padding)."""
+    L, slots, KV, hd = cache.shape
+    nL = bundle.shape[0]
+    paged = cache.reshape(L, slots // block_size, block_size, KV, hd)
+    return (paged.at[start_layer:start_layer + nL, block_ids]
+            .set(bundle).reshape(L, slots, KV, hd))
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "start_layer"),
+                   donate_argnums=(0,))
+def _scatter_packed_layers(cache, block_ids, bundle, *, block_size,
+                           start_layer):
+    """Layer-sliced write of a packed uint8 [nL, n, X] quant bundle."""
+    from dynamo_tpu.engine.cache import unpack_kv_blocks
+
+    L, slots, KV, hd = cache["q"].shape
+    nL = bundle.shape[0]
+    qb, sb = unpack_kv_blocks(bundle, block_size, KV, hd)
+    qp = cache["q"].reshape(L, slots // block_size, block_size, KV, hd)
+    sp = cache["s"].reshape(L, slots // block_size, block_size, KV)
+    return {
+        "q": (qp.at[start_layer:start_layer + nL, block_ids]
+              .set(qb).reshape(L, slots, KV, hd)),
+        "s": (sp.at[start_layer:start_layer + nL, block_ids]
+              .set(sb).reshape(L, slots, KV)),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "start_layer"),
+                   donate_argnums=(0,))
+def _scatter_quant_layers(cache, block_ids, bundle, *, block_size,
+                          start_layer):
+    """Layer-sliced write of a VALUE bundle into an int8 cache (quantize
+    in-trace — the cross-layout pair of _scatter_quant)."""
+    from dynamo_tpu.engine.cache import quantize_kv
+
+    L, slots, KV, hd = cache["q"].shape
+    nL = bundle.shape[0]
+    qb, sb = quantize_kv(bundle)
+    qp = cache["q"].reshape(L, slots // block_size, block_size, KV, hd)
+    sp = cache["s"].reshape(L, slots // block_size, block_size, KV)
+    return {
+        "q": (qp.at[start_layer:start_layer + nL, block_ids]
+              .set(qb).reshape(L, slots, KV, hd)),
+        "s": (sp.at[start_layer:start_layer + nL, block_ids]
+              .set(sb).reshape(L, slots, KV)),
+    }
+
+
 def _is_packed(bundle) -> bool:
     # attribute check, not np.asarray: device bundles must not round-trip
     # through host memory just to inspect dtype
@@ -111,7 +166,8 @@ def _is_packed(bundle) -> bool:
             and getattr(bundle, "ndim", 0) == 3)
 
 
-def scatter_blocks(cache, block_ids, bundle, *, block_size: int):
+def scatter_blocks(cache, block_ids, bundle, *, block_size: int,
+                   start_layer=None):
     """Write a gathered bundle into blocks of the cache; returns new cache.
 
     bundle: [L, n, bs, KV, hd] values (np or jax), or a packed uint8
@@ -125,6 +181,11 @@ def scatter_blocks(cache, block_ids, bundle, *, block_size: int):
     dequantizes on the way in (mixed prefill/decode deployments); a value
     bundle into an int8 cache re-quantizes in-trace (bit-exact for bundles
     that started as quantized pages — engine/cache.py int8 notes).
+
+    ``start_layer`` (int) means the bundle is a LAYER SLICE: its leading
+    axis covers only layers [start_layer, start_layer + nL) of the cache —
+    the layer-interleaved disagg transfer path (docs/disagg.md). None =
+    full depth.
     """
     from dynamo_tpu.engine.cache import (
         is_quant_cache, unpack_kv_blocks, dequantize_kv,
@@ -152,9 +213,19 @@ def scatter_blocks(cache, block_ids, bundle, *, block_size: int):
             f"{len(pids)} — ids and bundle disagree")
     if is_quant_cache(cache):
         if packed:
+            if start_layer is not None:
+                return _scatter_packed_layers(cache, jnp.asarray(pids),
+                                              jnp.asarray(bundle),
+                                              block_size=block_size,
+                                              start_layer=int(start_layer))
             return _scatter_packed(cache, jnp.asarray(pids),
                                    jnp.asarray(bundle),
                                    block_size=block_size)
+        if start_layer is not None:
+            return _scatter_quant_layers(cache, jnp.asarray(pids),
+                                         jnp.asarray(bundle, jnp.float32),
+                                         block_size=block_size,
+                                         start_layer=int(start_layer))
         return _scatter_quant(cache, jnp.asarray(pids),
                               jnp.asarray(bundle, jnp.float32),
                               block_size=block_size)
@@ -162,6 +233,11 @@ def scatter_blocks(cache, block_ids, bundle, *, block_size: int):
         KV, hd = cache.shape[2], cache.shape[3]
         qb, sb = unpack_kv_blocks(jnp.asarray(bundle), block_size, KV, hd)
         bundle = dequantize_kv(qb, sb)
+    if start_layer is not None:
+        return _scatter_layers(cache, jnp.asarray(pids),
+                               jnp.asarray(bundle).astype(cache.dtype),
+                               block_size=block_size,
+                               start_layer=int(start_layer))
     return _scatter(cache, jnp.asarray(pids),
                     jnp.asarray(bundle).astype(cache.dtype),
                     block_size=block_size)

@@ -132,6 +132,7 @@ class KvRouter:
         worker_ids: list[int],
         router_config_override: Optional[dict] = None,
         priority: Optional[str] = None,
+        link_costs: Optional[dict[int, float]] = None,
     ) -> SchedulingDecision:
         local = compute_block_hash_for_seq(token_ids, self.block_size)
         seq_hashes = compute_seq_hash_for_block(local)
@@ -144,6 +145,7 @@ class KvRouter:
             worker_ids=worker_ids,
             router_config_override=router_config_override,
             priority=priority,
+            link_costs=link_costs,
         )
         if isinstance(self.indexer, ApproxKvIndexer):
             self.indexer.process_routing_decision_for_request(token_ids, decision.worker_id)
@@ -171,11 +173,55 @@ class KvRouter:
 
 
 class KvPushRouter:
-    """Engine operator: route a PreprocessedRequest to the best worker."""
+    """Engine operator: route a PreprocessedRequest to the best worker.
 
-    def __init__(self, client: Client, router: KvRouter):
+    ``prefill_client`` (optional) watches the prefill component's
+    instances; when that pool publishes locality labels the routing logit
+    gains a topology-costed KV-transfer term (router/topology.py) so the
+    decode choice accounts for where the prefill fleet's KV bytes must
+    travel. Without the client — or with an unlabeled fleet — routing is
+    exactly the topology-blind cost function.
+    """
+
+    def __init__(self, client: Client, router: KvRouter,
+                 prefill_client: Optional[Client] = None):
         self.client = client
         self.router = router
+        self.prefill_client = prefill_client
+        self._topo_model = None
+        # memoized (key, costs): the sources×workers sweep only changes
+        # when an instance (de)registers, not per routed request
+        self._link_cache: Optional[tuple] = None
+
+    def _link_costs(self) -> Optional[dict[int, float]]:
+        """Per-decode-worker relative KV-transfer cost from the prefill
+        pool, or None (topology-blind) when disabled or unlabeled."""
+        cfg = self.router.config
+        if self.prefill_client is None or cfg.transfer_cost_weight <= 0:
+            return None
+        from dynamo_tpu.router.topology import (
+            TopologyCostModel, TopologyLabels, link_costs,
+        )
+
+        pre_insts = self.prefill_client.instances()
+        wk_insts = self.client.instances()
+        # Instance objects are rebuilt per registration event, so object
+        # identity is a change detector for membership AND metadata
+        key = (tuple(map(id, pre_insts)), tuple(map(id, wk_insts)))
+        if self._link_cache is not None and self._link_cache[0] == key:
+            return self._link_cache[1]
+        sources = [TopologyLabels.from_metadata(i.metadata)
+                   for i in pre_insts]
+        if not any(sources):
+            costs = None
+        else:
+            if self._topo_model is None:
+                self._topo_model = TopologyCostModel(cfg.link_gbps)
+            workers = {i.instance_id: TopologyLabels.from_metadata(i.metadata)
+                       for i in wk_insts}
+            costs = link_costs(sources, workers, self._topo_model)
+        self._link_cache = (key, costs)
+        return costs
 
     async def generate(self, req: PreprocessedRequest, ctx: Context) -> AsyncIterator:
         if isinstance(req, dict):
@@ -206,6 +252,7 @@ class KvPushRouter:
                     ctx.id, req.token_ids, worker_ids,
                     req.router_config_override,
                     priority=getattr(ctx, "priority", None),
+                    link_costs=self._link_costs(),
                 )
             except NoWorkersError as e:
                 raise NoRespondersError(str(e)) from e

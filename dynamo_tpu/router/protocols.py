@@ -186,6 +186,21 @@ class KvRouterConfig:
     #: both disables the bias. The standard class always uses 1.0.
     qos_interactive_load_factor: float = 2.0
     qos_batch_load_factor: float = 0.5
+    #: network-aware disagg (docs/disagg.md, NetKV arxiv 2606.03910):
+    #: weight on the ``transfer_blocks × link_cost`` term of the routing
+    #: logit. The term only exists when the prefill pool publishes
+    #: locality labels (router/topology.py), so the default deployment is
+    #: topology-blind with zero added cost; 0.0 disables the term even
+    #: with labels present.
+    transfer_cost_weight: float = 1.0
+    #: per-link-class bandwidth overrides (GB/s), e.g.
+    #: {"ici": 50, "dcn": 10, "host": 2}; None = topology defaults +
+    #: DYN_TOPO_GBPS env overrides (router/topology.DEFAULT_GBPS)
+    link_gbps: Optional[dict] = None
+    #: component whose instances are the KV source pool for the transfer
+    #: term (the prefill fleet in a disagg deployment); "" disables the
+    #: source watch entirely
+    prefill_component: str = "prefill"
 
 
 @dataclass

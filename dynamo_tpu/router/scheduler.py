@@ -3,11 +3,15 @@
 Rebuild of the reference scheduler (ref: lib/llm/src/kv_router/scheduler.rs:
 469-532 selector, :383-445 softmax): per worker,
 
-    logit = overlap_score_weight * potential_prefill_blocks + potential_decode_blocks
+    logit = overlap_score_weight * potential_prefill_blocks
+          + load_factor * potential_decode_blocks
+          + transfer_cost_weight * potential_prefill_blocks * link_cost
 
 (lower is better); selection is softmax sampling over min-max-normalized
 negated logits at ``router_temperature`` — temperature 0 means argmin with
-random tie-break.
+random tie-break. The transfer term (docs/disagg.md, NetKV) only exists
+when the caller supplies per-worker link costs from published topology
+labels; an unlabeled fleet is exactly the classic two-term cost.
 """
 
 from __future__ import annotations
@@ -104,6 +108,7 @@ class KvScheduler:
         worker_ids: list[int],
         router_config_override: Optional[dict] = None,
         priority: Optional[str] = None,
+        link_costs: Optional[dict[int, float]] = None,
     ) -> SchedulingDecision:
         if not worker_ids:
             raise NoWorkersError("no workers available")
@@ -114,6 +119,8 @@ class KvScheduler:
         override = router_config_override or {}
         overlap_weight = override.get("overlap_score_weight", self.config.overlap_score_weight)
         temperature = override.get("router_temperature", self.config.router_temperature)
+        transfer_weight = override.get("transfer_cost_weight",
+                                       self.config.transfer_cost_weight)
         load_factor = self._load_factor(priority)
 
         track = seq_hashes if self.config.router_track_active_blocks else None
@@ -123,12 +130,24 @@ class KvScheduler:
 
         request_blocks = -(-isl_tokens // self.block_size)
         logits: dict[int, float] = {}
+        # a worker absent from the cost map (registry race: it joined
+        # worker_ids after the topology snapshot) prices at the WORST
+        # known link — unknown is conservatively far (router/topology.py),
+        # never free
+        worst_link = max(link_costs.values()) if link_costs else 0.0
         for w in worker_ids:
             pt = prefill_tokens.get(w, isl_tokens)
             potential_prefill_block = pt / self.block_size
             decode_block = float(decode_blocks.get(w, math.floor(potential_prefill_block)))
             logits[w] = (overlap_weight * potential_prefill_block
                          + load_factor * decode_block)
+            if link_costs:
+                # network-aware disagg (router/topology.py): the blocks this
+                # worker must prefill are the blocks the prefill fleet will
+                # ship to it — charge them at the link's relative per-byte
+                # cost so decode lands where the KV is cheap to reach
+                logits[w] += (transfer_weight * potential_prefill_block
+                              * link_costs.get(w, worst_link))
 
         worker_id = softmax_sample(logits, temperature, self._rng)
         overlap = overlaps.scores.get(worker_id, 0)

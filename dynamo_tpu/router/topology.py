@@ -1,0 +1,187 @@
+"""Locality topology model for network-aware disaggregation (NetKV,
+arxiv 2606.03910).
+
+Workers publish where they sit — host / slice / pod — as locality labels in
+their ``Instance.metadata`` at registration (runtime/component.py stamps
+them from the ``DYN_TOPO_*`` environment). The router folds a (source,
+destination) label pair into one of four **link classes**, ordered by how
+expensive it is to move KV bytes across:
+
+    proc  — same host: in-process offer registry / shared JAX client;
+            pages move by reference or one local DMA
+    ici   — same slice: jax.experimental.transfer over the inter-chip
+            interconnect (the NVLink analog)
+    dcn   — same pod, different slice: the data-center network between
+            slices (direct pull still works, at DCN bandwidth)
+    host  — different pod, or unknown locality: host-staged bundles over
+            the response plane (the conservative fallback transport)
+
+``TopologyCostModel`` turns a link class into a relative per-byte cost from
+configurable bandwidths (``DYN_TOPO_GBPS`` / ``KvRouterConfig.link_gbps``),
+normalized so ICI costs 1.0. The KV router's logit gains
+``transfer_cost_weight × transfer_blocks × rel_cost(link)`` — decode lands
+where the KV is cheap to reach, not just where prefix overlap is high.
+When nobody publishes labels every link resolves to the same class and the
+term cancels: topology-blind behavior is the zero-config default.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+#: link classes, cheapest transport first
+LINK_CLASSES = ("proc", "ici", "dcn", "host")
+
+#: default effective bandwidths per link class, gigabytes/sec. proc is the
+#: in-process/same-host reference pass (no wire); ici/dcn follow TPU-pod
+#: orders of magnitude; host is the response-plane TCP fallback.
+DEFAULT_GBPS = {"proc": 400.0, "ici": 50.0, "dcn": 10.0, "host": 2.0}
+
+#: metadata key carrying labels inside Instance.metadata
+TOPO_METADATA_KEY = "topo"
+
+
+@dataclass(frozen=True)
+class TopologyLabels:
+    """Where a worker sits. Any field may be None (unpublished)."""
+
+    host: Optional[str] = None
+    slice_id: Optional[str] = None
+    pod: Optional[str] = None
+
+    def __bool__(self) -> bool:
+        return any((self.host, self.slice_id, self.pod))
+
+    def to_metadata(self) -> dict:
+        d = {}
+        if self.host:
+            d["host"] = self.host
+        if self.slice_id:
+            d["slice"] = self.slice_id
+        if self.pod:
+            d["pod"] = self.pod
+        return d
+
+    @staticmethod
+    def from_metadata(meta: Optional[dict]) -> "TopologyLabels":
+        """Labels from an Instance.metadata dict (missing/foreign → empty)."""
+        t = (meta or {}).get(TOPO_METADATA_KEY)
+        if not isinstance(t, dict):
+            return TopologyLabels()
+        return TopologyLabels(host=t.get("host") or None,
+                              slice_id=t.get("slice") or None,
+                              pod=t.get("pod") or None)
+
+    @staticmethod
+    def from_env(env=None) -> "TopologyLabels":
+        """DYN_TOPO_HOST / DYN_TOPO_SLICE / DYN_TOPO_POD. Empty when none
+        are set — an unlabeled fleet stays topology-blind by default."""
+        env = os.environ if env is None else env
+        host = env.get("DYN_TOPO_HOST") or None
+        sl = env.get("DYN_TOPO_SLICE") or None
+        pod = env.get("DYN_TOPO_POD") or None
+        if not (host or sl or pod):
+            return TopologyLabels()
+        if host is None:
+            # slice/pod published without a host name: default to the
+            # machine's hostname so same-VM co-location is still detected
+            import socket
+
+            host = socket.gethostname()
+        return TopologyLabels(host=host, slice_id=sl, pod=pod)
+
+
+def link_class(a: TopologyLabels, b: TopologyLabels) -> str:
+    """Fold two label sets into a link class. Unknown locality on either
+    side is conservatively the host-staged class — a wrong "fast" guess
+    costs a failed pull + prefill recompute, a wrong "slow" guess only
+    costs bandwidth headroom."""
+    if not a or not b:
+        return "host"
+    if a.host and a.host == b.host:
+        return "proc"
+    if a.slice_id and a.slice_id == b.slice_id:
+        return "ici"
+    if a.pod and a.pod == b.pod:
+        return "dcn"
+    return "host"
+
+
+def _parse_gbps(raw: str) -> dict[str, float]:
+    """'ici=50,dcn=10,host=2' → partial override dict. Bad entries raise —
+    a typo'd bandwidth silently defaulting would misroute a whole fleet."""
+    out: dict[str, float] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad DYN_TOPO_GBPS entry {part!r} "
+                             "(want class=gbps)")
+        k, v = part.split("=", 1)
+        k = k.strip()
+        if k not in LINK_CLASSES:
+            raise ValueError(f"unknown link class {k!r} in DYN_TOPO_GBPS "
+                             f"(valid: {', '.join(LINK_CLASSES)})")
+        try:
+            gbps = float(v)
+        except ValueError:
+            raise ValueError(f"bad gbps value {v!r} for link {k!r}") from None
+        if gbps <= 0:
+            raise ValueError(f"gbps for link {k!r} must be > 0")
+        out[k] = gbps
+    return out
+
+
+class TopologyCostModel:
+    """Per-link-class bandwidths → transfer costs.
+
+    ``rel_cost(link)`` is the inverse bandwidth normalized to ICI = 1.0 —
+    the unitless multiplier the router's cost function consumes.
+    ``seconds(link, nbytes)`` is the wall-clock estimate benchmarks and
+    link emulation use.
+    """
+
+    def __init__(self, gbps: Optional[dict] = None):
+        table = dict(DEFAULT_GBPS)
+        env = os.environ.get("DYN_TOPO_GBPS")
+        if env:
+            table.update(_parse_gbps(env))
+        if gbps:
+            table.update({k: float(v) for k, v in gbps.items()
+                          if k in LINK_CLASSES})
+        bad = [k for k, v in table.items() if v <= 0]
+        if bad:
+            raise ValueError(f"non-positive gbps for link class(es) {bad}")
+        self.gbps = table
+
+    def rel_cost(self, link: str) -> float:
+        return self.gbps["ici"] / self.gbps.get(link, self.gbps["host"])
+
+    def seconds(self, link: str, nbytes: int) -> float:
+        return nbytes / (self.gbps.get(link, self.gbps["host"]) * 1e9)
+
+
+def link_costs(
+    sources: list[TopologyLabels],
+    worker_labels: dict[int, TopologyLabels],
+    model: Optional[TopologyCostModel] = None,
+) -> Optional[dict[int, float]]:
+    """Per-worker relative transfer cost from the best-placed KV source.
+
+    ``sources`` are the prefill pool's labels (the KV originates there);
+    each worker's cost is the MIN over sources — the prefill-side claim
+    fallback prefers the same near instance, so best-case is the honest
+    estimate. Returns None when no source publishes labels (zero-cost
+    topology-blind default).
+    """
+    sources = [s for s in sources if s]
+    if not sources:
+        return None
+    model = model or TopologyCostModel()
+    return {
+        w: min(model.rel_cost(link_class(s, wl)) for s in sources)
+        for w, wl in worker_labels.items()
+    }

@@ -262,6 +262,16 @@ class Endpoint:
         pod = os.environ.get("DYN_POD_NAME")
         if pod and "pod" not in meta:
             meta["pod"] = pod
+        # locality labels (DYN_TOPO_HOST/SLICE/POD) ride the instance record
+        # so the KV router and the disagg claim fallback can cost transfers
+        # by link class (router/topology.py); unset env = no key published
+        # and the whole fleet stays topology-blind
+        if "topo" not in meta:
+            from dynamo_tpu.router.topology import TopologyLabels
+
+            topo = TopologyLabels.from_env()
+            if topo:
+                meta["topo"] = topo.to_metadata()
         inst = Instance(ns, comp, ep, lease, metadata=meta)
         value = msgpack.packb(inst.to_wire())
         key = instance_key(ns, comp, ep, lease)
