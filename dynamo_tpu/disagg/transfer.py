@@ -33,6 +33,7 @@ side degrades to local prefill recompute (the handler's existing
 
 from __future__ import annotations
 
+import asyncio
 import itertools
 import logging
 import os
@@ -40,6 +41,7 @@ import socket
 import threading
 import time
 import uuid as _uuidlib
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
@@ -307,3 +309,110 @@ def pull_bundle(mgr: DirectTransferManager, frame: KvDirectFrame
                           num_blocks=d.get("n", k.shape[1]),
                           start_layer=d.get("start_layer", 0),
                           total_layers=d.get("total_layers"))
+
+
+# ------------------------------------------------------- KV-restore pulls
+#
+# Stateful migration (docs/robustness.md): the decode worker that inherits
+# a crashed stream pulls the recoverable (prompt ‖ emitted) prefix from a
+# surviving peer's ``kv_pull`` endpoint — served out of the peer's device
+# prefix cache and KVBM G2/G3 tiers (engine.export_blocks) — instead of
+# re-prefilling it. Every failure mode below degrades to recompute with
+# exact token accounting; nothing here can corrupt a stream.
+
+
+@dataclass
+class RestoreConfig:
+    """Worker-side KV-restore policy knobs (``DYN_RESTORE_*`` env).
+
+    ``pull_timeout_cap_s`` bounds ONE pull attempt; the effective timeout
+    is further clamped to half the request's remaining deadline
+    (:func:`restore_pull_timeout`) so a slow pull can never eat the whole
+    budget and then recompute anyway. ``max_blocks``/``max_concurrent``
+    cap the restore burst a worker will absorb — a cold fleet inheriting
+    a dead worker's entire stream set must not thrash its pool or its
+    peers' serving loops with unbounded pulls."""
+
+    enabled: bool = True
+    pull_timeout_cap_s: float = 5.0
+    max_blocks: int = 4096
+    max_concurrent: int = 2
+    #: restores recovering fewer blocks than this are not worth a network
+    #: round trip — recompute instead
+    min_blocks: int = 1
+
+    @classmethod
+    def from_env(cls, env=None) -> "RestoreConfig":
+        env = os.environ if env is None else env
+
+        def _f(key, default, cast):
+            raw = env.get(key)
+            if raw is None or raw == "":
+                return default
+            try:
+                return cast(raw)
+            except ValueError:
+                raise ValueError(f"bad {key}={raw!r}") from None
+
+        return cls(
+            enabled=env.get("DYN_RESTORE", "1") not in ("0", "false", "off"),
+            pull_timeout_cap_s=_f("DYN_RESTORE_PULL_TIMEOUT", 5.0, float),
+            max_blocks=_f("DYN_RESTORE_MAX_BLOCKS", 4096, int),
+            max_concurrent=_f("DYN_RESTORE_MAX_CONCURRENT", 2, int),
+            min_blocks=_f("DYN_RESTORE_MIN_BLOCKS", 1, int),
+        )
+
+
+def restore_pull_timeout(cap_s: float,
+                         remaining_s: Optional[float]) -> Optional[float]:
+    """Effective timeout for one restore pull: ``min(cap, remaining/2)``.
+
+    Half the remaining budget, never more: if the pull times out, the
+    OTHER half still covers the recompute fallback — a restore attempt
+    must never convert a completable request into a deadline miss.
+    Returns None when the budget is already too thin to risk a pull."""
+    if remaining_s is None:
+        return cap_s
+    if remaining_s <= 0.05:
+        return None
+    t = min(cap_s, remaining_s / 2.0)
+    return t if t > 0 else None
+
+
+async def pull_restore_blocks(client, instance_id: int, hashes: list[int],
+                              timeout_s: float) -> list:
+    """Pull a contiguous run of KV blocks from ``instance_id``'s
+    ``kv_pull`` endpoint. Returns ordered [(seq_hash, k, v), ...] — the
+    longest leading run the peer could serve (possibly short, never
+    reordered). Raises on transport failure or timeout; the caller
+    degrades to recompute. Chaos hook ``kv.direct_pull`` injects failures
+    here so the degradation path is provable in tier-1."""
+    from dynamo_tpu.kvbm.distributed import _unpack_block
+    from dynamo_tpu.runtime.chaos import ChaosError, get_chaos
+
+    chaos = get_chaos()
+    if chaos is not None and chaos.should_error("kv.direct_pull"):
+        raise ChaosError("injected kv.direct_pull failure (restore)")
+
+    stream = await client.generate(
+        {"hashes": list(hashes)}, mode="direct", instance_id=instance_id)
+
+    async def consume():
+        out = []
+        async for frame in stream:
+            if not isinstance(frame, dict) or "hash" not in frame:
+                continue
+            out.append(_unpack_block(frame))
+        return out
+
+    try:
+        return await asyncio.wait_for(consume(), timeout=timeout_s)
+    except (asyncio.TimeoutError, asyncio.CancelledError):
+        # tell the serving peer to stop: without the cancel it keeps
+        # gathering and shipping blocks into a dead stream — exactly the
+        # surviving-worker load the restore burst caps exist to bound
+        try:
+            await stream.cancel()
+        except Exception:
+            pass
+        raise

@@ -319,6 +319,14 @@ class BlockPool:
             out.append(bid)
         return out
 
+    def lookup(self, seq_hash: SequenceHash) -> Optional[int]:
+        """Block id currently holding ``seq_hash``'s KV (active or LRU-
+        cached), or None. Read-only — no incref, no LRU touch; callers
+        that gather asynchronously must pin via acquire()/release()."""
+        if not self.enable_prefix_caching:
+            return None
+        return self._by_hash.get(seq_hash)
+
     def register(self, block_id: int, seq_hash: SequenceHash, tokens_hash: int,
                  parent_hash: Optional[SequenceHash]) -> bool:
         """Mark a full block as identified by its hashes (→ reusable).

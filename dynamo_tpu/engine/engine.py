@@ -174,7 +174,11 @@ class AsyncJaxEngine:
             from dynamo_tpu.kvbm import KvbmManager
             self.kvbm = KvbmManager(args.kvbm_host_bytes,
                                     disk_dir=args.kvbm_disk_dir,
-                                    disk_bytes=args.kvbm_disk_bytes)
+                                    disk_bytes=args.kvbm_disk_bytes,
+                                    # router-facing removed events fire
+                                    # only when the LAST tier copy dies
+                                    # (KvbmWorkerService chains onto this)
+                                    on_change=self._on_kvbm_change)
         #: set by engine/main.py when a distributed KVBM fleet is configured
         #: (RemoteKvbm — leader lookup + peer fetch)
         self.kvbm_remote = None
@@ -316,6 +320,7 @@ class AsyncJaxEngine:
         # that can make the next plan() non-empty
         self.pool.on_freed = self._wake.set
         self._task: Optional[asyncio.Task] = None
+        self._loop_ref = None  # captured by _ensure_loop (thread bridges)
         self._closed = False
         self.steps = 0
         #: decode steps executed by the depth-2 pipelined loop (telemetry:
@@ -354,6 +359,16 @@ class AsyncJaxEngine:
         if args.kv_transfer_direct:
             from dynamo_tpu.disagg.transfer import DirectTransferManager
             self.direct_transfer = DirectTransferManager()
+        #: chaos ``worker.kill`` (runtime/chaos.py): True once this engine
+        #: hard-died mid-step. The loop stops WITHOUT failing in-flight
+        #: sinks (a SIGKILLed process completes nothing) — consumers hang
+        #: until lease expiry breaks their streams, which is exactly the
+        #: path stateful migration must survive (docs/robustness.md).
+        self.killed = False
+        #: fired (sync, best-effort) when worker.kill trips: mains use it
+        #: to os._exit(137); in-process fleets to ServeHandle.kill() and
+        #: to stop the worker's lease keepalive
+        self.on_kill: list = []
 
     def direct_capability(self) -> Optional[str]:
         """Annotation a decode worker sends so prefill can offer direct
@@ -960,9 +975,209 @@ class AsyncJaxEngine:
                                                  prefill.logprob, ids, ctx):
             yield out
 
+    # ------------------------------------------------- KV-restore migration
+    #
+    # Stateful migration (docs/robustness.md): a migrated request's
+    # recoverable prefix of (prompt ‖ emitted) is pulled from surviving
+    # peers and attached HERE through the prefix cache — pool.register +
+    # stored events, exactly like a KVBM onboard — so the subsequent
+    # generate() prefix-matches it and recomputes only the tail. The
+    # attach is charge-free by construction: prefix hits never advance
+    # the QoS ledger (scheduler.commit_computed charges computed deltas
+    # only), mirroring the disagg add_prefilled charge=False discipline.
+
+    def restore_probe(self, req: PreprocessedRequest):
+        """Salted TokenBlockSequence over the request's matchable full
+        blocks — the hash chain restore pulls/attaches against. None when
+        restore cannot apply (prefix caching off, or nothing matchable)."""
+        from dynamo_tpu.tokens import TokenBlockSequence
+
+        if not self.args.enable_prefix_caching:
+            return None
+        bs = self.args.block_size
+        # never the whole prompt: at least one token must be computed
+        # locally to produce logits (same rule as _prefix_match)
+        matchable = (len(req.token_ids) - 1) // bs
+        if matchable <= 0:
+            return None
+        return TokenBlockSequence.from_tokens(
+            list(req.token_ids[: matchable * bs]), bs,
+            Scheduler._salt_for(req))
+
+    def resident_prefix_blocks(self, probe) -> int:
+        """Leading blocks of ``probe`` recoverable here WITHOUT a peer
+        pull: device prefix cache, or the G2 host tier that admission's
+        synchronous onboard reads. G3/G4 do NOT count — disk only feeds a
+        background promotion and G4 is a remote index, so treating them
+        as resident would skip pulls the stream actually needed and then
+        re-prefill anyway."""
+        hashes = probe.sequence_hashes()
+        in_host = (self.kvbm.host_resident(hashes)
+                   if self.kvbm is not None else frozenset())
+        n = 0
+        for h in hashes:
+            if self.pool.lookup(h) is None and h not in in_host:
+                break
+            n += 1
+        return n
+
+    def attach_restored(self, probe, start: int, blocks: list) -> int:
+        """Scatter pulled peer blocks into fresh device blocks and REGISTER
+        them (prefix cache + stored events), extending the contiguous
+        restored prefix from block ``start``. ``blocks`` is an ordered
+        [(seq_hash, k, v), ...] run; validation stops at the first torn
+        entry (hash out of order or shape mismatch) — like PR 8's layer
+        tears, a torn bundle is rejected, never half-scattered. Returns
+        how many blocks were attached; 0 leaks nothing."""
+        from dynamo_tpu.engine.cache import (
+            cache_shape, is_quant_cache, packed_block_width,
+        )
+
+        if not blocks:
+            return 0
+        bs = self.args.block_size
+        hashes = probe.sequence_hashes()
+        L, _slots, KV, hd = cache_shape(self.k_cache)
+        quant = is_quant_cache(self.k_cache)
+        want_kv = (L, packed_block_width(bs, KV, hd)) if quant \
+            else (L, bs, KV, hd)
+        ks, vs = [], []
+        for i, (h, k, v) in enumerate(blocks):
+            pos = start + i
+            if pos >= len(hashes) or h != hashes[pos]:
+                logger.warning("restore bundle torn at block %d (hash "
+                               "mismatch); keeping %d blocks", pos, len(ks))
+                break
+            ok = (tuple(k.shape) == want_kv and tuple(v.shape) == want_kv
+                  and (k.dtype == np.uint8 if quant else True))
+            if not ok:
+                logger.warning("restore bundle block %d shape %s mismatches "
+                               "cache %s; truncating", pos, k.shape, want_kv)
+                break
+            ks.append(k)
+            vs.append(v)
+        if not ks:
+            return 0
+        ids = self._scatter_register(probe, start, ks, vs)
+        if ids is None:
+            return 0  # memory pressure / torn scatter: recompute
+        # park in the LRU (refcount 0): generate()'s prefix match re-
+        # acquires them moments later; until then they are ordinary
+        # evictable cache content, so a failed restore leaks nothing
+        self.pool.release(ids)
+        return len(ks)
+
+    def _scatter_register(self, probe, start: int, ks: list, vs: list):
+        """Shared attach protocol for externally-sourced block data
+        (KVBM onboard + KV restore): allocate, scatter per-block k/v
+        stacks into the cache, register each block's hashes, announce
+        ONE chained stored event. Returns the allocated ids (refcount 1,
+        caller decides ownership) or None with nothing leaked."""
+        from dynamo_tpu.ops.block_copy import scatter_blocks
+
+        bs = self.args.block_size
+        ids = self.pool.allocate(len(ks))
+        if ids is None:
+            return None
+        try:
+            self.k_cache = scatter_blocks(self.k_cache, ids,
+                                          np.stack(ks, 1), block_size=bs)
+            self.v_cache = scatter_blocks(self.v_cache, ids,
+                                          np.stack(vs, 1), block_size=bs)
+        except Exception:
+            self.pool.release(ids)
+            logger.exception("block attach scatter failed")
+            return None
+        stored = []
+        parent = (probe.blocks[start].parent_sequence_hash
+                  if start < len(probe.blocks) else None)
+        for i, bid in enumerate(ids):
+            blk = probe.blocks[start + i]
+            if self.pool.register(bid, blk.sequence_hash, blk.block_hash,
+                                  blk.parent_sequence_hash):
+                stored.append(StoredBlock(block_hash=blk.sequence_hash,
+                                          tokens_hash=blk.block_hash))
+        if stored and self.event_cb:  # this worker now owns the blocks
+            self.event_cb(KvCacheEvent.stored(
+                next(self._event_id), parent, stored))
+        return ids
+
+    async def export_blocks(self, hashes: list[int],
+                            max_blocks: Optional[int] = None):
+        """Serve a peer's KV-restore pull: yield (seq_hash, k, v) host
+        arrays for the longest LEADING run of ``hashes`` recoverable here
+        — device prefix cache first (pinned gather, same discipline as
+        the offload path), then own G2/G3 tiers (kvbm.get_local; G4 is
+        never touched — a deadline-bounded pull must not block on the
+        object store). Stops at the first unrecoverable hash: restore
+        attaches contiguous prefixes only."""
+        from dynamo_tpu.ops.block_copy import gather_blocks
+
+        bs = self.args.block_size
+        budget = max_blocks if max_blocks is not None else len(hashes)
+        run: list[tuple[int, int]] = []  # (hash, block_id) device run
+
+        async def flush_run():
+            if not run:
+                return
+            ids = [bid for _, bid in run]
+            self.pool.acquire(ids)  # pin across the async gather
+            try:
+                kb = gather_blocks(self.k_cache, ids, block_size=bs)
+                vb = gather_blocks(self.v_cache, ids, block_size=bs)
+
+                def to_host():
+                    kbh, vbh = np.asarray(kb), np.asarray(vb)
+                    return [(np.ascontiguousarray(kbh[:, i]),
+                             np.ascontiguousarray(vbh[:, i]))
+                            for i in range(len(ids))]
+
+                pairs = await asyncio.to_thread(to_host)
+            finally:
+                self.pool.release(ids)
+            for (h, _bid), (k, v) in zip(run, pairs):
+                yield h, k, v
+            run.clear()
+
+        served = 0
+        for h in hashes:
+            if served >= budget:
+                break
+            bid = self.pool.lookup(h)
+            if bid is not None:
+                run.append((h, bid))
+                served += 1
+                continue
+            async for item in flush_run():
+                yield item
+            e = None
+            if self.kvbm is not None:
+                e = await asyncio.to_thread(self.kvbm.get_local, h)
+            if e is None:
+                break  # contiguity ends here
+            served += 1
+            yield h, e[0], e[1]
+        async for item in flush_run():
+            yield item
+
+    def _hard_kill(self) -> None:
+        """Chaos worker.kill: die like a SIGKILL. No sink resolution, no
+        drain — just stop and tell the owner hooks (which exit the
+        process, or kill serve handles + lease keepalive in-process)."""
+        logger.warning("chaos: worker.kill fired — hard-dying with %d "
+                       "running seqs", len(self.scheduler.running))
+        self.killed = True
+        self._closed = True
+        for cb in list(self.on_kill):
+            try:
+                cb()
+            except Exception:
+                logger.exception("on_kill hook failed")
+
     def _ensure_loop(self) -> None:
+        self._loop_ref = asyncio.get_running_loop()
         if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(self._run())
+            self._task = self._loop_ref.create_task(self._run())
 
     async def close(self) -> None:
         self._closed = True
@@ -988,6 +1203,13 @@ class AsyncJaxEngine:
                 continue
             plan = self.scheduler.plan()
             chaos = _get_chaos()
+            if (chaos is not None and not plan.empty
+                    and chaos.should_error("worker.kill")):
+                # seeded hard death mid-decode (SIGKILL-grade): stop the
+                # loop NOW — no drain, no goodbye, in-flight sinks never
+                # resolve. Streams break only when the lease TTL expires.
+                self._hard_kill()
+                return
             if (chaos is not None and not plan.empty
                     and chaos.should_error("engine.step")):
                 # injected step crash: fail in-flight sequences with a
@@ -2462,8 +2684,6 @@ class AsyncJaxEngine:
         here — np.load inside plan() would stall every in-flight decode —
         instead a background promotion pulls them G3→G2 so the next
         admission of the prefix hits host."""
-        from dynamo_tpu.ops.block_copy import scatter_blocks
-
         hashes = probe.sequence_hashes()[start:end]
         ks, vs = [], []
         for i, h in enumerate(hashes):
@@ -2478,32 +2698,10 @@ class AsyncJaxEngine:
             vs.append(e[1])
         if not ks:
             return []
-        m = len(ks)
-        ids = self.pool.allocate(m)
+        ids = self._scatter_register(probe, start, ks, vs)
         if ids is None:
             return []
-        bs = self.args.block_size
-        try:
-            self.k_cache = scatter_blocks(self.k_cache, ids, np.stack(ks, 1),
-                                          block_size=bs)
-            self.v_cache = scatter_blocks(self.v_cache, ids, np.stack(vs, 1),
-                                          block_size=bs)
-        except Exception:
-            self.pool.release(ids)
-            logger.exception("KVBM onboard scatter failed")
-            return []
-        stored = []
-        parent = probe.blocks[start].parent_sequence_hash if start < len(probe.blocks) else None
-        for i, bid in enumerate(ids):
-            blk = probe.blocks[start + i]
-            if self.pool.register(bid, blk.sequence_hash, blk.block_hash,
-                                  blk.parent_sequence_hash):
-                stored.append(StoredBlock(block_hash=blk.sequence_hash,
-                                          tokens_hash=blk.block_hash))
-        self.kvbm.onboarded_blocks += m
-        if stored and self.event_cb:  # the worker owns these blocks again
-            self.event_cb(KvCacheEvent.stored(
-                next(self._event_id), parent, stored))
+        self.kvbm.onboarded_blocks += len(ks)
         return ids
 
     # ------------------------------------------------------ preempt-to-swap
@@ -2695,8 +2893,56 @@ class AsyncJaxEngine:
             return
         if seq_hashes is None:
             self.event_cb(KvCacheEvent.clear(next(self._event_id)))
-        else:
+            return
+        # fleet-wide KV hierarchy (docs/robustness.md): a device eviction
+        # whose block survives in this worker's G2/G3 tiers is NOT gone —
+        # admission onboards it back and restore pulls serve it
+        # (export_blocks reads exactly host+disk) — so it must stay in
+        # the global radix index. The removed event fires only when the
+        # last LOCALLY-SERVABLE copy dies (here, or via the KVBM bridge
+        # below when the tiers finally evict it). A G4-only block does
+        # NOT suppress the removal: the remote index is not servable by
+        # kv_pull, and advertising it would burn peers' pull attempts.
+        if self.kvbm is not None:
+            seq_hashes = self.kvbm.filter_not_local(seq_hashes)
+        if seq_hashes:
             self.event_cb(KvCacheEvent.removed(next(self._event_id), list(seq_hashes)))
+
+    def _on_kvbm_change(self, stored, removed) -> None:
+        """KvbmManager.on_change bridge: when a hash leaves the LAST KVBM
+        tier and is not device-resident either, announce the removal to
+        the router — without this the radix would keep advertising KV
+        this worker can no longer serve (stale restore sources / inflated
+        overlap). Stored hashes need no event: blocks enter the tiers
+        from the device (offload), which already announced them.
+
+        Known G4 edge: on_change reports removal only when a hash leaves
+        EVERY tier, so a block cascading G3→G4 keeps its radix entry
+        until the G4 copy dies even though kv_pull cannot serve it (the
+        distributed-KVBM fetch endpoint can, which is why the manager's
+        contract is all-tiers). Cost: a peer's restore wastes one pull
+        attempt and fails over; bounded, and only with G4 armed.
+
+        Fired under the manager lock, possibly from an offload worker
+        thread — publishing hops onto the engine's loop when needed
+        (the event task machinery is loop-affine)."""
+        if self.event_cb is None or not removed:
+            return
+
+        def emit():
+            gone = [h for h in removed if self.pool.lookup(h) is None]
+            if gone and self.event_cb is not None:
+                self.event_cb(KvCacheEvent.removed(next(self._event_id),
+                                                   gone))
+
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            loop = self._loop_ref
+            if loop is not None and not loop.is_closed():
+                loop.call_soon_threadsafe(emit)
+            return
+        emit()
 
     def _metrics(self) -> ForwardPassMetrics:
         from dynamo_tpu.engine.model import MOE_DROPS

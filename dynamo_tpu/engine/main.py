@@ -516,6 +516,11 @@ async def amain():
              "sequences preempted (swap or recompute), by tenant/class")):
         runtime.metrics.counter(name, help_).add_callback(_qos_cb(fld))
 
+    # chaos worker.kill = SIGKILL-grade process death: no drain, no lease
+    # revoke — the fleet learns only when the lease TTL expires, which is
+    # what stateful migration + proactive death handling must cover
+    engine.on_kill.append(lambda: os._exit(137))
+
     component = cli.component or (
         "prefill" if cli.role == "prefill" else "backend")
     ns = runtime.namespace(cli.namespace)
@@ -545,10 +550,21 @@ async def amain():
             from dynamo_tpu.multimodal.encoder import ENCODE_COMPONENT
             mm_ep = ns.component(ENCODE_COMPONENT).endpoint("encode")
             mm_client = await mm_ep.client().start()
+        # KV-restore pull sources (docs/robustness.md): peers on our own
+        # component, plus the prefill fleet in a disagg deployment (the
+        # worker that prefilled a crashed stream's prompt holds its KV)
+        pull_clients = [await ns.component(component)
+                        .endpoint("kv_pull").client().start()]
+        if cli.role == "decode":
+            pull_clients.append(
+                await ns.component(cli.prefill_component)
+                .endpoint("kv_pull").client().start())
         handler = DecodeWorkerHandler(engine, prefill_client, dconf,
                                       prefill_queue=prefill_queue,
                                       mm_client=mm_client,
-                                      metrics=runtime.metrics)
+                                      metrics=runtime.metrics,
+                                      pull_clients=pull_clients)
+        handler.instance_id = lease
         serve = handler.generate
         if cli.role == "decode":  # live-tunable threshold (disagg_router.rs)
             from dynamo_tpu.disagg.handlers import DisaggConfigWatcher
@@ -611,6 +627,14 @@ async def amain():
                 worker_id=kvbm_worker.worker_id)
 
     handle = await ep.serve_endpoint(serve, lease_id=lease)
+    # every role serves restore pulls: prefill workers retain prompt KV in
+    # their prefix cache/G2 after extraction, so a crashed decode stream
+    # can rebuild its prompt from the worker that originally prefilled it
+    from dynamo_tpu.disagg.handlers import KvPullHandler
+    pull_handle = await ns.component(component).endpoint(
+        "kv_pull").serve_endpoint(
+        KvPullHandler(engine, metrics=runtime.metrics).generate,
+        lease_id=lease)
     # span buffer query endpoint (observability/collector.py): lets the
     # frontend's /v1/traces/{id} and `dynctl trace` stitch this worker's
     # engine/prefill/KV-transfer spans into the request trace
@@ -726,6 +750,7 @@ async def amain():
         await queue_worker.stop()
     if embed_handle is not None:
         await embed_handle.stop(graceful=False)
+    await pull_handle.stop(graceful=False)
     await clear_handle.stop(graceful=False)
     # SIGTERM drain: deregistration (lease key delete) happens first inside
     # stop(), so routers stop picking this worker; in-flight streams then

@@ -224,6 +224,12 @@ class Scheduler:
     def add(self, seq: SeqState) -> None:
         seq.tokens = list(seq.req.token_ids)
         seq.prompt_len = len(seq.tokens)
+        # PRNG step = ABSOLUTE token position, not per-seq generation
+        # count: a migrated stream re-enters as prompt ‖ emitted, and the
+        # tail must draw the same (seed, step) keys the unbroken run would
+        # have — position-anchored steps make seeded sampling stable
+        # across migration, disagg attach and recompute preemption alike
+        seq.step_idx = seq.prompt_len
         seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
                                         salt_hash=self._salt_for(seq.req))
         self._stamp_qos(seq)
@@ -518,6 +524,7 @@ class Scheduler:
         seq.tokens = list(seq.req.token_ids)
         self._stamp_qos(seq)
         seq.prompt_len = len(seq.tokens)
+        seq.step_idx = seq.prompt_len  # position-anchored PRNG (see add())
         seq.hashes = TokenBlockSequence(block_size=self.args.block_size,
                                         salt_hash=self._salt_for(seq.req))
         seq.block_table = list(block_table)

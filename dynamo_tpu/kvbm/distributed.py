@@ -192,7 +192,18 @@ class KvbmWorkerService:
         self.worker_id: Optional[int] = None
         self._handles = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
-        manager.on_change = self._on_change
+        # CHAIN onto any existing consumer (the engine's radix-removal
+        # bridge) instead of replacing it — both the distributed leader's
+        # ownership map and the router's index need tier-change events
+        prev = manager.on_change
+
+        def chained(stored, removed, _prev=prev):
+            self._on_change(stored, removed)
+            if _prev is not None:
+                _prev(stored, removed)
+
+        manager.on_change = chained
+        self._chained_prev = prev
 
     async def start(self, barrier_timeout: float = 120.0) -> "KvbmWorkerService":
         rt = self.runtime
@@ -270,7 +281,7 @@ class KvbmWorkerService:
             yield {"ok": False, "error": f"unknown op {op!r}"}
 
     async def stop(self):
-        self.manager.on_change = None
+        self.manager.on_change = self._chained_prev  # restore the chain
         for h in self._handles:
             await h.stop(graceful=False)
         self._handles.clear()

@@ -145,6 +145,38 @@ class KvbmManager:
         with self._lock:
             return self.disk is not None and h in self.disk
 
+    def in_host(self, h: int) -> bool:
+        """Host-tier residency WITHOUT an LRU touch — the restore path's
+        'synchronously recoverable here' probe."""
+        with self._lock:
+            return h in self.host
+
+    def in_local(self, h: int) -> bool:
+        """Resident in a tier this worker can actually SERVE (host or
+        disk) — G4 is an index over the shared object store, not local
+        bytes, and neither restore pulls nor admission onboarding read it
+        synchronously."""
+        with self._lock:
+            return (h in self.host
+                    or (self.disk is not None and h in self.disk))
+
+    def host_resident(self, hashes) -> set:
+        """The subset of ``hashes`` in the HOST tier, under one lock
+        acquisition — the restore residency probe walks hundreds of
+        hashes and must not pay a lock round trip per block."""
+        with self._lock:
+            return {h for h in hashes if h in self.host}
+
+    def filter_not_local(self, hashes) -> list[int]:
+        """The subset of ``hashes`` in NO locally-servable tier, under a
+        single lock acquisition — the engine's eviction-event filter runs
+        on its hot loop and a big LRU churn batch must not pay one lock
+        round trip per hash."""
+        with self._lock:
+            return [h for h in hashes
+                    if h not in self.host
+                    and (self.disk is None or h not in self.disk)]
+
     def in_lower_tier(self, h: int) -> bool:
         """Resident below host (G3 disk or G4 remote) — the admission path
         schedules a background promotion for these instead of blocking."""
@@ -267,6 +299,20 @@ class KvbmManager:
         """Host-tier-only lookup — cheap enough for the admission path."""
         with self._lock:
             return self.host.get(h)
+
+    def get_local(self, h: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """G2/G3-only lookup for PEER-SERVING paths (KV-restore pulls,
+        docs/robustness.md): host DRAM, then disk, never G4 — a pull
+        request bounded by a migration deadline must not block on an
+        object-store round trip, and a disk read stays off this worker's
+        own serving hot path only because callers run it in a thread.
+        Disk hits are NOT promoted to host: serving a peer's restore must
+        not churn the local G2 working set."""
+        with self._lock:
+            e = self.host.get(h)
+            if e is None and self.disk is not None:
+                e = self.disk.get(h)
+            return e
 
     def get(self, h: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
         with self._lock:

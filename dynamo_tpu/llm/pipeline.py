@@ -574,6 +574,18 @@ class Migration:
                     mdc_sum=current.mdc_sum,
                     annotations=current.annotations,
                     router_config_override=current.router_config_override,
+                    # multimodal payload MUST ride the re-send: without it
+                    # the new worker decodes placeholder tokens as plain
+                    # text (silently wrong output), and the mm salt in the
+                    # block hashes would no longer match the fleet's KV
+                    mm_embeds=current.mm_embeds,
+                    mm_refs=current.mm_refs,
+                    # stateful migration (docs/robustness.md): mark the
+                    # re-send so the router can attach a KV-restore plan
+                    # and the receiving worker can rebuild the recoverable
+                    # prefix from surviving peers instead of re-prefilling
+                    restore={"emitted": len(accumulated),
+                             "attempt": attempt},
                 )
                 await asyncio.sleep(delay)
 

@@ -180,6 +180,11 @@ class MockEngine:
         self._wake = asyncio.Event()
         self._stopped = False
         self.iterations = 0
+        #: chaos worker.kill (runtime/chaos.py): hard-died mid-step —
+        #: in-flight queues never resolve, death reaches the fleet only
+        #: via lease expiry (same contract as the real engine)
+        self.killed = False
+        self.on_kill: list = []
 
     async def start(self) -> "MockEngine":
         self._task = asyncio.get_running_loop().create_task(self._engine_loop())
@@ -263,6 +268,20 @@ class MockEngine:
     async def _step(self):
         self.iterations += 1
         chaos = get_chaos()
+        if (chaos is not None and self.running
+                and chaos.should_error("worker.kill")):
+            # seeded hard death (SIGKILL-grade): stop the loop without
+            # resolving any in-flight queue — no drain, no goodbye
+            logger.warning("chaos: worker.kill fired — mocker hard-dying "
+                           "with %d running seqs", len(self.running))
+            self.killed = True
+            self._stopped = True
+            for cb in list(self.on_kill):
+                try:
+                    cb()
+                except Exception:
+                    logger.exception("on_kill hook failed")
+            return
         if (chaos is not None and self.running
                 and chaos.should_error("engine.step")):
             # injected step crash: in-flight streams fail RETRYABLY so the

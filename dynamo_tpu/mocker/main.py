@@ -150,6 +150,12 @@ async def amain():
         runtime, cli.model, args, cli.namespace, cli.component,
         migration_limit=cli.migration_limit, topo=topo or None,
     )
+    # chaos worker.kill = SIGKILL-grade process death: no drain, no lease
+    # revoke — the fleet learns only when the lease TTL expires
+    import os as _os
+
+    for engine in engines:
+        engine.on_kill.append(lambda: _os._exit(137))
     print("MOCKER_READY", flush=True)
 
     loop = asyncio.get_running_loop()

@@ -110,6 +110,14 @@ class PreprocessedRequest:
     #: "tokens": n}] — the decode handler fetches embeddings from the
     #: encode component and fills mm_embeds
     mm_refs: Optional[list] = None
+    #: stateful migration (docs/robustness.md): set by Migration on a
+    #: retryable mid-stream re-send ({"emitted": n, "attempt": k}); the KV
+    #: router extends it with a restore plan ({"sources": [[worker_id,
+    #: prefix_blocks, rel_cost], ...], "block_size": bs}) so the receiving
+    #: worker can pull the recoverable prefix of (prompt ‖ emitted) from
+    #: surviving peers instead of re-prefilling it. Absent on the wire for
+    #: every non-migrated request — pre-restore peers interop unchanged.
+    restore: Optional[dict] = None
 
     def mm_digest(self) -> Optional[int]:
         """Stable content hash of the multimodal payload — salts the block
@@ -142,7 +150,12 @@ class PreprocessedRequest:
         return a in self.annotations
 
     def to_wire(self) -> dict:
-        return asdict(self)
+        d = asdict(self)
+        if d.get("restore") is None:
+            # keep non-migrated payloads byte-identical to pre-restore
+            # builds (the field exists only on migration re-sends)
+            d.pop("restore")
+        return d
 
     @staticmethod
     def from_wire(d: dict) -> "PreprocessedRequest":
@@ -160,6 +173,7 @@ class PreprocessedRequest:
             mm_embeds=d.get("mm_embeds"),
             mm_refs=d.get("mm_refs"),
             router_config_override=d.get("router_config_override"),
+            restore=d.get("restore"),
         )
 
 

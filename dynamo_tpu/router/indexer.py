@@ -141,6 +141,31 @@ class RadixTree:
                 out.scores[w] = out.scores.get(w, 0) + 1
         return out
 
+    def prefix_sources(self, local_hashes: list[int]) -> dict[int, int]:
+        """Per-worker CONTIGUOUS-from-root prefix length (in blocks) over
+        the hash chain — the KV-restore query (docs/robustness.md): which
+        surviving workers can serve how much of (prompt ‖ emitted) without
+        recompute. Read-only: unlike find_matches it does not bump
+        frequencies (a restore probe is not a routing popularity signal).
+
+        A worker counts only while its membership is unbroken from the
+        root: a mid-chain hole on that worker would make its deeper blocks
+        unreachable for a contiguous pull."""
+        out: dict[int, int] = {}
+        node = self.root
+        alive: Optional[set] = None
+        for depth, h in enumerate(local_hashes):
+            node = node.children.get(h)
+            if node is None:
+                break
+            alive = (set(node.workers) if alive is None
+                     else alive & node.workers)
+            if not alive:
+                break
+            for w in alive:
+                out[w] = depth + 1
+        return out
+
     # -- snapshot support (restored on router start, ref: subscriber.rs:30-65) --
     def dump_obj(self) -> dict:
         """Walk tree + removal lookup into plain lists (must run while the
@@ -390,6 +415,9 @@ class KvIndexer:
 
         return self.find_matches(compute_block_hash_for_seq(token_ids, self.kv_block_size))
 
+    def prefix_sources(self, local_hashes: list[int]) -> dict[int, int]:
+        return self.tree.prefix_sources(local_hashes)
+
     def remove_worker(self, worker_id: int) -> None:
         self.tree.remove_worker(worker_id)
 
@@ -442,6 +470,10 @@ class ApproxKvIndexer:
         from dynamo_tpu.tokens import compute_block_hash_for_seq
 
         return self.find_matches(compute_block_hash_for_seq(token_ids, self.kv_block_size))
+
+    def prefix_sources(self, local_hashes: list[int]) -> dict[int, int]:
+        self._expire()
+        return self.tree.prefix_sources(local_hashes)
 
     def remove_worker(self, worker_id: int) -> None:
         self.tree.remove_worker(worker_id)

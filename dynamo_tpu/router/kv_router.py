@@ -158,6 +158,30 @@ class KvRouter:
                 seq_hashes=track)
         return decision
 
+    def request_resync(self) -> None:
+        """Ask every worker to re-announce its cache contents (idempotent
+        upserts). Used after a re-registration purge: the discovery watch
+        and the KV event stream are unordered relative to each other, so
+        the purge may have wiped events the worker's NEW life already
+        published — the replay restores them."""
+        if not isinstance(self.indexer, KvIndexer):
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # sync caller outside an event loop (unit tests)
+        task = loop.create_task(self.indexer._request_resync())
+        self._publish_tasks.add(task)
+        task.add_done_callback(self._publish_tasks.discard)
+
+    def restore_sources(self, token_ids: list[int]) -> dict[int, int]:
+        """KV-restore query (docs/robustness.md): per-worker contiguous
+        prefix length (blocks) of ``token_ids`` resident anywhere in the
+        fleet, per the radix index. Dead workers are absent — lease expiry
+        purges them from the tree before Migration re-dispatches."""
+        local = compute_block_hash_for_seq(token_ids, self.block_size)
+        return self.indexer.prefix_sources(local)
+
     def mark_prefill_completed(self, request_id: str):
         self.scheduler.mark_prefill_completed(request_id)
         if self.config.router_replica_sync:
@@ -183,6 +207,10 @@ class KvPushRouter:
     exactly the topology-blind cost function.
     """
 
+    #: restore plans carry at most this many ranked sources — the worker
+    #: tries the best and fails over once; a longer list is dead weight
+    RESTORE_PLAN_SOURCES = 4
+
     def __init__(self, client: Client, router: KvRouter,
                  prefill_client: Optional[Client] = None):
         self.client = client
@@ -192,6 +220,39 @@ class KvPushRouter:
         # memoized (key, costs): the sources×workers sweep only changes
         # when an instance (de)registers, not per routed request
         self._link_cache: Optional[tuple] = None
+        # memoized worker↔worker link costs for restore-plan ranking;
+        # purged (with the radix tree) on lease expiry/deregistration
+        self._peer_cache: Optional[tuple] = None
+        #: instance ids seen deregistering — a later re-registration of
+        #: the SAME id must not resurrect its previous life's KV index
+        #: entries (dead-instance hygiene, docs/robustness.md)
+        self._dead_ids: set[int] = set()
+        add = getattr(client, "add_instance_listener", None)
+        if add is not None:
+            add(self._on_instance_event)
+
+    def _on_instance_event(self, typ: str, instance_id: int) -> None:
+        """Discovery watch events: proactive death handling. On delete
+        (lease expiry / deregistration) the worker's blocks leave the
+        radix tree and the memoized link-cost matrices IMMEDIATELY — a
+        restore plan must never point a pull at a corpse, and Migration
+        re-dispatches the victim's streams the moment the lease lapses."""
+        if typ == "delete":
+            self._dead_ids.add(instance_id)
+            self.router.remove_worker(instance_id)
+            self._link_cache = None
+            self._peer_cache = None
+        elif instance_id in self._dead_ids:
+            # re-registered id: purge whatever its previous life left in
+            # the tree BEFORE the new life's events repopulate it. The
+            # watch and the event stream are unordered, so the purge may
+            # also catch events the new life already published — ask for
+            # a replay (idempotent upserts) to restore those.
+            self._dead_ids.discard(instance_id)
+            self.router.remove_worker(instance_id)
+            self._link_cache = None
+            self._peer_cache = None
+            self.router.request_resync()
 
     def _link_costs(self) -> Optional[dict[int, float]]:
         """Per-decode-worker relative KV-transfer cost from the prefill
@@ -222,6 +283,56 @@ class KvPushRouter:
             costs = link_costs(sources, workers, self._topo_model)
         self._link_cache = (key, costs)
         return costs
+
+    def _peer_costs(self) -> dict[int, "object"]:
+        """Memoized worker-id → TopologyLabels map for restore-plan source
+        ranking (worker↔worker, unlike _link_costs' prefill→worker sweep).
+        Instance identity is the change detector, same as _link_costs."""
+        from dynamo_tpu.router.topology import TopologyLabels
+
+        insts = self.client.instances()
+        key = tuple(map(id, insts))
+        if self._peer_cache is not None and self._peer_cache[0] == key:
+            return self._peer_cache[1]
+        labels = {i.instance_id: TopologyLabels.from_metadata(i.metadata)
+                  for i in insts}
+        self._peer_cache = (key, labels)
+        return labels
+
+    def _restore_plan(self, req: PreprocessedRequest, worker_id: int) -> None:
+        """Extend a migrated request's restore hint with ranked pull
+        sources: the longest recoverable prefix first, topology-cheapest
+        link breaking ties (NetKV-style source selection). The chosen
+        worker itself is excluded — whatever it holds is a local prefix
+        hit, not a pull."""
+        from dynamo_tpu.router.topology import (
+            TopologyCostModel, TopologyLabels, link_class,
+        )
+
+        sources = self.router.restore_sources(req.token_ids)
+        sources.pop(worker_id, None)
+        if not sources:
+            req.restore = {**req.restore,
+                           "block_size": self.router.block_size,
+                           "sources": []}
+            return
+        labels = self._peer_costs()
+        if self._topo_model is None:
+            self._topo_model = TopologyCostModel(self.router.config.link_gbps)
+        dst = labels.get(worker_id) or TopologyLabels()
+        empty = TopologyLabels()
+        ranked = sorted(
+            ((wid, blocks,
+              self._topo_model.rel_cost(link_class(
+                  labels.get(wid) or empty, dst)))
+             for wid, blocks in sources.items()),
+            key=lambda t: (-t[1], t[2], t[0]))
+        req.restore = {
+            **req.restore,
+            "block_size": self.router.block_size,
+            "sources": [[wid, blocks, cost] for wid, blocks, cost
+                        in ranked[:self.RESTORE_PLAN_SOURCES]],
+        }
 
     async def generate(self, req: PreprocessedRequest, ctx: Context) -> AsyncIterator:
         if isinstance(req, dict):
@@ -273,6 +384,17 @@ class KvPushRouter:
             return
 
         req.estimated_prefix_hit_num_blocks = decision.overlap_blocks
+        if req.restore is not None and "sources" not in req.restore:
+            # migrated request: attach the KV-restore plan for the chosen
+            # worker (docs/robustness.md) so it can pull the recoverable
+            # prefix from surviving peers instead of re-prefilling
+            with get_tracer().span("router.restore_plan", ctx,
+                                   service="router") as rsp:
+                self._restore_plan(req, decision.worker_id)
+                rsp.set(sources=len(req.restore.get("sources") or []),
+                        best_blocks=max(
+                            (s[1] for s in req.restore["sources"]),
+                            default=0))
         async for item in self._stream_to(req, ctx, decision.worker_id, decision):
             yield item
 

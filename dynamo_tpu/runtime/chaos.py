@@ -14,7 +14,8 @@ Spec grammar (``DYN_CHAOS``)::
     spec    := entry (';' entry)*
     entry   := hook ':' action (',' action)*
     hook    := 'plane.publish' | 'stream.send' | 'request.dispatch'
-             | 'engine.step'   (free-form: unknown hooks parse but never fire)
+             | 'engine.step' | 'kv.direct_pull' | 'worker.kill'
+               (free-form: unknown hooks parse but never fire)
     action  := 'drop=' PROB | 'error=' PROB | 'delay=' DURATION
     PROB    := float in [0, 1]
     DURATION:= float with optional 'ms' or 's' suffix (default ms)
@@ -30,6 +31,19 @@ Semantics per hook:
   / exploding handler).
 - ``delay`` — sleep before the operation (models a slow network / stalled
   worker; only applied at async hooks).
+
+Two hooks have special-case semantics:
+
+- ``kv.direct_pull:error=P`` — a disagg direct KV pull or a migration
+  restore pull fails; the puller degrades to host-staged placement or
+  local recompute with exact token accounting (docs/robustness.md).
+- ``worker.kill:error=P`` — rolled once per engine/mocker step while work
+  is in flight; on fire the worker hard-dies SIGKILL-grade: the loop
+  stops mid-decode, in-flight streams are never completed, no drain, no
+  deregistration — death reaches the fleet only through lease expiry.
+  Subprocess workers ``os._exit(137)``; in-process workers tear down
+  their serve handles via ``ServeHandle.kill()`` and stop refreshing
+  their lease.
 
 Determinism: one ``random.Random(seed)`` (``DYN_CHAOS_SEED``, default 0)
 drives every roll in hook-call order, so a fixed workload + fixed spec +

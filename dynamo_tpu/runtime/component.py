@@ -129,6 +129,24 @@ class ServeHandle:
         self._inflight = inflight
         self._stopped = asyncio.Event()
 
+    async def _deregister(self, kv_delete: bool):
+        """Shared teardown for stop() and kill(): forget the replay
+        record, stop serving, drop the in-process short-circuit entry.
+        ``kv_delete`` is the goodbye — stop() says it, kill() leaves the
+        instance key to die with the lease TTL."""
+        rt = self.endpoint._runtime
+        ns = self.endpoint.component.namespace.name
+        comp = self.endpoint.component.name
+        ep = self.endpoint.name
+        key = instance_key(ns, comp, ep, self.lease_id)
+        rt.drop_registration(key)
+        if kv_delete:
+            await rt.plane.kv_delete(key)
+        if self._cancel_serve:
+            await self._cancel_serve()
+        rt._local_endpoints.pop(
+            instance_subject(ns, comp, ep, self.lease_id), None)
+
     async def stop(self, graceful: bool = True,
                    timeout: Optional[float] = None):
         """Deregister, then (graceful) wait for in-flight streams to finish.
@@ -137,26 +155,7 @@ class ServeHandle:
         mains): streams still running when it expires are cancelled instead
         of holding shutdown hostage.
         """
-        rt = self.endpoint._runtime
-        key = instance_key(
-            self.endpoint.component.namespace.name,
-            self.endpoint.component.name,
-            self.endpoint.name,
-            self.lease_id,
-        )
-        rt.drop_registration(key)
-        await rt.plane.kv_delete(key)
-        if self._cancel_serve:
-            await self._cancel_serve()
-        rt._local_endpoints.pop(
-            instance_subject(
-                self.endpoint.component.namespace.name,
-                self.endpoint.component.name,
-                self.endpoint.name,
-                self.lease_id,
-            ),
-            None,
-        )
+        await self._deregister(kv_delete=True)
         if graceful and self._inflight:
             tasks = list(self._inflight)
             if timeout is not None:
@@ -174,6 +173,16 @@ class ServeHandle:
 
     async def wait(self):
         await self._stopped.wait()
+
+    async def kill(self):
+        """SIGKILL-grade in-process death (chaos ``worker.kill``): stop
+        serving and drop the local short-circuit entry WITHOUT deleting
+        the instance key, draining, or completing in-flight streams —
+        exactly what a killed process looks like from outside. Discovery
+        learns of the death only when the lease TTL expires, which is the
+        path proactive death handling (docs/robustness.md) must cover."""
+        await self._deregister(kv_delete=False)
+        self._stopped.set()
 
 
 class Endpoint:
@@ -380,12 +389,106 @@ class Client:
         self._watch_task: Optional[asyncio.Task] = None
         self._ready = asyncio.Event()
         self._rr = 0
+        #: instance_id -> live ResponseReceivers streaming FROM it. When
+        #: the instance's key is deleted (lease expiry / deregistration)
+        #: each live stream gets a GRACE window (worker_lost_grace): a
+        #: gracefully-draining worker deregisters first and keeps
+        #: streaming — its frames keep arriving and the stream completes
+        #: untouched — while a lease-expired corpse's stream stays silent
+        #: and is failed RETRYABLY when the window closes, so Migration
+        #: fires on the lease TTL instead of a long transport timeout
+        #: (docs/robustness.md "proactive death handling"). A SIGKILLed
+        #: remote worker's TCP reset usually beats this; the in-process
+        #: short-circuit path and a silently-wedged worker have no other
+        #: death signal at all.
+        self._live_streams: dict[int, set] = {}
+        self._lost_grace = max(
+            0.0, getattr(runtime.config, "worker_lost_grace", 5.0))
+        self._break_tasks: set = set()  # strong refs for grace monitors
+        #: listeners fn(typ, instance_id) fired on discovery put/delete —
+        #: the KV router purges radix/link-cost state through this
+        self._instance_listeners: list = []
         # Trailing ':' so an endpoint name that is a prefix of a sibling
         # ("gen" vs "generate") cannot absorb the sibling's instances.
         self._prefix = (
             f"{INSTANCE_ROOT}/{endpoint.component.namespace.name}/"
             f"{endpoint.component.name}/{endpoint.name}:"
         )
+
+    def add_instance_listener(self, fn) -> None:
+        """Register fn(typ, instance_id) for discovery events ('put' on
+        registration, 'delete' on lease expiry/deregistration)."""
+        self._instance_listeners.append(fn)
+
+    def _track_stream(self, instance_id: int, receiver) -> None:
+        live = self._live_streams.setdefault(instance_id, set())
+        live.add(receiver)
+
+        def done(iid=instance_id, r=receiver):
+            s = self._live_streams.get(iid)
+            if s is not None:
+                s.discard(r)
+                if not s:
+                    self._live_streams.pop(iid, None)
+
+        receiver.on_done = done
+
+    def _break_streams(self, instance_id: int) -> None:
+        live = self._live_streams.pop(instance_id, None)
+        if not live:
+            return
+        if self._lost_grace <= 0:
+            for r in live:
+                self._fail_stream(r, instance_id)
+            return
+        logger.warning(
+            "instance %x deregistered with %d live streams; breaking any "
+            "still silent after %.1fs", instance_id, len(live),
+            self._lost_grace)
+        task = asyncio.get_running_loop().create_task(
+            self._grace_break(instance_id, live))
+        self._break_tasks.add(task)
+        task.add_done_callback(self._break_tasks.discard)
+
+    @staticmethod
+    def _fail_stream(r, instance_id: int) -> None:
+        r.fail(f"instance {instance_id:x} deregistered (lease lost)",
+               retryable=True, code="worker_lost")
+
+    #: extra silent windows granted to a stream that has produced NO
+    #: frames yet: a drain-accepted request mid-prefill (or mid-XLA-
+    #: compile) legitimately emits nothing for a TTFT-scale interval,
+    #: which one decode-scale window would misread as death. A stream
+    #: that HAS streamed and goes silent is dead after one window.
+    PRE_FIRST_FRAME_WINDOWS = 4
+
+    async def _grace_break(self, instance_id: int, live: set) -> None:
+        """Fail only streams with NO frame arrivals across a grace
+        window: a draining worker's streams keep producing (and complete
+        on their own); a dead worker's are silent since the kill. Streams
+        still active keep being watched — a worker dying MID-drain must
+        not leave them hanging forever (iteration cap is a backstop far
+        above any drain timeout)."""
+        marks = {r: (r.activity(), 0) for r in live}
+        for _ in range(240):
+            await asyncio.sleep(self._lost_grace)
+            nxt = {}
+            for r, (mark, silent) in marks.items():
+                if r.on_done is None:
+                    continue  # stream finished cleanly
+                act = r.activity()
+                if act != mark:
+                    nxt[r] = (act, 0)  # producing: re-watch
+                    continue
+                silent += 1
+                budget = (self.PRE_FIRST_FRAME_WINDOWS if act == 0 else 1)
+                if silent >= budget:
+                    self._fail_stream(r, instance_id)
+                else:
+                    nxt[r] = (mark, silent)
+            marks = nxt
+            if not marks:
+                return
 
     async def start(self) -> "Client":
         self._watch = await self._runtime.plane.watch_prefix(self._prefix)
@@ -430,6 +533,14 @@ class Client:
             self._down.discard(iid)
             self._fail_streak.pop(iid, None)
             self._half_open.discard(iid)
+            # the authoritative death signal: break every stream still
+            # flowing from this instance so migration starts NOW
+            self._break_streams(iid)
+        for fn in self._instance_listeners:
+            try:
+                fn("put" if typ == "put" else "delete", iid)
+            except Exception:
+                logger.exception("instance listener failed for %x", iid)
 
     def instance_ids(self) -> list[int]:
         return sorted(self._instances)
@@ -615,6 +726,7 @@ class Client:
             inflight.add(task)
             task.add_done_callback(inflight.discard)
             self.record_success(inst.instance_id)
+            self._track_stream(inst.instance_id, receiver)
             return receiver
 
         server = await rt.response_server()
@@ -645,4 +757,5 @@ class Client:
                 resp.get("error", STREAM_ERR_MSG), resp.get("code"),
                 resp.get("retryable", True))
         self.record_success(inst.instance_id)
+        self._track_stream(inst.instance_id, receiver)
         return receiver

@@ -130,6 +130,14 @@ class RuntimeConfig:
     #: graceful SIGTERM drain bound (seconds): in-flight streams get this
     #: long to finish before shutdown forces them
     drain_timeout: float = 30.0
+    #: proactive death handling (docs/robustness.md): after an instance's
+    #: discovery key is deleted, a live stream from it is failed RETRYABLY
+    #: once it has produced no frames for this long. The grace window is
+    #: what distinguishes a gracefully-DRAINING worker (deregisters first,
+    #: keeps streaming until done — its streams must not be broken) from a
+    #: lease-expired corpse (streams silent since death). 0 = break
+    #: immediately on the delete event.
+    worker_lost_grace: float = 5.0
 
     def __post_init__(self):
         if self.busy_threshold is not None and not 0 < self.busy_threshold <= 1:
@@ -158,6 +166,9 @@ class RuntimeConfig:
                 "config field 'circuit_threshold': must be >= 1")
         if self.drain_timeout <= 0:
             raise ConfigError("config field 'drain_timeout': must be > 0")
+        if self.worker_lost_grace < 0:
+            raise ConfigError(
+                "config field 'worker_lost_grace': must be >= 0")
 
     # -- layered loading -----------------------------------------------------
 

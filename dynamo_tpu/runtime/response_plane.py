@@ -81,24 +81,63 @@ class ResponseReceiver:
     def __init__(self, queue: "asyncio.Queue[Any]", on_cancel=None):
         self._queue = queue
         self._on_cancel = on_cancel
+        #: fired once when the stream terminates (complete/err) or the
+        #: consumer abandons it — lets a Client deregister this stream
+        #: from its per-instance liveness tracking (proactive death
+        #: handling, docs/robustness.md)
+        self.on_done = None
+        #: frames CONSUMED so far; with the queue depth this gives a
+        #: monotonic arrived-frame counter (activity()) — the liveness
+        #: signal the worker-lost grace window compares across time
+        self._consumed = 0
+
+    def activity(self) -> int:
+        """Monotonic count of frames that have ARRIVED on this stream
+        (consumed + still queued) — unchanged across a grace window means
+        the producer is dead, not draining."""
+        return self._consumed + self._queue.qsize()
 
     def __aiter__(self) -> AsyncIterator[Any]:
         return self._iter()
 
+    def _done(self):
+        cb, self.on_done = self.on_done, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.exception("stream on_done callback failed")
+
+    def fail(self, msg: str, retryable: bool = True,
+             code: Optional[str] = None) -> None:
+        """Terminate the stream from the REQUESTER side with a typed error
+        frame (e.g. the producing instance's lease expired — the worker
+        will never send a terminal frame itself). Sentinel delivery drops
+        buffered data if the queue is full; exact token accounting is the
+        Migration layer's job via its accumulated-token replay."""
+        frame = {"t": "err", "msg": msg, "retryable": retryable}
+        if code is not None:
+            frame["code"] = code
+        _put_sentinel(self._queue, frame)
+
     async def _iter(self):
-        while True:
-            frame = await self._queue.get()
-            t = frame.get("t")
-            if t == "data":
-                yield frame.get("d")
-            elif t == "complete":
-                return
-            elif t == "err":
-                # typed rehydration: the error class (and so Migration's
-                # retry decision) survives the wire hop
-                raise stream_error_from_wire(
-                    frame.get("msg", STREAM_ERR_MSG), frame.get("code"),
-                    frame.get("retryable", True))
+        try:
+            while True:
+                frame = await self._queue.get()
+                self._consumed += 1
+                t = frame.get("t")
+                if t == "data":
+                    yield frame.get("d")
+                elif t == "complete":
+                    return
+                elif t == "err":
+                    # typed rehydration: the error class (and so Migration's
+                    # retry decision) survives the wire hop
+                    raise stream_error_from_wire(
+                        frame.get("msg", STREAM_ERR_MSG), frame.get("code"),
+                        frame.get("retryable", True))
+        finally:
+            self._done()
 
     async def cancel(self):
         """Tell the producing worker to stop."""

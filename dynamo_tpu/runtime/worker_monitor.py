@@ -49,6 +49,12 @@ class WorkerMonitor:
     per-model monitors would duplicate all of it and cross-pollute busy
     sets); each client filters the set against its own instances."""
 
+    #: how long a purged worker's id stays tombstoned: metrics published
+    #: before death but delivered after must not resurrect its load state
+    #: (a resurrected entry would sit in the busy set forever — the dead
+    #: worker publishes no further metrics to clear it)
+    DEAD_TTL_S = 30.0
+
     def __init__(self, client=None, busy_threshold: float = DEFAULT_BUSY_THRESHOLD,
                  plane=None):
         if plane is None:
@@ -61,6 +67,30 @@ class WorkerMonitor:
         self._model_watch = None
         self._tasks: list[asyncio.Task] = []
         self._busy: list[int] = []
+        #: lease -> monotonic purge time (dead-instance hygiene)
+        self._dead: dict[int, float] = {}
+
+    def purge(self, lease: int) -> None:
+        """Drop a dead worker's load state from the busy computation and
+        tombstone its id against late metrics (docs/robustness.md
+        dead-instance hygiene). Idempotent; also called by the models/
+        watch on key deletion."""
+        import time as _time
+
+        self.load_states.pop(lease, None)
+        self._dead[lease] = _time.monotonic() + self.DEAD_TTL_S
+        self._recompute()
+
+    def _is_dead(self, lease: int) -> bool:
+        import time as _time
+
+        exp = self._dead.get(lease)
+        if exp is None:
+            return False
+        if exp < _time.monotonic():
+            del self._dead[lease]
+            return False
+        return True
 
     def register_client(self, client) -> None:
         if client not in self._clients:
@@ -109,13 +139,13 @@ class WorkerMonitor:
         except (IndexError, ValueError):
             return
         if ev_type == "delete":
-            self.load_states.pop(lease, None)
-            self._recompute()
+            self.purge(lease)
             return
         try:
             d = msgpack.unpackb(value, raw=False)
         except Exception:
             return
+        self._dead.pop(lease, None)  # re-registered: live again
         card = (d.get("card") or {}) if isinstance(d, dict) else {}
         total = (card.get("runtime_config") or {}).get("total_kv_blocks")
         st = self.load_states.setdefault(lease, WorkerLoadState())
@@ -132,6 +162,8 @@ class WorkerMonitor:
                 except Exception:
                     logger.exception("bad kv_metrics payload ignored")
                     continue
+                if self._is_dead(worker):
+                    continue  # late publish from a purged worker
                 st = self.load_states.setdefault(worker, WorkerLoadState())
                 st.kv_active_blocks = metrics.kv_stats.kv_active_blocks
                 self._recompute()

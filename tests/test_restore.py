@@ -1,0 +1,717 @@
+"""KV-restore migration (stateful migration, ISSUE 10 / docs/robustness.md):
+a decode worker dying mid-stream breaks its streams on LEASE EXPIRY (not a
+transport timeout); Migration re-issues with a restore hint; the router
+attaches a plan of surviving sources from the radix index; the receiving
+worker pulls the recoverable (prompt ‖ emitted) prefix over ``kv_pull`` and
+recomputes only the unrecoverable tail — bit-identical to an unbroken run,
+degrading to plain recompute with exact token accounting on every failure.
+"""
+
+import asyncio
+import dataclasses
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.handlers import DecodeWorkerHandler, KvPullHandler
+from dynamo_tpu.disagg.transfer import RestoreConfig, restore_pull_timeout
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.llm.pipeline import Migration, is_event
+from dynamo_tpu.protocols import (LLMEngineOutput, PreprocessedRequest,
+                                  SamplingOptions, StopConditions)
+from dynamo_tpu.router.indexer import RadixTree
+from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
+from dynamo_tpu.router.protocols import (KvCacheEvent, KvRouterConfig,
+                                         RouterEvent, StoredBlock)
+from dynamo_tpu.router.publisher import KvEventPublisher
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.chaos import configure_chaos
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context, StreamError
+
+pytestmark = pytest.mark.anyio
+
+BS = 4
+CFG = ModelConfig.tiny()
+VOCAB = CFG.vocab_size
+
+
+def eargs(**kw):
+    base = dict(block_size=BS, num_blocks=256, max_num_seqs=8,
+                max_num_batched_tokens=256, max_model_len=512,
+                enable_prefix_caching=True)
+    base.update(kw)
+    return EngineArgs(**base)
+
+
+def req(tokens, osl, seed=None, temp=0.0, pin=None):
+    return PreprocessedRequest(
+        model="m", token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=temp, seed=seed),
+        backend_instance_id=pin)
+
+
+async def _settle(check, timeout=8.0, msg="condition never settled"):
+    for _ in range(int(timeout / 0.05)):
+        if check():
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError(msg)
+
+
+# --------------------------------------------------------------- fleet rig
+
+
+async def make_fleet(n=2, lease_ttl=5.0, engine_kw=None, restore_cfg=None):
+    """n decode workers (own runtime/lease each) + a KV-routed frontend
+    pipeline, all over one in-process control plane with REAL response-
+    plane sockets between runtimes (so a killed worker's streams hang
+    exactly like a SIGKILLed process's would)."""
+    cfg = RuntimeConfig(lease_ttl=lease_ttl, worker_lost_grace=0.4)
+    rt = await DistributedRuntime.create(config=cfg)
+    fleet = SimpleNamespace(rt=rt, workers=[], infos=[])
+    for _ in range(n):
+        wrt = await DistributedRuntime.create(plane=rt.plane,
+                                              owns_plane=False, config=cfg)
+        lease = await wrt.primary_lease()
+        # off the loop: a blocking construct would starve the keepalives
+        # of already-built workers and fake a lease expiry mid-test
+        eng = await asyncio.to_thread(
+            AsyncJaxEngine, CFG, eargs(**(engine_kw or {})))
+        pub = KvEventPublisher(wrt.plane, worker_id=lease, kv_block_size=BS)
+        await pub.start_resync_responder()
+        eng.event_cb = pub.publish_sync
+        comp = wrt.namespace("dynamo").component("backend")
+        pull_client = await comp.endpoint("kv_pull").client().start()
+        handler = DecodeWorkerHandler(
+            eng, metrics=wrt.metrics, pull_clients=[pull_client],
+            restore_config=restore_cfg)
+        handler.instance_id = lease
+
+        async def spy(r, c, _h=handler):
+            out = await DecodeWorkerHandler._restore_migrated(_h, r, c)
+            fleet.infos.append(out)
+            return out
+
+        handler._restore_migrated = spy
+        h_gen = await comp.endpoint("generate").serve_endpoint(
+            handler.generate, lease_id=lease)
+        h_pull = await comp.endpoint("kv_pull").serve_endpoint(
+            KvPullHandler(eng).generate, lease_id=lease)
+        fleet.workers.append(SimpleNamespace(
+            rt=wrt, engine=eng, lease=lease, handler=handler, pub=pub,
+            handles=[h_gen, h_pull], pull_client=pull_client, killed=False))
+    client = await (rt.namespace("dynamo").component("backend")
+                    .endpoint("generate").client().start())
+    router = await KvRouter(rt.plane, BS, KvRouterConfig()).start()
+    fleet.client = client
+    fleet.router = router
+    fleet.push = KvPushRouter(client, router)
+    fleet.mig = Migration(fleet.push.generate, migration_limit=3)
+    return fleet
+
+
+async def kill_worker(w):
+    """SIGKILL-grade in-process death: serving stops, the engine loop
+    freezes with its sinks unresolved, the lease keepalive dies — the
+    fleet learns only when the lease TTL expires."""
+    w.killed = True
+    w.engine._closed = True
+    w.engine._wake.set()
+    for h in w.handles:
+        await h.kill()
+    if w.rt._keepalive_task is not None:
+        w.rt._keepalive_task.cancel()
+
+
+async def stop_fleet(fleet):
+    configure_chaos(None)
+    await fleet.router.stop()
+    await fleet.client.stop()
+    for w in fleet.workers:
+        for h in w.handles:
+            if not w.killed:
+                await h.stop(graceful=False)
+        await w.pull_client.stop()
+        await w.pub.stop()
+        if not w.killed:
+            await w.engine.close()
+        await w.rt.shutdown()
+    await fleet.rt.shutdown()
+
+
+async def seed_prefix(fleet, prefix, workers=None, salt=900):
+    """Selected workers compute (and prefix-register) the shared prefix via
+    a pinned 1-token request; waits until the radix index knows them."""
+    workers = fleet.workers if workers is None else workers
+    for i, w in enumerate(workers):
+        r = req(list(prefix) + [salt + i], 1, pin=w.lease)
+        async for _ in fleet.mig.generate(r, Context()):
+            pass
+    want = len(prefix) // BS
+
+    def indexed():
+        src = fleet.router.restore_sources(list(prefix))
+        return all(src.get(w.lease, 0) >= want for w in workers)
+
+    await _settle(indexed, msg="radix index never learned the seed prefix")
+
+
+async def run_stream(fleet, r, ctx=None, kill_at=None, after_kill=None):
+    """Drive one stream through Migration; optionally kill the serving
+    worker once ``kill_at`` tokens have been emitted (then run the
+    ``after_kill`` hook — e.g. re-steer the busy set)."""
+    ctx = ctx or Context()
+    toks = []
+    killed = False
+    async for out in fleet.mig.generate(r, ctx):
+        if is_event(out):
+            continue
+        toks.extend(out.token_ids)
+        if kill_at is not None and not killed and len(toks) >= kill_at:
+            victims = [w for w in fleet.workers
+                       if not w.killed and w.engine.scheduler.running]
+            assert victims, "no worker is serving the stream"
+            await kill_worker(victims[0])
+            killed = True
+            if after_kill is not None:
+                after_kill()
+    return toks, killed
+
+
+def steer_to(fleet, target):
+    """Mark every OTHER live worker busy so routing picks ``target``."""
+    fleet.client.set_busy_instances(
+        [w.lease for w in fleet.workers
+         if not w.killed and w is not target])
+
+
+async def reference_tokens(r):
+    """The unbroken run, on a standalone engine with identical weights
+    (same cfg + seed → deterministic init on CPU)."""
+    eng = await asyncio.to_thread(AsyncJaxEngine, CFG, eargs())
+    toks = []
+    async for out in eng.generate(dataclasses.replace(
+            r, backend_instance_id=None), Context()):
+        toks.extend(out.token_ids)
+    await eng.close()
+    return toks
+
+
+def fleet_restore_stats(fleet):
+    restored = sum(i.get("restored_blocks", 0) for i in fleet.infos)
+    outcomes = [i["outcome"] for i in fleet.infos]
+    return restored, outcomes
+
+
+# ------------------------------------------------------------------ units
+
+
+def _stored_event(eid, parent, hashes, locals_):
+    blocks = [StoredBlock(block_hash=h, tokens_hash=l)
+              for h, l in zip(hashes, locals_)]
+    return KvCacheEvent.stored(eid, parent, blocks)
+
+
+def test_radix_prefix_sources_contiguity():
+    tree = RadixTree()
+    # worker 1 holds blocks 0..3; worker 2 holds 0..1; worker 3 holds a
+    # mid-chain run only (anchored under worker 1's chain)
+    tree.apply_event(RouterEvent(1, _stored_event(
+        1, None, [10, 11, 12, 13], [100, 101, 102, 103])))
+    tree.apply_event(RouterEvent(2, _stored_event(
+        2, None, [20, 21], [100, 101])))
+    tree.apply_event(RouterEvent(3, _stored_event(
+        3, 11, [32, 33], [102, 103])))
+    src = tree.prefix_sources([100, 101, 102, 103])
+    assert src == {1: 4, 2: 2}
+    # read-only: no frequency bumps
+    assert tree.find_matches([100]).frequencies == [1]
+
+
+def test_restore_pull_timeout_clamp():
+    # no deadline → the cap; generous budget → half of it; thin → None
+    assert restore_pull_timeout(5.0, None) == 5.0
+    assert restore_pull_timeout(5.0, 8.0) == 4.0
+    assert restore_pull_timeout(1.0, 8.0) == 1.0
+    assert restore_pull_timeout(5.0, 0.01) is None
+    assert restore_pull_timeout(5.0, -1.0) is None
+
+
+async def test_migration_sets_restore_hint():
+    calls = []
+
+    async def downstream(r, ctx):
+        calls.append(r)
+        if len(calls) == 1:
+            yield LLMEngineOutput(token_ids=[5, 6])
+            raise StreamError("boom", retryable=True)
+        yield LLMEngineOutput(token_ids=[7], finish_reason="length")
+
+    mig = Migration(downstream, migration_limit=2)
+    toks = []
+    async for out in mig.generate(req(list(range(8)), 8), Context()):
+        toks.extend(out.token_ids)
+    assert toks == [5, 6, 7]
+    assert calls[0].restore is None
+    assert calls[1].restore == {"emitted": 2, "attempt": 1}
+    assert calls[1].token_ids == list(range(8)) + [5, 6]
+
+
+def _stub_push_router():
+    class StubClient:
+        def __init__(self):
+            self.listener = None
+
+        def add_instance_listener(self, fn):
+            self.listener = fn
+
+        def instances(self):
+            return []
+
+    router = KvRouter(None, BS, KvRouterConfig(use_kv_events=False))
+    client = StubClient()
+    return KvPushRouter(client, router), router, client
+
+
+def test_dead_instance_purges_radix_and_reregistration_is_clean():
+    push, router, client = _stub_push_router()
+    tokens = list(range(4 * BS))
+    router.indexer.process_routing_decision_for_request(tokens, 7)
+    assert router.restore_sources(tokens).get(7, 0) > 0
+    # lease expiry → delete event → the worker's blocks leave the tree
+    client.listener("delete", 7)
+    assert router.restore_sources(tokens) == {}
+    # a stale replay repopulates the tree while the id is dead...
+    router.indexer.process_routing_decision_for_request(tokens, 7)
+    # ...then the SAME id re-registers: stale entries must NOT resurrect
+    client.listener("put", 7)
+    assert router.restore_sources(tokens) == {}
+    # events from the new life land normally
+    router.indexer.process_routing_decision_for_request(tokens, 7)
+    assert router.restore_sources(tokens).get(7, 0) > 0
+
+
+def test_worker_monitor_purge_tombstones_late_metrics():
+    from dynamo_tpu.runtime.worker_monitor import (WorkerLoadState,
+                                                   WorkerMonitor)
+
+    class StubClient:
+        def __init__(self):
+            self.busy = None
+
+        def set_busy_instances(self, ids):
+            self.busy = set(ids)
+
+    mon = WorkerMonitor(plane=object())
+    c = StubClient()
+    mon.register_client(c)
+    mon.load_states[5] = WorkerLoadState(kv_active_blocks=99,
+                                         kv_total_blocks=100)
+    mon._recompute()
+    assert c.busy == {5}
+    mon.purge(5)
+    assert c.busy == set()
+    assert mon._is_dead(5)  # late kv_metrics for 5 are now ignored
+    # re-registration clears the tombstone
+    mon._dead[5] = time.monotonic() - 1.0
+    assert not mon._is_dead(5)
+
+
+async def test_pull_timeout_respects_deadline(monkeypatch):
+    """The restore pull budget is min(cap, remaining/2) — a slow pull must
+    never eat the whole deadline and then recompute anyway."""
+    import dynamo_tpu.disagg.transfer as T
+
+    seen = {}
+
+    async def fake_pull(client, iid, hashes, timeout_s):
+        seen["timeout"] = timeout_s
+        return []
+
+    monkeypatch.setattr(T, "pull_restore_blocks", fake_pull)
+    eng = AsyncJaxEngine(CFG, eargs())
+
+    class OneInstanceClient:
+        def instance(self, iid):
+            return object()
+
+    h = DecodeWorkerHandler(eng, pull_clients=[OneInstanceClient()],
+                            restore_config=RestoreConfig(
+                                pull_timeout_cap_s=5.0))
+    h.instance_id = 1
+    r = req(list(range(8 * BS)), 4)
+    r.restore = {"emitted": 2, "sources": [[2, 6, 1.0]], "block_size": BS}
+    ctx = Context()
+    ctx.set_timeout_ms(4000)
+    info = await h._restore_migrated(r, ctx)
+    assert info["pulls"] == 1
+    assert seen["timeout"] <= min(5.0, 2.0) + 1e-6
+    # thin budget: no pull is even attempted
+    seen.clear()
+    ctx2 = Context()
+    ctx2.set_timeout_ms(30)
+    info = await h._restore_migrated(r, ctx2)
+    assert info["reason"] == "deadline" and not seen
+    await eng.close()
+
+
+async def test_chaos_worker_kill_hard_death():
+    """worker.kill chaos: the engine loop dies mid-decode without resolving
+    in-flight sinks, and on_kill hooks fire."""
+    configure_chaos("worker.kill:error=1", seed=3)
+    try:
+        eng = AsyncJaxEngine(CFG, eargs())
+        fired = []
+        eng.on_kill.append(lambda: fired.append(1))
+
+        async def drive():
+            async for _ in eng.generate(req(list(range(8)), 8), Context()):
+                pass
+
+        task = asyncio.ensure_future(drive())
+        await _settle(lambda: eng.killed, msg="worker.kill never fired")
+        assert fired == [1]
+        # SIGKILL semantics: the stream hangs (no error frame, no finish)
+        done, _ = await asyncio.wait([task], timeout=0.3)
+        assert not done
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+    finally:
+        configure_chaos(None)
+
+
+# ------------------------------------------------------------- fleet e2e
+
+
+async def test_kill_midstream_restores_bit_identical_greedy():
+    """Flagship: worker A dies mid-decode; the stream migrates to COLD
+    worker C, which pulls the shared prefix from peer B and resumes
+    bit-identical to an unbroken run; the victim leaves the radix index."""
+    fleet = await make_fleet(3)
+    try:
+        a, b, c = fleet.workers
+        prefix = np.random.default_rng(0).integers(1, VOCAB, 6 * BS).tolist()
+        await seed_prefix(fleet, prefix, workers=[b])
+        r = req(prefix + [401], 10)
+        ref = await reference_tokens(r)
+        steer_to(fleet, a)  # the stream starts on A (the victim-to-be)
+        toks, killed = await run_stream(
+            fleet, r, kill_at=3,
+            after_kill=lambda: steer_to(fleet, c))  # migrate to cold C
+        assert killed and a.killed
+        assert toks == ref, f"restored stream diverged: {toks} != {ref}"
+        restored, outcomes = fleet_restore_stats(fleet)
+        assert restored > 0, f"nothing restored (outcomes={outcomes})"
+        assert outcomes[-1] in ("restored", "partial")
+        # C now owns the restored prefix in its own prefix cache
+        probe = c.engine.restore_probe(req(prefix + [401], 1))
+        assert c.engine.resident_prefix_blocks(probe) >= len(prefix) // BS
+        # the restore phase is a first-class trace span (dynctl trace
+        # renders it on migrated requests)
+        from dynamo_tpu.observability import get_tracer
+        spans = [s for s in get_tracer().all_spans()
+                 if s.name == "kv.restore"]
+        assert spans, "no kv.restore span recorded"
+        assert spans[-1].attributes.get("outcome") in ("restored", "partial")
+        # dead-instance hygiene: the victim left the radix index
+        await _settle(lambda: a.lease not in
+                      fleet.router.restore_sources(prefix),
+                      msg="victim never purged from radix")
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_kill_midstream_restores_bit_identical_seeded():
+    """Seeded sampling resumes bit-identical across migration (the PRNG
+    step is position-anchored, so the tail draws the unbroken run's keys)."""
+    fleet = await make_fleet(3)
+    try:
+        a, b, c = fleet.workers
+        prefix = np.random.default_rng(1).integers(1, VOCAB, 6 * BS).tolist()
+        await seed_prefix(fleet, prefix, workers=[b])
+        r = req(prefix + [402], 10, seed=1234, temp=0.9)
+        ref = await reference_tokens(r)
+        steer_to(fleet, a)
+        toks, killed = await run_stream(
+            fleet, r, kill_at=3, after_kill=lambda: steer_to(fleet, c))
+        assert killed
+        assert toks == ref, f"seeded stream diverged: {toks} != {ref}"
+        restored, _ = fleet_restore_stats(fleet)
+        assert restored > 0
+    finally:
+        await stop_fleet(fleet)
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+async def test_restore_from_peer_host_tier(kv_dtype):
+    """A stream whose recoverable prefix lives only in the PEER's G2 host
+    tier (device copies evicted) still restores bit-identical — the pull
+    serves out of the KVBM, and the hierarchy-aware removed events kept
+    the radix advertising the blocks."""
+    kw = dict(kvbm_host_bytes=64 << 20)
+    if kv_dtype:
+        kw["kv_cache_dtype"] = kv_dtype
+    fleet = await make_fleet(3, engine_kw=kw)
+    try:
+        a, b, c = fleet.workers
+        prefix = np.random.default_rng(2).integers(1, VOCAB, 6 * BS).tolist()
+        await seed_prefix(fleet, prefix, workers=[b])
+        # evict B's device prefix copies: its G2 tier is now the only
+        # holder (offloads drained first so the tier actually has them)
+        if b.engine._offload_tasks:
+            await asyncio.gather(*list(b.engine._offload_tasks),
+                                 return_exceptions=True)
+        pool = b.engine.pool
+        ids = pool.allocate(pool.num_free_blocks)
+        assert ids is not None
+        pool.release(ids)
+        assert not pool._lru, "device prefix cache not drained"
+        want = len(prefix) // BS
+        probe = b.engine.restore_probe(req(prefix + [999], 1))
+        assert all(pool.lookup(h) is None
+                   for h in probe.sequence_hashes()[:want])
+        # the radix must STILL know the blocks (they live in B's G2)
+        src = fleet.router.restore_sources(prefix)
+        assert src.get(b.lease, 0) >= want, src
+        if kv_dtype:  # reference engine must match the fleet's cache dtype
+            eng = await asyncio.to_thread(AsyncJaxEngine, CFG, eargs(**kw))
+            ref = []
+            async for out in eng.generate(req(prefix + [403], 10),
+                                          Context()):
+                ref.extend(out.token_ids)
+            await eng.close()
+        else:
+            ref = await reference_tokens(req(prefix + [403], 10))
+        steer_to(fleet, a)
+        toks, killed = await run_stream(
+            fleet, req(prefix + [403], 10), kill_at=3,
+            after_kill=lambda: steer_to(fleet, c))
+        assert killed
+        assert toks == ref
+        restored, outcomes = fleet_restore_stats(fleet)
+        assert restored > 0, outcomes
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_victim_swapped_stream_restores_bit_identical():
+    """Migration × swap interplay: the victim's stream is sitting in the
+    SWAP tier (preempted-to-swap, KV in the victim's host DRAM) when the
+    worker dies — its host tier dies with it, the stream breaks on lease
+    expiry like any other, and the migrated request still resumes
+    bit-identical via peer restore + tail recompute."""
+    fleet = await make_fleet(
+        3, engine_kw=dict(max_num_seqs=1, qos_scheduling=True))
+    try:
+        a, b, c = fleet.workers
+        prefix = np.random.default_rng(4).integers(1, VOCAB, 6 * BS).tolist()
+        await seed_prefix(fleet, prefix, workers=[b])
+        # long OSL: the batch stream must still be DECODING when the
+        # interloper's dispatch lands, or nothing is left to preempt
+        r = req(prefix + [405], 96)
+        ref = await reference_tokens(r)
+        steer_to(fleet, a)
+        ctx = Context(tenant="t-batch", priority="batch")
+        toks = []
+        killed = False
+
+        async def interloper():
+            """A pinned interactive arrival claims A's single slot — the
+            batch stream swap-preempts into A's host tier."""
+            ictx = Context(tenant="t-int", priority="interactive")
+            # long enough that the batch victim stays parked in the swap
+            # tier across several poll windows before the kill lands
+            r2 = req(np.random.default_rng(5).integers(
+                1, VOCAB, 2 * BS).tolist(), 48, pin=a.lease)
+            try:
+                async for _ in fleet.mig.generate(r2, ictx):
+                    pass
+            except Exception:
+                pass  # dies with A; only the batch stream is asserted on
+
+        async for out in fleet.mig.generate(r, ctx):
+            if is_event(out):
+                continue
+            toks.extend(out.token_ids)
+            if len(toks) >= 2 and not killed:
+                asyncio.ensure_future(interloper())
+                # wait for the swap preemption to land, then kill A with
+                # the victim stream's KV parked in its host swap tier
+                await _settle(lambda: len(a.engine.scheduler.swapped) > 0,
+                              timeout=6.0,
+                              msg="stream never swap-preempted")
+                await kill_worker(a)
+                steer_to(fleet, c)
+                killed = True
+        assert killed
+        assert toks == ref, f"swapped-victim stream diverged: {toks} != {ref}"
+        restored, outcomes = fleet_restore_stats(fleet)
+        assert restored > 0, outcomes
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_pull_chaos_degrades_to_recompute_exact():
+    """Acceptance: with kv.direct_pull erroring at 100%, every migration
+    falls back to recompute, completes with exact token accounting, and
+    leaks no blocks."""
+    fleet = await make_fleet(3)
+    try:
+        a, b, c = fleet.workers
+        prefix = np.random.default_rng(3).integers(1, VOCAB, 6 * BS).tolist()
+        await seed_prefix(fleet, prefix, workers=[b])
+        r = req(prefix + [404], 10)
+        ref = await reference_tokens(r)
+        configure_chaos("kv.direct_pull:error=1", seed=0)
+        steer_to(fleet, a)
+        toks, killed = await run_stream(
+            fleet, r, kill_at=3, after_kill=lambda: steer_to(fleet, c))
+        assert killed
+        assert toks == ref  # greedy recompute is still bit-identical
+        restored, outcomes = fleet_restore_stats(fleet)
+        assert restored == 0
+        assert outcomes and all(o in ("recomputed", "partial")
+                                for o in outcomes)
+        # no partial-scatter leak: every surviving engine is fully idle
+        # (all blocks free or parked in the LRU prefix cache)
+        for w in fleet.workers:
+            if w.killed:
+                continue
+            assert not w.engine.scheduler.running
+            assert w.engine.pool.num_active_blocks == 0
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_lease_expiry_breaks_streams_promptly():
+    """The victim's streams fail RETRYABLY within ~lease TTL + sweep, not
+    a long transport timeout — Migration fires on the TTL."""
+    fleet = await make_fleet(1, lease_ttl=1.5)
+    try:
+        w = fleet.workers[0]
+        # warm first: the initial request's XLA compile blocks the worker
+        # loop long enough to starve a sub-second lease all by itself
+        async for _ in fleet.push.generate(
+                req(list(range(1, 2 * BS)), 2, pin=w.lease), Context()):
+            pass
+        r = req(list(range(1, 2 * BS)), 64)
+        ctx = Context()
+        t_broken = None
+        t_kill = None
+        with pytest.raises(StreamError) as ei:
+            async for out in fleet.push.generate(r, ctx):
+                if is_event(out):
+                    continue
+                if t_kill is None:
+                    await kill_worker(w)
+                    t_kill = time.monotonic()
+        t_broken = time.monotonic()
+        assert ei.value.retryable
+        assert t_kill is not None
+        # TTL 1.5 + sweep ≤1s + margin; a transport-timeout path would
+        # take ≥10s (request_timeout) or hang outright
+        assert t_broken - t_kill < 6.0
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_graceful_drain_streams_not_broken():
+    """A gracefully-DRAINING worker deletes its instance key first and
+    keeps streaming; the worker-lost grace window must let those streams
+    complete instead of breaking them (a broken drain would turn every
+    rolling restart into a migration storm)."""
+    fleet = await make_fleet(1)
+    try:
+        w = fleet.workers[0]
+        # warm (compile off the measured path)
+        async for _ in fleet.push.generate(
+                req(list(range(1, 2 * BS)), 2, pin=w.lease), Context()):
+            pass
+        toks = []
+        stopped = [False]
+
+        async def drain_stop():
+            await w.handles[0].stop(graceful=True, timeout=30.0)
+            stopped[0] = True
+
+        stop_task = None
+        async for out in fleet.push.generate(
+                req(list(range(1, 2 * BS)), 48), Context()):
+            if is_event(out):
+                continue
+            toks.extend(out.token_ids if hasattr(out, "token_ids")
+                        else out.get("token_ids") or [])
+            if stop_task is None and len(toks) >= 2:
+                stop_task = asyncio.ensure_future(drain_stop())
+        assert stop_task is not None
+        await stop_task
+        assert stopped[0]
+        assert len(toks) == 48, f"drained stream truncated at {len(toks)}"
+        w.killed = True  # handle already stopped; skip double-stop
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_restore_failure_falls_through_to_remote_prefill():
+    """When restore recovers nothing (disabled) and the unrecovered
+    region is past the local-prefill threshold, the migrated request goes
+    through the prefill pool like the pre-restore migration path did."""
+    from dynamo_tpu.disagg.protocols import DisaggConfig
+
+    eng = AsyncJaxEngine(CFG, eargs())
+
+    class FakePrefillClient:
+        def available_ids(self):
+            return [1]
+
+    h = DecodeWorkerHandler(
+        eng, prefill_client=FakePrefillClient(),
+        config=DisaggConfig(max_local_prefill_length=4 * BS),
+        restore_config=RestoreConfig(enabled=False))
+    h.instance_id = 9
+    routed = []
+
+    async def fake_disagg(r, cx):
+        routed.append(len(r.token_ids))
+        yield LLMEngineOutput(token_ids=[1],
+                              finish_reason="length").to_wire()
+
+    h._generate_disagg = fake_disagg
+    r = req(list(range(1, 8 * BS)), 4)
+    r.restore = {"emitted": 2, "sources": [], "block_size": BS}
+    out = [o async for o in h.generate(r.to_wire(), Context())]
+    assert routed, "migrated request never reached the prefill pool"
+    assert out
+    # short unrecovered region (below threshold): served locally
+    routed.clear()
+    r2 = req(list(range(1, 2 * BS)), 2)
+    r2.restore = {"emitted": 1, "sources": [], "block_size": BS}
+    out2 = [o async for o in h.generate(r2.to_wire(), Context())]
+    assert not routed and out2
+    await eng.close()
+
+
+async def test_restore_budget_cap_bounded_wait_then_recompute():
+    """With every restore slot busy the migration waits at most the pull
+    budget for one to free (a peer's restore may make the prefix local),
+    then recomputes — it never queues unboundedly."""
+    eng = AsyncJaxEngine(CFG, eargs())
+    h = DecodeWorkerHandler(eng, restore_config=RestoreConfig(
+        max_concurrent=1, pull_timeout_cap_s=0.2))
+    h.instance_id = 1
+    await h._restore_slots.acquire()  # saturate the budget
+    r = req(list(range(8 * BS)), 4)
+    r.restore = {"emitted": 2, "sources": [[2, 6, 1.0]], "block_size": BS}
+    t0 = time.monotonic()
+    info = await h._restore_migrated(r, Context())
+    waited = time.monotonic() - t0
+    assert info["outcome"] == "recomputed"
+    assert info["reason"] == "budget"
+    assert 0.15 <= waited < 1.5  # bounded by the pull budget, not forever
+    await eng.close()
