@@ -1114,6 +1114,366 @@ async def migration_bench(on_tpu: bool = False, reps: int = 2,
     }
 
 
+async def onboard_bench(on_tpu: bool = False, reps: int = 2,
+                        isl: int = 4096, osl: int = 32,
+                        streams: int = 4) -> dict:
+    """``bench.py --onboard``: routine cross-worker prefix onboarding
+    (ISSUE 11 acceptance; docs/performance.md "prefix onboarding").
+
+    Scenario 1 — shared-system-prompt fleet: worker A holds the hot 4k
+    prefix, ``streams`` admissions sharing it land on worker B. Pull arm:
+    the router attaches peer plans and B onboards the prefix over
+    ``kv_pull`` (one pull, dedupe holds the rest); recompute arm
+    (``DYN_ONBOARD=0`` semantics): B re-prefills every stream. Gates:
+    100% completion, bit-identical greedy streams across arms, TTFT p95
+    ratio ≤ 0.7, AND fewer prefill chip-seconds (B's summed step wall) —
+    the pull must win latency without hiding recompute burn elsewhere.
+
+    Scenario 2 — cold start from G4: worker A's re-hit prefix flows up to
+    the object store (DYN_G4_PUBLISH_HITS=1) and is sentinel-announced to
+    the radix; A leaves; a COLD worker admits the same prefix and warms
+    it from G4 (no peer exists) vs recomputing it. Gate: TTFT p95 ratio
+    < 1.0 with blocks actually fetched from the store.
+
+    Arms are interleaved per rep so host drift cancels (the migration
+    bench discipline).
+    """
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler, KvPullHandler
+    from dynamo_tpu.disagg.transfer import OnboardConfig, RestoreConfig
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.kvbm.distributed import (G4PrefixAnnouncer,
+                                             ObjectStoreG4Client)
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+    from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
+    from dynamo_tpu.router.protocols import G4_SOURCE_ID, KvRouterConfig
+    from dynamo_tpu.router.publisher import KvEventPublisher
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.context import Context
+
+    cfg = ModelConfig.tiny()
+    bs = 16
+    blocks_needed = (isl + 64 + osl) // bs + 8
+    blk_bytes = 2 * cfg.num_layers * bs * cfg.num_kv_heads * (
+        cfg.hidden_size // cfg.num_heads) * 4
+    rng = np.random.default_rng(43)
+    prefix = rng.integers(1, cfg.vocab_size, isl).tolist()
+    warm_prefix = rng.integers(1, cfg.vocab_size, isl).tolist()
+    prefix_blocks = isl // bs
+
+    def eargs(**kw):
+        base = dict(block_size=bs, num_blocks=2 * blocks_needed + 64,
+                    max_num_seqs=streams + 2,
+                    max_num_batched_tokens=1024,
+                    max_model_len=isl + 64 + osl + bs,
+                    enable_prefix_caching=True)
+        base.update(kw)
+        return EngineArgs(**base)
+
+    def req(suffix, pin=None, osl_=None):
+        return PreprocessedRequest(
+            model="m", token_ids=prefix + list(suffix),
+            stop_conditions=StopConditions(
+                max_tokens=osl_ or osl, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            backend_instance_id=pin)
+
+    async def settle(check, timeout=20.0, msg="never settled"):
+        for _ in range(int(timeout / 0.02)):
+            if check():
+                return
+            await asyncio.sleep(0.02)
+        raise TimeoutError(msg)
+
+    async def make_worker(rt, rcfg, onboard_on, g4_client=None,
+                          hot_hits=0, host_blocks=0):
+        import os as _os
+
+        wrt = await DistributedRuntime.create(plane=rt.plane,
+                                              owns_plane=False, config=rcfg)
+        lease = await wrt.primary_lease()
+        kw = {}
+        if host_blocks:
+            kw["kvbm_host_bytes"] = host_blocks * blk_bytes
+        prev = _os.environ.get("DYN_G4_PUBLISH_HITS")
+        _os.environ["DYN_G4_PUBLISH_HITS"] = str(hot_hits)
+        try:
+            eng = await asyncio.to_thread(
+                AsyncJaxEngine, cfg, eargs(**kw))
+        finally:
+            if prev is None:
+                _os.environ.pop("DYN_G4_PUBLISH_HITS", None)
+            else:
+                _os.environ["DYN_G4_PUBLISH_HITS"] = prev
+        pub = KvEventPublisher(wrt.plane, worker_id=lease, kv_block_size=bs)
+        await pub.start_resync_responder()
+        eng.event_cb = pub.publish_sync
+        announcer = None
+        if g4_client is not None:
+            eng.kvbm.attach_remote(g4_client, 0)
+            if hot_hits:
+                announcer = await G4PrefixAnnouncer(
+                    wrt.plane, pub, asyncio.get_running_loop()).start()
+                eng.kvbm.on_remote_change = announcer.on_remote_change
+        comp = wrt.namespace("dynamo").component("backend")
+        pull_client = await comp.endpoint("kv_pull").client().start()
+        handler = DecodeWorkerHandler(
+            eng, pull_clients=[pull_client], metrics=wrt.metrics,
+            restore_config=RestoreConfig(enabled=False),
+            onboard_config=OnboardConfig(enabled=onboard_on))
+        handler.instance_id = lease
+        h_gen = await comp.endpoint("generate").serve_endpoint(
+            handler.generate, lease_id=lease)
+        h_pull = await comp.endpoint("kv_pull").serve_endpoint(
+            KvPullHandler(eng).generate, lease_id=lease)
+        w = type("W", (), {})()
+        w.rt, w.engine, w.lease = wrt, eng, lease
+        w.handler, w.pub, w.announcer = handler, pub, announcer
+        w.pull_client = pull_client
+        w.handles = [h_gen, h_pull]
+        return w
+
+    async def close_worker(w, stopped=False):
+        if not stopped:
+            for h in w.handles:
+                await h.stop(graceful=False)
+        await w.pull_client.stop()
+        if w.announcer is not None:
+            await w.announcer.stop()
+        await w.pub.stop()
+        await w.engine.close()
+        await w.rt.shutdown()
+
+    async def warm(w, push, tag):
+        """Compile surfaces OFF the measured path: full-ISL prefill +
+        decode signatures, plus the width-256 gather/scatter programs the
+        pull/attach path dispatches. The warm prefix is then dropped so
+        it can't shadow the measurement."""
+        from dynamo_tpu.ops.block_copy import gather_blocks, scatter_blocks
+
+        r = PreprocessedRequest(
+            model="m", token_ids=warm_prefix + [9700 + tag],
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            backend_instance_id=w.lease)
+        async for _ in push.generate(r, Context()):
+            pass
+        eng = w.engine
+        ids = list(range(1, min(257, eng.num_blocks)))
+        kb = np.asarray(gather_blocks(eng.k_cache, ids, block_size=bs))
+        vb = np.asarray(gather_blocks(eng.v_cache, ids, block_size=bs))
+        eng.k_cache = scatter_blocks(eng.k_cache, ids, kb, block_size=bs)
+        eng.v_cache = scatter_blocks(eng.v_cache, ids, vb, block_size=bs)
+        eng.pool.clear()
+
+    from dynamo_tpu.runtime.context import Context
+
+    async def measured_streams(push, rep, base):
+        """Launch the shared-prefix streams concurrently; returns
+        (ttfts, token_streams)."""
+        ttfts = []
+        outs = []
+
+        async def one(i):
+            r = req([base + rep * 16 + i])
+            t0 = time.perf_counter()
+            first = True
+            toks = []
+            async for out in push.generate(r, Context()):
+                if isinstance(out, dict) and out.get("token_ids"):
+                    if first:
+                        ttfts.append(time.perf_counter() - t0)
+                        first = False
+                    toks.extend(out["token_ids"])
+            outs.append((i, toks))
+            return toks
+
+        await asyncio.gather(*[one(i) for i in range(streams)])
+        return ttfts, [t for _, t in sorted(outs)]
+
+    def chip_seconds(eng, mark):
+        return sum(e[3] for e in list(eng.step_trace)[mark:]) / 1000.0
+
+    async def peer_rep(onboard_on: bool, rep: int) -> dict:
+        rcfg = RuntimeConfig(lease_ttl=8.0)
+        rt = await DistributedRuntime.create(config=rcfg)
+        a = b = None
+        router = client = None
+        try:
+            a = await make_worker(rt, rcfg, onboard_on)
+            b = await make_worker(rt, rcfg, onboard_on)
+            client = await (rt.namespace("dynamo").component("backend")
+                            .endpoint("generate").client().start())
+            router = await KvRouter(rt.plane, bs, KvRouterConfig()).start()
+            push = KvPushRouter(client, router)
+            await warm(a, push, 0)
+            await warm(b, push, 1)
+            # A computes (and keeps) the shared prefix
+            async for _ in push.generate(req([9001], pin=a.lease),
+                                         Context()):
+                pass
+            await settle(lambda: router.restore_sources(prefix + [1])
+                         .get(a.lease, 0) >= prefix_blocks - 1,
+                         msg="radix never learned A's prefix")
+            client.set_busy_instances([a.lease])  # steer onto B
+            mark = len(b.engine.step_trace)
+            q0 = b.engine.scheduler.prefix_query_tokens
+            h0 = b.engine.scheduler.prefix_hit_tokens
+            ttfts, toks = await measured_streams(push, rep, 9100)
+            sched = b.engine.scheduler
+            return {
+                "ttfts": ttfts,
+                "tokens": toks,
+                "complete": all(len(t) == osl for t in toks),
+                "chip_s": chip_seconds(b.engine, mark),
+                "prompt_tokens_computed": (
+                    (sched.prefix_query_tokens - q0)
+                    - (sched.prefix_hit_tokens - h0)),
+                "pulled_blocks": b.handler._onboard_blocks._values.get(
+                    (("source", "peer"),), 0),
+            }
+        finally:
+            for w in (a, b):
+                if w is not None:
+                    await close_worker(w)
+            if router is not None:
+                await router.stop()
+            if client is not None:
+                await client.stop()
+            await rt.shutdown()
+
+    async def g4_rep(onboard_on: bool, rep: int) -> dict:
+        rcfg = RuntimeConfig(lease_ttl=8.0)
+        rt = await DistributedRuntime.create(config=rcfg)
+        loop = asyncio.get_running_loop()
+        a = c = None
+        a_stopped = False
+        router = client = None
+        try:
+            g4 = ObjectStoreG4Client(rt.plane, loop)
+            # A: hot publisher (threshold 1 — first re-hit flows up).
+            # Host sized for warm-prefix AND measured-prefix blocks, so
+            # warm-block evictions never cascade garbage into G4.
+            a = await make_worker(rt, rcfg, onboard_on, g4_client=g4,
+                                  hot_hits=1,
+                                  host_blocks=2 * prefix_blocks + 32)
+            client = await (rt.namespace("dynamo").component("backend")
+                            .endpoint("generate").client().start())
+            router = await KvRouter(rt.plane, bs, KvRouterConfig()).start()
+            push = KvPushRouter(client, router)
+            await warm(a, push, 2)
+            async for _ in push.generate(req([9001], pin=a.lease),
+                                         Context()):
+                pass
+            # the MEASURED prefix must be G2-resident before the re-hit
+            # (warm-prefix blocks would satisfy a bare host_blocks count
+            # while the measured offload is still in flight)
+            from dynamo_tpu.tokens import KV_HASH_SEED, TokenBlockSequence
+            probe_hashes = TokenBlockSequence.from_tokens(
+                prefix[:prefix_blocks * bs], bs,
+                KV_HASH_SEED).sequence_hashes()
+            await settle(lambda: len(a.engine.kvbm.host_resident(
+                probe_hashes)) >= prefix_blocks - 1,
+                msg="offload to G2 never landed")
+            async for _ in push.generate(req([9002], pin=a.lease),
+                                         Context()):
+                pass
+            await settle(lambda: router.restore_sources(prefix + [1])
+                         .get(G4_SOURCE_ID, 0) >= prefix_blocks - 1,
+                         timeout=60.0,
+                         msg="hot prefix never reached G4/radix")
+            # A leaves the fleet; the G4 sentinel survives it
+            for h in a.handles:
+                await h.stop(graceful=False)
+            a_stopped = True
+            # cold worker joins (own G4 reach, empty caches); host sized
+            # so its warm-prefix offload can't evict into G4 mid-measure
+            c = await make_worker(rt, rcfg, onboard_on, g4_client=g4,
+                                  host_blocks=2 * prefix_blocks + 32)
+            await settle(lambda: client.available_ids() == [c.lease])
+            await warm(c, push, 3)
+            mark = len(c.engine.step_trace)
+            ttfts, toks = await measured_streams(push, rep, 9300)
+            return {
+                "ttfts": ttfts,
+                "tokens": toks,
+                "complete": all(len(t) == osl for t in toks),
+                "chip_s": chip_seconds(c.engine, mark),
+                "g4_blocks": c.engine.kvbm.stats()["onboarded_blocks"],
+            }
+        finally:
+            if a is not None:
+                await close_worker(a, stopped=a_stopped)
+            if c is not None:
+                await close_worker(c)
+            if router is not None:
+                await router.stop()
+            if client is not None:
+                await client.stop()
+            await rt.shutdown()
+
+    def p95(vals):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(len(vals) * 0.95))] if vals else 0.0
+
+    peer = {"pull": [], "recompute": []}
+    for rep in range(reps):  # interleaved per-rep: host drift cancels
+        peer["pull"].append(await peer_rep(True, rep))
+        peer["recompute"].append(await peer_rep(False, rep))
+    g4 = {"warm": [], "recompute": []}
+    g4["warm"].append(await g4_rep(True, 0))
+    g4["recompute"].append(await g4_rep(False, 0))
+
+    pull_ttfts = [t for r in peer["pull"] for t in r["ttfts"]]
+    rec_ttfts = [t for r in peer["recompute"] for t in r["ttfts"]]
+    pull_p95, rec_p95 = p95(pull_ttfts), p95(rec_ttfts)
+    ttft_ratio = pull_p95 / max(rec_p95, 1e-9)
+    pull_chip = sum(r["chip_s"] for r in peer["pull"])
+    rec_chip = sum(r["chip_s"] for r in peer["recompute"])
+    identical = all(
+        pr["tokens"] == rr["tokens"]
+        for pr, rr in zip(peer["pull"], peer["recompute"]))
+    complete = (all(r["complete"] for r in peer["pull"] + peer["recompute"]
+                    + g4["warm"] + g4["recompute"]))
+    g4_p95 = p95([t for r in g4["warm"] for t in r["ttfts"]])
+    g4_rec_p95 = p95([t for r in g4["recompute"] for t in r["ttfts"]])
+    g4_ratio = g4_p95 / max(g4_rec_p95, 1e-9)
+    g4_identical = all(
+        wr["tokens"] == rr["tokens"]
+        for wr, rr in zip(g4["warm"], g4["recompute"]))
+    pulled = sum(r["pulled_blocks"] or 0 for r in peer["pull"])
+    g4_warmed = sum(r["g4_blocks"] for r in g4["warm"])
+    return {
+        "onboard_workload": (f"{streams}x(ISL={isl},OSL={osl}) shared "
+                             f"prefix, 2 workers, {reps} reps/arm + G4 "
+                             "cold-start x1"),
+        "complete": complete,
+        "streams_identical_across_arms": identical,
+        "pull_ttft_p95_ms": round(pull_p95 * 1000, 1),
+        "recompute_ttft_p95_ms": round(rec_p95 * 1000, 1),
+        "ttft_ratio_pull_over_recompute": round(ttft_ratio, 3),
+        "pull_prefill_chip_s": round(pull_chip, 2),
+        "recompute_prefill_chip_s": round(rec_chip, 2),
+        "pull_prompt_tokens_computed": sum(
+            r["prompt_tokens_computed"] for r in peer["pull"]),
+        "recompute_prompt_tokens_computed": sum(
+            r["prompt_tokens_computed"] for r in peer["recompute"]),
+        "peer_pulled_blocks": pulled,
+        "g4_cold_ttft_p95_ms": round(g4_p95 * 1000, 1),
+        "g4_recompute_ttft_p95_ms": round(g4_rec_p95 * 1000, 1),
+        "g4_ttft_ratio": round(g4_ratio, 3),
+        "g4_warmed_blocks": g4_warmed,
+        "g4_streams_identical": g4_identical,
+        "onboard_ok": (complete and identical and g4_identical
+                       and ttft_ratio <= 0.7
+                       and pull_chip < rec_chip
+                       and pulled > 0
+                       and g4_ratio < 1.0 and g4_warmed > 0),
+    }
+
+
 async def ragged_bench(on_tpu: bool = False, reps: int = 3) -> dict:
     """``bench.py --ragged``: ragged vs bucketed A/B on a MIXED
     prefill+decode workload (ISSUE 7 acceptance).
@@ -1664,6 +2024,25 @@ def main():
         print(json.dumps(out), flush=True)
         raise SystemExit(0 if out["migration_ok"] else 1)
 
+    if "--onboard" in sys.argv:
+        # routine cross-worker prefix onboarding A/B: peer-pull vs
+        # recompute on a shared-prefix fleet + G4 cold-start warmup —
+        # prints one JSON line; exits nonzero when streams diverge,
+        # pull stops beating recompute on TTFT p95 (≤0.7) or prefill
+        # chip-seconds, or the G4 warm loses to cold recompute
+        # (docs/performance.md "prefix onboarding")
+        try:
+            out = asyncio.run(onboard_bench(False))
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"onboard": "failed",
+                              "error": repr(e)[:300]}), flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["onboard_ok"] else 1)
+
     if "--disagg" in sys.argv:
         # network-aware disagg A/Bs: topology-costed placement vs blind +
         # layer-interleaved vs whole-bundle tail — prints one JSON line;
@@ -1800,16 +2179,17 @@ def _child_main():
     phases = {p.strip() for p in
               os.environ.get("DYN_BENCH_PHASES",
                              "kernel,spec,e2e,chaos,mem,qos,autoscale,"
-                             "ragged,disagg,migration").split(",")
+                             "ragged,disagg,migration,onboard").split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
-                        "autoscale", "ragged", "disagg", "migration"}
+                        "autoscale", "ragged", "disagg", "migration",
+                        "onboard"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
                          f"chaos, mem, qos, autoscale, ragged, disagg, "
-                         f"migration)")
+                         f"migration, onboard)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -1898,6 +2278,15 @@ def _child_main():
                 kern["migration"] = asyncio.run(migration_bench(on_tpu))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["migration_error"] = repr(e)[:200]
+        if "onboard" in phases:
+            # routine prefix onboarding phase: shared-prefix peer-pull vs
+            # recompute + G4 cold-start warmup — TTFT p95 ratio, prefill
+            # chip-seconds, and exact stream identity on record every
+            # round (ISSUE 11 acceptance)
+            try:
+                kern["onboard"] = asyncio.run(onboard_bench(on_tpu))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["onboard_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

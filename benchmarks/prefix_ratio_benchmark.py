@@ -5,8 +5,16 @@ common prefix with probability ``--prefix-ratio``; with KV-aware routing,
 shared-prefix requests should land on workers already holding the prefix
 blocks (higher cache-hit rate, lower TTFT) vs. round-robin.
 
+With ``--metrics-url`` (repeatable, one per worker /metrics endpoint) the
+sweep also reports FLEET-WIDE prefix-hit provenance — where cache hits
+actually came from: served locally, peer-pulled at admission, warmed from
+the G4 object store, or recomputed (docs/performance.md "prefix
+onboarding").
+
 Usage: python -m benchmarks.prefix_ratio_benchmark --url http://... \
-           --model demo --prefix-ratio 0.8
+           --model demo --prefix-ratio 0.8 \
+           --metrics-url http://worker1:8081/metrics \
+           --metrics-url http://worker2:8081/metrics
 """
 
 from __future__ import annotations
@@ -15,10 +23,76 @@ import argparse
 import asyncio
 import json
 import random
+import re
 
 import aiohttp
 
 from benchmarks.client import make_prompt, stream_request, summarize
+
+_LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([^\s]+)$")
+
+
+def _scrape_labeled(text: str, families: set[str]) -> dict:
+    """name{label-string} → value for the requested metric families
+    (label sets kept apart — provenance lives in the labels)."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line.strip())
+        if not m:
+            continue
+        name, labels, value = m.groups()
+        if name not in families:
+            continue
+        try:
+            out[(name, labels or "")] = (
+                out.get((name, labels or ""), 0.0) + float(value))
+        except ValueError:
+            continue
+    return out
+
+
+_PROVENANCE_FAMILIES = {
+    "dynamo_prefix_hit_tokens_total",
+    "dynamo_prefix_query_tokens_total",
+    "dynamo_prefix_onboard_total",
+    "dynamo_prefix_onboard_blocks_total",
+}
+
+
+async def scrape_provenance(session, urls: list[str]) -> dict:
+    """Fleet-wide prefix-hit provenance, summed over worker /metrics."""
+    agg: dict = {}
+    scraped = 0
+    for url in urls:
+        try:
+            async with session.get(url) as resp:
+                text = await resp.text()
+        except Exception:
+            continue
+        scraped += 1
+        for k, v in _scrape_labeled(text, _PROVENANCE_FAMILIES).items():
+            agg[k] = agg.get(k, 0.0) + v
+
+    def fam(name, label=""):
+        return sum(v for (n, lb), v in agg.items()
+                   if n == name and (not label or label in lb))
+
+    hit = fam("dynamo_prefix_hit_tokens_total")
+    query = fam("dynamo_prefix_query_tokens_total")
+    return {
+        "workers_scraped": scraped,
+        "local_hit_tokens": hit,
+        "recomputed_prompt_tokens": max(0.0, query - hit),
+        "peer_pulled_blocks": fam("dynamo_prefix_onboard_blocks_total",
+                                  'source="peer"'),
+        "g4_warmed_blocks": fam("dynamo_prefix_onboard_blocks_total",
+                                'source="g4"'),
+        "onboard_outcomes": {
+            oc: fam("dynamo_prefix_onboard_total", f'outcome="{oc}"')
+            for oc in ("pulled", "g4", "local", "recomputed")},
+    }
 
 
 async def amain():
@@ -33,6 +107,9 @@ async def amain():
     ap.add_argument("--unique-words", type=int, default=64)
     ap.add_argument("--osl", type=int, default=32)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--metrics-url", action="append", default=[],
+                    help="worker /metrics endpoint (repeatable); enables "
+                         "the fleet-wide prefix-hit provenance report")
     cli = ap.parse_args()
 
     rng = random.Random(cli.seed)
@@ -60,8 +137,14 @@ async def amain():
                     session, cli.url, cli.model, p, cli.osl))
 
         await asyncio.gather(*(worker() for _ in range(cli.concurrency)))
+        out = {"prefix_ratio": cli.prefix_ratio, **summarize(results)}
+        if cli.metrics_url:
+            # where the sweep's cache hits actually came from (local /
+            # peer-pulled / G4 / recomputed), summed across the fleet
+            out["provenance"] = await scrape_provenance(
+                session, cli.metrics_url)
 
-    print(json.dumps({"prefix_ratio": cli.prefix_ratio, **summarize(results)}))
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -109,9 +109,16 @@ class DisaggConfigWatcher:
 
 class KvPullHandler:
     """Serves a worker's ``kv_pull`` endpoint: peers rebuilding a crashed
-    stream pull KV blocks by sequence hash out of this worker's device
-    prefix cache and KVBM G2/G3 tiers (stateful migration,
-    docs/robustness.md). Frames reuse the distributed-KVBM block format.
+    stream — or routinely onboarding a hot prefix at admission
+    (docs/performance.md) — pull KV blocks by sequence hash out of this
+    worker's device prefix cache and KVBM G2/G3 tiers. Frames reuse the
+    distributed-KVBM block format.
+
+    The serve budget is SPLIT by the request's ``reason``: routine
+    ``onboard`` pulls queue on their own concurrency cap
+    (DYN_ONBOARD_MAX_CONCURRENT) and can never starve crash-``restore``
+    pulls sharing DYN_RESTORE_MAX_CONCURRENT — a restore races a migration
+    deadline, an onboard merely races a recompute it was going to win.
     """
 
     #: absolute per-request serve cap, independent of what the puller
@@ -119,11 +126,22 @@ class KvPullHandler:
     MAX_SERVE_BLOCKS = 8192
 
     def __init__(self, engine, metrics=None):
+        import asyncio as _asyncio
+
+        from dynamo_tpu.disagg.transfer import OnboardConfig, RestoreConfig
+
         self.engine = engine
+        self._serve_slots = {
+            "restore": _asyncio.Semaphore(
+                max(1, RestoreConfig.from_env().max_concurrent)),
+            "onboard": _asyncio.Semaphore(
+                max(1, OnboardConfig.from_env().max_concurrent)),
+        }
         if metrics is not None:
             self._served = metrics.counter(
                 "kv_restore_served_blocks_total",
-                "KV blocks this worker served to peers' restore pulls")
+                "KV blocks this worker served to peers' restore/onboard "
+                "pulls, by reason")
         else:
             self._served = None
 
@@ -132,15 +150,20 @@ class KvPullHandler:
 
         hashes = list(request.get("hashes") or [])
         asked = request.get("max_blocks")
+        reason = request.get("reason") or "restore"
         budget = min(len(hashes) if asked is None else int(asked),
                      self.MAX_SERVE_BLOCKS)
         served = 0
-        async for h, k, v in self.engine.export_blocks(hashes,
-                                                       max_blocks=budget):
-            served += 1
-            yield _pack_block(h, k, v)
+        # queued waiters here are bounded by the PULLER's wait_for budget:
+        # a puller that gives up cancels the stream, releasing the slot
+        async with self._serve_slots.get(reason,
+                                         self._serve_slots["restore"]):
+            async for h, k, v in self.engine.export_blocks(
+                    hashes, max_blocks=budget):
+                served += 1
+                yield _pack_block(h, k, v)
         if self._served is not None and served:
-            self._served.inc(served)
+            self._served.inc(served, reason=reason)
 
 
 class DecodeWorkerHandler:
@@ -157,7 +180,8 @@ class DecodeWorkerHandler:
     def __init__(self, engine, prefill_client=None,
                  config: Optional[DisaggConfig] = None, prefill_queue=None,
                  mm_client=None, metrics=None, topo_labels=None,
-                 pull_clients=None, restore_config=None):
+                 pull_clients=None, restore_config=None,
+                 onboard_config=None):
         self.engine = engine
         self.prefill_client = prefill_client
         self.config = config or DisaggConfig()
@@ -208,6 +232,20 @@ class DecodeWorkerHandler:
                 "migration_restore_seconds",
                 "KV-restore phase wall per migrated stream (plan decode + "
                 "pulls + scatter/attach)")
+            # routine prefix onboarding (docs/performance.md)
+            self._onboard_total = metrics.counter(
+                "prefix_onboard_total",
+                "admissions that carried an onboard plan, by outcome: "
+                "pulled (peer blocks attached) | g4 (warmed from the "
+                "object store) | local (plan stale, prefix already here) "
+                "| recomputed (nothing attached)")
+            self._onboard_blocks = metrics.counter(
+                "prefix_onboard_blocks_total",
+                "prefix blocks attached by routine onboarding, by source")
+            self._onboard_seconds = metrics.histogram(
+                "prefix_onboard_seconds",
+                "onboard phase wall per admission (residency probe + "
+                "pulls/G4 fetch + scatter/attach)")
         else:
             self._xfer_bytes = self._xfer_seconds = None
             self._claim_fallback = self._pull_failures = None
@@ -215,7 +253,10 @@ class DecodeWorkerHandler:
             self._migration_restored_blocks = None
             self._migration_recomputed_tokens = None
             self._migration_restore_seconds = None
-        from dynamo_tpu.disagg.transfer import RestoreConfig
+            self._onboard_total = None
+            self._onboard_blocks = None
+            self._onboard_seconds = None
+        from dynamo_tpu.disagg.transfer import OnboardConfig, RestoreConfig
 
         #: Clients whose instance sets cover potential restore sources
         self.pull_clients = list(pull_clients or [])
@@ -228,6 +269,16 @@ class DecodeWorkerHandler:
         #: stream is already late)
         self._restore_slots = asyncio.Semaphore(
             max(1, self.restore_config.max_concurrent))
+        self.onboard_config = onboard_config or OnboardConfig.from_env()
+        #: SEPARATE budget for routine onboard pulls — sharing the restore
+        #: semaphore would let steady admission traffic starve the
+        #: deadline-racing crash restores (and vice versa)
+        self._onboard_slots = asyncio.Semaphore(
+            max(1, self.onboard_config.max_concurrent))
+        #: dedupe of simultaneous same-prefix onboards: first-missing-hash
+        #: → in-flight future. A shared system prompt arriving N-wide must
+        #: pull once; followers wait and re-check local coverage.
+        self._onboard_inflight: dict[int, asyncio.Future] = {}
 
     def _labels(self):
         if self._topo_labels is None:
@@ -324,6 +375,31 @@ class DecodeWorkerHandler:
                 async for out in self.engine.generate(req, ctx):
                     yield out.to_wire()
                 return
+        elif req.onboard is not None and self.onboard_config.enabled:
+            # routine prefix onboarding (docs/performance.md): peers (or
+            # G4) hold more of this prompt's prefix than we do, and the
+            # router's cost model said pulling beats recomputing. Skip
+            # only when the prompt is headed to the prefill pool anyway
+            # AND even a fully-executed plan would leave it there — the
+            # pool computes the whole prompt remotely, so a local pull
+            # would be pure waste.
+            bs = max(1, getattr(getattr(self.engine, "args", None),
+                                "block_size", 1) or 1)
+            best = max([int(n) for _w, n, _c in
+                        (req.onboard.get("sources") or [])]
+                       + [int(req.onboard.get("g4_blocks") or 0)],
+                       default=0)
+            est_tail = len(req.token_ids) - best * bs
+            if (not self._use_remote_prefill(req)
+                    or est_tail <= self.config.max_local_prefill_length):
+                info = await self._onboard_prefix(req, ctx)
+                unrecovered = (len(req.token_ids)
+                               - info.get("covered_blocks", 0) * bs)
+                if not (self._use_remote_prefill(req) and unrecovered
+                        > self.config.max_local_prefill_length):
+                    async for out in self.engine.generate(req, ctx):
+                        yield out.to_wire()
+                    return
         if self._use_remote_prefill(req):
             yielded = False
             try:
@@ -349,6 +425,60 @@ class DecodeWorkerHandler:
                 continue
         return None
 
+    @staticmethod
+    def _remaining_s(ctx):
+        return (ctx.remaining_s() if ctx is not None
+                and hasattr(ctx, "remaining_s") else None)
+
+    async def _pull_from_sources(self, probe, hashes, sources, covered,
+                                 want, cfg, ctx, info,
+                                 reason: str = "restore") -> int:
+        """Try the best-ranked source + one failover over ``kv_pull`` and
+        attach whatever lands contiguously. Shared by crash restore and
+        routine onboarding — identical wire path and tear handling,
+        separate budgets. Mutates ``info`` counters; returns the new
+        covered count; sets info["reason"]="deadline" and stops when the
+        per-pull clamp says the budget is too thin."""
+        from dynamo_tpu.disagg.transfer import (
+            pull_restore_blocks, restore_pull_timeout,
+        )
+
+        for wid, blocks, _cost in sources[:2]:  # best + one failover
+            client = self._client_for_instance(wid)
+            if client is None:
+                continue
+            end = min(blocks, want)
+            if end <= covered:
+                continue
+            # re-clamp PER PULL against what the slot wait / earlier
+            # attempt left: each pull gets at most half the remaining
+            # budget, so even a timed-out pull + failover can never
+            # starve the recompute fallback of its half
+            timeout = restore_pull_timeout(
+                cfg.pull_timeout_cap_s, self._remaining_s(ctx))
+            if timeout is None:
+                info["reason"] = "deadline"
+                return covered
+            info["pulls"] += 1
+            try:
+                pulled = await pull_restore_blocks(
+                    client, wid, hashes[covered:end], timeout,
+                    reason=reason)
+            except Exception as e:
+                info["pull_failures"] += 1
+                if self._pull_failures is not None:
+                    self._pull_failures.inc()
+                logger.warning("%s pull from %x failed (%s); "
+                               "trying next source / recompute",
+                               reason, wid, e)
+                continue
+            attached = self.engine.attach_restored(probe, covered, pulled)
+            covered += attached
+            info["restored_blocks"] += attached
+            if attached:
+                break  # contiguous coverage extended; done
+        return covered
+
     async def _restore_migrated(self, req, ctx) -> dict:
         """Execute the request's KV-restore plan: pull the recoverable
         prefix of (prompt ‖ emitted) from the cheapest surviving source
@@ -356,9 +486,7 @@ class DecodeWorkerHandler:
         ``kv.restore`` span + dynamo_migration_* metrics). NEVER raises —
         the caller always proceeds to engine.generate, which recomputes
         whatever was not restored, with exact token accounting."""
-        from dynamo_tpu.disagg.transfer import (
-            pull_restore_blocks, restore_pull_timeout,
-        )
+        from dynamo_tpu.disagg.transfer import restore_pull_timeout
 
         cfg = self.restore_config
         bs = self.engine.args.block_size
@@ -400,9 +528,7 @@ class DecodeWorkerHandler:
                 info["reason"] = "no_sources"
                 return info
             timeout = restore_pull_timeout(
-                cfg.pull_timeout_cap_s,
-                ctx.remaining_s() if ctx is not None
-                and hasattr(ctx, "remaining_s") else None)
+                cfg.pull_timeout_cap_s, self._remaining_s(ctx))
             if timeout is None:
                 info["reason"] = "deadline"
                 return info
@@ -427,40 +553,9 @@ class DecodeWorkerHandler:
                 return info
             sources = [s for s in sources if s[1] > covered]
             want = min(matchable, covered + max(0, cfg.max_blocks))
-            for wid, blocks, _cost in sources[:2]:  # best + one failover
-                client = self._client_for_instance(wid)
-                if client is None:
-                    continue
-                end = min(blocks, want)
-                if end <= covered:
-                    continue
-                # re-clamp PER PULL against what the slot wait / earlier
-                # attempt left: each pull gets at most half the remaining
-                # budget, so even a timed-out pull + failover can never
-                # starve the recompute fallback of its half
-                timeout = restore_pull_timeout(
-                    cfg.pull_timeout_cap_s,
-                    ctx.remaining_s() if ctx is not None
-                    and hasattr(ctx, "remaining_s") else None)
-                if timeout is None:
-                    info["reason"] = "deadline"
-                    return info
-                info["pulls"] += 1
-                try:
-                    pulled = await pull_restore_blocks(
-                        client, wid, hashes[covered:end], timeout)
-                except Exception as e:
-                    info["pull_failures"] += 1
-                    if self._pull_failures is not None:
-                        self._pull_failures.inc()
-                    logger.warning("restore pull from %x failed (%s); "
-                                   "trying next source / recompute", wid, e)
-                    continue
-                attached = self.engine.attach_restored(probe, covered, pulled)
-                covered += attached
-                info["restored_blocks"] += attached
-                if attached:
-                    break  # contiguous coverage extended; done
+            covered = await self._pull_from_sources(
+                probe, hashes, sources, covered, want, cfg, ctx, info,
+                reason="restore")
             return info
         except Exception:
             logger.exception("KV restore failed; recomputing")
@@ -475,9 +570,14 @@ class DecodeWorkerHandler:
             recomputed = len(req.token_ids) - covered * bs
             info["recomputed_tokens"] = max(0, recomputed)
             t1 = time.time()
+            # reason=restore|onboard distinguishes crash restores from
+            # routine admission onboards in `dynctl trace`; the skip cause
+            # (info["reason"]) moves to the ``skip`` attribute
             get_tracer().record(
                 "kv.restore", ctx, start=t0, end=t1, service="disagg",
-                **{k: v for k, v in info.items() if v is not None})
+                reason="restore",
+                **{("skip" if k == "reason" else k): v
+                   for k, v in info.items() if v is not None})
             if self._migration_total is not None:
                 self._migration_total.inc(outcome=info["outcome"])
                 if info["restored_blocks"]:
@@ -487,6 +587,136 @@ class DecodeWorkerHandler:
                     self._migration_recomputed_tokens.inc(
                         info["recomputed_tokens"])
                 self._migration_restore_seconds.observe(t1 - t0)
+
+    async def _onboard_prefix(self, req, ctx) -> dict:
+        """Routine prefix onboarding (docs/performance.md): execute the
+        router's admission plan — pull the prompt prefix this worker is
+        missing from the cheapest peer that holds it (its device cache +
+        G2/G3, over ``kv_pull``), or warm it from the fleet-global G4
+        object store when no cheap peer exists — and attach it through
+        the prefix cache so the subsequent generate() recomputes only the
+        tail. NEVER raises; every failure mode (torn bundle, slow pull,
+        dead source, thin deadline) degrades to exactly the recompute the
+        pre-onboard fleet always paid."""
+        from dynamo_tpu.disagg.transfer import restore_pull_timeout
+
+        cfg = self.onboard_config
+        bs = self.engine.args.block_size
+        t0 = time.time()
+        info = {"outcome": "recomputed", "restored_blocks": 0,
+                "g4_blocks": 0, "local_blocks": 0, "pulls": 0,
+                "pull_failures": 0, "reason": None}
+        matchable = 0
+        covered = 0
+        slot = False
+        dedup_key = None
+        fut = None
+        try:
+            probe = (self.engine.restore_probe(req)
+                     if hasattr(self.engine, "restore_probe") else None)
+            if probe is None:
+                info["reason"] = "unmatchable"
+                return info
+            hashes = probe.sequence_hashes()
+            matchable = len(hashes)
+            covered = self.engine.resident_prefix_blocks(probe)
+            info["local_blocks"] = covered
+            if covered >= matchable:
+                return info  # plan was stale: the prefix is already local
+            plan = req.onboard or {}
+            g4_blocks = min(int(plan.get("g4_blocks") or 0), matchable)
+            want = min(matchable, covered + max(0, cfg.max_blocks))
+            sources = [(int(w), int(n), float(c))
+                       for w, n, c in (plan.get("sources") or [])
+                       if int(w) != (self.instance_id or -1)
+                       and int(n) > covered]
+            sources.sort(key=lambda t: (-min(t[1], want), t[2]))
+            if ((not sources and g4_blocks <= covered)
+                    or want - covered < cfg.min_blocks):
+                info["reason"] = "no_sources"
+                return info
+            timeout = restore_pull_timeout(
+                cfg.pull_timeout_cap_s, self._remaining_s(ctx))
+            if timeout is None:
+                info["reason"] = "deadline"
+                return info
+            # dedupe: a shared prefix arriving N-wide pulls ONCE — the
+            # followers wait for the first puller, then re-check local
+            # coverage (its attach made them ordinary prefix hits)
+            dedup_key = hashes[covered]
+            holder = self._onboard_inflight.get(dedup_key)
+            if holder is not None:
+                try:
+                    await asyncio.wait_for(asyncio.shield(holder), timeout)
+                except Exception:
+                    pass
+                covered = self.engine.resident_prefix_blocks(probe)
+                info["local_blocks"] = covered
+                info["reason"] = "dedup"
+                return info
+            fut = asyncio.get_running_loop().create_future()
+            self._onboard_inflight[dedup_key] = fut
+            # onboard budget: bounded wait on the SEPARATE onboard
+            # semaphore — never the restore slots (docs/performance.md)
+            try:
+                await asyncio.wait_for(self._onboard_slots.acquire(),
+                                       timeout=timeout)
+            except asyncio.TimeoutError:
+                info["reason"] = "budget"
+                return info
+            slot = True
+            covered = max(covered,
+                          self.engine.resident_prefix_blocks(probe))
+            info["local_blocks"] = covered
+            if covered >= matchable:
+                return info
+            sources = [s for s in sources if s[1] > covered]
+            want = min(matchable, covered + max(0, cfg.max_blocks))
+            covered = await self._pull_from_sources(
+                probe, hashes, sources, covered, want, cfg, ctx, info,
+                reason="onboard")
+            if covered < min(g4_blocks, want) and info["reason"] is None:
+                # no peer could serve (or served short): warm the rest
+                # from the fleet-global G4 prefix store (cold start)
+                attached = await self.engine.onboard_remote(
+                    probe, covered, min(g4_blocks, want))
+                covered += attached
+                info["g4_blocks"] = attached
+            return info
+        except Exception:
+            logger.exception("prefix onboard failed; recomputing")
+            return info
+        finally:
+            if slot:
+                self._onboard_slots.release()
+            if fut is not None:
+                self._onboard_inflight.pop(dedup_key, None)
+                if not fut.done():
+                    fut.set_result(None)
+            if info["restored_blocks"] > 0:
+                info["outcome"] = "pulled"
+            elif info["g4_blocks"] > 0:
+                info["outcome"] = "g4"
+            elif matchable > 0 and info["local_blocks"] >= matchable:
+                info["outcome"] = "local"
+            info["covered_blocks"] = covered
+            info["recomputed_tokens"] = max(
+                0, len(req.token_ids) - covered * bs)
+            t1 = time.time()
+            get_tracer().record(
+                "kv.restore", ctx, start=t0, end=t1, service="disagg",
+                reason="onboard",
+                **{("skip" if k == "reason" else k): v
+                   for k, v in info.items() if v is not None})
+            if self._onboard_total is not None:
+                self._onboard_total.inc(outcome=info["outcome"])
+                if info["restored_blocks"]:
+                    self._onboard_blocks.inc(info["restored_blocks"],
+                                             source="peer")
+                if info["g4_blocks"]:
+                    self._onboard_blocks.inc(info["g4_blocks"],
+                                             source="g4")
+                self._onboard_seconds.observe(t1 - t0)
 
     async def _generate_disagg(self, req: PreprocessedRequest, ctx):
         import dataclasses

@@ -344,22 +344,58 @@ class RestoreConfig:
     @classmethod
     def from_env(cls, env=None) -> "RestoreConfig":
         env = os.environ if env is None else env
-
-        def _f(key, default, cast):
-            raw = env.get(key)
-            if raw is None or raw == "":
-                return default
-            try:
-                return cast(raw)
-            except ValueError:
-                raise ValueError(f"bad {key}={raw!r}") from None
-
+        _f = _env_caster(env)
         return cls(
             enabled=env.get("DYN_RESTORE", "1") not in ("0", "false", "off"),
             pull_timeout_cap_s=_f("DYN_RESTORE_PULL_TIMEOUT", 5.0, float),
             max_blocks=_f("DYN_RESTORE_MAX_BLOCKS", 4096, int),
             max_concurrent=_f("DYN_RESTORE_MAX_CONCURRENT", 2, int),
             min_blocks=_f("DYN_RESTORE_MIN_BLOCKS", 1, int),
+        )
+
+
+def _env_caster(env):
+    def _f(key, default, cast):
+        raw = env.get(key)
+        if raw is None or raw == "":
+            return default
+        try:
+            return cast(raw)
+        except ValueError:
+            raise ValueError(f"bad {key}={raw!r}") from None
+
+    return _f
+
+
+@dataclass
+class OnboardConfig:
+    """Routine prefix onboarding policy (``DYN_ONBOARD_*`` env,
+    docs/performance.md). The admission-path twin of :class:`RestoreConfig`
+    with a DELIBERATELY separate concurrency budget: onboard pulls are an
+    optimization on healthy traffic and must never starve crash-restore
+    pulls (which race a migration deadline) of their
+    ``DYN_RESTORE_MAX_CONCURRENT`` slots — or vice versa.
+
+    The pull-timeout cap defaults lower than restore's: an onboard miss
+    costs one prefill recompute the pre-onboard fleet paid anyway, so a
+    slow pull should cut over to recompute quickly."""
+
+    enabled: bool = True
+    pull_timeout_cap_s: float = 2.0
+    max_blocks: int = 4096
+    max_concurrent: int = 2
+    min_blocks: int = 1
+
+    @classmethod
+    def from_env(cls, env=None) -> "OnboardConfig":
+        env = os.environ if env is None else env
+        _f = _env_caster(env)
+        return cls(
+            enabled=env.get("DYN_ONBOARD", "1") not in ("0", "false", "off"),
+            pull_timeout_cap_s=_f("DYN_ONBOARD_PULL_TIMEOUT", 2.0, float),
+            max_blocks=_f("DYN_ONBOARD_MAX_BLOCKS", 4096, int),
+            max_concurrent=_f("DYN_ONBOARD_MAX_CONCURRENT", 2, int),
+            min_blocks=_f("DYN_ONBOARD_MIN_BLOCKS", 1, int),
         )
 
 
@@ -380,13 +416,17 @@ def restore_pull_timeout(cap_s: float,
 
 
 async def pull_restore_blocks(client, instance_id: int, hashes: list[int],
-                              timeout_s: float) -> list:
+                              timeout_s: float,
+                              reason: str = "restore") -> list:
     """Pull a contiguous run of KV blocks from ``instance_id``'s
     ``kv_pull`` endpoint. Returns ordered [(seq_hash, k, v), ...] — the
     longest leading run the peer could serve (possibly short, never
     reordered). Raises on transport failure or timeout; the caller
-    degrades to recompute. Chaos hook ``kv.direct_pull`` injects failures
-    here so the degradation path is provable in tier-1."""
+    degrades to recompute. ``reason`` ("restore" | "onboard") rides the
+    request so the serving peer applies the matching concurrency budget
+    (KvPullHandler — routine onboarding must never starve crash restores).
+    Chaos hook ``kv.direct_pull`` injects failures here so the degradation
+    path is provable in tier-1."""
     from dynamo_tpu.kvbm.distributed import _unpack_block
     from dynamo_tpu.runtime.chaos import ChaosError, get_chaos
 
@@ -395,7 +435,8 @@ async def pull_restore_blocks(client, instance_id: int, hashes: list[int],
         raise ChaosError("injected kv.direct_pull failure (restore)")
 
     stream = await client.generate(
-        {"hashes": list(hashes)}, mode="direct", instance_id=instance_id)
+        {"hashes": list(hashes), "reason": reason},
+        mode="direct", instance_id=instance_id)
 
     async def consume():
         out = []

@@ -183,6 +183,28 @@ class AsyncJaxEngine:
         #: (RemoteKvbm — leader lookup + peer fetch)
         self.kvbm_remote = None
         self._offload_tasks: set = set()
+        #: G4 prefix flow-up (docs/performance.md): prefix-cache hit
+        #: counts per sequence hash; a block crossing the threshold is
+        #: pushed to the fleet-global object store so cold workers can
+        #: warm from it. 0 disables the flow-up (G4 then fills only via
+        #: the eviction cascade, as before).
+        import os as _os
+
+        raw_hits = _os.environ.get("DYN_G4_PUBLISH_HITS")
+        if raw_hits in (None, ""):
+            self._g4_publish_hits = 2
+        elif raw_hits in ("0", "off", "false"):
+            self._g4_publish_hits = 0
+        else:
+            try:
+                self._g4_publish_hits = int(raw_hits)
+            except ValueError:
+                # same startup-clarity contract as the DYN_ONBOARD_* /
+                # DYN_RESTORE_* knobs (transfer._env_caster)
+                raise ValueError(
+                    f"bad DYN_G4_PUBLISH_HITS={raw_hits!r}") from None
+        self._prefix_hits: dict = {}
+        self._g4_publishing: set = set()
 
         self.pool = BlockPool(nb, args.enable_prefix_caching,
                               on_removed=self._on_removed)
@@ -235,7 +257,8 @@ class AsyncJaxEngine:
             args, self.pool, on_stored=self._on_stored,
             onboard_cb=self._onboard if self.kvbm is not None else None,
             swapper=self if self._swap is not None else None,
-            token_budget=self._ragged)
+            token_budget=self._ragged,
+            hot_cb=self._note_hot_prefix if self.kvbm is not None else None)
         if self._pp > 1:
             from dynamo_tpu.parallel.pipeline import make_pp_step_fn
             self.step_fn = make_pp_step_fn(
@@ -2676,6 +2699,97 @@ class AsyncJaxEngine:
             logger.exception("KVBM offload failed")
         finally:
             self.pool.release(block_ids)
+
+    def _note_hot_prefix(self, probe, n: int) -> None:
+        """Scheduler prefix-HIT hook (G4 flow-up, docs/performance.md):
+        count repeat hits per block; leading runs whose blocks cross
+        DYN_G4_PUBLISH_HITS are pushed up to the G4 object store so the
+        whole fleet — including cold-started workers — can warm from
+        them. Hits arrive leading-run-shaped, so a block's ancestors
+        always cross the threshold no later than it does and the G4
+        radix chain stays root-anchored."""
+        if (self._g4_publish_hits <= 0 or self.kvbm is None
+                or self.kvbm.remote is None):
+            return
+        hashes = probe.sequence_hashes()[:n]
+        if len(self._prefix_hits) > (1 << 16):
+            # bounded popularity state: drop the oldest half (dict order =
+            # insertion order; hot prefixes re-earn their counts quickly)
+            for h in list(itertools.islice(self._prefix_hits, 1 << 15)):
+                del self._prefix_hits[h]
+        todo = []
+        for h in hashes:
+            c = self._prefix_hits.get(h, 0) + 1
+            self._prefix_hits[h] = c
+            if c >= self._g4_publish_hits and h not in self._g4_publishing:
+                todo.append(h)
+        if not todo:
+            return
+        self._g4_publishing.update(todo)
+
+        async def run():
+            try:
+                # tier reads + object-store writes off the event loop, in
+                # prefix order (parents first — the announcer's chain
+                # rule). The thread only READS engine state; _prefix_hits
+                # is mutated exclusively on the loop (below), so the trim
+                # above can never race a cross-thread pop.
+                def work():
+                    already = self.kvbm.remote_resident(todo)
+                    missed, queued = [], 0
+                    for h in todo:
+                        if h in already:
+                            continue  # LRU-touched; no byte read needed
+                        e = self.kvbm.get_local(h)
+                        if e is None:
+                            missed.append(h)
+                            continue
+                        if self.kvbm.publish_remote(h, e[0], e[1],
+                                                    drain=False):
+                            # drain every 16 queued writes: one drain
+                            # cycle per batch, bounded payload residency
+                            # in the op queue
+                            queued += 1
+                            if queued % 16 == 0:
+                                self.kvbm.drain_remote()
+                    if queued % 16:
+                        self.kvbm.drain_remote()
+                    return missed
+
+                for h in await asyncio.to_thread(work):
+                    # device-only so far (the G1→G2 offload is still in
+                    # flight): forget the threshold crossing so the NEXT
+                    # hit retries once the bytes reach a tier
+                    self._prefix_hits.pop(h, None)
+            except Exception:
+                logger.exception("G4 prefix flow-up failed")
+            finally:
+                self._g4_publishing.difference_update(todo)
+
+        task = asyncio.get_running_loop().create_task(run())
+        self._offload_tasks.add(task)
+        task.add_done_callback(self._offload_tasks.discard)
+
+    async def onboard_remote(self, probe, start: int, end: int) -> int:
+        """G4 → host → device warmup at admission (routine onboarding's
+        cold-start path, docs/performance.md): fetch the leading run of
+        ``probe``'s missing blocks [start, end) out of the fleet-global
+        object store into the host tier (worker thread — blocking plane
+        I/O), then scatter/register them like any KVBM onboard. The
+        attached blocks park in the LRU (refcount 0) for the subsequent
+        generate()'s prefix match, so a failure leaks nothing. Returns
+        blocks attached."""
+        if self.kvbm is None or self.kvbm.remote is None or end <= start:
+            return 0
+        hashes = probe.sequence_hashes()[start:end]
+        landed = await asyncio.to_thread(self.kvbm.fetch_remote, hashes)
+        if not landed:
+            return 0
+        ids = self._onboard(probe, start, start + landed)
+        if not ids:
+            return 0
+        self.pool.release(ids)
+        return len(ids)
 
     def _onboard(self, probe, start: int, end: int) -> list[int]:
         """G2→G1 at admission: missing prefix blocks found in the HOST tier

@@ -473,6 +473,21 @@ async def amain():
         "decode").add_callback(
         lambda: {None: engine.spec_disabled_total})
 
+    # prefix-hit provenance (docs/performance.md "prefix onboarding"):
+    # together with dynamo_prefix_onboard_* these answer "where do this
+    # worker's cache hits actually come from" — local hits here, pulled /
+    # G4-warmed / recomputed from the onboard counters
+    runtime.metrics.counter(
+        "prefix_hit_tokens_total",
+        "prompt tokens served from the local prefix cache (device + "
+        "KVBM onboard + peer/G4 attaches)").add_callback(
+        lambda: {None: engine.scheduler.prefix_hit_tokens})
+    runtime.metrics.counter(
+        "prefix_query_tokens_total",
+        "prompt tokens that went through prefix-cache admission "
+        "matching").add_callback(
+        lambda: {None: engine.scheduler.prefix_query_tokens})
+
     # padded-dispatch waste + compiled-signature census (docs/performance.md
     # ragged section): the bucket-lattice-vs-ragged contrast, readable off
     # /metrics instead of only from bench output
@@ -597,11 +612,20 @@ async def amain():
         if engine.kvbm is None:
             ap.error("--kvbm-g4-gb requires --kvbm-host-gb (G4 backstops "
                      "the host/disk tiers)")
-        from dynamo_tpu.kvbm.distributed import ObjectStoreG4Client
+        from dynamo_tpu.kvbm.distributed import (
+            G4PrefixAnnouncer, ObjectStoreG4Client,
+        )
         engine.kvbm.attach_remote(
             ObjectStoreG4Client(runtime.plane, asyncio.get_running_loop(),
                                 cli.namespace),
             int(cli.kvbm_g4_gb * (1 << 30)))
+        # fleet-global prefix store (docs/performance.md): G4-resident
+        # prefixes are announced to the routers' radix index under the
+        # sentinel source id, so admission onboard plans can warm cold
+        # workers from object storage instead of burning peer pulls
+        g4_announcer = await G4PrefixAnnouncer(
+            runtime.plane, kv_pub, asyncio.get_running_loop()).start()
+        engine.kvbm.on_remote_change = g4_announcer.on_remote_change
     if cli.kvbm_distributed and engine.kvbm is None:
         ap.error("--kvbm-distributed needs --kvbm-host-gb > 0")
     if cli.kvbm_leader_workers or cli.kvbm_distributed:
@@ -742,6 +766,9 @@ async def amain():
         cold_beacon.cancel()
     if mm_worker is not None:
         await mm_worker.stop()
+    if cli.kvbm_g4_gb > 0:
+        engine.kvbm.on_remote_change = None
+        await g4_announcer.stop()
     if kvbm_worker is not None:
         await kvbm_worker.stop()
     if kvbm_leader is not None:

@@ -145,7 +145,8 @@ class Scheduler:
                  on_stored: Optional[Callable] = None,
                  onboard_cb: Optional[Callable] = None,
                  swapper: Optional[object] = None,
-                 token_budget: bool = False):
+                 token_budget: bool = False,
+                 hot_cb: Optional[Callable] = None):
         self.args = args
         self.pool = pool
         #: ragged-step planning (docs/performance.md): the step is ONE
@@ -165,6 +166,10 @@ class Scheduler:
         #: — KVBM onboard hook: device-misses found in host/disk tiers come
         #: back as freshly scattered device blocks extending the prefix hit
         self.onboard_cb = onboard_cb
+        #: fn(probe, hit_blocks) — prefix-HIT popularity hook: the G4
+        #: flow-up policy (engine._note_hot_prefix) counts repeat hits and
+        #: pushes hot prefixes to the fleet-global object store
+        self.hot_cb = hot_cb
         #: preempt-to-swap backend (the engine): swap_out(seq) -> bool,
         #: swap_status(seq) -> "ready"|"pending"|"failed", swap_in(seq) ->
         #: bool, swap_drop(seq). None = recompute preemption only.
@@ -777,6 +782,10 @@ class Scheduler:
         if not hit_blocks:
             return
         n = len(hit_blocks)
+        if self.hot_cb is not None:
+            # popularity signal for the G4 prefix flow-up: this leading
+            # run was just re-used (never fired on the cold first compute)
+            self.hot_cb(probe, n)
         seq.block_table = list(hit_blocks)
         seq.num_computed = n * bs
         seq.num_cached_prompt = n * bs

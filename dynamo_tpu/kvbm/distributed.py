@@ -62,9 +62,9 @@ def _pack_block(h: int, k: np.ndarray, v: np.ndarray) -> dict:
 
 
 def _unpack_block(d: dict) -> tuple[int, np.ndarray, np.ndarray]:
-    import ml_dtypes
+    from dynamo_tpu.kvbm.tiers import resolve_dtype
 
-    dtype = np.dtype(getattr(ml_dtypes, d["dtype"], None) or d["dtype"])
+    dtype = resolve_dtype(d["dtype"])
     k = np.frombuffer(d["k"], dtype).reshape(d["k_shape"]).copy()
     v = np.frombuffer(d["v"], dtype).reshape(d["v_shape"]).copy()
     return d["hash"], k, v
@@ -396,6 +396,84 @@ class KvbmController:
 
     async def stats(self) -> list[dict]:
         return await self._fanout({"op": "stats"})
+
+
+class G4PrefixAnnouncer:
+    """Announces G4-resident prefix blocks to the routers' radix index
+    under the :data:`~dynamo_tpu.router.protocols.G4_SOURCE_ID` sentinel
+    worker — the "radix layer knows G4-resident prefixes" half of the
+    fleet-global prefix store (docs/performance.md).
+
+    Rides the worker's own :class:`KvEventPublisher` mirror for chain
+    metadata (parent sequence hash + tokens hash — the KVBM layer only
+    knows bare sequence hashes), and publishes through a SECOND publisher
+    bound to the sentinel id, so the router needs no new event shape: the
+    G4 store looks like one more worker that happens not to be routable.
+    ``prefix_sources`` then reports it; the router's onboard planner pops
+    it into ``g4_blocks`` instead of a pull slot — peers' pull attempts
+    are never burned on it (the failure mode PR 10's review ruled out).
+
+    Chain discipline: a block is announced only when its parent is the
+    root or already G4-announced. Announcing a mid-chain block would be an
+    eternal orphan at every indexer (removal-keyed lookups would miss it)
+    and would re-trigger fleet-wide resyncs each time. Hot prefixes flow
+    up leading-run-first (engine._note_hot_prefix), so in practice chains
+    anchor immediately; cascade-driven mid-chain arrivals simply stay
+    unadvertised until their ancestors land.
+
+    Fired from KVBM drain threads — hops onto the runtime loop before
+    touching the publisher.
+    """
+
+    def __init__(self, plane, source_pub, loop=None):
+        from dynamo_tpu.router.protocols import G4_SOURCE_ID
+        from dynamo_tpu.router.publisher import KvEventPublisher
+
+        self.source = source_pub
+        self.pub = KvEventPublisher(
+            plane, worker_id=G4_SOURCE_ID,
+            kv_block_size=source_pub.kv_block_size)
+        self.loop = loop or asyncio.get_event_loop()
+        self.announced = 0
+        self.skipped_unanchored = 0
+
+    async def start(self) -> "G4PrefixAnnouncer":
+        # router gap-resyncs replay this worker's view of the G4 set too
+        # (idempotent upserts; overlapping replays from peers re-confirm)
+        await self.pub.start_resync_responder()
+        return self
+
+    async def stop(self):
+        await self.pub.stop()
+
+    def on_remote_change(self, stored, removed) -> None:
+        """KvbmManager.on_remote_change hook; callable from any thread."""
+        self.loop.call_soon_threadsafe(
+            self._apply, list(stored), list(removed))
+
+    def _apply(self, stored: list, removed: list) -> None:
+        from dynamo_tpu.router.protocols import KvCacheEvent, StoredBlock
+
+        for h in stored:
+            if h in self.pub._announced:
+                continue
+            meta = self.source._announced.get(h)
+            if meta is None:
+                # the local mirror no longer knows this block's chain
+                # position (removal already published) — unanchorable
+                self.skipped_unanchored += 1
+                continue
+            parent, tokens_hash = meta
+            if parent is not None and parent not in self.pub._announced:
+                self.skipped_unanchored += 1
+                continue
+            self.pub.publish_sync(KvCacheEvent.stored(
+                0, parent, [StoredBlock(block_hash=h,
+                                        tokens_hash=tokens_hash)]))
+            self.announced += 1
+        gone = [h for h in removed if h in self.pub._announced]
+        if gone:
+            self.pub.publish_sync(KvCacheEvent.removed(0, gone))
 
 
 class ObjectStoreG4Client:

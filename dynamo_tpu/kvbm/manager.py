@@ -49,6 +49,13 @@ class KvbmManager:
         #: treat them as misses WITHOUT discarding the index entry, or the
         #: later write leaks an orphaned object
         self._pending_puts: set = set()
+        #: hashes THIS worker wrote to G4 (offload cascade / flow-up) —
+        #: the only ones its budget evictions may delete remotely. Index
+        #: entries recorded by fetch_remote are residency facts about
+        #: FLEET-shared objects other workers own and still advertise;
+        #: deleting those would poison every peer's index and the
+        #: sentinel radix with no retraction path.
+        self._remote_owned: set = set()
         #: serializes drains end-to-end so a delete queued after a put can
         #: never execute before it (two offload threads draining)
         self._drain_lock = threading.Lock()
@@ -59,6 +66,13 @@ class KvbmManager:
         #: cleared-all. Feeds the distributed KVBM leader's ownership map
         #: (ref: block_manager/events.rs block store/evict events).
         self.on_change = on_change
+        #: on_remote_change(stored_hashes, removed_hashes) — fired from
+        #: the drain, OUTSIDE every lock, only after the G4 object store
+        #: round trip actually succeeded (a stored announcement for an
+        #: unwritten object would send cold workers fetching a miss).
+        #: Feeds the G4PrefixAnnouncer's sentinel radix events
+        #: (kvbm/distributed.py) so the FLEET knows G4-resident prefixes.
+        self.on_remote_change = None
 
     def _notify(self, stored: list[int], removed) -> None:
         """Fire on_change. MUST be called with the lock held: mutation and
@@ -111,14 +125,21 @@ class KvbmManager:
                         self._pending_puts.discard(h)
                         if failed and self.remote is not None:
                             self.remote.discard(h)
+                            self._remote_owned.discard(h)
                             self._notify_if_gone(h)
+                    if not failed:
+                        self._fire_remote_change([h], [])
+                elif not failed:
+                    self._fire_remote_change([], [h])
                 elif failed:
                     # the index entry is already gone — dropping the delete
                     # would orphan the object in the plane's store forever
                     # on a flaky plane; park it for the NEXT drain (retrying
                     # in this loop would exhaust the budget in milliseconds)
                     with self._lock:
-                        if attempts + 1 < 5 and self.remote is not None:
+                        gave_up = not (attempts + 1 < 5
+                                       and self.remote is not None)
+                        if not gave_up:
                             self._remote_retry.append(
                                 ("delete", h, None, attempts + 1))
                         else:
@@ -126,6 +147,21 @@ class KvbmManager:
                                 "kvbm G4 delete for %x gave up after %d "
                                 "attempts — object orphaned in the store",
                                 h, attempts + 1)
+                    if gave_up:
+                        # nothing tracks the orphan anymore — stop
+                        # advertising it to the fleet
+                        self._fire_remote_change([], [h])
+
+    def _fire_remote_change(self, stored: list, removed: list) -> None:
+        """Fire on_remote_change. MUST be called WITHOUT the lock — the
+        callback publishes to the control plane (G4PrefixAnnouncer) and
+        must never be able to deadlock a tier mutation."""
+        cb = self.on_remote_change
+        if cb is not None and (stored or removed):
+            try:
+                cb(stored, removed)
+            except Exception:
+                logger.exception("kvbm on_remote_change callback failed")
 
     def _notify_if_gone(self, h: int) -> None:
         """Announce removal when ``h`` left its LAST tier (lock held) —
@@ -209,6 +245,112 @@ class KvbmManager:
         with self._lock:
             return list(self.host._store)
 
+    # -- G4 as the fleet-global prefix store (docs/performance.md) -----------
+
+    def remote_resident(self, hashes) -> set:
+        """The subset of ``hashes`` already in the G4 index, LRU-touched,
+        under one lock — the flow-up's cheap skip: an already-remote hot
+        block needs its LRU slot refreshed, not a tier byte read."""
+        with self._lock:
+            if self.remote is None:
+                return set()
+            out = set()
+            for h in hashes:
+                if h in self.remote:
+                    self.remote.touch(h)
+                    out.add(h)
+            return out
+
+    def publish_remote(self, h: int, k: np.ndarray, v: np.ndarray,
+                       drain: bool = True) -> bool:
+        """Proactively push one HOT block up to G4 (prefix flow-up): unlike
+        the eviction cascade, the block keeps its local copies — G4 gains a
+        fleet-readable replica. True = a write was queued; False = G4 not
+        armed or the block is already remote (its LRU slot is refreshed so
+        hot prefixes stay resident under a byte budget). ``drain=False``
+        lets a multi-block run queue writes and flush once via
+        :meth:`drain_remote` instead of paying a drain cycle per block."""
+        with self._lock:
+            if self.remote is None:
+                return False
+            if h in self.remote:
+                self.remote.touch(h)
+                return False
+            removed = self._to_remote(h, k, v)
+            if removed:
+                self._notify([], removed)
+        if drain:
+            self._drain_remote()
+        return True
+
+    def drain_remote(self) -> None:
+        """Flush queued G4 I/O — the batch counterpart to
+        ``publish_remote(..., drain=False)``. Blocking round trips: never
+        call on the event loop."""
+        self._drain_remote()
+
+    def fetch_remote(self, hashes, max_blocks: Optional[int] = None) -> int:
+        """Read a LEADING run of ``hashes`` out of the G4 object store into
+        the host tier (cold-start warmup). BYPASSES the local index for
+        misses: a cold worker's RemoteTier index is empty even when the
+        fleet's G4 store is warm — the router's sentinel radix entries are
+        the authority that sent us here. Stops at the first miss
+        (onboarding attaches contiguous prefixes only). Blocking I/O: run
+        in a worker thread, never on the event loop."""
+        budget = len(hashes) if max_blocks is None else int(max_blocks)
+        landed = 0
+        for h in hashes:
+            if landed >= budget:
+                break
+            with self._lock:
+                client = (self.remote.client if self.remote is not None
+                          else None)
+                have = (h in self.host
+                        or (self.disk is not None and h in self.disk))
+            if client is None:
+                break
+            if have:
+                landed += 1
+                continue
+            try:
+                data = client.get(h)
+            except Exception:
+                logger.exception("kvbm G4 warm fetch failed for %x", h)
+                data = None
+            if data is None:
+                break
+            from dynamo_tpu.kvbm.tiers import RemoteTier
+
+            try:
+                k, v = RemoteTier.decode(data)
+            except Exception:
+                logger.exception("kvbm G4 payload for %x undecodable", h)
+                break
+            with self._lock:
+                if self.remote is None:
+                    break
+                # record the proven remote residency in the local index.
+                # Budget evictions here drop INDEX entries only — NEVER
+                # queue object deletes: a cold warmer does not own the
+                # fleet's shared objects, and deleting them would poison
+                # every peer's index and the sentinel radix (the
+                # announcer that advertised them could never retract).
+                # The one exception: our OWN queued-but-unwritten put,
+                # which is cancelled outright so it can't orphan an
+                # object the index just forgot.
+                for rh in self.remote.reserve(h, len(data)):
+                    if rh in self._pending_puts:
+                        self._remote_ops = [
+                            op for op in self._remote_ops
+                            if not (op[0] == "put" and op[1] == rh)]
+                        self._pending_puts.discard(rh)
+                        self._remote_owned.discard(rh)
+                removed = self._cascade(self.host.put(h, k, v))
+                self._notify([h], removed)
+            landed += 1
+        self._drain_remote()
+        return landed
+
     def _cascade(self, host_evicted) -> list[int]:
         """Push host evictions down the tiers (G2→G3→G4); return hashes
         gone from ALL tiers. Caller holds the lock. Evictions out of a
@@ -246,13 +388,18 @@ class KvbmManager:
         payload = RemoteTier.encode(k, v)
         gone = []
         for rh in self.remote.reserve(h, len(payload)):
-            self._remote_ops.append(("delete", rh, None))
             self._pending_puts.discard(rh)
+            if rh in self._remote_owned:
+                # only objects this worker wrote may be deleted remotely;
+                # fetched (shared) entries leave the index silently
+                self._remote_owned.discard(rh)
+                self._remote_ops.append(("delete", rh, None))
             if rh not in self.host and (self.disk is None
                                         or rh not in self.disk):
                 gone.append(rh)
         self._remote_ops.append(("put", h, payload))
         self._pending_puts.add(h)
+        self._remote_owned.add(h)
         return gone
 
     # -- runtime controller surface (ref: block_manager/controller.rs) -------
@@ -264,8 +411,13 @@ class KvbmManager:
             if self.disk is not None:
                 self.disk.clear()
             if self.remote is not None:
+                # admin reset drops the whole local index but deletes
+                # only objects THIS worker wrote — fetched entries are
+                # fleet-shared objects some other worker still advertises
                 self._remote_ops.extend(
-                    ("delete", h, None) for h in self.remote.clear())
+                    ("delete", h, None) for h in self.remote.clear()
+                    if h in self._remote_owned)
+                self._remote_owned.clear()
             self._notify([], None)
         self._drain_remote()
 

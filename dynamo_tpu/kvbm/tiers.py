@@ -19,6 +19,16 @@ import numpy as np
 logger = logging.getLogger("dynamo.kvbm")
 
 
+def resolve_dtype(name: str) -> np.dtype:
+    """Dtype from its string name, resolving non-numpy names (bf16, the
+    default TPU KV dtype) through ml_dtypes — the ONE copy of the idiom
+    the disk tier, the G4 wire codec, and the distributed block codec all
+    share."""
+    import ml_dtypes
+
+    return np.dtype(getattr(ml_dtypes, name, None) or name)
+
+
 class HostTier:
     """G2: host-DRAM LRU block store with a byte budget.
 
@@ -153,10 +163,7 @@ class DiskTier:
             return None
         try:
             with np.load(self._path(h), allow_pickle=False) as z:
-                import ml_dtypes
-
-                dtype = np.dtype(getattr(ml_dtypes, str(z["dtype"]), None)
-                                 or str(z["dtype"]))
+                dtype = resolve_dtype(str(z["dtype"]))
                 k = z["k"].view(dtype).reshape(tuple(z["k_shape"]))
                 v = z["v"].view(dtype).reshape(tuple(z["v_shape"]))
         except Exception:
@@ -254,7 +261,7 @@ class RemoteTier:
 
         (n,) = _struct.unpack_from("<I", data)
         hdr = _json.loads(data[4:4 + n].decode())
-        k_dt, v_dt = np.dtype(hdr["kd"]), np.dtype(hdr["vd"])
+        k_dt, v_dt = resolve_dtype(hdr["kd"]), resolve_dtype(hdr["vd"])
         k_n = int(np.prod(hdr["ks"])) * k_dt.itemsize
         off = 4 + n
         k = np.frombuffer(data[off:off + k_n], k_dt).reshape(hdr["ks"])

@@ -118,6 +118,18 @@ class PreprocessedRequest:
     #: surviving peers instead of re-prefilling it. Absent on the wire for
     #: every non-migrated request — pre-restore peers interop unchanged.
     restore: Optional[dict] = None
+    #: routine prefix onboarding (docs/performance.md): set by the KV
+    #: router at admission when PEERS hold more of this prompt's prefix
+    #: than the chosen worker and pulling it beats recomputing it
+    #: ({"sources": [[worker_id, prefix_blocks, rel_cost], ...],
+    #: "block_size": bs, "g4_blocks": n}) — the same plan shape the
+    #: restore path uses, so the worker pulls over the identical
+    #: kv_pull → export_blocks → attach_restored machinery. ``g4_blocks``
+    #: is how much of the prefix the fleet-global G4 object store holds
+    #: (cold-start warmup source when no cheap peer exists). Absent on
+    #: the wire when no plan was attached — pre-onboard peers interop
+    #: unchanged, and DYN_ONBOARD=0 keeps payloads byte-identical.
+    onboard: Optional[dict] = None
 
     def mm_digest(self) -> Optional[int]:
         """Stable content hash of the multimodal payload — salts the block
@@ -155,6 +167,10 @@ class PreprocessedRequest:
             # keep non-migrated payloads byte-identical to pre-restore
             # builds (the field exists only on migration re-sends)
             d.pop("restore")
+        if d.get("onboard") is None:
+            # same interop discipline: the key rides only when the router
+            # attached a plan
+            d.pop("onboard")
         return d
 
     @staticmethod
@@ -174,6 +190,7 @@ class PreprocessedRequest:
             mm_refs=d.get("mm_refs"),
             router_config_override=d.get("router_config_override"),
             restore=d.get("restore"),
+            onboard=d.get("onboard"),
         )
 
 

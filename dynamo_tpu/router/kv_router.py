@@ -21,7 +21,7 @@ from typing import AsyncIterator, Optional
 
 from dynamo_tpu.protocols import Annotated, PreprocessedRequest
 from dynamo_tpu.router.indexer import ApproxKvIndexer, KvIndexer, OverlapScores
-from dynamo_tpu.router.protocols import KvRouterConfig
+from dynamo_tpu.router.protocols import G4_SOURCE_ID, KvRouterConfig
 from dynamo_tpu.router.scheduler import KvScheduler, NoWorkersError, SchedulingDecision
 from dynamo_tpu.runtime.component import Client
 from dynamo_tpu.runtime.context import (
@@ -147,6 +147,7 @@ class KvRouter:
             priority=priority,
             link_costs=link_costs,
         )
+        decision.best_overlap_blocks = overlaps.best()
         if isinstance(self.indexer, ApproxKvIndexer):
             self.indexer.process_routing_decision_for_request(token_ids, decision.worker_id)
         if self.config.router_replica_sync:
@@ -227,6 +228,14 @@ class KvPushRouter:
         #: the SAME id must not resurrect its previous life's KV index
         #: entries (dead-instance hygiene, docs/robustness.md)
         self._dead_ids: set[int] = set()
+        #: routine prefix onboarding (docs/performance.md): DYN_ONBOARD=0
+        #: is the one-switch escape to pre-onboard behavior at both ends
+        #: (no plan on the wire here, no pull at the worker)
+        import os as _os
+
+        self._onboard_on = (self.router.config.onboard_enabled
+                            and _os.environ.get("DYN_ONBOARD", "1")
+                            not in ("0", "false", "off"))
         add = getattr(client, "add_instance_listener", None)
         if add is not None:
             add(self._on_instance_event)
@@ -311,6 +320,9 @@ class KvPushRouter:
 
         sources = self.router.restore_sources(req.token_ids)
         sources.pop(worker_id, None)
+        # the G4 sentinel is not a pullable instance — a restore plan slot
+        # spent on it would burn one of the worker's two pull attempts
+        sources.pop(G4_SOURCE_ID, None)
         if not sources:
             req.restore = {**req.restore,
                            "block_size": self.router.block_size,
@@ -333,6 +345,64 @@ class KvPushRouter:
             "sources": [[wid, blocks, cost] for wid, blocks, cost
                         in ranked[:self.RESTORE_PLAN_SOURCES]],
         }
+
+    def _onboard_plan(self, req: PreprocessedRequest, decision) -> bool:
+        """Routine prefix onboarding (docs/performance.md): when peers (or
+        the G4 object store) hold more of this prompt's prefix than the
+        chosen worker, and pulling the missing blocks is cheaper than
+        recomputing them under the admission cost model, attach a ranked
+        pull plan — same shape as a restore plan, same worker-side
+        machinery. Returns True when a plan was attached."""
+        from dynamo_tpu.router.topology import (
+            TopologyCostModel, TopologyLabels, link_class,
+        )
+
+        cfg = self.router.config
+        bs = self.router.block_size
+        overlap = decision.overlap_blocks
+        # a worker attaches at most the prompt's full blocks minus one
+        # token (engine.restore_probe) — clamp every source to that
+        matchable = (len(req.token_ids) - 1) // bs
+        if matchable <= 0:
+            return False
+        # cheap gate: find_matches already told us the fleet's deepest
+        # overlap; only a meaningful gap is worth the prefix_sources walk
+        if (min(decision.best_overlap_blocks, matchable) - overlap
+                < cfg.onboard_min_blocks):
+            return False
+        sources = self.router.restore_sources(req.token_ids)
+        g4_blocks = min(sources.pop(G4_SOURCE_ID, 0), matchable)
+        sources.pop(decision.worker_id, None)
+        labels = self._peer_costs()
+        if self._topo_model is None:
+            self._topo_model = TopologyCostModel(cfg.link_gbps)
+        dst = labels.get(decision.worker_id) or TopologyLabels()
+        empty = TopologyLabels()
+        recompute_ms_per_block = bs * cfg.onboard_recompute_ms_per_token
+        ranked = []
+        for wid, blocks in sources.items():
+            gain = min(blocks, matchable) - overlap
+            if gain < cfg.onboard_min_blocks:
+                continue
+            rel = self._topo_model.rel_cost(link_class(
+                labels.get(wid) or empty, dst))
+            # the admission decision: pull only where it beats recompute
+            if cfg.onboard_pull_ms_per_block * rel < recompute_ms_per_block:
+                ranked.append((wid, min(blocks, matchable), rel))
+        g4_wins = (g4_blocks - overlap >= cfg.onboard_min_blocks
+                   and cfg.onboard_g4_ms_per_block < recompute_ms_per_block)
+        if not ranked and not g4_wins:
+            return False
+        ranked.sort(key=lambda t: (-t[1], t[2], t[0]))
+        plan = {
+            "block_size": bs,
+            "sources": [[wid, blocks, cost] for wid, blocks, cost
+                        in ranked[:self.RESTORE_PLAN_SOURCES]],
+        }
+        if g4_wins:
+            plan["g4_blocks"] = g4_blocks
+        req.onboard = plan
+        return True
 
     async def generate(self, req: PreprocessedRequest, ctx: Context) -> AsyncIterator:
         if isinstance(req, dict):
@@ -384,6 +454,18 @@ class KvPushRouter:
             return
 
         req.estimated_prefix_hit_num_blocks = decision.overlap_blocks
+        if (self._onboard_on and req.restore is None
+                and req.onboard is None):
+            # routine onboarding: the fleet's hot prefixes are a pull
+            # away — attach the plan when the cost model says pull wins
+            if self._onboard_plan(req, decision):
+                with get_tracer().span("router.onboard_plan", ctx,
+                                       service="router") as osp:
+                    osp.set(sources=len(req.onboard.get("sources") or []),
+                            g4_blocks=req.onboard.get("g4_blocks", 0),
+                            best_blocks=max(
+                                (s[1] for s in req.onboard["sources"]),
+                                default=req.onboard.get("g4_blocks", 0)))
         if req.restore is not None and "sources" not in req.restore:
             # migrated request: attach the KV-restore plan for the chosen
             # worker (docs/robustness.md) so it can pull the recoverable

@@ -23,6 +23,15 @@ KV_METRICS_SUBJECT = "kv_metrics"
 KV_RESYNC_SUBJECT = "kv_resync"
 #: object-store bucket for radix snapshots (ref: kv_router.rs:68-71)
 RADIX_STATE_BUCKET = "radix-bucket"
+#: sentinel "worker" id under which G4-resident prefix blocks are announced
+#: on the kv_events stream (kvbm/distributed.G4PrefixAnnouncer). The radix
+#: tree treats it like any worker, which is exactly what prefix_sources
+#: needs — but it is NOT a routable instance: the scheduler only scores ids
+#: from the discovery set, and plan builders must pop it from pull-source
+#: candidates (a kv_pull aimed at it would burn a peer's attempt, the
+#: failure mode PR 10's review ruled out). Negative by construction: real
+#: worker ids are control-plane leases, which are non-negative.
+G4_SOURCE_ID = -4
 
 
 @dataclass
@@ -201,6 +210,28 @@ class KvRouterConfig:
     #: term (the prefill fleet in a disagg deployment); "" disables the
     #: source watch entirely
     prefill_component: str = "prefill"
+    #: routine prefix onboarding (docs/performance.md): attach a peer-pull
+    #: plan to ordinary admissions whose prefix some peer holds more of
+    #: than the chosen worker. False — or DYN_ONBOARD=0 in the router
+    #: process — keeps every payload byte-identical to pre-onboard builds.
+    onboard_enabled: bool = True
+    #: don't plan a pull for less than this many missing prefix blocks —
+    #: below it the round trip costs more than it saves
+    onboard_min_blocks: int = 4
+    #: admission-time pull-vs-recompute cost model (NetKV-style): a pull
+    #: costs ``blocks × onboard_pull_ms_per_block × link rel_cost`` (rel
+    #: cost normalized to ici=1, router/topology.py), a recompute costs
+    #: ``blocks × block_size × onboard_recompute_ms_per_token``. Defaults
+    #: from docs/PERF_NOTES.md measurements (export 256 blocks ≈ 5 ms +
+    #: attach ≈ 3 ms → ~0.03 ms/block same-host; tiny-cpu prefill ≈
+    #: 0.5 ms/token): pull wins by orders of magnitude on proc/ici links
+    #: and loses only on links priced hundreds of times worse.
+    onboard_pull_ms_per_block: float = 0.05
+    onboard_recompute_ms_per_token: float = 0.5
+    #: per-block cost of warming from the G4 object store (two plane round
+    #: trips + host staging — slower than a peer pull, still far cheaper
+    #: than recompute)
+    onboard_g4_ms_per_block: float = 0.5
 
 
 @dataclass

@@ -69,6 +69,11 @@ class SchedulingDecision:
     overlap_blocks: int
     required_blocks: int
     logits: dict[int, float]
+    #: deepest radix overlap ANY source has (workers and the G4 sentinel
+    #: alike) — the cheap gate for the onboard-plan walk: when the chosen
+    #: worker is already within onboard_min_blocks of the fleet's best,
+    #: there is nothing worth pulling and prefix_sources is never queried
+    best_overlap_blocks: int = 0
 
 
 class KvScheduler:

@@ -186,6 +186,102 @@ def test_remote_tier_codec_roundtrip_bf16():
     np.testing.assert_array_equal(v2, v)
 
 
+def test_remote_tier_reserve_evict_discard_accounting():
+    """RemoteTier is only the index: reserve charges bytes and LRU-evicts
+    past the budget (never the entry just reserved), touch refreshes,
+    discard refunds exactly once."""
+    from dynamo_tpu.kvbm.tiers import RemoteTier
+
+    t = RemoteTier(client=None, capacity_bytes=300)
+    assert t.reserve(1, 100) == []
+    assert t.reserve(2, 100) == []
+    assert t.reserve(3, 100) == []
+    assert t.used == 300 and len(t) == 3
+    t.reserve(1, 100)  # re-reserve: LRU refresh, no double charge
+    assert t.used == 300
+    assert t.reserve(4, 100) == [2]  # oldest untouched entry out
+    assert t.used == 300 and 2 not in t and 1 in t
+    t.touch(3)
+    assert t.reserve(5, 100) == [1]  # touch saved 3; 1 now oldest
+    t.discard(3)
+    assert t.used == 200
+    t.discard(3)  # double discard must not go negative
+    assert t.used == 200
+    # an over-budget single entry still reserves (len>1 guard: the tier
+    # never evicts the entry it is reserving)
+    big = RemoteTier(client=None, capacity_bytes=10)
+    assert big.reserve(7, 100) == []
+    assert 7 in big and big.used == 100
+    assert set(big.clear()) == {7} and big.used == 0
+
+
+def test_remote_tier_codec_roundtrip_int8():
+    """Packed int8 KV blocks ([L, X] uint8 quant payload) survive the G4
+    wire codec bit-exactly."""
+    from dynamo_tpu.kvbm.tiers import RemoteTier
+
+    rng = np.random.default_rng(0)
+    k = rng.integers(0, 256, (2, 96), dtype=np.uint8)
+    v = rng.integers(0, 256, (2, 96), dtype=np.uint8)
+    k2, v2 = RemoteTier.decode(RemoteTier.encode(k, v))
+    assert k2.dtype == np.uint8 and v2.dtype == np.uint8
+    np.testing.assert_array_equal(k2, k)
+    np.testing.assert_array_equal(v2, v)
+
+
+def test_drain_remote_order_and_retry():
+    """_drain_remote performs queued G4 I/O strictly in queue order (a
+    delete queued after a put can never run first), outside the manager
+    lock, and parks failed deletes for the NEXT drain instead of
+    hot-looping them."""
+    from dynamo_tpu.kvbm.manager import KvbmManager
+
+    calls = []
+
+    class Client(_FakeG4Client):
+        fail_deletes = 0
+
+        def put(self, h, data):
+            calls.append(("put", h))
+            super().put(h, data)
+
+        def delete(self, h):
+            calls.append(("delete", h))
+            if self.fail_deletes > 0:
+                self.fail_deletes -= 1
+                raise RuntimeError("plane flake")
+            super().delete(h)
+
+    client = Client()
+    m = KvbmManager(host_bytes=1 << 20)
+    m.attach_remote(client, capacity_bytes=0)
+    k = page(1)
+    with m._lock:
+        m._to_remote(1, k, k)
+        m._to_remote(2, k, k)
+        # delete of 1 queued AFTER its put: order must hold through drain
+        m._remote_ops.append(("delete", 1, None))
+        m._pending_puts.discard(1)
+        m.remote.discard(1)
+    m._drain_remote()
+    assert calls == [("put", 1), ("put", 2), ("delete", 1)]
+    assert 1 not in client.store and 2 in client.store
+
+    # failed delete parks for the next drain (bounded retries)
+    calls.clear()
+    client.fail_deletes = 1
+    with m._lock:
+        m._remote_ops.append(("delete", 2, None))
+        m._pending_puts.discard(2)
+        m.remote.discard(2)
+    m._drain_remote()
+    assert calls == [("delete", 2)]  # one attempt this drain, then parked
+    assert m._remote_retry and 2 in client.store
+    m._drain_remote()  # retry merged at the head of the next drain
+    assert calls == [("delete", 2), ("delete", 2)]
+    assert 2 not in client.store and not m._remote_retry
+
+
 def test_g4_cascade_fetch_and_budget(tmp_path):
     """G2→G3→G4 cascade: disk evictions land in the object store with the
     bytes intact; get() falls all the way through and promotes; the G4

@@ -1,0 +1,518 @@
+"""Routine cross-worker prefix onboarding (docs/performance.md):
+the KV router compares pull-cost (missing prefix blocks × link class)
+against recompute-cost at EVERY admission and, when pull wins, attaches a
+ranked peer plan; the decode worker onboards the missing contiguous prefix
+over the existing ``kv_pull`` → ``export_blocks`` → ``attach_restored``
+machinery — with its own concurrency budget, dedupe of simultaneous
+same-prefix pulls, a G4 object-store fallback for cold starts, and clean
+degradation to the recompute the pre-onboard fleet always paid.
+"""
+
+import asyncio
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.handlers import DecodeWorkerHandler, KvPullHandler
+from dynamo_tpu.disagg.transfer import OnboardConfig, RestoreConfig
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import AsyncJaxEngine
+from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                  StopConditions)
+from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
+from dynamo_tpu.router.protocols import G4_SOURCE_ID, KvRouterConfig
+from dynamo_tpu.router.publisher import KvEventPublisher
+from dynamo_tpu.router.scheduler import SchedulingDecision
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.chaos import configure_chaos
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+
+pytestmark = pytest.mark.anyio
+
+BS = 4
+CFG = ModelConfig.tiny()
+VOCAB = CFG.vocab_size
+
+
+def eargs(**kw):
+    base = dict(block_size=BS, num_blocks=256, max_num_seqs=8,
+                max_num_batched_tokens=256, max_model_len=512,
+                enable_prefix_caching=True)
+    base.update(kw)
+    return EngineArgs(**base)
+
+
+def req(tokens, osl=4, pin=None):
+    return PreprocessedRequest(
+        model="m", token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+        backend_instance_id=pin)
+
+
+async def _settle(check, timeout=8.0, msg="condition never settled"):
+    for _ in range(int(timeout / 0.05)):
+        if check():
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError(msg)
+
+
+class _FakeG4Client:
+    """Dict-backed, process-shared G4 object store for fleet tests."""
+
+    def __init__(self):
+        self.store: dict = {}
+        self.gets = 0
+        self.deletes = 0
+
+    def put(self, h, data):
+        self.store[h] = data
+
+    def get(self, h):
+        self.gets += 1
+        return self.store.get(h)
+
+    def delete(self, h):
+        self.deletes += 1
+        self.store.pop(h, None)
+
+
+# ------------------------------------------------- router plan unit tests
+
+
+class _FakeClient:
+    def __init__(self, ids):
+        self._ids = list(ids)
+
+    def instances(self):
+        return [SimpleNamespace(instance_id=i, metadata={})
+                for i in self._ids]
+
+    def available_ids(self):
+        return list(self._ids)
+
+
+def _plant(router, tokens, worker_id):
+    """Insert a worker's prefix into the (approx) radix index."""
+    router.indexer.process_routing_decision_for_request(tokens, worker_id)
+
+
+def _decision(worker_id, overlap, best):
+    return SchedulingDecision(worker_id=worker_id, overlap_blocks=overlap,
+                              required_blocks=0, logits={},
+                              best_overlap_blocks=best)
+
+
+def test_onboard_plan_attach_and_wire():
+    tokens = list(range(1, 1 + 12 * BS))
+    router = KvRouter(None, BS, KvRouterConfig(use_kv_events=False))
+    push = KvPushRouter(_FakeClient([1, 2]), router)
+    _plant(router, tokens, 2)
+    r = req(tokens)
+    assert push._onboard_plan(r, _decision(1, 0, 12))
+    # worker 2 holds all 12 blocks, clamped to matchable=11 (one token
+    # must always be computed locally); an unlabeled fleet prices the
+    # link at the conservative host class (rel_cost 25 at default GB/s) —
+    # still orders of magnitude cheaper than recompute
+    assert r.onboard["sources"] == [[2, 11, pytest.approx(25.0)]]
+    assert r.onboard["block_size"] == BS and "g4_blocks" not in r.onboard
+    assert "onboard" in r.to_wire()
+    rt = PreprocessedRequest.from_wire(r.to_wire())
+    assert rt.onboard == r.onboard
+    # absent plan stays off the wire entirely (pre-onboard interop)
+    assert "onboard" not in req(tokens).to_wire()
+
+
+def test_onboard_plan_gates():
+    tokens = list(range(1, 1 + 12 * BS))
+    router = KvRouter(None, BS, KvRouterConfig(use_kv_events=False))
+    push = KvPushRouter(_FakeClient([1, 2]), router)
+    _plant(router, tokens, 2)
+    # chosen worker already near the best: below min_blocks, no plan
+    r = req(tokens)
+    assert not push._onboard_plan(r, _decision(1, 9, 11))
+    assert r.onboard is None
+    # chosen worker IS the best source: nothing to pull
+    r = req(tokens)
+    assert not push._onboard_plan(r, _decision(2, 11, 11))
+    # tiny prompt: no matchable full blocks
+    r = req(tokens[:3])
+    assert not push._onboard_plan(r, _decision(1, 0, 11))
+
+
+def test_onboard_cost_model_rejects_expensive_pull():
+    """The admission decision is a genuine cost comparison: price the
+    pull above the recompute and the plan disappears."""
+    tokens = list(range(1, 1 + 12 * BS))
+    cfg = KvRouterConfig(use_kv_events=False,
+                         onboard_pull_ms_per_block=1e9)
+    router = KvRouter(None, BS, cfg)
+    push = KvPushRouter(_FakeClient([1, 2]), router)
+    _plant(router, tokens, 2)
+    r = req(tokens)
+    assert not push._onboard_plan(r, _decision(1, 0, 12))
+    assert r.onboard is None
+
+
+def test_onboard_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("DYN_ONBOARD", "0")
+    router = KvRouter(None, BS, KvRouterConfig(use_kv_events=False))
+    push = KvPushRouter(_FakeClient([1]), router)
+    assert not push._onboard_on
+    assert not OnboardConfig.from_env().enabled
+    monkeypatch.setenv("DYN_ONBOARD", "1")
+    assert OnboardConfig.from_env().enabled
+
+
+def test_g4_sentinel_in_plans():
+    """Sentinel-announced G4 blocks surface as ``g4_blocks`` in onboard
+    plans and are NEVER spent as restore/onboard pull sources."""
+    from dynamo_tpu.router.protocols import (KvCacheEvent, RouterEvent,
+                                             StoredBlock)
+    from dynamo_tpu.tokens import (compute_block_hash_for_seq,
+                                   compute_seq_hash_for_block)
+
+    tokens = list(range(1, 1 + 12 * BS))
+    router = KvRouter(None, BS, KvRouterConfig(use_kv_events=False))
+    push = KvPushRouter(_FakeClient([1, 2]), router)
+    local = compute_block_hash_for_seq(tokens, BS)
+    ext = compute_seq_hash_for_block(local)
+    blocks = [StoredBlock(block_hash=e, tokens_hash=t)
+              for e, t in zip(ext, local)]
+    router.indexer.tree.apply_event(RouterEvent(
+        G4_SOURCE_ID, KvCacheEvent.stored(1, None, blocks[:8])))
+    r = req(tokens)
+    assert push._onboard_plan(r, _decision(1, 0, 8))
+    assert r.onboard["g4_blocks"] == 8 and r.onboard["sources"] == []
+    # restore plans pop the sentinel: it is not a pullable instance
+    r2 = req(tokens)
+    r2.restore = {"emitted": 0}
+    push._restore_plan(r2, 1)
+    assert r2.restore["sources"] == []
+
+
+# ------------------------------------------------------------ fleet rig
+
+
+async def make_fleet(n=2, onboard_cfg=None, engine_kw=None, g4=None,
+                     hot_hits=0, monkeypatch=None):
+    """n decode workers + a KV-routed push router over one in-process
+    control plane (the test_restore rig, grown a G4 arm): when ``g4`` is
+    a client, every worker's KVBM gets it attached and worker 0 announces
+    G4 contents under the sentinel id."""
+    if monkeypatch is not None:
+        monkeypatch.setenv("DYN_G4_PUBLISH_HITS", str(hot_hits))
+    cfg = RuntimeConfig(lease_ttl=5.0, worker_lost_grace=0.4)
+    rt = await DistributedRuntime.create(config=cfg)
+    fleet = SimpleNamespace(rt=rt, workers=[], infos=[])
+    for _ in range(n):
+        wrt = await DistributedRuntime.create(plane=rt.plane,
+                                              owns_plane=False, config=cfg)
+        lease = await wrt.primary_lease()
+        eng = await asyncio.to_thread(
+            AsyncJaxEngine, CFG, eargs(**(engine_kw or {})))
+        pub = KvEventPublisher(wrt.plane, worker_id=lease, kv_block_size=BS)
+        await pub.start_resync_responder()
+        eng.event_cb = pub.publish_sync
+        announcer = None
+        if g4 is not None:
+            from dynamo_tpu.kvbm.distributed import G4PrefixAnnouncer
+            eng.kvbm.attach_remote(g4, 0)
+            if not fleet.workers:  # one announcer is enough for the rig
+                announcer = await G4PrefixAnnouncer(
+                    wrt.plane, pub, asyncio.get_running_loop()).start()
+                eng.kvbm.on_remote_change = announcer.on_remote_change
+        comp = wrt.namespace("dynamo").component("backend")
+        pull_client = await comp.endpoint("kv_pull").client().start()
+        handler = DecodeWorkerHandler(
+            eng, metrics=wrt.metrics, pull_clients=[pull_client],
+            restore_config=RestoreConfig(enabled=False),
+            onboard_config=onboard_cfg)
+        handler.instance_id = lease
+        pull_handler = KvPullHandler(eng, metrics=wrt.metrics)
+        h_gen = await comp.endpoint("generate").serve_endpoint(
+            handler.generate, lease_id=lease)
+        h_pull = await comp.endpoint("kv_pull").serve_endpoint(
+            pull_handler.generate, lease_id=lease)
+        fleet.workers.append(SimpleNamespace(
+            rt=wrt, engine=eng, lease=lease, handler=handler, pub=pub,
+            pull_handler=pull_handler, announcer=announcer,
+            handles=[h_gen, h_pull], pull_client=pull_client))
+    client = await (rt.namespace("dynamo").component("backend")
+                    .endpoint("generate").client().start())
+    router = await KvRouter(rt.plane, BS, KvRouterConfig()).start()
+    fleet.client = client
+    fleet.router = router
+    fleet.push = KvPushRouter(client, router)
+    return fleet
+
+
+async def stop_fleet(fleet):
+    configure_chaos(None)
+    await fleet.router.stop()
+    await fleet.client.stop()
+    for w in fleet.workers:
+        for h in w.handles:
+            await h.stop(graceful=False)
+        await w.pull_client.stop()
+        if w.announcer is not None:
+            await w.announcer.stop()
+        await w.pub.stop()
+        await w.engine.close()
+        await w.rt.shutdown()
+    await fleet.rt.shutdown()
+
+
+async def drain(fleet, r, ctx=None):
+    out = []
+    async for item in fleet.push.generate(r, ctx or Context()):
+        if isinstance(item, dict):
+            out.extend(item.get("token_ids") or [])
+    return out
+
+
+async def reference_tokens(tokens, osl=4):
+    """Greedy ground truth from a standalone engine."""
+    eng = await asyncio.to_thread(AsyncJaxEngine, CFG, eargs())
+    try:
+        out = []
+        async for o in eng.generate(req(tokens, osl)):
+            out.extend(o.token_ids)
+        return out
+    finally:
+        await eng.close()
+
+
+PREFIX = [(i * 7) % (VOCAB - 2) + 1 for i in range(12 * BS)]
+
+
+async def test_e2e_peer_pull_bit_identical(monkeypatch):
+    """The flagship path: A holds the shared prefix, a new admission lands
+    on B, B pulls the prefix from A at admission and the greedy stream is
+    bit-identical to a pure-recompute run."""
+    fleet = await make_fleet(2)
+    try:
+        a, b = fleet.workers
+        tokens = PREFIX + [9001]
+        want = await reference_tokens(tokens)
+        # A computes (and keeps) the prefix; radix learns via kv events
+        await drain(fleet, req(PREFIX + [9000], pin=a.lease))
+        await _settle(lambda: fleet.router.restore_sources(tokens)
+                      .get(a.lease, 0) >= 11)
+        # steer the measured admission onto B
+        fleet.client.set_busy_instances([a.lease])
+        got = await drain(fleet, req(tokens))
+        assert got == want
+        # B really pulled: attach happened, prefix-cache hit on generate
+        oc = b.handler._onboard_total._values
+        assert oc.get((("outcome", "pulled"),), 0) == 1
+        blocks = b.handler._onboard_blocks._values
+        assert blocks.get((("source", "peer"),), 0) >= 11 - 1
+        # A's serve side counted the onboard-reason pull
+        served = a.pull_handler._served._values
+        assert served.get((("reason", "onboard"),), 0) >= 10
+        assert b.engine.scheduler.prefix_hit_tokens > 0
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_onboard_dedupes_simultaneous_same_prefix(monkeypatch):
+    """A shared prefix arriving N-wide pulls ONCE: followers wait for the
+    first puller and land as ordinary local hits — and every stream is
+    still bit-identical."""
+    fleet = await make_fleet(2)
+    try:
+        a, b = fleet.workers
+        wants = []
+        for i in range(3):
+            wants.append(await reference_tokens(PREFIX + [9100 + i]))
+        await drain(fleet, req(PREFIX + [9000], pin=a.lease))
+        await _settle(lambda: fleet.router.restore_sources(PREFIX + [9100])
+                      .get(a.lease, 0) >= 11)
+        fleet.client.set_busy_instances([a.lease])
+        gots = await asyncio.gather(
+            *[drain(fleet, req(PREFIX + [9100 + i])) for i in range(3)])
+        assert list(gots) == wants
+        oc = b.handler._onboard_total._values
+        pulled = oc.get((("outcome", "pulled"),), 0)
+        assert pulled == 1  # exactly one puller
+        # followers deduped (waited) or arrived after the attach (stale
+        # plan → local) — never a second pull
+        others = sum(v for k, v in oc.items()
+                     if k != (("outcome", "pulled"),))
+        assert others == 2
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_onboard_budget_separate_from_restore():
+    """Onboard pulls draw from their own semaphore: an exhausted onboard
+    budget reports reason=budget without ever touching the restore
+    slots."""
+    eng = await asyncio.to_thread(AsyncJaxEngine, CFG, eargs())
+    try:
+        h = DecodeWorkerHandler(
+            eng, restore_config=RestoreConfig(enabled=True),
+            onboard_config=OnboardConfig(max_concurrent=1,
+                                         pull_timeout_cap_s=0.2))
+        h.instance_id = 1
+        await h._onboard_slots.acquire()  # saturate the onboard budget
+        r = req(PREFIX + [1])
+        r.onboard = {"sources": [[2, 11, 1.0]], "block_size": BS}
+        info = await h._onboard_prefix(r, Context())
+        assert info["reason"] == "budget"
+        assert info["outcome"] == "recomputed"
+        # restore slots untouched by the saturated onboard budget
+        assert h._restore_slots._value == h.restore_config.max_concurrent
+    finally:
+        await eng.close()
+
+
+async def test_onboard_chaos_pull_failure_recomputes(monkeypatch):
+    """100% kv.direct_pull chaos: every onboard pull fails, the stream
+    still completes bit-identically via local recompute."""
+    fleet = await make_fleet(2)
+    try:
+        a, b = fleet.workers
+        tokens = PREFIX + [9200]
+        want = await reference_tokens(tokens)
+        await drain(fleet, req(PREFIX + [9000], pin=a.lease))
+        await _settle(lambda: fleet.router.restore_sources(tokens)
+                      .get(a.lease, 0) >= 11)
+        fleet.client.set_busy_instances([a.lease])
+        configure_chaos("kv.direct_pull:error=1.0", seed=7)
+        got = await drain(fleet, req(tokens))
+        configure_chaos(None)
+        assert got == want
+        oc = b.handler._onboard_total._values
+        assert oc.get((("outcome", "recomputed"),), 0) == 1
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_dyn_onboard_escape_no_pulls(monkeypatch):
+    """DYN_ONBOARD=0 at the worker: the plan is ignored, nothing is
+    pulled, behavior is the pre-onboard recompute."""
+    fleet = await make_fleet(2, onboard_cfg=OnboardConfig(enabled=False))
+    try:
+        a, b = fleet.workers
+        tokens = PREFIX + [9300]
+        want = await reference_tokens(tokens)
+        await drain(fleet, req(PREFIX + [9000], pin=a.lease))
+        await _settle(lambda: fleet.router.restore_sources(tokens)
+                      .get(a.lease, 0) >= 11)
+        fleet.client.set_busy_instances([a.lease])
+        got = await drain(fleet, req(tokens))
+        assert got == want
+        assert not b.handler._onboard_total._values  # path never entered
+        assert not a.pull_handler._served._values
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_g4_flow_up_and_cold_warm(monkeypatch):
+    """The fleet-global prefix store end-to-end: hot prefixes flow up
+    from worker A (prefix-hit threshold → G4 publish → sentinel radix
+    events), A leaves the fleet, and cold worker B warms the prefix from
+    G4 at admission — bit-identical, outcome=g4."""
+    g4 = _FakeG4Client()
+    blk = 2 * CFG.num_layers * BS * CFG.num_kv_heads * (
+        CFG.hidden_size // CFG.num_heads) * 4
+    fleet = await make_fleet(
+        2, engine_kw=dict(kvbm_host_bytes=64 * blk), g4=g4, hot_hits=1,
+        monkeypatch=monkeypatch)
+    try:
+        a, b = fleet.workers
+        tokens = PREFIX + [9400]
+        want = await reference_tokens(tokens)
+        # A computes the prefix, then re-hits it → hot → flows up to G4
+        await drain(fleet, req(PREFIX + [9000], pin=a.lease))
+        await _settle(lambda: a.engine.kvbm.stats()["host_blocks"] >= 11,
+                      msg="offload to G2 never landed")
+        await drain(fleet, req(PREFIX + [9001], pin=a.lease))
+        await _settle(lambda: len(g4.store) >= 11,
+                      msg="hot prefix never flowed up to G4")
+        # sentinel announcements reached the router's radix
+        await _settle(lambda: fleet.router.restore_sources(tokens)
+                      .get(G4_SOURCE_ID, 0) >= 11,
+                      msg="G4 sentinel never reached the radix")
+        # A leaves the fleet (graceful dereg → router purges its blocks);
+        # the sentinel entries survive — G4 is not A
+        for h in a.handles:
+            await h.stop(graceful=False)
+        await _settle(lambda: fleet.client.available_ids() == [b.lease])
+        await _settle(lambda: fleet.router.restore_sources(tokens)
+                      .get(a.lease) is None)
+        assert (fleet.router.restore_sources(tokens)
+                .get(G4_SOURCE_ID, 0) >= 11)
+        got = await drain(fleet, req(tokens))
+        assert got == want
+        oc = b.handler._onboard_total._values
+        assert oc.get((("outcome", "g4"),), 0) == 1
+        blocks = b.handler._onboard_blocks._values
+        assert blocks.get((("source", "g4"),), 0) >= 10
+        assert g4.gets >= 10  # bytes really came from the object store
+    finally:
+        await stop_fleet(fleet)
+
+
+async def test_fetch_remote_leading_run_and_index_bypass():
+    """KvbmManager.fetch_remote reads a LEADING run from the store into
+    the host tier even when the local RemoteTier index is cold, and stops
+    at the first miss."""
+    from dynamo_tpu.kvbm.manager import KvbmManager
+    from dynamo_tpu.kvbm.tiers import RemoteTier
+
+    g4 = _FakeG4Client()
+    pages = {h: (np.full((2, 3), h, np.float32),
+                 np.full((2, 3), h + 10, np.float32)) for h in (1, 2, 4)}
+    for h, (k, v) in pages.items():
+        g4.put(h, RemoteTier.encode(k, v))
+    m = KvbmManager(host_bytes=1 << 20)
+    m.attach_remote(_FakeG4Client(), 0)  # SEPARATE (cold) local index
+    m.remote.client = g4  # ...but the shared store has the bytes
+    landed = await asyncio.to_thread(m.fetch_remote, [1, 2, 3, 4])
+    assert landed == 2  # stops at the missing 3; 4 never fetched
+    assert m.get_host(1) is not None and m.get_host(2) is not None
+    assert m.get_host(4) is None
+    np.testing.assert_array_equal(m.get_host(1)[0], pages[1][0])
+
+
+async def test_fetch_remote_never_deletes_shared_objects():
+    """A cold warmer under a tight G4 byte budget LRU-evicts its LOCAL
+    index entries only — it does not own the fleet's shared objects, and
+    a delete here would poison every peer's index and the sentinel
+    radix."""
+    from dynamo_tpu.kvbm.manager import KvbmManager
+    from dynamo_tpu.kvbm.tiers import RemoteTier
+
+    g4 = _FakeG4Client()
+    payloads = {}
+    for h in (1, 2, 3):
+        k = np.full((2, 3), h, np.float32)
+        payloads[h] = RemoteTier.encode(k, k)
+        g4.put(h, payloads[h])
+    m = KvbmManager(host_bytes=1 << 20)
+    # budget fits ~1 payload: each fetch evicts the previous index entry
+    m.attach_remote(g4, capacity_bytes=len(payloads[1]) + 1)
+    landed = await asyncio.to_thread(m.fetch_remote, [1, 2, 3])
+    assert landed == 3
+    assert g4.deletes == 0  # index-only evictions, objects untouched
+    assert set(g4.store) == {1, 2, 3}
+    # the EVICTION path honors the same ownership rule: a later flow-up
+    # whose reserve() evicts a fetched entry must not delete the shared
+    # object — only blocks this worker itself wrote are delete-eligible
+    k9 = np.full((2, 3), 9, np.float32)
+    await asyncio.to_thread(m.publish_remote, 9, k9, k9)
+    assert set(g4.store) == {1, 2, 3, 9}
+    assert g4.deletes == 0  # evicted entries were fetched, not owned
+    # evicting the OWNED block 9 (by publishing more owned blocks past
+    # the budget) does delete it remotely
+    for h in (10, 11):
+        kx = np.full((2, 3), h, np.float32)
+        await asyncio.to_thread(m.publish_remote, h, kx, kx)
+    assert 9 not in g4.store and g4.deletes >= 1
+    assert {1, 2, 3} <= set(g4.store)  # shared objects still never deleted

@@ -329,7 +329,7 @@ async def test_pull_timeout_respects_deadline(monkeypatch):
 
     seen = {}
 
-    async def fake_pull(client, iid, hashes, timeout_s):
+    async def fake_pull(client, iid, hashes, timeout_s, reason="restore"):
         seen["timeout"] = timeout_s
         return []
 
