@@ -545,6 +545,9 @@ class DecodeWorkerHandler:
                 info["reason"] = "budget"
                 return info
             slot = True
+            flight = getattr(self.engine, "flight", None)
+            if flight is not None:  # → flight-record restore_inflight
+                flight.bump_gauge("restore_inflight", 1)
             # re-check AFTER the wait: a concurrent restore of a shared
             # prefix may have attached exactly the blocks we need
             covered = self.engine.resident_prefix_blocks(probe)
@@ -563,6 +566,9 @@ class DecodeWorkerHandler:
         finally:
             if slot:
                 self._restore_slots.release()
+                flight = getattr(self.engine, "flight", None)
+                if flight is not None:
+                    flight.bump_gauge("restore_inflight", -1)
             if info["restored_blocks"] > 0 or info["local_blocks"] > 0:
                 info["outcome"] = ("restored" if covered >= matchable
                                    else "partial")
@@ -665,6 +671,9 @@ class DecodeWorkerHandler:
                 info["reason"] = "budget"
                 return info
             slot = True
+            flight = getattr(self.engine, "flight", None)
+            if flight is not None:  # → flight-record onboard_inflight
+                flight.bump_gauge("onboard_inflight", 1)
             covered = max(covered,
                           self.engine.resident_prefix_blocks(probe))
             info["local_blocks"] = covered
@@ -689,6 +698,9 @@ class DecodeWorkerHandler:
         finally:
             if slot:
                 self._onboard_slots.release()
+                flight = getattr(self.engine, "flight", None)
+                if flight is not None:
+                    flight.bump_gauge("onboard_inflight", -1)
             if fut is not None:
                 self._onboard_inflight.pop(dedup_key, None)
                 if not fut.done():

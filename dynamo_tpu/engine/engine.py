@@ -373,6 +373,37 @@ class AsyncJaxEngine:
         #: the profile that located the r4 serving-vs-kernel gap; cheap
         #: enough to keep always-on, dumped by step_trace_summary()
         self.step_trace: "collections.deque" = collections.deque(maxlen=2048)
+        #: step flight recorder (observability/flight.py): one structured,
+        #: anomaly-tagged record per executed step — the fleet-queryable
+        #: "why was this step slow" layer the step_trace ring cannot answer
+        from dynamo_tpu.observability.flight import (
+            FlightRecorder, register_recorder,
+        )
+        self.flight = FlightRecorder(service="engine")
+        self._flight_name = register_recorder("engine", self.flight)
+        #: last-seen cumulative totals, differenced into per-step flight
+        #: record deltas (preemptions, swap block movement)
+        self._flight_last: dict = {}
+        #: post-warmup jit traces observed at the serving dispatch sites:
+        #: kind → count / total seconds (→ dynamo_compile_total{kind} /
+        #: dynamo_compile_seconds_total{kind} in engine/main.py; the
+        #: unlabeled dynamo_compile_seconds histogram rides the tracer's
+        #: SLO registry). A compile after FLIGHT steady_after steps logs a
+        #: WARNING with the offending signature — a mid-traffic compile
+        #: used to be silent except as a latency cliff.
+        self.compile_events: dict[str, int] = {}
+        self.compile_seconds: dict[str, float] = {}
+        self._last_compile: Optional[tuple] = None  # (kind, sig, seconds)
+        self._last_dispatch_ms = 0.0  # latest jitted-call dispatch wall
+        #: bytes per KV block (both caches, quant scales included) —
+        #: computed lazily once for the G1 tier-occupancy gauge
+        self._kv_block_nbytes: Optional[int] = None
+        #: tier snapshot throttle for the flight record hot path (the
+        #: pipelined decode loop records per step): occupancy moves at
+        #: block-allocation cadence, so a 50 ms-old snapshot is current
+        self._flight_tiers: dict = {}
+        self._flight_tiers_t = 0.0
+        self._last_empty_rec = 0.0  # empty-bubble record rate limit
         #: multi-process DP fleet rank (None = single-rank); reported in
         #: worker stats (ref: kv_router/protocols.rs:57 data_parallel_rank)
         self.dp_rank: Optional[int] = None
@@ -1213,6 +1244,8 @@ class AsyncJaxEngine:
         if self._offload_tasks:
             await asyncio.gather(*list(self._offload_tasks),
                                  return_exceptions=True)
+        from dynamo_tpu.observability.flight import unregister_recorder
+        unregister_recorder(self._flight_name)
 
     # ------------------------------------------------------------ main loop
 
@@ -1253,10 +1286,23 @@ class AsyncJaxEngine:
                 # The timeout is a safety net for edge signals that have no
                 # hook (e.g. a context cancelled while we sleep).
                 self._wake.clear()
+                t0 = time.perf_counter()
                 try:
                     await asyncio.wait_for(self._wake.wait(), timeout=0.05)
                 except asyncio.TimeoutError:
                     pass
+                # empty-step bubble: work exists but nothing could run —
+                # the flight record carries how long the engine sat idle.
+                # Rate-limited (same 10 ms guard as the mocker): _wake is
+                # set by every arrival/cancel/offload, so a stall under
+                # heavy ingress would otherwise flood the ring with
+                # identical bubbles and evict the records explaining it
+                now = time.monotonic()
+                if now - self._last_empty_rec >= 0.01:
+                    self._last_empty_rec = now
+                    self._flight_record(
+                        "empty", (time.perf_counter() - t0) * 1000,
+                        decode_rows=0, prefill_chunks=0, chunk_tokens=0)
                 continue
             try:
                 await self._execute(plan)
@@ -1288,27 +1334,49 @@ class AsyncJaxEngine:
             n_tok = sum(w.chunk for w in plan.prefill) + len(plan.decode)
             with annotate("dynamo.ragged_step"):
                 padded = await self._run_ragged(plan)
+            wall = (time.perf_counter() - t0) * 1000
             self.step_trace.append((
                 "ragged", len(plan.prefill) + len(plan.decode), n_tok,
-                (time.perf_counter() - t0) * 1000, padded))
+                wall, padded))
+            self._flight_record(
+                "ragged", wall, decode_rows=len(plan.decode),
+                prefill_chunks=len(plan.prefill),
+                chunk_tokens=sum(w.chunk for w in plan.prefill),
+                padded=padded, dispatch_ms=self._last_dispatch_ms,
+                qos_mix=self._plan_qos_mix(plan))
             return
         if plan.prefill:
             t0 = time.perf_counter()
             with annotate("dynamo.prefill_step"):
                 await self._run_prefill(plan.prefill)
+            wall = (time.perf_counter() - t0) * 1000
             self.step_trace.append((
                 "prefill", len(plan.prefill),
-                sum(w.chunk for w in plan.prefill),
-                (time.perf_counter() - t0) * 1000))
+                sum(w.chunk for w in plan.prefill), wall))
+            # the bucketed path emits TWO records per plan (prefill +
+            # decode launches): the decode record owns the plan's
+            # starved-decode count and the decode rows' QoS mix — carrying
+            # them here too would double-count one starvation event
+            self._flight_record(
+                "prefill", wall, decode_rows=0,
+                prefill_chunks=len(plan.prefill),
+                chunk_tokens=sum(w.chunk for w in plan.prefill),
+                dispatch_ms=self._last_dispatch_ms, starved=0,
+                qos_mix=self._qos_mix_of([w.seq for w in plan.prefill]))
         if plan.decode:
             t0 = time.perf_counter()
             gen0 = sum(s.generated for s in plan.decode)
             with annotate("dynamo.decode_step"):
                 await self._run_decode(plan.decode)
+            wall = (time.perf_counter() - t0) * 1000
             self.step_trace.append((
                 "decode", len(plan.decode),
-                sum(s.generated for s in plan.decode) - gen0,
-                (time.perf_counter() - t0) * 1000))
+                sum(s.generated for s in plan.decode) - gen0, wall))
+            self._flight_record(
+                "decode", wall, decode_rows=len(plan.decode),
+                prefill_chunks=0, chunk_tokens=0,
+                dispatch_ms=self._last_dispatch_ms,
+                qos_mix=self._qos_mix_of(plan.decode))
 
     def step_trace_summary(self) -> dict:
         """Aggregate the timing ring: per kind, steps / seqs / tokens /
@@ -1327,6 +1395,121 @@ class AsyncJaxEngine:
                     "mean_ms": round(a[3] / a[0], 1),
                     "padded_tokens": a[4]}
                 for k, a in agg.items()}
+
+    # --------------------------------------------------- flight recording
+
+    def _note_compile(self, kind: str, sig: tuple, seconds: float) -> None:
+        """A serving dispatch just traced a NEW jit signature: count it,
+        time it, stage it for the step's flight record, and WARN when it
+        happened in steady state (the silent latency cliff)."""
+        self.compile_events[kind] = self.compile_events.get(kind, 0) + 1
+        self.compile_seconds[kind] = (self.compile_seconds.get(kind, 0.0)
+                                      + seconds)
+        try:
+            from dynamo_tpu.observability import get_tracer
+            get_tracer().metrics.histogram(
+                "compile_seconds",
+                "seconds spent tracing/compiling post-warmup jit "
+                "signatures").observe(seconds)
+        except Exception:
+            pass  # metrics must never fail a step
+        self._last_compile = (kind, sig, seconds)
+        # SAME steady signal as the record's compile-steady tag (the
+        # recorder's count) so the WARNING and the tag never desync; with
+        # recording disabled, executed steps are the fallback proxy
+        steady = (self.flight.steady() if self.flight.enabled
+                  else self.steps >= self.flight.steady_after)
+        if steady:
+            logger.warning(
+                "steady-state compile: signature %s traced in %.2fs at "
+                "step %d (warmup did not cover this shape)",
+                (kind,) + tuple(sig), seconds, self.steps)
+
+    def kv_tier_occupancy(self) -> dict:
+        """G1–G4 occupancy for /metrics gauges, flight records, and
+        ``dynctl top``: ``{tier: {"blocks": n, "bytes": n}}``. G1 is the
+        device paged cache (active blocks); G2/G3/G4 come from the KVBM
+        hierarchy when configured (zeros otherwise — the series exist
+        either way, so dashboards can wire against an unconfigured tier)."""
+        if self._kv_block_nbytes is None:
+            try:
+                import jax
+                leaves = jax.tree_util.tree_leaves(
+                    (self.k_cache, self.v_cache))
+                total = sum(int(x.nbytes) for x in leaves)
+                self._kv_block_nbytes = total // max(1, self.num_blocks)
+            except Exception:
+                self._kv_block_nbytes = 0
+        g1 = self.pool.num_active_blocks
+        out = {"g1": {"blocks": g1,
+                      "bytes": g1 * (self._kv_block_nbytes or 0)}}
+        if self.kvbm is not None:
+            s = self.kvbm.stats()
+            out["g2"] = {"blocks": s["host_blocks"],
+                         "bytes": s["host_bytes"]}
+            out["g3"] = {"blocks": s["disk_blocks"],
+                         "bytes": s["disk_bytes"]}
+            out["g4"] = {"blocks": s["remote_blocks"],
+                         "bytes": s["remote_bytes"]}
+        else:
+            for tier in ("g2", "g3", "g4"):
+                out[tier] = {"blocks": 0, "bytes": 0}
+        return out
+
+    def _flight_record(self, kind: str, wall_ms: float, decode_rows: int,
+                       prefill_chunks: int, chunk_tokens: int,
+                       padded: int = 0, dispatch_ms: float = 0.0,
+                       qos_mix: Optional[dict] = None,
+                       starved: Optional[int] = None) -> None:
+        """Append one flight record for an executed step: snapshot queue
+        depths + tier occupancy, difference the cumulative preempt/swap
+        totals into per-step deltas, and attach a compile staged by
+        ``_note_compile`` during this step's dispatch."""
+        if not self.flight.enabled:
+            return
+        sched = self.scheduler
+        cur = {"ps": sched.preempt_swap_total,
+               "pr": sched.preempt_recompute_total,
+               "so": self.swap_out_blocks, "si": self.swap_in_blocks}
+        last = self._flight_last
+        delta = {k: cur[k] - last.get(k, 0) for k in cur}
+        self._flight_last = cur
+        compile_s, compile_sig = 0.0, ""
+        if self._last_compile is not None:
+            ck, cs, csec = self._last_compile
+            compile_s = csec
+            compile_sig = ":".join(str(x) for x in (ck,) + tuple(cs))
+            self._last_compile = None
+        now = time.monotonic()
+        if now - self._flight_tiers_t > 0.05:
+            self._flight_tiers = {
+                t: v["blocks"] for t, v in self.kv_tier_occupancy().items()}
+            self._flight_tiers_t = now
+        tiers = self._flight_tiers
+        self.flight.record(
+            kind, wall_ms,
+            dispatch_ms=dispatch_ms,
+            decode_rows=decode_rows, prefill_chunks=prefill_chunks,
+            chunk_tokens=chunk_tokens, padded_tokens=padded,
+            compile_s=compile_s, compile_sig=compile_sig,
+            preempt_swap=delta["ps"], preempt_recompute=delta["pr"],
+            swap_out_blocks=delta["so"], swap_in_blocks=delta["si"],
+            waiting=sched.num_waiting(), swapped=len(sched.swapped),
+            running=len(sched.running),
+            starved_decode=(sched.last_starved_decode
+                            if starved is None else starved),
+            kv_tiers=tiers, qos_mix=qos_mix or {})
+
+    @staticmethod
+    def _qos_mix_of(seqs) -> dict:
+        mix: dict[str, int] = {}
+        for s in seqs:
+            mix[s.priority] = mix.get(s.priority, 0) + 1
+        return mix
+
+    def _plan_qos_mix(self, plan: StepPlan) -> dict:
+        return self._qos_mix_of(
+            plan.decode + [w.seq for w in plan.prefill])
 
     # ------------------------------------------------------- bucket warmup
 
@@ -1594,13 +1777,19 @@ class AsyncJaxEngine:
             kind, fn = "step_mm", self._get_step_mm_fn()
         else:
             kind, fn = "step", self.step_fn
+        new_sig = (kind, B, S, W) not in self.compiled_signatures
         self.compiled_signatures.add((kind, B, S, W))
         self.padded_tokens_total += B * S - sum(w.chunk for w in works)
         self._broadcast(kind, **operands)
+        t0d = time.perf_counter()
         logits, self.k_cache, self.v_cache = fn(
             self.params,
             *(self._put_batch(k, v) for k, v in operands.items()),
             self.k_cache, self.v_cache)
+        self._last_dispatch_ms = (time.perf_counter() - t0d) * 1000
+        if new_sig:
+            self._note_compile(kind, (B, S, W),
+                               time.perf_counter() - t0d)
 
         for w in works:
             seq, end = w.seq, w.start + w.chunk
@@ -1745,12 +1934,17 @@ class AsyncJaxEngine:
             # guided, penalties, swapped/waiting work pending): the
             # no-chunk-grid variant
             kind, fn = "ragged_dec", self.ragged_dec_fn
+        new_sig = (kind, T) not in self.compiled_signatures
         self.compiled_signatures.add((kind, T))
         self._broadcast(kind, **operands)
+        t0d = time.perf_counter()
         logits, self.k_cache, self.v_cache = fn(
             self.params,
             *(self._put_batch(k, v) for k, v in operands.items()),
             self.k_cache, self.v_cache)
+        self._last_dispatch_ms = (time.perf_counter() - t0d) * 1000
+        if new_sig:
+            self._note_compile(kind, (T,), time.perf_counter() - t0d)
 
         # commit BEFORE sampling, exactly like the bucketed steps: chunk
         # progress (and disagg block shipping) must never wait on the
@@ -2075,16 +2269,22 @@ class AsyncJaxEngine:
 
         ints3 = np.stack([tokens, positions, slot_map], axis=1)
         lens_last = np.stack([kv_lens, last_idx], axis=1)
+        new_sig = ("step", B, 1, W) not in self.compiled_signatures
         self.compiled_signatures.add(("step", B, 1, W))
         self.padded_tokens_total += B - len(seqs)
         self._broadcast("step", ints3=ints3, lens_last=lens_last,
                         block_tables=bt)
         self.param_reads += 1
+        t0d = time.perf_counter()
         logits, self.k_cache, self.v_cache = self.step_fn(
             self.params, self._put_batch("ints3", ints3),
             self._put_batch("lens_last", lens_last),
             self._put_batch("block_tables", bt),
             self.k_cache, self.v_cache)
+        self._last_dispatch_ms = (time.perf_counter() - t0d) * 1000
+        if new_sig:
+            self._note_compile("step", (B, 1, W),
+                               time.perf_counter() - t0d)
 
         toks, logps, tops = await self._sample(seqs, logits)
         for i, s in enumerate(seqs):
@@ -2207,6 +2407,7 @@ class AsyncJaxEngine:
             if feed is not None:
                 ints5 = ints5.at[0, :len(seqs)].set(
                     feed["toks"][:len(seqs)].astype(jnp.int32))
+            new_sig = ("ragged_dec", B) not in self.compiled_signatures
             self.compiled_signatures.add(("ragged_dec", B))
             self.padded_tokens_total += B - len(seqs)
             t0 = time.perf_counter()
@@ -2214,18 +2415,25 @@ class AsyncJaxEngine:
                 self.params, ints5, jnp.asarray(rows3),
                 jnp.zeros((C,), jnp.int32), jnp.asarray(bt),
                 self.k_cache, self.v_cache)
+            if new_sig:
+                self._note_compile("ragged_dec", (B,),
+                                   time.perf_counter() - t0)
         else:
             ints3 = jnp.asarray(
                 np.stack([tokens, positions, slot_map], axis=1))
             if feed is not None:
                 ints3 = ints3.at[:, 0, 0].set(feed["toks"].astype(jnp.int32))
             lens_last = np.stack([kv_lens, last_idx], axis=1)
+            new_sig = ("step", B, 1, W) not in self.compiled_signatures
             self.compiled_signatures.add(("step", B, 1, W))
             self.padded_tokens_total += B - len(seqs)
             t0 = time.perf_counter()
             logits, self.k_cache, self.v_cache = self.step_fn(
                 self.params, ints3, jnp.asarray(lens_last), jnp.asarray(bt),
                 self.k_cache, self.v_cache)
+            if new_sig:
+                self._note_compile("step", (B, 1, W),
+                                   time.perf_counter() - t0)
         toks, logps = self._sampling.sample_jit(logits, temp, top_k, top_p,
                                                 keys)
         # device→host copy in a worker thread: the loop dispatches step N+1
@@ -2247,9 +2455,12 @@ class AsyncJaxEngine:
             self._deliver(s, int(toks[i]), float(logps[i]))
             n += 1
         self.pipelined_steps += 1
+        wall = (time.perf_counter() - handle["t0"]) * 1000
         self.step_trace.append((
-            "decode_pipe", len(handle["seqs"]), n,
-            (time.perf_counter() - handle["t0"]) * 1000))
+            "decode_pipe", len(handle["seqs"]), n, wall))
+        self._flight_record(
+            "decode_pipe", wall, decode_rows=n, prefill_chunks=0,
+            chunk_tokens=0, starved=0)
 
     async def _run_decode_pipelined(self, seqs: list[SeqState]) -> bool:
         """Depth-2 software pipeline over single-step decode.
@@ -2345,17 +2556,22 @@ class AsyncJaxEngine:
         ints = np.stack([last_tokens, positions, kv_lens, top_k], axis=1)
         floats = np.stack([temp, top_p], axis=1)
         rand = np.stack([seeds, step0], axis=1)
+        new_sig = ("multi", B, W) not in self.compiled_signatures
         self.compiled_signatures.add(("multi", B, W))
         self.padded_tokens_total += (B - len(seqs)) * K
         self._broadcast("multi", ints=ints, floats=floats, rand=rand,
                         block_tables=bt)
         self.param_reads += K
+        t0d = time.perf_counter()
         toks, logps, self.k_cache, self.v_cache = self.multi_fn(
             self.params, self._put_batch("ints", ints),
             self._put_batch("floats", floats),
             self._put_batch("rand", rand),
             self._put_batch("block_tables", bt),
             self.k_cache, self.v_cache)
+        self._last_dispatch_ms = (time.perf_counter() - t0d) * 1000
+        if new_sig:
+            self._note_compile("multi", (B, W), time.perf_counter() - t0d)
         toks, logps = await asyncio.to_thread(
             lambda: (np.asarray(toks), np.asarray(logps)))
 

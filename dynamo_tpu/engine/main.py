@@ -508,6 +508,41 @@ async def amain():
         "served step").add_callback(
         lambda: {None: int(engine.warmup_skipped)})
 
+    # KV tier occupancy G1–G4 (docs/observability.md "Flight recorder"):
+    # the hierarchy PRs 10–11 built, finally visible to Prometheus and
+    # `dynctl top` — device paged cache (g1), KVBM host (g2), disk (g3),
+    # object store (g4)
+    def _tier_cb(field):
+        def cb():
+            return {(("tier", t),): v[field]
+                    for t, v in engine.kv_tier_occupancy().items()}
+        return cb
+
+    runtime.metrics.gauge(
+        "kv_tier_blocks",
+        "KV blocks resident per cache tier (g1=device, g2=host DRAM, "
+        "g3=disk, g4=object store)").add_callback(_tier_cb("blocks"))
+    runtime.metrics.gauge(
+        "kv_tier_bytes",
+        "bytes resident per KV cache tier").add_callback(_tier_cb("bytes"))
+
+    # runtime compile visibility (docs/observability.md): every
+    # post-warmup jit trace counted + timed by dispatch kind, so a
+    # steady-state compile is a measured series (and a WARNING log), not
+    # a silent latency cliff. The unlabeled dynamo_compile_seconds
+    # histogram rides the tracer registry merged into this /metrics.
+    runtime.metrics.counter(
+        "compile_total",
+        "post-warmup jit traces by dispatch kind").add_callback(
+        lambda: {(("kind", k),): v
+                 for k, v in engine.compile_events.items()})
+    runtime.metrics.counter(
+        "compile_seconds_total",
+        "seconds spent in post-warmup jit traces by dispatch "
+        "kind").add_callback(
+        lambda: {(("kind", k),): round(v, 4)
+                 for k, v in engine.compile_seconds.items()})
+
     # multi-tenant QoS telemetry (docs/qos.md): per-(tenant, class) served
     # tokens, queue wait, preemptions from the scheduler's fairness ledger;
     # rejections-by-tenant are a FRONTEND family (dynamo_tenant_rejected_total)
@@ -665,6 +700,18 @@ async def amain():
     from dynamo_tpu.observability import ensure_trace_endpoint
 
     await ensure_trace_endpoint(runtime)
+    # step flight recorder fan-out (observability/flight.py): re-register
+    # the engine's recorder under its serving role so `dynctl top` names
+    # workers usefully, then expose it to /v1/fleet/steps + dynctl
+    from dynamo_tpu.observability.flight import (
+        ensure_flight_endpoint, register_recorder, unregister_recorder,
+    )
+    unregister_recorder(engine._flight_name)
+    flight_name = component if cli.dp_rank is None \
+        else f"{component}-r{cli.dp_rank}"
+    engine.flight.service = flight_name
+    engine._flight_name = register_recorder(flight_name, engine.flight)
+    await ensure_flight_endpoint(runtime)
     embed_handle = None
     if cli.role != "prefill":  # embeddings ride the decode/agg fleet
         embed_ep = ns.component(component).endpoint("embed")

@@ -206,6 +206,11 @@ class Scheduler:
         #: after SWAP_IN_SKIP_AFTER failed reservations) →
         #: dynamo_swap_in_blocked_total
         self.swap_in_blocked_total = 0
+        #: flight-recorder signal (observability/flight.py): decode rows
+        #: that were READY last plan but did not fit the step (row cap /
+        #: token budget), i.e. a budget-starved decode — QoS sit-out sheds
+        #: are deliberate policy and are NOT counted here
+        self.last_starved_decode = 0
 
     # -- api ----------------------------------------------------------------
 
@@ -291,7 +296,9 @@ class Scheduler:
             # packed step: decode rows spend the shared token budget (one
             # token each) and must also fit the packed-token bucket cap
             row_cap = min(max_b, budget)
-        plan.decode = [s for s in ready_decode if s in self.running][:row_cap]
+        still_ready = [s for s in ready_decode if s in self.running]
+        plan.decode = still_ready[:row_cap]
+        self.last_starved_decode = len(still_ready) - len(plan.decode)
         budget -= len(plan.decode)
 
         if self.args.enable_chunked_prefill or not plan.decode:

@@ -425,6 +425,9 @@ class HttpService:
         # stitched request trace (observability spine): spans recorded in
         # this process merged with spans fetched from workers
         app.router.add_get("/v1/traces/{request_id}", self.handle_trace)
+        # fleet flight-recorder fan-out (docs/observability.md "Flight
+        # recorder"): per-worker step timelines + anomaly summaries
+        app.router.add_get("/v1/fleet/steps", self.handle_fleet_steps)
         # admin: flush every worker's KV cache/prefix state (ref:
         # lib/llm/src/http/service/clear_kv_blocks.rs)
         app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
@@ -562,6 +565,27 @@ class HttpService:
             except Exception:
                 logger.exception("trace fan-out failed; serving local spans")
         if not spans:
+            from dynamo_tpu.observability import (trace_sample_rate,
+                                                  trace_sampled)
+
+            rate = trace_sample_rate()
+            if rate < 1.0 and not trace_sampled(rid, rate):
+                # head-sampled out: say so explicitly — an operator
+                # debugging a request must be able to tell "not sampled"
+                # from "trace expired from the ring buffers". The
+                # decision keys on the request id, which IS the trace id
+                # unless the client sent its own traceparent — hedge for
+                # that case instead of asserting certainty.
+                return web.json_response({
+                    "request_id": rid, "sampled": False, "spans": [],
+                    "reason": (f"request not head-sampled "
+                               f"(DYN_TRACE_SAMPLE={rate:g}); raise the "
+                               "rate or resend with a sampled trace id. "
+                               "If the request carried its own "
+                               "traceparent, query by that trace id — "
+                               "the sampling decision follows the trace "
+                               "id, not the request id"),
+                })
             return web.json_response(
                 error_body(f"no trace recorded for '{rid}'",
                            "trace_not_found", 404), status=404)
@@ -572,6 +596,40 @@ class HttpService:
             "phases": sorted({d.get("name") for d in ordered}),
             "spans": ordered,
         })
+
+    async def handle_fleet_steps(self, request: web.Request) -> web.Response:
+        """GET /v1/fleet/steps — the stitched fleet flight view: every
+        worker's step summary (and, with ``?n=``, its recent records) fanned
+        out over the control plane. Dead/slow workers drop out of the
+        response individually (observability/flight.py)."""
+        from dynamo_tpu.observability import fetch_fleet_steps
+
+        try:
+            n = int(request.query.get("n", "0"))
+        except ValueError:
+            return web.json_response(
+                error_body("query param 'n' must be an integer",
+                           "bad_request", 400), status=400)
+        workers: dict = {}
+        if self.runtime is not None:
+            try:
+                workers = await fetch_fleet_steps(self.runtime.plane, n=n)
+            except Exception:
+                logger.exception("fleet step fan-out failed")
+        else:
+            # runtime-less frontend (tests, single-process serving): the
+            # process-local recorders ARE the fleet — with a runtime they
+            # arrive through the fan-out instead (never both, or the same
+            # ring would show up under two keys)
+            from dynamo_tpu.observability.flight import recorders
+
+            for name, rec in recorders().items():
+                entry = {"summary": rec.summary()}
+                if n > 0:
+                    entry["steps"] = rec.snapshot(n)
+                workers[f"local/{name}"] = entry
+        return web.json_response({"workers": workers,
+                                  "count": len(workers)})
 
     def _refresh_router_metrics(self) -> None:
         """Snapshot per-model KV-router stream health into gauges at scrape

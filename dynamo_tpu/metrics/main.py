@@ -79,7 +79,20 @@ class MetricsService:
             logger.warning("prefill queue depth unavailable; reporting 0")
             return 0
 
-    def render(self, prefill_queue_depth: int = 0) -> str:
+    async def sample_hub_stats(self):
+        """The hub's self-instrumentation, when the plane exposes it (the
+        dynctl hub and the in-process plane both do); None on failure —
+        /metrics must keep serving through a hub hiccup."""
+        plane = self.runtime.plane
+        if not hasattr(plane, "hub_stats"):
+            return None
+        try:
+            return await asyncio.wait_for(plane.hub_stats(), 2.0)
+        except Exception:
+            logger.warning("hub stats unavailable")
+            return None
+
+    def render(self, prefill_queue_depth: int = 0, hub: dict = None) -> str:
         a = self.agg.aggregate()
         lines = []
 
@@ -109,6 +122,26 @@ class MetricsService:
                 "KV removed events observed")
         gauge("prefill_queue_depth", prefill_queue_depth,
               "tickets waiting in the global prefill queue")
+        if hub:
+            # hub event-path instrumentation (docs/observability.md): the
+            # fleet-bench batching ceiling (docs/PERF_NOTES.md) as live
+            # series instead of a one-off bench note
+            lines.append("# HELP dynamo_hub_events_total control-plane "
+                         "ops handled by the hub, by kind")
+            lines.append("# TYPE dynamo_hub_events_total counter")
+            for kind, v in sorted((hub.get("events") or {}).items()):
+                lines.append(f'dynamo_hub_events_total{{kind="{kind}"}} {v}')
+            pub = hub.get("publish_seconds") or {}
+            lines.append("# HELP dynamo_hub_publish_seconds hub event "
+                         "fan-out latency (publish + stream_publish)")
+            lines.append("# TYPE dynamo_hub_publish_seconds histogram")
+            for le, cum in (pub.get("buckets") or {}).items():
+                lines.append(
+                    f'dynamo_hub_publish_seconds_bucket{{le="{le}"}} {cum}')
+            lines.append(f"dynamo_hub_publish_seconds_sum "
+                         f"{pub.get('sum', 0.0)}")
+            lines.append(f"dynamo_hub_publish_seconds_count "
+                         f"{pub.get('count', 0)}")
         return "\n".join(lines) + "\n"
 
 
@@ -122,7 +155,8 @@ async def amain():
 
     async def metrics(_req):
         depth = await svc.sample_queue_depth()
-        return web.Response(text=svc.render(depth),
+        hub = await svc.sample_hub_stats()
+        return web.Response(text=svc.render(depth, hub=hub),
                             content_type="text/plain")
 
     app = web.Application()

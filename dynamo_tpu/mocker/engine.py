@@ -180,6 +180,14 @@ class MockEngine:
         self._wake = asyncio.Event()
         self._stopped = False
         self.iterations = 0
+        #: step flight recorder parity with the real engine
+        #: (observability/flight.py): every simulated step appends one
+        #: tagged record, so fleet-level tests and `dynctl top` see the
+        #: same timeline shape without an accelerator. run_mocker
+        #: registers it per rank for the fan-out endpoint.
+        from dynamo_tpu.observability.flight import FlightRecorder
+        self.flight = FlightRecorder(service="mocker")
+        self._last_empty_rec = 0.0
         #: chaos worker.kill (runtime/chaos.py): hard-died mid-step —
         #: in-flight queues never resolve, death reaches the fleet only
         #: via lease expiry (same contract as the real engine)
@@ -195,6 +203,10 @@ class MockEngine:
         self._wake.set()
         if self._task:
             await self._task
+        name = getattr(self, "_flight_name", None)
+        if name is not None:  # set by run_mocker's per-rank registration
+            from dynamo_tpu.observability.flight import unregister_recorder
+            unregister_recorder(name)
 
     # -- public engine interface ------------------------------------------
     async def generate(self, req, ctx: Context) -> AsyncIterator[dict]:
@@ -339,8 +351,37 @@ class MockEngine:
             await asyncio.sleep(ms / 1000.0 / self.args.speedup_ratio)
         else:
             await asyncio.sleep(0)
+        self._flight_record(prefill_tokens, decoded, ms)
         self._reap_finished()
         await self._publish_metrics()
+
+    def _flight_record(self, prefill_tokens: int, decoded: int,
+                       ms: float) -> None:
+        """Real-engine flight parity: one record per simulated step. An
+        admission-blocked spin (work queued, nothing runnable — the memory
+        bubble) records ``empty`` at most every 10 ms so the busy-wait
+        cannot flood the ring with identical bubbles."""
+        if not self.flight.enabled:
+            return
+        if not prefill_tokens and not decoded:
+            if not (self.waiting or self.running):
+                return
+            now = time.monotonic()
+            if now - self._last_empty_rec < 0.01:
+                return
+            self._last_empty_rec = now
+            self.flight.record(
+                "empty", 0.0, waiting=len(self.waiting),
+                running=len(self.running),
+                kv_tiers={"g1": self.cache.used_blocks})
+            return
+        chunks = sum(1 for s in self.running if s.in_prefill)
+        self.flight.record(
+            "mock", ms / self.args.speedup_ratio,
+            decode_rows=decoded, prefill_chunks=chunks,
+            chunk_tokens=prefill_tokens,
+            waiting=len(self.waiting), running=len(self.running),
+            kv_tiers={"g1": self.cache.used_blocks})
 
     def _admit(self):
         while self.waiting and len(self.running) < self.args.max_num_seqs:

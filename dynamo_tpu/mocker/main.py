@@ -86,6 +86,15 @@ async def run_mocker(
     from dynamo_tpu.observability import ensure_trace_endpoint
 
     await ensure_trace_endpoint(runtime)
+    # per-rank flight recorders → /v1/fleet/steps + dynctl top/timeline
+    from dynamo_tpu.observability.flight import (
+        ensure_flight_endpoint, register_recorder,
+    )
+    for rank, engine in enumerate(engines):
+        name = component if len(engines) == 1 else f"{component}-r{rank}"
+        engine.flight.service = name
+        engine._flight_name = register_recorder(name, engine.flight)
+    await ensure_flight_endpoint(runtime)
     return engines, handles
 
 

@@ -1,8 +1,11 @@
-"""Distributed request tracing + request-lifecycle SLO metrics.
+"""Distributed request tracing + request-lifecycle SLO metrics + the
+per-step fleet flight recorder.
 
 Span/Tracer recorder keyed by the runtime's existing W3C trace ids
-(tracing.py), cross-process stitching over the control plane (collector.py),
-and the env-gated jax.profiler correlation hook (profiler.py).
+(tracing.py, with DYN_TRACE_SAMPLE head-sampling), cross-process stitching
+over the control plane (collector.py), the per-worker step flight recorder
+with anomaly tagging + fleet fan-out (flight.py), and the env-gated
+jax.profiler correlation hook (profiler.py).
 See docs/observability.md.
 """
 
@@ -14,6 +17,8 @@ from dynamo_tpu.observability.tracing import (
     get_tracer,
     parse_traceparent,
     stitch,
+    trace_sample_rate,
+    trace_sampled,
 )
 from dynamo_tpu.observability.collector import (
     TRACER_PREFIX,
@@ -21,9 +26,22 @@ from dynamo_tpu.observability.collector import (
     fetch_trace,
     serve_traces,
 )
+from dynamo_tpu.observability.flight import (
+    FLIGHT_PREFIX,
+    FlightRecorder,
+    StepRecord,
+    ensure_flight_endpoint,
+    fetch_fleet_steps,
+    flight_enabled,
+    register_recorder,
+    serve_flight,
+)
 
 __all__ = [
     "CURRENT_SPAN", "Span", "Tracer", "configure_tracer", "get_tracer",
-    "parse_traceparent", "stitch", "TRACER_PREFIX",
-    "ensure_trace_endpoint", "fetch_trace", "serve_traces",
+    "parse_traceparent", "stitch", "trace_sample_rate", "trace_sampled",
+    "TRACER_PREFIX", "ensure_trace_endpoint", "fetch_trace", "serve_traces",
+    "FLIGHT_PREFIX", "FlightRecorder", "StepRecord",
+    "ensure_flight_endpoint", "fetch_fleet_steps", "flight_enabled",
+    "register_recorder", "serve_flight",
 ]

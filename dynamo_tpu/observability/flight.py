@@ -1,0 +1,515 @@
+"""Per-worker step flight recorder: what every engine step DID, and why it
+was slow, in a bounded ring the whole fleet can be asked about.
+
+Request spans (tracing.py) answer "where did THIS request spend its time";
+they cannot say that a stall was a preempt-to-swap storm, a mid-traffic XLA
+compile, a budget-starved decode batch, or an empty-step memory bubble —
+the *step-level* causes the flagship drive (ROADMAP item 3) has to debug.
+This module is that missing layer (ref motivation: the KV-cache-management
+survey's per-tier visibility argument, arXiv 2607.02574 §6):
+
+- ``StepRecord`` — one scheduler plan / engine step: durations, decode
+  rows, prefill chunks + tokens, padded tokens, compile info, preemption /
+  swap deltas, queue depths, KV tier occupancy G1–G4, onboard/restore
+  pulls in flight, QoS class mix, and the anomaly ``tags`` computed the
+  moment the record lands.
+- ``FlightRecorder`` — bounded ring of records + rolling step-time
+  baseline; tags are computed inline (no offline pass needed):
+  ``slow-step`` (wall > kσ over the rolling baseline), ``compile`` /
+  ``compile-steady`` (a fresh jit trace; -steady once past the warmup
+  step count), ``preempt-storm`` (rolling preemption burst),
+  ``budget-starved`` (ready decode rows left out of the step), and
+  ``empty-step`` (work exists but nothing could run — a memory bubble).
+- ``serve_flight`` / ``fetch_fleet_steps`` — the ``serve_traces``-style
+  control-plane fan-out behind ``GET /v1/fleet/steps``, ``dynctl top``
+  and ``dynctl timeline``.
+
+Env knobs (all optional):
+
+- ``DYN_FLIGHT=0``            — disable recording entirely (bench A/B arm)
+- ``DYN_FLIGHT_CAPACITY``     — ring size in records (default 4096)
+- ``DYN_FLIGHT_SIGMA``        — slow-step threshold in rolling σ (default 4)
+- ``DYN_FLIGHT_STEADY_STEPS`` — steps after which a compile counts as
+  steady-state (default 64)
+- ``DYN_FLIGHT_STORM``        — preemptions within the rolling storm
+  window (32 records) that tag a preempt-storm (default 4)
+- ``DYN_STEP_JSONL=<path>``   — append every record as one JSON line
+  (offline analysis; a broken sink disables itself, like DYN_TRACE_JSONL)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import json
+import logging
+import math
+import os
+import threading
+import time
+import weakref
+from dataclasses import dataclass, field
+from typing import Optional
+
+import msgpack
+
+logger = logging.getLogger("dynamo.observability.flight")
+
+#: discovery prefix: observability/flight/<lease-hex> → {subject, service}
+FLIGHT_PREFIX = "observability/flight/"
+
+# anomaly tag names (docs/observability.md "Flight recorder")
+TAG_SLOW = "slow-step"
+TAG_COMPILE = "compile"
+TAG_COMPILE_STEADY = "compile-steady"
+TAG_PREEMPT_STORM = "preempt-storm"
+TAG_STARVED = "budget-starved"
+TAG_EMPTY = "empty-step"
+
+#: rolling windows (records, not seconds): baseline for slow-step σ and
+#: the preemption burst window for preempt-storm
+BASELINE_WINDOW = 256
+STORM_WINDOW = 32
+#: minimum baseline samples before slow-step can fire (σ of 3 samples is
+#: noise) and the floor added to the σ threshold so microsecond mock steps
+#: don't tag on scheduler jitter
+BASELINE_MIN_SAMPLES = 16
+SLOW_FLOOR_MS = 0.5
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r", name, raw)
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(_env_float(name, float(default)))
+
+
+def flight_enabled() -> bool:
+    """Global recording gate (``DYN_FLIGHT=0`` = off; the bench A/B arm)."""
+    return os.environ.get("DYN_FLIGHT", "1").lower() not in (
+        "0", "false", "off", "no")
+
+
+@dataclass
+class StepRecord:
+    """One engine step (or one empty-step bubble). All counts are THIS
+    step's work/deltas, not cumulative totals — the ring is a timeline."""
+
+    seq: int = 0            # monotonic step index within this recorder
+    t: float = 0.0          # epoch seconds at record time
+    kind: str = ""          # ragged|prefill|decode|decode_pipe|mock|empty…
+    wall_ms: float = 0.0    # plan+execute wall clock
+    dispatch_ms: float = 0.0  # jitted-call dispatch portion (0 = unknown)
+    decode_rows: int = 0
+    prefill_chunks: int = 0
+    chunk_tokens: int = 0   # real prefill tokens this step
+    padded_tokens: int = 0  # dispatched beyond real work (bucket tails)
+    compile_s: float = 0.0  # >0: this step traced a NEW jit signature
+    compile_sig: str = ""   # the offending signature, printable
+    preempt_swap: int = 0
+    preempt_recompute: int = 0
+    swap_out_blocks: int = 0
+    swap_in_blocks: int = 0
+    waiting: int = 0
+    swapped: int = 0
+    running: int = 0
+    starved_decode: int = 0  # ready decode rows the step could not carry
+    kv_tiers: dict = field(default_factory=dict)  # {g1..g4: blocks}
+    onboard_inflight: int = 0
+    restore_inflight: int = 0
+    qos_mix: dict = field(default_factory=dict)   # {class: rows this step}
+    tags: list = field(default_factory=list)
+
+    @property
+    def tokens(self) -> int:
+        return self.decode_rows + self.chunk_tokens
+
+    def to_dict(self) -> dict:
+        d = {
+            "seq": self.seq, "t": self.t, "kind": self.kind,
+            "wall_ms": round(self.wall_ms, 3),
+            "decode_rows": self.decode_rows,
+            "prefill_chunks": self.prefill_chunks,
+            "chunk_tokens": self.chunk_tokens,
+            "padded_tokens": self.padded_tokens,
+            "waiting": self.waiting, "swapped": self.swapped,
+            "running": self.running, "tags": list(self.tags),
+        }
+        # sparse optional fields: absent-when-zero keeps the wire/JSONL
+        # compact at fleet scale (most steps are unremarkable)
+        if self.dispatch_ms:
+            d["dispatch_ms"] = round(self.dispatch_ms, 3)
+        if self.compile_s:
+            d["compile_s"] = round(self.compile_s, 4)
+            d["compile_sig"] = self.compile_sig
+        for k in ("preempt_swap", "preempt_recompute", "swap_out_blocks",
+                  "swap_in_blocks", "starved_decode", "onboard_inflight",
+                  "restore_inflight"):
+            v = getattr(self, k)
+            if v:
+                d[k] = v
+        if self.kv_tiers:
+            d["kv_tiers"] = dict(self.kv_tiers)
+        if self.qos_mix:
+            d["qos_mix"] = dict(self.qos_mix)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "StepRecord":
+        rec = StepRecord()
+        for k, v in d.items():
+            if hasattr(rec, k) and k != "tokens":
+                setattr(rec, k, v)
+        rec.tags = list(d.get("tags") or [])
+        rec.kv_tiers = dict(d.get("kv_tiers") or {})
+        rec.qos_mix = dict(d.get("qos_mix") or {})
+        return rec
+
+
+class FlightRecorder:
+    """Bounded step-record ring + inline anomaly tagging.
+
+    Thread-safe: engine loops record from the event loop while scrapes /
+    fan-out queries snapshot from other tasks (and the offload thread may
+    bump the inflight gauges).
+    """
+
+    def __init__(self, service: str = "", capacity: Optional[int] = None,
+                 enabled: Optional[bool] = None):
+        self.service = service or os.environ.get("DYN_SERVICE", "dynamo")
+        self.enabled = flight_enabled() if enabled is None else enabled
+        cap = capacity or _env_int("DYN_FLIGHT_CAPACITY", 4096)
+        self.sigma = _env_float("DYN_FLIGHT_SIGMA", 4.0)
+        self.steady_after = _env_int("DYN_FLIGHT_STEADY_STEPS", 64)
+        self.storm_threshold = _env_int("DYN_FLIGHT_STORM", 4)
+        self._ring: collections.deque[StepRecord] = collections.deque(
+            maxlen=max(16, cap))
+        self._lock = threading.Lock()
+        self._seq = 0
+        #: PER-KIND rolling step-time baselines (non-empty steps) with
+        #: running moments — O(1) per record, never a full-window scan.
+        #: Per kind, not pooled: a routine 30 ms prefill chunk after a
+        #: stretch of ~1 ms pipelined decode steps is NOT a slow step,
+        #: and a pooled σ would tag it on every burst boundary.
+        self._base: dict[str, list] = {}  # kind -> [deque, sum, sq]
+        #: rolling preemption counts for the storm window
+        self._storm: collections.deque[int] = collections.deque(
+            maxlen=STORM_WINDOW)
+        self._storm_sum = 0
+        self.anomaly_counts: dict[str, int] = {}
+        #: external gauges (disagg handler sets onboard/restore inflight;
+        #: read at record time so every step carries the current value)
+        self.gauges: dict[str, int] = {}
+        self._jsonl_path = os.environ.get("DYN_STEP_JSONL") or None
+
+    # ------------------------------------------------------------ recording
+
+    def steady(self) -> bool:
+        """Past the warm-up record count — the ONE signal both the
+        ``compile-steady`` tag and the engine's steady-state-compile
+        WARNING key on, so the tag and the log can never disagree."""
+        return self._seq > self.steady_after
+
+    def set_gauge(self, name: str, value: int) -> None:
+        self.gauges[name] = value
+
+    def bump_gauge(self, name: str, delta: int) -> None:
+        self.gauges[name] = max(0, self.gauges.get(name, 0) + delta)
+
+    def _baseline(self, kind: str) -> tuple[int, float, float]:
+        b = self._base.get(kind)
+        if b is None:
+            return 0, 0.0, 0.0
+        dq, s, sq = b
+        n = len(dq)
+        if n == 0:
+            return 0, 0.0, 0.0
+        mean = s / n
+        var = max(0.0, sq / n - mean * mean)
+        return n, mean, math.sqrt(var)
+
+    def record(self, kind: str, wall_ms: float, **fields) -> (
+            Optional[StepRecord]):
+        """Append one step record, computing its anomaly tags inline.
+        Returns the record (None when recording is disabled)."""
+        if not self.enabled:
+            return None
+        rec = StepRecord(kind=kind, wall_ms=float(wall_ms), t=time.time(),
+                         **fields)
+        if self.gauges:
+            rec.onboard_inflight = rec.onboard_inflight or self.gauges.get(
+                "onboard_inflight", 0)
+            rec.restore_inflight = rec.restore_inflight or self.gauges.get(
+                "restore_inflight", 0)
+        with self._lock:
+            self._seq += 1
+            rec.seq = self._seq
+            # ---- tags (computed BEFORE this record joins the baseline, so
+            # an outlier can't raise the very threshold it must cross)
+            n, mean, std = self._baseline(kind)
+            if (kind != "empty" and n >= BASELINE_MIN_SAMPLES
+                    and rec.wall_ms > mean
+                    + max(self.sigma * std, SLOW_FLOOR_MS)):
+                rec.tags.append(TAG_SLOW)
+            if rec.compile_s > 0:
+                rec.tags.append(TAG_COMPILE)
+                if self.steady():
+                    rec.tags.append(TAG_COMPILE_STEADY)
+            preempts = rec.preempt_swap + rec.preempt_recompute
+            self._storm_sum += preempts
+            if len(self._storm) == self._storm.maxlen:
+                self._storm_sum -= self._storm[0]
+            self._storm.append(preempts)
+            if preempts and self._storm_sum >= self.storm_threshold:
+                rec.tags.append(TAG_PREEMPT_STORM)
+            if rec.starved_decode > 0:
+                rec.tags.append(TAG_STARVED)
+            if kind == "empty":
+                rec.tags.append(TAG_EMPTY)
+            for t in rec.tags:
+                self.anomaly_counts[t] = self.anomaly_counts.get(t, 0) + 1
+            # ---- baseline update (empty bubbles excluded: their duration
+            # is a wait, not a step time)
+            if kind != "empty":
+                b = self._base.get(kind)
+                if b is None:
+                    b = self._base[kind] = [
+                        collections.deque(maxlen=BASELINE_WINDOW), 0.0, 0.0]
+                dq = b[0]
+                if len(dq) == dq.maxlen:
+                    old = dq[0]
+                    b[1] -= old
+                    b[2] -= old * old
+                dq.append(rec.wall_ms)
+                b[1] += rec.wall_ms
+                b[2] += rec.wall_ms * rec.wall_ms
+            self._ring.append(rec)
+        path = self._jsonl_path
+        if path:
+            try:
+                with open(path, "a") as f:
+                    f.write(json.dumps(rec.to_dict()) + "\n")
+            except OSError:
+                self._jsonl_path = None  # never retry a broken sink per step
+        return rec
+
+    # ------------------------------------------------------------- reading
+
+    def snapshot(self, n: Optional[int] = None) -> list[dict]:
+        """Newest-last list of record dicts (the whole ring by default)."""
+        with self._lock:
+            recs = list(self._ring)
+        if n is not None and n > 0:
+            recs = recs[-n:]
+        return [r.to_dict() for r in recs]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def summary(self) -> dict:
+        """Aggregate view for ``dynctl top``: step counts, rolling wall
+        p50/p95, tok/s over the ring, anomaly counts, latest queue/tier
+        state."""
+        with self._lock:
+            recs = list(self._ring)
+            anomalies = dict(self.anomaly_counts)
+            total = self._seq
+        steps = [r for r in recs if r.kind != "empty"]
+        walls = sorted(r.wall_ms for r in steps)
+
+        def pct(p: float) -> float:
+            if not walls:
+                return 0.0
+            return walls[min(len(walls) - 1, int(len(walls) * p))]
+
+        tok_s = 0.0
+        if len(steps) >= 2:
+            span = steps[-1].t - steps[0].t
+            if span > 0:
+                tok_s = sum(r.tokens for r in steps) / span
+        last = recs[-1] if recs else StepRecord()
+        return {
+            "service": self.service,
+            "enabled": self.enabled,
+            "steps_total": total,
+            "steps_in_ring": len(steps),
+            "last_seq": last.seq,
+            "last_t": last.t,
+            "wall_p50_ms": round(pct(0.50), 3),
+            "wall_p95_ms": round(pct(0.95), 3),
+            "tok_s": round(tok_s, 1),
+            "tokens_in_ring": sum(r.tokens for r in steps),
+            "anomalies": anomalies,
+            "waiting": last.waiting,
+            "swapped": last.swapped,
+            "running": last.running,
+            "kv_tiers": dict(last.kv_tiers),
+            "onboard_inflight": self.gauges.get("onboard_inflight", 0),
+            "restore_inflight": self.gauges.get("restore_inflight", 0),
+        }
+
+    def export_jsonl(self, path: str) -> int:
+        """Dump the ring as JSONL; returns the line count."""
+        recs = self.snapshot()
+        with open(path, "w") as f:
+            for d in recs:
+                f.write(json.dumps(d) + "\n")
+        return len(recs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._base.clear()
+            self._storm.clear()
+            self._storm_sum = 0
+            self.anomaly_counts = {}
+
+
+# ------------------------------------------------------- process registry
+
+#: name → WEAK ref to a recorder of THIS process; a process may host
+#: several engines (mocker DP ranks), each with its own ring, all served
+#: by one endpoint. Weak refs mean an engine discarded WITHOUT close()
+#: (constructor failure after registration, bench/test churn) cannot pin
+#: a ghost ring for the process lifetime — the owner holds the only
+#: strong reference, and dead entries self-prune.
+_registry: dict[str, "weakref.ref[FlightRecorder]"] = {}
+_registry_lock = threading.Lock()
+
+
+def register_recorder(name: str, rec: FlightRecorder) -> str:
+    """Register under ``name`` (suffixing -2, -3… on collision); returns
+    the name actually used."""
+    with _registry_lock:
+        for k in [k for k, r in _registry.items() if r() is None]:
+            del _registry[k]
+        base, n, final = name, 1, name
+        while final in _registry and _registry[final]() is not rec:
+            n += 1
+            final = f"{base}-{n}"
+        _registry[final] = weakref.ref(rec)
+        return final
+
+
+def unregister_recorder(name: str) -> None:
+    with _registry_lock:
+        _registry.pop(name, None)
+
+
+def recorders() -> dict[str, FlightRecorder]:
+    with _registry_lock:
+        out = {}
+        for name, ref in _registry.items():
+            rec = ref()
+            if rec is not None:
+                out[name] = rec
+        return out
+
+
+# --------------------------------------------- control-plane fan-out layer
+
+
+class FlightServeHandle:
+    def __init__(self, runtime, key: str, cancel_serve):
+        self._runtime = runtime
+        self._key = key
+        self._cancel = cancel_serve
+
+    async def stop(self) -> None:
+        try:
+            self._runtime.drop_registration(self._key)
+            await self._runtime.plane.kv_delete(self._key)
+        finally:
+            if self._cancel:
+                await self._cancel()
+
+
+async def serve_flight(runtime) -> FlightServeHandle:
+    """Expose this process's flight recorders to fleet queries.
+
+    Query wire: msgpack ``{"n": <records>}`` (n<=0 or absent → summaries
+    only) → ``{"service", "workers": {name: {"summary", "steps"}}}``.
+    The discovery key rides the primary lease, so a dead worker drops out
+    of the fan-out exactly like its serving endpoints (collector.py)."""
+    lease = await runtime.primary_lease()
+    subject = f"flight-{lease:x}"
+
+    async def on_request(payload: bytes) -> bytes:
+        try:
+            q = msgpack.unpackb(payload, raw=False) or {}
+        except Exception:
+            q = {}
+        n = int(q.get("n") or 0)
+        workers = {}
+        for name, rec in recorders().items():
+            entry = {"summary": rec.summary()}
+            if n > 0:
+                entry["steps"] = rec.snapshot(n)
+            workers[name] = entry
+        return msgpack.packb({
+            "service": os.environ.get("DYN_SERVICE", "dynamo"),
+            "workers": workers,
+        })
+
+    cancel = await runtime.plane.serve(subject, on_request)
+    key = f"{FLIGHT_PREFIX}{lease:x}"
+    value = msgpack.packb(
+        {"subject": subject,
+         "service": os.environ.get("DYN_SERVICE", "dynamo")})
+    await runtime.plane.kv_put(key, value, lease_id=lease)
+    runtime.record_registration(key, value)
+    logger.debug("flight query endpoint on %s", subject)
+    return FlightServeHandle(runtime, key, cancel)
+
+
+async def ensure_flight_endpoint(runtime) -> FlightServeHandle:
+    """Idempotent per-runtime ``serve_flight`` (mirrors
+    ensure_trace_endpoint: mocker ranks / engine roles register once)."""
+    handle = getattr(runtime, "_flight_serve_handle", None)
+    if handle is None:
+        handle = await serve_flight(runtime)
+        runtime._flight_serve_handle = handle
+    return handle
+
+
+async def fetch_fleet_steps(plane, n: int = 0, timeout: float = 2.0) -> dict:
+    """Fan a step query out to every registered flight endpoint.
+
+    Returns ``{"<lease-hex>/<name>": {"summary", "steps"?}}``. A slow or
+    dead worker times out individually and is simply dropped — a partial
+    fleet view beats none (same contract as fetch_trace)."""
+    try:
+        entries = await plane.kv_get_prefix(FLIGHT_PREFIX)
+    except Exception:
+        logger.exception("flight discovery failed")
+        return {}
+
+    async def one(key: str, value: bytes) -> dict:
+        try:
+            meta = msgpack.unpackb(value, raw=False)
+            raw = await asyncio.wait_for(
+                plane.request(meta["subject"], msgpack.packb({"n": n}),
+                              timeout=timeout),
+                timeout + 0.5)
+            resp = msgpack.unpackb(raw, raw=False) or {}
+            lease_hex = key[len(FLIGHT_PREFIX):]
+            return {f"{lease_hex}/{name}": entry
+                    for name, entry in (resp.get("workers") or {}).items()}
+        except Exception:
+            return {}  # that worker is gone/slow; keep the rest
+
+    results = await asyncio.gather(
+        *(one(k, v) for k, v in entries.items()))
+    merged: dict = {}
+    for part in results:
+        merged.update(part)
+    return merged

@@ -35,10 +35,12 @@ from __future__ import annotations
 import collections
 import contextvars
 import json
+import logging
 import os
 import secrets
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -60,6 +62,44 @@ _SLO_HISTOGRAMS = {
 #: out of the latency histograms — an always-zero phase whose count can
 #: exceed request count under retries is dashboard noise
 _NO_HISTOGRAM = {"rpc.send"}
+
+
+_sample_warned = False
+
+
+def trace_sample_rate() -> float:
+    """``DYN_TRACE_SAMPLE`` (0.0–1.0) head-sampling rate for request spans;
+    default 1.0 (record everything). Keeps the tracer + flight recorder
+    bounded-overhead at fleet scale: unsampled traces record NOTHING in any
+    process — the decision is a pure function of the trace id, so every hop
+    agrees without a wire change."""
+    global _sample_warned
+    raw = os.environ.get("DYN_TRACE_SAMPLE")
+    if not raw:
+        return 1.0
+    try:
+        rate = float(raw)
+    except ValueError:
+        if not _sample_warned:
+            _sample_warned = True
+            logging.getLogger("dynamo.observability").warning(
+                "ignoring malformed DYN_TRACE_SAMPLE=%r", raw)
+        return 1.0
+    return min(1.0, max(0.0, rate))
+
+
+def trace_sampled(trace_or_request_id: str,
+                  rate: Optional[float] = None) -> bool:
+    """Deterministic head-sampling decision for a trace (or request) id:
+    hash → [0,1) < rate. Identical on every process/hop for one id."""
+    if rate is None:
+        rate = trace_sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    h = zlib.crc32(str(trace_or_request_id).encode()) & 0xFFFFFFFF
+    return h / 4294967296.0 < rate
 
 
 def parse_traceparent(tp: Optional[str]) -> Optional[tuple[str, str]]:
@@ -228,6 +268,8 @@ class Tracer:
         if parsed is None:
             return _NOOP
         trace_id, wire_span = parsed
+        if not trace_sampled(trace_id):
+            return _NOOP  # head-sampled out: no span, no histogram feed
         cur = CURRENT_SPAN.get()
         if cur is not None and cur.trace_id == trace_id:
             parent, span_id = cur.span_id, secrets.token_hex(8)
@@ -278,6 +320,8 @@ class Tracer:
         if parsed is None:
             return _NOOP
         trace_id, hop_span = parsed
+        if not trace_sampled(trace_id):
+            return _NOOP
         cur = CURRENT_SPAN.get()
         parent = None
         if cur is not None and cur.trace_id == trace_id:

@@ -254,6 +254,39 @@ def _subject_matches(pattern: str, subject: str) -> bool:
     return pattern == subject
 
 
+class _HubHist:
+    """Tiny fixed-bucket latency histogram for hub self-instrumentation —
+    runtime.metrics.Histogram carries labels/locks this single-loop hot
+    path does not need. Rendered as ``dynamo_hub_publish_seconds`` by the
+    metrics aggregator (metrics/main.py)."""
+
+    BUCKETS = (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 1e-1)
+
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self):
+        self.counts = [0] * (len(self.BUCKETS) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.sum += v
+        self.count += 1
+        for i, b in enumerate(self.BUCKETS):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def to_dict(self) -> dict:
+        cum, buckets = 0, {}
+        for i, b in enumerate(self.BUCKETS):
+            cum += self.counts[i]
+            buckets[str(b)] = cum
+        buckets["+Inf"] = self.count
+        return {"count": self.count, "sum": self.sum, "buckets": buckets}
+
+
 class LocalControlPlane(ControlPlane):
     """In-process control plane; also the core of :class:`ControlPlaneServer`."""
 
@@ -277,6 +310,12 @@ class LocalControlPlane(ControlPlane):
         self._objects: dict[tuple[str, str], bytes] = {}
         self._closed = False
         self._sweeper: Optional[asyncio.Task] = None
+        #: hub self-instrumentation (docs/observability.md): per-op event
+        #: counters + event-path publish latency, the measured series
+        #: behind the fleet-bench batching ceiling (docs/PERF_NOTES.md) —
+        #: read via hub_stats() / the `hub_stats` wire op
+        self.hub_events: dict[str, int] = {}
+        self.hub_publish = _HubHist()
 
     def _ensure_sweeper(self):
         if self._sweeper is None or self._sweeper.done():
@@ -294,6 +333,15 @@ class LocalControlPlane(ControlPlane):
         except asyncio.CancelledError:
             pass
 
+    def _hub_count(self, kind: str) -> None:
+        self.hub_events[kind] = self.hub_events.get(kind, 0) + 1
+
+    async def hub_stats(self) -> dict:
+        """Event counters + publish latency for dynctl top and the metrics
+        aggregator's dynamo_hub_* series."""
+        return {"epoch": self.epoch, "events": dict(self.hub_events),
+                "publish_seconds": self.hub_publish.to_dict()}
+
     # -- KV --
     def _notify(self, ev: WatchEvent):
         for prefix, q in self._watches:
@@ -301,6 +349,7 @@ class LocalControlPlane(ControlPlane):
                 q.put_nowait(ev)
 
     async def kv_put(self, key, value, lease_id=None):
+        self._hub_count("kv_put")
         self._kv[key] = value
         self._attach_lease(key, lease_id)
         self._notify(WatchEvent("put", key, value))
@@ -329,6 +378,7 @@ class LocalControlPlane(ControlPlane):
         return {k: v for k, v in self._kv.items() if k.startswith(prefix)}
 
     async def kv_delete(self, key) -> int:
+        self._hub_count("kv_delete")
         if key in self._kv:
             del self._kv[key]
             self._attach_lease(key, None)
@@ -385,11 +435,13 @@ class LocalControlPlane(ControlPlane):
 
     # -- Pub/sub --
     async def publish(self, subject, payload):
+        self._hub_count("publish")
         chaos = get_chaos()
         if chaos is not None:
             await chaos.pre("plane.publish")
             if chaos.should_drop("plane.publish"):
                 return  # message loss: subscribers simply never see it
+        t0 = time.perf_counter()
         groups: dict[str, list[asyncio.Queue]] = {}
         for pattern, qg, q in self._subs:
             if _subject_matches(pattern, subject):
@@ -399,6 +451,7 @@ class LocalControlPlane(ControlPlane):
                     groups.setdefault(qg, []).append(q)
         for qs in groups.values():
             random.choice(qs).put_nowait((subject, payload))
+        self.hub_publish.observe(time.perf_counter() - t0)
 
     async def subscribe(self, subject, queue_group=None) -> Subscription:
         q: asyncio.Queue = asyncio.Queue()
@@ -414,6 +467,7 @@ class LocalControlPlane(ControlPlane):
 
     # -- Request/reply --
     async def request(self, subject, payload, timeout=30.0) -> bytes:
+        self._hub_count("request")
         regs = [s for s in self._services if _subject_matches(s.subject, subject)]
         if not regs:
             raise NoRespondersError(subject)
@@ -439,6 +493,7 @@ class LocalControlPlane(ControlPlane):
     QUEUE_MAX_LEN = 65536  # oldest tickets dropped past this (cap like streams)
 
     async def queue_push(self, queue, payload) -> None:
+        self._hub_count("queue_push")
         waiters = self._queue_waiters.get(queue)
         while waiters:
             fut = waiters.popleft()
@@ -473,6 +528,8 @@ class LocalControlPlane(ControlPlane):
 
     # -- Durable streams --
     async def stream_publish(self, stream, payload) -> int:
+        self._hub_count("stream_publish")
+        t0 = time.perf_counter()
         seq, entries = self._streams.get(stream, (0, []))
         seq += 1
         entries.append((seq, payload))
@@ -481,6 +538,7 @@ class LocalControlPlane(ControlPlane):
         self._streams[stream] = (seq, entries)
         for q in self._stream_subs.get(stream, []):
             q.put_nowait((seq, payload))
+        self.hub_publish.observe(time.perf_counter() - t0)
         return seq
 
     async def stream_subscribe(self, stream, start_seq=0) -> StreamSub:
@@ -961,6 +1019,8 @@ class _ServerConn:
                 await cancel()
         elif op == "epoch":
             return core.epoch
+        elif op == "hub_stats":
+            return await core.hub_stats()
         elif op == "dump_state":
             return core.dump_state()
         elif op == "queue_push":
@@ -1440,6 +1500,11 @@ class RemoteControlPlane(ControlPlane):
 
     async def get_epoch(self) -> str:
         return await self._call("epoch")
+
+    async def hub_stats(self) -> dict:
+        """The hub's self-instrumentation (event counters + publish
+        latency) — surfaced by ``dynctl top`` and the metrics aggregator."""
+        return await self._call("hub_stats")
 
     # -- Object store --
     async def object_put(self, bucket, name, data):

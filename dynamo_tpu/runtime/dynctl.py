@@ -21,6 +21,13 @@ Subcommands:
   (docs/autoscaling.md): controller decision/SLO state, planner target,
   and the operator's desired/alive/ready/draining counts per service;
   ``--watch`` refreshes, ``--json`` dumps the raw status documents.
+- ``dynctl top`` — live fleet table from the step flight recorders
+  (docs/observability.md "Flight recorder"): per-worker tok/s, step
+  p50/p95, anomaly counts, KV tier occupancy G1–G4, queue depths, plus
+  the hub's own event counters; ``--watch`` refreshes, ``--json`` dumps.
+- ``dynctl timeline <worker>`` — one worker's recent step strip with
+  anomaly tags (``!`` slow, ``C`` compile, ``P`` preempt-storm, ``s``
+  budget-starved, ``_`` empty bubble) and the tagged records in full.
 """
 
 from __future__ import annotations
@@ -184,6 +191,164 @@ async def autoscale_amain(namespace: str, as_json: bool,
         await runtime.shutdown()
 
 
+async def top_amain(as_json: bool, watch: float = 0.0,
+                    timeout: float = 2.0) -> int:
+    """Live fleet table from every worker's flight recorder summary."""
+    from dynamo_tpu.observability import fetch_fleet_steps
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    runtime = await DistributedRuntime.create()
+
+    def fmt_anoms(anoms: dict) -> str:
+        labels = (("slow-step", "slow"), ("compile-steady", "steady"),
+                  ("compile", "compile"), ("preempt-storm", "storm"),
+                  ("budget-starved", "starved"), ("empty-step", "empty"))
+        parts = [f"{short}={anoms[k]}" for k, short in labels
+                 if anoms.get(k)]
+        return " ".join(parts) or "-"
+
+    try:
+        while True:
+            workers = await fetch_fleet_steps(runtime.plane, n=0,
+                                              timeout=timeout)
+            hub = None
+            if hasattr(runtime.plane, "hub_stats"):
+                try:
+                    hub = await runtime.plane.hub_stats()
+                except Exception:
+                    pass
+            if as_json:
+                print(json.dumps({"workers": workers, "hub": hub},
+                                 indent=2))
+            else:
+                if not workers:
+                    print("no flight recorders registered — are workers "
+                          "running against this control plane (and is "
+                          "DYN_CONTROL_PLANE set)?")
+                else:
+                    hdr = (f"{'worker':<28s} {'steps':>7s} {'tok/s':>8s} "
+                           f"{'p50ms':>8s} {'p95ms':>8s} "
+                           f"{'g1/g2/g3/g4':>15s} {'w/s/r':>8s}  anomalies")
+                    print(hdr)
+                    for name in sorted(workers):
+                        s = workers[name].get("summary") or {}
+                        t = s.get("kv_tiers") or {}
+                        tiers = "/".join(str(t.get(k, 0))
+                                         for k in ("g1", "g2", "g3", "g4"))
+                        queues = (f"{s.get('waiting', 0)}/"
+                                  f"{s.get('swapped', 0)}/"
+                                  f"{s.get('running', 0)}")
+                        print(f"{name:<28s} {s.get('steps_total', 0):>7d} "
+                              f"{s.get('tok_s', 0.0):>8.1f} "
+                              f"{s.get('wall_p50_ms', 0.0):>8.2f} "
+                              f"{s.get('wall_p95_ms', 0.0):>8.2f} "
+                              f"{tiers:>15s} {queues:>8s}  "
+                              f"{fmt_anoms(s.get('anomalies') or {})}")
+                if hub:
+                    ev = hub.get("events") or {}
+                    pub = hub.get("publish_seconds") or {}
+                    mean_us = (pub["sum"] / pub["count"] * 1e6
+                               if pub.get("count") else 0.0)
+                    print(f"hub: "
+                          + " ".join(f"{k}={v}" for k, v in sorted(ev.items()))
+                          + f"  publish mean {mean_us:.0f}us over "
+                            f"{pub.get('count', 0)} events")
+            if not watch:
+                return 0 if workers else 1
+            await asyncio.sleep(watch)
+            print()
+    finally:
+        await runtime.shutdown()
+
+
+#: timeline strip symbols, highest-priority tag wins per record
+_STRIP = (("empty-step", "_"), ("preempt-storm", "P"),
+          ("compile-steady", "C"), ("compile", "c"), ("slow-step", "!"),
+          ("budget-starved", "s"))
+
+
+async def timeline_amain(worker: str, n: int, as_json: bool,
+                         timeout: float = 2.0) -> int:
+    """Recent step strip + tagged records for one worker (substring match
+    on the fleet key, e.g. ``backend`` or the lease hex)."""
+    from dynamo_tpu.observability import fetch_fleet_steps
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    runtime = await DistributedRuntime.create()
+    try:
+        workers = await fetch_fleet_steps(runtime.plane, n=n,
+                                          timeout=timeout)
+        matches = {k: v for k, v in workers.items() if worker in k}
+        if not matches:
+            print(f"no flight recorder matches {worker!r} "
+                  f"(known: {sorted(workers) or 'none'})", file=sys.stderr)
+            return 1
+        if as_json:
+            print(json.dumps(matches, indent=2))
+            return 0
+        for name in sorted(matches):
+            steps = matches[name].get("steps") or []
+            summary = matches[name].get("summary") or {}
+            print(f"{name}: {len(steps)} recent steps "
+                  f"(p95 {summary.get('wall_p95_ms', 0.0)}ms, "
+                  f"anomalies {summary.get('anomalies') or {}})")
+            strip = []
+            for rec in steps:
+                tags = set(rec.get("tags") or [])
+                sym = "."
+                for tag, ch in _STRIP:
+                    if tag in tags:
+                        sym = ch
+                        break
+                strip.append(sym)
+            print("  " + "".join(strip))
+            for rec in steps:
+                if not rec.get("tags"):
+                    continue
+                extras = " ".join(
+                    f"{k}={rec[k]}" for k in
+                    ("compile_sig", "compile_s", "preempt_swap",
+                     "preempt_recompute", "starved_decode", "waiting",
+                     "swapped") if rec.get(k))
+                print(f"  #{rec.get('seq'):<7d} {rec.get('kind', ''):<12s} "
+                      f"{rec.get('wall_ms', 0.0):>9.2f}ms "
+                      f"dec={rec.get('decode_rows', 0)} "
+                      f"chunks={rec.get('prefill_chunks', 0)} "
+                      f"[{','.join(rec.get('tags'))}] {extras}".rstrip())
+        return 0
+    finally:
+        await runtime.shutdown()
+
+
+def _top_main(argv: list[str]) -> None:
+    ap = argparse.ArgumentParser(
+        prog="dynctl top",
+        description="live fleet table from the step flight recorders")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every N seconds (0 = one-shot)")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-worker fetch timeout (seconds)")
+    args = ap.parse_args(argv)
+    raise SystemExit(asyncio.run(
+        top_amain(args.json, args.watch, args.timeout)))
+
+
+def _timeline_main(argv: list[str]) -> None:
+    ap = argparse.ArgumentParser(
+        prog="dynctl timeline",
+        description="recent step strip + tagged records for one worker")
+    ap.add_argument("worker", help="fleet key substring "
+                                   "(component name or lease hex)")
+    ap.add_argument("-n", type=int, default=120,
+                    help="recent records to fetch (default 120)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    raise SystemExit(asyncio.run(
+        timeline_amain(args.worker, args.n, args.json, args.timeout)))
+
+
 def _autoscale_main(argv: list[str]) -> None:
     ap = argparse.ArgumentParser(
         prog="dynctl autoscale",
@@ -219,6 +384,12 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "autoscale":
         _autoscale_main(sys.argv[2:])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "top":
+        _top_main(sys.argv[2:])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "timeline":
+        _timeline_main(sys.argv[2:])
         return
     ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
     ap.add_argument("--host", default="0.0.0.0")

@@ -1,0 +1,485 @@
+"""Fleet flight recorder (docs/observability.md "Flight recorder"):
+ring-bounded memory, inline anomaly tagging, fleet fan-out with dead-worker
+drop, JSONL round-trip, mocker parity, trace head-sampling, hub event
+instrumentation, engine compile visibility, and tier occupancy gauges."""
+
+import asyncio
+import json
+
+import msgpack
+import pytest
+
+from dynamo_tpu.observability import (
+    FlightRecorder,
+    StepRecord,
+    Tracer,
+    fetch_fleet_steps,
+    serve_flight,
+    trace_sampled,
+)
+from dynamo_tpu.observability.flight import (
+    FLIGHT_PREFIX,
+    TAG_COMPILE_STEADY,
+    TAG_EMPTY,
+    TAG_PREEMPT_STORM,
+    TAG_SLOW,
+    TAG_STARVED,
+    register_recorder,
+    unregister_recorder,
+)
+from dynamo_tpu.runtime.context import Context
+
+pytestmark = pytest.mark.anyio
+
+
+def make_recorder(**kw) -> FlightRecorder:
+    kw.setdefault("service", "test")
+    kw.setdefault("enabled", True)
+    return FlightRecorder(**kw)
+
+
+# ------------------------------------------------------------- ring + tags
+
+
+def test_ring_bounded_under_10k_steps():
+    rec = make_recorder(capacity=512)
+    for i in range(10_000):
+        rec.record("ragged", 2.0, decode_rows=4, chunk_tokens=8,
+                   kv_tiers={"g1": i % 7})
+    assert len(rec) == 512
+    snap = rec.snapshot()
+    assert len(snap) == 512
+    # the ring keeps the NEWEST records and the seq keeps counting
+    assert snap[-1]["seq"] == 10_000
+    assert snap[0]["seq"] == 10_000 - 512 + 1
+    assert rec.summary()["steps_total"] == 10_000
+    # baseline/storm windows are bounded too (no unbounded growth)
+    assert all(len(b[0]) <= 256 for b in rec._base.values())
+    assert len(rec._storm) <= 32
+
+
+def test_disabled_recorder_records_nothing():
+    rec = make_recorder(enabled=False)
+    assert rec.record("ragged", 1.0) is None
+    assert len(rec) == 0
+
+
+def test_slow_step_tag_needs_baseline_and_sigma():
+    rec = make_recorder()
+    for _ in range(40):
+        r = rec.record("ragged", 5.0, decode_rows=1)
+        assert TAG_SLOW not in r.tags  # steady baseline: no false tags
+    slow = rec.record("ragged", 120.0, decode_rows=1)
+    assert TAG_SLOW in slow.tags
+    # the outlier joined the baseline AFTER tagging, not before
+    assert rec.anomaly_counts[TAG_SLOW] == 1
+    # too few samples → never tags (σ of 3 samples is noise)
+    fresh = make_recorder()
+    fresh.record("ragged", 1.0)
+    r = fresh.record("ragged", 500.0)
+    assert TAG_SLOW not in r.tags
+
+
+def test_slow_step_baseline_is_per_kind():
+    """A routine 30 ms prefill after a stretch of ~1 ms pipelined decode
+    steps is NOT slow — a pooled baseline would tag every burst boundary."""
+    rec = make_recorder()
+    for _ in range(40):
+        rec.record("decode_pipe", 1.0, decode_rows=4)
+    r = rec.record("ragged", 30.0, prefill_chunks=1, chunk_tokens=64)
+    assert TAG_SLOW not in r.tags  # no ragged baseline yet
+    for _ in range(20):
+        rec.record("ragged", 30.0, prefill_chunks=1, chunk_tokens=64)
+    ok = rec.record("ragged", 30.2, prefill_chunks=1, chunk_tokens=64)
+    assert TAG_SLOW not in ok.tags  # within the 0.5 ms jitter floor
+    slow = rec.record("ragged", 400.0, prefill_chunks=1, chunk_tokens=64)
+    assert TAG_SLOW in slow.tags
+    # the decode baseline still catches ITS OWN outliers
+    slow_d = rec.record("decode_pipe", 50.0, decode_rows=4)
+    assert TAG_SLOW in slow_d.tags
+
+
+def test_compile_steady_tag_and_warmup_grace():
+    rec = make_recorder()
+    rec.steady_after = 10
+    early = rec.record("ragged", 50.0, compile_s=0.5, compile_sig="ragged:64")
+    assert "compile" in early.tags and TAG_COMPILE_STEADY not in early.tags
+    for _ in range(12):
+        rec.record("ragged", 2.0)
+    late = rec.record("ragged", 50.0, compile_s=0.5, compile_sig="ragged:8")
+    assert TAG_COMPILE_STEADY in late.tags
+
+
+def test_preempt_storm_tag_rolling_window():
+    rec = make_recorder()
+    rec.storm_threshold = 4
+    # sparse preemptions never tag
+    for i in range(60):
+        r = rec.record("ragged", 2.0,
+                       preempt_recompute=1 if i % 40 == 0 else 0)
+        assert TAG_PREEMPT_STORM not in r.tags
+    # a burst inside the window does; preempt-free records in between
+    # do NOT get the tag (the tag marks steps that preempted)
+    tagged = []
+    for i in range(6):
+        r = rec.record("ragged", 2.0, preempt_swap=1)
+        tagged.append(TAG_PREEMPT_STORM in r.tags)
+    assert any(tagged)
+    calm = rec.record("ragged", 2.0)
+    assert TAG_PREEMPT_STORM not in calm.tags
+
+
+def test_starved_and_empty_tags():
+    rec = make_recorder()
+    r = rec.record("ragged", 2.0, decode_rows=3, starved_decode=2)
+    assert TAG_STARVED in r.tags
+    e = rec.record("empty", 50.0, waiting=4)
+    assert TAG_EMPTY in e.tags
+    # empty bubbles stay out of the slow-step baselines
+    assert "empty" not in rec._base
+    assert sum(len(b[0]) for b in rec._base.values()) == 1
+
+
+def test_summary_math():
+    rec = make_recorder()
+    for i in range(10):
+        rec.record("ragged", float(i + 1), decode_rows=2, chunk_tokens=3,
+                   waiting=1, running=2, kv_tiers={"g1": 5, "g2": 1})
+    s = rec.summary()
+    assert s["steps_total"] == 10
+    assert s["tokens_in_ring"] == 50
+    assert s["wall_p50_ms"] == 6.0  # sorted[5] of 1..10
+    assert s["wall_p95_ms"] == 10.0
+    assert s["kv_tiers"] == {"g1": 5, "g2": 1}
+    assert s["waiting"] == 1 and s["running"] == 2
+
+
+# ------------------------------------------------------------ JSONL export
+
+
+def test_jsonl_export_round_trips(tmp_path):
+    rec = make_recorder()
+    rec.record("ragged", 3.25, decode_rows=2, prefill_chunks=1,
+               chunk_tokens=7, padded_tokens=4, compile_s=0.5,
+               compile_sig="ragged:64", preempt_swap=1, starved_decode=1,
+               kv_tiers={"g1": 3, "g4": 2}, qos_mix={"interactive": 2})
+    rec.record("empty", 12.0, waiting=3)
+    path = tmp_path / "steps.jsonl"
+    n = rec.export_jsonl(str(path))
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert n == len(lines) == 2
+    back = StepRecord.from_dict(lines[0])
+    assert back.kind == "ragged" and back.wall_ms == 3.25
+    assert back.decode_rows == 2 and back.chunk_tokens == 7
+    assert back.compile_sig == "ragged:64" and back.preempt_swap == 1
+    assert back.kv_tiers == {"g1": 3, "g4": 2}
+    assert back.qos_mix == {"interactive": 2}
+    assert "compile" in back.tags
+    assert StepRecord.from_dict(lines[1]).kind == "empty"
+
+
+def test_streaming_jsonl_env(tmp_path, monkeypatch):
+    path = tmp_path / "live.jsonl"
+    monkeypatch.setenv("DYN_STEP_JSONL", str(path))
+    rec = make_recorder()
+    rec.record("ragged", 1.0, decode_rows=1)
+    rec.record("ragged", 2.0, decode_rows=1)
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [d["seq"] for d in lines] == [1, 2]
+
+
+# ----------------------------------------------------------- fleet fan-out
+
+
+async def test_fleet_fanout_merges_and_drops_dead_worker():
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    rt = await DistributedRuntime.create()
+    rec = make_recorder(service="workerA")
+    for _ in range(20):
+        rec.record("mock", 2.0, decode_rows=1, kv_tiers={"g1": 4})
+    name = register_recorder("workerA", rec)
+    try:
+        handle = await serve_flight(rt)
+        # a dead worker: discovery key present, nothing serving its subject
+        await rt.plane.kv_put(
+            FLIGHT_PREFIX + "deadbeef",
+            msgpack.packb({"subject": "flight-gone", "service": "dead"}))
+        out = await fetch_fleet_steps(rt.plane, n=5, timeout=0.3)
+        assert len(out) == 1  # dead worker dropped, live one served
+        key = next(iter(out))
+        assert key.endswith("/workerA")
+        assert out[key]["summary"]["steps_total"] == 20
+        assert len(out[key]["steps"]) == 5
+        # summary-only query ships no step payloads
+        out0 = await fetch_fleet_steps(rt.plane, n=0, timeout=0.3)
+        assert "steps" not in out0[key]
+        await handle.stop()
+        assert await fetch_fleet_steps(rt.plane, timeout=0.3) == {}
+    finally:
+        unregister_recorder(name)
+        await rt.shutdown()
+
+
+async def test_frontend_fleet_steps_route():
+    """GET /v1/fleet/steps serves the fan-out through the HTTP frontend."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    rt = await DistributedRuntime.create()
+    rec = make_recorder(service="w0")
+    rec.record("mock", 1.0, decode_rows=1)
+    name = register_recorder("w0", rec)
+    svc = HttpService(ModelManager(), host="127.0.0.1", port=0, runtime=rt)
+    try:
+        handle = await serve_flight(rt)
+        port = await svc.start()
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                    f"http://127.0.0.1:{port}/v1/fleet/steps?n=3") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert body["count"] == 1
+        entry = next(iter(body["workers"].values()))
+        assert entry["summary"]["steps_total"] == 1
+        assert len(entry["steps"]) == 1
+        await handle.stop()
+    finally:
+        unregister_recorder(name)
+        await svc.stop()
+        await rt.shutdown()
+
+
+# ---------------------------------------------------------- mocker parity
+
+
+async def test_mocker_flight_parity():
+    """The mocker's simulated steps append the same record shape the real
+    engine does (fleet tests see one timeline model)."""
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+
+    eng = await MockEngine(MockEngineArgs(
+        num_gpu_blocks=128, block_size=4, max_num_seqs=4,
+        max_num_batched_tokens=64, speedup_ratio=100.0)).start()
+    try:
+        req = PreprocessedRequest(
+            model="m", token_ids=list(range(1, 30)),
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+            sampling_options=SamplingOptions(), eos_token_ids=[2])
+        ctx = Context()
+        n = 0
+        async for out in eng.generate(req, ctx):
+            n += len(out.get("token_ids") or [])
+            if out.get("finish_reason"):
+                break
+        assert n >= 8
+        snap = eng.flight.snapshot()
+        assert snap, "mocker recorded no flight steps"
+        kinds = {d["kind"] for d in snap}
+        assert "mock" in kinds
+        steps = [d for d in snap if d["kind"] == "mock"]
+        assert any(d["chunk_tokens"] > 0 for d in steps)  # prefill visible
+        assert any(d["decode_rows"] > 0 for d in steps)   # decode visible
+        assert all("kv_tiers" in d for d in steps)
+        s = eng.flight.summary()
+        assert s["steps_total"] == len(snap)
+    finally:
+        await eng.stop()
+
+
+# --------------------------------------------------------- trace sampling
+
+
+def test_trace_sampling_deterministic_and_gating(monkeypatch):
+    ids = [f"req-{i}" for i in range(400)]
+    monkeypatch.setenv("DYN_TRACE_SAMPLE", "0.5")
+    first = [trace_sampled(i) for i in ids]
+    assert first == [trace_sampled(i) for i in ids]  # deterministic
+    assert 0.3 < sum(first) / len(first) < 0.7
+    # rate 0: every span degrades to the noop (bounded overhead)
+    monkeypatch.setenv("DYN_TRACE_SAMPLE", "0")
+    tracer = Tracer(service="t", capacity=8)
+    ctx = Context()
+    with tracer.span("http.request", ctx) as sp:
+        sp.set(a=1)
+    assert tracer.all_spans() == []
+    assert tracer.record_hop(ctx, ctx.child_traceparent()).span_id == ""
+    # rate 1 (and unset): everything records
+    monkeypatch.setenv("DYN_TRACE_SAMPLE", "1.0")
+    with tracer.span("http.request", ctx):
+        pass
+    assert len(tracer.all_spans()) == 1
+    # malformed rate falls back to record-everything, not crash
+    monkeypatch.setenv("DYN_TRACE_SAMPLE", "bogus")
+    with tracer.span("http.request", ctx):
+        pass
+    assert len(tracer.all_spans()) == 2
+
+
+async def test_unsampled_trace_http_response(monkeypatch):
+    """/v1/traces/{id} says "not sampled" instead of 404 when the id was
+    head-sampled out (the operator must be able to tell the difference)."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager
+
+    # find an id the 0.001-rate sampler drops (virtually all of them)
+    monkeypatch.setenv("DYN_TRACE_SAMPLE", "0.001")
+    rid = next(f"r-{i}" for i in range(1000)
+               if not trace_sampled(f"r-{i}", 0.001))
+    svc = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    try:
+        port = await svc.start()
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                    f"http://127.0.0.1:{port}/v1/traces/{rid}") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert body["sampled"] is False
+        assert "DYN_TRACE_SAMPLE" in body["reason"]
+        # a SAMPLED id with no spans still 404s (trace expired ≠ unsampled)
+        hit = next(f"r-{i}" for i in range(1000)
+                   if trace_sampled(f"r-{i}", 0.001))
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                    f"http://127.0.0.1:{port}/v1/traces/{hit}") as resp:
+                assert resp.status == 404
+    finally:
+        await svc.stop()
+
+
+# ------------------------------------------------------------- hub metrics
+
+
+async def test_hub_event_counters_and_publish_latency():
+    from dynamo_tpu.runtime.control_plane import LocalControlPlane
+
+    plane = LocalControlPlane()
+    await plane.kv_put("k1", b"v")
+    await plane.kv_delete("k1")
+    await plane.publish("subj", b"x")
+    await plane.stream_publish("st", b"y")
+    await plane.queue_push("q", b"z")
+    stats = await plane.hub_stats()
+    ev = stats["events"]
+    assert ev["kv_put"] == 1 and ev["kv_delete"] == 1
+    assert ev["publish"] == 1 and ev["stream_publish"] == 1
+    assert ev["queue_push"] == 1
+    pub = stats["publish_seconds"]
+    assert pub["count"] == 2 and pub["sum"] > 0
+    assert pub["buckets"]["+Inf"] == 2
+    await plane.close()
+
+
+async def test_hub_stats_over_tcp_and_metrics_render():
+    from dynamo_tpu.metrics.main import MetricsService
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.control_plane import (
+        ControlPlaneServer, RemoteControlPlane,
+    )
+
+    server = ControlPlaneServer("127.0.0.1", 0)
+    addr = await server.start()
+    plane = await RemoteControlPlane(addr).connect()
+    try:
+        await plane.publish("some.subject", b"p")
+        stats = await plane.hub_stats()
+        assert stats["events"]["publish"] == 1
+        rt = await DistributedRuntime.create(plane=plane, owns_plane=False)
+        svc = MetricsService(rt)
+        text = svc.render(prefill_queue_depth=0, hub=stats)
+        assert '# TYPE dynamo_hub_events_total counter' in text
+        assert 'dynamo_hub_events_total{kind="publish"} 1' in text
+        assert "# TYPE dynamo_hub_publish_seconds histogram" in text
+        assert "dynamo_hub_publish_seconds_count 1" in text
+        await rt.shutdown()
+    finally:
+        await plane.close()
+        await server.stop()
+
+
+# ------------------------------------------- engine parity + compile + tiers
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_cfg():
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+
+    return ModelConfig.tiny(), dict(
+        block_size=4, num_blocks=64, max_num_seqs=4,
+        max_num_batched_tokens=64, max_model_len=256,
+        enable_prefix_caching=False)
+
+
+async def test_engine_flight_records_and_compile_visibility(tiny_engine_cfg):
+    """A real (tiny-cpu) engine step appends tagged records, counts its
+    post-warmup jit traces, and reports tier occupancy."""
+    from dynamo_tpu.engine.config import EngineArgs
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+
+    cfg, base = tiny_engine_cfg
+    eng = AsyncJaxEngine(cfg, EngineArgs(**base))
+    try:
+        req = PreprocessedRequest(
+            model="m", token_ids=list(range(1, 30)),
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+        n = 0
+        async for out in eng.generate(req):
+            n += len(out.token_ids)
+        assert n == 6
+        snap = eng.flight.snapshot()
+        assert snap
+        first = snap[0]
+        assert first["kind"] == "ragged" and first["chunk_tokens"] == 29
+        assert "compile" in first["tags"]  # cold engine: first trace
+        assert first["compile_s"] > 0 and first["compile_sig"]
+        assert first["dispatch_ms"] > 0
+        assert set(first["kv_tiers"]) == {"g1", "g2", "g3", "g4"}
+        # compile accounting: the dispatch kinds this run traced
+        assert eng.compile_events.get("ragged") == 1
+        assert eng.compile_seconds["ragged"] > 0
+        # tier occupancy: g1 empty again after the stream finished
+        occ = eng.kv_tier_occupancy()
+        assert occ["g1"]["blocks"] == 0
+        assert occ["g2"] == {"blocks": 0, "bytes": 0}
+    finally:
+        await eng.close()
+
+
+async def test_engine_flight_disabled_is_pure_observation(tiny_engine_cfg):
+    """DYN_FLIGHT=0 arm: identical token stream, zero records (the bench
+    A/B contract in miniature)."""
+    from dynamo_tpu.engine.config import EngineArgs
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+
+    cfg, base = tiny_engine_cfg
+
+    async def run(flight_on: bool) -> list:
+        eng = AsyncJaxEngine(cfg, EngineArgs(**base))
+        eng.flight.enabled = flight_on
+        req = PreprocessedRequest(
+            model="m", token_ids=list(range(1, 20)),
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+        toks = []
+        async for out in eng.generate(req):
+            toks.extend(out.token_ids)
+        recs = len(eng.flight)
+        await eng.close()
+        return toks, recs
+
+    on_toks, on_recs = await run(True)
+    off_toks, off_recs = await run(False)
+    assert on_toks == off_toks
+    assert on_recs > 0 and off_recs == 0
