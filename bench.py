@@ -1790,6 +1790,304 @@ async def flight_bench(on_tpu: bool = False, reps: int = 4) -> dict:
     return out
 
 
+async def tools_bench(on_tpu: bool = False, reps: int = 3,
+                      sessions: int = 2, turns: int = 3) -> dict:
+    """``bench.py --tools``: the agentic tool-loop as a first-class
+    workload (ISSUE 13 acceptance; docs/structured.md).
+
+    1. Constrained-vs-free A/B — multi-turn tool-call sessions where each
+       turn's prompt is the previous turn's prompt + the model's tool call
+       + a synthetic tool result, so turn 2+ re-hits its own growing
+       prefix via the radix cache. The constrained arm enforces
+       ``tool_choice: "required"`` through the device-FSM path; the free
+       arm decodes unconstrained. Gates: 100% schema-valid constrained
+       output, constrained tok/s ≥ 0.9× free (the device path must not
+       tax decode), turn-2+ prefix-hit tokens > 0, zero host-oracle
+       fallbacks.
+    2. Peer provenance — a 2-worker fleet: a session's first turn lands
+       on worker A; later turns are steered to worker B, whose admission
+       peer-pulls the session's own prefix over the PR 11 onboarding wire
+       (constrained throughout). Gate: pulled blocks > 0 with the stream
+       complete.
+    """
+    import json as _json
+
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+    from dynamo_tpu.structured.tools import tool_constraint
+
+    cfg = ModelConfig.llama3_1b() if on_tpu else ModelConfig.tiny()
+    extra = dict(use_pallas_attention=True) if on_tpu else {}
+    bs = 16
+    vocab = [""] + [chr(32 + i) for i in range(cfg.vocab_size - 1)]
+    eos_id = 2
+    tools = [
+        {"type": "function", "function": {
+            "name": "get", "parameters": {
+                "type": "object",
+                "properties": {"k": {"enum": ["a", "b"]}}}}},
+        {"type": "function", "function": {
+            "name": "put", "parameters": {
+                "type": "object",
+                "properties": {"k": {"enum": ["a", "b"]},
+                               "n": {"type": "integer"}}}}},
+    ]
+    pattern = tool_constraint(tools, "required", None)
+    tool_names = {"get", "put"}
+    rng = np.random.default_rng(61)
+    base_prompt = rng.integers(3, cfg.vocab_size, 96).tolist()
+    result_filler = [rng.integers(3, cfg.vocab_size, 48).tolist()
+                     for _ in range(turns)]
+    OSL = 48
+
+    def req(tokens, constrained):
+        return PreprocessedRequest(
+            model="m", token_ids=list(tokens),
+            stop_conditions=StopConditions(max_tokens=OSL),
+            sampling_options=SamplingOptions(
+                temperature=0.0,
+                guided={"regex": pattern} if constrained else None),
+            eos_token_ids=[eos_id])
+
+    def decode_text(toks):
+        return "".join(vocab[t] for t in toks if t != eos_id)
+
+    def schema_valid(toks) -> bool:
+        try:
+            obj = _json.loads(decode_text(toks))
+        except Exception:
+            return False
+        return (isinstance(obj, dict) and obj.get("name") in tool_names
+                and isinstance(obj.get("arguments"), dict))
+
+    async def one_turn(eng, tokens, constrained):
+        toks = []
+        async for out in eng.generate(req(tokens, constrained)):
+            toks.extend(out.token_ids)
+            if out.finish_reason is not None:
+                break
+        return toks
+
+    async def run_arm(eng, constrained, rep, n_sessions=None) -> dict:
+        """All sessions advance their turns concurrently (each session's
+        turns are sequential — the client blocks on every round trip).
+        ``n_sessions=1`` doubles as the prefix-provenance probe: with one
+        session nothing else touches the scheduler's (global) hit
+        counter, so per-turn deltas attribute exactly."""
+        ns = sessions if n_sessions is None else n_sessions
+        hit0 = eng.scheduler.prefix_hit_tokens
+        turn_hits = []
+
+        async def session(si):
+            state = base_prompt + [9 + rep * sessions + si]
+            gen = 0
+            valid = 0
+            for t in range(turns):
+                h0 = eng.scheduler.prefix_hit_tokens
+                toks = await one_turn(eng, state, constrained)
+                gen += len(toks)
+                valid += schema_valid(toks)
+                if t > 0:
+                    turn_hits.append(eng.scheduler.prefix_hit_tokens - h0)
+                state = state + toks + result_filler[t]
+            return gen, valid
+
+        t0 = time.perf_counter()
+        res = await asyncio.gather(*[session(i) for i in range(ns)])
+        dt = time.perf_counter() - t0
+        return {
+            "tok_s": sum(g for g, _ in res) / dt,
+            "valid": sum(v for _, v in res),
+            "total_turns": ns * turns,
+            "turn2_hits": sum(turn_hits),
+            "hit_tokens": eng.scheduler.prefix_hit_tokens - hit0,
+        }
+
+    blocks = (len(base_prompt) + turns * (OSL + 48) + 64) // bs
+    eng = AsyncJaxEngine(cfg, EngineArgs(
+        block_size=bs, num_blocks=sessions * blocks * 2 * (reps + 1) + 16,
+        max_num_seqs=2 * sessions,
+        max_num_batched_tokens=512,
+        max_model_len=len(base_prompt) + turns * (OSL + 48) + 64,
+        enable_prefix_caching=True, **extra), guided_vocab=vocab)
+    assert eng.structured is not None, "device FSM arena failed to build"
+    # compile surfaces off the measured path (both arms' signatures)
+    await run_arm(eng, True, reps)
+    await run_arm(eng, False, reps + 1)
+
+    best = {True: None, False: None}
+    valid = total = 0
+    for rep in range(reps):
+        order = (True, False) if rep % 2 == 0 else (False, True)
+        for constrained in order:
+            r = await run_arm(eng, constrained, rep)
+            b = best[constrained]
+            if b is None or r["tok_s"] > b["tok_s"]:
+                best[constrained] = r
+            if constrained:
+                valid += r["valid"]
+                total += r["total_turns"]
+    # provenance probe: ONE session running alone, so the global hit
+    # counter's per-turn deltas attribute exactly to that session's own
+    # turn-2+ prefix re-hits (concurrent sessions' windows overlap and
+    # would double-count each other's hits)
+    prov = await run_arm(eng, True, reps * 2 + 5, n_sessions=1)
+    turn2_hits = prov["turn2_hits"]
+    valid += prov["valid"]
+    total += prov["total_turns"]
+    st = eng.structured.stats()
+    pipelined = eng.pipelined_steps
+    await eng.close()
+
+    out = {
+        "tools_workload": (f"sessions={sessions},turns={turns},OSL={OSL},"
+                           f"reps={reps}"),
+        "schema_valid_rate": round(valid / max(total, 1), 4),
+        "constrained_tok_s": round(best[True]["tok_s"], 1),
+        "free_tok_s": round(best[False]["tok_s"], 1),
+        "constrained_vs_free": round(
+            best[True]["tok_s"] / max(best[False]["tok_s"], 1e-9), 4),
+        "turn2_prefix_hit_tokens": turn2_hits,
+        "structured_rows_device": st["rows_device"],
+        "structured_rows_host": st["rows_host"],
+        "pipelined_steps": pipelined,
+    }
+
+    # ---- 2) peer provenance: turn 1 on A, turns 2+ steered to B, whose
+    # admission onboards the session's own prefix over kv_pull (PR 11)
+    try:
+        out["peer"] = await _tools_peer_leg(cfg, vocab, pattern, eos_id,
+                                            schema_valid, extra)
+    except Exception as e:  # noqa: BLE001 — optional extra datum
+        out["peer_error"] = repr(e)[:300]
+    peer = out.get("peer") or {}
+    out["tools_ok"] = (
+        out["schema_valid_rate"] == 1.0
+        and out["constrained_vs_free"] >= 0.9
+        and out["turn2_prefix_hit_tokens"] > 0
+        and out["structured_rows_host"] == 0
+        and peer.get("pulled_blocks", 0) > 0
+        and peer.get("complete", False))
+    return out
+
+
+async def _tools_peer_leg(cfg, vocab, pattern, eos_id, schema_valid,
+                          extra) -> dict:
+    """2-worker tool-loop: the session's prefix peer-onboards when its
+    later turns land on a different worker (bench --tools scenario 2)."""
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler, KvPullHandler
+    from dynamo_tpu.disagg.transfer import OnboardConfig, RestoreConfig
+    from dynamo_tpu.engine.config import EngineArgs
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+    from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
+    from dynamo_tpu.router.protocols import KvRouterConfig
+    from dynamo_tpu.router.publisher import KvEventPublisher
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.context import Context
+
+    bs = 16
+    isl = 512  # enough prefix blocks to clear onboard_min_blocks
+    OSL = 48   # the char-level tool-call JSON needs ~40 tokens to close
+    rng = np.random.default_rng(67)
+    prefix = rng.integers(3, cfg.vocab_size, isl).tolist()
+    rcfg = RuntimeConfig(lease_ttl=8.0)
+    rt = await DistributedRuntime.create(config=rcfg)
+    workers = []
+    router = client = None
+
+    async def make_worker():
+        wrt = await DistributedRuntime.create(plane=rt.plane,
+                                              owns_plane=False, config=rcfg)
+        lease = await wrt.primary_lease()
+        eng = await asyncio.to_thread(
+            AsyncJaxEngine, cfg, EngineArgs(
+                block_size=bs, num_blocks=4 * (isl // bs) + 64,
+                max_num_seqs=4, max_num_batched_tokens=1024,
+                max_model_len=isl + 4 * (OSL + 16) + bs,
+                enable_prefix_caching=True, **extra), guided_vocab=vocab)
+        pub = KvEventPublisher(wrt.plane, worker_id=lease, kv_block_size=bs)
+        await pub.start_resync_responder()
+        eng.event_cb = pub.publish_sync
+        comp = wrt.namespace("dynamo").component("backend")
+        pull_client = await comp.endpoint("kv_pull").client().start()
+        handler = DecodeWorkerHandler(
+            eng, pull_clients=[pull_client], metrics=wrt.metrics,
+            restore_config=RestoreConfig(enabled=False),
+            onboard_config=OnboardConfig(enabled=True))
+        handler.instance_id = lease
+        h_gen = await comp.endpoint("generate").serve_endpoint(
+            handler.generate, lease_id=lease)
+        h_pull = await comp.endpoint("kv_pull").serve_endpoint(
+            KvPullHandler(eng).generate, lease_id=lease)
+        w = type("W", (), {})()
+        w.rt, w.engine, w.lease, w.handler = wrt, eng, lease, handler
+        w.pub, w.pull_client, w.handles = pub, pull_client, [h_gen, h_pull]
+        workers.append(w)
+        return w
+
+    def req(tokens, pin=None):
+        return PreprocessedRequest(
+            model="m", token_ids=list(tokens),
+            stop_conditions=StopConditions(max_tokens=OSL),
+            sampling_options=SamplingOptions(
+                temperature=0.0, guided={"regex": pattern}),
+            eos_token_ids=[eos_id], backend_instance_id=pin)
+
+    try:
+        a = await make_worker()
+        b = await make_worker()
+        client = await (rt.namespace("dynamo").component("backend")
+                        .endpoint("generate").client().start())
+        router = await KvRouter(rt.plane, bs, KvRouterConfig()).start()
+        push = KvPushRouter(client, router)
+
+        async def turn(tokens, pin=None):
+            toks = []
+            async for out in push.generate(req(tokens, pin), Context()):
+                if isinstance(out, dict) and out.get("token_ids"):
+                    toks.extend(out["token_ids"])
+            return toks
+
+        # turn 1 computes the session prefix on A
+        state = prefix + [5]
+        t1 = await turn(state, pin=a.lease)
+        state = state + t1 + rng.integers(3, cfg.vocab_size, 32).tolist()
+        # radix must learn A's prefix before steering away
+        for _ in range(400):
+            if router.restore_sources(state).get(a.lease, 0) \
+                    >= isl // bs - 1:
+                break
+            await asyncio.sleep(0.02)
+        client.set_busy_instances([a.lease])  # turns 2+ land on B
+        t2 = await turn(state)
+        pulled = b.handler._onboard_blocks._values.get(
+            (("source", "peer"),), 0)
+        return {
+            "pulled_blocks": int(pulled),
+            "complete": bool(t1 and t2 and schema_valid(t1)
+                             and schema_valid(t2)),
+            "turn1_tokens": len(t1), "turn2_tokens": len(t2),
+        }
+    finally:
+        for w in workers:
+            for h in w.handles:
+                await h.stop(graceful=False)
+            await w.pull_client.stop()
+            await w.pub.stop()
+            await w.engine.close()
+            await w.rt.shutdown()
+        if router is not None:
+            await router.stop()
+        if client is not None:
+            await client.stop()
+        await rt.shutdown()
+
+
 async def autoscale_bench(duration_s: float = 40.0,
                           chaos_spec: str = "stream.send:drop=0.02",
                           chaos_seed: int = 1234) -> dict:
@@ -2195,6 +2493,24 @@ def main():
               < out["bucketed_padded_tokens"])
         raise SystemExit(0 if ok else 1)
 
+    if "--tools" in sys.argv:
+        # structured tool-loop smoke: constrained-vs-free multi-turn
+        # sessions + peer onboarding — prints one JSON line; exits nonzero
+        # when schema validity drops below 100%, constrained decode loses
+        # ≥10% to free on the device path, turn 2+ stops re-hitting its
+        # prefix, or the peer leg pulled nothing (docs/structured.md)
+        try:
+            out = asyncio.run(tools_bench(False))
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"tools": "failed", "error": repr(e)[:300]}),
+                  flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["tools_ok"] else 1)
+
     if "--migration" in sys.argv:
         # KV-restore migration under seeded worker kills: restore vs
         # recompute arms interleaved per rep — prints one JSON line; exits
@@ -2384,18 +2700,18 @@ def _child_main():
     phases = {p.strip() for p in
               os.environ.get("DYN_BENCH_PHASES",
                              "kernel,spec,e2e,chaos,mem,qos,autoscale,"
-                             "ragged,disagg,migration,onboard,flight"
+                             "ragged,disagg,migration,onboard,flight,tools"
                              ).split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
                         "autoscale", "ragged", "disagg", "migration",
-                        "onboard", "flight"}
+                        "onboard", "flight", "tools"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
                          f"chaos, mem, qos, autoscale, ragged, disagg, "
-                         f"migration, onboard, flight)")
+                         f"migration, onboard, flight, tools)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -2502,6 +2818,14 @@ def _child_main():
                 kern["flight"] = asyncio.run(flight_bench(on_tpu))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["flight_error"] = repr(e)[:200]
+        if "tools" in phases:
+            # structured tool-loop phase: constrained-vs-free tok/s,
+            # schema-validity, per-turn prefix-hit provenance + the
+            # 2-worker peer-onboard leg (ISSUE 13 acceptance)
+            try:
+                kern["tools"] = asyncio.run(tools_bench(on_tpu))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["tools_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

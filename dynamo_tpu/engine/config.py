@@ -476,6 +476,19 @@ class EngineArgs:
     #: QoS policy override (dynamo_tpu.qos.QosConfig); None = load from the
     #: DYN_QOS_* environment at scheduler construction
     qos: Optional[object] = None
+    #: structured decoding (docs/structured.md): compile guided-decoding
+    #: constraints into dense device tables and run the FSM inside the
+    #: sampling dispatch, so constrained rows ride the ragged step, the
+    #: pipelined decode loop, the fused multi-step burst, and spec decode
+    #: with no host sync. False (--no-structured-device) keeps every
+    #: constraint on the host-oracle path (the pre-PR behavior). Also
+    #: gated by DYN_STRUCTURED=0 at runtime.
+    structured_device: bool = True
+    #: byte budget (MiB) for the device FSM arena (mask bitmask + next-
+    #: state tables; the next table costs 4·vocab bytes per state). None =
+    #: DYN_STRUCTURED_TABLE_MB, default 64. Constraints whose reachable
+    #: state closure does not fit fall back to the host oracle.
+    structured_table_mb: Optional[float] = None
     seed: int = 0
 
     def __post_init__(self):

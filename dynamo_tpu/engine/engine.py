@@ -81,6 +81,25 @@ def _has_penalties(s) -> bool:
                 or (so.repetition_penalty not in (None, 1.0)))
 
 
+def _guided_fsm(s):
+    """The seq's device-compiled FSM cursor (structured/runtime.FsmCursor),
+    or None for unconstrained rows AND host-oracle fallbacks. Device rows
+    mask + advance inside the sampling dispatch, so they ride every fast
+    path (ragged, pipelined, fused burst, spec verify)."""
+    gs = s.guided_state
+    return gs if gs is not None and getattr(gs, "device", False) else None
+
+
+def _guided_host_only(s) -> bool:
+    """True when the seq's constraint runs on the HOST oracle (table over
+    budget, min_tokens EOS gating, multi-host, or --no-structured-device):
+    it needs host-visible logits and a Python FSM advance per token, so it
+    is excluded from the pipelined/burst/spec paths — the pre-structured
+    behavior, now the exception instead of the rule."""
+    gs = s.guided_state
+    return gs is not None and not getattr(gs, "device", False)
+
+
 class AsyncJaxEngine:
     """Continuously-batched paged-KV inference engine on JAX.
 
@@ -336,6 +355,33 @@ class AsyncJaxEngine:
         #: (engine/main.py decodes it from the served tokenizer); None =
         #: guided requests are refused
         self.guided_vocab = guided_vocab
+        #: structured decoding (docs/structured.md): the device FSM arena
+        #: constraints compile into. None = every constraint runs on the
+        #: host oracle (no vocab, --no-structured-device, DYN_STRUCTURED=0,
+        #: multi-host step replication — the arena uploads are leader-local
+        #: and would desync follower replay, or a byte budget too small for
+        #: this vocab width).
+        self.structured = None
+        if (args.structured_device and guided_vocab is not None
+                and not self._multihost):
+            from dynamo_tpu.structured import (
+                StructuredRuntime, arena_states, env_enabled,
+                table_budget_bytes,
+            )
+            if env_enabled():
+                cap = arena_states(cfg.vocab_size,
+                                   table_budget_bytes(args.structured_table_mb))
+                if cap:
+                    self.structured = StructuredRuntime(cfg.vocab_size, cap)
+                else:
+                    logger.info(
+                        "structured device tables disabled: budget buys "
+                        "too few states at vocab %d (DYN_STRUCTURED_TABLE_MB)",
+                        cfg.vocab_size)
+        #: lazily-compiled structured variants of the fused paths (first
+        #: constrained request on each path pays one trace)
+        self._multi_fsm_fn = None
+        self._verify_masked_fn = None
         self._seq_counter = itertools.count()
         self._wake = asyncio.Event()
         # memory-starved plan(): park on _wake instead of hot-polling; a
@@ -448,18 +494,23 @@ class AsyncJaxEngine:
         seq = SeqState(request_id=f"seq-{next(self._seq_counter)}",
                        req=req, ctx=ctx or _NullCtx(), sink=sink, **kw)
         if req.sampling_options.guided:
-            from dynamo_tpu.llm.guided import compile_guided
+            from dynamo_tpu.structured import build_guided_state
             if self.guided_vocab is None:
                 raise ValueError(
                     "guided decoding requested but this worker has no "
                     "tokenizer vocabulary (engine started without "
                     "guided_vocab)")
-            # off the event loop: a fresh machine's compile includes the
-            # start-state token-liveness proof, which can walk the vocab
-            # through the char DFA hundreds of times on a cold cache
+            # off the event loop: a cold constraint compiles the char NFA,
+            # walks the vocab per visited DFA state, AND packs the device
+            # tables; everything is cached so session turn 2+ is a dict hit.
+            # min_tokens rows stay on the host oracle — its EOS suppression
+            # depends on per-step generated counts the static tables can't
+            # express (docs/structured.md fallback rules).
             seq.guided_state = await asyncio.to_thread(
-                compile_guided, req.sampling_options.guided,
-                self.guided_vocab, req.eos_token_ids or [])
+                build_guided_state, req.sampling_options.guided,
+                self.guided_vocab, req.eos_token_ids or [],
+                self.structured,
+                not (req.stop_conditions.min_tokens or 0) > 0)
         return seq
 
     async def generate(self, req: PreprocessedRequest, ctx=None
@@ -1343,7 +1394,9 @@ class AsyncJaxEngine:
                 prefill_chunks=len(plan.prefill),
                 chunk_tokens=sum(w.chunk for w in plan.prefill),
                 padded=padded, dispatch_ms=self._last_dispatch_ms,
-                qos_mix=self._plan_qos_mix(plan))
+                qos_mix=self._plan_qos_mix(plan),
+                constrained=self._constrained_count(
+                    plan.decode + [w.seq for w in plan.prefill]))
             return
         if plan.prefill:
             t0 = time.perf_counter()
@@ -1376,7 +1429,8 @@ class AsyncJaxEngine:
                 "decode", wall, decode_rows=len(plan.decode),
                 prefill_chunks=0, chunk_tokens=0,
                 dispatch_ms=self._last_dispatch_ms,
-                qos_mix=self._qos_mix_of(plan.decode))
+                qos_mix=self._qos_mix_of(plan.decode),
+                constrained=self._constrained_count(plan.decode))
 
     def step_trace_summary(self) -> dict:
         """Aggregate the timing ring: per kind, steps / seqs / tokens /
@@ -1460,7 +1514,8 @@ class AsyncJaxEngine:
                        prefill_chunks: int, chunk_tokens: int,
                        padded: int = 0, dispatch_ms: float = 0.0,
                        qos_mix: Optional[dict] = None,
-                       starved: Optional[int] = None) -> None:
+                       starved: Optional[int] = None,
+                       constrained: int = 0) -> None:
         """Append one flight record for an executed step: snapshot queue
         depths + tier occupancy, difference the cumulative preempt/swap
         totals into per-step deltas, and attach a compile staged by
@@ -1498,6 +1553,7 @@ class AsyncJaxEngine:
             running=len(sched.running),
             starved_decode=(sched.last_starved_decode
                             if starved is None else starved),
+            constrained_rows=constrained,
             kv_tiers=tiers, qos_mix=qos_mix or {})
 
     @staticmethod
@@ -1506,6 +1562,10 @@ class AsyncJaxEngine:
         for s in seqs:
             mix[s.priority] = mix.get(s.priority, 0) + 1
         return mix
+
+    @staticmethod
+    def _constrained_count(seqs) -> int:
+        return sum(1 for s in seqs if s.guided_state is not None)
 
     def _plan_qos_mix(self, plan: StepPlan) -> dict:
         return self._qos_mix_of(
@@ -1931,8 +1991,8 @@ class AsyncJaxEngine:
             kind, fn = "ragged", self.ragged_fn
         else:
             # decode-only plan that bypassed the pipelined loop (logprobs,
-            # guided, penalties, swapped/waiting work pending): the
-            # no-chunk-grid variant
+            # host-oracle guided fallbacks, penalties, swapped/waiting
+            # work pending): the no-chunk-grid variant
             kind, fn = "ragged_dec", self.ragged_dec_fn
         new_sig = (kind, T) not in self.compiled_signatures
         self.compiled_signatures.add((kind, T))
@@ -2121,15 +2181,55 @@ class AsyncJaxEngine:
             kv_lens[i] = len(s.tokens) + K
 
         ints3 = np.stack([tokens, positions, slot_map], axis=1)
-        self.compiled_signatures.add(("verify", B, S, W))
+        cursors = [_guided_fsm(s) for s in seqs]
+        use_fsm = any(c is not None for c in cursors)
+        self.compiled_signatures.add(
+            ("verify_fsm" if use_fsm else "verify", B, S, W))
         self.padded_tokens_total += (B - len(seqs)) * S
         self._broadcast("verify", ints3=ints3, block_tables=bt,
                         kv_lens=kv_lens)
-        ids, lps, self.k_cache, self.v_cache = self.verify_fn(
-            self.params, self._put_batch("ints3", ints3),
-            self._put_batch("block_tables", bt),
-            self._put_batch("kv_lens", kv_lens),
-            self.k_cache, self.v_cache)
+        if use_fsm:
+            # constrained rows verify under per-position FSM masks: walk
+            # each cursor's compiled table along its draft host-side (O(K)
+            # lookups, no device round trip) — a draft token the mask
+            # forbids can never match the masked argmax, so it is rejected
+            # at its position exactly as masked single-step decode would
+            # reject it, and the bonus token at the first mismatch is drawn
+            # from the correctly-advanced state's mask.
+            import jax.numpy as _jnp
+            if self._verify_masked_fn is None:
+                from dynamo_tpu.engine import model as M
+                self._verify_masked_fn = M.make_verify_fn(
+                    self.cfg, args.block_size, self.mesh,
+                    replicate_outputs=self._multihost,
+                    kv_quant=self._kv_quant, masked=True)
+            W32 = self.structured.W32
+            mw = np.empty((B, S, W32), np.uint32)
+            mw[:] = np.uint32(0xFFFFFFFF)  # free/padded rows: identity
+            for i, c in enumerate(cursors):
+                if c is None:
+                    continue
+                fsm = c.seg.fsm
+                st = 0 if c.done else (c.state - c.seg.offset)
+                for j in range(S):
+                    mw[i, j] = fsm.mask[st]
+                    if j < len(drafts[i]):
+                        t = drafts[i][j]
+                        if t in c._eos_set or not 0 <= t < fsm.V:
+                            st = 0
+                        else:
+                            st = int(fsm.next[st, t])
+            ids, lps, self.k_cache, self.v_cache = self._verify_masked_fn(
+                self.params, self._put_batch("ints3", ints3),
+                self._put_batch("block_tables", bt),
+                self._put_batch("kv_lens", kv_lens),
+                _jnp.asarray(mw), self.k_cache, self.v_cache)
+        else:
+            ids, lps, self.k_cache, self.v_cache = self.verify_fn(
+                self.params, self._put_batch("ints3", ints3),
+                self._put_batch("block_tables", bt),
+                self._put_batch("kv_lens", kv_lens),
+                self.k_cache, self.v_cache)
         ids, lps = await asyncio.to_thread(
             lambda: (np.asarray(ids), np.asarray(lps)))
 
@@ -2220,7 +2320,9 @@ class AsyncJaxEngine:
                 and all(s.req.output_options.logprobs is None for s in seqs)
                 and all(not s.req.sampling_options.logit_bias for s in seqs)
                 and not any(_has_penalties(s) for s in seqs)
-                and all(s.guided_state is None for s in seqs)
+                # device-FSM constrained rows verify under per-position
+                # masks (host oracle fallbacks still force single-step)
+                and not any(_guided_host_only(s) for s in seqs)
                 # a seq one token from its limit gains nothing from a draft
                 and all((s.req.stop_conditions.max_tokens is None
                          or s.req.stop_conditions.max_tokens - s.generated >= 2)
@@ -2235,7 +2337,9 @@ class AsyncJaxEngine:
                 and all(s.req.output_options.logprobs is None for s in seqs)
                 and all(not s.req.sampling_options.logit_bias for s in seqs)
                 and not any(_has_penalties(s) for s in seqs)
-                and all(s.guided_state is None for s in seqs)
+                # device-FSM rows mask + advance INSIDE the burst scan
+                # (model.multi_decode fsm variant)
+                and not any(_guided_host_only(s) for s in seqs)
                 # NOTE a seq within K of max_tokens does NOT disqualify the
                 # burst: its overshoot rows cost FLOPs on the batch dim, not
                 # wall clock, while the old fallback cost EVERY stream K
@@ -2302,7 +2406,9 @@ class AsyncJaxEngine:
         """True when the decode batch qualifies for the depth-2 pipelined
         loop: single-host, single-step decode, every running seq in the
         batch, and no request feature that forces a host round trip
-        between sample and emit (logprob capture, logit edits, guided)."""
+        between sample and emit (logprob capture, logit edits, host-oracle
+        guided fallbacks — device-FSM constrained rows ride the loop, the
+        mask and state advance live inside the sampling dispatch)."""
         if not self.args.pipeline_decode or self._multihost or self._pp > 1:
             return False
         if self.multi_fn is not None or self.verify_fn is not None:
@@ -2317,7 +2423,7 @@ class AsyncJaxEngine:
         for s in seqs:
             if (s.req.output_options.logprobs is not None
                     or s.req.sampling_options.logit_bias
-                    or _has_penalties(s) or s.guided_state is not None):
+                    or _has_penalties(s) or _guided_host_only(s)):
                 return False
         return True
 
@@ -2434,13 +2540,34 @@ class AsyncJaxEngine:
             if new_sig:
                 self._note_compile("step", (B, 1, W),
                                    time.perf_counter() - t0)
-        toks, logps = self._sampling.sample_jit(logits, temp, top_k, top_p,
-                                                keys)
+        states = None
+        if any(_guided_fsm(s) is not None for s in seqs):
+            # constrained rows: per-row FSM state is one more device-fed
+            # column — step N+1 dispatches with step N's advanced states
+            # exactly like the token column, so the constraint costs no
+            # host sync anywhere in the loop
+            if feed is not None:
+                states = feed["states"]
+            else:
+                st = np.zeros((A,), np.int32)
+                for i, s in enumerate(seqs):
+                    c = _guided_fsm(s)
+                    if c is not None:
+                        st[i] = c.state
+                states = jnp.asarray(st)
+        if states is not None:
+            mask_t, next_t = self.structured.device_tables()
+            toks, logps, states = self._sampling.sample_masked_jit(
+                logits, temp, top_k, top_p, keys, states, mask_t, next_t)
+        else:
+            toks, logps = self._sampling.sample_jit(logits, temp, top_k,
+                                                    top_p, keys)
         # device→host copy in a worker thread: the loop dispatches step N+1
         # and only then awaits this
         copy = asyncio.get_running_loop().create_task(asyncio.to_thread(
             lambda: (np.asarray(toks), np.asarray(logps))))
-        return {"seqs": list(seqs), "toks": toks, "copy": copy, "t0": t0}
+        return {"seqs": list(seqs), "toks": toks, "states": states,
+                "copy": copy, "t0": t0}
 
     async def _commit_decode_step(self, handle) -> None:
         """Land one in-flight step: await its host copy, then commit + emit.
@@ -2448,10 +2575,18 @@ class AsyncJaxEngine:
         their KV write targeted an unregistered block and is discarded."""
         toks, logps = await handle["copy"]
         n = 0
+        constrained = 0
         for i, s in enumerate(handle["seqs"]):
             if s.finished is not None:
                 continue
             self.scheduler.commit_computed(s, len(s.tokens))
+            gs = _guided_fsm(s)
+            if gs is not None:
+                # host mirror of the on-device table advance (same table →
+                # same state); lands before _deliver's check_finish reads
+                # done/exhausted. O(1) numpy, never an oracle walk.
+                gs.advance(int(toks[i]))
+                constrained += 1
             self._deliver(s, int(toks[i]), float(logps[i]))
             n += 1
         self.pipelined_steps += 1
@@ -2460,7 +2595,7 @@ class AsyncJaxEngine:
             "decode_pipe", len(handle["seqs"]), n, wall))
         self._flight_record(
             "decode_pipe", wall, decode_rows=n, prefill_chunks=0,
-            chunk_tokens=0, starved=0)
+            chunk_tokens=0, starved=0, constrained=constrained)
 
     async def _run_decode_pipelined(self, seqs: list[SeqState]) -> bool:
         """Depth-2 software pipeline over single-step decode.
@@ -2556,22 +2691,50 @@ class AsyncJaxEngine:
         ints = np.stack([last_tokens, positions, kv_lens, top_k], axis=1)
         floats = np.stack([temp, top_p], axis=1)
         rand = np.stack([seeds, step0], axis=1)
-        new_sig = ("multi", B, W) not in self.compiled_signatures
-        self.compiled_signatures.add(("multi", B, W))
+        cursors = [_guided_fsm(s) for s in seqs]
+        use_fsm = any(c is not None for c in cursors)
+        kind = "multi_fsm" if use_fsm else "multi"
+        new_sig = (kind, B, W) not in self.compiled_signatures
+        self.compiled_signatures.add((kind, B, W))
         self.padded_tokens_total += (B - len(seqs)) * K
         self._broadcast("multi", ints=ints, floats=floats, rand=rand,
                         block_tables=bt)
         self.param_reads += K
         t0d = time.perf_counter()
-        toks, logps, self.k_cache, self.v_cache = self.multi_fn(
-            self.params, self._put_batch("ints", ints),
-            self._put_batch("floats", floats),
-            self._put_batch("rand", rand),
-            self._put_batch("block_tables", bt),
-            self.k_cache, self.v_cache)
+        if use_fsm:
+            # constrained rows: per-row FSM state rides the burst scan —
+            # masked sampling + table advance on device each of the K
+            # steps (free rows carry the arena's identity state 0)
+            import jax.numpy as _jnp
+            if self._multi_fsm_fn is None:
+                from dynamo_tpu.engine import model as M
+                self._multi_fsm_fn = M.make_multi_decode_fn(
+                    self.cfg, args.block_size, K, self.mesh,
+                    use_pallas=args.use_pallas_attention,
+                    replicate_outputs=self._multihost,
+                    kv_quant=self._kv_quant, fsm=True)
+            states = np.zeros((B,), np.int32)
+            for i, c in enumerate(cursors):
+                if c is not None:
+                    states[i] = c.state
+            mask_t, next_t = self.structured.device_tables()
+            toks, logps, self.k_cache, self.v_cache = self._multi_fsm_fn(
+                self.params, self._put_batch("ints", ints),
+                self._put_batch("floats", floats),
+                self._put_batch("rand", rand),
+                self._put_batch("block_tables", bt),
+                _jnp.asarray(states), mask_t, next_t,
+                self.k_cache, self.v_cache)
+        else:
+            toks, logps, self.k_cache, self.v_cache = self.multi_fn(
+                self.params, self._put_batch("ints", ints),
+                self._put_batch("floats", floats),
+                self._put_batch("rand", rand),
+                self._put_batch("block_tables", bt),
+                self.k_cache, self.v_cache)
         self._last_dispatch_ms = (time.perf_counter() - t0d) * 1000
         if new_sig:
-            self._note_compile("multi", (B, W), time.perf_counter() - t0d)
+            self._note_compile(kind, (B, W), time.perf_counter() - t0d)
         toks, logps = await asyncio.to_thread(
             lambda: (np.asarray(toks), np.asarray(logps)))
 
@@ -2697,9 +2860,19 @@ class AsyncJaxEngine:
                     if non_eos:
                         return non_eos
                 return ids
+            # host-oracle guided rows mask via sparse host logit edits;
+            # device-FSM rows (FsmCursor) mask inside the fused sampling
+            # dispatch below. Logprob capture is the exception: top-k must
+            # read the SAME masked logits the sampler saw, so those rows
+            # fall back to the host edit too.
             g_rows = [(i, g_allowed(s)) for i, s in enumerate(seqs)
-                      if s.guided_state is not None]
-            return b_rows, b_cols, b_vals, r_rows, r_cols, r_pens, g_rows
+                      if _guided_host_only(s)
+                      or (want_tops and _guided_fsm(s) is not None)]
+            fsm_rows = ([] if want_tops else
+                        [(i, c) for i, s in enumerate(seqs)
+                         if (c := _guided_fsm(s)) is not None])
+            return (b_rows, b_cols, b_vals, r_rows, r_cols, r_pens, g_rows,
+                    fsm_rows)
 
         def run_sampling():
             # runs in a worker thread: the host sync below must NEVER block
@@ -2707,18 +2880,20 @@ class AsyncJaxEngine:
             # FOLLOWER ranks can only join after the loop's broadcaster task
             # flushed the step (blocking the loop here deadlocked the fleet)
             (b_rows, b_cols, b_vals, r_rows, r_cols, r_pens,
-             g_rows) = build_triples()
+             g_rows, fsm_rows) = build_triples()
             lg = logits
             if self._multihost or isinstance(lg, np.ndarray):
                 # logits are fully replicated (make_step_fn): round-trip
                 # through host so sampling is a LOCAL computation — a global
                 # op here would have to be mirrored by every follower rank
                 # (this includes the penalty/bias edits below: numpy, never
-                # a device op on the global array)
+                # a device op on the global array). Device-FSM rows mask
+                # host-side here too — bit-unpack of the table row, same
+                # allowed set as the fused gather.
                 lg = np.asarray(lg)
                 if rows is not None:
                     lg = lg[np.asarray(rows)]  # fancy index: fresh, writable
-                elif r_rows or b_rows or g_rows:
+                elif r_rows or b_rows or g_rows or fsm_rows:
                     lg = lg.copy()
                 if r_rows:
                     v = lg[r_rows, r_cols]
@@ -2726,12 +2901,15 @@ class AsyncJaxEngine:
                     lg[r_rows, r_cols] = np.where(v > 0, v / rp, v * rp)
                 if b_rows:
                     np.add.at(lg, (b_rows, b_cols), b_vals)
-                for i, allowed in g_rows:
+                for i, allowed in (g_rows
+                                   + [(i, c.allowed_token_ids(V))
+                                      for i, c in fsm_rows]):
                     masked = np.full((lg.shape[-1],), -1e30, lg.dtype)
                     if allowed:
                         ai = np.asarray(allowed)
                         masked[ai] = lg[i, ai]
                     lg[i] = masked
+                fsm_rows = []
             elif r_rows or b_rows or g_rows:
                 # single-host: tiny device gather/scatter
                 import jax.numpy as jnp
@@ -2751,8 +2929,22 @@ class AsyncJaxEngine:
                         ai = jnp.asarray(allowed)
                         masked = masked.at[ai].set(lg[i, ai])
                     lg = lg.at[i].set(masked)
-            toks, logps = self._sampling.sample_jit(lg, temp, top_k, top_p,
-                                                    keys)
+            if fsm_rows:
+                # fused constrained sampling: the FSM mask is a packed-
+                # bitmask gather INSIDE the jitted dispatch — no host
+                # materialization, no per-row Python (docs/structured.md)
+                import jax.numpy as jnp
+
+                states = np.zeros((B,), np.int32)
+                for i, c in fsm_rows:
+                    states[i] = c.state
+                mask_t, next_t = self.structured.device_tables()
+                toks, logps, _ = self._sampling.sample_masked_jit(
+                    lg, temp, top_k, top_p, keys, jnp.asarray(states),
+                    mask_t, next_t)
+            else:
+                toks, logps = self._sampling.sample_jit(lg, temp, top_k,
+                                                        top_p, keys)
             top_res = None
             if want_tops:
                 # device-side top-k: only O(B·k) crosses to host, and the
@@ -2789,11 +2981,18 @@ class AsyncJaxEngine:
         ids: list[int] = []
         lps: list[float] = []
         reason = None
+        gs = seq.guided_state
         for t, lp in zip(tokens, logps):
             self.scheduler.commit_computed(seq, len(seq.tokens))
             self.scheduler.append_token(seq, int(t))
             ids.append(int(t))
             lps.append(float(lp))
+            if gs is not None:
+                # device-FSM cursor: one numpy table lookup — must land
+                # before check_finish reads done/exhausted (only device
+                # rows reach the fused paths, so this is never an
+                # O(vocab) oracle walk on the event loop)
+                gs.advance(int(t))
             reason = self.scheduler.check_finish(seq, int(t))
             if reason is not None:
                 break

@@ -142,6 +142,15 @@ async def amain():
                          "signature per token bucket) and restore the "
                          "bucketed per-(chunk,batch,width) step path "
                          "wholesale (docs/performance.md)")
+    ap.add_argument("--no-structured-device", dest="structured_device",
+                    action="store_false", default=True,
+                    help="keep guided-decoding constraints on the host "
+                         "oracle instead of compiling them into device FSM "
+                         "tables fused into the sampling dispatch "
+                         "(docs/structured.md)")
+    ap.add_argument("--structured-table-mb", type=float, default=None,
+                    help="byte budget (MiB) for the device FSM arena; "
+                         "default DYN_STRUCTURED_TABLE_MB or 64")
     ap.add_argument("--kv-layer-groups", type=int, default=4,
                     help="layer-interleaved disagg transfer: split the tail "
                          "chunk's KV bundle into this many layer groups "
@@ -298,6 +307,8 @@ async def amain():
         kv_cache_dtype=cli.kv_cache_dtype,
         pipeline_decode=cli.pipeline_decode,
         ragged_step=cli.ragged_step,
+        structured_device=cli.structured_device,
+        structured_table_mb=cli.structured_table_mb,
         warmup_buckets=cli.warmup_buckets,
         kv_transfer_layer_groups=cli.kv_layer_groups,
     )
@@ -334,6 +345,12 @@ async def amain():
     if tokenizer_ref:
         from dynamo_tpu.llm.tokenizer import load_guided_vocab
         cli._guided_vocab = load_guided_vocab(tokenizer_ref)
+    elif cli.allow_test_metadata:
+        # test fleets must be able to carry constrained traffic too
+        # (docs/structured.md): derive the guided alphabet from the same
+        # test tokenizer the frontend will serve with
+        from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+        cli._guided_vocab = make_test_tokenizer().guided_vocab()
     # parse BEFORE the heavy engine build: a typo'd value must fail in
     # milliseconds, not after minutes of weight loading
     warmup_seq_lens = None
@@ -542,6 +559,36 @@ async def amain():
         "kind").add_callback(
         lambda: {(("kind", k),): round(v, 4)
                  for k, v in engine.compile_seconds.items()})
+
+    # structured decoding (docs/structured.md): constraint compile-cache
+    # outcomes — a "hit" admission reused both the cached token machine
+    # AND the packed device tables; misses are where admission latency
+    # hides — plus the device-vs-host-fallback row split and arena
+    # occupancy
+    def _structured_cb():
+        from dynamo_tpu.structured import COMPILE_STATS
+        return {(("outcome", k),): v for k, v in COMPILE_STATS.items()}
+
+    runtime.metrics.counter(
+        "structured_compile_total",
+        "guided-constraint admissions by compile-cache outcome "
+        "(hit = machine + device tables both cached)").add_callback(
+        _structured_cb)
+    if engine.structured is not None:
+        runtime.metrics.counter(
+            "structured_rows_total",
+            "constrained admissions by sampling path (device = FSM fused "
+            "into the sampling dispatch, host = oracle "
+            "fallback)").add_callback(
+            lambda: {(("path", "device"),): engine.structured.rows_device,
+                     (("path", "host"),): engine.structured.rows_host})
+        runtime.metrics.gauge(
+            "structured_arena_states",
+            "device FSM arena occupancy (states resident / "
+            "capacity)").add_callback(
+            lambda: {(("kind", "used"),):
+                     engine.structured.stats()["states_used"],
+                     (("kind", "cap"),): engine.structured.cap})
 
     # multi-tenant QoS telemetry (docs/qos.md): per-(tenant, class) served
     # tokens, queue wait, preemptions from the scheduler's fairness ledger;

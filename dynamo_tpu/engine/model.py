@@ -1438,48 +1438,65 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
     return logits, k_cache, v_cache
 
 
-def verify_forward(params, tokens, positions, slot_map, block_tables,
-                   kv_lens, k_cache, v_cache, *, cfg: ModelConfig,
-                   block_size: int, mesh: Optional[Mesh] = None):
-    """Speculative-decode verification step: like ``forward`` over a chunk
-    of [last_token, draft...] but returns the GREEDY continuation at every
-    position — (argmax ids [B,S], their logprobs [B,S], caches). Draft KV is
-    scattered like any chunk; slots past the accepted prefix hold wrong-KV
-    garbage that the next real step overwrites (slot = f(position)), and
-    kv_lens caps what any later attention can read.
-
-    Only O(B·S) ids/logps cross to host instead of [B,S,V] logits — the
-    acceptance rule (greedy prefix match) needs nothing more."""
-    logits, k_cache, v_cache = forward(
-        params, tokens, positions, slot_map, block_tables, kv_lens,
-        jnp.zeros((tokens.shape[0],), jnp.int32), k_cache, v_cache,
-        cfg=cfg, block_size=block_size, mesh=mesh, all_logits=True)
-    lp = jax.nn.log_softmax(logits, axis=-1)  # [B,S,V] f32
-    ids = jnp.argmax(lp, axis=-1)
-    chosen = jnp.take_along_axis(lp, ids[..., None], axis=-1)[..., 0]
-    return ids.astype(jnp.int32), chosen, k_cache, v_cache
-
-
 def make_verify_fn(cfg: ModelConfig, block_size: int,
                    mesh: Optional[Mesh] = None,
                    replicate_outputs: bool = False,
-                   kv_quant: bool = False):
-    """Jitted speculative verification with cache donation. Packed
-    operands like make_step_fn: ``ints3`` [B,3,S] stacks
-    tokens/positions/slot_map; signature (params, ints3, block_tables,
-    kv_lens, k_cache, v_cache)."""
+                   kv_quant: bool = False, masked: bool = False):
+    """Jitted speculative verification with cache donation: a ``forward``
+    over a chunk of [last_token, draft...] returning the GREEDY
+    continuation at every position — (argmax ids [B,S], their logprobs
+    [B,S], caches). Draft KV is scattered like any chunk; slots past the
+    accepted prefix hold wrong-KV garbage that the next real step
+    overwrites (slot = f(position)), and kv_lens caps what any later
+    attention can read. Only O(B·S) ids/logps cross to host instead of
+    [B,S,V] logits — the acceptance rule (greedy prefix match) needs
+    nothing more. Packed operands like make_step_fn: ``ints3`` [B,3,S]
+    stacks tokens/positions/slot_map; signature (params, ints3,
+    block_tables, kv_lens, k_cache, v_cache).
 
-    def f(params, ints3, block_tables, kv_lens, k_cache, v_cache):
-        return verify_forward(params, ints3[:, 0], ints3[:, 1], ints3[:, 2],
-                              block_tables, kv_lens, k_cache, v_cache,
-                              cfg=cfg, block_size=block_size, mesh=mesh)
+    ``masked=True`` adds a per-position packed FSM bitmask operand
+    ``mask_words`` [B, S, ceil(V/32)] uint32 (host-precomputed by walking
+    each row's compiled FSM along its draft — O(S) table lookups, no
+    device round trip) applied before the greedy argmax, so a draft token
+    that violates a row's constraint is rejected at its position exactly
+    as masked single-step decode would reject it."""
+    from dynamo_tpu.engine.sampling import FSM_MASK_FILL
+
+    def f(params, ints3, block_tables, kv_lens, k_cache, v_cache,
+          mask_words=None):
+        tokens, positions, slot_map = ints3[:, 0], ints3[:, 1], ints3[:, 2]
+        logits, k_cache, v_cache = forward(
+            params, tokens, positions, slot_map, block_tables, kv_lens,
+            jnp.zeros((tokens.shape[0],), jnp.int32), k_cache, v_cache,
+            cfg=cfg, block_size=block_size, mesh=mesh, all_logits=True)
+        if mask_words is not None:
+            V = logits.shape[-1]
+            ids = jnp.arange(V, dtype=jnp.uint32)
+            bits = (mask_words[:, :, (ids // 32).astype(jnp.int32)]
+                    >> (ids % 32)) & jnp.uint32(1)
+            logits = jnp.where(bits.astype(bool), logits, FSM_MASK_FILL)
+        lp = jax.nn.log_softmax(logits, axis=-1)  # [B,S,V] f32
+        ids = jnp.argmax(lp, axis=-1)
+        chosen = jnp.take_along_axis(lp, ids[..., None], axis=-1)[..., 0]
+        return ids.astype(jnp.int32), chosen, k_cache, v_cache
+
+    if masked:
+        def fn(params, ints3, block_tables, kv_lens, mask_words,
+               k_cache, v_cache):
+            return f(params, ints3, block_tables, kv_lens, k_cache,
+                     v_cache, mask_words=mask_words)
+        donate = (5, 6)
+    else:
+        def fn(params, ints3, block_tables, kv_lens, k_cache, v_cache):
+            return f(params, ints3, block_tables, kv_lens, k_cache, v_cache)
+        donate = (4, 5)
 
     kw = {}
     if replicate_outputs and mesh is not None:
         rep = NamedSharding(mesh, P())
         csh = cache_shardings(mesh, cfg, quant=kv_quant)
         kw["out_shardings"] = (rep, rep, csh, csh)
-    return jax.jit(f, donate_argnums=(4, 5), **kw)
+    return jax.jit(fn, donate_argnums=donate, **kw)
 
 
 def make_embed_fn(cfg: ModelConfig, block_size: int,
@@ -1534,7 +1551,8 @@ def make_embed_fn(cfg: ModelConfig, block_size: int,
 def multi_decode(params, last_tokens, positions, block_tables, kv_lens,
                  k_cache, v_cache, temperature, top_k, top_p, seeds, step0,
                  *, cfg: ModelConfig, block_size: int, num_steps: int,
-                 use_pallas: bool = False, mesh: Optional[Mesh] = None):
+                 use_pallas: bool = False, mesh: Optional[Mesh] = None,
+                 fsm_states=None, fsm_mask=None, fsm_next=None):
     """Run ``num_steps`` chained decode steps in ONE compiled program.
 
     Per-step host dispatch dominates decode latency when the chip is remote
@@ -1557,9 +1575,13 @@ def multi_decode(params, last_tokens, positions, block_tables, kv_lens,
 
     B = last_tokens.shape[0]
     bs = block_size
+    fsm = fsm_mask is not None  # trace-time: separate jitted variants
 
     def step(carry, k):
-        tok, pos, kv, kc, vc = carry
+        if fsm:
+            tok, pos, kv, st, kc, vc = carry
+        else:
+            tok, pos, kv, kc, vc = carry
         slot = (jnp.take_along_axis(
             block_tables, (pos // bs)[:, None], axis=1)[:, 0] * bs + pos % bs)
         logits, kc, vc = forward(
@@ -1568,12 +1590,23 @@ def multi_decode(params, last_tokens, positions, block_tables, kv_lens,
             cfg=cfg, block_size=bs, use_pallas=use_pallas, mesh=mesh)
         keys = jnp.stack(
             [seeds.astype(jnp.uint32), (step0 + k).astype(jnp.uint32)], axis=1)
+        if fsm:
+            # constrained rows: FSM mask + on-device state advance, exactly
+            # the single-step fused dispatch (structured/runtime.py); FREE
+            # rows (state 0) see an identity mask and a 0 self-loop
+            new_tok, logp, new_st = S.sample_masked(
+                logits, temperature, top_k, top_p, keys, st,
+                fsm_mask, fsm_next)
+            return (new_tok, pos + 1, kv + 1, new_st, kc, vc), (new_tok, logp)
         new_tok, logp = S.sample(logits, temperature, top_k, top_p, keys)
         return (new_tok, pos + 1, kv + 1, kc, vc), (new_tok, logp)
 
-    (_, _, _, k_cache, v_cache), (toks, logps) = jax.lax.scan(
-        step, (last_tokens, positions, kv_lens, k_cache, v_cache),
-        jnp.arange(num_steps))
+    carry0 = ((last_tokens, positions, kv_lens, fsm_states, k_cache, v_cache)
+              if fsm else
+              (last_tokens, positions, kv_lens, k_cache, v_cache))
+    out_carry, (toks, logps) = jax.lax.scan(
+        step, carry0, jnp.arange(num_steps))
+    k_cache, v_cache = out_carry[-2], out_carry[-1]
     return toks, logps, k_cache, v_cache
 
 
@@ -1647,7 +1680,7 @@ def make_step_mm_fn(cfg: ModelConfig, block_size: int,
 def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
                          mesh: Optional[Mesh] = None, use_pallas: bool = False,
                          replicate_outputs: bool = False,
-                         kv_quant: bool = False):
+                         kv_quant: bool = False, fsm: bool = False):
     """Jitted multi-step decode with cache donation (args 5, 6).
 
     ``replicate_outputs`` (multi-host): tokens/logps come back fully
@@ -1665,22 +1698,42 @@ def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
 
     Signature: ``fn(params, ints, floats, rand, block_tables,
     k_cache, v_cache) -> (tokens [K,B], logps [K,B], k_cache, v_cache)``.
+
+    ``fsm=True`` builds the structured-decoding variant: three extra
+    operands — per-row FSM states [B] int32 plus the runtime's mask/next
+    arenas — thread through the scan so constrained rows stay masked and
+    advance on device across all K steps (docs/structured.md). Signature:
+    ``fn(params, ints, floats, rand, block_tables, states, mask_arena,
+    next_arena, k_cache, v_cache)``.
     """
     decode_pallas, _ = _resolve_kernel_flags(cfg, mesh, use_pallas, False)
 
-    def f(params, ints, floats, rand, block_tables, k_cache, v_cache):
-        return multi_decode(
-            params, ints[:, 0], ints[:, 1], block_tables, ints[:, 2],
-            k_cache, v_cache, floats[:, 0], ints[:, 3], floats[:, 1],
-            rand[:, 0], rand[:, 1], cfg=cfg, block_size=block_size,
-            num_steps=num_steps, use_pallas=decode_pallas, mesh=mesh)
+    if fsm:
+        def f(params, ints, floats, rand, block_tables, states,
+              mask_arena, next_arena, k_cache, v_cache):
+            return multi_decode(
+                params, ints[:, 0], ints[:, 1], block_tables, ints[:, 2],
+                k_cache, v_cache, floats[:, 0], ints[:, 3], floats[:, 1],
+                rand[:, 0], rand[:, 1], cfg=cfg, block_size=block_size,
+                num_steps=num_steps, use_pallas=decode_pallas, mesh=mesh,
+                fsm_states=states, fsm_mask=mask_arena,
+                fsm_next=next_arena)
+        donate = (8, 9)
+    else:
+        def f(params, ints, floats, rand, block_tables, k_cache, v_cache):
+            return multi_decode(
+                params, ints[:, 0], ints[:, 1], block_tables, ints[:, 2],
+                k_cache, v_cache, floats[:, 0], ints[:, 3], floats[:, 1],
+                rand[:, 0], rand[:, 1], cfg=cfg, block_size=block_size,
+                num_steps=num_steps, use_pallas=decode_pallas, mesh=mesh)
+        donate = (5, 6)
 
     kw = {}
     if replicate_outputs and mesh is not None:
         rep = NamedSharding(mesh, P())
         csh = cache_shardings(mesh, cfg, quant=kv_quant)
         kw["out_shardings"] = (rep, rep, csh, csh)
-    return jax.jit(f, donate_argnums=(5, 6), **kw)
+    return jax.jit(f, donate_argnums=donate, **kw)
 
 
 def make_draft_fn(cfg: ModelConfig, block_size: int, draft_layers: int,
@@ -1697,7 +1750,7 @@ def make_draft_fn(cfg: ModelConfig, block_size: int, draft_layers: int,
     draft tokens' REAL cache slots. Accepted tokens get those rows
     recomputed identically by the verify pass; rejected slots hold garbage
     that the next real step overwrites and kv_lens caps out of any read
-    (the verify_forward contract). The reference models this capability as
+    (the make_verify_fn contract). The reference models this capability as
     SpecDecodeStats on its engines (ref: kv_router/protocols.rs:48-84).
 
     Returns (tokens [K, B], k_cache, v_cache).

@@ -89,6 +89,52 @@ def sample_jit(logits, temperature, top_k, top_p, keys):
     return sample(logits, temperature, top_k, top_p, keys)
 
 
+# ------------------------------------------------- structured decoding FSM
+
+#: the same fill the host guided path uses (engine._sample) — NOT -inf, so
+#: device-FSM and host-oracle streams stay bit-identical
+FSM_MASK_FILL = -1e30
+
+
+def apply_fsm_mask(logits, states, mask_table):
+    """Mask each row to its FSM state's allowed-token set.
+
+    ``states`` [B] int32 indexes ``mask_table`` [S, ceil(V/32)] uint32 —
+    the structured runtime's packed bitmask arena (structured/runtime.py).
+    State 0 is the all-allowed FREE row, making this an exact identity for
+    unconstrained rows. One gather + a broadcast shift: no [B, V] host
+    materialization, no per-row Python.
+    """
+    V = logits.shape[-1]
+    words = mask_table[states]                       # [B, W32]
+    ids = jnp.arange(V, dtype=jnp.uint32)
+    bits = (words[:, (ids // 32).astype(jnp.int32)]
+            >> (ids % 32)) & jnp.uint32(1)           # [B, V]
+    return jnp.where(bits.astype(bool), logits, FSM_MASK_FILL)
+
+
+def sample_masked(logits, temperature, top_k, top_p, keys, states,
+                  mask_table, next_table):
+    """FSM-constrained sampling: mask → sample → advance, all on device.
+
+    Returns (tokens [B], logps [B], new_states [B]) — ``new_states`` is
+    ``next_table[state, token]``, fed device-to-device by the pipelined
+    decode loop exactly like the token column, so a constrained row costs
+    no host sync between steps.
+    """
+    lg = apply_fsm_mask(logits, states, mask_table)
+    toks, logps = sample(lg, temperature, top_k, top_p, keys)
+    new_states = next_table[states, toks]
+    return toks, logps, new_states
+
+
+@functools.partial(jax.jit, static_argnames=())
+def sample_masked_jit(logits, temperature, top_k, top_p, keys, states,
+                      mask_table, next_table):
+    return sample_masked(logits, temperature, top_k, top_p, keys, states,
+                         mask_table, next_table)
+
+
 def make_keys(seeds, steps):
     """Host helper: per-row threefry key data from (seed, step). [B,2] uint32.
 

@@ -514,6 +514,13 @@ class Scheduler:
     def finish(self, seq: SeqState, reason: str) -> None:
         seq.finished = reason
         self.qos.leave(seq)
+        if seq.guided_state is not None:
+            # structured decoding: drop the seq's device-FSM arena
+            # reference so idle constraint tables become evictable
+            # (duck-typed — the host oracle has no release)
+            rel = getattr(seq.guided_state, "release", None)
+            if rel is not None:
+                rel()
         self._flush_stored(seq)
         if seq in self.running:
             self.running.remove(seq)

@@ -49,6 +49,7 @@ from dynamo_tpu.runtime.context import (
     Context,
     DeadlineExceededError,
     OverloadedError,
+    StreamError,
 )
 from dynamo_tpu.runtime.control_plane import NoRespondersError
 from dynamo_tpu.runtime.metrics import MetricsRegistry, render_registries
@@ -240,8 +241,16 @@ class HttpService:
         # Retry-After estimate the next rejection hands out
         self._drain_rate.note()
 
-    def _resolve_qos(self, request: web.Request) -> tuple[str, str]:
+    def _resolve_qos(self, request: web.Request,
+                     has_tools: bool = False) -> tuple[str, str]:
         """(tenant, priority class) for a request (docs/qos.md).
+
+        ``has_tools``: the parsed body carries OpenAI ``tools`` — when the
+        operator configured DYN_QOS_TOOL_CLASS (docs/structured.md), tool-
+        loop traffic adopts that class unless an explicit
+        ``x-dynamo-priority`` header overrides it. This is server policy,
+        not a client claim, so the anonymous-escalation clamp below does
+        not apply to it.
 
         Tenant: a configured API key (``Authorization: Bearer``) wins,
         else the ``x-dynamo-tenant`` header, else "default". A tenant
@@ -300,6 +309,11 @@ class HttpService:
                 "configured class without an API key; using %r",
                 raw, tenant, base)
             cls = base
+        if has_tools and self.qos.tool_class and raw is None:
+            # tool-loop mapping (operator-configured): agentic round trips
+            # block the client per turn, so they class as the operator
+            # chose; an explicit header still wins
+            cls = self.qos.tool_class
         return tenant, cls
 
     def _retry_after(self, backlog: int) -> int:
@@ -763,7 +777,8 @@ class HttpService:
                 error_body(f"model '{parsed.model}' not found",
                            "model_not_found", 404), status=404)
 
-        tenant, qos_class = self._resolve_qos(request)
+        tenant, qos_class = self._resolve_qos(request,
+                                              has_tools=bool(parsed.tools))
         cost = parsed.stop.max_tokens or self.qos.default_cost
         rejection = self._qos_admission(
             "responses", parsed.model, tenant, qos_class, cost)
@@ -817,6 +832,18 @@ class HttpService:
                 return web.json_response(
                     error_body("no workers available", "service_unavailable",
                                503), status=503)
+            except StreamError as e:
+                # same mapping as the chat route: a typed invalid_request
+                # from the worker (unsatisfiable constraint) is the
+                # caller's 400; other stream failures are a clean 502
+                status = 400 if e.code == "invalid_request" else 502
+                self._requests.inc(route="responses", model=parsed.model,
+                                   status=str(status))
+                return web.json_response(
+                    error_body(str(e),
+                               "invalid_request_error" if status == 400
+                               else "upstream_error", status),
+                    status=status)
             except (ValueError, RuntimeError) as e:
                 self._requests.inc(route="responses", model=parsed.model,
                                    status="400")
@@ -989,7 +1016,8 @@ class HttpService:
                 status=404,
             )
 
-        tenant, qos_class = self._resolve_qos(request)
+        tenant, qos_class = self._resolve_qos(request,
+                                              has_tools=bool(parsed.tools))
         cost = parsed.stop.max_tokens or self.qos.default_cost
         rejection = self._qos_admission(
             route, parsed.model, tenant, qos_class, cost)
@@ -1049,6 +1077,22 @@ class HttpService:
                     return web.json_response(
                         error_body("no workers available", "service_unavailable", 503), status=503
                     )
+                except StreamError as e:
+                    # worker-side typed failure that exhausted migration:
+                    # invalid_request (e.g. an unsatisfiable constraint —
+                    # docs/structured.md) is the CALLER's error → 400;
+                    # anything else is an upstream failure → clean 502
+                    # JSON instead of aiohttp's bare 500
+                    status = 400 if e.code == "invalid_request" else 502
+                    root.set(status_code=status)
+                    self._requests.inc(route=route, model=parsed.model,
+                                       status=str(status))
+                    return web.json_response(
+                        error_body(str(e),
+                                   "invalid_request_error"
+                                   if status == 400 else "upstream_error",
+                                   status),
+                        status=status)
                 except (ValueError, RuntimeError) as e:
                     root.set(status_code=400)
                     self._requests.inc(route=route, model=parsed.model, status="400")

@@ -668,18 +668,27 @@ _MACHINE_CACHE: dict = {}
 _MACHINE_CACHE_CAP = 64
 
 
+def get_machine(pattern: str, vocab: list[str]) -> tuple[TokenMachine, bool]:
+    """(machine, cache_hit) for a pattern over a vocab. The hit flag feeds
+    the structured subsystem's dynamo_structured_compile_total counter —
+    a miss means the full char-NFA compile ran for this admission."""
+    key = (pattern, id(vocab))
+    machine = _MACHINE_CACHE.get(key)
+    if machine is not None and machine.vocab is vocab:
+        return machine, True
+    machine = TokenMachine(CharDfa(pattern), vocab)
+    if len(_MACHINE_CACHE) >= _MACHINE_CACHE_CAP:
+        _MACHINE_CACHE.pop(next(iter(_MACHINE_CACHE)))
+    _MACHINE_CACHE[key] = machine
+    return machine, False
+
+
 def compile_guided(guided: dict, vocab: list[str],
                    eos_ids: list[int]) -> GuidedState:
     """Build a GuidedState for one request (machines are cached across
     requests; the state cursor is per-sequence)."""
     pattern = guided_pattern(guided)
-    key = (pattern, id(vocab))
-    machine = _MACHINE_CACHE.get(key)
-    if machine is None or machine.vocab is not vocab:
-        machine = TokenMachine(CharDfa(pattern), vocab)
-        if len(_MACHINE_CACHE) >= _MACHINE_CACHE_CAP:
-            _MACHINE_CACHE.pop(next(iter(_MACHINE_CACHE)))
-        _MACHINE_CACHE[key] = machine
+    machine, _ = get_machine(pattern, vocab)
     if not machine.token_live(machine.start):
         # refuse at COMPILE time: no token sequence over this vocabulary
         # can satisfy the pattern, so generation would stall immediately

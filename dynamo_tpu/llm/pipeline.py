@@ -171,6 +171,40 @@ class OpenAIPreprocessor:
                 token_ids.extend([0] * n_ph)  # placeholder run
         return token_ids, mm_refs
 
+    def _apply_tool_choice(self, req: ParsedRequest
+                           ) -> tuple[ParsedRequest, bool]:
+        """Enforce ``tool_choice`` (docs/structured.md) — it is never
+        silently ignored:
+
+        * ``"none"``: tools are stripped BEFORE template rendering, so the
+          model never sees the schemas and no tool parser runs.
+        * ``"required"`` / named tool: the tool parameter schemas compile
+          into a constraint grammar (structured/tools.py) in the model's
+          tool-parser markup, attached as the request's guided constraint —
+          the model cannot emit anything but a valid call. Unsupported
+          parser markup or schema keywords raise (→ frontend 400) rather
+          than free-decoding and hoping.
+
+        Returns (request, enforced) — ``enforced`` selects a JSON tool
+        parser for models with none configured, so constrained output
+        still round-trips into ``tool_calls``.
+        """
+        tc = req.tool_choice
+        if tc in (None, "auto"):
+            return req, False
+        import dataclasses as _dc
+
+        if tc == "none":
+            return _dc.replace(req, tools=None, tool_choice=None), False
+        from dynamo_tpu.llm.guided import validate_guided
+        from dynamo_tpu.structured.tools import tool_constraint
+
+        parser = self.mdc.runtime_config.tool_call_parser
+        pattern = tool_constraint(req.tools or [], tc, parser)
+        validate_guided({"regex": pattern})  # clear 400, not a worker error
+        sampling = _dc.replace(req.sampling, guided={"regex": pattern})
+        return _dc.replace(req, sampling=sampling), True
+
     def preprocess(self, req: ParsedRequest) -> tuple[PreprocessedRequest, str]:
         mm_refs = None
         if req.messages is not None:
@@ -224,6 +258,7 @@ class OpenAIPreprocessor:
         """Yields Annotated-wire dicts whose ``data`` are OpenAI chunk objects."""
         from dynamo_tpu.observability import get_tracer
 
+        req, tools_enforced = self._apply_tool_choice(req)
         is_chat = req.messages is not None
         with get_tracer().span("preprocess.tokenize", ctx,
                                service="frontend") as sp:
@@ -248,6 +283,12 @@ class OpenAIPreprocessor:
             reasoning = get_reasoning_parser(rc.reasoning_parser)
             if rc.tool_call_parser and req.tools:
                 tool_parser_name = rc.tool_call_parser
+            elif tools_enforced and req.tools:
+                # enforcement without a configured parser constrains to
+                # bare JSON (structured/tools.py default markup) — parse it
+                # with the JSON tool parser so the call still surfaces as
+                # tool_calls instead of streaming as content
+                tool_parser_name = "llama3_json"
             elif hasattr(reasoning, "route_tools_to_reasoning"):
                 # tool-less request on a harmony model: no tool parser will
                 # run, so the channel parser must NOT pass commentary

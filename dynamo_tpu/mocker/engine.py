@@ -70,6 +70,20 @@ class MockEngineArgs:
     token_budget_plan: bool = True
 
 
+#: the mocker's constraint alphabet (structured-decoding parity): token id
+#: i decodes to one printable char, id 0 reserved — the same shape the
+#: engine-level guided tests use, so fleet tests can assert schema-valid
+#: canned output by decoding the token stream against it
+_GUIDED_VOCAB: list = []
+
+
+def mock_guided_vocab() -> list[str]:
+    global _GUIDED_VOCAB
+    if not _GUIDED_VOCAB:
+        _GUIDED_VOCAB = [""] + [chr(32 + i) for i in range(95)]
+    return _GUIDED_VOCAB
+
+
 @dataclass
 class _Seq:
     request_id: str
@@ -83,6 +97,9 @@ class _Seq:
     rng: random.Random = None
     owned_block_hashes: list[int] = field(default_factory=list)
     finished: Optional[str] = None
+    #: guided-decoding cursor over mock_guided_vocab (llm/guided
+    #: GuidedState via structured.build_guided_state) — None = free decode
+    guided: object = None
 
     @property
     def isl(self) -> int:
@@ -227,6 +244,15 @@ class MockEngine:
             rng=random.Random(req.sampling_options.seed if req.sampling_options.seed is not None
                               else hash(tuple(req.token_ids)) & 0xFFFFFFFF),
         )
+        if req.sampling_options.guided:
+            # structured-decoding parity: fleet tests (QoS/autoscale/chaos)
+            # carry constrained traffic through the mocker too — compile
+            # the constraint over the mock alphabet (cached + counted like
+            # the real engine's admissions) and emit schema-valid output
+            from dynamo_tpu.structured import build_guided_state
+            seq.guided = await asyncio.to_thread(
+                build_guided_state, req.sampling_options.guided,
+                mock_guided_vocab(), req.eos_token_ids or [], None)
         self.waiting.append(seq)
         self._wake.set()
         # same engine-side phase spans the real engine records, so the
@@ -381,6 +407,12 @@ class MockEngine:
             decode_rows=decoded, prefill_chunks=chunks,
             chunk_tokens=prefill_tokens,
             waiting=len(self.waiting), running=len(self.running),
+            # per-row constraint shape parity with the real engine's
+            # records (docs/structured.md): fleet views show constrained
+            # traffic on mocker fleets too
+            constrained_rows=sum(1 for s in self.running
+                                 if s.guided is not None
+                                 and not s.in_prefill and not s.finished),
             kv_tiers={"g1": self.cache.used_blocks})
 
     def _admit(self):
@@ -448,15 +480,41 @@ class MockEngine:
                 seq.out_queue.put_nowait(LLMEngineOutput.cancelled())
                 continue
             n += 1
-            tok = seq.rng.randint(10, self.args.vocab_size - 1)
             max_tokens = seq.req.stop_conditions.max_tokens or 64
             min_tokens = seq.req.stop_conditions.min_tokens or 0
             eos = False
-            if seq.req.eos_token_ids and seq.generated >= min_tokens and not seq.req.stop_conditions.ignore_eos:
-                # small chance of sampling EOS to model natural stops
-                if seq.rng.random() < 0.02:
-                    tok = seq.req.eos_token_ids[0]
-                    eos = True
+            guided_stop = False
+            if seq.guided is not None:
+                # constrained row: deterministic greedy walk of the mask —
+                # lowest allowed id each step, so the emitted stream is
+                # schema-valid by construction (EOS joins the set only
+                # where the constraint can terminate)
+                gs = seq.guided
+                hi = min(len(mock_guided_vocab()), self.args.vocab_size)
+                ids = gs.allowed_token_ids(hi)
+                if min_tokens > seq.generated:
+                    non_eos = [t for t in ids if t not in gs.eos_ids]
+                    ids = non_eos or ids
+                if not ids:
+                    # stranded (possible only past the liveness cap):
+                    # finish like the real scheduler would
+                    seq.finished = FinishReason.STOP
+                    seq.out_queue.put_nowait(LLMEngineOutput(
+                        finish_reason=FinishReason.STOP))
+                    continue
+                tok = ids[0]
+                gs.advance(tok)
+                eos = (tok in gs.eos_ids
+                       and not seq.req.stop_conditions.ignore_eos)
+                guided_stop = (gs.exhausted
+                               or (gs.done and seq.generated >= min_tokens))
+            else:
+                tok = seq.rng.randint(10, self.args.vocab_size - 1)
+                if seq.req.eos_token_ids and seq.generated >= min_tokens and not seq.req.stop_conditions.ignore_eos:
+                    # small chance of sampling EOS to model natural stops
+                    if seq.rng.random() < 0.02:
+                        tok = seq.req.eos_token_ids[0]
+                        eos = True
             new_block = seq.blocks.push_token(tok)
             if new_block is not None:
                 await self._store_blocks(
@@ -466,6 +524,10 @@ class MockEngine:
             finish = None
             if eos:
                 finish = FinishReason.EOS
+            elif guided_stop and seq.generated >= min_tokens:
+                # constraint completed/exhausted: stop instead of free-
+                # running past it (scheduler.check_finish parity)
+                finish = FinishReason.STOP
             elif seq.generated >= max_tokens:
                 finish = FinishReason.LENGTH
             seq.finished = finish

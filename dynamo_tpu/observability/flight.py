@@ -122,6 +122,9 @@ class StepRecord:
     swapped: int = 0
     running: int = 0
     starved_decode: int = 0  # ready decode rows the step could not carry
+    #: rows this step sampled under a structured-decoding constraint
+    #: (device FSM or host oracle) — docs/structured.md
+    constrained_rows: int = 0
     kv_tiers: dict = field(default_factory=dict)  # {g1..g4: blocks}
     onboard_inflight: int = 0
     restore_inflight: int = 0
@@ -152,7 +155,7 @@ class StepRecord:
             d["compile_sig"] = self.compile_sig
         for k in ("preempt_swap", "preempt_recompute", "swap_out_blocks",
                   "swap_in_blocks", "starved_decode", "onboard_inflight",
-                  "restore_inflight"):
+                  "restore_inflight", "constrained_rows"):
             v = getattr(self, k)
             if v:
                 d[k] = v

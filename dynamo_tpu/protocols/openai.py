@@ -201,13 +201,64 @@ def _parse_common(body: dict, req: ParsedRequest) -> ParsedRequest:
         logprobs=lp_count,
         echo=bool(body.get("echo", False)),
     )
-    req.tools = body.get("tools")
-    req.tool_choice = body.get("tool_choice")
+    req.tools = _validate_tools(body.get("tools"))
+    req.tool_choice = _validate_tool_choice(body.get("tool_choice"),
+                                            req.tools, guided)
     req.response_format = body.get("response_format")
     req.annotations = list(nvext.get("annotations") or [])
     req.backend_instance_id = nvext.get("backend_instance_id")
     req.router_config_override = nvext.get("router_config_override")
     return req
+
+
+def _validate_tools(tools) -> Optional[list[dict]]:
+    if tools is None:
+        return None
+    if not isinstance(tools, list) or not all(
+            isinstance(t, dict) for t in tools):
+        raise RequestError("'tools' must be an array of tool objects")
+    for t in tools:
+        fn = t.get("function")
+        if (t.get("type") not in (None, "function")
+                or not isinstance(fn, dict)
+                or not isinstance(fn.get("name"), str) or not fn["name"]):
+            raise RequestError(
+                "each tool must be {'type': 'function', 'function': "
+                "{'name': ...}}")
+    return tools
+
+
+def _validate_tool_choice(tc, tools, guided):
+    """Shape-validate ``tool_choice`` at the API boundary so enforcement
+    failures are 400s here, not worker-side errors. The PIPELINE enforces
+    it (docs/structured.md): "none" strips tools from the template,
+    "required"/named compiles a constraint grammar — it is never silently
+    ignored."""
+    if tc is None:
+        return None
+    named = (isinstance(tc, dict) and tc.get("type") in (None, "function")
+             and isinstance(tc.get("function"), dict)
+             and isinstance(tc["function"].get("name"), str))
+    if tc not in ("auto", "none", "required") and not named:
+        raise RequestError(
+            "'tool_choice' must be 'auto', 'none', 'required', or "
+            "{'type': 'function', 'function': {'name': ...}}")
+    if tc in ("required",) or named:
+        if not tools:
+            raise RequestError(f"tool_choice {tc!r} requires 'tools'")
+        if guided:
+            # one sampling constraint per request: an explicit guided_* /
+            # response_format schema cannot coexist with tool enforcement
+            raise RequestError(
+                "tool_choice 'required'/named cannot be combined with "
+                "guided_* options or response_format constraints")
+        if named:
+            names = {(t.get("function") or {}).get("name") for t in tools}
+            if tc["function"]["name"] not in names:
+                raise RequestError(
+                    f"tool_choice names unknown tool "
+                    f"{tc['function']['name']!r}")
+    return tc
 
 
 # ---------------------------------------------------------------------------

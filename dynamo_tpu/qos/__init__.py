@@ -129,6 +129,13 @@ class QosConfig:
     #: and /metrics label cardinality without bound; 0 disables header
     #: tenants outright. Configured tenants are never subject to the cap.
     max_adhoc_tenants: int = 1024
+    #: QoS class for tool-loop traffic (docs/structured.md): requests
+    #: carrying OpenAI ``tools`` adopt this class when no explicit
+    #: x-dynamo-priority header overrides it — agentic round trips are
+    #: latency-coupled (the client blocks on every turn), so operators
+    #: typically map them to "interactive". "" (default) disables the
+    #: mapping: tool traffic classes like any other request.
+    tool_class: str = ""
     tenants: dict = field(default_factory=dict)  # name -> TenantPolicy
     _key_to_tenant: dict = field(default_factory=dict, repr=False)
 
@@ -151,6 +158,9 @@ class QosConfig:
             raise ConfigError("DYN_QOS_DEFAULT_COST: must be >= 1")
         if self.max_adhoc_tenants < 0:
             raise ConfigError("DYN_QOS_MAX_TENANTS: must be >= 0")
+        if self.tool_class and self.tool_class not in CLASS_RANK:
+            raise ConfigError(
+                f"DYN_QOS_TOOL_CLASS: unknown class {self.tool_class!r}")
         self._key_to_tenant = {}
         for name, pol in self.tenants.items():
             if pol.priority is not None and pol.priority not in CLASS_RANK:
@@ -230,7 +240,8 @@ class QosConfig:
                                "tenant_max_inflight", int),
                               ("DYN_QOS_DEFAULT_COST", "default_cost", int),
                               ("DYN_QOS_MAX_TENANTS",
-                               "max_adhoc_tenants", int)):
+                               "max_adhoc_tenants", int),
+                              ("DYN_QOS_TOOL_CLASS", "tool_class", str)):
             if key in env:
                 try:
                     kw[fld] = typ(str(env[key]).strip())

@@ -71,6 +71,16 @@ class DeadlineExceededError(TerminalStreamError):
     code = "deadline"
 
 
+class InvalidRequestError(TerminalStreamError):
+    """The request itself is invalid for this model/fleet — a
+    DETERMINISTIC rejection (e.g. a guided constraint no token sequence
+    over the model's vocabulary can satisfy, docs/structured.md), so
+    retrying or migrating burns budget against the same answer. The
+    frontend maps the code to a 400."""
+
+    code = "invalid_request"
+
+
 def stream_error_from_wire(msg: str, code: Optional[str],
                            retryable: bool) -> StreamError:
     """Rehydrate a typed stream error from an err-frame's fields so the
@@ -79,6 +89,8 @@ def stream_error_from_wire(msg: str, code: Optional[str],
         return OverloadedError(msg)
     if code == "deadline":
         return DeadlineExceededError(msg)
+    if code == "invalid_request":
+        return InvalidRequestError(msg)
     return StreamError(msg, code=code, retryable=retryable)
 
 

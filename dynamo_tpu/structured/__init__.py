@@ -1,0 +1,73 @@
+"""Structured decoding subsystem (docs/structured.md).
+
+Compiles guided-decoding constraints (regex / JSON schema / choice /
+tool-call grammars) into dense device tables and runs the FSM inside the
+sampling dispatch, so constrained rows ride the ragged step and the
+pipelined decode loop with no host sync. The host DFA (llm/guided.py)
+remains the semantics oracle and the fallback for constraints whose
+tables exceed the byte budget.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dynamo_tpu.structured.compiler import (  # noqa: F401
+    COMPILE_STATS,
+    CompiledFsm,
+    FsmBudgetError,
+    compile_fsm,
+    get_compiled,
+)
+from dynamo_tpu.structured.runtime import (  # noqa: F401
+    FsmCursor,
+    FsmSegment,
+    StructuredRuntime,
+    arena_states,
+    env_enabled,
+    table_budget_bytes,
+)
+from dynamo_tpu.structured.tools import tool_constraint  # noqa: F401
+
+
+def build_guided_state(guided: dict, vocab: list, eos_ids: list,
+                       runtime: Optional[StructuredRuntime] = None,
+                       want_device: bool = True):
+    """The engine's ONE entry for attaching a constraint to a sequence.
+
+    Returns an :class:`FsmCursor` (device path: table mask fused into the
+    sampling dispatch, O(1) host mirror advance) when the runtime can hold
+    the compiled machine, else the host-oracle ``GuidedState``. Every
+    admission counts one ``hit``/``miss`` into :data:`COMPILE_STATS` —
+    a hit means NO DFA or table compile work ran (both caches warm).
+    """
+    from dynamo_tpu.llm.guided import GuidedState, get_machine, guided_pattern
+    from dynamo_tpu.runtime.context import InvalidRequestError
+
+    pattern = guided_pattern(guided)
+    machine, hit = get_machine(pattern, vocab)
+    if not machine.token_live(machine.start):
+        # same compile-time refusal as llm/guided.compile_guided, but
+        # TYPED: the rejection is deterministic across the fleet (every
+        # worker serves the same vocabulary), so it must not burn
+        # migration retries and must surface as the caller's 400
+        raise InvalidRequestError(
+            "guided constraint cannot be satisfied by any token sequence "
+            "over this model's vocabulary")
+    cursor = None
+    if want_device and runtime is not None and runtime.cap > 0:
+        compiled, c_hit = get_compiled(machine, pattern, vocab, eos_ids,
+                                       runtime.V, runtime.cap - 1)
+        hit = hit and c_hit
+        if compiled is not None:
+            seg = runtime.acquire((pattern, tuple(sorted(
+                e for e in eos_ids if 0 <= e < runtime.V))), compiled)
+            if seg is not None:
+                cursor = FsmCursor(seg, runtime)
+    COMPILE_STATS["hit" if hit else "miss"] += 1
+    if cursor is not None:
+        runtime.rows_device += 1
+        return cursor
+    if runtime is not None:
+        runtime.rows_host += 1
+    return GuidedState(machine, eos_ids)

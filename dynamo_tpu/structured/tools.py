@@ -1,0 +1,74 @@
+"""tool_choice → constraint grammar: compile OpenAI tool schemas into the
+regex the guided-decoding machinery enforces.
+
+``tool_choice: "required"`` (or a named tool) must GUARANTEE the model
+emits a parseable call — free-decoding and hoping the parser matches is
+exactly the silent failure this closes. The emitted grammar is the union
+over the (chosen) tools of
+
+    {"name":"<tool>","arguments":<schema_to_regex(parameters)>}
+
+wrapped in the markup of the model's configured tool-call parser
+(parsers/tool_calling.py) so the constrained text round-trips through the
+SAME parse path unconstrained output takes. Parsers whose markup cannot
+be expressed here refuse loudly (the frontend 400s) rather than free-
+decoding — docs/structured.md "tool enforcement".
+"""
+
+from __future__ import annotations
+
+import json
+import re as _pyre
+
+from dynamo_tpu.llm.guided import json_object_regex, schema_to_regex
+
+#: parser formats the enforcer can express. llama3_json doubles as the
+#: bare-JSON default for models with no tool parser configured.
+_WRAPPABLE = {"hermes", "llama3_json", "mistral", "phi4", "nemotron_deci",
+              None, ""}
+
+
+def _tool_obj_regex(tool: dict) -> str:
+    fn = tool.get("function") or {}
+    name = fn.get("name")
+    if not isinstance(name, str) or not name:
+        raise ValueError("each tool needs function.name")
+    params = fn.get("parameters")
+    if params:
+        args_re = schema_to_regex(params)
+    else:
+        args_re = json_object_regex()  # schema-less tool: any JSON object
+    key = _pyre.escape(json.dumps(name))
+    return rf'\{{"name":{key},"arguments":({args_re})\}}'
+
+
+def tool_constraint(tools: list[dict], tool_choice, parser: str | None
+                    ) -> str:
+    """Regex enforcing a tool call for ``tool_choice: "required"`` or a
+    named-tool choice dict. Raises ValueError (→ frontend 400) when the
+    parser's markup or a tool's parameter schema can't be expressed."""
+    if parser not in _WRAPPABLE:
+        raise ValueError(
+            f"tool_choice enforcement is not supported for tool parser "
+            f"{parser!r} (supported: hermes, llama3_json, mistral, phi4, "
+            f"nemotron_deci, or no parser)")
+    chosen = tools
+    if isinstance(tool_choice, dict):
+        want = ((tool_choice.get("function") or {}).get("name"))
+        chosen = [t for t in tools
+                  if (t.get("function") or {}).get("name") == want]
+        if not chosen:
+            raise ValueError(f"tool_choice names unknown tool {want!r}")
+    objs = [f"({_tool_obj_regex(t)})" for t in chosen]
+    union = "|".join(objs)
+    one = f"({union})"
+    many = f"{one}(,{one})*"
+    if parser == "hermes":
+        return f"<tool_call>{one}</tool_call>"
+    if parser == "mistral":
+        return rf"\[TOOL_CALLS\]\[{many}\]"
+    if parser == "phi4":
+        return rf"functools\[{many}\]"
+    if parser == "nemotron_deci":
+        return rf"<TOOLCALL>\[{many}\]</TOOLCALL>"
+    return one  # llama3_json / bare JSON default
