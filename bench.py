@@ -27,6 +27,25 @@ import time
 import numpy as np
 
 BASELINE_TOK_S = 2000.0
+
+
+def _p95(vals, default=0.0):
+    """Shared interpolated p95 (observability/stats.quantile) — ONE
+    estimator for the bench summaries, the flight summaries and the
+    autoscaler's histogram tracker, so the three can never disagree about
+    the same samples (nearest-rank `sorted[int(n*0.95)]` read an
+    8-sample wave's p95 as its max)."""
+    from dynamo_tpu.observability.stats import quantile
+
+    q = quantile(list(vals), 0.95)
+    return default if q is None else q
+
+
+def _p50(vals, default=0.0):
+    from dynamo_tpu.observability.stats import quantile
+
+    q = quantile(list(vals), 0.50)
+    return default if q is None else q
 # v5e roofline (How to Scale Your Model / public TPU specs): util fields are
 # measured against these even on CPU fallback runs, so numbers stay comparable.
 HBM_BW_V5E = 819e9        # bytes/s HBM bandwidth per chip
@@ -226,8 +245,7 @@ async def chaos_smoke(spec: str = "stream.send:drop=0.01",
         rate = sum(1 for ok, _ in res if ok) / len(res)
         return rate, lats
 
-    def p95(lats):
-        return lats[min(len(lats) - 1, int(len(lats) * 0.95))]
+    p95 = _p95  # shared interpolated estimator (observability/stats)
 
     try:
         clean_rate, clean = await wave()
@@ -464,8 +482,8 @@ async def _e2e(on_tpu: bool) -> dict:
     total_tokens = sum(r[1] for r in results)
     return {
         "e2e_tok_s": round(total_tokens / elapsed, 1),
-        "ttft_p50_ms": round(1000 * ttfts[len(ttfts) // 2], 1),
-        "ttft_p95_ms": round(1000 * ttfts[int(len(ttfts) * 0.95)], 1),
+        "ttft_p50_ms": round(1000 * _p50(ttfts), 1),
+        "ttft_p95_ms": round(1000 * _p95(ttfts), 1),
         "workload": f"ISL={ISL},OSL={OSL},conc={CONC},n={N_REQ}",
         # per-step-kind timing aggregates (the first thing to read when e2e
         # trails the kernel — see docs/performance.md) + how much of the
@@ -741,9 +759,7 @@ async def qos_bench(on_tpu: bool = False, reps: int = 4) -> dict:
         bat_res = await asyncio.gather(*bat)
         return int_res, bat_res, time.perf_counter() - t0
 
-    def p95(vals):
-        vals = sorted(vals)
-        return vals[min(len(vals) - 1, int(len(vals) * 0.95))]
+    p95 = _p95  # shared interpolated estimator (observability/stats)
 
     async def run_phase(qos: bool, mixed_load: bool):
         """Warm pass (compiles every bucket), then ``reps`` timed passes;
@@ -1078,9 +1094,7 @@ async def migration_bench(on_tpu: bool = False, reps: int = 2,
                 pass
             await rt.shutdown()
 
-    def p95(vals):
-        vals = sorted(vals)
-        return vals[min(len(vals) - 1, int(len(vals) * 0.95))] if vals else 0.0
+    p95 = _p95  # shared interpolated estimator (observability/stats)
 
     arms = {"restore": [], "recompute": []}
     for rep in range(reps):  # interleaved per-rep: host drift cancels
@@ -1414,9 +1428,7 @@ async def onboard_bench(on_tpu: bool = False, reps: int = 2,
                 await client.stop()
             await rt.shutdown()
 
-    def p95(vals):
-        vals = sorted(vals)
-        return vals[min(len(vals) - 1, int(len(vals) * 0.95))] if vals else 0.0
+    p95 = _p95  # shared interpolated estimator (observability/stats)
 
     peer = {"pull": [], "recompute": []}
     for rep in range(reps):  # interleaved per-rep: host drift cancels
@@ -1548,9 +1560,7 @@ async def ragged_bench(on_tpu: bool = False, reps: int = 3) -> dict:
         res = await asyncio.gather(*dec, *pre)
         return res, time.perf_counter() - t0
 
-    def p95(vals):
-        vals = sorted(vals)
-        return vals[min(len(vals) - 1, int(len(vals) * 0.95))]
+    p95 = _p95  # shared interpolated estimator (observability/stats)
 
     async def measure(ragged: bool) -> dict:
         eng = AsyncJaxEngine(cfg, EngineArgs(**base, ragged_step=ragged))
@@ -1787,6 +1797,193 @@ async def flight_bench(on_tpu: bool = False, reps: int = 4) -> dict:
                         and identical
                         and out["preempt_storm_tagged"]
                         and out["compile_steady_tagged"])
+    return out
+
+
+async def attribution_bench(on_tpu: bool = False) -> dict:
+    """``bench.py --attribution``: the latency-attribution engine's three
+    contracts (ISSUE 14 acceptance; docs/observability.md "Attribution").
+
+    1. Falsifiability on a seeded QoS-mixed drive — for every request,
+       the decomposition's buckets + residual must equal the measured e2e
+       (≥95% of requests within 5%) with the unattributed residual ≤10%
+       of e2e at p95.
+    2. Pure observation — the SAME seeded workload with attribution
+       (flight recording + id linkage) on vs off yields bit-identical
+       greedy token streams.
+    3. Anomaly-triggered profiling — a seeded preempt storm + forced
+       steady-state compiles with DYN_PROFILE_ON_ANOMALY set produce at
+       least one real ``jax.profiler`` capture, capped by the
+       max-captures budget, with the artifact path on the triggering
+       StepRecord.
+    """
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.observability import configure_tracer, gather_attribution
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+    from dynamo_tpu.runtime.context import Context
+
+    configure_tracer(service="attribution-bench", capacity=8192)
+    if on_tpu:
+        cfg = ModelConfig.llama3_1b()
+        bs = 16
+        N_I, ISL_I, OSL_I = 6, 128, 24
+        N_B, ISL_B, OSL_B = 8, 384, 48
+        slots = 8
+        extra = dict(use_pallas_attention=True)
+    else:
+        cfg = ModelConfig.tiny()
+        bs = 4
+        N_I, ISL_I, OSL_I = 6, 32, 12
+        N_B, ISL_B, OSL_B = 6, 96, 32
+        slots = 6
+        extra = {}
+    working = (N_B * ((ISL_B + OSL_B + bs - 1) // bs)
+               + N_I * ((ISL_I + OSL_I + bs - 1) // bs))
+    base = dict(block_size=bs, num_blocks=working + 8, max_num_seqs=slots,
+                max_num_batched_tokens=2 * max(ISL_B, 128),
+                max_model_len=2 * (ISL_B + OSL_B),
+                enable_prefix_caching=False, **extra)
+    rng = np.random.default_rng(31)
+    int_prompts = [rng.integers(1, cfg.vocab_size, ISL_I).tolist()
+                   for _ in range(N_I)]
+    bat_prompts = [rng.integers(1, cfg.vocab_size, ISL_B).tolist()
+                   for _ in range(N_B)]
+
+    def req(tokens, osl):
+        return PreprocessedRequest(
+            model="m", token_ids=list(tokens),
+            stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+
+    async def one(eng, tokens, osl, cls, collect=None):
+        ctx = Context()
+        ctx.priority = cls
+        ctx.ensure_traceparent()
+        t0 = time.perf_counter()
+        toks = []
+        async for out in eng.generate(req(tokens, osl), ctx):
+            toks.extend(out.token_ids)
+        if collect is not None:
+            collect.append((ctx.id, time.perf_counter() - t0))
+        return toks
+
+    async def drive(eng, collect=None):
+        bat = [asyncio.ensure_future(
+            one(eng, p, OSL_B, "batch", collect)) for p in bat_prompts]
+        for _ in range(20000):
+            if any(s.generated > 0 for s in eng.scheduler.running):
+                break
+            await asyncio.sleep(0.001)
+        ints = [asyncio.ensure_future(
+            one(eng, p, OSL_I, "interactive", collect))
+            for p in int_prompts]
+        return await asyncio.gather(*bat, *ints)
+
+    out: dict = {}
+
+    # ---- 1) falsifiability: attribute every request of a seeded drive
+    eng = AsyncJaxEngine(cfg, EngineArgs(**base))
+    await drive(eng)  # compile surfaces warm, off the measured path
+    measured: list = []
+    streams_on = await drive(eng, collect=measured)
+    within, resid_fracs, incomplete = 0, [], 0
+    for rid, wall_s in measured:
+        doc = await gather_attribution(rid)
+        if doc is None:
+            continue
+        total = sum(doc["total"].values())
+        # the sweep partitions the doc's own window exactly; the 5%
+        # contract is against the CLIENT-measured wall clock, which adds
+        # sink handoff + generator overhead around the spans
+        if abs(total - wall_s * 1000.0) <= 0.05 * wall_s * 1000.0 + 1.0:
+            within += 1
+        resid_fracs.append(doc["residual_ms"] / max(doc["e2e_ms"], 1e-9))
+        incomplete += bool(doc["incomplete"])
+    n = len(measured)
+    out["attr_requests"] = n
+    out["attr_within_5pct_frac"] = round(within / max(n, 1), 4)
+    out["attr_residual_p95_frac"] = round(_p95(resid_fracs), 4)
+    out["attr_incomplete"] = incomplete
+    await eng.close()
+
+    # ---- 2) pure observation: same seed, flight+linkage on vs off
+    streams = {}
+    for flight_on in (True, False):
+        e = AsyncJaxEngine(cfg, EngineArgs(**base))
+        e.flight.enabled = flight_on
+        await drive(e)  # warm
+        streams[flight_on] = await drive(e)
+        await e.close()
+    out["attr_streams_identical"] = streams[True] == streams[False]
+    # re-check the primary drive too (recording was on there)
+    out["attr_streams_identical"] &= streams[True] == streams_on
+
+    # ---- 3) anomaly-triggered profiler: seeded storm + steady compiles
+    # under a capped capture budget (REAL jax.profiler device traces)
+    profile_dir = tempfile.mkdtemp(prefix="dyn-anomaly-")
+    old_env = {k: os.environ.get(k) for k in
+               ("DYN_PROFILE_ON_ANOMALY", "DYN_PROFILE_MAX_CAPTURES",
+                "DYN_PROFILE_COOLDOWN_S", "DYN_PROFILE_STEPS")}
+    os.environ.update({"DYN_PROFILE_ON_ANOMALY": profile_dir,
+                       "DYN_PROFILE_MAX_CAPTURES": "2",
+                       "DYN_PROFILE_COOLDOWN_S": "0",
+                       "DYN_PROFILE_STEPS": "4"})
+    try:
+        eng = AsyncJaxEngine(cfg, EngineArgs(**base, preempt_swap=False))
+        eng.flight.steady_after = 16
+        batch = [asyncio.ensure_future(
+            one(eng, rng.integers(1, cfg.vocab_size, 24).tolist(), 48,
+                "batch")) for _ in range(slots)]
+        for _ in range(20000):
+            if sum(s.generated > 0 for s in eng.scheduler.running) >= slots:
+                break
+            await asyncio.sleep(0.001)
+        inter = [asyncio.ensure_future(
+            one(eng, rng.integers(1, cfg.vocab_size, 12).tolist(), 8,
+                "interactive")) for _ in range(max(4, slots - 2))]
+        await asyncio.gather(*batch, *inter)
+        # steady-state compile probes: prompts sized to ragged buckets the
+        # storm never dispatched — each traces a fresh signature, tags
+        # compile-steady, and (budget permitting) arms a capture
+        unseen = [b for b in eng.args.ragged_token_buckets
+                  if ("ragged", b) not in eng.compiled_signatures
+                  and b <= base["max_num_batched_tokens"]][:4]
+        for b in unseen:
+            await one(eng, rng.integers(1, cfg.vocab_size, b).tolist(), 2,
+                      "standard")
+        prof = eng.anomaly_profiler
+        out["profiler_captures"] = prof.captures if prof else 0
+        out["profiler_paths"] = list(prof.capture_paths) if prof else []
+        out["profiler_budget_respected"] = (
+            (prof.captures if prof else 0) <= 2)
+        # a REAL artifact landed (xplane.pb under the capture dir)
+        import glob
+        artifacts = glob.glob(os.path.join(profile_dir, "**", "*.pb"),
+                              recursive=True)
+        out["profiler_artifacts"] = len(artifacts)
+        recs = eng.flight.snapshot()
+        out["profile_path_on_record"] = any(
+            r.get("profile_path") for r in recs)
+        anoms = dict(eng.flight.summary()["anomalies"])
+        out["storm_tagged"] = bool(anoms.get("preempt-storm"))
+        await eng.close()
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    out["attribution_ok"] = (
+        out["attr_within_5pct_frac"] >= 0.95
+        and out["attr_residual_p95_frac"] <= 0.10
+        and out["attr_streams_identical"]
+        and out["profiler_captures"] >= 1
+        and out["profiler_budget_respected"]
+        and out["profiler_artifacts"] >= 1
+        and out["profile_path_on_record"])
     return out
 
 
@@ -2302,9 +2499,8 @@ async def autoscale_bench(duration_s: float = 40.0,
         else:
             os.environ["DYN_CONTROL_PLANE"] = old_plane
 
-    def p95(vals):
-        vals = sorted(vals)
-        return vals[min(len(vals) - 1, int(len(vals) * 0.95))] if vals else None
+    def p95(vals):  # None default: autoscale summary omits empty arms
+        return _p95(vals, default=None)
 
     ok = [r for r in results if r.ok]
     lost_tokens = sum(OSL - r.completion_tokens for r in ok)
@@ -2582,6 +2778,24 @@ def main():
         print(json.dumps(out), flush=True)
         raise SystemExit(0 if out["flight_ok"] else 1)
 
+    if "--attribution" in sys.argv:
+        # latency-attribution gates: per-request bucket sums + residual
+        # equal measured e2e, streams bit-identical with attribution on
+        # vs off, and the seeded storm produces one budget-capped
+        # anomaly-triggered profile capture (docs/observability.md
+        # "Attribution")
+        try:
+            out = asyncio.run(attribution_bench(False))
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"attribution": "failed",
+                              "error": repr(e)[:300]}), flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["attribution_ok"] else 1)
+
     if "--autoscale" in sys.argv:
         # closed-loop SLA autoscaling proof: a real operator-managed
         # mocker fleet through a full diurnal cycle with chaos on — prints
@@ -2700,18 +2914,20 @@ def _child_main():
     phases = {p.strip() for p in
               os.environ.get("DYN_BENCH_PHASES",
                              "kernel,spec,e2e,chaos,mem,qos,autoscale,"
-                             "ragged,disagg,migration,onboard,flight,tools"
+                             "ragged,disagg,migration,onboard,flight,"
+                             "tools,attribution"
                              ).split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
                         "autoscale", "ragged", "disagg", "migration",
-                        "onboard", "flight", "tools"}
+                        "onboard", "flight", "tools", "attribution"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
                          f"chaos, mem, qos, autoscale, ragged, disagg, "
-                         f"migration, onboard, flight, tools)")
+                         f"migration, onboard, flight, tools, "
+                         f"attribution)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -2826,6 +3042,15 @@ def _child_main():
                 kern["tools"] = asyncio.run(tools_bench(on_tpu))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["tools_error"] = repr(e)[:200]
+        if "attribution" in phases:
+            # latency-attribution phase: residual falsifiability on the
+            # seeded QoS drive, attribution-on/off stream identity, and
+            # the budget-capped anomaly-triggered profile capture
+            # (ISSUE 14 acceptance)
+            try:
+                kern["attribution"] = asyncio.run(attribution_bench(on_tpu))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["attribution_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

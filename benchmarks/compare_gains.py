@@ -1,0 +1,136 @@
+"""Compare a bench-gains.json against the checked-in bench-baseline.json.
+
+CI runs the mocker-based gains phases every push and uploads the JSON as
+an artifact (``.github/workflows/tier1.yml``); this script turns that
+record into an INFORMATIONAL per-PR annotation stream: the perf
+trajectory the attribution layer explains (docs/observability.md) is
+itself tracked, but a regression annotates the run rather than failing it
+— the tier-1 test step stays the only gate.
+
+Comparison heuristics (documented because they ARE the contract):
+
+- booleans and ``*_ok`` / ``*_identical`` / ``*_tagged`` keys: a
+  true→false flip is a regression (a gate the bench itself computes).
+- ``*tok_s`` (throughput): lower is worse; annotate past ``--tolerance``.
+- ``*_ms`` / ``*_seconds`` (latency): higher is worse, same tolerance.
+- every other shared numeric key: drifted values are listed in the
+  summary but carry no direction (a ratio can legitimately move either
+  way between rounds).
+
+Exit code is 0 unless ``--strict`` is passed (then regressions exit 1).
+Output lines use GitHub workflow commands (``::warning``/``::notice``)
+so they surface as annotations; a markdown table lands in
+``$GITHUB_STEP_SUMMARY`` when set.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _flatten(obj, prefix="") -> dict:
+    out: dict = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_flatten(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(obj, (bool, int, float)):
+        out[prefix] = obj
+    return out
+
+
+def _direction(key: str):
+    """'up'-is-good, 'down'-is-good, or None (no direction). Generic
+    ``_frac`` keys carry no direction (a fraction can name coverage OR
+    cost); only the specific cost/coverage fractions the bench emits are
+    classified — e.g. ``flight_overhead_frac`` is lower-is-better and a
+    blanket up-is-good rule would invert its regression detection."""
+    leaf = key.rsplit(".", 1)[-1]
+    if leaf.endswith("tok_s") or leaf.endswith("within_5pct_frac"):
+        return "up"
+    if (leaf.endswith(("_ms", "_seconds", "_s"))
+            or "overhead" in leaf or "residual" in leaf):
+        return "down"
+    return None
+
+
+def compare(baseline: dict, current: dict, tolerance: float) -> tuple[list, list]:
+    """→ (regressions, drifts): lists of human-readable lines."""
+    base = _flatten(baseline.get("extra") or baseline)
+    cur = _flatten(current.get("extra") or current)
+    regressions, drifts = [], []
+    for key in sorted(set(base) & set(cur)):
+        b, c = base[key], cur[key]
+        if isinstance(b, bool) or isinstance(c, bool):
+            if bool(b) and not bool(c):
+                regressions.append(f"{key}: gate flipped true → false")
+            continue
+        if not b:
+            continue  # zero/absent baseline: no ratio to compare
+        ratio = c / b
+        d = _direction(key)
+        if d == "up" and ratio < 1.0 - tolerance:
+            regressions.append(
+                f"{key}: {b:g} → {c:g} ({(1 - ratio) * 100:.0f}% worse)")
+        elif d == "down" and ratio > 1.0 + tolerance:
+            regressions.append(
+                f"{key}: {b:g} → {c:g} ({(ratio - 1) * 100:.0f}% worse)")
+        elif d is None and abs(ratio - 1.0) > tolerance:
+            drifts.append(f"{key}: {b:g} → {c:g}")
+    missing = sorted(set(base) - set(cur))
+    if missing:
+        drifts.append(f"{len(missing)} baseline keys absent from this run "
+                      f"(first: {missing[:3]})")
+    return regressions, drifts
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default="bench-baseline.json")
+    ap.add_argument("--current", default="bench-gains.json")
+    ap.add_argument("--tolerance", type=float, default=0.30,
+                    help="relative change annotated as regression/drift "
+                         "(default 0.30 — shared-CI-runner noise on "
+                         "sub-second mocker phases is large)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on regressions (default: informational)")
+    args = ap.parse_args()
+
+    def load(path):
+        try:
+            with open(path) as f:
+                return json.loads(f.read().strip().splitlines()[-1])
+        except (OSError, ValueError, IndexError) as e:
+            print(f"::notice::compare_gains: cannot read {path} ({e}); "
+                  "skipping comparison")
+            return None
+
+    baseline, current = load(args.baseline), load(args.current)
+    if baseline is None or current is None:
+        return 0
+    regressions, drifts = compare(baseline, current, args.tolerance)
+    for line in regressions:
+        print(f"::warning title=bench regression vs baseline::{line}")
+    for line in drifts:
+        print(f"::notice title=bench drift::{line}")
+    if not regressions:
+        print(f"::notice::bench gains: no regressions vs "
+              f"{args.baseline} (tolerance {args.tolerance:.0%}, "
+              f"{len(drifts)} undirected drifts)")
+    summary_path = os.environ.get("GITHUB_STEP_SUMMARY")
+    if summary_path:
+        with open(summary_path, "a") as f:
+            f.write("## Bench gains vs baseline\n\n")
+            f.write(f"- regressions: **{len(regressions)}**, drifts: "
+                    f"{len(drifts)} (tolerance {args.tolerance:.0%})\n")
+            for line in regressions:
+                f.write(f"- ⚠️ {line}\n")
+            for line in drifts[:20]:
+                f.write(f"- {line}\n")
+    return 1 if (args.strict and regressions) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

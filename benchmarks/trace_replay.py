@@ -158,7 +158,12 @@ async def replay(url: str, model: str, trace: list[dict], *,
     total_tok = sum(r[1] for r in ok)
 
     def pct(xs, p):
-        return round(1000 * xs[min(int(len(xs) * p), len(xs) - 1)], 1) if xs else None
+        # shared interpolated estimator (observability/stats.quantile) —
+        # the same math the flight summaries and autoscaler use
+        from dynamo_tpu.observability.stats import quantile
+
+        q = quantile(xs, p)
+        return round(1000 * q, 1) if q is not None else None
 
     out = {
         "requests": len(trace), "ok": len(ok),

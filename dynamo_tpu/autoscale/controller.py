@@ -138,6 +138,7 @@ class AutoscaleController:
         self.scale_ups = 0
         self.scale_downs = 0
         self.deferred_for_readiness = 0
+        self.deferred_for_compile = 0
         self.held_for_cooldown = 0
         self.last_result: Optional[TickResult] = None
         self._init_metrics(metrics)
@@ -183,6 +184,9 @@ class AutoscaleController:
             if target is not None:
                 out[cls] = {"ttft_p95_ms": p95, "target_ms": target,
                             "ok": p95 <= target}
+                burn = (fused.slo_burn or {}).get(cls)
+                if burn is not None:
+                    out[cls]["burn"] = burn
         return out
 
     async def tick(self) -> TickResult:
@@ -223,16 +227,52 @@ class AutoscaleController:
         # bound in a disaggregated fleet, so when the prefill dimension is
         # actually scalable it steps too — bumping only decode there would
         # grow the wrong pool forever while the breach persists.
+        #
+        # With the attribution layer's signals present (frontend exports
+        # dynamo_slo_burn_rate{class} / dynamo_slo_breach_compile_share),
+        # the term distinguishes breach CAUSES (docs/observability.md):
+        # - a compile-cliff breach (breached requests' TTFT dominated by
+        #   compile) is deferred — the capacity fix is warmup finishing,
+        #   which the readiness gate already owns; adding replicas would
+        #   stack MORE cold compiles onto the cliff;
+        # - a breach whose class is still inside its error budget
+        #   (burn < 1) is held — one slow interval is not sustained load;
+        # - everything else is a load breach and scales.
+        # Frontends predating the signals report neither gauge, which
+        # keeps the original breach-always-scales behavior.
         breaches = self._breaches(fused)
-        if any(not b["ok"] for b in breaches.values()):
-            if self.applied.decode_replicas + 1 > d:
-                d = self.applied.decode_replicas + 1
-                reason = "slo_breach"
-            cfg = self.planner.cfg
-            if (cfg.max_prefill_replicas > cfg.min_prefill_replicas
-                    and self.applied.prefill_replicas + 1 > p):
-                p = self.applied.prefill_replicas + 1
-                reason = "slo_breach"
+        breached = [cls for cls, b in breaches.items() if not b["ok"]]
+        if breached:
+            burn = fused.slo_burn or {}
+            compile_share = fused.breach_compile_share or {}
+            compile_cliff = [c for c in breached
+                             if compile_share.get(c, 0.0) >= 0.5]
+            load = [c for c in breached
+                    if c not in compile_cliff
+                    and (c not in burn or burn[c] >= 1.0)]
+            if load:
+                if self.applied.decode_replicas + 1 > d:
+                    d = self.applied.decode_replicas + 1
+                    reason = "slo_breach"
+                cfg = self.planner.cfg
+                if (cfg.max_prefill_replicas > cfg.min_prefill_replicas
+                        and self.applied.prefill_replicas + 1 > p):
+                    p = self.applied.prefill_replicas + 1
+                    reason = "slo_breach"
+            elif compile_cliff:
+                reason = "breach_compile_deferred"
+                self.deferred_for_compile += 1
+            else:
+                reason = "breach_within_budget"
+            if not load:
+                # deferred/held is NOT "free to shrink": the pre-burn
+                # behavior blocked scale-down during any active breach
+                # (the breach bump always exceeded the applied fleet),
+                # and removing capacity mid-breach — e.g. while a demand
+                # forecast dips because a compile cliff collapsed
+                # throughput — would deepen the very breach being held
+                p = max(p, self.applied.prefill_replicas)
+                d = max(d, self.applied.decode_replicas)
 
         p, d = self._clamp(p), self._clamp(d)
 
@@ -330,9 +370,11 @@ class AutoscaleController:
             "lastDecision": {"direction": result.direction,
                              "reason": result.reason,
                              "applied": result.applied},
+            "sloBurn": dict(fused.slo_burn or {}),
             "counters": {"ticks": self.ticks, "scaleUps": self.scale_ups,
                          "scaleDowns": self.scale_downs,
                          "deferredUnready": self.deferred_for_readiness,
+                         "deferredCompile": self.deferred_for_compile,
                          "heldCooldown": self.held_for_cooldown,
                          "scrapeFailures": getattr(self.source,
                                                    "scrape_failures", 0)},

@@ -37,6 +37,11 @@ _LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 
 #: frontend histogram family carrying per-class TTFT (frontend/http.py)
 TTFT_CLASS_METRIC = "dynamo_http_ttft_class_seconds"
+#: frontend gauges from the latency-attribution layer
+#: (docs/observability.md "Attribution"): rolling error-budget burn per
+#: class, and the EWMA compile share of breached requests' TTFT
+BURN_RATE_METRIC = "dynamo_slo_burn_rate"
+BREACH_COMPILE_METRIC = "dynamo_slo_breach_compile_share"
 
 
 def parse_class_ttft_buckets(text: str) -> dict[str, dict[float, float]]:
@@ -63,28 +68,39 @@ def parse_class_ttft_buckets(text: str) -> dict[str, dict[float, float]]:
 
 
 def histogram_p95(delta: dict[float, float]) -> Optional[float]:
-    """p95 (seconds) from per-bucket cumulative-count deltas, linearly
-    interpolated inside the crossing bucket (standard histogram_quantile).
-    None when the interval recorded nothing."""
-    bounds = sorted(delta)
-    if not bounds or bounds[-1] != float("inf"):
-        return None
-    total = delta[float("inf")]
-    if total <= 0:
-        return None
-    target = 0.95 * total
-    prev_bound, prev_cum = 0.0, 0.0
-    for b in bounds:
-        cum = delta[b]
-        if cum >= target:
-            if b == float("inf"):
-                return prev_bound  # tail bucket: best lower bound we have
-            if cum == prev_cum:
-                return b
-            frac = (target - prev_cum) / (cum - prev_cum)
-            return prev_bound + frac * (b - prev_bound)
-        prev_bound, prev_cum = b, cum
-    return prev_bound
+    """p95 (seconds) from per-bucket cumulative-count deltas — the shared
+    estimator (observability/stats.py histogram_quantile; one
+    implementation serves this tracker, the flight summaries and the bench
+    percentiles, so the three can never drift apart). None when the
+    interval recorded nothing."""
+    from dynamo_tpu.observability.stats import histogram_quantile
+
+    return histogram_quantile(delta, 0.95)
+
+
+def parse_gauge_by_class(text: Optional[str], metric: str
+                         ) -> dict[str, float]:
+    """``{class: value}`` for one ``<metric>{class="..."} v`` gauge family
+    out of a /metrics exposition (the frontend's burn-rate and
+    breach-cause signals ride the same scrape the TTFT tracker reads)."""
+    out: dict[str, float] = {}
+    if not text:
+        return out
+    for line in text.splitlines():
+        if not line.startswith(metric):
+            continue
+        m = _LINE.match(line.strip())
+        if not m or m.group(1) != metric:
+            continue
+        labels = dict(_LABEL.findall(m.group(2) or ""))
+        cls = labels.get("class") or labels.get("qos")
+        if cls is None:
+            continue
+        try:
+            out[cls] = float(m.group(3))
+        except ValueError:
+            continue
+    return out
 
 
 class ClassTtftTracker:
@@ -134,6 +150,12 @@ class FusedObservation:
     workers: int = 0
     #: per-QoS-class TTFT p95 (ms) over the scrape interval
     ttft_p95_ms: dict = field(default_factory=dict)
+    #: rolling SLO burn rate per class (frontend attribution layer;
+    #: empty when the frontend predates the signal or is idle)
+    slo_burn: dict = field(default_factory=dict)
+    #: EWMA compile share of breached requests' TTFT per class — the
+    #: compile-cliff-vs-load discriminator for the breach term
+    breach_compile_share: dict = field(default_factory=dict)
     #: True when the frontend scrape itself failed this tick (vs idle)
     frontend_down: bool = False
 
@@ -179,6 +201,9 @@ class ObservationFuser:
         fused = FusedObservation(observation=obs, frontend_down=frontend_down)
         text = getattr(self.frontend, "last_text", None)
         fused.ttft_p95_ms = self.ttft_tracker.feed(text)
+        fused.slo_burn = parse_gauge_by_class(text, BURN_RATE_METRIC)
+        fused.breach_compile_share = parse_gauge_by_class(
+            text, BREACH_COMPILE_METRIC)
         if self.aggregator is not None:
             try:
                 agg = self.aggregator.aggregate()
@@ -193,4 +218,8 @@ class ObservationFuser:
             # thread the fleet-depth signal into the planner's Observation
             # so corrections and (future) demand terms can see it
             obs.queue_depth = fused.queue_depth
+            # the burn-rate signal rides the Observation too: the planner's
+            # corrections/demand terms see error-budget consumption, not
+            # just point-in-time latency (docs/autoscaling.md)
+            obs.slo_burn = dict(fused.slo_burn)
         return fused

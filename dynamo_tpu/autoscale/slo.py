@@ -23,6 +23,9 @@ fail loudly at startup, not silently use a default):
 - ``DYN_SLO_BACKLOG_PER_REPLICA`` — reactive term: waiting+swapped depth a
   single replica is allowed to carry before backlog alone forces
   scale-up (0 disables the reactive path).
+- ``DYN_SLO_ERROR_BUDGET`` / ``DYN_SLO_BURN_WINDOW_S`` — burn-rate
+  accounting: allowed breach fraction and its rolling window
+  (dynamo_slo_burn_rate{class}; docs/observability.md "Attribution").
 """
 
 from __future__ import annotations
@@ -72,6 +75,13 @@ class SloConfig:
     #: reactive term: waiting+swapped sequences one replica may carry
     #: before backlog alone forces scale-up (0 = proactive-only)
     backlog_per_replica: float = 8.0
+    #: SLO burn-rate accounting (docs/observability.md "Attribution"):
+    #: allowed breach fraction (the error budget) and the rolling window
+    #: it is measured over. burn = breach_fraction / error_budget; the
+    #: frontend exports dynamo_slo_burn_rate{class} and the controller's
+    #: reactive SLO term keys on burn ≥ 1 when the signal is present.
+    error_budget: float = 0.05
+    burn_window_s: float = 120.0
 
     def __post_init__(self):
         if self.governing_class not in CLASS_RANK:
@@ -90,6 +100,12 @@ class SloConfig:
         if self.backlog_per_replica < 0:
             raise ConfigError(
                 "slo field 'backlog_per_replica': must be >= 0")
+        if not 0.0 < self.error_budget <= 1.0:
+            raise ConfigError(
+                "slo field 'error_budget': must be in (0, 1]")
+        if self.burn_window_s <= 0:
+            raise ConfigError(
+                "slo field 'burn_window_s': must be > 0")
         if self.predictor not in ("constant", "moving_average", "arima",
                                   "seasonal"):
             raise ConfigError(
@@ -164,6 +180,8 @@ class SloConfig:
             adjustment_interval_s=num("DYN_SLO_INTERVAL_S", 10.0),
             predictor=env.get("DYN_SLO_PREDICTOR", "seasonal"),
             backlog_per_replica=num("DYN_SLO_BACKLOG_PER_REPLICA", 8.0),
+            error_budget=num("DYN_SLO_ERROR_BUDGET", 0.05),
+            burn_window_s=num("DYN_SLO_BURN_WINDOW_S", 120.0),
         )
 
     def with_(self, **kw) -> "SloConfig":

@@ -578,10 +578,17 @@ class DecodeWorkerHandler:
             t1 = time.time()
             # reason=restore|onboard distinguishes crash restores from
             # routine admission onboards in `dynctl trace`; the skip cause
-            # (info["reason"]) moves to the ``skip`` attribute
+            # (info["reason"]) moves to the ``skip`` attribute. The
+            # predecessor's flight identity (Migration's restore hint)
+            # rides along so the attribution join stitches the broken
+            # leg's step interval (docs/observability.md "Attribution").
+            hint = req.restore or {}
+            prev = {k: hint[k] for k in
+                    ("prev_worker", "prev_name", "prev_seq", "t_break")
+                    if hint.get(k) is not None}
             get_tracer().record(
                 "kv.restore", ctx, start=t0, end=t1, service="disagg",
-                reason="restore",
+                reason="restore", **prev,
                 **{("skip" if k == "reason" else k): v
                    for k, v in info.items() if v is not None})
             if self._migration_total is not None:

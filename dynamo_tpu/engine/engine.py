@@ -427,6 +427,13 @@ class AsyncJaxEngine:
         )
         self.flight = FlightRecorder(service="engine")
         self._flight_name = register_recorder("engine", self.flight)
+        #: anomaly-triggered bounded jax.profiler capture (None unless
+        #: DYN_PROFILE_ON_ANOMALY names a directory): a slow-step /
+        #: compile-steady flight tag arms one device-trace capture whose
+        #: artifact path lands on the triggering StepRecord
+        #: (observability/profiler.py AnomalyProfiler)
+        from dynamo_tpu.observability.profiler import AnomalyProfiler
+        self.anomaly_profiler = AnomalyProfiler.from_env()
         #: last-seen cumulative totals, differenced into per-step flight
         #: record deltas (preemptions, swap block movement)
         self._flight_last: dict = {}
@@ -518,6 +525,8 @@ class AsyncJaxEngine:
         """EngineFn-compatible async stream of per-token outputs."""
         from dynamo_tpu.observability import get_tracer
 
+        from dynamo_tpu.observability.flight import flight_instance
+
         self._ensure_loop()
         sink: asyncio.Queue = asyncio.Queue()
         seq = await self._new_seq(req, ctx, sink)
@@ -525,9 +534,14 @@ class AsyncJaxEngine:
         self._wake.set()
         # phase timing: queue+prefill until the first token (engine-side
         # TTFT), then the decode loop until finish — recorded as spans on
-        # the request's trace (no-op for trace-less contexts)
+        # the request's trace (no-op for trace-less contexts). The spans
+        # carry this worker's flight identity + the step-seq interval so
+        # the attribution join (observability/attribution.py) can select
+        # exactly the StepRecords that overlapped this request's life.
         tracer = get_tracer()
         t0 = time.time()
+        seq0 = self.flight.seq_now
+        seq_first = None
         t_first = None
         n_tokens = 0
         try:
@@ -539,9 +553,19 @@ class AsyncJaxEngine:
                     raise out  # chaos/step failure: surfaces as StreamError
                 if t_first is None and out.token_ids:
                     t_first = time.time()
+                    seq_first = self.flight.seq_now
                     tracer.record("engine.ttft", ctx, start=t0, end=t_first,
                                   service="engine",
-                                  prompt_tokens=len(req.token_ids))
+                                  prompt_tokens=len(req.token_ids),
+                                  flight_instance=flight_instance(),
+                                  flight_name=self._flight_name,
+                                  seq0=seq0, seq1=seq_first)
+                    # first-frame flight identity: Migration reads it so a
+                    # later re-send's restore hint can name THIS worker as
+                    # the predecessor leg (prev_worker/prev_seq)
+                    out.flight = {"worker": flight_instance(),
+                                  "recorder": self._flight_name,
+                                  "seq": seq_first}
                 n_tokens += len(out.token_ids)
                 yield out
                 if out.finish_reason is not None:
@@ -550,7 +574,10 @@ class AsyncJaxEngine:
             if t_first is not None:
                 tracer.record("engine.decode", ctx, start=t_first,
                               end=time.time(), service="engine",
-                              tokens=n_tokens)
+                              tokens=n_tokens,
+                              flight_instance=flight_instance(),
+                              flight_name=self._flight_name,
+                              seq0=seq_first, seq1=self.flight.seq_now)
 
     # ---------------------------------------------------------- embeddings
 
@@ -1295,6 +1322,8 @@ class AsyncJaxEngine:
         if self._offload_tasks:
             await asyncio.gather(*list(self._offload_tasks),
                                  return_exceptions=True)
+        if self.anomaly_profiler is not None:
+            self.anomaly_profiler.close()  # stop a capture left open
         from dynamo_tpu.observability.flight import unregister_recorder
         unregister_recorder(self._flight_name)
 
@@ -1396,7 +1425,9 @@ class AsyncJaxEngine:
                 padded=padded, dispatch_ms=self._last_dispatch_ms,
                 qos_mix=self._plan_qos_mix(plan),
                 constrained=self._constrained_count(
-                    plan.decode + [w.seq for w in plan.prefill]))
+                    plan.decode + [w.seq for w in plan.prefill]),
+                decode_seqs=plan.decode,
+                prefill_seqs=[w.seq for w in plan.prefill])
             return
         if plan.prefill:
             t0 = time.perf_counter()
@@ -1415,7 +1446,8 @@ class AsyncJaxEngine:
                 prefill_chunks=len(plan.prefill),
                 chunk_tokens=sum(w.chunk for w in plan.prefill),
                 dispatch_ms=self._last_dispatch_ms, starved=0,
-                qos_mix=self._qos_mix_of([w.seq for w in plan.prefill]))
+                qos_mix=self._qos_mix_of([w.seq for w in plan.prefill]),
+                prefill_seqs=[w.seq for w in plan.prefill])
         if plan.decode:
             t0 = time.perf_counter()
             gen0 = sum(s.generated for s in plan.decode)
@@ -1430,7 +1462,8 @@ class AsyncJaxEngine:
                 prefill_chunks=0, chunk_tokens=0,
                 dispatch_ms=self._last_dispatch_ms,
                 qos_mix=self._qos_mix_of(plan.decode),
-                constrained=self._constrained_count(plan.decode))
+                constrained=self._constrained_count(plan.decode),
+                decode_seqs=plan.decode)
 
     def step_trace_summary(self) -> dict:
         """Aggregate the timing ring: per kind, steps / seqs / tokens /
@@ -1515,11 +1548,14 @@ class AsyncJaxEngine:
                        padded: int = 0, dispatch_ms: float = 0.0,
                        qos_mix: Optional[dict] = None,
                        starved: Optional[int] = None,
-                       constrained: int = 0) -> None:
+                       constrained: int = 0,
+                       decode_seqs=None, prefill_seqs=None) -> None:
         """Append one flight record for an executed step: snapshot queue
         depths + tier occupancy, difference the cumulative preempt/swap
-        totals into per-step deltas, and attach a compile staged by
-        ``_note_compile`` during this step's dispatch."""
+        totals into per-step deltas, attach a compile staged by
+        ``_note_compile`` during this step's dispatch, stamp the
+        step↔request-id linkage the attribution join needs, and feed the
+        anomaly-triggered profiler."""
         if not self.flight.enabled:
             return
         sched = self.scheduler
@@ -1541,7 +1577,7 @@ class AsyncJaxEngine:
                 t: v["blocks"] for t, v in self.kv_tier_occupancy().items()}
             self._flight_tiers_t = now
         tiers = self._flight_tiers
-        self.flight.record(
+        rec = self.flight.record(
             kind, wall_ms,
             dispatch_ms=dispatch_ms,
             decode_rows=decode_rows, prefill_chunks=prefill_chunks,
@@ -1554,7 +1590,26 @@ class AsyncJaxEngine:
             starved_decode=(sched.last_starved_decode
                             if starved is None else starved),
             constrained_rows=constrained,
-            kv_tiers=tiers, qos_mix=qos_mix or {})
+            kv_tiers=tiers, qos_mix=qos_mix or {},
+            decode_ids=self._ctx_ids(decode_seqs),
+            prefill_ids=self._ctx_ids(prefill_seqs),
+            starved_ids=(list(sched.last_starved_ids)
+                         if starved is None else []))
+        if self.anomaly_profiler is not None:
+            self.anomaly_profiler.on_record(rec)
+
+    @staticmethod
+    def _ctx_ids(seqs) -> list:
+        """Request ids (Context ids — what traces and attribution key on)
+        of the step's sequences; context-less seqs contribute nothing."""
+        if not seqs:
+            return []
+        out = []
+        for s in seqs:
+            rid = getattr(s.ctx, "id", None)
+            if rid:
+                out.append(rid)
+        return out
 
     @staticmethod
     def _qos_mix_of(seqs) -> dict:
@@ -2595,7 +2650,8 @@ class AsyncJaxEngine:
             "decode_pipe", len(handle["seqs"]), n, wall))
         self._flight_record(
             "decode_pipe", wall, decode_rows=n, prefill_chunks=0,
-            chunk_tokens=0, starved=0, constrained=constrained)
+            chunk_tokens=0, starved=0, constrained=constrained,
+            decode_seqs=handle["seqs"])
 
     async def _run_decode_pipelined(self, seqs: list[SeqState]) -> bool:
         """Depth-2 software pipeline over single-step decode.

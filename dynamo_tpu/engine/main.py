@@ -524,6 +524,15 @@ async def amain():
         "replication); the worker reports warmed_up=false until its first "
         "served step").add_callback(
         lambda: {None: int(engine.warmup_skipped)})
+    # flight-ring completeness (docs/observability.md "Attribution"):
+    # records evicted before ANY fleet query served them — when this
+    # moves, attribution over old intervals flags incomplete=true and the
+    # right fix is a bigger DYN_FLIGHT_CAPACITY or tighter polling
+    runtime.metrics.counter(
+        "flight_records_dropped_total",
+        "step records evicted from the flight ring before ever being "
+        "served to a fleet query").add_callback(
+        lambda: {None: engine.flight.records_dropped_total})
 
     # KV tier occupancy G1–G4 (docs/observability.md "Flight recorder"):
     # the hierarchy PRs 10–11 built, finally visible to Prometheus and

@@ -211,6 +211,10 @@ class Scheduler:
         #: token budget), i.e. a budget-starved decode — QoS sit-out sheds
         #: are deliberate policy and are NOT counted here
         self.last_starved_decode = 0
+        #: the starved rows' request (Context) ids — the flight record's
+        #: step↔request linkage, so attribution can charge the stall to
+        #: the request that actually sat out (observability/attribution.py)
+        self.last_starved_ids: list = []
 
     # -- api ----------------------------------------------------------------
 
@@ -299,6 +303,9 @@ class Scheduler:
         still_ready = [s for s in ready_decode if s in self.running]
         plan.decode = still_ready[:row_cap]
         self.last_starved_decode = len(still_ready) - len(plan.decode)
+        self.last_starved_ids = [
+            rid for s in still_ready[row_cap:]
+            if (rid := getattr(s.ctx, "id", None))]
         budget -= len(plan.decode)
 
         if self.args.enable_chunked_prefill or not plan.decode:

@@ -210,6 +210,53 @@ class HttpService:
         self._tenant_tokens = self.metrics.counter(
             "tenant_completion_tokens_total",
             "completion tokens served by tenant/class")
+        # latency attribution surfaces (docs/observability.md
+        # "Attribution"): rolling SLO error-budget burn per class, the
+        # compile-share of breached requests (the autoscaler's compile-
+        # cliff-vs-load discriminator), and the fleet breakdown histograms
+        # fed from per-request attribution joins (the /v1/attribution
+        # route + the optional DYN_ATTR_FEED_S background sampler)
+        from dynamo_tpu.autoscale.slo import SloConfig
+        from dynamo_tpu.observability.attribution import (BreachCauseEwma,
+                                                          SloBurnTracker)
+
+        self.slo = SloConfig.load()
+        self._burn = SloBurnTracker(self.slo)
+        self._breach_cause = BreachCauseEwma()
+        self._burn_gauge = self.metrics.gauge(
+            "slo_burn_rate",
+            "rolling SLO error-budget burn rate by QoS class "
+            "(breach fraction over DYN_SLO_BURN_WINDOW_S / "
+            "DYN_SLO_ERROR_BUDGET; 1.0 = budget consumed exactly at the "
+            "sustainable rate)")
+        self._breach_compile_gauge = self.metrics.gauge(
+            "slo_breach_compile_share",
+            "EWMA compile share of breached requests' TTFT by class "
+            "(from sampled attributions)")
+        self._ttft_breakdown = self.metrics.histogram(
+            "ttft_breakdown_seconds",
+            "per-request TTFT decomposition by attribution phase and "
+            "QoS class")
+        self._itl_breakdown = self.metrics.histogram(
+            "itl_breakdown_seconds",
+            "per-request ITL decomposition by attribution phase and "
+            "QoS class")
+        #: recently finished / recently breached request ids for the
+        #: background attribution sampler (newest kept, bounded)
+        from collections import deque
+        self._attr_done: deque = deque(maxlen=64)
+        self._attr_breached: deque = deque(maxlen=64)
+        #: request ids already folded into the breakdown histograms —
+        #: feeding is once-per-request, or an operator watch-looping
+        #: /v1/attribution on one breached id would drag the class's
+        #: compile-share EWMA (the autoscaler's breach-cause signal)
+        #: toward that single request
+        self._attr_fed: deque = deque(maxlen=256)
+        self._attr_fed_set: set = set()
+        #: classes whose burn gauge has ever been exported — idle ones
+        #: keep refreshing to the window-trimmed value (→ 0.0) at scrape
+        self._burn_exported: set = set()
+        self._attr_task: Optional[asyncio.Task] = None
 
     @property
     def tracer(self):
@@ -321,6 +368,84 @@ class HttpService:
         rate (completions/s), clamped to [1, 30]; 1 with no signal yet."""
         return self._drain_rate.retry_after_s(backlog)
 
+    # -- latency attribution / SLO burn (docs/observability.md) -----------
+
+    def _note_slo(self, ctx, ttft_s: float) -> None:
+        """Fold one first-token latency into the burn-rate ledger and
+        refresh the class's gauge; breached requests queue for the
+        attribution sampler so the breach CAUSE gets measured too."""
+        cls = ctx.priority or "standard"
+        self._burn.note(cls, ttft_s)  # O(1); gauges refresh at scrape
+        target = self.slo.slo_for(cls).ttft_p95_ms
+        if target is not None and ttft_s * 1000.0 > target:
+            self._attr_breached.append(ctx.id)
+
+    def _refresh_slo_gauges(self) -> None:
+        """Re-export burn + breach-cause for EVERY class ever seen — at
+        /metrics scrape time (every consumer reads the scrape: the
+        fuser, `dynctl autoscale`, burn alerting), so a class that goes
+        idle decays to 0 with its rolling window instead of freezing its
+        last (possibly extreme) value on the gauge, and the hot SSE path
+        pays only the O(1) ledger note."""
+        rates = self._burn.rates()
+        for c in self._burn_exported | set(rates):
+            self._burn_gauge.set(rates.get(c, 0.0), **{"class": c})
+        self._burn_exported |= set(rates)
+        # same staleness rule for the compile share — an expired entry
+        # reads 0.0, so yesterday's compile cliff can't classify today's
+        # pure load breach as compile-dominated and latch the controller
+        # into breach_compile_deferred while the SLO burns
+        for c, share in self._breach_cause.shares().items():
+            self._breach_compile_gauge.set(share, **{"class": c})
+
+    def feed_attribution(self, doc: dict) -> None:
+        """Aggregate one attribution document into the fleet breakdown
+        histograms (+ the breach-cause EWMA when the request breached its
+        class target). Called by the /v1/attribution route and the
+        background sampler — both surfaces feed the same series, and a
+        request feeds AT MOST ONCE however often it is queried."""
+        rid = doc.get("request_id")
+        if rid in self._attr_fed_set:
+            return
+        if len(self._attr_fed) == self._attr_fed.maxlen:
+            self._attr_fed_set.discard(self._attr_fed[0])
+        self._attr_fed.append(rid)
+        self._attr_fed_set.add(rid)
+        qos = doc.get("qos") or "standard"
+        for phase, ms in (doc.get("ttft") or {}).items():
+            self._ttft_breakdown.observe(ms / 1000.0, phase=phase, qos=qos)
+        for phase, ms in (doc.get("itl") or {}).items():
+            self._itl_breakdown.observe(ms / 1000.0, phase=phase, qos=qos)
+        target = self.slo.slo_for(qos).ttft_p95_ms
+        if target is not None and (doc.get("ttft_ms") or 0.0) > target:
+            self._breach_cause.note(doc)
+            for cls, share in self._breach_cause.shares().items():
+                self._breach_compile_gauge.set(share, **{"class": cls})
+
+    async def _attr_feed_loop(self, interval_s: float) -> None:
+        """Background sampler (DYN_ATTR_FEED_S > 0): every interval,
+        attribute ONE recent request — breached ones first — and feed the
+        histograms. Bounded cost by construction: one fan-out per
+        interval, never per request."""
+        from dynamo_tpu.observability.attribution import gather_attribution
+
+        while True:
+            await asyncio.sleep(interval_s)
+            rid = None
+            if self._attr_breached:
+                rid = self._attr_breached.pop()
+            elif self._attr_done:
+                rid = self._attr_done.pop()
+            if rid is None:
+                continue
+            try:
+                doc = await gather_attribution(rid, runtime=self.runtime)
+                if doc is not None:
+                    self.feed_attribution(doc)
+            except Exception:
+                logger.debug("attribution feed failed for %s", rid,
+                             exc_info=True)
+
     def _qos_admission(self, route: str, model: str, tenant: str, cls: str,
                        cost_tokens: float) -> Optional[web.Response]:
         """Per-tenant quota check (BEFORE the shared caps, so one tenant's
@@ -421,6 +546,9 @@ class HttpService:
         self._completion_tokens.inc(usage.get("completion_tokens", 0) or 0,
                                     model=model)
         self._finished.inc(model=model)
+        if ctx is not None:
+            # candidate for the background attribution sampler
+            self._attr_done.append(ctx.id)
         if ctx is not None and ctx.tenant is not None:
             self._tenant_tokens.inc(
                 usage.get("completion_tokens", 0) or 0,
@@ -442,6 +570,10 @@ class HttpService:
         # fleet flight-recorder fan-out (docs/observability.md "Flight
         # recorder"): per-worker step timelines + anomaly summaries
         app.router.add_get("/v1/fleet/steps", self.handle_fleet_steps)
+        # per-request latency attribution (docs/observability.md
+        # "Attribution"): spans ⊕ flight records → named-cause breakdown
+        app.router.add_get("/v1/attribution/{request_id}",
+                           self.handle_attribution)
         # admin: flush every worker's KV cache/prefix state (ref:
         # lib/llm/src/http/service/clear_kv_blocks.rs)
         app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
@@ -464,9 +596,26 @@ class HttpService:
             self.port = site._server.sockets[0].getsockname()[1]
         logger.info("OpenAI HTTP%s frontend on %s:%d",
                     "S" if ssl_ctx else "", self.host, self.port)
+        # optional continuous attribution sampling (off by default: the
+        # on-demand /v1/attribution route and dynctl why need no loop)
+        feed_s = 0.0
+        try:
+            feed_s = float(os.environ.get("DYN_ATTR_FEED_S", "0") or 0)
+        except ValueError:
+            logger.warning("ignoring malformed DYN_ATTR_FEED_S")
+        if feed_s > 0:
+            self._attr_task = asyncio.get_running_loop().create_task(
+                self._attr_feed_loop(feed_s))
         return self.port
 
     async def stop(self):
+        if self._attr_task is not None:
+            self._attr_task.cancel()
+            try:
+                await self._attr_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._attr_task = None
         if self._runner:
             await self._runner.cleanup()
 
@@ -558,6 +707,7 @@ class HttpService:
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         self._refresh_router_metrics()
+        self._refresh_slo_gauges()
         # merged exposition: HTTP registry + the tracer's SLO registry
         # (dynamo_ttft_seconds / dynamo_itl_seconds / dynamo_e2e_seconds /
         # dynamo_phase_seconds{phase=...}) with duplicate headers dropped
@@ -620,14 +770,16 @@ class HttpService:
 
         try:
             n = int(request.query.get("n", "0"))
+            since = int(request.query.get("since", "0"))
         except ValueError:
             return web.json_response(
-                error_body("query param 'n' must be an integer",
+                error_body("query params 'n'/'since' must be integers",
                            "bad_request", 400), status=400)
         workers: dict = {}
         if self.runtime is not None:
             try:
-                workers = await fetch_fleet_steps(self.runtime.plane, n=n)
+                workers = await fetch_fleet_steps(self.runtime.plane, n=n,
+                                                  since=since)
             except Exception:
                 logger.exception("fleet step fan-out failed")
         else:
@@ -639,11 +791,59 @@ class HttpService:
 
             for name, rec in recorders().items():
                 entry = {"summary": rec.summary()}
-                if n > 0:
-                    entry["steps"] = rec.snapshot(n)
+                if n > 0 or since > 0:
+                    entry["steps"] = rec.snapshot(n if n > 0 else None,
+                                                  since=since)
                 workers[f"local/{name}"] = entry
         return web.json_response({"workers": workers,
                                   "count": len(workers)})
+
+    async def handle_attribution(self, request: web.Request) -> web.Response:
+        """GET /v1/attribution/{request_id} — the critical-path
+        decomposition: the request's spans joined with the serving
+        workers' StepRecords, every millisecond bucketed into a named
+        cause + an explicit unattributed residual
+        (docs/observability.md "Attribution").
+
+        Head-sampled-out traces degrade to a flight-only decomposition
+        with ``trace_sampled: false`` — never a 404 just because
+        DYN_TRACE_SAMPLE was on; 404 only when nothing anywhere mentions
+        the id."""
+        from dynamo_tpu.observability.attribution import gather_attribution
+
+        rid = request.match_info["request_id"]
+        try:
+            records = int(request.query.get("records", "2048"))
+        except ValueError:
+            return web.json_response(
+                error_body("query param 'records' must be an integer",
+                           "bad_request", 400), status=400)
+        try:
+            doc = await gather_attribution(rid, runtime=self.runtime,
+                                           records=records)
+        except Exception:
+            logger.exception("attribution join failed")
+            return web.json_response(
+                error_body("attribution join failed", "internal_error",
+                           500), status=500)
+        if doc is None:
+            from dynamo_tpu.observability import (trace_sample_rate,
+                                                  trace_sampled)
+
+            rate = trace_sample_rate()
+            reason = "no spans or step records mention this request id"
+            if rate < 1.0 and not trace_sampled(rid, rate):
+                reason += (f" (and it was not head-sampled at "
+                           f"DYN_TRACE_SAMPLE={rate:g}; flight-only "
+                           "attribution needs the request inside the "
+                           "step ring window)")
+            return web.json_response(
+                error_body(f"no attribution for '{rid}': {reason}",
+                           "attribution_not_found", 404), status=404)
+        # every served decomposition also feeds the fleet breakdown
+        # histograms — debugging traffic and sampling share one series
+        self.feed_attribution(doc)
+        return web.json_response(doc)
 
     def _refresh_router_metrics(self) -> None:
         """Snapshot per-model KV-router stream health into gauges at scrape
@@ -922,6 +1122,7 @@ class HttpService:
                                 self._ttft.observe(dt, route="responses")
                                 self._ttft_class.observe(
                                     dt, qos=ctx.priority or "standard")
+                                self._note_slo(ctx, dt)
                             parts.append(delta)
                             buf += record("response.output_text.delta", {
                                 "type": "response.output_text.delta",
@@ -1141,6 +1342,7 @@ class HttpService:
                         self._ttft.observe(dt, route=route)
                         self._ttft_class.observe(
                             dt, qos=ctx.priority or "standard")
+                        self._note_slo(ctx, dt)
                     data = ann.data
                     if isinstance(data, dict) and "usage" in data:
                         # the pipeline always attaches final-chunk usage for

@@ -549,6 +549,12 @@ class Migration:
         budget = self.migration_limit if req.backend_instance_id is None else 0
         attempt = 0
         current = req
+        # flight identity of the worker currently serving (first frame of
+        # each leg carries it): a re-send's restore hint names it as the
+        # PREDECESSOR so latency attribution stitches both legs' step
+        # intervals instead of writing leg 1 off as unattributed
+        # (docs/observability.md "Attribution")
+        last_flight: Optional[dict] = None
         while True:
             try:
                 async for out in self.downstream(current, ctx):
@@ -557,6 +563,8 @@ class Migration:
                         continue
                     if isinstance(out, dict):
                         out = LLMEngineOutput.from_wire(out)
+                    if out.flight is not None:
+                        last_flight = out.flight
                     accumulated.extend(out.token_ids)
                     yield out
                     if out.finish_reason is not None:
@@ -624,10 +632,19 @@ class Migration:
                     # stateful migration (docs/robustness.md): mark the
                     # re-send so the router can attach a KV-restore plan
                     # and the receiving worker can rebuild the recoverable
-                    # prefix from surviving peers instead of re-prefilling
+                    # prefix from surviving peers instead of re-prefilling.
+                    # prev_* carries the broken leg's flight identity +
+                    # step seq for the attribution stitch; t_break (epoch)
+                    # bounds that leg's wall-clock interval.
                     restore={"emitted": len(accumulated),
-                             "attempt": attempt},
+                             "attempt": attempt,
+                             **({"prev_worker": last_flight["worker"],
+                                 "prev_name": last_flight.get("recorder"),
+                                 "prev_seq": last_flight.get("seq"),
+                                 "t_break": time.time()}
+                                if last_flight else {})},
                 )
+                last_flight = None  # the next leg announces itself afresh
                 await asyncio.sleep(delay)
 
 

@@ -257,10 +257,15 @@ class MockEngine:
         self._wake.set()
         # same engine-side phase spans the real engine records, so the
         # mock path yields a full stitched trace in accelerator-less tests
+        # — including the flight identity + step-seq interval attributes
+        # the attribution join keys on (observability/attribution.py)
         from dynamo_tpu.observability import get_tracer
+        from dynamo_tpu.observability.flight import flight_instance
 
         tracer = get_tracer()
         t0 = time.time()
+        seq0 = self.flight.seq_now
+        seq_first = None
         t_first = None
         n_tokens = 0
         try:
@@ -272,10 +277,19 @@ class MockEngine:
                     raise out  # chaos step failure → retryable stream error
                 if t_first is None and out.token_ids:
                     t_first = time.time()
+                    seq_first = self.flight.seq_now
                     tracer.record("engine.ttft", ctx, start=t0, end=t_first,
                                   service="engine",
                                   prompt_tokens=len(req.token_ids),
-                                  cached_tokens=seq.cached_tokens)
+                                  cached_tokens=seq.cached_tokens,
+                                  flight_instance=flight_instance(),
+                                  flight_name=getattr(
+                                      self, "_flight_name", "mocker"),
+                                  seq0=seq0, seq1=seq_first)
+                    out.flight = {"worker": flight_instance(),
+                                  "recorder": getattr(
+                                      self, "_flight_name", "mocker"),
+                                  "seq": seq_first}
                 n_tokens += len(out.token_ids)
                 yield out.to_wire()
                 if out.finish_reason is not None:
@@ -284,7 +298,11 @@ class MockEngine:
             if t_first is not None:
                 tracer.record("engine.decode", ctx, start=t_first,
                               end=time.time(), service="engine",
-                              tokens=n_tokens)
+                              tokens=n_tokens,
+                              flight_instance=flight_instance(),
+                              flight_name=getattr(
+                                  self, "_flight_name", "mocker"),
+                              seq0=seq_first, seq1=self.flight.seq_now)
 
     # -- engine loop -------------------------------------------------------
     async def _engine_loop(self):
@@ -413,7 +431,13 @@ class MockEngine:
             constrained_rows=sum(1 for s in self.running
                                  if s.guided is not None
                                  and not s.in_prefill and not s.finished),
-            kv_tiers={"g1": self.cache.used_blocks})
+            kv_tiers={"g1": self.cache.used_blocks},
+            # step↔request linkage parity (attribution join): the mocker's
+            # request_id IS the Context id
+            decode_ids=[s.request_id for s in self.running
+                        if not s.in_prefill and s.finished is None],
+            prefill_ids=[s.request_id for s in self.running
+                         if s.in_prefill])
 
     def _admit(self):
         while self.waiting and len(self.running) < self.args.max_num_seqs:

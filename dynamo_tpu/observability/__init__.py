@@ -33,9 +33,17 @@ from dynamo_tpu.observability.flight import (
     ensure_flight_endpoint,
     fetch_fleet_steps,
     flight_enabled,
+    flight_instance,
     register_recorder,
     serve_flight,
 )
+from dynamo_tpu.observability.attribution import (
+    BUCKETS,
+    SloBurnTracker,
+    attribute,
+    gather_attribution,
+)
+from dynamo_tpu.observability.stats import histogram_quantile, quantile
 
 __all__ = [
     "CURRENT_SPAN", "Span", "Tracer", "configure_tracer", "get_tracer",
@@ -43,5 +51,7 @@ __all__ = [
     "TRACER_PREFIX", "ensure_trace_endpoint", "fetch_trace", "serve_traces",
     "FLIGHT_PREFIX", "FlightRecorder", "StepRecord",
     "ensure_flight_endpoint", "fetch_fleet_steps", "flight_enabled",
-    "register_recorder", "serve_flight",
+    "flight_instance", "register_recorder", "serve_flight",
+    "BUCKETS", "SloBurnTracker", "attribute", "gather_attribution",
+    "histogram_quantile", "quantile",
 ]

@@ -1,0 +1,606 @@
+"""Per-request latency attribution: spans ⊕ flight records → named causes.
+
+The trace view (tracing.py spans) and the step view (flight.py
+StepRecords) were disjoint: a span says ``engine.decode`` took 900 ms, a
+StepRecord says step 4812 was tagged ``preempt-storm``, and nobody joined
+them. This module is that join — the critical-path decomposition behind
+``GET /v1/attribution/{request_id}`` and ``dynctl why`` (ref: the Dynamo
+stack's per-request latency decomposition pillar; Sheng et al. OSDI'24 on
+per-class latency accounting as the basis of debuggable QoS policy).
+
+Every wall-clock millisecond of a request's life is bucketed into a named
+cause; whatever no evidence covers lands in an explicit ``unattributed``
+residual, so the decomposition is FALSIFIABLE: buckets + residual always
+sum to the measured window (a wrong join shows up as a fat residual, not
+as silently mis-labeled time).
+
+Join semantics (docs/observability.md "Attribution"):
+
+1. The request's spans give the measured windows (e2e from
+   ``http.request``; the TTFT/ITL boundary from the frontend ``ttft``
+   span) and the span-evidenced buckets (tokenize → frontend, router.* →
+   routing, kv.transfer / kv.restore / prefill.extract → kv_transfer,
+   prefill.queue_wait → queue_wait).
+2. The ``engine.ttft`` / ``engine.decode`` spans carry the serving
+   worker's recorder identity (``flight_instance``/``flight_name``) and
+   step-seq interval, matching them to that worker's StepRecords.
+3. Inside an engine window, StepRecords refine the time: steps whose
+   ``prefill_ids``/``decode_ids`` carry this request are compute (their
+   ``compile_s`` head is compile); steps that do NOT carry it explain the
+   stall — ``empty`` → scheduler bubble, preempting steps → preempt/swap
+   stall, ``starved_ids`` naming the request → budget-starved, a compile
+   → compile, anything else → queue wait (serving someone else).
+4. Overlaps resolve by evidence priority (a sweep over the timeline — no
+   instant is counted twice); uncovered time is ``unattributed``.
+
+A migrated request's legs stitch through the restore hint
+(``prev_worker``/``prev_seq``, stamped by Migration and recorded on the
+new worker's ``kv.restore`` span) plus the step↔request-id linkage; a
+ring that wrapped over the interval flags ``incomplete=true`` instead of
+quietly attributing the gap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger("dynamo.observability.attribution")
+
+#: the bucket taxonomy (docs/observability.md) — ordered for display
+BUCKETS = (
+    "frontend",        # tokenize/preprocess + HTTP edge work
+    "routing",         # router.schedule + onboard/restore planning
+    "queue_wait",      # waiting for engine capacity (incl. prefill queue)
+    "kv_transfer",     # disagg transfer, restore/onboard pulls, extract
+    "compile",         # XLA traces blocking the serving step
+    "prefill_compute", # steps computing this request's prompt chunks
+    "decode_compute",  # steps decoding this request's rows
+    "sched_bubble",    # empty-step wall: work existed, nothing runnable
+    "preempt_stall",   # preempt/swap traffic blocking the engine
+    "budget_starved",  # ready decode rows shed by the token budget
+    "unattributed",    # the falsifiability residual
+)
+
+#: span name → (bucket, priority). Higher priority wins the sweep; the
+#: request's OWN evidence (its compute steps, its transfer spans) outranks
+#: circumstantial stall evidence, which outranks generic waiting.
+_SPAN_BUCKETS = {
+    "preprocess.tokenize": ("frontend", 6),
+    "router.schedule": ("routing", 6),
+    "router.onboard_plan": ("routing", 6),
+    "router.restore_plan": ("routing", 6),
+    "prefill.queue_wait": ("queue_wait", 3),
+    "kv.transfer": ("kv_transfer", 7),
+    "kv.restore": ("kv_transfer", 7),
+    "prefill.extract": ("kv_transfer", 7),
+}
+
+_PRIO_COMPILE = 9
+_PRIO_COMPUTE = 8
+_PRIO_PREEMPT = 5
+_PRIO_STARVED = 5
+_PRIO_BUBBLE = 4
+_PRIO_OTHER_STEP = 2   # engine busy serving someone else → queue_wait
+
+#: evidence records kept per stall bucket in the response (newest kept)
+_EVIDENCE_CAP = 12
+
+
+def _rec_interval(rec: dict) -> tuple[float, float]:
+    end = float(rec.get("t") or 0.0)
+    return end - float(rec.get("wall_ms") or 0.0) / 1000.0, end
+
+
+def _span_window(s: dict) -> Optional[tuple[float, float]]:
+    start, end = s.get("start"), s.get("end")
+    if start is None or end is None or end < start:
+        return None
+    return float(start), float(end)
+
+
+class _Segments:
+    """Candidate attributions + the priority sweep that resolves them."""
+
+    def __init__(self, t0: float, t1: float):
+        self.t0, self.t1 = t0, t1
+        self._segs: list[tuple[float, float, str, int]] = []
+
+    def add(self, start: float, end: float, bucket: str, prio: int) -> None:
+        start, end = max(start, self.t0), min(end, self.t1)
+        if end > start:
+            self._segs.append((start, end, bucket, prio))
+
+    def sweep(self, boundary: Optional[float]) -> tuple[dict, dict]:
+        """→ (phase1_ms, phase2_ms): per-bucket milliseconds before and
+        after ``boundary`` (None → everything lands in phase1). Each
+        elementary interval goes to its highest-priority covering segment;
+        uncovered time goes to ``unattributed``. By construction the two
+        dicts sum exactly to the window length.
+
+        Event-driven: segment endpoints are sorted once and a max-heap of
+        active segments (lazily pruned) answers "who owns this interval"
+        — O((S + points)·log S), never the O(S × points) rescan a fleet-
+        sized record fetch would turn into an event-loop stall."""
+        import heapq
+
+        events: list[tuple[float, int, int]] = []  # (t, kind 0=start/1=end, idx)
+        for i, (s, e, _, _) in enumerate(self._segs):
+            events.append((s, 0, i))
+            events.append((e, 1, i))
+        events.append((self.t1, 1, -1))
+        if boundary is not None and self.t0 < boundary < self.t1:
+            events.append((boundary, 1, -1))
+        events.sort()
+        out1: dict = collections.defaultdict(float)
+        out2: dict = collections.defaultdict(float)
+        heap: list[tuple[int, int]] = []   # (-prio, idx), lazy-deleted
+        ended: set[int] = set()
+        prev = self.t0
+        for t, kind, idx in events:
+            if t > prev:
+                while heap and heap[0][1] in ended:
+                    heapq.heappop(heap)
+                bucket = (self._segs[heap[0][1]][2] if heap
+                          else "unattributed")
+                mid = (prev + t) / 2.0
+                target = (out1 if (boundary is None or mid < boundary)
+                          else out2)
+                target[bucket] += (t - prev) * 1000.0
+                prev = t
+            if kind == 0:
+                heapq.heappush(heap, (-self._segs[idx][3], idx))
+            elif idx >= 0:
+                ended.add(idx)
+        return dict(out1), dict(out2)
+
+
+def _match_worker(workers: dict, instance: Optional[str],
+                  name: Optional[str]) -> Optional[str]:
+    """Fleet key of the worker entry whose summary instance matches."""
+    if not instance:
+        return None
+    for key, entry in workers.items():
+        summ = (entry or {}).get("summary") or {}
+        if summ.get("instance") == instance:
+            if not name or key.rsplit("/", 1)[-1].startswith(str(name)):
+                return key
+    # older peers whose summaries predate the instance field: fall back to
+    # the recorder name when it names exactly one such worker. Workers that
+    # DO report an instance are excluded — a mismatch there means "not this
+    # worker", not "identity unknown".
+    if name:
+        hits = [k for k in workers
+                if k.rsplit("/", 1)[-1] == name
+                and not (((workers[k] or {}).get("summary") or {})
+                         .get("instance"))]
+        if len(hits) == 1:
+            return hits[0]
+    return None
+
+
+#: longest inter-step gap attributed to the FOLLOWING step's cause —
+#: past this, the gap is something the records genuinely don't explain
+#: (it stays unattributed, which is the point of the residual)
+_GAP_CAP_S = 0.100
+
+
+def _step_bucket(rid: str, rec: dict) -> tuple[str, int, bool]:
+    """(bucket, priority, is_own_work) classification of one StepRecord
+    relative to the request."""
+    if rid in (rec.get("prefill_ids") or ()):
+        return "prefill_compute", _PRIO_COMPUTE, True
+    if rid in (rec.get("decode_ids") or ()):
+        return "decode_compute", _PRIO_COMPUTE, True
+    if rec.get("kind") == "empty":
+        return "sched_bubble", _PRIO_BUBBLE, False
+    if rec.get("preempt_swap") or rec.get("preempt_recompute"):
+        return "preempt_stall", _PRIO_PREEMPT, False
+    if rid in (rec.get("starved_ids") or ()):
+        return "budget_starved", _PRIO_STARVED, False
+    # the engine was busy serving other requests: queue wait
+    return "queue_wait", _PRIO_OTHER_STEP, False
+
+
+def _add_step_segments(segs: "_Segments", rid: str, steps: list[dict],
+                       window: tuple[float, float], evidence: dict,
+                       seq_range: Optional[tuple[int, int]] = None) -> None:
+    """Refine one worker's engine window with its StepRecords.
+
+    ``seq_range=(seq0, seq1)`` — the engine span's recorder-seq interval
+    — clips the selection to the steps that actually ran during the
+    window (records with ``seq0 < seq <= seq1``); wall-clock overlap
+    alone would smear a neighboring window's boundary step in.
+
+    ``wall_ms`` covers a step's execution; the host time BETWEEN steps
+    (scheduler planning, commit/emit bookkeeping, loop turns) belongs to
+    whatever the engine did next, so each inter-step gap (bounded by
+    ``_GAP_CAP_S``) is attributed to the FOLLOWING step's bucket at one
+    priority lower — real in-step evidence always outranks it, and gaps
+    the records cannot vouch for stay in the residual."""
+    w0, w1 = window
+    prev_end: Optional[float] = None
+    for rec in sorted(steps, key=lambda r: r.get("seq") or 0):
+        r0, r1 = _rec_interval(rec)
+        if r1 <= w0 or r0 >= w1:
+            if r1 <= w0:
+                prev_end = max(prev_end or r1, r1)
+            continue
+        if seq_range is not None:
+            seq = int(rec.get("seq") or 0)
+            if not seq_range[0] < seq <= seq_range[1]:
+                # outside the span's step interval: not this window's
+                # work, but its execution still explains the timeline —
+                # advance the gap watermark so no phantom gap appears
+                prev_end = max(prev_end or r1, r1)
+                continue
+        bucket, prio, mine = _step_bucket(rid, rec)
+        compile_s = float(rec.get("compile_s") or 0.0)
+        if compile_s > 0:
+            # the compile head of the step blocks everyone, the request
+            # included — own steps and others' alike
+            segs.add(r0, min(r1, r0 + compile_s), "compile", _PRIO_COMPILE)
+            _note_evidence(evidence, "compile", rec)
+        if not mine and bucket != "queue_wait":
+            _note_evidence(evidence, bucket, rec)
+        segs.add(r0, r1, bucket, prio)
+        if prev_end is not None and 0 < r0 - prev_end <= _GAP_CAP_S:
+            segs.add(prev_end, r0, bucket, max(1, prio - 1))
+        prev_end = max(prev_end or r1, r1)
+
+
+def _note_evidence(evidence: dict, bucket: str, rec: dict) -> None:
+    lst = evidence.setdefault(bucket, [])
+    lst.append({k: rec[k] for k in
+                ("seq", "kind", "wall_ms", "tags", "compile_sig",
+                 "preempt_swap", "preempt_recompute", "profile_path")
+                if rec.get(k)})
+    if len(lst) > _EVIDENCE_CAP:
+        del lst[0]
+
+
+def _steps_of(entry: dict) -> list[dict]:
+    return (entry or {}).get("steps") or []
+
+
+def attribute(request_id: str, spans: list[dict], workers: dict,
+              trace_sampled: bool = True) -> Optional[dict]:
+    """The pure join: span dicts + ``fetch_fleet_steps``-shaped worker
+    entries → the decomposition document (None when there is NOTHING —
+    no spans and no step carrying the request id)."""
+    spans = [s for s in spans or [] if _span_window(s) is not None]
+    workers = workers or {}
+    evidence: dict = {}
+    incomplete = False
+
+    # ---- measured windows -------------------------------------------------
+    root = next((s for s in spans if s.get("name") == "http.request"), None)
+    if root is None and spans:
+        t0 = min(_span_window(s)[0] for s in spans)
+        t1 = max(_span_window(s)[1] for s in spans)
+    elif root is not None:
+        t0, t1 = _span_window(root)
+    else:
+        return _flight_only(request_id, workers, evidence)
+    ttft_span = next((s for s in spans if s.get("name") == "ttft"), None)
+    if ttft_span is not None:
+        boundary = _span_window(ttft_span)[1]
+    else:
+        eng = next((s for s in spans if s.get("name") == "engine.ttft"),
+                   None)
+        boundary = _span_window(eng)[1] if eng is not None else None
+
+    segs = _Segments(t0, t1)
+    qos = None
+    if root is not None:
+        qos = (root.get("attributes") or {}).get("qos")
+
+    # ---- span-evidenced buckets ------------------------------------------
+    for s in spans:
+        mapped = _SPAN_BUCKETS.get(s.get("name"))
+        if mapped is None:
+            continue
+        bucket, prio = mapped
+        w = _span_window(s)
+        segs.add(w[0], w[1], bucket, prio)
+
+    # ---- engine windows, refined by that worker's StepRecords ------------
+    matched_workers: list[str] = []
+    engine_windows: list[tuple] = []  # (key, window, seq_range|None)
+    for s in spans:
+        if s.get("name") not in ("engine.ttft", "engine.decode"):
+            continue
+        attrs = s.get("attributes") or {}
+        key = _match_worker(workers, attrs.get("flight_instance"),
+                            attrs.get("flight_name"))
+        w = _span_window(s)
+        if key is None:
+            continue
+        if key not in matched_workers:
+            matched_workers.append(key)
+        seq_range = None
+        if (isinstance(attrs.get("seq0"), int)
+                and isinstance(attrs.get("seq1"), int)):
+            seq_range = (attrs["seq0"], attrs["seq1"])
+        engine_windows.append((key, w, seq_range))
+
+    # migration stitch: the restore hint names the PREDECESSOR worker and
+    # its step seq, so the first leg's engine time attributes from that
+    # worker's ring even though its engine spans never closed (the leg
+    # broke mid-stream). The leg window runs from request start to the
+    # restore (or the successor's first engine span).
+    for s in spans:
+        if s.get("name") != "kv.restore":
+            continue
+        attrs = s.get("attributes") or {}
+        prev = attrs.get("prev_worker")
+        if not prev:
+            continue
+        key = _match_worker(workers, prev, attrs.get("prev_name"))
+        leg_end = _span_window(s)[0]
+        if key is None:
+            incomplete = True  # the predecessor's ring is gone (dead)
+            continue
+        if key not in matched_workers:
+            matched_workers.append(key)
+        engine_windows.append((key, (t0, leg_end), None))
+        prev_seq = attrs.get("prev_seq")
+        first = ((workers.get(key) or {}).get("summary") or {}).get(
+            "first_seq") or 0
+        if prev_seq and first and first > int(prev_seq):
+            incomplete = True  # ring wrapped over the first leg
+
+    for key, window, seq_range in engine_windows:
+        entry = workers.get(key) or {}
+        steps = _steps_of(entry)
+        _add_step_segments(segs, request_id, steps, window, evidence,
+                           seq_range=seq_range)
+        summ = entry.get("summary") or {}
+        first = summ.get("first_seq", 0)
+        if seq_range is not None:
+            if first and first > seq_range[0] + 1:
+                incomplete = True  # the window's step head was evicted
+        elif steps:
+            earliest = _rec_interval(steps[0])[0]
+            if first > 1 and earliest > window[0] + 0.001:
+                incomplete = True  # evicted (or unfetched) ring head
+
+    # steps carrying the request OUTSIDE any engine window (e.g. a leg
+    # whose spans were lost entirely) still count as compute
+    for key, entry in workers.items():
+        for rec in _steps_of(entry):
+            if (request_id in (rec.get("decode_ids") or ())
+                    or request_id in (rec.get("prefill_ids") or ())):
+                if key not in matched_workers:
+                    matched_workers.append(key)
+                r0, r1 = _rec_interval(rec)
+                bucket = ("prefill_compute"
+                          if request_id in (rec.get("prefill_ids") or ())
+                          else "decode_compute")
+                segs.add(r0, r1, bucket, _PRIO_COMPUTE)
+
+    ttft_ms, itl_ms = segs.sweep(boundary)
+    return _finish(request_id, t0, t1, boundary, ttft_ms, itl_ms,
+                   matched_workers, evidence, incomplete, trace_sampled,
+                   qos)
+
+
+def _flight_only(request_id: str, workers: dict,
+                 evidence: dict) -> Optional[dict]:
+    """Degraded decomposition when the trace was head-sampled out (or
+    expired): the window is the span of steps that carried the request;
+    causes come from the step linkage alone. ``trace_sampled=false`` in
+    the document — never a 404 just because sampling was on."""
+    mine: list[tuple[str, dict]] = []
+    for key, entry in workers.items():
+        for rec in _steps_of(entry):
+            if (request_id in (rec.get("decode_ids") or ())
+                    or request_id in (rec.get("prefill_ids") or ())):
+                mine.append((key, rec))
+    if not mine:
+        return None
+    t0 = min(_rec_interval(r)[0] for _, r in mine)
+    t1 = max(_rec_interval(r)[1] for _, r in mine)
+    segs = _Segments(t0, t1)
+    matched = []
+    for key, _ in mine:
+        if key not in matched:
+            matched.append(key)
+    for key in matched:
+        _add_step_segments(segs, request_id, _steps_of(workers[key]),
+                           (t0, t1), evidence)
+    first_decode = min(
+        (_rec_interval(r)[1] for _, r in mine
+         if request_id in (r.get("decode_ids") or ())), default=None)
+    total, after = segs.sweep(first_decode)
+    return _finish(request_id, t0, t1, first_decode, total, after,
+                   matched, evidence, incomplete=False,
+                   trace_sampled=False, qos=None, flight_only=True)
+
+
+def _finish(request_id, t0, t1, boundary, ttft_ms, itl_ms, matched,
+            evidence, incomplete, trace_sampled, qos,
+            flight_only: bool = False) -> dict:
+    total: dict = collections.defaultdict(float)
+    for part in (ttft_ms, itl_ms):
+        for k, v in part.items():
+            total[k] += v
+    e2e = (t1 - t0) * 1000.0
+    doc = {
+        "request_id": request_id,
+        "trace_sampled": trace_sampled,
+        "flight_only": flight_only,
+        "incomplete": incomplete,
+        "e2e_ms": round(e2e, 3),
+        "ttft_ms": round(((boundary or t1) - t0) * 1000.0, 3),
+        "itl_ms": round((t1 - (boundary or t1)) * 1000.0, 3),
+        "start": t0,
+        "end": t1,
+        "qos": qos or "standard",
+        "workers": matched,
+        "ttft": {k: round(v, 3) for k, v in sorted(ttft_ms.items())},
+        "itl": {k: round(v, 3) for k, v in sorted(itl_ms.items())},
+        "total": {k: round(v, 3) for k, v in sorted(total.items())},
+        "residual_ms": round(total.get("unattributed", 0.0), 3),
+        "evidence": evidence,
+    }
+    return doc
+
+
+# ------------------------------------------------------------ input gather
+
+
+async def gather_attribution(request_id: str, tracer=None, runtime=None,
+                             records: int = 2048,
+                             timeout: float = 2.0) -> Optional[dict]:
+    """Collect spans (local tracer ⊕ control-plane fan-out) and flight
+    records (fleet fan-out ⊕ process-local recorders), then join.
+
+    The one entry point the HTTP route, ``dynctl why`` and the bench all
+    share. Returns None only when nothing anywhere mentions the id."""
+    from dynamo_tpu.observability.collector import fetch_trace
+    from dynamo_tpu.observability.flight import fetch_fleet_steps, recorders
+    from dynamo_tpu.observability.tracing import (get_tracer,
+                                                  trace_sample_rate,
+                                                  trace_sampled)
+
+    tracer = tracer or get_tracer()
+    spans = {s.span_id: s.to_dict() for s in tracer.spans_for(request_id)}
+    workers: dict = {}
+    if runtime is not None:
+        fetched, steps = await asyncio.gather(
+            fetch_trace(runtime.plane, request_id, timeout=timeout),
+            fetch_fleet_steps(runtime.plane, n=records, timeout=timeout),
+            return_exceptions=True)
+        if isinstance(fetched, list):
+            for d in fetched:
+                spans.setdefault(d["span_id"], d)
+        if isinstance(steps, dict):
+            workers.update(steps)
+    # process-local recorders (bench / single-process serving / the very
+    # frontend hosting in-proc engines), deduped against fan-out entries
+    # by instance id so one ring never shows up under two keys (every
+    # recorder of one process shares the process instance id)
+    seen_instances = {(e.get("summary") or {}).get("instance")
+                      for e in workers.values()}
+    for name, rec in recorders().items():
+        summ = rec.summary()
+        if summ.get("instance") in seen_instances:
+            continue
+        workers[f"local/{name}"] = {"summary": summ,
+                                    "steps": rec.snapshot(records)}
+    sampled = trace_sampled(request_id, trace_sample_rate())
+    # the pure join runs off the event loop: a fleet-sized record fetch
+    # (workers × records dicts) swept in-line would stall every in-flight
+    # SSE stream the frontend is serving — attribution is observation,
+    # and observation must not tax the data plane
+    return await asyncio.to_thread(
+        attribute, request_id, list(spans.values()), workers,
+        bool(spans) or sampled)
+
+
+# ------------------------------------------------------- SLO burn tracking
+
+
+class SloBurnTracker:
+    """Rolling error-budget burn rate per QoS class.
+
+    ``note(cls, ttft_s)`` on every first token; ``rates()`` answers
+    ``{class: burn}`` where burn = (breach fraction over the rolling
+    window) / error_budget. 1.0 means the class consumes its budget
+    exactly at the sustainable rate; 2.0 means the budget dies in half
+    its period — the standard multi-window burn-rate alerting quantity,
+    exported as ``dynamo_slo_burn_rate{class}`` and threaded into the
+    autoscaler's Observation (docs/autoscaling.md)."""
+
+    def __init__(self, slo=None, window_s: Optional[float] = None,
+                 error_budget: Optional[float] = None,
+                 now_fn=time.monotonic):
+        if slo is None:
+            from dynamo_tpu.autoscale.slo import SloConfig
+            slo = SloConfig.load()
+        self.slo = slo
+        self.window_s = window_s if window_s is not None else \
+            getattr(slo, "burn_window_s", 120.0)
+        self.error_budget = error_budget if error_budget is not None else \
+            getattr(slo, "error_budget", 0.05)
+        self._now = now_fn
+        #: class → deque[(t, breached)]
+        self._events: dict[str, collections.deque] = {}
+
+    def note(self, cls: str, ttft_s: float) -> None:
+        target_ms = self.slo.slo_for(cls).ttft_p95_ms
+        if target_ms is None:
+            return  # no target (e.g. batch): nothing to burn
+        dq = self._events.setdefault(
+            cls, collections.deque(maxlen=4096))
+        dq.append((self._now(), ttft_s * 1000.0 > target_ms))
+
+    def _trim(self, dq) -> None:
+        horizon = self._now() - self.window_s
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+
+    def burn_rate(self, cls: str) -> Optional[float]:
+        dq = self._events.get(cls)
+        if not dq:
+            return None
+        self._trim(dq)
+        if not dq:
+            return None
+        frac = sum(1 for _, b in dq if b) / len(dq)
+        return frac / max(self.error_budget, 1e-9)
+
+    def rates(self) -> dict[str, float]:
+        out = {}
+        for cls in list(self._events):
+            r = self.burn_rate(cls)
+            if r is not None:
+                out[cls] = round(r, 4)
+        return out
+
+
+class BreachCauseEwma:
+    """EWMA of the compile share of breached requests' TTFT, per class —
+    the signal that lets the autoscale controller tell a compile-cliff
+    breach (defer: readiness gating already owns warming capacity) from a
+    load breach (scale). Fed from sampled attributions
+    (``dynamo_slo_breach_compile_share{class}``).
+
+    Entries EXPIRE: an attribution fed during yesterday's compile cliff
+    must not classify today's pure load breach as compile-dominated —
+    with no fresh evidence inside ``max_age_s`` the share reads 0.0
+    (explicitly, so an already-exported gauge resets rather than
+    latching the controller into ``breach_compile_deferred`` forever)."""
+
+    def __init__(self, alpha: float = 0.3, max_age_s: float = 300.0,
+                 now_fn=time.monotonic):
+        self.alpha = alpha
+        self.max_age_s = max_age_s
+        self._now = now_fn
+        self._share: dict[str, tuple[float, float]] = {}  # cls -> (v, t)
+
+    def note(self, doc: dict) -> None:
+        """Fold one attribution document of a BREACHED request."""
+        ttft = doc.get("ttft") or {}
+        denom = sum(ttft.values())
+        if denom <= 0:
+            return
+        share = ttft.get("compile", 0.0) / denom
+        cls = doc.get("qos") or "standard"
+        prev = self._share.get(cls)
+        now = self._now()
+        if prev is None or now - prev[1] > self.max_age_s:
+            self._share[cls] = (share, now)
+        else:
+            self._share[cls] = (prev[0] + self.alpha * (share - prev[0]),
+                                now)
+
+    def shares(self) -> dict[str, float]:
+        """Every class ever noted, stale entries reporting 0.0."""
+        now = self._now()
+        return {c: (round(v, 4) if now - t <= self.max_age_s else 0.0)
+                for c, (v, t) in self._share.items()}

@@ -45,6 +45,7 @@ import json
 import logging
 import math
 import os
+import secrets
 import threading
 import time
 import weakref
@@ -54,6 +55,19 @@ from typing import Optional
 import msgpack
 
 logger = logging.getLogger("dynamo.observability.flight")
+
+#: process-unique recorder-instance id. Spans stamp it (engine.ttft /
+#: engine.decode ``flight_instance`` attributes) and summaries carry it, so
+#: the attribution join (attribution.py) can match "the worker that served
+#: this request" to "that worker's step ring" without knowing lease ids —
+#: several workers in one fleet share the recorder NAME ("engine"), never
+#: the instance.
+_INSTANCE_ID = secrets.token_hex(6)
+
+
+def flight_instance() -> str:
+    """This process's recorder-instance id (stable for the process life)."""
+    return _INSTANCE_ID
 
 #: discovery prefix: observability/flight/<lease-hex> → {subject, service}
 FLIGHT_PREFIX = "observability/flight/"
@@ -130,6 +144,20 @@ class StepRecord:
     restore_inflight: int = 0
     qos_mix: dict = field(default_factory=dict)   # {class: rows this step}
     tags: list = field(default_factory=list)
+    #: step↔request linkage (attribution.py): request ids whose decode
+    #: rows / prefill chunks this step carried, and the ready decode rows
+    #: the token budget left out. Sparse on the wire (absent when empty) —
+    #: most deployments never fetch them; the attribution join is what
+    #: turns "step 4812 was slow" into "THIS request stalled 3 ms there".
+    decode_ids: list = field(default_factory=list)
+    prefill_ids: list = field(default_factory=list)
+    starved_ids: list = field(default_factory=list)
+    #: anomaly-triggered device-trace artifact (observability/profiler.py
+    #: AnomalyProfiler): set on the record whose tags armed the capture,
+    #: AFTER it landed in the ring (snapshots serialize lazily, so fleet
+    #: queries see it; a DYN_STEP_JSONL line written at record time does
+    #: not — the path is logged as well)
+    profile_path: str = ""
 
     @property
     def tokens(self) -> int:
@@ -155,10 +183,14 @@ class StepRecord:
             d["compile_sig"] = self.compile_sig
         for k in ("preempt_swap", "preempt_recompute", "swap_out_blocks",
                   "swap_in_blocks", "starved_decode", "onboard_inflight",
-                  "restore_inflight", "constrained_rows"):
+                  "restore_inflight", "constrained_rows", "profile_path"):
             v = getattr(self, k)
             if v:
                 d[k] = v
+        for k in ("decode_ids", "prefill_ids", "starved_ids"):
+            v = getattr(self, k)
+            if v:
+                d[k] = list(v)
         if self.kv_tiers:
             d["kv_tiers"] = dict(self.kv_tiers)
         if self.qos_mix:
@@ -208,6 +240,18 @@ class FlightRecorder:
             maxlen=STORM_WINDOW)
         self._storm_sum = 0
         self.anomaly_counts: dict[str, int] = {}
+        #: merged [lo, hi] seq intervals snapshots have actually RETURNED
+        #: (every slice is seq-contiguous), and the count of records the
+        #: ring evicted while never inside any of them — i.e. dropped
+        #: before EVER being served. A high-water mark would be wrong
+        #: here: an ``n=1`` poll returns only the newest record, and
+        #: marking everything older as served would zero the very signal
+        #: the attribution join keys its ``incomplete`` flag on
+        #: (dynamo_flight_records_dropped_total). The list stays tiny in
+        #: practice (pollers repeat/extend one window); a hard cap merges
+        #: the closest pair so it can never grow unbounded.
+        self._served: list[list[int]] = []
+        self.records_dropped_total = 0
         #: external gauges (disagg handler sets onboard/restore inflight;
         #: read at record time so every step carries the current value)
         self.gauges: dict[str, int] = {}
@@ -220,6 +264,12 @@ class FlightRecorder:
         ``compile-steady`` tag and the engine's steady-state-compile
         WARNING key on, so the tag and the log can never disagree."""
         return self._seq > self.steady_after
+
+    @property
+    def seq_now(self) -> int:
+        """Latest assigned record seq (0 before any record) — span
+        attributes snapshot it to bound a request's step interval."""
+        return self._seq
 
     def set_gauge(self, name: str, value: int) -> None:
         self.gauges[name] = value
@@ -294,6 +344,15 @@ class FlightRecorder:
                 dq.append(rec.wall_ms)
                 b[1] += rec.wall_ms
                 b[2] += rec.wall_ms * rec.wall_ms
+            if len(self._ring) == self._ring.maxlen:
+                evicted = self._ring[0].seq
+                # retire intervals wholly below the eviction frontier
+                while self._served and self._served[0][1] < evicted:
+                    self._served.pop(0)
+                if not (self._served
+                        and self._served[0][0] <= evicted
+                        <= self._served[0][1]):
+                    self.records_dropped_total += 1
             self._ring.append(rec)
         path = self._jsonl_path
         if path:
@@ -306,13 +365,52 @@ class FlightRecorder:
 
     # ------------------------------------------------------------- reading
 
-    def snapshot(self, n: Optional[int] = None) -> list[dict]:
-        """Newest-last list of record dicts (the whole ring by default)."""
+    def _mark_served(self, lo: int, hi: int) -> None:
+        """Fold one returned contiguous seq range into the served-interval
+        list (caller holds the lock)."""
+        merged = []
+        for iv in self._served:
+            if iv[1] + 1 < lo or hi + 1 < iv[0]:
+                merged.append(iv)
+            else:  # overlap/adjacency: absorb
+                lo, hi = min(lo, iv[0]), max(hi, iv[1])
+        merged.append([lo, hi])
+        merged.sort()
+        while len(merged) > 64:  # bounded: fuse the closest gap (the
+            gaps = [(merged[i + 1][0] - merged[i][1], i)  # undercounted
+                    for i in range(len(merged) - 1)]      # drop is tiny)
+            _, i = min(gaps)
+            merged[i][1] = merged[i + 1][1]
+            del merged[i + 1]
+        self._served = merged
+
+    def snapshot(self, n: Optional[int] = None,
+                 since: int = 0) -> list[dict]:
+        """Newest-last list of record dicts (the whole ring by default).
+
+        ``since``: only records with ``seq > since`` — the incremental
+        cursor behind ``GET /v1/fleet/steps?since=`` (pollers re-fetch
+        only what they have not seen). Only the records actually RETURNED
+        count as served for the dropped-before-served accounting — and
+        they are marked under the SAME lock hold as the copy, so a
+        concurrent record() eviction can never count a record this query
+        is in the middle of serving as dropped-unserved."""
         with self._lock:
             recs = list(self._ring)
-        if n is not None and n > 0:
-            recs = recs[-n:]
+            if since > 0:
+                recs = [r for r in recs if r.seq > since]
+            if n is not None and n > 0:
+                recs = recs[-n:]
+            if recs:
+                self._mark_served(recs[0].seq, recs[-1].seq)
         return [r.to_dict() for r in recs]
+
+    def first_seq(self) -> int:
+        """Oldest seq still in the ring (0 when empty) — the attribution
+        join compares it against a request's step interval to detect a
+        ring wrap (``incomplete=true``)."""
+        with self._lock:
+            return self._ring[0].seq if self._ring else 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -326,14 +424,10 @@ class FlightRecorder:
             recs = list(self._ring)
             anomalies = dict(self.anomaly_counts)
             total = self._seq
+        from dynamo_tpu.observability.stats import quantile
+
         steps = [r for r in recs if r.kind != "empty"]
-        walls = sorted(r.wall_ms for r in steps)
-
-        def pct(p: float) -> float:
-            if not walls:
-                return 0.0
-            return walls[min(len(walls) - 1, int(len(walls) * p))]
-
+        walls = [r.wall_ms for r in steps]
         tok_s = 0.0
         if len(steps) >= 2:
             span = steps[-1].t - steps[0].t
@@ -342,13 +436,16 @@ class FlightRecorder:
         last = recs[-1] if recs else StepRecord()
         return {
             "service": self.service,
+            "instance": _INSTANCE_ID,
             "enabled": self.enabled,
             "steps_total": total,
             "steps_in_ring": len(steps),
+            "first_seq": recs[0].seq if recs else 0,
             "last_seq": last.seq,
             "last_t": last.t,
-            "wall_p50_ms": round(pct(0.50), 3),
-            "wall_p95_ms": round(pct(0.95), 3),
+            "dropped_unserved": self.records_dropped_total,
+            "wall_p50_ms": round(quantile(walls, 0.50) or 0.0, 3),
+            "wall_p95_ms": round(quantile(walls, 0.95) or 0.0, 3),
             "tok_s": round(tok_s, 1),
             "tokens_in_ring": sum(r.tokens for r in steps),
             "anomalies": anomalies,
@@ -439,10 +536,12 @@ class FlightServeHandle:
 async def serve_flight(runtime) -> FlightServeHandle:
     """Expose this process's flight recorders to fleet queries.
 
-    Query wire: msgpack ``{"n": <records>}`` (n<=0 or absent → summaries
-    only) → ``{"service", "workers": {name: {"summary", "steps"}}}``.
-    The discovery key rides the primary lease, so a dead worker drops out
-    of the fan-out exactly like its serving endpoints (collector.py)."""
+    Query wire: msgpack ``{"n": <records>, "since": <seq>}`` (n<=0 or
+    absent → summaries only; since>0 → only records past that seq —
+    the incremental-poll cursor) → ``{"service", "workers": {name:
+    {"summary", "steps"}}}``. The discovery key rides the primary lease,
+    so a dead worker drops out of the fan-out exactly like its serving
+    endpoints (collector.py)."""
     lease = await runtime.primary_lease()
     subject = f"flight-{lease:x}"
 
@@ -452,11 +551,13 @@ async def serve_flight(runtime) -> FlightServeHandle:
         except Exception:
             q = {}
         n = int(q.get("n") or 0)
+        since = int(q.get("since") or 0)
         workers = {}
         for name, rec in recorders().items():
             entry = {"summary": rec.summary()}
-            if n > 0:
-                entry["steps"] = rec.snapshot(n)
+            if n > 0 or since > 0:
+                entry["steps"] = rec.snapshot(n if n > 0 else None,
+                                              since=since)
             workers[name] = entry
         return msgpack.packb({
             "service": os.environ.get("DYN_SERVICE", "dynamo"),
@@ -484,12 +585,15 @@ async def ensure_flight_endpoint(runtime) -> FlightServeHandle:
     return handle
 
 
-async def fetch_fleet_steps(plane, n: int = 0, timeout: float = 2.0) -> dict:
+async def fetch_fleet_steps(plane, n: int = 0, timeout: float = 2.0,
+                            since: int = 0) -> dict:
     """Fan a step query out to every registered flight endpoint.
 
     Returns ``{"<lease-hex>/<name>": {"summary", "steps"?}}``. A slow or
     dead worker times out individually and is simply dropped — a partial
-    fleet view beats none (same contract as fetch_trace)."""
+    fleet view beats none (same contract as fetch_trace). ``since``
+    fetches only records past that seq (one cursor applied to every
+    worker; per-worker cursors belong to the poller)."""
     try:
         entries = await plane.kv_get_prefix(FLIGHT_PREFIX)
     except Exception:
@@ -500,7 +604,8 @@ async def fetch_fleet_steps(plane, n: int = 0, timeout: float = 2.0) -> dict:
         try:
             meta = msgpack.unpackb(value, raw=False)
             raw = await asyncio.wait_for(
-                plane.request(meta["subject"], msgpack.packb({"n": n}),
+                plane.request(meta["subject"],
+                              msgpack.packb({"n": n, "since": since}),
                               timeout=timeout),
                 timeout + 0.5)
             resp = msgpack.unpackb(raw, raw=False) or {}

@@ -1,0 +1,86 @@
+"""Shared percentile math.
+
+Three callers grew three diverging estimators: the autoscaler's
+``histogram_p95`` (bucketed-histogram interpolation over scrape deltas),
+the flight recorder's ``dynctl top`` p50/p95 (nearest-rank over raw step
+walls), and the bench summaries' ad-hoc ``sorted()[int(n*0.95)]`` closures.
+Nearest-rank with ``int(n*p)`` is biased high for small n (the p95 of an
+8-sample wave is its max) and the three could silently disagree about the
+same data. This module is the ONE implementation both sample-based and
+bucket-based callers use:
+
+- :func:`quantile` — linear interpolation between order statistics
+  (numpy's default / Prometheus-free path) over raw samples.
+- :func:`histogram_quantile` — Prometheus ``histogram_quantile`` semantics
+  over cumulative bucket counts (linear interpolation inside the crossing
+  bucket; the ``+Inf`` bucket answers with its lower bound).
+
+Both return ``None`` for empty input so callers choose their own default
+(``or 0.0`` in displays, skip in control loops).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Optional
+
+
+def quantile(values: Iterable[float], q: float) -> Optional[float]:
+    """Linearly-interpolated quantile of raw samples (numpy ``linear``
+    method): sort, then interpolate between the two order statistics
+    straddling rank ``q * (n - 1)``. ``None`` on empty input; NaNs are
+    dropped (a poisoned sample must not poison the estimate)."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile q={q} outside [0, 1]")
+    xs = sorted(v for v in values if not math.isnan(v))
+    if not xs:
+        return None
+    if len(xs) == 1:
+        return xs[0]
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = pos - lo
+    return xs[lo] + (xs[hi] - xs[lo]) * frac
+
+
+def p50(values: Iterable[float]) -> Optional[float]:
+    return quantile(values, 0.50)
+
+
+def p95(values: Iterable[float]) -> Optional[float]:
+    return quantile(values, 0.95)
+
+
+def histogram_quantile(cumulative: dict[float, float], q: float
+                       ) -> Optional[float]:
+    """Quantile from cumulative histogram bucket counts
+    ``{le_upper_bound: cumulative_count}`` (``float('inf')`` for +Inf).
+
+    Standard ``histogram_quantile`` semantics: find the bucket where the
+    cumulative count crosses ``q * total`` and interpolate linearly inside
+    it (buckets assumed to start at 0). Crossing in the ``+Inf`` bucket
+    returns the highest finite bound — the best LOWER bound available.
+    ``None`` when the set is empty, has no +Inf bucket (a partial scrape
+    can't be trusted), or recorded nothing."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile q={q} outside [0, 1]")
+    bounds = sorted(cumulative)
+    if not bounds or bounds[-1] != float("inf"):
+        return None
+    total = cumulative[float("inf")]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for b in bounds:
+        cum = cumulative[b]
+        if cum >= target:
+            if b == float("inf"):
+                return prev_bound
+            if cum == prev_cum:
+                return b
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_bound + frac * (b - prev_bound)
+        prev_bound, prev_cum = b, cum
+    return prev_bound

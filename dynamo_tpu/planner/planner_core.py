@@ -44,6 +44,10 @@ class Observation:
     #: correction factor exactly when the loop is most excitable.
     ready_prefill: Optional[int] = None
     ready_decode: Optional[int] = None
+    #: rolling SLO error-budget burn per QoS class (autoscale fuser; the
+    #: frontend's dynamo_slo_burn_rate{class} — docs/observability.md
+    #: "Attribution"). None = signal absent (pre-attribution frontend).
+    slo_burn: Optional[dict] = None
 
 
 @dataclass

@@ -211,12 +211,20 @@ class LLMEngineOutput:
     index: Optional[int] = None
     #: disaggregation: prefill worker hands decode worker the KV transfer params
     kv_transfer_params: Optional[dict] = None
+    #: serving-worker flight identity, set ONCE on the first token-bearing
+    #: output of each engine leg: {"worker": <flight instance hex>,
+    #: "recorder": <name>, "seq": <recorder seq>}. Migration carries it
+    #: into the restore hint (prev_worker/prev_seq) so latency attribution
+    #: stitches both legs of a migrated stream (docs/observability.md
+    #: "Attribution"). Absent-when-None: pre-attribution peers and every
+    #: later frame stay byte-identical on the wire.
+    flight: Optional[dict] = None
 
     def to_wire(self) -> dict:
         d = {"token_ids": self.token_ids}
         for k in ("tokens", "text", "cum_log_probs", "log_probs",
                   "top_logprobs", "finish_reason", "index",
-                  "kv_transfer_params"):
+                  "kv_transfer_params", "flight"):
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
@@ -234,6 +242,7 @@ class LLMEngineOutput:
             finish_reason=d.get("finish_reason"),
             index=d.get("index"),
             kv_transfer_params=d.get("kv_transfer_params"),
+            flight=d.get("flight"),
         )
 
     @staticmethod

@@ -27,7 +27,14 @@ Subcommands:
   the hub's own event counters; ``--watch`` refreshes, ``--json`` dumps.
 - ``dynctl timeline <worker>`` — one worker's recent step strip with
   anomaly tags (``!`` slow, ``C`` compile, ``P`` preempt-storm, ``s``
-  budget-starved, ``_`` empty bubble) and the tagged records in full.
+  budget-starved, ``_`` empty bubble) and the tagged records in full;
+  ``--watch`` refreshes incrementally via the ``since`` step cursor.
+- ``dynctl why <request-id>`` — the per-request latency attribution tree
+  (docs/observability.md "Attribution"): the request's spans joined with
+  the serving workers' step records, every millisecond bucketed into a
+  named cause (queue wait, KV transfer, compile, compute, preempt stall,
+  scheduler bubble, …) plus the unattributed residual, with the tagged
+  StepRecords behind each stall.
 """
 
 from __future__ import annotations
@@ -162,9 +169,13 @@ async def autoscale_amain(namespace: str, as_json: bool,
                           f"scrape-failures={c.get('scrapeFailures')}")
                     for cls, b in sorted((ctl.get("slo") or {}).items()):
                         mark = "OK" if b.get("ok") else "BREACH"
+                        burn = b.get("burn")
+                        burn_s = (f"  burn {burn:.2f}x"
+                                  if burn is not None else "")
                         print(f"  slo {cls:<12s} ttft p95 "
                               f"{b.get('ttft_p95_ms')}ms / "
-                              f"target {b.get('target_ms')}ms  [{mark}]")
+                              f"target {b.get('target_ms')}ms  "
+                              f"[{mark}]{burn_s}")
                 if target:
                     print(f"  planner key: prefill={target.get('prefill')} "
                           f"decode={target.get('decode')} "
@@ -267,54 +278,153 @@ _STRIP = (("empty-step", "_"), ("preempt-storm", "P"),
           ("budget-starved", "s"))
 
 
+def _print_timeline(name: str, entry: dict) -> None:
+    steps = entry.get("steps") or []
+    summary = entry.get("summary") or {}
+    marks = ""
+    if entry.get("restarted"):
+        marks += "  [recorder restarted — cursor reset]"
+    if entry.get("gap"):
+        marks += f"  [{entry['gap']} records skipped — raise -n]"
+    print(f"{name}: {len(steps)} recent steps "
+          f"(p95 {summary.get('wall_p95_ms', 0.0)}ms, "
+          f"anomalies {summary.get('anomalies') or {}}){marks}")
+    strip = []
+    for rec in steps:
+        tags = set(rec.get("tags") or [])
+        sym = "."
+        for tag, ch in _STRIP:
+            if tag in tags:
+                sym = ch
+                break
+        strip.append(sym)
+    print("  " + "".join(strip))
+    for rec in steps:
+        if not rec.get("tags"):
+            continue
+        extras = " ".join(
+            f"{k}={rec[k]}" for k in
+            ("compile_sig", "compile_s", "preempt_swap",
+             "preempt_recompute", "starved_decode", "waiting",
+             "swapped", "profile_path") if rec.get(k))
+        print(f"  #{rec.get('seq'):<7d} {rec.get('kind', ''):<12s} "
+              f"{rec.get('wall_ms', 0.0):>9.2f}ms "
+              f"dec={rec.get('decode_rows', 0)} "
+              f"chunks={rec.get('prefill_chunks', 0)} "
+              f"[{','.join(rec.get('tags'))}] {extras}".rstrip())
+
+
 async def timeline_amain(worker: str, n: int, as_json: bool,
-                         timeout: float = 2.0) -> int:
+                         timeout: float = 2.0, watch: float = 0.0) -> int:
     """Recent step strip + tagged records for one worker (substring match
-    on the fleet key, e.g. ``backend`` or the lease hex)."""
+    on the fleet key, e.g. ``backend`` or the lease hex). ``--watch``
+    polls incrementally: the wire ``since`` carries the LOWEST cursor of
+    the matched workers (each recorder's seq counter is independent, so
+    one shared high-water mark would freeze the lower-seq workers), and
+    the per-worker cursors filter client-side on top."""
     from dynamo_tpu.observability import fetch_fleet_steps
     from dynamo_tpu.runtime import DistributedRuntime
 
     runtime = await DistributedRuntime.create()
+    cursors: dict[str, int] = {}
+    first = True
     try:
-        workers = await fetch_fleet_steps(runtime.plane, n=n,
-                                          timeout=timeout)
-        matches = {k: v for k, v in workers.items() if worker in k}
-        if not matches:
-            print(f"no flight recorder matches {worker!r} "
-                  f"(known: {sorted(workers) or 'none'})", file=sys.stderr)
+        while True:
+            wire_since = min(cursors.values()) if cursors else 0
+            workers = await fetch_fleet_steps(runtime.plane, n=n,
+                                              timeout=timeout,
+                                              since=wire_since)
+            matches = {k: v for k, v in workers.items() if worker in k}
+            if not matches and first:
+                print(f"no flight recorder matches {worker!r} "
+                      f"(known: {sorted(workers) or 'none'})",
+                      file=sys.stderr)
+                return 1
+            first = False
+            for key, entry in matches.items():
+                cur = cursors.get(key, 0)
+                last = int((entry.get("summary") or {}).get("last_seq")
+                           or 0)
+                if 0 < last < cur:
+                    # the worker's recorder restarted (fresh seq counter):
+                    # reset this cursor, and the NEXT poll's wire since
+                    # (min over cursors) drops low enough to refetch it —
+                    # otherwise the server-side filter would hide the new
+                    # life's records forever
+                    cursors[key] = cur = 0
+                    entry["restarted"] = True
+                steps = [rec for rec in entry.get("steps") or []
+                         if int(rec.get("seq") or 0) > cur]
+                entry["steps"] = steps
+                if steps:
+                    if cur and int(steps[0].get("seq") or 0) > cur + 1:
+                        # more new records than -n fetched: mark the hole
+                        # instead of rendering a silently-continuous strip
+                        entry["gap"] = int(steps[0]["seq"]) - cur - 1
+                    cursors[key] = int(steps[-1].get("seq") or 0)
+            if as_json:
+                print(json.dumps(matches, indent=2))
+            else:
+                for name in sorted(matches):
+                    _print_timeline(name, matches[name])
+            if not watch:
+                return 0
+            await asyncio.sleep(watch)
+            print()
+    finally:
+        await runtime.shutdown()
+
+
+async def why_amain(request_id: str, as_json: bool, records: int = 2048,
+                    timeout: float = 2.0) -> int:
+    """Fetch + join + print one request's latency attribution tree."""
+    from dynamo_tpu.observability.attribution import gather_attribution
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    runtime = await DistributedRuntime.create()
+    try:
+        doc = await gather_attribution(request_id, runtime=runtime,
+                                       records=records, timeout=timeout)
+        if doc is None:
+            print(f"no spans or step records mention {request_id!r} "
+                  "(is DYN_CONTROL_PLANE set, and is the request still "
+                  "inside the span/step ring windows?)", file=sys.stderr)
             return 1
         if as_json:
-            print(json.dumps(matches, indent=2))
+            print(json.dumps(doc, indent=2))
             return 0
-        for name in sorted(matches):
-            steps = matches[name].get("steps") or []
-            summary = matches[name].get("summary") or {}
-            print(f"{name}: {len(steps)} recent steps "
-                  f"(p95 {summary.get('wall_p95_ms', 0.0)}ms, "
-                  f"anomalies {summary.get('anomalies') or {}})")
-            strip = []
-            for rec in steps:
-                tags = set(rec.get("tags") or [])
-                sym = "."
-                for tag, ch in _STRIP:
-                    if tag in tags:
-                        sym = ch
-                        break
-                strip.append(sym)
-            print("  " + "".join(strip))
-            for rec in steps:
-                if not rec.get("tags"):
+        flags = []
+        if not doc.get("trace_sampled", True):
+            flags.append("trace sampled out — flight-only decomposition")
+        if doc.get("incomplete"):
+            flags.append("INCOMPLETE: step ring wrapped over part of the "
+                         "request's interval")
+        print(f"request {doc['request_id']}  e2e {doc['e2e_ms']:.1f}ms  "
+              f"qos={doc.get('qos')}  workers={doc.get('workers')}")
+        for f in flags:
+            print(f"  ! {f}")
+        for phase in ("ttft", "itl"):
+            total = doc.get(f"{phase}_ms") or 0.0
+            buckets = doc.get(phase) or {}
+            if not buckets and not total:
+                continue
+            print(f"  {phase} {total:.1f}ms")
+            for bucket, ms in sorted(buckets.items(),
+                                     key=lambda kv: -kv[1]):
+                if ms <= 0:
                     continue
-                extras = " ".join(
-                    f"{k}={rec[k]}" for k in
-                    ("compile_sig", "compile_s", "preempt_swap",
-                     "preempt_recompute", "starved_decode", "waiting",
-                     "swapped") if rec.get(k))
-                print(f"  #{rec.get('seq'):<7d} {rec.get('kind', ''):<12s} "
-                      f"{rec.get('wall_ms', 0.0):>9.2f}ms "
-                      f"dec={rec.get('decode_rows', 0)} "
-                      f"chunks={rec.get('prefill_chunks', 0)} "
-                      f"[{','.join(rec.get('tags'))}] {extras}".rstrip())
+                pct = 100.0 * ms / total if total else 0.0
+                print(f"    {bucket:<16s} {ms:>9.1f}ms {pct:5.1f}%")
+                for ev in (doc.get("evidence") or {}).get(bucket, [])[-3:]:
+                    bits = " ".join(f"{k}={ev[k]}" for k in
+                                    ("kind", "wall_ms", "tags",
+                                     "compile_sig", "profile_path")
+                                    if ev.get(k))
+                    print(f"      · step #{ev.get('seq')} {bits}")
+        res = doc.get("residual_ms") or 0.0
+        e2e = doc.get("e2e_ms") or 0.0
+        print(f"  residual {res:.1f}ms "
+              f"({100.0 * res / e2e if e2e else 0.0:.1f}% of e2e)")
         return 0
     finally:
         await runtime.shutdown()
@@ -344,9 +454,29 @@ def _timeline_main(argv: list[str]) -> None:
                     help="recent records to fetch (default 120)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--timeout", type=float, default=2.0)
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every N seconds via the since cursor "
+                         "(0 = one-shot)")
     args = ap.parse_args(argv)
     raise SystemExit(asyncio.run(
-        timeline_amain(args.worker, args.n, args.json, args.timeout)))
+        timeline_amain(args.worker, args.n, args.json, args.timeout,
+                       args.watch)))
+
+
+def _why_main(argv: list[str]) -> None:
+    ap = argparse.ArgumentParser(
+        prog="dynctl why",
+        description="per-request latency attribution: spans joined with "
+                    "the serving workers' step records")
+    ap.add_argument("request_id")
+    ap.add_argument("--records", type=int, default=2048,
+                    help="step records to fetch per worker (default 2048)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw attribution document")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    raise SystemExit(asyncio.run(
+        why_amain(args.request_id, args.json, args.records, args.timeout)))
 
 
 def _autoscale_main(argv: list[str]) -> None:
@@ -390,6 +520,9 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "timeline":
         _timeline_main(sys.argv[2:])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "why":
+        _why_main(sys.argv[2:])
         return
     ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
     ap.add_argument("--host", default="0.0.0.0")

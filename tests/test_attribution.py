@@ -1,0 +1,830 @@
+"""Latency attribution engine (docs/observability.md "Attribution"):
+the span⊕StepRecord join, its falsifiability property (buckets + residual
+sum to measured e2e), sampled-out degradation, two-worker migration
+stitching, ring-wrap incompleteness, the shared percentile helpers, SLO
+burn-rate accounting + the controller's cause-aware breach term, and the
+anomaly-triggered profiler's arming/budget logic."""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.observability import (
+    FlightRecorder,
+    attribute,
+    configure_tracer,
+    gather_attribution,
+)
+from dynamo_tpu.observability.attribution import (
+    BreachCauseEwma,
+    SloBurnTracker,
+)
+from dynamo_tpu.observability.flight import (
+    flight_instance,
+    register_recorder,
+    unregister_recorder,
+)
+from dynamo_tpu.observability.profiler import AnomalyProfiler
+from dynamo_tpu.observability.stats import histogram_quantile, quantile
+from dynamo_tpu.runtime.context import Context
+
+pytestmark = pytest.mark.anyio
+
+
+# ------------------------------------------------- shared percentile math
+
+
+def test_quantile_interpolation_edges():
+    assert quantile([], 0.5) is None
+    assert quantile([7.0], 0.95) == 7.0
+    xs = list(range(1, 11))  # 1..10
+    assert quantile(xs, 0.0) == 1.0
+    assert quantile(xs, 1.0) == 10.0
+    assert quantile(xs, 0.5) == 5.5          # interpolated median
+    assert quantile(xs, 0.95) == pytest.approx(9.55)
+    # NaNs are dropped, not propagated
+    assert quantile([1.0, float("nan"), 3.0], 0.5) == 2.0
+    with pytest.raises(ValueError):
+        quantile(xs, 1.5)
+
+
+def test_histogram_quantile_edges():
+    inf = float("inf")
+    # no +Inf bucket → untrustworthy partial set
+    assert histogram_quantile({0.1: 5.0}, 0.95) is None
+    # zero total → nothing recorded
+    assert histogram_quantile({0.1: 0.0, inf: 0.0}, 0.95) is None
+    # crossing in the tail bucket → best lower bound (the highest finite)
+    assert histogram_quantile({0.1: 1.0, 0.5: 1.0, inf: 100.0},
+                              0.95) == 0.5
+    # linear interpolation inside the crossing bucket
+    q = histogram_quantile({0.1: 0.0, 0.5: 100.0, inf: 100.0}, 0.5)
+    assert q == pytest.approx(0.1 + 0.5 * 0.4)
+    # flat bucket (cum == prev_cum at the crossing) returns the bound
+    assert histogram_quantile({0.1: 10.0, 0.5: 10.0, inf: 10.0},
+                              0.95) == pytest.approx(0.095)
+
+
+def test_autoscale_histogram_p95_delegates():
+    """The autoscaler's histogram_p95 and the shared helper are ONE
+    estimator (the dedupe satellite's contract)."""
+    from dynamo_tpu.autoscale.observe import histogram_p95
+
+    delta = {0.05: 10.0, 0.2: 90.0, 1.0: 100.0, float("inf"): 100.0}
+    assert histogram_p95(delta) == histogram_quantile(delta, 0.95)
+
+
+# ------------------------------------------------------- the pure join
+
+
+def _span(name, start, end, **attrs):
+    return {"name": name, "trace_id": "t", "span_id": f"{name}-{start}",
+            "parent_span_id": None, "start": start, "end": end,
+            "service": "x", "request_id": "rid-1", "attributes": attrs}
+
+
+def _rec(seq, t_end, wall_ms, **kw):
+    d = {"seq": seq, "t": t_end, "kind": kw.pop("kind", "ragged"),
+         "wall_ms": wall_ms, "tags": kw.pop("tags", [])}
+    d.update(kw)
+    return d
+
+
+def _workers(steps, instance="inst-a", name="engine", first_seq=None):
+    return {f"abc/{name}": {
+        "summary": {"instance": instance,
+                    "first_seq": first_seq if first_seq is not None
+                    else (steps[0]["seq"] if steps else 0)},
+        "steps": steps}}
+
+
+def test_join_buckets_and_sum_property():
+    """Synthetic request: 100 ms window — tokenize, route, then an engine
+    TTFT window whose records split into compile / others' steps / own
+    prefill, then decode. Every bucket lands where the evidence says and
+    the total (buckets + residual) equals e2e exactly."""
+    t0 = 1000.0
+    spans = [
+        _span("http.request", t0, t0 + 0.100, qos="interactive"),
+        _span("ttft", t0, t0 + 0.080),
+        _span("preprocess.tokenize", t0, t0 + 0.005),
+        _span("router.schedule", t0 + 0.005, t0 + 0.010),
+        _span("engine.ttft", t0 + 0.010, t0 + 0.080,
+              flight_instance="inst-a", flight_name="engine",
+              seq0=0, seq1=4),
+        _span("engine.decode", t0 + 0.080, t0 + 0.100,
+              flight_instance="inst-a", flight_name="engine",
+              seq0=4, seq1=6),
+    ]
+    steps = [
+        # 10→30 ms: another request's step WITH a compile head of 15 ms
+        _rec(1, t0 + 0.030, 20.0, compile_s=0.015,
+             decode_ids=["other"]),
+        # 30→40 ms: preempt traffic
+        _rec(2, t0 + 0.040, 10.0, preempt_swap=2, decode_ids=["other"],
+             tags=["preempt-storm"]),
+        # 40→50 ms: empty bubble
+        _rec(3, t0 + 0.050, 10.0, kind="empty"),
+        # 50→80 ms: OUR prefill chunk
+        _rec(4, t0 + 0.080, 30.0, prefill_ids=["rid-1"]),
+        # 80→100 ms: our decode steps
+        _rec(5, t0 + 0.090, 10.0, decode_ids=["rid-1"]),
+        _rec(6, t0 + 0.100, 10.0, decode_ids=["rid-1"]),
+    ]
+    doc = attribute("rid-1", spans, _workers(steps))
+    assert doc is not None
+    assert doc["qos"] == "interactive"
+    assert doc["workers"] == ["abc/engine"]
+    assert not doc["incomplete"]
+    total = doc["total"]
+    assert total["frontend"] == pytest.approx(5.0, abs=0.2)
+    assert total["routing"] == pytest.approx(5.0, abs=0.2)
+    assert total["compile"] == pytest.approx(15.0, abs=0.2)
+    # the rest of the other-request step reads as queue wait
+    assert total["queue_wait"] == pytest.approx(5.0, abs=0.2)
+    assert total["preempt_stall"] == pytest.approx(10.0, abs=0.2)
+    assert total["sched_bubble"] == pytest.approx(10.0, abs=0.2)
+    assert total["prefill_compute"] == pytest.approx(30.0, abs=0.2)
+    assert total["decode_compute"] == pytest.approx(20.0, abs=0.2)
+    # FALSIFIABILITY: everything + residual sums to measured e2e
+    assert sum(total.values()) == pytest.approx(doc["e2e_ms"], abs=0.01)
+    # the TTFT/ITL split respects the boundary
+    assert sum(doc["ttft"].values()) == pytest.approx(80.0, abs=0.1)
+    assert sum(doc["itl"].values()) == pytest.approx(20.0, abs=0.1)
+    assert doc["itl"].get("decode_compute", 0.0) == pytest.approx(
+        20.0, abs=0.2)
+    # evidence names the stall steps, preempt-storm tag included
+    ev = doc["evidence"]
+    assert any(e["seq"] == 2 for e in ev["preempt_stall"])
+    assert any(e["seq"] == 1 for e in ev["compile"])
+
+
+def test_sampled_out_degrades_to_flight_only():
+    """No spans at all (head-sampled out / expired): the decomposition
+    still answers from the step↔request linkage, flagged
+    trace_sampled=false — never a 'not found'."""
+    t0 = 2000.0
+    steps = [
+        _rec(1, t0 + 0.030, 30.0, prefill_ids=["rid-2"]),
+        _rec(2, t0 + 0.040, 10.0, decode_ids=["other"]),
+        _rec(3, t0 + 0.050, 10.0, decode_ids=["rid-2"]),
+    ]
+    doc = attribute("rid-2", [], _workers(steps))
+    assert doc is not None
+    assert doc["trace_sampled"] is False
+    assert doc["flight_only"] is True
+    total = doc["total"]
+    assert total["prefill_compute"] == pytest.approx(30.0, abs=0.2)
+    assert total["decode_compute"] == pytest.approx(10.0, abs=0.2)
+    assert sum(total.values()) == pytest.approx(doc["e2e_ms"], abs=0.01)
+    # nothing anywhere: None (the route's 404)
+    assert attribute("rid-404", [], _workers(steps)) is None
+
+
+def test_two_worker_migration_stitch():
+    """A migrated request: leg 1 on worker A (engine spans never closed —
+    the leg broke), leg 2 on worker B. The kv.restore span's prev_worker/
+    prev_seq hint (Migration satellite) stitches worker A's records in;
+    without records before prev_seq the doc flags incomplete."""
+    t0 = 3000.0
+    spans = [
+        _span("http.request", t0, t0 + 0.100),
+        # leg 2's restore + engine spans on worker B
+        _span("kv.restore", t0 + 0.050, t0 + 0.060,
+              prev_worker="inst-a", prev_name="engine", prev_seq=2),
+        _span("engine.ttft", t0 + 0.060, t0 + 0.080,
+              flight_instance="inst-b", flight_name="engine",
+              seq0=0, seq1=1),
+        _span("engine.decode", t0 + 0.080, t0 + 0.100,
+              flight_instance="inst-b", flight_name="engine",
+              seq0=1, seq1=2),
+    ]
+    leg1 = [_rec(1, t0 + 0.020, 20.0, prefill_ids=["rid-1"]),
+            _rec(2, t0 + 0.040, 20.0, decode_ids=["rid-1"])]
+    leg2 = [_rec(1, t0 + 0.080, 20.0, prefill_ids=["rid-1"]),
+            _rec(2, t0 + 0.100, 20.0, decode_ids=["rid-1"])]
+    workers = {}
+    workers.update(_workers(leg1, instance="inst-a"))
+    workers.update({"def/engine": {
+        "summary": {"instance": "inst-b", "first_seq": 1},
+        "steps": leg2}})
+    doc = attribute("rid-1", spans, workers)
+    assert set(doc["workers"]) == {"abc/engine", "def/engine"}
+    assert not doc["incomplete"]
+    total = doc["total"]
+    # BOTH legs' compute attributed — leg 1 is not "unattributed"
+    assert total["prefill_compute"] == pytest.approx(40.0, abs=0.5)
+    assert total["decode_compute"] == pytest.approx(40.0, abs=0.5)
+    assert total["kv_transfer"] == pytest.approx(10.0, abs=0.5)
+    assert sum(total.values()) == pytest.approx(doc["e2e_ms"], abs=0.01)
+
+    # predecessor ring wrapped past the hint's seq → incomplete
+    wrapped = dict(workers)
+    wrapped["abc/engine"] = {
+        "summary": {"instance": "inst-a", "first_seq": 5}, "steps": []}
+    assert attribute("rid-1", spans, wrapped)["incomplete"] is True
+
+    # predecessor gone entirely (dead worker, ring unreachable):
+    # incomplete, not silently attributed
+    gone = {"def/engine": workers["def/engine"]}
+    assert attribute("rid-1", spans, gone)["incomplete"] is True
+
+
+def test_ring_wrap_flags_incomplete():
+    """An engine window whose worker ring starts AFTER the window began
+    (and has evicted records) is an incomplete decomposition."""
+    t0 = 4000.0
+    spans = [
+        _span("http.request", t0, t0 + 0.100),
+        _span("engine.ttft", t0, t0 + 0.100,
+              flight_instance="inst-a", flight_name="engine",
+              seq0=90, seq1=100),
+    ]
+    # ring starts mid-window with a wrapped head (first_seq 95 > 1)
+    steps = [_rec(s, t0 + 0.050 + (s - 95) * 0.01, 10.0,
+                  decode_ids=["rid-1"]) for s in range(95, 101)]
+    doc = attribute("rid-1", spans, _workers(steps, first_seq=95))
+    assert doc["incomplete"] is True
+    assert sum(doc["total"].values()) == pytest.approx(doc["e2e_ms"],
+                                                       abs=0.01)
+    # a fresh worker whose ring simply STARTS at seq 1 is complete
+    fresh = [_rec(s, t0 + 0.010 * s_i, 10.0, decode_ids=["rid-1"])
+             for s_i, s in enumerate(range(1, 4), start=1)]
+    doc2 = attribute("rid-1", spans, _workers(fresh, first_seq=1))
+    assert doc2["incomplete"] is False
+
+
+# -------------------------------------------- since cursor + drop counter
+
+
+def test_snapshot_since_cursor_and_dropped_unserved():
+    rec = FlightRecorder(service="t", capacity=16, enabled=True)
+    for _ in range(10):
+        rec.record("mock", 1.0, decode_rows=1)
+    snap = rec.snapshot()            # serves seqs 1..10
+    assert [d["seq"] for d in rec.snapshot(since=7)] == [8, 9, 10]
+    assert rec.snapshot(since=10) == []
+    assert [d["seq"] for d in rec.snapshot(2, since=5)] == [9, 10]
+    # evictions of already-served records (seqs 1..10) don't count…
+    for _ in range(16):
+        rec.record("mock", 1.0, decode_rows=1)
+    assert rec.records_dropped_total == 0
+    # …but every eviction of a never-served record does (seqs 11..30)
+    for _ in range(20):
+        rec.record("mock", 1.0, decode_rows=1)
+    assert rec.records_dropped_total == 20
+    assert rec.summary()["dropped_unserved"] == 20
+    assert rec.summary()["first_seq"] == rec.snapshot()[0]["seq"]
+    assert snap[-1]["seq"] == 10
+
+
+def test_n1_snapshot_does_not_mark_ring_served():
+    """An ``n=1`` poll (dynctl-style) serves ONE record; the other ring
+    entries are still unserved and their eviction must count — a
+    high-water mark would zero the incompleteness signal under the most
+    common polling pattern."""
+    rec = FlightRecorder(service="t", capacity=16, enabled=True)
+    for _ in range(16):
+        rec.record("mock", 1.0, decode_rows=1)
+    assert len(rec.snapshot(1)) == 1            # serves seq 16 only
+    for _ in range(16):                          # evicts seqs 1..16
+        rec.record("mock", 1.0, decode_rows=1)
+    assert rec.records_dropped_total == 15       # seq 16 was served
+
+
+def test_feed_attribution_is_once_per_request():
+    """Repeated /v1/attribution queries of one request feed the fleet
+    histograms + breach-cause EWMA at most once (a watch-looped curl must
+    not drag the autoscaler's compile-share signal)."""
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager
+
+    svc = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    doc = {"request_id": "r1", "qos": "standard",
+           "ttft_ms": 500.0, "ttft": {"compile": 400.0, "queue_wait": 100.0},
+           "itl": {"decode_compute": 50.0}}
+    svc.feed_attribution(doc)
+    svc.feed_attribution(doc)
+    svc.feed_attribution(dict(doc))  # same id, fresh dict: still deduped
+    text = svc.metrics.render()
+    assert ('dynamo_ttft_breakdown_seconds_count'
+            '{phase="compile",qos="standard"} 1') in text
+    svc.feed_attribution({**doc, "request_id": "r2"})
+    text = svc.metrics.render()
+    assert ('dynamo_ttft_breakdown_seconds_count'
+            '{phase="compile",qos="standard"} 2') in text
+
+
+async def test_fleet_steps_since_over_the_wire():
+    from dynamo_tpu.observability import fetch_fleet_steps, serve_flight
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    rt = await DistributedRuntime.create()
+    rec = FlightRecorder(service="w", capacity=64, enabled=True)
+    for _ in range(12):
+        rec.record("mock", 1.0, decode_rows=1)
+    name = register_recorder("wsince", rec)
+    try:
+        handle = await serve_flight(rt)
+        out = await fetch_fleet_steps(rt.plane, since=9, timeout=0.5)
+        entry = next(v for k, v in out.items() if k.endswith("/wsince"))
+        assert [d["seq"] for d in entry["steps"]] == [10, 11, 12]
+        await handle.stop()
+    finally:
+        unregister_recorder(name)
+        await rt.shutdown()
+
+
+# ------------------------------------------------------- SLO burn tracking
+
+
+def make_slo(**kw):
+    from dynamo_tpu.autoscale.slo import SloConfig
+
+    return SloConfig.load(env=kw)
+
+
+def test_burn_tracker_math():
+    clock = [0.0]
+    slo = make_slo(DYN_SLO_INTERACTIVE_TTFT_P95_MS="100")
+    tr = SloBurnTracker(slo, window_s=60.0, error_budget=0.1,
+                        now_fn=lambda: clock[0])
+    assert tr.burn_rate("interactive") is None  # no samples yet
+    for i in range(10):
+        tr.note("interactive", 0.050 if i < 8 else 0.500)  # 2/10 breach
+    assert tr.burn_rate("interactive") == pytest.approx(0.2 / 0.1)
+    assert tr.rates()["interactive"] == pytest.approx(2.0)
+    # the window forgets old samples
+    clock[0] = 120.0
+    assert tr.burn_rate("interactive") is None
+    # a class with no target (batch by default) burns nothing
+    tr.note("batch", 99.0)
+    assert tr.burn_rate("batch") is None
+
+
+def test_breach_cause_ewma():
+    clock = [0.0]
+    ew = BreachCauseEwma(alpha=0.5, max_age_s=300.0,
+                         now_fn=lambda: clock[0])
+    ew.note({"qos": "interactive",
+             "ttft": {"compile": 80.0, "queue_wait": 20.0}})
+    assert ew.shares()["interactive"] == pytest.approx(0.8)
+    ew.note({"qos": "interactive",
+             "ttft": {"compile": 0.0, "queue_wait": 100.0}})
+    assert ew.shares()["interactive"] == pytest.approx(0.4)
+    # staleness: yesterday's compile cliff must not classify today's load
+    # breach — an expired entry reads 0.0 (explicitly, so the exported
+    # gauge resets instead of latching the controller's deferral)
+    clock[0] = 400.0
+    assert ew.shares()["interactive"] == 0.0
+    # a fresh note after expiry restarts the EWMA (no blend with stale)
+    ew.note({"qos": "interactive",
+             "ttft": {"compile": 100.0, "queue_wait": 0.0}})
+    assert ew.shares()["interactive"] == pytest.approx(1.0)
+
+
+def test_observe_parses_burn_gauges():
+    from dynamo_tpu.autoscale.observe import (BURN_RATE_METRIC,
+                                              parse_gauge_by_class)
+
+    text = (f'{BURN_RATE_METRIC}{{class="interactive"}} 2.5\n'
+            f'{BURN_RATE_METRIC}{{class="standard"}} 0.25\n'
+            'dynamo_other{class="x"} 9\n')
+    assert parse_gauge_by_class(text, BURN_RATE_METRIC) == {
+        "interactive": 2.5, "standard": 0.25}
+    assert parse_gauge_by_class(None, BURN_RATE_METRIC) == {}
+
+
+async def test_controller_consumes_burn_and_defers_compile_cliff():
+    """The reactive SLO term distinguishes breach causes: legacy feeds
+    (no burn signal) scale as before; burn < 1 holds; a compile-dominated
+    breach defers; a load breach with burn ≥ 1 scales."""
+    from dynamo_tpu.autoscale.controller import AutoscaleController
+    from dynamo_tpu.autoscale.observe import FusedObservation
+    from dynamo_tpu.autoscale.slo import SloConfig
+    from dynamo_tpu.planner.planner_core import Decision
+
+    class FakePlanner:
+        def __init__(self):
+            self.current = Decision(1, 1)
+            self.cfg = type("C", (), {"max_prefill_replicas": 1,
+                                      "min_prefill_replicas": 1})()
+
+        def observe(self, obs):
+            pass
+
+        def compute(self):
+            return Decision(1, 1)
+
+    class FakeConnector:
+        def __init__(self):
+            self.applied = []
+
+        async def apply(self, d):
+            self.applied.append(d)
+
+    def fused(**kw):
+        f = FusedObservation()
+        f.ttft_p95_ms = {"interactive": 500.0}  # breach (target 200)
+        for k, v in kw.items():
+            setattr(f, k, v)
+        return f
+
+    async def run_tick(f):
+        conn = FakeConnector()
+        ctl = AutoscaleController(
+            SloConfig.load(env={}), FakePlanner(), source=None,
+            connector=conn, now_fn=lambda: 1000.0)
+
+        async def src():
+            return f
+        ctl.source = src
+        res = await ctl.tick()
+        return ctl, conn, res
+
+    # legacy: breach with NO burn signal → scale (old behavior preserved)
+    ctl, conn, res = await run_tick(fused())
+    assert res.reason == "slo_breach" and conn.applied
+
+    # burn present but inside the error budget → hold
+    ctl, conn, res = await run_tick(fused(slo_burn={"interactive": 0.4}))
+    assert res.reason == "breach_within_budget" and not conn.applied
+
+    # compile-cliff dominated breach → defer (readiness gating owns it)
+    ctl, conn, res = await run_tick(fused(
+        slo_burn={"interactive": 5.0},
+        breach_compile_share={"interactive": 0.9}))
+    assert res.reason == "breach_compile_deferred" and not conn.applied
+    assert ctl.deferred_for_compile == 1
+
+    # sustained load breach (burn ≥ 1, not compile) → scale
+    ctl, conn, res = await run_tick(fused(
+        slo_burn={"interactive": 5.0},
+        breach_compile_share={"interactive": 0.1}))
+    assert res.reason == "slo_breach" and conn.applied
+    assert res.breaches["interactive"]["burn"] == 5.0
+
+    # a held/deferred breach must also HOLD the fleet: the planner's
+    # dipped forecast (throughput collapsed during the cliff) must not
+    # shrink capacity mid-breach under a "deferred" label
+    conn = FakeConnector()
+    ctl = AutoscaleController(
+        SloConfig.load(env={}), FakePlanner(), source=None,
+        connector=conn, now_fn=lambda: 1000.0)
+    ctl.applied = Decision(1, 3)          # current fleet above the
+    ctl.planner.current = Decision(1, 3)  # planner's (1, 1) target
+
+    async def src():
+        return fused(slo_burn={"interactive": 5.0},
+                     breach_compile_share={"interactive": 0.9})
+    ctl.source = src
+    res = await ctl.tick()
+    assert res.reason == "breach_compile_deferred"
+    assert not conn.applied                      # no scale-DOWN either
+    assert ctl.applied.decode_replicas == 3
+
+
+def test_burn_gauge_decays_for_idle_class():
+    """A class that stops sending traffic must not freeze its last burn
+    value on /metrics — the gauge refreshes to the window-trimmed rate
+    (0 once the window empties) at scrape time."""
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager
+
+    svc = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    clock = [0.0]
+    svc._burn = SloBurnTracker(svc.slo, window_s=60.0, error_budget=0.05,
+                               now_fn=lambda: clock[0])
+    ctx = Context()
+    ctx.priority = "interactive"
+    svc._note_slo(ctx, 5.0)  # far over the 200 ms default target
+    svc._refresh_slo_gauges()  # what handle_metrics runs per scrape
+    assert 'dynamo_slo_burn_rate{class="interactive"} 20.0' in \
+        svc.metrics.render()
+    clock[0] = 120.0         # window empties; class goes idle
+    svc._refresh_slo_gauges()  # what handle_metrics runs per scrape
+    assert 'dynamo_slo_burn_rate{class="interactive"} 0' in \
+        svc.metrics.render()
+
+
+# --------------------------------------------- anomaly-triggered profiler
+
+
+def test_anomaly_profiler_arming_budget_cooldown(tmp_path):
+    from dynamo_tpu.observability.flight import StepRecord
+
+    clock = [0.0]
+    calls = {"start": [], "stop": 0}
+    prof = AnomalyProfiler(
+        str(tmp_path), steps=2, cooldown_s=100.0, max_captures=2,
+        start_fn=lambda p: calls["start"].append(p),
+        stop_fn=lambda: calls.__setitem__("stop", calls["stop"] + 1),
+        now_fn=lambda: clock[0])
+
+    def rec(seq, tags):
+        return StepRecord(seq=seq, kind="ragged", wall_ms=1.0,
+                          tags=list(tags))
+
+    # untagged records never arm
+    prof.on_record(rec(1, []))
+    assert not calls["start"]
+    # a slow-step tag arms; the path lands on the TRIGGERING record
+    r = rec(2, ["slow-step"])
+    prof.on_record(r)
+    assert len(calls["start"]) == 1 and r.profile_path
+    # bounded: stops after `steps` further records (tagged or not)
+    prof.on_record(rec(3, ["slow-step"]))
+    assert calls["stop"] == 0
+    prof.on_record(rec(4, []))
+    assert calls["stop"] == 1
+    # cooldown: the next anomaly inside the window does NOT re-arm
+    prof.on_record(rec(5, ["compile-steady"]))
+    assert len(calls["start"]) == 1
+    clock[0] = 150.0
+    prof.on_record(rec(6, ["compile-steady"]))
+    assert len(calls["start"]) == 2
+    prof.on_record(rec(7, []))
+    prof.on_record(rec(8, []))
+    # lifetime budget: capture 3 never starts
+    clock[0] = 400.0
+    prof.on_record(rec(9, ["slow-step"]))
+    assert len(calls["start"]) == 2 and prof.captures == 2
+    # a broken start disables the profiler instead of breaking the loop
+    broken = AnomalyProfiler(
+        str(tmp_path), steps=1, cooldown_s=0.0, max_captures=5,
+        start_fn=lambda p: 1 / 0, stop_fn=lambda: None,
+        now_fn=lambda: clock[0])
+    broken.on_record(rec(1, ["slow-step"]))
+    assert broken._broken
+
+
+def test_anomaly_profiler_from_env(monkeypatch, tmp_path):
+    monkeypatch.delenv("DYN_PROFILE_ON_ANOMALY", raising=False)
+    assert AnomalyProfiler.from_env() is None
+    monkeypatch.setenv("DYN_PROFILE_ON_ANOMALY", str(tmp_path))
+    monkeypatch.setenv("DYN_PROFILE_MAX_CAPTURES", "1")
+    prof = AnomalyProfiler.from_env()
+    assert prof is not None and prof.max_captures == 1
+
+
+# -------------------------------------------- residual property (seeded)
+
+
+async def test_residual_property_on_engine_drive():
+    """Seeded tiny-engine drive: per-request bucket sums + residual equal
+    the measured e2e (exact by construction — the sweep partitions the
+    window) and the residual stays a small fraction. Also proves the
+    engine stamps flight identity on spans and ids into records."""
+    import numpy as np
+
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+
+    configure_tracer(service="attr-test")
+    cfg = ModelConfig.tiny()
+    eng = AsyncJaxEngine(cfg, EngineArgs(
+        block_size=4, num_blocks=256, max_num_seqs=8,
+        max_num_batched_tokens=128, max_model_len=512,
+        enable_prefix_caching=False))
+    rng = np.random.default_rng(11)
+    try:
+        async def one(i):
+            ctx = Context()
+            ctx.priority = "interactive" if i % 2 else "batch"
+            ctx.ensure_traceparent()
+            req = PreprocessedRequest(
+                model="m",
+                token_ids=rng.integers(1, cfg.vocab_size, 24).tolist(),
+                stop_conditions=StopConditions(max_tokens=12,
+                                               ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=0.0))
+            async for _ in eng.generate(req, ctx):
+                pass
+            return ctx.id
+
+        rids = await asyncio.gather(*[one(i) for i in range(6)])
+        for rid in rids:
+            doc = await gather_attribution(rid)
+            assert doc is not None, rid
+            total = sum(doc["total"].values())
+            assert total == pytest.approx(doc["e2e_ms"], rel=0.001,
+                                          abs=0.05)
+            assert doc["residual_ms"] <= 0.10 * doc["e2e_ms"] + 1.0
+            # real compute got attributed, not residualized
+            assert (doc["total"].get("prefill_compute", 0.0)
+                    + doc["total"].get("decode_compute", 0.0)
+                    + doc["total"].get("compile", 0.0)
+                    + doc["total"].get("queue_wait", 0.0)) > 0
+    finally:
+        await eng.close()
+
+
+# ------------------------------------------------ HTTP route + burn gauge
+
+
+async def test_attribution_http_route_and_burn_metrics(monkeypatch):
+    """Full mocker stack: a streamed request, then
+    GET /v1/attribution/{rid} answers with buckets summing to e2e, the
+    breakdown histograms + dynamo_slo_burn_rate{class} show on /metrics,
+    and an unknown id 404s while a sampled-out id with flight linkage
+    still answers (flight-only)."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+    from dynamo_tpu.mocker.engine import MockEngineArgs
+    from dynamo_tpu.mocker.main import run_mocker
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    configure_tracer(service="attr-http")
+    rt = await DistributedRuntime.create()
+    engines, handles = [], []
+    watcher = service = None
+    try:
+        args = MockEngineArgs(vocab_size=make_test_tokenizer().vocab_size,
+                              block_size=4, num_gpu_blocks=128,
+                              speedup_ratio=20.0)
+        engines, handles = await run_mocker(rt, "attr", args)
+        manager = ModelManager()
+        watcher = await ModelWatcher(rt, manager, router_mode="kv").start()
+        service = HttpService(manager, host="127.0.0.1", port=0,
+                              runtime=rt)
+        await service.start()
+        for _ in range(200):
+            if manager.list_models():
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise RuntimeError("model never appeared in discovery")
+
+        rid = "attr-route-request"
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                    f"{base}/v1/completions",
+                    json={"model": "attr", "prompt": "hello tokens stream",
+                          "max_tokens": 8, "stream": True,
+                          "ignore_eos": True},
+                    headers={"x-request-id": rid}) as resp:
+                assert resp.status == 200, await resp.text()
+                async for _ in resp.content:
+                    pass
+            async with http.get(f"{base}/v1/attribution/{rid}") as resp:
+                assert resp.status == 200, await resp.text()
+                doc = await resp.json()
+            async with http.get(f"{base}/v1/attribution/nope-404") as resp:
+                assert resp.status == 404
+            async with http.get(f"{base}/metrics") as resp:
+                metrics_text = await resp.text()
+
+        assert doc["request_id"] == rid
+        assert doc["trace_sampled"] is True
+        assert sum(doc["total"].values()) == pytest.approx(
+            doc["e2e_ms"], rel=0.001, abs=0.05)
+        # the serving mocker's steps were matched (compute attributed)
+        assert (doc["total"].get("prefill_compute", 0.0)
+                + doc["total"].get("decode_compute", 0.0)) > 0
+        # surfaces: burn gauge + breakdown histograms on /metrics
+        assert 'dynamo_slo_burn_rate{class="standard"}' in metrics_text
+        assert "dynamo_ttft_breakdown_seconds" in metrics_text
+        assert 'phase="decode_compute"' in metrics_text \
+            or 'phase="prefill_compute"' in metrics_text
+    finally:
+        if service is not None:
+            await service.stop()
+        if watcher is not None:
+            await watcher.stop()
+        for h in handles:
+            await h.stop(graceful=False)
+        for e in engines:
+            await e.stop()
+        await rt.shutdown()
+
+
+async def test_sampled_out_http_is_flight_only_not_404(monkeypatch):
+    """DYN_TRACE_SAMPLE drops the trace, but the step linkage still
+    answers /v1/attribution with trace_sampled=false (the satellite's
+    degrade-not-404 contract)."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.observability import trace_sampled
+
+    # an id the 0.001-rate sampler drops
+    rid = next(f"u-{i}" for i in range(1000)
+               if not trace_sampled(f"u-{i}", 0.001))
+    monkeypatch.setenv("DYN_TRACE_SAMPLE", "0.001")
+    rec = FlightRecorder(service="w", capacity=64, enabled=True)
+    rec.record("mock", 5.0, decode_rows=1, decode_ids=[rid])
+    name = register_recorder("wsample", rec)
+    svc = HttpService(ModelManager(), host="127.0.0.1", port=0)
+    try:
+        port = await svc.start()
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                    f"http://127.0.0.1:{port}/v1/attribution/{rid}") as r:
+                assert r.status == 200
+                doc = await r.json()
+        assert doc["trace_sampled"] is False
+        assert doc["flight_only"] is True
+        assert doc["total"].get("decode_compute", 0.0) > 0
+    finally:
+        unregister_recorder(name)
+        await svc.stop()
+
+
+# ------------------------------------------- migration hint (wire-level)
+
+
+async def test_migration_restore_hint_carries_prev_worker():
+    """Migration's re-send names the broken leg's flight identity
+    (prev_worker/prev_seq) learned from the first frame — the stitch key
+    the kv.restore span republishes for attribution."""
+    from dynamo_tpu.llm.pipeline import Migration
+    from dynamo_tpu.protocols import (LLMEngineOutput, PreprocessedRequest,
+                                      SamplingOptions, StopConditions)
+    from dynamo_tpu.runtime.context import StreamError
+
+    seen = []
+
+    async def downstream(req, ctx):
+        seen.append(req)
+        if len(seen) == 1:
+            yield LLMEngineOutput(
+                token_ids=[5],
+                flight={"worker": "inst-dead", "recorder": "engine",
+                        "seq": 42}).to_wire()
+            raise StreamError("boom", retryable=True)
+        yield LLMEngineOutput(token_ids=[6], finish_reason="stop").to_wire()
+
+    req = PreprocessedRequest(
+        model="m", token_ids=[1, 2, 3],
+        stop_conditions=StopConditions(max_tokens=8),
+        sampling_options=SamplingOptions())
+    out = []
+    async for o in Migration(downstream).generate(req, Context()):
+        out.append(o)
+    assert [t for o in out for t in o.token_ids] == [5, 6]
+    hint = seen[1].restore
+    assert hint["emitted"] == 1 and hint["attempt"] == 1
+    assert hint["prev_worker"] == "inst-dead"
+    assert hint["prev_name"] == "engine"
+    assert hint["prev_seq"] == 42
+    assert hint["t_break"] == pytest.approx(time.time(), abs=30)
+    # the flight dict survives the wire round trip sparsely
+    w = LLMEngineOutput(token_ids=[1]).to_wire()
+    assert "flight" not in w
+    assert LLMEngineOutput.from_wire(
+        {"token_ids": [1], "flight": {"worker": "x"}}).flight == {
+            "worker": "x"}
+
+
+async def test_engine_spans_carry_flight_identity():
+    """The real engine's engine.ttft/engine.decode spans stamp this
+    worker's instance + step interval, and its step records carry the
+    request-id linkage (the join's two keys)."""
+    import numpy as np
+
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.observability import get_tracer
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+
+    configure_tracer(service="attr-engine")
+    cfg = ModelConfig.tiny()
+    eng = AsyncJaxEngine(cfg, EngineArgs(
+        block_size=4, num_blocks=128, max_num_seqs=4,
+        max_num_batched_tokens=64, max_model_len=256,
+        enable_prefix_caching=False))
+    try:
+        ctx = Context()
+        ctx.ensure_traceparent()
+        rng = np.random.default_rng(3)
+        req = PreprocessedRequest(
+            model="m",
+            token_ids=rng.integers(1, cfg.vocab_size, 12).tolist(),
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0))
+        first = None
+        async for out in eng.generate(req, ctx):
+            if first is None and out.token_ids:
+                first = out
+        assert first.flight["worker"] == flight_instance()
+        assert first.flight["recorder"] == eng._flight_name
+        spans = {s.name: s for s in get_tracer().spans_for(ctx.id)}
+        for name in ("engine.ttft", "engine.decode"):
+            at = spans[name].attributes
+            assert at["flight_instance"] == flight_instance()
+            assert at["flight_name"] == eng._flight_name
+            assert at["seq1"] >= at["seq0"]
+        recs = eng.flight.snapshot()
+        assert any(ctx.id in (r.get("decode_ids") or []) for r in recs)
+        assert any(ctx.id in (r.get("prefill_ids") or []) for r in recs)
+    finally:
+        await eng.close()

@@ -148,8 +148,10 @@ def test_summary_math():
     s = rec.summary()
     assert s["steps_total"] == 10
     assert s["tokens_in_ring"] == 50
-    assert s["wall_p50_ms"] == 6.0  # sorted[5] of 1..10
-    assert s["wall_p95_ms"] == 10.0
+    # the shared interpolated estimator (observability/stats.quantile):
+    # p50 of 1..10 interpolates between the 5th and 6th order statistics
+    assert s["wall_p50_ms"] == 5.5
+    assert s["wall_p95_ms"] == 9.55
     assert s["kv_tiers"] == {"g1": 5, "g2": 1}
     assert s["waiting"] == 1 and s["running"] == 2
 
