@@ -2285,6 +2285,391 @@ async def _tools_peer_leg(cfg, vocab, pattern, eos_id, schema_valid,
         await rt.shutdown()
 
 
+async def kvaudit_bench(on_tpu: bool = False) -> dict:
+    """``bench.py --kvaudit``: the KV index audit plane's contracts
+    (ISSUE 15 acceptance; docs/observability.md "KV audit").
+
+    Scenario 1 — mocker fleet under seeded ``plane.publish:drop`` chaos
+    on the KV event stream: stored AND removed events are lost before
+    the hub assigns a seq (no gap for the indexer to see), leaving the
+    radix silently diverged. Gates: the auditor detects within one audit
+    interval, classifies phantom vs missing EXACTLY against ground truth
+    (worker ledgers + publisher mirrors vs the tree), heals via resync
+    to digest equality, and a clean interleaved A/B (audit on vs off,
+    same seeded prompts) streams bit-identical with ≤1% audit overhead
+    (measured directly: audit cycle wall / the production 30 s interval).
+
+    Scenario 2 — stale-advert demand loop on a real 2-engine fleet:
+    worker A's prefix is evicted with its events suppressed (the radix
+    keeps advertising it); admissions steered to B plan doomed pulls,
+    tagged ``outcome=stale_advert``; the suspicion report wakes the
+    router's auditor, which purges + resyncs (the ledger-aware replay
+    retracts A's stale mirror entries), after which further admissions
+    plan no pulls at A — the stale-advert rate returns to zero.
+    """
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+    from dynamo_tpu.mocker.engine import MockEngineArgs
+    from dynamo_tpu.mocker.main import run_mocker
+    from dynamo_tpu.observability.kvaudit import AuditConfig, KvAuditor
+    from dynamo_tpu.router.publisher import reachable_chain
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.chaos import configure_chaos
+
+    out: dict = {}
+    U64 = (1 << 64) - 1
+    AUDIT_INTERVAL = 0.6
+    rng = np.random.default_rng(77)
+    prompts = [rng.integers(10, 200, 24).tolist() for _ in range(8)]
+    evictors = [rng.integers(210, 400, 40).tolist() for _ in range(5)]
+
+    async def fleet(name):
+        rt = await DistributedRuntime.create()
+        args = MockEngineArgs(vocab_size=make_test_tokenizer().vocab_size,
+                              block_size=4, num_gpu_blocks=72, dp_size=2,
+                              speedup_ratio=50.0)
+        engines, handles = await run_mocker(rt, name, args)
+        manager = ModelManager()
+        watcher = await ModelWatcher(rt, manager, router_mode="kv").start()
+        service = HttpService(manager, port=0, runtime=rt)
+        await service.start()
+        for _ in range(200):
+            if manager.list_models():
+                break
+            await asyncio.sleep(0.05)
+        return rt, engines, handles, manager, watcher, service
+
+    async def teardown(rt, engines, handles, watcher, service):
+        await service.stop()
+        await watcher.stop()
+        for h in handles:
+            await h.stop(graceful=False)
+        for e in engines:
+            await e.stop()
+        await rt.shutdown()
+
+    async def wave(service, name, ps):
+        texts = []
+        url = f"http://127.0.0.1:{service.port}/v1/completions"
+        async with aiohttp.ClientSession() as session:
+            for i, p in enumerate(ps):
+                async with session.post(url, json={
+                        "model": name, "prompt": list(p),
+                        "max_tokens": 12, "ignore_eos": True}) as r:
+                    assert r.status == 200, await r.text()
+                    data = await r.json()
+                    texts.append(data["choices"][0]["text"])
+        return texts
+
+    def gt_divergence(engines, tree):
+        """Ground truth per worker: (phantom, missing) hash sets from the
+        ledgers + mirrors vs the radix — the same taxonomy the auditor
+        must reproduce from wire digests alone."""
+        gt = {}
+        for e in engines:
+            wid = e.kv_publisher.worker_id
+            resident = {h & U64 for h in e.kv_ledger.servable_hashes()}
+            anchored = {bh & U64 for bh, _p, _t in reachable_chain(
+                e.kv_publisher.announced_chain(),
+                member={h & U64 for h in resident})}
+            radix = {h & U64 for h in tree.worker_hashes(wid)}
+            gt[wid] = (radix - resident, anchored - radix)
+        return gt
+
+    # ---- scenario 1: audit-off arm first (stream identity baseline)
+    os.environ["DYN_KV_AUDIT"] = "0"
+    try:
+        rt2, eng2, h2, man2, wat2, svc2 = await fleet("kvaudit-off")
+        try:
+            texts_off = await wave(svc2, "kvaudit-off", prompts)
+        finally:
+            await teardown(rt2, eng2, h2, wat2, svc2)
+
+        # ---- audit-on arm: same prompts, auditor live during the wave
+        rt, engines, handles, manager, watcher, service = await fleet(
+            "kvaudit-on")
+        auditor = detect_auditor = None
+        try:
+            sm = manager.get("kvaudit-on")
+            idx = sm.router.indexer
+            acfg = AuditConfig(interval_s=AUDIT_INTERVAL, settle_s=0.05)
+            auditor = await KvAuditor(rt.plane, idx, acfg).start()
+            texts_on = await wave(service, "kvaudit-on", prompts)
+            out["streams_identical"] = texts_on == texts_off
+            # clean fleet: one audited cycle must report zero divergence,
+            # and its wall time is the DIRECT overhead measurement
+            cycle_walls = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                doc = await auditor.audit_once()
+                cycle_walls.append(time.perf_counter() - t0)
+            out["clean_divergence"] = sum(
+                w["phantom"] + w["missing"]
+                for w in doc["workers"].values())
+            out["audit_cycle_ms"] = round(
+                min(cycle_walls) * 1000.0, 3)
+            # production duty cycle: one cycle per DYN_KV_AUDIT_INTERVAL
+            # (default 30 s) — overhead is cycle wall over the interval
+            out["audit_overhead_frac"] = round(
+                min(cycle_walls) / 30.0, 6)
+            await auditor.stop()
+            auditor = None
+
+            # ---- seeded chaos: KV events lost BEFORE the hub assigns a
+            # seq (my stream_publish chaos hook) — gap detection is blind
+            configure_chaos("plane.publish:drop=1.0", seed=7)
+            try:
+                await wave(service, "kvaudit-on", evictors)
+            finally:
+                configure_chaos(None)
+            # settle: drain whatever did reach the stream
+            tail = await rt.plane.stream_last_seq("kv_events")
+            for _ in range(300):
+                if idx._last_seq >= tail:
+                    break
+                await asyncio.sleep(0.01)
+            gt = gt_divergence(engines, idx.tree)
+            out["gt_phantom"] = sum(len(p) for p, _m in gt.values())
+            out["gt_missing"] = sum(len(m) for _p, m in gt.values())
+
+            # ---- detection + classification: a REPORT-ONLY production
+            # auditor (DYN_KV_AUDIT_HEAL=0 semantics) must find the
+            # divergence within one interval and classify every worker
+            # against ground truth — report-only because a healing
+            # auditor's FIRST resync repairs the whole fleet's missing
+            # blocks at once, leaving later-audited workers nothing to
+            # classify (traffic is quiesced, so gt is static until heal)
+            import dataclasses as _dc
+
+            detect_auditor = KvAuditor(
+                rt.plane, idx, _dc.replace(acfg, heal_enabled=False))
+            diverged_wids = [wid for wid, (p, m) in gt.items() if p or m]
+            t0 = time.perf_counter()
+            await detect_auditor.start()
+            detected = False
+            for _ in range(int((AUDIT_INTERVAL + 3.0) / 0.02)):
+                if diverged_wids and all(
+                        (detect_auditor.worker_state.get(w) or {}).get(
+                            "diverged_since") for w in diverged_wids):
+                    detected = True
+                    break
+                await asyncio.sleep(0.02)
+            out["detect_latency_s"] = round(time.perf_counter() - t0, 3)
+            out["detected_within_interval"] = (
+                detected
+                and out["detect_latency_s"] <= AUDIT_INTERVAL + 2.0)
+            # counts per worker must match gt exactly, samples ⊆ gt sets
+            classified_ok = detected
+            for e in engines:
+                wid = e.kv_publisher.worker_id
+                st = detect_auditor.worker_state.get(wid) or {}
+                gp, gm = gt.get(wid, (set(), set()))
+                if (st.get("phantom", 0), st.get("missing", 0)) \
+                        != (len(gp), len(gm)):
+                    classified_ok = False
+                samp = st.get("samples") or {}
+                if not set(samp.get("phantom") or ()) <= gp \
+                        or not set(samp.get("missing") or ()) <= gm:
+                    classified_ok = False
+            out["classified_correctly"] = classified_ok
+            await detect_auditor.stop()
+
+            # ---- heal: the healing auditor must drive phantom+missing
+            # to zero (dangling — mid-chain LRU holes no resync can
+            # re-anchor — is reported, not counted as divergence)
+            detect_auditor = await KvAuditor(rt.plane, idx, acfg).start()
+            healed = False
+            for _ in range(40):
+                doc = await detect_auditor.audit_once()
+                remaining = sum(w["phantom"] + w["missing"]
+                                for w in doc["workers"].values())
+                if detect_auditor.heals_total and remaining == 0:
+                    healed = True
+                    break
+                await asyncio.sleep(0.25)
+            out["healed"] = healed
+            out["heals_total"] = dict(detect_auditor.heals_total)
+            out["post_heal_divergence"] = sum(
+                w["phantom"] + w["missing"]
+                for w in doc["workers"].values())
+            out["post_heal_dangling"] = sum(
+                w["dangling"] for w in doc["workers"].values())
+        finally:
+            for a in (auditor, detect_auditor):
+                if a is not None:
+                    await a.stop()
+            await teardown(rt, engines, handles, watcher, service)
+    finally:
+        os.environ.pop("DYN_KV_AUDIT", None)
+
+    # ---- scenario 2: stale-advert demand loop on a real engine fleet
+    out.update(await _kvaudit_stale_advert_leg(AUDIT_INTERVAL))
+
+    out["kvaudit_ok"] = bool(
+        out["streams_identical"]
+        and out["clean_divergence"] == 0
+        and out["audit_overhead_frac"] <= 0.01
+        and out["gt_phantom"] > 0
+        and out["gt_missing"] > 0
+        and out["detected_within_interval"]
+        and out["classified_correctly"]
+        and out["healed"]
+        and out["post_heal_divergence"] == 0
+        and out["stale_adverts_pre_heal"] >= 1
+        and out["stale_adverts_post_heal"]
+        == out["stale_adverts_pre_heal"]
+        and out["stale_heal_cause"] == "phantom")
+    return out
+
+
+async def _kvaudit_stale_advert_leg(audit_interval: float) -> dict:
+    """kvaudit scenario 2: doomed pulls at a lying advert are tagged
+    stale_advert, suspicion wakes the auditor, the heal retracts the
+    advert, and subsequent admissions stop planning pulls there."""
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler, KvPullHandler
+    from dynamo_tpu.disagg.transfer import OnboardConfig, RestoreConfig
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.observability.kvaudit import serve_kv_digest
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+    from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
+    from dynamo_tpu.router.protocols import KvRouterConfig
+    from dynamo_tpu.router.publisher import KvEventPublisher
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.context import Context
+
+    cfg = ModelConfig.tiny()
+    bs = 16
+    isl, OSL = 256, 8
+    rng = np.random.default_rng(91)
+    prefix = rng.integers(3, cfg.vocab_size, isl).tolist()
+    rcfg = RuntimeConfig(lease_ttl=8.0)
+    rt = await DistributedRuntime.create(config=rcfg)
+    workers = []
+    router = client = None
+
+    async def make_worker():
+        wrt = await DistributedRuntime.create(plane=rt.plane,
+                                              owns_plane=False, config=rcfg)
+        lease = await wrt.primary_lease()
+        eng = await asyncio.to_thread(
+            AsyncJaxEngine, cfg, EngineArgs(
+                block_size=bs, num_blocks=4 * (isl // bs) + 64,
+                max_num_seqs=4, max_num_batched_tokens=1024,
+                max_model_len=isl + 8 * (OSL + 16) + bs,
+                enable_prefix_caching=True))
+        pub = KvEventPublisher(wrt.plane, worker_id=lease, kv_block_size=bs,
+                               ledger=eng.kv_ledger)
+        await pub.start_resync_responder()
+        eng.event_cb = pub.publish_sync
+        comp = wrt.namespace("dynamo").component("backend")
+        pull_client = await comp.endpoint("kv_pull").client().start()
+        handler = DecodeWorkerHandler(
+            eng, pull_clients=[pull_client], metrics=wrt.metrics,
+            restore_config=RestoreConfig(enabled=False),
+            onboard_config=OnboardConfig(enabled=True), plane=rt.plane)
+        handler.instance_id = lease
+        h_gen = await comp.endpoint("generate").serve_endpoint(
+            handler.generate, lease_id=lease)
+        h_pull = await comp.endpoint("kv_pull").serve_endpoint(
+            KvPullHandler(eng).generate, lease_id=lease)
+        h_dig = await serve_kv_digest(wrt, eng.kv_ledger, lease,
+                                      publisher=pub)
+        w = type("W", (), {})()
+        w.rt, w.engine, w.lease, w.handler = wrt, eng, lease, handler
+        w.pub, w.pull_client = pub, pull_client
+        w.handles = [h_gen, h_pull]
+        w.dig = h_dig
+        workers.append(w)
+        return w
+
+    def req(suffix, pin=None):
+        return PreprocessedRequest(
+            model="m", token_ids=prefix + list(suffix),
+            stop_conditions=StopConditions(max_tokens=OSL, ignore_eos=True),
+            sampling_options=SamplingOptions(temperature=0.0),
+            backend_instance_id=pin)
+
+    def stale_count(w):
+        return int(w.handler._pull_outcomes._values.get(
+            (("outcome", "stale_advert"),), 0))
+
+    out: dict = {}
+    os.environ["DYN_KV_AUDIT_INTERVAL"] = str(audit_interval)
+    os.environ["DYN_KV_AUDIT_SETTLE"] = "0.05"
+    try:
+        a = await make_worker()
+        b = await make_worker()
+        client = await (rt.namespace("dynamo").component("backend")
+                        .endpoint("generate").client().start())
+        router = await KvRouter(rt.plane, bs, KvRouterConfig()).start()
+        push = KvPushRouter(client, router)
+
+        # A computes (and keeps) the shared prefix; the radix learns it
+        async for _ in push.generate(req([9001], pin=a.lease), Context()):
+            pass
+        for _ in range(400):
+            if router.restore_sources(prefix + [1]).get(a.lease, 0) \
+                    >= isl // bs - 1:
+                break
+            await asyncio.sleep(0.02)
+        # the suppression bug: A's prefix leaves the device pool with its
+        # removal events swallowed — ledger truthful, mirror + radix stale
+        a.engine.event_cb = None
+        a.engine.pool.clear()
+        out["advertised_after_evict"] = router.indexer.tree.worker_counts(
+            ).get(a.lease, 0)
+        client.set_busy_instances([a.lease])  # admissions land on B
+        t0 = time.perf_counter()
+        async for _ in push.generate(req([9100]), Context()):
+            pass
+        out["stale_adverts_pre_heal"] = stale_count(b)
+        # the suspicion report wakes the router's own auditor: wait for
+        # the phantom heal to retract A's adverts from the radix
+        healed = False
+        for _ in range(int((audit_interval + 8.0) / 0.05)):
+            if router.auditor is not None \
+                    and router.auditor.heals_total.get("phantom") \
+                    and not router.indexer.tree.worker_counts().get(
+                        a.lease, 0):
+                healed = True
+                break
+            await asyncio.sleep(0.05)
+        out["stale_heal_s"] = round(time.perf_counter() - t0, 3)
+        out["stale_heal_cause"] = ("phantom" if healed else "none")
+        # post-heal: the radix no longer lies, so fresh admissions plan
+        # no pulls at A — the stale-advert rate returns to zero
+        for i in range(3):
+            async for _ in push.generate(req([9200 + i]), Context()):
+                pass
+        out["stale_adverts_post_heal"] = stale_count(b)
+        out["stale_suspicion_seen"] = bool(
+            router.auditor is not None
+            and router.auditor.stale_adverts.get(a.lease, 0) >= 1)
+        return out
+    finally:
+        os.environ.pop("DYN_KV_AUDIT_INTERVAL", None)
+        os.environ.pop("DYN_KV_AUDIT_SETTLE", None)
+        for w in workers:
+            for h in w.handles:
+                await h.stop(graceful=False)
+            await w.dig.stop()
+            await w.pull_client.stop()
+            await w.pub.stop()
+            await w.engine.close()
+            await w.rt.shutdown()
+        if router is not None:
+            await router.stop()
+        if client is not None:
+            await client.stop()
+        await rt.shutdown()
+
+
 async def autoscale_bench(duration_s: float = 40.0,
                           chaos_spec: str = "stream.send:drop=0.02",
                           chaos_seed: int = 1234) -> dict:
@@ -2778,6 +3163,24 @@ def main():
         print(json.dumps(out), flush=True)
         raise SystemExit(0 if out["flight_ok"] else 1)
 
+    if "--kvaudit" in sys.argv:
+        # KV index audit gates: seeded kv-event drop chaos → divergence
+        # detected within one audit interval, classified phantom/missing
+        # against ground truth, healed via resync; stale-advert pulls
+        # tagged + driven to zero; clean A/B bit-identical with ≤1%
+        # audit overhead (docs/observability.md "KV audit")
+        try:
+            out = asyncio.run(kvaudit_bench(False))
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"kvaudit": "failed", "error": repr(e)[:300]}),
+                  flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["kvaudit_ok"] else 1)
+
     if "--attribution" in sys.argv:
         # latency-attribution gates: per-request bucket sums + residual
         # equal measured e2e, streams bit-identical with attribution on
@@ -2915,19 +3318,20 @@ def _child_main():
               os.environ.get("DYN_BENCH_PHASES",
                              "kernel,spec,e2e,chaos,mem,qos,autoscale,"
                              "ragged,disagg,migration,onboard,flight,"
-                             "tools,attribution"
+                             "tools,attribution,kvaudit"
                              ).split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
                         "autoscale", "ragged", "disagg", "migration",
-                        "onboard", "flight", "tools", "attribution"}
+                        "onboard", "flight", "tools", "attribution",
+                        "kvaudit"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
                          f"chaos, mem, qos, autoscale, ragged, disagg, "
                          f"migration, onboard, flight, tools, "
-                         f"attribution)")
+                         f"attribution, kvaudit)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -3051,6 +3455,16 @@ def _child_main():
                 kern["attribution"] = asyncio.run(attribution_bench(on_tpu))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["attribution_error"] = repr(e)[:200]
+        if "kvaudit" in phases:
+            # KV index audit phase: seeded kv-event drop chaos →
+            # detection within one interval, ground-truth phantom/missing
+            # classification, resync heal, stale-advert rate to zero, and
+            # the ≤1% clean-overhead + stream-identity A/B (ISSUE 15
+            # acceptance)
+            try:
+                kern["kvaudit"] = asyncio.run(kvaudit_bench(on_tpu))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["kvaudit_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

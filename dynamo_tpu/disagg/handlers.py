@@ -181,7 +181,7 @@ class DecodeWorkerHandler:
                  config: Optional[DisaggConfig] = None, prefill_queue=None,
                  mm_client=None, metrics=None, topo_labels=None,
                  pull_clients=None, restore_config=None,
-                 onboard_config=None):
+                 onboard_config=None, plane=None):
         self.engine = engine
         self.prefill_client = prefill_client
         self.config = config or DisaggConfig()
@@ -246,6 +246,17 @@ class DecodeWorkerHandler:
                 "prefix_onboard_seconds",
                 "onboard phase wall per admission (residency probe + "
                 "pulls/G4 fetch + scatter/attach)")
+            # KV audit plane demand feedback (docs/observability.md "KV
+            # audit"): every restore/onboard pull classified by outcome —
+            # stale_advert (the advertised source lacked the blocks: a
+            # doomed pull, evidence the radix lied) distinct from torn /
+            # slow / dead transport failures
+            self._pull_outcomes = metrics.counter(
+                "kv_pull_outcome_total",
+                "restore/onboard pull attempts by outcome: pulled | "
+                "stale_advert (source lacked the advertised blocks) | "
+                "torn (bundle rejected) | slow (timeout) | dead "
+                "(transport failure)")
         else:
             self._xfer_bytes = self._xfer_seconds = None
             self._claim_fallback = self._pull_failures = None
@@ -256,6 +267,11 @@ class DecodeWorkerHandler:
             self._onboard_total = None
             self._onboard_blocks = None
             self._onboard_seconds = None
+            self._pull_outcomes = None
+        #: control plane for suspicion reports (kv_audit_suspect): set by
+        #: engine/main.py; falls back to a pull client's runtime plane so
+        #: in-process harnesses report without extra wiring
+        self._plane = plane
         from dynamo_tpu.disagg.transfer import OnboardConfig, RestoreConfig
 
         #: Clients whose instance sets cover potential restore sources
@@ -430,6 +446,40 @@ class DecodeWorkerHandler:
         return (ctx.remaining_s() if ctx is not None
                 and hasattr(ctx, "remaining_s") else None)
 
+    def _count_pull_outcome(self, outcome: str) -> None:
+        if self._pull_outcomes is not None:
+            self._pull_outcomes.inc(outcome=outcome)
+
+    def _report_suspect(self, wid: int, cause: str = "stale_advert") -> None:
+        """Feed a stale-advert pull failure back into the routers' KV
+        audit plane (kvaudit.KvAuditor): the source advertised blocks it
+        could not serve, so its radix entries are suspect — audit it
+        before idle workers. Fire-and-forget: a lost report only delays
+        the next scheduled audit."""
+        import msgpack as _msgpack
+
+        from dynamo_tpu.observability.kvaudit import KV_AUDIT_SUSPECT_SUBJECT
+        from dynamo_tpu.router.publisher import _spawn_publish
+
+        plane = self._plane
+        if plane is None:
+            for c in self.pull_clients:
+                rt = getattr(c, "_runtime", None)
+                if rt is not None:
+                    plane = rt.plane
+                    break
+        if plane is None:
+            return
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            # no running loop (sync caller in unit tests): bail BEFORE
+            # building the publish coroutine, or it leaks never-awaited
+            return
+        _spawn_publish(self, plane.publish(
+            KV_AUDIT_SUSPECT_SUBJECT,
+            _msgpack.packb({"worker_id": wid, "cause": cause})))
+
     async def _pull_from_sources(self, probe, hashes, sources, covered,
                                  want, cfg, ctx, info,
                                  reason: str = "restore") -> int:
@@ -468,15 +518,37 @@ class DecodeWorkerHandler:
                 info["pull_failures"] += 1
                 if self._pull_failures is not None:
                     self._pull_failures.inc()
+                self._count_pull_outcome(
+                    "slow" if isinstance(e, asyncio.TimeoutError)
+                    else "dead")
                 logger.warning("%s pull from %x failed (%s); "
                                "trying next source / recompute",
                                reason, wid, e)
+                continue
+            if not pulled:
+                # the source answered but had NOTHING of the advertised
+                # run — the radix lied about it (suppressed removal /
+                # lost event / tombstone leak), not a transport problem.
+                # Tag it apart from torn/slow/dead and raise the audit
+                # plane's suspicion so this worker is audited next.
+                info["pull_failures"] += 1
+                if self._pull_failures is not None:
+                    self._pull_failures.inc()
+                info["stale_adverts"] = info.get("stale_adverts", 0) + 1
+                self._count_pull_outcome("stale_advert")
+                self._report_suspect(wid)
+                logger.warning(
+                    "%s pull from %x returned nothing for %d advertised "
+                    "blocks (stale advert); trying next source / "
+                    "recompute", reason, wid, end - covered)
                 continue
             attached = self.engine.attach_restored(probe, covered, pulled)
             covered += attached
             info["restored_blocks"] += attached
             if attached:
+                self._count_pull_outcome("pulled")
                 break  # contiguous coverage extended; done
+            self._count_pull_outcome("torn")
         return covered
 
     async def _restore_migrated(self, req, ctx) -> dict:

@@ -232,10 +232,15 @@ class BlockPool:
     """
 
     def __init__(self, num_blocks: int, enable_prefix_caching: bool = True,
-                 on_removed: Optional[Callable[[list[int]], None]] = None):
+                 on_removed: Optional[Callable[[list[int]], None]] = None,
+                 ledger=None):
         self.num_blocks = num_blocks
         self.enable_prefix_caching = enable_prefix_caching
         self.on_removed = on_removed
+        #: optional WorkerKvLedger (observability/kvaudit.py): the audit
+        #: plane's device-tier (g1) residency digest, folded inline at
+        #: register/evict/clear — membership mirrors _by_hash exactly
+        self.ledger = ledger
         #: fn() called whenever release() returns capacity to the pool —
         #: the engine loop parks on it instead of polling when it is
         #: memory-starved (a freed block is exactly what unblocks plan())
@@ -294,6 +299,8 @@ class BlockPool:
                 h, bid = self._lru.popitem(last=False)
                 meta = self._meta.pop(bid)
                 self._by_hash.pop(h, None)
+                if self.ledger is not None:
+                    self.ledger.remove("g1", h)
                 evicted.append(meta.seq_hash)
             self._meta[bid] = BlockMeta(block_id=bid, ref_count=1)
             out.append(bid)
@@ -340,6 +347,8 @@ class BlockPool:
             return True
         if seq_hash in self._by_hash and self._by_hash[seq_hash] != block_id:
             return False
+        if self.ledger is not None and seq_hash not in self._by_hash:
+            self.ledger.add("g1", seq_hash)
         self._by_hash[seq_hash] = block_id
         return True
 
@@ -387,6 +396,8 @@ class BlockPool:
         for h, bid in list(self._lru.items()):
             self._meta.pop(bid, None)
             self._by_hash.pop(h, None)
+            if self.ledger is not None:
+                self.ledger.remove("g1", h)
             self._free.append(bid)
         self._lru.clear()
         if self.on_removed:

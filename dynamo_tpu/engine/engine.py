@@ -188,6 +188,13 @@ class AsyncJaxEngine:
             cfg, nb, args.block_size, mesh, global_arrays=self._multihost,
             dtype="int8" if self._kv_quant else None)
 
+        #: per-tier residency ledger (observability/kvaudit.py): the
+        #: worker-side ground truth the KV audit plane compares the
+        #: router's radix view against — rolling xor/count digests folded
+        #: inline at register/evict/tier-change, served via the
+        #: ``kv_digest`` wire op (engine/main.py)
+        from dynamo_tpu.observability.kvaudit import WorkerKvLedger
+        self.kv_ledger = WorkerKvLedger()
         self.kvbm = None
         if args.kvbm_host_bytes > 0 and args.enable_prefix_caching:
             from dynamo_tpu.kvbm import KvbmManager
@@ -197,7 +204,8 @@ class AsyncJaxEngine:
                                     # router-facing removed events fire
                                     # only when the LAST tier copy dies
                                     # (KvbmWorkerService chains onto this)
-                                    on_change=self._on_kvbm_change)
+                                    on_change=self._on_kvbm_change,
+                                    ledger=self.kv_ledger)
         #: set by engine/main.py when a distributed KVBM fleet is configured
         #: (RemoteKvbm — leader lookup + peer fetch)
         self.kvbm_remote = None
@@ -226,7 +234,8 @@ class AsyncJaxEngine:
         self._g4_publishing: set = set()
 
         self.pool = BlockPool(nb, args.enable_prefix_caching,
-                              on_removed=self._on_removed)
+                              on_removed=self._on_removed,
+                              ledger=self.kv_ledger)
         #: preempt-to-swap: host staging for preempted sequences' KV
         #: (scheduler-driven swap-out/swap-in replacing recompute). Budget
         #: shares the G2 tier's allowance when one is configured. Disabled

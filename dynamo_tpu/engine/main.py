@@ -411,7 +411,14 @@ async def amain():
     lease = await runtime.primary_lease()
     engine.dp_rank = cli.dp_rank
     kv_pub = KvEventPublisher(
-        runtime.plane, worker_id=lease, kv_block_size=args.block_size)
+        runtime.plane, worker_id=lease, kv_block_size=args.block_size,
+        # ledger-reconciling resyncs (docs/observability.md "KV audit"):
+        # a replay retracts announced-but-not-resident blocks instead of
+        # resurrecting phantoms at every purged router replica. Caching-
+        # off engines keep the ledger detached: they announce blocks the
+        # pool never registers (pre-existing advert semantics), so the
+        # ledger would read every advert as a phantom.
+        ledger=engine.kv_ledger if args.enable_prefix_caching else None)
     await kv_pub.start_resync_responder()
     engine.event_cb = kv_pub.publish_sync
     engine.metrics_cb = WorkerMetricsPublisher(
@@ -669,7 +676,8 @@ async def amain():
                                       prefill_queue=prefill_queue,
                                       mm_client=mm_client,
                                       metrics=runtime.metrics,
-                                      pull_clients=pull_clients)
+                                      pull_clients=pull_clients,
+                                      plane=runtime.plane)
         handler.instance_id = lease
         serve = handler.generate
         if cli.role == "decode":  # live-tunable threshold (disagg_router.rs)
@@ -768,6 +776,16 @@ async def amain():
     engine.flight.service = flight_name
     engine._flight_name = register_recorder(flight_name, engine.flight)
     await ensure_flight_endpoint(runtime)
+    # KV audit plane (docs/observability.md "KV audit"): serve this
+    # worker's per-tier residency digests + chain diffs so routers can
+    # continuously prove their radix view against tier ground truth.
+    # Caching-off engines serve no digest — their adverts are routing
+    # hints with no residency contract to audit.
+    if args.enable_prefix_caching:
+        from dynamo_tpu.observability.kvaudit import serve_kv_digest
+
+        await serve_kv_digest(runtime, engine.kv_ledger, lease,
+                              publisher=kv_pub)
     embed_handle = None
     if cli.role != "prefill":  # embeddings ride the decode/agg fleet
         embed_ep = ns.component(component).endpoint("embed")

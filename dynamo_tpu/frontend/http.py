@@ -257,6 +257,19 @@ class HttpService:
         #: keep refreshing to the window-trimmed value (→ 0.0) at scrape
         self._burn_exported: set = set()
         self._attr_task: Optional[asyncio.Task] = None
+        #: KV audit plane exposition state (docs/observability.md "KV
+        #: audit"): per-model label sets currently on /metrics (key →
+        #: True once its departure 0 has been scraped; the series is then
+        #: dropped entirely so fleet churn can't grow cardinality without
+        #: bound), and one-shot callback registration latches for the
+        #: shared-monitor tombstone counter and the cross-model heals
+        #: and cycles counters
+        self._radix_exported: dict[str, dict] = {}
+        self._divergence_exported: dict[str, dict] = {}
+        self._age_exported: dict[str, dict] = {}
+        self._tombstone_cb_set = False
+        self._heals_cb_set = False
+        self._cycles_cb_set = False
 
     @property
     def tracer(self):
@@ -574,6 +587,9 @@ class HttpService:
         # "Attribution"): spans ⊕ flight records → named-cause breakdown
         app.router.add_get("/v1/attribution/{request_id}",
                            self.handle_attribution)
+        # KV index audit plane (docs/observability.md "KV audit"):
+        # per-worker advertised vs resident blocks, divergence, heals
+        app.router.add_get("/v1/kv/audit", self.handle_kv_audit)
         # admin: flush every worker's KV cache/prefix state (ref:
         # lib/llm/src/http/service/clear_kv_blocks.rs)
         app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
@@ -845,14 +861,63 @@ class HttpService:
         self.feed_attribution(doc)
         return web.json_response(doc)
 
+    async def handle_kv_audit(self, request: web.Request) -> web.Response:
+        """GET /v1/kv/audit — the KV index audit plane's live status per
+        model (docs/observability.md "KV audit"): per-worker advertised
+        vs resident block counts, phantom/missing/dangling divergence
+        with age, last heal, suspicion and stale-advert counts. Models
+        routed without the event-fed KV indexer (round_robin, approx)
+        have nothing to audit and are simply absent."""
+        models = {}
+        for name, sm in self.manager.models.items():
+            auditor = getattr(sm.router, "auditor", None) if sm.router \
+                else None
+            if auditor is not None:
+                models[name] = auditor.status()
+        return web.json_response({"models": models, "count": len(models)})
+
+    @staticmethod
+    def _decay_departed(gauge, exported: dict, current: set,
+                        labelize) -> None:
+        """Label-churn hygiene for per-worker gauges: a departed label
+        set gets ONE 0-valued scrape (so dashboards see the decay, not a
+        frozen last value), then the series leaves /metrics entirely —
+        under autoscaler churn every restart mints a new lease hex, and
+        an ever-growing set of 0-valued series is an unbounded scrape."""
+        for key in [k for k in exported if k not in current]:
+            if exported[key]:
+                gauge.remove(**labelize(key))
+                del exported[key]
+            else:
+                gauge.set(0, **labelize(key))
+                exported[key] = True
+        for key in current:
+            exported[key] = False
+
     def _refresh_router_metrics(self) -> None:
         """Snapshot per-model KV-router stream health into gauges at scrape
         time (ref role: the reference's router metrics aggregation). A
         nonzero gaps/resyncs rate is the operator's signal that the event
         stream is outrunning its consumers (ring cap / hub sizing)."""
         from dynamo_tpu.router.indexer import KvIndexer
+        from dynamo_tpu.observability.kvaudit import u64_hex
+        from dynamo_tpu.router.protocols import G4_SOURCE_ID
 
         for name, sm in self.manager.models.items():
+            # tombstone-rejected late kv_metrics (runtime/worker_monitor):
+            # the shared monitor serves every model AND every router mode
+            # (round_robin fleets tombstone too) — export once, before
+            # the KV-indexer gate below
+            if sm.monitor is not None and not self._tombstone_cb_set:
+                self._tombstone_cb_set = True
+                monitor = sm.monitor
+                self.metrics.counter(
+                    "kv_events_tombstoned_total",
+                    "late kv_metrics publishes rejected by a dead-worker "
+                    "tombstone (rate-limited WARN; a steady rate means "
+                    "something keeps publishing for a purged "
+                    "worker)").add_callback(
+                    lambda: {None: monitor.tombstoned_total})
             idx = getattr(sm.router, "indexer", None) if sm.router else None
             if not isinstance(idx, KvIndexer):
                 continue
@@ -866,6 +931,141 @@ class HttpService:
                 "kv_router_orphan_events",
                 "stored events dropped for unknown parents").set(
                     idx.tree.orphan_events, model=name)
+            # radix shape (docs/observability.md "KV audit"): the index's
+            # size was invisible — per-worker advertised block counts,
+            # the worker census, and the G4 sentinel's announced prefix
+            # depth, all O(workers) off the tree's inline digests
+            counts = idx.tree.worker_counts()
+            g4_blocks = counts.pop(G4_SOURCE_ID, 0)
+            blocks_g = self.metrics.gauge(
+                "radix_blocks",
+                "blocks the KV radix index advertises per worker")
+            self._decay_departed(
+                blocks_g, self._radix_exported.setdefault(name, {}),
+                {u64_hex(w) for w in counts},
+                lambda whex: {"model": name, "worker": whex})
+            for w, c in counts.items():
+                blocks_g.set(c, model=name, worker=u64_hex(w))
+            self.metrics.gauge(
+                "radix_workers",
+                "workers with at least one advertised block in the KV "
+                "radix index").set(len(counts), model=name)
+            self.metrics.gauge(
+                "radix_g4_blocks",
+                "G4 object-store prefix blocks announced under the "
+                "sentinel source").set(g4_blocks, model=name)
+            # audit plane results (kvaudit.KvAuditor)
+            auditor = getattr(sm.router, "auditor", None)
+            if auditor is not None:
+                div_g = self.metrics.gauge(
+                    "radix_divergence_blocks",
+                    "radix↔residency divergent blocks per worker by kind "
+                    "(phantom = advertised not resident, missing = "
+                    "resident not advertised, dangling = resident but "
+                    "not re-announceable)")
+                div_keys = set()
+                for (w, kind), n in auditor.divergence_blocks().items():
+                    div_g.set(n, model=name, worker=u64_hex(w), kind=kind)
+                    div_keys.add((u64_hex(w), kind))
+                self._decay_departed(
+                    div_g, self._divergence_exported.setdefault(name, {}),
+                    div_keys,
+                    lambda k: {"model": name, "worker": k[0], "kind": k[1]})
+                age_g = self.metrics.gauge(
+                    "radix_divergence_age_seconds",
+                    "seconds since unhealed divergence was first "
+                    "detected, per worker (0 = clean)")
+                import time as _time
+
+                now = _time.time()
+                age_keys = set()
+                for wid, st in auditor.worker_state.items():
+                    since = st.get("diverged_since")
+                    whex = u64_hex(wid)
+                    age_g.set(round(now - since, 3) if since else 0.0,
+                              model=name, worker=whex)
+                    age_keys.add(whex)
+                self._decay_departed(
+                    age_g, self._age_exported.setdefault(name, {}),
+                    age_keys,
+                    lambda whex: {"model": name, "worker": whex})
+                heals = self.metrics.counter(
+                    "kv_audit_heals_total",
+                    "audit-triggered resync heals by cause (phantom "
+                    "purges the worker's radix entries first; missing "
+                    "replays idempotent upserts)")
+                if not self._heals_cb_set:
+                    self._heals_cb_set = True
+                    mgr2 = self.manager  # late-bound over all models
+                    # counters must be MONOTONIC: a model teardown (last
+                    # worker left) destroys its auditor, so a live-sum
+                    # would decrease and Prometheus rate() would read the
+                    # drop as a process restart. Fold each auditor's last
+                    # seen counts into a retained baseline when it
+                    # disappears (or restarts at lower counts).
+                    last: dict = {}  # model -> last seen heals_total
+                    base: dict = {}  # cause -> retired heals
+
+                    def _heals():
+                        live = set()
+                        for mname, sm2 in mgr2.models.items():
+                            a = getattr(sm2.router, "auditor", None) \
+                                if sm2.router else None
+                            if a is None:
+                                continue
+                            live.add(mname)
+                            cur = dict(a.heals_total)
+                            prev = last.get(mname)
+                            if prev and any(cur.get(c, 0) < n
+                                            for c, n in prev.items()):
+                                for c, n in prev.items():  # new auditor
+                                    base[c] = base.get(c, 0) + n
+                            last[mname] = cur
+                        for mname in [m for m in last if m not in live]:
+                            for c, n in last.pop(mname).items():
+                                base[c] = base.get(c, 0) + n
+                        out: dict = {}
+                        for src in [base] + [last[m] for m in last]:
+                            for cause, n in src.items():
+                                key = (("cause", cause),)
+                                out[key] = out.get(key, 0) + n
+                        return out
+
+                    heals.add_callback(_heals)
+                cycles = self.metrics.counter(
+                    "kv_audit_cycles_total", "audit cycles completed")
+                if not self._cycles_cb_set:
+                    self._cycles_cb_set = True
+                    mgr = self.manager  # late-bound over all models
+                    # same monotonicity hazard as _heals above: a model
+                    # teardown destroys its auditor and a recreated one
+                    # restarts cycles at 0 — fold retired counts into a
+                    # per-model baseline so the counter never decreases
+                    cyc_last: dict = {}  # model -> last seen cycles
+                    cyc_base: dict = {}  # model -> retired cycles
+
+                    def _cycles():
+                        out: dict = {}
+                        live = set()
+                        for mname, sm2 in mgr.models.items():
+                            a = getattr(sm2.router, "auditor", None) \
+                                if sm2.router else None
+                            if a is None:
+                                continue
+                            live.add(mname)
+                            if a.cycles < cyc_last.get(mname, 0):
+                                cyc_base[mname] = cyc_base.get(mname, 0) \
+                                    + cyc_last[mname]
+                            cyc_last[mname] = a.cycles
+                        for mname in [m for m in cyc_last if m not in live]:
+                            cyc_base[mname] = cyc_base.get(mname, 0) \
+                                + cyc_last.pop(mname)
+                        for mname in set(cyc_last) | set(cyc_base):
+                            out[(("model", mname),)] = \
+                                cyc_base.get(mname, 0) + cyc_last.get(mname, 0)
+                        return out
+
+                    cycles.add_callback(_cycles)
 
     async def handle_embeddings(self, request: web.Request) -> web.Response:
         """OpenAI embeddings (ref: openai.rs:714): tokenize each input via

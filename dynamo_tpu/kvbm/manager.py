@@ -37,10 +37,20 @@ class KvbmManager:
     blocking round-trips are safe there."""
 
     def __init__(self, host_bytes: int, disk_dir: Optional[str] = None,
-                 disk_bytes: int = 0, on_change=None):
+                 disk_bytes: int = 0, on_change=None, ledger=None):
         self.host = HostTier(host_bytes)
         self.disk = DiskTier(disk_dir, disk_bytes) if (disk_dir and disk_bytes) else None
         self.remote: Optional[RemoteTier] = None
+        #: optional WorkerKvLedger (observability/kvaudit.py): per-tier
+        #: residency digests for the audit plane. The G2/G3 tiers fold
+        #: their own membership changes (tiers.py); owned-G4 entries are
+        #: folded here at the _remote_owned mutation sites — all under
+        #: this manager's lock, so digest and tier state move together.
+        self.ledger = ledger
+        if ledger is not None:
+            self.host.ledger = ledger
+            if self.disk is not None:
+                self.disk.ledger = ledger
         self._remote_ops: list = []  # (op, hash, payload|None), lock-guarded
         #: failed deletes awaiting their next attempt (merged into the op
         #: queue at the START of each drain, so retries span drain calls)
@@ -125,7 +135,7 @@ class KvbmManager:
                         self._pending_puts.discard(h)
                         if failed and self.remote is not None:
                             self.remote.discard(h)
-                            self._remote_owned.discard(h)
+                            self._disown_g4(h)
                             self._notify_if_gone(h)
                     if not failed:
                         self._fire_remote_change([h], [])
@@ -162,6 +172,16 @@ class KvbmManager:
                 cb(stored, removed)
             except Exception:
                 logger.exception("kvbm on_remote_change callback failed")
+
+    def _own_g4(self, h: int) -> None:
+        self._remote_owned.add(h)
+        if self.ledger is not None:
+            self.ledger.add("g4", h)
+
+    def _disown_g4(self, h: int) -> None:
+        self._remote_owned.discard(h)
+        if self.ledger is not None:
+            self.ledger.remove("g4", h)
 
     def _notify_if_gone(self, h: int) -> None:
         """Announce removal when ``h`` left its LAST tier (lock held) —
@@ -344,7 +364,7 @@ class KvbmManager:
                             op for op in self._remote_ops
                             if not (op[0] == "put" and op[1] == rh)]
                         self._pending_puts.discard(rh)
-                        self._remote_owned.discard(rh)
+                        self._disown_g4(rh)
                 removed = self._cascade(self.host.put(h, k, v))
                 self._notify([h], removed)
             landed += 1
@@ -392,14 +412,14 @@ class KvbmManager:
             if rh in self._remote_owned:
                 # only objects this worker wrote may be deleted remotely;
                 # fetched (shared) entries leave the index silently
-                self._remote_owned.discard(rh)
+                self._disown_g4(rh)
                 self._remote_ops.append(("delete", rh, None))
             if rh not in self.host and (self.disk is None
                                         or rh not in self.disk):
                 gone.append(rh)
         self._remote_ops.append(("put", h, payload))
         self._pending_puts.add(h)
-        self._remote_owned.add(h)
+        self._own_g4(h)
         return gone
 
     # -- runtime controller surface (ref: block_manager/controller.rs) -------
@@ -418,6 +438,8 @@ class KvbmManager:
                     ("delete", h, None) for h in self.remote.clear()
                     if h in self._remote_owned)
                 self._remote_owned.clear()
+                if self.ledger is not None:
+                    self.ledger.remove_all("g4")
             self._notify([], None)
         self._drain_remote()
 

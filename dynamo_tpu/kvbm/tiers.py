@@ -44,6 +44,9 @@ class HostTier:
         self.capacity = capacity_bytes
         self.used = 0
         self.external_used = external_used
+        #: optional WorkerKvLedger (observability/kvaudit.py), set by the
+        #: manager: g2 residency digest folded inline at put/evict/clear
+        self.ledger = None
         self._store: "OrderedDict[int, tuple[np.ndarray, np.ndarray]]" = OrderedDict()
 
     def _external(self) -> int:
@@ -73,6 +76,8 @@ class HostTier:
         evicted = self.evict_to_capacity(budget - size)
         self._store[h] = (k, v)
         self.used += size
+        if self.ledger is not None:
+            self.ledger.add("g2", h)
         return evicted
 
     def evict_to_capacity(self, capacity: int) -> list[tuple]:
@@ -83,6 +88,8 @@ class HostTier:
         while self._store and self.used > capacity:
             eh, (ek, ev) = self._store.popitem(last=False)
             self.used -= ek.nbytes + ev.nbytes
+            if self.ledger is not None:
+                self.ledger.remove("g2", eh)
             evicted.append((eh, ek, ev))
         return evicted
 
@@ -95,6 +102,8 @@ class HostTier:
     def clear(self):
         self._store.clear()
         self.used = 0
+        if self.ledger is not None:
+            self.ledger.remove_all("g2")
 
 
 class DiskTier:
@@ -104,6 +113,8 @@ class DiskTier:
         self.dir = directory
         self.capacity = capacity_bytes
         self.used = 0
+        #: optional WorkerKvLedger, set by the manager (g3 digest)
+        self.ledger = None
         self._index: "OrderedDict[int, int]" = OrderedDict()  # hash -> nbytes
         os.makedirs(directory, exist_ok=True)
         # reconcile stale files from previous runs: the index starts empty,
@@ -143,6 +154,8 @@ class DiskTier:
             # already dropped eh from the index (and used) — pop defaults
             esize = self._index.pop(eh, 0)
             self.used -= esize
+            if self.ledger is not None:
+                self.ledger.remove("g3", eh)
             evicted.append((eh, *entry) if entry is not None else eh)
             try:
                 os.unlink(self._path(eh))
@@ -156,6 +169,8 @@ class DiskTier:
                  dtype=str(k.dtype))
         self._index[h] = size
         self.used += size
+        if self.ledger is not None:
+            self.ledger.add("g3", h)
         return evicted
 
     def get(self, h: int) -> Optional[tuple[np.ndarray, np.ndarray]]:
@@ -171,6 +186,8 @@ class DiskTier:
             n = self._index.pop(h, None)
             if n is not None:
                 self.used -= n
+                if self.ledger is not None:
+                    self.ledger.remove("g3", h)
             return None
         self._index.move_to_end(h)
         return k, v
@@ -183,6 +200,8 @@ class DiskTier:
                 pass
         self._index.clear()
         self.used = 0
+        if self.ledger is not None:
+            self.ledger.remove_all("g3")
 
 
 class RemoteTier:

@@ -124,6 +124,10 @@ class KvCacheSim:
         self.watermark_blocks = int(capacity * watermark)
         self.active: dict[int, int] = {}  # seq_hash -> refcount
         self.inactive: dict[int, float] = {}  # seq_hash -> last_use (LRU)
+        #: optional WorkerKvLedger (observability/kvaudit.py) — real-
+        #: engine parity: membership mirrors active ∪ inactive, so the
+        #: KV audit plane measures mocker fleets too
+        self.ledger = None
 
     @property
     def used_blocks(self) -> int:
@@ -159,8 +163,12 @@ class KvCacheSim:
         while self.free_blocks < 1 and self.inactive:
             lru = min(self.inactive, key=self.inactive.get)
             del self.inactive[lru]
+            if self.ledger is not None:
+                self.ledger.remove("g1", lru)
             evicted.append(lru)
         self.active[seq_hash] = 1
+        if self.ledger is not None:
+            self.ledger.add("g1", seq_hash)
         return True, evicted
 
     def release(self, seq_hash: int, cache: bool) -> Optional[int]:
@@ -175,6 +183,8 @@ class KvCacheSim:
         if cache:
             self.inactive[seq_hash] = time.monotonic()
             return None
+        if self.ledger is not None:
+            self.ledger.remove("g1", seq_hash)
         return seq_hash
 
 
@@ -191,6 +201,20 @@ class MockEngine:
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
         self.cache = KvCacheSim(args.num_gpu_blocks, args.watermark)
+        #: KV audit plane parity (observability/kvaudit.py): the mocker
+        #: keeps the same residency ledger a real engine does, served by
+        #: run_mocker via the kv_digest wire op; wiring it into the
+        #: publisher makes resync replays ledger-reconciling here too
+        from dynamo_tpu.observability.kvaudit import WorkerKvLedger
+        self.kv_ledger = WorkerKvLedger()
+        self.cache.ledger = self.kv_ledger
+        if (args.enable_prefix_caching and kv_publisher is not None
+                and kv_publisher.ledger is None):
+            # caching-off mockers announce blocks they release silently
+            # (pre-existing advert semantics) — a ledger-reconciling
+            # replay there would retract every advert, so the audit
+            # plane only covers prefix-caching workers (engine parity)
+            kv_publisher.ledger = self.kv_ledger
         self.waiting: list[_Seq] = []
         self.running: list[_Seq] = []
         self._task: Optional[asyncio.Task] = None

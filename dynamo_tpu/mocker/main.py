@@ -61,6 +61,14 @@ async def run_mocker(
         await kv_pub.start_resync_responder()
         metrics_pub = WorkerMetricsPublisher(runtime.plane, worker_id=lease)
         engine = await MockEngine(args, kv_pub, metrics_pub).start()
+        # KV audit plane parity (docs/observability.md "KV audit"): each
+        # rank serves its residency digests under its own lease, exactly
+        # like a real engine worker (caching-off ranks have no residency
+        # contract to audit — engine/main.py parity)
+        if args.enable_prefix_caching:
+            from dynamo_tpu.observability.kvaudit import serve_kv_digest
+            await serve_kv_digest(runtime, engine.kv_ledger, lease,
+                                  publisher=kv_pub)
         # synthetic locality labels ({"host":…,"slice":…,"pod":…}) let fleet
         # tests/benches exercise topology-costed routing without real slices
         meta = {"dp_rank": rank}

@@ -44,6 +44,17 @@ from dynamo_tpu.observability.attribution import (
     gather_attribution,
 )
 from dynamo_tpu.observability.stats import histogram_quantile, quantile
+from dynamo_tpu.observability.kvaudit import (
+    KV_AUDIT_SUSPECT_SUBJECT,
+    KV_DIGEST_PREFIX,
+    AuditConfig,
+    KvAuditor,
+    WorkerKvLedger,
+    fetch_kv_chain,
+    fetch_kv_digest,
+    list_digest_workers,
+    serve_kv_digest,
+)
 
 __all__ = [
     "CURRENT_SPAN", "Span", "Tracer", "configure_tracer", "get_tracer",
@@ -54,4 +65,7 @@ __all__ = [
     "flight_instance", "register_recorder", "serve_flight",
     "BUCKETS", "SloBurnTracker", "attribute", "gather_attribution",
     "histogram_quantile", "quantile",
+    "KV_AUDIT_SUSPECT_SUBJECT", "KV_DIGEST_PREFIX", "AuditConfig",
+    "KvAuditor", "WorkerKvLedger", "fetch_kv_chain", "fetch_kv_digest",
+    "list_digest_workers", "serve_kv_digest",
 ]

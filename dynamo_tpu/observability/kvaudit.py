@@ -1,0 +1,807 @@
+"""KV index audit plane: is the router's radix view of worker KV *true*?
+
+Every fleet decision — KV-aware routing, onboard plans, restore plans, the
+G4 sentinel — is made from the router's radix projection of each worker's
+cache, yet the indexer only detects *stream gaps*: semantic drift
+(tier-transition suppression bugs, announce/removal races, tombstone
+leaks, chaos-dropped events that never earned a seq) is invisible to the
+gap protocol and surfaces downstream as torn pulls and mispriced routes.
+The KV-management survey (arXiv 2607.02574) calls index staleness the
+central correctness hazard of hierarchical KV stores; this module makes
+index accuracy a continuously measured, self-healing quantity
+(docs/observability.md "KV audit"):
+
+- ``WorkerKvLedger`` — the worker-side ground truth: a cheap per-tier
+  rolling xor/count digest over resident block hashes (device g1, host
+  g2, disk g3, owned-G4), updated inline at register/evict/tier-change —
+  never a sweep — plus the union "servable" digest (g1|g2|g3: exactly
+  the set ``kv_pull`` can serve, which is what the radix advertises).
+- ``serve_kv_digest`` / ``fetch_kv_digest`` / ``fetch_kv_chain`` — the
+  ``kv_digest`` wire op (serve_flight-style discovery under the worker's
+  lease): digests for the low-duty compare, the targeted chain diff on
+  mismatch.
+- ``KvAuditor`` — the router-side loop: compares its per-worker radix
+  digest (maintained inline by ``RadixTree``) against worker digests; on
+  a settled mismatch pulls the chain diff and classifies divergent
+  blocks as **phantom** (advertised, not resident → mispriced routes,
+  doomed pulls) or **missing** (resident + announceable, not advertised
+  → lost reuse), then heals through the existing resync machinery —
+  phantoms purge the worker's radix entries first so idempotent stored
+  upserts rebuild a truthful view. Workers whose pulls failed
+  ``stale_advert`` (disagg/handlers.py) raise a suspicion score over the
+  ``kv_audit_suspect`` subject, so hot divergence is audited before idle
+  workers.
+
+Taxonomy (sets per worker; R = radix, M = resident servable membership,
+A = root-anchored announceable subset of M per the publisher mirror):
+
+- phantom  = R − M        (heal: purge worker from tree + resync)
+- missing  = A − R        (heal: resync — idempotent upserts restore)
+- dangling = (M − A) − R  (resident but not re-announceable: mid-chain
+  ancestor lost, or stored under an admin clear; informational — no
+  resync can restore it, so the auditor reports it and stops re-healing
+  until either side's digest moves)
+
+Env knobs:
+
+- ``DYN_KV_AUDIT=0``            — disable the audit loop (A/B arm)
+- ``DYN_KV_AUDIT_INTERVAL``     — audit cycle seconds (default 30)
+- ``DYN_KV_AUDIT_SETTLE``       — mismatch re-check delay (default 0.25 s)
+  so in-flight batched stored events never read as divergence
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Optional
+
+import msgpack
+
+logger = logging.getLogger("dynamo.observability.kvaudit")
+
+#: discovery prefix: kv/digest/<lease-hex> → {subject, service}
+KV_DIGEST_PREFIX = "kv/digest/"
+#: pub/sub subject carrying per-worker suspicion reports (stale_advert
+#: pull failures, disagg/handlers.py) toward every router's auditor
+KV_AUDIT_SUSPECT_SUBJECT = "kv_audit_suspect"
+#: control-plane key the auditor publishes its status doc under — one
+#: per (stream, replica): every model's and frontend replica's auditor
+#: shares the default "kv_events" stream, so a shared key would let one
+#: auditor's stop() blank the survivors' status. Crash leftovers (no
+#: lease) are GC'd by surviving auditors and flagged stale by dynctl kv.
+KV_AUDIT_STATUS_KEY = "public/kvaudit/{stream}/{replica}"
+
+#: tier names (match the flight recorder's kv_tiers g1..g4 convention)
+TIER_DEVICE, TIER_HOST, TIER_DISK, TIER_G4 = "g1", "g2", "g3", "g4"
+_TIER_BITS = {TIER_DEVICE: 1, TIER_HOST: 2, TIER_DISK: 4, TIER_G4: 8}
+#: tiers kv_pull can actually serve (engine.export_blocks: device prefix
+#: cache + own G2/G3) — the union the radix advertises, so the union
+#: digest is what audits compare. Owned-G4 is tracked for visibility but
+#: is a remote index, not local bytes.
+_SERVABLE_MASK = _TIER_BITS[TIER_DEVICE] | _TIER_BITS[TIER_HOST] | _TIER_BITS[TIER_DISK]
+
+#: chain-diff responses cap their hash lists — a worker holding more is
+#: audited over the leading window (count mismatch still detects the rest)
+MAX_CHAIN_HASHES = 1 << 16
+
+_U64 = (1 << 64) - 1
+
+
+def u64_hex(v: int) -> str:
+    """Canonical label spelling for worker ids / block hashes: hashes are
+    u64 but travel as signed i64 through msgpack, so an unmasked format
+    would render the same worker under two different spellings."""
+    return f"{v & _U64:x}"
+
+
+class WorkerKvLedger:
+    """Per-tier residency digest, updated inline — the worker-side ground
+    truth the audit plane compares the radix against.
+
+    Thread-safe: the engine loop registers device blocks while KVBM
+    offload/promotion worker threads mutate G2/G3 under the manager lock;
+    every mutation here takes one short lock. Memory: one dict entry per
+    hash resident in ANY tier (same order as the tier indexes themselves).
+
+    Digest arithmetic: xor folds in/out in O(1) and is order-independent,
+    so two sets are equal iff (xor, count) match — modulo the astronomically
+    unlikely xor collision at equal counts, which the chain diff (fetched on
+    every mismatch) would simply find empty and ignore.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mask: dict[int, int] = {}  # hash -> tier bitmask
+        # per-tier and servable-union rolling [xor, count]
+        self._tiers: dict[str, list[int]] = {
+            t: [0, 0] for t in _TIER_BITS}
+        self._servable: list[int] = [0, 0]
+
+    def add(self, tier: str, h: int) -> None:
+        bit = _TIER_BITS[tier]
+        h &= _U64
+        with self._lock:
+            m = self._mask.get(h, 0)
+            if m & bit:
+                return  # already resident in this tier: no digest motion
+            self._mask[h] = m | bit
+            d = self._tiers[tier]
+            d[0] ^= h
+            d[1] += 1
+            if not (m & _SERVABLE_MASK) and (bit & _SERVABLE_MASK):
+                self._servable[0] ^= h
+                self._servable[1] += 1
+
+    def remove(self, tier: str, h: int) -> None:
+        bit = _TIER_BITS[tier]
+        h &= _U64
+        with self._lock:
+            m = self._mask.get(h, 0)
+            if not (m & bit):
+                return  # double-remove / never added: digest untouched
+            m &= ~bit
+            if m:
+                self._mask[h] = m
+            else:
+                del self._mask[h]
+            d = self._tiers[tier]
+            d[0] ^= h
+            d[1] -= 1
+            if (bit & _SERVABLE_MASK) and not (m & _SERVABLE_MASK):
+                self._servable[0] ^= h
+                self._servable[1] -= 1
+
+    def remove_all(self, tier: str) -> None:
+        """Admin clear of one tier (the only sweep, and only on clears)."""
+        with self._lock:
+            bit = _TIER_BITS[tier]
+            hashes = [h for h, m in self._mask.items() if m & bit]
+        for h in hashes:
+            self.remove(tier, h)
+
+    def servable_hashes(self) -> list[int]:
+        """Snapshot of the servable union — the chain-diff payload."""
+        with self._lock:
+            return [h for h, m in self._mask.items() if m & _SERVABLE_MASK]
+
+    def servable_digest(self) -> tuple[int, int]:
+        with self._lock:
+            return self._servable[0], self._servable[1]
+
+    def digest(self) -> dict:
+        """Wire shape served by the ``kv_digest`` op."""
+        with self._lock:
+            return {
+                "servable": {"xor": self._servable[0],
+                             "count": self._servable[1]},
+                "tiers": {t: {"xor": d[0], "count": d[1]}
+                          for t, d in self._tiers.items()},
+            }
+
+
+# ----------------------------------------------------------- kv_digest wire
+
+
+class KvDigestServeHandle:
+    def __init__(self, runtime, key: str, cancel_serve):
+        self._runtime = runtime
+        self._key = key
+        self._cancel = cancel_serve
+
+    async def stop(self) -> None:
+        try:
+            self._runtime.drop_registration(self._key)
+            await self._runtime.plane.kv_delete(self._key)
+        finally:
+            if self._cancel:
+                await self._cancel()
+
+
+async def serve_kv_digest(runtime, ledger: WorkerKvLedger, worker_id: int,
+                          publisher=None) -> KvDigestServeHandle:
+    """Expose ``ledger`` (and the publisher mirror's chain structure) as
+    this worker's ``kv_digest`` endpoint.
+
+    Query wire (msgpack): ``{"op": "digest"}`` → per-tier + servable
+    digests; ``{"op": "chain"}`` → the targeted diff payload:
+    ``resident`` (servable membership) and ``anchored`` (the subset a
+    resync replay would re-announce — root-anchored per the publisher
+    mirror), both capped at MAX_CHAIN_HASHES. The discovery key rides
+    the worker's lease so a dead worker drops out of audits exactly like
+    its serving endpoints."""
+    subject = f"kvdigest-{u64_hex(worker_id)}"
+
+    async def on_request(payload: bytes) -> bytes:
+        try:
+            q = msgpack.unpackb(payload, raw=False) or {}
+        except Exception:
+            q = {}
+        resp: dict = {"worker_id": worker_id}
+        if q.get("op") == "chain":
+            resident = ledger.servable_hashes()
+            anchored: list[int] = []
+            if publisher is not None:
+                from dynamo_tpu.router.publisher import reachable_chain
+
+                member = set(resident)
+                anchored = [bh for bh, _p, _t in
+                            reachable_chain(publisher.announced_chain(),
+                                            member=member)]
+            resp["resident"] = resident[:MAX_CHAIN_HASHES]
+            resp["anchored"] = anchored[:MAX_CHAIN_HASHES]
+            resp["resident_total"] = len(resident)
+        else:
+            resp.update(ledger.digest())
+        return msgpack.packb(resp)
+
+    cancel = await runtime.plane.serve(subject, on_request)
+    key = f"{KV_DIGEST_PREFIX}{u64_hex(worker_id)}"
+    value = msgpack.packb(
+        {"subject": subject,
+         "service": os.environ.get("DYN_SERVICE", "dynamo")})
+    await runtime.plane.kv_put(key, value, lease_id=worker_id)
+    runtime.record_registration(key, value)
+    logger.debug("kv_digest endpoint on %s", subject)
+    return KvDigestServeHandle(runtime, key, cancel)
+
+
+async def list_digest_workers(plane) -> dict[int, dict]:
+    """worker_id → endpoint meta for every registered kv_digest server."""
+    try:
+        entries = await plane.kv_get_prefix(KV_DIGEST_PREFIX)
+    except Exception:
+        logger.exception("kv_digest discovery failed")
+        return {}
+    out: dict[int, dict] = {}
+    for key, value in entries.items():
+        try:
+            wid = int(key[len(KV_DIGEST_PREFIX):], 16)
+            out[wid] = msgpack.unpackb(value, raw=False)
+        except Exception:
+            continue
+    return out
+
+
+async def _digest_request(plane, worker_id: int, query: dict,
+                          timeout: float,
+                          subject: Optional[str] = None) -> Optional[dict]:
+    try:
+        if subject is None:
+            # caller didn't already discover the endpoint (the auditor
+            # passes the subject from its per-cycle list_digest_workers
+            # scan — re-fetching the same key per probe is wasted RTTs
+            # on a network plane)
+            key = f"{KV_DIGEST_PREFIX}{u64_hex(worker_id)}"
+            value = await plane.kv_get(key)
+            if not value:
+                return None
+            subject = msgpack.unpackb(value, raw=False)["subject"]
+        raw = await asyncio.wait_for(
+            plane.request(subject, msgpack.packb(query), timeout=timeout),
+            timeout + 0.5)
+        return msgpack.unpackb(raw, raw=False)
+    except Exception:
+        return None  # dead/slow worker: the caller skips it this cycle
+
+
+async def fetch_kv_digest(plane, worker_id: int, timeout: float = 2.0,
+                          subject: Optional[str] = None) -> Optional[dict]:
+    return await _digest_request(plane, worker_id, {"op": "digest"},
+                                 timeout, subject=subject)
+
+
+async def fetch_kv_chain(plane, worker_id: int, timeout: float = 5.0,
+                         subject: Optional[str] = None) -> Optional[dict]:
+    return await _digest_request(plane, worker_id, {"op": "chain"},
+                                 timeout, subject=subject)
+
+
+async def list_live_instances(plane) -> Optional[set]:
+    """Fleet-wide live instance ids off the discovery KV store: every
+    serving endpoint registers ``instances/<ns>/<comp>/<ep>:<lease-hex>``
+    under its lease, so a lapsed worker drops out of this scan exactly
+    like its endpoints — across ALL models and components, which is what
+    makes it a safe liveness oracle for the audit's tombstone-leak purge
+    (the kv_events stream is fleet-global, so another model's live
+    worker must never read as a corpse). Returns None on scan failure —
+    unknown, not empty: the caller must stay conservative."""
+    try:
+        entries = await plane.kv_get_prefix("instances/")
+    except Exception:
+        logger.exception("instance discovery failed")
+        return None
+    out: set = set()
+    for key in entries:
+        _, _, hexid = key.rpartition(":")
+        try:
+            out.add(int(hexid, 16))
+        except ValueError:
+            continue
+    return out
+
+
+# ------------------------------------------------------------- the auditor
+
+
+@dataclass
+class AuditConfig:
+    """Router-side audit policy (``DYN_KV_AUDIT_*`` env)."""
+
+    enabled: bool = True
+    interval_s: float = 30.0
+    #: mismatch re-check delay: batched stored events are in flight for
+    #: milliseconds — a one-shot compare would tag them as divergence
+    settle_s: float = 0.25
+    #: divergent-hash samples kept per worker for dynctl kv --diff
+    max_samples: int = 32
+    #: report-only mode (DYN_KV_AUDIT_HEAL=0): classify and expose
+    #: divergence without purging or requesting resyncs — observe a
+    #: misbehaving fleet without mutating it
+    heal_enabled: bool = True
+
+    @classmethod
+    def from_env(cls, env=None) -> "AuditConfig":
+        env = os.environ if env is None else env
+
+        def _f(name, default):
+            raw = env.get(name)
+            if not raw:
+                return default
+            try:
+                return float(raw)
+            except ValueError:
+                raise ValueError(f"bad {name}={raw!r}") from None
+
+        return cls(
+            enabled=env.get("DYN_KV_AUDIT", "1") not in ("0", "false", "off"),
+            interval_s=_f("DYN_KV_AUDIT_INTERVAL", 30.0),
+            settle_s=_f("DYN_KV_AUDIT_SETTLE", 0.25),
+            heal_enabled=env.get("DYN_KV_AUDIT_HEAL", "1")
+            not in ("0", "false", "off"),
+        )
+
+
+class KvAuditor:
+    """Low-duty loop proving (and repairing) radix↔residency agreement.
+
+    One auditor per KvIndexer (i.e. per router replica per model). All
+    radix reads/mutations happen synchronously on the event loop the
+    indexer task runs on — the same single-threaded discipline the
+    indexer itself relies on for race-freedom."""
+
+    def __init__(self, plane, indexer, config: Optional[AuditConfig] = None):
+        self.plane = plane
+        self.indexer = indexer  # KvIndexer (owns the RadixTree + resync)
+        self.config = config or AuditConfig.from_env()
+        #: worker → audit state: {"diverged_since", "last_heal",
+        #: "phantom", "missing", "dangling", "resident", "advertised",
+        #: "samples": {...}, "skip_pair"}
+        self.worker_state: dict[int, dict] = {}
+        self.suspicion: dict[int, float] = {}
+        self.stale_adverts: dict[int, int] = {}
+        self.cycles = 0
+        self.heals_total: dict[str, int] = {}
+        self._resync_pending = False
+        #: distinguishes this auditor's status doc from its siblings'
+        #: (every model/replica audits the same default stream) — random,
+        #: not id()-derived: allocation addresses collide across
+        #: identically-started replica processes
+        self.replica_hex = uuid.uuid4().hex[:12]
+        #: test/override hook: sync () -> set of live instance ids. When
+        #: None (production), liveness comes from list_live_instances —
+        #: a FLEET-wide discovery scan, because the kv_events stream is
+        #: fleet-global and a model-scoped view would read another
+        #: model's live worker as a corpse and purge it in a loop
+        self.alive_fn = None
+        self.last_cycle_s = 0.0
+        self.last_cycle_at = 0.0
+        self._task: Optional[asyncio.Task] = None
+        self._suspect_sub = None
+        self._suspect_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> "KvAuditor":
+        self._suspect_sub = await self.plane.subscribe(
+            KV_AUDIT_SUSPECT_SUBJECT)
+        loop = asyncio.get_running_loop()
+        self._suspect_task = loop.create_task(self._suspect_loop())
+        self._task = loop.create_task(self._loop())
+        return self
+
+    async def stop(self):
+        for t in (self._task, self._suspect_task):
+            if t is not None:
+                t.cancel()
+        if self._suspect_sub is not None:
+            await self._suspect_sub.cancel()
+        try:
+            # the status doc is written without a lease (the auditor
+            # lives in the router process, not under a worker lease) —
+            # delete OUR OWN per-replica doc so dynctl kv never renders
+            # a dead fleet as live (sibling auditors' docs stay)
+            await self.plane.kv_delete(self._status_key())
+        except Exception:
+            logger.debug("kv audit status cleanup failed", exc_info=True)
+
+    # ------------------------------------------------------------ suspicion
+
+    async def _suspect_loop(self):
+        """Demand-side feedback: a worker whose advertised blocks failed a
+        pull (outcome=stale_advert) is audited before idle workers — and
+        immediately, not at the next scheduled cycle."""
+        try:
+            async for _subject, payload in self._suspect_sub:
+                try:
+                    m = msgpack.unpackb(payload, raw=False)
+                    wid = int(m["worker_id"])
+                except Exception:
+                    continue
+                self.suspicion[wid] = self.suspicion.get(wid, 0.0) + 1.0
+                self.stale_adverts[wid] = self.stale_adverts.get(wid, 0) + 1
+                self._wake.set()
+        except asyncio.CancelledError:
+            pass
+
+    # ------------------------------------------------------------ the loop
+
+    async def _loop(self):
+        try:
+            while True:
+                try:
+                    await asyncio.wait_for(self._wake.wait(),
+                                           timeout=self.config.interval_s)
+                except asyncio.TimeoutError:
+                    pass
+                # clear AFTER the wait, right before auditing: a suspicion
+                # arriving mid-cycle re-sets the event and the next wait
+                # returns immediately instead of being lost to a clear at
+                # the top of the iteration (which would delay the promised
+                # immediate audit by a full interval)
+                self._wake.clear()
+                try:
+                    await self.audit_once()
+                except Exception:
+                    logger.exception("kv audit cycle failed")
+                # wake-storm floor: under report-only mode a persistent
+                # stale advert re-suspects on every failed pull, and
+                # back-to-back wakeups would otherwise degrade the
+                # low-duty loop into request-rate audit cycles
+                await asyncio.sleep(min(1.0, self.config.interval_s / 4))
+        except asyncio.CancelledError:
+            pass
+
+    async def audit_once(self) -> dict:
+        """One full audit cycle; returns the status doc it published."""
+        t0 = time.perf_counter()
+        endpoints = await list_digest_workers(self.plane)
+        tree = self.indexer.tree
+        # audit every worker that serves a digest OR still has radix
+        # entries (a tombstone-leaked worker shows up only in the tree);
+        # the G4 sentinel has no ledger — its count is exported as a
+        # radix-shape metric instead (frontend /metrics)
+        from dynamo_tpu.router.protocols import G4_SOURCE_ID
+
+        counts = tree.worker_counts()
+        workers = set(endpoints) | {
+            w for w in counts if w != G4_SOURCE_ID}
+        # liveness is fetched at most once per cycle, and only when some
+        # worker advertises blocks without serving a digest endpoint
+        # (the tombstone-leak candidate set); None = unknown, never purge
+        alive = None
+        if any(w not in endpoints and counts.get(w) for w in workers):
+            if self.alive_fn is not None:
+                try:
+                    alive = self.alive_fn()
+                except Exception:
+                    logger.debug("kv audit liveness probe failed",
+                                 exc_info=True)
+            else:
+                alive = await list_live_instances(self.plane)
+        ordered = sorted(workers,
+                         key=lambda w: -self.suspicion.get(w, 0.0))
+        self._resync_pending = False
+        for wid in ordered:
+            try:
+                await self._audit_worker(wid, endpoints.get(wid), alive)
+            except Exception:
+                logger.exception("kv audit of worker %x failed", wid)
+        if self._resync_pending:
+            # ONE resync per cycle, after every diverged worker's phantom
+            # purge: the replay is fleet-wide (every worker re-announces),
+            # so K diverged workers need K purges but only one replay —
+            # per-worker requests would multiply full-mirror replays on
+            # the shared stream by K after a fleet-wide loss incident
+            await self.indexer._request_resync()
+        # drop state for workers gone from both views (stale-advert
+        # history goes with it — keyed by lease ids that never recur,
+        # it would otherwise grow forever under fleet churn)
+        for wid in list(self.worker_state):
+            if wid not in workers:
+                del self.worker_state[wid]
+                self.stale_adverts.pop(wid, None)
+        # suspicion decays per cycle: healed workers drift back to the
+        # idle rotation instead of being hot-audited forever
+        for wid in list(self.suspicion):
+            s = self.suspicion[wid] * 0.5
+            if s < 0.1:
+                del self.suspicion[wid]
+            else:
+                self.suspicion[wid] = s
+        self.cycles += 1
+        self.last_cycle_s = time.perf_counter() - t0
+        self.last_cycle_at = time.time()
+        doc = self.status()
+        try:
+            await self.plane.kv_put(self._status_key(),
+                                    json.dumps(doc).encode())
+            await self._gc_sibling_status()
+        except Exception:
+            logger.debug("kv audit status publish failed", exc_info=True)
+        return doc
+
+    def _status_key(self) -> str:
+        return KV_AUDIT_STATUS_KEY.format(stream=self.indexer.stream,
+                                          replica=self.replica_hex)
+
+    async def _gc_sibling_status(self) -> None:
+        """Crashed routers leave their (lease-less) status docs behind;
+        surviving auditors sweep same-stream docs whose ts stopped
+        advancing — dynctl's stale flag covers the window in between."""
+        prefix = f"public/kvaudit/{self.indexer.stream}/"
+        own = self._status_key()
+        for key, value in (await self.plane.kv_get_prefix(prefix)).items():
+            if key == own:
+                continue
+            try:
+                st = json.loads(value)
+                age = time.time() - float(st.get("ts") or 0)
+                stale_after = 10 * float(
+                    st.get("interval_s") or self.config.interval_s)
+            except Exception:
+                age, stale_after = 1.0, 0.0  # unparsable: sweep it
+            if age > stale_after:
+                await self.plane.kv_delete(key)
+
+    def _tree_digest(self, wid: int) -> tuple[int, int]:
+        return self.indexer.tree.worker_digest(wid)
+
+    async def _audit_worker(self, wid: int, meta: Optional[dict],
+                            alive: Optional[set]) -> None:
+        st = self.worker_state.setdefault(wid, {
+            "diverged_since": None, "last_heal": None, "skip_pair": None,
+            "phantom": 0, "missing": 0, "dangling": 0,
+            "resident": None, "advertised": 0, "reachable": None,
+            "samples": {},
+        })
+        st["advertised"] = self._tree_digest(wid)[1]
+        if meta is None:
+            st["resident"] = None
+            self._audit_endpointless(wid, st, alive)
+            return
+        subject = meta.get("subject")
+        d = await fetch_kv_digest(self.plane, wid, subject=subject)
+        if d is None:
+            return  # dead/slow this cycle; lease expiry handles corpses
+        wdig = (int(d["servable"]["xor"]), int(d["servable"]["count"]))
+        st["resident"] = wdig[1]
+        rdig = self._tree_digest(wid)
+        if wdig == rdig:
+            self._mark_clean(st)
+            return
+        if st["skip_pair"] == (wdig, rdig):
+            return  # known dangling-stable pair: nothing resync can fix
+        # settle: batched stored events / in-flight removals are ms-scale;
+        # re-probe before declaring divergence so the audit never heals a
+        # write that was simply still on the wire
+        await asyncio.sleep(self.config.settle_s)
+        d = await fetch_kv_digest(self.plane, wid, subject=subject)
+        if d is None:
+            return
+        wdig = (int(d["servable"]["xor"]), int(d["servable"]["count"]))
+        st["resident"] = wdig[1]
+        rdig = self._tree_digest(wid)
+        if wdig == rdig:
+            self._mark_clean(st)
+            return
+        await self._classify_and_heal(wid, st, wdig, rdig, subject)
+
+    def _audit_endpointless(self, wid: int, st: dict,
+                            alive: Optional[set]) -> None:
+        """A worker in the radix with no kv_digest endpoint is either a
+        live digest-less worker (pre-audit build, caching-off adverts —
+        nothing to compare against, leave informational) or a corpse
+        resurrected by the ring replay: a replica born after the
+        worker's death replays its stored events out of the hub ring,
+        and the delete event that would have purged them predates the
+        replica — every advertised block is a phantom no resync can
+        retract (the worker's resync responder died with it). With a
+        definitive liveness view (fleet-wide instance scan; None =
+        unknown, never purge), purge after two consecutive endpoint-less
+        sightings (one cycle of watch-lag grace)."""
+        if not st["advertised"]:
+            st["no_endpoint_cycles"] = 0
+            return
+        if alive is None:
+            return  # liveness unknown this cycle: stay conservative
+        if wid in alive:
+            st["no_endpoint_cycles"] = 0
+            return
+        st["no_endpoint_cycles"] = st.get("no_endpoint_cycles", 0) + 1
+        if st["no_endpoint_cycles"] < 2:
+            return
+        tree = self.indexer.tree
+        n = st["advertised"]
+        st["phantom"] = n
+        st["samples"] = {
+            "phantom": sorted(h & _U64 for h in tree.worker_hashes(wid))[
+                :self.config.max_samples],
+            "missing": [], "dangling": []}
+        if st["diverged_since"] is None:
+            st["diverged_since"] = time.time()
+        if not self.config.heal_enabled:
+            logger.warning(
+                "kv audit (report-only): departed worker %x still "
+                "advertises %d blocks in the radix (tombstone leak)",
+                wid, n)
+            return
+        logger.warning(
+            "kv audit: purging %d phantom blocks advertised by departed "
+            "worker %x (tombstone leak — no delete event will ever come)",
+            n, wid)
+        tree.remove_worker(wid)
+        # no resync: only live workers replay, so nothing re-adds the
+        # corpse — and its state entry is swept next cycle (gone from
+        # both views)
+        st["diverged_since"] = None
+        st["last_heal"] = time.time()
+        self.heals_total["departed"] = \
+            self.heals_total.get("departed", 0) + 1
+
+    def _mark_clean(self, st: dict) -> None:
+        if st["diverged_since"] is not None:
+            st["last_heal"] = time.time()
+        st["diverged_since"] = None
+        st["skip_pair"] = None
+        st["phantom"] = st["missing"] = st["dangling"] = 0
+        st["samples"] = {}
+
+    async def _classify_and_heal(self, wid: int, st: dict, wdig, rdig,
+                                 subject: Optional[str] = None) -> None:
+        chain = await fetch_kv_chain(self.plane, wid, subject=subject)
+        if chain is None:
+            return
+        tree = self.indexer.tree
+        resident = {h & _U64 for h in chain.get("resident") or ()}
+        anchored = {h & _U64 for h in chain.get("anchored") or ()}
+        radix = {h & _U64 for h in tree.worker_hashes(wid)}
+        phantom = radix - resident
+        missing = anchored - radix
+        # double-probe: any block announced/removed between the two
+        # snapshots above would read as divergence for exactly one probe —
+        # intersecting two independent probes kills the one-shot races.
+        # An unanswered second probe must NOT fall through to a purge
+        # from the single racing snapshot — skip the cycle instead,
+        # exactly like an unanswered first probe
+        chain2 = await fetch_kv_chain(self.plane, wid, subject=subject)
+        if chain2 is None:
+            return
+        resident2 = {h & _U64 for h in chain2.get("resident") or ()}
+        anchored2 = {h & _U64 for h in chain2.get("anchored") or ()}
+        radix2 = {h & _U64 for h in tree.worker_hashes(wid)}
+        phantom &= radix2 - resident2
+        missing &= anchored2 - radix2
+        resident, anchored, radix = resident2, anchored2, radix2
+        dangling = (resident - anchored) - radix
+        if int(chain2.get("resident_total", len(resident))) > len(resident):
+            # the chain payload is capped at MAX_CHAIN_HASHES: phantom
+            # (radix − resident) against a TRUNCATED resident set would
+            # mass-classify the worker's valid adverts beyond the cap
+            # and purge its whole projection every cycle. A truncated
+            # anchored set is still safe for the missing side — it is a
+            # subset, and the resync replays the full chain — so heal
+            # that and only that
+            logger.warning(
+                "kv audit: worker %x serves %s resident blocks, over the "
+                "%d chain-diff cap — phantom/dangling classification "
+                "skipped on the truncated view", wid,
+                chain2.get("resident_total"), MAX_CHAIN_HASHES)
+            phantom = set()
+            dangling = set()
+        n = self.config.max_samples
+        st["phantom"], st["missing"] = len(phantom), len(missing)
+        st["dangling"] = len(dangling)
+        st["samples"] = {
+            "phantom": sorted(phantom)[:n],
+            "missing": sorted(missing)[:n],
+            "dangling": sorted(dangling)[:n],
+        }
+        if not phantom and not missing:
+            # digests disagree but nothing is healable: dangling blocks
+            # (or an xor-collision ghost) — report, remember the pair,
+            # and stop re-healing until either side moves
+            st["skip_pair"] = (wdig, rdig)
+            st["diverged_since"] = None
+            return
+        if st["diverged_since"] is None:
+            st["diverged_since"] = time.time()
+        cause = "phantom" if phantom else "missing"
+        if not self.config.heal_enabled:
+            logger.warning(
+                "kv audit (report-only): worker %x diverged (%d phantom, "
+                "%d missing, %d dangling; advertised %d vs resident %d)",
+                wid, len(phantom), len(missing), len(dangling),
+                rdig[1], wdig[1])
+            return
+        logger.warning(
+            "kv audit: worker %x diverged (%d phantom, %d missing, "
+            "%d dangling; advertised %d vs resident %d) — healing via %s "
+            "resync", wid, len(phantom), len(missing), len(dangling),
+            rdig[1], wdig[1], cause)
+        if phantom:
+            # stored events are idempotent UPSERTS — a resync replay can
+            # only add; the phantoms must leave the local tree first. The
+            # replay then restores everything the worker really holds
+            # (and the worker's ledger-aware replay publishes removals
+            # for its own stale mirror entries, healing replicas that
+            # did not purge).
+            tree.remove_worker(wid)
+        self._resync_pending = True  # issued once per cycle by audit_once
+        # one resync heals BOTH kinds; credit each cause present so a
+        # mixed divergence doesn't undercount missing heals
+        if phantom:
+            self.heals_total["phantom"] = \
+                self.heals_total.get("phantom", 0) + 1
+        if missing:
+            self.heals_total["missing"] = \
+                self.heals_total.get("missing", 0) + 1
+
+    # ------------------------------------------------------------- surfaces
+
+    def divergence_blocks(self) -> dict[tuple[int, str], int]:
+        """{(worker, kind): blocks} for dynamo_radix_divergence_blocks."""
+        out: dict[tuple[int, str], int] = {}
+        for wid, st in self.worker_state.items():
+            for kind in ("phantom", "missing", "dangling"):
+                if st.get(kind):
+                    out[(wid, kind)] = st[kind]
+        return out
+
+    def status(self) -> dict:
+        now = time.time()
+        workers = {}
+        for wid, st in self.worker_state.items():
+            workers[u64_hex(wid)] = {
+                "advertised_blocks": st.get("advertised", 0),
+                "resident_blocks": st.get("resident"),
+                "phantom": st.get("phantom", 0),
+                "missing": st.get("missing", 0),
+                "dangling": st.get("dangling", 0),
+                "divergence_age_s": (
+                    round(now - st["diverged_since"], 3)
+                    if st.get("diverged_since") else 0.0),
+                "last_heal_s_ago": (
+                    round(now - st["last_heal"], 3)
+                    if st.get("last_heal") else None),
+                "suspicion": round(self.suspicion.get(wid, 0.0), 2),
+                "stale_adverts": self.stale_adverts.get(wid, 0),
+                "samples": st.get("samples") or {},
+            }
+        return {
+            "ts": now,
+            "stream": self.indexer.stream,
+            "replica": self.replica_hex,
+            "cycles": self.cycles,
+            "interval_s": self.config.interval_s,
+            "last_cycle_ms": round(self.last_cycle_s * 1000.0, 3),
+            "heals_total": dict(self.heals_total),
+            "workers": workers,
+        }

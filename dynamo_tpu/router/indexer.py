@@ -67,6 +67,43 @@ class RadixTree:
         #: stored events dropped because their parent was unknown — each one
         #: is evidence of event loss; the indexer turns these into resyncs
         self.orphan_events = 0
+        #: per-worker rolling [xor, count] over this tree's (worker, hash)
+        #: membership — maintained inline at every insert/remove so the
+        #: audit plane (observability/kvaudit.py) compares a worker's
+        #: radix projection against its residency ledger in O(1) instead
+        #: of walking the index, and the frontend exports radix shape
+        #: (dynamo_radix_blocks{worker}) for free
+        self._digests: dict[int, list[int]] = {}
+
+    _U64 = (1 << 64) - 1
+
+    def _digest_add(self, worker: int, h: int) -> None:
+        d = self._digests.setdefault(worker, [0, 0])
+        d[0] ^= h & self._U64
+        d[1] += 1
+
+    def _digest_del(self, worker: int, h: int) -> None:
+        d = self._digests.get(worker)
+        if d is None:
+            return
+        d[0] ^= h & self._U64
+        d[1] -= 1
+        if d[1] <= 0:
+            del self._digests[worker]
+
+    def worker_digest(self, worker: int) -> tuple[int, int]:
+        """(xor, count) over the worker's advertised block hashes."""
+        d = self._digests.get(worker)
+        return (d[0], d[1]) if d else (0, 0)
+
+    def worker_counts(self) -> dict[int, int]:
+        """worker → advertised block count (radix shape, O(workers))."""
+        return {w: d[1] for w, d in self._digests.items()}
+
+    def worker_hashes(self, worker: int) -> set[int]:
+        """The worker's advertised hash set — O(index); only the audit's
+        chain diff (a mismatch, i.e. rare) walks it."""
+        return {h for (w, h) in self._lookup if w == worker}
 
     def apply_event(self, ev: RouterEvent) -> None:
         self.event_count += 1
@@ -100,6 +137,9 @@ class RadixTree:
                 child = _Node(node, b.tokens_hash)
                 node.children[b.tokens_hash] = child
             child.workers.add(worker)
+            if (worker, b.block_hash) not in self._lookup:
+                # idempotent re-store (resync replay) must not double-fold
+                self._digest_add(worker, b.block_hash)
             self._lookup[(worker, b.block_hash)] = child
             node = child
 
@@ -108,6 +148,7 @@ class RadixTree:
             node = self._lookup.pop((worker, h), None)
             if node is None:
                 continue
+            self._digest_del(worker, h)
             node.workers.discard(worker)
             self._prune(node)
 
@@ -126,6 +167,7 @@ class RadixTree:
             node = self._lookup.pop(k)
             node.workers.discard(worker)
             self._prune(node)
+        self._digests.pop(worker, None)
 
     def find_matches(self, local_hashes: list[int]) -> OverlapScores:
         """Walk the chain of local hashes from root, scoring workers per level."""
@@ -210,6 +252,8 @@ class RadixTree:
         for path, workers in d.get("entries", []):
             node_at(path).workers.update(workers)
         for w, h, path in d.get("lookup", []):
+            if (w, h) not in tree._lookup:
+                tree._digest_add(w, h)
             tree._lookup[(w, h)] = node_at(path)
         return tree
 

@@ -60,10 +60,21 @@ class KvRouter:
         self._sync_sub = None
         self._sync_task = None
         self._publish_tasks: set = set()  # strong refs: loop holds only weak
+        #: KV index audit plane (docs/observability.md "KV audit"):
+        #: started with the event-fed indexer unless DYN_KV_AUDIT=0 —
+        #: the approx indexer predicts contents by construction, so
+        #: there is no truth claim to audit there
+        self.auditor = None
 
     async def start(self) -> "KvRouter":
         if isinstance(self.indexer, KvIndexer):
             await self.indexer.start()
+            from dynamo_tpu.observability.kvaudit import (AuditConfig,
+                                                          KvAuditor)
+            acfg = AuditConfig.from_env()
+            if acfg.enabled:
+                self.auditor = await KvAuditor(
+                    self.plane, self.indexer, acfg).start()
         if self.config.router_replica_sync:
             self._sync_sub = await self.plane.subscribe(ROUTER_SYNC_SUBJECT)
             self._sync_task = asyncio.get_running_loop().create_task(
@@ -71,6 +82,8 @@ class KvRouter:
         return self
 
     async def stop(self):
+        if self.auditor is not None:
+            await self.auditor.stop()
         if isinstance(self.indexer, KvIndexer):
             await self.indexer.stop()
         if self._sync_task:

@@ -45,16 +45,61 @@ def _spawn_publish(owner, coro) -> None:
     task.add_done_callback(_done)
 
 
+def reachable_chain(entries: dict[int, tuple[Optional[int], int]],
+                    member: Optional[set] = None
+                    ) -> list[tuple[int, Optional[int], int]]:
+    """Root-anchored ordered subset of a publisher mirror: the blocks a
+    resync replay can re-announce, parents before children.
+
+    ``entries`` is ``{block_hash: (parent_hash | None, tokens_hash)}``;
+    ``member`` (optional) restricts anchoring to hashes actually resident
+    per the worker's KV ledger (observability/kvaudit.py) — a mirror
+    entry whose block left every servable tier must neither be replayed
+    nor anchor its children. Iterates to fixpoint: mirror order USUALLY
+    has parents first, but a remove-then-re-store moves a parent behind
+    its children (dict re-insertion), so one pass could drop valid
+    chains. Entries never reached are dangling (ancestor evicted while
+    the child survives) — unroutable anyway, since find_matches walks
+    from the root."""
+    reachable: set[int] = set()
+    pending = list(entries.items())
+    ordered: list[tuple[int, Optional[int], int]] = []
+    while True:
+        still = []
+        for bh, (parent, tokens_hash) in pending:
+            if member is not None and bh not in member:
+                continue  # stale mirror entry: cannot anchor anything
+            if parent is None or parent in reachable:
+                reachable.add(bh)
+                ordered.append((bh, parent, tokens_hash))
+            else:
+                still.append((bh, (parent, tokens_hash)))
+        if len(still) == len(pending):
+            break  # the rest are genuinely dangling
+        pending = still
+    return ordered
+
+
 class KvEventPublisher:
     """Publishes KV cache deltas to the durable stream AND mirrors what it
     has announced, so a router that detects a stream gap can ask for a full
-    re-announcement instead of serving a silently-stale radix index."""
+    re-announcement instead of serving a silently-stale radix index.
 
-    def __init__(self, plane, worker_id: int, kv_block_size: int, stream: str = KV_EVENTS_STREAM):
+    ``ledger`` (observability/kvaudit.WorkerKvLedger, optional): the
+    worker's tier-residency ground truth. When attached, a resync replay
+    reconciles the mirror against it — mirror entries whose blocks left
+    every servable tier (an eviction whose removal event a bug or the
+    wire lost) are dropped from the mirror AND published as removals, so
+    the replay heals phantom adverts at every replica, not just the one
+    that purged (docs/observability.md "KV audit")."""
+
+    def __init__(self, plane, worker_id: int, kv_block_size: int,
+                 stream: str = KV_EVENTS_STREAM, ledger=None):
         self.plane = plane
         self.worker_id = worker_id
         self.kv_block_size = kv_block_size
         self.stream = stream
+        self.ledger = ledger
         self._event_id = 0
         # block_hash -> (parent_block_hash | None, tokens_hash), insertion-
         # ordered so a replay announces parents before children
@@ -144,6 +189,11 @@ class KvEventPublisher:
         except asyncio.CancelledError:
             pass
 
+    def announced_chain(self) -> dict[int, tuple[Optional[int], int]]:
+        """Snapshot of the announce mirror (block → (parent, tokens_hash))
+        — the chain structure the kv_digest diff op serves."""
+        return dict(self._announced)
+
     async def _replay_announced(self):
         """Re-publish the mirror as chained stored events. Consecutive blocks
         whose parent is the previous block collapse into one event. Holds the
@@ -152,30 +202,33 @@ class KvEventPublisher:
         replay lands after it — so the stream's final word on every block
         matches the mirror's."""
         async with self._publish_lock:
-            # Only replay blocks REACHABLE from a root-anchored chain. A
-            # dangling entry (ancestor evicted while the child survives LRU)
-            # can't be routed to anyway — find_matches walks from the root —
-            # and emitting it would be an eternal orphan at every indexer,
-            # re-triggering a fleet-wide replay each time. Iterate to
-            # fixpoint: mirror order USUALLY has parents first, but a
-            # remove-then-re-store moves the parent behind its children
-            # (dict re-insertion), so one pass could drop valid chains.
+            # Only replay blocks REACHABLE from a root-anchored chain
+            # (see reachable_chain): a dangling entry can't be routed to
+            # anyway, and emitting it would be an eternal orphan at every
+            # indexer, re-triggering a fleet-wide replay each time.
             snapshot = list(self._announced.items())
-            reachable: set[int] = set()
-            pending = snapshot
-            ordered: list[tuple] = []
-            while True:
-                still = []
-                for bh, (parent, tokens_hash) in pending:
-                    if parent is None or parent in reachable:
-                        reachable.add(bh)
-                        ordered.append((bh, parent, tokens_hash))
-                    else:
-                        still.append((bh, (parent, tokens_hash)))
-                if len(still) == len(pending):
-                    break  # the rest are genuinely dangling
-                pending = still
-            items = ordered
+            member = None
+            if self.ledger is not None:
+                # ledger reconciliation (the audit plane's phantom heal):
+                # mirror entries no servable tier holds anymore were
+                # announced but never retracted — a suppression bug or a
+                # wire-lost removal. Replaying them would resurrect the
+                # phantom at every purged replica; instead retract them
+                # here, so the replay's final word matches RESIDENCY, not
+                # just past announcements.
+                member = set(self.ledger.servable_hashes())
+                stale = [bh for bh, _ in snapshot if bh not in member]
+                if stale:
+                    logger.warning(
+                        "kv resync: retracting %d announced-but-not-"
+                        "resident blocks (lost/suppressed removals)",
+                        len(stale))
+                    for bh in stale:
+                        self._announced.pop(bh, None)
+                    snapshot = [e for e in snapshot if e[0] in member]
+                    await self._publish_unlocked(KvCacheEvent.removed(
+                        self._next_id(), stale))
+            items = reachable_chain(dict(snapshot), member=member)
             chain_parent: Optional[int] = None
             chain: list[StoredBlock] = []
             prev_hash: Optional[int] = None

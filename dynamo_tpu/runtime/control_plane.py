@@ -316,6 +316,13 @@ class LocalControlPlane(ControlPlane):
         #: read via hub_stats() / the `hub_stats` wire op
         self.hub_events: dict[str, int] = {}
         self.hub_publish = _HubHist()
+        #: per-stream entries dropped off the ring cap — a consumer
+        #: further behind than this sees a gap and must resync
+        self.hub_stream_truncated: dict[str, int] = {}
+        #: resync requests observed (publishes on the kv_resync.* subject
+        #: — the literal prefix is a wire constant, router/protocols.py's
+        #: KV_RESYNC_SUBJECT; importing it here would cycle the packages)
+        self.hub_resyncs_requested = 0
 
     def _ensure_sweeper(self):
         if self._sweeper is None or self._sweeper.done():
@@ -338,9 +345,21 @@ class LocalControlPlane(ControlPlane):
 
     async def hub_stats(self) -> dict:
         """Event counters + publish latency for dynctl top and the metrics
-        aggregator's dynamo_hub_* series."""
+        aggregator's dynamo_hub_* series — plus per-stream health (last
+        seq / first retained seq / entries truncated off the ring) and
+        the resync-request count, so the `dynctl top` hub footer shows
+        whether the KV event stream is outrunning its consumers."""
+        streams = {}
+        for name, (seq, entries) in self._streams.items():
+            streams[name] = {
+                "last_seq": seq,
+                "first_seq": entries[0][0] if entries else seq + 1,
+                "truncated": self.hub_stream_truncated.get(name, 0),
+            }
         return {"epoch": self.epoch, "events": dict(self.hub_events),
-                "publish_seconds": self.hub_publish.to_dict()}
+                "publish_seconds": self.hub_publish.to_dict(),
+                "streams": streams,
+                "resyncs_requested": self.hub_resyncs_requested}
 
     # -- KV --
     def _notify(self, ev: WatchEvent):
@@ -436,6 +455,8 @@ class LocalControlPlane(ControlPlane):
     # -- Pub/sub --
     async def publish(self, subject, payload):
         self._hub_count("publish")
+        if subject.startswith("kv_resync"):
+            self.hub_resyncs_requested += 1
         chaos = get_chaos()
         if chaos is not None:
             await chaos.pre("plane.publish")
@@ -529,11 +550,24 @@ class LocalControlPlane(ControlPlane):
     # -- Durable streams --
     async def stream_publish(self, stream, payload) -> int:
         self._hub_count("stream_publish")
+        chaos = get_chaos()
+        if chaos is not None:
+            await chaos.pre("plane.publish")
+            if chaos.should_drop("plane.publish"):
+                # lost BEFORE the stream assigns a seq: no gap for the
+                # consumer's sequence check to see — the silent-drift
+                # shape the KV audit plane exists to catch
+                # (docs/observability.md "KV audit")
+                seq, _ = self._streams.get(stream, (0, []))
+                return seq
         t0 = time.perf_counter()
         seq, entries = self._streams.get(stream, (0, []))
         seq += 1
         entries.append((seq, payload))
         if len(entries) > self.stream_max_len:
+            dropped = len(entries) - self.stream_max_len
+            self.hub_stream_truncated[stream] = (
+                self.hub_stream_truncated.get(stream, 0) + dropped)
             entries[:] = entries[-self.stream_max_len:]
         self._streams[stream] = (seq, entries)
         for q in self._stream_subs.get(stream, []):

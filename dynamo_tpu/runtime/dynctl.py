@@ -29,6 +29,11 @@ Subcommands:
   anomaly tags (``!`` slow, ``C`` compile, ``P`` preempt-storm, ``s``
   budget-starved, ``_`` empty bubble) and the tagged records in full;
   ``--watch`` refreshes incrementally via the ``since`` step cursor.
+- ``dynctl kv [--worker] [--diff]`` — the KV index audit view
+  (docs/observability.md "KV audit"): per worker, the router's
+  advertised block count vs the worker's resident count, phantom /
+  missing / dangling divergence with age, last heal, suspicion score and
+  stale-advert pull failures; ``--diff`` adds divergent-hash samples.
 - ``dynctl why <request-id>`` — the per-request latency attribution tree
   (docs/observability.md "Attribution"): the request's spans joined with
   the serving workers' step records, every millisecond bucketed into a
@@ -43,6 +48,7 @@ import argparse
 import asyncio
 import json
 import sys
+import time
 
 from dynamo_tpu.runtime.config import setup_logging
 from dynamo_tpu.runtime.control_plane import ControlPlaneServer
@@ -264,6 +270,16 @@ async def top_amain(as_json: bool, watch: float = 0.0,
                           + " ".join(f"{k}={v}" for k, v in sorted(ev.items()))
                           + f"  publish mean {mean_us:.0f}us over "
                             f"{pub.get('count', 0)} events")
+                    # KV event-stream health (docs/observability.md "KV
+                    # audit"): is the radix's feed intact, truncating, or
+                    # forcing resyncs?
+                    kv = (hub.get("streams") or {}).get("kv_events")
+                    if kv:
+                        print(f"kv_events: last seq {kv.get('last_seq', 0)} "
+                              f"(retained from {kv.get('first_seq', 1)})  "
+                              f"truncated {kv.get('truncated', 0)}  "
+                              f"resyncs requested "
+                              f"{hub.get('resyncs_requested', 0)}")
             if not watch:
                 return 0 if workers else 1
             await asyncio.sleep(watch)
@@ -430,6 +446,146 @@ async def why_amain(request_id: str, as_json: bool, records: int = 2048,
         await runtime.shutdown()
 
 
+async def kv_amain(worker: str, diff: bool, as_json: bool,
+                   watch: float = 0.0, timeout: float = 2.0) -> int:
+    """``dynctl kv`` — the KV index audit view (docs/observability.md
+    "KV audit"): per worker, the router's advertised block count vs the
+    worker's resident count (live digest), divergence classification +
+    age, last heal, suspicion, and stale-advert pull failures. The audit
+    status comes from the routers' published docs (public/kvaudit/...);
+    resident counts are fetched live from each worker's kv_digest
+    endpoint so the view works even before any auditor has run."""
+    from dynamo_tpu.observability.kvaudit import (fetch_kv_chain,
+                                                  fetch_kv_digest,
+                                                  list_digest_workers,
+                                                  u64_hex)
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    runtime = await DistributedRuntime.create()
+    try:
+        while True:
+            statuses = {}
+            try:
+                for key, value in (await runtime.plane.kv_get_prefix(
+                        "public/kvaudit/")).items():
+                    try:
+                        st = json.loads(value)
+                    except Exception:
+                        continue
+                    # a stopped auditor deletes its doc; a CRASHED one
+                    # can't — flag anything older than 3 intervals so a
+                    # dead fleet's counts never read as live
+                    age = time.time() - float(st.get("ts") or 0)
+                    if age > 3 * float(st.get("interval_s") or 30.0):
+                        st["stale_s"] = round(age, 1)
+                    # key = public/kvaudit/<stream>/<replica>
+                    statuses[key[len("public/kvaudit/"):]] = st
+            except Exception:
+                pass
+            endpoints = await list_digest_workers(runtime.plane)
+            digests = {}
+            for wid in endpoints:
+                d = await fetch_kv_digest(runtime.plane, wid, timeout)
+                if d is not None:
+                    digests[u64_hex(wid)] = d
+            if as_json:
+                print(json.dumps({"audit": statuses, "digests": digests},
+                                 indent=2))
+            else:
+                # one row per worker: audit status merged with the live
+                # digest (live wins for "resident now")
+                rows: dict[str, dict] = {}
+                for stream, st in statuses.items():
+                    if st.get("stale_s"):
+                        print(f"warning: audit status for stream "
+                              f"{stream!r} is {st['stale_s']}s old "
+                              f"(auditor crashed?) — counts below may "
+                              f"describe a dead fleet")
+                    for whex, w in (st.get("workers") or {}).items():
+                        rows[whex] = dict(w)
+                for whex, d in digests.items():
+                    rows.setdefault(whex, {})["resident_now"] = (
+                        d.get("servable") or {}).get("count")
+                    rows[whex]["tiers"] = {
+                        t: v.get("count", 0)
+                        for t, v in (d.get("tiers") or {}).items()}
+                shown = {k: v for k, v in rows.items()
+                         if not worker or worker in k}
+                if not shown:
+                    print("no kv_digest endpoints or audit status found — "
+                          "are workers (and a kv-mode router) running "
+                          "against this control plane?")
+                else:
+                    print(f"{'worker':<18s} {'advert':>7s} {'resident':>9s} "
+                          f"{'phantom':>8s} {'missing':>8s} {'dangling':>9s} "
+                          f"{'div-age':>8s} {'heal':>9s} {'susp':>5s} "
+                          f"{'stale':>6s}  tiers g1/g2/g3/g4")
+                    for whex in sorted(shown):
+                        w = shown[whex]
+                        res = w.get("resident_now",
+                                    w.get("resident_blocks"))
+                        t = w.get("tiers") or {}
+                        tiers = "/".join(str(t.get(k, 0)) for k in
+                                         ("g1", "g2", "g3", "g4"))
+                        heal = w.get("last_heal_s_ago")
+                        print(f"{whex:<18s} "
+                              f"{w.get('advertised_blocks', 0):>7} "
+                              f"{res if res is not None else '-':>9} "
+                              f"{w.get('phantom', 0):>8} "
+                              f"{w.get('missing', 0):>8} "
+                              f"{w.get('dangling', 0):>9} "
+                              f"{w.get('divergence_age_s', 0.0):>7.1f}s "
+                              f"{(f'{heal:.0f}s ago' if heal is not None else 'never'):>9s} "
+                              f"{w.get('suspicion', 0):>5} "
+                              f"{w.get('stale_adverts', 0):>6}  {tiers}")
+                        if diff and w.get("samples"):
+                            for kind, hs in sorted(w["samples"].items()):
+                                if hs:
+                                    print(f"    {kind}: "
+                                          + " ".join(f"{h:x}" for h in hs))
+                if diff and worker:
+                    # live chain fetch for the named worker: the full
+                    # resident/anchored view, not just the last audit's
+                    # samples
+                    for wid in endpoints:
+                        whex = u64_hex(wid)
+                        if worker not in whex:
+                            continue
+                        ch = await fetch_kv_chain(runtime.plane, wid,
+                                                  timeout)
+                        if ch:
+                            print(f"  {whex} live chain: "
+                                  f"{ch.get('resident_total', 0)} resident, "
+                                  f"{len(ch.get('anchored') or ())} "
+                                  f"root-anchored")
+            if not watch:
+                return 0 if (statuses or digests) else 1
+            await asyncio.sleep(watch)
+            print()
+    finally:
+        await runtime.shutdown()
+
+
+def _kv_main(argv: list[str]) -> None:
+    ap = argparse.ArgumentParser(
+        prog="dynctl kv",
+        description="KV index audit view: advertised vs resident blocks, "
+                    "divergence, heals, suspicion per worker")
+    ap.add_argument("--worker", default="",
+                    help="filter by worker lease-hex substring")
+    ap.add_argument("--diff", action="store_true",
+                    help="show divergent-hash samples (and, with "
+                         "--worker, the live chain summary)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every N seconds (0 = one-shot)")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    raise SystemExit(asyncio.run(
+        kv_amain(args.worker, args.diff, args.json, args.watch,
+                 args.timeout)))
+
+
 def _top_main(argv: list[str]) -> None:
     ap = argparse.ArgumentParser(
         prog="dynctl top",
@@ -523,6 +679,9 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "why":
         _why_main(sys.argv[2:])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "kv":
+        _kv_main(sys.argv[2:])
         return
     ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
     ap.add_argument("--host", default="0.0.0.0")

@@ -97,6 +97,14 @@ class Gauge:
         with self._lock:
             self._values[key] = value
 
+    def remove(self, **labels):
+        """Drop one labeled series (label-churn hygiene: a departed
+        worker's gauge must leave /metrics, not linger as a 0-valued
+        series forever — unbounded cardinality under fleet churn)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values.pop(key, None)
+
     def add_callback(self, fn):
         """fn() -> dict[labels, value] evaluated at scrape time (the
         _merge_callback_values contract, shared with Counter)."""

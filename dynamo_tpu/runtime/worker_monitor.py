@@ -69,6 +69,12 @@ class WorkerMonitor:
         self._busy: list[int] = []
         #: lease -> monotonic purge time (dead-instance hygiene)
         self._dead: dict[int, float] = {}
+        #: late kv_metrics rejected by a tombstone — counted (and rate-
+        #: limit-logged) instead of silently dropped: a steady rate means
+        #: something keeps publishing for a worker the fleet purged
+        #: (exported as dynamo_kv_events_tombstoned_total)
+        self.tombstoned_total = 0
+        self._tombstone_warned_at = 0.0
 
     def purge(self, lease: int) -> None:
         """Drop a dead worker's load state from the busy computation and
@@ -163,7 +169,20 @@ class WorkerMonitor:
                     logger.exception("bad kv_metrics payload ignored")
                     continue
                 if self._is_dead(worker):
-                    continue  # late publish from a purged worker
+                    # late publish from a purged worker: count it, warn at
+                    # most once per 30 s (one dead worker's queued reports
+                    # arrive in bursts — a line each would flood the log)
+                    import time as _time
+
+                    self.tombstoned_total += 1
+                    now = _time.monotonic()
+                    if now - self._tombstone_warned_at > 30.0:
+                        self._tombstone_warned_at = now
+                        logger.warning(
+                            "tombstone rejected late kv_metrics from "
+                            "purged worker %x (%d total)", worker,
+                            self.tombstoned_total)
+                    continue
                 st = self.load_states.setdefault(worker, WorkerLoadState())
                 st.kv_active_blocks = metrics.kv_stats.kv_active_blocks
                 self._recompute()
