@@ -3217,6 +3217,28 @@ def main():
         print(json.dumps(out), flush=True)
         raise SystemExit(0 if out["autoscale_ok"] else 1)
 
+    if "--flagship" in sys.argv:
+        # flagship fleet drive: the plan_70b placement as a live mocker
+        # fleet (2xTP8 prefill + 6xTP8 decode) through one diurnal
+        # QoS-mixed cycle with disagg, autoscaling, KV audit and seeded
+        # chaos kills all on — prints one JSON line; exits nonzero when
+        # completion, token accounting, scorecard checks, scale events,
+        # or audit convergence fail (docs/observability.md "Fleet
+        # scorecard")
+        from benchmarks.flagship_drive import drive as flagship_drive
+        try:
+            out = asyncio.run(flagship_drive())
+            out.pop("scorecard", None)  # full doc is too big for one line
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"flagship": "failed",
+                              "error": repr(e)[:300]}), flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["flagship_ok"] else 1)
+
     if "--chaos" in sys.argv:
         # chaos smoke: no accelerator, no child orchestration — prints one
         # JSON line; exits nonzero when completion rate or p95 degradation
@@ -3318,20 +3340,20 @@ def _child_main():
               os.environ.get("DYN_BENCH_PHASES",
                              "kernel,spec,e2e,chaos,mem,qos,autoscale,"
                              "ragged,disagg,migration,onboard,flight,"
-                             "tools,attribution,kvaudit"
+                             "tools,attribution,kvaudit,flagship"
                              ).split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
                         "autoscale", "ragged", "disagg", "migration",
                         "onboard", "flight", "tools", "attribution",
-                        "kvaudit"}
+                        "kvaudit", "flagship"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
                          f"chaos, mem, qos, autoscale, ragged, disagg, "
                          f"migration, onboard, flight, tools, "
-                         f"attribution, kvaudit)")
+                         f"attribution, kvaudit, flagship)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -3465,6 +3487,20 @@ def _child_main():
                 kern["kvaudit"] = asyncio.run(kvaudit_bench(on_tpu))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["kvaudit_error"] = repr(e)[:200]
+        if "flagship" in phases:
+            # flagship fleet drive: the 70B placement live as a mocker
+            # fleet through one diurnal cycle with everything on —
+            # completion, zero-loss accounting, scorecard checks, scale
+            # events, audit convergence and hub saturation headroom on
+            # record every round (ISSUE 16 acceptance)
+            try:
+                from benchmarks.flagship_drive import drive as _flagship
+
+                flag = asyncio.run(_flagship())
+                flag.pop("scorecard", None)  # keep the metric line bounded
+                kern["flagship"] = flag
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["flagship_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

@@ -115,6 +115,14 @@ async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
                     chunk = _json.loads(line[6:])
                 except ValueError:
                     continue
+                if chunk.get("error"):
+                    # in-band SSE error (stream broke after the 200 went
+                    # out): the request FAILED even though the HTTP layer
+                    # looks clean — counting it ok hides silent truncation
+                    err = chunk["error"]
+                    res.error = (err.get("message", "stream error")
+                                 if isinstance(err, dict) else str(err))
+                    break
                 if chunk.get("usage"):  # record the true token ISL/OSL
                     res.prompt_tokens = chunk["usage"].get("prompt_tokens", 0)
                     res.completion_tokens = chunk["usage"].get(
@@ -133,7 +141,7 @@ async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
                 last = now
                 res.tokens += 1
             res.latency_s = time.perf_counter() - t0
-            res.ok = res.ttft_s is not None
+            res.ok = res.ttft_s is not None and res.error is None
             return res
     except Exception as e:
         res.error = repr(e)

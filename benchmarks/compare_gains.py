@@ -79,7 +79,24 @@ def compare(baseline: dict, current: dict, tolerance: float) -> tuple[list, list
                 f"{key}: {b:g} → {c:g} ({(ratio - 1) * 100:.0f}% worse)")
         elif d is None and abs(ratio - 1.0) > tolerance:
             drifts.append(f"{key}: {b:g} → {c:g}")
-    missing = sorted(set(base) - set(cur))
+    # phase-granular presence accounting: a whole bench phase appearing
+    # (a new subsystem's phase lands before the baseline refresh) or
+    # disappearing (phase skipped this run) must collapse to ONE line per
+    # phase, not a warning per key — only keys missing from phases BOTH
+    # sides ran are per-key news
+    def phase(key: str) -> str:
+        return key.split(".", 1)[0]
+
+    base_phases = {phase(k) for k in base}
+    cur_phases = {phase(k) for k in cur}
+    new_phases = sorted(cur_phases - base_phases)
+    if new_phases:
+        drifts.append(f"phase(s) not in baseline yet (refresh it): "
+                      f"{new_phases}")
+    for p in sorted(base_phases - cur_phases):
+        drifts.append(f"baseline phase '{p}' absent from this run")
+    missing = sorted(k for k in set(base) - set(cur)
+                     if phase(k) in cur_phases)
     if missing:
         drifts.append(f"{len(missing)} baseline keys absent from this run "
                       f"(first: {missing[:3]})")

@@ -11,7 +11,20 @@ processes' worth of connections:
 - **kv_put**: discovery-write ops/s;
 - **stream_publish**: KV-event appends/s (the router feed).
 
+``--fleet-profile [PATH]`` replays the FLAGSHIP DRIVE's measured hub event
+mix instead of the homogeneous legs: every worker cycles a deterministic
+weighted schedule of request/kv_put/kv_delete/publish/stream_publish ops in
+the proportions the 70B fleet drive actually produced
+(benchmarks/flagship_drive.py → ``hub_event_mix``), plus a per-request
+BATCHED KV-event leg at the plan's blocks-per-request. The output states
+headroom against both ceilings the fleet needs (docs/PERF_NOTES.md): the
+plan's hub op rate vs the mixed ceiling, and the plan's stored-blocks rate
+vs the batched event ceiling. PATH is a ``flagship_drive --json`` output
+(its ``hub_event_mix`` key) or a bare ``{kind: fraction}`` JSON object;
+without PATH the recorded drive mix below is used.
+
 Usage: python -m benchmarks.hub_bench [--clients 8] [--seconds 3]
+       python -m benchmarks.hub_bench --fleet-profile [drive.json]
 Prints one JSON line per op kind.
 """
 
@@ -27,6 +40,17 @@ import msgpack
 from dynamo_tpu.runtime.control_plane import (
     ControlPlaneServer, RemoteControlPlane,
 )
+
+#: hub event mix measured by the flagship drive (flagship_drive.py result
+#: key ``hub_event_mix``) — fractions of total hub ops by kind. Updated
+#: whenever the drive's traffic shape changes materially.
+DRIVE_EVENT_MIX = {
+    "request": 0.58,
+    "publish": 0.23,
+    "kv_put": 0.16,
+    "kv_delete": 0.02,
+    "stream_publish": 0.01,
+}
 
 
 async def _timed(clients, seconds: float, op) -> dict:
@@ -46,10 +70,96 @@ async def _timed(clients, seconds: float, op) -> dict:
             "ops_per_s": round(total / dt, 1)}
 
 
+def mix_schedule(mix: dict, length: int = 200) -> list:
+    """Deterministic weighted op cycle: largest-remainder apportionment of
+    ``length`` slots, interleaved most-frequent-first so no kind bursts."""
+    quota = {k: v * length for k, v in mix.items() if v > 0}
+    counts = {k: int(q) for k, q in quota.items()}
+    short = length - sum(counts.values())
+    for k in sorted(quota, key=lambda k: quota[k] - counts[k],
+                    reverse=True)[:short]:
+        counts[k] += 1
+    pools = {k: c for k, c in counts.items() if c}
+    sched = []
+    while any(pools.values()):
+        for k in sorted(pools, key=lambda k: pools[k], reverse=True):
+            if pools[k]:
+                pools[k] -= 1
+                sched.append(k)
+    return sched
+
+
+async def fleet_profile_bench(clients, seconds: float, mix: dict) -> dict:
+    """Replay the drive's event mix; report the mixed ceiling + the batched
+    KV-event ceiling, each with headroom vs the 70B plan's required rate."""
+    from benchmarks.plan_70b import placement
+
+    plan = placement()
+    payload = msgpack.packb({"tokens": list(range(64))})
+    sched = mix_schedule(mix)
+    per_kind = [dict.fromkeys(mix, 0) for _ in clients]
+
+    async def op(i, n, plane):
+        kind = sched[n % len(sched)]
+        per_kind[i][kind] += 1
+        if kind == "request":
+            await plane.request("bench.echo", payload, timeout=30.0)
+        elif kind == "kv_put":
+            await plane.kv_put(f"bench/{i}/{n % 512}", payload)
+        elif kind == "kv_delete":
+            await plane.kv_delete(f"bench/{i}/{n % 512}")
+        elif kind == "publish":
+            await plane.publish("bench.metrics", payload)
+        else:  # stream_publish
+            await plane.stream_publish("bench_events", payload)
+
+    mixed = await _timed(clients, seconds, op)
+    mixed["per_kind"] = {k: sum(c[k] for c in per_kind) for k in mix}
+
+    # batched KV-event leg: one stream_publish per REQUEST, carrying all
+    # of that request's stored blocks (the per-request batching that moved
+    # the event ceiling from per-block to per-request in PERF_NOTES) —
+    # blocks/s = events/s x plan blocks-per-request
+    fleet = plan["fleet"]
+    blocks_per_req = max(
+        1, round(fleet["stored_blocks_per_s"] / fleet["request_rate_per_s"]))
+    batch_payload = msgpack.packb(
+        {"stored_blocks": list(range(blocks_per_req))})
+
+    async def batched(i, n, plane):
+        await plane.stream_publish("bench_block_events", batch_payload)
+
+    ev = await _timed(clients, seconds, batched)
+    blocks_per_s = round(ev["ops_per_s"] * blocks_per_req, 1)
+
+    # required rates at the plan's operating point: every request costs
+    # 1/mix["request"] hub ops (the other kinds ride along in proportion)
+    req_share = mix.get("request") or 1.0
+    need_ops_s = fleet["request_rate_per_s"] / req_share
+    need_blocks_s = fleet["stored_blocks_per_s"]
+    return {
+        "mix": {k: round(v, 4) for k, v in mix.items()},
+        "mixed": mixed,
+        "batched_events": {**ev, "blocks_per_event": blocks_per_req,
+                           "blocks_per_s": blocks_per_s},
+        "fleet_need": {"hub_ops_per_s": round(need_ops_s, 1),
+                       "stored_blocks_per_s": need_blocks_s},
+        "headroom": {
+            "ops": round(mixed["ops_per_s"] / need_ops_s, 1),
+            "blocks": round(blocks_per_s / need_blocks_s, 1),
+        },
+    }
+
+
 async def amain():
     ap = argparse.ArgumentParser(description="dynctl hub ceiling bench")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--fleet-profile", nargs="?", const="", default=None,
+                    metavar="DRIVE_JSON",
+                    help="replay the flagship drive's hub event mix "
+                         "(optional path to a drive --json output; "
+                         "default: the recorded mix)")
     cli = ap.parse_args()
 
     server = ControlPlaneServer(port=0)
@@ -60,9 +170,22 @@ async def amain():
     # an echo service on the hub's request plane
     async def echo(payload: bytes) -> bytes:
         return payload
-
     await clients[0].serve("bench.echo", echo)
     payload = msgpack.packb({"tokens": list(range(64))})
+
+    if cli.fleet_profile is not None:
+        mix = dict(DRIVE_EVENT_MIX)
+        if cli.fleet_profile:
+            with open(cli.fleet_profile) as f:
+                doc = json.load(f)
+            mix = doc.get("hub_event_mix", doc)
+        out = await fleet_profile_bench(clients, cli.seconds, mix)
+        print(json.dumps({"metric": "hub_fleet_profile",
+                          "clients": cli.clients, **out}), flush=True)
+        for c in clients:
+            await c.close()
+        await server.stop()
+        return
 
     results = {}
 

@@ -82,6 +82,75 @@ def solve(cfg, tp: int, w_bytes: float, kv_b: float) -> dict:
     }
 
 
+#: the north-star topology on a v5e-64 slice (docs/PERF_NOTES.md "Hub
+#: ceiling vs the 70B fleet"): 2 prefill workers + 6 decode workers, TP=8
+#: each — 64 chips total. The combo is the solver's best-fitting config
+#: (int4-g32 weights + int8 KV: the only pair with real batch headroom).
+PLACEMENT_PREFILL_WORKERS = 2
+PLACEMENT_DECODE_WORKERS = 6
+PLACEMENT_TP = 8
+PLACEMENT_COMBO = "tp8_wint4_kvint8"
+
+#: measured hub ceilings the placement is checked against (PERF_NOTES):
+#: ~11.7k rpc/s for non-stream hub ops, 119.5k stored blocks/s on the
+#: per-request-batched event path, vs the fleet's ~53k blocks/s demand
+HUB_RPC_CEILING_PER_S = 11_700
+HUB_BLOCKS_CEILING_PER_S = 119_500
+HUB_BLOCKS_REQUIRED_PER_S = 53_000
+
+
+def placement(combo: str = PLACEMENT_COMBO) -> dict:
+    """The solved north-star placement as one machine-readable document.
+
+    This is what ``--emit-placement`` prints and what
+    ``benchmarks/flagship_drive.py`` instantiates as a mocker fleet —
+    the drive consumes the plan instead of re-deriving worker counts,
+    step timings, and batch bounds by hand."""
+    from dynamo_tpu.engine.config import ModelConfig
+
+    cfg = ModelConfig.llama3_70b()
+    w_bytes = {"bf16": 2.0, "int8": 1.0, "int4": 0.5}
+    kv_bytes = {"bf16": 2.0, "int8": 1.0}
+    # combo key grammar: tp{N}_w{dtype}_kv{dtype}
+    tp_s, w_s, kv_s = combo.split("_")
+    tp = int(tp_s[2:])
+    solved = solve(cfg, tp, w_bytes[w_s[1:]], kv_bytes[kv_s[2:]])
+    if not solved.get("fits"):
+        raise ValueError(f"placement combo {combo} does not fit on v5e")
+    # per-request stored-block math at the reference workload (PERF_NOTES):
+    # prefill mints ceil(ISL/16) blocks per request; decode one block per
+    # 16 generated tokens
+    block = 16
+    decode_tok_s = solved["tok_s_per_chip_roofline"] * tp \
+        * PLACEMENT_DECODE_WORKERS
+    req_s = decode_tok_s / OSL
+    stored_blocks_s = int(req_s * math.ceil(ISL / block)
+                          + decode_tok_s / block)
+    return {
+        "model": "llama3-70b",
+        "slice": "v5e-64",
+        "workload": {"isl": ISL, "osl": OSL},
+        "combo": combo,
+        "prefill": {"workers": PLACEMENT_PREFILL_WORKERS, "tp": tp,
+                    **solved},
+        "decode": {"workers": PLACEMENT_DECODE_WORKERS, "tp": tp,
+                   **solved},
+        "fleet": {
+            "workers": PLACEMENT_PREFILL_WORKERS + PLACEMENT_DECODE_WORKERS,
+            "chips": (PLACEMENT_PREFILL_WORKERS
+                      + PLACEMENT_DECODE_WORKERS) * tp,
+            "decode_tok_s": int(decode_tok_s),
+            "request_rate_per_s": round(req_s, 1),
+            "stored_blocks_per_s": stored_blocks_s,
+        },
+        "hub": {
+            "rpc_ceiling_per_s": HUB_RPC_CEILING_PER_S,
+            "blocks_ceiling_per_s": HUB_BLOCKS_CEILING_PER_S,
+            "blocks_required_per_s": HUB_BLOCKS_REQUIRED_PER_S,
+        },
+    }
+
+
 def compile_proof(tp: int = 8, layers: int = 2) -> dict:
     """AOT-compile the decode step at 70B layer shapes over a TP mesh."""
     flags = os.environ.get("XLA_FLAGS", "")
@@ -142,7 +211,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--compile", action="store_true",
                     help="also AOT-compile the sharded step (slow on 1 core)")
+    ap.add_argument("--emit-placement", action="store_true",
+                    help="print ONLY the solved north-star placement "
+                         "(2xTP8 prefill + 6xTP8 decode) as JSON and exit")
+    ap.add_argument("--combo", default=PLACEMENT_COMBO,
+                    help=f"placement combo key (default {PLACEMENT_COMBO})")
     cli = ap.parse_args()
+
+    if cli.emit_placement:
+        print(json.dumps(placement(cli.combo)), flush=True)
+        return
 
     from dynamo_tpu.engine.config import ModelConfig
     cfg = ModelConfig.llama3_70b()

@@ -173,8 +173,19 @@ class AutoscaleController:
                   else self.slo.cooldown_down_s)
         return now - last >= window
 
-    def _clamp(self, n: int) -> int:
-        return max(self.slo.min_replicas, min(self.slo.max_replicas, n))
+    def _clamp(self, n: int, role: str = "decode") -> int:
+        """Bound a target by the PLANNER's per-role limits — not the
+        SLO-wide min/max, which would override tighter per-role bounds
+        passed to make_planner (observed in the flagship drive: a pinned
+        2-replica prefill pool silently scaled to slo.min_replicas=4).
+        Duck-typed planners whose cfg lacks the per-role fields keep the
+        SLO-wide bounds."""
+        cfg = self.planner.cfg
+        lo = getattr(cfg, f"min_{role}_replicas", None)
+        hi = getattr(cfg, f"max_{role}_replicas", None)
+        if lo is None or hi is None:
+            lo, hi = self.slo.min_replicas, self.slo.max_replicas
+        return max(lo, min(hi, n))
 
     def _breaches(self, fused: FusedObservation) -> dict:
         """Per-class SLO breach check from the interval's TTFT p95s."""
@@ -274,7 +285,7 @@ class AutoscaleController:
                 p = max(p, self.applied.prefill_replicas)
                 d = max(d, self.applied.decode_replicas)
 
-        p, d = self._clamp(p), self._clamp(d)
+        p, d = self._clamp(p, "prefill"), self._clamp(d, "decode")
 
         # readiness gate: while the last scale-up is still materializing
         # (ready < applied), don't stack another one — the planner would

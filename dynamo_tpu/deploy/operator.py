@@ -154,8 +154,16 @@ class ProcessOperator:
         self.services: dict[str, ServiceSpec] = parse_spec(spec_path)
         self.replicas: dict[str, list[Replica]] = {s: [] for s in self.services}
         self.restarts: dict[str, int] = {s: 0 for s in self.services}
-        self._crash_streak: dict[str, int] = {s: 0 for s in self.services}
-        self._next_start: dict[str, float] = {s: 0.0 for s in self.services}
+        #: crash backoff is PER REPLICA SLOT (service, index), not per
+        #: service: independent chaos/hardware deaths spread across a pool
+        #: must not accumulate into one service-wide streak that freezes
+        #: ALL respawns (observed in the flagship drive: the decode pool
+        #: collapsed to 1 alive while desired was 4, every kill anywhere
+        #: bumping the shared streak). Only a slot that itself crash-loops
+        #: earns a growing delay — Kubernetes backs off per pod the same
+        #: way.
+        self._crash_streak: dict[tuple, int] = {}
+        self._next_start: dict[tuple, float] = {}
         #: victims mid-drain: no longer capacity, still alive processes
         self._draining: dict[str, list[Replica]] = {s: [] for s in self.services}
         self._spec_mtime = os.path.getmtime(spec_path)
@@ -223,8 +231,6 @@ class ProcessOperator:
         for name, svc in new.items():
             self.replicas.setdefault(name, [])
             self.restarts.setdefault(name, 0)
-            self._crash_streak.setdefault(name, 0)
-            self._next_start.setdefault(name, 0.0)
             self._draining.setdefault(name, [])
         self.services = new
         logger.info("spec reloaded: %s",
@@ -330,12 +336,13 @@ class ProcessOperator:
                 logger.warning("%s[%d] exited rc=%s", svc.name, r.index,
                                r.proc.returncode)
                 self.restarts[svc.name] += 1
-                streak = self._crash_streak[svc.name]
+                slot = (svc.name, r.index)
+                streak = self._crash_streak.get(slot, 0)
                 if time.monotonic() - r.started > 60:
                     streak = 0  # ran long enough: reset the backoff
-                self._crash_streak[svc.name] = streak + 1
+                self._crash_streak[slot] = streak + 1
                 delay = _BACKOFF[min(streak, len(_BACKOFF) - 1)]
-                self._next_start[svc.name] = time.monotonic() + delay
+                self._next_start[slot] = time.monotonic() + delay
         reps[:] = alive
         # scale down: fewest in-flight streams first (disturb the least
         # work), newest-first on ties (the historical order; leases expire
@@ -346,11 +353,18 @@ class ProcessOperator:
             for r in victims[: len(reps) - want]:
                 reps.remove(r)
                 self._begin_drain(svc.name, r, "scale down")
-        # scale up (respecting crash backoff)
-        while len(reps) < want and time.monotonic() >= self._next_start[svc.name]:
-            used = {r.index for r in reps}
-            index = next(i for i in range(want) if i not in used)
+        # scale up (respecting each SLOT's crash backoff: a crash-looping
+        # slot waits out its delay while the rest of the pool refills)
+        used = {r.index for r in reps}
+        now = time.monotonic()
+        for index in range(want):
+            if len(reps) >= want:
+                break
+            if index in used or now < self._next_start.get(
+                    (svc.name, index), 0.0):
+                continue
             reps.append(self._spawn(svc, index))
+            used.add(index)
 
     # -- readiness ---------------------------------------------------------
 

@@ -270,6 +270,27 @@ class HttpService:
         self._tombstone_cb_set = False
         self._heals_cb_set = False
         self._cycles_cb_set = False
+        # fleet scorecard (docs/observability.md "Fleet scorecard"): joins
+        # the instruments above into one falsifiable rollup at
+        # /v1/fleet/scorecard, and keeps the hub-saturation window behind
+        # dynamo_hub_saturation_ratio{kind} (live headroom vs the measured
+        # ceilings in docs/PERF_NOTES.md "Hub ceiling")
+        from dynamo_tpu.llm.pipeline import migration_stats
+        from dynamo_tpu.observability.scorecard import ScorecardKeeper
+
+        self.scorecard = ScorecardKeeper(
+            self, namespace=os.environ.get("DYN_NAMESPACE", "dynamo"))
+        self._hub_saturation = self.metrics.gauge(
+            "hub_saturation_ratio",
+            "live hub op rate over measured ceiling by kind (rpc = "
+            "non-stream hub ops/s vs DYN_HUB_CEILING_RPC; blocks = stored "
+            "KV blocks/s applied by the radix indexes vs "
+            "DYN_HUB_CEILING_BLOCKS)")
+        self.metrics.counter(
+            "stream_migrations_total",
+            "stream migration outcomes (resend / completed / exhausted)"
+        ).add_callback(lambda: {
+            (("outcome", k),): v for k, v in migration_stats().items()})
 
     @property
     def tracer(self):
@@ -424,6 +445,8 @@ class HttpService:
             self._attr_fed_set.discard(self._attr_fed[0])
         self._attr_fed.append(rid)
         self._attr_fed_set.add(rid)
+        # scorecard reconciliation: bucket sums vs measured e2e, per doc
+        self.scorecard.note_attribution(doc)
         qos = doc.get("qos") or "standard"
         for phase, ms in (doc.get("ttft") or {}).items():
             self._ttft_breakdown.observe(ms / 1000.0, phase=phase, qos=qos)
@@ -583,6 +606,10 @@ class HttpService:
         # fleet flight-recorder fan-out (docs/observability.md "Flight
         # recorder"): per-worker step timelines + anomaly summaries
         app.router.add_get("/v1/fleet/steps", self.handle_fleet_steps)
+        # fleet scorecard (docs/observability.md "Fleet scorecard"): the
+        # joined per-class SLO / attribution / migration / audit /
+        # autoscale / hub rollup with its falsifiability checks
+        app.router.add_get("/v1/fleet/scorecard", self.handle_scorecard)
         # per-request latency attribution (docs/observability.md
         # "Attribution"): spans ⊕ flight records → named-cause breakdown
         app.router.add_get("/v1/attribution/{request_id}",
@@ -724,11 +751,34 @@ class HttpService:
     async def handle_metrics(self, request: web.Request) -> web.Response:
         self._refresh_router_metrics()
         self._refresh_slo_gauges()
+        await self._refresh_hub_saturation()
         # merged exposition: HTTP registry + the tracer's SLO registry
         # (dynamo_ttft_seconds / dynamo_itl_seconds / dynamo_e2e_seconds /
         # dynamo_phase_seconds{phase=...}) with duplicate headers dropped
         text = render_registries(self.metrics, self.tracer.metrics)
         return web.Response(text=text, content_type="text/plain")
+
+    async def _refresh_hub_saturation(self) -> None:
+        """Fold one hub_stats + radix-blocks sample into the saturation
+        window and re-export dynamo_hub_saturation_ratio{kind} — at scrape
+        time, so the gauge's freshness tracks the scrape interval and the
+        hot path pays nothing."""
+        hub = None
+        plane = self.runtime.plane if self.runtime is not None else None
+        if plane is not None and hasattr(plane, "hub_stats"):
+            try:
+                hub = await plane.hub_stats()
+            except Exception:
+                hub = None
+        self.scorecard.sample_hub(hub)
+        for kind, ratio in self.scorecard.saturation.ratios().items():
+            if ratio is not None:
+                self._hub_saturation.set(ratio, kind=kind)
+
+    async def handle_scorecard(self, request: web.Request) -> web.Response:
+        """GET /v1/fleet/scorecard — the joined falsifiable fleet rollup
+        (observability/scorecard.py; rendered by ``dynctl fleet``)."""
+        return web.json_response(await self.scorecard.document())
 
     async def handle_trace(self, request: web.Request) -> web.Response:
         """GET /v1/traces/{request_id} — the stitched request trace.

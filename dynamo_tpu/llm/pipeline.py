@@ -39,6 +39,7 @@ from dynamo_tpu.runtime.context import (
     DeadlineExceededError,
     StreamError,
 )
+from dynamo_tpu.runtime.control_plane import NoRespondersError
 
 logger = logging.getLogger("dynamo.pipeline")
 
@@ -507,6 +508,23 @@ class Backend:
 # Migration (stream-level fault tolerance)
 # ---------------------------------------------------------------------------
 
+#: process-wide migration outcome totals, exported by the frontend as
+#: ``dynamo_stream_migrations_total{outcome}`` and joined into the fleet
+#: scorecard (observability/scorecard.py) — this is how a drive's
+#: kill→migrate→zero-loss path becomes visible without parsing logs.
+#: outcomes: resend (each re-issued leg), completed (stream finished after
+#: ≥1 migration), exhausted (retryable break with no budget left)
+_MIGRATION_STATS: dict[str, int] = {}
+
+
+def _note_migration(outcome: str) -> None:
+    _MIGRATION_STATS[outcome] = _MIGRATION_STATS.get(outcome, 0) + 1
+
+
+def migration_stats() -> dict[str, int]:
+    """Snapshot of the process-wide migration outcome counters."""
+    return dict(_MIGRATION_STATS)
+
 
 class Migration:
     """Replays a broken stream on a new worker with accumulated tokens.
@@ -566,9 +584,20 @@ class Migration:
                     if out.flight is not None:
                         last_flight = out.flight
                     accumulated.extend(out.token_ids)
-                    yield out
                     if out.finish_reason is not None:
+                        # account BEFORE the final yield: downstream
+                        # operators return as soon as they see the finish
+                        # frame (detokenizer jail-break, aggregators),
+                        # which closes this generator at the yield — code
+                        # after it never runs and `completed` flatlines at
+                        # zero no matter how many migrations succeeded
+                        if attempt:
+                            _note_migration("completed")
+                        yield out
                         return
+                    yield out
+                if attempt:
+                    _note_migration("completed")
                 return
             except DeadlineExceededError:
                 if accumulated:
@@ -577,8 +606,18 @@ class Migration:
                     yield LLMEngineOutput(finish_reason=FinishReason.DEADLINE)
                     return
                 raise
-            except StreamError as e:
-                if not e.retryable or budget <= 0 or ctx.cancelled:
+            except (StreamError, NoRespondersError) as e:
+                # NoRespondersError = fleet blackout (every instance dead at
+                # once, e.g. correlated kills): transient under operator
+                # supervision, so it burns the migration budget like a
+                # retryable transport loss — the backoff window is exactly
+                # the operator's restart window. On exhaustion it re-raises
+                # and keeps its type (frontend maps it to 503).
+                retryable = (e.retryable if isinstance(e, StreamError)
+                             else True)
+                if not retryable or budget <= 0 or ctx.cancelled:
+                    if retryable and budget <= 0 and not ctx.cancelled:
+                        _note_migration("exhausted")
                     raise
                 if ctx.expired:
                     if accumulated:
@@ -589,6 +628,7 @@ class Migration:
                         "deadline expired while migrating") from e
                 budget -= 1
                 attempt += 1
+                _note_migration("resend")
                 remaining = None
                 if req.stop_conditions.max_tokens is not None:
                     # against the ORIGINAL budget: current's max_tokens was

@@ -116,6 +116,18 @@ async def amain():
     ap.add_argument("--max-num-seqs", type=int, default=256)
     ap.add_argument("--max-num-batched-tokens", type=int, default=8192)
     ap.add_argument("--speedup-ratio", type=float, default=1.0)
+    # step-timing model knobs: let a drive instantiate PLAN-derived
+    # per-step costs (benchmarks/plan_70b.py --emit-placement → solved
+    # step_ms) instead of the generic tiny-model defaults
+    ap.add_argument("--prefill-base-ms", type=float, default=None,
+                    help="fixed prefill step cost (MockEngineArgs default "
+                         "5.0)")
+    ap.add_argument("--prefill-per-token-ms", type=float, default=None,
+                    help="per-prefill-token step cost (default 0.02)")
+    ap.add_argument("--decode-base-ms", type=float, default=None,
+                    help="fixed decode step cost (default 2.0)")
+    ap.add_argument("--decode-per-seq-ms", type=float, default=None,
+                    help="per-running-sequence decode cost (default 0.05)")
     ap.add_argument("--dp-size", type=int, default=1,
                     help="simulated DP ranks (one scheduler + KV event "
                          "stream + metrics stream per rank)")
@@ -160,6 +172,13 @@ async def amain():
         startup_time=cli.startup_time,
         token_budget_plan=cli.token_budget_plan,
     )
+    for flag, field in (("prefill_base_ms", "prefill_base_ms"),
+                        ("prefill_per_token_ms", "prefill_per_token_ms"),
+                        ("decode_base_ms", "decode_base_ms"),
+                        ("decode_per_seq_ms", "decode_per_seq_ms")):
+        v = getattr(cli, flag)
+        if v is not None:
+            setattr(args, field, v)
     topo = {k: v for k, v in (("host", cli.topo_host),
                               ("slice", cli.topo_slice),
                               ("pod", cli.topo_pod)) if v}

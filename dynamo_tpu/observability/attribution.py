@@ -530,14 +530,24 @@ class SloBurnTracker:
         self._now = now_fn
         #: class → deque[(t, breached)]
         self._events: dict[str, collections.deque] = {}
+        #: class → {"count", "breached"} cumulative since construction —
+        #: the scorecard's independent path for its falsifiability
+        #: cross-check against the frontend's own TTFT histogram
+        #: (observability/scorecard.py)
+        self.totals: dict[str, dict[str, int]] = {}
 
     def note(self, cls: str, ttft_s: float) -> None:
         target_ms = self.slo.slo_for(cls).ttft_p95_ms
         if target_ms is None:
             return  # no target (e.g. batch): nothing to burn
+        breached = ttft_s * 1000.0 > target_ms
+        tot = self.totals.setdefault(cls, {"count": 0, "breached": 0})
+        tot["count"] += 1
+        if breached:
+            tot["breached"] += 1
         dq = self._events.setdefault(
             cls, collections.deque(maxlen=4096))
-        dq.append((self._now(), ttft_s * 1000.0 > target_ms))
+        dq.append((self._now(), breached))
 
     def _trim(self, dq) -> None:
         horizon = self._now() - self.window_s

@@ -1,0 +1,649 @@
+"""Fleet scorecard: one falsifiable rollup of every observability plane.
+
+ROADMAP item 2 closes with the whole stack running *together*
+(``benchmarks/flagship_drive.py``); this module is the surface that makes
+such a run legible. It JOINS the existing instruments — per-class SLO burn
+(attribution.SloBurnTracker) against the frontend's own per-class TTFT
+histogram, attribution bucket reconciliation, stream-migration outcomes,
+KV-audit divergence/heals, autoscale+operator decisions, and hub op rates
+— into one document served at ``GET /v1/fleet/scorecard`` and rendered by
+``dynctl fleet``. No new collection plane: every number here is read from
+an instrument that already exists, which is exactly what makes the
+cross-checks falsifiable (two independent paths must agree, or the
+scorecard says so).
+
+Falsifiability contract (the ``checks`` list):
+
+- ``slo_count[cls]``    — the burn tracker's per-class observation count
+  must equal the ``dynamo_http_ttft_class_seconds{qos}`` histogram count.
+  Both are fed from the same first-token callback but through different
+  code paths and data structures; a drift means a path lost samples.
+- ``slo_breaches[cls]`` — the tracker's cumulative breach count must fall
+  inside the bracket the histogram's buckets imply for the class target
+  (observations above the nearest bucket edge ≥ target bound it from
+  below; above the nearest edge ≤ target from above). Exact math, no
+  tunable tolerance.
+- ``attr_reconcile``    — every attribution document fed through the
+  frontend must have bucket sums (including the explicit unattributed
+  residual) equal to its measured e2e within 2% / 5 ms.
+
+Hub headroom (``dynamo_hub_saturation_ratio{kind}``): live rates from
+``plane.hub_stats()`` + the radix consumers' stored-block counters,
+divided by the measured ceilings (docs/PERF_NOTES.md "Hub ceiling vs the
+70B fleet") — approach toward hub saturation becomes a dashboard series
+instead of a bench re-run:
+
+- kind="rpc":    non-stream hub ops/s vs ``DYN_HUB_CEILING_RPC``
+  (default 11700, the measured total-hub rpc ceiling);
+- kind="blocks": stored KV blocks/s applied by the event-fed radix
+  indexes vs ``DYN_HUB_CEILING_BLOCKS`` (default 119500, the measured
+  per-request-batched event-path ceiling; the 70B fleet demands ~53k).
+
+Phases: ``ScorecardKeeper.mark_phase(name)`` closes the open window and
+cards it (per-phase deltas + per-phase checks) — the flagship drive marks
+its diurnal phases so each one carries its own falsifiable rollup.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+#: measured ceilings (docs/PERF_NOTES.md) — env-overridable so a re-bench
+#: on different hardware feeds the gauge without a code change
+DEFAULT_RPC_CEILING = 11_700.0
+DEFAULT_BLOCKS_CEILING = 119_500.0
+#: what the 70B north-star fleet demands of the stored-block path
+BLOCKS_REQUIRED_70B = 53_000.0
+
+#: attribution reconciliation tolerance: bucket sums vs measured e2e
+_ATTR_REL_TOL = 0.02
+_ATTR_ABS_TOL_MS = 5.0
+
+
+def _env_ceiling(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def hub_rpc_total(events: Optional[dict]) -> int:
+    """Non-stream hub ops from a ``hub_stats()['events']`` dict — the
+    numerator governed by the measured ~11.7k rpc/s ceiling (stream
+    appends scale separately; PERF_NOTES)."""
+    if not events:
+        return 0
+    return sum(int(v) for k, v in events.items() if k != "stream_publish")
+
+
+class HubSaturationTracker:
+    """Rolling hub op rates from successive cumulative samples, divided by
+    the measured ceilings.
+
+    Feed it ``sample(hub_stats, blocks_stored)`` with cumulative totals
+    (hub op counts from ``plane.hub_stats()``; stored blocks applied by
+    the radix indexes); ``rates()``/``ratios()`` answer over the retained
+    window. Counter regressions (hub restart → epoch change) reset the
+    window instead of producing a negative rate."""
+
+    def __init__(self, rpc_ceiling: Optional[float] = None,
+                 blocks_ceiling: Optional[float] = None,
+                 window_s: float = 60.0, now_fn=time.monotonic):
+        self.rpc_ceiling = rpc_ceiling if rpc_ceiling is not None else \
+            _env_ceiling("DYN_HUB_CEILING_RPC", DEFAULT_RPC_CEILING)
+        self.blocks_ceiling = blocks_ceiling if blocks_ceiling is not None \
+            else _env_ceiling("DYN_HUB_CEILING_BLOCKS",
+                              DEFAULT_BLOCKS_CEILING)
+        self.window_s = window_s
+        self._now = now_fn
+        self._samples: list[tuple[float, int, int]] = []  # (t, rpc, blocks)
+
+    def sample(self, hub_stats: Optional[dict],
+               blocks_stored: int = 0) -> None:
+        rpc = hub_rpc_total((hub_stats or {}).get("events"))
+        t = self._now()
+        if self._samples:
+            _, last_rpc, last_blocks = self._samples[-1]
+            if rpc < last_rpc or blocks_stored < last_blocks:
+                # hub restarted (new epoch) or consumers were rebuilt:
+                # the cumulative totals regressed — restart the window
+                self._samples = []
+        self._samples.append((t, rpc, int(blocks_stored)))
+        horizon = t - self.window_s
+        while len(self._samples) > 2 and self._samples[1][0] <= horizon:
+            self._samples.pop(0)
+
+    def rates(self) -> dict:
+        """ops/s over the retained window (None until 2 samples span
+        a nonzero interval)."""
+        if len(self._samples) < 2:
+            return {"rpc": None, "blocks": None}
+        t0, rpc0, blk0 = self._samples[0]
+        t1, rpc1, blk1 = self._samples[-1]
+        dt = t1 - t0
+        if dt <= 0:
+            return {"rpc": None, "blocks": None}
+        return {"rpc": round((rpc1 - rpc0) / dt, 1),
+                "blocks": round((blk1 - blk0) / dt, 1)}
+
+    def ratios(self) -> dict:
+        """rate / measured ceiling per kind (the gauge values)."""
+        r = self.rates()
+        out = {}
+        for kind, ceiling in (("rpc", self.rpc_ceiling),
+                              ("blocks", self.blocks_ceiling)):
+            rate = r.get(kind)
+            out[kind] = (round(rate / ceiling, 4)
+                         if rate is not None and ceiling > 0 else None)
+        return out
+
+
+# ------------------------------------------------------------- histogram IO
+
+
+def class_hist_stats(hist, targets: dict) -> dict:
+    """Per-class stats straight off a ``qos``-labeled Histogram's internal
+    per-bucket counts: count, mean, bucket-derived p95, and the breach
+    BRACKET for the class target (counts above the nearest bucket edges
+    bounding the target). The bracket is what makes the SLO cross-check
+    exact instead of tolerance-tuned: the true breach count provably lies
+    within it."""
+    out: dict = {}
+    with hist._lock:
+        counts = {k: list(v) for k, v in hist._counts.items()}
+        sums = dict(hist._sums)
+    for key, per_bucket in counts.items():
+        labels = dict(key)
+        cls = labels.get("qos", "standard")
+        total = per_bucket[-1]
+        if total == 0:
+            continue
+        entry = {"count": total,
+                 "sum_s": round(sums.get(key, 0.0), 6)}
+        # bucket-derived p95 (upper edge of the bucket holding the 95th
+        # percentile observation — same estimator autoscale/observe uses)
+        rank = 0.95 * total
+        cum = 0
+        p95 = None
+        for i, edge in enumerate(hist.buckets):
+            cum += per_bucket[i]
+            if cum >= rank:
+                p95 = edge
+                break
+        entry["p95_s_le"] = p95  # None = in the +Inf bucket
+        target_ms = targets.get(cls)
+        if target_ms is not None:
+            target_s = target_ms / 1000.0
+            # observations provably above target: above the smallest edge
+            # >= target (lower bound) / above the largest edge <= target
+            # (upper bound)
+            cum = 0
+            above_hi = total   # above largest edge <= target
+            above_lo = total   # above smallest edge >= target
+            for i, edge in enumerate(hist.buckets):
+                cum += per_bucket[i]
+                if edge <= target_s:
+                    above_hi = total - cum
+                if edge >= target_s:
+                    above_lo = total - cum
+                    break
+            entry["target_ms"] = target_ms
+            entry["breach_bracket"] = [above_lo, above_hi]
+        out[cls] = entry
+    return out
+
+
+# --------------------------------------------------------------- the keeper
+
+
+class ScorecardKeeper:
+    """Holds the rollup state for one frontend process.
+
+    Constructed by ``HttpService``; the drive (in-process) calls
+    ``mark_phase``; the HTTP route calls ``document``. Every read is
+    against live instruments — the keeper itself stores only attribution
+    reconciliation tallies, phase boundaries, and the saturation window.
+    """
+
+    def __init__(self, service, namespace: str = "dynamo"):
+        self.service = service
+        self.namespace = namespace
+        self.saturation = HubSaturationTracker()
+        #: attribution falsifiability tallies (docs fed via the frontend)
+        self.attr_docs = 0
+        self.attr_reconciled = 0
+        self.attr_residual_ms = 0.0
+        self.attr_failures: list[dict] = []  # first few, for the operator
+        self.phases: list[dict] = []
+        self._open_phase: Optional[str] = None
+        self._open_snap: Optional[dict] = None
+
+    # -- feeds ------------------------------------------------------------
+
+    def note_attribution(self, doc: dict) -> None:
+        """Reconcile one attribution document: bucket sums (incl. the
+        explicit residual) must equal measured e2e within tolerance."""
+        e2e_ms = doc.get("e2e_ms")
+        total = doc.get("total") or {}
+        if e2e_ms is None or not total:
+            return
+        self.attr_docs += 1
+        bucket_ms = sum(total.values())
+        gap = abs(bucket_ms - e2e_ms)
+        self.attr_residual_ms += doc.get("residual_ms", 0.0)
+        if gap <= max(_ATTR_ABS_TOL_MS, _ATTR_REL_TOL * e2e_ms):
+            self.attr_reconciled += 1
+        elif len(self.attr_failures) < 8:
+            self.attr_failures.append(
+                {"request_id": doc.get("request_id"),
+                 "e2e_ms": round(e2e_ms, 3),
+                 "bucket_sum_ms": round(bucket_ms, 3)})
+
+    def sample_hub(self, hub_stats: Optional[dict]) -> None:
+        """Fold one ``hub_stats()`` snapshot + the radix consumers' block
+        counters into the saturation window (called from the frontend at
+        scrape/collect time)."""
+        self.saturation.sample(hub_stats, self._blocks_stored())
+
+    # -- cumulative sources ------------------------------------------------
+
+    def _blocks_stored(self) -> int:
+        total = 0
+        for sm in self.service.manager.models.values():
+            idx = getattr(sm.router, "indexer", None) if sm.router else None
+            tree = getattr(idx, "tree", None)
+            if tree is not None:
+                total += getattr(tree, "blocks_stored", 0)
+        return total
+
+    def slo_rollup(self) -> dict:
+        """Per-class: the burn tracker's independent totals joined with
+        the frontend histogram's stats for the same class."""
+        svc = self.service
+        targets = {cls: slo.ttft_p95_ms
+                   for cls, slo in svc.slo.class_slos.items()}
+        hist = class_hist_stats(svc._ttft_class, targets)
+        tracker = {cls: dict(t) for cls, t in svc._burn.totals.items()}
+        burn = svc._burn.rates()
+        out: dict = {}
+        for cls in sorted(set(hist) | set(tracker)):
+            h = hist.get(cls) or {}
+            t = tracker.get(cls) or {}
+            out[cls] = {
+                "requests_hist": h.get("count", 0),
+                "requests_tracker": t.get("count", 0),
+                "breaches_tracker": t.get("breached", 0),
+                "breach_bracket_hist": h.get("breach_bracket"),
+                "target_ms": h.get("target_ms", targets.get(cls)),
+                "p95_s_le": h.get("p95_s_le"),
+                "sum_s": h.get("sum_s", 0.0),
+                "burn": burn.get(cls),
+            }
+        return out
+
+    def audit_rollup(self) -> dict:
+        models = {}
+        for name, sm in self.service.manager.models.items():
+            auditor = getattr(sm.router, "auditor", None) if sm.router \
+                else None
+            if auditor is None:
+                continue
+            div = {"phantom": 0, "missing": 0, "dangling": 0}
+            for (_w, kind), n in auditor.divergence_blocks().items():
+                div[kind] = div.get(kind, 0) + n
+            models[name] = {
+                "cycles": auditor.cycles,
+                "heals_total": dict(auditor.heals_total),
+                "divergence_blocks": div,
+                "stale_adverts": sum(auditor.stale_adverts.values()),
+                "workers": len(auditor.worker_state),
+            }
+        return models
+
+    def migration_rollup(self) -> dict:
+        from dynamo_tpu.llm.pipeline import migration_stats
+
+        return migration_stats()
+
+    def breakdown_rollup(self) -> dict:
+        """Phase-bucket seconds from the fleet breakdown histograms
+        (fed by sampled attributions — docs/observability.md
+        "Attribution")."""
+        out = {}
+        for name, hist in (("ttft", self.service._ttft_breakdown),
+                           ("itl", self.service._itl_breakdown)):
+            with hist._lock:
+                sums = dict(hist._sums)
+            phases: dict = {}
+            for key, s in sums.items():
+                phase = dict(key).get("phase", "?")
+                phases[phase] = round(phases.get(phase, 0.0) + s, 6)
+            out[name] = dict(sorted(phases.items()))
+        return out
+
+    async def snapshot(self) -> dict:
+        """One cumulative snapshot of every joined instrument."""
+        import json as _json
+
+        svc = self.service
+        plane = svc.runtime.plane if svc.runtime is not None else None
+        hub = autoscale = operator = None
+        if plane is not None:
+            try:
+                if hasattr(plane, "hub_stats"):
+                    hub = await plane.hub_stats()
+            except Exception:
+                hub = None
+            from dynamo_tpu.autoscale.controller import (
+                AUTOSCALE_STATUS_KEY, OPERATOR_STATUS_KEY,
+            )
+            for key, attr in ((AUTOSCALE_STATUS_KEY, "autoscale"),
+                              (OPERATOR_STATUS_KEY, "operator")):
+                try:
+                    raw = await plane.kv_get(
+                        key.format(namespace=self.namespace))
+                    if raw:
+                        doc = _json.loads(raw)
+                        if attr == "autoscale":
+                            autoscale = doc
+                        else:
+                            operator = doc
+                except Exception:
+                    pass
+        self.sample_hub(hub)
+        hub_events = (hub or {}).get("events") or {}
+        pub = (hub or {}).get("publish_seconds") or {}
+        snap = {
+            "ts": time.time(),
+            "slo": self.slo_rollup(),
+            "attribution": {
+                "docs": self.attr_docs,
+                "reconciled": self.attr_reconciled,
+                "residual_ms_total": round(self.attr_residual_ms, 3),
+                "failures": list(self.attr_failures),
+                "breakdown_s": self.breakdown_rollup(),
+            },
+            "migrations": self.migration_rollup(),
+            "audit": self.audit_rollup(),
+            "autoscale": _autoscale_slim(autoscale),
+            "operator": _operator_slim(operator),
+            "hub": {
+                "events": dict(hub_events),
+                "rpc_total": hub_rpc_total(hub_events),
+                "blocks_stored": self._blocks_stored(),
+                "publish_count": pub.get("count", 0),
+                "publish_mean_us": (
+                    round(pub["sum"] / pub["count"] * 1e6, 1)
+                    if pub.get("count") else None),
+                "rates": self.saturation.rates(),
+                "saturation": self.saturation.ratios(),
+                "ceilings": {"rpc": self.saturation.rpc_ceiling,
+                             "blocks": self.saturation.blocks_ceiling,
+                             "blocks_required_70b": BLOCKS_REQUIRED_70B},
+            },
+        }
+        return snap
+
+    # -- phases ------------------------------------------------------------
+
+    async def mark_phase(self, name: Optional[str]) -> Optional[dict]:
+        """Close the open phase (if any) into a per-phase card and open a
+        new one named ``name`` (None = just close). Returns the closed
+        card."""
+        snap = await self.snapshot()
+        card = None
+        if self._open_phase is not None and self._open_snap is not None:
+            card = phase_card(self._open_phase, self._open_snap, snap)
+            self.phases.append(card)
+        self._open_phase = name
+        self._open_snap = snap if name is not None else None
+        return card
+
+    async def document(self) -> dict:
+        snap = await self.snapshot()
+        doc = {
+            "generated": snap["ts"],
+            "now": snap,
+            "checks": run_checks(snap),
+            "phases": list(self.phases),
+        }
+        if self._open_phase is not None and self._open_snap is not None:
+            doc["open_phase"] = phase_card(self._open_phase,
+                                           self._open_snap, snap)
+        doc["ok"] = all(c["ok"] for c in doc["checks"]) and all(
+            all(c["ok"] for c in p["checks"]) for p in doc["phases"])
+        return doc
+
+
+def _autoscale_slim(doc: Optional[dict]) -> Optional[dict]:
+    if not doc:
+        return None
+    return {"desired": doc.get("desired"), "ready": doc.get("ready"),
+            "lastDecision": doc.get("lastDecision"),
+            "counters": doc.get("counters"),
+            "sloBurn": doc.get("sloBurn")}
+
+
+def _operator_slim(doc: Optional[dict]) -> Optional[dict]:
+    if not doc:
+        return None
+    services = {}
+    for name, svc in (doc.get("services") or {}).items():
+        services[name] = {k: svc.get(k) for k in
+                          ("desired", "alive", "ready", "draining",
+                           "restarts", "plannerRole")}
+    return {"services": services,
+            "drainsCompleted": doc.get("drainsCompleted"),
+            "drainsKilled": doc.get("drainsKilled")}
+
+
+# ----------------------------------------------------------------- checks
+
+
+def run_checks(snap: dict) -> list[dict]:
+    """The falsifiability list for one cumulative snapshot."""
+    checks: list[dict] = []
+    for cls, s in (snap.get("slo") or {}).items():
+        if s.get("target_ms") is None:
+            continue  # class carries no SLO (batch): nothing to cross-check
+        checks.append({
+            "name": f"slo_count[{cls}]",
+            "ok": s["requests_hist"] == s["requests_tracker"],
+            "detail": (f"hist {s['requests_hist']} vs tracker "
+                       f"{s['requests_tracker']}"),
+        })
+        bracket = s.get("breach_bracket_hist")
+        if bracket is not None:
+            lo, hi = bracket
+            checks.append({
+                "name": f"slo_breaches[{cls}]",
+                "ok": lo <= s["breaches_tracker"] <= hi,
+                "detail": (f"tracker {s['breaches_tracker']} in "
+                           f"[{lo}, {hi}]"),
+            })
+    attr = snap.get("attribution") or {}
+    if attr.get("docs"):
+        checks.append({
+            "name": "attr_reconcile",
+            "ok": attr["reconciled"] == attr["docs"],
+            "detail": (f"{attr['reconciled']}/{attr['docs']} bucket sums "
+                       f"match measured e2e"),
+        })
+    return checks
+
+
+def phase_card(name: str, start: dict, end: dict) -> dict:
+    """Per-phase deltas between two cumulative snapshots, with the same
+    falsifiability checks run on the deltas."""
+    window = max(end["ts"] - start["ts"], 1e-9)
+    slo = {}
+    for cls in set(end.get("slo") or {}) | set(start.get("slo") or {}):
+        e = (end.get("slo") or {}).get(cls) or {}
+        s = (start.get("slo") or {}).get(cls) or {}
+        d = {
+            "requests_hist": e.get("requests_hist", 0)
+            - s.get("requests_hist", 0),
+            "requests_tracker": e.get("requests_tracker", 0)
+            - s.get("requests_tracker", 0),
+            "breaches_tracker": e.get("breaches_tracker", 0)
+            - s.get("breaches_tracker", 0),
+            "target_ms": e.get("target_ms", s.get("target_ms")),
+            "burn": e.get("burn"),
+        }
+        eb, sb = e.get("breach_bracket_hist"), s.get("breach_bracket_hist")
+        if eb is not None:
+            d["breach_bracket_hist"] = [eb[0] - (sb[0] if sb else 0),
+                                        eb[1] - (sb[1] if sb else 0)]
+        if d["requests_hist"] or d["requests_tracker"]:
+            slo[cls] = d
+    he, hs = end.get("hub") or {}, start.get("hub") or {}
+    d_rpc = he.get("rpc_total", 0) - hs.get("rpc_total", 0)
+    d_blocks = he.get("blocks_stored", 0) - hs.get("blocks_stored", 0)
+    ceilings = he.get("ceilings") or {}
+    hub = {
+        "rpc_per_s": round(d_rpc / window, 1),
+        "blocks_per_s": round(d_blocks / window, 1),
+        "saturation": {
+            "rpc": (round(d_rpc / window / ceilings["rpc"], 4)
+                    if ceilings.get("rpc") else None),
+            "blocks": (round(d_blocks / window / ceilings["blocks"], 4)
+                       if ceilings.get("blocks") else None),
+        },
+        "events": {k: he.get("events", {}).get(k, 0)
+                   - hs.get("events", {}).get(k, 0)
+                   for k in set(he.get("events") or {})
+                   | set(hs.get("events") or {})},
+    }
+    ae, as_ = end.get("attribution") or {}, start.get("attribution") or {}
+    me, ms = end.get("migrations") or {}, start.get("migrations") or {}
+    card = {
+        "phase": name,
+        "window_s": round(window, 3),
+        "slo": slo,
+        "attribution": {
+            "docs": ae.get("docs", 0) - as_.get("docs", 0),
+            "reconciled": ae.get("reconciled", 0)
+            - as_.get("reconciled", 0),
+        },
+        "migrations": {k: me.get(k, 0) - ms.get(k, 0)
+                       for k in set(me) | set(ms)},
+        "hub": hub,
+        "audit_end": end.get("audit"),
+        "autoscale_end": end.get("autoscale"),
+    }
+    card["checks"] = _phase_checks(card)
+    return card
+
+
+def _phase_checks(card: dict) -> list[dict]:
+    checks = []
+    for cls, s in (card.get("slo") or {}).items():
+        if s.get("target_ms") is None:
+            continue
+        checks.append({
+            "name": f"slo_count[{cls}]",
+            "ok": s["requests_hist"] == s["requests_tracker"],
+            "detail": (f"hist {s['requests_hist']} vs tracker "
+                       f"{s['requests_tracker']}"),
+        })
+        bracket = s.get("breach_bracket_hist")
+        if bracket is not None:
+            lo, hi = bracket
+            checks.append({
+                "name": f"slo_breaches[{cls}]",
+                "ok": lo <= s["breaches_tracker"] <= hi,
+                "detail": (f"tracker {s['breaches_tracker']} in "
+                           f"[{lo}, {hi}]"),
+            })
+    attr = card.get("attribution") or {}
+    if attr.get("docs"):
+        checks.append({
+            "name": "attr_reconcile",
+            "ok": attr["reconciled"] == attr["docs"],
+            "detail": f"{attr['reconciled']}/{attr['docs']} reconciled",
+        })
+    return checks
+
+
+# --------------------------------------------------------------- rendering
+
+
+def render_scorecard(doc: dict) -> str:
+    """The ``dynctl fleet`` text view of one scorecard document."""
+    lines: list[str] = []
+    now = doc.get("now") or {}
+    ok = doc.get("ok")
+    lines.append(f"fleet scorecard  [{'OK' if ok else 'CHECK FAILURES'}]")
+    slo = now.get("slo") or {}
+    if slo:
+        lines.append(f"{'class':<14s}{'reqs':>7s}{'breach':>8s}"
+                     f"{'target':>9s}{'burn':>7s}")
+        for cls, s in sorted(slo.items()):
+            tgt = s.get("target_ms")
+            burn = s.get("burn")
+            lines.append(
+                f"{cls:<14s}{s.get('requests_hist', 0):>7d}"
+                f"{s.get('breaches_tracker', 0):>8d}"
+                f"{(str(int(tgt)) + 'ms') if tgt else '-':>9s}"
+                f"{(f'{burn:.2f}' if burn is not None else '-'):>7s}")
+    attr = now.get("attribution") or {}
+    if attr.get("docs"):
+        lines.append(f"attribution: {attr['reconciled']}/{attr['docs']} "
+                     f"docs reconcile vs e2e")
+    mig = {k: v for k, v in (now.get("migrations") or {}).items() if v}
+    if mig:
+        lines.append("migrations: "
+                     + " ".join(f"{k}={v}" for k, v in sorted(mig.items())))
+    for model, a in (now.get("audit") or {}).items():
+        div = a.get("divergence_blocks") or {}
+        total_div = sum(div.values())
+        heals = a.get("heals_total") or {}
+        lines.append(
+            f"audit[{model}]: divergence {total_div} blocks "
+            f"({' '.join(f'{k}={v}' for k, v in sorted(div.items()) if v) or 'clean'})"
+            f"  heals {sum(heals.values())}  cycles {a.get('cycles', 0)}")
+    asc = now.get("autoscale")
+    if asc:
+        c = asc.get("counters") or {}
+        lines.append(
+            f"autoscale: desired={asc.get('desired')} "
+            f"ready={asc.get('ready')} ups={c.get('scaleUps', 0)} "
+            f"downs={c.get('scaleDowns', 0)} "
+            f"last={((asc.get('lastDecision') or {}).get('direction'))}")
+    hub = now.get("hub") or {}
+    sat = hub.get("saturation") or {}
+    rates = hub.get("rates") or {}
+    if hub.get("events"):
+        def pct(v):
+            return f"{v * 100:.1f}%" if v is not None else "n/a"
+
+        lines.append(
+            f"hub: rpc {rates.get('rpc') or 0}/s "
+            f"({pct(sat.get('rpc'))} of ceiling)  stored-blocks "
+            f"{rates.get('blocks') or 0}/s ({pct(sat.get('blocks'))})"
+            + (f"  publish mean {hub['publish_mean_us']}us"
+               if hub.get("publish_mean_us") is not None else ""))
+    for phase in doc.get("phases") or []:
+        p_ok = all(c["ok"] for c in phase.get("checks") or [])
+        reqs = sum(s.get("requests_hist", 0)
+                   for s in (phase.get("slo") or {}).values())
+        psat = (phase.get("hub") or {}).get("saturation") or {}
+        lines.append(
+            f"phase {phase['phase']:<10s} {phase['window_s']:>7.1f}s "
+            f"reqs={reqs:<5d} migr="
+            f"{sum((phase.get('migrations') or {}).values())} "
+            f"hub rpc {((phase.get('hub') or {}).get('rpc_per_s')) or 0}/s"
+            f" sat {psat.get('rpc') if psat.get('rpc') is not None else '-'}"
+            f" [{'ok' if p_ok else 'FAIL'}]")
+    failed = [c for c in doc.get("checks") or [] if not c["ok"]]
+    for c in failed:
+        lines.append(f"FAILED {c['name']}: {c['detail']}")
+    if not failed and doc.get("checks"):
+        lines.append(f"checks: {len(doc['checks'])} passed")
+    return "\n".join(lines)

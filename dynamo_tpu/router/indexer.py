@@ -67,6 +67,11 @@ class RadixTree:
         #: stored events dropped because their parent was unknown — each one
         #: is evidence of event loss; the indexer turns these into resyncs
         self.orphan_events = 0
+        #: cumulative blocks applied/removed through the event path — the
+        #: numerator of dynamo_hub_saturation_ratio{kind="blocks"} (the
+        #: stored-block rate the hub ceiling in docs/PERF_NOTES.md bounds)
+        self.blocks_stored = 0
+        self.blocks_removed = 0
         #: per-worker rolling [xor, count] over this tree's (worker, hash)
         #: membership — maintained inline at every insert/remove so the
         #: audit plane (observability/kvaudit.py) compares a worker's
@@ -131,6 +136,7 @@ class RadixTree:
                              e.stored_parent_hash, worker)
                 self.orphan_events += 1
                 return
+        self.blocks_stored += len(e.stored_blocks)
         for b in e.stored_blocks:
             child = node.children.get(b.tokens_hash)
             if child is None:
@@ -148,6 +154,7 @@ class RadixTree:
             node = self._lookup.pop((worker, h), None)
             if node is None:
                 continue
+            self.blocks_removed += 1
             self._digest_del(worker, h)
             node.workers.discard(worker)
             self._prune(node)
@@ -358,7 +365,12 @@ class KvIndexer:
         its cache contents. Stored events are idempotent, so replicas that
         did NOT gap simply re-confirm their state."""
         self.gaps_detected += 1
+        old = self.tree
         self.tree = RadixTree()
+        # carry the cumulative block-flow counters across the swap: they
+        # feed a rate (hub saturation), which must not regress on resync
+        self.tree.blocks_stored = old.blocks_stored
+        self.tree.blocks_removed = old.blocks_removed
         await self._request_resync()
 
     async def _request_resync(self):

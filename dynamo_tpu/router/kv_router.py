@@ -438,7 +438,17 @@ class KvPushRouter:
                                service="router") as sp:
             worker_ids = self.client.available_ids()
             if not worker_ids:
-                worker_ids = await self.client.wait_for_instances(timeout=5.0)
+                try:
+                    worker_ids = await self.client.wait_for_instances(
+                        timeout=5.0)
+                except TimeoutError as e:
+                    # fleet blackout (every worker dead at once, e.g. a
+                    # correlated kill): a bare TimeoutError escapes both
+                    # Migration and the frontend's typed handlers and
+                    # truncates the client stream as a generic 500. Type it
+                    # so Migration can re-send once the operator restarts
+                    # workers, and the frontend maps exhaustion to a 503.
+                    raise NoRespondersError(str(e)) from e
             try:
                 # class-biased cost (docs/qos.md): interactive requests
                 # avoid saturated workers, batch chases cache overlap

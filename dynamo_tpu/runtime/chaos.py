@@ -206,6 +206,18 @@ def get_chaos() -> Optional[ChaosInjector]:
         spec = os.environ.get("DYN_CHAOS")
         if spec:
             seed = int(os.environ.get("DYN_CHAOS_SEED", "0"))
+            # decorrelate replicas of the same service: an operator fleet
+            # shares one DYN_CHAOS_SEED, and identical seeds mean identical
+            # roll SEQUENCES — every replica dies at nearly the same step,
+            # turning per-worker kills into fleet-wide blackouts. Mixing in
+            # the replica index keeps each process deterministic (fixed
+            # seed + fixed index → same rolls) without the lockstep.
+            replica = os.environ.get("DYN_REPLICA_INDEX")
+            if replica is not None:
+                try:
+                    seed = seed * 1_000_003 + int(replica) + 1
+                except ValueError:
+                    pass
             _injector = ChaosInjector.from_spec(spec, seed=seed)
             logger.warning("chaos enabled (seed=%d): %s", seed, spec)
         else:

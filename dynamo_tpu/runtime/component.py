@@ -747,6 +747,20 @@ class Client:
         except NoRespondersError:
             server.abandon_stream(info)
             raise
+        except StreamError:
+            server.abandon_stream(info)
+            raise
+        except (asyncio.TimeoutError, TimeoutError, RuntimeError,
+                ConnectionError) as e:
+            # Dispatch-ack failure to a worker whose lease hasn't expired
+            # yet (e.g. SIGKILL'd corpse still advertised): the hub's
+            # forward times out and relays a generic error. This is
+            # PRE-STREAM by construction — no token was produced — so
+            # surface it as a retryable StreamError: generate()'s failover
+            # marks the instance down and re-picks instead of bubbling a
+            # client-visible 500.
+            server.abandon_stream(info)
+            raise StreamError(f"dispatch ack failed: {e!r}") from e
         except Exception:
             server.abandon_stream(info)
             raise

@@ -34,6 +34,12 @@ Subcommands:
   advertised block count vs the worker's resident count, phantom /
   missing / dangling divergence with age, last heal, suspicion score and
   stale-advert pull failures; ``--diff`` adds divergent-hash samples.
+- ``dynctl fleet`` — the fleet scorecard (docs/observability.md "Fleet
+  scorecard"): per-class SLO rollup cross-checked against the frontend's
+  own histograms, attribution reconciliation, migration outcomes, audit
+  divergence/heals, autoscale decisions and hub saturation, fetched from
+  a frontend's ``/v1/fleet/scorecard``; ``--watch`` refreshes, ``--json``
+  dumps the raw document.
 - ``dynctl why <request-id>`` — the per-request latency attribution tree
   (docs/observability.md "Attribution"): the request's spans joined with
   the serving workers' step records, every millisecond bucketed into a
@@ -212,9 +218,13 @@ async def top_amain(as_json: bool, watch: float = 0.0,
                     timeout: float = 2.0) -> int:
     """Live fleet table from every worker's flight recorder summary."""
     from dynamo_tpu.observability import fetch_fleet_steps
+    from dynamo_tpu.observability.scorecard import HubSaturationTracker
     from dynamo_tpu.runtime import DistributedRuntime
 
     runtime = await DistributedRuntime.create()
+    # hub-saturation footer: rpc ops/s between refreshes vs the measured
+    # ceiling (same ratio dynamo_hub_saturation_ratio{kind="rpc"} exports)
+    sat = HubSaturationTracker()
 
     def fmt_anoms(anoms: dict) -> str:
         labels = (("slow-step", "slow"), ("compile-steady", "steady"),
@@ -266,10 +276,23 @@ async def top_amain(as_json: bool, watch: float = 0.0,
                     pub = hub.get("publish_seconds") or {}
                     mean_us = (pub["sum"] / pub["count"] * 1e6
                                if pub.get("count") else 0.0)
+                    sat.sample(hub)
+                    if not watch and sat.rates().get("rpc") is None:
+                        # one-shot run: a rate needs two samples — take a
+                        # short second one instead of printing nothing
+                        await asyncio.sleep(0.3)
+                        try:
+                            sat.sample(await runtime.plane.hub_stats())
+                        except Exception:
+                            pass
+                    ratio = sat.ratios().get("rpc")
+                    sat_txt = (f"  saturation {ratio * 100:.1f}% of "
+                               f"{sat.rpc_ceiling:.0f} rpc/s"
+                               if ratio is not None else "")
                     print(f"hub: "
                           + " ".join(f"{k}={v}" for k, v in sorted(ev.items()))
                           + f"  publish mean {mean_us:.0f}us over "
-                            f"{pub.get('count', 0)} events")
+                            f"{pub.get('count', 0)} events" + sat_txt)
                     # KV event-stream health (docs/observability.md "KV
                     # audit"): is the radix's feed intact, truncating, or
                     # forcing resyncs?
@@ -635,6 +658,53 @@ def _why_main(argv: list[str]) -> None:
         why_amain(args.request_id, args.json, args.records, args.timeout)))
 
 
+async def fleet_amain(url: str, as_json: bool, watch: float = 0.0,
+                      timeout: float = 5.0) -> int:
+    """The fleet scorecard (docs/observability.md "Fleet scorecard"):
+    GET /v1/fleet/scorecard off a frontend and render the joined
+    per-class SLO / attribution / migration / audit / autoscale / hub
+    rollup with its falsifiability checks."""
+    import aiohttp
+
+    from dynamo_tpu.observability.scorecard import render_scorecard
+
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=timeout)) as session:
+        while True:
+            try:
+                async with session.get(
+                        f"{url.rstrip('/')}/v1/fleet/scorecard") as resp:
+                    doc = await resp.json()
+            except Exception as e:
+                print(f"scorecard fetch failed: {e}", file=sys.stderr)
+                return 1
+            if as_json:
+                print(json.dumps(doc, indent=2))
+            else:
+                print(render_scorecard(doc))
+            if not watch:
+                return 0 if doc.get("ok") else 1
+            await asyncio.sleep(watch)
+            print()
+
+
+def _fleet_main(argv: list[str]) -> None:
+    ap = argparse.ArgumentParser(
+        prog="dynctl fleet",
+        description="render a frontend's fleet scorecard "
+                    "(/v1/fleet/scorecard)")
+    ap.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="frontend base URL (default http://127.0.0.1:8000)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw scorecard document")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every N seconds (0 = one-shot)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    raise SystemExit(asyncio.run(
+        fleet_amain(args.url, args.json, args.watch, args.timeout)))
+
+
 def _autoscale_main(argv: list[str]) -> None:
     ap = argparse.ArgumentParser(
         prog="dynctl autoscale",
@@ -682,6 +752,9 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "kv":
         _kv_main(sys.argv[2:])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        _fleet_main(sys.argv[2:])
         return
     ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
     ap.add_argument("--host", default="0.0.0.0")

@@ -1,0 +1,70 @@
+"""benchmarks/compare_gains.py comparison heuristics.
+
+The phase-presence rules matter most: a brand-new bench phase (landed
+before the baseline refresh) or a skipped phase must collapse to one
+drift line per PHASE — per-key warn-spam buries the real regressions.
+"""
+
+from benchmarks.compare_gains import compare
+
+
+BASE = {
+    "extra": {
+        "kernel_tok_s": 100.0,
+        "chaos_smoke": {"chaos_ok": True, "p95_ms": 20.0},
+        "qos": {"qos_ok": True, "int_ttft_p95_ms": 50.0},
+    }
+}
+
+
+def _cur(**over):
+    import copy
+
+    cur = copy.deepcopy(BASE)
+    cur["extra"].update(over)
+    return cur
+
+
+def test_no_changes_no_noise():
+    regs, drifts = compare(BASE, BASE, 0.3)
+    assert regs == [] and drifts == []
+
+
+def test_gate_flip_and_directional_regression():
+    cur = _cur(kernel_tok_s=50.0,
+               chaos_smoke={"chaos_ok": False, "p95_ms": 40.0})
+    regs, _ = compare(BASE, cur, 0.3)
+    assert any("kernel_tok_s" in r for r in regs)
+    assert any("chaos_ok" in r and "true → false" in r for r in regs)
+    assert any("p95_ms" in r for r in regs)
+
+
+def test_new_phase_in_gains_is_one_drift_line_not_spam():
+    # a new phase (e.g. the flagship drive) lands before the baseline is
+    # refreshed: its whole subtree must produce exactly ONE drift line
+    # naming the phase, zero regressions, zero per-key lines
+    cur = _cur(flagship={"flagship_ok": True, "lost_tokens": 0,
+                         "hub_rpc_per_s": 20.0, "requests": 24,
+                         "int_ttft_p95_ms": 21.0})
+    regs, drifts = compare(BASE, cur, 0.3)
+    assert regs == []
+    assert len(drifts) == 1
+    assert "flagship" in drifts[0] and "not in baseline" in drifts[0]
+
+
+def test_baseline_phase_skipped_is_one_drift_line():
+    cur = {"extra": {k: v for k, v in BASE["extra"].items()
+                     if k != "qos"}}
+    regs, drifts = compare(BASE, cur, 0.3)
+    assert regs == []
+    assert len(drifts) == 1
+    assert "'qos'" in drifts[0] and "absent" in drifts[0]
+
+
+def test_missing_keys_within_shared_phase_still_reported():
+    cur = _cur(chaos_smoke={"chaos_ok": True})  # p95_ms gone, phase kept
+    regs, drifts = compare(BASE, cur, 0.3)
+    assert regs == []
+    assert len(drifts) == 1
+    assert "baseline keys absent" in drifts[0]
+    assert "chaos_smoke.p95_ms" in drifts[0]

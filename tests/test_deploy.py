@@ -55,7 +55,7 @@ async def test_operator_scale_and_crash_restart(tmp_path):
         op.replicas["work"][0].proc.wait()
         op.reconcile_once()
         assert op.restarts["work"] == 1
-        op._next_start["work"] = 0.0
+        op._next_start[("work", 0)] = 0.0
         op.reconcile_once()
         assert alive(op, "work") == 2
 
@@ -67,6 +67,43 @@ async def test_operator_scale_and_crash_restart(tmp_path):
     finally:
         await op.stop()
     assert alive(op, "work") == 0  # drained
+
+
+async def test_operator_backoff_is_per_slot_not_per_service(tmp_path):
+    """Flagship-drive regression: chaos kills spread across a pool must
+    not accumulate into one service-wide crash streak that freezes ALL
+    respawns (observed as the decode pool collapsing to 1 alive while
+    desired was 4). Each replica slot carries its own backoff."""
+    spec = str(tmp_path / "graph.yaml")
+    write_spec(spec, {"work": {"replicas": 3, "command": SLEEPER}})
+    op = ProcessOperator(spec, tick_s=0.1)
+    try:
+        op.reconcile_once()
+        assert alive(op, "work") == 3
+        t0 = time.monotonic()
+        for i in range(3):  # one independent death per slot
+            victim = next(r for r in op.replicas["work"] if r.index == i)
+            victim.proc.kill()
+            victim.proc.wait()
+            op.reconcile_once()
+        # every slot is a FIRST offense (~1s delay each) — no shared
+        # streak escalating toward the 5s/10s/30s tiers
+        for i in range(3):
+            assert op._crash_streak[("work", i)] == 1
+            assert op._next_start[("work", i)] - t0 < 3.0
+        # a slot whose delay elapsed respawns even while the others are
+        # still backing off
+        op._next_start[("work", 0)] = 0.0
+        op.reconcile_once()
+        assert alive(op, "work") == 1
+        assert {r.index for r in op.replicas["work"]
+                if r.proc.poll() is None} == {0}
+        for slot in list(op._next_start):
+            op._next_start[slot] = 0.0
+        op.reconcile_once()
+        assert alive(op, "work") == 3
+    finally:
+        await op.stop()
 
 
 async def test_operator_follows_planner_target(tmp_path):

@@ -250,6 +250,159 @@ def _req(max_tokens=16):
         stop_conditions=StopConditions(max_tokens=max_tokens))
 
 
+async def test_migration_retries_fleet_blackout_no_responders():
+    """Regression (flagship drive): when every worker is dead at once
+    (correlated kills), the router raises NoRespondersError — Migration
+    must burn the retry budget against it like a retryable transport loss
+    (the backoff window is the operator's restart window), instead of
+    letting it escape and truncate the client stream."""
+    calls = []
+
+    async def blackout_then_serve(req, ctx):
+        calls.append(1)
+        if len(calls) < 3:
+            from dynamo_tpu.runtime.control_plane import NoRespondersError
+            raise NoRespondersError("no instances for decode/generate")
+        yield LLMEngineOutput(token_ids=[7],
+                              finish_reason=FinishReason.LENGTH)
+
+    outs = []
+    async for out in Migration(blackout_then_serve,
+                               migration_limit=5).generate(
+            _req(max_tokens=1), Context()):
+        outs.append(out)
+    assert len(calls) == 3  # two blackout legs re-sent, third served
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+
+    # exhaustion keeps the TYPE so the frontend still maps it to a 503
+    from dynamo_tpu.runtime.control_plane import NoRespondersError
+
+    async def always_blackout(req, ctx):
+        raise NoRespondersError("no instances")
+        yield  # pragma: no cover
+
+    with pytest.raises(NoRespondersError):
+        async for _ in Migration(always_blackout,
+                                 migration_limit=2).generate(
+                _req(), Context()):
+            pass
+
+
+async def test_kv_router_blackout_is_typed_not_bare_timeout():
+    """Regression (flagship drive): wait_for_instances timing out on an
+    empty fleet raised a bare TimeoutError, which no typed handler
+    (Migration, frontend SSE) catches — the client saw a silently
+    truncated 200 stream. It must surface as NoRespondersError."""
+    from types import SimpleNamespace
+
+    from dynamo_tpu.router.kv_router import KvPushRouter
+    from dynamo_tpu.runtime.control_plane import NoRespondersError
+
+    async def wait_for_instances(timeout=None):
+        raise TimeoutError("no instances for decode/generate")
+
+    client = SimpleNamespace(available_ids=lambda: [],
+                             wait_for_instances=wait_for_instances)
+    router = SimpleNamespace(config=SimpleNamespace(onboard_enabled=False))
+    kpr = KvPushRouter(client, router)
+    with pytest.raises(NoRespondersError):
+        async for _ in kpr.generate(_req(), Context()):
+            pass
+
+
+async def test_migration_completed_counts_before_final_yield():
+    """Regression (flagship drive): downstream operators return the moment
+    they see the finish frame, closing Migration's generator at the final
+    yield — accounting placed after it never ran, so the 'completed'
+    counter stayed at zero no matter how many migrations succeeded."""
+    from dynamo_tpu.llm.pipeline import migration_stats
+
+    state = {"n": 0}
+
+    async def die_once(req, ctx):
+        if state["n"] == 0:
+            state["n"] += 1
+            yield LLMEngineOutput(token_ids=[1])
+            raise StreamError("stream disconnected")
+        yield LLMEngineOutput(token_ids=[2],
+                              finish_reason=FinishReason.LENGTH)
+
+    before = migration_stats().get("completed", 0)
+    agen = Migration(die_once, migration_limit=2).generate(_req(), Context())
+    async for out in agen:
+        if out.finish_reason is not None:
+            break  # abandon at the finish frame, like the detokenizer
+    await agen.aclose()
+    assert migration_stats().get("completed", 0) == before + 1
+
+
+async def test_dispatch_ack_failure_fails_over_as_stream_error(monkeypatch):
+    """Regression (flagship drive): a dispatch ack timing out against a
+    just-killed worker (lease not yet expired) surfaced as a bare
+    RuntimeError/TimeoutError — outside Client.generate's failover set and
+    Migration's retry set, so it became a client-visible 500. It must be a
+    retryable StreamError."""
+    rt = await DistributedRuntime.create()
+    try:
+        async def handler(request, ctx):
+            yield {"ok": True}
+
+        ep = rt.namespace("ns").component("ack").endpoint("gen")
+        handle = await ep.serve_endpoint(handler)
+        client = await ep.client().start()
+        # force the wire path: the in-process shortcut never touches the ack
+        subject = next(iter(rt._local_endpoints))
+        rt._local_endpoints.pop(subject)
+
+        async def hung_ack(subj, payload, timeout=None):
+            raise asyncio.TimeoutError()
+
+        monkeypatch.setattr(rt.plane, "request", hung_ack)
+        with pytest.raises(StreamError) as ei:
+            await client.generate({}, ctx=Context())
+        assert ei.value.retryable
+        assert "dispatch ack" in str(ei.value)
+
+        # the hub-relayed shape (RuntimeError carrying the detail repr)
+        # must convert identically
+        async def relayed_error(subj, payload, timeout=None):
+            raise RuntimeError("TimeoutError()")
+
+        monkeypatch.setattr(rt.plane, "request", relayed_error)
+        with pytest.raises(StreamError):
+            await client.generate({}, ctx=Context())
+        await client.stop()
+        await handle.stop(graceful=False)
+    finally:
+        await rt.shutdown()
+
+
+def test_chaos_replica_index_decorrelates_rolls(monkeypatch):
+    """Regression (flagship drive): operator replicas share DYN_CHAOS_SEED,
+    and identical seeds meant identical roll sequences — every decode
+    worker died at nearly the same step, turning per-worker kills into
+    fleet-wide blackouts. get_chaos() must mix DYN_REPLICA_INDEX in."""
+    from dynamo_tpu.runtime import chaos as chaos_mod
+
+    def rolls(replica):
+        monkeypatch.setenv("DYN_CHAOS", "engine.step:error=0.3")
+        monkeypatch.setenv("DYN_CHAOS_SEED", "7")
+        if replica is None:
+            monkeypatch.delenv("DYN_REPLICA_INDEX", raising=False)
+        else:
+            monkeypatch.setenv("DYN_REPLICA_INDEX", str(replica))
+        chaos_mod._injector = chaos_mod._UNSET
+        inj = chaos_mod.get_chaos()
+        return [inj.should_error("engine.step") for _ in range(200)]
+
+    try:
+        assert rolls(0) == rolls(0)          # per-replica determinism
+        assert rolls(0) != rolls(1)          # replicas decorrelated
+        assert rolls(None) == rolls(None)    # no index: plain seed, stable
+    finally:
+        chaos_mod._injector = chaos_mod._UNSET
+
+
 # ----------------------------------------------------------- breaker layer
 
 

@@ -1,0 +1,266 @@
+"""Fleet scorecard (observability/scorecard.py + the frontend join).
+
+The scorecard's value is falsifiability: every rollup is cross-checked
+against an independent instrument fed from the same events. These tests
+exercise the join math exactly — histogram-vs-tracker count equality,
+the bucket-derived breach BRACKET, attribution reconciliation — plus the
+HTTP route, the ``dynctl fleet`` renderer, and a bounded flagship-drive
+smoke (the scaled-down ISSUE 16 cycle: operator-spawned mocker fleet,
+chaos kills, audit heals, live saturation gauge).
+"""
+
+import asyncio
+from types import SimpleNamespace
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.http import HttpService
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.observability.scorecard import (
+    HubSaturationTracker, class_hist_stats, hub_rpc_total, render_scorecard,
+)
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.anyio
+
+
+# ------------------------------------------------------------- unit: hub
+
+
+def test_hub_rpc_total_excludes_stream_publish():
+    # stream appends scale separately from the rpc ceiling (PERF_NOTES) —
+    # they must not count against it
+    events = {"request": 10, "kv_put": 5, "publish": 2,
+              "stream_publish": 10_000}
+    assert hub_rpc_total(events) == 17
+    assert hub_rpc_total({}) == 0
+    assert hub_rpc_total(None) == 0
+
+
+def test_saturation_tracker_window_math():
+    clock = [0.0]
+    t = HubSaturationTracker(rpc_ceiling=100.0, blocks_ceiling=1000.0,
+                             now_fn=lambda: clock[0])
+    t.sample({"events": {"request": 10}}, blocks_stored=100)
+    # one sample spans no interval: no rate, no ratio
+    assert t.rates() == {"rpc": None, "blocks": None}
+    assert t.ratios() == {"rpc": None, "blocks": None}
+    clock[0] = 10.0
+    t.sample({"events": {"request": 100, "stream_publish": 9999,
+                         "kv_put": 10}}, blocks_stored=600)
+    # rpc: (110 - 10) / 10s (stream_publish excluded); blocks: 500 / 10s
+    assert t.rates() == {"rpc": 10.0, "blocks": 50.0}
+    assert t.ratios() == {"rpc": 0.1, "blocks": 0.05}
+
+
+def test_saturation_tracker_counter_regression_resets_window():
+    clock = [0.0]
+    t = HubSaturationTracker(rpc_ceiling=100.0, blocks_ceiling=1000.0,
+                             now_fn=lambda: clock[0])
+    t.sample({"events": {"request": 50}}, blocks_stored=500)
+    clock[0] = 5.0
+    t.sample({"events": {"request": 100}}, blocks_stored=600)
+    assert t.rates()["rpc"] == 10.0
+    # hub restarted: cumulative totals regressed — the window must reset
+    # instead of reporting a negative rate
+    clock[0] = 6.0
+    t.sample({"events": {"request": 3}}, blocks_stored=10)
+    assert t.rates() == {"rpc": None, "blocks": None}
+
+
+def test_saturation_ceilings_from_env(monkeypatch):
+    monkeypatch.setenv("DYN_HUB_CEILING_RPC", "123.5")
+    monkeypatch.setenv("DYN_HUB_CEILING_BLOCKS", "not-a-number")
+    t = HubSaturationTracker()
+    assert t.rpc_ceiling == 123.5
+    from dynamo_tpu.observability.scorecard import DEFAULT_BLOCKS_CEILING
+    assert t.blocks_ceiling == DEFAULT_BLOCKS_CEILING
+
+
+# ------------------------------------------- unit: histogram breach math
+
+
+def test_class_hist_stats_breach_bracket_exact():
+    hist = MetricsRegistry().histogram(
+        "t", buckets=(0.05, 0.15, 0.3, 0.6, 1.2))
+    # interactive @ 200ms target: 0.04/0.1 below, 0.25/0.5/2.0 above
+    for v in (0.04, 0.1, 0.25, 0.5, 2.0):
+        hist.observe(v, qos="interactive")
+    hist.observe(9.0, qos="batch")  # no target: no bracket
+    out = class_hist_stats(hist, {"interactive": 200.0, "batch": None})
+    s = out["interactive"]
+    assert s["count"] == 5
+    assert s["sum_s"] == pytest.approx(2.89)
+    # above the smallest edge >= 0.2s (0.3): the 0.5 and 2.0 obs → lower
+    # bound 2; above the largest edge <= 0.2s (0.15): also 0.25 → upper
+    # bound 3. The true breach count (3) provably lies inside.
+    assert s["breach_bracket"] == [2, 3]
+    assert s["target_ms"] == 200.0
+    assert s["p95_s_le"] is None  # 95th-percentile obs sits in +Inf
+    assert "breach_bracket" not in out["batch"]
+
+
+# ------------------------------------- the frontend join (no fleet needed)
+
+
+def _svc() -> HttpService:
+    return HttpService(ModelManager(), port=0)
+
+
+def _feed(svc: HttpService, cls: str, ttft_s: float) -> None:
+    """Both halves of the first-token callback, exactly as the SSE path
+    does it (http.py: _ttft_class.observe + _note_slo)."""
+    svc._ttft_class.observe(ttft_s, qos=cls)
+    svc._note_slo(SimpleNamespace(priority=cls, id="req-x"), ttft_s)
+
+
+async def test_slo_join_checks_pass_when_paths_agree():
+    svc = _svc()
+    for dt in (0.01, 0.02, 5.0):  # one clear breach of the 200ms default
+        _feed(svc, "interactive", dt)
+    doc = await svc.scorecard.document()
+    s = doc["now"]["slo"]["interactive"]
+    assert s["requests_hist"] == s["requests_tracker"] == 3
+    assert s["breaches_tracker"] == 1
+    lo, hi = s["breach_bracket_hist"]
+    assert lo <= 1 <= hi
+    names = {c["name"]: c["ok"] for c in doc["checks"]}
+    assert names["slo_count[interactive]"]
+    assert names["slo_breaches[interactive]"]
+    assert doc["ok"]
+
+
+async def test_slo_join_desync_is_flagged():
+    svc = _svc()
+    _feed(svc, "interactive", 0.01)
+    # a path losing samples: histogram observed, tracker never told
+    svc._ttft_class.observe(0.02, qos="interactive")
+    doc = await svc.scorecard.document()
+    bad = [c for c in doc["checks"] if not c["ok"]]
+    assert [c["name"] for c in bad] == ["slo_count[interactive]"]
+    assert "hist 2 vs tracker 1" in bad[0]["detail"]
+    assert not doc["ok"]
+
+
+async def test_breach_undercount_fails_bracket_check():
+    svc = _svc()
+    # tracker claims zero breaches while the histogram PROVES >= 1:
+    # 5.0s sits above every edge <= the 200ms target
+    svc._ttft_class.observe(5.0, qos="interactive")
+    svc._burn.note("interactive", 0.01)  # same count, wrong latency
+    doc = await svc.scorecard.document()
+    names = {c["name"]: c["ok"] for c in doc["checks"]}
+    assert names["slo_count[interactive]"]          # counts still agree
+    assert not names["slo_breaches[interactive]"]   # bracket refutes it
+    assert not doc["ok"]
+
+
+async def test_phase_cards_delta_math():
+    svc = _svc()
+    _feed(svc, "interactive", 0.01)
+    await svc.scorecard.mark_phase("peak")
+    for dt in (0.02, 0.03):
+        _feed(svc, "interactive", dt)
+    card = await svc.scorecard.mark_phase(None)
+    # the card carries the PHASE's deltas, not the cumulative totals
+    assert card["phase"] == "peak"
+    assert card["slo"]["interactive"]["requests_hist"] == 2
+    assert card["slo"]["interactive"]["requests_tracker"] == 2
+    assert card["slo"]["interactive"]["breaches_tracker"] == 0
+    assert all(c["ok"] for c in card["checks"])
+    assert svc.scorecard.phases == [card]
+    doc = await svc.scorecard.document()
+    assert doc["phases"][0]["phase"] == "peak"
+    assert doc["ok"]
+
+
+async def test_attribution_reconciliation_check():
+    svc = _svc()
+    good = {"request_id": "r1", "e2e_ms": 100.0, "residual_ms": 2.0,
+            "total": {"prefill": 60.0, "decode": 38.0, "unattributed": 2.0}}
+    bad = {"request_id": "r2", "e2e_ms": 100.0,
+           "total": {"prefill": 60.0}}  # 40ms of e2e unexplained
+    svc.scorecard.note_attribution(good)
+    doc = await svc.scorecard.document()
+    names = {c["name"]: c for c in doc["checks"]}
+    assert names["attr_reconcile"]["ok"]
+    svc.scorecard.note_attribution(bad)
+    doc = await svc.scorecard.document()
+    names = {c["name"]: c for c in doc["checks"]}
+    assert not names["attr_reconcile"]["ok"]
+    assert "1/2" in names["attr_reconcile"]["detail"]
+    assert svc.scorecard.attr_failures[0]["request_id"] == "r2"
+
+
+async def test_render_scorecard_text():
+    svc = _svc()
+    for dt in (0.01, 5.0):
+        _feed(svc, "interactive", dt)
+    doc = await svc.scorecard.document()
+    text = render_scorecard(doc)
+    assert "fleet scorecard  [OK]" in text
+    assert "interactive" in text and "200ms" in text
+    assert text.rstrip().endswith("passed")
+    # now a desynced doc: the renderer must surface the failed check
+    svc._ttft_class.observe(0.02, qos="interactive")
+    text = render_scorecard(await svc.scorecard.document())
+    assert "CHECK FAILURES" in text
+    assert "FAILED slo_count[interactive]" in text
+
+
+# --------------------------------------------- HTTP route + dynctl fleet
+
+
+async def test_scorecard_route_and_dynctl_fleet(capsys):
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.dynctl import fleet_amain
+
+    rt = await DistributedRuntime.create()
+    service = HttpService(ModelManager(), port=0, runtime=rt)
+    await service.start()
+    try:
+        _feed(service, "interactive", 0.01)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.get(f"{base}/v1/fleet/scorecard") as r:
+                assert r.status == 200
+                doc = await r.json()
+        assert doc["ok"]
+        assert doc["now"]["slo"]["interactive"]["requests_hist"] == 1
+        assert {c["name"] for c in doc["checks"]} >= {
+            "slo_count[interactive]", "slo_breaches[interactive]"}
+        assert "saturation" in doc["now"]["hub"]
+        # dynctl fleet: fetch + render the same route
+        await fleet_amain(base, as_json=False)
+        out = capsys.readouterr().out
+        assert "fleet scorecard  [OK]" in out
+        assert "interactive" in out
+    finally:
+        await service.stop()
+        await rt.shutdown()
+
+
+# ------------------------------------------- bounded flagship-drive smoke
+
+
+async def test_flagship_drive_smoke():
+    """Scaled-down ISSUE 16 cycle: 1+3 mocker fleet at the plan's step
+    economics, pinned (no autoscaler), seeded decode kills, audit + attr
+    sampler + scorecard phases live. Bounded: ~12s wall."""
+    from benchmarks.flagship_drive import drive
+
+    out = await asyncio.wait_for(
+        drive(duration_s=8.0, scale=0.5, seed=7, kill_error=0.004,
+              autoscale=False),
+        timeout=180.0)
+    assert out["requests"] > 0
+    assert out["failed"] == 0, out
+    assert out["lost_tokens"] == 0, out
+    assert out["audit_divergence_end"] == 0, out
+    assert out["scorecard_failed_checks"] == [], out
+    assert out["scorecard_phases"] >= 3
+    assert out["saturation_gauge_live"], "gauge never appeared on /metrics"
+    assert out["hub_rpc_per_s"] and out["hub_rpc_per_s"] > 0
+    assert out["flagship_ok"], {k: v for k, v in out.items()
+                                if k != "scorecard"}
