@@ -362,19 +362,14 @@ class EngineArgs:
     kv_cache_memory_fraction: float = 0.6  # of free HBM, when num_blocks is None
     decode_batch_buckets: tuple = ()  # () = powers of two up to max_num_seqs
     prefill_buckets: tuple = ()  # () = powers of two up to max_num_batched_tokens
-    #: ragged step (docs/performance.md): prefill chunks and decode rows of
-    #: a plan ride ONE packed token batch served by the ragged paged-
-    #: attention path (ops/ragged_attention.py) instead of separate
-    #: (chunk-bucket × batch-bucket × table-width-bucket) compiled programs.
-    #: Compiled-signature count collapses to the token buckets below (R and
-    #: W derive statically from T), warmup shrinks to a handful of traces,
-    #: and the scheduler plans a token budget per step instead of grouping
-    #: same-bucket chunks. Falls back to the bucketed path automatically
-    #: for MLA caches, pipeline parallelism, and multi-host step
-    #: replication; False (--no-ragged-step) restores it wholesale.
-    ragged_step: bool = True
-    #: packed-token buckets for the ragged step; () = powers of two from 8
-    #: up to max_num_batched_tokens
+    #: packed-token buckets for the ragged step (docs/performance.md):
+    #: prefill chunks and decode rows of a plan ride ONE packed token batch
+    #: served by the ragged paged-attention path (ops/ragged_attention.py)
+    #: — the engine's only step path. Compiled-signature count collapses to
+    #: the token buckets below (R and W derive statically from T), warmup
+    #: shrinks to a handful of traces, and the scheduler plans a token
+    #: budget per step. () = powers of two from 8 up to
+    #: max_num_batched_tokens
     ragged_token_buckets: tuple = ()
     use_pallas_attention: bool = False  # Pallas paged-attention kernel (TPU only)
     #: decode steps fused into one jitted call when only decode work exists

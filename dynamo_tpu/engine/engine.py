@@ -171,10 +171,25 @@ class AsyncJaxEngine:
                 raise ValueError(f"pp_size={self._pp}: {reason}")
 
         self._kv_quant = args.kv_cache_dtype == "int8"
-        if self._kv_quant and self._pp > 1:
-            logger.warning("int8 KV cache is not supported under pipeline "
-                           "parallelism yet — using model dtype")
-            self._kv_quant = False
+        # capability gaps fail loudly at construction: a fleet silently
+        # running a degraded configuration would serve at a fraction of its
+        # planned capacity with nothing but a log line to show for it
+        if self._pp > 1:
+            if self._kv_quant:
+                raise ValueError(
+                    "kv_cache_dtype='int8' is not supported under pipeline "
+                    "parallelism (pp_size=%d); use the model dtype or pp=1"
+                    % self._pp)
+            if args.multi_step_decode > 1:
+                raise ValueError(
+                    "multi_step_decode=%d is not supported under pipeline "
+                    "parallelism (pp_size=%d); set multi_step_decode=1"
+                    % (args.multi_step_decode, self._pp))
+            if args.speculative_tokens > 0:
+                raise ValueError(
+                    "speculative_tokens=%d is not supported under pipeline "
+                    "parallelism (pp_size=%d); set speculative_tokens=0"
+                    % (args.speculative_tokens, self._pp))
         from dynamo_tpu.engine.cache import tree_nbytes
         # tree_nbytes is GLOBAL bytes; the fallback estimator reasons about
         # ONE chip's HBM, and TP shards the big weight matrices across
@@ -265,79 +280,54 @@ class AsyncJaxEngine:
         self.swap_out_blocks = 0
         self.swap_in_blocks = 0
         #: ragged step (docs/performance.md): mixed prefill+decode in ONE
-        #: packed launch — compiled signatures collapse to the token
-        #: buckets, the scheduler plans a token budget per step, and
-        #: padded dispatch between buckets disappears. Bucketed fns stay
-        #: built as the escape hatch (--no-ragged-step) and for the paths
-        #: ragged doesn't cover yet.
-        ragged_blockers = [r for r, hit in (
-            ("MLA latent cache", cfg.is_mla),
-            ("pipeline parallelism", self._pp > 1),
-            ("multi-host step replication", self._multihost),
-            ("multi-step fused decode", args.multi_step_decode > 1),
-            ("speculative decoding", args.speculative_tokens > 0),
-        ) if hit]
-        self._ragged = args.ragged_step and not ragged_blockers
-        if args.ragged_step and not self._ragged:
-            logger.info("ragged step disabled (%s) — bucketed step path "
-                        "in use", ", ".join(ragged_blockers))
+        #: packed launch, the ONLY step path — compiled signatures collapse
+        #: to the token buckets, the scheduler plans a token budget per
+        #: step, and padded dispatch between buckets is gone. Every mode
+        #: (spec verify, MLA/TPLA, pp, multi-host, multi-step) rides the
+        #: same packed layout.
         self.scheduler = Scheduler(
             args, self.pool, on_stored=self._on_stored,
             onboard_cb=self._onboard if self.kvbm is not None else None,
             swapper=self if self._swap is not None else None,
-            token_budget=self._ragged,
+            token_budget=True,
             hot_cb=self._note_hot_prefix if self.kvbm is not None else None)
+        self.pp_fn = None
+        self.ragged_fn = None
+        self.ragged_dec_fn = None
+        self._ragged_mm_fn = None  # compiled lazily on first mm request
+        self.multi_fn = None
+        self.verify_fn = None
+        self.draft_fn = None
         if self._pp > 1:
             from dynamo_tpu.parallel.pipeline import make_pp_step_fn
-            self.step_fn = make_pp_step_fn(
+            # pp takes packed ragged microbatches: each microbatch is one
+            # ragged bin with the same (T, R, C, W) shape, so the compiled
+            # signature is (T, M) — no bucketed lattice per stage
+            self.pp_fn = make_pp_step_fn(
                 cfg, args.block_size, mesh,
                 replicate_logits=self._multihost)
-            if args.multi_step_decode > 1:
-                logger.warning("multi-step decode is not pipelined yet — "
-                               "single-step decode under pp")
-            if args.speculative_tokens > 0:
-                logger.warning("speculative decoding is not pipelined yet — "
-                               "disabled under pp")
-            self.multi_fn = None
-            self._step_mm_fn = None
-            self.ragged_fn = None
-            self.ragged_dec_fn = None
-            self._ragged_mm_fn = None
-            self.verify_fn = None
-            self.draft_fn = None
         else:
-            self.step_fn = M.make_step_fn(cfg, args.block_size, mesh,
-                                          use_pallas=args.use_pallas_attention,
-                                          replicate_logits=self._multihost,
-                                          kv_quant=self._kv_quant)
-            self.multi_fn = None
+            self.ragged_fn = M.make_ragged_step_fn(
+                cfg, args.block_size, mesh,
+                use_pallas=args.use_pallas_attention,
+                replicate_logits=self._multihost,
+                kv_quant=self._kv_quant)
+            # decode-only variant (no chunk grid): what decode-only plans
+            # and the pipelined decode loop dispatch
+            self.ragged_dec_fn = M.make_ragged_step_fn(
+                cfg, args.block_size, mesh,
+                use_pallas=args.use_pallas_attention,
+                replicate_logits=self._multihost,
+                kv_quant=self._kv_quant, chunks=False)
             if args.multi_step_decode > 1:
                 self.multi_fn = M.make_multi_decode_fn(
                     cfg, args.block_size, args.multi_step_decode, mesh,
                     use_pallas=args.use_pallas_attention,
                     replicate_outputs=self._multihost,
                     kv_quant=self._kv_quant)
-            self._step_mm_fn = None  # compiled lazily on first mm request
-            self.ragged_fn = None
-            self.ragged_dec_fn = None
-            self._ragged_mm_fn = None  # lazy, like _step_mm_fn
-            if self._ragged:
-                self.ragged_fn = M.make_ragged_step_fn(
-                    cfg, args.block_size, mesh,
-                    use_pallas=args.use_pallas_attention,
-                    replicate_logits=self._multihost,
-                    kv_quant=self._kv_quant)
-                # decode-only variant (no chunk grid): what the pipelined
-                # decode loop dispatches
-                self.ragged_dec_fn = M.make_ragged_step_fn(
-                    cfg, args.block_size, mesh,
-                    use_pallas=args.use_pallas_attention,
-                    replicate_logits=self._multihost,
-                    kv_quant=self._kv_quant, chunks=False)
-            self.verify_fn = None
-            self.draft_fn = None
             if args.speculative_tokens > 0:
-                self.verify_fn = M.make_verify_fn(
+                # verify is a ragged row with q_len = draft+1
+                self.verify_fn = M.make_ragged_verify_fn(
                     cfg, args.block_size, mesh,
                     replicate_outputs=self._multihost,
                     kv_quant=self._kv_quant)
@@ -357,6 +347,11 @@ class AsyncJaxEngine:
         self._spec_resume_step = 0
         self.spec_disabled_total = 0
         self.spec_measured_gain: Optional[float] = None
+        #: measured dispatch walls (EWMA, ms): one spec round (draft +
+        #: verify + host round trip) vs one plain decode step — the
+        #: governor's ragged cost re-baseline (_spec_dispatch_cost)
+        self._spec_round_ms: Optional[float] = None
+        self._decode_step_ms: Optional[float] = None
         from dynamo_tpu.engine import sampling as S
         self._sampling = S
 
@@ -502,7 +497,7 @@ class AsyncJaxEngine:
         (generate, disagg prefill_extract, generate_prefilled/injected)
         honors it."""
         if req.mm_embeds and self._pp > 1:
-            # admission-time refusal: raising mid-step (inside _run_prefill)
+            # admission-time refusal: raising mid-step (inside _run_ragged)
             # would fail every in-flight sequence in the batch, not just
             # this request
             raise ValueError("multimodal requests are not supported under "
@@ -1416,63 +1411,41 @@ class AsyncJaxEngine:
             with annotate("dynamo.decode_pipeline"):
                 if await self._run_decode_pipelined(plan.decode):
                     return
-        if self._ragged and not plan.empty:
-            # one packed launch for the whole plan — prefill chunks and
-            # decode rows together (docs/performance.md ragged step)
-            t0 = time.perf_counter()
-            n_tok = sum(w.chunk for w in plan.prefill) + len(plan.decode)
-            with annotate("dynamo.ragged_step"):
-                padded = await self._run_ragged(plan)
-            wall = (time.perf_counter() - t0) * 1000
-            self.step_trace.append((
-                "ragged", len(plan.prefill) + len(plan.decode), n_tok,
-                wall, padded))
-            self._flight_record(
-                "ragged", wall, decode_rows=len(plan.decode),
-                prefill_chunks=len(plan.prefill),
-                chunk_tokens=sum(w.chunk for w in plan.prefill),
-                padded=padded, dispatch_ms=self._last_dispatch_ms,
-                qos_mix=self._plan_qos_mix(plan),
-                constrained=self._constrained_count(
-                    plan.decode + [w.seq for w in plan.prefill]),
-                decode_seqs=plan.decode,
-                prefill_seqs=[w.seq for w in plan.prefill])
+        if plan.empty:
             return
-        if plan.prefill:
-            t0 = time.perf_counter()
-            with annotate("dynamo.prefill_step"):
-                await self._run_prefill(plan.prefill)
-            wall = (time.perf_counter() - t0) * 1000
-            self.step_trace.append((
-                "prefill", len(plan.prefill),
-                sum(w.chunk for w in plan.prefill), wall))
-            # the bucketed path emits TWO records per plan (prefill +
-            # decode launches): the decode record owns the plan's
-            # starved-decode count and the decode rows' QoS mix — carrying
-            # them here too would double-count one starvation event
-            self._flight_record(
-                "prefill", wall, decode_rows=0,
-                prefill_chunks=len(plan.prefill),
-                chunk_tokens=sum(w.chunk for w in plan.prefill),
-                dispatch_ms=self._last_dispatch_ms, starved=0,
-                qos_mix=self._qos_mix_of([w.seq for w in plan.prefill]),
-                prefill_seqs=[w.seq for w in plan.prefill])
-        if plan.decode:
-            t0 = time.perf_counter()
-            gen0 = sum(s.generated for s in plan.decode)
-            with annotate("dynamo.decode_step"):
-                await self._run_decode(plan.decode)
-            wall = (time.perf_counter() - t0) * 1000
-            self.step_trace.append((
-                "decode", len(plan.decode),
-                sum(s.generated for s in plan.decode) - gen0, wall))
-            self._flight_record(
-                "decode", wall, decode_rows=len(plan.decode),
-                prefill_chunks=0, chunk_tokens=0,
-                dispatch_ms=self._last_dispatch_ms,
-                qos_mix=self._qos_mix_of(plan.decode),
-                constrained=self._constrained_count(plan.decode),
-                decode_seqs=plan.decode)
+        # decode-only plans may take the burst/spec fast paths (K tokens or
+        # a draft+verify round per dispatch) before falling back to the one
+        # packed launch below
+        if not plan.prefill and plan.decode:
+            if await self._run_decode_fast(plan.decode):
+                return
+        # one packed launch for the whole plan — prefill chunks and
+        # decode rows together (docs/performance.md ragged step). ONE
+        # flight record per plan: the record owns the plan's starvation
+        # count, QoS mix, and padded-token accounting.
+        t0 = time.perf_counter()
+        n_tok = sum(w.chunk for w in plan.prefill) + len(plan.decode)
+        with annotate("dynamo.ragged_step"):
+            padded = await self._run_ragged(plan)
+        wall = (time.perf_counter() - t0) * 1000
+        if not plan.prefill and plan.decode:
+            # plain decode step wall: the spec governor's cost baseline
+            self._decode_step_ms = (
+                wall if self._decode_step_ms is None
+                else 0.8 * self._decode_step_ms + 0.2 * wall)
+        self.step_trace.append((
+            "ragged", len(plan.prefill) + len(plan.decode), n_tok,
+            wall, padded))
+        self._flight_record(
+            "ragged", wall, decode_rows=len(plan.decode),
+            prefill_chunks=len(plan.prefill),
+            chunk_tokens=sum(w.chunk for w in plan.prefill),
+            padded=padded, dispatch_ms=self._last_dispatch_ms,
+            qos_mix=self._plan_qos_mix(plan),
+            constrained=self._constrained_count(
+                plan.decode + [w.seq for w in plan.prefill]),
+            decode_seqs=plan.decode,
+            prefill_seqs=[w.seq for w in plan.prefill])
 
     def step_trace_summary(self) -> dict:
         """Aggregate the timing ring: per kind, steps / seqs / tokens /
@@ -1639,20 +1612,17 @@ class AsyncJaxEngine:
 
     async def warmup(self, seq_lens: Optional[list] = None,
                      prefill_batches: Optional[list] = None) -> dict:
-        """AOT bucket precompile: one dummy dispatch per configured
-        (prefill-chunk × decode-batch) bucket signature, so the first REAL
-        request never eats an XLA compile — first-compile is the TTFT
-        p95-vs-p50 cliff this attacks.
+        """AOT precompile of the ragged token-bucket signatures, so the
+        first REAL request never eats an XLA compile — first-compile is the
+        TTFT p95-vs-p50 cliff this attacks.
 
-        ``seq_lens``: expected total sequence lengths (prompt + output) of
-        the workload; they choose the block-table-width buckets to trace
-        (default: max_model_len). Prefill buckets are traced at EVERY
-        power-of-two width from their own up to the workload width —
-        chunked continuations of a long prompt re-trace the chunk bucket
-        at growing table widths. ``prefill_batches``: expected concurrent
-        prefill row counts (default [1]); concurrent arrivals batch into
-        one call at bucket_batch(rows). Dummy writes land in the reserved
-        NULL block, whose contents are garbage by design. Must run BEFORE
+        The ragged step's whole signature space IS the token-bucket list
+        (R, W, and the chunk grid derive statically from T), so warmup is a
+        handful of traces instead of the old (chunk × batch × width)
+        bucketed lattice. ``seq_lens`` / ``prefill_batches`` are accepted
+        for API compatibility but choose nothing — the table width never
+        enters a ragged signature. Dummy writes land in the reserved NULL
+        block, whose contents are garbage by design. Must run BEFORE
         serving traffic (the dummy calls ride the same donated cache chain
         as real steps). Returns a report listing each compiled signature
         exactly once.
@@ -1677,21 +1647,9 @@ class AsyncJaxEngine:
                 "bucket warmup must run before serving traffic (sequences "
                 "are already scheduled)")
         args = self.args
-        lens = sorted({min(max(int(x), 1), args.max_model_len)
-                       for x in (seq_lens or [args.max_model_len])})
-        widths = sorted({args.bucket_table_width(x) for x in lens})
-        prefill_bs = sorted({args.bucket_batch(max(1, int(b)))
-                             for b in (prefill_batches or [1])})
         t_start = time.perf_counter()
 
         def run_ragged():
-            # the ragged step's whole signature space IS the token-bucket
-            # list: R and W derive statically from T, the table width never
-            # enters the signature (the kernel walks real pages, the XLA
-            # path's while-loop trip count follows real kv length) — so
-            # warmup is a handful of traces instead of the
-            # (chunk × batch × width) lattice, and seq_lens/prefill_batches
-            # have nothing left to choose.
             import jax.numpy as jnp
 
             from dynamo_tpu.engine.model import ragged_grid_shape
@@ -1708,16 +1666,34 @@ class AsyncJaxEngine:
                 rows3[0] = (0, 1, 1)  # one real row attending a NULL slot
                 bt = np.full((R, W), NULL_BLOCK, np.int32)
                 gr = np.zeros((C,), np.int32)
-                # both variants: the mixed step and the pipelined
-                # decode-only step
-                for kind, fn in (("ragged", self.ragged_fn),
-                                 ("ragged_dec", self.ragged_dec_fn)):
-                    logits, self.k_cache, self.v_cache = fn(
-                        self.params, jnp.asarray(ints5), jnp.asarray(rows3),
-                        jnp.asarray(gr), jnp.asarray(bt),
+                if self.pp_fn is not None:
+                    # pp: one packed microbatch stack per token bucket —
+                    # the signature is (T, M) with M fixed at pp_size
+                    Mmb = self._pp
+                    logits, self.k_cache, self.v_cache = self.pp_fn(
+                        self.params,
+                        jnp.asarray(np.broadcast_to(
+                            ints5, (Mmb, 5, T)).copy()),
+                        jnp.asarray(np.broadcast_to(
+                            rows3, (Mmb, R, 3)).copy()),
+                        jnp.asarray(np.broadcast_to(gr, (Mmb, C)).copy()),
+                        jnp.asarray(np.broadcast_to(
+                            bt, (Mmb, R, W)).copy()),
                         self.k_cache, self.v_cache)
-                    self.compiled_signatures.add((kind, T))
-                    report["ragged"].append((kind, T, R, W))
+                    logits = logits[0]
+                    self.compiled_signatures.add(("pp", T, Mmb))
+                    report["ragged"].append(("pp", T, R, W))
+                else:
+                    # both variants: the mixed step and the pipelined
+                    # decode-only step
+                    for kind, fn in (("ragged", self.ragged_fn),
+                                     ("ragged_dec", self.ragged_dec_fn)):
+                        logits, self.k_cache, self.v_cache = fn(
+                            self.params, jnp.asarray(ints5),
+                            jnp.asarray(rows3), jnp.asarray(gr),
+                            jnp.asarray(bt), self.k_cache, self.v_cache)
+                        self.compiled_signatures.add((kind, T))
+                        report["ragged"].append((kind, T, R, W))
                 if R not in sampled:
                     sampled.add(R)
                     toks, _ = self._sampling.sample_jit(
@@ -1728,87 +1704,10 @@ class AsyncJaxEngine:
                     report["sample"].append(R)
             return report
 
-        def run_all():
-            import jax.numpy as jnp
-
-            report: dict = {"prefill": [], "decode": [], "multi": [],
-                            "sample": []}
-            sampled_b: set = set()
-
-            def dispatch(B: int, S: int, W: int):
-                ints3 = np.zeros((B, 3, S), np.int32)
-                lens_last = np.zeros((B, 2), np.int32)
-                lens_last[:, 0] = 1  # kv_len 1: attend one NULL slot
-                bt = np.full((B, W), NULL_BLOCK, np.int32)
-                logits, self.k_cache, self.v_cache = self.step_fn(
-                    self.params, jnp.asarray(ints3), jnp.asarray(lens_last),
-                    jnp.asarray(bt), self.k_cache, self.v_cache)
-                self.compiled_signatures.add(("step", B, S, W))
-                return logits
-
-            def warm_sample(logits):
-                B = logits.shape[0]
-                if B in sampled_b:
-                    return
-                sampled_b.add(B)
-                toks, _ = self._sampling.sample_jit(
-                    logits, np.zeros((B,), np.float32),
-                    np.zeros((B,), np.int32), np.ones((B,), np.float32),
-                    self._sampling.make_keys([0] * B, [0] * B))
-                np.asarray(toks)  # block: this signature's compile is done
-                report["sample"].append(B)
-
-            for S in args.prefill_buckets:
-                # width range: the chunk's own width plus every reachable
-                # step up to the workload width (chunk N of a long prompt
-                # keeps bucket S while its table width grows) — derived via
-                # bucket_table_width so the max_blocks_per_seq cap matches
-                # what serving will actually request
-                ws = {args.bucket_table_width(S)}
-                t = S
-                while t < max(lens):
-                    t = min(t * 2, max(lens))
-                    ws.add(args.bucket_table_width(t))
-                for B in prefill_bs:
-                    for W in sorted(ws):
-                        logits = dispatch(B, S, W)
-                        report["prefill"].append((B, S, W))
-                        warm_sample(logits)
-            for B in args.decode_batch_buckets:
-                for W in widths:
-                    logits = dispatch(B, 1, W)
-                    report["decode"].append((B, W))
-                    warm_sample(logits)
-            if self.multi_fn is not None:
-                for B in args.decode_batch_buckets:
-                    for W in widths:
-                        ints = np.zeros((B, 4), np.int32)
-                        ints[:, 2] = 1  # kv_lens
-                        floats = np.zeros((B, 2), np.float32)
-                        floats[:, 1] = 1.0  # top_p off
-                        rand = np.zeros((B, 2), np.uint32)
-                        bt = np.full((B, W), NULL_BLOCK, np.int32)
-                        toks, _, self.k_cache, self.v_cache = self.multi_fn(
-                            self.params, jnp.asarray(ints),
-                            jnp.asarray(floats), jnp.asarray(rand),
-                            jnp.asarray(bt), self.k_cache, self.v_cache)
-                        np.asarray(toks)
-                        self.compiled_signatures.add(("multi", B, W))
-                        report["multi"].append((B, W))
-            return report
-
-        report = await asyncio.to_thread(
-            run_ragged if self._ragged else run_all)
+        report = await asyncio.to_thread(run_ragged)
         report["seconds"] = round(time.perf_counter() - t_start, 2)
-        if self._ragged:
-            logger.info("ragged warmup: %d token-bucket signatures in %.1fs",
-                        len(report["ragged"]), report["seconds"])
-        else:
-            logger.info(
-                "bucket warmup: %d prefill + %d decode + %d multi "
-                "signatures in %.1fs", len(report["prefill"]),
-                len(report["decode"]), len(report["multi"]),
-                report["seconds"])
+        logger.info("ragged warmup: %d token-bucket signatures in %.1fs",
+                    len(report["ragged"]), report["seconds"])
         return report
 
     # ------------------------------------------------------------- prefill
@@ -1833,135 +1732,6 @@ class AsyncJaxEngine:
                     mask[0, p - start] = True
         return (vec, mask) if vec is not None else None
 
-    def _get_step_mm_fn(self):
-        if self._step_mm_fn is None:
-            if self._pp > 1:
-                # backstop only — _new_seq refuses mm requests at admission
-                # under pp, so this cannot fire from the serving path
-                raise ValueError(
-                    "multimodal requests are not supported under pipeline "
-                    "parallelism yet")
-            from dynamo_tpu.engine import model as M
-
-            self._step_mm_fn = M.make_step_mm_fn(
-                self.cfg, self.args.block_size, self.mesh,
-                use_pallas=self.args.use_pallas_attention,
-                replicate_logits=self._multihost,
-                kv_quant=self._kv_quant)
-        return self._step_mm_fn
-
-    async def _run_prefill(self, works: list) -> None:
-        """Execute a BATCH of prefill chunks as rows of one jitted step —
-        the scheduler groups same-bucket chunks so concurrent prompts do
-        not serialize one-prefill-per-step."""
-        import jax.numpy as jnp
-
-        self.param_reads += 1
-
-        args = self.args
-        bs = args.block_size
-        B = args.bucket_batch(len(works))
-        S = args.bucket_tokens(max(w.chunk for w in works))
-        max_end = max(w.start + w.chunk for w in works)
-        W = args.bucket_table_width(max_end)
-
-        tokens = np.zeros((B, S), np.int32)
-        positions = np.zeros((B, S), np.int32)
-        slot_map = np.zeros((B, S), np.int32)
-        bt = np.full((B, W), NULL_BLOCK, np.int32)
-        kv_lens = np.zeros((B,), np.int32)
-        last_idx = np.zeros((B,), np.int32)
-        mm_vec = mm_mask = None
-        for i, w in enumerate(works):
-            seq, start, chunk = w.seq, w.start, w.chunk
-            end = start + chunk
-            tokens[i, :chunk] = seq.tokens[start:end]
-            positions[i, :chunk] = np.arange(start, end)
-            for j, pos in enumerate(range(start, end)):
-                slot_map[i, j] = seq.block_table[pos // bs] * bs + pos % bs
-            n = min(len(seq.block_table), W)
-            bt[i, :n] = seq.block_table[:n]
-            kv_lens[i] = end
-            last_idx[i] = chunk - 1
-            mm = self._mm_arrays(seq, start, end, S)
-            if mm is not None:
-                if mm_vec is None:
-                    mm_vec = np.zeros((B, S, self.cfg.hidden_size), np.float32)
-                    mm_mask = np.zeros((B, S), bool)
-                mm_vec[i], mm_mask[i] = mm[0][0], mm[1][0]
-
-        # packed operands: 3 transfers per prefill step instead of 6 (the
-        # burst-packing pattern; ~12 ms per small put over a tunneled chip)
-        ints3 = np.stack([tokens, positions, slot_map], axis=1)
-        lens_last = np.stack([kv_lens, last_idx], axis=1)
-        operands = {"ints3": ints3, "lens_last": lens_last,
-                    "block_tables": bt}
-        if mm_vec is not None:
-            operands["mm_vec"], operands["mm_mask"] = mm_vec, mm_mask
-            kind, fn = "step_mm", self._get_step_mm_fn()
-        else:
-            kind, fn = "step", self.step_fn
-        new_sig = (kind, B, S, W) not in self.compiled_signatures
-        self.compiled_signatures.add((kind, B, S, W))
-        self.padded_tokens_total += B * S - sum(w.chunk for w in works)
-        self._broadcast(kind, **operands)
-        t0d = time.perf_counter()
-        logits, self.k_cache, self.v_cache = fn(
-            self.params,
-            *(self._put_batch(k, v) for k, v in operands.items()),
-            self.k_cache, self.v_cache)
-        self._last_dispatch_ms = (time.perf_counter() - t0d) * 1000
-        if new_sig:
-            self._note_compile(kind, (B, S, W),
-                               time.perf_counter() - t0d)
-
-        for w in works:
-            seq, end = w.seq, w.start + w.chunk
-            self.scheduler.commit_computed(seq, end)
-            if seq.progress_cb is not None:
-                try:
-                    seq.progress_cb(end)
-                except Exception:
-                    # shipping is an optimization: stop it for THIS seq (the
-                    # tail bundle covers whatever wasn't shipped) instead of
-                    # letting the failure abort every in-flight sequence via
-                    # _run's blanket handler
-                    logger.exception("prefill progress callback failed; "
-                                     "disabling chunk shipping for %s",
-                                     seq.request_id)
-                    seq.progress_cb = None
-
-        sample_rows = [(i, w.seq) for i, w in enumerate(works) if w.sample]
-        if sample_rows:
-            rows = [i for i, _ in sample_rows]
-            if rows == list(range(len(works))):
-                # common case (non-chunked prompts): every row samples —
-                # _sample tolerates padded B >= len(seqs), no gather needed
-                sel = logits
-                rows = None
-            else:
-                # gather the sampling rows, padded to a batch bucket so the
-                # sampling jit sees a bounded set of shapes. Under
-                # multi-host the gather must be host-side (a leader-only
-                # device op on the replicated global array would never be
-                # mirrored by the follower ranks) AND off the event loop
-                # (the host sync would stall the step broadcaster task) —
-                # _sample's worker thread does it when given ``rows``
-                Bp = args.bucket_batch(len(rows))
-                rows = rows + [rows[0]] * (Bp - len(rows))
-                if self._multihost:
-                    sel = logits  # gathered host-side in run_sampling
-                else:
-                    sel = logits[jnp.asarray(rows, jnp.int32)]
-                    rows = None
-            seqs = [s for _, s in sample_rows]
-            toks, logps, tops = await self._sample(seqs, sel, rows=rows)
-            for j, (_, seq) in enumerate(sample_rows):
-                self._deliver(seq, int(toks[j]), float(logps[j]), tops.get(j))
-        else:
-            # no chunk reached its end: logits unused, sync to pace the loop
-            await asyncio.to_thread(lambda: logits.block_until_ready())
-
     # -------------------------------------------------------- ragged step
 
     def _get_ragged_mm_fn(self):
@@ -1975,6 +1745,16 @@ class AsyncJaxEngine:
                 kv_quant=self._kv_quant, mm=True)
         return self._ragged_mm_fn
 
+    def _get_verify_masked_fn(self):
+        if self._verify_masked_fn is None:
+            from dynamo_tpu.engine import model as M
+
+            self._verify_masked_fn = M.make_ragged_verify_fn(
+                self.cfg, self.args.block_size, self.mesh,
+                replicate_outputs=self._multihost,
+                kv_quant=self._kv_quant, masked=True)
+        return self._verify_masked_fn
+
     async def _run_ragged(self, plan: StepPlan) -> int:
         """Execute the WHOLE plan — decode rows and prefill chunks — as one
         packed ragged launch (ops/ragged_attention.py; docs/performance.md).
@@ -1983,7 +1763,11 @@ class AsyncJaxEngine:
         per-row (q_start, q_len, kv_len) metadata; nothing pads to a
         chunk/batch/width bucket, so the only waste is the tail of the one
         token bucket (returned, for the step trace / padded-tokens metric).
+        Under pipeline parallelism the plan splits into M packed ragged
+        microbatches instead (_run_ragged_pp).
         """
+        if self.pp_fn is not None:
+            return await self._run_ragged_pp(plan)
         import jax.numpy as jnp
 
         from dynamo_tpu.engine.model import ragged_grid_shape
@@ -2108,6 +1892,129 @@ class AsyncJaxEngine:
             self._deliver(seq, int(toks[j]), float(logps[j]), tops.get(j))
         return T - total
 
+    async def _run_ragged_pp(self, plan: StepPlan) -> int:
+        """The pipeline-parallel ragged step: the plan's rows split into
+        M = pp_size packed ragged microbatches (longest-first greedy into
+        the lightest bin, so the GPipe ticks stay balanced), each bin laid
+        out exactly like the single-bin packed launch. The compiled
+        signature is (T, M) — T covers the HEAVIEST bin, M is fixed — so
+        pp serving warms the same token-bucket family as everything else.
+        """
+        import jax.numpy as jnp
+
+        from dynamo_tpu.engine.model import ragged_grid_shape
+
+        args = self.args
+        bs = args.block_size
+        works = plan.prefill
+        Mmb = self._pp
+        rows_all = [(s, True, None) for s in plan.decode]
+        rows_all += [(w.seq, w.sample, w) for w in works]
+
+        def ntok(row):
+            return 1 if row[2] is None else row[2].chunk
+
+        bins: list[list] = [[] for _ in range(Mmb)]
+        loads = [0] * Mmb
+        for row in sorted(rows_all, key=ntok, reverse=True):
+            m = loads.index(min(loads))
+            bins[m].append(row)
+            loads[m] += ntok(row)
+        total = sum(loads)
+        T = args.bucket_ragged_tokens(max(1, max(loads)))
+        R = args.ragged_rows(T)
+        W = args.max_blocks_per_seq
+        C, S_C = ragged_grid_shape(T)
+        self.param_reads += 1
+        self.padded_tokens_total += Mmb * T - total
+
+        ints5 = np.zeros((Mmb, 5, T), np.int32)
+        ints5[:, 3] = C
+        rows3 = np.zeros((Mmb, R, 3), np.int32)
+        grid_rows = np.zeros((Mmb, C), np.int32)
+        bt = np.full((Mmb, R, W), NULL_BLOCK, np.int32)
+        #: (bin, row-in-bin, seq) for every sampling row, bin pack order
+        sample_rows = []
+        for m, rows in enumerate(bins):
+            t = 0
+            tile = 0
+            for i, (seq, sample, w) in enumerate(rows):
+                if w is None:
+                    start, chunk = len(seq.tokens) - 1, 1
+                else:
+                    start, chunk = w.start, w.chunk
+                    if seq.req.mm_embeds:
+                        # backstop only — _new_seq refuses mm requests at
+                        # admission under pp
+                        raise RuntimeError(
+                            "multimodal prefill is not supported under "
+                            "pipeline parallelism")
+                end = start + chunk
+                ints5[m, 0, t:t + chunk] = seq.tokens[start:end]
+                ints5[m, 1, t:t + chunk] = np.arange(start, end)
+                for j, pos in enumerate(range(start, end)):
+                    ints5[m, 2, t + j] = (seq.block_table[pos // bs] * bs
+                                          + pos % bs)
+                if chunk > 1:
+                    for off in range(0, chunk, S_C):
+                        width = min(S_C, chunk - off)
+                        grid_rows[m, tile] = i
+                        ints5[m, 3, t + off:t + off + width] = tile
+                        ints5[m, 4, t + off:t + off + width] = (
+                            np.arange(width))
+                        tile += 1
+                rows3[m, i] = (t, chunk, end)
+                n = min(len(seq.block_table), W)
+                bt[m, i, :n] = seq.block_table[:n]
+                if sample:
+                    sample_rows.append((m, i, seq))
+                t += chunk
+            assert tile <= C, f"chunk grid overflow: {tile} > {C}"
+
+        operands = {"ints5": ints5, "rows3": rows3, "grid_rows": grid_rows,
+                    "block_tables": bt}
+        new_sig = ("pp", T, Mmb) not in self.compiled_signatures
+        self.compiled_signatures.add(("pp", T, Mmb))
+        self._broadcast("pp", **operands)
+        t0d = time.perf_counter()
+        logits, self.k_cache, self.v_cache = self.pp_fn(
+            self.params,
+            *(self._put_batch(k, v) for k, v in operands.items()),
+            self.k_cache, self.v_cache)
+        self._last_dispatch_ms = (time.perf_counter() - t0d) * 1000
+        if new_sig:
+            self._note_compile("pp", (T, Mmb), time.perf_counter() - t0d)
+
+        # commit BEFORE sampling, exactly like the single-bin launch
+        for w in works:
+            seq, end = w.seq, w.start + w.chunk
+            self.scheduler.commit_computed(seq, end)
+            if seq.progress_cb is not None:
+                try:
+                    seq.progress_cb(end)
+                except Exception:
+                    logger.exception("prefill progress callback failed; "
+                                     "disabling chunk shipping for %s",
+                                     seq.request_id)
+                    seq.progress_cb = None
+        for s in plan.decode:
+            self.scheduler.commit_computed(s, len(s.tokens))
+
+        if not sample_rows:
+            await asyncio.to_thread(lambda: logits.block_until_ready())
+            return Mmb * T - total
+        # logits land [M, R, V]: flatten and gather the sampling rows,
+        # padded to a batch bucket so the sampling jit sees bounded shapes
+        idx = [m * R + i for m, i, _ in sample_rows]
+        Bp = args.bucket_batch(len(idx))
+        flat = logits.reshape(Mmb * R, logits.shape[-1])
+        sel = flat[jnp.asarray(idx + [idx[0]] * (Bp - len(idx)), jnp.int32)]
+        seqs = [s for _m, _i, s in sample_rows]
+        toks, logps, tops = await self._sample(seqs, sel)
+        for j, (_m, _i, seq) in enumerate(sample_rows):
+            self._deliver(seq, int(toks[j]), float(logps[j]), tops.get(j))
+        return Mmb * T - total
+
     # -------------------------------------------------------------- decode
 
     # ---------------------------------------------- speculative decoding
@@ -2166,9 +2073,10 @@ class AsyncJaxEngine:
         KV lands in the tokens' real slots — blocks are already
         preallocated by the caller."""
         args = self.args
-        B = args.bucket_batch(len(seqs))
-        max_kv = max(len(s.tokens) for s in seqs) + K
-        W = args.bucket_table_width(max_kv)
+        # ragged-family signature, like the multi burst: row bucket from
+        # the token bucket, static table width
+        B = args.ragged_rows(args.bucket_ragged_tokens(len(seqs)))
+        W = args.max_blocks_per_seq
 
         last_tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
@@ -2182,7 +2090,7 @@ class AsyncJaxEngine:
             kv_lens[i] = len(s.tokens)
 
         ints = np.stack([last_tokens, positions, kv_lens], axis=1)
-        self.compiled_signatures.add(("draft", B, W))
+        self.compiled_signatures.add(("draft", B))
         self._broadcast("draft", ints=ints, block_tables=bt)
         toks, self.k_cache, self.v_cache = self.draft_fn(
             self.params, self._put_batch("ints", ints),
@@ -2202,121 +2110,174 @@ class AsyncJaxEngine:
         seq drafts anything or block preallocation fails."""
         args = self.args
         K = args.speculative_tokens
+        t0 = time.perf_counter()
         if self.draft_fn is not None:
             # the draft model writes KV into the draft slots, so blocks
             # must exist BEFORE drafting
             if not self._prealloc_blocks(seqs, K):
                 return False
             drafts = await self._run_draft_model(seqs, K)
-            return await self._verify_and_commit(seqs, drafts)
-        drafts = [self._draft_tokens(s, K) for s in seqs]
-        if not any(drafts):
-            return False
-        if not self._prealloc_blocks(seqs, K):
-            return False
-        return await self._verify_and_commit(seqs, drafts)
+        else:
+            drafts = [self._draft_tokens(s, K) for s in seqs]
+            if not any(drafts):
+                return False
+            if not self._prealloc_blocks(seqs, K):
+                return False
+        ok = await self._verify_and_commit(seqs, drafts)
+        if ok:
+            # measured spec round (draft + verify + host round trip): the
+            # governor's cost re-baseline (_spec_dispatch_cost)
+            wall = (time.perf_counter() - t0) * 1000
+            self._spec_round_ms = (
+                wall if self._spec_round_ms is None
+                else 0.8 * self._spec_round_ms + 0.2 * wall)
+        return ok
 
     async def _verify_and_commit(self, seqs: list[SeqState],
                                  drafts: list[list[int]]) -> bool:
+        """Verify ON the packed ragged layout: each seq is one ragged row
+        with q_len = draft+1, so verify shares the serving step's
+        token-bucket signature family instead of its own [B, S, W]
+        lattice. Every verify row is a chunk (q_len > 1) occupying
+        ceil(S / tile) chunk-grid tiles; the token bucket is chosen as the
+        smallest that holds both the packed tokens AND the needed tiles,
+        dispatching in groups when even the largest bucket cannot."""
+        from dynamo_tpu.engine.model import ragged_grid_shape
+
         args = self.args
         K = args.speculative_tokens
-
-        B = args.bucket_batch(len(seqs))
         S = 1 + K
         bs = args.block_size
-        max_kv = max(len(s.tokens) for s in seqs) + K
-        W = args.bucket_table_width(max_kv)
 
-        tokens = np.zeros((B, S), np.int32)
-        positions = np.zeros((B, S), np.int32)
-        slot_map = np.zeros((B, S), np.int32)
-        bt = np.full((B, W), NULL_BLOCK, np.int32)
-        kv_lens = np.zeros((B,), np.int32)
-        for i, s in enumerate(seqs):
-            row = [s.tokens[-1]] + drafts[i] + [0] * (K - len(drafts[i]))
-            base = len(s.tokens) - 1
-            tokens[i] = row
-            positions[i] = base + np.arange(S)
-            for j in range(S):
-                p = base + j
-                slot_map[i, j] = s.block_table[p // bs] * bs + p % bs
-            n = min(len(s.block_table), W)
-            bt[i, :n] = s.block_table[:n]
-            kv_lens[i] = len(s.tokens) + K
+        def bucket_for(n: int):
+            # smallest token bucket with n*S tokens AND n chunk rows' tiles
+            for cand in args.ragged_token_buckets:
+                C, S_C = ragged_grid_shape(cand)
+                if cand >= n * S and n * -(-S // S_C) <= C:
+                    return cand
+            return None
 
-        ints3 = np.stack([tokens, positions, slot_map], axis=1)
-        cursors = [_guided_fsm(s) for s in seqs]
-        use_fsm = any(c is not None for c in cursors)
-        self.compiled_signatures.add(
-            ("verify_fsm" if use_fsm else "verify", B, S, W))
-        self.padded_tokens_total += (B - len(seqs)) * S
-        self._broadcast("verify", ints3=ints3, block_tables=bt,
-                        kv_lens=kv_lens)
-        if use_fsm:
-            # constrained rows verify under per-position FSM masks: walk
-            # each cursor's compiled table along its draft host-side (O(K)
-            # lookups, no device round trip) — a draft token the mask
-            # forbids can never match the masked argmax, so it is rejected
-            # at its position exactly as masked single-step decode would
-            # reject it, and the bonus token at the first mismatch is drawn
-            # from the correctly-advanced state's mask.
-            import jax.numpy as _jnp
-            if self._verify_masked_fn is None:
-                from dynamo_tpu.engine import model as M
-                self._verify_masked_fn = M.make_verify_fn(
-                    self.cfg, args.block_size, self.mesh,
-                    replicate_outputs=self._multihost,
-                    kv_quant=self._kv_quant, masked=True)
-            W32 = self.structured.W32
-            mw = np.empty((B, S, W32), np.uint32)
-            mw[:] = np.uint32(0xFFFFFFFF)  # free/padded rows: identity
-            for i, c in enumerate(cursors):
-                if c is None:
-                    continue
-                fsm = c.seg.fsm
-                st = 0 if c.done else (c.state - c.seg.offset)
-                for j in range(S):
-                    mw[i, j] = fsm.mask[st]
-                    if j < len(drafts[i]):
-                        t = drafts[i][j]
-                        if t in c._eos_set or not 0 <= t < fsm.V:
-                            st = 0
-                        else:
-                            st = int(fsm.next[st, t])
-            ids, lps, self.k_cache, self.v_cache = self._verify_masked_fn(
-                self.params, self._put_batch("ints3", ints3),
-                self._put_batch("block_tables", bt),
-                self._put_batch("kv_lens", kv_lens),
-                _jnp.asarray(mw), self.k_cache, self.v_cache)
+        T_all = bucket_for(len(seqs))
+        if T_all is not None:
+            groups = [list(range(len(seqs)))]
         else:
-            ids, lps, self.k_cache, self.v_cache = self.verify_fn(
-                self.params, self._put_batch("ints3", ints3),
-                self._put_batch("block_tables", bt),
-                self._put_batch("kv_lens", kv_lens),
-                self.k_cache, self.v_cache)
-        ids, lps = await asyncio.to_thread(
-            lambda: (np.asarray(ids), np.asarray(lps)))
+            Tmax = args.ragged_token_buckets[-1]
+            C, S_C = ragged_grid_shape(Tmax)
+            cap = max(1, min(C // -(-S // S_C), Tmax // S))
+            groups = [list(range(i, min(i + cap, len(seqs))))
+                      for i in range(0, len(seqs), cap)]
 
         total_emitted = 0
-        for i, s in enumerate(seqs):
-            d = drafts[i]
-            accepted = 0
-            while accepted < len(d) and d[accepted] == int(ids[i, accepted]):
-                accepted += 1
-            # emit accepted drafts + the corrected/bonus token as ONE
-            # coalesced output; each commit marks the CURRENT tokens' KV
-            # resident (the verify step computed it — accepted drafts equal
-            # the real tokens) before the next append
-            emitted = self._deliver_batch(s, ids[i, :accepted + 1],
-                                          lps[i, :accepted + 1])
-            # count what was actually DELIVERED — a seq finishing mid-burst
-            # must not inflate acceptance telemetry
-            self.spec_stats.num_drafts += 1
-            self.spec_stats.num_draft_tokens += len(d)
-            self.spec_stats.num_accepted_tokens += min(accepted, emitted)
-            self.spec_stats.num_spec_tokens += emitted
-            total_emitted += emitted
-        self.param_reads += 1
+        for grp in groups:
+            n = len(grp)
+            T = T_all if T_all is not None else bucket_for(n)
+            R = args.ragged_rows(T)
+            W = args.max_blocks_per_seq
+            C, S_C = ragged_grid_shape(T)
+            ints5 = np.zeros((5, T), np.int32)
+            ints5[3] = C  # padding tokens: grid dump tile
+            rows3 = np.zeros((R, 3), np.int32)
+            grid_rows = np.zeros((C,), np.int32)
+            bt = np.full((R, W), NULL_BLOCK, np.int32)
+            t = 0
+            tile = 0
+            for i, gi in enumerate(grp):
+                s = seqs[gi]
+                d = drafts[gi]
+                row = [s.tokens[-1]] + d + [0] * (K - len(d))
+                base = len(s.tokens) - 1
+                ints5[0, t:t + S] = row
+                ints5[1, t:t + S] = base + np.arange(S)
+                for j in range(S):
+                    p = base + j
+                    ints5[2, t + j] = s.block_table[p // bs] * bs + p % bs
+                for off in range(0, S, S_C):
+                    width = min(S_C, S - off)
+                    grid_rows[tile] = i
+                    ints5[3, t + off:t + off + width] = tile
+                    ints5[4, t + off:t + off + width] = np.arange(width)
+                    tile += 1
+                rows3[i] = (t, S, len(s.tokens) + K)
+                nblk = min(len(s.block_table), W)
+                bt[i, :nblk] = s.block_table[:nblk]
+                t += S
+            assert tile <= C, f"verify grid overflow: {tile} > {C}"
+
+            cursors = [_guided_fsm(seqs[gi]) for gi in grp]
+            use_fsm = any(c is not None for c in cursors)
+            self.compiled_signatures.add(
+                ("verify_fsm" if use_fsm else "verify", T))
+            self.padded_tokens_total += T - n * S
+            operands = {"ints5": ints5, "rows3": rows3,
+                        "grid_rows": grid_rows, "block_tables": bt}
+            if use_fsm:
+                # constrained rows verify under per-position FSM masks:
+                # walk each cursor's compiled table along its draft
+                # host-side (O(K) lookups, no device round trip) — a draft
+                # token the mask forbids can never match the masked argmax,
+                # so it is rejected at its position exactly as masked
+                # single-step decode would reject it, and the bonus token
+                # at the first mismatch is drawn from the correctly-
+                # advanced state's mask.
+                self._get_verify_masked_fn()
+                W32 = self.structured.W32
+                mw = np.empty((T, W32), np.uint32)
+                mw[:] = np.uint32(0xFFFFFFFF)  # padding tokens: identity
+                for i, c in enumerate(cursors):
+                    if c is None:
+                        continue
+                    d = drafts[grp[i]]
+                    fsm = c.seg.fsm
+                    st = 0 if c.done else (c.state - c.seg.offset)
+                    for j in range(S):
+                        mw[i * S + j] = fsm.mask[st]
+                        if j < len(d):
+                            tok = d[j]
+                            if tok in c._eos_set or not 0 <= tok < fsm.V:
+                                st = 0
+                            else:
+                                st = int(fsm.next[st, tok])
+                operands["mask_words"] = mw
+                self._broadcast("verify_fsm", **operands)
+                ids, lps, self.k_cache, self.v_cache = (
+                    self._verify_masked_fn(
+                        self.params,
+                        *(self._put_batch(k, v)
+                          for k, v in operands.items()),
+                        self.k_cache, self.v_cache))
+            else:
+                self._broadcast("verify", **operands)
+                ids, lps, self.k_cache, self.v_cache = self.verify_fn(
+                    self.params,
+                    *(self._put_batch(k, v) for k, v in operands.items()),
+                    self.k_cache, self.v_cache)
+            ids, lps = await asyncio.to_thread(
+                lambda: (np.asarray(ids), np.asarray(lps)))
+
+            for i, gi in enumerate(grp):
+                s = seqs[gi]
+                d = drafts[gi]
+                q0 = i * S
+                row_ids = ids[q0:q0 + S]
+                row_lps = lps[q0:q0 + S]
+                accepted = 0
+                while (accepted < len(d)
+                       and d[accepted] == int(row_ids[accepted])):
+                    accepted += 1
+                # emit accepted drafts + the corrected/bonus token as ONE
+                # coalesced output; each commit marks the CURRENT tokens'
+                # KV resident (the verify step computed it — accepted
+                # drafts equal the real tokens) before the next append
+                emitted = self._deliver_batch(s, row_ids[:accepted + 1],
+                                              row_lps[:accepted + 1])
+                # count what was actually DELIVERED — a seq finishing
+                # mid-burst must not inflate acceptance telemetry
+                self.spec_stats.num_drafts += 1
+                self.spec_stats.num_draft_tokens += len(d)
+                self.spec_stats.num_accepted_tokens += min(accepted, emitted)
+                self.spec_stats.num_spec_tokens += emitted
+                total_emitted += emitted
+            self.param_reads += 1
         self._note_spec_result(total_emitted, len(seqs))
         return True
 
@@ -2329,16 +2290,29 @@ class AsyncJaxEngine:
         return self.steps >= self._spec_resume_step
 
     def _spec_dispatch_cost(self) -> float:
-        """Estimated dispatch cost of one draft+verify round relative to a
-        plain decode step (both read the weights once; layer-skip drafting
-        adds draft_layers/num_layers of a forward per drafted token)."""
+        """Dispatch cost of one draft+verify round relative to a plain
+        decode step. Re-baselined on MEASURED ragged dispatch walls: a
+        verify row is just one more ragged chunk in the packed launch, so
+        the static bucketed-dispatch constants below OVERESTIMATE its cost
+        and made the governor suspend speculation too eagerly. When both
+        EWMAs exist the measured ratio is used, floored at 1.01 (a round
+        computes strictly more than a decode step) and capped at the
+        static estimate (measurement only ever CHEAPENS spec — a noisy
+        high sample must not suspend harder than the old model did)."""
         args = self.args
         if (args.speculative_method == "draft_layers"
                 and args.speculative_draft_layers > 0):
-            return 1.0 + (args.speculative_tokens
-                          * args.speculative_draft_layers
-                          / max(1, self.cfg.num_layers))
-        return 1.05  # prompt lookup: free drafts, small verify overhead
+            static = 1.0 + (args.speculative_tokens
+                            * args.speculative_draft_layers
+                            / max(1, self.cfg.num_layers))
+        else:
+            static = 1.05  # prompt lookup: free drafts, small overhead
+        if (self._spec_round_ms is not None
+                and self._decode_step_ms is not None
+                and self._decode_step_ms > 0):
+            return min(static,
+                       max(1.01, self._spec_round_ms / self._decode_step_ms))
+        return static
 
     def _note_spec_result(self, emitted: int, n_seqs: int) -> None:
         """Feed the governor one verify dispatch's outcome. When the mean
@@ -2367,7 +2341,7 @@ class AsyncJaxEngine:
                 / max(1, self.spec_stats.num_draft_tokens),
                 self.args.spec_reprobe_steps)
 
-    async def _run_decode(self, seqs: list[SeqState]) -> None:
+    async def _run_decode_fast(self, seqs: list[SeqState]) -> bool:
         # Burst/spec paths gate on the DECODE SUBSET only — not on a
         # globally-idle scheduler. The old `not waiting and all(running)`
         # gate meant any queued or mid-prefill request demoted every other
@@ -2379,6 +2353,11 @@ class AsyncJaxEngine:
         # (~bounded TTFT cost) and buys K× fewer host round trips.
         # (plan.decode already contains only remaining==1 seqs — the
         # scheduler guarantees it, no per-step re-check needed)
+        # Returns True when a fast path consumed the plan (with its own
+        # flight record); False → the caller's packed ragged launch runs.
+        t0 = time.perf_counter()
+        gen0 = sum(s.generated for s in seqs)
+        kind = None
         if (self.verify_fn is not None and seqs and self._spec_active()
                 and all(s.sampling_tuple()[0] == 0.0 for s in seqs)
                 and all(s.req.output_options.logprobs is None for s in seqs)
@@ -2392,9 +2371,8 @@ class AsyncJaxEngine:
                          or s.req.stop_conditions.max_tokens - s.generated >= 2)
                         for s in seqs)
                 and await self._run_spec_decode(seqs)):
-            return
-        K = self.args.multi_step_decode
-        if (self.multi_fn is not None and seqs
+            kind = "spec"
+        elif (self.multi_fn is not None and seqs
                 # top-k capture and logit_bias need host-visible logits:
                 # the burst keeps them on device, so those requests take
                 # the single-step path
@@ -2410,54 +2388,20 @@ class AsyncJaxEngine:
                 # single-step dispatch round trips whenever any one stream
                 # was finishing — under continuous load, constantly
                 and await self._run_multi_decode(seqs)):
-            return
-        import jax.numpy as jnp
-
-        args = self.args
-        B = args.bucket_batch(len(seqs))
-        bs = args.block_size
-        max_kv = max(len(s.tokens) for s in seqs)
-        W = args.bucket_table_width(max_kv)
-
-        tokens = np.zeros((B, 1), np.int32)
-        positions = np.zeros((B, 1), np.int32)
-        slot_map = np.zeros((B, 1), np.int32)
-        bt = np.full((B, W), NULL_BLOCK, np.int32)
-        kv_lens = np.zeros((B,), np.int32)
-        last_idx = np.zeros((B,), np.int32)
-
-        for i, s in enumerate(seqs):
-            pos = len(s.tokens) - 1
-            tokens[i, 0] = s.tokens[-1]
-            positions[i, 0] = pos
-            slot_map[i, 0] = s.block_table[pos // bs] * bs + pos % bs
-            n = min(len(s.block_table), W)
-            bt[i, :n] = s.block_table[:n]
-            kv_lens[i] = len(s.tokens)
-
-        ints3 = np.stack([tokens, positions, slot_map], axis=1)
-        lens_last = np.stack([kv_lens, last_idx], axis=1)
-        new_sig = ("step", B, 1, W) not in self.compiled_signatures
-        self.compiled_signatures.add(("step", B, 1, W))
-        self.padded_tokens_total += B - len(seqs)
-        self._broadcast("step", ints3=ints3, lens_last=lens_last,
-                        block_tables=bt)
-        self.param_reads += 1
-        t0d = time.perf_counter()
-        logits, self.k_cache, self.v_cache = self.step_fn(
-            self.params, self._put_batch("ints3", ints3),
-            self._put_batch("lens_last", lens_last),
-            self._put_batch("block_tables", bt),
-            self.k_cache, self.v_cache)
-        self._last_dispatch_ms = (time.perf_counter() - t0d) * 1000
-        if new_sig:
-            self._note_compile("step", (B, 1, W),
-                               time.perf_counter() - t0d)
-
-        toks, logps, tops = await self._sample(seqs, logits)
-        for i, s in enumerate(seqs):
-            self.scheduler.commit_computed(s, len(s.tokens))
-            self._deliver(s, int(toks[i]), float(logps[i]), tops.get(i))
+            kind = "multi"
+        if kind is None:
+            return False
+        wall = (time.perf_counter() - t0) * 1000
+        self.step_trace.append((
+            kind, len(seqs), sum(s.generated for s in seqs) - gen0, wall))
+        self._flight_record(
+            kind, wall, decode_rows=len(seqs),
+            prefill_chunks=0, chunk_tokens=0,
+            dispatch_ms=self._last_dispatch_ms,
+            qos_mix=self._qos_mix_of(seqs),
+            constrained=self._constrained_count(seqs),
+            decode_seqs=seqs)
+        return True
 
     # ------------------------------------------------- pipelined decode loop
 
@@ -2512,28 +2456,21 @@ class AsyncJaxEngine:
             # table must cover len+off tokens
             if not self.scheduler._ensure_blocks(s, len(s.tokens) + off):
                 return None
-        R = None
-        if self._ragged:
-            # ragged layout: decode row i is the single packed token at
-            # flat index i — the feed substitution lands on ints5[0, :n].
-            # Token arrays size to the T bucket, row/sampling/table arrays
-            # to the (statically derived, R <= T) row count — the hot loop
-            # must not memset T-bucket-sized host buffers it never reads.
-            B = args.bucket_ragged_tokens(len(seqs))
-            R = args.ragged_rows(B)
-            W = args.max_blocks_per_seq
-        else:
-            B = args.bucket_batch(len(seqs))
-            max_kv = max(len(s.tokens) + off for s in seqs)
-            W = args.bucket_table_width(max_kv)
+        # ragged layout: decode row i is the single packed token at
+        # flat index i — the feed substitution lands on ints5[0, :n].
+        # Token arrays size to the T bucket, row/sampling/table arrays
+        # to the (statically derived, R <= T) row count — the hot loop
+        # must not memset T-bucket-sized host buffers it never reads.
+        B = args.bucket_ragged_tokens(len(seqs))
+        R = args.ragged_rows(B)
+        W = args.max_blocks_per_seq
 
-        A = R if R is not None else B  # per-row host array size
+        A = R  # per-row host array size
         tokens = np.zeros((A, 1), np.int32)
         positions = np.zeros((A, 1), np.int32)
         slot_map = np.zeros((A, 1), np.int32)
         bt = np.full((A, W), NULL_BLOCK, np.int32)
         kv_lens = np.zeros((A,), np.int32)
-        last_idx = np.zeros((A,), np.int32)
         temp = np.zeros((A,), np.float32)
         top_k = np.zeros((A,), np.int32)
         top_p = np.ones((A,), np.float32)
@@ -2560,50 +2497,33 @@ class AsyncJaxEngine:
         keys = self._sampling.make_keys(seeds, steps)
 
         self.param_reads += 1
-        if self._ragged:
-            from dynamo_tpu.engine.model import ragged_grid_shape
+        from dynamo_tpu.engine.model import ragged_grid_shape
 
-            C, _ = ragged_grid_shape(B)
-            ints5 = np.zeros((5, B), np.int32)
-            ints5[0, :R] = tokens[:, 0]
-            ints5[1, :R] = positions[:, 0]
-            ints5[2, :R] = slot_map[:, 0]
-            ints5[3] = C  # every token is decode: grid dump tile
-            rows3 = np.zeros((R, 3), np.int32)
-            rows3[:len(seqs), 0] = np.arange(len(seqs))
-            rows3[:len(seqs), 1] = 1
-            rows3[:len(seqs), 2] = kv_lens[:len(seqs)]
-            ints5 = jnp.asarray(ints5)
-            if feed is not None:
-                ints5 = ints5.at[0, :len(seqs)].set(
-                    feed["toks"][:len(seqs)].astype(jnp.int32))
-            new_sig = ("ragged_dec", B) not in self.compiled_signatures
-            self.compiled_signatures.add(("ragged_dec", B))
-            self.padded_tokens_total += B - len(seqs)
-            t0 = time.perf_counter()
-            logits, self.k_cache, self.v_cache = self.ragged_dec_fn(
-                self.params, ints5, jnp.asarray(rows3),
-                jnp.zeros((C,), jnp.int32), jnp.asarray(bt),
-                self.k_cache, self.v_cache)
-            if new_sig:
-                self._note_compile("ragged_dec", (B,),
-                                   time.perf_counter() - t0)
-        else:
-            ints3 = jnp.asarray(
-                np.stack([tokens, positions, slot_map], axis=1))
-            if feed is not None:
-                ints3 = ints3.at[:, 0, 0].set(feed["toks"].astype(jnp.int32))
-            lens_last = np.stack([kv_lens, last_idx], axis=1)
-            new_sig = ("step", B, 1, W) not in self.compiled_signatures
-            self.compiled_signatures.add(("step", B, 1, W))
-            self.padded_tokens_total += B - len(seqs)
-            t0 = time.perf_counter()
-            logits, self.k_cache, self.v_cache = self.step_fn(
-                self.params, ints3, jnp.asarray(lens_last), jnp.asarray(bt),
-                self.k_cache, self.v_cache)
-            if new_sig:
-                self._note_compile("step", (B, 1, W),
-                                   time.perf_counter() - t0)
+        C, _ = ragged_grid_shape(B)
+        ints5 = np.zeros((5, B), np.int32)
+        ints5[0, :R] = tokens[:, 0]
+        ints5[1, :R] = positions[:, 0]
+        ints5[2, :R] = slot_map[:, 0]
+        ints5[3] = C  # every token is decode: grid dump tile
+        rows3 = np.zeros((R, 3), np.int32)
+        rows3[:len(seqs), 0] = np.arange(len(seqs))
+        rows3[:len(seqs), 1] = 1
+        rows3[:len(seqs), 2] = kv_lens[:len(seqs)]
+        ints5 = jnp.asarray(ints5)
+        if feed is not None:
+            ints5 = ints5.at[0, :len(seqs)].set(
+                feed["toks"][:len(seqs)].astype(jnp.int32))
+        new_sig = ("ragged_dec", B) not in self.compiled_signatures
+        self.compiled_signatures.add(("ragged_dec", B))
+        self.padded_tokens_total += B - len(seqs)
+        t0 = time.perf_counter()
+        logits, self.k_cache, self.v_cache = self.ragged_dec_fn(
+            self.params, ints5, jnp.asarray(rows3),
+            jnp.zeros((C,), jnp.int32), jnp.asarray(bt),
+            self.k_cache, self.v_cache)
+        if new_sig:
+            self._note_compile("ragged_dec", (B,),
+                               time.perf_counter() - t0)
         states = None
         if any(_guided_fsm(s) is not None for s in seqs):
             # constrained rows: per-row FSM state is one more device-fed
@@ -2726,9 +2646,11 @@ class AsyncJaxEngine:
         if not self._prealloc_blocks(seqs, K - 1):
             return False
 
-        B = args.bucket_batch(len(seqs))
-        max_kv = max(len(s.tokens) for s in seqs) + K - 1
-        W = args.bucket_table_width(max_kv)
+        # ragged-family signature: the row bucket derives from the token
+        # bucket (one token per row) and the table width is static, so the
+        # burst adds no (B × W) lattice of its own
+        B = args.ragged_rows(args.bucket_ragged_tokens(len(seqs)))
+        W = args.max_blocks_per_seq
 
         last_tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
@@ -2759,8 +2681,8 @@ class AsyncJaxEngine:
         cursors = [_guided_fsm(s) for s in seqs]
         use_fsm = any(c is not None for c in cursors)
         kind = "multi_fsm" if use_fsm else "multi"
-        new_sig = (kind, B, W) not in self.compiled_signatures
-        self.compiled_signatures.add((kind, B, W))
+        new_sig = (kind, B) not in self.compiled_signatures
+        self.compiled_signatures.add((kind, B))
         self.padded_tokens_total += (B - len(seqs)) * K
         self._broadcast("multi", ints=ints, floats=floats, rand=rand,
                         block_tables=bt)
@@ -2799,7 +2721,7 @@ class AsyncJaxEngine:
                 self.k_cache, self.v_cache)
         self._last_dispatch_ms = (time.perf_counter() - t0d) * 1000
         if new_sig:
-            self._note_compile(kind, (B, W), time.perf_counter() - t0d)
+            self._note_compile(kind, (B,), time.perf_counter() - t0d)
         toks, logps = await asyncio.to_thread(
             lambda: (np.asarray(toks), np.asarray(logps)))
 
@@ -2948,7 +2870,7 @@ class AsyncJaxEngine:
              g_rows, fsm_rows) = build_triples()
             lg = logits
             if self._multihost or isinstance(lg, np.ndarray):
-                # logits are fully replicated (make_step_fn): round-trip
+                # logits are fully replicated (replicate_logits): round-trip
                 # through host so sampling is a LOCAL computation — a global
                 # op here would have to be mirrored by every follower rank
                 # (this includes the penalty/bias edits below: numpy, never

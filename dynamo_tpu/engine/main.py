@@ -135,13 +135,6 @@ async def amain():
                     action="store_false", default=True,
                     help="disable the depth-2 pipelined decode loop "
                          "(overlaps device compute with host commit/emit)")
-    ap.add_argument("--no-ragged-step", dest="ragged_step",
-                    action="store_false", default=True,
-                    help="disable the ragged mixed prefill+decode step "
-                         "(one packed launch per plan, one compiled "
-                         "signature per token bucket) and restore the "
-                         "bucketed per-(chunk,batch,width) step path "
-                         "wholesale (docs/performance.md)")
     ap.add_argument("--no-structured-device", dest="structured_device",
                     action="store_false", default=True,
                     help="keep guided-decoding constraints on the host "
@@ -306,7 +299,6 @@ async def amain():
         quantization=cli.quantization,
         kv_cache_dtype=cli.kv_cache_dtype,
         pipeline_decode=cli.pipeline_decode,
-        ragged_step=cli.ragged_step,
         structured_device=cli.structured_device,
         structured_table_mb=cli.structured_table_mb,
         warmup_buckets=cli.warmup_buckets,

@@ -157,6 +157,25 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> dict:
     return params
 
 
+def mla_tpla_shards(cfg: Optional[ModelConfig], mesh: Optional[Mesh]) -> int:
+    """Tensor-parallel shard count of the MLA latent stream under TPLA
+    (arxiv 2508.15881): the latent rank r — not the (single) KV head — is
+    the dimension MLA can split across tensor ranks. When both cache
+    streams divide evenly over "tp", the latent cache shards on its last
+    dim, w_uk/w_uv shard on their r dim, and GSPMD all-reduces the
+    partial scores before softmax and the partial W_UV expansion after —
+    scores stay exact, each rank holds (and disagg ships) only r/tp of
+    every latent page. Returns 1 (replicated, the classic MLA/TP layout)
+    whenever TPLA does not apply."""
+    if cfg is None or mesh is None or not cfg.is_mla:
+        return 1
+    tp = mesh.shape.get("tp", 1)
+    if (tp > 1 and cfg.kv_lora_rank % tp == 0
+            and cfg.rope_cache_dim % tp == 0):
+        return tp
+    return 1
+
+
 def _layer_stack_shardings(cfg: ModelConfig, mesh: Mesh, moe: bool,
                            stack_axis=None) -> dict:
     """``stack_axis``: mesh axis for the stacked-layer leading dim — "pp"
@@ -183,8 +202,15 @@ def _layer_stack_shardings(cfg: ModelConfig, mesh: Mesh, moe: bool,
             layers["wq"] = ns(None, None, "tp")
         layers["kv_a"] = ns(None, None, None)
         layers["kv_a_norm"] = ns(None, None)
-        layers["w_uk"] = ns(None, None, "tp")
-        layers["w_uv"] = ns(None, None, "tp")
+        if mla_tpla_shards(cfg, mesh) > 1:
+            # TPLA: absorb projections shard on the latent rank r (their
+            # contraction partner, the cache's sharded dim) instead of on
+            # heads — partial scores / partial W_UV outputs all-reduce
+            layers["w_uk"] = ns(None, "tp", None)
+            layers["w_uv"] = ns(None, "tp", None)
+        else:
+            layers["w_uk"] = ns(None, None, "tp")
+            layers["w_uv"] = ns(None, None, "tp")
         layers["wo"] = ns(None, "tp", None)
     else:
         layers["wq"] = ns(None, None, "tp")
@@ -255,14 +281,20 @@ def cache_shardings(mesh: Mesh, cfg: Optional[ModelConfig] = None,
                     quant: bool = False):
     """KV cache [L, num_slots, KV, hd]: heads sharded on tp, replicated on dp.
 
-    MLA's latent cache has a single shared "head" — it rides replicated
-    (the well-known MLA/TP property; the latent is tiny, ~576 dims/token).
+    MLA's latent cache has a single shared "head": under TPLA
+    (mla_tpla_shards) the latent DIM shards over tp — each rank holds
+    r/tp of every page, scores all-reduce before softmax — otherwise it
+    rides replicated (the classic MLA/TP property; the latent is tiny,
+    ~576 dims/token).
 
     ``quant``: int8 caches are {"q": [L,slots,KV,hd], "s": [L,slots,KV]}
     pytrees — returns a matching dict of shardings (scales shard with their
     heads)."""
+    lat_axis = None
     if cfg is not None and cfg.is_mla:
         head_axis = None
+        if mla_tpla_shards(cfg, mesh) > 1:
+            lat_axis = "tp"
     elif (cfg is not None
           and cfg.num_kv_heads % max(1, mesh.shape.get("tp", 1)) != 0):
         # KV heads not divisible by tp (tiny test models on wide meshes):
@@ -274,9 +306,11 @@ def cache_shardings(mesh: Mesh, cfg: Optional[ModelConfig] = None,
     pp = mesh.shape.get("pp", 1)
     layer_axis = ("pp" if pp > 1 and cfg is not None
                   and cfg.num_layers % pp == 0 else None)
-    q_sh = NamedSharding(mesh, P(layer_axis, None, head_axis, None))
+    q_sh = NamedSharding(mesh, P(layer_axis, None, head_axis, lat_axis))
     if not quant:
         return q_sh
+    # int8 scales are per (slot, stream) — shared across the sharded
+    # latent dim, so they stay replicated over tp even under TPLA
     return {"q": q_sh,
             "s": NamedSharding(mesh, P(layer_axis, None, head_axis))}
 
@@ -662,10 +696,118 @@ def _ragged_attention(q, kc, vc, lidx, block_tables, positions, rows3,
     return out
 
 
+def _mla_attention_seg(q_eff, q_rot, kc, vc, lidx, block_tables, positions,
+                       kv_lens, cfg: ModelConfig, block_size: int,
+                       seg_keys: int = 128):
+    """Latent-space counterpart of :func:`_paged_attention_seg`: online
+    softmax over fixed key segments, scores and values both in the latent
+    stream (q_eff·c + q_rot·k_rot, value = c). This is what lets MLA ride
+    the ragged launch: the full table width stays out of the compiled
+    signature while gather traffic follows the batch's actual kv lengths.
+    Under TPLA the r dim of c (and of q_eff) is tp-sharded — GSPMD
+    all-reduces the partial scores inside the loop body, exactly the
+    TPLA partial-score sum.
+
+    q_eff [B,S,H,r] f32 (already absorbed through W_UK), q_rot [B,S,H,dr]
+    f32; returns o_lat [B,S,H,r] f32.
+    """
+    B, S, H, r = q_eff.shape
+    dr = q_rot.shape[-1]
+    W = block_tables.shape[1]
+    bs = block_size
+    from dynamo_tpu.engine.cache import gather_pages
+
+    spp = max(1, min(W, -(-seg_keys // bs)))
+    SEG = spp * bs
+    nseg = -(-W // spp)
+    bt = (block_tables if W == nseg * spp
+          else jnp.pad(block_tables, ((0, 0), (0, nseg * spp - W))))
+    max_kv = jnp.max(kv_lens)
+    scale = mla_softmax_scale(cfg)
+
+    def cond(c):
+        return (c[0] * SEG < max_kv) & (c[0] < nseg)
+
+    def body(c):
+        s, m, l, acc = c
+        pages = jax.lax.dynamic_slice(bt, (0, s * spp), (B, spp))
+        slot_idx = (pages[:, :, None] * bs
+                    + jnp.arange(bs)[None, None, :]).reshape(B, SEG)
+        cg = gather_pages(kc, lidx, slot_idx)[:, :, 0].astype(jnp.float32)
+        krg = gather_pages(vc, lidx, slot_idx)[:, :, 0, :dr].astype(
+            jnp.float32)
+        sc = (jnp.einsum("bshr,btr->bhst", q_eff, cg)
+              + jnp.einsum("bshd,btd->bhst", q_rot, krg)) * scale
+        key_pos = s * SEG + jnp.arange(SEG)
+        mask = (key_pos[None, None, :] <= positions[:, :, None]) & (
+            key_pos[None, None, :] < kv_lens[:, None, None])  # [B, S, SEG]
+        sc = jnp.where(mask[:, None, :, :], sc, -1e30)
+        m_new = jnp.maximum(m, sc.max(-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(sc - m_new[..., None])
+        l_new = l * corr + p.sum(-1)
+        acc_new = (acc * corr[..., None]
+                   + jnp.einsum("bhst,btr->bhsr", p, cg))
+        return s + 1, m_new, l_new, acc_new
+
+    m0 = jnp.full((B, H, S), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    acc0 = jnp.zeros((B, H, S, r), jnp.float32)
+    _, m, l, acc = jax.lax.while_loop(cond, body, (0, m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3)  # [B, S, H, r]
+
+
+def _mla_ragged_olat(q_eff, q_rot, kc, vc, lidx, block_tables, positions,
+                     rows3, grid_row, grid_col, grid_rows,
+                     cfg: ModelConfig, block_size: int):
+    """Ragged MLA attention: the packed-batch decomposition of
+    :func:`_ragged_attention` (decode rows as a [R, 1] batch, chunk tokens
+    through the host-tiled grid) applied to the latent segment walk.
+    q_eff [T,H,r] / q_rot [T,H,dr] f32, returns o_lat [T,H,r] f32."""
+    T, H, r = q_eff.shape
+    R = rows3.shape[0]
+    q_start, q_len, kv_lens = rows3[:, 0], rows3[:, 1], rows3[:, 2]
+
+    if grid_rows is None:
+        # decode-only variant: identity layout, padding rows kv→0
+        dec = _mla_attention_seg(
+            q_eff[:R][:, None], q_rot[:R][:, None], kc, vc, lidx,
+            block_tables, positions[:R][:, None],
+            jnp.where(q_len == 1, kv_lens, 0), cfg, block_size)[:, 0]
+        return jnp.pad(dec, ((0, T - R), (0, 0), (0, 0)))
+
+    is_dec = q_len == 1
+    dec_idx = jnp.where(is_dec, q_start, T)
+    qe_pad = jnp.pad(q_eff, ((0, 1), (0, 0), (0, 0)))
+    qr_pad = jnp.pad(q_rot, ((0, 1), (0, 0), (0, 0)))
+    pos_pad = jnp.pad(positions, (0, 1))
+    dec = _mla_attention_seg(
+        qe_pad[dec_idx][:, None], qr_pad[dec_idx][:, None], kc, vc, lidx,
+        block_tables, pos_pad[dec_idx][:, None],
+        jnp.where(is_dec, kv_lens, 0), cfg, block_size)[:, 0]  # [R, H, r]
+    out = jnp.zeros((T + 1, H, r), jnp.float32).at[dec_idx].set(dec)[:T]
+
+    C = grid_rows.shape[0]
+    S_C = min(RAGGED_TILE, T)
+    qeg = jnp.zeros((C + 1, S_C, H, r), jnp.float32).at[
+        grid_row, grid_col].set(q_eff)
+    qrg = jnp.zeros((C + 1, S_C, H, q_rot.shape[-1]), jnp.float32).at[
+        grid_row, grid_col].set(q_rot)
+    pg = jnp.zeros((C + 1, S_C), positions.dtype).at[
+        grid_row, grid_col].set(positions)
+    g_out = _mla_attention_seg(
+        qeg[:C], qrg[:C], kc, vc, lidx, block_tables[grid_rows], pg[:C],
+        kv_lens[grid_rows], cfg, block_size)
+    g_pad = jnp.pad(g_out, ((0, 1), (0, 0), (0, 0), (0, 0)))
+    vals = g_pad[grid_row, grid_col]  # [T, H, r]
+    return jnp.where((grid_row < C)[:, None, None], vals, out)
+
+
 def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
                    kv_lens, cfg: ModelConfig, block_size: int,
                    use_pallas: bool = False, use_flash: bool = False,
-                   mesh: Optional[Mesh] = None):
+                   mesh: Optional[Mesh] = None, ragged=None):
     """Multi-head latent attention (DeepSeek V2/V3) over the paged latent
     cache — the weight-ABSORBED formulation throughout.
 
@@ -734,7 +876,18 @@ def _mla_attention(h, lp, lidx, kc, vc, slot_map, block_tables, positions,
     # on the fast path instead of falling back at L× the footprint
     pallas_ok = (not kv_quant
                  or mla_int8_kernel_supported(block_size, _slots))
-    if use_pallas and S == 1 and pallas_ok:
+    if ragged is not None:
+        # packed ragged batch: B == 1, S == T, block_tables is [R, W].
+        # Decode rows and chunk-grid tokens decompose exactly like the
+        # dense-attention ragged path, but in latent space; under TPLA the
+        # latent caches and q_eff/o_lat r dims are tp-sharded and GSPMD
+        # inserts the partial-score / partial-W_UV all-reduces.
+        rows3, grid_row, grid_col, grid_rows = ragged
+        o_lat = _mla_ragged_olat(
+            q_eff[0], q_rot[0].astype(jnp.float32), kc, vc, lidx,
+            block_tables, positions[0], rows3, grid_row, grid_col,
+            grid_rows, cfg, block_size)[None]
+    elif use_pallas and S == 1 and pallas_ok:
         # Pallas latent decode: pages stream HBM→VMEM once; output stays in
         # latent space, W_UV expansion below is shared with the XLA path
         from dynamo_tpu.ops.paged_attention import mla_paged_decode
@@ -1183,8 +1336,9 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
             attn_flat, kc, vc = _mla_attention(
                 h, lp, lidx, kc, vc, slot_map, block_tables, positions,
                 kv_lens, cfg, block_size,
-                use_pallas=use_pallas and dp_ok,
-                use_flash=use_flash_prefill and dp_ok, mesh=mesh)
+                use_pallas=use_pallas and dp_ok and ragged is None,
+                use_flash=use_flash_prefill and dp_ok and ragged is None,
+                mesh=mesh, ragged=ragged)
             x = x + _mm(attn_flat, lp["wo"])
             return _mlp_epilogue(x, kc, vc, lp, moe)
         q = _mm(h, lp["wq"])
@@ -1499,6 +1653,65 @@ def make_verify_fn(cfg: ModelConfig, block_size: int,
     return jax.jit(fn, donate_argnums=donate, **kw)
 
 
+def make_ragged_verify_fn(cfg: ModelConfig, block_size: int,
+                          mesh: Optional[Mesh] = None,
+                          replicate_outputs: bool = False,
+                          kv_quant: bool = False, masked: bool = False):
+    """Speculative verification ON the packed ragged layout: each verify row
+    is just a ragged chunk with q_len = draft+1, so the compiled signature
+    is the same token-bucket family as the serving step (no separate
+    [B, S] verify lattice). Same math as make_verify_fn — greedy argmax +
+    logprob at EVERY packed position; the host slices each row's
+    [q_start, q_start + q_len) window out of the flat [T] result.
+
+    Signature: ``fn(params, ints5 [5, T], rows3 [R, 3], grid_rows [C],
+    block_tables [R, W], [mask_words [T, ceil(V/32)],] k_cache, v_cache)
+    -> (ids [T] i32, logps [T] f32, k_cache, v_cache)``. ``masked=True``
+    threads the host-walked FSM bitmask per packed position (the
+    make_verify_fn contract, flat layout)."""
+    from dynamo_tpu.engine.sampling import FSM_MASK_FILL
+
+    def f(params, ints5, rows3, grid_rows, block_tables, k_cache, v_cache,
+          mask_words=None):
+        kv_lens = rows3[:, 2]
+        logits, k_cache, v_cache = forward(
+            params, ints5[0][None], ints5[1][None], ints5[2][None],
+            block_tables, kv_lens, jnp.zeros((rows3.shape[0],), jnp.int32),
+            k_cache, v_cache, cfg=cfg, block_size=block_size, mesh=mesh,
+            all_logits=True, ragged=(rows3, ints5[3], ints5[4], grid_rows))
+        logits = logits[0]  # [T, V]
+        if mask_words is not None:
+            V = logits.shape[-1]
+            ids = jnp.arange(V, dtype=jnp.uint32)
+            bits = (mask_words[:, (ids // 32).astype(jnp.int32)]
+                    >> (ids % 32)) & jnp.uint32(1)
+            logits = jnp.where(bits.astype(bool), logits, FSM_MASK_FILL)
+        lp = jax.nn.log_softmax(logits, axis=-1)  # [T, V] f32
+        ids = jnp.argmax(lp, axis=-1)
+        chosen = jnp.take_along_axis(lp, ids[..., None], axis=-1)[..., 0]
+        return ids.astype(jnp.int32), chosen, k_cache, v_cache
+
+    if masked:
+        def fn(params, ints5, rows3, grid_rows, block_tables, mask_words,
+               k_cache, v_cache):
+            return f(params, ints5, rows3, grid_rows, block_tables,
+                     k_cache, v_cache, mask_words=mask_words)
+        donate = (6, 7)
+    else:
+        def fn(params, ints5, rows3, grid_rows, block_tables,
+               k_cache, v_cache):
+            return f(params, ints5, rows3, grid_rows, block_tables,
+                     k_cache, v_cache)
+        donate = (5, 6)
+
+    kw = {}
+    if replicate_outputs and mesh is not None:
+        rep = NamedSharding(mesh, P())
+        csh = cache_shardings(mesh, cfg, quant=kv_quant)
+        kw["out_shardings"] = (rep, rep, csh, csh)
+    return jax.jit(fn, donate_argnums=donate, **kw)
+
+
 def make_embed_fn(cfg: ModelConfig, block_size: int,
                   mesh: Optional[Mesh] = None, use_pallas: bool = False,
                   replicate_outputs: bool = False):
@@ -1552,7 +1765,8 @@ def multi_decode(params, last_tokens, positions, block_tables, kv_lens,
                  k_cache, v_cache, temperature, top_k, top_p, seeds, step0,
                  *, cfg: ModelConfig, block_size: int, num_steps: int,
                  use_pallas: bool = False, mesh: Optional[Mesh] = None,
-                 fsm_states=None, fsm_mask=None, fsm_next=None):
+                 fsm_states=None, fsm_mask=None, fsm_next=None,
+                 ragged: bool = False):
     """Run ``num_steps`` chained decode steps in ONE compiled program.
 
     Per-step host dispatch dominates decode latency when the chip is remote
@@ -1584,10 +1798,26 @@ def multi_decode(params, last_tokens, positions, block_tables, kv_lens,
             tok, pos, kv, kc, vc = carry
         slot = (jnp.take_along_axis(
             block_tables, (pos // bs)[:, None], axis=1)[:, 0] * bs + pos % bs)
-        logits, kc, vc = forward(
-            params, tok[:, None], pos[:, None], slot[:, None], block_tables,
-            kv, jnp.zeros((B,), jnp.int32), kc, vc,
-            cfg=cfg, block_size=bs, use_pallas=use_pallas, mesh=mesh)
+        if ragged:
+            # packed decode layout [1, R=B]: one row per sequence, padding
+            # rows (kv == 0) get q_len = 0 and are fully masked. Same
+            # sampler math on the same logits → stream parity with the
+            # bucketed scan by construction.
+            q_len = (kv > 0).astype(jnp.int32)
+            rows3 = jnp.stack(
+                [jnp.arange(B, dtype=jnp.int32), q_len, kv], axis=1)
+            zt = jnp.zeros((B,), jnp.int32)
+            logits, kc, vc = forward(
+                params, tok[None, :], pos[None, :], slot[None, :],
+                block_tables, kv,
+                jnp.clip(jnp.arange(B) + q_len - 1, 0, B - 1), kc, vc,
+                cfg=cfg, block_size=bs, use_pallas=use_pallas, mesh=mesh,
+                ragged=(rows3, zt, zt, None))
+        else:
+            logits, kc, vc = forward(
+                params, tok[:, None], pos[:, None], slot[:, None],
+                block_tables, kv, jnp.zeros((B,), jnp.int32), kc, vc,
+                cfg=cfg, block_size=bs, use_pallas=use_pallas, mesh=mesh)
         keys = jnp.stack(
             [seeds.astype(jnp.uint32), (step0 + k).astype(jnp.uint32)], axis=1)
         if fsm:
@@ -1627,7 +1857,10 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
         tp_ = mesh.shape.get("tp", 1) if mesh is not None else 1
         mla_ok = (cfg.num_heads % tp_ == 0
                   and mla_pallas_supported(cfg.kv_lora_rank,
-                                           cfg.rope_cache_dim))
+                                           cfg.rope_cache_dim)
+                  # TPLA shards the latent cache over tp; the MLA kernels'
+                  # shard_maps assume a replicated cache — XLA/GSPMD path
+                  and mla_tpla_shards(cfg, mesh) == 1)
         if use_flash_prefill is None:
             use_flash_prefill = use_pallas or jax.default_backend() == "tpu"
         return (use_pallas and mla_ok), (bool(use_flash_prefill) and mla_ok)
@@ -1649,38 +1882,11 @@ def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],
     return decode_pallas, prefill_flash
 
 
-def make_step_mm_fn(cfg: ModelConfig, block_size: int,
-                    mesh: Optional[Mesh] = None, use_pallas: bool = False,
-                    use_flash_prefill=None, replicate_logits: bool = False,
-                    kv_quant: bool = False):
-    """Jitted engine step accepting multimodal embedding overrides:
-    (params, ints3 [B,3,S], lens_last [B,2], block_tables, mm_vec [B,S,D],
-    mm_mask [B,S], k_cache, v_cache) — same packed layout as make_step_fn.
-    Compiled lazily by the engine only when a request actually carries mm
-    content."""
-    decode_pallas, prefill_flash = _resolve_kernel_flags(
-        cfg, mesh, use_pallas, use_flash_prefill)
-
-    def f(params, ints3, lens_last, block_tables, mm_vec, mm_mask,
-          k_cache, v_cache):
-        return forward(params, ints3[:, 0], ints3[:, 1], ints3[:, 2],
-                       block_tables, lens_last[:, 0], lens_last[:, 1],
-                       k_cache, v_cache, cfg=cfg,
-                       block_size=block_size, use_pallas=decode_pallas,
-                       use_flash_prefill=prefill_flash, mesh=mesh,
-                       mm_vec=mm_vec, mm_mask=mm_mask)
-
-    kw = {}
-    if replicate_logits and mesh is not None:
-        csh = cache_shardings(mesh, cfg, quant=kv_quant)
-        kw["out_shardings"] = (NamedSharding(mesh, P()), csh, csh)
-    return jax.jit(f, donate_argnums=(6, 7), **kw)
-
-
 def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
                          mesh: Optional[Mesh] = None, use_pallas: bool = False,
                          replicate_outputs: bool = False,
-                         kv_quant: bool = False, fsm: bool = False):
+                         kv_quant: bool = False, fsm: bool = False,
+                         ragged: bool = True):
     """Jitted multi-step decode with cache donation (args 5, 6).
 
     ``replicate_outputs`` (multi-host): tokens/logps come back fully
@@ -1717,7 +1923,7 @@ def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
                 rand[:, 0], rand[:, 1], cfg=cfg, block_size=block_size,
                 num_steps=num_steps, use_pallas=decode_pallas, mesh=mesh,
                 fsm_states=states, fsm_mask=mask_arena,
-                fsm_next=next_arena)
+                fsm_next=next_arena, ragged=ragged)
         donate = (8, 9)
     else:
         def f(params, ints, floats, rand, block_tables, k_cache, v_cache):
@@ -1725,7 +1931,8 @@ def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
                 params, ints[:, 0], ints[:, 1], block_tables, ints[:, 2],
                 k_cache, v_cache, floats[:, 0], ints[:, 3], floats[:, 1],
                 rand[:, 0], rand[:, 1], cfg=cfg, block_size=block_size,
-                num_steps=num_steps, use_pallas=decode_pallas, mesh=mesh)
+                num_steps=num_steps, use_pallas=decode_pallas, mesh=mesh,
+                ragged=ragged)
         donate = (5, 6)
 
     kw = {}
@@ -1739,7 +1946,7 @@ def make_multi_decode_fn(cfg: ModelConfig, block_size: int, num_steps: int,
 def make_draft_fn(cfg: ModelConfig, block_size: int, draft_layers: int,
                   num_steps: int, mesh: Optional[Mesh] = None,
                   use_pallas: bool = False, replicate_outputs: bool = False,
-                  kv_quant: bool = False):
+                  kv_quant: bool = False, ragged: bool = True):
     """Layer-skip self-drafting (the draft-model speculative path): chain
     ``num_steps`` GREEDY decode steps through only the first
     ``draft_layers`` layers + the shared final norm / LM head, in one
@@ -1788,7 +1995,7 @@ def make_draft_fn(cfg: ModelConfig, block_size: int, draft_layers: int,
             pd, last_tokens, positions, block_tables, kv_lens,
             k_cache, v_cache, zf, zi, jnp.ones((B,), jnp.float32), zu, zu,
             cfg=cfg_d, block_size=block_size, num_steps=num_steps,
-            use_pallas=decode_pallas, mesh=mesh)
+            use_pallas=decode_pallas, mesh=mesh, ragged=ragged)
         return toks, k_cache, v_cache
 
     kw = {}
@@ -1822,11 +2029,9 @@ def make_ragged_step_fn(cfg: ModelConfig, block_size: int,
     Signature: ``fn(params, ints5, rows3, grid_rows, block_tables [R, W],
     [mm_vec [T, D], mm_mask [T],] k_cache, v_cache) ->
     (logits [R, V], k_cache, v_cache)`` (``mm=True`` adds the multimodal
-    override operands, compiled lazily by the engine like make_step_mm_fn).
+    override operands; the engine compiles that variant lazily, only when
+    a request actually carries mm content).
     """
-    if cfg.is_mla:
-        raise ValueError("the ragged step does not cover MLA latent caches "
-                         "yet — run with ragged_step=False")
     decode_pallas, _ = _resolve_kernel_flags(cfg, mesh, use_pallas, False)
 
     def f(params, ints5, rows3, grid_rows, block_tables, *rest):
@@ -1856,7 +2061,10 @@ def make_ragged_step_fn(cfg: ModelConfig, block_size: int,
 def make_step_fn(cfg: ModelConfig, block_size: int, mesh: Optional[Mesh] = None,
                  use_pallas: bool = False, use_flash_prefill=None,
                  replicate_logits: bool = False, kv_quant: bool = False):
-    """Jitted engine step with cache donation (and GSPMD shardings if mesh).
+    """Jitted bucketed step — KEPT AS A MODEL-LEVEL ORACLE ONLY. The
+    engine dispatches exclusively through make_ragged_step_fn; this
+    per-row [B,S] layout survives because kernel parity and mesh tests
+    (tests/test_flash_prefill.py) pin Pallas-vs-XLA behavior against it.
 
     ``use_pallas`` switches decode (S=1) attention onto the Pallas paged
     kernel; prefill (S>1) uses the flash kernel when supported. Both work

@@ -145,22 +145,22 @@ class Scheduler:
                  on_stored: Optional[Callable] = None,
                  onboard_cb: Optional[Callable] = None,
                  swapper: Optional[object] = None,
-                 token_budget: bool = False,
+                 token_budget: bool = True,
                  hot_cb: Optional[Callable] = None):
         self.args = args
         self.pool = pool
-        #: ragged-step planning (docs/performance.md): the step is ONE
-        #: packed launch, so plan() budgets TOKENS (prefill chunks + decode
-        #: rows co-scheduled under max_num_batched_tokens) instead of
-        #: grouping same-bucket chunks. Chunk sizes are free (no
-        #: prefill-bucket clamp — the bucketed path's chunk-clamp
-        #: workaround doesn't apply), padding-cost row checks are moot
-        #: (nothing pads to a bucket), and the QoS decode sit-out collapses
-        #: to plain budget accounting: better-class chunks are admitted
-        #: first (class order), and decode rows cost one token each — they
-        #: never inflate a better-class prefill's padded step shape, so
-        #: there is nothing to shed.
-        self.token_budget = token_budget
+        #: ragged-step planning (docs/performance.md), the ONLY planning
+        #: mode: the step is ONE packed launch, so plan() budgets TOKENS
+        #: (prefill chunks + decode rows co-scheduled under
+        #: max_num_batched_tokens). Chunk sizes are free (no prefill-bucket
+        #: clamp), padding-cost row checks are moot (nothing pads to a
+        #: bucket), and the QoS decode sit-out collapses to plain budget
+        #: accounting: better-class chunks are admitted first (class
+        #: order), and decode rows cost one token each — they never
+        #: inflate a better-class prefill's padded step shape, so there is
+        #: nothing to shed. (``token_budget`` is accepted for API
+        #: compatibility and ignored — the bucketed planner is gone.)
+        self.token_budget = True
         self.on_stored = on_stored  # fn(parent_hash, [StoredBlock], [block_id])
         #: fn(probe: TokenBlockSequence, start_block, end_block) -> [block_id]
         #: — KVBM onboard hook: device-misses found in host/disk tiers come
@@ -295,11 +295,9 @@ class Scheduler:
             else:
                 if not self._preempt_for(s):
                     self._preempt(s)
-        row_cap = max_b
-        if self.token_budget:
-            # packed step: decode rows spend the shared token budget (one
-            # token each) and must also fit the packed-token bucket cap
-            row_cap = min(max_b, budget)
+        # packed step: decode rows spend the shared token budget (one
+        # token each) and must also fit the packed-token bucket cap
+        row_cap = min(max_b, budget)
         still_ready = [s for s in ready_decode if s in self.running]
         plan.decode = still_ready[:row_cap]
         self.last_starved_decode = len(still_ready) - len(plan.decode)
@@ -318,18 +316,9 @@ class Scheduler:
             prefill_seqs = [s for s in self.running if s.remaining > 1]
             if self.args.qos_scheduling:
                 prefill_seqs.sort(key=by_class)
-            s_bucket = None
-            # chunks must fit the LARGEST compiled prefill bucket: with
-            # custom buckets coarser than max_num_batched_tokens, an
-            # unclamped chunk (e.g. a recompute re-prefill of prompt +
-            # generated tokens) would overflow the padded batch row.
-            # Token-budget (ragged) planning has no per-row padding, so the
-            # clamp is simply the step budget.
-            if self.token_budget:
-                cap = self.args.max_num_batched_tokens
-            else:
-                cap = min(self.args.max_num_batched_tokens,
-                          self.args.prefill_buckets[-1])
+            # ragged planning has no per-row padding, so chunks are
+            # clamped only by the step's token budget.
+            cap = self.args.max_num_batched_tokens
             for s in prefill_seqs:
                 if s not in self.running:
                     continue  # preempted by an earlier iteration's victim pick
@@ -345,33 +334,13 @@ class Scheduler:
                                  "and chunked prefill is disabled"))
                         s.sink.put_nowait(None)
                     continue  # a shorter seq may still fit this step
-                prefill_cap = max_b
-                if self.token_budget:
-                    # the ragged step's chunk grid sizes for at most
-                    # RAGGED_MAX_CHUNKS co-scheduled chunks (model.
-                    # ragged_grid_shape capacity proof); later chunks wait
-                    # a step — they were budget-starved anyway
-                    prefill_cap = min(max_b, RAGGED_MAX_CHUNKS)
+                # the ragged step's chunk grid sizes for at most
+                # RAGGED_MAX_CHUNKS co-scheduled chunks (model.
+                # ragged_grid_shape capacity proof); later chunks wait
+                # a step — they were budget-starved anyway
+                prefill_cap = min(max_b, RAGGED_MAX_CHUNKS)
                 if chunk <= 0 or len(plan.prefill) >= prefill_cap:
                     break
-                if not self.token_budget:
-                    # bucketed step: rows of one jitted call share a token
-                    # bucket, and the PADDED cost B·S_bucket is what the
-                    # budget must bound. The ragged step has neither
-                    # constraint — chunks of any size pack side by side and
-                    # only REAL tokens spend budget.
-                    bucket = self.args.bucket_tokens(chunk)
-                    if s_bucket is None:
-                        s_bucket = bucket
-                    elif bucket > s_bucket:
-                        continue  # would inflate every row's padding
-                    # padded-cost bound applies only when ADDING rows: the
-                    # first chunk always runs even if its bucket exceeds
-                    # the budget (custom buckets may be coarser than the
-                    # budget — refusing it would wedge the engine forever)
-                    if plan.prefill and (len(plan.prefill) + 1) * s_bucket \
-                            > self.args.max_num_batched_tokens:
-                        break
                 protected = plan.decode + [w.seq for w in plan.prefill]
                 if not self._ensure_blocks(s, s.num_computed + chunk):
                     # not enough memory: preempt, but never a seq whose
@@ -386,34 +355,11 @@ class Scheduler:
                     sample=(s.num_computed + chunk == len(s.tokens)),
                 ))
                 budget -= chunk
-        if (self.args.qos_scheduling and plan.prefill and plan.decode
-                and not self.token_budget):
-            # TTFT protection (docs/qos.md): when this step carries a
-            # prefill chunk of a BETTER class, strictly-worse-class decode
-            # rows sit the step out — their next token arrives one step
-            # late (a bounded ITL hit for the backlogged class) instead of
-            # inflating every step of the interactive prompt's prefill.
-            # ONLY when it pays: decode dispatch cost is set by the padded
-            # batch bucket, so shedding worse rows that leave the bucket
-            # unchanged would delay their tokens without speeding the step
-            # by a single flop. Same-class mixes (every pre-QoS workload)
-            # are untouched either way.
-            best = min(CLASS_RANK.get(w.seq.priority, 1)
-                       for w in plan.prefill)
-            better = [s for s in plan.decode
-                      if CLASS_RANK.get(s.priority, 1) <= best]
-            # Shedding to EMPTY when every row is worse-class looks like
-            # the biggest win (the whole decode dispatch skipped) but
-            # measured consistently WORSE on bench.py --qos: interactive
-            # TTFT p95 117ms vs 84ms, ratio 1.3-1.65x vs 0.75-1.09x over
-            # 3 runs each — oscillating between prefill-only and
-            # decode-only step shapes costs more than the batched decode
-            # rows ever did, and batch rows frozen mid-wave hold their
-            # slots/blocks longer. Worse-class rows therefore ride along
-            # unless dropping them shrinks the compiled bucket.
-            if better and self.args.bucket_batch(len(better)) \
-                    < self.args.bucket_batch(len(plan.decode)):
-                plan.decode = better
+        # NOTE: the bucketed planner's QoS decode sit-out (shed worse-class
+        # decode rows when that shrank the compiled batch bucket) is gone
+        # with the bucketed step itself: the packed ragged launch has no
+        # padded batch bucket to shrink, so shedding rows would delay their
+        # tokens without speeding the step by a single flop.
         return plan
 
     # -- post-step bookkeeping ----------------------------------------------

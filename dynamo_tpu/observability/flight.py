@@ -119,7 +119,9 @@ class StepRecord:
 
     seq: int = 0            # monotonic step index within this recorder
     t: float = 0.0          # epoch seconds at record time
-    kind: str = ""          # ragged|prefill|decode|decode_pipe|mock|empty…
+    kind: str = ""          # ragged|spec|multi|decode_pipe|mock|empty —
+    #                         ONE record per plan (the packed ragged launch
+    #                         is the only step path; no per-bucket records)
     wall_ms: float = 0.0    # plan+execute wall clock
     dispatch_ms: float = 0.0  # jitted-call dispatch portion (0 = unknown)
     decode_rows: int = 0

@@ -47,16 +47,22 @@ STEP_STREAM_PREFIX = "mh_steps/{namespace}/"
 #: the follower's replay, and the engine's dispatch must agree or the fleet
 #: silently desyncs
 STEP_KEYS = {
-    # packed layouts (model.make_step_fn / make_multi_decode_fn /
-    # make_verify_fn): ints3 [B,3,S] i32 = tokens/positions/slot_map,
-    # lens_last [B,2] i32 = kv_lens/last_idx, ints [B,4] i32 =
+    # packed RAGGED layouts (model.make_ragged_step_fn /
+    # make_ragged_verify_fn / make_multi_decode_fn): ints5 [5,T] i32 =
+    # tokens/positions/slot_map/grid_row/grid_col, rows3 [R,3] i32 =
+    # q_start/q_len/kv_len, grid_rows [C] i32, ints [B,4] i32 =
     # last_tokens/positions/kv_lens/top_k, floats [B,2] f32 = temp/top_p,
-    # rand [B,2] u32 = seeds/step0
-    "step": ("ints3", "lens_last", "block_tables"),
+    # rand [B,2] u32 = seeds/step0, mask_words [T, ceil(V/32)] u32
+    "ragged": ("ints5", "rows3", "grid_rows", "block_tables"),
+    "ragged_dec": ("ints5", "rows3", "grid_rows", "block_tables"),
+    "ragged_mm": ("ints5", "rows3", "grid_rows", "block_tables",
+                  "mm_vec", "mm_mask"),
+    "pp": ("ints5", "rows3", "grid_rows", "block_tables"),
     "multi": ("ints", "floats", "rand", "block_tables"),
-    "verify": ("ints3", "block_tables", "kv_lens"),
+    "verify": ("ints5", "rows3", "grid_rows", "block_tables"),
+    "verify_fsm": ("ints5", "rows3", "grid_rows", "block_tables",
+                   "mask_words"),
     "draft": ("ints", "block_tables"),  # ints [B,3] = last_tokens/positions/kv_lens
-    "step_mm": ("ints3", "lens_last", "block_tables", "mm_vec", "mm_mask"),
     "embed": ("tokens", "lengths"),
 }
 
@@ -335,10 +341,14 @@ class StepFollower:
                     # Resolve the attribute LAZILY — an eager dict would
                     # touch fns the engine never built (no spec/multi
                     # configured) and crash the replay for unrelated kinds.
-                    if kind == "step_mm":
-                        fn = eng._get_step_mm_fn()
+                    if kind == "ragged_mm":
+                        fn = eng._get_ragged_mm_fn()
+                    elif kind == "verify_fsm":
+                        fn = eng._get_verify_masked_fn()
                     else:
-                        fn = getattr(eng, {"step": "step_fn",
+                        fn = getattr(eng, {"ragged": "ragged_fn",
+                                           "ragged_dec": "ragged_dec_fn",
+                                           "pp": "pp_fn",
                                            "verify": "verify_fn",
                                            "draft": "draft_fn",
                                            "multi": "multi_fn"}[kind])

@@ -42,7 +42,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelConfig
 from dynamo_tpu.engine.model import (
-    _mlp_dense, _mm, _paged_attention, _rms_norm, _rope,
+    _mlp_dense, _mm, _paged_attention, _ragged_attention, _rms_norm, _rope,
 )
 
 AXIS = "pp"
@@ -251,23 +251,171 @@ def pp_forward(params, tokens, positions, slot_map, block_tables, kv_lens,
     return _mm(x_last, head).astype(jnp.float32), k_cache, v_cache
 
 
+def _ragged_dense_layer(x, lp, lidx, glidx, kc, vc, slot_map, block_tables,
+                        positions, rows3, grid_row, grid_col, grid_rows,
+                        cfg: ModelConfig, block_size: int):
+    """One dense layer over a PACKED ragged microbatch [T, D] — the pp
+    mirror of model.forward's ragged XLA branch (projections/RoPE/scatter
+    pointwise per token, attention through :func:`_ragged_attention`)."""
+    T = x.shape[0]
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = _mm(h, lp["wq"])
+    k = _mm(h, lp["wk"])
+    v = _mm(h, lp["wv"])
+    if "bq" in lp:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(1, T, H, hd)
+    k = k.reshape(1, T, KV, hd)
+    v = v.reshape(1, T, KV, hd)
+    if cfg.qk_norm:
+        q = _rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = _rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q = _rope(q, positions[None], cfg.rope_theta, cfg.rope_scaling)
+    k = _rope(k, positions[None], cfg.rope_theta, cfg.rope_scaling)
+    kc = kc.at[lidx, slot_map].set(k.reshape(T, KV, hd), mode="drop")
+    vc = vc.at[lidx, slot_map].set(v.reshape(T, KV, hd), mode="drop")
+    window = (jnp.asarray(cfg.layer_windows, jnp.int32)[glidx]
+              if cfg.layer_windows is not None else None)
+    attn = _ragged_attention(q[0], kc, vc, lidx, block_tables, positions,
+                             rows3, grid_row, grid_col, grid_rows, cfg,
+                             block_size, window=window,
+                             sinks=lp.get("sink"))
+    x = x + _mm(attn.reshape(T, H * hd), lp["wo"])
+    if "bo" in lp:
+        x = x + lp["bo"]
+    h2 = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    return x + _mlp_dense(h2, lp), kc, vc
+
+
+def _ragged_stage_body(layers, x_mb, pos_mb, slot_mb, bt_mb, rows3_mb,
+                       grow_mb, gcol_mb, grows_mb, kc, vc, *,
+                       cfg: ModelConfig, block_size: int, M: int,
+                       n_stages: int):
+    """shard_map body over "pp": the GPipe schedule of `_stage_body`, with
+    each microbatch a PACKED ragged slice of the plan instead of a bucketed
+    [b, S] row block.
+
+    Local shapes: layers leaves [L/P, ...]; kc/vc [L/P, slots, KV, hd];
+    x_mb [M, T_mb, D]; rows/grids replicated across stages. Invalid ticks
+    (pipeline fill/drain) write to slot 0 — the reserved null block — and
+    their ragged attention reads whatever the clipped microbatch's tables
+    name; the garbage output is never banked.
+    """
+    s = jax.lax.axis_index(AXIS)
+    L_local = kc.shape[0]
+    state = jax.lax.pcast(jnp.zeros(x_mb.shape[1:], x_mb.dtype), (AXIS,),
+                          to="varying")
+    out = jax.lax.pcast(jnp.zeros_like(x_mb), (AXIS,), to="varying")
+    lidx_arange = jnp.arange(L_local)
+
+    def run_layers(x, kc, vc, sm, bt, pos, rows3, grow, gcol, grows):
+        def body(carry, xs):
+            x, kc, vc = carry
+            lp, li = xs
+            x, kc, vc = _ragged_dense_layer(
+                x, lp, li, s * L_local + li, kc, vc, sm, bt, pos,
+                rows3, grow, gcol, grows, cfg, block_size)
+            return (x, kc, vc), None
+        (x, kc, vc), _ = jax.lax.scan(body, (x, kc, vc),
+                                      (layers, lidx_arange))
+        return x, kc, vc
+
+    def tick(t, carry):
+        state, out, kc, vc = carry
+        m = t - s
+        valid = (m >= 0) & (m < M)
+        mc = jnp.clip(m, 0, M - 1)
+        x_in = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, M - 1), keepdims=False)
+        state = jnp.where((s == 0) & (t < M), x_in, state)
+        sm = jnp.where(valid,
+                       jax.lax.dynamic_index_in_dim(slot_mb, mc,
+                                                    keepdims=False), 0)
+        pos = jax.lax.dynamic_index_in_dim(pos_mb, mc, keepdims=False)
+        bt = jax.lax.dynamic_index_in_dim(bt_mb, mc, keepdims=False)
+        rows3 = jax.lax.dynamic_index_in_dim(rows3_mb, mc, keepdims=False)
+        grow = jax.lax.dynamic_index_in_dim(grow_mb, mc, keepdims=False)
+        gcol = jax.lax.dynamic_index_in_dim(gcol_mb, mc, keepdims=False)
+        grows = jax.lax.dynamic_index_in_dim(grows_mb, mc, keepdims=False)
+        state2, kc, vc = run_layers(state, kc, vc, sm, bt, pos, rows3,
+                                    grow, gcol, grows)
+        rec = valid & (s == n_stages - 1)
+        prev = jax.lax.dynamic_index_in_dim(out, mc, keepdims=False)
+        out = out.at[mc].set(jnp.where(rec, state2, prev))
+        state = jax.lax.ppermute(
+            state2, AXIS, [(i, i + 1) for i in range(n_stages - 1)])
+        return state, out, kc, vc
+
+    T, _ = pp_schedule(M, n_stages)
+    state, out, kc, vc = jax.lax.fori_loop(
+        0, T, tick, (state, out, kc, vc))
+    out = jax.lax.psum(jnp.where(s == n_stages - 1, out,
+                                 jnp.zeros_like(out)), AXIS)
+    return out, kc, vc
+
+
+def pp_forward_ragged(params, ints5, rows3, grid_rows, block_tables,
+                      k_cache, v_cache, *, cfg: ModelConfig,
+                      block_size: int, mesh: Mesh):
+    """Pipelined RAGGED step: each of the M microbatches is a packed
+    ragged slice of the scheduler plan (make_ragged_step_fn layout, one
+    extra leading M axis) — ``ints5`` [M, 5, T], ``rows3`` [M, R, 3],
+    ``grid_rows`` [M, C], ``block_tables`` [M, R, W]. The compiled
+    signature depends only on (T, M); the bucketed (batch × chunk × width)
+    lattice never existed on this path. Returns (logits [M, R, V], caches).
+    """
+    n_stages = mesh.shape[AXIS]
+    reason = pp_compatible(cfg, n_stages)
+    if reason is not None:
+        raise ValueError(f"pp_forward_ragged: {reason}")
+    M, _, T = ints5.shape
+
+    x = params["embed"][ints5[:, 0]]  # [M, T, D]
+    body = functools.partial(_ragged_stage_body, cfg=cfg,
+                             block_size=block_size, M=M, n_stages=n_stages)
+    stack_specs = jax.tree.map(lambda _: P(AXIS), params["layers"])
+    rep = P()
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(stack_specs, rep, rep, rep, rep, rep, rep, rep, rep,
+                  P(AXIS), P(AXIS)),
+        out_specs=(rep, P(AXIS), P(AXIS)),
+        axis_names={AXIS},
+    )
+    out, k_cache, v_cache = fn(
+        params["layers"], x, ints5[:, 1], ints5[:, 2], block_tables,
+        rows3, ints5[:, 3], ints5[:, 4], grid_rows, k_cache, v_cache)
+
+    x = _rms_norm(out, params["final_norm"], cfg.rms_norm_eps)  # [M, T, D]
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    last_flat = jnp.clip(rows3[:, :, 0] + rows3[:, :, 1] - 1, 0, T - 1)
+    x_last = jnp.take_along_axis(x, last_flat[..., None], axis=1)
+    return _mm(x_last, head).astype(jnp.float32), k_cache, v_cache
+
+
 def make_pp_step_fn(cfg: ModelConfig, block_size: int, mesh: Mesh,
-                    num_microbatches: Optional[int] = None,
                     replicate_logits: bool = False):
-    """Jitted pipelined step with cache donation — drop-in for
-    model.make_step_fn when the mesh carries a pp axis.
+    """Jitted pipelined RAGGED step with cache donation — the pp
+    counterpart of model.make_ragged_step_fn: microbatches are packed
+    ragged plan slices, not bucketed rows.
+
+    Signature: ``fn(params, ints5 [M, 5, T], rows3 [M, R, 3], grid_rows
+    [M, C], block_tables [M, R, W], k_cache, v_cache) ->
+    (logits [M, R, V], k_cache, v_cache)``.
 
     ``replicate_logits`` (multi-host): logits come back fully replicated so
-    the leader rank can read them host-side (same contract as
-    model.make_step_fn — the lm head is tp-sharded otherwise)."""
+    the leader rank can read them host-side (the lm head is tp-sharded
+    otherwise)."""
     from jax.sharding import NamedSharding
 
-    def f(params, ints3, lens_last, block_tables, k_cache, v_cache):
-        # packed layout shared with model.make_step_fn (drop-in contract)
-        return pp_forward(params, ints3[:, 0], ints3[:, 1], ints3[:, 2],
-                          block_tables, lens_last[:, 0], lens_last[:, 1],
-                          k_cache, v_cache, cfg=cfg, block_size=block_size,
-                          mesh=mesh, num_microbatches=num_microbatches)
+    def f(params, ints5, rows3, grid_rows, block_tables, k_cache, v_cache):
+        return pp_forward_ragged(params, ints5, rows3, grid_rows,
+                                 block_tables, k_cache, v_cache, cfg=cfg,
+                                 block_size=block_size, mesh=mesh)
 
     kw = {}
     if replicate_logits:
@@ -275,4 +423,4 @@ def make_pp_step_fn(cfg: ModelConfig, block_size: int, mesh: Mesh,
 
         csh = cache_shardings(mesh, cfg)
         kw["out_shardings"] = (NamedSharding(mesh, P()), csh, csh)
-    return jax.jit(f, donate_argnums=(4, 5), **kw)
+    return jax.jit(f, donate_argnums=(5, 6), **kw)

@@ -418,52 +418,31 @@ async def test_logit_bias_steers_and_bans():
 
 
 async def test_batched_prefill_plans_and_matches_sequential():
-    """Concurrent same-size prompts share ONE prefill step (the bucketed
-    scheduler batches same-bucket chunks; the ragged step co-schedules
-    them into one packed launch) and outputs equal sequential runs."""
-    # bucketed path: spy _run_prefill for the shared step
+    """Concurrent same-size prompts share ONE packed launch (the ragged
+    step co-schedules their chunks) and outputs equal sequential runs."""
     eng = tiny_engine(max_num_seqs=8, max_num_batched_tokens=64,
                       prefill_buckets=(16, 32, 64),
-                      decode_batch_buckets=(1, 2, 4, 8), ragged_step=False)
+                      decode_batch_buckets=(1, 2, 4, 8))
     prompts = [[10 + i] + list(range(1, 14)) for i in range(4)]
 
     # sequential reference
     seq_out = [await collect(eng, req(p, max_tokens=4)) for p in prompts]
 
-    # concurrent: watch the max prefill batch the scheduler produced
-    max_batch = 0
-    orig = eng._run_prefill
+    # concurrent: watch the max co-scheduled chunk count per packed step
+    max_chunks = 0
+    orig = eng._run_ragged
 
-    async def spy(works):
-        nonlocal max_batch
-        max_batch = max(max_batch, len(works))
-        await orig(works)
+    async def spy(plan):
+        nonlocal max_chunks
+        max_chunks = max(max_chunks, len(plan.prefill))
+        return await orig(plan)
 
-    eng._run_prefill = spy
+    eng._run_ragged = spy
     conc_out = await asyncio.gather(
         *(collect(eng, req(p, max_tokens=4)) for p in prompts))
     assert [t for t, _ in conc_out] == [t for t, _ in seq_out]
-    assert max_batch >= 2  # prompts actually shared a prefill step
-    await eng.close()
-
-    # ragged path: the same concurrency rides one packed launch per step
-    eng_r = tiny_engine(max_num_seqs=8, max_num_batched_tokens=64,
-                        prefill_buckets=(16, 32, 64),
-                        decode_batch_buckets=(1, 2, 4, 8))
-    max_chunks = 0
-    orig_r = eng_r._run_ragged
-
-    async def spy_r(plan):
-        nonlocal max_chunks
-        max_chunks = max(max_chunks, len(plan.prefill))
-        return await orig_r(plan)
-
-    eng_r._run_ragged = spy_r
-    conc_r = await asyncio.gather(
-        *(collect(eng_r, req(p, max_tokens=4)) for p in prompts))
-    assert [t for t, _ in conc_r] == [t for t, _ in seq_out]
     assert max_chunks >= 2  # chunks co-scheduled into one packed step
-    await eng_r.close()
+    await eng.close()
 
 
 async def test_prefill_runs_when_bucket_exceeds_budget():

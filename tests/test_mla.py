@@ -200,6 +200,111 @@ def test_deepseek_presets_resolve():
     assert lite.kv_cache_spec == ((1, 512), (1, 128))  # rope 64 lane-padded
 
 
+def test_mla_ragged_packed_matches_bucketed():
+    """MLA rides the packed ragged launch (_mla_ragged_olat): a two-chunk
+    prefill launch and a mixed decode+chunk launch reproduce the bucketed
+    latent-attention logits row by row (disjoint pages per row, greedy
+    argmax identical)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.cache import allocate_device_cache
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.model import (
+        forward, init_params, make_ragged_step_fn, ragged_grid_shape,
+    )
+
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=4, dtype="float32",
+        max_position_embeddings=256,
+        kv_lora_rank=32, q_lora_rank=None, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16)
+    params = init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+    bs, W = 4, 8
+    rows = [[5, 9, 17, 23, 42, 77, 101, 3], [7, 11, 13]]
+    B = len(rows)
+    bt = np.zeros((B, W), np.int32)
+    nxt = 1
+    for b in range(B):
+        bt[b] = np.arange(nxt, nxt + W)
+        nxt += W
+    num_blocks = nxt + 1
+
+    def slots(b, positions):
+        return [int(bt[b, p // bs]) * bs + p % bs for p in positions]
+
+    # bucketed reference: per-row prefills, then one decode + one chunk
+    kcb, vcb = allocate_device_cache(cfg, num_blocks, bs, dtype=jnp.float32)
+    want = []
+    for b, row in enumerate(rows):
+        n = len(row)
+        lg, kcb, vcb = forward(
+            params, jnp.asarray([row], jnp.int32),
+            jnp.asarray([np.arange(n)], jnp.int32),
+            jnp.asarray([slots(b, range(n))], jnp.int32),
+            jnp.asarray(bt[b:b + 1]), jnp.asarray([n], jnp.int32),
+            jnp.asarray([n - 1], jnp.int32), kcb, vcb,
+            cfg=cfg, block_size=bs)
+        want.append(np.asarray(lg[0]))
+    lg_dec, kcb, vcb = forward(
+        params, jnp.asarray([[54]], jnp.int32), jnp.asarray([[8]], jnp.int32),
+        jnp.asarray([slots(0, [8])], jnp.int32), jnp.asarray(bt[0:1]),
+        jnp.asarray([9], jnp.int32), jnp.asarray([0], jnp.int32),
+        kcb, vcb, cfg=cfg, block_size=bs)
+    lg_ch, kcb, vcb = forward(
+        params, jnp.asarray([[15, 16]], jnp.int32),
+        jnp.asarray([[3, 4]], jnp.int32),
+        jnp.asarray([slots(1, [3, 4])], jnp.int32), jnp.asarray(bt[1:2]),
+        jnp.asarray([5], jnp.int32), jnp.asarray([1], jnp.int32),
+        kcb, vcb, cfg=cfg, block_size=bs)
+
+    # ragged: launch 1 packs both prompts as chunks of ONE launch;
+    # launch 2 mixes a decode row (row 0) with a prefill chunk (row 1)
+    step = make_ragged_step_fn(cfg, bs)
+    kc, vc = allocate_device_cache(cfg, num_blocks, bs, dtype=jnp.float32)
+
+    def pack(work):  # work: list of (cache_row, tokens, positions)
+        T = sum(len(t) for _, t, _ in work)
+        C, S_C = ragged_grid_shape(T)
+        ints5 = np.zeros((5, T), np.int32)
+        ints5[3] = C  # decode/padding tokens route to the dump tile
+        rows3 = np.zeros((len(work), 3), np.int32)
+        grid_rows = np.zeros((C,), np.int32)
+        t = tile = 0
+        for i, (b, toks, poss) in enumerate(work):
+            q = len(toks)
+            rows3[i] = (t, q, poss[-1] + 1)
+            ints5[0, t:t + q] = toks
+            ints5[1, t:t + q] = poss
+            ints5[2, t:t + q] = slots(b, poss)
+            if q > 1:
+                for off in range(0, q, S_C):
+                    w = min(S_C, q - off)
+                    grid_rows[tile] = i
+                    ints5[3, t + off:t + off + w] = tile
+                    ints5[4, t + off:t + off + w] = np.arange(w)
+                    tile += 1
+            t += q
+        return (jnp.asarray(ints5), jnp.asarray(rows3),
+                jnp.asarray(grid_rows))
+
+    i5, r3, gr = pack([(0, rows[0], list(range(8))),
+                       (1, rows[1], list(range(3)))])
+    lg1, kc, vc = step(params, i5, r3, gr, jnp.asarray(bt), kc, vc)
+    for b in range(B):
+        np.testing.assert_allclose(np.asarray(lg1[b]), want[b],
+                                   atol=1e-4, rtol=1e-3)
+        assert int(np.argmax(lg1[b])) == int(np.argmax(want[b]))
+
+    i5, r3, gr = pack([(0, [54], [8]), (1, [15, 16], [3, 4])])
+    lg2, kc, vc = step(params, i5, r3, gr, jnp.asarray(bt), kc, vc)
+    np.testing.assert_allclose(np.asarray(lg2[0]), np.asarray(lg_dec[0]),
+                               atol=1e-4, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(lg2[1]), np.asarray(lg_ch[0]),
+                               atol=1e-4, rtol=1e-3)
+
+
 def test_mla_pallas_decode_matches_xla():
     """The Pallas latent-decode kernel (interpret mode on CPU) must equal
     the XLA gather path bit-for-bit-ish on a lane-aligned config."""

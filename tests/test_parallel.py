@@ -299,7 +299,9 @@ def test_pp_forward_windows_and_sinks_match_dense():
 
 
 def test_pp_decode_step_matches_dense():
-    """Single-token decode (S=1) through the pipeline after a prefill."""
+    """Single-token decode through the pipeline after a prefill — dispatched
+    as PACKED ragged microbatches (the make_pp_step_fn contract: each
+    microbatch is a ragged plan slice, two decode rows per bin here)."""
     from dynamo_tpu.engine import model as Mo
     from dynamo_tpu.engine.config import ModelConfig
     from dynamo_tpu.parallel.pipeline import make_pp_step_fn
@@ -331,13 +333,29 @@ def test_pp_decode_step_matches_dense():
     csh = Mo.cache_shardings(mesh, cfg)
     p_pp = jax.device_put(params, sh)
     step = make_pp_step_fn(cfg, block_size, mesh)
-    d_tok, d_pos, d_slot, d_bt, d_lens, d_last = dec
-    d_ints3 = jnp.stack([d_tok, d_pos, d_slot], axis=1)
-    d_ll = jnp.stack([d_lens, d_last], axis=1)
-    got, _, _ = step(p_pp, d_ints3, d_ll, d_bt, jax.device_put(kc, csh),
-                     jax.device_put(vc, csh))
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               atol=1e-5, rtol=1e-5)
+    d_tok, d_pos, d_slot, d_bt, d_lens, _ = dec
+    # pack B decode rows into M=2 ragged microbatches of R=T=2 each
+    M, R = 2, 2
+    T = R
+    C, _ = Mo.ragged_grid_shape(T)
+    ints5 = np.zeros((M, 5, T), np.int32)
+    rows3 = np.zeros((M, R, 3), np.int32)
+    bt_mb = np.zeros((M, R, W), np.int32)
+    for m in range(M):
+        for j in range(R):
+            i = m * R + j
+            ints5[m, 0, j] = int(d_tok[i, 0])
+            ints5[m, 1, j] = int(d_pos[i, 0])
+            ints5[m, 2, j] = int(d_slot[i, 0])
+            ints5[m, 3, j] = C          # dump tile: no chunk grid work
+            rows3[m, j] = (j, 1, int(d_lens[i]))
+            bt_mb[m, j] = np.asarray(d_bt[i])
+    grid_rows = np.zeros((M, C), np.int32)
+    got, _, _ = step(p_pp, jnp.asarray(ints5), jnp.asarray(rows3),
+                     jnp.asarray(grid_rows), jnp.asarray(bt_mb),
+                     jax.device_put(kc, csh), jax.device_put(vc, csh))
+    np.testing.assert_allclose(np.asarray(got).reshape(B, -1),
+                               np.asarray(want), atol=1e-5, rtol=1e-5)
 
 
 def test_pp_compatibility_guards():

@@ -154,12 +154,9 @@ async def test_step_exception_fails_all_inflight_then_recovers():
             return real(*a)
         return boom
 
-    # wrap every step entry point: the ragged engine dispatches through
-    # ragged_fn/ragged_dec_fn, the bucketed fallback through step_fn
-    eng.step_fn = wrap(eng.step_fn)
-    if eng.ragged_fn is not None:
-        eng.ragged_fn = wrap(eng.ragged_fn)
-        eng.ragged_dec_fn = wrap(eng.ragged_dec_fn)
+    # wrap both ragged step entry points (mixed + pipelined decode-only)
+    eng.ragged_fn = wrap(eng.ragged_fn)
+    eng.ragged_dec_fn = wrap(eng.ragged_dec_fn)
     results = await asyncio.gather(
         collect(eng, req(range(1, 12), max_tokens=50)),
         collect(eng, req(range(20, 33), max_tokens=50)))
@@ -218,25 +215,27 @@ async def test_starved_engine_makes_progress():
 
 
 async def test_warmup_compiles_each_bucket_exactly_once():
-    """The AOT warmup pass dispatches exactly one dummy step per configured
-    bucket signature, and a real request inside the warmed envelope adds NO
-    new step signature (its compiles were all paid up front)."""
-    # the BUCKETED warmup contract (--no-ragged-step); the ragged warmup's
-    # token-bucket contract is pinned in tests/test_ragged.py
-    eng = tiny_engine(ragged_step=False)
+    """The AOT warmup pass dispatches exactly one dummy step per ragged
+    signature (token bucket × variant), and a real request inside the
+    warmed envelope adds NO new step signature (its compiles were all
+    paid up front)."""
+    eng = tiny_engine()
     sigs = []
-    real = eng.step_fn
 
-    def counting(params, ints3, lens_last, bt, k, v):
-        sigs.append((tuple(ints3.shape), tuple(bt.shape)))
-        return real(params, ints3, lens_last, bt, k, v)
+    def wrap(kind, real):
+        def counting(params, ints5, rows3, gr, bt, k, v):
+            sigs.append((kind, tuple(ints5.shape)))
+            return real(params, ints5, rows3, gr, bt, k, v)
+        return counting
 
-    eng.step_fn = counting
+    eng.ragged_fn = wrap("ragged", eng.ragged_fn)
+    eng.ragged_dec_fn = wrap("ragged_dec", eng.ragged_dec_fn)
     rep = await eng.warmup(seq_lens=[14])
-    # every configured prefill bucket is covered (some at several widths —
-    # chunked continuations grow the table width within one chunk bucket)
-    assert sorted({s for _, s, _ in rep["prefill"]}) == [8, 16, 32, 64]
-    assert sorted(b for b, _ in rep["decode"]) == [1, 2, 4, 8]
+    buckets = list(eng.args.ragged_token_buckets)
+    # both variants trace every configured token bucket, exactly once
+    for kind in ("ragged", "ragged_dec"):
+        assert sorted(t for k, t, *_ in rep["ragged"] if k == kind) \
+            == buckets
     assert len(sigs) == len(set(sigs)), "duplicate warmup dispatch"
     warm = set(sigs)
     # prompt 10 + 4 generated = 14 tokens: inside the warmed envelope

@@ -1,14 +1,18 @@
-"""Ragged paged attention + ragged engine step (ISSUE 7).
+"""Ragged paged attention + the ragged engine step — the engine's ONLY
+step path (ISSUE 7 introduced it; ISSUE 17 deleted the bucketed path).
 
 Covers: the Pallas ragged kernel against its XLA oracle (interpret mode),
-the stacked-cache XLA ragged path against the bucketed attention math,
-ragged-vs-bucketed engine equivalence (bit-identical greedy AND seeded
-streams for decode-only / chunked-prefill-only / mixed batches, sliding
-windows, int8 KV), mid-step cancellation, the --no-ragged-step fallback
-gate, token-budget planning (chunk-clamp deletion), warmup shrinking to
-the token buckets, the padded-token / compiled-signature metrics, the
-mocker's token-budget planning mode, and the multi-host warmup-skip
-readiness surfacing.
+the stacked-cache XLA ragged path against the bucketed attention math
+(kept in model.py as a test oracle), packing-invariance of the streams
+(bit-identical greedy AND seeded streams across different chunking /
+co-scheduling configs for decode-only / chunked-prefill-only / mixed
+batches, sliding windows, int8 KV), per-mode parity against the legacy
+bucketed oracles (spec verify, multi-step decode), mid-step cancellation,
+the single-path invariant (no escape hatch, token-bucket-only signature
+census incl. the 70B serving geometry), token-budget planning
+(chunk-clamp deletion), warmup tracing exactly the token buckets, the
+padded-token / compiled-signature metrics, the mocker's token-budget
+planning mode, and the multi-host warmup-skip readiness surfacing.
 """
 
 import asyncio
@@ -184,14 +188,14 @@ async def collect(eng, r, ctx=None):
 
 
 async def assert_streams_equal(prompts, max_tokens=10, sampling=(),
-                               ragged_kw=None, bucketed_kw=None,
-                               stagger=False):
-    """Ragged and bucketed engines must emit bit-identical streams."""
+                               kw_a=None, kw_b=None, stagger=False):
+    """Two ragged engines with DIFFERENT packing configs must emit
+    bit-identical streams: how tokens pack into the launch (chunk split,
+    co-scheduling, bucket padding) must never leak into the stream."""
     for s in sampling or ({},):
-        e_r = tiny_engine(**(ragged_kw or {}))
-        e_b = tiny_engine(ragged_step=False, **(bucketed_kw or ragged_kw
-                                                or {}))
-        assert e_r._ragged and not e_b._ragged
+        e_r = tiny_engine(**(kw_a or {}))
+        e_b = tiny_engine(**(kw_b if kw_b is not None
+                             else dict(max_num_batched_tokens=24)))
 
         async def run(eng):
             if not stagger:
@@ -218,22 +222,24 @@ async def assert_streams_equal(prompts, max_tokens=10, sampling=(),
         await e_b.close()
 
 
-async def test_ragged_matches_bucketed_decode_only():
+async def test_ragged_packing_invariant_decode_only():
     prompts = [[3, 4, 5], [9, 8], [11, 12, 13, 14]]
     await assert_streams_equal(prompts, max_tokens=12,
                                sampling=({}, dict(temperature=0.8, seed=7)))
 
 
-async def test_ragged_matches_bucketed_chunked_prefill():
-    """Long prompts forced through multiple budget-sized chunks."""
+async def test_ragged_packing_invariant_chunked_prefill():
+    """Long prompts forced through multiple budget-sized chunks; the two
+    budgets split the prompts into different chunk sequences."""
     prompts = [list(range(1, 120)), list(range(120, 221))]
     await assert_streams_equal(
         prompts, max_tokens=6,
         sampling=({}, dict(temperature=0.6, seed=3)),
-        ragged_kw=dict(max_num_batched_tokens=32, prefill_buckets=(8, 32)))
+        kw_a=dict(max_num_batched_tokens=32),
+        kw_b=dict(max_num_batched_tokens=64))
 
 
-async def test_ragged_matches_bucketed_mixed():
+async def test_ragged_packing_invariant_mixed():
     """Staggered arrivals: prefill chunks ride steps that carry decode
     rows — the regime the ragged launch exists for."""
     prompts = [list(range(1, 50)), list(range(60, 75)),
@@ -243,12 +249,12 @@ async def test_ragged_matches_bucketed_mixed():
         sampling=({}, dict(temperature=0.9, seed=11)), stagger=True)
 
 
-async def test_ragged_sliding_window_parity():
+async def test_ragged_sliding_window_packing_invariant():
     cfg = dataclasses.replace(ModelConfig.tiny(), sliding_window=8)
     prompts = [list(range(1, 40)), list(range(50, 64))]
     for s in ({}, dict(temperature=0.7, seed=5)):
         e_r = tiny_engine(cfg=cfg)
-        e_b = tiny_engine(cfg=cfg, ragged_step=False)
+        e_b = tiny_engine(cfg=cfg, max_num_batched_tokens=24)
         a = await asyncio.gather(*[collect(e_r, req(p, max_tokens=8, **s))
                                    for p in prompts])
         b = await asyncio.gather(*[collect(e_b, req(p, max_tokens=8, **s))
@@ -258,14 +264,14 @@ async def test_ragged_sliding_window_parity():
         await e_b.close()
 
 
-async def test_ragged_int8_kv_parity():
+async def test_ragged_int8_kv_packing_invariant():
     """int8 paged cache: the ragged path dequantizes in the gather (same
-    contract as every XLA attention read) — streams stay bit-identical to
-    the bucketed int8 path."""
+    contract as every XLA attention read) — streams stay bit-identical
+    across packing configs."""
     prompts = [list(range(1, 30)), list(range(40, 55))]
     for s in ({}, dict(temperature=0.8, seed=9)):
         e_r = tiny_engine(kv_cache_dtype="int8")
-        e_b = tiny_engine(kv_cache_dtype="int8", ragged_step=False)
+        e_b = tiny_engine(kv_cache_dtype="int8", max_num_batched_tokens=24)
         a = await asyncio.gather(*[collect(e_r, req(p, max_tokens=8, **s))
                                    for p in prompts])
         b = await asyncio.gather(*[collect(e_b, req(p, max_tokens=8, **s))
@@ -307,16 +313,20 @@ async def test_ragged_mid_step_cancel():
     await eng.close()
 
 
-async def test_no_ragged_step_gate_restores_bucketed_path():
-    """The escape hatch restores the old path wholesale: no ragged fn is
-    built, every dispatched signature is a bucketed kind."""
-    eng = tiny_engine(ragged_step=False)
-    assert eng.ragged_fn is None and not eng._ragged
-    assert not eng.scheduler.token_budget
+async def test_ragged_is_the_only_path():
+    """The bucketed step and its escape hatch are GONE: EngineArgs rejects
+    ragged_step, the engine always builds the ragged fns, the scheduler
+    always plans against the token budget, and every dispatched signature
+    is a ragged-family kind."""
+    with pytest.raises(TypeError):
+        EngineArgs(ragged_step=False)
+    eng = tiny_engine()
+    assert eng.ragged_fn is not None and eng.ragged_dec_fn is not None
+    assert eng.scheduler.token_budget
     toks, _ = await collect(eng, req(range(1, 20), max_tokens=6))
     assert len(toks) == 6
     kinds = {sig[0] for sig in eng.compiled_signatures}
-    assert "ragged" not in kinds and "step" in kinds
+    assert kinds and kinds <= {"ragged", "ragged_dec"}
     await eng.close()
 
 
@@ -341,6 +351,140 @@ async def test_ragged_pipelined_decode_equivalence():
         await e_off.close()
 
 
+# ------------------------------- per-mode parity vs the legacy oracles
+#
+# The bucketed step fns stay in model.py as TEST ORACLES only; these
+# tests pin each migrated mode's ragged dispatch to the legacy math
+# before/after the path deletion (ISSUE 17 acceptance).
+
+
+def _alloc_bt(B, W, nxt=1):
+    """Disjoint contiguous page ranges per row (no cross-row collisions)."""
+    bt = np.zeros((B, W), np.int32)
+    for b in range(B):
+        bt[b] = np.arange(nxt, nxt + W)
+        nxt += W
+    return bt, nxt + 1
+
+
+def _prefill_rows(M, params, cfg, prompts, bt, bs, kc, vc):
+    """Write each prompt's KV through the plain forward (one row at a
+    time — the reference prefill both variants share)."""
+    for b, row in enumerate(prompts):
+        n = len(row)
+        toks = jnp.asarray([row], jnp.int32)
+        pos = jnp.asarray([np.arange(n)], jnp.int32)
+        slot = jnp.asarray([[int(bt[b, i // bs]) * bs + i % bs
+                             for i in range(n)]], jnp.int32)
+        _, kc, vc = M.forward(params, toks, pos, slot,
+                              jnp.asarray(bt[b:b + 1]),
+                              jnp.asarray([n], jnp.int32),
+                              jnp.asarray([n - 1], jnp.int32),
+                              kc, vc, cfg=cfg, block_size=bs)
+    return kc, vc
+
+
+def test_ragged_verify_matches_legacy_verify_fn():
+    """Spec-decode verification as ragged rows (q_len = draft+1 on the
+    packed launch) returns the same greedy ids/logps as the legacy [B, S]
+    verify oracle."""
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.cache import allocate_device_cache
+
+    cfg = ModelConfig.tiny()
+    params = M.init_params(cfg, jax.random.key(7), dtype=jnp.float32)
+    bs, W, K = 4, 8, 2
+    S = 1 + K
+    prompts = [[5, 9, 17, 23, 42], [7, 11, 13, 3, 29, 31, 8]]
+    drafts = [[21, 34], [55, 89]]
+    last = [61, 62]  # each row's newest token (KV not yet written)
+    B = len(prompts)
+    bt, num_blocks = _alloc_bt(B, W)
+
+    ints3 = np.zeros((B, 3, S), np.int32)
+    kv_lens = np.zeros((B,), np.int32)
+    for b, row in enumerate(prompts):
+        n = len(row)
+        pos = np.arange(n, n + S)
+        ints3[b, 0] = [last[b]] + drafts[b]
+        ints3[b, 1] = pos
+        ints3[b, 2] = [int(bt[b, p // bs]) * bs + p % bs for p in pos]
+        kv_lens[b] = n + 1 + K
+
+    kc, vc = allocate_device_cache(cfg, num_blocks, bs, dtype=jnp.float32)
+    kc, vc = _prefill_rows(M, params, cfg, prompts, bt, bs, kc, vc)
+    legacy = M.make_verify_fn(cfg, bs)
+    ids_l, lps_l, _, _ = legacy(params, jnp.asarray(ints3), jnp.asarray(bt),
+                                jnp.asarray(kv_lens), kc, vc)
+
+    # ragged: the same rows packed flat — every row is a chunk on the grid
+    T = B * S
+    C, S_C = M.ragged_grid_shape(T)
+    ints5 = np.zeros((5, T), np.int32)
+    rows3 = np.zeros((B, 3), np.int32)
+    grid_rows = np.zeros((C,), np.int32)
+    tile = 0
+    for b in range(B):
+        q0 = b * S
+        rows3[b] = (q0, S, kv_lens[b])
+        ints5[:3, q0:q0 + S] = ints3[b]
+        for off in range(0, S, S_C):
+            w = min(S_C, S - off)
+            grid_rows[tile] = b
+            ints5[3, q0 + off:q0 + off + w] = tile
+            ints5[4, q0 + off:q0 + off + w] = np.arange(w)
+            tile += 1
+    kc, vc = allocate_device_cache(cfg, num_blocks, bs, dtype=jnp.float32)
+    kc, vc = _prefill_rows(M, params, cfg, prompts, bt, bs, kc, vc)
+    ragged = M.make_ragged_verify_fn(cfg, bs)
+    ids_r, lps_r, _, _ = ragged(params, jnp.asarray(ints5),
+                                jnp.asarray(rows3), jnp.asarray(grid_rows),
+                                jnp.asarray(bt), kc, vc)
+    for b in range(B):
+        q0 = b * S
+        assert (np.asarray(ids_r[q0:q0 + S]).tolist()
+                == np.asarray(ids_l[b]).tolist()), f"row {b} ids diverged"
+        np.testing.assert_allclose(np.asarray(lps_r[q0:q0 + S]),
+                                   np.asarray(lps_l[b]),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_multi_decode_ragged_matches_bucketed_scan():
+    """The multi-step fused decode scan body now runs the packed ragged
+    layout; tokens and logps match the legacy bucketed scan exactly
+    (greedy AND seeded rows)."""
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.cache import allocate_device_cache
+
+    cfg = ModelConfig.tiny()
+    params = M.init_params(cfg, jax.random.key(9), dtype=jnp.float32)
+    bs, W = 4, 8
+    prompts = [[5, 9, 17, 23, 42], [7, 11, 13]]
+    B = len(prompts)
+    bt, num_blocks = _alloc_bt(B, W)
+
+    ints = np.zeros((B, 4), np.int32)
+    floats = np.zeros((B, 2), np.float32)
+    rand = np.zeros((B, 2), np.uint32)
+    for b, row in enumerate(prompts):
+        n = len(row)
+        ints[b] = (61 + b, n, n + 1, 0)  # last_tok, position, kv_len, top_k
+        floats[b] = (0.8 if b else 0.0, 1.0)  # greedy row + seeded row
+        rand[b] = (b + 1, 0)
+    outs = {}
+    for ragged in (False, True):
+        kc, vc = allocate_device_cache(cfg, num_blocks, bs,
+                                       dtype=jnp.float32)
+        kc, vc = _prefill_rows(M, params, cfg, prompts, bt, bs, kc, vc)
+        fn = M.make_multi_decode_fn(cfg, bs, num_steps=3, ragged=ragged)
+        t, lp, _, _ = fn(params, jnp.asarray(ints), jnp.asarray(floats),
+                         jnp.asarray(rand), jnp.asarray(bt), kc, vc)
+        outs[ragged] = (np.asarray(t), np.asarray(lp))
+    assert outs[True][0].tolist() == outs[False][0].tolist()
+    np.testing.assert_allclose(outs[True][1], outs[False][1],
+                               atol=1e-5, rtol=1e-5)
+
+
 # ------------------------------------------------- planning + telemetry
 
 
@@ -357,12 +501,12 @@ async def test_token_budget_plan_deletes_chunk_clamp():
         "first ragged step should carry the whole 31-token prompt"
     await eng.close()
 
-    e_b = tiny_engine(max_num_batched_tokens=32, prefill_buckets=(8,),
-                      ragged_step=False)
+    # a tighter budget must chunk — and chunking must not change the stream
+    e_b = tiny_engine(max_num_batched_tokens=8, prefill_buckets=(8,))
     toks_b, _ = await collect(e_b, req(range(1, 32), max_tokens=2))
-    assert toks_b == toks  # chunking must not change the stream
-    pre = [e for e in e_b.step_trace if e[0] == "prefill"]
-    assert len(pre) >= 4, "bucketed path should need >= 4 clamped chunks"
+    assert toks_b == toks
+    ragged_b = [e for e in e_b.step_trace if e[0] == "ragged"]
+    assert len(ragged_b) >= 4, "8-token budget should need >= 4 chunks"
     await e_b.close()
 
 
@@ -381,25 +525,54 @@ async def test_padded_tokens_and_signature_metrics():
     await eng.close()
 
 
+def _bucketed_lattice_size(args) -> int:
+    """Signature count of the DELETED bucketed warmup lattice for the same
+    args — (prefill bucket × table width) + (decode batch bucket × table
+    width) — kept as arithmetic so the census comparison survives the
+    path's deletion."""
+    widths = {args.bucket_table_width(l)
+              for l in range(args.block_size, args.max_model_len + 1,
+                             args.block_size)}
+    return (len(args.prefill_buckets) + len(args.decode_batch_buckets)) \
+        * len(widths)
+
+
 async def test_warmup_shrinks_to_token_buckets():
     """Ragged warmup traces exactly the configured token buckets — a
-    handful — while the bucketed warmup walks the (chunk × width × batch)
-    lattice."""
+    handful — where the deleted bucketed warmup walked the
+    (chunk × width × batch) lattice."""
     kw = dict(block_size=4, num_blocks=256, max_num_seqs=8,
               max_num_batched_tokens=128, max_model_len=256)
     e_r = tiny_engine(**kw)
     rep_r = await e_r.warmup(seq_lens=[128], prefill_batches=[1, 4])
-    # two variants (mixed + decode-only) per token bucket
+    # two variants (mixed + decode-only) per token bucket, nothing else
     assert len(rep_r["ragged"]) == 2 * len(e_r.args.ragged_token_buckets)
-    sigs_r = len(rep_r["ragged"])
+    assert {k for k, *_ in rep_r["ragged"]} == {"ragged", "ragged_dec"}
+    assert len(rep_r["ragged"]) < _bucketed_lattice_size(e_r.args)
     await e_r.close()
 
-    e_b = tiny_engine(**kw, ragged_step=False)
-    rep_b = await e_b.warmup(seq_lens=[128], prefill_batches=[1, 4])
-    sigs_b = len(rep_b["prefill"]) + len(rep_b["decode"]) + \
-        len(rep_b["multi"])
-    await e_b.close()
-    assert sigs_r < sigs_b, (sigs_r, sigs_b)
+
+async def test_signature_census_70b_geometry():
+    """At the flagship 70B serving geometry (llama3-70b-v5e64 recipe's
+    block/budget/batch shape, tiny weights — signatures depend on args
+    geometry, not parameters) the compiled-signature universe stays at the
+    token-bucket count: every dispatched signature is (kind, T) with T a
+    configured token bucket, and the full warmable census is strictly
+    below the deleted bucketed lattice for the same args."""
+    eng = tiny_engine(block_size=16, num_blocks=512, max_num_seqs=64,
+                      max_num_batched_tokens=2048, max_model_len=8192,
+                      prefill_buckets=(), decode_batch_buckets=(),
+                      ragged_token_buckets=())
+    args = eng.args
+    toks, _ = await collect(eng, req(range(1, 20), max_tokens=4))
+    assert len(toks) == 4
+    buckets = set(args.ragged_token_buckets)
+    for sig in eng.compiled_signatures:
+        assert sig[0] in ("ragged", "ragged_dec") and sig[1] in buckets, sig
+    census = 2 * len(args.ragged_token_buckets)
+    assert census < _bucketed_lattice_size(args), \
+        (census, _bucketed_lattice_size(args))
+    await eng.close()
 
 
 async def test_mocker_token_budget_plan():
