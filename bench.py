@@ -3397,20 +3397,21 @@ def _child_main():
               os.environ.get("DYN_BENCH_PHASES",
                              "kernel,spec,e2e,chaos,mem,qos,autoscale,"
                              "ragged,raggedmodes,disagg,migration,onboard,"
-                             "flight,tools,attribution,kvaudit,flagship"
+                             "flight,tools,attribution,kvaudit,flagship,"
+                             "frontdoor"
                              ).split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
                         "autoscale", "ragged", "raggedmodes", "disagg",
                         "migration", "onboard", "flight", "tools",
-                        "attribution", "kvaudit", "flagship"}
+                        "attribution", "kvaudit", "flagship", "frontdoor"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
                          f"chaos, mem, qos, autoscale, ragged, raggedmodes, "
                          f"disagg, migration, onboard, flight, tools, "
-                         f"attribution, kvaudit, flagship)")
+                         f"attribution, kvaudit, flagship, frontdoor)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -3561,6 +3562,19 @@ def _child_main():
                 kern["flagship"] = flag
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["flagship_error"] = repr(e)[:200]
+        if "frontdoor" in phases:
+            # front-door chaos phase: 3 frontend replicas on one KV-fed
+            # routing view, one SIGKILLed mid-peak + hub primary killed
+            # under live load — 100% completion with bounded client
+            # retries, zero lost/dup tokens, cross-replica radix digest
+            # agreement, zero leaked seqs/blocks, auditor + autoscale loop
+            # surviving promotion (ISSUE 18 acceptance)
+            try:
+                from benchmarks.flagship_drive import frontdoor_drive
+
+                kern["frontdoor"] = asyncio.run(frontdoor_drive(22.0))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["frontdoor_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

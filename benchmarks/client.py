@@ -27,6 +27,10 @@ class RequestResult:
     #: accounting reads this
     completion_tokens: int = 0
     error: Optional[str] = None
+    #: front-door failover accounting (stream_request_ha): total attempts
+    #: made and the URL that produced this result
+    attempts: int = 1
+    url: Optional[str] = None
 
 
 def make_prompt(rng: random.Random, n_words: int, prefix: str = "") -> str:
@@ -146,6 +150,48 @@ async def stream_request(session: aiohttp.ClientSession, url: str, model: str,
     except Exception as e:
         res.error = repr(e)
         return res
+
+
+def _retryable(res: RequestResult) -> bool:
+    """Failures worth re-driving at ANOTHER replica: connection refused/
+    reset, a stream the peer's death broke mid-decode, a draining 503, or
+    an overloaded 429. Deterministic client errors (400/404/401…) are NOT
+    — they would fail identically everywhere."""
+    if res.ok:
+        return False
+    err = res.error or ""
+    if err.startswith("http "):
+        return err in ("http 429", "http 503")
+    return True
+
+
+async def stream_request_ha(session: aiohttp.ClientSession, urls: list[str],
+                            model: str, prompt: str, max_tokens: int,
+                            headers: Optional[dict] = None,
+                            max_attempts: int = 4,
+                            backoff_s: float = 0.25,
+                            start: int = 0) -> RequestResult:
+    """Client-transparent front-door failover (docs/robustness.md "Front
+    door"): drive ``stream_request`` against a list of frontend replica
+    URLs, retrying refused/broken streams on the next replica with bounded
+    attempts. Token accounting stays EXACT: a retry restarts the stream
+    from scratch and only the final attempt's tokens/usage are kept — the
+    killed frontend's worker-side seqs are cancelled via response-plane
+    peer death, so the abandoned attempt serves nothing the client counts.
+    ``start`` offsets the first URL so concurrent callers spread load."""
+    urls = [u for u in urls if u]
+    res = RequestResult(ok=False, error="no frontend urls")
+    for attempt in range(max_attempts):
+        url = urls[(start + attempt) % len(urls)]
+        res = await stream_request(session, url, model, prompt, max_tokens,
+                                   headers=headers)
+        res.attempts = attempt + 1
+        res.url = url
+        if res.ok or not _retryable(res):
+            return res
+        if attempt + 1 < max_attempts:
+            await asyncio.sleep(backoff_s * (attempt + 1))
+    return res
 
 
 async def run_closed_loop(url: str, model: str, *, concurrency: int,

@@ -475,6 +475,433 @@ async def drive(duration_s: float = 40.0, scale: float = 1.0,
     return out
 
 
+async def _spawn_frontend(idx: int, env: dict, timeout_s: float = 40.0):
+    """Launch ``python -m dynamo_tpu.frontend.main`` as replica ``fe-<idx>``
+    and wait for its FRONTEND_READY line. Returns (proc, port, drain_task);
+    the drain task keeps consuming stdout so the pipe can never backpressure
+    the child."""
+    import sys
+
+    debug = bool(os.environ.get("DYN_DRIVE_DEBUG"))
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "dynamo_tpu.frontend.main",
+        "--port", "0", "--replica-id", f"fe-{idx}", "--router-mode", "kv",
+        env=env, stdout=asyncio.subprocess.PIPE,
+        stderr=(None if debug else asyncio.subprocess.DEVNULL))
+    port = None
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            line = await asyncio.wait_for(proc.stdout.readline(),
+                                          deadline - time.monotonic())
+        except asyncio.TimeoutError:
+            break
+        if not line:
+            break
+        text = line.decode(errors="replace").strip()
+        if text.startswith("FRONTEND_READY"):
+            port = int(text.rpartition("=")[2])
+            break
+    if port is None:
+        proc.kill()
+        raise RuntimeError(f"frontend fe-{idx} never became ready")
+
+    async def _drain():
+        while await proc.stdout.readline():
+            pass
+
+    return proc, port, asyncio.get_running_loop().create_task(_drain())
+
+
+async def frontdoor_drive(duration_s: float = 30.0, seed: int = 1234,
+                          n_frontends: int = 3) -> dict:
+    """Front-door chaos leg (ISSUE 18, docs/robustness.md "Front door").
+
+    N frontend REPLICA subprocesses share one hub-fed KV routing view over
+    a primary+standby hub pair; a mocker fleet serves behind them. The
+    client drives QoS-less traffic through ``stream_request_ha`` (all
+    replica URLs, bounded retries). Mid-peak one frontend is SIGKILLed;
+    shortly after, the hub PRIMARY dies and the standby promotes under
+    live load. Falsifiable gates:
+
+    - 100% client completion within the bounded retry budget, with zero
+      lost and zero duplicated tokens (usage.completion_tokens == OSL
+      exactly, every stream);
+    - the surviving replicas' per-worker radix digests agree after settle
+      (``/v1/kv/digest``), and each survivor force-resynced on the hub
+      epoch change (the in-band epoch marker — no silent seq-continuity
+      loss from the promoted standby);
+    - zero leaked seqs/blocks on the workers once traffic stops (a worker
+      still stepping an orphaned seq keeps publishing fresh metrics —
+      idle-stale aggregation is the no-leak signal);
+    - the KV auditor and the autoscale loop keep cycling AFTER promotion;
+    - the dead replica ages out of the front-door listing while the
+      survivors stay ready.
+    """
+    import sys
+
+    import aiohttp
+    import numpy as np
+    import yaml
+
+    from benchmarks.client import make_prompt, stream_request_ha
+    from dynamo_tpu.deploy.operator import ProcessOperator
+    from dynamo_tpu.runtime import DistributedRuntime, RemoteControlPlane
+    from dynamo_tpu.runtime.control_plane import ControlPlaneServer
+
+    MODEL = "llama3-ha-sim"
+    OSL, ISL_WORDS = 16, 32
+    # bounded failover budget: wide enough that a request landing exactly
+    # on the frontend-kill + hub-promotion overlap can ride out the
+    # reconnect window (attempt backoff spans ~7s), still a hard cap
+    MAX_ATTEMPTS = 6
+    n_prefill, n_decode = 1, 3
+
+    primary = ControlPlaneServer(port=0)
+    p_addr = await primary.start()
+    standby = ControlPlaneServer(port=0, standby_of=p_addr,
+                                 takeover_after=0.8, replicate_interval=0.1)
+    s_addr = await standby.start()
+    addrs = f"{p_addr},{s_addr}"
+
+    env_overrides = {
+        "DYN_CONTROL_PLANE": addrs,
+        "DYN_LEASE_TTL": "2",
+        "DYN_KV_AUDIT_INTERVAL": "2",
+        "DYN_KV_AUDIT_SETTLE": "0.1",
+        "DYN_SLO_MIN_REPLICAS": str(n_decode),
+        "DYN_SLO_MAX_REPLICAS": str(n_decode),
+        "DYN_SLO_INTERVAL_S": "1",
+    }
+    saved_env = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="frontdoor-drive-")
+    spec_path = os.path.join(tmp, "graph.yaml")
+
+    def worker_cmd(component: str) -> list[str]:
+        return [
+            sys.executable, "-m", "dynamo_tpu.mocker.main",
+            "--model", MODEL, "--component", component,
+            "--block-size", "16", "--num-gpu-blocks", "2048",
+            "--max-num-seqs", "8", "--speedup-ratio", "4.0",
+            "--migration-limit", "50",
+        ]
+
+    common_env = {
+        "DYN_CONTROL_PLANE": addrs,
+        "PYTHONPATH": os.pathsep.join(sys.path),
+        "JAX_PLATFORMS": "cpu",
+        "DYN_LEASE_TTL": "2",
+        "DYN_DRAIN_TIMEOUT": "8",
+        "DYN_LOG": "warning",
+    }
+    with open(spec_path, "w") as f:
+        yaml.safe_dump({
+            "apiVersion": "dynamo.tpu/v1alpha1",
+            "kind": "DynamoGraphDeployment",
+            "metadata": {"name": "frontdoor-drive"},
+            "spec": {"services": {
+                "prefill": {"replicas": n_prefill, "plannerRole": "prefill",
+                            "command": worker_cmd("prefill"),
+                            "env": dict(common_env)},
+                "decode": {"replicas": n_decode, "plannerRole": "decode",
+                           "command": worker_cmd("decode"),
+                           "env": dict(common_env)},
+            }},
+        }, f)
+
+    rt = await DistributedRuntime.create(
+        plane=await RemoteControlPlane(addrs).connect())
+    operator = aggregator = runner = None
+    fe_procs: list = []
+    drains: list = []
+    results: list = []
+    promoted_at: Optional[float] = None
+    ticks_at_promotion = 0
+    audit_cycles_post = (0, 0)
+    kill_idx = 1
+    hub_killed = False
+    try:
+        from dynamo_tpu.autoscale import (
+            AutoscaleController, AutoscaleRunner, ObservationFuser,
+            SloConfig, make_planner, plane_readiness,
+        )
+        from dynamo_tpu.planner.perf_interpolation import PerfInterpolator
+        from dynamo_tpu.planner.prometheus import MultiPrometheusSource
+        from dynamo_tpu.planner.virtual_connector import VirtualConnector
+        from dynamo_tpu.router.publisher import MetricsAggregator
+
+        operator = await ProcessOperator(
+            spec_path, plane=rt.plane, tick_s=0.25, drain_timeout=10.0
+        ).start()
+
+        fe_env = {**os.environ, **common_env, **env_overrides}
+        for i in range(n_frontends):
+            proc, port, drain = await _spawn_frontend(i, fe_env)
+            fe_procs.append((proc, port))
+            drains.append(drain)
+        urls = [f"http://127.0.0.1:{p}" for _, p in fe_procs]
+
+        aggregator = await MetricsAggregator(
+            rt.plane, stale_after_s=3.0).start()
+        # the autoscale loop rides the FLEET scrape (MultiPrometheusSource:
+        # per-replica deltas summed, dead replicas dropping out) — pinned
+        # replica bounds, so the gate is "the loop keeps ticking through
+        # both kills", not a scaling decision
+        fuser = ObservationFuser(MultiPrometheusSource(urls), aggregator)
+        slo = SloConfig.load()
+        planner = make_planner(
+            slo, PerfInterpolator([(1.0, 200.0), (4.0, 2500.0)]),
+            PerfInterpolator([(24.0, 20.0), (72.0, 400.0)]),
+            min_prefill_replicas=n_prefill, max_prefill_replicas=n_prefill,
+            no_correction=True)
+
+        async def readiness():
+            return await plane_readiness(rt.plane, "dynamo")
+
+        controller = AutoscaleController(
+            slo, planner, fuser, VirtualConnector(rt.plane),
+            readiness=readiness, metrics=rt.metrics, plane=rt.plane)
+        runner = await AutoscaleRunner(controller).start()
+
+        async with aiohttp.ClientSession() as session:
+            # every replica must discover the model before traffic starts
+            for url in urls:
+                for _ in range(300):
+                    try:
+                        async with session.get(f"{url}/v1/models") as r:
+                            doc = await r.json()
+                        if any(m.get("id") == MODEL
+                               for m in doc.get("data", [])):
+                            break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.1)
+                else:
+                    raise RuntimeError(f"{url} never discovered {MODEL}")
+
+            rng = np.random.default_rng(seed)
+            import random as _random
+            prompt_rng = _random.Random(seed)
+            inflight: set = set()
+            issued = 0
+            fe_killed = False
+            t0 = time.monotonic()
+            while (now := time.monotonic() - t0) < duration_s:
+                if not fe_killed and now >= 0.40 * duration_s:
+                    # SIGKILL one replica mid-peak: no drain, no goodbye —
+                    # its in-flight streams break and must be retried by
+                    # the client, its worker-side seqs cancelled by
+                    # response-plane peer death
+                    os.kill(fe_procs[kill_idx][0].pid, 9)
+                    fe_killed = True
+                if not hub_killed and now >= 0.55 * duration_s:
+                    await primary.stop()  # standby promotes under load
+                    hub_killed = True
+                    ticks_at_promotion = fuser.ticks
+                    promoted_at = now
+                rate = max(0.5, 2.0 + 3.0 * math.sin(
+                    math.pi * now / duration_s))
+                task = asyncio.get_running_loop().create_task(
+                    stream_request_ha(
+                        session, urls, MODEL,
+                        make_prompt(prompt_rng, ISL_WORDS), OSL,
+                        max_attempts=MAX_ATTEMPTS, backoff_s=0.5,
+                        start=issued))
+                issued += 1
+                inflight.add(task)
+                task.add_done_callback(
+                    lambda t: (inflight.discard(t),
+                               results.append(t.result())))
+                await asyncio.sleep(float(rng.exponential(1.0 / rate)))
+            if inflight:
+                await asyncio.gather(*inflight, return_exceptions=True)
+
+            await _wait_for_async(lambda: not standby.is_standby,
+                                  10.0, "standby promotion")
+
+            survivors = [u for i, u in enumerate(urls) if i != kill_idx]
+
+            async def _digest(url: str) -> Optional[dict]:
+                try:
+                    async with session.get(
+                            f"{url}/v1/kv/digest",
+                            timeout=aiohttp.ClientTimeout(total=3)) as r:
+                        return await r.json()
+                except Exception:
+                    return None
+
+            # settle: the survivors' per-worker radix digests must agree
+            digests_agree = False
+            resyncs_each: list = []
+            last_docs: list = []
+            for _ in range(60):
+                docs = [await _digest(u) for u in survivors]
+                last_docs = docs
+                if all(d is not None for d in docs):
+                    views = [d.get("models", {}).get(MODEL, {})
+                             for d in docs]
+                    if views[0] and all(v == views[0] for v in views[1:]):
+                        digests_agree = True
+                        resyncs_each = [
+                            (d.get("cursors", {}).get(MODEL, {})
+                             .get("resyncs_requested", 0)) for d in docs]
+                        break
+                await asyncio.sleep(0.25)
+            if not digests_agree and os.environ.get("DYN_DRIVE_DEBUG"):
+                print(f"DRIVE_DEBUG digests: {json.dumps(last_docs)}",
+                      flush=True)
+
+            # auditor continuing post-promotion: cycles advance
+            async def _audit_cycles(url: str) -> int:
+                try:
+                    async with session.get(
+                            f"{url}/v1/kv/audit",
+                            timeout=aiohttp.ClientTimeout(total=3)) as r:
+                        doc = await r.json()
+                    return sum(int(m.get("cycles", 0))
+                               for m in (doc.get("models") or doc).values()
+                               if isinstance(m, dict))
+                except Exception:
+                    return -1
+
+            c0 = await _audit_cycles(survivors[0])
+            await asyncio.sleep(3.0)
+            c1 = await _audit_cycles(survivors[0])
+            audit_cycles_post = (c0, c1)
+
+            # the dead replica's lease expires; survivors stay ready
+            frontends_ready = -1
+            fe_doc: dict = {}
+            for _ in range(40):
+                try:
+                    async with session.get(
+                            f"{survivors[0]}/v1/fleet/frontends") as r:
+                        fe_doc = await r.json()
+                    if fe_doc.get("count") == n_frontends - 1:
+                        frontends_ready = fe_doc.get("ready", -1)
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.25)
+            if frontends_ready < 0 and os.environ.get("DYN_DRIVE_DEBUG"):
+                print(f"DRIVE_DEBUG frontends: {json.dumps(fe_doc)}",
+                      flush=True)
+
+            # fleet scorecard's cross-replica convergence check, from a
+            # survivor's own point of view
+            radix_check_ok = None
+            try:
+                async with session.get(
+                        f"{survivors[0]}/v1/fleet/scorecard") as r:
+                    scorecard_doc = await r.json()
+                for c in scorecard_doc.get("checks", []):
+                    if c.get("name") == "radix_replica_agreement":
+                        radix_check_ok = bool(c.get("ok"))
+            except Exception:
+                pass
+
+            # no-leak settle: with traffic stopped, a worker still
+            # stepping an orphaned seq keeps publishing fresh metrics —
+            # after the stale window, any non-stale active/waiting slot IS
+            # a leak
+            await asyncio.sleep(4.0)
+            agg = aggregator.aggregate()
+            leaked_seqs = (agg["requests_active"] + agg["requests_waiting"]
+                           if agg["workers"] else 0)
+            leaked_blocks = agg["kv_active_blocks"] if agg["workers"] else 0
+        ticks_end = fuser.ticks
+        fe_rc = fe_procs[kill_idx][0].returncode
+    finally:
+        if runner is not None:
+            await runner.stop()
+        if aggregator is not None:
+            await aggregator.stop()
+        for proc, _ in fe_procs:
+            if proc.returncode is None:
+                proc.terminate()
+        for proc, _ in fe_procs:
+            if proc.returncode is None:
+                try:
+                    await asyncio.wait_for(proc.wait(), 15.0)
+                except asyncio.TimeoutError:
+                    proc.kill()
+        for d in drains:
+            d.cancel()
+        if operator is not None:
+            await operator.stop()
+        await rt.shutdown()
+        await standby.stop()
+        if not hub_killed:
+            await primary.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    ok = [r for r in results if r.ok]
+    lost_tokens = sum(max(0, OSL - r.completion_tokens) for r in ok)
+    dup_tokens = sum(max(0, r.completion_tokens - OSL) for r in ok)
+    retried = [r for r in results if r.attempts > 1]
+    errors: dict = {}
+    for r in results:
+        if not r.ok:
+            key = (r.error or "?")[:80]
+            errors[key] = errors.get(key, 0) + 1
+    out = {
+        "workload": (f"{len(results)} reqs over {duration_s:.0f}s, "
+                     f"OSL {OSL}, {n_frontends} frontend replicas, "
+                     f"fe-{kill_idx} SIGKILLed @40%, hub primary killed "
+                     f"@55%"),
+        "requests": len(results), "ok": len(ok),
+        "failed": len(results) - len(ok),
+        "failure_errors": errors,
+        "retried": len(retried),
+        "max_attempts_seen": max((r.attempts for r in results), default=0),
+        "lost_tokens": lost_tokens,
+        "dup_tokens": dup_tokens,
+        "frontend_killed_rc": fe_rc,
+        "hub_promoted": not standby.is_standby,
+        "promoted_at_s": round(promoted_at, 2) if promoted_at else None,
+        "digests_agree": digests_agree,
+        "replica_resyncs": resyncs_each,
+        "radix_check_ok": radix_check_ok,
+        "frontends_ready_after": frontends_ready,
+        "leaked_seqs": leaked_seqs,
+        "leaked_blocks": leaked_blocks,
+        "audit_cycles_post_promotion": list(audit_cycles_post),
+        "autoscale_ticks_post_promotion": ticks_end - ticks_at_promotion,
+    }
+    gates = [
+        out["failed"] == 0,
+        len(retried) >= 1,                       # failover exercised
+        out["max_attempts_seen"] <= MAX_ATTEMPTS,
+        lost_tokens == 0 and dup_tokens == 0,
+        out["hub_promoted"],
+        digests_agree,
+        all(r >= 1 for r in resyncs_each) and bool(resyncs_each),
+        radix_check_ok is True,
+        frontends_ready == n_frontends - 1,
+        leaked_seqs == 0 and leaked_blocks == 0,
+        audit_cycles_post[1] > audit_cycles_post[0] >= 0,
+        out["autoscale_ticks_post_promotion"] >= 2,
+    ]
+    out["frontdoor_ok"] = all(gates)
+    return out
+
+
+async def _wait_for_async(predicate, timeout: float, msg: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.05)
+    raise RuntimeError(f"timed out waiting for {msg}")
+
+
 def main() -> None:
     from dynamo_tpu.runtime.config import setup_logging
 
@@ -490,12 +917,21 @@ def main() -> None:
                     help="per-step worker.kill probability on decode")
     ap.add_argument("--no-autoscale", action="store_true",
                     help="pin the fleet (bounded smoke mode)")
+    ap.add_argument("--frontdoor", action="store_true",
+                    help="run the front-door chaos leg instead (ISSUE 18: "
+                         "3 frontend replicas, one SIGKILLed mid-peak, hub "
+                         "primary killed once under live load)")
     ap.add_argument("--json", default=None, metavar="FILE",
                     help="also write the result document to FILE")
     cli = ap.parse_args()
-    out = asyncio.run(drive(cli.duration, cli.scale, cli.seed,
-                            cli.kill_error,
-                            autoscale=not cli.no_autoscale))
+    if cli.frontdoor:
+        out = asyncio.run(frontdoor_drive(cli.duration, cli.seed))
+        gate = out["frontdoor_ok"]
+    else:
+        out = asyncio.run(drive(cli.duration, cli.scale, cli.seed,
+                                cli.kill_error,
+                                autoscale=not cli.no_autoscale))
+        gate = out["flagship_ok"]
     doc = json.dumps(out, indent=2, default=str)
     if cli.json:
         with open(cli.json, "w") as f:
@@ -503,7 +939,7 @@ def main() -> None:
     # summary line without the full embedded scorecard
     slim = {k: v for k, v in out.items() if k != "scorecard"}
     print(json.dumps(slim, indent=2, default=str))
-    raise SystemExit(0 if out["flagship_ok"] else 1)
+    raise SystemExit(0 if gate else 1)
 
 
 if __name__ == "__main__":

@@ -46,7 +46,13 @@ BREACH_COMPILE_METRIC = "dynamo_slo_breach_compile_share"
 
 def parse_class_ttft_buckets(text: str) -> dict[str, dict[float, float]]:
     """``{qos_class: {le_upper_bound: cumulative_count}}`` from one
-    /metrics exposition (``+Inf`` maps to ``float('inf')``)."""
+    /metrics exposition (``+Inf`` maps to ``float('inf')``).
+
+    Duplicate (class, bound) samples — the replica-labeled series of a
+    multi-frontend scrape (``MultiPrometheusSource.last_text``) — are
+    SUMMED: cumulative histogram counts across replicas add, so the fleet
+    p95 is computed over all replicas' traffic rather than whichever
+    replica's line parsed last."""
     out: dict[str, dict[float, float]] = {}
     prefix = TTFT_CLASS_METRIC + "_bucket"
     for line in text.splitlines():
@@ -61,7 +67,8 @@ def parse_class_ttft_buckets(text: str) -> dict[str, dict[float, float]]:
             continue
         try:
             bound = float("inf") if le == "+Inf" else float(le)
-            out.setdefault(cls, {})[bound] = float(m.group(3))
+            d = out.setdefault(cls, {})
+            d[bound] = d.get(bound, 0.0) + float(m.group(3))
         except ValueError:
             continue
     return out
@@ -82,7 +89,12 @@ def parse_gauge_by_class(text: Optional[str], metric: str
                          ) -> dict[str, float]:
     """``{class: value}`` for one ``<metric>{class="..."} v`` gauge family
     out of a /metrics exposition (the frontend's burn-rate and
-    breach-cause signals ride the same scrape the TTFT tracker reads)."""
+    breach-cause signals ride the same scrape the TTFT tracker reads).
+
+    Duplicate class samples (replica-labeled series of a multi-frontend
+    scrape) take the MAX — burn rate and breach share are worst-case
+    signals, and summing gauges across replicas would fabricate burn no
+    single replica observed."""
     out: dict[str, float] = {}
     if not text:
         return out
@@ -97,9 +109,10 @@ def parse_gauge_by_class(text: Optional[str], metric: str
         if cls is None:
             continue
         try:
-            out[cls] = float(m.group(3))
+            v = float(m.group(3))
         except ValueError:
             continue
+        out[cls] = max(out[cls], v) if cls in out else v
     return out
 
 

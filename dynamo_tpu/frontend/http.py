@@ -117,9 +117,24 @@ class HttpService:
         tls_cert_path: Optional[str] = None,
         tls_key_path: Optional[str] = None,
         runtime=None,
+        replica: Optional[str] = None,
     ):
         self.manager = manager
+        #: replica identity for multi-frontend deployments (docs/
+        #: robustness.md "Front door"): --replica-id / DYN_FRONTEND_REPLICA
+        #: / the operator's DYN_POD_NAME. None = classic single-frontend
+        #: mode — no discovery lease, no replica metric label, /metrics
+        #: byte-identical to a replica-unaware build.
+        self.replica = (replica or os.environ.get("DYN_FRONTEND_REPLICA")
+                        or os.environ.get("DYN_POD_NAME") or None)
+        if metrics is None and self.replica:
+            # every sample this process exports carries its replica label
+            # so a fleet scrape of N frontends sums instead of clobbering
+            metrics = MetricsRegistry(
+                default_labels={"replica": self.replica})
         self.metrics = metrics or MetricsRegistry()
+        self._frontend_key: Optional[str] = None
+        self._started_at = time.time()
         #: optional DistributedRuntime — lets /v1/traces/{id} stitch spans
         #: fetched from workers over the control plane (None = local only)
         self.runtime = runtime
@@ -567,12 +582,100 @@ class HttpService:
         /health flips to draining so load balancers pull this replica), then
         wait up to ``timeout`` for in-flight streams to finish."""
         self._draining = True
+        # flip the discovery doc to ready=false FIRST: peers/dynctl/LBs
+        # reading frontends/<ns>/ must stop picking this replica before the
+        # in-flight wait begins
+        await self._register_frontend()
         deadline = time.monotonic() + timeout
         while self._inflight_count > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.05)
         if self._inflight_count:
             logger.warning("drain timeout: %d requests still in flight",
                            self._inflight_count)
+
+    # -- front-door discovery (docs/robustness.md "Front door") ------------
+
+    def _frontend_doc(self) -> dict:
+        """The discovery document for this replica. ``ready`` is the
+        drain-aware readiness an LB/peer/dynctl keys on — same semantic as
+        /health, but readable fleet-wide off one prefix get."""
+        host = os.environ.get("DYN_FRONTEND_ADVERTISE") or self.host
+        if host in ("0.0.0.0", "::", ""):
+            host = "127.0.0.1"
+        return {
+            "replica": self.replica,
+            "url": f"http{'s' if self.tls_cert_path else ''}://{host}:{self.port}",
+            "pid": os.getpid(),
+            "started": self._started_at,
+            "ready": not self._draining,
+        }
+
+    async def _register_frontend(self) -> None:
+        """Register (or refresh) ``frontends/<ns>/<replica>`` under the
+        runtime's primary lease: the key dies with this process (SIGKILL
+        included) and is replayed after a hub restart/failover like every
+        other registration. No-op without a runtime or replica identity."""
+        if self.runtime is None or self.replica is None:
+            return
+        ns = self.runtime.config.namespace
+        key = f"frontends/{ns}/{self.replica}"
+        value = json.dumps(self._frontend_doc()).encode()
+        try:
+            lease = await self.runtime.primary_lease()
+            await self.runtime.plane.kv_put(key, value, lease_id=lease)
+        except Exception:
+            logger.exception("frontend replica registration failed")
+            return
+        self.runtime.record_registration(key, value)
+        self._frontend_key = key
+
+    async def list_frontends(self) -> list[dict]:
+        """Live frontend replicas from the discovery prefix (this replica
+        included), each doc tagged ``self``. A runtime-less service lists
+        only itself — single-process serving has exactly one front door."""
+        if self.runtime is None:
+            doc = self._frontend_doc()
+            doc["self"] = True
+            return [doc] if self.replica else []
+        ns = self.runtime.config.namespace
+        try:
+            entries = await self.runtime.plane.kv_get_prefix(
+                f"frontends/{ns}/")
+        except Exception:
+            logger.exception("frontend discovery read failed")
+            return []
+        out = []
+        for key in sorted(entries):
+            try:
+                doc = json.loads(entries[key])
+            except Exception:
+                continue
+            doc["self"] = key == self._frontend_key
+            out.append(doc)
+        return out
+
+    def local_kv_digest(self) -> dict:
+        """This replica's radix view, digested per model per worker:
+        ``{model: {worker_hex: [xor, count]}}`` — the number two replicas
+        consuming the same kv_events stream must agree on after settle
+        (the PR 15 ledger digest, projected from the router's view)."""
+        from dynamo_tpu.observability.kvaudit import u64_hex
+        from dynamo_tpu.router.protocols import G4_SOURCE_ID
+
+        models = {}
+        for name, sm in self.manager.models.items():
+            idx = getattr(sm.router, "indexer", None) if sm.router else None
+            tree = getattr(idx, "tree", None)
+            if tree is None:
+                continue
+            per = {}
+            for w in tree.worker_counts():
+                if w == G4_SOURCE_ID:
+                    continue  # G4 sentinel is not a worker
+                xor, count = tree.worker_digest(w)
+                per[u64_hex(w)] = [xor, count]
+            models[name] = per
+        return models
 
     def _record_usage(self, model: str, usage: Optional[dict],
                       ctx: Optional[Context] = None) -> None:
@@ -617,6 +720,12 @@ class HttpService:
         # KV index audit plane (docs/observability.md "KV audit"):
         # per-worker advertised vs resident blocks, divergence, heals
         app.router.add_get("/v1/kv/audit", self.handle_kv_audit)
+        # cross-replica convergence surface (docs/robustness.md "Front
+        # door"): THIS replica's radix digests, compared by peers'
+        # scorecards and the dynctl agreement check
+        app.router.add_get("/v1/kv/digest", self.handle_kv_digest)
+        # live frontend replicas off the frontends/<ns>/ discovery prefix
+        app.router.add_get("/v1/fleet/frontends", self.handle_fleet_frontends)
         # admin: flush every worker's KV cache/prefix state (ref:
         # lib/llm/src/http/service/clear_kv_blocks.rs)
         app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
@@ -649,6 +758,9 @@ class HttpService:
         if feed_s > 0:
             self._attr_task = asyncio.get_running_loop().create_task(
                 self._attr_feed_loop(feed_s))
+        # multi-replica front door: advertise this replica for LBs, peer
+        # scorecards, `dynctl frontends`, and client failover lists
+        await self._register_frontend()
         return self.port
 
     async def stop(self):
@@ -659,6 +771,15 @@ class HttpService:
             except (asyncio.CancelledError, Exception):
                 pass
             self._attr_task = None
+        if self._frontend_key is not None and self.runtime is not None:
+            # deliberate stop ≠ crash: delete the advert now instead of
+            # letting peers see a dead-but-leased replica for a lease TTL
+            try:
+                await self.runtime.plane.kv_delete(self._frontend_key)
+            except Exception:
+                pass
+            self.runtime.drop_registration(self._frontend_key)
+            self._frontend_key = None
         if self._runner:
             await self._runner.cleanup()
 
@@ -925,6 +1046,39 @@ class HttpService:
             if auditor is not None:
                 models[name] = auditor.status()
         return web.json_response({"models": models, "count": len(models)})
+
+    async def handle_kv_digest(self, request: web.Request) -> web.Response:
+        """GET /v1/kv/digest — this replica's per-model per-worker radix
+        digests plus the indexer cursors. Replicas feeding off the same
+        ``kv_events`` stream must converge to identical digests once the
+        stream settles — /v1/fleet/scorecard's ``radix_replica_agreement``
+        check fetches this from every live peer and diffs per worker."""
+        cursors = {}
+        for name, sm in self.manager.models.items():
+            idx = getattr(sm.router, "indexer", None) if sm.router else None
+            if idx is not None:
+                cursors[name] = {
+                    "last_seq": getattr(idx, "_last_seq", None),
+                    "events_applied": getattr(idx, "events_applied", 0),
+                    "gaps_detected": getattr(idx, "gaps_detected", 0),
+                    "resyncs_requested": getattr(idx, "resyncs_requested", 0),
+                }
+        return web.json_response({
+            "replica": self.replica,
+            "models": self.local_kv_digest(),
+            "cursors": cursors,
+        })
+
+    async def handle_fleet_frontends(self, request: web.Request) -> web.Response:
+        """GET /v1/fleet/frontends — live frontend replicas with drain-aware
+        readiness (the worker-side analog is /v1/fleet/steps; this is the
+        front door's census, rendered by ``dynctl frontends``)."""
+        frontends = await self.list_frontends()
+        return web.json_response({
+            "frontends": frontends,
+            "count": len(frontends),
+            "ready": sum(1 for f in frontends if f.get("ready", True)),
+        })
 
     @staticmethod
     def _decay_departed(gauge, exported: dict, current: set,

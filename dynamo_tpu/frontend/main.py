@@ -29,6 +29,13 @@ async def amain():
     ap.add_argument("--admin-token", default=None,
                     help="bearer token required on destructive admin routes "
                          "(/clear_kv_blocks); also via DYN_ADMIN_TOKEN")
+    ap.add_argument("--replica-id", default=None,
+                    help="front-door replica identity (docs/robustness.md "
+                         "'Front door'): registers frontends/<ns>/<id> "
+                         "with drain-aware readiness and stamps a replica "
+                         "label on every /metrics sample; also via "
+                         "DYN_FRONTEND_REPLICA / DYN_POD_NAME. Unset = "
+                         "classic single-frontend mode")
     ap.add_argument("--router-mode", choices=["kv", "round_robin", "random"], default="kv")
     ap.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     ap.add_argument("--router-temperature", type=float, default=0.0)
@@ -73,7 +80,7 @@ async def amain():
     service = HttpService(manager, host=args.host, port=args.port,
                           tls_cert_path=args.tls_cert_path,
                           tls_key_path=args.tls_key_path,
-                          runtime=runtime)
+                          runtime=runtime, replica=args.replica_id)
     if args.admin_token:
         service.admin_token = args.admin_token
     await service.start()

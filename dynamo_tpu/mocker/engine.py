@@ -391,6 +391,22 @@ class MockEngine:
                 seq.out_queue.put_nowait(LLMEngineOutput(
                     finish_reason=FinishReason.DEADLINE))
                 seq.out_queue.put_nowait(None)
+        # orphan-cancellation sweep (front-door kill hygiene, docs/
+        # robustness.md): a cancelled context must free its slot whether
+        # the row is decoding, MID-PREFILL, or still WAITING. Response-
+        # plane peer death cancels a dead frontend's seqs; without this
+        # sweep a prefilling/queued orphan would keep burning budget and
+        # holding blocks until it finished naturally, so the BlockPool
+        # would not return to its pre-request count.
+        for seq in self.running:
+            if seq.finished is None and seq.ctx.cancelled:
+                seq.finished = FinishReason.CANCELLED
+                seq.out_queue.put_nowait(LLMEngineOutput.cancelled())
+        for seq in list(self.waiting):
+            if seq.ctx.cancelled:
+                self.waiting.remove(seq)
+                seq.out_queue.put_nowait(LLMEngineOutput.cancelled())
+                seq.out_queue.put_nowait(None)
         if self.args.token_budget_plan:
             # ragged-style step: decode rows spend the shared budget first
             # (one token each), prefill chunks fill what remains, and the

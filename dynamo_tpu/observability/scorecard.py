@@ -311,6 +311,69 @@ class ScorecardKeeper:
 
         return migration_stats()
 
+    async def frontdoor_rollup(self) -> Optional[dict]:
+        """Cross-replica front-door convergence (docs/robustness.md "Front
+        door"): list live frontend replicas off the discovery prefix, fetch
+        each READY peer's /v1/kv/digest, and diff per-model per-worker
+        against this replica's own radix digests. Replicas consume the
+        same kv_events stream, so after settle the digests must be equal —
+        a standing mismatch means one routing view silently diverged (the
+        multi-replica projection of the PR 15 ledger check). None when
+        this process has no replica identity (classic single frontend)."""
+        svc = self.service
+        if svc.replica is None:
+            return None
+        frontends = await svc.list_frontends()
+        local = svc.local_kv_digest()
+        peers: dict = {}
+        mismatches: list[dict] = []
+        compared = 0
+        import aiohttp
+
+        timeout = aiohttp.ClientTimeout(total=3.0)
+        for fe in frontends:
+            name = fe.get("replica") or fe.get("url") or "?"
+            if fe.get("self"):
+                continue
+            if not fe.get("ready", True):
+                peers[name] = {"skipped": "draining"}
+                continue
+            try:
+                async with aiohttp.ClientSession(timeout=timeout) as sess:
+                    async with sess.get(f"{fe.get('url')}/v1/kv/digest") as r:
+                        peer = await r.json()
+            except Exception as e:  # noqa: BLE001 — dead peer ≠ divergence
+                peers[name] = {"unreachable": repr(e)[:120]}
+                continue
+            compared += 1
+            pmodels = peer.get("models") or {}
+            n_mis = 0
+            for model in set(local) | set(pmodels):
+                lw = local.get(model) or {}
+                pw = pmodels.get(model) or {}
+                for w in set(lw) | set(pw):
+                    if lw.get(w) != pw.get(w):
+                        n_mis += 1
+                        if len(mismatches) < 16:
+                            mismatches.append({
+                                "replica": name, "model": model,
+                                "worker": w, "local": lw.get(w),
+                                "peer": pw.get(w)})
+            peers[name] = {"mismatches": n_mis}
+        return {
+            "replica": svc.replica,
+            "frontends": [{k: fe.get(k) for k in
+                           ("replica", "url", "ready", "self", "pid")}
+                          for fe in frontends],
+            "peers_compared": compared,
+            "mismatch_count": sum(p.get("mismatches", 0)
+                                  for p in peers.values()),
+            "mismatches": mismatches,
+            "peers": peers,
+            "agree": all(p.get("mismatches", 0) == 0
+                         for p in peers.values()),
+        }
+
     def breakdown_rollup(self) -> dict:
         """Phase-bucket seconds from the fleet breakdown histograms
         (fed by sampled attributions — docs/observability.md
@@ -371,6 +434,7 @@ class ScorecardKeeper:
             },
             "migrations": self.migration_rollup(),
             "audit": self.audit_rollup(),
+            "frontdoor": await self.frontdoor_rollup(),
             "autoscale": _autoscale_slim(autoscale),
             "operator": _operator_slim(operator),
             "hub": {
@@ -474,6 +538,15 @@ def run_checks(snap: dict) -> list[dict]:
             "ok": attr["reconciled"] == attr["docs"],
             "detail": (f"{attr['reconciled']}/{attr['docs']} bucket sums "
                        f"match measured e2e"),
+        })
+    fd = snap.get("frontdoor")
+    if fd and fd.get("peers_compared"):
+        checks.append({
+            "name": "radix_replica_agreement",
+            "ok": bool(fd.get("agree")),
+            "detail": (f"{fd['peers_compared']} peer radix view(s), "
+                       f"{fd.get('mismatch_count', 0)} per-worker digest "
+                       f"mismatches"),
         })
     return checks
 
@@ -608,6 +681,17 @@ def render_scorecard(doc: dict) -> str:
             f"audit[{model}]: divergence {total_div} blocks "
             f"({' '.join(f'{k}={v}' for k, v in sorted(div.items()) if v) or 'clean'})"
             f"  heals {sum(heals.values())}  cycles {a.get('cycles', 0)}")
+    fd = now.get("frontdoor")
+    if fd:
+        reps = " ".join(
+            f"{r.get('replica')}"
+            f"[{'ready' if r.get('ready', True) else 'draining'}]"
+            + ("*" if r.get("self") else "")
+            for r in fd.get("frontends") or [])
+        agree = ("digests agree" if fd.get("agree")
+                 else f"{fd.get('mismatch_count', 0)} digest MISMATCHES") \
+            if fd.get("peers_compared") else "no peers compared"
+        lines.append(f"frontends: {reps or '(none registered)'}  {agree}")
     asc = now.get("autoscale")
     if asc:
         c = asc.get("counters") or {}

@@ -53,6 +53,45 @@ def parse_prometheus_text(text: str) -> dict[str, float]:
     return out
 
 
+#: counter/sum families whose per-interval deltas build an Observation
+_DELTA_FAMILIES = (
+    "dynamo_llm_requests_finished_total",
+    "dynamo_llm_prompt_tokens_total",
+    "dynamo_llm_completion_tokens_total",
+    "dynamo_http_request_duration_seconds_sum",
+    "dynamo_http_request_duration_seconds_count",
+    "dynamo_http_time_to_first_token_seconds_sum",
+    "dynamo_http_time_to_first_token_seconds_count",
+)
+
+
+def _observation_from_deltas(dt: float, d: dict[str, float]
+                             ) -> Optional[Observation]:
+    """Counter deltas over one interval → Observation (None when idle)."""
+    finished = d.get("dynamo_llm_requests_finished_total", 0.0)
+    if finished <= 0:
+        return None  # idle interval: nothing to learn from
+    prompt = d.get("dynamo_llm_prompt_tokens_total", 0.0)
+    completion = d.get("dynamo_llm_completion_tokens_total", 0.0)
+    d_lat_sum = d.get("dynamo_http_request_duration_seconds_sum", 0.0)
+    d_lat_cnt = d.get("dynamo_http_request_duration_seconds_count", 0.0)
+    d_ttft_sum = d.get("dynamo_http_time_to_first_token_seconds_sum", 0.0)
+    d_ttft_cnt = d.get("dynamo_http_time_to_first_token_seconds_count", 0.0)
+    ttft_ms = (1000.0 * d_ttft_sum / d_ttft_cnt) if d_ttft_cnt else None
+    osl = completion / finished
+    itl_ms = None
+    if d_lat_cnt and ttft_ms is not None and osl > 1:
+        mean_lat_ms = 1000.0 * d_lat_sum / d_lat_cnt
+        itl_ms = max(0.0, (mean_lat_ms - ttft_ms) / (osl - 1))
+    return Observation(
+        request_rate=finished / max(1e-9, dt),
+        isl=prompt / finished,
+        osl=osl,
+        ttft_ms=ttft_ms,
+        itl_ms=itl_ms,
+    )
+
+
 class PrometheusMetricsSource:
     """async () -> Observation|None over a frontend /metrics URL."""
 
@@ -97,7 +136,13 @@ class PrometheusMetricsSource:
             logger.warning("metrics scrape failed: %s", self.url)
             return None
 
-    async def __call__(self) -> Optional[Observation]:
+    async def sample(self) -> Optional[tuple[float, dict[str, float]]]:
+        """One scrape → ``(dt_seconds, counter_deltas)``, or None when the
+        fetch failed, this was the first sample, or a counter reset was
+        detected. The raw-delta form exists so a fleet of replica scrapes
+        (:class:`MultiPrometheusSource`) can be SUMMED before the ratio
+        math — averaging per-replica Observations would weight a nearly
+        idle replica the same as a loaded one."""
         cur = await self._fetch()
         now = time.monotonic()
         if cur is None:
@@ -117,30 +162,64 @@ class PrometheusMetricsSource:
             logger.warning("counter reset detected (frontend restart?); "
                            "skipping one observation interval")
             return None
+        deltas = {n: max(0.0, cur.get(n, 0.0) - prev.get(n, 0.0))
+                  for n in _DELTA_FAMILIES}
+        return max(1e-9, now - prev_t), deltas
 
-        def delta(name: str) -> float:
-            return max(0.0, cur.get(name, 0.0) - prev.get(name, 0.0))
+    async def __call__(self) -> Optional[Observation]:
+        s = await self.sample()
+        if s is None:
+            return None
+        return _observation_from_deltas(*s)
 
-        dt = max(1e-9, now - prev_t)
-        finished = delta("dynamo_llm_requests_finished_total")
-        if finished <= 0:
-            return None  # idle interval: nothing to learn from
-        prompt = delta("dynamo_llm_prompt_tokens_total")
-        completion = delta("dynamo_llm_completion_tokens_total")
-        d_lat_sum = delta("dynamo_http_request_duration_seconds_sum")
-        d_lat_cnt = delta("dynamo_http_request_duration_seconds_count")
-        d_ttft_sum = delta("dynamo_http_time_to_first_token_seconds_sum")
-        d_ttft_cnt = delta("dynamo_http_time_to_first_token_seconds_count")
-        ttft_ms = (1000.0 * d_ttft_sum / d_ttft_cnt) if d_ttft_cnt else None
-        osl = completion / finished
-        itl_ms = None
-        if d_lat_cnt and ttft_ms is not None and osl > 1:
-            mean_lat_ms = 1000.0 * d_lat_sum / d_lat_cnt
-            itl_ms = max(0.0, (mean_lat_ms - ttft_ms) / (osl - 1))
-        return Observation(
-            request_rate=finished / dt,
-            isl=prompt / finished,
-            osl=osl,
-            ttft_ms=ttft_ms,
-            itl_ms=itl_ms,
-        )
+
+class MultiPrometheusSource:
+    """Fleet front-door source: one :class:`PrometheusMetricsSource` per
+    frontend replica URL, per-replica counter deltas summed into ONE
+    Observation per tick (docs/robustness.md "Front door").
+
+    Per-replica ``_prev`` snapshots keep reset detection replica-local —
+    one restarted frontend rebases alone instead of poisoning the whole
+    fleet sample — and a dead replica simply drops out of the sum, so the
+    autoscaler keeps seeing the surviving replicas' traffic during a
+    front-door kill. ``last_text`` concatenates the expositions of the
+    replicas that answered THIS tick (a dead replica's stale text is
+    excluded); replica-labeled series keep their label sets distinct, so
+    downstream per-class parsers (autoscale/observe.py) sum histogram
+    buckets and take worst-case gauges instead of double-counting.
+    """
+
+    def __init__(self, urls: list[str]):
+        if not urls:
+            raise ValueError("MultiPrometheusSource needs at least one URL")
+        self.sources = [PrometheusMetricsSource(u) for u in urls]
+        self.last_text: Optional[str] = None
+        #: ticks on which NO replica could be scraped (fleet-level
+        #: blindness — one dead replica of several is not a failure here;
+        #: per-replica counts live on ``self.sources[i].scrape_failures``)
+        self.scrape_failures = 0
+        self.resets = 0
+
+    async def __call__(self) -> Optional[Observation]:
+        import asyncio
+
+        before = [s.scrape_failures for s in self.sources]
+        samples = await asyncio.gather(*(s.sample() for s in self.sources))
+        answered = [s for s, b in zip(self.sources, before)
+                    if s.scrape_failures == b]
+        if not answered:
+            self.scrape_failures += 1
+        self.resets = sum(s.resets for s in self.sources)
+        texts = [s.last_text for s in answered if s.last_text]
+        self.last_text = "\n".join(texts) if texts else None
+        live = [x for x in samples if x is not None]
+        if not live:
+            return None
+        combined: dict[str, float] = {}
+        for _, d in live:
+            for k, v in d.items():
+                combined[k] = combined.get(k, 0.0) + v
+        # replica scrape windows are near-identical (same tick); the mean
+        # interval turns the summed finished-count into a fleet rate
+        dt = sum(t for t, _ in live) / len(live)
+        return _observation_from_deltas(dt, combined)

@@ -398,6 +398,24 @@ class KvIndexer:
     async def _loop(self):
         try:
             async for seq, payload in self._sub:
+                if seq < 0:
+                    # Epoch-change marker injected by RemoteControlPlane on
+                    # hub failover: a promoted standby CONTINUES the
+                    # replicated seq numbering, so events the dead primary
+                    # accepted after its last replication tick vanish with
+                    # no observable seq gap. The marker makes that silent
+                    # loss explicit — drop the tree and resync now instead
+                    # of serving stale overlap scores until the audit
+                    # cadence notices.
+                    logger.warning(
+                        "kv event stream %s hub epoch changed under us; resyncing",
+                        self.stream)
+                    await self._force_resync()
+                    # the re-subscription restarts from seq 0 (cursor was
+                    # reset alongside the marker) — accept whatever the new
+                    # hub retains first without flagging a second gap
+                    self._last_seq = -1
+                    continue
                 if self._last_seq >= 0 and seq != self._last_seq + 1:
                     # Forward jump = ring overflow outran this consumer;
                     # regression = plane restarted and the stream reset.

@@ -46,6 +46,9 @@ logger = logging.getLogger("dynamo.control_plane")
 DEFAULT_LEASE_TTL = 10.0
 SWEEP_INTERVAL = 1.0
 STREAM_MAX_LEN = 65536  # per-stream ring buffer cap
+# In-band stream discontinuity marker (see RemoteControlPlane._replay): real
+# stream seqs are >= 1, so a negative seq can never collide with one.
+EPOCH_MARKER_SEQ = -1
 
 
 class NoRespondersError(Exception):
@@ -1335,6 +1338,19 @@ class RemoteControlPlane(ControlPlane):
             for sid, meta in list(self._sub_meta.items()):
                 if meta[0] == "stream":
                     self._sub_meta[sid] = ("stream", meta[1], 0)
+                    # A promoted standby CONTINUES the replicated seq
+                    # numbering, so publishes the old primary took after the
+                    # last replication tick are lost without any seq gap the
+                    # consumer could observe — its next delivered seq is
+                    # contiguous with the last one it saw. Surface the
+                    # discontinuity in-band: a negative-seq marker ahead of
+                    # the re-subscribed tail tells stream consumers (the KV
+                    # indexers) to treat their state as suspect and resync
+                    # instead of waiting for the audit cadence to notice.
+                    q = self._sub_queues.get(sid)
+                    if q is not None:
+                        q.put_nowait((EPOCH_MARKER_SEQ,
+                                      msgpack.packb({"epoch_changed": epoch})))
         for svc_id, subject in list(self._serve_meta.items()):
             await self._call("serve", svc_id=svc_id, subject=subject)
         for wid, prefix in list(self._watch_meta.items()):

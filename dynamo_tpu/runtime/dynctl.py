@@ -705,6 +705,65 @@ def _fleet_main(argv: list[str]) -> None:
         fleet_amain(args.url, args.json, args.watch, args.timeout)))
 
 
+async def frontends_amain(url: str, as_json: bool, watch: float = 0.0,
+                          timeout: float = 5.0) -> int:
+    """Front-door census (docs/robustness.md "Front door"): GET
+    /v1/fleet/frontends off any one replica and list every live frontend
+    lease with drain-aware readiness. Exit 0 only when at least one
+    replica is ready."""
+    import aiohttp
+
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=timeout)) as session:
+        while True:
+            try:
+                async with session.get(
+                        f"{url.rstrip('/')}/v1/fleet/frontends") as resp:
+                    doc = await resp.json()
+            except Exception as e:
+                print(f"frontend census fetch failed: {e}", file=sys.stderr)
+                return 1
+            if as_json:
+                print(json.dumps(doc, indent=2))
+            else:
+                rows = doc.get("frontends") or []
+                print(f"{'replica':<18s}{'url':<32s}{'pid':>8s}"
+                      f"{'up_s':>8s}  state")
+                now = time.time()
+                for fe in rows:
+                    up = now - fe["started"] if fe.get("started") else 0.0
+                    state = "ready" if fe.get("ready", True) else "draining"
+                    if fe.get("self"):
+                        state += " *"
+                    print(f"{str(fe.get('replica')):<18s}"
+                          f"{str(fe.get('url')):<32s}"
+                          f"{str(fe.get('pid') or '-'):>8s}"
+                          f"{up:>8.1f}  {state}")
+                print(f"{doc.get('ready', 0)}/{doc.get('count', 0)} ready")
+            if not watch:
+                return 0 if doc.get("ready") else 1
+            await asyncio.sleep(watch)
+            print()
+
+
+def _frontends_main(argv: list[str]) -> None:
+    ap = argparse.ArgumentParser(
+        prog="dynctl frontends",
+        description="list live frontend replicas with readiness "
+                    "(/v1/fleet/frontends)")
+    ap.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="any frontend base URL "
+                         "(default http://127.0.0.1:8000)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw census document")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every N seconds (0 = one-shot)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    raise SystemExit(asyncio.run(
+        frontends_amain(args.url, args.json, args.watch, args.timeout)))
+
+
 def _autoscale_main(argv: list[str]) -> None:
     ap = argparse.ArgumentParser(
         prog="dynctl autoscale",
@@ -755,6 +814,9 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "fleet":
         _fleet_main(sys.argv[2:])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "frontends":
+        _frontends_main(sys.argv[2:])
         return
     ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
     ap.add_argument("--host", default="0.0.0.0")

@@ -34,20 +34,23 @@ def _fmt_labels(labels: dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
-def _merge_callback_values(values: dict, callbacks: list, name: str) -> dict:
+def _merge_callback_values(values: dict, callbacks: list, name: str,
+                           base: Optional[dict] = None) -> dict:
     """Fold scrape-time callback samples into ``values`` (shared by Counter
     and Gauge render). Each callback returns dict[labels, value]; ``labels``
     is None (no labels) or a TUPLE of (name, value) pairs — a dict cannot
     key a dict. Keys must be None or ((name, value), ...) pairs — an
     iterable of anything else (e.g. a bare string, whose sort would
-    silently yield characters) is a caller bug."""
+    silently yield characters) is a caller bug. ``base`` labels (the
+    registry's default labels, e.g. the frontend replica id) merge under
+    the callback's own labels."""
     for cb in callbacks:
         try:
             for labels, v in cb().items():
-                key = (() if labels is None else
-                       tuple(sorted((str(n), str(lv))
-                                    for n, lv in labels)))
-                values[key] = v
+                d = {str(k): str(bv) for k, bv in (base or {}).items()}
+                if labels is not None:
+                    d.update((str(n), str(lv)) for n, lv in labels)
+                values[tuple(sorted(d.items()))] = v
         except Exception:
             logging.getLogger("dynamo.metrics").exception(
                 "metric %s scrape callback failed", name)
@@ -55,15 +58,16 @@ def _merge_callback_values(values: dict, callbacks: list, name: str) -> dict:
 
 
 class Counter:
-    def __init__(self, name: str, help_: str):
+    def __init__(self, name: str, help_: str, base: Optional[dict] = None):
         self.name = name
         self.help = help_
+        self._base = dict(base or {})
         self._values: dict[tuple, float] = {}
         self._callbacks: list = []
         self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0, **labels):
-        key = tuple(sorted(labels.items()))
+        key = tuple(sorted({**self._base, **labels}.items()))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
@@ -78,22 +82,23 @@ class Counter:
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         values = _merge_callback_values(dict(self._values), self._callbacks,
-                                        self.name)
+                                        self.name, self._base)
         for key, v in sorted(values.items()):
             lines.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return "\n".join(lines)
 
 
 class Gauge:
-    def __init__(self, name: str, help_: str):
+    def __init__(self, name: str, help_: str, base: Optional[dict] = None):
         self.name = name
         self.help = help_
+        self._base = dict(base or {})
         self._values: dict[tuple, float] = {}
         self._callbacks: list = []
         self._lock = threading.Lock()
 
     def set(self, value: float, **labels):
-        key = tuple(sorted(labels.items()))
+        key = tuple(sorted({**self._base, **labels}.items()))
         with self._lock:
             self._values[key] = value
 
@@ -101,7 +106,7 @@ class Gauge:
         """Drop one labeled series (label-churn hygiene: a departed
         worker's gauge must leave /metrics, not linger as a 0-valued
         series forever — unbounded cardinality under fleet churn)."""
-        key = tuple(sorted(labels.items()))
+        key = tuple(sorted({**self._base, **labels}.items()))
         with self._lock:
             self._values.pop(key, None)
 
@@ -113,23 +118,25 @@ class Gauge:
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         values = _merge_callback_values(dict(self._values), self._callbacks,
-                                        self.name)
+                                        self.name, self._base)
         for key, v in sorted(values.items()):
             lines.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return "\n".join(lines)
 
 
 class Histogram:
-    def __init__(self, name: str, help_: str, buckets=_DEFAULT_BUCKETS):
+    def __init__(self, name: str, help_: str, buckets=_DEFAULT_BUCKETS,
+                 base: Optional[dict] = None):
         self.name = name
         self.help = help_
         self.buckets = tuple(buckets)
+        self._base = dict(base or {})
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._lock = threading.Lock()
 
     def observe(self, value: float, **labels):
-        key = tuple(sorted(labels.items()))
+        key = tuple(sorted({**self._base, **labels}.items()))
         with self._lock:
             counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
             self._sums[key] = self._sums.get(key, 0.0) + value
@@ -173,27 +180,37 @@ class _Timer:
 
 
 class MetricsRegistry:
-    def __init__(self, prefix: str = "dynamo"):
+    def __init__(self, prefix: str = "dynamo",
+                 default_labels: Optional[dict] = None):
         self.prefix = prefix
+        #: labels stamped on EVERY sample this registry records (e.g.
+        #: ``{"replica": "fe-1"}`` in multi-frontend deployments, so a
+        #: fleet scrape can sum per-replica series instead of letting
+        #: identical label sets clobber each other). Empty by default —
+        #: single-replica exposition stays byte-identical.
+        self.default_labels = dict(default_labels or {})
         self._metrics: dict[str, object] = {}
         self._start = time.time()
 
     def counter(self, name: str, help_: str = "") -> Counter:
         full = f"{self.prefix}_{name}"
         if full not in self._metrics:
-            self._metrics[full] = Counter(full, help_ or name)
+            self._metrics[full] = Counter(full, help_ or name,
+                                          base=self.default_labels)
         return self._metrics[full]  # type: ignore[return-value]
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
         full = f"{self.prefix}_{name}"
         if full not in self._metrics:
-            self._metrics[full] = Gauge(full, help_ or name)
+            self._metrics[full] = Gauge(full, help_ or name,
+                                        base=self.default_labels)
         return self._metrics[full]  # type: ignore[return-value]
 
     def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
         full = f"{self.prefix}_{name}"
         if full not in self._metrics:
-            self._metrics[full] = Histogram(full, help_ or name, buckets)
+            self._metrics[full] = Histogram(full, help_ or name, buckets,
+                                            base=self.default_labels)
         return self._metrics[full]  # type: ignore[return-value]
 
     def render(self) -> str:

@@ -192,8 +192,20 @@ class DistributedRuntime:
         fails is the shutdown event tripped (the process is then an
         undiscoverable zombie and the supervisor should restart it).
         """
+        import time as _time
+
         interval = max(self.config.lease_ttl / 3.0, 0.5)
+        # continuous-failure budget before declaring this process a zombie.
+        # A strike COUNT is the wrong unit: a hub FAILOVER keeps keepalives
+        # erroring for standby takeover_after + client reconnect backoff —
+        # several seconds — and a count tuned for transient blips would
+        # suicide the entire fleet right when the standby is about to
+        # serve it. Only a hub unreachable well past any takeover window
+        # is fatal; once reconnected, the reconnect callback recovers the
+        # lease and replays registrations.
+        fail_budget_s = max(10.0, 5 * interval)
         failures = 0
+        failing_since: Optional[float] = None
         try:
             while not self._shutdown_event.is_set():
                 await asyncio.sleep(interval)
@@ -202,15 +214,20 @@ class DistributedRuntime:
                 except asyncio.CancelledError:
                     raise
                 except Exception:
+                    now = _time.monotonic()
+                    if failing_since is None:
+                        failing_since = now
                     failures += 1
                     logger.warning(
-                        "lease keepalive error (%d consecutive)", failures, exc_info=True
+                        "lease keepalive error (%d consecutive, %.1fs)",
+                        failures, now - failing_since, exc_info=True
                     )
-                    if failures >= 5:
+                    if now - failing_since >= fail_budget_s:
                         logger.error("lease keepalive failing persistently; shutting down")
                         self._shutdown_event.set()
                         return
                     continue
+                failing_since = None
                 for extra in list(self._extra_leases):
                     try:
                         ok2 = await self.plane.lease_keepalive(extra)

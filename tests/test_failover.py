@@ -199,3 +199,147 @@ async def test_hub_death_inflight_stream_survives_and_discovery_recovers():
         await worker_rt.shutdown()
         await client_rt.shutdown()
         await standby.stop()
+
+
+async def test_promotion_under_live_load_no_truncation_and_full_rejoin():
+    """Standby promotion UNDER LOAD: several concurrent streams across a
+    multi-worker fleet span the promotion and every one completes without
+    truncation (the response plane is hub-independent), and afterwards
+    EVERY worker's lease registrations are re-established on the promoted
+    standby under their ORIGINAL instance ids — no worker may come back as
+    a zombie or a renamed instance."""
+    primary = ControlPlaneServer()
+    p_addr = await primary.start()
+    standby = ControlPlaneServer(standby_of=p_addr, takeover_after=0.8,
+                                 replicate_interval=0.1)
+    s_addr = await standby.start()
+    addrs = f"{p_addr},{s_addr}"
+
+    hub_died = asyncio.Event()
+
+    async def handler(request, ctx: Context):
+        for i in range(request["n"]):
+            if i == 3:
+                # every stream parks here until the hub is dead, so ALL of
+                # them are provably in flight across the promotion
+                await asyncio.wait_for(hub_died.wait(), 15.0)
+            yield {"i": i}
+            await asyncio.sleep(0.01)
+
+    worker_rts, handles = [], []
+    client_rt = await DistributedRuntime.create(
+        plane=await RemoteControlPlane(addrs).connect(), config=_cfg())
+    try:
+        for _ in range(3):
+            rt = await DistributedRuntime.create(
+                plane=await RemoteControlPlane(addrs).connect(), config=_cfg())
+            worker_rts.append(rt)
+            ep = rt.namespace("test").component("gen").endpoint("e")
+            handles.append(await ep.serve_endpoint(handler))
+
+        client = await (client_rt.namespace("test").component("gen")
+                        .endpoint("e").client().start())
+        ids_before = set(await client.wait_for_instances(timeout=5))
+        assert len(ids_before) == 3
+
+        streams = [await client.generate({"n": 8}) for _ in range(6)]
+        its = [aiter(s) for s in streams]
+        for it in its:  # all streams are live before the hub dies
+            assert (await anext(it))["i"] == 0
+
+        await primary.stop()
+        hub_died.set()
+
+        # no truncation beyond the first item already read: every stream
+        # yields its full remainder over the direct response plane
+        for it in its:
+            assert [x["i"] async for x in it] == [1, 2, 3, 4, 5, 6, 7]
+
+        await _wait_for(lambda: asyncio.sleep(0, not standby.is_standby),
+                        msg="standby promotion")
+
+        # full rejoin: each worker's keepalive/reconnect recovery re-puts
+        # its instance key on the promoted hub with the original id
+        async def all_rejoined():
+            keys = [k for k in standby.core._kv
+                    if k.startswith("instances/test/")]
+            return len(keys) == 3
+        await _wait_for(all_rejoined, timeout=6 * _cfg().lease_ttl,
+                        msg="every worker re-registered after promotion")
+
+        async def ids_stable():
+            try:
+                return set(client.available_ids()) == ids_before
+            except Exception:
+                return False
+        await _wait_for(ids_stable, timeout=6 * _cfg().lease_ttl,
+                        msg="instance ids stable across failover")
+
+        s = await client.generate({"n": 2})  # post-promotion serving works
+        assert [x["i"] async for x in s] == [0, 1]
+    finally:
+        for h in handles:
+            await h.stop(graceful=False)
+        for rt in worker_rts:
+            await rt.shutdown()
+        await client_rt.shutdown()
+        await standby.stop()
+
+
+async def test_epoch_marker_resyncs_kv_indexer_across_promotion():
+    """Regression (front-door convergence): a promoted standby CONTINUES
+    the replicated kv_events seq numbering, so a router that survived the
+    failover sees no seq gap even though events may have died with the
+    primary. The client's re-subscription must inject the epoch-change
+    marker, and the KvIndexer must respond by dropping its tree and
+    resyncing — then keep applying post-promotion events normally."""
+    from dynamo_tpu.router.indexer import KvIndexer
+    from dynamo_tpu.router.protocols import StoredBlock
+    from dynamo_tpu.router.publisher import KvEventPublisher
+
+    primary = ControlPlaneServer()
+    p_addr = await primary.start()
+    standby = ControlPlaneServer(standby_of=p_addr, takeover_after=0.8,
+                                 replicate_interval=0.1)
+    s_addr = await standby.start()
+
+    plane = await RemoteControlPlane(f"{p_addr},{s_addr}").connect()
+    idx = await KvIndexer(plane, kv_block_size=4).start()
+    pub = KvEventPublisher(plane, worker_id=0xabc, kv_block_size=4)
+    try:
+        await pub.publish_stored(None, [StoredBlock(1, 101),
+                                        StoredBlock(2, 102)])
+        await _wait_for(lambda: asyncio.sleep(0, idx.events_applied >= 1),
+                        msg="pre-failover event applied")
+        gaps0, resyncs0 = idx.gaps_detected, idx.resyncs_requested
+
+        # wait until the stored event is REPLICATED (else promotion loses
+        # it legitimately and the test measures durability, not the marker)
+        async def replicated():
+            return await standby.core.stream_last_seq("kv_events") >= 1
+        await _wait_for(replicated, msg="kv event replicated to standby")
+
+        await primary.stop()
+        await _wait_for(lambda: asyncio.sleep(0, not standby.is_standby),
+                        msg="standby promotion")
+
+        # mid-watch promotion: the reconnect replay injects the epoch
+        # marker; the indexer must resync rather than trust its tree
+        await _wait_for(
+            lambda: asyncio.sleep(0, idx.gaps_detected > gaps0),
+            timeout=15.0, msg="epoch marker triggered indexer resync")
+        assert idx.resyncs_requested > resyncs0
+
+        # the re-subscription replays the retained (replicated) events into
+        # the fresh tree, and NEW post-promotion events keep applying
+        applied0 = idx.events_applied
+        await pub.publish_stored(2, [StoredBlock(3, 103)])
+        await _wait_for(
+            lambda: asyncio.sleep(0, idx.events_applied > applied0
+                                  and (0xabc, 3) in idx.tree._lookup),
+            timeout=15.0, msg="post-promotion event applied")
+        assert (0xabc, 1) in idx.tree._lookup  # replicated state recovered
+    finally:
+        await idx.stop()
+        await plane.close()
+        await standby.stop()

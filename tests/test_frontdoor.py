@@ -1,0 +1,323 @@
+"""Front-door redundancy: replica discovery, convergent streams, kill hygiene.
+
+Multi-replica frontends (docs/robustness.md "Front door") share one
+KV-aware routing view: each HttpService replica runs its own KvPushRouter
+fed off the same durable ``kv_events`` stream, registers a
+``frontends/<ns>/<replica>`` lease with drain-aware readiness, and clients
+fail over between replicas with ordinary retries. The properties proved
+here are the ones the acceptance gate names:
+
+- the replica census (``/v1/fleet/frontends`` / ``dynctl frontends``) lists
+  every live replica, and drain flips readiness fleet-wide before the
+  process exits;
+- with no chaos, the SAME prompt streamed through one frontend or any of N
+  replicas yields bit-identical token streams (the mocker's sampling is
+  seeded by the prompt tokens, and routing must not perturb the output);
+- a SIGKILLed frontend leaks nothing: workers cancel the orphaned
+  sequences when the response-plane peer dies, the KV block pool returns to
+  its pre-request census, and a surviving replica serves the retry
+  radix-warm off the shared event stream.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import sys
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.http import HttpService
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+from dynamo_tpu.mocker.engine import MockEngineArgs
+from dynamo_tpu.mocker.main import run_mocker
+from dynamo_tpu.runtime import (
+    ControlPlaneServer,
+    DistributedRuntime,
+    RemoteControlPlane,
+)
+from dynamo_tpu.runtime.config import RuntimeConfig
+
+pytestmark = pytest.mark.anyio
+
+MODEL = "mock-model"
+TK = make_test_tokenizer()
+
+
+def mock_args(**kw):
+    kw.setdefault("vocab_size", TK.vocab_size)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_gpu_blocks", 256)
+    kw.setdefault("speedup_ratio", 20.0)
+    return MockEngineArgs(**kw)
+
+
+async def _wait_for(predicate, timeout=10.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if await predicate():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+async def wait_for_model(manager: ModelManager, timeout=5.0):
+    await _wait_for(lambda: asyncio.sleep(0, manager.get(MODEL) is not None),
+                    timeout=timeout, msg="model discovery")
+
+
+async def test_replica_census_and_drain_readiness(capsys):
+    """Each replica registers frontends/<ns>/<replica>; any replica's
+    census lists the whole front door; drain flips readiness before exit
+    so LBs/clients stop picking the replica; `dynctl frontends` renders
+    the same census."""
+    rt = await DistributedRuntime.create()
+    a = HttpService(ModelManager(), port=0, runtime=rt, replica="fe-a")
+    b = HttpService(ModelManager(), port=0, runtime=rt, replica="fe-b")
+    await a.start()
+    await b.start()
+    base_a = f"http://127.0.0.1:{a.port}"
+    try:
+        async with aiohttp.ClientSession() as http:
+            async with http.get(f"{base_a}/v1/fleet/frontends") as r:
+                doc = await r.json()
+            assert doc["count"] == 2 and doc["ready"] == 2
+            rows = {fe["replica"]: fe for fe in doc["frontends"]}
+            assert set(rows) == {"fe-a", "fe-b"}
+            assert rows["fe-a"]["self"] and not rows["fe-b"]["self"]
+            assert rows["fe-b"]["url"].startswith("http://")
+
+            # drain B: readiness must flip in the shared census (A's view)
+            # BEFORE the process goes away — that ordering is what lets a
+            # client stop dialing a replica that will 503 it
+            await b.drain(timeout=1.0)
+            async with http.get(f"{base_a}/v1/fleet/frontends") as r:
+                doc = await r.json()
+            rows = {fe["replica"]: fe for fe in doc["frontends"]}
+            assert doc["ready"] == 1
+            assert rows["fe-a"]["ready"] and not rows["fe-b"]["ready"]
+            # and B itself refuses new work while draining
+            async with http.get(f"http://127.0.0.1:{b.port}/health") as r:
+                assert r.status == 503
+
+        # the operator view renders the same census (exit 0: ≥1 ready)
+        from dynamo_tpu.runtime.dynctl import frontends_amain
+
+        assert await frontends_amain(base_a, as_json=False) == 0
+        out = capsys.readouterr().out
+        assert "fe-a" in out and "fe-b" in out
+        assert "draining" in out and "1/2 ready" in out
+    finally:
+        await a.stop()
+        await b.stop()
+        await rt.shutdown()
+
+
+async def _stream_tokens(http, base, prompt, max_tokens=8):
+    """One SSE chat stream → (delta texts, finish_reason, completion_tokens)."""
+    body = {
+        "model": MODEL,
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": max_tokens,
+        "temperature": 0.0,
+        "stream": True,
+        "stream_options": {"include_usage": True},
+    }
+    deltas, finish, usage = [], None, None
+    async with http.post(f"{base}/v1/chat/completions", json=body) as r:
+        assert r.status == 200, await r.text()
+        async for line in r.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = json.loads(line[6:])
+            for ch in chunk.get("choices", []):
+                if ch.get("delta", {}).get("content"):
+                    deltas.append(ch["delta"]["content"])
+                if ch.get("finish_reason"):
+                    finish = ch["finish_reason"]
+            if chunk.get("usage"):
+                usage = chunk["usage"]["completion_tokens"]
+    return deltas, finish, usage
+
+
+async def test_streams_bit_identical_single_vs_multi_frontend():
+    """No chaos: the same prompt through a classic single frontend and
+    through each of two replicas (own router + event-fed radix each, same
+    worker fleet) must produce bit-identical token streams — replica mode
+    changes WHO routes, never WHAT the client reads."""
+    rt = await DistributedRuntime.create()
+    lease = await rt.plane.lease_create(30)
+    (engine,), (handle,) = await run_mocker(
+        rt, MODEL, mock_args(), lease_id=lease)
+
+    stacks = []  # (service, watcher, manager)
+    try:
+        for replica in (None, "fe-1", "fe-2"):
+            manager = ModelManager()
+            watcher = await ModelWatcher(rt, manager, router_mode="kv").start()
+            service = HttpService(manager, port=0, runtime=rt,
+                                  replica=replica)
+            await service.start()
+            stacks.append((service, watcher, manager))
+            await wait_for_model(manager)
+
+        prompt = "the quick brown fox jumps over the lazy dog " * 3
+        results = []
+        async with aiohttp.ClientSession() as http:
+            for service, _, _ in stacks:
+                results.append(await _stream_tokens(
+                    http, f"http://127.0.0.1:{service.port}", prompt))
+
+        single, rep1, rep2 = results
+        assert single[0], "single-frontend stream produced no tokens"
+        assert rep1 == single, (rep1, single)
+        assert rep2 == single, (rep2, single)
+    finally:
+        for service, watcher, _ in stacks:
+            await service.stop()
+            await watcher.stop()
+        await handle.stop(graceful=False)
+        await engine.stop()
+        await rt.shutdown()
+
+
+def _cfg():
+    return RuntimeConfig(control_plane_address=None, lease_ttl=2.0)
+
+
+async def test_frontend_sigkill_leaks_nothing_and_retry_is_radix_warm():
+    """SIGKILL a subprocess frontend mid-decode: the worker notices the
+    dead response-plane peer, cancels the orphaned sequence, and the KV
+    block pool returns to its pre-request census; a surviving in-process
+    replica then serves the retry radix-warm (the killed request's stored
+    prefix blocks score overlap on the shared event stream)."""
+    hub = ControlPlaneServer()
+    addr = await hub.start()
+
+    worker_rt = await DistributedRuntime.create(
+        plane=await RemoteControlPlane(addr).connect(), config=_cfg())
+    lease = await worker_rt.plane.lease_create(30)
+    # slow decode (speedup 2) so the stream is mid-flight when we kill
+    (engine,), (handle,) = await run_mocker(
+        worker_rt, MODEL, mock_args(speedup_ratio=2.0), lease_id=lease)
+
+    env = dict(os.environ)
+    env.update({"DYN_CONTROL_PLANE": addr, "DYN_LOG": "warning",
+                "JAX_PLATFORMS": "cpu"})
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "dynamo_tpu.frontend.main", "--port", "0",
+        "--replica-id", "fe-victim", "--router-mode", "kv",
+        env=env, stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.DEVNULL)
+    victim_port = None
+
+    fe_rt = await DistributedRuntime.create(
+        plane=await RemoteControlPlane(addr).connect(), config=_cfg())
+    manager = ModelManager()
+    watcher = await ModelWatcher(fe_rt, manager, router_mode="kv").start()
+    survivor = HttpService(manager, port=0, runtime=fe_rt, replica="fe-live")
+    await survivor.start()
+
+    try:
+        async def _ready_line():
+            while True:
+                line = (await proc.stdout.readline()).decode()
+                assert line, "frontend subprocess exited before READY"
+                if line.startswith("FRONTEND_READY"):
+                    return int(line.split("port=")[1])
+        victim_port = await asyncio.wait_for(_ready_line(), 30.0)
+        base_victim = f"http://127.0.0.1:{victim_port}"
+
+        async with aiohttp.ClientSession() as http:
+            async def victim_serves():
+                try:
+                    async with http.get(f"{base_victim}/v1/models") as r:
+                        return any(m["id"] == MODEL
+                                   for m in (await r.json())["data"])
+                except Exception:
+                    return False
+            await _wait_for(victim_serves, timeout=15.0,
+                            msg="victim frontend model discovery")
+            await wait_for_model(manager)
+
+            baseline = len(engine.cache.active)
+            prompt = "kv leak census prompt words " * 8
+            body = {"model": MODEL, "stream": True, "max_tokens": 64,
+                    "messages": [{"role": "user", "content": prompt}]}
+            got_tokens = 0
+            try:
+                async with http.post(f"{base_victim}/v1/chat/completions",
+                                     json=body) as r:
+                    assert r.status == 200, await r.text()
+                    async for line in r.content:
+                        if b'"content"' in line:
+                            got_tokens += 1
+                        if got_tokens >= 3:
+                            # mid-decode: the sequence is running on the
+                            # worker with blocks acquired
+                            os.kill(proc.pid, signal.SIGKILL)
+                            break
+                    # drain whatever the dead socket still yields
+                    async for _ in r.content:
+                        pass
+            except aiohttp.ClientError:
+                pass  # the peer just died under us — expected
+            assert got_tokens >= 3
+            await proc.wait()
+
+            # hygiene: the worker must cancel the orphan and release every
+            # block the request held — the active census returns to its
+            # pre-request value instead of pinning blocks forever
+            await _wait_for(
+                lambda: asyncio.sleep(
+                    0, len(engine.cache.active) <= baseline),
+                timeout=12.0, msg="orphaned KV blocks released")
+
+            # the retry lands radix-warm on the surviving replica: its
+            # router consumed the SAME kv_events the victim's did, so the
+            # killed request's stored prefix scores overlap immediately
+            base_live = f"http://127.0.0.1:{survivor.port}"
+            query = {"model": MODEL, "max_tokens": 4, "stream": True,
+                     "messages": [{"role": "user", "content": prompt}],
+                     "nvext": {"annotations": ["query_instance_id"]}}
+
+            async def warm():
+                async with http.post(f"{base_live}/v1/chat/completions",
+                                     json=query) as r:
+                    assert r.status == 200
+                    async for line in r.content:
+                        line = line.decode().strip()
+                        if line.startswith("data: ") and "worker_id" in line:
+                            return json.loads(line[6:])
+                return {}
+            await _wait_for(
+                lambda: _overlap(warm), timeout=10.0,
+                msg="surviving replica radix-warm retry")
+
+            # and the actual retry completes end to end
+            async with http.post(f"{base_live}/v1/chat/completions", json={
+                "model": MODEL, "max_tokens": 8,
+                "messages": [{"role": "user", "content": prompt}],
+            }) as r:
+                assert r.status == 200, await r.text()
+                resp = await r.json()
+                assert resp["usage"]["completion_tokens"] >= 1
+    finally:
+        if proc.returncode is None:
+            proc.kill()
+            await proc.wait()
+        await survivor.stop()
+        await watcher.stop()
+        await handle.stop(graceful=False)
+        await engine.stop()
+        await fe_rt.shutdown()
+        await worker_rt.shutdown()
+        await hub.stop()
+
+
+async def _overlap(warm):
+    return (await warm()).get("overlap_blocks", 0) >= 1
