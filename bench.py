@@ -1671,6 +1671,266 @@ async def ragged_bench(on_tpu: bool = False, reps: int = 2,
     return rep
 
 
+#: ``--quant`` kernel-arm gates: the int8-weight arm must cash its byte
+#: savings in. On TPU the measured wall-clock tok/s ratio is gated
+#: directly; on the CPU fallback the 427 KB tiny model is dispatch-bound
+#: (weights live in L2 — wall-clock cannot see HBM traffic), so the 1.5x
+#: is asserted on the v5e bandwidth-floor tok/s computed from each arm's
+#: REAL quantized bytes (a silent full-width fallback in quantize_params
+#: fails it) while wall-clock only has to hold the no-regression floor.
+QUANT_W8_SPEEDUP = 1.5
+QUANT_WALL_FLOOR = 0.8
+
+
+async def quant_bench(on_tpu: bool = False, reps: int = 2) -> dict:
+    """``bench.py --quant``: quantized serving to the bandwidth floor —
+    the ISSUE 19 A/B record.
+
+    Kernel arms (round-robin interleaved timed rounds at fixed batch, so
+    clock/thermal drift hits every arm equally instead of flattering the
+    late ones): bf16 / int8 / int4-g32 weights x bf16 / int8 KV on the
+    fused multi-step decode launch. Each arm reports ``quant_<arm>_tok_s``
+    plus the roofline block (``*_hbm_gbps`` / ``*_hbm_util_v5e`` /
+    ``*_params_bytes``) and its v5e bandwidth-floor tok/s from measured
+    bytes (see QUANT_W8_SPEEDUP note for which one the gate reads).
+
+    Engine arms (the ragged_bench mixed prefill+decode wave, shrunk):
+    base bf16, int8 KV on the in-kernel dequant path, int8 KV forced onto
+    the XLA oracle (``DYN_RAGGED_ORACLE=1`` — the deleted silent fallback
+    kept reachable ONLY as this explicit A/B switch), int8 and int4-g32
+    weights. Gates:
+
+    - int8-KV greedy AND seeded streams bit-identical to the bf16-KV arm
+      and to the oracle arm (cache quantization noise must stay below the
+      sampler on the tiny-f32 horizon — docs/performance.md);
+    - int8-KV compiled-signature census == bf16 census (zero new
+      signatures: quantized KV rides the same packed launch);
+    - int8-KV arm no slower than its oracle arm past the noise floor
+      (in-kernel dequant must not lose to the fallback it replaced);
+    - weight-quant arms deterministic across reps (int4 noise may move
+      greedy argmax vs base, but never run-to-run);
+    - plan_70b's solved quantized placement still fits under its
+      bandwidth ceiling (``assert_quant``, solver half — the compile half
+      runs in tests/test_quant_serving.py where 8 host devices exist).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.cache import allocate_device_cache, tree_nbytes
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.engine.quant import quantize_params
+    from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                      StopConditions)
+
+    # ------------------------------------------------- kernel arms (fixed B)
+    if on_tpu:
+        cfg = ModelConfig.llama3_1b()
+        B, kv_len, iters, K = 64, 512, 50, 16
+    else:
+        cfg = ModelConfig.tiny()
+        B, kv_len, iters, K = 8, 64, 10, 4
+    block_size = 16
+    W = (kv_len + K + block_size - 1) // block_size
+    num_blocks = B * W + 1
+
+    params = M.init_params(cfg, jax.random.key(0))
+    host = jax.tree.map(np.asarray, params)
+    multi = M.make_multi_decode_fn(cfg, block_size, K)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B,)), jnp.int32)
+    bt = np.zeros((B, W), np.int32)
+    for i in range(B):
+        bt[i] = 1 + i * W + np.arange(W)
+    block_tables = jnp.asarray(bt)
+    ints = jnp.stack([tokens, jnp.full((B,), kv_len - 1, jnp.int32),
+                      jnp.full((B,), kv_len, jnp.int32),
+                      jnp.zeros((B,), jnp.int32)], axis=1)
+    floats = jnp.stack([jnp.zeros((B,), jnp.float32),
+                        jnp.ones((B,), jnp.float32)], axis=1)
+    rand = jnp.zeros((B, 2), jnp.uint32)
+
+    arms = [("bf16", None, False), ("w8", "int8", False),
+            ("w4g32", "int4-g32", False), ("kv8", None, True),
+            ("w4kv8", "int4-g32", True)]
+    state: dict = {}
+    for name, quant, kv8 in arms:
+        p = (jax.device_put(quantize_params(host, quant)) if quant
+             else params)
+        kc, vc = allocate_device_cache(cfg, num_blocks, block_size,
+                                       dtype="int8" if kv8 else None)
+        kv_tok = ((tree_nbytes(kc) + tree_nbytes(vc))
+                  / (num_blocks * block_size))
+        toks, _, kc, vc = multi(p, ints, floats, rand, block_tables, kc, vc)
+        int(toks[0, 0])  # compile + settle before any arm's timed round
+        state[name] = {"params": p, "kc": kc, "vc": vc, "kv_tok": kv_tok,
+                       "tok_s": 0.0}
+    for _ in range(max(reps, 2)):
+        for name, _, _ in arms:
+            st = state[name]
+            kc, vc = st["kc"], st["vc"]
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                toks, _, kc, vc = multi(st["params"], ints, floats, rand,
+                                        block_tables, kc, vc)
+            # a device->host fetch forces completion of the donated chain
+            int(toks[-1, 0])
+            dt = time.perf_counter() - t0
+            st["kc"], st["vc"] = kc, vc
+            st["tok_s"] = max(st["tok_s"], B * K * iters / dt)
+
+    rep: dict = {"quant_kernel_shape":
+                 f"B={B},kv={kv_len},K={K},iters={iters}"}
+    for name, _, _ in arms:
+        st = state[name]
+        rep[f"quant_{name}_tok_s"] = round(st["tok_s"], 1)
+        roof = _roofline(st["params"], st["tok_s"], st["tok_s"] / B,
+                         f"quant_{name}")
+        rep.update(roof)
+        # decode tok/s at the v5e bandwidth floor from MEASURED bytes:
+        # every step streams the weights once + each row's KV window
+        step_bytes = (roof[f"quant_{name}_params_bytes"]
+                      + B * kv_len * st["kv_tok"])
+        rep[f"quant_{name}_tok_s_v5e_floor"] = int(
+            B / (step_bytes / HBM_BW_V5E))
+    del state  # release the donated caches before the engine arms
+    rep["quant_w8_vs_bf16"] = round(
+        rep["quant_w8_tok_s"] / max(rep["quant_bf16_tok_s"], 1e-9), 3)
+    rep["quant_w8_vs_bf16_v5e_floor"] = round(
+        rep["quant_w8_tok_s_v5e_floor"]
+        / max(rep["quant_bf16_tok_s_v5e_floor"], 1), 3)
+    w8_gate = (rep["quant_w8_vs_bf16"] if on_tpu
+               else rep["quant_w8_vs_bf16_v5e_floor"])
+
+    # ------------------------------------------ engine arms (mixed wave)
+    if on_tpu:
+        ecfg = ModelConfig.llama3_1b()
+        bs = 16
+        N_P, ISL_P, OSL_P = 4, 256, 16
+        N_D, ISL_D, OSL_D = 4, 64, 32
+        slots, budget = 16, 512
+        extra = dict(use_pallas_attention=True)
+    else:
+        ecfg = ModelConfig.tiny()
+        bs = 4
+        N_P, ISL_P, OSL_P = 3, 48, 8
+        N_D, ISL_D, OSL_D = 3, 12, 16
+        slots, budget = 8, 64
+        extra = {}
+    max_len = 2 * max(ISL_P + OSL_P, ISL_D + OSL_D)
+    working = (N_P * ((ISL_P + OSL_P + bs - 1) // bs)
+               + N_D * ((ISL_D + OSL_D + bs - 1) // bs))
+    base = dict(block_size=bs, num_blocks=2 * working + 8,
+                max_num_seqs=slots, max_num_batched_tokens=budget,
+                max_model_len=max_len, enable_prefix_caching=False, **extra)
+    wrng = np.random.default_rng(41)
+    p_prompts = [wrng.integers(1, ecfg.vocab_size, ISL_P).tolist()
+                 for _ in range(N_P)]
+    d_prompts = [wrng.integers(1, ecfg.vocab_size, ISL_D).tolist()
+                 for _ in range(N_D)]
+
+    def req(tokens, osl, seed=None):
+        sopt = (SamplingOptions(temperature=0.0) if seed is None else
+                SamplingOptions(temperature=0.8, top_p=0.9, seed=seed))
+        return PreprocessedRequest(
+            model="m", token_ids=list(tokens),
+            stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
+            sampling_options=sopt)
+
+    async def one(eng, tokens, osl, seed=None):
+        toks = []
+        async for out in eng.generate(req(tokens, osl, seed)):
+            toks.extend(out.token_ids)
+        return toks
+
+    async def wave(eng, seeded=False):
+        """Decode-heavy first; prefill-heavy arrives once decode is
+        underway — the mixed regime of ragged_bench, on every arm."""
+        t0 = time.perf_counter()
+        dec = [asyncio.ensure_future(
+            one(eng, p, OSL_D, seed=100 + i if seeded else None))
+            for i, p in enumerate(d_prompts)]
+        for _ in range(20000):
+            if any(s.generated > 0 for s in eng.scheduler.running):
+                break
+            await asyncio.sleep(0.001)
+        pre = [asyncio.ensure_future(
+            one(eng, p, OSL_P, seed=200 + i if seeded else None))
+            for i, p in enumerate(p_prompts)]
+        res = await asyncio.gather(*dec, *pre)
+        return res, time.perf_counter() - t0
+
+    async def measure(**arm_args) -> dict:
+        eng = AsyncJaxEngine(ecfg, EngineArgs(**base, **arm_args))
+        out: dict = {}
+        res0, _ = await wave(eng)  # serving caches warm (XLA compiled)
+        out["streams_first"] = res0
+        for _ in range(reps):
+            res, dt = await wave(eng)
+            out["tok_s"] = max(out.get("tok_s", 0.0),
+                               sum(len(t) for t in res) / dt)
+            out["greedy"] = res
+        sres, _ = await wave(eng, seeded=True)
+        out["seeded"] = sres
+        out["signatures"] = sorted(eng.compiled_signatures)
+        await eng.close()
+        return out
+
+    ebase = await measure()
+    ekv8 = await measure(kv_cache_dtype="int8")
+    # oracle arm: the SAME int8-KV engine forced onto the XLA ragged
+    # reference — the only remaining way to reach the ex-fallback path
+    os.environ["DYN_RAGGED_ORACLE"] = "1"
+    try:
+        eoracle = await measure(kv_cache_dtype="int8")
+    finally:
+        os.environ.pop("DYN_RAGGED_ORACLE", None)
+    ew8 = await measure(quantization="int8")
+    ew4 = await measure(quantization="int4-g32")
+
+    rep.update({
+        "serve_workload": (f"pre={N_P}x(ISL={ISL_P},OSL={OSL_P}) "
+                           f"dec={N_D}x(ISL={ISL_D},OSL={OSL_D}) "
+                           f"slots={slots} budget={budget}"),
+        "serve_base_tok_s": round(ebase["tok_s"], 1),
+        "serve_kv8_tok_s": round(ekv8["tok_s"], 1),
+        "serve_kv8_oracle_tok_s": round(eoracle["tok_s"], 1),
+        "serve_w8_tok_s": round(ew8["tok_s"], 1),
+        "serve_w4_tok_s": round(ew4["tok_s"], 1),
+        "kv8_greedy_identical": ekv8["greedy"] == ebase["greedy"],
+        "kv8_seeded_identical": ekv8["seeded"] == ebase["seeded"],
+        "kv8_oracle_greedy_identical": ekv8["greedy"] == eoracle["greedy"],
+        "kv8_oracle_seeded_identical": ekv8["seeded"] == eoracle["seeded"],
+        "kv8_new_signatures": [
+            list(s) for s in ekv8["signatures"]
+            if s not in ebase["signatures"]],
+        "kv8_vs_oracle_tok_s": round(
+            ekv8["tok_s"] / max(eoracle["tok_s"], 1e-9), 3),
+        "w8_deterministic": ew8["greedy"] == ew8["streams_first"],
+        "w4_deterministic": ew4["greedy"] == ew4["streams_first"],
+    })
+
+    # solver half of the 70B quantized-placement gate (fast, no compile —
+    # the bench child has a single initialized CPU device)
+    from benchmarks.plan_70b import assert_quant
+    plan = assert_quant(run_compile=False)
+    rep["plan_70b"] = {k: plan[k] for k in
+                       ("combo", "fits", "kernel_hbm_util_v5e", "quant_ok")}
+
+    rep["quant_ok"] = (
+        w8_gate >= QUANT_W8_SPEEDUP
+        and rep["quant_w8_vs_bf16"] >= QUANT_WALL_FLOOR
+        and rep["kv8_greedy_identical"] and rep["kv8_seeded_identical"]
+        and rep["kv8_oracle_greedy_identical"]
+        and rep["kv8_oracle_seeded_identical"]
+        and not rep["kv8_new_signatures"]
+        and rep["kv8_vs_oracle_tok_s"] >= QUANT_WALL_FLOOR
+        and rep["w8_deterministic"] and rep["w4_deterministic"]
+        and plan["quant_ok"])
+    return rep
+
+
 async def flight_bench(on_tpu: bool = False, reps: int = 4) -> dict:
     """``bench.py --flight``: the flight recorder's two contracts (ISSUE 12
     acceptance).
@@ -3131,6 +3391,24 @@ def main():
         print(json.dumps(out), flush=True)
         raise SystemExit(0 if out["ragged_ok"] else 1)
 
+    if "--quant" in sys.argv:
+        # quantized-serving A/B (ISSUE 19): interleaved kernel arms with
+        # roofline + bandwidth-floor fields, engine arms with the int8-KV
+        # vs bf16 / vs DYN_RAGGED_ORACLE stream-identity + signature-census
+        # gates, and the plan_70b quantized-placement solver gate — prints
+        # one JSON line; exits nonzero when any gate fails
+        try:
+            out = asyncio.run(quant_bench(False))
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"quant": "failed", "error": repr(e)[:300]}),
+                  flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["quant_ok"] else 1)
+
     if "--tools" in sys.argv:
         # structured tool-loop smoke: constrained-vs-free multi-turn
         # sessions + peer onboarding — prints one JSON line; exits nonzero
@@ -3398,20 +3676,22 @@ def _child_main():
                              "kernel,spec,e2e,chaos,mem,qos,autoscale,"
                              "ragged,raggedmodes,disagg,migration,onboard,"
                              "flight,tools,attribution,kvaudit,flagship,"
-                             "frontdoor"
+                             "frontdoor,quant"
                              ).split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
                         "autoscale", "ragged", "raggedmodes", "disagg",
                         "migration", "onboard", "flight", "tools",
-                        "attribution", "kvaudit", "flagship", "frontdoor"}
+                        "attribution", "kvaudit", "flagship", "frontdoor",
+                        "quant"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
                          f"{sorted(unknown)} (valid: kernel, spec, e2e, "
                          f"chaos, mem, qos, autoscale, ragged, raggedmodes, "
                          f"disagg, migration, onboard, flight, tools, "
-                         f"attribution, kvaudit, flagship, frontdoor)")
+                         f"attribution, kvaudit, flagship, frontdoor, "
+                         f"quant)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -3562,6 +3842,16 @@ def _child_main():
                 kern["flagship"] = flag
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["flagship_error"] = repr(e)[:200]
+        if "quant" in phases:
+            # quantized-serving phase: interleaved weight/KV-quant kernel
+            # arms (roofline + bandwidth-floor), int8-KV stream identity
+            # vs the bf16 arm and the DYN_RAGGED_ORACLE arm, signature
+            # census, and the plan_70b quantized-placement solver gate —
+            # the bandwidth-floor record every round (ISSUE 19 acceptance)
+            try:
+                kern["quant"] = asyncio.run(quant_bench(on_tpu))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["quant_error"] = repr(e)[:200]
         if "frontdoor" in phases:
             # front-door chaos phase: 3 frontend replicas on one KV-fed
             # routing view, one SIGKILLed mid-peak + hub primary killed

@@ -151,8 +151,31 @@ def placement(combo: str = PLACEMENT_COMBO) -> dict:
     }
 
 
-def compile_proof(tp: int = 8, layers: int = 2) -> dict:
-    """AOT-compile the decode step at 70B layer shapes over a TP mesh."""
+#: ceiling on the quantized combo's REAL bandwidth demand relative to the
+#: solver's analytic roofline (quant_metrics): f32 group scales on int4-g32
+#: weights cost 4/32 = 0.125 B/element over the 0.5 B/element payload, so
+#: ~1.15× is the honest layout tax; past 1.25 the layout has regressed
+#: (scales stored wide, a leaf fallen back to full width, ...)
+QUANT_HBM_UTIL_CEILING = 1.25
+
+#: the materialization guard (the §2 risk in docs/PERF_NOTES.md): a
+#: grouped dequant chain that materializes full-width weight copies would
+#: ADD gigabytes of temp to the 2-layer TP8 step (w_down alone is 0.94 GB
+#: f32) — so the quantized program's temp bytes must stay BELOW the bf16
+#: program's, never above. Measured on CPU AOT: 0.526 GB quant vs
+#: 0.975 GB bf16.
+QUANT_TEMP_RATIO_CEILING = 1.05
+
+
+def compile_proof(tp: int = 8, layers: int = 2, quantization=None,
+                  kv_int8: bool = False) -> dict:
+    """AOT-compile the decode step at 70B layer shapes over a TP mesh.
+
+    ``quantization``/``kv_int8`` lower the step against the ABSTRACT
+    quantized param tree (engine/quant.quantize_params_abstract) and the
+    int8 paged-KV pytree — the solved ``tp8_wint4_kvint8`` placement
+    proven to lower, shard, and stay under the no-materialization temp
+    ceiling without 141 GB of arrays."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -164,6 +187,7 @@ def compile_proof(tp: int = 8, layers: int = 2) -> dict:
 
     jax.config.update("jax_platforms", "cpu")
     from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.cache import tree_nbytes
     from dynamo_tpu.engine.config import ModelConfig
     from dynamo_tpu.parallel import MeshConfig, make_mesh
 
@@ -174,9 +198,26 @@ def compile_proof(tp: int = 8, layers: int = 2) -> dict:
 
     params = jax.eval_shape(functools.partial(M.init_params, cfg),
                             jax.random.key(0))
-    kc = jax.ShapeDtypeStruct((cfg.num_layers, num_blocks * block_size,
-                               cfg.num_kv_heads, cfg.head_dim),
-                              jnp.dtype(cfg.dtype))
+    sh_params = M.param_shardings(cfg, mesh)
+    if quantization is not None:
+        from dynamo_tpu.engine.quant import (
+            quant_shardings, quantize_params_abstract,
+        )
+        params = quantize_params_abstract(params, quantization)
+        sh_params = quant_shardings(sh_params, params)
+    slots = num_blocks * block_size
+    if kv_int8:
+        kc = {"q": jax.ShapeDtypeStruct(
+                  (cfg.num_layers, slots, cfg.num_kv_heads, cfg.head_dim),
+                  jnp.int8),
+              "s": jax.ShapeDtypeStruct(
+                  (cfg.num_layers, slots, cfg.num_kv_heads), jnp.float32)}
+        sh_cache = M.cache_shardings(mesh, cfg, quant=True)
+    else:
+        kc = jax.ShapeDtypeStruct((cfg.num_layers, slots,
+                                   cfg.num_kv_heads, cfg.head_dim),
+                                  jnp.dtype(cfg.dtype))
+        sh_cache = M.cache_shardings(mesh, cfg)
     args = (
         params,
         jax.ShapeDtypeStruct((B, 1), jnp.int32),      # tokens
@@ -189,8 +230,6 @@ def compile_proof(tp: int = 8, layers: int = 2) -> dict:
     )
     fn = functools.partial(M.forward, cfg=cfg, block_size=block_size,
                            mesh=mesh)
-    sh_params = M.param_shardings(cfg, mesh)
-    sh_cache = M.cache_shardings(mesh, cfg)
     bs = M.batch_shardings(mesh)
     in_sh = (sh_params, bs["tokens"], bs["positions"], bs["slot_map"],
              bs["block_tables"], bs["kv_lens"], bs["last_idx"],
@@ -201,10 +240,90 @@ def compile_proof(tp: int = 8, layers: int = 2) -> dict:
     ma = compiled.memory_analysis()
     return {
         "tp": tp, "layers": layers,
+        "quantization": quantization, "kv_int8": kv_int8,
+        "params_bytes": int(tree_nbytes(params)),
         "argument_gb": round(ma.argument_size_in_bytes / 1e9, 2),
         "temp_gb": round(ma.temp_size_in_bytes / 1e9, 3),
         "output_gb": round(ma.output_size_in_bytes / 1e9, 3),
     }
+
+
+def quant_metrics(combo: str = PLACEMENT_COMBO) -> dict:
+    """Ground-truth HBM accounting for a quantized combo from the REAL
+    quantized param tree (abstract — shapes only, full 80-layer depth),
+    against the solver's analytic estimate.
+
+    ``kernel_hbm_util_v5e`` is the fraction of v5e peak bandwidth the
+    placement needs to hit its solved roofline tok/s once the real layout
+    tax (f32 group scales, non-divisible leaves kept wide) is counted:
+    1.0 = the analytic plan was exact, > QUANT_HBM_UTIL_CEILING = the
+    quantized layout regressed and the plan is infeasible."""
+    import functools
+
+    import jax
+
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.cache import tree_nbytes
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.quant import quantize_params_abstract
+
+    cfg = ModelConfig.llama3_70b()
+    tp_s, w_s, kv_s = combo.split("_")
+    tp = int(tp_s[2:])
+    wname, kvname = w_s[1:], kv_s[2:]
+    w_bytes = {"bf16": 2.0, "int8": 1.0, "int4": 0.5}[wname]
+    kv_b = {"bf16": 2.0, "int8": 1.0}[kvname]
+    solved = solve(cfg, tp, w_bytes, kv_b)
+    params = jax.eval_shape(functools.partial(M.init_params, cfg),
+                            jax.random.key(0))
+    spec = {"int8": "int8", "int4": "int4-g32"}.get(wname)
+    if spec is not None:
+        params = quantize_params_abstract(params, spec)
+    pb = int(tree_nbytes(params))
+    out = {"combo": combo, "quant_spec": spec, "params_bytes": pb,
+           "weights_gb_chip_actual": round(pb / tp / 1e9, 2),
+           "fits": bool(solved.get("fits"))}
+    if not solved.get("fits"):
+        return out
+    # the step the solver planned, re-costed with the real weight bytes
+    kvpt = kv_bytes_per_token_per_chip(cfg, tp, kv_b)
+    batch = solved["max_batch_per_worker"]
+    step_bytes = pb / tp + batch * AVG_KV * kvpt
+    planned_step_s = solved["step_ms_roofline"] / 1e3
+    out["kernel_hbm_util_v5e"] = round(
+        step_bytes / (planned_step_s * HBM_BW), 3)
+    out["tok_s_per_chip_roofline_actual"] = int(
+        batch / (step_bytes / HBM_BW) / tp)
+    return out
+
+
+def assert_quant(run_compile: bool = False) -> dict:
+    """The ``--assert-quant`` exit gate: the solved quantized placement
+    must fit, its real-layout bandwidth demand must stay under
+    QUANT_HBM_UTIL_CEILING, and (with ``run_compile``) the quantized step
+    must AOT-lower with temp bytes under the no-materialization ceiling.
+    The bench quant phase runs the solver half of this; the compile half
+    also runs as a test (tests/test_quant_serving.py)."""
+    proofs = None
+    if run_compile:
+        # BEFORE any other jax use: compile_proof sets the host-device
+        # XLA flag, which only takes effect if jax is uninitialized
+        proofs = (compile_proof(quantization="int4-g32", kv_int8=True),
+                  compile_proof())
+    qm = quant_metrics(PLACEMENT_COMBO)
+    ok = qm["fits"] and qm.get(
+        "kernel_hbm_util_v5e", 99.0) <= QUANT_HBM_UTIL_CEILING
+    out = dict(qm)
+    if proofs is not None:
+        proof_q, proof_bf16 = proofs
+        out["compile_proof"] = proof_q
+        out["compile_proof_bf16"] = proof_bf16
+        # materialization guard: wide dequant copies would push quant temp
+        # past bf16 temp (see QUANT_TEMP_RATIO_CEILING note)
+        ok = (ok and proof_q["temp_gb"]
+              <= proof_bf16["temp_gb"] * QUANT_TEMP_RATIO_CEILING)
+    out["quant_ok"] = bool(ok)
+    return out
 
 
 def main():
@@ -216,11 +335,22 @@ def main():
                          "(2xTP8 prefill + 6xTP8 decode) as JSON and exit")
     ap.add_argument("--combo", default=PLACEMENT_COMBO,
                     help=f"placement combo key (default {PLACEMENT_COMBO})")
+    ap.add_argument("--assert-quant", action="store_true",
+                    help="exit 1 unless the solved quantized placement "
+                         "(tp8_wint4_kvint8) fits with real-layout bytes "
+                         "under the bandwidth ceiling; add --compile to "
+                         "also AOT-lower the quantized step and gate its "
+                         "temp bytes (no-materialization proof)")
     cli = ap.parse_args()
 
     if cli.emit_placement:
         print(json.dumps(placement(cli.combo)), flush=True)
         return
+
+    if cli.assert_quant:
+        res = assert_quant(run_compile=cli.compile)
+        print(json.dumps(res), flush=True)
+        sys.exit(0 if res["quant_ok"] else 1)
 
     from dynamo_tpu.engine.config import ModelConfig
     cfg = ModelConfig.llama3_70b()

@@ -505,6 +505,18 @@ class EngineArgs:
             raise ValueError(
                 f"kv_cache_dtype={self.kv_cache_dtype!r} not supported "
                 "(None/'auto' = model dtype, or 'int8')")
+        if self.quantization is not None:
+            # validate the spec HERE, not at weight-load time deep in the
+            # loader: int4 without grouping and unknown "-gN" grammars must
+            # surface as a field-named config error, not a raw traceback
+            # mid-initialization
+            from dynamo_tpu.engine.quant import parse_spec
+            try:
+                parse_spec(self.quantization)
+            except ValueError as e:
+                raise ValueError(
+                    f"quantization={self.quantization!r} invalid: {e}"
+                ) from None
         if not self.decode_batch_buckets:
             b = [2**i for i in range(0, max(1, self.max_num_seqs).bit_length())
                  if 2**i <= self.max_num_seqs] or [1]

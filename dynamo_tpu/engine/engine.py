@@ -203,6 +203,22 @@ class AsyncJaxEngine:
             cfg, nb, args.block_size, mesh, global_arrays=self._multihost,
             dtype="int8" if self._kv_quant else None)
 
+        #: silent-fallback visibility (docs/performance.md "Quantized
+        #: serving"): static reason the ragged step degrades to the XLA
+        #: attention path (None = Pallas ragged kernel on the path, or
+        #: never requested). A degraded launch is a silent TTFT/HBM
+        #: regression — log it ONCE here, count every degraded step into
+        #: dynamo_ragged_fallback_total{reason}, and tag the flight record.
+        self.ragged_fallback_reason = M.ragged_fallback_reason(
+            cfg, mesh, args.use_pallas_attention, self._kv_quant,
+            nb * args.block_size)
+        self.ragged_fallback_total: dict = {}
+        if self.ragged_fallback_reason is not None:
+            logger.warning(
+                "ragged Pallas kernel unavailable (reason=%s): steps take "
+                "the XLA attention path — counted in "
+                "dynamo_ragged_fallback_total", self.ragged_fallback_reason)
+
         #: per-tier residency ledger (observability/kvaudit.py): the
         #: worker-side ground truth the KV audit plane compares the
         #: router's radix view against — rolling xor/count digests folded
@@ -1538,6 +1554,12 @@ class AsyncJaxEngine:
         ``_note_compile`` during this step's dispatch, stamp the
         step↔request-id linkage the attribution join needs, and feed the
         anomaly-triggered profiler."""
+        fb = self.ragged_fallback_reason
+        if fb is not None:
+            # every executed step on a degraded attention path counts —
+            # the counter runs even with the flight recorder disabled
+            self.ragged_fallback_total[fb] = (
+                self.ragged_fallback_total.get(fb, 0) + 1)
         if not self.flight.enabled:
             return
         sched = self.scheduler
@@ -1577,6 +1599,8 @@ class AsyncJaxEngine:
             prefill_ids=self._ctx_ids(prefill_seqs),
             starved_ids=(list(sched.last_starved_ids)
                          if starved is None else []))
+        if rec is not None and fb is not None:
+            rec.tags.append("ragged_fallback:" + fb)
         if self.anomaly_profiler is not None:
             self.anomaly_profiler.on_record(rec)
 

@@ -517,6 +517,16 @@ async def amain():
         "distinct jitted step signatures dispatched so far (the compile "
         "surface warmup must cover)").add_callback(
         lambda: {None: len(engine.compiled_signatures)})
+    # silent-fallback visibility (docs/performance.md "Quantized serving"):
+    # steps executed while the ragged Pallas kernel is degraded to the XLA
+    # attention path, labeled by the static reason (mesh / softcap /
+    # lane_align / scale_budget). Zero on a healthy quantized fleet.
+    runtime.metrics.counter(
+        "ragged_fallback_total",
+        "steps executed on the XLA ragged fallback instead of the Pallas "
+        "ragged kernel, by reason").add_callback(
+        lambda: {(("reason", r),): v
+                 for r, v in engine.ragged_fallback_total.items()})
     runtime.metrics.gauge(
         "engine_warmup_skipped",
         "1 = requested AOT warmup could not run (multi-host step "

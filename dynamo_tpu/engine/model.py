@@ -1417,17 +1417,21 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
         if ragged is not None:
             rows3, grid_row, grid_col, grid_rows = ragged
             # Pallas ragged kernel: single-launch mixed prefill+decode over
-            # the flat page view. XLA ragged path covers everything the
-            # kernel doesn't (int8 pages, non-aligned heads, meshes,
-            # Gemma-2 softcap) with identical masking semantics.
+            # the flat page view — int8 KV pages included (scales ride
+            # VMEM-resident, dequant fused into the launch). XLA ragged
+            # path covers what the kernel can't (non-aligned heads, meshes,
+            # Gemma-2 softcap, over-budget scale tables) with identical
+            # masking semantics; that degrade is counted by the engine
+            # (dynamo_ragged_fallback_total), never silent.
             from dynamo_tpu.ops.ragged_attention import (
-                ragged_paged_attention, ragged_pallas_supported,
+                ragged_int8_kernel_supported, ragged_paged_attention,
+                ragged_pallas_supported,
             )
 
             # lane alignment checked HERE: the kernel's own fallback is the
             # dense per-token oracle, fine for tests but O(T·W·bs) memory —
             # non-aligned shapes must take the grid path below instead
-            use_ragged_kernel = (use_pallas and mesh is None and not kv_quant
+            use_ragged_kernel = (use_pallas and mesh is None
                                  and not cfg.attn_logit_softcap
                                  and ragged_pallas_supported(KV, hd))
             if use_ragged_kernel:
@@ -1436,6 +1440,24 @@ def forward(params: dict, tokens, positions, slot_map, block_tables, kv_lens,
                 L_, slots_, KV_, hd_ = cache_shape(kc)
                 nb = slots_ // block_size
                 flat = L_ * slots_
+                if kv_quant and not ragged_int8_kernel_supported(KV_, slots_):
+                    use_ragged_kernel = False
+            if use_ragged_kernel and kv_quant:
+                # int8 pages IN-kernel: flat int8 page view + THIS layer's
+                # scale slice, rebased onto the flat slot ids via
+                # scale_slot_base so the VMEM scale budget is per-layer
+                attn = ragged_paged_attention(
+                    q[0], kc["q"].reshape(flat, KV_, hd_),
+                    vc["q"].reshape(flat, KV_, hd_),
+                    block_tables + lidx * nb, rows3,
+                    block_size=block_size, window=window,
+                    sinks=lp.get("sink"),
+                    k_scales=jax.lax.dynamic_index_in_dim(
+                        kc["s"], lidx, keepdims=False),
+                    v_scales=jax.lax.dynamic_index_in_dim(
+                        vc["s"], lidx, keepdims=False),
+                    scale_slot_base=lidx * slots_)[None]
+            elif use_ragged_kernel:
                 attn = ragged_paged_attention(
                     q[0], kc.reshape(flat, KV_, hd_),
                     vc.reshape(flat, KV_, hd_),
@@ -1838,6 +1860,35 @@ def multi_decode(params, last_tokens, positions, block_tables, kv_lens,
         step, carry0, jnp.arange(num_steps))
     k_cache, v_cache = out_carry[-2], out_carry[-1]
     return toks, logps, k_cache, v_cache
+
+
+def ragged_fallback_reason(cfg: ModelConfig, mesh: Optional[Mesh],
+                           use_pallas: bool, kv_quant: bool = False,
+                           slots_per_layer: int = 0) -> Optional[str]:
+    """Static (trace-time) reason the ragged step will degrade to the XLA
+    attention path instead of the Pallas ragged kernel, or None when the
+    kernel is on the path. Mirrors the gate in :func:`forward` exactly —
+    the engine counts this per step (``dynamo_ragged_fallback_total``) so
+    a degraded launch is never silent. Returns None as well when Pallas
+    was never requested (a config choice, not a degrade) and for MLA
+    models (the latent ragged walk is their designed path, not a
+    fallback)."""
+    from dynamo_tpu.ops.ragged_attention import (
+        ragged_int8_kernel_supported, ragged_pallas_supported,
+    )
+
+    if not use_pallas or cfg.is_mla:
+        return None
+    if mesh is not None:
+        return "mesh"
+    if cfg.attn_logit_softcap:
+        return "softcap"
+    if not ragged_pallas_supported(cfg.num_kv_heads, cfg.head_dim):
+        return "lane_align"
+    if kv_quant and not ragged_int8_kernel_supported(cfg.num_kv_heads,
+                                                     slots_per_layer):
+        return "scale_budget"
+    return None
 
 
 def _resolve_kernel_flags(cfg: ModelConfig, mesh: Optional[Mesh],

@@ -150,6 +150,38 @@ def stack_layers(xs: list):
     return jnp.stack(xs)
 
 
+def _quant_walk(tree: dict, bits: int, group: Optional[int], leaf) -> dict:
+    """Shared eligibility walk for the real and abstract quantizers:
+    ``leaf(v, group)`` maps each eligible weight; narrow projections that
+    do not divide the group fall back to per-channel (or stay full-width
+    for int4, which needs groups)."""
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            out[k] = _quant_walk(v, bits, group, leaf)
+        elif k in QUANT_KEYS:
+            g = group
+            if g is not None and v.shape[-2] % g:
+                # narrow projections (e.g. MLA kv_a with small D) may
+                # not divide; fall back to per-channel rather than fail
+                g = None
+                if bits == 4:
+                    _logger.warning(
+                        "quantize_params: %s dim %d not divisible by "
+                        "group %d — kept at FULL width (int4 needs "
+                        "groups)", k, v.shape[-2], group)
+                    out[k] = v
+                    continue
+                _logger.warning(
+                    "quantize_params: %s dim %d not divisible by group "
+                    "%d — per-channel int8 instead", k, v.shape[-2],
+                    group)
+            out[k] = leaf(v, g)
+        else:
+            out[k] = v
+    return out
+
+
 def quantize_params(params: dict, spec: str) -> dict:
     """Quantize every eligible matmul weight in a loaded param tree.
 
@@ -158,35 +190,25 @@ def quantize_params(params: dict, spec: str) -> dict:
     host (numpy) so the bf16 originals never need to be device-resident
     together with the quantized copies."""
     bits, group = parse_spec(spec)
+    return _quant_walk(params, bits, group,
+                       lambda v, g: quantize(v, bits=bits, group=g))
 
-    def walk(tree: dict) -> dict:
-        out = {}
-        for k, v in tree.items():
-            if isinstance(v, dict):
-                out[k] = walk(v)
-            elif k in QUANT_KEYS:
-                g = group
-                if g is not None and v.shape[-2] % g:
-                    # narrow projections (e.g. MLA kv_a with small D) may
-                    # not divide; fall back to per-channel rather than fail
-                    g = None
-                    if bits == 4:
-                        _logger.warning(
-                            "quantize_params: %s dim %d not divisible by "
-                            "group %d — kept at FULL width (int4 needs "
-                            "groups)", k, v.shape[-2], group)
-                        out[k] = v
-                        continue
-                    _logger.warning(
-                        "quantize_params: %s dim %d not divisible by group "
-                        "%d — per-channel int8 instead", k, v.shape[-2],
-                        group)
-                out[k] = quantize(v, bits=bits, group=g)
-            else:
-                out[k] = v
-        return out
 
-    return walk(params)
+def quantize_params_abstract(params: dict, spec: str) -> dict:
+    """ShapeDtypeStruct analog of :func:`quantize_params` — same leaf
+    eligibility and QTensor shapes without touching data. This is what
+    AOT compile proofs (benchmarks/plan_70b.py) lower against: 70B-scale
+    quantized layouts validated without 141 GB of arrays."""
+    bits, group = parse_spec(spec)
+    dt = jnp.int8 if bits == 8 else jnp.int4
+
+    def leaf(v, g):
+        G = v.shape[-2] // (g or v.shape[-2])
+        return {"q": jax.ShapeDtypeStruct(v.shape, dt),
+                "s": jax.ShapeDtypeStruct((*v.shape[:-2], G, v.shape[-1]),
+                                          jnp.float32)}
+
+    return _quant_walk(params, bits, group, leaf)
 
 
 def quant_shardings(shardings: dict, params: dict) -> dict:

@@ -29,14 +29,26 @@ HBM at dynamic offsets (q_start is data), so T never enters VMEM whole and
 the compiled signature depends ONLY on (T, R, W) — one program per token
 budget, not per (chunk × batch × width) bucket.
 
-Sliding windows and attention sinks match the decode kernel; int8 KV pages
-take the XLA fallback (engine/model._ragged_attention dequantizes in the
-gather), as do shapes with KV·hd not lane-aligned.
+Sliding windows and attention sinks match the decode kernel. int8 KV pages
+dequantize IN the kernel: per-(slot, head) f32 scales ride as constant-block
+VMEM operands in the lane-packed TRANSPOSED ``[KV, padded_slots]`` layout
+(slots on the lane dim), rebased per layer via ``scale_slot_base`` — the
+§4b design the bucketed decode kernel proved (docs/PERF_NOTES.md; the
+4-DMA HBM-scale variant measured 2.9× slower on-chip). Scores dequant in
+the [TQ·H, bs] domain through one tiny seg_oh matmul per page, and v-scales
+fold into p before the PV matmul, so int8 pages cost the same two DMAs per
+page as bf16 at half the bytes. The only remaining degrades to
+:func:`ragged_attention_xla` are non-lane-aligned KV·hd and scale tables
+past the VMEM budget — both static shape facts the engine counts and logs
+(``dynamo_ragged_fallback_total``), never a silent data-dependent branch.
+``DYN_RAGGED_ORACLE=1`` routes to the XLA oracle explicitly (bench/test
+A/B arms only).
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -51,17 +63,35 @@ def ragged_pallas_supported(num_kv_heads: int, head_dim: int) -> bool:
     return (num_kv_heads * head_dim) % _LANE == 0
 
 
+def ragged_int8_kernel_supported(num_kv_heads: int, sc_slots: int) -> bool:
+    """True when the per-layer k/v scale tables fit the VMEM-resident
+    budget in the lane-packed transposed [KV, padded_slots] layout
+    (sublane pads KV→8, lane pads slots→128) — same accounting as the
+    decode kernel's gate. ``sc_slots`` is the PER-LAYER slot count (the
+    layer-stacked caller passes one layer's slice + scale_slot_base)."""
+    padded_slots = -(-sc_slots // _LANE) * _LANE
+    scale_bytes = 2 * (-(-num_kv_heads // 8) * 8) * padded_slots * 4
+    return scale_bytes <= int(os.environ.get("DYN_KV_SCALE_VMEM_BYTES",
+                                             32 << 20))
+
+
 def _ragged_kernel(rows3_ref, block_tables_ref, win_ref,  # scalar prefetch
+                   sbase_ref,  # scalar pf; sbase = scale-table slot base
                    sink_ref,   # [1, H, 1] VMEM (zeros when has_sink=False)
                    q_ref,      # [Tpad, H·KVhd] HBM (block-expanded, scaled)
                    kcache_ref, vcache_ref,  # [slots, KVhd] HBM
-                   out_ref,    # [Tpad, H·KVhd] HBM
-                   qbuf, obuf,  # [TQ, H·KVhd] VMEM scratch
-                   kbuf, vbuf,  # [D, bs, KVhd] VMEM scratch
-                   qo_sem, dma_sem,
-                   *, bs: int, tq: int, H: int, has_sink: bool):
+                   *rest,  # [ksc_ref, vsc_ref ([KV, padded_slots] VMEM),]
+                           # out_ref, qbuf, obuf, kbuf, vbuf, qo_sem, dma_sem
+                   bs: int, tq: int, H: int, has_sink: bool, quant: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if quant:
+        (ksc_ref, vsc_ref, out_ref, qbuf, obuf, kbuf, vbuf,
+         qo_sem, dma_sem) = rest
+    else:
+        out_ref, qbuf, obuf, kbuf, vbuf, qo_sem, dma_sem = rest
+        ksc_ref = vsc_ref = None
 
     r = pl.program_id(0)
     q_start = rows3_ref[r, 0]
@@ -89,6 +119,17 @@ def _ragged_kernel(rows3_ref, block_tables_ref, win_ref,  # scalar prefetch
                               dma_sem.at[slot, 1]).wait()
 
     n_tiles = (q_len + tq - 1) // tq
+
+    if quant:
+        # static head→segment one-hot [H, KV]: head h's per-key scale is
+        # seg_oh @ scale-page — one tiny MXU matmul instead of
+        # lane-expanding scales into the [bs, KVhd] domain (same trick as
+        # the decode kernel)
+        KV = ksc_ref.shape[0]
+        G = H // KV
+        oh_rows = jax.lax.broadcasted_iota(jnp.int32, (H, KV), 0)
+        oh_cols = jax.lax.broadcasted_iota(jnp.int32, (H, KV), 1)
+        seg_oh = (oh_cols == oh_rows // G).astype(jnp.float32)
 
     def tile_body(t, _carry):
         tok0 = q_start + t * tq
@@ -125,6 +166,19 @@ def _ragged_kernel(rows3_ref, block_tables_ref, win_ref,  # scalar prefetch
             s = jax.lax.dot_general(
                 qt, kpage, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # [TQ·H, bs]
+            if quant:
+                # dequant scores before masking: the VMEM-resident scale
+                # tables are TRANSPOSED [KV, padded_slots] (slots on the
+                # lane dim), sliced per page and rebased onto the caller's
+                # per-layer scale slice
+                blk = block_tables_ref[r, w]
+                soff = blk * bs - sbase_ref[0]
+                ksc = jax.lax.dot_general(
+                    seg_oh, ksc_ref[:, pl.ds(soff, bs)],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [H, bs]
+                s = s * jnp.broadcast_to(
+                    ksc[None], (tq, H, bs)).reshape(tq * H, bs)
 
             rows = jax.lax.broadcasted_iota(jnp.int32, (tq * H, bs), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (tq * H, bs), 1)
@@ -139,8 +193,18 @@ def _ragged_kernel(rows3_ref, block_tables_ref, win_ref,  # scalar prefetch
             corr = jnp.exp(m - new_m)
             p = jnp.exp(s - new_m)
             new_l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+            pv_p = p
+            if quant:
+                # fold per-key v-scales into p (head h's own segment; other
+                # segments become garbage the caller discards anyway)
+                vsc = jax.lax.dot_general(
+                    seg_oh, vsc_ref[:, pl.ds(soff, bs)],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [H, bs]
+                pv_p = p * jnp.broadcast_to(
+                    vsc[None], (tq, H, bs)).reshape(tq * H, bs)
             pv = jax.lax.dot_general(
-                p, vpage, (((1,), (0,)), ((), ())),
+                pv_p, vpage, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)  # [TQ·H, KVhd]
 
             @pl.when(w + D < num_pages)
@@ -178,10 +242,23 @@ def _ragged_kernel(rows3_ref, block_tables_ref, win_ref,  # scalar prefetch
 
 def ragged_paged_attention(q, k_cache, v_cache, block_tables, rows3, *,
                            block_size: int, interpret: bool = False,
-                           window=None, sinks=None, tq: int = 8):
+                           window=None, sinks=None, tq: int = 8,
+                           k_scales=None, v_scales=None,
+                           scale_slot_base=None):
     """Ragged paged attention over a packed token batch. See module
-    docstring for the contract. Falls back to :func:`ragged_attention_xla`
-    when KV·hd is not lane-aligned."""
+    docstring for the contract.
+
+    ``k_scales``/``v_scales`` [sc_slots, KV] f32 (int8 caches): pages are
+    int8 and dequantize IN the kernel — scales go VMEM-resident in the
+    lane-packed transposed layout, fetched once for the whole grid.
+    ``scale_slot_base`` (traced scalar, default 0): slot offset of the
+    scale tables relative to the page cache — layer-stacked callers pass
+    one layer's scale slice plus ``lidx·slots`` so the VMEM budget is
+    per-layer, not ×L.
+
+    Routes to :func:`ragged_attention_xla` only for non-lane-aligned
+    KV·hd, scale tables past the VMEM budget, or the explicit
+    ``DYN_RAGGED_ORACLE=1`` bench/test oracle switch."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -190,15 +267,22 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, rows3, *,
     G = H // KV
     KVhd = KV * hd
     bs = block_size
-    if not ragged_pallas_supported(KV, hd):
+    quant = k_scales is not None
+    sc_slots = k_scales.shape[0] if quant else 0
+    if (not ragged_pallas_supported(KV, hd)
+            or (quant and not ragged_int8_kernel_supported(KV, sc_slots))
+            or os.environ.get("DYN_RAGGED_ORACLE") == "1"):
         return ragged_attention_xla(
             q, k_cache, v_cache, block_tables, rows3, block_size=bs,
-            window=window, sinks=sinks)
+            window=window, sinks=sinks, k_scales=k_scales,
+            v_scales=v_scales, scale_slot_base=scale_slot_base)
     interpret = interpret or jax.default_backend() != "tpu"
     R, W = block_tables.shape
     has_sink = sinks is not None
     win_arr = jnp.asarray([0 if window is None else window],
                           jnp.int32).reshape(1)
+    sbase_arr = jnp.asarray([0 if scale_slot_base is None
+                             else scale_slot_base], jnp.int32).reshape(1)
     sink_in = (jnp.zeros((1, H, 1), q.dtype) if not has_sink
                else sinks.reshape(1, H, 1).astype(q.dtype))
 
@@ -212,16 +296,34 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, rows3, *,
 
     D = min(W, 8)  # page-pipeline depth (VMEM: 2·D·bs·KVhd·dtype bytes)
     kernel = functools.partial(_ragged_kernel, bs=bs, tq=tq, H=H,
-                               has_sink=has_sink)
+                               has_sink=has_sink, quant=quant)
+    in_specs = [
+        pl.BlockSpec((1, H, 1), lambda r, *_: (0, 0, 0)),
+        pl.BlockSpec(memory_space=_hbm_space(pltpu)),  # qexp
+        pl.BlockSpec(memory_space=_hbm_space(pltpu)),  # k pages
+        pl.BlockSpec(memory_space=_hbm_space(pltpu)),  # v pages
+    ]
+    operands = [sink_in, qexp, k_cache.reshape(slots, KVhd),
+                v_cache.reshape(slots, KVhd)]
+    if quant:
+        # constant block index → Pallas fetches the scale tables once and
+        # keeps them resident across the whole (R,) grid. Transposed so
+        # slots ride the (cheap) lane dim — see the decode kernel's budget
+        # note for why [slots, KV] would tile-pad KV→128.
+        padded_slots = -(-sc_slots // _LANE) * _LANE
+
+        def lane_pack_t(s):
+            s = s.astype(jnp.float32).T  # [KV, sc_slots]
+            return jnp.pad(s, ((0, 0), (0, padded_slots - sc_slots)))
+
+        in_specs += [
+            pl.BlockSpec((KV, padded_slots), lambda r, *_: (0, 0)),
+            pl.BlockSpec((KV, padded_slots), lambda r, *_: (0, 0))]
+        operands += [lane_pack_t(k_scales), lane_pack_t(v_scales)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(R,),
-        in_specs=[
-            pl.BlockSpec((1, H, 1), lambda r, *_: (0, 0, 0)),
-            pl.BlockSpec(memory_space=_hbm_space(pltpu)),  # qexp
-            pl.BlockSpec(memory_space=_hbm_space(pltpu)),  # k pages
-            pl.BlockSpec(memory_space=_hbm_space(pltpu)),  # v pages
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(memory_space=_hbm_space(pltpu)),
         scratch_shapes=[
             pltpu.VMEM((tq, H * KVhd), q.dtype),       # qbuf
@@ -238,8 +340,7 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, rows3, *,
         out_shape=jax.ShapeDtypeStruct((T + tq, H * KVhd), q.dtype),
         interpret=interpret,
     )(rows3.astype(jnp.int32), block_tables.astype(jnp.int32), win_arr,
-      sink_in, qexp, k_cache.reshape(slots, KVhd),
-      v_cache.reshape(slots, KVhd))
+      sbase_arr, *operands)
 
     # pick each head's own KV segment back out of the expanded domain
     out_full = out_full[:T].reshape(T, H, KV, hd)
@@ -248,10 +349,14 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, rows3, *,
 
 
 def ragged_attention_xla(q, k_cache, v_cache, block_tables, rows3, *,
-                         block_size: int, window=None, sinks=None):
-    """Reference/fallback path: per-token dense gather through XLA, same
+                         block_size: int, window=None, sinks=None,
+                         k_scales=None, v_scales=None,
+                         scale_slot_base=None):
+    """Reference/oracle path: per-token dense gather through XLA, same
     masking semantics as the kernel — the oracle the kernel tests pin, and
-    the path non-lane-aligned shapes take."""
+    the path non-lane-aligned shapes take. int8 caches dequantize in the
+    gather with the same ``k_scales``/``v_scales``/``scale_slot_base``
+    contract as the kernel."""
     T, H, hd = q.shape
     KV = k_cache.shape[1]
     G = H // KV
@@ -275,8 +380,16 @@ def ragged_attention_xla(q, k_cache, v_cache, block_tables, rows3, *,
 
     slot_idx = (block_tables[:, :, None] * bs
                 + jnp.arange(bs)[None, None, :]).reshape(R, Tk)
-    k = k_cache[slot_idx][row_ids].astype(jnp.float32)  # [T, Tk, KV, hd]
-    v = v_cache[slot_idx][row_ids].astype(jnp.float32)
+    k = k_cache[slot_idx].astype(jnp.float32)  # [R, Tk, KV, hd]
+    v = v_cache[slot_idx].astype(jnp.float32)
+    if k_scales is not None:
+        # int8 pages: dequant in the gather, rebasing slot ids onto the
+        # caller's (possibly per-layer) scale slice
+        sidx = slot_idx - (0 if scale_slot_base is None else scale_slot_base)
+        k = k * k_scales[sidx].astype(jnp.float32)[..., None]
+        v = v * v_scales[sidx].astype(jnp.float32)[..., None]
+    k = k[row_ids]  # [T, Tk, KV, hd]
+    v = v[row_ids]
 
     qg = q.reshape(T, KV, G, hd).astype(jnp.float32)
     s = jnp.einsum("tkgd,tskd->tkgs", qg, k) / np.sqrt(hd)
