@@ -1486,6 +1486,429 @@ async def onboard_bench(on_tpu: bool = False, reps: int = 2,
     }
 
 
+async def sessions_bench(on_tpu: bool = False, n_sessions: int = 3,
+                         n_turns: int = 4) -> dict:
+    """``bench.py --sessions``: session-native vs sessionless serving A/B
+    (ISSUE 20 acceptance; docs/sessions.md).
+
+    A 2-worker tiny-cpu fleet behind the real HTTP frontend serves
+    multi-turn conversations. Between turns, churn traffic floods the
+    device pool AND the (deliberately small, disk-less) host tier, so by
+    the time a session returns its prefix has been evicted from every
+    radix-visible tier. The session-native arm rides the full product:
+    delta turns over ``previous_response_id``, router affinity, idle-KV
+    parking to G4 during think-time, proactive restore on return. The
+    sessionless control (``store=false``, full transcript each turn)
+    recomputes everything. Gates: bit-identical conversations across
+    arms, turn-2+ TTFT p95 ratio ≤ 0.5, strictly fewer computed prompt
+    tokens AND prefill chip-seconds per session, concurrent non-session
+    QoS TTFT ratio ≤ 1.2, parked+restored G4 blocks actually observed,
+    and the TTL reaper collecting an abandoned session."""
+    import random
+
+    import aiohttp
+
+    from benchmarks.client import (run_session_trace, session_headers,
+                                   stream_request, stream_responses_request)
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import AsyncJaxEngine
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.kvbm.distributed import ObjectStoreG4Client
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+    from dynamo_tpu.router.publisher import KvEventPublisher
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.sessions import SESSION_ENDPOINT, SessionKvHandler
+
+    # Deliberately beefier than ModelConfig.tiny(): at 2 layers / hidden 64
+    # the prefill is so cheap (~1.6ms/block on CPU) that the restore+onboard
+    # memcpy (~1ms/block) rivals recompute and the TTFT win saturates near
+    # 0.7x. Widening the model raises compute quadratically in hidden size
+    # while KV bytes (copy cost) grow only linearly, so FLOPs dominate and
+    # the A/B measures what sessions actually buy: skipped prefill compute.
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=384, intermediate_size=768,
+        num_layers=4, num_heads=8, num_kv_heads=4, rope_theta=10000.0,
+        max_position_embeddings=4096, dtype="float32",
+    )
+    bs = 16
+    model = "tiny-sess"
+    blk_bytes = 2 * cfg.num_layers * bs * cfg.num_kv_heads * (
+        cfg.hidden_size // cfg.num_heads) * 4
+    # G2 must hold one full restored session prefix (fetch_remote lands
+    # leading→trailing; a host tier smaller than the prefix would LRU the
+    # leading blocks before admission probes them) yet still be small
+    # enough for a churn gap to evict completely
+    host_blocks = 160
+
+    # tokenizer whose vocab covers the model's sampled ids (the _e2e
+    # discipline) — the stock "test" tokenizer maps every synthetic word
+    # to <unk>, which would fuse all prompts into one shared prefix and
+    # void the whole eviction/restore A/B. Space-joined template keeps
+    # the token stream of turn N a strict prefix of turn N+1.
+    tmp = tempfile.mkdtemp(prefix="bench-sess-tk-")
+    _write_tokenizer_dir(tmp, cfg.vocab_size)
+    with open(os.path.join(tmp, "tokenizer_config.json"), "w") as f:
+        json.dump({"chat_template": "{% for m in messages %}"
+                                    "{{ m['content'] }} {% endfor %}"}, f)
+
+    prng = random.Random(202)
+
+    def words(n):
+        return " ".join(f"w{prng.randrange(1, cfg.vocab_size)}"
+                        for _ in range(n))
+
+    def eargs():
+        return EngineArgs(block_size=bs, num_blocks=224, max_num_seqs=12,
+                          max_num_batched_tokens=1024, max_model_len=2560,
+                          enable_prefix_caching=True,
+                          kvbm_host_bytes=host_blocks * blk_bytes)
+
+    async def make_worker(rt, rcfg, g4):
+        wrt = await DistributedRuntime.create(plane=rt.plane,
+                                              owns_plane=False, config=rcfg)
+        lease = await wrt.primary_lease()
+        eng = await asyncio.to_thread(AsyncJaxEngine, cfg, eargs())
+        pub = KvEventPublisher(wrt.plane, worker_id=lease, kv_block_size=bs)
+        await pub.start_resync_responder()
+        eng.event_cb = pub.publish_sync
+        eng.kvbm.attach_remote(g4, 1 << 30)
+        comp = wrt.namespace("dynamo").component("backend")
+        handler = DecodeWorkerHandler(eng, metrics=wrt.metrics)
+        handler.instance_id = lease
+        ep = comp.endpoint("generate")
+        h_gen = await ep.serve_endpoint(handler.generate, lease_id=lease)
+        h_sess = await comp.endpoint(SESSION_ENDPOINT).serve_endpoint(
+            SessionKvHandler(eng, metrics=wrt.metrics).generate,
+            lease_id=lease)
+        card = ModelDeploymentCard(
+            display_name=model, kv_cache_block_size=bs, eos_token_ids=[],
+            tokenizer_ref=tmp)
+        card.runtime_config.total_kv_blocks = eng.num_blocks
+        card.runtime_config.max_num_seqs = 12
+        await register_llm(wrt, ep, card, lease_id=lease)
+        w = type("W", (), {})()
+        w.rt, w.engine, w.lease, w.pub = wrt, eng, lease, pub
+        w.handles = [h_gen, h_sess]
+        return w
+
+    async def close_worker(w):
+        for h in w.handles:
+            await h.stop(graceful=False)
+        await w.pub.stop()
+        await w.engine.close()
+        await w.rt.shutdown()
+
+    p95 = _p95
+    rcfg = RuntimeConfig(lease_ttl=8.0)
+    rt = await DistributedRuntime.create(config=rcfg)
+    workers = []
+    watcher = service = reap_service = None
+    env_keys = {"DYN_SESSION_PARK_AFTER_S": "0.6",
+                "DYN_SESSION_REAP_INTERVAL_S": "0.15",
+                "DYN_SESSION_RESTORE_WAIT_S": "2.0"}
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+    try:
+        os.environ.update(env_keys)
+        g4 = ObjectStoreG4Client(rt.plane, asyncio.get_running_loop())
+        workers = [await make_worker(rt, rcfg, g4) for _ in range(2)]
+        manager = ModelManager()
+        watcher = await ModelWatcher(rt, manager, router_mode="kv").start()
+        service = HttpService(manager, port=0)
+        await service.start()
+        for _ in range(200):
+            served = manager.get(model)
+            if served is not None and len(served.client.available_ids()) == 2:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise TimeoutError("fleet never appeared in discovery")
+        base = f"http://127.0.0.1:{service.port}"
+
+        # identical conversations AND identical per-gap churn across both
+        # arms: the compute comparison is then apples-to-apples and the
+        # bit-identity gate is meaningful (greedy + shared weight seed)
+        convos = [[words(1400 if t == 0 else 150) for t in range(n_turns)]
+                  for s in range(n_sessions)]
+        n_gaps = n_sessions * (n_turns - 1)
+        churn_sets = [([words(500) for _ in range(12)], words(16))
+                      for _ in range(n_gaps)]
+
+        async def churn_and_qos(http, gap, qos_ttfts):
+            """Flood both tiers with one-shot strangers while a concurrent
+            interactive probe measures non-session QoS TTFT."""
+            churn, probe_prompt = churn_sets[gap]
+
+            async def churn_one(p):
+                r = await stream_request(http, base, model, p, 4)
+                assert r.ok, f"churn failed: {r.error}"
+
+            async def probe():
+                # several sequential probes per gap: p95 over 4x gaps
+                # samples instead of one max-prone sample per gap
+                for suffix in ("", " w9 w8", " w7", " w6 w5 w4"):
+                    r = await stream_request(http, base, model,
+                                             probe_prompt + suffix, 8)
+                    assert r.ok, f"qos probe failed: {r.error}"
+                    qos_ttfts.append(r.ttft_s)
+
+            await asyncio.gather(*[churn_one(p) for p in churn], probe())
+
+        async def wait_parked(http, sid, timeout=8.0):
+            for _ in range(int(timeout / 0.05)):
+                async with http.get(f"{base}/v1/sessions") as r:
+                    snap = await r.json()
+                for s in snap.get("sessions", []):
+                    if s["id"] == sid and s["parked"]:
+                        return True
+                await asyncio.sleep(0.05)
+            return False
+
+        async def warm(http):
+            """Compile + fault-in every measured surface off the record on
+            BOTH workers (steered via set_busy_instances): single prefills
+            at the conversation sizes, a churn-shaped concurrent burst (the
+            big ragged token buckets), the turn osl's decode buckets, AND a
+            full park→evict→restore→onboard session cycle per worker — the
+            first measured restore must not pay one-time scatter compiles
+            or cold code paths the control arm never touches. Then flush
+            all tiers."""
+            for i, w in enumerate(workers):
+                others = [x.lease for x in workers if x is not w]
+                served.client.set_busy_instances(others)
+                for n_words in (2300, 1400, 600, 150, 55, 30):
+                    r = await stream_request(http, base, model,
+                                             words(n_words), 24)
+                    assert r.ok, f"warmup failed: {r.error}"
+                burst = await asyncio.gather(
+                    *[stream_request(http, base, model, words(500), 4)
+                      for _ in range(6)],
+                    stream_request(http, base, model, words(16), 8))
+                assert all(r.ok for r in burst), "warmup burst failed"
+                sid, prev = f"warm-s{i}", None
+                for t in range(2):
+                    res = await stream_responses_request(
+                        http, base, model,
+                        [{"role": "user",
+                          "content": words(1400 if t == 0 else 250)}],
+                        24, previous_response_id=prev,
+                        headers=session_headers(sid),
+                        sampling={"temperature": 0.0})
+                    assert res.ok, f"warm session failed: {res.error}"
+                    prev = res.response_id
+                    if t == 0:
+                        assert await wait_parked(http, sid), "warm park"
+                        evict = await asyncio.gather(
+                            *[stream_request(http, base, model, words(500),
+                                             4) for _ in range(10)])
+                        assert all(r.ok for r in evict), "warm evict failed"
+            served.client.set_busy_instances([])
+            for w in workers:
+                w.engine.pool.clear()
+                await asyncio.to_thread(w.engine.kvbm.clear)
+
+        async def run_arm(http, native: bool) -> dict:
+            marks = [len(w.engine.step_trace) for w in workers]
+            c0 = [(w.engine.scheduler.prefix_query_tokens,
+                   w.engine.scheduler.prefix_hit_tokens) for w in workers]
+            first_ttfts, later_ttfts, qos_ttfts = [], [], []
+            texts, turn_hit_blocks, turn_ttfts_ms = [], [], []
+            parked_misses = gap = 0
+            for s in range(n_sessions):
+                sid = f"{'native' if native else 'ctl'}-s{s}"
+                transcript, prev, arm_texts = [], None, []
+                for t in range(n_turns):
+                    item = {"role": "user", "content": convos[s][t]}
+                    if native and prev is not None:
+                        items = [item]
+                    else:
+                        items = transcript + [item]
+                    sampling = {"temperature": 0.0}
+                    if not native:
+                        sampling["store"] = False
+                    th0 = sum(w.engine.scheduler.prefix_hit_tokens
+                              for w in workers)
+                    res = await stream_responses_request(
+                        http, base, model, items, 24,
+                        previous_response_id=prev if native else None,
+                        headers=session_headers(sid) if native else None,
+                        sampling=sampling)
+                    assert res.ok, f"turn failed: {res.error}"
+                    turn_hit_blocks.append(
+                        (sum(w.engine.scheduler.prefix_hit_tokens
+                             for w in workers) - th0) // bs)
+                    turn_ttfts_ms.append(round(res.ttft_s * 1000, 1))
+                    (first_ttfts if t == 0 else later_ttfts).append(
+                        res.ttft_s)
+                    arm_texts.append(res.text)
+                    transcript += [item,
+                                   {"role": "assistant", "content": res.text}]
+                    prev = res.response_id
+                    if t < n_turns - 1:
+                        # think-time: the native arm's session goes idle
+                        # long enough for the reaper to park it, THEN the
+                        # churn wave hits; the control gets the same wave
+                        # after an equivalent pause
+                        if native:
+                            if not await wait_parked(http, sid):
+                                parked_misses += 1
+                        else:
+                            await asyncio.sleep(0.9)
+                        await churn_and_qos(http, gap, qos_ttfts)
+                        # identical settle in both arms: let the churn
+                        # wave's background offload/cascade tail drain so
+                        # turn TTFTs measure the serving path, not copy
+                        # traffic the arms share anyway
+                        await asyncio.sleep(0.35)
+                        gap += 1
+                # session boundary: let the reaper's FINAL park of this
+                # session (it idles forever now) land before the next
+                # session's turns start, so that park's G4 publish burst
+                # can't jitter a measured TTFT; control idles equivalently
+                if native:
+                    if not await wait_parked(http, sid):
+                        parked_misses += 1
+                else:
+                    await asyncio.sleep(0.9)
+                texts.append(arm_texts)
+            chip_s = sum(
+                sum(e[3] for e in list(w.engine.step_trace)[m:]) / 1000.0
+                for w, m in zip(workers, marks))
+            query = sum(w.engine.scheduler.prefix_query_tokens - q0
+                        for w, (q0, _h0) in zip(workers, c0))
+            hits = sum(w.engine.scheduler.prefix_hit_tokens - h0
+                       for w, (_q0, h0) in zip(workers, c0))
+            return {"first_ttfts": first_ttfts, "later_ttfts": later_ttfts,
+                    "qos_ttfts": qos_ttfts, "texts": texts,
+                    "chip_s": chip_s, "query_tokens": query,
+                    "hit_tokens": hits,
+                    "computed_prompt_tokens": query - hits,
+                    "turn_hit_blocks": turn_hit_blocks,
+                    "turn_ttfts_ms": turn_ttfts_ms,
+                    "parked_misses": parked_misses}
+
+        timeout = aiohttp.ClientTimeout(total=120)
+        async with aiohttp.ClientSession(timeout=timeout) as http:
+            await warm(http)
+            # control arm first; flush every tier so its residue cannot
+            # feed the native arm (G4 is only ever written by parking)
+            ctl = await run_arm(http, native=False)
+            for w in workers:
+                w.engine.pool.clear()
+                await asyncio.to_thread(w.engine.kvbm.clear)
+            native = await run_arm(http, native=True)
+
+            async with http.get(f"{base}/v1/sessions") as r:
+                snap = await r.json()
+            native_rows = [s for s in snap.get("sessions", [])
+                           if s["id"].startswith("native-")]
+            parked_blocks = sum(s["parked_blocks"] for s in native_rows)
+            restored_blocks = sum(s["restored_blocks"] for s in native_rows)
+            affinity_workers = {s["worker"] for s in native_rows}
+            async with http.get(f"{base}/metrics") as r:
+                mtext = await r.text()
+
+            # session-realistic trace shapes (client.py satellite): an
+            # agent tool-loop session and an abandoned one, driven on a
+            # short-TTL frontend so the reaper demonstrably collects it
+            os.environ["DYN_SESSION_TTL_S"] = "1.2"
+            try:
+                reap_service = HttpService(manager, port=0)
+                await reap_service.start()
+                rbase = f"http://127.0.0.1:{reap_service.port}"
+                trace_rng = random.Random(7)
+                agent = await run_session_trace(
+                    http, [rbase], model, sid="agent", rng=trace_rng,
+                    turns=3, words_per_turn=20, osl=8,
+                    think_s=(0.05, 0.1), tool_loop_p=1.0,
+                    headers=session_headers("agent"),
+                    sampling={"temperature": 0.0})
+                gone = await run_session_trace(
+                    http, [rbase], model, sid="gone", rng=trace_rng,
+                    turns=4, words_per_turn=20, osl=8,
+                    think_s=(0.05, 0.1), abandon_p=1.0,
+                    headers=session_headers("gone"),
+                    sampling={"temperature": 0.0})
+                await asyncio.sleep(2.0)  # TTL 1.2s + reap sweep
+                async with http.get(f"{rbase}/v1/sessions") as r:
+                    reap_snap = await r.json()
+            finally:
+                os.environ.pop("DYN_SESSION_TTL_S", None)
+
+        t2_native, t2_ctl = p95(native["later_ttfts"]), p95(
+            ctl["later_ttfts"])
+        ttft_ratio = t2_native / max(t2_ctl, 1e-9)
+        qos_ratio = (p95(native["qos_ttfts"])
+                     / max(p95(ctl["qos_ttfts"]), 1e-9))
+        identical = native["texts"] == ctl["texts"]
+        reaped = reap_snap["count"] == 0
+        sessions_ok = (
+            identical
+            and ttft_ratio <= 0.5
+            and native["computed_prompt_tokens"]
+            < ctl["computed_prompt_tokens"]
+            and native["chip_s"] < ctl["chip_s"]
+            and qos_ratio <= 1.2
+            and parked_blocks > 0 and restored_blocks > 0
+            and native["parked_misses"] == 0
+            and len(affinity_workers) >= 1
+            and agent.ok and agent.tool_loops > 0 and gone.abandoned
+            and reaped
+            and "dynamo_session_parked_blocks_total" in mtext)
+        return {
+            "sessions_workload": (f"{n_sessions} sessions x {n_turns} "
+                                  f"turns, 2 workers, churn-evicted tiers, "
+                                  "G4 park/restore"),
+            "streams_identical_across_arms": identical,
+            "turn2_ttft_p95_ms_native": round(t2_native * 1000, 1),
+            "turn2_ttft_p95_ms_sessionless": round(t2_ctl * 1000, 1),
+            "turn2_ttft_ratio": round(ttft_ratio, 3),
+            "turn1_ttft_p95_ms_native": round(
+                p95(native["first_ttfts"]) * 1000, 1),
+            "turn1_ttft_p95_ms_sessionless": round(
+                p95(ctl["first_ttfts"]) * 1000, 1),
+            "computed_prompt_tokens_native":
+                native["computed_prompt_tokens"],
+            "computed_prompt_tokens_sessionless":
+                ctl["computed_prompt_tokens"],
+            "prefix_hit_tokens_native": native["hit_tokens"],
+            "prefix_hit_tokens_sessionless": ctl["hit_tokens"],
+            "turn_hit_blocks_native": native["turn_hit_blocks"],
+            "turn_hit_blocks_sessionless": ctl["turn_hit_blocks"],
+            "turn_ttfts_ms_native": native["turn_ttfts_ms"],
+            "turn_ttfts_ms_sessionless": ctl["turn_ttfts_ms"],
+            "prefill_chip_s_native": round(native["chip_s"], 3),
+            "prefill_chip_s_sessionless": round(ctl["chip_s"], 3),
+            "qos_ttft_ratio": round(qos_ratio, 3),
+            "parked_blocks": parked_blocks,
+            "restored_blocks": restored_blocks,
+            "parked_misses": native["parked_misses"],
+            "affinity_workers": sorted(x for x in affinity_workers if x),
+            "agent_trace_ok": agent.ok,
+            "agent_tool_loops": agent.tool_loops,
+            "abandoned_trace": gone.abandoned,
+            "abandoned_reaped": reaped,
+            "sessions_ok": sessions_ok,
+        }
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if reap_service is not None:
+            await reap_service.stop()
+        if service is not None:
+            await service.stop()
+        if watcher is not None:
+            await watcher.stop()
+        for w in workers:
+            await close_worker(w)
+        await rt.shutdown()
+
+
 async def ragged_bench(on_tpu: bool = False, reps: int = 2,
                        modes: bool = True) -> dict:
     """``bench.py --ragged``: per-mode A/B ON the packed ragged launch —
@@ -3391,6 +3814,26 @@ def main():
         print(json.dumps(out), flush=True)
         raise SystemExit(0 if out["ragged_ok"] else 1)
 
+    if "--sessions" in sys.argv:
+        # session-native serving A/B (ISSUE 20): delta turns + affinity +
+        # G4 park/restore vs sessionless full resends on a churn-evicted
+        # 2-worker fleet — prints one JSON line; exits nonzero when a gate
+        # fails (streams not bit-identical across arms, turn-2+ TTFT p95
+        # ratio > 0.5, no prefill-compute win, QoS collateral > 1.2x, no
+        # blocks actually parked/restored, or the reaper failed to collect
+        # an abandoned session)
+        try:
+            out = asyncio.run(sessions_bench(False))
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"sessions": "failed", "error": repr(e)[:300]}),
+                  flush=True)
+            raise SystemExit(1)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0 if out["sessions_ok"] else 1)
+
     if "--quant" in sys.argv:
         # quantized-serving A/B (ISSUE 19): interleaved kernel arms with
         # roofline + bandwidth-floor fields, engine arms with the int8-KV
@@ -3676,14 +4119,14 @@ def _child_main():
                              "kernel,spec,e2e,chaos,mem,qos,autoscale,"
                              "ragged,raggedmodes,disagg,migration,onboard,"
                              "flight,tools,attribution,kvaudit,flagship,"
-                             "frontdoor,quant"
+                             "frontdoor,quant,sessions"
                              ).split(",")
               if p.strip()}
     unknown = phases - {"kernel", "spec", "e2e", "chaos", "mem", "qos",
                         "autoscale", "ragged", "raggedmodes", "disagg",
                         "migration", "onboard", "flight", "tools",
                         "attribution", "kvaudit", "flagship", "frontdoor",
-                        "quant"}
+                        "quant", "sessions"}
     if unknown:
         # a typo'd phase must not masquerade as a 100% perf regression
         raise SystemExit(f"DYN_BENCH_PHASES: unknown phase(s) "
@@ -3691,7 +4134,7 @@ def _child_main():
                          f"chaos, mem, qos, autoscale, ragged, raggedmodes, "
                          f"disagg, migration, onboard, flight, tools, "
                          f"attribution, kvaudit, flagship, frontdoor, "
-                         f"quant)")
+                         f"quant, sessions)")
     try:
         platform, on_tpu = _init_backend()
         model = "llama3-1b" if on_tpu else "tiny-cpu"
@@ -3865,6 +4308,17 @@ def _child_main():
                 kern["frontdoor"] = asyncio.run(frontdoor_drive(22.0))
             except Exception as e:  # noqa: BLE001 — optional extra datum
                 kern["frontdoor_error"] = repr(e)[:200]
+        if "sessions" in phases:
+            # session-native serving phase: delta turns + router affinity
+            # + idle-KV G4 park/restore vs sessionless full resends on a
+            # churn-evicted 2-worker fleet — bit-identical streams,
+            # turn-2+ TTFT p95 ratio ≤ 0.5, strict prefill-compute win,
+            # QoS collateral ≤ 1.2x, reaper collecting abandonment
+            # (ISSUE 20 acceptance)
+            try:
+                kern["sessions"] = asyncio.run(sessions_bench(on_tpu))
+            except Exception as e:  # noqa: BLE001 — optional extra datum
+                kern["sessions_error"] = repr(e)[:200]
         tok_s = kern["kernel_tok_s"]
         if "kernel" in phases:
             fallback_metric = (f"kernel_decode_tok_s_per_chip[{model},"

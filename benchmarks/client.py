@@ -31,6 +31,11 @@ class RequestResult:
     #: made and the URL that produced this result
     attempts: int = 1
     url: Optional[str] = None
+    #: responses-API extras (stream_responses_request): the response id
+    #: (the next delta turn's previous_response_id) and the full text —
+    #: the sessions bench's bit-identity check compares these across arms
+    response_id: Optional[str] = None
+    text: str = ""
 
 
 def make_prompt(rng: random.Random, n_words: int, prefix: str = "") -> str:
@@ -76,6 +81,22 @@ class Mix:
             if x <= 0:
                 return name
         return self.choices[-1][0]
+
+
+def session_headers(session_id: Optional[str],
+                    tenant: Optional[str] = None,
+                    priority: Optional[str] = None) -> dict:
+    """QoS headers + the session identity header (docs/sessions.md).
+
+    ``x-dynamo-session`` buys router affinity and idle-KV parking for every
+    turn that carries it — INCLUDING failover retries: pass the result as
+    ``headers=`` to ``stream_request_ha``/``stream_responses_ha`` and every
+    attempt re-sends it, so a killed frontend cannot strand the session's
+    affinity on the replica that died."""
+    h = qos_headers(tenant, priority)
+    if session_id:
+        h["x-dynamo-session"] = session_id
+    return h
 
 
 def qos_headers(tenant: Optional[str], priority: Optional[str]) -> dict:
@@ -192,6 +213,184 @@ async def stream_request_ha(session: aiohttp.ClientSession, urls: list[str],
         if attempt + 1 < max_attempts:
             await asyncio.sleep(backoff_s * (attempt + 1))
     return res
+
+
+async def stream_responses_request(session: aiohttp.ClientSession, url: str,
+                                   model: str, input_items, max_tokens: int,
+                                   previous_response_id: Optional[str] = None,
+                                   headers: Optional[dict] = None,
+                                   sampling: Optional[dict] = None
+                                   ) -> RequestResult:
+    """Stream one /v1/responses turn; TTFT/ITL keyed on output_text deltas.
+
+    ``input_items`` is a string or a message-item list. With
+    ``previous_response_id`` the items are the TURN DELTA — the frontend's
+    session registry reconstructs the full conversation server-side
+    (docs/sessions.md). The result carries ``response_id`` (the next
+    delta's resume point) and the full ``text`` (bit-identity checks)."""
+    t0 = time.perf_counter()
+    res = RequestResult(ok=False)
+    body = {"model": model, "stream": True, "input": input_items,
+            "max_output_tokens": max_tokens}
+    if previous_response_id is not None:
+        body["previous_response_id"] = previous_response_id
+    for k, v in (sampling or {}).items():
+        body[k] = v
+    try:
+        async with session.post(f"{url}/v1/responses", json=body,
+                                headers=headers or {}) as resp:
+            if resp.status != 200:
+                res.error = f"http {resp.status}"
+                return res
+            import json as _json
+
+            last = None
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                try:
+                    ev = _json.loads(line[6:])
+                except ValueError:
+                    continue
+                typ = ev.get("type")
+                if typ == "response.output_text.delta" and ev.get("delta"):
+                    now = time.perf_counter()
+                    if res.ttft_s is None:
+                        res.ttft_s = now - t0
+                    elif last is not None:
+                        res.itl_s.append(now - last)
+                    last = now
+                    res.tokens += 1
+                elif typ in ("response.completed", "response.incomplete"):
+                    r = ev.get("response") or {}
+                    res.response_id = r.get("id")
+                    out = r.get("output") or []
+                    if out and out[0].get("content"):
+                        res.text = out[0]["content"][0].get("text", "")
+                    u = r.get("usage") or {}
+                    res.prompt_tokens = u.get("input_tokens", 0)
+                    res.completion_tokens = u.get("output_tokens", 0)
+                elif typ == "response.failed":
+                    res.error = "response.failed"
+                    break
+            res.latency_s = time.perf_counter() - t0
+            res.ok = res.ttft_s is not None and res.error is None
+            return res
+    except Exception as e:
+        res.error = repr(e)
+        return res
+
+
+async def stream_responses_ha(session: aiohttp.ClientSession,
+                              urls: list[str], model: str, input_items,
+                              max_tokens: int,
+                              previous_response_id: Optional[str] = None,
+                              headers: Optional[dict] = None,
+                              max_attempts: int = 4,
+                              backoff_s: float = 0.25,
+                              start: int = 0,
+                              sampling: Optional[dict] = None
+                              ) -> RequestResult:
+    """``stream_request_ha`` for the responses route: caller-supplied
+    headers (the session identity included) and the previous_response_id
+    ride EVERY retry attempt, so a frontend kill mid-session neither
+    strands the session's affinity nor silently downgrades a delta turn
+    to a context-free one. NB: an unknown previous_response_id on the
+    surviving replica is a deterministic 404 — _retryable correctly stops
+    there instead of hammering replicas that will all refuse."""
+    urls = [u for u in urls if u]
+    res = RequestResult(ok=False, error="no frontend urls")
+    for attempt in range(max_attempts):
+        url = urls[(start + attempt) % len(urls)]
+        res = await stream_responses_request(
+            session, url, model, input_items, max_tokens,
+            previous_response_id=previous_response_id, headers=headers,
+            sampling=sampling)
+        res.attempts = attempt + 1
+        res.url = url
+        if res.ok or not _retryable(res):
+            return res
+        if attempt + 1 < max_attempts:
+            await asyncio.sleep(backoff_s * (attempt + 1))
+    return res
+
+
+@dataclass
+class SessionResult:
+    """One driven conversation (run_session_trace)."""
+
+    sid: str
+    turns: list = field(default_factory=list)  # RequestResult per turn
+    abandoned: bool = False
+    tool_loops: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return all(t.ok for t in self.turns) and bool(self.turns)
+
+
+async def run_session_trace(session: aiohttp.ClientSession, urls: list[str],
+                            model: str, *, sid: str, rng: random.Random,
+                            turns: int, words_per_turn: int, osl: int,
+                            think_s: tuple[float, float] = (0.5, 2.0),
+                            tool_loop_p: float = 0.0,
+                            abandon_p: float = 0.0,
+                            delta: bool = True,
+                            headers: Optional[dict] = None,
+                            first_prompt: Optional[str] = None,
+                            sampling: Optional[dict] = None,
+                            max_attempts: int = 4,
+                            on_turn=None) -> SessionResult:
+    """Drive one session-realistic conversation (docs/sessions.md):
+    think-time gaps between turns (uniform over ``think_s`` — real users
+    read before they reply), tool loops (with prob ``tool_loop_p`` a turn
+    is followed immediately by a near-zero-think follow-up, the agent-loop
+    shape), and abandonment (with prob ``abandon_p`` the session walks
+    away mid-conversation and never returns — reaper fodder).
+
+    ``delta=True`` is the session-native arm: turn N+1 ships only the new
+    user item + ``previous_response_id``. ``delta=False`` is the
+    sessionless control: the full transcript rides every turn. Both arms
+    produce byte-identical conversations under greedy sampling, which is
+    exactly the bench's bit-identity gate."""
+    out = SessionResult(sid=sid)
+    transcript: list[dict] = []  # client-side mirror of the conversation
+    prev_id: Optional[str] = None
+    t = 0
+    while t < turns:
+        user_text = (first_prompt if (t == 0 and first_prompt is not None)
+                     else make_prompt(rng, words_per_turn, prefix=f"turn{t}"))
+        new_item = {"role": "user", "content": user_text}
+        if delta and prev_id is not None:
+            input_items = [new_item]
+        else:
+            input_items = transcript + [new_item]
+        res = await stream_responses_ha(
+            session, urls, model, input_items, osl,
+            previous_response_id=prev_id if delta else None,
+            headers=headers, start=rng.randrange(len(urls) or 1),
+            max_attempts=max_attempts, sampling=sampling)
+        out.turns.append(res)
+        if on_turn is not None:
+            on_turn(t, res)
+        if not res.ok:
+            break
+        transcript.append(new_item)
+        transcript.append({"role": "assistant", "content": res.text})
+        prev_id = res.response_id
+        t += 1
+        if t >= turns:
+            break
+        if rng.random() < abandon_p:
+            out.abandoned = True
+            break
+        if tool_loop_p and rng.random() < tool_loop_p:
+            out.tool_loops += 1  # agent loop: immediate follow-up
+            await asyncio.sleep(0.01)
+        else:
+            await asyncio.sleep(rng.uniform(*think_s))
+    return out
 
 
 async def run_closed_loop(url: str, model: str, *, concurrency: int,

@@ -804,6 +804,14 @@ async def amain():
 
     clear_handle = await ns.component(component).endpoint(
         "clear_kv_blocks").serve_endpoint(clear_kv_handler, lease_id=lease)
+    # session KV parking/restore (docs/sessions.md): the frontend's session
+    # reaper parks idle sessions' prefixes down the tier ladder here, and a
+    # returning session proactively restores G4 blocks into the host tier
+    from dynamo_tpu.sessions import SESSION_ENDPOINT, SessionKvHandler
+    session_handle = await ns.component(component).endpoint(
+        SESSION_ENDPOINT).serve_endpoint(
+        SessionKvHandler(engine, metrics=runtime.metrics).generate,
+        lease_id=lease)
 
     if cli.role == "prefill" and cli.prefill_queue:
         from dynamo_tpu.disagg.queue import (PrefillQueueWorker,
@@ -902,6 +910,7 @@ async def amain():
         await embed_handle.stop(graceful=False)
     await pull_handle.stop(graceful=False)
     await clear_handle.stop(graceful=False)
+    await session_handle.stop(graceful=False)
     # SIGTERM drain: deregistration (lease key delete) happens first inside
     # stop(), so routers stop picking this worker; in-flight streams then
     # get DYN_DRAIN_TIMEOUT to finish before being cancelled

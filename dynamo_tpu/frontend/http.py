@@ -53,6 +53,8 @@ from dynamo_tpu.runtime.context import (
 )
 from dynamo_tpu.runtime.control_plane import NoRespondersError
 from dynamo_tpu.runtime.metrics import MetricsRegistry, render_registries
+from dynamo_tpu.sessions import (SessionConfig, SessionRegistry,
+                                 UnknownResponseError)
 
 # SSE writers iterate _batched(stream) instead of the raw stream so chunks
 # that pile up while a socket write is in flight coalesce into ONE write —
@@ -179,6 +181,22 @@ class HttpService:
         # grow buckets/counters/metric labels without bound (docs/qos.md)
         self._adhoc_tenants: set = set()
         self._adhoc_overflow_warned = False
+        # session-native serving (docs/sessions.md): conversation state for
+        # /v1/responses delta turns, soft worker affinity for the router,
+        # idle-session KV parking to G4. DYN_SESSIONS=0 → stateless
+        # frontend (previous_response_id turns get the typed 404).
+        _scfg = SessionConfig.load()
+        self.sessions: Optional[SessionRegistry] = (
+            SessionRegistry(_scfg, metrics=self.metrics)
+            if _scfg.enabled else None)
+        self._session_tasks: set = set()
+        # how long a returning turn's dispatch waits for the proactive
+        # restore (0 = never wait, pure tokenize-overlap mode)
+        try:
+            self._session_restore_wait = float(
+                os.environ.get("DYN_SESSION_RESTORE_WAIT_S", "1.0"))
+        except ValueError:
+            self._session_restore_wait = 1.0
         self._draining = False
         self.host = host
         self.port = port
@@ -729,6 +747,9 @@ class HttpService:
         # admin: flush every worker's KV cache/prefix state (ref:
         # lib/llm/src/http/service/clear_kv_blocks.rs)
         app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
+        # live session registry view (docs/sessions.md): the `dynctl
+        # sessions` source
+        app.router.add_get("/v1/sessions", self.handle_sessions)
         return app
 
     async def start(self) -> int:
@@ -761,9 +782,17 @@ class HttpService:
         # multi-replica front door: advertise this replica for LBs, peer
         # scorecards, `dynctl frontends`, and client failover lists
         await self._register_frontend()
+        # session lifecycle loop (docs/sessions.md): park idle sessions'
+        # KV to G4, reap expired ones
+        if self.sessions is not None:
+            self.sessions.start(self._park_session)
         return self.port
 
     async def stop(self):
+        if self.sessions is not None:
+            await self.sessions.stop()
+        for t in list(self._session_tasks):
+            t.cancel()
         if self._attr_task is not None:
             self._attr_task.cancel()
             try:
@@ -782,6 +811,95 @@ class HttpService:
             self._frontend_key = None
         if self._runner:
             await self._runner.cleanup()
+
+    # -- session-native serving (docs/sessions.md) -------------------------
+
+    async def _park_session(self, entry) -> Optional[int]:
+        """Reaper callback: park one idle session's KV prefix down the tier
+        ladder at its affinity worker. None = worker unreachable (retry
+        next sweep); an int is the G4-covered block count."""
+        served = self.manager.get(entry.model)
+        if served is None or not entry.token_ids:
+            return 0
+        res = await served.session_op("park", entry.token_ids,
+                                      instance_id=entry.worker_id)
+        if res is None:
+            return None
+        return int(res.get("blocks") or 0)
+
+    def _spawn_restore(self, entry, served):
+        """Fire the proactive G4→host restore for a returning parked
+        session CONCURRENT with tokenization/routing — by the time the
+        turn's admission builds its onboard plan, the prefix is host-
+        resident and attaches without an object-store round trip. Returns
+        the task so the dispatch path can bound-wait on it (see
+        :meth:`_await_restore`)."""
+
+        async def _restore():
+            try:
+                res = await served.session_op("restore", entry.token_ids,
+                                              instance_id=entry.worker_id)
+                if res is not None and self.sessions is not None:
+                    self.sessions.note_restored(
+                        entry, int(res.get("blocks") or 0))
+            except Exception:
+                logger.exception("session restore for %s failed", entry.sid)
+
+        task = asyncio.get_running_loop().create_task(_restore())
+        self._session_tasks.add(task)
+        task.add_done_callback(self._session_tasks.discard)
+        return task
+
+    async def _await_restore(self, ctx) -> None:
+        """Bound-wait for an in-flight session restore before dispatching
+        the turn. The restore races the pipeline's tokenize→route→admit
+        hops; losing that race silently re-prefills the whole history, so
+        the dispatch waits up to DYN_SESSION_RESTORE_WAIT_S (default 1s,
+        0 = pure overlap mode) — a hung object store degrades to the
+        recompute path instead of wedging the turn."""
+        task = getattr(ctx, "session_restore", None)
+        if task is None or self._session_restore_wait <= 0:
+            return
+        try:
+            # shield: on timeout the restore keeps running (late blocks
+            # still help the NEXT turn) — only the wait is abandoned
+            await asyncio.wait_for(asyncio.shield(task),
+                                   self._session_restore_wait)
+        except asyncio.TimeoutError:
+            logger.warning("session restore still in flight after %.1fs; "
+                           "dispatching without it",
+                           self._session_restore_wait)
+        except Exception:
+            pass  # restore errors are already logged in the task
+
+    def _attach_session(self, ctx, entry, served, kind: str):
+        """Stamp the session identity + affinity on the request Context and
+        open the turn. The router reads ``session_affinity`` as a logit
+        bonus and calls ``on_routed`` back with the serving worker and the
+        prompt's token ids (the in-process feedback loop that keeps the
+        affinity map and the parkable hash chain current)."""
+        ctx.session = entry.sid
+        if entry.worker_id is not None:
+            ctx.session_affinity = entry.worker_id
+        registry = self.sessions
+
+        def on_routed(worker_id, token_ids, _e=entry):
+            registry.note_routed(_e, worker_id, token_ids)
+
+        ctx.on_routed = on_routed
+        was_parked = registry.begin_turn(entry, kind=kind)
+        if was_parked and entry.token_ids:
+            ctx.session_restore = self._spawn_restore(entry, served)
+
+    async def handle_sessions(self, request: web.Request) -> web.Response:
+        """Live session registry view (docs/sessions.md): ids, turns,
+        affinity worker, idle/parked state — the `dynctl sessions` source."""
+        if self.sessions is None:
+            return web.json_response(
+                {"enabled": False, "sessions": [], "count": 0})
+        snap = self.sessions.snapshot()
+        snap["enabled"] = True
+        return web.json_response(snap)
 
     def _request_context(self, request: web.Request,
                          tenant: Optional[str] = None,
@@ -1383,6 +1501,58 @@ class HttpService:
 
         tenant, qos_class = self._resolve_qos(request,
                                               has_tools=bool(parsed.tools))
+
+        # session resolution (docs/sessions.md) BEFORE admission: an
+        # unknown previous_response_id is the caller's typed 404 — it must
+        # not charge quota, and it must NEVER silently fall back to
+        # serving the delta as if it were the full conversation
+        rid = gen_request_id("resp")
+        session_entry = None
+        turn_kind = "full"
+        delta_chars_saved = 0
+        if parsed.previous_response_id is not None:
+            if self.sessions is None:
+                self._requests.inc(route="responses", model=parsed.model,
+                                   status="404")
+                return web.json_response(
+                    error_body("previous_response_id cannot resolve: the "
+                               "session registry is disabled on this "
+                               "frontend (DYN_SESSIONS=0) — resend the "
+                               "full conversation",
+                               "previous_response_not_found", 404),
+                    status=404)
+            try:
+                session_entry = self.sessions.resolve_response(
+                    parsed.previous_response_id)
+            except UnknownResponseError as e:
+                self._requests.inc(route="responses", model=parsed.model,
+                                   status="404")
+                return web.json_response(
+                    error_body(str(e), "previous_response_not_found", 404),
+                    status=404)
+            # delta turn: the client shipped only the new input items —
+            # reconstruct the full prompt from the server-held history
+            if session_entry.messages:
+                delta_chars_saved = sum(
+                    len(str(m.get("content") or ""))
+                    for m in session_entry.messages)
+                parsed.messages = (list(session_entry.messages)
+                                   + list(parsed.messages))
+            turn_kind = "delta"
+        elif self.sessions is not None:
+            sid = request.headers.get("x-dynamo-session")
+            if not sid and parsed.raw.get("store", True) is not False:
+                # anonymous first turn, store=true (the OpenAI default):
+                # the response id we are about to mint is itself a resume
+                # point, so the session is keyed by it — a later delta
+                # turn resolves rid without any header
+                sid = rid
+            if sid:
+                session_entry = self.sessions.get_or_create(
+                    sid, parsed.model, tenant=tenant)
+                if session_entry is not None and session_entry.turns == 0:
+                    turn_kind = "first"
+
         cost = parsed.stop.max_tokens or self.qos.default_cost
         rejection = self._qos_admission(
             "responses", parsed.model, tenant, qos_class, cost)
@@ -1400,8 +1570,9 @@ class HttpService:
         if ctx.expired:
             self.quotas.refund(tenant, cost)
             return self._deadline_reject("responses", parsed.model)
-        rid = gen_request_id("resp")
         created = int(time.time())
+        if session_entry is not None:
+            self._attach_session(ctx, session_entry, served, turn_kind)
         self._begin_request(parsed.model, tenant)
         self._tenant_requests.inc(route="responses", tenant=tenant,
                                   qos=qos_class)
@@ -1413,15 +1584,24 @@ class HttpService:
                 route="responses", model=parsed.model,
                 tenant=tenant, qos=qos_class):
             return await self._handle_responses_inner(
-                request, served, parsed, ctx, rid, created, t0)
+                request, served, parsed, ctx, rid, created, t0,
+                session_entry=session_entry,
+                delta_chars_saved=delta_chars_saved)
 
     async def _handle_responses_inner(self, request, served, parsed, ctx,
-                                      rid, created, t0) -> web.StreamResponse:
+                                      rid, created, t0, session_entry=None,
+                                      delta_chars_saved=0
+                                      ) -> web.StreamResponse:
+        turn_closed = session_entry is None
         try:
+            await self._await_restore(ctx)
             stream = served.pipeline.generate(parsed, ctx)
             if parsed.stream:
+                turn_closed = True  # the SSE path owns turn completion
                 return await self._stream_responses_sse(
-                    request, stream, ctx, parsed.model, rid, created, t0)
+                    request, stream, ctx, parsed.model, rid, created, t0,
+                    parsed=parsed, session_entry=session_entry,
+                    delta_chars_saved=delta_chars_saved)
             try:
                 result = await aggregate_chat_stream(stream)
             except DeadlineExceededError:
@@ -1459,6 +1639,13 @@ class HttpService:
             # "incomplete", everything else "completed"
             status_word = ("incomplete" if choice.get("finish_reason") == "length"
                            else "completed")
+            if session_entry is not None:
+                # the turn's FULL history + reply under the new response
+                # id: the next delta turn resolves rid and prepends this
+                self.sessions.complete_turn(
+                    session_entry, rid, parsed.messages, text,
+                    delta_chars_saved=delta_chars_saved)
+                turn_closed = True
             self._requests.inc(route="responses", model=parsed.model, status="200")
             self._latency.observe(time.perf_counter() - t0, route="responses")
             out = response_object(rid, parsed.model, created, text, status_word,
@@ -1467,10 +1654,16 @@ class HttpService:
                 out["incomplete_details"] = {"reason": "max_output_tokens"}
             return web.json_response(out, headers={"x-request-id": ctx.id})
         finally:
+            if not turn_closed and self.sessions is not None:
+                # failed turn: drop the in-flight mark, store nothing —
+                # the previous response id stays the resume point
+                self.sessions.abort_turn(session_entry)
             self._end_request(parsed.model, ctx.tenant)
 
     async def _stream_responses_sse(self, request, stream, ctx, model,
-                                    rid, created, t0) -> web.StreamResponse:
+                                    rid, created, t0, parsed=None,
+                                    session_entry=None,
+                                    delta_chars_saved=0) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
             headers={"Content-Type": "text/event-stream",
@@ -1585,6 +1778,15 @@ class HttpService:
                                             "".join(parts), "failed")})
             status = "500"
         finally:
+            if session_entry is not None and self.sessions is not None:
+                if status == "200" and parsed is not None:
+                    self.sessions.complete_turn(
+                        session_entry, rid, parsed.messages, "".join(parts),
+                        delta_chars_saved=delta_chars_saved)
+                else:
+                    # broken/failed stream: the reply may be truncated —
+                    # don't store it; the previous id stays the resume point
+                    self.sessions.abort_turn(session_entry)
             self._requests.inc(route="responses", model=model, status=status)
             self._latency.observe(time.perf_counter() - t0, route="responses")
             timing.finish(ctx)
@@ -1642,6 +1844,28 @@ class HttpService:
             # behind a slow LB): reject with 408 before any worker sees it
             self.quotas.refund(tenant, cost)
             return self._deadline_reject(route, parsed.model)
+        # x-dynamo-session on chat/completions (docs/sessions.md): no
+        # server-held conversation state (the client ships full prompts),
+        # but the session still gets router affinity, idle parking, and a
+        # proactive restore when it returns to a parked prefix
+        if self.sessions is not None:
+            sid = request.headers.get("x-dynamo-session")
+            if sid:
+                entry = self.sessions.get_or_create(sid, parsed.model,
+                                                    tenant=tenant)
+                if entry is not None:
+                    ctx.session = entry.sid
+                    if entry.worker_id is not None:
+                        ctx.session_affinity = entry.worker_id
+                    registry = self.sessions
+
+                    def on_routed(worker_id, token_ids, _e=entry):
+                        registry.note_routed(_e, worker_id, token_ids)
+
+                    ctx.on_routed = on_routed
+                    if registry.touch_turn(entry) and entry.token_ids:
+                        ctx.session_restore = self._spawn_restore(
+                            entry, served)
         self._begin_request(parsed.model, tenant)
         self._tenant_requests.inc(route=route, tenant=tenant, qos=qos_class)
         # root span: every downstream phase (tokenize, route, worker,
@@ -1655,6 +1879,7 @@ class HttpService:
                 route=route, model=parsed.model,
                 tenant=tenant, qos=qos_class) as root:
             try:
+                await self._await_restore(ctx)
                 stream = served.pipeline.generate(parsed, ctx)
                 if parsed.stream:
                     return await self._stream_sse(

@@ -509,5 +509,24 @@ class ObjectStoreG4Client:
     def get(self, h: int):
         return self._run(self.plane.object_get(self.BUCKET, self._name(h)))
 
+    def get_many(self, hashes) -> list:
+        """Fetch many objects in ONE thread→loop round trip, gathered
+        concurrently on the plane. A session restore pulls a whole prefix
+        (dozens of blocks); per-block ``get`` calls would pay the
+        run_coroutine_threadsafe hop and the plane RTT serially for each.
+        Returns payloads in ``hashes`` order, ``None`` per miss/error."""
+        hashes = list(hashes)
+        if not hashes:
+            return []
+
+        async def _gather():
+            return await asyncio.gather(
+                *[self.plane.object_get(self.BUCKET, self._name(h))
+                  for h in hashes],
+                return_exceptions=True)
+
+        return [None if isinstance(r, BaseException) else r
+                for r in self._run(_gather())]
+
     def delete(self, h: int) -> None:
         self._run(self.plane.object_delete(self.BUCKET, self._name(h)))

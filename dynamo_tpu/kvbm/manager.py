@@ -319,55 +319,79 @@ class KvbmManager:
         in a worker thread, never on the event loop."""
         budget = len(hashes) if max_blocks is None else int(max_blocks)
         landed = 0
-        for h in hashes:
-            if landed >= budget:
-                break
+        # fetch in chunks so a prefix restore pays one gathered object-store
+        # round trip per ~32 blocks instead of one per block (a chunk past
+        # the first miss wastes at most one chunk of reads, and the landing
+        # loop below still stops at the hole so contiguity holds)
+        chunk_size = 32
+        i, stop = 0, False
+        while i < len(hashes) and landed < budget and not stop:
+            chunk = hashes[i:i + min(chunk_size, budget - landed)]
+            i += len(chunk)
             with self._lock:
                 client = (self.remote.client if self.remote is not None
                           else None)
-                have = (h in self.host
-                        or (self.disk is not None and h in self.disk))
+                have = {h for h in chunk
+                        if h in self.host
+                        or (self.disk is not None and h in self.disk)}
             if client is None:
                 break
-            if have:
-                landed += 1
-                continue
-            try:
-                data = client.get(h)
-            except Exception:
-                logger.exception("kvbm G4 warm fetch failed for %x", h)
-                data = None
-            if data is None:
-                break
-            from dynamo_tpu.kvbm.tiers import RemoteTier
-
-            try:
-                k, v = RemoteTier.decode(data)
-            except Exception:
-                logger.exception("kvbm G4 payload for %x undecodable", h)
-                break
-            with self._lock:
-                if self.remote is None:
+            need = [h for h in chunk if h not in have]
+            fetched: dict = {}
+            if need:
+                getter = getattr(client, "get_many", None)
+                if getter is not None:
+                    try:
+                        fetched = dict(zip(need, getter(need)))
+                    except Exception:
+                        logger.exception("kvbm G4 warm batch fetch failed")
+                else:
+                    for h in need:
+                        try:
+                            fetched[h] = client.get(h)
+                        except Exception:
+                            logger.exception(
+                                "kvbm G4 warm fetch failed for %x", h)
+                            break
+            for h in chunk:
+                if h in have:
+                    landed += 1
+                    continue
+                data = fetched.get(h)
+                if data is None:
+                    stop = True
                     break
-                # record the proven remote residency in the local index.
-                # Budget evictions here drop INDEX entries only — NEVER
-                # queue object deletes: a cold warmer does not own the
-                # fleet's shared objects, and deleting them would poison
-                # every peer's index and the sentinel radix (the
-                # announcer that advertised them could never retract).
-                # The one exception: our OWN queued-but-unwritten put,
-                # which is cancelled outright so it can't orphan an
-                # object the index just forgot.
-                for rh in self.remote.reserve(h, len(data)):
-                    if rh in self._pending_puts:
-                        self._remote_ops = [
-                            op for op in self._remote_ops
-                            if not (op[0] == "put" and op[1] == rh)]
-                        self._pending_puts.discard(rh)
-                        self._disown_g4(rh)
-                removed = self._cascade(self.host.put(h, k, v))
-                self._notify([h], removed)
-            landed += 1
+                from dynamo_tpu.kvbm.tiers import RemoteTier
+
+                try:
+                    k, v = RemoteTier.decode(data)
+                except Exception:
+                    logger.exception("kvbm G4 payload for %x undecodable", h)
+                    stop = True
+                    break
+                with self._lock:
+                    if self.remote is None:
+                        stop = True
+                        break
+                    # record the proven remote residency in the local index.
+                    # Budget evictions here drop INDEX entries only — NEVER
+                    # queue object deletes: a cold warmer does not own the
+                    # fleet's shared objects, and deleting them would poison
+                    # every peer's index and the sentinel radix (the
+                    # announcer that advertised them could never retract).
+                    # The one exception: our OWN queued-but-unwritten put,
+                    # which is cancelled outright so it can't orphan an
+                    # object the index just forgot.
+                    for rh in self.remote.reserve(h, len(data)):
+                        if rh in self._pending_puts:
+                            self._remote_ops = [
+                                op for op in self._remote_ops
+                                if not (op[0] == "put" and op[1] == rh)]
+                            self._pending_puts.discard(rh)
+                            self._disown_g4(rh)
+                    removed = self._cascade(self.host.put(h, k, v))
+                    self._notify([h], removed)
+                landed += 1
         self._drain_remote()
         return landed
 

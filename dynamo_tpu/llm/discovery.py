@@ -48,6 +48,9 @@ class ServedModel:
     embed_client: Optional[Client] = None
     #: lazy client to the worker's "clear_kv_blocks" admin endpoint
     clear_client: Optional[Client] = None
+    #: lazy client to the worker's "kv_session" park/restore endpoint
+    #: (docs/sessions.md)
+    session_client: Optional[Client] = None
     #: prefill-pool watch feeding the router's topology-costed KV-transfer
     #: term (docs/disagg.md); None in aggregated/topology-blind deployments
     prefill_client: Optional[Client] = None
@@ -107,6 +110,40 @@ class ServedModel:
                                 "status": "error", "error": str(e)})
         return results
 
+    async def session_op(self, op: str, token_ids: list,
+                         instance_id=None) -> Optional[dict]:
+        """One ``kv_session`` park/restore op (docs/sessions.md) at the
+        session's affinity worker (direct mode) or any worker. Returns the
+        worker's frame, or None when the fleet has no kv_session surface —
+        parking is an optimization, so an old worker generation or a dead
+        affinity worker degrades to 'nothing parked', never an error."""
+        async with self._embed_lock:
+            if self.session_client is None:
+                from dynamo_tpu.sessions import SESSION_ENDPOINT
+                ep = self._endpoint.component.endpoint(SESSION_ENDPOINT)
+                self.session_client = await ep.client().start()
+        client = self.session_client
+        try:
+            if instance_id is not None and instance_id in set(
+                    client.instance_ids()):
+                stream = await client.generate(
+                    {"op": op, "token_ids": token_ids},
+                    mode="direct", instance_id=instance_id)
+            elif client.instance_ids():
+                stream = await client.generate(
+                    {"op": op, "token_ids": token_ids}, mode="round_robin")
+            else:
+                return None
+            async for frame in stream:
+                if "error" in frame:
+                    logger.warning("kv_session %s failed: %s", op,
+                                   frame["error"])
+                    return None
+                return frame
+        except Exception:
+            logger.exception("kv_session %s op failed", op)
+        return None
+
     async def stop(self):
         if self.monitor:
             self.monitor.unregister_client(self.client)
@@ -115,6 +152,8 @@ class ServedModel:
             await self.embed_client.stop()
         if self.clear_client:
             await self.clear_client.stop()
+        if self.session_client:
+            await self.session_client.stop()
         if self.prefill_client:
             await self.prefill_client.stop()
         if self.router:

@@ -76,6 +76,26 @@ async def run_mocker(
             meta["topo"] = dict(topo)
         handle = await ep.serve_endpoint(engine.generate, lease_id=lease,
                                          metadata=meta)
+        # kv_session stub (docs/sessions.md): mockers have no KVBM tiers,
+        # so park/restore report honest zeros — fleet drives still carry
+        # session traffic end-to-end (frontend registry, affinity routing,
+        # reaper park calls) without wire errors. The stub handle rides
+        # the generate handle's stop() so callers' (engines, handles)
+        # unpacking contract stays exactly one handle per rank.
+        from dynamo_tpu.sessions import SESSION_ENDPOINT, SessionKvHandler
+        session_handle = await runtime.namespace(namespace).component(
+            component).endpoint(SESSION_ENDPOINT).serve_endpoint(
+            SessionKvHandler(None).generate, lease_id=lease)
+        _orig_stop = handle.stop
+
+        async def _stop(*a, _o=_orig_stop, _s=session_handle, **kw):
+            try:
+                await _s.stop(graceful=False)
+            except Exception:
+                pass
+            return await _o(*a, **kw)
+
+        handle.stop = _stop
         engines.append(engine)
         handles.append(handle)
     card = ModelDeploymentCard(

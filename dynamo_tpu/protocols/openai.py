@@ -55,6 +55,9 @@ class ParsedRequest:
     annotations: list[str] = field(default_factory=list)
     backend_instance_id: Optional[int] = None
     router_config_override: Optional[dict] = None
+    #: responses API: continue the server-held conversation that produced
+    #: this id (docs/sessions.md) — the input is the TURN DELTA only
+    previous_response_id: Optional[str] = None
     raw: dict = field(default_factory=dict)
 
 
@@ -442,7 +445,17 @@ def parse_responses_request(body: dict) -> ParsedRequest:
     chat_body["messages"] = messages
     if "max_output_tokens" in body:
         chat_body["max_tokens"] = body["max_output_tokens"]
-    return parse_chat_request(chat_body)
+    req = parse_chat_request(chat_body)
+    # session continuation (docs/sessions.md): the id is resolved by the
+    # frontend's session registry — parsing only validates the shape. The
+    # messages above are then the DELTA the registry prepends history to.
+    prev = body.get("previous_response_id")
+    if prev is not None:
+        if not isinstance(prev, str) or not prev:
+            raise RequestError(
+                "'previous_response_id' must be a non-empty string")
+        req.previous_response_id = prev
+    return req
 
 
 def response_msg_id(request_id: str) -> str:

@@ -146,10 +146,15 @@ class KvRouter:
         router_config_override: Optional[dict] = None,
         priority: Optional[str] = None,
         link_costs: Optional[dict[int, float]] = None,
+        affinity_worker: Optional[int] = None,
     ) -> SchedulingDecision:
         local = compute_block_hash_for_seq(token_ids, self.block_size)
         seq_hashes = compute_seq_hash_for_block(local)
         overlaps = self.indexer.find_matches(local)
+        # a dead affinity worker must not attract a session to a corpse —
+        # the bonus only applies to a live candidate
+        if affinity_worker is not None and affinity_worker not in worker_ids:
+            affinity_worker = None
         decision = self.scheduler.schedule(
             request_id,
             isl_tokens=len(token_ids),
@@ -159,6 +164,7 @@ class KvRouter:
             router_config_override=router_config_override,
             priority=priority,
             link_costs=link_costs,
+            affinity_worker=affinity_worker,
         )
         decision.best_overlap_blocks = overlaps.best()
         if isinstance(self.indexer, ApproxKvIndexer):
@@ -451,12 +457,15 @@ class KvPushRouter:
                     raise NoRespondersError(str(e)) from e
             try:
                 # class-biased cost (docs/qos.md): interactive requests
-                # avoid saturated workers, batch chases cache overlap
+                # avoid saturated workers, batch chases cache overlap;
+                # returning sessions pull softly toward their affinity
+                # worker (docs/sessions.md)
                 decision = self.router.find_best_match(
                     ctx.id, req.token_ids, worker_ids,
                     req.router_config_override,
                     priority=getattr(ctx, "priority", None),
                     link_costs=self._link_costs(),
+                    affinity_worker=getattr(ctx, "session_affinity", None),
                 )
             except NoWorkersError as e:
                 raise NoRespondersError(str(e)) from e
@@ -464,7 +473,19 @@ class KvPushRouter:
                    overlap_blocks=decision.overlap_blocks,
                    candidates=len(worker_ids),
                    tenant=getattr(ctx, "tenant", None) or "default",
-                   qos=getattr(ctx, "priority", None) or "standard")
+                   qos=getattr(ctx, "priority", None) or "standard",
+                   session=getattr(ctx, "session", None) or "")
+            # session feedback (docs/sessions.md): the frontend registry
+            # runs in this same process — hand it the serving worker and
+            # the exact prompt token ids (the hash chain a later park must
+            # address) at decision time, before the stream even starts
+            on_routed = getattr(ctx, "on_routed", None)
+            if on_routed is not None:
+                try:
+                    on_routed(decision.worker_id, req.token_ids)
+                except Exception:
+                    logger.exception("session on_routed hook failed")
+            ctx.routed_worker = decision.worker_id
 
         if req.has_annotation("query_instance_id"):
             # dry route: report the decision without generating

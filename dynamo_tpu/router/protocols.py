@@ -195,6 +195,15 @@ class KvRouterConfig:
     #: both disables the bias. The standard class always uses 1.0.
     qos_interactive_load_factor: float = 2.0
     qos_batch_load_factor: float = 0.5
+    #: session-native serving (docs/sessions.md): bonus subtracted from the
+    #: session's affinity worker's logit, scaled by that worker's potential
+    #: prefill blocks. The affinity worker likely holds the session's
+    #: prefix in tiers the radix undercounts (host-tier after device
+    #: eviction, parked G4 blocks mid-restore), so its true prefill cost is
+    #: far below the radix estimate — but the bonus stays bounded by the
+    #: request size, so the load and link terms can still SHED a returning
+    #: session off a saturated worker. 0.0 disables the term.
+    session_affinity_weight: float = 1.0
     #: network-aware disagg (docs/disagg.md, NetKV arxiv 2606.03910):
     #: weight on the ``transfer_blocks × link_cost`` term of the routing
     #: logit. The term only exists when the prefill pool publishes

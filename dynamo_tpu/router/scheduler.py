@@ -11,7 +11,11 @@ Rebuild of the reference scheduler (ref: lib/llm/src/kv_router/scheduler.rs:
 negated logits at ``router_temperature`` — temperature 0 means argmin with
 random tie-break. The transfer term (docs/disagg.md, NetKV) only exists
 when the caller supplies per-worker link costs from published topology
-labels; an unlabeled fleet is exactly the classic two-term cost.
+labels; an unlabeled fleet is exactly the classic two-term cost. A
+returning session's affinity worker (docs/sessions.md) additionally gets
+``session_affinity_weight * potential_prefill_blocks`` SUBTRACTED — a soft
+pull toward the worker holding the session's KV in radix-invisible tiers,
+sized so load/link pressure can still shed the session elsewhere.
 """
 
 from __future__ import annotations
@@ -114,6 +118,7 @@ class KvScheduler:
         router_config_override: Optional[dict] = None,
         priority: Optional[str] = None,
         link_costs: Optional[dict[int, float]] = None,
+        affinity_worker: Optional[int] = None,
     ) -> SchedulingDecision:
         if not worker_ids:
             raise NoWorkersError("no workers available")
@@ -126,6 +131,8 @@ class KvScheduler:
         temperature = override.get("router_temperature", self.config.router_temperature)
         transfer_weight = override.get("transfer_cost_weight",
                                        self.config.transfer_cost_weight)
+        affinity_weight = override.get("session_affinity_weight",
+                                       self.config.session_affinity_weight)
         load_factor = self._load_factor(priority)
 
         track = seq_hashes if self.config.router_track_active_blocks else None
@@ -153,6 +160,14 @@ class KvScheduler:
                 # cost so decode lands where the KV is cheap to reach
                 logits[w] += (transfer_weight * potential_prefill_block
                               * link_costs.get(w, worst_link))
+            if w == affinity_worker and affinity_weight:
+                # session affinity (docs/sessions.md): this worker served
+                # the session's last turn, so it likely holds the prefix in
+                # tiers the radix undercounts (host tier after device
+                # eviction, parked G4 blocks mid-restore). Discount its
+                # apparent prefill cost — bounded by the request size, so a
+                # saturated worker's load term can still shed the session.
+                logits[w] -= affinity_weight * potential_prefill_block
 
         worker_id = softmax_sample(logits, temperature, self._rng)
         overlap = overlaps.scores.get(worker_id, 0)

@@ -145,12 +145,16 @@ async def start_worker(runtime, out: str, cli):
 
     clear_handle = await backend.endpoint("clear_kv_blocks").serve_endpoint(
         clear_kv_handler)
+    # session KV parking/restore endpoint (docs/sessions.md)
+    from dynamo_tpu.sessions import SESSION_ENDPOINT, SessionKvHandler
+    session_handle = await backend.endpoint(SESSION_ENDPOINT).serve_endpoint(
+        SessionKvHandler(engine).generate)
     card = ModelDeploymentCard(
         display_name=cli.model, kv_cache_block_size=eargs.block_size,
         eos_token_ids=eos, tokenizer_ref=tokenizer_ref or "test")
     card.runtime_config.total_kv_blocks = engine.num_blocks
     await register_llm(runtime, ep, card)
-    handles = [handle, embed_handle, clear_handle]
+    handles = [handle, embed_handle, clear_handle, session_handle]
     if mm_worker is not None:  # duck-typed: _stop_worker calls .stop()
         handles.append(mm_worker)
     return handles

@@ -764,6 +764,68 @@ def _frontends_main(argv: list[str]) -> None:
         frontends_amain(args.url, args.json, args.watch, args.timeout)))
 
 
+async def sessions_amain(url: str, as_json: bool, watch: float = 0.0,
+                         timeout: float = 5.0) -> int:
+    """Live session registry view (docs/sessions.md): GET /v1/sessions off
+    a frontend and render id / turns / affinity worker / idle / parked
+    state. Exit 0 when the registry is enabled (even if empty)."""
+    import aiohttp
+
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=timeout)) as session:
+        while True:
+            try:
+                async with session.get(
+                        f"{url.rstrip('/')}/v1/sessions") as resp:
+                    doc = await resp.json()
+            except Exception as e:
+                print(f"session registry fetch failed: {e}", file=sys.stderr)
+                return 1
+            if as_json:
+                print(json.dumps(doc, indent=2))
+            else:
+                rows = doc.get("sessions") or []
+                print(f"{'session':<26s}{'model':<16s}{'turns':>6s}"
+                      f"{'worker':>18s}{'idle_s':>8s}{'parked':>8s}"
+                      f"{'restored':>9s}  state")
+                for s in rows:
+                    state = ("active" if s.get("active")
+                             else "parked" if s.get("parked") else "idle")
+                    print(f"{str(s.get('id'))[:25]:<26s}"
+                          f"{str(s.get('model'))[:15]:<16s}"
+                          f"{s.get('turns', 0):>6d}"
+                          f"{str(s.get('worker') or '-'):>18s}"
+                          f"{s.get('idle_s', 0.0):>8.1f}"
+                          f"{s.get('parked_blocks', 0):>8d}"
+                          f"{s.get('restored_blocks', 0):>9d}  {state}")
+                print(f"{doc.get('count', 0)}/{doc.get('cap', '-')} sessions"
+                      f" (ttl {doc.get('ttl_s', '-')}s, park after "
+                      f"{doc.get('park_after_s', '-')}s)"
+                      + ("" if doc.get("enabled", True)
+                         else " — registry DISABLED"))
+            if not watch:
+                return 0 if doc.get("enabled", True) else 1
+            await asyncio.sleep(watch)
+            print()
+
+
+def _sessions_main(argv: list[str]) -> None:
+    ap = argparse.ArgumentParser(
+        prog="dynctl sessions",
+        description="show a frontend's live session registry "
+                    "(/v1/sessions: turns, affinity, parked KV)")
+    ap.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="frontend base URL (default http://127.0.0.1:8000)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw registry snapshot")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every N seconds (0 = one-shot)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    raise SystemExit(asyncio.run(
+        sessions_amain(args.url, args.json, args.watch, args.timeout)))
+
+
 def _autoscale_main(argv: list[str]) -> None:
     ap = argparse.ArgumentParser(
         prog="dynctl autoscale",
@@ -817,6 +879,9 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "frontends":
         _frontends_main(sys.argv[2:])
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "sessions":
+        _sessions_main(sys.argv[2:])
         return
     ap = argparse.ArgumentParser(description="dynamo-tpu control plane server")
     ap.add_argument("--host", default="0.0.0.0")

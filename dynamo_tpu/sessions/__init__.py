@@ -1,0 +1,35 @@
+"""Session-native serving (docs/sessions.md, ROADMAP item 5).
+
+Real traffic is not i.i.d. requests — it is chat sessions and agent loops
+that return every few seconds with a growing shared prefix (NetKV, arxiv
+2606.03910). This package makes the session a first-class serving object:
+
+- ``registry``: frontend-resident conversation state keyed by
+  ``x-dynamo-session`` / ``previous_response_id`` (delta turns, TTL + cap,
+  reaping) plus the soft session→worker affinity map the router consumes.
+- ``park``: the worker-side ``kv_session`` endpoint that parks an idle
+  session's KV prefix down the tier ladder to G4 and proactively restores
+  it into the host tier when the session returns.
+"""
+
+from dynamo_tpu.sessions.park import (
+    SESSION_ENDPOINT,
+    SessionKvHandler,
+    session_prefix_hashes,
+)
+from dynamo_tpu.sessions.registry import (
+    SessionConfig,
+    SessionEntry,
+    SessionRegistry,
+    UnknownResponseError,
+)
+
+__all__ = [
+    "SESSION_ENDPOINT",
+    "SessionConfig",
+    "SessionEntry",
+    "SessionKvHandler",
+    "SessionRegistry",
+    "UnknownResponseError",
+    "session_prefix_hashes",
+]

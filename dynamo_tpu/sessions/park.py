@@ -1,0 +1,124 @@
+"""Worker-side session KV parking/restore (the ``kv_session`` endpoint).
+
+An idle session's prompt prefix should ride the tier ladder DOWN (G2/G3 →
+G4 object store) instead of dying by LRU, and ride back UP (G4 → host
+tier) before the session's next turn arrives — docs/sessions.md "Parking".
+The frontend's session reaper drives ``op=park`` at the session's affinity
+worker when the idle threshold passes; a returning turn fires ``op=restore``
+concurrent with tokenization, so by the time admission builds its onboard
+plan the prefix is host-resident and attaches without a G4 round trip.
+
+Keying: parked blocks use the session prefix's canonical hash chain
+(``dynamo_tpu.tokens`` block/sequence hashes) — the same key domain every
+tier and the router's radix speak. The "session scope" lives in the
+frontend registry (which chain belongs to which session); the G4 replica
+itself stays fleet-readable, so a parked session's prefix doubles as
+shared prefix cache for any same-prefix traffic via the sentinel radix.
+
+The handler degrades to an explicit no-op without a KVBM (mocker fleets,
+caching-off engines): fleet drives carry session traffic end-to-end and
+the frontend sees honest zeros instead of wire errors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dynamo_tpu.tokens import KV_HASH_SEED, TokenBlockSequence
+
+logger = logging.getLogger("dynamo.sessions.park")
+
+#: endpoint name on the worker component (sibling of generate/kv_pull)
+SESSION_ENDPOINT = "kv_session"
+
+
+def session_prefix_hashes(token_ids, block_size: int) -> list[int]:
+    """The sequence-hash chain of a prompt's COMPLETE blocks — the keys a
+    park/restore addresses. The ragged tail block never got a KV identity,
+    so it is never parked."""
+    if not token_ids or block_size <= 0:
+        return []
+    seq = TokenBlockSequence.from_tokens(token_ids, block_size, KV_HASH_SEED)
+    return [b.sequence_hash for b in seq.blocks]
+
+
+class SessionKvHandler:
+    """Serves ``kv_session`` ops against this worker's KVBM tiers.
+
+    ``engine=None`` (or an engine without a KVBM) is the stub arm: every op
+    succeeds with ``blocks=0`` so session traffic runs unchanged on mocker
+    fleets and caching-off workers.
+    """
+
+    def __init__(self, engine=None, metrics=None):
+        self.engine = engine
+        self._parked = self._restored = None
+        if metrics is not None:
+            self._parked = metrics.counter(
+                "session_kv_blocks_total",
+                "session KV blocks moved by this worker's kv_session "
+                "endpoint, by op (park|restore)")
+
+    def _kvbm(self):
+        return getattr(self.engine, "kvbm", None) if self.engine else None
+
+    def _block_size(self) -> int:
+        args = getattr(self.engine, "args", None)
+        return getattr(args, "block_size", 0) if args is not None else 0
+
+    def _park(self, hashes: list[int]) -> tuple[int, int]:
+        """Publish the leading locally-resident run to G4. Returns
+        (published, covered): ``covered`` counts blocks now G4-resident
+        (published this call or already there) — the number the session
+        can rely on for its return. Stops at the first block no local
+        tier holds: G4 onboarding attaches contiguous prefixes only, so a
+        gapped park would strand everything behind the hole."""
+        kvbm = self._kvbm()
+        published = covered = 0
+        try:
+            for h in hashes:
+                if kvbm.remote_resident([h]):
+                    covered += 1
+                    continue
+                e = kvbm.get_local(h)
+                if e is None:
+                    break
+                if kvbm.publish_remote(h, e[0], e[1], drain=False):
+                    published += 1
+                    covered += 1
+                else:
+                    break  # G4 not armed: nothing downstream can land
+        finally:
+            kvbm.drain_remote()
+        return published, covered
+
+    async def generate(self, request: dict, ctx=None):
+        op = (request or {}).get("op")
+        token_ids = (request or {}).get("token_ids") or []
+        if op not in ("park", "restore"):
+            yield {"error": f"unknown kv_session op {op!r}"}
+            return
+        kvbm = self._kvbm()
+        bs = self._block_size()
+        if kvbm is None or bs <= 0:
+            yield {"ok": True, "op": op, "blocks": 0, "stub": True}
+            return
+        hashes = session_prefix_hashes(token_ids, bs)
+        if not hashes:
+            yield {"ok": True, "op": op, "blocks": 0}
+            return
+        # tier I/O is blocking (disk reads, object-store round trips):
+        # never on the serving event loop
+        if op == "park":
+            published, covered = await asyncio.to_thread(self._park, hashes)
+            if self._parked is not None and published:
+                self._parked.inc(published, op="park")
+            yield {"ok": True, "op": "park", "blocks": covered,
+                   "published": published, "prefix_blocks": len(hashes)}
+        else:
+            landed = await asyncio.to_thread(kvbm.fetch_remote, hashes)
+            if self._parked is not None and landed:
+                self._parked.inc(landed, op="restore")
+            yield {"ok": True, "op": "restore", "blocks": landed,
+                   "prefix_blocks": len(hashes)}

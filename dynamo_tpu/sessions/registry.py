@@ -1,0 +1,403 @@
+"""Frontend session registry: conversation state, affinity, lifecycle.
+
+The registry is the frontend-process ledger of live sessions (docs/
+sessions.md). It owns three concerns:
+
+1. **Conversation state** — the ``/v1/responses`` route stores each turn's
+   messages plus the assistant reply under the response id it returned, so
+   turn N+1 ships only the delta (``previous_response_id`` + new input).
+   An unknown/expired id is a typed 404 (``UnknownResponseError``), never a
+   silent full-prompt fallback — silently serving a truncated conversation
+   would be a correctness bug dressed as liveness.
+2. **Affinity** — the worker that served the session's last turn, stamped
+   by ``KvPushRouter`` at decision time via the ``on_routed`` ctx hook.
+   The router trades this against overlap/load/link cost; the registry
+   just remembers and reports held-vs-shed outcomes.
+3. **Lifecycle** — bounded TTL + cap (the DYN_QOS_MAX_TENANTS pattern from
+   docs/qos.md: anonymous id churn must not grow frontend state or
+   /metrics cardinality), idle→park scheduling, and reaping.
+
+Entries hold the last routed prompt's token ids so parking can address the
+exact hash chain the worker's KVBM tiers hold.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+logger = logging.getLogger("dynamo.sessions")
+
+
+class UnknownResponseError(Exception):
+    """``previous_response_id`` does not resolve to live session state.
+
+    The route maps this to a typed 404 (``previous_response_not_found``):
+    the client must resend the full conversation. Falling back silently
+    would serve a reply computed from a truncated history.
+    """
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r", name, os.environ.get(name))
+        return default
+
+
+@dataclass
+class SessionConfig:
+    """Env-tunable session knobs (docs/sessions.md "Knobs")."""
+
+    #: DYN_SESSIONS=0 disables the registry entirely (stateless frontend)
+    enabled: bool = True
+    #: DYN_SESSION_TTL_S: idle seconds before a session (and its
+    #: previous_response_id chain) is reaped
+    ttl_s: float = 600.0
+    #: DYN_SESSION_MAX: live-session cap — the cardinality-DoS guard
+    #: (mirrors DYN_QOS_MAX_TENANTS): at the cap, new session ids are
+    #: served statelessly with a one-shot warning instead of growing state
+    max_sessions: int = 4096
+    #: DYN_SESSION_PARK_AFTER_S: idle seconds before the session's KV
+    #: prefix is parked down the tier ladder to G4; 0 disables parking
+    park_after_s: float = 30.0
+    #: reaper scan cadence
+    reap_interval_s: float = 5.0
+
+    @staticmethod
+    def load() -> "SessionConfig":
+        return SessionConfig(
+            enabled=os.environ.get("DYN_SESSIONS", "1") not in ("0", "false"),
+            ttl_s=_env_float("DYN_SESSION_TTL_S", 600.0),
+            max_sessions=int(_env_float("DYN_SESSION_MAX", 4096)),
+            park_after_s=_env_float("DYN_SESSION_PARK_AFTER_S", 30.0),
+            reap_interval_s=_env_float("DYN_SESSION_REAP_INTERVAL_S", 5.0),
+        )
+
+
+@dataclass
+class SessionEntry:
+    sid: str
+    model: str
+    tenant: Optional[str] = None
+    created: float = 0.0
+    last_seen: float = 0.0
+    turns: int = 0
+    #: full conversation (user/system/tool turns + assistant replies) —
+    #: what a delta turn's prompt is reconstructed from
+    messages: list = field(default_factory=list)
+    #: latest response id; only the latest resolves — older ids in the
+    #: chain expire with the state they referenced (bounded memory)
+    response_id: Optional[str] = None
+    #: soft affinity: worker that served the last turn (router hook)
+    worker_id: Optional[int] = None
+    #: the last routed prompt's token ids — the hash chain parking targets
+    token_ids: Optional[list] = None
+    parked: bool = False
+    parked_blocks: int = 0
+    restored_blocks: int = 0
+    #: prompt chars the client did NOT re-ship thanks to delta turns
+    delta_chars_saved: int = 0
+    #: a turn is in flight (parking while active would race the engine)
+    active: int = 0
+
+    def summary(self, now: float) -> dict:
+        return {
+            "id": self.sid,
+            "model": self.model,
+            "tenant": self.tenant,
+            "turns": self.turns,
+            "messages": len(self.messages),
+            "response_id": self.response_id,
+            "worker": f"{self.worker_id:x}" if self.worker_id else None,
+            "idle_s": round(max(0.0, now - self.last_seen), 3),
+            "parked": self.parked,
+            "parked_blocks": self.parked_blocks,
+            "restored_blocks": self.restored_blocks,
+            "prompt_tokens": len(self.token_ids or ()),
+            "delta_chars_saved": self.delta_chars_saved,
+            "active": self.active > 0,
+        }
+
+
+class SessionRegistry:
+    """Live-session ledger with bounded state and an idle park/reap loop.
+
+    ``clock`` is injectable for tests (defaults to ``time.monotonic``).
+    Metric families land under ``dynamo_session_*`` when a metrics
+    registry is supplied; per-session labels are deliberately NOT used —
+    the cap bounds registry entries, but metrics stay aggregate so even a
+    full registry adds zero scrape cardinality.
+    """
+
+    def __init__(self, config: Optional[SessionConfig] = None, metrics=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config or SessionConfig.load()
+        self.clock = clock
+        self._by_sid: dict[str, SessionEntry] = {}
+        self._by_response: dict[str, str] = {}  # response id -> sid
+        self._cap_warned = False
+        self._reaper_task: Optional[asyncio.Task] = None
+        self._m_turns = self._m_reaped = self._m_rejected = None
+        self._m_affinity = self._m_parked = self._m_parked_blocks = None
+        self._m_restored_blocks = self._m_delta_chars = None
+        if metrics is not None:
+            metrics.gauge(
+                "session_active",
+                "live sessions in this frontend's registry").add_callback(
+                lambda: {None: float(len(self._by_sid))})
+            self._m_turns = metrics.counter(
+                "session_turns_total",
+                "session turns served, by kind (first|delta|full)")
+            self._m_reaped = metrics.counter(
+                "session_reaped_total",
+                "sessions dropped from the registry, by reason")
+            self._m_rejected = metrics.counter(
+                "session_rejected_total",
+                "session creations refused (served statelessly), by reason")
+            self._m_affinity = metrics.counter(
+                "session_affinity_total",
+                "routing outcomes for returning sessions "
+                "(held = same worker, shed = load/link term won)")
+            self._m_parked = metrics.counter(
+                "session_parked_total", "idle sessions parked to G4")
+            self._m_parked_blocks = metrics.counter(
+                "session_parked_blocks_total",
+                "KV blocks published to G4 by idle-session parking")
+            self._m_restored_blocks = metrics.counter(
+                "session_restored_blocks_total",
+                "KV blocks proactively restored from G4 for returning "
+                "sessions")
+            self._m_delta_chars = metrics.counter(
+                "session_delta_chars_saved_total",
+                "prompt characters reconstructed server-side instead of "
+                "re-shipped by the client (delta turns)")
+
+    def __len__(self) -> int:
+        return len(self._by_sid)
+
+    # -- turn lifecycle ----------------------------------------------------
+
+    def resolve_response(self, previous_response_id: str) -> SessionEntry:
+        """Look up the session a ``previous_response_id`` continues.
+
+        Raises :class:`UnknownResponseError` for ids that never existed,
+        expired with their session, or were superseded by a later turn in
+        the same session (only the latest id resolves — forking from
+        mid-chain state the registry no longer holds must be explicit)."""
+        sid = self._by_response.get(previous_response_id)
+        entry = self._by_sid.get(sid) if sid else None
+        if entry is None:
+            raise UnknownResponseError(
+                f"previous_response_id '{previous_response_id}' not found "
+                "(expired, reaped, or superseded by a later turn) — resend "
+                "the full conversation")
+        return entry
+
+    def get_or_create(self, sid: str, model: str,
+                      tenant: Optional[str] = None) -> Optional[SessionEntry]:
+        """Fetch or create the entry for an ``x-dynamo-session`` id.
+
+        Returns None at the cap (cardinality-DoS guard): the request is
+        served statelessly — correct output, no affinity/state — with a
+        one-shot warning, mirroring the QoS adhoc-tenant demotion."""
+        entry = self._by_sid.get(sid)
+        if entry is not None:
+            return entry
+        if len(self._by_sid) >= self.config.max_sessions:
+            if not self._cap_warned:
+                self._cap_warned = True
+                logger.warning(
+                    "session cap reached (%d, DYN_SESSION_MAX): new session "
+                    "ids are served statelessly; further overflows are "
+                    "silent", self.config.max_sessions)
+            if self._m_rejected is not None:
+                self._m_rejected.inc(reason="capacity")
+            return None
+        now = self.clock()
+        entry = SessionEntry(sid=sid, model=model, tenant=tenant,
+                             created=now, last_seen=now)
+        self._by_sid[sid] = entry
+        return entry
+
+    def begin_turn(self, entry: SessionEntry, kind: str = "full") -> bool:
+        """Mark a turn in flight; returns True when the session was parked
+        (the caller should fire a proactive restore concurrent with
+        tokenization — the returning turn's admission then attaches from
+        the prewarmed host tier instead of a G4 round trip)."""
+        entry.last_seen = self.clock()
+        entry.turns += 1
+        entry.active += 1
+        was_parked = entry.parked
+        entry.parked = False
+        if self._m_turns is not None:
+            self._m_turns.inc(kind=kind)
+        return was_parked
+
+    def touch_turn(self, entry: SessionEntry) -> bool:
+        """Chat-route variant of :meth:`begin_turn`: affinity + park/restore
+        lifecycle without in-flight tracking — chat stores no conversation
+        state, so there is no completion call to pair with. Returns True
+        when the session was parked (caller fires the proactive restore)."""
+        entry.last_seen = self.clock()
+        entry.turns += 1
+        was_parked = entry.parked
+        entry.parked = False
+        if self._m_turns is not None:
+            self._m_turns.inc(kind="chat")
+        return was_parked
+
+    def note_routed(self, entry: SessionEntry, worker_id: int,
+                    token_ids=None):
+        """Router decision hook (``ctx.on_routed``): remember the serving
+        worker and the exact prompt token ids — the hash chain any later
+        park must address. Counts affinity held/shed for the scorecard."""
+        if self._m_affinity is not None:
+            if entry.worker_id is None:
+                self._m_affinity.inc(outcome="new")
+            elif entry.worker_id == worker_id:
+                self._m_affinity.inc(outcome="held")
+            else:
+                self._m_affinity.inc(outcome="shed")
+        entry.worker_id = worker_id
+        if token_ids:
+            entry.token_ids = list(token_ids)
+
+    def complete_turn(self, entry: SessionEntry, response_id: Optional[str],
+                      messages: Optional[list] = None,
+                      assistant_text: Optional[str] = None,
+                      delta_chars_saved: int = 0):
+        """Store the turn's outcome: full message history + the assistant
+        reply under the new response id. ``messages`` is the FULL prompt
+        history of this turn (already reconstructed for delta turns)."""
+        entry.active = max(0, entry.active - 1)
+        entry.last_seen = self.clock()
+        if messages is not None:
+            history = list(messages)
+            if assistant_text is not None:
+                history.append({"role": "assistant",
+                                "content": assistant_text})
+            entry.messages = history
+        if response_id is not None:
+            if entry.response_id is not None:
+                self._by_response.pop(entry.response_id, None)
+            entry.response_id = response_id
+            self._by_response[response_id] = entry.sid
+        if delta_chars_saved > 0:
+            entry.delta_chars_saved += delta_chars_saved
+            if self._m_delta_chars is not None:
+                self._m_delta_chars.inc(delta_chars_saved)
+
+    def abort_turn(self, entry: SessionEntry):
+        """A turn that never completed (client gone, worker error): drop
+        the in-flight mark without storing state."""
+        entry.active = max(0, entry.active - 1)
+        entry.last_seen = self.clock()
+
+    def note_parked(self, entry: SessionEntry, blocks: int):
+        entry.parked = True
+        entry.parked_blocks += blocks
+        if self._m_parked is not None:
+            self._m_parked.inc()
+        if self._m_parked_blocks is not None and blocks > 0:
+            self._m_parked_blocks.inc(blocks)
+
+    def note_restored(self, entry: SessionEntry, blocks: int):
+        entry.restored_blocks += blocks
+        if self._m_restored_blocks is not None and blocks > 0:
+            self._m_restored_blocks.inc(blocks)
+
+    # -- lifecycle sweeps --------------------------------------------------
+
+    def park_candidates(self) -> list[SessionEntry]:
+        """Sessions idle past the park threshold with a known prefix and
+        worker, not yet parked, no turn in flight. The caller marks each
+        via :meth:`note_parked` after the worker acks."""
+        if self.config.park_after_s <= 0:
+            return []
+        now = self.clock()
+        return [e for e in self._by_sid.values()
+                if not e.parked and e.active == 0 and e.token_ids
+                and e.worker_id is not None
+                and now - e.last_seen >= self.config.park_after_s]
+
+    def reap(self) -> list[SessionEntry]:
+        """Drop sessions idle past the TTL. Their response ids stop
+        resolving (typed 404 on the next delta turn). Parked G4 blocks are
+        NOT deleted — G4 runs its own capacity policy, and a same-prefix
+        stranger can still hit them via the sentinel radix."""
+        now = self.clock()
+        dead = [e for e in self._by_sid.values()
+                if e.active == 0 and now - e.last_seen >= self.config.ttl_s]
+        for e in dead:
+            self._by_sid.pop(e.sid, None)
+            if e.response_id is not None:
+                self._by_response.pop(e.response_id, None)
+            if self._m_reaped is not None:
+                self._m_reaped.inc(reason="expired")
+        if dead and len(self._by_sid) < self.config.max_sessions:
+            self._cap_warned = False  # back under the cap: warn again next time
+        return dead
+
+    async def run_reaper(self, park_cb=None):
+        """Background loop: park idle sessions (via ``park_cb(entry)``, an
+        async callable that talks to the affinity worker's ``kv_session``
+        endpoint) and reap expired ones. Parking marks the entry BEFORE the
+        ack so a slow park is not re-fired every scan; a failed park
+        unmarks it for retry next sweep."""
+        while True:
+            await asyncio.sleep(self.config.reap_interval_s)
+            try:
+                self.reap()
+                if park_cb is None:
+                    continue
+                for entry in self.park_candidates():
+                    entry.parked = True  # claim before the await (no re-fire)
+                    try:
+                        blocks = await park_cb(entry)
+                    except Exception:
+                        logger.exception("parking session %s failed",
+                                         entry.sid)
+                        entry.parked = False
+                        continue
+                    if blocks is None:  # worker unreachable: retry later
+                        entry.parked = False
+                        continue
+                    entry.parked = False  # note_parked re-marks + counts
+                    self.note_parked(entry, blocks)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("session reaper sweep failed")
+
+    def start(self, park_cb=None):
+        if self._reaper_task is None:
+            self._reaper_task = asyncio.get_running_loop().create_task(
+                self.run_reaper(park_cb))
+
+    async def stop(self):
+        if self._reaper_task is not None:
+            self._reaper_task.cancel()
+            try:
+                await self._reaper_task
+            except asyncio.CancelledError:
+                pass
+            self._reaper_task = None
+
+    def snapshot(self) -> dict:
+        """The ``/v1/sessions`` + ``dynctl sessions`` view."""
+        now = self.clock()
+        sessions = sorted((e.summary(now) for e in self._by_sid.values()),
+                          key=lambda s: s["idle_s"])
+        return {
+            "sessions": sessions,
+            "count": len(sessions),
+            "cap": self.config.max_sessions,
+            "ttl_s": self.config.ttl_s,
+            "park_after_s": self.config.park_after_s,
+        }
